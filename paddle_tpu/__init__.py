@@ -1,0 +1,74 @@
+"""paddle_tpu: a TPU-native deep-learning framework with the capabilities of
+the reference PaddlePaddle snapshot (see SURVEY.md), built on JAX/XLA/Pallas.
+
+Public surface mirrors ``paddle.*`` so reference users can switch: tensor ops,
+``nn``, ``optimizer``, ``amp``, ``io``, ``jit``, ``distributed``, ``vision``.
+Compute is XLA-compiled (eager per-op jit cache; whole-program via ``jit``);
+parallelism is mesh-based GSPMD rather than runtime collectives.
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+from .core import autograd  # noqa: F401
+from .core.autograd import enable_grad, grad, is_grad_enabled, no_grad, set_grad_enabled  # noqa: F401
+from .core.device import (  # noqa: F401
+    CPUPlace,
+    CUDAPlace,
+    Place,
+    TPUPlace,
+    device_count,
+    get_device,
+    is_compiled_with_cuda,
+    is_compiled_with_tpu,
+    set_device,
+)
+from .core.dtype import (  # noqa: F401
+    bfloat16,
+    bool_,
+    complex64,
+    complex128,
+    float16,
+    float32,
+    float64,
+    get_default_dtype,
+    int8,
+    int16,
+    int32,
+    int64,
+    set_default_dtype,
+    uint8,
+)
+from .core.flags import all_flags, get_flags, set_flags  # noqa: F401
+from .core.rng import get_rng_state, seed, set_rng_state  # noqa: F401
+from .core.tensor import Tensor, to_tensor  # noqa: F401
+
+# op surface (paddle.* functions)
+from .ops import *  # noqa: F401,F403
+from .ops import creation, linalg, manipulation, math, random  # noqa: F401
+
+# subpackages (imported lazily by users: paddle_tpu.nn, .optimizer, ...)
+from . import nn  # noqa: F401,E402
+from . import optimizer  # noqa: F401,E402
+from . import amp  # noqa: F401,E402
+from . import io  # noqa: F401,E402
+from . import jit  # noqa: F401,E402
+from . import device  # noqa: F401,E402
+from .framework import io as framework_io  # noqa: F401,E402
+from .framework.io import load, save  # noqa: F401,E402
+
+bool = bool_  # paddle.bool alias
+
+
+def disable_static():  # API parity: we are always "dygraph"
+    pass
+
+
+def enable_static():
+    raise NotImplementedError(
+        "paddle_tpu has no legacy static-graph mode; use paddle_tpu.jit.to_static (XLA compiles traced functions)"
+    )
+
+
+def in_dynamic_mode():
+    return True

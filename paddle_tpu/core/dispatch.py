@@ -1,0 +1,116 @@
+"""Eager op dispatch.
+
+Replaces the reference's kernel dispatch stack — KernelKey lookup
+(ref:paddle/phi/core/kernel_factory.h:324 SelectKernelOrThrowError) plus the
+generated PHI C++ API (ref:paddle/phi/api/yaml/generator/api_base.py). On TPU
+the "kernel" is an XLA executable: eager ops are dispatched through a per-
+(fn, static-args) ``jax.jit`` cache, so the second call with the same shapes
+hits a compiled executable — the KernelFactory idea with the compiler as the
+kernel library.
+
+Every op goes through :func:`apply`:
+  * unwraps Tensor args to jax arrays,
+  * runs the pure function (jitted in eager mode, raw under an outer trace),
+  * records a TapeNode when autograd is on and an input requires grad,
+  * wraps outputs back into Tensors with correct ``stop_gradient``.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from . import flags
+from .autograd import TapeNode, is_grad_enabled
+from .tensor import Tensor
+
+_JIT_CACHE: Dict[Tuple, Any] = {}
+_amp = None  # set lazily to break the import cycle
+
+
+def _init_amp():
+    global _amp
+    if _amp is None:
+        from .. import amp as _amp_mod
+
+        _amp = _amp_mod
+
+
+def _jitted(fn, static: Tuple):
+    key = (fn, static)
+    ex = _JIT_CACHE.get(key)
+    if ex is None:
+        ex = jax.jit(functools.partial(fn, **dict(static))) if static else jax.jit(fn)
+        _JIT_CACHE[key] = ex
+    return ex
+
+
+def _check_nan_inf(name, outs):
+    import numpy as np
+
+    for o in outs:
+        arr = np.asarray(o)
+        if arr.dtype.kind in "fc" and not np.isfinite(arr).all():
+            msg = f"NaN/Inf detected in output of op '{name}'"
+            if flags.flag("check_nan_inf_level") == 0:
+                raise FloatingPointError(msg)
+            print("WARNING:", msg)
+
+
+def apply(fn, tensor_args: Tuple, static: Dict[str, Any], *, differentiable: bool = True, name: str = None):
+    """Run pure function ``fn(*arrays, **static)`` over Tensor/array args."""
+    name = name or fn.__name__.lstrip("_")
+    datas = tuple(t._data if isinstance(t, Tensor) else jnp.asarray(t) for t in tensor_args)
+    if _amp is not None and _amp.amp_state() is not None:
+        datas = _amp.maybe_cast_inputs(name, datas)
+    tracing = any(isinstance(d, jax.core.Tracer) for d in datas)
+    static_t = tuple(sorted(static.items())) if static else ()
+
+    if tracing or not flags.flag("eager_jit_ops"):
+        out = fn(*datas, **static) if static else fn(*datas)
+    else:
+        out = _jitted(fn, static_t)(*datas)
+
+    multi = isinstance(out, (tuple, list))
+    outs = tuple(out) if multi else (out,)
+
+    requires_grad = (
+        differentiable
+        and not tracing
+        and is_grad_enabled()
+        and any(isinstance(t, Tensor) and not t.stop_gradient for t in tensor_args)
+    )
+
+    if flags.flag("check_nan_inf") and not tracing:
+        _check_nan_inf(name, outs)
+
+    if requires_grad:
+        # in_tensors aligns 1:1 with fn's positional args for the vjp zip;
+        # non-Tensor entries (python scalars) get no cotangent.
+        node = TapeNode(fn, static_t, datas, tensor_args, multi, name)
+        out_tensors = []
+        for o in outs:
+            t = Tensor(o, stop_gradient=False)
+            t._node = node
+            node.add_output(t)
+            out_tensors.append(t)
+    else:
+        sg = not (
+            not tracing
+            and is_grad_enabled()
+            and differentiable
+            and any(isinstance(t, Tensor) and not t.stop_gradient for t in tensor_args)
+        )
+        # under tracing, propagate stop_gradient flags so jit.grad can honor them
+        if tracing:
+            sg = not (
+                differentiable
+                and any(isinstance(t, Tensor) and not t.stop_gradient for t in tensor_args)
+            )
+        out_tensors = [Tensor(o, stop_gradient=sg) for o in outs]
+
+    if multi:
+        return tuple(out_tensors)
+    return out_tensors[0]

@@ -1,0 +1,3 @@
+"""paddle.distributed equivalent — mesh-first (fleshed out in later stages)."""
+from . import env  # noqa: F401
+from .env import get_rank, get_world_size  # noqa: F401

@@ -1,0 +1,13 @@
+"""Test env: CPU backend with 8 virtual devices (the fake-mesh layer for
+distributed logic tests — SURVEY.md §4 implication (c))."""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# golden tests compare against float64 numpy: pin full-precision matmuls
+# (the library default stays fast/bf16 on TPU)
+import jax  # noqa: E402
+
+jax.config.update("jax_default_matmul_precision", "highest")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"

@@ -1,0 +1,70 @@
+"""Numpy-golden op test harness.
+
+Port of the reference OpTest idea (ref:python/paddle/fluid/tests/unittests/
+eager_op_test.py:324): run the framework op, compare the output against a
+numpy reference, and compare analytic (tape) gradients against central finite
+differences (their get_numeric_gradient, delta 0.005).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+
+
+def check_output(op_fn, np_fn, np_inputs, rtol=1e-5, atol=1e-6, kwargs=None):
+    """op_fn(tensors, **kwargs) vs np_fn(arrays, **kwargs)."""
+    kwargs = kwargs or {}
+    tensors = [paddle.to_tensor(a) for a in np_inputs]
+    out = op_fn(*tensors, **kwargs)
+    ref = np_fn(*np_inputs, **kwargs)
+    outs = out if isinstance(out, (tuple, list)) else [out]
+    refs = ref if isinstance(ref, (tuple, list)) else [ref]
+    assert len(outs) == len(refs), f"output arity {len(outs)} vs ref {len(refs)}"
+    for o, r in zip(outs, refs):
+        np.testing.assert_allclose(np.asarray(o.numpy(), dtype=np.float64) if o.numpy().dtype.kind == "f" else o.numpy(),
+                                   np.asarray(r), rtol=rtol, atol=atol)
+    return outs
+
+
+def numeric_grad(op_fn, np_inputs, wrt_idx, kwargs=None, delta=5e-3, out_idx=0):
+    """Central-difference gradient of sum(op(x)) w.r.t. inputs[wrt_idx]."""
+    kwargs = kwargs or {}
+
+    def f(arrays):
+        tensors = [paddle.to_tensor(a) for a in arrays]
+        out = op_fn(*tensors, **kwargs)
+        out = out[out_idx] if isinstance(out, (tuple, list)) else out
+        return float(np.sum(out.numpy().astype(np.float64)))
+
+    base = [np.array(a, dtype=np.float64) for a in np_inputs]
+    x = base[wrt_idx]
+    g = np.zeros_like(x)
+    it = np.nditer(x, flags=["multi_index"])
+    while not it.finished:
+        idx = it.multi_index
+        orig = x[idx]
+        x[idx] = orig + delta
+        fp = f([b.astype(np_inputs[i].dtype) for i, b in enumerate(base)])
+        x[idx] = orig - delta
+        fm = f([b.astype(np_inputs[i].dtype) for i, b in enumerate(base)])
+        x[idx] = orig
+        g[idx] = (fp - fm) / (2 * delta)
+        it.iternext()
+    return g
+
+
+def check_grad(op_fn, np_inputs, wrt=(0,), kwargs=None, rtol=1e-2, atol=1e-3, out_idx=0):
+    """Analytic (tape) grads vs finite differences."""
+    kwargs = kwargs or {}
+    tensors = [paddle.to_tensor(a, stop_gradient=False) for a in np_inputs]
+    out = op_fn(*tensors, **kwargs)
+    out = out[out_idx] if isinstance(out, (tuple, list)) else out
+    loss = out.sum() if out.size > 1 else out
+    loss.backward()
+    for i in wrt:
+        assert tensors[i].grad is not None, f"no grad for input {i}"
+        num = numeric_grad(op_fn, [np.array(a) for a in np_inputs], i, kwargs, out_idx=out_idx)
+        np.testing.assert_allclose(tensors[i].grad.numpy().astype(np.float64), num, rtol=rtol, atol=atol,
+                                   err_msg=f"analytic vs numeric grad mismatch for input {i}")

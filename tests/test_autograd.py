@@ -1,0 +1,142 @@
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+RNG = np.random.RandomState(2)
+
+
+def test_backward_scalar():
+    x = paddle.to_tensor([2.0, 3.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [4.0, 6.0])
+
+
+def test_backward_chain():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = paddle.exp(x)
+    z = paddle.log(y) * 3.0
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [3.0], rtol=1e-5)
+
+
+def test_grad_accumulation():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    (x * 2).sum().backward()
+    (x * 3).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [5.0, 5.0])
+
+
+def test_shared_subexpression():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = x * x  # used twice
+    z = y + y
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [8.0])
+
+
+def test_matmul_grad():
+    a = RNG.rand(2, 3).astype(np.float32)
+    b = RNG.rand(3, 4).astype(np.float32)
+    x = paddle.to_tensor(a, stop_gradient=False)
+    y = paddle.to_tensor(b, stop_gradient=False)
+    paddle.matmul(x, y).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), np.ones((2, 4)) @ b.T, rtol=1e-5)
+    np.testing.assert_allclose(y.grad.numpy(), a.T @ np.ones((2, 4)), rtol=1e-5)
+
+
+def test_stop_gradient_blocks():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = paddle.to_tensor([2.0], stop_gradient=True)
+    z = (x * y).sum()
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+    assert y.grad is None
+
+
+def test_detach_blocks():
+    x = paddle.to_tensor([3.0], stop_gradient=False)
+    y = x * 2
+    d = y.detach()
+    z = (d * x).sum()
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [6.0])
+
+
+def test_no_grad_context():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    with paddle.no_grad():
+        y = x * 2
+    assert y.stop_gradient
+    assert y._node is None
+
+
+def test_paddle_grad_api():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = x * x * x
+    (gx,) = paddle.grad(y, x)
+    np.testing.assert_allclose(gx.numpy(), [12.0], rtol=1e-5)
+    assert x.grad is None  # functional API must not mutate .grad
+
+
+def test_grad_allow_unused():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    u = paddle.to_tensor([1.0], stop_gradient=False)
+    y = x * 2
+    gx, gu = paddle.grad(y, [x, u], allow_unused=True)
+    assert gu is None
+    np.testing.assert_allclose(gx.numpy(), [2.0])
+
+
+def test_backward_nonscalar_with_grad_tensor():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = x * 3
+    y.backward(paddle.to_tensor([1.0, 0.5]))
+    np.testing.assert_allclose(x.grad.numpy(), [3.0, 1.5])
+
+
+def test_backward_nonscalar_requires_grad_tensor():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = x * 3
+    with pytest.raises(RuntimeError):
+        y.backward()
+
+
+def test_hook():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = x * 2
+    seen = []
+
+    def hook(g):
+        seen.append(g.numpy().copy())
+        return g * 10
+
+    y.register_hook(hook)
+    (y * 3).sum().backward()
+    assert seen and seen[0][0] == pytest.approx(3.0)
+    np.testing.assert_allclose(x.grad.numpy(), [60.0])
+
+
+def test_multi_output_op_grad():
+    x = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3), stop_gradient=False)
+    parts = paddle.split(x, 3, axis=1)
+    loss = (parts[0] * 1 + parts[1] * 2 + parts[2] * 3).sum()
+    loss.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [[1, 2, 3], [1, 2, 3]])
+
+
+def test_double_backward_not_required_for_clear():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    x.clear_grad()
+    assert x.grad is None
+
+
+def test_int_input_no_grad():
+    x = paddle.to_tensor(np.array([1.0, 2.0], np.float32), stop_gradient=False)
+    idx = paddle.to_tensor(np.array([1, 0]))
+    out = paddle.gather(x, idx).sum()
+    out.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [1.0, 1.0])

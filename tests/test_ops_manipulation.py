@@ -1,0 +1,106 @@
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from op_test import check_grad, check_output
+
+RNG = np.random.RandomState(1)
+
+
+def test_reshape_flatten_transpose():
+    x = RNG.rand(2, 3, 4).astype(np.float32)
+    check_output(paddle.reshape, lambda a, shape: a.reshape(shape), [x], kwargs=dict(shape=[4, 6]))
+    check_output(paddle.flatten, lambda a, start_axis=0, stop_axis=-1: a.reshape(2, 12), [x], kwargs=dict(start_axis=1))
+    check_output(paddle.transpose, lambda a, perm: a.transpose(perm), [x], kwargs=dict(perm=[2, 0, 1]))
+
+
+def test_concat_stack_split():
+    xs = [RNG.rand(2, 3).astype(np.float32) for _ in range(3)]
+    out = paddle.concat([paddle.to_tensor(a) for a in xs], axis=1)
+    np.testing.assert_allclose(out.numpy(), np.concatenate(xs, axis=1))
+    out = paddle.stack([paddle.to_tensor(a) for a in xs], axis=0)
+    np.testing.assert_allclose(out.numpy(), np.stack(xs, axis=0))
+    parts = paddle.split(paddle.to_tensor(xs[0]), 3, axis=1)
+    assert len(parts) == 3 and parts[0].shape == [2, 1]
+    parts = paddle.split(paddle.to_tensor(xs[0]), [1, -1], axis=1)
+    assert parts[1].shape == [2, 2]
+
+
+def test_squeeze_unsqueeze_expand_tile():
+    x = RNG.rand(1, 3, 1).astype(np.float32)
+    assert paddle.squeeze(paddle.to_tensor(x)).shape == [3]
+    assert paddle.squeeze(paddle.to_tensor(x), axis=0).shape == [3, 1]
+    assert paddle.unsqueeze(paddle.to_tensor(x), [0, 2]).shape == [1, 1, 1, 3, 1]
+    assert paddle.expand(paddle.to_tensor(x), [2, 3, 4]).shape == [2, 3, 4]
+    np.testing.assert_allclose(paddle.tile(paddle.to_tensor(x), [2, 1, 2]).numpy(), np.tile(x, [2, 1, 2]))
+
+
+def test_gather_scatter():
+    x = RNG.rand(5, 3).astype(np.float32)
+    idx = np.array([0, 2, 4])
+    np.testing.assert_allclose(paddle.gather(paddle.to_tensor(x), paddle.to_tensor(idx)).numpy(), x[idx])
+    upd = RNG.rand(2, 3).astype(np.float32)
+    out = paddle.scatter(paddle.to_tensor(x), paddle.to_tensor(np.array([1, 3])), paddle.to_tensor(upd))
+    ref = x.copy()
+    ref[[1, 3]] = upd
+    np.testing.assert_allclose(out.numpy(), ref)
+
+
+def test_gather_nd():
+    x = RNG.rand(3, 4, 5).astype(np.float32)
+    idx = np.array([[0, 1], [2, 3]])
+    out = paddle.gather_nd(paddle.to_tensor(x), paddle.to_tensor(idx))
+    np.testing.assert_allclose(out.numpy(), x[[0, 2], [1, 3]])
+
+
+def test_where_masked():
+    x = RNG.rand(3, 4).astype(np.float32)
+    y = RNG.rand(3, 4).astype(np.float32)
+    c = x > 0.5
+    out = paddle.where(paddle.to_tensor(c), paddle.to_tensor(x), paddle.to_tensor(y))
+    np.testing.assert_allclose(out.numpy(), np.where(c, x, y))
+    ms = paddle.masked_select(paddle.to_tensor(x), paddle.to_tensor(c))
+    np.testing.assert_allclose(ms.numpy(), x[c])
+
+
+def test_argmax_sort_topk():
+    x = RNG.rand(3, 5).astype(np.float32)
+    np.testing.assert_array_equal(paddle.argmax(paddle.to_tensor(x), axis=1).numpy(), np.argmax(x, axis=1))
+    np.testing.assert_allclose(paddle.sort(paddle.to_tensor(x), axis=1).numpy(), np.sort(x, axis=1))
+    vals, idx = paddle.topk(paddle.to_tensor(x), 2, axis=1)
+    ref = np.sort(x, axis=1)[:, ::-1][:, :2]
+    np.testing.assert_allclose(vals.numpy(), ref, rtol=1e-6)
+
+
+def test_nonzero_unique():
+    x = np.array([[1, 0], [0, 3]], np.int64)
+    nz = paddle.nonzero(paddle.to_tensor(x))
+    np.testing.assert_array_equal(nz.numpy(), np.stack(np.nonzero(x), axis=1))
+    u = paddle.unique(paddle.to_tensor(np.array([3, 1, 1, 2])))
+    np.testing.assert_array_equal(u.numpy(), [1, 2, 3])
+
+
+def test_one_hot_pad_roll_flip():
+    x = np.array([0, 2, 1])
+    oh = paddle.manipulation.one_hot(paddle.to_tensor(x), 3)
+    np.testing.assert_array_equal(oh.numpy(), np.eye(3)[x])
+    y = RNG.rand(2, 2).astype(np.float32)
+    np.testing.assert_allclose(paddle.roll(paddle.to_tensor(y), 1, axis=0).numpy(), np.roll(y, 1, axis=0))
+    np.testing.assert_allclose(paddle.flip(paddle.to_tensor(y), axis=[0]).numpy(), np.flip(y, axis=0))
+    p = paddle.manipulation.pad(paddle.to_tensor(y), [1, 1, 2, 2], mode="constant", value=0.0, data_format=None)
+    assert p.shape == [4, 6]  # full-spec per-dim (lo,hi) pad
+
+
+def test_grad_manipulation():
+    x = RNG.rand(2, 3).astype(np.float32)
+    check_grad(paddle.reshape, [x], kwargs=dict(shape=[3, 2]))
+    check_grad(paddle.transpose, [x], kwargs=dict(perm=[1, 0]))
+    idx = np.array([0, 1])
+    check_grad(lambda t: paddle.gather(t, paddle.to_tensor(idx)), [x])
+
+
+def test_take_put_along_axis():
+    x = RNG.rand(3, 4).astype(np.float32)
+    idx = np.array([[0, 1, 2, 3], [3, 2, 1, 0], [0, 0, 0, 0]])
+    out = paddle.take_along_axis(paddle.to_tensor(x), paddle.to_tensor(idx), axis=1)
+    np.testing.assert_allclose(out.numpy(), np.take_along_axis(x, idx, axis=1))

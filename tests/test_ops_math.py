@@ -1,0 +1,108 @@
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from op_test import check_grad, check_output
+
+
+RNG = np.random.RandomState(0)
+
+
+@pytest.mark.parametrize(
+    "name",
+    ["abs", "exp", "log", "sqrt", "square", "sin", "cos", "tanh", "floor", "ceil", "sign", "sigmoid"],
+)
+def test_unary_golden(name):
+    x = RNG.rand(3, 4).astype(np.float32) + 0.5
+    np_fn = {
+        "sigmoid": lambda a: 1 / (1 + np.exp(-a)),
+    }.get(name, getattr(np, name, None))
+    # XLA's transcendental approximations differ from libm at ~1e-4
+    check_output(getattr(paddle, name), np_fn, [x], rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("name,np_name", [
+    ("add", "add"), ("subtract", "subtract"), ("multiply", "multiply"), ("divide", "divide"),
+    ("maximum", "maximum"), ("minimum", "minimum"), ("pow", "power"), ("atan2", "arctan2"),
+])
+def test_binary_golden(name, np_name):
+    x = RNG.rand(3, 4).astype(np.float32) + 0.5
+    y = RNG.rand(3, 4).astype(np.float32) + 0.5
+    check_output(getattr(paddle, name), getattr(np, np_name), [x, y])
+
+
+def test_broadcasting():
+    x = RNG.rand(3, 1, 4).astype(np.float32)
+    y = RNG.rand(2, 4).astype(np.float32)
+    check_output(paddle.add, np.add, [x, y])
+
+
+@pytest.mark.parametrize("name", ["sum", "mean", "max", "min", "prod"])
+@pytest.mark.parametrize("axis,keepdim", [(None, False), (0, False), (1, True), ((0, 1), False)])
+def test_reductions(name, axis, keepdim):
+    x = RNG.rand(3, 4, 5).astype(np.float32)
+    def np_fn(a, axis=None, keepdim=False):
+        return getattr(np, name if name != "prod" else "prod")(a, axis=axis, keepdims=keepdim)
+    check_output(getattr(paddle, name), np_fn, [x], kwargs=dict(axis=axis, keepdim=keepdim))
+
+
+def test_logsumexp():
+    from scipy.special import logsumexp as np_lse  # noqa
+
+    x = RNG.rand(3, 4).astype(np.float32)
+    out = paddle.logsumexp(paddle.to_tensor(x), axis=1)
+    ref = np.log(np.sum(np.exp(x), axis=1))
+    np.testing.assert_allclose(out.numpy(), ref, rtol=2e-4)
+
+
+def test_cumsum_clip_scale():
+    x = RNG.rand(3, 4).astype(np.float32)
+    np.testing.assert_allclose(paddle.cumsum(paddle.to_tensor(x), axis=1).numpy(), np.cumsum(x, axis=1), rtol=1e-5)
+    np.testing.assert_allclose(paddle.clip(paddle.to_tensor(x), 0.2, 0.8).numpy(), np.clip(x, 0.2, 0.8))
+    np.testing.assert_allclose(paddle.scale(paddle.to_tensor(x), scale=2.0, bias=1.0).numpy(), x * 2 + 1, rtol=1e-6)
+
+
+def test_comparisons_and_logical():
+    x = np.array([1.0, 2.0, 3.0], np.float32)
+    y = np.array([2.0, 2.0, 2.0], np.float32)
+    assert (paddle.equal(paddle.to_tensor(x), paddle.to_tensor(y)).numpy() == (x == y)).all()
+    assert (paddle.less_than(paddle.to_tensor(x), paddle.to_tensor(y)).numpy() == (x < y)).all()
+    a = np.array([True, False])
+    b = np.array([True, True])
+    assert (paddle.logical_and(paddle.to_tensor(a), paddle.to_tensor(b)).numpy() == (a & b)).all()
+
+
+def test_add_n_assign_lerp():
+    xs = [RNG.rand(2, 2).astype(np.float32) for _ in range(3)]
+    out = paddle.add_n([paddle.to_tensor(x) for x in xs])
+    np.testing.assert_allclose(out.numpy(), sum(xs), rtol=1e-6)
+    t = paddle.to_tensor(xs[0])
+    np.testing.assert_allclose(paddle.assign(t).numpy(), xs[0])
+    l = paddle.lerp(paddle.to_tensor(xs[0]), paddle.to_tensor(xs[1]), 0.5)
+    np.testing.assert_allclose(l.numpy(), xs[0] + 0.5 * (xs[1] - xs[0]), rtol=1e-6)
+
+
+def test_grad_unary():
+    x = RNG.rand(2, 3).astype(np.float32) + 0.5
+    check_grad(paddle.exp, [x])
+    check_grad(paddle.log, [x])
+    check_grad(paddle.tanh, [x])
+
+
+def test_grad_binary_broadcast():
+    x = RNG.rand(2, 3).astype(np.float32)
+    y = RNG.rand(3).astype(np.float32) + 0.5
+    check_grad(paddle.multiply, [x, y], wrt=(0, 1))
+    check_grad(paddle.divide, [x, y], wrt=(0, 1))
+
+
+def test_grad_reduction():
+    x = RNG.rand(2, 3).astype(np.float32)
+    check_grad(paddle.sum, [x], kwargs=dict(axis=1))
+    check_grad(paddle.mean, [x])
+
+
+def test_isnan_isinf():
+    x = np.array([1.0, np.nan, np.inf], np.float32)
+    assert (paddle.isnan(paddle.to_tensor(x)).numpy() == np.isnan(x)).all()
+    assert (paddle.isinf(paddle.to_tensor(x)).numpy() == np.isinf(x)).all()

@@ -1,0 +1,93 @@
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+
+
+def test_to_tensor_basics():
+    t = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+    assert t.shape == [2, 2]
+    assert t.dtype == paddle.float32  # float64 input downcast per paddle contract
+    assert t.ndim == 2
+    assert t.size == 4
+    np.testing.assert_array_equal(t.numpy(), [[1, 2], [3, 4]])
+
+
+def test_dtypes():
+    assert paddle.to_tensor([1, 2]).dtype == paddle.int64 or paddle.to_tensor([1, 2]).dtype == paddle.int32
+    assert paddle.to_tensor([1.0], dtype="bfloat16").dtype == paddle.bfloat16
+    t = paddle.to_tensor([1.5], dtype="int32")
+    assert t.dtype == paddle.int32
+
+
+def test_item_scalar():
+    assert paddle.to_tensor(3.5).item() == pytest.approx(3.5)
+    assert int(paddle.to_tensor(7)) == 7
+
+
+def test_indexing():
+    t = paddle.to_tensor(np.arange(24).reshape(2, 3, 4).astype(np.float32))
+    assert t[0].shape == [3, 4]
+    assert t[0, 1, 2].item() == 6
+    assert t[:, 1].shape == [2, 4]
+    assert t[..., -1].shape == [2, 3]
+    assert t[0:1, ::2].shape == [1, 2, 4]
+
+
+def test_setitem():
+    t = paddle.zeros([3, 3])
+    t[1, 1] = 5.0
+    assert t[1, 1].item() == 5.0
+    t[0] = paddle.ones([3])
+    np.testing.assert_array_equal(t[0].numpy(), [1, 1, 1])
+
+
+def test_astype_cast():
+    t = paddle.to_tensor([1.7, 2.3])
+    i = t.astype("int32")
+    np.testing.assert_array_equal(i.numpy(), [1, 2])
+
+
+def test_operators():
+    a = paddle.to_tensor([1.0, 2.0])
+    b = paddle.to_tensor([3.0, 4.0])
+    np.testing.assert_allclose((a + b).numpy(), [4, 6])
+    np.testing.assert_allclose((a - b).numpy(), [-2, -2])
+    np.testing.assert_allclose((a * b).numpy(), [3, 8])
+    np.testing.assert_allclose((b / a).numpy(), [3, 2])
+    np.testing.assert_allclose((a**2).numpy(), [1, 4])
+    np.testing.assert_allclose((2.0 + a).numpy(), [3, 4])
+    np.testing.assert_allclose((1.0 - a).numpy(), [0, -1])
+    np.testing.assert_allclose((-a).numpy(), [-1, -2])
+    assert (a < b).numpy().all()
+    assert (a == a).numpy().all()
+
+
+def test_detach_clone():
+    t = paddle.to_tensor([1.0], stop_gradient=False)
+    d = t.detach()
+    assert d.stop_gradient
+    c = t.clone()
+    np.testing.assert_array_equal(c.numpy(), t.numpy())
+
+
+def test_pytree_registration():
+    import jax
+
+    t = paddle.to_tensor([1.0, 2.0])
+    leaves, treedef = jax.tree_util.tree_flatten(t)
+    assert len(leaves) == 1
+    t2 = jax.tree_util.tree_unflatten(treedef, leaves)
+    assert isinstance(t2, Tensor)
+
+
+def test_repr_does_not_crash():
+    repr(paddle.to_tensor([1.0]))
+    repr(paddle.to_tensor([1.0], stop_gradient=False))
+
+
+def test_zero_dim():
+    t = paddle.to_tensor(2.0)
+    assert t.shape == []
+    assert (t + 1).item() == 3.0
