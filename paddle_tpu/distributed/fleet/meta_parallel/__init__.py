@@ -1,0 +1,7 @@
+"""Hybrid-parallel building blocks (TP layers here; PP in pp_layers)."""
+from .mp_layers import (  # noqa: F401
+    ColumnParallelLinear,
+    ParallelCrossEntropy,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+)

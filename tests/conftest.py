@@ -1,13 +1,22 @@
 """Test env: CPU backend with 8 virtual devices (the fake-mesh layer for
-distributed logic tests — SURVEY.md §4 implication (c))."""
+distributed logic tests — SURVEY.md §4 implication (c)).
+
+NOTE the sandbox's sitecustomize force-selects the 'axon' TPU platform via
+``jax.config.update("jax_platforms", "axon,cpu")`` (overriding the
+JAX_PLATFORMS env var), which would put every test on the single tunneled
+TPU chip — and concurrent pytest processes then deadlock on the chip claim.
+We re-update the config to plain cpu before any backend initializes.
+"""
 import os
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
-# golden tests compare against float64 numpy: pin full-precision matmuls
-# (the library default stays fast/bf16 on TPU)
-import jax  # noqa: E402
-
-jax.config.update("jax_default_matmul_precision", "highest")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+# golden tests compare against float64 numpy: pin full-precision matmuls
+# (the library default stays fast/bf16 on TPU)
+jax.config.update("jax_default_matmul_precision", "highest")

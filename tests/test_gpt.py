@@ -1,0 +1,55 @@
+"""Flagship GPT: eager forward, compiled TrainStep convergence, hybrid-mesh
+sharded step on the 8-device CPU mesh."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.models import GPTForCausalLM, gpt_tiny
+
+
+def setup_function(_):
+    dist.destroy_process_group()
+    dist.set_mesh(None)
+
+
+def _batch(cfg, b=4, s=32, seed=0):
+    rng = np.random.RandomState(seed)
+    ids = rng.randint(0, cfg.vocab_size, size=(b, s + 1)).astype(np.int32)
+    return paddle.to_tensor(ids[:, :-1]), paddle.to_tensor(ids[:, 1:].astype(np.int64))
+
+
+def test_gpt_forward_shapes():
+    cfg = gpt_tiny()
+    model = GPTForCausalLM(cfg)
+    x, y = _batch(cfg)
+    logits = model(x)
+    assert logits.shape == [4, 32, cfg.vocab_size]
+    loss = model(x, y)
+    assert loss.shape == [] and np.isfinite(loss.numpy())
+
+
+def test_gpt_trainstep_loss_decreases():
+    cfg = gpt_tiny()
+    model = GPTForCausalLM(cfg)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3, parameters=model.parameters())
+    step = paddle.jit.TrainStep(lambda x, y: model(x, y), opt, layers=model)
+    x, y = _batch(cfg, b=2, s=16)
+    losses = [float(step(x, y).numpy()) for _ in range(8)]
+    assert losses[-1] < losses[0], losses
+
+
+def test_gpt_sharded_hybrid_step():
+    dist.init_hybrid_mesh(dp=2, mp=2, sep=2)
+    cfg = gpt_tiny()
+    model = GPTForCausalLM(cfg)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3, parameters=model.parameters())
+    step = paddle.jit.TrainStep(lambda x, y: model(x, y), opt, layers=model)
+    x, y = _batch(cfg, b=4, s=32)
+    x, y = dist.shard_batch(x), dist.shard_batch(y)
+    l0 = float(step(x, y).numpy())
+    l1 = float(step(x, y).numpy())
+    assert np.isfinite(l0) and np.isfinite(l1) and l1 < l0
+
+    # TP weights really live sharded on the model axis
+    w = model.gpt.layers[0].attn.qkv.weight
+    assert "model" in str(w._data.sharding.spec)
