@@ -1,0 +1,86 @@
+"""Headline benchmark: GPT causal-LM training throughput, samples/sec/chip.
+
+Runs the flagship GPT model (config scaled to the platform: GPT-base-ish on
+a real TPU chip, tiny on CPU) through the fully-compiled TrainStep and prints
+ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The reference publishes no absolute numbers (BASELINE.md) — baseline is our
+own first recorded run, stored in BENCH_BASELINE.json; vs_baseline is the
+ratio current/recorded (1.0 on the run that creates the record).
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM, gpt_tiny
+    from paddle_tpu.optimizer import AdamW
+
+    platform = jax.devices()[0].platform
+    if platform == "tpu":
+        cfg = GPTConfig(vocab_size=50304, hidden_size=768, num_layers=12,
+                        num_heads=12, max_position_embeddings=1024,
+                        use_recompute=False)
+        batch, seq = 8, 1024
+        warmup, iters = 3, 10
+    else:  # CPU smoke path so the script always works
+        cfg = gpt_tiny()
+        batch, seq = 4, 128
+        warmup, iters = 1, 3
+
+    model = GPTForCausalLM(cfg)
+    opt = AdamW(learning_rate=1e-4, parameters=model.parameters(), weight_decay=0.01)
+    step = TrainStep(lambda x, y: model(x, y), opt, layers=model)
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, (batch, seq), dtype=np.int32)
+    x, y = Tensor(ids), Tensor(np.roll(ids, -1, axis=1))
+
+    for _ in range(warmup):
+        loss = step(x, y)
+    jax.block_until_ready(loss._data)
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = step(x, y)
+    jax.block_until_ready(loss._data)
+    dt = time.perf_counter() - t0
+
+    samples_per_sec = batch * iters / dt
+    metric = f"samples/sec/chip (GPT {cfg.hidden_size}h/{cfg.num_layers}L b{batch} s{seq} {platform})"
+
+    baseline_path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_BASELINE.json")
+    vs = 1.0
+    try:
+        with open(baseline_path) as f:
+            rec = json.load(f)
+        if rec.get("metric") == metric and rec.get("value"):
+            vs = samples_per_sec / float(rec["value"])
+        else:
+            raise FileNotFoundError
+    except (FileNotFoundError, json.JSONDecodeError, ValueError):
+        try:
+            with open(baseline_path, "w") as f:
+                json.dump({"metric": metric, "value": samples_per_sec}, f)
+        except OSError:
+            pass
+
+    print(json.dumps({
+        "metric": metric,
+        "value": round(samples_per_sec, 3),
+        "unit": "samples/sec/chip",
+        "vs_baseline": round(vs, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()
