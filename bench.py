@@ -63,22 +63,25 @@ def main():
     try:
         with open(baseline_path) as f:
             rec = json.load(f)
-        if rec.get("metric") == metric and rec.get("value"):
-            vs = samples_per_sec / float(rec["value"])
-        else:
-            raise FileNotFoundError
-    except (FileNotFoundError, json.JSONDecodeError, ValueError):
+    except (FileNotFoundError, json.JSONDecodeError):
+        rec = None
         try:
             with open(baseline_path, "w") as f:
                 json.dump({"metric": metric, "value": samples_per_sec}, f)
         except OSError:
             pass
+    if rec is not None:
+        if rec.get("metric") == metric and rec.get("value"):
+            vs = samples_per_sec / float(rec["value"])
+        else:
+            # different platform/config: don't clobber the recorded baseline
+            vs = None
 
     print(json.dumps({
         "metric": metric,
         "value": round(samples_per_sec, 3),
         "unit": "samples/sec/chip",
-        "vs_baseline": round(vs, 4),
+        "vs_baseline": round(vs, 4) if vs is not None else None,
     }))
 
 

@@ -59,7 +59,9 @@ class Group:
         self.mesh = mesh
         self.axis = axis
         self.nranks = mesh.shape.get(axis, 1) if axis else 1
-        self.ranks = ranks if ranks is not None else list(range(self.nranks))
+        if ranks is None:
+            ranks = _axis_rank_list(mesh, axis) if axis and self.nranks > 1 else list(range(self.nranks))
+        self.ranks = ranks
         Group._next_gid += 1
         self.id = Group._next_gid
         self.name = pg_name or f"pg_{self.id}"
@@ -73,6 +75,24 @@ class Group:
 
     def __repr__(self):
         return f"Group(axis={self.axis!r}, nranks={self.nranks})"
+
+
+def _axis_rank_list(mesh: Mesh, axis: str) -> List[int]:
+    """Global (device-id) ranks of this process's group along a mesh axis:
+    hold the local device's other coordinates fixed, vary the axis."""
+    devs = mesh.devices
+    names = list(mesh.axis_names)
+    if axis not in names:
+        return [0]
+    ax = names.index(axis)
+    local = jax.local_devices()[0]
+    coords = np.argwhere(devs == local)
+    base = list(coords[0]) if coords.size else [0] * devs.ndim
+    ranks = []
+    for i in range(devs.shape[ax]):
+        base[ax] = i
+        ranks.append(int(devs[tuple(base)].id))
+    return ranks
 
 
 _lock = threading.Lock()
@@ -96,7 +116,10 @@ def get_group(gid: Optional[int] = None) -> Group:
     for g in _groups:
         if g.id == gid:
             return g
-    return _get_default_group()
+    default = _get_default_group()
+    if gid == default.id:
+        return default
+    raise ValueError(f"no communication group with id {gid} (was it destroyed?)")
 
 
 def new_group(ranks: Optional[Sequence[int]] = None, backend: Optional[str] = None, axis: Optional[str] = None) -> Group:
@@ -135,6 +158,8 @@ def destroy_process_group(group: Optional[Group] = None):
 def get_rank(group: Optional[Group] = None) -> int:
     from . import env
 
+    if group is not None:
+        return group.get_group_rank(env.get_rank())
     return env.get_rank()
 
 
@@ -269,16 +294,21 @@ def all_gather(tensor_list: list, tensor, group: Optional[Group] = None, sync_op
 def broadcast(tensor, src: int = 0, group: Optional[Group] = None, sync_op: bool = True):
     g = group or _get_default_group()
     x = _data(tensor)
+    # src is a global rank (paddle contract); the gather index is the
+    # position along the group's axis
+    src_idx = g.get_group_rank(src)
+    if src_idx < 0:
+        raise ValueError(f"src rank {src} is not a member of {g}")
     if _is_traced(x):
         # broadcast from src along the bound axis: select src's value
-        out = jax.lax.all_gather(x, g.axis, tiled=False)[src]
+        out = jax.lax.all_gather(x, g.axis, tiled=False)[src_idx]
         if isinstance(tensor, Tensor):
             tensor._data = out
             return tensor
         return out
     if g.nranks <= 1 or not _axis_in_sharding(x, g.axis):
         return tensor  # degenerate / replicated
-    fn = _shard_map_collective(g.mesh, g.axis, "broadcast", src, x.shape, str(x.dtype), _spec_of(x))
+    fn = _shard_map_collective(g.mesh, g.axis, "broadcast", src_idx, x.shape, str(x.dtype), _spec_of(x))
     out = fn(x)
     if isinstance(tensor, Tensor):
         tensor._data = out
@@ -300,8 +330,15 @@ def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM, group: Optional[Gr
             tensor._data = out
             return tensor
         return out
-    if g.nranks <= 1 or not _axis_in_sharding(x, g.axis):
+    if g.nranks <= 1:
+        if tensor_list is not None and isinstance(tensor, Tensor):
+            tensor._data = _data(tensor_list[0])
         return tensor
+    if not _axis_in_sharding(x, g.axis):
+        raise NotImplementedError(
+            "eager reduce_scatter needs the input sharded along the group "
+            "axis (or group size 1); got an unsharded array"
+        )
     fn = _shard_map_collective(g.mesh, g.axis, "reduce_scatter", op, x.shape, str(x.dtype), _spec_of(x))
     out = fn(x)
     if isinstance(tensor, Tensor):
@@ -323,7 +360,10 @@ def scatter(tensor, tensor_list=None, src: int = 0, group: Optional[Group] = Non
         idx = jax.lax.axis_index(g.axis)
         tensor._data = jnp.take(stacked, idx, axis=0)
         return tensor
-    return tensor
+    raise NotImplementedError(
+        "eager scatter over a group of size > 1 is only expressible inside a "
+        "traced (shard_map) program in the single-controller model"
+    )
 
 
 def alltoall(in_tensor_list, out_tensor_list=None, group: Optional[Group] = None, sync_op: bool = True):
@@ -398,6 +438,11 @@ def shift(tensor, offset: int = 1, group: Optional[Group] = None):
         if isinstance(tensor, Tensor):
             return Tensor(out, stop_gradient=tensor.stop_gradient)
         return out
+    if not _axis_in_sharding(x, g.axis):
+        raise NotImplementedError(
+            "eager shift needs the input sharded along the group axis "
+            "(or group size 1); got an unsharded array"
+        )
     fn = _shard_map_collective(g.mesh, g.axis, "shift", offset, x.shape, str(x.dtype), _spec_of(x))
     out = fn(x)
     if isinstance(tensor, Tensor):

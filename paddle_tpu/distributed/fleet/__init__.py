@@ -23,7 +23,7 @@ class DistributedStrategy:
 
     def __init__(self):
         self.hybrid_configs = {
-            "dp_degree": 1,
+            "dp_degree": -1,  # -1 = auto-fill from device count (paddle contract)
             "mp_degree": 1,
             "pp_degree": 1,
             "sharding_degree": 1,
@@ -73,7 +73,7 @@ def init(role_maker=None, is_collective: bool = True, strategy: Optional[Distrib
 
     ndev = len(jax.devices())
     degrees = {
-        "dp": int(hc.get("dp_degree", 1)),
+        "dp": int(hc.get("dp_degree", -1)),
         "mp": int(hc.get("mp_degree", 1)),
         "pp": int(hc.get("pp_degree", 1)),
         "sharding": int(hc.get("sharding_degree", 1)),
@@ -81,8 +81,14 @@ def init(role_maker=None, is_collective: bool = True, strategy: Optional[Distrib
         "expert": int(hc.get("ep_degree", 1)),
     }
     prod_rest = degrees["mp"] * degrees["pp"] * degrees["sharding"] * degrees["sep"] * degrees["expert"]
-    if degrees["dp"] * prod_rest != ndev and ndev % prod_rest == 0:
-        degrees["dp"] = ndev // prod_rest  # auto dp fill (fleet does the same)
+    # dp_degree == -1 means auto-fill (paddle contract); an explicit degree
+    # that mismatches the device count falls through to ValueError
+    if degrees["dp"] == -1:
+        if ndev % prod_rest != 0:
+            raise ValueError(
+                f"non-dp degrees {prod_rest} do not divide device count {ndev}"
+            )
+        degrees["dp"] = ndev // prod_rest
     mesh = init_hybrid_mesh(
         dp=degrees["dp"],
         mp=degrees["mp"],

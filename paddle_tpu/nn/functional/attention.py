@@ -18,11 +18,18 @@ import math
 import jax
 import jax.numpy as jnp
 
+from ...core import rng
 from ...core.dispatch import apply
 from ...core.tensor import Tensor
 
 
-def _sdpa_reference(q, k, v, *, scale, causal):
+def _prob_dropout(probs, key, p):
+    # paddle contract: dropout acts on the post-softmax probability matrix
+    keep = jax.random.bernoulli(key, 1.0 - p, probs.shape)
+    return jnp.where(keep, probs / (1.0 - p), 0.0).astype(probs.dtype)
+
+
+def _sdpa_reference(q, k, v, *, scale, causal, dropout_p=0.0, key=None):
     # [b, s, h, d] -> [b, h, s, d]
     qt = jnp.swapaxes(q, 1, 2)
     kt = jnp.swapaxes(k, 1, 2)
@@ -33,6 +40,8 @@ def _sdpa_reference(q, k, v, *, scale, causal):
         mask = jnp.tril(jnp.ones((sq, sk), jnp.bool_), k=sk - sq)
         logits = jnp.where(mask, logits, jnp.finfo(logits.dtype).min)
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+    if dropout_p:
+        probs = _prob_dropout(probs, key, dropout_p)
     out = jnp.einsum("bhqk,bhkd->bhqd", probs, vt)
     return jnp.swapaxes(out, 1, 2)
 
@@ -51,6 +60,13 @@ def _sdpa(q, k, v, *, scale, causal, use_flash):
     return _sdpa_reference(q, k, v, scale=scale, causal=causal)
 
 
+def _sdpa_dropout(q, k, v, key, *, scale, causal, dropout_p):
+    # dropout on the probability matrix isn't expressible in the Pallas flash
+    # kernel; the XLA path materializes probs anyway
+    return _sdpa_reference(q, k, v, scale=scale, causal=causal,
+                           dropout_p=dropout_p, key=key)
+
+
 def scaled_dot_product_attention(
     query,
     key,
@@ -65,9 +81,10 @@ def scaled_dot_product_attention(
     Layout [batch, seq, num_heads, head_dim]."""
     d = query.shape[-1]
     scale = 1.0 / math.sqrt(d)
+    drop = float(dropout_p) if (dropout_p and training) else 0.0
     if attn_mask is not None:
         # masked variant stays on the XLA path (mask shapes are arbitrary)
-        def _masked(q, k, v, m, *, scale):
+        def _masked(q, k, v, m, rkey=None, *, scale, dropout_p):
             qt, kt, vt = (jnp.swapaxes(t, 1, 2) for t in (q, k, v))
             logits = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) * scale
             if m.dtype == jnp.bool_:
@@ -75,9 +92,21 @@ def scaled_dot_product_attention(
             else:
                 logits = logits + m
             p = jax.nn.softmax(logits.astype(jnp.float32), -1).astype(q.dtype)
+            if dropout_p:
+                p = _prob_dropout(p, rkey, dropout_p)
             return jnp.swapaxes(jnp.einsum("bhqk,bhkd->bhqd", p, vt), 1, 2)
 
-        out = apply(_masked, (query, key, value, attn_mask), {"scale": scale}, name="sdpa")
+        args = (query, key, value, attn_mask)
+        if drop:  # consume an rng key only when dropout is live
+            args += (Tensor(rng.next_key()),)
+        out = apply(_masked, args, {"scale": scale, "dropout_p": drop}, name="sdpa")
+    elif drop:
+        out = apply(
+            _sdpa_dropout,
+            (query, key, value, Tensor(rng.next_key())),
+            {"scale": scale, "causal": bool(is_causal), "dropout_p": drop},
+            name="sdpa",
+        )
     else:
         use_flash = _use_pallas(query._data if isinstance(query, Tensor) else query)
         out = apply(
@@ -86,10 +115,6 @@ def scaled_dot_product_attention(
             {"scale": scale, "causal": bool(is_causal), "use_flash": use_flash},
             name="sdpa",
         )
-    if dropout_p and training:
-        from .common import dropout as _dropout
-
-        out = _dropout(out, p=dropout_p, training=True)
     return out
 
 

@@ -110,3 +110,72 @@ def test_barrier_and_world_size():
     dist.init_parallel_env()
     assert dist.get_world_size() >= 1
     dist.barrier()
+
+
+def test_get_group_unknown_gid_raises():
+    dist.init_parallel_env()
+    with pytest.raises(ValueError):
+        dist.collective.get_group(999999)
+
+
+def test_get_rank_group_local():
+    dist.init_hybrid_mesh(dp=8)
+    g = dist.new_group(axis="data")
+    g.ranks = [3, 4, 5]  # simulate a subgroup not containing rank 0 at pos 0
+    assert dist.get_rank(g) == g.get_group_rank(0)
+
+
+def test_broadcast_src_maps_to_group_index():
+    m = dist.init_hybrid_mesh(dp=4, mp=2)
+    g = dist.new_group(axis="model")
+    with pytest.raises(ValueError):
+        dist.broadcast(paddle.to_tensor(np.ones(4, np.float32)), src=5, group=g)
+
+
+def test_eager_unsharded_collectives_raise_not_silent():
+    dist.init_hybrid_mesh(dp=8)
+    g = dist.new_group(axis="data")
+    t = paddle.to_tensor(np.ones((8, 2), np.float32))
+    with pytest.raises(NotImplementedError):
+        dist.collective.scatter(t, [t] * 8, group=g)
+    with pytest.raises(NotImplementedError):
+        dist.collective.shift(t, offset=1, group=g)
+    with pytest.raises(NotImplementedError):
+        dist.collective.reduce_scatter(t, [t] * 8, group=g)
+
+
+def test_reduce_scatter_degenerate_tensor_list():
+    dist.init_hybrid_mesh(dp=8)
+    g = dist.Group(dist.get_mesh(), "")  # nranks == 1
+    out = paddle.to_tensor(np.zeros((2,), np.float32))
+    src = paddle.to_tensor(np.ones((2,), np.float32))
+    dist.collective.reduce_scatter(out, [src], group=g)
+    np.testing.assert_allclose(out.numpy(), np.ones((2,), np.float32))
+
+
+def test_fleet_explicit_dp_mismatch_raises():
+    strat = dist.fleet.DistributedStrategy()
+    strat.hybrid_configs = {"dp_degree": 2, "mp_degree": 2}  # 4 != 8 devices
+    with pytest.raises(ValueError):
+        dist.fleet.init(strategy=strat)
+
+
+def test_attention_dropout_on_probs():
+    from paddle_tpu.nn import functional as F
+
+    q = paddle.to_tensor(np.random.rand(2, 8, 2, 4).astype(np.float32))
+    out0 = F.scaled_dot_product_attention(q, q, q, dropout_p=0.0)
+    out_eval = F.scaled_dot_product_attention(q, q, q, dropout_p=0.9, training=False)
+    np.testing.assert_allclose(out0.numpy(), out_eval.numpy(), atol=1e-6)
+    out_tr = F.scaled_dot_product_attention(q, q, q, dropout_p=0.9, training=True)
+    # prob-dropout changes values but never whole-output zeroing with renorm
+    assert not np.allclose(out0.numpy(), out_tr.numpy())
+
+
+def test_axis_group_ranks_are_global_device_ids():
+    m = dist.init_hybrid_mesh(dp=4, mp=2)
+    g = dist.new_group(axis="model")
+    # local device 0 sits at dp-coord 0; its model-axis peers are the two
+    # device ids in that dp row of the mesh array
+    row = [int(d.id) for d in m.devices[0]]
+    assert g.ranks == row
