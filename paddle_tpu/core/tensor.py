@@ -302,8 +302,16 @@ def to_tensor(data, dtype=None, place: Optional[Place] = None, stop_gradient: bo
         arr = arr.astype(np.float32)  # paddle default dtype contract
     if dtype is not None:
         arr = np.asarray(arr, dtype=jnp.dtype(dtype))
-    dev = (place or current_place()).jax_device()
-    return Tensor(jax.device_put(arr, dev), stop_gradient=stop_gradient)
+    from . import device as device_mod
+
+    if place is None and device_mod._current_device is not None:
+        place = device_mod._current_device  # user called set_device: honor it
+    if place is not None:
+        # explicit placement commits the array to that device
+        return Tensor(jax.device_put(arr, place.jax_device()), stop_gradient=stop_gradient)
+    # no explicit place: leave the array uncommitted so jit/pjit may reshard
+    # it freely (a device-0-committed input poisons multi-device programs)
+    return Tensor(jnp.asarray(arr), stop_gradient=stop_gradient)
 
 
 def _unwrap(x):

@@ -121,6 +121,10 @@ def distributed_model(model):
     compiled TrainStep partitions the step; no runtime wrapper needed."""
     if _state.hcg is None:
         init()
+    from .meta_parallel import PipelineLayer, PipelineParallel
+
+    if isinstance(model, PipelineLayer) and _state.hcg.get_pipe_parallel_world_size() > 1:
+        return PipelineParallel(model, _state.hcg, _state.strategy)
     mode = _state.hcg.get_parallel_mode()
     if mode == "data_parallel":
         return DataParallel(model)

@@ -5,3 +5,5 @@ from .mp_layers import (  # noqa: F401
     RowParallelLinear,
     VocabParallelEmbedding,
 )
+from .pipeline_parallel import PipelineParallel  # noqa: F401
+from .pp_layers import LayerDesc, PipelineLayer, SharedLayerDesc  # noqa: F401

@@ -63,6 +63,11 @@ def constraint(x, *spec, mesh: Optional[Mesh] = None):
     arr = x._data if t else x
     ns = NamedSharding(mesh, PartitionSpec(*spec))
     if isinstance(arr, jax.core.Tracer):
+        # inside a shard_map manual region (e.g. the pipeline stage body)
+        # the value is manual-axis-varying; a full-mesh constraint is
+        # ill-typed there — let GSPMD propagate from the operands instead
+        if getattr(getattr(arr, "aval", None), "vma", None):
+            return x
         out = jax.lax.with_sharding_constraint(arr, ns)
     else:
         out = jax.device_put(arr, ns)

@@ -141,6 +141,89 @@ class GPTModel(nn.Layer):
         return self.ln_f(x)
 
 
+class GPTEmbeddingPipe(nn.Layer):
+    """First pipeline section: token + position embeddings."""
+
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.wte = VocabParallelEmbedding(cfg.vocab_size, cfg.hidden_size)
+        self.wpe = nn.Embedding(cfg.max_position_embeddings, cfg.hidden_size)
+        self.drop = nn.Dropout(cfg.dropout)
+
+    def forward(self, input_ids):
+        b, s = input_ids.shape
+        pos = creation.arange(0, s, dtype="int32")
+        x = self.wte(input_ids) + self.wpe(pos)
+        return constraint(self.drop(x), "data", "sep", None)
+
+
+class GPTHeadPipe(nn.Layer):
+    """Last pipeline section: final norm + (tied) LM head."""
+
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.ln_f = nn.LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_epsilon)
+        if not cfg.tie_word_embeddings:
+            self.head = ColumnParallelLinear(cfg.hidden_size, cfg.vocab_size, has_bias=False)
+
+    def forward(self, x, shared_weight=None):
+        h = self.ln_f(x)
+        if self.cfg.tie_word_embeddings:
+            logits = F.linear(h, M.transpose(shared_weight, [1, 0]))
+        else:
+            logits = self.head(h)
+        return constraint(logits, "data", "sep", "model")
+
+
+def gpt_pipe_loss(logits, labels):
+    vocab = logits.shape[-1]
+    return F.cross_entropy(
+        M.reshape(logits, [-1, vocab]).astype("float32"),
+        M.reshape(labels, [-1]),
+        reduction="mean",
+    )
+
+
+def GPTForCausalLMPipe(cfg: GPTConfig, num_stages=None, num_microbatches: int = 1):
+    """Pipeline-parallel GPT (parity role: the reference's fleet
+    GPTForPretrainingPipe built from LayerDesc lists). Decoder blocks form
+    the stage-stacked homogeneous run; embedding/head run under GSPMD on
+    every stage; tied embeddings share the wte Parameter object."""
+    from ..distributed.fleet.meta_parallel import LayerDesc, PipelineLayer
+
+    emb = GPTEmbeddingPipe(cfg)
+    descs = [emb]
+    descs += [LayerDesc(GPTDecoderLayer, cfg) for _ in range(cfg.num_layers)]
+    head = GPTHeadPipe(cfg)
+    if cfg.tie_word_embeddings:
+        head_wrap = _TiedHead(head, emb)
+        descs.append(head_wrap)
+    else:
+        descs.append(head)
+    return PipelineLayer(
+        descs,
+        num_stages=num_stages,
+        loss_fn=gpt_pipe_loss,
+        num_microbatches=num_microbatches,
+        recompute_interval=1 if cfg.use_recompute else 0,
+    )
+
+
+class _TiedHead(nn.Layer):
+    """Binds the shared embedding weight into the head's forward (the
+    SharedLayerDesc tie: same Parameter object, grads sum automatically)."""
+
+    def __init__(self, head: GPTHeadPipe, emb: GPTEmbeddingPipe):
+        super().__init__()
+        self.head = head
+        object.__setattr__(self, "_emb_ref", emb)  # not a sublayer: no double-count
+
+    def forward(self, x):
+        return self.head(x, shared_weight=self._emb_ref.wte.weight)
+
+
 class GPTForCausalLM(nn.Layer):
     def __init__(self, cfg: GPTConfig):
         super().__init__()

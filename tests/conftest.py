@@ -20,3 +20,19 @@ jax.config.update("jax_platforms", "cpu")
 # golden tests compare against float64 numpy: pin full-precision matmuls
 # (the library default stays fast/bf16 on TPU)
 jax.config.update("jax_default_matmul_precision", "highest")
+
+
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _reset_global_mesh():
+    """Each test starts without an installed mesh / comm groups (tests that
+    need one call init_hybrid_mesh themselves)."""
+    yield
+    from paddle_tpu.distributed import collective, fleet, mesh as mesh_mod
+
+    mesh_mod._global_mesh = None
+    collective._default_group = None
+    collective._groups.clear()
+    fleet._state = fleet._FleetState()

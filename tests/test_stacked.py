@@ -1,0 +1,85 @@
+"""StackedLayers: scan-over-layers == per-layer sequential, eager + jit."""
+import jax
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.nn.stacked import StackedLayers
+
+
+class Block(nn.Layer):
+    def __init__(self, d):
+        super().__init__()
+        self.fc = nn.Linear(d, d)
+
+    def forward(self, x):
+        return paddle.tanh(self.fc(x))
+
+
+def test_stacked_matches_sequential_forward():
+    paddle.seed(0)
+    d, L = 8, 4
+    blocks = [Block(d) for _ in range(L)]
+    stacked = StackedLayers(lambda i: Block(d), L)
+    # copy the per-layer weights into the stacked params
+    sd = {}
+    for j, name in enumerate(stacked._t_names):
+        key = name.replace(".", "__")
+        sd[key] = paddle.to_tensor(np.stack(
+            [np.asarray(dict(b.named_parameters())[name]._data) for b in blocks]))
+    stacked.set_state_dict(sd)
+
+    x = paddle.to_tensor(np.random.rand(3, d).astype(np.float32))
+    ref = x
+    for b in blocks:
+        ref = b(ref)
+    out = stacked(x)
+    np.testing.assert_allclose(out.numpy(), ref.numpy(), atol=1e-5)
+
+
+def test_stacked_eager_backward():
+    paddle.seed(0)
+    d, L = 4, 3
+    stacked = StackedLayers(lambda i: Block(d), L)
+    x = paddle.to_tensor(np.random.rand(5, d).astype(np.float32))
+    loss = stacked(x).mean()
+    loss.backward()
+    for p in stacked.parameters():
+        assert p.grad is not None
+        assert np.isfinite(p.grad.numpy()).all()
+
+
+def test_stacked_trains():
+    paddle.seed(0)
+    d, L = 6, 3
+    stacked = StackedLayers(lambda i: Block(d), L)
+    head = nn.Linear(d, 1)
+    opt = paddle.optimizer.Adam(
+        learning_rate=0.01, parameters=stacked.parameters() + head.parameters())
+    X = np.random.rand(64, d).astype(np.float32)
+    Y = (X.sum(1, keepdims=True) > d / 2).astype(np.float32)
+    first = None
+    for _ in range(60):
+        loss = ((head(stacked(paddle.to_tensor(X))) - paddle.to_tensor(Y)) ** 2).mean()
+        if first is None:
+            first = float(loss.numpy())
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    assert float(loss.numpy()) < first * 0.5
+
+
+def test_stacked_rejects_buffered_layers():
+    with pytest.raises(ValueError):
+        StackedLayers(lambda i: nn.BatchNorm1D(4), 2)
+
+
+def test_stacked_remat_same_result():
+    paddle.seed(0)
+    d, L = 4, 3
+    s1 = StackedLayers(lambda i: Block(d), L)
+    s2 = StackedLayers(lambda i: Block(d), L, remat=True)
+    s2.set_state_dict(s1.state_dict())
+    x = paddle.to_tensor(np.random.rand(2, d).astype(np.float32))
+    np.testing.assert_allclose(s1(x).numpy(), s2(x).numpy(), atol=1e-6)
