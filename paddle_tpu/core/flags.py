@@ -86,3 +86,5 @@ define_flag("benchmark", False, "Block on each op for accurate eager timing.")
 define_flag("tracer_mkldnn_ops_on", "", "Unused; kept for API parity.")
 define_flag("allocator_strategy", "xla", "Memory allocator strategy (XLA manages HBM on TPU).")
 define_flag("use_stream_safe_allocator", True, "Kept for API parity; XLA/PJRT owns streams on TPU.")
+define_flag("sequence_parallel_mode", "auto",
+            "Context parallelism for attention: auto|ring|ulysses|none.")

@@ -52,7 +52,12 @@ def _use_pallas(q) -> bool:
     return jax.default_backend() in ("tpu", "axon")
 
 
-def _sdpa(q, k, v, *, scale, causal, use_flash):
+def _sdpa(q, k, v, *, scale, causal, use_flash, seq_parallel="none"):
+    if seq_parallel in ("ring", "ulysses"):
+        from ...distributed.context_parallel import ring_attention, ulysses_attention
+
+        fn = ring_attention if seq_parallel == "ring" else ulysses_attention
+        return fn(q, k, v, scale=scale, causal=causal)
     if use_flash:
         from ...ops.pallas_ops import flash_attention as pallas_flash
 
@@ -112,10 +117,25 @@ def scaled_dot_product_attention(
         out = apply(
             _sdpa,
             (query, key, value),
-            {"scale": scale, "causal": bool(is_causal), "use_flash": use_flash},
+            {"scale": scale, "causal": bool(is_causal), "use_flash": use_flash,
+             "seq_parallel": _seq_parallel_mode()},
             name="sdpa",
         )
     return out
+
+
+def _seq_parallel_mode() -> str:
+    """Context-parallel dispatch: 'ring' (default when the mesh has an active
+    "sep" axis), 'ulysses', or 'none'; FLAGS_sequence_parallel_mode
+    overrides (the reference has no SP at all — SURVEY.md §5.7)."""
+    from ...core import flags
+    from ...distributed import mesh as mesh_mod
+
+    mode = flags.flag("sequence_parallel_mode")
+    if mode in ("ring", "ulysses", "none"):
+        return mode
+    m = mesh_mod.get_mesh()
+    return "ring" if m is not None and m.shape.get("sep", 1) > 1 else "none"
 
 
 def flash_attention(query, key, value, dropout=0.0, causal=False, return_softmax=False,
