@@ -1,0 +1,107 @@
+"""MoE layer: routing correctness, expert parallelism, training."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu import nn
+from paddle_tpu.incubate.distributed.models.moe import MoELayer, NaiveGate
+
+
+class Expert(nn.Layer):
+    def __init__(self, d, hidden=None):
+        super().__init__()
+        h = hidden or 2 * d
+        self.fc1 = nn.Linear(d, h)
+        self.fc2 = nn.Linear(h, d)
+
+    def forward(self, x):
+        return self.fc2(nn.functional.gelu(self.fc1(x)))
+
+
+def test_moe_forward_shapes():
+    paddle.seed(0)
+    d = 8
+    moe = MoELayer(d, lambda i: Expert(d), num_experts=4, gate="gshard")
+    x = paddle.to_tensor(np.random.rand(2, 6, d).astype(np.float32))
+    y = moe(x)
+    assert y.shape == [2, 6, d]
+    assert moe.l_aux is not None
+
+
+def test_moe_single_expert_equals_dense():
+    """1 expert, top-1, generous capacity: MoE == the dense expert."""
+    paddle.seed(0)
+    d = 8
+    moe = MoELayer(d, lambda i: Expert(d), num_experts=1, gate="naive",
+                   top_k=1, capacity_factor=8.0)
+    x = paddle.to_tensor(np.random.rand(16, d).astype(np.float32))
+    y = moe(x)
+    # rebuild the dense expert from stacked params
+    dense = Expert(d)
+    sd = {}
+    for n in moe._t_names:
+        key = "experts__" + n.replace(".", "__")
+        sd[n] = paddle.to_tensor(np.asarray(dict(moe.named_parameters())[key]._data)[0])
+    dense.set_state_dict(sd)
+    np.testing.assert_allclose(y.numpy(), dense(x).numpy(), atol=1e-5)
+
+
+def test_moe_trains_eager():
+    paddle.seed(0)
+    d = 8
+    moe = MoELayer(d, lambda i: Expert(d), num_experts=4, gate="switch", top_k=1)
+    head = nn.Linear(d, 1)
+    opt = paddle.optimizer.Adam(learning_rate=5e-3,
+                                parameters=moe.parameters() + head.parameters())
+    X = np.random.rand(64, d).astype(np.float32)
+    Y = (X.mean(1, keepdims=True) > 0.5).astype(np.float32)
+    first = None
+    for _ in range(40):
+        out = head(moe(paddle.to_tensor(X)))
+        loss = ((out - paddle.to_tensor(Y)) ** 2).mean() + 0.01 * moe.l_aux
+        if first is None:
+            first = float(loss.numpy())
+        loss.backward()
+        opt.step(); opt.clear_grad()
+    assert float(loss.numpy()) < first
+
+
+def test_moe_expert_parallel_mesh():
+    """Experts sharded over the expert axis; step compiles and runs."""
+    paddle.seed(0)
+    dist.init_hybrid_mesh(expert=4, dp=2)
+    d = 8
+    moe = MoELayer(d, lambda i: Expert(d), num_experts=4, gate="gshard")
+    head = nn.Linear(d, 1)
+    from paddle_tpu.jit import TrainStep
+
+    opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                parameters=moe.parameters() + head.parameters())
+
+    def loss_fn(x, y):
+        out = head(moe(x))
+        return ((out - y) ** 2).mean() + 0.01 * moe.l_aux
+
+    step = TrainStep(loss_fn, opt, layers=[moe, head])
+    X = paddle.to_tensor(np.random.rand(32, d).astype(np.float32))
+    Y = paddle.to_tensor(np.random.rand(32, 1).astype(np.float32))
+    losses = [float(step(X, Y).numpy()) for _ in range(3)]
+    assert all(np.isfinite(l) for l in losses)
+    # stacked expert params are sharded over the expert axis
+    p = dict(moe.named_parameters())["experts__fc1.weight".replace(".", "__") if False else "experts__fc1__weight"]
+    assert "expert" in str(p._data.sharding.spec)
+
+
+def test_gate_capacity_drops_overflow():
+    paddle.seed(0)
+    d = 4
+    g = NaiveGate(d, 2, top_k=1, capacity_factor=0.1)
+    x = jnp.asarray(np.random.rand(64, d).astype(np.float32))
+    dispatch, combine, _ = g.route(x, 2)  # capacity 2
+    # per-expert routed count never exceeds capacity
+    per_expert = np.asarray(dispatch.sum(axis=(0, 2)))
+    assert (np.asarray(dispatch.sum(axis=2)) <= 1.0 + 1e-6).all()
+    assert (np.asarray(dispatch.sum(axis=(0,))) <= 1.0 + 1e-6).all()
