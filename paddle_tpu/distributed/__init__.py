@@ -41,3 +41,8 @@ from .collective import (  # noqa: F401
 )
 from .parallel import DataParallel, init_parallel_env, shard_batch  # noqa: F401
 from . import fleet  # noqa: F401
+from . import checkpoint  # noqa: F401
+from . import context_parallel  # noqa: F401
+from . import pipeline  # noqa: F401
+from . import sharding  # noqa: F401
+from .sharding import group_sharded_parallel, save_group_sharded_model  # noqa: F401
