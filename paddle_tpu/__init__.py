@@ -56,6 +56,12 @@ from . import jit  # noqa: F401,E402
 from . import device  # noqa: F401,E402
 from .framework import io as framework_io  # noqa: F401,E402
 from .framework.io import load, save  # noqa: F401,E402
+from . import metric  # noqa: F401,E402
+from . import vision  # noqa: F401,E402
+from . import hapi  # noqa: F401,E402
+from . import incubate  # noqa: F401,E402
+from .hapi import Model  # noqa: F401,E402
+from .hapi.model import summary  # noqa: F401,E402
 
 bool = bool_  # paddle.bool alias
 

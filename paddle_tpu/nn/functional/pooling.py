@@ -25,15 +25,18 @@ def _pool(x, ksize, stride, padding, n, data_format, reducer, init, ceil_mode=Fa
             strides = (1, 1) + stride
             pads = ((0, 0), (0, 0)) + pad if not isinstance(pad, str) else pad
         red = jax.lax.max if reducer == "max" else jax.lax.add
+        # init MUST be a scalar literal: an array init makes reduce_window
+        # opaque to jit-linearization (grad-under-jit then fails)
         ini = -jnp.inf if reducer == "max" else 0.0
-        out = jax.lax.reduce_window(x, jnp.asarray(ini, x.dtype), red, dims, strides, pads)
+        out = jax.lax.reduce_window(x, ini, red, dims, strides, pads)
+        out = out.astype(x.dtype)
         if reducer == "avg":
             if count_include_pad or isinstance(pads, str):
                 denom = np.prod(ksize)
                 out = out / denom
             else:
                 ones = jnp.ones_like(x)
-                counts = jax.lax.reduce_window(ones, jnp.asarray(0.0, x.dtype), jax.lax.add, dims, strides, pads)
+                counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, dims, strides, pads)
                 out = out / counts
         return out
 

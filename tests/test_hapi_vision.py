@@ -1,0 +1,97 @@
+"""hapi Model.fit + vision zoo + metrics: the 'book' MNIST config end-to-end."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.metric import Accuracy, Auc, Precision, Recall
+from paddle_tpu.vision.datasets import FakeData
+from paddle_tpu.vision.models import LeNet
+from paddle_tpu.vision.transforms import Compose, Normalize, ToTensor
+
+
+def test_model_fit_lenet_fakedata():
+    paddle.seed(0)
+    train = FakeData(num_samples=128, seed=0)
+    val = FakeData(num_samples=64, seed=1)
+    model = paddle.Model(LeNet())
+    opt = paddle.optimizer.Adam(learning_rate=1e-3, parameters=model.parameters())
+    model.prepare(opt, nn.CrossEntropyLoss(), Accuracy())
+    hist = model.fit(train, val, batch_size=32, epochs=2, verbose=0)
+    assert len(hist["loss"]) == 2
+    assert np.isfinite(hist["loss"][-1])
+    logs = model.evaluate(val, batch_size=32, verbose=0)
+    assert "loss" in logs and "acc" in logs
+
+
+def test_model_fit_learns_separable():
+    paddle.seed(0)
+
+    class DS(paddle.io.Dataset):
+        def __init__(self, n=256):
+            rng = np.random.default_rng(0)
+            self.x = rng.standard_normal((n, 8)).astype(np.float32)
+            self.y = (self.x.sum(1) > 0).astype(np.int64).reshape(-1, 1)
+
+        def __getitem__(self, i):
+            return self.x[i], self.y[i]
+
+        def __len__(self):
+            return len(self.x)
+
+    ds = DS()
+    model = paddle.Model(nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 2)))
+    model.prepare(paddle.optimizer.Adam(learning_rate=0.01, parameters=model.parameters()),
+                  nn.CrossEntropyLoss(), Accuracy())
+    model.fit(ds, batch_size=64, epochs=8, verbose=0)
+    logs = model.evaluate(ds, batch_size=64, verbose=0)
+    assert logs["acc"] > 0.9, logs
+
+
+def test_model_save_load(tmp_path):
+    import os
+
+    model = paddle.Model(LeNet())
+    model.prepare(paddle.optimizer.Adam(learning_rate=1e-3, parameters=model.parameters()),
+                  nn.CrossEntropyLoss())
+    p = os.path.join(str(tmp_path), "ck", "model")
+    model.save(p)
+    m2 = paddle.Model(LeNet())
+    m2.load(p)
+    for (k, a), (_, b) in zip(model.network.state_dict().items(),
+                              m2.network.state_dict().items()):
+        np.testing.assert_allclose(a.numpy(), b.numpy())
+
+
+def test_summary_counts():
+    info = paddle.summary(LeNet())
+    assert info["total_params"] > 60000
+    assert info["trainable_params"] == info["total_params"]
+
+
+def test_metrics_precision_recall_auc():
+    p = Precision(); r = Recall(); a = Auc()
+    preds = np.asarray([0.9, 0.8, 0.2, 0.1, 0.7, 0.3])
+    labels = np.asarray([1, 1, 0, 0, 0, 1])
+    p.update(preds, labels); r.update(preds, labels); a.update(preds, labels)
+    assert p.accumulate() == pytest.approx(2 / 3)
+    assert r.accumulate() == pytest.approx(2 / 3)
+    assert 0.5 < a.accumulate() <= 1.0
+
+
+def test_transforms_pipeline():
+    t = Compose([ToTensor(), Normalize(mean=[0.5], std=[0.5])])
+    img = (np.random.rand(28, 28) * 255).astype(np.uint8)
+    out = t(img)
+    assert out.shape == (1, 28, 28)
+    assert out.min() >= -1.0 - 1e-6 and out.max() <= 1.0 + 1e-6
+
+
+def test_vision_models_forward_shapes():
+    from paddle_tpu.vision.models import mobilenet_v2, resnet18
+
+    x = paddle.to_tensor(np.random.rand(2, 3, 32, 32).astype(np.float32))
+    for ctor in (resnet18, mobilenet_v2):
+        m = ctor(num_classes=7)
+        m.eval()
+        assert m(x).shape == [2, 7]
