@@ -62,6 +62,7 @@ from . import hapi  # noqa: F401,E402
 from . import incubate  # noqa: F401,E402
 from .hapi import Model  # noqa: F401,E402
 from .hapi.model import summary  # noqa: F401,E402
+from . import profiler  # noqa: F401,E402
 
 bool = bool_  # paddle.bool alias
 

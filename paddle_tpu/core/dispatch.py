@@ -22,7 +22,7 @@ from typing import Any, Dict, Tuple
 import jax
 import jax.numpy as jnp
 
-from . import flags
+from . import flags, trace_hook
 from .autograd import TapeNode, is_grad_enabled
 from .tensor import Tensor
 
@@ -68,10 +68,13 @@ def apply(fn, tensor_args: Tuple, static: Dict[str, Any], *, differentiable: boo
     tracing = any(isinstance(d, jax.core.Tracer) for d in datas)
     static_t = tuple(sorted(static.items())) if static else ()
 
+    _t0 = trace_hook.begin() if trace_hook.active else 0
     if tracing or not flags.flag("eager_jit_ops"):
         out = fn(*datas, **static) if static else fn(*datas)
     else:
         out = _jitted(fn, static_t)(*datas)
+    if _t0:
+        trace_hook.end(name, _t0)
 
     multi = isinstance(out, (tuple, list))
     outs = tuple(out) if multi else (out,)

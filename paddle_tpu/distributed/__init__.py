@@ -45,4 +45,5 @@ from . import checkpoint  # noqa: F401
 from . import context_parallel  # noqa: F401
 from . import pipeline  # noqa: F401
 from . import sharding  # noqa: F401
+from .store import TCPStore  # noqa: F401
 from .sharding import group_sharded_parallel, save_group_sharded_model  # noqa: F401

@@ -53,6 +53,20 @@ def init_parallel_env() -> Optional[Group]:
         except Exception as e:  # already initialized / single-host tests
             if "already" not in str(e).lower():
                 raise
+        # host-side KV rendezvous (native TCPStore, ≈ ref parallel.py:1076):
+        # rank 0 hosts; all ranks barrier before touching devices
+        if os.environ.get("PADDLE_TPU_STORE", "1") == "1":
+            try:
+                from .store import TCPStore
+
+                host, port = eps[0].rsplit(":", 1)
+                store = TCPStore(host, int(port) + 1, is_master=(rank == 0),
+                                 world_size=len(eps))
+                store.set(f"rank/{rank}", str(rank))
+                store.barrier("init")
+                env._store = store
+            except Exception:
+                env._store = None  # jax.distributed already synced us
     mesh_mod.ensure_mesh()
     _initialized = True
     return _get_default_group()

@@ -1,0 +1,97 @@
+"""Native runtime library loader.
+
+C++ sources in ``csrc/`` compile into one ``libpaddle_tpu_native.so`` on
+first import (g++ -O2 -fPIC, cached by source hash under
+~/.cache/paddle_tpu). The C ABI is consumed via ctypes — no
+pybind dependency (not available in this image).
+
+Components (SURVEY.md §7 'C++ where Paddle is C++'):
+  kvstore.cc — TCPStore bootstrap/rendezvous service
+               (≈ ref:paddle/phi/core/distributed/store/tcp_store.h:120)
+  trace.cc   — host RecordEvent ring buffers + chrome-trace export
+               (≈ ref:paddle/fluid/platform/profiler/host_event_recorder.h)
+"""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC_DIR = os.path.join(_HERE, "csrc")
+_SOURCES = ["kvstore.cc", "trace.cc"]
+
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def _source_hash() -> str:
+    h = hashlib.sha256()
+    for s in _SOURCES:
+        with open(os.path.join(_SRC_DIR, s), "rb") as f:
+            h.update(f.read())
+    return h.hexdigest()[:16]
+
+
+def _build(out_path: str):
+    srcs = [os.path.join(_SRC_DIR, s) for s in _SOURCES]
+    cmd = ["g++", "-O2", "-fPIC", "-shared", "-std=c++17", "-pthread",
+           "-o", out_path] + srcs
+    subprocess.run(cmd, check=True, capture_output=True)
+
+
+def load():
+    """Load (building if needed) the native library; returns a ctypes CDLL."""
+    global _lib
+    if _lib is not None:
+        return _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        cache = os.environ.get(
+            "PADDLE_TPU_NATIVE_CACHE",
+            os.path.join(os.path.expanduser("~"), ".cache", "paddle_tpu"))
+        os.makedirs(cache, exist_ok=True)
+        so = os.path.join(cache, f"libpaddle_tpu_native_{_source_hash()}.so")
+        if not os.path.exists(so):
+            tmp = so + f".tmp{os.getpid()}"
+            _build(tmp)
+            os.replace(tmp, so)
+        lib = ctypes.CDLL(so)
+        _declare(lib)
+        _lib = lib
+        return _lib
+
+
+def _declare(lib):
+    c = ctypes
+    lib.pt_store_server_start.restype = c.c_void_p
+    lib.pt_store_server_start.argtypes = [c.c_int, c.c_int]
+    lib.pt_store_server_port.restype = c.c_int
+    lib.pt_store_server_port.argtypes = [c.c_void_p]
+    lib.pt_store_server_stop.argtypes = [c.c_void_p]
+    lib.pt_store_connect.restype = c.c_void_p
+    lib.pt_store_connect.argtypes = [c.c_char_p, c.c_int, c.c_int]
+    lib.pt_store_set.restype = c.c_int
+    lib.pt_store_set.argtypes = [c.c_void_p, c.c_char_p, c.c_char_p, c.c_int]
+    lib.pt_store_get.restype = c.c_int
+    lib.pt_store_get.argtypes = [c.c_void_p, c.c_char_p, c.c_char_p, c.c_int]
+    lib.pt_store_wait.restype = c.c_int
+    lib.pt_store_wait.argtypes = [c.c_void_p, c.c_char_p, c.c_char_p, c.c_int]
+    lib.pt_store_add.restype = c.c_longlong
+    lib.pt_store_add.argtypes = [c.c_void_p, c.c_char_p, c.c_longlong]
+    lib.pt_store_barrier.restype = c.c_int
+    lib.pt_store_barrier.argtypes = [c.c_void_p, c.c_char_p]
+    lib.pt_store_disconnect.argtypes = [c.c_void_p]
+
+    lib.pt_trace_enable.argtypes = [c.c_int]
+    lib.pt_trace_enabled.restype = c.c_int
+    lib.pt_trace_begin.restype = c.c_uint64
+    lib.pt_trace_end.argtypes = [c.c_char_p, c.c_uint64]
+    lib.pt_trace_instant.argtypes = [c.c_char_p]
+    lib.pt_trace_clear.argtypes = []
+    lib.pt_trace_event_count.restype = c.c_uint64
+    lib.pt_trace_dump.restype = c.c_uint64
+    lib.pt_trace_dump.argtypes = [c.c_char_p, c.c_uint64, c.c_int]

@@ -1,0 +1,102 @@
+"""Native C++ runtime: TCPStore KV/barrier and host trace recorder."""
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.store import TCPStore
+from paddle_tpu.profiler import Profiler, ProfilerTarget, RecordEvent
+
+
+def test_store_set_get_add():
+    master = TCPStore("127.0.0.1", 0, is_master=True, world_size=1)
+    try:
+        client = TCPStore("127.0.0.1", master.port, is_master=False, world_size=1)
+        client.set("k1", b"hello")
+        assert master.get("k1") == b"hello"
+        assert client.get("missing") is None
+        assert client.add("cnt", 3) == 3
+        assert master.add("cnt", 2) == 5
+        client.close()
+    finally:
+        master.close()
+
+
+def test_store_wait_blocks_until_set():
+    master = TCPStore("127.0.0.1", 0, is_master=True, world_size=1)
+    try:
+        client = TCPStore("127.0.0.1", master.port)
+        result = {}
+
+        def waiter():
+            result["v"] = client.wait("late_key")
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        import time
+
+        time.sleep(0.2)
+        assert "v" not in result
+        master.set("late_key", b"now")
+        t.join(timeout=5)
+        assert result.get("v") == b"now"
+        client.close()
+    finally:
+        master.close()
+
+
+def test_store_barrier_world2():
+    master = TCPStore("127.0.0.1", 0, is_master=True, world_size=2)
+    try:
+        c2 = TCPStore("127.0.0.1", master.port, world_size=2)
+        hits = []
+
+        def hit(store, i):
+            store.barrier("b")
+            hits.append(i)
+
+        t1 = threading.Thread(target=hit, args=(master, 1))
+        t1.start()
+        import time
+
+        time.sleep(0.2)
+        assert not hits  # first arriver blocks
+        hit(c2, 2)
+        t1.join(timeout=5)
+        assert sorted(hits) == [1, 2]
+        c2.close()
+    finally:
+        master.close()
+
+
+def test_trace_records_ops_and_exports(tmp_path):
+    prof = Profiler(targets=[ProfilerTarget.CPU])
+    prof.start()
+    with RecordEvent("user_scope"):
+        x = paddle.to_tensor(np.random.rand(8, 8).astype(np.float32))
+        y = (x @ x).sum()
+    prof.stop()
+    path = prof.export_chrome_tracing(str(tmp_path))
+    with open(path) as f:
+        trace = json.load(f)
+    names = {e["name"] for e in trace["traceEvents"]}
+    assert "user_scope" in names
+    assert any("matmul" in n or "sum" in n for n in names), names
+    table = prof.summary()
+    assert "user_scope" in table
+
+
+def test_trace_disabled_is_cheap_and_empty(tmp_path):
+    prof = Profiler()
+    prof.start()
+    prof.stop()
+    # after stop, new ops are NOT recorded
+    x = paddle.to_tensor(np.random.rand(4, 4).astype(np.float32))
+    _ = (x + x).numpy()
+    path = prof.export_chrome_tracing(str(tmp_path))
+    with open(path) as f:
+        trace = json.load(f)
+    assert all("add" not in e["name"] for e in trace["traceEvents"])
