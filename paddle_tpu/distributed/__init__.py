@@ -47,3 +47,5 @@ from . import pipeline  # noqa: F401
 from . import sharding  # noqa: F401
 from .store import TCPStore  # noqa: F401
 from .sharding import group_sharded_parallel, save_group_sharded_model  # noqa: F401
+from .spawn import spawn  # noqa: F401
+from . import launch  # noqa: F401

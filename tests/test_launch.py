@@ -1,0 +1,94 @@
+"""Launcher CLI + spawn: env contract, failure handling, elastic restart."""
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+
+def _run_launch(tmp_path, script_body, extra_args=None, nproc=2):
+    script = tmp_path / "worker.py"
+    script.write_text(textwrap.dedent(script_body))
+    cmd = [sys.executable, "-m", "paddle_tpu.distributed.launch",
+           f"--nproc_per_node={nproc}", f"--log_dir={tmp_path}/log"]
+    cmd += (extra_args or [])
+    cmd += [str(script)]
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH="/root/repo")
+    return subprocess.run(cmd, capture_output=True, text=True, timeout=120,
+                          env=env, cwd=str(tmp_path))
+
+
+def test_launch_sets_env_contract(tmp_path):
+    r = _run_launch(tmp_path, """
+        import os
+        rank = os.environ["PADDLE_TRAINER_ID"]
+        n = os.environ["PADDLE_TRAINERS_NUM"]
+        eps = os.environ["PADDLE_TRAINER_ENDPOINTS"].split(",")
+        cur = os.environ["PADDLE_CURRENT_ENDPOINT"]
+        assert len(eps) == int(n) == 2
+        assert cur == eps[int(rank)]
+        print("WORKER_OK", rank)
+    """)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "WORKER_OK 0" in r.stdout
+    log1 = (tmp_path / "log" / "workerlog.1").read_text()
+    assert "WORKER_OK 1" in log1
+
+
+def test_launch_propagates_failure(tmp_path):
+    r = _run_launch(tmp_path, """
+        import os, sys
+        sys.exit(3 if os.environ["PADDLE_TRAINER_ID"] == "1" else 0)
+    """)
+    assert r.returncode == 3
+
+
+def test_launch_elastic_restarts(tmp_path):
+    # worker fails once (flag file), succeeds after restart
+    r = _run_launch(tmp_path, """
+        import os, sys
+        flag = "restarted.flag"
+        if not os.path.exists(flag):
+            open(flag, "w").close()
+            sys.exit(1)
+        print("RECOVERED")
+    """, extra_args=["--elastic_level=1", "--max_restart=2"], nproc=1)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "RECOVERED" in r.stdout
+
+
+def test_spawn_runs_function_per_rank():
+    from paddle_tpu.distributed.spawn import spawn
+
+    results = spawn(_rank_fn, nprocs=2)
+    assert sorted(results) == [0, 1]
+
+
+def _rank_fn():
+    import os
+
+    return int(os.environ["PADDLE_TRAINER_ID"])
+
+
+def test_spawn_tcpstore_cross_process():
+    from paddle_tpu.distributed.spawn import spawn
+
+    results = spawn(_store_fn, nprocs=2)
+    assert sorted(results) == [b"from_rank_0", b"from_rank_1"]
+
+
+def _store_fn():
+    import os
+
+    from paddle_tpu.distributed.store import TCPStore
+
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    host, port = os.environ["PADDLE_TRAINER_ENDPOINTS"].split(",")[0].rsplit(":", 1)
+    store = TCPStore(host, int(port), is_master=(rank == 0), world_size=2)
+    store.set(f"msg/{rank}", f"from_rank_{rank}")
+    store.barrier("x")
+    other = store.wait(f"msg/{1 - rank}")
+    store.barrier("y")
+    store.close()
+    return other
