@@ -34,3 +34,12 @@ from .layers_common import (  # noqa: F401
     SyncBatchNorm,
     Upsample,
 )
+from .transformer import (  # noqa: F401
+    MultiHeadAttention,
+    Transformer,
+    TransformerDecoder,
+    TransformerDecoderLayer,
+    TransformerEncoder,
+    TransformerEncoderLayer,
+)
+from .stacked import StackedLayers  # noqa: F401
