@@ -37,9 +37,20 @@ def main():
         batch, seq = 4, 128
         warmup, iters = 1, 3
 
+    from paddle_tpu import amp
+
     model = GPTForCausalLM(cfg)
     opt = AdamW(learning_rate=1e-4, parameters=model.parameters(), weight_decay=0.01)
-    step = TrainStep(lambda x, y: model(x, y), opt, layers=model)
+
+    use_amp = platform == "tpu"
+
+    def loss_fn(x, y):
+        if use_amp:  # bf16 compute on the MXU; fp32 loss/master weights
+            with amp.auto_cast(level="O1", dtype="bfloat16"):
+                return model(x, y)
+        return model(x, y)
+
+    step = TrainStep(loss_fn, opt, layers=model)
 
     rng = np.random.default_rng(0)
     ids = rng.integers(0, cfg.vocab_size, (batch, seq), dtype=np.int32)

@@ -170,7 +170,10 @@ class TrainStep:
         fn, opt = self._fn, self._opt
         train_params, buffers = self._train_params, self._buffers
 
-        @jax.jit
+        # donate params + optimizer state: XLA updates them in place
+        # (halves the peak HBM of the update; old arrays are invalidated,
+        # but __call__ rebinds every Tensor._data to the new buffers)
+        @functools.partial(jax.jit, donate_argnums=(0, 2))
         def _step(param_arrays, buffer_arrays, opt_state, lr, key, args):
             def loss_f(pa):
                 sink = {}

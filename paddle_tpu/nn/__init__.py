@@ -43,3 +43,4 @@ from .transformer import (  # noqa: F401
     TransformerEncoderLayer,
 )
 from .stacked import StackedLayers  # noqa: F401
+from .rnn import GRU, GRUCell, LSTM, LSTMCell, SimpleRNN, SimpleRNNCell  # noqa: F401

@@ -1,0 +1,81 @@
+"""RNN layers: shapes, torch-golden values, training."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+def test_lstm_matches_torch():
+    torch = pytest.importorskip("torch")
+    paddle.seed(0)
+    b, s, f, h = 2, 5, 4, 3
+    ours = nn.LSTM(f, h, num_layers=1)
+    ref = torch.nn.LSTM(f, h, num_layers=1, batch_first=True)
+    sd = {}
+    with torch.no_grad():
+        ref.weight_ih_l0.copy_(torch.tensor(np.asarray(ours.wi_l0_d0._data)))
+        ref.weight_hh_l0.copy_(torch.tensor(np.asarray(ours.wh_l0_d0._data)))
+        ref.bias_ih_l0.copy_(torch.tensor(np.asarray(ours.bi_l0_d0._data)))
+        ref.bias_hh_l0.copy_(torch.tensor(np.asarray(ours.bh_l0_d0._data)))
+    x = np.random.rand(b, s, f).astype(np.float32)
+    out, (hn, cn) = ours(paddle.to_tensor(x))
+    tout, (thn, tcn) = ref(torch.tensor(x))
+    np.testing.assert_allclose(out.numpy(), tout.detach().numpy(), atol=1e-5)
+    np.testing.assert_allclose(hn.numpy(), thn.detach().numpy(), atol=1e-5)
+    np.testing.assert_allclose(cn.numpy(), tcn.detach().numpy(), atol=1e-5)
+
+
+def test_gru_matches_torch():
+    torch = pytest.importorskip("torch")
+    paddle.seed(0)
+    b, s, f, h = 2, 6, 4, 3
+    ours = nn.GRU(f, h)
+    ref = torch.nn.GRU(f, h, batch_first=True)
+    with torch.no_grad():
+        ref.weight_ih_l0.copy_(torch.tensor(np.asarray(ours.wi_l0_d0._data)))
+        ref.weight_hh_l0.copy_(torch.tensor(np.asarray(ours.wh_l0_d0._data)))
+        ref.bias_ih_l0.copy_(torch.tensor(np.asarray(ours.bi_l0_d0._data)))
+        ref.bias_hh_l0.copy_(torch.tensor(np.asarray(ours.bh_l0_d0._data)))
+    x = np.random.rand(b, s, f).astype(np.float32)
+    out, hn = ours(paddle.to_tensor(x))
+    tout, thn = ref(torch.tensor(x))
+    np.testing.assert_allclose(out.numpy(), tout.detach().numpy(), atol=1e-5)
+
+
+def test_bidirectional_lstm_shapes():
+    paddle.seed(0)
+    m = nn.LSTM(4, 3, num_layers=2, direction="bidirect")
+    x = paddle.to_tensor(np.random.rand(2, 5, 4).astype(np.float32))
+    out, (h, c) = m(x)
+    assert out.shape == [2, 5, 6]       # 2 directions * hidden
+    assert h.shape == [4, 2, 3]         # layers*dirs, batch, hidden
+    assert c.shape == [4, 2, 3]
+
+
+def test_lstm_trains():
+    paddle.seed(0)
+    m = nn.LSTM(4, 8)
+    head = nn.Linear(8, 1)
+    opt = paddle.optimizer.Adam(learning_rate=0.01,
+                                parameters=m.parameters() + head.parameters())
+    X = np.random.rand(16, 6, 4).astype(np.float32)
+    Y = X.sum(axis=(1, 2), keepdims=False).reshape(-1, 1).astype(np.float32)
+    first = None
+    for _ in range(40):
+        out, (h, _) = m(paddle.to_tensor(X))
+        loss = ((head(h[0]) - paddle.to_tensor(Y)) ** 2).mean()
+        if first is None:
+            first = float(loss.numpy())
+        loss.backward()
+        opt.step(); opt.clear_grad()
+    assert float(loss.numpy()) < first * 0.5
+
+
+def test_cells():
+    paddle.seed(0)
+    for cell_cls, states in ((nn.SimpleRNNCell, 1), (nn.LSTMCell, 2), (nn.GRUCell, 1)):
+        cell = cell_cls(4, 3)
+        x = paddle.to_tensor(np.random.rand(2, 4).astype(np.float32))
+        out, st = cell(x)
+        assert out.shape == [2, 3]
