@@ -79,3 +79,4 @@ def enable_static():
 
 def in_dynamic_mode():
     return True
+from . import distribution  # noqa: F401,E402

@@ -1,0 +1,87 @@
+"""paddle.distribution parity: moments, log_prob goldens (scipy), KL."""
+import numpy as np
+import pytest
+from scipy import stats
+
+import paddle_tpu as paddle
+from paddle_tpu import distribution as D
+
+
+def test_normal_logprob_and_moments():
+    d = D.Normal(1.5, 2.0)
+    xs = np.linspace(-3, 5, 7).astype(np.float32)
+    np.testing.assert_allclose(d.log_prob(paddle.to_tensor(xs)).numpy(),
+                               stats.norm.logpdf(xs, 1.5, 2.0), atol=1e-5)
+    s = d.sample((20000,)).numpy()
+    assert abs(s.mean() - 1.5) < 0.1 and abs(s.std() - 2.0) < 0.1
+    np.testing.assert_allclose(float(d.entropy().numpy()),
+                               stats.norm.entropy(1.5, 2.0), atol=1e-5)
+
+
+@pytest.mark.parametrize("ctor,sp,args", [
+    (D.Exponential, stats.expon, {"scale": 1 / 1.7}),
+    (D.Laplace, stats.laplace, {"loc": 0.5, "scale": 1.2}),
+    (D.Gumbel, stats.gumbel_r, {"loc": 0.5, "scale": 1.2}),
+])
+def test_logprob_goldens(ctor, sp, args):
+    if ctor is D.Exponential:
+        d = ctor(1.7)
+    else:
+        d = ctor(0.5, 1.2)
+    xs = np.linspace(0.1, 3, 5).astype(np.float32)
+    np.testing.assert_allclose(d.log_prob(paddle.to_tensor(xs)).numpy(),
+                               sp.logpdf(xs, **args), atol=1e-4)
+
+
+def test_gamma_beta_logprob():
+    g = D.Gamma(2.0, 3.0)
+    xs = np.asarray([0.2, 0.5, 1.0], np.float32)
+    np.testing.assert_allclose(g.log_prob(paddle.to_tensor(xs)).numpy(),
+                               stats.gamma.logpdf(xs, 2.0, scale=1 / 3.0), atol=1e-4)
+    b = D.Beta(2.0, 5.0)
+    xs = np.asarray([0.1, 0.4, 0.8], np.float32)
+    np.testing.assert_allclose(b.log_prob(paddle.to_tensor(xs)).numpy(),
+                               stats.beta.logpdf(xs, 2.0, 5.0), atol=1e-4)
+
+
+def test_categorical_sample_and_logprob():
+    paddle.seed(0)
+    d = D.Categorical(probs=np.asarray([0.2, 0.3, 0.5], np.float32))
+    s = d.sample((5000,)).numpy()
+    freq = np.bincount(s.astype(int), minlength=3) / 5000
+    np.testing.assert_allclose(freq, [0.2, 0.3, 0.5], atol=0.03)
+    lp = d.log_prob(paddle.to_tensor(np.asarray([0, 1, 2])))
+    np.testing.assert_allclose(lp.numpy(), np.log([0.2, 0.3, 0.5]), atol=1e-5)
+
+
+def test_bernoulli_poisson():
+    b = D.Bernoulli(probs=0.3)
+    np.testing.assert_allclose(float(b.log_prob(paddle.to_tensor(1.0)).numpy()),
+                               np.log(0.3), atol=1e-5)
+    p = D.Poisson(4.0)
+    np.testing.assert_allclose(float(p.log_prob(paddle.to_tensor(2.0)).numpy()),
+                               stats.poisson.logpmf(2, 4.0), atol=1e-4)
+
+
+def test_kl_registry():
+    p = D.Normal(0.0, 1.0)
+    q = D.Normal(1.0, 2.0)
+    expected = np.log(2.0) + (1 + 1) / 8 - 0.5
+    np.testing.assert_allclose(float(D.kl_divergence(p, q).numpy()), expected, atol=1e-5)
+    c1 = D.Categorical(probs=np.asarray([0.5, 0.5], np.float32))
+    c2 = D.Categorical(probs=np.asarray([0.9, 0.1], np.float32))
+    kl = float(D.kl_divergence(c1, c2).numpy())
+    assert kl > 0
+    with pytest.raises(NotImplementedError):
+        D.kl_divergence(p, c1)
+
+
+def test_dirichlet_multinomial():
+    paddle.seed(0)
+    d = D.Dirichlet(np.asarray([2.0, 3.0, 5.0], np.float32))
+    s = d.sample((2000,)).numpy()
+    np.testing.assert_allclose(s.mean(0), [0.2, 0.3, 0.5], atol=0.03)
+    m = D.Multinomial(10, np.asarray([0.2, 0.3, 0.5], np.float32))
+    sm = m.sample((500,)).numpy()
+    assert sm.sum(-1).max() == 10
+    np.testing.assert_allclose(sm.mean(0), [2.0, 3.0, 5.0], atol=0.4)
