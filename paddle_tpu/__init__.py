@@ -80,3 +80,6 @@ def enable_static():
 def in_dynamic_mode():
     return True
 from . import distribution  # noqa: F401,E402
+from . import sparse  # noqa: F401,E402
+from . import static  # noqa: F401,E402
+from . import inference  # noqa: F401,E402

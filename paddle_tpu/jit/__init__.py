@@ -243,24 +243,113 @@ def grad_and_value(fn: Callable, params: List[Tensor]):
     return run
 
 
+class InputSpec:
+    """paddle.static.InputSpec parity (shape may contain None for dynamic
+    batch — exported with a fixed example size of 1 for those dims)."""
+
+    def __init__(self, shape, dtype="float32", name=None):
+        self.shape = list(shape)
+        self.dtype = dtype
+        self.name = name
+
+    def to_sds(self):
+        import jax
+
+        from ..core.dtype import convert_dtype_arg
+
+        shape = tuple(1 if s is None or s < 0 else int(s) for s in self.shape)
+        return jax.ShapeDtypeStruct(shape, jnp.dtype(convert_dtype_arg(self.dtype)))
+
+
 def save(layer, path, input_spec=None, **configs):
-    """jit.save: persist state_dict + metadata (deployable via jit.load)."""
+    """jit.save — deployable export (≈ ref jit.save -> TranslatedLayer,
+    ref:python/paddle/jit/api.py).
+
+    Writes:
+      path.pdparams  — pickled numpy state dict (paddle contract)
+      path.pdmodel   — serialized StableHLO program (jax.export), callable
+                       after jit.load WITHOUT the Python model code — the
+                       compiled-program deployment story (replaces the
+                       reference's Program pbtxt + C++ executor).
+    Program export happens when input_spec is given (or the layer was
+    to_static-decorated with one).
+    """
     import os
     import pickle
 
-    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     import numpy as np
 
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    state = {}
     if isinstance(layer, Layer):
         state = {k: np.asarray(v._data) for k, v in layer.state_dict().items()}
-    else:
-        state = {}
     with open(path + ".pdparams", "wb") as f:
         pickle.dump(state, f, protocol=4)
 
+    if input_spec and isinstance(layer, Layer):
+        from jax import export as jexport
+
+        was_training = layer.training
+        layer.eval()
+        params, buffers = layer.functional_state()
+        objs = list(params.values()) + list(buffers.values())
+        arrays = [p._data for p in objs]
+
+        def fwd(param_arrays, *inputs):
+            with _swap_data(objs, list(param_arrays)):
+                with rng.key_guard(jax.random.key(0)):
+                    out = layer(*[Tensor(i) for i in inputs])
+            return out._data if isinstance(out, Tensor) else out
+
+        sds = [s.to_sds() if isinstance(s, InputSpec) else s for s in input_spec]
+        param_sds = [jax.ShapeDtypeStruct(a.shape, a.dtype) for a in arrays]
+        exp = jexport.export(jax.jit(fwd))(param_sds, *sds)
+        with open(path + ".pdmodel", "wb") as f:
+            pickle.dump({
+                "stablehlo": exp.serialize(),
+                "param_keys": list(params.keys()) + list(buffers.keys()),
+            }, f, protocol=4)
+        if was_training:
+            layer.train()
+
+
+class TranslatedLayer:
+    """Result of jit.load on an exported program: a callable that runs the
+    deserialized StableHLO with the saved parameters (no model code)."""
+
+    def __init__(self, exported, param_arrays):
+        self._exported = exported
+        self._params = param_arrays
+
+    def __call__(self, *inputs):
+        arrs = [i._data if isinstance(i, Tensor) else jnp.asarray(i) for i in inputs]
+        return Tensor(self._exported.call(self._params, *arrs))
+
+    def forward(self, *inputs):
+        return self(*inputs)
+
+    def eval(self):
+        return self
+
+    def train(self):
+        raise RuntimeError("TranslatedLayer is inference-only")
+
 
 def load(path, **configs):
+    """jit.load: returns a TranslatedLayer when a .pdmodel exists, else the
+    raw state dict (legacy contract)."""
+    import os
     import pickle
 
+    if os.path.exists(path + ".pdmodel"):
+        from jax import export as jexport
+
+        with open(path + ".pdmodel", "rb") as f:
+            meta = pickle.load(f)
+        with open(path + ".pdparams", "rb") as f:
+            state = pickle.load(f)
+        exported = jexport.deserialize(meta["stablehlo"])
+        arrays = [jnp.asarray(state[k]) for k in meta["param_keys"]]
+        return TranslatedLayer(exported, arrays)
     with open(path + ".pdparams", "rb") as f:
         return pickle.load(f)

@@ -1,0 +1,61 @@
+"""jit.save/load StableHLO export + inference predictor."""
+import os
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import inference, nn
+from paddle_tpu.jit import InputSpec
+
+
+def _model():
+    paddle.seed(7)
+    return nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+
+
+def test_export_and_load_runs_without_model_code(tmp_path):
+    m = _model()
+    m.eval()
+    x = np.random.rand(3, 4).astype(np.float32)
+    expected = m(paddle.to_tensor(x)).numpy()
+    prefix = os.path.join(str(tmp_path), "deploy", "model")
+    paddle.jit.save(m, prefix, input_spec=[InputSpec([3, 4], "float32")])
+    assert os.path.exists(prefix + ".pdmodel")
+    loaded = paddle.jit.load(prefix)
+    out = loaded(paddle.to_tensor(x))
+    np.testing.assert_allclose(out.numpy(), expected, atol=1e-5)
+
+
+def test_static_save_load_inference_model(tmp_path):
+    m = _model()
+    m.eval()
+    prefix = os.path.join(str(tmp_path), "infer")
+    paddle.static.save_inference_model(prefix, m, [InputSpec([2, 4])])
+    loaded = paddle.static.load_inference_model(prefix)
+    x = np.random.rand(2, 4).astype(np.float32)
+    np.testing.assert_allclose(loaded(x).numpy(), m(paddle.to_tensor(x)).numpy(),
+                               atol=1e-5)
+
+
+def test_predictor_api(tmp_path):
+    m = _model()
+    m.eval()
+    prefix = os.path.join(str(tmp_path), "pred")
+    paddle.jit.save(m, prefix, input_spec=[InputSpec([2, 4])])
+    cfg = inference.Config(prefix + ".pdmodel")
+    predictor = inference.create_predictor(cfg)
+    x = np.random.rand(2, 4).astype(np.float32)
+    h = predictor.get_input_handle("input_0")
+    h.copy_from_cpu(x)
+    predictor.run()
+    out = predictor.get_output_handle("output_0").copy_to_cpu()
+    np.testing.assert_allclose(out, m(paddle.to_tensor(x)).numpy(), atol=1e-5)
+
+
+def test_legacy_static_apis_raise():
+    import pytest
+
+    with pytest.raises(NotImplementedError):
+        paddle.static.Program()
+    with pytest.raises(NotImplementedError):
+        paddle.static.data("x", [1])

@@ -1,0 +1,71 @@
+"""paddle.sparse: creation, conversion, ops."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import sparse
+
+
+def _coo():
+    indices = np.asarray([[0, 1, 2], [1, 2, 0]])  # [ndim, nnz] paddle layout
+    values = np.asarray([1.0, 2.0, 3.0], np.float32)
+    return sparse.sparse_coo_tensor(indices, values, shape=[3, 3])
+
+
+def test_coo_roundtrip():
+    s = _coo()
+    dense = s.to_dense().numpy()
+    expect = np.zeros((3, 3), np.float32)
+    expect[0, 1], expect[1, 2], expect[2, 0] = 1, 2, 3
+    np.testing.assert_allclose(dense, expect)
+    assert s.nnz() == 3
+    np.testing.assert_allclose(s.values().numpy(), [1, 2, 3])
+    assert s.indices().shape == [2, 3]
+
+
+def test_to_sparse_and_back():
+    x = paddle.to_tensor(np.asarray([[0, 5.0], [7.0, 0]], np.float32))
+    s = sparse.to_sparse_coo(x)
+    np.testing.assert_allclose(s.to_dense().numpy(), x.numpy())
+    csr = s.to_sparse_csr()
+    np.testing.assert_allclose(csr.to_dense().numpy(), x.numpy())
+    assert csr.nnz() == 2
+
+
+def test_sparse_dense_matmul():
+    s = _coo()
+    d = paddle.to_tensor(np.random.rand(3, 4).astype(np.float32))
+    out = sparse.matmul(s, d)
+    np.testing.assert_allclose(out.numpy(), s.to_dense().numpy() @ d.numpy(),
+                               atol=1e-6)
+
+
+def test_sparse_add_and_unary():
+    s = _coo()
+    out = sparse.add(s, s)
+    np.testing.assert_allclose(out.to_dense().numpy(), 2 * s.to_dense().numpy())
+    r = sparse.relu(sparse.add(s, s))
+    assert isinstance(r, sparse.SparseCooTensor)
+    neg = sparse.neg(s)
+    np.testing.assert_allclose(neg.to_dense().numpy(), -s.to_dense().numpy())
+
+
+def test_csr_creation():
+    crows = np.asarray([0, 1, 2, 3])
+    cols = np.asarray([1, 2, 0])
+    vals = np.asarray([1.0, 2.0, 3.0], np.float32)
+    s = sparse.sparse_csr_tensor(crows, cols, vals, [3, 3])
+    expect = np.zeros((3, 3), np.float32)
+    expect[0, 1], expect[1, 2], expect[2, 0] = 1, 2, 3
+    np.testing.assert_allclose(s.to_dense().numpy(), expect)
+
+
+def test_masked_matmul():
+    mask = _coo()
+    a = paddle.to_tensor(np.random.rand(3, 5).astype(np.float32))
+    b = paddle.to_tensor(np.random.rand(5, 3).astype(np.float32))
+    out = sparse.masked_matmul(a, b, mask)
+    full = a.numpy() @ b.numpy()
+    dense = out.to_dense().numpy()
+    for (i, j) in [(0, 1), (1, 2), (2, 0)]:
+        np.testing.assert_allclose(dense[i, j], full[i, j], atol=1e-5)
