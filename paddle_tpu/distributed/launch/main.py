@@ -39,6 +39,9 @@ def _parse(argv=None):
     p = argparse.ArgumentParser(prog="paddle_tpu.distributed.launch")
     p.add_argument("--master", default=None, help="rank0 host:port (default: auto local)")
     p.add_argument("--nnodes", type=int, default=1)
+    p.add_argument("--hosts", default=os.environ.get("PADDLE_TRAINER_HOSTS"),
+                   help="comma-separated host list, one per node (required "
+                        "for --nnodes > 1); also read from PADDLE_TRAINER_HOSTS")
     p.add_argument("--node_rank", type=int, default=int(os.environ.get("PADDLE_NODE_RANK", "0")))
     p.add_argument("--nproc_per_node", type=int, default=1)
     p.add_argument("--log_dir", default="log")
@@ -68,11 +71,24 @@ class Pod:
         n_local = a.nproc_per_node
         world = a.nnodes * n_local
         base = a.node_rank * n_local
+        if a.nnodes > 1:
+            if not a.master:
+                raise SystemExit(
+                    "--nnodes > 1 requires --master host:port (every node "
+                    "must agree on the rendezvous address and port base)")
+            node_hosts = [h.strip() for h in (a.hosts or "").split(",") if h.strip()]
+            if len(node_hosts) != a.nnodes:
+                raise SystemExit(
+                    f"--nnodes={a.nnodes} requires --hosts (or "
+                    f"PADDLE_TRAINER_HOSTS) with exactly {a.nnodes} "
+                    f"comma-separated hosts; got {a.hosts!r}")
+        else:
+            node_hosts = [host]
         endpoints = []
         for node in range(a.nnodes):
-            nh = host if a.nnodes == 1 else f"{host}"  # single-host default
             for i in range(n_local):
-                endpoints.append(f"{nh}:{int(port) + node * n_local + i}")
+                endpoints.append(
+                    f"{node_hosts[node]}:{int(port) + node * n_local + i}")
         devices = (a.devices.split(",") if a.devices
                    else [str(i) for i in range(n_local)])
         for local_rank in range(n_local):

@@ -118,7 +118,8 @@ class Model:
                 total_loss += float(np.asarray(self._loss(out, y)._data))
                 batches += 1
             for m in self._metrics:
-                m.update(m.compute(out, y))
+                res = m.compute(out, y)
+                m.update(*res) if isinstance(res, tuple) else m.update(res)
         logs = {}
         if batches:
             logs["loss"] = total_loss / batches

@@ -244,21 +244,31 @@ def grad_and_value(fn: Callable, params: List[Tensor]):
 
 
 class InputSpec:
-    """paddle.static.InputSpec parity (shape may contain None for dynamic
-    batch — exported with a fixed example size of 1 for those dims)."""
+    """paddle.static.InputSpec parity. Dims of None/-1 are exported as
+    jax.export symbolic dimensions, so the saved program stays callable at
+    any size for those axes (the reference's dynamic-batch .pdmodel
+    contract)."""
 
     def __init__(self, shape, dtype="float32", name=None):
         self.shape = list(shape)
         self.dtype = dtype
         self.name = name
 
-    def to_sds(self):
+    def to_sds(self, scope=None, prefix="d"):
         import jax
 
         from ..core.dtype import convert_dtype_arg
 
-        shape = tuple(1 if s is None or s < 0 else int(s) for s in self.shape)
-        return jax.ShapeDtypeStruct(shape, jnp.dtype(convert_dtype_arg(self.dtype)))
+        dtype = jnp.dtype(convert_dtype_arg(self.dtype))
+        if any(s is None or s < 0 for s in self.shape):
+            from jax import export as jexport
+
+            parts = [f"{prefix}{i}" if s is None or s < 0 else str(int(s))
+                     for i, s in enumerate(self.shape)]
+            shape = jexport.symbolic_shape(",".join(parts), scope=scope)
+        else:
+            shape = tuple(int(s) for s in self.shape)
+        return jax.ShapeDtypeStruct(tuple(shape), dtype)
 
 
 def save(layer, path, input_spec=None, **configs):
@@ -301,7 +311,14 @@ def save(layer, path, input_spec=None, **configs):
                     out = layer(*[Tensor(i) for i in inputs])
             return out._data if isinstance(out, Tensor) else out
 
-        sds = [s.to_sds() if isinstance(s, InputSpec) else s for s in input_spec]
+        # One shared scope; unnamed specs share per-axis symbols (d0, d1, ...)
+        # so the common "all inputs share the dynamic batch/seq size" case
+        # exports with the dims constrained equal. A spec with name= gets its
+        # own symbols (name_0, ...) for genuinely independent dynamic dims.
+        scope = jexport.SymbolicScope()
+        sds = [s.to_sds(scope=scope, prefix=(f"{s.name}_" if s.name else "d"))
+               if isinstance(s, InputSpec) else s
+               for s in input_spec]
         param_sds = [jax.ShapeDtypeStruct(a.shape, a.dtype) for a in arrays]
         exp = jexport.export(jax.jit(fwd))(param_sds, *sds)
         with open(path + ".pdmodel", "wb") as f:

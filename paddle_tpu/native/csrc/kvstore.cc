@@ -39,6 +39,14 @@ struct Server {
   std::map<std::string, int64_t> counters;
   int world_size = 1;
   std::vector<std::thread> workers;
+  // Live client fds, so pt_store_server_stop can shutdown() them to unblock
+  // workers; workers are joined, never detached, so no thread can outlive
+  // the Server. A worker erases + closes its own fd on disconnect and queues
+  // its thread id in `finished` for the accept loop to reap (bounds fd and
+  // thread growth on long-lived servers with client churn).
+  std::mutex fds_mu;
+  std::vector<int> client_fds;
+  std::vector<std::thread::id> finished;
 };
 
 bool read_n(int fd, void* buf, size_t n) {
@@ -77,7 +85,7 @@ bool write_blob(int fd, const std::string& s) {
   return s.empty() || write_n(fd, s.data(), s.size());
 }
 
-void serve_client(Server* srv, int fd) {
+void serve_loop(Server* srv, int fd) {
   int one = 1;
   ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
   for (;;) {
@@ -163,6 +171,23 @@ void serve_client(Server* srv, int fd) {
         return;
     }
   }
+}
+
+void serve_client(Server* srv, int fd) {
+  serve_loop(srv, fd);
+  // Remove the fd from the live set BEFORE closing so stop() (which only
+  // shutdowns fds still in the set, under fds_mu) can never race this close.
+  {
+    std::lock_guard<std::mutex> g(srv->fds_mu);
+    auto& v = srv->client_fds;
+    for (auto it = v.begin(); it != v.end(); ++it) {
+      if (*it == fd) {
+        v.erase(it);
+        break;
+      }
+    }
+    srv->finished.push_back(std::this_thread::get_id());
+  }
   ::close(fd);
 }
 
@@ -195,6 +220,31 @@ void* pt_store_server_start(int port, int world_size) {
     while (!srv->stop.load()) {
       int fd = ::accept(srv->listen_fd, nullptr, nullptr);
       if (fd < 0) break;
+      {
+        std::lock_guard<std::mutex> g(srv->fds_mu);
+        srv->client_fds.push_back(fd);
+      }
+      // Reap workers that finished (disconnected clients) so thread objects
+      // don't accumulate over the server lifetime under client churn.
+      std::vector<std::thread::id> done;
+      {
+        std::lock_guard<std::mutex> g(srv->fds_mu);
+        done.swap(srv->finished);
+      }
+      if (!done.empty()) {
+        auto& w = srv->workers;
+        for (auto it = w.begin(); it != w.end();) {
+          bool fin = false;
+          for (auto id : done)
+            if (it->get_id() == id) fin = true;
+          if (fin) {
+            it->join();
+            it = w.erase(it);
+          } else {
+            ++it;
+          }
+        }
+      }
       srv->workers.emplace_back(serve_client, srv, fd);
     }
   });
@@ -212,13 +262,26 @@ int pt_store_server_port(void* handle) {
 
 void pt_store_server_stop(void* handle) {
   auto* srv = static_cast<Server*>(handle);
-  srv->stop.store(true);
+  {
+    // Set stop under mu: a waiter that checked the predicate but has not yet
+    // slept holds mu, so notify_all issued after release cannot be lost.
+    std::lock_guard<std::mutex> g(srv->mu);
+    srv->stop.store(true);
+  }
   srv->cv.notify_all();
   ::shutdown(srv->listen_fd, SHUT_RDWR);
   ::close(srv->listen_fd);
   if (srv->accept_thread.joinable()) srv->accept_thread.join();
+  {
+    std::lock_guard<std::mutex> g(srv->fds_mu);
+    for (int fd : srv->client_fds) ::shutdown(fd, SHUT_RDWR);
+  }
   for (auto& t : srv->workers)
-    if (t.joinable()) t.detach();  // blocked clients exit on socket close
+    if (t.joinable()) t.join();
+  {
+    std::lock_guard<std::mutex> g(srv->fds_mu);
+    for (int fd : srv->client_fds) ::close(fd);
+  }
   delete srv;
 }
 

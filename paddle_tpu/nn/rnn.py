@@ -174,10 +174,21 @@ class _RNNBase(Layer):
         return outs, carry
 
     def forward(self, inputs, initial_states=None, sequence_length=None):
+        if sequence_length is not None:
+            raise NotImplementedError(
+                "sequence_length is not supported by paddle_tpu RNN layers; "
+                "mask the padded steps of the output instead")
         lstm = self.MODE == "LSTM"
 
-        def run(x, *flat_params):
+        # initial_states: LSTM -> (h0, c0), each [L*D, b, h]; RNN/GRU -> h0.
+        init_args = ()
+        if initial_states is not None:
+            init_args = (tuple(initial_states) if lstm else (initial_states,))
+
+        def run(x, *rest):
             # x arrives batch-major [b, s, f] unless time_major
+            n_init = len(init_args)
+            inits, flat_params = rest[:n_init], rest[n_init:]
             xt = x if self.time_major else jnp.swapaxes(x, 0, 1)
             s, b = xt.shape[0], xt.shape[1]
             params = [flat_params[i * 4:(i + 1) * 4]
@@ -189,9 +200,14 @@ class _RNNBase(Layer):
                 outs_dirs = []
                 for d in range(self.num_directions):
                     wi, wh, bi, bh = params[idx]
+                    if inits:
+                        h0 = inits[0][idx].astype(layer_in.dtype)
+                        init = ((h0, inits[1][idx].astype(layer_in.dtype))
+                                if lstm else h0)
+                    else:
+                        z = jnp.zeros((b, self.hidden_size), layer_in.dtype)
+                        init = (z, z) if lstm else z
                     idx += 1
-                    z = jnp.zeros((b, self.hidden_size), layer_in.dtype)
-                    init = (z, z) if lstm else z
                     outs, carry = self._scan_layer(layer_in, wi, wh, bi, bh,
                                                    init, reverse=(d == 1))
                     outs_dirs.append(outs)
@@ -211,7 +227,7 @@ class _RNNBase(Layer):
         flat = []
         for p in self._params:
             flat += [p["wi"], p["wh"], p["bi"], p["bh"]]
-        res = apply(run, (inputs, *flat), {}, name=self.MODE.lower())
+        res = apply(run, (inputs, *init_args, *flat), {}, name=self.MODE.lower())
         if lstm:
             out, h, c = res
             return out, (h, c)
