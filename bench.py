@@ -1,12 +1,20 @@
-"""Headline benchmark: GPT causal-LM training throughput, samples/sec/chip.
+"""Headline benchmark: GPT causal-LM training throughput + MFU.
 
 Runs the flagship GPT model (config scaled to the platform: GPT-base-ish on
 a real TPU chip, tiny on CPU) through the fully-compiled TrainStep and prints
-ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+ONE JSON line: {"metric", "value", "unit", "vs_baseline", "tokens_per_sec",
+"tflops", "mfu"}.
 
 The reference publishes no absolute numbers (BASELINE.md) — baseline is our
 own first recorded run, stored in BENCH_BASELINE.json; vs_baseline is the
-ratio current/recorded (1.0 on the run that creates the record).
+ratio current/recorded tokens/sec (1.0 on the run that creates the record).
+
+MFU = achieved model FLOP/s ÷ chip peak bf16 FLOP/s, with the standard
+training accounting: 6·N_matmul per token (fwd+bwd over every matmul
+parameter, including the tied LM head) plus 6·L·s·h for causal attention
+(QKᵀ and PV, halved for causality, ×3 for fwd+bwd).
+
+Env knobs for sweeps: BENCH_BATCH, BENCH_SEQ, BENCH_REMAT=1, BENCH_ITERS.
 """
 from __future__ import annotations
 
@@ -16,8 +24,35 @@ import time
 
 import numpy as np
 
+# bf16 peak FLOP/s per chip by PJRT device_kind (public spec sheets)
+PEAK_FLOPS = {
+    "TPU v2": 45e12,
+    "TPU v3": 123e12,
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5p": 459e12,
+    "TPU v5": 459e12,
+    "TPU v6 lite": 918e12,
+    "TPU v6e": 918e12,
+}
+
+
+def model_flops_per_token(cfg) -> float:
+    """Training FLOPs per token: 6*N_matmul + causal attention term."""
+    h, L, V = cfg.hidden_size, cfg.num_layers, cfg.vocab_size
+    i = cfg.intermediate_size
+    n_matmul = L * (4 * h * h + 2 * h * i)  # qkv+proj (4h^2) + mlp up/down
+    n_matmul += h * V  # (tied) LM head
+    attn = 6 * L * cfg_seq_len * h  # 3*(4*s*h)/2 causal, per token
+    return 6.0 * n_matmul + attn
+
+
+cfg_seq_len = 1024  # set in main() before flop accounting
+
 
 def main():
+    global cfg_seq_len
     import jax
 
     from paddle_tpu.core.tensor import Tensor
@@ -25,17 +60,21 @@ def main():
     from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM, gpt_tiny
     from paddle_tpu.optimizer import AdamW
 
-    platform = jax.devices()[0].platform
+    dev = jax.devices()[0]
+    platform = dev.platform
+    remat = os.environ.get("BENCH_REMAT", "0") == "1"
     if platform == "tpu":
         cfg = GPTConfig(vocab_size=50304, hidden_size=768, num_layers=12,
-                        num_heads=12, max_position_embeddings=1024,
-                        use_recompute=False)
-        batch, seq = 8, 1024
-        warmup, iters = 3, 10
+                        num_heads=12, max_position_embeddings=2048,
+                        use_recompute=remat)
+        batch = int(os.environ.get("BENCH_BATCH", "8"))
+        seq = int(os.environ.get("BENCH_SEQ", "1024"))
+        warmup, iters = 3, int(os.environ.get("BENCH_ITERS", "10"))
     else:  # CPU smoke path so the script always works
         cfg = gpt_tiny()
         batch, seq = 4, 128
         warmup, iters = 1, 3
+    cfg_seq_len = seq
 
     from paddle_tpu import amp
 
@@ -67,6 +106,10 @@ def main():
     dt = time.perf_counter() - t0
 
     samples_per_sec = batch * iters / dt
+    tokens_per_sec = samples_per_sec * seq
+    flops = model_flops_per_token(cfg) * tokens_per_sec
+    peak = PEAK_FLOPS.get(dev.device_kind)
+    mfu = flops / peak if peak else None
     metric = f"samples/sec/chip (GPT {cfg.hidden_size}h/{cfg.num_layers}L b{batch} s{seq} {platform})"
 
     baseline_path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_BASELINE.json")
@@ -78,14 +121,18 @@ def main():
         rec = None
         try:
             with open(baseline_path, "w") as f:
-                json.dump({"metric": metric, "value": samples_per_sec}, f)
+                json.dump({"metric": metric, "value": samples_per_sec,
+                           "tokens_per_sec": tokens_per_sec}, f)
         except OSError:
             pass
     if rec is not None:
+        rec_tps = rec.get("tokens_per_sec")
         if rec.get("metric") == metric and rec.get("value"):
             vs = samples_per_sec / float(rec["value"])
+        elif rec_tps and "(GPT " in rec.get("metric", "") and f"{platform})" in rec.get("metric", ""):
+            # config changed (batch/seq sweep): tokens/sec is still comparable
+            vs = tokens_per_sec / float(rec_tps)
         else:
-            # different platform/config: don't clobber the recorded baseline
             vs = None
 
     print(json.dumps({
@@ -93,6 +140,9 @@ def main():
         "value": round(samples_per_sec, 3),
         "unit": "samples/sec/chip",
         "vs_baseline": round(vs, 4) if vs is not None else None,
+        "tokens_per_sec": round(tokens_per_sec, 1),
+        "tflops": round(flops / 1e12, 2),
+        "mfu": round(mfu, 4) if mfu is not None else None,
     }))
 
 

@@ -45,7 +45,10 @@ from .core.tensor import Tensor, to_tensor  # noqa: F401
 
 # op surface (paddle.* functions)
 from .ops import *  # noqa: F401,F403
-from .ops import creation, linalg, manipulation, math, random  # noqa: F401
+from .ops import creation, manipulation, math, random  # noqa: F401
+from . import fft  # noqa: F401
+from . import signal  # noqa: F401
+from . import linalg  # noqa: F401
 
 # subpackages (imported lazily by users: paddle_tpu.nn, .optimizer, ...)
 from . import nn  # noqa: F401,E402

@@ -273,3 +273,41 @@ for _m in ("matmul", "mm", "bmm", "mv", "dot", "norm", "dist", "cholesky", "inve
     Tensor._register_method(_m, getattr(_this, _m))
 Tensor.__matmul__ = lambda self, other: matmul(self, other)
 Tensor.__rmatmul__ = lambda self, other: matmul(other, self)
+
+
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    """Unpack LU factorization (ref:python/paddle/tensor/linalg.py lu_unpack):
+    x = packed LU from ``lu``, y = 1-based pivots. Returns (P, L, U)."""
+    import numpy as _np
+
+    def _unpack(lu_, piv):
+        m, n = lu_.shape[-2], lu_.shape[-1]
+        k = min(m, n)
+        L = jnp.tril(lu_[..., :, :k], -1) + jnp.eye(m, k, dtype=lu_.dtype)
+        U = jnp.triu(lu_[..., :k, :])
+        # pivots -> permutation matrix: apply row swaps to identity
+        def perm_of(p):
+            def body(i, perm):
+                j = p[i] - 1
+                pi, pj = perm[i], perm[j]
+                perm = perm.at[i].set(pj)
+                return perm.at[j].set(pi)
+            return jax.lax.fori_loop(0, p.shape[0], body, jnp.arange(m))
+        if piv.ndim == 1:
+            perm = perm_of(piv)
+            P = jnp.zeros((m, m), lu_.dtype).at[perm, jnp.arange(m)].set(1.0)
+        else:
+            batch = piv.reshape((-1, piv.shape[-1]))
+            perms = jax.vmap(perm_of)(batch)
+            eye = jnp.zeros((perms.shape[0], m, m), lu_.dtype)
+            bi = jnp.arange(perms.shape[0])[:, None]
+            P = eye.at[bi, perms, jnp.arange(m)[None, :]].set(1.0)
+            P = P.reshape(lu_.shape[:-2] + (m, m))
+        return P, L, U
+
+    return apply(_unpack, (x, y), {})
+
+
+def inv(x, name=None):
+    """Alias of inverse (paddle.linalg.inv)."""
+    return inverse(x, name=name)

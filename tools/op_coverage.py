@@ -1,0 +1,76 @@
+"""API-surface coverage audit — the auditable op registry (SURVEY.md L4).
+
+Compares paddle_tpu's public API against the reference's checked-in public
+surface (tools/ref_surface.json, extracted from the reference's __all__
+lists; see ref:python/paddle/__init__.py, fft.py, signal.py, ...).
+
+Usage:  JAX_PLATFORMS=cpu python tools/op_coverage.py [--missing]
+
+Prints per-module implemented/total and the grand total; --missing lists
+the names still absent (the work queue for op-surface parity).
+"""
+from __future__ import annotations
+
+import importlib
+import json
+import os
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(HERE))
+
+MODULE_MAP = {
+    "paddle": "paddle_tpu",
+    "paddle.fft": "paddle_tpu.fft",
+    "paddle.signal": "paddle_tpu.signal",
+    "paddle.linalg": "paddle_tpu.linalg",
+    "paddle.nn": "paddle_tpu.nn",
+    "paddle.nn.functional": "paddle_tpu.nn.functional",
+    "paddle.sparse": "paddle_tpu.sparse",
+    "paddle.distribution": "paddle_tpu.distribution",
+    "paddle.optimizer": "paddle_tpu.optimizer",
+    "paddle.optimizer.lr": "paddle_tpu.optimizer.lr",
+    "paddle.metric": "paddle_tpu.metric",
+    "paddle.vision.transforms": "paddle_tpu.vision.transforms",
+    "paddle.vision.models": "paddle_tpu.vision.models",
+    "paddle.distributed": "paddle_tpu.distributed",
+    "paddle.io": "paddle_tpu.io",
+    "paddle.amp": "paddle_tpu.amp",
+    "paddle.autograd": "paddle_tpu.autograd",
+    "paddle.jit": "paddle_tpu.jit",
+    "paddle.static": "paddle_tpu.static",
+    "paddle.incubate": "paddle_tpu.incubate",
+}
+
+
+def audit(show_missing: bool = False):
+    surface = json.load(open(os.path.join(HERE, "ref_surface.json")))
+    grand_impl, grand_total = 0, 0
+    all_missing = {}
+    for ref_mod, names in sorted(surface.items()):
+        our_mod = MODULE_MAP.get(ref_mod)
+        have = set()
+        if our_mod:
+            try:
+                m = importlib.import_module(our_mod)
+                have = {n for n in names if hasattr(m, n)}
+            except ImportError:
+                pass
+        missing = sorted(set(names) - have)
+        grand_impl += len(have)
+        grand_total += len(names)
+        print(f"{ref_mod:28s} {len(have):4d}/{len(names):4d}")
+        if missing:
+            all_missing[ref_mod] = missing
+    pct = 100.0 * grand_impl / max(1, grand_total)
+    print(f"{'TOTAL':28s} {grand_impl:4d}/{grand_total:4d}  ({pct:.1f}%)")
+    if show_missing:
+        for mod, names in all_missing.items():
+            print(f"\n[{mod}] missing {len(names)}:")
+            for n in names:
+                print(f"  {n}")
+    return grand_impl, grand_total
+
+
+if __name__ == "__main__":
+    audit(show_missing="--missing" in sys.argv)
