@@ -10,7 +10,7 @@ from __future__ import annotations
 
 __version__ = "0.1.0"
 
-from .core import autograd  # noqa: F401
+from . import autograd  # noqa: F401
 from .core.autograd import enable_grad, grad, is_grad_enabled, no_grad, set_grad_enabled  # noqa: F401
 from .core.device import (  # noqa: F401
     CPUPlace,

@@ -59,6 +59,72 @@ def _check_nan_inf(name, outs):
             print("WARNING:", msg)
 
 
+def run_inplace(op, x: Tensor, *args, **kw):
+    """Run ``op(x, ...)`` and graft the result back into ``x`` in-place,
+    keeping the autograd tape correct.
+
+    Mirrors the reference's dygraph inplace rules (leaf-requiring-grad is
+    rejected, ref:paddle/fluid/eager/utils.cc CheckInplace): the op is run on
+    an *alias* carrying x's producer node so the recorded TapeNode links to
+    x's history (the old producer's output ref is rebound to the alias), then
+    the new node's output ref is rebound to ``x`` so future backward passes
+    deliver cotangents arriving at x.
+    """
+    import weakref
+
+    from .autograd import is_grad_enabled
+
+    if (
+        isinstance(x, Tensor)
+        and not x.stop_gradient
+        and x._node is None
+        and is_grad_enabled()
+    ):
+        raise RuntimeError(
+            "Leaf Tensor that requires grad cannot be used in an in-place operation"
+        )
+    alias = Tensor(x._data, stop_gradient=x.stop_gradient)
+    alias._node = x._node
+    if alias._node is not None:
+        # the alias now plays x's old role: the old producer must deliver
+        # its cotangent to the alias, not to the (about to change) x
+        for i, r in enumerate(alias._node.out_refs):
+            if r is not None and r() is x:
+                alias._node.out_refs[i] = weakref.ref(alias)
+    out = op(alias, *args, **kw)
+    x._data = out._data
+    x.stop_gradient = out.stop_gradient
+    x._version += 1  # stale pre-inplace consumers now fail backward loudly
+    node = out._node
+    x._node = node
+    if node is not None:
+        for i, r in enumerate(node.out_refs):
+            if r is not None and r() is out:
+                node.out_refs[i] = weakref.ref(x)
+    return x
+
+
+def replace_value(x: Tensor, out: Tensor):
+    """Overwrite ``x`` with ``out``'s value + tape link (full replacement:
+    x's own history is intentionally dropped, e.g. paddle.assign(y, out=x))."""
+    import weakref
+
+    if x._node is not None:
+        # x no longer carries its old producer's output; drop that link
+        for i, r in enumerate(x._node.out_refs):
+            if r is not None and r() is x:
+                x._node.out_refs[i] = None
+    x._data = out._data
+    x.stop_gradient = out.stop_gradient
+    x._version += 1
+    x._node = out._node
+    if out._node is not None:
+        for i, r in enumerate(out._node.out_refs):
+            if r is not None and r() is out:
+                out._node.out_refs[i] = weakref.ref(x)
+    return x
+
+
 def apply(fn, tensor_args: Tuple, static: Dict[str, Any], *, differentiable: bool = True, name: str = None):
     """Run pure function ``fn(*arrays, **static)`` over Tensor/array args."""
     name = name or fn.__name__.lstrip("_")

@@ -33,6 +33,7 @@ class Tensor:
         "name",
         "persistable",
         "_retain_grad",
+        "_version",
         "__weakref__",
     )
 
@@ -45,6 +46,10 @@ class Tensor:
         self.name = name
         self.persistable = False
         self._retain_grad = False
+        # bumped by in-place mutation; tape nodes snapshot it so backward can
+        # reject stale reads (the reference's inplace version check,
+        # ref:paddle/fluid/eager/tensor_wrapper.h inplace_version)
+        self._version = 0
 
     # -- basic properties --------------------------------------------------
     @property

@@ -261,9 +261,9 @@ def index_add(x, index, axis, value, name=None):
 
 
 def index_add_(x, index, axis, value, name=None):
-    out = index_add(x, index, axis, value)
-    x._data = out._data
-    return x
+    from ..core.dispatch import run_inplace
+
+    return run_inplace(index_add, x, index, axis, value)
 
 
 def crop(x, shape=None, offsets=None, name=None):
@@ -382,35 +382,31 @@ def renorm(x, p, axis, max_norm, name=None):
 
 
 def scatter_(x, index, updates, overwrite=True, name=None):
+    from ..core.dispatch import run_inplace
     from .manipulation import scatter
 
-    out = scatter(x, index, updates, overwrite=overwrite)
-    x._data = out._data
-    return x
+    return run_inplace(scatter, x, index, updates, overwrite=overwrite)
 
 
 def squeeze_(x, axis=None, name=None):
+    from ..core.dispatch import run_inplace
     from .manipulation import squeeze
 
-    out = squeeze(x, axis)
-    x._data = out._data
-    return x
+    return run_inplace(squeeze, x, axis)
 
 
 def unsqueeze_(x, axis, name=None):
+    from ..core.dispatch import run_inplace
     from .manipulation import unsqueeze
 
-    out = unsqueeze(x, axis)
-    x._data = out._data
-    return x
+    return run_inplace(unsqueeze, x, axis)
 
 
 def tanh_(x, name=None):
+    from ..core.dispatch import run_inplace
     from .math import tanh
 
-    out = tanh(x)
-    x._data = out._data
-    return x
+    return run_inplace(tanh, x)
 
 
 # --------------------------------------------------------- meta/attrs

@@ -353,8 +353,9 @@ def assign(x, output=None, name=None):
 
     out = apply(_assign, (x,) if isinstance(x, Tensor) else (Tensor(jnp.asarray(x)),), {})
     if output is not None:
-        output._data = out._data
-        return output
+        from ..core.dispatch import replace_value
+
+        return replace_value(output, out)
     return out
 
 

@@ -35,6 +35,8 @@ def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
 
 def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
     x._data = uniform(x.shape, x.dtype, min, max)._data
+    x._node = None  # random fill: previous producer is no longer relevant
+    x._version += 1  # pre-fill consumers must not backward through this
     return x
 
 

@@ -1,0 +1,350 @@
+"""PyLayer (user-defined vjp) + higher-order autograd.
+
+Mirrors the reference's PyLayer contract
+(ref:python/paddle/autograd/py_layer.py:29,234) and double-grad tests
+(ref:test/autograd in the reference tree).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.autograd import PyLayer
+
+
+class CusTanh(PyLayer):
+    @staticmethod
+    def forward(ctx, x):
+        y = paddle.tanh(x)
+        ctx.save_for_backward(y)
+        return y
+
+    @staticmethod
+    def backward(ctx, dy):
+        (y,) = ctx.saved_tensor()
+        return dy * (1.0 - paddle.square(y))
+
+
+def test_pylayer_matches_builtin():
+    a = np.linspace(-2, 2, 7).astype(np.float32)
+    x1 = paddle.to_tensor(a, stop_gradient=False)
+    y1 = CusTanh.apply(x1)
+    y1.sum().backward()
+
+    x2 = paddle.to_tensor(a, stop_gradient=False)
+    paddle.tanh(x2).sum().backward()
+
+    np.testing.assert_allclose(y1.numpy(), np.tanh(a), rtol=1e-6)
+    np.testing.assert_allclose(x1.grad.numpy(), x2.grad.numpy(), rtol=1e-5)
+
+
+class ScaleAdd(PyLayer):
+    """Two tensor inputs, non-tensor attr, two outputs."""
+
+    @staticmethod
+    def forward(ctx, x, y, alpha=2.0):
+        ctx.alpha = alpha
+        return x * alpha + y, x - y
+
+    @staticmethod
+    def backward(ctx, d0, d1):
+        return d0 * ctx.alpha + d1, d0 - d1
+
+
+def test_pylayer_multi_io():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = paddle.to_tensor([3.0, 4.0], stop_gradient=False)
+    o0, o1 = ScaleAdd.apply(x, y, alpha=3.0)
+    (o0.sum() + 2 * o1.sum()).backward()
+    # d/dx = alpha*1 + 2*1 = 5 ; d/dy = 1 - 2 = -1
+    np.testing.assert_allclose(x.grad.numpy(), [5.0, 5.0])
+    np.testing.assert_allclose(y.grad.numpy(), [-1.0, -1.0])
+
+
+def test_pylayer_unused_output_gets_zeros():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = paddle.to_tensor([3.0, 4.0], stop_gradient=False)
+    o0, o1 = ScaleAdd.apply(x, y)  # alpha=2; o1 unused
+    o0.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 2.0])
+    np.testing.assert_allclose(y.grad.numpy(), [1.0, 1.0])
+
+
+class NoneGrad(PyLayer):
+    @staticmethod
+    def forward(ctx, x, y):
+        return x * 2.0 + y.detach()
+
+    @staticmethod
+    def backward(ctx, dy):
+        return dy * 2.0, None  # no grad for y
+
+
+def test_pylayer_none_grad():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = paddle.to_tensor([1.0], stop_gradient=False)
+    NoneGrad.apply(x, y).backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+    assert y.grad is None
+
+
+def test_pylayer_materialize_grads_off():
+    seen = {}
+
+    class TwoOut(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.set_materialize_grads(False)
+            return x * 1.0, x * 2.0
+
+        @staticmethod
+        def backward(ctx, d0, d1):
+            seen["d1"] = d1
+            return d0
+
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    o0, o1 = TwoOut.apply(x)
+    o0.sum().backward()
+    assert seen["d1"] is None
+    np.testing.assert_allclose(x.grad.numpy(), [1.0])
+
+
+def test_pylayer_wrong_grad_count_raises():
+    class Bad(PyLayer):
+        @staticmethod
+        def forward(ctx, x, y):
+            return x + y
+
+        @staticmethod
+        def backward(ctx, dy):
+            return dy  # should be two
+
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = paddle.to_tensor([1.0], stop_gradient=False)
+    out = Bad.apply(x, y)
+    with pytest.raises(RuntimeError, match="gradients"):
+        out.backward()
+
+
+def test_pylayer_no_grad_passthrough():
+    x = paddle.to_tensor([1.0])  # stop_gradient=True
+    y = CusTanh.apply(x)
+    assert y.stop_gradient
+
+
+# ---------------------------------------------------------------- double grad
+
+
+def test_double_grad_square():
+    # y = x^3: dy/dx = 3x^2, d2y/dx2 = 6x
+    x = paddle.to_tensor([2.0, -1.0], stop_gradient=False)
+    y = (x * x * x).sum()
+    (g,) = paddle.grad(y, x, create_graph=True)
+    np.testing.assert_allclose(g.numpy(), [12.0, 3.0], rtol=1e-5)
+    (g2,) = paddle.grad(g.sum(), x)
+    np.testing.assert_allclose(g2.numpy(), [12.0, -6.0], rtol=1e-5)
+
+
+def test_double_grad_mixed_vars():
+    # z = x^2 * y: dz/dx = 2xy, d(dz/dx)/dy = 2x
+    x = paddle.to_tensor([3.0], stop_gradient=False)
+    y = paddle.to_tensor([5.0], stop_gradient=False)
+    z = (x * x * y).sum()
+    (gx,) = paddle.grad(z, x, create_graph=True)
+    np.testing.assert_allclose(gx.numpy(), [30.0], rtol=1e-5)
+    (gxy,) = paddle.grad(gx.sum(), y)
+    np.testing.assert_allclose(gxy.numpy(), [6.0], rtol=1e-5)
+
+
+def test_double_grad_matches_finite_difference():
+    rng = np.random.RandomState(0)
+    a = rng.rand(5).astype(np.float32) + 0.5
+
+    def f(arr):
+        t = paddle.to_tensor(arr, stop_gradient=False)
+        return t, (paddle.exp(t) * paddle.sin(t)).sum()
+
+    x, y = f(a)
+    (g,) = paddle.grad(y, x, create_graph=True)
+    (g2,) = paddle.grad(g.sum(), x)
+
+    eps = 1e-3
+    fd = np.zeros_like(a)
+    for i in range(len(a)):
+        ap, am = a.copy(), a.copy()
+        ap[i] += eps
+        am[i] -= eps
+        _, yp = f(ap)
+        _, ym = f(am)
+        xp = paddle.to_tensor(ap, stop_gradient=False)
+        xm = paddle.to_tensor(am, stop_gradient=False)
+        (gp,) = paddle.grad((paddle.exp(xp) * paddle.sin(xp)).sum(), xp)
+        (gm,) = paddle.grad((paddle.exp(xm) * paddle.sin(xm)).sum(), xm)
+        fd[i] = (gp.numpy()[i] - gm.numpy()[i]) / (2 * eps)
+    np.testing.assert_allclose(g2.numpy(), fd, rtol=1e-2, atol=1e-2)
+
+
+def test_backward_with_create_graph_then_grad():
+    # second-order via backward(): grad of (dy/dx) w.r.t x using .grad chain
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = (x ** 4).sum()
+    (g,) = paddle.grad(y, x, create_graph=True)  # 4x^3 = 32
+    z = (g * g).sum()  # z = 16 x^6, dz/dx = 96 x^5 = 3072
+    (gz,) = paddle.grad(z, x)
+    np.testing.assert_allclose(gz.numpy(), [3072.0], rtol=1e-4)
+
+
+def test_triple_grad():
+    # y = x^4: y''' = 24x
+    x = paddle.to_tensor([1.5], stop_gradient=False)
+    y = (x ** 4).sum()
+    (g1,) = paddle.grad(y, x, create_graph=True)
+    (g2,) = paddle.grad(g1.sum(), x, create_graph=True)
+    (g3,) = paddle.grad(g2.sum(), x)
+    np.testing.assert_allclose(g3.numpy(), [36.0], rtol=1e-4)
+
+
+def test_double_grad_through_matmul():
+    rng = np.random.RandomState(1)
+    a = rng.rand(3, 3).astype(np.float32)
+    x = paddle.to_tensor(a, stop_gradient=False)
+    y = paddle.matmul(x, x).sum()
+    (g,) = paddle.grad(y, x, create_graph=True)
+    # g_ab = rowsum(x)_b + colsum(x)_a, so sum(g) = 2*n*sum(x) and
+    # d sum(g)/dx = 2*n = 6 for n=3
+    (g2,) = paddle.grad(g.sum(), x)
+    np.testing.assert_allclose(g2.numpy(), np.full((3, 3), 6.0), rtol=1e-5)
+
+
+def test_pylayer_double_grad():
+    class Square(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * x
+
+        @staticmethod
+        def backward(ctx, dy):
+            (x,) = ctx.saved_tensor()
+            xt = paddle.to_tensor(x.numpy(), stop_gradient=True)
+            return dy * 2.0 * xt
+
+    x = paddle.to_tensor([3.0], stop_gradient=False)
+    y = Square.apply(x).sum()
+    (g,) = paddle.grad(y, x, create_graph=True)
+    np.testing.assert_allclose(g.numpy(), [6.0], rtol=1e-5)
+    # d(g)/d(x) through the PyLayer's backward: dy is what carries the graph;
+    # grad-of-grad w.r.t. dy-chain works, x-dependence inside backward is
+    # through a constant here (documented limitation, as in the reference).
+
+
+def test_no_grad_vars():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = paddle.to_tensor([3.0], stop_gradient=False)
+    z = (x * y).sum()
+    (gx,) = paddle.grad(z, [x], no_grad_vars=[y])
+    np.testing.assert_allclose(gx.numpy(), [3.0])
+
+
+# ------------------------------------------------- inplace version checking
+
+
+def test_stale_inplace_consumer_raises():
+    # a consumed y BEFORE tanh_; backward through the stale read must raise
+    # (the reference's inplace-version error), not silently misroute grads
+    w = paddle.to_tensor([0.5], stop_gradient=False)
+    y = w * 1.0
+    a = y + 0.0
+    y.tanh_()
+    with pytest.raises(RuntimeError, match="in-place"):
+        a.sum().backward()
+
+
+def test_stale_uniform_fill_consumer_raises():
+    w = paddle.to_tensor([1.0], stop_gradient=False)
+    y = w * 3.0
+    b = y + 0.0
+    paddle.uniform_(y)
+    with pytest.raises(RuntimeError, match="in-place"):
+        b.sum().backward()
+
+
+def test_stale_assign_consumer_raises():
+    w = paddle.to_tensor([1.0], stop_gradient=False)
+    o = w * 2.0
+    c = o * 5.0
+    paddle.assign(paddle.to_tensor([9.0]), o)
+    with pytest.raises(RuntimeError, match="in-place"):
+        c.sum().backward()
+
+
+def test_inplace_then_use_is_fine():
+    # consumers AFTER the in-place op see the new version: no error
+    w = paddle.to_tensor([0.5], stop_gradient=False)
+    y = w * 1.0
+    y.tanh_()
+    z = y + 0.0
+    z.sum().backward()
+    np.testing.assert_allclose(
+        w.grad.numpy(), 1.0 - np.tanh([0.5]) ** 2, rtol=1e-5)
+
+
+# ---------------------------------------------------- PyLayer under tracing
+
+
+class StraightThrough(PyLayer):
+    """sign() forward, identity backward — grad differs from the true vjp
+    (which is 0 a.e.), so this detects whether the custom backward is used."""
+
+    @staticmethod
+    def forward(ctx, x):
+        return paddle.sign(x)
+
+    @staticmethod
+    def backward(ctx, dy):
+        return dy
+
+
+def test_pylayer_traced_uses_custom_backward():
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.core.tensor import Tensor
+
+    x = paddle.to_tensor([0.3, -0.7], stop_gradient=False)
+    # eager: d/dx = 1 (straight-through) * 2
+    StraightThrough.apply(x * 2.0).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 2.0])
+
+    # traced/compiled: the same custom grad must survive jax autodiff
+    # (without the custom_vjp lowering this would be 0 a.e. — sign's true vjp)
+    def f_arr(xa):
+        t = Tensor(xa, stop_gradient=False)
+        return StraightThrough.apply(t * 2.0).sum()._data
+
+    g = jax.jit(jax.grad(f_arr))(jnp.asarray([0.3, -0.7], jnp.float32))
+    np.testing.assert_allclose(np.asarray(g), [2.0, 2.0])
+
+
+def test_pylayer_traced_saved_tensors():
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.core.tensor import Tensor
+
+    class SquareSaved(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * x
+
+        @staticmethod
+        def backward(ctx, dy):
+            (x,) = ctx.saved_tensor()
+            return dy * 2.0 * x
+
+    def f_arr(xa):
+        t = Tensor(xa, stop_gradient=False)
+        return SquareSaved.apply(t).sum()._data
+
+    g = jax.jit(jax.grad(f_arr))(jnp.asarray([3.0, -2.0], jnp.float32))
+    np.testing.assert_allclose(np.asarray(g), [6.0, -4.0], rtol=1e-5)
