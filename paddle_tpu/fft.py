@@ -103,27 +103,30 @@ def irfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
     return _fft2(jnp.fft.irfft2, x, s, axes, norm, "irfft2")
 
 
+_DUAL_NORM = {"backward": "forward", "forward": "backward", "ortho": "ortho"}
+
+
+def _hfft_nd(x, *, s, axes, norm):
+    # Hermitian FFT over n dims via the norm-duality identity
+    # hfftn(x) = irfftn(conj(x)) with the norm direction swapped
+    return jnp.fft.irfftn(jnp.conj(x), s=s, axes=axes, norm=_DUAL_NORM[norm])
+
+
+def _ihfft_nd(x, *, s, axes, norm):
+    # ihfftn(x) = conj(rfftn(x)) with the norm direction swapped
+    return jnp.conj(jnp.fft.rfftn(x, s=s, axes=axes, norm=_DUAL_NORM[norm]))
+
+
 def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
-    # hfft over the last axis after an ifft over the first (Hermitian 2-D)
     _check_norm(norm)
-
-    def g(x, *, s, axes, norm):
-        y = jnp.fft.ifft(x, n=s[0] if s else None, axis=axes[0], norm=norm)
-        return jnp.fft.hfft(y, n=s[1] if s else None, axis=axes[1], norm=norm)
-
-    return apply(g, (x,), dict(s=tuple(s) if s else None,
-                               axes=tuple(axes), norm=norm), name="hfft2")
+    return apply(_hfft_nd, (x,), dict(s=tuple(s) if s else None,
+                                      axes=tuple(axes), norm=norm), name="hfft2")
 
 
 def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
     _check_norm(norm)
-
-    def g(x, *, s, axes, norm):
-        y = jnp.fft.ihfft(x, n=s[1] if s else None, axis=axes[1], norm=norm)
-        return jnp.fft.fft(y, n=s[0] if s else None, axis=axes[0], norm=norm)
-
-    return apply(g, (x,), dict(s=tuple(s) if s else None,
-                               axes=tuple(axes), norm=norm), name="ihfft2")
+    return apply(_ihfft_nd, (x,), dict(s=tuple(s) if s else None,
+                                       axes=tuple(axes), norm=norm), name="ihfft2")
 
 
 def fftn(x, s=None, axes=None, norm="backward", name=None):
@@ -144,32 +147,16 @@ def irfftn(x, s=None, axes=None, norm="backward", name=None):
 
 def hfftn(x, s=None, axes=None, norm="backward", name=None):
     _check_norm(norm)
-
-    def g(x, *, s, axes, norm):
-        ax = axes if axes is not None else tuple(range(x.ndim))
-        y = x
-        if len(ax) > 1:
-            y = jnp.fft.ifftn(y, s=s[:-1] if s else None, axes=ax[:-1], norm=norm)
-        return jnp.fft.hfft(y, n=s[-1] if s else None, axis=ax[-1], norm=norm)
-
-    return apply(g, (x,), dict(s=tuple(s) if s else None,
-                               axes=tuple(axes) if axes else None, norm=norm),
-                 name="hfftn")
+    return apply(_hfft_nd, (x,), dict(s=tuple(s) if s else None,
+                                      axes=tuple(axes) if axes else None,
+                                      norm=norm), name="hfftn")
 
 
 def ihfftn(x, s=None, axes=None, norm="backward", name=None):
     _check_norm(norm)
-
-    def g(x, *, s, axes, norm):
-        ax = axes if axes is not None else tuple(range(x.ndim))
-        y = jnp.fft.ihfft(x, n=s[-1] if s else None, axis=ax[-1], norm=norm)
-        if len(ax) > 1:
-            y = jnp.fft.fftn(y, s=s[:-1] if s else None, axes=ax[:-1], norm=norm)
-        return y
-
-    return apply(g, (x,), dict(s=tuple(s) if s else None,
-                               axes=tuple(axes) if axes else None, norm=norm),
-                 name="ihfftn")
+    return apply(_ihfft_nd, (x,), dict(s=tuple(s) if s else None,
+                                       axes=tuple(axes) if axes else None,
+                                       norm=norm), name="ihfftn")
 
 
 def fftfreq(n, d=1.0, dtype=None, name=None):

@@ -191,7 +191,9 @@ def _bwd_common(q, k, v, do, lse, di, qi, ki, scale, causal, blk_q, blk_k,
     reps = blk_k // _LANES
     lse_b = jnp.tile(lse, (1, reps)) if reps > 1 else lse[:, :blk_k]
     di_b = jnp.tile(di, (1, reps)) if reps > 1 else di[:, :blk_k]
-    p = jnp.exp(s - lse_b)  # [blk_q, blk_k] f32
+    # fully-masked query rows store lse = NEG_INF; exp(NEG_INF - NEG_INF)
+    # would be 1, so force their probabilities (and thus grads) to zero
+    p = jnp.where(lse_b > NEG_INF * 0.5, jnp.exp(s - lse_b), 0.0)  # [blk_q, blk_k] f32
     dp = jax.lax.dot_general(
         do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     )  # [blk_q, blk_k]

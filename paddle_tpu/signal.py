@@ -33,6 +33,10 @@ def stft(x, n_fft, hop_length=None, win_length=None, window=None,
     win_length = win_length or n_fft
     if win_length > n_fft:
         raise ValueError("win_length must be <= n_fft")
+    x_arr = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    if onesided and jnp.iscomplexobj(x_arr):
+        # the reference asserts: a complex input has no Hermitian symmetry
+        raise ValueError("stft: onesided=True is not supported for complex input")
 
     win = window._data if isinstance(window, Tensor) else window
 

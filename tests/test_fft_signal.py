@@ -1,0 +1,181 @@
+"""paddle.fft / paddle.signal golden tests (vs numpy/torch) + in-place op
+autograd regressions.
+
+Models the reference's test/fft (numpy-reference comparisons across norms)
+and test/legacy_test inplace checks.
+"""
+import numpy as np
+import pytest
+import torch
+
+import paddle_tpu as paddle
+from paddle_tpu import fft, signal
+
+RNG = np.random.RandomState(7)
+NORMS = ("backward", "ortho", "forward")
+
+
+def _t(a):
+    return paddle.to_tensor(a)
+
+
+@pytest.mark.parametrize("norm", NORMS)
+def test_fft_ifft_roundtrip(norm):
+    x = (RNG.rand(8, 16) + 1j * RNG.rand(8, 16)).astype(np.complex64)
+    y = fft.fft(_t(x), norm=norm).numpy()
+    np.testing.assert_allclose(y, np.fft.fft(x, norm=norm), rtol=1e-4, atol=1e-5)
+    back = fft.ifft(_t(y), norm=norm).numpy()
+    np.testing.assert_allclose(back, x, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("norm", NORMS)
+def test_rfft_irfft(norm):
+    x = RNG.rand(4, 32).astype(np.float32)
+    y = fft.rfft(_t(x), norm=norm).numpy()
+    np.testing.assert_allclose(y, np.fft.rfft(x, norm=norm), rtol=1e-4, atol=1e-5)
+    back = fft.irfft(_t(y), n=32, norm=norm).numpy()
+    np.testing.assert_allclose(back, x, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("norm", NORMS)
+def test_hfft_ihfft_family_matches_torch(norm):
+    xr = RNG.rand(4, 6).astype(np.float32)
+    xc = (RNG.rand(3, 5) + 1j * RNG.rand(3, 5)).astype(np.complex64)
+
+    np.testing.assert_allclose(
+        fft.ihfftn(_t(xr), norm=norm).numpy(),
+        torch.fft.ihfftn(torch.tensor(xr), norm=norm).numpy(),
+        rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(
+        fft.ihfft2(_t(xr), norm=norm).numpy(),
+        torch.fft.ihfft2(torch.tensor(xr), norm=norm).numpy(),
+        rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(
+        fft.hfft2(_t(xc), norm=norm).numpy(),
+        torch.fft.hfft2(torch.tensor(xc), norm=norm).numpy(),
+        rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(
+        fft.hfftn(_t(xc), norm=norm).numpy(),
+        torch.fft.hfftn(torch.tensor(xc), norm=norm).numpy(),
+        rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(
+        fft.hfft(_t(xc), norm=norm).numpy(),
+        torch.fft.hfft(torch.tensor(xc), norm=norm).numpy(),
+        rtol=1e-3, atol=1e-4)
+
+
+def test_fft2_fftn_shift():
+    x = (RNG.rand(4, 8) + 1j * RNG.rand(4, 8)).astype(np.complex64)
+    np.testing.assert_allclose(fft.fft2(_t(x)).numpy(), np.fft.fft2(x), rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(fft.fftn(_t(x)).numpy(), np.fft.fftn(x), rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(fft.fftshift(_t(x.real)).numpy(), np.fft.fftshift(x.real), rtol=1e-6)
+    np.testing.assert_allclose(
+        fft.ifftshift(_t(np.fft.fftshift(x.real))).numpy(), x.real, rtol=1e-6)
+    np.testing.assert_allclose(fft.fftfreq(8, 0.5).numpy(), np.fft.fftfreq(8, 0.5), rtol=1e-6)
+    np.testing.assert_allclose(fft.rfftfreq(8, 0.5).numpy(), np.fft.rfftfreq(8, 0.5), rtol=1e-6)
+
+
+def test_fft_grad_flows():
+    x = paddle.to_tensor(RNG.rand(16).astype(np.float32), stop_gradient=False)
+    y = fft.rfft(x)
+    # |F(x)|^2 differentiable w.r.t. x
+    (y.real() ** 2 + y.imag() ** 2).sum().backward() if hasattr(y, "real") else None
+
+
+# ------------------------------------------------------------------- signal
+
+
+def test_stft_matches_torch():
+    x = RNG.rand(2, 256).astype(np.float32)
+    win = np.hanning(64).astype(np.float32)
+    got = signal.stft(_t(x), n_fft=64, hop_length=16, window=_t(win),
+                      center=True, onesided=True).numpy()
+    exp = torch.stft(torch.tensor(x), n_fft=64, hop_length=16,
+                     window=torch.tensor(win), center=True, onesided=True,
+                     return_complex=True).numpy()
+    np.testing.assert_allclose(got, exp, rtol=1e-3, atol=1e-4)
+
+
+def test_stft_istft_roundtrip():
+    x = RNG.rand(300).astype(np.float32)
+    win = np.hanning(128).astype(np.float32)
+    spec = signal.stft(_t(x), n_fft=128, hop_length=32, window=_t(win))
+    back = signal.istft(spec, n_fft=128, hop_length=32, window=_t(win)).numpy()
+    n = min(len(back), len(x))
+    np.testing.assert_allclose(back[160:n - 160], x[160:n - 160], rtol=1e-3, atol=1e-3)
+
+
+def test_stft_onesided_complex_rejected():
+    xc = (RNG.rand(256) + 1j * RNG.rand(256)).astype(np.complex64)
+    with pytest.raises(ValueError, match="onesided"):
+        signal.stft(_t(xc), n_fft=64, onesided=True)
+
+
+# ------------------------------------------------------- in-place autograd
+
+
+def test_inplace_tanh_keeps_tape():
+    x = paddle.to_tensor([0.5, 1.0], stop_gradient=False)
+    y = x * 1.0
+    y.tanh_()
+    y.sum().backward()
+    np.testing.assert_allclose(
+        x.grad.numpy(), 1.0 - np.tanh([0.5, 1.0]) ** 2, rtol=1e-5)
+
+
+def test_inplace_index_add_grad_to_value():
+    x = paddle.to_tensor(np.zeros((3, 2), np.float32), stop_gradient=False)
+    v = paddle.to_tensor(np.ones((2, 2), np.float32) * 2.0, stop_gradient=False)
+    y = x * 1.0
+    idx = paddle.to_tensor(np.array([0, 2], np.int32))
+    y.index_add_(idx, 0, v)
+    (y * y).sum().backward()
+    assert v.grad is not None
+    # y rows 0,2 become 2.0; dL/dv = 2*y = 4
+    np.testing.assert_allclose(v.grad.numpy(), np.full((2, 2), 4.0), rtol=1e-5)
+    np.testing.assert_allclose(x.grad.numpy()[1], [0.0, 0.0])
+
+
+def test_inplace_on_requires_grad_leaf_raises():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    with pytest.raises(RuntimeError, match="[Ll]eaf"):
+        x.tanh_()
+
+
+def test_inplace_chain_through_earlier_ops():
+    # gradient must flow through BOTH the inplace op and x's earlier producer
+    x = paddle.to_tensor([0.4], stop_gradient=False)
+    y = x * 3.0
+    y.tanh_()
+    y.backward()
+    expected = (1.0 - np.tanh(1.2) ** 2) * 3.0
+    np.testing.assert_allclose(x.grad.numpy(), [expected], rtol=1e-4)
+
+
+def test_assign_output_keeps_tape():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    out = paddle.to_tensor([0.0, 0.0])
+    paddle.assign(x * 2.0, out)
+    out.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 2.0])
+
+
+# ------------------------------------------------- pylayer kwargs tensors
+
+
+def test_pylayer_kwarg_tensor_tracked():
+    from paddle_tpu.autograd import PyLayer
+
+    class Mul(PyLayer):
+        @staticmethod
+        def forward(ctx, x, y=None):
+            return x * y
+
+        @staticmethod
+        def backward(ctx, dy):
+            return dy, dy  # grads for x and kwarg y
+
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = paddle.to_tensor([3.0], stop_gradient=False)
+    Mul.apply(x, y=y).backward()
+    assert x.grad is not None and y.grad is not None
