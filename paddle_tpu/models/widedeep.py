@@ -47,11 +47,17 @@ class WideDeep(nn.Layer):
 
     def __init__(self, num_fields: int = 26, num_dense: int = 13,
                  num_buckets: int = 1000001, embedding_dim: int = 16,
-                 hidden_sizes: Sequence[int] = (400, 400, 400)):
+                 hidden_sizes: Sequence[int] = (400, 400, 400),
+                 sparse_embedding=None, wide_embedding=None):
+        """``sparse_embedding``/``wide_embedding`` may inject e.g. a
+        ``distributed.ps.PSEmbedding`` (host-RAM table service) in place of
+        the default mesh-sharded HBM table — the PS-mode Wide&Deep of the
+        reference (ref:python/paddle/distributed/ps/the_one_ps.py)."""
         super().__init__()
         self.num_fields = num_fields
-        self.embedding = DistributedEmbedding(num_buckets, embedding_dim)
-        self.wide = DistributedEmbedding(num_buckets, 1)
+        self.embedding = sparse_embedding or DistributedEmbedding(
+            num_buckets, embedding_dim)
+        self.wide = wide_embedding or DistributedEmbedding(num_buckets, 1)
         self.dense_wide = nn.Linear(num_dense, 1)
         dims = [num_fields * embedding_dim + num_dense] + list(hidden_sizes)
         mlp = []

@@ -10,6 +10,9 @@ Components (SURVEY.md §7 'C++ where Paddle is C++'):
                (≈ ref:paddle/phi/core/distributed/store/tcp_store.h:120)
   trace.cc   — host RecordEvent ring buffers + chrome-trace export
                (≈ ref:paddle/fluid/platform/profiler/host_event_recorder.h)
+  embedding_service.cc — host-RAM sparse embedding table server/client
+               (≈ ref:paddle/fluid/distributed/ps/service/brpc_ps_server.cc,
+                ref:paddle/fluid/distributed/ps/table/memory_sparse_table.h)
 """
 from __future__ import annotations
 
@@ -21,7 +24,7 @@ import threading
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC_DIR = os.path.join(_HERE, "csrc")
-_SOURCES = ["kvstore.cc", "trace.cc"]
+_SOURCES = ["kvstore.cc", "trace.cc", "embedding_service.cc"]
 
 _lib = None
 _lib_lock = threading.Lock()
@@ -85,6 +88,33 @@ def _declare(lib):
     lib.pt_store_barrier.restype = c.c_int
     lib.pt_store_barrier.argtypes = [c.c_void_p, c.c_char_p]
     lib.pt_store_disconnect.argtypes = [c.c_void_p]
+
+    u64p = c.POINTER(c.c_uint64)
+    f32p = c.POINTER(c.c_float)
+    lib.pt_emb_server_start.restype = c.c_void_p
+    lib.pt_emb_server_start.argtypes = [c.c_int, c.c_int, c.c_int, c.c_float, c.c_longlong]
+    lib.pt_emb_server_port.restype = c.c_int
+    lib.pt_emb_server_port.argtypes = [c.c_void_p]
+    lib.pt_emb_server_stop.argtypes = [c.c_void_p]
+    lib.pt_emb_server_rows.restype = c.c_longlong
+    lib.pt_emb_server_rows.argtypes = [c.c_void_p]
+    lib.pt_emb_server_bytes.restype = c.c_longlong
+    lib.pt_emb_server_bytes.argtypes = [c.c_void_p]
+    lib.pt_emb_connect.restype = c.c_void_p
+    lib.pt_emb_connect.argtypes = [c.c_char_p, c.c_int, c.c_int]
+    lib.pt_emb_disconnect.argtypes = [c.c_void_p]
+    lib.pt_emb_pull.restype = c.c_int
+    lib.pt_emb_pull.argtypes = [c.c_void_p, u64p, c.c_uint, c.c_int, f32p]
+    lib.pt_emb_push.restype = c.c_int
+    lib.pt_emb_push.argtypes = [c.c_void_p, u64p, c.c_uint, c.c_int, f32p, c.c_float]
+    lib.pt_emb_save.restype = c.c_int
+    lib.pt_emb_save.argtypes = [c.c_void_p, c.c_char_p]
+    lib.pt_emb_load.restype = c.c_int
+    lib.pt_emb_load.argtypes = [c.c_void_p, c.c_char_p]
+    lib.pt_emb_clear.restype = c.c_int
+    lib.pt_emb_clear.argtypes = [c.c_void_p]
+    lib.pt_emb_stats.restype = c.c_int
+    lib.pt_emb_stats.argtypes = [c.c_void_p, u64p]
 
     lib.pt_trace_enable.argtypes = [c.c_int]
     lib.pt_trace_enabled.restype = c.c_int

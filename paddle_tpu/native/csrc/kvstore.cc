@@ -273,8 +273,12 @@ void pt_store_server_stop(void* handle) {
   ::close(srv->listen_fd);
   if (srv->accept_thread.joinable()) srv->accept_thread.join();
   {
+    // SHUT_RD (not RDWR): unblocks workers stuck in read, but lets a worker
+    // that was just released from a barrier/wait flush its in-flight reply —
+    // otherwise a peer whose reply raced the master's stop sees a transport
+    // error on a barrier that actually completed
     std::lock_guard<std::mutex> g(srv->fds_mu);
-    for (int fd : srv->client_fds) ::shutdown(fd, SHUT_RDWR);
+    for (int fd : srv->client_fds) ::shutdown(fd, SHUT_RD);
   }
   for (auto& t : srv->workers)
     if (t.joinable()) t.join();

@@ -1,0 +1,199 @@
+"""Sparse embedding parameter-service tests.
+
+Models the reference PS test pattern (server+client on one host,
+ref:paddle/fluid/distributed/ps/ + test/ps/): in-process C++ table servers,
+sharded client routing, server-side optimizer rules, save/load, and the
+PS-mode Wide&Deep end-to-end training path.
+"""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import ps
+
+
+@pytest.fixture
+def cluster():
+    svc = ps.start_local_cluster(dim=8, num_shards=3, rule="sgd")
+    yield svc
+    svc.stop()
+
+
+def test_pull_lazy_init_deterministic(cluster):
+    c = cluster.client()
+    ids = np.array([1, 2, 3, 1 << 40], np.uint64)
+    rows1 = c.pull(ids)
+    rows2 = c.pull(ids)
+    np.testing.assert_array_equal(rows1, rows2)  # init once, stable
+    assert rows1.shape == (4, 8)
+    assert np.abs(rows1).max() <= 0.01 + 1e-6
+    assert not np.allclose(rows1[0], rows1[1])  # per-id streams differ
+    rows, nbytes = c.stats()
+    assert rows == 4 and nbytes == 4 * 8 * 4
+    c.close()
+
+
+def test_push_sgd_rule(cluster):
+    c = cluster.client()
+    ids = np.array([7, 8], np.uint64)
+    before = c.pull(ids)
+    g = np.full((2, 8), 2.0, np.float32)
+    c.push(ids, g, lr=0.25)
+    after = c.pull(ids)
+    np.testing.assert_allclose(before - after, np.full((2, 8), 0.5), rtol=1e-6)
+    c.close()
+
+
+def test_adagrad_rule_matches_numpy():
+    svc = ps.start_local_cluster(dim=4, num_shards=1, rule="adagrad")
+    try:
+        c = svc.client()
+        ids = np.array([3], np.uint64)
+        w = c.pull(ids).copy()
+        acc = np.zeros((1, 4), np.float32)
+        for step in range(3):
+            g = np.full((1, 4), 0.5 * (step + 1), np.float32)
+            c.push(ids, g, lr=0.1)
+            acc += g * g
+            w -= 0.1 * g / (np.sqrt(acc) + 1e-8)
+        np.testing.assert_allclose(c.pull(ids), w, rtol=1e-5)
+        c.close()
+    finally:
+        svc.stop()
+
+
+def test_adam_rule_matches_numpy():
+    svc = ps.start_local_cluster(dim=4, num_shards=1, rule="adam")
+    try:
+        c = svc.client()
+        ids = np.array([11], np.uint64)
+        w = c.pull(ids).copy()
+        m = np.zeros((1, 4), np.float32)
+        v = np.zeros((1, 4), np.float32)
+        b1, b2, eps = 0.9, 0.999, 1e-8
+        for step in range(1, 4):
+            g = np.full((1, 4), 0.3, np.float32)
+            c.push(ids, g, lr=0.01)
+            m = b1 * m + (1 - b1) * g
+            v = b2 * v + (1 - b2) * g * g
+            w -= 0.01 * (m / (1 - b1 ** step)) / (np.sqrt(v / (1 - b2 ** step)) + eps)
+        np.testing.assert_allclose(c.pull(ids), w, rtol=1e-4)
+        c.close()
+    finally:
+        svc.stop()
+
+
+def test_save_load_roundtrip(cluster, tmp_path):
+    c = cluster.client()
+    ids = np.arange(100, dtype=np.uint64)
+    rows = c.pull(ids)
+    c.push(ids, np.ones((100, 8), np.float32), lr=0.1)
+    trained = c.pull(ids)
+    prefix = str(tmp_path / "table")
+    c.save(prefix)
+    c.clear()
+    assert c.stats()[0] == 0
+    c.load(prefix)
+    np.testing.assert_array_equal(c.pull(ids), trained)
+    assert not np.allclose(trained, rows)
+    c.close()
+
+
+def test_ps_embedding_layer_trains(cluster):
+    """PS-mode training loop: pull -> device step -> push; loss decreases."""
+    from paddle_tpu.distributed.ps import PSEmbedding
+
+    emb = PSEmbedding(cluster.client(), learning_rate=0.5)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 1 << 30, size=(32, 4)).astype(np.int64)
+    # target depends on the ids through a fixed random projection
+    labels = paddle.to_tensor(
+        (rng.rand(32, 1) > 0.5).astype(np.float32))
+
+    head = paddle.nn.Linear(4 * 8, 1)
+    opt = paddle.optimizer.SGD(learning_rate=0.3, parameters=head.parameters())
+    losses = []
+    for _ in range(40):
+        e = emb(paddle.to_tensor(ids))          # [32, 4, 8] pulled rows
+        flat = paddle.reshape(e, [32, -1])
+        logits = head(flat)
+        loss = paddle.nn.functional.binary_cross_entropy_with_logits(
+            logits, labels, reduction="mean")
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0] * 0.7, losses[::10]
+    rows, _ = emb.client.stats()
+    assert rows == len(np.unique(ids))  # lazy rows: only touched ids exist
+
+
+def test_widedeep_ps_mode(cluster):
+    """Wide&Deep with host-RAM PS tables: the VERDICT 'bigger than HBM' path
+    (capacity bounded by host RAM; no vocab declared at build time)."""
+    from paddle_tpu.distributed.ps import PSEmbedding
+    from paddle_tpu.models.widedeep import WideDeep
+
+    wide_svc = ps.start_local_cluster(dim=1, num_shards=2)
+    try:
+        model = WideDeep(
+            num_fields=6, num_dense=4, hidden_sizes=(32, 16),
+            sparse_embedding=PSEmbedding(cluster.client(), learning_rate=0.2),
+            wide_embedding=PSEmbedding(wide_svc.client(), learning_rate=0.2),
+            embedding_dim=8)
+        dense_params = [p for p in model.parameters()]
+        opt = paddle.optimizer.Adam(learning_rate=1e-2, parameters=dense_params)
+        rng = np.random.RandomState(1)
+        # feature hashes from the full 64-bit space (no bucket bound)
+        sparse = rng.randint(0, 1 << 62, size=(64, 6)).astype(np.int64)
+        dense = rng.rand(64, 4).astype(np.float32)
+        w = rng.rand(4)
+        labels = ((dense @ w) > w.sum() / 2).astype(np.float32)[:, None]
+
+        losses = []
+        for _ in range(30):
+            logits = model(paddle.to_tensor(sparse), paddle.to_tensor(dense))
+            loss = model.loss(logits, paddle.to_tensor(labels))
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0] * 0.8, losses[::10]
+    finally:
+        wide_svc.stop()
+
+
+_SERVER_SCRIPT = r"""
+import sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+from paddle_tpu.distributed import ps
+srv = ps.run_server(dim=8, port=0, rule="sgd")
+print(srv.port, flush=True)
+sys.stdin.readline()  # block until parent closes stdin
+srv.stop()
+"""
+
+
+def test_cross_process_server():
+    """Server in a separate OS process (the real deployment shape)."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH="/root/repo")
+    proc = subprocess.Popen([sys.executable, "-c", _SERVER_SCRIPT],
+                            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+                            env=env, text=True)
+    try:
+        port = int(proc.stdout.readline().strip())
+        client = ps.SparseTableClient([f"127.0.0.1:{port}"], dim=8)
+        ids = np.array([42, 43], np.uint64)
+        rows = client.pull(ids)
+        client.push(ids, np.ones((2, 8), np.float32), lr=1.0)
+        after = client.pull(ids)
+        np.testing.assert_allclose(rows - after, 1.0, rtol=1e-6)
+        client.close()
+    finally:
+        proc.stdin.close()
+        proc.wait(timeout=10)
