@@ -19,7 +19,15 @@ import jax
 import jax.numpy as jnp
 
 _state = threading.local()
-_global = {"key": jax.random.key(0), "seed": 0}
+# key is created lazily: building it at import time would initialize the JAX
+# backend (possibly a remote TPU plugin) before the app can pick a platform
+_global = {"key": None, "seed": 0}
+
+
+def _key():
+    if _global["key"] is None:
+        _global["key"] = jax.random.key(_global["seed"])
+    return _global["key"]
 
 
 def seed(s: int):
@@ -30,7 +38,7 @@ def seed(s: int):
 
 
 def get_rng_state():
-    return _global["key"]
+    return _key()
 
 
 def set_rng_state(key):
@@ -63,7 +71,7 @@ def next_key():
         k = jax.random.fold_in(frame["key"], frame["counter"])
         frame["counter"] += 1
         return k
-    k, sub = jax.random.split(_global["key"])
+    k, sub = jax.random.split(_key())
     _global["key"] = k
     return sub
 

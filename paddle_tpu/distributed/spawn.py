@@ -25,7 +25,16 @@ def _worker(func, rank, nprocs, endpoints, backend, args, queue):
     os.environ["PADDLE_TRAINER_ENDPOINTS"] = ",".join(endpoints)
     os.environ["PADDLE_CURRENT_ENDPOINT"] = endpoints[rank]
     if backend == "cpu":
-        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        # force, not setdefault: the inherited env (and any sitecustomize
+        # jax.config pin) may point at a TPU plugin the workers must not
+        # fight over
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        try:
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
     try:
         result = func(*args)
         queue.put((rank, "ok", result))

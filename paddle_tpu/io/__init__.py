@@ -9,14 +9,17 @@ the TPU equivalent of the shared-memory worker pool.
 from __future__ import annotations
 
 import itertools
+import os
 import queue
 import threading
+import time
 from typing import Iterable, List, Optional
 
 import jax
 import numpy as np
 
 from ..core.tensor import Tensor
+from . import worker as worker_mod
 
 
 class Dataset:
@@ -244,11 +247,18 @@ class DataLoader:
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
         self.prefetch_factor = max(prefetch_factor, 1)
+        self.use_shared_memory = use_shared_memory
+        self.timeout = timeout
+        self.worker_init_fn = worker_init_fn
+        self.persistent_workers = persistent_workers
+        if persistent_workers and num_workers == 0:
+            raise ValueError("persistent_workers requires num_workers > 0")
+        self._persistent_iter = None
         self._iterable_mode = isinstance(dataset, IterableDataset)
+        self.batch_size = batch_size
+        self.drop_last = drop_last
         if self._iterable_mode:
             self.batch_sampler = None
-            self.batch_size = batch_size
-            self.drop_last = drop_last
         elif batch_sampler is not None:
             self.batch_sampler = batch_sampler
         else:
@@ -283,24 +293,27 @@ class DataLoader:
             for b in self._batches():
                 yield self._to_device(b)
             return
-        # background-thread prefetch pipeline
-        q: "queue.Queue" = queue.Queue(maxsize=self.prefetch_factor * max(self.num_workers, 1))
-        _END = object()
+        if self.persistent_workers and not self._iterable_mode:
+            if self._persistent_iter is None:
+                self._persistent_iter = _MultiProcessIter(self)
+            it = self._persistent_iter
+            it.start_epoch()
+        else:
+            it = _MultiProcessIter(self)
+            it.start_epoch()
+        try:
+            for b in it.epoch_batches():
+                yield self._to_device(b)
+        finally:
+            if it is not self._persistent_iter:
+                it.shutdown()
 
-        def producer():
-            try:
-                for b in self._batches():
-                    q.put(self._to_device(b))
-            finally:
-                q.put(_END)
-
-        t = threading.Thread(target=producer, daemon=True)
-        t.start()
-        while True:
-            item = q.get()
-            if item is _END:
-                break
-            yield item
+    def __del__(self):  # pragma: no cover
+        try:
+            if self._persistent_iter is not None:
+                self._persistent_iter.shutdown()
+        except Exception:
+            pass
 
     def __len__(self):
         if self._iterable_mode:
@@ -308,5 +321,176 @@ class DataLoader:
         return len(self.batch_sampler)
 
 
+class _MultiProcessIter:
+    """Parent side of the multiprocess loader: feeds batch-index tasks to
+    worker processes and reassembles results in sampler order.
+
+    Replaces ref:python/paddle/fluid/dataloader/dataloader_iter.py:370
+    (_DataLoaderIterMultiProcess): index queues per worker, one shared result
+    queue, shm transport (io/worker.py), reorder buffer for determinism.
+    """
+
+    def __init__(self, loader: "DataLoader"):
+        import multiprocessing as mp
+
+        self.loader = loader
+        method = os.environ.get("PADDLE_TPU_LOADER_START_METHOD", "fork")
+        ctx = mp.get_context(method)
+        self.nw = loader.num_workers
+        self.iterable = loader._iterable_mode
+        self.result_queue = ctx.Queue()
+        self.index_queues = []
+        self.procs = []
+        self.alive = True
+        base_seed = int(np.random.randint(0, 1 << 30))
+        for wid in range(self.nw):
+            iq = ctx.Queue() if not self.iterable else None
+            self.index_queues.append(iq)
+            p = ctx.Process(
+                target=worker_mod.worker_loop,
+                args=(loader.dataset, iq, self.result_queue, loader.collate_fn,
+                      loader.use_shared_memory, wid, self.nw,
+                      loader.worker_init_fn, self.iterable, loader.batch_size,
+                      loader.drop_last, base_seed),
+                daemon=True)
+            p.start()
+            self.procs.append(p)
+
+    # ------------------------------------------------------------ epochs
+
+    def start_epoch(self):
+        if self.iterable:
+            self._done_workers = 0
+        else:
+            # epoch generation tag: results from a previous, partially
+            # consumed epoch (persistent workers + early break) are discarded
+            # instead of being misread as this epoch's batches
+            self._epoch = getattr(self, "_epoch", -1) + 1
+            self._task_iter = enumerate(iter(self.loader.batch_sampler))
+            self._sent = 0
+            self._yielded = 0
+            self._next_worker = 0
+            self._reorder = {}
+            depth = self.loader.prefetch_factor * self.nw
+            for _ in range(depth):
+                self._send_task()
+
+    def _send_task(self):
+        task = next(self._task_iter, None)
+        if task is None:
+            return False
+        seq, indices = task
+        self.index_queues[self._next_worker].put((self._epoch, seq, list(indices)))
+        self._next_worker = (self._next_worker + 1) % self.nw
+        self._sent += 1
+        return True
+
+    def _get(self):
+        """Poll the result queue, watching worker liveness so a hard-killed
+        worker (OOM/SIGKILL never runs the traceback handler) raises instead
+        of hanging the training loop forever."""
+        deadline = (time.monotonic() + self.loader.timeout
+                    if self.loader.timeout else None)
+        while True:
+            try:
+                return self.result_queue.get(timeout=1.0)
+            except queue.Empty:
+                pass
+            dead = [i for i, p in enumerate(self.procs)
+                    if not p.is_alive() and p.exitcode not in (0, None)]
+            if dead:
+                self.shutdown()
+                raise RuntimeError(
+                    f"DataLoader worker(s) {dead} died unexpectedly "
+                    f"(exitcodes {[self.procs[i].exitcode for i in dead]})")
+            if deadline is not None and time.monotonic() > deadline:
+                self.shutdown()
+                raise RuntimeError(
+                    f"DataLoader timed out after {self.loader.timeout}s "
+                    "waiting for a worker batch")
+
+    def epoch_batches(self):
+        if self.iterable:
+            yield from self._iterable_epoch()
+            return
+        while self._yielded < self._sent:
+            if self._yielded in self._reorder:
+                batch = self._reorder.pop(self._yielded)
+                self._yielded += 1
+                yield batch
+                continue
+            kind, tag, payload = self._get()
+            if kind == "error":
+                self.shutdown()
+                raise RuntimeError(f"DataLoader worker {tag} failed:\n{payload}")
+            if kind == "done":  # premature exit (worker crash w/o traceback)
+                self.shutdown()
+                raise RuntimeError(f"DataLoader worker {tag} exited early")
+            epoch, seq = tag
+            if epoch != self._epoch:  # stale batch from an abandoned epoch
+                worker_mod.discard(payload)
+                continue
+            # refill on receipt (not on in-order yield): a straggler batch
+            # must not starve the other workers of tasks
+            self._send_task()
+            self._reorder[seq] = worker_mod._unpack(payload)
+
+    def _iterable_epoch(self):
+        done = 0
+        while done < self.nw:
+            kind, wid, payload = self._get()
+            if kind == "error":
+                self.shutdown()
+                raise RuntimeError(f"DataLoader worker {wid} failed:\n{payload}")
+            if kind == "done":
+                done += 1
+                continue
+            yield worker_mod._unpack(payload)
+        self.alive = False  # iterable workers are exhausted; epoch over
+
+    # ---------------------------------------------------------- shutdown
+
+    def shutdown(self):
+        if not self.alive:
+            return
+        self.alive = False
+        for iq in self.index_queues:
+            if iq is not None:
+                try:
+                    iq.put(None)
+                except Exception:
+                    pass
+        # drain-while-joining: workers flush pending results, then exit; every
+        # drained shm segment is unlinked so nothing leaks in /dev/shm
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            try:
+                kind, _, payload = self.result_queue.get(timeout=0.2)
+                if kind == "batch":
+                    worker_mod.discard(payload)
+                continue
+            except queue.Empty:
+                pass
+            except Exception:
+                break
+            if all(not p.is_alive() for p in self.procs):
+                break
+        for p in self.procs:
+            if p.is_alive():  # pragma: no cover
+                p.terminate()
+                p.join(timeout=1)
+            else:
+                p.join(timeout=1)
+        # final sweep for results that landed between drain and join
+        while True:
+            try:
+                kind, _, payload = self.result_queue.get(timeout=0.1)
+            except Exception:
+                break
+            if kind == "batch":
+                worker_mod.discard(payload)
+
+
 def get_worker_info():
-    return None
+    """Worker-process info (id/num_workers/seed/dataset), None in the parent."""
+    return worker_mod.get_worker_info()
