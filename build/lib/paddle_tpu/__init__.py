@@ -8,7 +8,7 @@ parallelism is mesh-based GSPMD rather than runtime collectives.
 """
 from __future__ import annotations
 
-__version__ = "0.2.0"
+__version__ = "0.1.0"
 
 from . import autograd  # noqa: F401
 from .core.autograd import enable_grad, grad, is_grad_enabled, no_grad, set_grad_enabled  # noqa: F401

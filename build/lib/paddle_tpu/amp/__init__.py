@@ -1,0 +1,193 @@
+"""AMP: bf16-first autocast + GradScaler (ref:python/paddle/amp/).
+
+On TPU the native fast dtype is bfloat16 — same exponent range as f32, so
+dynamic loss scaling is a no-op numerically, but the GradScaler API is kept
+for compatibility (and for f16 if requested). ``auto_cast`` drives per-op
+input casting from white/black lists, checked inside the dispatch layer
+(mirrors AmpAutoCast in ref:paddle/fluid/eager/eager_amp_auto_cast.h and lists
+in ref:python/paddle/amp/amp_lists.py).
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax.numpy as jnp
+
+from ..core.dtype import convert_dtype_arg, is_floating
+from ..core.tensor import Tensor
+
+_state = threading.local()
+
+# ops that benefit from low precision (MXU ops)
+WHITE_LIST = {"matmul", "conv", "conv2d", "conv1d", "conv3d", "einsum", "mm", "bmm", "addmm", "linear", "linear_nb"}
+# ops that need f32 accumulate / range
+BLACK_LIST = {
+    "exp", "log", "log2", "log10", "log1p", "logsumexp", "softmax", "log_softmax", "ce", "bce", "bcel",
+    "mse", "nll", "kl", "cumsum", "cumprod", "norm", "mean", "sum", "var", "std", "pow",
+    "ln", "ln_nw", "bn", "rms", "rms_nw",
+}
+
+
+def amp_state():
+    return getattr(_state, "amp", None)
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None, level="O1", dtype="bfloat16"):
+    """paddle.amp.auto_cast (ref:python/paddle/amp/auto_cast.py:324)."""
+    if level not in ("O0", "OD", "O1", "O2"):
+        raise ValueError(f"bad amp level {level}")
+    prev = amp_state()
+    if not enable or level == "O0":
+        _state.amp = None
+    else:
+        white = set(WHITE_LIST)
+        black = set(BLACK_LIST)
+        if custom_white_list:
+            white |= set(custom_white_list)
+            black -= set(custom_white_list)
+        if custom_black_list:
+            black |= set(custom_black_list)
+            white -= set(custom_black_list)
+        _state.amp = {"level": level, "dtype": convert_dtype_arg(dtype), "white": white, "black": black}
+    try:
+        yield
+    finally:
+        _state.amp = prev
+
+
+amp_guard = auto_cast
+
+
+def maybe_cast_inputs(name: str, datas):
+    """Called by core.dispatch.apply: cast op inputs per AMP policy."""
+    st = amp_state()
+    if st is None:
+        return datas
+    dtype = st["dtype"]
+    lvl = st["level"]
+    if name in st["black"]:
+        # promote low-precision inputs to f32 for numerically-sensitive ops
+        return tuple(d.astype(jnp.float32) if hasattr(d, "dtype") and d.dtype == dtype else d for d in datas)
+    if name in st["white"] or lvl == "O2":
+        return tuple(
+            d.astype(dtype) if hasattr(d, "dtype") and d.dtype == jnp.float32 else d for d in datas
+        )
+    return datas
+
+
+def decorate(models=None, optimizers=None, level="O2", dtype="bfloat16", master_weight=None, save_dtype=None):
+    """Cast model params to the AMP dtype (O2). Optimizer math stays f32
+    (our optimizer slots are always f32 = master weights)."""
+    dtype = convert_dtype_arg(dtype)
+    single = not isinstance(models, (list, tuple))
+    ms = [models] if single else list(models)
+    for m in ms:
+        if m is None:
+            continue
+        for p in m.parameters():
+            if is_floating(p._data.dtype):
+                p._data = p._data.astype(dtype)
+    if optimizers is None:
+        return models if single else ms
+    return (models, optimizers) if single else (ms, optimizers)
+
+
+class GradScaler:
+    """Dynamic loss scaling (ref:python/paddle/amp/grad_scaler.py:40).
+    With bf16 this is effectively pass-through but keeps the API contract."""
+
+    def __init__(self, enable=True, init_loss_scaling=2.0**15, incr_ratio=2.0, decr_ratio=0.5,
+                 incr_every_n_steps=1000, decr_every_n_nan_or_inf=2, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling) if enable else 1.0
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+        self._unscaled = set()  # ids of optimizers already unscaled this step
+
+    def scale(self, loss):
+        if not self._enable:
+            return loss
+        return loss * self._scale
+
+    def unscale_(self, optimizer):
+        if not self._enable or id(optimizer) in self._unscaled:
+            return
+        self._unscaled.add(id(optimizer))
+        inv = 1.0 / self._scale
+        self._found_inf = False
+        for p in optimizer._parameter_list or []:
+            if p.grad is not None:
+                p.grad._data = p.grad._data * inv
+        for p in optimizer._parameter_list or []:
+            if p.grad is not None and not bool(jnp.isfinite(p.grad._data).all()):
+                self._found_inf = True
+                break
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self.update()
+
+    def minimize(self, optimizer, scaled_loss):
+        scaled_loss.backward()
+        self.step(optimizer)
+
+    def update(self):
+        self._unscaled.clear()
+        if not (self._enable and self._dynamic):
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+
+    def is_enable(self):
+        return self._enable
+
+    def get_loss_scaling(self):
+        return self._scale
+
+    def state_dict(self):
+        return {"scale": self._scale, "good_steps": self._good_steps, "bad_steps": self._bad_steps}
+
+    def load_state_dict(self, sd):
+        self._scale = sd.get("scale", self._scale)
+
+
+AmpScaler = GradScaler
+
+
+def is_bfloat16_supported(device=None):
+    return True
+
+
+def is_float16_supported(device=None):
+    return True
+
+
+# register with the dispatch layer (lazy hook avoids an import cycle)
+import sys as _sys  # noqa: E402
+
+from ..core import dispatch as _dispatch  # noqa: E402
+
+_dispatch._amp = _sys.modules[__name__]

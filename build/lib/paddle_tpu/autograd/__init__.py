@@ -1,0 +1,27 @@
+"""paddle.autograd namespace (ref:python/paddle/autograd/__init__.py).
+
+The engine itself lives in ``paddle_tpu.core.autograd`` (tape over jax.vjp);
+this package re-exports the user-facing API: backward/grad, grad-mode
+contexts, PyLayer (user-defined vjp ops) and hooks.
+"""
+from ..core.autograd import (  # noqa: F401
+    PyLayer,
+    PyLayerContext,
+    backward,
+    enable_grad,
+    grad,
+    is_grad_enabled,
+    no_grad,
+    set_grad_enabled,
+)
+
+__all__ = [
+    "PyLayer",
+    "PyLayerContext",
+    "backward",
+    "grad",
+    "no_grad",
+    "enable_grad",
+    "set_grad_enabled",
+    "is_grad_enabled",
+]

@@ -1,0 +1,2 @@
+from . import autograd, device, dispatch, dtype, flags, rng, tensor  # noqa: F401
+from .tensor import Tensor, to_tensor  # noqa: F401

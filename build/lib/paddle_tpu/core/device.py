@@ -1,0 +1,109 @@
+"""Device / Place abstraction.
+
+Replaces the reference's Place/Backend system (ref:paddle/phi/common/backend.h:40,
+ref:paddle/fluid/platform/place.h) and DeviceContextPool. On TPU there is no
+per-op stream management — PJRT owns execution — so a Place is just a named
+jax.Device plus helpers.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+
+from . import flags
+
+
+class Place:
+    """A device placement, e.g. Place('tpu', 0)."""
+
+    __slots__ = ("device_type", "device_id")
+
+    def __init__(self, device_type: str, device_id: int = 0):
+        self.device_type = device_type
+        self.device_id = device_id
+
+    def __repr__(self):
+        return f"Place({self.device_type}:{self.device_id})"
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, Place)
+            and self.device_type == other.device_type
+            and self.device_id == other.device_id
+        )
+
+    def __hash__(self):
+        return hash((self.device_type, self.device_id))
+
+    def jax_device(self) -> jax.Device:
+        devs = [d for d in jax.devices() if _platform_name(d) == self.device_type]
+        if not devs:
+            devs = jax.devices()  # fall back to default platform
+        return devs[min(self.device_id, len(devs) - 1)]
+
+
+def CPUPlace() -> Place:
+    return Place("cpu", 0)
+
+
+def TPUPlace(device_id: int = 0) -> Place:
+    return Place("tpu", device_id)
+
+
+def CUDAPlace(device_id: int = 0) -> Place:  # API-parity alias: maps to the accelerator
+    return Place(_default_accelerator(), device_id)
+
+
+def _platform_name(d: jax.Device) -> str:
+    p = d.platform
+    # the axon tunnel reports TPU devices under an experimental platform name
+    return "tpu" if p in ("tpu", "axon") else p
+
+
+@functools.lru_cache(maxsize=1)
+def _default_accelerator() -> str:
+    platforms = {_platform_name(d) for d in jax.devices()}
+    if "tpu" in platforms:
+        return "tpu"
+    return "cpu"
+
+
+_current_device: Optional[Place] = None
+
+
+def set_device(device: str) -> Place:
+    """paddle.device.set_device equivalent: 'cpu', 'tpu', 'tpu:1'."""
+    global _current_device
+    if ":" in device:
+        t, i = device.split(":")
+        _current_device = Place(t, int(i))
+    else:
+        _current_device = Place(device, 0)
+    return _current_device
+
+
+def get_device() -> str:
+    p = current_place()
+    return f"{p.device_type}:{p.device_id}"
+
+
+def current_place() -> Place:
+    global _current_device
+    if _current_device is None:
+        override = flags.flag("default_device")
+        _current_device = Place(override, 0) if override else Place(_default_accelerator(), 0)
+    return _current_device
+
+
+def is_compiled_with_cuda() -> bool:  # API parity
+    return False
+
+
+def is_compiled_with_tpu() -> bool:
+    return _default_accelerator() == "tpu"
+
+
+def device_count() -> int:
+    return jax.device_count()

@@ -1,0 +1,79 @@
+"""Dtype system.
+
+Replaces the reference's ``phi::DataType`` enum (ref:paddle/phi/common/data_type.h)
+with thin aliases over numpy/jax dtypes. On TPU the native matmul type is
+bfloat16; float64 is supported by XLA:CPU for tests but discouraged on TPU.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+# Canonical dtype objects (jnp dtypes are numpy dtype instances).
+bool_ = jnp.bool_
+uint8 = jnp.uint8
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+float16 = jnp.float16
+bfloat16 = jnp.bfloat16
+float32 = jnp.float32
+float64 = jnp.float64
+complex64 = jnp.complex64
+complex128 = jnp.complex128
+
+_STR_TO_DTYPE = {
+    "bool": bool_,
+    "uint8": uint8,
+    "int8": int8,
+    "int16": int16,
+    "int32": int32,
+    "int64": int64,
+    "float16": float16,
+    "bfloat16": bfloat16,
+    "float32": float32,
+    "float64": float64,
+    "complex64": complex64,
+    "complex128": complex128,
+}
+
+_default_dtype = jnp.float32
+
+
+def set_default_dtype(d) -> None:
+    global _default_dtype
+    _default_dtype = convert_dtype_arg(d)
+
+
+def get_default_dtype():
+    return _default_dtype
+
+
+def convert_dtype_arg(dtype):
+    """Normalize a user-provided dtype (str | np.dtype | jnp scalar type) to a jnp type."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        try:
+            return _STR_TO_DTYPE[dtype]
+        except KeyError:
+            raise ValueError(f"unsupported dtype string: {dtype!r}")
+    return jnp.dtype(dtype).type
+
+
+def dtype_name(dtype) -> str:
+    """'float32'-style name for any dtype representation."""
+    return jnp.dtype(dtype).name
+
+
+def is_floating(dtype) -> bool:
+    return jnp.issubdtype(jnp.dtype(dtype), np.floating) or jnp.dtype(dtype) == jnp.dtype(bfloat16)
+
+
+def is_integer(dtype) -> bool:
+    return jnp.issubdtype(jnp.dtype(dtype), np.integer)
+
+
+def is_complex(dtype) -> bool:
+    return jnp.issubdtype(jnp.dtype(dtype), np.complexfloating)

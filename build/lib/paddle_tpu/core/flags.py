@@ -1,0 +1,90 @@
+"""Exported-flags registry.
+
+Equivalent of the reference's ``PHI_DEFINE_EXPORTED_*`` global flag registry
+(ref:paddle/phi/core/flags.cc, ref:paddle/phi/core/flags.h:142 ExportedFlagInfoMap)
+and the Python ``paddle.set_flags/get_flags`` surface
+(ref:python/paddle/fluid/framework.py:7506,7531).
+
+Flags are typed, documented, and overridable via ``FLAGS_<name>`` environment
+variables at import time, matching the reference's env-var contract.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Union
+
+_lock = threading.Lock()
+
+
+@dataclass
+class _FlagInfo:
+    name: str
+    default: Any
+    type: type
+    doc: str
+    value: Any
+
+
+_REGISTRY: Dict[str, _FlagInfo] = {}
+
+
+def _parse(type_, raw: str):
+    if type_ is bool:
+        return raw.lower() in ("1", "true", "yes", "on")
+    return type_(raw)
+
+
+def define_flag(name: str, default: Any, doc: str = "") -> None:
+    """Register an exported flag; FLAGS_<name> env var overrides the default."""
+    type_ = type(default)
+    value = default
+    env = os.environ.get("FLAGS_" + name)
+    if env is not None:
+        value = _parse(type_, env)
+    with _lock:
+        _REGISTRY[name] = _FlagInfo(name, default, type_, doc, value)
+
+
+def get_flags(flags: Union[str, List[str]]) -> Dict[str, Any]:
+    if isinstance(flags, str):
+        flags = [flags]
+    out = {}
+    for f in flags:
+        key = f[6:] if f.startswith("FLAGS_") else f
+        if key not in _REGISTRY:
+            raise KeyError(f"unknown flag: {f}")
+        out[f] = _REGISTRY[key].value
+    return out
+
+
+def set_flags(flags: Dict[str, Any]) -> None:
+    for f, v in flags.items():
+        key = f[6:] if f.startswith("FLAGS_") else f
+        if key not in _REGISTRY:
+            raise KeyError(f"unknown flag: {f}")
+        info = _REGISTRY[key]
+        info.value = _parse(info.type, v) if isinstance(v, str) and info.type is not str else info.type(v)
+
+
+def flag(name: str) -> Any:
+    """Fast read of a single flag value."""
+    return _REGISTRY[name].value
+
+
+def all_flags() -> Dict[str, Any]:
+    return {k: v.value for k, v in _REGISTRY.items()}
+
+
+# ---- Core flags (subset of ref:paddle/phi/core/flags.cc relevant on TPU) ----
+define_flag("check_nan_inf", False, "Scan op outputs for NaN/Inf in eager mode (ref flags.cc:74).")
+define_flag("check_nan_inf_level", 0, "0: fail on nan/inf; >0 report-only.")
+define_flag("eager_jit_ops", True, "Cache per-op jitted executables for eager mode dispatch.")
+define_flag("default_device", "", "Override default device: 'cpu' | 'tpu'.")
+define_flag("benchmark", False, "Block on each op for accurate eager timing.")
+define_flag("tracer_mkldnn_ops_on", "", "Unused; kept for API parity.")
+define_flag("allocator_strategy", "xla", "Memory allocator strategy (XLA manages HBM on TPU).")
+define_flag("use_stream_safe_allocator", True, "Kept for API parity; XLA/PJRT owns streams on TPU.")
+define_flag("sequence_parallel_mode", "auto",
+            "Context parallelism for attention: auto|ring|ulysses|none.")

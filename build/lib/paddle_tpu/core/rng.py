@@ -1,0 +1,104 @@
+"""Global RNG state + trace-safe key derivation.
+
+Replaces the reference's per-device Generator (ref:paddle/phi/core/generator.h)
+and the TP-aware ``RNGStatesTracker``
+(ref:python/paddle/distributed/fleet/layers/mpu/random.py).
+
+Eager mode: a global threefry key split per draw (stateful, like paddle's
+global generator). Under a jit trace, stateful splitting would bake keys as
+constants, so a ``KeyGuard`` scope provides a traced base key; draws fold in a
+trace-time counter, giving deterministic per-call streams inside one compiled
+step — the idiomatic JAX pattern.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax
+import jax.numpy as jnp
+
+_state = threading.local()
+# key is created lazily: building it at import time would initialize the JAX
+# backend (possibly a remote TPU plugin) before the app can pick a platform
+_global = {"key": None, "seed": 0}
+
+
+def _key():
+    if _global["key"] is None:
+        _global["key"] = jax.random.key(_global["seed"])
+    return _global["key"]
+
+
+def seed(s: int):
+    """paddle.seed equivalent."""
+    _global["key"] = jax.random.key(int(s))
+    _global["seed"] = int(s)
+    return s
+
+
+def get_rng_state():
+    return _key()
+
+
+def set_rng_state(key):
+    _global["key"] = key
+
+
+def _guard_stack():
+    if not hasattr(_state, "stack"):
+        _state.stack = []
+    return _state.stack
+
+
+@contextlib.contextmanager
+def key_guard(base_key):
+    """Provide a (possibly traced) base key; draws inside fold in a counter."""
+    if isinstance(base_key, int):
+        base_key = jax.random.key(base_key)
+    frame = {"key": base_key, "counter": 0}
+    _guard_stack().append(frame)
+    try:
+        yield
+    finally:
+        _guard_stack().pop()
+
+
+def next_key():
+    stack = _guard_stack()
+    if stack:
+        frame = stack[-1]
+        k = jax.random.fold_in(frame["key"], frame["counter"])
+        frame["counter"] += 1
+        return k
+    k, sub = jax.random.split(_key())
+    _global["key"] = k
+    return sub
+
+
+class RNGStatesTracker:
+    """Named RNG streams for TP determinism (mirror of mpu/random.py API)."""
+
+    def __init__(self):
+        self.states = {}
+
+    def add(self, name, seed_):
+        if name in self.states:
+            raise ValueError(f"rng state {name} already exists")
+        self.states[name] = jax.random.key(int(seed_))
+
+    @contextlib.contextmanager
+    def rng_state(self, name="model_parallel_rng"):
+        if name not in self.states:
+            self.states[name] = jax.random.key(0)
+        with key_guard(self.states[name]):
+            # advance the stored stream so successive scopes differ
+            self.states[name] = jax.random.split(self.states[name])[0]
+            yield
+
+
+_tracker = RNGStatesTracker()
+
+
+def get_rng_state_tracker():
+    return _tracker

@@ -1,0 +1,31 @@
+"""Cheap hot-path hook connecting op dispatch to the native host tracer.
+
+``active`` is flipped by profiler.Profiler.start/stop; when False the op
+dispatch pays a single attribute load. When True each eager op wraps its
+execution in a native RecordEvent (ring buffer write, no locks)."""
+from __future__ import annotations
+
+active = False
+_lib = None
+
+
+def enable():
+    global active, _lib
+    from ..native import load
+
+    _lib = load()
+    active = True
+
+
+def disable():
+    global active
+    active = False
+
+
+def begin() -> int:
+    return _lib.pt_trace_begin() if _lib is not None else 0
+
+
+def end(name: str, t0: int):
+    if _lib is not None and t0:
+        _lib.pt_trace_end(name.encode(), t0)

@@ -1,0 +1,51 @@
+"""paddle.distributed equivalent — mesh-first.
+
+Collectives are XLA ops over a named ``jax.sharding.Mesh`` (SURVEY.md §5.8);
+the ProcessGroup survives as mesh/axis bookkeeping (``Group``), bootstrap is
+the JAX coordination service, and hybrid parallelism is axes of one mesh.
+"""
+from . import env  # noqa: F401
+from .env import get_endpoints  # noqa: F401
+from .mesh import (  # noqa: F401
+    HYBRID_AXES,
+    HybridCommunicateGroup,
+    build_mesh,
+    ensure_mesh,
+    get_mesh,
+    init_hybrid_mesh,
+    named_sharding,
+    set_mesh,
+)
+from .collective import (  # noqa: F401
+    Group,
+    ReduceOp,
+    all_gather,
+    all_gather_object,
+    all_reduce,
+    alltoall,
+    alltoall_single,
+    barrier,
+    broadcast,
+    destroy_process_group,
+    get_group,
+    get_rank,
+    get_world_size,
+    is_initialized,
+    new_group,
+    recv,
+    reduce,
+    reduce_scatter,
+    scatter,
+    send,
+    shift,
+)
+from .parallel import DataParallel, init_parallel_env, shard_batch  # noqa: F401
+from . import fleet  # noqa: F401
+from . import checkpoint  # noqa: F401
+from . import context_parallel  # noqa: F401
+from . import pipeline  # noqa: F401
+from . import sharding  # noqa: F401
+from .store import TCPStore  # noqa: F401
+from .sharding import group_sharded_parallel, save_group_sharded_model  # noqa: F401
+from .spawn import spawn  # noqa: F401
+from . import launch  # noqa: F401

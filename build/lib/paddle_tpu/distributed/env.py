@@ -1,0 +1,35 @@
+"""Distributed environment contract.
+
+Keeps the reference launcher's env-var names
+(PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM / PADDLE_TRAINER_ENDPOINTS,
+ref:python/paddle/distributed/launch) so launch scripts port over, while the
+actual device topology comes from JAX process/device info.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+
+def get_rank() -> int:
+    v = os.environ.get("PADDLE_TRAINER_ID")
+    if v is not None:
+        return int(v)
+    return jax.process_index()
+
+
+def get_world_size() -> int:
+    v = os.environ.get("PADDLE_TRAINERS_NUM")
+    if v is not None:
+        return int(v)
+    return jax.process_count()
+
+
+def get_endpoints():
+    eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+    return eps.split(",") if eps else []
+
+
+def parallel_helper_is_initialized() -> bool:
+    return get_world_size() > 1

@@ -1,0 +1,190 @@
+"""Fleet facade — hybrid-parallel entry points.
+
+Replaces ref:python/paddle/distributed/fleet/fleet.py:168 (``fleet.init``),
+``distributed_model`` dispatch (ref:python/paddle/distributed/fleet/model.py:30)
+and the 244-field ``DistributedStrategy`` protobuf
+(ref:paddle/fluid/framework/distributed_strategy.proto:323) — collapsed to a
+typed config + ONE device mesh (SURVEY.md §7 "Parallelism = one mesh").
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from .. import env, mesh as mesh_mod
+from ..collective import new_group
+from ..mesh import HybridCommunicateGroup, init_hybrid_mesh
+from ..parallel import DataParallel, init_parallel_env
+
+
+class DistributedStrategy:
+    """Typed strategy tree (the surviving subset of the 244 proto fields that
+    changes behavior on TPU; unknown attributes are accepted and stored so
+    reference configs load without edits)."""
+
+    def __init__(self):
+        self.hybrid_configs = {
+            "dp_degree": -1,  # -1 = auto-fill from device count (paddle contract)
+            "mp_degree": 1,
+            "pp_degree": 1,
+            "sharding_degree": 1,
+            "sep_degree": 1,
+            "ep_degree": 1,
+        }
+        self.amp = False
+        self.amp_configs = {"init_loss_scaling": 32768.0, "use_pure_fp16": False}
+        self.recompute = False
+        self.recompute_configs = {"checkpoints": []}
+        self.sharding = False
+        self.sharding_configs = {"stage": 1}
+        self.gradient_merge = False
+        self.gradient_merge_configs = {"k_steps": 1}
+        self.pipeline = False
+        self.pipeline_configs = {"accumulate_steps": 1, "micro_batch_size": 1}
+        self.find_unused_parameters = False
+        self.fuse_all_reduce_ops = True  # XLA does this; kept for parity
+        self.without_graph_optimization = False
+
+    def __setattr__(self, k, v):
+        object.__setattr__(self, k, v)
+
+    def __repr__(self):
+        pub = {k: v for k, v in self.__dict__.items() if not k.startswith("_")}
+        return f"DistributedStrategy({pub})"
+
+
+class _FleetState:
+    def __init__(self):
+        self.initialized = False
+        self.strategy: Optional[DistributedStrategy] = None
+        self.hcg: Optional[HybridCommunicateGroup] = None
+        self.axis_groups = {}  # axis name -> stable Group object
+
+
+_state = _FleetState()
+
+
+def init(role_maker=None, is_collective: bool = True, strategy: Optional[DistributedStrategy] = None):
+    """fleet.init — builds the global hybrid mesh from strategy.hybrid_configs
+    and installs the topology object."""
+    strategy = strategy or DistributedStrategy()
+    hc = strategy.hybrid_configs
+    init_parallel_env()
+    import jax
+
+    ndev = len(jax.devices())
+    degrees = {
+        "dp": int(hc.get("dp_degree", -1)),
+        "mp": int(hc.get("mp_degree", 1)),
+        "pp": int(hc.get("pp_degree", 1)),
+        "sharding": int(hc.get("sharding_degree", 1)),
+        "sep": int(hc.get("sep_degree", 1)),
+        "expert": int(hc.get("ep_degree", 1)),
+    }
+    prod_rest = degrees["mp"] * degrees["pp"] * degrees["sharding"] * degrees["sep"] * degrees["expert"]
+    # dp_degree == -1 means auto-fill (paddle contract); an explicit degree
+    # that mismatches the device count falls through to ValueError
+    if degrees["dp"] == -1:
+        if ndev % prod_rest != 0:
+            raise ValueError(
+                f"non-dp degrees {prod_rest} do not divide device count {ndev}"
+            )
+        degrees["dp"] = ndev // prod_rest
+    mesh = init_hybrid_mesh(
+        dp=degrees["dp"],
+        mp=degrees["mp"],
+        pp=degrees["pp"],
+        sharding=degrees["sharding"],
+        sep=degrees["sep"],
+        expert=degrees["expert"],
+    )
+    _state.initialized = True
+    _state.strategy = strategy
+    _state.hcg = HybridCommunicateGroup(mesh)
+    _state.axis_groups = {}  # groups are per-mesh; invalidate on re-init
+    return None
+
+
+def is_initialized() -> bool:
+    return _state.initialized
+
+
+def get_hybrid_communicate_group() -> Optional[HybridCommunicateGroup]:
+    return _state.hcg
+
+
+def distributed_model(model):
+    """Wrap a Layer for the current topology
+    (ref:python/paddle/distributed/fleet/model.py:134-169 dispatch).
+
+    Pure DP → DataParallel wrapper (input sharding). Hybrid (mp/pp/sharding
+    axes active) → returned as-is: TP/PP layers carry GSPMD shardings and the
+    compiled TrainStep partitions the step; no runtime wrapper needed."""
+    if _state.hcg is None:
+        init()
+    from .meta_parallel import PipelineLayer, PipelineParallel
+
+    if isinstance(model, PipelineLayer) and _state.hcg.get_pipe_parallel_world_size() > 1:
+        return PipelineParallel(model, _state.hcg, _state.strategy)
+    mode = _state.hcg.get_parallel_mode()
+    if mode == "data_parallel":
+        return DataParallel(model)
+    return model
+
+
+def distributed_optimizer(optimizer, strategy: Optional[DistributedStrategy] = None):
+    """The optimizer update is compiled into the sharded step; optimizer-state
+    sharding (ZeRO) comes from the 'sharding' mesh axis, not a wrapper."""
+    if strategy is not None:
+        _state.strategy = strategy
+    return optimizer
+
+
+def worker_index() -> int:
+    return env.get_rank()
+
+
+def worker_num() -> int:
+    return env.get_world_size()
+
+
+def is_first_worker() -> bool:
+    return worker_index() == 0
+
+
+def worker_endpoints():
+    return env.get_endpoints()
+
+
+def barrier_worker():
+    from ..collective import barrier
+
+    barrier()
+
+
+def stop_worker():
+    pass
+
+
+# per-axis group accessors (paddle topology contract: stable objects)
+def _axis_group(axis: str):
+    g = _state.axis_groups.get(axis)
+    if g is None:
+        g = new_group(axis=axis)
+        _state.axis_groups[axis] = g
+    return g
+
+
+def get_data_parallel_group():
+    return _axis_group("data")
+
+
+def get_model_parallel_group():
+    return _axis_group("model")
+
+
+def get_pipe_parallel_group():
+    return _axis_group("pipe")
+
+
+def get_sharding_parallel_group():
+    return _axis_group("sharding")

@@ -1,0 +1,120 @@
+"""Elastic membership over the TCPStore (the reference's etcd ElasticManager,
+ref:python/paddle/distributed/fleet/elastic/manager.py:124,220-255).
+
+Each worker leases its membership: a heartbeat thread refreshes
+``hb/{rank}`` every ``lease/3`` seconds. Any peer whose heartbeat is older
+than the lease is dead — the TPU analog of the etcd TTL-lease + watch,
+without an external etcd: rank 0's TCPStore is the membership table.
+
+Used together with the launcher's elastic restart loop
+(``--elastic_level 1``) and ``TrainCheckpointer`` auto-resume: a preempted
+worker is detected by lease expiry, the pod relaunches, and training
+continues from the latest checkpoint.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from ...store import TCPStore
+
+
+class ElasticManager:
+    def __init__(self, store: TCPStore, rank: int, world_size: int,
+                 lease: float = 3.0):
+        self.store = store
+        self.rank = rank
+        self.world_size = world_size
+        self.lease = lease
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._watchers: List[Callable[[List[int]], None]] = []
+
+    # ------------------------------------------------------------ leasing
+
+    def start(self):
+        """Register and start heartbeating this rank's lease."""
+        self._beat()
+        self.store.set(f"member/{self.rank}", str(time.time()))
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def _beat(self):
+        self.store.set(f"hb/{self.rank}", repr(time.time()))
+
+    def _loop(self):
+        interval = self.lease / 3.0
+        while not self._stop.wait(interval):
+            try:
+                self._beat()
+            except Exception:  # store gone: the pod is going down anyway
+                return
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self.lease)
+            self._thread = None
+
+    def resign(self):
+        """Graceful leave (scale-in): drop the lease immediately."""
+        self.stop()
+        self.store.set(f"hb/{self.rank}", "0")
+
+    # ----------------------------------------------------------- watching
+
+    def heartbeats(self) -> Dict[int, float]:
+        out = {}
+        for r in range(self.world_size):
+            v = self.store.get(f"hb/{r}")
+            if v is not None:
+                try:
+                    out[r] = float(v)
+                except ValueError:
+                    pass
+        return out
+
+    def dead_peers(self) -> List[int]:
+        """Ranks whose lease expired (or never registered)."""
+        now = time.time()
+        hb = self.heartbeats()
+        return [r for r in range(self.world_size)
+                if r not in hb or now - hb[r] > self.lease]
+
+    def all_alive(self) -> bool:
+        return not self.dead_peers()
+
+    def wait_for_world(self, timeout: float = 30.0) -> bool:
+        """Block until every rank holds a live lease (rendezvous barrier for
+        membership, not steps)."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if self.all_alive():
+                return True
+            time.sleep(self.lease / 4)
+        return False
+
+    def watch(self, on_change: Callable[[List[int]], None],
+              interval: Optional[float] = None) -> threading.Thread:
+        """Poll membership; invoke ``on_change(dead_ranks)`` when a lease
+        expires (the etcd watch-callback analog, manager.py:238-255)."""
+        interval = interval or self.lease / 2
+
+        def loop():
+            healthy = True
+            while not self._stop.wait(interval):
+                dead = self.dead_peers()
+                if dead and healthy:
+                    healthy = False
+                    try:
+                        on_change(dead)
+                    except Exception:
+                        pass
+                elif not dead:
+                    healthy = True
+
+        t = threading.Thread(target=loop, daemon=True)
+        t.start()
+        return t

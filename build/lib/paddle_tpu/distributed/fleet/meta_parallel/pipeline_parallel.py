@@ -1,0 +1,63 @@
+"""PipelineParallel wrapper — parity with
+ref:python/paddle/distributed/fleet/meta_parallel/pipeline_parallel.py.
+
+The reference's ``train_batch`` interprets a 1F1B schedule over p2p ops
+(:154 warmup/steady/cooldown, interleaved variant :514). Here the schedule
+is already compiled into the PipelineLayer's forward (shard_map + scan +
+ppermute, see distributed/pipeline.py); ``train_batch`` just runs ONE
+compiled train step over the whole (micro-batched) global batch.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ....core.tensor import Tensor
+from ....nn.layer import Layer
+from .pp_layers import PipelineLayer
+
+
+class PipelineParallel(Layer):
+    def __init__(self, layers: PipelineLayer, hcg=None, strategy=None):
+        super().__init__()
+        if not isinstance(layers, PipelineLayer):
+            raise TypeError("PipelineParallel expects a PipelineLayer")
+        self._layers = layers
+        self._hcg = hcg
+        self._strategy = strategy
+        if strategy is not None:
+            acc = getattr(strategy, "pipeline_configs", {}).get("accumulate_steps", None)
+            # accumulate_steps=1 is the strategy default — don't clobber an
+            # explicitly configured num_microbatches with it
+            if acc and int(acc) > 1:
+                layers.num_microbatches = int(acc)
+        self._train_step = None
+        self._train_opt = None
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
+        """data = (inputs, labels); returns the (scalar Tensor) mean loss."""
+        x, y = data
+        if self._layers.loss_fn is None:
+            raise ValueError("PipelineLayer was built without a loss_fn")
+        if self._train_step is None or self._train_opt is not optimizer:
+            from ....jit import TrainStep
+
+            def loss_f(xi, yi):
+                out = self._layers(xi)
+                return self._layers.loss_fn(out, yi)
+
+            self._train_step = TrainStep(loss_f, optimizer, layers=self._layers)
+            self._train_opt = optimizer
+        loss = self._train_step(x, y)
+        if lr_scheduler is not None:
+            lr_scheduler.step()
+        return loss
+
+    def eval_batch(self, data, compute_loss=True):
+        x, y = data
+        out = self._layers(x)
+        if compute_loss:
+            return self._layers.loss_fn(out, y)
+        return out

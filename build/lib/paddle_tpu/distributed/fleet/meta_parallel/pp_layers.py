@@ -1,0 +1,204 @@
+"""Pipeline layer segmentation — API parity with
+ref:python/paddle/distributed/fleet/meta_parallel/parallel_layers/pp_layers.py
+(LayerDesc, SharedLayerDesc, PipelineLayer), redesigned for SPMD:
+
+The reference materializes only this rank's stage and hand-schedules p2p.
+Here every process holds the logical model; the homogeneous block run is
+stored stage-stacked (nn.StackedLayers) and executed through
+``pipeline_apply`` (shard_map over the "pipe" axis) when the mesh has pipe
+degree > 1 — the schedule is compiled, not interpreted.
+
+Segmentation contract: the layer list must contain one maximal run of
+structurally identical layers (the transformer blocks); layers before/after
+it (embedding / final norm / head) run under plain GSPMD on every stage.
+This covers the models PP is used for (GPT/BERT/ViT) without supporting
+arbitrary heterogeneous stage graphs.
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ....core import rng
+from ....core.dispatch import apply
+from ....core.tensor import Tensor
+from ....nn.layer import Layer
+from ....nn.stacked import StackedLayers
+from ... import mesh as mesh_mod
+from ...pipeline import PIPE_AXIS, pipeline_apply
+
+
+class LayerDesc:
+    def __init__(self, layer_cls, *args, **kwargs):
+        if not issubclass(layer_cls, Layer):
+            raise TypeError(f"LayerDesc expects a Layer subclass, got {layer_cls}")
+        self.layer_cls = layer_cls
+        self.args = args
+        self.kwargs = kwargs
+
+    def build_layer(self) -> Layer:
+        return self.layer_cls(*self.args, **self.kwargs)
+
+    def __repr__(self):
+        return f"LayerDesc({self.layer_cls.__name__})"
+
+
+class SharedLayerDesc(LayerDesc):
+    """Tied layer (e.g. embedding shared with the LM head,
+    ref:pp_layers.py SharedLayerDesc). In SPMD the tie is simply the same
+    Parameter object appearing twice; autodiff sums both grad paths."""
+
+    def __init__(self, key, layer_cls, *args, forward_func: Optional[Callable] = None,
+                 shared_weight_attr: str = "weight", **kwargs):
+        super().__init__(layer_cls, *args, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+def _param_signature(layer: Layer):
+    return tuple(
+        (name, tuple(p.shape), str(p.dtype)) for name, p in layer.named_parameters()
+    )
+
+
+class PipelineLayer(Layer):
+    def __init__(
+        self,
+        layers: List[LayerDesc],
+        num_stages: Optional[int] = None,
+        topology=None,
+        loss_fn=None,
+        seg_method: str = "uniform",
+        recompute_interval: int = 0,
+        num_virtual_pipeline_stages: Optional[int] = None,
+        num_microbatches: int = 1,
+    ):
+        super().__init__()
+        self.loss_fn = loss_fn
+        self.num_microbatches = num_microbatches
+        self.recompute = recompute_interval > 0
+
+        mesh = mesh_mod.get_mesh()
+        pipe = mesh.shape.get(PIPE_AXIS, 1) if mesh is not None else 1
+        self.num_stages = num_stages or pipe
+
+        # build all descs; shared keys reuse the first instance
+        shared: dict = {}
+        built: List[Layer] = []
+        self._forward_funcs: List[Optional[Callable]] = []
+        for d in layers:
+            if isinstance(d, SharedLayerDesc):
+                if d.layer_name in shared:
+                    inst = shared[d.layer_name]
+                else:
+                    inst = d.build_layer()
+                    shared[d.layer_name] = inst
+                self._forward_funcs.append(d.forward_func)
+            elif isinstance(d, LayerDesc):
+                inst = d.build_layer()
+                self._forward_funcs.append(None)
+            elif isinstance(d, Layer):
+                inst = d
+                self._forward_funcs.append(None)
+            else:
+                raise TypeError(f"expected LayerDesc or Layer, got {type(d)}")
+            built.append(inst)
+
+        # find the maximal run of structurally identical layers
+        sigs = [_param_signature(l) for l in built]
+        best = (0, 0)  # (length, start)
+        i = 0
+        while i < len(built):
+            j = i
+            while j + 1 < len(built) and sigs[j + 1] == sigs[i] and sigs[i]:
+                j += 1
+            if j - i + 1 > best[0]:
+                best = (j - i + 1, i)
+            i = j + 1
+        run_len, run_start = best
+        if run_len < self.num_stages:
+            raise ValueError(
+                f"homogeneous block run of length {run_len} cannot be split "
+                f"into {self.num_stages} pipeline stages"
+            )
+        if run_len % self.num_stages:
+            raise ValueError(
+                f"{run_len} blocks not divisible by {self.num_stages} stages"
+            )
+
+        self._pre = built[:run_start]
+        self._post = built[run_start + run_len:]
+        self._pre_fns = self._forward_funcs[:run_start]
+        self._post_fns = self._forward_funcs[run_start + run_len:]
+        blocks = built[run_start:run_start + run_len]
+        self.blocks = StackedLayers(lambda i: blocks[i], run_len, remat=self.recompute)
+        for k, l in enumerate(self._pre):
+            self.add_sublayer(f"pre_{k}", l)
+        for k, l in enumerate(self._post):
+            self.add_sublayer(f"post_{k}", l)
+
+    # ------------------------------------------------------------------
+    def get_num_stages(self):
+        return self.num_stages
+
+    def _run_section(self, layers, fns, x):
+        for l, f in zip(layers, fns):
+            x = f(l, x) if f is not None else l(x)
+        return x
+
+    def _pipe_fn(self):
+        if hasattr(self, "_pipe_fn_cached"):
+            return self._pipe_fn_cached
+        blocks = self.blocks
+        L = blocks.num_layers
+        S = self.num_stages
+        per = L // S
+        mesh = mesh_mod.ensure_mesh()
+        M = self.num_microbatches
+
+        def fn(h, key, *arrays):
+            trees = tuple(a.reshape((S, per) + a.shape[1:]) for a in arrays)
+
+            def stage_fn(local, hh):
+                s = jax.lax.axis_index(PIPE_AXIS)
+
+                def body(c, xs):
+                    idx, slices = xs[0], xs[1:]
+                    gidx = s * per + idx
+                    return blocks._apply_one(slices, c, jax.random.fold_in(key, gidx)), None
+
+                xs = (jnp.arange(per),) + local
+                return jax.lax.scan(body, hh, xs)[0]
+
+            return pipeline_apply(
+                stage_fn, trees, h, num_microbatches=M, mesh=mesh,
+                remat=self.recompute,
+            )
+
+        object.__setattr__(self, "_pipe_fn_cached", fn)
+        return fn
+
+    def forward(self, x):
+        mesh = mesh_mod.get_mesh()
+        pipe = mesh.shape.get(PIPE_AXIS, 1) if mesh is not None else 1
+        if pipe > 1 and pipe != self.num_stages:
+            raise ValueError(
+                f"PipelineLayer was built with num_stages={self.num_stages} "
+                f"but the mesh pipe degree is {pipe}; stage slices would be "
+                "silently dropped"
+            )
+        h = self._run_section(self._pre, self._pre_fns, x)
+        if pipe > 1:
+            if isinstance(h, Tensor) and not h._is_traced():
+                # eager: the shard_map operand must live on the mesh
+                from jax.sharding import NamedSharding, PartitionSpec
+
+                h._data = jax.device_put(h._data, NamedSharding(mesh, PartitionSpec()))
+            args = (h, Tensor(rng.next_key())) + tuple(self.blocks.stacked_parameters())
+            h = apply(self._pipe_fn(), args, {}, name="pipeline")
+        else:
+            h = self.blocks(h)
+        return self._run_section(self._post, self._post_fns, h)

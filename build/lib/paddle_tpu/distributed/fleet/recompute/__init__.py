@@ -1,0 +1,88 @@
+"""Activation recompute — parity with
+ref:python/paddle/distributed/fleet/recompute/recompute.py:57 (PyLayer-based
+replay with RNGStatesTracker) and recompute_hybrid.py (mp-aware offload).
+
+TPU-native: ``jax.checkpoint`` IS recompute — XLA rematerializes the wrapped
+region during the backward pass. The RNG contract (same dropout mask on
+replay) holds automatically because draws are pure functions of the traced
+key, so no state stashing is needed.
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+
+from ....core.tensor import Tensor
+from ....nn.layer import Layer
+
+_POLICIES = {
+    "full": jax.checkpoint_policies.nothing_saveable,
+    "full_attn": getattr(jax.checkpoint_policies, "dots_saveable",
+                         jax.checkpoint_policies.nothing_saveable),
+    "core_attn": getattr(jax.checkpoint_policies, "dots_with_no_batch_dims_saveable",
+                         jax.checkpoint_policies.nothing_saveable),
+}
+
+
+def recompute(function, *args, **kwargs):
+    """paddle.distributed.fleet.recompute.recompute parity: run ``function``
+    without saving intermediates; recompute them in backward.
+
+    Under a trace this is jax.checkpoint; in eager mode intermediates are
+    owned by the tape anyway, so the call is a plain invocation (matching the
+    reference's behavior of recompute being a no-op benefit-wise in pure
+    eager)."""
+    use_reentrant = kwargs.pop("use_reentrant", True)  # accepted, unused
+    preserve_rng_state = kwargs.pop("preserve_rng_state", True)  # automatic
+
+    traced = any(
+        isinstance(getattr(a, "_data", a), jax.core.Tracer)
+        for a in args
+        if isinstance(a, (Tensor, jax.Array)) or hasattr(a, "_data")
+    )
+    if not traced:
+        return function(*args, **kwargs)
+    fn = jax.checkpoint(function, policy=jax.checkpoint_policies.nothing_saveable)
+    return fn(*args, **kwargs)
+
+
+def recompute_hybrid(ctx, function, *args, **kwargs):
+    """recompute_hybrid parity (mp-aware offload config accepted via ctx and
+    ignored: XLA owns HBM scheduling on TPU)."""
+    return recompute(function, *args, **kwargs)
+
+
+def recompute_sequential(ctx, functions: Sequence, *args):
+    """Apply a list of layers with per-segment recompute
+    (≈ paddle.incubate.distributed.fleet.recompute_sequential)."""
+    segments = int(ctx.get("segments", 1)) if isinstance(ctx, dict) else 1
+    funcs = list(functions)
+    seg_len = max(1, len(funcs) // max(1, segments))
+    out = args
+    for i in range(0, len(funcs), seg_len):
+        seg = funcs[i:i + seg_len]
+
+        def run_seg(*xs, _seg=seg):
+            for f in _seg:
+                xs = f(*xs) if isinstance(xs, tuple) else f(xs)
+                if not isinstance(xs, tuple):
+                    xs = (xs,)
+            return xs if len(xs) > 1 else xs[0]
+
+        out = recompute(run_seg, *(out if isinstance(out, tuple) else (out,)))
+        if not isinstance(out, tuple):
+            out = (out,)
+    return out if len(out) > 1 else out[0]
+
+
+class RecomputeLayer(Layer):
+    """Wrap a sublayer so its forward always recomputes under trace."""
+
+    def __init__(self, inner: Layer, policy: str = "full"):
+        super().__init__()
+        self.inner = inner
+        self.policy = policy
+
+    def forward(self, *args, **kwargs):
+        return recompute(self.inner, *args, **kwargs)

@@ -1,0 +1,167 @@
+"""``python -m paddle_tpu.distributed.launch`` — the job launcher.
+
+Parity with ref:python/paddle/distributed/launch/main.py (CollectiveController
++ Master rendezvous + pod process management + log watcher + elastic
+restarts, ref:.../controllers/{collective,master}.py, manager.py):
+
+* spawns ``--nproc_per_node`` worker processes with the reference's env
+  contract: PADDLE_TRAINER_ID, PADDLE_TRAINERS_NUM, PADDLE_TRAINER_ENDPOINTS,
+  PADDLE_CURRENT_ENDPOINT, FLAGS_selected_devices;
+* per-rank log files under --log_dir; stdout of rank 0 tees through;
+* watches children — one failure kills the pod (controller.py behavior);
+* ``--elastic_level 1`` relaunches the pod up to --max_restart times
+  (ElasticManager role; TPU preemption story pairs with
+  distributed.checkpoint auto-resume).
+
+On TPU pods each host runs one worker per host (JAX single process per host
+owns all local chips); multi-host rendezvous goes through
+jax.distributed.initialize + the native TCPStore inside init_parallel_env.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+from typing import List, Optional
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def _parse(argv=None):
+    p = argparse.ArgumentParser(prog="paddle_tpu.distributed.launch")
+    p.add_argument("--master", default=None, help="rank0 host:port (default: auto local)")
+    p.add_argument("--nnodes", type=int, default=1)
+    p.add_argument("--hosts", default=os.environ.get("PADDLE_TRAINER_HOSTS"),
+                   help="comma-separated host list, one per node (required "
+                        "for --nnodes > 1); also read from PADDLE_TRAINER_HOSTS")
+    p.add_argument("--node_rank", type=int, default=int(os.environ.get("PADDLE_NODE_RANK", "0")))
+    p.add_argument("--nproc_per_node", type=int, default=1)
+    p.add_argument("--log_dir", default="log")
+    p.add_argument("--devices", "--gpus", default=None,
+                   help="comma-separated device ids for FLAGS_selected_devices")
+    p.add_argument("--job_id", default="default")
+    p.add_argument("--max_restart", type=int, default=3)
+    p.add_argument("--elastic_level", type=int, default=0)
+    p.add_argument("training_script")
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+class Pod:
+    def __init__(self, args):
+        self.args = args
+        self.procs: List[subprocess.Popen] = []
+        self.logs = []
+
+    def start(self) -> None:
+        a = self.args
+        os.makedirs(a.log_dir, exist_ok=True)
+        if a.master:
+            host, port = a.master.rsplit(":", 1)
+        else:
+            host, port = "127.0.0.1", str(_free_port())
+        n_local = a.nproc_per_node
+        world = a.nnodes * n_local
+        base = a.node_rank * n_local
+        if a.nnodes > 1:
+            if not a.master:
+                raise SystemExit(
+                    "--nnodes > 1 requires --master host:port (every node "
+                    "must agree on the rendezvous address and port base)")
+            node_hosts = [h.strip() for h in (a.hosts or "").split(",") if h.strip()]
+            if len(node_hosts) != a.nnodes:
+                raise SystemExit(
+                    f"--nnodes={a.nnodes} requires --hosts (or "
+                    f"PADDLE_TRAINER_HOSTS) with exactly {a.nnodes} "
+                    f"comma-separated hosts; got {a.hosts!r}")
+        else:
+            node_hosts = [host]
+        endpoints = []
+        for node in range(a.nnodes):
+            for i in range(n_local):
+                endpoints.append(
+                    f"{node_hosts[node]}:{int(port) + node * n_local + i}")
+        devices = (a.devices.split(",") if a.devices
+                   else [str(i) for i in range(n_local)])
+        for local_rank in range(n_local):
+            rank = base + local_rank
+            env = dict(os.environ)
+            env.update({
+                "PADDLE_TRAINER_ID": str(rank),
+                "PADDLE_TRAINERS_NUM": str(world),
+                "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
+                "PADDLE_CURRENT_ENDPOINT": endpoints[rank],
+                "PADDLE_MASTER": f"{host}:{port}",
+                "FLAGS_selected_devices": devices[local_rank % len(devices)],
+                "PADDLE_LOCAL_RANK": str(local_rank),
+            })
+            log_path = os.path.join(a.log_dir, f"workerlog.{local_rank}")
+            logf = open(log_path, "ab", buffering=0)
+            self.logs.append(logf)
+            stdout = None if rank == 0 else logf  # rank0 tees to console
+            proc = subprocess.Popen(
+                [sys.executable, a.training_script] + a.training_script_args,
+                env=env, stdout=stdout, stderr=subprocess.STDOUT if rank else None,
+            )
+            self.procs.append(proc)
+
+    def watch(self) -> int:
+        """Block until all exit (0) or any fails (kill pod, return its code)."""
+        while True:
+            alive = False
+            for p in self.procs:
+                code = p.poll()
+                if code is None:
+                    alive = True
+                elif code != 0:
+                    self.stop()
+                    return code
+            if not alive:
+                return 0
+            time.sleep(0.5)
+
+    def stop(self) -> None:
+        for p in self.procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        deadline = time.time() + 10
+        for p in self.procs:
+            try:
+                p.wait(timeout=max(0.1, deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+        for f in self.logs:
+            try:
+                f.close()
+            except Exception:
+                pass
+        self.logs.clear()
+
+
+def launch(argv: Optional[List[str]] = None) -> int:
+    args = _parse(argv)
+    restarts = 0
+    while True:
+        pod = Pod(args)
+        pod.start()
+        code = pod.watch()
+        if code == 0:
+            return 0
+        if args.elastic_level > 0 and restarts < args.max_restart:
+            restarts += 1
+            print(f"[launch] pod failed (exit {code}); elastic restart "
+                  f"{restarts}/{args.max_restart}", file=sys.stderr)
+            continue
+        return code
+
+
+def main():
+    sys.exit(launch())

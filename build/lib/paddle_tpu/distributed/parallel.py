@@ -1,0 +1,153 @@
+"""Parallel environment + DataParallel.
+
+Replaces ``init_parallel_env`` (ref:python/paddle/distributed/parallel.py:915
+— TCPStore rendezvous + ProcessGroupNCCL) and ``paddle.DataParallel``
+(ref:python/paddle/distributed/parallel.py:366 + EagerReducer grad bucketing,
+ref:paddle/fluid/distributed/collective/reducer.cc).
+
+TPU-native: rendezvous is ``jax.distributed.initialize`` (coordination
+service over DCN ≈ TCPStore); gradient synchronization is not a runtime
+bucketing engine — batches are sharded over the mesh "data" axis and XLA
+inserts the cross-replica reduction into the compiled step (the psum rides
+ICI, overlapped by the scheduler — what EagerReducer's comm-stream overlap
+hand-builds).
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ..core.tensor import Tensor
+from ..nn.layer import Layer
+from . import env, mesh as mesh_mod
+from .collective import Group, _get_default_group
+
+_initialized = False
+
+
+def init_parallel_env() -> Optional[Group]:
+    """Initialize the distributed environment.
+
+    Multi-process (launcher-spawned, PADDLE_TRAINER_ENDPOINTS set with >1
+    entries): wires jax.distributed (coordinator = rank 0's endpoint).
+    Single-process: just installs the default mesh over local devices.
+    """
+    global _initialized
+    if _initialized:
+        return _get_default_group()
+    # env-var checks ONLY before jax.distributed.initialize — any jax call
+    # that initializes the XLA backend first would poison multi-host init
+    eps = env.get_endpoints()
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    if len(eps) > 1 and os.environ.get("PADDLE_TPU_DIST_INIT", "1") == "1":
+        try:
+            jax.distributed.initialize(
+                coordinator_address=eps[0],
+                num_processes=len(eps),
+                process_id=rank,
+            )
+        except Exception as e:  # already initialized / single-host tests
+            if "already" not in str(e).lower():
+                raise
+        # host-side KV rendezvous (native TCPStore, ≈ ref parallel.py:1076):
+        # rank 0 hosts; all ranks barrier before touching devices
+        if os.environ.get("PADDLE_TPU_STORE", "1") == "1":
+            try:
+                from .store import TCPStore
+
+                host, port = eps[0].rsplit(":", 1)
+                store = TCPStore(host, int(port) + 1, is_master=(rank == 0),
+                                 world_size=len(eps))
+                store.set(f"rank/{rank}", str(rank))
+                store.barrier("init")
+                env._store = store
+            except Exception:
+                env._store = None  # jax.distributed already synced us
+    mesh_mod.ensure_mesh()
+    _initialized = True
+    return _get_default_group()
+
+
+def get_rank() -> int:
+    return env.get_rank()
+
+
+def get_world_size() -> int:
+    return env.get_world_size()
+
+
+def shard_batch(t, axis: str = "data", batch_dim: int = 0):
+    """Place a host batch onto the mesh, sharded along ``axis`` at
+    ``batch_dim`` (the DP input contract; DistributedBatchSampler analog for
+    the single-controller model)."""
+    mesh = mesh_mod.ensure_mesh()
+    if mesh.shape.get(axis, 1) <= 1:
+        return t
+    data = t._data if isinstance(t, Tensor) else jnp.asarray(t)
+    spec = [None] * data.ndim
+    spec[batch_dim] = axis
+    arr = jax.device_put(data, NamedSharding(mesh, PartitionSpec(*spec)))
+    if isinstance(t, Tensor):
+        return Tensor(arr, stop_gradient=t.stop_gradient)
+    return Tensor(arr)
+
+
+class DataParallel(Layer):
+    """paddle.DataParallel parity wrapper.
+
+    Forward shards the inputs' batch dim over the "data" mesh axis and
+    constrains parameters replicated; the compiled training step then runs
+    SPMD with XLA-inserted gradient reductions. ``find_unused_parameters`` /
+    bucketing knobs are accepted for API parity and ignored (the compiler
+    handles dead grads and fusion).
+    """
+
+    def __init__(
+        self,
+        layers: Layer,
+        strategy=None,
+        comm_buffer_size: int = 25,
+        last_comm_buffer_size: int = 1,
+        find_unused_parameters: bool = False,
+        group: Optional[Group] = None,
+    ):
+        super().__init__()
+        self._layers = layers
+        self._group = group
+        init_parallel_env()
+        mesh = mesh_mod.ensure_mesh()
+        # replicate parameters across the data axis (device_put once, eager)
+        if mesh.shape.get("data", 1) > 1:
+            repl = NamedSharding(mesh, PartitionSpec())
+            for _, p in layers.named_parameters():
+                if not p._is_traced():
+                    p._data = jax.device_put(p._data, repl)
+
+    def forward(self, *inputs, **kwargs):
+        inputs = tuple(
+            shard_batch(x) if isinstance(x, Tensor) and not x._is_traced() else x for x in inputs
+        )
+        return self._layers(*inputs, **kwargs)
+
+    def scale_loss(self, loss):
+        return loss  # XLA mean-reduces across replicas; no manual scaling
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, sd, *a, **k):
+        return self._layers.set_state_dict(sd, *a, **k)
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        return self._layers.named_parameters(prefix, include_sublayers)
+
+    def __getattr__(self, name):
+        # only called when normal lookup fails: delegate to the wrapped layer
+        return getattr(self.__dict__["_sub_layers"]["_layers"], name)

@@ -1,0 +1,140 @@
+"""Pipeline parallelism — compiled GPipe/1F1B over the "pipe" mesh axis.
+
+The reference implements PP as a runtime: a hand-written 1F1B schedule
+(ref:python/paddle/distributed/fleet/meta_parallel/pipeline_parallel.py:154,
+271) driving per-microbatch send_partial/recv_partial p2p ops
+(ref:.../pp_utils/p2p_communication.py:206) between rank processes, plus the
+FleetExecutor actor runtime for static graphs.
+
+TPU-native redesign: the pipeline is ONE differentiable program.
+
+* Stage weights are stacked along a leading stage dimension and sharded over
+  the "pipe" mesh axis.
+* The schedule is a ``lax.scan`` over M + S - 1 clock ticks inside a
+  partial-manual ``shard_map`` (manual only over "pipe"; data/model/sharding
+  axes stay under GSPMD inside each stage).
+* The per-tick hop between stages is ``lax.ppermute`` — the compiled form of
+  the reference's p2p send/recv. Autodiff through scan+ppermute *derives*
+  the backward pipeline (reverse ppermute), so there is no hand-written 1F1B
+  backward pass to get wrong; XLA overlaps the forward of microbatch i+1
+  with the backward of microbatch i exactly as 1F1B does.
+* ``jax.checkpoint`` on the stage body keeps activation memory at
+  O(microbatch) like the reference's recompute-in-pipeline mode.
+
+Bubble fraction is the GPipe (S-1)/(M+S-1); choose M >= 4*S like the
+reference's accumulate_steps guidance.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from . import mesh as mesh_mod
+
+PIPE_AXIS = "pipe"
+
+
+def stack_stage_params(param_arrays, num_stages: int, mesh: Optional[Mesh] = None):
+    """Stack per-stage pytrees (list of length S of identical-structure
+    pytrees) into stage-major arrays sharded over the pipe axis."""
+    mesh = mesh or mesh_mod.ensure_mesh()
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *param_arrays)
+
+    def _place(x):
+        spec = (PIPE_AXIS,) + (None,) * (x.ndim - 1)
+        return jax.device_put(x, NamedSharding(mesh, PartitionSpec(*spec)))
+
+    if mesh.shape.get(PIPE_AXIS, 1) > 1:
+        stacked = jax.tree.map(_place, stacked)
+    return stacked
+
+
+def pipeline_apply(
+    stage_fn: Callable,
+    stage_params,
+    x,
+    *,
+    num_microbatches: int,
+    mesh: Optional[Mesh] = None,
+    remat: bool = True,
+):
+    """Run ``x`` through S pipeline stages.
+
+    ``stage_fn(local_params, h) -> h`` — one stage's computation. Its
+    ``local_params`` pytree has the *leading stage dimension stripped*
+    (each pipe rank sees its own stage's slice).
+
+    ``stage_params`` — pytree with leading dim S on every leaf, sharded over
+    the "pipe" axis (see :func:`stack_stage_params`).
+
+    ``x`` — [B, ...] global batch; B must divide by num_microbatches.
+    Returns [B, ...] outputs of the final stage (replicated over pipe).
+    """
+    mesh = mesh or mesh_mod.ensure_mesh()
+    S = mesh.shape.get(PIPE_AXIS, 1)
+    M = num_microbatches
+    if x.shape[0] % M:
+        raise ValueError(f"batch {x.shape[0]} not divisible by {M} microbatches")
+
+    body = stage_fn
+    if remat:
+        body = jax.checkpoint(stage_fn, policy=jax.checkpoint_policies.nothing_saveable)
+
+    if S <= 1:  # no pipe axis: plain microbatch loop (keeps semantics/shapes)
+        local = jax.tree.map(lambda a: a[0], stage_params)
+        mb = x.reshape((M, x.shape[0] // M) + x.shape[1:])
+        ys = jax.lax.map(lambda h: body(local, h), mb)
+        return ys.reshape(x.shape[:1] + ys.shape[2:])
+
+    def _pipelined(params, xb):
+        # params leaves: [S_local=1, ...] (manual over pipe) -> strip
+        local = jax.tree.map(lambda a: a[0], params)
+        rank = jax.lax.axis_index(PIPE_AXIS)
+        mb_sz = xb.shape[0] // M
+        x_mb = xb.reshape((M, mb_sz) + xb.shape[1:])
+
+        # initial carries become stage-varying after the first tick; mark them
+        state = jax.lax.pcast(jnp.zeros_like(x_mb[0]), (PIPE_AXIS,), to="varying")
+        outputs = jax.lax.pcast(jnp.zeros_like(x_mb), (PIPE_AXIS,), to="varying")
+        fwd_perm = [(i, (i + 1) % S) for i in range(S)]
+
+        def tick(carry, t):
+            state, outputs = carry
+            # stage 0 injects microbatch t (clamped; masked by is-first-stage)
+            inject = jax.lax.dynamic_index_in_dim(
+                x_mb, jnp.clip(t, 0, M - 1), axis=0, keepdims=False
+            )
+            h = jnp.where(rank == 0, inject, state)
+            h = body(local, h)
+            # last stage owns microbatch t-(S-1) once t >= S-1
+            out_idx = jnp.clip(t - (S - 1), 0, M - 1)
+            take = jnp.logical_and(rank == S - 1, t >= S - 1)
+            cur = jax.lax.dynamic_index_in_dim(outputs, out_idx, 0, keepdims=False)
+            new = jnp.where(take, h, cur)
+            outputs = jax.lax.dynamic_update_index_in_dim(outputs, new, out_idx, 0)
+            # rotate activations one stage forward (compiled p2p hop)
+            state = jax.lax.ppermute(h, PIPE_AXIS, fwd_perm)
+            return (state, outputs), None
+
+        (state, outputs), _ = jax.lax.scan(tick, (state, outputs), jnp.arange(M + S - 1))
+        # replicate the last stage's outputs to every pipe rank
+        mask = (rank == S - 1).astype(outputs.dtype)
+        outputs = jax.lax.psum(outputs * mask, PIPE_AXIS)
+        return outputs.reshape(xb.shape[:1] + outputs.shape[2:])
+
+    in_specs = (
+        jax.tree.map(lambda _: PartitionSpec(PIPE_AXIS), stage_params),
+        PartitionSpec(),
+    )
+    fn = jax.shard_map(
+        _pipelined,
+        mesh=mesh,
+        in_specs=in_specs,
+        out_specs=PartitionSpec(),
+        axis_names={PIPE_AXIS},
+        check_vma=True,  # partial-manual mode requires vma tracking
+    )
+    return fn(stage_params, x)

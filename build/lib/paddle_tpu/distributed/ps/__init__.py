@@ -1,0 +1,280 @@
+"""Parameter-server mode: host-RAM sparse embedding tables over DCN.
+
+The reference trains "100-billion-feature" recommenders by keeping sparse
+embedding tables on parameter servers (ref:paddle/fluid/distributed/ps/,
+ref:python/paddle/distributed/ps/the_one_ps.py:1031). TPU-native redesign:
+
+* dense parameters live in HBM and train in the compiled XLA step;
+* sparse tables live in host RAM behind ``embedding_service.cc`` servers
+  (C++, lazy rows + server-side sparse SGD/Adagrad/Adam rules, save/load);
+* a table is *sharded by feature hash across servers*; workers pull the
+  unique rows of each batch, run the device step, and push per-row grads
+  (the geo-async communicator pattern, without brpc).
+
+Capacity therefore scales with aggregate host RAM, not HBM: a table bigger
+than one chip's HBM is just a bigger std::unordered_map spread over hosts.
+
+User surface:
+  EmbeddingService  — start/stop a group of table servers (one per shard)
+  SparseTableClient — sharded pull/push/save/load client
+  PSEmbedding       — nn.Layer; forward pulls rows, backward pushes grads
+                      (a PyLayer: the table is *not* a device parameter)
+  init_from_env / start_local_cluster — the_one_ps-style orchestration
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ... import nn
+from ...core.autograd import PyLayer
+from ...core.tensor import Tensor
+
+RULE_SGD = 0
+RULE_ADAGRAD = 1
+RULE_ADAM = 2
+_RULES = {"sgd": RULE_SGD, "adagrad": RULE_ADAGRAD, "adam": RULE_ADAM}
+
+
+def _lib():
+    from ... import native
+
+    return native.load()
+
+
+class EmbeddingServer:
+    """One in-process table shard server (C++ threads; GIL-free serving)."""
+
+    def __init__(self, dim: int, rule: str = "sgd", port: int = 0,
+                 init_range: float = 0.01, seed: int = 42):
+        self._lib = _lib()
+        self._h = self._lib.pt_emb_server_start(
+            port, dim, _RULES[rule], ctypes.c_float(init_range), seed)
+        if not self._h:
+            raise RuntimeError("failed to start embedding server")
+        self.port = self._lib.pt_emb_server_port(self._h)
+        self.dim = dim
+
+    @property
+    def num_rows(self) -> int:
+        return int(self._lib.pt_emb_server_rows(self._h))
+
+    @property
+    def bytes(self) -> int:
+        return int(self._lib.pt_emb_server_bytes(self._h))
+
+    def stop(self):
+        if self._h:
+            self._lib.pt_emb_server_stop(self._h)
+            self._h = None
+
+    def __del__(self):  # pragma: no cover
+        try:
+            self.stop()
+        except Exception:
+            pass
+
+
+class SparseTableClient:
+    """Sharded client: routes each feature id to ``endpoints[hash % n]``.
+
+    The pull path dedups ids first (the PS client's unique-key merge in the
+    reference communicator), so a batch with repeated features costs one row
+    fetch per distinct feature.
+    """
+
+    def __init__(self, endpoints: Sequence[str], dim: int, timeout_ms: int = 10000):
+        self._lib = _lib()
+        self.dim = dim
+        self.endpoints = list(endpoints)
+        self._conns = []
+        for ep in self.endpoints:
+            host, port = ep.rsplit(":", 1)
+            h = self._lib.pt_emb_connect(host.encode(), int(port), timeout_ms)
+            if not h:
+                raise RuntimeError(f"cannot connect to embedding server {ep}")
+            self._conns.append(h)
+
+    def _route(self, ids: np.ndarray) -> np.ndarray:
+        # splitmix scramble so server load is even for clustered ids
+        h = (ids.astype(np.uint64) * np.uint64(0x9E3779B97F4A7C15)) >> np.uint64(33)
+        return (h % np.uint64(len(self._conns))).astype(np.int64)
+
+    def pull(self, ids: np.ndarray) -> np.ndarray:
+        """ids [n] uint64 -> rows [n, dim] float32 (lazy-initialized)."""
+        ids = np.ascontiguousarray(ids, dtype=np.uint64)
+        n = len(ids)
+        out = np.empty((n, self.dim), np.float32)
+        shard = self._route(ids)
+        for s, conn in enumerate(self._conns):
+            sel = np.nonzero(shard == s)[0]
+            if not len(sel):
+                continue
+            sub = np.ascontiguousarray(ids[sel])
+            rows = np.empty((len(sel), self.dim), np.float32)
+            rc = self._lib.pt_emb_pull(
+                conn, sub.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+                len(sel), self.dim,
+                rows.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+            if rc != 0:
+                raise RuntimeError(f"pull failed on shard {s}")
+            out[sel] = rows
+        return out
+
+    def push(self, ids: np.ndarray, grads: np.ndarray, lr: float):
+        """Apply the server-side optimizer rule for each (id, grad) row."""
+        ids = np.ascontiguousarray(ids, dtype=np.uint64)
+        grads = np.ascontiguousarray(grads, dtype=np.float32)
+        shard = self._route(ids)
+        for s, conn in enumerate(self._conns):
+            sel = np.nonzero(shard == s)[0]
+            if not len(sel):
+                continue
+            sub = np.ascontiguousarray(ids[sel])
+            g = np.ascontiguousarray(grads[sel])
+            rc = self._lib.pt_emb_push(
+                conn, sub.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+                len(sel), self.dim,
+                g.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                ctypes.c_float(lr))
+            if rc != 0:
+                raise RuntimeError(f"push failed on shard {s}")
+
+    def save(self, path_prefix: str):
+        """Each shard dumps to ``{prefix}.shard{i}`` (fleet.save_persistables)."""
+        for i, conn in enumerate(self._conns):
+            if self._lib.pt_emb_save(conn, f"{path_prefix}.shard{i}".encode()) != 0:
+                raise RuntimeError(f"save failed on shard {i}")
+
+    def load(self, path_prefix: str):
+        for i, conn in enumerate(self._conns):
+            if self._lib.pt_emb_load(conn, f"{path_prefix}.shard{i}".encode()) != 0:
+                raise RuntimeError(f"load failed on shard {i}")
+
+    def stats(self):
+        """Aggregate (num_rows, bytes) over shards."""
+        rows = bytes_ = 0
+        buf = (ctypes.c_uint64 * 2)()
+        for i, conn in enumerate(self._conns):
+            if self._lib.pt_emb_stats(conn, buf) != 0:
+                raise RuntimeError(f"stats failed on shard {i}")
+            rows += buf[0]
+            bytes_ += buf[1]
+        return rows, bytes_
+
+    def clear(self):
+        for conn in self._conns:
+            self._lib.pt_emb_clear(conn)
+
+    def close(self):
+        for conn in self._conns:
+            self._lib.pt_emb_disconnect(conn)
+        self._conns = []
+
+
+class _PullPush(PyLayer):
+    """forward = pull rows for (deduped) ids; backward = push row grads.
+
+    The table is not a device parameter: its "gradient update" happens
+    server-side at push time, so backward returns no input grads.
+    """
+
+    @staticmethod
+    def forward(ctx, ids_t, client, lr_fn):
+        ids = np.asarray(ids_t.numpy()).astype(np.uint64)
+        flat = ids.reshape(-1)
+        uniq, inverse = np.unique(flat, return_inverse=True)
+        rows = client.pull(uniq)                       # [u, dim]
+        out = rows[inverse].reshape(ids.shape + (client.dim,))
+        ctx.uniq, ctx.inverse = uniq, inverse
+        ctx.client, ctx.lr_fn = client, lr_fn
+        ctx.shape = ids.shape
+        return Tensor(out)
+
+    @staticmethod
+    def backward(ctx, d_out):
+        g = np.asarray(d_out.numpy(), np.float32).reshape(-1, ctx.client.dim)
+        # sum duplicate-id grads (the communicator's merge_add)
+        merged = np.zeros((len(ctx.uniq), ctx.client.dim), np.float32)
+        np.add.at(merged, ctx.inverse, g)
+        ctx.client.push(ctx.uniq, merged, float(ctx.lr_fn()))
+        return None  # ids are integer: no grad
+
+
+class PSEmbedding(nn.Layer):
+    """Sparse embedding lookup served from host-RAM table shards.
+
+    Drop-in for ``DistributedEmbedding`` when the table exceeds device memory
+    (the reference's memory_sparse_table path). Rows are created lazily on
+    first touch — the id space can be the full 64-bit feature-hash space, no
+    vocab size is declared.
+    """
+
+    def __init__(self, client: SparseTableClient, learning_rate: float = 0.01):
+        super().__init__()
+        self.client = client
+        self.learning_rate = learning_rate
+
+    def forward(self, ids):
+        x = ids if isinstance(ids, Tensor) else Tensor(np.asarray(ids))
+        out = _PullPush.apply(_mark_diff(x), self.client, lambda: self.learning_rate)
+        return out
+
+
+def _mark_diff(ids: Tensor) -> Tensor:
+    """PyLayer only records when some input requires grad; int ids never do,
+    so thread a zero-size float sentinel through stop_gradient."""
+    t = Tensor(ids._data, stop_gradient=False)
+    return t
+
+
+# ---------------------------------------------------------------- orchestration
+
+
+class EmbeddingService:
+    """A group of table-shard servers living in this process (one host)."""
+
+    def __init__(self, dim: int, num_shards: int = 1, rule: str = "sgd",
+                 init_range: float = 0.01, seed: int = 42):
+        self.servers = [
+            EmbeddingServer(dim, rule=rule, init_range=init_range, seed=seed + i)
+            for i in range(num_shards)
+        ]
+        self.endpoints = [f"127.0.0.1:{s.port}" for s in self.servers]
+        self.dim = dim
+
+    def client(self) -> SparseTableClient:
+        return SparseTableClient(self.endpoints, self.dim)
+
+    def stop(self):
+        for s in self.servers:
+            s.stop()
+
+
+def start_local_cluster(dim: int, num_shards: int = 2, rule: str = "sgd",
+                        **kw) -> EmbeddingService:
+    """Test/dev helper: all shards in-process (C++ threads serve requests)."""
+    return EmbeddingService(dim, num_shards, rule=rule, **kw)
+
+
+def init_from_env(dim: int, timeout_ms: int = 30000) -> SparseTableClient:
+    """Worker-side init from the launcher env contract.
+
+    ``PADDLE_PSERVER_ENDPOINTS`` (comma-separated host:port) names the table
+    shards, mirroring the reference's fleet PS env
+    (ref:python/paddle/distributed/ps/the_one_ps.py).
+    """
+    eps = os.environ.get("PADDLE_PSERVER_ENDPOINTS", "")
+    if not eps:
+        raise RuntimeError("PADDLE_PSERVER_ENDPOINTS not set")
+    return SparseTableClient(eps.split(","), dim, timeout_ms=timeout_ms)
+
+
+def run_server(dim: int, port: int, rule: str = "sgd", init_range: float = 0.01,
+               seed: int = 42) -> EmbeddingServer:
+    """Server-side: host one table shard on ``port`` (fleet.run_server)."""
+    return EmbeddingServer(dim, rule=rule, port=port, init_range=init_range,
+                           seed=seed)

@@ -1,0 +1,81 @@
+"""Sharding helpers shared by TP/PP/ZeRO layers.
+
+The reference moves data with explicit collective ops (c_allreduce/c_concat/
+c_split, ref:paddle/fluid/operators/collective/); TPU-native we *annotate*:
+parameters are device_put with a NamedSharding, activations get
+``with_sharding_constraint`` under trace, and XLA's SPMD partitioner inserts
+the ICI collectives (SURVEY.md §7: "GSPMD sharding annotations give DP/TP/
+sharding for free").
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ..core.tensor import Tensor
+from . import mesh as mesh_mod
+
+
+def _mesh() -> Mesh:
+    return mesh_mod.ensure_mesh()
+
+
+def _prune_spec(mesh: Mesh, spec):
+    """Drop axis names that aren't on the mesh or have size 1."""
+    out = []
+    for entry in spec:
+        if entry is None:
+            out.append(None)
+        elif isinstance(entry, (tuple, list)):
+            kept = tuple(a for a in entry if mesh.shape.get(a, 1) > 1)
+            out.append(kept if kept else None)
+        else:
+            out.append(entry if mesh.shape.get(entry, 1) > 1 else None)
+    return tuple(out)
+
+
+def shard_parameter(p: Tensor, *spec, mesh: Optional[Mesh] = None) -> Tensor:
+    """Place a parameter on the mesh with the given PartitionSpec (eager).
+    jit infers in_shardings from committed arrays, so this single device_put
+    is all the 'dist_attr annotation' a compiled step needs.
+
+    No-op when no mesh was installed (single-chip eager mode) — placing
+    params on an implicit mesh would strand them away from host inputs."""
+    mesh = mesh or mesh_mod.get_mesh()
+    if mesh is None:
+        return p
+    spec = _prune_spec(mesh, spec)
+    if not p._is_traced():
+        p._data = jax.device_put(p._data, NamedSharding(mesh, PartitionSpec(*spec)))
+    return p
+
+
+def constraint(x, *spec, mesh: Optional[Mesh] = None):
+    """Activation sharding constraint: under trace emits
+    with_sharding_constraint; eager re-places the array."""
+    mesh = mesh or mesh_mod.get_mesh()
+    if mesh is None:
+        return x
+    spec = _prune_spec(mesh, spec)
+    t = isinstance(x, Tensor)
+    arr = x._data if t else x
+    ns = NamedSharding(mesh, PartitionSpec(*spec))
+    if isinstance(arr, jax.core.Tracer):
+        # inside a shard_map manual region (e.g. the pipeline stage body)
+        # the value is manual-axis-varying; a full-mesh constraint is
+        # ill-typed there — let GSPMD propagate from the operands instead
+        if getattr(getattr(arr, "aval", None), "vma", None):
+            return x
+        out = jax.lax.with_sharding_constraint(arr, ns)
+    else:
+        out = jax.device_put(arr, ns)
+    if t:
+        x._data = out
+        return x
+    return out
+
+
+def replicate(x, mesh: Optional[Mesh] = None):
+    return constraint(x, mesh=mesh)

@@ -1,0 +1,81 @@
+"""TCPStore — Python face of the native C++ KV store (native/csrc/kvstore.cc).
+
+API parity with the reference's core.TCPStore used by init_parallel_env
+(ref:python/paddle/distributed/parallel.py:1076; C++ impl
+ref:paddle/phi/core/distributed/store/tcp_store.h:120): rank 0 hosts, all
+ranks set/get/wait/add; barrier() blocks until world_size hits."""
+from __future__ import annotations
+
+import ctypes
+from typing import Optional
+
+from ..native import load as _load_native
+
+
+class TCPStore:
+    def __init__(self, host: str, port: int, is_master: bool = False,
+                 world_size: int = 1, timeout: int = 30000):
+        self._lib = _load_native()
+        self._server = None
+        self._world_size = world_size
+        if is_master:
+            self._server = self._lib.pt_store_server_start(port, world_size)
+            if not self._server:
+                raise RuntimeError(f"TCPStore: cannot bind port {port}")
+            port = self._lib.pt_store_server_port(self._server)
+        self._port = port
+        self._client = self._lib.pt_store_connect(
+            host.encode() if host != "localhost" else b"127.0.0.1", port, timeout)
+        if not self._client:
+            if self._server:
+                self._lib.pt_store_server_stop(self._server)
+            raise RuntimeError(f"TCPStore: cannot connect to {host}:{port}")
+        self._barrier_seq = 0
+
+    @property
+    def port(self) -> int:
+        return self._port
+
+    def set(self, key: str, value) -> None:
+        data = value if isinstance(value, bytes) else str(value).encode()
+        if self._lib.pt_store_set(self._client, key.encode(), data, len(data)) != 0:
+            raise RuntimeError("TCPStore.set failed")
+
+    def get(self, key: str) -> Optional[bytes]:
+        buf = ctypes.create_string_buffer(1 << 20)
+        n = self._lib.pt_store_get(self._client, key.encode(), buf, len(buf))
+        if n == -2:
+            raise RuntimeError("TCPStore.get transport error")
+        if n < 0:
+            return None
+        return buf.raw[:n]
+
+    def wait(self, key: str) -> bytes:
+        buf = ctypes.create_string_buffer(1 << 20)
+        n = self._lib.pt_store_wait(self._client, key.encode(), buf, len(buf))
+        if n < 0:
+            raise RuntimeError("TCPStore.wait failed")
+        return buf.raw[:n]
+
+    def add(self, key: str, delta: int = 1) -> int:
+        return int(self._lib.pt_store_add(self._client, key.encode(), delta))
+
+    def barrier(self, tag: str = "") -> None:
+        self._barrier_seq += 1
+        key = f"__barrier__{tag}_{self._barrier_seq}"
+        if self._lib.pt_store_barrier(self._client, key.encode()) != 0:
+            raise RuntimeError("TCPStore.barrier failed")
+
+    def close(self) -> None:
+        if self._client:
+            self._lib.pt_store_disconnect(self._client)
+            self._client = None
+        if self._server:
+            self._lib.pt_store_server_stop(self._server)
+            self._server = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -1,0 +1,426 @@
+"""Probability distributions — parity with ref:python/paddle/distribution/
+(Distribution base, Normal, Uniform, Bernoulli, Beta, Categorical,
+Dirichlet, Exponential, Gamma, Geometric, Gumbel, Laplace, LogNormal,
+Multinomial, Poisson, StudentT, and kl_divergence).
+
+Backed by jax.random sampling and jax.scipy log-probability math; all
+methods accept/return paddle_tpu Tensors.
+"""
+from __future__ import annotations
+
+import math
+from typing import Dict, Tuple, Type
+
+import jax
+import jax.numpy as jnp
+import jax.scipy.special as jsp
+
+from ..core import rng
+from ..core.tensor import Tensor
+
+
+def _arr(x):
+    if isinstance(x, Tensor):
+        return x._data
+    return jnp.asarray(x, jnp.float32) if not isinstance(x, jnp.ndarray) else x
+
+
+def _t(x):
+    return Tensor(x)
+
+
+class Distribution:
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        return self.sample(shape)
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return _t(jnp.exp(_arr(self.log_prob(value))))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        return kl_divergence(self, other)
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    @property
+    def mean(self):
+        return _t(jnp.broadcast_to(self.loc, self.batch_shape))
+
+    @property
+    def variance(self):
+        return _t(jnp.broadcast_to(self.scale ** 2, self.batch_shape))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        z = jax.random.normal(rng.next_key(), shape)
+        return _t(self.loc + self.scale * z)
+
+    def log_prob(self, value):
+        v = _arr(value)
+        var = self.scale ** 2
+        return _t(-((v - self.loc) ** 2) / (2 * var)
+                  - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi))
+
+    def entropy(self):
+        return _t(0.5 + 0.5 * math.log(2 * math.pi) +
+                  jnp.log(jnp.broadcast_to(self.scale, self.batch_shape)))
+
+    def cdf(self, value):
+        return _t(0.5 * (1 + jsp.erf((_arr(value) - self.loc) /
+                                     (self.scale * math.sqrt(2)))))
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _arr(low)
+        self.high = _arr(high)
+        super().__init__(jnp.broadcast_shapes(self.low.shape, self.high.shape))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        u = jax.random.uniform(rng.next_key(), shape)
+        return _t(self.low + (self.high - self.low) * u)
+
+    def log_prob(self, value):
+        v = _arr(value)
+        inside = jnp.logical_and(v >= self.low, v < self.high)
+        lp = -jnp.log(self.high - self.low)
+        return _t(jnp.where(inside, lp, -jnp.inf))
+
+    def entropy(self):
+        return _t(jnp.log(self.high - self.low))
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs=None, logits=None, name=None):
+        if (probs is None) == (logits is None):
+            raise ValueError("pass exactly one of probs/logits")
+        if probs is None:
+            self.logits = _arr(logits)
+            self.probs = jax.nn.sigmoid(self.logits)
+        else:
+            self.probs = _arr(probs)
+            self.logits = jnp.log(self.probs) - jnp.log1p(-self.probs)
+        super().__init__(self.probs.shape)
+
+    @property
+    def mean(self):
+        return _t(self.probs)
+
+    @property
+    def variance(self):
+        return _t(self.probs * (1 - self.probs))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        return _t(jax.random.bernoulli(rng.next_key(), self.probs, shape)
+                  .astype(jnp.float32))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        return _t(v * jax.nn.log_sigmoid(self.logits)
+                  + (1 - v) * jax.nn.log_sigmoid(-self.logits))
+
+    def entropy(self):
+        p = self.probs
+        return _t(-(p * jnp.log(p + 1e-38) + (1 - p) * jnp.log1p(-p + 1e-38)))
+
+
+class Categorical(Distribution):
+    def __init__(self, logits=None, probs=None, name=None):
+        if logits is not None:
+            self.logits = _arr(logits)
+        else:
+            self.logits = jnp.log(_arr(probs) + 1e-38)
+        self._log_probs = jax.nn.log_softmax(self.logits, axis=-1)
+        super().__init__(self.logits.shape[:-1])
+
+    @property
+    def probs(self):
+        return _t(jnp.exp(self._log_probs))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        return _t(jax.random.categorical(rng.next_key(), self.logits, shape=shape))
+
+    def log_prob(self, value):
+        idx = _arr(value).astype(jnp.int32)
+        lp = jnp.broadcast_to(self._log_probs,
+                              idx.shape + self._log_probs.shape[-1:])
+        return _t(jnp.take_along_axis(lp, idx[..., None], -1)[..., 0])
+
+    def entropy(self):
+        p = jnp.exp(self._log_probs)
+        return _t(-(p * self._log_probs).sum(-1))
+
+
+class Exponential(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = _arr(rate)
+        super().__init__(self.rate.shape)
+
+    @property
+    def mean(self):
+        return _t(1.0 / self.rate)
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        return _t(jax.random.exponential(rng.next_key(), shape) / self.rate)
+
+    def log_prob(self, value):
+        v = _arr(value)
+        return _t(jnp.log(self.rate) - self.rate * v)
+
+    def entropy(self):
+        return _t(1.0 - jnp.log(self.rate))
+
+
+class Gamma(Distribution):
+    def __init__(self, concentration, rate, name=None):
+        self.concentration = _arr(concentration)
+        self.rate = _arr(rate)
+        super().__init__(jnp.broadcast_shapes(self.concentration.shape, self.rate.shape))
+
+    @property
+    def mean(self):
+        return _t(self.concentration / self.rate)
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        return _t(jax.random.gamma(rng.next_key(), self.concentration, shape) / self.rate)
+
+    def log_prob(self, value):
+        v = _arr(value)
+        a, b = self.concentration, self.rate
+        return _t(a * jnp.log(b) + (a - 1) * jnp.log(v) - b * v - jsp.gammaln(a))
+
+    def entropy(self):
+        a, b = self.concentration, self.rate
+        return _t(a - jnp.log(b) + jsp.gammaln(a) + (1 - a) * jsp.digamma(a))
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta, name=None):
+        self.alpha = _arr(alpha)
+        self.beta = _arr(beta)
+        super().__init__(jnp.broadcast_shapes(self.alpha.shape, self.beta.shape))
+
+    @property
+    def mean(self):
+        return _t(self.alpha / (self.alpha + self.beta))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        return _t(jax.random.beta(rng.next_key(), self.alpha, self.beta, shape))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        a, b = self.alpha, self.beta
+        lbeta = jsp.gammaln(a) + jsp.gammaln(b) - jsp.gammaln(a + b)
+        return _t((a - 1) * jnp.log(v) + (b - 1) * jnp.log1p(-v) - lbeta)
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration, name=None):
+        self.concentration = _arr(concentration)
+        super().__init__(self.concentration.shape[:-1],
+                         self.concentration.shape[-1:])
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        return _t(jax.random.dirichlet(rng.next_key(), self.concentration, shape))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        a = self.concentration
+        norm = jsp.gammaln(a).sum(-1) - jsp.gammaln(a.sum(-1))
+        return _t(((a - 1) * jnp.log(v)).sum(-1) - norm)
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        return _t(self.loc + self.scale * jax.random.laplace(rng.next_key(), shape))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        return _t(-jnp.abs(v - self.loc) / self.scale
+                  - jnp.log(2 * self.scale))
+
+    def entropy(self):
+        return _t(1 + jnp.log(2 * jnp.broadcast_to(self.scale, self.batch_shape)))
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        return _t(self.loc + self.scale * jax.random.gumbel(rng.next_key(), shape))
+
+    def log_prob(self, value):
+        z = (_arr(value) - self.loc) / self.scale
+        return _t(-(z + jnp.exp(-z)) - jnp.log(self.scale))
+
+
+class LogNormal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        self._normal = Normal(loc, scale)
+        super().__init__(self._normal.batch_shape)
+
+    def sample(self, shape=()):
+        return _t(jnp.exp(_arr(self._normal.sample(shape))))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        return _t(_arr(self._normal.log_prob(jnp.log(v))) - jnp.log(v))
+
+
+class Geometric(Distribution):
+    def __init__(self, probs, name=None):
+        self.probs = _arr(probs)
+        super().__init__(self.probs.shape)
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        u = jax.random.uniform(rng.next_key(), shape)
+        return _t(jnp.floor(jnp.log1p(-u) / jnp.log1p(-self.probs)))
+
+    def log_prob(self, value):
+        k = _arr(value)
+        return _t(k * jnp.log1p(-self.probs) + jnp.log(self.probs))
+
+
+class Poisson(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = _arr(rate)
+        super().__init__(self.rate.shape)
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        return _t(jax.random.poisson(rng.next_key(), self.rate, shape)
+                  .astype(jnp.float32))
+
+    def log_prob(self, value):
+        k = _arr(value)
+        return _t(k * jnp.log(self.rate) - self.rate - jsp.gammaln(k + 1))
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = int(total_count)
+        self.probs = _arr(probs)
+        super().__init__(self.probs.shape[:-1], self.probs.shape[-1:])
+
+    def sample(self, shape=()):
+        cat = Categorical(probs=self.probs)
+        draws = _arr(cat.sample((self.total_count,) + tuple(shape)))
+        k = self.probs.shape[-1]
+        onehot = jax.nn.one_hot(draws, k)
+        return _t(onehot.sum(0))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        logp = jnp.log(self.probs + 1e-38)
+        coeff = (jsp.gammaln(jnp.asarray(self.total_count + 1.0))
+                 - jsp.gammaln(v + 1).sum(-1))
+        return _t(coeff + (v * logp).sum(-1))
+
+
+class StudentT(Distribution):
+    def __init__(self, df, loc=0.0, scale=1.0, name=None):
+        self.df = _arr(df)
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(jnp.broadcast_shapes(self.df.shape, self.loc.shape,
+                                              self.scale.shape))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        return _t(self.loc + self.scale * jax.random.t(rng.next_key(), self.df, shape))
+
+    def log_prob(self, value):
+        z = (_arr(value) - self.loc) / self.scale
+        d = self.df
+        return _t(jsp.gammaln((d + 1) / 2) - jsp.gammaln(d / 2)
+                  - 0.5 * jnp.log(d * math.pi) - jnp.log(self.scale)
+                  - (d + 1) / 2 * jnp.log1p(z ** 2 / d))
+
+
+# ---------------------------------------------------------------------- KL
+_KL: Dict[Tuple[Type, Type], object] = {}
+
+
+def register_kl(p_cls, q_cls):
+    def deco(fn):
+        _KL[(p_cls, q_cls)] = fn
+        return fn
+
+    return deco
+
+
+def kl_divergence(p: Distribution, q: Distribution):
+    fn = _KL.get((type(p), type(q)))
+    if fn is None:
+        raise NotImplementedError(f"KL({type(p).__name__} || {type(q).__name__})")
+    return fn(p, q)
+
+
+@register_kl(Normal, Normal)
+def _kl_normal(p, q):
+    var_p, var_q = p.scale ** 2, q.scale ** 2
+    return _t(jnp.log(q.scale / p.scale)
+              + (var_p + (p.loc - q.loc) ** 2) / (2 * var_q) - 0.5)
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical(p, q):
+    pp = jnp.exp(p._log_probs)
+    return _t((pp * (p._log_probs - q._log_probs)).sum(-1))
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli(p, q):
+    a, b = p.probs, q.probs
+    return _t(a * (jnp.log(a + 1e-38) - jnp.log(b + 1e-38))
+              + (1 - a) * (jnp.log1p(-a + 1e-38) - jnp.log1p(-b + 1e-38)))
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform(p, q):
+    return _t(jnp.log((q.high - q.low) / (p.high - p.low)))

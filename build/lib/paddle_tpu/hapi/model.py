@@ -1,0 +1,216 @@
+"""hapi Model — parity with ref:python/paddle/hapi/model.py
+(Model.prepare/fit/evaluate/predict/save/load :1018-2072, paddle.summary).
+
+TPU-native: ``fit`` drives the fully-compiled TrainStep (one XLA program per
+step) instead of the reference's per-op dygraph loop.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..core.tensor import Tensor, to_tensor
+from ..metric import Metric
+from ..nn.layer import Layer
+from .callbacks import Callback, CallbackList, ProgBarLogger
+
+
+class Model:
+    def __init__(self, network: Layer, inputs=None, labels=None):
+        self.network = network
+        self._optimizer = None
+        self._loss = None
+        self._metrics: List[Metric] = []
+        self._train_step = None
+        self.stop_training = False
+
+    # ------------------------------------------------------------- prepare
+    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        if metrics is None:
+            metrics = []
+        elif isinstance(metrics, Metric):
+            metrics = [metrics]
+        self._metrics = list(metrics)
+        self._train_step = None
+        return self
+
+    # ---------------------------------------------------------------- fit
+    def fit(
+        self,
+        train_data=None,
+        eval_data=None,
+        batch_size: int = 1,
+        epochs: int = 1,
+        eval_freq: int = 1,
+        log_freq: int = 10,
+        save_dir: Optional[str] = None,
+        save_freq: int = 1,
+        verbose: int = 2,
+        drop_last: bool = False,
+        shuffle: bool = True,
+        num_workers: int = 0,
+        callbacks: Optional[Sequence[Callback]] = None,
+    ):
+        loader = self._as_loader(train_data, batch_size, shuffle, drop_last, num_workers)
+        cbs = CallbackList(list(callbacks or []) + [ProgBarLogger(log_freq, verbose)])
+        cbs.set_model(self)
+        cbs.set_params({"epochs": epochs, "verbose": verbose})
+        self.stop_training = False
+
+        if self._train_step is None:
+            from ..jit import TrainStep
+
+            def loss_fn(*batch):
+                *xs, y = batch
+                out = self.network(*xs)
+                return self._loss(out, y)
+
+            self._train_step = TrainStep(loss_fn, self._optimizer, layers=self.network)
+
+        cbs.on_train_begin()
+        history = {"loss": []}
+        for epoch in range(epochs):
+            if self.stop_training:
+                break
+            cbs.on_epoch_begin(epoch)
+            self.network.train()
+            last_loss = None
+            for step, batch in enumerate(loader):
+                cbs.on_train_batch_begin(step)
+                batch = self._to_tensors(batch)
+                loss = self._train_step(*batch)
+                last_loss = float(np.asarray(loss._data))
+                cbs.on_train_batch_end(step, {"loss": last_loss})
+            history["loss"].append(last_loss)
+            logs = {"loss": last_loss}
+            if eval_data is not None and (epoch + 1) % eval_freq == 0:
+                eval_logs = self.evaluate(eval_data, batch_size=batch_size,
+                                          verbose=0, num_workers=num_workers,
+                                          callbacks=list(callbacks or []))
+                logs.update(eval_logs)
+            cbs.on_epoch_end(epoch, logs)
+            if save_dir and (epoch + 1) % save_freq == 0:
+                import os
+
+                self.save(os.path.join(save_dir, str(epoch), "model"))
+        cbs.on_train_end()
+        return history
+
+    # ------------------------------------------------------------ evaluate
+    def evaluate(self, eval_data, batch_size: int = 1, log_freq: int = 10,
+                 verbose: int = 2, num_workers: int = 0, callbacks=None):
+        loader = self._as_loader(eval_data, batch_size, False, False, num_workers)
+        cbs = CallbackList(list(callbacks or []))
+        cbs.set_model(self)
+        self.network.eval()
+        for m in self._metrics:
+            m.reset()
+        cbs.on_eval_begin()
+        total_loss, batches = 0.0, 0
+        for step, batch in enumerate(loader):
+            batch = self._to_tensors(batch)
+            *xs, y = batch
+            out = self.network(*xs)
+            if self._loss is not None:
+                total_loss += float(np.asarray(self._loss(out, y)._data))
+                batches += 1
+            for m in self._metrics:
+                res = m.compute(out, y)
+                m.update(*res) if isinstance(res, tuple) else m.update(res)
+        logs = {}
+        if batches:
+            logs["loss"] = total_loss / batches
+        for m in self._metrics:
+            names = m.name()
+            vals = m.accumulate()
+            if isinstance(names, list):
+                logs.update(dict(zip(names, vals)))
+            else:
+                logs[names] = vals
+        cbs.on_eval_end(logs)
+        self.network.train()
+        return logs
+
+    # ------------------------------------------------------------- predict
+    def predict(self, test_data, batch_size: int = 1, num_workers: int = 0,
+                stack_outputs: bool = False, verbose: int = 1, callbacks=None):
+        loader = self._as_loader(test_data, batch_size, False, False, num_workers)
+        self.network.eval()
+        outs = []
+        for batch in loader:
+            batch = self._to_tensors(batch)
+            xs = batch[:-1] if len(batch) > 1 else batch
+            outs.append(np.asarray(self.network(*xs)._data))
+        self.network.train()
+        if stack_outputs:
+            return [np.concatenate(outs, axis=0)]
+        return [outs]
+
+    # ------------------------------------------------------- save / load
+    def save(self, path: str, training: bool = True):
+        import os
+        import pickle
+
+        from ..framework import io as fio
+
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        fio.save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None and hasattr(self._optimizer, "state_dict"):
+            try:
+                fio.save(self._optimizer.state_dict(), path + ".pdopt")
+            except Exception:
+                pass
+
+    def load(self, path: str, skip_mismatch: bool = False, reset_optimizer: bool = False):
+        from ..framework import io as fio
+
+        state = fio.load(path + ".pdparams")
+        self.network.set_state_dict(state)
+        return self
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    # -------------------------------------------------------------- utils
+    def _as_loader(self, data, batch_size, shuffle, drop_last, num_workers):
+        from ..io import DataLoader, Dataset
+
+        if data is None:
+            raise ValueError("data is required")
+        if isinstance(data, DataLoader):
+            return data
+        if hasattr(data, "__iter__") and not isinstance(data, Dataset) and not hasattr(data, "__getitem__"):
+            return data
+        return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                          drop_last=drop_last, num_workers=num_workers)
+
+    @staticmethod
+    def _to_tensors(batch):
+        if isinstance(batch, (list, tuple)):
+            return [b if isinstance(b, Tensor) else to_tensor(np.asarray(b)) for b in batch]
+        return [batch if isinstance(batch, Tensor) else to_tensor(np.asarray(batch))]
+
+
+def summary(net: Layer, input_size=None, dtypes=None, input=None):
+    """paddle.summary parity: parameter table + totals."""
+    rows = []
+    total, trainable = 0, 0
+    for name, p in net.named_parameters():
+        n = int(np.prod(p.shape)) if p.shape else 1
+        total += n
+        if not p.stop_gradient:
+            trainable += n
+        rows.append((name, list(p.shape), n))
+    width = max((len(r[0]) for r in rows), default=20) + 2
+    lines = [f"{'Layer (param)':<{width}}{'Shape':<20}{'Params':>12}"]
+    lines += [f"{r[0]:<{width}}{str(r[1]):<20}{r[2]:>12,}" for r in rows]
+    lines.append(f"Total params: {total:,}")
+    lines.append(f"Trainable params: {trainable:,}")
+    lines.append(f"Non-trainable params: {total - trainable:,}")
+    print("\n".join(lines))
+    return {"total_params": total, "trainable_params": trainable}

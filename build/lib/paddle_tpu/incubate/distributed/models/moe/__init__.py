@@ -1,0 +1,2 @@
+from .gate import BaseGate, GShardGate, NaiveGate, SwitchGate  # noqa: F401
+from .moe_layer import MoELayer  # noqa: F401

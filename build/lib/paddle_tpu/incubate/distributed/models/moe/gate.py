@@ -1,0 +1,142 @@
+"""MoE gates — parity with ref:python/paddle/incubate/distributed/models/moe/
+gate/{naive,gshard,switch}_gate.py, computed as dense XLA ops.
+
+Each gate maps token activations [T, d_model] to:
+  dispatch [T, E, C] one-hot routing tensor (capacity-limited),
+  combine  [T, E, C] dispatch scaled by gate probabilities,
+  aux loss (load balancing), exposed via ``get_loss()``.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..... import nn
+from .....core import rng
+from .....core.tensor import Tensor
+from .....nn.layer import Layer
+
+
+def _capacity(num_tokens: int, num_experts: int, top_k: int, factor: float) -> int:
+    return max(4, int(math.ceil(top_k * num_tokens / num_experts * factor)))
+
+
+def _one_hot(x, n):
+    return jax.nn.one_hot(x, n, dtype=jnp.float32)
+
+
+def _positions_in_expert(mask):
+    """mask [T, E] 0/1 -> position of each routed token within its expert."""
+    return (jnp.cumsum(mask, axis=0) - 1.0) * mask
+
+
+def _topk_dispatch(probs, top_k, capacity, *, normalize=True, extra_mask=None):
+    """Shared dense top-k routing: probs [T, E] -> dispatch/combine [T, E, C]."""
+    T, E = probs.shape
+    gates_list, masks = [], []
+    p = probs
+    for _ in range(top_k):
+        idx = jnp.argmax(p, axis=-1)
+        m = _one_hot(idx, E)
+        gates_list.append((p * m).sum(-1))
+        masks.append(m)
+        p = p * (1.0 - m)
+    if extra_mask is not None:
+        masks = [m * extra_mask for m in masks]
+    # capacity assignment: earlier-k choices claim slots first
+    occupancy = jnp.zeros((E,), jnp.float32)
+    dispatch = jnp.zeros((T, E, capacity), jnp.float32)
+    combine = jnp.zeros((T, E, capacity), jnp.float32)
+    gate_sum = sum(gates_list) if normalize else None
+    for g, m in zip(gates_list, masks):
+        pos = _positions_in_expert(m) + occupancy[None, :] * m
+        keep = (pos < capacity).astype(jnp.float32) * m
+        sel = jnp.einsum("te,tc->tec", keep, _one_hot(
+            jnp.clip((pos * m).sum(-1), 0, capacity - 1).astype(jnp.int32), capacity))
+        sel = sel * keep.sum(-1, keepdims=True)[..., None]
+        dispatch = dispatch + sel
+        gn = g / jnp.maximum(gate_sum, 1e-9) if normalize else g
+        combine = combine + sel * gn[:, None, None]
+        occupancy = occupancy + m.sum(0)
+    return dispatch, combine
+
+
+def _load_balance_loss(probs, mask_top1):
+    """GShard/Switch aux loss: E * sum_e(mean_prob_e * mean_routed_e)."""
+    E = probs.shape[-1]
+    me = probs.mean(axis=0)
+    ce = mask_top1.mean(axis=0)
+    return E * jnp.sum(me * ce)
+
+
+class BaseGate(Layer):
+    def __init__(self, d_model: int, num_experts: int, top_k: int = 2,
+                 capacity_factor: float = 1.25):
+        super().__init__()
+        self.d_model = d_model
+        self.num_experts = num_experts
+        self.top_k = top_k
+        self.capacity_factor = capacity_factor
+        from .....nn import initializer as I
+
+        self.weight = self.create_parameter(
+            [d_model, num_experts], default_initializer=I.XavierUniform())
+        self._loss = None
+
+    def get_loss(self, clear: bool = True):
+        l = self._loss
+        if clear:
+            self._loss = None
+        return l
+
+    def _probs(self, x):
+        logits = jnp.einsum("tm,me->te", x, self.weight._data if isinstance(
+            self.weight, Tensor) else self.weight)
+        return jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+
+
+class NaiveGate(BaseGate):
+    """Top-k softmax routing, no aux loss (ref gate/naive_gate.py)."""
+
+    def route(self, x, capacity):
+        probs = self._probs(x)
+        dispatch, combine = _topk_dispatch(probs, self.top_k, capacity)
+        self._loss = jnp.zeros((), jnp.float32)
+        return dispatch, combine, self._loss
+
+
+class GShardGate(BaseGate):
+    """Top-2 with load-balancing aux loss (ref gate/gshard_gate.py)."""
+
+    def route(self, x, capacity):
+        probs = self._probs(x)
+        dispatch, combine = _topk_dispatch(probs, min(2, self.top_k or 2), capacity)
+        top1 = _one_hot(jnp.argmax(probs, -1), self.num_experts)
+        self._loss = _load_balance_loss(probs, top1)
+        return dispatch, combine, self._loss
+
+
+class SwitchGate(BaseGate):
+    """Top-1 switch routing with jitter noise (ref gate/switch_gate.py)."""
+
+    def __init__(self, d_model, num_experts, top_k: int = 1,
+                 capacity_factor: float = 1.25, switch_eps: float = 0.1):
+        super().__init__(d_model, num_experts, 1, capacity_factor)
+        self.switch_eps = switch_eps
+
+    def route(self, x, capacity):
+        if self.training and self.switch_eps:
+            noise = jax.random.uniform(
+                rng.next_key(), x.shape, x.dtype,
+                1.0 - self.switch_eps, 1.0 + self.switch_eps)
+            x = x * noise
+        probs = self._probs(x)
+        dispatch, combine = _topk_dispatch(probs, 1, capacity, normalize=False)
+        top1 = _one_hot(jnp.argmax(probs, -1), self.num_experts)
+        self._loss = _load_balance_loss(probs, top1)
+        return dispatch, combine, self._loss
+
+
+GATES = {"naive": NaiveGate, "gshard": GShardGate, "switch": SwitchGate}

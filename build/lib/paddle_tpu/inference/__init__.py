@@ -1,0 +1,103 @@
+"""Inference API — parity with the reference's AnalysisPredictor surface
+(ref:paddle/fluid/inference/api/analysis_predictor.cc, paddle_inference_api.h).
+
+TPU-native: a "predictor" is a deserialized, ahead-of-time exported StableHLO
+program (jit.save's .pdmodel) executed by XLA — the pass pipeline the
+reference runs at load time (fusion, memory optimization) is what XLA
+already did at export. Config keeps the familiar knobs as no-ops where XLA
+owns the decision.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+class Config:
+    def __init__(self, model_path: Optional[str] = None, params_path: Optional[str] = None):
+        # paddle passes either a dir or (model, params) pair; we need the
+        # jit.save path prefix
+        prefix = model_path or ""
+        for suffix in (".pdmodel", ".pdiparams", ".pdparams"):
+            if prefix.endswith(suffix):
+                prefix = prefix[: -len(suffix)]
+        self.model_prefix = prefix
+        self._mem_optim = True
+        self._device = None
+
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        self._device = ("gpu", device_id)  # accepted; XLA owns placement
+
+    def enable_memory_optim(self, flag=True):
+        self._mem_optim = flag
+
+    def disable_glog_info(self):
+        pass
+
+    def switch_ir_optim(self, flag=True):
+        pass  # XLA optimized at export time
+
+    def set_cpu_math_library_num_threads(self, n):
+        pass
+
+
+class PredictorTensor:
+    """Zero-copy-ish handle mirroring paddle's input/output tensor API."""
+
+    def __init__(self, owner, name):
+        self._owner = owner
+        self._name = name
+
+    def copy_from_cpu(self, arr: np.ndarray):
+        self._owner._inputs[self._name] = np.asarray(arr)
+
+    def copy_to_cpu(self) -> np.ndarray:
+        return np.asarray(self._owner._outputs[self._name])
+
+    def shape(self):
+        src = self._owner._inputs.get(self._name)
+        if src is None:
+            src = self._owner._outputs.get(self._name)
+        return list(np.asarray(src).shape)
+
+
+class Predictor:
+    def __init__(self, config: Config):
+        from ..jit import load as jit_load
+
+        self._layer = jit_load(config.model_prefix)
+        self._inputs = {}
+        self._outputs = {}
+
+    def get_input_names(self) -> List[str]:
+        return ["input_0"] if not self._inputs else sorted(self._inputs)
+
+    def get_output_names(self) -> List[str]:
+        return sorted(self._outputs) or ["output_0"]
+
+    def get_input_handle(self, name: str) -> PredictorTensor:
+        return PredictorTensor(self, name)
+
+    def get_output_handle(self, name: str) -> PredictorTensor:
+        return PredictorTensor(self, name)
+
+    def run(self, inputs: Optional[List[np.ndarray]] = None):
+        if inputs is not None:
+            arrs = [np.asarray(a) for a in inputs]
+        else:
+            arrs = [self._inputs[k] for k in sorted(self._inputs)]
+        out = self._layer(*arrs)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        self._outputs = {
+            f"output_{i}": (o.numpy() if isinstance(o, Tensor) else np.asarray(o))
+            for i, o in enumerate(outs)
+        }
+        if inputs is not None:
+            return [self._outputs[k] for k in sorted(self._outputs)]
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)

@@ -321,26 +321,6 @@ class DataLoader:
         return len(self.batch_sampler)
 
 
-def _start_method() -> str:
-    """Worker start method: 'fork' (cheap, no pickling constraints — the
-    reference's Linux default) while the parent hasn't initialized a
-    non-CPU JAX backend; 'spawn' once an accelerator client exists, since
-    forking a live libtpu/PJRT client is not fork-safe. Overridable via
-    PADDLE_TPU_LOADER_START_METHOD."""
-    env = os.environ.get("PADDLE_TPU_LOADER_START_METHOD")
-    if env:
-        return env
-    try:
-        from jax._src import xla_bridge
-
-        backends = getattr(xla_bridge, "_backends", {})
-        if any(name != "cpu" for name in backends):
-            return "spawn"
-    except Exception:  # private API drift: fall through to fork
-        pass
-    return "fork"
-
-
 class _MultiProcessIter:
     """Parent side of the multiprocess loader: feeds batch-index tasks to
     worker processes and reassembles results in sampler order.
@@ -354,7 +334,8 @@ class _MultiProcessIter:
         import multiprocessing as mp
 
         self.loader = loader
-        ctx = mp.get_context(_start_method())
+        method = os.environ.get("PADDLE_TPU_LOADER_START_METHOD", "fork")
+        ctx = mp.get_context(method)
         self.nw = loader.num_workers
         self.iterable = loader._iterable_mode
         self.result_queue = ctx.Queue()
@@ -379,7 +360,7 @@ class _MultiProcessIter:
 
     def start_epoch(self):
         if self.iterable:
-            pass  # workers stream autonomously; _iterable_epoch tracks done
+            self._done_workers = 0
         else:
             # epoch generation tag: results from a previous, partially
             # consumed epoch (persistent workers + early break) are discarded

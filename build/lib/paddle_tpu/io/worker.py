@@ -1,0 +1,210 @@
+"""DataLoader worker processes + shared-memory transport.
+
+The reference's multiprocess loader (ref:python/paddle/fluid/dataloader/
+dataloader_iter.py:370 _DataLoaderIterMultiProcess, worker.py, and the C++
+shared-memory LoDTensor transport in ref:paddle/fluid/imperative/
+data_loader.cc) decodes samples in worker processes and ships batches through
+shared memory. TPU-native equivalent: numpy batches move via
+multiprocessing.shared_memory segments (zero-copy into the parent, one copy
+into the device via jax.device_put); ordering is restored in the parent with
+a sequence-number reorder buffer.
+
+Workers never touch the accelerator: they force the CPU platform before any
+jax import so a data worker can't grab the TPU chip.
+"""
+from __future__ import annotations
+
+import itertools
+import os
+import queue
+from dataclasses import dataclass
+from multiprocessing import shared_memory
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+_worker_info: Optional["WorkerInfo"] = None
+
+
+@dataclass
+class WorkerInfo:
+    id: int
+    num_workers: int
+    seed: int
+    dataset: Any
+
+
+def get_worker_info() -> Optional[WorkerInfo]:
+    """Inside a worker: its (id, num_workers, seed, dataset); None in the
+    parent (ref:python/paddle/fluid/dataloader/worker.py get_worker_info)."""
+    return _worker_info
+
+
+# ------------------------------------------------------------- transport
+
+
+def _pack_leaf(x, use_shm: bool, shm_threshold: int = 1 << 12):
+    arr = np.ascontiguousarray(x)
+    if use_shm and arr.nbytes >= shm_threshold:
+        seg = shared_memory.SharedMemory(create=True, size=arr.nbytes)
+        np.ndarray(arr.shape, arr.dtype, buffer=seg.buf)[...] = arr
+        name = seg.name
+        seg.close()  # keep the segment (parent unlinks after reading)
+        _untrack(name)  # ownership transfers to the parent with the message
+        return ("shm", name, str(arr.dtype), arr.shape)
+    return ("raw", arr)
+
+
+def _unpack_leaf(p):
+    if p[0] == "raw":
+        return p[1]
+    _, name, dtype, shape = p
+    seg = shared_memory.SharedMemory(name=name)
+    _untrack(name)  # attach re-registered it; the unlink below is ours
+    try:
+        arr = np.array(np.ndarray(shape, np.dtype(dtype), buffer=seg.buf))
+    finally:
+        seg.close()
+        try:
+            seg.unlink()
+        except FileNotFoundError:  # pragma: no cover
+            pass
+    return arr
+
+
+def _untrack(name: str):
+    """Drop a segment from this process's resource_tracker registry.
+
+    SharedMemory registers on both create and attach; with worker-creates /
+    parent-unlinks ownership the extra registrations make resource_tracker
+    warn (or re-unlink) at exit. Best-effort: tracker internals are private.
+    """
+    try:
+        from multiprocessing import resource_tracker
+
+        resource_tracker.unregister(f"/{name}" if not name.startswith("/") else name,
+                                    "shared_memory")
+    except Exception:  # pragma: no cover
+        pass
+
+
+def _pack(obj, use_shm):
+    if isinstance(obj, np.ndarray):
+        return ("leaf", _pack_leaf(obj, use_shm))
+    if isinstance(obj, tuple):
+        return ("tuple", [_pack(o, use_shm) for o in obj])
+    if isinstance(obj, list):
+        return ("list", [_pack(o, use_shm) for o in obj])
+    if isinstance(obj, dict):
+        return ("dict", {k: _pack(v, use_shm) for k, v in obj.items()})
+    return ("obj", obj)
+
+
+def _unpack(p):
+    kind, payload = p
+    if kind == "leaf":
+        return _unpack_leaf(payload)
+    if kind == "tuple":
+        return tuple(_unpack(o) for o in payload)
+    if kind == "list":
+        return [_unpack(o) for o in payload]
+    if kind == "dict":
+        return {k: _unpack(v) for k, v in payload.items()}
+    return payload
+
+
+def discard(p):
+    """Release shm segments of an unconsumed packed batch (shutdown path)."""
+    kind, payload = p
+    if kind == "leaf" and payload[0] == "shm":
+        try:
+            seg = shared_memory.SharedMemory(name=payload[1])
+            _untrack(payload[1])
+            seg.close()
+            seg.unlink()
+        except FileNotFoundError:
+            pass
+    elif kind in ("tuple", "list"):
+        for o in payload:
+            discard(o)
+    elif kind == "dict":
+        for o in payload.values():
+            discard(o)
+
+
+# ------------------------------------------------------------- worker loop
+
+
+def _to_numpy_tree(obj):
+    """Collated batches may contain framework Tensors; strip to numpy so the
+    transport (and the parent's device_put) owns placement."""
+    from ..core.tensor import Tensor
+
+    if isinstance(obj, Tensor):
+        return np.asarray(obj._data)
+    if isinstance(obj, tuple):
+        return tuple(_to_numpy_tree(o) for o in obj)
+    if isinstance(obj, list):
+        return [_to_numpy_tree(o) for o in obj]
+    if isinstance(obj, dict):
+        return {k: _to_numpy_tree(v) for k, v in obj.items()}
+    return obj
+
+
+def worker_loop(dataset, index_queue, result_queue, collate_fn, use_shm,
+                worker_id, num_workers, worker_init_fn, iterable_mode,
+                batch_size, drop_last, base_seed):
+    global _worker_info
+    os.environ["JAX_PLATFORMS"] = "cpu"  # data workers must not claim the TPU
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:  # pragma: no cover
+        pass
+    _worker_info = WorkerInfo(id=worker_id, num_workers=num_workers,
+                              seed=base_seed + worker_id, dataset=dataset)
+    np.random.seed((base_seed + worker_id) % (1 << 31))
+    try:
+        if worker_init_fn is not None:
+            worker_init_fn(worker_id)
+        if iterable_mode:
+            _iterable_loop(dataset, result_queue, collate_fn, use_shm,
+                           worker_id, batch_size, drop_last)
+        else:
+            _map_loop(dataset, index_queue, result_queue, collate_fn, use_shm)
+    except KeyboardInterrupt:  # pragma: no cover
+        pass
+    except Exception:  # surface the traceback to the parent
+        import traceback
+
+        result_queue.put(("error", worker_id, traceback.format_exc()))
+    finally:
+        result_queue.put(("done", worker_id, None))
+        result_queue.close()
+
+
+def _map_loop(dataset, index_queue, result_queue, collate_fn, use_shm):
+    while True:
+        task = index_queue.get()
+        if task is None:
+            return
+        epoch, seq, indices = task
+        batch = collate_fn([dataset[i] for i in indices])
+        result_queue.put(
+            ("batch", (epoch, seq), _pack(_to_numpy_tree(batch), use_shm)))
+
+
+def _iterable_loop(dataset, result_queue, collate_fn, use_shm, worker_id,
+                   batch_size, drop_last):
+    # each worker iterates its own dataset replica; the user shards work by
+    # worker via get_worker_info() in __iter__ (the reference contract)
+    it = iter(dataset)
+    while True:
+        samples = list(itertools.islice(it, batch_size))
+        if not samples:
+            return
+        if len(samples) < batch_size and drop_last:
+            return
+        batch = collate_fn(samples)
+        result_queue.put(("batch", -1, _pack(_to_numpy_tree(batch), use_shm)))

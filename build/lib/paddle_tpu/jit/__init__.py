@@ -1,0 +1,372 @@
+"""Compiled execution (paddle.jit equivalent).
+
+The reference gets graphs from dygraph via AST rewriting (``@to_static``,
+ref:python/paddle/jit/api.py:232 + dy2static transformers) and runs them on
+StandaloneExecutor. TPU-native replacement: *trace* the same Python with JAX —
+Tensor ops run on tracers, the whole function becomes one XLA program. Python
+control flow is evaluated at trace time (use lax.cond/scan via paddle_tpu ops
+for data-dependent flow); no AST surgery, no separate executor.
+
+Key pieces:
+  * ``functional_call(layer, state, args)`` — run a Layer with swapped
+    parameter arrays (the lifting trick that makes Layers pure).
+  * ``@to_static`` — jit a function/Layer forward; buffer mutations
+    (BatchNorm stats) are captured via the mutation sink and applied after.
+  * ``TrainStep`` — whole-training-step compilation: loss, grads, optimizer
+    update in ONE XLA program (what the bench uses; ~KernelFusion of the
+    reference's separate op launches).
+"""
+from __future__ import annotations
+
+import contextlib
+import functools
+from typing import Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core import rng
+from ..core.tensor import Tensor
+from ..nn.layer import Layer, mutation_sink
+
+
+@contextlib.contextmanager
+def _swap_data(tensors: List[Tensor], arrays):
+    old = [t._data for t in tensors]
+    for t, a in zip(tensors, arrays):
+        t._data = a
+    try:
+        yield
+    finally:
+        for t, o in zip(tensors, old):
+            t._data = o
+
+
+def functional_call(layer: Layer, params_and_buffers: Dict[str, object], *args, **kwargs):
+    """Run ``layer(*args)`` with parameter/buffer values taken from the dict
+    (name -> Tensor/array). Pure w.r.t. the provided values; jit/grad-safe."""
+    params, buffers = layer.functional_state()
+    objs, vals = [], []
+    for name, t in list(params.items()) + list(buffers.items()):
+        if name in params_and_buffers:
+            v = params_and_buffers[name]
+            objs.append(t)
+            vals.append(v._data if isinstance(v, Tensor) else v)
+    with _swap_data(objs, vals):
+        return layer(*args, **kwargs)
+
+
+class StaticFunction:
+    """Result of @to_static: a compile-cached callable (≈ ref StaticFunction,
+    ref:python/paddle/jit/dy2static/program_translator.py)."""
+
+    def __init__(self, function: Callable, layer: Optional[Layer] = None, donate_buffers: bool = True):
+        self._fn = function
+        self._layer = layer
+        self._jit_fn = None
+        self._param_objs: List[Tensor] = []
+        self._buffer_objs: List[Tensor] = []
+        functools.update_wrapper(self, function, updated=[])
+
+    def _discover_state(self):
+        layer = self._layer
+        if layer is None and hasattr(self._fn, "__self__") and isinstance(self._fn.__self__, Layer):
+            layer = self._fn.__self__
+        if layer is not None:
+            params, buffers = layer.functional_state()
+            self._param_objs = list(params.values())
+            self._buffer_objs = list(buffers.values())
+
+    def _build(self):
+        self._discover_state()
+        fn = self._fn
+        param_objs = self._param_objs
+        buffer_objs = self._buffer_objs
+
+        @jax.jit
+        def _compiled(param_arrays, buffer_arrays, key, args, kwargs):
+            sink = {}
+            with _swap_data(param_objs + buffer_objs, list(param_arrays) + list(buffer_arrays)):
+                with rng.key_guard(key), mutation_sink(sink):
+                    out = fn(*args, **kwargs)
+            mutated = []
+            for b in buffer_objs:
+                hit = sink.get(id(b))
+                mutated.append(hit[1] if hit is not None else None)
+            return out, mutated
+
+        self._jit_fn = _compiled
+
+    def __call__(self, *args, **kwargs):
+        if self._jit_fn is None:
+            self._build()
+        param_arrays = tuple(p._data for p in self._param_objs)
+        buffer_arrays = tuple(b._data for b in self._buffer_objs)
+        out, mutated = self._jit_fn(param_arrays, buffer_arrays, rng.next_key(), args, kwargs)
+        for b, m in zip(self._buffer_objs, mutated):
+            if m is not None:
+                b._data = m
+        return out
+
+    @property
+    def code(self):
+        return "<XLA-compiled via jax.jit>"
+
+    def concrete_program(self):
+        return self._jit_fn
+
+
+def to_static(function=None, input_spec=None, build_strategy=None, backend=None, **kwargs):
+    """@paddle.jit.to_static equivalent (trace+XLA instead of AST rewrite)."""
+
+    def deco(fn):
+        if isinstance(fn, Layer):
+            layer = fn
+            sf = StaticFunction(layer.forward, layer=layer)
+            layer.forward = sf
+            return layer
+        return StaticFunction(fn)
+
+    if function is not None:
+        return deco(function)
+    return deco
+
+
+class TrainStep:
+    """One fully-compiled training step: forward + backward + optimizer.
+
+    Replaces the reference's per-op dygraph hot loop (§3.1 of SURVEY.md) with
+    a single XLA program; with sharded inputs this same class is the pjit
+    training path.
+    """
+
+    def __init__(self, fn: Callable, optimizer, layers=None, extra_state: Optional[List[Tensor]] = None):
+        self._fn = fn
+        self._opt = optimizer
+        plist = optimizer._parameter_list or []
+        self._train_params = [p for p in plist if not p.stop_gradient]
+        frozen = [p for p in plist if p.stop_gradient]
+        buffers: List[Tensor] = list(frozen)
+        if layers is not None:
+            if isinstance(layers, Layer):
+                layers = [layers]
+            seen = {id(p) for p in plist}
+            for l in layers:
+                for _, b in l.named_buffers():
+                    if id(b) not in seen:
+                        buffers.append(b)
+                        seen.add(id(b))
+                for _, p in l.named_parameters():
+                    if id(p) not in seen:
+                        buffers.append(p)
+                        seen.add(id(p))
+        self._buffers = buffers
+        if extra_state:
+            self._buffers.extend(extra_state)
+        self._opt_state = None
+        self._jit_fn = None
+
+    def _build(self):
+        fn, opt = self._fn, self._opt
+        train_params, buffers = self._train_params, self._buffers
+
+        # donate params + optimizer state: XLA updates them in place
+        # (halves the peak HBM of the update; old arrays are invalidated,
+        # but __call__ rebinds every Tensor._data to the new buffers)
+        @functools.partial(jax.jit, donate_argnums=(0, 2))
+        def _step(param_arrays, buffer_arrays, opt_state, lr, key, args):
+            def loss_f(pa):
+                sink = {}
+                with _swap_data(train_params + buffers, list(pa) + list(buffer_arrays)):
+                    with rng.key_guard(key), mutation_sink(sink):
+                        loss = fn(*args)
+                loss_arr = loss._data if isinstance(loss, Tensor) else loss
+                mutated = []
+                for b in buffers:
+                    hit = sink.get(id(b))
+                    mutated.append(hit[1] if hit is not None else None)
+                return loss_arr.astype(jnp.float32), mutated
+
+            (loss, mutated), grads = jax.value_and_grad(loss_f, has_aux=True)(list(param_arrays))
+            if opt._grad_clip is not None:
+                grads = opt._grad_clip._clip_arrays(grads)
+            step = opt_state["step"] + 1
+            new_params, new_slots = [], []
+            for p_arr, g, slots in zip(param_arrays, grads, opt_state["slots"]):
+                np_, ns_ = opt._update(p_arr, g.astype(p_arr.dtype), slots, lr, step)
+                new_params.append(np_)
+                new_slots.append(ns_)
+            return loss, new_params, {"slots": new_slots, "step": step}, mutated
+
+        self._jit_fn = _step
+
+    def __call__(self, *args):
+        if self._jit_fn is None:
+            self._build()
+        if self._opt_state is None:
+            self._opt_state = {
+                "slots": [self._opt._init_slot(p._data) for p in self._train_params],
+                "step": jnp.zeros((), jnp.int32),
+            }
+        param_arrays = tuple(p._data for p in self._train_params)
+        buffer_arrays = tuple(b._data for b in self._buffers)
+        lr = jnp.asarray(self._opt.get_lr(), jnp.float32)
+        loss, new_params, self._opt_state, mutated = self._jit_fn(
+            param_arrays, buffer_arrays, self._opt_state, lr, rng.next_key(), args
+        )
+        for p, np_ in zip(self._train_params, new_params):
+            p._data = np_
+        for b, m in zip(self._buffers, mutated):
+            if m is not None:
+                b._data = m
+        self._opt._step_count = int(self._opt_state["step"])
+        return Tensor(loss)
+
+
+def grad_and_value(fn: Callable, params: List[Tensor]):
+    """Functional helper: returns jitted (loss, grads) over the given params."""
+
+    @jax.jit
+    def _gv(param_arrays, key, args):
+        def loss_f(pa):
+            with _swap_data(params, list(pa)):
+                with rng.key_guard(key):
+                    loss = fn(*args)
+            return (loss._data if isinstance(loss, Tensor) else loss).astype(jnp.float32)
+
+        return jax.value_and_grad(loss_f)(list(param_arrays))
+
+    def run(*args):
+        loss, grads = _gv(tuple(p._data for p in params), rng.next_key(), args)
+        return Tensor(loss), [Tensor(g) for g in grads]
+
+    return run
+
+
+class InputSpec:
+    """paddle.static.InputSpec parity. Dims of None/-1 are exported as
+    jax.export symbolic dimensions, so the saved program stays callable at
+    any size for those axes (the reference's dynamic-batch .pdmodel
+    contract)."""
+
+    def __init__(self, shape, dtype="float32", name=None):
+        self.shape = list(shape)
+        self.dtype = dtype
+        self.name = name
+
+    def to_sds(self, scope=None, prefix="d"):
+        import jax
+
+        from ..core.dtype import convert_dtype_arg
+
+        dtype = jnp.dtype(convert_dtype_arg(self.dtype))
+        if any(s is None or s < 0 for s in self.shape):
+            from jax import export as jexport
+
+            parts = [f"{prefix}{i}" if s is None or s < 0 else str(int(s))
+                     for i, s in enumerate(self.shape)]
+            shape = jexport.symbolic_shape(",".join(parts), scope=scope)
+        else:
+            shape = tuple(int(s) for s in self.shape)
+        return jax.ShapeDtypeStruct(tuple(shape), dtype)
+
+
+def save(layer, path, input_spec=None, **configs):
+    """jit.save — deployable export (≈ ref jit.save -> TranslatedLayer,
+    ref:python/paddle/jit/api.py).
+
+    Writes:
+      path.pdparams  — pickled numpy state dict (paddle contract)
+      path.pdmodel   — serialized StableHLO program (jax.export), callable
+                       after jit.load WITHOUT the Python model code — the
+                       compiled-program deployment story (replaces the
+                       reference's Program pbtxt + C++ executor).
+    Program export happens when input_spec is given (or the layer was
+    to_static-decorated with one).
+    """
+    import os
+    import pickle
+
+    import numpy as np
+
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    state = {}
+    if isinstance(layer, Layer):
+        state = {k: np.asarray(v._data) for k, v in layer.state_dict().items()}
+    with open(path + ".pdparams", "wb") as f:
+        pickle.dump(state, f, protocol=4)
+
+    if input_spec and isinstance(layer, Layer):
+        from jax import export as jexport
+
+        was_training = layer.training
+        layer.eval()
+        params, buffers = layer.functional_state()
+        objs = list(params.values()) + list(buffers.values())
+        arrays = [p._data for p in objs]
+
+        def fwd(param_arrays, *inputs):
+            with _swap_data(objs, list(param_arrays)):
+                with rng.key_guard(jax.random.key(0)):
+                    out = layer(*[Tensor(i) for i in inputs])
+            return out._data if isinstance(out, Tensor) else out
+
+        # One shared scope; unnamed specs share per-axis symbols (d0, d1, ...)
+        # so the common "all inputs share the dynamic batch/seq size" case
+        # exports with the dims constrained equal. A spec with name= gets its
+        # own symbols (name_0, ...) for genuinely independent dynamic dims.
+        scope = jexport.SymbolicScope()
+        sds = [s.to_sds(scope=scope, prefix=(f"{s.name}_" if s.name else "d"))
+               if isinstance(s, InputSpec) else s
+               for s in input_spec]
+        param_sds = [jax.ShapeDtypeStruct(a.shape, a.dtype) for a in arrays]
+        exp = jexport.export(jax.jit(fwd))(param_sds, *sds)
+        with open(path + ".pdmodel", "wb") as f:
+            pickle.dump({
+                "stablehlo": exp.serialize(),
+                "param_keys": list(params.keys()) + list(buffers.keys()),
+            }, f, protocol=4)
+        if was_training:
+            layer.train()
+
+
+class TranslatedLayer:
+    """Result of jit.load on an exported program: a callable that runs the
+    deserialized StableHLO with the saved parameters (no model code)."""
+
+    def __init__(self, exported, param_arrays):
+        self._exported = exported
+        self._params = param_arrays
+
+    def __call__(self, *inputs):
+        arrs = [i._data if isinstance(i, Tensor) else jnp.asarray(i) for i in inputs]
+        return Tensor(self._exported.call(self._params, *arrs))
+
+    def forward(self, *inputs):
+        return self(*inputs)
+
+    def eval(self):
+        return self
+
+    def train(self):
+        raise RuntimeError("TranslatedLayer is inference-only")
+
+
+def load(path, **configs):
+    """jit.load: returns a TranslatedLayer when a .pdmodel exists, else the
+    raw state dict (legacy contract)."""
+    import os
+    import pickle
+
+    if os.path.exists(path + ".pdmodel"):
+        from jax import export as jexport
+
+        with open(path + ".pdmodel", "rb") as f:
+            meta = pickle.load(f)
+        with open(path + ".pdparams", "rb") as f:
+            state = pickle.load(f)
+        exported = jexport.deserialize(meta["stablehlo"])
+        arrays = [jnp.asarray(state[k]) for k in meta["param_keys"]]
+        return TranslatedLayer(exported, arrays)
+    with open(path + ".pdparams", "rb") as f:
+        return pickle.load(f)

@@ -1,0 +1,36 @@
+"""paddle.linalg namespace parity (ref:python/paddle/linalg.py — a curated
+re-export of the tensor linalg ops; implementations in ops/linalg.py lower to
+single XLA linalg HLOs)."""
+from .ops.linalg import (  # noqa: F401
+    cholesky,
+    cholesky_solve,
+    cond,
+    corrcoef,
+    cov,
+    det,
+    eig,
+    eigh,
+    eigvals,
+    eigvalsh,
+    inv,
+    lstsq,
+    lu,
+    lu_unpack,
+    matrix_power,
+    matrix_rank,
+    multi_dot,
+    norm,
+    pinv,
+    qr,
+    slogdet,
+    solve,
+    svd,
+    triangular_solve,
+)
+
+__all__ = [
+    "cholesky", "cholesky_solve", "cond", "corrcoef", "cov", "det", "eig",
+    "eigh", "eigvals", "eigvalsh", "inv", "lstsq", "lu", "lu_unpack",
+    "matrix_power", "matrix_rank", "multi_dot", "norm", "pinv", "qr",
+    "slogdet", "solve", "svd", "triangular_solve",
+]

@@ -1,0 +1,16 @@
+"""Flagship model families (≈ the reference's fleetx/model-zoo configs used
+in its benchmark suites; ref:python/paddle/vision/models/ holds the vision
+zoo, which lives in paddle_tpu.vision.models)."""
+from .ernie import ErnieConfig, ErnieForPretraining, ErnieForSequenceClassification, ErnieModel, ernie_base, ernie_tiny  # noqa: F401
+from .gpt import (  # noqa: F401
+    GPTEmbeddingPipe,
+    GPTForCausalLMPipe,
+    GPTHeadPipe,
+    GPTConfig,
+    GPTForCausalLM,
+    GPTModel,
+    gpt_1p3b,
+    gpt_base,
+    gpt_tiny,
+)
+from .widedeep import DeepFM, DistributedEmbedding, WideDeep  # noqa: F401

@@ -1,0 +1,182 @@
+"""ERNIE family — benchmark config 3 (ERNIE-3.0-base pretraining, DP).
+
+Functional parity role: the ERNIE/BERT encoder stack the reference trains
+with Fleet data parallelism (external PaddleNLP model; in-repo analogue is
+nn.TransformerEncoder). Built TPU-first on the shared TP layers + GSPMD
+constraints like models/gpt.py: the same code runs pure-DP (config 3) or
+hybrid-sharded without modification.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from .. import nn
+from ..distributed.fleet.meta_parallel.mp_layers import (
+    ColumnParallelLinear,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+)
+from ..distributed.sharding_util import constraint
+from ..nn import functional as F
+from ..ops import creation, manipulation as M
+
+
+@dataclass
+class ErnieConfig:
+    vocab_size: int = 40000
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 4
+    hidden_dropout: float = 0.1
+    attention_dropout: float = 0.1
+    layer_norm_epsilon: float = 1e-12
+    use_recompute: bool = False
+
+
+def ernie_tiny(**kw) -> ErnieConfig:
+    return ErnieConfig(vocab_size=1024, hidden_size=128, num_layers=2,
+                       num_heads=4, intermediate_size=512,
+                       max_position_embeddings=128, hidden_dropout=0.0,
+                       attention_dropout=0.0, **kw)
+
+
+def ernie_base(**kw) -> ErnieConfig:
+    return ErnieConfig(**kw)
+
+
+class ErnieEmbeddings(nn.Layer):
+    def __init__(self, cfg: ErnieConfig):
+        super().__init__()
+        self.word_embeddings = VocabParallelEmbedding(cfg.vocab_size, cfg.hidden_size)
+        self.position_embeddings = nn.Embedding(cfg.max_position_embeddings, cfg.hidden_size)
+        self.token_type_embeddings = nn.Embedding(cfg.type_vocab_size, cfg.hidden_size)
+        self.layer_norm = nn.LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_epsilon)
+        self.dropout = nn.Dropout(cfg.hidden_dropout)
+
+    def forward(self, input_ids, token_type_ids=None):
+        b, s = input_ids.shape
+        pos = creation.arange(0, s, dtype="int32")
+        x = self.word_embeddings(input_ids) + self.position_embeddings(pos)
+        if token_type_ids is not None:
+            x = x + self.token_type_embeddings(token_type_ids)
+        x = self.dropout(self.layer_norm(x))
+        return constraint(x, "data", "sep", None)
+
+
+class ErnieSelfAttention(nn.Layer):
+    def __init__(self, cfg: ErnieConfig):
+        super().__init__()
+        h = cfg.hidden_size
+        self.num_heads = cfg.num_heads
+        self.head_dim = h // cfg.num_heads
+        self.qkv = ColumnParallelLinear(h, 3 * h, gather_output=False)
+        self.out = RowParallelLinear(h, h, input_is_parallel=True)
+        self.dropout_p = cfg.attention_dropout
+
+    def forward(self, x, attn_mask=None):
+        b, s, h = x.shape
+        qkv = self.qkv(x)
+        qkv = M.reshape(qkv, [b, s, 3, self.num_heads, self.head_dim])
+        qkv = constraint(qkv, "data", "sep", None, "model", None)
+        q, k, v = (M.squeeze(t, 2) for t in M.split(qkv, 3, axis=2))
+        out = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask, is_causal=False,
+            dropout_p=self.dropout_p if self.training else 0.0,
+            training=self.training)
+        out = M.reshape(out, [b, s, h])
+        return self.out(constraint(out, "data", "sep", "model"))
+
+
+class ErnieLayer(nn.Layer):
+    def __init__(self, cfg: ErnieConfig):
+        super().__init__()
+        self.attn = ErnieSelfAttention(cfg)
+        self.norm1 = nn.LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_epsilon)
+        self.up = ColumnParallelLinear(cfg.hidden_size, cfg.intermediate_size,
+                                       gather_output=False)
+        self.down = RowParallelLinear(cfg.intermediate_size, cfg.hidden_size,
+                                      input_is_parallel=True)
+        self.norm2 = nn.LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_epsilon)
+        self.dropout = nn.Dropout(cfg.hidden_dropout)
+
+    def forward(self, x, attn_mask=None):
+        # post-norm (BERT/ERNIE convention)
+        x = self.norm1(x + self.dropout(self.attn(x, attn_mask)))
+        y = self.down(F.gelu(self.up(x), approximate=True))
+        x = self.norm2(x + self.dropout(y))
+        return constraint(x, "data", "sep", None)
+
+
+class ErnieModel(nn.Layer):
+    def __init__(self, cfg: ErnieConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.embeddings = ErnieEmbeddings(cfg)
+        self.layers = nn.LayerList([ErnieLayer(cfg) for _ in range(cfg.num_layers)])
+        self.pooler = nn.Linear(cfg.hidden_size, cfg.hidden_size)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        import jax
+
+        x = self.embeddings(input_ids, token_type_ids)
+        for layer in self.layers:
+            if self.cfg.use_recompute and x._is_traced():
+                x = jax.checkpoint(
+                    layer, policy=jax.checkpoint_policies.nothing_saveable
+                )(x, attention_mask)
+            else:
+                x = layer(x, attention_mask)
+        pooled = F.tanh(self.pooler(x[:, 0]))
+        return x, pooled
+
+
+class ErnieForPretraining(nn.Layer):
+    """MLM + sentence-order heads (ERNIE pretraining objective)."""
+
+    def __init__(self, cfg: ErnieConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.ernie = ErnieModel(cfg)
+        self.mlm_transform = nn.Linear(cfg.hidden_size, cfg.hidden_size)
+        self.mlm_norm = nn.LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_epsilon)
+        self.nsp_head = nn.Linear(cfg.hidden_size, 2)
+
+    def forward(self, input_ids, token_type_ids=None, masked_lm_labels=None,
+                next_sentence_labels=None):
+        seq, pooled = self.ernie(input_ids, token_type_ids)
+        h = self.mlm_norm(F.gelu(self.mlm_transform(seq), approximate=True))
+        # tied decoder: project onto the (vocab-sharded) embedding matrix
+        logits = F.linear(h, M.transpose(self.ernie.embeddings.word_embeddings.weight, [1, 0]))
+        logits = constraint(logits, "data", "sep", "model")
+        if masked_lm_labels is None:
+            return logits
+        mlm_loss = F.cross_entropy(
+            M.reshape(logits, [-1, self.cfg.vocab_size]).astype("float32"),
+            M.reshape(masked_lm_labels, [-1]),
+            reduction="mean", ignore_index=-100)
+        if next_sentence_labels is not None:
+            nsp_logits = self.nsp_head(pooled).astype("float32")
+            nsp_loss = F.cross_entropy(nsp_logits,
+                                       M.reshape(next_sentence_labels, [-1]),
+                                       reduction="mean")
+            return mlm_loss + nsp_loss
+        return mlm_loss
+
+
+class ErnieForSequenceClassification(nn.Layer):
+    def __init__(self, cfg: ErnieConfig, num_classes: int = 2):
+        super().__init__()
+        self.ernie = ErnieModel(cfg)
+        self.dropout = nn.Dropout(cfg.hidden_dropout)
+        self.classifier = nn.Linear(cfg.hidden_size, num_classes)
+
+    def forward(self, input_ids, token_type_ids=None, labels=None):
+        _, pooled = self.ernie(input_ids, token_type_ids)
+        logits = self.classifier(self.dropout(pooled))
+        if labels is None:
+            return logits
+        return F.cross_entropy(logits.astype("float32"),
+                               M.reshape(labels, [-1]), reduction="mean")

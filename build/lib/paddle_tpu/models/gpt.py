@@ -1,0 +1,249 @@
+"""GPT family — the flagship transformer (benchmark config 4 of BASELINE.md:
+GPT-3 1.3B, tensor+pipeline hybrid).
+
+Functional parity with the reference's fleet GPT configs (TP layers from
+ref:python/paddle/distributed/fleet/layers/mpu/mp_layers.py, fused attention
+ref:python/paddle/incubate/nn/layer/fused_transformer.py), designed TPU-first:
+
+* weights carry GSPMD shardings (model axis for TP; the "sharding" axis gives
+  ZeRO-style param/optimizer partitioning when active),
+* activations are constrained ("data", "sep", None) so long sequences can be
+  context-parallel over the "sep" axis (the gap called out in SURVEY.md §5.7),
+* attention runs through ``F.scaled_dot_product_attention`` which picks the
+  Pallas flash kernel on TPU,
+* recompute = ``jax.checkpoint`` per decoder block (policy: save nothing —
+  trade FLOPs for HBM, SURVEY guidance).
+
+All shapes static; whole model jits into one XLA program via TrainStep/pjit.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import nn
+from ..core.tensor import Tensor
+from ..distributed.fleet.meta_parallel.mp_layers import (
+    ColumnParallelLinear,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+)
+from ..distributed.sharding_util import constraint
+from ..nn import functional as F
+from ..ops import creation, manipulation as M
+
+
+@dataclass
+class GPTConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    max_position_embeddings: int = 1024
+    intermediate_size: int = 0  # 0 -> 4*hidden
+    dropout: float = 0.0
+    layer_norm_epsilon: float = 1e-5
+    use_recompute: bool = False
+    tie_word_embeddings: bool = True
+
+    def __post_init__(self):
+        if self.intermediate_size == 0:
+            self.intermediate_size = 4 * self.hidden_size
+
+
+def gpt_tiny(**kw) -> "GPTConfig":
+    return GPTConfig(vocab_size=1024, hidden_size=128, num_layers=2, num_heads=4,
+                     max_position_embeddings=256, **kw)
+
+
+def gpt_base(**kw) -> "GPTConfig":
+    return GPTConfig(hidden_size=768, num_layers=12, num_heads=12, **kw)
+
+
+def gpt_1p3b(**kw) -> "GPTConfig":
+    return GPTConfig(hidden_size=2048, num_layers=24, num_heads=16,
+                     max_position_embeddings=2048, **kw)
+
+
+class GPTAttention(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        h = cfg.hidden_size
+        self.num_heads = cfg.num_heads
+        self.head_dim = h // cfg.num_heads
+        self.qkv = ColumnParallelLinear(h, 3 * h, gather_output=False)
+        self.proj = RowParallelLinear(h, h, input_is_parallel=True)
+        self.dropout = cfg.dropout
+
+    def forward(self, x):
+        b, s, h = x.shape
+        qkv = self.qkv(x)  # [b, s, 3h] sharded on model axis
+        qkv = M.reshape(qkv, [b, s, 3, self.num_heads, self.head_dim])
+        qkv = constraint(qkv, "data", "sep", None, "model", None)
+        qs = M.split(qkv, 3, axis=2)
+        q, k, v = (M.squeeze(t, 2) for t in qs)
+        out = F.scaled_dot_product_attention(q, k, v, is_causal=True,
+                                             dropout_p=self.dropout if self.training else 0.0)
+        out = M.reshape(out, [b, s, h])
+        out = constraint(out, "data", "sep", "model")
+        return self.proj(out)
+
+
+class GPTMLP(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.up = ColumnParallelLinear(cfg.hidden_size, cfg.intermediate_size, gather_output=False)
+        self.down = RowParallelLinear(cfg.intermediate_size, cfg.hidden_size, input_is_parallel=True)
+
+    def forward(self, x):
+        return self.down(F.gelu(self.up(x), approximate=True))
+
+
+class GPTDecoderLayer(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.ln1 = nn.LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_epsilon)
+        self.attn = GPTAttention(cfg)
+        self.ln2 = nn.LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_epsilon)
+        self.mlp = GPTMLP(cfg)
+        self.drop = nn.Dropout(cfg.dropout)
+
+    def forward(self, x):
+        x = x + self.drop(self.attn(self.ln1(x)))
+        x = x + self.drop(self.mlp(self.ln2(x)))
+        return constraint(x, "data", "sep", None)
+
+
+class GPTModel(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.wte = VocabParallelEmbedding(cfg.vocab_size, cfg.hidden_size)
+        self.wpe = nn.Embedding(cfg.max_position_embeddings, cfg.hidden_size)
+        self.drop = nn.Dropout(cfg.dropout)
+        self.layers = nn.LayerList([GPTDecoderLayer(cfg) for _ in range(cfg.num_layers)])
+        self.ln_f = nn.LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_epsilon)
+
+    def forward(self, input_ids):
+        b, s = input_ids.shape
+        pos = creation.arange(0, s, dtype="int32")
+        x = self.wte(input_ids) + self.wpe(pos)
+        x = constraint(self.drop(x), "data", "sep", None)
+        for layer in self.layers:
+            if self.cfg.use_recompute and x._is_traced():
+                x = jax.checkpoint(layer, policy=jax.checkpoint_policies.nothing_saveable)(x)
+            else:
+                x = layer(x)
+        return self.ln_f(x)
+
+
+class GPTEmbeddingPipe(nn.Layer):
+    """First pipeline section: token + position embeddings."""
+
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.wte = VocabParallelEmbedding(cfg.vocab_size, cfg.hidden_size)
+        self.wpe = nn.Embedding(cfg.max_position_embeddings, cfg.hidden_size)
+        self.drop = nn.Dropout(cfg.dropout)
+
+    def forward(self, input_ids):
+        b, s = input_ids.shape
+        pos = creation.arange(0, s, dtype="int32")
+        x = self.wte(input_ids) + self.wpe(pos)
+        return constraint(self.drop(x), "data", "sep", None)
+
+
+class GPTHeadPipe(nn.Layer):
+    """Last pipeline section: final norm + (tied) LM head."""
+
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.ln_f = nn.LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_epsilon)
+        if not cfg.tie_word_embeddings:
+            self.head = ColumnParallelLinear(cfg.hidden_size, cfg.vocab_size, has_bias=False)
+
+    def forward(self, x, shared_weight=None):
+        h = self.ln_f(x)
+        if self.cfg.tie_word_embeddings:
+            logits = F.linear(h, M.transpose(shared_weight, [1, 0]))
+        else:
+            logits = self.head(h)
+        return constraint(logits, "data", "sep", "model")
+
+
+def gpt_pipe_loss(logits, labels):
+    vocab = logits.shape[-1]
+    return F.cross_entropy(
+        M.reshape(logits, [-1, vocab]).astype("float32"),
+        M.reshape(labels, [-1]),
+        reduction="mean",
+    )
+
+
+def GPTForCausalLMPipe(cfg: GPTConfig, num_stages=None, num_microbatches: int = 1):
+    """Pipeline-parallel GPT (parity role: the reference's fleet
+    GPTForPretrainingPipe built from LayerDesc lists). Decoder blocks form
+    the stage-stacked homogeneous run; embedding/head run under GSPMD on
+    every stage; tied embeddings share the wte Parameter object."""
+    from ..distributed.fleet.meta_parallel import LayerDesc, PipelineLayer
+
+    emb = GPTEmbeddingPipe(cfg)
+    descs = [emb]
+    descs += [LayerDesc(GPTDecoderLayer, cfg) for _ in range(cfg.num_layers)]
+    head = GPTHeadPipe(cfg)
+    if cfg.tie_word_embeddings:
+        head_wrap = _TiedHead(head, emb)
+        descs.append(head_wrap)
+    else:
+        descs.append(head)
+    return PipelineLayer(
+        descs,
+        num_stages=num_stages,
+        loss_fn=gpt_pipe_loss,
+        num_microbatches=num_microbatches,
+        recompute_interval=1 if cfg.use_recompute else 0,
+    )
+
+
+class _TiedHead(nn.Layer):
+    """Binds the shared embedding weight into the head's forward (the
+    SharedLayerDesc tie: same Parameter object, grads sum automatically)."""
+
+    def __init__(self, head: GPTHeadPipe, emb: GPTEmbeddingPipe):
+        super().__init__()
+        self.head = head
+        object.__setattr__(self, "_emb_ref", emb)  # not a sublayer: no double-count
+
+    def forward(self, x):
+        return self.head(x, shared_weight=self._emb_ref.wte.weight)
+
+
+class GPTForCausalLM(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.gpt = GPTModel(cfg)
+        if not cfg.tie_word_embeddings:
+            self.lm_head = ColumnParallelLinear(cfg.hidden_size, cfg.vocab_size, has_bias=False)
+
+    def forward(self, input_ids, labels=None):
+        h = self.gpt(input_ids)
+        if self.cfg.tie_word_embeddings:
+            logits = F.linear(h, M.transpose(self.gpt.wte.weight, [1, 0]))
+        else:
+            logits = self.lm_head(h)
+        logits = constraint(logits, "data", "sep", "model")
+        if labels is None:
+            return logits
+        loss = F.cross_entropy(
+            M.reshape(logits, [-1, self.cfg.vocab_size]).astype("float32"),
+            M.reshape(labels, [-1]),
+            reduction="mean",
+        )
+        return loss

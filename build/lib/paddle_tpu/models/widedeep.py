@@ -1,0 +1,115 @@
+"""Wide&Deep and DeepFM — benchmark config 5 (sparse embedding training).
+
+The reference serves huge sparse tables from a brpc parameter server
+(ref:paddle/fluid/distributed/ps/, SURVEY.md §2.2 'Parameter server').
+TPU-native redesign: the table IS device memory — a hash-bucketed embedding
+row-sharded over the mesh ("model" axis when active, else "sharding"/"data");
+GSPMD turns per-step lookups into the same sparse gather + all-to-all the PS
+client performs, but fused into the step and riding ICI instead of RPC.
+Capacity scales with chips (v5e-64 pod ≈ 1TB+ HBM ≈ tens of billions of
+fp32 embedding parameters), which covers the reference's "100 billion
+features" claim once dims are accounted for.
+"""
+from __future__ import annotations
+
+from typing import List, Sequence
+
+from .. import nn
+from ..distributed.sharding_util import constraint, shard_parameter
+from ..nn import functional as F
+from ..ops import manipulation as M
+
+
+class DistributedEmbedding(nn.Layer):
+    """Hash-bucketed sparse embedding, vocab-sharded over the mesh.
+
+    ``ids`` may be arbitrary int64 feature hashes; they are mapped into
+    [0, num_buckets) on device (the PS client's hash in ref
+    memory_sparse_table.cc), then gathered from the sharded table."""
+
+    def __init__(self, num_buckets: int, embedding_dim: int, axis: str = "model"):
+        super().__init__()
+        from ..nn import initializer as I
+
+        self.num_buckets = num_buckets
+        self.weight = self.create_parameter(
+            [num_buckets, embedding_dim], default_initializer=I.Normal(0.0, 0.01))
+        shard_parameter(self.weight, axis, None)
+
+    def forward(self, ids):
+        hashed = ids.astype("int64") % self.num_buckets
+        return F.embedding(hashed, self.weight)
+
+
+class WideDeep(nn.Layer):
+    """ref benchmark Wide&Deep: wide linear-in-sparse + deep MLP over
+    concatenated field embeddings + dense features."""
+
+    def __init__(self, num_fields: int = 26, num_dense: int = 13,
+                 num_buckets: int = 1000001, embedding_dim: int = 16,
+                 hidden_sizes: Sequence[int] = (400, 400, 400),
+                 sparse_embedding=None, wide_embedding=None):
+        """``sparse_embedding``/``wide_embedding`` may inject e.g. a
+        ``distributed.ps.PSEmbedding`` (host-RAM table service) in place of
+        the default mesh-sharded HBM table — the PS-mode Wide&Deep of the
+        reference (ref:python/paddle/distributed/ps/the_one_ps.py)."""
+        super().__init__()
+        self.num_fields = num_fields
+        self.embedding = sparse_embedding or DistributedEmbedding(
+            num_buckets, embedding_dim)
+        self.wide = wide_embedding or DistributedEmbedding(num_buckets, 1)
+        self.dense_wide = nn.Linear(num_dense, 1)
+        dims = [num_fields * embedding_dim + num_dense] + list(hidden_sizes)
+        mlp = []
+        for i in range(len(hidden_sizes)):
+            mlp += [nn.Linear(dims[i], dims[i + 1]), nn.ReLU()]
+        mlp.append(nn.Linear(dims[-1], 1))
+        self.deep = nn.Sequential(*mlp)
+
+    def forward(self, sparse_ids, dense):
+        """sparse_ids [b, fields] int; dense [b, num_dense] float."""
+        b = sparse_ids.shape[0]
+        emb = self.embedding(sparse_ids)                       # [b, f, d]
+        emb = constraint(emb, "data", None, None)
+        deep_in = M.concat([M.reshape(emb, [b, -1]), dense], axis=1)
+        deep_out = self.deep(deep_in)                          # [b, 1]
+        wide_out = self.wide(sparse_ids).sum(axis=1) + self.dense_wide(dense)
+        return deep_out + wide_out                             # logits [b, 1]
+
+    def loss(self, logits, labels):
+        return F.binary_cross_entropy_with_logits(
+            logits.astype("float32"), labels.astype("float32"), reduction="mean")
+
+
+class DeepFM(nn.Layer):
+    """DeepFM: first-order + pairwise FM interaction + deep MLP."""
+
+    def __init__(self, num_fields: int = 26, num_dense: int = 13,
+                 num_buckets: int = 1000001, embedding_dim: int = 16,
+                 hidden_sizes: Sequence[int] = (400, 400)):
+        super().__init__()
+        self.embedding = DistributedEmbedding(num_buckets, embedding_dim)
+        self.first_order = DistributedEmbedding(num_buckets, 1)
+        self.dense_proj = nn.Linear(num_dense, embedding_dim)
+        self.dense_first = nn.Linear(num_dense, 1)
+        dims = [num_fields * embedding_dim + num_dense] + list(hidden_sizes)
+        mlp = []
+        for i in range(len(hidden_sizes)):
+            mlp += [nn.Linear(dims[i], dims[i + 1]), nn.ReLU()]
+        mlp.append(nn.Linear(dims[-1], 1))
+        self.deep = nn.Sequential(*mlp)
+
+    def forward(self, sparse_ids, dense):
+        b = sparse_ids.shape[0]
+        emb = self.embedding(sparse_ids)                       # [b, f, d]
+        first = self.first_order(sparse_ids).sum(axis=1) + self.dense_first(dense)
+        # FM second order over field embeddings + projected dense as a field
+        dense_f = M.unsqueeze(self.dense_proj(dense), 1)       # [b, 1, d]
+        fields = M.concat([emb, dense_f], axis=1)              # [b, f+1, d]
+        sum_sq = fields.sum(axis=1) ** 2                       # [b, d]
+        sq_sum = (fields ** 2).sum(axis=1)
+        fm = 0.5 * (sum_sq - sq_sum).sum(axis=1, keepdim=True)  # [b, 1]
+        deep_out = self.deep(M.concat([M.reshape(emb, [b, -1]), dense], axis=1))
+        return first + fm + deep_out
+
+    loss = WideDeep.loss

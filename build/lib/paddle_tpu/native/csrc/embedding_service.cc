@@ -1,0 +1,565 @@
+// Sparse embedding service: the TPU-native replacement for the reference's
+// parameter-server stack (ref:paddle/fluid/distributed/ps/service/brpc_ps_server.cc,
+// ref:paddle/fluid/distributed/ps/table/memory_sparse_table.h:39,
+// ref:paddle/fluid/distributed/ps/table/sparse_sgd_rule.cc).
+//
+// Design: dense model parameters live in HBM and are trained by the compiled
+// XLA step; *sparse* embedding tables too large for HBM live in host RAM,
+// sharded across hosts. Workers PULL rows for the unique ids of a batch
+// (missing rows are lazily initialized server-side), run the device step, and
+// PUSH per-id gradients back; the server applies the sparse optimizer rule
+// (SGD / Adagrad / Adam with per-row state). Communication is a simple
+// length-prefixed binary protocol over TCP (DCN), replacing brpc.
+//
+// Not copied from the reference: single-file flat C ABI (used via ctypes),
+// open-addressing std::unordered_map shards with per-shard mutexes, and the
+// optimizer state stored inline after the embedding row.
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <mutex>
+#include <random>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+// ------------------------------------------------------------------ wire
+// request:  u8 op | u64 payload_len | payload
+// response: i64 status_or_len | payload
+enum Op : uint8_t {
+  OP_PULL = 1,   // u32 n, u64 ids[n]                 -> f32 rows[n*dim]
+  OP_PUSH = 2,   // u32 n, f32 lr, u64 ids[n], f32 g[n*dim] -> status 0
+  OP_SAVE = 3,   // path string                       -> status
+  OP_LOAD = 4,   // path string                       -> status
+  OP_STATS = 5,  // -                                 -> u64 rows, u64 bytes
+  OP_CLEAR = 6,  // -                                 -> status
+};
+
+bool read_n(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_n(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n) {
+    ssize_t r = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+// ------------------------------------------------------------------ table
+
+enum Rule : int {
+  RULE_SGD = 0,      // w -= lr * g                     (state: none)
+  RULE_ADAGRAD = 1,  // acc += g^2; w -= lr*g/sqrt(acc+eps)  (state: dim)
+  RULE_ADAM = 2,     // m,v moments                      (state: 2*dim + 1)
+};
+
+struct TableConfig {
+  int dim = 8;
+  int rule = RULE_SGD;
+  float init_range = 0.01f;  // uniform(-r, r) lazy init
+  float beta1 = 0.9f;
+  float beta2 = 0.999f;
+  float eps = 1e-8f;
+  uint64_t seed = 42;
+};
+
+class SparseTable {
+ public:
+  explicit SparseTable(const TableConfig& cfg) : cfg_(cfg) {
+    row_len_ = cfg.dim;
+    if (cfg.rule == RULE_ADAGRAD) row_len_ += cfg.dim;
+    if (cfg.rule == RULE_ADAM) row_len_ += 2 * cfg.dim + 1;  // m, v, step
+  }
+
+  // Copy the embedding part of each id's row into out (n * dim floats),
+  // creating missing rows with the deterministic per-id initializer.
+  void Pull(const uint64_t* ids, uint32_t n, float* out) {
+    for (uint32_t i = 0; i < n; ++i) {
+      Shard& s = shard(ids[i]);
+      std::lock_guard<std::mutex> lk(s.mu);
+      std::vector<float>& row = FindOrInit(s, ids[i]);
+      memcpy(out + static_cast<size_t>(i) * cfg_.dim, row.data(),
+             sizeof(float) * cfg_.dim);
+    }
+  }
+
+  void Push(const uint64_t* ids, uint32_t n, const float* grads, float lr) {
+    for (uint32_t i = 0; i < n; ++i) {
+      Shard& s = shard(ids[i]);
+      std::lock_guard<std::mutex> lk(s.mu);
+      std::vector<float>& row = FindOrInit(s, ids[i]);
+      const float* g = grads + static_cast<size_t>(i) * cfg_.dim;
+      ApplyRule(row.data(), g, lr);
+    }
+  }
+
+  uint64_t NumRows() {
+    uint64_t n = 0;
+    for (auto& s : shards_) {
+      std::lock_guard<std::mutex> lk(s.mu);
+      n += s.rows.size();
+    }
+    return n;
+  }
+
+  uint64_t Bytes() { return NumRows() * row_len_ * sizeof(float); }
+
+  void Clear() {
+    for (auto& s : shards_) {
+      std::lock_guard<std::mutex> lk(s.mu);
+      s.rows.clear();
+    }
+  }
+
+  // Binary dump: header (magic, dim, rule, row_len, count) then
+  // (id, row floats) records. The sparse analog of fleet.save_persistables.
+  bool Save(const char* path) {
+    FILE* f = fopen(path, "wb");
+    if (!f) return false;
+    uint64_t magic = 0x70747370'61727365ULL;  // "ptspARSE"
+    uint64_t count = NumRows();
+    uint64_t dim = cfg_.dim, rule = cfg_.rule, rl = row_len_;
+    fwrite(&magic, 8, 1, f);
+    fwrite(&dim, 8, 1, f);
+    fwrite(&rule, 8, 1, f);
+    fwrite(&rl, 8, 1, f);
+    fwrite(&count, 8, 1, f);
+    for (auto& s : shards_) {
+      std::lock_guard<std::mutex> lk(s.mu);
+      for (auto& kv : s.rows) {
+        fwrite(&kv.first, 8, 1, f);
+        fwrite(kv.second.data(), sizeof(float), row_len_, f);
+      }
+    }
+    fclose(f);
+    return true;
+  }
+
+  bool Load(const char* path) {
+    FILE* f = fopen(path, "rb");
+    if (!f) return false;
+    uint64_t magic = 0, dim = 0, rule = 0, rl = 0, count = 0;
+    bool ok = fread(&magic, 8, 1, f) == 1 && fread(&dim, 8, 1, f) == 1 &&
+              fread(&rule, 8, 1, f) == 1 && fread(&rl, 8, 1, f) == 1 &&
+              fread(&count, 8, 1, f) == 1;
+    if (!ok || magic != 0x70747370'61727365ULL ||
+        dim != static_cast<uint64_t>(cfg_.dim) || rl != row_len_) {
+      fclose(f);
+      return false;
+    }
+    Clear();
+    for (uint64_t i = 0; i < count; ++i) {
+      uint64_t id;
+      std::vector<float> row(row_len_);
+      if (fread(&id, 8, 1, f) != 1 ||
+          fread(row.data(), sizeof(float), row_len_, f) != row_len_) {
+        fclose(f);
+        return false;
+      }
+      Shard& s = shard(id);
+      std::lock_guard<std::mutex> lk(s.mu);
+      s.rows[id] = std::move(row);
+    }
+    fclose(f);
+    return true;
+  }
+
+  int dim() const { return cfg_.dim; }
+
+ private:
+  static constexpr int kShards = 64;  // per-table lock striping
+  struct Shard {
+    std::mutex mu;
+    std::unordered_map<uint64_t, std::vector<float>> rows;
+  };
+
+  Shard& shard(uint64_t id) {
+    // splitmix-style scramble so striping is independent of client routing
+    uint64_t h = id * 0x9e3779b97f4a7c15ULL;
+    return shards_[(h >> 32) % kShards];
+  }
+
+  std::vector<float>& FindOrInit(Shard& s, uint64_t id) {
+    auto it = s.rows.find(id);
+    if (it != s.rows.end()) return it->second;
+    std::vector<float> row(row_len_, 0.0f);
+    // deterministic per-id init -> pull order / restarts don't change values
+    std::mt19937_64 gen(cfg_.seed ^ (id * 0xff51afd7ed558ccdULL));
+    std::uniform_real_distribution<float> dist(-cfg_.init_range,
+                                               cfg_.init_range);
+    for (int d = 0; d < cfg_.dim; ++d) row[d] = dist(gen);
+    return s.rows.emplace(id, std::move(row)).first->second;
+  }
+
+  void ApplyRule(float* row, const float* g, float lr) {
+    int D = cfg_.dim;
+    switch (cfg_.rule) {
+      case RULE_SGD:
+        for (int d = 0; d < D; ++d) row[d] -= lr * g[d];
+        break;
+      case RULE_ADAGRAD: {
+        float* acc = row + D;
+        for (int d = 0; d < D; ++d) {
+          acc[d] += g[d] * g[d];
+          row[d] -= lr * g[d] / (std::sqrt(acc[d]) + cfg_.eps);
+        }
+        break;
+      }
+      case RULE_ADAM: {
+        float* m = row + D;
+        float* v = row + 2 * D;
+        float& step = row[3 * D];
+        step += 1.0f;
+        float bc1 = 1.0f - std::pow(cfg_.beta1, step);
+        float bc2 = 1.0f - std::pow(cfg_.beta2, step);
+        for (int d = 0; d < D; ++d) {
+          m[d] = cfg_.beta1 * m[d] + (1.0f - cfg_.beta1) * g[d];
+          v[d] = cfg_.beta2 * v[d] + (1.0f - cfg_.beta2) * g[d] * g[d];
+          row[d] -= lr * (m[d] / bc1) / (std::sqrt(v[d] / bc2) + cfg_.eps);
+        }
+        break;
+      }
+    }
+  }
+
+  TableConfig cfg_;
+  uint64_t row_len_;
+  Shard shards_[kShards];
+};
+
+// ------------------------------------------------------------------ server
+
+class EmbServer {
+ public:
+  EmbServer(int port, const TableConfig& cfg) : table_(cfg) {
+    listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    int one = 1;
+    setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    if (bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) !=
+            0 ||
+        listen(listen_fd_, 128) != 0) {
+      ::close(listen_fd_);
+      listen_fd_ = -1;
+      return;
+    }
+    socklen_t len = sizeof(addr);
+    getsockname(listen_fd_, reinterpret_cast<sockaddr*>(&addr), &len);
+    port_ = ntohs(addr.sin_port);
+    accept_thread_ = std::thread([this] { AcceptLoop(); });
+  }
+
+  ~EmbServer() { Stop(); }
+
+  void Stop() {
+    bool expected = false;
+    if (!stopping_.compare_exchange_strong(expected, true)) return;
+    if (listen_fd_ >= 0) {
+      ::shutdown(listen_fd_, SHUT_RDWR);
+      ::close(listen_fd_);
+    }
+    {
+      std::lock_guard<std::mutex> lk(clients_mu_);
+      for (int fd : client_fds_) ::shutdown(fd, SHUT_RDWR);
+    }
+    if (accept_thread_.joinable()) accept_thread_.join();
+    // join OUTSIDE clients_mu_: exiting workers lock it to deregister
+    // their fd, so joining while holding it deadlocks
+    std::vector<std::thread> workers;
+    {
+      std::lock_guard<std::mutex> lk(clients_mu_);
+      workers.swap(workers_);
+    }
+    for (auto& t : workers)
+      if (t.joinable()) t.join();
+  }
+
+  int port() const { return port_; }
+  bool ok() const { return listen_fd_ >= 0; }
+  SparseTable& table() { return table_; }
+
+ private:
+  void AcceptLoop() {
+    while (!stopping_.load()) {
+      int fd = ::accept(listen_fd_, nullptr, nullptr);
+      if (fd < 0) break;
+      int one = 1;
+      setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      std::lock_guard<std::mutex> lk(clients_mu_);
+      client_fds_.push_back(fd);
+      workers_.emplace_back([this, fd] { Serve(fd); });
+    }
+  }
+
+  void Serve(int fd) {
+    std::vector<char> payload;
+    while (!stopping_.load()) {
+      uint8_t op;
+      uint64_t plen;
+      if (!read_n(fd, &op, 1) || !read_n(fd, &plen, 8)) break;
+      if (plen > (1ULL << 33)) break;  // 8GB sanity cap
+      payload.resize(plen);
+      if (plen && !read_n(fd, payload.data(), plen)) break;
+      if (!Handle(fd, op, payload)) break;
+    }
+    ::close(fd);
+    std::lock_guard<std::mutex> lk(clients_mu_);
+    for (size_t i = 0; i < client_fds_.size(); ++i)
+      if (client_fds_[i] == fd) {
+        client_fds_.erase(client_fds_.begin() + i);
+        break;
+      }
+  }
+
+  bool Handle(int fd, uint8_t op, std::vector<char>& p) {
+    const int D = table_.dim();
+    switch (op) {
+      case OP_PULL: {
+        if (p.size() < 4) return false;
+        uint32_t n;
+        memcpy(&n, p.data(), 4);
+        if (p.size() != 4 + 8ULL * n) return false;
+        const uint64_t* ids = reinterpret_cast<const uint64_t*>(p.data() + 4);
+        std::vector<float> rows(static_cast<size_t>(n) * D);
+        table_.Pull(ids, n, rows.data());
+        int64_t len = static_cast<int64_t>(rows.size() * sizeof(float));
+        return write_n(fd, &len, 8) && write_n(fd, rows.data(), len);
+      }
+      case OP_PUSH: {
+        if (p.size() < 8) return false;
+        uint32_t n;
+        float lr;
+        memcpy(&n, p.data(), 4);
+        memcpy(&lr, p.data() + 4, 4);
+        size_t want = 8 + 8ULL * n + sizeof(float) * static_cast<size_t>(n) * D;
+        if (p.size() != want) return false;
+        const uint64_t* ids = reinterpret_cast<const uint64_t*>(p.data() + 8);
+        const float* g =
+            reinterpret_cast<const float*>(p.data() + 8 + 8ULL * n);
+        table_.Push(ids, n, g, lr);
+        int64_t st = 0;
+        return write_n(fd, &st, 8);
+      }
+      case OP_SAVE:
+      case OP_LOAD: {
+        std::string path(p.data(), p.size());
+        bool ok = op == OP_SAVE ? table_.Save(path.c_str())
+                                : table_.Load(path.c_str());
+        int64_t st = ok ? 0 : -1;
+        return write_n(fd, &st, 8);
+      }
+      case OP_STATS: {
+        int64_t len = 16;
+        uint64_t stats[2] = {table_.NumRows(), table_.Bytes()};
+        return write_n(fd, &len, 8) && write_n(fd, stats, 16);
+      }
+      case OP_CLEAR: {
+        table_.Clear();
+        int64_t st = 0;
+        return write_n(fd, &st, 8);
+      }
+      default:
+        return false;
+    }
+  }
+
+  SparseTable table_;
+  int listen_fd_ = -1;
+  int port_ = 0;
+  std::atomic<bool> stopping_{false};
+  std::thread accept_thread_;
+  std::mutex clients_mu_;
+  std::vector<int> client_fds_;
+  std::vector<std::thread> workers_;
+};
+
+// ------------------------------------------------------------------ client
+
+class EmbClient {
+ public:
+  EmbClient(const char* host, int port, int timeout_ms) {
+    addrinfo hints{}, *res = nullptr;
+    hints.ai_family = AF_INET;
+    hints.ai_socktype = SOCK_STREAM;
+    std::string ps = std::to_string(port);
+    if (getaddrinfo(host, ps.c_str(), &hints, &res) != 0) return;
+    for (int attempt = 0; attempt * 50 < timeout_ms || attempt == 0;
+         ++attempt) {
+      fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+      if (::connect(fd_, res->ai_addr, res->ai_addrlen) == 0) break;
+      ::close(fd_);
+      fd_ = -1;
+      struct timespec ts {
+        0, 50 * 1000000
+      };
+      nanosleep(&ts, nullptr);
+    }
+    freeaddrinfo(res);
+    if (fd_ >= 0) {
+      int one = 1;
+      setsockopt(fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    }
+  }
+
+  ~EmbClient() {
+    if (fd_ >= 0) ::close(fd_);
+  }
+
+  bool ok() const { return fd_ >= 0; }
+
+  int64_t Request(uint8_t op, const void* payload, uint64_t plen, void* out,
+                  uint64_t out_cap) {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (fd_ < 0) return -2;
+    if (!write_n(fd_, &op, 1) || !write_n(fd_, &plen, 8) ||
+        (plen && !write_n(fd_, payload, plen)))
+      return -2;
+    int64_t len;
+    if (!read_n(fd_, &len, 8)) return -2;
+    if (len < 0) return len;
+    if (static_cast<uint64_t>(len) > out_cap) return -3;
+    if (len && !read_n(fd_, out, static_cast<size_t>(len))) return -2;
+    return len;
+  }
+
+ private:
+  int fd_ = -1;
+  std::mutex mu_;
+};
+
+}  // namespace
+
+// ------------------------------------------------------------------ C ABI
+
+extern "C" {
+
+void* pt_emb_server_start(int port, int dim, int rule, float init_range,
+                          long long seed) {
+  TableConfig cfg;
+  cfg.dim = dim;
+  cfg.rule = rule;
+  cfg.init_range = init_range;
+  cfg.seed = static_cast<uint64_t>(seed);
+  auto* s = new EmbServer(port, cfg);
+  if (!s->ok()) {
+    delete s;
+    return nullptr;
+  }
+  return s;
+}
+
+int pt_emb_server_port(void* h) { return static_cast<EmbServer*>(h)->port(); }
+
+void pt_emb_server_stop(void* h) {
+  auto* s = static_cast<EmbServer*>(h);
+  s->Stop();
+  delete s;
+}
+
+// in-process shortcuts (single-host mode / tests)
+long long pt_emb_server_rows(void* h) {
+  return static_cast<long long>(static_cast<EmbServer*>(h)->table().NumRows());
+}
+
+long long pt_emb_server_bytes(void* h) {
+  return static_cast<long long>(static_cast<EmbServer*>(h)->table().Bytes());
+}
+
+void* pt_emb_connect(const char* host, int port, int timeout_ms) {
+  auto* c = new EmbClient(host, port, timeout_ms);
+  if (!c->ok()) {
+    delete c;
+    return nullptr;
+  }
+  return c;
+}
+
+void pt_emb_disconnect(void* h) { delete static_cast<EmbClient*>(h); }
+
+// ids: n uint64; out: n*dim float32. Returns 0 on success.
+int pt_emb_pull(void* h, const unsigned long long* ids, unsigned int n,
+                int dim, float* out) {
+  std::vector<char> payload(4 + 8ULL * n);
+  memcpy(payload.data(), &n, 4);
+  memcpy(payload.data() + 4, ids, 8ULL * n);
+  int64_t r = static_cast<EmbClient*>(h)->Request(
+      OP_PULL, payload.data(), payload.size(), out,
+      sizeof(float) * static_cast<uint64_t>(n) * dim);
+  return r == static_cast<int64_t>(sizeof(float) * static_cast<uint64_t>(n) *
+                                   dim)
+             ? 0
+             : -1;
+}
+
+int pt_emb_push(void* h, const unsigned long long* ids, unsigned int n,
+                int dim, const float* grads, float lr) {
+  std::vector<char> payload(8 + 8ULL * n +
+                            sizeof(float) * static_cast<size_t>(n) * dim);
+  memcpy(payload.data(), &n, 4);
+  memcpy(payload.data() + 4, &lr, 4);
+  memcpy(payload.data() + 8, ids, 8ULL * n);
+  memcpy(payload.data() + 8 + 8ULL * n, grads,
+         sizeof(float) * static_cast<size_t>(n) * dim);
+  int64_t r = static_cast<EmbClient*>(h)->Request(OP_PUSH, payload.data(),
+                                                  payload.size(), nullptr, 0);
+  return r == 0 ? 0 : -1;
+}
+
+int pt_emb_save(void* h, const char* path) {
+  return static_cast<EmbClient*>(h)->Request(OP_SAVE, path, strlen(path),
+                                             nullptr, 0) == 0
+             ? 0
+             : -1;
+}
+
+int pt_emb_load(void* h, const char* path) {
+  return static_cast<EmbClient*>(h)->Request(OP_LOAD, path, strlen(path),
+                                             nullptr, 0) == 0
+             ? 0
+             : -1;
+}
+
+int pt_emb_clear(void* h) {
+  return static_cast<EmbClient*>(h)->Request(OP_CLEAR, nullptr, 0, nullptr,
+                                             0) == 0
+             ? 0
+             : -1;
+}
+
+// out: [rows, bytes]
+int pt_emb_stats(void* h, unsigned long long* out) {
+  return static_cast<EmbClient*>(h)->Request(OP_STATS, nullptr, 0, out, 16) ==
+                 16
+             ? 0
+             : -1;
+}
+
+}  // extern "C"

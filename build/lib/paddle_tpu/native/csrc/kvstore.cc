@@ -1,0 +1,376 @@
+// TCP key-value store — the bootstrap/rendezvous service.
+//
+// Native equivalent of the reference's C++ TCPStore
+// (ref:paddle/phi/core/distributed/store/tcp_store.h:120, tcp_utils.cc):
+// rank 0 hosts the table; clients connect over DCN and issue SET/GET/WAIT/
+// ADD/BARRIER. Used for multi-host mesh bootstrap, data coordination and
+// checkpoint barriers; collectives themselves are XLA-compiled (no comm lib).
+//
+// Wire format: [1B op][4B klen][key][4B vlen][value]; replies [4B len][data].
+// Exported as a C ABI consumed via ctypes (no pybind dependency).
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+enum Op : uint8_t { SET = 1, GET = 2, ADD = 3, WAIT = 4, BARRIER_HIT = 5, DEL = 6 };
+
+struct Server {
+  int listen_fd = -1;
+  std::thread accept_thread;
+  std::atomic<bool> stop{false};
+  std::mutex mu;
+  std::condition_variable cv;
+  std::map<std::string, std::string> table;
+  std::map<std::string, int64_t> counters;
+  int world_size = 1;
+  std::vector<std::thread> workers;
+  // Live client fds, so pt_store_server_stop can shutdown() them to unblock
+  // workers; workers are joined, never detached, so no thread can outlive
+  // the Server. A worker erases + closes its own fd on disconnect and queues
+  // its thread id in `finished` for the accept loop to reap (bounds fd and
+  // thread growth on long-lived servers with client churn).
+  std::mutex fds_mu;
+  std::vector<int> client_fds;
+  std::vector<std::thread::id> finished;
+};
+
+bool read_n(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_n(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n) {
+    ssize_t r = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool read_blob(int fd, std::string* out) {
+  uint32_t len_net;
+  if (!read_n(fd, &len_net, 4)) return false;
+  uint32_t len = ntohl(len_net);
+  out->resize(len);
+  return len == 0 || read_n(fd, out->data(), len);
+}
+
+bool write_blob(int fd, const std::string& s) {
+  uint32_t len_net = htonl(static_cast<uint32_t>(s.size()));
+  if (!write_n(fd, &len_net, 4)) return false;
+  return s.empty() || write_n(fd, s.data(), s.size());
+}
+
+void serve_loop(Server* srv, int fd) {
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  for (;;) {
+    uint8_t op;
+    if (!read_n(fd, &op, 1)) break;
+    std::string key;
+    if (!read_blob(fd, &key)) break;
+    switch (op) {
+      case SET: {
+        std::string val;
+        if (!read_blob(fd, &val)) return;
+        {
+          std::lock_guard<std::mutex> g(srv->mu);
+          srv->table[key] = std::move(val);
+        }
+        srv->cv.notify_all();
+        if (!write_blob(fd, "1")) return;
+        break;
+      }
+      case GET: {
+        std::string val;
+        bool found;
+        {
+          std::lock_guard<std::mutex> g(srv->mu);
+          auto it = srv->table.find(key);
+          found = it != srv->table.end();
+          if (found) val = it->second;
+        }
+        if (!write_blob(fd, found ? val : std::string())) return;
+        break;
+      }
+      case ADD: {
+        std::string val;
+        if (!read_blob(fd, &val)) return;
+        int64_t delta = std::strtoll(val.c_str(), nullptr, 10);
+        int64_t now;
+        {
+          std::lock_guard<std::mutex> g(srv->mu);
+          now = (srv->counters[key] += delta);
+        }
+        srv->cv.notify_all();
+        if (!write_blob(fd, std::to_string(now))) return;
+        break;
+      }
+      case WAIT: {
+        std::unique_lock<std::mutex> g(srv->mu);
+        srv->cv.wait(g, [&] {
+          return srv->stop.load() || srv->table.count(key) > 0;
+        });
+        std::string val = srv->stop.load() ? std::string() : srv->table[key];
+        g.unlock();
+        if (!write_blob(fd, val)) return;
+        break;
+      }
+      case BARRIER_HIT: {
+        int64_t now;
+        {
+          std::lock_guard<std::mutex> g(srv->mu);
+          now = ++srv->counters[key];
+        }
+        srv->cv.notify_all();
+        {
+          std::unique_lock<std::mutex> g(srv->mu);
+          int64_t target =
+              (now + srv->world_size - 1) / srv->world_size * srv->world_size;
+          srv->cv.wait(g, [&] {
+            return srv->stop.load() || srv->counters[key] >= target;
+          });
+        }
+        if (!write_blob(fd, "1")) return;
+        break;
+      }
+      case DEL: {
+        {
+          std::lock_guard<std::mutex> g(srv->mu);
+          srv->table.erase(key);
+          srv->counters.erase(key);
+        }
+        if (!write_blob(fd, "1")) return;
+        break;
+      }
+      default:
+        return;
+    }
+  }
+}
+
+void serve_client(Server* srv, int fd) {
+  serve_loop(srv, fd);
+  // Remove the fd from the live set BEFORE closing so stop() (which only
+  // shutdowns fds still in the set, under fds_mu) can never race this close.
+  {
+    std::lock_guard<std::mutex> g(srv->fds_mu);
+    auto& v = srv->client_fds;
+    for (auto it = v.begin(); it != v.end(); ++it) {
+      if (*it == fd) {
+        v.erase(it);
+        break;
+      }
+    }
+    srv->finished.push_back(std::this_thread::get_id());
+  }
+  ::close(fd);
+}
+
+}  // namespace
+
+extern "C" {
+
+// ---- server ----
+void* pt_store_server_start(int port, int world_size) {
+  auto* srv = new Server();
+  srv->world_size = world_size;
+  srv->listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (srv->listen_fd < 0) {
+    delete srv;
+    return nullptr;
+  }
+  int one = 1;
+  ::setsockopt(srv->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::bind(srv->listen_fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0 ||
+      ::listen(srv->listen_fd, 128) < 0) {
+    ::close(srv->listen_fd);
+    delete srv;
+    return nullptr;
+  }
+  srv->accept_thread = std::thread([srv] {
+    while (!srv->stop.load()) {
+      int fd = ::accept(srv->listen_fd, nullptr, nullptr);
+      if (fd < 0) break;
+      {
+        std::lock_guard<std::mutex> g(srv->fds_mu);
+        srv->client_fds.push_back(fd);
+      }
+      // Reap workers that finished (disconnected clients) so thread objects
+      // don't accumulate over the server lifetime under client churn.
+      std::vector<std::thread::id> done;
+      {
+        std::lock_guard<std::mutex> g(srv->fds_mu);
+        done.swap(srv->finished);
+      }
+      if (!done.empty()) {
+        auto& w = srv->workers;
+        for (auto it = w.begin(); it != w.end();) {
+          bool fin = false;
+          for (auto id : done)
+            if (it->get_id() == id) fin = true;
+          if (fin) {
+            it->join();
+            it = w.erase(it);
+          } else {
+            ++it;
+          }
+        }
+      }
+      srv->workers.emplace_back(serve_client, srv, fd);
+    }
+  });
+  return srv;
+}
+
+int pt_store_server_port(void* handle) {
+  auto* srv = static_cast<Server*>(handle);
+  sockaddr_in addr{};
+  socklen_t len = sizeof(addr);
+  if (::getsockname(srv->listen_fd, reinterpret_cast<sockaddr*>(&addr), &len) < 0)
+    return -1;
+  return ntohs(addr.sin_port);
+}
+
+void pt_store_server_stop(void* handle) {
+  auto* srv = static_cast<Server*>(handle);
+  {
+    // Set stop under mu: a waiter that checked the predicate but has not yet
+    // slept holds mu, so notify_all issued after release cannot be lost.
+    std::lock_guard<std::mutex> g(srv->mu);
+    srv->stop.store(true);
+  }
+  srv->cv.notify_all();
+  ::shutdown(srv->listen_fd, SHUT_RDWR);
+  ::close(srv->listen_fd);
+  if (srv->accept_thread.joinable()) srv->accept_thread.join();
+  {
+    // SHUT_RD (not RDWR): unblocks workers stuck in read, but lets a worker
+    // that was just released from a barrier/wait flush its in-flight reply —
+    // otherwise a peer whose reply raced the master's stop sees a transport
+    // error on a barrier that actually completed
+    std::lock_guard<std::mutex> g(srv->fds_mu);
+    for (int fd : srv->client_fds) ::shutdown(fd, SHUT_RD);
+  }
+  for (auto& t : srv->workers)
+    if (t.joinable()) t.join();
+  {
+    std::lock_guard<std::mutex> g(srv->fds_mu);
+    for (int fd : srv->client_fds) ::close(fd);
+  }
+  delete srv;
+}
+
+// ---- client ----
+struct Client {
+  int fd = -1;
+  std::mutex mu;
+};
+
+void* pt_store_connect(const char* host, int port, int timeout_ms) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return nullptr;
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+    ::close(fd);
+    return nullptr;
+  }
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::milliseconds(timeout_ms > 0 ? timeout_ms : 30000);
+  while (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0) {
+    if (std::chrono::steady_clock::now() > deadline) {
+      ::close(fd);
+      return nullptr;
+    }
+    std::this_thread::sleep_for(std::chrono::milliseconds(50));
+  }
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  auto* c = new Client();
+  c->fd = fd;
+  return c;
+}
+
+static int request(Client* c, uint8_t op, const std::string& key,
+                   const std::string* val, std::string* reply) {
+  std::lock_guard<std::mutex> g(c->mu);
+  if (!write_n(c->fd, &op, 1)) return -1;
+  if (!write_blob(c->fd, key)) return -1;
+  if (val && !write_blob(c->fd, *val)) return -1;
+  if (!read_blob(c->fd, reply)) return -1;
+  return 0;
+}
+
+int pt_store_set(void* h, const char* key, const char* val, int vlen) {
+  std::string v(val, static_cast<size_t>(vlen)), reply;
+  return request(static_cast<Client*>(h), SET, key, &v, &reply);
+}
+
+// Returns length, -1 on missing key, -2 on transport error.
+int pt_store_get(void* h, const char* key, char* out, int cap) {
+  std::string reply;
+  if (request(static_cast<Client*>(h), GET, key, nullptr, &reply) != 0) return -2;
+  if (reply.empty()) return -1;
+  int n = static_cast<int>(reply.size());
+  if (n > cap) n = cap;
+  std::memcpy(out, reply.data(), static_cast<size_t>(n));
+  return n;
+}
+
+int pt_store_wait(void* h, const char* key, char* out, int cap) {
+  std::string reply;
+  if (request(static_cast<Client*>(h), WAIT, key, nullptr, &reply) != 0) return -2;
+  int n = static_cast<int>(reply.size());
+  if (n > cap) n = cap;
+  std::memcpy(out, reply.data(), static_cast<size_t>(n));
+  return n;
+}
+
+long long pt_store_add(void* h, const char* key, long long delta) {
+  std::string v = std::to_string(delta), reply;
+  if (request(static_cast<Client*>(h), ADD, key, &v, &reply) != 0) return -1;
+  return std::strtoll(reply.c_str(), nullptr, 10);
+}
+
+int pt_store_barrier(void* h, const char* key) {
+  std::string reply;
+  return request(static_cast<Client*>(h), BARRIER_HIT, key, nullptr, &reply);
+}
+
+void pt_store_disconnect(void* h) {
+  auto* c = static_cast<Client*>(h);
+  ::close(c->fd);
+  delete c;
+}
+
+}  // extern "C"

@@ -1,0 +1,162 @@
+// Host-side trace recorder — RecordEvent ring buffers.
+//
+// Native equivalent of the reference's profiler host path
+// (ref:paddle/fluid/platform/profiler/host_event_recorder.h — lock-free
+// thread-local ring buffers filled by RecordEvent RAII markers, merged and
+// exported as chrome://tracing JSON by chrometracing_logger.cc).
+//
+// Each thread owns a fixed-capacity event buffer (no locks on the hot path);
+// pt_trace_dump merges all buffers into one chrome-trace JSON string.
+
+#include <sys/syscall.h>
+#include <time.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct Event {
+  uint64_t t0_ns;
+  uint64_t t1_ns;
+  uint32_t name_off;  // offset into the thread's name arena
+  uint32_t name_len;
+};
+
+struct ThreadBuf {
+  std::vector<Event> events;
+  std::string arena;
+  uint64_t dropped = 0;
+  long tid = 0;
+};
+
+std::mutex g_mu;                       // guards registry only
+std::vector<ThreadBuf*> g_buffers;     // one per thread, never freed
+std::atomic<bool> g_enabled{false};
+size_t g_capacity = 1 << 20;
+
+thread_local ThreadBuf* t_buf = nullptr;
+
+uint64_t now_ns() {
+  timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return static_cast<uint64_t>(ts.tv_sec) * 1000000000ull +
+         static_cast<uint64_t>(ts.tv_nsec);
+}
+
+ThreadBuf* local_buf() {
+  if (t_buf == nullptr) {
+    t_buf = new ThreadBuf();
+    t_buf->tid = static_cast<long>(::syscall(SYS_gettid));
+    t_buf->events.reserve(4096);
+    std::lock_guard<std::mutex> g(g_mu);
+    g_buffers.push_back(t_buf);
+  }
+  return t_buf;
+}
+
+void json_escape(const char* s, size_t n, std::string* out) {
+  for (size_t i = 0; i < n; ++i) {
+    char c = s[i];
+    if (c == '"' || c == '\\') {
+      out->push_back('\\');
+      out->push_back(c);
+    } else if (static_cast<unsigned char>(c) < 0x20) {
+      out->push_back(' ');
+    } else {
+      out->push_back(c);
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void pt_trace_enable(int enable) { g_enabled.store(enable != 0); }
+
+int pt_trace_enabled() { return g_enabled.load() ? 1 : 0; }
+
+// Begin an event; returns the start timestamp to pass to pt_trace_end.
+uint64_t pt_trace_begin() { return g_enabled.load() ? now_ns() : 0; }
+
+void pt_trace_end(const char* name, uint64_t t0_ns) {
+  if (!g_enabled.load() || t0_ns == 0) return;
+  ThreadBuf* buf = local_buf();
+  if (buf->events.size() >= g_capacity) {
+    buf->dropped++;
+    return;
+  }
+  Event e;
+  e.t0_ns = t0_ns;
+  e.t1_ns = now_ns();
+  e.name_off = static_cast<uint32_t>(buf->arena.size());
+  size_t len = std::strlen(name);
+  if (len > 255) len = 255;
+  e.name_len = static_cast<uint32_t>(len);
+  buf->arena.append(name, len);
+  buf->events.push_back(e);
+}
+
+// Instant (zero-duration) marker.
+void pt_trace_instant(const char* name) {
+  uint64_t t = pt_trace_begin();
+  if (t) pt_trace_end(name, t);
+}
+
+void pt_trace_clear() {
+  std::lock_guard<std::mutex> g(g_mu);
+  for (auto* b : g_buffers) {
+    b->events.clear();
+    b->arena.clear();
+    b->dropped = 0;
+  }
+}
+
+uint64_t pt_trace_event_count() {
+  std::lock_guard<std::mutex> g(g_mu);
+  uint64_t n = 0;
+  for (auto* b : g_buffers) n += b->events.size();
+  return n;
+}
+
+// Serialize all buffers as chrome-trace JSON. Two-call protocol: pass
+// cap=0 to get the required size, then call again with a buffer.
+uint64_t pt_trace_dump(char* out, uint64_t cap, int process_id) {
+  std::string json;
+  json.reserve(1 << 20);
+  json += "{\"traceEvents\":[";
+  bool first = true;
+  {
+    std::lock_guard<std::mutex> g(g_mu);
+    for (auto* b : g_buffers) {
+      for (const Event& e : b->events) {
+        if (!first) json += ",";
+        first = false;
+        json += "{\"name\":\"";
+        json_escape(b->arena.data() + e.name_off, e.name_len, &json);
+        json += "\",\"ph\":\"X\",\"pid\":";
+        json += std::to_string(process_id);
+        json += ",\"tid\":";
+        json += std::to_string(b->tid);
+        json += ",\"ts\":";
+        json += std::to_string(e.t0_ns / 1000.0);
+        json += ",\"dur\":";
+        json += std::to_string((e.t1_ns - e.t0_ns) / 1000.0);
+        json += "}";
+      }
+    }
+  }
+  json += "]}";
+  if (cap == 0 || out == nullptr) return json.size();
+  uint64_t n = json.size() < cap ? json.size() : cap;
+  std::memcpy(out, json.data(), n);
+  return n;
+}
+
+}  // extern "C"

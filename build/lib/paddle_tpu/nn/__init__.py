@@ -1,0 +1,46 @@
+"""paddle.nn equivalent (ref:python/paddle/nn/)."""
+from . import functional  # noqa: F401
+from . import initializer  # noqa: F401
+from .containers import LayerList, ParameterList, Sequential  # noqa: F401
+from .layer import Layer, ParamAttr, Parameter  # noqa: F401
+from .layers_activation import *  # noqa: F401,F403
+from .layers_common import (  # noqa: F401
+    AdaptiveAvgPool1D,
+    AdaptiveAvgPool2D,
+    AdaptiveMaxPool2D,
+    AvgPool1D,
+    AvgPool2D,
+    BatchNorm,
+    BatchNorm1D,
+    BatchNorm2D,
+    BatchNorm3D,
+    Conv1D,
+    Conv2D,
+    Conv2DTranspose,
+    Conv3D,
+    Dropout,
+    Dropout2D,
+    Embedding,
+    Flatten,
+    GroupNorm,
+    InstanceNorm2D,
+    LayerNorm,
+    Linear,
+    MaxPool1D,
+    MaxPool2D,
+    Pad2D,
+    PixelShuffle,
+    RMSNorm,
+    SyncBatchNorm,
+    Upsample,
+)
+from .transformer import (  # noqa: F401
+    MultiHeadAttention,
+    Transformer,
+    TransformerDecoder,
+    TransformerDecoderLayer,
+    TransformerEncoder,
+    TransformerEncoderLayer,
+)
+from .stacked import StackedLayers  # noqa: F401
+from .rnn import GRU, GRUCell, LSTM, LSTMCell, SimpleRNN, SimpleRNNCell  # noqa: F401

@@ -1,0 +1,9 @@
+"""paddle.nn.functional surface."""
+from .activation import *  # noqa: F401,F403
+from .common import *  # noqa: F401,F403
+from .conv import *  # noqa: F401,F403
+from .loss import *  # noqa: F401,F403
+from .norm import *  # noqa: F401,F403
+from .pooling import *  # noqa: F401,F403
+from .attention import flash_attention, scaled_dot_product_attention  # noqa: F401
+from . import activation, attention, common, conv, loss, norm, pooling  # noqa: F401

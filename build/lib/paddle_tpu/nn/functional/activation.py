@@ -1,0 +1,190 @@
+"""Activation functionals (ref:python/paddle/nn/functional/activation.py)."""
+from __future__ import annotations
+
+import sys
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import apply
+from ...core.tensor import Tensor
+
+_this = sys.modules[__name__]
+
+_SIMPLE = {
+    "relu": jax.nn.relu,
+    "relu6": lambda x: jnp.clip(x, 0, 6),
+    "sigmoid": jax.nn.sigmoid,
+    "tanh": jnp.tanh,
+    "silu": jax.nn.silu,
+    "swish": jax.nn.silu,
+    "mish": lambda x: x * jnp.tanh(jax.nn.softplus(x)),
+    "softsign": jax.nn.soft_sign,
+    "tanhshrink": lambda x: x - jnp.tanh(x),
+    "hardswish": jax.nn.hard_swish,
+    "hardsigmoid": lambda x: jnp.clip(x / 6.0 + 0.5, 0.0, 1.0),
+    "selu_": jax.nn.selu,
+    "elu_": jax.nn.elu,
+}
+
+for _n, _f in _SIMPLE.items():
+    def _op(x, name=None, _f=_f, _n=_n.rstrip("_")):
+        return apply(_f, (x,), {}, name=_n)
+
+    setattr(_this, _n.rstrip("_") if _n.endswith("_") else _n, _op)
+
+
+def gelu(x, approximate=False, name=None):
+    def _gelu(x, *, approximate):
+        return jax.nn.gelu(x, approximate=approximate)
+
+    return apply(_gelu, (x,), dict(approximate=bool(approximate)))
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    def _leaky_relu(x, *, slope):
+        return jax.nn.leaky_relu(x, negative_slope=slope)
+
+    return apply(_leaky_relu, (x,), dict(slope=float(negative_slope)))
+
+
+def elu(x, alpha=1.0, name=None):
+    def _elu(x, *, alpha):
+        return jax.nn.elu(x, alpha=alpha)
+
+    return apply(_elu, (x,), dict(alpha=float(alpha)))
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    def _selu(x, *, scale, alpha):
+        return scale * jnp.where(x > 0, x, alpha * jnp.expm1(x))
+
+    return apply(_selu, (x,), dict(scale=float(scale), alpha=float(alpha)))
+
+
+def celu(x, alpha=1.0, name=None):
+    def _celu(x, *, alpha):
+        return jax.nn.celu(x, alpha=alpha)
+
+    return apply(_celu, (x,), dict(alpha=float(alpha)))
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def _prelu(x, w, *, data_format):
+        if w.size == 1:
+            return jnp.where(x >= 0, x, w.reshape(()) * x)
+        shape = [1] * x.ndim
+        ch_axis = 1 if data_format[1] == "C" else x.ndim - 1
+        shape[ch_axis] = w.size
+        return jnp.where(x >= 0, x, w.reshape(shape) * x)
+
+    return apply(_prelu, (x, weight), dict(data_format=data_format))
+
+
+def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=False, name=None):
+    if not training:
+        return leaky_relu(x, (lower + upper) / 2.0)
+    from ...core import rng
+
+    def _rrelu(x, key, *, lo, hi):
+        a = jax.random.uniform(key, x.shape, minval=lo, maxval=hi).astype(x.dtype)
+        return jnp.where(x >= 0, x, a * x)
+
+    return apply(_rrelu, (x, Tensor(rng.next_key())), dict(lo=float(lower), hi=float(upper)))
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    def _hardtanh(x, *, lo, hi):
+        return jnp.clip(x, lo, hi)
+
+    return apply(_hardtanh, (x,), dict(lo=float(min), hi=float(max)))
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    def _hardshrink(x, *, t):
+        return jnp.where(jnp.abs(x) > t, x, 0.0)
+
+    return apply(_hardshrink, (x,), dict(t=float(threshold)))
+
+
+def softshrink(x, threshold=0.5, name=None):
+    def _softshrink(x, *, t):
+        return jnp.where(x > t, x - t, jnp.where(x < -t, x + t, 0.0))
+
+    return apply(_softshrink, (x,), dict(t=float(threshold)))
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    def _softplus(x, *, beta, threshold):
+        return jnp.where(beta * x > threshold, x, jax.nn.softplus(beta * x) / beta)
+
+    return apply(_softplus, (x,), dict(beta=float(beta), threshold=float(threshold)))
+
+
+def thresholded_relu(x, threshold=1.0, name=None):
+    def _thresholded_relu(x, *, t):
+        return jnp.where(x > t, x, 0.0)
+
+    return apply(_thresholded_relu, (x,), dict(t=float(threshold)))
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    def _softmax(x, *, axis):
+        return jax.nn.softmax(x, axis=axis)
+
+    from ...core.dtype import convert_dtype_arg
+
+    if dtype is not None:
+        from ...ops.manipulation import cast
+
+        x = cast(x, dtype)
+    return apply(_softmax, (x,), dict(axis=int(axis)))
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    def _log_softmax(x, *, axis):
+        return jax.nn.log_softmax(x, axis=axis)
+
+    if dtype is not None:
+        from ...ops.manipulation import cast
+
+        x = cast(x, dtype)
+    return apply(_log_softmax, (x,), dict(axis=int(axis)))
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ...core import rng
+
+    def _gumbel_softmax(x, key, *, tau, hard, axis):
+        g = jax.random.gumbel(key, x.shape, dtype=x.dtype)
+        y = jax.nn.softmax((x + g) / tau, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            y_hard = jnp.zeros_like(y)
+            y_hard = jnp.put_along_axis(y_hard, idx, 1.0, axis=axis, inplace=False)
+            y = y_hard + jax.lax.stop_gradient(-y) + y  # straight-through
+        return y
+
+    return apply(_gumbel_softmax, (x, Tensor(rng.next_key())), dict(tau=float(temperature), hard=bool(hard), axis=int(axis)))
+
+
+def maxout(x, groups, axis=1, name=None):
+    def _maxout(x, *, groups, axis):
+        s = list(x.shape)
+        c = s[axis]
+        s[axis : axis + 1] = [c // groups, groups]
+        return jnp.max(x.reshape(s), axis=axis + 1)
+
+    return apply(_maxout, (x,), dict(groups=int(groups), axis=int(axis)))
+
+
+def glu(x, axis=-1, name=None):
+    def _glu(x, *, axis):
+        a, b = jnp.split(x, 2, axis=axis)
+        return a * jax.nn.sigmoid(b)
+
+    return apply(_glu, (x,), dict(axis=int(axis)))
+
+
+def log_sigmoid(x, name=None):
+    return apply(jax.nn.log_sigmoid, (x,), {}, name="log_sigmoid")

@@ -1,0 +1,147 @@
+"""Attention functionals.
+
+Parity surface: ``paddle.nn.functional.flash_attention`` /
+``scaled_dot_product_attention`` (ref:python/paddle/nn/functional/
+flash_attention.py wrapping the CUDA flash kernels,
+ref:paddle/phi/kernels/gpu/flash_attn_kernel.cu:213).
+
+TPU-native: on TPU the hot path is a Pallas blockwise-flash kernel
+(paddle_tpu.ops.pallas_ops); elsewhere (CPU tests) a numerically-stable XLA
+softmax attention — same math, fused by XLA. Layout is [batch, seq, heads,
+head_dim] (paddle flash_attn contract).
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...core import rng
+from ...core.dispatch import apply
+from ...core.tensor import Tensor
+
+
+def _prob_dropout(probs, key, p):
+    # paddle contract: dropout acts on the post-softmax probability matrix
+    keep = jax.random.bernoulli(key, 1.0 - p, probs.shape)
+    return jnp.where(keep, probs / (1.0 - p), 0.0).astype(probs.dtype)
+
+
+def _sdpa_reference(q, k, v, *, scale, causal, dropout_p=0.0, key=None):
+    # [b, s, h, d] -> [b, h, s, d]
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) * scale
+    if causal:
+        sq, sk = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((sq, sk), jnp.bool_), k=sk - sq)
+        logits = jnp.where(mask, logits, jnp.finfo(logits.dtype).min)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+    if dropout_p:
+        probs = _prob_dropout(probs, key, dropout_p)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, vt)
+    return jnp.swapaxes(out, 1, 2)
+
+
+def _use_pallas(q) -> bool:
+    # trace-safe: the backend, not the (possibly traced) array, decides
+    # ("axon" is the tunneled TPU plugin in this environment)
+    return jax.default_backend() in ("tpu", "axon")
+
+
+def _sdpa(q, k, v, *, scale, causal, use_flash, seq_parallel="none"):
+    if seq_parallel in ("ring", "ulysses"):
+        from ...distributed.context_parallel import ring_attention, ulysses_attention
+
+        fn = ring_attention if seq_parallel == "ring" else ulysses_attention
+        return fn(q, k, v, scale=scale, causal=causal)
+    if use_flash:
+        from ...ops.pallas_ops import flash_attention as pallas_flash
+
+        return pallas_flash(q, k, v, scale=scale, causal=causal)
+    return _sdpa_reference(q, k, v, scale=scale, causal=causal)
+
+
+def _sdpa_dropout(q, k, v, key, *, scale, causal, dropout_p):
+    # dropout on the probability matrix isn't expressible in the Pallas flash
+    # kernel; the XLA path materializes probs anyway
+    return _sdpa_reference(q, k, v, scale=scale, causal=causal,
+                           dropout_p=dropout_p, key=key)
+
+
+def scaled_dot_product_attention(
+    query,
+    key,
+    value,
+    attn_mask=None,
+    dropout_p: float = 0.0,
+    is_causal: bool = False,
+    training: bool = True,
+    name=None,
+):
+    """paddle.nn.functional.scaled_dot_product_attention parity.
+    Layout [batch, seq, num_heads, head_dim]."""
+    d = query.shape[-1]
+    scale = 1.0 / math.sqrt(d)
+    drop = float(dropout_p) if (dropout_p and training) else 0.0
+    if attn_mask is not None:
+        # masked variant stays on the XLA path (mask shapes are arbitrary)
+        def _masked(q, k, v, m, rkey=None, *, scale, dropout_p):
+            qt, kt, vt = (jnp.swapaxes(t, 1, 2) for t in (q, k, v))
+            logits = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) * scale
+            if m.dtype == jnp.bool_:
+                logits = jnp.where(m, logits, jnp.finfo(logits.dtype).min)
+            else:
+                logits = logits + m
+            p = jax.nn.softmax(logits.astype(jnp.float32), -1).astype(q.dtype)
+            if dropout_p:
+                p = _prob_dropout(p, rkey, dropout_p)
+            return jnp.swapaxes(jnp.einsum("bhqk,bhkd->bhqd", p, vt), 1, 2)
+
+        args = (query, key, value, attn_mask)
+        if drop:  # consume an rng key only when dropout is live
+            args += (Tensor(rng.next_key()),)
+        out = apply(_masked, args, {"scale": scale, "dropout_p": drop}, name="sdpa")
+    elif drop:
+        out = apply(
+            _sdpa_dropout,
+            (query, key, value, Tensor(rng.next_key())),
+            {"scale": scale, "causal": bool(is_causal), "dropout_p": drop},
+            name="sdpa",
+        )
+    else:
+        use_flash = _use_pallas(query._data if isinstance(query, Tensor) else query)
+        out = apply(
+            _sdpa,
+            (query, key, value),
+            {"scale": scale, "causal": bool(is_causal), "use_flash": use_flash,
+             "seq_parallel": _seq_parallel_mode()},
+            name="sdpa",
+        )
+    return out
+
+
+def _seq_parallel_mode() -> str:
+    """Context-parallel dispatch: 'ring' (default when the mesh has an active
+    "sep" axis), 'ulysses', or 'none'; FLAGS_sequence_parallel_mode
+    overrides (the reference has no SP at all — SURVEY.md §5.7)."""
+    from ...core import flags
+    from ...distributed import mesh as mesh_mod
+
+    mode = flags.flag("sequence_parallel_mode")
+    if mode in ("ring", "ulysses", "none"):
+        return mode
+    m = mesh_mod.get_mesh()
+    return "ring" if m is not None and m.shape.get("sep", 1) > 1 else "none"
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False, return_softmax=False,
+                    fixed_seed_offset=None, rng_name="", training=True, name=None):
+    """paddle.nn.functional.flash_attention.flash_attention parity."""
+    out = scaled_dot_product_attention(
+        query, key, value, dropout_p=dropout, is_causal=causal, training=training
+    )
+    return out, None  # (out, softmax); softmax only materialized on request

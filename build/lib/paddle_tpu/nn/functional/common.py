@@ -1,0 +1,220 @@
+"""Common functionals: linear, dropout, embedding, interpolate, padding.
+
+(ref:python/paddle/nn/functional/common.py, input.py)
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core import rng
+from ...core.dispatch import apply
+from ...core.tensor import Tensor
+from ...ops.manipulation import pad as _pad_op
+
+
+def linear(x, weight, bias=None, name=None):
+    # weight layout follows the reference: [in_features, out_features]
+    # (ref:python/paddle/nn/layer/common.py Linear) — maps to one MXU matmul.
+    if bias is None:
+        def _linear_nb(x, w):
+            return jnp.matmul(x, w)
+
+        return apply(_linear_nb, (x, weight), {})
+
+    def _linear(x, w, b):
+        return jnp.matmul(x, w) + b
+
+    return apply(_linear, (x, weight, bias), {})
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
+    if not training or p == 0.0:
+        return x
+
+    def _dropout(x, key, *, p, axis, upscale):
+        shape = list(x.shape)
+        if axis is not None:
+            axes = (axis,) if isinstance(axis, int) else axis
+            shape = [s if i in [a % x.ndim for a in axes] else 1 for i, s in enumerate(x.shape)]
+        keep = jax.random.bernoulli(key, 1.0 - p, tuple(shape))
+        if upscale:
+            return jnp.where(keep, x / (1.0 - p), 0.0).astype(x.dtype)
+        return jnp.where(keep, x, 0.0).astype(x.dtype)
+
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return apply(
+        _dropout,
+        (x, Tensor(rng.next_key())),
+        dict(p=float(p), axis=ax, upscale=(mode == "upscale_in_train")),
+    )
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    axis = (0, 1) if data_format == "NCHW" else (0, 3)
+    return dropout(x, p, axis=axis, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    axis = (0, 1) if data_format == "NCDHW" else (0, 4)
+    return dropout(x, p, axis=axis, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return x
+
+    def _alpha_dropout(x, key, *, p):
+        alpha = 1.6732632423543772
+        scale = 1.0507009873554805
+        alpha_p = -alpha * scale
+        keep = jax.random.bernoulli(key, 1.0 - p, x.shape)
+        a = (1.0 / ((1.0 - p) * (1.0 + p * alpha_p**2)) ** 0.5)
+        b = -a * alpha_p * p
+        return (a * jnp.where(keep, x, alpha_p) + b).astype(x.dtype)
+
+    return apply(_alpha_dropout, (x, Tensor(rng.next_key())), dict(p=float(p)))
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    def _embedding(ids, w, *, padding_idx):
+        out = jnp.take(w, ids.astype(jnp.int32), axis=0)
+        if padding_idx is not None:
+            mask = (ids == padding_idx)[..., None]
+            out = jnp.where(mask, 0.0, out)
+        return out
+
+    return apply(_embedding, (x, weight), dict(padding_idx=padding_idx))
+
+
+def one_hot(x, num_classes, name=None):
+    from ...ops.manipulation import one_hot as _oh
+
+    return _oh(x, num_classes)
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    return _pad_op(x, pad, mode, value, data_format)
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    return _pad_op(x, padding, "constant", 0.0, data_format)
+
+
+def interpolate(
+    x, size=None, scale_factor=None, mode="nearest", align_corners=False, align_mode=0, data_format="NCHW", name=None
+):
+    nchw = data_format in ("NCHW", "NCL", "NCDHW")
+    spatial = x.shape[2:] if nchw else x.shape[1:-1]
+    if size is not None:
+        if isinstance(size, Tensor):
+            size = size.tolist()
+        out_size = tuple(int(s.item()) if isinstance(s, Tensor) else int(s) for s in (size if isinstance(size, (list, tuple)) else [size]))
+    else:
+        if isinstance(scale_factor, (int, float)):
+            scale_factor = [scale_factor] * len(spatial)
+        out_size = tuple(int(s * f) for s, f in zip(spatial, scale_factor))
+
+    jmode = {"nearest": "nearest", "bilinear": "linear", "trilinear": "linear", "linear": "linear", "bicubic": "cubic", "area": "linear"}[mode]
+
+    def _interp(x, *, out_size, jmode, nchw):
+        if nchw:
+            full = x.shape[:2] + out_size
+        else:
+            full = (x.shape[0],) + out_size + (x.shape[-1],)
+        return jax.image.resize(x, full, method=jmode).astype(x.dtype)
+
+    return apply(_interp, (x,), dict(out_size=out_size, jmode=jmode, nchw=nchw))
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest", align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode, data_format)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    def _as2(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+
+    k, s, p, d = _as2(kernel_sizes), _as2(strides), _as2(paddings), _as2(dilations)
+
+    def _unfold(x, *, k, s, p, d):
+        n, c, h, w = x.shape
+        x = jnp.pad(x, ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1])))
+        oh = (h + 2 * p[0] - d[0] * (k[0] - 1) - 1) // s[0] + 1
+        ow = (w + 2 * p[1] - d[1] * (k[1] - 1) - 1) // s[1] + 1
+        patches = jax.lax.conv_general_dilated_patches(
+            x, filter_shape=k, window_strides=s, padding="VALID", rhs_dilation=d,
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        )
+        return patches.reshape(n, c * k[0] * k[1], oh * ow)
+
+    return apply(_unfold, (x,), dict(k=k, s=s, p=p, d=d))
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    def _as2(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+
+    out_hw, k, s, p, d = _as2(output_sizes), _as2(kernel_sizes), _as2(strides), _as2(paddings), _as2(dilations)
+
+    def _fold(x, *, out_hw, k, s, p, d):
+        n, ckk, L = x.shape
+        c = ckk // (k[0] * k[1])
+        oh = (out_hw[0] + 2 * p[0] - d[0] * (k[0] - 1) - 1) // s[0] + 1
+        ow = (out_hw[1] + 2 * p[1] - d[1] * (k[1] - 1) - 1) // s[1] + 1
+        cols = x.reshape(n, c, k[0], k[1], oh, ow)
+        out = jnp.zeros((n, c, out_hw[0] + 2 * p[0], out_hw[1] + 2 * p[1]), x.dtype)
+        for i in range(k[0]):
+            for j in range(k[1]):
+                hi = i * d[0]
+                wj = j * d[1]
+                out = out.at[:, :, hi : hi + oh * s[0] : s[0], wj : wj + ow * s[1] : s[1]].add(cols[:, :, i, j])
+        return out[:, :, p[0] : out.shape[2] - p[0], p[1] : out.shape[3] - p[1]]
+
+    return apply(_fold, (x,), dict(out_hw=out_hw, k=k, s=s, p=p, d=d))
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):
+    def _cos(x1, x2, *, axis, eps):
+        dot = jnp.sum(x1 * x2, axis=axis)
+        n1 = jnp.sqrt(jnp.sum(x1 * x1, axis=axis))
+        n2 = jnp.sqrt(jnp.sum(x2 * x2, axis=axis))
+        return dot / jnp.maximum(n1 * n2, eps)
+
+    return apply(_cos, (x1, x2), dict(axis=int(axis), eps=float(eps)))
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    def _ps(x, *, r, nchw):
+        if not nchw:
+            x = jnp.transpose(x, (0, 3, 1, 2))
+        n, c, h, w = x.shape
+        x = x.reshape(n, c // (r * r), r, r, h, w)
+        x = jnp.transpose(x, (0, 1, 4, 2, 5, 3)).reshape(n, c // (r * r), h * r, w * r)
+        if not nchw:
+            x = jnp.transpose(x, (0, 2, 3, 1))
+        return x
+
+    return apply(_ps, (x,), dict(r=int(upscale_factor), nchw=data_format == "NCHW"))
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    def _pu(x, *, r, nchw):
+        if not nchw:
+            x = jnp.transpose(x, (0, 3, 1, 2))
+        n, c, h, w = x.shape
+        x = x.reshape(n, c, h // r, r, w // r, r)
+        x = jnp.transpose(x, (0, 1, 3, 5, 2, 4)).reshape(n, c * r * r, h // r, w // r)
+        if not nchw:
+            x = jnp.transpose(x, (0, 2, 3, 1))
+        return x
+
+    return apply(_pu, (x,), dict(r=int(downscale_factor), nchw=data_format == "NCHW"))
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    def _ls(label, *, eps):
+        k = label.shape[-1]
+        return (1 - eps) * label + eps / k
+
+    return apply(_ls, (label,), dict(eps=float(epsilon)))

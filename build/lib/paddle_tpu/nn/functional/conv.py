@@ -1,0 +1,137 @@
+"""Convolution functionals (ref:python/paddle/nn/functional/conv.py).
+
+All convs lower to ``lax.conv_general_dilated`` — XLA maps these onto the MXU.
+Weight layout follows paddle: [out_c, in_c/groups, *kernel] (OIHW).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import apply
+
+
+def _norm_tuple(v, n):
+    if isinstance(v, int):
+        return (v,) * n
+    v = tuple(int(i) for i in v)
+    if len(v) == 1:
+        return v * n
+    return v
+
+
+def _norm_padding(padding, n, stride, dilation, ksize):
+    """Returns lax-style padding: list of (lo, hi) per spatial dim or 'SAME'/'VALID'."""
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    padding = list(padding)
+    if len(padding) == n and all(isinstance(p, int) for p in padding):
+        return [(p, p) for p in padding]
+    if len(padding) == 2 * n:
+        return [(padding[2 * i], padding[2 * i + 1]) for i in range(n)]
+    if all(isinstance(p, (list, tuple)) for p in padding):
+        # NCHW-style 4-d padding spec: take spatial entries
+        sp = padding[-n:]
+        return [tuple(p) for p in sp]
+    raise ValueError(f"bad padding {padding}")
+
+
+def _conv_nd(x, weight, bias, stride, padding, dilation, groups, n, data_format, transpose=False, output_padding=0):
+    stride = _norm_tuple(stride, n)
+    dilation = _norm_tuple(dilation, n)
+    ksize = weight.shape[2:] if hasattr(weight, "shape") else None
+    pad = _norm_padding(padding, n, stride, dilation, ksize)
+
+    channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+    spatial = "DHW"[3 - n :]
+    if channel_last:
+        lhs_spec = "N" + spatial + "C"
+    else:
+        lhs_spec = "NC" + spatial
+    rhs_spec = "OI" + spatial
+    out_spec = lhs_spec
+    dn = (lhs_spec, rhs_spec, out_spec)
+
+    if not transpose:
+        def _conv(x, w, *, stride, pad, dilation, groups, dn):
+            return jax.lax.conv_general_dilated(
+                x, w, window_strides=stride, padding=pad, rhs_dilation=dilation,
+                feature_group_count=groups, dimension_numbers=dn,
+                preferred_element_type=jnp.float32 if x.dtype == jnp.float32 else None,
+            )
+
+        out = apply(_conv, (x, weight), dict(stride=stride, pad=pad if isinstance(pad, str) else tuple(pad), dilation=dilation, groups=groups, dn=dn))
+    else:
+        opad = _norm_tuple(output_padding, n)
+
+        def _convt(x, w, *, stride, pad, dilation, groups, dn, opad):
+            # transpose conv = gradient of conv: use lax.conv_transpose
+            w_t = jnp.swapaxes(w, 0, 1)  # paddle convT weight is [in, out/groups, *k]
+            if groups > 1:
+                # grouped transpose conv: block-diagonal over groups
+                in_per_g = w.shape[0] // groups
+                outs = []
+                xs = jnp.split(x, groups, axis=1 if dn[0][1] == "C" else -1)
+                ws = jnp.split(w, groups, axis=0)
+                for xg, wg in zip(xs, ws):
+                    outs.append(
+                        jax.lax.conv_transpose(
+                            xg, jnp.swapaxes(wg, 0, 1), strides=stride,
+                            padding=pad if isinstance(pad, str) else list(pad),
+                            rhs_dilation=dilation, dimension_numbers=dn, transpose_kernel=True,
+                        )
+                    )
+                out = jnp.concatenate(outs, axis=1 if dn[0][1] == "C" else -1)
+            else:
+                out = jax.lax.conv_transpose(
+                    x, w_t, strides=stride, padding=pad if isinstance(pad, str) else list(pad),
+                    rhs_dilation=dilation, dimension_numbers=dn, transpose_kernel=True,
+                )
+            if any(opad):
+                pads = [(0, 0, 0)] * out.ndim
+                spatial_axes = range(2, out.ndim) if dn[0][1] == "C" else range(1, out.ndim - 1)
+                cfg = [(0, 0, 0)] * out.ndim
+                for i, ax in enumerate(spatial_axes):
+                    cfg[ax] = (0, opad[i], 0)
+                out = jax.lax.pad(out, jnp.zeros((), out.dtype), cfg)
+            return out
+
+        out = apply(
+            _convt,
+            (x, weight),
+            dict(stride=stride, pad=pad if isinstance(pad, str) else tuple(pad), dilation=dilation, groups=groups, dn=dn, opad=opad),
+        )
+
+    if bias is not None:
+        def _add_bias(x, b, *, channel_last):
+            shape = (1,) * (x.ndim - 1) + (-1,) if channel_last else (1, -1) + (1,) * (x.ndim - 2)
+            return x + b.reshape(shape)
+
+        out = apply(_add_bias, (out, bias), dict(channel_last=channel_last))
+    return out
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NCL", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 1, data_format)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NCHW", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 2, data_format)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NCDHW", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 3, data_format)
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0, groups=1, dilation=1, output_size=None, data_format="NCL", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 1, data_format, transpose=True, output_padding=output_padding)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0, groups=1, dilation=1, output_size=None, data_format="NCHW", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 2, data_format, transpose=True, output_padding=output_padding)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0, groups=1, dilation=1, output_size=None, data_format="NCDHW", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 3, data_format, transpose=True, output_padding=output_padding)

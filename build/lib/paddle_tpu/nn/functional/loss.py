@@ -1,0 +1,229 @@
+"""Loss functionals (ref:python/paddle/nn/functional/loss.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import apply
+from ...core.tensor import Tensor
+
+
+def _reduce(x, reduction):
+    if reduction == "mean":
+        return jnp.mean(x)
+    if reduction == "sum":
+        return jnp.sum(x)
+    return x
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean", soft_label=False, axis=-1, use_softmax=True, label_smoothing=0.0, name=None):
+    def _ce(logits, label, w, *, ignore_index, reduction, soft_label, axis, use_softmax, smooth, has_w):
+        if use_softmax:
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=axis)
+        else:
+            logp = jnp.log(jnp.maximum(logits.astype(jnp.float32), 1e-30))
+        if soft_label:
+            tgt = label.astype(jnp.float32)
+            loss = -jnp.sum(tgt * logp, axis=axis)
+        else:
+            lbl = label
+            if lbl.ndim == logp.ndim:
+                lbl = jnp.squeeze(lbl, axis=axis)
+            lbl = lbl.astype(jnp.int32)
+            n_cls = logp.shape[axis]
+            if smooth > 0.0:
+                oh = jax.nn.one_hot(lbl, n_cls, axis=axis)
+                tgt = oh * (1.0 - smooth) + smooth / n_cls
+                loss = -jnp.sum(tgt * logp, axis=axis)
+            else:
+                loss = -jnp.take_along_axis(logp, jnp.expand_dims(lbl, axis), axis=axis).squeeze(axis)
+            mask = lbl != ignore_index
+            wt = mask.astype(jnp.float32)
+            if has_w:
+                wt = wt * jnp.take(w.astype(jnp.float32), jnp.where(mask, lbl, 0))
+            loss = loss * wt
+            if reduction == "mean":
+                # paddle/torch weighted-mean contract: normalize by sum of weights
+                return jnp.sum(loss) / jnp.maximum(jnp.sum(wt), 1e-12)
+        return _reduce(loss, reduction)
+
+    from ...ops.creation import zeros
+
+    has_w = weight is not None and not soft_label
+    w = weight if has_w else zeros([1], dtype="float32")
+    return apply(
+        _ce,
+        (input, label, w),
+        dict(ignore_index=int(ignore_index), reduction=reduction, soft_label=bool(soft_label), axis=int(axis), use_softmax=bool(use_softmax), smooth=float(label_smoothing), has_w=has_w),
+    )
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100, numeric_stable_mode=True, return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label, ignore_index=ignore_index, reduction="none", axis=axis)
+    from ...ops.manipulation import unsqueeze
+
+    loss = unsqueeze(loss, axis)
+    if return_softmax:
+        from .activation import softmax
+
+        return loss, softmax(logits, axis=axis)
+    return loss
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean", name=None):
+    def _nll(logp, label, w, *, ignore_index, reduction, has_w):
+        lbl = label.astype(jnp.int32)
+        loss = -jnp.take_along_axis(logp, lbl[..., None] if logp.ndim == lbl.ndim + 1 else lbl, axis=1 if logp.ndim > 1 else 0)
+        loss = jnp.squeeze(loss, axis=1) if loss.ndim > lbl.ndim else loss
+        mask = lbl != ignore_index
+        wt = mask.astype(jnp.float32)
+        if has_w:
+            wt = wt * jnp.take(w.astype(jnp.float32), jnp.where(mask, lbl, 0))
+        loss = loss * wt
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(jnp.sum(wt), 1e-12)
+        return _reduce(loss, reduction)
+
+    from ...ops.creation import zeros
+
+    has_w = weight is not None
+    w = weight if has_w else zeros([1], dtype="float32")
+    return apply(_nll, (input, label, w), dict(ignore_index=int(ignore_index), reduction=reduction, has_w=has_w))
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    def _mse(x, y, *, reduction):
+        return _reduce(jnp.square(x - y), reduction)
+
+    return apply(_mse, (input, label), dict(reduction=reduction))
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    def _l1(x, y, *, reduction):
+        return _reduce(jnp.abs(x - y), reduction)
+
+    return apply(_l1, (input, label), dict(reduction=reduction))
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def _sl1(x, y, *, reduction, delta):
+        d = x - y
+        loss = jnp.where(jnp.abs(d) < delta, 0.5 * d * d / delta, jnp.abs(d) - 0.5 * delta)
+        return _reduce(loss, reduction)
+
+    return apply(_sl1, (input, label), dict(reduction=reduction, delta=float(delta)))
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):
+    def _bce(p, y, w, *, reduction, has_w):
+        p = jnp.clip(p, 1e-12, 1.0 - 1e-12)
+        loss = -(y * jnp.log(p) + (1 - y) * jnp.log(1 - p))
+        if has_w:
+            loss = loss * w
+        return _reduce(loss, reduction)
+
+    from ...ops.creation import zeros
+
+    has_w = weight is not None
+    w = weight if has_w else zeros([1], dtype="float32")
+    return apply(_bce, (input, label, w), dict(reduction=reduction, has_w=has_w))
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean", pos_weight=None, name=None):
+    from ...ops.creation import zeros
+
+    has_w = weight is not None
+    w = weight if has_w else zeros([1], dtype="float32")
+    if pos_weight is not None:
+        def _bcelw(z, y, pw, w, *, reduction, has_w):
+            log_w = (pw - 1) * y + 1
+            loss = (1 - y) * z + log_w * (jnp.log1p(jnp.exp(-jnp.abs(z))) + jnp.maximum(-z, 0))
+            if has_w:
+                loss = loss * w
+            return _reduce(loss, reduction)
+
+        return apply(_bcelw, (logit, label, pos_weight, w), dict(reduction=reduction, has_w=has_w))
+
+    def _bcel(z, y, w, *, reduction, has_w):
+        loss = jnp.maximum(z, 0) - z * y + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        if has_w:
+            loss = loss * w
+        return _reduce(loss, reduction)
+
+    return apply(_bcel, (logit, label, w), dict(reduction=reduction, has_w=has_w))
+
+
+def kl_div(input, label, reduction="mean", name=None):
+    def _kl(logp, y, *, reduction):
+        loss = y * (jnp.log(jnp.maximum(y, 1e-30)) - logp)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / logp.shape[0]
+        return _reduce(loss, reduction)
+
+    return apply(_kl, (input, label), dict(reduction=reduction))
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean", name=None):
+    def _mrl(x1, x2, y, *, margin, reduction):
+        return _reduce(jnp.maximum(0.0, -y * (x1 - x2) + margin), reduction)
+
+    return apply(_mrl, (input, other, label), dict(margin=float(margin), reduction=reduction))
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    def _hel(x, y, *, margin, reduction):
+        loss = jnp.where(y == 1, x, jnp.maximum(0.0, margin - x))
+        return _reduce(loss, reduction)
+
+    return apply(_hel, (input, label), dict(margin=float(margin), reduction=reduction))
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean", name=None):
+    def _cel(x1, x2, y, *, margin, reduction):
+        cos = jnp.sum(x1 * x2, axis=-1) / jnp.maximum(
+            jnp.linalg.norm(x1, axis=-1) * jnp.linalg.norm(x2, axis=-1), 1e-12
+        )
+        loss = jnp.where(y == 1, 1 - cos, jnp.maximum(0.0, cos - margin))
+        return _reduce(loss, reduction)
+
+    return apply(_cel, (input1, input2, label), dict(margin=float(margin), reduction=reduction))
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0, epsilon=1e-6, swap=False, reduction="mean", name=None):
+    def _tml(a, pos, neg, *, margin, p, eps, swap, reduction):
+        dp = jnp.sum(jnp.abs(a - pos) ** p + eps, axis=-1) ** (1 / p)
+        dn = jnp.sum(jnp.abs(a - neg) ** p + eps, axis=-1) ** (1 / p)
+        if swap:
+            dn2 = jnp.sum(jnp.abs(pos - neg) ** p + eps, axis=-1) ** (1 / p)
+            dn = jnp.minimum(dn, dn2)
+        return _reduce(jnp.maximum(dp - dn + margin, 0.0), reduction)
+
+    return apply(_tml, (input, positive, negative), dict(margin=float(margin), p=float(p), eps=float(epsilon), swap=bool(swap), reduction=reduction))
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0, reduction="mean", norm_by_times=False):
+    raise NotImplementedError("ctc_loss: planned (lax.scan forward algorithm)")
+
+
+def square_error_cost(input, label):
+    def _sec(x, y):
+        return jnp.square(x - y)
+
+    return apply(_sec, (input, label), {})
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0, reduction="sum", name=None):
+    def _sfl(z, y, *, alpha, gamma, reduction):
+        p = jax.nn.sigmoid(z)
+        ce = jnp.maximum(z, 0) - z * y + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        p_t = p * y + (1 - p) * (1 - y)
+        a_t = alpha * y + (1 - alpha) * (1 - y)
+        loss = a_t * ((1 - p_t) ** gamma) * ce
+        return _reduce(loss, reduction)
+
+    out = apply(_sfl, (logit, label), dict(alpha=float(alpha), gamma=float(gamma), reduction=reduction))
+    if normalizer is not None:
+        from ...ops.math import divide
+
+        out = divide(out, normalizer)
+    return out

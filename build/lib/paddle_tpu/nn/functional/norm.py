@@ -1,0 +1,192 @@
+"""Normalization functionals (ref:python/paddle/nn/functional/norm.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import apply
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None, training=False, momentum=0.9, epsilon=1e-5, data_format="NCHW", use_global_stats=None, name=None):
+    """Returns output only; running-stat updates are handled by the BatchNorm
+    layer (eager in-place, trace-safe via the mutation sink)."""
+    channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+    use_batch_stats = training and not use_global_stats
+
+    def _bn(x, rm, rv, w, b, *, eps, channel_last, use_batch_stats):
+        c_axis = x.ndim - 1 if channel_last else 1
+        red_axes = tuple(i for i in range(x.ndim) if i != c_axis)
+        if use_batch_stats:
+            mean = jnp.mean(x, axis=red_axes)
+            var = jnp.var(x, axis=red_axes)
+        else:
+            mean, var = rm, rv
+        shape = [1] * x.ndim
+        shape[c_axis] = x.shape[c_axis]
+        inv = jax.lax.rsqrt(var + eps)
+        out = (x - mean.reshape(shape)) * inv.reshape(shape)
+        if w is not None:
+            out = out * w.reshape(shape)
+        if b is not None:
+            out = out + b.reshape(shape)
+        return out.astype(x.dtype)
+
+    from ...core.tensor import Tensor
+    from ...ops.creation import ones, zeros
+
+    c_axis = x.ndim - 1 if channel_last else 1
+    C = x.shape[c_axis]
+    w = weight if weight is not None else ones([C], dtype="float32")
+    b = bias if bias is not None else zeros([C], dtype="float32")
+    return apply(_bn, (x, running_mean, running_var, w, b), dict(eps=float(epsilon), channel_last=channel_last, use_batch_stats=bool(use_batch_stats)))
+
+
+def batch_stats(x, data_format="NCHW"):
+    """Batch mean/var used for running-stat updates (layer helper)."""
+    channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+
+    def _stats(x, *, channel_last):
+        c_axis = x.ndim - 1 if channel_last else 1
+        red = tuple(i for i in range(x.ndim) if i != c_axis)
+        return jnp.mean(x, axis=red), jnp.var(x, axis=red)
+
+    return apply(_stats, (x,), dict(channel_last=channel_last))
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5, name=None):
+    if isinstance(normalized_shape, int):
+        normalized_shape = (normalized_shape,)
+    n_axes = len(tuple(normalized_shape))
+
+    def _ln(x, w, b, *, eps, n_axes):
+        axes = tuple(range(x.ndim - n_axes, x.ndim))
+        # reduce in f32 for bf16 stability, the standard TPU recipe
+        xf = x.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=axes, keepdims=True)
+        var = jnp.var(xf, axis=axes, keepdims=True)
+        out = (xf - mean) * jax.lax.rsqrt(var + eps)
+        if w is not None:
+            out = out * w.astype(jnp.float32)
+        if b is not None:
+            out = out + b.astype(jnp.float32)
+        return out.astype(x.dtype)
+
+    from ...ops.creation import ones, zeros
+
+    if weight is None and bias is None:
+        def _ln_nw(x, *, eps, n_axes):
+            axes = tuple(range(x.ndim - n_axes, x.ndim))
+            xf = x.astype(jnp.float32)
+            mean = jnp.mean(xf, axis=axes, keepdims=True)
+            var = jnp.var(xf, axis=axes, keepdims=True)
+            return ((xf - mean) * jax.lax.rsqrt(var + eps)).astype(x.dtype)
+
+        return apply(_ln_nw, (x,), dict(eps=float(epsilon), n_axes=n_axes))
+    w = weight if weight is not None else ones(list(normalized_shape), dtype="float32")
+    b = bias if bias is not None else zeros(list(normalized_shape), dtype="float32")
+    return apply(_ln, (x, w, b), dict(eps=float(epsilon), n_axes=n_axes))
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None, use_input_stats=True, momentum=0.9, eps=1e-5, data_format="NCHW", name=None):
+    def _in(x, w, b, *, eps, channel_last):
+        c_axis = x.ndim - 1 if channel_last else 1
+        red = tuple(i for i in range(2 if not channel_last else 1, x.ndim) if i != c_axis)
+        mean = jnp.mean(x, axis=red, keepdims=True)
+        var = jnp.var(x, axis=red, keepdims=True)
+        out = (x - mean) * jax.lax.rsqrt(var + eps)
+        shape = [1] * x.ndim
+        shape[c_axis] = x.shape[c_axis]
+        if w is not None:
+            out = out * w.reshape(shape)
+        if b is not None:
+            out = out + b.reshape(shape)
+        return out.astype(x.dtype)
+
+    from ...ops.creation import ones, zeros
+
+    channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+    C = x.shape[x.ndim - 1 if channel_last else 1]
+    w = weight if weight is not None else ones([C], dtype="float32")
+    b = bias if bias is not None else zeros([C], dtype="float32")
+    return apply(_in, (x, w, b), dict(eps=float(eps), channel_last=channel_last))
+
+
+def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None, data_format="NCHW", name=None):
+    def _gn(x, w, b, *, g, eps, channel_last):
+        if channel_last:
+            x_t = jnp.moveaxis(x, -1, 1)
+        else:
+            x_t = x
+        n, c = x_t.shape[:2]
+        r = x_t.reshape(n, g, c // g, *x_t.shape[2:])
+        axes = tuple(range(2, r.ndim))
+        mean = jnp.mean(r, axis=axes, keepdims=True)
+        var = jnp.var(r, axis=axes, keepdims=True)
+        out = ((r - mean) * jax.lax.rsqrt(var + eps)).reshape(x_t.shape)
+        shape = (1, c) + (1,) * (x_t.ndim - 2)
+        if w is not None:
+            out = out * w.reshape(shape)
+        if b is not None:
+            out = out + b.reshape(shape)
+        if channel_last:
+            out = jnp.moveaxis(out, 1, -1)
+        return out.astype(x.dtype)
+
+    from ...ops.creation import ones, zeros
+
+    channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+    C = x.shape[x.ndim - 1 if channel_last else 1]
+    w = weight if weight is not None else ones([C], dtype="float32")
+    b = bias if bias is not None else zeros([C], dtype="float32")
+    return apply(_gn, (x, w, b), dict(g=int(num_groups), eps=float(epsilon), channel_last=channel_last))
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    def _normalize(x, *, p, axis, eps):
+        if p == 2:
+            n = jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True))
+        else:
+            n = jnp.sum(jnp.abs(x) ** p, axis=axis, keepdims=True) ** (1.0 / p)
+        return x / jnp.maximum(n, eps)
+
+    return apply(_normalize, (x,), dict(p=float(p), axis=int(axis), eps=float(epsilon)))
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0, data_format="NCHW", name=None):
+    def _lrn(x, *, size, alpha, beta, k, channel_last):
+        if channel_last:
+            x_t = jnp.moveaxis(x, -1, 1)
+        else:
+            x_t = x
+        sq = jnp.square(x_t)
+        half = size // 2
+        pads = [(0, 0), (half, size - 1 - half)] + [(0, 0)] * (x_t.ndim - 2)
+        sq_p = jnp.pad(sq, pads)
+        acc = sum(sq_p[:, i : i + x_t.shape[1]] for i in range(size))
+        out = x_t / (k + alpha / size * acc) ** beta
+        if channel_last:
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+
+    return apply(_lrn, (x,), dict(size=int(size), alpha=float(alpha), beta=float(beta), k=float(k), channel_last=data_format in ("NHWC", "NLC", "NDHWC")))
+
+
+def rms_norm(x, weight=None, epsilon=1e-6, name=None):
+    """TPU-native addition: RMSNorm (standard in modern LLMs)."""
+
+    def _rms(x, w, *, eps):
+        xf = x.astype(jnp.float32)
+        ms = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+        out = xf * jax.lax.rsqrt(ms + eps)
+        if w is not None:
+            out = out * w.astype(jnp.float32)
+        return out.astype(x.dtype)
+
+    if weight is None:
+        def _rms_nw(x, *, eps):
+            xf = x.astype(jnp.float32)
+            ms = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+            return (xf * jax.lax.rsqrt(ms + eps)).astype(x.dtype)
+
+        return apply(_rms_nw, (x,), dict(eps=float(epsilon)))
+    return apply(_rms, (x, weight), dict(eps=float(epsilon)))

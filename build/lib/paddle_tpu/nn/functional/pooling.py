@@ -1,0 +1,134 @@
+"""Pooling functionals (ref:python/paddle/nn/functional/pooling.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.dispatch import apply
+from .conv import _norm_padding, _norm_tuple
+
+
+def _pool(x, ksize, stride, padding, n, data_format, reducer, init, ceil_mode=False, count_include_pad=True):
+    ksize = _norm_tuple(ksize, n)
+    stride = _norm_tuple(stride if stride is not None else ksize, n)
+    pad = _norm_padding(padding, n, stride, (1,) * n, ksize)
+    channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+
+    def _run(x, *, ksize, stride, pad, channel_last, reducer, init, count_include_pad):
+        if channel_last:
+            dims = (1,) + ksize + (1,)
+            strides = (1,) + stride + (1,)
+            pads = ((0, 0),) + (pad if not isinstance(pad, str) else pad) + ((0, 0),) if not isinstance(pad, str) else pad
+        else:
+            dims = (1, 1) + ksize
+            strides = (1, 1) + stride
+            pads = ((0, 0), (0, 0)) + pad if not isinstance(pad, str) else pad
+        red = jax.lax.max if reducer == "max" else jax.lax.add
+        # init MUST be a scalar literal: an array init makes reduce_window
+        # opaque to jit-linearization (grad-under-jit then fails)
+        ini = -jnp.inf if reducer == "max" else 0.0
+        out = jax.lax.reduce_window(x, ini, red, dims, strides, pads)
+        out = out.astype(x.dtype)
+        if reducer == "avg":
+            if count_include_pad or isinstance(pads, str):
+                denom = np.prod(ksize)
+                out = out / denom
+            else:
+                ones = jnp.ones_like(x)
+                counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, dims, strides, pads)
+                out = out / counts
+        return out
+
+    return apply(
+        _run,
+        (x,),
+        dict(
+            ksize=ksize,
+            stride=stride,
+            pad=pad if isinstance(pad, str) else tuple(pad),
+            channel_last=channel_last,
+            reducer=reducer,
+            init=init,
+            count_include_pad=count_include_pad,
+        ),
+    )
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, data_format="NCL", name=None):
+    return _pool(x, kernel_size, stride, padding, 1, data_format, "max", -np.inf, ceil_mode)
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, data_format="NCHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 2, data_format, "max", -np.inf, ceil_mode)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, data_format="NCDHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 3, data_format, "max", -np.inf, ceil_mode)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True, ceil_mode=False, data_format="NCL", name=None):
+    return _pool(x, kernel_size, stride, padding, 1, data_format, "avg", 0.0, ceil_mode, count_include_pad=not exclusive)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True, divisor_override=None, data_format="NCHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 2, data_format, "avg", 0.0, ceil_mode, count_include_pad=not exclusive)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True, divisor_override=None, data_format="NCDHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 3, data_format, "avg", 0.0, ceil_mode, count_include_pad=not exclusive)
+
+
+def _adaptive_pool(x, output_size, n, data_format, mode):
+    if isinstance(output_size, int):
+        output_size = (output_size,) * n
+    output_size = tuple(int(s) for s in output_size)
+    channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+
+    def _run(x, *, out_size, channel_last, mode):
+        spatial_axes = list(range(1, x.ndim - 1)) if channel_last else list(range(2, x.ndim))
+        out = x
+        for ax, os in zip(spatial_axes, out_size):
+            in_s = out.shape[ax]
+            if in_s % os == 0:
+                k = in_s // os
+                new_shape = out.shape[:ax] + (os, k) + out.shape[ax + 1 :]
+                r = out.reshape(new_shape)
+                out = jnp.max(r, axis=ax + 1) if mode == "max" else jnp.mean(r, axis=ax + 1)
+            else:
+                # general adaptive bins
+                idx = [np.arange(os) * in_s // os, ((np.arange(os) + 1) * in_s + os - 1) // os]
+                pieces = []
+                for i in range(os):
+                    sl = [slice(None)] * out.ndim
+                    sl[ax] = slice(int(idx[0][i]), int(idx[1][i]))
+                    seg = out[tuple(sl)]
+                    pieces.append(jnp.max(seg, axis=ax, keepdims=True) if mode == "max" else jnp.mean(seg, axis=ax, keepdims=True))
+                out = jnp.concatenate(pieces, axis=ax)
+        return out
+
+    return apply(_run, (x,), dict(out_size=output_size, channel_last=channel_last, mode=mode))
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive_pool(x, output_size, 1, "NCL", "avg")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive_pool(x, output_size, 2, data_format, "avg")
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive_pool(x, output_size, 3, data_format, "avg")
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 1, "NCL", "max")
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 2, "NCHW", "max")
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 3, "NCDHW", "max")

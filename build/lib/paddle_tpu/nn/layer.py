@@ -1,0 +1,341 @@
+"""nn.Layer base class.
+
+Mirrors the reference's ``paddle.nn.Layer``
+(ref:python/paddle/fluid/dygraph/layers.py): parameter/sublayer/buffer
+registries, hooks, ``state_dict``/``set_state_dict``, train/eval.
+
+TPU-first addition: a Layer is convertible to a pytree of parameters
+(``functional_state``) and can be executed functionally with swapped
+parameter values (see jit.functional_call) — this is what lets one Layer
+definition serve eager mode AND compiled/pjit-sharded training.
+"""
+from __future__ import annotations
+
+import contextlib
+from collections import OrderedDict
+from typing import Callable, Dict, Iterator, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dtype import convert_dtype_arg, is_floating
+from ..core.tensor import Tensor
+
+# Active "mutation sink" used while tracing: buffer updates (e.g. BatchNorm
+# running stats) are recorded here so the compiled program can return them.
+_MUTATION_SINK = []
+
+
+@contextlib.contextmanager
+def mutation_sink(sink: dict):
+    _MUTATION_SINK.append(sink)
+    try:
+        yield sink
+    finally:
+        _MUTATION_SINK.pop()
+
+
+class Parameter(Tensor):
+    """Trainable tensor (ref: paddle.ParamAttr / EagerParamBase)."""
+
+    def __init__(self, data, trainable=True, name=None):
+        super().__init__(data, stop_gradient=not trainable, name=name)
+        self.persistable = True
+
+    @property
+    def trainable(self):
+        return not self.stop_gradient
+
+    @trainable.setter
+    def trainable(self, v):
+        self.stop_gradient = not v
+
+
+jax.tree_util.register_pytree_node(
+    Parameter,
+    lambda t: ((t._data,), (t.stop_gradient, t.name)),
+    lambda aux, children: _unflatten_param(aux, children),
+)
+
+
+def _unflatten_param(aux, children):
+    p = Parameter.__new__(Parameter)
+    Tensor.__init__(p, children[0], stop_gradient=aux[0], name=aux[1])
+    p.persistable = True
+    return p
+
+
+class Layer:
+    def __init__(self, name_scope: Optional[str] = None, dtype="float32"):
+        self.training = True
+        self._dtype = convert_dtype_arg(dtype)
+        self._parameters: "OrderedDict[str, Parameter]" = OrderedDict()
+        self._sub_layers: "OrderedDict[str, Layer]" = OrderedDict()
+        self._buffers: "OrderedDict[str, Tensor]" = OrderedDict()
+        self._non_persistable_buffer_names = set()
+        self._forward_pre_hooks: "OrderedDict[int, Callable]" = OrderedDict()
+        self._forward_post_hooks: "OrderedDict[int, Callable]" = OrderedDict()
+        self._hook_id = 0
+        self._name = name_scope or self.__class__.__name__.lower()
+
+    # ---------------------------------------------------------- registration
+    def __setattr__(self, name, value):
+        params = self.__dict__.get("_parameters")
+        layers = self.__dict__.get("_sub_layers")
+        buffers = self.__dict__.get("_buffers")
+        if isinstance(value, Parameter) and params is not None:
+            params[name] = value
+            buffers.pop(name, None) if buffers else None
+            object.__setattr__(self, name, value)
+        elif isinstance(value, Layer) and layers is not None:
+            layers[name] = value
+            object.__setattr__(self, name, value)
+        else:
+            if params is not None and name in params and value is None:
+                del params[name]
+            if layers is not None and name in layers and value is None:
+                del layers[name]
+            object.__setattr__(self, name, value)
+
+    def add_parameter(self, name: str, parameter: Optional[Parameter]):
+        if parameter is not None and not isinstance(parameter, Parameter):
+            parameter = Parameter(parameter._data if isinstance(parameter, Tensor) else jnp.asarray(parameter))
+        setattr(self, name, parameter)
+        return parameter
+
+    def add_sublayer(self, name: str, sublayer: "Layer"):
+        self._sub_layers[name] = sublayer
+        object.__setattr__(self, name, sublayer)
+        return sublayer
+
+    def register_buffer(self, name: str, tensor: Optional[Tensor], persistable: bool = True):
+        if tensor is not None and not isinstance(tensor, Tensor):
+            tensor = Tensor(jnp.asarray(tensor))
+        self._buffers[name] = tensor
+        if not persistable:
+            self._non_persistable_buffer_names.add(name)
+        object.__setattr__(self, name, tensor)
+        return tensor
+
+    def update_buffer(self, buffer: Tensor, new_value):
+        """Assign a new value to a registered buffer; trace-safe."""
+        val = new_value._data if isinstance(new_value, Tensor) else new_value
+        if _MUTATION_SINK and isinstance(val, jax.core.Tracer):
+            _MUTATION_SINK[-1][id(buffer)] = (buffer, val)
+        else:
+            buffer._data = val
+
+    def create_parameter(self, shape, attr=None, dtype=None, is_bias=False, default_initializer=None):
+        from . import initializer as I
+
+        dtype = convert_dtype_arg(dtype) or self._dtype
+        init = default_initializer
+        name = None
+        trainable = True
+        learning_rate = 1.0
+        if attr is not None and attr is not False:
+            init = getattr(attr, "initializer", None) or init
+            name = getattr(attr, "name", None)
+            trainable = getattr(attr, "trainable", True)
+        if init is None:
+            init = I.Constant(0.0) if is_bias else I.XavierNormal()
+        data = init(shape, dtype)
+        p = Parameter(data, trainable=trainable, name=name)
+        return p
+
+    # ------------------------------------------------------------- traversal
+    def named_parameters(self, prefix="", include_sublayers=True) -> Iterator[Tuple[str, Parameter]]:
+        seen = set()
+        for name, p in self._parameters.items():
+            if p is not None and id(p) not in seen:
+                seen.add(id(p))
+                yield (prefix + name if not prefix else prefix + "." + name) if prefix else name, p
+        if include_sublayers:
+            for lname, layer in self._sub_layers.items():
+                if layer is None:
+                    continue
+                sub_prefix = prefix + "." + lname if prefix else lname
+                for n, p in layer.named_parameters(sub_prefix, True):
+                    if id(p) not in seen:
+                        seen.add(id(p))
+                        yield n, p
+
+    def parameters(self, include_sublayers=True):
+        return [p for _, p in self.named_parameters(include_sublayers=include_sublayers)]
+
+    def named_buffers(self, prefix="", include_sublayers=True):
+        for name, b in self._buffers.items():
+            if b is not None:
+                yield (prefix + "." + name if prefix else name), b
+        if include_sublayers:
+            for lname, layer in self._sub_layers.items():
+                if layer is None:
+                    continue
+                sub_prefix = prefix + "." + lname if prefix else lname
+                yield from layer.named_buffers(sub_prefix, True)
+
+    def buffers(self, include_sublayers=True):
+        return [b for _, b in self.named_buffers(include_sublayers=include_sublayers)]
+
+    def named_sublayers(self, prefix="", include_self=False):
+        if include_self:
+            yield prefix, self
+        for name, layer in self._sub_layers.items():
+            if layer is None:
+                continue
+            sub_prefix = prefix + "." + name if prefix else name
+            yield sub_prefix, layer
+            yield from layer.named_sublayers(sub_prefix, False)
+
+    def sublayers(self, include_self=False):
+        return [l for _, l in self.named_sublayers(include_self=include_self)]
+
+    def children(self):
+        return iter(l for l in self._sub_layers.values() if l is not None)
+
+    def named_children(self):
+        return iter((n, l) for n, l in self._sub_layers.items() if l is not None)
+
+    def apply(self, fn):
+        for l in self.sublayers(include_self=True):
+            fn(l)
+        return self
+
+    # ------------------------------------------------------------ state dict
+    def state_dict(self, destination=None, include_sublayers=True, use_hook=True):
+        dest = destination if destination is not None else OrderedDict()
+        for name, p in self.named_parameters(include_sublayers=include_sublayers):
+            dest[name] = p
+        for name, b in self.named_buffers(include_sublayers=include_sublayers):
+            leaf = name.rsplit(".", 1)[-1]
+            owner = self._locate(name)
+            if leaf in owner._non_persistable_buffer_names:
+                continue
+            dest[name] = b
+        return dest
+
+    def _locate(self, dotted: str) -> "Layer":
+        parts = dotted.split(".")[:-1]
+        layer = self
+        for p in parts:
+            layer = layer._sub_layers.get(p, layer)
+        return layer
+
+    def set_state_dict(self, state_dict, use_structured_name=True):
+        own = self.state_dict()
+        missing = []
+        for name, target in own.items():
+            if name not in state_dict:
+                missing.append(name)
+                continue
+            v = state_dict[name]
+            arr = v._data if isinstance(v, Tensor) else jnp.asarray(np.asarray(v))
+            if tuple(arr.shape) != tuple(target._data.shape):
+                raise ValueError(f"shape mismatch for {name}: {arr.shape} vs {target._data.shape}")
+            target._data = arr.astype(target._data.dtype)
+        return missing, [k for k in state_dict if k not in own]
+
+    set_dict = set_state_dict
+    load_dict = set_state_dict
+
+    # ------------------------------------------------------------ mode / cast
+    def train(self):
+        self.training = True
+        for l in self.sublayers():
+            l.training = True
+        return self
+
+    def eval(self):
+        self.training = False
+        for l in self.sublayers():
+            l.training = False
+        return self
+
+    def to(self, device=None, dtype=None, blocking=None):
+        dtype = convert_dtype_arg(dtype)
+        for t in list(self.parameters()) + list(self.buffers()):
+            if dtype is not None and is_floating(t._data.dtype):
+                t._data = t._data.astype(dtype)
+        if dtype is not None:
+            self._dtype = dtype
+        return self
+
+    def astype(self, dtype):
+        return self.to(dtype=dtype)
+
+    def float(self):
+        return self.to(dtype="float32")
+
+    def bfloat16(self):
+        return self.to(dtype="bfloat16")
+
+    # ---------------------------------------------------------------- hooks
+    def register_forward_pre_hook(self, hook):
+        self._hook_id += 1
+        self._forward_pre_hooks[self._hook_id] = hook
+        return _HookRemover(self._forward_pre_hooks, self._hook_id)
+
+    def register_forward_post_hook(self, hook):
+        self._hook_id += 1
+        self._forward_post_hooks[self._hook_id] = hook
+        return _HookRemover(self._forward_post_hooks, self._hook_id)
+
+    # ---------------------------------------------------------------- call
+    def forward(self, *inputs, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, *inputs, **kwargs):
+        for hook in self._forward_pre_hooks.values():
+            out = hook(self, inputs)
+            if out is not None:
+                inputs = out if isinstance(out, tuple) else (out,)
+        outputs = self.forward(*inputs, **kwargs)
+        for hook in self._forward_post_hooks.values():
+            o = hook(self, inputs, outputs)
+            if o is not None:
+                outputs = o
+        return outputs
+
+    def extra_repr(self):
+        return ""
+
+    def __repr__(self):
+        lines = [self.__class__.__name__ + "(" + self.extra_repr()]
+        for name, l in self._sub_layers.items():
+            sub = repr(l).split("\n")
+            lines.append(f"  ({name}): " + sub[0])
+            lines.extend("  " + s for s in sub[1:])
+        lines.append(")")
+        return "\n".join(lines)
+
+    def clear_gradients(self):
+        for p in self.parameters():
+            p.clear_grad()
+
+    # --------------------------------------------------- functional bridge
+    def functional_state(self):
+        """(params, buffers) as flat name->Tensor dicts (pjit-able pytrees)."""
+        params = OrderedDict(self.named_parameters())
+        buffers = OrderedDict(self.named_buffers())
+        return params, buffers
+
+
+class _HookRemover:
+    def __init__(self, d, k):
+        self._d, self._k = d, k
+
+    def remove(self):
+        self._d.pop(self._k, None)
+
+
+class ParamAttr:
+    """ref: paddle.ParamAttr — initializer/trainable/name bundle."""
+
+    def __init__(self, name=None, initializer=None, learning_rate=1.0, regularizer=None, trainable=True, need_clip=True):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.need_clip = need_clip

@@ -1,0 +1,97 @@
+"""Activation & loss layers as thin functional wrappers."""
+from __future__ import annotations
+
+import sys
+
+from . import functional as F
+from .layer import Layer
+
+_this = sys.modules[__name__]
+
+
+def _act_layer(cls_name, fn_name, **defaults):
+    fn = getattr(F, fn_name)
+
+    class _Act(Layer):
+        def __init__(self, *a, name=None, **kw):
+            super().__init__()
+            merged = dict(defaults)
+            for k, v in zip(list(defaults.keys()), a):
+                merged[k] = v
+            merged.update(kw)
+            self._kw = merged
+
+        def forward(self, x):
+            return fn(x, **self._kw)
+
+    _Act.__name__ = cls_name
+    setattr(_this, cls_name, _Act)
+    return _Act
+
+
+ReLU = _act_layer("ReLU", "relu")
+ReLU6 = _act_layer("ReLU6", "relu6")
+GELU = _act_layer("GELU", "gelu", approximate=False)
+Sigmoid = _act_layer("Sigmoid", "sigmoid")
+Tanh = _act_layer("Tanh", "tanh")
+Softmax = _act_layer("Softmax", "softmax", axis=-1)
+LogSoftmax = _act_layer("LogSoftmax", "log_softmax", axis=-1)
+LeakyReLU = _act_layer("LeakyReLU", "leaky_relu", negative_slope=0.01)
+ELU = _act_layer("ELU", "elu", alpha=1.0)
+SELU = _act_layer("SELU", "selu")
+CELU = _act_layer("CELU", "celu", alpha=1.0)
+Silu = _act_layer("Silu", "silu")
+Swish = _act_layer("Swish", "swish")
+Mish = _act_layer("Mish", "mish")
+Hardswish = _act_layer("Hardswish", "hardswish")
+Hardsigmoid = _act_layer("Hardsigmoid", "hardsigmoid")
+Hardtanh = _act_layer("Hardtanh", "hardtanh", min=-1.0, max=1.0)
+Hardshrink = _act_layer("Hardshrink", "hardshrink", threshold=0.5)
+Softshrink = _act_layer("Softshrink", "softshrink", threshold=0.5)
+Softplus = _act_layer("Softplus", "softplus", beta=1.0, threshold=20.0)
+Softsign = _act_layer("Softsign", "softsign")
+Tanhshrink = _act_layer("Tanhshrink", "tanhshrink")
+ThresholdedReLU = _act_layer("ThresholdedReLU", "thresholded_relu", threshold=1.0)
+LogSigmoid = _act_layer("LogSigmoid", "log_sigmoid")
+GLU = _act_layer("GLU", "glu", axis=-1)
+Maxout = _act_layer("Maxout", "maxout", groups=2, axis=1)
+
+
+class PReLU(Layer):
+    def __init__(self, num_parameters=1, init=0.25, weight_attr=None, data_format="NCHW", name=None):
+        super().__init__()
+        from . import initializer as I
+
+        self._data_format = data_format
+        self.weight = self.create_parameter([num_parameters], attr=weight_attr, default_initializer=I.Constant(init))
+
+    def forward(self, x):
+        return F.prelu(x, self.weight, self._data_format)
+
+
+def _loss_layer(cls_name, fn_name, **defaults):
+    fn = getattr(F, fn_name)
+
+    class _Loss(Layer):
+        def __init__(self, name=None, **kw):
+            super().__init__()
+            merged = dict(defaults)
+            merged.update(kw)
+            self._kw = merged
+
+        def forward(self, input, label):
+            return fn(input, label, **self._kw)
+
+    _Loss.__name__ = cls_name
+    setattr(_this, cls_name, _Loss)
+    return _Loss
+
+
+CrossEntropyLoss = _loss_layer("CrossEntropyLoss", "cross_entropy", reduction="mean")
+MSELoss = _loss_layer("MSELoss", "mse_loss", reduction="mean")
+L1Loss = _loss_layer("L1Loss", "l1_loss", reduction="mean")
+NLLLoss = _loss_layer("NLLLoss", "nll_loss", reduction="mean")
+BCELoss = _loss_layer("BCELoss", "binary_cross_entropy", reduction="mean")
+BCEWithLogitsLoss = _loss_layer("BCEWithLogitsLoss", "binary_cross_entropy_with_logits", reduction="mean")
+SmoothL1Loss = _loss_layer("SmoothL1Loss", "smooth_l1_loss", reduction="mean")
+KLDivLoss = _loss_layer("KLDivLoss", "kl_div", reduction="mean")

@@ -1,0 +1,339 @@
+"""Standard layers (ref:python/paddle/nn/layer/{common,conv,norm,pooling}.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from . import functional as F
+from . import initializer as I
+from .layer import Layer, Parameter
+
+
+class Linear(Layer):
+    def __init__(self, in_features, out_features, weight_attr=None, bias_attr=None, name=None):
+        super().__init__()
+        self.weight = self.create_parameter([in_features, out_features], attr=weight_attr)
+        self.bias = None if bias_attr is False else self.create_parameter([out_features], attr=bias_attr, is_bias=True)
+
+    def forward(self, x):
+        return F.linear(x, self.weight, self.bias)
+
+    def extra_repr(self):
+        return f"in={self.weight.shape[0]}, out={self.weight.shape[1]}"
+
+
+class _ConvNd(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, n, stride=1, padding=0, dilation=1, groups=1,
+                 padding_mode="zeros", weight_attr=None, bias_attr=None, data_format="NCHW", transpose=False,
+                 output_padding=0):
+        super().__init__()
+        if isinstance(kernel_size, int):
+            kernel_size = (kernel_size,) * n
+        self._n = n
+        self._stride = stride
+        self._padding = padding
+        self._dilation = dilation
+        self._groups = groups
+        self._data_format = data_format
+        self._transpose = transpose
+        self._output_padding = output_padding
+        if transpose:
+            wshape = [in_channels, out_channels // groups, *kernel_size]
+        else:
+            wshape = [out_channels, in_channels // groups, *kernel_size]
+        fan_in = in_channels // groups * int(np.prod(kernel_size))
+        default_init = I.Uniform(-np.sqrt(1.0 / fan_in), np.sqrt(1.0 / fan_in))
+        self.weight = self.create_parameter(wshape, attr=weight_attr, default_initializer=default_init)
+        self.bias = None if bias_attr is False else self.create_parameter([out_channels], attr=bias_attr, is_bias=True)
+
+    def forward(self, x):
+        fn = {
+            (1, False): F.conv1d, (2, False): F.conv2d, (3, False): F.conv3d,
+            (1, True): F.conv1d_transpose, (2, True): F.conv2d_transpose, (3, True): F.conv3d_transpose,
+        }[(self._n, self._transpose)]
+        if self._transpose:
+            return fn(x, self.weight, self.bias, self._stride, self._padding, self._output_padding,
+                      self._groups, self._dilation, None, self._data_format)
+        return fn(x, self.weight, self.bias, self._stride, self._padding, self._dilation, self._groups, self._data_format)
+
+
+class Conv1D(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1, padding=0, dilation=1, groups=1,
+                 padding_mode="zeros", weight_attr=None, bias_attr=None, data_format="NCL"):
+        super().__init__(in_channels, out_channels, kernel_size, 1, stride, padding, dilation, groups,
+                         padding_mode, weight_attr, bias_attr, data_format)
+
+
+class Conv2D(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1, padding=0, dilation=1, groups=1,
+                 padding_mode="zeros", weight_attr=None, bias_attr=None, data_format="NCHW"):
+        super().__init__(in_channels, out_channels, kernel_size, 2, stride, padding, dilation, groups,
+                         padding_mode, weight_attr, bias_attr, data_format)
+
+
+class Conv3D(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1, padding=0, dilation=1, groups=1,
+                 padding_mode="zeros", weight_attr=None, bias_attr=None, data_format="NCDHW"):
+        super().__init__(in_channels, out_channels, kernel_size, 3, stride, padding, dilation, groups,
+                         padding_mode, weight_attr, bias_attr, data_format)
+
+
+class Conv2DTranspose(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1, padding=0, output_padding=0,
+                 dilation=1, groups=1, weight_attr=None, bias_attr=None, data_format="NCHW"):
+        super().__init__(in_channels, out_channels, kernel_size, 2, stride, padding, dilation, groups,
+                         "zeros", weight_attr, bias_attr, data_format, transpose=True, output_padding=output_padding)
+
+
+class Embedding(Layer):
+    def __init__(self, num_embeddings, embedding_dim, padding_idx=None, sparse=False, weight_attr=None, name=None):
+        super().__init__()
+        self._padding_idx = padding_idx
+        self.weight = self.create_parameter(
+            [num_embeddings, embedding_dim], attr=weight_attr, default_initializer=I.Normal(0.0, 1.0)
+        )
+
+    def forward(self, x):
+        return F.embedding(x, self.weight, padding_idx=self._padding_idx)
+
+
+class Dropout(Layer):
+    def __init__(self, p=0.5, axis=None, mode="upscale_in_train", name=None):
+        super().__init__()
+        self.p, self.axis, self.mode = p, axis, mode
+
+    def forward(self, x):
+        return F.dropout(x, self.p, axis=self.axis, training=self.training, mode=self.mode)
+
+
+class Dropout2D(Layer):
+    def __init__(self, p=0.5, data_format="NCHW", name=None):
+        super().__init__()
+        self.p, self.data_format = p, data_format
+
+    def forward(self, x):
+        return F.dropout2d(x, self.p, training=self.training, data_format=self.data_format)
+
+
+class Flatten(Layer):
+    def __init__(self, start_axis=1, stop_axis=-1):
+        super().__init__()
+        self.start_axis, self.stop_axis = start_axis, stop_axis
+
+    def forward(self, x):
+        from ..ops.manipulation import flatten
+
+        return flatten(x, self.start_axis, self.stop_axis)
+
+
+class _BatchNormBase(Layer):
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5, weight_attr=None, bias_attr=None,
+                 data_format="NCHW", use_global_stats=None, name=None):
+        super().__init__()
+        self._momentum = momentum
+        self._epsilon = epsilon
+        self._data_format = data_format
+        self._use_global_stats = use_global_stats
+        self.weight = (
+            None if weight_attr is False
+            else self.create_parameter([num_features], attr=weight_attr, default_initializer=I.Constant(1.0))
+        )
+        self.bias = None if bias_attr is False else self.create_parameter([num_features], attr=bias_attr, is_bias=True)
+        from ..ops.creation import ones, zeros
+
+        self.register_buffer("_mean", zeros([num_features], dtype="float32"))
+        self.register_buffer("_variance", ones([num_features], dtype="float32"))
+
+    def forward(self, x):
+        training = self.training and not self._use_global_stats
+        out = F.batch_norm(
+            x, self._mean, self._variance, self.weight, self.bias,
+            training=training, momentum=self._momentum, epsilon=self._epsilon,
+            data_format=self._data_format, use_global_stats=self._use_global_stats,
+        )
+        if training:
+            bm, bv = F.norm.batch_stats(x, self._data_format)
+            m = self._momentum
+            n = x.size // bm.size
+            unbiased = bv._data * (n / max(n - 1, 1))
+            self.update_buffer(self._mean, self._mean._data * m + bm._data * (1 - m))
+            self.update_buffer(self._variance, self._variance._data * m + unbiased * (1 - m))
+        return out
+
+
+class BatchNorm1D(_BatchNormBase):
+    pass
+
+
+class BatchNorm2D(_BatchNormBase):
+    pass
+
+
+class BatchNorm3D(_BatchNormBase):
+    pass
+
+
+class BatchNorm(_BatchNormBase):
+    """paddle.nn.BatchNorm (fluid-style, act support)."""
+
+    def __init__(self, num_channels, act=None, momentum=0.9, epsilon=1e-5, param_attr=None, bias_attr=None,
+                 data_layout="NCHW", use_global_stats=None, name=None):
+        super().__init__(num_channels, momentum, epsilon, param_attr, bias_attr, data_layout, use_global_stats)
+        self._act = act
+
+    def forward(self, x):
+        out = super().forward(x)
+        if self._act:
+            out = getattr(F, self._act)(out)
+        return out
+
+
+class SyncBatchNorm(_BatchNormBase):
+    """Cross-replica BN: on TPU, batch stats are all-reduced over the data axis
+    by GSPMD when running under pjit; eager single-host falls back to local BN
+    (ref:python/paddle/nn/layer/norm.py SyncBatchNorm)."""
+
+    @classmethod
+    def convert_sync_batchnorm(cls, layer):
+        return layer
+
+
+class LayerNorm(Layer):
+    def __init__(self, normalized_shape, epsilon=1e-5, weight_attr=None, bias_attr=None, name=None):
+        super().__init__()
+        if isinstance(normalized_shape, int):
+            normalized_shape = [normalized_shape]
+        self._normalized_shape = list(normalized_shape)
+        self._epsilon = epsilon
+        self.weight = (
+            None if weight_attr is False
+            else self.create_parameter(self._normalized_shape, attr=weight_attr, default_initializer=I.Constant(1.0))
+        )
+        self.bias = None if bias_attr is False else self.create_parameter(self._normalized_shape, attr=bias_attr, is_bias=True)
+
+    def forward(self, x):
+        return F.layer_norm(x, self._normalized_shape, self.weight, self.bias, self._epsilon)
+
+
+class RMSNorm(Layer):
+    def __init__(self, hidden_size, epsilon=1e-6):
+        super().__init__()
+        self._epsilon = epsilon
+        self.weight = self.create_parameter([hidden_size], default_initializer=I.Constant(1.0))
+
+    def forward(self, x):
+        return F.rms_norm(x, self.weight, self._epsilon)
+
+
+class GroupNorm(Layer):
+    def __init__(self, num_groups, num_channels, epsilon=1e-5, weight_attr=None, bias_attr=None, data_format="NCHW", name=None):
+        super().__init__()
+        self._num_groups = num_groups
+        self._epsilon = epsilon
+        self._data_format = data_format
+        self.weight = (
+            None if weight_attr is False
+            else self.create_parameter([num_channels], attr=weight_attr, default_initializer=I.Constant(1.0))
+        )
+        self.bias = None if bias_attr is False else self.create_parameter([num_channels], attr=bias_attr, is_bias=True)
+
+    def forward(self, x):
+        return F.group_norm(x, self._num_groups, self._epsilon, self.weight, self.bias, self._data_format)
+
+
+class InstanceNorm2D(Layer):
+    def __init__(self, num_features, epsilon=1e-5, momentum=0.9, weight_attr=None, bias_attr=None, data_format="NCHW", name=None):
+        super().__init__()
+        self._epsilon = epsilon
+        self.scale = (
+            None if weight_attr is False
+            else self.create_parameter([num_features], attr=weight_attr, default_initializer=I.Constant(1.0))
+        )
+        self.bias = None if bias_attr is False else self.create_parameter([num_features], attr=bias_attr, is_bias=True)
+
+    def forward(self, x):
+        return F.instance_norm(x, weight=self.scale, bias=self.bias, eps=self._epsilon)
+
+
+class _PoolNd(Layer):
+    def __init__(self, fn, kernel_size, stride=None, padding=0, **kw):
+        super().__init__()
+        self._fn, self._k, self._s, self._p, self._kw = fn, kernel_size, stride, padding, kw
+
+    def forward(self, x):
+        return self._fn(x, self._k, self._s, self._p, **self._kw)
+
+
+class MaxPool1D(_PoolNd):
+    def __init__(self, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, name=None):
+        super().__init__(F.max_pool1d, kernel_size, stride, padding)
+
+
+class MaxPool2D(_PoolNd):
+    def __init__(self, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, data_format="NCHW", name=None):
+        super().__init__(F.max_pool2d, kernel_size, stride, padding)
+
+
+class AvgPool1D(_PoolNd):
+    def __init__(self, kernel_size, stride=None, padding=0, exclusive=True, ceil_mode=False, name=None):
+        super().__init__(F.avg_pool1d, kernel_size, stride, padding)
+
+
+class AvgPool2D(_PoolNd):
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True, divisor_override=None, data_format="NCHW", name=None):
+        super().__init__(F.avg_pool2d, kernel_size, stride, padding)
+
+
+class AdaptiveAvgPool1D(Layer):
+    def __init__(self, output_size, name=None):
+        super().__init__()
+        self._os = output_size
+
+    def forward(self, x):
+        return F.adaptive_avg_pool1d(x, self._os)
+
+
+class AdaptiveAvgPool2D(Layer):
+    def __init__(self, output_size, data_format="NCHW", name=None):
+        super().__init__()
+        self._os = output_size
+        self._df = data_format
+
+    def forward(self, x):
+        return F.adaptive_avg_pool2d(x, self._os, self._df)
+
+
+class AdaptiveMaxPool2D(Layer):
+    def __init__(self, output_size, return_mask=False, name=None):
+        super().__init__()
+        self._os = output_size
+
+    def forward(self, x):
+        return F.adaptive_max_pool2d(x, self._os)
+
+
+class Upsample(Layer):
+    def __init__(self, size=None, scale_factor=None, mode="nearest", align_corners=False, align_mode=0, data_format="NCHW", name=None):
+        super().__init__()
+        self._args = (size, scale_factor, mode, align_corners, align_mode, data_format)
+
+    def forward(self, x):
+        return F.interpolate(x, *self._args)
+
+
+class Pad2D(Layer):
+    def __init__(self, padding, mode="constant", value=0.0, data_format="NCHW", name=None):
+        super().__init__()
+        self._args = (padding, mode, value, data_format)
+
+    def forward(self, x):
+        return F.pad(x, *self._args)
+
+
+class PixelShuffle(Layer):
+    def __init__(self, upscale_factor, data_format="NCHW", name=None):
+        super().__init__()
+        self._r, self._df = upscale_factor, data_format
+
+    def forward(self, x):
+        return F.pixel_shuffle(x, self._r, self._df)

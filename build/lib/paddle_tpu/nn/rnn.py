@@ -1,0 +1,250 @@
+"""Recurrent layers — parity with ref:python/paddle/nn/layer/rnn.py
+(SimpleRNNCell/LSTMCell/GRUCell, SimpleRNN/LSTM/GRU with multi-layer and
+bidirectional support).
+
+TPU-native: the time loop is ONE ``lax.scan`` per layer/direction — O(1)
+program size in sequence length, compiled once; the reference instead runs
+a cuDNN RNN kernel or an unrolled graph. Batch-major [b, s, f] by default
+(time_major=True accepted).
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core import rng
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+from .layer import Layer
+
+
+def _uniform_init(shape, dtype, k):
+    return jax.random.uniform(rng.next_key(), tuple(shape),
+                              jnp.dtype(dtype), -k, k)
+
+
+class RNNCellBase(Layer):
+    def __init__(self, input_size, hidden_size, gates, dtype="float32"):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        k = 1.0 / math.sqrt(hidden_size)
+        g = gates * hidden_size
+        from .layer import Parameter
+
+        self.weight_ih = Parameter(_uniform_init([g, input_size], dtype, k), name="weight_ih")
+        self.weight_hh = Parameter(_uniform_init([g, hidden_size], dtype, k), name="weight_hh")
+        self.bias_ih = Parameter(_uniform_init([g], dtype, k), name="bias_ih")
+        self.bias_hh = Parameter(_uniform_init([g], dtype, k), name="bias_hh")
+        self.add_parameter("weight_ih", self.weight_ih)
+        self.add_parameter("weight_hh", self.weight_hh)
+        self.add_parameter("bias_ih", self.bias_ih)
+        self.add_parameter("bias_hh", self.bias_hh)
+
+    def get_initial_states(self, batch):
+        import numpy as np
+
+        z = jnp.zeros((batch, self.hidden_size), jnp.float32)
+        return Tensor(z)
+
+
+def _rnn_step(x, h, w_ih, w_hh, b_ih, b_hh):
+    return jnp.tanh(x @ w_ih.T + b_ih + h @ w_hh.T + b_hh)
+
+
+def _lstm_step(x, hc, w_ih, w_hh, b_ih, b_hh):
+    h, c = hc
+    gates = x @ w_ih.T + b_ih + h @ w_hh.T + b_hh
+    i, f, g, o = jnp.split(gates, 4, axis=-1)
+    i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+    c_new = f * c + i * jnp.tanh(g)
+    h_new = o * jnp.tanh(c_new)
+    return (h_new, c_new)
+
+
+def _gru_step(x, h, w_ih, w_hh, b_ih, b_hh):
+    gi = x @ w_ih.T + b_ih
+    gh = h @ w_hh.T + b_hh
+    ir, iz, in_ = jnp.split(gi, 3, axis=-1)
+    hr, hz, hn = jnp.split(gh, 3, axis=-1)
+    r = jax.nn.sigmoid(ir + hr)
+    z = jax.nn.sigmoid(iz + hz)
+    n = jnp.tanh(in_ + r * hn)
+    return (1.0 - z) * n + z * h
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh", **kw):
+        super().__init__(input_size, hidden_size, 1)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs.shape[0])
+        out = apply(_rnn_step, (inputs, states, self.weight_ih, self.weight_hh,
+                                self.bias_ih, self.bias_hh), {}, name="rnn_cell")
+        return out, out
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, **kw):
+        super().__init__(input_size, hidden_size, 4)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            z = self.get_initial_states(inputs.shape[0])
+            states = (z, z)
+
+        def f(x, h, c, wi, wh, bi, bh):
+            return _lstm_step(x, (h, c), wi, wh, bi, bh)
+
+        h, c = apply(f, (inputs, states[0], states[1], self.weight_ih,
+                         self.weight_hh, self.bias_ih, self.bias_hh), {},
+                     name="lstm_cell")
+        return h, (h, c)
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, **kw):
+        super().__init__(input_size, hidden_size, 3)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs.shape[0])
+        out = apply(_gru_step, (inputs, states, self.weight_ih, self.weight_hh,
+                                self.bias_ih, self.bias_hh), {}, name="gru_cell")
+        return out, out
+
+
+class _RNNBase(Layer):
+    MODE = "RNN"
+    GATES = 1
+
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        if direction in ("bidirect", "bidirectional"):
+            self.num_directions = 2
+        elif direction == "forward":
+            self.num_directions = 1
+        else:
+            raise ValueError(f"unknown direction {direction!r}")
+        k = 1.0 / math.sqrt(hidden_size)
+        g = self.GATES * hidden_size
+        from .layer import Parameter
+
+        self._params = []
+        for layer in range(num_layers):
+            for d in range(self.num_directions):
+                in_sz = input_size if layer == 0 else hidden_size * self.num_directions
+                tag = f"l{layer}_d{d}"
+                p = {
+                    "wi": Parameter(_uniform_init([g, in_sz], "float32", k)),
+                    "wh": Parameter(_uniform_init([g, hidden_size], "float32", k)),
+                    "bi": Parameter(_uniform_init([g], "float32", k)),
+                    "bh": Parameter(_uniform_init([g], "float32", k)),
+                }
+                for n, v in p.items():
+                    self.add_parameter(f"{n}_{tag}", v)
+                self._params.append(p)
+
+    def _step_fn(self):
+        return {"RNN": _rnn_step, "LSTM": _lstm_step, "GRU": _gru_step}[self.MODE]
+
+    def _scan_layer(self, x, wi, wh, bi, bh, init, reverse):
+        """x [s, b, f] -> outputs [s, b, h], final state."""
+        step = self._step_fn()
+        lstm = self.MODE == "LSTM"
+
+        def body(carry, xt):
+            new = step(xt, carry, wi, wh, bi, bh)
+            out = new[0] if lstm else new
+            return new, out
+
+        carry, outs = jax.lax.scan(body, init, x, reverse=reverse)
+        return outs, carry
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        if sequence_length is not None:
+            raise NotImplementedError(
+                "sequence_length is not supported by paddle_tpu RNN layers; "
+                "mask the padded steps of the output instead")
+        lstm = self.MODE == "LSTM"
+
+        # initial_states: LSTM -> (h0, c0), each [L*D, b, h]; RNN/GRU -> h0.
+        init_args = ()
+        if initial_states is not None:
+            init_args = (tuple(initial_states) if lstm else (initial_states,))
+
+        def run(x, *rest):
+            # x arrives batch-major [b, s, f] unless time_major
+            n_init = len(init_args)
+            inits, flat_params = rest[:n_init], rest[n_init:]
+            xt = x if self.time_major else jnp.swapaxes(x, 0, 1)
+            s, b = xt.shape[0], xt.shape[1]
+            params = [flat_params[i * 4:(i + 1) * 4]
+                      for i in range(len(self._params))]
+            h_finals, c_finals = [], []
+            layer_in = xt
+            idx = 0
+            for layer in range(self.num_layers):
+                outs_dirs = []
+                for d in range(self.num_directions):
+                    wi, wh, bi, bh = params[idx]
+                    if inits:
+                        h0 = inits[0][idx].astype(layer_in.dtype)
+                        init = ((h0, inits[1][idx].astype(layer_in.dtype))
+                                if lstm else h0)
+                    else:
+                        z = jnp.zeros((b, self.hidden_size), layer_in.dtype)
+                        init = (z, z) if lstm else z
+                    idx += 1
+                    outs, carry = self._scan_layer(layer_in, wi, wh, bi, bh,
+                                                   init, reverse=(d == 1))
+                    outs_dirs.append(outs)
+                    if lstm:
+                        h_finals.append(carry[0])
+                        c_finals.append(carry[1])
+                    else:
+                        h_finals.append(carry)
+                layer_in = (jnp.concatenate(outs_dirs, axis=-1)
+                            if len(outs_dirs) > 1 else outs_dirs[0])
+            out = layer_in if self.time_major else jnp.swapaxes(layer_in, 0, 1)
+            h = jnp.stack(h_finals)
+            if lstm:
+                return out, h, jnp.stack(c_finals)
+            return out, h
+
+        flat = []
+        for p in self._params:
+            flat += [p["wi"], p["wh"], p["bi"], p["bh"]]
+        res = apply(run, (inputs, *init_args, *flat), {}, name=self.MODE.lower())
+        if lstm:
+            out, h, c = res
+            return out, (h, c)
+        out, h = res
+        return out, h
+
+
+class SimpleRNN(_RNNBase):
+    MODE = "RNN"
+    GATES = 1
+
+
+class LSTM(_RNNBase):
+    MODE = "LSTM"
+    GATES = 4
+
+
+class GRU(_RNNBase):
+    MODE = "GRU"
+    GATES = 3

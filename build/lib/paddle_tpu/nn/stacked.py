@@ -1,0 +1,127 @@
+"""Scan-over-layers container — the TPU-idiomatic deep-stack representation.
+
+The reference builds N separate decoder-layer objects and the executor walks
+N copies of the same ops (ref:python/paddle/incubate/nn/layer/
+fused_transformer.py FusedMultiTransformer holds per-layer ParamAttr lists).
+On TPU that multiplies HLO size and compile time by N. ``StackedLayers``
+instead holds ONE template layer plus parameters stacked along a leading
+layer dimension, and runs ``lax.scan`` over that dimension: O(1) program
+size for any depth, and the stacked leaves are exactly what pipeline
+parallelism shards over the "pipe" mesh axis
+(paddle_tpu.distributed.pipeline.pipeline_apply).
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ..core import rng
+from ..core.tensor import Tensor
+from ..distributed import mesh as mesh_mod
+from .layer import Layer, Parameter
+
+
+class StackedLayers(Layer):
+    """``num_layers`` structurally-identical layers with stacked parameters.
+
+    ``factory(i)`` must build layer i (fresh init each call). All instances
+    must have identical parameter trees. Mutable buffers (e.g. BatchNorm
+    running stats) are not supported inside the scanned body.
+    """
+
+    def __init__(self, factory: Callable[[int], Layer], num_layers: int, remat: bool = False):
+        super().__init__()
+        if num_layers < 1:
+            raise ValueError("num_layers must be >= 1")
+        self.num_layers = num_layers
+        self.remat = remat
+        insts = [factory(i) for i in range(num_layers)]
+        template = insts[0]
+        if any(True for _ in template.named_buffers()):
+            raise ValueError(
+                "StackedLayers does not support layers with buffers "
+                "(running stats can't mutate inside lax.scan)"
+            )
+        # keep the template OUT of the sublayer registry (its per-layer params
+        # are replaced by the stacked ones below)
+        object.__setattr__(self, "_template", template)
+        self._t_names: List[str] = []
+        self._t_objs: List[Parameter] = []
+        for name, p in template.named_parameters():
+            self._t_names.append(name)
+            self._t_objs.append(p)
+        mesh = mesh_mod.get_mesh()
+        for name, obj in zip(self._t_names, self._t_objs):
+            per_layer = []
+            for inst in insts:
+                q = dict(inst.named_parameters())[name]
+                per_layer.append(q._data)
+            stacked = jnp.stack(per_layer)
+            # leading layer dim + the template param's own (e.g. TP) sharding;
+            # committing to the mesh here is what makes the pipe shard_map /
+            # pjit see consistently-placed operands
+            if mesh is not None:
+                if isinstance(obj._data.sharding, NamedSharding):
+                    inner = tuple(obj._data.sharding.spec) + (None,) * (
+                        obj._data.ndim - len(obj._data.sharding.spec)
+                    )
+                else:
+                    inner = (None,) * obj._data.ndim
+                pipe = "pipe" if mesh.shape.get("pipe", 1) > 1 else None
+                stacked = jax.device_put(
+                    stacked, NamedSharding(mesh, PartitionSpec(pipe, *inner))
+                )
+            sp = Parameter(stacked, trainable=not obj.stop_gradient)
+            self.add_parameter(name.replace(".", "__"), sp)
+
+    def stacked_parameters(self) -> List[Parameter]:
+        params = dict(self.named_parameters(include_sublayers=False))
+        return [params[n.replace(".", "__")] for n in self._t_names]
+
+    def _apply_one(self, arrays, h, layer_key):
+        """Run the template with one layer's parameter slice."""
+        from ..jit import _swap_data
+
+        with _swap_data(self._t_objs, list(arrays)):
+            with rng.key_guard(layer_key):
+                out = self._template(Tensor(h) if not isinstance(h, Tensor) else h)
+        return out._data if isinstance(out, Tensor) else out
+
+    def scan_body(self, base_key):
+        """(h, (idx, *arrays)) -> (h_out, None) — the lax.scan step, usable
+        both here and inside a pipeline stage."""
+
+        def body(h, xs):
+            idx, arrays = xs[0], xs[1:]
+            out = self._apply_one(arrays, h, jax.random.fold_in(base_key, idx))
+            return out, None
+
+        if self.remat:
+            body = jax.checkpoint(body, policy=jax.checkpoint_policies.nothing_saveable)
+        return body
+
+    def forward(self, x):
+        # ONE dispatch.apply call wraps the whole scan: the eager tape records
+        # a single vjp node (and under jit it traces straight through)
+        from ..core.dispatch import apply
+
+        if not hasattr(self, "_scan_fn"):
+            def _scan_fn(h, key, *arrays):
+                xs = (jnp.arange(self.num_layers),) + tuple(arrays)
+                body = self.scan_body(key)
+                out, _ = jax.lax.scan(body, h, xs)
+                return out
+
+            object.__setattr__(self, "_scan_fn", _scan_fn)
+
+        params = self.stacked_parameters()
+        if (isinstance(x, Tensor) and not x._is_traced() and params
+                and isinstance(params[0]._data.sharding, NamedSharding)):
+            # eager: co-locate the activation with the mesh-committed params
+            pmesh = params[0]._data.sharding.mesh
+            x._data = jax.device_put(x._data, NamedSharding(pmesh, PartitionSpec()))
+        args = (x, Tensor(rng.next_key())) + tuple(params)
+        return apply(self._scan_fn, args, {}, name="stacked_layers")

@@ -1,0 +1,194 @@
+"""Transformer layers — parity with ref:python/paddle/nn/layer/transformer.py
+(MultiHeadAttention, TransformerEncoderLayer/Encoder, TransformerDecoderLayer/
+Decoder, Transformer). Attention routes through
+F.scaled_dot_product_attention, so the Pallas flash kernel / ring attention
+dispatch applies here too.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from . import functional as F
+from .layer import Layer
+from .layers_common import Dropout, LayerNorm, Linear
+
+
+class MultiHeadAttention(Layer):
+    def __init__(self, embed_dim, num_heads, dropout=0.0, kdim=None, vdim=None,
+                 need_weights=False, weight_attr=None, bias_attr=None):
+        super().__init__()
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        if self.head_dim * num_heads != embed_dim:
+            raise ValueError("embed_dim must be divisible by num_heads")
+        self.dropout = dropout
+        self.need_weights = need_weights
+        kdim = kdim or embed_dim
+        vdim = vdim or embed_dim
+        self.q_proj = Linear(embed_dim, embed_dim, weight_attr, bias_attr)
+        self.k_proj = Linear(kdim, embed_dim, weight_attr, bias_attr)
+        self.v_proj = Linear(vdim, embed_dim, weight_attr, bias_attr)
+        self.out_proj = Linear(embed_dim, embed_dim, weight_attr, bias_attr)
+
+    def forward(self, query, key=None, value=None, attn_mask=None, cache=None):
+        key = query if key is None else key
+        value = key if value is None else value
+        b, sq = query.shape[0], query.shape[1]
+        sk = key.shape[1]
+        q = self.q_proj(query).reshape([b, sq, self.num_heads, self.head_dim])
+        k = self.k_proj(key).reshape([b, sk, self.num_heads, self.head_dim])
+        v = self.v_proj(value).reshape([b, sk, self.num_heads, self.head_dim])
+        out = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask,
+            dropout_p=self.dropout if self.training else 0.0,
+            training=self.training,
+        )
+        out = out.reshape([b, sq, self.embed_dim])
+        return self.out_proj(out)
+
+
+class TransformerEncoderLayer(Layer):
+    def __init__(self, d_model, nhead, dim_feedforward, dropout=0.1,
+                 activation="relu", attn_dropout=None, act_dropout=None,
+                 normalize_before=False, weight_attr=None, bias_attr=None,
+                 layer_norm_eps=1e-5):
+        super().__init__()
+        self.normalize_before = normalize_before
+        self.self_attn = MultiHeadAttention(
+            d_model, nhead, dropout=attn_dropout if attn_dropout is not None else dropout)
+        self.linear1 = Linear(d_model, dim_feedforward)
+        self.linear2 = Linear(dim_feedforward, d_model)
+        self.norm1 = LayerNorm(d_model, epsilon=layer_norm_eps)
+        self.norm2 = LayerNorm(d_model, epsilon=layer_norm_eps)
+        self.dropout = Dropout(dropout)
+        self.dropout1 = Dropout(dropout)
+        self.dropout2 = Dropout(act_dropout if act_dropout is not None else dropout)
+        self.activation = activation
+
+    def _act(self, x):
+        return F.gelu(x) if self.activation == "gelu" else F.relu(x)
+
+    def forward(self, src, src_mask=None, cache=None):
+        residual = src
+        x = self.norm1(src) if self.normalize_before else src
+        x = self.self_attn(x, attn_mask=src_mask)
+        x = residual + self.dropout1(x)
+        if not self.normalize_before:
+            x = self.norm1(x)
+        residual = x
+        y = self.norm2(x) if self.normalize_before else x
+        y = self.linear2(self.dropout2(self._act(self.linear1(y))))
+        y = residual + self.dropout(y)
+        if not self.normalize_before:
+            y = self.norm2(y)
+        return y
+
+
+class TransformerEncoder(Layer):
+    def __init__(self, encoder_layer_or_factory, num_layers, norm=None,
+                 use_stacked: bool = True):
+        super().__init__()
+        self.num_layers = num_layers
+        self.norm = norm
+        if callable(encoder_layer_or_factory) and not isinstance(
+                encoder_layer_or_factory, Layer):
+            factory = encoder_layer_or_factory
+        else:
+            proto = encoder_layer_or_factory
+            import copy
+
+            def factory(i, _p=proto):
+                return copy.deepcopy(_p)
+
+        from .containers import LayerList
+
+        self.layers = LayerList([factory(i) for i in range(num_layers)])
+
+    def forward(self, src, src_mask=None):
+        out = src
+        for layer in self.layers:
+            out = layer(out, src_mask=src_mask)
+        if self.norm is not None:
+            out = self.norm(out)
+        return out
+
+
+class TransformerDecoderLayer(Layer):
+    def __init__(self, d_model, nhead, dim_feedforward, dropout=0.1,
+                 activation="relu", attn_dropout=None, act_dropout=None,
+                 normalize_before=False, layer_norm_eps=1e-5):
+        super().__init__()
+        self.normalize_before = normalize_before
+        self.self_attn = MultiHeadAttention(
+            d_model, nhead, dropout=attn_dropout if attn_dropout is not None else dropout)
+        self.cross_attn = MultiHeadAttention(
+            d_model, nhead, dropout=attn_dropout if attn_dropout is not None else dropout)
+        self.linear1 = Linear(d_model, dim_feedforward)
+        self.linear2 = Linear(dim_feedforward, d_model)
+        self.norm1 = LayerNorm(d_model, epsilon=layer_norm_eps)
+        self.norm2 = LayerNorm(d_model, epsilon=layer_norm_eps)
+        self.norm3 = LayerNorm(d_model, epsilon=layer_norm_eps)
+        self.dropout = Dropout(dropout)
+        self.activation = activation
+
+    def _act(self, x):
+        return F.gelu(x) if self.activation == "gelu" else F.relu(x)
+
+    def forward(self, tgt, memory, tgt_mask=None, memory_mask=None, cache=None):
+        residual = tgt
+        x = self.norm1(tgt) if self.normalize_before else tgt
+        x = residual + self.dropout(self.self_attn(x, attn_mask=tgt_mask))
+        if not self.normalize_before:
+            x = self.norm1(x)
+        residual = x
+        y = self.norm2(x) if self.normalize_before else x
+        y = residual + self.dropout(self.cross_attn(y, memory, memory, attn_mask=memory_mask))
+        if not self.normalize_before:
+            y = self.norm2(y)
+        residual = y
+        z = self.norm3(y) if self.normalize_before else y
+        z = residual + self.dropout(self.linear2(self._act(self.linear1(z))))
+        if not self.normalize_before:
+            z = self.norm3(z)
+        return z
+
+
+class TransformerDecoder(Layer):
+    def __init__(self, decoder_layer, num_layers, norm=None):
+        super().__init__()
+        import copy
+
+        from .containers import LayerList
+
+        self.layers = LayerList([copy.deepcopy(decoder_layer) for _ in range(num_layers)])
+        self.norm = norm
+
+    def forward(self, tgt, memory, tgt_mask=None, memory_mask=None):
+        out = tgt
+        for layer in self.layers:
+            out = layer(out, memory, tgt_mask=tgt_mask, memory_mask=memory_mask)
+        if self.norm is not None:
+            out = self.norm(out)
+        return out
+
+
+class Transformer(Layer):
+    def __init__(self, d_model=512, nhead=8, num_encoder_layers=6,
+                 num_decoder_layers=6, dim_feedforward=2048, dropout=0.1,
+                 activation="relu", normalize_before=False):
+        super().__init__()
+        enc_layer = TransformerEncoderLayer(d_model, nhead, dim_feedforward,
+                                            dropout, activation,
+                                            normalize_before=normalize_before)
+        dec_layer = TransformerDecoderLayer(d_model, nhead, dim_feedforward,
+                                            dropout, activation,
+                                            normalize_before=normalize_before)
+        self.encoder = TransformerEncoder(enc_layer, num_encoder_layers)
+        self.decoder = TransformerDecoder(dec_layer, num_decoder_layers)
+        self.d_model = d_model
+        self.nhead = nhead
+
+    def forward(self, src, tgt, src_mask=None, tgt_mask=None, memory_mask=None):
+        memory = self.encoder(src, src_mask=src_mask)
+        return self.decoder(tgt, memory, tgt_mask=tgt_mask, memory_mask=memory_mask)

@@ -1,0 +1,9 @@
+"""Op library: the PHI-kernel-library equivalent (ref:paddle/phi/kernels/),
+defined once as pure jax functions and dispatched through the eager jit cache."""
+from . import creation, extras, linalg, manipulation, math, random  # noqa: F401
+from .creation import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .random import *  # noqa: F401,F403
+from .extras import *  # noqa: F401,F403

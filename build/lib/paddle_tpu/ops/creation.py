@@ -1,0 +1,176 @@
+"""Tensor creation ops (ref:python/paddle/tensor/creation.py surface)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import apply
+from ..core.dtype import convert_dtype_arg, get_default_dtype
+from ..core.tensor import Tensor, to_tensor  # noqa: F401  (re-export)
+
+
+def _shape_arg(shape):
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s) for s in shape)
+
+
+def zeros(shape, dtype=None, name=None):
+    dtype = convert_dtype_arg(dtype) or get_default_dtype()
+    return Tensor(jnp.zeros(_shape_arg(shape), dtype))
+
+
+def ones(shape, dtype=None, name=None):
+    dtype = convert_dtype_arg(dtype) or get_default_dtype()
+    return Tensor(jnp.ones(_shape_arg(shape), dtype))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    dtype = convert_dtype_arg(dtype)
+    if dtype is None:
+        dtype = get_default_dtype() if isinstance(fill_value, float) else None
+    return Tensor(jnp.full(_shape_arg(shape), fill_value, dtype))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+def zeros_like(x, dtype=None, name=None):
+    def _zeros_like(x, *, dtype):
+        return jnp.zeros_like(x, dtype=dtype)
+
+    return apply(_zeros_like, (x,), dict(dtype=convert_dtype_arg(dtype)), differentiable=False)
+
+
+def ones_like(x, dtype=None, name=None):
+    def _ones_like(x, *, dtype):
+        return jnp.ones_like(x, dtype=dtype)
+
+    return apply(_ones_like, (x,), dict(dtype=convert_dtype_arg(dtype)), differentiable=False)
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    def _full_like(x, *, fill_value, dtype):
+        return jnp.full_like(x, fill_value, dtype=dtype)
+
+    return apply(
+        _full_like, (x,), dict(fill_value=fill_value, dtype=convert_dtype_arg(dtype)), differentiable=False
+    )
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    for v in (start, end, step):
+        pass
+    if isinstance(start, Tensor):
+        start = start.item()
+    if isinstance(end, Tensor):
+        end = end.item()
+    if isinstance(step, Tensor):
+        step = step.item()
+    dtype = convert_dtype_arg(dtype)
+    if dtype is None:
+        if any(isinstance(v, float) for v in (start, end or 0, step)):
+            dtype = get_default_dtype()
+        else:
+            dtype = jnp.int64
+    return Tensor(jnp.arange(start, end, step, dtype=dtype))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    if isinstance(start, Tensor):
+        start = start.item()
+    if isinstance(stop, Tensor):
+        stop = stop.item()
+    if isinstance(num, Tensor):
+        num = int(num.item())
+    return Tensor(jnp.linspace(start, stop, int(num), dtype=convert_dtype_arg(dtype) or get_default_dtype()))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    return Tensor(
+        jnp.logspace(start, stop, int(num), base=base, dtype=convert_dtype_arg(dtype) or get_default_dtype())
+    )
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor(jnp.eye(int(num_rows), num_columns and int(num_columns), dtype=convert_dtype_arg(dtype) or get_default_dtype()))
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    def _diag(x, *, offset, padding_value):
+        out = jnp.diag(x, k=offset)
+        if x.ndim == 1 and padding_value != 0:
+            mask = jnp.eye(out.shape[0], out.shape[1], k=offset, dtype=bool)
+            out = jnp.where(mask, out, padding_value)
+        return out
+
+    return apply(_diag, (x,), dict(offset=offset, padding_value=padding_value))
+
+
+def diagflat(x, offset=0, name=None):
+    def _diagflat(x, *, offset):
+        return jnp.diagflat(x, k=offset)
+
+    return apply(_diagflat, (x,), dict(offset=offset))
+
+
+def diag_embed(x, offset=0, dim1=-2, dim2=-1, name=None):
+    def _diag_embed(x, *, offset):
+        return jax.vmap(lambda v: jnp.diag(v, k=offset))(x.reshape(-1, x.shape[-1])).reshape(
+            *x.shape[:-1], x.shape[-1] + abs(offset), x.shape[-1] + abs(offset)
+        )
+
+    return apply(_diag_embed, (x,), dict(offset=offset))
+
+
+def tril(x, diagonal=0, name=None):
+    def _tril(x, *, diagonal):
+        return jnp.tril(x, k=diagonal)
+
+    return apply(_tril, (x,), dict(diagonal=diagonal))
+
+
+def triu(x, diagonal=0, name=None):
+    def _triu(x, *, diagonal):
+        return jnp.triu(x, k=diagonal)
+
+    return apply(_triu, (x,), dict(diagonal=diagonal))
+
+
+def meshgrid(*args, **kwargs):
+    tensors = args[0] if len(args) == 1 and isinstance(args[0], (list, tuple)) else args
+
+    def _meshgrid(*xs):
+        return tuple(jnp.meshgrid(*xs, indexing="ij"))
+
+    return list(apply(_meshgrid, tuple(tensors), {}))
+
+
+def clone(x, name=None):
+    from .math import assign
+
+    return assign(x)
+
+
+def tril_indices(row, col, offset=0, dtype="int64", name=None):
+    r, c = np.tril_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]), dtype=convert_dtype_arg(dtype)))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64", name=None):
+    r, c = np.triu_indices(row, offset, col if col is not None else row)
+    return Tensor(jnp.asarray(np.stack([r, c]), dtype=convert_dtype_arg(dtype)))
+
+
+for _m in ("zeros_like", "ones_like", "clone"):
+    Tensor._register_method(_m, globals()[_m])

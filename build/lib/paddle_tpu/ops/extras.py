@@ -1,0 +1,478 @@
+"""Op-surface completion: complex/cumulative/search/manipulation families
+(ref:python/paddle/tensor/{math,manipulation,search,attribute}.py entries
+absent from the core modules). Each op is one pure jnp function through the
+eager dispatch cache — one XLA HLO sequence, fusable under jit.
+"""
+from __future__ import annotations
+
+import builtins
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import apply
+from ..core.dtype import convert_dtype_arg
+from ..core.tensor import Tensor
+
+__all__ = [
+    "complex", "polar", "is_complex", "is_floating_point", "is_integer",
+    "is_tensor", "is_empty", "sgn", "logit", "frexp", "logcumsumexp",
+    "trapezoid", "cumulative_trapezoid", "kthvalue", "mode", "nanmedian",
+    "nanquantile", "take", "index_add", "index_add_", "crop", "diagonal",
+    "reverse", "slice", "strided_slice", "vsplit", "hsplit", "dsplit",
+    "tensordot", "vander", "renorm", "scatter_", "squeeze_", "unsqueeze_",
+    "tanh_", "finfo", "iinfo", "rank", "tolist", "set_printoptions",
+    "check_shape", "logaddexp",
+]
+
+_builtin_complex = complex  # shadowed below
+
+
+# ------------------------------------------------------------- complex
+
+
+def complex(real, imag, name=None):
+    """Construct a complex tensor (ref:python/paddle/tensor/creation.py)."""
+
+    def _complex(r, i):
+        return jax.lax.complex(r, i)
+
+    return apply(_complex, (real, imag), {})
+
+
+def polar(abs, angle, name=None):
+    def _polar(a, t):
+        return jax.lax.complex(a * jnp.cos(t), a * jnp.sin(t))
+
+    return apply(_polar, (abs, angle), {})
+
+
+def is_complex(x):
+    d = x._data.dtype if isinstance(x, Tensor) else np.asarray(x).dtype
+    return jnp.issubdtype(d, jnp.complexfloating)
+
+
+def is_floating_point(x):
+    d = x._data.dtype if isinstance(x, Tensor) else np.asarray(x).dtype
+    return jnp.issubdtype(d, jnp.floating)
+
+
+def is_integer(x):
+    d = x._data.dtype if isinstance(x, Tensor) else np.asarray(x).dtype
+    return jnp.issubdtype(d, jnp.integer)
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def is_empty(x, name=None):
+    return Tensor(jnp.asarray(int(np.prod(x.shape)) == 0))
+
+
+def sgn(x, name=None):
+    """sign for real, unit phasor (x/|x|) for complex."""
+
+    def _sgn(x):
+        if jnp.issubdtype(x.dtype, jnp.complexfloating):
+            mag = jnp.abs(x)
+            return jnp.where(mag == 0, 0.0 + 0.0j, x / jnp.where(mag == 0, 1.0, mag))
+        return jnp.sign(x)
+
+    return apply(_sgn, (x,), {})
+
+
+# ------------------------------------------------------- math extras
+
+
+def logit(x, eps=None, name=None):
+    def _logit(x, *, eps):
+        if eps is not None:
+            x = jnp.clip(x, eps, 1.0 - eps)
+        return jnp.log(x / (1.0 - x))
+
+    return apply(_logit, (x,), dict(eps=eps))
+
+
+def frexp(x, name=None):
+    def _frexp(x):
+        m, e = jnp.frexp(x)
+        return m, e.astype(x.dtype)
+
+    return apply(_frexp, (x,), {}, differentiable=False)
+
+
+def logaddexp(x, y, name=None):
+    def _logaddexp(x, y):
+        return jnp.logaddexp(x, y)
+
+    return apply(_logaddexp, (x, y), {})
+
+
+def logcumsumexp(x, axis=None, dtype=None, name=None):
+    def _lce(x, *, axis, dtype):
+        if dtype is not None:
+            x = x.astype(dtype)
+        if axis is None:
+            x = x.reshape(-1)
+            axis = 0
+        return jax.lax.cumlogsumexp(x, axis=axis)
+
+    return apply(_lce, (x,), dict(axis=axis, dtype=convert_dtype_arg(dtype)))
+
+
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    if x is not None:
+        def _trap(y, x, *, axis):
+            return jnp.trapezoid(y, x=x, axis=axis)
+
+        return apply(_trap, (y, x), dict(axis=axis))
+
+    def _trapd(y, *, dx, axis):
+        return jnp.trapezoid(y, dx=1.0 if dx is None else dx, axis=axis)
+
+    return apply(_trapd, (y,), dict(dx=dx, axis=axis))
+
+
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    def _mov(a, axis):
+        sl1 = [builtins.slice(None)] * a.ndim
+        sl2 = [builtins.slice(None)] * a.ndim
+        sl1[axis] = builtins.slice(1, None)
+        sl2[axis] = builtins.slice(None, -1)
+        return a[tuple(sl1)], a[tuple(sl2)]
+
+    if x is not None:
+        def _ct(y, x, *, axis):
+            y1, y0 = _mov(y, axis)
+            x1, x0 = _mov(x, axis) if x.ndim == y.ndim else (x[1:], x[:-1])
+            d = x1 - x0
+            if d.ndim != y1.ndim:
+                shape = [1] * y1.ndim
+                shape[axis] = d.shape[0]
+                d = d.reshape(shape)
+            return jnp.cumsum(d * (y1 + y0) / 2.0, axis=axis)
+
+        return apply(_ct, (y, x), dict(axis=axis))
+
+    def _ctd(y, *, dx, axis):
+        y1, y0 = _mov(y, axis)
+        return jnp.cumsum((1.0 if dx is None else dx) * (y1 + y0) / 2.0, axis=axis)
+
+    return apply(_ctd, (y,), dict(dx=dx, axis=axis))
+
+
+# ------------------------------------------------------------ search
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    def _kth(x, *, k, axis, keepdim):
+        vals = jnp.sort(x, axis=axis)
+        idxs = jnp.argsort(x, axis=axis)
+        v = jnp.take(vals, k - 1, axis=axis)
+        i = jnp.take(idxs, k - 1, axis=axis).astype(jnp.int64)
+        if keepdim:
+            v = jnp.expand_dims(v, axis)
+            i = jnp.expand_dims(i, axis)
+        return v, i
+
+    return apply(_kth, (x,), dict(k=int(k), axis=axis, keepdim=bool(keepdim)),
+                 differentiable=False)
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    def _mode(x, *, axis, keepdim):
+        sorted_x = jnp.sort(x, axis=axis)
+        n = x.shape[axis]
+        sx = jnp.moveaxis(sorted_x, axis, -1)
+        same = jnp.concatenate(
+            [jnp.ones(sx.shape[:-1] + (1,), jnp.int32),
+             (sx[..., 1:] == sx[..., :-1]).astype(jnp.int32)], axis=-1)
+        # run lengths via cumulative reset-scan
+        def scan_fn(carry, cur):
+            run = jnp.where(cur == 1, carry + 1, 1)
+            return run, run
+        _, runs = jax.lax.scan(scan_fn,
+                               jnp.zeros(sx.shape[:-1], jnp.int32),
+                               jnp.moveaxis(same, -1, 0))
+        runs = jnp.moveaxis(runs, 0, -1)
+        best = jnp.argmax(runs, axis=-1)
+        vals = jnp.take_along_axis(sx, best[..., None], axis=-1)[..., 0]
+        # paddle returns the LAST index equal to the mode value
+        xm = jnp.moveaxis(x, axis, -1)
+        eq = xm == vals[..., None]
+        idx = jnp.where(eq, jnp.arange(n), -1).max(axis=-1).astype(jnp.int64)
+        if keepdim:
+            vals = jnp.expand_dims(vals, -1)
+            idx = jnp.expand_dims(idx, -1)
+            vals = jnp.moveaxis(vals, -1, axis)
+            idx = jnp.moveaxis(idx, -1, axis)
+        return vals, idx
+
+    return apply(_mode, (x,), dict(axis=axis, keepdim=bool(keepdim)),
+                 differentiable=False)
+
+
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    def _nm(x, *, axis, keepdim):
+        return jnp.nanmedian(x, axis=axis, keepdims=keepdim)
+
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return apply(_nm, (x,), dict(axis=ax, keepdim=bool(keepdim)))
+
+
+def nanquantile(x, q, axis=None, keepdim=False, name=None):
+    def _nq(x, *, q, axis, keepdim):
+        return jnp.nanquantile(x, jnp.asarray(q), axis=axis, keepdims=keepdim)
+
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    qs = tuple(q) if isinstance(q, (list, tuple)) else float(q)
+    return apply(_nq, (x,), dict(q=qs, axis=ax, keepdim=bool(keepdim)))
+
+
+def take(x, index, mode="raise", name=None):
+    if mode not in ("raise", "wrap", "clip"):
+        raise ValueError(f"take mode must be raise/wrap/clip, got {mode!r}")
+
+    def _take(x, idx, *, mode):
+        flat = x.reshape(-1)
+        n = flat.shape[0]
+        i = idx.reshape(-1)
+        if mode == "wrap":
+            i = ((i % n) + n) % n
+        elif mode == "clip":
+            i = jnp.clip(i, 0, n - 1)
+        else:  # raise-mode bounds aren't checkable inside jit; clamp negatives paddle-style
+            i = jnp.where(i < 0, i + n, i)
+        return flat[i].reshape(idx.shape)
+
+    return apply(_take, (x, index), dict(mode=mode))
+
+
+# ------------------------------------------------------- manipulation
+
+
+def index_add(x, index, axis, value, name=None):
+    def _ia(x, idx, v, *, axis):
+        return x.at[(builtins.slice(None),) * axis + (idx,)].add(v)
+
+    return apply(_ia, (x, index, value), dict(axis=int(axis)))
+
+
+def index_add_(x, index, axis, value, name=None):
+    from ..core.dispatch import run_inplace
+
+    return run_inplace(index_add, x, index, axis, value)
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    shape = [int(s) for s in (shape or x.shape)]
+    offsets = [int(o) for o in (offsets or [0] * len(shape))]
+    shape = [xs if s == -1 else s for s, xs in zip(shape, x.shape)]
+
+    def _crop(x, *, shape, offsets):
+        return jax.lax.dynamic_slice(x, offsets, shape)
+
+    return apply(_crop, (x,), dict(shape=tuple(shape), offsets=tuple(offsets)))
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    def _diag(x, *, offset, axis1, axis2):
+        return jnp.diagonal(x, offset=offset, axis1=axis1, axis2=axis2)
+
+    return apply(_diag, (x,), dict(offset=int(offset), axis1=int(axis1), axis2=int(axis2)))
+
+
+def reverse(x, axis, name=None):
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else (axis,)
+
+    def _rev(x, *, axis):
+        return jnp.flip(x, axis=axis)
+
+    return apply(_rev, (x,), dict(axis=ax))
+
+
+def slice(input, axes, starts, ends, name=None):
+    """Static slice op (ref:python/paddle/fluid/layers slice)."""
+    sls = [builtins.slice(None)] * len(input.shape)
+    for ax, st, en in zip(axes, starts, ends):
+        dim = input.shape[ax]
+        st, en = int(st), int(en)
+        if st < 0:
+            st += dim
+        if en < 0:
+            en += dim
+        sls[ax] = builtins.slice(max(0, st), min(dim, en))
+
+    def _slice(x, *, key):
+        return x[key]
+
+    return apply(_slice, (input,), dict(key=tuple(sls)))
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    sls = [builtins.slice(None)] * len(x.shape)
+    for ax, st, en, sd in zip(axes, starts, ends, strides):
+        sls[ax] = builtins.slice(int(st), int(en), int(sd))
+
+    def _ss(x, *, key):
+        return x[key]
+
+    return apply(_ss, (x,), dict(key=tuple(sls)))
+
+
+def _nsplit(x, num_or_sections, axis):
+    from .manipulation import split as _split
+
+    return _split(x, num_or_sections, axis=axis)
+
+
+def vsplit(x, num_or_sections, name=None):
+    if len(x.shape) < 2:
+        raise ValueError("vsplit expects ndim >= 2")
+    return _nsplit(x, num_or_sections, 0)
+
+
+def hsplit(x, num_or_sections, name=None):
+    if len(x.shape) < 1:
+        raise ValueError("hsplit expects ndim >= 1")
+    return _nsplit(x, num_or_sections, 1 if len(x.shape) > 1 else 0)
+
+
+def dsplit(x, num_or_sections, name=None):
+    if len(x.shape) < 3:
+        raise ValueError("dsplit expects ndim >= 3")
+    return _nsplit(x, num_or_sections, 2)
+
+
+def tensordot(x, y, axes=2, name=None):
+    if isinstance(axes, (list, tuple)):
+        a = tuple(axes[0]) if isinstance(axes[0], (list, tuple)) else (axes[0],)
+        b = tuple(axes[1]) if len(axes) > 1 and isinstance(axes[1], (list, tuple)) else \
+            (axes[1],) if len(axes) > 1 else a
+        ax = (a, b)
+    else:
+        ax = int(axes)
+
+    def _td(x, y, *, ax):
+        return jnp.tensordot(x, y, axes=ax)
+
+    return apply(_td, (x, y), dict(ax=ax))
+
+
+def vander(x, n=None, increasing=False, name=None):
+    def _vander(x, *, n, increasing):
+        return jnp.vander(x, N=n, increasing=increasing)
+
+    return apply(_vander, (x,), dict(n=n, increasing=bool(increasing)))
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    def _renorm(x, *, p, axis, max_norm):
+        axes = tuple(i for i in range(x.ndim) if i != axis)
+        norms = jnp.sum(jnp.abs(x) ** p, axis=axes, keepdims=True) ** (1.0 / p)
+        factor = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+        return x * factor
+
+    return apply(_renorm, (x,), dict(p=float(p), axis=int(axis), max_norm=float(max_norm)))
+
+
+# ------------------------------------------------------------ inplace
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    from ..core.dispatch import run_inplace
+    from .manipulation import scatter
+
+    return run_inplace(scatter, x, index, updates, overwrite=overwrite)
+
+
+def squeeze_(x, axis=None, name=None):
+    from ..core.dispatch import run_inplace
+    from .manipulation import squeeze
+
+    return run_inplace(squeeze, x, axis)
+
+
+def unsqueeze_(x, axis, name=None):
+    from ..core.dispatch import run_inplace
+    from .manipulation import unsqueeze
+
+    return run_inplace(unsqueeze, x, axis)
+
+
+def tanh_(x, name=None):
+    from ..core.dispatch import run_inplace
+    from .math import tanh
+
+    return run_inplace(tanh, x)
+
+
+# --------------------------------------------------------- meta/attrs
+
+
+class finfo:
+    """paddle.finfo (numpy-compatible float type info)."""
+
+    def __init__(self, dtype):
+        fi = jnp.finfo(jnp.dtype(convert_dtype_arg(dtype)))
+        self.dtype = str(fi.dtype)
+        self.bits = fi.bits
+        self.eps = float(fi.eps)
+        self.min = float(fi.min)
+        self.max = float(fi.max)
+        self.tiny = float(fi.tiny)
+        self.smallest_normal = float(fi.tiny)
+        self.resolution = float(fi.resolution)
+
+
+class iinfo:
+    """paddle.iinfo (numpy-compatible int type info)."""
+
+    def __init__(self, dtype):
+        ii = jnp.iinfo(jnp.dtype(convert_dtype_arg(dtype)))
+        self.dtype = str(ii.dtype)
+        self.bits = ii.bits
+        self.min = int(ii.min)
+        self.max = int(ii.max)
+
+
+def rank(input, name=None):
+    return Tensor(jnp.asarray(len(input.shape), jnp.int32))
+
+
+def tolist(x):
+    return np.asarray(x._data if isinstance(x, Tensor) else x).tolist()
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    np.set_printoptions(**kw)
+
+
+def check_shape(x, expected):
+    """Debug helper (paddle.check_shape): assert static shape equality."""
+    if list(x.shape) != list(expected):
+        raise ValueError(f"shape mismatch: {x.shape} != {list(expected)}")
+    return True
+
+
+for _m in ("diagonal", "tensordot", "kthvalue", "mode", "nanmedian",
+           "nanquantile", "take", "index_add", "index_add_", "logit",
+           "frexp", "logcumsumexp", "trapezoid", "cumulative_trapezoid",
+           "sgn", "vsplit", "hsplit", "dsplit", "vander", "renorm",
+           "scatter_", "squeeze_", "unsqueeze_", "tanh_", "tolist",
+           "is_complex", "is_floating_point", "is_integer", "is_empty"):
+    Tensor._register_method(_m, globals()[_m])

@@ -1,0 +1,313 @@
+"""Linear algebra ops (ref:python/paddle/tensor/linalg.py surface).
+
+Matmuls are the MXU path: keep them batched, let XLA tile; bf16 inputs hit
+the systolic array natively.
+"""
+from __future__ import annotations
+
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+
+_this = sys.modules[__name__]
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    def _matmul(x, y, *, tx, ty):
+        if tx:
+            x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
+        if ty:
+            y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
+        return jnp.matmul(x, y)
+
+    return apply(_matmul, (x, y), dict(tx=bool(transpose_x), ty=bool(transpose_y)))
+
+
+def mm(input, mat2, name=None):
+    return matmul(input, mat2)
+
+
+def bmm(x, y, name=None):
+    return matmul(x, y)
+
+
+def mv(x, vec, name=None):
+    return matmul(x, vec)
+
+
+def dot(x, y, name=None):
+    def _dot(x, y):
+        return jnp.sum(x * y, axis=-1)
+
+    return apply(_dot, (x, y), {})
+
+
+def dist(x, y, p=2, name=None):
+    def _dist(x, y, *, p):
+        d = (x - y).reshape(-1)
+        if p == float("inf"):
+            return jnp.max(jnp.abs(d))
+        if p == float("-inf"):
+            return jnp.min(jnp.abs(d))
+        if p == 0:
+            return jnp.sum(d != 0).astype(x.dtype)
+        return jnp.sum(jnp.abs(d) ** p) ** (1.0 / p)
+
+    return apply(_dist, (x, y), dict(p=float(p)))
+
+
+def norm(x, p="fro", axis=None, keepdim=False, name=None):
+    def _norm(x, *, p, axis, keepdim):
+        if p == "fro" or (p == 2 and axis is None):
+            return jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=keepdim))
+        if p == float("inf"):
+            return jnp.max(jnp.abs(x), axis=axis, keepdims=keepdim)
+        if p == float("-inf"):
+            return jnp.min(jnp.abs(x), axis=axis, keepdims=keepdim)
+        if p == 0:
+            return jnp.sum((x != 0).astype(x.dtype), axis=axis, keepdims=keepdim)
+        if p == 1:
+            return jnp.sum(jnp.abs(x), axis=axis, keepdims=keepdim)
+        return jnp.sum(jnp.abs(x) ** p, axis=axis, keepdims=keepdim) ** (1.0 / p)
+
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(int(a) for a in axis)
+    return apply(_norm, (x,), dict(p=p if isinstance(p, str) else float(p), axis=axis, keepdim=bool(keepdim)))
+
+
+def cond(x, p=None, name=None):
+    def _cond(x, *, p):
+        return jnp.linalg.cond(x, p=p)
+
+    return apply(_cond, (x,), dict(p=p))
+
+
+def cholesky(x, upper=False, name=None):
+    def _cholesky(x, *, upper):
+        L = jnp.linalg.cholesky(x)
+        return jnp.swapaxes(L, -1, -2) if upper else L
+
+    return apply(_cholesky, (x,), dict(upper=bool(upper)))
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    def _cholesky_solve(b, L, *, upper):
+        return jax.scipy.linalg.cho_solve((L, not upper), b)
+
+    return apply(_cholesky_solve, (x, y), dict(upper=bool(upper)))
+
+
+def qr(x, mode="reduced", name=None):
+    def _qr(x, *, mode):
+        return tuple(jnp.linalg.qr(x, mode=mode))
+
+    return apply(_qr, (x,), dict(mode=mode))
+
+
+def svd(x, full_matrices=False, name=None):
+    def _svd(x, *, full_matrices):
+        u, s, vh = jnp.linalg.svd(x, full_matrices=full_matrices)
+        return u, s, jnp.swapaxes(vh, -1, -2).conj()  # paddle returns V, not V^H
+
+    return apply(_svd, (x,), dict(full_matrices=bool(full_matrices)))
+
+
+def inverse(x, name=None):
+    def _inverse(x):
+        return jnp.linalg.inv(x)
+
+    return apply(_inverse, (x,), {})
+
+
+inv = inverse
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    def _pinv(x, *, rcond, hermitian):
+        return jnp.linalg.pinv(x, rtol=rcond, hermitian=hermitian)
+
+    return apply(_pinv, (x,), dict(rcond=float(rcond), hermitian=bool(hermitian)))
+
+
+def solve(x, y, name=None):
+    def _solve(x, y):
+        return jnp.linalg.solve(x, y)
+
+    return apply(_solve, (x, y), {})
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
+    def _triangular_solve(a, b, *, upper, transpose, unit):
+        return jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0, unit_diagonal=unit
+        )
+
+    return apply(_triangular_solve, (x, y), dict(upper=bool(upper), transpose=bool(transpose), unit=bool(unitriangular)))
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    def _lstsq(a, b, *, rcond):
+        sol, res, rank, sv = jnp.linalg.lstsq(a, b, rcond=rcond)
+        return sol, res, rank, sv
+
+    return apply(_lstsq, (x, y), dict(rcond=rcond))
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    def _lu(x):
+        lu_, piv = jax.scipy.linalg.lu_factor(x)
+        return lu_, piv.astype(jnp.int32) + 1  # paddle pivots are 1-based
+
+    out = apply(_lu, (x,), {})
+    if get_infos:
+        from .creation import zeros
+
+        return out[0], out[1], zeros([1], dtype="int32")
+    return out
+
+
+def matrix_power(x, n, name=None):
+    def _matrix_power(x, *, n):
+        return jnp.linalg.matrix_power(x, n)
+
+    return apply(_matrix_power, (x,), dict(n=int(n)))
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    def _matrix_rank(x, *, tol, hermitian):
+        return jnp.linalg.matrix_rank(x, rtol=tol)
+
+    return apply(_matrix_rank, (x,), dict(tol=tol, hermitian=bool(hermitian)), differentiable=False)
+
+
+def det(x, name=None):
+    def _det(x):
+        return jnp.linalg.det(x)
+
+    return apply(_det, (x,), {})
+
+
+def slogdet(x, name=None):
+    def _slogdet(x):
+        s, l = jnp.linalg.slogdet(x)
+        return jnp.stack([s, l], axis=0) if s.ndim == 0 else jnp.stack([s, l], axis=0)
+
+    return apply(_slogdet, (x,), {})
+
+
+def eig(x, name=None):
+    # XLA:TPU lacks nonsymmetric eig; host-evaluated like the reference's
+    # CPU-only eig kernel (ref:paddle/phi/kernels/cpu/eig_kernel.cc).
+    w, v = np.linalg.eig(np.asarray(x._data))
+    return Tensor(jnp.asarray(w)), Tensor(jnp.asarray(v))
+
+
+def eigh(x, UPLO="L", name=None):
+    def _eigh(x, *, uplo):
+        return tuple(jnp.linalg.eigh(x, symmetrize_input=True))
+
+    return apply(_eigh, (x,), dict(uplo=UPLO))
+
+
+def eigvals(x, name=None):
+    w = np.linalg.eigvals(np.asarray(x._data))
+    return Tensor(jnp.asarray(w))
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    def _eigvalsh(x):
+        return jnp.linalg.eigvalsh(x)
+
+    return apply(_eigvalsh, (x,), {})
+
+
+def multi_dot(x, name=None):
+    def _multi_dot(*xs):
+        return jnp.linalg.multi_dot(xs)
+
+    return apply(_multi_dot, tuple(x), {})
+
+
+def einsum(equation, *operands):
+    def _einsum(*xs, eq):
+        return jnp.einsum(eq, *xs, precision=jax.lax.Precision.HIGHEST)
+
+    if len(operands) == 1 and isinstance(operands[0], (list, tuple)):
+        operands = tuple(operands[0])
+    return apply(_einsum, tuple(operands), dict(eq=equation))
+
+
+def histogram(input, bins=100, min=0, max=0, name=None):
+    arr = np.asarray(input._data)
+    lo, hi = (min, max) if (min != 0 or max != 0) else (arr.min(), arr.max())
+    h, _ = np.histogram(arr, bins=bins, range=(lo, hi))
+    return Tensor(jnp.asarray(h.astype(np.int64)))
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    arr = np.asarray(x._data)
+    w = np.asarray(weights._data) if weights is not None else None
+    return Tensor(jnp.asarray(np.bincount(arr, weights=w, minlength=minlength)))
+
+
+def corrcoef(x, rowvar=True, name=None):
+    def _corrcoef(x, *, rowvar):
+        return jnp.corrcoef(x, rowvar=rowvar)
+
+    return apply(_corrcoef, (x,), dict(rowvar=bool(rowvar)))
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    def _cov(x, *, rowvar, ddof):
+        return jnp.cov(x, rowvar=rowvar, ddof=1 if ddof else 0)
+
+    return apply(_cov, (x,), dict(rowvar=bool(rowvar), ddof=bool(ddof)))
+
+
+for _m in ("matmul", "mm", "bmm", "mv", "dot", "norm", "dist", "cholesky", "inverse", "det"):
+    Tensor._register_method(_m, getattr(_this, _m))
+Tensor.__matmul__ = lambda self, other: matmul(self, other)
+Tensor.__rmatmul__ = lambda self, other: matmul(other, self)
+
+
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    """Unpack LU factorization (ref:python/paddle/tensor/linalg.py lu_unpack):
+    x = packed LU from ``lu``, y = 1-based pivots. Returns (P, L, U)."""
+    import numpy as _np
+
+    def _unpack(lu_, piv):
+        m, n = lu_.shape[-2], lu_.shape[-1]
+        k = min(m, n)
+        L = jnp.tril(lu_[..., :, :k], -1) + jnp.eye(m, k, dtype=lu_.dtype)
+        U = jnp.triu(lu_[..., :k, :])
+        # pivots -> permutation matrix: apply row swaps to identity
+        def perm_of(p):
+            def body(i, perm):
+                j = p[i] - 1
+                pi, pj = perm[i], perm[j]
+                perm = perm.at[i].set(pj)
+                return perm.at[j].set(pi)
+            return jax.lax.fori_loop(0, p.shape[0], body, jnp.arange(m))
+        if piv.ndim == 1:
+            perm = perm_of(piv)
+            P = jnp.zeros((m, m), lu_.dtype).at[perm, jnp.arange(m)].set(1.0)
+        else:
+            batch = piv.reshape((-1, piv.shape[-1]))
+            perms = jax.vmap(perm_of)(batch)
+            eye = jnp.zeros((perms.shape[0], m, m), lu_.dtype)
+            bi = jnp.arange(perms.shape[0])[:, None]
+            P = eye.at[bi, perms, jnp.arange(m)[None, :]].set(1.0)
+            P = P.reshape(lu_.shape[:-2] + (m, m))
+        return P, L, U
+
+    return apply(_unpack, (x, y), {})
+
+
+def inv(x, name=None):
+    """Alias of inverse (paddle.linalg.inv)."""
+    return inverse(x, name=name)

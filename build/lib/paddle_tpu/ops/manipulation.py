@@ -1,0 +1,529 @@
+"""Shape/layout manipulation ops (ref:python/paddle/tensor/manipulation.py surface)."""
+from __future__ import annotations
+
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import apply
+from ..core.dtype import convert_dtype_arg
+from ..core.tensor import Tensor
+
+_this = sys.modules[__name__]
+
+
+def _ints(v):
+    if isinstance(v, Tensor):
+        v = v.tolist()
+    if isinstance(v, (int, np.integer)):
+        return int(v)
+    return tuple(int(i) for i in v)
+
+
+def cast(x, dtype):
+    def _cast(x, *, dtype):
+        return x.astype(dtype)
+
+    return apply(_cast, (x,), dict(dtype=convert_dtype_arg(dtype)))
+
+
+def reshape(x, shape, name=None):
+    def _reshape(x, *, shape):
+        return jnp.reshape(x, shape)
+
+    return apply(_reshape, (x,), dict(shape=_ints(shape)))
+
+
+def reshape_(x, shape, name=None):
+    x._data = jnp.reshape(x._data, _ints(shape))
+    return x
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    def _flatten(x, *, start_axis, stop_axis):
+        nd = x.ndim
+        sa = start_axis % nd if nd else 0
+        so = stop_axis % nd if nd else 0
+        new_shape = x.shape[:sa] + (-1,) + x.shape[so + 1 :]
+        return jnp.reshape(x, new_shape)
+
+    return apply(_flatten, (x,), dict(start_axis=start_axis, stop_axis=stop_axis))
+
+
+def transpose(x, perm=None, name=None):
+    def _transpose(x, *, perm):
+        return jnp.transpose(x, perm)
+
+    return apply(_transpose, (x,), dict(perm=_ints(perm) if perm is not None else None))
+
+
+def moveaxis(x, source, destination, name=None):
+    def _moveaxis(x, *, source, destination):
+        return jnp.moveaxis(x, source, destination)
+
+    return apply(_moveaxis, (x,), dict(source=_ints(source), destination=_ints(destination)))
+
+
+def swapaxes(x, axis1, axis2, name=None):
+    def _swapaxes(x, *, axis1, axis2):
+        return jnp.swapaxes(x, axis1, axis2)
+
+    return apply(_swapaxes, (x,), dict(axis1=axis1, axis2=axis2))
+
+
+def t(x, name=None):
+    def _t(x):
+        return x.T
+
+    return apply(_t, (x,), {})
+
+
+def concat(x, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+
+    def _concat(*xs, axis):
+        return jnp.concatenate(xs, axis=axis)
+
+    return apply(_concat, tuple(x), dict(axis=int(axis)))
+
+
+def stack(x, axis=0, name=None):
+    def _stack(*xs, axis):
+        return jnp.stack(xs, axis=axis)
+
+    return apply(_stack, tuple(x), dict(axis=int(axis)))
+
+
+def unstack(x, axis=0, num=None, name=None):
+    n = num or x.shape[axis]
+
+    def _unstack(x, *, axis, n):
+        return tuple(jnp.moveaxis(x, axis, 0)[i] for i in range(n))
+
+    return list(apply(_unstack, (x,), dict(axis=axis, n=n)))
+
+
+def unbind(input, axis=0):
+    return unstack(input, axis)
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    axis = int(axis)
+    dim = x.shape[axis]
+    if isinstance(num_or_sections, int):
+        if dim % num_or_sections != 0:
+            raise ValueError(f"split: axis dim {dim} not divisible by num {num_or_sections}")
+        sections = [dim // num_or_sections] * num_or_sections
+    else:
+        sections = [int(s.item()) if isinstance(s, Tensor) else int(s) for s in num_or_sections]
+        neg = [i for i, s in enumerate(sections) if s < 0]
+        if neg:
+            sections[neg[0]] = dim - sum(s for s in sections if s >= 0)
+    offsets = np.cumsum([0] + sections).tolist()
+
+    def _split(x, *, axis, offsets):
+        return tuple(jax.lax.slice_in_dim(x, offsets[i], offsets[i + 1], axis=axis) for i in range(len(offsets) - 1))
+
+    return list(apply(_split, (x,), dict(axis=axis, offsets=tuple(offsets))))
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def squeeze(x, axis=None, name=None):
+    def _squeeze(x, *, axis):
+        if axis is None:
+            return jnp.squeeze(x)
+        axes = (axis,) if isinstance(axis, int) else axis
+        axes = tuple(a % x.ndim for a in axes)
+        axes = tuple(a for a in axes if x.shape[a] == 1)
+        return jnp.squeeze(x, axis=axes) if axes else x
+
+    return apply(_squeeze, (x,), dict(axis=_ints(axis) if axis is not None else None))
+
+
+def unsqueeze(x, axis, name=None):
+    def _unsqueeze(x, *, axis):
+        axes = (axis,) if isinstance(axis, int) else axis
+        out = x
+        for a in sorted(axes):
+            out = jnp.expand_dims(out, a)
+        return out
+
+    return apply(_unsqueeze, (x,), dict(axis=_ints(axis)))
+
+
+def expand(x, shape, name=None):
+    def _expand(x, *, shape):
+        tgt = list(shape)
+        src = (1,) * (len(tgt) - x.ndim) + tuple(x.shape)
+        for i, s in enumerate(tgt):
+            if s == -1:
+                tgt[i] = src[i]
+        return jnp.broadcast_to(x.reshape(src), tuple(tgt))
+
+    return apply(_expand, (x,), dict(shape=_ints(shape)))
+
+
+def broadcast_to(x, shape, name=None):
+    return expand(x, shape)
+
+
+def expand_as(x, y, name=None):
+    return expand(x, y.shape)
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def broadcast_tensors(inputs, name=None):
+    def _bt(*xs):
+        return tuple(jnp.broadcast_arrays(*xs))
+
+    return list(apply(_bt, tuple(inputs), {}))
+
+
+def tile(x, repeat_times, name=None):
+    def _tile(x, *, reps):
+        return jnp.tile(x, reps)
+
+    return apply(_tile, (x,), dict(reps=_ints(repeat_times)))
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    def _ri(x, *, repeats, axis):
+        return jnp.repeat(x, repeats, axis=axis)
+
+    if isinstance(repeats, Tensor):
+        def _ri_t(x, r, *, axis, total):
+            return jnp.repeat(x, r, axis=axis, total_repeat_length=total)
+
+        total = int(np.sum(np.asarray(repeats._data)))
+        return apply(_ri_t, (x, repeats), dict(axis=axis, total=total))
+    return apply(_ri, (x,), dict(repeats=int(repeats), axis=axis))
+
+
+def flip(x, axis, name=None):
+    def _flip(x, *, axis):
+        return jnp.flip(x, axis=axis)
+
+    return apply(_flip, (x,), dict(axis=_ints(axis)))
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    def _rot90(x, *, k, axes):
+        return jnp.rot90(x, k=k, axes=axes)
+
+    return apply(_rot90, (x,), dict(k=k, axes=tuple(axes)))
+
+
+def roll(x, shifts, axis=None, name=None):
+    def _roll(x, *, shifts, axis):
+        return jnp.roll(x, shifts, axis=axis)
+
+    return apply(_roll, (x,), dict(shifts=_ints(shifts), axis=_ints(axis) if axis is not None else None))
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=False)
+
+    def _where(c, x, y):
+        return jnp.where(c, x, y)
+
+    if not isinstance(x, Tensor):
+        x = Tensor(jnp.asarray(x))
+    if not isinstance(y, Tensor):
+        y = Tensor(jnp.asarray(y))
+    return apply(_where, (condition, x, y), {})
+
+
+def nonzero(x, as_tuple=False):
+    # dynamic output shape: eager-only (host round-trip), like the reference's
+    # CPU-synced nonzero (ref:paddle/phi/kernels/gpu/nonzero_kernel.cu d2h copy).
+    arr = np.asarray(x._data)
+    idx = np.nonzero(arr)
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(i)) for i in idx)
+    return Tensor(jnp.asarray(np.stack(idx, axis=1).astype(np.int64)))
+
+
+def masked_select(x, mask, name=None):
+    arr = np.asarray(x._data)
+    m = np.asarray(mask._data)
+    return Tensor(jnp.asarray(arr[m]))
+
+
+def masked_fill(x, mask, value, name=None):
+    def _masked_fill(x, mask, value):
+        return jnp.where(mask, value, x)
+
+    if not isinstance(value, Tensor):
+        value = Tensor(jnp.asarray(value, dtype=x.dtype))
+    return apply(_masked_fill, (x, mask, value), {})
+
+
+def gather(x, index, axis=0, name=None):
+    def _gather(x, idx, *, axis):
+        return jnp.take(x, idx.astype(jnp.int32), axis=axis)
+
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    return apply(_gather, (x, index), dict(axis=int(axis)))
+
+
+def gather_nd(x, index, name=None):
+    def _gather_nd(x, idx):
+        idx_shape = idx.shape
+        k = idx_shape[-1]
+        flat = idx.reshape(-1, k)
+        out = x[tuple(flat[:, i] for i in range(k))]
+        return out.reshape(idx_shape[:-1] + x.shape[k:])
+
+    return apply(_gather_nd, (x, index), {})
+
+
+def take_along_axis(arr, indices, axis, broadcast=True):
+    def _taa(x, idx, *, axis):
+        return jnp.take_along_axis(x, idx, axis=axis)
+
+    return apply(_taa, (arr, indices), dict(axis=int(axis)))
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", include_self=True, broadcast=True):
+    def _paa(x, idx, v, *, axis, mode):
+        v = jnp.broadcast_to(v, idx.shape).astype(x.dtype)
+        if mode == "assign":
+            return jnp.put_along_axis(x, idx, v, axis=axis, inplace=False)
+        dims = [i for i in range(x.ndim)]
+        # scatter-add/mul via segment ops on flattened representation
+        upd = jnp.zeros_like(x)
+        upd = jnp.put_along_axis(upd, idx, v, axis=axis, inplace=False)
+        if mode == "add":
+            return x + upd
+        if mode == "mul":
+            mask = jnp.put_along_axis(jnp.zeros_like(x, dtype=bool), idx, True, axis=axis, inplace=False)
+            return jnp.where(mask, x * v if v.shape == x.shape else x * upd, x)
+        raise ValueError(mode)
+
+    if not isinstance(values, Tensor):
+        values = Tensor(jnp.asarray(values))
+    return apply(_paa, (arr, indices, values), dict(axis=int(axis), mode=reduce))
+
+
+def index_select(x, index, axis=0, name=None):
+    return gather(x, index, axis)
+
+
+def index_sample(x, index):
+    def _index_sample(x, idx):
+        return jnp.take_along_axis(x, idx.astype(jnp.int32), axis=1)
+
+    return apply(_index_sample, (x, index), {})
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    def _scatter(x, idx, upd, *, overwrite):
+        idx = idx.reshape(-1).astype(jnp.int32)
+        if overwrite:
+            return x.at[idx].set(upd)
+        return x.at[idx].add(upd)
+
+    return apply(_scatter, (x, index, updates), dict(overwrite=bool(overwrite)))
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    def _scatter_nd_add(x, idx, upd):
+        k = idx.shape[-1]
+        flat = idx.reshape(-1, k)
+        upd_flat = upd.reshape((flat.shape[0],) + x.shape[k:])
+        return x.at[tuple(flat[:, i] for i in range(k))].add(upd_flat)
+
+    return apply(_scatter_nd_add, (x, index, updates), {})
+
+
+def scatter_nd(index, updates, shape, name=None):
+    from .creation import zeros
+
+    return scatter_nd_add(zeros(shape, dtype=updates.dtype), index, updates)
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None, dtype="int64", name=None):
+    arr = np.asarray(x._data)
+    res = np.unique(arr, return_index=return_index, return_inverse=return_inverse, return_counts=return_counts, axis=axis)
+    if not isinstance(res, tuple):
+        return Tensor(jnp.asarray(res))
+    return tuple(Tensor(jnp.asarray(r)) for r in res)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None, dtype="int64", name=None):
+    arr = np.asarray(x._data)
+    if axis is not None:
+        raise NotImplementedError
+    flat = arr.flatten()
+    if flat.size == 0:
+        out = (Tensor(jnp.asarray(flat)),)
+    else:
+        keep = np.concatenate([[True], flat[1:] != flat[:-1]])
+        vals = flat[keep]
+        out = (Tensor(jnp.asarray(vals)),)
+        if return_inverse:
+            inv = np.cumsum(keep) - 1
+            out += (Tensor(jnp.asarray(inv)),)
+        if return_counts:
+            idx = np.nonzero(keep)[0]
+            counts = np.diff(np.concatenate([idx, [flat.size]]))
+            out += (Tensor(jnp.asarray(counts)),)
+    return out if len(out) > 1 else out[0]
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    pad = _ints(pad)
+
+    def _pad(x, *, pad, mode, value, data_format):
+        nd = x.ndim
+        if len(pad) == 2 * nd:
+            width = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+        else:
+            # paddle semantics: pad applies to spatial dims of NCHW/NHWC etc.
+            width = [(0, 0)] * nd
+            spatial = list(range(nd))
+            if data_format in ("NCHW", "NCL", "NCDHW"):
+                spatial = list(range(2, nd))
+            elif data_format in ("NHWC", "NLC", "NDHWC"):
+                spatial = list(range(1, nd - 1))
+            k = len(pad) // 2
+            for j in range(k):
+                width[spatial[-(j + 1)]] = (pad[2 * (k - 1 - j)], pad[2 * (k - 1 - j) + 1])
+        if mode == "constant":
+            return jnp.pad(x, width, constant_values=value)
+        jmode = {"reflect": "reflect", "replicate": "edge", "circular": "wrap"}[mode]
+        return jnp.pad(x, width, mode=jmode)
+
+    return apply(_pad, (x,), dict(pad=pad, mode=mode, value=float(value), data_format=data_format))
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    def _argmax(x, *, axis, keepdim):
+        out = jnp.argmax(x, axis=axis)
+        if keepdim and axis is not None:
+            out = jnp.expand_dims(out, axis)
+        return out.astype(jnp.int64)
+
+    return apply(_argmax, (x,), dict(axis=axis, keepdim=bool(keepdim)), differentiable=False)
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    def _argmin(x, *, axis, keepdim):
+        out = jnp.argmin(x, axis=axis)
+        if keepdim and axis is not None:
+            out = jnp.expand_dims(out, axis)
+        return out.astype(jnp.int64)
+
+    return apply(_argmin, (x,), dict(axis=axis, keepdim=bool(keepdim)), differentiable=False)
+
+
+def argsort(x, axis=-1, descending=False, name=None):
+    def _argsort(x, *, axis, descending):
+        out = jnp.argsort(-x if descending else x, axis=axis)
+        return out.astype(jnp.int64)
+
+    return apply(_argsort, (x,), dict(axis=axis, descending=bool(descending)), differentiable=False)
+
+
+def sort(x, axis=-1, descending=False, name=None):
+    def _sort(x, *, axis, descending):
+        out = jnp.sort(x, axis=axis)
+        return jnp.flip(out, axis=axis) if descending else out
+
+    return apply(_sort, (x,), dict(axis=axis, descending=bool(descending)))
+
+
+def topk(x, k, axis=None, largest=True, sorted=True, name=None):
+    if isinstance(k, Tensor):
+        k = int(k.item())
+
+    def _topk(x, *, k, axis, largest):
+        ax = axis if axis is not None else x.ndim - 1
+        xm = jnp.moveaxis(x, ax, -1)
+        if largest:
+            vals, idx = jax.lax.top_k(xm, k)
+        else:
+            vals, idx = jax.lax.top_k(-xm, k)
+            vals = -vals
+        return jnp.moveaxis(vals, -1, ax), jnp.moveaxis(idx.astype(jnp.int64), -1, ax)
+
+    return apply(_topk, (x,), dict(k=int(k), axis=axis, largest=bool(largest)))
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    def _searchsorted(s, v, *, side):
+        return jnp.searchsorted(s, v, side=side)
+
+    return apply(_searchsorted, (sorted_sequence, values), dict(side="right" if right else "left"), differentiable=False)
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32, right)
+
+
+def one_hot(x, num_classes, name=None):
+    def _one_hot(x, *, n):
+        return jax.nn.one_hot(x.astype(jnp.int32), n, dtype=jnp.float32)
+
+    return apply(_one_hot, (x,), dict(n=int(num_classes)), differentiable=False)
+
+
+def numel(x, name=None):
+    return Tensor(jnp.asarray(x.size, dtype=jnp.int64))
+
+
+def shape(x):
+    return Tensor(jnp.asarray(x._data.shape, dtype=jnp.int32))
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    def _shard_index(x, *, index_num, nshards, shard_id, ignore_value):
+        size = index_num // nshards
+        lo, hi = shard_id * size, (shard_id + 1) * size
+        ok = (x >= lo) & (x < hi)
+        return jnp.where(ok, x - lo, ignore_value)
+
+    return apply(
+        _shard_index,
+        (input,),
+        dict(index_num=index_num, nshards=nshards, shard_id=shard_id, ignore_value=ignore_value),
+        differentiable=False,
+    )
+
+
+def as_complex(x, name=None):
+    def _as_complex(x):
+        return jax.lax.complex(x[..., 0], x[..., 1])
+
+    return apply(_as_complex, (x,), {})
+
+
+def as_real(x, name=None):
+    def _as_real(x):
+        return jnp.stack([jnp.real(x), jnp.imag(x)], axis=-1)
+
+    return apply(_as_real, (x,), {})
+
+
+_METHODS = [
+    "cast", "reshape", "reshape_", "flatten", "transpose", "t", "squeeze", "unsqueeze",
+    "expand", "expand_as", "broadcast_to", "tile", "flip", "roll", "gather", "gather_nd",
+    "scatter", "scatter_nd_add", "argmax", "argmin", "argsort", "sort", "topk", "split",
+    "chunk", "unbind", "numel", "nonzero", "masked_select", "masked_fill", "index_select",
+    "take_along_axis", "put_along_axis", "unique", "where", "moveaxis", "repeat_interleave",
+]
+for _m in _METHODS:
+    Tensor._register_method(_m, getattr(_this, _m))

@@ -1,0 +1,470 @@
+"""Math ops (paddle.tensor.math / logic / reduce surface).
+
+Covers the elementwise/reduction portion of the reference's op library
+(ref:paddle/phi/kernels/, ref:python/paddle/tensor/math.py, logic.py).
+Each op is a pure jax function dispatched through core.dispatch.apply —
+XLA fuses elementwise chains, so there is no need for the reference's
+fused elementwise kernels.
+"""
+from __future__ import annotations
+
+import sys
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import apply
+from ..core.dtype import convert_dtype_arg
+from ..core.tensor import Tensor
+
+_this = sys.modules[__name__]
+
+
+# ---------------------------------------------------------------- unary ops
+_UNARY = {
+    "abs": jnp.abs,
+    "exp": jnp.exp,
+    "expm1": jnp.expm1,
+    "log": jnp.log,
+    "log2": jnp.log2,
+    "log10": jnp.log10,
+    "log1p": jnp.log1p,
+    "sqrt": jnp.sqrt,
+    "rsqrt": lambda x: jax.lax.rsqrt(x),
+    "square": jnp.square,
+    "sin": jnp.sin,
+    "cos": jnp.cos,
+    "tan": jnp.tan,
+    "asin": jnp.arcsin,
+    "acos": jnp.arccos,
+    "atan": jnp.arctan,
+    "sinh": jnp.sinh,
+    "cosh": jnp.cosh,
+    "tanh": jnp.tanh,
+    "asinh": jnp.arcsinh,
+    "acosh": jnp.arccosh,
+    "atanh": jnp.arctanh,
+    "floor": jnp.floor,
+    "ceil": jnp.ceil,
+    "round": jnp.round,
+    "trunc": jnp.trunc,
+    "sign": jnp.sign,
+    "reciprocal": jnp.reciprocal,
+    "erf": jax.scipy.special.erf,
+    "erfinv": jax.scipy.special.erfinv,
+    "digamma": jax.scipy.special.digamma,
+    "lgamma": jax.scipy.special.gammaln,
+    "sigmoid": jax.nn.sigmoid,
+    "neg": jnp.negative,
+    "conj": jnp.conj,
+    "angle": jnp.angle,
+    "real": jnp.real,
+    "imag": jnp.imag,
+    "frac": lambda x: x - jnp.trunc(x),
+    "i0": lambda x: jax.scipy.special.i0(x),
+    "i1": lambda x: jax.scipy.special.i1(x),
+}
+
+_NONDIFF_UNARY = {
+    "isnan": jnp.isnan,
+    "isinf": jnp.isinf,
+    "isfinite": jnp.isfinite,
+    "logical_not": jnp.logical_not,
+    "bitwise_not": jnp.invert,
+}
+
+
+def _def_unary(name, f, differentiable=True):
+    def op(x, name=None, _f=f, _n=name, _d=differentiable):
+        return apply(_f, (x,), {}, differentiable=_d, name=_n)
+
+    op.__name__ = name
+    setattr(_this, name, op)
+    Tensor._register_method(name, op)
+    return op
+
+
+for _n, _f in _UNARY.items():
+    _def_unary(_n, _f)
+for _n, _f in _NONDIFF_UNARY.items():
+    _def_unary(_n, _f, differentiable=False)
+
+
+# --------------------------------------------------------------- binary ops
+_BINARY = {
+    "add": jnp.add,
+    "subtract": jnp.subtract,
+    "multiply": jnp.multiply,
+    "divide": jnp.divide,
+    "pow": jnp.power,
+    "maximum": jnp.maximum,
+    "minimum": jnp.minimum,
+    "fmax": jnp.fmax,
+    "fmin": jnp.fmin,
+    "remainder": jnp.remainder,
+    "mod": jnp.remainder,
+    "floor_mod": jnp.remainder,
+    "floor_divide": jnp.floor_divide,
+    "atan2": jnp.arctan2,
+    "hypot": jnp.hypot,
+    "heaviside": jnp.heaviside,
+    "nextafter": jnp.nextafter,
+    "copysign": jnp.copysign,
+    "gcd": jnp.gcd,
+    "lcm": jnp.lcm,
+    "ldexp": jnp.ldexp,
+    "logaddexp": jnp.logaddexp,
+    "inner": jnp.inner,
+    "outer": jnp.outer,
+    "kron": jnp.kron,
+    "cross": jnp.cross,
+}
+
+_NONDIFF_BINARY = {
+    "equal": jnp.equal,
+    "not_equal": jnp.not_equal,
+    "less_than": jnp.less,
+    "less_equal": jnp.less_equal,
+    "greater_than": jnp.greater,
+    "greater_equal": jnp.greater_equal,
+    "logical_and": jnp.logical_and,
+    "logical_or": jnp.logical_or,
+    "logical_xor": jnp.logical_xor,
+    "bitwise_and": jnp.bitwise_and,
+    "bitwise_or": jnp.bitwise_or,
+    "bitwise_xor": jnp.bitwise_xor,
+    "isclose": jnp.isclose,
+}
+
+
+def _def_binary(name, f, differentiable=True):
+    def op(x, y, name=None, _f=f, _n=name, _d=differentiable):
+        return apply(_f, (x, y), {}, differentiable=_d, name=_n)
+
+    op.__name__ = name
+    setattr(_this, name, op)
+    Tensor._register_method(name, op)
+    return op
+
+
+for _n, _f in _BINARY.items():
+    _def_binary(_n, _f)
+for _n, _f in _NONDIFF_BINARY.items():
+    _def_binary(_n, _f, differentiable=False)
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    def _allclose(x, y, *, rtol, atol, equal_nan):
+        return jnp.allclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+    return apply(_allclose, (x, y), dict(rtol=rtol, atol=atol, equal_nan=equal_nan), differentiable=False)
+
+
+def equal_all(x, y, name=None):
+    def _equal_all(x, y):
+        return jnp.array_equal(x, y)
+
+    return apply(_equal_all, (x, y), {}, differentiable=False)
+
+
+# ------------------------------------------------------------- reductions
+def _axis_arg(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        axis = axis.tolist()
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def _def_reduce(name, f, differentiable=True):
+    def _fn(x, *, axis, keepdim):
+        return f(x, axis=axis, keepdims=keepdim)
+
+    _fn.__name__ = "_" + name
+
+    def op(x, axis=None, keepdim=False, name=None, _fn=_fn, _n=name, _d=differentiable):
+        return apply(_fn, (x,), dict(axis=_axis_arg(axis), keepdim=bool(keepdim)), differentiable=_d, name=_n)
+
+    op.__name__ = name
+    setattr(_this, name, op)
+    Tensor._register_method(name, op)
+    return op
+
+
+for _n, _f, _d in [
+    ("sum", jnp.sum, True),
+    ("mean", jnp.mean, True),
+    ("prod", jnp.prod, True),
+    ("max", jnp.max, True),
+    ("min", jnp.min, True),
+    ("amax", jnp.amax, True),
+    ("amin", jnp.amin, True),
+    ("all", jnp.all, False),
+    ("any", jnp.any, False),
+    ("nansum", jnp.nansum, True),
+    ("nanmean", jnp.nanmean, True),
+]:
+    _def_reduce(_n, _f, _d)
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    def _logsumexp(x, *, axis, keepdim):
+        return jax.scipy.special.logsumexp(x, axis=axis, keepdims=keepdim)
+
+    return apply(_logsumexp, (x,), dict(axis=_axis_arg(axis), keepdim=bool(keepdim)))
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    def _std(x, *, axis, ddof, keepdim):
+        return jnp.std(x, axis=axis, ddof=ddof, keepdims=keepdim)
+
+    return apply(_std, (x,), dict(axis=_axis_arg(axis), ddof=1 if unbiased else 0, keepdim=bool(keepdim)))
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    def _var(x, *, axis, ddof, keepdim):
+        return jnp.var(x, axis=axis, ddof=ddof, keepdims=keepdim)
+
+    return apply(_var, (x,), dict(axis=_axis_arg(axis), ddof=1 if unbiased else 0, keepdim=bool(keepdim)))
+
+
+def median(x, axis=None, keepdim=False, name=None):
+    def _median(x, *, axis, keepdim):
+        return jnp.median(x, axis=axis, keepdims=keepdim)
+
+    return apply(_median, (x,), dict(axis=_axis_arg(axis), keepdim=bool(keepdim)))
+
+
+def quantile(x, q, axis=None, keepdim=False, name=None):
+    def _quantile(x, q, *, axis, keepdim):
+        return jnp.quantile(x, q, axis=axis, keepdims=keepdim)
+
+    return apply(_quantile, (x, q), dict(axis=_axis_arg(axis), keepdim=bool(keepdim)))
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    def _count_nonzero(x, *, axis, keepdim):
+        return jnp.count_nonzero(x, axis=axis, keepdims=keepdim)
+
+    return apply(_count_nonzero, (x,), dict(axis=_axis_arg(axis), keepdim=bool(keepdim)), differentiable=False)
+
+
+# ------------------------------------------------------------- scans / misc
+def cumsum(x, axis=None, dtype=None, name=None):
+    def _cumsum(x, *, axis, dtype):
+        return jnp.cumsum(x, axis=axis, dtype=dtype)
+
+    return apply(_cumsum, (x,), dict(axis=axis, dtype=convert_dtype_arg(dtype)))
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    def _cumprod(x, *, axis, dtype):
+        return jnp.cumprod(x, axis=axis, dtype=dtype)
+
+    return apply(_cumprod, (x,), dict(axis=dim, dtype=convert_dtype_arg(dtype)))
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    def _cummax(x, *, axis, idx_dtype):
+        if axis is None:
+            x = x.reshape(-1)
+            axis = 0
+        n = x.shape[axis]
+        iota = jax.lax.broadcasted_iota(jnp.int32, x.shape, axis)
+
+        def combine(a, b):
+            av, ai = a
+            bv, bi = b
+            take_b = bv >= av  # paddle keeps the later index on ties
+            return jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai)
+
+        vals, idx = jax.lax.associative_scan(combine, (x, iota), axis=axis)
+        return vals, idx.astype(idx_dtype)
+
+    return apply(_cummax, (x,), dict(axis=axis, idx_dtype=convert_dtype_arg(dtype)))
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    def _cummin(x, *, axis, idx_dtype):
+        if axis is None:
+            x = x.reshape(-1)
+            axis = 0
+        iota = jax.lax.broadcasted_iota(jnp.int32, x.shape, axis)
+
+        def combine(a, b):
+            av, ai = a
+            bv, bi = b
+            take_b = bv <= av
+            return jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai)
+
+        vals, idx = jax.lax.associative_scan(combine, (x, iota), axis=axis)
+        return vals, idx.astype(idx_dtype)
+
+    return apply(_cummin, (x,), dict(axis=axis, idx_dtype=convert_dtype_arg(dtype)))
+
+
+def clip(x, min=None, max=None, name=None):
+    def _clip(x, *, lo, hi):
+        return jnp.clip(x, lo, hi)
+
+    lo = float(min) if min is not None and not isinstance(min, Tensor) else min
+    hi = float(max) if max is not None and not isinstance(max, Tensor) else max
+    if isinstance(lo, Tensor) or isinstance(hi, Tensor):
+        def _clip_t(x, lo, hi):
+            return jnp.clip(x, lo, hi)
+
+        import jax.numpy as _j
+
+        lo_t = lo if isinstance(lo, Tensor) else Tensor(_j.asarray(-_j.inf if lo is None else lo))
+        hi_t = hi if isinstance(hi, Tensor) else Tensor(_j.asarray(_j.inf if hi is None else hi))
+        return apply(_clip_t, (x, lo_t, hi_t), {})
+    return apply(_clip, (x,), dict(lo=lo, hi=hi))
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    def _scale(x, *, s, b, after):
+        return x * s + b if after else (x + b) * s
+
+    return apply(_scale, (x,), dict(s=float(scale), b=float(bias), after=bool(bias_after_scale)))
+
+
+def increment(x, value=1.0, name=None):
+    x._data = x._data + value
+    return x
+
+
+def add_n(inputs, name=None):
+    def _add_n(*xs):
+        out = xs[0]
+        for x in xs[1:]:
+            out = out + x
+        return out
+
+    if isinstance(inputs, Tensor):
+        return inputs
+    return apply(_add_n, tuple(inputs), {})
+
+
+def assign(x, output=None, name=None):
+    def _assign(x):
+        return x + 0  # force a copy through XLA
+
+    out = apply(_assign, (x,) if isinstance(x, Tensor) else (Tensor(jnp.asarray(x)),), {})
+    if output is not None:
+        from ..core.dispatch import replace_value
+
+        return replace_value(output, out)
+    return out
+
+
+def lerp(x, y, weight, name=None):
+    def _lerp(x, y, w):
+        return x + w * (y - x)
+
+    if not isinstance(weight, Tensor):
+        weight = Tensor(jnp.asarray(weight, dtype=x.dtype))
+    return apply(_lerp, (x, y, weight), {})
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    def _addmm(i, x, y, *, beta, alpha):
+        return beta * i + alpha * (x @ y)
+
+    return apply(_addmm, (input, x, y), dict(beta=float(beta), alpha=float(alpha)))
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    def _trace(x, *, offset, axis1, axis2):
+        return jnp.trace(x, offset=offset, axis1=axis1, axis2=axis2)
+
+    return apply(_trace, (x,), dict(offset=offset, axis1=axis1, axis2=axis2))
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    from .manipulation import concat
+
+    parts = []
+    if prepend is not None:
+        parts.append(prepend if isinstance(prepend, Tensor) else Tensor(jnp.asarray(prepend)))
+    parts.append(x)
+    if append is not None:
+        parts.append(append if isinstance(append, Tensor) else Tensor(jnp.asarray(append)))
+    if len(parts) > 1:
+        x = concat(parts, axis=axis)
+
+    def _diff(x, *, n, axis):
+        return jnp.diff(x, n=n, axis=axis)
+
+    return apply(_diff, (x,), dict(n=n, axis=axis))
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    def _nan_to_num(x, *, nan, posinf, neginf):
+        return jnp.nan_to_num(x, nan=nan, posinf=posinf, neginf=neginf)
+
+    return apply(_nan_to_num, (x,), dict(nan=nan, posinf=posinf, neginf=neginf))
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    def _stanh(x, *, a, b):
+        return b * jnp.tanh(a * x)
+
+    return apply(_stanh, (x,), dict(a=scale_a, b=scale_b))
+
+
+def rad2deg(x, name=None):
+    return apply(jnp.rad2deg, (x,), {})
+
+
+def deg2rad(x, name=None):
+    return apply(jnp.deg2rad, (x,), {})
+
+
+def multiplex(inputs, index, name=None):
+    def _multiplex(idx, *xs):
+        stacked = jnp.stack(xs, axis=0)  # [n, batch, ...]
+        return jnp.take_along_axis(
+            stacked, idx.reshape(1, -1, *([1] * (stacked.ndim - 2))).astype(jnp.int32), axis=0
+        )[0]
+
+    return apply(_multiplex, (index, *inputs), {})
+
+
+# dunder operators --------------------------------------------------------
+def _scalar_or_tensor_op(opname, reverse=False):
+    base = getattr(_this, opname)
+
+    def dunder(self, other):
+        if reverse:
+            return base(other if isinstance(other, Tensor) else Tensor(jnp.asarray(other, dtype=self.dtype)), self)
+        return base(self, other)
+
+    return dunder
+
+
+Tensor.__add__ = _scalar_or_tensor_op("add")
+Tensor.__radd__ = _scalar_or_tensor_op("add", reverse=True)
+Tensor.__sub__ = _scalar_or_tensor_op("subtract")
+Tensor.__rsub__ = _scalar_or_tensor_op("subtract", reverse=True)
+Tensor.__mul__ = _scalar_or_tensor_op("multiply")
+Tensor.__rmul__ = _scalar_or_tensor_op("multiply", reverse=True)
+Tensor.__truediv__ = _scalar_or_tensor_op("divide")
+Tensor.__rtruediv__ = _scalar_or_tensor_op("divide", reverse=True)
+Tensor.__pow__ = _scalar_or_tensor_op("pow")
+Tensor.__rpow__ = _scalar_or_tensor_op("pow", reverse=True)
+Tensor.__mod__ = _scalar_or_tensor_op("mod")
+Tensor.__floordiv__ = _scalar_or_tensor_op("floor_divide")
+Tensor.__neg__ = lambda self: neg(self)  # noqa: F821
+Tensor.__abs__ = lambda self: abs(self)  # noqa: F821
+Tensor.__eq__ = lambda self, o: equal(self, o)  # noqa: F821
+Tensor.__ne__ = lambda self, o: not_equal(self, o)  # noqa: F821
+Tensor.__lt__ = lambda self, o: less_than(self, o)  # noqa: F821
+Tensor.__le__ = lambda self, o: less_equal(self, o)  # noqa: F821
+Tensor.__gt__ = lambda self, o: greater_than(self, o)  # noqa: F821
+Tensor.__ge__ = lambda self, o: greater_equal(self, o)  # noqa: F821
+Tensor.__invert__ = lambda self: logical_not(self)  # noqa: F821
+Tensor.__and__ = lambda self, o: (logical_and if self.dtype == jnp.bool_ else bitwise_and)(self, o)  # noqa: F821
+Tensor.__or__ = lambda self, o: (logical_or if self.dtype == jnp.bool_ else bitwise_or)(self, o)  # noqa: F821
+Tensor.__xor__ = lambda self, o: (logical_xor if self.dtype == jnp.bool_ else bitwise_xor)(self, o)  # noqa: F821

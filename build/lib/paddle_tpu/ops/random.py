@@ -1,0 +1,168 @@
+"""Random ops (ref:python/paddle/tensor/random.py surface), threefry-backed."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core import rng
+from ..core.dispatch import apply
+from ..core.dtype import convert_dtype_arg, get_default_dtype, is_floating
+from ..core.tensor import Tensor
+from .creation import _shape_arg
+
+seed = rng.seed
+get_rng_state = rng.get_rng_state
+set_rng_state = rng.set_rng_state
+
+
+def _key_tensor():
+    return Tensor(rng.next_key())
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    dtype = convert_dtype_arg(dtype) or get_default_dtype()
+
+    def _uniform(key, *, shape, dtype, lo, hi):
+        return jax.random.uniform(key, shape, dtype=dtype, minval=lo, maxval=hi)
+
+    return apply(
+        _uniform,
+        (_key_tensor(),),
+        dict(shape=_shape_arg(shape), dtype=dtype, lo=float(min), hi=float(max)),
+        differentiable=False,
+    )
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
+    x._data = uniform(x.shape, x.dtype, min, max)._data
+    x._node = None  # random fill: previous producer is no longer relevant
+    x._version += 1  # pre-fill consumers must not backward through this
+    return x
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        def _normal_t(key, mean, std):
+            return mean + std * jax.random.normal(key, jnp.broadcast_shapes(jnp.shape(mean), jnp.shape(std)))
+
+        m = mean if isinstance(mean, Tensor) else Tensor(jnp.asarray(mean, jnp.float32))
+        s = std if isinstance(std, Tensor) else Tensor(jnp.asarray(std, jnp.float32))
+        return apply(_normal_t, (_key_tensor(), m, s), {}, differentiable=False)
+
+    def _normal(key, *, shape, mean, std):
+        return mean + std * jax.random.normal(key, shape, dtype=get_default_dtype())
+
+    return apply(
+        _normal,
+        (_key_tensor(),),
+        dict(shape=_shape_arg(shape or [1]), mean=float(mean), std=float(std)),
+        differentiable=False,
+    )
+
+
+def gaussian(shape, mean=0.0, std=1.0, dtype=None, name=None):
+    dtype = convert_dtype_arg(dtype) or get_default_dtype()
+
+    def _gaussian(key, *, shape, mean, std, dtype):
+        return (mean + std * jax.random.normal(key, shape)).astype(dtype)
+
+    return apply(
+        _gaussian,
+        (_key_tensor(),),
+        dict(shape=_shape_arg(shape), mean=float(mean), std=float(std), dtype=dtype),
+        differentiable=False,
+    )
+
+
+def standard_normal(shape, dtype=None, name=None):
+    return gaussian(shape, 0.0, 1.0, dtype)
+
+
+def randn(shape, dtype=None, name=None):
+    return standard_normal(shape, dtype)
+
+
+def rand(shape, dtype=None, name=None):
+    return uniform(shape, dtype, 0.0, 1.0)
+
+
+def randint(low=0, high=None, shape=(1,), dtype=None, name=None):
+    if high is None:
+        low, high = 0, low
+    dtype = convert_dtype_arg(dtype) or jnp.int64
+
+    def _randint(key, *, shape, lo, hi, dtype):
+        return jax.random.randint(key, shape, lo, hi, dtype=dtype)
+
+    return apply(
+        _randint,
+        (_key_tensor(),),
+        dict(shape=_shape_arg(shape), lo=int(low), hi=int(high), dtype=dtype),
+        differentiable=False,
+    )
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    return randint(low, high, x.shape, dtype or x.dtype)
+
+
+def randperm(n, dtype="int64", name=None):
+    def _randperm(key, *, n, dtype):
+        return jax.random.permutation(key, n).astype(dtype)
+
+    return apply(_randperm, (_key_tensor(),), dict(n=int(n), dtype=convert_dtype_arg(dtype)), differentiable=False)
+
+
+def shuffle(x, axis=0):
+    def _shuffle(key, x, *, axis):
+        return jax.random.permutation(key, x, axis=axis, independent=False)
+
+    return apply(_shuffle, (_key_tensor(), x), dict(axis=int(axis)), differentiable=False)
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    def _multinomial(key, p, *, n, replacement):
+        logits = jnp.log(jnp.maximum(p, 1e-30))
+        if replacement:
+            return jax.random.categorical(key, logits, axis=-1, shape=(n,) if p.ndim == 1 else (n, p.shape[0])).T
+        # without replacement: Gumbel top-k trick
+        g = jax.random.gumbel(key, p.shape)
+        _, idx = jax.lax.top_k(logits + g, n)
+        return idx
+
+    out = apply(_multinomial, (_key_tensor(), x), dict(n=int(num_samples), replacement=bool(replacement)), differentiable=False)
+    from .manipulation import cast
+
+    return cast(out, "int64")
+
+
+def bernoulli(x, name=None):
+    def _bernoulli(key, p):
+        return jax.random.bernoulli(key, p).astype(p.dtype)
+
+    return apply(_bernoulli, (_key_tensor(), x), {}, differentiable=False)
+
+
+def poisson(x, name=None):
+    def _poisson(key, lam):
+        return jax.random.poisson(key, lam).astype(lam.dtype)
+
+    return apply(_poisson, (_key_tensor(), x), {}, differentiable=False)
+
+
+def exponential_(x, lam=1.0, name=None):
+    def _exponential(key, *, shape, lam, dtype):
+        return (jax.random.exponential(key, shape) / lam).astype(dtype)
+
+    x._data = apply(
+        _exponential, (_key_tensor(),), dict(shape=tuple(x.shape), lam=float(lam), dtype=x._data.dtype), differentiable=False
+    )._data
+    return x
+
+
+def rand_like(x, dtype=None, name=None):
+    return uniform(x.shape, dtype or x.dtype, 0.0, 1.0)
+
+
+def normal_like(x, mean=0.0, std=1.0, name=None):
+    return gaussian(x.shape, mean, std, x.dtype)

@@ -1,0 +1,389 @@
+"""Optimizers (ref:python/paddle/optimizer/optimizer.py).
+
+Dual execution modes, same update math:
+  * eager: ``opt.step()`` reads ``param.grad`` and applies a per-parameter
+    jitted update (the fused-optimizer-kernel equivalent — XLA fuses the
+    whole update into one kernel per parameter).
+  * functional: ``opt.apply_gradients(params, grads, state)`` is pure over
+    pytrees — this is what jit.TrainStep / pjit shard; optimizer state
+    sharding (ZeRO) falls out of pjit partitioning the state pytree.
+"""
+from __future__ import annotations
+
+import functools
+from collections import OrderedDict
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from .lr import LRScheduler
+
+
+class Optimizer:
+    _state_names: List[str] = []  # per-param slot names, e.g. ["moment1", "moment2"]
+    _needs_step_count = False
+
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None, grad_clip=None, name=None, multi_precision=False):
+        if parameters is not None:
+            parameters = list(parameters)
+        self._parameter_list = parameters
+        self._learning_rate = learning_rate
+        self._weight_decay = 0.0 if weight_decay is None else weight_decay
+        self._grad_clip = grad_clip
+        self._accumulators: Dict[int, Dict[str, jax.Array]] = {}
+        self._step_count = 0
+
+    # ------------------------------------------------------------ LR access
+    def get_lr(self) -> float:
+        if isinstance(self._learning_rate, LRScheduler):
+            return float(self._learning_rate())
+        return float(self._learning_rate)
+
+    def set_lr(self, value):
+        self._learning_rate = value
+
+    # ----------------------------------------------------- pure update math
+    def _init_slot(self, param: jax.Array) -> Dict[str, jax.Array]:
+        return {name: jnp.zeros_like(param) for name in self._state_names}
+
+    def _update(self, param, grad, slots, lr, step):
+        """Pure: (param, grad, slots, lr, step) -> (new_param, new_slots)."""
+        raise NotImplementedError
+
+    # --------------------------------------------------------- eager path
+    def step(self):
+        if self._parameter_list is None:
+            raise ValueError("optimizer created without a parameter list")
+        self._step_count += 1
+        lr = self.get_lr()
+        params = [p for p in self._parameter_list if p.grad is not None and not p.stop_gradient]
+        if not params:
+            return
+        grads = [p.grad._data for p in params]
+        if self._grad_clip is not None:
+            grads = self._grad_clip._clip_arrays(grads)
+        step = jnp.asarray(self._step_count, jnp.int32)
+        for p, g in zip(params, grads):
+            slots = self._accumulators.get(id(p))
+            if slots is None:
+                slots = self._init_slot(p._data)
+                self._accumulators[id(p)] = slots
+            new_p, new_slots = _jit_update(type(self), self._hyper_key())(
+                p._data, g.astype(p._data.dtype) if g.dtype != p._data.dtype else g, slots, jnp.asarray(lr, jnp.float32), step
+            )
+            p._data = new_p
+            self._accumulators[id(p)] = new_slots
+
+    minimize = None  # set below
+
+    def clear_grad(self, set_to_zero=False):
+        if self._parameter_list is not None:
+            for p in self._parameter_list:
+                p.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def _hyper_key(self):
+        """Hashable hyperparameters closed over by the jitted update."""
+        return (float(self._weight_decay) if not callable(self._weight_decay) else 0.0,)
+
+    # ------------------------------------------------------ functional path
+    def init_state(self, params: Dict[str, Tensor]):
+        """Pytree of optimizer state for the functional/pjit path."""
+        state = {}
+        for name, p in params.items():
+            arr = p._data if isinstance(p, Tensor) else p
+            state[name] = self._init_slot(arr)
+        return {"slots": state, "step": jnp.zeros((), jnp.int32)}
+
+    def apply_gradients(self, params, grads, state, lr=None):
+        """Pure pytree update: returns (new_params, new_state). jit/pjit-safe."""
+        lr_v = jnp.asarray(self.get_lr() if lr is None else lr, jnp.float32)
+        step = state["step"] + 1
+        if self._grad_clip is not None:
+            flat, treedef = jax.tree_util.tree_flatten(grads)
+            flat = self._grad_clip._clip_arrays([g._data if isinstance(g, Tensor) else g for g in flat])
+            grads = jax.tree_util.tree_unflatten(treedef, flat)
+        new_params, new_slots = {}, {}
+        for name in params:
+            p = params[name]
+            arr = p._data if isinstance(p, Tensor) else p
+            g = grads[name]
+            garr = g._data if isinstance(g, Tensor) else g
+            if getattr(p, "stop_gradient", False) or garr is None:
+                new_params[name], new_slots[name] = p, state["slots"][name]
+                continue
+            np_, ns_ = self._update(arr, garr.astype(arr.dtype), state["slots"][name], lr_v, step)
+            new_params[name] = Tensor(np_, stop_gradient=False) if isinstance(p, Tensor) else np_
+            new_slots[name] = ns_
+        return new_params, {"slots": new_slots, "step": step}
+
+    # ---------------------------------------------------------- checkpoint
+    def state_dict(self):
+        sd = {"step": self._step_count}
+        if self._parameter_list is not None:
+            for i, p in enumerate(self._parameter_list):
+                slots = self._accumulators.get(id(p))
+                if slots:
+                    for k, v in slots.items():
+                        sd[f"{p.name or i}.{k}"] = Tensor(v)
+        if isinstance(self._learning_rate, LRScheduler):
+            sd["LR_Scheduler"] = self._learning_rate.state_dict()
+        return sd
+
+    def set_state_dict(self, state_dict):
+        self._step_count = int(state_dict.get("step", 0))
+        if isinstance(self._learning_rate, LRScheduler) and "LR_Scheduler" in state_dict:
+            self._learning_rate.set_state_dict(state_dict["LR_Scheduler"])
+        if self._parameter_list is not None:
+            for i, p in enumerate(self._parameter_list):
+                slots = {}
+                for name in self._state_names:
+                    key = f"{p.name or i}.{name}"
+                    if key in state_dict:
+                        v = state_dict[key]
+                        slots[name] = v._data if isinstance(v, Tensor) else jnp.asarray(v)
+                if slots:
+                    self._accumulators[id(p)] = slots
+
+    set_dict = set_state_dict
+
+
+def _minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+    loss.backward()
+    self.step()
+    return None, None
+
+
+Optimizer.minimize = _minimize
+
+
+@functools.lru_cache(maxsize=256)
+def _jit_update(cls, hyper_key):
+    opt = cls.__new__(cls)
+    Optimizer.__init__(opt, learning_rate=0.0)
+    opt._hyper = hyper_key
+    opt._weight_decay = hyper_key[0] if hyper_key else 0.0
+    for attr, val in zip(cls._hyper_names, hyper_key[1:] if cls._hyper_names else ()):
+        setattr(opt, attr, val)
+
+    @jax.jit
+    def upd(param, grad, slots, lr, step):
+        return opt._update(param, grad, slots, lr, step)
+
+    return upd
+
+
+class SGD(Optimizer):
+    _state_names: List[str] = []
+    _hyper_names: List[str] = []
+
+    def _update(self, param, grad, slots, lr, step):
+        if self._weight_decay:
+            grad = grad + self._weight_decay * param
+        return (param - lr.astype(param.dtype) * grad).astype(param.dtype), slots
+
+
+class Momentum(Optimizer):
+    _state_names = ["velocity"]
+    _hyper_names = ["_momentum", "_use_nesterov"]
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None, use_nesterov=False, weight_decay=None, grad_clip=None, name=None, multi_precision=False):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+
+    def _hyper_key(self):
+        return (float(self._weight_decay or 0.0), float(self._momentum), bool(self._use_nesterov))
+
+    def _update(self, param, grad, slots, lr, step):
+        if self._weight_decay:
+            grad = grad + self._weight_decay * param
+        v = self._momentum * slots["velocity"] + grad
+        if self._use_nesterov:
+            new_p = param - lr.astype(param.dtype) * (grad + self._momentum * v)
+        else:
+            new_p = param - lr.astype(param.dtype) * v
+        return new_p.astype(param.dtype), {"velocity": v}
+
+
+class Adam(Optimizer):
+    _state_names = ["moment1", "moment2"]
+    _hyper_names = ["_beta1", "_beta2", "_epsilon"]
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, parameters=None,
+                 weight_decay=None, grad_clip=None, lazy_mode=False, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _hyper_key(self):
+        return (float(self._weight_decay or 0.0), float(self._beta1), float(self._beta2), float(self._epsilon))
+
+    def _update(self, param, grad, slots, lr, step):
+        f32 = jnp.float32
+        g = grad.astype(f32)
+        if self._weight_decay:
+            g = g + self._weight_decay * param.astype(f32)
+        m = self._beta1 * slots["moment1"] + (1 - self._beta1) * g
+        v = self._beta2 * slots["moment2"] + (1 - self._beta2) * jnp.square(g)
+        t = step.astype(f32)
+        m_hat = m / (1 - self._beta1**t)
+        v_hat = v / (1 - self._beta2**t)
+        new_p = param.astype(f32) - lr * m_hat / (jnp.sqrt(v_hat) + self._epsilon)
+        return new_p.astype(param.dtype), {"moment1": m, "moment2": v}
+
+    def _init_slot(self, param):
+        return {name: jnp.zeros(param.shape, jnp.float32) for name in self._state_names}
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (ref:python/paddle/optimizer/adamw.py)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, parameters=None,
+                 weight_decay=0.01, lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters, None, grad_clip, name=name)
+        self._weight_decay = weight_decay
+        self._apply_decay_param_fun = apply_decay_param_fun
+
+    def _update(self, param, grad, slots, lr, step):
+        f32 = jnp.float32
+        g = grad.astype(f32)
+        m = self._beta1 * slots["moment1"] + (1 - self._beta1) * g
+        v = self._beta2 * slots["moment2"] + (1 - self._beta2) * jnp.square(g)
+        t = step.astype(f32)
+        m_hat = m / (1 - self._beta1**t)
+        v_hat = v / (1 - self._beta2**t)
+        p32 = param.astype(f32)
+        new_p = p32 - lr * (m_hat / (jnp.sqrt(v_hat) + self._epsilon) + self._weight_decay * p32)
+        return new_p.astype(param.dtype), {"moment1": m, "moment2": v}
+
+
+class Adagrad(Optimizer):
+    _state_names = ["moment"]
+    _hyper_names = ["_epsilon", "_initial_accumulator_value"]
+
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None, weight_decay=None, grad_clip=None,
+                 initial_accumulator_value=0.0, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._epsilon = epsilon
+        self._initial_accumulator_value = initial_accumulator_value
+
+    def _hyper_key(self):
+        return (float(self._weight_decay or 0.0), float(self._epsilon), float(self._initial_accumulator_value))
+
+    def _init_slot(self, param):
+        return {"moment": jnp.full(param.shape, self._initial_accumulator_value, jnp.float32)}
+
+    def _update(self, param, grad, slots, lr, step):
+        g = grad.astype(jnp.float32)
+        if self._weight_decay:
+            g = g + self._weight_decay * param.astype(jnp.float32)
+        mom = slots["moment"] + jnp.square(g)
+        new_p = param.astype(jnp.float32) - lr * g / (jnp.sqrt(mom) + self._epsilon)
+        return new_p.astype(param.dtype), {"moment": mom}
+
+
+class Adadelta(Optimizer):
+    _state_names = ["avg_squared_grad", "avg_squared_update"]
+    _hyper_names = ["_rho", "_epsilon"]
+
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95, parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._rho, self._epsilon = rho, epsilon
+
+    def _hyper_key(self):
+        return (float(self._weight_decay or 0.0), float(self._rho), float(self._epsilon))
+
+    def _update(self, param, grad, slots, lr, step):
+        g = grad.astype(jnp.float32)
+        if self._weight_decay:
+            g = g + self._weight_decay * param.astype(jnp.float32)
+        asg = self._rho * slots["avg_squared_grad"] + (1 - self._rho) * jnp.square(g)
+        upd = jnp.sqrt(slots["avg_squared_update"] + self._epsilon) / jnp.sqrt(asg + self._epsilon) * g
+        asu = self._rho * slots["avg_squared_update"] + (1 - self._rho) * jnp.square(upd)
+        new_p = param.astype(jnp.float32) - lr * upd
+        return new_p.astype(param.dtype), {"avg_squared_grad": asg, "avg_squared_update": asu}
+
+
+class RMSProp(Optimizer):
+    _state_names = ["mean_square", "mean_grad", "momentum"]
+    _hyper_names = ["_rho", "_epsilon", "_momentum", "_centered"]
+
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0, centered=False, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._rho, self._epsilon, self._momentum, self._centered = rho, epsilon, momentum, centered
+
+    def _hyper_key(self):
+        return (float(self._weight_decay or 0.0), float(self._rho), float(self._epsilon), float(self._momentum), bool(self._centered))
+
+    def _update(self, param, grad, slots, lr, step):
+        g = grad.astype(jnp.float32)
+        if self._weight_decay:
+            g = g + self._weight_decay * param.astype(jnp.float32)
+        ms = self._rho * slots["mean_square"] + (1 - self._rho) * jnp.square(g)
+        if self._centered:
+            mg = self._rho * slots["mean_grad"] + (1 - self._rho) * g
+            denom = jnp.sqrt(ms - jnp.square(mg) + self._epsilon)
+        else:
+            mg = slots["mean_grad"]
+            denom = jnp.sqrt(ms + self._epsilon)
+        mom = self._momentum * slots["momentum"] + lr * g / denom
+        new_p = param.astype(jnp.float32) - mom
+        return new_p.astype(param.dtype), {"mean_square": ms, "mean_grad": mg, "momentum": mom}
+
+
+class Adamax(Optimizer):
+    _state_names = ["moment", "inf_norm"]
+    _hyper_names = ["_beta1", "_beta2", "_epsilon"]
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _hyper_key(self):
+        return (float(self._weight_decay or 0.0), float(self._beta1), float(self._beta2), float(self._epsilon))
+
+    def _update(self, param, grad, slots, lr, step):
+        g = grad.astype(jnp.float32)
+        if self._weight_decay:
+            g = g + self._weight_decay * param.astype(jnp.float32)
+        m = self._beta1 * slots["moment"] + (1 - self._beta1) * g
+        u = jnp.maximum(self._beta2 * slots["inf_norm"], jnp.abs(g))
+        t = step.astype(jnp.float32)
+        new_p = param.astype(jnp.float32) - lr / (1 - self._beta1**t) * m / (u + self._epsilon)
+        return new_p.astype(param.dtype), {"moment": m, "inf_norm": u}
+
+
+class Lamb(Optimizer):
+    _state_names = ["moment1", "moment2"]
+    _hyper_names = ["_beta1", "_beta2", "_epsilon", "_lamb_weight_decay"]
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9, beta2=0.999, epsilon=1e-6,
+                 parameters=None, grad_clip=None, exclude_from_weight_decay_fn=None, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._lamb_weight_decay = lamb_weight_decay
+
+    def _hyper_key(self):
+        return (0.0, float(self._beta1), float(self._beta2), float(self._epsilon), float(self._lamb_weight_decay))
+
+    def _update(self, param, grad, slots, lr, step):
+        f32 = jnp.float32
+        g = grad.astype(f32)
+        p32 = param.astype(f32)
+        m = self._beta1 * slots["moment1"] + (1 - self._beta1) * g
+        v = self._beta2 * slots["moment2"] + (1 - self._beta2) * jnp.square(g)
+        t = step.astype(f32)
+        m_hat = m / (1 - self._beta1**t)
+        v_hat = v / (1 - self._beta2**t)
+        r = m_hat / (jnp.sqrt(v_hat) + self._epsilon) + self._lamb_weight_decay * p32
+        w_norm = jnp.linalg.norm(p32)
+        r_norm = jnp.linalg.norm(r)
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        new_p = p32 - lr * trust * r
+        return new_p.astype(param.dtype), {"moment1": m, "moment2": v}

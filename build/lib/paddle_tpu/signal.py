@@ -1,0 +1,117 @@
+"""Short-time Fourier transforms — paddle.signal parity
+(ref:python/paddle/signal.py: stft/istft built on frame/overlap_add ops;
+here framing is one strided gather and the FFT one XLA HLO).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .core.dispatch import apply
+from .core.tensor import Tensor
+
+__all__ = ["stft", "istft"]
+
+
+def _frame(x, frame_length, hop_length):
+    """[.., n] -> [.., frame_length, num_frames] (paddle layout)."""
+    n = x.shape[-1]
+    num_frames = 1 + (n - frame_length) // hop_length
+    starts = jnp.arange(num_frames) * hop_length
+    idx = starts[None, :] + jnp.arange(frame_length)[:, None]  # [fl, nf]
+    return x[..., idx]
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False, onesided=True,
+         name=None):
+    """Short-time Fourier transform (ref:python/paddle/signal.py stft).
+
+    x: [batch?, n] real or complex. Returns [batch?, n_fft//2+1 | n_fft,
+    num_frames] complex64/128.
+    """
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    if win_length > n_fft:
+        raise ValueError("win_length must be <= n_fft")
+    x_arr = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    if onesided and jnp.iscomplexobj(x_arr):
+        # the reference asserts: a complex input has no Hermitian symmetry
+        raise ValueError("stft: onesided=True is not supported for complex input")
+
+    win = window._data if isinstance(window, Tensor) else window
+
+    def f(x, *wargs, n_fft, hop_length, win_length, center, pad_mode,
+          normalized, onesided):
+        w = wargs[0] if wargs else jnp.ones((win_length,), jnp.float32)
+        # center-pad window to n_fft
+        if win_length < n_fft:
+            lp = (n_fft - win_length) // 2
+            w = jnp.pad(w, (lp, n_fft - win_length - lp))
+        if center:
+            pad = n_fft // 2
+            x = jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(pad, pad)],
+                        mode=pad_mode)
+        frames = _frame(x, n_fft, hop_length)  # [.., n_fft, nf]
+        frames = frames * w[:, None]
+        if onesided and not jnp.iscomplexobj(x):
+            spec = jnp.fft.rfft(frames, axis=-2)
+        else:
+            spec = jnp.fft.fft(frames, axis=-2)
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+        return spec
+
+    args = (x,) + ((win,) if win is not None else ())
+    return apply(f, args, dict(n_fft=n_fft, hop_length=hop_length,
+                               win_length=win_length, center=center,
+                               pad_mode=pad_mode, normalized=normalized,
+                               onesided=onesided), name="stft")
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    """Inverse STFT via overlap-add with window-envelope normalization."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+
+    win = window._data if isinstance(window, Tensor) else window
+
+    def f(spec, *wargs, n_fft, hop_length, win_length, center, normalized,
+          onesided, length, return_complex):
+        w = wargs[0] if wargs else jnp.ones((win_length,), jnp.float32)
+        if win_length < n_fft:
+            lp = (n_fft - win_length) // 2
+            w = jnp.pad(w, (lp, n_fft - win_length - lp))
+        if normalized:
+            spec = spec * jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+        if onesided:
+            frames = jnp.fft.irfft(spec, n=n_fft, axis=-2)
+        else:
+            frames = jnp.fft.ifft(spec, axis=-2)
+            if not return_complex:
+                frames = frames.real
+        frames = frames * w[:, None]
+        nf = frames.shape[-1]
+        out_len = n_fft + hop_length * (nf - 1)
+        lead = frames.shape[:-2]
+        sig = jnp.zeros(lead + (out_len,), frames.dtype)
+        env = jnp.zeros((out_len,), jnp.float32)
+        idx = (jnp.arange(nf) * hop_length)[None, :] + jnp.arange(n_fft)[:, None]
+        sig = sig.at[..., idx].add(frames)
+        env = env.at[idx].add((w * w)[:, None].astype(jnp.float32) *
+                              jnp.ones((n_fft, nf), jnp.float32))
+        env = jnp.where(env > 1e-11, env, 1.0)
+        sig = sig / env.astype(sig.dtype)
+        if center:
+            sig = sig[..., n_fft // 2: out_len - n_fft // 2]
+        if length is not None:
+            sig = sig[..., :length]
+        return sig
+
+    args = (x,) + ((win,) if win is not None else ())
+    return apply(f, args, dict(n_fft=n_fft, hop_length=hop_length,
+                               win_length=win_length, center=center,
+                               normalized=normalized, onesided=onesided,
+                               length=length, return_complex=return_complex),
+                 name="istft")

@@ -1,0 +1,194 @@
+"""paddle.sparse parity — COO/CSR tensors and ops.
+
+Reference: ref:python/paddle/sparse/ (sparse_coo_tensor/sparse_csr_tensor
+creation, Tensor.to_dense/to_sparse_coo, unary/binary/matmul ops, sparse
+nn) over the C++ SparseCooTensor/SparseCsrTensor (ref:paddle/phi/core/
+sparse_coo_tensor.h, 30K LoC of CUDA kernels).
+
+TPU-native: jax.experimental.sparse.BCOO/BCSR provide the storage and the
+XLA lowerings (scatter/gather/segment-sum); this module wraps them in the
+paddle API. On TPU, sparse matmuls lower to gather+dot — fine for the
+embedding/graph workloads the reference uses them for.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+from ..core.tensor import Tensor
+
+
+class SparseCooTensor:
+    """COO sparse tensor (values + [ndim, nnz] indices)."""
+
+    def __init__(self, bcoo: jsparse.BCOO):
+        self._bcoo = bcoo
+
+    # ---- creation/conversion
+    @property
+    def shape(self):
+        return list(self._bcoo.shape)
+
+    @property
+    def dtype(self):
+        return self._bcoo.dtype
+
+    def values(self) -> Tensor:
+        return Tensor(self._bcoo.data)
+
+    def indices(self) -> Tensor:
+        return Tensor(self._bcoo.indices.T)  # paddle layout [ndim, nnz]
+
+    def nnz(self) -> int:
+        return int(self._bcoo.nse)
+
+    def to_dense(self) -> Tensor:
+        return Tensor(self._bcoo.todense())
+
+    def to_sparse_csr(self) -> "SparseCsrTensor":
+        return SparseCsrTensor(jsparse.BCSR.from_bcoo(self._bcoo.sort_indices()))
+
+    def coalesce(self) -> "SparseCooTensor":
+        return SparseCooTensor(self._bcoo.sum_duplicates())
+
+    def __repr__(self):
+        return f"SparseCooTensor(shape={self.shape}, nnz={self.nnz()})"
+
+
+class SparseCsrTensor:
+    def __init__(self, bcsr: jsparse.BCSR):
+        self._bcsr = bcsr
+
+    @property
+    def shape(self):
+        return list(self._bcsr.shape)
+
+    @property
+    def dtype(self):
+        return self._bcsr.dtype
+
+    def values(self) -> Tensor:
+        return Tensor(self._bcsr.data)
+
+    def crows(self) -> Tensor:
+        return Tensor(self._bcsr.indptr)
+
+    def cols(self) -> Tensor:
+        return Tensor(self._bcsr.indices)
+
+    def nnz(self) -> int:
+        return int(self._bcsr.nse)
+
+    def to_dense(self) -> Tensor:
+        return Tensor(self._bcsr.todense())
+
+    def to_sparse_coo(self, sparse_dim=None) -> SparseCooTensor:
+        return SparseCooTensor(self._bcsr.to_bcoo())
+
+    def __repr__(self):
+        return f"SparseCsrTensor(shape={self.shape}, nnz={self.nnz()})"
+
+
+def _data(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def sparse_coo_tensor(indices, values, shape: Optional[Sequence[int]] = None,
+                      dtype=None, place=None, stop_gradient=True) -> SparseCooTensor:
+    """paddle.sparse.sparse_coo_tensor: indices [ndim, nnz] (paddle layout)."""
+    idx = jnp.asarray(_data(indices)).T.astype(jnp.int32)  # -> [nnz, ndim]
+    vals = _data(values)
+    if dtype is not None:
+        from ..core.dtype import convert_dtype_arg
+
+        vals = vals.astype(convert_dtype_arg(dtype))
+    if shape is None:
+        shape = tuple(int(i) + 1 for i in jnp.max(idx, axis=0))
+    return SparseCooTensor(jsparse.BCOO((vals, idx), shape=tuple(shape)))
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None) -> SparseCsrTensor:
+    vals = _data(values)
+    if dtype is not None:
+        from ..core.dtype import convert_dtype_arg
+
+        vals = vals.astype(convert_dtype_arg(dtype))
+    bcsr = jsparse.BCSR((vals, jnp.asarray(_data(cols)).astype(jnp.int32),
+                         jnp.asarray(_data(crows)).astype(jnp.int32)),
+                        shape=tuple(shape))
+    return SparseCsrTensor(bcsr)
+
+
+def to_sparse_coo(x: Tensor, sparse_dim: Optional[int] = None) -> SparseCooTensor:
+    return SparseCooTensor(jsparse.BCOO.fromdense(_data(x)))
+
+
+# ------------------------------------------------------------------- ops
+def _coo(x):
+    if isinstance(x, SparseCooTensor):
+        return x._bcoo
+    raise TypeError(f"expected SparseCooTensor, got {type(x)}")
+
+
+def add(x, y):
+    if isinstance(x, SparseCooTensor) and isinstance(y, SparseCooTensor):
+        return SparseCooTensor((_coo(x) + _coo(y)).sum_duplicates())
+    if isinstance(x, SparseCooTensor):
+        return Tensor(_coo(x).todense() + _data(y))
+    return Tensor(_data(x) + _coo(y).todense())
+
+
+def multiply(x, y):
+    if isinstance(x, SparseCooTensor) and not isinstance(y, SparseCooTensor):
+        b = _coo(x)
+        gathered = _data(y)[tuple(b.indices[:, i] for i in range(b.indices.shape[1]))]
+        return SparseCooTensor(jsparse.BCOO((b.data * gathered, b.indices), shape=b.shape))
+    if isinstance(x, SparseCooTensor) and isinstance(y, SparseCooTensor):
+        return SparseCooTensor(jsparse.BCOO.fromdense(_coo(x).todense() * _coo(y).todense()))
+    raise TypeError("multiply expects at least one sparse operand")
+
+
+def matmul(x, y):
+    """sparse @ dense (the GNN/embedding hot path)."""
+    if isinstance(x, SparseCooTensor):
+        out = _coo(x) @ _data(y)
+        return Tensor(out)
+    if isinstance(x, SparseCsrTensor):
+        out = x._bcsr @ _data(y)
+        return Tensor(out)
+    raise TypeError(f"matmul expects a sparse lhs, got {type(x)}")
+
+
+def masked_matmul(x, y, mask: SparseCooTensor):
+    """dense@dense evaluated only at mask's nonzeros (SDDMM)."""
+    b = _coo(mask)
+    xd, yd = _data(x), _data(y)
+    rows, cols = b.indices[:, 0], b.indices[:, 1]
+    vals = jnp.einsum("nk,nk->n", xd[rows], yd[:, cols].T)
+    return SparseCooTensor(jsparse.BCOO((vals, b.indices), shape=b.shape))
+
+
+def _unary(fn):
+    def op(x):
+        if isinstance(x, SparseCooTensor):
+            b = _coo(x)
+            return SparseCooTensor(jsparse.BCOO((fn(b.data), b.indices), shape=b.shape))
+        if isinstance(x, SparseCsrTensor):
+            b = x._bcsr
+            return SparseCsrTensor(jsparse.BCSR((fn(b.data), b.indices, b.indptr),
+                                                shape=b.shape))
+        return Tensor(fn(_data(x)))
+
+    return op
+
+
+relu = _unary(lambda v: jnp.maximum(v, 0))
+abs = _unary(jnp.abs)  # noqa: A001
+sin = _unary(jnp.sin)
+tanh = _unary(jnp.tanh)
+sqrt = _unary(jnp.sqrt)
+square = _unary(jnp.square)
+neg = _unary(jnp.negative)

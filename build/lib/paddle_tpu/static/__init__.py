@@ -1,0 +1,53 @@
+"""paddle.static compatibility surface.
+
+The reference's static graph (Program/Executor/feed-fetch,
+ref:python/paddle/static/) is replaced by traced compilation: on TPU the
+compiler is the executor (SURVEY.md §7). This module keeps the *deployment*
+entry points working — InputSpec, save/load_inference_model backed by
+jit.save/load's StableHLO export — and raises clear errors for the
+graph-construction APIs that have no TPU-native meaning.
+"""
+from __future__ import annotations
+
+from ..jit import InputSpec  # noqa: F401
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars=None, executor=None,
+                         program=None, **kwargs):
+    """TPU-native contract: save_inference_model(path, layer, input_spec).
+
+    (feed_vars = the Layer, fetch_vars = list of InputSpec; the legacy
+    (feed, fetch, executor, program) form is not representable.)"""
+    from ..jit import save as jit_save
+    from ..nn.layer import Layer
+
+    if isinstance(feed_vars, Layer):
+        jit_save(feed_vars, path_prefix, input_spec=fetch_vars)
+        return
+    raise NotImplementedError(
+        "legacy Program-based save_inference_model is not supported; pass "
+        "(path, layer, input_spec) — the model exports as StableHLO")
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    from ..jit import load as jit_load
+
+    return jit_load(path_prefix)
+
+
+def _no_static(name):
+    def fn(*a, **k):
+        raise NotImplementedError(
+            f"paddle.static.{name} builds a legacy Program graph; "
+            "paddle_tpu compiles traced functions instead — decorate with "
+            "@paddle_tpu.jit.to_static and use jit.save/load for deployment")
+
+    return fn
+
+
+Program = _no_static("Program")
+program_guard = _no_static("program_guard")
+Executor = _no_static("Executor")
+data = _no_static("data")
+default_main_program = _no_static("default_main_program")
+default_startup_program = _no_static("default_startup_program")

@@ -1,0 +1,4 @@
+"""paddle.vision parity: model zoo, transforms, datasets."""
+from . import models  # noqa: F401
+from . import transforms  # noqa: F401
+from . import datasets  # noqa: F401

@@ -1,0 +1,130 @@
+"""Vision datasets — parity surface for ref:python/paddle/vision/datasets/
+(MNIST, Cifar10/100, FashionMNIST). No egress in this environment, so
+constructors read local files when given, else raise with instructions;
+``FakeData`` provides deterministic synthetic data for tests/benchmarks."""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+
+import numpy as np
+
+from ...io import Dataset
+
+
+class FakeData(Dataset):
+    """Deterministic synthetic image dataset (tests + benchmark warmers)."""
+
+    def __init__(self, num_samples=256, image_shape=(1, 28, 28), num_classes=10,
+                 transform=None, seed=0):
+        self.num_samples = num_samples
+        self.image_shape = tuple(image_shape)
+        self.num_classes = num_classes
+        self.transform = transform
+        rng = np.random.default_rng(seed)
+        self._images = rng.random((num_samples,) + self.image_shape, np.float32)
+        self._labels = rng.integers(0, num_classes, (num_samples, 1)).astype(np.int64)
+
+    def __getitem__(self, idx):
+        img = self._images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self._labels[idx]
+
+    def __len__(self):
+        return self.num_samples
+
+
+class MNIST(Dataset):
+    """MNIST from local idx/gz files (ref mnist.py format). Pass image_path/
+    label_path pointing at the standard ubyte(.gz) files."""
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=False, backend=None):
+        if download and (image_path is None or label_path is None):
+            raise NotImplementedError(
+                "no network egress: provide image_path/label_path to local "
+                "MNIST ubyte files")
+        self.transform = transform
+        if image_path is None:
+            raise ValueError("image_path is required")
+        self.images = self._read_images(image_path)
+        self.labels = self._read_labels(label_path)
+
+    @staticmethod
+    def _open(path):
+        return gzip.open(path, "rb") if path.endswith(".gz") else open(path, "rb")
+
+    def _read_images(self, path):
+        with self._open(path) as f:
+            magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            data = np.frombuffer(f.read(), np.uint8)
+        return data.reshape(n, rows, cols)
+
+    def _read_labels(self, path):
+        with self._open(path) as f:
+            magic, n = struct.unpack(">II", f.read(8))
+            data = np.frombuffer(f.read(), np.uint8)
+        return data.reshape(n, 1).astype(np.int64)
+
+    def __getitem__(self, idx):
+        img = self.images[idx].astype(np.float32) / 255.0
+        img = img[None, :, :]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.images)
+
+
+class FashionMNIST(MNIST):
+    pass
+
+
+class Cifar10(Dataset):
+    """CIFAR-10 from a local python-version pickle archive directory."""
+
+    def __init__(self, data_file=None, mode="train", transform=None, download=False):
+        if download and data_file is None:
+            raise NotImplementedError(
+                "no network egress: provide data_file pointing at the local "
+                "cifar-10 python batches directory")
+        if data_file is None:
+            raise ValueError("data_file is required")
+        self.transform = transform
+        batches = ([f"data_batch_{i}" for i in range(1, 6)]
+                   if mode == "train" else ["test_batch"])
+        xs, ys = [], []
+        for b in batches:
+            with open(os.path.join(data_file, b), "rb") as f:
+                d = pickle.load(f, encoding="bytes")
+            xs.append(d[b"data"])
+            ys.extend(d[b"labels"])
+        self.images = np.concatenate(xs).reshape(-1, 3, 32, 32)
+        self.labels = np.asarray(ys, np.int64).reshape(-1, 1)
+
+    def __getitem__(self, idx):
+        img = self.images[idx].astype(np.float32) / 255.0
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.images)
+
+
+class Cifar100(Cifar10):
+    def __init__(self, data_file=None, mode="train", transform=None, download=False):
+        if download and data_file is None:
+            raise NotImplementedError("no network egress: provide data_file")
+        if data_file is None:
+            raise ValueError("data_file is required")
+        self.transform = transform
+        name = "train" if mode == "train" else "test"
+        with open(os.path.join(data_file, name), "rb") as f:
+            d = pickle.load(f, encoding="bytes")
+        self.images = np.asarray(d[b"data"]).reshape(-1, 3, 32, 32)
+        self.labels = np.asarray(d[b"fine_labels"], np.int64).reshape(-1, 1)

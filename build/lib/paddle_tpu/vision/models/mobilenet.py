@@ -1,0 +1,119 @@
+"""MobileNet V1/V2 — parity with ref:python/paddle/vision/models/
+mobilenetv1.py, mobilenetv2.py. Depthwise convs use grouped
+lax.conv_general_dilated (feature_group_count) — MXU-friendly."""
+from __future__ import annotations
+
+from ... import nn
+
+
+def _conv_bn(c_in, c_out, k, stride=1, padding=0, groups=1):
+    return nn.Sequential(
+        nn.Conv2D(c_in, c_out, k, stride=stride, padding=padding,
+                  groups=groups, bias_attr=False),
+        nn.BatchNorm2D(c_out),
+        nn.ReLU6(),
+    )
+
+
+class MobileNetV1(nn.Layer):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+
+        def c(ch):
+            return max(8, int(ch * scale))
+
+        cfg = [  # (out, stride)
+            (64, 1), (128, 2), (128, 1), (256, 2), (256, 1), (512, 2),
+            (512, 1), (512, 1), (512, 1), (512, 1), (512, 1), (1024, 2), (1024, 1),
+        ]
+        layers = [_conv_bn(3, c(32), 3, stride=2, padding=1)]
+        c_in = c(32)
+        for out, s in cfg:
+            layers.append(_conv_bn(c_in, c_in, 3, stride=s, padding=1, groups=c_in))
+            layers.append(_conv_bn(c_in, c(out), 1))
+            c_in = c(out)
+        self.features = nn.Sequential(*layers)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.fc = nn.Linear(c(1024), num_classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = x.reshape([x.shape[0], -1])
+            x = self.fc(x)
+        return x
+
+
+class InvertedResidual(nn.Layer):
+    def __init__(self, c_in, c_out, stride, expand_ratio):
+        super().__init__()
+        hidden = int(round(c_in * expand_ratio))
+        self.use_res = stride == 1 and c_in == c_out
+        layers = []
+        if expand_ratio != 1:
+            layers.append(_conv_bn(c_in, hidden, 1))
+        layers += [
+            _conv_bn(hidden, hidden, 3, stride=stride, padding=1, groups=hidden),
+            nn.Conv2D(hidden, c_out, 1, bias_attr=False),
+            nn.BatchNorm2D(c_out),
+        ]
+        self.conv = nn.Sequential(*layers)
+
+    def forward(self, x):
+        out = self.conv(x)
+        return x + out if self.use_res else out
+
+
+class MobileNetV2(nn.Layer):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+
+        def c(ch):
+            return max(8, int(ch * scale))
+
+        cfg = [  # t, c, n, s
+            (1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2), (6, 64, 4, 2),
+            (6, 96, 3, 1), (6, 160, 3, 2), (6, 320, 1, 1),
+        ]
+        layers = [_conv_bn(3, c(32), 3, stride=2, padding=1)]
+        c_in = c(32)
+        for t, ch, n, s in cfg:
+            for i in range(n):
+                layers.append(InvertedResidual(c_in, c(ch), s if i == 0 else 1, t))
+                c_in = c(ch)
+        last = max(1280, int(1280 * scale))
+        layers.append(_conv_bn(c_in, last, 1))
+        self.features = nn.Sequential(*layers)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.classifier = nn.Sequential(nn.Dropout(0.2), nn.Linear(last, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = x.reshape([x.shape[0], -1])
+            x = self.classifier(x)
+        return x
+
+
+def mobilenet_v1(pretrained=False, scale=1.0, **kwargs):
+    if pretrained:
+        raise NotImplementedError("pretrained weights unavailable (no egress)")
+    return MobileNetV1(scale=scale, **kwargs)
+
+
+def mobilenet_v2(pretrained=False, scale=1.0, **kwargs):
+    if pretrained:
+        raise NotImplementedError("pretrained weights unavailable (no egress)")
+    return MobileNetV2(scale=scale, **kwargs)

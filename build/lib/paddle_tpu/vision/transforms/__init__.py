@@ -1,0 +1,128 @@
+"""Vision transforms — numpy host-side preprocessing, parity with
+ref:python/paddle/vision/transforms/transforms.py (Compose, ToTensor,
+Normalize, Resize, CenterCrop, RandomCrop, RandomHorizontalFlip). Images are
+HWC uint8/float numpy arrays in; CHW float32 out of ToTensor."""
+from __future__ import annotations
+
+import numbers
+import random as pyrandom
+
+import numpy as np
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def __call__(self, x):
+        for t in self.transforms:
+            x = t(x)
+        return x
+
+
+class ToTensor:
+    def __init__(self, data_format="CHW"):
+        self.data_format = data_format
+
+    def __call__(self, img):
+        arr = np.asarray(img)
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        if arr.dtype == np.uint8:
+            arr = arr.astype(np.float32) / 255.0
+        else:
+            arr = arr.astype(np.float32)
+        if self.data_format == "CHW":
+            arr = np.transpose(arr, (2, 0, 1))
+        return arr
+
+
+class Normalize:
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False):
+        self.mean = np.asarray(mean, np.float32)
+        self.std = np.asarray(std, np.float32)
+        self.data_format = data_format
+
+    def __call__(self, img):
+        img = np.asarray(img, np.float32)
+        if self.data_format == "CHW":
+            shape = (-1, 1, 1)
+        else:
+            shape = (1, 1, -1)
+        return (img - self.mean.reshape(shape)) / self.std.reshape(shape)
+
+
+def _resize_np(img, size):
+    """Nearest-neighbour resize (no PIL/cv2 dependency)."""
+    h, w = img.shape[:2]
+    if isinstance(size, numbers.Number):
+        short = min(h, w)
+        scale = size / short
+        nh, nw = int(round(h * scale)), int(round(w * scale))
+    else:
+        nh, nw = size
+    rows = (np.arange(nh) * (h / nh)).astype(np.int64).clip(0, h - 1)
+    cols = (np.arange(nw) * (w / nw)).astype(np.int64).clip(0, w - 1)
+    return img[rows][:, cols]
+
+
+class Resize:
+    def __init__(self, size, interpolation="nearest"):
+        self.size = size
+
+    def __call__(self, img):
+        return _resize_np(np.asarray(img), self.size)
+
+
+class CenterCrop:
+    def __init__(self, size):
+        self.size = (size, size) if isinstance(size, numbers.Number) else tuple(size)
+
+    def __call__(self, img):
+        img = np.asarray(img)
+        h, w = img.shape[:2]
+        th, tw = self.size
+        i = max(0, (h - th) // 2)
+        j = max(0, (w - tw) // 2)
+        return img[i:i + th, j:j + tw]
+
+
+class RandomCrop:
+    def __init__(self, size, padding=0):
+        self.size = (size, size) if isinstance(size, numbers.Number) else tuple(size)
+        self.padding = padding
+
+    def __call__(self, img):
+        img = np.asarray(img)
+        if self.padding:
+            pad = [(self.padding, self.padding), (self.padding, self.padding)]
+            if img.ndim == 3:
+                pad.append((0, 0))
+            img = np.pad(img, pad, mode="constant")
+        h, w = img.shape[:2]
+        th, tw = self.size
+        i = pyrandom.randint(0, max(0, h - th))
+        j = pyrandom.randint(0, max(0, w - tw))
+        return img[i:i + th, j:j + tw]
+
+
+class RandomHorizontalFlip:
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def __call__(self, img):
+        if pyrandom.random() < self.prob:
+            return np.asarray(img)[:, ::-1].copy()
+        return np.asarray(img)
+
+
+def to_tensor(img, data_format="CHW"):
+    return ToTensor(data_format)(img)
+
+
+def normalize(img, mean, std, data_format="CHW"):
+    return Normalize(mean, std, data_format)(img)
+
+
+def resize(img, size):
+    return Resize(size)(img)

@@ -1,0 +1,30 @@
+"""Wheel build with a prebuilt native runtime library.
+
+The reference drives a CMake superbuild from setup.py (ref:setup.py:60-79);
+here the native surface is one shared library (kvstore + trace + embedding
+service) compiled with g++ at build time and shipped as package data.
+``paddle_tpu.native.load()`` prefers the prebuilt .so and falls back to a
+source JIT build (cached by source hash) when running from a checkout.
+"""
+import subprocess
+from pathlib import Path
+
+from setuptools import setup
+from setuptools.command.build_py import build_py
+
+
+class BuildPyWithNative(build_py):
+    def run(self):
+        super().run()
+        src_dir = Path(__file__).parent / "paddle_tpu" / "native" / "csrc"
+        sources = sorted(str(p) for p in src_dir.glob("*.cc"))
+        if sources:
+            out_dir = Path(self.build_lib) / "paddle_tpu" / "native"
+            out_dir.mkdir(parents=True, exist_ok=True)
+            out = out_dir / "libpaddle_tpu_native.so"
+            cmd = ["g++", "-O2", "-fPIC", "-shared", "-std=c++17",
+                   "-pthread", "-o", str(out)] + sources
+            subprocess.run(cmd, check=True)
+
+
+setup(cmdclass={"build_py": BuildPyWithNative})

@@ -1,0 +1,87 @@
+"""Eager-dispatch overhead microbenchmark.
+
+The reference gates per-op perf in CI (ref:tools/ci_op_benchmark.sh). Here
+the eager hot loop is Python -> dispatch.apply -> per-(op, shape) jax.jit
+cache -> PJRT; this tool measures µs/op for representative ops, the same
+chain fully compiled (one program), and the framework overhead ratio.
+
+Writes one JSON line; run with BENCH_RECORD=path to append to a budget file.
+A budget: eager dispatch should stay under ~150µs/op on CPU-class hosts
+(SURVEY.md §3.1 flags the per-op boundary as the dygraph hot-loop risk).
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    import paddle_tpu as paddle
+
+    dev = jax.devices()[0]
+    x = paddle.to_tensor(np.random.rand(256, 256).astype(np.float32))
+    y = paddle.to_tensor(np.random.rand(256, 256).astype(np.float32))
+
+    ops = {
+        "add": lambda: paddle.add(x, y),
+        "matmul": lambda: paddle.matmul(x, y),
+        "relu": lambda: paddle.nn.functional.relu(x),
+        "sum": lambda: paddle.sum(x),
+        "transpose": lambda: paddle.transpose(x, [1, 0]),
+    }
+
+    results = {}
+    for name, f in ops.items():
+        f()  # compile/cache
+        n = 200
+        t0 = time.perf_counter()
+        for _ in range(n):
+            out = f()
+        np.asarray(out._data if hasattr(out, "_data") else out)
+        results[name] = (time.perf_counter() - t0) / n * 1e6  # µs/op
+
+    # the same 5-op chain as ONE compiled program
+    def chain(xa, ya):
+        import jax.numpy as jnp
+
+        a = xa + ya
+        b = a @ ya
+        c = jnp.maximum(b, 0)
+        return c.sum() + xa.T.sum()
+
+    cf = jax.jit(chain)
+    cf(x._data, y._data)
+    n = 200
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = cf(x._data, y._data)
+    np.asarray(out)
+    compiled_us = (time.perf_counter() - t0) / n * 1e6
+
+    eager_mean = float(np.mean(list(results.values())))
+    rec = {
+        "metric": "eager dispatch overhead",
+        "unit": "us/op",
+        "platform": dev.platform,
+        "per_op_us": {k: round(v, 1) for k, v in results.items()},
+        "eager_mean_us": round(eager_mean, 1),
+        "compiled_chain_us": round(compiled_us, 1),
+        "overhead_ratio": round(eager_mean * len(results) / max(compiled_us, 1e-9), 2),
+        "budget_us": 150.0,
+        "within_budget": eager_mean <= 150.0,
+    }
+    line = json.dumps(rec)
+    print(line)
+    path = os.environ.get("BENCH_RECORD")
+    if path:
+        with open(path, "a") as f:
+            f.write(line + "\n")
+
+
+if __name__ == "__main__":
+    main()
