@@ -28,6 +28,8 @@ from .tensor import Tensor
 
 _JIT_CACHE: Dict[Tuple, Any] = {}
 _amp = None  # set lazily to break the import cycle
+# active (pack, unpack) saved-tensor hooks (autograd.saved_tensors_hooks)
+_saved_tensor_hooks: list = []
 
 
 def _init_amp():
@@ -193,6 +195,10 @@ def apply(fn, tensor_args: Tuple, static: Dict[str, Any], *, differentiable: boo
         # in_tensors aligns 1:1 with fn's positional args for the vjp zip;
         # non-Tensor entries (python scalars) get no cotangent.
         node = TapeNode(fn, static_t, datas, tensor_args, multi, name)
+        if _saved_tensor_hooks:
+            pack, unpack = _saved_tensor_hooks[-1]
+            node.in_datas = tuple(pack(d) for d in datas)
+            node.unpack = unpack
         out_tensors = []
         for o in outs:
             t = Tensor(o, stop_gradient=False)

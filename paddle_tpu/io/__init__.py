@@ -513,3 +513,27 @@ class _MultiProcessIter:
 def get_worker_info():
     """Worker-process info (id/num_workers/seed/dataset), None in the parent."""
     return worker_mod.get_worker_info()
+
+
+class ComposeDataset(Dataset):
+    """Zip datasets sample-wise; fields concatenate
+    (ref:python/paddle/fluid/dataloader/dataset.py ComposeDataset)."""
+
+    def __init__(self, datasets):
+        self.datasets = list(datasets)
+        if not self.datasets:
+            raise ValueError("ComposeDataset needs at least one dataset")
+        n = len(self.datasets[0])
+        for d in self.datasets[1:]:
+            if len(d) != n:
+                raise ValueError("ComposeDataset requires equal lengths")
+
+    def __len__(self):
+        return len(self.datasets[0])
+
+    def __getitem__(self, idx):
+        out = []
+        for d in self.datasets:
+            s = d[idx]
+            out.extend(s if isinstance(s, (tuple, list)) else [s])
+        return tuple(out)

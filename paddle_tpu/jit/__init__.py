@@ -370,3 +370,49 @@ def load(path, **configs):
         return TranslatedLayer(exported, arrays)
     with open(path + ".pdparams", "rb") as f:
         return pickle.load(f)
+
+
+# --------------------------------------------------- dy2static config knobs
+# (ref:python/paddle/jit/api.py enable_to_static, dy2static/logging_utils)
+
+_to_static_enabled = True
+
+
+def enable_to_static(enable: bool = True):
+    """Globally toggle to_static compilation (when off, StaticFunction runs
+    the original eager function)."""
+    global _to_static_enabled
+    _to_static_enabled = bool(enable)
+
+
+def not_to_static(function):
+    """Mark a function to stay eager inside to_static regions. Tracing-based
+    to_static has no AST rewriting, so marked functions simply run as part of
+    the trace; the marker is honored by returning the function unchanged."""
+    function._paddle_not_to_static = True
+    return function
+
+
+_ignored_modules: list = []
+
+
+def ignore_module(modules):
+    """Register modules the dy2static transformer should skip. Trace-based
+    compilation never rewrites module code, so registration is bookkeeping
+    for API parity."""
+    _ignored_modules.extend(modules if isinstance(modules, (list, tuple))
+                            else [modules])
+
+
+_code_level = 0
+_verbosity = 0
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    global _code_level
+    _code_level = level
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    global _verbosity
+    _verbosity = level

@@ -399,9 +399,11 @@ def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
                 spatial = list(range(2, nd))
             elif data_format in ("NHWC", "NLC", "NDHWC"):
                 spatial = list(range(1, nd - 1))
+            # paddle/torch contract: the FIRST (left, right) pair pads the
+            # LAST spatial dim, the next pair the one before it, ...
             k = len(pad) // 2
             for j in range(k):
-                width[spatial[-(j + 1)]] = (pad[2 * (k - 1 - j)], pad[2 * (k - 1 - j) + 1])
+                width[spatial[-(j + 1)]] = (pad[2 * j], pad[2 * j + 1])
         if mode == "constant":
             return jnp.pad(x, width, constant_values=value)
         jmode = {"reflect": "reflect", "replicate": "edge", "circular": "wrap"}[mode]

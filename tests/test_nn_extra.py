@@ -1,0 +1,339 @@
+"""Golden tests for the nn surface completion (losses, unpool, vision ops).
+
+Torch (CPU) is the reference oracle where it implements the same op —
+mirroring the reference's OpTest numpy/torch-golden pattern (SURVEY.md §4.1).
+"""
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as TF
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.nn import functional as F
+
+RNG = np.random.RandomState(11)
+
+
+def _t(a):
+    return paddle.to_tensor(a)
+
+
+# ------------------------------------------------------------------ losses
+
+
+def test_ctc_loss_matches_torch():
+    T, B, V, L = 12, 3, 6, 4
+    logits = RNG.randn(T, B, V).astype(np.float32)
+    log_probs = torch.log_softmax(torch.tensor(logits), dim=-1)
+    labels = RNG.randint(1, V, (B, L)).astype(np.int32)
+    in_len = np.array([12, 10, 8], np.int64)
+    lab_len = np.array([4, 3, 2], np.int64)
+
+    exp = TF.ctc_loss(log_probs, torch.tensor(labels.astype(np.int64)),
+                      torch.tensor(in_len), torch.tensor(lab_len),
+                      blank=0, reduction="none").numpy()
+    got = F.ctc_loss(_t(log_probs.numpy()), _t(labels), _t(in_len.astype(np.int32)),
+                     _t(lab_len.astype(np.int32)), blank=0,
+                     reduction="none").numpy()
+    np.testing.assert_allclose(got, exp, rtol=1e-4, atol=1e-4)
+
+
+def test_ctc_loss_gradient_flows():
+    T, B, V, L = 8, 2, 5, 3
+    x = paddle.to_tensor(RNG.randn(T, B, V).astype(np.float32),
+                         stop_gradient=False)
+    lp = F.log_softmax(x, axis=-1)
+    labels = _t(RNG.randint(1, V, (B, L)).astype(np.int32))
+    loss = F.ctc_loss(lp, labels, _t(np.array([8, 8], np.int32)),
+                      _t(np.array([3, 2], np.int32)))
+    loss.backward()
+    assert np.isfinite(x.grad.numpy()).all()
+
+
+def _rnnt_brute(lp, lab, T, U, blank):
+    """Enumerate all monotone paths (tiny sizes only)."""
+    import itertools
+
+    best = []
+    # path = sequence of T blanks and U emits interleaved; prob summed
+    total = -np.inf
+    for positions in itertools.combinations(range(T + U), U):
+        t = u = 0
+        logp = 0.0
+        ok = True
+        for step in range(T + U):
+            if step in positions:  # emit label u at (t, u)
+                if u >= U or t >= T:
+                    ok = False
+                    break
+                logp += lp[t, u, lab[u]]
+                u += 1
+            else:  # blank at (t, u)
+                if t >= T:
+                    ok = False
+                    break
+                logp += lp[t, u, blank]
+                t += 1
+        if ok and u == U and t == T:
+            total = np.logaddexp(total, logp)
+    return -total
+
+
+def test_rnnt_loss_matches_bruteforce():
+    B, T, U, V = 2, 3, 2, 4
+    lp = np.log(np.random.RandomState(3).dirichlet(np.ones(V), (B, T, U + 1))
+                ).astype(np.float32)
+    lab = np.array([[1, 2], [3, 1]], np.int32)
+    got = F.rnnt_loss(_t(lp), _t(lab), _t(np.array([T, T], np.int32)),
+                      _t(np.array([U, U], np.int32)), blank=0,
+                      reduction="none").numpy()
+    for b in range(B):
+        exp = _rnnt_brute(lp[b], lab[b], T, U, 0)
+        np.testing.assert_allclose(got[b], exp, rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("red", ["none", "mean", "sum"])
+def test_margin_losses_match_torch(red):
+    x = RNG.randn(8, 5).astype(np.float32)
+    y = RNG.randint(0, 5, 8)
+    np.testing.assert_allclose(
+        F.multi_margin_loss(_t(x), _t(y.astype(np.int32)), reduction=red).numpy(),
+        TF.multi_margin_loss(torch.tensor(x), torch.tensor(y), reduction=red).numpy(),
+        rtol=1e-5, atol=1e-6)
+
+    xs = RNG.randn(10).astype(np.float32)
+    ys = np.sign(RNG.randn(10)).astype(np.float32)
+    np.testing.assert_allclose(
+        F.soft_margin_loss(_t(xs), _t(ys), reduction=red).numpy(),
+        TF.soft_margin_loss(torch.tensor(xs), torch.tensor(ys), reduction=red).numpy(),
+        rtol=1e-5, atol=1e-6)
+
+    yl = (RNG.rand(8, 5) > 0.5).astype(np.float32)
+    np.testing.assert_allclose(
+        F.multi_label_soft_margin_loss(_t(x), _t(yl), reduction=red).numpy(),
+        TF.multilabel_soft_margin_loss(torch.tensor(x), torch.tensor(yl),
+                                       reduction=red).numpy(),
+        rtol=1e-5, atol=1e-6)
+
+
+def test_poisson_gaussian_nll_match_torch():
+    x = RNG.rand(10).astype(np.float32) + 0.1
+    y = RNG.poisson(2.0, 10).astype(np.float32)
+    np.testing.assert_allclose(
+        F.poisson_nll_loss(_t(x), _t(y)).numpy(),
+        TF.poisson_nll_loss(torch.tensor(x), torch.tensor(y)).numpy(),
+        rtol=1e-5)
+    mu = RNG.randn(10).astype(np.float32)
+    var = RNG.rand(10).astype(np.float32) + 0.1
+    tgt = RNG.randn(10).astype(np.float32)
+    np.testing.assert_allclose(
+        F.gaussian_nll_loss(_t(mu), _t(tgt), _t(var)).numpy(),
+        TF.gaussian_nll_loss(torch.tensor(mu), torch.tensor(tgt),
+                             torch.tensor(var)).numpy(),
+        rtol=1e-5, atol=1e-6)
+
+
+def test_pairwise_distance_matches_torch():
+    a = RNG.randn(6, 8).astype(np.float32)
+    b = RNG.randn(6, 8).astype(np.float32)
+    np.testing.assert_allclose(
+        F.pairwise_distance(_t(a), _t(b)).numpy(),
+        TF.pairwise_distance(torch.tensor(a), torch.tensor(b)).numpy(),
+        rtol=1e-4)
+
+
+def test_hsigmoid_loss_runs_and_trains():
+    feat, C = 8, 10
+    layer = nn.HSigmoidLoss(feat, C)
+    x = paddle.to_tensor(RNG.randn(16, feat).astype(np.float32))
+    y = _t(RNG.randint(0, C, 16).astype(np.int32))
+    opt = paddle.optimizer.Adam(learning_rate=0.05,
+                                parameters=layer.parameters())
+    first = None
+    for _ in range(20):
+        loss = layer(x, y).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        first = first if first is not None else float(loss.numpy())
+    assert float(loss.numpy()) < first * 0.7
+
+
+# ---------------------------------------------------------- pooling/unpool
+
+
+def test_max_pool_mask_and_unpool_match_torch():
+    x = RNG.randn(2, 3, 8, 8).astype(np.float32)
+    out, mask = F.max_pool2d(_t(x), 2, 2, return_mask=True)
+    tout, tmask = TF.max_pool2d(torch.tensor(x), 2, 2, return_indices=True)
+    np.testing.assert_allclose(out.numpy(), tout.numpy(), rtol=1e-6)
+    np.testing.assert_array_equal(mask.numpy(), tmask.numpy())
+
+    un = F.max_unpool2d(out, mask, 2, 2)
+    tun = TF.max_unpool2d(tout, tmask, 2, 2)
+    np.testing.assert_allclose(un.numpy(), tun.numpy(), rtol=1e-6)
+
+
+def test_max_unpool1d_3d():
+    x1 = RNG.randn(2, 3, 10).astype(np.float32)
+    o, m = F.max_pool1d(_t(x1), 2, 2, return_mask=True)
+    u = F.max_unpool1d(o, m, 2, 2)
+    to, tm = TF.max_pool1d(torch.tensor(x1), 2, 2, return_indices=True)
+    tu = TF.max_unpool1d(to, tm, 2, 2)
+    np.testing.assert_allclose(u.numpy(), tu.numpy(), rtol=1e-6)
+
+    x3 = RNG.randn(1, 2, 4, 4, 4).astype(np.float32)
+    o3, m3 = F.max_pool3d(_t(x3), 2, 2, return_mask=True)
+    u3 = F.max_unpool3d(o3, m3, 2, 2)
+    to3, tm3 = TF.max_pool3d(torch.tensor(x3), 2, 2, return_indices=True)
+    tu3 = TF.max_unpool3d(to3, tm3, 2, 2)
+    np.testing.assert_allclose(u3.numpy(), tu3.numpy(), rtol=1e-6)
+
+
+# ------------------------------------------------------------- vision ops
+
+
+def test_grid_sample_and_affine_grid_match_torch():
+    x = RNG.randn(2, 3, 6, 6).astype(np.float32)
+    theta = np.tile(np.array([[[0.8, 0.1, 0.1], [-0.1, 0.9, -0.2]]],
+                             np.float32), (2, 1, 1))
+    grid = F.affine_grid(_t(theta), [2, 3, 5, 5], align_corners=True)
+    tgrid = TF.affine_grid(torch.tensor(theta), [2, 3, 5, 5],
+                           align_corners=True)
+    np.testing.assert_allclose(grid.numpy(), tgrid.numpy(), rtol=1e-4,
+                               atol=1e-5)
+    out = F.grid_sample(_t(x), grid, align_corners=True)
+    texp = TF.grid_sample(torch.tensor(x), tgrid, align_corners=True)
+    np.testing.assert_allclose(out.numpy(), texp.numpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_channel_shuffle_matches_torch():
+    x = RNG.randn(2, 6, 4, 4).astype(np.float32)
+    np.testing.assert_array_equal(
+        F.channel_shuffle(_t(x), 3).numpy(),
+        TF.channel_shuffle(torch.tensor(x), 3).numpy())
+
+
+def test_local_response_norm_matches_torch():
+    x = RNG.randn(2, 7, 5, 5).astype(np.float32)
+    layer = nn.LocalResponseNorm(size=3, alpha=1e-4, beta=0.75, k=1.0)
+    exp = TF.local_response_norm(torch.tensor(x), 3, alpha=1e-4, beta=0.75,
+                                 k=1.0).numpy()
+    np.testing.assert_allclose(layer(_t(x)).numpy(), exp, rtol=1e-4, atol=1e-6)
+
+
+def test_bilinear_matches_torch():
+    m = nn.Bilinear(4, 5, 3)
+    x1 = RNG.randn(6, 4).astype(np.float32)
+    x2 = RNG.randn(6, 5).astype(np.float32)
+    w = np.asarray(m.weight._data)
+    b = np.asarray(m.bias._data)
+    exp = TF.bilinear(torch.tensor(x1), torch.tensor(x2), torch.tensor(w),
+                      torch.tensor(b[0]))
+    np.testing.assert_allclose(m(_t(x1), _t(x2)).numpy(), exp.numpy(),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_sequence_mask():
+    lens = _t(np.array([1, 3, 5], np.int32))
+    m = F.sequence_mask(lens, maxlen=5, dtype="int32").numpy()
+    exp = np.array([[1, 0, 0, 0, 0], [1, 1, 1, 0, 0], [1, 1, 1, 1, 1]])
+    np.testing.assert_array_equal(m, exp)
+
+
+def test_temporal_shift_shapes_and_content():
+    x = np.arange(2 * 2 * 4 * 2 * 2, dtype=np.float32).reshape(4, 4, 2, 2)
+    out = F.temporal_shift(_t(x), seg_num=2, shift_ratio=0.25).numpy()
+    assert out.shape == x.shape
+    x5 = x.reshape(2, 2, 4, 2, 2)
+    np.testing.assert_array_equal(out.reshape(2, 2, 4, 2, 2)[:, 0, 0],
+                                  x5[:, 1, 0])  # fwd-shifted slice
+
+
+def test_spectral_norm_normalizes():
+    w = RNG.randn(8, 6).astype(np.float32) * 5
+    sn = nn.SpectralNorm([8, 6], dim=0, power_iters=20)
+    out = sn(_t(w)).numpy()
+    s = np.linalg.svd(out, compute_uv=False)
+    np.testing.assert_allclose(s[0], 1.0, rtol=1e-2)
+
+
+def test_layers_smoke():
+    """Every new layer constructs and runs on a plausible input."""
+    x4 = _t(RNG.randn(2, 4, 8, 8).astype(np.float32))
+    x3 = _t(RNG.randn(2, 4, 8).astype(np.float32))
+    x5 = _t(RNG.randn(2, 4, 4, 8, 8).astype(np.float32))
+    assert nn.Identity()(x4).shape == [2, 4, 8, 8]
+    assert nn.Softmax2D()(x4).shape == [2, 4, 8, 8]
+    assert nn.MaxPool3D(2)(x5).shape == [2, 4, 2, 4, 4]
+    assert nn.AvgPool3D(2)(x5).shape == [2, 4, 2, 4, 4]
+    assert nn.AdaptiveAvgPool3D(2)(x5).shape == [2, 4, 2, 2, 2]
+    assert nn.AdaptiveMaxPool1D(4)(x3).shape == [2, 4, 4]
+    assert nn.Pad1D([1, 2])(x3).shape == [2, 4, 11]
+    assert nn.Pad3D([1, 1, 1, 1, 1, 1])(x5).shape == [2, 4, 6, 10, 10]
+    assert nn.ZeroPad2D([1, 1, 2, 2])(x4).shape == [2, 4, 12, 10]
+    assert nn.PixelUnshuffle(2)(x4).shape == [2, 16, 4, 4]
+    assert nn.ChannelShuffle(2)(x4).shape == [2, 4, 8, 8]
+    assert nn.UpsamplingNearest2D(scale_factor=2)(x4).shape == [2, 4, 16, 16]
+    assert nn.UpsamplingBilinear2D(size=[16, 16])(x4).shape == [2, 4, 16, 16]
+    assert nn.InstanceNorm1D(4)(x3).shape == [2, 4, 8]
+    assert nn.InstanceNorm3D(4)(x5).shape == [2, 4, 4, 8, 8]
+    assert nn.CosineSimilarity()(x4, x4).shape == [2, 8, 8]
+    assert nn.Dropout3D(0.5)(x5).shape == [2, 4, 4, 8, 8]
+    assert nn.AlphaDropout(0.5)(x3).shape == [2, 4, 8]
+    assert nn.RReLU()(x3).shape == [2, 4, 8]
+    d = nn.LayerDict({"a": nn.Linear(3, 4)})
+    assert "a" in d and len(d) == 1
+    assert nn.Conv1DTranspose(4, 6, 3)(x3).shape[1] == 6
+    assert nn.Conv3DTranspose(4, 6, 3)(x5).shape[1] == 6
+    # loss layers
+    a = _t(RNG.randn(5, 3).astype(np.float32))
+    b = _t(RNG.randn(5, 3).astype(np.float32))
+    yv = _t(np.sign(RNG.randn(5)).astype(np.float32))
+    for layer, args in [
+        (nn.MarginRankingLoss(), (a[:, 0], b[:, 0], yv)),
+        (nn.HingeEmbeddingLoss(), (a, _t(np.sign(RNG.randn(5, 3)).astype(np.float32)))),
+        (nn.CosineEmbeddingLoss(), (a, b, yv)),
+        (nn.TripletMarginLoss(), (a, b, _t(RNG.randn(5, 3).astype(np.float32)))),
+        (nn.TripletMarginWithDistanceLoss(), (a, b, _t(RNG.randn(5, 3).astype(np.float32)))),
+        (nn.SoftMarginLoss(), (a, _t(np.sign(RNG.randn(5, 3)).astype(np.float32)))),
+        (nn.MultiMarginLoss(), (a, _t(RNG.randint(0, 3, 5).astype(np.int32)))),
+        (nn.MultiLabelSoftMarginLoss(), (a, _t((RNG.rand(5, 3) > 0.5).astype(np.float32)))),
+        (nn.PoissonNLLLoss(), (_t(RNG.rand(5).astype(np.float32)), _t(RNG.poisson(1.0, 5).astype(np.float32)))),
+        (nn.GaussianNLLLoss(), (a, b, _t(RNG.rand(5, 3).astype(np.float32) + 0.1))),
+    ]:
+        out = layer(*args)
+        assert np.isfinite(out.numpy()).all(), type(layer).__name__
+
+
+@pytest.mark.parametrize("kw", [{}, {"stride": 2, "padding": 1},
+                                {"stride": 2, "padding": 1, "output_padding": 1},
+                                {"dilation": 2}])
+def test_conv_transpose_matches_torch(kw):
+    """Regression: the convT path double-swapped the kernel IO axes and
+    mis-mapped padding (every output was wrong before this fix)."""
+    x = RNG.rand(1, 4, 8, 8).astype(np.float32)
+    w = RNG.rand(4, 6, 3, 3).astype(np.float32)
+    got = F.conv2d_transpose(_t(x), _t(w), **kw).numpy()
+    exp = TF.conv_transpose2d(torch.tensor(x), torch.tensor(w), **kw).numpy()
+    np.testing.assert_allclose(got, exp, rtol=1e-3, atol=1e-4)
+
+
+def test_conv_transpose_grouped():
+    x = RNG.rand(1, 4, 8, 8).astype(np.float32)
+    w = RNG.rand(4, 3, 3, 3).astype(np.float32)
+    got = F.conv2d_transpose(_t(x), _t(w), groups=2).numpy()
+    exp = TF.conv_transpose2d(torch.tensor(x), torch.tensor(w), groups=2).numpy()
+    np.testing.assert_allclose(got, exp, rtol=1e-3, atol=1e-4)
+
+
+def test_rnn_birnn_wrappers():
+    cell = nn.SimpleRNNCell(8, 16)
+    rnn = nn.RNN(cell)
+    x = _t(RNG.randn(2, 5, 8).astype(np.float32))
+    y, s = rnn(x)
+    assert y.shape == [2, 5, 16]
+    bi = nn.BiRNN(nn.SimpleRNNCell(8, 16), nn.SimpleRNNCell(8, 16))
+    yb, _ = bi(x)
+    assert yb.shape == [2, 5, 32]

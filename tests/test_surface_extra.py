@@ -1,0 +1,276 @@
+"""Tests for the coverage-sweep additions: LBFGS, schedulers, incubate
+segment/graph ops, distributions, jacobian/hessian, saved_tensors_hooks,
+vision zoo/transforms, static working surface, distributed api extras."""
+import numpy as np
+import pytest
+import torch
+
+import paddle_tpu as paddle
+from paddle_tpu import incubate, nn
+
+RNG = np.random.RandomState(5)
+
+
+def _t(a):
+    return paddle.to_tensor(a)
+
+
+# ---------------------------------------------------------------- optimizers
+
+
+def test_lbfgs_converges_on_rosenbrock():
+    x = paddle.create_parameter([2])
+    x._data = x._data * 0 + paddle.to_tensor(np.array([-1.2, 1.0], np.float32))._data
+    opt = paddle.optimizer.LBFGS(learning_rate=1.0, max_iter=60,
+                                 line_search_fn="strong_wolfe", parameters=[x])
+
+    def closure():
+        opt.clear_grad()
+        a = x[0]
+        b = x[1]
+        loss = (1 - a) ** 2 + 100 * (b - a * a) ** 2
+        loss.backward()
+        return loss
+
+    for _ in range(8):
+        loss = opt.step(closure)
+    np.testing.assert_allclose(x.numpy(), [1.0, 1.0], atol=1e-2)
+
+
+def test_cyclic_and_multiplicative_lr():
+    from paddle_tpu.optimizer.lr import CyclicLR, MultiplicativeDecay
+
+    s = CyclicLR(base_learning_rate=0.1, max_learning_rate=0.5, step_size_up=4)
+    vals = []
+    for _ in range(8):
+        vals.append(s())
+        s.step()
+    assert max(vals) > 0.4 and min(vals) <= 0.11
+
+    m = MultiplicativeDecay(0.5, lambda e: 0.5)
+    m.step()
+    m.step()
+    assert abs(m() - 0.125) < 1e-9
+
+
+def test_lookahead_and_model_average():
+    net = nn.Linear(4, 1)
+    inner = paddle.optimizer.SGD(learning_rate=0.1, parameters=net.parameters())
+    opt = incubate.LookAhead(inner, alpha=0.5, k=2)
+    X = RNG.rand(32, 4).astype(np.float32)
+    Y = (X @ np.array([1, 2, 3, 4], np.float32))[:, None]
+    first = None
+    for _ in range(20):
+        loss = ((net(_t(X)) - _t(Y)) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        first = first if first is not None else float(loss.numpy())
+    assert float(loss.numpy()) < first
+
+    ma = incubate.ModelAverage(parameters=net.parameters())
+    w0 = np.asarray(net.weight._data).copy()
+    ma.step()
+    net.weight._data = net.weight._data * 0
+    ma.apply()
+    np.testing.assert_allclose(np.asarray(net.weight._data), w0, rtol=1e-6)
+    ma.restore()
+    np.testing.assert_allclose(np.asarray(net.weight._data), 0)
+
+
+# ------------------------------------------------------------------ incubate
+
+
+def test_segment_ops_match_torch():
+    data = RNG.randn(8, 3).astype(np.float32)
+    ids = np.array([0, 0, 1, 1, 1, 2, 3, 3], np.int32)
+    got = incubate.segment_sum(_t(data), _t(ids)).numpy()
+    exp = torch.zeros(4, 3).index_add_(0, torch.tensor(ids, dtype=torch.int64),
+                                       torch.tensor(data)).numpy()
+    np.testing.assert_allclose(got, exp, rtol=1e-5)
+    gm = incubate.segment_mean(_t(data), _t(ids)).numpy()
+    np.testing.assert_allclose(gm[0], data[:2].mean(0), rtol=1e-5)
+    gx = incubate.segment_max(_t(data), _t(ids)).numpy()
+    np.testing.assert_allclose(gx[2], data[5], rtol=1e-6)
+
+
+def test_graph_send_recv_and_reindex():
+    x = RNG.randn(5, 2).astype(np.float32)
+    src = np.array([0, 1, 2, 3], np.int32)
+    dst = np.array([1, 1, 4, 4], np.int32)
+    out = incubate.graph_send_recv(_t(x), _t(src), _t(dst), "sum").numpy()
+    np.testing.assert_allclose(out[1], x[0] + x[1], rtol=1e-5)
+    np.testing.assert_allclose(out[4], x[2] + x[3], rtol=1e-5)
+
+    # csc graph: edges (row=neighbors) for 3 nodes
+    row = _t(np.array([1, 2, 0, 2, 0, 1], np.int64))
+    colptr = _t(np.array([0, 2, 4, 6], np.int64))
+    neigh, cnt = incubate.graph_sample_neighbors(row, colptr,
+                                                _t(np.array([0, 2], np.int64)))
+    assert cnt.numpy().tolist() == [2, 2]
+    s, d, nodes = incubate.graph_reindex(_t(np.array([0, 2], np.int64)),
+                                         neigh, cnt)
+    assert len(s.numpy()) == 4 and len(d.numpy()) == 4
+    assert set(nodes.numpy().tolist()) >= {0, 2}
+
+
+def test_softmax_mask_fuse():
+    x = RNG.randn(2, 2, 4, 4).astype(np.float32)
+    out = incubate.softmax_mask_fuse_upper_triangle(_t(x)).numpy()
+    assert np.allclose(out.sum(-1), 1.0, atol=1e-5)
+    assert (np.triu(np.ones((4, 4)), 1)[None, None] * out < 1e-6).all()
+
+
+# ------------------------------------------------------------ distributions
+
+
+def test_cauchy_and_transformed():
+    from paddle_tpu import distribution as D
+
+    c = D.Cauchy(0.0, 2.0)
+    np.testing.assert_allclose(float(c.cdf(_t(0.0)).numpy()), 0.5, atol=1e-6)
+    td = D.TransformedDistribution(D.Normal(0.0, 1.0), [D.ExpTransform()])
+    ln = D.LogNormal(0.0, 1.0)
+    for v in (0.5, 1.0, 3.0):
+        np.testing.assert_allclose(float(td.log_prob(_t(v)).numpy()),
+                                   float(ln.log_prob(_t(v)).numpy()), rtol=1e-5)
+    ind = D.Independent(D.Normal(np.zeros(4, np.float32), np.ones(4, np.float32)), 1)
+    lp = ind.log_prob(_t(np.zeros(4, np.float32)))
+    assert lp.shape == []
+
+
+# ------------------------------------------------------------ autograd extra
+
+
+def test_jacobian_and_hessian():
+    from paddle_tpu.autograd import hessian, jacobian
+
+    x = paddle.to_tensor(np.array([1.0, 2.0], np.float32), stop_gradient=False)
+    y = x * x * 3.0
+    jac = jacobian(y, x)
+    np.testing.assert_allclose(jac.numpy(), np.diag([6.0, 12.0]), rtol=1e-5)
+
+    x2 = paddle.to_tensor(np.array([1.0, 2.0], np.float32), stop_gradient=False)
+    out = (x2 * x2 * x2).sum()
+    h = hessian(out, x2)
+    np.testing.assert_allclose(h.numpy(), np.diag([6.0, 12.0]), rtol=1e-5)
+
+
+def test_saved_tensors_hooks_roundtrip():
+    from paddle_tpu.autograd import saved_tensors_hooks
+
+    packed, unpacked = [], []
+
+    def pack(arr):
+        packed.append(1)
+        return np.asarray(arr)  # offload to host
+
+    def unpack(obj):
+        unpacked.append(1)
+        import jax.numpy as jnp
+
+        return jnp.asarray(obj)
+
+    x = paddle.to_tensor(np.array([3.0], np.float32), stop_gradient=False)
+    with saved_tensors_hooks(pack, unpack):
+        y = x * x
+    y.backward()
+    assert packed and unpacked
+    np.testing.assert_allclose(x.grad.numpy(), [6.0], rtol=1e-6)
+
+
+# ----------------------------------------------------------------- vision
+
+
+def test_transforms_functional_golden():
+    import paddle_tpu.vision.transforms as T
+
+    img = (RNG.rand(8, 10, 3) * 255).astype(np.uint8)
+    np.testing.assert_array_equal(T.hflip(img), img[:, ::-1])
+    np.testing.assert_array_equal(T.vflip(img), img[::-1])
+    assert T.center_crop(img, 4).shape == (4, 4, 3)
+    assert T.pad(img, 2).shape == (12, 14, 3)
+    b = T.adjust_brightness(img, 1.5)
+    assert b.dtype == np.uint8 and b.mean() >= img.mean()
+    g = T.to_grayscale(img, 3)
+    assert np.allclose(g[..., 0], g[..., 1])
+    r = T.rotate(img, 90)
+    assert r.shape == img.shape
+    e = T.erase(img, 1, 1, 3, 3, 0)
+    assert (e[1:4, 1:4] == 0).all()
+
+
+def test_small_zoo_trains_one_step():
+    import paddle_tpu.vision.models as m
+
+    net = m.squeezenet1_1(num_classes=4)
+    opt = paddle.optimizer.SGD(learning_rate=0.01, parameters=net.parameters())
+    x = _t(RNG.rand(2, 3, 32, 32).astype(np.float32))
+    y = _t(np.array([0, 1], np.int64))
+    loss = nn.functional.cross_entropy(net(x), y).mean()
+    loss.backward()
+    opt.step()
+    assert np.isfinite(float(loss.numpy()))
+
+
+# ------------------------------------------------------------------ static
+
+
+def test_static_working_surface():
+    import paddle_tpu.static as st
+
+    net = nn.Linear(3, 2)
+    ema = st.ExponentialMovingAverage(0.5)
+    ema.update(net.parameters())
+    w0 = np.asarray(net.weight._data).copy()
+    net.weight._data = net.weight._data + 1.0
+    ema.update()
+    ema.apply()
+    expected = 0.5 * w0 + 0.5 * (w0 + 1.0)
+    np.testing.assert_allclose(np.asarray(net.weight._data), expected, rtol=1e-5)
+    ema.restore()
+
+    x = paddle.to_tensor(np.array([2.0], np.float32), stop_gradient=False)
+    y = (x * x).sum()
+    (g,) = st.gradients(y, [x])
+    np.testing.assert_allclose(g.numpy(), [4.0])
+
+    v = st.create_global_var([2, 2], 1.5, "float32")
+    np.testing.assert_allclose(v.numpy(), np.full((2, 2), 1.5))
+    with pytest.raises(NotImplementedError):
+        st.Program()
+
+
+# -------------------------------------------------------------- distributed
+
+
+def test_parallel_env_and_backend():
+    import paddle_tpu.distributed as dist
+
+    env = dist.ParallelEnv()
+    assert env.world_size >= 1
+    assert dist.get_backend() == "XCCL"
+    assert dist.is_available()
+
+
+def test_in_memory_dataset(tmp_path):
+    import paddle_tpu.distributed as dist
+
+    f = tmp_path / "data.txt"
+    f.write_text("\n".join(f"{i} {i*2}" for i in range(10)))
+    ds = dist.InMemoryDataset()
+    ds.init(batch_size=3, parse_fn=lambda line: tuple(map(int, line.split())))
+    ds.set_filelist([str(f)])
+    ds.load_into_memory()
+    assert ds.get_memory_data_size() == 10
+    ds.global_shuffle()
+    batches = list(ds)
+    assert sum(len(b) for b in batches) == 10
+
+
+def test_metric_accuracy_topk():
+    scores = np.array([[0.1, 0.9, 0.0], [0.8, 0.05, 0.15]], np.float32)
+    label = np.array([[1], [2]])
+    a1 = float(paddle.metric.accuracy(_t(scores), _t(label), k=1).numpy())
+    a2 = float(paddle.metric.accuracy(_t(scores), _t(label), k=2).numpy())
+    assert a1 == 0.5 and a2 == 1.0
