@@ -47,6 +47,10 @@ from .core.tensor import Tensor, to_tensor  # noqa: F401
 from .ops import *  # noqa: F401,F403
 from .ops import creation, manipulation, math, random  # noqa: F401
 from . import fft  # noqa: F401
+from . import audio  # noqa: F401
+from . import text  # noqa: F401
+from . import hub  # noqa: F401
+from . import onnx  # noqa: F401
 from . import signal  # noqa: F401
 from . import linalg  # noqa: F401
 
@@ -57,6 +61,7 @@ from . import amp  # noqa: F401,E402
 from . import io  # noqa: F401,E402
 from . import jit  # noqa: F401,E402
 from . import device  # noqa: F401,E402
+from . import utils  # noqa: F401,E402
 from .framework import io as framework_io  # noqa: F401,E402
 from .framework.io import load, save  # noqa: F401,E402
 from . import metric  # noqa: F401,E402

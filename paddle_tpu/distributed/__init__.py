@@ -48,6 +48,9 @@ from . import sharding  # noqa: F401
 from .store import TCPStore  # noqa: F401
 from . import ps  # noqa: F401
 from . import io  # noqa: F401
+from . import auto_parallel  # noqa: F401
+from .auto_parallel import shard_tensor, shard_op  # noqa: F401
+from . import rpc  # noqa: F401
 from .api_extra import (  # noqa: F401
     CountFilterEntry,
     InMemoryDataset,

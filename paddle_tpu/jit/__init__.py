@@ -98,6 +98,8 @@ class StaticFunction:
         self._jit_fn = _compiled
 
     def __call__(self, *args, **kwargs):
+        if not _to_static_enabled:
+            return self._fn(*args, **kwargs)  # eager fallback (debugging)
         if self._jit_fn is None:
             self._build()
         param_arrays = tuple(p._data for p in self._param_objs)

@@ -272,14 +272,21 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0, reduction
 
 
 def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
-              fastemit_lambda=0.001, reduction="mean", name=None):
+              fastemit_lambda=0.0, reduction="mean", name=None):
     """RNN-T (transducer) loss: log-space alpha recursion over the (t, u)
     lattice (ref:python/paddle/nn/functional/loss.py rnnt_loss wrapping
     warprnnt). Scan over t; the within-row emit recursion over u is a second
     scan — fully XLA-compiled.
 
     input: [B, T, U+1, V] log-softmax joint scores; label: [B, U].
+    FastEmit gradient regularization is a warprnnt backward-pass rescaling
+    with no pure-loss equivalent; it is not implemented — a nonzero
+    ``fastemit_lambda`` raises rather than silently diverging.
     """
+    if fastemit_lambda:
+        raise NotImplementedError(
+            "rnnt_loss fastemit_lambda: FastEmit rescales the backward pass "
+            "inside warprnnt; not supported — pass fastemit_lambda=0")
 
     def _rnnt(lp, lab, in_len, lab_len, *, blank):
         B, T, U1, V = lp.shape

@@ -178,7 +178,17 @@ def _max_pool_with_mask(x, kernel_size, stride, padding, n, data_format,
                         ceil_mode=False):
     """Max pool that also returns the flat argmax index per window
     (ref max_poolNd(return_mask=True) contract: index into the flattened
-    input spatial volume)."""
+    input spatial volume). Channel-last layouts are transposed through the
+    channel-first kernel (flat spatial indices are layout-independent)."""
+    if data_format in ("NHWC", "NLC", "NDHWC"):
+        from ...ops import manipulation as _M
+
+        to_cf = [0, n + 1] + list(range(1, n + 1))
+        to_cl = [0] + list(range(2, n + 2)) + [1]
+        out, mask = _max_pool_with_mask(
+            _M.transpose(x, to_cf), kernel_size, stride, padding, n,
+            "NC" + "DHW"[3 - n:], ceil_mode)
+        return _M.transpose(out, to_cl), _M.transpose(mask, to_cl)
     ksize = _norm_tuple(kernel_size, n)
     stride_t = _norm_tuple(stride if stride is not None else kernel_size, n)
     pad = _norm_padding(padding, n, stride_t, (1,) * n, ksize)

@@ -447,7 +447,7 @@ class CTCLoss(_LossLayer):
 
 
 class RNNTLoss(_LossLayer):
-    def __init__(self, blank=0, fastemit_lambda=0.001, reduction="mean",
+    def __init__(self, blank=0, fastemit_lambda=0.0, reduction="mean",
                  name=None):
         super().__init__(F.rnnt_loss, blank=blank,
                          fastemit_lambda=fastemit_lambda, reduction=reduction)

@@ -1,0 +1,331 @@
+"""Ecosystem batch tests: auto_parallel Engine, RPC, audio features, text
+Viterbi, hub, onnx shim, amp.debugging, device memory stats, utils.monitor."""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+RNG = np.random.RandomState(9)
+
+
+def _t(a):
+    return paddle.to_tensor(a)
+
+
+# --------------------------------------------------------- auto_parallel
+
+
+def test_engine_fit_on_mesh():
+    from paddle_tpu.distributed.auto_parallel import Engine, Strategy
+
+    net = nn.Sequential(nn.Linear(8, 32), nn.ReLU(), nn.Linear(32, 1))
+    opt = paddle.optimizer.Adam(learning_rate=5e-3, parameters=net.parameters())
+    eng = Engine(net, loss=lambda out, y: ((out - y) ** 2).mean(),
+                 optimizer=opt, strategy=Strategy(dp_degree=4, mp_degree=2))
+    eng.prepare()
+
+    X = RNG.rand(64, 8).astype(np.float32)
+    w = RNG.rand(8).astype(np.float32)
+    Y = (X @ w)[:, None]
+    data = [(_t(X[i:i + 16]), _t(Y[i:i + 16])) for i in range(0, 64, 16)]
+    hist = eng.fit(data, epochs=8, verbose=0)
+    assert hist[-1] < hist[0] * 0.5
+    res = eng.evaluate(data)
+    assert res["loss"] < hist[0]
+
+
+def test_shard_tensor_and_op():
+    from paddle_tpu.distributed import shard_op, shard_tensor
+    from paddle_tpu.distributed.mesh import init_hybrid_mesh
+
+    init_hybrid_mesh(dp=4, mp=2)
+    x = _t(RNG.rand(8, 16).astype(np.float32))
+    sx = shard_tensor(x, shard_spec=["data", None])
+    assert sx.shape == [8, 16]
+
+    matmul_sharded = shard_op(paddle.matmul,
+                              out_shard_specs=[["data", "model"]])
+    w = _t(RNG.rand(16, 4).astype(np.float32))
+    out = matmul_sharded(sx, w)
+    np.testing.assert_allclose(out.numpy(), x.numpy() @ w.numpy(), rtol=1e-5)
+
+
+def test_suggest_mesh():
+    from paddle_tpu.distributed.auto_parallel import suggest_mesh
+
+    s = suggest_mesh(64, param_count=1_300_000_000, hbm_per_chip=16e9)
+    assert s.degree <= 64
+    # 1.3B params * 16B = 20.8GB > one chip: must shard over >1 device
+    assert s.mp_degree * s.sharding_degree >= 2
+    s2 = suggest_mesh(8, param_count=10_000_000)
+    assert s2.dp_degree == 8  # small model: pure DP
+
+
+# ------------------------------------------------------------------ rpc
+
+
+def test_rpc_two_workers():
+    script = r"""
+import os, sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+import paddle_tpu.distributed.rpc as rpc
+
+rank = int(os.environ["PADDLE_TRAINER_ID"])
+rpc.init_rpc(f"worker{rank}")
+if rank == 0:
+    out = rpc.rpc_sync("worker1", eval, args=("6*7",))
+    assert out == 42, out
+    fut = rpc.rpc_async("worker1", pow, args=(2, 10))
+    assert fut.result() == 1024
+    info = rpc.get_worker_info("worker1")
+    assert info.name == "worker1"
+    print("RPC_OK", flush=True)
+import time
+time.sleep(1.0)  # let peer finish its calls before tearing down
+rpc.shutdown()
+"""
+    from paddle_tpu.distributed.spawn import _free_port
+
+    port = _free_port()
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH="/root/repo",
+               PADDLE_TRAINERS_NUM="2", PADDLE_MASTER=f"127.0.0.1:{port}")
+    procs = []
+    for rank in range(2):
+        e = dict(env, PADDLE_TRAINER_ID=str(rank))
+        procs.append(subprocess.Popen([sys.executable, "-c", script], env=e,
+                                      stdout=subprocess.PIPE, text=True))
+    outs = [p.communicate(timeout=120)[0] for p in procs]
+    assert all(p.returncode == 0 for p in procs)
+    assert "RPC_OK" in outs[0]
+
+
+# ---------------------------------------------------------------- audio
+
+
+def test_audio_features_match_librosa_free_reference():
+    import paddle_tpu.audio as A
+
+    sr = 16000
+    t = np.linspace(0, 1, sr, endpoint=False)
+    wav = np.sin(2 * np.pi * 440 * t).astype(np.float32)
+
+    spec = A.Spectrogram(n_fft=512, hop_length=256)(_t(wav)).numpy()
+    assert spec.shape[0] == 257
+    # energy concentrated at the 440 Hz bin
+    bin_440 = int(round(440 * 512 / sr))
+    assert np.argmax(spec.mean(axis=1)) in range(bin_440 - 1, bin_440 + 2)
+
+    mel = A.MelSpectrogram(sr=sr, n_fft=512, n_mels=40)(_t(wav))
+    assert mel.shape[0] == 40
+    logmel = A.LogMelSpectrogram(sr=sr, n_fft=512, n_mels=40)(_t(wav))
+    assert np.isfinite(logmel.numpy()).all()
+    mfcc = A.MFCC(sr=sr, n_mfcc=13, n_fft=512)(_t(wav))
+    assert mfcc.shape[0] == 13
+
+
+def test_fbank_dct_matrices():
+    from paddle_tpu.audio import compute_fbank_matrix, create_dct
+
+    fb = compute_fbank_matrix(sr=16000, n_fft=512, n_mels=40)
+    assert fb.shape == (40, 257)
+    assert (fb >= 0).all() and fb.sum(axis=1).min() > 0
+    dct = create_dct(13, 40)
+    # orthonormal rows
+    np.testing.assert_allclose(dct @ dct.T, np.eye(13), atol=1e-6)
+
+
+# ----------------------------------------------------------------- text
+
+
+def test_viterbi_matches_bruteforce():
+    import itertools
+
+    from paddle_tpu.text import ViterbiDecoder
+
+    B, T, N = 2, 6, 4
+    em = RNG.rand(B, T, N).astype(np.float32)
+    tr = RNG.rand(N, N).astype(np.float32)
+    dec = ViterbiDecoder(_t(tr), include_bos_eos_tag=False)
+    score, path = dec(_t(em), _t(np.array([T, T], np.int32)))
+    for b in range(B):
+        best, bp = -1e9, None
+        for p in itertools.product(range(N), repeat=T):
+            s = em[b, 0, p[0]] + sum(
+                tr[p[i - 1], p[i]] + em[b, i, p[i]] for i in range(1, T))
+            if s > best:
+                best, bp = s, p
+        np.testing.assert_allclose(float(score.numpy()[b]), best, rtol=1e-5)
+        assert list(path.numpy()[b]) == list(bp)
+
+
+# ------------------------------------------------------------ hub / onnx
+
+
+def test_hub_local(tmp_path):
+    (tmp_path / "hubconf.py").write_text(
+        "def tiny(n=2):\n    'a tiny linear'\n"
+        "    import paddle_tpu.nn as nn\n    return nn.Linear(n, 1)\n")
+    assert paddle.hub.list(str(tmp_path)) == ["tiny"]
+    assert "tiny linear" in paddle.hub.help(str(tmp_path), "tiny")
+    m = paddle.hub.load(str(tmp_path), "tiny", n=5)
+    assert m.weight.shape == [5, 1]
+    with pytest.raises(NotImplementedError):
+        paddle.hub.list("user/repo", source="github")
+
+
+def test_onnx_export_writes_artifact(tmp_path):
+    from paddle_tpu.static import InputSpec
+
+    net = nn.Linear(4, 2)
+    net.eval()
+    with pytest.warns(UserWarning, match="StableHLO"):
+        paddle.onnx.export(net, str(tmp_path / "m"),
+                           input_spec=[InputSpec([None, 4], "float32")])
+    from paddle_tpu import jit
+
+    loaded = jit.load(str(tmp_path / "m"))
+    x = _t(RNG.rand(3, 4).astype(np.float32))
+    out = loaded(x)
+    out = out[0] if isinstance(out, (list, tuple)) else out
+    np.testing.assert_allclose(out.numpy(), net(x).numpy(), rtol=1e-5)
+
+
+# ------------------------------------------- amp.debugging / device / utils
+
+
+def test_amp_debugging_check_numerics():
+    from paddle_tpu.amp import debugging as dbg
+
+    n_nan, n_inf, n_zero = dbg.check_numerics(_t(np.array([1.0, 0.0, 2.0])))
+    assert (int(n_nan.numpy()), int(n_inf.numpy()), int(n_zero.numpy())) == (0, 0, 1)
+    with pytest.raises(FloatingPointError):
+        dbg.check_numerics(_t(np.array([np.nan, 1.0])), op_type="bad_op")
+
+
+def test_amp_operator_stats(capsys):
+    from paddle_tpu.amp import debugging as dbg
+
+    x = _t(np.ones(4, np.float32))
+    with dbg.collect_operator_stats():
+        paddle.tanh(x)
+        paddle.tanh(x)
+        paddle.add(x, x)
+    out = capsys.readouterr().out
+    assert "tanh: 2 calls" in out
+
+
+def test_device_memory_stats():
+    a = paddle.device.memory_allocated()
+    assert a >= 0
+    assert paddle.device.max_memory_allocated() >= a or a == 0
+    paddle.device.cuda.synchronize()
+
+
+def test_utils_monitor_and_run_check(capsys):
+    from paddle_tpu.utils import monitor, run_check, unique_name
+
+    monitor.reset()
+    monitor.add("steps", 3)
+    monitor.max("peak", 7)
+    monitor.max("peak", 5)
+    assert monitor.get("steps") == 3 and monitor.get("peak") == 7
+    assert monitor.stats()["steps"] == 3
+    n1, n2 = unique_name.generate("fc"), unique_name.generate("fc")
+    assert n1 != n2
+    assert run_check()
+
+
+# ------------------------------------------------ review-fix regressions
+
+
+def test_viterbi_bos_eos_semantics():
+    """Default include_bos_eos_tag=True: row N-2 = start scores, col N-1 =
+    stop scores must shape the decoded path."""
+    import itertools
+
+    from paddle_tpu.text import viterbi_decode
+
+    B, T, N = 1, 3, 4  # tags 0,1 real; 2=BOS, 3=EOS
+    em = RNG.rand(B, T, N).astype(np.float32)
+    tr = RNG.rand(N, N).astype(np.float32)
+    score, path = viterbi_decode(_t(em), _t(tr),
+                                 _t(np.array([T], np.int32)),
+                                 include_bos_eos_tag=True)
+    best, bp = -1e9, None
+    for p in itertools.product(range(N), repeat=T):
+        s = tr[N - 2, p[0]] + em[0, 0, p[0]]
+        for i in range(1, T):
+            s += tr[p[i - 1], p[i]] + em[0, i, p[i]]
+        s += tr[p[-1], N - 1]
+        if s > best:
+            best, bp = s, p
+    np.testing.assert_allclose(float(score.numpy()[0]), best, rtol=1e-5)
+    assert list(path.numpy()[0]) == list(bp)
+
+
+def test_max_pool_mask_nhwc():
+    import torch
+    import torch.nn.functional as TF
+
+    from paddle_tpu.nn import functional as F
+
+    x = RNG.rand(2, 6, 6, 3).astype(np.float32)  # NHWC
+    o, m = F.max_pool2d(_t(x), 2, 2, data_format="NHWC", return_mask=True)
+    xt = torch.tensor(x.transpose(0, 3, 1, 2))
+    to, ti = TF.max_pool2d(xt, 2, 2, return_indices=True)
+    np.testing.assert_allclose(o.numpy().transpose(0, 3, 1, 2), to.numpy(),
+                               rtol=1e-6)
+    np.testing.assert_array_equal(m.numpy().transpose(0, 3, 1, 2), ti.numpy())
+
+
+def test_enable_to_static_fallback():
+    from paddle_tpu import jit
+
+    calls = []
+
+    @jit.to_static
+    def f(x):
+        calls.append(1)  # python side effect: only visible when eager
+        return x * 2
+
+    x = _t(np.ones(3, np.float32))
+    f(x)
+    n_traced = len(calls)  # traced once
+    jit.enable_to_static(False)
+    try:
+        f(x)
+        f(x)
+        assert len(calls) == n_traced + 2  # ran eagerly both times
+    finally:
+        jit.enable_to_static(True)
+
+
+def test_engine_fit_empty_data():
+    from paddle_tpu.distributed.auto_parallel import Engine
+
+    net = nn.Linear(2, 1)
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=net.parameters())
+    eng = Engine(net, loss=lambda o, y: ((o - y) ** 2).mean(), optimizer=opt)
+    eng.prepare()
+    assert eng.fit([], epochs=2, verbose=0) == []
+
+
+def test_rnnt_fastemit_rejected():
+    from paddle_tpu.nn import functional as F
+
+    with pytest.raises(NotImplementedError, match="fastemit"):
+        F.rnnt_loss(_t(np.zeros((1, 2, 2, 3), np.float32)),
+                    _t(np.zeros((1, 1), np.int32)),
+                    _t(np.array([2], np.int32)), _t(np.array([1], np.int32)),
+                    fastemit_lambda=0.01)
+
+
+def test_device_id_out_of_range():
+    with pytest.raises(ValueError, match="out of range"):
+        paddle.device.memory_allocated(device_id=99)
