@@ -303,33 +303,95 @@ def save(layer, path, input_spec=None, **configs):
 
         was_training = layer.training
         layer.eval()
-        params, buffers = layer.functional_state()
-        objs = list(params.values()) + list(buffers.values())
-        arrays = [p._data for p in objs]
+        try:
+            params, buffers = layer.functional_state()
+            objs = list(params.values()) + list(buffers.values())
+            arrays = [p._data for p in objs]
 
-        def fwd(param_arrays, *inputs):
-            with _swap_data(objs, list(param_arrays)):
-                with rng.key_guard(jax.random.key(0)):
-                    out = layer(*[Tensor(i) for i in inputs])
-            return out._data if isinstance(out, Tensor) else out
+            def fwd(param_arrays, *inputs):
+                with _swap_data(objs, list(param_arrays)):
+                    with rng.key_guard(jax.random.key(0)):
+                        out = layer(*[Tensor(i) for i in inputs])
+                return out._data if isinstance(out, Tensor) else out
 
-        # One shared scope; unnamed specs share per-axis symbols (d0, d1, ...)
-        # so the common "all inputs share the dynamic batch/seq size" case
-        # exports with the dims constrained equal. A spec with name= gets its
-        # own symbols (name_0, ...) for genuinely independent dynamic dims.
-        scope = jexport.SymbolicScope()
-        sds = [s.to_sds(scope=scope, prefix=(f"{s.name}_" if s.name else "d"))
-               if isinstance(s, InputSpec) else s
-               for s in input_spec]
-        param_sds = [jax.ShapeDtypeStruct(a.shape, a.dtype) for a in arrays]
-        exp = jexport.export(jax.jit(fwd))(param_sds, *sds)
-        with open(path + ".pdmodel", "wb") as f:
-            pickle.dump({
-                "stablehlo": exp.serialize(),
-                "param_keys": list(params.keys()) + list(buffers.keys()),
-            }, f, protocol=4)
-        if was_training:
-            layer.train()
+            # One shared scope; unnamed specs share per-axis symbols (d0, d1,
+            # ...) so the common "all inputs share the dynamic batch/seq size"
+            # case exports with the dims constrained equal. A spec with name=
+            # gets its own symbols (name_0, ...) for genuinely independent
+            # dynamic dims.
+            scope = jexport.SymbolicScope()
+            sds = [s.to_sds(scope=scope, prefix=(f"{s.name}_" if s.name else "d"))
+                   if isinstance(s, InputSpec) else s
+                   for s in input_spec]
+            param_sds = [jax.ShapeDtypeStruct(a.shape, a.dtype) for a in arrays]
+            exp = jexport.export(jax.jit(fwd))(param_sds, *sds)
+            with open(path + ".pdmodel", "wb") as f:
+                pickle.dump({
+                    "stablehlo": exp.serialize(),
+                    "param_keys": list(params.keys()) + list(buffers.keys()),
+                }, f, protocol=4)
+
+            # Native deploy artifact for the C++ PJRT runner (pjrt_runner.cc):
+            # only for fully-static specs (C/C++ serving is static-shape;
+            # dynamic batch stays on the python TranslatedLayer path). Lower
+            # for TPU when possible so device custom-calls are baked for the
+            # serving target.
+            static = all(
+                not isinstance(s, InputSpec)
+                or all(d is not None and d != -1 for d in s.shape)
+                for s in input_spec)
+            if not static and configs.get("native") is True:
+                raise ValueError(
+                    "native=True requires a fully-static input_spec: the C++ "
+                    "deploy artifact is static-shape (dynamic dims stay on "
+                    "the python TranslatedLayer path)")
+            if static and configs.get("native", True):
+                try:
+                    _write_pdnative(path, fwd, param_sds, sds, arrays,
+                                    list(params.keys()) + list(buffers.keys()),
+                                    exp)
+                except Exception:
+                    if configs.get("native") is True:  # explicit: surface
+                        raise
+        finally:
+            if was_training:
+                layer.train()
+
+
+def _write_pdnative(path, fwd, param_sds, sds, arrays, param_keys, exp_host):
+    """Emit ``path.pdnative`` — the self-contained C++ deploy artifact
+    (StableHLO bytecode + compile options + weights + I/O specs) consumed by
+    ``native/csrc/pjrt_runner.cc``. Prefers a TPU-platform lowering; falls
+    back to the host export when cross-lowering fails."""
+    import numpy as np
+    from jax import export as jexport
+
+    from paddle_tpu.native import pdnative
+
+    exp = exp_host
+    try:
+        exp = jexport.export(jax.jit(fwd), platforms=["tpu"])(param_sds, *sds)
+    except Exception:
+        pass
+
+    n_params = len(arrays)
+    args = []
+    for i in sorted(exp.module_kept_var_idx):
+        if i < n_params:
+            a = np.asarray(arrays[i])
+            args.append(pdnative.ArgSpec(param_keys[i], a.dtype, a.shape,
+                                         a.tobytes()))
+        else:
+            s = sds[i - n_params]
+            args.append(pdnative.ArgSpec(f"input_{i - n_params}",
+                                         np.dtype(s.dtype), s.shape))
+    outs = [pdnative.ArgSpec(f"output_{j}", np.dtype(o.dtype), o.shape)
+            for j, o in enumerate(exp.out_avals)]
+    pdnative.write(path + ".pdnative",
+                   platform=exp.platforms[0],
+                   compile_options=pdnative.default_compile_options(),
+                   stablehlo=exp.mlir_module_serialized,
+                   args=args, outputs=outs)
 
 
 class TranslatedLayer:

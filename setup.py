@@ -23,7 +23,7 @@ class BuildPyWithNative(build_py):
             out_dir.mkdir(parents=True, exist_ok=True)
             out = out_dir / "libpaddle_tpu_native.so"
             cmd = ["g++", "-O2", "-fPIC", "-shared", "-std=c++17",
-                   "-pthread", "-o", str(out)] + sources
+                   "-pthread", "-o", str(out)] + sources + ["-ldl"]
             subprocess.run(cmd, check=True)
 
 
