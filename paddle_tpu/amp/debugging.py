@@ -54,7 +54,8 @@ def disable_tensor_checker():
 
 def check_numerics(tensor, op_type="", var_name="", debug_mode=None):
     """Scan a tensor for NaN/Inf; returns (num_nan, num_inf, num_zero) like
-    the reference's check_numerics op."""
+    the reference's check_numerics op. An explicit ``debug_mode`` overrides
+    the global flag: ABORT raises, the report-only modes warn."""
     arr = np.asarray(tensor._data if isinstance(tensor, Tensor) else tensor)
     n_nan = int(np.isnan(arr).sum())
     n_inf = int(np.isinf(arr).sum())
@@ -62,7 +63,11 @@ def check_numerics(tensor, op_type="", var_name="", debug_mode=None):
     if n_nan or n_inf:
         msg = (f"check_numerics: op={op_type or '?'} var={var_name or '?'} "
                f"nan={n_nan} inf={n_inf}")
-        if flags.flag("check_nan_inf_level") == 0:
+        if debug_mode is not None:
+            abort = debug_mode == DebugMode.CHECK_NAN_INF_AND_ABORT
+        else:
+            abort = flags.flag("check_nan_inf_level") == 0
+        if abort:
             raise FloatingPointError(msg)
         print("WARNING:", msg)
     import jax.numpy as jnp

@@ -17,7 +17,8 @@ from .. import nn, signal
 from ..core.dispatch import apply
 from ..core.tensor import Tensor
 from . import functional  # noqa: F401
-from .functional import compute_fbank_matrix, create_dct, hz_to_mel, mel_to_hz
+from .functional import (compute_fbank_matrix, create_dct, get_window,
+                         hz_to_mel, mel_to_hz)
 
 __all__ = ["Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC",
            "functional", "compute_fbank_matrix", "create_dct", "hz_to_mel",
@@ -35,9 +36,10 @@ class Spectrogram(nn.Layer):
         self.power = power
         self.center = center
         self.pad_mode = pad_mode
-        w = {"hann": np.hanning, "hamming": np.hamming,
-             "blackman": np.blackman}.get(window, np.hanning)(self.win_length)
-        self.register_buffer("window", Tensor(jnp.asarray(w.astype(np.float32))))
+        # periodic (fftbins) window via the shared helper — the STFT
+        # contract; unknown names raise instead of silently becoming hann
+        w = get_window(window, self.win_length, fftbins=True)
+        self.register_buffer("window", Tensor(jnp.asarray(w)))
 
     def forward(self, x):
         spec = signal.stft(x, self.n_fft, self.hop_length, self.win_length,

@@ -59,9 +59,13 @@ def shard_tensor(x, process_mesh=None, shard_spec=None, mesh=None,
     spec = shard_spec if shard_spec is not None else placements
     if spec is None:
         return x
-    if getattr(x, "stop_gradient", True) is False or getattr(x, "persistable", False):
-        return shard_parameter(x, *spec)
-    return _constraint(x, *spec)
+    # Route on tracedness, not tensor kind: under jit only a sharding
+    # constraint reaches the compiled program (shard_parameter's device_put
+    # is a deliberate eager no-op when traced), while eager tensors —
+    # parameter or activation — want the actual placement.
+    if getattr(x, "_is_traced", lambda: False)():
+        return _constraint(x, *spec)
+    return shard_parameter(x, *spec)
 
 
 def shard_op(op, process_mesh=None, in_shard_specs=None, out_shard_specs=None):
@@ -119,19 +123,30 @@ def suggest_mesh(n_devices: int, param_count: int, hbm_per_chip: float = 16e9,
     need = param_count * 16.0
     shard_needed = int(np.ceil(need / hbm_per_chip))
     s = Strategy()
+
+    def pow2_div(n):  # largest power of two dividing n
+        return n & -n
+
+    def take(want, limit):
+        # smallest power of two >= want, capped at limit (limit is a power
+        # of two dividing the remaining devices, so the product of all axis
+        # degrees always divides n_devices exactly — no overshoot)
+        p = 1
+        while p < want and p * 2 <= limit:
+            p *= 2
+        return p
+
+    remaining = n_devices
     # prefer mp<=8 (one ICI ring), remainder via zero-sharding
-    mp = 1
-    while mp < min(8, n_devices) and shard_needed > mp:
-        mp *= 2
-    s.mp_degree = mp
-    rest = max(shard_needed // mp, 1)
-    sh = 1
-    while sh < rest and mp * sh < n_devices:
-        sh *= 2
-    s.sharding_degree = sh
-    if seq_len >= 32768 and n_devices // (mp * sh) >= 2:
+    s.mp_degree = take(shard_needed, min(8, pow2_div(remaining)))
+    remaining //= s.mp_degree
+    s.sharding_degree = take(
+        -(-shard_needed // s.mp_degree), pow2_div(remaining))
+    remaining //= s.sharding_degree
+    if seq_len >= 32768 and remaining % 2 == 0 and remaining >= 2:
         s.sep_degree = 2
-    s.dp_degree = max(n_devices // (s.mp_degree * s.sharding_degree * s.sep_degree), 1)
+        remaining //= 2
+    s.dp_degree = max(remaining, 1)
     return s
 
 

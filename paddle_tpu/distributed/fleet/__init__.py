@@ -215,6 +215,8 @@ def save_persistables(executor=None, dirname="", main_program=None, mode=0):
     """Dump every registered sparse table shard set under ``dirname``."""
     import os
 
+    if not dirname:
+        raise ValueError("save_persistables requires a dirname")
     os.makedirs(dirname, exist_ok=True)
     for tid, client in _registered_tables.items():
         client.save(os.path.join(dirname, f"table{tid}"))

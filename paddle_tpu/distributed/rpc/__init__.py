@@ -71,13 +71,17 @@ class _RpcState:
         self.rank = rank
         self.world_size = world_size
         self.store = store
-        self.server = _Server(("0.0.0.0", 0), _Handler)
+        # bind the advertised interface only (default loopback): the handler
+        # executes pickled callables, so listening wider than the rendezvous
+        # contract would hand code execution to anything that can reach the
+        # ephemeral port
+        ip = os.environ.get("PADDLE_RPC_IP", "127.0.0.1")
+        self.server = _Server((ip, 0), _Handler)
         self.port = self.server.server_address[1]
         self.thread = threading.Thread(target=self.server.serve_forever,
                                        daemon=True)
         self.thread.start()
         self.pool = ThreadPoolExecutor(max_workers=8)
-        ip = os.environ.get("PADDLE_RPC_IP", "127.0.0.1")
         store.set(f"rpc/{name}", f"{rank}|{ip}|{self.port}")
         store.set(f"rpc/byrank/{rank}", name)
         self.workers: Dict[str, WorkerInfo] = {}
