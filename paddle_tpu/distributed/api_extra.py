@@ -26,11 +26,14 @@ def gather(tensor, gather_list=None, dst: int = 0, group: Optional[Group] = None
     the non-dst copies are DCE'd."""
     tmp: List = []
     C.all_gather(tmp, tensor, group=group)
-    g = group or C._get_default_group()
-    if gather_list is not None and g.rank == dst:
+    # single-controller SPMD: every rank materializes the gathered value —
+    # there is no per-process dst to special-case; unused non-dst copies
+    # disappear in compilation
+    if gather_list is not None:
         gather_list.clear()
         gather_list.extend(tmp)
-    return gather_list if g.rank == dst else None
+        return gather_list
+    return tmp
 
 
 class _Task:
