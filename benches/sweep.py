@@ -30,14 +30,18 @@ def main():
     best = None
     for point in POINTS:
         env = dict(os.environ, **point, BENCH_WATCHDOG="900")
-        r = subprocess.run([sys.executable, BENCH], env=env,
-                           capture_output=True, text=True, timeout=1200)
-        line = (r.stdout.strip().splitlines() or [""])[-1]
         try:
-            rec = json.loads(line)
-        except json.JSONDecodeError:
-            rec = {"error": f"unparseable output: {line!r}",
-                   "stderr": r.stderr[-500:]}
+            r = subprocess.run([sys.executable, BENCH], env=env,
+                               capture_output=True, text=True, timeout=1200)
+            line = (r.stdout.strip().splitlines() or [""])[-1]
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                rec = {"error": f"unparseable output: {line!r}",
+                       "stderr": r.stderr[-500:]}
+        except subprocess.TimeoutExpired:
+            # even the in-process watchdog got wedged: treat like a hang
+            rec = {"error": "watchdog: bench subprocess exceeded 1200s"}
         rec["sweep_point"] = point
         print(json.dumps(rec), flush=True)
         with open(OUT, "a") as f:

@@ -32,6 +32,10 @@ def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean"
                 lbl = jnp.squeeze(lbl, axis=axis)
             lbl = lbl.astype(jnp.int32)
             n_cls = logits.shape[axis]
+            # ignore_index rows are masked out below, but the gather must not
+            # see the out-of-range index first: fill-mode gather yields NaN,
+            # and NaN*0 stays NaN through the mask
+            safe_lbl = jnp.where(lbl == ignore_index, 0, lbl)
             if smooth > 0.0:
                 if logp is None:
                     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=axis)
@@ -49,11 +53,11 @@ def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean"
                 lse = jax.scipy.special.logsumexp(
                     logits.astype(jnp.float32), axis=axis)
                 picked = jnp.take_along_axis(
-                    logits, jnp.expand_dims(lbl, axis), axis=axis
+                    logits, jnp.expand_dims(safe_lbl, axis), axis=axis
                 ).squeeze(axis).astype(jnp.float32)
                 loss = lse - picked
             else:
-                loss = -jnp.take_along_axis(logp, jnp.expand_dims(lbl, axis), axis=axis).squeeze(axis)
+                loss = -jnp.take_along_axis(logp, jnp.expand_dims(safe_lbl, axis), axis=axis).squeeze(axis)
             mask = lbl != ignore_index
             wt = mask.astype(jnp.float32)
             if has_w:

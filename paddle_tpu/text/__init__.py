@@ -1,9 +1,9 @@
-"""paddle.text (ref:python/paddle/text/): ViterbiDecoder + dataset stubs.
+"""paddle.text (ref:python/paddle/text/): ViterbiDecoder + datasets.
 
 ViterbiDecoder is the real compute piece (CRF decoding) — implemented as a
-lax.scan DP so it compiles into serving programs. The dataset downloads of
-the reference (Imdb/Conll05/WMT14...) require egress; constructors accept a
-local ``data_file`` and raise a clear error otherwise.
+lax.scan DP so it compiles into serving programs. Datasets (Imdb/Conll05/WMT14...)
+parse the reference's file formats; constructors accept local ``data_file``
+paths (no egress needed) or download into DATA_HOME when available.
 """
 from __future__ import annotations
 
@@ -90,23 +90,11 @@ class ViterbiDecoder(nn.Layer):
                               self.include_bos_eos_tag)
 
 
-def _dataset_stub(name):
-    class _D:
-        def __init__(self, *a, data_file=None, **k):
-            if data_file is None:
-                raise NotImplementedError(
-                    f"paddle.text.{name}: dataset download needs network "
-                    "egress; pass data_file= pointing at a local copy")
-            self.data_file = data_file
+# real dataset implementations live in .datasets (parsers over the
+# reference's file formats; explicit data_file paths work offline)
+from .datasets import (Conll05st, Imdb, Imikolov, Movielens,  # noqa: E402
+                       UCIHousing, WMT14, WMT16)
+from . import datasets  # noqa: E402
 
-    _D.__name__ = name
-    return _D
-
-
-Imdb = _dataset_stub("Imdb")
-Imikolov = _dataset_stub("Imikolov")
-Movielens = _dataset_stub("Movielens")
-UCIHousing = _dataset_stub("UCIHousing")
-WMT14 = _dataset_stub("WMT14")
-WMT16 = _dataset_stub("WMT16")
-Conll05st = _dataset_stub("Conll05st")
+__all__ += ["datasets", "Conll05st", "Imdb", "Imikolov", "Movielens",
+            "UCIHousing", "WMT14", "WMT16"]

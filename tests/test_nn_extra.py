@@ -337,3 +337,22 @@ def test_rnn_birnn_wrappers():
     bi = nn.BiRNN(nn.SimpleRNNCell(8, 16), nn.SimpleRNNCell(8, 16))
     yb, _ = bi(x)
     assert yb.shape == [2, 5, 32]
+
+
+def test_cross_entropy_ignore_index_with_weight_finite():
+    """Regression: the label gather must clamp ignore_index rows BEFORE the
+    lookup — an out-of-range fill-mode gather yields NaN, and NaN*0 survives
+    the mask into the weighted mean."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.nn import functional as F
+
+    rng = np.random.default_rng(0)
+    logits = paddle.to_tensor(rng.standard_normal((6, 5)).astype(np.float32))
+    labels = paddle.to_tensor(np.array([0, 1, -100, 2, -100, 4]))
+    w = paddle.to_tensor(np.ones(5, np.float32))
+    lw = float(F.cross_entropy(logits, labels, weight=w).numpy())
+    l = float(F.cross_entropy(logits, labels).numpy())
+    assert np.isfinite(lw) and np.isfinite(l)
+    assert abs(lw - l) < 1e-5  # all-ones weights == unweighted
