@@ -169,8 +169,7 @@ class Imdb(Dataset):
         # extra pass would re-inflate the whole archive): bucket tokenized
         # docs by (split, label) while counting dict frequencies
         freq: dict = collections.defaultdict(int)
-        buckets = {("train", 0): [], ("train", 1): [],
-                   ("test", 0): [], ("test", 1): []}
+        buckets = {(self.mode, 0): [], (self.mode, 1): []}
         strip = string.punctuation.encode("latin-1")
         with tarfile.open(self.data_file) as tf:
             for member in tf:
@@ -181,7 +180,11 @@ class Imdb(Dataset):
                 doc = body.translate(None, strip).lower().split()
                 for w in doc:
                     freq[w] += 1
-                buckets[(m.group(1), 0 if m.group(2) == "pos" else 1)].append(doc)
+                # only this mode's docs are kept; the other split feeds the
+                # dict counts but would double peak memory if retained
+                if m.group(1) == self.mode:
+                    buckets[(self.mode,
+                             0 if m.group(2) == "pos" else 1)].append(doc)
         freq.pop(b"<unk>", None)
         kept = [kv for kv in freq.items() if kv[1] > cutoff]
         kept.sort(key=lambda kv: (-kv[1], kv[0]))

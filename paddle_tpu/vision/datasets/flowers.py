@@ -69,7 +69,7 @@ class Flowers(Dataset):
 
         image = Image.open(path)
         if self.backend == "cv2":
-            image = np.asarray(image)
+            image = np.asarray(image.convert("RGB"))[:, :, ::-1]  # BGR
         if self.transform is not None:
             image = self.transform(image)
         return image, label.astype("int64")

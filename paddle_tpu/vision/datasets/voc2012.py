@@ -64,8 +64,8 @@ class VOC2012(Dataset):
         image = Image.open(_io.BytesIO(img_bytes))
         label = Image.open(_io.BytesIO(lbl_bytes))
         if self.backend == "cv2":
-            image = np.asarray(image)
-            label = np.asarray(label)
+            image = np.asarray(image.convert("RGB"))[:, :, ::-1]  # BGR
+            label = np.asarray(label)  # palette mask: single channel
         if self.transform is not None:
             image = self.transform(image)
         return image, label

@@ -1,0 +1,302 @@
+"""paddle.vision.ops detection op tests: hand-computed goldens for the
+geometry ops, structural/identity properties for the big kernels
+(ref:test/legacy_test/test_roi_align_op.py, test_yolov3_loss_op.py ...)."""
+import io
+
+import numpy as np
+import pytest
+from PIL import Image
+
+import paddle_tpu as paddle
+from paddle_tpu.nn import functional as F
+from paddle_tpu.vision import ops
+
+
+def T(x, dtype=np.float32):
+    return paddle.to_tensor(np.asarray(x, dtype))
+
+
+# ----------------------------------------------------------------- nms
+
+
+def test_nms_suppresses_overlaps():
+    boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11], [50, 50, 60, 60]],
+                     np.float32)
+    keep = np.asarray(ops.nms(T(boxes), 0.5).numpy())
+    assert list(keep) == [0, 2]
+
+
+def test_nms_with_scores_sorts_first():
+    boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11], [50, 50, 60, 60]],
+                     np.float32)
+    scores = np.array([0.3, 0.9, 0.5], np.float32)
+    keep = list(np.asarray(ops.nms(T(boxes), 0.5, T(scores)).numpy()))
+    assert keep == [1, 2]  # box 1 beats box 0
+
+
+def test_nms_categories_batched():
+    boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11], [0, 0, 10, 10]],
+                     np.float32)
+    scores = np.array([0.9, 0.8, 0.7], np.float32)
+    cats = np.array([0, 0, 1], np.int64)
+    keep = list(np.asarray(ops.nms(T(boxes), 0.5, T(scores),
+                                   paddle.to_tensor(cats), [0, 1]).numpy()))
+    # boxes 0 and 1 overlap within category 0 -> keep 0; box 2 is category 1
+    assert keep == [0, 2]
+
+
+def test_matrix_nms_contract():
+    boxes = np.zeros((1, 3, 4), np.float32)
+    boxes[0] = [[0, 0, 10, 10], [1, 1, 11, 11], [50, 50, 60, 60]]
+    scores = np.zeros((1, 2, 3), np.float32)
+    scores[0, 1] = [0.9, 0.8, 0.7]  # class 1 (class 0 is background)
+    out, index, rois_num = ops.matrix_nms(
+        T(boxes), T(scores), score_threshold=0.1, post_threshold=0.0,
+        nms_top_k=10, keep_top_k=10, return_index=True)
+    o = np.asarray(out.numpy())
+    assert o.shape[1] == 6
+    assert int(np.asarray(rois_num.numpy())[0]) == o.shape[0] == 3
+    assert (o[:, 0] == 1.0).all()  # class label column
+    # scores decayed for overlapping box, untouched for the top one
+    assert abs(o[0, 1] - 0.9) < 1e-6
+    assert o[1, 1] <= 0.8
+
+
+# ------------------------------------------------------------ roi family
+
+
+def test_roi_align_constant_map():
+    x = np.full((1, 2, 8, 8), 3.0, np.float32)
+    boxes = np.array([[1.0, 1.0, 5.0, 5.0]], np.float32)
+    out = ops.roi_align(T(x), T(boxes), T([1], np.int32), 2).numpy()
+    assert out.shape == (1, 2, 2, 2)
+    np.testing.assert_allclose(out, 3.0, rtol=1e-5)
+
+
+def test_roi_align_linear_ramp():
+    # f(y, x) = x: bilinear sampling of a linear ramp is exact
+    x = np.tile(np.arange(8, dtype=np.float32), (8, 1))[None, None]
+    boxes = np.array([[2.0, 2.0, 6.0, 6.0]], np.float32)
+    out = ops.roi_align(T(x), T(boxes), T([1], np.int32), 2,
+                        sampling_ratio=2, aligned=False).numpy()
+    # bins span x in [2,4] and [4,6]; mean of samples on a ramp = bin center
+    np.testing.assert_allclose(out[0, 0, :, 0], 3.0, atol=1e-5)
+    np.testing.assert_allclose(out[0, 0, :, 1], 5.0, atol=1e-5)
+
+
+def test_roi_pool_exact_max():
+    x = np.arange(36, dtype=np.float32).reshape(1, 1, 6, 6)
+    boxes = np.array([[0.0, 0.0, 3.0, 3.0]], np.float32)
+    out = ops.roi_pool(T(x), T(boxes), T([1], np.int32), 2).numpy()
+    # roi rounds to [0,3]x[0,3] (4x4 incl. +1), bins 2x2 -> maxes
+    assert out.shape == (1, 1, 2, 2)
+    assert out[0, 0, 1, 1] == x[0, 0, :4, :4].max()
+
+
+def test_psroi_pool_channel_mapping():
+    # each channel c holds constant value c; output bin (i,j) must read
+    # channel group (i*pw+j)
+    C = 8  # oc=2 with 2x2 bins
+    x = np.zeros((1, C, 6, 6), np.float32)
+    for c in range(C):
+        x[0, c] = c
+    boxes = np.array([[0.0, 0.0, 6.0, 6.0]], np.float32)
+    out = ops.psroi_pool(T(x), T(boxes), T([1], np.int32), 2).numpy()
+    assert out.shape == (1, 2, 2, 2)
+    for i in range(2):
+        for j in range(2):
+            assert out[0, 0, i, j] == (i * 2 + j) * 2
+            assert out[0, 1, i, j] == (i * 2 + j) * 2 + 1
+
+
+def test_roi_layers():
+    x = T(np.random.default_rng(0).standard_normal((1, 4, 8, 8)), np.float32)
+    boxes = T([[1.0, 1.0, 6.0, 6.0]])
+    bn = T([1], np.int32)
+    assert ops.RoIAlign(2)(x, boxes, bn).shape == [1, 4, 2, 2]
+    assert ops.RoIPool(2)(x, boxes, bn).shape == [1, 4, 2, 2]
+    assert ops.PSRoIPool(2)(x, boxes, bn).shape == [1, 1, 2, 2]
+
+
+# ---------------------------------------------------------- deform conv
+
+
+def test_deform_conv2d_zero_offset_is_conv():
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((2, 3, 8, 8)).astype(np.float32)
+    w = rng.standard_normal((4, 3, 3, 3)).astype(np.float32)
+    Ho = Wo = 8 - 2
+    offset = np.zeros((2, 2 * 9, Ho, Wo), np.float32)
+    got = ops.deform_conv2d(T(x), T(offset), T(w)).numpy()
+    want = F.conv2d(T(x), T(w)).numpy()
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_deform_conv2d_mask_scales_output():
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal((1, 2, 6, 6)).astype(np.float32)
+    w = rng.standard_normal((2, 2, 3, 3)).astype(np.float32)
+    offset = np.zeros((1, 18, 4, 4), np.float32)
+    half = np.full((1, 9, 4, 4), 0.5, np.float32)
+    full = np.ones((1, 9, 4, 4), np.float32)
+    got_half = ops.deform_conv2d(T(x), T(offset), T(w), mask=T(half)).numpy()
+    got_full = ops.deform_conv2d(T(x), T(offset), T(w), mask=T(full)).numpy()
+    np.testing.assert_allclose(got_half, got_full * 0.5, rtol=1e-4, atol=1e-5)
+
+
+def test_deform_conv2d_layer():
+    layer = ops.DeformConv2D(3, 5, 3)
+    x = T(np.random.default_rng(2).standard_normal((1, 3, 7, 7)), np.float32)
+    offset = T(np.zeros((1, 18, 5, 5), np.float32))
+    assert layer(x, offset).shape == [1, 5, 5, 5]
+
+
+# ----------------------------------------------------------------- yolo
+
+
+def test_yolo_box_decode():
+    N, S, cls, H = 1, 2, 3, 4
+    x = np.zeros((N, S * (5 + cls), H, H), np.float32)
+    x[0, 4] = 10.0  # anchor 0: objectness ~1 everywhere
+    out_boxes, out_scores = ops.yolo_box(
+        T(x), paddle.to_tensor(np.array([[128, 128]], np.int32)),
+        anchors=[10, 13, 16, 30], class_num=cls, conf_thresh=0.5,
+        downsample_ratio=32)
+    b = np.asarray(out_boxes.numpy())
+    s = np.asarray(out_scores.numpy())
+    assert b.shape == (1, H * H * S, 4) and s.shape == (1, H * H * S, cls)
+    # anchor-0 entries survive the threshold, anchor-1 (conf=0.5 sigmoid(0))
+    # fails 0.5 and is zeroed
+    assert (np.abs(b).sum(-1) > 0).sum() == H * H
+    # cell (0,0) anchor 0: center = (0.5/4)*128 = 16
+    first = b[0, 0]
+    cx = (first[0] + first[2]) / 2
+    assert abs(cx - 16.0) < 1e-3
+
+
+def test_yolo_loss_prefers_correct_prediction():
+    rng = np.random.default_rng(0)
+    N, S, cls, H = 1, 3, 2, 4
+    anchors = [10, 13, 16, 30, 33, 23]
+    gt_box = np.zeros((N, 2, 4), np.float32)
+    gt_box[0, 0] = [0.4, 0.4, 0.2, 0.3]  # one real gt
+    gt_label = np.zeros((N, 2), np.int32)
+    random_pred = rng.standard_normal((N, S * (5 + cls), H, H)).astype(np.float32)
+    loss_rand = float(np.asarray(ops.yolo_loss(
+        T(random_pred), T(gt_box), paddle.to_tensor(gt_label), anchors,
+        [0, 1, 2], cls, 0.7, 32).numpy())[0])
+    assert np.isfinite(loss_rand) and loss_rand > 0
+    # an all-negative-objectness prediction scores lower than random when
+    # there is just one gt (most cells are background)
+    neg = np.zeros_like(random_pred)
+    neg[:, 4::5 + cls] = -10.0
+    loss_neg = float(np.asarray(ops.yolo_loss(
+        T(neg), T(gt_box), paddle.to_tensor(gt_label), anchors,
+        [0, 1, 2], cls, 0.7, 32).numpy())[0])
+    assert loss_neg < loss_rand
+
+
+# -------------------------------------------------------- priors & coder
+
+
+def test_prior_box_counts_and_range():
+    feat = T(np.zeros((1, 8, 4, 4), np.float32))
+    img = T(np.zeros((1, 3, 32, 32), np.float32))
+    boxes, var = ops.prior_box(feat, img, min_sizes=[8.0], max_sizes=[16.0],
+                               aspect_ratios=[2.0], flip=True, clip=True)
+    b = np.asarray(boxes.numpy())
+    # priors per cell: ar {1, 2, 0.5} on min + 1 sqrt(min*max) = 4
+    assert b.shape == (4, 4, 4, 4)
+    assert (b >= 0).all() and (b <= 1).all()
+    assert np.asarray(var.numpy()).shape == b.shape
+    np.testing.assert_allclose(np.asarray(var.numpy())[0, 0, 0],
+                               [0.1, 0.1, 0.2, 0.2])
+
+
+def test_box_coder_roundtrip():
+    priors = np.array([[0, 0, 10, 10], [5, 5, 20, 25]], np.float32)
+    targets = np.array([[1, 2, 8, 9], [6, 4, 18, 28]], np.float32)
+    var = [0.1, 0.1, 0.2, 0.2]
+    enc = ops.box_coder(T(priors), var, T(targets),
+                        code_type="encode_center_size")
+    dec = ops.box_coder(T(priors), var, enc, code_type="decode_center_size",
+                        axis=0)
+    d = np.asarray(dec.numpy())
+    for i in range(2):
+        np.testing.assert_allclose(d[i, i], targets[i], rtol=1e-4, atol=1e-4)
+
+
+# ----------------------------------------------- fpn / proposals / io
+
+
+def test_distribute_fpn_proposals():
+    rois = np.array([[0, 0, 10, 10],      # small -> low level
+                     [0, 0, 300, 300]],   # large -> high level
+                    np.float32)
+    multi, restore = ops.distribute_fpn_proposals(T(rois), 2, 5, 4, 224)
+    assert len(multi) == 4
+    sizes = [m.shape[0] for m in multi]
+    assert sum(sizes) == 2
+    assert sizes[0] == 1 and sizes[-2] == 1 or sizes[-1] == 1
+    r = np.asarray(restore.numpy()).ravel()
+    assert sorted(r.tolist()) == [0, 1]
+
+
+def test_generate_proposals():
+    rng = np.random.default_rng(0)
+    H = W = 4
+    A = 2
+    scores = rng.random((1, A, H, W)).astype(np.float32)
+    deltas = (rng.standard_normal((1, 4 * A, H, W)) * 0.1).astype(np.float32)
+    anchors = np.zeros((H, W, A, 4), np.float32)
+    for i in range(H):
+        for j in range(W):
+            anchors[i, j, 0] = [j * 8, i * 8, j * 8 + 16, i * 8 + 16]
+            anchors[i, j, 1] = [j * 8, i * 8, j * 8 + 24, i * 8 + 24]
+    var = np.full((H, W, A, 4), 0.1, np.float32)
+    rois, probs, num = ops.generate_proposals(
+        T(scores), T(deltas), T([[32, 32]]), T(anchors), T(var),
+        pre_nms_top_n=10, post_nms_top_n=5, return_rois_num=True)
+    r = np.asarray(rois.numpy())
+    p = np.asarray(probs.numpy())
+    assert r.shape[0] == int(np.asarray(num.numpy())[0]) <= 5
+    assert p.shape == (r.shape[0], 1)
+    assert (np.diff(p[:, 0]) <= 1e-6).all()  # sorted by score
+    assert (r[:, 0] >= 0).all() and (r[:, 2] <= 32).all()
+
+
+def test_read_file_decode_jpeg(tmp_path):
+    img = Image.fromarray(
+        np.arange(64, dtype=np.uint8).reshape(8, 8), mode="L").convert("RGB")
+    p = tmp_path / "t.jpg"
+    img.save(p)
+    raw = ops.read_file(str(p))
+    assert raw.numpy().dtype == np.uint8
+    dec = ops.decode_jpeg(raw, mode="rgb")
+    assert np.asarray(dec.numpy()).shape == (3, 8, 8)
+
+
+def test_conv_norm_activation():
+    block = ops.ConvNormActivation(3, 8, kernel_size=3, stride=2)
+    x = T(np.random.default_rng(3).standard_normal((2, 3, 16, 16)), np.float32)
+    assert block(x).shape == [2, 8, 8, 8]
+
+
+def test_roi_align_and_deform_conv_gradients_flow():
+    """The detection heads must train: gradients reach the backbone feature
+    map through roi_align, and DeformConv2D's own weights get grads."""
+    rng = np.random.default_rng(4)
+    x = paddle.to_tensor(
+        rng.standard_normal((1, 2, 8, 8)).astype(np.float32))
+    x.stop_gradient = False
+    out = ops.roi_align(x, T([[1.0, 1.0, 6.0, 6.0]]), T([1], np.int32), 2)
+    out.sum().backward()
+    assert x.grad is not None
+    assert float(np.abs(x.grad.numpy()).sum()) > 0
+
+    layer = ops.DeformConv2D(2, 3, 3)
+    offset = T(np.zeros((1, 18, 6, 6), np.float32))
+    y = layer(x, offset)
+    y.sum().backward()
+    assert layer.weight.grad is not None
+    assert float(np.abs(layer.weight.grad.numpy()).sum()) > 0

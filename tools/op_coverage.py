@@ -33,6 +33,7 @@ MODULE_MAP = {
     "paddle.metric": "paddle_tpu.metric",
     "paddle.vision.transforms": "paddle_tpu.vision.transforms",
     "paddle.vision.models": "paddle_tpu.vision.models",
+    "paddle.vision.ops": "paddle_tpu.vision.ops",
     "paddle.distributed": "paddle_tpu.distributed",
     "paddle.io": "paddle_tpu.io",
     "paddle.amp": "paddle_tpu.amp",
