@@ -67,6 +67,11 @@ from .framework.io import load, save  # noqa: F401,E402
 from . import metric  # noqa: F401,E402
 from . import vision  # noqa: F401,E402
 from . import hapi  # noqa: F401,E402
+from . import callbacks  # noqa: F401,E402
+from . import regularizer  # noqa: F401,E402
+from . import reader  # noqa: F401,E402
+from . import sysconfig  # noqa: F401,E402
+from . import version  # noqa: F401,E402
 from . import incubate  # noqa: F401,E402
 from .hapi import Model  # noqa: F401,E402
 from .hapi.model import summary  # noqa: F401,E402

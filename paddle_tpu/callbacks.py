@@ -1,0 +1,12 @@
+"""paddle.callbacks (ref:python/paddle/callbacks.py): the hapi training
+callbacks under their public alias."""
+from .hapi.callbacks import (  # noqa: F401
+    Callback, CallbackList, EarlyStopping, LRScheduler, ModelCheckpoint,
+    ProgBarLogger)
+
+try:  # optional extras if present in the hapi set
+    from .hapi.callbacks import ReduceLROnPlateau, VisualDL  # noqa: F401
+except ImportError:
+    pass
+
+__all__ = [n for n in dir() if not n.startswith("_")]

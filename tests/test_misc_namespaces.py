@@ -1,0 +1,72 @@
+"""paddle.regularizer / reader / callbacks / version / sysconfig parity
+(ref:python/paddle/regularizer.py, reader/decorator.py, callbacks.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.regularizer import L1Decay, L2Decay
+
+
+def _step_sgd(init, wd, lr=1.0):
+    q = paddle.to_tensor(np.full(4, init, np.float32))
+    q.stop_gradient = False
+    opt = paddle.optimizer.SGD(learning_rate=lr, parameters=[q],
+                               weight_decay=wd)
+    (q * 0.0).sum().backward()
+    opt.step()
+    return q.numpy()
+
+
+def test_l2_decay_pulls_toward_zero():
+    np.testing.assert_allclose(_step_sgd(-2.0, L2Decay(0.5)), -1.0)
+    np.testing.assert_allclose(_step_sgd(-2.0, 0.5), -1.0)  # float == L2
+
+
+def test_l1_decay_steps_by_sign():
+    np.testing.assert_allclose(_step_sgd(-2.0, L1Decay(0.5)), -1.5)
+    np.testing.assert_allclose(_step_sgd(2.0, L1Decay(0.5)), 1.5)
+
+
+def test_adamw_accepts_regularizer():
+    q = paddle.to_tensor(np.full(4, 2.0, np.float32))
+    q.stop_gradient = False
+    opt = paddle.optimizer.AdamW(learning_rate=0.1, parameters=[q],
+                                 weight_decay=L2Decay(0.01))
+    (q * 0.0).sum().backward()
+    opt.step()
+    assert float(q.numpy()[0]) < 2.0  # decoupled decay shrank the weight
+
+
+def test_reader_combinators():
+    import paddle_tpu.reader as reader
+
+    r = lambda: iter(range(5))
+    assert list(reader.firstn(r, 3)()) == [0, 1, 2]
+    assert sorted(reader.shuffle(r, 2)()) == [0, 1, 2, 3, 4]
+    assert list(reader.chain(r, r)()) == list(range(5)) * 2
+    assert list(reader.compose(r, r)()) == [(i, i) for i in range(5)]
+    assert list(reader.map_readers(lambda a, b: a + b, r, r)()) == [
+        0, 2, 4, 6, 8]
+    assert sorted(reader.buffered(r, 2)()) == [0, 1, 2, 3, 4]
+    assert sorted(reader.xmap_readers(lambda v: v * 2, r, 2, 4)()) == [
+        0, 2, 4, 6, 8]
+    cached = reader.cache(r)
+    assert list(cached()) == list(cached()) == [0, 1, 2, 3, 4]
+
+
+def test_reader_compose_misalignment_raises():
+    import paddle_tpu.reader as reader
+
+    r5 = lambda: iter(range(5))
+    r3 = lambda: iter(range(3))
+    with pytest.raises(ValueError, match="different lengths"):
+        list(reader.compose(r5, r3)())
+
+
+def test_callbacks_version_sysconfig():
+    import os
+
+    assert paddle.callbacks.EarlyStopping is paddle.hapi.callbacks.EarlyStopping
+    assert paddle.version.full_version == paddle.__version__
+    paddle.version.show()  # must not raise
+    assert os.path.isdir(paddle.sysconfig.get_include())
