@@ -68,6 +68,7 @@ from . import metric  # noqa: F401,E402
 from . import vision  # noqa: F401,E402
 from . import hapi  # noqa: F401,E402
 from . import callbacks  # noqa: F401,E402
+from . import geometric  # noqa: F401,E402
 from . import regularizer  # noqa: F401,E402
 from . import reader  # noqa: F401,E402
 from . import sysconfig  # noqa: F401,E402

@@ -1,0 +1,76 @@
+"""paddle.geometric message passing + sampling (ref:python/paddle/geometric/)."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import geometric as G
+
+
+def T(x, dt=np.float32):
+    return paddle.to_tensor(np.asarray(x, dt))
+
+
+def test_send_u_recv_reduces():
+    x = T([[1.0], [2.0], [4.0]])
+    src = T([0, 1, 2, 0], np.int32)
+    dst = T([1, 2, 1, 0], np.int32)
+    out = G.send_u_recv(x, src, dst, reduce_op="sum").numpy()
+    np.testing.assert_allclose(out, [[1.0], [5.0], [2.0]])
+    out = G.send_u_recv(x, src, dst, reduce_op="max").numpy()
+    np.testing.assert_allclose(out, [[1.0], [4.0], [2.0]])
+    out = G.send_u_recv(x, src, dst, reduce_op="mean").numpy()
+    np.testing.assert_allclose(out, [[1.0], [2.5], [2.0]])
+
+
+def test_send_ue_recv_and_uv():
+    x = T([[1.0], [2.0], [4.0]])
+    e = T([[10.0], [20.0], [30.0]])
+    src = T([0, 1, 2], np.int32)
+    dst = T([1, 1, 0], np.int32)
+    out = G.send_ue_recv(x, e, src, dst, "add", "sum").numpy()
+    np.testing.assert_allclose(out, [[34.0], [33.0], [0.0]])
+    uv = G.send_uv(x, x, src, dst, "mul").numpy()
+    np.testing.assert_allclose(uv, [[2.0], [4.0], [4.0]])
+
+
+def test_segment_ops_reexported():
+    data = T([[1.0], [2.0], [3.0]])
+    ids = T([0, 0, 1], np.int32)
+    np.testing.assert_allclose(G.segment_sum(data, ids).numpy(),
+                               [[3.0], [3.0]])
+    np.testing.assert_allclose(G.segment_mean(data, ids).numpy(),
+                               [[1.5], [3.0]])
+
+
+def test_reindex_graph():
+    x = T([10, 20], np.int64)
+    neighbors = T([30, 10, 20, 30], np.int64)
+    count = T([2, 2], np.int32)
+    src, dst, nodes = G.reindex_graph(x, neighbors, count)
+    n = nodes.numpy().tolist()
+    assert n[:2] == [10, 20] and set(n) == {10, 20, 30}
+    assert dst.numpy().tolist() == [0, 0, 1, 1]
+    assert src.numpy().tolist() == [n.index(30), 0, 1, n.index(30)]
+
+
+def test_reindex_heter_graph():
+    x = T([10, 20], np.int64)
+    srcs, dsts, nodes = G.reindex_heter_graph(
+        x, [T([30, 10], np.int64), T([20, 30], np.int64)],
+        [T([1, 1], np.int32), T([1, 1], np.int32)])
+    assert len(srcs) == 2 and len(dsts) == 2
+    assert srcs[0].numpy().shape == (2,)
+
+
+def test_sample_neighbors_uniform_and_weighted():
+    # CSC: node0 -> {1,2,3}, node1 -> {0}
+    row = T([1, 2, 3, 0], np.int64)
+    colptr = T([0, 3, 4], np.int64)
+    nodes = T([0, 1], np.int64)
+    neigh, cnt = G.sample_neighbors(row, colptr, nodes, sample_size=2)
+    assert cnt.numpy().tolist() == [2, 1]
+    assert set(neigh.numpy().tolist()[:2]) <= {1, 2, 3}
+    w = T([0.0, 0.0, 1.0, 1.0])
+    neigh, cnt, eids = G.weighted_sample_neighbors(
+        row, colptr, w, nodes, sample_size=1, return_eids=True)
+    assert neigh.numpy().tolist()[0] == 3  # only nonzero-weight edge
+    assert eids.numpy().tolist()[0] == 2

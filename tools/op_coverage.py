@@ -34,6 +34,7 @@ MODULE_MAP = {
     "paddle.vision.transforms": "paddle_tpu.vision.transforms",
     "paddle.vision.models": "paddle_tpu.vision.models",
     "paddle.vision.ops": "paddle_tpu.vision.ops",
+    "paddle.geometric": "paddle_tpu.geometric",
     "paddle.distributed": "paddle_tpu.distributed",
     "paddle.io": "paddle_tpu.io",
     "paddle.amp": "paddle_tpu.amp",
