@@ -250,3 +250,6 @@ def init_worker():
     """PS worker role entry: connect via PADDLE_PSERVER_ENDPOINTS
     (distributed.ps.init_from_env does the actual connect per table)."""
     return None
+
+
+from . import utils  # noqa: F401,E402  (LocalFS/HDFSClient/recompute)

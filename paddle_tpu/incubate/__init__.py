@@ -14,6 +14,7 @@ import numpy as np
 from ..core.dispatch import apply
 from ..core.tensor import Tensor
 from . import distributed  # noqa: F401
+from . import asp  # noqa: F401
 
 __all__ = [
     "segment_sum", "segment_mean", "segment_max", "segment_min",

@@ -1,0 +1,78 @@
+"""incubate.asp n:m sparsity + fleet.utils filesystem clients
+(ref:python/paddle/incubate/asp, distributed/fleet/utils/fs.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.incubate import asp
+
+
+def test_prune_model_2_4_density():
+    model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    dens = asp.prune_model(model, n=2, m=4)
+    assert dens  # pruned something
+    for v in dens.values():
+        assert abs(v - 0.5) < 1e-6  # exactly 2:4
+    w = np.asarray(model[0].weight.numpy())
+    groups = np.abs(w).reshape(-1, 2, 4)
+    nz = (groups != 0).sum(-1)
+    assert (nz == 2).all()
+
+
+def test_decorated_optimizer_preserves_masks():
+    model = nn.Linear(8, 8)
+    asp.prune_model(model)
+    opt = asp.decorate(paddle.optimizer.SGD(
+        learning_rate=0.5, parameters=model.parameters()))
+    x = paddle.to_tensor(np.random.default_rng(0).standard_normal(
+        (4, 8)).astype(np.float32))
+    loss = (model(x) ** 2).mean()
+    loss.backward()
+    opt.step()
+    opt.clear_grad()  # pass-through attr works
+    assert abs(asp.calculate_density(model.weight) - 0.5) < 0.05
+
+
+def test_excluded_layers():
+    model = nn.Linear(8, 8)
+    name = model.weight.name or "weight"  # unnamed params go by attr path
+    asp.set_excluded_layers([name])
+    try:
+        dens = asp.prune_model(model)
+        assert not dens
+        assert asp.calculate_density(model.weight) == 1.0
+    finally:
+        asp.reset_excluded_layers()
+
+
+def test_local_fs(tmp_path):
+    from paddle_tpu.distributed.fleet.utils import LocalFS
+
+    fs = LocalFS()
+    d = str(tmp_path / "a")
+    fs.mkdirs(d)
+    assert fs.is_dir(d) and fs.is_exist(d)
+    f = str(tmp_path / "a" / "x.txt")
+    fs.touch(f)
+    assert fs.is_file(f)
+    dirs, files = fs.ls_dir(str(tmp_path))
+    assert dirs == ["a"] and files == []
+    fs.mv(f, str(tmp_path / "y.txt"))
+    assert fs.is_file(str(tmp_path / "y.txt"))
+    fs.delete(d)
+    assert not fs.is_exist(d)
+
+
+def test_hdfs_client_reports_missing_binary(tmp_path):
+    from paddle_tpu.distributed.fleet.utils import ExecuteError, HDFSClient
+
+    client = HDFSClient(str(tmp_path))  # no bin/hadoop here
+    with pytest.raises(ExecuteError, match="hadoop command failed"):
+        client.is_exist("/whatever")
+
+
+def test_fleet_utils_recompute_reexport():
+    from paddle_tpu.distributed.fleet import utils
+
+    assert callable(utils.recompute)
