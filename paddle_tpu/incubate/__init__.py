@@ -15,6 +15,8 @@ from ..core.dispatch import apply
 from ..core.tensor import Tensor
 from . import distributed  # noqa: F401
 from . import asp  # noqa: F401
+from . import autograd  # noqa: F401
+from . import nn  # noqa: F401
 
 __all__ = [
     "segment_sum", "segment_mean", "segment_max", "segment_min",

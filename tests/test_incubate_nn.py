@@ -1,0 +1,87 @@
+"""incubate.nn fused layers + incubate.autograd functional transforms
+(ref:python/paddle/incubate/nn/, incubate/autograd/)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.incubate import autograd as iag
+from paddle_tpu.incubate import nn as inn
+
+
+def T(x):
+    return paddle.to_tensor(np.asarray(x, np.float32))
+
+
+def test_fused_linear_matches_linear():
+    rng = np.random.default_rng(0)
+    x = T(rng.standard_normal((2, 8)))
+    fl = inn.FusedLinear(8, 4)
+    ref = nn.Linear(8, 4)
+    ref.weight._data = fl.weight._data
+    ref.bias._data = fl.bias._data
+    np.testing.assert_allclose(fl(x).numpy(), ref(x).numpy(), rtol=1e-5)
+
+
+def test_fused_dropout_add_eval_is_add():
+    m = inn.FusedDropoutAdd(p=0.9)
+    m.eval()
+    x, y = T(np.ones((3, 3))), T(np.full((3, 3), 2.0))
+    np.testing.assert_allclose(m(x, y).numpy(), 3.0)
+
+
+def test_fused_bias_dropout_residual_ln():
+    m = inn.FusedBiasDropoutResidualLayerNorm(8, dropout_rate=0.0)
+    x = T(np.random.default_rng(1).standard_normal((2, 4, 8)))
+    r = T(np.random.default_rng(2).standard_normal((2, 4, 8)))
+    out = m(x, r).numpy()
+    assert out.shape == (2, 4, 8)
+    np.testing.assert_allclose(out.mean(-1), 0.0, atol=1e-5)  # LN output
+
+
+def test_fused_mha_shapes_and_grad():
+    m = inn.FusedMultiHeadAttention(16, 4, dropout_rate=0.0,
+                                    attn_dropout_rate=0.0)
+    x = T(np.random.default_rng(3).standard_normal((2, 6, 16)))
+    x.stop_gradient = False
+    out = m(x)
+    assert out.shape == [2, 6, 16]
+    out.sum().backward()
+    assert m.qkv_weight.grad is not None
+    assert float(np.abs(m.qkv_weight.grad.numpy()).sum()) > 0
+
+
+def test_fused_encoder_layer_and_multi_transformer():
+    enc = inn.FusedTransformerEncoderLayer(16, 4, 32, dropout_rate=0.0)
+    x = T(np.random.default_rng(4).standard_normal((2, 5, 16)))
+    assert enc(x).shape == [2, 5, 16]
+    mt = inn.FusedMultiTransformer(16, 4, 32, num_layers=2)
+    mt.eval()
+    assert mt(x).shape == [2, 5, 16]
+
+
+def test_fused_ec_moe():
+    m = inn.FusedEcMoe(16, 32, num_experts=4)
+    x = T(np.random.default_rng(5).standard_normal((2, 6, 16)))
+    gate = T(np.random.default_rng(6).standard_normal((2, 6, 4)))
+    out = m(x, gate)
+    assert out.shape == [2, 6, 16]
+    # one-hot gate == that expert alone
+    g = np.full((2, 6, 4), -1e9, np.float32)
+    g[..., 1] = 0.0
+    only1 = m(x, T(g)).numpy()
+    assert np.isfinite(only1).all()
+
+
+def test_incubate_autograd_vjp_jvp():
+    f = lambda x: (x * x).sum()
+    x = T([1.0, 2.0, 3.0])
+    out, g = iag.vjp(f, x)
+    np.testing.assert_allclose(g.numpy(), [2.0, 4.0, 6.0])
+    out, t = iag.jvp(lambda x: x * x, x, T([1.0, 1.0, 1.0]))
+    np.testing.assert_allclose(t.numpy(), [2.0, 4.0, 6.0])
+    fg = iag.forward_grad(lambda x: x * 3.0, x, T([1.0, 0.0, 0.0]))
+    np.testing.assert_allclose(fg.numpy(), [3.0, 0.0, 0.0])
+    g2 = iag.grad(f, x)
+    np.testing.assert_allclose(g2.numpy(), [2.0, 4.0, 6.0])
+    iag.enable_prim(); assert iag.prim_enabled(); iag.disable_prim()
