@@ -74,7 +74,10 @@ class LocalFS:
     def mv(self, src_path, dst_path, overwrite=False, test_exists=False):
         if not self.is_exist(src_path):
             raise FileNotFoundError(src_path)
-        if overwrite and self.is_exist(dst_path):
+        if self.is_exist(dst_path):
+            if not overwrite:
+                # os.rename would clobber silently; the reference FS raises
+                raise FileExistsError(dst_path)
             self.delete(dst_path)
         os.rename(src_path, dst_path)
 
@@ -139,9 +142,14 @@ class HDFSClient:
         self._run("-rm", "-r", "-f", fs_path)
 
     def mv(self, fs_src_path, fs_dst_path, overwrite=False, test_exists=True):
+        if test_exists and not self.is_exist(fs_src_path):
+            raise ExecuteError(f"mv source does not exist: {fs_src_path}")
         if overwrite:
             self.delete(fs_dst_path)
-        self._run("-mv", fs_src_path, fs_dst_path)
+        code, out = self._run("-mv", fs_src_path, fs_dst_path)
+        if code != 0:
+            raise ExecuteError(
+                f"hadoop fs -mv {fs_src_path} {fs_dst_path} failed: {out}")
 
     def upload(self, local_path, fs_path, multi_processes=1, overwrite=False):
         if overwrite:

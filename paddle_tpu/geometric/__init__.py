@@ -40,8 +40,13 @@ def _reduce(msg, dst, reduce_op, nseg):
           "min": jax.ops.segment_min}[reduce_op]
     out = fn(msg, dst, num_segments=nseg)
     if reduce_op in ("max", "min"):
-        # empty segments come back +-inf; the reference yields 0
-        out = jnp.where(jnp.isfinite(out), out, 0.0).astype(msg.dtype)
+        # segments receiving no edges yield 0 (the reference contract) —
+        # detected by edge counts, so int identities (INT_MIN/MAX) are fixed
+        # too and legitimate +-inf reductions are left alone
+        counts = jax.ops.segment_sum(jnp.ones((msg.shape[0],), jnp.int32),
+                                     dst, num_segments=nseg)
+        empty = (counts == 0).reshape((-1,) + (1,) * (msg.ndim - 1))
+        out = jnp.where(empty, jnp.zeros_like(out), out)
     return out
 
 
@@ -153,8 +158,14 @@ def _sample_with_eids(row, colptr, input_nodes, sample_size, eids, weights,
         if 0 <= sample_size < idx.size:
             if w is not None:
                 p = w[idx].astype(np.float64)
-                p = p / p.sum() if p.sum() > 0 else None
-                idx = rng.choice(idx, sample_size, replace=False, p=p)
+                if p.sum() > 0:
+                    p = p / p.sum()
+                    # without replacement we can pick at most the number of
+                    # positive-weight neighbors
+                    k = min(sample_size, int(np.count_nonzero(p)))
+                    idx = rng.choice(idx, k, replace=False, p=p)
+                else:
+                    idx = rng.choice(idx, sample_size, replace=False)
             else:
                 idx = rng.choice(idx, sample_size, replace=False)
         out_n.append(rown[idx])

@@ -23,7 +23,6 @@ __all__ = ["calculate_density", "decorate", "prune_model",
 
 _excluded_layers: List[str] = []
 _supported_layer_types = {"Linear", "Conv2D"}
-_masks: Dict[int, jnp.ndarray] = {}  # id(param) -> mask
 
 
 def set_excluded_layers(param_names, main_program=None):
@@ -95,7 +94,9 @@ def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
         mask = _nm_mask(arr, n, m)
         w._data = jnp.asarray(arr * mask)
         if with_mask:
-            _masks[id(w)] = jnp.asarray(mask, arr.dtype)
+            # stored ON the tensor: lives and dies with the parameter, no
+            # global registry to leak or collide on recycled ids
+            w._asp_mask = jnp.asarray(mask, arr.dtype)
         densities[label] = calculate_density(w)
     return densities
 
@@ -112,9 +113,8 @@ class OptimizerWithSparsityGuarantee:
 
     def step(self):
         self._optimizer.step()
-        params = self._optimizer._parameter_list or []
-        for p in params:
-            mask = _masks.get(id(p))
+        for p in (self._optimizer._parameter_list or []):
+            mask = getattr(p, "_asp_mask", None)
             if mask is not None:
                 p._data = p._data * mask
 
@@ -126,7 +126,7 @@ class OptimizerWithSparsityGuarantee:
 
     def step_mask_only(self):
         for p in (self._optimizer._parameter_list or []):
-            mask = _masks.get(id(p))
+            mask = getattr(p, "_asp_mask", None)
             if mask is not None:
                 p._data = p._data * mask
 

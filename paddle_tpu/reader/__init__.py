@@ -96,9 +96,12 @@ def buffered(reader, size):
         q = _queue.Queue(maxsize=size)
 
         def produce():
-            for item in reader():
-                q.put(item)
-            q.put(_End)
+            try:
+                for item in reader():
+                    q.put(item)
+                q.put(_End)
+            except BaseException as e:  # surface, don't deadlock the consumer
+                q.put(e)
 
         t = threading.Thread(target=produce, daemon=True)
         t.start()
@@ -106,6 +109,8 @@ def buffered(reader, size):
             item = q.get()
             if item is _End:
                 break
+            if isinstance(item, BaseException):
+                raise item
             yield item
 
     return _impl
@@ -125,14 +130,29 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
     threads here too); order=True preserves input order."""
 
     def _impl():
+        import collections
         import concurrent.futures as cf
 
         with cf.ThreadPoolExecutor(max_workers=process_num) as pool:
             if order:
-                yield from pool.map(mapper, reader())
+                # Executor.map is lazy on submission in chunks; bound it by
+                # windowing ourselves for strict buffer_size semantics
+                window: collections.deque = collections.deque()
+                for item in reader():
+                    window.append(pool.submit(mapper, item))
+                    if len(window) >= max(buffer_size, 1):
+                        yield window.popleft().result()
+                while window:
+                    yield window.popleft().result()
             else:
-                futures = [pool.submit(mapper, item) for item in reader()]
-                for f in cf.as_completed(futures):
+                window = collections.deque()
+                for item in reader():
+                    window.append(pool.submit(mapper, item))
+                    if len(window) >= max(buffer_size, 1):
+                        done = next(cf.as_completed(window))
+                        window.remove(done)
+                        yield done.result()
+                for f in cf.as_completed(window):
                     yield f.result()
 
     return _impl
@@ -149,9 +169,12 @@ def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
         q = _queue.Queue(maxsize=queue_size)
 
         def produce(r):
-            for item in r():
-                q.put(item)
-            q.put(_End)
+            try:
+                for item in r():
+                    q.put(item)
+                q.put(_End)
+            except BaseException as e:  # surface, don't deadlock the consumer
+                q.put(e)
 
         threads = [threading.Thread(target=produce, args=(r,), daemon=True)
                    for r in readers]
@@ -163,6 +186,8 @@ def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
             if item is _End:
                 done += 1
                 continue
+            if isinstance(item, BaseException):
+                raise item
             yield item
 
     return _impl

@@ -4,7 +4,10 @@ from __future__ import annotations
 
 import subprocess
 
-full_version = "0.2.0"
+try:  # single source of truth: the package __version__ (set before this
+    from .. import __version__ as full_version  # module is imported)
+except ImportError:  # pragma: no cover
+    full_version = "0.2.0"
 major, minor, patch = (full_version.split(".") + ["0", "0"])[:3]
 rc = "0"
 istaged = True
@@ -27,7 +30,12 @@ def _commit() -> str:
         return "unknown"
 
 
-commit = _commit()
+def __getattr__(name):  # PEP 562: no git subprocess at import time
+    if name == "commit":
+        val = _commit()
+        globals()["commit"] = val
+        return val
+    raise AttributeError(f"module 'paddle_tpu.version' has no attribute {name!r}")
 
 
 def show():
@@ -39,7 +47,7 @@ def show():
         print("minor:", minor)
         print("patch:", patch)
         print("rc:", rc)
-    print("commit:", commit)
+    print("commit:", globals().get("commit") or _commit())
 
 
 def cuda() -> str:

@@ -74,3 +74,21 @@ def test_sample_neighbors_uniform_and_weighted():
         row, colptr, w, nodes, sample_size=1, return_eids=True)
     assert neigh.numpy().tolist()[0] == 3  # only nonzero-weight edge
     assert eids.numpy().tolist()[0] == 2
+
+
+def test_send_u_recv_int_empty_segments_zero():
+    x = T([[5], [7]], np.int32)
+    src = T([0, 1], np.int32)
+    dst = T([0, 0], np.int32)  # slot 1 receives nothing
+    out = G.send_u_recv(x, src, dst, reduce_op="max", out_size=2).numpy()
+    assert out[0, 0] == 7 and out[1, 0] == 0  # not INT32_MIN
+
+
+def test_weighted_sampling_fewer_nonzero_than_k():
+    row = T([1, 2, 3], np.int64)
+    colptr = T([0, 3], np.int64)
+    w = T([0.0, 0.0, 1.0])
+    neigh, cnt = G.weighted_sample_neighbors(
+        row, colptr, w, T([0], np.int64), sample_size=2)
+    # only one positive-weight edge: degrade to 1 sample, don't crash
+    assert cnt.numpy().tolist() == [1] and neigh.numpy().tolist() == [3]

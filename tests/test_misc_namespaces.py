@@ -70,3 +70,29 @@ def test_callbacks_version_sysconfig():
     assert paddle.version.full_version == paddle.__version__
     paddle.version.show()  # must not raise
     assert os.path.isdir(paddle.sysconfig.get_include())
+
+
+def test_reader_error_propagates_not_deadlocks():
+    import paddle_tpu.reader as reader
+
+    def bad():
+        yield 1
+        raise IOError("boom")
+
+    with pytest.raises(IOError, match="boom"):
+        list(reader.buffered(lambda: bad(), 2)())
+    with pytest.raises(IOError, match="boom"):
+        list(reader.multiprocess_reader([lambda: bad()])())
+
+
+def test_local_fs_mv_no_clobber(tmp_path):
+    from paddle_tpu.distributed.fleet.utils import LocalFS
+
+    fs = LocalFS()
+    a, b = str(tmp_path / "a"), str(tmp_path / "b")
+    fs.touch(a)
+    fs.touch(b)
+    with pytest.raises(FileExistsError):
+        fs.mv(a, b, overwrite=False)
+    fs.mv(a, b, overwrite=True)
+    assert not fs.is_exist(a) and fs.is_exist(b)
