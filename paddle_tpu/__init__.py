@@ -69,6 +69,9 @@ from . import vision  # noqa: F401,E402
 from . import hapi  # noqa: F401,E402
 from . import callbacks  # noqa: F401,E402
 from . import geometric  # noqa: F401,E402
+from . import tensor  # noqa: F401,E402
+from . import cost_model  # noqa: F401,E402
+from . import dataset  # noqa: F401,E402
 from . import regularizer  # noqa: F401,E402
 from . import reader  # noqa: F401,E402
 from . import sysconfig  # noqa: F401,E402
