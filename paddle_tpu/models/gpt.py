@@ -247,3 +247,80 @@ class GPTForCausalLM(nn.Layer):
             reduction="mean",
         )
         return loss
+
+    def generate(self, input_ids, max_new_tokens: int = 32,
+                 do_sample: bool = False, temperature: float = 1.0,
+                 top_k: int = 0, eos_token_id: int = -1, seed: int = 0):
+        """Compiled autoregressive decoding: ONE jitted program — a
+        ``lax.scan`` over decode steps on a max-length padded sequence, so
+        every step is shape-static and the whole loop runs on-device with no
+        host round trips (the XLA-native replacement for the reference's
+        per-step executor decode). Each step re-runs the causal forward on
+        the padded buffer and takes the logits at the current position —
+        exact module semantics; O(T * full-forward), the right trade at
+        moderate lengths where weights (not the KV dot) dominate HBM
+        traffic.
+
+        Returns [batch, prompt_len + max_new_tokens] token ids; positions
+        after an ``eos_token_id`` hit are filled with eos.
+        """
+        was_training = self.training
+        self.eval()
+        try:
+            ids = input_ids._data if isinstance(input_ids, Tensor) else jnp.asarray(input_ids)
+            b, prompt_len = ids.shape
+            total = prompt_len + max_new_tokens
+            if total > self.cfg.max_position_embeddings:
+                raise ValueError(
+                    f"prompt+new tokens {total} exceeds "
+                    f"max_position_embeddings {self.cfg.max_position_embeddings}")
+
+            params, buffers = self.functional_state()
+            objs = list(params.values()) + list(buffers.values())
+            arrays = [p._data for p in objs]
+            from ..jit import _swap_data
+
+            from ..core import rng as prng
+
+            def logits_at(param_arrays, buf, pos):
+                with _swap_data(objs, list(param_arrays)):
+                    with prng.key_guard(jax.random.key(0)):
+                        full = self(Tensor(buf))._data  # [b, total, V]
+                return jax.lax.dynamic_index_in_dim(full, pos, axis=1,
+                                                    keepdims=False)
+
+            def decode(param_arrays, start_ids, key):
+                buf = jnp.zeros((b, total), start_ids.dtype)
+                buf = jax.lax.dynamic_update_slice(buf, start_ids, (0, 0))
+
+                def step(carry, _):
+                    buf, pos, done, key = carry
+                    logits = logits_at(param_arrays, buf, pos - 1)
+                    if do_sample:
+                        key, sub = jax.random.split(key)
+                        scaled = logits / jnp.maximum(temperature, 1e-6)
+                        if top_k > 0:
+                            kth = jnp.sort(scaled, axis=-1)[:, -top_k][:, None]
+                            scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+                        nxt = jax.random.categorical(sub, scaled)
+                    else:
+                        nxt = jnp.argmax(logits, axis=-1)
+                    nxt = nxt.astype(buf.dtype)
+                    if eos_token_id >= 0:
+                        nxt = jnp.where(done, eos_token_id, nxt)
+                        done = done | (nxt == eos_token_id)
+                    buf = jax.lax.dynamic_update_slice(
+                        buf, nxt[:, None], (0, pos))
+                    return (buf, pos + 1, done, key), None
+
+                done0 = jnp.zeros((b,), jnp.bool_)
+                (buf, _, _, _), _ = jax.lax.scan(
+                    step, (buf, jnp.int32(prompt_len), done0, key),
+                    None, length=max_new_tokens)
+                return buf
+
+            out = jax.jit(decode)(arrays, ids, jax.random.key(seed))
+            return Tensor(out)
+        finally:
+            if was_training:
+                self.train()
