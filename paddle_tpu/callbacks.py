@@ -4,9 +4,7 @@ from .hapi.callbacks import (  # noqa: F401
     Callback, CallbackList, EarlyStopping, LRScheduler, ModelCheckpoint,
     ProgBarLogger)
 
-try:  # optional extras if present in the hapi set
-    from .hapi.callbacks import ReduceLROnPlateau, VisualDL  # noqa: F401
-except ImportError:
-    pass
+from .hapi.callbacks import (  # noqa: F401
+    ReduceLROnPlateau, VisualDL, WandbCallback)
 
 __all__ = [n for n in dir() if not n.startswith("_")]

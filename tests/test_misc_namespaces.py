@@ -130,3 +130,43 @@ def test_legacy_dataset_reader_creators(tmp_path):
     x, y = samples[0]
     assert x.shape == (13,) and y.shape == (1,)
     assert dataset.common.md5file(str(f))
+
+
+def test_reduce_lr_on_plateau_callback():
+    from paddle_tpu.callbacks import ReduceLROnPlateau
+
+    class FakeOpt:
+        lr = 1.0
+        def get_lr(self): return self.lr
+        def set_lr(self, v): self.lr = v
+
+    class FakeModel:
+        _optimizer = FakeOpt()
+
+    cb = ReduceLROnPlateau(monitor="loss", factor=0.5, patience=2, verbose=0)
+    cb.set_model(FakeModel())
+    cb.on_eval_end({"loss": 1.0})
+    for _ in range(2):
+        cb.on_eval_end({"loss": 1.0})  # no improvement
+    assert abs(FakeModel._optimizer.lr - 0.5) < 1e-9
+
+
+def test_visualdl_callback_writes_scalars(tmp_path):
+    import json
+
+    from paddle_tpu.callbacks import VisualDL
+
+    cb = VisualDL(log_dir=str(tmp_path))
+    cb.on_train_batch_end(0, {"loss": 0.5})
+    cb.on_eval_end({"acc": [0.9]})
+    cb.on_train_end()
+    lines = [json.loads(ln) for ln in
+             (tmp_path / "scalars.jsonl").read_text().splitlines()]
+    assert {r["tag"] for r in lines} == {"train/loss", "eval/acc"}
+
+
+def test_wandb_callback_requires_package():
+    from paddle_tpu.callbacks import WandbCallback
+
+    with pytest.raises(ImportError, match="wandb"):
+        WandbCallback(project="x")
