@@ -56,9 +56,12 @@ class CostModel:
         """Calibration table {op: microseconds} — measured lazily on first
         use and cached next to the package."""
         if self._static_data is None:
+            import jax
+
+            platform = jax.devices()[0].platform
             cache = os.path.join(
                 os.path.expanduser("~"), ".cache", "paddle_tpu",
-                "op_cost.json")
+                f"op_cost_{platform}.json")  # timings are per-backend
             if os.path.exists(cache):
                 with open(cache) as f:
                     self._static_data = json.load(f)

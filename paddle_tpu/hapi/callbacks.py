@@ -174,7 +174,9 @@ class ReduceLROnPlateau(Callback):
         self.factor = factor
         self.patience = patience
         self.verbose = verbose
-        self.mode = "min" if mode == "auto" else mode
+        if mode == "auto":  # accuracy-like monitors maximize (ref contract)
+            mode = "max" if "acc" in monitor else "min"
+        self.mode = mode
         self.min_delta = abs(min_delta)
         self.cooldown = cooldown
         self.min_lr = min_lr
@@ -197,8 +199,13 @@ class ReduceLROnPlateau(Callback):
             cur = cur[0]
         cur = float(cur)
         if self.cooldown_counter > 0:
+            # inside the cooldown window: track best but don't accumulate
+            # non-improvement (no further reductions until it expires)
             self.cooldown_counter -= 1
             self.wait = 0
+            if self._better(cur):
+                self.best = cur
+            return
         if self._better(cur):
             self.best = cur
             self.wait = 0
@@ -229,6 +236,7 @@ class VisualDL(Callback):
         self.log_dir = log_dir
         self._fh = None
         self._step = 0
+        self._eval_step = 0
 
     def _write(self, tag, value, step):
         import json
@@ -252,9 +260,12 @@ class VisualDL(Callback):
                 self._write(f"train/{k}", v, self._step)
 
     def on_eval_end(self, logs=None):
+        # monotone, distinct x per eval — tracks the train step during
+        # training and keeps advancing for standalone/repeated evals
+        self._eval_step += 1
         for k, v in (logs or {}).items():
             if k not in ("batch_size", "steps"):
-                self._write(f"eval/{k}", v, self._step)
+                self._write(f"eval/{k}", v, self._step + self._eval_step)
 
     def on_train_end(self, logs=None):
         if self._fh is not None:

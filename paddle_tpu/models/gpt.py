@@ -289,6 +289,16 @@ class GPTForCausalLM(nn.Layer):
                 return jax.lax.dynamic_index_in_dim(full, pos, axis=1,
                                                     keepdims=False)
 
+            # one compiled program per decode configuration: jit's cache is
+            # keyed on function identity, so the closure is memoized here —
+            # repeat generate() calls with the same shapes/flags reuse the
+            # executable instead of retracing the whole scan
+            cache_key = (b, prompt_len, max_new_tokens, bool(do_sample),
+                         float(temperature), int(top_k), int(eos_token_id))
+            cached = getattr(self, "_gen_cache", None)
+            if cached is not None and cached[0] == cache_key:
+                return Tensor(cached[1](arrays, ids, jax.random.key(seed)))
+
             def decode(param_arrays, start_ids, key):
                 buf = jnp.zeros((b, total), start_ids.dtype)
                 buf = jax.lax.dynamic_update_slice(buf, start_ids, (0, 0))
@@ -299,8 +309,9 @@ class GPTForCausalLM(nn.Layer):
                     if do_sample:
                         key, sub = jax.random.split(key)
                         scaled = logits / jnp.maximum(temperature, 1e-6)
-                        if top_k > 0:
-                            kth = jnp.sort(scaled, axis=-1)[:, -top_k][:, None]
+                        k_eff = min(top_k, self.cfg.vocab_size)
+                        if k_eff > 0:
+                            kth = jnp.sort(scaled, axis=-1)[:, -k_eff][:, None]
                             scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
                         nxt = jax.random.categorical(sub, scaled)
                     else:
@@ -319,8 +330,9 @@ class GPTForCausalLM(nn.Layer):
                     None, length=max_new_tokens)
                 return buf
 
-            out = jax.jit(decode)(arrays, ids, jax.random.key(seed))
-            return Tensor(out)
+            jitted = jax.jit(decode)
+            self._gen_cache = (cache_key, jitted)
+            return Tensor(jitted(arrays, ids, jax.random.key(seed)))
         finally:
             if was_training:
                 self.train()

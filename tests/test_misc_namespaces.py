@@ -170,3 +170,41 @@ def test_wandb_callback_requires_package():
 
     with pytest.raises(ImportError, match="wandb"):
         WandbCallback(project="x")
+
+
+def test_reduce_lr_cooldown_suppresses_repeat_cuts():
+    from paddle_tpu.callbacks import ReduceLROnPlateau
+
+    class FakeOpt:
+        lr = 1.0
+        def get_lr(self): return self.lr
+        def set_lr(self, v): self.lr = v
+
+    class FakeModel:
+        _optimizer = FakeOpt()
+
+    cb = ReduceLROnPlateau(monitor="loss", factor=0.5, patience=1,
+                           cooldown=3, verbose=0)
+    cb.set_model(FakeModel())
+    cb.on_eval_end({"loss": 1.0})
+    for _ in range(4):  # plateaued evals: one cut, then cooldown holds
+        cb.on_eval_end({"loss": 1.0})
+    assert abs(FakeModel._optimizer.lr - 0.5) < 1e-9
+
+
+def test_reduce_lr_auto_mode_maximizes_accuracy():
+    from paddle_tpu.callbacks import ReduceLROnPlateau
+
+    class FakeOpt:
+        lr = 1.0
+        def get_lr(self): return self.lr
+        def set_lr(self, v): self.lr = v
+
+    class FakeModel:
+        _optimizer = FakeOpt()
+
+    cb = ReduceLROnPlateau(monitor="acc", patience=2, verbose=0)
+    cb.set_model(FakeModel())
+    for a in (0.5, 0.6, 0.7, 0.8):  # steadily improving accuracy
+        cb.on_eval_end({"acc": a})
+    assert FakeModel._optimizer.lr == 1.0  # never reduced
