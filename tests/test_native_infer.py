@@ -153,3 +153,20 @@ def test_cpp_demo_app(artifact, tmp_path):
                        capture_output=True, text=True, timeout=120)
     assert r.returncode == 0, r.stderr
     assert "ok" in r.stdout and "output 0" in r.stdout
+
+
+def test_gpt_exports_tpu_pdnative(tmp_path):
+    """The flagship model cross-lowers to a TPU-platform deploy artifact
+    from a CPU host (jax.export platforms=['tpu'])."""
+    from paddle_tpu.models.gpt import GPTForCausalLM, gpt_tiny
+
+    m = GPTForCausalLM(gpt_tiny())
+    m.eval()
+    path = str(tmp_path / "gpt")
+    paddle.jit.save(m, path, input_spec=[InputSpec([2, 16], "int32")])
+    art = pdnative.read(path + ".pdnative")
+    assert art["platform"] == "tpu"
+    assert sum(1 for a in art["args"] if a.is_weight) == len(
+        m.state_dict())
+    (out,) = art["outputs"]
+    assert out.shape == (2, 16, m.cfg.vocab_size)
