@@ -112,3 +112,34 @@ def test_decoder_cache_pipeline():
         outs.append(out.numpy())
     np.testing.assert_allclose(outs[-1][:, 0], full[:, -1], rtol=1e-4,
                                atol=1e-5)
+
+
+def test_cached_decode_matches_padded_full_forward(model):
+    """KV-cache incremental decode must produce exactly the padded
+    full-forward decode's tokens (greedy, same model)."""
+    rng = np.random.default_rng(5)
+    ids = rng.integers(0, 1024, (2, 6), dtype=np.int32)
+    full = np.asarray(model.generate(paddle.to_tensor(ids), max_new_tokens=5,
+                                     use_cache=False).numpy())
+    cached = np.asarray(model.generate(paddle.to_tensor(ids),
+                                       max_new_tokens=5,
+                                       use_cache=True).numpy())
+    np.testing.assert_array_equal(full, cached)
+
+
+def test_cached_decode_with_sampling_and_eos(model):
+    rng = np.random.default_rng(6)
+    ids = rng.integers(0, 1024, (1, 4), dtype=np.int32)
+    s1 = np.asarray(model.generate(paddle.to_tensor(ids), max_new_tokens=4,
+                                   do_sample=True, top_k=5, seed=11,
+                                   use_cache=True).numpy())
+    s2 = np.asarray(model.generate(paddle.to_tensor(ids), max_new_tokens=4,
+                                   do_sample=True, top_k=5, seed=11,
+                                   use_cache=True).numpy())
+    np.testing.assert_array_equal(s1, s2)
+    g = np.asarray(model.generate(paddle.to_tensor(ids), max_new_tokens=3,
+                                  use_cache=True).numpy())
+    eos = int(g[0, 4])
+    out = np.asarray(model.generate(paddle.to_tensor(ids), max_new_tokens=3,
+                                    eos_token_id=eos, use_cache=True).numpy())
+    assert (out[0, 4:] == eos).all()
