@@ -144,10 +144,7 @@ class FusedMultiHeadAttention(nn.Layer):
             [embed_dim], default_initializer=nn.initializer.Constant(0.0))
 
     def forward(self, query, key=None, value=None, attn_mask=None,
-                cache=None):
-        if cache is not None:
-            raise NotImplementedError("cache is served by "
-                                      "FusedMultiTransformer")
+                cache=None, time_step=None):
         residual = query
         x = query
         if self.normalize_before:
@@ -162,9 +159,36 @@ class FusedMultiHeadAttention(nn.Layer):
         qkv = apply(_qkv, (x, self.qkv_weight, self.qkv_bias), {},
                     name="fused_qkv")
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # [b,s,h,d] each
-        ctx = F.scaled_dot_product_attention(
-            q, k, v, attn_mask=attn_mask,
-            dropout_p=self.attn_dropout_rate, training=self.training)
+        if cache is not None:
+            # incremental decode against a preallocated [b, max_len, h, d]
+            # buffer pair, written at time_step (absolute-position mask)
+            def _cached(qa, ka, va, kb, vb, pos):
+                kb = jax.lax.dynamic_update_slice(kb, ka, (0, pos, 0, 0))
+                vb = jax.lax.dynamic_update_slice(vb, va, (0, pos, 0, 0))
+                j = jnp.arange(kb.shape[1])[None, :]
+                i = pos + jnp.arange(qa.shape[1])[:, None]
+                mask = (j <= i)[None, None]
+                qt, kt, vt = (jnp.swapaxes(t, 1, 2) for t in (qa, kb, vb))
+                scale = 1.0 / math.sqrt(qa.shape[-1])
+                logits = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) * scale
+                logits = jnp.where(mask, logits, -1e30)
+                p = jax.nn.softmax(logits.astype(jnp.float32), -1).astype(
+                    qa.dtype)
+                o = jnp.swapaxes(jnp.einsum("bhqk,bhkd->bhqd", p, vt), 1, 2)
+                return o, kb, vb
+
+            pos = time_step if time_step is not None else 0
+            pos_t = Tensor(jnp.asarray(
+                pos._data if isinstance(pos, Tensor) else pos, jnp.int32))
+            ctx, kb2, vb2 = apply(
+                _cached, (q, k, v, cache[0], cache[1], pos_t), {},
+                name="fused_cached_attn")
+            cache_out = (kb2, vb2)
+        else:
+            ctx = F.scaled_dot_product_attention(
+                q, k, v, attn_mask=attn_mask,
+                dropout_p=self.attn_dropout_rate, training=self.training)
+            cache_out = None
         b, s = ctx.shape[0], ctx.shape[1]
         ctx = ctx.reshape([b, s, self.embed_dim])
         out = F.linear(ctx, self.linear_weight, self.linear_bias)
@@ -173,7 +197,7 @@ class FusedMultiHeadAttention(nn.Layer):
         if not self.normalize_before:
             out = F.layer_norm(out, [self.embed_dim], weight=self.ln_scale,
                                bias=self.ln_bias, epsilon=self._epsilon)
-        return out
+        return out if cache_out is None else (out, cache_out)
 
 
 class FusedFeedForward(nn.Layer):
@@ -233,7 +257,12 @@ class FusedTransformerEncoderLayer(nn.Layer):
             activation=activation, act_dropout_rate=act_dropout_rate,
             normalize_before=normalize_before)
 
-    def forward(self, src, src_mask=None, cache=None):
+    def forward(self, src, src_mask=None, cache=None, time_step=None):
+        if cache is not None:
+            out, new_cache = self.fused_attn(src, attn_mask=src_mask,
+                                             cache=cache,
+                                             time_step=time_step)
+            return self.ffn(out), new_cache
         out = self.fused_attn(src, attn_mask=src_mask)
         return self.ffn(out)
 
@@ -269,21 +298,32 @@ class FusedMultiTransformer(nn.Layer):
             for _ in range(num_layers)])
         self.norm = nn.LayerNorm(embed_dim, epsilon=epsilon)
 
+    def gen_caches(self, batch, max_len, dtype="float32"):
+        """Per-layer preallocated (k, v) buffers for cached decoding."""
+        from ...ops import creation
+
+        head_dim = self.layers[0].fused_attn.head_dim
+        heads = self.layers[0].fused_attn.num_heads
+        shape = [batch, max_len, heads, head_dim]
+        return [(creation.zeros(shape, dtype=dtype),
+                 creation.zeros(shape, dtype=dtype))
+                for _ in self.layers]
+
     def forward(self, src, attn_mask=None, caches=None, pre_caches=None,
                 rotary_embs=None, rotary_emb_dims=0, seq_lens=None,
                 time_step=None):
         out = src
-        new_caches = [] if caches is not None else None
-        for i, layer in enumerate(self.layers):
-            if caches is not None:
-                # decode step: concat cached K/V via the plain attention path
-                raise NotImplementedError(
-                    "KV-cache decoding goes through nn.TransformerDecoder "
-                    "incremental path; FusedMultiTransformer serves full "
-                    "sequences")
+        if caches is not None:
+            new_caches = []
+            for layer, cache in zip(self.layers, caches):
+                out, nc = layer(out, src_mask=attn_mask, cache=cache,
+                                time_step=time_step)
+                new_caches.append(nc)
+            return self.norm(out), new_caches
+        for layer in self.layers:
             out = layer(out, src_mask=attn_mask)
         out = self.norm(out)
-        return (out, new_caches) if caches is not None else out
+        return out
 
 
 class FusedEcMoe(nn.Layer):
