@@ -1,0 +1,65 @@
+"""Autoregressive decode throughput: tokens/sec through the compiled
+KV-cache generate loop (the serving-side companion to bench.py's training
+number).
+
+Usage: python benches/decode_bench.py  (TPU: GPT-base; CPU: tiny smoke)
+Env: DECODE_BATCH, DECODE_PROMPT, DECODE_NEW, DECODE_ITERS.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+
+def main():
+    import jax
+
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM, gpt_tiny
+
+    dev = jax.devices()[0]
+    platform = dev.platform
+    if platform == "tpu":
+        cfg = GPTConfig(vocab_size=50304, hidden_size=768, num_layers=12,
+                        num_heads=12, max_position_embeddings=2048)
+        batch = int(os.environ.get("DECODE_BATCH", "8"))
+        prompt = int(os.environ.get("DECODE_PROMPT", "128"))
+        new = int(os.environ.get("DECODE_NEW", "128"))
+        iters = int(os.environ.get("DECODE_ITERS", "5"))
+    else:
+        cfg = gpt_tiny()
+        batch, prompt, new, iters = 2, 16, 16, 2
+
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    rng = np.random.default_rng(0)
+    ids = Tensor(rng.integers(0, cfg.vocab_size, (batch, prompt),
+                              dtype=np.int32))
+
+    out = model.generate(ids, max_new_tokens=new)  # compile + warm
+    jax.block_until_ready(out._data)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = model.generate(ids, max_new_tokens=new)
+    jax.block_until_ready(out._data)
+    dt = time.perf_counter() - t0
+
+    toks = batch * new * iters
+    print(json.dumps({
+        "metric": f"decode tokens/sec (GPT {cfg.hidden_size}h/"
+                  f"{cfg.num_layers}L b{batch} p{prompt}+{new} {platform})",
+        "value": round(toks / dt, 1),
+        "unit": "tokens/sec",
+        "ms_per_token": round(dt / toks * 1e3, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()
