@@ -81,14 +81,48 @@ class MultiHeadAttention(Layer):
         # sdpa layout [b, s, h, d]
         k = k_c.transpose([0, 2, 1, 3])
         v = v_c.transpose([0, 2, 1, 3])
-        out = F.scaled_dot_product_attention(
-            q, k, v, attn_mask=attn_mask,
-            dropout_p=self.dropout if self.training else 0.0,
-            training=self.training,
-        )
+        weights = None
+        if self.need_weights:
+            # explicit-probs path: materialize [b, h, q, k] attention weights
+            import math as _math
+
+            import jax
+            import jax.numpy as jnp
+
+            from ..core.dispatch import apply as _apply
+
+            def _attn_w(qa, ka, va, *rest):
+                # qa/ka/va in [b, s, h, d]
+                m = rest[0] if rest else None
+                qt, kt, vt = (jnp.swapaxes(t, 1, 2) for t in (qa, ka, va))
+                logits = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) / _math.sqrt(
+                    qa.shape[-1])
+                if m is not None:
+                    logits = (jnp.where(m, logits, -1e30)
+                              if m.dtype == jnp.bool_ else logits + m)
+                p = jax.nn.softmax(logits.astype(jnp.float32), -1).astype(
+                    qa.dtype)
+                o = jnp.swapaxes(jnp.einsum("bhqk,bhkd->bhqd", p, vt), 1, 2)
+                return o, p
+
+            args = (q, k, v)
+            if attn_mask is not None:
+                args += (attn_mask,)
+            out, weights = _apply(_attn_w, args, {}, name="mha_with_weights")
+        else:
+            out = F.scaled_dot_product_attention(
+                q, k, v, attn_mask=attn_mask,
+                dropout_p=self.dropout if self.training else 0.0,
+                training=self.training,
+            )
         out = out.reshape([b, sq, self.embed_dim])
         out = self.out_proj(out)
-        return (out, cache) if had_cache else out
+        outs = [out]
+        if self.need_weights:
+            outs.append(weights)
+        if had_cache:
+            outs.append(cache)
+        return out if len(outs) == 1 else tuple(outs)
 
 
 class TransformerEncoderLayer(Layer):
@@ -112,10 +146,16 @@ class TransformerEncoderLayer(Layer):
     def _act(self, x):
         return F.gelu(x) if self.activation == "gelu" else F.relu(x)
 
+    def gen_cache(self, src):
+        return self.self_attn.gen_cache(src)
+
     def forward(self, src, src_mask=None, cache=None):
         residual = src
         x = self.norm1(src) if self.normalize_before else src
-        x = self.self_attn(x, attn_mask=src_mask)
+        if cache is None:
+            x = self.self_attn(x, attn_mask=src_mask)
+        else:
+            x, new_cache = self.self_attn(x, attn_mask=src_mask, cache=cache)
         x = residual + self.dropout1(x)
         if not self.normalize_before:
             x = self.norm1(x)
@@ -125,7 +165,7 @@ class TransformerEncoderLayer(Layer):
         y = residual + self.dropout(y)
         if not self.normalize_before:
             y = self.norm2(y)
-        return y
+        return y if cache is None else (y, new_cache)
 
 
 class TransformerEncoder(Layer):
@@ -148,13 +188,21 @@ class TransformerEncoder(Layer):
 
         self.layers = LayerList([factory(i) for i in range(num_layers)])
 
-    def forward(self, src, src_mask=None):
+    def gen_cache(self, src):
+        return [layer.gen_cache(src) for layer in self.layers]
+
+    def forward(self, src, src_mask=None, cache=None):
         out = src
-        for layer in self.layers:
-            out = layer(out, src_mask=src_mask)
+        new_caches = []
+        for i, layer in enumerate(self.layers):
+            if cache is None:
+                out = layer(out, src_mask=src_mask)
+            else:
+                out, nc = layer(out, src_mask=src_mask, cache=cache[i])
+                new_caches.append(nc)
         if self.norm is not None:
             out = self.norm(out)
-        return out
+        return out if cache is None else (out, new_caches)
 
 
 class TransformerDecoderLayer(Layer):

@@ -143,3 +143,41 @@ def test_cached_decode_with_sampling_and_eos(model):
     out = np.asarray(model.generate(paddle.to_tensor(ids), max_new_tokens=3,
                                     eos_token_id=eos, use_cache=True).numpy())
     assert (out[0, 4:] == eos).all()
+
+
+def test_mha_need_weights_returns_probs():
+    paddle.seed(3)
+    mha = nn.MultiHeadAttention(16, 4, need_weights=True)
+    mha.eval()
+    x = paddle.to_tensor(np.random.default_rng(5).standard_normal(
+        (2, 6, 16)).astype(np.float32))
+    out, weights = mha(x)
+    assert out.shape == [2, 6, 16]
+    w = np.asarray(weights.numpy())
+    assert w.shape == (2, 4, 6, 6)
+    np.testing.assert_allclose(w.sum(-1), 1.0, rtol=1e-5)  # softmax rows
+    # matches the need_weights=False output
+    mha2 = nn.MultiHeadAttention(16, 4)
+    mha2.eval()
+    mha2.set_state_dict(mha.state_dict())
+    np.testing.assert_allclose(out.numpy(), mha2(x).numpy(), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_encoder_incremental_cache():
+    paddle.seed(4)
+    layer = nn.TransformerEncoderLayer(16, 4, 32, dropout=0.0)
+    enc = nn.TransformerEncoder(layer, num_layers=2)
+    enc.eval()
+    x = paddle.to_tensor(np.random.default_rng(6).standard_normal(
+        (1, 5, 16)).astype(np.float32))
+    causal = paddle.to_tensor(np.tril(np.ones((1, 1, 5, 5), bool)))
+    full = enc(x, src_mask=causal).numpy()
+    caches = enc.gen_cache(x)
+    outs = []
+    from paddle_tpu.core.tensor import Tensor
+    for t in range(5):
+        out, caches = enc(Tensor(x._data[:, t:t + 1]), cache=caches)
+        outs.append(out.numpy())
+    np.testing.assert_allclose(outs[-1][:, 0], full[:, -1], rtol=1e-4,
+                               atol=1e-5)
