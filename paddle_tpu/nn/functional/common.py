@@ -6,6 +6,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ...core import rng
 from ...core.dispatch import apply
@@ -115,16 +116,71 @@ def interpolate(
             scale_factor = [scale_factor] * len(spatial)
         out_size = tuple(int(s * f) for s, f in zip(spatial, scale_factor))
 
-    jmode = {"nearest": "nearest", "bilinear": "linear", "trilinear": "linear", "linear": "linear", "bicubic": "cubic", "area": "linear"}[mode]
+    amode = {"nearest": "nearest", "bilinear": "linear",
+             "trilinear": "linear", "linear": "linear", "bicubic": "cubic",
+             "area": "area"}[mode]
 
-    def _interp(x, *, out_size, jmode, nchw):
-        if nchw:
-            full = x.shape[:2] + out_size
+    def _axis_matrix(in_s, out_s):
+        """[out_s, in_s] resampling weights with the paddle/torch index
+        conventions (align_corners, half-pixel, legacy align_mode=1,
+        replicate borders, bicubic a=-0.75)."""
+        i = np.arange(out_s, dtype=np.float64)
+        W = np.zeros((out_s, in_s))
+        rows = np.arange(out_s)
+        if amode == "nearest":
+            if align_corners:
+                src = np.round(i * (in_s - 1) / max(out_s - 1, 1))
+            else:
+                src = np.floor(i * in_s / out_s)
+            W[rows, np.clip(src.astype(int), 0, in_s - 1)] = 1.0
+            return W
+        if amode == "area":
+            start = np.floor(i * in_s / out_s).astype(int)
+            end = np.ceil((i + 1) * in_s / out_s).astype(int)
+            for o in range(out_s):
+                W[o, start[o]:end[o]] = 1.0 / (end[o] - start[o])
+            return W
+        if align_corners:
+            src = i * (in_s - 1) / max(out_s - 1, 1)
+        elif amode == "linear" and align_mode == 1:
+            src = i * in_s / out_s
         else:
-            full = (x.shape[0],) + out_size + (x.shape[-1],)
-        return jax.image.resize(x, full, method=jmode).astype(x.dtype)
+            src = (i + 0.5) * in_s / out_s - 0.5
+        if amode == "linear":
+            src = np.clip(src, 0, in_s - 1)
+            lo = np.floor(src).astype(int)
+            hi = np.minimum(lo + 1, in_s - 1)
+            t = src - lo
+            np.add.at(W, (rows, lo), 1.0 - t)
+            np.add.at(W, (rows, hi), t)
+            return W
+        # cubic convolution, a=-0.75 (torch/paddle kernel); replicate border
+        a = -0.75
+        lo = np.floor(src).astype(int)
+        t = src - lo
+        w_m1 = ((a * (t + 1) - 5 * a) * (t + 1) + 8 * a) * (t + 1) - 4 * a
+        w_0 = ((a + 2) * t - (a + 3)) * t * t + 1
+        u = 1 - t
+        w_p1 = ((a + 2) * u - (a + 3)) * u * u + 1
+        w_p2 = 1.0 - w_m1 - w_0 - w_p1
+        for off, w in ((-1, w_m1), (0, w_0), (1, w_p1), (2, w_p2)):
+            np.add.at(W, (rows, np.clip(lo + off, 0, in_s - 1)), w)
+        return W
 
-    return apply(_interp, (x,), dict(out_size=out_size, jmode=jmode, nchw=nchw))
+    mats = [_axis_matrix(int(s), int(o)) for s, o in zip(spatial, out_size)]
+
+    def _interp(x, *, nchw):
+        out = x
+        first_spatial = 2 if nchw else 1
+        for k, W in enumerate(mats):
+            axis = first_spatial + k
+            Wa = jnp.asarray(W, jnp.float32)
+            moved = jnp.moveaxis(out, axis, -1)
+            moved = (moved.astype(jnp.float32) @ Wa.T).astype(x.dtype)
+            out = jnp.moveaxis(moved, -1, axis)
+        return out
+
+    return apply(_interp, (x,), dict(nchw=nchw), name="interpolate")
 
 
 def upsample(x, size=None, scale_factor=None, mode="nearest", align_corners=False, align_mode=0, data_format="NCHW", name=None):

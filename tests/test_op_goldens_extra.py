@@ -1,0 +1,131 @@
+"""Edge-case torch-golden battery for geometry-sensitive ops: conv
+(groups/dilation/same-padding), interpolate modes, pad modes, adaptive
+pools, pixel shuffle, grid_sample (ref test/legacy_test op tests)."""
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as TF
+
+import paddle_tpu as paddle
+from paddle_tpu.nn import functional as F
+
+
+def _t(x):
+    return paddle.to_tensor(np.asarray(x, np.float32))
+
+
+RNG = np.random.default_rng(0)
+
+
+@pytest.mark.parametrize("groups,dilation,padding,stride", [
+    (1, 1, 0, 1),
+    (1, 1, 2, 2),
+    (2, 1, 1, 1),
+    (4, 1, 0, 1),
+    (1, 2, 2, 1),
+    (2, 2, 3, 2),
+])
+def test_conv2d_variants(groups, dilation, padding, stride):
+    x = RNG.standard_normal((2, 4, 12, 12)).astype(np.float32)
+    w = RNG.standard_normal((8, 4 // groups, 3, 3)).astype(np.float32)
+    got = F.conv2d(_t(x), _t(w), stride=stride, padding=padding,
+                   dilation=dilation, groups=groups).numpy()
+    want = TF.conv2d(torch.tensor(x), torch.tensor(w), stride=stride,
+                     padding=padding, dilation=dilation,
+                     groups=groups).numpy()
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_conv2d_same_padding():
+    x = RNG.standard_normal((1, 3, 11, 11)).astype(np.float32)
+    w = RNG.standard_normal((5, 3, 3, 3)).astype(np.float32)
+    got = F.conv2d(_t(x), _t(w), padding="SAME").numpy()
+    want = TF.conv2d(torch.tensor(x), torch.tensor(w),
+                     padding="same").numpy()
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("mode,align", [
+    ("nearest", False),
+    ("bilinear", False),
+    ("bilinear", True),
+    ("bicubic", False),
+    ("bicubic", True),
+])
+def test_interpolate_modes(mode, align):
+    x = RNG.standard_normal((1, 2, 6, 6)).astype(np.float32)
+    kwargs = {} if mode == "nearest" else {"align_corners": align}
+    got = F.interpolate(_t(x), size=[11, 9], mode=mode, **kwargs).numpy()
+    want = TF.interpolate(torch.tensor(x), size=[11, 9], mode=mode,
+                          **({} if mode == "nearest"
+                             else {"align_corners": align})).numpy()
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("mode", ["constant", "reflect", "replicate",
+                                  "circular"])
+def test_pad_modes(mode):
+    x = RNG.standard_normal((1, 2, 5, 5)).astype(np.float32)
+    got = F.pad(_t(x), [1, 2, 2, 1], mode=mode).numpy()
+    want = TF.pad(torch.tensor(x), (1, 2, 2, 1), mode=mode).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("out_size", [1, 3, 5])
+def test_adaptive_pools(out_size):
+    x = RNG.standard_normal((2, 3, 7, 9)).astype(np.float32)
+    got = F.adaptive_avg_pool2d(_t(x), out_size).numpy()
+    want = TF.adaptive_avg_pool2d(torch.tensor(x), out_size).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+    got = F.adaptive_max_pool2d(_t(x), out_size).numpy()
+    want = TF.adaptive_max_pool2d(torch.tensor(x), out_size).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("ceil_mode", [False, True])
+def test_avg_pool_ceil_and_pad(ceil_mode):
+    x = RNG.standard_normal((1, 2, 7, 7)).astype(np.float32)
+    got = F.avg_pool2d(_t(x), 3, 2, padding=1, ceil_mode=ceil_mode,
+                       exclusive=False).numpy()
+    want = TF.avg_pool2d(torch.tensor(x), 3, 2, padding=1,
+                         ceil_mode=ceil_mode,
+                         count_include_pad=True).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_pixel_shuffle_and_unshuffle():
+    x = RNG.standard_normal((1, 8, 4, 4)).astype(np.float32)
+    got = F.pixel_shuffle(_t(x), 2).numpy()
+    want = TF.pixel_shuffle(torch.tensor(x), 2).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+    back = F.pixel_unshuffle(_t(got), 2).numpy()
+    np.testing.assert_allclose(back, x, rtol=1e-6)
+
+
+@pytest.mark.parametrize("mode,align", [("bilinear", True),
+                                        ("bilinear", False),
+                                        ("nearest", True)])
+def test_grid_sample(mode, align):
+    x = RNG.standard_normal((1, 2, 5, 5)).astype(np.float32)
+    grid = (RNG.random((1, 4, 4, 2)) * 2 - 1).astype(np.float32)
+    got = F.grid_sample(_t(x), _t(grid), mode=mode,
+                        align_corners=align).numpy()
+    want = TF.grid_sample(torch.tensor(x), torch.tensor(grid), mode=mode,
+                          align_corners=align).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_local_response_norm():
+    x = RNG.standard_normal((2, 6, 5, 5)).astype(np.float32)
+    got = F.local_response_norm(_t(x), size=3, alpha=1e-4, beta=0.75,
+                                k=1.0).numpy()
+    want = TF.local_response_norm(torch.tensor(x), size=3, alpha=1e-4,
+                                  beta=0.75, k=1.0).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_unfold_matches_torch():
+    x = RNG.standard_normal((1, 3, 8, 8)).astype(np.float32)
+    got = F.unfold(_t(x), 3, strides=2, paddings=1).numpy()
+    want = TF.unfold(torch.tensor(x), 3, stride=2, padding=1).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
