@@ -127,9 +127,12 @@ def l1_loss(input, label, reduction="mean", name=None):
 
 
 def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    # the reference lowers this to huber_loss (ref:python/paddle/nn/
+    # functional/loss.py:1120): 0.5 z^2 inside delta, delta|z| - 0.5 delta^2
+    # outside
     def _sl1(x, y, *, reduction, delta):
-        d = x - y
-        loss = jnp.where(jnp.abs(d) < delta, 0.5 * d * d / delta, jnp.abs(d) - 0.5 * delta)
+        d = jnp.abs(x - y)
+        loss = jnp.where(d < delta, 0.5 * d * d, delta * d - 0.5 * delta * delta)
         return _reduce(loss, reduction)
 
     return apply(_sl1, (input, label), dict(reduction=reduction, delta=float(delta)))

@@ -129,3 +129,79 @@ def test_unfold_matches_torch():
     got = F.unfold(_t(x), 3, strides=2, paddings=1).numpy()
     want = TF.unfold(torch.tensor(x), 3, stride=2, padding=1).numpy()
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("groups", [1, 2, 3])
+def test_group_norm_matches_torch(groups):
+    x = RNG.standard_normal((2, 6, 5, 5)).astype(np.float32)
+    w = RNG.standard_normal(6).astype(np.float32)
+    b = RNG.standard_normal(6).astype(np.float32)
+    got = F.group_norm(_t(x), groups, weight=_t(w), bias=_t(b),
+                       epsilon=1e-5).numpy()
+    want = TF.group_norm(torch.tensor(x), groups, torch.tensor(w),
+                         torch.tensor(b), eps=1e-5).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_instance_norm_matches_torch():
+    x = RNG.standard_normal((2, 3, 6, 6)).astype(np.float32)
+    got = F.instance_norm(_t(x), eps=1e-5).numpy()
+    want = TF.instance_norm(torch.tensor(x), eps=1e-5).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_conv1d_conv3d():
+    x1 = RNG.standard_normal((2, 3, 12)).astype(np.float32)
+    w1 = RNG.standard_normal((4, 3, 3)).astype(np.float32)
+    got = F.conv1d(_t(x1), _t(w1), stride=2, padding=1).numpy()
+    want = TF.conv1d(torch.tensor(x1), torch.tensor(w1), stride=2,
+                     padding=1).numpy()
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+    x3 = RNG.standard_normal((1, 2, 6, 6, 6)).astype(np.float32)
+    w3 = RNG.standard_normal((3, 2, 3, 3, 3)).astype(np.float32)
+    got = F.conv3d(_t(x3), _t(w3), padding=1).numpy()
+    want = TF.conv3d(torch.tensor(x3), torch.tensor(w3), padding=1).numpy()
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_kl_div_and_smooth_l1_conventions():
+    p = RNG.random((4, 5)).astype(np.float32) + 0.1
+    logq = np.log(RNG.random((4, 5)).astype(np.float32) + 0.1)
+    # paddle kl_div(input=log-prob, label=prob), batchmean default? use 'mean'
+    got = F.kl_div(_t(logq), _t(p), reduction="mean").numpy()
+    want = TF.kl_div(torch.tensor(logq), torch.tensor(p),
+                     reduction="mean").numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+    x = RNG.standard_normal((6,)).astype(np.float32) * 3
+    y = RNG.standard_normal((6,)).astype(np.float32)
+    # the reference's smooth_l1_loss lowers to huber_loss (NOT torch's
+    # smooth_l1 beta parameterization)
+    got = F.smooth_l1_loss(_t(x), _t(y), delta=2.0).numpy()
+    want = TF.huber_loss(torch.tensor(x), torch.tensor(y),
+                         delta=2.0).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("act,targs", [
+    ("hardsigmoid", {}),
+    ("hardswish", {}),
+    ("mish", {}),
+    ("softsign", {}),
+    ("tanhshrink", {}),
+    ("hardshrink", {}),
+    ("softshrink", {}),
+    ("celu", {}),
+    ("selu", {}),
+    ("relu6", {}),
+    ("silu", {}),
+    ("log_sigmoid", {}),
+])
+def test_activations_match_torch(act, targs):
+    x = (RNG.standard_normal((3, 7)).astype(np.float32) * 3)
+    ours = getattr(F, act)
+    torch_name = {"log_sigmoid": "logsigmoid"}.get(act, act)
+    theirs = getattr(TF, torch_name)
+    np.testing.assert_allclose(ours(_t(x)).numpy(),
+                               theirs(torch.tensor(x)).numpy(),
+                               rtol=1e-4, atol=1e-5)
