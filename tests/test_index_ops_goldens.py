@@ -1,0 +1,94 @@
+"""Paddle-specific index/scatter semantics (these diverge from torch/numpy
+in overwrite behavior and axis conventions — ref:python/paddle/tensor/
+manipulation.py docstring contracts)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def T(x, dt=None):
+    return paddle.to_tensor(np.asarray(x, dt) if dt else np.asarray(x))
+
+
+def test_scatter_overwrite_true():
+    x = np.ones((3, 2), np.float32)
+    index = np.array([2, 1, 0, 1], np.int64)
+    updates = np.arange(8, dtype=np.float32).reshape(4, 2)
+    out = paddle.scatter(T(x), T(index), T(updates), overwrite=True).numpy()
+    # duplicate index 1: last write wins (paddle contract)
+    np.testing.assert_array_equal(out[2], updates[0])
+    np.testing.assert_array_equal(out[1], updates[3])
+    np.testing.assert_array_equal(out[0], updates[2])
+
+
+def test_scatter_overwrite_false_accumulates():
+    x = np.zeros((3, 2), np.float32)
+    index = np.array([1, 1, 0], np.int64)
+    updates = np.ones((3, 2), np.float32)
+    out = paddle.scatter(T(x), T(index), T(updates), overwrite=False).numpy()
+    np.testing.assert_array_equal(out[1], [2.0, 2.0])
+    np.testing.assert_array_equal(out[0], [1.0, 1.0])
+    np.testing.assert_array_equal(out[2], [0.0, 0.0])
+
+
+def test_scatter_nd_add():
+    x = np.zeros((4,), np.float32)
+    index = np.array([[1], [1], [3]], np.int64)
+    updates = np.array([1.0, 2.0, 5.0], np.float32)
+    out = paddle.scatter_nd_add(T(x), T(index), T(updates)).numpy()
+    np.testing.assert_array_equal(out, [0.0, 3.0, 0.0, 5.0])
+
+
+def test_put_along_axis_modes():
+    x = np.zeros((2, 3), np.float32)
+    idx = np.array([[0, 1, 2], [2, 1, 0]], np.int64)
+    val = np.ones((2, 3), np.float32)
+    out = paddle.put_along_axis(T(x), T(idx), T(val), axis=1).numpy()
+    np.testing.assert_array_equal(out, np.ones((2, 3)))
+    out = paddle.put_along_axis(T(np.ones((2, 3), np.float32)), T(idx),
+                                T(val), axis=1, reduce="add").numpy()
+    np.testing.assert_array_equal(out, np.full((2, 3), 2.0))
+
+
+def test_index_sample():
+    x = np.arange(12, dtype=np.float32).reshape(3, 4)
+    index = np.array([[0, 2], [1, 3], [0, 0]], np.int64)
+    out = paddle.index_sample(T(x), T(index)).numpy()
+    np.testing.assert_array_equal(out, [[0, 2], [5, 7], [8, 8]])
+
+
+def test_index_select_and_index_add():
+    x = np.arange(12, dtype=np.float32).reshape(3, 4)
+    out = paddle.index_select(T(x), T(np.array([2, 0], np.int64)),
+                              axis=0).numpy()
+    np.testing.assert_array_equal(out, x[[2, 0]])
+    added = paddle.index_add(T(x), T(np.array([0, 0], np.int64)), 0,
+                             T(np.ones((2, 4), np.float32))).numpy()
+    np.testing.assert_array_equal(added[0], x[0] + 2.0)
+    np.testing.assert_array_equal(added[1:], x[1:])
+
+
+def test_gather_nd_and_take_along_axis():
+    x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    index = np.array([[0, 2], [1, 0]], np.int64)
+    out = paddle.gather_nd(T(x), T(index)).numpy()
+    np.testing.assert_array_equal(out, np.stack([x[0, 2], x[1, 0]]))
+    idx = np.array([[[1], [0], [3]]], np.int64)
+    out = paddle.take_along_axis(T(x[:1]), T(idx), axis=2).numpy()
+    np.testing.assert_array_equal(out[0, :, 0], [x[0, 0, 1], x[0, 1, 0],
+                                                 x[0, 2, 3]])
+
+
+def test_masked_select_and_fill():
+    x = np.arange(6, dtype=np.float32).reshape(2, 3)
+    mask = x > 2
+    out = paddle.masked_select(T(x), T(mask)).numpy()
+    np.testing.assert_array_equal(out, [3, 4, 5])
+    filled = paddle.masked_fill(T(x), T(mask), -1.0).numpy()
+    np.testing.assert_array_equal(filled, np.where(mask, -1.0, x))
+
+
+def test_index_put_absent_matches_reference_surface():
+    # the reference snapshot predates paddle.index_put; we track its surface
+    assert not hasattr(paddle, "index_put")
