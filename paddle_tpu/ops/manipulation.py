@@ -466,10 +466,21 @@ def topk(x, k, axis=None, largest=True, sorted=True, name=None):
 
 
 def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
-    def _searchsorted(s, v, *, side):
-        return jnp.searchsorted(s, v, side=side)
+    def _searchsorted(s, v, *, side, int32):
+        if s.ndim > 1:
+            # paddle contract: row-wise search over the innermost dim —
+            # leading dims of sequence and values must match
+            flat_s = s.reshape((-1, s.shape[-1]))
+            flat_v = v.reshape((-1, v.shape[-1]))
+            out = jax.vmap(lambda a, b: jnp.searchsorted(a, b, side=side))(
+                flat_s, flat_v).reshape(v.shape)
+        else:
+            out = jnp.searchsorted(s, v, side=side)
+        return out.astype(jnp.int32) if int32 else out.astype(jnp.int64)
 
-    return apply(_searchsorted, (sorted_sequence, values), dict(side="right" if right else "left"), differentiable=False)
+    return apply(_searchsorted, (sorted_sequence, values),
+                 dict(side="right" if right else "left",
+                      int32=bool(out_int32)), differentiable=False)
 
 
 def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):

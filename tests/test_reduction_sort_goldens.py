@@ -1,0 +1,123 @@
+"""Flag-sensitive reduction/sort/search op semantics vs torch/numpy
+(descending sort, topk flags, searchsorted sides, unique return bundles,
+quantile interpolation, cumulative ops — ref:python/paddle/tensor/
+{search,math,stat}.py contracts)."""
+import numpy as np
+import pytest
+import torch
+
+import paddle_tpu as paddle
+
+
+RNG = np.random.default_rng(3)
+
+
+def T(x):
+    return paddle.to_tensor(np.asarray(x))
+
+
+def test_sort_argsort_descending_axes():
+    x = RNG.standard_normal((3, 5)).astype(np.float32)
+    for axis in (0, 1, -1):
+        for desc in (False, True):
+            got = paddle.sort(T(x), axis=axis, descending=desc).numpy()
+            want = np.sort(x, axis=axis)
+            if desc:
+                want = np.flip(want, axis=axis)
+            np.testing.assert_array_equal(got, want)
+            gi = paddle.argsort(T(x), axis=axis, descending=desc).numpy()
+            np.testing.assert_array_equal(
+                np.take_along_axis(x, gi, axis=axis), want)
+
+
+def test_topk_flags():
+    x = RNG.standard_normal((4, 7)).astype(np.float32)
+    vals, idxs = paddle.topk(T(x), k=3, largest=True, sorted=True)
+    tv, ti = torch.topk(torch.tensor(x), 3, largest=True, sorted=True)
+    np.testing.assert_allclose(vals.numpy(), tv.numpy(), rtol=1e-6)
+    np.testing.assert_array_equal(idxs.numpy(), ti.numpy())
+    vals, idxs = paddle.topk(T(x), k=2, largest=False)
+    tv, ti = torch.topk(torch.tensor(x), 2, largest=False)
+    np.testing.assert_allclose(np.sort(vals.numpy(), -1),
+                               np.sort(tv.numpy(), -1), rtol=1e-6)
+
+
+def test_searchsorted_sides():
+    sorted_seq = np.array([[1.0, 3.0, 5.0, 7.0]], np.float32)
+    vals = np.array([[3.0, 4.0, 7.0]], np.float32)
+    got_l = paddle.searchsorted(T(sorted_seq), T(vals), right=False).numpy()
+    got_r = paddle.searchsorted(T(sorted_seq), T(vals), right=True).numpy()
+    np.testing.assert_array_equal(got_l[0], [1, 2, 3])
+    np.testing.assert_array_equal(got_r[0], [2, 2, 4])
+
+
+def test_unique_bundle():
+    x = np.array([2, 1, 2, 3, 1], np.int64)
+    out, index, inverse, counts = paddle.unique(
+        T(x), return_index=True, return_inverse=True, return_counts=True)
+    np.testing.assert_array_equal(out.numpy(), [1, 2, 3])
+    np.testing.assert_array_equal(out.numpy()[inverse.numpy()], x)
+    np.testing.assert_array_equal(counts.numpy(), [2, 2, 1])
+    np.testing.assert_array_equal(x[index.numpy()], out.numpy())
+
+
+def test_unique_consecutive():
+    x = np.array([1, 1, 2, 2, 2, 3, 1, 1], np.int64)
+    out, inverse, counts = paddle.unique_consecutive(
+        T(x), return_inverse=True, return_counts=True)
+    np.testing.assert_array_equal(out.numpy(), [1, 2, 3, 1])
+    np.testing.assert_array_equal(counts.numpy(), [2, 3, 1, 2])
+    np.testing.assert_array_equal(out.numpy()[inverse.numpy()], x)
+
+
+def test_quantile_matches_numpy_linear():
+    # the reference snapshot's quantile has no interpolation param: linear
+    x = RNG.standard_normal((20,)).astype(np.float64)
+    got = float(paddle.quantile(T(x), 0.3).numpy())
+    assert abs(got - float(np.quantile(x, 0.3))) < 1e-6
+    got2 = paddle.quantile(T(x.reshape(4, 5)), 0.7, axis=1).numpy()
+    np.testing.assert_allclose(got2, np.quantile(x.reshape(4, 5), 0.7, axis=1),
+                               rtol=1e-6)
+
+
+def test_cumulative_ops():
+    x = RNG.standard_normal((3, 4)).astype(np.float32)
+    np.testing.assert_allclose(paddle.cumsum(T(x), axis=1).numpy(),
+                               np.cumsum(x, 1), rtol=1e-6)
+    np.testing.assert_allclose(paddle.cumprod(T(x), dim=0).numpy(),
+                               np.cumprod(x, 0), rtol=1e-5)
+    got = paddle.logcumsumexp(T(x), axis=1).numpy()
+    want = np.log(np.cumsum(np.exp(x), 1))
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+    np.testing.assert_allclose(paddle.cummax(T(x), axis=1)[0].numpy(),
+                               np.maximum.accumulate(x, 1), rtol=1e-6)
+    np.testing.assert_allclose(paddle.cummin(T(x), axis=1)[0].numpy(),
+                               np.minimum.accumulate(x, 1), rtol=1e-6)
+
+
+def test_median_modes():
+    x = np.array([[1.0, 2.0, 3.0, 4.0]], np.float32)
+    # even count: paddle median averages the two middle values by default
+    assert float(paddle.median(T(x), axis=1).numpy()[0]) == 2.5
+    x_nan = np.array([1.0, np.nan, 3.0, 2.0], np.float32)
+    assert float(paddle.nanmedian(T(x_nan)).numpy()) == 2.0
+
+
+def test_kthvalue_and_mode():
+    x = RNG.standard_normal((2, 6)).astype(np.float32)
+    v, i = paddle.kthvalue(T(x), k=2, axis=1)
+    tv, ti = torch.kthvalue(torch.tensor(x), 2, dim=1)
+    np.testing.assert_allclose(v.numpy(), tv.numpy(), rtol=1e-6)
+    xm = np.array([[1, 2, 2, 3], [4, 4, 5, 4]], np.int64)
+    v, i = paddle.mode(T(xm), axis=1)
+    np.testing.assert_array_equal(v.numpy(), [2, 4])
+
+
+def test_histogram_and_bincount():
+    x = np.array([0.5, 1.5, 1.6, 3.2], np.float32)
+    got = paddle.histogram(T(x), bins=4, min=0, max=4).numpy()
+    want, _ = np.histogram(x, bins=4, range=(0, 4))
+    np.testing.assert_array_equal(got, want)
+    xi = np.array([0, 1, 1, 3], np.int64)
+    np.testing.assert_array_equal(paddle.bincount(T(xi)).numpy(),
+                                  np.bincount(xi))
