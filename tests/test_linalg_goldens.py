@@ -1,0 +1,129 @@
+"""Linalg correctness: reconstruction/identity properties (sign/ordering
+conventions vary across backends, so tests verify the defining equations —
+ref:python/paddle/tensor/linalg.py contracts)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+RNG = np.random.default_rng(1)
+
+
+def T(x):
+    return paddle.to_tensor(np.asarray(x))
+
+
+def _spd(n):
+    a = RNG.standard_normal((n, n))
+    return (a @ a.T + n * np.eye(n)).astype(np.float32)
+
+
+def test_svd_reconstructs():
+    a = RNG.standard_normal((5, 3)).astype(np.float32)
+    u, s, vh = paddle.linalg.svd(T(a))
+    rec = u.numpy()[:, :3] @ np.diag(s.numpy()) @ vh.numpy()
+    np.testing.assert_allclose(rec, a, rtol=1e-4, atol=1e-5)
+    assert (np.diff(s.numpy()) <= 1e-6).all()  # descending singular values
+
+
+def test_qr_reconstructs_orthonormal():
+    a = RNG.standard_normal((6, 4)).astype(np.float32)
+    q, r = paddle.linalg.qr(T(a))
+    np.testing.assert_allclose(q.numpy() @ r.numpy(), a, rtol=1e-4,
+                               atol=1e-5)
+    np.testing.assert_allclose(q.numpy().T @ q.numpy(), np.eye(4), atol=1e-5)
+    np.testing.assert_allclose(np.tril(r.numpy(), -1), 0, atol=1e-6)
+
+
+def test_eigh_spd():
+    a = _spd(4)
+    w, v = paddle.linalg.eigh(T(a))
+    rec = v.numpy() @ np.diag(w.numpy()) @ v.numpy().T
+    np.testing.assert_allclose(rec, a, rtol=1e-4, atol=1e-4)
+    assert (w.numpy() > 0).all()
+
+
+def test_cholesky_and_solve():
+    a = _spd(4)
+    L = paddle.linalg.cholesky(T(a)).numpy()
+    np.testing.assert_allclose(L @ L.T, a, rtol=1e-4, atol=1e-4)
+    b = RNG.standard_normal((4, 2)).astype(np.float32)
+    x = paddle.linalg.solve(T(a), T(b)).numpy()
+    np.testing.assert_allclose(a @ x, b, rtol=1e-3, atol=1e-3)
+    xc = paddle.linalg.cholesky_solve(T(b), T(L), upper=False).numpy()
+    np.testing.assert_allclose(a @ xc, b, rtol=1e-3, atol=1e-3)
+
+
+def test_triangular_solve():
+    a = np.triu(_spd(4))
+    b = RNG.standard_normal((4, 1)).astype(np.float32)
+    x = paddle.linalg.triangular_solve(T(a), T(b), upper=True).numpy()
+    np.testing.assert_allclose(a @ x, b, rtol=1e-3, atol=1e-3)
+
+
+def test_lu_unpack_reconstructs():
+    a = RNG.standard_normal((4, 4)).astype(np.float32)
+    lu, piv = paddle.linalg.lu(T(a))
+    p, L, U = paddle.linalg.lu_unpack(lu, piv)
+    rec = p.numpy() @ L.numpy() @ U.numpy()
+    np.testing.assert_allclose(rec, a, rtol=1e-4, atol=1e-4)
+
+
+def test_inv_pinv_det():
+    a = _spd(3)
+    inv = paddle.linalg.inv(T(a)).numpy()
+    np.testing.assert_allclose(a @ inv, np.eye(3), atol=1e-4)
+    r = RNG.standard_normal((5, 3)).astype(np.float32)
+    pinv = paddle.linalg.pinv(T(r)).numpy()
+    np.testing.assert_allclose(r @ pinv @ r, r, rtol=1e-3, atol=1e-3)
+    det = float(paddle.linalg.det(T(a)).numpy())
+    np.testing.assert_allclose(det, np.linalg.det(a.astype(np.float64)),
+                               rtol=1e-4)
+    sign, logd = paddle.linalg.slogdet(T(a))
+    np.testing.assert_allclose(float(sign.numpy()) * np.exp(float(logd.numpy())),
+                               det, rtol=1e-4)
+
+
+def test_lstsq():
+    a = RNG.standard_normal((6, 3)).astype(np.float32)
+    b = RNG.standard_normal((6, 2)).astype(np.float32)
+    sol = paddle.linalg.lstsq(T(a), T(b))[0].numpy()
+    want = np.linalg.lstsq(a, b, rcond=None)[0]
+    np.testing.assert_allclose(sol, want, rtol=1e-3, atol=1e-3)
+
+
+def test_matrix_rank_power_cond():
+    a = np.zeros((4, 4), np.float32)
+    a[:2, :2] = _spd(2)
+    assert int(paddle.linalg.matrix_rank(T(a)).numpy()) == 2
+    m = _spd(3)
+    p3 = paddle.linalg.matrix_power(T(m), 3).numpy()
+    np.testing.assert_allclose(p3, m @ m @ m, rtol=1e-3)
+    c = float(paddle.linalg.cond(T(m)).numpy())
+    np.testing.assert_allclose(c, np.linalg.cond(m.astype(np.float64)),
+                               rtol=1e-3)
+
+
+def test_norms():
+    x = RNG.standard_normal((3, 4)).astype(np.float32)
+    np.testing.assert_allclose(float(paddle.linalg.norm(T(x)).numpy()),
+                               np.linalg.norm(x), rtol=1e-5)
+    np.testing.assert_allclose(
+        float(paddle.linalg.norm(T(x), p="fro").numpy()),
+        np.linalg.norm(x, "fro"), rtol=1e-5)
+    np.testing.assert_allclose(
+        paddle.linalg.norm(T(x), p=1, axis=1).numpy(),
+        np.abs(x).sum(1), rtol=1e-5)
+
+
+def test_multi_dot_cov_corrcoef():
+    a = RNG.standard_normal((3, 4)).astype(np.float32)
+    b = RNG.standard_normal((4, 5)).astype(np.float32)
+    c = RNG.standard_normal((5, 2)).astype(np.float32)
+    got = paddle.linalg.multi_dot([T(a), T(b), T(c)]).numpy()
+    np.testing.assert_allclose(got, a @ b @ c, rtol=1e-4, atol=1e-4)
+    x = RNG.standard_normal((4, 10)).astype(np.float32)
+    np.testing.assert_allclose(paddle.linalg.cov(T(x)).numpy(), np.cov(x),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(paddle.linalg.corrcoef(T(x)).numpy(),
+                               np.corrcoef(x), rtol=1e-4, atol=1e-5)
