@@ -1,0 +1,76 @@
+"""Optimizer update math vs torch single/multi-step goldens (bias
+correction, momentum accumulation, centered RMSProp, decoupled AdamW —
+ref:python/paddle/optimizer/*.py formulas)."""
+import numpy as np
+import pytest
+import torch
+
+import paddle_tpu as paddle
+
+
+def _run_ours(opt_cls, steps=3, lr=0.1, grads=None, **kw):
+    p = paddle.to_tensor(np.arange(1.0, 5.0, dtype=np.float32))
+    p.stop_gradient = False
+    opt = opt_cls(learning_rate=lr, parameters=[p], **kw)
+    for g in grads:
+        loss = (p * paddle.to_tensor(g)).sum()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    return p.numpy()
+
+
+def _run_torch(topt_cls, steps=3, lr=0.1, grads=None, **kw):
+    p = torch.arange(1.0, 5.0, requires_grad=True)
+    opt = topt_cls([p], lr=lr, **kw)
+    for g in grads:
+        opt.zero_grad()
+        (p * torch.tensor(g)).sum().backward()
+        opt.step()
+    return p.detach().numpy()
+
+
+GRADS = [np.random.default_rng(s).standard_normal(4).astype(np.float32)
+         for s in range(3)]
+
+
+def test_sgd_matches_torch():
+    ours = _run_ours(paddle.optimizer.SGD, grads=GRADS)
+    torchs = _run_torch(torch.optim.SGD, grads=GRADS)
+    np.testing.assert_allclose(ours, torchs, rtol=1e-5, atol=1e-6)
+
+
+def test_momentum_matches_torch():
+    ours = _run_ours(paddle.optimizer.Momentum, grads=GRADS, momentum=0.9)
+    torchs = _run_torch(torch.optim.SGD, grads=GRADS, momentum=0.9)
+    np.testing.assert_allclose(ours, torchs, rtol=1e-5, atol=1e-6)
+
+
+def test_adam_bias_correction_matches_torch():
+    ours = _run_ours(paddle.optimizer.Adam, grads=GRADS, beta1=0.9,
+                     beta2=0.999, epsilon=1e-8)
+    torchs = _run_torch(torch.optim.Adam, grads=GRADS, betas=(0.9, 0.999),
+                        eps=1e-8)
+    np.testing.assert_allclose(ours, torchs, rtol=1e-5, atol=1e-6)
+
+
+def test_adamw_decoupled_matches_torch():
+    ours = _run_ours(paddle.optimizer.AdamW, grads=GRADS, weight_decay=0.05)
+    torchs = _run_torch(torch.optim.AdamW, grads=GRADS, weight_decay=0.05)
+    np.testing.assert_allclose(ours, torchs, rtol=1e-4, atol=1e-5)
+
+
+def test_adagrad_matches_torch():
+    ours = _run_ours(paddle.optimizer.Adagrad, grads=GRADS,
+                     initial_accumulator_value=0.1, epsilon=1e-10)
+    torchs = _run_torch(torch.optim.Adagrad, grads=GRADS,
+                        initial_accumulator_value=0.1, eps=1e-10)
+    np.testing.assert_allclose(ours, torchs, rtol=1e-4, atol=1e-5)
+
+
+def test_adamax_matches_torch():
+    ours = _run_ours(paddle.optimizer.Adamax, grads=GRADS, beta1=0.9,
+                     beta2=0.999, epsilon=1e-8)
+    torchs = _run_torch(torch.optim.Adamax, grads=GRADS, betas=(0.9, 0.999),
+                        eps=1e-8)
+    np.testing.assert_allclose(ours, torchs, rtol=1e-4, atol=1e-5)
