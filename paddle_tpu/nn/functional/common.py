@@ -30,7 +30,13 @@ def linear(x, weight, bias=None, name=None):
 
 
 def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
+    if mode not in ("upscale_in_train", "downscale_in_infer"):
+        raise ValueError(f"unsupported dropout mode {mode!r}")
     if not training or p == 0.0:
+        if mode == "downscale_in_infer" and p != 0.0:
+            # legacy mode: train keeps raw masked values, inference scales
+            # by the keep probability (ref nn/functional/common.py dropout)
+            return x * (1.0 - float(p))
         return x
 
     def _dropout(x, key, *, p, axis, upscale):

@@ -1,0 +1,116 @@
+"""LR scheduler value sequences vs hand-computed reference formulas
+(ref:python/paddle/optimizer/lr.py docstring math)."""
+import math
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.optimizer import lr as L
+
+
+def _values(sched, n):
+    out = []
+    for _ in range(n):
+        out.append(float(sched()))
+        sched.step()
+    return out
+
+
+def test_step_decay():
+    s = L.StepDecay(learning_rate=1.0, step_size=3, gamma=0.5)
+    vals = _values(s, 8)
+    np.testing.assert_allclose(vals, [1, 1, 1, .5, .5, .5, .25, .25])
+
+
+def test_multistep_decay():
+    s = L.MultiStepDecay(learning_rate=1.0, milestones=[2, 5], gamma=0.1)
+    vals = _values(s, 7)
+    np.testing.assert_allclose(vals, [1, 1, .1, .1, .1, .01, .01])
+
+
+def test_exponential_decay():
+    s = L.ExponentialDecay(learning_rate=2.0, gamma=0.9)
+    vals = _values(s, 4)
+    np.testing.assert_allclose(vals, [2 * 0.9 ** i for i in range(4)],
+                               rtol=1e-6)
+
+
+def test_natural_exp_decay():
+    s = L.NaturalExpDecay(learning_rate=1.0, gamma=0.5)
+    vals = _values(s, 3)
+    np.testing.assert_allclose(vals, [math.exp(-0.5 * i) for i in range(3)],
+                               rtol=1e-6)
+
+
+def test_inverse_time_decay():
+    s = L.InverseTimeDecay(learning_rate=1.0, gamma=0.5)
+    vals = _values(s, 3)
+    np.testing.assert_allclose(vals, [1 / (1 + 0.5 * i) for i in range(3)],
+                               rtol=1e-6)
+
+
+def test_polynomial_decay():
+    s = L.PolynomialDecay(learning_rate=1.0, decay_steps=4, end_lr=0.1,
+                          power=1.0)
+    vals = _values(s, 6)
+    expect = [(1.0 - 0.1) * (1 - min(i, 4) / 4) ** 1.0 + 0.1
+              for i in range(6)]
+    np.testing.assert_allclose(vals, expect, rtol=1e-6)
+
+
+def test_cosine_annealing():
+    s = L.CosineAnnealingDecay(learning_rate=1.0, T_max=10, eta_min=0.0)
+    vals = _values(s, 11)
+    assert abs(vals[0] - 1.0) < 1e-6
+    # the reference's recursive formulation hits ~eta_min at T_max
+    assert vals[10] < 0.01
+    assert all(vals[i + 1] <= vals[i] + 1e-6 for i in range(10))
+
+
+def test_linear_warmup():
+    s = L.LinearWarmup(learning_rate=1.0, warmup_steps=4, start_lr=0.0,
+                       end_lr=1.0)
+    vals = _values(s, 6)
+    np.testing.assert_allclose(vals[:4], [0.0, 0.25, 0.5, 0.75], rtol=1e-6)
+    np.testing.assert_allclose(vals[4:], 1.0, rtol=1e-6)
+
+
+def test_noam_decay():
+    d, warm = 64, 10
+    s = L.NoamDecay(d_model=d, warmup_steps=warm, learning_rate=1.0)
+    vals = _values(s, 12)
+    expect = [d ** -0.5 * min((i or 1) ** -0.5, (i or 1) * warm ** -1.5)
+              for i in range(12)]
+    np.testing.assert_allclose(vals[1:], expect[1:], rtol=1e-5)
+
+
+def test_piecewise_decay():
+    s = L.PiecewiseDecay(boundaries=[2, 4], values=[1.0, 0.5, 0.1])
+    vals = _values(s, 6)
+    np.testing.assert_allclose(vals, [1, 1, .5, .5, .1, .1])
+
+
+def test_lambda_and_multiplicative():
+    s = L.LambdaDecay(learning_rate=2.0, lr_lambda=lambda e: 0.9 ** e)
+    np.testing.assert_allclose(_values(s, 3), [2 * 0.9 ** i
+                                               for i in range(3)], rtol=1e-6)
+    s = L.MultiplicativeDecay(learning_rate=1.0, lr_lambda=lambda e: 0.5)
+    np.testing.assert_allclose(_values(s, 3), [1.0, 0.5, 0.25], rtol=1e-6)
+
+
+def test_one_cycle():
+    s = L.OneCycleLR(max_learning_rate=1.0, total_steps=10, phase_pct=0.3)
+    vals = _values(s, 10)
+    peak = np.argmax(vals)
+    assert 2 <= peak <= 4  # peak near phase_pct * total_steps
+    assert vals[0] < vals[peak] and vals[-1] < vals[peak] / 10
+
+
+def test_reduce_on_plateau_scheduler():
+    s = L.ReduceOnPlateau(learning_rate=1.0, factor=0.5, patience=1,
+                          cooldown=0)
+    assert float(s()) == 1.0
+    s.step(metrics=1.0)
+    s.step(metrics=1.0)
+    s.step(metrics=1.0)
+    assert float(s()) <= 0.5

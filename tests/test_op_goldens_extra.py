@@ -205,3 +205,17 @@ def test_activations_match_torch(act, targs):
     np.testing.assert_allclose(ours(_t(x)).numpy(),
                                theirs(torch.tensor(x)).numpy(),
                                rtol=1e-4, atol=1e-5)
+
+
+def test_dropout_downscale_in_infer_mode():
+    x = _t(np.ones((1000,)))
+    # inference: output scales by keep prob (legacy paddle contract)
+    out = F.dropout(x, p=0.4, training=False, mode="downscale_in_infer")
+    np.testing.assert_allclose(out.numpy(), 0.6, rtol=1e-6)
+    # train: kept values stay raw (no 1/(1-p) upscale)
+    paddle.seed(0)
+    tr = F.dropout(x, p=0.4, training=True, mode="downscale_in_infer").numpy()
+    kept = tr[tr != 0]
+    np.testing.assert_allclose(kept, 1.0, rtol=1e-6)
+    with pytest.raises(ValueError, match="dropout mode"):
+        F.dropout(x, p=0.4, mode="bogus")
