@@ -123,7 +123,11 @@ def sparse_csr_tensor(crows, cols, values, shape, dtype=None) -> SparseCsrTensor
 
 
 def to_sparse_coo(x: Tensor, sparse_dim: Optional[int] = None) -> SparseCooTensor:
-    return SparseCooTensor(jsparse.BCOO.fromdense(_data(x)))
+    """sparse_dim leading dims are indexed; the rest stay dense trailing
+    dims (paddle's Tensor.to_sparse_coo(sparse_dim) contract)."""
+    arr = _data(x)
+    n_dense = 0 if sparse_dim is None else max(arr.ndim - int(sparse_dim), 0)
+    return SparseCooTensor(jsparse.BCOO.fromdense(arr, n_dense=n_dense))
 
 
 # ------------------------------------------------------------------- ops
@@ -277,3 +281,6 @@ def addmm(input, x, y, beta=1.0, alpha=1.0):
     """beta*input + alpha*(x @ y) with sparse x (ref sparse.addmm)."""
     prod = matmul(x, y)
     return Tensor(beta * _data(input) + alpha * _data(prod))
+
+
+from . import nn  # noqa: F401,E402  (sparse layers)

@@ -1,0 +1,55 @@
+"""paddle.sparse.nn.functional: functional forms of the sparse layers."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from . import (LeakyReLU, MaxPool3D, Softmax, _map_values)
+
+__all__ = ["relu", "relu6", "leaky_relu", "softmax", "max_pool3d",
+           "attention"]
+
+
+def relu(x, name=None):
+    return _map_values(x, lambda v: jnp.maximum(v, 0))
+
+
+def relu6(x, name=None):
+    return _map_values(x, lambda v: jnp.clip(v, 0, 6))
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return _map_values(x, lambda v: jnp.where(v >= 0, v, negative_slope * v))
+
+
+def softmax(x, axis=-1, name=None):
+    return Softmax(axis)(x)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               data_format="NDHWC", name=None):
+    return MaxPool3D(kernel_size, stride, padding, data_format)(x)
+
+
+def attention(query, key, value, sparse_mask, key_padding_mask=None,
+              attn_mask=None, name=None):
+    """Sparse-masked attention: computes probs only at the mask's nonzero
+    sites (ref sparse/nn/functional/transformer.py)."""
+    import math
+
+    import jax
+
+    from ...core.tensor import Tensor
+    from .. import SparseCooTensor
+
+    q = query._data if isinstance(query, Tensor) else jnp.asarray(query)
+    k = key._data if isinstance(key, Tensor) else jnp.asarray(key)
+    v = value._data if isinstance(value, Tensor) else jnp.asarray(value)
+    # [b, h, s, d] layout; mask is a 2-D/3-D sparse COO over [s, s]
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    dense_mask = sparse_mask._bcoo.todense() if isinstance(
+        sparse_mask, SparseCooTensor) else jnp.asarray(sparse_mask)
+    neg = jnp.asarray(-1e30, logits.dtype)
+    logits = jnp.where(dense_mask != 0, logits, neg)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), -1).astype(q.dtype)
+    return Tensor(jnp.einsum("bhqk,bhkd->bhqd", probs, v))

@@ -1,0 +1,99 @@
+"""paddle.sparse.nn layers (ref:python/paddle/sparse/nn/)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import sparse
+from paddle_tpu.sparse import nn as snn
+
+
+def _coo4d(shape=(1, 4, 4, 4, 3), density=0.3, seed=0):
+    rng = np.random.default_rng(seed)
+    dense = rng.standard_normal(shape).astype(np.float32)
+    mask = rng.random(shape[:-1]) < density
+    dense = dense * mask[..., None]
+    t = paddle.to_tensor(dense)
+    return sparse.to_sparse_coo(t, sparse_dim=len(shape) - 1), dense
+
+
+def test_sparse_relu_family():
+    s, dense = _coo4d()
+    out = snn.ReLU()(s).to_dense().numpy()
+    np.testing.assert_allclose(out, np.maximum(dense, 0), rtol=1e-6)
+    out = snn.ReLU6()(s).to_dense().numpy()
+    np.testing.assert_allclose(out, np.clip(dense, 0, 6), rtol=1e-6)
+    out = snn.LeakyReLU(0.1)(s).to_dense().numpy()
+    np.testing.assert_allclose(out, np.where(dense >= 0, dense, 0.1 * dense),
+                               rtol=1e-6)
+    f = snn.functional.relu(s).to_dense().numpy()
+    np.testing.assert_allclose(f, np.maximum(dense, 0), rtol=1e-6)
+
+
+def test_sparse_softmax_rows():
+    rng = np.random.default_rng(1)
+    dense = rng.standard_normal((4, 6)).astype(np.float32)
+    mask = rng.random((4, 6)) < 0.5
+    mask[:, 0] = True  # no empty rows
+    dense = dense * mask
+    s = sparse.to_sparse_coo(paddle.to_tensor(dense), sparse_dim=2)
+    out = snn.Softmax()(s).to_dense().numpy()
+    for r in range(4):
+        nz = mask[r]
+        e = np.exp(dense[r][nz] - dense[r][nz].max())
+        np.testing.assert_allclose(out[r][nz], e / e.sum(), rtol=1e-5)
+        np.testing.assert_allclose(out[r][~nz], 0.0)
+
+
+def test_sparse_batchnorm_normalizes_active_values():
+    s, dense = _coo4d(density=0.5, seed=2)
+    bn = snn.BatchNorm(3)
+    out = bn(s)
+    v = out.values().numpy()
+    # active-site statistics ~ standardized
+    np.testing.assert_allclose(v.mean(0), 0.0, atol=1e-4)
+    np.testing.assert_allclose(v.std(0), 1.0, atol=1e-2)
+    bn.eval()
+    out2 = bn(s)
+    assert out2.to_dense().numpy().shape == dense.shape
+
+
+def test_subm_conv3d_preserves_sites():
+    s, dense = _coo4d(density=0.3, seed=3)
+    conv = snn.SubmConv3D(3, 5, 3)
+    out = conv(s)
+    assert tuple(out.shape) == (1, 4, 4, 4, 5)
+    od = out.to_dense().numpy()
+    active = (dense != 0).any(-1)
+    assert (od[~active] == 0).all()  # inactive sites stay empty
+    assert (od[active] != 0).any()
+
+
+def test_sparse_conv3d_and_maxpool():
+    s, dense = _coo4d(density=0.4, seed=4)
+    conv = snn.Conv3D(3, 2, 3, padding=1)
+    out = conv(s)
+    assert tuple(out.shape) == (1, 4, 4, 4, 2)
+    pool = snn.MaxPool3D(2, 2)
+    p = pool(s)
+    assert tuple(p.shape) == (1, 2, 2, 2, 3)
+    # pooled dense equals dense maxpool (zeros participate, as reference)
+    import torch
+    import torch.nn.functional as TF
+
+    want = TF.max_pool3d(torch.tensor(dense).permute(0, 4, 1, 2, 3), 2, 2)
+    want = want.permute(0, 2, 3, 4, 1).numpy()
+    np.testing.assert_allclose(p.to_dense().numpy(), want, rtol=1e-5)
+
+
+def test_sparse_attention_masked():
+    rng = np.random.default_rng(5)
+    q = paddle.to_tensor(rng.standard_normal((1, 2, 4, 8)).astype(np.float32))
+    k = paddle.to_tensor(rng.standard_normal((1, 2, 4, 8)).astype(np.float32))
+    v = paddle.to_tensor(rng.standard_normal((1, 2, 4, 8)).astype(np.float32))
+    mask = np.tril(np.ones((4, 4), np.float32))
+    sm = sparse.to_sparse_coo(paddle.to_tensor(mask), sparse_dim=2)
+    out = snn.functional.attention(q, k, v, sm)
+    assert out.shape == [1, 2, 4, 8]
+    # row 0 attends only to position 0 -> equals v[..., 0, :]
+    np.testing.assert_allclose(out.numpy()[:, :, 0], v.numpy()[:, :, 0],
+                               rtol=1e-5)

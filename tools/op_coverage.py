@@ -27,6 +27,7 @@ MODULE_MAP = {
     "paddle.nn": "paddle_tpu.nn",
     "paddle.nn.functional": "paddle_tpu.nn.functional",
     "paddle.sparse": "paddle_tpu.sparse",
+    "paddle.sparse.nn": "paddle_tpu.sparse.nn",
     "paddle.distribution": "paddle_tpu.distribution",
     "paddle.optimizer": "paddle_tpu.optimizer",
     "paddle.optimizer.lr": "paddle_tpu.optimizer.lr",
