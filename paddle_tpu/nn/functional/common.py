@@ -173,20 +173,23 @@ def interpolate(
             np.add.at(W, (rows, np.clip(lo + off, 0, in_s - 1)), w)
         return W
 
-    mats = [_axis_matrix(int(s), int(o)) for s, o in zip(spatial, out_size)]
+    # weight matrices ride as TENSOR args (not closure constants): the eager
+    # jit cache keys on shapes/statics, so repeat calls with one config hit
+    # the compiled executable instead of retracing per call
+    mats = [Tensor(jnp.asarray(_axis_matrix(int(s), int(o)), jnp.float32))
+            for s, o in zip(spatial, out_size)]
 
-    def _interp(x, *, nchw):
+    def _interp(x, *mat_args, nchw):
         out = x
         first_spatial = 2 if nchw else 1
-        for k, W in enumerate(mats):
+        for k, Wa in enumerate(mat_args):
             axis = first_spatial + k
-            Wa = jnp.asarray(W, jnp.float32)
             moved = jnp.moveaxis(out, axis, -1)
             moved = (moved.astype(jnp.float32) @ Wa.T).astype(x.dtype)
             out = jnp.moveaxis(moved, -1, axis)
         return out
 
-    return apply(_interp, (x,), dict(nchw=nchw), name="interpolate")
+    return apply(_interp, (x, *mats), dict(nchw=nchw), name="interpolate")
 
 
 def upsample(x, size=None, scale_factor=None, mode="nearest", align_corners=False, align_mode=0, data_format="NCHW", name=None):

@@ -131,8 +131,14 @@ def _dense_roundtrip(x: SparseCooTensor, fn, keep_input_sites: bool):
     out = fn(dense)
     arr = out._data if isinstance(out, Tensor) else out
     if keep_input_sites:
-        # submanifold: output only at the input's active sites
-        idx = x._bcoo.indices  # [nnz, ndim_sparse]
+        # submanifold: output only at the input's active sites. Requires the
+        # channel dim dense (to_sparse_coo(sparse_dim=ndim-1)); with a fully
+        # sparse layout the per-channel indices would be misread as sites.
+        if x._bcoo.n_dense < 1:
+            raise ValueError(
+                "SubmConv3D needs the channel dim dense: build the input "
+                "with to_sparse_coo(x, sparse_dim=x.ndim - 1)")
+        idx = x._bcoo.indices  # [nnz, n_sparse]
         vals = arr[tuple(idx[:, d] for d in range(idx.shape[1]))]
         new = x._bcoo.__class__((vals, idx), shape=tuple(arr.shape))
         return SparseCooTensor(new)
@@ -172,11 +178,18 @@ class _SparseConv3DBase(dense_nn.Layer):
             xt = M.transpose(dense, [0, 4, 1, 2, 3])
             w = M.transpose(self.weight, [4, 3, 0, 1, 2])
             if self._subm:
-                # submanifold convs preserve geometry: force same-size output
-                ks = self.weight.shape[0]
-                pad = (ks - 1) // 2 * (self._dilation if isinstance(
-                    self._dilation, int) else self._dilation[0])
-                out = F.conv3d(xt, w, bias=self.bias, stride=1, padding=pad,
+                # submanifold convs preserve geometry: same-size output,
+                # padded per dim (odd kernels only — even ones can't pad
+                # symmetrically, same as the reference kernel)
+                ks = self.weight.shape[:3]
+                dil = ((self._dilation,) * 3
+                       if isinstance(self._dilation, int)
+                       else tuple(self._dilation))
+                if any(k % 2 == 0 for k in ks):
+                    raise ValueError(
+                        f"SubmConv3D needs odd kernel sizes, got {ks}")
+                pads = [((k - 1) // 2) * d for k, d in zip(ks, dil)]
+                out = F.conv3d(xt, w, bias=self.bias, stride=1, padding=pads,
                                dilation=self._dilation, groups=self._groups)
             else:
                 out = F.conv3d(xt, w, bias=self.bias, stride=self._stride,

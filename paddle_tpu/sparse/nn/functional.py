@@ -27,7 +27,17 @@ def softmax(x, axis=-1, name=None):
 
 def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                data_format="NDHWC", name=None):
-    return MaxPool3D(kernel_size, stride, padding, data_format)(x)
+    from ...nn import functional as dense_F
+    from ...ops import manipulation as M
+    from . import _dense_roundtrip
+
+    def run(dense):
+        xt = M.transpose(dense, [0, 4, 1, 2, 3])
+        out = dense_F.max_pool3d(xt, kernel_size, stride, padding,
+                                 ceil_mode=ceil_mode)
+        return M.transpose(out, [0, 2, 3, 4, 1])
+
+    return _dense_roundtrip(x, run, keep_input_sites=False)
 
 
 def attention(query, key, value, sparse_mask, key_padding_mask=None,
@@ -51,5 +61,14 @@ def attention(query, key, value, sparse_mask, key_padding_mask=None,
         sparse_mask, SparseCooTensor) else jnp.asarray(sparse_mask)
     neg = jnp.asarray(-1e30, logits.dtype)
     logits = jnp.where(dense_mask != 0, logits, neg)
+    if key_padding_mask is not None:
+        kp = (key_padding_mask._data if isinstance(key_padding_mask, Tensor)
+              else jnp.asarray(key_padding_mask))  # [b, s]: nonzero = keep
+        logits = jnp.where(kp[:, None, None, :] != 0, logits, neg)
+    if attn_mask is not None:
+        am = (attn_mask._data if isinstance(attn_mask, Tensor)
+              else jnp.asarray(attn_mask))
+        logits = (jnp.where(am, logits, neg) if am.dtype == jnp.bool_
+                  else logits + am)
     probs = jax.nn.softmax(logits.astype(jnp.float32), -1).astype(q.dtype)
     return Tensor(jnp.einsum("bhqk,bhkd->bhqd", probs, v))

@@ -97,3 +97,40 @@ def test_sparse_attention_masked():
     # row 0 attends only to position 0 -> equals v[..., 0, :]
     np.testing.assert_allclose(out.numpy()[:, :, 0], v.numpy()[:, :, 0],
                                rtol=1e-5)
+
+
+def test_subm_conv3d_noncubic_kernel_same_shape():
+    s, dense = _coo4d(density=0.3, seed=6)
+    out = snn.SubmConv3D(3, 2, (1, 3, 3))(s)
+    assert tuple(out.shape) == (1, 4, 4, 4, 2)
+    with pytest.raises(ValueError, match="odd kernel"):
+        snn.SubmConv3D(3, 2, 2)(s)
+
+
+def test_subm_conv3d_rejects_fully_sparse_layout():
+    rng = np.random.default_rng(7)
+    dense = rng.standard_normal((1, 3, 3, 3, 2)).astype(np.float32)
+    fully = sparse.to_sparse_coo(paddle.to_tensor(dense))  # no dense dims
+    with pytest.raises(ValueError, match="channel dim dense"):
+        snn.SubmConv3D(2, 2, 3)(fully)
+
+
+def test_sparse_functional_maxpool_ceil_and_attention_masks():
+    s, dense = _coo4d(shape=(1, 5, 5, 5, 2), density=0.4, seed=8)
+    out = snn.functional.max_pool3d(s, 2, 2, ceil_mode=True)
+    assert tuple(out.shape) == (1, 3, 3, 3, 2)
+    rng = np.random.default_rng(9)
+    q = paddle.to_tensor(rng.standard_normal((1, 1, 3, 4)).astype(np.float32))
+    mask = sparse.to_sparse_coo(
+        paddle.to_tensor(np.ones((3, 3), np.float32)), sparse_dim=2)
+    pad = paddle.to_tensor(np.array([[1, 1, 0]], np.float32))  # key 2 padded
+    out = snn.functional.attention(q, q, q, mask, key_padding_mask=pad)
+    # with key 2 masked everywhere, output is a mix of keys 0/1 only:
+    # replacing key 2's value must not change the result
+    q2 = q.numpy().copy()
+    q2[:, :, 2] = 99.0
+    out2 = snn.functional.attention(paddle.to_tensor(q.numpy()),
+                                    paddle.to_tensor(q.numpy()),
+                                    paddle.to_tensor(q2), mask,
+                                    key_padding_mask=pad)
+    np.testing.assert_allclose(out.numpy(), out2.numpy(), rtol=1e-5)
