@@ -1,0 +1,99 @@
+"""Distribution log_prob/entropy/cdf vs scipy goldens + sampling moments
+(ref:python/paddle/distribution/)."""
+import numpy as np
+import pytest
+import scipy.stats as st
+
+import paddle_tpu as paddle
+from paddle_tpu import distribution as D
+
+
+def T(x):
+    return paddle.to_tensor(np.asarray(x, np.float32))
+
+
+def test_normal_logprob_entropy_cdf():
+    d = D.Normal(loc=T([1.0]), scale=T([2.0]))
+    xs = np.array([-1.0, 0.5, 3.0], np.float32)
+    np.testing.assert_allclose(d.log_prob(T(xs)).numpy(),
+                               st.norm(1.0, 2.0).logpdf(xs), rtol=1e-5)
+    np.testing.assert_allclose(float(d.entropy().numpy()),
+                               st.norm(1.0, 2.0).entropy(), rtol=1e-5)
+    if hasattr(d, "cdf"):
+        np.testing.assert_allclose(d.cdf(T(xs)).numpy(),
+                                   st.norm(1.0, 2.0).cdf(xs), rtol=1e-5)
+
+
+def test_uniform_beta_gamma_logprobs():
+    u = D.Uniform(low=T([0.0]), high=T([4.0]))
+    np.testing.assert_allclose(u.log_prob(T([1.0])).numpy(),
+                               st.uniform(0, 4).logpdf([1.0]), rtol=1e-5)
+    b = D.Beta(alpha=T([2.0]), beta=T([3.0]))
+    np.testing.assert_allclose(b.log_prob(T([0.3])).numpy(),
+                               st.beta(2, 3).logpdf([0.3]), rtol=1e-4)
+    g = D.Gamma(concentration=T([2.0]), rate=T([0.5]))
+    np.testing.assert_allclose(g.log_prob(T([1.5])).numpy(),
+                               st.gamma(2.0, scale=2.0).logpdf([1.5]),
+                               rtol=1e-4)
+
+
+def test_categorical_and_multinomial():
+    logits = np.log(np.array([0.2, 0.3, 0.5], np.float32))
+    c = D.Categorical(logits=T(logits))
+    np.testing.assert_allclose(
+        np.exp(c.log_prob(T(np.array([2.0]))).numpy()), [0.5], rtol=1e-5)
+    m = D.Multinomial(total_count=4, probs=T([0.25, 0.75]))
+    lp = float(m.log_prob(T([1.0, 3.0])).numpy())
+    want = st.multinomial(4, [0.25, 0.75]).logpmf([1, 3])
+    np.testing.assert_allclose(lp, want, rtol=1e-4)
+
+
+def test_laplace_lognormal_exponential():
+    lap = D.Laplace(loc=T([0.0]), scale=T([1.5]))
+    np.testing.assert_allclose(lap.log_prob(T([2.0])).numpy(),
+                               st.laplace(0, 1.5).logpdf([2.0]), rtol=1e-5)
+    ln = D.LogNormal(loc=T([0.2]), scale=T([0.7]))
+    np.testing.assert_allclose(
+        ln.log_prob(T([1.4])).numpy(),
+        st.lognorm(0.7, scale=np.exp(0.2)).logpdf([1.4]), rtol=1e-4)
+    ex = D.ExponentialFamily if not hasattr(D, "Exponential") else None
+    if hasattr(D, "Exponential"):
+        e = D.Exponential(rate=T([2.0]))
+        np.testing.assert_allclose(
+            e.log_prob(T([0.7])).numpy(),
+            st.expon(scale=0.5).logpdf([0.7]), rtol=1e-4)
+
+
+def test_bernoulli_geometric_poisson():
+    be = D.Bernoulli(probs=T([0.3]))
+    np.testing.assert_allclose(np.exp(be.log_prob(T([1.0])).numpy()), [0.3],
+                               rtol=1e-5)
+    if hasattr(D, "Geometric"):
+        ge = D.Geometric(probs=T([0.25]))
+        # paddle geometric counts failures before first success (support 0..)
+        lp = float(ge.log_prob(T([3.0])).numpy())
+        assert abs(lp - st.geom(0.25, loc=-1).logpmf(3)) < 1e-4
+    if hasattr(D, "Poisson"):
+        po = D.Poisson(rate=T([2.5]))
+        np.testing.assert_allclose(po.log_prob(T([4.0])).numpy(),
+                                   st.poisson(2.5).logpmf([4]), rtol=1e-4)
+
+
+def test_kl_divergence_normals():
+    p = D.Normal(loc=T([0.0]), scale=T([1.0]))
+    q = D.Normal(loc=T([1.0]), scale=T([2.0]))
+    got = float(D.kl_divergence(p, q).numpy())
+    # closed form: log(s2/s1) + (s1^2 + (m1-m2)^2)/(2 s2^2) - 0.5
+    want = np.log(2.0) + (1.0 + 1.0) / 8.0 - 0.5
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_sampling_moments():
+    paddle.seed(0)
+    d = D.Normal(loc=T([3.0]), scale=T([0.5]))
+    s = d.sample([4000]).numpy()
+    assert abs(s.mean() - 3.0) < 0.05
+    assert abs(s.std() - 0.5) < 0.05
+    g = D.Gumbel(loc=T([0.0]), scale=T([1.0]))
+    sg = g.sample([4000]).numpy()
+    assert abs(sg.mean() - 0.5772) < 0.1  # Euler-Mascheroni
