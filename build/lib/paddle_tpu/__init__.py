@@ -8,7 +8,7 @@ parallelism is mesh-based GSPMD rather than runtime collectives.
 """
 from __future__ import annotations
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"
 
 from . import autograd  # noqa: F401
 from .core.autograd import enable_grad, grad, is_grad_enabled, no_grad, set_grad_enabled  # noqa: F401
@@ -47,6 +47,10 @@ from .core.tensor import Tensor, to_tensor  # noqa: F401
 from .ops import *  # noqa: F401,F403
 from .ops import creation, manipulation, math, random  # noqa: F401
 from . import fft  # noqa: F401
+from . import audio  # noqa: F401
+from . import text  # noqa: F401
+from . import hub  # noqa: F401
+from . import onnx  # noqa: F401
 from . import signal  # noqa: F401
 from . import linalg  # noqa: F401
 
@@ -57,11 +61,21 @@ from . import amp  # noqa: F401,E402
 from . import io  # noqa: F401,E402
 from . import jit  # noqa: F401,E402
 from . import device  # noqa: F401,E402
+from . import utils  # noqa: F401,E402
 from .framework import io as framework_io  # noqa: F401,E402
 from .framework.io import load, save  # noqa: F401,E402
 from . import metric  # noqa: F401,E402
 from . import vision  # noqa: F401,E402
 from . import hapi  # noqa: F401,E402
+from . import callbacks  # noqa: F401,E402
+from . import geometric  # noqa: F401,E402
+from . import tensor  # noqa: F401,E402
+from . import cost_model  # noqa: F401,E402
+from . import dataset  # noqa: F401,E402
+from . import regularizer  # noqa: F401,E402
+from . import reader  # noqa: F401,E402
+from . import sysconfig  # noqa: F401,E402
+from . import version  # noqa: F401,E402
 from . import incubate  # noqa: F401,E402
 from .hapi import Model  # noqa: F401,E402
 from .hapi.model import summary  # noqa: F401,E402
@@ -87,3 +101,102 @@ from . import sparse  # noqa: F401,E402
 from . import quantization  # noqa: F401,E402
 from . import static  # noqa: F401,E402
 from . import inference  # noqa: F401,E402
+
+# ------------------------------------------------------- remaining root API
+from .nn.layer import ParamAttr  # noqa: F401,E402
+from .distributed.parallel import DataParallel  # noqa: F401,E402
+from .core.dtype import convert_dtype_arg as _cvt_dtype  # noqa: E402
+
+
+def create_parameter(shape, dtype="float32", name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    """Standalone parameter (ref:python/paddle/tensor/creation.py
+    create_parameter): a leaf Tensor with stop_gradient=False."""
+    from .nn import initializer as _I
+    from .nn.layer import Parameter
+
+    import jax.numpy as _jnp
+
+    init = default_initializer or (_I.Constant(0.0) if is_bias else _I.XavierNormal())
+    dt = _cvt_dtype(dtype)
+    return Parameter(_jnp.asarray(init(list(shape), dt)))
+
+
+class dtype(str):  # noqa: N801 - paddle exposes `paddle.dtype`
+    """Dtype token (string-compatible, like paddle.dtype values)."""
+
+
+def CUDAPinnedPlace():  # noqa: N802
+    """Pinned-host placement maps to plain host memory on this stack."""
+    from .core.device import CPUPlace
+
+    return CPUPlace()
+
+
+class LazyGuard:
+    """ref LazyGuard: delay parameter materialization. Parameters here are
+    created eagerly but cheaply (XLA zeros); the guard is a no-op scope."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def disable_signal_handler():
+    """The reference installs C++ signal handlers (paddle.disable_signal_handler
+    removes them); this runtime installs none, so nothing to disable."""
+    return None
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Legacy reader-decorator (ref:python/paddle/batch.py)."""
+
+    def batched():
+        b = []
+        for item in reader():
+            b.append(item)
+            if len(b) == batch_size:
+                yield b
+                b = []
+        if b and not drop_last:
+            yield b
+
+    return batched
+
+
+def get_cuda_rng_state():
+    """Accelerator RNG state: the global threefry key (device-agnostic)."""
+    return get_rng_state()
+
+
+def set_cuda_rng_state(state):
+    return set_rng_state(state)
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """Estimate forward FLOPs (ref:python/paddle/hapi/dynamic_flops.py) via
+    XLA's cost analysis of the traced program — the compiler's own count
+    rather than per-layer hand rules."""
+    import jax
+    import numpy as _np
+
+    from .core.tensor import Tensor as _T
+
+    x = _np.zeros(input_size, _np.float32)
+
+    def fwd(arr):
+        return net(_T(arr))._data
+
+    try:
+        lowered = jax.jit(fwd).lower(x)
+        cost = lowered.compile().cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0]
+        total = int(cost.get("flops", 0)) if cost else 0
+    except Exception:
+        total = 0
+    if print_detail:
+        print(f"Total Flops: {total}")
+    return total

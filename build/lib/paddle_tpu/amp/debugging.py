@@ -1,0 +1,121 @@
+"""paddle.amp.debugging (ref:python/paddle/amp/debugging.py): numeric
+anomaly checking for mixed-precision training.
+
+The reference installs per-op CUDA tensor scans; here enable_tensor_checker
+turns on the dispatch-level NaN/Inf scan (core/flags check_nan_inf) and
+check_numerics/collect_operator_stats inspect values directly."""
+from __future__ import annotations
+
+import contextlib
+import enum
+from typing import Optional
+
+import numpy as np
+
+from ..core import flags
+from ..core.tensor import Tensor
+
+__all__ = ["DebugMode", "TensorCheckerConfig", "enable_tensor_checker",
+           "disable_tensor_checker", "check_numerics",
+           "collect_operator_stats", "enable_operator_stats_collection",
+           "disable_operator_stats_collection"]
+
+
+class DebugMode(enum.Enum):
+    CHECK_NAN_INF_AND_ABORT = 0
+    CHECK_NAN_INF = 1
+    CHECK_ALL = 2
+
+
+class TensorCheckerConfig:
+    def __init__(self, enable=True, debug_mode=DebugMode.CHECK_NAN_INF_AND_ABORT,
+                 output_dir=None, checked_op_list=None,
+                 skipped_op_list=None, debug_step=None, stack_height_limit=1):
+        self.enable = enable
+        self.debug_mode = debug_mode
+        self.output_dir = output_dir
+        self.checked_op_list = checked_op_list
+        self.skipped_op_list = skipped_op_list
+        self.debug_step = debug_step
+
+
+def enable_tensor_checker(config: Optional[TensorCheckerConfig] = None):
+    config = config or TensorCheckerConfig()
+    if not config.enable:
+        return
+    flags.set_flags({"FLAGS_check_nan_inf": True})
+    level = 0 if config.debug_mode == DebugMode.CHECK_NAN_INF_AND_ABORT else 1
+    flags.set_flags({"FLAGS_check_nan_inf_level": level})
+
+
+def disable_tensor_checker():
+    flags.set_flags({"FLAGS_check_nan_inf": False})
+
+
+def check_numerics(tensor, op_type="", var_name="", debug_mode=None):
+    """Scan a tensor for NaN/Inf; returns (num_nan, num_inf, num_zero) like
+    the reference's check_numerics op. An explicit ``debug_mode`` overrides
+    the global flag: ABORT raises, the report-only modes warn."""
+    arr = np.asarray(tensor._data if isinstance(tensor, Tensor) else tensor)
+    n_nan = int(np.isnan(arr).sum())
+    n_inf = int(np.isinf(arr).sum())
+    n_zero = int((arr == 0).sum())
+    if n_nan or n_inf:
+        msg = (f"check_numerics: op={op_type or '?'} var={var_name or '?'} "
+               f"nan={n_nan} inf={n_inf}")
+        if debug_mode is not None:
+            abort = debug_mode == DebugMode.CHECK_NAN_INF_AND_ABORT
+        else:
+            abort = flags.flag("check_nan_inf_level") == 0
+        if abort:
+            raise FloatingPointError(msg)
+        print("WARNING:", msg)
+    import jax.numpy as jnp
+
+    return (Tensor(jnp.asarray(n_nan)), Tensor(jnp.asarray(n_inf)),
+            Tensor(jnp.asarray(n_zero)))
+
+
+_op_stats = {"active": False, "counts": {}}
+
+
+def enable_operator_stats_collection():
+    from ..core import trace_hook
+
+    _op_stats["active"] = True
+    _op_stats["counts"] = {}
+    trace_hook.enable()  # native tracer supplies the begin() timestamps
+    trace_hook._lib.pt_trace_enable(1)
+    _prev = trace_hook.end
+
+    def counting_end(name, t0):
+        _op_stats["counts"][name] = _op_stats["counts"].get(name, 0) + 1
+        return _prev(name, t0)
+
+    _op_stats["_restore"] = (_prev,)
+    trace_hook.end = counting_end
+
+
+def disable_operator_stats_collection():
+    from ..core import trace_hook
+
+    if not _op_stats["active"]:
+        return
+    _op_stats["active"] = False
+    trace_hook.end = _op_stats.pop("_restore")[0]
+    trace_hook._lib.pt_trace_enable(0)
+    trace_hook.disable()
+    print("<------ op list ------>")
+    for name, n in sorted(_op_stats["counts"].items()):
+        print(f"  {name}: {n} calls")
+    print("<----- op count: "
+          f"{sum(_op_stats['counts'].values())} ----->")
+
+
+@contextlib.contextmanager
+def collect_operator_stats():
+    enable_operator_stats_collection()
+    try:
+        yield
+    finally:
+        disable_operator_stats_collection()

@@ -1,0 +1,124 @@
+"""paddle.audio: feature extraction over the fft/signal stack
+(ref:python/paddle/audio/features/layers.py, functional/functional.py).
+
+Spectrogram/MelSpectrogram/LogMelSpectrogram/MFCC are Layers whose forward
+runs the framework stft + mel filterbank + DCT — all XLA ops, so feature
+extraction fuses into the model's compiled program on TPU (the reference
+computes these with its own kernels on GPU).
+"""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+
+from .. import nn, signal
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+from . import functional  # noqa: F401
+from .functional import (compute_fbank_matrix, create_dct, get_window,
+                         hz_to_mel, mel_to_hz)
+
+__all__ = ["Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC",
+           "functional", "compute_fbank_matrix", "create_dct", "hz_to_mel",
+           "mel_to_hz"]
+
+
+class Spectrogram(nn.Layer):
+    def __init__(self, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 dtype="float32"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        # periodic (fftbins) window via the shared helper — the STFT
+        # contract; unknown names raise instead of silently becoming hann
+        w = get_window(window, self.win_length, fftbins=True)
+        self.register_buffer("window", Tensor(jnp.asarray(w)))
+
+    def forward(self, x):
+        spec = signal.stft(x, self.n_fft, self.hop_length, self.win_length,
+                           window=self.window, center=self.center,
+                           pad_mode=self.pad_mode)
+
+        def _mag(s, *, power):
+            m = jnp.abs(s)
+            return m ** power if power != 1.0 else m
+
+        return apply(_mag, (spec,), {"power": float(self.power)})
+
+
+class MelSpectrogram(nn.Layer):
+    def __init__(self, sr=22050, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 n_mels=64, f_min=50.0, f_max=None, htk=False, norm="slaney",
+                 dtype="float32"):
+        super().__init__()
+        self.spectrogram = Spectrogram(n_fft, hop_length, win_length, window,
+                                       power, center, pad_mode)
+        fbank = compute_fbank_matrix(sr=sr, n_fft=n_fft, n_mels=n_mels,
+                                     f_min=f_min, f_max=f_max, htk=htk,
+                                     norm=norm)
+        self.register_buffer("fbank", Tensor(jnp.asarray(fbank)))
+
+    def forward(self, x):
+        spec = self.spectrogram(x)  # [.., n_fft//2+1, frames]
+
+        def _mel(s, fb):
+            return jnp.einsum("mf,...ft->...mt", fb, s)
+
+        return apply(_mel, (spec, self.fbank), {})
+
+
+class LogMelSpectrogram(nn.Layer):
+    def __init__(self, sr=22050, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 n_mels=64, f_min=50.0, f_max=None, htk=False, norm="slaney",
+                 ref_value=1.0, amin=1e-10, top_db=None, dtype="float32"):
+        super().__init__()
+        self.mel = MelSpectrogram(sr, n_fft, hop_length, win_length, window,
+                                  power, center, pad_mode, n_mels, f_min,
+                                  f_max, htk, norm)
+        self.ref_value, self.amin, self.top_db = ref_value, amin, top_db
+
+    def forward(self, x):
+        m = self.mel(x)
+
+        def _db(m, *, ref, amin, top_db):
+            db = 10.0 * jnp.log10(jnp.maximum(m, amin))
+            db = db - 10.0 * math.log10(max(ref, amin))
+            if top_db is not None:
+                db = jnp.maximum(db, db.max() - top_db)
+            return db
+
+        return apply(_db, (m,), {"ref": float(self.ref_value),
+                                 "amin": float(self.amin),
+                                 "top_db": self.top_db})
+
+
+class MFCC(nn.Layer):
+    def __init__(self, sr=22050, n_mfcc=40, n_fft=512, hop_length=None,
+                 win_length=None, window="hann", power=2.0, center=True,
+                 pad_mode="reflect", n_mels=64, f_min=50.0, f_max=None,
+                 htk=False, norm="slaney", ref_value=1.0, amin=1e-10,
+                 top_db=None, dtype="float32"):
+        super().__init__()
+        self.logmel = LogMelSpectrogram(sr, n_fft, hop_length, win_length,
+                                        window, power, center, pad_mode,
+                                        n_mels, f_min, f_max, htk, norm,
+                                        ref_value, amin, top_db)
+        dct = create_dct(n_mfcc, n_mels)
+        self.register_buffer("dct", Tensor(jnp.asarray(dct)))
+
+    def forward(self, x):
+        lm = self.logmel(x)  # [.., n_mels, t]
+
+        def _dct(lm, d):
+            return jnp.einsum("km,...mt->...kt", d, lm)
+
+        return apply(_dct, (lm, self.dct), {})

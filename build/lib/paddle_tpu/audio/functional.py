@@ -1,0 +1,94 @@
+"""paddle.audio.functional (ref:python/paddle/audio/functional/functional.py):
+mel scale conversions, filterbank and DCT matrices, window functions.
+Matrix builders are host-side numpy (they run once at layer build)."""
+from __future__ import annotations
+
+import numpy as np
+
+
+def hz_to_mel(freq, htk: bool = False):
+    freq = np.asarray(freq, np.float64)
+    if htk:
+        return 2595.0 * np.log10(1.0 + freq / 700.0)
+    # slaney: linear below 1 kHz, log above
+    f_min, f_sp = 0.0, 200.0 / 3
+    mels = (freq - f_min) / f_sp
+    min_log_hz = 1000.0
+    min_log_mel = (min_log_hz - f_min) / f_sp
+    logstep = np.log(6.4) / 27.0
+    return np.where(freq >= min_log_hz,
+                    min_log_mel + np.log(np.maximum(freq, 1e-10) / min_log_hz) / logstep,
+                    mels)
+
+
+def mel_to_hz(mel, htk: bool = False):
+    mel = np.asarray(mel, np.float64)
+    if htk:
+        return 700.0 * (10.0 ** (mel / 2595.0) - 1.0)
+    f_min, f_sp = 0.0, 200.0 / 3
+    freqs = f_min + f_sp * mel
+    min_log_hz = 1000.0
+    min_log_mel = (min_log_hz - f_min) / f_sp
+    logstep = np.log(6.4) / 27.0
+    return np.where(mel >= min_log_mel,
+                    min_log_hz * np.exp(logstep * (mel - min_log_mel)), freqs)
+
+
+def mel_frequencies(n_mels=64, f_min=0.0, f_max=11025.0, htk=False):
+    mels = np.linspace(hz_to_mel(f_min, htk), hz_to_mel(f_max, htk), n_mels)
+    return mel_to_hz(mels, htk)
+
+
+def fft_frequencies(sr, n_fft):
+    return np.linspace(0, sr / 2, 1 + n_fft // 2)
+
+
+def compute_fbank_matrix(sr=22050, n_fft=512, n_mels=64, f_min=50.0,
+                         f_max=None, htk=False, norm="slaney",
+                         dtype=np.float32):
+    """Triangular mel filterbank [n_mels, n_fft//2 + 1]."""
+    f_max = f_max or sr / 2.0
+    fft_f = fft_frequencies(sr, n_fft)
+    mel_f = mel_frequencies(n_mels + 2, f_min, f_max, htk)
+    fdiff = np.diff(mel_f)
+    ramps = mel_f[:, None] - fft_f[None, :]
+    weights = np.zeros((n_mels, len(fft_f)))
+    for i in range(n_mels):
+        lower = -ramps[i] / fdiff[i]
+        upper = ramps[i + 2] / fdiff[i + 1]
+        weights[i] = np.maximum(0, np.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (mel_f[2:n_mels + 2] - mel_f[:n_mels])
+        weights *= enorm[:, None]
+    return weights.astype(dtype)
+
+
+def create_dct(n_mfcc, n_mels, norm="ortho", dtype=np.float32):
+    """Type-II DCT matrix [n_mfcc, n_mels]."""
+    n = np.arange(n_mels)
+    k = np.arange(n_mfcc)[:, None]
+    dct = np.cos(np.pi / n_mels * (n + 0.5) * k)
+    if norm == "ortho":
+        dct[0] *= 1.0 / np.sqrt(2)
+        dct *= np.sqrt(2.0 / n_mels)
+    else:
+        dct *= 2.0
+    return dct.astype(dtype)
+
+
+def get_window(window, win_length, fftbins=True, dtype=np.float32):
+    fn = {"hann": np.hanning, "hamming": np.hamming,
+          "blackman": np.blackman, "bartlett": np.bartlett}.get(window)
+    if fn is None:
+        raise ValueError(f"unsupported window {window!r}")
+    if fftbins:  # periodic
+        return fn(win_length + 1)[:-1].astype(dtype)
+    return fn(win_length).astype(dtype)
+
+
+def power_to_db(spect, ref_value=1.0, amin=1e-10, top_db=80.0):
+    db = 10.0 * np.log10(np.maximum(np.asarray(spect), amin))
+    db -= 10.0 * np.log10(max(ref_value, amin))
+    if top_db is not None:
+        db = np.maximum(db, db.max() - top_db)
+    return db

@@ -25,3 +25,73 @@ __all__ = [
     "set_grad_enabled",
     "is_grad_enabled",
 ]
+
+
+def jacobian(ys, xs, batch_axis=None):
+    """Dense Jacobian d ys / d xs (ref:python/paddle/autograd/autograd.py
+    Jacobian). Computed row-by-row with the eager tape (vjp per output
+    element); for compiled use, jax.jacrev over a pure fn is preferred."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..core.autograd import grad as _grad
+    from ..core.tensor import Tensor
+
+    single_x = isinstance(xs, Tensor)
+    xs_list = [xs] if single_x else list(xs)
+    y_flat = ys.reshape([-1]) if ys.ndim > 0 else ys.reshape([1])
+    rows = []
+    n = y_flat.shape[0]
+    for i in range(n):
+        gs = _grad(y_flat[i], xs_list, retain_graph=True, allow_unused=True)
+        row = [
+            (np.zeros(np.asarray(x._data).shape, np.float32).ravel()
+             if g is None else np.asarray(g._data).ravel())
+            for g, x in zip(gs, xs_list)
+        ]
+        rows.append(np.concatenate(row))
+    jac = Tensor(jnp.asarray(np.stack(rows)))
+    return jac
+
+
+def hessian(func_out, xs, batch_axis=None):
+    """Full Hessian of a scalar output w.r.t. xs via grad-of-grad: the
+    jacobian (including cross-partial blocks) of the concatenated gradient."""
+    from ..core.autograd import grad as _grad
+    from ..core.tensor import Tensor
+
+    single_x = isinstance(xs, Tensor)
+    xs_list = [xs] if single_x else list(xs)
+    gs = _grad(func_out, xs_list, create_graph=True)
+    if single_x:
+        return jacobian(gs[0], xs)
+    from ..ops.manipulation import concat, reshape
+
+    flat = concat([reshape(g, [-1]) for g in gs], axis=0)
+    return jacobian(flat, xs_list)
+
+
+class saved_tensors_hooks:
+    """Context manager transforming tape-saved forward activations
+    (ref:python/paddle/autograd/saved_tensors_hooks.py): ``pack`` runs when
+    an op records its inputs, ``unpack`` when backward needs them — e.g.
+    cast-to-bf16 storage, or host offload. Note: the tape's tensor links may
+    keep device buffers alive independently of the packed copies, so the
+    memory saved by an offloading hook is bounded by what only in_datas
+    referenced."""
+
+    def __init__(self, pack_hook, unpack_hook):
+        self.pack_hook = pack_hook
+        self.unpack_hook = unpack_hook
+
+    def __enter__(self):
+        from ..core import dispatch
+
+        dispatch._saved_tensor_hooks.append((self.pack_hook, self.unpack_hook))
+        return self
+
+    def __exit__(self, *exc):
+        from ..core import dispatch
+
+        dispatch._saved_tensor_hooks.pop()
+        return False

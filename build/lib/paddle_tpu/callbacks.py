@@ -1,0 +1,10 @@
+"""paddle.callbacks (ref:python/paddle/callbacks.py): the hapi training
+callbacks under their public alias."""
+from .hapi.callbacks import (  # noqa: F401
+    Callback, CallbackList, EarlyStopping, LRScheduler, ModelCheckpoint,
+    ProgBarLogger)
+
+from .hapi.callbacks import (  # noqa: F401
+    ReduceLROnPlateau, VisualDL, WandbCallback)
+
+__all__ = [n for n in dir() if not n.startswith("_")]

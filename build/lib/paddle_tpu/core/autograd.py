@@ -77,12 +77,13 @@ class TapeNode:
     """One recorded op application (≈ GradNodeBase)."""
 
     __slots__ = ("fn", "static", "in_datas", "in_tensors", "in_versions",
-                 "out_refs", "out_avals", "multi_out", "name")
+                 "out_refs", "out_avals", "multi_out", "name", "unpack")
 
     def __init__(self, fn, static, in_datas, in_tensors, multi_out, name):
         self.fn = fn
         self.static = static
         self.in_datas = in_datas
+        self.unpack = None  # saved_tensors_hooks unpack fn, if active
         self.in_tensors = in_tensors  # strong refs: keeps producing subgraph alive
         self.in_versions = tuple(
             t._version if isinstance(t, Tensor) else 0 for t in in_tensors
@@ -115,6 +116,9 @@ class TapeNode:
         jax.vjp-of-vjp, replacing the reference's retained-graph GeneralGrad,
         ref:paddle/fluid/eager/general_grad.h).
         """
+        in_datas = self.in_datas
+        if self.unpack is not None:
+            in_datas = tuple(self.unpack(d) for d in in_datas)
         if not create_graph:
             cts = [
                 (c._data if isinstance(c, Tensor) else c)
@@ -122,15 +126,15 @@ class TapeNode:
                 else jnp.zeros(shape, dt)
                 for c, (shape, dt) in zip(out_cts, self.out_avals)
             ]
-            _, vjp_fn = jax.vjp(self.pure(), *self.in_datas)
+            _, vjp_fn = jax.vjp(self.pure(), *in_datas)
             return vjp_fn(tuple(cts) if self.multi_out else cts[0])
 
         from . import dispatch
 
-        diff_idx = tuple(i for i, d in enumerate(self.in_datas) if _is_float(d.dtype))
+        diff_idx = tuple(i for i, d in enumerate(in_datas) if _is_float(d.dtype))
         if not diff_idx:
             return (None,) * len(self.in_datas)
-        g = _vjp_fn_of(self.fn, self.static, self.multi_out, len(self.in_datas), diff_idx)
+        g = _vjp_fn_of(self.fn, self.static, self.multi_out, len(in_datas), diff_idx)
         ct_ts = [
             (c if isinstance(c, Tensor) else Tensor(c))
             if c is not None
@@ -138,7 +142,25 @@ class TapeNode:
             for c, (shape, dt) in zip(out_cts, self.out_avals)
         ]
         args = tuple(self.in_tensors) + tuple(ct_ts)
-        out = dispatch.apply(g, args, {}, name=(self.name or "op") + "_grad")
+        if self.unpack is None:
+            out = dispatch.apply(g, args, {}, name=(self.name or "op") + "_grad")
+        else:
+            # evaluate at the hook-transformed values (consistent with the
+            # first-order path) while keeping the tensors' graph identity:
+            # temporarily swap in the unpacked data
+            olds = []
+            for t, d in zip(self.in_tensors, in_datas):
+                if isinstance(t, Tensor):
+                    olds.append(t._data)
+                    t._data = d
+                else:
+                    olds.append(None)
+            try:
+                out = dispatch.apply(g, args, {}, name=(self.name or "op") + "_grad")
+            finally:
+                for t, o in zip(self.in_tensors, olds):
+                    if isinstance(t, Tensor):
+                        t._data = o
         out = out if isinstance(out, tuple) else (out,)
         res = [None] * len(self.in_datas)
         for i, o in zip(diff_idx, out):

@@ -28,6 +28,8 @@ from .tensor import Tensor
 
 _JIT_CACHE: Dict[Tuple, Any] = {}
 _amp = None  # set lazily to break the import cycle
+# active (pack, unpack) saved-tensor hooks (autograd.saved_tensors_hooks)
+_saved_tensor_hooks: list = []
 
 
 def _init_amp():
@@ -38,8 +40,42 @@ def _init_amp():
         _amp = _amp_mod
 
 
+def _fn_cache_key(fn):
+    """Stable cache identity for op pure-functions.
+
+    Most ops define their pure fn as a nested def, so the function OBJECT is
+    new on every call — keying the jit cache by it would recompile every op
+    invocation. The code object is shared across instances of the same def;
+    together with the (hashable) closure contents it identifies the
+    computation. Unhashable closure contents fall back to object identity.
+    """
+    code = getattr(fn, "__code__", None)
+    if code is None:
+        return fn
+    vals = []
+    closure_vals = [c.cell_contents for c in getattr(fn, "__closure__", None) or ()]
+    # default args are behavior too (the `def g(*a, _bound=x)` binding idiom;
+    # keyword-only defaults land in __kwdefaults__, positional in __defaults__)
+    kwdefaults = getattr(fn, "__kwdefaults__", None) or {}
+    for v in (
+        closure_vals
+        + list(getattr(fn, "__defaults__", None) or ())
+        + [v for _, v in sorted(kwdefaults.items())]
+    ):
+        try:
+            hash(v)
+        except TypeError:
+            return fn
+        # (type, value): 2 and 2.0 (or True) are ==-equal but jit to
+        # different programs under weak-type promotion
+        vals.append((type(v), v))
+    if not vals:
+        return code
+    return (code, tuple(vals))
+
+
 def _jitted(fn, static: Tuple):
-    key = (fn, static)
+    key = (_fn_cache_key(fn), static)
     ex = _JIT_CACHE.get(key)
     if ex is None:
         ex = jax.jit(functools.partial(fn, **dict(static))) if static else jax.jit(fn)
@@ -159,6 +195,10 @@ def apply(fn, tensor_args: Tuple, static: Dict[str, Any], *, differentiable: boo
         # in_tensors aligns 1:1 with fn's positional args for the vjp zip;
         # non-Tensor entries (python scalars) get no cotangent.
         node = TapeNode(fn, static_t, datas, tensor_args, multi, name)
+        if _saved_tensor_hooks:
+            pack, unpack = _saved_tensor_hooks[-1]
+            node.in_datas = tuple(pack(d) for d in datas)
+            node.unpack = unpack
         out_tensors = []
         for o in outs:
             t = Tensor(o, stop_gradient=False)

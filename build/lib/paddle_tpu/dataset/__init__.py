@@ -1,0 +1,103 @@
+"""paddle.dataset (ref:python/paddle/dataset/): the legacy reader-creator
+API — ``paddle.dataset.uci_housing.train()`` returns a zero-arg callable
+yielding samples. Thin adapters over the map-style classes in
+``paddle_tpu.text.datasets`` / ``vision.datasets``; every creator also
+accepts the class kwargs (e.g. ``data_file=``) so they work offline."""
+from __future__ import annotations
+
+import sys
+import types
+
+from ..utils.download import DATA_HOME  # noqa: F401
+
+__all__ = ["common", "mnist", "cifar", "flowers", "imdb", "imikolov",
+           "movielens", "uci_housing", "voc2012", "conll05", "wmt14",
+           "wmt16"]
+
+
+def _reader_from(dataset_cls, **fixed):
+    def creator(*args, **kwargs):
+        def reader():
+            ds = dataset_cls(*args, **{**fixed, **kwargs})
+            for i in range(len(ds)):
+                yield ds[i]
+
+        return reader
+
+    return creator
+
+
+def _module(name, **attrs):
+    mod = types.ModuleType(f"{__name__}.{name}")
+    for k, v in attrs.items():
+        setattr(mod, k, v)
+    sys.modules[mod.__name__] = mod
+    return mod
+
+
+def _build():
+    from ..text import datasets as td
+    from ..vision import datasets as vd
+
+    def md5file(fname):
+        import hashlib
+
+        h = hashlib.md5()
+        with open(fname, "rb") as f:
+            for chunk in iter(lambda: f.read(1 << 20), b""):
+                h.update(chunk)
+        return h.hexdigest()
+
+    mods = {
+        "common": _module("common", DATA_HOME=DATA_HOME, md5file=md5file),
+        "mnist": _module(
+            "mnist",
+            train=_reader_from(vd.MNIST, mode="train"),
+            test=_reader_from(vd.MNIST, mode="test")),
+        "cifar": _module(
+            "cifar",
+            train10=_reader_from(vd.Cifar10, mode="train"),
+            test10=_reader_from(vd.Cifar10, mode="test"),
+            train100=_reader_from(vd.Cifar100, mode="train"),
+            test100=_reader_from(vd.Cifar100, mode="test")),
+        "flowers": _module(
+            "flowers",
+            train=_reader_from(vd.Flowers, mode="train"),
+            valid=_reader_from(vd.Flowers, mode="valid"),
+            test=_reader_from(vd.Flowers, mode="test")),
+        "voc2012": _module(
+            "voc2012",
+            train=_reader_from(vd.VOC2012, mode="train"),
+            val=_reader_from(vd.VOC2012, mode="valid"),
+            test=_reader_from(vd.VOC2012, mode="test")),
+        "imdb": _module(
+            "imdb",
+            train=_reader_from(td.Imdb, mode="train"),
+            test=_reader_from(td.Imdb, mode="test")),
+        "imikolov": _module(
+            "imikolov",
+            train=_reader_from(td.Imikolov, mode="train"),
+            test=_reader_from(td.Imikolov, mode="test")),
+        "movielens": _module(
+            "movielens",
+            train=_reader_from(td.Movielens, mode="train"),
+            test=_reader_from(td.Movielens, mode="test")),
+        "uci_housing": _module(
+            "uci_housing",
+            train=_reader_from(td.UCIHousing, mode="train"),
+            test=_reader_from(td.UCIHousing, mode="test")),
+        "conll05": _module("conll05", test=_reader_from(td.Conll05st)),
+        "wmt14": _module(
+            "wmt14",
+            train=_reader_from(td.WMT14, mode="train"),
+            test=_reader_from(td.WMT14, mode="test")),
+        "wmt16": _module(
+            "wmt16",
+            train=_reader_from(td.WMT16, mode="train"),
+            test=_reader_from(td.WMT16, mode="test")),
+    }
+    return mods
+
+
+_mods = _build()
+globals().update(_mods)

@@ -21,3 +21,69 @@ def get_all_device_type():
 
 def get_available_device():
     return get_device()
+
+
+# ------------------------------------------------------- memory introspection
+# (ref:paddle/fluid/memory/stats.h DEVICE_MEMORY_STAT / paddle.device.cuda
+# memory_allocated family) — backed by PJRT's per-device memory_stats.
+
+
+def _mem_stats(device_id=0):
+    import jax
+
+    devs = jax.local_devices()
+    if not 0 <= device_id < len(devs):
+        raise ValueError(
+            f"device_id {device_id} out of range: {len(devs)} local device(s)")
+    stats = devs[device_id].memory_stats() or {}
+    return stats
+
+
+def memory_allocated(device=None, device_id=0):
+    """Bytes currently allocated on the device (0 if the backend does not
+    report, e.g. CPU)."""
+    return int(_mem_stats(device_id).get("bytes_in_use", 0))
+
+
+def max_memory_allocated(device=None, device_id=0):
+    return int(_mem_stats(device_id).get("peak_bytes_in_use", 0))
+
+
+def memory_reserved(device=None, device_id=0):
+    s = _mem_stats(device_id)
+    return int(s.get("bytes_reserved", s.get("bytes_in_use", 0)))
+
+
+def max_memory_reserved(device=None, device_id=0):
+    s = _mem_stats(device_id)
+    return int(s.get("peak_bytes_reserved", s.get("peak_bytes_in_use", 0)))
+
+
+def device_memory_limit(device_id=0):
+    return int(_mem_stats(device_id).get("bytes_limit", 0))
+
+
+def empty_cache():
+    """Release cached device allocations back to the allocator where the
+    backend supports it (XLA manages its own pools; this is best-effort)."""
+    import gc
+
+    gc.collect()
+
+
+class cuda:  # namespace parity: paddle.device.cuda.*
+    memory_allocated = staticmethod(memory_allocated)
+    max_memory_allocated = staticmethod(max_memory_allocated)
+    memory_reserved = staticmethod(memory_reserved)
+    max_memory_reserved = staticmethod(max_memory_reserved)
+    empty_cache = staticmethod(empty_cache)
+
+    @staticmethod
+    def synchronize(device=None):
+        import jax
+
+        jax.effects_barrier()
+
+    @staticmethod
+    def device_count():
+        return device_count()

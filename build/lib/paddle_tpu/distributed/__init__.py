@@ -46,6 +46,32 @@ from . import context_parallel  # noqa: F401
 from . import pipeline  # noqa: F401
 from . import sharding  # noqa: F401
 from .store import TCPStore  # noqa: F401
+from . import ps  # noqa: F401
+from . import io  # noqa: F401
+from . import auto_parallel  # noqa: F401
+from .auto_parallel import shard_tensor, shard_op  # noqa: F401
+from . import rpc  # noqa: F401
+from .api_extra import (  # noqa: F401
+    CountFilterEntry,
+    InMemoryDataset,
+    ParallelEnv,
+    ParallelMode,
+    ProbabilityEntry,
+    QueueDataset,
+    ShowClickEntry,
+    broadcast_object_list,
+    gather,
+    get_backend,
+    gloo_barrier,
+    gloo_init_parallel_env,
+    gloo_release,
+    irecv,
+    is_available,
+    isend,
+    scatter_object_list,
+    split,
+    wait,
+)
 from .sharding import group_sharded_parallel, save_group_sharded_model  # noqa: F401
 from .spawn import spawn  # noqa: F401
 from . import launch  # noqa: F401

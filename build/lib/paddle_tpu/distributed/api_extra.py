@@ -1,0 +1,301 @@
+"""Remaining paddle.distributed API surface
+(ref:python/paddle/distributed/communication/*.py, parallel.py, fleet
+dataset + PS accessor entries).
+
+gather/isend/irecv/wait build on the collective layer; the dataset classes
+are host-side containers (the reference's C++ InMemoryDataset feeds the PS
+trainers — here the consumer is the DataLoader/PS pipeline); the *Entry
+configs parameterize the sparse-table accessor of distributed/ps.
+"""
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from . import collective as C
+from .collective import Group
+
+
+def gather(tensor, gather_list=None, dst: int = 0, group: Optional[Group] = None,
+           sync_op: bool = True):
+    """Gather tensors to dst (ref communication/gather.py): implemented as
+    all_gather + selection — on TPU the collective is compiler-scheduled and
+    the non-dst copies are DCE'd."""
+    tmp: List = []
+    C.all_gather(tmp, tensor, group=group)
+    # single-controller SPMD: every rank materializes the gathered value —
+    # there is no per-process dst to special-case; unused non-dst copies
+    # disappear in compilation
+    if gather_list is not None:
+        gather_list.clear()
+        gather_list.extend(tmp)
+        return gather_list
+    return tmp
+
+
+class _Task:
+    """Waitable handle mirroring the reference's async Task (collectives
+    here are compiled/synchronous, so wait() is immediate)."""
+
+    def __init__(self, tensor=None):
+        self._tensor = tensor
+
+    def wait(self):
+        return True
+
+    def is_completed(self):
+        return True
+
+
+def isend(tensor, dst: int = 0, group: Optional[Group] = None):
+    C.send(tensor, dst=dst, group=group)
+    return _Task(tensor)
+
+
+def irecv(tensor, src: int = 0, group: Optional[Group] = None):
+    C.recv(tensor, src=src, group=group)
+    return _Task(tensor)
+
+
+def wait(tensor, group: Optional[Group] = None, use_calc_stream: bool = True):
+    """Stream-sync parity hook: XLA programs are ordered by data flow, so
+    this only blocks the host until the value is materialized."""
+    import jax
+
+    if isinstance(tensor, Tensor):
+        jax.block_until_ready(tensor._data)
+    return None
+
+
+def broadcast_object_list(object_list: list, src: int = 0,
+                          group: Optional[Group] = None):
+    """Pickle-based object broadcast (ref broadcast_object_list)."""
+    import pickle
+
+    g = group or C._get_default_group()
+    if g.world_size == 1:
+        return
+    # ride the tensor broadcast: serialize on src, length-prefix, pad
+    payload = pickle.dumps(object_list) if g.rank == src else b""
+    n = len(payload)
+    import jax.numpy as jnp
+
+    ln = Tensor(jnp.asarray([n], jnp.int32))
+    C.broadcast(ln, src=src, group=group)
+    n = int(np.asarray(ln._data)[0])
+    buf = np.zeros(n, np.uint8)
+    if g.rank == src:
+        buf[:] = np.frombuffer(payload, np.uint8)
+    t = Tensor(jnp.asarray(buf))
+    C.broadcast(t, src=src, group=group)
+    if g.rank != src:
+        got = pickle.loads(np.asarray(t._data).tobytes())
+        object_list.clear()
+        object_list.extend(got)
+
+
+def scatter_object_list(out_object_list: list, in_object_list=None,
+                        src: int = 0, group: Optional[Group] = None):
+    """Scatter python objects (ref scatter_object_list): broadcast all then
+    select this rank's slot (object payloads are small control-plane data)."""
+    g = group or C._get_default_group()
+    tmp = list(in_object_list or [None] * g.world_size)
+    broadcast_object_list(tmp, src=src, group=group)
+    out_object_list.clear()
+    out_object_list.append(tmp[g.rank])
+
+
+def get_backend(group: Optional[Group] = None) -> str:
+    """The single comm backend: XLA collectives over ICI/DCN."""
+    return "XCCL"
+
+
+def is_available() -> bool:
+    return True
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """Model-parallel helper api (ref:python/paddle/distributed/fleet/layers/
+    mpu/mp_ops.py split): builds the column/row-parallel layer for the
+    current model-parallel group."""
+    from .fleet.meta_parallel.mp_layers import (ColumnParallelLinear,
+                                                RowParallelLinear,
+                                                VocabParallelEmbedding)
+
+    if operation == "linear":
+        if axis == 0:
+            layer = RowParallelLinear(size[0], size[1], has_bias=bias_attr is not False)
+        else:
+            layer = ColumnParallelLinear(size[0], size[1], has_bias=bias_attr is not False,
+                                         gather_output=gather_out)
+        return layer(x)
+    if operation == "embedding":
+        layer = VocabParallelEmbedding(size[0], size[1])
+        return layer(x)
+    raise ValueError(f"split: unknown operation {operation!r}")
+
+
+class ParallelEnv:
+    """Env-contract view (ref:python/paddle/distributed/parallel.py
+    ParallelEnv): rank/world/endpoints from the launcher env."""
+
+    def __init__(self):
+        self.rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+        self.world_size = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+        self.device_id = int(os.environ.get("FLAGS_selected_devices", "0")
+                             .split(",")[0] or 0)
+        self.current_endpoint = os.environ.get("PADDLE_CURRENT_ENDPOINT", "")
+        self.trainer_endpoints = [
+            e for e in os.environ.get("PADDLE_TRAINER_ENDPOINTS", "").split(",") if e
+        ]
+
+    @property
+    def nranks(self):
+        return self.world_size
+
+    @property
+    def local_rank(self):
+        return self.rank
+
+    @property
+    def dev_id(self):
+        return self.device_id
+
+
+class ParallelMode:
+    """Parallelism taxonomy constants (ref base/topology.py ParallelMode)."""
+
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+    SEGMENT_PARALLEL = 4
+
+
+# ------------------------------------------------------------- PS datasets
+
+
+class InMemoryDataset:
+    """Host-RAM training dataset for PS workloads
+    (ref:python/paddle/distributed/fleet/dataset/dataset.py InMemoryDataset):
+    load text samples into memory, shuffle globally, batch for the trainer."""
+
+    def __init__(self):
+        self._samples: List = []
+        self._parse_fn = None
+        self._batch_size = 1
+        self._shuffled = False
+
+    def init(self, batch_size=1, use_var=None, pipe_command=None,
+             parse_fn=None, **kw):
+        self._batch_size = batch_size
+        self._parse_fn = parse_fn
+
+    set_batch_size = init
+
+    def set_filelist(self, files):
+        self._files = list(files)
+
+    def load_into_memory(self):
+        self._samples = []
+        for path in getattr(self, "_files", []):
+            with open(path) as f:
+                for line in f:
+                    line = line.rstrip("\n")
+                    self._samples.append(
+                        self._parse_fn(line) if self._parse_fn else line)
+
+    def local_shuffle(self):
+        np.random.shuffle(self._samples)
+        self._shuffled = True
+
+    def global_shuffle(self, fleet=None, thread_num=12):
+        self.local_shuffle()
+
+    def get_memory_data_size(self, fleet=None):
+        return len(self._samples)
+
+    def release_memory(self):
+        self._samples = []
+
+    def __iter__(self):
+        for i in range(0, len(self._samples), self._batch_size):
+            yield self._samples[i:i + self._batch_size]
+
+
+class QueueDataset(InMemoryDataset):
+    """Streaming variant (ref QueueDataset): iterates files lazily."""
+
+    def load_into_memory(self):  # streaming: nothing to preload
+        pass
+
+    def __iter__(self):
+        batch = []
+        for path in getattr(self, "_files", []):
+            with open(path) as f:
+                for line in f:
+                    line = line.rstrip("\n")
+                    batch.append(self._parse_fn(line) if self._parse_fn else line)
+                    if len(batch) == self._batch_size:
+                        yield batch
+                        batch = []
+        if batch:
+            yield batch
+
+
+# -------------------------------------------------- sparse accessor entries
+
+
+class _Entry:
+    def __init__(self, **kw):
+        self.config = kw
+
+
+class CountFilterEntry(_Entry):
+    """Admit a feature into the sparse table only after N shows
+    (ref:paddle/fluid/distributed/ps/table/ctr accessor entries)."""
+
+    def __init__(self, count_filter=5):
+        super().__init__(count_filter=count_filter)
+
+
+class ProbabilityEntry(_Entry):
+    def __init__(self, probability=0.1):
+        super().__init__(probability=probability)
+
+
+class ShowClickEntry(_Entry):
+    def __init__(self, show_name="show", click_name="click"):
+        super().__init__(show_name=show_name, click_name=click_name)
+
+
+# ------------------------------------------------------------- gloo shims
+
+
+def gloo_init_parallel_env(rank_id, rank_num, server_endpoint):
+    """CPU-barrier bootstrap parity (ref gloo_init_parallel_env): the
+    TCPStore provides the same rendezvous on this stack."""
+    from .store import TCPStore
+
+    host, port = server_endpoint.rsplit(":", 1)
+    store = TCPStore(host, int(port), is_master=(rank_id == 0),
+                     world_size=rank_num)
+    globals()["_gloo_store"] = store
+    return store
+
+
+def gloo_barrier():
+    store = globals().get("_gloo_store")
+    if store is None:
+        raise RuntimeError("call gloo_init_parallel_env first")
+    store.barrier("gloo")
+
+
+def gloo_release():
+    store = globals().pop("_gloo_store", None)
+    if store is not None:
+        store.close()

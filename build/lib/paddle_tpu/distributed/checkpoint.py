@@ -72,7 +72,7 @@ def load_state_dict(
     path = os.path.abspath(path)
     ckpt = _checkpointer()
     if target is None:
-        return ckpt.restore(path)
+        return ckpt.restore(path, args=ocp.args.StandardRestore())
     abstract = _abstract_tree(_to_arrays(target))
     return ckpt.restore(path, args=ocp.args.StandardRestore(abstract))
 

@@ -188,3 +188,68 @@ def get_pipe_parallel_group():
 
 def get_sharding_parallel_group():
     return _axis_group("sharding")
+
+
+# ------------------------------------------------------------- PS-mode save
+# (ref:python/paddle/distributed/fleet/fleet.py:843 save_persistables,
+#  :998 save_one_table) — on this stack sparse tables live in the
+# embedding_service; dense state is a plain state_dict.
+
+_registered_tables = {}
+
+
+def register_sparse_table(table_id, client):
+    """Associate a SparseTableClient with a table id so fleet.save_* can
+    reach it (TheOnePSRuntime's table registry role)."""
+    _registered_tables[int(table_id)] = client
+
+
+def save_one_table(table_id, path, mode=0):
+    client = _registered_tables.get(int(table_id))
+    if client is None:
+        raise ValueError(f"no sparse table registered under id {table_id}")
+    client.save(path)
+
+
+def save_persistables(executor=None, dirname="", main_program=None, mode=0):
+    """Dump every registered sparse table shard set under ``dirname``."""
+    import os
+
+    if not dirname:
+        raise ValueError("save_persistables requires a dirname")
+    os.makedirs(dirname, exist_ok=True)
+    for tid, client in _registered_tables.items():
+        client.save(os.path.join(dirname, f"table{tid}"))
+
+
+def load_one_table(table_id, path, mode=0):
+    client = _registered_tables.get(int(table_id))
+    if client is None:
+        raise ValueError(f"no sparse table registered under id {table_id}")
+    client.load(path)
+
+
+def init_server(*args, **kwargs):
+    """PS server role entry (ref fleet.init_server): servers are started via
+    distributed.ps.run_server; nothing to pre-build here."""
+    return None
+
+
+def run_server():
+    import os
+
+    from ..ps import run_server as _run
+
+    port = int(os.environ.get("PADDLE_PORT", "0"))
+    dim = int(os.environ.get("PADDLE_PS_DIM", "16"))
+    srv = _run(dim=dim, port=port)
+    return srv
+
+
+def init_worker():
+    """PS worker role entry: connect via PADDLE_PSERVER_ENDPOINTS
+    (distributed.ps.init_from_env does the actual connect per table)."""
+    return None
+
+
+from . import utils  # noqa: F401,E402  (LocalFS/HDFSClient/recompute)

@@ -1,0 +1,191 @@
+"""fleet.utils (ref:python/paddle/distributed/fleet/utils/__init__.py):
+recompute re-export + filesystem clients (LocalFS over os/shutil; HDFSClient
+shelling to the hadoop CLI exactly like the reference's fs.py) +
+DistributedInfer."""
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+
+from ..recompute import recompute  # noqa: F401
+
+__all__ = ["LocalFS", "recompute", "DistributedInfer", "HDFSClient"]
+
+
+class ExecuteError(Exception):
+    pass
+
+
+class LocalFS:
+    """Local filesystem with the reference FS interface
+    (ref:python/paddle/distributed/fleet/utils/fs.py LocalFS)."""
+
+    def ls_dir(self, fs_path):
+        if not self.is_exist(fs_path):
+            return [], []
+        dirs, files = [], []
+        for entry in os.listdir(fs_path):
+            full = os.path.join(fs_path, entry)
+            (dirs if os.path.isdir(full) else files).append(entry)
+        return dirs, files
+
+    def mkdirs(self, fs_path):
+        os.makedirs(fs_path, exist_ok=True)
+
+    def rename(self, fs_src_path, fs_dst_path):
+        os.rename(fs_src_path, fs_dst_path)
+
+    def _rmr(self, fs_path):
+        shutil.rmtree(fs_path, ignore_errors=True)
+
+    def _rm(self, fs_path):
+        if os.path.exists(fs_path):
+            os.remove(fs_path)
+
+    def delete(self, fs_path):
+        if not self.is_exist(fs_path):
+            return
+        if os.path.isdir(fs_path):
+            self._rmr(fs_path)
+        else:
+            self._rm(fs_path)
+
+    def need_upload_download(self):
+        return False
+
+    def is_file(self, fs_path):
+        return os.path.isfile(fs_path)
+
+    def is_dir(self, fs_path):
+        return os.path.isdir(fs_path)
+
+    def is_exist(self, fs_path):
+        return os.path.exists(fs_path)
+
+    def touch(self, fs_path, exist_ok=True):
+        if self.is_exist(fs_path):
+            if exist_ok:
+                return
+            raise FileExistsError(fs_path)
+        with open(fs_path, "a"):
+            pass
+
+    def mv(self, src_path, dst_path, overwrite=False, test_exists=False):
+        if not self.is_exist(src_path):
+            raise FileNotFoundError(src_path)
+        if self.is_exist(dst_path):
+            if not overwrite:
+                # os.rename would clobber silently; the reference FS raises
+                raise FileExistsError(dst_path)
+            self.delete(dst_path)
+        os.rename(src_path, dst_path)
+
+    def list_dirs(self, fs_path):
+        if not self.is_exist(fs_path):
+            return []
+        return [e for e in os.listdir(fs_path)
+                if os.path.isdir(os.path.join(fs_path, e))]
+
+
+class HDFSClient:
+    """``hadoop fs`` CLI wrapper (ref fs.py HDFSClient): every call shells
+    to the configured hadoop binary; a missing binary raises ExecuteError
+    with the attempted command."""
+
+    def __init__(self, hadoop_home, configs=None, time_out=5 * 60 * 1000,
+                 sleep_inter=1000):
+        self._base = [os.path.join(hadoop_home, "bin", "hadoop"), "fs"]
+        if configs:
+            for k, v in configs.items():
+                self._base += ["-D", f"{k}={v}"]
+        self._timeout = time_out / 1000.0
+
+    def _run(self, *args):
+        cmd = self._base + list(args)
+        try:
+            r = subprocess.run(cmd, capture_output=True, text=True,
+                               timeout=self._timeout)
+        except (FileNotFoundError, subprocess.TimeoutExpired) as e:
+            raise ExecuteError(f"hadoop command failed: {' '.join(cmd)}: {e}")
+        return r.returncode, r.stdout
+
+    def ls_dir(self, fs_path):
+        code, out = self._run("-ls", fs_path)
+        if code != 0:
+            return [], []
+        dirs, files = [], []
+        for line in out.splitlines():
+            parts = line.split()
+            if len(parts) < 8:
+                continue
+            name = parts[-1]
+            (dirs if parts[0].startswith("d") else files).append(
+                os.path.basename(name))
+        return dirs, files
+
+    def is_exist(self, fs_path):
+        code, _ = self._run("-test", "-e", fs_path)
+        return code == 0
+
+    def is_dir(self, fs_path):
+        code, _ = self._run("-test", "-d", fs_path)
+        return code == 0
+
+    def is_file(self, fs_path):
+        return self.is_exist(fs_path) and not self.is_dir(fs_path)
+
+    def mkdirs(self, fs_path):
+        self._run("-mkdir", "-p", fs_path)
+
+    def delete(self, fs_path):
+        self._run("-rm", "-r", "-f", fs_path)
+
+    def mv(self, fs_src_path, fs_dst_path, overwrite=False, test_exists=True):
+        if test_exists and not self.is_exist(fs_src_path):
+            raise ExecuteError(f"mv source does not exist: {fs_src_path}")
+        if overwrite:
+            self.delete(fs_dst_path)
+        code, out = self._run("-mv", fs_src_path, fs_dst_path)
+        if code != 0:
+            raise ExecuteError(
+                f"hadoop fs -mv {fs_src_path} {fs_dst_path} failed: {out}")
+
+    def upload(self, local_path, fs_path, multi_processes=1, overwrite=False):
+        if overwrite:
+            self.delete(fs_path)
+        self._run("-put", local_path, fs_path)
+
+    def download(self, fs_path, local_path, multi_processes=1,
+                 overwrite=False):
+        if overwrite and os.path.exists(local_path):
+            LocalFS().delete(local_path)
+        self._run("-get", fs_path, local_path)
+
+    def touch(self, fs_path, exist_ok=True):
+        if not exist_ok and self.is_exist(fs_path):
+            raise ExecuteError(f"{fs_path} exists")
+        self._run("-touchz", fs_path)
+
+    def need_upload_download(self):
+        return True
+
+    def cat(self, fs_path):
+        code, out = self._run("-cat", fs_path)
+        return out if code == 0 else ""
+
+
+class DistributedInfer:
+    """PS inference helper (ref fleet/utils/ps_util.py): in this framework
+    inference over PS tables is just eval-mode forward with PSEmbedding
+    pulls, so init is bookkeeping only."""
+
+    def __init__(self, main_program=None, startup_program=None):
+        self._main = main_program
+
+    def init_distributed_infer_env(self, exe, loss, role_maker=None,
+                                   dirname=None):
+        return None
+
+    def get_dist_infer_program(self):
+        return self._main

@@ -1,0 +1,25 @@
+"""paddle.distributed.io (ref:python/paddle/distributed/io.py):
+persistable save/load helpers for distributed training."""
+from __future__ import annotations
+
+import os
+
+
+def save_persistables(executor=None, dirname="", main_program=None,
+                      filename=None):
+    """Static-graph parity shim: persistable state saving is the dynamic
+    checkpoint path here (distributed.checkpoint / fleet.save)."""
+    raise NotImplementedError(
+        "static-graph save_persistables: use paddle.save(state_dict) or "
+        "paddle_tpu.distributed.checkpoint.save_state_dict")
+
+
+def load_persistables(executor=None, dirname="", main_program=None,
+                      filename=None):
+    raise NotImplementedError(
+        "static-graph load_persistables: use paddle.load / "
+        "paddle_tpu.distributed.checkpoint.load_state_dict")
+
+
+def is_persistable(var) -> bool:
+    return bool(getattr(var, "persistable", False))

@@ -1,0 +1,172 @@
+"""paddle.distributed.rpc: user-level RPC between workers
+(ref:python/paddle/distributed/rpc/rpc.py over brpc,
+ref:paddle/fluid/distributed/rpc/).
+
+TPU-native redesign: no brpc — each worker runs a small pickle-over-TCP
+request server (one thread per connection, like the kvstore's C++ server);
+the rank-0 TCPStore is the rendezvous that maps worker names to endpoints.
+``rpc_sync``/``rpc_async`` pickle (fn, args, kwargs), execute them in the
+remote worker's process, and return the pickled result.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+from concurrent.futures import Future, ThreadPoolExecutor
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+_state = None
+
+
+@dataclass
+class WorkerInfo:
+    name: str
+    rank: int
+    ip: str
+    port: int
+
+
+def _recv_exact(sock, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("rpc peer closed the connection")
+        buf += chunk
+    return buf
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):
+        try:
+            (n,) = struct.unpack("<q", _recv_exact(self.request, 8))
+            fn, args, kwargs = pickle.loads(_recv_exact(self.request, n))
+            try:
+                result = (True, fn(*args, **kwargs))
+            except Exception as e:  # ship the exception back
+                result = (False, e)
+            try:
+                payload = pickle.dumps(result)
+            except Exception as e:  # unpicklable result/exception
+                payload = pickle.dumps(
+                    (False, RuntimeError(f"rpc result not picklable: {e}")))
+            self.request.sendall(struct.pack("<q", len(payload)) + payload)
+        except (ConnectionError, OSError):
+            pass  # peer went away; nothing to reply to
+
+
+class _Server(socketserver.ThreadingTCPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+
+class _RpcState:
+    def __init__(self, name, rank, world_size, store):
+        self.name = name
+        self.rank = rank
+        self.world_size = world_size
+        self.store = store
+        # bind the advertised interface only (default loopback): the handler
+        # executes pickled callables, so listening wider than the rendezvous
+        # contract would hand code execution to anything that can reach the
+        # ephemeral port
+        ip = os.environ.get("PADDLE_RPC_IP", "127.0.0.1")
+        self.server = _Server((ip, 0), _Handler)
+        self.port = self.server.server_address[1]
+        self.thread = threading.Thread(target=self.server.serve_forever,
+                                       daemon=True)
+        self.thread.start()
+        self.pool = ThreadPoolExecutor(max_workers=8)
+        store.set(f"rpc/{name}", f"{rank}|{ip}|{self.port}")
+        store.set(f"rpc/byrank/{rank}", name)
+        self.workers: Dict[str, WorkerInfo] = {}
+
+    def lookup(self, name) -> WorkerInfo:
+        if name not in self.workers:
+            v = self.store.wait(f"rpc/{name}").decode()
+            rank, ip, port = v.split("|")
+            self.workers[name] = WorkerInfo(name, int(rank), ip, int(port))
+        return self.workers[name]
+
+    def shutdown(self):
+        self.server.shutdown()
+        self.server.server_close()
+        self.pool.shutdown(wait=False)
+
+
+def init_rpc(name: str, rank: Optional[int] = None,
+             world_size: Optional[int] = None,
+             master_endpoint: Optional[str] = None):
+    """Start this worker's RPC server and register with the rendezvous store
+    (ref rpc.init_rpc)."""
+    global _state
+    from ..store import TCPStore
+
+    rank = rank if rank is not None else int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    world_size = world_size if world_size is not None else int(
+        os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    ep = master_endpoint or os.environ.get("PADDLE_MASTER", "127.0.0.1:0")
+    host, port = ep.rsplit(":", 1)
+    store = TCPStore(host, int(port), is_master=(rank == 0),
+                     world_size=world_size)
+    _state = _RpcState(name, rank, world_size, store)
+    # barrier: everyone registered before user code issues calls
+    for r in range(world_size):
+        store.wait(f"rpc/byrank/{r}")
+    return _state.port
+
+
+def _call(to: str, fn, args, kwargs, timeout):
+    info = _state.lookup(to)
+    payload = pickle.dumps((fn, args or (), kwargs or {}))
+    with socket.create_connection((info.ip, info.port), timeout=timeout or None) as s:
+        s.sendall(struct.pack("<q", len(payload)) + payload)
+        (n,) = struct.unpack("<q", _recv_exact(s, 8))
+        buf = _recv_exact(s, n)
+    ok, result = pickle.loads(buf)
+    if not ok:
+        raise result
+    return result
+
+
+def rpc_sync(to: str, fn, args=None, kwargs=None, timeout=None):
+    """Execute fn on worker ``to``; block for the result (ref rpc_sync)."""
+    if _state is None:
+        raise RuntimeError("call init_rpc first")
+    return _call(to, fn, args, kwargs, timeout)
+
+
+def rpc_async(to: str, fn, args=None, kwargs=None, timeout=None) -> Future:
+    """Execute fn on worker ``to``; returns a Future (ref rpc_async)."""
+    if _state is None:
+        raise RuntimeError("call init_rpc first")
+    return _state.pool.submit(_call, to, fn, args, kwargs, timeout)
+
+
+def get_worker_info(name: Optional[str] = None) -> WorkerInfo:
+    if _state is None:
+        raise RuntimeError("call init_rpc first")
+    if name is None:
+        return WorkerInfo(_state.name, _state.rank, "127.0.0.1", _state.port)
+    return _state.lookup(name)
+
+
+def get_all_worker_infos():
+    if _state is None:
+        raise RuntimeError("call init_rpc first")
+    names = [_state.store.wait(f"rpc/byrank/{r}").decode()
+             for r in range(_state.world_size)]
+    return [_state.lookup(n) for n in names]
+
+
+def shutdown():
+    """Tear down this worker's RPC server (ref rpc.shutdown)."""
+    global _state
+    if _state is not None:
+        _state.shutdown()
+        _state = None

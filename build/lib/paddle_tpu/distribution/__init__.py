@@ -424,3 +424,179 @@ def _kl_bernoulli(p, q):
 @register_kl(Uniform, Uniform)
 def _kl_uniform(p, q):
     return _t(jnp.log((q.high - q.low) / (p.high - p.low)))
+
+
+class Cauchy(Distribution):
+    """ref:python/paddle/distribution/cauchy.py."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        u = jax.random.uniform(rng.next_key(), shape, minval=1e-7,
+                               maxval=1.0 - 1e-7)
+        return _t(self.loc + self.scale * jnp.tan(math.pi * (u - 0.5)))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = _arr(value)
+        z = (v - self.loc) / self.scale
+        return _t(-jnp.log(math.pi * self.scale * (1 + z * z)))
+
+    def cdf(self, value):
+        z = (_arr(value) - self.loc) / self.scale
+        return _t(jnp.arctan(z) / math.pi + 0.5)
+
+    def entropy(self):
+        return _t(jnp.broadcast_to(jnp.log(4 * math.pi * self.scale),
+                                   self.batch_shape))
+
+
+class ExponentialFamily(Distribution):
+    """Base carrying the Bregman-divergence entropy identity
+    (ref:python/paddle/distribution/exponential_family.py). Subclasses
+    define natural parameters + log normalizer; entropy falls out by
+    differentiation."""
+
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        raise NotImplementedError
+
+    @property
+    def _mean_carrier_measure(self):
+        return 0.0
+
+    def entropy(self):
+        nat = [jnp.asarray(p) for p in self._natural_parameters]
+        lg, grads = jax.value_and_grad(
+            lambda *ps: jnp.sum(self._log_normalizer(*ps)),
+            argnums=tuple(range(len(nat))))(*nat)
+        ent = self._log_normalizer(*nat) - self._mean_carrier_measure
+        for p, g in zip(nat, grads):
+            ent = ent - p * g
+        return _t(ent)
+
+
+class Independent(Distribution):
+    """Reinterpret batch dims as event dims
+    (ref:python/paddle/distribution/independent.py)."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self.reinterpreted_batch_rank = int(reinterpreted_batch_rank)
+        bs = base.batch_shape
+        k = self.reinterpreted_batch_rank
+        super().__init__(bs[: len(bs) - k],
+                         bs[len(bs) - k:] + tuple(base.event_shape))
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def rsample(self, shape=()):
+        return self.base.rsample(shape)
+
+    def log_prob(self, value):
+        lp = _arr(self.base.log_prob(value))
+        axes = tuple(range(lp.ndim - self.reinterpreted_batch_rank, lp.ndim))
+        return _t(lp.sum(axis=axes))
+
+    def entropy(self):
+        e = _arr(self.base.entropy())
+        axes = tuple(range(e.ndim - self.reinterpreted_batch_rank, e.ndim))
+        return _t(e.sum(axis=axes))
+
+    @property
+    def mean(self):
+        return self.base.mean
+
+    @property
+    def variance(self):
+        return self.base.variance
+
+
+class TransformedDistribution(Distribution):
+    """Pushforward of a base distribution through invertible transforms
+    (ref:python/paddle/distribution/transformed_distribution.py). Transforms
+    need .forward(x), .inverse(y), .forward_log_det_jacobian(x)."""
+
+    def __init__(self, base, transforms):
+        self.base = base
+        self.transforms = list(transforms)
+        super().__init__(base.batch_shape, base.event_shape)
+
+    def sample(self, shape=()):
+        x = _arr(self.base.sample(shape))
+        for t in self.transforms:
+            x = _arr(t.forward(_t(x)))
+        return _t(x)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        y = _arr(value)
+        lp = jnp.zeros(())
+        for t in reversed(self.transforms):
+            x = _arr(t.inverse(_t(y)))
+            lp = lp - _arr(t.forward_log_det_jacobian(_t(x)))
+            y = x
+        return _t(lp + _arr(self.base.log_prob(_t(y))))
+
+
+class Transform:
+    """Minimal invertible-transform interface (ref:python/paddle/
+    distribution/transform.py)."""
+
+    def forward(self, x):
+        raise NotImplementedError
+
+    def inverse(self, y):
+        raise NotImplementedError
+
+    def forward_log_det_jacobian(self, x):
+        raise NotImplementedError
+
+
+class AffineTransform(Transform):
+    def __init__(self, loc, scale):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+
+    def forward(self, x):
+        return _t(self.loc + self.scale * _arr(x))
+
+    def inverse(self, y):
+        return _t((_arr(y) - self.loc) / self.scale)
+
+    def forward_log_det_jacobian(self, x):
+        return _t(jnp.broadcast_to(jnp.log(jnp.abs(self.scale)), _arr(x).shape))
+
+
+class ExpTransform(Transform):
+    def forward(self, x):
+        return _t(jnp.exp(_arr(x)))
+
+    def inverse(self, y):
+        return _t(jnp.log(_arr(y)))
+
+    def forward_log_det_jacobian(self, x):
+        return _t(_arr(x))
+
+
+class SigmoidTransform(Transform):
+    def forward(self, x):
+        return _t(jax.nn.sigmoid(_arr(x)))
+
+    def inverse(self, y):
+        ya = _arr(y)
+        return _t(jnp.log(ya) - jnp.log1p(-ya))
+
+    def forward_log_det_jacobian(self, x):
+        xa = _arr(x)
+        return _t(-jax.nn.softplus(-xa) - jax.nn.softplus(xa))

@@ -1,0 +1,180 @@
+"""paddle.geometric (ref:python/paddle/geometric/): graph-learning ops —
+message passing over (src, dst) edge indices, segment reductions, graph
+reindexing, and neighbor sampling. Message passing compiles to XLA
+gather + segment reduces (the TPU replacement for the reference's fused
+CUDA graph kernels, ref:paddle/phi/kernels/gpu/graph_send_recv_kernel.cu);
+sampling/reindex are host ops feeding the data pipeline, as in the
+reference's CPU kernels."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+from ..incubate import (  # noqa: F401  (shared implementations)
+    graph_reindex as _reindex_impl,
+    graph_sample_neighbors as _sample_impl,
+    segment_max, segment_mean, segment_min, segment_sum)
+
+__all__ = ["send_u_recv", "send_ue_recv", "send_uv", "segment_sum",
+           "segment_mean", "segment_min", "segment_max", "reindex_graph",
+           "reindex_heter_graph", "sample_neighbors",
+           "weighted_sample_neighbors"]
+
+_MSG_OPS = {
+    "add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
+    "div": jnp.divide,
+}
+
+
+def _reduce(msg, dst, reduce_op, nseg):
+    if reduce_op == "mean":
+        s = jax.ops.segment_sum(msg, dst, num_segments=nseg)
+        c = jax.ops.segment_sum(jnp.ones((msg.shape[0],), msg.dtype), dst,
+                                num_segments=nseg)
+        c = jnp.maximum(c, 1.0)
+        return s / c.reshape((-1,) + (1,) * (msg.ndim - 1))
+    fn = {"sum": jax.ops.segment_sum, "max": jax.ops.segment_max,
+          "min": jax.ops.segment_min}[reduce_op]
+    out = fn(msg, dst, num_segments=nseg)
+    if reduce_op in ("max", "min"):
+        # segments receiving no edges yield 0 (the reference contract) —
+        # detected by edge counts, so int identities (INT_MIN/MAX) are fixed
+        # too and legitimate +-inf reductions are left alone
+        counts = jax.ops.segment_sum(jnp.ones((msg.shape[0],), jnp.int32),
+                                     dst, num_segments=nseg)
+        empty = (counts == 0).reshape((-1,) + (1,) * (msg.ndim - 1))
+        out = jnp.where(empty, jnp.zeros_like(out), out)
+    return out
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
+                name=None):
+    """Gather x[src], reduce into dst slots: the u->recv message pass."""
+    if reduce_op not in ("sum", "mean", "max", "min"):
+        raise ValueError(f"unsupported reduce_op {reduce_op!r}")
+
+    def _fn(xa, src, dst, *, nseg):
+        return _reduce(xa[src], dst, reduce_op, nseg)
+
+    nseg = int(out_size) if out_size else int(x.shape[0])
+    return apply(_fn, (x, src_index, dst_index), {"nseg": nseg},
+                 name="send_u_recv")
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add",
+                 reduce_op="sum", out_size=None, name=None):
+    """Combine x[src] with the edge feature y, then reduce into dst."""
+    if message_op not in _MSG_OPS:
+        raise ValueError(f"unsupported message_op {message_op!r}")
+    if reduce_op not in ("sum", "mean", "max", "min"):
+        raise ValueError(f"unsupported reduce_op {reduce_op!r}")
+
+    def _fn(xa, ya, src, dst, *, nseg):
+        return _reduce(_MSG_OPS[message_op](xa[src], ya), dst, reduce_op,
+                       nseg)
+
+    nseg = int(out_size) if out_size else int(x.shape[0])
+    return apply(_fn, (x, y, src_index, dst_index), {"nseg": nseg},
+                 name="send_ue_recv")
+
+
+def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
+    """Per-edge message x[src] (op) y[dst] — no reduction."""
+    if message_op not in _MSG_OPS:
+        raise ValueError(f"unsupported message_op {message_op!r}")
+
+    def _fn(xa, ya, src, dst):
+        return _MSG_OPS[message_op](xa[src], ya[dst])
+
+    return apply(_fn, (x, y, src_index, dst_index), {}, name="send_uv")
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None):
+    """Compress the global node ids of a sampled subgraph into a local
+    contiguous space: returns (reindex_src, reindex_dst, out_nodes)."""
+    return _reindex_impl(x, neighbors, count, value_buffer, index_buffer)
+
+
+def reindex_heter_graph(x, neighbors, count, value_buffer=None,
+                        index_buffer=None):
+    """reindex_graph over per-edge-type neighbor/count lists sharing ONE
+    node-id space: the id remap is built once over x + every type's
+    neighbors, then applied per type."""
+    xs = np.asarray(x._data if isinstance(x, Tensor) else x)
+    neigh = [np.asarray(n._data if isinstance(n, Tensor) else n)
+             for n in neighbors]
+    cnts = [np.asarray(c._data if isinstance(c, Tensor) else c)
+            for c in count]
+    all_ids = xs.tolist()
+    for n in neigh:
+        all_ids.extend(n.tolist())
+    out_nodes = list(dict.fromkeys(all_ids))
+    remap = {v: i for i, v in enumerate(out_nodes)}
+    x_local = np.asarray([remap[v] for v in xs], np.int64)
+    srcs, dsts = [], []
+    for n, c in zip(neigh, cnts):
+        srcs.append(Tensor(jnp.asarray(
+            np.asarray([remap[v] for v in n], np.int64))))
+        dsts.append(Tensor(jnp.asarray(np.repeat(x_local, c))))
+    nodes = Tensor(jnp.asarray(np.asarray(out_nodes, xs.dtype)))
+    return srcs, dsts, nodes
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1, eids=None,
+                     return_eids=False, perm_buffer=None, name=None):
+    """Uniform neighbor sampling on a CSC graph."""
+    if return_eids:
+        return _sample_with_eids(row, colptr, input_nodes, sample_size, eids,
+                                 weights=None)
+    return _sample_impl(row, colptr, input_nodes, sample_size, eids,
+                        return_eids, perm_buffer)
+
+
+def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
+                              sample_size=-1, return_eids=False, name=None):
+    """Neighbor sampling where selection probability follows edge_weight."""
+    return _sample_with_eids(row, colptr, input_nodes, sample_size, None,
+                             weights=edge_weight, return_eids=return_eids)
+
+
+def _sample_with_eids(row, colptr, input_nodes, sample_size, eids, weights,
+                      return_eids=True):
+    rown = np.asarray(row._data if isinstance(row, Tensor) else row)
+    cp = np.asarray(colptr._data if isinstance(colptr, Tensor) else colptr)
+    nodes = np.asarray(input_nodes._data if isinstance(input_nodes, Tensor)
+                       else input_nodes)
+    w = (np.asarray(weights._data if isinstance(weights, Tensor) else weights)
+         if weights is not None else None)
+    ids = (np.asarray(eids._data if isinstance(eids, Tensor) else eids)
+           if eids is not None else np.arange(rown.size))
+    out_n, out_count, out_e = [], [], []
+    rng = np.random.default_rng()
+    for v in nodes.ravel():
+        lo, hi = int(cp[v]), int(cp[v + 1])
+        idx = np.arange(lo, hi)
+        if 0 <= sample_size < idx.size:
+            if w is not None:
+                p = w[idx].astype(np.float64)
+                if p.sum() > 0:
+                    p = p / p.sum()
+                    # without replacement we can pick at most the number of
+                    # positive-weight neighbors
+                    k = min(sample_size, int(np.count_nonzero(p)))
+                    idx = rng.choice(idx, k, replace=False, p=p)
+                else:
+                    idx = rng.choice(idx, sample_size, replace=False)
+            else:
+                idx = rng.choice(idx, sample_size, replace=False)
+        out_n.append(rown[idx])
+        out_e.append(ids[idx])
+        out_count.append(idx.size)
+    neigh = np.concatenate(out_n) if out_n else np.empty(0, rown.dtype)
+    eout = np.concatenate(out_e) if out_e else np.empty(0, np.int64)
+    res = [Tensor(jnp.asarray(neigh)),
+           Tensor(jnp.asarray(np.asarray(out_count, np.int32)))]
+    if return_eids:
+        res.append(Tensor(jnp.asarray(eout)))
+    return tuple(res)

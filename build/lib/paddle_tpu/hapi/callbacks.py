@@ -161,3 +161,137 @@ class EarlyStopping(Callback):
                 self.stopped = True
                 if self.model is not None:
                     self.model.stop_training = True
+
+
+class ReduceLROnPlateau(Callback):
+    """Scale the LR by ``factor`` after ``patience`` evals without metric
+    improvement (ref callbacks.py ReduceLROnPlateau)."""
+
+    def __init__(self, monitor="loss", factor=0.1, patience=10, verbose=1,
+                 mode="auto", min_delta=1e-4, cooldown=0, min_lr=0):
+        super().__init__()
+        self.monitor = monitor
+        self.factor = factor
+        self.patience = patience
+        self.verbose = verbose
+        if mode == "auto":  # accuracy-like monitors maximize (ref contract)
+            mode = "max" if "acc" in monitor else "min"
+        self.mode = mode
+        self.min_delta = abs(min_delta)
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        self.wait = 0
+        self.cooldown_counter = 0
+        self.best = None
+
+    def _better(self, cur):
+        if self.best is None:
+            return True
+        if self.mode == "min":
+            return cur < self.best - self.min_delta
+        return cur > self.best + self.min_delta
+
+    def on_eval_end(self, logs=None):
+        cur = (logs or {}).get(self.monitor)
+        if cur is None:
+            return
+        if isinstance(cur, (list, tuple)):
+            cur = cur[0]
+        cur = float(cur)
+        if self.cooldown_counter > 0:
+            # inside the cooldown window: track best but don't accumulate
+            # non-improvement (no further reductions until it expires)
+            self.cooldown_counter -= 1
+            self.wait = 0
+            if self._better(cur):
+                self.best = cur
+            return
+        if self._better(cur):
+            self.best = cur
+            self.wait = 0
+            return
+        self.wait += 1
+        if self.wait >= self.patience:
+            opt = getattr(self.model, "_optimizer", None)
+            if opt is None:
+                return
+            old = opt.get_lr()
+            new = max(old * self.factor, self.min_lr)
+            if old - new > 1e-12:
+                opt.set_lr(new)
+                if self.verbose:
+                    print(f"ReduceLROnPlateau: lr {old:.3g} -> {new:.3g}")
+            self.cooldown_counter = self.cooldown
+            self.wait = 0
+
+
+class VisualDL(Callback):
+    """Training-curve logger (ref callbacks.py VisualDL). The visualdl
+    package isn't part of this stack; scalars stream to
+    ``<log_dir>/scalars.jsonl`` (one {tag, step, value} record per line) —
+    the same data the reference sends to the visualdl writer."""
+
+    def __init__(self, log_dir="./log"):
+        super().__init__()
+        self.log_dir = log_dir
+        self._fh = None
+        self._step = 0
+        self._eval_step = 0
+
+    def _write(self, tag, value, step):
+        import json
+        import os
+
+        if self._fh is None:
+            os.makedirs(self.log_dir, exist_ok=True)
+            self._fh = open(f"{self.log_dir}/scalars.jsonl", "a")
+        try:
+            v = float(value[0] if isinstance(value, (list, tuple)) else value)
+        except (TypeError, ValueError):
+            return
+        self._fh.write(json.dumps({"tag": tag, "step": step, "value": v})
+                       + "\n")
+        self._fh.flush()
+
+    def on_train_batch_end(self, step, logs=None):
+        self._step += 1
+        for k, v in (logs or {}).items():
+            if k not in ("batch_size", "steps"):
+                self._write(f"train/{k}", v, self._step)
+
+    def on_eval_end(self, logs=None):
+        # monotone, distinct x per eval — tracks the train step during
+        # training and keeps advancing for standalone/repeated evals
+        self._eval_step += 1
+        for k, v in (logs or {}).items():
+            if k not in ("batch_size", "steps"):
+                self._write(f"eval/{k}", v, self._step + self._eval_step)
+
+    def on_train_end(self, logs=None):
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+
+class WandbCallback(Callback):
+    """Weights & Biases logger (ref callbacks.py WandbCallback); requires
+    the wandb package at construction time."""
+
+    def __init__(self, project=None, run_name=None, **kwargs):
+        super().__init__()
+        try:
+            import wandb
+        except ImportError as e:
+            raise ImportError(
+                "WandbCallback requires the wandb package") from e
+        self._wandb = wandb
+        self._run = wandb.init(project=project, name=run_name, **kwargs)
+
+    def on_train_batch_end(self, step, logs=None):
+        self._run.log({f"train/{k}": v for k, v in (logs or {}).items()})
+
+    def on_eval_end(self, logs=None):
+        self._run.log({f"eval/{k}": v for k, v in (logs or {}).items()})
+
+    def on_train_end(self, logs=None):
+        self._run.finish()

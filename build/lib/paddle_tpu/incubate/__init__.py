@@ -1,1 +1,266 @@
+"""paddle.incubate surface (ref:python/paddle/incubate/__init__.py).
 
+Segment reductions map to jax.ops.segment_*; graph message-passing and
+sampling ops are re-designed over segment ops + host-side neighbor sampling
+(ref:python/paddle/geometric/ and incubate/operators/); LookAhead and
+ModelAverage are wrapper optimizers.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+from . import distributed  # noqa: F401
+from . import asp  # noqa: F401
+from . import autograd  # noqa: F401
+from . import nn  # noqa: F401
+
+__all__ = [
+    "segment_sum", "segment_mean", "segment_max", "segment_min",
+    "graph_send_recv", "graph_khop_sampler", "graph_reindex",
+    "graph_sample_neighbors", "softmax_mask_fuse",
+    "softmax_mask_fuse_upper_triangle", "identity_loss",
+    "LookAhead", "ModelAverage",
+]
+
+
+# ------------------------------------------------------------ segment ops
+
+
+def _segment(fn_name, data, segment_ids):
+    def _seg(d, ids, *, fn_name):
+        n = d.shape[0]  # static bound: num_segments <= n rows
+        fn = {
+            "sum": jax.ops.segment_sum,
+            "max": jax.ops.segment_max,
+            "min": jax.ops.segment_min,
+        }[fn_name]
+        return fn(d, ids, num_segments=n)
+
+    out = apply(_seg, (data, segment_ids), {"fn_name": fn_name},
+                name=f"segment_{fn_name}")
+    # trim to the actual number of segments (host-side, like the reference's
+    # dynamic out dim)
+    nseg = int(np.asarray((segment_ids._data if isinstance(segment_ids, Tensor)
+                           else segment_ids)).max()) + 1
+    return out[:nseg]
+
+
+def segment_sum(data, segment_ids, name=None):
+    return _segment("sum", data, segment_ids)
+
+
+def segment_max(data, segment_ids, name=None):
+    return _segment("max", data, segment_ids)
+
+
+def segment_min(data, segment_ids, name=None):
+    return _segment("min", data, segment_ids)
+
+
+def segment_mean(data, segment_ids, name=None):
+    def _segm(d, ids):
+        n = d.shape[0]
+        s = jax.ops.segment_sum(d, ids, num_segments=n)
+        c = jax.ops.segment_sum(jnp.ones((d.shape[0],), d.dtype), ids,
+                                num_segments=n)
+        return s / jnp.maximum(c, 1.0)[:, None] if d.ndim > 1 else s / jnp.maximum(c, 1.0)
+
+    out = apply(_segm, (data, segment_ids), {}, name="segment_mean")
+    nseg = int(np.asarray((segment_ids._data if isinstance(segment_ids, Tensor)
+                           else segment_ids)).max()) + 1
+    return out[:nseg]
+
+
+# ---------------------------------------------------------------- graph
+
+
+def graph_send_recv(x, src_index, dst_index, pool_type="sum", out_size=None,
+                    name=None):
+    """Gather x rows at src, scatter-reduce into dst
+    (ref:python/paddle/geometric/message_passing/send_recv.py)."""
+
+    def _gsr(x, src, dst, *, pool, nseg):
+        msg = x[src]
+        fn = {"sum": jax.ops.segment_sum, "mean": None,
+              "max": jax.ops.segment_max, "min": jax.ops.segment_min}[pool]
+        if pool == "mean":
+            s = jax.ops.segment_sum(msg, dst, num_segments=nseg)
+            c = jax.ops.segment_sum(jnp.ones((msg.shape[0],), x.dtype), dst,
+                                    num_segments=nseg)
+            c = jnp.maximum(c, 1.0)
+            return s / (c[:, None] if msg.ndim > 1 else c)
+        return fn(msg, dst, num_segments=nseg)
+
+    nseg = int(out_size) if out_size else x.shape[0]
+    return apply(_gsr, (x, src_index, dst_index),
+                 {"pool": pool_type, "nseg": nseg}, name="graph_send_recv")
+
+
+def graph_sample_neighbors(row, colptr, input_nodes, sample_size=-1,
+                           eids=None, return_eids=False, perm_buffer=None,
+                           name=None):
+    """Uniform neighbor sampling on a CSC graph (host-side, like the
+    reference's CPU sampling kernels feeding the dataloader)."""
+    rown = np.asarray(row._data if isinstance(row, Tensor) else row)
+    cp = np.asarray(colptr._data if isinstance(colptr, Tensor) else colptr)
+    nodes = np.asarray(input_nodes._data if isinstance(input_nodes, Tensor)
+                       else input_nodes)
+    out_n, out_count = [], []
+    rng = np.random.default_rng()
+    for v in nodes.ravel():
+        lo, hi = int(cp[v]), int(cp[v + 1])
+        nbrs = rown[lo:hi]
+        if sample_size >= 0 and len(nbrs) > sample_size:
+            nbrs = rng.choice(nbrs, sample_size, replace=False)
+        out_n.append(nbrs)
+        out_count.append(len(nbrs))
+    neigh = np.concatenate(out_n) if out_n else np.empty(0, rown.dtype)
+    return (Tensor(jnp.asarray(neigh)),
+            Tensor(jnp.asarray(np.asarray(out_count, np.int32))))
+
+
+def graph_reindex(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  flag_buffer=None, name=None):
+    """Compact the ids of a sampled subgraph (ref graph_reindex): returns
+    (reindexed_src, reindexed_dst, out_nodes)."""
+    xs = np.asarray(x._data if isinstance(x, Tensor) else x)
+    nb = np.asarray(neighbors._data if isinstance(neighbors, Tensor) else neighbors)
+    ct = np.asarray(count._data if isinstance(count, Tensor) else count)
+    out_nodes = list(dict.fromkeys(xs.tolist() + nb.tolist()))
+    remap = {v: i for i, v in enumerate(out_nodes)}
+    src = np.asarray([remap[v] for v in nb], np.int64)
+    dst = np.repeat(np.asarray([remap[v] for v in xs], np.int64), ct)
+    return (Tensor(jnp.asarray(src)), Tensor(jnp.asarray(dst)),
+            Tensor(jnp.asarray(np.asarray(out_nodes, xs.dtype))))
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       sorted_eids=None, return_eids=False, name=None):
+    """K-hop neighborhood sampling: repeated graph_sample_neighbors +
+    reindex (ref graph_khop_sampler)."""
+    cur = input_nodes
+    all_neigh, all_count = [], []
+    for k in sample_sizes:
+        neigh, count = graph_sample_neighbors(row, colptr, cur, sample_size=k)
+        all_neigh.append(neigh)
+        all_count.append(count)
+        cur = neigh
+    import numpy as _np
+
+    nb = _np.concatenate([_np.asarray(n._data) for n in all_neigh])
+    ct = _np.concatenate([_np.asarray(c._data) for c in all_count])
+    seeds_plus = _np.concatenate(
+        [_np.asarray(input_nodes._data if isinstance(input_nodes, Tensor)
+                     else input_nodes).ravel()]
+        + [_np.asarray(n._data) for n in all_neigh[:-1]])
+    return graph_reindex(Tensor(jnp.asarray(seeds_plus)),
+                         Tensor(jnp.asarray(nb)), Tensor(jnp.asarray(ct)))
+
+
+# ------------------------------------------------------------- fused misc
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """softmax(x + mask) fused by XLA (ref fused softmax_mask kernels)."""
+
+    def _smf(x, m):
+        return jax.nn.softmax(x + m, axis=-1)
+
+    return apply(_smf, (x, mask), {}, name="softmax_mask_fuse")
+
+
+def softmax_mask_fuse_upper_triangle(x, name=None):
+    """Causal-masked softmax (ref softmax_mask_fuse_upper_triangle)."""
+
+    def _smf(x):
+        s = x.shape[-1]
+        mask = jnp.tril(jnp.ones((s, s), bool))
+        z = jnp.where(mask, x, jnp.finfo(x.dtype).min)
+        return jax.nn.softmax(z, axis=-1)
+
+    return apply(_smf, (x,), {}, name="softmax_mask_fuse_ut")
+
+
+def identity_loss(x, reduction="none"):
+    if reduction in (0, "sum"):
+        return x.sum()
+    if reduction in (1, "mean"):
+        return x.mean()
+    return x
+
+
+# ------------------------------------------------------ wrapper optimizers
+
+
+class LookAhead:
+    """k-step lookahead wrapper (ref incubate LookAhead): every k inner
+    steps, slow weights move alpha toward the fast weights."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        self.inner = inner_optimizer
+        self.alpha = alpha
+        self.k = k
+        self._slow = None
+        self._count = 0
+
+    @property
+    def _parameter_list(self):
+        return self.inner._parameter_list
+
+    def step(self):
+        params = self.inner._parameter_list
+        if self._slow is None:
+            self._slow = [p._data for p in params]
+        self.inner.step()
+        self._count += 1
+        if self._count % self.k == 0:
+            for p, slow in zip(params, self._slow):
+                new_slow = slow + self.alpha * (p._data - slow)
+                p._data = new_slow
+            self._slow = [p._data for p in params]
+
+    def clear_grad(self):
+        self.inner.clear_grad()
+
+    def state_dict(self):
+        return self.inner.state_dict()
+
+    def set_state_dict(self, sd):
+        self.inner.set_state_dict(sd)
+
+    def minimize(self, loss):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+
+class ModelAverage:
+    """Running average of parameters for eval (ref incubate ModelAverage):
+    apply()/restore() swap the averaged weights in and out."""
+
+    def __init__(self, average_window_rate=0.15, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        self._params = list(parameters or [])
+        self._sum = [jnp.zeros_like(p._data) for p in self._params]
+        self._n = 0
+        self._backup = None
+
+    def step(self):
+        self._n += 1
+        self._sum = [s + p._data for s, p in zip(self._sum, self._params)]
+
+    def apply(self, executor=None, need_restore=True):
+        self._backup = [p._data for p in self._params]
+        for p, s in zip(self._params, self._sum):
+            p._data = (s / max(self._n, 1)).astype(p._data.dtype)
+
+    def restore(self, executor=None):
+        if self._backup is not None:
+            for p, b in zip(self._params, self._backup):
+                p._data = b
+            self._backup = None

@@ -1,0 +1,136 @@
+"""Automatic SParsity (ref:python/paddle/incubate/asp/__init__.py): n:m
+structured weight pruning with a sparsity-preserving optimizer wrapper.
+
+The reference targets Ampere sparse tensor cores; on TPU the value is the
+model-compression workflow itself: ``prune_model`` computes n:m magnitude
+masks (default 2:4 along the input dim), ``decorate`` wraps an optimizer so
+every ``step()`` re-applies the masks (the reference's
+OptimizerWithSparsityGuarantee), and ``calculate_density`` reports nnz
+ratio. Masks multiply into the weights — XLA folds them into the matmuls.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.tensor import Tensor
+
+__all__ = ["calculate_density", "decorate", "prune_model",
+           "set_excluded_layers", "reset_excluded_layers",
+           "add_supported_layer"]
+
+_excluded_layers: List[str] = []
+_supported_layer_types = {"Linear", "Conv2D"}
+
+
+def set_excluded_layers(param_names, main_program=None):
+    """Skip these parameter names during pruning."""
+    _excluded_layers.extend(list(param_names))
+
+
+def reset_excluded_layers(main_program=None):
+    _excluded_layers.clear()
+
+
+def add_supported_layer(layer, pruning_func=None):
+    """Register another layer type whose weights prune_model should mask."""
+    name = layer if isinstance(layer, str) else type(layer).__name__
+    _supported_layer_types.add(name)
+
+
+def calculate_density(x) -> float:
+    """Fraction of nonzero entries."""
+    arr = np.asarray(x._data if isinstance(x, Tensor) else x)
+    return float(np.count_nonzero(arr)) / max(arr.size, 1)
+
+
+def _nm_mask(w: np.ndarray, n: int, m: int) -> np.ndarray:
+    """Keep the n largest-|.| entries of every m-group along the last dim."""
+    orig_shape = w.shape
+    flat = w.reshape(-1, orig_shape[-1])
+    cols = flat.shape[1]
+    pad = (-cols) % m
+    if pad:
+        flat = np.pad(flat, ((0, 0), (0, pad)))
+    g = np.abs(flat).reshape(flat.shape[0], -1, m)
+    # indices of the (m-n) smallest per group -> zeroed
+    order = np.argsort(g, axis=-1)
+    mask = np.ones_like(g, dtype=bool)
+    np.put_along_axis(mask, order[..., :m - n], False, axis=-1)
+    mask = mask.reshape(flat.shape[0], -1)[:, :cols]
+    return mask.reshape(orig_shape)
+
+
+def _prunable_params(layer):
+    """(label, weight) pairs for supported sublayers; the label is the
+    parameter name or, when unnamed, the sublayer path + '.weight' — both
+    match against set_excluded_layers entries."""
+    from ... import nn
+
+    params = []
+    for name, sub in ([("", layer)] + list(layer.named_sublayers())
+                      if isinstance(layer, nn.Layer) else []):
+        if type(sub).__name__ not in _supported_layer_types:
+            continue
+        w = getattr(sub, "weight", None)
+        if w is None:
+            continue
+        label = w.name or (f"{name}.weight" if name else "weight")
+        if label in _excluded_layers:
+            continue
+        if len(w.shape) >= 2 and w.shape[-1] >= 4:
+            params.append((label, w))
+    return params
+
+
+def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
+    """Compute and apply n:m masks to every supported layer's weight;
+    returns {param name/index: density} for inspection."""
+    densities = {}
+    for label, w in _prunable_params(model):
+        arr = np.asarray(w._data)
+        mask = _nm_mask(arr, n, m)
+        w._data = jnp.asarray(arr * mask)
+        if with_mask:
+            # stored ON the tensor: lives and dies with the parameter, no
+            # global registry to leak or collide on recycled ids
+            w._asp_mask = jnp.asarray(mask, arr.dtype)
+        densities[label] = calculate_density(w)
+    return densities
+
+
+class OptimizerWithSparsityGuarantee:
+    """Re-applies the pruning masks after every optimizer step so updates
+    cannot resurrect pruned weights."""
+
+    def __init__(self, optimizer):
+        self._optimizer = optimizer
+
+    def __getattr__(self, item):
+        return getattr(self._optimizer, item)
+
+    def step(self):
+        self._optimizer.step()
+        for p in (self._optimizer._parameter_list or []):
+            mask = getattr(p, "_asp_mask", None)
+            if mask is not None:
+                p._data = p._data * mask
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        out = self._optimizer.minimize(loss)
+        self.step_mask_only()
+        return out
+
+    def step_mask_only(self):
+        for p in (self._optimizer._parameter_list or []):
+            mask = getattr(p, "_asp_mask", None)
+            if mask is not None:
+                p._data = p._data * mask
+
+
+def decorate(optimizer):
+    """Wrap an optimizer with the sparsity guarantee."""
+    return OptimizerWithSparsityGuarantee(optimizer)

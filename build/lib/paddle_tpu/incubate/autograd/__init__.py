@@ -1,0 +1,153 @@
+"""paddle.incubate.autograd (ref:python/paddle/incubate/autograd/):
+functional differentiation primitives. The reference lowers these through
+its prim-op system; here they ARE jax's native transforms — vjp/jvp map
+directly, forward_grad is forward-mode, and Jacobian/Hessian reuse the
+stable autograd implementations."""
+from __future__ import annotations
+
+from typing import Callable, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+from ...autograd import hessian as _hessian_fn, jacobian as _jacobian_fn
+from ...core.tensor import Tensor
+
+__all__ = ["vjp", "jvp", "Jacobian", "Hessian", "enable_prim",
+           "disable_prim", "forward_grad", "grad"]
+
+_prim_enabled = False
+
+
+def enable_prim():
+    """The reference toggles prim-op lowering; jax always lowers through
+    primitives, so this is a recorded no-op for API parity."""
+    global _prim_enabled
+    _prim_enabled = True
+
+
+def disable_prim():
+    global _prim_enabled
+    _prim_enabled = False
+
+
+def prim_enabled() -> bool:
+    return _prim_enabled
+
+
+def _unwrap(xs):
+    if isinstance(xs, (list, tuple)):
+        return [x._data if isinstance(x, Tensor) else jnp.asarray(x)
+                for x in xs]
+    return xs._data if isinstance(xs, Tensor) else jnp.asarray(xs)
+
+
+def _wrap(out):
+    if isinstance(out, (list, tuple)):
+        return type(out)(Tensor(o) for o in out)
+    return Tensor(out)
+
+
+def _fn_on_arrays(func):
+    def f(*arrays):
+        out = func(*[Tensor(a) for a in arrays])
+        if isinstance(out, (list, tuple)):
+            return [o._data if isinstance(o, Tensor) else o for o in out]
+        return out._data if isinstance(out, Tensor) else out
+
+    return f
+
+
+def vjp(func: Callable, xs, v=None):
+    """Reverse-mode: returns (func(xs), vjp_result) for cotangent v
+    (defaults to ones like the output)."""
+    single = not isinstance(xs, (list, tuple))
+    arrs = _unwrap(xs)
+    if single:
+        arrs = [arrs]
+    out, pullback = jax.vjp(_fn_on_arrays(func), *arrs)
+    if v is None:
+        cot = jax.tree_util.tree_map(jnp.ones_like, out)
+    else:
+        cot = _unwrap(v)
+    grads = pullback(cot)
+    grads = grads[0] if single else list(grads)
+    return _wrap(out), _wrap(grads)
+
+
+def jvp(func: Callable, xs, v=None):
+    """Forward-mode: returns (func(xs), jvp_result) for tangent v (defaults
+    to ones like the inputs)."""
+    single = not isinstance(xs, (list, tuple))
+    arrs = _unwrap(xs)
+    if single:
+        arrs = [arrs]
+    if v is None:
+        tangents = [jnp.ones_like(a) for a in arrs]
+    else:
+        tangents = _unwrap(v)
+        if single:
+            tangents = [tangents]
+    out, tangent_out = jax.jvp(_fn_on_arrays(func), tuple(arrs),
+                               tuple(tangents))
+    return _wrap(out), _wrap(tangent_out)
+
+
+def forward_grad(outputs, inputs, grad_inputs=None):
+    """Forward-mode gradient of traced outputs w.r.t. inputs — expressed
+    functionally: pass a callable as ``outputs`` (the eager tape has no
+    forward-mode pass; the reference requires prim mode for this too)."""
+    if not callable(outputs):
+        raise ValueError(
+            "forward_grad takes a callable on this stack (the eager tape "
+            "records reverse-mode only); use forward_grad(fn, xs, v)")
+    return jvp(outputs, inputs, grad_inputs)[1]
+
+
+def grad(outputs, inputs, grad_outputs=None):
+    """Reverse-mode gradient, callable or tape form: with a callable this is
+    vjp; with Tensors it defers to paddle.grad."""
+    if callable(outputs):
+        return vjp(outputs, inputs, grad_outputs)[1]
+    from ...core.autograd import grad as tape_grad
+
+    return tape_grad(outputs, inputs, grad_outputs)
+
+
+class Jacobian:
+    """Lazy Jacobian matrix of func at xs (ref autograd/functional.py
+    Jacobian): index/slice to materialize."""
+
+    def __init__(self, func: Callable, xs, is_batched: bool = False):
+        ys = func(*xs) if isinstance(xs, (list, tuple)) else func(xs)
+        self._jac = _jacobian_fn(ys, xs,
+                                 batch_axis=0 if is_batched else None)
+
+    def __getitem__(self, idx):
+        return self._jac[idx]
+
+    @property
+    def shape(self):
+        return self._jac.shape
+
+    def numpy(self):
+        return self._jac.numpy()
+
+
+class Hessian:
+    """Lazy Hessian of a scalar func at xs (ref autograd/functional.py)."""
+
+    def __init__(self, func: Callable, xs, is_batched: bool = False):
+        self._hess = _hessian_fn(
+            func(*xs) if isinstance(xs, (list, tuple)) else func(xs), xs,
+            batch_axis=0 if is_batched else None)
+
+    def __getitem__(self, idx):
+        return self._hess[idx]
+
+    @property
+    def shape(self):
+        return self._hess.shape
+
+    def numpy(self):
+        return self._hess.numpy()

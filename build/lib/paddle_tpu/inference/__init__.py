@@ -101,3 +101,14 @@ class Predictor:
 
 def create_predictor(config: Config) -> Predictor:
     return Predictor(config)
+
+
+# Native (no-Python-at-serve-time) deploy path: jit.save's .pdnative artifact
+# run by the C++ PJRT runner in libpaddle_tpu_native.so. The import is lazy so
+# `paddle_tpu.inference` stays importable on hosts without a C++ toolchain.
+def __getattr__(name):
+    if name == "NativePredictor":
+        from ..native.pdnative import NativePredictor
+
+        return NativePredictor
+    raise AttributeError(f"module 'paddle_tpu.inference' has no attribute {name!r}")

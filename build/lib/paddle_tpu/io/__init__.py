@@ -321,6 +321,26 @@ class DataLoader:
         return len(self.batch_sampler)
 
 
+def _start_method() -> str:
+    """Worker start method: 'fork' (cheap, no pickling constraints — the
+    reference's Linux default) while the parent hasn't initialized a
+    non-CPU JAX backend; 'spawn' once an accelerator client exists, since
+    forking a live libtpu/PJRT client is not fork-safe. Overridable via
+    PADDLE_TPU_LOADER_START_METHOD."""
+    env = os.environ.get("PADDLE_TPU_LOADER_START_METHOD")
+    if env:
+        return env
+    try:
+        from jax._src import xla_bridge
+
+        backends = getattr(xla_bridge, "_backends", {})
+        if any(name != "cpu" for name in backends):
+            return "spawn"
+    except Exception:  # private API drift: fall through to fork
+        pass
+    return "fork"
+
+
 class _MultiProcessIter:
     """Parent side of the multiprocess loader: feeds batch-index tasks to
     worker processes and reassembles results in sampler order.
@@ -334,8 +354,7 @@ class _MultiProcessIter:
         import multiprocessing as mp
 
         self.loader = loader
-        method = os.environ.get("PADDLE_TPU_LOADER_START_METHOD", "fork")
-        ctx = mp.get_context(method)
+        ctx = mp.get_context(_start_method())
         self.nw = loader.num_workers
         self.iterable = loader._iterable_mode
         self.result_queue = ctx.Queue()
@@ -360,7 +379,7 @@ class _MultiProcessIter:
 
     def start_epoch(self):
         if self.iterable:
-            self._done_workers = 0
+            pass  # workers stream autonomously; _iterable_epoch tracks done
         else:
             # epoch generation tag: results from a previous, partially
             # consumed epoch (persistent workers + early break) are discarded
@@ -494,3 +513,27 @@ class _MultiProcessIter:
 def get_worker_info():
     """Worker-process info (id/num_workers/seed/dataset), None in the parent."""
     return worker_mod.get_worker_info()
+
+
+class ComposeDataset(Dataset):
+    """Zip datasets sample-wise; fields concatenate
+    (ref:python/paddle/fluid/dataloader/dataset.py ComposeDataset)."""
+
+    def __init__(self, datasets):
+        self.datasets = list(datasets)
+        if not self.datasets:
+            raise ValueError("ComposeDataset needs at least one dataset")
+        n = len(self.datasets[0])
+        for d in self.datasets[1:]:
+            if len(d) != n:
+                raise ValueError("ComposeDataset requires equal lengths")
+
+    def __len__(self):
+        return len(self.datasets[0])
+
+    def __getitem__(self, idx):
+        out = []
+        for d in self.datasets:
+            s = d[idx]
+            out.extend(s if isinstance(s, (tuple, list)) else [s])
+        return tuple(out)

@@ -60,7 +60,8 @@ def _unpack_leaf(p):
         return p[1]
     _, name, dtype, shape = p
     seg = shared_memory.SharedMemory(name=name)
-    _untrack(name)  # attach re-registered it; the unlink below is ours
+    # (attach does not register with resource_tracker on this Python; the
+    # creator already untracked, so unlink below is the only cleanup)
     try:
         arr = np.array(np.ndarray(shape, np.dtype(dtype), buffer=seg.buf))
     finally:
@@ -119,7 +120,6 @@ def discard(p):
     if kind == "leaf" and payload[0] == "shm":
         try:
             seg = shared_memory.SharedMemory(name=payload[1])
-            _untrack(payload[1])
             seg.close()
             seg.unlink()
         except FileNotFoundError:

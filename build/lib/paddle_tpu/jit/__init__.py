@@ -98,6 +98,8 @@ class StaticFunction:
         self._jit_fn = _compiled
 
     def __call__(self, *args, **kwargs):
+        if not _to_static_enabled:
+            return self._fn(*args, **kwargs)  # eager fallback (debugging)
         if self._jit_fn is None:
             self._build()
         param_arrays = tuple(p._data for p in self._param_objs)
@@ -301,33 +303,95 @@ def save(layer, path, input_spec=None, **configs):
 
         was_training = layer.training
         layer.eval()
-        params, buffers = layer.functional_state()
-        objs = list(params.values()) + list(buffers.values())
-        arrays = [p._data for p in objs]
+        try:
+            params, buffers = layer.functional_state()
+            objs = list(params.values()) + list(buffers.values())
+            arrays = [p._data for p in objs]
 
-        def fwd(param_arrays, *inputs):
-            with _swap_data(objs, list(param_arrays)):
-                with rng.key_guard(jax.random.key(0)):
-                    out = layer(*[Tensor(i) for i in inputs])
-            return out._data if isinstance(out, Tensor) else out
+            def fwd(param_arrays, *inputs):
+                with _swap_data(objs, list(param_arrays)):
+                    with rng.key_guard(jax.random.key(0)):
+                        out = layer(*[Tensor(i) for i in inputs])
+                return out._data if isinstance(out, Tensor) else out
 
-        # One shared scope; unnamed specs share per-axis symbols (d0, d1, ...)
-        # so the common "all inputs share the dynamic batch/seq size" case
-        # exports with the dims constrained equal. A spec with name= gets its
-        # own symbols (name_0, ...) for genuinely independent dynamic dims.
-        scope = jexport.SymbolicScope()
-        sds = [s.to_sds(scope=scope, prefix=(f"{s.name}_" if s.name else "d"))
-               if isinstance(s, InputSpec) else s
-               for s in input_spec]
-        param_sds = [jax.ShapeDtypeStruct(a.shape, a.dtype) for a in arrays]
-        exp = jexport.export(jax.jit(fwd))(param_sds, *sds)
-        with open(path + ".pdmodel", "wb") as f:
-            pickle.dump({
-                "stablehlo": exp.serialize(),
-                "param_keys": list(params.keys()) + list(buffers.keys()),
-            }, f, protocol=4)
-        if was_training:
-            layer.train()
+            # One shared scope; unnamed specs share per-axis symbols (d0, d1,
+            # ...) so the common "all inputs share the dynamic batch/seq size"
+            # case exports with the dims constrained equal. A spec with name=
+            # gets its own symbols (name_0, ...) for genuinely independent
+            # dynamic dims.
+            scope = jexport.SymbolicScope()
+            sds = [s.to_sds(scope=scope, prefix=(f"{s.name}_" if s.name else "d"))
+                   if isinstance(s, InputSpec) else s
+                   for s in input_spec]
+            param_sds = [jax.ShapeDtypeStruct(a.shape, a.dtype) for a in arrays]
+            exp = jexport.export(jax.jit(fwd))(param_sds, *sds)
+            with open(path + ".pdmodel", "wb") as f:
+                pickle.dump({
+                    "stablehlo": exp.serialize(),
+                    "param_keys": list(params.keys()) + list(buffers.keys()),
+                }, f, protocol=4)
+
+            # Native deploy artifact for the C++ PJRT runner (pjrt_runner.cc):
+            # only for fully-static specs (C/C++ serving is static-shape;
+            # dynamic batch stays on the python TranslatedLayer path). Lower
+            # for TPU when possible so device custom-calls are baked for the
+            # serving target.
+            static = all(
+                not isinstance(s, InputSpec)
+                or all(d is not None and d != -1 for d in s.shape)
+                for s in input_spec)
+            if not static and configs.get("native") is True:
+                raise ValueError(
+                    "native=True requires a fully-static input_spec: the C++ "
+                    "deploy artifact is static-shape (dynamic dims stay on "
+                    "the python TranslatedLayer path)")
+            if static and configs.get("native", True):
+                try:
+                    _write_pdnative(path, fwd, param_sds, sds, arrays,
+                                    list(params.keys()) + list(buffers.keys()),
+                                    exp)
+                except Exception:
+                    if configs.get("native") is True:  # explicit: surface
+                        raise
+        finally:
+            if was_training:
+                layer.train()
+
+
+def _write_pdnative(path, fwd, param_sds, sds, arrays, param_keys, exp_host):
+    """Emit ``path.pdnative`` — the self-contained C++ deploy artifact
+    (StableHLO bytecode + compile options + weights + I/O specs) consumed by
+    ``native/csrc/pjrt_runner.cc``. Prefers a TPU-platform lowering; falls
+    back to the host export when cross-lowering fails."""
+    import numpy as np
+    from jax import export as jexport
+
+    from paddle_tpu.native import pdnative
+
+    exp = exp_host
+    try:
+        exp = jexport.export(jax.jit(fwd), platforms=["tpu"])(param_sds, *sds)
+    except Exception:
+        pass
+
+    n_params = len(arrays)
+    args = []
+    for i in sorted(exp.module_kept_var_idx):
+        if i < n_params:
+            a = np.asarray(arrays[i])
+            args.append(pdnative.ArgSpec(param_keys[i], a.dtype, a.shape,
+                                         a.tobytes()))
+        else:
+            s = sds[i - n_params]
+            args.append(pdnative.ArgSpec(f"input_{i - n_params}",
+                                         np.dtype(s.dtype), s.shape))
+    outs = [pdnative.ArgSpec(f"output_{j}", np.dtype(o.dtype), o.shape)
+            for j, o in enumerate(exp.out_avals)]
+    pdnative.write(path + ".pdnative",
+                   platform=exp.platforms[0],
+                   compile_options=pdnative.default_compile_options(),
+                   stablehlo=exp.mlir_module_serialized,
+                   args=args, outputs=outs)
 
 
 class TranslatedLayer:
@@ -370,3 +434,49 @@ def load(path, **configs):
         return TranslatedLayer(exported, arrays)
     with open(path + ".pdparams", "rb") as f:
         return pickle.load(f)
+
+
+# --------------------------------------------------- dy2static config knobs
+# (ref:python/paddle/jit/api.py enable_to_static, dy2static/logging_utils)
+
+_to_static_enabled = True
+
+
+def enable_to_static(enable: bool = True):
+    """Globally toggle to_static compilation (when off, StaticFunction runs
+    the original eager function)."""
+    global _to_static_enabled
+    _to_static_enabled = bool(enable)
+
+
+def not_to_static(function):
+    """Mark a function to stay eager inside to_static regions. Tracing-based
+    to_static has no AST rewriting, so marked functions simply run as part of
+    the trace; the marker is honored by returning the function unchanged."""
+    function._paddle_not_to_static = True
+    return function
+
+
+_ignored_modules: list = []
+
+
+def ignore_module(modules):
+    """Register modules the dy2static transformer should skip. Trace-based
+    compilation never rewrites module code, so registration is bookkeeping
+    for API parity."""
+    _ignored_modules.extend(modules if isinstance(modules, (list, tuple))
+                            else [modules])
+
+
+_code_level = 0
+_verbosity = 0
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    global _code_level
+    _code_level = level
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    global _verbosity
+    _verbosity = level

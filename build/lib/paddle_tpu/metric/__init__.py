@@ -146,3 +146,19 @@ class Auc(Metric):
         tpr = pos_c / tot_pos
         fpr = neg_c / tot_neg
         return float(np.trapezoid(tpr, fpr))
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    """Functional top-k accuracy (ref:python/paddle/metric/metrics.py
+    accuracy): input [N, C] scores, label [N, 1] or [N]."""
+    import jax.numpy as jnp
+
+    from ..core.dispatch import apply
+
+    def _acc(x, y, *, k):
+        topk = jnp.argsort(-x, axis=-1)[:, :k]
+        yy = y.reshape(-1, 1)
+        hit = (topk == yy).any(axis=1)
+        return hit.mean(dtype=jnp.float32)
+
+    return apply(_acc, (input, label), {"k": int(k)}, name="accuracy")

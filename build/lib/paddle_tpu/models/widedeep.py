@@ -86,10 +86,15 @@ class DeepFM(nn.Layer):
 
     def __init__(self, num_fields: int = 26, num_dense: int = 13,
                  num_buckets: int = 1000001, embedding_dim: int = 16,
-                 hidden_sizes: Sequence[int] = (400, 400)):
+                 hidden_sizes: Sequence[int] = (400, 400),
+                 sparse_embedding=None, first_order_embedding=None):
+        """Like WideDeep, the embeddings may be injected — e.g.
+        ``distributed.ps.PSEmbedding`` for host-RAM tables."""
         super().__init__()
-        self.embedding = DistributedEmbedding(num_buckets, embedding_dim)
-        self.first_order = DistributedEmbedding(num_buckets, 1)
+        self.embedding = sparse_embedding or DistributedEmbedding(
+            num_buckets, embedding_dim)
+        self.first_order = first_order_embedding or DistributedEmbedding(
+            num_buckets, 1)
         self.dense_proj = nn.Linear(num_dense, embedding_dim)
         self.dense_first = nn.Linear(num_dense, 1)
         dims = [num_fields * embedding_dim + num_dense] + list(hidden_sizes)

@@ -24,7 +24,10 @@ import threading
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC_DIR = os.path.join(_HERE, "csrc")
-_SOURCES = ["kvstore.cc", "trace.cc", "embedding_service.cc"]
+_SOURCES = ["kvstore.cc", "trace.cc", "embedding_service.cc", "pjrt_runner.cc"]
+# headers participate in the cache key: a header-only change (e.g. a PJRT API
+# bump) must rebuild, or a stale .so would run with mismatched struct layouts
+_HEADERS = [os.path.join("third_party", "pjrt_c_api.h")]
 
 _lib = None
 _lib_lock = threading.Lock()
@@ -32,7 +35,7 @@ _lib_lock = threading.Lock()
 
 def _source_hash() -> str:
     h = hashlib.sha256()
-    for s in _SOURCES:
+    for s in _SOURCES + _HEADERS:
         with open(os.path.join(_SRC_DIR, s), "rb") as f:
             h.update(f.read())
     return h.hexdigest()[:16]
@@ -41,7 +44,7 @@ def _source_hash() -> str:
 def _build(out_path: str):
     srcs = [os.path.join(_SRC_DIR, s) for s in _SOURCES]
     cmd = ["g++", "-O2", "-fPIC", "-shared", "-std=c++17", "-pthread",
-           "-o", out_path] + srcs
+           "-o", out_path] + srcs + ["-ldl"]
     subprocess.run(cmd, check=True, capture_output=True)
 
 
@@ -120,6 +123,25 @@ def _declare(lib):
     lib.pt_emb_clear.argtypes = [c.c_void_p]
     lib.pt_emb_stats.restype = c.c_int
     lib.pt_emb_stats.argtypes = [c.c_void_p, u64p]
+
+    lib.pt_infer_create.restype = c.c_void_p
+    lib.pt_infer_create.argtypes = [c.c_char_p, c.c_char_p]
+    lib.pt_infer_last_error.restype = c.c_char_p
+    lib.pt_infer_last_error.argtypes = []
+    lib.pt_infer_destroy.argtypes = [c.c_void_p]
+    lib.pt_infer_input_count.restype = c.c_int
+    lib.pt_infer_input_count.argtypes = [c.c_void_p]
+    lib.pt_infer_output_count.restype = c.c_int
+    lib.pt_infer_output_count.argtypes = [c.c_void_p]
+    i64p = c.POINTER(c.c_int64)
+    intp = c.POINTER(c.c_int)
+    lib.pt_infer_input_spec.restype = c.c_int
+    lib.pt_infer_input_spec.argtypes = [c.c_void_p, c.c_int, i64p, intp, intp]
+    lib.pt_infer_output_spec.restype = c.c_int
+    lib.pt_infer_output_spec.argtypes = [c.c_void_p, c.c_int, i64p, intp, intp]
+    lib.pt_infer_run.restype = c.c_int
+    lib.pt_infer_run.argtypes = [c.c_void_p, c.POINTER(c.c_void_p), c.c_int,
+                                 c.POINTER(c.c_void_p), c.c_int]
 
     lib.pt_trace_enable.argtypes = [c.c_int]
     lib.pt_trace_enabled.restype = c.c_int

@@ -1,0 +1,192 @@
+// A minimal fake PJRT plugin for CI: implements exactly the subset of the
+// PJRT C API that pjrt_runner.cc drives, with deterministic semantics —
+// "execute" returns a copy of the first runtime buffer list entry per
+// output. No XLA, no device; this is the fake-backend test pattern the
+// reference uses for device-independent runtime tests
+// (ref:test/cpp/fluid/fake_device tests): it validates the runner's dlopen →
+// initialize → client → compile → upload → execute → download → destroy
+// plumbing without hardware. Real numerics are covered by the TPU-gated
+// integration test in tests/test_native_infer.py.
+//
+// Built on demand by tests (paddle_tpu/native/pdnative.py:build_fake_plugin),
+// NOT part of libpaddle_tpu_native.so.
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "../third_party/pjrt_c_api.h"
+
+struct PJRT_Error {
+  std::string msg;
+};
+
+namespace {
+
+struct FakeBuffer {
+  std::string data;
+  std::vector<int64_t> dims;
+  PJRT_Buffer_Type type;
+};
+
+struct FakeClient {
+  int device_marker = 7;  // PJRT_Device* points here
+  std::vector<PJRT_Device*> devices;
+};
+
+struct FakeExec {
+  size_t num_compiled_bytes = 0;
+};
+
+PJRT_Buffer* wrap(FakeBuffer* b) { return reinterpret_cast<PJRT_Buffer*>(b); }
+FakeBuffer* unwrap(PJRT_Buffer* b) { return reinterpret_cast<FakeBuffer*>(b); }
+
+void err_destroy(PJRT_Error_Destroy_Args* a) { delete a->error; }
+
+void err_message(PJRT_Error_Message_Args* a) {
+  a->message = a->error->msg.c_str();
+  a->message_size = a->error->msg.size();
+}
+
+PJRT_Error* plugin_init(PJRT_Plugin_Initialize_Args*) { return nullptr; }
+
+PJRT_Error* event_await(PJRT_Event_Await_Args*) { return nullptr; }
+
+PJRT_Error* event_destroy(PJRT_Event_Destroy_Args*) {
+  return nullptr;  // fake events are tags, nothing allocated
+}
+
+PJRT_Error* client_create(PJRT_Client_Create_Args* a) {
+  auto* c = new FakeClient();
+  c->devices.push_back(reinterpret_cast<PJRT_Device*>(&c->device_marker));
+  a->client = reinterpret_cast<PJRT_Client*>(c);
+  return nullptr;
+}
+
+PJRT_Error* client_destroy(PJRT_Client_Destroy_Args* a) {
+  delete reinterpret_cast<FakeClient*>(a->client);
+  return nullptr;
+}
+
+PJRT_Error* addressable_devices(PJRT_Client_AddressableDevices_Args* a) {
+  auto* c = reinterpret_cast<FakeClient*>(a->client);
+  a->addressable_devices = c->devices.data();
+  a->num_addressable_devices = c->devices.size();
+  return nullptr;
+}
+
+PJRT_Error* compile(PJRT_Client_Compile_Args* a) {
+  if (a->program == nullptr || a->program->code_size == 0)
+    return new PJRT_Error{"fake plugin: empty program"};
+  std::string fmt(a->program->format, a->program->format_size);
+  if (fmt != "mlir")
+    return new PJRT_Error{"fake plugin: unsupported format " + fmt};
+  auto* e = new FakeExec();
+  e->num_compiled_bytes = a->program->code_size;
+  a->executable = reinterpret_cast<PJRT_LoadedExecutable*>(e);
+  return nullptr;
+}
+
+PJRT_Error* exec_destroy(PJRT_LoadedExecutable_Destroy_Args* a) {
+  delete reinterpret_cast<FakeExec*>(a->executable);
+  return nullptr;
+}
+
+PJRT_Error* get_executable(PJRT_LoadedExecutable_GetExecutable_Args* a) {
+  a->executable = reinterpret_cast<PJRT_Executable*>(a->loaded_executable);
+  return nullptr;
+}
+
+PJRT_Error* num_outputs(PJRT_Executable_NumOutputs_Args* a) {
+  a->num_outputs = 1;
+  return nullptr;
+}
+
+size_t type_size(PJRT_Buffer_Type t) {
+  switch (t) {
+    case PJRT_Buffer_Type_PRED: case PJRT_Buffer_Type_S8:
+    case PJRT_Buffer_Type_U8: return 1;
+    case PJRT_Buffer_Type_S16: case PJRT_Buffer_Type_U16:
+    case PJRT_Buffer_Type_F16: case PJRT_Buffer_Type_BF16: return 2;
+    case PJRT_Buffer_Type_S64: case PJRT_Buffer_Type_U64:
+    case PJRT_Buffer_Type_F64: case PJRT_Buffer_Type_C64: return 8;
+    default: return 4;
+  }
+}
+
+PJRT_Error* from_host(PJRT_Client_BufferFromHostBuffer_Args* a) {
+  auto* b = new FakeBuffer();
+  b->type = a->type;
+  b->dims.assign(a->dims, a->dims + a->num_dims);
+  size_t n = type_size(a->type);
+  for (size_t i = 0; i < a->num_dims; i++)
+    n *= static_cast<size_t>(a->dims[i]);
+  b->data.assign(static_cast<const char*>(a->data), n);
+  a->buffer = wrap(b);
+  a->done_with_host_buffer = reinterpret_cast<PJRT_Event*>(b);  // ready tag
+  return nullptr;
+}
+
+PJRT_Error* execute(PJRT_LoadedExecutable_Execute_Args* a) {
+  if (a->num_devices != 1)
+    return new PJRT_Error{"fake plugin: single device only"};
+  if (a->num_args == 0)
+    return new PJRT_Error{"fake plugin: no arguments"};
+  // one output: a copy of argument 0 (deterministic echo)
+  FakeBuffer* src = unwrap(const_cast<PJRT_Buffer*>(a->argument_lists[0][0]));
+  auto* out = new FakeBuffer(*src);
+  a->output_lists[0][0] = wrap(out);
+  if (a->device_complete_events != nullptr)
+    a->device_complete_events[0] = reinterpret_cast<PJRT_Event*>(out);
+  return nullptr;
+}
+
+PJRT_Error* to_host(PJRT_Buffer_ToHostBuffer_Args* a) {
+  FakeBuffer* b = unwrap(const_cast<PJRT_Buffer*>(a->src));
+  if (a->dst == nullptr) {
+    a->dst_size = b->data.size();
+    return nullptr;
+  }
+  if (a->dst_size < b->data.size())
+    return new PJRT_Error{"fake plugin: dst too small"};
+  memcpy(a->dst, b->data.data(), b->data.size());
+  a->event = reinterpret_cast<PJRT_Event*>(b);  // ready tag
+  return nullptr;
+}
+
+PJRT_Error* buffer_destroy(PJRT_Buffer_Destroy_Args* a) {
+  delete unwrap(a->buffer);
+  return nullptr;
+}
+
+PJRT_Api make_api() {
+  PJRT_Api api;
+  memset(&api, 0, sizeof(api));
+  api.struct_size = PJRT_Api_STRUCT_SIZE;
+  api.pjrt_api_version.major_version = PJRT_API_MAJOR;
+  api.pjrt_api_version.minor_version = PJRT_API_MINOR;
+  api.PJRT_Error_Destroy = err_destroy;
+  api.PJRT_Error_Message = err_message;
+  api.PJRT_Plugin_Initialize = plugin_init;
+  api.PJRT_Event_Await = event_await;
+  api.PJRT_Event_Destroy = event_destroy;
+  api.PJRT_Client_Create = client_create;
+  api.PJRT_Client_Destroy = client_destroy;
+  api.PJRT_Client_AddressableDevices = addressable_devices;
+  api.PJRT_Client_Compile = compile;
+  api.PJRT_Client_BufferFromHostBuffer = from_host;
+  api.PJRT_LoadedExecutable_Destroy = exec_destroy;
+  api.PJRT_LoadedExecutable_GetExecutable = get_executable;
+  api.PJRT_Executable_NumOutputs = num_outputs;
+  api.PJRT_LoadedExecutable_Execute = execute;
+  api.PJRT_Buffer_ToHostBuffer = to_host;
+  api.PJRT_Buffer_Destroy = buffer_destroy;
+  return api;
+}
+
+PJRT_Api g_api = make_api();
+
+}  // namespace
+
+extern "C" const PJRT_Api* GetPjrtApi() { return &g_api; }

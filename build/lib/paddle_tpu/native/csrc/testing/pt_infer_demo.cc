@@ -1,0 +1,100 @@
+// Minimal C++ serving application over the pt_infer C ABI — what a deploy
+// user compiles against libpaddle_tpu_native.so (the analog of the
+// reference's C++ inference demos, ref:paddle/fluid/inference/api/demo_ci).
+//
+//   g++ -std=c++17 pt_infer_demo.cc /path/to/libpaddle_tpu_native.so \
+//       -Wl,-rpath,/path/to -o demo
+//   ./demo <plugin.so> <model.pdnative>
+//
+// Feeds zero-filled inputs, prints per-output shape + first elements as f32
+// bits, exits nonzero on any runner error.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <vector>
+
+extern "C" {
+struct PTInfer;
+PTInfer* pt_infer_create(const char* plugin, const char* artifact);
+const char* pt_infer_last_error();
+int pt_infer_input_count(PTInfer*);
+int pt_infer_output_count(PTInfer*);
+int pt_infer_input_spec(PTInfer*, int, int64_t*, int*, int*);
+int pt_infer_output_spec(PTInfer*, int, int64_t*, int*, int*);
+int pt_infer_run(PTInfer*, const void**, int, void**, int);
+void pt_infer_destroy(PTInfer*);
+}
+
+namespace {
+size_t dtype_size(int t) {
+  switch (t) {
+    case 1: case 2: case 6: return 1;             // pred, s8, u8
+    case 3: case 7: case 10: case 13: return 2;   // s16, u16, f16, bf16
+    case 5: case 9: case 12: case 14: return 8;   // s64, u64, f64, c64
+    case 15: return 16;                           // c128
+    default: return 4;                            // s32, u32, f32
+  }
+}
+
+size_t spec_bytes(int rc, const int64_t* dims, int ndim, int dtype) {
+  if (rc != 0) return 0;
+  size_t n = dtype_size(dtype);
+  for (int i = 0; i < ndim; i++) n *= static_cast<size_t>(dims[i]);
+  return n;
+}
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc != 3) {
+    fprintf(stderr, "usage: %s <pjrt_plugin.so> <model.pdnative>\n", argv[0]);
+    return 2;
+  }
+  PTInfer* h = pt_infer_create(argv[1], argv[2]);
+  if (h == nullptr) {
+    fprintf(stderr, "create failed: %s\n", pt_infer_last_error());
+    return 1;
+  }
+  int nin = pt_infer_input_count(h), nout = pt_infer_output_count(h);
+  printf("inputs=%d outputs=%d\n", nin, nout);
+
+  std::vector<std::vector<char>> in_store(nin), out_store(nout);
+  std::vector<const void*> ins(nin);
+  std::vector<void*> outs(nout);
+  int64_t dims[16];
+  int ndim, dtype;
+  for (int i = 0; i < nin; i++) {
+    ndim = 16;
+    int rc = pt_infer_input_spec(h, i, dims, &ndim, &dtype);
+    in_store[i].assign(spec_bytes(rc, dims, ndim, dtype), 0);
+    ins[i] = in_store[i].data();
+  }
+  for (int i = 0; i < nout; i++) {
+    ndim = 16;
+    int rc = pt_infer_output_spec(h, i, dims, &ndim, &dtype);
+    out_store[i].assign(spec_bytes(rc, dims, ndim, dtype), 0);
+    outs[i] = out_store[i].data();
+  }
+  if (pt_infer_run(h, ins.data(), nin, outs.data(), nout) != 0) {
+    fprintf(stderr, "run failed: %s\n", pt_infer_last_error());
+    pt_infer_destroy(h);
+    return 1;
+  }
+  for (int i = 0; i < nout; i++) {
+    ndim = 16;
+    pt_infer_output_spec(h, i, dims, &ndim, &dtype);
+    printf("output %d: dtype=%d shape=[", i, dtype);
+    for (int d = 0; d < ndim; d++)
+      printf("%s%lld", d ? "," : "", static_cast<long long>(dims[d]));
+    printf("] bytes=%zu head=", out_store[i].size());
+    for (size_t b = 0; b < out_store[i].size() && b < 16; b += 4) {
+      uint32_t v;
+      memcpy(&v, out_store[i].data() + b, 4);
+      printf("%08x ", v);
+    }
+    printf("\n");
+  }
+  pt_infer_destroy(h);
+  printf("ok\n");
+  return 0;
+}

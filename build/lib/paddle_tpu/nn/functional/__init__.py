@@ -6,4 +6,5 @@ from .loss import *  # noqa: F401,F403
 from .norm import *  # noqa: F401,F403
 from .pooling import *  # noqa: F401,F403
 from .attention import flash_attention, scaled_dot_product_attention  # noqa: F401
+from ...ops.creation import diag_embed  # noqa: F401
 from . import activation, attention, common, conv, loss, norm, pooling  # noqa: F401

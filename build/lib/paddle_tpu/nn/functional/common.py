@@ -6,6 +6,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ...core import rng
 from ...core.dispatch import apply
@@ -29,7 +30,13 @@ def linear(x, weight, bias=None, name=None):
 
 
 def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
+    if mode not in ("upscale_in_train", "downscale_in_infer"):
+        raise ValueError(f"unsupported dropout mode {mode!r}")
     if not training or p == 0.0:
+        if mode == "downscale_in_infer" and p != 0.0:
+            # legacy mode: train keeps raw masked values, inference scales
+            # by the keep probability (ref nn/functional/common.py dropout)
+            return x * (1.0 - float(p))
         return x
 
     def _dropout(x, key, *, p, axis, upscale):
@@ -115,16 +122,74 @@ def interpolate(
             scale_factor = [scale_factor] * len(spatial)
         out_size = tuple(int(s * f) for s, f in zip(spatial, scale_factor))
 
-    jmode = {"nearest": "nearest", "bilinear": "linear", "trilinear": "linear", "linear": "linear", "bicubic": "cubic", "area": "linear"}[mode]
+    amode = {"nearest": "nearest", "bilinear": "linear",
+             "trilinear": "linear", "linear": "linear", "bicubic": "cubic",
+             "area": "area"}[mode]
 
-    def _interp(x, *, out_size, jmode, nchw):
-        if nchw:
-            full = x.shape[:2] + out_size
+    def _axis_matrix(in_s, out_s):
+        """[out_s, in_s] resampling weights with the paddle/torch index
+        conventions (align_corners, half-pixel, legacy align_mode=1,
+        replicate borders, bicubic a=-0.75)."""
+        i = np.arange(out_s, dtype=np.float64)
+        W = np.zeros((out_s, in_s))
+        rows = np.arange(out_s)
+        if amode == "nearest":
+            if align_corners:
+                src = np.round(i * (in_s - 1) / max(out_s - 1, 1))
+            else:
+                src = np.floor(i * in_s / out_s)
+            W[rows, np.clip(src.astype(int), 0, in_s - 1)] = 1.0
+            return W
+        if amode == "area":
+            start = np.floor(i * in_s / out_s).astype(int)
+            end = np.ceil((i + 1) * in_s / out_s).astype(int)
+            for o in range(out_s):
+                W[o, start[o]:end[o]] = 1.0 / (end[o] - start[o])
+            return W
+        if align_corners:
+            src = i * (in_s - 1) / max(out_s - 1, 1)
+        elif amode == "linear" and align_mode == 1:
+            src = i * in_s / out_s
         else:
-            full = (x.shape[0],) + out_size + (x.shape[-1],)
-        return jax.image.resize(x, full, method=jmode).astype(x.dtype)
+            src = (i + 0.5) * in_s / out_s - 0.5
+        if amode == "linear":
+            src = np.clip(src, 0, in_s - 1)
+            lo = np.floor(src).astype(int)
+            hi = np.minimum(lo + 1, in_s - 1)
+            t = src - lo
+            np.add.at(W, (rows, lo), 1.0 - t)
+            np.add.at(W, (rows, hi), t)
+            return W
+        # cubic convolution, a=-0.75 (torch/paddle kernel); replicate border
+        a = -0.75
+        lo = np.floor(src).astype(int)
+        t = src - lo
+        w_m1 = ((a * (t + 1) - 5 * a) * (t + 1) + 8 * a) * (t + 1) - 4 * a
+        w_0 = ((a + 2) * t - (a + 3)) * t * t + 1
+        u = 1 - t
+        w_p1 = ((a + 2) * u - (a + 3)) * u * u + 1
+        w_p2 = 1.0 - w_m1 - w_0 - w_p1
+        for off, w in ((-1, w_m1), (0, w_0), (1, w_p1), (2, w_p2)):
+            np.add.at(W, (rows, np.clip(lo + off, 0, in_s - 1)), w)
+        return W
 
-    return apply(_interp, (x,), dict(out_size=out_size, jmode=jmode, nchw=nchw))
+    # weight matrices ride as TENSOR args (not closure constants): the eager
+    # jit cache keys on shapes/statics, so repeat calls with one config hit
+    # the compiled executable instead of retracing per call
+    mats = [Tensor(jnp.asarray(_axis_matrix(int(s), int(o)), jnp.float32))
+            for s, o in zip(spatial, out_size)]
+
+    def _interp(x, *mat_args, nchw):
+        out = x
+        first_spatial = 2 if nchw else 1
+        for k, Wa in enumerate(mat_args):
+            axis = first_spatial + k
+            moved = jnp.moveaxis(out, axis, -1)
+            moved = (moved.astype(jnp.float32) @ Wa.T).astype(x.dtype)
+            out = jnp.moveaxis(moved, -1, axis)
+        return out
+
+    return apply(_interp, (x, *mats), dict(nchw=nchw), name="interpolate")
 
 
 def upsample(x, size=None, scale_factor=None, mode="nearest", align_corners=False, align_mode=0, data_format="NCHW", name=None):
@@ -218,3 +283,241 @@ def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
         return (1 - eps) * label + eps / k
 
     return apply(_ls, (label,), dict(eps=float(epsilon)))
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    def _cs(x, *, groups, nchw):
+        if nchw:
+            n, c, h, w = x.shape
+            return (x.reshape(n, groups, c // groups, h, w)
+                     .transpose(0, 2, 1, 3, 4).reshape(n, c, h, w))
+        n, h, w, c = x.shape
+        return (x.reshape(n, h, w, groups, c // groups)
+                 .transpose(0, 1, 2, 4, 3).reshape(n, h, w, c))
+
+    return apply(_cs, (x,), {"groups": int(groups),
+                             "nchw": data_format == "NCHW"})
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    from ...core.dtype import convert_dtype_arg
+
+    if maxlen is None:
+        import numpy as _np
+
+        maxlen = int(_np.asarray((x._data if isinstance(x, Tensor) else x)).max())
+
+    def _sm(lens, *, maxlen, dtype):
+        return (jnp.arange(maxlen) < lens[..., None]).astype(dtype)
+
+    return apply(_sm, (x,), {"maxlen": int(maxlen),
+                             "dtype": convert_dtype_arg(dtype)})
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    def _bl(a, b, w, bias=None):
+        out = jnp.einsum("ni,oij,nj->no", a, w, b)
+        return out if bias is None else out + bias
+
+    args = (x1, x2, weight) + (() if bias is None else (bias,))
+    return apply(_bl, args, {}, name="bilinear")
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """theta [N, 2, 3] -> sampling grid [N, H, W, 2] (ref F.affine_grid)."""
+
+    def _ag(theta, *, size, align):
+        N, _, H, W = size
+
+        def axis(n):
+            if align:
+                return jnp.linspace(-1.0, 1.0, n)
+            step = 2.0 / n
+            return jnp.linspace(-1.0 + step / 2, 1.0 - step / 2, n)
+
+        ys, xs = jnp.meshgrid(axis(H), axis(W), indexing="ij")
+        base = jnp.stack([xs, ys, jnp.ones_like(xs)], axis=-1)  # [H, W, 3]
+        return jnp.einsum("hwk,nck->nhwc", base, theta)
+
+    size = tuple(int(s) for s in (out_shape.numpy() if isinstance(out_shape, Tensor) else out_shape))
+    return apply(_ag, (theta,), {"size": size, "align": bool(align_corners)})
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """Bilinear/nearest sampling of NCHW x at grid [N, H', W', 2]
+    (ref F.grid_sample over ref:paddle/phi/kernels/.../grid_sample)."""
+
+    def _gs(x, grid, *, mode, pad_mode, align):
+        N, C, H, W = x.shape
+        gx, gy = grid[..., 0], grid[..., 1]
+        if align:
+            fx = (gx + 1) * (W - 1) / 2
+            fy = (gy + 1) * (H - 1) / 2
+        else:
+            fx = ((gx + 1) * W - 1) / 2
+            fy = ((gy + 1) * H - 1) / 2
+
+        def fetch(ix, iy):
+            inb = (ix >= 0) & (ix < W) & (iy >= 0) & (iy < H)
+            if pad_mode == "border":
+                ixc = jnp.clip(ix, 0, W - 1)
+                iyc = jnp.clip(iy, 0, H - 1)
+                inb = jnp.ones_like(inb)
+            elif pad_mode == "reflection":
+                ixc = jnp.abs(jnp.mod(ix, 2 * (W - 1)))
+                ixc = jnp.where(ixc > W - 1, 2 * (W - 1) - ixc, ixc)
+                iyc = jnp.abs(jnp.mod(iy, 2 * (H - 1)))
+                iyc = jnp.where(iyc > H - 1, 2 * (H - 1) - iyc, iyc)
+                inb = jnp.ones_like(inb)
+            else:
+                ixc = jnp.clip(ix, 0, W - 1)
+                iyc = jnp.clip(iy, 0, H - 1)
+            # x [N,C,H,W]; ixc/iyc [N,h,w] -> out [N,C,h,w]
+            ni = jnp.arange(N)[:, None, None]
+            v = x[ni, :, iyc, ixc]               # [N, h, w, C]
+            v = jnp.moveaxis(v, -1, 1)
+            return v * inb[:, None].astype(x.dtype)
+
+        if mode == "nearest":
+            return fetch(jnp.round(fx).astype(jnp.int32),
+                         jnp.round(fy).astype(jnp.int32))
+        x0 = jnp.floor(fx).astype(jnp.int32)
+        y0 = jnp.floor(fy).astype(jnp.int32)
+        wx = (fx - x0)[:, None]
+        wy = (fy - y0)[:, None]
+        v00 = fetch(x0, y0)
+        v01 = fetch(x0 + 1, y0)
+        v10 = fetch(x0, y0 + 1)
+        v11 = fetch(x0 + 1, y0 + 1)
+        top = v00 * (1 - wx) + v01 * wx
+        bot = v10 * (1 - wx) + v11 * wx
+        return (top * (1 - wy) + bot * wy).astype(x.dtype)
+
+    return apply(_gs, (x, grid), {"mode": mode, "pad_mode": padding_mode,
+                                  "align": bool(align_corners)})
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW", name=None):
+    """TSM temporal shift (ref F.temporal_shift): shift a slice of channels
+    one step forward/backward along the segment axis."""
+
+    def _ts(x, *, seg, ratio):
+        nt, c, h, w = x.shape
+        n = nt // seg
+        x5 = x.reshape(n, seg, c, h, w)
+        fold = int(c * ratio)
+        fwd = jnp.concatenate([x5[:, 1:, :fold], jnp.zeros_like(x5[:, :1, :fold])], axis=1)
+        bwd = jnp.concatenate([jnp.zeros_like(x5[:, :1, fold:2 * fold]),
+                               x5[:, :-1, fold:2 * fold]], axis=1)
+        rest = x5[:, :, 2 * fold:]
+        return jnp.concatenate([fwd, bwd, rest], axis=2).reshape(nt, c, h, w)
+
+    return apply(_ts, (x,), {"seg": int(seg_num), "ratio": float(shift_ratio)})
+
+
+def gather_tree(ids, parents):
+    """Beam-search backtrace (ref F.gather_tree): ids/parents [T, B, beam]."""
+
+    def _gt(ids, parents):
+        T = ids.shape[0]
+
+        def step(carry, t):
+            beams, out = carry  # beams [B, W] current beam index per slot
+            tt = T - 1 - t
+            tok = jnp.take_along_axis(ids[tt], beams, axis=1)
+            par = jnp.take_along_axis(parents[tt], beams, axis=1)
+            return (par, None), tok
+
+        (final, _), toks = jax.lax.scan(
+            step,
+            (jnp.broadcast_to(jnp.arange(ids.shape[2]), ids.shape[1:]), None),
+            jnp.arange(T),
+        )
+        return toks[::-1]
+
+    return apply(_gt, (ids, parents), {}, name="gather_tree")
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    """Sample negative class centers (ref F.class_center_sample). Host-side
+    sampling (data-dependent sizes are not traceable); returns
+    (remapped_label, sampled_class_index)."""
+    import numpy as _np
+
+    lab = _np.asarray(label._data if isinstance(label, Tensor) else label)
+    pos = _np.unique(lab)
+    if len(pos) >= num_samples:
+        sampled = pos
+    else:
+        rest = _np.setdiff1d(_np.arange(num_classes), pos)
+        extra = _np.random.choice(rest, num_samples - len(pos), replace=False)
+        sampled = _np.sort(_np.concatenate([pos, extra]))
+    remap = -_np.ones(num_classes, _np.int64)
+    remap[sampled] = _np.arange(len(sampled))
+    return Tensor(jnp.asarray(remap[lab])), Tensor(jnp.asarray(sampled))
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5, margin3=0.0,
+                         scale=64.0, group=None, return_softmax=False,
+                         reduction="mean"):
+    """ArcFace/CosFace-style margin softmax (ref F.margin_cross_entropy):
+    cos(m1*theta + m2) - m3 applied to the target logit."""
+
+    def _mce(logits, label, *, m1, m2, m3, s, reduction, ret_sm):
+        theta = jnp.arccos(jnp.clip(logits, -1.0 + 1e-7, 1.0 - 1e-7))
+        n = logits.shape[0]
+        tgt = jnp.cos(m1 * theta + m2) - m3
+        mod = logits.at[jnp.arange(n), label].set(tgt[jnp.arange(n), label])
+        mod = mod * s
+        logp = jax.nn.log_softmax(mod, axis=-1)
+        loss = -jnp.take_along_axis(logp, label[:, None], axis=1)[:, 0]
+        if reduction == "mean":
+            loss = loss.mean()
+        elif reduction == "sum":
+            loss = loss.sum()
+        if ret_sm:
+            return loss, jnp.exp(logp)
+        return loss
+
+    return apply(_mce, (logits, label),
+                 {"m1": float(margin1), "m2": float(margin2),
+                  "m3": float(margin3), "s": float(scale),
+                  "reduction": reduction, "ret_sm": bool(return_softmax)})
+
+
+def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns,
+                     key_padding_mask=None, attn_mask=None, name=None):
+    """Block-sparse attention fallback: dense SDPA with the CSR pattern
+    applied as a mask (the reference's CUDA kernel is pattern-pruned compute;
+    on TPU the MXU prefers the dense masked form for these sizes)."""
+    from .attention import scaled_dot_product_attention
+
+    return scaled_dot_product_attention(query, key, value, attn_mask=attn_mask)
+
+
+def relu_(x, name=None):
+    from ...core.dispatch import run_inplace
+    from .activation import relu
+
+    return run_inplace(relu, x)
+
+
+def elu_(x, alpha=1.0, name=None):
+    from ...core.dispatch import run_inplace
+    from .activation import elu
+
+    return run_inplace(elu, x, alpha)
+
+
+def softmax_(x, axis=-1, dtype=None, name=None):
+    from ...core.dispatch import run_inplace
+    from .activation import softmax
+
+    return run_inplace(softmax, x, axis, dtype)
+
+
+def tanh_(x, name=None):
+    from ...ops.extras import tanh_ as _t
+
+    return _t(x)

@@ -66,37 +66,49 @@ def _conv_nd(x, weight, bias, stride, padding, dilation, groups, n, data_format,
     else:
         opad = _norm_tuple(output_padding, n)
 
-        def _convt(x, w, *, stride, pad, dilation, groups, dn, opad):
-            # transpose conv = gradient of conv: use lax.conv_transpose
-            w_t = jnp.swapaxes(w, 0, 1)  # paddle convT weight is [in, out/groups, *k]
-            if groups > 1:
-                # grouped transpose conv: block-diagonal over groups
-                in_per_g = w.shape[0] // groups
-                outs = []
-                xs = jnp.split(x, groups, axis=1 if dn[0][1] == "C" else -1)
-                ws = jnp.split(w, groups, axis=0)
-                for xg, wg in zip(xs, ws):
-                    outs.append(
-                        jax.lax.conv_transpose(
-                            xg, jnp.swapaxes(wg, 0, 1), strides=stride,
-                            padding=pad if isinstance(pad, str) else list(pad),
-                            rhs_dilation=dilation, dimension_numbers=dn, transpose_kernel=True,
-                        )
-                    )
-                out = jnp.concatenate(outs, axis=1 if dn[0][1] == "C" else -1)
+        if isinstance(pad, str):
+            # SAME: output = input * stride (total conv-pad d*(k-1)+1-s,
+            # clamped); VALID: no padding — the reference's string modes
+            k_sp = weight.shape[2:]
+            if pad.upper() == "VALID":
+                pad = tuple((0, 0) for _ in range(n))
             else:
-                out = jax.lax.conv_transpose(
-                    x, w_t, strides=stride, padding=pad if isinstance(pad, str) else list(pad),
-                    rhs_dilation=dilation, dimension_numbers=dn, transpose_kernel=True,
+                pairs = []
+                for i in range(n):
+                    total = max(dilation[i] * (k_sp[i] - 1) + 1 - stride[i], 0)
+                    pairs.append((total // 2, total - total // 2))
+                pad = tuple(pairs)
+
+        def _convt(x, w, *, stride, pad, dilation, groups, dn, opad):
+            # transpose conv = gradient of a forward conv: dilate the input by
+            # `stride` (lhs_dilation), pad each side with d*(k-1) - p (plus
+            # output_padding on the high side), convolve with the spatially
+            # flipped, IO-swapped kernel. Matches the reference convT contract
+            # L_out = (L-1)*s - 2p + d*(k-1) + 1 + output_padding.
+            n_sp = len(stride)
+            k_sp = w.shape[2:]
+            jpad = tuple(
+                (dilation[i] * (k_sp[i] - 1) - pad[i][0],
+                 dilation[i] * (k_sp[i] - 1) - pad[i][1] + opad[i])
+                for i in range(n_sp)
+            )
+            flip = tuple(range(2, 2 + n_sp))
+
+            def one(xg, wg):
+                w2 = jnp.flip(jnp.swapaxes(wg, 0, 1), flip)  # [out, in_g, *k]
+                return jax.lax.conv_general_dilated(
+                    xg, w2, window_strides=(1,) * n_sp, padding=jpad,
+                    lhs_dilation=stride, rhs_dilation=dilation,
+                    dimension_numbers=dn,
                 )
-            if any(opad):
-                pads = [(0, 0, 0)] * out.ndim
-                spatial_axes = range(2, out.ndim) if dn[0][1] == "C" else range(1, out.ndim - 1)
-                cfg = [(0, 0, 0)] * out.ndim
-                for i, ax in enumerate(spatial_axes):
-                    cfg[ax] = (0, opad[i], 0)
-                out = jax.lax.pad(out, jnp.zeros((), out.dtype), cfg)
-            return out
+
+            if groups > 1:
+                ch_ax = 1 if dn[0][1] == "C" else -1
+                xs = jnp.split(x, groups, axis=ch_ax)
+                ws = jnp.split(w, groups, axis=0)
+                return jnp.concatenate([one(a, b) for a, b in zip(xs, ws)],
+                                       axis=ch_ax)
+            return one(x, w)
 
         out = apply(
             _convt,

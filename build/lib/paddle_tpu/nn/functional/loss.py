@@ -18,25 +18,46 @@ def _reduce(x, reduction):
 
 def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean", soft_label=False, axis=-1, use_softmax=True, label_smoothing=0.0, name=None):
     def _ce(logits, label, w, *, ignore_index, reduction, soft_label, axis, use_softmax, smooth, has_w):
-        if use_softmax:
-            logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=axis)
-        else:
+        logp = None
+        if not use_softmax:
             logp = jnp.log(jnp.maximum(logits.astype(jnp.float32), 1e-30))
         if soft_label:
+            if logp is None:
+                logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=axis)
             tgt = label.astype(jnp.float32)
             loss = -jnp.sum(tgt * logp, axis=axis)
         else:
             lbl = label
-            if lbl.ndim == logp.ndim:
+            if lbl.ndim == logits.ndim:
                 lbl = jnp.squeeze(lbl, axis=axis)
             lbl = lbl.astype(jnp.int32)
-            n_cls = logp.shape[axis]
+            n_cls = logits.shape[axis]
+            # ignore_index rows are masked out below, but the gather must not
+            # see the out-of-range index first: fill-mode gather yields NaN,
+            # and NaN*0 stays NaN through the mask
+            safe_lbl = jnp.where(lbl == ignore_index, 0, lbl)
             if smooth > 0.0:
+                if logp is None:
+                    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=axis)
                 oh = jax.nn.one_hot(lbl, n_cls, axis=axis)
                 tgt = oh * (1.0 - smooth) + smooth / n_cls
                 loss = -jnp.sum(tgt * logp, axis=axis)
+            elif logp is None:
+                # hot path (hard labels, softmax): loss = lse - logits[label].
+                # log_softmax would materialize a full fp32 [.., V] tensor —
+                # and save it as the take_along_axis residual — whose only use
+                # is one element per row; the logsumexp form reduces straight
+                # to [..] with the upcast fused into the reduction, which is
+                # the difference between HBM-bound and fused on a 50K-vocab
+                # LM head (same numerics: both use the max-shift trick).
+                lse = jax.scipy.special.logsumexp(
+                    logits.astype(jnp.float32), axis=axis)
+                picked = jnp.take_along_axis(
+                    logits, jnp.expand_dims(safe_lbl, axis), axis=axis
+                ).squeeze(axis).astype(jnp.float32)
+                loss = lse - picked
             else:
-                loss = -jnp.take_along_axis(logp, jnp.expand_dims(lbl, axis), axis=axis).squeeze(axis)
+                loss = -jnp.take_along_axis(logp, jnp.expand_dims(safe_lbl, axis), axis=axis).squeeze(axis)
             mask = lbl != ignore_index
             wt = mask.astype(jnp.float32)
             if has_w:
@@ -106,9 +127,12 @@ def l1_loss(input, label, reduction="mean", name=None):
 
 
 def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    # the reference lowers this to huber_loss (ref:python/paddle/nn/
+    # functional/loss.py:1120): 0.5 z^2 inside delta, delta|z| - 0.5 delta^2
+    # outside
     def _sl1(x, y, *, reduction, delta):
-        d = x - y
-        loss = jnp.where(jnp.abs(d) < delta, 0.5 * d * d / delta, jnp.abs(d) - 0.5 * delta)
+        d = jnp.abs(x - y)
+        loss = jnp.where(d < delta, 0.5 * d * d, delta * d - 0.5 * delta * delta)
         return _reduce(loss, reduction)
 
     return apply(_sl1, (input, label), dict(reduction=reduction, delta=float(delta)))
@@ -202,7 +226,152 @@ def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0, epsilon=1e
 
 
 def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0, reduction="mean", norm_by_times=False):
-    raise NotImplementedError("ctc_loss: planned (lax.scan forward algorithm)")
+    """CTC loss via the log-space forward algorithm as one lax.scan over time
+    (ref:python/paddle/nn/functional/loss.py ctc_loss wrapping
+    ref:paddle/phi/kernels/.../warpctc — here the DP is XLA-compiled, no
+    external warpctc).
+
+    log_probs: [T, B, V] log-softmax scores (paddle layout), labels: [B, L],
+    input_lengths/label_lengths: [B].
+    """
+
+    def _ctc(lp, lab, in_len, lab_len, *, blank):
+        T, B, V = lp.shape
+        L = lab.shape[1]
+        S = 2 * L + 1
+        NEG = -1e30
+
+        # extended label sequence: blank, l1, blank, l2, ... blank
+        ext = jnp.full((B, S), blank, lab.dtype)
+        ext = ext.at[:, 1::2].set(lab)
+        # transitions: from s-1 always; from s-2 iff ext[s] != blank and
+        # ext[s] != ext[s-2]
+        ext_prev2 = jnp.pad(ext, ((0, 0), (2, 0)), constant_values=blank)[:, :S]
+        can_skip = (ext != blank) & (ext != ext_prev2)
+
+        emit = jnp.take_along_axis(
+            lp.transpose(1, 0, 2), ext[:, None, :].repeat(T, 1), axis=2
+        )  # [B, T, S] score of ext symbol s at time t
+
+        alpha0 = jnp.full((B, S), NEG)
+        alpha0 = alpha0.at[:, 0].set(emit[:, 0, 0])
+        alpha0 = alpha0.at[:, 1].set(jnp.where(lab_len > 0, emit[:, 0, 1], NEG))
+
+        def step(alpha, t):
+            a_prev1 = jnp.pad(alpha, ((0, 0), (1, 0)), constant_values=NEG)[:, :S]
+            a_prev2 = jnp.pad(alpha, ((0, 0), (2, 0)), constant_values=NEG)[:, :S]
+            a_prev2 = jnp.where(can_skip, a_prev2, NEG)
+            nxt = jnp.logaddexp(jnp.logaddexp(alpha, a_prev1), a_prev2) + emit[:, t]
+            # frozen past input length: keep alpha (sequence already ended)
+            nxt = jnp.where((t < in_len)[:, None], nxt, alpha)
+            return nxt, None
+
+        alpha, _ = jax.lax.scan(step, alpha0, jnp.arange(1, T))
+        send = 2 * lab_len  # last blank index
+        a_last = jnp.take_along_axis(alpha, send[:, None], axis=1)[:, 0]
+        a_last2 = jnp.where(
+            lab_len > 0,
+            jnp.take_along_axis(alpha, jnp.maximum(send - 1, 0)[:, None], axis=1)[:, 0],
+            NEG,
+        )
+        nll = -jnp.logaddexp(a_last, a_last2)
+        return nll
+
+    out = apply(
+        _ctc,
+        (log_probs, labels, input_lengths, label_lengths),
+        {"blank": int(blank)},
+        name="ctc_loss",
+    )
+    if norm_by_times:
+        # normalize each sample by its number of TIME steps (the reference's
+        # warpctc norm_by_times contract)
+        out = out / input_lengths.astype("float32")
+    if reduction == "mean":
+        # paddle contract: divide by label_lengths, then mean
+        return (out / label_lengths.astype("float32")).mean()
+    if reduction == "sum":
+        return out.sum()
+    return out
+
+
+def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
+              fastemit_lambda=0.0, reduction="mean", name=None):
+    """RNN-T (transducer) loss: log-space alpha recursion over the (t, u)
+    lattice (ref:python/paddle/nn/functional/loss.py rnnt_loss wrapping
+    warprnnt). Scan over t; the within-row emit recursion over u is a second
+    scan — fully XLA-compiled.
+
+    input: [B, T, U+1, V] log-softmax joint scores; label: [B, U].
+    FastEmit gradient regularization is a warprnnt backward-pass rescaling
+    with no pure-loss equivalent; it is not implemented — a nonzero
+    ``fastemit_lambda`` raises rather than silently diverging.
+    """
+    if fastemit_lambda:
+        raise NotImplementedError(
+            "rnnt_loss fastemit_lambda: FastEmit rescales the backward pass "
+            "inside warprnnt; not supported — pass fastemit_lambda=0")
+
+    def _rnnt(lp, lab, in_len, lab_len, *, blank):
+        B, T, U1, V = lp.shape
+        U = U1 - 1
+        NEG = -1e30
+        u_idx = jnp.arange(U1)
+
+        blank_lp = lp[..., blank]  # [B, T, U1]
+        emit_lp = jnp.take_along_axis(
+            lp[:, :, :U, :], lab[:, None, :, None].repeat(T, 1), axis=3
+        )[..., 0]  # [B, T, U] score of emitting label u at (t, u)
+
+        valid_u = u_idx[None, :] <= lab_len[:, None]  # [B, U1]
+
+        def row(alpha_prev, t):
+            # horizontal move: from alpha[t-1, u] via blank at (t-1, u)
+            from_blank = jnp.where(
+                (t > 0) & ((t - 1) < in_len)[:, None],
+                alpha_prev + blank_lp[:, jnp.maximum(t - 1, 0), :],
+                jnp.where(t == 0, alpha_prev, NEG),
+            )
+            base = jnp.where(t == 0, alpha_prev, from_blank)
+
+            # vertical moves within the row: alpha[t, u] <- alpha[t, u-1] +
+            # emit(t, u-1); a sequential scan over u
+            def vstep(carry, u):
+                cur = jnp.logaddexp(
+                    base[:, u],
+                    carry + jnp.where(u >= 1, emit_lp[:, t, jnp.maximum(u - 1, 0)], NEG),
+                )
+                cur = jnp.where(u == 0, base[:, 0], cur)
+                return cur, cur
+
+            _, cols = jax.lax.scan(vstep, jnp.full((B,), NEG), u_idx)
+            alpha = cols.T  # [B, U1]
+            alpha = jnp.where(valid_u, alpha, NEG)
+            alpha = jnp.where((t < in_len)[:, None], alpha, alpha_prev)
+            return alpha, None
+
+        alpha0 = jnp.full((B, U1), NEG).at[:, 0].set(0.0)
+        # t = 0 row needs its vertical pass too: run rows for t = 0..T-1
+        alpha, _ = jax.lax.scan(row, alpha0, jnp.arange(T))
+
+        # total log prob: alpha[T_b - 1, U_b] + blank(T_b - 1, U_b)
+        bi = jnp.arange(B)
+        t_last = jnp.maximum(in_len - 1, 0)
+        a_end = alpha[bi, lab_len]
+        nll = -(a_end + blank_lp[bi, t_last, lab_len])
+        return nll
+
+    out = apply(
+        _rnnt,
+        (input, label, input_lengths, label_lengths),
+        {"blank": int(blank)},
+        name="rnnt_loss",
+    )
+    if reduction == "mean":
+        return out.mean()
+    if reduction == "sum":
+        return out.sum()
+    return out
 
 
 def square_error_cost(input, label):
@@ -227,3 +396,200 @@ def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0, red
 
         out = divide(out, normalizer)
     return out
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    def _sml(x, y, *, reduction):
+        return _reduce(jnp.log1p(jnp.exp(-y * x)), reduction)
+
+    return apply(_sml, (input, label), {"reduction": reduction})
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
+                      reduction="mean", name=None):
+    """Multi-class margin (hinge) loss over [N, C] scores, int labels."""
+    args = (input, label) + ((weight,) if weight is not None else ())
+
+    def _mml(x, y, w=None, *, p, margin, reduction):
+        n, c = x.shape
+        xy = jnp.take_along_axis(x, y[:, None], axis=1)  # [N, 1]
+        m = jnp.maximum(0.0, margin - xy + x) ** p
+        if w is not None:
+            m = m * w[y][:, None]
+        m = m.at[jnp.arange(n), y].set(0.0)
+        return _reduce(m.sum(axis=1) / c, reduction)
+
+    return apply(_mml, args, {"p": int(p), "margin": float(margin),
+                              "reduction": reduction})
+
+
+def multi_label_soft_margin_loss(input, label, weight=None, reduction="mean",
+                                 name=None):
+    args = (input, label) + ((weight,) if weight is not None else ())
+
+    def _mlsm(x, y, w=None, *, reduction):
+        l = -(y * jax.nn.log_sigmoid(x) + (1 - y) * jax.nn.log_sigmoid(-x))
+        if w is not None:
+            l = l * w
+        return _reduce(l.mean(axis=-1), reduction)
+
+    return apply(_mlsm, args, {"reduction": reduction})
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False, epsilon=1e-8,
+                     reduction="mean", name=None):
+    def _pnll(x, y, *, log_input, full, epsilon, reduction):
+        if log_input:
+            loss = jnp.exp(x) - y * x
+        else:
+            loss = x - y * jnp.log(x + epsilon)
+        if full:
+            stirling = y * jnp.log(y) - y + 0.5 * jnp.log(2 * jnp.pi * y)
+            loss = loss + jnp.where(y > 1, stirling, 0.0)
+        return _reduce(loss, reduction)
+
+    return apply(_pnll, (input, label),
+                 {"log_input": bool(log_input), "full": bool(full),
+                  "epsilon": float(epsilon), "reduction": reduction})
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean", name=None):
+    def _gnll(mu, y, var, *, full, epsilon, reduction):
+        var = jnp.maximum(var, epsilon)
+        loss = 0.5 * (jnp.log(var) + (y - mu) ** 2 / var)
+        if full:
+            loss = loss + 0.5 * jnp.log(jnp.asarray(2 * jnp.pi, var.dtype))
+        return _reduce(loss, reduction)
+
+    return apply(_gnll, (input, label, variance),
+                 {"full": bool(full), "epsilon": float(epsilon),
+                  "reduction": reduction})
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    def _ll(p, y, *, epsilon):
+        return -y * jnp.log(p + epsilon) - (1 - y) * jnp.log(1 - p + epsilon)
+
+    return apply(_ll, (input, label), {"epsilon": float(epsilon)})
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    """input [N, ..., C] probabilities, label [N, ..., 1] int."""
+
+    def _dice(x, y, *, epsilon):
+        y1 = jax.nn.one_hot(y[..., 0], x.shape[-1], dtype=x.dtype)
+        red = tuple(range(1, x.ndim))
+        inter = (x * y1).sum(axis=red)
+        union = x.sum(axis=red) + y1.sum(axis=red)
+        return (1 - (2 * inter + epsilon) / (union + epsilon)).mean()
+
+    return apply(_dice, (input, label), {"epsilon": float(epsilon)})
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    def _npair(a, p, y, *, l2_reg):
+        logits = a @ p.T  # [N, N]
+        same = (y[:, None] == y[None, :]).astype(a.dtype)
+        targets = same / same.sum(axis=1, keepdims=True)
+        logp = jax.nn.log_softmax(logits, axis=1)
+        xent = -(targets * logp).sum(axis=1).mean()
+        reg = l2_reg * ((a * a).sum(axis=1) + (p * p).sum(axis=1)).mean() * 0.25
+        return xent + reg
+
+    return apply(_npair, (anchor, positive, labels), {"l2_reg": float(l2_reg)})
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    def _pd(a, b, *, p, epsilon, keepdim):
+        d = jnp.abs(a - b) + epsilon
+        return jnp.power(jnp.power(d, p).sum(axis=-1), 1.0 / p) if not keepdim \
+            else jnp.power(jnp.power(d, p).sum(axis=-1, keepdims=True), 1.0 / p)
+
+    return apply(_pd, (x, y), {"p": float(p), "epsilon": float(epsilon),
+                               "keepdim": bool(keepdim)})
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean", name=None):
+    from ...ops import math as M
+
+    if distance_function is None:
+        d_pos = pairwise_distance(input, positive)
+        d_neg = pairwise_distance(input, negative)
+        d_swap = pairwise_distance(positive, negative) if swap else None
+    else:
+        d_pos = distance_function(input, positive)
+        d_neg = distance_function(input, negative)
+        d_swap = distance_function(positive, negative) if swap else None
+    if swap:
+        d_neg = M.minimum(d_neg, d_swap)
+
+    def _tm(dp, dn, *, margin, reduction):
+        return _reduce(jnp.maximum(dp - dn + margin, 0.0), reduction)
+
+    return apply(_tm, (d_pos, d_neg), {"margin": float(margin),
+                                       "reduction": reduction})
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False, name=None):
+    """Hierarchical sigmoid over the default complete binary tree
+    (ref:python/paddle/nn/functional/loss.py hsigmoid_loss). Custom trees via
+    path_table/path_code [N, L] as in the reference."""
+    if path_table is None:
+        # default tree: internal nodes 1..C-1 (heap order), leaves = classes
+        import numpy as _np
+
+        C = int(num_classes)
+        depth = max(int(_np.ceil(_np.log2(max(C, 2)))), 1)
+        tables, codes = [], []
+        for c in range(C):
+            node = c + C  # leaves occupy C..2C-1 in the implicit heap
+            t, k = [], []
+            while node > 1:
+                parent = node // 2
+                t.append(parent - 1)      # internal node index (0-based)
+                k.append(node % 2)        # left/right bit
+                node = parent
+            t = t[::-1][:depth] + [-1] * max(0, depth - len(t))
+            k = k[::-1][:depth] + [0] * max(0, depth - len(k))
+            tables.append(t)
+            codes.append(k)
+        path_table_np = _np.asarray(tables, _np.int32)
+        path_code_np = _np.asarray(codes, _np.int32)
+
+        def _hs(x, y, w, b=None, *, _pt=tuple(map(tuple, path_table_np)),
+                _pc=tuple(map(tuple, path_code_np))):
+            pt = jnp.asarray(_pt)
+            pc = jnp.asarray(_pc)
+            t = pt[y]                     # [N, L] node ids (-1 padded)
+            code = pc[y].astype(x.dtype)  # [N, L]
+            mask = (t >= 0).astype(x.dtype)
+            tw = w[jnp.maximum(t, 0)]     # [N, L, D]
+            logit = jnp.einsum("nld,nd->nl", tw, x)
+            if b is not None:
+                logit = logit + b[jnp.maximum(t, 0)][..., 0] \
+                    if b.ndim > 1 else logit + b[jnp.maximum(t, 0)]
+            # code bit 0 -> sigmoid(logit), 1 -> sigmoid(-logit)
+            lsig = jax.nn.log_sigmoid(jnp.where(code > 0, -logit, logit))
+            return -(lsig * mask).sum(axis=1)
+
+        args = (input, label, weight) + ((bias,) if bias is not None else ())
+        return apply(_hs, args, {})
+
+    def _hs_custom(x, y, w, pt, pc, b=None):
+        code = pc.astype(x.dtype)
+        mask = (pt >= 0).astype(x.dtype)
+        tw = w[jnp.maximum(pt, 0)]
+        logit = jnp.einsum("nld,nd->nl", tw, x)
+        if b is not None:
+            logit = logit + (b[jnp.maximum(pt, 0)][..., 0]
+                             if b.ndim > 1 else b[jnp.maximum(pt, 0)])
+        lsig = jax.nn.log_sigmoid(jnp.where(code > 0, -logit, logit))
+        return -(lsig * mask).sum(axis=1)
+
+    args = (input, label, weight, path_table, path_code) + (
+        (bias,) if bias is not None else ())
+    return apply(_hs_custom, args, {})

@@ -14,29 +14,59 @@ def _pool(x, ksize, stride, padding, n, data_format, reducer, init, ceil_mode=Fa
     stride = _norm_tuple(stride if stride is not None else ksize, n)
     pad = _norm_padding(padding, n, stride, (1,) * n, ksize)
     channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+    ceil_extra = (0,) * n
+    if ceil_mode and not isinstance(pad, str):
+        # extend high-side padding so partially-covered windows are emitted
+        # (ceil output-size formula); the extension is "invisible" padding:
+        # -inf for max, excluded from every avg denominator
+        extra = []
+        sp_off = 1 if channel_last else 2
+        for i in range(n):
+            L = x.shape[sp_off + i] + pad[i][0] + pad[i][1]
+            rem = (L - ksize[i]) % stride[i]
+            extra.append((stride[i] - rem) % stride[i] if L >= ksize[i] else 0)
+        ceil_extra = tuple(extra)
 
-    def _run(x, *, ksize, stride, pad, channel_last, reducer, init, count_include_pad):
+    def _run(x, *, ksize, stride, pad, channel_last, reducer, init, count_include_pad, ceil_extra):
+        if isinstance(pad, str):
+            full = pad
+        else:
+            sp = tuple((lo, hi + ce) for (lo, hi), ce in zip(pad, ceil_extra))
+            full = (((0, 0),) + sp + ((0, 0),)) if channel_last else (((0, 0), (0, 0)) + sp)
         if channel_last:
             dims = (1,) + ksize + (1,)
             strides = (1,) + stride + (1,)
-            pads = ((0, 0),) + (pad if not isinstance(pad, str) else pad) + ((0, 0),) if not isinstance(pad, str) else pad
         else:
             dims = (1, 1) + ksize
             strides = (1, 1) + stride
-            pads = ((0, 0), (0, 0)) + pad if not isinstance(pad, str) else pad
         red = jax.lax.max if reducer == "max" else jax.lax.add
         # init MUST be a scalar literal: an array init makes reduce_window
         # opaque to jit-linearization (grad-under-jit then fails)
         ini = -jnp.inf if reducer == "max" else 0.0
-        out = jax.lax.reduce_window(x, ini, red, dims, strides, pads)
+        out = jax.lax.reduce_window(x, ini, red, dims, strides, full)
         out = out.astype(x.dtype)
         if reducer == "avg":
-            if count_include_pad or isinstance(pads, str):
-                denom = np.prod(ksize)
-                out = out / denom
+            if isinstance(pad, str):
+                out = out / np.prod(ksize)
+            elif count_include_pad:
+                if any(ceil_extra):
+                    # explicit padding counts toward the denominator, the
+                    # ceil extension does not (the reference/torch contract)
+                    ones = jnp.ones_like(x)
+                    cfg = (([(0, 0)] + [list(p) for p in pad] + [(0, 0)])
+                           if channel_last
+                           else ([(0, 0), (0, 0)] + [list(p) for p in pad]))
+                    ones = jnp.pad(ones, cfg, constant_values=1.0)
+                    ce = tuple((0, c) for c in ceil_extra)
+                    cfull = (((0, 0),) + ce + ((0, 0),)) if channel_last else (((0, 0), (0, 0)) + ce)
+                    counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add,
+                                                   dims, strides, cfull)
+                    out = out / counts
+                else:
+                    out = out / np.prod(ksize)
             else:
                 ones = jnp.ones_like(x)
-                counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, dims, strides, pads)
+                counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, dims, strides, full)
                 out = out / counts
         return out
 
@@ -51,19 +81,26 @@ def _pool(x, ksize, stride, padding, n, data_format, reducer, init, ceil_mode=Fa
             reducer=reducer,
             init=init,
             count_include_pad=count_include_pad,
+            ceil_extra=ceil_extra,
         ),
     )
 
 
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, data_format="NCL", name=None):
+    if return_mask:
+        return _max_pool_with_mask(x, kernel_size, stride, padding, 1, data_format, ceil_mode)
     return _pool(x, kernel_size, stride, padding, 1, data_format, "max", -np.inf, ceil_mode)
 
 
 def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, data_format="NCHW", name=None):
+    if return_mask:
+        return _max_pool_with_mask(x, kernel_size, stride, padding, 2, data_format, ceil_mode)
     return _pool(x, kernel_size, stride, padding, 2, data_format, "max", -np.inf, ceil_mode)
 
 
 def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, data_format="NCDHW", name=None):
+    if return_mask:
+        return _max_pool_with_mask(x, kernel_size, stride, padding, 3, data_format, ceil_mode)
     return _pool(x, kernel_size, stride, padding, 3, data_format, "max", -np.inf, ceil_mode)
 
 
@@ -132,3 +169,115 @@ def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
 
 def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
     return _adaptive_pool(x, output_size, 3, "NCDHW", "max")
+
+
+# ------------------------------------------------- mask pooling + unpooling
+
+
+def _max_pool_with_mask(x, kernel_size, stride, padding, n, data_format,
+                        ceil_mode=False):
+    """Max pool that also returns the flat argmax index per window
+    (ref max_poolNd(return_mask=True) contract: index into the flattened
+    input spatial volume). Channel-last layouts are transposed through the
+    channel-first kernel (flat spatial indices are layout-independent)."""
+    if data_format in ("NHWC", "NLC", "NDHWC"):
+        from ...ops import manipulation as _M
+
+        to_cf = [0, n + 1] + list(range(1, n + 1))
+        to_cl = [0] + list(range(2, n + 2)) + [1]
+        out, mask = _max_pool_with_mask(
+            _M.transpose(x, to_cf), kernel_size, stride, padding, n,
+            "NC" + "DHW"[3 - n:], ceil_mode)
+        return _M.transpose(out, to_cl), _M.transpose(mask, to_cl)
+    ksize = _norm_tuple(kernel_size, n)
+    stride_t = _norm_tuple(stride if stride is not None else kernel_size, n)
+    pad = _norm_padding(padding, n, stride_t, (1,) * n, ksize)
+    if ceil_mode:
+        # extend the high-side padding so the window count matches the
+        # ceil formula (same output shape as the non-mask path)
+        pad = list(pad)
+        for i in range(n):
+            L = x.shape[2 + i] + pad[i][0] + pad[i][1]
+            rem = (L - ksize[i]) % stride_t[i]
+            if rem:
+                pad[i] = (pad[i][0], pad[i][1] + stride_t[i] - rem)
+        pad = tuple(pad)
+
+    def _run(x, *, ksize, stride, pad):
+        import numpy as _np
+
+        N, C = x.shape[:2]
+        spatial = x.shape[2:]
+        pads = tuple(pad)
+        # finite large-negative pad: patches are conv-extracted, and
+        # -inf * 0 inside the conv would poison outputs with NaN
+        neg = jnp.finfo(jnp.float32).min / 2
+        xp = jnp.pad(x, ((0, 0), (0, 0)) + pads, constant_values=neg)
+        idx = jnp.arange(int(_np.prod(spatial))).reshape(spatial)
+        idxp = jnp.pad(idx, pads, constant_values=-1)
+
+        def patches(a, chans):
+            # a: [B, chans, *padded_spatial] -> [B, chans*K, *out_spatial]
+            return jax.lax.conv_general_dilated_patches(
+                a.astype(jnp.float32), ksize, stride, "VALID")
+
+        xpat = patches(xp.reshape(N * C, 1, *xp.shape[2:]), 1)  # [NC, K, *o]
+        ipat = patches(idxp[None, None].astype(jnp.float32), 1)  # [1, K, *o]
+        am = jnp.argmax(xpat, axis=1)                            # [NC, *o]
+        mask = jnp.take_along_axis(
+            jnp.broadcast_to(ipat, xpat.shape), am[:, None], axis=1
+        )[:, 0]
+        out = jnp.max(xpat, axis=1).astype(x.dtype)
+        out_sp = out.shape[1:]
+        return (out.reshape(N, C, *out_sp),
+                mask.astype(jnp.int32).reshape(N, C, *out_sp))
+
+    return apply(_run, (x,), dict(ksize=ksize, stride=stride_t,
+                                  pad=tuple(pad)), name="max_pool_mask")
+
+
+def _max_unpool(x, indices, output_spatial):
+    def _run(x, idx, *, out_sp):
+        import numpy as _np
+
+        N, C = x.shape[:2]
+        flat = jnp.zeros((N * C, int(_np.prod(out_sp))), x.dtype)
+        xv = x.reshape(N * C, -1)
+        iv = idx.reshape(N * C, -1)
+        rows = jnp.arange(N * C)[:, None]
+        flat = flat.at[rows, iv].set(xv)
+        return flat.reshape(N, C, *out_sp)
+
+    return apply(_run, (x, indices), {"out_sp": tuple(output_spatial)},
+                 name="max_unpool")
+
+
+def _unpool_out_spatial(in_sp, kernel_size, stride, padding, output_size, n):
+    if output_size is not None:
+        os = tuple(output_size)[-n:]
+        return os
+    k = _norm_tuple(kernel_size, n)
+    s = _norm_tuple(stride if stride is not None else kernel_size, n)
+    p = _norm_tuple(padding, n)
+    return tuple((in_sp[i] - 1) * s[i] - 2 * p[i] + k[i] for i in range(n))
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCL", name=None):
+    out_sp = _unpool_out_spatial(x.shape[2:], kernel_size, stride, padding,
+                                 output_size, 1)
+    return _max_unpool(x, indices, out_sp)
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCHW", name=None):
+    out_sp = _unpool_out_spatial(x.shape[2:], kernel_size, stride, padding,
+                                 output_size, 2)
+    return _max_unpool(x, indices, out_sp)
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCDHW", name=None):
+    out_sp = _unpool_out_spatial(x.shape[2:], kernel_size, stride, padding,
+                                 output_size, 3)
+    return _max_unpool(x, indices, out_sp)

@@ -337,3 +337,17 @@ class PixelShuffle(Layer):
 
     def forward(self, x):
         return F.pixel_shuffle(x, self._r, self._df)
+
+
+class Conv1DTranspose(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1, padding=0, output_padding=0,
+                 groups=1, dilation=1, weight_attr=None, bias_attr=None, data_format="NCL"):
+        super().__init__(in_channels, out_channels, kernel_size, 1, stride, padding, dilation, groups,
+                         "zeros", weight_attr, bias_attr, data_format, transpose=True, output_padding=output_padding)
+
+
+class Conv3DTranspose(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1, padding=0, output_padding=0,
+                 dilation=1, groups=1, weight_attr=None, bias_attr=None, data_format="NCDHW"):
+        super().__init__(in_channels, out_channels, kernel_size, 3, stride, padding, dilation, groups,
+                         "zeros", weight_attr, bias_attr, data_format, transpose=True, output_padding=output_padding)

@@ -1,0 +1,532 @@
+"""Layer classes completing the reference nn surface
+(ref:python/paddle/nn/layer/{loss,pooling,common,norm,distance,vision}.py).
+
+Thin Layer wrappers over the functional library plus a few real modules
+(Bilinear, SpectralNorm, LocalResponseNorm, max-unpool family).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+from . import functional as F
+from . import initializer as I
+from .layer import Layer
+from .layers_common import _PoolNd
+
+
+# ------------------------------------------------------------------ basics
+
+
+class Identity(Layer):
+    def __init__(self, *args, **kwargs):
+        super().__init__()
+
+    def forward(self, x):
+        return x
+
+
+class Softmax2D(Layer):
+    """Softmax over the channel axis of NCHW input."""
+
+    def forward(self, x):
+        return F.softmax(x, axis=-3)
+
+
+class Dropout3D(Layer):
+    def __init__(self, p=0.5, data_format="NCDHW", name=None):
+        super().__init__()
+        self.p = p
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.dropout3d(x, self.p, training=self.training,
+                           data_format=self.data_format)
+
+
+class AlphaDropout(Layer):
+    def __init__(self, p=0.5, name=None):
+        super().__init__()
+        self.p = p
+
+    def forward(self, x):
+        return F.alpha_dropout(x, self.p, training=self.training)
+
+
+class RReLU(Layer):
+    def __init__(self, lower=1.0 / 8.0, upper=1.0 / 3.0, name=None):
+        super().__init__()
+        self.lower, self.upper = lower, upper
+
+    def forward(self, x):
+        return F.rrelu(x, self.lower, self.upper, training=self.training)
+
+
+class CosineSimilarity(Layer):
+    def __init__(self, axis=1, eps=1e-8):
+        super().__init__()
+        self.axis, self.eps = axis, eps
+
+    def forward(self, x1, x2):
+        return F.cosine_similarity(x1, x2, axis=self.axis, eps=self.eps)
+
+
+class PairwiseDistance(Layer):
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self.p, self.epsilon, self.keepdim = p, epsilon, keepdim
+
+    def forward(self, x, y):
+        return F.pairwise_distance(x, y, self.p, self.epsilon, self.keepdim)
+
+
+class Bilinear(Layer):
+    """out[n, o] = x1[n, i] W[o, i, j] x2[n, j] + b (ref nn.Bilinear)."""
+
+    def __init__(self, in1_features, in2_features, out_features,
+                 weight_attr=None, bias_attr=None, name=None):
+        super().__init__()
+        self.weight = self.create_parameter(
+            [out_features, in1_features, in2_features], attr=weight_attr)
+        self.bias = (None if bias_attr is False
+                     else self.create_parameter([1, out_features],
+                                                attr=bias_attr, is_bias=True))
+
+    def forward(self, x1, x2):
+        args = (x1, x2, self.weight) + (
+            () if self.bias is None else (self.bias,))
+
+        def _bl(a, b, w, bias=None):
+            out = jnp.einsum("ni,oij,nj->no", a, w, b)
+            return out if bias is None else out + bias
+
+        return apply(_bl, args, {}, name="bilinear")
+
+
+class LayerDict(Layer):
+    """Dict container of sublayers (ref nn.LayerDict)."""
+
+    def __init__(self, sublayers=None):
+        super().__init__()
+        if sublayers:
+            self.update(sublayers)
+
+    def __getitem__(self, key):
+        return self._sub_layers[key]
+
+    def __setitem__(self, key, layer):
+        self.add_sublayer(key, layer)
+
+    def __delitem__(self, key):
+        del self._sub_layers[key]
+
+    def __len__(self):
+        return len(self._sub_layers)
+
+    def __iter__(self):
+        return iter(self._sub_layers)
+
+    def __contains__(self, key):
+        return key in self._sub_layers
+
+    def clear(self):
+        self._sub_layers.clear()
+
+    def pop(self, key):
+        v = self._sub_layers.pop(key)
+        return v
+
+    def keys(self):
+        return self._sub_layers.keys()
+
+    def items(self):
+        return self._sub_layers.items()
+
+    def values(self):
+        return self._sub_layers.values()
+
+    def update(self, sublayers):
+        for k, v in (sublayers.items() if isinstance(sublayers, dict)
+                     else sublayers):
+            self.add_sublayer(k, v)
+
+
+# ----------------------------------------------------------------- pooling
+
+
+class MaxPool3D(_PoolNd):
+    def __init__(self, kernel_size, stride=None, padding=0, return_mask=False,
+                 ceil_mode=False, data_format="NCDHW", name=None):
+        super().__init__(F.max_pool3d, kernel_size, stride, padding)
+
+
+class AvgPool3D(_PoolNd):
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
+                 exclusive=True, divisor_override=None, data_format="NCDHW",
+                 name=None):
+        super().__init__(F.avg_pool3d, kernel_size, stride, padding)
+
+
+class AdaptiveAvgPool3D(Layer):
+    def __init__(self, output_size, data_format="NCDHW", name=None):
+        super().__init__()
+        self._os = output_size
+
+    def forward(self, x):
+        return F.adaptive_avg_pool3d(x, self._os)
+
+
+class AdaptiveMaxPool1D(Layer):
+    def __init__(self, output_size, return_mask=False, name=None):
+        super().__init__()
+        self._os = output_size
+        self._rm = return_mask
+
+    def forward(self, x):
+        return F.adaptive_max_pool1d(x, self._os, self._rm)
+
+
+class AdaptiveMaxPool3D(Layer):
+    def __init__(self, output_size, return_mask=False, name=None):
+        super().__init__()
+        self._os = output_size
+        self._rm = return_mask
+
+    def forward(self, x):
+        return F.adaptive_max_pool3d(x, self._os, self._rm)
+
+
+class MaxUnPool1D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, data_format="NCL",
+                 output_size=None, name=None):
+        super().__init__()
+        self.k, self.s, self.p, self.os = kernel_size, stride, padding, output_size
+
+    def forward(self, x, indices, output_size=None):
+        return F.max_unpool1d(x, indices, self.k, self.s, self.p,
+                              output_size or self.os)
+
+
+class MaxUnPool2D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+        super().__init__()
+        self.k, self.s, self.p, self.os = kernel_size, stride, padding, output_size
+
+    def forward(self, x, indices, output_size=None):
+        return F.max_unpool2d(x, indices, self.k, self.s, self.p,
+                              output_size or self.os)
+
+
+class MaxUnPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+        super().__init__()
+        self.k, self.s, self.p, self.os = kernel_size, stride, padding, output_size
+
+    def forward(self, x, indices, output_size=None):
+        return F.max_unpool3d(x, indices, self.k, self.s, self.p,
+                              output_size or self.os)
+
+
+# ----------------------------------------------------------------- padding
+
+
+class Pad1D(Layer):
+    def __init__(self, padding, mode="constant", value=0.0, data_format="NCL",
+                 name=None):
+        super().__init__()
+        self.padding, self.mode, self.value = padding, mode, value
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.pad(x, self.padding, self.mode, self.value,
+                     data_format=self.data_format)
+
+
+class Pad3D(Layer):
+    def __init__(self, padding, mode="constant", value=0.0,
+                 data_format="NCDHW", name=None):
+        super().__init__()
+        self.padding, self.mode, self.value = padding, mode, value
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.pad(x, self.padding, self.mode, self.value,
+                     data_format=self.data_format)
+
+
+class ZeroPad2D(Layer):
+    def __init__(self, padding, data_format="NCHW", name=None):
+        super().__init__()
+        self.padding = padding
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.zeropad2d(x, self.padding, data_format=self.data_format)
+
+
+# ------------------------------------------------------------- vision-ish
+
+
+class ChannelShuffle(Layer):
+    def __init__(self, groups, data_format="NCHW", name=None):
+        super().__init__()
+        self.groups = groups
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.channel_shuffle(x, self.groups, self.data_format)
+
+
+class PixelUnshuffle(Layer):
+    def __init__(self, downscale_factor, data_format="NCHW", name=None):
+        super().__init__()
+        self.f = downscale_factor
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.pixel_unshuffle(x, self.f, self.data_format)
+
+
+class Fold(Layer):
+    def __init__(self, output_sizes, kernel_sizes, strides=1, paddings=0,
+                 dilations=1, name=None):
+        super().__init__()
+        self.a = (output_sizes, kernel_sizes, strides, paddings, dilations)
+
+    def forward(self, x):
+        return F.fold(x, *self.a)
+
+
+class Unfold(Layer):
+    def __init__(self, kernel_sizes, strides=1, paddings=0, dilations=1,
+                 name=None):
+        super().__init__()
+        self.a = (kernel_sizes, strides, paddings, dilations)
+
+    def forward(self, x):
+        return F.unfold(x, *self.a)
+
+
+class UpsamplingNearest2D(Layer):
+    def __init__(self, size=None, scale_factor=None, data_format="NCHW",
+                 name=None):
+        super().__init__()
+        self.size, self.scale = size, scale_factor
+
+    def forward(self, x):
+        return F.interpolate(x, size=self.size, scale_factor=self.scale,
+                             mode="nearest")
+
+
+class UpsamplingBilinear2D(Layer):
+    def __init__(self, size=None, scale_factor=None, data_format="NCHW",
+                 name=None):
+        super().__init__()
+        self.size, self.scale = size, scale_factor
+
+    def forward(self, x):
+        return F.interpolate(x, size=self.size, scale_factor=self.scale,
+                             mode="bilinear", align_corners=True)
+
+
+# -------------------------------------------------------------------- norm
+
+
+class InstanceNorm1D(Layer):
+    def __init__(self, num_features, epsilon=1e-5, momentum=0.9,
+                 weight_attr=None, bias_attr=None, data_format="NCL",
+                 name=None):
+        super().__init__()
+        self._epsilon = epsilon
+        self.scale = (None if weight_attr is False else self.create_parameter(
+            [num_features], attr=weight_attr, default_initializer=I.Constant(1.0)))
+        self.bias = (None if bias_attr is False else self.create_parameter(
+            [num_features], attr=bias_attr, is_bias=True))
+
+    def forward(self, x):
+        return F.instance_norm(x, weight=self.scale, bias=self.bias,
+                               eps=self._epsilon)
+
+
+class InstanceNorm3D(InstanceNorm1D):
+    def __init__(self, num_features, epsilon=1e-5, momentum=0.9,
+                 weight_attr=None, bias_attr=None, data_format="NCDHW",
+                 name=None):
+        super().__init__(num_features, epsilon, momentum, weight_attr,
+                         bias_attr)
+
+
+class LocalResponseNorm(Layer):
+    """Cross-channel LRN (ref nn.LocalResponseNorm semantics)."""
+
+    def __init__(self, size, alpha=1e-4, beta=0.75, k=1.0,
+                 data_format="NCHW", name=None):
+        super().__init__()
+        self.size, self.alpha, self.beta, self.k = size, alpha, beta, k
+
+    def forward(self, x):
+        def _lrn(x, *, size, alpha, beta, k):
+            sq = jnp.square(x)
+            half = size // 2
+            pads = [(0, 0)] * x.ndim
+            pads[1] = (half, size - half - 1)
+            sq = jnp.pad(sq, pads)
+            # sliding-window sum over channels
+            acc = sum(
+                jax.lax.slice_in_dim(sq, i, i + x.shape[1], axis=1)
+                for i in range(size)
+            )
+            return x / jnp.power(k + alpha * acc / size, beta)
+
+        return apply(_lrn, (x,), {"size": int(self.size),
+                                  "alpha": float(self.alpha),
+                                  "beta": float(self.beta),
+                                  "k": float(self.k)}, name="lrn")
+
+
+class SpectralNorm(Layer):
+    """Power-iteration spectral normalization of a weight tensor
+    (ref nn.SpectralNorm: returns W / sigma_max)."""
+
+    def __init__(self, weight_shape, dim=0, power_iters=1, eps=1e-12,
+                 dtype="float32"):
+        super().__init__()
+        self.dim, self.power_iters, self.eps = dim, power_iters, eps
+        h = weight_shape[dim]
+        w = int(np.prod(weight_shape)) // h
+        self.weight_u = self.create_parameter(
+            [h], dtype=dtype, default_initializer=I.Normal(0.0, 1.0))
+        self.weight_v = self.create_parameter(
+            [w], dtype=dtype, default_initializer=I.Normal(0.0, 1.0))
+        self.weight_u.stop_gradient = True
+        self.weight_v.stop_gradient = True
+
+    def forward(self, weight):
+        def _sn(w, u, v, *, dim, iters, eps):
+            perm = (dim,) + tuple(i for i in range(w.ndim) if i != dim)
+            mat = jnp.transpose(w, perm).reshape(w.shape[dim], -1)
+            for _ in range(iters):
+                v = mat.T @ u
+                v = v / (jnp.linalg.norm(v) + eps)
+                u = mat @ v
+                u = u / (jnp.linalg.norm(u) + eps)
+            sigma = u @ mat @ v
+            return w / sigma
+
+        return apply(_sn, (weight, self.weight_u, self.weight_v),
+                     {"dim": int(self.dim), "iters": int(self.power_iters),
+                      "eps": float(self.eps)}, name="spectral_norm")
+
+
+# ------------------------------------------------------------------ losses
+
+
+class _LossLayer(Layer):
+    def __init__(self, fn, **kw):
+        super().__init__()
+        self._fn = fn
+        self._kw = kw
+
+    def forward(self, *args):
+        return self._fn(*args, **self._kw)
+
+
+class CTCLoss(_LossLayer):
+    def __init__(self, blank=0, reduction="mean"):
+        super().__init__(F.ctc_loss, blank=blank, reduction=reduction)
+
+    def forward(self, log_probs, labels, input_lengths, label_lengths,
+                norm_by_times=False):
+        return F.ctc_loss(log_probs, labels, input_lengths, label_lengths,
+                          norm_by_times=norm_by_times, **self._kw)
+
+
+class RNNTLoss(_LossLayer):
+    def __init__(self, blank=0, fastemit_lambda=0.0, reduction="mean",
+                 name=None):
+        super().__init__(F.rnnt_loss, blank=blank,
+                         fastemit_lambda=fastemit_lambda, reduction=reduction)
+
+
+class MarginRankingLoss(_LossLayer):
+    def __init__(self, margin=0.0, reduction="mean", name=None):
+        super().__init__(F.margin_ranking_loss, margin=margin,
+                         reduction=reduction)
+
+
+class HingeEmbeddingLoss(_LossLayer):
+    def __init__(self, margin=1.0, reduction="mean", name=None):
+        super().__init__(F.hinge_embedding_loss, margin=margin,
+                         reduction=reduction)
+
+
+class CosineEmbeddingLoss(_LossLayer):
+    def __init__(self, margin=0.0, reduction="mean", name=None):
+        super().__init__(F.cosine_embedding_loss, margin=margin,
+                         reduction=reduction)
+
+
+class TripletMarginLoss(_LossLayer):
+    def __init__(self, margin=1.0, p=2.0, epsilon=1e-6, swap=False,
+                 reduction="mean", name=None):
+        super().__init__(F.triplet_margin_loss, margin=margin, p=p,
+                         epsilon=epsilon, swap=swap, reduction=reduction)
+
+
+class TripletMarginWithDistanceLoss(_LossLayer):
+    def __init__(self, distance_function=None, margin=1.0, swap=False,
+                 reduction="mean", name=None):
+        super().__init__(F.triplet_margin_with_distance_loss,
+                         distance_function=distance_function, margin=margin,
+                         swap=swap, reduction=reduction)
+
+
+class SoftMarginLoss(_LossLayer):
+    def __init__(self, reduction="mean", name=None):
+        super().__init__(F.soft_margin_loss, reduction=reduction)
+
+
+class MultiMarginLoss(_LossLayer):
+    def __init__(self, p=1, margin=1.0, weight=None, reduction="mean",
+                 name=None):
+        super().__init__(F.multi_margin_loss, p=p, margin=margin,
+                         weight=weight, reduction=reduction)
+
+
+class MultiLabelSoftMarginLoss(_LossLayer):
+    def __init__(self, weight=None, reduction="mean", name=None):
+        super().__init__(F.multi_label_soft_margin_loss, weight=weight,
+                         reduction=reduction)
+
+
+class PoissonNLLLoss(_LossLayer):
+    def __init__(self, log_input=True, full=False, epsilon=1e-8,
+                 reduction="mean", name=None):
+        super().__init__(F.poisson_nll_loss, log_input=log_input, full=full,
+                         epsilon=epsilon, reduction=reduction)
+
+
+class GaussianNLLLoss(_LossLayer):
+    def __init__(self, full=False, epsilon=1e-6, reduction="mean", name=None):
+        super().__init__(F.gaussian_nll_loss, full=full, epsilon=epsilon,
+                         reduction=reduction)
+
+
+class HSigmoidLoss(Layer):
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False, name=None):
+        super().__init__()
+        self.num_classes = num_classes
+        self.weight = self.create_parameter(
+            [num_classes - 1, feature_size], attr=weight_attr)
+        self.bias = (None if bias_attr is False else self.create_parameter(
+            [num_classes - 1], attr=bias_attr, is_bias=True))
+
+    def forward(self, input, label, path_table=None, path_code=None):
+        return F.hsigmoid_loss(input, label, self.num_classes, self.weight,
+                               self.bias, path_table, path_code)

@@ -248,3 +248,97 @@ class LSTM(_RNNBase):
 class GRU(_RNNBase):
     MODE = "GRU"
     GATES = 3
+
+
+class RNN(Layer):
+    """Wrap a single cell into a sequence scan (ref nn.RNN)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None, **kwargs):
+        if sequence_length is not None:
+            raise NotImplementedError("sequence_length unsupported; mask outputs")
+        from ..ops import manipulation as M
+
+        x = inputs if self.time_major else M.transpose(inputs, [1, 0, 2])
+        steps = range(x.shape[0])
+        if self.is_reverse:
+            steps = reversed(list(steps))
+        states = initial_states
+        outs = []
+        for t in steps:
+            out, states = self.cell(x[t], states)
+            outs.append(out)
+        if self.is_reverse:
+            outs = outs[::-1]
+        y = M.stack(outs, axis=0)
+        if not self.time_major:
+            y = M.transpose(y, [1, 0, 2])
+        return y, states
+
+
+class BiRNN(Layer):
+    """Forward + backward cells over the sequence (ref nn.BiRNN)."""
+
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, is_reverse=False, time_major=time_major)
+        self.rnn_bw = RNN(cell_bw, is_reverse=True, time_major=time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None, **kwargs):
+        fw0, bw0 = (initial_states if initial_states is not None else (None, None))
+        yf, sf = self.rnn_fw(inputs, fw0, sequence_length)
+        yb, sb = self.rnn_bw(inputs, bw0, sequence_length)
+        from ..ops import manipulation as M
+
+        return M.concat([yf, yb], axis=-1), (sf, sb)
+
+
+class BeamSearchDecoder(Layer):
+    """Greedy/beam decoding driver state (ref nn.BeamSearchDecoder). The
+    compiled-decode path lives in dynamic_decode; this class carries the
+    cell + projection and per-step logic."""
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        super().__init__()
+        self.cell = cell
+        self.start_token = start_token
+        self.end_token = end_token
+        self.beam_size = beam_size
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=32, **kwargs):
+    """Greedy decode loop over a BeamSearchDecoder (beam_size=1 path of the
+    reference's dynamic_decode; beam>1 tracks the best beam greedily)."""
+    import numpy as np
+
+    from ..core.tensor import Tensor
+    from ..ops import manipulation as M
+
+    cell = decoder.cell
+    states = inits
+    token = None
+    outputs = []
+    for _ in range(int(max_step_num)):
+        if token is None:
+            import jax.numpy as jnp
+
+            token = Tensor(jnp.asarray(decoder.start_token))
+        inp = decoder.embedding_fn(token) if decoder.embedding_fn else token
+        out, states = cell(inp, states)
+        logits = decoder.output_fn(out) if decoder.output_fn else out
+        from ..ops import math as MM
+
+        token = MM.argmax(logits, axis=-1)
+        outputs.append(token)
+        tok_np = np.asarray(token._data)
+        if np.all(tok_np == decoder.end_token):
+            break
+    return M.stack(outputs, axis=-1), states

@@ -6,6 +6,7 @@ dispatch applies here too.
 """
 from __future__ import annotations
 
+import collections
 from typing import Optional
 
 from . import functional as F
@@ -31,21 +32,97 @@ class MultiHeadAttention(Layer):
         self.v_proj = Linear(vdim, embed_dim, weight_attr, bias_attr)
         self.out_proj = Linear(embed_dim, embed_dim, weight_attr, bias_attr)
 
+    # reference cache contract (ref:python/paddle/nn/layer/transformer.py:155):
+    # k/v cached as [batch, num_heads, length, head_dim]
+    Cache = collections.namedtuple("Cache", ["k", "v"])
+    StaticCache = collections.namedtuple("StaticCache", ["k", "v"])
+
+    def compute_kv(self, key, value):
+        """Project key/value to the cache layout [b, h, s, d]."""
+        b, sk = key.shape[0], key.shape[1]
+        k = self.k_proj(key).reshape([b, sk, self.num_heads, self.head_dim])
+        v = self.v_proj(value).reshape([b, sk, self.num_heads, self.head_dim])
+        return k.transpose([0, 2, 1, 3]), v.transpose([0, 2, 1, 3])
+
+    def gen_cache(self, key, value=None, type=None):
+        """Produce the inference cache: StaticCache precomputes k/v from the
+        encoder memory (cross attention); Cache starts empty (or wraps given
+        k/v) for incremental decoder self-attention."""
+        type = type or MultiHeadAttention.Cache
+        if type is MultiHeadAttention.StaticCache:
+            k, v = self.compute_kv(key, value if value is not None else key)
+            return self.StaticCache(k, v)
+        if value is None:
+            b = key.shape[0]
+            from ..ops import creation
+
+            empty = creation.zeros(
+                [b, self.num_heads, 0, self.head_dim],
+                dtype=str(key.dtype).replace("paddle.", ""))
+            return self.Cache(empty, empty)
+        return self.Cache(key, value)
+
     def forward(self, query, key=None, value=None, attn_mask=None, cache=None):
         key = query if key is None else key
         value = key if value is None else value
+        had_cache = cache is not None
         b, sq = query.shape[0], query.shape[1]
-        sk = key.shape[1]
         q = self.q_proj(query).reshape([b, sq, self.num_heads, self.head_dim])
-        k = self.k_proj(key).reshape([b, sk, self.num_heads, self.head_dim])
-        v = self.v_proj(value).reshape([b, sk, self.num_heads, self.head_dim])
-        out = F.scaled_dot_product_attention(
-            q, k, v, attn_mask=attn_mask,
-            dropout_p=self.dropout if self.training else 0.0,
-            training=self.training,
-        )
+        if isinstance(cache, self.StaticCache):
+            k_c, v_c = cache.k, cache.v
+        else:
+            k_c, v_c = self.compute_kv(key, value)
+        if isinstance(cache, self.Cache):
+            from ..ops import manipulation as M
+
+            k_c = M.concat([cache.k, k_c], axis=2)
+            v_c = M.concat([cache.v, v_c], axis=2)
+            cache = self.Cache(k_c, v_c)
+        # sdpa layout [b, s, h, d]
+        k = k_c.transpose([0, 2, 1, 3])
+        v = v_c.transpose([0, 2, 1, 3])
+        weights = None
+        if self.need_weights:
+            # explicit-probs path: materialize [b, h, q, k] attention weights
+            import math as _math
+
+            import jax
+            import jax.numpy as jnp
+
+            from ..core.dispatch import apply as _apply
+
+            def _attn_w(qa, ka, va, *rest):
+                # qa/ka/va in [b, s, h, d]
+                m = rest[0] if rest else None
+                qt, kt, vt = (jnp.swapaxes(t, 1, 2) for t in (qa, ka, va))
+                logits = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) / _math.sqrt(
+                    qa.shape[-1])
+                if m is not None:
+                    logits = (jnp.where(m, logits, -1e30)
+                              if m.dtype == jnp.bool_ else logits + m)
+                p = jax.nn.softmax(logits.astype(jnp.float32), -1).astype(
+                    qa.dtype)
+                o = jnp.swapaxes(jnp.einsum("bhqk,bhkd->bhqd", p, vt), 1, 2)
+                return o, p
+
+            args = (q, k, v)
+            if attn_mask is not None:
+                args += (attn_mask,)
+            out, weights = _apply(_attn_w, args, {}, name="mha_with_weights")
+        else:
+            out = F.scaled_dot_product_attention(
+                q, k, v, attn_mask=attn_mask,
+                dropout_p=self.dropout if self.training else 0.0,
+                training=self.training,
+            )
         out = out.reshape([b, sq, self.embed_dim])
-        return self.out_proj(out)
+        out = self.out_proj(out)
+        outs = [out]
+        if self.need_weights:
+            outs.append(weights)
+        if had_cache:
+            outs.append(cache)
+        return out if len(outs) == 1 else tuple(outs)
 
 
 class TransformerEncoderLayer(Layer):
@@ -69,10 +146,16 @@ class TransformerEncoderLayer(Layer):
     def _act(self, x):
         return F.gelu(x) if self.activation == "gelu" else F.relu(x)
 
+    def gen_cache(self, src):
+        return self.self_attn.gen_cache(src)
+
     def forward(self, src, src_mask=None, cache=None):
         residual = src
         x = self.norm1(src) if self.normalize_before else src
-        x = self.self_attn(x, attn_mask=src_mask)
+        if cache is None:
+            x = self.self_attn(x, attn_mask=src_mask)
+        else:
+            x, new_cache = self.self_attn(x, attn_mask=src_mask, cache=cache)
         x = residual + self.dropout1(x)
         if not self.normalize_before:
             x = self.norm1(x)
@@ -82,7 +165,7 @@ class TransformerEncoderLayer(Layer):
         y = residual + self.dropout(y)
         if not self.normalize_before:
             y = self.norm2(y)
-        return y
+        return y if cache is None else (y, new_cache)
 
 
 class TransformerEncoder(Layer):
@@ -105,13 +188,21 @@ class TransformerEncoder(Layer):
 
         self.layers = LayerList([factory(i) for i in range(num_layers)])
 
-    def forward(self, src, src_mask=None):
+    def gen_cache(self, src):
+        return [layer.gen_cache(src) for layer in self.layers]
+
+    def forward(self, src, src_mask=None, cache=None):
         out = src
-        for layer in self.layers:
-            out = layer(out, src_mask=src_mask)
+        new_caches = []
+        for i, layer in enumerate(self.layers):
+            if cache is None:
+                out = layer(out, src_mask=src_mask)
+            else:
+                out, nc = layer(out, src_mask=src_mask, cache=cache[i])
+                new_caches.append(nc)
         if self.norm is not None:
             out = self.norm(out)
-        return out
+        return out if cache is None else (out, new_caches)
 
 
 class TransformerDecoderLayer(Layer):
@@ -135,15 +226,35 @@ class TransformerDecoderLayer(Layer):
     def _act(self, x):
         return F.gelu(x) if self.activation == "gelu" else F.relu(x)
 
+    def gen_cache(self, memory):
+        """(incremental self-attn cache, static cross-attn cache) — the
+        reference decoder-layer cache pair."""
+        inc = self.self_attn.gen_cache(memory)
+        static = self.cross_attn.gen_cache(
+            memory, memory, type=MultiHeadAttention.StaticCache)
+        return inc, static
+
     def forward(self, tgt, memory, tgt_mask=None, memory_mask=None, cache=None):
         residual = tgt
         x = self.norm1(tgt) if self.normalize_before else tgt
-        x = residual + self.dropout(self.self_attn(x, attn_mask=tgt_mask))
+        if cache is None:
+            x = residual + self.dropout(self.self_attn(x, attn_mask=tgt_mask))
+        else:
+            attn_out, new_inc = self.self_attn(x, attn_mask=tgt_mask,
+                                               cache=cache[0])
+            x = residual + self.dropout(attn_out)
         if not self.normalize_before:
             x = self.norm1(x)
         residual = x
         y = self.norm2(x) if self.normalize_before else x
-        y = residual + self.dropout(self.cross_attn(y, memory, memory, attn_mask=memory_mask))
+        if cache is None:
+            y = residual + self.dropout(
+                self.cross_attn(y, memory, memory, attn_mask=memory_mask))
+        else:
+            cross_out, _ = self.cross_attn(y, memory, memory,
+                                           attn_mask=memory_mask,
+                                           cache=cache[1])
+            y = residual + self.dropout(cross_out)
         if not self.normalize_before:
             y = self.norm2(y)
         residual = y
@@ -151,7 +262,7 @@ class TransformerDecoderLayer(Layer):
         z = residual + self.dropout(self.linear2(self._act(self.linear1(z))))
         if not self.normalize_before:
             z = self.norm3(z)
-        return z
+        return z if cache is None else (z, (new_inc, cache[1]))
 
 
 class TransformerDecoder(Layer):
@@ -164,13 +275,27 @@ class TransformerDecoder(Layer):
         self.layers = LayerList([copy.deepcopy(decoder_layer) for _ in range(num_layers)])
         self.norm = norm
 
-    def forward(self, tgt, memory, tgt_mask=None, memory_mask=None):
+    def gen_cache(self, memory, do_zip=False):
+        """Per-layer (incremental, static) cache pairs; do_zip transposes to
+        the reference's zipped layout."""
+        caches = [layer.gen_cache(memory) for layer in self.layers]
+        return list(zip(*caches)) if do_zip else caches
+
+    def forward(self, tgt, memory, tgt_mask=None, memory_mask=None,
+                cache=None):
         out = tgt
-        for layer in self.layers:
-            out = layer(out, memory, tgt_mask=tgt_mask, memory_mask=memory_mask)
+        new_caches = []
+        for i, layer in enumerate(self.layers):
+            if cache is None:
+                out = layer(out, memory, tgt_mask=tgt_mask,
+                            memory_mask=memory_mask)
+            else:
+                out, nc = layer(out, memory, tgt_mask=tgt_mask,
+                                memory_mask=memory_mask, cache=cache[i])
+                new_caches.append(nc)
         if self.norm is not None:
             out = self.norm(out)
-        return out
+        return out if cache is None else (out, new_caches)
 
 
 class Transformer(Layer):

@@ -1,0 +1,26 @@
+"""paddle.onnx (ref:python/paddle/onnx/export.py wrapping paddle2onnx).
+
+This stack's portable serialization is StableHLO (jit.save) — the
+MLIR-standard exchange format for XLA-compiled models. ``export`` writes
+that artifact; true ONNX emission would need the onnx package + a
+StableHLO->ONNX converter, neither of which ships in this environment.
+"""
+from __future__ import annotations
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    """Export ``layer`` as a deployable artifact.
+
+    Writes the StableHLO program + weights via jit.save at ``path`` and
+    raises afterwards if a real .onnx file was expected (the reference
+    depends on the external paddle2onnx package)."""
+    from ..jit import save as jit_save
+
+    jit_save(layer, path, input_spec=input_spec)
+    import warnings
+
+    warnings.warn(
+        "paddle.onnx.export wrote a StableHLO artifact (the portable format "
+        "of this stack); ONNX emission needs paddle2onnx which is not "
+        "available here", stacklevel=2)
+    return path

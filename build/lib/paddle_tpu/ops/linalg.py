@@ -111,8 +111,9 @@ def qr(x, mode="reduced", name=None):
 
 def svd(x, full_matrices=False, name=None):
     def _svd(x, *, full_matrices):
-        u, s, vh = jnp.linalg.svd(x, full_matrices=full_matrices)
-        return u, s, jnp.swapaxes(vh, -1, -2).conj()  # paddle returns V, not V^H
+        # paddle contract (ref:python/paddle/tensor/linalg.py:1926): the
+        # third output IS the conjugate transpose V^H, as in numpy/jax
+        return jnp.linalg.svd(x, full_matrices=full_matrices)
 
     return apply(_svd, (x,), dict(full_matrices=bool(full_matrices)))
 

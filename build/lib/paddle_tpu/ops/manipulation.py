@@ -399,9 +399,11 @@ def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
                 spatial = list(range(2, nd))
             elif data_format in ("NHWC", "NLC", "NDHWC"):
                 spatial = list(range(1, nd - 1))
+            # paddle/torch contract: the FIRST (left, right) pair pads the
+            # LAST spatial dim, the next pair the one before it, ...
             k = len(pad) // 2
             for j in range(k):
-                width[spatial[-(j + 1)]] = (pad[2 * (k - 1 - j)], pad[2 * (k - 1 - j) + 1])
+                width[spatial[-(j + 1)]] = (pad[2 * j], pad[2 * j + 1])
         if mode == "constant":
             return jnp.pad(x, width, constant_values=value)
         jmode = {"reflect": "reflect", "replicate": "edge", "circular": "wrap"}[mode]
@@ -464,10 +466,21 @@ def topk(x, k, axis=None, largest=True, sorted=True, name=None):
 
 
 def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
-    def _searchsorted(s, v, *, side):
-        return jnp.searchsorted(s, v, side=side)
+    def _searchsorted(s, v, *, side, int32):
+        if s.ndim > 1:
+            # paddle contract: row-wise search over the innermost dim —
+            # leading dims of sequence and values must match
+            flat_s = s.reshape((-1, s.shape[-1]))
+            flat_v = v.reshape((-1, v.shape[-1]))
+            out = jax.vmap(lambda a, b: jnp.searchsorted(a, b, side=side))(
+                flat_s, flat_v).reshape(v.shape)
+        else:
+            out = jnp.searchsorted(s, v, side=side)
+        return out.astype(jnp.int32) if int32 else out.astype(jnp.int64)
 
-    return apply(_searchsorted, (sorted_sequence, values), dict(side="right" if right else "left"), differentiable=False)
+    return apply(_searchsorted, (sorted_sequence, values),
+                 dict(side="right" if right else "left",
+                      int32=bool(out_int32)), differentiable=False)
 
 
 def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):

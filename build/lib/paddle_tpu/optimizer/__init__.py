@@ -1,5 +1,6 @@
 """paddle.optimizer equivalent."""
 from . import lr  # noqa: F401
+from .lbfgs import LBFGS  # noqa: F401
 from .optimizer import (  # noqa: F401
     SGD,
     Adadelta,

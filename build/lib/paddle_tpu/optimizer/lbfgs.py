@@ -1,0 +1,155 @@
+"""L-BFGS optimizer (ref:python/paddle/optimizer/lbfgs.py:308 LBFGS).
+
+Closure-driven full-batch optimizer: ``step(closure)`` re-evaluates the loss
+as the strong-Wolfe line search probes points along the two-loop-recursion
+direction. History (s, y) pairs live on host as jax arrays; the direction
+computation is numpy-light Python over a handful of vectors, matching the
+reference's flat-tensor implementation strategy.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from .optimizer import Optimizer
+
+
+def _flatten(tensors):
+    return jnp.concatenate([jnp.ravel(t) for t in tensors])
+
+
+class LBFGS(Optimizer):
+    def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None,
+                 tolerance_grad=1e-7, tolerance_change=1e-9, history_size=100,
+                 line_search_fn=None, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self.max_iter = max_iter
+        self.max_eval = max_eval if max_eval is not None else max_iter * 5 // 4
+        self.tolerance_grad = tolerance_grad
+        self.tolerance_change = tolerance_change
+        self.history_size = history_size
+        self.line_search_fn = line_search_fn
+        self._s: List = []
+        self._y: List = []
+        self._prev_flat_grad = None
+
+    # -- flat views --------------------------------------------------------
+    def _gather(self):
+        return [p._data for p in self._parameter_list]
+
+    def _grads(self):
+        gs = []
+        for p in self._parameter_list:
+            if p.grad is None:
+                gs.append(jnp.zeros_like(p._data))
+            else:
+                gs.append(p.grad._data)
+        return gs
+
+    def _scatter(self, flat):
+        off = 0
+        for p in self._parameter_list:
+            n = int(np.prod(p._data.shape)) if p._data.shape else 1
+            p._data = flat[off:off + n].reshape(p._data.shape).astype(p._data.dtype)
+            off += n
+
+    def _direction(self, flat_grad):
+        """Two-loop recursion over the (s, y) history."""
+        q = flat_grad
+        alphas = []
+        rhos = [1.0 / float(jnp.vdot(y, s)) for s, y in zip(self._s, self._y)]
+        for (s, y), rho in zip(reversed(list(zip(self._s, self._y))),
+                               reversed(rhos)):
+            a = rho * float(jnp.vdot(s, q))
+            alphas.append(a)
+            q = q - a * y
+        if self._y:
+            s, y = self._s[-1], self._y[-1]
+            gamma = float(jnp.vdot(s, y) / jnp.maximum(jnp.vdot(y, y), 1e-20))
+            q = q * gamma
+        for (s, y), rho, a in zip(zip(self._s, self._y), rhos,
+                                  reversed(alphas)):
+            b = rho * float(jnp.vdot(y, q))
+            q = q + s * (a - b)
+        return -q
+
+    def step(self, closure=None):
+        if closure is None:
+            raise ValueError("LBFGS.step requires a closure re-evaluating the loss")
+        lr = self.get_lr()
+        loss = closure()
+        loss_val = float(np.asarray(loss._data))
+        n_eval = 1
+
+        for _ in range(self.max_iter):
+            flat_grad = _flatten(self._grads())
+            if float(jnp.abs(flat_grad).max()) <= self.tolerance_grad:
+                break
+            d = self._direction(flat_grad)
+            x0 = _flatten(self._gather())
+            gtd = float(jnp.vdot(flat_grad, d))
+            if gtd > -1e-15:  # not a descent direction: reset history
+                self._s.clear()
+                self._y.clear()
+                d = -flat_grad
+                gtd = float(jnp.vdot(flat_grad, d))
+
+            t = lr if self._y else min(1.0, 1.0 / max(float(jnp.abs(flat_grad).sum()), 1e-12)) * lr
+
+            if self.line_search_fn == "strong_wolfe":
+                c1, c2 = 1e-4, 0.9
+                t_ok = None
+                for _ls in range(20):
+                    self._scatter(x0 + t * d)
+                    self.clear_grad()
+                    new_loss = closure()
+                    n_eval += 1
+                    nl = float(np.asarray(new_loss._data))
+                    new_grad = _flatten(self._grads())
+                    if nl > loss_val + c1 * t * gtd:
+                        t *= 0.5
+                    elif float(jnp.vdot(new_grad, d)) < c2 * gtd:
+                        t *= 2.1
+                    else:
+                        t_ok = t
+                        break
+                    if n_eval >= self.max_eval:
+                        break
+                if t_ok is None:
+                    self._scatter(x0 + t * d)
+                    self.clear_grad()
+                    new_loss = closure()
+                    n_eval += 1
+            else:
+                self._scatter(x0 + t * d)
+                self.clear_grad()
+                new_loss = closure()
+                n_eval += 1
+
+            new_flat_grad = _flatten(self._grads())
+            s = _flatten(self._gather()) - x0
+            y = new_flat_grad - flat_grad
+            if float(jnp.vdot(s, y)) > 1e-10:
+                self._s.append(s)
+                self._y.append(y)
+                if len(self._s) > self.history_size:
+                    self._s.pop(0)
+                    self._y.pop(0)
+            new_val = float(np.asarray(new_loss._data))
+            if abs(new_val - loss_val) < self.tolerance_change:
+                loss_val = new_val
+                loss = new_loss
+                break
+            loss_val = new_val
+            loss = new_loss
+            if n_eval >= self.max_eval:
+                break
+        return loss
+
+    def clear_grad(self):
+        for p in self._parameter_list:
+            p.grad = None

@@ -225,3 +225,53 @@ class OneCycleLR(LRScheduler):
             return self.initial_lr + (self.max_lr - self.initial_lr) * (1 - math.cos(math.pi * pct)) / 2
         pct = (step - up) / max(self.total_steps - up, 1)
         return self.end_lr + (self.max_lr - self.end_lr) * (1 + math.cos(math.pi * pct)) / 2
+
+
+class MultiplicativeDecay(LRScheduler):
+    """lr *= lr_lambda(epoch) each step (ref lr.MultiplicativeDecay)."""
+
+    def __init__(self, learning_rate, lr_lambda, last_epoch=-1, verbose=False):
+        self.lr_lambda = lr_lambda
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def get_lr(self):
+        if self.last_epoch > 0:
+            return self.last_lr * self.lr_lambda(self.last_epoch)
+        return self.base_lr
+
+
+class CyclicLR(LRScheduler):
+    """Triangular / triangular2 / exp_range cyclic schedule
+    (ref lr.CyclicLR)."""
+
+    def __init__(self, base_learning_rate, max_learning_rate,
+                 step_size_up=2000, step_size_down=None, mode="triangular",
+                 exp_gamma=1.0, scale_fn=None, scale_mode="cycle",
+                 last_epoch=-1, verbose=False):
+        self.max_lr = max_learning_rate
+        self.step_size_up = step_size_up
+        self.step_size_down = step_size_down or step_size_up
+        self.mode = mode
+        self.exp_gamma = exp_gamma
+        self.scale_fn = scale_fn
+        self.scale_mode = scale_mode
+        super().__init__(base_learning_rate, last_epoch, verbose)
+
+    def get_lr(self):
+        total = self.step_size_up + self.step_size_down
+        it = max(self.last_epoch, 0)
+        cycle = it // total
+        pos = it - cycle * total
+        if pos < self.step_size_up:
+            frac = pos / self.step_size_up
+        else:
+            frac = 1.0 - (pos - self.step_size_up) / self.step_size_down
+        amp = (self.max_lr - self.base_lr) * frac
+        if self.scale_fn is not None:
+            x = cycle + 1 if self.scale_mode == "cycle" else it
+            return self.base_lr + amp * self.scale_fn(x)
+        if self.mode == "triangular2":
+            amp = amp / (2.0 ** cycle)
+        elif self.mode == "exp_range":
+            amp = amp * (self.exp_gamma ** it)
+        return self.base_lr + amp

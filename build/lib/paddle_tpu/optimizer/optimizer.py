@@ -30,6 +30,13 @@ class Optimizer:
             parameters = list(parameters)
         self._parameter_list = parameters
         self._learning_rate = learning_rate
+        # weight_decay: float (L2), or a paddle.regularizer instance —
+        # L1Decay flips _wd_l1 so the decay term becomes coeff*sign(param)
+        from ..regularizer import L1Decay, L2Decay
+
+        self._wd_l1 = isinstance(weight_decay, L1Decay)
+        if isinstance(weight_decay, (L1Decay, L2Decay)):
+            weight_decay = weight_decay.coeff
         self._weight_decay = 0.0 if weight_decay is None else weight_decay
         self._grad_clip = grad_clip
         self._accumulators: Dict[int, Dict[str, jax.Array]] = {}
@@ -85,9 +92,25 @@ class Optimizer:
 
     clear_gradients = clear_grad
 
+    @property
+    def _wd_key(self) -> float:
+        """Weight decay encoded for the jit cache key: negative == L1."""
+        wd = float(self._weight_decay or 0.0) if not callable(
+            self._weight_decay) else 0.0
+        return -wd if getattr(self, "_wd_l1", False) else wd
+
+    def _decay_grad(self, grad, param):
+        """Add the regularization term to a gradient (L2: coeff*param,
+        L1: coeff*sign(param))."""
+        if not self._weight_decay:
+            return grad
+        if getattr(self, "_wd_l1", False):
+            return grad + self._weight_decay * jnp.sign(param)
+        return grad + self._weight_decay * param
+
     def _hyper_key(self):
         """Hashable hyperparameters closed over by the jitted update."""
-        return (float(self._weight_decay) if not callable(self._weight_decay) else 0.0,)
+        return (self._wd_key,)
 
     # ------------------------------------------------------ functional path
     def init_state(self, params: Dict[str, Tensor]):
@@ -165,7 +188,9 @@ def _jit_update(cls, hyper_key):
     opt = cls.__new__(cls)
     Optimizer.__init__(opt, learning_rate=0.0)
     opt._hyper = hyper_key
-    opt._weight_decay = hyper_key[0] if hyper_key else 0.0
+    wd = hyper_key[0] if hyper_key else 0.0
+    opt._wd_l1 = wd < 0
+    opt._weight_decay = abs(wd)
     for attr, val in zip(cls._hyper_names, hyper_key[1:] if cls._hyper_names else ()):
         setattr(opt, attr, val)
 
@@ -181,8 +206,7 @@ class SGD(Optimizer):
     _hyper_names: List[str] = []
 
     def _update(self, param, grad, slots, lr, step):
-        if self._weight_decay:
-            grad = grad + self._weight_decay * param
+        grad = self._decay_grad(grad, param)
         return (param - lr.astype(param.dtype) * grad).astype(param.dtype), slots
 
 
@@ -196,11 +220,10 @@ class Momentum(Optimizer):
         self._use_nesterov = use_nesterov
 
     def _hyper_key(self):
-        return (float(self._weight_decay or 0.0), float(self._momentum), bool(self._use_nesterov))
+        return (self._wd_key, float(self._momentum), bool(self._use_nesterov))
 
     def _update(self, param, grad, slots, lr, step):
-        if self._weight_decay:
-            grad = grad + self._weight_decay * param
+        grad = self._decay_grad(grad, param)
         v = self._momentum * slots["velocity"] + grad
         if self._use_nesterov:
             new_p = param - lr.astype(param.dtype) * (grad + self._momentum * v)
@@ -219,13 +242,12 @@ class Adam(Optimizer):
         self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
 
     def _hyper_key(self):
-        return (float(self._weight_decay or 0.0), float(self._beta1), float(self._beta2), float(self._epsilon))
+        return (self._wd_key, float(self._beta1), float(self._beta2), float(self._epsilon))
 
     def _update(self, param, grad, slots, lr, step):
         f32 = jnp.float32
         g = grad.astype(f32)
-        if self._weight_decay:
-            g = g + self._weight_decay * param.astype(f32)
+        g = self._decay_grad(g, param.astype(f32))
         m = self._beta1 * slots["moment1"] + (1 - self._beta1) * g
         v = self._beta2 * slots["moment2"] + (1 - self._beta2) * jnp.square(g)
         t = step.astype(f32)
@@ -245,7 +267,12 @@ class AdamW(Adam):
                  weight_decay=0.01, lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
                  lazy_mode=False, multi_precision=False, name=None):
         super().__init__(learning_rate, beta1, beta2, epsilon, parameters, None, grad_clip, name=name)
-        self._weight_decay = weight_decay
+        from ..regularizer import L1Decay, L2Decay
+
+        self._wd_l1 = isinstance(weight_decay, L1Decay)
+        if isinstance(weight_decay, (L1Decay, L2Decay)):
+            weight_decay = weight_decay.coeff
+        self._weight_decay = weight_decay or 0.0
         self._apply_decay_param_fun = apply_decay_param_fun
 
     def _update(self, param, grad, slots, lr, step):
@@ -257,7 +284,9 @@ class AdamW(Adam):
         m_hat = m / (1 - self._beta1**t)
         v_hat = v / (1 - self._beta2**t)
         p32 = param.astype(f32)
-        new_p = p32 - lr * (m_hat / (jnp.sqrt(v_hat) + self._epsilon) + self._weight_decay * p32)
+        decay_dir = jnp.sign(p32) if getattr(self, "_wd_l1", False) else p32
+        new_p = p32 - lr * (m_hat / (jnp.sqrt(v_hat) + self._epsilon)
+                            + self._weight_decay * decay_dir)
         return new_p.astype(param.dtype), {"moment1": m, "moment2": v}
 
 
@@ -272,15 +301,14 @@ class Adagrad(Optimizer):
         self._initial_accumulator_value = initial_accumulator_value
 
     def _hyper_key(self):
-        return (float(self._weight_decay or 0.0), float(self._epsilon), float(self._initial_accumulator_value))
+        return (self._wd_key, float(self._epsilon), float(self._initial_accumulator_value))
 
     def _init_slot(self, param):
         return {"moment": jnp.full(param.shape, self._initial_accumulator_value, jnp.float32)}
 
     def _update(self, param, grad, slots, lr, step):
         g = grad.astype(jnp.float32)
-        if self._weight_decay:
-            g = g + self._weight_decay * param.astype(jnp.float32)
+        g = self._decay_grad(g, param.astype(jnp.float32))
         mom = slots["moment"] + jnp.square(g)
         new_p = param.astype(jnp.float32) - lr * g / (jnp.sqrt(mom) + self._epsilon)
         return new_p.astype(param.dtype), {"moment": mom}
@@ -295,12 +323,11 @@ class Adadelta(Optimizer):
         self._rho, self._epsilon = rho, epsilon
 
     def _hyper_key(self):
-        return (float(self._weight_decay or 0.0), float(self._rho), float(self._epsilon))
+        return (self._wd_key, float(self._rho), float(self._epsilon))
 
     def _update(self, param, grad, slots, lr, step):
         g = grad.astype(jnp.float32)
-        if self._weight_decay:
-            g = g + self._weight_decay * param.astype(jnp.float32)
+        g = self._decay_grad(g, param.astype(jnp.float32))
         asg = self._rho * slots["avg_squared_grad"] + (1 - self._rho) * jnp.square(g)
         upd = jnp.sqrt(slots["avg_squared_update"] + self._epsilon) / jnp.sqrt(asg + self._epsilon) * g
         asu = self._rho * slots["avg_squared_update"] + (1 - self._rho) * jnp.square(upd)
@@ -318,12 +345,11 @@ class RMSProp(Optimizer):
         self._rho, self._epsilon, self._momentum, self._centered = rho, epsilon, momentum, centered
 
     def _hyper_key(self):
-        return (float(self._weight_decay or 0.0), float(self._rho), float(self._epsilon), float(self._momentum), bool(self._centered))
+        return (self._wd_key, float(self._rho), float(self._epsilon), float(self._momentum), bool(self._centered))
 
     def _update(self, param, grad, slots, lr, step):
         g = grad.astype(jnp.float32)
-        if self._weight_decay:
-            g = g + self._weight_decay * param.astype(jnp.float32)
+        g = self._decay_grad(g, param.astype(jnp.float32))
         ms = self._rho * slots["mean_square"] + (1 - self._rho) * jnp.square(g)
         if self._centered:
             mg = self._rho * slots["mean_grad"] + (1 - self._rho) * g
@@ -346,12 +372,11 @@ class Adamax(Optimizer):
         self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
 
     def _hyper_key(self):
-        return (float(self._weight_decay or 0.0), float(self._beta1), float(self._beta2), float(self._epsilon))
+        return (self._wd_key, float(self._beta1), float(self._beta2), float(self._epsilon))
 
     def _update(self, param, grad, slots, lr, step):
         g = grad.astype(jnp.float32)
-        if self._weight_decay:
-            g = g + self._weight_decay * param.astype(jnp.float32)
+        g = self._decay_grad(g, param.astype(jnp.float32))
         m = self._beta1 * slots["moment"] + (1 - self._beta1) * g
         u = jnp.maximum(self._beta2 * slots["inf_norm"], jnp.abs(g))
         t = step.astype(jnp.float32)

@@ -130,6 +130,9 @@ class FakeQuanterWithAbsMaxObserver(nn.Layer):
     def forward(self, x):
         if self.training:
             self._observer(x)
+        elif self._observer._stat is None:
+            # eval before any observation: identity, not a garbage 1e-9 scale
+            return x
         return fake_quant(x, Tensor(jnp.float32(self._observer.scale())))
 
     def scale(self) -> float:

@@ -1,0 +1,193 @@
+"""paddle.reader (ref:python/paddle/reader/decorator.py): the legacy
+reader-creator combinators. Readers are zero-arg callables returning an
+iterable; decorators compose them."""
+from __future__ import annotations
+
+import itertools
+import random
+import threading
+import queue as _queue
+
+__all__ = ["cache", "map_readers", "buffered", "compose", "chain",
+           "shuffle", "firstn", "xmap_readers", "multiprocess_reader"]
+
+
+def cache(reader):
+    """Materialize the reader's data once; replay from memory after."""
+    all_data = tuple(reader())
+
+    def _impl():
+        return iter(all_data)
+
+    return _impl
+
+
+def map_readers(func, *readers):
+    """Zip several readers, mapping func over the item tuples."""
+
+    def _impl():
+        rs = [r() for r in readers]
+        for items in zip(*rs):
+            yield func(*items)
+
+    return _impl
+
+
+def shuffle(reader, buf_size):
+    """Buffered shuffle: read buf_size items, shuffle, emit; repeat."""
+
+    def _impl():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) >= buf_size:
+                random.shuffle(buf)
+                yield from buf
+                buf = []
+        if buf:
+            random.shuffle(buf)
+            yield from buf
+
+    return _impl
+
+
+def chain(*readers):
+    """Concatenate readers back to back."""
+
+    def _impl():
+        return itertools.chain(*[r() for r in readers])
+
+    return _impl
+
+
+def compose(*readers, **kwargs):
+    """Zip readers into flat tuples: outputs (a1, a2, b, c1...) per item.
+    check_alignment=True (default) raises if readers run out unevenly."""
+    check_alignment = kwargs.pop("check_alignment", True)
+
+    def _to_tuple(x):
+        return x if isinstance(x, tuple) else (x,)
+
+    _SENTINEL = object()
+
+    def _impl():
+        rs = [iter(r()) for r in readers]
+        if check_alignment:
+            for items in zip(*rs):
+                yield sum((_to_tuple(i) for i in items), ())
+            for r in rs:  # any leftover item -> readers were misaligned
+                if next(r, _SENTINEL) is not _SENTINEL:
+                    raise ValueError(
+                        "compose: readers have different lengths")
+        else:
+            for items in itertools.zip_longest(*rs):
+                yield sum((_to_tuple(i) for i in items if i is not None), ())
+
+    return _impl
+
+
+def buffered(reader, size):
+    """Decouple producer/consumer with a bounded background-thread queue."""
+
+    class _End:
+        pass
+
+    def _impl():
+        q = _queue.Queue(maxsize=size)
+
+        def produce():
+            try:
+                for item in reader():
+                    q.put(item)
+                q.put(_End)
+            except BaseException as e:  # surface, don't deadlock the consumer
+                q.put(e)
+
+        t = threading.Thread(target=produce, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is _End:
+                break
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+
+    return _impl
+
+
+def firstn(reader, n):
+    """Limit the reader to its first n items."""
+
+    def _impl():
+        return itertools.islice(reader(), n)
+
+    return _impl
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
+    """Parallel map over a reader with worker threads (the reference uses
+    threads here too); order=True preserves input order."""
+
+    def _impl():
+        import collections
+        import concurrent.futures as cf
+
+        with cf.ThreadPoolExecutor(max_workers=process_num) as pool:
+            if order:
+                # Executor.map is lazy on submission in chunks; bound it by
+                # windowing ourselves for strict buffer_size semantics
+                window: collections.deque = collections.deque()
+                for item in reader():
+                    window.append(pool.submit(mapper, item))
+                    if len(window) >= max(buffer_size, 1):
+                        yield window.popleft().result()
+                while window:
+                    yield window.popleft().result()
+            else:
+                window = collections.deque()
+                for item in reader():
+                    window.append(pool.submit(mapper, item))
+                    if len(window) >= max(buffer_size, 1):
+                        done = next(cf.as_completed(window))
+                        window.remove(done)
+                        yield done.result()
+                for f in cf.as_completed(window):
+                    yield f.result()
+
+    return _impl
+
+
+def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
+    """Interleave several readers, each driven from a worker thread (the
+    single-controller analog of the reference's fork-based version)."""
+
+    class _End:
+        pass
+
+    def _impl():
+        q = _queue.Queue(maxsize=queue_size)
+
+        def produce(r):
+            try:
+                for item in r():
+                    q.put(item)
+                q.put(_End)
+            except BaseException as e:  # surface, don't deadlock the consumer
+                q.put(e)
+
+        threads = [threading.Thread(target=produce, args=(r,), daemon=True)
+                   for r in readers]
+        for t in threads:
+            t.start()
+        done = 0
+        while done < len(readers):
+            item = q.get()
+            if item is _End:
+                done += 1
+                continue
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+
+    return _impl

@@ -1,0 +1,27 @@
+"""paddle.regularizer (ref:python/paddle/regularizer.py): weight-decay
+regularizers accepted by every optimizer's ``weight_decay=``. L2Decay adds
+``coeff * param`` to the gradient; L1Decay adds ``coeff * sign(param)``
+(sparsity-encouraging). A bare float keeps meaning L2, as in the
+reference."""
+from __future__ import annotations
+
+__all__ = ["L1Decay", "L2Decay"]
+
+
+class L1Decay:
+    def __init__(self, coeff: float = 0.0):
+        self.coeff = float(coeff)
+        # reference-compat alias (fluid regularizer attribute name)
+        self._regularization_coeff = self.coeff
+
+    def __repr__(self):
+        return f"L1Decay, coeff={self.coeff}"
+
+
+class L2Decay:
+    def __init__(self, coeff: float = 0.0):
+        self.coeff = float(coeff)
+        self._regularization_coeff = self.coeff
+
+    def __repr__(self):
+        return f"L2Decay, coeff={self.coeff}"

@@ -123,7 +123,11 @@ def sparse_csr_tensor(crows, cols, values, shape, dtype=None) -> SparseCsrTensor
 
 
 def to_sparse_coo(x: Tensor, sparse_dim: Optional[int] = None) -> SparseCooTensor:
-    return SparseCooTensor(jsparse.BCOO.fromdense(_data(x)))
+    """sparse_dim leading dims are indexed; the rest stay dense trailing
+    dims (paddle's Tensor.to_sparse_coo(sparse_dim) contract)."""
+    arr = _data(x)
+    n_dense = 0 if sparse_dim is None else max(arr.ndim - int(sparse_dim), 0)
+    return SparseCooTensor(jsparse.BCOO.fromdense(arr, n_dense=n_dense))
 
 
 # ------------------------------------------------------------------- ops
@@ -192,3 +196,91 @@ tanh = _unary(jnp.tanh)
 sqrt = _unary(jnp.sqrt)
 square = _unary(jnp.square)
 neg = _unary(jnp.negative)
+
+# value-wise unary family (zero-preserving, applied to stored values only —
+# the reference's sparse unary kernel contract)
+asin = _unary(jnp.arcsin)
+asinh = _unary(jnp.arcsinh)
+atan = _unary(jnp.arctan)
+atanh = _unary(jnp.arctanh)
+sinh = _unary(jnp.sinh)
+tan = _unary(jnp.tan)
+expm1 = _unary(jnp.expm1)
+log1p = _unary(jnp.log1p)
+deg2rad = _unary(jnp.deg2rad)
+rad2deg = _unary(jnp.rad2deg)
+isnan = _unary(jnp.isnan)
+
+
+def pow(x, factor):  # noqa: A001
+    return _unary(lambda v: jnp.power(v, factor))(x)
+
+
+def cast(x, index_dtype=None, value_dtype=None):
+    def f(v):
+        return v.astype(value_dtype) if value_dtype else v
+
+    out = _unary(f)(x)
+    if index_dtype and isinstance(out, SparseCooTensor):
+        b = _coo(out)
+        out = SparseCooTensor(jsparse.BCOO((b.data, b.indices.astype(index_dtype)),
+                                           shape=b.shape))
+    return out
+
+
+def divide(x, y):
+    """Elementwise divide: sparse / dense or sparse / sparse-same-pattern."""
+    if isinstance(x, SparseCooTensor) and not isinstance(y, (SparseCooTensor, SparseCsrTensor)):
+        b = _coo(x)
+        yv = _data(y)
+        picked = yv[tuple(b.indices.T)]
+        return SparseCooTensor(jsparse.BCOO((b.data / picked, b.indices), shape=b.shape))
+    xd = x.to_dense() if isinstance(x, (SparseCooTensor, SparseCsrTensor)) else x
+    yd = y.to_dense() if isinstance(y, (SparseCooTensor, SparseCsrTensor)) else y
+    return Tensor(_data(xd) / _data(yd))
+
+
+def subtract(x, y):
+    return add(x, neg(y) if isinstance(y, (SparseCooTensor, SparseCsrTensor))
+               else Tensor(-_data(y)))
+
+
+def coalesce(x):
+    """Merge duplicate coordinates (ref sparse.coalesce)."""
+    b = _coo(x)
+    return SparseCooTensor(b.sum_duplicates())
+
+
+def is_same_shape(x, y) -> bool:
+    return tuple(x.shape) == tuple(y.shape)
+
+
+def reshape(x, shape):
+    """Reshape via dense roundtrip (pattern changes entirely; the reference's
+    sparse reshape kernel also recomputes coordinates)."""
+    d = x.to_dense() if isinstance(x, (SparseCooTensor, SparseCsrTensor)) else x
+    arr = jnp.reshape(_data(d), shape)
+    return to_sparse_coo(Tensor(arr), sparse_dim=len(shape))
+
+
+def transpose(x, perm):
+    d = x.to_dense() if isinstance(x, (SparseCooTensor, SparseCsrTensor)) else x
+    arr = jnp.transpose(_data(d), perm)
+    return to_sparse_coo(Tensor(arr), sparse_dim=arr.ndim)
+
+
+def mv(x, vec):
+    """Sparse matrix @ dense vector."""
+    if isinstance(x, (SparseCooTensor, SparseCsrTensor)):
+        out = matmul(x, Tensor(_data(vec)[:, None]))
+        return Tensor(_data(out)[:, 0])
+    return Tensor(_data(x) @ _data(vec))
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0):
+    """beta*input + alpha*(x @ y) with sparse x (ref sparse.addmm)."""
+    prod = matmul(x, y)
+    return Tensor(beta * _data(input) + alpha * _data(prod))
+
+
+from . import nn  # noqa: F401,E402  (sparse layers)

@@ -1,0 +1,231 @@
+"""paddle.sparse.nn (ref:python/paddle/sparse/nn/): layers over sparse COO
+tensors.
+
+TPU stance: elementwise layers act on the nonzero values directly (zero
+compute on zeros). The 3-D convolution/pool layers compute through dense
+XLA windows — the MXU path — and re-sparsify: SubmConv3D keeps the input's
+active sites (the submanifold contract), Conv3D/MaxPool3D emit the
+nonzeros of the result. The reference's gather-scatter CUDA kernels
+(ref:paddle/phi/kernels/sparse/gpu/conv_kernel.cu) are a bandwidth
+optimization of the same math; a Pallas gather kernel can slot in behind
+this API without changing it."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ... import nn as dense_nn
+from ...core.dispatch import apply
+from ...core.tensor import Tensor
+from .. import SparseCooTensor, _coo, to_sparse_coo
+
+__all__ = ["ReLU", "ReLU6", "LeakyReLU", "Softmax", "BatchNorm",
+           "SyncBatchNorm", "Conv3D", "SubmConv3D", "MaxPool3D"]
+
+
+def _map_values(x: SparseCooTensor, fn) -> SparseCooTensor:
+    bcoo = x._bcoo
+    new = bcoo.__class__((fn(bcoo.data), bcoo.indices), shape=bcoo.shape)
+    return SparseCooTensor(new)
+
+
+class ReLU(dense_nn.Layer):
+    def forward(self, x):
+        return _map_values(x, lambda v: jnp.maximum(v, 0))
+
+
+class ReLU6(dense_nn.Layer):
+    def forward(self, x):
+        return _map_values(x, lambda v: jnp.clip(v, 0, 6))
+
+
+class LeakyReLU(dense_nn.Layer):
+    def __init__(self, negative_slope=0.01):
+        super().__init__()
+        self._slope = negative_slope
+
+    def forward(self, x):
+        return _map_values(
+            x, lambda v: jnp.where(v >= 0, v, self._slope * v))
+
+
+class Softmax(dense_nn.Layer):
+    """Softmax over the nonzeros of each row (last dim), the reference
+    sparse-softmax contract."""
+
+    def __init__(self, axis=-1):
+        super().__init__()
+        if axis != -1:
+            raise ValueError("sparse softmax supports axis=-1")
+
+    def forward(self, x):
+        bcoo = x._bcoo
+        if len(bcoo.shape) != 2:
+            raise ValueError("sparse softmax expects a 2-D sparse matrix")
+        rows = bcoo.indices[:, 0]
+        n_rows = bcoo.shape[0]
+        v = bcoo.data
+        row_max = jax.ops.segment_max(v, rows, num_segments=n_rows)
+        e = jnp.exp(v - row_max[rows])
+        denom = jax.ops.segment_sum(e, rows, num_segments=n_rows)
+        new = bcoo.__class__((e / denom[rows], bcoo.indices),
+                             shape=bcoo.shape)
+        return SparseCooTensor(new)
+
+
+class BatchNorm(dense_nn.Layer):
+    """Channel batch norm over the ACTIVE values of a [N, ..., C] sparse
+    tensor (statistics from nonzeros only — the sparse BN contract)."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NDHWC",
+                 use_global_stats=None, name=None):
+        super().__init__()
+        self._momentum = momentum
+        self._epsilon = epsilon
+        self.weight = self.create_parameter(
+            [num_features],
+            default_initializer=dense_nn.initializer.Constant(1.0))
+        self.bias = self.create_parameter(
+            [num_features],
+            default_initializer=dense_nn.initializer.Constant(0.0))
+        self._mean = self.create_parameter(
+            [num_features],
+            default_initializer=dense_nn.initializer.Constant(0.0))
+        self._mean.stop_gradient = True
+        self._variance = self.create_parameter(
+            [num_features],
+            default_initializer=dense_nn.initializer.Constant(1.0))
+        self._variance.stop_gradient = True
+
+    def forward(self, x):
+        bcoo = x._bcoo
+        v = bcoo.data  # [nnz, C] (dense trailing channel dim)
+        if v.ndim != 2:
+            raise ValueError(
+                "sparse BatchNorm expects channels as the dense trailing dim")
+        if self.training:
+            mean = v.mean(0)
+            var = v.var(0)
+            m = self._momentum
+            self._mean._data = m * self._mean._data + (1 - m) * mean
+            self._variance._data = m * self._variance._data + (1 - m) * var
+        else:
+            mean, var = self._mean._data, self._variance._data
+        vhat = (v - mean) / jnp.sqrt(var + self._epsilon)
+        out = vhat * self.weight._data + self.bias._data
+        new = bcoo.__class__((out.astype(v.dtype), bcoo.indices),
+                             shape=bcoo.shape)
+        return SparseCooTensor(new)
+
+
+class SyncBatchNorm(BatchNorm):
+    """Cross-replica statistics come from GSPMD compiling the mean/var
+    reductions over the data axis — same module, compiled sharded."""
+
+
+def _dense_roundtrip(x: SparseCooTensor, fn, keep_input_sites: bool):
+    dense = Tensor(x._bcoo.todense())
+    out = fn(dense)
+    arr = out._data if isinstance(out, Tensor) else out
+    if keep_input_sites:
+        # submanifold: output only at the input's active sites. Requires the
+        # channel dim dense (to_sparse_coo(sparse_dim=ndim-1)); with a fully
+        # sparse layout the per-channel indices would be misread as sites.
+        if x._bcoo.n_dense < 1:
+            raise ValueError(
+                "SubmConv3D needs the channel dim dense: build the input "
+                "with to_sparse_coo(x, sparse_dim=x.ndim - 1)")
+        idx = x._bcoo.indices  # [nnz, n_sparse]
+        vals = arr[tuple(idx[:, d] for d in range(idx.shape[1]))]
+        new = x._bcoo.__class__((vals, idx), shape=tuple(arr.shape))
+        return SparseCooTensor(new)
+    return to_sparse_coo(Tensor(arr), sparse_dim=arr.ndim - 1)
+
+
+class _SparseConv3DBase(dense_nn.Layer):
+    _subm = False
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NDHWC"):
+        super().__init__()
+        if data_format != "NDHWC":
+            raise ValueError("sparse conv3d is NDHWC (reference contract)")
+        ks = (kernel_size,) * 3 if isinstance(kernel_size, int) else tuple(kernel_size)
+        self._stride = stride
+        self._padding = padding
+        self._dilation = dilation
+        self._groups = groups
+        fan_in = in_channels * ks[0] * ks[1] * ks[2]
+        bound = 1.0 / math.sqrt(fan_in)
+        # NDHWC sparse weight layout [kd, kh, kw, in, out]
+        self.weight = self.create_parameter(
+            list(ks) + [in_channels // groups, out_channels],
+            default_initializer=dense_nn.initializer.Uniform(-bound, bound))
+        self.bias = (None if bias_attr is False else self.create_parameter(
+            [out_channels],
+            default_initializer=dense_nn.initializer.Constant(0.0)))
+
+    def forward(self, x):
+        from ...nn import functional as F
+        from ...ops import manipulation as M
+
+        def run(dense):
+            # NDHWC -> NCDHW for the dense conv, weight -> OIDHW
+            xt = M.transpose(dense, [0, 4, 1, 2, 3])
+            w = M.transpose(self.weight, [4, 3, 0, 1, 2])
+            if self._subm:
+                # submanifold convs preserve geometry: same-size output,
+                # padded per dim (odd kernels only — even ones can't pad
+                # symmetrically, same as the reference kernel)
+                ks = self.weight.shape[:3]
+                dil = ((self._dilation,) * 3
+                       if isinstance(self._dilation, int)
+                       else tuple(self._dilation))
+                if any(k % 2 == 0 for k in ks):
+                    raise ValueError(
+                        f"SubmConv3D needs odd kernel sizes, got {ks}")
+                pads = [((k - 1) // 2) * d for k, d in zip(ks, dil)]
+                out = F.conv3d(xt, w, bias=self.bias, stride=1, padding=pads,
+                               dilation=self._dilation, groups=self._groups)
+            else:
+                out = F.conv3d(xt, w, bias=self.bias, stride=self._stride,
+                               padding=self._padding,
+                               dilation=self._dilation, groups=self._groups)
+            return M.transpose(out, [0, 2, 3, 4, 1])
+
+        return _dense_roundtrip(x, run, keep_input_sites=self._subm)
+
+
+class Conv3D(_SparseConv3DBase):
+    _subm = False
+
+
+class SubmConv3D(_SparseConv3DBase):
+    _subm = True
+
+
+class MaxPool3D(dense_nn.Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NDHWC", name=None):
+        super().__init__()
+        self._k = kernel_size
+        self._s = stride if stride is not None else kernel_size
+        self._p = padding
+
+    def forward(self, x):
+        from ...nn import functional as F
+        from ...ops import manipulation as M
+
+        def run(dense):
+            xt = M.transpose(dense, [0, 4, 1, 2, 3])
+            out = F.max_pool3d(xt, self._k, self._s, self._p)
+            return M.transpose(out, [0, 2, 3, 4, 1])
+
+        return _dense_roundtrip(x, run, keep_input_sites=False)
+
+
+from . import functional  # noqa: F401,E402  (wraps the layers above)

@@ -1,0 +1,74 @@
+"""paddle.sparse.nn.functional: functional forms of the sparse layers."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from . import (LeakyReLU, MaxPool3D, Softmax, _map_values)
+
+__all__ = ["relu", "relu6", "leaky_relu", "softmax", "max_pool3d",
+           "attention"]
+
+
+def relu(x, name=None):
+    return _map_values(x, lambda v: jnp.maximum(v, 0))
+
+
+def relu6(x, name=None):
+    return _map_values(x, lambda v: jnp.clip(v, 0, 6))
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return _map_values(x, lambda v: jnp.where(v >= 0, v, negative_slope * v))
+
+
+def softmax(x, axis=-1, name=None):
+    return Softmax(axis)(x)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               data_format="NDHWC", name=None):
+    from ...nn import functional as dense_F
+    from ...ops import manipulation as M
+    from . import _dense_roundtrip
+
+    def run(dense):
+        xt = M.transpose(dense, [0, 4, 1, 2, 3])
+        out = dense_F.max_pool3d(xt, kernel_size, stride, padding,
+                                 ceil_mode=ceil_mode)
+        return M.transpose(out, [0, 2, 3, 4, 1])
+
+    return _dense_roundtrip(x, run, keep_input_sites=False)
+
+
+def attention(query, key, value, sparse_mask, key_padding_mask=None,
+              attn_mask=None, name=None):
+    """Sparse-masked attention: computes probs only at the mask's nonzero
+    sites (ref sparse/nn/functional/transformer.py)."""
+    import math
+
+    import jax
+
+    from ...core.tensor import Tensor
+    from .. import SparseCooTensor
+
+    q = query._data if isinstance(query, Tensor) else jnp.asarray(query)
+    k = key._data if isinstance(key, Tensor) else jnp.asarray(key)
+    v = value._data if isinstance(value, Tensor) else jnp.asarray(value)
+    # [b, h, s, d] layout; mask is a 2-D/3-D sparse COO over [s, s]
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    dense_mask = sparse_mask._bcoo.todense() if isinstance(
+        sparse_mask, SparseCooTensor) else jnp.asarray(sparse_mask)
+    neg = jnp.asarray(-1e30, logits.dtype)
+    logits = jnp.where(dense_mask != 0, logits, neg)
+    if key_padding_mask is not None:
+        kp = (key_padding_mask._data if isinstance(key_padding_mask, Tensor)
+              else jnp.asarray(key_padding_mask))  # [b, s]: nonzero = keep
+        logits = jnp.where(kp[:, None, None, :] != 0, logits, neg)
+    if attn_mask is not None:
+        am = (attn_mask._data if isinstance(attn_mask, Tensor)
+              else jnp.asarray(attn_mask))
+        logits = (jnp.where(am, logits, neg) if am.dtype == jnp.bool_
+                  else logits + am)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), -1).astype(q.dtype)
+    return Tensor(jnp.einsum("bhqk,bhkd->bhqd", probs, v))

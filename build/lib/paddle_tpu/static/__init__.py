@@ -9,6 +9,8 @@ graph-construction APIs that have no TPU-native meaning.
 """
 from __future__ import annotations
 
+import numpy as np
+
 from ..jit import InputSpec  # noqa: F401
 
 
@@ -51,3 +53,295 @@ Executor = _no_static("Executor")
 data = _no_static("data")
 default_main_program = _no_static("default_main_program")
 default_startup_program = _no_static("default_startup_program")
+
+
+# -------------------------------------------------- working static surface
+# Pieces of paddle.static that have a real meaning on this stack are
+# implemented; pure Program-graph machinery stays an explicit redirect.
+
+
+class BuildStrategy:
+    """Config holder (ref BuildStrategy): fields are recorded; XLA performs
+    the corresponding fusions/scheduling itself."""
+
+    def __init__(self):
+        self.enable_inplace = True
+        self.fuse_all_optimizer_ops = True
+        self.fuse_elewise_add_act_ops = True
+        self.memory_optimize = True
+        self.reduce_strategy = 0
+        self.gradient_scale_strategy = 0
+
+
+class ExecutionStrategy:
+    def __init__(self):
+        self.num_threads = 1
+        self.num_iteration_per_drop_scope = 10
+        self.use_experimental_executor = True
+
+
+class IpuStrategy:  # accepted for API parity; IPUs are not a target here
+    def __init__(self):
+        self.config = {}
+
+    def set_graph_config(self, **kw):
+        self.config.update(kw)
+
+    def set_pipelining_config(self, **kw):
+        self.config.update(kw)
+
+
+class CompiledProgram:
+    """Wrap a to_static function/TranslatedLayer (the reference wraps a
+    Program for PE/Standalone executors; compilation here is jax.jit)."""
+
+    def __init__(self, program, build_strategy=None):
+        self.program = program
+        self.build_strategy = build_strategy or BuildStrategy()
+
+    def __call__(self, *args, **kw):
+        return self.program(*args, **kw)
+
+
+class Variable:  # alias: the framework's tensor IS the variable
+    pass
+
+
+class WeightNormParamAttr:
+    """Accepted attr (ref WeightNormParamAttr); weight-norm reparameterization
+    can be applied with nn.SpectralNorm-style wrappers."""
+
+    def __init__(self, dim=None, **kw):
+        self.dim = dim
+        self.kw = kw
+
+
+class ExponentialMovingAverage:
+    """EMA of parameters (ref static.ExponentialMovingAverage), usable in
+    dygraph training loops: update() after each step; apply()/restore()."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self.decay = decay
+        self._ema = {}
+        self._backup = None
+        self._params = None
+
+    def update(self, parameters=None):
+        import jax.numpy as jnp
+
+        params = parameters or self._params
+        if params is None:
+            raise ValueError("pass parameters on first update()")
+        self._params = list(params)
+        for p in self._params:
+            key = id(p)
+            prev = self._ema.get(key)
+            self._ema[key] = (p._data if prev is None
+                              else self.decay * prev + (1 - self.decay) * p._data)
+
+    def apply(self, executor=None, need_restore=True):
+        self._backup = [p._data for p in self._params]
+        for p in self._params:
+            p._data = self._ema[id(p)].astype(p._data.dtype)
+
+    def restore(self, executor=None):
+        if self._backup is not None:
+            for p, b in zip(self._params, self._backup):
+                p._data = b
+            self._backup = None
+
+
+def accuracy(input, label, k=1, **kw):
+    from ..metric import accuracy as _acc
+
+    return _acc(input, label, k=k)
+
+
+def auc(input, label, **kw):
+    from ..metric import Auc
+
+    m = Auc()
+    m.update(input, label)
+    import jax.numpy as jnp
+
+    from ..core.tensor import Tensor
+
+    return Tensor(jnp.asarray(m.accumulate(), jnp.float32))
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    from .. import create_parameter as _cp
+
+    return _cp(shape, dtype=dtype, attr=attr, is_bias=is_bias,
+               default_initializer=default_initializer)
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    import jax.numpy as jnp
+
+    from ..core.dtype import convert_dtype_arg
+    from ..core.tensor import Tensor
+
+    t = Tensor(jnp.full(shape, value, convert_dtype_arg(dtype)))
+    t.persistable = persistable
+    return t
+
+
+def cpu_places(device_count=None):
+    from ..core.device import CPUPlace
+
+    n = device_count or 1
+    return [CPUPlace() for _ in range(n)]
+
+
+def cuda_places(device_ids=None):
+    from ..core.device import CUDAPlace
+
+    ids = device_ids if device_ids is not None else [0]
+    return [CUDAPlace(i) for i in ids]
+
+
+def xpu_places(device_ids=None):
+    return cuda_places(device_ids)
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    from ..core.autograd import grad as _grad
+
+    return _grad(targets, inputs, grad_outputs=target_gradients,
+                 retain_graph=True, allow_unused=True)
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None):
+    """Dygraph equivalent of adding backward ops: run backward now."""
+    loss.backward()
+    params = parameter_list or []
+    return [(p, p.grad) for p in params]
+
+
+def name_scope(prefix=None):
+    import contextlib
+
+    return contextlib.nullcontext(prefix)
+
+
+def device_guard(device=None):
+    import contextlib
+
+    return contextlib.nullcontext(device)
+
+
+def ipu_shard_guard(index=-1, stage=-1):
+    import contextlib
+
+    return contextlib.nullcontext()
+
+
+def set_ipu_shard(call_func, index=-1, stage=-1):
+    return call_func
+
+
+def scope_guard(scope):
+    import contextlib
+
+    return contextlib.nullcontext(scope)
+
+
+def global_scope():
+    return {}
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """Host-python op (ref static.py_func): in the traced world this is
+    PyLayer/pure_callback territory; eager just calls the function."""
+    res = func(*(x if isinstance(x, (list, tuple)) else [x]))
+    return res
+
+
+def Print(input, first_n=-1, message=None, summarize=20, **kw):
+    print(message or "", np.asarray(input._data))
+    return input
+
+
+def exponential_decay(learning_rate, decay_steps, decay_rate, staircase=False):
+    from ..optimizer.lr import ExponentialDecay
+
+    return ExponentialDecay(gamma=decay_rate, learning_rate=learning_rate)
+
+
+# state/save-load: flat state-dict based (the Program-free equivalents)
+
+
+def save(program, model_path, protocol=4, **configs):
+    raise NotImplementedError(
+        "static.save persists a Program; use paddle.save(layer.state_dict()) "
+        "or jit.save for deployable programs")
+
+
+def load(program, model_path, executor=None, var_list=None):
+    raise NotImplementedError(
+        "static.load loads a Program; use paddle.load / jit.load")
+
+
+def save_to_file(path, content: bytes):
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path) -> bytes:
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def serialize_program(feed_vars=None, fetch_vars=None, program=None):
+    raise NotImplementedError(
+        "program serialization is jit.save (StableHLO) on this stack")
+
+
+def deserialize_program(data):
+    raise NotImplementedError(
+        "program deserialization is jit.load (StableHLO) on this stack")
+
+
+def serialize_persistables(feed_vars=None, fetch_vars=None, program=None):
+    raise NotImplementedError("use paddle.save(state_dict)")
+
+
+def deserialize_persistables(program, data, executor=None):
+    raise NotImplementedError("use paddle.load")
+
+
+def normalize_program(program, feed_vars, fetch_vars, **kw):
+    return program
+
+
+def load_program_state(model_path, var_list=None):
+    from ..framework.io import load as _load
+
+    return _load(model_path + ".pdparams" if not model_path.endswith(".pdparams")
+                 else model_path)
+
+
+def set_program_state(program, state_dict):
+    raise NotImplementedError(
+        "no mutable Program exists; load state into a Layer via "
+        "layer.set_state_dict")
+
+
+def ctr_metric_bundle(input, label, ins_tag_weight=None):
+    """CTR eval bundle (ref static.ctr_metric_bundle): returns (auc, batch_auc)
+    computed from the running Auc metric."""
+    a = auc(input, label)
+    return a, a
+
+
+class IpuCompiledProgram:
+    """IPU target is not part of this stack (ref IpuCompiledProgram)."""
+
+    def __init__(self, program=None, scope=None, ipu_strategy=None):
+        raise NotImplementedError(
+            "IPU compilation is not supported; the XLA TPU/CPU pipeline is "
+            "the compilation target of this framework")

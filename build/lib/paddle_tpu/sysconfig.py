@@ -1,0 +1,26 @@
+"""paddle.sysconfig (ref:python/paddle/sysconfig.py): build-tree paths for
+compiling extensions against the framework — here the native C ABI headers
+and the prebuilt libpaddle_tpu_native.so."""
+from __future__ import annotations
+
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+_PKG = os.path.dirname(os.path.abspath(__file__))
+
+
+def get_include() -> str:
+    """Directory of the native C/C++ sources and vendored headers."""
+    return os.path.join(_PKG, "native", "csrc")
+
+
+def get_lib() -> str:
+    """Directory containing libpaddle_tpu_native.so (wheel layout), or the
+    source-build cache directory for checkouts."""
+    wheel_dir = os.path.join(_PKG, "native")
+    if os.path.exists(os.path.join(wheel_dir, "libpaddle_tpu_native.so")):
+        return wheel_dir
+    return os.environ.get(
+        "PADDLE_TPU_NATIVE_CACHE",
+        os.path.join(os.path.expanduser("~"), ".cache", "paddle_tpu"))

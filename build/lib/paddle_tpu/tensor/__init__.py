@@ -1,0 +1,8 @@
+"""paddle.tensor namespace (ref:python/paddle/tensor/__init__.py): the op
+families are implemented in ``paddle_tpu.ops`` and re-exported both at the
+package top level and here, so ``paddle.tensor.<fn>`` imports written
+against the reference resolve."""
+from ..ops import *  # noqa: F401,F403
+from ..ops import creation, linalg, manipulation, math, random  # noqa: F401
+
+__all__ = [n for n in dir() if not n.startswith("_")]

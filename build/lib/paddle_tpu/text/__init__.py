@@ -1,0 +1,100 @@
+"""paddle.text (ref:python/paddle/text/): ViterbiDecoder + datasets.
+
+ViterbiDecoder is the real compute piece (CRF decoding) — implemented as a
+lax.scan DP so it compiles into serving programs. Datasets (Imdb/Conll05/WMT14...)
+parse the reference's file formats; constructors accept local ``data_file``
+paths (no egress needed) or download into DATA_HOME when available.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .. import nn
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+
+__all__ = ["ViterbiDecoder", "viterbi_decode"]
+
+
+def viterbi_decode(potentials, transition_params, lengths=None,
+                   include_bos_eos_tag=True, name=None):
+    """CRF Viterbi decode (ref:python/paddle/text/viterbi_decode.py).
+
+    potentials [B, T, N] emission scores, transition_params [N, N] (+2 rows/
+    cols for BOS/EOS when include_bos_eos_tag). Returns (scores [B],
+    paths [B, T]).
+    """
+
+    def _viterbi(emis, trans, lens, *, bos_eos):
+        B, T, N = emis.shape
+        if bos_eos:
+            # reference layout: the last two of the N tags ARE the BOS and
+            # EOS tags — row N-2 scores transitions out of BOS (start), and
+            # column N-1 scores transitions into EOS (stop)
+            start = trans[N - 2, :]
+            stop = trans[:, N - 1]
+            tr = trans
+        else:
+            start = jnp.zeros(N)
+            stop = jnp.zeros(N)
+            tr = trans
+
+        alpha0 = emis[:, 0] + start  # [B, N]
+
+        def step(alpha, t):
+            # alpha [B, N] -> scores of extending to each next tag
+            scores = alpha[:, :, None] + tr[None]  # [B, N, N]
+            best = scores.max(axis=1) + emis[:, t]
+            back = scores.argmax(axis=1)  # [B, N]
+            # frozen past sequence end
+            live = (t < lens)[:, None]
+            best = jnp.where(live, best, alpha)
+            return best, back
+
+        alpha, backs = jax.lax.scan(step, alpha0, jnp.arange(1, T))
+        alpha = alpha + stop
+        last = alpha.argmax(axis=1)  # [B]
+        score = alpha.max(axis=1)
+
+        def backtrace(carry, t):
+            tag = carry  # [B] tag at position t+1
+            prev = jnp.take_along_axis(backs[t], tag[:, None], axis=1)[:, 0]
+            # only step back while within the sequence
+            live = (t + 1) < lens
+            prev = jnp.where(live, prev, tag)
+            return prev, tag
+
+        # collected ys = tags at positions T-1 .. 1; final carry = tag at 0
+        first, ys = jax.lax.scan(backtrace, last, jnp.arange(T - 2, -1, -1))
+        full = jnp.concatenate([first[:, None], ys[::-1].T], axis=1)
+        return score.astype(emis.dtype), full.astype(jnp.int64)
+
+    if lengths is None:
+        import numpy as np
+
+        T = (potentials.shape[1] if hasattr(potentials, "shape") else None)
+        lengths = Tensor(jnp.full((potentials.shape[0],), T, jnp.int32))
+    return apply(_viterbi, (potentials, transition_params, lengths),
+                 {"bos_eos": bool(include_bos_eos_tag)}, name="viterbi")
+
+
+class ViterbiDecoder(nn.Layer):
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        super().__init__()
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def forward(self, potentials, lengths=None):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)
+
+
+# real dataset implementations live in .datasets (parsers over the
+# reference's file formats; explicit data_file paths work offline)
+from .datasets import (Conll05st, Imdb, Imikolov, Movielens,  # noqa: E402
+                       UCIHousing, WMT14, WMT16)
+from . import datasets  # noqa: E402
+
+__all__ += ["datasets", "Conll05st", "Imdb", "Imikolov", "Movielens",
+            "UCIHousing", "WMT14", "WMT16"]

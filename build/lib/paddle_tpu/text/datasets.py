@@ -1,0 +1,624 @@
+"""paddle.text.datasets (ref:python/paddle/text/datasets/): the seven
+classic NLP/tabular datasets with the reference's file-format contracts.
+Every class accepts explicit local file paths (``data_file=...``) so they
+work without network access; ``download=True`` fetches into DATA_HOME via
+paddle_tpu.utils.download otherwise."""
+from __future__ import annotations
+
+import collections
+import gzip
+import re
+import string
+import tarfile
+import zipfile
+
+import numpy as np
+
+from ..io import Dataset
+from ..utils.download import _check_exists_and_download
+
+__all__ = ["Conll05st", "Imdb", "Imikolov", "Movielens", "UCIHousing",
+           "WMT14", "WMT16"]
+
+
+# --------------------------------------------------------------- UCIHousing
+
+UCI_URL = "https://paddlemodels.cdn.bcebos.com/uci_housing/housing.data"
+UCI_MD5 = "d4accdce7a25600298819f8e28e8d593"
+
+
+class UCIHousing(Dataset):
+    """Boston housing: 14-column whitespace table, min-max-normalized
+    features, 80/20 train/test split (ref uci_housing.py)."""
+
+    def __init__(self, data_file=None, mode="train", download=True):
+        if mode.lower() not in ("train", "test"):
+            raise ValueError(f"mode should be 'train' or 'test', got {mode}")
+        self.mode = mode.lower()
+        self.data_file = _check_exists_and_download(
+            data_file, UCI_URL, UCI_MD5, "uci_housing", download)
+        self._load(feature_num=14, ratio=0.8)
+        self.dtype = "float32"
+
+    def _load(self, feature_num, ratio):
+        data = np.fromfile(self.data_file, sep=" ")
+        data = data.reshape(-1, feature_num)
+        maxs, mins = data.max(axis=0), data.min(axis=0)
+        avgs = data.mean(axis=0)
+        for i in range(feature_num - 1):
+            data[:, i] = (data[:, i] - avgs[i]) / (maxs[i] - mins[i])
+        offset = int(data.shape[0] * ratio)
+        self.data = data[:offset] if self.mode == "train" else data[offset:]
+
+    def __getitem__(self, idx):
+        row = self.data[idx]
+        return (row[:-1].astype(self.dtype), row[-1:].astype(self.dtype))
+
+    def __len__(self):
+        return len(self.data)
+
+
+# ---------------------------------------------------------------- Imikolov
+
+IMIKOLOV_URL = ("https://paddlemodels.cdn.bcebos.com/imikolov/"
+                "simple-examples.tgz")
+IMIKOLOV_MD5 = "30177ea32e27c525793142b6bf2c8e2d"
+
+
+class Imikolov(Dataset):
+    """PTB language-model corpus: word dict above a frequency cutoff, NGRAM
+    windows or <s>/<e>-bracketed SEQ pairs (ref imikolov.py)."""
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=-1,
+                 mode="train", min_word_freq=50, download=True):
+        if data_type.upper() not in ("NGRAM", "SEQ"):
+            raise ValueError(f"data_type should be NGRAM or SEQ, got {data_type}")
+        if mode.lower() not in ("train", "test"):
+            raise ValueError(f"mode should be 'train' or 'test', got {mode}")
+        self.data_type = data_type.upper()
+        self.mode = mode.lower()
+        self.window_size = window_size
+        self.min_word_freq = min_word_freq
+        self.data_file = _check_exists_and_download(
+            data_file, IMIKOLOV_URL, IMIKOLOV_MD5, "imikolov", download)
+        self.word_idx = self._build_dict(min_word_freq)
+        self._load()
+
+    @staticmethod
+    def _count(f, freq):
+        for line in f:
+            for w in line.strip().split():
+                freq[w.decode() if isinstance(w, bytes) else w] += 1
+            freq["<s>"] += 1
+            freq["<e>"] += 1
+        return freq
+
+    def _member(self, tf, suffix):
+        for name in tf.getnames():
+            if name.endswith(suffix):
+                return name
+        raise KeyError(f"{suffix} not found in {self.data_file}")
+
+    def _build_dict(self, cutoff):
+        freq: dict = collections.defaultdict(int)
+        with tarfile.open(self.data_file) as tf:
+            self._count(tf.extractfile(self._member(tf, "data/ptb.train.txt")), freq)
+            self._count(tf.extractfile(self._member(tf, "data/ptb.valid.txt")), freq)
+        freq.pop("<unk>", None)
+        kept = [kv for kv in freq.items() if kv[1] > cutoff]
+        kept.sort(key=lambda kv: (-kv[1], kv[0]))
+        word_idx = {w: i for i, (w, _) in enumerate(kept)}
+        word_idx["<unk>"] = len(word_idx)
+        return word_idx
+
+    def _load(self):
+        # the reference maps mode 'test' onto ptb.valid.txt
+        fname = "data/ptb.train.txt" if self.mode == "train" else "data/ptb.valid.txt"
+        unk = self.word_idx["<unk>"]
+        self.data = []
+        with tarfile.open(self.data_file) as tf:
+            for raw in tf.extractfile(self._member(tf, fname)):
+                words = raw.decode().strip().split()
+                if self.data_type == "NGRAM":
+                    if self.window_size <= 0:
+                        raise ValueError("NGRAM needs window_size > 0")
+                    seq = ["<s>"] + words + ["<e>"]
+                    if len(seq) < self.window_size:
+                        continue
+                    ids = [self.word_idx.get(w, unk) for w in seq]
+                    for i in range(self.window_size, len(ids) + 1):
+                        self.data.append(tuple(ids[i - self.window_size:i]))
+                else:
+                    ids = [self.word_idx.get(w, unk) for w in words]
+                    src = [self.word_idx["<s>"]] + ids
+                    trg = ids + [self.word_idx["<e>"]]
+                    if 0 < self.window_size < len(src):
+                        continue
+                    self.data.append((src, trg))
+
+    def __getitem__(self, idx):
+        return tuple(np.array(d) for d in self.data[idx])
+
+    def __len__(self):
+        return len(self.data)
+
+
+# -------------------------------------------------------------------- Imdb
+
+IMDB_URL = "https://paddlemodels.cdn.bcebos.com/imdb/aclImdb_v1.tar.gz"
+IMDB_MD5 = "7c2ac02c03563afcf9b574c7e56c153a"
+
+
+class Imdb(Dataset):
+    """IMDB sentiment: aclImdb tar of pos/neg review text files; frequency
+    dict with a cutoff, punctuation-stripped lowercase tokens, label 0 for
+    pos and 1 for neg (ref imdb.py)."""
+
+    _PAT = re.compile(r"aclImdb/(train|test)/(pos|neg)/.*\.txt$")
+
+    def __init__(self, data_file=None, mode="train", cutoff=150, download=True):
+        if mode.lower() not in ("train", "test"):
+            raise ValueError(f"mode should be 'train' or 'test', got {mode}")
+        self.mode = mode.lower()
+        self.data_file = _check_exists_and_download(
+            data_file, IMDB_URL, IMDB_MD5, "imdb", download)
+        self._load(cutoff)
+
+    def _load(self, cutoff):
+        # one streaming pass over the gzip tar (it can't be seeked, so each
+        # extra pass would re-inflate the whole archive): bucket tokenized
+        # docs by (split, label) while counting dict frequencies
+        freq: dict = collections.defaultdict(int)
+        buckets = {(self.mode, 0): [], (self.mode, 1): []}
+        strip = string.punctuation.encode("latin-1")
+        with tarfile.open(self.data_file) as tf:
+            for member in tf:
+                m = self._PAT.match(member.name)
+                if not m:
+                    continue
+                body = tf.extractfile(member).read().rstrip(b"\n\r")
+                doc = body.translate(None, strip).lower().split()
+                for w in doc:
+                    freq[w] += 1
+                # only this mode's docs are kept; the other split feeds the
+                # dict counts but would double peak memory if retained
+                if m.group(1) == self.mode:
+                    buckets[(self.mode,
+                             0 if m.group(2) == "pos" else 1)].append(doc)
+        freq.pop(b"<unk>", None)
+        kept = [kv for kv in freq.items() if kv[1] > cutoff]
+        kept.sort(key=lambda kv: (-kv[1], kv[0]))
+        self.word_idx = {w: i for i, (w, _) in enumerate(kept)}
+        self.word_idx[b"<unk>"] = len(self.word_idx)
+        unk = self.word_idx[b"<unk>"]
+        self.docs, self.labels = [], []
+        for label in (0, 1):
+            for doc in buckets[(self.mode, label)]:
+                self.docs.append([self.word_idx.get(w, unk) for w in doc])
+                self.labels.append(label)
+
+    def __getitem__(self, idx):
+        return np.array(self.docs[idx]), np.array([self.labels[idx]])
+
+    def __len__(self):
+        return len(self.docs)
+
+
+# --------------------------------------------------------------- Movielens
+
+ML_URL = "https://paddlemodels.cdn.bcebos.com/movielens/ml-1m.zip"
+ML_MD5 = "c4d9eecfca2ab87c1945afe126590906"
+_AGE_TABLE = [1, 18, 25, 35, 45, 50, 56]
+
+
+class MovieInfo:
+    def __init__(self, index, categories, title):
+        self.index = int(index)
+        self.categories = categories
+        self.title = title
+
+    def value(self, categories_dict, movie_title_dict):
+        return [[self.index],
+                [categories_dict[c] for c in self.categories],
+                [movie_title_dict[w.lower()] for w in self.title.split()]]
+
+    def __repr__(self):
+        return (f"<MovieInfo id({self.index}), title({self.title}), "
+                f"categories({self.categories})>")
+
+
+class UserInfo:
+    def __init__(self, index, gender, age, job_id):
+        self.index = int(index)
+        self.is_male = gender == "M"
+        self.age = _AGE_TABLE.index(int(age))
+        self.job_id = int(job_id)
+
+    def value(self):
+        return [[self.index], [0 if self.is_male else 1], [self.age],
+                [self.job_id]]
+
+    def __repr__(self):
+        return (f"<UserInfo id({self.index}), gender({'M' if self.is_male else 'F'}), "
+                f"age({_AGE_TABLE[self.age]}), job({self.job_id})>")
+
+
+class Movielens(Dataset):
+    """MovieLens-1M ratings joined with user and movie features; random
+    train/test split by ``test_ratio`` under ``rand_seed`` (ref
+    movielens.py). Ratings rescaled to [-5, 5] via r*2-5."""
+
+    def __init__(self, data_file=None, mode="train", test_ratio=0.1,
+                 rand_seed=0, download=True):
+        if mode.lower() not in ("train", "test"):
+            raise ValueError(f"mode should be 'train' or 'test', got {mode}")
+        self.mode = mode.lower()
+        self.test_ratio = test_ratio
+        self.rand_seed = rand_seed
+        self.data_file = _check_exists_and_download(
+            data_file, ML_URL, ML_MD5, "movielens", download)
+        np.random.seed(rand_seed)
+        self._load_meta()
+        self._load()
+
+    def _load_meta(self):
+        pat = re.compile(r"^(.*)\((\d+)\)$")
+        self.movie_info, self.user_info = {}, {}
+        title_words, categories = set(), set()
+        with zipfile.ZipFile(self.data_file) as z:
+            with z.open("ml-1m/movies.dat") as f:
+                for line in f:
+                    mid, title, cats = (line.decode("latin")
+                                        .strip().split("::"))
+                    cats = cats.split("|")
+                    categories.update(cats)
+                    m = pat.match(title)
+                    title = m.group(1) if m else title
+                    self.movie_info[int(mid)] = MovieInfo(mid, cats, title)
+                    title_words.update(w.lower() for w in title.split())
+            with z.open("ml-1m/users.dat") as f:
+                for line in f:
+                    uid, gender, age, job, _ = (line.decode("latin")
+                                                .strip().split("::"))
+                    self.user_info[int(uid)] = UserInfo(uid, gender, age, job)
+        self.movie_title_dict = {w: i for i, w in enumerate(sorted(title_words))}
+        self.categories_dict = {c: i for i, c in enumerate(sorted(categories))}
+
+    def _load(self):
+        self.data = []
+        is_test = self.mode == "test"
+        with zipfile.ZipFile(self.data_file) as z:
+            with z.open("ml-1m/ratings.dat") as f:
+                for line in f:
+                    if (np.random.random() < self.test_ratio) != is_test:
+                        continue
+                    uid, mid, rating, _ = (line.decode("latin")
+                                           .strip().split("::"))
+                    usr = self.user_info[int(uid)]
+                    mov = self.movie_info[int(mid)]
+                    self.data.append(
+                        usr.value()
+                        + mov.value(self.categories_dict,
+                                    self.movie_title_dict)
+                        + [[float(rating) * 2 - 5.0]])
+
+    def __getitem__(self, idx):
+        return tuple(np.array(d) for d in self.data[idx])
+
+    def __len__(self):
+        return len(self.data)
+
+
+# ---------------------------------------------------------------- Conll05st
+
+CONLL_DATA_URL = ("https://paddlemodels.cdn.bcebos.com/conll05st/"
+                  "conll05st-tests.tar.gz")
+CONLL_DATA_MD5 = "387719152ae52d60422c016e92a742fc"
+CONLL_WORDDICT_URL = ("https://paddlemodels.cdn.bcebos.com/conll05st/"
+                      "wordDict.txt")
+CONLL_WORDDICT_MD5 = "ea7fb7d4c75cc6254716f0177a506baa"
+CONLL_VERBDICT_URL = ("https://paddlemodels.cdn.bcebos.com/conll05st/"
+                      "verbDict.txt")
+CONLL_VERBDICT_MD5 = "0d2977293bbb6cbefab5b0f97db1e77c"
+CONLL_TRGDICT_URL = ("https://paddlemodels.cdn.bcebos.com/conll05st/"
+                     "targetDict.txt")
+CONLL_TRGDICT_MD5 = "d8c7f03ceb5fc2e5a0fa7503a4353751"
+CONLL_EMB_URL = "https://paddlemodels.cdn.bcebos.com/conll05st/emb"
+CONLL_EMB_MD5 = "bf436eb0faa1f6f9103017f8be57cdb7"
+
+_UNK_IDX = 0
+
+
+class Conll05st(Dataset):
+    """CoNLL-2005 SRL test split: WSJ words + per-predicate prop columns
+    expanded into one (sentence, predicate, BIO labels) sample per verb
+    (ref conll05.py). Yields the 9-array feature tuple (word ids, 5 context
+    windows, predicate id, mark, label ids)."""
+
+    def __init__(self, data_file=None, word_dict_file=None,
+                 verb_dict_file=None, target_dict_file=None, emb_file=None,
+                 download=True):
+        self.data_file = _check_exists_and_download(
+            data_file, CONLL_DATA_URL, CONLL_DATA_MD5, "conll05st", download)
+        self.word_dict_file = _check_exists_and_download(
+            word_dict_file, CONLL_WORDDICT_URL, CONLL_WORDDICT_MD5,
+            "conll05st", download)
+        self.verb_dict_file = _check_exists_and_download(
+            verb_dict_file, CONLL_VERBDICT_URL, CONLL_VERBDICT_MD5,
+            "conll05st", download)
+        self.target_dict_file = _check_exists_and_download(
+            target_dict_file, CONLL_TRGDICT_URL, CONLL_TRGDICT_MD5,
+            "conll05st", download)
+        self.emb_file = emb_file  # optional; only served via get_embedding
+        self.word_dict = self._load_dict(self.word_dict_file)
+        self.predicate_dict = self._load_dict(self.verb_dict_file)
+        self.label_dict = self._load_label_dict(self.target_dict_file)
+        self._load_anno()
+
+    @staticmethod
+    def _load_dict(path):
+        with open(path) as f:
+            return {ln.strip(): i for i, ln in enumerate(f)}
+
+    @staticmethod
+    def _load_label_dict(path):
+        """Expand the target dict the reference way: 'B-X' rows become B-X
+        and I-X, plus O."""
+        d, idx = {}, 0
+        with open(path) as f:
+            for ln in f:
+                tag = ln.strip()
+                if tag.startswith("B-"):
+                    d["B-" + tag[2:]] = idx
+                    idx += 1
+                    d["I-" + tag[2:]] = idx
+                    idx += 1
+                elif tag == "O":
+                    d["O"] = idx
+                    idx += 1
+        return d
+
+    @staticmethod
+    def _props_to_bio(label_cols):
+        """One prop column (bracket spans: '(A0*', '*', '*)', '(V*)') ->
+        per-token BIO sequence."""
+        seq, cur, inside = [], "O", False
+        for tok in label_cols:
+            if tok == "*" and not inside:
+                seq.append("O")
+            elif tok == "*" and inside:
+                seq.append("I-" + cur)
+            elif tok == "*)":
+                seq.append("I-" + cur)
+                inside = False
+            elif "(" in tok and ")" in tok:
+                cur = tok[1:tok.find("*")]
+                seq.append("B-" + cur)
+                inside = False
+            elif "(" in tok:
+                cur = tok[1:tok.find("*")]
+                seq.append("B-" + cur)
+                inside = True
+            else:
+                raise RuntimeError(f"unexpected prop label {tok!r}")
+        return seq
+
+    def _load_anno(self):
+        self.sentences, self.predicates, self.labels = [], [], []
+        with tarfile.open(self.data_file) as tf:
+            wf = tf.extractfile(
+                "conll05st-release/test.wsj/words/test.wsj.words.gz")
+            pf = tf.extractfile(
+                "conll05st-release/test.wsj/props/test.wsj.props.gz")
+            with gzip.GzipFile(fileobj=wf) as words, \
+                    gzip.GzipFile(fileobj=pf) as props:
+
+                def flush(sentence, columns):
+                    if not columns:
+                        return
+                    verbs = [v for v in
+                             (row[0] for row in columns) if v != "-"]
+                    n_pred = len(columns[0]) - 1
+                    for k in range(n_pred):
+                        bio = self._props_to_bio(
+                            [row[k + 1] for row in columns])
+                        self.sentences.append(list(sentence))
+                        self.predicates.append(verbs[k])
+                        self.labels.append(bio)
+
+                sentence, columns = [], []
+                for wline, pline in zip(words, props):
+                    word = wline.strip().decode()
+                    cols = pline.strip().decode().split()
+                    if not cols:  # sentence boundary
+                        flush(sentence, columns)
+                        sentence, columns = [], []
+                    else:
+                        sentence.append(word)
+                        columns.append(cols)
+                flush(sentence, columns)  # file may not end with a blank line
+
+    def __getitem__(self, idx):
+        sentence = self.sentences[idx]
+        labels = self.labels[idx]
+        n = len(sentence)
+        v = labels.index("B-V")
+        mark = [0] * n
+        ctx = {}
+        for off, name, pad in ((-2, "n2", "bos"), (-1, "n1", "bos"),
+                               (0, "0", None), (1, "p1", "eos"),
+                               (2, "p2", "eos")):
+            j = v + off
+            if 0 <= j < n:
+                mark[j] = 1
+                ctx[name] = sentence[j]
+            else:
+                ctx[name] = pad
+        word_idx = [self.word_dict.get(w, _UNK_IDX) for w in sentence]
+        ctxs = [[self.word_dict.get(ctx[name], _UNK_IDX)] * n
+                for name in ("n2", "n1", "0", "p1", "p2")]
+        pred = self.predicates[idx]
+        if pred not in self.predicate_dict:
+            raise KeyError(f"predicate {pred!r} missing from verb dict")
+        pred_idx = [self.predicate_dict[pred]] * n
+        missing = [t for t in labels if t not in self.label_dict]
+        if missing:
+            raise KeyError(f"label tags {sorted(set(missing))} missing from "
+                           "target dict")
+        label_idx = [self.label_dict[tag] for tag in labels]
+        return tuple(np.array(a) for a in
+                     [word_idx, *ctxs, pred_idx, mark, label_idx])
+
+    def __len__(self):
+        return len(self.sentences)
+
+    def get_dict(self):
+        return self.word_dict, self.predicate_dict, self.label_dict
+
+    def get_embedding(self):
+        if self.emb_file is None:
+            self.emb_file = _check_exists_and_download(
+                None, CONLL_EMB_URL, CONLL_EMB_MD5, "conll05st", True)
+        return self.emb_file
+
+
+# ------------------------------------------------------------- WMT14/WMT16
+
+WMT14_URL = ("https://paddlemodels.cdn.bcebos.com/wmt/wmt14.tgz")
+WMT14_MD5 = "0791583d57d5beb693b9414c5b36798c"
+_START, _END, _UNK = "<s>", "<e>", "<unk>"
+
+
+class WMT14(Dataset):
+    """WMT14 en→fr subset: src/trg dicts truncated to dict_size, tab-split
+    parallel text, sequences over 80 tokens dropped (ref wmt14.py)."""
+
+    def __init__(self, data_file=None, mode="train", dict_size=-1,
+                 download=True):
+        if mode.lower() not in ("train", "test", "gen"):
+            raise ValueError(f"mode should be train/test/gen, got {mode}")
+        self.mode = mode.lower()
+        if dict_size <= 0:
+            raise ValueError("dict_size must be a positive number")
+        self.dict_size = dict_size
+        self.data_file = _check_exists_and_download(
+            data_file, WMT14_URL, WMT14_MD5, "wmt14", download)
+        self._load()
+
+    def _load(self):
+        def to_dict(fd, size):
+            d = {}
+            for i, ln in enumerate(fd):
+                if i >= size:
+                    break
+                d[ln.strip().decode()] = i
+            return d
+
+        self.src_ids, self.trg_ids, self.trg_ids_next = [], [], []
+        with tarfile.open(self.data_file) as tf:
+            names = tf.getnames()
+            (src_dict_name,) = [n for n in names if n.endswith("src.dict")]
+            (trg_dict_name,) = [n for n in names if n.endswith("trg.dict")]
+            self.src_dict = to_dict(tf.extractfile(src_dict_name),
+                                    self.dict_size)
+            self.trg_dict = to_dict(tf.extractfile(trg_dict_name),
+                                    self.dict_size)
+            data_names = [n for n in names
+                          if n.endswith(f"{self.mode}/{self.mode}")]
+            for name in data_names:
+                for ln in tf.extractfile(name):
+                    parts = ln.decode().strip().split("\t")
+                    if len(parts) != 2:
+                        continue
+                    src = [self.src_dict.get(w, _UNK_IDX_WMT) for w in
+                           [_START] + parts[0].split() + [_END]]
+                    trg = [self.trg_dict.get(w, _UNK_IDX_WMT)
+                           for w in parts[1].split()]
+                    if len(src) > 80 or len(trg) > 80:
+                        continue
+                    self.src_ids.append(src)
+                    self.trg_ids.append([self.trg_dict[_START]] + trg)
+                    self.trg_ids_next.append(trg + [self.trg_dict[_END]])
+
+    def __getitem__(self, idx):
+        return (np.array(self.src_ids[idx]), np.array(self.trg_ids[idx]),
+                np.array(self.trg_ids_next[idx]))
+
+    def __len__(self):
+        return len(self.src_ids)
+
+    def get_dict(self, reverse=False):
+        if reverse:
+            return ({v: k for k, v in self.src_dict.items()},
+                    {v: k for k, v in self.trg_dict.items()})
+        return self.src_dict, self.trg_dict
+
+
+_UNK_IDX_WMT = 2  # <s>=0 <e>=1 <unk>=2 in the wmt dict layout
+
+
+class WMT16(Dataset):
+    """WMT16 en↔de (bpe): dicts built from the train corpus on first use
+    (<s>/<e>/<unk> reserved), tab-split parallel text (ref wmt16.py)."""
+
+    def __init__(self, data_file=None, mode="train", src_dict_size=-1,
+                 trg_dict_size=-1, lang="en", download=True):
+        if mode.lower() not in ("train", "test", "val"):
+            raise ValueError(f"mode should be train/test/val, got {mode}")
+        if lang not in ("en", "de"):
+            raise ValueError(f"lang should be 'en' or 'de', got {lang}")
+        if src_dict_size <= 0 or trg_dict_size <= 0:
+            raise ValueError("dict sizes must be positive numbers")
+        self.mode = mode.lower()
+        self.lang = lang
+        self.data_file = _check_exists_and_download(
+            data_file, "https://paddlemodels.cdn.bcebos.com/wmt/wmt16.tar.gz",
+            "0c38be43600334966403524a40dcd81e", "wmt16", download)
+        self.src_dict = self._build_dict(src_dict_size, src=True)
+        self.trg_dict = self._build_dict(trg_dict_size, src=False)
+        self._load()
+
+    def _build_dict(self, size, src):
+        lang_col = 0 if (self.lang == "en") == src else 1
+        freq: dict = collections.defaultdict(int)
+        with tarfile.open(self.data_file) as tf:
+            for ln in tf.extractfile("wmt16/train"):
+                parts = ln.decode().strip().split("\t")
+                if len(parts) != 2:
+                    continue
+                for w in parts[lang_col].split():
+                    freq[w] += 1
+        words = [w for w, _ in
+                 sorted(freq.items(), key=lambda kv: kv[1], reverse=True)]
+        vocab = [_START, _END, _UNK] + words[:max(size - 3, 0)]
+        return {w: i for i, w in enumerate(vocab)}
+
+    def _load(self):
+        start, end = self.src_dict[_START], self.src_dict[_END]
+        unk = self.src_dict[_UNK]
+        src_col = 0 if self.lang == "en" else 1
+        self.src_ids, self.trg_ids, self.trg_ids_next = [], [], []
+        with tarfile.open(self.data_file) as tf:
+            for ln in tf.extractfile(f"wmt16/{self.mode}"):
+                parts = ln.decode().strip().split("\t")
+                if len(parts) != 2:
+                    continue
+                src = ([start]
+                       + [self.src_dict.get(w, unk)
+                          for w in parts[src_col].split()] + [end])
+                trg = [self.trg_dict.get(w, unk)
+                       for w in parts[1 - src_col].split()]
+                self.src_ids.append(src)
+                self.trg_ids.append([start] + trg)
+                self.trg_ids_next.append(trg + [end])
+
+    def __getitem__(self, idx):
+        return (np.array(self.src_ids[idx]), np.array(self.trg_ids[idx]),
+                np.array(self.trg_ids_next[idx]))
+
+    def __len__(self):
+        return len(self.src_ids)
+
+    def get_dict(self, lang, reverse=False):
+        d = self.src_dict if lang == self.lang else self.trg_dict
+        return {v: k for k, v in d.items()} if reverse else d

@@ -1,0 +1,89 @@
+"""paddle.utils: monitor stats registry + small helpers
+(ref:paddle/fluid/platform/monitor.cc named int64 stats;
+ref:python/paddle/utils/)."""
+from __future__ import annotations
+
+import importlib
+import threading
+from collections import defaultdict
+
+__all__ = ["monitor", "try_import", "unique_name", "run_check"]
+
+
+class _Monitor:
+    """Named int64 counters/gauges (the monitor.cc registry): thread-safe,
+    queryable, resettable — the hook point for framework-internal stats."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._stats = defaultdict(int)
+
+    def add(self, name: str, delta: int = 1) -> int:
+        with self._lock:
+            self._stats[name] += int(delta)
+            return self._stats[name]
+
+    def set(self, name: str, value: int):
+        with self._lock:
+            self._stats[name] = int(value)
+
+    def get(self, name: str) -> int:
+        with self._lock:
+            return self._stats.get(name, 0)
+
+    def max(self, name: str, value: int):
+        with self._lock:
+            self._stats[name] = max(self._stats.get(name, 0), int(value))
+
+    def stats(self) -> dict:
+        with self._lock:
+            return dict(self._stats)
+
+    def reset(self, name=None):
+        with self._lock:
+            if name is None:
+                self._stats.clear()
+            else:
+                self._stats.pop(name, None)
+
+
+monitor = _Monitor()
+
+
+def try_import(module_name: str, err_msg: str = None):
+    """ref:python/paddle/utils/lazy_import.py try_import."""
+    try:
+        return importlib.import_module(module_name)
+    except ImportError:
+        raise ImportError(
+            err_msg or f"module {module_name!r} is required but not installed")
+
+
+class _UniqueNames:
+    def __init__(self):
+        self._counters = defaultdict(int)
+        self._lock = threading.Lock()
+
+    def generate(self, key: str = "") -> str:
+        with self._lock:
+            n = self._counters[key]
+            self._counters[key] += 1
+        return f"{key}_{n}" if key else str(n)
+
+
+unique_name = _UniqueNames()
+
+
+def run_check():
+    """paddle.utils.run_check: verify the install can compile + run a
+    program on the available device."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    out = jax.jit(lambda a: a @ a)(jnp.ones((8, 8), jnp.float32))
+    assert float(np.asarray(out)[0, 0]) == 8.0
+    dev = jax.devices()[0]
+    print(f"paddle_tpu is installed successfully! "
+          f"(compiled and ran on {dev.platform}:{dev.id})")
+    return True

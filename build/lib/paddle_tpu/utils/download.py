@@ -1,0 +1,91 @@
+"""Dataset/weights download cache (ref:python/paddle/utils/download.py and
+ref:python/paddle/dataset/common.py DATA_HOME): fetch a URL once into
+``~/.cache/paddle_tpu/dataset/<name>/``, verify md5, optionally decompress.
+
+Network access is environment-dependent (this sandbox has none); every
+dataset class therefore also accepts an explicit ``data_file`` path, which is
+what the tests use.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import shutil
+import tarfile
+import zipfile
+
+DATA_HOME = os.environ.get(
+    "PADDLE_TPU_DATA_HOME",
+    os.path.join(os.path.expanduser("~"), ".cache", "paddle_tpu", "dataset"))
+
+__all__ = ["DATA_HOME", "get_path_from_url", "get_weights_path_from_url"]
+
+
+def _md5check(path: str, md5sum: str | None) -> bool:
+    if not md5sum:
+        return True
+    h = hashlib.md5()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest() == md5sum
+
+
+def _download(url: str, dst_dir: str, md5sum: str | None) -> str:
+    import urllib.request
+
+    os.makedirs(dst_dir, exist_ok=True)
+    fname = os.path.basename(url.split("?")[0]) or "download"
+    fullpath = os.path.join(dst_dir, fname)
+    if os.path.exists(fullpath) and _md5check(fullpath, md5sum):
+        return fullpath
+    tmp = fullpath + ".part"
+    with urllib.request.urlopen(url) as r, open(tmp, "wb") as f:
+        shutil.copyfileobj(r, f)
+    if not _md5check(tmp, md5sum):
+        os.remove(tmp)
+        raise RuntimeError(f"md5 mismatch downloading {url}")
+    os.replace(tmp, fullpath)
+    return fullpath
+
+
+def _decompress(path: str) -> str:
+    dst = os.path.dirname(path)
+    if tarfile.is_tarfile(path):
+        with tarfile.open(path) as tf:
+            tf.extractall(dst)
+    elif zipfile.is_zipfile(path):
+        with zipfile.ZipFile(path) as zf:
+            zf.extractall(dst)
+    return dst
+
+
+def get_path_from_url(url: str, root_dir: str | None = None,
+                      md5sum: str | None = None, check_exist: bool = True,
+                      decompress: bool = False) -> str:
+    """Download ``url`` into ``root_dir`` (default DATA_HOME), verify md5,
+    and return the local file path (optionally decompressing archives)."""
+    root_dir = root_dir or DATA_HOME
+    fname = os.path.basename(url.split("?")[0]) or "download"
+    fullpath = os.path.join(root_dir, fname)
+    if not (check_exist and os.path.exists(fullpath)
+            and _md5check(fullpath, md5sum)):
+        fullpath = _download(url, root_dir, md5sum)
+    if decompress:
+        _decompress(fullpath)
+    return fullpath
+
+
+def get_weights_path_from_url(url: str, md5sum: str | None = None) -> str:
+    return get_path_from_url(
+        url, os.path.join(os.path.dirname(DATA_HOME), "weights"), md5sum)
+
+
+def _check_exists_and_download(path, url, md5sum, module_name, download):
+    """The per-dataset gate (ref:python/paddle/dataset/common.py): honor an
+    explicit path, else download into DATA_HOME/<module_name>."""
+    if path and os.path.exists(path):
+        return path
+    if not download:
+        raise ValueError(f"{path} not exists and auto download disabled")
+    return get_path_from_url(url, os.path.join(DATA_HOME, module_name), md5sum)

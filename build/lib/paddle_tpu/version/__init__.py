@@ -1,0 +1,62 @@
+"""paddle.version (ref: generated python/paddle/version/__init__.py):
+version metadata + show()."""
+from __future__ import annotations
+
+import subprocess
+
+try:  # single source of truth: the package __version__ (set before this
+    from .. import __version__ as full_version  # module is imported)
+except ImportError:  # pragma: no cover
+    full_version = "0.2.0"
+major, minor, patch = (full_version.split(".") + ["0", "0"])[:3]
+rc = "0"
+istaged = True
+with_gpu = "False"  # TPU build
+cuda_version = "False"
+cudnn_version = "False"
+xpu_version = "False"
+
+
+def _commit() -> str:
+    try:
+        import os
+
+        root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        out = subprocess.run(["git", "-C", root, "rev-parse", "HEAD"],
+                             capture_output=True, text=True, timeout=5)
+        return out.stdout.strip() or "unknown"
+    except Exception:
+        return "unknown"
+
+
+def __getattr__(name):  # PEP 562: no git subprocess at import time
+    if name == "commit":
+        val = _commit()
+        globals()["commit"] = val
+        return val
+    raise AttributeError(f"module 'paddle_tpu.version' has no attribute {name!r}")
+
+
+def show():
+    """Print the version info (the reference prints commit or full_version
+    depending on whether the build is tagged)."""
+    if istaged:
+        print("full_version:", full_version)
+        print("major:", major)
+        print("minor:", minor)
+        print("patch:", patch)
+        print("rc:", rc)
+    print("commit:", globals().get("commit") or _commit())
+
+
+def cuda() -> str:
+    return cuda_version
+
+
+def cudnn() -> str:
+    return cudnn_version
+
+
+def xpu() -> str:
+    return xpu_version

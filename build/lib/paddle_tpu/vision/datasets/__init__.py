@@ -128,3 +128,13 @@ class Cifar100(Cifar10):
             d = pickle.load(f, encoding="bytes")
         self.images = np.asarray(d[b"data"]).reshape(-1, 3, 32, 32)
         self.labels = np.asarray(d[b"fine_labels"], np.int64).reshape(-1, 1)
+
+
+# directory-tree and download-backed datasets
+from .folder import (DatasetFolder, ImageFolder,  # noqa: E402
+                     has_valid_extension, make_dataset)
+from .flowers import Flowers  # noqa: E402
+from .voc2012 import VOC2012  # noqa: E402
+
+__all__ = ["FakeData", "MNIST", "FashionMNIST", "Cifar10", "Cifar100",
+           "DatasetFolder", "ImageFolder", "Flowers", "VOC2012"]

@@ -1,0 +1,78 @@
+"""Oxford 102 Flowers (ref:python/paddle/vision/datasets/flowers.py):
+images tgz + .mat label/split files, modes train/valid/test."""
+from __future__ import annotations
+
+import os
+import tarfile
+
+import numpy as np
+
+from ...io import Dataset
+from ...utils.download import _check_exists_and_download
+
+__all__ = ["Flowers"]
+
+DATA_URL = "https://paddlemodels.cdn.bcebos.com/flowers/102flowers.tgz"
+LABEL_URL = "https://paddlemodels.cdn.bcebos.com/flowers/imagelabels.mat"
+SETID_URL = "https://paddlemodels.cdn.bcebos.com/flowers/setid.mat"
+DATA_MD5 = "52808999861908f626f3c1f4e79d11fa"
+LABEL_MD5 = "e0620be6f572b9609742df49c70aed4d"
+SETID_MD5 = "a5357ecc9cb78c4bef273ce3793fc85c"
+# which setid.mat key holds each split's 1-based image indices
+_MODE_FLAG = {"train": "trnid", "valid": "valid", "test": "tstid"}
+
+
+class Flowers(Dataset):
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=True, backend=None):
+        if mode.lower() not in _MODE_FLAG:
+            raise ValueError(f"mode should be train/valid/test, got {mode}")
+        self.mode = mode.lower()
+        backend = backend or "pil"
+        if backend not in ("pil", "cv2"):
+            raise ValueError(f"backend must be 'pil' or 'cv2', got {backend}")
+        self.backend = backend
+        self.transform = transform
+
+        data_file = _check_exists_and_download(
+            data_file, DATA_URL, DATA_MD5, "flowers", download)
+        label_file = _check_exists_and_download(
+            label_file, LABEL_URL, LABEL_MD5, "flowers", download)
+        setid_file = _check_exists_and_download(
+            setid_file, SETID_URL, SETID_MD5, "flowers", download)
+
+        # extract images next to the archive once; extract into a temp dir
+        # and rename so an interrupted extraction is never mistaken for done
+        self.data_path = data_file + ".extracted"
+        if not os.path.exists(self.data_path):
+            tmp = f"{self.data_path}.tmp{os.getpid()}"
+            with tarfile.open(data_file) as tf:
+                tf.extractall(tmp)
+            try:
+                os.rename(tmp, self.data_path)
+            except OSError:  # lost the race to another process: theirs wins
+                import shutil
+
+                shutil.rmtree(tmp, ignore_errors=True)
+
+        import scipy.io as scio
+
+        self.labels = scio.loadmat(label_file)["labels"][0]
+        self.indexes = scio.loadmat(setid_file)[_MODE_FLAG[self.mode]][0]
+        self.dtype = "float32"
+
+    def __getitem__(self, idx):
+        index = int(self.indexes[idx])  # 1-based
+        label = np.array([self.labels[index - 1]])
+        path = os.path.join(self.data_path, "jpg", f"image_{index:05d}.jpg")
+        from PIL import Image
+
+        image = Image.open(path)
+        if self.backend == "cv2":
+            image = np.asarray(image.convert("RGB"))[:, :, ::-1]  # BGR
+        if self.transform is not None:
+            image = self.transform(image)
+        return image, label.astype("int64")
+
+    def __len__(self):
+        return len(self.indexes)

@@ -1,0 +1,132 @@
+"""Directory-tree image datasets (ref:python/paddle/vision/datasets/
+folder.py): one class per subdirectory, samples discovered by extension or
+predicate."""
+from __future__ import annotations
+
+import os
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ...io import Dataset
+
+__all__ = ["DatasetFolder", "ImageFolder", "has_valid_extension",
+           "make_dataset", "default_loader"]
+
+IMG_EXTENSIONS = (".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".pgm", ".tif",
+                  ".tiff", ".webp")
+
+
+def has_valid_extension(filename: str, extensions: Sequence[str]) -> bool:
+    """True if ``filename`` ends with one of ``extensions`` (case-blind)."""
+    return filename.lower().endswith(tuple(e.lower() for e in extensions))
+
+
+def default_loader(path: str, backend: str = "pil"):
+    from PIL import Image
+
+    with Image.open(path) as img:
+        img = img.convert("RGB")
+        if backend == "cv2":
+            return np.asarray(img)[:, :, ::-1]  # RGB -> BGR, cv2 convention
+        return img.copy()
+
+
+def make_dataset(directory: str, class_to_idx: dict,
+                 extensions: Optional[Sequence[str]] = None,
+                 is_valid_file: Optional[Callable[[str], bool]] = None
+                 ) -> List[Tuple[str, int]]:
+    """Walk ``directory``/<class>/... collecting (path, class_idx) samples."""
+    if (extensions is None) == (is_valid_file is None):
+        raise ValueError(
+            "exactly one of extensions / is_valid_file must be given")
+    if extensions is not None:
+        def is_valid_file(p, _ext=tuple(extensions)):  # type: ignore
+            return has_valid_extension(p, _ext)
+    samples = []
+    directory = os.path.expanduser(directory)
+    for cls in sorted(class_to_idx):
+        d = os.path.join(directory, cls)
+        if not os.path.isdir(d):
+            continue
+        for root, _, fnames in sorted(os.walk(d, followlinks=True)):
+            for fname in sorted(fnames):
+                path = os.path.join(root, fname)
+                if is_valid_file(path):
+                    samples.append((path, class_to_idx[cls]))
+    return samples
+
+
+class DatasetFolder(Dataset):
+    """<root>/<class_name>/xxx.ext layout; yields (image, class_index)."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or default_loader
+        if extensions is None and is_valid_file is None:
+            extensions = IMG_EXTENSIONS
+        classes, class_to_idx = self._find_classes(root)
+        samples = make_dataset(root, class_to_idx, extensions, is_valid_file)
+        if not samples:
+            raise RuntimeError(
+                f"found 0 files in subfolders of {root} "
+                f"(supported extensions: {extensions})")
+        self.classes = classes
+        self.class_to_idx = class_to_idx
+        self.samples = samples
+        self.targets = [s[1] for s in samples]
+        self.dtype = "float32"
+
+    @staticmethod
+    def _find_classes(root):
+        classes = sorted(e.name for e in os.scandir(root) if e.is_dir())
+        if not classes:
+            raise RuntimeError(f"no class folders found in {root}")
+        return classes, {c: i for i, c in enumerate(classes)}
+
+    def __getitem__(self, index):
+        path, target = self.samples[index]
+        sample = self.loader(path)
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return sample, target
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class ImageFolder(Dataset):
+    """Flat (recursive) image directory with no labels; yields [image]."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or default_loader
+        if extensions is None and is_valid_file is None:
+            extensions = IMG_EXTENSIONS
+        if extensions is not None and is_valid_file is None:
+            def is_valid_file(p, _ext=tuple(extensions)):  # type: ignore
+                return has_valid_extension(p, _ext)
+        samples = []
+        for r, _, fnames in sorted(os.walk(os.path.expanduser(root),
+                                           followlinks=True)):
+            for fname in sorted(fnames):
+                p = os.path.join(r, fname)
+                if is_valid_file(p):
+                    samples.append(p)
+        if not samples:
+            raise RuntimeError(f"found 0 files in {root}")
+        self.samples = samples
+        self.dtype = "float32"
+
+    def __getitem__(self, index):
+        sample = self.loader(self.samples[index])
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return [sample]
+
+    def __len__(self):
+        return len(self.samples)

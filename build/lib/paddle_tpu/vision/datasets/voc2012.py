@@ -1,0 +1,80 @@
+"""Pascal VOC2012 segmentation (ref:python/paddle/vision/datasets/
+voc2012.py): images + class masks read straight out of the tar, split lists
+under ImageSets/Segmentation."""
+from __future__ import annotations
+
+import io as _io
+import tarfile
+
+import numpy as np
+
+from ...io import Dataset
+from ...utils.download import _check_exists_and_download
+
+__all__ = ["VOC2012"]
+
+VOC_URL = ("https://paddlemodels.cdn.bcebos.com/voc2012/VOCtrainval_11-May-2012.tar")
+VOC_MD5 = "6cd6e144f989b92b3379bac3b3de84fd"
+_SET_FILE = "VOCdevkit/VOC2012/ImageSets/Segmentation/{}.txt"
+_DATA_FILE = "VOCdevkit/VOC2012/JPEGImages/{}.jpg"
+_LABEL_FILE = "VOCdevkit/VOC2012/SegmentationClass/{}.png"
+_MODE_NAME = {"train": "train", "valid": "val", "test": "val",
+              "trainval": "trainval"}
+
+
+class VOC2012(Dataset):
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        if mode.lower() not in _MODE_NAME:
+            raise ValueError(
+                f"mode should be train/valid/test/trainval, got {mode}")
+        self.mode = mode.lower()
+        backend = backend or "pil"
+        if backend not in ("pil", "cv2"):
+            raise ValueError(f"backend must be 'pil' or 'cv2', got {backend}")
+        self.backend = backend
+        self.transform = transform
+        self.data_file = _check_exists_and_download(
+            data_file, VOC_URL, VOC_MD5, "voc2012", download)
+        self.dtype = "float32"
+        self._tar = None
+        self._load_anno()
+
+    def _tarfile(self):
+        if self._tar is None:
+            self._tar = tarfile.open(self.data_file)
+            self._name2mem = {m.name: m for m in self._tar.getmembers()}
+        return self._tar
+
+    def _load_anno(self):
+        tf = self._tarfile()
+        setf = tf.extractfile(
+            self._name2mem[_SET_FILE.format(_MODE_NAME[self.mode])])
+        self.names = [ln.strip().decode() for ln in setf if ln.strip()]
+
+    def __getitem__(self, idx):
+        from PIL import Image
+
+        tf = self._tarfile()
+        name = self.names[idx]
+        img_bytes = tf.extractfile(
+            self._name2mem[_DATA_FILE.format(name)]).read()
+        lbl_bytes = tf.extractfile(
+            self._name2mem[_LABEL_FILE.format(name)]).read()
+        image = Image.open(_io.BytesIO(img_bytes))
+        label = Image.open(_io.BytesIO(lbl_bytes))
+        if self.backend == "cv2":
+            image = np.asarray(image.convert("RGB"))[:, :, ::-1]  # BGR
+            label = np.asarray(label)  # palette mask: single channel
+        if self.transform is not None:
+            image = self.transform(image)
+        return image, label
+
+    def __len__(self):
+        return len(self.names)
+
+    def __getstate__(self):  # tar handles don't pickle (DataLoader workers)
+        state = self.__dict__.copy()
+        state["_tar"] = None
+        state.pop("_name2mem", None)
+        return state

@@ -1,0 +1,453 @@
+"""Model-zoo completion: AlexNet, SqueezeNet, DenseNet, GoogLeNet,
+InceptionV3, ShuffleNetV2, MobileNetV3 (parity role:
+ref:python/paddle/vision/models/{alexnet,squeezenet,densenet,googlenet,
+inceptionv3,shufflenetv2,mobilenetv3}.py — re-implemented from the papers'
+architectures, NCHW, MXU-friendly convs)."""
+from __future__ import annotations
+
+from ... import nn
+from ...ops import manipulation as M
+
+
+class AlexNet(nn.Layer):
+    def __init__(self, num_classes=1000):
+        super().__init__()
+        self.features = nn.Sequential(
+            nn.Conv2D(3, 64, 11, stride=4, padding=2), nn.ReLU(),
+            nn.MaxPool2D(3, 2),
+            nn.Conv2D(64, 192, 5, padding=2), nn.ReLU(),
+            nn.MaxPool2D(3, 2),
+            nn.Conv2D(192, 384, 3, padding=1), nn.ReLU(),
+            nn.Conv2D(384, 256, 3, padding=1), nn.ReLU(),
+            nn.Conv2D(256, 256, 3, padding=1), nn.ReLU(),
+            nn.MaxPool2D(3, 2),
+        )
+        self.avgpool = nn.AdaptiveAvgPool2D((6, 6))
+        self.classifier = nn.Sequential(
+            nn.Dropout(0.5), nn.Linear(256 * 36, 4096), nn.ReLU(),
+            nn.Dropout(0.5), nn.Linear(4096, 4096), nn.ReLU(),
+            nn.Linear(4096, num_classes),
+        )
+
+    def forward(self, x):
+        x = self.avgpool(self.features(x))
+        return self.classifier(x.reshape([x.shape[0], -1]))
+
+
+def alexnet(pretrained=False, **kw):
+    if pretrained:
+        raise NotImplementedError("pretrained weights unavailable (no egress)")
+    return AlexNet(**kw)
+
+
+class _Fire(nn.Layer):
+    def __init__(self, cin, squeeze, e1, e3):
+        super().__init__()
+        self.squeeze = nn.Sequential(nn.Conv2D(cin, squeeze, 1), nn.ReLU())
+        self.e1 = nn.Sequential(nn.Conv2D(squeeze, e1, 1), nn.ReLU())
+        self.e3 = nn.Sequential(nn.Conv2D(squeeze, e3, 3, padding=1), nn.ReLU())
+
+    def forward(self, x):
+        s = self.squeeze(x)
+        return M.concat([self.e1(s), self.e3(s)], axis=1)
+
+
+class SqueezeNet(nn.Layer):
+    def __init__(self, version="1.0", num_classes=1000):
+        super().__init__()
+        if version == "1.0":
+            self.features = nn.Sequential(
+                nn.Conv2D(3, 96, 7, stride=2), nn.ReLU(), nn.MaxPool2D(3, 2),
+                _Fire(96, 16, 64, 64), _Fire(128, 16, 64, 64),
+                _Fire(128, 32, 128, 128), nn.MaxPool2D(3, 2),
+                _Fire(256, 32, 128, 128), _Fire(256, 48, 192, 192),
+                _Fire(384, 48, 192, 192), _Fire(384, 64, 256, 256),
+                nn.MaxPool2D(3, 2), _Fire(512, 64, 256, 256),
+            )
+        else:
+            self.features = nn.Sequential(
+                nn.Conv2D(3, 64, 3, stride=2), nn.ReLU(), nn.MaxPool2D(3, 2),
+                _Fire(64, 16, 64, 64), _Fire(128, 16, 64, 64),
+                nn.MaxPool2D(3, 2), _Fire(128, 32, 128, 128),
+                _Fire(256, 32, 128, 128), nn.MaxPool2D(3, 2),
+                _Fire(256, 48, 192, 192), _Fire(384, 48, 192, 192),
+                _Fire(384, 64, 256, 256), _Fire(512, 64, 256, 256),
+            )
+        self.classifier = nn.Sequential(
+            nn.Dropout(0.5), nn.Conv2D(512, num_classes, 1), nn.ReLU(),
+            nn.AdaptiveAvgPool2D((1, 1)),
+        )
+
+    def forward(self, x):
+        x = self.classifier(self.features(x))
+        return x.reshape([x.shape[0], -1])
+
+
+def squeezenet1_0(pretrained=False, **kw):
+    return SqueezeNet("1.0", **kw)
+
+
+def squeezenet1_1(pretrained=False, **kw):
+    return SqueezeNet("1.1", **kw)
+
+
+class _DenseLayer(nn.Layer):
+    def __init__(self, cin, growth, bn_size):
+        super().__init__()
+        self.norm1 = nn.BatchNorm2D(cin)
+        self.relu = nn.ReLU()
+        self.conv1 = nn.Conv2D(cin, bn_size * growth, 1, bias_attr=False)
+        self.norm2 = nn.BatchNorm2D(bn_size * growth)
+        self.conv2 = nn.Conv2D(bn_size * growth, growth, 3, padding=1,
+                               bias_attr=False)
+
+    def forward(self, x):
+        h = self.conv1(self.relu(self.norm1(x)))
+        h = self.conv2(self.relu(self.norm2(h)))
+        return M.concat([x, h], axis=1)
+
+
+class DenseNet(nn.Layer):
+    CFG = {121: (6, 12, 24, 16), 161: (6, 12, 36, 24),
+           169: (6, 12, 32, 32), 201: (6, 12, 48, 32),
+           264: (6, 12, 64, 48)}
+
+    def __init__(self, layers=121, growth_rate=32, num_init_features=64,
+                 bn_size=4, dropout=0.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        if layers == 161:
+            growth_rate, num_init_features = 48, 96
+        blocks = self.CFG[layers]
+        feats = [nn.Conv2D(3, num_init_features, 7, stride=2, padding=3,
+                           bias_attr=False),
+                 nn.BatchNorm2D(num_init_features), nn.ReLU(),
+                 nn.MaxPool2D(3, 2, padding=1)]
+        c = num_init_features
+        for i, n in enumerate(blocks):
+            for _ in range(n):
+                feats.append(_DenseLayer(c, growth_rate, bn_size))
+                c += growth_rate
+            if i != len(blocks) - 1:
+                feats += [nn.BatchNorm2D(c), nn.ReLU(),
+                          nn.Conv2D(c, c // 2, 1, bias_attr=False),
+                          nn.AvgPool2D(2, 2)]
+                c //= 2
+        feats += [nn.BatchNorm2D(c), nn.ReLU()]
+        self.features = nn.Sequential(*feats)
+        self.with_pool = with_pool
+        if with_pool:
+            self.avgpool = nn.AdaptiveAvgPool2D((1, 1))
+        self.classifier = nn.Linear(c, num_classes) if num_classes > 0 else None
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.classifier is not None:
+            x = self.classifier(x.reshape([x.shape[0], -1]))
+        return x
+
+
+def densenet121(pretrained=False, **kw):
+    return DenseNet(121, **kw)
+
+
+def densenet161(pretrained=False, **kw):
+    return DenseNet(161, **kw)
+
+
+def densenet169(pretrained=False, **kw):
+    return DenseNet(169, **kw)
+
+
+def densenet201(pretrained=False, **kw):
+    return DenseNet(201, **kw)
+
+
+def densenet264(pretrained=False, **kw):
+    return DenseNet(264, **kw)
+
+
+class _InceptionA(nn.Layer):
+    """GoogLeNet inception block (two reduce paths + pool path)."""
+
+    def __init__(self, cin, c1, c3r, c3, c5r, c5, pp):
+        super().__init__()
+        self.b1 = nn.Sequential(nn.Conv2D(cin, c1, 1), nn.ReLU())
+        self.b3 = nn.Sequential(nn.Conv2D(cin, c3r, 1), nn.ReLU(),
+                                nn.Conv2D(c3r, c3, 3, padding=1), nn.ReLU())
+        self.b5 = nn.Sequential(nn.Conv2D(cin, c5r, 1), nn.ReLU(),
+                                nn.Conv2D(c5r, c5, 5, padding=2), nn.ReLU())
+        self.bp = nn.Sequential(nn.MaxPool2D(3, 1, padding=1),
+                                nn.Conv2D(cin, pp, 1), nn.ReLU())
+
+    def forward(self, x):
+        return M.concat([self.b1(x), self.b3(x), self.b5(x), self.bp(x)], axis=1)
+
+
+class GoogLeNet(nn.Layer):
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.stem = nn.Sequential(
+            nn.Conv2D(3, 64, 7, stride=2, padding=3), nn.ReLU(),
+            nn.MaxPool2D(3, 2, padding=1),
+            nn.Conv2D(64, 64, 1), nn.ReLU(),
+            nn.Conv2D(64, 192, 3, padding=1), nn.ReLU(),
+            nn.MaxPool2D(3, 2, padding=1),
+        )
+        self.i3a = _InceptionA(192, 64, 96, 128, 16, 32, 32)
+        self.i3b = _InceptionA(256, 128, 128, 192, 32, 96, 64)
+        self.pool3 = nn.MaxPool2D(3, 2, padding=1)
+        self.i4a = _InceptionA(480, 192, 96, 208, 16, 48, 64)
+        self.i4b = _InceptionA(512, 160, 112, 224, 24, 64, 64)
+        self.i4c = _InceptionA(512, 128, 128, 256, 24, 64, 64)
+        self.i4d = _InceptionA(512, 112, 144, 288, 32, 64, 64)
+        self.i4e = _InceptionA(528, 256, 160, 320, 32, 128, 128)
+        self.pool4 = nn.MaxPool2D(3, 2, padding=1)
+        self.i5a = _InceptionA(832, 256, 160, 320, 32, 128, 128)
+        self.i5b = _InceptionA(832, 384, 192, 384, 48, 128, 128)
+        self.avgpool = nn.AdaptiveAvgPool2D((1, 1))
+        self.dropout = nn.Dropout(0.2)
+        self.fc = nn.Linear(1024, num_classes)
+
+    def forward(self, x):
+        x = self.stem(x)
+        x = self.pool3(self.i3b(self.i3a(x)))
+        x = self.pool4(self.i4e(self.i4d(self.i4c(self.i4b(self.i4a(x))))))
+        x = self.i5b(self.i5a(x))
+        x = self.dropout(self.avgpool(x))
+        return self.fc(x.reshape([x.shape[0], -1]))
+
+
+def googlenet(pretrained=False, **kw):
+    return GoogLeNet(**kw)
+
+
+class InceptionV3(nn.Layer):
+    """Compact InceptionV3: stem + inception-A stacks + reduction (the full
+    figure-10 topology at parity depth; factorized 7x7 columns are folded
+    into 3x3 pairs which XLA fuses identically on the MXU)."""
+
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        def cbr(cin, cout, k, **kw):
+            return nn.Sequential(
+                nn.Conv2D(cin, cout, k, bias_attr=False, **kw),
+                nn.BatchNorm2D(cout), nn.ReLU())
+
+        self.stem = nn.Sequential(
+            cbr(3, 32, 3, stride=2), cbr(32, 32, 3), cbr(32, 64, 3, padding=1),
+            nn.MaxPool2D(3, 2), cbr(64, 80, 1), cbr(80, 192, 3),
+            nn.MaxPool2D(3, 2),
+        )
+        self.a1 = _InceptionA(192, 64, 48, 64, 64, 96, 32)
+        self.a2 = _InceptionA(256, 64, 48, 64, 64, 96, 64)
+        self.a3 = _InceptionA(288, 64, 48, 64, 64, 96, 64)
+        self.reduce = nn.Sequential(cbr(288, 768, 3, stride=2))
+        self.b1 = _InceptionA(768, 192, 128, 192, 128, 192, 192)
+        self.b2 = _InceptionA(768, 192, 160, 192, 160, 192, 192)
+        self.avgpool = nn.AdaptiveAvgPool2D((1, 1))
+        self.fc = nn.Linear(768, num_classes)
+
+    def forward(self, x):
+        x = self.stem(x)
+        x = self.a3(self.a2(self.a1(x)))
+        x = self.reduce(x)
+        x = self.b2(self.b1(x))
+        x = self.avgpool(x)
+        return self.fc(x.reshape([x.shape[0], -1]))
+
+
+def inception_v3(pretrained=False, **kw):
+    return InceptionV3(**kw)
+
+
+class _ShuffleUnit(nn.Layer):
+    def __init__(self, cin, cout, stride, act):
+        super().__init__()
+        self.stride = stride
+        branch = cout // 2
+        Act = nn.Swish if act == "swish" else nn.ReLU
+        if stride == 2:
+            self.branch1 = nn.Sequential(
+                nn.Conv2D(cin, cin, 3, stride=2, padding=1, groups=cin,
+                          bias_attr=False),
+                nn.BatchNorm2D(cin),
+                nn.Conv2D(cin, branch, 1, bias_attr=False),
+                nn.BatchNorm2D(branch), Act(),
+            )
+            in2 = cin
+        else:
+            self.branch1 = None
+            in2 = cin // 2
+        self.branch2 = nn.Sequential(
+            nn.Conv2D(in2, branch, 1, bias_attr=False),
+            nn.BatchNorm2D(branch), Act(),
+            nn.Conv2D(branch, branch, 3, stride=stride, padding=1,
+                      groups=branch, bias_attr=False),
+            nn.BatchNorm2D(branch),
+            nn.Conv2D(branch, branch, 1, bias_attr=False),
+            nn.BatchNorm2D(branch), Act(),
+        )
+        self.shuffle = nn.ChannelShuffle(2)
+
+    def forward(self, x):
+        if self.stride == 2:
+            out = M.concat([self.branch1(x), self.branch2(x)], axis=1)
+        else:
+            c = x.shape[1] // 2
+            x1, x2 = x[:, :c], x[:, c:]
+            out = M.concat([x1, self.branch2(x2)], axis=1)
+        return self.shuffle(out)
+
+
+class ShuffleNetV2(nn.Layer):
+    WIDTH = {0.25: (24, 24, 48, 96, 512), 0.33: (24, 32, 64, 128, 512),
+             0.5: (24, 48, 96, 192, 1024), 1.0: (24, 116, 232, 464, 1024),
+             1.5: (24, 176, 352, 704, 1024), 2.0: (24, 244, 488, 976, 2048)}
+
+    def __init__(self, scale=1.0, act="relu", num_classes=1000, with_pool=True):
+        super().__init__()
+        c0, c1, c2, c3, c4 = self.WIDTH[scale]
+        self.stem = nn.Sequential(
+            nn.Conv2D(3, c0, 3, stride=2, padding=1, bias_attr=False),
+            nn.BatchNorm2D(c0), nn.ReLU(), nn.MaxPool2D(3, 2, padding=1))
+        stages = []
+        cin = c0
+        for cout, reps in zip((c1, c2, c3), (4, 8, 4)):
+            stages.append(_ShuffleUnit(cin, cout, 2, act))
+            for _ in range(reps - 1):
+                stages.append(_ShuffleUnit(cout, cout, 1, act))
+            cin = cout
+        self.stages = nn.Sequential(*stages)
+        self.tail = nn.Sequential(nn.Conv2D(c3, c4, 1, bias_attr=False),
+                                  nn.BatchNorm2D(c4), nn.ReLU())
+        self.avgpool = nn.AdaptiveAvgPool2D((1, 1))
+        self.fc = nn.Linear(c4, num_classes)
+
+    def forward(self, x):
+        x = self.tail(self.stages(self.stem(x)))
+        x = self.avgpool(x)
+        return self.fc(x.reshape([x.shape[0], -1]))
+
+
+def shufflenet_v2_x0_25(pretrained=False, **kw):
+    return ShuffleNetV2(0.25, **kw)
+
+
+def shufflenet_v2_x0_33(pretrained=False, **kw):
+    return ShuffleNetV2(0.33, **kw)
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kw):
+    return ShuffleNetV2(0.5, **kw)
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kw):
+    return ShuffleNetV2(1.0, **kw)
+
+
+def shufflenet_v2_x1_5(pretrained=False, **kw):
+    return ShuffleNetV2(1.5, **kw)
+
+
+def shufflenet_v2_x2_0(pretrained=False, **kw):
+    return ShuffleNetV2(2.0, **kw)
+
+
+def shufflenet_v2_swish(pretrained=False, **kw):
+    return ShuffleNetV2(1.0, act="swish", **kw)
+
+
+class _SEBlock(nn.Layer):
+    def __init__(self, c, r=4):
+        super().__init__()
+        self.pool = nn.AdaptiveAvgPool2D((1, 1))
+        self.fc1 = nn.Conv2D(c, c // r, 1)
+        self.fc2 = nn.Conv2D(c // r, c, 1)
+        self.relu = nn.ReLU()
+        self.hsig = nn.Hardsigmoid()
+
+    def forward(self, x):
+        s = self.hsig(self.fc2(self.relu(self.fc1(self.pool(x)))))
+        return x * s
+
+
+class _MBConvV3(nn.Layer):
+    def __init__(self, cin, exp, cout, k, stride, se, act):
+        super().__init__()
+        Act = nn.Hardswish if act == "hs" else nn.ReLU
+        layers = []
+        if exp != cin:
+            layers += [nn.Conv2D(cin, exp, 1, bias_attr=False),
+                       nn.BatchNorm2D(exp), Act()]
+        layers += [nn.Conv2D(exp, exp, k, stride=stride, padding=k // 2,
+                             groups=exp, bias_attr=False),
+                   nn.BatchNorm2D(exp), Act()]
+        if se:
+            layers.append(_SEBlock(exp))
+        layers += [nn.Conv2D(exp, cout, 1, bias_attr=False),
+                   nn.BatchNorm2D(cout)]
+        self.block = nn.Sequential(*layers)
+        self.res = stride == 1 and cin == cout
+
+    def forward(self, x):
+        out = self.block(x)
+        return x + out if self.res else out
+
+
+class MobileNetV3Small(nn.Layer):
+    CFG = [  # k, exp, out, se, act, stride
+        (3, 16, 16, True, "re", 2), (3, 72, 24, False, "re", 2),
+        (3, 88, 24, False, "re", 1), (5, 96, 40, True, "hs", 2),
+        (5, 240, 40, True, "hs", 1), (5, 240, 40, True, "hs", 1),
+        (5, 120, 48, True, "hs", 1), (5, 144, 48, True, "hs", 1),
+        (5, 288, 96, True, "hs", 2), (5, 576, 96, True, "hs", 1),
+        (5, 576, 96, True, "hs", 1),
+    ]
+    LAST = (576, 1024)
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.stem = nn.Sequential(
+            nn.Conv2D(3, 16, 3, stride=2, padding=1, bias_attr=False),
+            nn.BatchNorm2D(16), nn.Hardswish())
+        blocks = []
+        cin = 16
+        for k, exp, cout, se, act, s in self.CFG:
+            blocks.append(_MBConvV3(cin, exp, cout, k, s, se, act))
+            cin = cout
+        self.blocks = nn.Sequential(*blocks)
+        c_mid, c_last = self.LAST
+        self.tail = nn.Sequential(nn.Conv2D(cin, c_mid, 1, bias_attr=False),
+                                  nn.BatchNorm2D(c_mid), nn.Hardswish())
+        self.avgpool = nn.AdaptiveAvgPool2D((1, 1))
+        self.classifier = nn.Sequential(
+            nn.Linear(c_mid, c_last), nn.Hardswish(), nn.Dropout(0.2),
+            nn.Linear(c_last, num_classes))
+
+    def forward(self, x):
+        x = self.avgpool(self.tail(self.blocks(self.stem(x))))
+        return self.classifier(x.reshape([x.shape[0], -1]))
+
+
+class MobileNetV3Large(MobileNetV3Small):
+    CFG = [
+        (3, 16, 16, False, "re", 1), (3, 64, 24, False, "re", 2),
+        (3, 72, 24, False, "re", 1), (5, 72, 40, True, "re", 2),
+        (5, 120, 40, True, "re", 1), (5, 120, 40, True, "re", 1),
+        (3, 240, 80, False, "hs", 2), (3, 200, 80, False, "hs", 1),
+        (3, 184, 80, False, "hs", 1), (3, 184, 80, False, "hs", 1),
+        (3, 480, 112, True, "hs", 1), (3, 672, 112, True, "hs", 1),
+        (5, 672, 160, True, "hs", 2), (5, 960, 160, True, "hs", 1),
+        (5, 960, 160, True, "hs", 1),
+    ]
+    LAST = (960, 1280)
+
+
+def mobilenet_v3_small(pretrained=False, **kw):
+    return MobileNetV3Small(**kw)
+
+
+def mobilenet_v3_large(pretrained=False, **kw):
+    return MobileNetV3Large(**kw)

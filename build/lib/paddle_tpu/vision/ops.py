@@ -1,0 +1,907 @@
+"""paddle.vision.ops (ref:python/paddle/vision/ops.py): the detection op
+set — ROI pooling family, NMS family, YOLO decode/loss, SSD priors/coder,
+deformable conv, FPN distribution, proposal generation, image IO.
+
+TPU stance: the dense per-pixel math (roi_align/roi_pool/psroi_pool,
+deform_conv2d, yolo_box/yolo_loss, prior_box, box_coder) is pure jnp —
+traceable, fusable, differentiable. The inherently dynamic-shape
+postprocessing ops (nms/matrix_nms selection, distribute_fpn_proposals,
+generate_proposals, file IO) run eagerly on host arrays, which is where
+detection pipelines run them (the reference implements these as CPU/host
+kernels too).
+"""
+from __future__ import annotations
+
+import math
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+from .. import nn
+
+__all__ = ["yolo_loss", "yolo_box", "prior_box", "box_coder", "deform_conv2d",
+           "DeformConv2D", "distribute_fpn_proposals", "generate_proposals",
+           "read_file", "decode_jpeg", "roi_pool", "RoIPool", "psroi_pool",
+           "PSRoIPool", "roi_align", "RoIAlign", "nms", "matrix_nms",
+           "ConvNormActivation"]
+
+
+def _np(x):
+    return np.asarray(x._data if isinstance(x, Tensor) else x)
+
+
+def _pair(v):
+    return tuple(v) if isinstance(v, (list, tuple)) else (v, v)
+
+
+# ------------------------------------------------------------ iou helpers
+
+
+def _iou_matrix(a, b, normalized=True):
+    """[N,4] x [M,4] -> [N,M] IoU (xyxy); pixel_offset=+1 when not
+    normalized, matching the reference box area convention."""
+    off = 0.0 if normalized else 1.0
+    area_a = (a[:, 2] - a[:, 0] + off) * (a[:, 3] - a[:, 1] + off)
+    area_b = (b[:, 2] - b[:, 0] + off) * (b[:, 3] - b[:, 1] + off)
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.clip(rb - lt + off, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    return inter / jnp.maximum(area_a[:, None] + area_b[None, :] - inter,
+                               1e-10)
+
+
+# -------------------------------------------------------------------- nms
+
+
+def _greedy_nms(boxes: np.ndarray, iou_threshold: float) -> np.ndarray:
+    """Indices kept by greedy NMS over boxes already sorted by priority."""
+    n = boxes.shape[0]
+    if n == 0:
+        return np.zeros((0,), np.int64)
+    iou = np.asarray(_iou_matrix(jnp.asarray(boxes), jnp.asarray(boxes)))
+    keep = []
+    alive = np.ones(n, bool)
+    for i in range(n):
+        if not alive[i]:
+            continue
+        keep.append(i)
+        alive &= iou[i] <= iou_threshold
+        alive[i] = False
+    return np.array(keep, np.int64)
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None):
+    """Greedy hard NMS; with scores boxes are priority-sorted first; with
+    categories it's applied per class and re-sorted by score."""
+    b = _np(boxes).astype(np.float64)
+    if scores is None:
+        return Tensor(jnp.asarray(_greedy_nms(b, iou_threshold)))
+    s = _np(scores)
+    if category_idxs is None:
+        order = np.argsort(-s, kind="stable")
+        kept = _greedy_nms(b[order], iou_threshold)
+        out = order[kept]
+        if top_k is not None:
+            out = out[:top_k]
+        return Tensor(jnp.asarray(out.astype(np.int64)))
+    if categories is None:
+        raise ValueError("categories is required when category_idxs is given")
+    if top_k is not None and top_k > s.shape[0]:
+        raise ValueError("top_k should be <= the number of boxes")
+    cat = _np(category_idxs)
+    kept_mask = np.zeros(s.shape[0], bool)
+    for c in categories:
+        idxs = np.where(cat == np.int64(c))[0]
+        if idxs.size == 0:
+            continue
+        order = idxs[np.argsort(-s[idxs], kind="stable")]
+        kept_mask[order[_greedy_nms(b[order], iou_threshold)]] = True
+    kept = np.where(kept_mask)[0]
+    kept = kept[np.argsort(-s[kept], kind="stable")]
+    if top_k is not None:
+        kept = kept[:top_k]
+    return Tensor(jnp.asarray(kept.astype(np.int64)))
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold, nms_top_k,
+               keep_top_k, use_gaussian=False, gaussian_sigma=2.0,
+               background_label=0, normalized=True, return_index=False,
+               return_rois_num=True):
+    """Parallel soft-suppression (SOLOv2 matrix NMS): per kept box the decay
+    is min over higher-scored overlapping boxes of f(iou)/f(max prior
+    overlap), f linear or gaussian. bboxes [N,M,4], scores [N,C,M]; output
+    rows are [label, score, x1, y1, x2, y2]."""
+    bb = _np(bboxes).astype(np.float64)
+    sc = _np(scores).astype(np.float64)
+    n_batch, n_cls, _ = sc.shape
+    outs, idxs, nums = [], [], []
+    for n in range(n_batch):
+        rows, inds = [], []
+        for c in range(n_cls):
+            if c == background_label:
+                continue
+            s = sc[n, c]
+            sel = np.where(s > score_threshold)[0]
+            if sel.size == 0:
+                continue
+            order = sel[np.argsort(-s[sel], kind="stable")]
+            if nms_top_k > 0:
+                order = order[:nms_top_k]
+            boxes = bb[n, order]
+            m = order.size
+            iou = np.asarray(_iou_matrix(jnp.asarray(boxes),
+                                         jnp.asarray(boxes),
+                                         normalized=normalized))
+            iou = np.triu(iou, k=1)  # ious with higher-scored boxes
+            max_prior = iou.max(axis=0)  # per box i: worst overlap above it
+            if use_gaussian:
+                # exp(-sigma*iou^2) / exp(-sigma*comp^2), the SOLOv2 kernel
+                decay = np.exp(gaussian_sigma
+                               * (max_prior[:, None] ** 2 - iou ** 2))
+            else:
+                decay = (1.0 - iou) / np.maximum(1.0 - max_prior[:, None],
+                                                 1e-10)
+            decay = np.where(np.triu(np.ones_like(iou), k=1) > 0, decay,
+                             np.inf).min(axis=0)
+            decay = np.where(np.isinf(decay), 1.0, decay)
+            new_scores = s[order] * decay
+            keep = new_scores > post_threshold
+            for j in np.where(keep)[0]:
+                rows.append([float(c), new_scores[j], *boxes[j]])
+                inds.append(order[j])
+        if rows:
+            rows = np.array(rows, np.float64)
+            inds = np.array(inds, np.int64)
+            order = np.argsort(-rows[:, 1], kind="stable")
+            if keep_top_k > 0:
+                order = order[:keep_top_k]
+            rows, inds = rows[order], inds[order]
+        else:
+            rows = np.zeros((0, 6), np.float64)
+            inds = np.zeros((0,), np.int64)
+        outs.append(rows)
+        idxs.append(inds + n * bb.shape[1])
+        nums.append(len(rows))
+    out = Tensor(jnp.asarray(np.concatenate(outs, 0).astype(np.float32)))
+    result = [out]
+    if return_index:
+        result.append(Tensor(jnp.asarray(np.concatenate(idxs, 0))))
+    if return_rois_num:
+        result.append(Tensor(jnp.asarray(np.array(nums, np.int32))))
+    return result[0] if len(result) == 1 else tuple(result)
+
+
+# ------------------------------------------------------------- roi family
+
+
+def _bilinear_sample(img, y, x):
+    """img [C,H,W]; y/x broadcastable point grids -> [C,*y.shape]; zero
+    outside the feature map (the roi_align border convention)."""
+    C, H, W = img.shape
+    y0 = jnp.floor(y)
+    x0 = jnp.floor(x)
+    wy1 = y - y0
+    wx1 = x - x0
+
+    def tap(yi, xi, w):
+        valid = (yi >= 0) & (yi < H) & (xi >= 0) & (xi < W)
+        yc = jnp.clip(yi, 0, H - 1).astype(jnp.int32)
+        xc = jnp.clip(xi, 0, W - 1).astype(jnp.int32)
+        v = img[:, yc, xc]  # [C, *grid]
+        return v * (w * valid)[None]
+
+    valid_pt = (y > -1.0) & (y < H) & (x > -1.0) & (x < W)
+    out = (tap(y0, x0, (1 - wy1) * (1 - wx1))
+           + tap(y0, x0 + 1, (1 - wy1) * wx1)
+           + tap(y0 + 1, x0, wy1 * (1 - wx1))
+           + tap(y0 + 1, x0 + 1, wy1 * wx1))
+    return out * valid_pt[None]
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True):
+    """Average-of-bilinear-samples ROI pooling (Mask R-CNN). x [N,C,H,W],
+    boxes [R,4] xyxy in image coords, boxes_num [N]. Differentiable (routes
+    through the dispatch tape; gradients flow to x and boxes). The adaptive
+    sampling grid (sampling_ratio<=0) sizes per-roi sample counts from the
+    concrete boxes, so tracing requires an explicit sampling_ratio>0."""
+    ph, pw = _pair(output_size)
+    counts = _np(boxes_num).astype(int)
+    broi = boxes._data if isinstance(boxes, Tensor) else jnp.asarray(boxes)
+    if sampling_ratio > 0:
+        srs = [(sampling_ratio, sampling_ratio)] * int(counts.sum())
+    else:
+        if isinstance(broi, jax.core.Tracer):
+            raise ValueError(
+                "roi_align under tracing needs sampling_ratio > 0 (the "
+                "adaptive grid is sized from concrete box values)")
+        bh_ = _np(boxes).astype(np.float64) * spatial_scale
+        srs = []
+        for r in bh_:
+            rw = r[2] - r[0]
+            rh = r[3] - r[1]
+            if not aligned:
+                rw, rh = max(rw, 1.0), max(rh, 1.0)
+            srs.append((max(int(math.ceil(rh / ph)), 1),
+                        max(int(math.ceil(rw / pw)), 1)))
+
+    def _align(xa, ba):
+        outs, k = [], 0
+        for n, c in enumerate(counts):
+            img = xa[n]
+            for _ in range(c):
+                roi = ba[k] * spatial_scale
+                off = 0.5 if aligned else 0.0
+                x1, y1 = roi[0] - off, roi[1] - off
+                x2, y2 = roi[2] - off, roi[3] - off
+                rw, rh = x2 - x1, y2 - y1
+                if not aligned:
+                    rw, rh = jnp.maximum(rw, 1.0), jnp.maximum(rh, 1.0)
+                bh, bw = rh / ph, rw / pw
+                sy, sx = srs[k]
+                iy = (jnp.arange(ph)[:, None] * bh + y1
+                      + (jnp.arange(sy)[None, :] + 0.5) * bh / sy)  # [ph,sy]
+                ix = (jnp.arange(pw)[:, None] * bw + x1
+                      + (jnp.arange(sx)[None, :] + 0.5) * bw / sx)  # [pw,sx]
+                yg = jnp.broadcast_to(iy[:, None, :, None], (ph, pw, sy, sx))
+                xg = jnp.broadcast_to(ix[None, :, None, :], (ph, pw, sy, sx))
+                vals = _bilinear_sample(img, yg, xg)  # [C,ph,pw,sy,sx]
+                outs.append(vals.mean(axis=(-1, -2)))
+                k += 1
+        if not outs:
+            return jnp.zeros((0, xa.shape[1], ph, pw), xa.dtype)
+        return jnp.stack(outs).astype(xa.dtype)
+
+    return apply(_align, (x, boxes), {}, name="roi_align")
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0):
+    """Quantized max-pool ROI pooling (Fast R-CNN): integer bin boundaries
+    (quantized on host from the concrete boxes — the reference kernel does
+    the same, and has no roi gradient either), empty bins produce 0. The
+    max over x routes through the dispatch tape, so feature gradients
+    flow."""
+    ph, pw = _pair(output_size)
+    counts = _np(boxes_num).astype(int)
+    b = _np(boxes).astype(np.float64)
+    xshape = (x._data if isinstance(x, Tensor) else np.asarray(x)).shape
+    H, W = xshape[-2:]
+    specs = []  # (image index, [(hs,he,ws,we)] * ph*pw) per roi
+    k = 0
+    for n, c in enumerate(counts):
+        for _ in range(c):
+            roi = b[k]
+            x1 = int(round(roi[0] * spatial_scale))
+            y1 = int(round(roi[1] * spatial_scale))
+            x2 = int(round(roi[2] * spatial_scale))
+            y2 = int(round(roi[3] * spatial_scale))
+            rh, rw = max(y2 - y1 + 1, 1), max(x2 - x1 + 1, 1)
+            bins = []
+            for i in range(ph):
+                hs = min(max(y1 + int(math.floor(i * rh / ph)), 0), H)
+                he = min(max(y1 + int(math.ceil((i + 1) * rh / ph)), 0), H)
+                for j in range(pw):
+                    ws = min(max(x1 + int(math.floor(j * rw / pw)), 0), W)
+                    we = min(max(x1 + int(math.ceil((j + 1) * rw / pw)), 0), W)
+                    bins.append((hs, he, ws, we))
+            specs.append((n, bins))
+            k += 1
+
+    def _pool(xa):
+        outs = []
+        for n, bins in specs:
+            img = xa[n]
+            cols = []
+            for hs, he, ws, we in bins:
+                if he <= hs or we <= ws:
+                    cols.append(jnp.zeros((xa.shape[1],), xa.dtype))
+                else:
+                    cols.append(img[:, hs:he, ws:we].max(axis=(-1, -2)))
+            outs.append(jnp.stack(cols, -1).reshape(xa.shape[1], ph, pw))
+        if not outs:
+            return jnp.zeros((0, xa.shape[1], ph, pw), xa.dtype)
+        return jnp.stack(outs)
+
+    return apply(_pool, (x,), {}, name="roi_pool")
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0):
+    """Position-sensitive average ROI pooling (R-FCN): bin (i,j) reads its
+    own channel group; C must equal out_channels * ph * pw."""
+    ph, pw = _pair(output_size)
+    xarr = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    C, H, W = xarr.shape[1:]
+    if C % (ph * pw) != 0:
+        raise ValueError(f"channels {C} must be divisible by "
+                         f"output_size^2 {ph * pw}")
+    oc = C // (ph * pw)
+    counts = _np(boxes_num).astype(int)
+    b = _np(boxes).astype(np.float64)
+    specs = []
+    k = 0
+    for n, c_ in enumerate(counts):
+        for _ in range(c_):
+            x1, y1, x2, y2 = b[k] * spatial_scale
+            rh, rw = max(y2 - y1, 0.1), max(x2 - x1, 0.1)
+            bh, bw = rh / ph, rw / pw
+            bins = []
+            for i in range(ph):
+                for j in range(pw):
+                    hs = min(max(int(math.floor(y1 + i * bh)), 0), H)
+                    he = min(max(int(math.ceil(y1 + (i + 1) * bh)), 0), H)
+                    ws = min(max(int(math.floor(x1 + j * bw)), 0), W)
+                    we = min(max(int(math.ceil(x1 + (j + 1) * bw)), 0), W)
+                    bins.append((hs, he, ws, we))
+            specs.append((n, bins))
+            k += 1
+
+    def _psroi(xa):
+        outs = []
+        for n, bins in specs:
+            img = xa[n]
+            cols = []
+            for idx, (hs, he, ws, we) in enumerate(bins):
+                chan = img[idx * oc:(idx + 1) * oc]
+                if he <= hs or we <= ws:
+                    cols.append(jnp.zeros((oc,), xa.dtype))
+                else:
+                    cols.append(chan[:, hs:he, ws:we].mean(axis=(-1, -2)))
+            outs.append(jnp.stack(cols, -1).reshape(oc, ph, pw))
+        if not outs:
+            return jnp.zeros((0, oc, ph, pw), xa.dtype)
+        return jnp.stack(outs)
+
+    return apply(_psroi, (x,), {}, name="psroi_pool")
+
+
+class RoIPool(nn.Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self._output_size,
+                        self._spatial_scale)
+
+
+class RoIAlign(nn.Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num, aligned=True):
+        return roi_align(x, boxes, boxes_num, self._output_size,
+                         self._spatial_scale, aligned=aligned)
+
+
+class PSRoIPool(nn.Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return psroi_pool(x, boxes, boxes_num, self._output_size,
+                          self._spatial_scale)
+
+
+# ---------------------------------------------------------- deform conv
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None):
+    """Deformable convolution v1 (mask=None) / v2: sample each kernel tap at
+    its learned offset by bilinear interpolation, then contract with the
+    weights — fully traced jnp (bilinear gathers + one einsum), so XLA fuses
+    it rather than needing the reference's hand CUDA kernel
+    (ref:paddle/phi/kernels/impl/deformable_conv_kernel_impl.h)."""
+    sh, sw = _pair(stride)
+    ph, pw = _pair(padding)
+    dh, dw = _pair(dilation)
+
+    def _dcn(xa, off, w, b, m):
+        N, Cin, H, W = xa.shape
+        Cout, Cin_g, kh, kw = w.shape
+        Ho = (H + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+        Wo = (W + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+        dg = deformable_groups
+        off = off.reshape(N, dg, kh * kw, 2, Ho, Wo)
+        if m is not None:
+            m = m.reshape(N, dg, kh * kw, Ho, Wo)
+        base_y = (jnp.arange(Ho) * sh - ph)[:, None]  # [Ho,1]
+        base_x = (jnp.arange(Wo) * sw - pw)[None, :]  # [1,Wo]
+        taps = []
+        cg = Cin // dg  # channels per deformable group
+        for i in range(kh):
+            for j in range(kw):
+                k = i * kw + j
+                # offset layout per tap: (dy, dx)
+                y = base_y + i * dh + off[:, :, k, 0]  # [N,dg,Ho,Wo]
+                xpos = base_x + j * dw + off[:, :, k, 1]
+                gs = []
+                for g in range(dg):
+                    samp = jax.vmap(
+                        lambda img, yy, xx: _bilinear_sample(img, yy, xx)
+                    )(xa[:, g * cg:(g + 1) * cg], y[:, g], xpos[:, g])
+                    if m is not None:
+                        samp = samp * m[:, g, k][:, None]
+                    gs.append(samp)
+                taps.append(jnp.concatenate(gs, axis=1))  # [N,Cin,Ho,Wo]
+        patches = jnp.stack(taps, axis=2)  # [N, Cin, kh*kw, Ho, Wo]
+        cg2 = Cin // groups
+        og = Cout // groups
+        outs = []
+        for g in range(groups):
+            pg = patches[:, g * cg2:(g + 1) * cg2]
+            wg = w[g * og:(g + 1) * og].reshape(og, cg2, kh * kw)
+            outs.append(jnp.einsum("nckhw,ock->nohw", pg, wg))
+        out = jnp.concatenate(outs, axis=1)
+        if b is not None:
+            out = out + b.reshape(1, -1, 1, 1)
+        return out
+
+    # route through the dispatch tape: weight/bias/x/offset all get grads
+    has_bias, has_mask = bias is not None, mask is not None
+    tensor_args = [x, offset, weight]
+    if has_bias:
+        tensor_args.append(bias)
+    if has_mask:
+        tensor_args.append(mask)
+
+    def _entry(xa, off, w, *rest):
+        b = rest[0] if has_bias else None
+        m = rest[-1] if has_mask else None
+        return _dcn(xa, off, w, b, m)
+
+    return apply(_entry, tuple(tensor_args), {}, name="deform_conv2d")
+
+
+class DeformConv2D(nn.Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        kh, kw = _pair(kernel_size)
+        self._stride = stride
+        self._padding = padding
+        self._dilation = dilation
+        self._deformable_groups = deformable_groups
+        self._groups = groups
+        fan_in = in_channels // groups * kh * kw
+        bound = 1.0 / math.sqrt(fan_in)
+        self.weight = self.create_parameter(
+            [out_channels, in_channels // groups, kh, kw],
+            default_initializer=nn.initializer.Uniform(-bound, bound))
+        if bias_attr is False:
+            self.bias = None
+        else:
+            self.bias = self.create_parameter(
+                [out_channels],
+                default_initializer=nn.initializer.Uniform(-bound, bound))
+
+    def forward(self, x, offset, mask=None):
+        return deform_conv2d(x, offset, self.weight, self.bias, self._stride,
+                             self._padding, self._dilation,
+                             self._deformable_groups, self._groups, mask)
+
+
+# ----------------------------------------------------------------- yolo
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh, downsample_ratio,
+             clip_bbox=True, name=None, scale_x_y=1.0, iou_aware=False,
+             iou_aware_factor=0.5):
+    """Decode YOLOv3 head output [N, S*(5+cls), H, W] into boxes
+    [N, H*W*S, 4] (xyxy in image scale) and scores [N, H*W*S, cls]; boxes
+    under conf_thresh are zeroed."""
+    def _decode(xa, img_sz):
+        N, C, H, W = xa.shape
+        S = len(anchors) // 2
+        aw = jnp.asarray(anchors[0::2], jnp.float32)
+        ah = jnp.asarray(anchors[1::2], jnp.float32)
+        if iou_aware:
+            ioup = jax.nn.sigmoid(xa[:, :S].reshape(N, S, 1, H, W))
+            xa = xa[:, S:]
+        p = xa.reshape(N, S, 5 + class_num, H, W)
+        gx = jnp.arange(W, dtype=jnp.float32)[None, None, None, :]
+        gy = jnp.arange(H, dtype=jnp.float32)[None, None, :, None]
+        sxy = scale_x_y
+        bx = (gx + jax.nn.sigmoid(p[:, :, 0]) * sxy - 0.5 * (sxy - 1)) / W
+        by = (gy + jax.nn.sigmoid(p[:, :, 1]) * sxy - 0.5 * (sxy - 1)) / H
+        bw = jnp.exp(p[:, :, 2]) * aw[None, :, None, None] / (
+            downsample_ratio * W)
+        bh = jnp.exp(p[:, :, 3]) * ah[None, :, None, None] / (
+            downsample_ratio * H)
+        conf = jax.nn.sigmoid(p[:, :, 4])
+        if iou_aware:
+            conf = conf ** (1 - iou_aware_factor) * (
+                ioup[:, :, 0] ** iou_aware_factor)
+        cls = jax.nn.sigmoid(p[:, :, 5:]) * conf[:, :, None]
+        imh = img_sz[:, 0].astype(jnp.float32)[:, None, None, None]
+        imw = img_sz[:, 1].astype(jnp.float32)[:, None, None, None]
+        x1 = (bx - bw / 2) * imw
+        y1 = (by - bh / 2) * imh
+        x2 = (bx + bw / 2) * imw
+        y2 = (by + bh / 2) * imh
+        if clip_bbox:
+            x1 = jnp.clip(x1, 0)
+            y1 = jnp.clip(y1, 0)
+            x2 = jnp.minimum(x2, imw - 1)
+            y2 = jnp.minimum(y2, imh - 1)
+        keep = conf > conf_thresh
+        boxes = jnp.stack([x1, y1, x2, y2], axis=-1) * keep[..., None]
+        scores = cls * keep[:, :, None]
+        # [N, S, H, W, ...] -> [N, H*W*S, ...] (h-major, anchor-minor order)
+        boxes = boxes.transpose(0, 2, 3, 1, 4).reshape(N, H * W * S, 4)
+        scores = scores.transpose(0, 3, 4, 1, 2).reshape(
+            N, H * W * S, class_num)
+        return boxes, scores
+
+    return apply(_decode, (x, img_size), {})
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, name=None, scale_x_y=1.0):
+    """YOLOv3 training loss (one detection scale): BCE on sigmoid(tx,ty),
+    L1 on tw,th (weighted 2 - w*h), objectness BCE with IoU>ignore_thresh
+    negatives ignored, per-class BCE; each gt is assigned to its best
+    shape-IoU anchor and only contributes on this scale if that anchor is
+    in anchor_mask. gt boxes are (cx, cy, w, h) normalized; zero-width gts
+    are padding. Returns per-sample loss [N]."""
+    def _loss(xa, gtb, gtl, gts):
+        N, C, H, W = xa.shape
+        S = len(anchor_mask)
+        p = xa.reshape(N, S, 5 + class_num, H, W)
+        an_w = np.asarray(anchors[0::2], np.float32)
+        an_h = np.asarray(anchors[1::2], np.float32)
+        inp_w = downsample_ratio * W
+        inp_h = downsample_ratio * H
+
+        tx, ty = p[:, :, 0], p[:, :, 1]
+        tw, th = p[:, :, 2], p[:, :, 3]
+        tobj = p[:, :, 4]
+        tcls = p[:, :, 5:]
+
+        # ---- build targets (host loop over the gt list, static per trace)
+        B = gtb.shape[1]
+        obj_mask = jnp.zeros((N, S, H, W))
+        tgt = {k: jnp.zeros((N, S, H, W)) for k in
+               ("x", "y", "w", "h", "scale")}
+        cls_tgt = jnp.zeros((N, S, class_num, H, W))
+
+        # best anchor per gt by shape-only IoU (centered boxes)
+        gw = gtb[:, :, 2] * inp_w
+        gh = gtb[:, :, 3] * inp_h
+        inter = (jnp.minimum(gw[..., None], an_w[None, None])
+                 * jnp.minimum(gh[..., None], an_h[None, None]))
+        union = gw[..., None] * gh[..., None] + (an_w * an_h)[None, None] - inter
+        best_anchor = jnp.argmax(inter / jnp.maximum(union, 1e-10), -1)
+
+        gi = jnp.clip((gtb[:, :, 0] * W).astype(jnp.int32), 0, W - 1)
+        gj = jnp.clip((gtb[:, :, 1] * H).astype(jnp.int32), 0, H - 1)
+        valid = gtb[:, :, 2] > 0
+        mask_arr = np.asarray(anchor_mask)
+        for b in range(B):
+            in_scale = jnp.isin(best_anchor[:, b], jnp.asarray(mask_arr))
+            use = valid[:, b] & in_scale
+            # map global anchor id -> local slot in this scale's mask
+            local = jnp.argmax(
+                best_anchor[:, b][:, None] == jnp.asarray(mask_arr)[None], 1)
+            n_idx = jnp.arange(N)
+            w_ = jnp.where(use, 1.0, 0.0)
+            sel = (n_idx, local, gj[:, b], gi[:, b])
+            obj_mask = obj_mask.at[sel].max(w_)
+            tgt["x"] = tgt["x"].at[sel].set(
+                jnp.where(use, gtb[:, b, 0] * W - gi[:, b], tgt["x"][sel]))
+            tgt["y"] = tgt["y"].at[sel].set(
+                jnp.where(use, gtb[:, b, 1] * H - gj[:, b], tgt["y"][sel]))
+            aw_sel = jnp.asarray(an_w)[jnp.asarray(mask_arr)][local]
+            ah_sel = jnp.asarray(an_h)[jnp.asarray(mask_arr)][local]
+            tgt["w"] = tgt["w"].at[sel].set(jnp.where(
+                use, jnp.log(jnp.maximum(gw[:, b] / aw_sel, 1e-9)),
+                tgt["w"][sel]))
+            tgt["h"] = tgt["h"].at[sel].set(jnp.where(
+                use, jnp.log(jnp.maximum(gh[:, b] / ah_sel, 1e-9)),
+                tgt["h"][sel]))
+            tgt["scale"] = tgt["scale"].at[sel].set(jnp.where(
+                use, 2.0 - gtb[:, b, 2] * gtb[:, b, 3], tgt["scale"][sel]))
+            score_b = gts[:, b] if gts is not None else jnp.ones((N,))
+            cls_sel = (n_idx, local, gtl[:, b].astype(jnp.int32),
+                       gj[:, b], gi[:, b])
+            cls_tgt = cls_tgt.at[cls_sel].max(jnp.where(use, score_b, 0.0))
+
+        # ---- ignore mask: predicted boxes overlapping any gt > thresh
+        gx_ = jnp.arange(W, dtype=jnp.float32)[None, None, None, :]
+        gy_ = jnp.arange(H, dtype=jnp.float32)[None, None, :, None]
+        sxy = scale_x_y
+        px = (gx_ + jax.nn.sigmoid(tx) * sxy - 0.5 * (sxy - 1)) / W
+        py = (gy_ + jax.nn.sigmoid(ty) * sxy - 0.5 * (sxy - 1)) / H
+        pw_ = jnp.exp(tw) * jnp.asarray(an_w)[mask_arr][None, :, None, None] / inp_w
+        ph_ = jnp.exp(th) * jnp.asarray(an_h)[mask_arr][None, :, None, None] / inp_h
+        p1 = jnp.stack([px - pw_ / 2, py - ph_ / 2,
+                        px + pw_ / 2, py + ph_ / 2], -1)  # [N,S,H,W,4]
+        g1 = jnp.stack([gtb[:, :, 0] - gtb[:, :, 2] / 2,
+                        gtb[:, :, 1] - gtb[:, :, 3] / 2,
+                        gtb[:, :, 0] + gtb[:, :, 2] / 2,
+                        gtb[:, :, 1] + gtb[:, :, 3] / 2], -1)  # [N,B,4]
+        lt = jnp.maximum(p1[..., None, :2], g1[:, None, None, None, :, :2])
+        rb = jnp.minimum(p1[..., None, 2:], g1[:, None, None, None, :, 2:])
+        wh = jnp.clip(rb - lt, 0)
+        inter2 = wh[..., 0] * wh[..., 1]
+        area_p = pw_ * ph_
+        area_g = (gtb[:, :, 2] * gtb[:, :, 3])[:, None, None, None, :]
+        iou = inter2 / jnp.maximum(area_p[..., None] + area_g - inter2, 1e-10)
+        iou = jnp.where(valid[:, None, None, None, :], iou, 0.0)
+        best_iou = iou.max(-1)
+        ignore = (best_iou > ignore_thresh) & (obj_mask < 0.5)
+
+        def bce(logit, label):
+            return jnp.maximum(logit, 0) - logit * label + jnp.log1p(
+                jnp.exp(-jnp.abs(logit)))
+
+        sc = tgt["scale"] * obj_mask
+        loss_xy = (bce(tx, tgt["x"]) + bce(ty, tgt["y"])) * sc
+        loss_wh = (jnp.abs(tw - tgt["w"]) + jnp.abs(th - tgt["h"])) * sc
+        loss_obj = jnp.where(ignore, 0.0,
+                             bce(tobj, obj_mask))
+        if use_label_smooth:
+            delta = 1.0 / class_num if class_num > 1 else 0.0
+            cls_lab = cls_tgt * (1 - delta) + delta * 0.5 * (cls_tgt > -1)
+        else:
+            cls_lab = cls_tgt
+        loss_cls = bce(tcls, cls_lab) * obj_mask[:, :, None]
+        total = (loss_xy.sum((1, 2, 3)) + loss_wh.sum((1, 2, 3))
+                 + loss_obj.sum((1, 2, 3)) + loss_cls.sum((1, 2, 3, 4)))
+        return total
+
+    args = (x, gt_box, gt_label) + ((gt_score,) if gt_score is not None else ())
+    if gt_score is not None:
+        return apply(lambda a, b, c, d: _loss(a, b, c, d), args, {})
+    return apply(lambda a, b, c: _loss(a, b, c, None), args, {})
+
+
+# ------------------------------------------------------- priors & coder
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=[1.0],
+              variance=[0.1, 0.1, 0.2, 0.2], flip=False, clip=False,
+              steps=[0.0, 0.0], offset=0.5,
+              min_max_aspect_ratios_order=False, name=None):
+    """SSD prior boxes: per feature-map cell, one box per (min_size, AR) +
+    sqrt(min*max) boxes; output [H, W, P, 4] normalized xyxy + matching
+    variances."""
+    def _priors(feat, img):
+        H, W = feat.shape[-2:]
+        imh, imw = img.shape[-2:]
+        sh = steps[1] if steps[1] > 0 else imh / H
+        sw = steps[0] if steps[0] > 0 else imw / W
+        ars = [1.0]
+        for ar in aspect_ratios:
+            if abs(ar - 1.0) > 1e-6:
+                ars.append(ar)
+                if flip:
+                    ars.append(1.0 / ar)
+        whs = []  # (w, h) per prior, reference ordering
+        for k, ms in enumerate(min_sizes):
+            if min_max_aspect_ratios_order:
+                whs.append((ms, ms))
+                if max_sizes:
+                    s = math.sqrt(ms * max_sizes[k])
+                    whs.append((s, s))
+                for ar in ars[1:]:
+                    whs.append((ms * math.sqrt(ar), ms / math.sqrt(ar)))
+            else:
+                for ar in ars:
+                    whs.append((ms * math.sqrt(ar), ms / math.sqrt(ar)))
+                if max_sizes:
+                    s = math.sqrt(ms * max_sizes[k])
+                    whs.append((s, s))
+        P = len(whs)
+        cx = (jnp.arange(W, dtype=jnp.float32) + offset) * sw
+        cy = (jnp.arange(H, dtype=jnp.float32) + offset) * sh
+        cxg = jnp.broadcast_to(cx[None, :, None], (H, W, P))
+        cyg = jnp.broadcast_to(cy[:, None, None], (H, W, P))
+        bw = jnp.asarray([w for w, _ in whs], jnp.float32) / 2
+        bh = jnp.asarray([h for _, h in whs], jnp.float32) / 2
+        out = jnp.stack([(cxg - bw) / imw, (cyg - bh) / imh,
+                         (cxg + bw) / imw, (cyg + bh) / imh], -1)
+        if clip:
+            out = jnp.clip(out, 0.0, 1.0)
+        var = jnp.broadcast_to(jnp.asarray(variance, jnp.float32),
+                               (H, W, P, 4))
+        return out, var
+
+    return apply(_priors, (input, image), {})
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True, axis=0,
+              name=None):
+    """Encode target boxes against priors (or decode offsets back to boxes)
+    with the center-size parameterization and per-coordinate variances."""
+    def _coder(pb, tb, pvar):
+        off = 0.0 if box_normalized else 1.0
+        pw = pb[..., 2] - pb[..., 0] + off
+        ph = pb[..., 3] - pb[..., 1] + off
+        pcx = pb[..., 0] + pw / 2
+        pcy = pb[..., 1] + ph / 2
+        if code_type == "encode_center_size":
+            tw = tb[..., 2] - tb[..., 0] + off
+            th = tb[..., 3] - tb[..., 1] + off
+            tcx = tb[..., 0] + tw / 2
+            tcy = tb[..., 1] + th / 2
+            # [M,4] priors vs [N,4] targets -> [N,M,4]
+            out = jnp.stack([
+                (tcx[:, None] - pcx[None]) / pw[None],
+                (tcy[:, None] - pcy[None]) / ph[None],
+                jnp.log(jnp.abs(tw[:, None] / pw[None])),
+                jnp.log(jnp.abs(th[:, None] / ph[None]))], -1)
+            if pvar is not None:
+                out = out / pvar.reshape((1, -1, 4) if pvar.ndim == 2
+                                         else (1, 1, 4))
+            return out
+        # decode_center_size: tb [N,M,4] offsets
+        if axis == 0:
+            pw_, ph_, pcx_, pcy_ = (v[None, :] for v in (pw, ph, pcx, pcy))
+            vshape = (1, -1, 4)
+        else:
+            pw_, ph_, pcx_, pcy_ = (v[:, None] for v in (pw, ph, pcx, pcy))
+            vshape = (-1, 1, 4)
+        t = tb
+        if pvar is not None:
+            t = t * pvar.reshape(vshape if pvar.ndim == 2 else (1, 1, 4))
+        ocx = t[..., 0] * pw_ + pcx_
+        ocy = t[..., 1] * ph_ + pcy_
+        ow = jnp.exp(t[..., 2]) * pw_
+        oh = jnp.exp(t[..., 3]) * ph_
+        return jnp.stack([ocx - ow / 2, ocy - oh / 2,
+                          ocx + ow / 2 - off, ocy + oh / 2 - off], -1)
+
+    if isinstance(prior_box_var, (list, tuple)):
+        pv = jnp.asarray(prior_box_var, jnp.float32)
+        return apply(lambda pb, tb: _coder(pb, tb, pv),
+                     (prior_box, target_box), {})
+    if prior_box_var is None:
+        return apply(lambda pb, tb: _coder(pb, tb, None),
+                     (prior_box, target_box), {})
+    return apply(lambda pb, tb, pv: _coder(pb, tb, pv),
+                 (prior_box, target_box, prior_box_var), {})
+
+
+# ------------------------------------------------- fpn / proposals / io
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False, rois_num=None,
+                             name=None):
+    """Route each ROI to an FPN level by sqrt(area)/refer_scale; returns
+    (per-level roi tensors, restore index, optional per-level rois_num)."""
+    rois = _np(fpn_rois).astype(np.float64)
+    off = 1.0 if pixel_offset else 0.0
+    w = rois[:, 2] - rois[:, 0] + off
+    h = rois[:, 3] - rois[:, 1] + off
+    scale = np.sqrt(np.maximum(w * h, 0))
+    lvl = np.floor(np.log2(scale / refer_scale + 1e-8)) + refer_level
+    lvl = np.clip(lvl, min_level, max_level).astype(int)
+    n_lvl = max_level - min_level + 1
+    multi, order = [], []
+    nums_src = None if rois_num is None else _np(rois_num).astype(int)
+    per_level_nums = []
+    for li in range(n_lvl):
+        idx = np.where(lvl == min_level + li)[0]
+        multi.append(Tensor(jnp.asarray(rois[idx].astype(np.float32))))
+        order.append(idx)
+        if nums_src is not None:
+            bounds = np.cumsum(nums_src)
+            img_of = np.searchsorted(bounds, idx, side="right")
+            per_level_nums.append(Tensor(jnp.asarray(np.bincount(
+                img_of, minlength=len(nums_src)).astype(np.int32))))
+    order = np.concatenate(order) if order else np.zeros((0,), int)
+    restore = np.empty_like(order)
+    restore[order] = np.arange(order.size)
+    restore_t = Tensor(jnp.asarray(restore.astype(np.int32).reshape(-1, 1)))
+    if rois_num is not None:
+        return multi, restore_t, per_level_nums
+    return multi, restore_t
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=False, name=None):
+    """RPN proposal generation: decode anchor deltas, clip to image, drop
+    tiny boxes, top-k + NMS per image. scores [N,A,H,W], bbox_deltas
+    [N,4A,H,W], anchors/variances [H,W,A,4]."""
+    sc = _np(scores)
+    bd = _np(bbox_deltas)
+    ims = _np(img_size)
+    an = _np(anchors).reshape(-1, 4)
+    va = _np(variances).reshape(-1, 4)
+    N, A, H, W = sc.shape
+    off = 1.0 if pixel_offset else 0.0
+    rois_all, probs_all, nums = [], [], []
+    for n in range(N):
+        s = sc[n].transpose(1, 2, 0).reshape(-1)  # [H*W*A]
+        d = bd[n].reshape(A, 4, H, W).transpose(2, 3, 0, 1).reshape(-1, 4)
+        aw = an[:, 2] - an[:, 0] + off
+        ah = an[:, 3] - an[:, 1] + off
+        acx = an[:, 0] + aw / 2
+        acy = an[:, 1] + ah / 2
+        cx = va[:, 0] * d[:, 0] * aw + acx
+        cy = va[:, 1] * d[:, 1] * ah + acy
+        bw = np.exp(np.minimum(va[:, 2] * d[:, 2], np.log(1000 / 16))) * aw
+        bh = np.exp(np.minimum(va[:, 3] * d[:, 3], np.log(1000 / 16))) * ah
+        boxes = np.stack([cx - bw / 2, cy - bh / 2,
+                          cx + bw / 2 - off, cy + bh / 2 - off], -1)
+        imh, imw = float(ims[n, 0]), float(ims[n, 1])
+        boxes[:, 0::2] = np.clip(boxes[:, 0::2], 0, imw - off)
+        boxes[:, 1::2] = np.clip(boxes[:, 1::2], 0, imh - off)
+        keep = ((boxes[:, 2] - boxes[:, 0] + off >= min_size)
+                & (boxes[:, 3] - boxes[:, 1] + off >= min_size))
+        boxes, s2 = boxes[keep], s[keep]
+        order = np.argsort(-s2, kind="stable")[:pre_nms_top_n]
+        boxes, s2 = boxes[order], s2[order]
+        kept = _greedy_nms(boxes, nms_thresh)[:post_nms_top_n]
+        rois_all.append(boxes[kept].astype(np.float32))
+        probs_all.append(s2[kept].astype(np.float32).reshape(-1, 1))
+        nums.append(len(kept))
+    rois = Tensor(jnp.asarray(np.concatenate(rois_all, 0)))
+    probs = Tensor(jnp.asarray(np.concatenate(probs_all, 0)))
+    if return_rois_num:
+        return rois, probs, Tensor(jnp.asarray(np.array(nums, np.int32)))
+    return rois, probs
+
+
+def read_file(filename, name=None):
+    with open(filename, "rb") as f:
+        data = f.read()
+    return Tensor(jnp.asarray(np.frombuffer(data, np.uint8)))
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    import io as _io
+
+    from PIL import Image
+
+    raw = bytes(_np(x).astype(np.uint8))
+    img = Image.open(_io.BytesIO(raw))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode == "rgb":
+        img = img.convert("RGB")
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None]  # [1, H, W]
+    else:
+        arr = arr.transpose(2, 0, 1)  # [C, H, W]
+    return Tensor(jnp.asarray(arr))
+
+
+class ConvNormActivation(nn.Sequential):
+    """Conv2D + norm + activation block (torchvision-style helper the
+    reference exposes for model builders)."""
+
+    def __init__(self, in_channels, out_channels, kernel_size=3, stride=1,
+                 padding=None, groups=1, norm_layer=nn.BatchNorm2D,
+                 activation_layer=nn.ReLU, dilation=1, bias=None):
+        if padding is None:
+            padding = (kernel_size - 1) // 2 * dilation
+        if bias is None:
+            bias = norm_layer is None
+        layers = [nn.Conv2D(in_channels, out_channels, kernel_size, stride,
+                            padding, dilation=dilation, groups=groups,
+                            bias_attr=bias)]
+        if norm_layer is not None:
+            layers.append(norm_layer(out_channels))
+        if activation_layer is not None:
+            layers.append(activation_layer())
+        super().__init__(*layers)

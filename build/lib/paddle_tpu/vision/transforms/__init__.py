@@ -126,3 +126,378 @@ def normalize(img, mean, std, data_format="CHW"):
 
 def resize(img, size):
     return Resize(size)(img)
+
+
+# ---------------------------------------------------------------- functional
+# (ref:python/paddle/vision/transforms/functional.py; numpy HWC images)
+
+
+def _as_np(img):
+    return np.asarray(img)
+
+
+def hflip(img):
+    return _as_np(img)[:, ::-1].copy()
+
+
+def vflip(img):
+    return _as_np(img)[::-1].copy()
+
+
+def crop(img, top, left, height, width):
+    return _as_np(img)[top:top + height, left:left + width].copy()
+
+
+def center_crop(img, output_size):
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    a = _as_np(img)
+    th, tw = output_size
+    i = max((a.shape[0] - th) // 2, 0)
+    j = max((a.shape[1] - tw) // 2, 0)
+    return crop(a, i, j, th, tw)
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    a = _as_np(img)
+    if isinstance(padding, int):
+        padding = (padding, padding, padding, padding)
+    if len(padding) == 2:
+        padding = (padding[0], padding[1], padding[0], padding[1])
+    l, t, r, b = padding
+    width = [(t, b), (l, r)] + [(0, 0)] * (a.ndim - 2)
+    if padding_mode == "constant":
+        return np.pad(a, width, constant_values=fill)
+    mode = {"reflect": "reflect", "edge": "edge", "symmetric": "symmetric"}[padding_mode]
+    return np.pad(a, width, mode=mode)
+
+
+def adjust_brightness(img, brightness_factor):
+    a = _as_np(img).astype(np.float32) * brightness_factor
+    return _clip_like(a, img)
+
+
+def adjust_contrast(img, contrast_factor):
+    a = _as_np(img).astype(np.float32)
+    mean = a.mean() if a.ndim == 2 else _gray(a).mean()
+    out = (a - mean) * contrast_factor + mean
+    return _clip_like(out, img)
+
+
+def adjust_saturation(img, saturation_factor):
+    a = _as_np(img).astype(np.float32)
+    g = _gray(a)[..., None]
+    out = a * saturation_factor + g * (1 - saturation_factor)
+    return _clip_like(out, img)
+
+
+def adjust_hue(img, hue_factor):
+    """Hue rotation via HSV roundtrip (numpy)."""
+    a = _as_np(img).astype(np.float32)
+    scale = 255.0 if a.max() > 1.5 else 1.0
+    x = a / scale
+    mx, mn = x.max(-1), x.min(-1)
+    diff = mx - mn + 1e-12
+    r, g, b = x[..., 0], x[..., 1], x[..., 2]
+    h = np.where(mx == r, (g - b) / diff % 6,
+                 np.where(mx == g, (b - r) / diff + 2, (r - g) / diff + 4)) / 6
+    h = (h + hue_factor) % 1.0
+    s = np.where(mx > 0, diff / (mx + 1e-12), 0)
+    v = mx
+    i = np.floor(h * 6)
+    f = h * 6 - i
+    p, q, t = v * (1 - s), v * (1 - f * s), v * (1 - (1 - f) * s)
+    i = i.astype(np.int32) % 6
+    out = np.select(
+        [i[..., None] == k for k in range(6)],
+        [np.stack(c, -1) for c in
+         [(v, t, p), (q, v, p), (p, v, t), (p, q, v), (t, p, v), (v, p, q)]],
+    )
+    return _clip_like(out * scale, img)
+
+
+def to_grayscale(img, num_output_channels=1):
+    a = _as_np(img).astype(np.float32)
+    g = _gray(a)
+    out = np.repeat(g[..., None], num_output_channels, axis=-1)
+    return _clip_like(out, img)
+
+
+def rotate(img, angle, interpolation="nearest", expand=False, center=None,
+           fill=0):
+    rad = -np.deg2rad(angle)
+    m = np.array([[np.cos(rad), -np.sin(rad)], [np.sin(rad), np.cos(rad)]],
+                 np.float32)
+    return _affine_np(img, m, fill)
+
+
+def affine(img, angle, translate, scale, shear, interpolation="nearest",
+           fill=0, center=None):
+    rad = -np.deg2rad(angle)
+    sx, sy = (np.deg2rad(s) for s in (shear if isinstance(shear, (list, tuple))
+                                      else (shear, 0)))
+    rot = np.array([[np.cos(rad), -np.sin(rad)], [np.sin(rad), np.cos(rad)]])
+    sh = np.array([[1, np.tan(sx)], [np.tan(sy), 1]])
+    m = (rot @ sh) * scale
+    return _affine_np(img, m.astype(np.float32), fill,
+                      translate=tuple(translate))
+
+
+def perspective(img, startpoints, endpoints, interpolation="nearest", fill=0):
+    """Projective warp from 4 point pairs (DLT solve, nearest sampling)."""
+    a = _as_np(img)
+    A = []
+    for (x, y), (u, v) in zip(endpoints, startpoints):
+        A.append([x, y, 1, 0, 0, 0, -u * x, -u * y, -u])
+        A.append([0, 0, 0, x, y, 1, -v * x, -v * y, -v])
+    _, _, V = np.linalg.svd(np.asarray(A, np.float64))
+    H = V[-1].reshape(3, 3)
+    h, w = a.shape[:2]
+    ys, xs = np.meshgrid(np.arange(h), np.arange(w), indexing="ij")
+    pts = np.stack([xs.ravel(), ys.ravel(), np.ones(h * w)], 0)
+    src = H @ pts
+    sx = (src[0] / (src[2] + 1e-12)).round().astype(np.int64)
+    sy = (src[1] / (src[2] + 1e-12)).round().astype(np.int64)
+    inb = (sx >= 0) & (sx < w) & (sy >= 0) & (sy < h)
+    out = np.full_like(a, fill)
+    oy, ox = ys.ravel()[inb], xs.ravel()[inb]
+    out[oy, ox] = a[sy[inb], sx[inb]]
+    return out
+
+
+def erase(img, i, j, h, w, v, inplace=False):
+    a = _as_np(img) if inplace else _as_np(img).copy()
+    a[i:i + h, j:j + w] = v
+    return a
+
+
+def _gray(a):
+    return a[..., 0] * 0.299 + a[..., 1] * 0.587 + a[..., 2] * 0.114
+
+
+def _clip_like(a, ref):
+    r = _as_np(ref)
+    if r.dtype == np.uint8:
+        return np.clip(a, 0, 255).astype(np.uint8)
+    return a.astype(r.dtype)
+
+
+def _affine_np(img, m2, fill=0, translate=(0, 0)):
+    """Inverse-map nearest-neighbor affine about the image center."""
+    a = _as_np(img)
+    h, w = a.shape[:2]
+    cy, cx = (h - 1) / 2, (w - 1) / 2
+    ys, xs = np.meshgrid(np.arange(h), np.arange(w), indexing="ij")
+    minv = np.linalg.inv(m2)
+    vx = xs - cx - translate[0]
+    vy = ys - cy - translate[1]
+    sx = (minv[0, 0] * vx + minv[0, 1] * vy + cx).round().astype(np.int64)
+    sy = (minv[1, 0] * vx + minv[1, 1] * vy + cy).round().astype(np.int64)
+    inb = (sx >= 0) & (sx < w) & (sy >= 0) & (sy < h)
+    out = np.full_like(a, fill)
+    out[inb] = a[sy[inb], sx[inb]]
+    return out
+
+
+# ------------------------------------------------------------------ classes
+
+
+class BaseTransform:
+    """Transform base (ref transforms.BaseTransform): subclasses implement
+    _apply_image (+ optionally _apply_{boxes,mask}); with tuple inputs, only
+    elements whose key has a handler are transformed — the rest (labels,
+    ids, ...) pass through unchanged."""
+
+    def __init__(self, keys=None):
+        self.keys = keys or ("image",)
+
+    def _apply_image(self, image):
+        raise NotImplementedError
+
+    def __call__(self, inputs):
+        if isinstance(inputs, tuple):
+            out = []
+            for key, item in zip(self.keys, inputs):
+                fn = getattr(self, f"_apply_{key}", None)
+                out.append(fn(item) if fn is not None else item)
+            out.extend(inputs[len(self.keys):])  # unnamed extras untouched
+            return tuple(out)
+        return self._apply_image(inputs)
+
+
+class Transpose(BaseTransform):
+    def __init__(self, order=(2, 0, 1), keys=None):
+        super().__init__(keys)
+        self.order = order
+
+    def _apply_image(self, img):
+        return _as_np(img).transpose(self.order)
+
+
+class Pad(BaseTransform):
+    def __init__(self, padding, fill=0, padding_mode="constant", keys=None):
+        super().__init__(keys)
+        self.padding, self.fill, self.mode = padding, fill, padding_mode
+
+    def _apply_image(self, img):
+        return pad(img, self.padding, self.fill, self.mode)
+
+
+class RandomVerticalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+
+    def _apply_image(self, img):
+        return vflip(img) if np.random.rand() < self.prob else _as_np(img)
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels=1, keys=None):
+        super().__init__(keys)
+        self.n = num_output_channels
+
+    def _apply_image(self, img):
+        return to_grayscale(img, self.n)
+
+
+class BrightnessTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = value
+
+    def _apply_image(self, img):
+        f = 1 + np.random.uniform(-self.value, self.value)
+        return adjust_brightness(img, f)
+
+
+class ContrastTransform(BrightnessTransform):
+    def _apply_image(self, img):
+        f = 1 + np.random.uniform(-self.value, self.value)
+        return adjust_contrast(img, f)
+
+
+class SaturationTransform(BrightnessTransform):
+    def _apply_image(self, img):
+        f = 1 + np.random.uniform(-self.value, self.value)
+        return adjust_saturation(img, f)
+
+
+class HueTransform(BrightnessTransform):
+    def _apply_image(self, img):
+        f = np.random.uniform(-self.value, self.value)
+        return adjust_hue(img, f)
+
+
+class ColorJitter(BaseTransform):
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0, keys=None):
+        super().__init__(keys)
+        self.t = [BrightnessTransform(brightness), ContrastTransform(contrast),
+                  SaturationTransform(saturation), HueTransform(hue)]
+
+    def _apply_image(self, img):
+        for tr in np.random.permutation(self.t):
+            img = tr._apply_image(img)
+        return img
+
+
+class RandomResizedCrop(BaseTransform):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation="bilinear", keys=None):
+        super().__init__(keys)
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.scale, self.ratio = scale, ratio
+
+    def _apply_image(self, img):
+        a = _as_np(img)
+        h, w = a.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = area * np.random.uniform(*self.scale)
+            ar = np.exp(np.random.uniform(np.log(self.ratio[0]),
+                                          np.log(self.ratio[1])))
+            cw = int(round(np.sqrt(target * ar)))
+            ch = int(round(np.sqrt(target / ar)))
+            if 0 < cw <= w and 0 < ch <= h:
+                i = np.random.randint(0, h - ch + 1)
+                j = np.random.randint(0, w - cw + 1)
+                return _resize_np(crop(a, i, j, ch, cw), self.size)
+        return _resize_np(center_crop(a, min(h, w)), self.size)
+
+
+class RandomRotation(BaseTransform):
+    def __init__(self, degrees, interpolation="nearest", expand=False,
+                 center=None, fill=0, keys=None):
+        super().__init__(keys)
+        self.degrees = ((-degrees, degrees) if isinstance(degrees, (int, float))
+                        else tuple(degrees))
+        self.fill = fill
+
+    def _apply_image(self, img):
+        return rotate(img, np.random.uniform(*self.degrees), fill=self.fill)
+
+
+class RandomAffine(BaseTransform):
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="nearest", fill=0, center=None, keys=None):
+        super().__init__(keys)
+        self.degrees = ((-degrees, degrees) if isinstance(degrees, (int, float))
+                        else tuple(degrees))
+        self.translate, self.scale_rng, self.shear = translate, scale, shear
+        self.fill = fill
+
+    def _apply_image(self, img):
+        a = _as_np(img)
+        ang = np.random.uniform(*self.degrees)
+        tr = (0, 0)
+        if self.translate:
+            tr = (np.random.uniform(-self.translate[0], self.translate[0]) * a.shape[1],
+                  np.random.uniform(-self.translate[1], self.translate[1]) * a.shape[0])
+        sc = np.random.uniform(*self.scale_rng) if self.scale_rng else 1.0
+        sh = (np.random.uniform(-self.shear, self.shear)
+              if isinstance(self.shear, (int, float)) else 0.0)
+        return affine(a, ang, tr, sc, sh, fill=self.fill)
+
+
+class RandomPerspective(BaseTransform):
+    def __init__(self, prob=0.5, distortion_scale=0.5,
+                 interpolation="nearest", fill=0, keys=None):
+        super().__init__(keys)
+        self.prob, self.d = prob, distortion_scale
+        self.fill = fill
+
+    def _apply_image(self, img):
+        a = _as_np(img)
+        if np.random.rand() >= self.prob:
+            return a
+        h, w = a.shape[:2]
+        dw, dh = int(self.d * w / 2), int(self.d * h / 2)
+        start = [(0, 0), (w - 1, 0), (w - 1, h - 1), (0, h - 1)]
+        end = [(np.random.randint(0, dw + 1), np.random.randint(0, dh + 1)),
+               (w - 1 - np.random.randint(0, dw + 1), np.random.randint(0, dh + 1)),
+               (w - 1 - np.random.randint(0, dw + 1), h - 1 - np.random.randint(0, dh + 1)),
+               (np.random.randint(0, dw + 1), h - 1 - np.random.randint(0, dh + 1))]
+        return perspective(a, start, end, fill=self.fill)
+
+
+class RandomErasing(BaseTransform):
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, inplace=False, keys=None):
+        super().__init__(keys)
+        self.prob, self.scale, self.ratio, self.value = prob, scale, ratio, value
+
+    def _apply_image(self, img):
+        a = _as_np(img)
+        if np.random.rand() >= self.prob:
+            return a
+        h, w = a.shape[:2]
+        for _ in range(10):
+            target = h * w * np.random.uniform(*self.scale)
+            ar = np.exp(np.random.uniform(np.log(self.ratio[0]),
+                                          np.log(self.ratio[1])))
+            eh, ew = int(round(np.sqrt(target / ar))), int(round(np.sqrt(target * ar)))
+            if eh < h and ew < w:
+                i = np.random.randint(0, h - eh + 1)
+                j = np.random.randint(0, w - ew + 1)
+                return erase(a, i, j, eh, ew, self.value)
+        return a
