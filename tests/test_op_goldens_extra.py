@@ -219,3 +219,34 @@ def test_dropout_downscale_in_infer_mode():
     np.testing.assert_allclose(kept, 1.0, rtol=1e-6)
     with pytest.raises(ValueError, match="dropout mode"):
         F.dropout(x, p=0.4, mode="bogus")
+
+
+def test_einsum_cases_match_numpy():
+    a = RNG.standard_normal((3, 4)).astype(np.float32)
+    b = RNG.standard_normal((4, 5)).astype(np.float32)
+    c = RNG.standard_normal((2, 3, 4)).astype(np.float32)
+    cases = [
+        ("ij,jk->ik", (a, b)),
+        ("ij->ji", (a,)),
+        ("ij->", (a,)),
+        ("bij,jk->bik", (c, b)),
+        ("ij,ij->i", (a, a)),
+        ("bij->bj", (c,)),
+    ]
+    for eq, ops_ in cases:
+        got = paddle.einsum(eq, *[_t(o) for o in ops_]).numpy()
+        want = np.einsum(eq, *ops_)
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5,
+                                   err_msg=eq)
+
+
+def test_broadcast_semantics():
+    a = RNG.standard_normal((3, 1, 5)).astype(np.float32)
+    b = RNG.standard_normal((4, 1)).astype(np.float32)
+    np.testing.assert_allclose((_t(a) + _t(b)).numpy(), a + b, rtol=1e-6)
+    out = paddle.broadcast_to(_t(b), [3, 4, 5]).numpy()
+    np.testing.assert_array_equal(out, np.broadcast_to(b, (3, 4, 5)))
+    shapes = paddle.broadcast_shape([3, 1, 5], [4, 1])
+    assert list(shapes) == [3, 4, 5]
+    x1, x2 = paddle.broadcast_tensors([_t(a), _t(b)])
+    assert x1.shape == [3, 4, 5] and x2.shape == [3, 4, 5]
