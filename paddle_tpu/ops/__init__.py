@@ -7,3 +7,5 @@ from .manipulation import *  # noqa: F401,F403
 from .math import *  # noqa: F401,F403
 from .random import *  # noqa: F401,F403
 from .extras import *  # noqa: F401,F403
+from . import inplace  # noqa: F401
+from .inplace import *  # noqa: F401,F403

@@ -1,0 +1,96 @@
+"""Inplace op variants (ref:python/paddle/tensor/*.py `*_` functions and the
+monkey-patched Tensor methods): compute out-of-place through the same
+dispatch path — XLA rewrites in place where profitable via donation — then
+rebind the tensor's buffer and bump its inplace version so stale tape reads
+fail loudly (the reference's inplace_version check)."""
+from __future__ import annotations
+
+import sys
+
+from ..core.tensor import Tensor
+
+_this = sys.modules[__name__]
+
+__all__ = ["add_", "subtract_", "multiply_", "remainder_", "clip_",
+           "ceil_", "floor_", "exp_", "reciprocal_", "round_", "sqrt_",
+           "rsqrt_", "tanh_", "erfinv_", "scale_", "lerp_", "flatten_",
+           "reshape_", "squeeze_", "unsqueeze_", "fill_", "zero_",
+           "uniform_", "scatter_", "index_add_", "put_along_axis_",
+           "fill_diagonal_"]
+
+
+def _rebind(x: Tensor, out) -> Tensor:
+    arr = out._data if isinstance(out, Tensor) else out
+    x._data = arr
+    x._version += 1
+    return x
+
+
+def _make(name, get_fn):
+    def op(x, *args, **kwargs):
+        return _rebind(x, get_fn()(x, *args, **kwargs))
+
+    op.__name__ = name
+    setattr(_this, name, op)
+    Tensor._register_method(name, op)
+
+
+def _from(mod_name, base_name):
+    def get():
+        from .. import ops
+
+        return getattr(ops, base_name)
+
+    return get
+
+
+for _base in ["add", "subtract", "multiply", "remainder", "clip", "ceil",
+              "floor", "exp", "reciprocal", "round", "sqrt", "rsqrt",
+              "tanh", "erfinv", "scale", "lerp", "flatten", "reshape",
+              "squeeze", "unsqueeze", "scatter", "index_add",
+              "put_along_axis"]:
+    _make(_base + "_", _from("ops", _base))
+
+
+def fill_(x, value):
+    """Fill with a scalar (ref fill_)."""
+    from . import creation
+
+    return _rebind(x, creation.full_like(x, value))
+
+
+def zero_(x):
+    from . import creation
+
+    return _rebind(x, creation.zeros_like(x))
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
+    """Refill with uniform noise (ref uniform_)."""
+    from . import random as prandom
+
+    return _rebind(
+        x, prandom.uniform(x.shape, dtype=str(x.dtype).replace("paddle.", ""),
+                           min=min, max=max))
+
+
+def fill_diagonal_(x, value, offset=0, wrap=False, name=None):
+    """Set the main diagonal (2-D) to ``value`` (ref fill_diagonal_)."""
+    import jax.numpy as jnp
+
+    from ..core.dispatch import apply
+
+    def _fd(a, *, value, offset):
+        n = min(a.shape[-2], a.shape[-1])
+        i = jnp.arange(n - abs(offset))
+        rows = i + max(-offset, 0)
+        cols = i + max(offset, 0)
+        return a.at[..., rows, cols].set(value)
+
+    return _rebind(x, apply(_fd, (x,), dict(value=float(value),
+                                            offset=int(offset)),
+                            name="fill_diagonal"))
+
+
+for _n in ("fill_", "zero_", "uniform_", "fill_diagonal_"):
+    Tensor._register_method(_n, getattr(_this, _n))

@@ -1,0 +1,72 @@
+"""Inplace op variants: value semantics, identity return, version bumps,
+and tape safety (ref:python/paddle/tensor `*_` ops + inplace_version)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def T(x):
+    return paddle.to_tensor(np.asarray(x, np.float32))
+
+
+def test_inplace_math_family():
+    x = T(np.ones(4))
+    assert x.add_(T(np.full(4, 2.0))) is x
+    np.testing.assert_allclose(x.numpy(), 3.0)
+    x.subtract_(T(np.ones(4)))
+    x.multiply_(T(np.full(4, 3.0)))
+    np.testing.assert_allclose(x.numpy(), 6.0)
+    x.sqrt_()
+    np.testing.assert_allclose(x.numpy(), np.sqrt(6.0), rtol=1e-6)
+    x.fill_(0.25)
+    x.rsqrt_()
+    np.testing.assert_allclose(x.numpy(), 2.0, rtol=1e-6)
+
+
+def test_inplace_shape_family():
+    t = T(np.arange(6).reshape(2, 3))
+    assert t.reshape_([3, 2]) is t and t.shape == [3, 2]
+    t.flatten_()
+    assert t.shape == [6]
+    t.unsqueeze_(0)
+    assert t.shape == [1, 6]
+    t.squeeze_()
+    assert t.shape == [6]
+
+
+def test_inplace_bumps_version():
+    x = T(np.ones(3))
+    v0 = x._version
+    x.add_(T(np.ones(3)))
+    x.zero_()
+    assert x._version == v0 + 2
+
+
+def test_inplace_after_save_for_backward_raises():
+    x = T(np.ones(3))
+    x.stop_gradient = False
+    y = (x * x).sum()  # saves x for the backward
+    x.add_(T(np.ones(3)))  # mutates after save
+    with pytest.raises(RuntimeError, match="[Ii]nplace|version"):
+        y.backward()
+
+
+def test_scatter_and_index_add_inplace():
+    x = T(np.zeros((3, 2)))
+    x.scatter_(paddle.to_tensor(np.array([1], np.int64)),
+               T(np.ones((1, 2))))
+    np.testing.assert_allclose(x.numpy()[1], 1.0)
+    x.index_add_(paddle.to_tensor(np.array([0], np.int64)), 0,
+                 T(np.full((1, 2), 5.0)))
+    np.testing.assert_allclose(x.numpy()[0], 5.0)
+
+
+def test_uniform_and_fill_diagonal():
+    paddle.seed(3)
+    t = T(np.zeros((4, 4)))
+    t.uniform_(0.0, 2.0)
+    assert 0.0 <= t.numpy().min() and t.numpy().max() <= 2.0
+    t.zero_()
+    t.fill_diagonal_(1.0)
+    np.testing.assert_allclose(t.numpy(), np.eye(4))
