@@ -220,10 +220,29 @@ class Tensor:
         return self
 
     def __setitem__(self, idx, value):
-        if isinstance(value, Tensor):
-            value = value._data
-        idx = _unwrap_index(idx)
-        self._data = self._data.at[idx].set(value)
+        """Differentiable in-place assignment (the reference's set_value op),
+        recorded through run_inplace: the vjp zeroes the overwritten region,
+        so gradients no longer flow through replaced entries; the value
+        tensor (if any) receives its gradient."""
+        from .dispatch import apply, run_inplace
+
+        idx_u = _unwrap_index(idx)
+        val_t = value if isinstance(value, Tensor) else Tensor(jnp.asarray(value))
+
+        if _index_is_static(idx_u):
+            run_inplace(
+                lambda t, v: apply(_setitem_static, (t, v), {"idx": idx_u},
+                                   name="set_value"), self, val_t)
+        elif not isinstance(idx_u, tuple):
+            run_inplace(
+                lambda t, i, v: apply(_setitem_dynamic, (t, i, v), {},
+                                      name="set_value"),
+                self, Tensor(jnp.asarray(idx_u)), val_t)
+        else:  # mixed dynamic tuple index: rare; plain functional update
+            arr = val_t._data
+            self._data = self._data.at[idx_u].set(
+                arr.astype(self._data.dtype) if hasattr(arr, "astype") else arr)
+            self._version += 1
 
     def __getitem__(self, idx):
         from .dispatch import apply
@@ -288,6 +307,23 @@ def _getitem_static(x, *, idx):
 
 def _getitem_dynamic(x, idx):
     return x[idx]
+
+
+def _fit_assign(v, slot_shape, dtype):
+    """numpy assignment broadcasting: surplus leading length-1 dims drop."""
+    v = v.astype(dtype)
+    while v.ndim > len(slot_shape) and v.shape[0] == 1:
+        v = v[0]
+    return v
+
+
+def _setitem_static(x, v, *, idx):
+    i = _unhash_index(idx)
+    return x.at[i].set(_fit_assign(v, x[i].shape, x.dtype))
+
+
+def _setitem_dynamic(x, idx, v):
+    return x.at[idx].set(_fit_assign(v, x[idx].shape, x.dtype))
 
 
 def to_tensor(data, dtype=None, place: Optional[Place] = None, stop_gradient: bool = True) -> Tensor:

@@ -1,77 +1,69 @@
 """Inplace op variants (ref:python/paddle/tensor/*.py `*_` functions and the
-monkey-patched Tensor methods): compute out-of-place through the same
-dispatch path — XLA rewrites in place where profitable via donation — then
-rebind the tensor's buffer and bump its inplace version so stale tape reads
-fail loudly (the reference's inplace_version check)."""
+monkey-patched Tensor methods), generated over the out-of-place ops through
+``core.dispatch.run_inplace`` — the op is recorded on the tape against an
+alias carrying the old producer, so consumers after the mutation
+differentiate through it and stale pre-mutation readers fail loudly.
+
+Names the op library already defines individually (tanh_, relu_, elu_,
+softmax_, squeeze_, unsqueeze_, scatter_, index_add_) are not redefined
+here."""
 from __future__ import annotations
 
 import sys
 
+from ..core.dispatch import run_inplace
 from ..core.tensor import Tensor
 
 _this = sys.modules[__name__]
 
 __all__ = ["add_", "subtract_", "multiply_", "remainder_", "clip_",
            "ceil_", "floor_", "exp_", "reciprocal_", "round_", "sqrt_",
-           "rsqrt_", "tanh_", "erfinv_", "scale_", "lerp_", "flatten_",
-           "reshape_", "squeeze_", "unsqueeze_", "fill_", "zero_",
-           "uniform_", "scatter_", "index_add_", "put_along_axis_",
+           "rsqrt_", "erfinv_", "scale_", "lerp_", "flatten_", "reshape_",
+           "put_along_axis_", "fill_", "zero_", "uniform_",
            "fill_diagonal_"]
 
 
-def _rebind(x: Tensor, out) -> Tensor:
-    arr = out._data if isinstance(out, Tensor) else out
-    x._data = arr
-    x._version += 1
-    return x
-
-
-def _make(name, get_fn):
+def _make(base):
     def op(x, *args, **kwargs):
-        return _rebind(x, get_fn()(x, *args, **kwargs))
-
-    op.__name__ = name
-    setattr(_this, name, op)
-    Tensor._register_method(name, op)
-
-
-def _from(mod_name, base_name):
-    def get():
         from .. import ops
 
-        return getattr(ops, base_name)
+        return run_inplace(getattr(ops, base), x, *args, **kwargs)
 
-    return get
+    op.__name__ = base + "_"
+    setattr(_this, base + "_", op)
+    Tensor._register_method(base + "_", op)
 
 
 for _base in ["add", "subtract", "multiply", "remainder", "clip", "ceil",
               "floor", "exp", "reciprocal", "round", "sqrt", "rsqrt",
-              "tanh", "erfinv", "scale", "lerp", "flatten", "reshape",
-              "squeeze", "unsqueeze", "scatter", "index_add",
+              "erfinv", "scale", "lerp", "flatten", "reshape",
               "put_along_axis"]:
-    _make(_base + "_", _from("ops", _base))
+    _make(_base)
 
 
 def fill_(x, value):
     """Fill with a scalar (ref fill_)."""
     from . import creation
 
-    return _rebind(x, creation.full_like(x, value))
+    return run_inplace(lambda t: creation.full_like(t, value), x)
 
 
 def zero_(x):
     from . import creation
 
-    return _rebind(x, creation.zeros_like(x))
+    return run_inplace(lambda t: creation.zeros_like(t), x)
 
 
 def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
-    """Refill with uniform noise (ref uniform_)."""
+    """Refill with uniform noise (ref uniform_). The old value doesn't feed
+    the result, so the history link is dropped (replace semantics)."""
+    from ..core.dispatch import replace_value
     from . import random as prandom
 
-    return _rebind(
-        x, prandom.uniform(x.shape, dtype=str(x.dtype).replace("paddle.", ""),
-                           min=min, max=max))
+    out = prandom.uniform(x.shape, dtype=str(x.dtype).replace("paddle.", ""),
+                          min=min, max=max)
+    replace_value(x, out)
+    return x
 
 
 def fill_diagonal_(x, value, offset=0, wrap=False, name=None):
@@ -85,11 +77,12 @@ def fill_diagonal_(x, value, offset=0, wrap=False, name=None):
         i = jnp.arange(n - abs(offset))
         rows = i + max(-offset, 0)
         cols = i + max(offset, 0)
-        return a.at[..., rows, cols].set(value)
+        return a.at[..., rows, cols].set(jnp.asarray(value, a.dtype))
 
-    return _rebind(x, apply(_fd, (x,), dict(value=float(value),
-                                            offset=int(offset)),
-                            name="fill_diagonal"))
+    return run_inplace(
+        lambda t: apply(_fd, (t,), dict(value=float(value),
+                                        offset=int(offset)),
+                        name="fill_diagonal"), x)
 
 
 for _n in ("fill_", "zero_", "uniform_", "fill_diagonal_"):

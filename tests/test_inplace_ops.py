@@ -44,12 +44,23 @@ def test_inplace_bumps_version():
 
 
 def test_inplace_after_save_for_backward_raises():
-    x = T(np.ones(3))
-    x.stop_gradient = False
+    leaf = T(np.ones(3))
+    leaf.stop_gradient = False
+    x = leaf * 1.0  # non-leaf (leaf mutation is rejected upfront)
     y = (x * x).sum()  # saves x for the backward
     x.add_(T(np.ones(3)))  # mutates after save
-    with pytest.raises(RuntimeError, match="[Ii]nplace|version"):
+    with pytest.raises(RuntimeError, match="[Ii]n-place|version"):
         y.backward()
+
+
+def test_inplace_on_grad_leaf_rejected():
+    x = T(np.ones(3))
+    x.stop_gradient = False
+    with pytest.raises(RuntimeError, match="[Ll]eaf"):
+        x.add_(T(np.ones(3)))
+    with paddle.no_grad():
+        x.add_(T(np.ones(3)))  # allowed without grad tracking
+    np.testing.assert_allclose(x.numpy(), 2.0)
 
 
 def test_scatter_and_index_add_inplace():
