@@ -465,6 +465,19 @@ def topk(x, k, axis=None, largest=True, sorted=True, name=None):
     return apply(_topk, (x,), dict(k=int(k), axis=axis, largest=bool(largest)))
 
 
+def _t_property(self):
+    """Tensor.T: reverse all dimensions (paddle contract; matrix transpose
+    for 2-D)."""
+    if len(self.shape) < 2:
+        return self
+    return transpose(self, list(range(len(self.shape)))[::-1])
+
+
+from ..core.tensor import Tensor as _Tensor  # noqa: E402
+
+_Tensor.T = property(_t_property)
+
+
 def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
     def _searchsorted(s, v, *, side, int32):
         if s.ndim > 1:

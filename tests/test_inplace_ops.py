@@ -81,3 +81,13 @@ def test_uniform_and_fill_diagonal():
     t.zero_()
     t.fill_diagonal_(1.0)
     np.testing.assert_allclose(t.numpy(), np.eye(4))
+
+
+def test_tensor_T_property():
+    t = T(np.arange(6).reshape(2, 3))
+    assert t.T.shape == [3, 2]
+    np.testing.assert_array_equal(t.T.numpy(), t.numpy().T)
+    u = T(np.arange(24).reshape(2, 3, 4))
+    assert u.T.shape == [4, 3, 2]
+    v = T(np.arange(3))
+    assert v.T.shape == [3]  # <2-D: unchanged (paddle contract)
