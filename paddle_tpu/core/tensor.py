@@ -226,19 +226,43 @@ class Tensor:
         tensor (if any) receives its gradient."""
         from .dispatch import apply, run_inplace
 
+        from . import autograd as _ag
+
         idx_u = _unwrap_index(idx)
         val_t = value if isinstance(value, Tensor) else Tensor(jnp.asarray(value))
 
         if _index_is_static(idx_u):
+            hidx = _hashable_index(idx_u)
             run_inplace(
-                lambda t, v: apply(_setitem_static, (t, v), {"idx": idx_u},
+                lambda t, v: apply(_setitem_static, (t, v), {"idx": hidx},
                                    name="set_value"), self, val_t)
+        elif _index_has_bool_mask(idx_u) and not isinstance(idx_u, tuple):
+            # mask assignment: expressible as where() when the value
+            # broadcasts against the full tensor (scalar / per-row value);
+            # a per-nonzero value vector has a data-dependent mapping
+            try:
+                np.broadcast_shapes(tuple(self._data.shape),
+                                    tuple(val_t._data.shape))
+            except ValueError:
+                raise NotImplementedError(
+                    "mask assignment with a per-nonzero value vector has a "
+                    "data-dependent mapping; use paddle.where or scatter")
+            run_inplace(
+                lambda t, m, v: apply(_setitem_mask, (t, m, v), {},
+                                      name="set_value"),
+                self, Tensor(jnp.asarray(idx_u)), val_t)
         elif not isinstance(idx_u, tuple):
             run_inplace(
                 lambda t, i, v: apply(_setitem_dynamic, (t, i, v), {},
                                       name="set_value"),
                 self, Tensor(jnp.asarray(idx_u)), val_t)
-        else:  # mixed dynamic tuple index: rare; plain functional update
+        else:  # mixed dynamic tuple index: rare; functional update, no tape
+            if (_ag.is_grad_enabled()
+                    and (not self.stop_gradient or not val_t.stop_gradient)):
+                raise NotImplementedError(
+                    "gradient through a mixed dynamic tuple index assignment "
+                    "is not supported; index with a single array or static "
+                    "slices, or assign under paddle.no_grad()")
             arr = val_t._data
             self._data = self._data.at[idx_u].set(
                 arr.astype(self._data.dtype) if hasattr(arr, "astype") else arr)
@@ -249,7 +273,9 @@ class Tensor:
 
         idx = _unwrap_index(idx)
         if _index_is_static(idx):
-            return apply(_getitem_static, (self,), {"idx": idx})
+            # slices encode hashably (slice.__hash__ is 3.12+ only)
+            return apply(_getitem_static, (self,),
+                         {"idx": _hashable_index(idx)})
         if _index_has_bool_mask(idx):
             # data-dependent output shape: host round-trip, eager only
             # (same contract as nonzero/masked_select)
@@ -324,6 +350,10 @@ def _setitem_static(x, v, *, idx):
 
 def _setitem_dynamic(x, idx, v):
     return x.at[idx].set(_fit_assign(v, x[idx].shape, x.dtype))
+
+
+def _setitem_mask(x, mask, v):
+    return jnp.where(mask, v.astype(x.dtype), x)
 
 
 def to_tensor(data, dtype=None, place: Optional[Place] = None, stop_gradient: bool = True) -> Tensor:

@@ -56,32 +56,58 @@ def zero_(x):
 
 def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
     """Refill with uniform noise (ref uniform_). The old value doesn't feed
-    the result, so the history link is dropped (replace semantics)."""
+    the result, so the history link is dropped (replace semantics) — but the
+    tensor's own trainability is preserved (re-initializing a parameter must
+    not freeze it)."""
     from ..core.dispatch import replace_value
     from . import random as prandom
 
+    was_trainable = not x.stop_gradient
     out = prandom.uniform(x.shape, dtype=str(x.dtype).replace("paddle.", ""),
                           min=min, max=max)
     replace_value(x, out)
+    if was_trainable:
+        x.stop_gradient = False
     return x
 
 
 def fill_diagonal_(x, value, offset=0, wrap=False, name=None):
-    """Set the main diagonal (2-D) to ``value`` (ref fill_diagonal_)."""
+    """Set the (offset) diagonal to ``value`` (ref fill_diagonal_):
+    2-D fills (i, i+offset) with numpy-style wrap for tall matrices;
+    >2-D fills the all-equal-index diagonal x[i, i, ..., i]."""
+    import numpy as _np
+
     import jax.numpy as jnp
 
     from ..core.dispatch import apply
 
-    def _fd(a, *, value, offset):
-        n = min(a.shape[-2], a.shape[-1])
-        i = jnp.arange(n - abs(offset))
-        rows = i + max(-offset, 0)
-        cols = i + max(offset, 0)
-        return a.at[..., rows, cols].set(jnp.asarray(value, a.dtype))
+    ndim = len(x.shape)
+    if ndim < 2:
+        raise ValueError("fill_diagonal_ needs at least 2 dims")
+    if ndim == 2:
+        rows_n, cols_n = x.shape
+        r0, c0 = max(-offset, 0), max(offset, 0)
+        if wrap:
+            # numpy fill_diagonal(wrap=True): flat stride cols+1 runs the
+            # diagonal again after each (cols+1)-row block of a tall matrix
+            flat = _np.arange(r0 * cols_n + c0, rows_n * cols_n, cols_n + 1)
+            idx = (tuple(flat // cols_n), tuple(flat % cols_n))
+        else:
+            n = max(min(rows_n - r0, cols_n - c0), 0)
+            idx = (tuple(range(r0, r0 + n)), tuple(range(c0, c0 + n)))
+    else:
+        if len(set(x.shape)) != 1:
+            raise ValueError(
+                "fill_diagonal_ on >2-D needs all dims equal (ref contract)")
+        n = x.shape[0]
+        idx = tuple(tuple(range(n)) for _ in range(ndim))
+
+    def _fd(a, *, value, idx):
+        ii = tuple(jnp.asarray(_np.asarray(i)) for i in idx)
+        return a.at[ii].set(jnp.asarray(value, a.dtype))
 
     return run_inplace(
-        lambda t: apply(_fd, (t,), dict(value=float(value),
-                                        offset=int(offset)),
+        lambda t: apply(_fd, (t,), dict(value=float(value), idx=idx),
                         name="fill_diagonal"), x)
 
 

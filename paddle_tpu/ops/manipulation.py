@@ -467,10 +467,12 @@ def topk(x, k, axis=None, largest=True, sorted=True, name=None):
 
 def _t_property(self):
     """Tensor.T: reverse all dimensions (paddle contract; matrix transpose
-    for 2-D)."""
-    if len(self.shape) < 2:
-        return self
-    return transpose(self, list(range(len(self.shape)))[::-1])
+    for 2-D). Always a new tensor, so in-place ops on the result never
+    alias-mutate the original regardless of rank."""
+    nd = len(self.shape)
+    if nd == 0:
+        return reshape(self, [])
+    return transpose(self, list(range(nd))[::-1])
 
 
 from ..core.tensor import Tensor as _Tensor  # noqa: E402

@@ -91,3 +91,38 @@ def test_tensor_T_property():
     assert u.T.shape == [4, 3, 2]
     v = T(np.arange(3))
     assert v.T.shape == [3]  # <2-D: unchanged (paddle contract)
+
+
+def test_mask_assignment_and_grad():
+    t = T(np.arange(4))
+    t[paddle.to_tensor(np.array([True, False, True, False]))] = -1.0
+    np.testing.assert_allclose(t.numpy(), [-1, 1, -1, 3])
+    x = T(np.ones(4))
+    x.stop_gradient = False
+    y = x * 2.0
+    y[paddle.to_tensor(np.array([True, True, False, False]))] = 0.0
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [0, 0, 2, 2])
+
+
+def test_fill_diagonal_offsets_and_wrap():
+    m = T(np.zeros((2, 5)))
+    m.fill_diagonal_(1.0, offset=2)
+    np.testing.assert_allclose(m.numpy(),
+                               [[0, 0, 1, 0, 0], [0, 0, 0, 1, 0]])
+    tall = T(np.zeros((5, 2)))
+    tall.fill_diagonal_(1.0, wrap=True)
+    ref = np.zeros((5, 2))
+    np.fill_diagonal(ref, 1.0, wrap=True)
+    np.testing.assert_allclose(tall.numpy(), ref)
+    cube = T(np.zeros((3, 3, 3)))
+    cube.fill_diagonal_(7.0)
+    assert cube.numpy().sum() == 21.0
+
+
+def test_uniform_preserves_trainability():
+    p = T(np.zeros(4))
+    p.stop_gradient = False
+    with paddle.no_grad():
+        p.uniform_()
+    assert not p.stop_gradient
