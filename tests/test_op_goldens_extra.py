@@ -250,3 +250,17 @@ def test_broadcast_semantics():
     assert list(shapes) == [3, 4, 5]
     x1, x2 = paddle.broadcast_tensors([_t(a), _t(b)])
     assert x1.shape == [3, 4, 5] and x2.shape == [3, 4, 5]
+
+
+def test_stft_istft_match_torch_roundtrip():
+    x = RNG.standard_normal(256).astype(np.float32)
+    win = np.hanning(65)[:-1].astype(np.float32)
+    got = paddle.signal.stft(_t(x[None]), n_fft=64, hop_length=16,
+                             window=_t(win), center=True).numpy()
+    want = torch.stft(torch.tensor(x[None]), n_fft=64, hop_length=16,
+                      window=torch.tensor(win), center=True,
+                      return_complex=True).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+    rec = paddle.signal.istft(_t(got), n_fft=64, hop_length=16,
+                              window=_t(win), center=True).numpy()
+    np.testing.assert_allclose(rec[0, :200], x[:200], rtol=1e-4, atol=1e-5)
