@@ -261,6 +261,6 @@ def test_stft_istft_match_torch_roundtrip():
                       window=torch.tensor(win), center=True,
                       return_complex=True).numpy()
     np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
-    rec = paddle.signal.istft(_t(got), n_fft=64, hop_length=16,
+    rec = paddle.signal.istft(paddle.to_tensor(got), n_fft=64, hop_length=16,
                               window=_t(win), center=True).numpy()
     np.testing.assert_allclose(rec[0, :200], x[:200], rtol=1e-4, atol=1e-5)
