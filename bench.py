@@ -14,7 +14,8 @@ training accounting: 6·N_matmul per token (fwd+bwd over every matmul
 parameter, including the tied LM head) plus 6·L·s·h for causal attention
 (QKᵀ and PV, halved for causality, ×3 for fwd+bwd).
 
-Env knobs for sweeps: BENCH_BATCH, BENCH_SEQ, BENCH_REMAT=1, BENCH_ITERS.
+Env knobs for sweeps: BENCH_BATCH, BENCH_SEQ, BENCH_REMAT=1, BENCH_ITERS,
+BENCH_CHUNK_LOSS=N (sequence-chunked fused LM-head loss).
 """
 from __future__ import annotations
 
@@ -91,10 +92,11 @@ def main():
     dev = jax.devices()[0]
     platform = dev.platform
     remat = os.environ.get("BENCH_REMAT", "0") == "1"
+    chunk = int(os.environ.get("BENCH_CHUNK_LOSS", "0"))
     if platform == "tpu":
         cfg = GPTConfig(vocab_size=50304, hidden_size=768, num_layers=12,
                         num_heads=12, max_position_embeddings=2048,
-                        use_recompute=remat)
+                        use_recompute=remat, loss_chunk_size=chunk)
         batch = int(os.environ.get("BENCH_BATCH", "8"))
         seq = int(os.environ.get("BENCH_SEQ", "1024"))
         warmup, iters = 3, int(os.environ.get("BENCH_ITERS", "10"))

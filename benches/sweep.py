@@ -18,11 +18,14 @@ OUT = os.path.join(HERE, "SWEEP_RESULTS.jsonl")
 
 POINTS = [
     {"BENCH_BATCH": "8", "BENCH_REMAT": "0"},
+    {"BENCH_BATCH": "8", "BENCH_REMAT": "0", "BENCH_CHUNK_LOSS": "1024"},
     {"BENCH_BATCH": "16", "BENCH_REMAT": "0"},
+    {"BENCH_BATCH": "16", "BENCH_REMAT": "0", "BENCH_CHUNK_LOSS": "1024"},
     {"BENCH_BATCH": "32", "BENCH_REMAT": "0"},
-    {"BENCH_BATCH": "64", "BENCH_REMAT": "0"},
+    {"BENCH_BATCH": "32", "BENCH_REMAT": "0", "BENCH_CHUNK_LOSS": "1024"},
+    {"BENCH_BATCH": "64", "BENCH_REMAT": "0", "BENCH_CHUNK_LOSS": "1024"},
     {"BENCH_BATCH": "32", "BENCH_REMAT": "1"},
-    {"BENCH_BATCH": "64", "BENCH_REMAT": "1"},
+    {"BENCH_BATCH": "64", "BENCH_REMAT": "1", "BENCH_CHUNK_LOSS": "1024"},
 ]
 
 

@@ -53,3 +53,26 @@ def test_gpt_sharded_hybrid_step():
     # TP weights really live sharded on the model axis
     w = model.gpt.layers[0].attn.qkv.weight
     assert "model" in str(w._data.sharding.spec)
+
+
+def test_chunked_lm_loss_matches_unchunked():
+    """loss_chunk_size fuses head+CE over sequence chunks without changing
+    the math (incl. ragged tail padding)."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import GPTForCausalLM, gpt_tiny
+
+    paddle.seed(11)
+    m1 = GPTForCausalLM(gpt_tiny())
+    paddle.seed(11)
+    cfg = gpt_tiny()
+    cfg.loss_chunk_size = 16
+    m2 = GPTForCausalLM(cfg)
+    rng = np.random.default_rng(1)
+    ids = rng.integers(0, 1024, (2, 33), dtype=np.int32)
+    x = paddle.to_tensor(ids)
+    y = paddle.to_tensor(np.roll(ids, -1, 1))
+    l1 = float(m1(x, y).numpy())
+    l2 = float(m2(x, y).numpy())
+    assert abs(l1 - l2) < 1e-4
