@@ -23,8 +23,10 @@ POINTS = [
     {"BENCH_BATCH": "16", "BENCH_REMAT": "0", "BENCH_CHUNK_LOSS": "1024"},
     {"BENCH_BATCH": "32", "BENCH_REMAT": "0"},
     {"BENCH_BATCH": "32", "BENCH_REMAT": "0", "BENCH_CHUNK_LOSS": "1024"},
+    {"BENCH_BATCH": "64", "BENCH_REMAT": "0"},
     {"BENCH_BATCH": "64", "BENCH_REMAT": "0", "BENCH_CHUNK_LOSS": "1024"},
     {"BENCH_BATCH": "32", "BENCH_REMAT": "1"},
+    {"BENCH_BATCH": "64", "BENCH_REMAT": "1"},
     {"BENCH_BATCH": "64", "BENCH_REMAT": "1", "BENCH_CHUNK_LOSS": "1024"},
 ]
 

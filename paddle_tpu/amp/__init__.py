@@ -20,7 +20,8 @@ from ..core.tensor import Tensor
 _state = threading.local()
 
 # ops that benefit from low precision (MXU ops)
-WHITE_LIST = {"matmul", "conv", "conv2d", "conv1d", "conv3d", "einsum", "mm", "bmm", "addmm", "linear", "linear_nb"}
+WHITE_LIST = {"matmul", "conv", "conv2d", "conv1d", "conv3d", "einsum", "mm",
+              "bmm", "addmm", "linear", "linear_nb", "chunked_lm_loss"}
 # ops that need f32 accumulate / range
 BLACK_LIST = {
     "exp", "log", "log2", "log10", "log1p", "logsumexp", "softmax", "log_softmax", "ce", "bce", "bcel",

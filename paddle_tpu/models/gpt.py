@@ -336,7 +336,7 @@ class GPTForCausalLM(nn.Layer):
             pad = (-n_tok) % chunk
             if pad:
                 hf = jnp.pad(hf, ((0, pad), (0, 0)))
-                yf = jnp.pad(yf, (0, pad), constant_values=-1)
+                yf = jnp.pad(yf, (0, pad), constant_values=-100)
             hc = hf.reshape(-1, chunk, hf.shape[-1])
             yc = yf.reshape(-1, chunk)
             w_mat = (wa.T if self.cfg.tie_word_embeddings else wa)  # [H, V]
@@ -344,17 +344,25 @@ class GPTForCausalLM(nn.Layer):
             @jax.checkpoint
             def body(carry, xs):
                 h_i, y_i = xs
-                logits = (h_i.astype(jnp.float32)
-                          @ w_mat.astype(jnp.float32))  # [chunk, V]
-                lse = jax.scipy.special.logsumexp(logits, axis=-1)
-                safe = jnp.where(y_i >= 0, y_i, 0)
+                # matmul in the ambient dtype (bf16 under AMP — this op is
+                # on the autocast white list); fp32 only in the reduction
+                logits = h_i @ w_mat  # [chunk, V]
+                lse = jax.scipy.special.logsumexp(
+                    logits.astype(jnp.float32), axis=-1)
+                valid = y_i != -100  # F.cross_entropy's ignore_index
+                safe = jnp.where(valid, jnp.clip(y_i, 0), 0)
                 picked = jnp.take_along_axis(
-                    logits, safe[:, None], axis=-1)[:, 0]
-                valid = (y_i >= 0).astype(jnp.float32)
-                return carry + ((lse - picked) * valid).sum(), None
+                    logits, safe[:, None], axis=-1)[:, 0].astype(jnp.float32)
+                vf = valid.astype(jnp.float32)
+                tot, cnt = carry
+                return (tot + ((lse - picked) * vf).sum(),
+                        cnt + vf.sum()), None
 
-            total, _ = jax.lax.scan(body, jnp.float32(0.0), (hc, yc))
-            return total / n_tok
+            (total, count), _ = jax.lax.scan(
+                body, (jnp.float32(0.0), jnp.float32(0.0)), (hc, yc))
+            # normalize by VALID tokens — identical to F.cross_entropy's
+            # weighted mean, so toggling chunking never rescales the loss
+            return total / jnp.maximum(count, 1.0)
 
         return apply(_loss, (h, labels, w), {}, name="chunked_lm_loss")
 

@@ -76,3 +76,28 @@ def test_chunked_lm_loss_matches_unchunked():
     l1 = float(m1(x, y).numpy())
     l2 = float(m2(x, y).numpy())
     assert abs(l1 - l2) < 1e-4
+
+
+def test_chunked_lm_loss_ignore_index_parity():
+    """With -100-padded labels the chunked path must match F.cross_entropy's
+    valid-token normalization exactly."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import GPTForCausalLM, gpt_tiny
+
+    paddle.seed(21)
+    m1 = GPTForCausalLM(gpt_tiny())
+    paddle.seed(21)
+    cfg = gpt_tiny()
+    cfg.loss_chunk_size = 16
+    m2 = GPTForCausalLM(cfg)
+    rng = np.random.default_rng(2)
+    ids = rng.integers(0, 1024, (2, 24), dtype=np.int32)
+    labels = np.roll(ids, -1, 1).astype(np.int64)
+    labels[:, -6:] = -100  # padded tail
+    x = paddle.to_tensor(ids)
+    y = paddle.to_tensor(labels)
+    l1 = float(m1(x, y).numpy())
+    l2 = float(m2(x, y).numpy())
+    assert abs(l1 - l2) < 1e-4
