@@ -7,7 +7,8 @@ import importlib
 import threading
 from collections import defaultdict
 
-__all__ = ["monitor", "try_import", "unique_name", "run_check"]
+__all__ = ["monitor", "try_import", "unique_name", "run_check",
+           "cpp_extension", "download"]
 
 
 class _Monitor:
@@ -87,3 +88,7 @@ def run_check():
     print(f"paddle_tpu is installed successfully! "
           f"(compiled and ran on {dev.platform}:{dev.id})")
     return True
+
+
+from . import cpp_extension  # noqa: F401,E402
+from . import download  # noqa: F401,E402

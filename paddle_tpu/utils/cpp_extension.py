@@ -1,0 +1,132 @@
+"""paddle.utils.cpp_extension (ref:python/paddle/utils/cpp_extension/):
+build and load user C++ extensions.
+
+TPU stance: device compute belongs in jax/Pallas (write a PyLayer with a
+custom vjp), so a C++ extension here is a HOST op — data loaders,
+tokenizers, samplers, custom services — exposed through a plain C ABI and
+consumed via ctypes (the same pattern as libpaddle_tpu_native.so). ``load``
+JIT-compiles sources with g++ into a cached shared library and returns the
+ctypes CDLL; ``CppExtension``/``setup`` wrap setuptools for wheel builds.
+``paddle_tpu.sysconfig.get_include()/get_lib()`` point at the framework's
+headers and library for extensions that want to link against the native
+runtime (e.g. reuse the PJRT runner or the trace recorder)."""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+from typing import List, Optional, Sequence
+
+__all__ = ["CppExtension", "CUDAExtension", "load", "setup",
+           "get_build_directory", "BuildExtension"]
+
+
+def get_build_directory(verbose=False) -> str:
+    d = os.environ.get(
+        "PADDLE_EXTENSION_DIR",
+        os.path.join(os.path.expanduser("~"), ".cache", "paddle_tpu",
+                     "extensions"))
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _default_flags(extra_cxx_flags):
+    from .. import sysconfig
+
+    flags = ["-O2", "-fPIC", "-shared", "-std=c++17", "-pthread",
+             f"-I{sysconfig.get_include()}"]
+    if extra_cxx_flags:
+        flags += list(extra_cxx_flags)
+    return flags
+
+
+def load(name: str, sources: Sequence[str], extra_cxx_flags=None,
+         extra_cuda_cflags=None, extra_ldflags=None, extra_include_paths=None,
+         build_directory: Optional[str] = None, interpreter=None,
+         verbose: bool = False):
+    """JIT-compile ``sources`` into ``<name>.so`` (cached by source+flag
+    hash) and return the loaded ctypes CDLL."""
+    sources = [os.path.abspath(s) for s in sources]
+    for s in sources:
+        if not os.path.exists(s):
+            raise FileNotFoundError(s)
+    flags = _default_flags(extra_cxx_flags)
+    if extra_include_paths:
+        flags += [f"-I{p}" for p in extra_include_paths]
+    ld = list(extra_ldflags or [])
+    h = hashlib.sha256()
+    for s in sources:
+        with open(s, "rb") as f:
+            h.update(f.read())
+    h.update(" ".join(flags + ld).encode())
+    tag = h.hexdigest()[:16]
+    out_dir = build_directory or get_build_directory()
+    so = os.path.join(out_dir, f"{name}_{tag}.so")
+    if not os.path.exists(so):
+        tmp = f"{so}.tmp{os.getpid()}"
+        cmd = ["g++"] + flags + ["-o", tmp] + sources + ld
+        if verbose:
+            print("cpp_extension:", " ".join(cmd))
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, text=True)
+        except subprocess.CalledProcessError as e:
+            raise RuntimeError(
+                f"building extension {name!r} failed:\n{e.stderr}") from e
+        os.replace(tmp, so)
+    return ctypes.CDLL(so)
+
+
+class CppExtension:
+    """setuptools Extension descriptor for the C-ABI host-op pattern."""
+
+    def __init__(self, sources: List[str], name: Optional[str] = None,
+                 include_dirs=None, extra_compile_args=None,
+                 extra_link_args=None, **kw):
+        self.name = name
+        self.sources = list(sources)
+        self.include_dirs = list(include_dirs or [])
+        self.extra_compile_args = list(extra_compile_args or [])
+        self.extra_link_args = list(extra_link_args or [])
+
+
+def CUDAExtension(sources, *args, **kwargs):
+    raise RuntimeError(
+        "CUDAExtension is not available on the TPU stack: device compute "
+        "goes through jax/Pallas (write a PyLayer with a custom vjp); use "
+        "CppExtension for host ops")
+
+
+class BuildExtension:
+    """build_py hook compiling every CppExtension into package data."""
+
+    def __init__(self, extensions: List[CppExtension],
+                 output_dir: Optional[str] = None):
+        self.extensions = extensions
+        self.output_dir = output_dir
+
+    def build(self):
+        outs = []
+        for ext in self.extensions:
+            out_dir = self.output_dir or get_build_directory()
+            flags = _default_flags(ext.extra_compile_args)
+            flags += [f"-I{d}" for d in ext.include_dirs]
+            out = os.path.join(out_dir, f"{ext.name or 'extension'}.so")
+            cmd = (["g++"] + flags + ["-o", out] + ext.sources
+                   + ext.extra_link_args)
+            subprocess.run(cmd, check=True, capture_output=True)
+            outs.append(out)
+        return outs
+
+
+def setup(name: Optional[str] = None, ext_modules=None, **kwargs):
+    """Build the given extensions immediately (the reference drives a full
+    setuptools build; for the ctypes C-ABI pattern an eager build into the
+    extension cache is the whole job). Returns the built .so paths."""
+    exts = ext_modules or []
+    if isinstance(exts, CppExtension):
+        exts = [exts]
+    for i, e in enumerate(exts):
+        if e.name is None:
+            e.name = f"{name or 'paddle_ext'}_{i}"
+    return BuildExtension(exts).build()

@@ -1,0 +1,58 @@
+"""paddle.utils.cpp_extension: JIT-build a host op and call it via ctypes
+(ref:python/paddle/utils/cpp_extension/)."""
+import ctypes
+import os
+
+import numpy as np
+import pytest
+
+from paddle_tpu.utils import cpp_extension as cpp
+
+
+SRC = r"""
+extern "C" double pd_ext_dot(const double* a, const double* b, int n) {
+    double s = 0.0;
+    for (int i = 0; i < n; i++) s += a[i] * b[i];
+    return s;
+}
+"""
+
+
+def test_load_builds_and_runs(tmp_path):
+    src = tmp_path / "dot.cc"
+    src.write_text(SRC)
+    lib = cpp.load("dot_ext", [str(src)], build_directory=str(tmp_path))
+    lib.pd_ext_dot.restype = ctypes.c_double
+    lib.pd_ext_dot.argtypes = [ctypes.POINTER(ctypes.c_double),
+                               ctypes.POINTER(ctypes.c_double), ctypes.c_int]
+    a = np.arange(4, dtype=np.float64)
+    b = np.full(4, 2.0)
+    got = lib.pd_ext_dot(a.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+                         b.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), 4)
+    assert got == 12.0
+    # cached: second load hits the same .so
+    sos = [f for f in os.listdir(tmp_path) if f.endswith(".so")]
+    cpp.load("dot_ext", [str(src)], build_directory=str(tmp_path))
+    assert [f for f in os.listdir(tmp_path) if f.endswith(".so")] == sos
+
+
+def test_build_error_is_reported(tmp_path):
+    bad = tmp_path / "bad.cc"
+    bad.write_text("this is not C++")
+    with pytest.raises(RuntimeError, match="building extension"):
+        cpp.load("bad_ext", [str(bad)], build_directory=str(tmp_path))
+
+
+def test_setup_builds_extensions(tmp_path):
+    src = tmp_path / "dot.cc"
+    src.write_text(SRC)
+    outs = cpp.setup(name="demo",
+                     ext_modules=cpp.CppExtension([str(src)]),
+                     )
+    assert outs and outs[0].endswith(".so") and os.path.exists(outs[0])
+    os.remove(outs[0])
+
+
+def test_cuda_extension_rejected():
+    with pytest.raises(RuntimeError, match="Pallas"):
+        cpp.CUDAExtension(["x.cu"])

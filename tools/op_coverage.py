@@ -36,6 +36,7 @@ MODULE_MAP = {
     "paddle.vision.models": "paddle_tpu.vision.models",
     "paddle.vision.ops": "paddle_tpu.vision.ops",
     "paddle.geometric": "paddle_tpu.geometric",
+    "paddle.utils.cpp_extension": "paddle_tpu.utils.cpp_extension",
     "paddle.distributed": "paddle_tpu.distributed",
     "paddle.io": "paddle_tpu.io",
     "paddle.amp": "paddle_tpu.amp",
