@@ -92,3 +92,55 @@ def run_check():
 
 from . import cpp_extension  # noqa: F401,E402
 from . import download  # noqa: F401,E402
+
+
+def deprecated(update_to="", since="", reason="", level=0):
+    """Decorator marking an API deprecated (ref:python/paddle/utils/
+    deprecated.py): warns once per call site, keeps the wrapped behavior."""
+    import functools
+    import warnings
+
+    def wrap(func):
+        msg = f"API '{func.__module__}.{func.__name__}' is deprecated"
+        if since:
+            msg += f" since {since}"
+        if update_to:
+            msg += f", use '{update_to}' instead"
+        if reason:
+            msg += f" ({reason})"
+        if level == 2:
+            @functools.wraps(func)
+            def dead(*a, **k):
+                raise RuntimeError(msg)
+
+            return dead
+
+        @functools.wraps(func)
+        def inner(*a, **k):
+            warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return func(*a, **k)
+
+        return inner
+
+    return wrap
+
+
+def require_version(min_version, max_version=None):
+    """Check the installed framework version against bounds
+    (ref:python/paddle/utils/__init__.py require_version)."""
+    from .. import __version__
+
+    def parse(v):
+        return tuple(int(p) for p in str(v).split(".")[:3] if p.isdigit())
+
+    cur = parse(__version__)
+    if parse(min_version) > cur:
+        raise Exception(
+            f"installed version {__version__} < required {min_version}")
+    if max_version is not None and parse(max_version) < cur:
+        raise Exception(
+            f"installed version {__version__} > allowed {max_version}")
+    return True
+
+
+__all__ += ["deprecated", "require_version"]

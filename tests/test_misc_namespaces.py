@@ -208,3 +208,21 @@ def test_reduce_lr_auto_mode_maximizes_accuracy():
     for a in (0.5, 0.6, 0.7, 0.8):  # steadily improving accuracy
         cb.on_eval_end({"acc": a})
     assert FakeModel._optimizer.lr == 1.0  # never reduced
+
+
+def test_utils_deprecated_and_require_version():
+    import warnings
+
+    from paddle_tpu import utils
+
+    @utils.deprecated(update_to="paddle.new_api", since="2.0")
+    def old():
+        return 42
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        assert old() == 42
+        assert any("deprecated" in str(x.message) for x in w)
+    assert utils.require_version("0.1.0")
+    with pytest.raises(Exception, match="<"):
+        utils.require_version("99.0.0")
