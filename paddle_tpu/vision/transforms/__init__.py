@@ -34,7 +34,11 @@ class ToTensor:
             arr = arr.astype(np.float32)
         if self.data_format == "CHW":
             arr = np.transpose(arr, (2, 0, 1))
-        return arr
+        from ...core.tensor import Tensor  # paddle contract: a Tensor out
+
+        import jax.numpy as jnp
+
+        return Tensor(jnp.asarray(arr))
 
 
 class Normalize:
@@ -44,12 +48,20 @@ class Normalize:
         self.data_format = data_format
 
     def __call__(self, img):
-        img = np.asarray(img, np.float32)
+        from ...core.tensor import Tensor
+
+        was_tensor = isinstance(img, Tensor)
+        arr = np.asarray(img._data if was_tensor else img, np.float32)
         if self.data_format == "CHW":
             shape = (-1, 1, 1)
         else:
             shape = (1, 1, -1)
-        return (img - self.mean.reshape(shape)) / self.std.reshape(shape)
+        out = (arr - self.mean.reshape(shape)) / self.std.reshape(shape)
+        if was_tensor:
+            import jax.numpy as jnp
+
+            return Tensor(jnp.asarray(out))
+        return out
 
 
 def _resize_np(img, size):
@@ -66,12 +78,39 @@ def _resize_np(img, size):
     return img[rows][:, cols]
 
 
+_PIL_MODES = {"nearest": 0, "lanczos": 1, "bilinear": 2, "bicubic": 3,
+              "box": 4, "hamming": 5}
+
+
 class Resize:
-    def __init__(self, size, interpolation="nearest"):
+    """Resize with the reference interpolation contract (PIL semantics,
+    incl. PIL's area-weighted downscale filters); PIL in -> PIL out,
+    array in -> array out (ref transforms.functional.resize)."""
+
+    def __init__(self, size, interpolation="bilinear", keys=None):
         self.size = size
+        if interpolation not in _PIL_MODES:
+            raise ValueError(f"unsupported interpolation {interpolation!r}")
+        self.interpolation = interpolation
+
+    def _target(self, w, h):
+        if isinstance(self.size, numbers.Number):
+            short = min(h, w)
+            scale = self.size / short
+            return max(int(round(w * scale)), 1), max(int(round(h * scale)), 1)
+        th, tw = self.size
+        return int(tw), int(th)
 
     def __call__(self, img):
-        return _resize_np(np.asarray(img), self.size)
+        from PIL import Image
+
+        was_pil = isinstance(img, Image.Image)
+        pil = img if was_pil else Image.fromarray(
+            np.asarray(img).astype(np.uint8)
+            if np.asarray(img).dtype != np.uint8 else np.asarray(img))
+        out = pil.resize(self._target(*pil.size),
+                         _PIL_MODES[self.interpolation])
+        return out if was_pil else np.asarray(out)
 
 
 class CenterCrop:

@@ -83,8 +83,9 @@ def test_transforms_pipeline():
     t = Compose([ToTensor(), Normalize(mean=[0.5], std=[0.5])])
     img = (np.random.rand(28, 28) * 255).astype(np.uint8)
     out = t(img)
-    assert out.shape == (1, 28, 28)
-    assert out.min() >= -1.0 - 1e-6 and out.max() <= 1.0 + 1e-6
+    assert list(out.shape) == [1, 28, 28]  # ToTensor returns a Tensor now
+    vals = out.numpy()
+    assert vals.min() >= -1.0 - 1e-6 and vals.max() <= 1.0 + 1e-6
 
 
 def test_vision_models_forward_shapes():
@@ -95,3 +96,22 @@ def test_vision_models_forward_shapes():
         m = ctor(num_classes=7)
         m.eval()
         assert m(x).shape == [2, 7]
+
+
+def test_resize_matches_pil_and_honors_interpolation():
+    from PIL import Image
+
+    from paddle_tpu.vision.transforms import Resize
+
+    img = Image.fromarray(
+        (np.random.rand(32, 48, 3) * 255).astype(np.uint8))
+    out = Resize(16)(img)
+    assert isinstance(out, Image.Image) and out.size == (24, 16)
+    np.testing.assert_array_equal(
+        np.asarray(out), np.asarray(img.resize((24, 16), Image.BILINEAR)))
+    nearest = Resize(16, interpolation="nearest")(img)
+    assert np.asarray(nearest).shape == (16, 24, 3)
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="interpolation"):
+        Resize(16, interpolation="bogus")
