@@ -526,10 +526,14 @@ class RandomErasing(BaseTransform):
         self.prob, self.scale, self.ratio, self.value = prob, scale, ratio, value
 
     def _apply_image(self, img):
-        a = _as_np(img)
+        from ...core.tensor import Tensor
+
+        was_tensor = isinstance(img, Tensor)
+        a = np.asarray(img._data) if was_tensor else _as_np(img)
+        chw = was_tensor  # Tensor input follows ToTensor's CHW layout
         if np.random.rand() >= self.prob:
-            return a
-        h, w = a.shape[:2]
+            return img
+        h, w = (a.shape[-2], a.shape[-1]) if chw else a.shape[:2]
         for _ in range(10):
             target = h * w * np.random.uniform(*self.scale)
             ar = np.exp(np.random.uniform(np.log(self.ratio[0]),
@@ -538,5 +542,14 @@ class RandomErasing(BaseTransform):
             if eh < h and ew < w:
                 i = np.random.randint(0, h - eh + 1)
                 j = np.random.randint(0, w - ew + 1)
-                return erase(a, i, j, eh, ew, self.value)
-        return a
+                if chw:
+                    out = a.copy()
+                    out[..., i:i + eh, j:j + ew] = self.value
+                else:
+                    out = erase(a, i, j, eh, ew, self.value)
+                if was_tensor:
+                    import jax.numpy as jnp
+
+                    return Tensor(jnp.asarray(out))
+                return out
+        return img
