@@ -170,3 +170,27 @@ def test_mp_loader_throughput_scales():
     speedup = t0 / t4
     print(f"serial {t0:.2f}s, 4 workers {t4:.2f}s, speedup {speedup:.2f}x")
     assert speedup > 1.5, f"multiprocess loader too slow: {speedup:.2f}x"
+
+
+def test_workers_handle_tensor_samples():
+    """ToTensor-style datasets emit paddle Tensors; the worker transport
+    must round-trip them (they serialize as arrays through shm)."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import io
+
+    class DS(io.Dataset):
+        def __len__(self):
+            return 16
+
+        def __getitem__(self, i):
+            return (paddle.to_tensor(np.full((3, 4), float(i), np.float32)),
+                    i)
+
+    dl = io.DataLoader(DS(), batch_size=4, num_workers=2)
+    seen = []
+    for xb, yb in dl:
+        assert xb.shape == [4, 3, 4]
+        seen.extend(np.asarray(yb.numpy()).ravel().tolist())
+    assert sorted(seen) == list(range(16))
