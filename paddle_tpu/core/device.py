@@ -101,6 +101,10 @@ def is_compiled_with_cuda() -> bool:  # API parity
     return False
 
 
+def is_compiled_with_xpu() -> bool:  # API parity
+    return False
+
+
 def is_compiled_with_tpu() -> bool:
     return _default_accelerator() == "tpu"
 
