@@ -237,20 +237,41 @@ class Tensor:
                 lambda t, v: apply(_setitem_static, (t, v), {"idx": hidx},
                                    name="set_value"), self, val_t)
         elif _index_has_bool_mask(idx_u) and not isinstance(idx_u, tuple):
-            # mask assignment: expressible as where() when the value
-            # broadcasts against the full tensor (scalar / per-row value);
-            # a per-nonzero value vector has a data-dependent mapping
-            try:
-                np.broadcast_shapes(tuple(self._data.shape),
-                                    tuple(val_t._data.shape))
-            except ValueError:
-                raise NotImplementedError(
-                    "mask assignment with a per-nonzero value vector has a "
-                    "data-dependent mapping; use paddle.where or scatter")
-            run_inplace(
-                lambda t, m, v: apply(_setitem_mask, (t, m, v), {},
-                                      name="set_value"),
-                self, Tensor(jnp.asarray(idx_u)), val_t)
+            # mask assignment: where() is only valid when the value applies
+            # identically at every selected position — a scalar, or a value
+            # broadcasting over the dims the mask does NOT index. A value
+            # mapped per-nonzero has a data-dependent layout: gather the
+            # nonzero coordinates on the host (eager-only, the bool-mask
+            # __getitem__ contract) and scatter in nonzero order.
+            mask = idx_u
+            k = getattr(mask, "ndim", 0)
+            trail = tuple(self._data.shape)[k:]
+            vshape = tuple(val_t._data.shape)
+            pos_independent = val_t._data.size == 1
+            if not pos_independent and len(vshape) <= len(trail):
+                try:
+                    np.broadcast_shapes(trail, vshape)
+                    pos_independent = True
+                except ValueError:
+                    pass
+            if pos_independent:
+                mask_e = jnp.asarray(mask)
+                mask_e = mask_e.reshape(tuple(mask_e.shape) + (1,) * len(trail))
+                run_inplace(
+                    lambda t, m, v: apply(_setitem_mask, (t, m, v), {},
+                                          name="set_value"),
+                    self, Tensor(mask_e), val_t)
+            else:
+                if (self._is_traced() or val_t._is_traced()
+                        or isinstance(mask, jax.core.Tracer)):
+                    raise NotImplementedError(
+                        "mask assignment with a per-nonzero value has a "
+                        "data-dependent mapping and cannot be jitted")
+                coords = np.nonzero(np.asarray(mask))
+                run_inplace(
+                    lambda t, v, *ii: apply(_setitem_coords, (t, v) + ii, {},
+                                            name="set_value"),
+                    self, val_t, *(Tensor(jnp.asarray(c)) for c in coords))
         elif not isinstance(idx_u, tuple):
             run_inplace(
                 lambda t, i, v: apply(_setitem_dynamic, (t, i, v), {},
@@ -354,6 +375,11 @@ def _setitem_dynamic(x, idx, v):
 
 def _setitem_mask(x, mask, v):
     return jnp.where(mask, v.astype(x.dtype), x)
+
+
+def _setitem_coords(x, v, *idx):
+    sel = tuple(idx)
+    return x.at[sel].set(_fit_assign(v, x[sel].shape, x.dtype))
 
 
 def to_tensor(data, dtype=None, place: Optional[Place] = None, stop_gradient: bool = True) -> Tensor:

@@ -90,10 +90,11 @@ class MultiHeadAttention(Layer):
             import jax.numpy as jnp
 
             from ..core.dispatch import apply as _apply
+            from ..core.tensor import Tensor
 
-            def _attn_w(qa, ka, va, *rest):
+            def _attn_w(qa, ka, va, *rest, has_mask, drop_p):
                 # qa/ka/va in [b, s, h, d]
-                m = rest[0] if rest else None
+                m = rest[0] if has_mask else None
                 qt, kt, vt = (jnp.swapaxes(t, 1, 2) for t in (qa, ka, va))
                 logits = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) / _math.sqrt(
                     qa.shape[-1])
@@ -102,13 +103,28 @@ class MultiHeadAttention(Layer):
                               if m.dtype == jnp.bool_ else logits + m)
                 p = jax.nn.softmax(logits.astype(jnp.float32), -1).astype(
                     qa.dtype)
+                if drop_p > 0.0:
+                    # probability dropout, matching the reference's F.dropout
+                    # on the returned weights (upscale_in_train)
+                    keep = jax.random.bernoulli(rest[-1], 1.0 - drop_p,
+                                                p.shape)
+                    p = jnp.where(keep, p / (1.0 - drop_p), 0.0).astype(
+                        p.dtype)
                 o = jnp.swapaxes(jnp.einsum("bhqk,bhkd->bhqd", p, vt), 1, 2)
                 return o, p
 
+            from ..core import rng as _rng
+
+            drop_p = self.dropout if self.training else 0.0
             args = (q, k, v)
             if attn_mask is not None:
                 args += (attn_mask,)
-            out, weights = _apply(_attn_w, args, {}, name="mha_with_weights")
+            if drop_p > 0.0:
+                args += (Tensor(_rng.next_key()),)
+            out, weights = _apply(
+                _attn_w, args,
+                dict(has_mask=attn_mask is not None, drop_p=float(drop_p)),
+                name="mha_with_weights")
         else:
             out = F.scaled_dot_product_attention(
                 q, k, v, attn_mask=attn_mask,

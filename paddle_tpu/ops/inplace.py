@@ -20,7 +20,7 @@ __all__ = ["add_", "subtract_", "multiply_", "remainder_", "clip_",
            "ceil_", "floor_", "exp_", "reciprocal_", "round_", "sqrt_",
            "rsqrt_", "erfinv_", "scale_", "lerp_", "flatten_", "reshape_",
            "put_along_axis_", "fill_", "zero_", "uniform_",
-           "fill_diagonal_"]
+           "fill_diagonal_", "sigmoid_"]
 
 
 def _make(base):
@@ -37,7 +37,7 @@ def _make(base):
 for _base in ["add", "subtract", "multiply", "remainder", "clip", "ceil",
               "floor", "exp", "reciprocal", "round", "sqrt", "rsqrt",
               "erfinv", "scale", "lerp", "flatten", "reshape",
-              "put_along_axis"]:
+              "put_along_axis", "sigmoid"]:
     _make(_base)
 
 
@@ -64,7 +64,7 @@ def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
 
     was_trainable = not x.stop_gradient
     out = prandom.uniform(x.shape, dtype=str(x.dtype).replace("paddle.", ""),
-                          min=min, max=max)
+                          min=min, max=max, seed=seed)
     replace_value(x, out)
     if was_trainable:
         x.stop_gradient = False

@@ -25,16 +25,19 @@ def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
     def _uniform(key, *, shape, dtype, lo, hi):
         return jax.random.uniform(key, shape, dtype=dtype, minval=lo, maxval=hi)
 
+    # nonzero seed = deterministic draw from that seed (ref uniform seed
+    # contract); 0 = draw from the global stream
+    key_t = Tensor(jax.random.key(int(seed))) if seed else _key_tensor()
     return apply(
         _uniform,
-        (_key_tensor(),),
+        (key_t,),
         dict(shape=_shape_arg(shape), dtype=dtype, lo=float(min), hi=float(max)),
         differentiable=False,
     )
 
 
 def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
-    x._data = uniform(x.shape, x.dtype, min, max)._data
+    x._data = uniform(x.shape, x.dtype, min, max, seed=seed)._data
     x._node = None  # random fill: previous producer is no longer relevant
     x._version += 1  # pre-fill consumers must not backward through this
     return x

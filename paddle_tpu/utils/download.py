@@ -49,13 +49,33 @@ def _download(url: str, dst_dir: str, md5sum: str | None) -> str:
     return fullpath
 
 
+def safe_extract_tar(tf: "tarfile.TarFile", dst: str) -> None:
+    """extractall with path-traversal protection on every Python we support."""
+    try:
+        # filter="data" rejects path traversal / links escaping dst
+        tf.extractall(dst, filter="data")
+    except TypeError:  # Python < 3.10.12/3.11.4: no filter kwarg
+        base = os.path.realpath(dst)
+        for m in tf.getmembers():
+            tgt = os.path.realpath(os.path.join(dst, m.name))
+            if (not (tgt == base or tgt.startswith(base + os.sep))
+                    or m.islnk() or m.issym()):
+                raise RuntimeError(f"archive member escapes target dir: {m.name}")
+        tf.extractall(dst)
+
+
 def _decompress(path: str) -> str:
     dst = os.path.dirname(path)
     if tarfile.is_tarfile(path):
         with tarfile.open(path) as tf:
-            tf.extractall(dst)
+            safe_extract_tar(tf, dst)
     elif zipfile.is_zipfile(path):
         with zipfile.ZipFile(path) as zf:
+            base = os.path.realpath(dst)
+            for m in zf.namelist():
+                tgt = os.path.realpath(os.path.join(dst, m))
+                if not (tgt == base or tgt.startswith(base + os.sep)):
+                    raise RuntimeError(f"archive member escapes target dir: {m}")
             zf.extractall(dst)
     return dst
 

@@ -46,8 +46,10 @@ class Flowers(Dataset):
         self.data_path = data_file + ".extracted"
         if not os.path.exists(self.data_path):
             tmp = f"{self.data_path}.tmp{os.getpid()}"
+            from ...utils.download import safe_extract_tar
+
             with tarfile.open(data_file) as tf:
-                tf.extractall(tmp)
+                safe_extract_tar(tf, tmp)
             try:
                 os.rename(tmp, self.data_path)
             except OSError:  # lost the race to another process: theirs wins

@@ -287,3 +287,15 @@ def test_download_rejected_without_file(tmp_path, monkeypatch):
                         str(tmp_path / "nope"))
     with pytest.raises(ValueError, match="auto download disabled"):
         UCIHousing(data_file=None, mode="train", download=False)
+
+
+def test_decompress_rejects_zip_traversal(tmp_path):
+    import zipfile
+
+    from paddle_tpu.utils.download import _decompress
+
+    zp = tmp_path / "evil.zip"
+    with zipfile.ZipFile(zp, "w") as z:
+        z.writestr("../evil.txt", "x")
+    with pytest.raises(RuntimeError, match="escapes"):
+        _decompress(str(zp))

@@ -356,3 +356,17 @@ def test_cross_entropy_ignore_index_with_weight_finite():
     l = float(F.cross_entropy(logits, labels).numpy())
     assert np.isfinite(lw) and np.isfinite(l)
     assert abs(lw - l) < 1e-5  # all-ones weights == unweighted
+
+
+def test_mha_need_weights_dropout():
+    # the explicit-weights path applies probability dropout in training
+    # (ref MultiHeadAttention applies F.dropout to the weights)
+    paddle.seed(7)
+    mha = nn.MultiHeadAttention(16, 4, dropout=0.5, need_weights=True)
+    x = paddle.randn([2, 5, 16])
+    mha.train()
+    _, w_train = mha(x, x, x)
+    assert (w_train.numpy() == 0).any()
+    mha.eval()
+    _, w_eval = mha(x, x, x)
+    assert np.allclose(w_eval.numpy().sum(-1), 1.0, atol=1e-4)

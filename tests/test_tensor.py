@@ -91,3 +91,58 @@ def test_zero_dim():
     t = paddle.to_tensor(2.0)
     assert t.shape == []
     assert (t + 1).item() == 3.0
+
+
+def test_setitem_bool_mask_per_nonzero():
+    # a value vector maps to selected positions in nonzero order, not by
+    # broadcast against the full shape (numpy/paddle set_value semantics)
+    m = np.array([[1, 0, 0, 0], [0, 0, 1, 0], [1, 0, 0, 1]], bool)
+    x = paddle.zeros([3, 4])
+    x[paddle.to_tensor(m)] = paddle.to_tensor(np.array([1., 2., 3., 4.], np.float32))
+    assert np.allclose(x.numpy(), [[1, 0, 0, 0], [0, 0, 2, 0], [3, 0, 0, 4]])
+
+    # scalar value: where() fast path
+    y = paddle.zeros([3, 4])
+    y[paddle.to_tensor(m)] = 7.0
+    assert y.numpy().sum() == 28
+
+    # leading-dim mask, value broadcast over the unmasked trailing dim
+    rm = np.array([True, False, True])
+    z = paddle.zeros([3, 4])
+    z[paddle.to_tensor(rm)] = paddle.to_tensor(np.arange(4, dtype=np.float32))
+    zn = np.zeros((3, 4), np.float32)
+    zn[rm] = np.arange(4, dtype=np.float32)
+    assert np.allclose(z.numpy(), zn)
+
+    # leading-dim mask with a per-selected-row value block
+    w = paddle.zeros([3, 4])
+    w[paddle.to_tensor(rm)] = paddle.to_tensor(
+        np.arange(8, dtype=np.float32).reshape(2, 4))
+    wn = np.zeros((3, 4), np.float32)
+    wn[rm] = np.arange(8, dtype=np.float32).reshape(2, 4)
+    assert np.allclose(w.numpy(), wn)
+
+
+def test_setitem_bool_mask_per_nonzero_grad():
+    m = np.array([[1, 0, 0, 0], [0, 0, 1, 0], [1, 0, 0, 1]], bool)
+    v = paddle.to_tensor(np.array([1., 2., 3., 4.], np.float32),
+                         stop_gradient=False)
+    g = paddle.ones([3, 4]) * 2
+    g.stop_gradient = False
+    g2 = g * 1.0
+    g2[paddle.to_tensor(m)] = v * 2
+    g2.sum().backward()
+    assert np.allclose(v.grad.numpy(), [2, 2, 2, 2])
+
+
+def test_uniform_inplace_seed_deterministic():
+    a = paddle.ones([16])
+    b = paddle.ones([16])
+    a.uniform_(seed=123)
+    b.uniform_(seed=123)
+    assert np.allclose(a.numpy(), b.numpy())
+    c = paddle.ones([16])
+    d = paddle.ones([16])
+    c.uniform_()
+    d.uniform_()
+    assert not np.allclose(c.numpy(), d.numpy())
