@@ -201,3 +201,30 @@ def flops(net, input_size, custom_ops=None, print_detail=False):
     if print_detail:
         print(f"Total Flops: {total}")
     return total
+
+
+# ---------------------------------------------------------- Tensor methods
+# The reference patches every ``tensor_method_func`` name onto the Tensor
+# class (ref:python/paddle/tensor/__init__.py monkey_patch). Most methods
+# register at their op's definition site; the remainder are namespace
+# functions patched here so ``x.op(...)`` works for the full method surface.
+_TENSOR_METHOD_PATCH = [
+    "add_n", "addmm", "allclose", "as_complex", "as_real", "bincount",
+    "broadcast_shape", "broadcast_tensors", "bucketize", "cholesky_solve",
+    "clip", "concat", "cond", "corrcoef", "count_nonzero", "cov",
+    "create_parameter", "create_tensor", "cumprod", "cumsum", "deg2rad",
+    "diff", "eig", "eigvals", "eigvalsh", "equal_all", "exponential_",
+    "histogram", "increment", "index_sample", "is_tensor", "lerp",
+    "logsumexp", "lstsq", "lu", "lu_unpack", "matrix_power", "median",
+    "multi_dot", "multiplex", "nan_to_num", "polar", "qr", "quantile",
+    "rad2deg", "rank", "reverse", "rot90", "scale", "scatter_nd",
+    "shard_index", "slice", "solve", "stack", "stanh", "std",
+    "strided_slice", "trace", "triangular_solve", "unique_consecutive",
+    "unstack", "var",
+]
+from .core.tensor import Tensor as _PatchT  # noqa: E402
+
+for _n in _TENSOR_METHOD_PATCH:
+    if not hasattr(_PatchT, _n) and _n in globals():
+        _PatchT._register_method(_n, globals()[_n])
+del _PatchT

@@ -22,7 +22,7 @@ from .functional import (compute_fbank_matrix, create_dct, get_window,
 
 __all__ = ["Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC",
            "functional", "compute_fbank_matrix", "create_dct", "hz_to_mel",
-           "mel_to_hz"]
+           "mel_to_hz", "backends", "datasets", "info", "load", "save"]
 
 
 class Spectrogram(nn.Layer):
@@ -122,3 +122,10 @@ class MFCC(nn.Layer):
             return jnp.einsum("km,...mt->...kt", d, lm)
 
         return apply(_dct, (lm, self.dct), {})
+
+
+# IO + datasets live in subpackages; imported last so their (lazy) references
+# back to the feature layers above resolve
+from . import backends  # noqa: E402
+from . import datasets  # noqa: E402
+from .backends import info, load, save  # noqa: E402

@@ -1,0 +1,233 @@
+"""paddle.audio.backends: wav IO with a pluggable backend registry
+(ref:python/paddle/audio/backends/init_backend.py, wave_backend.py).
+
+The default ``wave_backend`` wraps the stdlib ``wave`` module and handles
+PCM WAV (8/16/32-bit — wider than the reference's 16-bit-only backend).
+``soundfile`` is offered as an extra backend when the optional ``soundfile``
+package is importable (the reference gets it from ``paddleaudio``).
+
+Audio decode is host-side IO, not accelerator work: tensors are produced on
+host and enter the XLA program through the DataLoader like any other input.
+"""
+from __future__ import annotations
+
+import sys
+import wave
+from pathlib import Path
+from typing import List, Optional, Tuple, Union
+
+import numpy as np
+
+__all__ = ["AudioInfo", "info", "load", "save", "list_available_backends",
+           "get_current_backend", "set_backend"]
+
+
+class AudioInfo:
+    """Signal metadata returned by :func:`info`."""
+
+    def __init__(self, sample_rate: int, num_samples: int, num_channels: int,
+                 bits_per_sample: int, encoding: str):
+        self.sample_rate = sample_rate
+        self.num_samples = num_samples
+        self.num_channels = num_channels
+        self.bits_per_sample = bits_per_sample
+        self.encoding = encoding
+
+    def __repr__(self):  # debugging aid
+        return (f"AudioInfo(sample_rate={self.sample_rate}, "
+                f"num_samples={self.num_samples}, "
+                f"num_channels={self.num_channels}, "
+                f"bits_per_sample={self.bits_per_sample}, "
+                f"encoding={self.encoding!r})")
+
+
+# -- wave backend -----------------------------------------------------------
+
+_PCM_DTYPES = {1: np.uint8, 2: np.dtype("<i2"), 4: np.dtype("<i4")}
+
+
+def _open_wave(filepath):
+    owns = not hasattr(filepath, "read")
+    fobj = open(filepath, "rb") if owns else filepath
+    try:
+        return wave.open(fobj), fobj, owns
+    except wave.Error as e:
+        if owns:
+            fobj.close()
+        raise NotImplementedError(
+            "wave_backend only reads PCM WAV files; for other formats "
+            "install `soundfile` and call "
+            "paddle.audio.backends.set_backend('soundfile')") from e
+
+
+def _wave_info(filepath) -> AudioInfo:
+    wf, fobj, owns = _open_wave(filepath)
+    try:
+        return AudioInfo(wf.getframerate(), wf.getnframes(),
+                         wf.getnchannels(), wf.getsampwidth() * 8, "PCM_S")
+    finally:
+        if owns:
+            fobj.close()
+
+
+def _wave_load(filepath: Union[str, Path], frame_offset: int = 0,
+               num_frames: int = -1, normalize: bool = True,
+               channels_first: bool = True):
+    from ...core.tensor import to_tensor
+
+    wf, fobj, owns = _open_wave(filepath)
+    try:
+        channels = wf.getnchannels()
+        rate = wf.getframerate()
+        width = wf.getsampwidth()
+        total = wf.getnframes()
+        if width not in _PCM_DTYPES:
+            raise NotImplementedError(
+                f"wave_backend: unsupported sample width {width * 8} bits")
+        # seek instead of decoding the whole file when a window is requested
+        wf.setpos(min(max(frame_offset, 0), total))
+        n = total - wf.tell() if num_frames == -1 else num_frames
+        raw = wf.readframes(max(n, 0))
+    finally:
+        if owns:
+            fobj.close()
+
+    data = np.frombuffer(raw, dtype=_PCM_DTYPES[width]).reshape(-1, channels)
+    if normalize:
+        if width == 1:  # unsigned 8-bit PCM is offset-binary
+            arr = (data.astype(np.float32) - 128.0) / 128.0
+        else:
+            arr = data.astype(np.float32) / float(2 ** (width * 8 - 1))
+    elif width == 2:
+        arr = data
+    elif width == 1:  # offset-binary uint8 -> signed 16-bit PCM
+        arr = ((data.astype(np.int16) - 128) << 8).astype(np.int16)
+    else:  # 32-bit PCM -> 16-bit by dropping low bits (contract: int16 out)
+        arr = (data >> 16).astype(np.int16)
+    if channels_first:
+        arr = np.ascontiguousarray(arr.T)
+    return to_tensor(arr), rate
+
+
+def _wave_save(filepath: str, src, sample_rate: int,
+               channels_first: bool = True, encoding: Optional[str] = None,
+               bits_per_sample: Optional[int] = 16) -> None:
+    arr = np.asarray(src.numpy() if hasattr(src, "numpy") else src)
+    if arr.ndim != 2:
+        raise ValueError(f"expected a 2-D (channels, time) tensor, got "
+                         f"shape {arr.shape}")
+    if channels_first:
+        arr = arr.T  # -> (time, channels)
+    if encoding not in (None, "PCM_S"):
+        raise ValueError(f"wave_backend only writes PCM ({encoding!r})")
+    if bits_per_sample not in (None, 16):
+        raise ValueError("wave_backend only writes 16-bit samples")
+    if arr.dtype != np.int16:
+        arr = np.clip(arr.astype(np.float32), -1.0, 1.0 - 1.0 / 32768)
+        arr = (arr * 32768.0).astype("<i2")
+    with wave.open(str(filepath), "wb") as wf:
+        wf.setnchannels(arr.shape[1])
+        wf.setsampwidth(2)
+        wf.setframerate(int(sample_rate))
+        wf.writeframes(np.ascontiguousarray(arr).tobytes())
+
+
+# -- soundfile backend (optional) ------------------------------------------
+
+def _soundfile_mod():
+    try:
+        import soundfile  # noqa: F401
+        return soundfile
+    except ImportError:
+        return None
+
+
+def _sf_info(filepath) -> AudioInfo:
+    sf = _soundfile_mod()
+    i = sf.info(str(filepath))
+    bits = {"PCM_16": 16, "PCM_24": 24, "PCM_32": 32, "PCM_U8": 8,
+            "FLOAT": 32, "DOUBLE": 64}.get(i.subtype, 16)
+    return AudioInfo(i.samplerate, i.frames, i.channels, bits, i.subtype)
+
+
+def _sf_load(filepath, frame_offset=0, num_frames=-1, normalize=True,
+             channels_first=True):
+    from ...core.tensor import to_tensor
+
+    sf = _soundfile_mod()
+    stop = None if num_frames == -1 else frame_offset + num_frames
+    data, rate = sf.read(str(filepath), start=frame_offset, stop=stop,
+                         dtype="float32" if normalize else "int16",
+                         always_2d=True)
+    if channels_first:
+        data = np.ascontiguousarray(data.T)
+    return to_tensor(data), rate
+
+
+def _sf_save(filepath, src, sample_rate, channels_first=True, encoding=None,
+             bits_per_sample=16):
+    sf = _soundfile_mod()
+    arr = np.asarray(src.numpy() if hasattr(src, "numpy") else src)
+    if channels_first:
+        arr = arr.T
+    subtype = {8: "PCM_U8", 16: "PCM_16", 24: "PCM_24", 32: "PCM_32"}.get(
+        bits_per_sample or 16, "PCM_16")
+    sf.write(str(filepath), arr, int(sample_rate), subtype=subtype)
+
+
+# -- registry ---------------------------------------------------------------
+
+_BACKENDS = {"wave_backend": (_wave_info, _wave_load, _wave_save)}
+_current = "wave_backend"
+
+
+def list_available_backends() -> List[str]:
+    """Names accepted by :func:`set_backend`."""
+    names = ["wave_backend"]
+    if _soundfile_mod() is not None:
+        names.append("soundfile")
+    return names
+
+
+def get_current_backend() -> str:
+    return _current
+
+
+def set_backend(backend_name: str) -> None:
+    """Route paddle.audio.{info,load,save} through the named backend."""
+    global _current
+    if backend_name not in list_available_backends():
+        raise NotImplementedError(
+            f"unknown audio backend {backend_name!r}; available: "
+            f"{list_available_backends()}")
+    if backend_name == "soundfile" and "soundfile" not in _BACKENDS:
+        _BACKENDS["soundfile"] = (_sf_info, _sf_load, _sf_save)
+    _current = backend_name
+    # re-export on the audio namespace, mirroring the reference's setattr
+    audio_mod = sys.modules.get("paddle_tpu.audio")
+    if audio_mod is not None:
+        audio_mod.info, audio_mod.load, audio_mod.save = info, load, save
+
+
+def info(filepath) -> AudioInfo:
+    """Metadata of an audio file via the current backend."""
+    return _BACKENDS[_current][0](filepath)
+
+
+def load(filepath, frame_offset: int = 0, num_frames: int = -1,
+         normalize: bool = True, channels_first: bool = True):
+    """Load audio as (Tensor, sample_rate).
+
+    normalize=True returns float32 in [-1, 1); False returns raw int16.
+    channels_first=True returns (channels, time).
+    """
+    return _BACKENDS[_current][1](filepath, frame_offset, num_frames,
+                                  normalize, channels_first)
+
+
+def save(filepath, src, sample_rate: int, channels_first: bool = True,
+         encoding: Optional[str] = None,
+         bits_per_sample: Optional[int] = 16) -> None:
+    """Write a (channels, time) [or (time, channels)] tensor as PCM WAV."""
+    return _BACKENDS[_current][2](filepath, src, sample_rate, channels_first,
+                                  encoding, bits_per_sample)

@@ -1,0 +1,202 @@
+"""paddle.audio.datasets: audio classification datasets
+(ref:python/paddle/audio/datasets/dataset.py, tess.py, esc50.py).
+
+Each dataset yields ``(feature, label)`` where the feature is either the
+raw waveform or an on-the-fly Spectrogram/MelSpectrogram/LogMel/MFCC —
+computed by the framework's XLA feature layers, so with feat_type != 'raw'
+the extraction runs as a compiled TPU program when the data pipeline is
+device-backed (the reference computes these with eager GPU kernels).
+
+Offline use: both datasets accept ``archive={'url':..., 'md5':...}`` like
+the reference, and the audio tree is searched under ``DATA_HOME`` — point
+``PADDLE_TPU_DATA_HOME`` (or pre-extract the archive) at a local copy; no
+network is required when the files are already in place.
+"""
+from __future__ import annotations
+
+import collections
+import os
+from typing import List, Tuple
+
+import numpy as np
+
+from ...io import Dataset
+from ...utils import download as _dl
+
+__all__ = ["AudioClassificationDataset", "TESS", "ESC50"]
+
+
+_FEAT_NAMES = ("raw", "spectrogram", "melspectrogram", "logmelspectrogram",
+               "mfcc")
+
+
+def _feat_layer(feat_type: str, sample_rate: int, config: dict):
+    from .. import MFCC, LogMelSpectrogram, MelSpectrogram, Spectrogram
+
+    if feat_type == "spectrogram":
+        return Spectrogram(**config)
+    cls = {"melspectrogram": MelSpectrogram,
+           "logmelspectrogram": LogMelSpectrogram,
+           "mfcc": MFCC}[feat_type]
+    return cls(sr=sample_rate, **config)
+
+
+class AudioClassificationDataset(Dataset):
+    """Base: a list of audio files + integer labels, with optional feature
+    extraction (ref:python/paddle/audio/datasets/dataset.py:30)."""
+
+    def __init__(self, files: List[str], labels: List[int],
+                 feat_type: str = "raw", sample_rate: int = None, **kwargs):
+        super().__init__()
+        if feat_type not in _FEAT_NAMES:
+            raise RuntimeError(
+                f"Unknown feat_type: {feat_type}, it must be one in "
+                f"{list(_FEAT_NAMES)}")
+        self.files = files
+        self.labels = labels
+        self.feat_type = feat_type
+        self.sample_rate = sample_rate
+        self.feat_config = kwargs
+        self._extractor = None  # built lazily from the first file's rate
+
+    def _get_data(self, *args):
+        raise NotImplementedError
+
+    def __getitem__(self, idx):
+        from .. import backends
+
+        waveform, sr = backends.load(self.files[idx])
+        self.sample_rate = sr
+        arr = waveform.numpy()
+        if arr.ndim == 2:  # mono: drop the channel axis like the reference
+            arr = arr[0] if arr.shape[0] == 1 else arr.mean(0)
+        from ...core.tensor import to_tensor
+
+        wave_t = to_tensor(arr.astype(np.float32))
+        if self.feat_type == "raw":
+            return wave_t, self.labels[idx]
+        if self._extractor is None:
+            self._extractor = _feat_layer(self.feat_type, sr,
+                                          self.feat_config)
+        feat = self._extractor(wave_t.unsqueeze(0)).squeeze(0)
+        return feat, self.labels[idx]
+
+    def __len__(self):
+        return len(self.files)
+
+
+class TESS(AudioClassificationDataset):
+    """Toronto emotional speech set: 2800 clips, 7 emotions, labelled by
+    filename ``<speaker>_<word>_<emotion>.wav``
+    (ref:python/paddle/audio/datasets/tess.py:26). Folds are assigned
+    round-robin over the file list; ``split`` selects the dev fold."""
+
+    archive = {
+        "url": "https://bj.bcebos.com/paddleaudio/datasets/"
+               "TESS_Toronto_emotional_speech_set.zip",
+        "md5": "1465311b24d1de704c4c63e4ccc470c7",
+    }
+    label_list = ["angry", "disgust", "fear", "happy", "neutral", "ps", "sad"]
+    meta_info = collections.namedtuple("META_INFO",
+                                       ("speaker", "word", "emotion"))
+    audio_path = "TESS_Toronto_emotional_speech_set"
+
+    def __init__(self, mode: str = "train", n_folds: int = 5, split: int = 1,
+                 feat_type: str = "raw", archive=None, **kwargs):
+        if not (isinstance(n_folds, int) and n_folds >= 1):
+            raise ValueError(f"n_folds must be a positive int, got {n_folds}")
+        if split not in range(1, n_folds + 1):
+            raise ValueError(
+                f"split must satisfy 1 <= split <= {n_folds}, got {split}")
+        if archive is not None:
+            self.archive = archive
+        files, labels = self._get_data(mode, n_folds, split)
+        super().__init__(files=files, labels=labels, feat_type=feat_type,
+                         **kwargs)
+
+    def _get_data(self, mode: str, n_folds: int,
+                  split: int) -> Tuple[List[str], List[int]]:
+        root = os.path.join(_dl.DATA_HOME, self.audio_path)
+        if not os.path.isdir(root):
+            _dl.get_path_from_url(self.archive["url"], _dl.DATA_HOME,
+                                  self.archive["md5"], decompress=True)
+        wavs = sorted(
+            os.path.join(r, f)
+            for r, _, fs in os.walk(root) for f in fs if f.endswith(".wav"))
+        files, labels = [], []
+        for idx, path in enumerate(wavs):
+            emotion = os.path.basename(path)[:-4].split("_")[-1]
+            fold = idx % n_folds + 1
+            keep = (fold != split) if mode == "train" else (fold == split)
+            if keep:
+                files.append(path)
+                labels.append(self.label_list.index(emotion))
+        return files, labels
+
+
+class ESC50(AudioClassificationDataset):
+    """ESC-50 environmental sounds: 2000 clips, 50 classes, 5 predefined
+    folds from ``meta/esc50.csv``
+    (ref:python/paddle/audio/datasets/esc50.py:25)."""
+
+    archive = {
+        "url": "https://paddleaudio.bj.bcebos.com/datasets/ESC-50-master.zip",
+        "md5": "7771e4b9d86d0945acce719c7a59305a",
+    }
+    label_list = [
+        # Animals
+        "Dog", "Rooster", "Pig", "Cow", "Frog", "Cat", "Hen",
+        "Insects (flying)", "Sheep", "Crow",
+        # Natural soundscapes & water
+        "Rain", "Sea waves", "Crackling fire", "Crickets", "Chirping birds",
+        "Water drops", "Wind", "Pouring water", "Toilet flush",
+        "Thunderstorm",
+        # Human, non-speech
+        "Crying baby", "Sneezing", "Clapping", "Breathing", "Coughing",
+        "Footsteps", "Laughing", "Brushing teeth", "Snoring",
+        "Drinking, sipping",
+        # Interior/domestic
+        "Door knock", "Mouse click", "Keyboard typing", "Door, wood creaks",
+        "Can opening", "Washing machine", "Vacuum cleaner", "Clock alarm",
+        "Clock tick", "Glass breaking",
+        # Exterior/urban
+        "Helicopter", "Chainsaw", "Siren", "Car horn", "Engine", "Train",
+        "Church bells", "Airplane", "Fireworks", "Hand saw",
+    ]
+    meta = os.path.join("ESC-50-master", "meta", "esc50.csv")
+    meta_info = collections.namedtuple(
+        "META_INFO",
+        ("filename", "fold", "target", "category", "esc10", "src_file",
+         "take"))
+    audio_path = os.path.join("ESC-50-master", "audio")
+
+    def __init__(self, mode: str = "train", split: int = 1,
+                 feat_type: str = "raw", archive=None, **kwargs):
+        if split not in range(1, 6):
+            raise ValueError(f"split must satisfy 1 <= split <= 5, got "
+                             f"{split}")
+        if archive is not None:
+            self.archive = archive
+        files, labels = self._get_data(mode, split)
+        super().__init__(files=files, labels=labels, feat_type=feat_type,
+                         **kwargs)
+
+    def _get_meta_info(self):
+        with open(os.path.join(_dl.DATA_HOME, self.meta)) as rf:
+            return [self.meta_info(*ln.strip().split(","))
+                    for ln in rf.readlines()[1:]]
+
+    def _get_data(self, mode: str, split: int) -> Tuple[List[str], List[int]]:
+        root = os.path.join(_dl.DATA_HOME, self.audio_path)
+        meta = os.path.join(_dl.DATA_HOME, self.meta)
+        if not os.path.isdir(root) or not os.path.isfile(meta):
+            _dl.get_path_from_url(self.archive["url"], _dl.DATA_HOME,
+                                  self.archive["md5"], decompress=True)
+        files, labels = [], []
+        for rec in self._get_meta_info():
+            keep = (int(rec.fold) != split) if mode == "train" \
+                else (int(rec.fold) == split)
+            if keep:
+                files.append(os.path.join(root, rec.filename))
+                labels.append(int(rec.target))
+        return files, labels

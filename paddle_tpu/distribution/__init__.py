@@ -549,54 +549,10 @@ class TransformedDistribution(Distribution):
         return _t(lp + _arr(self.base.log_prob(_t(y))))
 
 
-class Transform:
-    """Minimal invertible-transform interface (ref:python/paddle/
-    distribution/transform.py)."""
-
-    def forward(self, x):
-        raise NotImplementedError
-
-    def inverse(self, y):
-        raise NotImplementedError
-
-    def forward_log_det_jacobian(self, x):
-        raise NotImplementedError
-
-
-class AffineTransform(Transform):
-    def __init__(self, loc, scale):
-        self.loc = _arr(loc)
-        self.scale = _arr(scale)
-
-    def forward(self, x):
-        return _t(self.loc + self.scale * _arr(x))
-
-    def inverse(self, y):
-        return _t((_arr(y) - self.loc) / self.scale)
-
-    def forward_log_det_jacobian(self, x):
-        return _t(jnp.broadcast_to(jnp.log(jnp.abs(self.scale)), _arr(x).shape))
-
-
-class ExpTransform(Transform):
-    def forward(self, x):
-        return _t(jnp.exp(_arr(x)))
-
-    def inverse(self, y):
-        return _t(jnp.log(_arr(y)))
-
-    def forward_log_det_jacobian(self, x):
-        return _t(_arr(x))
-
-
-class SigmoidTransform(Transform):
-    def forward(self, x):
-        return _t(jax.nn.sigmoid(_arr(x)))
-
-    def inverse(self, y):
-        ya = _arr(y)
-        return _t(jnp.log(ya) - jnp.log1p(-ya))
-
-    def forward_log_det_jacobian(self, x):
-        xa = _arr(x)
-        return _t(-jax.nn.softplus(-xa) - jax.nn.softplus(xa))
+from .transform import (Transform, AbsTransform, AffineTransform,  # noqa: E402
+                        ChainTransform, ExpTransform,
+                        IndependentTransform, PowerTransform,
+                        ReshapeTransform, SigmoidTransform,
+                        SoftmaxTransform, StackTransform,
+                        StickBreakingTransform, TanhTransform)
+from . import transform  # noqa: E402,F401

@@ -1,0 +1,358 @@
+"""Invertible transforms (ref:python/paddle/distribution/transform.py).
+
+The reference's Transform zoo for building TransformedDistributions.
+Everything is elementwise jnp math, so a TransformedDistribution's
+sample/log_prob stays a single fused XLA computation.
+
+Log-det conventions follow the reference: elementwise (per-event-element)
+for scalar bijections; ``IndependentTransform`` sums the trailing
+``reinterpreted_batch_ndims`` dims; vector bijections
+(``StickBreakingTransform``) return one value per event.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["Transform", "AbsTransform", "AffineTransform", "ChainTransform",
+           "ExpTransform", "IndependentTransform", "PowerTransform",
+           "ReshapeTransform", "SigmoidTransform", "SoftmaxTransform",
+           "StackTransform", "StickBreakingTransform", "TanhTransform"]
+
+
+def _arr(x):
+    if isinstance(x, Tensor):
+        return x._data
+    return jnp.asarray(x, jnp.float32)
+
+
+def _t(x):
+    return Tensor(x)
+
+
+class Transform:
+    """Base invertible transform: forward/inverse plus log-det-Jacobians."""
+
+    _is_injective = True
+
+    def forward(self, x):
+        raise NotImplementedError
+
+    def inverse(self, y):
+        raise NotImplementedError
+
+    def forward_log_det_jacobian(self, x):
+        raise NotImplementedError
+
+    def inverse_log_det_jacobian(self, y):
+        # generic fallback: -fldj at the preimage
+        x = self.inverse(y)
+        return _t(-_arr(self.forward_log_det_jacobian(x)))
+
+    def forward_shape(self, shape):
+        return tuple(shape)
+
+    def inverse_shape(self, shape):
+        return tuple(shape)
+
+    def __call__(self, x):
+        return self.forward(x)
+
+
+class AbsTransform(Transform):
+    """y = |x| — not injective; inverse picks the non-negative branch."""
+
+    _is_injective = False
+
+    def forward(self, x):
+        return _t(jnp.abs(_arr(x)))
+
+    def inverse(self, y):
+        return _t(_arr(y))
+
+    def forward_log_det_jacobian(self, x):
+        return _t(jnp.zeros_like(_arr(x)))
+
+
+class AffineTransform(Transform):
+    """y = loc + scale * x."""
+
+    def __init__(self, loc, scale):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+
+    def forward(self, x):
+        return _t(self.loc + self.scale * _arr(x))
+
+    def inverse(self, y):
+        return _t((_arr(y) - self.loc) / self.scale)
+
+    def forward_log_det_jacobian(self, x):
+        return _t(jnp.broadcast_to(jnp.log(jnp.abs(self.scale)),
+                                   _arr(x).shape))
+
+
+class ChainTransform(Transform):
+    """Composition t_n ∘ ... ∘ t_1 (first transform applied first)."""
+
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def forward(self, x):
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t.inverse(y)
+        return y
+
+    def forward_log_det_jacobian(self, x):
+        total = jnp.zeros(())
+        for t in self.transforms:
+            total = total + _arr(t.forward_log_det_jacobian(x))
+            x = t.forward(x)
+        return _t(total)
+
+    def forward_shape(self, shape):
+        for t in self.transforms:
+            shape = t.forward_shape(shape)
+        return tuple(shape)
+
+    def inverse_shape(self, shape):
+        for t in reversed(self.transforms):
+            shape = t.inverse_shape(shape)
+        return tuple(shape)
+
+
+class ExpTransform(Transform):
+    """y = exp(x)."""
+
+    def forward(self, x):
+        return _t(jnp.exp(_arr(x)))
+
+    def inverse(self, y):
+        return _t(jnp.log(_arr(y)))
+
+    def forward_log_det_jacobian(self, x):
+        return _t(_arr(x))
+
+
+class IndependentTransform(Transform):
+    """Reinterpret the trailing ``reinterpreted_batch_ndims`` dims of a base
+    transform as event dims: log-dets sum over them."""
+
+    def __init__(self, base, reinterpreted_batch_ndims):
+        if reinterpreted_batch_ndims < 0:
+            raise ValueError("reinterpreted_batch_ndims must be >= 0")
+        self.base = base
+        self.reinterpreted_batch_ndims = int(reinterpreted_batch_ndims)
+
+    def forward(self, x):
+        return self.base.forward(x)
+
+    def inverse(self, y):
+        return self.base.inverse(y)
+
+    def _sum_rightmost(self, a):
+        n = self.reinterpreted_batch_ndims
+        return a.sum(axis=tuple(range(a.ndim - n, a.ndim))) if n else a
+
+    def forward_log_det_jacobian(self, x):
+        return _t(self._sum_rightmost(
+            _arr(self.base.forward_log_det_jacobian(x))))
+
+    def inverse_log_det_jacobian(self, y):
+        return _t(self._sum_rightmost(
+            _arr(self.base.inverse_log_det_jacobian(y))))
+
+    def forward_shape(self, shape):
+        return self.base.forward_shape(shape)
+
+    def inverse_shape(self, shape):
+        return self.base.inverse_shape(shape)
+
+
+class PowerTransform(Transform):
+    """y = x ** power on the positive half-line."""
+
+    def __init__(self, power):
+        self.power = _arr(power)
+
+    def forward(self, x):
+        return _t(jnp.power(_arr(x), self.power))
+
+    def inverse(self, y):
+        return _t(jnp.power(_arr(y), 1.0 / self.power))
+
+    def forward_log_det_jacobian(self, x):
+        xa = _arr(x)
+        return _t(jnp.log(jnp.abs(self.power * jnp.power(xa, self.power - 1))))
+
+
+class ReshapeTransform(Transform):
+    """Reshape the event block; volume-preserving (log-det 0)."""
+
+    def __init__(self, in_event_shape, out_event_shape):
+        self.in_event_shape = tuple(in_event_shape)
+        self.out_event_shape = tuple(out_event_shape)
+        in_n = 1
+        for s in self.in_event_shape:
+            in_n *= s
+        out_n = 1
+        for s in self.out_event_shape:
+            out_n *= s
+        if in_n != out_n:
+            raise ValueError(
+                f"in_event_shape {self.in_event_shape} and out_event_shape "
+                f"{self.out_event_shape} have different sizes")
+
+    def _split(self, shape, event):
+        k = len(shape) - len(event)
+        if k < 0 or tuple(shape[k:]) != event:
+            raise ValueError(f"shape {shape} does not end with {event}")
+        return tuple(shape[:k])
+
+    def forward(self, x):
+        xa = _arr(x)
+        batch = self._split(xa.shape, self.in_event_shape)
+        return _t(xa.reshape(batch + self.out_event_shape))
+
+    def inverse(self, y):
+        ya = _arr(y)
+        batch = self._split(ya.shape, self.out_event_shape)
+        return _t(ya.reshape(batch + self.in_event_shape))
+
+    def forward_log_det_jacobian(self, x):
+        xa = _arr(x)
+        batch = self._split(xa.shape, self.in_event_shape)
+        return _t(jnp.zeros(batch, xa.dtype))
+
+    def forward_shape(self, shape):
+        return self._split(shape, self.in_event_shape) + self.out_event_shape
+
+    def inverse_shape(self, shape):
+        return self._split(shape, self.out_event_shape) + self.in_event_shape
+
+
+class SigmoidTransform(Transform):
+    """y = sigmoid(x)."""
+
+    def forward(self, x):
+        return _t(jax.nn.sigmoid(_arr(x)))
+
+    def inverse(self, y):
+        ya = _arr(y)
+        return _t(jnp.log(ya) - jnp.log1p(-ya))
+
+    def forward_log_det_jacobian(self, x):
+        xa = _arr(x)
+        return _t(-jax.nn.softplus(-xa) - jax.nn.softplus(xa))
+
+
+class SoftmaxTransform(Transform):
+    """y = softmax(x) over the last axis — many-to-one (shift invariant), so
+    not injective and no log-det; inverse returns the log representative."""
+
+    _is_injective = False
+
+    def forward(self, x):
+        return _t(jax.nn.softmax(_arr(x), axis=-1))
+
+    def inverse(self, y):
+        return _t(jnp.log(_arr(y)))
+
+    def forward_log_det_jacobian(self, x):
+        raise NotImplementedError(
+            "SoftmaxTransform is not injective; no log-det-Jacobian")
+
+
+class StackTransform(Transform):
+    """Apply transforms[i] to slice i of the given axis."""
+
+    def __init__(self, transforms, axis=0):
+        self.transforms = list(transforms)
+        self.axis = int(axis)
+
+    def _map(self, method, v):
+        va = _arr(v)
+        if va.shape[self.axis] != len(self.transforms):
+            raise ValueError(
+                f"axis {self.axis} has length {va.shape[self.axis]}, "
+                f"expected {len(self.transforms)}")
+        parts = [
+            _arr(getattr(t, method)(_t(jnp.take(va, i, axis=self.axis))))
+            for i, t in enumerate(self.transforms)
+        ]
+        return _t(jnp.stack(parts, axis=self.axis))
+
+    def forward(self, x):
+        return self._map("forward", x)
+
+    def inverse(self, y):
+        return self._map("inverse", y)
+
+    def forward_log_det_jacobian(self, x):
+        return self._map("forward_log_det_jacobian", x)
+
+
+class StickBreakingTransform(Transform):
+    """R^K -> open (K+1)-simplex by iterative stick breaking
+    (ref:python/paddle/distribution/transform.py StickBreakingTransform).
+    The log-det is one value per event (vector bijection)."""
+
+    def forward(self, x):
+        xa = _arr(x)
+        k = xa.shape[-1]
+        offset = jnp.log(jnp.arange(k, 0, -1, dtype=xa.dtype))
+        z = jax.nn.sigmoid(xa - offset)
+        rest = jnp.cumprod(1 - z, axis=-1)
+        pad = jnp.ones_like(xa[..., :1])
+        return _t(jnp.concatenate([z, pad], -1)
+                  * jnp.concatenate([pad, rest], -1))
+
+    def inverse(self, y):
+        ya = _arr(y)
+        k = ya.shape[-1] - 1
+        y_crop = ya[..., :-1]
+        # remaining stick before each break: 1 - cumulative mass so far
+        rest = 1.0 - jnp.cumsum(y_crop, axis=-1) + y_crop
+        z = y_crop / rest
+        offset = jnp.log(jnp.arange(k, 0, -1, dtype=ya.dtype))
+        return _t(jnp.log(z) - jnp.log1p(-z) + offset)
+
+    def forward_log_det_jacobian(self, x):
+        xa = _arr(x)
+        k = xa.shape[-1]
+        offset = jnp.log(jnp.arange(k, 0, -1, dtype=xa.dtype))
+        u = xa - offset
+        z = jax.nn.sigmoid(u)
+        rest = jnp.concatenate(
+            [jnp.ones_like(xa[..., :1]), jnp.cumprod(1 - z, -1)[..., :-1]], -1)
+        # triangular Jacobian: prod of diag dy_k/dx_k = rest_k * z_k * (1-z_k)
+        return _t((jnp.log(rest) - jax.nn.softplus(u)
+                   - jax.nn.softplus(-u)).sum(-1))
+
+    def forward_shape(self, shape):
+        return tuple(shape[:-1]) + (shape[-1] + 1,)
+
+    def inverse_shape(self, shape):
+        return tuple(shape[:-1]) + (shape[-1] - 1,)
+
+
+class TanhTransform(Transform):
+    """y = tanh(x)."""
+
+    def forward(self, x):
+        return _t(jnp.tanh(_arr(x)))
+
+    def inverse(self, y):
+        return _t(jnp.arctanh(_arr(y)))
+
+    def forward_log_det_jacobian(self, x):
+        xa = _arr(x)
+        # log(1 - tanh(x)^2) = 2 (log 2 - x - softplus(-2x)), stable form
+        return _t(2.0 * (jnp.log(2.0) - xa - jax.nn.softplus(-2.0 * xa)))

@@ -41,6 +41,13 @@ def empty(shape, dtype=None, name=None):
     return zeros(shape, dtype)
 
 
+def create_tensor(dtype, name=None, persistable=False):
+    """Placeholder tensor of the given dtype, filled later with set_value
+    (ref:python/paddle/tensor/creation.py:231 create_tensor)."""
+    dt = convert_dtype_arg(dtype) or get_default_dtype()
+    return Tensor(jnp.zeros((0,), dt))
+
+
 def zeros_like(x, dtype=None, name=None):
     def _zeros_like(x, *, dtype):
         return jnp.zeros_like(x, dtype=dtype)

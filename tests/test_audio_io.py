@@ -1,0 +1,144 @@
+"""paddle.audio backends + datasets (ref:python/paddle/audio/backends/
+wave_backend.py, datasets/tess.py, datasets/esc50.py)."""
+import os
+import wave
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import audio
+from paddle_tpu.utils import download as _dl
+
+
+def _tone(sr=8000, dur=0.05, hz=440.0, channels=1):
+    t = np.arange(int(sr * dur)) / sr
+    x = 0.4 * np.sin(2 * np.pi * hz * t).astype(np.float32)
+    return np.tile(x, (channels, 1))
+
+
+def test_wave_save_load_roundtrip(tmp_path):
+    sr = 8000
+    x = _tone(sr, channels=2)
+    p = str(tmp_path / "t.wav")
+    audio.save(p, paddle.to_tensor(x), sr)
+    y, sr2 = audio.load(p)
+    assert sr2 == sr and y.shape == list(x.shape)
+    assert np.allclose(y.numpy(), x, atol=2.0 / 32768)
+    # info
+    i = audio.info(p)
+    assert (i.sample_rate, i.num_channels, i.bits_per_sample) == (sr, 2, 16)
+    assert i.num_samples == x.shape[1]
+
+
+def test_wave_load_window_and_raw(tmp_path):
+    sr = 8000
+    x = _tone(sr)
+    p = str(tmp_path / "t.wav")
+    audio.save(p, paddle.to_tensor(x), sr)
+    y, _ = audio.load(p, frame_offset=100, num_frames=50)
+    assert y.shape == [1, 50]
+    full, _ = audio.load(p)
+    assert np.allclose(y.numpy(), full.numpy()[:, 100:150])
+    raw, _ = audio.load(p, normalize=False)
+    assert raw.numpy().dtype == np.int16
+    # channels_last
+    cl, _ = audio.load(p, channels_first=False)
+    assert cl.shape == [x.shape[1], 1]
+
+
+def test_wave_save_validates():
+    with pytest.raises(ValueError):
+        audio.save("/tmp/x.wav", paddle.to_tensor(np.zeros(8, np.float32)), 8000)
+    with pytest.raises(ValueError):
+        audio.save("/tmp/x.wav", paddle.to_tensor(np.zeros((1, 8), np.float32)),
+                   8000, bits_per_sample=24)
+
+
+def test_backend_registry():
+    assert "wave_backend" in audio.backends.list_available_backends()
+    assert audio.backends.get_current_backend() == "wave_backend"
+    with pytest.raises(NotImplementedError):
+        audio.backends.set_backend("nonexistent")
+
+
+def _fake_tess(root, n=10, sr=4000):
+    d = os.path.join(root, audio.datasets.TESS.audio_path)
+    os.makedirs(d, exist_ok=True)
+    labels = audio.datasets.TESS.label_list
+    for i in range(n):
+        emo = labels[i % len(labels)]
+        path = os.path.join(d, f"OAF_word{i}_{emo}.wav")
+        x = _tone(sr, dur=0.03, hz=200 + 50 * i)
+        audio.save(path, paddle.to_tensor(x), sr)
+
+
+def test_tess_dataset(tmp_path, monkeypatch):
+    monkeypatch.setattr(_dl, "DATA_HOME", str(tmp_path))
+    _fake_tess(str(tmp_path), n=10)
+    train = audio.datasets.TESS(mode="train", n_folds=5, split=1)
+    dev = audio.datasets.TESS(mode="dev", n_folds=5, split=1)
+    assert len(train) + len(dev) == 10 and len(dev) == 2
+    w, label = train[0]
+    assert w.ndim == 1 and 0 <= label < 7
+    # feature extraction path
+    mf = audio.datasets.TESS(mode="dev", feat_type="mfcc", n_mfcc=13,
+                             n_fft=64, n_mels=20)
+    feat, _ = mf[0]
+    assert feat.shape[0] == 13
+    with pytest.raises(RuntimeError):
+        audio.datasets.TESS(mode="dev", feat_type="nope")
+    with pytest.raises(ValueError):
+        audio.datasets.TESS(split=9)
+
+
+def _fake_esc50(root, n=10, sr=4000):
+    d = os.path.join(root, audio.datasets.ESC50.audio_path)
+    os.makedirs(d, exist_ok=True)
+    meta = os.path.join(root, audio.datasets.ESC50.meta)
+    os.makedirs(os.path.dirname(meta), exist_ok=True)
+    with open(meta, "w") as f:
+        f.write("filename,fold,target,category,esc10,src_file,take\n")
+        for i in range(n):
+            fn = f"clip{i}.wav"
+            audio.save(os.path.join(d, fn),
+                       paddle.to_tensor(_tone(sr, dur=0.02)), sr)
+            f.write(f"{fn},{i % 5 + 1},{i % 50},cat,False,{i},A\n")
+
+
+def test_esc50_dataset(tmp_path, monkeypatch):
+    monkeypatch.setattr(_dl, "DATA_HOME", str(tmp_path))
+    _fake_esc50(str(tmp_path), n=10)
+    train = audio.datasets.ESC50(mode="train", split=1)
+    dev = audio.datasets.ESC50(mode="dev", split=1)
+    assert len(train) == 8 and len(dev) == 2
+    w, label = dev[0]
+    assert w.ndim == 1 and isinstance(label, int)
+    spec, _ = audio.datasets.ESC50(mode="dev", feat_type="spectrogram",
+                                   n_fft=64)[0]
+    assert spec.shape[0] == 33  # n_fft//2 + 1
+
+
+def test_wave_load_wide_and_narrow_pcm(tmp_path):
+    # 32-bit PCM: normalize scales by 2^31; raw path keeps top 16 bits
+    p = str(tmp_path / "w32.wav")
+    x32 = np.array([100000, 2**30, -(2**30)], np.int32)
+    with wave.open(p, "wb") as wf:
+        wf.setnchannels(1); wf.setsampwidth(4); wf.setframerate(8000)
+        wf.writeframes(x32.astype("<i4").tobytes())
+    raw, _ = audio.load(p, normalize=False)
+    assert np.array_equal(raw.numpy()[0], (x32 >> 16).astype(np.int16))
+    norm, _ = audio.load(p)
+    assert np.allclose(norm.numpy()[0], x32 / 2**31, atol=1e-6)
+
+    # 8-bit offset-binary: normalize centers at 0; raw converts to PCM16
+    p8 = str(tmp_path / "w8.wav")
+    x8 = np.array([0, 128, 255], np.uint8)
+    with wave.open(p8, "wb") as wf:
+        wf.setnchannels(1); wf.setsampwidth(1); wf.setframerate(8000)
+        wf.writeframes(x8.tobytes())
+    raw8, _ = audio.load(p8, normalize=False)
+    assert np.array_equal(
+        raw8.numpy()[0], ((x8.astype(np.int16) - 128) << 8).astype(np.int16))
+    norm8, _ = audio.load(p8)
+    assert np.allclose(norm8.numpy()[0], (x8.astype(np.float32) - 128) / 128)

@@ -97,3 +97,83 @@ def test_sampling_moments():
     g = D.Gumbel(loc=T([0.0]), scale=T([1.0]))
     sg = g.sample([4000]).numpy()
     assert abs(sg.mean() - 0.5772) < 0.1  # Euler-Mascheroni
+
+
+def test_transform_zoo_numeric_jacobians():
+    """Every injective transform: inverse(forward(x)) == x and the analytic
+    log-det matches jax.jacfwd's (ref:python/paddle/distribution/
+    transform.py)."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.distribution import transform as T
+    from paddle_tpu.core.tensor import Tensor
+
+    x = np.array([0.3, -0.7, 1.2], np.float32)
+
+    # scalar bijections: elementwise fldj == log |f'(x)|
+    cases = [
+        (T.AffineTransform(1.0, 2.5), x),
+        (T.ExpTransform(), x),
+        (T.SigmoidTransform(), x),
+        (T.TanhTransform(), x * 0.5),
+        (T.PowerTransform(2.0), np.abs(x)),
+        (T.ChainTransform([T.AffineTransform(0.0, 2.0), T.ExpTransform()]), x),
+    ]
+    for tr, xv in cases:
+        xt = Tensor(jnp.asarray(xv))
+        y = tr.forward(xt)
+        back = tr.inverse(y).numpy()
+        assert np.allclose(back, xv, atol=1e-5), type(tr).__name__
+        fldj = tr.forward_log_det_jacobian(xt).numpy()
+
+        def scalar_fwd(v, tr=tr):
+            return tr.forward(Tensor(v))._data
+
+        jac = jax.vmap(jax.grad(lambda v: scalar_fwd(v).reshape(())))(
+            jnp.asarray(xv).reshape(-1, 1)[:, 0])
+        assert np.allclose(fldj, np.log(np.abs(np.asarray(jac))),
+                           atol=1e-4), type(tr).__name__
+        # ildj == -fldj at preimage
+        ildj = tr.inverse_log_det_jacobian(y).numpy()
+        assert np.allclose(ildj, -fldj, atol=1e-4)
+
+    # stick-breaking: simplex output, roundtrip, and log-det vs full jacobian
+    sb = T.StickBreakingTransform()
+    xt = Tensor(jnp.asarray(x))
+    y = sb.forward(xt)
+    yn = y.numpy()
+    assert yn.shape == (4,) and np.all(yn > 0) and abs(yn.sum() - 1) < 1e-5
+    assert np.allclose(sb.inverse(y).numpy(), x, atol=1e-4)
+    J = jax.jacfwd(lambda v: sb.forward(Tensor(v))._data[:-1])(jnp.asarray(x))
+    _, logdet = np.linalg.slogdet(np.asarray(J))
+    assert np.allclose(sb.forward_log_det_jacobian(xt).numpy(), logdet,
+                       atol=1e-4)
+    assert sb.forward_shape((5, 3)) == (5, 4)
+    assert sb.inverse_shape((5, 4)) == (5, 3)
+
+    # reshape / independent / stack / softmax / abs
+    rs = T.ReshapeTransform((6,), (2, 3))
+    z = np.arange(6, dtype=np.float32)
+    assert rs.forward(Tensor(jnp.asarray(z))).shape == [2, 3]
+    assert rs.inverse(rs.forward(Tensor(jnp.asarray(z)))).shape == [6]
+    assert rs.forward_shape((4, 6)) == (4, 2, 3)
+
+    ind = T.IndependentTransform(T.ExpTransform(), 1)
+    v = np.array([[0.1, 0.2], [0.3, 0.4]], np.float32)
+    fl = ind.forward_log_det_jacobian(Tensor(jnp.asarray(v))).numpy()
+    assert fl.shape == (2,) and np.allclose(fl, v.sum(-1), atol=1e-6)
+
+    st = T.StackTransform([T.ExpTransform(), T.AffineTransform(0.0, 3.0)], 0)
+    sv = np.array([[0.5, 1.0], [2.0, 4.0]], np.float32)
+    out = st.forward(Tensor(jnp.asarray(sv))).numpy()
+    assert np.allclose(out[0], np.exp(sv[0])) and np.allclose(out[1], 3 * sv[1])
+    assert np.allclose(st.inverse(Tensor(jnp.asarray(out))).numpy(), sv,
+                       atol=1e-5)
+
+    sm = T.SoftmaxTransform()
+    p = sm.forward(Tensor(jnp.asarray(x))).numpy()
+    assert abs(p.sum() - 1) < 1e-5 and not sm._is_injective
+
+    ab = T.AbsTransform()
+    assert np.allclose(ab.forward(Tensor(jnp.asarray(x))).numpy(), np.abs(x))

@@ -2,12 +2,20 @@
 
 Compares paddle_tpu's public API against the reference's checked-in public
 surface (tools/ref_surface.json, extracted from the reference's __all__
-lists; see ref:python/paddle/__init__.py, fft.py, signal.py, ...).
+lists and ``tensor_method_func``; see ref:python/paddle/__init__.py,
+ref:python/paddle/tensor/__init__.py).
 
-Usage:  JAX_PLATFORMS=cpu python tools/op_coverage.py [--missing]
+Three buckets per name:
+  implemented — the attribute exists and is NOT an intentional-raise stub
+  redirect    — the attribute exists but is tagged ``_intentional_redirect``
+                (a deliberate raising shim, e.g. legacy Program-graph APIs);
+                excluded from the implemented numerator and listed separately
+  missing     — no such attribute
 
-Prints per-module implemented/total and the grand total; --missing lists
-the names still absent (the work queue for op-surface parity).
+``paddle.Tensor`` names are audited on the Tensor class (methods patched on
+by the op modules, the analog of monkey_patch_varbase).
+
+Usage:  JAX_PLATFORMS=cpu python tools/op_coverage.py [--missing] [--json]
 """
 from __future__ import annotations
 
@@ -29,12 +37,14 @@ MODULE_MAP = {
     "paddle.sparse": "paddle_tpu.sparse",
     "paddle.sparse.nn": "paddle_tpu.sparse.nn",
     "paddle.distribution": "paddle_tpu.distribution",
+    "paddle.distribution.transform": "paddle_tpu.distribution.transform",
     "paddle.optimizer": "paddle_tpu.optimizer",
     "paddle.optimizer.lr": "paddle_tpu.optimizer.lr",
     "paddle.metric": "paddle_tpu.metric",
     "paddle.vision.transforms": "paddle_tpu.vision.transforms",
     "paddle.vision.models": "paddle_tpu.vision.models",
     "paddle.vision.ops": "paddle_tpu.vision.ops",
+    "paddle.vision.datasets": "paddle_tpu.vision.datasets",
     "paddle.geometric": "paddle_tpu.geometric",
     "paddle.utils.cpp_extension": "paddle_tpu.utils.cpp_extension",
     "paddle.distributed": "paddle_tpu.distributed",
@@ -44,37 +54,74 @@ MODULE_MAP = {
     "paddle.jit": "paddle_tpu.jit",
     "paddle.static": "paddle_tpu.static",
     "paddle.incubate": "paddle_tpu.incubate",
+    "paddle.text": "paddle_tpu.text",
+    "paddle.profiler": "paddle_tpu.profiler",
+    "paddle.audio.features": "paddle_tpu.audio",
+    "paddle.audio.functional": "paddle_tpu.audio.functional",
+    "paddle.audio.backends": "paddle_tpu.audio.backends",
+    "paddle.audio.datasets": "paddle_tpu.audio.datasets",
 }
 
 
-def audit(show_missing: bool = False):
+def _target(ref_mod):
+    """Resolve the object whose attributes carry the surface."""
+    if ref_mod == "paddle.Tensor":
+        from paddle_tpu.core.tensor import Tensor
+        return Tensor
+    our = MODULE_MAP.get(ref_mod)
+    if not our:
+        return None
+    try:
+        return importlib.import_module(our)
+    except ImportError:
+        return None
+
+
+def _classify(obj):
+    return "redirect" if getattr(obj, "_intentional_redirect", False) \
+        else "implemented"
+
+
+def audit(show_missing: bool = False, as_json: bool = False):
     surface = json.load(open(os.path.join(HERE, "ref_surface.json")))
-    grand_impl, grand_total = 0, 0
-    all_missing = {}
+    totals = {"implemented": 0, "redirect": 0, "missing": 0}
+    report = {}
     for ref_mod, names in sorted(surface.items()):
-        our_mod = MODULE_MAP.get(ref_mod)
-        have = set()
-        if our_mod:
-            try:
-                m = importlib.import_module(our_mod)
-                have = {n for n in names if hasattr(m, n)}
-            except ImportError:
-                pass
-        missing = sorted(set(names) - have)
-        grand_impl += len(have)
-        grand_total += len(names)
-        print(f"{ref_mod:28s} {len(have):4d}/{len(names):4d}")
-        if missing:
-            all_missing[ref_mod] = missing
-    pct = 100.0 * grand_impl / max(1, grand_total)
-    print(f"{'TOTAL':28s} {grand_impl:4d}/{grand_total:4d}  ({pct:.1f}%)")
+        tgt = _target(ref_mod)
+        buckets = {"implemented": [], "redirect": [], "missing": []}
+        for n in names:
+            if tgt is not None and hasattr(tgt, n):
+                buckets[_classify(getattr(tgt, n))].append(n)
+            else:
+                buckets["missing"].append(n)
+        for k in totals:
+            totals[k] += len(buckets[k])
+        report[ref_mod] = buckets
+        r = f" +{len(buckets['redirect'])}R" if buckets["redirect"] else ""
+        print(f"{ref_mod:32s} {len(buckets['implemented']):4d}/"
+              f"{len(names):4d}{r}")
+    total = sum(totals.values())
+    pct = 100.0 * totals["implemented"] / max(1, total)
+    print(f"{'TOTAL':32s} {totals['implemented']:4d}/{total:4d}  ({pct:.1f}%)"
+          f"  [redirect {totals['redirect']}, missing {totals['missing']}]")
     if show_missing:
-        for mod, names in all_missing.items():
-            print(f"\n[{mod}] missing {len(names)}:")
-            for n in names:
-                print(f"  {n}")
-    return grand_impl, grand_total
+        for mod, b in report.items():
+            if b["missing"]:
+                print(f"\n[{mod}] missing {len(b['missing'])}:")
+                for n in b["missing"]:
+                    print(f"  {n}")
+            if b["redirect"]:
+                print(f"[{mod}] redirect {len(b['redirect'])}: "
+                      f"{', '.join(b['redirect'])}")
+    if as_json:
+        out = {m: {"missing": b["missing"], "redirect": b["redirect"]}
+               for m, b in report.items()}
+        json.dump({"totals": totals, "modules": out},
+                  open(os.path.join(HERE, "coverage_report.json"), "w"),
+                  indent=1)
+    return totals
 
 
 if __name__ == "__main__":
-    audit(show_missing="--missing" in sys.argv)
+    audit(show_missing="--missing" in sys.argv,
+          as_json="--json" in sys.argv)
