@@ -1,0 +1,16 @@
+"""Shared bench-record emitter: one JSON line to stdout + append to
+benches/BASELINE_RESULTS.jsonl with a timestamp (the accumulating-baselines
+protocol in BASELINE.md)."""
+import json
+import os
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def emit(rec, path=None):
+    rec["ts"] = time.time()
+    line = json.dumps(rec)
+    print(line, flush=True)
+    with open(path or os.path.join(HERE, "BASELINE_RESULTS.jsonl"), "a") as f:
+        f.write(line + "\n")

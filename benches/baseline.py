@@ -27,6 +27,7 @@ import numpy as np
 
 HERE = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, os.path.dirname(HERE))
+sys.path.insert(0, HERE)
 
 
 def _timed(step, args, warmup=2, iters=8):
@@ -48,11 +49,9 @@ def _timed(step, args, warmup=2, iters=8):
 
 
 def _emit(rec):
-    rec["ts"] = time.time()
-    line = json.dumps(rec)
-    print(line, flush=True)
-    with open(os.path.join(HERE, "BASELINE_RESULTS.jsonl"), "a") as f:
-        f.write(line + "\n")
+    from _common import emit
+
+    emit(rec)
 
 
 def _platform():

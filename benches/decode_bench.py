@@ -51,14 +51,39 @@ def main():
     jax.block_until_ready(out._data)
     dt = time.perf_counter() - t0
 
+    # prefill share: a 1-new-token generate is prefill + one decode step.
+    # Measured after the main loop (own warmup) so its compilation doesn't
+    # perturb the headline timing.
+    p1 = model.generate(ids, max_new_tokens=1)
+    jax.block_until_ready(p1._data)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        p1 = model.generate(ids, max_new_tokens=1)
+    jax.block_until_ready(p1._data)
+    dt_prefill = time.perf_counter() - t0
+
     toks = batch * new * iters
-    print(json.dumps({
+    decode_dt = dt - dt_prefill  # time spent in steps 2..new
+    # on tiny CPU smokes the two loops' noise can swamp the split; only
+    # report a decode-only rate when the subtraction is meaningful
+    decode_only = (round(batch * (new - 1) * iters / decode_dt, 1)
+                   if decode_dt > 0.05 * dt else None)
+    rec = {
         "metric": f"decode tokens/sec (GPT {cfg.hidden_size}h/"
                   f"{cfg.num_layers}L b{batch} p{prompt}+{new} {platform})",
         "value": round(toks / dt, 1),
         "unit": "tokens/sec",
         "ms_per_token": round(dt / toks * 1e3, 3),
-    }))
+        "platform": platform,
+        "prefill_ms": round(dt_prefill / iters * 1e3, 3),
+        "decode_only_tokens_per_sec": decode_only,
+        "prefill_tokens_per_sec": round(
+            batch * prompt * iters / dt_prefill, 1),
+    }
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from _common import emit
+
+    emit({"bench": "decode", **rec})
 
 
 if __name__ == "__main__":

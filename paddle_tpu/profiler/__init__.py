@@ -18,10 +18,15 @@ from __future__ import annotations
 import enum
 import json
 import os
-from collections import defaultdict
 from typing import Iterable, Optional
 
 from ..native import load as _load_native
+from .statistic import SortedKeys, StatisticData, SummaryView, build_views
+
+__all__ = ["ProfilerTarget", "ProfilerState", "SortedKeys", "SummaryView",
+           "Profiler", "RecordEvent", "record_instant", "make_scheduler",
+           "export_chrome_tracing", "export_protobuf",
+           "load_profiler_result"]
 
 
 class ProfilerTarget(enum.Enum):
@@ -29,6 +34,14 @@ class ProfilerTarget(enum.Enum):
     GPU = 1  # accepted for API parity; maps to device tracing
     TPU = 2
     CUSTOM_DEVICE = 3
+
+
+class ProfilerState(enum.Enum):
+    """Scheduler states (ref:python/paddle/profiler/profiler.py:79)."""
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3  # the last step of RECORD
 
 
 class RecordEvent:
@@ -83,17 +96,24 @@ class Profiler:
                  profile_memory: bool = False, with_flops: bool = False):
         self.targets = set(targets or [ProfilerTarget.CPU])
         self.on_trace_ready = on_trace_ready
+        self.profile_memory = profile_memory
+        self._scheduler = scheduler
         self._lib = _load_native()
         self._device_dir: Optional[str] = None
         self._running = False
         self._step = 0
+        self._memory_steps = []
 
     # -------------------------------------------------------------- control
     def start(self):
         from ..core import trace_hook
 
         self._lib.pt_trace_clear()
-        self._lib.pt_trace_enable(1)
+        # iteration i (0-based) is gated by scheduler(i): the first
+        # iteration must respect CLOSED/skip_first windows too
+        self._gate_on = (self._scheduler is None
+                         or self._sched_on(self._scheduler(self._step)))
+        self._lib.pt_trace_enable(1 if self._gate_on else 0)
         trace_hook.enable()  # eager op dispatch emits RecordEvents
         if ProfilerTarget.TPU in self.targets or ProfilerTarget.GPU in self.targets:
             import tempfile
@@ -125,9 +145,29 @@ class Profiler:
         if self.on_trace_ready is not None:
             self.on_trace_ready(self)
 
+    @staticmethod
+    def _sched_on(state) -> bool:
+        return state in (ProfilerState.RECORD,
+                         ProfilerState.RECORD_AND_RETURN, "RECORD")
+
     def step(self):
         self._step += 1
+        if self.profile_memory:
+            self._memory_steps.append(
+                {"step": self._step, **_memory_snapshot_mb()})
+        # the boundary marker must survive gated-off windows or the step-gap
+        # analysis would span whole CLOSED windows as one "step"
+        if not getattr(self, "_gate_on", True):
+            self._lib.pt_trace_enable(1)
         record_instant(f"profiler_step#{self._step}")
+        if self._scheduler is not None and self._running:
+            # honor the scheduler's state machine: the host recorder is
+            # gated per iteration (ref profiler.py RECORD/READY windows);
+            # after N step() calls the next iteration's index is N
+            self._gate_on = self._sched_on(self._scheduler(self._step))
+        else:
+            self._gate_on = True
+        self._lib.pt_trace_enable(1 if self._gate_on else 0)
 
     def __enter__(self):
         self.start()
@@ -140,16 +180,10 @@ class Profiler:
     # -------------------------------------------------------------- export
     def export_chrome_tracing(self, dir_name: str, worker_name: Optional[str] = None):
         os.makedirs(dir_name, exist_ok=True)
-        pid = os.getpid()
-        size = self._lib.pt_trace_dump(None, 0, pid)
-        import ctypes
-
-        buf = ctypes.create_string_buffer(int(size))
-        self._lib.pt_trace_dump(buf, size, pid)
-        name = worker_name or f"host_{pid}"
+        name = worker_name or f"host_{os.getpid()}"
         path = os.path.join(dir_name, f"{name}.json")
         with open(path, "wb") as f:
-            f.write(buf.raw[:int(size)])
+            f.write(self._dump_raw())
         if self._device_dir:
             import shutil
 
@@ -160,42 +194,65 @@ class Profiler:
 
     export = export_chrome_tracing
 
-    # ------------------------------------------------------------- summary
-    def summary(self, sorted_by: str = "total", op_detail: bool = True,
-                thread_sep: bool = False, time_unit: str = "ms"):
-        """Aggregate host events into an operator table (SummaryView role,
-        ref:python/paddle/profiler/profiler_statistic.py)."""
+    def export_protobuf(self, dir_name: str,
+                        worker_name: Optional[str] = None):
+        """Serialized trace for later load_profiler_result
+        (ref:python/paddle/profiler/profiler.py:267 export_protobuf — same
+        role; the wire format here is length-prefixed records, not the
+        reference's schema)."""
+        os.makedirs(dir_name, exist_ok=True)
+        name = worker_name or f"host_{os.getpid()}"
+        path = os.path.join(dir_name, f"{name}.pt_trace")
+        _write_trace_file(path, self._events(), self._memory_steps)
+        return path
+
+    def _dump_raw(self) -> bytes:
+        """The native recorder's two-call size-probe/fill protocol."""
         import ctypes
 
-        size = self._lib.pt_trace_dump(None, 0, os.getpid())
+        pid = os.getpid()
+        size = self._lib.pt_trace_dump(None, 0, pid)
         buf = ctypes.create_string_buffer(int(size))
-        self._lib.pt_trace_dump(buf, size, os.getpid())
-        events = json.loads(buf.raw[:int(size)].decode())["traceEvents"]
-        agg = defaultdict(lambda: [0, 0.0, 0.0])  # count, total_us, max_us
-        for e in events:
-            a = agg[e["name"]]
-            a[0] += 1
-            a[1] += e.get("dur", 0.0)
-            a[2] = max(a[2], e.get("dur", 0.0))
-        rows = sorted(agg.items(), key=lambda kv: -kv[1][1])
-        div = {"ms": 1000.0, "us": 1.0, "s": 1e6}[time_unit]
-        lines = [f"{'Name':<40}{'Calls':>8}{'Total(' + time_unit + ')':>14}"
-                 f"{'Avg(' + time_unit + ')':>12}{'Max(' + time_unit + ')':>12}"]
-        for name, (cnt, tot, mx) in rows[:60]:
-            lines.append(f"{name[:39]:<40}{cnt:>8}{tot / div:>14.3f}"
-                         f"{tot / cnt / div:>12.3f}{mx / div:>12.3f}")
-        table = "\n".join(lines)
+        self._lib.pt_trace_dump(buf, size, pid)
+        return buf.raw[:int(size)]
+
+    def _events(self):
+        return json.loads(self._dump_raw().decode())["traceEvents"]
+
+    # ------------------------------------------------------------- summary
+    def summary(self, sorted_by=SortedKeys.CPUTotal, op_detail: bool = True,
+                thread_sep: bool = False, time_unit: str = "ms",
+                views=None):
+        """Print the SummaryView tables: Overview, Model, Distributed,
+        Operator, Memory + a step-gap scheduling line
+        (ref:python/paddle/profiler/profiler_statistic.py:46)."""
+        stat = StatisticData(self._events(), self._memory_steps)
+        table = build_views(stat, views, sorted_by, time_unit,
+                            op_limit=60 if op_detail else 10)
         print(table)
         return table
 
 
 def make_scheduler(*, closed: int, ready: int, record: int, repeat: int = 0,
                    skip_first: int = 0):
-    """API-parity scheduler factory (state machine is a no-op here: the
-    native recorder is cheap enough to keep on while the profiler runs)."""
+    """Cyclic CLOSED->READY->RECORD state machine
+    (ref:python/paddle/profiler/profiler.py make_scheduler)."""
+    period = closed + ready + record
 
-    def sched(step: int):
-        return "RECORD"
+    def sched(step: int) -> ProfilerState:
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        s = step - skip_first
+        if repeat > 0 and s >= repeat * period:
+            return ProfilerState.CLOSED
+        pos = s % period
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == period - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
 
     return sched
 
@@ -207,3 +264,72 @@ def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None):
         prof.export_chrome_tracing(dir_name, worker_name)
 
     return handler
+
+
+def export_protobuf(dir_name: str, worker_name: Optional[str] = None):
+    """on_trace_ready helper writing the reloadable binary trace
+    (ref:python/paddle/profiler/profiler.py:267)."""
+
+    def handler(prof: Profiler):
+        prof.export_protobuf(dir_name, worker_name)
+
+    return handler
+
+
+# ------------------------------------------------------- trace (de)serialize
+_TRACE_MAGIC = b"PTTRACE1"
+
+
+def _write_trace_file(path: str, events, memory_steps):
+    """Length-prefixed binary records; role of the reference's
+    serialization_logger (byte format is this stack's own)."""
+    import struct
+
+    with open(path, "wb") as f:
+        f.write(_TRACE_MAGIC)
+        for payload in (events, memory_steps):
+            blob = json.dumps(payload).encode()
+            f.write(struct.pack("<Q", len(blob)))
+            f.write(blob)
+
+
+class ProfilerResult:
+    """Reloaded trace: events + the same summary views as a live Profiler."""
+
+    def __init__(self, events, memory_steps):
+        self.events = events
+        self.memory_steps = memory_steps
+
+    def summary(self, sorted_by=SortedKeys.CPUTotal, time_unit: str = "ms",
+                views=None):
+        table = build_views(StatisticData(self.events, self.memory_steps),
+                            views, sorted_by, time_unit)
+        print(table)
+        return table
+
+
+def load_profiler_result(filename: str) -> ProfilerResult:
+    """Reload an export_protobuf trace
+    (ref:python/paddle/profiler/utils.py:139)."""
+    import struct
+
+    with open(filename, "rb") as f:
+        if f.read(len(_TRACE_MAGIC)) != _TRACE_MAGIC:
+            raise ValueError(f"{filename} is not a paddle_tpu trace file")
+        parts = []
+        for _ in range(2):
+            (n,) = struct.unpack("<Q", f.read(8))
+            parts.append(json.loads(f.read(n).decode()))
+    return ProfilerResult(*parts)
+
+
+def _memory_snapshot_mb():
+    """Live/peak device memory from the runtime introspection the device
+    module exposes (allocator stats role, ref:paddle/fluid/memory/stats.h)."""
+    try:
+        from ..device import memory_allocated, max_memory_allocated
+
+        return {"live_mb": memory_allocated() / 1e6,
+                "peak_mb": max_memory_allocated() / 1e6}
+    except Exception:
+        return {"live_mb": 0.0, "peak_mb": 0.0}

@@ -1,0 +1,256 @@
+"""Summary views over collected trace events
+(ref:python/paddle/profiler/profiler_statistic.py SummaryView tables and
+ref:paddle/fluid/framework/new_executor/executor_statistics.cc scheduling
+analysis).
+
+Events come from the native host ring buffer (chrome-trace dicts with
+``name``, ``ts``, ``dur`` in µs). Categories are inferred from names:
+
+  dataloader — DataLoader worker/collate spans
+  communication — collective verbs (the XLA-collective analog of the
+    reference's NCCL kernels)
+  operator — op dispatch spans emitted by the eager trace hook; under a
+    compiled TrainStep the XLA program span counts as one operator
+  user — RecordEvent scopes (forward/backward/optimizer stage markers feed
+    the Model view)
+
+The reference splits host/device columns per op from CUPTI records; on this
+stack a sync eager op's host span covers its device execution, and compiled
+steps execute as one fused program, so the tables report wall spans and the
+step-gap analysis states whether the loop is input- or compute-bound.
+"""
+from __future__ import annotations
+
+from collections import defaultdict
+from enum import Enum
+from typing import Dict, Iterable, List, Optional
+
+__all__ = ["SortedKeys", "SummaryView", "StatisticData", "build_views"]
+
+
+class SortedKeys(Enum):
+    """Sort orders for the operator table
+    (ref:python/paddle/profiler/profiler_statistic.py:49)."""
+    CPUTotal = 0
+    CPUAvg = 1
+    CPUMax = 2
+    CPUMin = 3
+    GPUTotal = 4
+    GPUAvg = 5
+    GPUMax = 6
+    GPUMin = 7
+
+
+class SummaryView(Enum):
+    """Table selection (ref:python/paddle/profiler/profiler.py:46)."""
+    DeviceView = 0
+    OverView = 1
+    ModelView = 2
+    DistributedView = 3
+    KernelView = 4
+    OperatorView = 5
+    MemoryView = 6
+    MemoryManipulationView = 7
+    UDFView = 8
+
+
+_COMM_HINTS = ("all_reduce", "allreduce", "all_gather", "allgather",
+               "reduce_scatter", "all_to_all", "alltoall", "broadcast",
+               "psum", "ppermute", "send", "recv", "barrier", "collective")
+_DATA_HINTS = ("dataloader", "data_loader", "collate", "reader", "batch_fetch")
+_STAGE_NAMES = ("forward", "backward", "optimizer", "dataloader")
+
+
+def _category(name: str) -> str:
+    low = name.lower()
+    if any(h in low for h in _DATA_HINTS):
+        return "dataloader"
+    if any(h in low for h in _COMM_HINTS):
+        return "communication"
+    if low.startswith("profiler_step"):
+        return "step_marker"
+    return "operator"
+
+
+def _merged_span(intervals: List[tuple]) -> float:
+    """Total µs covered by a union of (start, end) intervals."""
+    total, cur_s, cur_e = 0.0, None, None
+    for s, e in sorted(intervals):
+        if cur_e is None or s > cur_e:
+            if cur_e is not None:
+                total += cur_e - cur_s
+            cur_s, cur_e = s, e
+        else:
+            cur_e = max(cur_e, e)
+    if cur_e is not None:
+        total += cur_e - cur_s
+    return total
+
+
+class StatisticData:
+    """Aggregations shared by every view."""
+
+    def __init__(self, events: Iterable[dict],
+                 memory_steps: Optional[List[dict]] = None):
+        self.events = [e for e in events if e.get("ph") != "M"]
+        self.memory_steps = memory_steps or []
+        self.by_cat: Dict[str, List[dict]] = defaultdict(list)
+        for e in self.events:
+            self.by_cat[e.get("cat") or _category(e["name"])].append(e)
+        spans = [(e["ts"], e["ts"] + e.get("dur", 0.0)) for e in self.events
+                 if e.get("dur")]
+        self.wall_us = (max(e for _, e in spans) - min(s for s, _ in spans)) \
+            if spans else 0.0
+        self.step_marks = sorted(
+            e["ts"] for e in self.by_cat.get("step_marker", []))
+
+    def cat_total(self, cat: str) -> float:
+        return _merged_span([(e["ts"], e["ts"] + e.get("dur", 0.0))
+                             for e in self.by_cat.get(cat, [])
+                             if e.get("dur")])
+
+    def op_table(self, sorted_by: SortedKeys = SortedKeys.CPUTotal):
+        agg = defaultdict(lambda: [0, 0.0, 0.0, float("inf")])
+        for e in self.by_cat.get("operator", []):
+            a = agg[e["name"]]
+            d = e.get("dur", 0.0)
+            a[0] += 1
+            a[1] += d
+            a[2] = max(a[2], d)
+            a[3] = min(a[3], d)
+        key = {
+            SortedKeys.CPUTotal: lambda kv: -kv[1][1],
+            SortedKeys.CPUAvg: lambda kv: -(kv[1][1] / max(kv[1][0], 1)),
+            SortedKeys.CPUMax: lambda kv: -kv[1][2],
+            SortedKeys.CPUMin: lambda kv: kv[1][3],
+        }.get(sorted_by, lambda kv: -kv[1][1])
+        return sorted(agg.items(), key=key)
+
+    def stage_totals(self) -> Dict[str, float]:
+        """forward/backward/optimizer/dataloader stage spans for ModelView —
+        user or hapi RecordEvent scopes matching the reference stage names."""
+        out = {}
+        for stage in _STAGE_NAMES:
+            iv = [(e["ts"], e["ts"] + e.get("dur", 0.0))
+                  for e in self.events
+                  if e.get("dur") and e["name"].lower() == stage]
+            if iv:
+                out[stage] = _merged_span(iv)
+        return out
+
+    def step_gap_analysis(self):
+        """Input-bound vs compute-bound per step window
+        (ref:paddle/fluid/framework/new_executor/executor_statistics.cc)."""
+        if len(self.step_marks) < 2:
+            return None
+        steps = []
+        data_iv = [(e["ts"], e["ts"] + e.get("dur", 0.0))
+                   for e in self.by_cat.get("dataloader", []) if e.get("dur")]
+        comp_iv = [(e["ts"], e["ts"] + e.get("dur", 0.0))
+                   for e in self.by_cat.get("operator", []) if e.get("dur")]
+        for a, b in zip(self.step_marks, self.step_marks[1:]):
+            clip = lambda iv: [(max(s, a), min(e, b)) for s, e in iv
+                               if min(e, b) > max(s, a)]
+            steps.append({
+                "span_us": b - a,
+                "data_us": _merged_span(clip(data_iv)),
+                "compute_us": _merged_span(clip(comp_iv)),
+            })
+        return steps
+
+
+def _fmt_table(header: List[str], rows: List[List[str]],
+               widths: List[int]) -> List[str]:
+    line = "-" * (sum(widths) + len(widths) - 1)
+    out = [line, " ".join(h.ljust(w) for h, w in zip(header, widths)), line]
+    out += [" ".join(str(c)[:w].ljust(w) for c, w in zip(r, widths))
+            for r in rows]
+    out.append(line)
+    return out
+
+
+def build_views(stat: StatisticData, views, sorted_by, time_unit: str = "ms",
+                op_limit: int = 40) -> str:
+    if views is not None and not isinstance(views, (list, tuple, set)):
+        views = [views]
+    div = {"ms": 1000.0, "us": 1.0, "s": 1e6}[time_unit]
+    u = time_unit
+    lines: List[str] = []
+
+    def want(v):
+        return views is None or v in views
+
+    if want(SummaryView.OverView):
+        rows = [["Total wall", f"{stat.wall_us / div:.3f}", "100.0%"]]
+        for cat in ("operator", "communication", "dataloader"):
+            t = stat.cat_total(cat)
+            pct = 100.0 * t / stat.wall_us if stat.wall_us else 0.0
+            rows.append([cat.capitalize(), f"{t / div:.3f}", f"{pct:.1f}%"])
+        lines += ["", f"[ Overview ({u}) ]"]
+        lines += _fmt_table(["Category", f"Time({u})", "Ratio"],
+                            rows, [24, 14, 8])
+
+    if want(SummaryView.ModelView):
+        stages = stat.stage_totals()
+        lines += ["", f"[ Model ({u}) ]"]
+        if stages:
+            rows = [[k.capitalize(), f"{v / div:.3f}",
+                     f"{100.0 * v / stat.wall_us if stat.wall_us else 0:.1f}%"]
+                    for k, v in stages.items()]
+            lines += _fmt_table(["Stage", f"Time({u})", "Ratio"],
+                                rows, [24, 14, 8])
+        else:
+            lines.append("  (wrap stages in RecordEvent('forward'/'backward'/"
+                         "'optimizer') to populate)")
+
+    if want(SummaryView.DistributedView):
+        comm = stat.cat_total("communication")
+        comp = stat.cat_total("operator")
+        comm_iv = [(e["ts"], e["ts"] + e.get("dur", 0.0))
+                   for e in stat.by_cat.get("communication", [])
+                   if e.get("dur")]
+        comp_iv = [(e["ts"], e["ts"] + e.get("dur", 0.0))
+                   for e in stat.by_cat.get("operator", []) if e.get("dur")]
+        both = _merged_span(comm_iv + comp_iv)
+        overlap = max(comm + comp - both, 0.0)
+        lines += ["", f"[ Distributed ({u}) ]"]
+        lines += _fmt_table(
+            ["Kind", f"Time({u})"],
+            [["Communication", f"{comm / div:.3f}"],
+             ["Computation", f"{comp / div:.3f}"],
+             ["Overlap", f"{overlap / div:.3f}"]], [24, 14])
+
+    if want(SummaryView.OperatorView) or want(SummaryView.KernelView):
+        rows = []
+        for name, (cnt, tot, mx, mn) in stat.op_table(sorted_by)[:op_limit]:
+            rows.append([name, cnt, f"{tot / div:.3f}",
+                         f"{tot / cnt / div:.3f}", f"{mx / div:.3f}",
+                         f"{mn / div:.3f}"])
+        lines += ["", f"[ Operator ({u}) ] (sync host spans; compiled steps "
+                      "appear as one fused program)"]
+        lines += _fmt_table(
+            ["Name", "Calls", f"Total({u})", f"Avg({u})", f"Max({u})",
+             f"Min({u})"], rows, [40, 6, 12, 10, 10, 10])
+
+    if want(SummaryView.MemoryView):
+        lines += ["", "[ Memory ]"]
+        if stat.memory_steps:
+            rows = [[m["step"], f"{m['live_mb']:.1f}", f"{m['peak_mb']:.1f}"]
+                    for m in stat.memory_steps]
+            lines += _fmt_table(["Step", "Live(MB)", "Peak(MB)"],
+                                rows, [8, 12, 12])
+        else:
+            lines.append("  (enable profile_memory=True and call step())")
+
+    gaps = stat.step_gap_analysis()
+    if gaps is not None:
+        data = sum(g["data_us"] for g in gaps)
+        comp = sum(g["compute_us"] for g in gaps)
+        span = sum(g["span_us"] for g in gaps)
+        bound = "input-bound" if data > comp else "compute-bound"
+        lines += ["", f"[ Scheduling ] {len(gaps)} steps, avg "
+                      f"{span / len(gaps) / div:.3f}{u}/step; dataloader "
+                      f"{100 * data / span if span else 0:.1f}%, compute "
+                      f"{100 * comp / span if span else 0:.1f}% -> {bound}"]
+
+    return "\n".join(lines)

@@ -1,0 +1,93 @@
+"""Profiler summary views + scheduler + trace reload
+(ref:python/paddle/profiler/profiler_statistic.py:46 SummaryView,
+ref:python/paddle/profiler/profiler.py make_scheduler)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer, profiler
+from paddle_tpu.profiler import (ProfilerState, RecordEvent, SortedKeys,
+                                 SummaryView, load_profiler_result,
+                                 make_scheduler)
+
+
+def _profiled_run(prof):
+    m = nn.Linear(8, 8)
+    opt = optimizer.SGD(learning_rate=0.1, parameters=m.parameters())
+    x = paddle.randn([4, 8])
+    with prof:
+        for _ in range(3):
+            with RecordEvent("forward"):
+                loss = (m(x) ** 2).mean()
+            with RecordEvent("backward"):
+                loss.backward()
+            with RecordEvent("optimizer"):
+                opt.step()
+                opt.clear_grad()
+            prof.step()
+
+
+def test_summary_views_print_all_sections(capsys):
+    prof = profiler.Profiler(profile_memory=True)
+    _profiled_run(prof)
+    out = prof.summary()
+    for section in ("[ Overview", "[ Model", "[ Distributed", "[ Operator",
+                    "[ Memory", "[ Scheduling"):
+        assert section in out, section
+    # stage rows present in the Model view
+    assert "Forward" in out and "Backward" in out and "Optimizer" in out
+    # memory snapshots recorded per step
+    assert len(prof._memory_steps) == 3
+    # view selection narrows output
+    only_ops = prof.summary(views=SummaryView.OperatorView,
+                            sorted_by=SortedKeys.CPUMax)
+    assert "[ Operator" in only_ops and "[ Overview" not in only_ops
+
+
+def test_export_protobuf_roundtrip(tmp_path):
+    prof = profiler.Profiler(profile_memory=True)
+    _profiled_run(prof)
+    path = prof.export_protobuf(str(tmp_path))
+    res = load_profiler_result(path)
+    assert len(res.events) > 0
+    out = res.summary(views=[SummaryView.OverView, SummaryView.MemoryView])
+    assert "[ Overview" in out and "[ Memory" in out
+    with pytest.raises(ValueError):
+        bad = tmp_path / "bad.pt_trace"
+        bad.write_bytes(b"nope")
+        load_profiler_result(str(bad))
+
+
+def test_make_scheduler_state_machine():
+    sched = make_scheduler(closed=1, ready=1, record=2, repeat=2,
+                           skip_first=1)
+    states = [sched(i) for i in range(10)]
+    assert states[0] == ProfilerState.CLOSED          # skip_first
+    assert states[1] == ProfilerState.CLOSED          # closed
+    assert states[2] == ProfilerState.READY
+    assert states[3] == ProfilerState.RECORD
+    assert states[4] == ProfilerState.RECORD_AND_RETURN
+    assert states[5] == ProfilerState.CLOSED          # cycle 2
+    assert states[8] == ProfilerState.RECORD_AND_RETURN
+    assert states[9] == ProfilerState.CLOSED          # repeat exhausted
+
+
+def test_scheduler_gates_recording_and_keeps_step_marks():
+    # closed=1, ready=0, record=2: iterations 0 CLOSED, 1-2 RECORD, cycle
+    sched = make_scheduler(closed=1, ready=0, record=2)
+    prof = profiler.Profiler(scheduler=sched)
+    m = nn.Linear(4, 4)
+    x = paddle.randn([2, 4])
+    with prof:
+        for _ in range(6):
+            with RecordEvent("forward"):
+                m(x)
+            prof.step()
+    events = prof._events()
+    # step boundary markers survive CLOSED windows
+    marks = [e for e in events if e["name"].startswith("profiler_step")]
+    assert len(marks) == 6
+    # iteration 0, 3 are CLOSED -> only 4 of 6 forward scopes recorded
+    fwd = [e for e in events if e["name"] == "forward"]
+    assert len(fwd) == 4
+
