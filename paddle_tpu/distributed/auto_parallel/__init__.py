@@ -31,7 +31,7 @@ from ..sharding_util import constraint as _constraint
 from ..sharding_util import shard_parameter
 
 __all__ = ["ProcessMesh", "shard_tensor", "shard_op", "Strategy", "Engine",
-           "suggest_mesh"]
+           "suggest_mesh", "candidate_strategies"]
 
 
 class ProcessMesh:
@@ -150,6 +150,44 @@ def suggest_mesh(n_devices: int, param_count: int, hbm_per_chip: float = 16e9,
     return s
 
 
+def _synth(spec):
+    """Materialize a sample Tensor from an InputSpec-like / (shape, dtype)."""
+    if isinstance(spec, Tensor):
+        return spec
+    shape = getattr(spec, "shape", None)
+    dtype = str(getattr(spec, "dtype", "float32")).replace("paddle.", "")
+    if shape is None:
+        shape, dtype = spec[0], (spec[1] if len(spec) > 1 else "float32")
+    shape = [2 if d in (None, -1) else int(d) for d in shape]
+    if "int" in dtype:
+        return Tensor(np.zeros(shape, dtype))
+    return Tensor(np.random.default_rng(0).standard_normal(shape)
+                  .astype(dtype))
+
+
+def candidate_strategies(n_devices: int, param_count: int,
+                         seq_len: int = 0) -> "list[Strategy]":
+    """The trial set the tuner measures: the heuristic prior plus the
+    axis-degree variants it might be wrong about (the parallel_tuner's
+    search space, ref:python/paddle/distributed/auto_parallel/tuner/
+    parallel_tuner.py, reduced to the degrees GSPMD can't pick itself)."""
+    cands = [suggest_mesh(n_devices, param_count, seq_len=seq_len)]
+    cands.append(Strategy(dp_degree=n_devices))  # pure dp
+    if n_devices % 2 == 0 and n_devices >= 2:
+        cands.append(Strategy(dp_degree=n_devices // 2, mp_degree=2))
+        cands.append(Strategy(dp_degree=n_devices // 2, sharding_degree=2))
+    if n_devices % 4 == 0:
+        cands.append(Strategy(dp_degree=n_devices // 4, mp_degree=4))
+    seen, out = set(), []
+    for s in cands:
+        key = (s.dp_degree, s.mp_degree, s.pp_degree, s.sharding_degree,
+               s.sep_degree)
+        if key not in seen and s.degree <= n_devices:
+            seen.add(key)
+            out.append(s)
+    return out
+
+
 class Engine:
     """Annotate a model, get a plan, fit (ref engine.py:55,848,1309).
 
@@ -172,8 +210,15 @@ class Engine:
 
     # ------------------------------------------------------------ prepare
 
-    def prepare(self, inputs_spec=None, labels_spec=None, mode="train"):
+    def prepare(self, inputs_spec=None, labels_spec=None, mode="train",
+                sample_batch=None):
         import jax
+
+        if mode == "tune":
+            # measurement-driven strategy search (OptimizationTuner role)
+            self.tune(sample_batch=sample_batch, inputs_spec=inputs_spec,
+                      labels_spec=labels_spec)
+            mode = "train"
 
         s = self.strategy
         n = len(jax.devices())
@@ -198,6 +243,86 @@ class Engine:
         if mode == "train":
             self._step = TrainStep(loss_fn, self.optimizer, layers=self.model)
         return self
+
+    # -------------------------------------------------------------- tuner
+
+    def tune(self, sample_batch=None, inputs_spec=None, labels_spec=None,
+             candidates=None, warmup=2, iters=6, verbose=1):
+        """Trial-compile candidate meshes and pick by MEASURED step time
+        (ref:python/paddle/distributed/auto_parallel/tuner/
+        optimization_tuner.py OptimizationTuner.tune — trial-run pass
+        configs; here the config space is the mesh-degree choice and the
+        measurement is CostModel.profile_measure on a compiled TrainStep).
+
+        ``sample_batch`` — (inputs..., labels) Tensors sized like one real
+        global batch; or pass (shape, dtype) specs to synthesize one.
+        ``suggest_mesh``'s heuristic stays the prior (first candidate); the
+        measured winner replaces self.strategy. Returns the trial report.
+        """
+        import jax
+
+        from ...cost_model import CostModel
+        from ...jit import TrainStep
+
+        if sample_batch is None:
+            sample_batch = tuple(
+                _synth(spec) for spec in (list(inputs_spec or [])
+                                          + list(labels_spec or [])))
+        if not sample_batch:
+            raise ValueError("tune() needs sample_batch or inputs/labels specs")
+        n = len(jax.devices())
+        param_count = int(sum(np.prod(p.shape)
+                              for p in self.model.parameters()))
+        cands = candidates or candidate_strategies(n, param_count)
+        if len(cands) < 2 and candidates is None:
+            cands = cands + [Strategy(dp_degree=n)]
+
+        # trials perturb params/opt state: snapshot and restore afterwards
+        snap = {k: np.array(np.asarray(v._data if isinstance(v, Tensor)
+                                       else v))
+                for k, v in self.model.state_dict().items()}
+        opt_snap = (self.optimizer.state_dict()
+                    if self.optimizer is not None else None)
+        cm = CostModel()
+        report = []
+        for s in cands:
+            try:
+                self._mesh = init_hybrid_mesh(
+                    dp=s.dp_degree, mp=s.mp_degree, pp=s.pp_degree,
+                    sharding=s.sharding_degree, sep=s.sep_degree)
+
+                def loss_fn(*args):
+                    return self.loss(self.model(*args[:-1]), args[-1])
+
+                step = TrainStep(loss_fn, self.optimizer, layers=self.model)
+                xs = tuple(self._shard_batch(b) for b in sample_batch)
+                t = cm.profile_measure(step, xs, warmup=warmup,
+                                       iters=iters)["time"]
+                report.append((s, float(t)))
+                if verbose:
+                    print(f"[tune] dp{s.dp_degree} mp{s.mp_degree} "
+                          f"pp{s.pp_degree} sh{s.sharding_degree} "
+                          f"sep{s.sep_degree}: {t * 1e3:.2f} ms/step")
+            except Exception as e:  # infeasible candidate: record, move on
+                report.append((s, float("inf")))
+                if verbose:
+                    print(f"[tune] dp{s.dp_degree} mp{s.mp_degree}: "
+                          f"failed ({type(e).__name__})")
+        self.model.set_state_dict({k: Tensor(v) for k, v in snap.items()})
+        if opt_snap is not None:
+            self.optimizer.set_state_dict(opt_snap)
+        best = min(report, key=lambda r: r[1])
+        if not np.isfinite(best[1]):
+            raise RuntimeError("every tuner candidate failed to run")
+        self.strategy = best[0]
+        # the last trial left the global mesh at the losing candidate;
+        # re-establish the winner's mesh for anything built before prepare()
+        w = best[0]
+        self._mesh = init_hybrid_mesh(
+            dp=w.dp_degree, mp=w.mp_degree, pp=w.pp_degree,
+            sharding=w.sharding_degree, sep=w.sep_degree)
+        self._tuner_report = report
+        return report
 
     def _shard_batch(self, t):
         from ..parallel import shard_batch

@@ -27,7 +27,8 @@ from ....core.tensor import Tensor
 from ....nn.layer import Layer
 from ....nn.stacked import StackedLayers
 from ... import mesh as mesh_mod
-from ...pipeline import PIPE_AXIS, pipeline_apply
+from ...pipeline import (PIPE_AXIS, pipeline_apply,
+                         pipeline_apply_interleaved)
 
 
 class LayerDesc:
@@ -80,6 +81,7 @@ class PipelineLayer(Layer):
         self.loss_fn = loss_fn
         self.num_microbatches = num_microbatches
         self.recompute = recompute_interval > 0
+        self.num_virtual_stages = num_virtual_pipeline_stages or 1
 
         mesh = mesh_mod.get_mesh()
         pipe = mesh.shape.get(PIPE_AXIS, 1) if mesh is not None else 1
@@ -119,14 +121,17 @@ class PipelineLayer(Layer):
                 best = (j - i + 1, i)
             i = j + 1
         run_len, run_start = best
-        if run_len < self.num_stages:
+        parts = self.num_stages * self.num_virtual_stages
+        if run_len < parts:
             raise ValueError(
                 f"homogeneous block run of length {run_len} cannot be split "
-                f"into {self.num_stages} pipeline stages"
+                f"into {self.num_stages} stages x {self.num_virtual_stages} "
+                "virtual chunks"
             )
-        if run_len % self.num_stages:
+        if run_len % parts:
             raise ValueError(
-                f"{run_len} blocks not divisible by {self.num_stages} stages"
+                f"{run_len} blocks not divisible by {self.num_stages} stages "
+                f"x {self.num_virtual_stages} virtual chunks"
             )
 
         self._pre = built[:run_start]
@@ -155,28 +160,52 @@ class PipelineLayer(Layer):
         blocks = self.blocks
         L = blocks.num_layers
         S = self.num_stages
-        per = L // S
+        V = self.num_virtual_stages
         mesh = mesh_mod.ensure_mesh()
         M = self.num_microbatches
 
-        def fn(h, key, *arrays):
-            trees = tuple(a.reshape((S, per) + a.shape[1:]) for a in arrays)
+        per = L // (S * V)
 
-            def stage_fn(local, hh):
-                s = jax.lax.axis_index(PIPE_AXIS)
+        def block_scan(local, hh, key, global_stage):
+            """Run this (virtual) stage's `per` blocks; RNG keys fold in the
+            GLOBAL block index so every schedule draws identical streams."""
 
-                def body(c, xs):
-                    idx, slices = xs[0], xs[1:]
-                    gidx = s * per + idx
-                    return blocks._apply_one(slices, c, jax.random.fold_in(key, gidx)), None
+            def body(c, xs):
+                idx, slices = xs[0], xs[1:]
+                gidx = global_stage * per + idx
+                return blocks._apply_one(
+                    slices, c, jax.random.fold_in(key, gidx)), None
 
-                xs = (jnp.arange(per),) + local
-                return jax.lax.scan(body, hh, xs)[0]
+            xs = (jnp.arange(per),) + local
+            return jax.lax.scan(body, hh, xs)[0]
 
-            return pipeline_apply(
-                stage_fn, trees, h, num_microbatches=M, mesh=mesh,
-                remat=self.recompute,
-            )
+        if V > 1:
+            def fn(h, key, *arrays):
+                # chunk (v, s) = global stage v*S+s holds blocks
+                # [(v*S+s)*per, ...) — the interleaved placement
+                trees = tuple(a.reshape((V, S, per) + a.shape[1:])
+                              for a in arrays)
+
+                def chunk_fn(local, hh, v):
+                    s = jax.lax.axis_index(PIPE_AXIS)
+                    return block_scan(local, hh, key, v * S + s)
+
+                return pipeline_apply_interleaved(
+                    chunk_fn, trees, h, num_microbatches=M, num_chunks=V,
+                    mesh=mesh, remat=self.recompute,
+                )
+        else:
+            def fn(h, key, *arrays):
+                trees = tuple(a.reshape((S, per) + a.shape[1:]) for a in arrays)
+
+                def stage_fn(local, hh):
+                    return block_scan(local, hh, key,
+                                      jax.lax.axis_index(PIPE_AXIS))
+
+                return pipeline_apply(
+                    stage_fn, trees, h, num_microbatches=M, mesh=mesh,
+                    remat=self.recompute,
+                )
 
         object.__setattr__(self, "_pipe_fn_cached", fn)
         return fn

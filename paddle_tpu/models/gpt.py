@@ -251,11 +251,14 @@ def gpt_pipe_loss(logits, labels):
     )
 
 
-def GPTForCausalLMPipe(cfg: GPTConfig, num_stages=None, num_microbatches: int = 1):
+def GPTForCausalLMPipe(cfg: GPTConfig, num_stages=None, num_microbatches: int = 1,
+                       num_virtual_pipeline_stages=None):
     """Pipeline-parallel GPT (parity role: the reference's fleet
     GPTForPretrainingPipe built from LayerDesc lists). Decoder blocks form
     the stage-stacked homogeneous run; embedding/head run under GSPMD on
-    every stage; tied embeddings share the wte Parameter object."""
+    every stage; tied embeddings share the wte Parameter object.
+    ``num_virtual_pipeline_stages`` > 1 selects the interleaved schedule
+    (ref:...pipeline_parallel.py:514)."""
     from ..distributed.fleet.meta_parallel import LayerDesc, PipelineLayer
 
     emb = GPTEmbeddingPipe(cfg)
@@ -273,6 +276,7 @@ def GPTForCausalLMPipe(cfg: GPTConfig, num_stages=None, num_microbatches: int = 
         loss_fn=gpt_pipe_loss,
         num_microbatches=num_microbatches,
         recompute_interval=1 if cfg.use_recompute else 0,
+        num_virtual_pipeline_stages=num_virtual_pipeline_stages,
     )
 
 

@@ -242,7 +242,7 @@ def build_views(stat: StatisticData, views, sorted_by, time_unit: str = "ms",
         else:
             lines.append("  (enable profile_memory=True and call step())")
 
-    gaps = stat.step_gap_analysis()
+    gaps = stat.step_gap_analysis() if want(SummaryView.OverView) else None
     if gaps is not None:
         data = sum(g["data_us"] for g in gaps)
         comp = sum(g["compute_us"] for g in gaps)
