@@ -1,0 +1,127 @@
+"""Beyond-RAM sparse-table benchmark: a multi-GB Wide&Deep embedding table
+behind a hard resident-RAM cap, spilling cold rows to disk
+(the SSD-table story, ref:paddle/fluid/distributed/ps/table/
+ssd_sparse_table.cc; accessor ref:.../ctr_accessor.cc).
+
+Drives the REAL Wide&Deep model + PS client path: every step touches a
+fresh slice of a huge id space (recommender long-tail access pattern), so
+the table grows far past the cap and the server pages LRU rows to the
+spill file while training continues. Records throughput + tier stats +
+shrink eviction to benches/BASELINE_RESULTS.jsonl.
+
+Usage: python benches/ps_spill_bench.py [target_gb] [ram_cap_mb]
+Defaults: 2.0 GB logical table, 256 MB resident cap.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(HERE))
+sys.path.insert(0, HERE)
+
+
+def main():
+    import jax
+
+    jax.config.update("jax_platforms", jax.default_backend())
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import ps
+    from paddle_tpu.models.widedeep import WideDeep
+
+    target_gb = float(sys.argv[1]) if len(sys.argv) > 1 else 2.0
+    cap_mb = int(sys.argv[2]) if len(sys.argv) > 2 else 256
+    dim = 64
+    # adagrad row: 3 meta + 64 emb + 64 acc floats = 524 B payload
+    row_bytes = (3 + 2 * dim) * 4 + 64
+    n_rows_target = int(target_gb * 1e9 / row_bytes)
+    batch = 4096
+    fields = 26
+    steps = max(n_rows_target // (batch * fields) + 1, 8)
+
+    spill_dir = tempfile.mkdtemp(prefix="ps_spill_")
+    svc = ps.EmbeddingService(dim, num_shards=2, rule="adagrad",
+                              ram_cap_bytes=cap_mb * 1_000_000,
+                              spill_dir=spill_dir)
+    try:
+        model = WideDeep(
+            num_fields=fields, num_dense=13, hidden_sizes=(64, 64),
+            sparse_embedding=ps.PSEmbedding(svc.client(), learning_rate=0.05),
+            embedding_dim=dim)
+        opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                    parameters=model.parameters())
+        rng = np.random.default_rng(0)
+        dense = paddle.to_tensor(
+            rng.standard_normal((batch, 13)).astype(np.float32))
+        labels = paddle.to_tensor(
+            (rng.random((batch, 1)) > 0.5).astype(np.float32))
+
+        t0 = time.perf_counter()
+        loss = None
+        for i in range(steps):
+            # long-tail access: mostly-new ids each step + a hot head
+            fresh = rng.integers(0, 1 << 50, (batch, fields - 2))
+            hot = rng.integers(0, 10_000, (batch, 2))
+            sparse = np.concatenate([hot, fresh], 1).astype(np.int64)
+            logits = model(paddle.to_tensor(sparse), dense)
+            loss = model.loss(logits, labels)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            if i % 20 == 0:
+                st = model.embedding.client.tier_stats()
+                print(f"step {i}/{steps} rows="
+                      f"{st['mem_rows'] + st['spill_rows']:,} "
+                      f"mem={st['mem_bytes'] / 1e6:.0f}MB "
+                      f"spill={st['spill_bytes'] / 1e9:.2f}GB", flush=True)
+        dt = time.perf_counter() - t0
+
+        st = model.embedding.client.tier_stats()
+        total_rows = st["mem_rows"] + st["spill_rows"]
+        logical_gb = total_rows * row_bytes / 1e9
+        assert st["spill_rows"] > 0 and st["mem_bytes"] <= cap_mb * 1.2e6, st
+
+        # checkpoint includes the spilled tier
+        ckpt = os.path.join(spill_dir, "ckpt")
+        t1 = time.perf_counter()
+        model.embedding.client.save(ckpt)
+        save_s = time.perf_counter() - t1
+
+        # accessor shrink: evict the long tail (seen once, no clicks)
+        t2 = time.perf_counter()
+        evicted = model.embedding.client.shrink(threshold=0.3, decay=1.0)
+        shrink_s = time.perf_counter() - t2
+
+        from _common import emit
+
+        emit({
+            "bench": "ps-spill",
+            "config": f"widedeep dim{dim} cap{cap_mb}MB",
+            "samples_per_sec": round(batch * steps / dt, 1),
+            "steps": steps, "batch": batch,
+            "table_rows": int(total_rows),
+            "table_gb": round(logical_gb, 2),
+            "ram_cap_mb": cap_mb,
+            "mem_mb": round(st["mem_bytes"] / 1e6, 1),
+            "spill_gb": round(st["spill_bytes"] / 1e9, 2),
+            "pageouts": st["pageouts"], "pageins": st["pageins"],
+            "shrink_evicted": int(evicted),
+            "shrink_s": round(shrink_s, 1),
+            "save_s": round(save_s, 1),
+            "loss": float(np.asarray(loss._data)),
+            "platform": jax.devices()[0].platform,
+        })
+    finally:
+        svc.stop()
+        import shutil
+
+        shutil.rmtree(spill_dir, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

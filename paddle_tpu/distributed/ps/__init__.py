@@ -11,8 +11,13 @@ ref:python/paddle/distributed/ps/the_one_ps.py:1031). TPU-native redesign:
   unique rows of each batch, run the device step, and push per-row grads
   (the geo-async communicator pattern, without brpc).
 
-Capacity therefore scales with aggregate host RAM, not HBM: a table bigger
-than one chip's HBM is just a bigger std::unordered_map spread over hosts.
+Capacity scales past host RAM too: with ``ram_cap_bytes`` set, each server
+pages least-recently-used rows out to an append-only spill file and pages
+them back in on access (the SSD-table role,
+ref:paddle/fluid/distributed/ps/table/ssd_sparse_table.cc — file-backed
+instead of RocksDB), and a CTR-style accessor tracks per-row show/click
+counters so ``shrink()`` can decay and evict the long tail
+(ref:paddle/fluid/distributed/ps/table/ctr_accessor.cc).
 
 User surface:
   EmbeddingService  — start/stop a group of table servers (one per shard)
@@ -49,10 +54,29 @@ class EmbeddingServer:
     """One in-process table shard server (C++ threads; GIL-free serving)."""
 
     def __init__(self, dim: int, rule: str = "sgd", port: int = 0,
-                 init_range: float = 0.01, seed: int = 42):
+                 init_range: float = 0.01, seed: int = 42,
+                 ram_cap_bytes: int = 0, spill_path: Optional[str] = None,
+                 show_coeff: float = 0.25, click_coeff: float = 1.0):
+        """``ram_cap_bytes`` > 0 turns on the beyond-RAM tier: when the
+        resident rows exceed the cap, least-recently-used rows page out to
+        ``spill_path`` and page back in on access (the SSD-table role,
+        ref:paddle/fluid/distributed/ps/table/ssd_sparse_table.cc). The
+        show/click coefficients weight :meth:`shrink`'s eviction score
+        (ref:.../ps/table/ctr_accessor.cc)."""
         self._lib = _lib()
-        self._h = self._lib.pt_emb_server_start(
-            port, dim, _RULES[rule], ctypes.c_float(init_range), seed)
+        if ram_cap_bytes > 0 and not spill_path:
+            raise ValueError("ram_cap_bytes requires spill_path")
+        if spill_path and ram_cap_bytes <= 0:
+            raise ValueError("spill_path requires ram_cap_bytes > 0 "
+                             "(the cap decides when rows page out)")
+        if ram_cap_bytes > 0 or spill_path:
+            self._h = self._lib.pt_emb_server_start2(
+                port, dim, _RULES[rule], ctypes.c_float(init_range), seed,
+                ram_cap_bytes, (spill_path or "").encode(),
+                ctypes.c_float(show_coeff), ctypes.c_float(click_coeff))
+        else:
+            self._h = self._lib.pt_emb_server_start(
+                port, dim, _RULES[rule], ctypes.c_float(init_range), seed)
         if not self._h:
             raise RuntimeError("failed to start embedding server")
         self.port = self._lib.pt_emb_server_port(self._h)
@@ -65,6 +89,22 @@ class EmbeddingServer:
     @property
     def bytes(self) -> int:
         return int(self._lib.pt_emb_server_bytes(self._h))
+
+    def tier_stats(self) -> dict:
+        """mem_rows/mem_bytes/spill_rows/spill_bytes/evicted/pageouts/pageins."""
+        buf = (ctypes.c_uint64 * 7)()
+        self._lib.pt_emb_server_stats2(self._h, buf)
+        keys = ("mem_rows", "mem_bytes", "spill_rows", "spill_bytes",
+                "evicted", "pageouts", "pageins")
+        return dict(zip(keys, (int(v) for v in buf)))
+
+    def shrink(self, threshold: float = 0.0, max_unseen: int = 0,
+               decay: float = 1.0) -> int:
+        """Decay show/click and evict rows scoring below ``threshold`` or
+        unseen for more than ``max_unseen`` accesses (CTR-accessor shrink)."""
+        return int(self._lib.pt_emb_server_shrink(
+            self._h, ctypes.c_float(threshold), max_unseen,
+            ctypes.c_float(decay)))
 
     def stop(self):
         if self._h:
@@ -165,6 +205,52 @@ class SparseTableClient:
             bytes_ += buf[1]
         return rows, bytes_
 
+    def tier_stats(self) -> dict:
+        """Aggregate memory/spill-tier counters over shards."""
+        keys = ("mem_rows", "mem_bytes", "spill_rows", "spill_bytes",
+                "evicted", "pageouts", "pageins")
+        total = dict.fromkeys(keys, 0)
+        buf = (ctypes.c_uint64 * 7)()
+        for i, conn in enumerate(self._conns):
+            if self._lib.pt_emb_stats2(conn, buf) != 0:
+                raise RuntimeError(f"stats2 failed on shard {i}")
+            for k, v in zip(keys, buf):
+                total[k] += int(v)
+        return total
+
+    def show_click(self, ids: np.ndarray, shows: np.ndarray,
+                   clicks: np.ndarray):
+        """Feed impression/click signals for the accessor's eviction score."""
+        ids = np.ascontiguousarray(ids, dtype=np.uint64)
+        shows = np.ascontiguousarray(shows, dtype=np.float32)
+        clicks = np.ascontiguousarray(clicks, dtype=np.float32)
+        shard = self._route(ids)
+        for s, conn in enumerate(self._conns):
+            sel = np.nonzero(shard == s)[0]
+            if not len(sel):
+                continue
+            sub = np.ascontiguousarray(ids[sel])
+            sh = np.ascontiguousarray(shows[sel])
+            ck = np.ascontiguousarray(clicks[sel])
+            rc = self._lib.pt_emb_showclick(
+                conn, sub.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+                len(sel), sh.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                ck.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+            if rc != 0:
+                raise RuntimeError(f"show_click failed on shard {s}")
+
+    def shrink(self, threshold: float = 0.0, max_unseen: int = 0,
+               decay: float = 1.0) -> int:
+        """Shrink every shard; returns total rows evicted."""
+        total = 0
+        for i, conn in enumerate(self._conns):
+            ev = self._lib.pt_emb_shrink(conn, ctypes.c_float(threshold),
+                                         max_unseen, ctypes.c_float(decay))
+            if ev < 0:
+                raise RuntimeError(f"shrink failed on shard {i}")
+            total += int(ev)
+        return total
+
     def clear(self):
         for conn in self._conns:
             self._lib.pt_emb_clear(conn)
@@ -238,9 +324,19 @@ class EmbeddingService:
     """A group of table-shard servers living in this process (one host)."""
 
     def __init__(self, dim: int, num_shards: int = 1, rule: str = "sgd",
-                 init_range: float = 0.01, seed: int = 42):
+                 init_range: float = 0.01, seed: int = 42,
+                 ram_cap_bytes: int = 0, spill_dir: Optional[str] = None,
+                 show_coeff: float = 0.25, click_coeff: float = 1.0):
+        if ram_cap_bytes > 0 and not spill_dir:
+            raise ValueError("ram_cap_bytes requires spill_dir")
         self.servers = [
-            EmbeddingServer(dim, rule=rule, init_range=init_range, seed=seed + i)
+            EmbeddingServer(
+                dim, rule=rule, init_range=init_range, seed=seed + i,
+                ram_cap_bytes=ram_cap_bytes // max(num_shards, 1)
+                if ram_cap_bytes else 0,
+                spill_path=(os.path.join(spill_dir, f"table{i}.spill")
+                            if spill_dir else None),
+                show_coeff=show_coeff, click_coeff=click_coeff)
             for i in range(num_shards)
         ]
         self.endpoints = [f"127.0.0.1:{s.port}" for s in self.servers]

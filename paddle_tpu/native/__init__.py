@@ -123,6 +123,20 @@ def _declare(lib):
     lib.pt_emb_clear.argtypes = [c.c_void_p]
     lib.pt_emb_stats.restype = c.c_int
     lib.pt_emb_stats.argtypes = [c.c_void_p, u64p]
+    lib.pt_emb_server_start2.restype = c.c_void_p
+    lib.pt_emb_server_start2.argtypes = [
+        c.c_int, c.c_int, c.c_int, c.c_float, c.c_longlong, c.c_ulonglong,
+        c.c_char_p, c.c_float, c.c_float]
+    lib.pt_emb_server_stats2.argtypes = [c.c_void_p, u64p]
+    lib.pt_emb_server_shrink.restype = c.c_longlong
+    lib.pt_emb_server_shrink.argtypes = [c.c_void_p, c.c_float, c.c_uint,
+                                         c.c_float]
+    lib.pt_emb_showclick.restype = c.c_int
+    lib.pt_emb_showclick.argtypes = [c.c_void_p, u64p, c.c_uint, f32p, f32p]
+    lib.pt_emb_shrink.restype = c.c_longlong
+    lib.pt_emb_shrink.argtypes = [c.c_void_p, c.c_float, c.c_uint, c.c_float]
+    lib.pt_emb_stats2.restype = c.c_int
+    lib.pt_emb_stats2.argtypes = [c.c_void_p, u64p]
 
     lib.pt_infer_create.restype = c.c_void_p
     lib.pt_infer_create.argtypes = [c.c_char_p, c.c_char_p]

@@ -15,6 +15,7 @@
 // open-addressing std::unordered_map shards with per-shard mutexes, and the
 // optimizer state stored inline after the embedding row.
 #include <arpa/inet.h>
+#include <fcntl.h>
 #include <netdb.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
@@ -22,6 +23,7 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <atomic>
 #include <cmath>
 #include <cstdint>
@@ -46,6 +48,10 @@ enum Op : uint8_t {
   OP_LOAD = 4,   // path string                       -> status
   OP_STATS = 5,  // -                                 -> u64 rows, u64 bytes
   OP_CLEAR = 6,  // -                                 -> status
+  OP_SHOWCLICK = 7,  // u32 n, u64 ids[n], f32 shows[n], f32 clicks[n] -> 0
+  OP_SHRINK = 8,     // f32 threshold, u32 max_unseen, f32 decay -> u64 evicted
+  OP_STATS2 = 9,     // - -> u64[7] mem_rows, mem_bytes, spill_rows,
+                     //      spill_bytes, evicted, pageouts, pageins
 };
 
 bool read_n(int fd, void* buf, size_t n) {
@@ -86,40 +92,139 @@ struct TableConfig {
   float beta2 = 0.999f;
   float eps = 1e-8f;
   uint64_t seed = 42;
+  // beyond-RAM tier (ref:paddle/fluid/distributed/ps/table/
+  // ssd_sparse_table.cc — same role, file-backed instead of RocksDB):
+  // when the in-memory tier exceeds ram_cap_bytes, the least-recently-used
+  // rows page out to an append-only spill file and page back in on access.
+  uint64_t ram_cap_bytes = 0;  // 0 = unlimited (no spill)
+  std::string spill_path;      // required when ram_cap_bytes > 0
+  // CTR accessor (ref:paddle/fluid/distributed/ps/table/ctr_accessor.cc):
+  // per-row show/click counters, decayed on every Shrink; rows whose score
+  // show_coeff*show + click_coeff*click falls below the threshold (or that
+  // go unseen too long) are evicted by Shrink.
+  float show_coeff = 0.25f;
+  float click_coeff = 1.0f;
 };
 
 class SparseTable {
  public:
+  // Per-row metadata floats prepended to every row:
+  // [0] last-access tick (LRU clock), [1] show counter, [2] click counter —
+  // the accessor state (ref:.../ps/table/ctr_accessor.cc CtrCommonAccessor).
+  static constexpr int kMeta = 3;
+  // Book-keeping estimate per resident row beyond the float payload
+  // (unordered_map node + vector header + allocator slack).
+  static constexpr uint64_t kRowOverhead = 64;
+
   explicit SparseTable(const TableConfig& cfg) : cfg_(cfg) {
-    row_len_ = cfg.dim;
+    row_len_ = kMeta + cfg.dim;
     if (cfg.rule == RULE_ADAGRAD) row_len_ += cfg.dim;
     if (cfg.rule == RULE_ADAM) row_len_ += 2 * cfg.dim + 1;  // m, v, step
+    if (cfg_.ram_cap_bytes > 0 && !cfg_.spill_path.empty()) {
+      spill_fd_ = ::open(cfg_.spill_path.c_str(), O_RDWR | O_CREAT | O_TRUNC,
+                         0644);
+    }
+  }
+
+  ~SparseTable() {
+    if (spill_fd_ >= 0) ::close(spill_fd_);
   }
 
   // Copy the embedding part of each id's row into out (n * dim floats),
   // creating missing rows with the deterministic per-id initializer.
   void Pull(const uint64_t* ids, uint32_t n, float* out) {
+    uint32_t now = ++tick_;
     for (uint32_t i = 0; i < n; ++i) {
       Shard& s = shard(ids[i]);
       std::lock_guard<std::mutex> lk(s.mu);
       std::vector<float>& row = FindOrInit(s, ids[i]);
-      memcpy(out + static_cast<size_t>(i) * cfg_.dim, row.data(),
+      SetTick(row.data(), now);
+      memcpy(out + static_cast<size_t>(i) * cfg_.dim, row.data() + kMeta,
              sizeof(float) * cfg_.dim);
     }
+    MaybePageOut();
   }
 
   void Push(const uint64_t* ids, uint32_t n, const float* grads, float lr) {
+    uint32_t now = ++tick_;
     for (uint32_t i = 0; i < n; ++i) {
       Shard& s = shard(ids[i]);
       std::lock_guard<std::mutex> lk(s.mu);
       std::vector<float>& row = FindOrInit(s, ids[i]);
+      SetTick(row.data(), now);
+      row[1] += 1.0f;  // appearing in a training batch = one impression
       const float* g = grads + static_cast<size_t>(i) * cfg_.dim;
-      ApplyRule(row.data(), g, lr);
+      ApplyRule(row.data() + kMeta, g, lr);
     }
+    MaybePageOut();
+  }
+
+  // Feed explicit impression/click signals (the accessor's show_click
+  // update, ref ctr_accessor.cc UpdateShowClick).
+  void ShowClick(const uint64_t* ids, uint32_t n, const float* shows,
+                 const float* clicks) {
+    uint32_t now = ++tick_;
+    for (uint32_t i = 0; i < n; ++i) {
+      Shard& s = shard(ids[i]);
+      std::lock_guard<std::mutex> lk(s.mu);
+      std::vector<float>& row = FindOrInit(s, ids[i]);
+      SetTick(row.data(), now);
+      row[1] += shows[i];
+      row[2] += clicks[i];
+    }
+    MaybePageOut();
+  }
+
+  // Decay counters and evict low-score / long-unseen rows, both resident
+  // and spilled (ref:.../memory_sparse_table.cc Shrink + ctr_accessor
+  // show_click_score). Returns the number of rows evicted.
+  uint64_t Shrink(float threshold, uint32_t max_unseen, float decay) {
+    uint64_t evicted = 0;
+    uint32_t now = tick_.load();
+    size_t rec = RecBytes();
+    for (auto& s : shards_) {
+      std::lock_guard<std::mutex> lk(s.mu);
+      for (auto it = s.rows.begin(); it != s.rows.end();) {
+        std::vector<float>& row = it->second;
+        row[1] *= decay;
+        row[2] *= decay;
+        if (Doomed(row.data(), now, threshold, max_unseen)) {
+          mem_bytes_ -= kRowOverhead + row_len_ * sizeof(float);
+          it = s.rows.erase(it);
+          ++evicted;
+        } else {
+          ++it;
+        }
+      }
+      for (auto it = s.spilled.begin(); it != s.spilled.end();) {
+        float meta[kMeta];
+        if (pread(spill_fd_, meta, sizeof(meta),
+                  static_cast<off_t>(it->second + 8)) !=
+            static_cast<ssize_t>(sizeof(meta))) {
+          ++it;
+          continue;
+        }
+        meta[1] *= decay;
+        meta[2] *= decay;
+        if (Doomed(meta, now, threshold, max_unseen)) {
+          spill_garbage_ += rec;
+          it = s.spilled.erase(it);
+          --spill_rows_;
+          ++evicted;
+        } else {
+          pwrite(spill_fd_, meta, sizeof(meta),
+                 static_cast<off_t>(it->second + 8));
+          ++it;
+        }
+      }
+    }
+    evicted_ += evicted;
+    MaybeCompact();
+    return evicted;
   }
 
   uint64_t NumRows() {
-    uint64_t n = 0;
+    uint64_t n = spill_rows_.load();
     for (auto& s : shards_) {
       std::lock_guard<std::mutex> lk(s.mu);
       n += s.rows.size();
@@ -129,19 +234,46 @@ class SparseTable {
 
   uint64_t Bytes() { return NumRows() * row_len_ * sizeof(float); }
 
+  // mem_rows, mem_bytes, spill_rows, spill_bytes(live), evicted, pageouts,
+  // pageins
+  void Stats2(uint64_t out[7]) {
+    uint64_t mem_rows = 0;
+    for (auto& s : shards_) {
+      std::lock_guard<std::mutex> lk(s.mu);
+      mem_rows += s.rows.size();
+    }
+    out[0] = mem_rows;
+    out[1] = mem_bytes_.load();
+    out[2] = spill_rows_.load();
+    out[3] = spill_rows_.load() * RecBytes();
+    out[4] = evicted_.load();
+    out[5] = pageouts_.load();
+    out[6] = pageins_.load();
+  }
+
   void Clear() {
     for (auto& s : shards_) {
       std::lock_guard<std::mutex> lk(s.mu);
       s.rows.clear();
+      s.spilled.clear();
+    }
+    mem_bytes_ = 0;
+    spill_rows_ = 0;
+    spill_garbage_ = 0;
+    if (spill_fd_ >= 0) {
+      if (ftruncate(spill_fd_, 0) != 0) { /* best effort */
+      }
+      spill_end_ = 0;
     }
   }
 
   // Binary dump: header (magic, dim, rule, row_len, count) then
-  // (id, row floats) records. The sparse analog of fleet.save_persistables.
+  // (id, row floats) records — resident AND spilled rows. The sparse
+  // analog of fleet.save_persistables.
   bool Save(const char* path) {
     FILE* f = fopen(path, "wb");
     if (!f) return false;
-    uint64_t magic = 0x70747370'61727365ULL;  // "ptspARSE"
+    uint64_t magic = 0x70747370'61727332ULL;  // v2 ("ptspars2"): meta rows
     uint64_t count = NumRows();
     uint64_t dim = cfg_.dim, rule = cfg_.rule, rl = row_len_;
     fwrite(&magic, 8, 1, f);
@@ -149,11 +281,25 @@ class SparseTable {
     fwrite(&rule, 8, 1, f);
     fwrite(&rl, 8, 1, f);
     fwrite(&count, 8, 1, f);
+    std::vector<float> tmp(row_len_);
     for (auto& s : shards_) {
       std::lock_guard<std::mutex> lk(s.mu);
       for (auto& kv : s.rows) {
         fwrite(&kv.first, 8, 1, f);
         fwrite(kv.second.data(), sizeof(float), row_len_, f);
+      }
+      for (auto& kv : s.spilled) {
+        if (pread(spill_fd_, tmp.data(), sizeof(float) * row_len_,
+                  static_cast<off_t>(kv.second + 8)) !=
+            static_cast<ssize_t>(sizeof(float) * row_len_)) {
+          // a checkpoint missing records under a count-N header would
+          // destroy the table it protects at Load() time: fail loudly
+          fclose(f);
+          ::unlink(path);
+          return false;
+        }
+        fwrite(&kv.first, 8, 1, f);
+        fwrite(tmp.data(), sizeof(float), row_len_, f);
       }
     }
     fclose(f);
@@ -167,25 +313,31 @@ class SparseTable {
     bool ok = fread(&magic, 8, 1, f) == 1 && fread(&dim, 8, 1, f) == 1 &&
               fread(&rule, 8, 1, f) == 1 && fread(&rl, 8, 1, f) == 1 &&
               fread(&count, 8, 1, f) == 1;
-    if (!ok || magic != 0x70747370'61727365ULL ||
-        dim != static_cast<uint64_t>(cfg_.dim) || rl != row_len_) {
+    bool v2 = magic == 0x70747370'61727332ULL;
+    bool v1 = magic == 0x70747370'61727365ULL;  // pre-meta format
+    uint64_t want_rl = v1 ? row_len_ - kMeta : row_len_;
+    if (!ok || (!v1 && !v2) || dim != static_cast<uint64_t>(cfg_.dim) ||
+        rl != want_rl) {
       fclose(f);
       return false;
     }
     Clear();
     for (uint64_t i = 0; i < count; ++i) {
       uint64_t id;
-      std::vector<float> row(row_len_);
+      std::vector<float> row(row_len_, 0.0f);
+      float* dst = v1 ? row.data() + kMeta : row.data();
       if (fread(&id, 8, 1, f) != 1 ||
-          fread(row.data(), sizeof(float), row_len_, f) != row_len_) {
+          fread(dst, sizeof(float), rl, f) != rl) {
         fclose(f);
         return false;
       }
       Shard& s = shard(id);
       std::lock_guard<std::mutex> lk(s.mu);
       s.rows[id] = std::move(row);
+      mem_bytes_ += kRowOverhead + row_len_ * sizeof(float);
     }
     fclose(f);
+    MaybePageOut();
     return true;
   }
 
@@ -196,6 +348,8 @@ class SparseTable {
   struct Shard {
     std::mutex mu;
     std::unordered_map<uint64_t, std::vector<float>> rows;
+    // id -> byte offset of its (id, row) record in the spill file
+    std::unordered_map<uint64_t, uint64_t> spilled;
   };
 
   Shard& shard(uint64_t id) {
@@ -204,16 +358,145 @@ class SparseTable {
     return shards_[(h >> 32) % kShards];
   }
 
+  size_t RecBytes() const { return 8 + sizeof(float) * row_len_; }
+
+  // The LRU tick lives in meta[0] as raw uint32 BITS (not a float value):
+  // a value-cast would round past 2^24 and make fresh rows look stale.
+  static void SetTick(float* meta, uint32_t t) { memcpy(meta, &t, 4); }
+  static uint32_t GetTick(const float* meta) {
+    uint32_t t;
+    memcpy(&t, meta, 4);
+    return t;
+  }
+
+  bool Doomed(const float* meta, uint32_t now, float threshold,
+              uint32_t max_unseen) const {
+    float score = cfg_.show_coeff * meta[1] + cfg_.click_coeff * meta[2];
+    // rows touched after `now` was snapshotted have a LATER tick: clamp to
+    // fresh instead of letting unsigned subtraction wrap to ~4e9
+    int64_t unseen = static_cast<int64_t>(now) -
+                     static_cast<int64_t>(GetTick(meta));
+    if (unseen < 0) unseen = 0;
+    return score < threshold ||
+           (max_unseen > 0 && unseen > static_cast<int64_t>(max_unseen));
+  }
+
+  // caller holds s.mu
   std::vector<float>& FindOrInit(Shard& s, uint64_t id) {
     auto it = s.rows.find(id);
     if (it != s.rows.end()) return it->second;
     std::vector<float> row(row_len_, 0.0f);
+    auto sp = s.spilled.find(id);
+    if (sp != s.spilled.end()) {
+      // page the cold row back in; its file record becomes garbage
+      if (pread(spill_fd_, row.data(), sizeof(float) * row_len_,
+                static_cast<off_t>(sp->second + 8)) ==
+          static_cast<ssize_t>(sizeof(float) * row_len_)) {
+        s.spilled.erase(sp);
+        --spill_rows_;
+        spill_garbage_ += RecBytes();
+        ++pageins_;
+        mem_bytes_ += kRowOverhead + row_len_ * sizeof(float);
+        return s.rows.emplace(id, std::move(row)).first->second;
+      }
+    }
     // deterministic per-id init -> pull order / restarts don't change values
     std::mt19937_64 gen(cfg_.seed ^ (id * 0xff51afd7ed558ccdULL));
     std::uniform_real_distribution<float> dist(-cfg_.init_range,
                                                cfg_.init_range);
-    for (int d = 0; d < cfg_.dim; ++d) row[d] = dist(gen);
+    for (int d = 0; d < cfg_.dim; ++d) row[kMeta + d] = dist(gen);
+    mem_bytes_ += kRowOverhead + row_len_ * sizeof(float);
     return s.rows.emplace(id, std::move(row)).first->second;
+  }
+
+  // Page least-recently-used rows out to the spill file until the resident
+  // tier is back under ~70% of the cap. LRU is per-shard (each shard
+  // evicts its own oldest rows) — the striping hash makes shard loads
+  // uniform, so this approximates global LRU without a global lock.
+  void MaybePageOut() {
+    if (spill_fd_ < 0 || mem_bytes_.load() <= cfg_.ram_cap_bytes) return;
+    std::lock_guard<std::mutex> pg(pageout_mu_);  // one pager at a time
+    uint64_t target = cfg_.ram_cap_bytes * 7 / 10;
+    if (mem_bytes_.load() <= cfg_.ram_cap_bytes) return;
+    size_t rec = RecBytes();
+    for (auto& s : shards_) {
+      if (mem_bytes_.load() <= target) break;
+      std::lock_guard<std::mutex> lk(s.mu);
+      std::vector<std::pair<uint32_t, uint64_t>> order;  // (tick, id)
+      order.reserve(s.rows.size());
+      for (auto& kv : s.rows)
+        order.emplace_back(GetTick(kv.second.data()), kv.first);
+      std::sort(order.begin(), order.end());
+      // never evict this shard entirely: hot rows would thrash
+      size_t cap = order.size() - std::min<size_t>(order.size(), 8);
+      for (size_t i = 0; i < cap && mem_bytes_.load() > target; ++i) {
+        uint64_t id = order[i].second;
+        auto it = s.rows.find(id);
+        if (it == s.rows.end()) continue;
+        uint64_t off = spill_end_.fetch_add(rec);
+        if (pwrite(spill_fd_, &id, 8, static_cast<off_t>(off)) != 8 ||
+            pwrite(spill_fd_, it->second.data(), sizeof(float) * row_len_,
+                   static_cast<off_t>(off + 8)) !=
+                static_cast<ssize_t>(sizeof(float) * row_len_)) {
+          spill_garbage_ += rec;  // failed write: burn the slot
+          continue;
+        }
+        s.spilled[id] = off;
+        ++spill_rows_;
+        s.rows.erase(it);
+        mem_bytes_ -= kRowOverhead + row_len_ * sizeof(float);
+      }
+    }
+    ++pageouts_;
+  }
+
+  // Rewrite the spill file without garbage records once garbage dominates
+  // (the role of RocksDB compaction in the reference's SSD table).
+  void MaybeCompact() {
+    if (spill_fd_ < 0) return;
+    uint64_t live = spill_rows_.load() * RecBytes();
+    if (spill_garbage_.load() < (1u << 20) ||
+        spill_garbage_.load() < live)
+      return;
+    std::lock_guard<std::mutex> pg(pageout_mu_);
+    std::string tmp_path = cfg_.spill_path + ".compact";
+    int nfd = ::open(tmp_path.c_str(), O_RDWR | O_CREAT | O_TRUNC, 0644);
+    if (nfd < 0) return;
+    // hold every shard lock so the index can be rewritten atomically;
+    // compaction is rare (garbage > live) and pagers also serialize on
+    // pageout_mu_, so this cannot deadlock with them
+    for (auto& s : shards_) s.mu.lock();
+    uint64_t off = 0;
+    size_t rec = RecBytes();
+    std::vector<char> buf(rec);
+    bool ok = true;
+    std::vector<std::pair<uint64_t*, uint64_t>> commits;  // (&slot, new_off)
+    for (auto& s : shards_) {
+      for (auto& kv : s.spilled) {
+        if (pread(spill_fd_, buf.data(), rec,
+                  static_cast<off_t>(kv.second)) !=
+                static_cast<ssize_t>(rec) ||
+            pwrite(nfd, buf.data(), rec, static_cast<off_t>(off)) !=
+                static_cast<ssize_t>(rec)) {
+          ok = false;
+          break;
+        }
+        commits.emplace_back(&kv.second, off);
+        off += rec;
+      }
+      if (!ok) break;
+    }
+    if (ok && ::rename(tmp_path.c_str(), cfg_.spill_path.c_str()) == 0) {
+      for (auto& c : commits) *c.first = c.second;
+      ::close(spill_fd_);
+      spill_fd_ = nfd;
+      spill_end_ = off;
+      spill_garbage_ = 0;
+    } else {
+      ::close(nfd);
+      ::unlink(tmp_path.c_str());
+    }
+    for (auto& s : shards_) s.mu.unlock();
   }
 
   void ApplyRule(float* row, const float* g, float lr) {
@@ -250,6 +533,16 @@ class SparseTable {
   TableConfig cfg_;
   uint64_t row_len_;
   Shard shards_[kShards];
+  int spill_fd_ = -1;
+  std::mutex pageout_mu_;
+  std::atomic<uint32_t> tick_{0};
+  std::atomic<uint64_t> mem_bytes_{0};
+  std::atomic<uint64_t> spill_rows_{0};
+  std::atomic<uint64_t> spill_end_{0};
+  std::atomic<uint64_t> spill_garbage_{0};
+  std::atomic<uint64_t> evicted_{0};
+  std::atomic<uint64_t> pageouts_{0};
+  std::atomic<uint64_t> pageins_{0};
 };
 
 // ------------------------------------------------------------------ server
@@ -386,6 +679,36 @@ class EmbServer {
         int64_t st = 0;
         return write_n(fd, &st, 8);
       }
+      case OP_SHOWCLICK: {
+        if (p.size() < 4) return false;
+        uint32_t n;
+        memcpy(&n, p.data(), 4);
+        if (p.size() != 4 + 16ULL * n) return false;
+        const uint64_t* ids = reinterpret_cast<const uint64_t*>(p.data() + 4);
+        const float* shows =
+            reinterpret_cast<const float*>(p.data() + 4 + 8ULL * n);
+        const float* clicks = shows + n;
+        table_.ShowClick(ids, n, shows, clicks);
+        int64_t st = 0;
+        return write_n(fd, &st, 8);
+      }
+      case OP_SHRINK: {
+        if (p.size() != 12) return false;
+        float threshold, decay;
+        uint32_t max_unseen;
+        memcpy(&threshold, p.data(), 4);
+        memcpy(&max_unseen, p.data() + 4, 4);
+        memcpy(&decay, p.data() + 8, 4);
+        uint64_t ev = table_.Shrink(threshold, max_unseen, decay);
+        int64_t len = 8;
+        return write_n(fd, &len, 8) && write_n(fd, &ev, 8);
+      }
+      case OP_STATS2: {
+        uint64_t st2[7];
+        table_.Stats2(st2);
+        int64_t len = sizeof(st2);
+        return write_n(fd, &len, 8) && write_n(fd, st2, sizeof(st2));
+      }
       default:
         return false;
     }
@@ -476,6 +799,45 @@ void* pt_emb_server_start(int port, int dim, int rule, float init_range,
   return s;
 }
 
+// Spill-enabled variant: rows page out to spill_path once the resident
+// tier exceeds ram_cap_bytes (0 disables); show/click coefficients weight
+// the accessor's eviction score.
+void* pt_emb_server_start2(int port, int dim, int rule, float init_range,
+                           long long seed, unsigned long long ram_cap_bytes,
+                           const char* spill_path, float show_coeff,
+                           float click_coeff) {
+  TableConfig cfg;
+  cfg.dim = dim;
+  cfg.rule = rule;
+  cfg.init_range = init_range;
+  cfg.seed = static_cast<uint64_t>(seed);
+  cfg.ram_cap_bytes = ram_cap_bytes;
+  if (spill_path) cfg.spill_path = spill_path;
+  cfg.show_coeff = show_coeff;
+  cfg.click_coeff = click_coeff;
+  auto* s = new EmbServer(port, cfg);
+  if (!s->ok()) {
+    delete s;
+    return nullptr;
+  }
+  return s;
+}
+
+// out7: mem_rows, mem_bytes, spill_rows, spill_bytes, evicted, pageouts,
+// pageins (server-handle shortcut)
+void pt_emb_server_stats2(void* h, unsigned long long out7[7]) {
+  uint64_t s[7];
+  static_cast<EmbServer*>(h)->table().Stats2(s);
+  for (int i = 0; i < 7; ++i) out7[i] = s[i];
+}
+
+long long pt_emb_server_shrink(void* h, float threshold,
+                               unsigned int max_unseen, float decay) {
+  return static_cast<long long>(
+      static_cast<EmbServer*>(h)->table().Shrink(threshold, max_unseen,
+                                                 decay));
+}
+
 int pt_emb_server_port(void* h) { return static_cast<EmbServer*>(h)->port(); }
 
 void pt_emb_server_stop(void* h) {
@@ -531,6 +893,36 @@ int pt_emb_push(void* h, const unsigned long long* ids, unsigned int n,
   int64_t r = static_cast<EmbClient*>(h)->Request(OP_PUSH, payload.data(),
                                                   payload.size(), nullptr, 0);
   return r == 0 ? 0 : -1;
+}
+
+int pt_emb_showclick(void* h, const unsigned long long* ids, unsigned int n,
+                     const float* shows, const float* clicks) {
+  std::vector<char> payload(4 + 16ULL * n);
+  memcpy(payload.data(), &n, 4);
+  memcpy(payload.data() + 4, ids, 8ULL * n);
+  memcpy(payload.data() + 4 + 8ULL * n, shows, 4ULL * n);
+  memcpy(payload.data() + 4 + 12ULL * n, clicks, 4ULL * n);
+  int64_t r = static_cast<EmbClient*>(h)->Request(
+      OP_SHOWCLICK, payload.data(), payload.size(), nullptr, 0);
+  return r == 0 ? 0 : -1;
+}
+
+long long pt_emb_shrink(void* h, float threshold, unsigned int max_unseen,
+                        float decay) {
+  char payload[12];
+  memcpy(payload, &threshold, 4);
+  memcpy(payload + 4, &max_unseen, 4);
+  memcpy(payload + 8, &decay, 4);
+  unsigned long long ev = 0;
+  int64_t r = static_cast<EmbClient*>(h)->Request(OP_SHRINK, payload, 12, &ev,
+                                                  8);
+  return r == 8 ? static_cast<long long>(ev) : -1;
+}
+
+int pt_emb_stats2(void* h, unsigned long long out7[7]) {
+  int64_t r =
+      static_cast<EmbClient*>(h)->Request(OP_STATS2, nullptr, 0, out7, 56);
+  return r == 56 ? 0 : -1;
 }
 
 int pt_emb_save(void* h, const char* path) {

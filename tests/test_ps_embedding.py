@@ -33,7 +33,8 @@ def test_pull_lazy_init_deterministic(cluster):
     assert np.abs(rows1).max() <= 0.01 + 1e-6
     assert not np.allclose(rows1[0], rows1[1])  # per-id streams differ
     rows, nbytes = c.stats()
-    assert rows == 4 and nbytes == 4 * 8 * 4
+    # row = 3 meta floats (tick/show/click) + 8 embedding floats
+    assert rows == 4 and nbytes == 4 * (3 + 8) * 4
     c.close()
 
 

@@ -1,0 +1,162 @@
+"""Beyond-RAM sparse table: spill tier, LRU page-out/page-in, CTR-accessor
+eviction (ref:paddle/fluid/distributed/ps/table/ssd_sparse_table.cc,
+ctr_accessor.cc)."""
+import os
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed import ps
+
+
+def _push_ids(client, ids, dim, lr=0.1):
+    grads = np.ones((len(ids), dim), np.float32)
+    client.push(ids, grads, lr)
+
+
+def test_spill_pageout_and_pagein_roundtrip(tmp_path):
+    dim = 16
+    # adagrad row = 3 meta + 16 emb + 16 acc = 35 floats = 140B (+64B est)
+    svc = ps.EmbeddingService(dim, num_shards=2, rule="adagrad",
+                              ram_cap_bytes=600_000,
+                              spill_dir=str(tmp_path))
+    try:
+        client = svc.client()
+        rng = np.random.default_rng(0)
+        n_ids = 20_000  # ~4MB of rows >> 600KB cap
+        all_ids = rng.choice(2**50, size=n_ids, replace=False).astype(np.uint64)
+        # push a known gradient so row values are deterministic: after one
+        # adagrad step w = init - lr*g/(sqrt(g^2)+eps) = init - lr*sign(g)
+        for i in range(0, n_ids, 2000):
+            _push_ids(client, all_ids[i:i + 2000], dim)
+        st = client.tier_stats()
+        assert st["spill_rows"] > 0, st            # spill engaged
+        assert st["pageouts"] > 0
+        assert st["mem_bytes"] <= 2 * 600_000, st  # resident tier bounded
+        assert st["mem_rows"] + st["spill_rows"] == n_ids
+        # spilled rows page back in with their trained values intact
+        probe = all_ids[:128]  # the earliest-pushed = most likely spilled
+        rows = client.pull(probe)
+        expect_delta = -0.1  # one adagrad step of the all-ones gradient
+        # re-derive init deterministically by pulling a FRESH id
+        st2 = client.tier_stats()
+        assert st2["pageins"] > 0, st2
+        assert np.all(np.abs(rows - expect_delta) < 0.02), rows[:2]
+        # save/load includes spilled rows
+        path = str(tmp_path / "ckpt")
+        client.save(path)
+        total_before = client.stats()[0]
+        client.clear()
+        assert client.stats()[0] == 0
+        client.load(path)
+        assert client.stats()[0] == total_before
+        rows2 = client.pull(probe)
+        assert np.allclose(rows, rows2, atol=1e-6)
+        client.close()
+    finally:
+        svc.stop()
+
+
+def test_ctr_accessor_shrink_evicts_cold_keeps_hot(tmp_path):
+    dim = 8
+    svc = ps.EmbeddingService(dim, num_shards=1, rule="sgd",
+                              show_coeff=0.25, click_coeff=1.0)
+    try:
+        client = svc.client()
+        hot = np.arange(100, dtype=np.uint64)
+        cold = np.arange(1000, 1100, dtype=np.uint64)
+        _push_ids(client, hot, dim)
+        _push_ids(client, cold, dim)
+        # hot ids get clicks; cold ids only the single push impression
+        client.show_click(hot, np.full(100, 5.0, np.float32),
+                          np.full(100, 2.0, np.float32))
+        # score(hot) = 0.25*(1+5) + 1.0*2 = 3.5; score(cold) = 0.25
+        evicted = client.shrink(threshold=1.0, decay=1.0)
+        assert evicted == 100, evicted
+        assert client.stats()[0] == 100
+        st = client.tier_stats()
+        assert st["evicted"] == 100
+        # decay drives even hot rows below threshold eventually
+        for _ in range(40):
+            ev = client.shrink(threshold=1.0, decay=0.7)
+            if client.stats()[0] == 0:
+                break
+        assert client.stats()[0] == 0
+        client.close()
+    finally:
+        svc.stop()
+
+
+def test_shrink_max_unseen_evicts_stale_spilled_rows(tmp_path):
+    dim = 8
+    svc = ps.EmbeddingService(dim, num_shards=1, rule="sgd",
+                              ram_cap_bytes=100_000,
+                              spill_dir=str(tmp_path))
+    try:
+        client = svc.client()
+        stale = np.arange(5000, dtype=np.uint64)
+        _push_ids(client, stale, dim)
+        # advance the access clock far past the stale rows
+        fresh = np.arange(10**6, 10**6 + 200, dtype=np.uint64)
+        for _ in range(50):
+            client.pull(fresh)
+        st = client.tier_stats()
+        assert st["spill_rows"] > 0
+        evicted = client.shrink(threshold=-1.0, max_unseen=40, decay=1.0)
+        assert evicted >= len(stale) * 0.9, (evicted, st)
+        st2 = client.tier_stats()
+        assert st2["spill_rows"] < st["spill_rows"]
+        client.close()
+    finally:
+        svc.stop()
+
+
+def test_v1_checkpoint_still_loads(tmp_path):
+    # a server without spill saves v2 now; ensure fresh-format roundtrip
+    # across differently-configured servers (spill <-> no spill)
+    dim = 8
+    svc1 = ps.EmbeddingService(dim, num_shards=1, rule="sgd")
+    c1 = svc1.client()
+    ids = np.arange(5000, dtype=np.uint64)
+    _push_ids(c1, ids, dim)
+    vals = c1.pull(ids)
+    c1.save(str(tmp_path / "t"))
+    c1.close()
+    svc1.stop()
+
+    svc2 = ps.EmbeddingService(dim, num_shards=1, rule="sgd",
+                               ram_cap_bytes=10_000,
+                               spill_dir=str(tmp_path))
+    c2 = svc2.client()
+    c2.load(str(tmp_path / "t"))
+    assert c2.stats()[0] == 5000
+    st = c2.tier_stats()
+    assert st["spill_rows"] > 0  # load respects the RAM cap by paging out
+    assert np.allclose(c2.pull(ids), vals, atol=1e-6)
+    c2.close()
+    svc2.stop()
+
+
+def test_spill_path_without_cap_rejected():
+    with pytest.raises(ValueError, match="ram_cap_bytes"):
+        ps.EmbeddingServer(8, spill_path="/tmp/x.spill")
+    with pytest.raises(ValueError, match="spill_path"):
+        ps.EmbeddingServer(8, ram_cap_bytes=1000)
+
+
+def test_shrink_concurrent_tick_no_underflow(tmp_path):
+    # rows accessed AFTER shrink snapshots its clock must not be evicted
+    # as "maximally stale" (uint32 wraparound guard)
+    dim = 8
+    svc = ps.EmbeddingService(dim, num_shards=1, rule="sgd")
+    try:
+        client = svc.client()
+        ids = np.arange(200, dtype=np.uint64)
+        _push_ids(client, ids, dim)
+        # freshly-touched rows, tiny max_unseen: nothing should be evicted
+        client.pull(ids)
+        ev = client.shrink(threshold=-1.0, max_unseen=1000, decay=1.0)
+        assert ev == 0, ev
+        client.close()
+    finally:
+        svc.stop()
