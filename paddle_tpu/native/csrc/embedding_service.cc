@@ -123,8 +123,13 @@ class SparseTable {
     if (cfg_.ram_cap_bytes > 0 && !cfg_.spill_path.empty()) {
       spill_fd_ = ::open(cfg_.spill_path.c_str(), O_RDWR | O_CREAT | O_TRUNC,
                          0644);
+      // a server that silently can't spill would grow until the host OOMs
+      // — exactly the failure the cap exists to prevent
+      spill_broken_ = spill_fd_ < 0;
     }
   }
+
+  bool ok() const { return !spill_broken_; }
 
   ~SparseTable() {
     if (spill_fd_ >= 0) ::close(spill_fd_);
@@ -534,6 +539,7 @@ class SparseTable {
   uint64_t row_len_;
   Shard shards_[kShards];
   int spill_fd_ = -1;
+  bool spill_broken_ = false;
   std::mutex pageout_mu_;
   std::atomic<uint32_t> tick_{0};
   std::atomic<uint64_t> mem_bytes_{0};
@@ -596,7 +602,7 @@ class EmbServer {
   }
 
   int port() const { return port_; }
-  bool ok() const { return listen_fd_ >= 0; }
+  bool ok() const { return listen_fd_ >= 0 && table_.ok(); }
   SparseTable& table() { return table_; }
 
  private:

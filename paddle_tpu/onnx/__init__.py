@@ -1,26 +1,87 @@
-"""paddle.onnx (ref:python/paddle/onnx/export.py wrapping paddle2onnx).
+"""paddle.onnx (ref:python/paddle/onnx/export.py, which wraps the external
+paddle2onnx converter).
 
-This stack's portable serialization is StableHLO (jit.save) — the
-MLIR-standard exchange format for XLA-compiled models. ``export`` writes
-that artifact; true ONNX emission would need the onnx package + a
-StableHLO->ONNX converter, neither of which ships in this environment.
+Native ONNX emission: the layer's forward is traced to a jaxpr (the same
+trace jit compiles) and converted op-by-op to an ONNX GraphProto — see
+``exporter.py`` for the primitive coverage and ``onnx_ir.proto`` for the
+vendored schema subset. Parameters are baked as initializers; the file is
+standard ONNX readable by onnxruntime / netron.
+
+Dynamic dims in the input_spec (None/-1) are traced at size 1 and export
+as static dims — re-export at the serving shape, or use jit.save's
+StableHLO artifact for genuinely dynamic batch.
 """
 from __future__ import annotations
 
+import numpy as np
 
-def export(layer, path, input_spec=None, opset_version=9, **configs):
-    """Export ``layer`` as a deployable artifact.
+__all__ = ["export"]
 
-    Writes the StableHLO program + weights via jit.save at ``path`` and
-    raises afterwards if a real .onnx file was expected (the reference
-    depends on the external paddle2onnx package)."""
-    from ..jit import save as jit_save
 
-    jit_save(layer, path, input_spec=input_spec)
-    import warnings
+def export(layer, path, input_spec=None, opset_version=17, **configs):
+    """Export ``layer`` to ``{path}.onnx`` (ref onnx/export.py signature).
 
-    warnings.warn(
-        "paddle.onnx.export wrote a StableHLO artifact (the portable format "
-        "of this stack); ONNX emission needs paddle2onnx which is not "
-        "available here", stacklevel=2)
-    return path
+    input_spec: list of InputSpec / Tensors / arrays describing the
+    forward's inputs. Returns the written path.
+    """
+    import jax
+
+    from ..core import rng
+    from ..core.tensor import Tensor
+    from ..jit import InputSpec, _swap_data
+    from .exporter import to_onnx_model
+
+    if input_spec is None:
+        raise ValueError("paddle.onnx.export requires input_spec")
+    if opset_version < 13:
+        raise ValueError("opset_version >= 13 required (Squeeze/ReduceSum "
+                         "axes-as-input forms)")
+
+    was_training = getattr(layer, "training", False)
+    if hasattr(layer, "eval"):
+        layer.eval()
+    try:
+        params, buffers = layer.functional_state()
+        objs = list(params.values()) + list(buffers.values())
+        arrays = [p._data for p in objs]
+
+        # the key is created OUTSIDE the trace: inside, jax.random.key()
+        # would add key-creation primitives even when nothing draws
+        base_key = jax.random.key(0)
+
+        def fwd(*inputs):
+            # params are closed over -> jaxpr consts -> ONNX initializers
+            with _swap_data(objs, list(arrays)):
+                with rng.key_guard(base_key):
+                    out = layer(*[Tensor(i) for i in inputs])
+            if isinstance(out, (tuple, list)):
+                return [o._data if isinstance(o, Tensor) else o for o in out]
+            return out._data if isinstance(out, Tensor) else out
+
+        example = []
+        for s in input_spec:
+            if isinstance(s, InputSpec):
+                shape = tuple(1 if (d is None or d == -1) else int(d)
+                              for d in s.shape)
+                dt = np.dtype(str(s.dtype).replace("paddle.", ""))
+                example.append(jax.ShapeDtypeStruct(shape, dt))
+            elif isinstance(s, Tensor):
+                example.append(
+                    jax.ShapeDtypeStruct(tuple(s._data.shape), s._data.dtype))
+            else:
+                arr = np.asarray(s)
+                example.append(jax.ShapeDtypeStruct(arr.shape, arr.dtype))
+
+        model = to_onnx_model(fwd, tuple(example),
+                              graph_name=type(layer).__name__,
+                              opset_version=opset_version)
+        out_path = path if path.endswith(".onnx") else path + ".onnx"
+        import os
+
+        os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
+        with open(out_path, "wb") as f:
+            f.write(model.SerializeToString())
+        return out_path
+    finally:
+        if was_training:
+            layer.train()

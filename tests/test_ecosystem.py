@@ -184,16 +184,16 @@ def test_onnx_export_writes_artifact(tmp_path):
 
     net = nn.Linear(4, 2)
     net.eval()
-    with pytest.warns(UserWarning, match="StableHLO"):
-        paddle.onnx.export(net, str(tmp_path / "m"),
-                           input_spec=[InputSpec([None, 4], "float32")])
-    from paddle_tpu import jit
+    path = paddle.onnx.export(net, str(tmp_path / "m"),
+                              input_spec=[InputSpec([None, 4], "float32")])
+    assert path.endswith(".onnx")
+    from paddle_tpu.onnx import onnx_ir_pb2 as P
 
-    loaded = jit.load(str(tmp_path / "m"))
-    x = _t(RNG.rand(3, 4).astype(np.float32))
-    out = loaded(x)
-    out = out[0] if isinstance(out, (list, tuple)) else out
-    np.testing.assert_allclose(out.numpy(), net(x).numpy(), rtol=1e-5)
+    m = P.ModelProto()
+    m.ParseFromString(open(path, "rb").read())
+    assert m.graph.node and m.graph.initializer
+    # dynamic batch traces at 1 (documented); weights baked as initializers
+    assert m.graph.input[0].type.tensor_type.shape.dim[0].dim_value == 1
 
 
 # ------------------------------------------- amp.debugging / device / utils

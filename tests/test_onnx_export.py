@@ -1,0 +1,245 @@
+"""paddle.onnx.export emits real, numerically-correct ONNX
+(ref:python/paddle/onnx/export.py). Since onnxruntime isn't in this
+environment, a minimal numpy interpreter of the emitted op set executes
+the graph and the result is compared against the framework forward."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.jit import InputSpec
+from paddle_tpu.onnx import onnx_ir_pb2 as P
+
+_NP_DTYPES = {
+    P.TensorProto.FLOAT: np.float32, P.TensorProto.DOUBLE: np.float64,
+    P.TensorProto.INT32: np.int32, P.TensorProto.INT64: np.int64,
+    P.TensorProto.BOOL: np.bool_, P.TensorProto.INT8: np.int8,
+    P.TensorProto.UINT8: np.uint8,
+}
+
+
+def _tensor_to_np(t):
+    dt = _NP_DTYPES[t.data_type]
+    if t.raw_data:
+        arr = np.frombuffer(t.raw_data, np.uint8 if dt == np.bool_ else dt)
+        if dt == np.bool_:
+            arr = arr.astype(np.bool_)
+        return arr.reshape(list(t.dims)).copy()
+    raise AssertionError("only raw_data initializers are emitted")
+
+
+def _attrs(node):
+    out = {}
+    for a in node.attribute:
+        if a.type == P.AttributeProto.INT:
+            out[a.name] = a.i
+        elif a.type == P.AttributeProto.FLOAT:
+            out[a.name] = a.f
+        elif a.type == P.AttributeProto.STRING:
+            out[a.name] = a.s.decode()
+        elif a.type == P.AttributeProto.INTS:
+            out[a.name] = list(a.ints)
+        elif a.type == P.AttributeProto.FLOATS:
+            out[a.name] = list(a.floats)
+    return out
+
+
+def _conv(x, w, at):
+    import jax.lax as lax
+
+    pads = at.get("pads", [0] * (2 * (x.ndim - 2)))
+    nd = x.ndim - 2
+    pad_pairs = list(zip(pads[:nd], pads[nd:]))
+    return np.asarray(lax.conv_general_dilated(
+        x, w, window_strides=at.get("strides", [1] * nd),
+        padding=pad_pairs, rhs_dilation=at.get("dilations", [1] * nd),
+        feature_group_count=at.get("group", 1)))
+
+
+def _pool(x, at, reduce_max=True):
+    import jax.lax as lax
+
+    k = at["kernel_shape"]
+    s = at.get("strides", [1] * len(k))
+    nd = len(k)
+    pads = at.get("pads", [0] * (2 * nd))
+    pad_pairs = [(0, 0), (0, 0)] + list(zip(pads[:nd], pads[nd:]))
+    wd = (1, 1) + tuple(k)
+    ws = (1, 1) + tuple(s)
+    if reduce_max:
+        return np.asarray(lax.reduce_window(
+            x, -np.inf, lax.max, wd, ws, pad_pairs))
+    total = np.asarray(lax.reduce_window(x, 0.0, lax.add, wd, ws, pad_pairs))
+    return total / float(np.prod(k))
+
+
+def run_onnx(model: "P.ModelProto", feeds: dict):
+    env = dict(feeds)
+    for init in model.graph.initializer:
+        env[init.name] = _tensor_to_np(init)
+    for node in model.graph.node:
+        i = [env[n] for n in node.input]
+        at = _attrs(node)
+        op = node.op_type
+        if op == "Add":
+            out = i[0] + i[1]
+        elif op == "Sub":
+            out = i[0] - i[1]
+        elif op == "Mul":
+            out = i[0] * i[1]
+        elif op == "Div":
+            out = i[0] / i[1]
+        elif op == "Max":
+            out = np.maximum(i[0], i[1])
+        elif op == "Min":
+            out = np.minimum(i[0], i[1])
+        elif op == "Pow":
+            out = np.power(i[0], i[1])
+        elif op == "Exp":
+            out = np.exp(i[0])
+        elif op == "Log":
+            out = np.log(i[0])
+        elif op == "Tanh":
+            out = np.tanh(i[0])
+        elif op == "Sigmoid":
+            out = 1.0 / (1.0 + np.exp(-i[0]))
+        elif op == "Sqrt":
+            out = np.sqrt(i[0])
+        elif op == "Erf":
+            from scipy.special import erf
+
+            out = erf(i[0]).astype(i[0].dtype)
+        elif op == "Neg":
+            out = -i[0]
+        elif op == "Reciprocal":
+            out = 1.0 / i[0]
+        elif op == "Where":
+            out = np.where(i[0], i[1], i[2])
+        elif op == "Greater":
+            out = i[0] > i[1]
+        elif op == "GreaterOrEqual":
+            out = i[0] >= i[1]
+        elif op == "Less":
+            out = i[0] < i[1]
+        elif op == "LessOrEqual":
+            out = i[0] <= i[1]
+        elif op == "Equal":
+            out = i[0] == i[1]
+        elif op == "Cast":
+            out = i[0].astype(_NP_DTYPES[at["to"]])
+        elif op == "Reshape":
+            out = i[0].reshape(list(i[1]))
+        elif op == "Expand":
+            out = np.broadcast_to(i[0], list(i[1])).copy()
+        elif op == "Transpose":
+            out = np.transpose(i[0], at["perm"])
+        elif op == "Squeeze":
+            out = np.squeeze(i[0], tuple(int(a) for a in i[1]))
+        elif op == "Unsqueeze":
+            out = np.expand_dims(i[0], tuple(int(a) for a in i[1]))
+        elif op == "Concat":
+            out = np.concatenate(i, axis=at["axis"])
+        elif op == "Slice":
+            starts, ends, axes = i[1], i[2], i[3]
+            steps = i[4] if len(i) > 4 else np.ones_like(starts)
+            sl = [slice(None)] * i[0].ndim
+            for st, en, ax, sp in zip(starts, ends, axes, steps):
+                sl[int(ax)] = slice(int(st), None if en < -2**62 else int(en),
+                                    int(sp))
+            out = i[0][tuple(sl)]
+        elif op == "Gather":
+            out = np.take(i[0], i[1].astype(np.int64), axis=at.get("axis", 0))
+        elif op == "Einsum":
+            out = np.einsum(at["equation"], *i)
+        elif op == "Conv":
+            out = _conv(i[0], i[1], at)
+        elif op == "MaxPool":
+            out = _pool(i[0], at, reduce_max=True)
+        elif op == "AveragePool":
+            out = _pool(i[0], at, reduce_max=False)
+        elif op in ("ReduceSum", "ReduceMax", "ReduceMin", "ReduceProd"):
+            fn = {"ReduceSum": np.sum, "ReduceMax": np.max,
+                  "ReduceMin": np.min, "ReduceProd": np.prod}[op]
+            # opset-13 contract: ReduceSum takes axes as input[1]; the other
+            # Reduce* ops take the axes attribute (input form is opset 18+)
+            if op == "ReduceSum":
+                assert len(i) == 2, "ReduceSum must carry axes as an input"
+                axes = tuple(int(a) for a in i[1])
+            else:
+                assert len(i) == 1, f"{op} axes-as-input needs opset 18"
+                axes = tuple(int(a) for a in at["axes"])
+            out = fn(i[0], axis=axes, keepdims=bool(at.get("keepdims", 1)))
+        else:
+            raise AssertionError(f"test interpreter: unknown op {op}")
+        env[node.output[0]] = np.asarray(out)
+    return [env[o.name] for o in model.graph.output]
+
+
+def _export_and_check(layer, specs, feeds, atol=1e-5):
+    import tempfile
+
+    layer.eval()
+    ref = layer(*[paddle.to_tensor(f) for f in feeds])
+    with tempfile.TemporaryDirectory() as td:
+        path = paddle.onnx.export(layer, f"{td}/m", input_spec=specs)
+        m = P.ModelProto()
+        m.ParseFromString(open(path, "rb").read())
+    assert m.ir_version == 8 and m.opset_import[0].version == 17
+    outs = run_onnx(m, {v.name: f for v, f in zip(m.graph.input, feeds)})
+    np.testing.assert_allclose(outs[0], ref.numpy(), atol=atol, rtol=1e-4)
+    return m
+
+
+def test_onnx_mlp_numerics():
+    class MLP(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(16, 32)
+            self.fc2 = nn.Linear(32, 4)
+            self.bn = nn.BatchNorm1D(32)
+
+        def forward(self, x):
+            h = paddle.nn.functional.relu(self.bn(self.fc1(x)))
+            return paddle.nn.functional.softmax(self.fc2(h))
+
+    x = np.random.default_rng(0).standard_normal((4, 16)).astype(np.float32)
+    m = _export_and_check(MLP(), [InputSpec([4, 16])], [x])
+    assert any(n.op_type == "Einsum" for n in m.graph.node)
+
+
+def test_onnx_lenet_numerics():
+    from paddle_tpu.vision.models import LeNet
+
+    x = np.random.default_rng(1).standard_normal(
+        (2, 1, 28, 28)).astype(np.float32)
+    m = _export_and_check(LeNet(), [InputSpec([2, 1, 28, 28])], [x],
+                          atol=1e-4)
+    ops = {n.op_type for n in m.graph.node}
+    assert "Conv" in ops and "MaxPool" in ops
+
+
+def test_onnx_gpt_numerics():
+    from paddle_tpu.models.gpt import GPTForCausalLM, gpt_tiny
+
+    model = GPTForCausalLM(gpt_tiny())
+    ids = np.random.default_rng(2).integers(0, 1024, (1, 8)).astype(np.int32)
+    m = _export_and_check(model, [InputSpec([1, 8], dtype="int32")], [ids],
+                          atol=2e-4)
+    ops = {n.op_type for n in m.graph.node}
+    assert "Gather" in ops and "Tanh" in ops  # embedding + gelu
+
+
+def test_onnx_export_validations(tmp_path):
+    lin = nn.Linear(4, 2)
+    with pytest.raises(ValueError, match="input_spec"):
+        paddle.onnx.export(lin, str(tmp_path / "x"))
+    with pytest.raises(ValueError, match="opset"):
+        paddle.onnx.export(lin, str(tmp_path / "x"),
+                           input_spec=[InputSpec([1, 4])], opset_version=9)
+    # unsupported primitives must raise, not write a broken file
+    from paddle_tpu.onnx.exporter import UnsupportedOp, to_onnx_model
+    import jax.numpy as jnp
+
+    with pytest.raises(UnsupportedOp, match="sort"):
+        to_onnx_model(lambda a: jnp.sort(a),
+                      (np.zeros((4,), np.float32),))

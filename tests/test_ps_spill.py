@@ -111,9 +111,8 @@ def test_shrink_max_unseen_evicts_stale_spilled_rows(tmp_path):
         svc.stop()
 
 
-def test_v1_checkpoint_still_loads(tmp_path):
-    # a server without spill saves v2 now; ensure fresh-format roundtrip
-    # across differently-configured servers (spill <-> no spill)
+def test_checkpoint_roundtrip_across_spill_configs(tmp_path):
+    # v2 roundtrip across differently-configured servers (no spill -> spill)
     dim = 8
     svc1 = ps.EmbeddingService(dim, num_shards=1, rule="sgd")
     c1 = svc1.client()
@@ -135,6 +134,38 @@ def test_v1_checkpoint_still_loads(tmp_path):
     assert np.allclose(c2.pull(ids), vals, atol=1e-6)
     c2.close()
     svc2.stop()
+
+
+def test_v1_pre_meta_checkpoint_loads(tmp_path):
+    """Hand-written v1-format file (pre-meta rows, old magic): the
+    back-compat Load branch must place values at the post-meta offset."""
+    import struct
+
+    dim = 4
+    n = 10
+    path = str(tmp_path / "old.ckpt.shard0")
+    rows = np.arange(n * dim, dtype=np.float32).reshape(n, dim)
+    with open(path, "wb") as f:
+        f.write(struct.pack("<QQQQQ", 0x70747370_61727365, dim, 0, dim, n))
+        for i in range(n):
+            f.write(struct.pack("<Q", i))
+            f.write(rows[i].tobytes())
+    svc = ps.EmbeddingService(dim, num_shards=1, rule="sgd")
+    try:
+        c = svc.client()
+        c.load(str(tmp_path / "old.ckpt"))
+        assert c.stats()[0] == n
+        got = c.pull(np.arange(n, dtype=np.uint64))
+        assert np.allclose(got, rows, atol=1e-6), got
+        c.close()
+    finally:
+        svc.stop()
+
+
+def test_spill_open_failure_fails_server_start(tmp_path):
+    with pytest.raises(RuntimeError, match="failed to start"):
+        ps.EmbeddingServer(8, ram_cap_bytes=1000,
+                           spill_path=str(tmp_path / "no_dir" / "x.spill"))
 
 
 def test_spill_path_without_cap_rejected():
