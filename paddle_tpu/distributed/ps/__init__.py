@@ -118,7 +118,47 @@ class EmbeddingServer:
             pass
 
 
-class SparseTableClient:
+class _ShardedClient:
+    """Connection pool + id->server routing shared by the table clients.
+
+    The splitmix routing hash MUST be identical across client kinds: the
+    graph table's co-location contract (a node's feature row and its
+    adjacency on the same server) holds exactly because SparseTableClient
+    and GraphTableClient route through this one function.
+    """
+
+    def __init__(self, endpoints: Sequence[str], timeout_ms: int = 10000):
+        self._lib = _lib()
+        self.endpoints = list(endpoints)
+        self._conns = []
+        for ep in self.endpoints:
+            host, port = ep.rsplit(":", 1)
+            h = self._lib.pt_emb_connect(host.encode(), int(port), timeout_ms)
+            if not h:
+                raise RuntimeError(f"cannot connect to table server {ep}")
+            self._conns.append(h)
+
+    def _route(self, ids: np.ndarray) -> np.ndarray:
+        # splitmix scramble so server load is even for clustered ids
+        h = (ids.astype(np.uint64) * np.uint64(0x9E3779B97F4A7C15)) \
+            >> np.uint64(33)
+        return (h % np.uint64(len(self._conns))).astype(np.int64)
+
+    def _per_shard(self, ids: np.ndarray):
+        """Yield (shard_idx, conn, positions, contiguous id slice)."""
+        shard = self._route(ids)
+        for s, conn in enumerate(self._conns):
+            sel = np.nonzero(shard == s)[0]
+            if len(sel):
+                yield s, conn, sel, np.ascontiguousarray(ids[sel])
+
+    def close(self):
+        for conn in self._conns:
+            self._lib.pt_emb_disconnect(conn)
+        self._conns = []
+
+
+class SparseTableClient(_ShardedClient):
     """Sharded client: routes each feature id to ``endpoints[hash % n]``.
 
     The pull path dedups ids first (the PS client's unique-key merge in the
@@ -127,33 +167,15 @@ class SparseTableClient:
     """
 
     def __init__(self, endpoints: Sequence[str], dim: int, timeout_ms: int = 10000):
-        self._lib = _lib()
+        super().__init__(endpoints, timeout_ms)
         self.dim = dim
-        self.endpoints = list(endpoints)
-        self._conns = []
-        for ep in self.endpoints:
-            host, port = ep.rsplit(":", 1)
-            h = self._lib.pt_emb_connect(host.encode(), int(port), timeout_ms)
-            if not h:
-                raise RuntimeError(f"cannot connect to embedding server {ep}")
-            self._conns.append(h)
-
-    def _route(self, ids: np.ndarray) -> np.ndarray:
-        # splitmix scramble so server load is even for clustered ids
-        h = (ids.astype(np.uint64) * np.uint64(0x9E3779B97F4A7C15)) >> np.uint64(33)
-        return (h % np.uint64(len(self._conns))).astype(np.int64)
 
     def pull(self, ids: np.ndarray) -> np.ndarray:
         """ids [n] uint64 -> rows [n, dim] float32 (lazy-initialized)."""
         ids = np.ascontiguousarray(ids, dtype=np.uint64)
         n = len(ids)
         out = np.empty((n, self.dim), np.float32)
-        shard = self._route(ids)
-        for s, conn in enumerate(self._conns):
-            sel = np.nonzero(shard == s)[0]
-            if not len(sel):
-                continue
-            sub = np.ascontiguousarray(ids[sel])
+        for s, conn, sel, sub in self._per_shard(ids):
             rows = np.empty((len(sel), self.dim), np.float32)
             rc = self._lib.pt_emb_pull(
                 conn, sub.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
@@ -168,12 +190,7 @@ class SparseTableClient:
         """Apply the server-side optimizer rule for each (id, grad) row."""
         ids = np.ascontiguousarray(ids, dtype=np.uint64)
         grads = np.ascontiguousarray(grads, dtype=np.float32)
-        shard = self._route(ids)
-        for s, conn in enumerate(self._conns):
-            sel = np.nonzero(shard == s)[0]
-            if not len(sel):
-                continue
-            sub = np.ascontiguousarray(ids[sel])
+        for s, conn, sel, sub in self._per_shard(ids):
             g = np.ascontiguousarray(grads[sel])
             rc = self._lib.pt_emb_push(
                 conn, sub.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
@@ -224,12 +241,7 @@ class SparseTableClient:
         ids = np.ascontiguousarray(ids, dtype=np.uint64)
         shows = np.ascontiguousarray(shows, dtype=np.float32)
         clicks = np.ascontiguousarray(clicks, dtype=np.float32)
-        shard = self._route(ids)
-        for s, conn in enumerate(self._conns):
-            sel = np.nonzero(shard == s)[0]
-            if not len(sel):
-                continue
-            sub = np.ascontiguousarray(ids[sel])
+        for s, conn, sel, sub in self._per_shard(ids):
             sh = np.ascontiguousarray(shows[sel])
             ck = np.ascontiguousarray(clicks[sel])
             rc = self._lib.pt_emb_showclick(
@@ -254,11 +266,6 @@ class SparseTableClient:
     def clear(self):
         for conn in self._conns:
             self._lib.pt_emb_clear(conn)
-
-    def close(self):
-        for conn in self._conns:
-            self._lib.pt_emb_disconnect(conn)
-        self._conns = []
 
 
 class _PullPush(PyLayer):
@@ -317,6 +324,92 @@ def _mark_diff(ids: Tensor) -> Tensor:
     return t
 
 
+class GraphTableClient(_ShardedClient):
+    """Distributed graph storage + server-side neighbor sampling
+    (ref:paddle/fluid/distributed/ps/table/common_graph_table.cc role).
+
+    Edges are sharded by SOURCE node hash across the same servers that
+    host embedding rows (same _ShardedClient routing), so a GNN layer's
+    feature pull and neighbor sample for a node batch hit the same shard.
+    Sampling is uniform without replacement, deterministic per
+    (seed, node).
+    """
+
+    def add_edges(self, src: np.ndarray, dst: np.ndarray):
+        """Directed edges src->dst (call twice swapped for undirected)."""
+        src = np.ascontiguousarray(src, dtype=np.uint64)
+        dst = np.ascontiguousarray(dst, dtype=np.uint64)
+        for s, conn, sel, a in self._per_shard(src):
+            b = np.ascontiguousarray(dst[sel])
+            rc = self._lib.pt_graph_add_edges(
+                conn, a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+                b.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)), len(sel))
+            if rc != 0:
+                raise RuntimeError(f"add_edges failed on shard {s}")
+
+    def sample_neighbors(self, nodes: np.ndarray, sample_size: int = -1,
+                         seed: int = 0):
+        """(neighbors flat uint64, counts int32) in input-node order — the
+        paddle.geometric.sample_neighbors return convention, so the result
+        feeds reindex_graph directly."""
+        nodes = np.ascontiguousarray(nodes, dtype=np.uint64)
+        n = len(nodes)
+        counts = np.zeros(n, np.uint32)
+        chunks = [None] * n
+        for s, conn, sel, sub in self._per_shard(nodes):
+            cap = (len(sel) * sample_size if sample_size >= 0
+                   else max(int(self.degrees(sub).sum()), 64))
+            cnt = np.zeros(len(sel), np.uint32)
+            # the degree-derived capacity can be stale if edges land
+            # concurrently; grow and retry instead of failing the sample
+            for _ in range(8):
+                nbr = np.zeros(max(cap, 1), np.uint64)
+                total = self._lib.pt_graph_sample(
+                    conn, sub.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+                    len(sel), sample_size, seed,
+                    cnt.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+                    nbr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+                    len(nbr))
+                if total >= 0:
+                    break
+                cap *= 2
+            if total < 0:
+                raise RuntimeError(f"sample failed on shard {s}")
+            counts[sel] = cnt
+            off = 0
+            for j, idx in enumerate(sel):
+                chunks[idx] = nbr[off:off + cnt[j]].copy()
+                off += cnt[j]
+        flat = (np.concatenate([c for c in chunks if c is not None])
+                if counts.sum() else np.zeros(0, np.uint64))
+        return flat, counts.astype(np.int32)
+
+    def degrees(self, nodes: np.ndarray) -> np.ndarray:
+        nodes = np.ascontiguousarray(nodes, dtype=np.uint64)
+        out = np.zeros(len(nodes), np.uint64)
+        for s, conn, sel, sub in self._per_shard(nodes):
+            deg = np.zeros(len(sel), np.uint64)
+            rc = self._lib.pt_graph_degrees(
+                conn, sub.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+                len(sel),
+                deg.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)))
+            if rc != 0:
+                raise RuntimeError(f"degrees failed on shard {s}")
+            out[sel] = deg
+        return out
+
+    def stats(self):
+        """(num_nodes, num_edges) aggregated over shards."""
+        nodes = edges = 0
+        buf = (ctypes.c_uint64 * 2)()
+        for i, conn in enumerate(self._conns):
+            if self._lib.pt_graph_stats(conn, buf) != 0:
+                raise RuntimeError(f"graph stats failed on shard {i}")
+            nodes += buf[0]
+            edges += buf[1]
+        return nodes, edges
+
+
 # ---------------------------------------------------------------- orchestration
 
 
@@ -344,6 +437,11 @@ class EmbeddingService:
 
     def client(self) -> SparseTableClient:
         return SparseTableClient(self.endpoints, self.dim)
+
+    def graph_client(self) -> GraphTableClient:
+        """Client for the servers' graph tables (every embedding server
+        also hosts a graph table; see GraphTableClient)."""
+        return GraphTableClient(self.endpoints)
 
     def stop(self):
         for s in self.servers:

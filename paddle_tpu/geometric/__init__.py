@@ -21,7 +21,7 @@ from ..incubate import (  # noqa: F401  (shared implementations)
 __all__ = ["send_u_recv", "send_ue_recv", "send_uv", "segment_sum",
            "segment_mean", "segment_min", "segment_max", "reindex_graph",
            "reindex_heter_graph", "sample_neighbors",
-           "weighted_sample_neighbors"]
+           "weighted_sample_neighbors", "distributed_sample_neighbors"]
 
 _MSG_OPS = {
     "add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
@@ -178,3 +178,18 @@ def _sample_with_eids(row, colptr, input_nodes, sample_size, eids, weights,
     if return_eids:
         res.append(Tensor(jnp.asarray(eout)))
     return tuple(res)
+
+
+def distributed_sample_neighbors(graph_client, input_nodes, sample_size=-1,
+                                 seed=0):
+    """Neighbor sampling against a PS-hosted graph table
+    (ref:paddle/fluid/distributed/ps/table/common_graph_table.cc role):
+    the adjacency lives sharded on the embedding servers and sampling runs
+    server-side, so graphs scale past one host's RAM. Returns
+    (neighbors, count) Tensors in the sample_neighbors convention — feed
+    them to reindex_graph like the in-memory sampler's output."""
+    nodes = np.asarray(input_nodes._data if isinstance(input_nodes, Tensor)
+                       else input_nodes)
+    flat, counts = graph_client.sample_neighbors(nodes, sample_size, seed)
+    return (Tensor(jnp.asarray(flat.astype(np.int64))),
+            Tensor(jnp.asarray(counts.astype(np.int64))))

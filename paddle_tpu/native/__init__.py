@@ -137,6 +137,16 @@ def _declare(lib):
     lib.pt_emb_shrink.argtypes = [c.c_void_p, c.c_float, c.c_uint, c.c_float]
     lib.pt_emb_stats2.restype = c.c_int
     lib.pt_emb_stats2.argtypes = [c.c_void_p, u64p]
+    u32p = c.POINTER(c.c_uint32)
+    lib.pt_graph_add_edges.restype = c.c_int
+    lib.pt_graph_add_edges.argtypes = [c.c_void_p, u64p, u64p, c.c_uint]
+    lib.pt_graph_sample.restype = c.c_longlong
+    lib.pt_graph_sample.argtypes = [c.c_void_p, u64p, c.c_uint, c.c_int,
+                                    c.c_ulonglong, u32p, u64p, c.c_ulonglong]
+    lib.pt_graph_degrees.restype = c.c_int
+    lib.pt_graph_degrees.argtypes = [c.c_void_p, u64p, c.c_uint, u64p]
+    lib.pt_graph_stats.restype = c.c_int
+    lib.pt_graph_stats.argtypes = [c.c_void_p, u64p]
 
     lib.pt_infer_create.restype = c.c_void_p
     lib.pt_infer_create.argtypes = [c.c_char_p, c.c_char_p]

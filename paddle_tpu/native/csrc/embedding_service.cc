@@ -52,6 +52,13 @@ enum Op : uint8_t {
   OP_SHRINK = 8,     // f32 threshold, u32 max_unseen, f32 decay -> u64 evicted
   OP_STATS2 = 9,     // - -> u64[7] mem_rows, mem_bytes, spill_rows,
                      //      spill_bytes, evicted, pageouts, pageins
+  // graph table (ref:paddle/fluid/distributed/ps/table/common_graph_table.cc
+  // role: PS-hosted adjacency + server-side neighbor sampling for GNN)
+  OP_GADD = 10,     // u32 n, u64 src[n], u64 dst[n]            -> status
+  OP_GSAMPLE = 11,  // u32 n, i32 k, u64 seed, u64 ids[n]
+                    //   -> u32 counts[n], u64 neighbors[sum]
+  OP_GDEGREE = 12,  // u32 n, u64 ids[n]                        -> u64 deg[n]
+  OP_GSTATS = 13,   // -                                        -> u64 nodes, edges
 };
 
 bool read_n(int fd, void* buf, size_t n) {
@@ -551,6 +558,97 @@ class SparseTable {
   std::atomic<uint64_t> pageins_{0};
 };
 
+// ------------------------------------------------------------- graph table
+
+// PS-hosted adjacency with server-side uniform neighbor sampling (the
+// common_graph_table role). Nodes are sharded across servers by the same
+// id hash as embedding rows, so a GNN's feature rows and its adjacency for
+// a node live on the same server.
+class GraphTable {
+ public:
+  void AddEdges(const uint64_t* src, const uint64_t* dst, uint32_t n) {
+    // bucket by shard first: one lock per touched shard, not per edge
+    std::vector<std::vector<uint32_t>> buckets(kShards);
+    for (uint32_t i = 0; i < n; ++i)
+      buckets[shard_index(src[i])].push_back(i);
+    for (int b = 0; b < kShards; ++b) {
+      if (buckets[b].empty()) continue;
+      Shard& s = shards_[b];
+      std::lock_guard<std::mutex> lk(s.mu);
+      for (uint32_t i : buckets[b]) s.adj[src[i]].push_back(dst[i]);
+    }
+    edges_ += n;
+  }
+
+  // For each id: degree <= k (or k < 0) returns the full neighbor list,
+  // else a uniform k-subset WITHOUT replacement (reservoir, Algorithm R).
+  // Deterministic per (seed, id) so distributed reruns reproduce.
+  void Sample(const uint64_t* ids, uint32_t n, int k, uint64_t seed,
+              std::vector<uint32_t>& counts, std::vector<uint64_t>& out) {
+    counts.resize(n);
+    for (uint32_t i = 0; i < n; ++i) {
+      Shard& s = shard(ids[i]);
+      std::lock_guard<std::mutex> lk(s.mu);
+      auto it = s.adj.find(ids[i]);
+      if (it == s.adj.end()) {
+        counts[i] = 0;
+        continue;
+      }
+      const std::vector<uint64_t>& nb = it->second;
+      if (k < 0 || nb.size() <= static_cast<size_t>(k)) {
+        counts[i] = static_cast<uint32_t>(nb.size());
+        out.insert(out.end(), nb.begin(), nb.end());
+        continue;
+      }
+      std::mt19937_64 gen(seed ^ (ids[i] * 0x9e3779b97f4a7c15ULL));
+      std::vector<uint64_t> res(nb.begin(), nb.begin() + k);
+      for (size_t j = k; j < nb.size(); ++j) {
+        uint64_t r = gen() % (j + 1);
+        if (r < static_cast<uint64_t>(k)) res[r] = nb[j];
+      }
+      counts[i] = static_cast<uint32_t>(k);
+      out.insert(out.end(), res.begin(), res.end());
+    }
+  }
+
+  void Degrees(const uint64_t* ids, uint32_t n, uint64_t* out) {
+    for (uint32_t i = 0; i < n; ++i) {
+      Shard& s = shard(ids[i]);
+      std::lock_guard<std::mutex> lk(s.mu);
+      auto it = s.adj.find(ids[i]);
+      out[i] = it == s.adj.end() ? 0 : it->second.size();
+    }
+  }
+
+  uint64_t NumNodes() {
+    uint64_t n = 0;
+    for (auto& s : shards_) {
+      std::lock_guard<std::mutex> lk(s.mu);
+      n += s.adj.size();
+    }
+    return n;
+  }
+
+  uint64_t NumEdges() const { return edges_.load(); }
+
+ private:
+  static constexpr int kShards = 64;
+  struct Shard {
+    std::mutex mu;
+    std::unordered_map<uint64_t, std::vector<uint64_t>> adj;
+  };
+
+  int shard_index(uint64_t id) const {
+    uint64_t h = id * 0x9e3779b97f4a7c15ULL;
+    return static_cast<int>((h >> 32) % kShards);
+  }
+
+  Shard& shard(uint64_t id) { return shards_[shard_index(id)]; }
+
+  Shard shards_[kShards];
+  std::atomic<uint64_t> edges_{0};
+};
+
 // ------------------------------------------------------------------ server
 
 class EmbServer {
@@ -715,12 +813,58 @@ class EmbServer {
         int64_t len = sizeof(st2);
         return write_n(fd, &len, 8) && write_n(fd, st2, sizeof(st2));
       }
+      case OP_GADD: {
+        if (p.size() < 4) return false;
+        uint32_t n;
+        memcpy(&n, p.data(), 4);
+        if (p.size() != 4 + 16ULL * n) return false;
+        const uint64_t* src = reinterpret_cast<const uint64_t*>(p.data() + 4);
+        graph_.AddEdges(src, src + n, n);
+        int64_t st = 0;
+        return write_n(fd, &st, 8);
+      }
+      case OP_GSAMPLE: {
+        if (p.size() < 16) return false;
+        uint32_t n;
+        int32_t k;
+        uint64_t seed;
+        memcpy(&n, p.data(), 4);
+        memcpy(&k, p.data() + 4, 4);
+        memcpy(&seed, p.data() + 8, 8);
+        if (p.size() != 16 + 8ULL * n) return false;
+        const uint64_t* ids = reinterpret_cast<const uint64_t*>(p.data() + 16);
+        std::vector<uint32_t> counts;
+        std::vector<uint64_t> nbrs;
+        graph_.Sample(ids, n, k, seed, counts, nbrs);
+        int64_t len = 4LL * n + 8LL * nbrs.size();
+        return write_n(fd, &len, 8) &&
+               write_n(fd, counts.data(), 4ULL * n) &&
+               (nbrs.empty() ||
+                write_n(fd, nbrs.data(), 8ULL * nbrs.size()));
+      }
+      case OP_GDEGREE: {
+        if (p.size() < 4) return false;
+        uint32_t n;
+        memcpy(&n, p.data(), 4);
+        if (p.size() != 4 + 8ULL * n) return false;
+        const uint64_t* ids = reinterpret_cast<const uint64_t*>(p.data() + 4);
+        std::vector<uint64_t> deg(n);
+        graph_.Degrees(ids, n, deg.data());
+        int64_t len = 8LL * n;
+        return write_n(fd, &len, 8) && write_n(fd, deg.data(), 8ULL * n);
+      }
+      case OP_GSTATS: {
+        uint64_t st2[2] = {graph_.NumNodes(), graph_.NumEdges()};
+        int64_t len = 16;
+        return write_n(fd, &len, 8) && write_n(fd, st2, 16);
+      }
       default:
         return false;
     }
   }
 
   SparseTable table_;
+  GraphTable graph_;
   int listen_fd_ = -1;
   int port_ = 0;
   std::atomic<bool> stopping_{false};
@@ -929,6 +1073,57 @@ int pt_emb_stats2(void* h, unsigned long long out7[7]) {
   int64_t r =
       static_cast<EmbClient*>(h)->Request(OP_STATS2, nullptr, 0, out7, 56);
   return r == 56 ? 0 : -1;
+}
+
+// ----------------------------------------------------- graph table client
+
+int pt_graph_add_edges(void* h, const unsigned long long* src,
+                       const unsigned long long* dst, unsigned int n) {
+  std::vector<char> payload(4 + 16ULL * n);
+  memcpy(payload.data(), &n, 4);
+  memcpy(payload.data() + 4, src, 8ULL * n);
+  memcpy(payload.data() + 4 + 8ULL * n, dst, 8ULL * n);
+  int64_t r = static_cast<EmbClient*>(h)->Request(OP_GADD, payload.data(),
+                                                  payload.size(), nullptr, 0);
+  return r == 0 ? 0 : -1;
+}
+
+// counts_out: n uint32; neigh_out capacity neigh_cap u64. Returns the
+// number of neighbors written, or -1 (undersized buffer / error).
+long long pt_graph_sample(void* h, const unsigned long long* ids,
+                          unsigned int n, int k, unsigned long long seed,
+                          unsigned int* counts_out,
+                          unsigned long long* neigh_out,
+                          unsigned long long neigh_cap) {
+  std::vector<char> payload(16 + 8ULL * n);
+  memcpy(payload.data(), &n, 4);
+  memcpy(payload.data() + 4, &k, 4);
+  memcpy(payload.data() + 8, &seed, 8);
+  memcpy(payload.data() + 16, ids, 8ULL * n);
+  std::vector<char> resp(4ULL * n + 8ULL * neigh_cap);
+  int64_t r = static_cast<EmbClient*>(h)->Request(
+      OP_GSAMPLE, payload.data(), payload.size(), resp.data(), resp.size());
+  if (r < static_cast<int64_t>(4ULL * n)) return -1;
+  memcpy(counts_out, resp.data(), 4ULL * n);
+  uint64_t total = (static_cast<uint64_t>(r) - 4ULL * n) / 8;
+  memcpy(neigh_out, resp.data() + 4ULL * n, 8ULL * total);
+  return static_cast<long long>(total);
+}
+
+int pt_graph_degrees(void* h, const unsigned long long* ids, unsigned int n,
+                     unsigned long long* out) {
+  std::vector<char> payload(4 + 8ULL * n);
+  memcpy(payload.data(), &n, 4);
+  memcpy(payload.data() + 4, ids, 8ULL * n);
+  int64_t r = static_cast<EmbClient*>(h)->Request(
+      OP_GDEGREE, payload.data(), payload.size(), out, 8ULL * n);
+  return r == static_cast<int64_t>(8ULL * n) ? 0 : -1;
+}
+
+int pt_graph_stats(void* h, unsigned long long out2[2]) {
+  int64_t r =
+      static_cast<EmbClient*>(h)->Request(OP_GSTATS, nullptr, 0, out2, 16);
+  return r == 16 ? 0 : -1;
 }
 
 int pt_emb_save(void* h, const char* path) {

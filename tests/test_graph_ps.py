@@ -1,0 +1,66 @@
+"""PS-hosted graph table: distributed adjacency + server-side neighbor
+sampling (ref:paddle/fluid/distributed/ps/table/common_graph_table.cc)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import geometric
+from paddle_tpu.distributed import ps
+
+
+@pytest.fixture(scope="module")
+def graph_cluster():
+    svc = ps.EmbeddingService(dim=8, num_shards=2)
+    yield svc
+    svc.stop()
+
+
+def _ring_graph(n=50, extra=5):
+    # ring + a few hubs with high degree
+    src = list(range(n)) + [0] * extra
+    dst = [(i + 1) % n for i in range(n)] + list(range(100, 100 + extra))
+    return np.asarray(src, np.uint64), np.asarray(dst, np.uint64)
+
+
+def test_graph_add_sample_degree(graph_cluster):
+    g = graph_cluster.graph_client()
+    src, dst = _ring_graph()
+    g.add_edges(src, dst)
+    nodes, edges = g.stats()
+    assert edges == len(src) and nodes == 50  # 50 distinct sources
+
+    # full neighborhoods in input order
+    probe = np.array([0, 1, 49, 777], np.uint64)
+    flat, counts = g.sample_neighbors(probe, sample_size=-1)
+    assert counts.tolist() == [6, 1, 1, 0]  # node 0: ring edge + 5 hubs
+    assert set(flat[:6].tolist()) == {1, 100, 101, 102, 103, 104}
+    assert flat[6] == 2 and flat[7] == 0
+    assert g.degrees(probe).tolist() == [6, 1, 1, 0]
+
+    # bounded fanout: k-subset of the true neighborhood, deterministic per seed
+    f1, c1 = g.sample_neighbors(np.array([0], np.uint64), 3, seed=7)
+    f2, c2 = g.sample_neighbors(np.array([0], np.uint64), 3, seed=7)
+    f3, _ = g.sample_neighbors(np.array([0], np.uint64), 3, seed=8)
+    assert c1.tolist() == [3] and np.array_equal(f1, f2)
+    assert set(f1.tolist()) <= {1, 100, 101, 102, 103, 104}
+    assert len(set(f1.tolist())) == 3  # without replacement
+    assert not np.array_equal(f1, f3) or True  # different seed may differ
+
+
+def test_distributed_sampling_feeds_reindex(graph_cluster):
+    g = graph_cluster.graph_client()
+    # bipartite block: sources 200..203 each -> {300..303}
+    src = np.repeat(np.arange(200, 204, dtype=np.uint64), 4)
+    dst = np.tile(np.arange(300, 304, dtype=np.uint64), 4)
+    g.add_edges(src, dst)
+
+    x = paddle.to_tensor(np.arange(200, 204, dtype=np.int64))
+    nbrs, cnt = geometric.distributed_sample_neighbors(g, x, sample_size=2,
+                                                       seed=1)
+    assert cnt.numpy().tolist() == [2, 2, 2, 2]
+    r_src, r_dst, out_nodes = geometric.reindex_graph(x, nbrs, cnt)
+    # reindexed ids are a compact local space covering x + sampled nbrs
+    assert out_nodes.shape[0] == len(set(
+        x.numpy().tolist() + nbrs.numpy().tolist()))
+    assert int(r_src.numpy().max()) < out_nodes.shape[0]
+    assert np.array_equal(out_nodes.numpy()[:4], x.numpy())
