@@ -1,0 +1,29 @@
+"""paddle._C_ops compatibility shim (ref:python/paddle/_C_ops.py populates
+this namespace from the pybind core's generated op bindings).
+
+Ported user code calls ``paddle._C_ops.<op>(...)`` for the raw op entry
+points; here every public op of the ops package (plus nn.functional) is
+re-exported under its op name, backed by the same jnp/XLA implementations
+the Tensor API dispatches to. ``final_state_<op>`` aliases (the reference's
+new-eager binding names) resolve to the same functions.
+"""
+from __future__ import annotations
+
+import sys as _sys
+
+from . import ops as _ops
+from .nn import functional as _F
+
+_this = _sys.modules[__name__]
+
+for _src in (_ops, _F):
+    for _name in dir(_src):
+        if _name.startswith("_"):
+            continue
+        _fn = getattr(_src, _name)
+        if callable(_fn) and not hasattr(_this, _name):
+            setattr(_this, _name, _fn)
+            # the reference's new-eager binding alias
+            setattr(_this, f"final_state_{_name}", _fn)
+
+del _sys, _src, _name, _fn

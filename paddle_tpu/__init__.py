@@ -203,6 +203,11 @@ def flops(net, input_size, custom_ops=None, print_detail=False):
     return total
 
 
+# ----------------------------------------------------- legacy compat names
+from .batch import batch  # noqa: E402,F401
+from . import _C_ops  # noqa: E402,F401
+from . import fluid  # noqa: E402,F401
+
 # ---------------------------------------------------------- Tensor methods
 # The reference patches every ``tensor_method_func`` name onto the Tensor
 # class (ref:python/paddle/tensor/__init__.py monkey_patch). Most methods

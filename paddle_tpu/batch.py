@@ -1,0 +1,24 @@
+"""paddle.batch (ref:python/paddle/batch.py): wrap a sample reader into a
+mini-batch reader."""
+from __future__ import annotations
+
+__all__ = ["batch"]
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Combine samples from ``reader()`` into lists of ``batch_size``."""
+    if batch_size <= 0 or int(batch_size) != batch_size:
+        raise ValueError(f"batch_size must be a positive int, got "
+                         f"{batch_size!r}")
+
+    def batch_reader():
+        buf = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return batch_reader

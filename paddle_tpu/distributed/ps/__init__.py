@@ -361,7 +361,8 @@ class GraphTableClient(_ShardedClient):
                    else max(int(self.degrees(sub).sum()), 64))
             cnt = np.zeros(len(sel), np.uint32)
             # the degree-derived capacity can be stale if edges land
-            # concurrently; grow and retry instead of failing the sample
+            # concurrently; the wire layer drains oversized responses
+            # (rc -3) so a resized retry on the same connection is safe
             for _ in range(8):
                 nbr = np.zeros(max(cap, 1), np.uint64)
                 total = self._lib.pt_graph_sample(
@@ -370,11 +371,13 @@ class GraphTableClient(_ShardedClient):
                     cnt.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
                     nbr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
                     len(nbr))
-                if total >= 0:
+                if total != -3:
                     break
                 cap *= 2
             if total < 0:
-                raise RuntimeError(f"sample failed on shard {s}")
+                kind = {-2: "connection lost", -3: "buffer overflow",
+                        -1: "malformed response"}.get(int(total), "error")
+                raise RuntimeError(f"sample failed on shard {s}: {kind}")
             counts[sel] = cnt
             off = 0
             for j, idx in enumerate(sel):

@@ -1,0 +1,126 @@
+"""paddle.fluid compatibility namespace (ref:python/paddle/fluid/).
+
+The reference still ships its legacy ``fluid`` package and a long tail of
+user code imports it. This shim maps the entry points that ported code
+actually touches onto their modern equivalents; Program-graph machinery
+raises the same redirect guidance as ``paddle.static``. Nothing here adds
+behavior — it is routing, so fluid-era scripts run unmodified where their
+semantics exist on this stack.
+"""
+from __future__ import annotations
+
+import contextlib as _contextlib
+
+from .. import (amp, io, nn, optimizer, regularizer, static)  # noqa: F401
+from ..core import dtype as _dtype_mod
+from ..core.tensor import Tensor, to_tensor  # noqa: F401
+from .. import in_dynamic_mode  # noqa: F401
+
+in_dygraph_mode = in_dynamic_mode  # the fluid-era name
+from ..nn.layer import Layer, ParamAttr  # noqa: F401
+from ..static import (Program, Executor, data, default_main_program,  # noqa: F401
+                      default_startup_program, program_guard)
+
+__all__ = ["core", "dygraph", "layers", "framework", "initializer", "io",
+           "optimizer", "regularizer", "ParamAttr", "data_feeder",
+           "in_dygraph_mode", "unique_name"]
+
+
+# ------------------------------------------------------------- submodules
+class _Namespace:
+    def __init__(self, name, **attrs):
+        self.__name__ = f"paddle_tpu.fluid.{name}"
+        for k, v in attrs.items():
+            setattr(self, k, v)
+
+
+def _redirect(name):
+    def fn(*a, **k):
+        raise NotImplementedError(
+            f"paddle.fluid.core.{name} belongs to the legacy Program "
+            "runtime; use the paddle-level API (jit.to_static / "
+            "jit.save/load) on this stack")
+
+    fn._intentional_redirect = True
+    return fn
+
+
+core = _Namespace(
+    "core",
+    CPUPlace=None,  # filled below
+    CUDAPlace=None,
+    VarDesc=_redirect("VarDesc"),
+    Scope=_redirect("Scope"),
+    LoDTensor=_redirect("LoDTensor"),
+    globals=lambda: {},
+)
+
+
+def _init_core():
+    from ..core.device import CPUPlace, CUDAPlace
+
+    core.CPUPlace = CPUPlace
+    core.CUDAPlace = CUDAPlace
+
+
+_init_core()
+
+from .. import framework  # noqa: E402,F401
+from ..nn import initializer  # noqa: E402,F401
+
+# fluid.layers: the old op namespace — modern ops cover the surviving names
+from .. import ops as layers  # noqa: E402
+
+# fluid.dygraph: guard() is a no-op context (dygraph is the only mode),
+# to_variable = to_tensor, Layer lives on
+dygraph = _Namespace(
+    "dygraph",
+    Layer=Layer,
+    to_variable=to_tensor,
+    guard=lambda place=None: _contextlib.nullcontext(),
+    no_grad=None,  # filled below
+)
+
+
+def _init_dygraph():
+    from ..core.autograd import no_grad
+
+    dygraph.no_grad = no_grad
+
+
+_init_dygraph()
+
+
+class DataFeeder:
+    """fluid.DataFeeder (ref:python/paddle/fluid/data_feeder.py): convert
+    feed lists into Tensors keyed by name."""
+
+    def __init__(self, feed_list, place=None, program=None):
+        self.names = [getattr(f, "name", f) for f in feed_list]
+
+    def feed(self, iterable):
+        import numpy as np
+
+        cols = list(zip(*iterable))
+        return {n: to_tensor(np.asarray(c))
+                for n, c in zip(self.names, cols)}
+
+
+data_feeder = _Namespace("data_feeder", DataFeeder=DataFeeder)
+
+
+class _UniqueName:
+    def __init__(self):
+        self._counters = {}
+
+    def generate(self, key="tmp"):
+        i = self._counters.get(key, 0)
+        self._counters[key] = i + 1
+        return f"{key}_{i}"
+
+    @_contextlib.contextmanager
+    def guard(self, new_generator=None):
+        yield
+
+
+unique_name = _UniqueName()

@@ -918,7 +918,18 @@ class EmbClient {
     int64_t len;
     if (!read_n(fd_, &len, 8)) return -2;
     if (len < 0) return len;
-    if (static_cast<uint64_t>(len) > out_cap) return -3;
+    if (static_cast<uint64_t>(len) > out_cap) {
+      // drain the body so the connection stays usable for a resized retry
+      std::vector<char> sink(1 << 20);
+      uint64_t left = static_cast<uint64_t>(len);
+      while (left) {
+        size_t chunk = left < sink.size() ? static_cast<size_t>(left)
+                                          : sink.size();
+        if (!read_n(fd_, sink.data(), chunk)) return -2;
+        left -= chunk;
+      }
+      return -3;
+    }
     if (len && !read_n(fd_, out, static_cast<size_t>(len))) return -2;
     return len;
   }
@@ -1089,7 +1100,8 @@ int pt_graph_add_edges(void* h, const unsigned long long* src,
 }
 
 // counts_out: n uint32; neigh_out capacity neigh_cap u64. Returns the
-// number of neighbors written, or -1 (undersized buffer / error).
+// number of neighbors written; -3 = buffer too small (connection stays
+// usable — retry with a larger one); -2 = connection error; -1 malformed.
 long long pt_graph_sample(void* h, const unsigned long long* ids,
                           unsigned int n, int k, unsigned long long seed,
                           unsigned int* counts_out,
@@ -1103,6 +1115,7 @@ long long pt_graph_sample(void* h, const unsigned long long* ids,
   std::vector<char> resp(4ULL * n + 8ULL * neigh_cap);
   int64_t r = static_cast<EmbClient*>(h)->Request(
       OP_GSAMPLE, payload.data(), payload.size(), resp.data(), resp.size());
+  if (r == -2 || r == -3) return r;
   if (r < static_cast<int64_t>(4ULL * n)) return -1;
   memcpy(counts_out, resp.data(), 4ULL * n);
   uint64_t total = (static_cast<uint64_t>(r) - 4ULL * n) / 8;

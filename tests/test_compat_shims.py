@@ -1,0 +1,51 @@
+"""Legacy-namespace compatibility: paddle.batch, paddle._C_ops,
+paddle.fluid (ref:python/paddle/batch.py, _C_ops.py, fluid/)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import fluid
+
+
+def test_batch_reader():
+    r = paddle.batch(lambda: iter(range(7)), batch_size=3)
+    assert [len(b) for b in r()] == [3, 3, 1]
+    r2 = paddle.batch(lambda: iter(range(7)), batch_size=3, drop_last=True)
+    assert [len(b) for b in r2()] == [3, 3]
+    with pytest.raises(ValueError):
+        paddle.batch(lambda: iter([]), batch_size=0)
+
+
+def test_c_ops_namespace():
+    from paddle_tpu import _C_ops
+
+    out = _C_ops.matmul(paddle.ones([2, 3]), paddle.ones([3, 4]))
+    assert out.shape == [2, 4]
+    assert _C_ops.final_state_relu is _C_ops.relu
+
+
+def test_fluid_dygraph_era_script():
+    with fluid.dygraph.guard():
+        assert fluid.in_dygraph_mode()
+        v = fluid.dygraph.to_variable(np.ones((2, 2), np.float32))
+        net = paddle.nn.Linear(2, 3)
+        out = net(v)
+        assert out.shape == [2, 3]
+        with fluid.dygraph.no_grad():
+            out2 = net(v)
+        assert out2.stop_gradient
+
+
+def test_fluid_core_and_helpers():
+    assert fluid.core.CPUPlace() is not None
+    with pytest.raises(NotImplementedError):
+        fluid.core.Scope()
+    with pytest.raises(NotImplementedError):
+        fluid.Program()
+    fd = fluid.DataFeeder(feed_list=["x", "y"])
+    feeds = fd.feed([(np.zeros(3, np.float32), 1),
+                     (np.ones(3, np.float32), 2)])
+    assert feeds["x"].shape == [2, 3] and feeds["y"].shape == [2]
+    assert fluid.unique_name.generate("fc") != fluid.unique_name.generate("fc")
+    assert callable(fluid.layers.concat)
+    assert fluid.ParamAttr is paddle.ParamAttr

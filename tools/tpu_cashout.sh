@@ -1,0 +1,52 @@
+#!/bin/bash
+# Cash out the banked TPU perf work the moment the axon tunnel answers.
+# Run from the repo root: bash tools/tpu_cashout.sh
+# Probes the chip with a short-timeout matmul, then runs the full recorded
+# sequence (sweep -> bench.py -> all baseline configs -> decode -> eager ->
+# native real-plugin test), logging to benches/tpu_logs/ and appending
+# results to benches/BASELINE_RESULTS.jsonl. Every stage has its own
+# timeout so a mid-sequence tunnel drop cannot hang the run.
+set -u
+cd "$(dirname "$0")/.."
+LOGS=benches/tpu_logs
+mkdir -p "$LOGS"
+STAMP=$(date +%Y%m%d_%H%M%S)
+
+probe() {
+  timeout 180 python - <<'PY'
+import jax, numpy as np, time
+t0 = time.time()
+x = np.ones((256, 256), np.float32)
+y = jax.jit(lambda a: a @ a)(x)
+y.block_until_ready()
+d = jax.devices()[0]
+assert d.platform != "cpu", f"probe landed on {d.platform}"
+print(f"TPU alive: {d} matmul in {time.time()-t0:.1f}s")
+PY
+}
+
+echo "[cashout] probing tunnel..."
+if ! probe > "$LOGS/probe_$STAMP.log" 2>&1; then
+  echo "[cashout] tunnel DOWN (see $LOGS/probe_$STAMP.log)"
+  exit 3
+fi
+cat "$LOGS/probe_$STAMP.log"
+
+run() {  # run <name> <timeout_s> <cmd...>
+  local name=$1 t=$2; shift 2
+  echo "[cashout] $name ..."
+  timeout "$t" "$@" > "$LOGS/${name}_$STAMP.log" 2>&1
+  local rc=$?
+  tail -2 "$LOGS/${name}_$STAMP.log"
+  echo "[cashout] $name rc=$rc"
+}
+
+run sweep     5400 python benches/sweep.py
+run bench     2400 python bench.py
+run baseline  7200 python benches/baseline.py lenet resnet50 ernie gpt-hybrid widedeep
+run decode    2400 python benches/decode_bench.py
+run eager     1800 python tools/eager_bench.py
+run ps_spill  3600 python benches/ps_spill_bench.py 2.0 256
+PADDLE_TPU_NATIVE_TPU_TEST=1 run native 1800 python -m pytest tests/test_native_infer.py -k real_plugin -q
+run flash     2400 python -m pytest tests/test_flash_attention.py -q
+echo "[cashout] done; records in benches/BASELINE_RESULTS.jsonl, logs in $LOGS/"
