@@ -27,6 +27,7 @@ from .autograd import TapeNode, is_grad_enabled
 from .tensor import Tensor
 
 _JIT_CACHE: Dict[Tuple, Any] = {}
+_Tracer = jax.core.Tracer
 _amp = None  # set lazily to break the import cycle
 # active (pack, unpack) saved-tensor hooks (autograd.saved_tensors_hooks)
 _saved_tensor_hooks: list = []
@@ -75,6 +76,27 @@ def _fn_cache_key(fn):
 
 
 def _jitted(fn, static: Tuple):
+    # fast path for stable fn objects without __code__ (jnp ufuncs — the
+    # binary/unary op hot path): executables live in a dict ON the object,
+    # skipping the closure walk and the (expensive) ufunc hash entirely.
+    # Nested defs (fresh object per call) must NOT take this path — a
+    # per-object dict would re-trace every call — they go through the
+    # code-object-keyed global cache below.
+    if getattr(fn, "__code__", None) is None:
+        rec = getattr(fn, "_pt_jit_rec", None)
+        if rec is None:
+            try:
+                rec = {}
+                fn._pt_jit_rec = rec
+            except (AttributeError, TypeError):
+                rec = None
+        if rec is not None:
+            ex = rec.get(static)
+            if ex is None:
+                ex = (jax.jit(functools.partial(fn, **dict(static)))
+                      if static else jax.jit(fn))
+                rec[static] = ex
+            return ex
     key = (_fn_cache_key(fn), static)
     ex = _JIT_CACHE.get(key)
     if ex is None:
@@ -164,10 +186,23 @@ def replace_value(x: Tensor, out: Tensor):
 def apply(fn, tensor_args: Tuple, static: Dict[str, Any], *, differentiable: bool = True, name: str = None):
     """Run pure function ``fn(*arrays, **static)`` over Tensor/array args."""
     name = name or fn.__name__.lstrip("_")
-    datas = tuple(t._data if isinstance(t, Tensor) else jnp.asarray(t) for t in tensor_args)
+    # one fused scan over the args: unwrap, detect tracers, detect live grads
+    datas = []
+    tracing = False
+    any_live = False
+    for t in tensor_args:
+        if isinstance(t, Tensor):
+            d = t._data
+            if not t.stop_gradient:
+                any_live = True
+        else:
+            d = jnp.asarray(t)
+        if isinstance(d, _Tracer):
+            tracing = True
+        datas.append(d)
+    datas = tuple(datas)
     if _amp is not None and _amp.amp_state() is not None:
         datas = _amp.maybe_cast_inputs(name, datas)
-    tracing = any(isinstance(d, jax.core.Tracer) for d in datas)
     static_t = tuple(sorted(static.items())) if static else ()
 
     _t0 = trace_hook.begin() if trace_hook.active else 0
@@ -184,8 +219,8 @@ def apply(fn, tensor_args: Tuple, static: Dict[str, Any], *, differentiable: boo
     requires_grad = (
         differentiable
         and not tracing
+        and any_live
         and is_grad_enabled()
-        and any(isinstance(t, Tensor) and not t.stop_gradient for t in tensor_args)
     )
 
     if flags.flag("check_nan_inf") and not tracing:
@@ -206,18 +241,11 @@ def apply(fn, tensor_args: Tuple, static: Dict[str, Any], *, differentiable: boo
             node.add_output(t)
             out_tensors.append(t)
     else:
-        sg = not (
-            not tracing
-            and is_grad_enabled()
-            and differentiable
-            and any(isinstance(t, Tensor) and not t.stop_gradient for t in tensor_args)
-        )
-        # under tracing, propagate stop_gradient flags so jit.grad can honor them
+        # under tracing, propagate stop_gradient so jit.grad can honor it
         if tracing:
-            sg = not (
-                differentiable
-                and any(isinstance(t, Tensor) and not t.stop_gradient for t in tensor_args)
-            )
+            sg = not (differentiable and any_live)
+        else:
+            sg = not (is_grad_enabled() and differentiable and any_live)
         out_tensors = [Tensor(o, stop_gradient=sg) for o in outs]
 
     if multi:

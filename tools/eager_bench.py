@@ -21,6 +21,13 @@ import numpy as np
 def main():
     import jax
 
+    # the sandbox sitecustomize force-pins a (possibly wedged) remote TPU
+    # platform; EAGER_BENCH_PLATFORM=cpu pins the backend BEFORE any device
+    # touch so a dead tunnel can't hang the tool
+    plat = os.environ.get("EAGER_BENCH_PLATFORM")
+    if plat:
+        jax.config.update("jax_platforms", plat)
+
     import paddle_tpu as paddle
 
     dev = jax.devices()[0]
