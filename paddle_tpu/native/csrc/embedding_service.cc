@@ -286,12 +286,15 @@ class SparseTable {
     FILE* f = fopen(path, "wb");
     if (!f) return false;
     uint64_t magic = 0x70747370'61727332ULL;  // v2 ("ptspars2"): meta rows
-    uint64_t count = NumRows();
+    uint64_t count = 0;  // patched after the walk — a concurrent Push/Shrink
+                         // between a NumRows() snapshot and the per-shard
+                         // iteration would make a pre-written count lie
     uint64_t dim = cfg_.dim, rule = cfg_.rule, rl = row_len_;
     fwrite(&magic, 8, 1, f);
     fwrite(&dim, 8, 1, f);
     fwrite(&rule, 8, 1, f);
     fwrite(&rl, 8, 1, f);
+    long count_pos = ftell(f);
     fwrite(&count, 8, 1, f);
     std::vector<float> tmp(row_len_);
     for (auto& s : shards_) {
@@ -299,6 +302,7 @@ class SparseTable {
       for (auto& kv : s.rows) {
         fwrite(&kv.first, 8, 1, f);
         fwrite(kv.second.data(), sizeof(float), row_len_, f);
+        ++count;
       }
       for (auto& kv : s.spilled) {
         if (pread(spill_fd_, tmp.data(), sizeof(float) * row_len_,
@@ -312,9 +316,15 @@ class SparseTable {
         }
         fwrite(&kv.first, 8, 1, f);
         fwrite(tmp.data(), sizeof(float), row_len_, f);
+        ++count;
       }
     }
-    fclose(f);
+    bool hdr_ok = !ferror(f) && fseek(f, count_pos, SEEK_SET) == 0 &&
+                  fwrite(&count, 8, 1, f) == 1;
+    if (fclose(f) != 0 || !hdr_ok) {
+      ::unlink(path);
+      return false;
+    }
     return true;
   }
 
@@ -400,17 +410,21 @@ class SparseTable {
     std::vector<float> row(row_len_, 0.0f);
     auto sp = s.spilled.find(id);
     if (sp != s.spilled.end()) {
-      // page the cold row back in; its file record becomes garbage
-      if (pread(spill_fd_, row.data(), sizeof(float) * row_len_,
-                static_cast<off_t>(sp->second + 8)) ==
-          static_cast<ssize_t>(sizeof(float) * row_len_)) {
-        s.spilled.erase(sp);
-        --spill_rows_;
-        spill_garbage_ += RecBytes();
+      // page the cold row back in; its file record becomes garbage either
+      // way — a stale spilled entry left behind after a failed pread would
+      // shadow the fresh resident row at Save/Load time
+      bool read_ok = pread(spill_fd_, row.data(), sizeof(float) * row_len_,
+                           static_cast<off_t>(sp->second + 8)) ==
+                     static_cast<ssize_t>(sizeof(float) * row_len_);
+      s.spilled.erase(sp);
+      --spill_rows_;
+      spill_garbage_ += RecBytes();
+      if (read_ok) {
         ++pageins_;
         mem_bytes_ += kRowOverhead + row_len_ * sizeof(float);
         return s.rows.emplace(id, std::move(row)).first->second;
       }
+      std::fill(row.begin(), row.end(), 0.0f);
     }
     // deterministic per-id init -> pull order / restarts don't change values
     std::mt19937_64 gen(cfg_.seed ^ (id * 0xff51afd7ed558ccdULL));
@@ -736,6 +750,15 @@ class EmbServer {
       }
   }
 
+  // ids ride the wire at a 4-mod-8 offset (after the u32 count); copy them
+  // into an aligned buffer — reinterpret_cast'ing an unaligned uint64* is
+  // UB (faults on strict-alignment targets)
+  static std::vector<uint64_t> CopyIds(const char* src, uint32_t n) {
+    std::vector<uint64_t> ids(n);
+    if (n) memcpy(ids.data(), src, 8ULL * n);
+    return ids;
+  }
+
   bool Handle(int fd, uint8_t op, std::vector<char>& p) {
     const int D = table_.dim();
     switch (op) {
@@ -744,9 +767,9 @@ class EmbServer {
         uint32_t n;
         memcpy(&n, p.data(), 4);
         if (p.size() != 4 + 8ULL * n) return false;
-        const uint64_t* ids = reinterpret_cast<const uint64_t*>(p.data() + 4);
+        std::vector<uint64_t> ids = CopyIds(p.data() + 4, n);
         std::vector<float> rows(static_cast<size_t>(n) * D);
-        table_.Pull(ids, n, rows.data());
+        table_.Pull(ids.data(), n, rows.data());
         int64_t len = static_cast<int64_t>(rows.size() * sizeof(float));
         return write_n(fd, &len, 8) && write_n(fd, rows.data(), len);
       }
@@ -788,11 +811,11 @@ class EmbServer {
         uint32_t n;
         memcpy(&n, p.data(), 4);
         if (p.size() != 4 + 16ULL * n) return false;
-        const uint64_t* ids = reinterpret_cast<const uint64_t*>(p.data() + 4);
+        std::vector<uint64_t> ids = CopyIds(p.data() + 4, n);
         const float* shows =
             reinterpret_cast<const float*>(p.data() + 4 + 8ULL * n);
         const float* clicks = shows + n;
-        table_.ShowClick(ids, n, shows, clicks);
+        table_.ShowClick(ids.data(), n, shows, clicks);
         int64_t st = 0;
         return write_n(fd, &st, 8);
       }
@@ -818,8 +841,8 @@ class EmbServer {
         uint32_t n;
         memcpy(&n, p.data(), 4);
         if (p.size() != 4 + 16ULL * n) return false;
-        const uint64_t* src = reinterpret_cast<const uint64_t*>(p.data() + 4);
-        graph_.AddEdges(src, src + n, n);
+        std::vector<uint64_t> pairs = CopyIds(p.data() + 4, 2 * n);
+        graph_.AddEdges(pairs.data(), pairs.data() + n, n);
         int64_t st = 0;
         return write_n(fd, &st, 8);
       }
@@ -847,9 +870,9 @@ class EmbServer {
         uint32_t n;
         memcpy(&n, p.data(), 4);
         if (p.size() != 4 + 8ULL * n) return false;
-        const uint64_t* ids = reinterpret_cast<const uint64_t*>(p.data() + 4);
+        std::vector<uint64_t> ids = CopyIds(p.data() + 4, n);
         std::vector<uint64_t> deg(n);
-        graph_.Degrees(ids, n, deg.data());
+        graph_.Degrees(ids.data(), n, deg.data());
         int64_t len = 8LL * n;
         return write_n(fd, &len, 8) && write_n(fd, deg.data(), 8ULL * n);
       }

@@ -47,6 +47,6 @@ run baseline  7200 python benches/baseline.py lenet resnet50 ernie gpt-hybrid wi
 run decode    2400 python benches/decode_bench.py
 run eager     1800 python tools/eager_bench.py
 run ps_spill  3600 python benches/ps_spill_bench.py 2.0 256
-PADDLE_TPU_NATIVE_TPU_TEST=1 run native 1800 python -m pytest tests/test_native_infer.py -k real_plugin -q
+run native   1800 env PADDLE_TPU_NATIVE_TPU_TEST=1 python -m pytest tests/test_native_infer.py -k real_plugin -q
 run flash     2400 python -m pytest tests/test_flash_attention.py -q
 echo "[cashout] done; records in benches/BASELINE_RESULTS.jsonl, logs in $LOGS/"
