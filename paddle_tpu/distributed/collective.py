@@ -39,10 +39,16 @@ class ReduceOp:
     AVG = 4
 
 
+def _pprod(x, axis):
+    # no lax.pprod primitive: gather the axis then reduce locally
+    return jnp.prod(jax.lax.all_gather(x, axis, tiled=False), axis=0)
+
+
 _REDUCE_FNS = {
     ReduceOp.SUM: jax.lax.psum,
     ReduceOp.MAX: jax.lax.pmax,
     ReduceOp.MIN: jax.lax.pmin,
+    ReduceOp.PROD: _pprod,
 }
 
 
@@ -248,6 +254,47 @@ def _data(t):
     return t._data if isinstance(t, Tensor) else t
 
 
+def _is_per_process(g: Group, x) -> bool:
+    """Regime 4: multi-process eager (launcher-spawned, one group member per
+    jax process) with a process-local tensor — the reference's ProcessGroup
+    semantics, where each rank holds its own full tensor."""
+    if jax.process_count() <= 1 or g.nranks != jax.process_count():
+        return False
+    # the tensor must actually be process-local — a global array sharded
+    # along some OTHER mesh axis must not be np.asarray'd here
+    if isinstance(x, jax.Array) and (
+        not x.is_fully_addressable or len(x.sharding.device_set) > 1
+    ):
+        return False
+    # each group member must live on a distinct process, or the per-process
+    # local block handed to make_array_from_process_local_data is wrong
+    devs = g.mesh.devices
+    names = list(g.mesh.axis_names)
+    if g.axis not in names:
+        return False
+    ax = names.index(g.axis)
+    idx = [0] * devs.ndim
+    procs = set()
+    for i in range(devs.shape[ax]):
+        idx[ax] = i
+        procs.add(devs[tuple(idx)].process_index)
+    return len(procs) == g.nranks
+
+
+def _per_process_collective(g: Group, x, kind, op):
+    """Assemble a (nranks, *shape) global array from each process's local
+    tensor, run the one-op shard_map program over the group axis, and return
+    the (replicated) result array of shape (k, *shape)."""
+    spec = (g.axis,) + (None,) * x.ndim
+    sharding = NamedSharding(g.mesh, PartitionSpec(*spec))
+    garr = jax.make_array_from_process_local_data(sharding, np.asarray(x)[None])
+    fn = _shard_map_collective(g.mesh, g.axis, kind, op, garr.shape, str(garr.dtype), spec)
+    out = fn(garr)
+    # output is replicated along the group axis: this process's shard is the
+    # whole value
+    return jnp.asarray(out.addressable_shards[0].data)
+
+
 # ---------------------------------------------------------------------------
 # collectives
 
@@ -259,6 +306,12 @@ def all_reduce(tensor, op=ReduceOp.SUM, group: Optional[Group] = None, sync_op: 
     if _is_traced(x):
         red = _REDUCE_FNS.get(op, jax.lax.psum) if op != ReduceOp.AVG else jax.lax.pmean
         out = red(x, g.axis)
+        if isinstance(tensor, Tensor):
+            tensor._data = out
+            return tensor
+        return out
+    if _is_per_process(g, x):
+        out = _per_process_collective(g, x, "all_reduce", op)[0]
         if isinstance(tensor, Tensor):
             tensor._data = out
             return tensor
@@ -281,6 +334,10 @@ def all_gather(tensor_list: list, tensor, group: Optional[Group] = None, sync_op
         out = jax.lax.all_gather(x, g.axis, tiled=False)
         tensor_list.extend(Tensor(out[i]) for i in range(g.nranks))
         return tensor_list
+    if _is_per_process(g, x):
+        out = _per_process_collective(g, x, "all_gather", ReduceOp.SUM)
+        tensor_list.extend(Tensor(out[i, 0]) for i in range(out.shape[0]))
+        return tensor_list
     if g.nranks <= 1 or not _axis_in_sharding(x, g.axis):
         tensor_list.append(tensor if isinstance(tensor, Tensor) else Tensor(x))
         return tensor_list
@@ -302,6 +359,12 @@ def broadcast(tensor, src: int = 0, group: Optional[Group] = None, sync_op: bool
     if _is_traced(x):
         # broadcast from src along the bound axis: select src's value
         out = jax.lax.all_gather(x, g.axis, tiled=False)[src_idx]
+        if isinstance(tensor, Tensor):
+            tensor._data = out
+            return tensor
+        return out
+    if _is_per_process(g, x):
+        out = _per_process_collective(g, x, "broadcast", src_idx)[0]
         if isinstance(tensor, Tensor):
             tensor._data = out
             return tensor

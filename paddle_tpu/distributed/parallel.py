@@ -19,6 +19,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec
 
 from ..core.tensor import Tensor
@@ -45,6 +46,11 @@ def init_parallel_env() -> Optional[Group]:
     rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
     if len(eps) > 1 and os.environ.get("PADDLE_TPU_DIST_INIT", "1") == "1":
         try:
+            # CPU multi-process (the spawn-and-compare test regime and any
+            # CPU fallback cluster) needs a cross-process collective
+            # transport; gloo is jaxlib's CPU implementation. No-op on TPU,
+            # where collectives ride ICI/DCN inside the compiled program.
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
             jax.distributed.initialize(
                 coordinator_address=eps[0],
                 num_processes=len(eps),
@@ -82,15 +88,33 @@ def get_world_size() -> int:
 
 def shard_batch(t, axis: str = "data", batch_dim: int = 0):
     """Place a host batch onto the mesh, sharded along ``axis`` at
-    ``batch_dim`` (the DP input contract; DistributedBatchSampler analog for
-    the single-controller model)."""
+    ``batch_dim``.
+
+    Single-process (single-controller): ``t`` is the GLOBAL batch,
+    device_put splits it over the axis. Multi-process (launcher-spawned,
+    one jax process per host): ``t`` is this process's LOCAL batch — the
+    per-rank loading contract of DistributedBatchSampler — and the global
+    array is assembled from the per-process shards.
+    """
     mesh = mesh_mod.ensure_mesh()
     if mesh.shape.get(axis, 1) <= 1:
         return t
     data = t._data if isinstance(t, Tensor) else jnp.asarray(t)
+    if isinstance(data, jax.Array) and len(data.sharding.device_set) > 1:
+        from .collective import _axis_in_sharding
+
+        if _axis_in_sharding(data, axis) or jax.process_count() > 1:
+            # already placed along the axis (e.g. re-entering forward), or a
+            # global multi-process array whose host value is unreachable —
+            # leave placement alone either way
+            return t if isinstance(t, Tensor) else Tensor(data)
     spec = [None] * data.ndim
     spec[batch_dim] = axis
-    arr = jax.device_put(data, NamedSharding(mesh, PartitionSpec(*spec)))
+    sharding = NamedSharding(mesh, PartitionSpec(*spec))
+    if jax.process_count() > 1:
+        arr = jax.make_array_from_process_local_data(sharding, np.asarray(data))
+    else:
+        arr = jax.device_put(data, sharding)
     if isinstance(t, Tensor):
         return Tensor(arr, stop_gradient=t.stop_gradient)
     return Tensor(arr)
