@@ -43,6 +43,17 @@ class DistributedStrategy:
         self.find_unused_parameters = False
         self.fuse_all_reduce_ops = True  # XLA does this; kept for parity
         self.without_graph_optimization = False
+        # PS async-training knobs (ref distributed_strategy.py a_sync):
+        # a_sync=True, k_steps==0 -> AsyncCommunicator (merged bg pushes);
+        # k_steps>0 -> GeoCommunicator (local replica + delta sync).
+        # Consumed by paddle.distributed.ps.create_communicator.
+        self.a_sync = False
+        self.a_sync_configs = {
+            "k_steps": 0,
+            "max_merge_var_num": 4,
+            "send_queue_size": 16,
+            "geo_need_push_nums": 100,
+        }
 
     def __setattr__(self, k, v):
         object.__setattr__(self, k, v)

@@ -24,6 +24,10 @@ User surface:
   SparseTableClient — sharded pull/push/save/load client
   PSEmbedding       — nn.Layer; forward pulls rows, backward pushes grads
                       (a PyLayer: the table is *not* a device parameter)
+  AsyncCommunicator / GeoCommunicator / create_communicator — async and
+                      geo-async training modes (client-side grad merge +
+                      background flush; local-replica SGD + delta sync) —
+                      see communicator.py
   init_from_env / start_local_cluster — the_one_ps-style orchestration
 """
 from __future__ import annotations
@@ -475,3 +479,10 @@ def run_server(dim: int, port: int, rule: str = "sgd", init_range: float = 0.01,
     """Server-side: host one table shard on ``port`` (fleet.run_server)."""
     return EmbeddingServer(dim, rule=rule, port=port, init_range=init_range,
                            seed=seed)
+
+
+from .communicator import (  # noqa: E402  (re-export; see communicator.py)
+    AsyncCommunicator,
+    GeoCommunicator,
+    create_communicator,
+)

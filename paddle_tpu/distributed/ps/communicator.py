@@ -1,0 +1,318 @@
+"""Async / geo-async communicators for the PS path.
+
+The reference's async training story is client-side grad merge + background
+flush threads with bounded staleness
+(``AsyncCommunicator``, ref:paddle/fluid/distributed/ps/service/communicator/
+communicator.h:427) and, for geo mode, local SGD on a client-side replica
+with periodic delta push + fresh pull (``GeoCommunicator``, :597). On a real
+pod the synchronous DCN round-trip per step is the throughput ceiling for
+Wide&Deep-class models; these communicators take the push (and, for geo,
+the pull too) off the training loop's critical path.
+
+Both expose the ``pull/push/dim`` surface of :class:`SparseTableClient`, so
+``PSEmbedding(communicator)`` is a drop-in swap for ``PSEmbedding(client)``.
+
+Staleness contract:
+  * ``AsyncCommunicator`` — pulls are synchronous (always fresh); pushes
+    queue onto a background sender that merges up to ``max_merge_var_num``
+    pending batches by id before one wire push. The queue is bounded by
+    ``send_queue_size`` — a full queue blocks the trainer, which is the
+    staleness bound (ref knob communicator_send_queue_size).
+  * ``GeoCommunicator`` — trains on a local replica (SGD applied client
+    side), accumulates per-id deltas, and every ``geo_need_push_nums``
+    distinct dirty ids ships the deltas and re-pulls those rows (picking up
+    other workers' deltas). Requires the server's ``sgd`` rule: delta push
+    is ``row -= 1.0 * delta``, which only composes with a linear update.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+
+def _merge_by_id(ids: np.ndarray, grads: np.ndarray):
+    """Sum duplicate-id grads (the communicator's merge_add,
+    ref:paddle/fluid/distributed/ps/service/communicator/communicator.cc
+    MergeVars role)."""
+    uniq, inverse = np.unique(ids, return_inverse=True)
+    merged = np.zeros((len(uniq), grads.shape[1]), np.float32)
+    np.add.at(merged, inverse, grads)
+    return uniq, merged
+
+
+class AsyncCommunicator:
+    """Background-flushed pushes with client-side grad merge."""
+
+    def __init__(self, client, max_merge_var_num: int = 4,
+                 send_queue_size: int = 16):
+        self.client = client
+        self.dim = client.dim
+        self.max_merge_var_num = max(1, int(max_merge_var_num))
+        self._q: queue.Queue = queue.Queue(maxsize=max(1, int(send_queue_size)))
+        self._err: Optional[BaseException] = None
+        self._stopping = threading.Event()
+        self._sent_batches = 0  # wire pushes (for tests/introspection)
+        self._thread = threading.Thread(target=self._main, daemon=True)
+        self._thread.start()
+
+    # ------------------------------------------------------------- surface
+    def pull(self, ids: np.ndarray) -> np.ndarray:
+        self._raise_if_failed()
+        return self.client.pull(ids)
+
+    def push(self, ids: np.ndarray, grads: np.ndarray, lr: float):
+        """Enqueue; blocks when ``send_queue_size`` batches are unflushed
+        (the bounded-staleness backpressure)."""
+        self._raise_if_failed()
+        if self._stopping.is_set():
+            raise RuntimeError("communicator is stopped")
+        self._q.put((np.ascontiguousarray(ids, np.uint64),
+                     np.ascontiguousarray(grads, np.float32), float(lr)))
+
+    def flush(self):
+        """Barrier: returns when every queued push has hit the servers."""
+        self._q.join()
+        self._raise_if_failed()
+
+    def stop(self):
+        if not self._stopping.is_set():
+            self._q.join()
+            self._stopping.set()
+            self._thread.join()
+        self._raise_if_failed()
+
+    # save/load/stats pass through (they are control-plane, keep them sync)
+    def __getattr__(self, name):
+        return getattr(self.client, name)
+
+    # ------------------------------------------------------------ internals
+    def _raise_if_failed(self):
+        if self._err is not None:
+            raise RuntimeError(f"async communicator send failed: {self._err}")
+
+    def _main(self):
+        while True:
+            try:
+                batch = [self._q.get(timeout=0.05)]
+            except queue.Empty:
+                if self._stopping.is_set():
+                    return
+                continue
+            while len(batch) < self.max_merge_var_num:
+                try:
+                    batch.append(self._q.get_nowait())
+                except queue.Empty:
+                    break
+            try:
+                if self._err is None:
+                    self._send(batch)
+            except BaseException as e:  # surface on next push/flush
+                self._err = e
+            finally:
+                for _ in batch:
+                    self._q.task_done()
+
+    def _send(self, batch):
+        # merge only same-lr entries: sum(lr_i*g_i) == lr*sum(g_i) needs one lr
+        by_lr: Dict[float, list] = {}
+        for ids, grads, lr in batch:
+            by_lr.setdefault(lr, []).append((ids, grads))
+        for lr, items in by_lr.items():
+            ids = np.concatenate([i for i, _ in items])
+            grads = np.concatenate([g for _, g in items])
+            uniq, merged = _merge_by_id(ids, grads)
+            self.client.push(uniq, merged, lr)
+            self._sent_batches += 1
+
+
+class GeoCommunicator:
+    """Local-replica SGD with periodic delta sync (geo-async mode)."""
+
+    def __init__(self, client, geo_need_push_nums: int = 100,
+                 send_queue_size: int = 4):
+        self.client = client
+        self.dim = client.dim
+        self.geo_need_push_nums = max(1, int(geo_need_push_nums))
+        self._cache: Dict[int, np.ndarray] = {}   # id -> local row replica
+        self._delta: Dict[int, np.ndarray] = {}   # id -> subtracted-sum since last sync
+        # swapped-out-but-not-landed deltas: id -> [pending_batches, sum].
+        # Without this ledger a landing sync would restore fresh-server rows
+        # that silently un-apply updates sitting in still-queued batches.
+        self._inflight: Dict[int, list] = {}
+        self._lock = threading.Lock()
+        self._q: queue.Queue = queue.Queue(maxsize=max(1, int(send_queue_size)))
+        self._err: Optional[BaseException] = None
+        self._stopping = threading.Event()
+        self._syncs = 0
+        self._thread = threading.Thread(target=self._main, daemon=True)
+        self._thread.start()
+
+    # ------------------------------------------------------------- surface
+    def pull(self, ids: np.ndarray) -> np.ndarray:
+        self._raise_if_failed()
+        ids = np.ascontiguousarray(ids, np.uint64)
+        with self._lock:
+            missing = list(dict.fromkeys(
+                int(i) for i in ids if int(i) not in self._cache))
+        if missing:
+            rows = self.client.pull(np.array(missing, np.uint64))
+            with self._lock:
+                for i, mid in enumerate(missing):
+                    # a concurrent refresh may have landed a fresher row
+                    self._cache.setdefault(mid, rows[i].copy())
+        with self._lock:
+            return np.stack([self._cache[int(i)] for i in ids])
+
+    def push(self, ids: np.ndarray, grads: np.ndarray, lr: float):
+        """Apply SGD to the local replica immediately; accumulate the delta
+        for the next background sync."""
+        self._raise_if_failed()
+        if self._stopping.is_set():
+            raise RuntimeError("communicator is stopped")
+        ids = np.ascontiguousarray(ids, np.uint64)
+        grads = np.ascontiguousarray(grads, np.float32)
+        uniq, merged = _merge_by_id(ids, grads)
+        # first-touch rows (push without a preceding pull): one batched wire
+        # fetch OUTSIDE the lock, not a per-id pull under it
+        with self._lock:
+            missing = [int(u) for u in uniq if int(u) not in self._cache]
+        if missing:
+            rows = self.client.pull(np.array(missing, np.uint64))
+            with self._lock:
+                for i, mid in enumerate(missing):
+                    self._cache.setdefault(mid, rows[i].copy())
+        need_sync = False
+        with self._lock:
+            for i, uid in enumerate(uniq):
+                uid = int(uid)
+                upd = lr * merged[i]
+                self._cache[uid] -= upd
+                d = self._delta.get(uid)
+                if d is None:
+                    self._delta[uid] = upd.copy()
+                else:
+                    d += upd
+            if len(self._delta) >= self.geo_need_push_nums:
+                ids_arr, deltas = self._swap_out_locked()
+                need_sync = True
+        if need_sync:
+            self._q.put((ids_arr, deltas))  # blocks when syncs back up
+
+    def _swap_out_locked(self):
+        """Move _delta into the in-flight ledger; caller holds _lock."""
+        ids_arr = np.array(list(self._delta.keys()), np.uint64)
+        deltas = np.stack(list(self._delta.values()))
+        for i, uid in enumerate(ids_arr):
+            uid = int(uid)
+            ent = self._inflight.get(uid)
+            if ent is None:
+                self._inflight[uid] = [1, deltas[i].copy()]
+            else:
+                ent[0] += 1
+                ent[1] += deltas[i]
+        self._delta = {}
+        return ids_arr, deltas
+
+    def flush(self):
+        """Ship any pending deltas and wait for all syncs to land."""
+        self._raise_if_failed()
+        with self._lock:
+            ids_arr = None
+            if self._delta:
+                ids_arr, deltas = self._swap_out_locked()
+        if ids_arr is not None:
+            self._q.put((ids_arr, deltas))
+        self._q.join()
+        self._raise_if_failed()
+
+    def stop(self):
+        if not self._stopping.is_set():
+            self.flush()
+            self._stopping.set()
+            self._thread.join()
+        self._raise_if_failed()
+
+    def __getattr__(self, name):
+        return getattr(self.client, name)
+
+    # ------------------------------------------------------------ internals
+    def _raise_if_failed(self):
+        if self._err is not None:
+            raise RuntimeError(f"geo communicator sync failed: {self._err}")
+
+    def _main(self):
+        while True:
+            try:
+                ids, deltas = self._q.get(timeout=0.05)
+            except queue.Empty:
+                if self._stopping.is_set():
+                    return
+                continue
+            try:
+                if self._err is None:
+                    self._sync(ids, deltas)
+            except BaseException as e:
+                self._err = e
+            finally:
+                self._q.task_done()
+
+    def _sync(self, ids: np.ndarray, deltas: np.ndarray):
+        # server sgd rule is row -= lr*g: lr=1.0 applies the raw delta
+        self.client.push(ids, deltas, 1.0)
+        fresh = self.client.pull(ids)
+        with self._lock:
+            for i, uid in enumerate(ids):
+                uid = int(uid)
+                # retire this batch from the in-flight ledger
+                ent = self._inflight.get(uid)
+                if ent is not None:
+                    ent[0] -= 1
+                    if ent[0] <= 0:
+                        del self._inflight[uid]
+                    else:
+                        ent[1] -= deltas[i]
+                row = fresh[i].copy()
+                # keep everything the trainer applied that the server has
+                # not seen yet: un-swapped deltas AND still-queued batches
+                pend = self._delta.get(uid)
+                if pend is not None:
+                    row -= pend
+                ent = self._inflight.get(uid)
+                if ent is not None:
+                    row -= ent[1]
+                self._cache[uid] = row
+        self._syncs += 1
+
+
+def create_communicator(client, strategy=None, mode: Optional[str] = None,
+                        **configs):
+    """Map fleet ``DistributedStrategy`` async knobs to a communicator.
+
+    ref:python/paddle/distributed/fleet/base/distributed_strategy.py
+    ``a_sync``/``a_sync_configs``: a_sync=False -> the plain (synchronous)
+    client; a_sync=True with k_steps==0 -> AsyncCommunicator; k_steps>0 ->
+    GeoCommunicator. ``mode`` ("sync"|"async"|"geo") overrides.
+    """
+    if mode is None:
+        if strategy is None or not getattr(strategy, "a_sync", False):
+            mode = "sync"
+        else:
+            cfg = dict(getattr(strategy, "a_sync_configs", {}) or {})
+            configs = {**cfg, **configs}
+            mode = "geo" if int(cfg.get("k_steps", 0) or 0) > 0 else "async"
+    if mode == "sync":
+        return client
+    if mode == "async":
+        return AsyncCommunicator(
+            client,
+            max_merge_var_num=int(configs.get("max_merge_var_num", 4)),
+            send_queue_size=int(configs.get("send_queue_size", 16)))
+    if mode == "geo":
+        return GeoCommunicator(
+            client,
+            geo_need_push_nums=int(configs.get("geo_need_push_nums", 100)),
+            send_queue_size=int(configs.get("send_queue_size", 4)))
+    raise ValueError(f"unknown communicator mode {mode!r}")
