@@ -201,27 +201,54 @@ def bench_widedeep():
             embedding_dim=16)
         opt = paddle.optimizer.Adam(learning_rate=1e-3,
                                     parameters=model.parameters())
-        rng = np.random.default_rng(0)
-        sparse = rng.integers(0, 1 << 40, (batch, 26)).astype(np.int64)
-        dense = rng.standard_normal((batch, 13)).astype(np.float32)
-        labels = paddle.to_tensor(
-            (rng.random((batch, 1)) > 0.5).astype(np.float32))
 
-        def step():
-            logits = model(paddle.to_tensor(sparse), paddle.to_tensor(dense))
-            loss = model.loss(logits, labels)
+        # feed through the PS ingestion path (InMemoryDataset: file-list
+        # load -> in-RAM shuffle -> collated batches), not raw arrays
+        import tempfile
+
+        from paddle_tpu.distributed import InMemoryDataset
+
+        rng = np.random.default_rng(0)
+        tmpd = tempfile.mkdtemp(prefix="wd_data_")
+        files = []
+        rows_per_file = batch * 3
+        for fi in range(4):
+            lines = []
+            for _ in range(rows_per_file):
+                label = int(rng.random() > 0.5)
+                dense_s = ",".join(f"{v:.4f}" for v in rng.standard_normal(13))
+                sparse_s = ",".join(str(int(v))
+                                    for v in rng.integers(0, 1 << 40, 26))
+                lines.append(f"{label}\t{dense_s}\t{sparse_s}")
+            p = os.path.join(tmpd, f"part-{fi}.txt")
+            with open(p, "w") as f:
+                f.write("\n".join(lines) + "\n")
+            files.append(p)
+        ds = InMemoryDataset()
+        ds.init(batch_size=batch)
+        ds.set_filelist(files)
+        ds.load_into_memory(is_shuffle=True)
+
+        def step(sparse_b, dense_b, label_b):
+            logits = model(paddle.to_tensor(sparse_b),
+                           paddle.to_tensor(dense_b))
+            loss = model.loss(logits, paddle.to_tensor(label_b))
             loss.backward()
             opt.step()
             opt.clear_grad()
             return loss
 
-        step()  # warm
-        step()
+        it = iter(ds.epochs(100))
+        step(*next(it))  # warm
+        step(*next(it))
         t0 = time.perf_counter()
         iters = 8
         for _ in range(iters):
-            loss = step()
+            loss = step(*next(it))
         dt = (time.perf_counter() - t0) / iters
+        import shutil
+
+        shutil.rmtree(tmpd, ignore_errors=True)
         rows, nbytes = model.embedding.client.stats()
         _emit({"config": "widedeep-ps", "samples_per_sec": round(batch / dt, 1),
                "batch": batch, "step_ms": round(dt * 1e3, 2),
@@ -238,6 +265,14 @@ CONFIGS = {"lenet": bench_lenet, "resnet50": bench_resnet50,
 
 
 def main():
+    # PADDLE_TPU_BENCH_PLATFORM=cpu pins the backend BEFORE first device
+    # query — the sandbox sitecustomize force-selects the tunneled TPU,
+    # which hangs every bench when the tunnel is wedged
+    want = os.environ.get("PADDLE_TPU_BENCH_PLATFORM")
+    if want:
+        import jax
+
+        jax.config.update("jax_platforms", want)
     names = sys.argv[1:] or list(CONFIGS)
     for name in names:
         CONFIGS[name]()

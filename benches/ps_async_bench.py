@@ -22,10 +22,11 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from benches._common import emit  # noqa: E402
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# host-side bench (tables + numpy): never initialize the TPU tunnel
+os.environ["JAX_PLATFORMS"] = "cpu"
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"].split(",")[0])
+jax.config.update("jax_platforms", "cpu")
 
 from paddle_tpu.distributed import ps  # noqa: E402
 from paddle_tpu.distributed.ps import create_communicator  # noqa: E402

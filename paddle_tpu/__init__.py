@@ -172,8 +172,8 @@ def get_cuda_rng_state():
     return get_rng_state()
 
 
-def set_cuda_rng_state(state):
-    return set_rng_state(state)
+def set_cuda_rng_state(state_list):
+    return set_rng_state(state_list)
 
 
 def flops(net, input_size, custom_ops=None, print_detail=False):

@@ -30,19 +30,19 @@ def _key():
     return _global["key"]
 
 
-def seed(s: int):
+def seed(seed: int):
     """paddle.seed equivalent."""
-    _global["key"] = jax.random.key(int(s))
-    _global["seed"] = int(s)
-    return s
+    _global["key"] = jax.random.key(int(seed))
+    _global["seed"] = int(seed)
+    return seed
 
 
-def get_rng_state():
-    return _key()
+def get_rng_state(device=None):
+    return _key()  # one accelerator RNG stream; device selects nothing here
 
 
-def set_rng_state(key):
-    _global["key"] = key
+def set_rng_state(state_list, device=None):
+    _global["key"] = state_list
 
 
 def _guard_stack():

@@ -179,72 +179,10 @@ class ParallelMode:
 # ------------------------------------------------------------- PS datasets
 
 
-class InMemoryDataset:
-    """Host-RAM training dataset for PS workloads
-    (ref:python/paddle/distributed/fleet/dataset/dataset.py InMemoryDataset):
-    load text samples into memory, shuffle globally, batch for the trainer."""
-
-    def __init__(self):
-        self._samples: List = []
-        self._parse_fn = None
-        self._batch_size = 1
-        self._shuffled = False
-
-    def init(self, batch_size=1, use_var=None, pipe_command=None,
-             parse_fn=None, **kw):
-        self._batch_size = batch_size
-        self._parse_fn = parse_fn
-
-    set_batch_size = init
-
-    def set_filelist(self, files):
-        self._files = list(files)
-
-    def load_into_memory(self):
-        self._samples = []
-        for path in getattr(self, "_files", []):
-            with open(path) as f:
-                for line in f:
-                    line = line.rstrip("\n")
-                    self._samples.append(
-                        self._parse_fn(line) if self._parse_fn else line)
-
-    def local_shuffle(self):
-        np.random.shuffle(self._samples)
-        self._shuffled = True
-
-    def global_shuffle(self, fleet=None, thread_num=12):
-        self.local_shuffle()
-
-    def get_memory_data_size(self, fleet=None):
-        return len(self._samples)
-
-    def release_memory(self):
-        self._samples = []
-
-    def __iter__(self):
-        for i in range(0, len(self._samples), self._batch_size):
-            yield self._samples[i:i + self._batch_size]
-
-
-class QueueDataset(InMemoryDataset):
-    """Streaming variant (ref QueueDataset): iterates files lazily."""
-
-    def load_into_memory(self):  # streaming: nothing to preload
-        pass
-
-    def __iter__(self):
-        batch = []
-        for path in getattr(self, "_files", []):
-            with open(path) as f:
-                for line in f:
-                    line = line.rstrip("\n")
-                    batch.append(self._parse_fn(line) if self._parse_fn else line)
-                    if len(batch) == self._batch_size:
-                        yield batch
-                        batch = []
-        if batch:
-            yield batch
+# InMemoryDataset/QueueDataset moved to fleet.dataset (file-list sharding
+# across workers, real global shuffle, collated numpy batches); re-exported
+# here for the paddle.distributed.* binding the reference also has.
+from .fleet.dataset import InMemoryDataset, QueueDataset  # noqa: E402,F401
 
 
 # -------------------------------------------------- sparse accessor entries

@@ -188,6 +188,13 @@ def candidate_strategies(n_devices: int, param_count: int,
     return out
 
 
+class _TunerReport(list):
+    """tune()'s trial list [(Strategy, seconds)] plus the platform it was
+    measured on (list subclass: existing positional consumers keep working)."""
+
+    platform: str = "unknown"
+
+
 class Engine:
     """Annotate a model, get a plan, fit (ref engine.py:55,848,1309).
 
@@ -219,6 +226,20 @@ class Engine:
             self.tune(sample_batch=sample_batch, inputs_spec=inputs_spec,
                       labels_spec=labels_spec)
             mode = "train"
+
+        rep = getattr(self, "_tuner_report", None)
+        if rep is not None:
+            cur = jax.devices()[0].platform
+            measured = getattr(rep, "platform", None)
+            if measured is not None and measured != cur:
+                import warnings
+
+                warnings.warn(
+                    f"auto_parallel plan was tuned on '{measured}' but is "
+                    f"being applied on '{cur}': step-time ratios between "
+                    "mesh candidates do not transfer across platforms "
+                    "(CPU has no ICI); re-run Engine.tune() on the target "
+                    "platform", RuntimeWarning, stacklevel=2)
 
         s = self.strategy
         n = len(jax.devices())
@@ -321,6 +342,11 @@ class Engine:
         self._mesh = init_hybrid_mesh(
             dp=w.dp_degree, mp=w.mp_degree, pp=w.pp_degree,
             sharding=w.sharding_degree, sep=w.sep_degree)
+        # stamp the measurement platform: collective/compute ratios measured
+        # on XLA:CPU (no ICI) do NOT transfer to TPU — prepare() warns if a
+        # plan measured here is applied on a different platform
+        report = _TunerReport(report)
+        report.platform = jax.devices()[0].platform
         self._tuner_report = report
         return report
 

@@ -54,6 +54,15 @@ class DistributedStrategy:
             "send_queue_size": 16,
             "geo_need_push_nums": 100,
         }
+        # large-batch LARS (ref meta_optimizers/lars_optimizer.py:23):
+        # distributed_optimizer upgrades a Momentum to LarsMomentum
+        self.lars = False
+        self.lars_configs = {
+            "lars_coeff": 0.001,
+            "lars_weight_decay": 0.0005,
+            "epsilon": 0.0,
+            "exclude_from_weight_decay": [],
+        }
 
     def __setattr__(self, k, v):
         object.__setattr__(self, k, v)
@@ -144,9 +153,41 @@ def distributed_model(model):
 
 def distributed_optimizer(optimizer, strategy: Optional[DistributedStrategy] = None):
     """The optimizer update is compiled into the sharded step; optimizer-state
-    sharding (ZeRO) comes from the 'sharding' mesh axis, not a wrapper."""
+    sharding (ZeRO) comes from the 'sharding' mesh axis, not a wrapper.
+
+    The lars strategy knob survives as a true meta-optimizer: it upgrades a
+    Momentum to LarsMomentum with the strategy's coefficients
+    (ref:python/paddle/distributed/fleet/meta_optimizers/lars_optimizer.py:23).
+    """
     if strategy is not None:
         _state.strategy = strategy
+    strategy = strategy or _state.strategy
+    if strategy is not None and getattr(strategy, "lars", False):
+        from ...optimizer import LarsMomentum, Momentum
+
+        if isinstance(optimizer, Momentum) and not isinstance(optimizer, LarsMomentum):
+            cfg = dict(getattr(strategy, "lars_configs", {}) or {})
+            if getattr(optimizer, "_use_nesterov", False) or \
+                    getattr(optimizer, "_weight_decay", 0.0):
+                import warnings
+
+                warnings.warn(
+                    "strategy.lars replaces the Momentum update entirely: "
+                    "use_nesterov and the optimizer's own weight_decay are "
+                    "dropped (LARS uses lars_configs['lars_weight_decay'], "
+                    "as the reference meta-optimizer does)", UserWarning,
+                    stacklevel=2)
+            optimizer = LarsMomentum(
+                learning_rate=optimizer._learning_rate,
+                momentum=optimizer._momentum,
+                lars_coeff=float(cfg.get("lars_coeff", 0.001)),
+                lars_weight_decay=float(cfg.get("lars_weight_decay", 0.0005)),
+                parameters=optimizer._parameter_list,
+                grad_clip=optimizer._grad_clip,
+                exclude_from_weight_decay=cfg.get(
+                    "exclude_from_weight_decay", []),
+                epsilon=float(cfg.get("epsilon", 0.0)),
+                rescale_grad=float(getattr(optimizer, "_rescale_grad", 1.0)))
     return optimizer
 
 
@@ -264,3 +305,7 @@ def init_worker():
 
 
 from . import utils  # noqa: F401,E402  (LocalFS/HDFSClient/recompute)
+
+
+from . import dataset  # noqa: E402  (fleet dataset module)
+from .dataset import InMemoryDataset, QueueDataset  # noqa: E402,F401

@@ -1,0 +1,209 @@
+"""Fleet datasets: file-list-sharded, shuffle-in-RAM streaming ingestion.
+
+The reference's PS workloads don't read through DataLoader — they point an
+``InMemoryDataset`` at a file list, each worker loads ITS share of the
+files into RAM, shuffles there (locally or globally across workers), and
+the trainer drains merged epochs
+(ref:python/paddle/distributed/fleet/dataset/dataset.py:350
+InMemoryDataset, :857 load_into_memory, :969 local_shuffle, :1001
+global_shuffle; C++ ref:paddle/fluid/framework/data_set.cc).
+
+TPU-native redesign: no proto DataFeed / pipe_command subprocess — a line
+parser runs in-process and batches collate to numpy, feeding the same
+training loop the PS path already uses. The distributed contract is kept:
+files shard ``rank::nranks`` over the launcher env, and global_shuffle
+repartitions samples across workers by hash.
+"""
+from __future__ import annotations
+
+import random
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+from .. import env
+
+
+def default_parse(line: str):
+    """Criteo-style text: ``label<TAB>d1,...,dN<TAB>s1,...,sM`` with float
+    dense features and integer feature hashes. Returns
+    (sparse int64 [M], dense float32 [N], label float32 [1])."""
+    parts = line.rstrip("\n").split("\t")
+    if len(parts) != 3 or not parts[0]:
+        return None
+    label = np.asarray([float(parts[0])], np.float32)
+    dense = (np.array(parts[1].split(","), np.float32)
+             if parts[1] else np.zeros(0, np.float32))
+    sparse = (np.array(parts[2].split(","), np.int64)
+              if parts[2] else np.zeros(0, np.int64))
+    return sparse, dense, label
+
+
+class DatasetBase:
+    def __init__(self):
+        self._filelist: List[str] = []
+        self._batch_size = 1
+        self._parse: Callable = default_parse
+        self._samples: list = []
+        self._seed = 0
+
+    def init(self, batch_size: int = 1, thread_num: int = 1, use_var=None,
+             pipe_command: Optional[str] = None, input_type: int = 0,
+             fs_name: str = "", fs_ugi: str = "", download_cmd: str = "cat",
+             parse_func: Optional[Callable] = None,
+             parse_fn: Optional[Callable] = None, **kwargs):
+        """Reference knob set accepted; pipe_command/fs_* are the static
+        DataFeed/HDFS controls — parsing is in-process here (parse_func;
+        parse_fn kept as the pre-round-4 alias)."""
+        self._batch_size = int(batch_size)
+        if parse_func is not None or parse_fn is not None:
+            self._parse = parse_func or parse_fn
+        return self
+
+    def set_filelist(self, filelist: Sequence[str]):
+        self._filelist = list(filelist)
+
+    def set_parse_func(self, fn: Callable):
+        self._parse = fn
+
+    def _my_files(self) -> List[str]:
+        """File-list sharding: worker ``rank`` owns files[rank::nranks]
+        (the reference's dataset file dispatch)."""
+        rank, n = env.get_rank(), max(env.get_world_size(), 1)
+        return self._filelist[rank::n]
+
+
+class InMemoryDataset(DatasetBase):
+    """Load-into-RAM dataset with local/global shuffle and epoch-merged
+    batch feeding (the PS ingestion path)."""
+
+    def load_into_memory(self, is_shuffle: bool = False):
+        self._samples = []
+        skipped = 0
+        for path in self._my_files():
+            with open(path, "r", encoding="utf-8") as f:
+                for line in f:
+                    s = self._parse(line)
+                    if s is not None:
+                        self._samples.append(s)
+                    else:
+                        skipped += 1
+        self._skipped = skipped
+        if skipped and not self._samples:
+            import warnings
+
+            warnings.warn(
+                f"InMemoryDataset: parser rejected all {skipped} lines — "
+                "the default parser expects 'label<TAB>dense<TAB>sparse'; "
+                "pass parse_func= for other formats", RuntimeWarning,
+                stacklevel=2)
+        if is_shuffle:
+            self.local_shuffle()
+
+    def preload_into_memory(self, thread_num=None):
+        self.load_into_memory()
+
+    def wait_preload_done(self):
+        pass
+
+    def local_shuffle(self):
+        rng = random.Random(self._seed)
+        self._seed += 1
+        rng.shuffle(self._samples)
+
+    def global_shuffle(self, fleet=None, thread_num: int = 12):
+        """Repartition samples across workers, then shuffle locally (the
+        reference's fleet_send exchange). Runs in nproc ROUNDS — round d
+        gathers only the samples destined to worker d — so peak extra
+        memory stays ~total/nproc instead of the whole dataset per worker.
+        Single process: local shuffle only."""
+        import jax
+
+        nproc = jax.process_count()
+        if nproc > 1:
+            from ..collective import all_gather_object
+
+            rank = env.get_rank()
+            # deterministic scatter: position-and-rank hashed destination
+            # (every rank computes its own routing independently)
+            dests = [(i * 2654435761 + rank * 40503) % nproc
+                     for i in range(len(self._samples))]
+            mine: list = []
+            for d in range(nproc):
+                batch = [s for s, dd in zip(self._samples, dests) if dd == d]
+                got: list = []
+                all_gather_object(got, batch)
+                if d == rank:
+                    mine = [s for worker in got for s in worker]
+                del got
+            self._samples = mine
+        self.local_shuffle()
+
+    def get_memory_data_size(self, fleet=None) -> int:
+        n = len(self._samples)
+        import jax
+
+        if jax.process_count() > 1:
+            from ..collective import all_gather_object
+
+            got: list = []
+            all_gather_object(got, n)
+            n = sum(got)
+        return n
+
+    def release_memory(self):
+        self._samples = []
+
+    def get_shuffle_data_size(self, fleet=None) -> int:
+        return self.get_memory_data_size(fleet)
+
+    # ------------------------------------------------------------- feeding
+    def __len__(self):
+        return (len(self._samples) + self._batch_size - 1) // self._batch_size
+
+    def __iter__(self):
+        """One epoch of collated numpy batches (fields stacked per sample
+        position — fields must be fixed-width across samples; pad ragged
+        sparse slots in parse_func). The remainder batch is kept, as the
+        reference feed does."""
+        b = self._batch_size
+        for lo in range(0, len(self._samples), b):
+            chunk = self._samples[lo:lo + b]
+            try:
+                yield tuple(np.stack([s[i] for s in chunk])
+                            for i in range(len(chunk[0])))
+            except ValueError as e:
+                raise ValueError(
+                    "InMemoryDataset collation failed — samples have "
+                    "ragged field shapes (e.g. variable sparse-slot "
+                    "lengths); make parse_func pad/truncate to fixed "
+                    f"width: {e}") from e
+
+    def epochs(self, n: int, shuffle_each: bool = True):
+        """Epoch-merged feeding: n passes, reshuffling between them."""
+        for _ in range(n):
+            if shuffle_each:
+                self.local_shuffle()
+            yield from self
+
+
+class QueueDataset(DatasetBase):
+    """Streaming (non-resident) variant: batches parse straight off the
+    worker's file shard (ref dataset.py QueueDataset)."""
+
+    def __iter__(self):
+        buf = []
+        for path in self._my_files():
+            with open(path, "r", encoding="utf-8") as f:
+                for line in f:
+                    s = self._parse(line)
+                    if s is None:
+                        continue
+                    buf.append(s)
+                    if len(buf) == self._batch_size:
+                        yield tuple(np.stack([s[i] for s in buf])
+                                    for i in range(len(buf[0])))
+                        buf = []
+        if buf:
+            yield tuple(np.stack([s[i] for s in buf])
+                        for i in range(len(buf[0])))

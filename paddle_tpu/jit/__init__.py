@@ -194,8 +194,10 @@ class TrainStep:
                 grads = opt._grad_clip._clip_arrays(grads)
             step = opt_state["step"] + 1
             new_params, new_slots = [], []
-            for p_arr, g, slots in zip(param_arrays, grads, opt_state["slots"]):
-                np_, ns_ = opt._update(p_arr, g.astype(p_arr.dtype), slots, lr, step)
+            for p_t, p_arr, g, slots in zip(train_params, param_arrays,
+                                            grads, opt_state["slots"]):
+                upd = opt._update_for(getattr(p_t, "name", None))
+                np_, ns_ = upd(p_arr, g.astype(p_arr.dtype), slots, lr, step)
                 new_params.append(np_)
                 new_slots.append(ns_)
             return loss, new_params, {"slots": new_slots, "step": step}, mutated

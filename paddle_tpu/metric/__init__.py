@@ -162,3 +162,6 @@ def accuracy(input, label, k=1, correct=None, total=None, name=None):
         return hit.mean(dtype=jnp.float32)
 
     return apply(_acc, (input, label), {"k": int(k)}, name="accuracy")
+
+
+from .fleet import DistributedAuc, WuAuc  # noqa: E402  (fleet metrics)

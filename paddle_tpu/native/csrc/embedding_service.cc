@@ -189,44 +189,64 @@ class SparseTable {
 
   // Decay counters and evict low-score / long-unseen rows, both resident
   // and spilled (ref:.../memory_sparse_table.cc Shrink + ctr_accessor
-  // show_click_score). Returns the number of rows evicted.
+  // show_click_score; the reference runs this as a table-level background
+  // op, ref:.../ssd_sparse_table.cc). Returns the number of rows evicted.
+  //
+  // Locking: the resident pass holds the shard lock only for its in-memory
+  // map walk. The spill pass snapshots the (id, offset) index, then works
+  // in kShrinkChunk-row chunks, re-acquiring the shard lock per chunk — so
+  // concurrent pulls are never blocked behind file I/O of the whole tier.
+  // Entries that paged in / were re-spilled between snapshot and chunk are
+  // detected by the offset check and skipped; the pread/pwrite stay under
+  // the (chunked) shard lock because compaction swaps spill_fd_ while
+  // holding every shard lock.
   uint64_t Shrink(float threshold, uint32_t max_unseen, float decay) {
+    static constexpr size_t kShrinkChunk = 64;
     uint64_t evicted = 0;
     uint32_t now = tick_.load();
     size_t rec = RecBytes();
     for (auto& s : shards_) {
-      std::lock_guard<std::mutex> lk(s.mu);
-      for (auto it = s.rows.begin(); it != s.rows.end();) {
-        std::vector<float>& row = it->second;
-        row[1] *= decay;
-        row[2] *= decay;
-        if (Doomed(row.data(), now, threshold, max_unseen)) {
-          mem_bytes_ -= kRowOverhead + row_len_ * sizeof(float);
-          it = s.rows.erase(it);
-          ++evicted;
-        } else {
-          ++it;
+      std::vector<std::pair<uint64_t, uint64_t>> snap;  // (id, offset)
+      {
+        std::lock_guard<std::mutex> lk(s.mu);
+        for (auto it = s.rows.begin(); it != s.rows.end();) {
+          std::vector<float>& row = it->second;
+          row[1] *= decay;
+          row[2] *= decay;
+          if (Doomed(row.data(), now, threshold, max_unseen)) {
+            mem_bytes_ -= kRowOverhead + row_len_ * sizeof(float);
+            it = s.rows.erase(it);
+            ++evicted;
+          } else {
+            ++it;
+          }
         }
+        snap.reserve(s.spilled.size());
+        for (auto& kv : s.spilled) snap.emplace_back(kv.first, kv.second);
       }
-      for (auto it = s.spilled.begin(); it != s.spilled.end();) {
-        float meta[kMeta];
-        if (pread(spill_fd_, meta, sizeof(meta),
-                  static_cast<off_t>(it->second + 8)) !=
-            static_cast<ssize_t>(sizeof(meta))) {
-          ++it;
-          continue;
-        }
-        meta[1] *= decay;
-        meta[2] *= decay;
-        if (Doomed(meta, now, threshold, max_unseen)) {
-          spill_garbage_ += rec;
-          it = s.spilled.erase(it);
-          --spill_rows_;
-          ++evicted;
-        } else {
-          pwrite(spill_fd_, meta, sizeof(meta),
-                 static_cast<off_t>(it->second + 8));
-          ++it;
+      for (size_t base = 0; base < snap.size(); base += kShrinkChunk) {
+        std::lock_guard<std::mutex> lk(s.mu);
+        size_t end = std::min(snap.size(), base + kShrinkChunk);
+        for (size_t i = base; i < end; ++i) {
+          auto it = s.spilled.find(snap[i].first);
+          if (it == s.spilled.end() || it->second != snap[i].second)
+            continue;  // paged in or moved since the snapshot
+          float meta[kMeta];
+          if (pread(spill_fd_, meta, sizeof(meta),
+                    static_cast<off_t>(it->second + 8)) !=
+              static_cast<ssize_t>(sizeof(meta)))
+            continue;
+          meta[1] *= decay;
+          meta[2] *= decay;
+          if (Doomed(meta, now, threshold, max_unseen)) {
+            spill_garbage_ += rec;
+            s.spilled.erase(it);
+            --spill_rows_;
+            ++evicted;
+          } else {
+            pwrite(spill_fd_, meta, sizeof(meta),
+                   static_cast<off_t>(it->second + 8));
+          }
         }
       }
     }
@@ -445,18 +465,33 @@ class SparseTable {
     uint64_t target = cfg_.ram_cap_bytes * 7 / 10;
     if (mem_bytes_.load() <= cfg_.ram_cap_bytes) return;
     size_t rec = RecBytes();
+    // balanced eviction: each shard trims to its SHARE of the target.
+    // Draining shards in iteration order until the global target is met
+    // would empty the first shards entirely — hot rows included — while
+    // later shards keep their cold tail (observed as steady-state thrash).
+    size_t per_row = kRowOverhead + row_len_ * sizeof(float);
+    size_t shard_target_rows =
+        std::max<size_t>(target / kShards / per_row, 8);
     for (auto& s : shards_) {
       if (mem_bytes_.load() <= target) break;
       std::lock_guard<std::mutex> lk(s.mu);
-      std::vector<std::pair<uint32_t, uint64_t>> order;  // (tick, id)
+      if (s.rows.size() <= shard_target_rows) continue;
+      // LRU tick first; among same-tick rows (one Pull stamps a whole
+      // batch identically) evict LOW-show rows first — repeatedly-trained
+      // hot rows survive while the batch's fresh long-tail pages out (the
+      // CTR accessor's show-weighted eviction, ref:.../ctr_accessor.cc
+      // ShowClickScore). Without the secondary key an 80/20-skew steady
+      // state thrashes: hot rows evict at random within their own batch.
+      std::vector<std::tuple<uint32_t, float, uint64_t>> order;
       order.reserve(s.rows.size());
       for (auto& kv : s.rows)
-        order.emplace_back(GetTick(kv.second.data()), kv.first);
+        order.emplace_back(GetTick(kv.second.data()), kv.second[1],
+                           kv.first);
       std::sort(order.begin(), order.end());
-      // never evict this shard entirely: hot rows would thrash
-      size_t cap = order.size() - std::min<size_t>(order.size(), 8);
+      // trim only down to this shard's share (and never empty it)
+      size_t cap = order.size() - shard_target_rows;
       for (size_t i = 0; i < cap && mem_bytes_.load() > target; ++i) {
-        uint64_t id = order[i].second;
+        uint64_t id = std::get<2>(order[i]);
         auto it = s.rows.find(id);
         if (it == s.rows.end()) continue;
         uint64_t off = spill_end_.fetch_add(rec);

@@ -22,7 +22,6 @@ _SIMPLE = {
     "softsign": jax.nn.soft_sign,
     "tanhshrink": lambda x: x - jnp.tanh(x),
     "hardswish": jax.nn.hard_swish,
-    "hardsigmoid": lambda x: jnp.clip(x / 6.0 + 0.5, 0.0, 1.0),
     "selu_": jax.nn.selu,
     "elu_": jax.nn.elu,
 }
@@ -32,6 +31,14 @@ for _n, _f in _SIMPLE.items():
         return apply(_f, (x,), {}, name=_n)
 
     setattr(_this, _n.rstrip("_") if _n.endswith("_") else _n, _op)
+
+
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    def _hardsigmoid(x, *, slope, offset):
+        return jnp.clip(x * slope + offset, 0.0, 1.0)
+
+    return apply(_hardsigmoid, (x,),
+                 dict(slope=float(slope), offset=float(offset)))
 
 
 def gelu(x, approximate=False, name=None):
@@ -81,7 +88,7 @@ def prelu(x, weight, data_format="NCHW", name=None):
     return apply(_prelu, (x, weight), dict(data_format=data_format))
 
 
-def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=False, name=None):
+def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=True, name=None):
     if not training:
         return leaky_relu(x, (lower + upper) / 2.0)
     from ...core import rng

@@ -141,7 +141,7 @@ def conv1d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0
     return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 1, data_format, transpose=True, output_padding=output_padding)
 
 
-def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0, groups=1, dilation=1, output_size=None, data_format="NCHW", name=None):
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0, dilation=1, groups=1, output_size=None, data_format="NCHW", name=None):
     return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 2, data_format, transpose=True, output_padding=output_padding)
 
 

@@ -296,23 +296,21 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0, reduction
 
 
 def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
-              fastemit_lambda=0.0, reduction="mean", name=None):
+              fastemit_lambda=0.001, reduction="mean", name=None):
     """RNN-T (transducer) loss: log-space alpha recursion over the (t, u)
     lattice (ref:python/paddle/nn/functional/loss.py rnnt_loss wrapping
     warprnnt). Scan over t; the within-row emit recursion over u is a second
     scan — fully XLA-compiled.
 
     input: [B, T, U+1, V] log-softmax joint scores; label: [B, U].
-    FastEmit gradient regularization is a warprnnt backward-pass rescaling
-    with no pure-loss equivalent; it is not implemented — a nonzero
-    ``fastemit_lambda`` raises rather than silently diverging.
+    FastEmit regularization (the warprnnt backward rescaling the reference
+    defaults to 0.001) scales the gradient flowing through label-emission
+    transitions by (1 + lambda) while leaving the loss VALUE unchanged —
+    expressed here as ``(1+l)*x - l*stop_gradient(x)`` on the emit scores,
+    which autodiff turns into exactly that backward rescaling.
     """
-    if fastemit_lambda:
-        raise NotImplementedError(
-            "rnnt_loss fastemit_lambda: FastEmit rescales the backward pass "
-            "inside warprnnt; not supported — pass fastemit_lambda=0")
 
-    def _rnnt(lp, lab, in_len, lab_len, *, blank):
+    def _rnnt(lp, lab, in_len, lab_len, *, blank, fe):
         B, T, U1, V = lp.shape
         U = U1 - 1
         NEG = -1e30
@@ -322,6 +320,10 @@ def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
         emit_lp = jnp.take_along_axis(
             lp[:, :, :U, :], lab[:, None, :, None].repeat(T, 1), axis=3
         )[..., 0]  # [B, T, U] score of emitting label u at (t, u)
+        if fe:
+            # FastEmit: same value, (1+fe)x gradient through emissions
+            emit_lp = (1.0 + fe) * emit_lp - \
+                fe * jax.lax.stop_gradient(emit_lp)
 
         valid_u = u_idx[None, :] <= lab_len[:, None]  # [B, U1]
 
@@ -364,7 +366,7 @@ def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
     out = apply(
         _rnnt,
         (input, label, input_lengths, label_lengths),
-        {"blank": int(blank)},
+        {"blank": int(blank), "fe": float(fastemit_lambda)},
         name="rnnt_loss",
     )
     if reduction == "mean":

@@ -263,21 +263,21 @@ def _unpool_out_spatial(in_sp, kernel_size, stride, padding, output_size, n):
 
 
 def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
-                 output_size=None, data_format="NCL", name=None):
+                 data_format="NCL", output_size=None, name=None):
     out_sp = _unpool_out_spatial(x.shape[2:], kernel_size, stride, padding,
                                  output_size, 1)
     return _max_unpool(x, indices, out_sp)
 
 
 def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
-                 output_size=None, data_format="NCHW", name=None):
+                 data_format="NCHW", output_size=None, name=None):
     out_sp = _unpool_out_spatial(x.shape[2:], kernel_size, stride, padding,
                                  output_size, 2)
     return _max_unpool(x, indices, out_sp)
 
 
 def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
-                 output_size=None, data_format="NCDHW", name=None):
+                 data_format="NCDHW", output_size=None, name=None):
     out_sp = _unpool_out_spatial(x.shape[2:], kernel_size, stride, padding,
                                  output_size, 3)
     return _max_unpool(x, indices, out_sp)

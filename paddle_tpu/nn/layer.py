@@ -150,7 +150,17 @@ class Layer:
         for name, p in self._parameters.items():
             if p is not None and id(p) not in seen:
                 seen.add(id(p))
-                yield (prefix + name if not prefix else prefix + "." + name) if prefix else name, p
+                full = (prefix + "." + name) if prefix else name
+                if p.name is None or full.endswith("." + p.name):
+                    # auto-name with the state_dict path (the reference
+                    # auto-names every parameter at creation); name-keyed
+                    # features (LARS exclusion lists, optimizer state_dict
+                    # keys) match against these. A name stamped by an
+                    # earlier SUB-layer traversal upgrades to the more
+                    # qualified path, so names converge to the root-model
+                    # spelling regardless of which traversal ran first.
+                    p.name = full
+                yield full, p
         if include_sublayers:
             for lname, layer in self._sub_layers.items():
                 if layer is None:

@@ -52,8 +52,13 @@ class _ConvNd(Layer):
             (1, True): F.conv1d_transpose, (2, True): F.conv2d_transpose, (3, True): F.conv3d_transpose,
         }[(self._n, self._transpose)]
         if self._transpose:
-            return fn(x, self.weight, self.bias, self._stride, self._padding, self._output_padding,
-                      self._groups, self._dilation, None, self._data_format)
+            # keyword args: the reference's transpose convs disagree among
+            # themselves on groups/dilation positional order
+            return fn(x, self.weight, self.bias, stride=self._stride,
+                      padding=self._padding,
+                      output_padding=self._output_padding,
+                      groups=self._groups, dilation=self._dilation,
+                      output_size=None, data_format=self._data_format)
         return fn(x, self.weight, self.bias, self._stride, self._padding, self._dilation, self._groups, self._data_format)
 
 
@@ -162,7 +167,11 @@ class _BatchNormBase(Layer):
 
 
 class BatchNorm1D(_BatchNormBase):
-    pass
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NCL",
+                 use_global_stats=None, name=None):
+        super().__init__(num_features, momentum, epsilon, weight_attr,
+                         bias_attr, data_format, use_global_stats, name)
 
 
 class BatchNorm2D(_BatchNormBase):
@@ -170,16 +179,29 @@ class BatchNorm2D(_BatchNormBase):
 
 
 class BatchNorm3D(_BatchNormBase):
-    pass
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NCDHW",
+                 use_global_stats=None, name=None):
+        super().__init__(num_features, momentum, epsilon, weight_attr,
+                         bias_attr, data_format, use_global_stats, name)
 
 
 class BatchNorm(_BatchNormBase):
     """paddle.nn.BatchNorm (fluid-style, act support)."""
 
-    def __init__(self, num_channels, act=None, momentum=0.9, epsilon=1e-5, param_attr=None, bias_attr=None,
-                 data_layout="NCHW", use_global_stats=None, name=None):
-        super().__init__(num_channels, momentum, epsilon, param_attr, bias_attr, data_layout, use_global_stats)
+    def __init__(self, num_channels, act=None, is_test=False, momentum=0.9,
+                 epsilon=1e-5, param_attr=None, bias_attr=None,
+                 dtype="float32", data_layout="NCHW", in_place=False,
+                 moving_mean_name=None, moving_variance_name=None,
+                 do_model_average_for_mean_and_var=True,
+                 use_global_stats=False, trainable_statistics=False):
+        # is_test/in_place/moving_*_name/do_model_average are static-graph
+        # knobs kept for signature parity; eval() covers is_test here
+        super().__init__(num_channels, momentum, epsilon, param_attr,
+                         bias_attr, data_layout, use_global_stats)
         self._act = act
+        if is_test:
+            self.eval()
 
     def forward(self, x):
         out = super().forward(x)

@@ -32,6 +32,9 @@ class Identity(Layer):
 class Softmax2D(Layer):
     """Softmax over the channel axis of NCHW input."""
 
+    def __init__(self, name=None):
+        super().__init__()
+
     def forward(self, x):
         return F.softmax(x, axis=-3)
 
@@ -207,7 +210,7 @@ class MaxUnPool1D(Layer):
 
     def forward(self, x, indices, output_size=None):
         return F.max_unpool1d(x, indices, self.k, self.s, self.p,
-                              output_size or self.os)
+                              output_size=output_size or self.os)
 
 
 class MaxUnPool2D(Layer):
@@ -218,7 +221,7 @@ class MaxUnPool2D(Layer):
 
     def forward(self, x, indices, output_size=None):
         return F.max_unpool2d(x, indices, self.k, self.s, self.p,
-                              output_size or self.os)
+                              output_size=output_size or self.os)
 
 
 class MaxUnPool3D(Layer):
@@ -229,7 +232,7 @@ class MaxUnPool3D(Layer):
 
     def forward(self, x, indices, output_size=None):
         return F.max_unpool3d(x, indices, self.k, self.s, self.p,
-                              output_size or self.os)
+                              output_size=output_size or self.os)
 
 
 # ----------------------------------------------------------------- padding
@@ -293,8 +296,8 @@ class PixelUnshuffle(Layer):
 
 
 class Fold(Layer):
-    def __init__(self, output_sizes, kernel_sizes, strides=1, paddings=0,
-                 dilations=1, name=None):
+    def __init__(self, output_sizes, kernel_sizes, dilations=1, paddings=0,
+                 strides=1, name=None):
         super().__init__()
         self.a = (output_sizes, kernel_sizes, strides, paddings, dilations)
 
@@ -303,7 +306,7 @@ class Fold(Layer):
 
 
 class Unfold(Layer):
-    def __init__(self, kernel_sizes, strides=1, paddings=0, dilations=1,
+    def __init__(self, kernel_sizes, dilations=1, paddings=0, strides=1,
                  name=None):
         super().__init__()
         self.a = (kernel_sizes, strides, paddings, dilations)
@@ -447,7 +450,7 @@ class CTCLoss(_LossLayer):
 
 
 class RNNTLoss(_LossLayer):
-    def __init__(self, blank=0, fastemit_lambda=0.0, reduction="mean",
+    def __init__(self, blank=0, fastemit_lambda=0.001, reduction="mean",
                  name=None):
         super().__init__(F.rnnt_loss, blank=blank,
                          fastemit_lambda=fastemit_lambda, reduction=reduction)

@@ -314,9 +314,13 @@ class BeamSearchDecoder(Layer):
         self.output_fn = output_fn
 
 
-def dynamic_decode(decoder, inits=None, max_step_num=32, **kwargs):
+def dynamic_decode(decoder, inits=None, max_step_num=None, **kwargs):
     """Greedy decode loop over a BeamSearchDecoder (beam_size=1 path of the
-    reference's dynamic_decode; beam>1 tracks the best beam greedily)."""
+    reference's dynamic_decode; beam>1 tracks the best beam greedily).
+    ``max_step_num=None`` (decode until every beam finishes) is bounded at
+    the reference kernel's practical cap via a 1000-step guard."""
+    if max_step_num is None:
+        max_step_num = 1000
     import numpy as np
 
     from ..core.tensor import Tensor

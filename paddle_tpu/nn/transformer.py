@@ -185,16 +185,16 @@ class TransformerEncoderLayer(Layer):
 
 
 class TransformerEncoder(Layer):
-    def __init__(self, encoder_layer_or_factory, num_layers, norm=None,
+    def __init__(self, encoder_layer, num_layers, norm=None,
                  use_stacked: bool = True):
         super().__init__()
         self.num_layers = num_layers
         self.norm = norm
-        if callable(encoder_layer_or_factory) and not isinstance(
-                encoder_layer_or_factory, Layer):
-            factory = encoder_layer_or_factory
+        if callable(encoder_layer) and not isinstance(
+                encoder_layer, Layer):
+            factory = encoder_layer
         else:
-            proto = encoder_layer_or_factory
+            proto = encoder_layer
             import copy
 
             def factory(i, _p=proto):
@@ -224,7 +224,8 @@ class TransformerEncoder(Layer):
 class TransformerDecoderLayer(Layer):
     def __init__(self, d_model, nhead, dim_feedforward, dropout=0.1,
                  activation="relu", attn_dropout=None, act_dropout=None,
-                 normalize_before=False, layer_norm_eps=1e-5):
+                 normalize_before=False, weight_attr=None, bias_attr=None,
+                 layer_norm_eps=1e-5):
         super().__init__()
         self.normalize_before = normalize_before
         self.self_attn = MultiHeadAttention(
@@ -317,16 +318,26 @@ class TransformerDecoder(Layer):
 class Transformer(Layer):
     def __init__(self, d_model=512, nhead=8, num_encoder_layers=6,
                  num_decoder_layers=6, dim_feedforward=2048, dropout=0.1,
-                 activation="relu", normalize_before=False):
+                 activation="relu", attn_dropout=None, act_dropout=None,
+                 normalize_before=False, weight_attr=None, bias_attr=None,
+                 custom_encoder=None, custom_decoder=None):
         super().__init__()
-        enc_layer = TransformerEncoderLayer(d_model, nhead, dim_feedforward,
-                                            dropout, activation,
-                                            normalize_before=normalize_before)
-        dec_layer = TransformerDecoderLayer(d_model, nhead, dim_feedforward,
-                                            dropout, activation,
-                                            normalize_before=normalize_before)
-        self.encoder = TransformerEncoder(enc_layer, num_encoder_layers)
-        self.decoder = TransformerDecoder(dec_layer, num_decoder_layers)
+        if custom_encoder is not None:
+            self.encoder = custom_encoder
+        else:
+            enc_layer = TransformerEncoderLayer(
+                d_model, nhead, dim_feedforward, dropout, activation,
+                attn_dropout=attn_dropout, act_dropout=act_dropout,
+                normalize_before=normalize_before)
+            self.encoder = TransformerEncoder(enc_layer, num_encoder_layers)
+        if custom_decoder is not None:
+            self.decoder = custom_decoder
+        else:
+            dec_layer = TransformerDecoderLayer(
+                d_model, nhead, dim_feedforward, dropout, activation,
+                attn_dropout=attn_dropout, act_dropout=act_dropout,
+                normalize_before=normalize_before)
+            self.decoder = TransformerDecoder(dec_layer, num_decoder_layers)
         self.d_model = d_model
         self.nhead = nhead
 

@@ -131,7 +131,8 @@ def diagflat(x, offset=0, name=None):
     return apply(_diagflat, (x,), dict(offset=offset))
 
 
-def diag_embed(x, offset=0, dim1=-2, dim2=-1, name=None):
+def diag_embed(input, offset=0, dim1=-2, dim2=-1, name=None):
+    x = input
     def _diag_embed(x, *, offset):
         return jax.vmap(lambda v: jnp.diag(v, k=offset))(x.reshape(-1, x.shape[-1])).reshape(
             *x.shape[:-1], x.shape[-1] + abs(offset), x.shape[-1] + abs(offset)

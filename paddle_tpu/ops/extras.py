@@ -166,7 +166,7 @@ def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
 # ------------------------------------------------------------ search
 
 
-def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+def kthvalue(x, k, axis=None, keepdim=False, name=None):
     def _kth(x, *, k, axis, keepdim):
         vals = jnp.sort(x, axis=axis)
         idxs = jnp.argsort(x, axis=axis)
@@ -177,6 +177,8 @@ def kthvalue(x, k, axis=-1, keepdim=False, name=None):
             i = jnp.expand_dims(i, axis)
         return v, i
 
+    if axis is None:
+        axis = -1  # ref kthvalue: axis=None means the last dim
     return apply(_kth, (x,), dict(k=int(k), axis=axis, keepdim=bool(keepdim)),
                  differentiable=False)
 
@@ -214,7 +216,7 @@ def mode(x, axis=-1, keepdim=False, name=None):
                  differentiable=False)
 
 
-def nanmedian(x, axis=None, keepdim=False, name=None):
+def nanmedian(x, axis=None, keepdim=True, name=None):
     def _nm(x, *, axis, keepdim):
         return jnp.nanmedian(x, axis=axis, keepdims=keepdim)
 
@@ -462,10 +464,26 @@ def set_printoptions(precision=None, threshold=None, edgeitems=None,
     np.set_printoptions(**kw)
 
 
-def check_shape(x, expected):
-    """Debug helper (paddle.check_shape): assert static shape equality."""
-    if list(x.shape) != list(expected):
-        raise ValueError(f"shape mismatch: {x.shape} != {list(expected)}")
+def check_shape(shape, expected=None):
+    """Validate a shape spec: every element a non-negative int
+    (ref:python/paddle/utils/layers_utils.py:463). With ``expected`` given,
+    additionally assert a tensor's static shape (debug extension)."""
+    if expected is not None:
+        got = list(shape.shape) if hasattr(shape, "shape") else list(shape)
+        if got != list(expected):
+            raise ValueError(f"shape mismatch: {got} != {list(expected)}")
+        return True
+    seq = shape.tolist() if hasattr(shape, "tolist") else shape
+    for ele in seq:
+        if isinstance(ele, (int, np.integer)):
+            if ele < 0:
+                raise ValueError(
+                    "All elements in ``shape`` must be positive when it's "
+                    "a list or tuple")
+        else:
+            raise TypeError(
+                "All elements in ``shape`` must be integers when it's a "
+                "list or tuple")
     return True
 
 

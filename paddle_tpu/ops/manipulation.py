@@ -73,11 +73,11 @@ def swapaxes(x, axis1, axis2, name=None):
     return apply(_swapaxes, (x,), dict(axis1=axis1, axis2=axis2))
 
 
-def t(x, name=None):
+def t(input, name=None):
     def _t(x):
         return x.T
 
-    return apply(_t, (x,), {})
+    return apply(_t, (input,), {})
 
 
 def concat(x, axis=0, name=None):
@@ -183,11 +183,11 @@ def broadcast_shape(x_shape, y_shape):
     return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
 
 
-def broadcast_tensors(inputs, name=None):
+def broadcast_tensors(input, name=None):
     def _bt(*xs):
         return tuple(jnp.broadcast_arrays(*xs))
 
-    return list(apply(_bt, tuple(inputs), {}))
+    return list(apply(_bt, tuple(input), {}))
 
 
 def tile(x, repeat_times, name=None):
@@ -270,12 +270,14 @@ def masked_fill(x, mask, value, name=None):
     return apply(_masked_fill, (x, mask, value), {})
 
 
-def gather(x, index, axis=0, name=None):
+def gather(x, index, axis=None, name=None):
     def _gather(x, idx, *, axis):
         return jnp.take(x, idx.astype(jnp.int32), axis=axis)
 
     if isinstance(axis, Tensor):
         axis = int(axis.item())
+    if axis is None:
+        axis = 0  # ref gather: axis=None means axis 0
     return apply(_gather, (x, index), dict(axis=int(axis)))
 
 
@@ -513,8 +515,8 @@ def numel(x, name=None):
     return Tensor(jnp.asarray(x.size, dtype=jnp.int64))
 
 
-def shape(x):
-    return Tensor(jnp.asarray(x._data.shape, dtype=jnp.int32))
+def shape(input):
+    return Tensor(jnp.asarray(input._data.shape, dtype=jnp.int32))
 
 
 def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):

@@ -47,7 +47,6 @@ _UNARY = {
     "floor": jnp.floor,
     "ceil": jnp.ceil,
     "round": jnp.round,
-    "trunc": jnp.trunc,
     "sign": jnp.sign,
     "reciprocal": jnp.reciprocal,
     "erf": jax.scipy.special.erf,
@@ -69,14 +68,31 @@ _NONDIFF_UNARY = {
     "isnan": jnp.isnan,
     "isinf": jnp.isinf,
     "isfinite": jnp.isfinite,
+}
+
+# logical_not/bitwise_not carry the reference's ``out=`` arg
+_NONDIFF_UNARY_OUT = {
     "logical_not": jnp.logical_not,
     "bitwise_not": jnp.invert,
 }
 
 
-def _def_unary(name, f, differentiable=True):
-    def op(x, name=None, _f=f, _n=name, _d=differentiable):
-        return apply(_f, (x,), {}, differentiable=_d, name=_n)
+def _write_out(result, out):
+    """paddle's ``out=`` contract: write into out, return it."""
+    if out is None:
+        return result
+    out._data = result._data if isinstance(result, Tensor) else result
+    return out
+
+
+def _def_unary(name, f, differentiable=True, with_out=False):
+    if with_out:
+        def op(x, out=None, name=None, _f=f, _n=name, _d=differentiable):
+            return _write_out(
+                apply(_f, (x,), {}, differentiable=_d, name=_n), out)
+    else:
+        def op(x, name=None, _f=f, _n=name, _d=differentiable):
+            return apply(_f, (x,), {}, differentiable=_d, name=_n)
 
     op.__name__ = name
     setattr(_this, name, op)
@@ -88,6 +104,8 @@ for _n, _f in _UNARY.items():
     _def_unary(_n, _f)
 for _n, _f in _NONDIFF_UNARY.items():
     _def_unary(_n, _f, differentiable=False)
+for _n, _f in _NONDIFF_UNARY_OUT.items():
+    _def_unary(_n, _f, differentiable=False, with_out=True)
 
 
 # --------------------------------------------------------------- binary ops
@@ -117,7 +135,6 @@ _BINARY = {
     "inner": jnp.inner,
     "outer": jnp.outer,
     "kron": jnp.kron,
-    "cross": jnp.cross,
 }
 
 _NONDIFF_BINARY = {
@@ -127,19 +144,27 @@ _NONDIFF_BINARY = {
     "less_equal": jnp.less_equal,
     "greater_than": jnp.greater,
     "greater_equal": jnp.greater_equal,
+}
+
+# the reference's logical/bitwise binaries carry an ``out=`` arg
+_NONDIFF_BINARY_OUT = {
     "logical_and": jnp.logical_and,
     "logical_or": jnp.logical_or,
     "logical_xor": jnp.logical_xor,
     "bitwise_and": jnp.bitwise_and,
     "bitwise_or": jnp.bitwise_or,
     "bitwise_xor": jnp.bitwise_xor,
-    "isclose": jnp.isclose,
 }
 
 
-def _def_binary(name, f, differentiable=True):
-    def op(x, y, name=None, _f=f, _n=name, _d=differentiable):
-        return apply(_f, (x, y), {}, differentiable=_d, name=_n)
+def _def_binary(name, f, differentiable=True, with_out=False):
+    if with_out:
+        def op(x, y, out=None, name=None, _f=f, _n=name, _d=differentiable):
+            return _write_out(
+                apply(_f, (x, y), {}, differentiable=_d, name=_n), out)
+    else:
+        def op(x, y, name=None, _f=f, _n=name, _d=differentiable):
+            return apply(_f, (x, y), {}, differentiable=_d, name=_n)
 
     op.__name__ = name
     setattr(_this, name, op)
@@ -151,6 +176,44 @@ for _n, _f in _BINARY.items():
     _def_binary(_n, _f)
 for _n, _f in _NONDIFF_BINARY.items():
     _def_binary(_n, _f, differentiable=False)
+for _n, _f in _NONDIFF_BINARY_OUT.items():
+    _def_binary(_n, _f, differentiable=False, with_out=True)
+
+
+def trunc(input, name=None):
+    return apply(jnp.trunc, (input,), {}, name="trunc")
+
+
+Tensor._register_method("trunc", trunc)
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    def _isclose(x, y, *, rtol, atol, equal_nan):
+        return jnp.isclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+    return apply(_isclose, (x, y),
+                 dict(rtol=rtol, atol=atol, equal_nan=equal_nan),
+                 differentiable=False)
+
+
+def cross(x, y, axis=9, name=None):
+    """Cross product. ``axis=9`` is the reference's sentinel for "the first
+    axis whose size is 3" (ref:python/paddle/tensor/linalg.py:1345)."""
+    xd = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    if axis is None or axis == 9:
+        cands = [i for i, d in enumerate(xd.shape) if d == 3]
+        if not cands:
+            raise ValueError("cross: no axis of size 3 found")
+        axis = cands[0]
+
+    def _cross(x, y, *, axis):
+        return jnp.cross(x, y, axis=axis)
+
+    return apply(_cross, (x, y), dict(axis=int(axis)))
+
+
+Tensor._register_method("isclose", isclose)
+Tensor._register_method("cross", cross)
 
 
 def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
@@ -178,14 +241,31 @@ def _axis_arg(axis):
     return int(axis)
 
 
-def _def_reduce(name, f, differentiable=True):
-    def _fn(x, *, axis, keepdim):
+def _def_reduce(name, f, differentiable=True, with_dtype=False):
+    def _fn(x, *, axis, keepdim, dtype=None):
+        if dtype is not None:
+            x = x.astype(dtype)  # ref sum/prod/nansum cast before reducing
         return f(x, axis=axis, keepdims=keepdim)
 
     _fn.__name__ = "_" + name
 
-    def op(x, axis=None, keepdim=False, name=None, _fn=_fn, _n=name, _d=differentiable):
-        return apply(_fn, (x,), dict(axis=_axis_arg(axis), keepdim=bool(keepdim)), differentiable=_d, name=_n)
+    if with_dtype == "after_keepdim":  # ref prod: (x, axis, keepdim, dtype)
+        def op(x, axis=None, keepdim=False, dtype=None, name=None,
+               _fn=_fn, _n=name, _d=differentiable):
+            return apply(_fn, (x,),
+                         dict(axis=_axis_arg(axis), keepdim=bool(keepdim),
+                              dtype=convert_dtype_arg(dtype)),
+                         differentiable=_d, name=_n)
+    elif with_dtype:  # ref sum/nansum: (x, axis, dtype, keepdim)
+        def op(x, axis=None, dtype=None, keepdim=False, name=None,
+               _fn=_fn, _n=name, _d=differentiable):
+            return apply(_fn, (x,),
+                         dict(axis=_axis_arg(axis), keepdim=bool(keepdim),
+                              dtype=convert_dtype_arg(dtype)),
+                         differentiable=_d, name=_n)
+    else:
+        def op(x, axis=None, keepdim=False, name=None, _fn=_fn, _n=name, _d=differentiable):
+            return apply(_fn, (x,), dict(axis=_axis_arg(axis), keepdim=bool(keepdim)), differentiable=_d, name=_n)
 
     op.__name__ = name
     setattr(_this, name, op)
@@ -206,7 +286,11 @@ for _n, _f, _d in [
     ("nansum", jnp.nansum, True),
     ("nanmean", jnp.nanmean, True),
 ]:
-    _def_reduce(_n, _f, _d)
+    # ref signatures: sum/prod/nansum take a dtype kwarg (prod orders it
+    # after keepdim, the others before)
+    _def_reduce(_n, _f, _d,
+                with_dtype="after_keepdim" if _n == "prod"
+                else _n in ("sum", "nansum"))
 
 
 def logsumexp(x, axis=None, keepdim=False, name=None):

@@ -9,6 +9,7 @@ from .optimizer import (  # noqa: F401
     Adamax,
     AdamW,
     Lamb,
+    LarsMomentum,
     Momentum,
     Optimizer,
     RMSProp,

@@ -59,6 +59,12 @@ class Optimizer:
         """Pure: (param, grad, slots, lr, step) -> (new_param, new_slots)."""
         raise NotImplementedError
 
+    def _update_for(self, param_name):
+        """Per-parameter update fn, dispatched on the (static) name at trace
+        time — how name-conditional math (e.g. LARS weight-decay exclusion)
+        reaches compiled paths that call the update directly (jit.TrainStep)."""
+        return self._update
+
     # --------------------------------------------------------- eager path
     def step(self):
         if self._parameter_list is None:
@@ -144,14 +150,34 @@ class Optimizer:
         return new_params, {"slots": new_slots, "step": step}
 
     # ---------------------------------------------------------- checkpoint
+    def _slot_keys(self):
+        """One stable checkpoint key per parameter: the param name, or the
+        list index when unnamed — disambiguated by index when two params
+        carry the same auto-stamped name (e.g. bare layers enumerated
+        before nesting), so momentum state can never be cross-written."""
+        from collections import Counter
+
+        names = [p.name or str(i)
+                 for i, p in enumerate(self._parameter_list)]
+        counts = Counter(names)
+        seen = {}
+        keys = []
+        for n in names:
+            if counts[n] > 1:
+                seen[n] = seen.get(n, -1) + 1
+                keys.append(f"{n}#{seen[n]}")
+            else:
+                keys.append(n)
+        return keys
+
     def state_dict(self):
         sd = {"step": self._step_count}
         if self._parameter_list is not None:
-            for i, p in enumerate(self._parameter_list):
+            for key, p in zip(self._slot_keys(), self._parameter_list):
                 slots = self._accumulators.get(id(p))
                 if slots:
                     for k, v in slots.items():
-                        sd[f"{p.name or i}.{k}"] = Tensor(v)
+                        sd[f"{key}.{k}"] = Tensor(v)
         if isinstance(self._learning_rate, LRScheduler):
             sd["LR_Scheduler"] = self._learning_rate.state_dict()
         return sd
@@ -161,13 +187,17 @@ class Optimizer:
         if isinstance(self._learning_rate, LRScheduler) and "LR_Scheduler" in state_dict:
             self._learning_rate.set_state_dict(state_dict["LR_Scheduler"])
         if self._parameter_list is not None:
-            for i, p in enumerate(self._parameter_list):
+            for i, (key, p) in enumerate(zip(self._slot_keys(),
+                                             self._parameter_list)):
                 slots = {}
                 for name in self._state_names:
-                    key = f"{p.name or i}.{name}"
-                    if key in state_dict:
-                        v = state_dict[key]
-                        slots[name] = v._data if isinstance(v, Tensor) else jnp.asarray(v)
+                    # accept the index form too (pre-auto-naming ckpts)
+                    for k in (f"{key}.{name}", f"{i}.{name}"):
+                        if k in state_dict:
+                            v = state_dict[k]
+                            slots[name] = v._data if isinstance(v, Tensor) \
+                                else jnp.asarray(v)
+                            break
                 if slots:
                     self._accumulators[id(p)] = slots
 
@@ -212,17 +242,24 @@ class SGD(Optimizer):
 
 class Momentum(Optimizer):
     _state_names = ["velocity"]
-    _hyper_names = ["_momentum", "_use_nesterov"]
+    _hyper_names = ["_momentum", "_use_nesterov", "_rescale_grad"]
 
-    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None, use_nesterov=False, weight_decay=None, grad_clip=None, name=None, multi_precision=False):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None, use_nesterov=False,
+                 weight_decay=None, grad_clip=None, multi_precision=False, rescale_grad=1.0,
+                 use_multi_tensor=False, name=None):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
         self._momentum = momentum
         self._use_nesterov = use_nesterov
+        self._rescale_grad = float(rescale_grad)
 
     def _hyper_key(self):
-        return (self._wd_key, float(self._momentum), bool(self._use_nesterov))
+        return (self._wd_key, float(self._momentum), bool(self._use_nesterov),
+                float(getattr(self, "_rescale_grad", 1.0)))
 
     def _update(self, param, grad, slots, lr, step):
+        rescale = float(getattr(self, "_rescale_grad", 1.0))
+        if rescale != 1.0:
+            grad = grad * rescale
         grad = self._decay_grad(grad, param)
         v = self._momentum * slots["velocity"] + grad
         if self._use_nesterov:
@@ -237,7 +274,10 @@ class Adam(Optimizer):
     _hyper_names = ["_beta1", "_beta2", "_epsilon"]
 
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, parameters=None,
-                 weight_decay=None, grad_clip=None, lazy_mode=False, multi_precision=False, name=None):
+                 weight_decay=None, grad_clip=None, lazy_mode=False, multi_precision=False,
+                 use_multi_tensor=False, name=None):
+        # use_multi_tensor: fused-kernel knob in the reference; XLA fuses
+        # the update across params anyway — accepted for parity
         super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
         self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
 
@@ -389,7 +429,8 @@ class Lamb(Optimizer):
     _hyper_names = ["_beta1", "_beta2", "_epsilon", "_lamb_weight_decay"]
 
     def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9, beta2=0.999, epsilon=1e-6,
-                 parameters=None, grad_clip=None, exclude_from_weight_decay_fn=None, name=None):
+                 parameters=None, grad_clip=None, exclude_from_weight_decay_fn=None,
+                 multi_precision=False, name=None):
         super().__init__(learning_rate, parameters, None, grad_clip, name)
         self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
         self._lamb_weight_decay = lamb_weight_decay
@@ -412,3 +453,143 @@ class Lamb(Optimizer):
         trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
         new_p = p32 - lr * trust * r
         return new_p.astype(param.dtype), {"moment1": m, "moment2": v}
+
+
+class LarsMomentum(Optimizer):
+    """LARS: momentum with layer-wise adaptive rate scaling, the large-batch
+    vision optimizer (ref:python/paddle/fluid/optimizer.py:1786
+    LarsMomentumOptimizer; update math mirrors
+    ref:paddle/fluid/operators/optimizers/lars_momentum_op.h)::
+
+        g' = rescale_grad * g
+        local_lr = lr * lars_coeff * ||p|| / (||g'|| + wd * ||p|| + eps)
+                   (plain lr when wd == 0 or either norm is 0)
+        v = mu * v + local_lr * (g' + wd * p)
+        p = p - v
+
+    ``exclude_from_weight_decay`` lists parameter-name substrings that train
+    with wd=0 (and hence a plain-lr update), as in the fleet lars
+    meta-optimizer (ref:python/paddle/distributed/fleet/meta_optimizers/
+    lars_optimizer.py:23).
+    """
+
+    _state_names = ["velocity"]
+    _hyper_names = ["_momentum", "_lars_coeff", "_lars_weight_decay",
+                    "_epsilon", "_rescale_grad"]
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay=None, epsilon=0.0,
+                 multi_precision=False, rescale_grad=1.0, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._momentum = momentum
+        self._lars_coeff = lars_coeff
+        self._lars_weight_decay = lars_weight_decay
+        self._epsilon = epsilon
+        self._rescale_grad = rescale_grad
+        self._exclude_names = list(exclude_from_weight_decay or [])
+
+    def _hyper_key(self):
+        return (self._wd_key, float(self._momentum), float(self._lars_coeff),
+                float(self._lars_weight_decay), float(self._epsilon),
+                float(self._rescale_grad))
+
+    def _update(self, param, grad, slots, lr, step):
+        f32 = jnp.float32
+        p32 = param.astype(f32)
+        g = grad.astype(f32) * self._rescale_grad
+        wd = self._lars_weight_decay
+        p_norm = jnp.linalg.norm(p32)
+        g_norm = jnp.linalg.norm(g)
+        lars_lr = lr * self._lars_coeff * p_norm / (
+            g_norm + wd * p_norm + self._epsilon)
+        use_lars = (wd > 0) & (p_norm > 0) & (g_norm > 0)
+        local_lr = jnp.where(use_lars, lars_lr, lr)
+        v = self._momentum * slots["velocity"] + local_lr * (g + wd * p32)
+        new_p = p32 - v
+        return new_p.astype(param.dtype), {"velocity": v}
+
+    def _init_slot(self, param):
+        return {name: jnp.zeros(param.shape, jnp.float32)
+                for name in self._state_names}
+
+    def _is_excluded(self, name: str) -> bool:
+        return any(s in (name or "") for s in self._exclude_names)
+
+    def _update_for(self, param_name):
+        if not self._is_excluded(param_name):
+            return self._update
+
+        def upd_no_wd(param, grad, slots, lr, step):
+            saved = self._lars_weight_decay
+            self._lars_weight_decay = 0.0
+            try:
+                return self._update(param, grad, slots, lr, step)
+            finally:
+                self._lars_weight_decay = saved
+
+        return upd_no_wd
+
+    def step(self):
+        if not self._exclude_names or self._parameter_list is None:
+            return super().step()
+        # excluded params update with wd=0 (a different jit-cache key):
+        # split the list and run the base step per group. Clip FIRST, over
+        # the full gradient set — per-group clipping would change the
+        # global norm ClipGradByGlobalNorm is defined over.
+        all_params = self._parameter_list
+        clip = self._grad_clip
+        if clip is not None:
+            with_grad = [p for p in all_params
+                         if p.grad is not None and not p.stop_gradient]
+            if with_grad:
+                clipped = clip._clip_arrays([p.grad._data for p in with_grad])
+                for p, a in zip(with_grad, clipped):
+                    p.grad._data = a
+        wd = self._lars_weight_decay
+        try:
+            self._grad_clip = None
+            self._parameter_list = [
+                p for p in all_params if not self._is_excluded(p.name)]
+            super().step()
+            self._lars_weight_decay = 0.0
+            self._parameter_list = [
+                p for p in all_params if self._is_excluded(p.name)]
+            self._step_count -= 1
+            super().step()
+        finally:
+            self._grad_clip = clip
+            self._lars_weight_decay = wd
+            self._parameter_list = all_params
+
+    def apply_gradients(self, params, grads, state, lr=None):
+        if not self._exclude_names:
+            return super().apply_gradients(params, grads, state, lr)
+        # clip once over ALL grads (global norm), then split by exclusion
+        clip = self._grad_clip
+        if clip is not None:
+            names = list(grads)
+            flat = [grads[k]._data if isinstance(grads[k], Tensor)
+                    else grads[k] for k in names]
+            flat = clip._clip_arrays(flat)
+            grads = dict(zip(names, flat))
+        inc = {k: v for k, v in params.items() if not self._is_excluded(k)}
+        exc = {k: v for k, v in params.items() if self._is_excluded(k)}
+        wd = self._lars_weight_decay
+        try:
+            self._grad_clip = None
+            new_p, st1 = super().apply_gradients(
+                inc, {k: grads[k] for k in inc},
+                {"slots": {k: state["slots"][k] for k in inc},
+                 "step": state["step"]}, lr)
+            self._lars_weight_decay = 0.0
+            new_p2, st2 = super().apply_gradients(
+                exc, {k: grads[k] for k in exc},
+                {"slots": {k: state["slots"][k] for k in exc},
+                 "step": state["step"]}, lr)
+        finally:
+            self._grad_clip = clip
+            self._lars_weight_decay = wd
+        new_p.update(new_p2)
+        slots = {**st1["slots"], **st2["slots"]}
+        return new_p, {"slots": slots, "step": st1["step"]}

@@ -94,3 +94,30 @@ def test_tuner_rejects_bad_mesh_the_heuristic_accepts():
     eng2.prepare(mode="tune", sample_batch=(paddle.randn([16, 32]),
                                             paddle.randn([16, 1])))
     assert eng2._step is not None
+
+
+def test_tuner_report_carries_platform_and_warns_cross_platform():
+    """The report records the measurement platform; applying a plan on a
+    different platform warns (CPU step-time ratios don't transfer to TPU)."""
+    import warnings
+
+    import jax
+
+    from paddle_tpu.distributed.auto_parallel import _TunerReport
+
+    m = _ToyMLP()
+    opt = optimizer.SGD(learning_rate=0.01, parameters=m.parameters())
+    eng = Engine(m, loss=_mse, optimizer=opt)
+    x = paddle.randn([16, 32])
+    y = paddle.randn([16, 1])
+    report = eng.tune(sample_batch=(x, y), iters=2, warmup=1, verbose=0)
+    assert report.platform == jax.devices()[0].platform  # "cpu" in CI
+
+    # simulate a plan measured elsewhere
+    eng._tuner_report = _TunerReport(report)
+    eng._tuner_report.platform = "tpu"
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        eng.prepare(sample_batch=(x, y))
+    assert any("tuned on 'tpu'" in str(x.message) for x in w), \
+        [str(x.message) for x in w]

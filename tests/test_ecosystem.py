@@ -316,14 +316,18 @@ def test_engine_fit_empty_data():
     assert eng.fit([], epochs=2, verbose=0) == []
 
 
-def test_rnnt_fastemit_rejected():
+def test_rnnt_fastemit_value_invariant():
+    """FastEmit (now implemented as a backward-only emission-grad rescale)
+    must leave the loss VALUE identical to lambda=0; the gradient behavior
+    is covered in test_nn_extra.py."""
     from paddle_tpu.nn import functional as F
 
-    with pytest.raises(NotImplementedError, match="fastemit"):
-        F.rnnt_loss(_t(np.zeros((1, 2, 2, 3), np.float32)),
-                    _t(np.zeros((1, 1), np.int32)),
-                    _t(np.array([2], np.int32)), _t(np.array([1], np.int32)),
-                    fastemit_lambda=0.01)
+    args = (_t(np.random.RandomState(0).randn(1, 2, 2, 3).astype(np.float32)),
+            _t(np.zeros((1, 1), np.int32)),
+            _t(np.array([2], np.int32)), _t(np.array([1], np.int32)))
+    a = float(F.rnnt_loss(*args, fastemit_lambda=0.01).numpy())
+    b = float(F.rnnt_loss(*args, fastemit_lambda=0.0).numpy())
+    assert abs(a - b) < 1e-6
 
 
 def test_device_id_out_of_range():

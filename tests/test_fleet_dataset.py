@@ -1,0 +1,158 @@
+"""InMemoryDataset / QueueDataset streaming ingestion
+(ref:python/paddle/distributed/fleet/dataset/dataset.py:350)."""
+import os
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import InMemoryDataset
+from paddle_tpu.distributed.fleet import QueueDataset
+from paddle_tpu.distributed.spawn import spawn
+
+N_FILES = 4
+ROWS_PER_FILE = 30
+
+
+def _write_files(tmp_path):
+    files = []
+    rng = np.random.RandomState(0)
+    uid = 0
+    for i in range(N_FILES):
+        p = tmp_path / f"part-{i}.txt"
+        lines = []
+        for _ in range(ROWS_PER_FILE):
+            label = int(rng.rand() < 0.5)
+            dense = ",".join(f"{v:.3f}" for v in rng.rand(3))
+            sparse = ",".join(str(uid * 100 + k) for k in range(4))
+            lines.append(f"{label}\t{dense}\t{sparse}")
+            uid += 1
+        p.write_text("\n".join(lines) + "\n")
+        files.append(str(p))
+    return files
+
+
+def test_load_shuffle_batch(tmp_path):
+    files = _write_files(tmp_path)
+    ds = InMemoryDataset()
+    ds.init(batch_size=16)
+    ds.set_filelist(files)
+    ds.load_into_memory()
+    assert ds.get_memory_data_size() == N_FILES * ROWS_PER_FILE
+
+    batches = list(ds)
+    assert len(batches) == len(ds) == 8  # 120/16 -> 7 full + remainder
+    sparse, dense, label = batches[0]
+    assert sparse.shape == (16, 4) and sparse.dtype == np.int64
+    assert dense.shape == (16, 3) and dense.dtype == np.float32
+    assert label.shape == (16, 1)
+    assert batches[-1][0].shape[0] == 120 - 7 * 16
+
+    before = sorted(int(b[0][i, 0]) for b in batches
+                    for i in range(b[0].shape[0]))
+    ds.local_shuffle()
+    after_batches = list(ds)
+    after = sorted(int(b[0][i, 0]) for b in after_batches
+                   for i in range(b[0].shape[0]))
+    assert before == after  # shuffle permutes, never drops
+    assert [b[0][0, 0] for b in batches] != \
+        [b[0][0, 0] for b in after_batches]  # ...and actually moved rows
+
+    # epoch-merged feeding: n passes, each a full epoch
+    seen = sum(b[0].shape[0] for b in ds.epochs(3))
+    assert seen == 3 * 120
+    ds.release_memory()
+    assert ds.get_memory_data_size() == 0
+
+
+def test_queue_dataset_streams_same_samples(tmp_path):
+    files = _write_files(tmp_path)
+    mem = InMemoryDataset()
+    mem.init(batch_size=32)
+    mem.set_filelist(files)
+    mem.load_into_memory()
+    q = QueueDataset()
+    q.init(batch_size=32)
+    q.set_filelist(files)
+    a = np.concatenate([b[0] for b in mem])
+    b = np.concatenate([b[0] for b in q])
+    np.testing.assert_array_equal(a, b)
+
+
+def _shard_worker(files):
+    import paddle_tpu.distributed as dist
+
+    ds = InMemoryDataset()
+    ds.init(batch_size=8)
+    ds.set_filelist(files)
+    ds.load_into_memory()
+    ids = sorted(int(s[0][0]) for s in ds._samples)
+    return dist.get_rank(), ids
+
+
+def test_filelist_shards_across_workers(tmp_path):
+    """Worker rank owns files[rank::nranks] — disjoint, union = everything."""
+    files = _write_files(tmp_path)
+    results = spawn(_shard_worker, args=(files,), nprocs=2)
+    by_rank = dict(results)
+    assert set(by_rank) == {0, 1}
+    assert not (set(by_rank[0]) & set(by_rank[1]))
+    assert len(by_rank[0]) == len(by_rank[1]) == 2 * ROWS_PER_FILE
+    all_ids = sorted(by_rank[0] + by_rank[1])
+    assert len(all_ids) == N_FILES * ROWS_PER_FILE
+
+
+def _gshuffle_worker(files):
+    import os
+
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    import paddle_tpu.distributed as dist
+
+    dist.init_parallel_env()
+    ds = InMemoryDataset()
+    ds.init(batch_size=8)
+    ds.set_filelist(files)
+    ds.load_into_memory()
+    ds.global_shuffle()
+    total = ds.get_memory_data_size()
+    ids = sorted(int(s[0][0]) for s in ds._samples)
+    return dist.get_rank(), total, ids
+
+
+def test_global_shuffle_repartitions(tmp_path):
+    files = _write_files(tmp_path)
+    results = spawn(_gshuffle_worker, args=(files,), nprocs=2)
+    by_rank = {r: (t, ids) for r, t, ids in results}
+    # reduced size sees every sample exactly once
+    assert by_rank[0][0] == by_rank[1][0] == N_FILES * ROWS_PER_FILE
+    a, b = set(by_rank[0][1]), set(by_rank[1][1])
+    assert not (a & b)
+    assert len(a) + len(b) == N_FILES * ROWS_PER_FILE
+
+
+def test_widedeep_reads_through_dataset(tmp_path):
+    """The PS ingestion contract end-to-end: Wide&Deep trains off
+    InMemoryDataset batches (the verdict's acceptance for this item)."""
+    from paddle_tpu.models import WideDeep
+
+    files = _write_files(tmp_path)
+    ds = InMemoryDataset()
+    ds.init(batch_size=24)
+    ds.set_filelist(files)
+    ds.load_into_memory(is_shuffle=True)
+
+    paddle.seed(0)
+    model = WideDeep(num_fields=4, num_dense=3, num_buckets=100_003,
+                     embedding_dim=8, hidden_sizes=(16,))
+    opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                parameters=model.parameters())
+    losses = []
+    for sparse, dense, label in ds.epochs(2):
+        loss = model.loss(
+            model(paddle.to_tensor(sparse), paddle.to_tensor(dense)),
+            paddle.to_tensor(label))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert len(losses) == 2 * len(ds)
+    assert np.isfinite(losses).all()

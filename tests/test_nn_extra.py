@@ -370,3 +370,38 @@ def test_mha_need_weights_dropout():
     mha.eval()
     _, w_eval = mha(x, x, x)
     assert np.allclose(w_eval.numpy().sum(-1), 1.0, atol=1e-4)
+
+
+def test_rnnt_fastemit_rescales_emission_grads():
+    """FastEmit leaves the loss value unchanged and adds exactly
+    lambda * (emission-path gradient): grad(l) = grad(0) + l*(grad(1)-grad(0))."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.nn import functional as F
+
+    rng = np.random.RandomState(0)
+    B, T, U, V = 2, 4, 3, 5
+    logits = rng.randn(B, T, U + 1, V).astype(np.float32)
+    lp = logits - np.log(np.exp(logits).sum(-1, keepdims=True))
+    labels = rng.randint(1, V, (B, U)).astype(np.int32)
+    in_len = np.array([T, T - 1], np.int32)
+    lab_len = np.array([U, U - 1], np.int32)
+
+    def run(lam):
+        x = paddle.to_tensor(lp)
+        x.stop_gradient = False
+        loss = F.rnnt_loss(x, paddle.to_tensor(labels),
+                           paddle.to_tensor(in_len),
+                           paddle.to_tensor(lab_len),
+                           fastemit_lambda=lam, reduction="sum")
+        loss.backward()
+        return float(loss), x.grad.numpy().copy()
+
+    v0, g0 = run(0.0)
+    v1, g1 = run(1.0)
+    vh, gh = run(0.5)
+    assert abs(v0 - v1) < 1e-5 and abs(v0 - vh) < 1e-5  # value unchanged
+    assert not np.allclose(g0, g1)  # gradient IS regularized
+    np.testing.assert_allclose(gh, g0 + 0.5 * (g1 - g0), rtol=1e-4,
+                               atol=1e-6)

@@ -74,3 +74,139 @@ def test_adamax_matches_torch():
     torchs = _run_torch(torch.optim.Adamax, grads=GRADS, betas=(0.9, 0.999),
                         eps=1e-8)
     np.testing.assert_allclose(ours, torchs, rtol=1e-4, atol=1e-5)
+
+
+def _lars_numpy(p0, grads, lr=0.1, mu=0.9, coeff=0.001, wd=0.0005,
+                eps=0.0, rescale=1.0):
+    """Reference formula, mirrored from
+    ref:paddle/fluid/operators/optimizers/lars_momentum_op.h (float64)."""
+    p = p0.astype(np.float64).copy()
+    v = np.zeros_like(p)
+    for g in grads:
+        g = g.astype(np.float64) * rescale
+        p_norm = np.linalg.norm(p)
+        g_norm = np.linalg.norm(g)
+        local_lr = lr
+        if wd > 0 and p_norm > 0 and g_norm > 0:
+            local_lr = lr * coeff * p_norm / (g_norm + wd * p_norm + eps)
+        v = mu * v + local_lr * (g + wd * p)
+        p = p - v
+    return p
+
+
+def test_lars_matches_reference_formula():
+    p0 = np.arange(1.0, 5.0, dtype=np.float32)
+    ours = _run_ours(paddle.optimizer.LarsMomentum, grads=GRADS,
+                     momentum=0.9, lars_coeff=0.001, lars_weight_decay=0.0005)
+    ref = _lars_numpy(p0, GRADS)
+    np.testing.assert_allclose(ours, ref, rtol=1e-5, atol=1e-7)
+
+
+def test_lars_zero_wd_is_plain_momentum():
+    ours = _run_ours(paddle.optimizer.LarsMomentum, grads=GRADS,
+                     momentum=0.9, lars_weight_decay=0.0)
+    torchs = _run_torch(torch.optim.SGD, grads=GRADS, momentum=0.9)
+    np.testing.assert_allclose(ours, torchs, rtol=1e-5, atol=1e-6)
+
+
+def test_lars_exclude_from_weight_decay():
+    """Excluded params (name substring) update with wd=0 => plain momentum."""
+    p1 = paddle.to_tensor(np.arange(1.0, 5.0, dtype=np.float32))
+    p1.stop_gradient = False
+    p1.name = "fc.weight"
+    p2 = paddle.to_tensor(np.arange(1.0, 5.0, dtype=np.float32))
+    p2.stop_gradient = False
+    p2.name = "bn.scale"
+    opt = paddle.optimizer.LarsMomentum(
+        learning_rate=0.1, momentum=0.9, parameters=[p1, p2],
+        exclude_from_weight_decay=["bn"])
+    for g in GRADS:
+        loss = (p1 * paddle.to_tensor(g)).sum() + (p2 * paddle.to_tensor(g)).sum()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    ref_lars = _lars_numpy(np.arange(1.0, 5.0, dtype=np.float32), GRADS)
+    torchs = _run_torch(torch.optim.SGD, grads=GRADS, momentum=0.9)
+    np.testing.assert_allclose(p1.numpy(), ref_lars, rtol=1e-5, atol=1e-7)
+    np.testing.assert_allclose(p2.numpy(), torchs, rtol=1e-5, atol=1e-6)
+    assert opt._step_count == len(GRADS)  # split update counts steps once
+
+
+def test_fleet_lars_strategy_upgrades_momentum():
+    from paddle_tpu.distributed import fleet
+
+    p = paddle.to_tensor(np.ones(4, np.float32))
+    p.stop_gradient = False
+    s = fleet.DistributedStrategy()
+    s.lars = True
+    s.lars_configs["lars_coeff"] = 0.002
+    opt = paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.8,
+                                    parameters=[p])
+    wrapped = fleet.distributed_optimizer(opt, s)
+    assert isinstance(wrapped, paddle.optimizer.LarsMomentum)
+    assert wrapped._lars_coeff == 0.002
+    assert wrapped._momentum == 0.8
+
+
+def test_lars_exclusion_applies_in_compiled_trainstep():
+    """The wd=0 exclusion must reach jit.TrainStep's direct _update calls
+    (trace-time name dispatch), not just eager step()."""
+    from paddle_tpu.jit import TrainStep
+
+    class _Net(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = paddle.nn.Linear(4, 4)
+            self.bn = paddle.nn.BatchNorm1D(4)
+
+        def forward(self, x):
+            return self.bn(self.fc(x))
+
+    def run(exclude):
+        paddle.seed(7)
+        m = _Net()
+        opt = paddle.optimizer.LarsMomentum(
+            learning_rate=0.1, momentum=0.9, lars_weight_decay=0.05,
+            parameters=m.parameters(), exclude_from_weight_decay=exclude)
+        step = TrainStep(lambda x: (m(x) ** 2).mean(), opt, layers=m)
+        x = paddle.to_tensor(np.random.RandomState(0).randn(8, 4)
+                             .astype(np.float32))
+        step(x)  # one step: identical grads, so only the wd term differs
+        return {k: v.numpy().copy() for k, v in m.state_dict().items()}
+
+    with_excl = run(["bn"])
+    without = run([])
+    bn_keys = [k for k in with_excl if k.startswith("bn.") and
+               not k.endswith(("_mean", "_variance"))]
+    lin_keys = [k for k in with_excl if k.startswith("fc.")]
+    assert bn_keys and lin_keys, list(with_excl)
+    # linear params identical either way; bn params differ (wd dropped)
+    for k in lin_keys:
+        np.testing.assert_allclose(with_excl[k], without[k], rtol=1e-6)
+    assert any(not np.allclose(with_excl[k], without[k]) for k in bn_keys), \
+        bn_keys
+
+
+def test_param_names_converge_to_qualified_path():
+    """A sub-layer traversal stamping short names must not pin them: the
+    root-model traversal upgrades to the qualified path, so optimizer slot
+    keys and LARS exclusion match regardless of traversal order."""
+    class _Net(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = paddle.nn.Linear(2, 2)
+            self.bn = paddle.nn.BatchNorm1D(2)
+
+        def forward(self, x):
+            return self.bn(self.fc(x))
+
+    m = _Net()
+    short = [p.name for p in m.fc.parameters()]  # stamps "weight"/"bias"
+    assert short == ["weight", "bias"]
+    full = [n for n, _ in m.named_parameters()]
+    assert [p.name for p in m.parameters()] == full  # upgraded
+    assert full[0] == "fc.weight"
+    # optimizer slot keys are the qualified names -> no collisions
+    opt = paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                                    parameters=m.parameters())
+    assert len(set(opt._slot_keys())) == len(opt._parameter_list)

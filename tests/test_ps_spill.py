@@ -191,3 +191,113 @@ def test_shrink_concurrent_tick_no_underflow(tmp_path):
         client.close()
     finally:
         svc.stop()
+
+
+def test_concurrent_pull_push_shrink_chunked_locks(tmp_path):
+    """VERDICT r3 weak-6: shrink must not hold a shard lock across file I/O
+    of the whole spill tier. With a multi-thousand-row spilled tier, pulls
+    issued WHILE shrink runs must keep completing quickly (chunked locks);
+    the test also hammers push/pull/shrink concurrently for races."""
+    import threading
+    import time
+
+    import numpy as np
+
+    from paddle_tpu.distributed import ps
+
+    svc = ps.EmbeddingService(dim=32, num_shards=1, rule="sgd",
+                              ram_cap_bytes=600_000,
+                              spill_dir=str(tmp_path))
+    try:
+        grow = svc.client()
+        # grow the table well past the cap -> thousands of spilled rows
+        for i in range(40):
+            ids = np.arange(i * 2000, (i + 1) * 2000, dtype=np.uint64)
+            grow.pull(ids)
+        st = grow.tier_stats()
+        assert st["spill_rows"] > 10_000, st
+
+        stop = threading.Event()
+        errors = []
+        pull_lat = []
+
+        def puller():
+            try:
+                c = svc.client()
+                rng = np.random.RandomState(1)
+                while not stop.is_set():
+                    ids = rng.randint(0, 80_000, 64).astype(np.uint64)
+                    t0 = time.perf_counter()
+                    c.pull(ids)
+                    pull_lat.append(time.perf_counter() - t0)
+            except BaseException as e:  # noqa: BLE001 — surfaced below
+                errors.append(("puller", repr(e)))
+
+        def pusher():
+            try:
+                c = svc.client()
+                rng = np.random.RandomState(2)
+                g = np.ones((64, 32), np.float32)
+                while not stop.is_set():
+                    ids = rng.randint(0, 80_000, 64).astype(np.uint64)
+                    c.push(ids, g, lr=0.01)
+            except BaseException as e:  # noqa: BLE001
+                errors.append(("pusher", repr(e)))
+
+        threads = [threading.Thread(target=puller),
+                   threading.Thread(target=pusher)]
+        [t.start() for t in threads]
+        try:
+            shr = svc.client()
+            total_evicted = 0
+            for _ in range(4):  # decay-only shrinks touch every spilled row
+                total_evicted += shr.shrink(threshold=0.0, max_unseen=0,
+                                            decay=0.9)
+        finally:
+            stop.set()
+            [t.join(timeout=30) for t in threads]
+        assert not errors
+        assert len(pull_lat) > 10  # pulls kept flowing during shrink
+        # a pull may wait for one 64-row chunk of file I/O, never the tier
+        assert max(pull_lat) < 2.0, max(pull_lat)
+        # table still serves consistent rows
+        ids = np.array([5, 50_000], np.uint64)
+        r1, r2 = shr.pull(ids), shr.pull(ids)
+        np.testing.assert_array_equal(r1, r2)
+    finally:
+        svc.stop()
+
+
+def test_pageout_keeps_hot_rows_resident(tmp_path):
+    """Balanced per-shard eviction (trim each shard to its share): a hot set
+    pulled+pushed every step must stay resident while cold churn spills —
+    draining shards in order used to evict hot rows wholesale."""
+    import numpy as np
+
+    from paddle_tpu.distributed import ps
+
+    svc = ps.EmbeddingService(dim=64, num_shards=1, rule="adagrad",
+                              ram_cap_bytes=32_000_000,
+                              spill_dir=str(tmp_path))
+    try:
+        c = svc.client()
+        hot = np.arange(13_000, dtype=np.uint64)
+        g = np.ones((len(hot), 64), np.float32)
+        rng = np.random.RandomState(0)
+        for _ in range(6):  # grow past the cap with cold churn
+            c.pull(hot)
+            c.push(hot, g, 0.01)
+            c.pull(rng.randint(1 << 20, 1 << 50, 10_000).astype(np.uint64))
+        st0 = c.tier_stats()
+        assert st0["spill_rows"] > 0  # the pager did run
+        for _ in range(3):  # steady phase: hot only +  cold churn
+            c.pull(hot)
+            c.push(hot, g, 0.01)
+            c.pull(rng.randint(1 << 20, 1 << 50, 10_000).astype(np.uint64))
+        st1 = c.tier_stats()
+        hot_lookups = 2 * 3 * len(hot)
+        # hot traffic must not page in (cold-id collisions are ~0)
+        assert st1["pageins"] - st0["pageins"] < 0.02 * hot_lookups, (
+            st0, st1)
+    finally:
+        svc.stop()

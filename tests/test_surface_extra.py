@@ -264,8 +264,8 @@ def test_in_memory_dataset(tmp_path):
     ds.load_into_memory()
     assert ds.get_memory_data_size() == 10
     ds.global_shuffle()
-    batches = list(ds)
-    assert sum(len(b) for b in batches) == 10
+    batches = list(ds)  # collated: tuple of per-field arrays
+    assert sum(b[0].shape[0] for b in batches) == 10
 
 
 def test_metric_accuracy_topk():

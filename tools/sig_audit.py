@@ -1,0 +1,192 @@
+"""Signature-parity audit: argument names + defaults vs the reference.
+
+The name audit (op_coverage.py) can say "matmul exists" while our matmul
+silently lacks ``transpose_y`` or defaults it differently — invisible drift
+(VERDICT r3 missing #5). This audit compares, per surface in
+tools/ref_signatures.json (extracted by extract_ref_signatures.py from
+ref:python/paddle — e.g. ref:python/paddle/tensor/__init__.py:302's method
+surface and the yaml-generated arg contracts in ref:paddle/phi/api/yaml/
+ops.yaml), every reference parameter against our live signature:
+
+  pass     — every reference param is accepted: same-name param present
+             (defaults equal after normalization), or absorbed by **kwargs
+  diverge  — a reference param is missing, or its default differs
+
+Positional ORDER is not enforced beyond the reference params appearing in
+relative order among our named params; our extra params (TPU knobs) are
+allowed. The first arg of Tensor methods (x/self) is skipped on both sides.
+
+Usage: JAX_PLATFORMS=cpu python tools/sig_audit.py [--diverging] [--json]
+"""
+from __future__ import annotations
+
+import importlib
+import inspect
+import json
+import os
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(HERE))
+
+MODULE_MAP = {
+    "paddle": "paddle_tpu",
+    "paddle.nn": "paddle_tpu.nn",
+    "paddle.nn.functional": "paddle_tpu.nn.functional",
+    "paddle.optimizer": "paddle_tpu.optimizer",
+    "paddle.optimizer.lr": "paddle_tpu.optimizer.lr",
+}
+
+# normalized default equivalences: the reference writes these spellings
+# interchangeably across its own modules
+_EQUIV = [
+    {"None", "'None'"},
+    {"'float32'", "'float32'"},
+    {"0", "0.0"}, {"1", "1.0"}, {"-1", "-1.0"},
+    {"False", "0"}, {"True", "1"},
+]
+
+
+def _norm(r: str) -> str:
+    r = r.strip()
+    if r.startswith("'") and r.endswith("'"):
+        return r
+    try:
+        v = eval(r, {"__builtins__": {}}, {})  # literals only
+        if isinstance(v, float) and v == int(v):
+            return repr(int(v))
+        if isinstance(v, (list, tuple)):  # [0, 1] and (0, 1) are one default
+            return repr(tuple(v))
+        return repr(v)
+    except Exception:
+        return r
+
+
+def _defaults_equal(ref: str, ours) -> bool:
+    if ours is inspect.Parameter.empty:
+        return False
+    o = _norm(repr(ours))
+    rn = _norm(ref)
+    if o == rn:
+        return True
+    for eq in _EQUIV:
+        if o in eq and rn in eq:
+            return True
+    return False
+
+
+def _target(mod):
+    if mod == "paddle.Tensor":
+        from paddle_tpu.core.tensor import Tensor
+
+        return Tensor
+    name = MODULE_MAP.get(mod)
+    if name is None:
+        return None
+    try:
+        return importlib.import_module(name)
+    except ImportError:
+        return None
+
+
+def _our_sig(obj):
+    if inspect.isclass(obj):
+        obj = obj.__init__
+    try:
+        return inspect.signature(obj)
+    except (TypeError, ValueError):
+        return None
+
+
+def _check(name, ref_sig, obj, skip_first):
+    sig = _our_sig(obj)
+    if sig is None:
+        return ["uninspectable"]
+    params = list(sig.parameters.values())
+    if params and params[0].name in ("self", "cls"):
+        params = params[1:]
+    ref_params = list(ref_sig["params"])
+    if ref_params and ref_params[0] in ("self", "cls"):
+        ref_params = ref_params[1:]
+    if skip_first and ref_params:
+        ref_params = ref_params[1:]
+        if params and params[0].kind not in (
+                inspect.Parameter.VAR_POSITIONAL,
+                inspect.Parameter.VAR_KEYWORD):
+            params = params[1:]
+    ours = {p.name: p for p in params}
+    has_kwargs = any(p.kind == inspect.Parameter.VAR_KEYWORD for p in params)
+    has_varargs = any(p.kind == inspect.Parameter.VAR_POSITIONAL
+                      for p in params)
+    issues = []
+    order = [p.name for p in params]
+    last_idx = -1
+    for rp in ref_params:
+        if rp in ("name",):  # debug-name arg: cosmetic everywhere
+            if rp not in ours and not has_kwargs:
+                issues.append("missing name kwarg")
+            continue
+        if rp not in ours:
+            if has_kwargs or has_varargs:
+                continue  # absorbed
+            issues.append(f"missing param '{rp}'")
+            continue
+        idx = order.index(rp)
+        if idx < last_idx:
+            issues.append(f"param '{rp}' out of order")
+        last_idx = idx
+        rdef = ref_sig["defaults"].get(rp)
+        odef = ours[rp].default
+        if rdef is None:
+            continue  # reference has no default -> nothing to compare
+        if not _defaults_equal(rdef, odef):
+            issues.append(
+                f"default '{rp}': ref {rdef} != ours "
+                f"{'<required>' if odef is inspect.Parameter.empty else repr(odef)}")
+    return issues
+
+
+def audit(show_diverging=False, as_json=False):
+    ref = json.load(open(os.path.join(HERE, "ref_signatures.json")))
+    totals = {"pass": 0, "diverge": 0, "unchecked": 0}
+    report = {}
+    for mod, entries in sorted(ref.items()):
+        tgt = _target(mod)
+        skip_first = mod == "paddle.Tensor"
+        ok, div = [], {}
+        for name, rsig in sorted(entries.items()):
+            obj = getattr(tgt, name, None) if tgt is not None else None
+            if obj is None or not callable(obj):
+                totals["unchecked"] += 1  # name-audit's territory
+                continue
+            if getattr(obj, "_intentional_redirect", False):
+                totals["unchecked"] += 1
+                continue
+            issues = _check(name, rsig, obj, skip_first)
+            if issues:
+                div[name] = issues
+                totals["diverge"] += 1
+            else:
+                ok.append(name)
+                totals["pass"] += 1
+        report[mod] = {"pass": ok, "diverge": div}
+        n = len(ok) + len(div)
+        pct = 100.0 * len(ok) / max(1, n)
+        print(f"{mod:24s} {len(ok):4d}/{n:4d} signatures match ({pct:.1f}%)")
+    n = totals["pass"] + totals["diverge"]
+    pct = 100.0 * totals["pass"] / max(1, n)
+    print(f"{'TOTAL':24s} {totals['pass']:4d}/{n:4d}  ({pct:.1f}%)  "
+          f"[unchecked {totals['unchecked']}]")
+    if show_diverging:
+        for mod, r in report.items():
+            for name, issues in r["diverge"].items():
+                print(f"  {mod}.{name}: {'; '.join(issues)}")
+    if as_json:
+        json.dump({"totals": totals,
+                   "modules": {m: r["diverge"] for m, r in report.items()}},
+                  open(os.path.join(HERE, "sig_report.json"), "w"), indent=1)
+    return pct, report
+
+
+if __name__ == "__main__":
+    audit("--diverging" in sys.argv, "--json" in sys.argv)
