@@ -386,9 +386,9 @@ class StudentT(Distribution):
 _KL: Dict[Tuple[Type, Type], object] = {}
 
 
-def register_kl(p_cls, q_cls):
+def register_kl(cls_p, cls_q):
     def deco(fn):
-        _KL[(p_cls, q_cls)] = fn
+        _KL[(cls_p, cls_q)] = fn
         return fn
 
     return deco

@@ -110,7 +110,8 @@ def sparse_coo_tensor(indices, values, shape: Optional[Sequence[int]] = None,
     return SparseCooTensor(jsparse.BCOO((vals, idx), shape=tuple(shape)))
 
 
-def sparse_csr_tensor(crows, cols, values, shape, dtype=None) -> SparseCsrTensor:
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
+                      stop_gradient=True) -> SparseCsrTensor:
     vals = _data(values)
     if dtype is not None:
         from ..core.dtype import convert_dtype_arg
@@ -137,7 +138,7 @@ def _coo(x):
     raise TypeError(f"expected SparseCooTensor, got {type(x)}")
 
 
-def add(x, y):
+def add(x, y, name=None):
     if isinstance(x, SparseCooTensor) and isinstance(y, SparseCooTensor):
         return SparseCooTensor((_coo(x) + _coo(y)).sum_duplicates())
     if isinstance(x, SparseCooTensor):
@@ -145,7 +146,7 @@ def add(x, y):
     return Tensor(_data(x) + _coo(y).todense())
 
 
-def multiply(x, y):
+def multiply(x, y, name=None):
     if isinstance(x, SparseCooTensor) and not isinstance(y, SparseCooTensor):
         b = _coo(x)
         gathered = _data(y)[tuple(b.indices[:, i] for i in range(b.indices.shape[1]))]
@@ -155,7 +156,7 @@ def multiply(x, y):
     raise TypeError("multiply expects at least one sparse operand")
 
 
-def matmul(x, y):
+def matmul(x, y, name=None):
     """sparse @ dense (the GNN/embedding hot path)."""
     if isinstance(x, SparseCooTensor):
         out = _coo(x) @ _data(y)
@@ -166,7 +167,7 @@ def matmul(x, y):
     raise TypeError(f"matmul expects a sparse lhs, got {type(x)}")
 
 
-def masked_matmul(x, y, mask: SparseCooTensor):
+def masked_matmul(x, y, mask: SparseCooTensor, name=None):
     """dense@dense evaluated only at mask's nonzeros (SDDMM)."""
     b = _coo(mask)
     xd, yd = _data(x), _data(y)
@@ -176,7 +177,7 @@ def masked_matmul(x, y, mask: SparseCooTensor):
 
 
 def _unary(fn):
-    def op(x):
+    def op(x, name=None):
         if isinstance(x, SparseCooTensor):
             b = _coo(x)
             return SparseCooTensor(jsparse.BCOO((fn(b.data), b.indices), shape=b.shape))
@@ -212,11 +213,11 @@ rad2deg = _unary(jnp.rad2deg)
 isnan = _unary(jnp.isnan)
 
 
-def pow(x, factor):  # noqa: A001
+def pow(x, factor, name=None):  # noqa: A001
     return _unary(lambda v: jnp.power(v, factor))(x)
 
 
-def cast(x, index_dtype=None, value_dtype=None):
+def cast(x, index_dtype=None, value_dtype=None, name=None):
     def f(v):
         return v.astype(value_dtype) if value_dtype else v
 
@@ -228,7 +229,7 @@ def cast(x, index_dtype=None, value_dtype=None):
     return out
 
 
-def divide(x, y):
+def divide(x, y, name=None):
     """Elementwise divide: sparse / dense or sparse / sparse-same-pattern."""
     if isinstance(x, SparseCooTensor) and not isinstance(y, (SparseCooTensor, SparseCsrTensor)):
         b = _coo(x)
@@ -240,12 +241,12 @@ def divide(x, y):
     return Tensor(_data(xd) / _data(yd))
 
 
-def subtract(x, y):
+def subtract(x, y, name=None):
     return add(x, neg(y) if isinstance(y, (SparseCooTensor, SparseCsrTensor))
                else Tensor(-_data(y)))
 
 
-def coalesce(x):
+def coalesce(x, name=None):
     """Merge duplicate coordinates (ref sparse.coalesce)."""
     b = _coo(x)
     return SparseCooTensor(b.sum_duplicates())
@@ -255,7 +256,7 @@ def is_same_shape(x, y) -> bool:
     return tuple(x.shape) == tuple(y.shape)
 
 
-def reshape(x, shape):
+def reshape(x, shape, name=None):
     """Reshape via dense roundtrip (pattern changes entirely; the reference's
     sparse reshape kernel also recomputes coordinates)."""
     d = x.to_dense() if isinstance(x, (SparseCooTensor, SparseCsrTensor)) else x
@@ -263,13 +264,13 @@ def reshape(x, shape):
     return to_sparse_coo(Tensor(arr), sparse_dim=len(shape))
 
 
-def transpose(x, perm):
+def transpose(x, perm, name=None):
     d = x.to_dense() if isinstance(x, (SparseCooTensor, SparseCsrTensor)) else x
     arr = jnp.transpose(_data(d), perm)
     return to_sparse_coo(Tensor(arr), sparse_dim=arr.ndim)
 
 
-def mv(x, vec):
+def mv(x, vec, name=None):
     """Sparse matrix @ dense vector."""
     if isinstance(x, (SparseCooTensor, SparseCsrTensor)):
         out = matmul(x, Tensor(_data(vec)[:, None]))
@@ -277,7 +278,7 @@ def mv(x, vec):
     return Tensor(_data(x) @ _data(vec))
 
 
-def addmm(input, x, y, beta=1.0, alpha=1.0):
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
     """beta*input + alpha*(x @ y) with sparse x (ref sparse.addmm)."""
     prod = matmul(x, y)
     return Tensor(beta * _data(input) + alpha * _data(prod))

@@ -20,11 +20,35 @@ class Compose:
         return x
 
 
-class ToTensor:
-    def __init__(self, data_format="CHW"):
+class BaseTransform:
+    """Transform base (ref transforms.BaseTransform): subclasses implement
+    _apply_image (+ optionally _apply_{boxes,mask}); with tuple inputs, only
+    elements whose key has a handler are transformed — the rest (labels,
+    ids, ...) pass through unchanged."""
+
+    def __init__(self, keys=None):
+        self.keys = keys or ("image",)
+
+    def _apply_image(self, image):
+        raise NotImplementedError
+
+    def __call__(self, inputs):
+        if isinstance(inputs, tuple):
+            out = []
+            for key, item in zip(self.keys, inputs):
+                fn = getattr(self, f"_apply_{key}", None)
+                out.append(fn(item) if fn is not None else item)
+            out.extend(inputs[len(self.keys):])  # unnamed extras untouched
+            return tuple(out)
+        return self._apply_image(inputs)
+
+
+class ToTensor(BaseTransform):
+    def __init__(self, data_format="CHW", keys=None):
+        super().__init__(keys)
         self.data_format = data_format
 
-    def __call__(self, img):
+    def _apply_image(self, img):
         arr = np.asarray(img)
         if arr.ndim == 2:
             arr = arr[:, :, None]
@@ -41,13 +65,16 @@ class ToTensor:
         return Tensor(jnp.asarray(arr))
 
 
-class Normalize:
-    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False):
+class Normalize(BaseTransform):
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False,
+                 keys=None):
+        super().__init__(keys)
         self.mean = np.asarray(mean, np.float32)
         self.std = np.asarray(std, np.float32)
         self.data_format = data_format
+        self.to_rgb = to_rgb
 
-    def __call__(self, img):
+    def _apply_image(self, img):
         from ...core.tensor import Tensor
 
         was_tensor = isinstance(img, Tensor)
@@ -56,6 +83,8 @@ class Normalize:
             shape = (-1, 1, 1)
         else:
             shape = (1, 1, -1)
+        if self.to_rgb:  # BGR input -> reverse the channel axis
+            arr = arr[::-1] if self.data_format == "CHW" else arr[..., ::-1]
         out = (arr - self.mean.reshape(shape)) / self.std.reshape(shape)
         if was_tensor:
             import jax.numpy as jnp
@@ -113,11 +142,12 @@ class Resize:
         return out if was_pil else np.asarray(out)
 
 
-class CenterCrop:
-    def __init__(self, size):
+class CenterCrop(BaseTransform):
+    def __init__(self, size, keys=None):
+        super().__init__(keys)
         self.size = (size, size) if isinstance(size, numbers.Number) else tuple(size)
 
-    def __call__(self, img):
+    def _apply_image(self, img):
         img = np.asarray(img)
         h, w = img.shape[:2]
         th, tw = self.size
@@ -126,45 +156,71 @@ class CenterCrop:
         return img[i:i + th, j:j + tw]
 
 
-class RandomCrop:
-    def __init__(self, size, padding=0):
+class RandomCrop(BaseTransform):
+    _PAD_MODES = frozenset({"constant", "edge", "reflect", "symmetric"})
+
+    def __init__(self, size, padding=None, pad_if_needed=False, fill=0,
+                 padding_mode="constant", keys=None):
+        super().__init__(keys)
+        if padding_mode not in self._PAD_MODES:
+            raise ValueError(f"padding_mode must be one of "
+                             f"{sorted(self._PAD_MODES)}, got {padding_mode!r}")
         self.size = (size, size) if isinstance(size, numbers.Number) else tuple(size)
         self.padding = padding
+        self.pad_if_needed = pad_if_needed
+        self.fill = fill
+        self.padding_mode = padding_mode
 
-    def __call__(self, img):
+    def _pad(self, img, pads):
+        if img.ndim == 3:
+            pads = pads + [(0, 0)]
+        kw = {"mode": self.padding_mode}
+        if self.padding_mode == "constant":
+            kw["constant_values"] = self.fill
+        return np.pad(img, pads, **kw)
+
+    def _apply_image(self, img):
         img = np.asarray(img)
         if self.padding:
-            pad = [(self.padding, self.padding), (self.padding, self.padding)]
-            if img.ndim == 3:
-                pad.append((0, 0))
-            img = np.pad(img, pad, mode="constant")
-        h, w = img.shape[:2]
+            p = self.padding
+            p = (p, p, p, p) if isinstance(p, numbers.Number) else tuple(p)
+            if len(p) == 2:
+                p = (p[0], p[1], p[0], p[1])
+            # paddle order: (left, top, right, bottom)
+            img = self._pad(img, [(p[1], p[3]), (p[0], p[2])])
         th, tw = self.size
+        if self.pad_if_needed:
+            h, w = img.shape[:2]
+            if h < th or w < tw:
+                dh, dw = max(0, th - h), max(0, tw - w)
+                img = self._pad(img, [(dh, dh), (dw, dw)])
+        h, w = img.shape[:2]
         i = pyrandom.randint(0, max(0, h - th))
         j = pyrandom.randint(0, max(0, w - tw))
         return img[i:i + th, j:j + tw]
 
 
-class RandomHorizontalFlip:
-    def __init__(self, prob=0.5):
+class RandomHorizontalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        super().__init__(keys)
         self.prob = prob
 
-    def __call__(self, img):
+    def _apply_image(self, img):
         if pyrandom.random() < self.prob:
             return np.asarray(img)[:, ::-1].copy()
         return np.asarray(img)
 
 
-def to_tensor(img, data_format="CHW"):
-    return ToTensor(data_format)(img)
+def to_tensor(pic, data_format="CHW"):
+    return ToTensor(data_format)(pic)
 
 
-def normalize(img, mean, std, data_format="CHW"):
-    return Normalize(mean, std, data_format)(img)
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    return Normalize(mean, std, data_format, to_rgb)(img)
 
 
-def resize(img, size):
-    return Resize(size)(img)
+def resize(img, size, interpolation="bilinear"):
+    return Resize(size, interpolation)(img)
 
 
 # ---------------------------------------------------------------- functional
@@ -339,29 +395,6 @@ def _affine_np(img, m2, fill=0, translate=(0, 0)):
 
 
 # ------------------------------------------------------------------ classes
-
-
-class BaseTransform:
-    """Transform base (ref transforms.BaseTransform): subclasses implement
-    _apply_image (+ optionally _apply_{boxes,mask}); with tuple inputs, only
-    elements whose key has a handler are transformed — the rest (labels,
-    ids, ...) pass through unchanged."""
-
-    def __init__(self, keys=None):
-        self.keys = keys or ("image",)
-
-    def _apply_image(self, image):
-        raise NotImplementedError
-
-    def __call__(self, inputs):
-        if isinstance(inputs, tuple):
-            out = []
-            for key, item in zip(self.keys, inputs):
-                fn = getattr(self, f"_apply_{key}", None)
-                out.append(fn(item) if fn is not None else item)
-            out.extend(inputs[len(self.keys):])  # unnamed extras untouched
-            return tuple(out)
-        return self._apply_image(inputs)
 
 
 class Transpose(BaseTransform):

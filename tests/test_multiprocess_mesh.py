@@ -124,3 +124,67 @@ def test_two_process_global_mesh_matches_single_process():
         np.testing.assert_allclose(r["w"], ref_w, rtol=2e-5, atol=1e-6)
     # and both ranks agree bit-for-bit with each other
     assert results[0]["losses"] == results[1]["losses"]
+
+
+def _hybrid_worker():
+    """2 processes x 2 local devices = ONE 4-device dp2 x mp2 mesh: the
+    dp axis crosses the process boundary while mp stays process-local —
+    GSPMD must insert cross-process collectives for the grad reduction."""
+    import os
+
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+
+    dist.init_parallel_env()
+    import jax
+
+    assert len(jax.devices()) == 4
+    from paddle_tpu.distributed.mesh import init_hybrid_mesh
+
+    init_hybrid_mesh(dp=2, mp=2)
+    rank = dist.get_rank()
+
+    from paddle_tpu.distributed.fleet.meta_parallel import ColumnParallelLinear
+    from paddle_tpu.jit import TrainStep
+
+    paddle.seed(0)
+    layer = ColumnParallelLinear(8, 8, gather_output=True)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=layer.parameters())
+    x, y = _make_data()
+    x8 = np.concatenate([x, x], axis=1).reshape(8, 1, 8)  # [B, S, H]
+    step = TrainStep(lambda a, b: ((layer(a) - b) ** 2).mean(), opt,
+                     layers=layer)
+    lo, hi = rank * 4, (rank + 1) * 4
+    xb = dist.shard_batch(paddle.to_tensor(x8[lo:hi]))
+    yb = dist.shard_batch(paddle.to_tensor(x8[lo:hi] * 0.5))
+    losses = [float(np.asarray(step(xb, yb)._data)) for _ in range(2)]
+    return losses
+
+
+def test_hybrid_dp_mp_mesh_across_processes():
+    """dp crosses processes, mp is local; compiled TrainStep loss parity
+    vs the single-process run on the full batch."""
+    results = spawn(_hybrid_worker, nprocs=WORLD)
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.fleet.meta_parallel import ColumnParallelLinear
+    from paddle_tpu.jit import TrainStep
+
+    paddle.seed(0)
+    layer = ColumnParallelLinear(8, 8, gather_output=True)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=layer.parameters())
+    x, _ = _make_data()
+    x8 = np.concatenate([x, x], axis=1).reshape(8, 1, 8)
+    step = TrainStep(lambda a, b: ((layer(a) - b) ** 2).mean(), opt,
+                     layers=layer)
+    ref = [float(np.asarray(step(paddle.to_tensor(x8),
+                                 paddle.to_tensor(x8 * 0.5))._data))
+           for _ in range(2)]
+    for r in results:
+        np.testing.assert_allclose(r, ref, rtol=2e-5, atol=1e-6)
+    assert results[0] == results[1]

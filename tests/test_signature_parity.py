@@ -120,3 +120,53 @@ def test_check_shape_reference_contract():
         paddle.check_shape([1, -2])
     with pytest.raises(TypeError):
         paddle.check_shape([1, 2.5])
+
+
+def test_random_crop_reference_behaviors():
+    from paddle_tpu.vision import transforms as T
+
+    img = np.arange(36, dtype=np.uint8).reshape(6, 6)
+    # pad_if_needed grows a too-small image instead of crashing
+    out = T.RandomCrop(8, pad_if_needed=True)(img)
+    assert out.shape == (8, 8)
+    # constant fill value lands in the padding
+    out = T.RandomCrop(6, padding=2, fill=7)(np.zeros((2, 2), np.uint8))
+    assert (out == 7).sum() > 0
+    # non-constant mode accepted
+    out = T.RandomCrop(4, padding=2, padding_mode="reflect")(img)
+    assert out.shape == (4, 4)
+
+
+def test_normalize_to_rgb_and_resize_interpolation():
+    from paddle_tpu.vision import transforms as T
+
+    img = np.zeros((4, 4, 3), np.float32)
+    img[..., 0] = 1.0  # "B" channel hot
+    out = T.normalize(img, mean=[0, 0, 0], std=[1, 1, 1],
+                      data_format="HWC", to_rgb=True)
+    assert out[..., 2].max() == 1.0 and out[..., 0].max() == 0.0
+    r = T.resize(np.zeros((8, 8), np.uint8), 4, interpolation="nearest")
+    assert np.asarray(r).shape[:2] == (4, 4)
+
+
+def test_transform_keys_tuple_semantics():
+    """keys routes tuple inputs through per-key handlers: elements without
+    a handler (e.g. a mask/label) pass through untouched."""
+    from paddle_tpu.vision import transforms as T
+
+    img = np.full((2, 2, 3), 4.0, np.float32)
+    mask = np.ones((2, 2), np.int32)
+    t = T.Normalize(mean=[1, 1, 1], std=[2, 2, 2], data_format="HWC",
+                    keys=("image", "mask"))
+    out_img, out_mask = t((img, mask))
+    np.testing.assert_allclose(out_img, np.full((2, 2, 3), 1.5), rtol=1e-6)
+    assert out_mask is mask  # untouched
+
+    with pytest.raises(ValueError, match="padding_mode"):
+        T.RandomCrop(4, padding_mode="wrap")
+
+    # pad_if_needed pads BOTH sides: the crop offset stays random
+    crops = {T.RandomCrop(8, pad_if_needed=True)(
+        np.arange(36, dtype=np.uint8).reshape(6, 6)).tobytes()
+        for _ in range(25)}
+    assert len(crops) > 1

@@ -31,6 +31,13 @@ SURFACES = {
     "paddle.nn.functional": ["paddle/nn/functional/"],
     "paddle.optimizer": ["paddle/optimizer/"],
     "paddle.optimizer.lr": ["paddle/optimizer/lr"],
+    "paddle.linalg": ["paddle/tensor/linalg", "paddle/tensor/"],
+    "paddle.fft": ["paddle/fft"],
+    "paddle.signal": ["paddle/signal"],
+    "paddle.distribution": ["paddle/distribution/"],
+    "paddle.vision.transforms": ["paddle/vision/transforms/"],
+    "paddle.metric": ["paddle/metric/"],
+    "paddle.sparse": ["paddle/sparse/"],
 }
 
 SKIP_DIRS = {"fluid", "tests", "incubate", "distributed"}

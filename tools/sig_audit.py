@@ -35,6 +35,13 @@ MODULE_MAP = {
     "paddle.nn.functional": "paddle_tpu.nn.functional",
     "paddle.optimizer": "paddle_tpu.optimizer",
     "paddle.optimizer.lr": "paddle_tpu.optimizer.lr",
+    "paddle.linalg": "paddle_tpu.linalg",
+    "paddle.fft": "paddle_tpu.fft",
+    "paddle.signal": "paddle_tpu.signal",
+    "paddle.distribution": "paddle_tpu.distribution",
+    "paddle.vision.transforms": "paddle_tpu.vision.transforms",
+    "paddle.metric": "paddle_tpu.metric",
+    "paddle.sparse": "paddle_tpu.sparse",
 }
 
 # normalized default equivalences: the reference writes these spellings
