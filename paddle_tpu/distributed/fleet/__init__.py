@@ -287,15 +287,43 @@ def init_server(*args, **kwargs):
     return None
 
 
-def run_server():
+def is_server() -> bool:
+    """True in a PSERVER-role process (launch --server_num sets
+    TRAINING_ROLE, the reference role_maker contract)."""
     import os
+
+    return os.environ.get("TRAINING_ROLE", "").upper() == "PSERVER"
+
+
+def is_worker() -> bool:
+    import os
+
+    return os.environ.get("TRAINING_ROLE", "TRAINER").upper() == "TRAINER"
+
+
+def run_server(block: bool = True):
+    """Host this process's table shard on PADDLE_PORT and serve until
+    terminated (ref fleet.run_server blocks; the launcher retires servers
+    once every trainer exits). ``block=False`` returns the server object
+    (tests drive it in-process)."""
+    import os
+    import time as _time
 
     from ..ps import run_server as _run
 
     port = int(os.environ.get("PADDLE_PORT", "0"))
     dim = int(os.environ.get("PADDLE_PS_DIM", "16"))
     srv = _run(dim=dim, port=port)
-    return srv
+    if not block:
+        return srv
+    try:
+        while True:
+            _time.sleep(1.0)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        srv.stop()
+    return None
 
 
 def init_worker():

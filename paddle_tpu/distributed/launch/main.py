@@ -50,6 +50,12 @@ def _parse(argv=None):
     p.add_argument("--job_id", default="default")
     p.add_argument("--max_restart", type=int, default=3)
     p.add_argument("--elastic_level", type=int, default=0)
+    # PS mode (ref launch --server_num/--trainer_num): spawns servers with
+    # TRAINING_ROLE=PSERVER + PADDLE_PORT and workers with TRAINING_ROLE=
+    # TRAINER + PADDLE_PSERVER_ENDPOINTS; one script runs both roles via
+    # fleet.is_server()
+    p.add_argument("--server_num", type=int, default=0)
+    p.add_argument("--trainer_num", "--worker_num", type=int, default=None)
     p.add_argument("training_script")
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
@@ -60,10 +66,14 @@ class Pod:
         self.args = args
         self.procs: List[subprocess.Popen] = []
         self.logs = []
+        self._n_servers = 0  # PS mode: first N procs serve forever
 
     def start(self) -> None:
         a = self.args
         os.makedirs(a.log_dir, exist_ok=True)
+        if a.server_num > 0:
+            self._start_ps()
+            return
         if a.master:
             host, port = a.master.rsplit(":", 1)
         else:
@@ -113,18 +123,72 @@ class Pod:
             )
             self.procs.append(proc)
 
+    def _start_ps(self) -> None:
+        """PS topology: server_num table servers + trainer_num workers on
+        this host, the reference's --server_num/--trainer_num launch
+        (ref:python/paddle/distributed/launch/controllers/ps.py role)."""
+        a = self.args
+        if a.nnodes > 1:
+            raise SystemExit(
+                "--server_num (PS mode) is single-host in this launcher; "
+                "for multi-host PS start servers per host and point workers "
+                "at them via PADDLE_PSERVER_ENDPOINTS")
+        n_workers = a.trainer_num if a.trainer_num is not None \
+            else a.nproc_per_node
+        server_eps = [f"127.0.0.1:{_free_port()}" for _ in range(a.server_num)]
+        worker_eps = [f"127.0.0.1:{_free_port()}" for _ in range(n_workers)]
+
+        def spawn(role, extra_env, log_name, tee):
+            env = dict(os.environ)
+            env.update({
+                "TRAINING_ROLE": role,
+                "PADDLE_PSERVER_ENDPOINTS": ",".join(server_eps),
+                "PADDLE_TRAINERS_NUM": str(n_workers),
+            })
+            env.update(extra_env)
+            log_path = os.path.join(a.log_dir, log_name)
+            logf = open(log_path, "ab", buffering=0)
+            self.logs.append(logf)
+            proc = subprocess.Popen(
+                [sys.executable, a.training_script] + a.training_script_args,
+                env=env, stdout=None if tee else logf,
+                stderr=None if tee else subprocess.STDOUT)
+            self.procs.append(proc)
+
+        for i, ep in enumerate(server_eps):
+            spawn("PSERVER",
+                  {"PADDLE_PORT": ep.rsplit(":", 1)[1],
+                   "POD_IP": "127.0.0.1",
+                   "PADDLE_PSERVER_ID": str(i)},
+                  f"serverlog.{i}", tee=False)
+        self._n_servers = a.server_num
+        for i, ep in enumerate(worker_eps):
+            spawn("TRAINER",
+                  {"PADDLE_TRAINER_ID": str(i),
+                   "PADDLE_TRAINER_ENDPOINTS": ",".join(worker_eps),
+                   "PADDLE_CURRENT_ENDPOINT": ep},
+                  f"workerlog.{i}", tee=(i == 0))
+
     def watch(self) -> int:
-        """Block until all exit (0) or any fails (kill pod, return its code)."""
+        """Block until all exit (0) or any fails (kill pod, return its code).
+        PS mode: servers run until every trainer exits 0, then the pod stops
+        them (the reference launcher's trainer-driven shutdown)."""
         while True:
             alive = False
-            for p in self.procs:
+            workers_alive = False
+            for i, p in enumerate(self.procs):
                 code = p.poll()
                 if code is None:
                     alive = True
+                    if i >= self._n_servers:
+                        workers_alive = True
                 elif code != 0:
                     self.stop()
                     return code
             if not alive:
+                return 0
+            if self._n_servers and not workers_alive:
+                self.stop()  # all trainers done: retire the servers
                 return 0
             time.sleep(0.5)
 

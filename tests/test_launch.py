@@ -92,3 +92,34 @@ def _store_fn():
     store.barrier("y")
     store.close()
     return other
+
+
+def test_launch_ps_mode_servers_and_trainers(tmp_path):
+    """--server_num spawns PSERVER-role processes (TRAINING_ROLE contract)
+    that serve tables until every trainer exits; the one script runs both
+    roles via fleet.is_server() — the reference PS launch shape."""
+    r = _run_launch(tmp_path, """
+        import os
+        import numpy as np
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        from paddle_tpu.distributed import fleet, ps
+
+        if fleet.is_server():
+            os.environ.setdefault("PADDLE_PS_DIM", "8")
+            fleet.run_server()           # blocks until the launcher retires us
+        else:
+            assert fleet.is_worker()
+            client = ps.init_from_env(dim=8)
+            rank = int(os.environ["PADDLE_TRAINER_ID"])
+            ids = np.arange(16, dtype=np.uint64)
+            client.pull(ids)
+            client.push(ids, np.ones((16, 8), np.float32), lr=0.1)
+            rows = client.pull(ids)
+            assert np.isfinite(rows).all()
+            print("PS_WORKER_OK", rank)
+    """, extra_args=["--server_num=2", "--trainer_num=2"], nproc=1)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "PS_WORKER_OK 0" in r.stdout
+    log1 = (tmp_path / "log" / "workerlog.1").read_text()
+    assert "PS_WORKER_OK 1" in log1

@@ -11,8 +11,11 @@ backend-independent (counted from HLO dot/conv shapes); bytes-accessed is
 layout-dependent and treated as an upper-bound estimate. Both are stated
 with that caveat in the generated report.
 
-Usage: JAX_PLATFORMS=cpu python tools/hlo_analysis.py [out_md]
+Usage: python tools/hlo_analysis.py [out_md]
 Writes benches/HLO_ANALYSIS.md and prints a summary JSON line.
+HLO_PLATFORM=tpu compiles for the live TPU backend instead (run from
+tpu_cashout.sh once the tunnel answers): bytes-accessed then reflects real
+bf16 TPU layouts and TPU fusion, replacing the CPU upper bound.
 """
 from __future__ import annotations
 
@@ -21,14 +24,16 @@ import os
 import re
 import sys
 
-os.environ["JAX_PLATFORMS"] = "cpu"
+_PLAT = os.environ.get("HLO_PLATFORM", "cpu")
+os.environ["JAX_PLATFORMS"] = _PLAT
 
 HERE = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, os.path.dirname(HERE))
 
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+if _PLAT == "cpu":
+    jax.config.update("jax_platforms", "cpu")
 import jax.numpy as jnp  # noqa: E402
 import numpy as np  # noqa: E402
 
@@ -110,8 +115,10 @@ def model_flops(cfg) -> float:
 
 
 def main():
+    default_name = ("HLO_ANALYSIS.md" if _PLAT == "cpu"
+                    else f"HLO_ANALYSIS_{_PLAT.upper()}.md")
     out_md = sys.argv[1] if len(sys.argv) > 1 else os.path.join(
-        os.path.dirname(HERE), "benches", "HLO_ANALYSIS.md")
+        os.path.dirname(HERE), "benches", default_name)
     rows = {}
     for remat in (False, True):
         cfg, stats, n_params = analyze(remat)

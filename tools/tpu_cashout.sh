@@ -49,4 +49,9 @@ run eager     1800 python tools/eager_bench.py
 run ps_spill  3600 python benches/ps_spill_bench.py 2.0 256
 run native   1800 env PADDLE_TPU_NATIVE_TPU_TEST=1 python -m pytest tests/test_native_infer.py -k real_plugin -q
 run flash     2400 python -m pytest tests/test_flash_attention.py -q
+# TPU-compiled cost analysis: real bf16 bytes-accessed + TPU fusion counts,
+# written to benches/HLO_ANALYSIS_TPU.md (compare against the CPU
+# upper-bound report in benches/HLO_ANALYSIS.md)
+run hlo_tpu   2400 env HLO_PLATFORM=tpu python tools/hlo_analysis.py
+run ps_async  1200 python benches/ps_async_bench.py 5 40
 echo "[cashout] done; records in benches/BASELINE_RESULTS.jsonl, logs in $LOGS/"
