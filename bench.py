@@ -61,7 +61,7 @@ def _arm_watchdog():
     limit = float(os.environ.get("BENCH_WATCHDOG", "1500"))
 
     def fire():
-        print(json.dumps({
+        rec = {
             "metric": "samples/sec/chip (GPT bench)",
             "value": 0.0,
             "unit": "samples/sec/chip",
@@ -69,7 +69,33 @@ def _arm_watchdog():
             "error": f"watchdog: no result within {limit:.0f}s "
                      "(TPU tunnel hang — device enumerates but does not "
                      "execute)",
-        }), flush=True)
+        }
+        # emit the failure record IMMEDIATELY — if an outer timeout kills us
+        # during the smoke attempt below, the round still has its record
+        print(json.dumps(rec), flush=True)
+        # the wedged backend poisons THIS process; a fresh subprocess pinned
+        # to CPU still yields a (clearly labeled) smoke datum. On success,
+        # re-emit the combined record as the final line (line-parsers that
+        # take either the first or the last JSON line both see a valid,
+        # honestly-zero record).
+        if os.environ.get("BENCH_PLATFORM") != "cpu":
+            import subprocess
+            import sys
+
+            try:
+                env = dict(os.environ, BENCH_PLATFORM="cpu",
+                           BENCH_WATCHDOG="420",
+                           BENCH_NO_BASELINE_WRITE="1")
+                out = subprocess.run(
+                    [sys.executable, os.path.abspath(__file__)], env=env,
+                    timeout=480, capture_output=True, text=True)
+                lines = [ln for ln in out.stdout.splitlines()
+                         if ln.startswith("{")]
+                if lines:
+                    rec["cpu_smoke"] = json.loads(lines[-1])
+                    print(json.dumps(rec), flush=True)
+            except Exception:  # smoke is best-effort; failure line already out
+                pass
         os._exit(3)
 
     t = threading.Timer(limit, fire)
@@ -81,6 +107,15 @@ def _arm_watchdog():
 def main():
     global cfg_seq_len
     import jax
+
+    # BENCH_PLATFORM / PADDLE_TPU_BENCH_PLATFORM pin the backend before
+    # device init (the watchdog's fallback subprocess and any wedged-tunnel
+    # manual run use this; the second name matches the benches/ convention)
+    want = os.environ.get("BENCH_PLATFORM") or \
+        os.environ.get("PADDLE_TPU_BENCH_PLATFORM")
+    if want:
+        os.environ["BENCH_PLATFORM"] = want  # the watchdog guard reads it
+        jax.config.update("jax_platforms", want)
 
     watchdog = _arm_watchdog()
 
@@ -151,12 +186,15 @@ def main():
             rec = json.load(f)
     except (FileNotFoundError, json.JSONDecodeError):
         rec = None
-        try:
-            with open(baseline_path, "w") as f:
-                json.dump({"metric": metric, "value": samples_per_sec,
-                           "tokens_per_sec": tokens_per_sec}, f)
-        except OSError:
-            pass
+        # the watchdog's CPU smoke must never claim the baseline slot with
+        # tiny-config numbers — that would block a real TPU baseline forever
+        if not os.environ.get("BENCH_NO_BASELINE_WRITE"):
+            try:
+                with open(baseline_path, "w") as f:
+                    json.dump({"metric": metric, "value": samples_per_sec,
+                               "tokens_per_sec": tokens_per_sec}, f)
+            except OSError:
+                pass
     if rec is not None:
         rec_tps = rec.get("tokens_per_sec")
         if rec.get("metric") == metric and rec.get("value"):
