@@ -170,3 +170,14 @@ def test_transform_keys_tuple_semantics():
         np.arange(36, dtype=np.uint8).reshape(6, 6)).tobytes()
         for _ in range(25)}
     assert len(crops) > 1
+
+
+def test_name_audit_no_missing(capsys):
+    """The name-level surface audit (op_coverage) must stay at zero
+    missing — regressions in module wiring show up here, not just in the
+    standalone tool."""
+    from tools.op_coverage import audit
+
+    totals = audit()
+    capsys.readouterr()  # swallow the table
+    assert totals["missing"] == 0, totals
