@@ -1,0 +1,248 @@
+"""Host + device memory stat registry.
+
+The reference keeps a process-wide registry of named memory stats — per-device
+"Allocated"/"Reserved" counters with thread-local current values aggregated on
+read and a lock-free global peak (ref:paddle/fluid/memory/stats.h:50, the
+``Stat<ThreadLocalStatBase>`` singletons updated from every allocator) — plus
+string-keyed update/query entry points (``DeviceMemoryStatCurrentValue``,
+``HOST_MEMORY_STAT_UPDATE``).
+
+TPU-native split of responsibilities:
+
+* **Device** memory is owned by XLA's BFC allocator inside the PJRT runtime —
+  we do not re-implement it (SURVEY.md L1 stance); its counters come from
+  ``Device.memory_stats()`` (bytes_in_use / peak_bytes_in_use / bytes_limit)
+  and are surfaced here read-only under the reference's stat names.
+* **Host** memory that *this framework* allocates — DataLoader shared-memory
+  transport segments, parameter-server table tiers, pinned staging buffers —
+  is tracked in-process by ``Stat`` objects with the reference's contract:
+  thread-local current (no cross-thread contention on update), summed on
+  read, monotone global peak, string-keyed access.
+
+Components with their own native accounting (the C++ embedding service's
+resident/spill tiers) register live *providers* so ``memory_stats()`` and
+``memory_summary()`` show one coherent picture without this module owning
+their counters.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Tuple
+
+__all__ = [
+    "Stat",
+    "local_device",
+    "host_memory_stat_update",
+    "host_memory_stat_current_value",
+    "host_memory_stat_peak_value",
+    "device_memory_stat_current_value",
+    "device_memory_stat_peak_value",
+    "register_stat_provider",
+    "unregister_stat_provider",
+    "memory_stats",
+    "memory_summary",
+    "reset_peaks",
+]
+
+
+class Stat:
+    """One named counter: thread-local ``current`` aggregated on read,
+    global monotone ``peak`` (ref:paddle/fluid/memory/stats.h:50)."""
+
+    def __init__(self) -> None:
+        self._local = threading.local()
+        self._all: Dict[int, list] = {}  # thread ident -> [current, local_peak]
+        self._lock = threading.Lock()
+        self._peak = 0
+        self._retired = 0  # folded-in counts of exited threads (ident reuse)
+
+    def _cell(self) -> list:
+        cell = getattr(self._local, "cell", None)
+        if cell is None:
+            cell = [0, 0]
+            self._local.cell = cell
+            with self._lock:
+                # thread idents are reused after a thread exits; fold the
+                # dead thread's contribution in before taking over its slot
+                # (the reference's ThreadDataRegistry keeps exited threads'
+                # data alive for the same reason)
+                old = self._all.get(threading.get_ident())
+                if old is not None and old is not cell:
+                    self._retired += old[0]
+                self._all[threading.get_ident()] = cell
+        return cell
+
+    def update(self, increment: int) -> None:
+        # lock-free on the hot path: only when this thread's running value
+        # makes a new thread-local high does the global peak need a look
+        # (exactly the reference's Stat::Update, stats.h:68)
+        cell = self._cell()
+        cell[0] += increment
+        if cell[0] > cell[1]:
+            cell[1] = cell[0]
+            cur = self.current_value()
+            with self._lock:
+                if cur > self._peak:
+                    self._peak = cur
+
+    def current_value(self) -> int:
+        with self._lock:
+            return self._retired + sum(c[0] for c in self._all.values())
+
+    def peak_value(self) -> int:
+        with self._lock:
+            return self._peak
+
+    def reset_peak(self) -> None:
+        cur = self.current_value()
+        with self._lock:
+            self._peak = cur
+            # lower thread-local peaks too, or post-reset highs below the
+            # old local peak would never re-examine the global peak
+            for cell in self._all.values():
+                cell[1] = cell[0]
+
+
+_host_stats: Dict[Tuple[str, int], Stat] = {}
+_host_lock = threading.Lock()
+_providers: Dict[str, Callable[[], int]] = {}
+
+
+def _host_stat(stat_type: str, dev_id: int = 0) -> Stat:
+    key = (stat_type, dev_id)
+    with _host_lock:
+        s = _host_stats.get(key)
+        if s is None:
+            s = _host_stats[key] = Stat()
+        return s
+
+
+def host_memory_stat_update(stat_type: str, dev_id: int, increment: int) -> None:
+    """String-keyed update (``HOST_MEMORY_STAT_UPDATE`` analog)."""
+    _host_stat(stat_type, dev_id).update(increment)
+
+
+def host_memory_stat_current_value(stat_type: str, dev_id: int = 0) -> int:
+    return _host_stat(stat_type, dev_id).current_value()
+
+
+def host_memory_stat_peak_value(stat_type: str, dev_id: int = 0) -> int:
+    return _host_stat(stat_type, dev_id).peak_value()
+
+
+def register_stat_provider(name: str, fn: Callable[[], int]) -> None:
+    """Register a live byte-count gauge (e.g. a PS table's resident tier).
+    The callable is polled by memory_stats()/memory_summary()."""
+    _providers[name] = fn
+
+
+def unregister_stat_provider(name: str) -> None:
+    _providers.pop(name, None)
+
+
+def local_device(device_id: int = 0):
+    """The validated PJRT device — THE device-id range check (device/ and
+    profiler call through here so the validation lives once)."""
+    import jax
+
+    devs = jax.local_devices()
+    if not 0 <= device_id < len(devs):
+        raise ValueError(
+            f"device_id {device_id} out of range: {len(devs)} local device(s)")
+    return devs[device_id]
+
+
+def _pjrt_stats(device_id: int = 0) -> dict:
+    try:
+        return local_device(device_id).memory_stats() or {}
+    except ValueError:
+        raise
+    except Exception:  # backend without stats (CPU)
+        return {}
+
+
+_DEVICE_KEYS = {
+    "Allocated": ("bytes_in_use", "peak_bytes_in_use"),
+    "Reserved": ("bytes_reserved", "peak_bytes_reserved"),
+}
+
+
+def device_memory_stat_current_value(stat_type: str, dev_id: int = 0) -> int:
+    cur_key, _ = _DEVICE_KEYS.get(stat_type, (None, None))
+    if cur_key is None:
+        raise ValueError(f"unknown device stat {stat_type!r} "
+                         f"(have {sorted(_DEVICE_KEYS)})")
+    s = _pjrt_stats(dev_id)
+    return int(s.get(cur_key, s.get("bytes_in_use", 0) if stat_type == "Reserved" else 0))
+
+
+def device_memory_stat_peak_value(stat_type: str, dev_id: int = 0) -> int:
+    _, peak_key = _DEVICE_KEYS.get(stat_type, (None, None))
+    if peak_key is None:
+        raise ValueError(f"unknown device stat {stat_type!r} "
+                         f"(have {sorted(_DEVICE_KEYS)})")
+    s = _pjrt_stats(dev_id)
+    return int(s.get(peak_key, s.get("peak_bytes_in_use", 0) if stat_type == "Reserved" else 0))
+
+
+def reset_peaks(device_id: int = 0) -> None:
+    """Reset host-stat peaks (for ``device_id``'s keys only) to their
+    current values. PJRT does not support resetting its device peak counter;
+    device peaks are lifetime values."""
+    with _host_lock:
+        stats = [s for (_, dev), s in _host_stats.items() if dev == device_id]
+    for s in stats:
+        s.reset_peak()
+
+
+def memory_stats(device_id: int = 0) -> dict:
+    """One merged dict: PJRT device counters, host stat registry, and any
+    registered live providers (``paddle.device.cuda.memory_stats`` analog)."""
+    out: dict = {}
+    pj = _pjrt_stats(device_id)
+    for name, (cur, peak) in _DEVICE_KEYS.items():
+        if cur in pj or peak in pj:
+            out[f"device.{name}.current"] = int(pj.get(cur, 0))
+            out[f"device.{name}.peak"] = int(pj.get(peak, 0))
+    if "bytes_limit" in pj:
+        out["device.limit"] = int(pj["bytes_limit"])
+    with _host_lock:
+        items = list(_host_stats.items())
+    for (stat_type, dev_id), s in items:
+        if dev_id == device_id:
+            out[f"host.{stat_type}.current"] = s.current_value()
+            out[f"host.{stat_type}.peak"] = s.peak_value()
+    for name, fn in list(_providers.items()):
+        try:
+            out[f"provider.{name}"] = int(fn())
+        except Exception:
+            out[f"provider.{name}"] = -1
+    return out
+
+
+def _fmt(n: int) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024 or unit == "TiB":
+            return f"{n:.1f} {unit}" if unit != "B" else f"{n} B"
+        n /= 1024.0
+    return f"{n} B"
+
+
+def memory_summary(device_id: int = 0) -> str:
+    """Human-readable allocator report (the ``memory_summary`` convention)."""
+    stats = memory_stats(device_id)
+    lines = [f"=== paddle_tpu memory summary (device {device_id}) ===",
+             f"{'stat':<34}{'current':>14}{'peak':>14}"]
+    seen = set()
+    for key in sorted(stats):
+        base = key.rsplit(".", 1)[0] if key.endswith((".current", ".peak")) else key
+        if base in seen:
+            continue
+        seen.add(base)
+        if key.endswith((".current", ".peak")):
+            cur = stats.get(f"{base}.current", 0)
+            peak = stats.get(f"{base}.peak", 0)
+            lines.append(f"{base:<34}{_fmt(cur):>14}{_fmt(peak):>14}")
+        else:
+            lines.append(f"{base:<34}{_fmt(stats[key]):>14}{'—':>14}")
+    return "\n".join(lines)

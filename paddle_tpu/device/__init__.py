@@ -28,39 +28,118 @@ def get_available_device():
 # memory_allocated family) — backed by PJRT's per-device memory_stats.
 
 
-def _mem_stats(device_id=0):
-    import jax
+def _resolve_device_id(device, device_id=0) -> int:
+    """Accept the reference's flexible device designators — int, 'tpu:N' /
+    'gpu:N' strings, Place — falling back to ``device_id``."""
+    if isinstance(device, int):
+        return device
+    if isinstance(device, str) and ":" in device:
+        return int(device.rsplit(":", 1)[1])
+    if isinstance(device, Place):
+        return getattr(device, "device_id", 0) or 0
+    return device_id
 
-    devs = jax.local_devices()
-    if not 0 <= device_id < len(devs):
-        raise ValueError(
-            f"device_id {device_id} out of range: {len(devs)} local device(s)")
-    stats = devs[device_id].memory_stats() or {}
-    return stats
+
+def _mem_stats(device_id=0):
+    from ..core.memory_stats import local_device
+
+    try:
+        return local_device(device_id).memory_stats() or {}
+    except ValueError:
+        raise
+    except Exception:  # backend without stats (CPU)
+        return {}
 
 
 def memory_allocated(device=None, device_id=0):
     """Bytes currently allocated on the device (0 if the backend does not
     report, e.g. CPU)."""
+    device_id = _resolve_device_id(device, device_id)
     return int(_mem_stats(device_id).get("bytes_in_use", 0))
 
 
 def max_memory_allocated(device=None, device_id=0):
+    device_id = _resolve_device_id(device, device_id)
     return int(_mem_stats(device_id).get("peak_bytes_in_use", 0))
 
 
 def memory_reserved(device=None, device_id=0):
+    device_id = _resolve_device_id(device, device_id)
     s = _mem_stats(device_id)
     return int(s.get("bytes_reserved", s.get("bytes_in_use", 0)))
 
 
 def max_memory_reserved(device=None, device_id=0):
+    device_id = _resolve_device_id(device, device_id)
     s = _mem_stats(device_id)
     return int(s.get("peak_bytes_reserved", s.get("peak_bytes_in_use", 0)))
 
 
 def device_memory_limit(device_id=0):
     return int(_mem_stats(device_id).get("bytes_limit", 0))
+
+
+def memory_stats(device=None, device_id=0):
+    """Merged device (PJRT) + host (framework allocator) stat dict
+    (ref:paddle/fluid/memory/stats.h string-keyed registry)."""
+    device_id = _resolve_device_id(device, device_id)
+    from ..core.memory_stats import memory_stats as _ms
+
+    return _ms(device_id)
+
+
+def memory_summary(device=None, device_id=0):
+    device_id = _resolve_device_id(device, device_id)
+    from ..core.memory_stats import memory_summary as _ms
+
+    return _ms(device_id)
+
+
+def reset_max_memory_allocated(device=None, device_id=0):
+    """Reset HOST-side peak stats to current values. The device peak counter
+    lives in the PJRT runtime and is a lifetime value (no reset API);
+    device.max_memory_allocated keeps reporting the lifetime peak."""
+    device_id = _resolve_device_id(device, device_id)
+    from ..core.memory_stats import reset_peaks
+
+    reset_peaks(device_id)
+
+
+reset_max_memory_reserved = reset_max_memory_allocated
+
+
+class _DeviceProperties:
+    """ASCII-repr struct matching _gpuDeviceProperties's shape
+    (ref:python/paddle/device/cuda/__init__.py:413) with TPU fields:
+    major/minor from the TPU generation, multi_processor_count = core count
+    on the chip (TensorCore count for TPUs)."""
+
+    def __init__(self, name, major, minor, total_memory, multi_processor_count):
+        self.name = name
+        self.major = major
+        self.minor = minor
+        self.total_memory = total_memory
+        self.multi_processor_count = multi_processor_count
+
+    def __repr__(self):
+        return (f"_DeviceProperties(name='{self.name}', major={self.major}, "
+                f"minor={self.minor}, total_memory={self.total_memory // (1 << 20)}MB, "
+                f"multi_processor_count={self.multi_processor_count})")
+
+
+def get_device_properties(device=None):
+    import re
+
+    from ..core.memory_stats import local_device
+
+    d = local_device(_resolve_device_id(device))
+    kind = d.device_kind  # e.g. "TPU v5 lite"
+    m = re.search(r"v(\d+)", kind)
+    major = int(m.group(1)) if m else 0
+    minor = 1 if "lite" in kind.lower() or kind.endswith("e") else 0
+    total = int((d.memory_stats() or {}).get("bytes_limit", 0)) if hasattr(d, "memory_stats") else 0
+    cores = getattr(d, "num_cores", None) or 1
+    return _DeviceProperties(kind, major, minor, total, cores)
 
 
 def empty_cache():
@@ -71,18 +150,180 @@ def empty_cache():
     gc.collect()
 
 
+# ----------------------------------------------------------- streams/events
+# (paddle.device.Stream/Event, ref:python/paddle/device/__init__.py:410,555)
+#
+# TPU-native stance: a PJRT device executes enqueued programs in order — the
+# runtime IS a single stream per device. Stream is therefore an ordering
+# handle (cross-stream waits are no-ops that hold), and Event marks a point
+# in the dispatch queue: record() enqueues a tiny program and keeps its
+# result array; the event is "done" when that array is ready, which implies
+# every earlier-enqueued program on the device has executed.
+
+
+class Event:
+    def __init__(self, device=None, enable_timing=False, blocking=False,
+                 interprocess=False):
+        if interprocess:
+            raise ValueError("interprocess events are not supported on the "
+                             "XLA runtime (single-process device queues)")
+        self.device = device
+        self.enable_timing = enable_timing
+        self.blocking = blocking
+        self._marker = None
+        self._time = None  # host wall-clock at observed completion
+
+    def record(self, stream=None):
+        import jax
+        import jax.numpy as jnp
+
+        self._time = None
+        # enqueued behind everything already dispatched to the device
+        self._marker = jnp.zeros((), jnp.int32) + 0
+
+    def query(self) -> bool:
+        if self._marker is None:
+            return True
+        ready = getattr(self._marker, "is_ready", None)
+        if ready is not None:
+            done = bool(ready())
+        else:
+            # no non-blocking readiness probe on this array type: block —
+            # a correct (if slow) answer; never stamp _time on a guess
+            import jax
+
+            jax.block_until_ready(self._marker)
+            done = True
+        if done and self._time is None:
+            import time as _t
+
+            self._time = _t.perf_counter()
+        return done
+
+    def synchronize(self):
+        import time as _t
+
+        import jax
+
+        if self._marker is not None:
+            jax.block_until_ready(self._marker)
+        if self._time is None:
+            self._time = _t.perf_counter()
+
+    def elapsed_time(self, end_event) -> float:
+        """Milliseconds between two recorded events (both synchronized
+        first). Host-observed completion times: correct ordering, ~queue
+        latency resolution — not an on-chip hardware counter. If completions
+        were observed out of record order (e.g. the end event was
+        synchronized before the start event was ever queried), the skew is
+        clamped to 0."""
+        if not (self.enable_timing and end_event.enable_timing):
+            raise ValueError("both events need enable_timing=True")
+        self.synchronize()
+        end_event.synchronize()
+        return max(0.0, (end_event._time - self._time) * 1e3)
+
+
+class Stream:
+    def __init__(self, device=None, priority=2, stream_base=None):
+        self.device = device
+        self.priority = priority
+
+    def wait_event(self, event):
+        # the device queue is in-order: anything enqueued after this call is
+        # already behind the event's marker
+        return None
+
+    def wait_stream(self, stream):
+        return None
+
+    def record_event(self, event=None):
+        ev = event or Event(self.device)
+        ev.record(self)
+        return ev
+
+    def query(self) -> bool:
+        return True
+
+    def synchronize(self):
+        synchronize(self.device)
+
+
+_current_streams: dict = {}
+
+
+def current_stream(device=None):
+    key = str(device)
+    if key not in _current_streams:
+        _current_streams[key] = Stream(device)
+    return _current_streams[key]
+
+
+def set_stream(stream):
+    prev = current_stream(stream.device)
+    _current_streams[str(stream.device)] = stream
+    return prev
+
+
+class stream_guard:
+    def __init__(self, stream):
+        self.stream = stream
+
+    def __enter__(self):
+        self._prev = set_stream(self.stream)
+        return self.stream
+
+    def __exit__(self, *exc):
+        _current_streams[str(self.stream.device)] = self._prev
+
+
+def synchronize(device=None):
+    """Block until all enqueued work on the device has executed.
+
+    ``jax.effects_barrier()`` only drains ordered side-effects, not pure
+    dispatched computations — so additionally enqueue a marker program on
+    the device and block on it; the per-device in-order execution queue
+    makes its readiness imply everything before it has run."""
+    import jax
+    import jax.numpy as jnp
+
+    jax.effects_barrier()
+    from ..core.memory_stats import local_device
+
+    dev = local_device(_resolve_device_id(device))
+    jax.block_until_ready(jax.device_put(jnp.zeros((), jnp.int32), dev) + 0)
+
+
+def get_device_name(device=None):
+    return get_device_properties(device).name
+
+
+def get_device_capability(device=None):
+    p = get_device_properties(device)
+    return p.major, p.minor
+
+
 class cuda:  # namespace parity: paddle.device.cuda.*
     memory_allocated = staticmethod(memory_allocated)
     max_memory_allocated = staticmethod(max_memory_allocated)
     memory_reserved = staticmethod(memory_reserved)
     max_memory_reserved = staticmethod(max_memory_reserved)
+    reset_max_memory_allocated = staticmethod(reset_max_memory_allocated)
+    reset_max_memory_reserved = staticmethod(reset_max_memory_reserved)
+    memory_stats = staticmethod(memory_stats)
+    memory_summary = staticmethod(memory_summary)
     empty_cache = staticmethod(empty_cache)
+    get_device_properties = staticmethod(get_device_properties)
+    get_device_name = staticmethod(get_device_name)
+    get_device_capability = staticmethod(get_device_capability)
+    Stream = Stream
+    Event = Event
+    current_stream = staticmethod(current_stream)
+    stream_guard = stream_guard
 
     @staticmethod
     def synchronize(device=None):
-        import jax
-
-        jax.effects_barrier()
+        return synchronize(device)
 
     @staticmethod
     def device_count():

@@ -85,6 +85,26 @@ class EmbeddingServer:
             raise RuntimeError("failed to start embedding server")
         self.port = self._lib.pt_emb_server_port(self._h)
         self.dim = dim
+        # live gauge in the host memory-stat registry (the C++ tiers own the
+        # bytes; we only poll) — weakref so the gauge never pins the server,
+        # and a lock so a concurrent stop() can't free the handle between
+        # the gauge's check and the C call
+        import threading
+        import weakref
+
+        from ...core.memory_stats import register_stat_provider
+
+        self._h_lock = threading.Lock()
+        ref = weakref.ref(self)
+
+        def _gauge():
+            s = ref()
+            if s is None:
+                return 0
+            with s._h_lock:
+                return int(s._lib.pt_emb_server_bytes(s._h)) if s._h else 0
+
+        register_stat_provider(f"ps_table:{self.port}", _gauge)
 
     @property
     def num_rows(self) -> int:
@@ -111,9 +131,13 @@ class EmbeddingServer:
             ctypes.c_float(decay)))
 
     def stop(self):
-        if self._h:
-            self._lib.pt_emb_server_stop(self._h)
-            self._h = None
+        with self._h_lock:
+            h, self._h = self._h, None
+        if h:
+            self._lib.pt_emb_server_stop(h)
+            from ...core.memory_stats import unregister_stat_provider
+
+            unregister_stat_provider(f"ps_table:{self.port}")
 
     def __del__(self):  # pragma: no cover
         try:

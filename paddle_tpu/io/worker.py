@@ -59,9 +59,11 @@ def _unpack_leaf(p):
     if p[0] == "raw":
         return p[1]
     _, name, dtype, shape = p
+    nbytes = int(np.prod(shape, dtype=np.int64)) * np.dtype(dtype).itemsize
     seg = shared_memory.SharedMemory(name=name)
     # (attach does not register with resource_tracker on this Python; the
     # creator already untracked, so unlink below is the only cleanup)
+    _stat_update(nbytes)
     try:
         arr = np.array(np.ndarray(shape, np.dtype(dtype), buffer=seg.buf))
     finally:
@@ -70,7 +72,22 @@ def _unpack_leaf(p):
             seg.unlink()
         except FileNotFoundError:  # pragma: no cover
             pass
+        _stat_update(-nbytes)
     return arr
+
+
+def _stat_update(delta: int):
+    """Account /dev/shm transport bytes mapped by THIS process in the host
+    stat registry: +nbytes at attach, -nbytes after unlink, so ``current`` is
+    live mapped transport bytes and ``peak`` the high-water mark (the
+    reference tracks its pinned/host allocators the same way,
+    ref:paddle/fluid/memory/stats.h HOST_MEMORY_STAT_UPDATE)."""
+    try:
+        from ..core.memory_stats import host_memory_stat_update
+
+        host_memory_stat_update("ShmTransport", 0, delta)
+    except Exception:  # pragma: no cover - stats must never break transport
+        pass
 
 
 def _untrack(name: str):

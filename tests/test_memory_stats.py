@@ -1,0 +1,148 @@
+"""Memory stat registry + device properties + stream/event surface.
+
+The reference's registry contract (ref:paddle/fluid/memory/stats.h:50):
+thread-local current aggregated on read, monotone global peak, string-keyed
+update. Host side is ours to track (shm transport, PS tables); device side
+is read-only from PJRT.
+"""
+import threading
+
+import numpy as np
+import pytest
+
+from paddle_tpu.core import memory_stats as ms
+
+
+def test_stat_current_and_peak():
+    s = ms.Stat()
+    s.update(100)
+    s.update(50)
+    assert s.current_value() == 150
+    assert s.peak_value() == 150
+    s.update(-120)
+    assert s.current_value() == 30
+    assert s.peak_value() == 150  # peak is monotone
+    s.reset_peak()
+    assert s.peak_value() == 30
+
+
+def test_stat_aggregates_across_threads():
+    s = ms.Stat()
+
+    def work(n):
+        for _ in range(n):
+            s.update(10)
+
+    ts = [threading.Thread(target=work, args=(100,)) for _ in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert s.current_value() == 4 * 100 * 10
+    assert s.peak_value() == s.current_value()
+
+
+def test_string_keyed_host_registry():
+    ms.host_memory_stat_update("UnitTestStat", 3, 4096)
+    assert ms.host_memory_stat_current_value("UnitTestStat", 3) == 4096
+    assert ms.host_memory_stat_peak_value("UnitTestStat", 3) == 4096
+    ms.host_memory_stat_update("UnitTestStat", 3, -4096)
+    assert ms.host_memory_stat_current_value("UnitTestStat", 3) == 0
+    assert ms.host_memory_stat_peak_value("UnitTestStat", 3) == 4096
+    # other (type, dev) keys are independent
+    assert ms.host_memory_stat_current_value("UnitTestStat", 0) == 0
+
+
+def test_provider_gauge_in_stats_and_summary():
+    ms.register_stat_provider("unittest_gauge", lambda: 12345)
+    try:
+        stats = ms.memory_stats()
+        assert stats["provider.unittest_gauge"] == 12345
+        summary = ms.memory_summary()
+        assert "unittest_gauge" in summary
+        assert "paddle_tpu memory summary" in summary
+    finally:
+        ms.unregister_stat_provider("unittest_gauge")
+    assert "provider.unittest_gauge" not in ms.memory_stats()
+
+
+def test_shm_transport_accounted():
+    """DataLoader shm transport: attach/unlink in the consuming process
+    updates the ShmTransport host stat (current returns to 0, peak records
+    the segment size)."""
+    from paddle_tpu.io import worker as w
+
+    before_peak = ms.host_memory_stat_peak_value("ShmTransport", 0)
+    arr = np.arange(8192, dtype=np.float32)  # 32 KiB > shm threshold
+    packed = w._pack_leaf(arr, use_shm=True)
+    assert packed[0] == "shm"
+    out = w._unpack_leaf(packed)
+    np.testing.assert_array_equal(out, arr)
+    assert ms.host_memory_stat_current_value("ShmTransport", 0) == 0
+    assert ms.host_memory_stat_peak_value("ShmTransport", 0) >= max(
+        before_peak, arr.nbytes)
+
+
+def test_ps_table_provider_registered():
+    native = pytest.importorskip("paddle_tpu.native")
+    try:
+        native.load()
+    except Exception:
+        pytest.skip("native lib unavailable")
+    from paddle_tpu.distributed.ps import EmbeddingServer
+
+    srv = EmbeddingServer(dim=8, rule="sgd")
+    name = f"provider.ps_table:{srv.port}"
+    try:
+        assert name in ms.memory_stats()
+    finally:
+        srv.stop()
+    assert name not in ms.memory_stats()
+
+
+def test_device_namespace_surface():
+    import paddle_tpu.device as D
+
+    stats = D.memory_stats()
+    assert isinstance(stats, dict)
+    assert isinstance(D.memory_summary(), str)
+    D.reset_max_memory_allocated()
+    # CPU test backend: PJRT reports no stats; the calls still work
+    assert D.memory_allocated() >= 0
+
+    props = D.get_device_properties(0)
+    assert props.name
+    assert "_DeviceProperties" in repr(props)
+    assert D.get_device_name() == props.name
+    major, minor = D.get_device_capability()
+    assert (major, minor) == (props.major, props.minor)
+    with pytest.raises(ValueError):
+        D.get_device_properties(999)
+
+
+def test_stream_event_ordering_api():
+    import jax.numpy as jnp
+
+    import paddle_tpu.device as D
+
+    s = D.current_stream()
+    assert D.current_stream() is s  # stable handle
+    e1 = D.Event(enable_timing=True)
+    e2 = D.Event(enable_timing=True)
+    e1.record()
+    e1.synchronize()  # observe completions in record order
+    _ = (jnp.ones((64, 64)) @ jnp.ones((64, 64))).block_until_ready()
+    e2.record()
+    e2.synchronize()
+    assert e1.query() and e2.query()
+    assert e1.elapsed_time(e2) >= 0.0
+    ev = s.record_event()
+    ev.synchronize()
+    assert ev.query()
+    with D.stream_guard(D.Stream()):
+        assert D.current_stream() is not s
+    assert D.current_stream() is s
+    with pytest.raises(ValueError):
+        D.Event(interprocess=True)
+    with pytest.raises(ValueError):
+        D.Event().elapsed_time(D.Event())
