@@ -129,8 +129,15 @@ def main():
     remat = os.environ.get("BENCH_REMAT", "0") == "1"
     chunk = int(os.environ.get("BENCH_CHUNK_LOSS", "0"))
     if platform == "tpu":
-        cfg = GPTConfig(vocab_size=50304, hidden_size=768, num_layers=12,
-                        num_heads=12, max_position_embeddings=2048,
+        # BENCH_HIDDEN/LAYERS/HEADS scale toward the reference's headline
+        # GPT-3 1.3B-class config (BASELINE.md config 4) as far as one chip
+        # fits; bigger models raise FLOPs-per-HBM-byte, which is the MFU
+        # lever benches/HLO_ANALYSIS.md identifies
+        hidden = int(os.environ.get("BENCH_HIDDEN", "768"))
+        layers = int(os.environ.get("BENCH_LAYERS", "12"))
+        heads = int(os.environ.get("BENCH_HEADS", str(max(1, hidden // 64))))
+        cfg = GPTConfig(vocab_size=50304, hidden_size=hidden, num_layers=layers,
+                        num_heads=heads, max_position_embeddings=2048,
                         use_recompute=remat, loss_chunk_size=chunk)
         batch = int(os.environ.get("BENCH_BATCH", "16"))  # b16 fits v5e
         # HBM comfortably (fused logsumexp CE, donation) and lifts MFU over
@@ -149,6 +156,11 @@ def main():
     opt = AdamW(learning_rate=1e-4, parameters=model.parameters(), weight_decay=0.01)
 
     use_amp = platform == "tpu"
+    # BENCH_AMP=O2: cast params themselves to bf16 (f32 optimizer slots act
+    # as the master weights) — halves the per-step weight HBM traffic on top
+    # of O1's bf16 compute
+    if use_amp and os.environ.get("BENCH_AMP", "O1") == "O2":
+        amp.decorate(model, opt, level="O2")
 
     def loss_fn(x, y):
         if use_amp:  # bf16 compute on the MXU; fp32 loss/master weights

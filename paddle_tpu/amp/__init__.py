@@ -79,8 +79,10 @@ def maybe_cast_inputs(name: str, datas):
 
 
 def decorate(models=None, optimizers=None, level="O2", dtype="bfloat16", master_weight=None, save_dtype=None):
-    """Cast model params to the AMP dtype (O2). Optimizer math stays f32
-    (our optimizer slots are always f32 = master weights)."""
+    """Cast model params to the AMP dtype (O2) and switch the optimizers to
+    multi_precision so each low-precision param trains against an f32
+    ``master_weight`` slot (ref:python/paddle/amp/auto_cast.py decorate;
+    master_weight=None means auto-on for O2, matching the reference)."""
     dtype = convert_dtype_arg(dtype)
     single = not isinstance(models, (list, tuple))
     ms = [models] if single else list(models)
@@ -90,6 +92,13 @@ def decorate(models=None, optimizers=None, level="O2", dtype="bfloat16", master_
         for p in m.parameters():
             if is_floating(p._data.dtype):
                 p._data = p._data.astype(dtype)
+    opts = [] if optimizers is None else (
+        [optimizers] if not isinstance(optimizers, (list, tuple)) else list(optimizers))
+    if level == "O2":
+        for opt in opts:
+            if opt is not None:
+                opt._multi_precision = True if master_weight is None \
+                    else bool(master_weight)
     if optimizers is None:
         return models if single else ms
     return (models, optimizers) if single else (ms, optimizers)

@@ -197,7 +197,8 @@ class TrainStep:
             for p_t, p_arr, g, slots in zip(train_params, param_arrays,
                                             grads, opt_state["slots"]):
                 upd = opt._update_for(getattr(p_t, "name", None))
-                np_, ns_ = upd(p_arr, g.astype(p_arr.dtype), slots, lr, step)
+                np_, ns_ = opt._apply_with_master(upd, p_arr, g, slots, lr,
+                                                  step)
                 new_params.append(np_)
                 new_slots.append(ns_)
             return loss, new_params, {"slots": new_slots, "step": step}, mutated
@@ -208,9 +209,23 @@ class TrainStep:
         if self._jit_fn is None:
             self._build()
         if self._opt_state is None:
+            # seed from the optimizer's accumulators when present (ckpt
+            # resume via opt.set_state_dict): overlay restored values onto
+            # freshly-initialized slots — restored keys the current config
+            # doesn't use (e.g. a master_weight from a run with different
+            # AMP settings) are dropped rather than changing the update path
+            slots = []
+            for p in self._train_params:
+                base = self._opt._init_slot(p._data)
+                acc = self._opt._accumulators.get(id(p))
+                if acc:
+                    for k in base:
+                        if k in acc:
+                            base[k] = jnp.asarray(acc[k]).astype(base[k].dtype)
+                slots.append(base)
             self._opt_state = {
-                "slots": [self._opt._init_slot(p._data) for p in self._train_params],
-                "step": jnp.zeros((), jnp.int32),
+                "slots": slots,
+                "step": jnp.asarray(self._opt._step_count, jnp.int32),
             }
         param_arrays = tuple(p._data for p in self._train_params)
         buffer_arrays = tuple(b._data for b in self._buffers)
@@ -223,6 +238,11 @@ class TrainStep:
         for b, m in zip(self._buffers, mutated):
             if m is not None:
                 b._data = m
+        # keep the optimizer's own accumulators coherent with the compiled
+        # state so opt.state_dict() after TrainStep training is truthful
+        # (device arrays are shared by reference — no transfer)
+        for p, ns in zip(self._train_params, self._opt_state["slots"]):
+            self._opt._accumulators[id(p)] = ns
         self._opt._step_count = int(self._opt_state["step"])
         return Tensor(loss)
 

@@ -29,6 +29,7 @@ class Optimizer:
         if parameters is not None:
             parameters = list(parameters)
         self._parameter_list = parameters
+        self._multi_precision = bool(multi_precision)
         self._learning_rate = learning_rate
         # weight_decay: float (L2), or a paddle.regularizer instance —
         # L1Decay flips _wd_l1 so the decay term becomes coeff*sign(param)
@@ -53,11 +54,45 @@ class Optimizer:
 
     # ----------------------------------------------------- pure update math
     def _init_slot(self, param: jax.Array) -> Dict[str, jax.Array]:
+        low = self._multi_precision and param.dtype in (jnp.bfloat16,
+                                                        jnp.float16)
+        # multi_precision / AMP-O2: moments are initialized FROM the f32
+        # master (not the low-precision param) so their dtype matches what
+        # the master update produces — otherwise the opt_state pytree
+        # changes dtype after step 1 and retriggers a full XLA compile
+        master = param.astype(jnp.float32) if low else None
+        slots = self._init_moments(master if low else param)
+        if low:
+            # f32 master copy: the update runs on it and the low-precision
+            # param is a cast of it, so sub-ulp updates are never lost to
+            # bf16 rounding (ref:paddle/phi/kernels/gpu/adamw_kernel.cu
+            # master-param path)
+            slots["master_weight"] = master
+        return slots
+
+    def _init_moments(self, param: jax.Array) -> Dict[str, jax.Array]:
         return {name: jnp.zeros_like(param) for name in self._state_names}
 
     def _update(self, param, grad, slots, lr, step):
         """Pure: (param, grad, slots, lr, step) -> (new_param, new_slots)."""
         raise NotImplementedError
+
+    @staticmethod
+    def _apply_with_master(upd, param, grad, slots, lr, step):
+        """Run an update fn with master-weight dispatch: when ``slots``
+        carries a ``master_weight`` f32 copy (multi_precision / AMP O2),
+        the math runs on the master and the param is emitted as its cast —
+        the gradient is consumed in f32, never rounded through the param
+        dtype. Dict membership is static under jit, so both branches
+        compile to straight-line code."""
+        if "master_weight" not in slots:
+            g = grad.astype(param.dtype) if grad.dtype != param.dtype else grad
+            return upd(param, g, slots, lr, step)
+        sub = {k: v for k, v in slots.items() if k != "master_weight"}
+        new_master, ns = upd(slots["master_weight"], grad.astype(jnp.float32),
+                             sub, lr, step)
+        ns["master_weight"] = new_master
+        return new_master.astype(param.dtype), ns
 
     def _update_for(self, param_name):
         """Per-parameter update fn, dispatched on the (static) name at trace
@@ -83,8 +118,10 @@ class Optimizer:
             if slots is None:
                 slots = self._init_slot(p._data)
                 self._accumulators[id(p)] = slots
+            # grad passed uncast: the jitted update casts per master/plain
+            # dispatch (a master-weight update must see the f32 grad)
             new_p, new_slots = _jit_update(type(self), self._hyper_key())(
-                p._data, g.astype(p._data.dtype) if g.dtype != p._data.dtype else g, slots, jnp.asarray(lr, jnp.float32), step
+                p._data, g, slots, jnp.asarray(lr, jnp.float32), step
             )
             p._data = new_p
             self._accumulators[id(p)] = new_slots
@@ -144,7 +181,8 @@ class Optimizer:
             if getattr(p, "stop_gradient", False) or garr is None:
                 new_params[name], new_slots[name] = p, state["slots"][name]
                 continue
-            np_, ns_ = self._update(arr, garr.astype(arr.dtype), state["slots"][name], lr_v, step)
+            np_, ns_ = self._apply_with_master(
+                self._update_for(name), arr, garr, state["slots"][name], lr_v, step)
             new_params[name] = Tensor(np_, stop_gradient=False) if isinstance(p, Tensor) else np_
             new_slots[name] = ns_
         return new_params, {"slots": new_slots, "step": step}
@@ -177,7 +215,11 @@ class Optimizer:
                 slots = self._accumulators.get(id(p))
                 if slots:
                     for k, v in slots.items():
-                        sd[f"{key}.{k}"] = Tensor(v)
+                        # snapshot a COPY: after TrainStep training the
+                        # accumulator arrays alias the compiled opt_state,
+                        # which is donated to the next step — an aliased
+                        # snapshot would die with it
+                        sd[f"{key}.{k}"] = Tensor(jnp.copy(v))
         if isinstance(self._learning_rate, LRScheduler):
             sd["LR_Scheduler"] = self._learning_rate.state_dict()
         return sd
@@ -190,7 +232,7 @@ class Optimizer:
             for i, (key, p) in enumerate(zip(self._slot_keys(),
                                              self._parameter_list)):
                 slots = {}
-                for name in self._state_names:
+                for name in list(self._state_names) + ["master_weight"]:
                     # accept the index form too (pre-auto-naming ckpts)
                     for k in (f"{key}.{name}", f"{i}.{name}"):
                         if k in state_dict:
@@ -226,7 +268,8 @@ def _jit_update(cls, hyper_key):
 
     @jax.jit
     def upd(param, grad, slots, lr, step):
-        return opt._update(param, grad, slots, lr, step)
+        return Optimizer._apply_with_master(opt._update, param, grad, slots,
+                                            lr, step)
 
     return upd
 
@@ -247,7 +290,7 @@ class Momentum(Optimizer):
     def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None, use_nesterov=False,
                  weight_decay=None, grad_clip=None, multi_precision=False, rescale_grad=1.0,
                  use_multi_tensor=False, name=None):
-        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name, multi_precision)
         self._momentum = momentum
         self._use_nesterov = use_nesterov
         self._rescale_grad = float(rescale_grad)
@@ -278,7 +321,7 @@ class Adam(Optimizer):
                  use_multi_tensor=False, name=None):
         # use_multi_tensor: fused-kernel knob in the reference; XLA fuses
         # the update across params anyway — accepted for parity
-        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name, multi_precision)
         self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
 
     def _hyper_key(self):
@@ -296,7 +339,7 @@ class Adam(Optimizer):
         new_p = param.astype(f32) - lr * m_hat / (jnp.sqrt(v_hat) + self._epsilon)
         return new_p.astype(param.dtype), {"moment1": m, "moment2": v}
 
-    def _init_slot(self, param):
+    def _init_moments(self, param):
         return {name: jnp.zeros(param.shape, jnp.float32) for name in self._state_names}
 
 
@@ -306,7 +349,8 @@ class AdamW(Adam):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, parameters=None,
                  weight_decay=0.01, lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
                  lazy_mode=False, multi_precision=False, name=None):
-        super().__init__(learning_rate, beta1, beta2, epsilon, parameters, None, grad_clip, name=name)
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters, None, grad_clip,
+                         multi_precision=multi_precision, name=name)
         from ..regularizer import L1Decay, L2Decay
 
         self._wd_l1 = isinstance(weight_decay, L1Decay)
@@ -343,7 +387,7 @@ class Adagrad(Optimizer):
     def _hyper_key(self):
         return (self._wd_key, float(self._epsilon), float(self._initial_accumulator_value))
 
-    def _init_slot(self, param):
+    def _init_moments(self, param):
         return {"moment": jnp.full(param.shape, self._initial_accumulator_value, jnp.float32)}
 
     def _update(self, param, grad, slots, lr, step):
@@ -431,7 +475,7 @@ class Lamb(Optimizer):
     def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9, beta2=0.999, epsilon=1e-6,
                  parameters=None, grad_clip=None, exclude_from_weight_decay_fn=None,
                  multi_precision=False, name=None):
-        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        super().__init__(learning_rate, parameters, None, grad_clip, name, multi_precision)
         self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
         self._lamb_weight_decay = lamb_weight_decay
 
@@ -481,7 +525,7 @@ class LarsMomentum(Optimizer):
                  lars_weight_decay=0.0005, parameters=None, grad_clip=None,
                  exclude_from_weight_decay=None, epsilon=0.0,
                  multi_precision=False, rescale_grad=1.0, name=None):
-        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        super().__init__(learning_rate, parameters, None, grad_clip, name, multi_precision)
         self._momentum = momentum
         self._lars_coeff = lars_coeff
         self._lars_weight_decay = lars_weight_decay
@@ -509,7 +553,7 @@ class LarsMomentum(Optimizer):
         new_p = p32 - v
         return new_p.astype(param.dtype), {"velocity": v}
 
-    def _init_slot(self, param):
+    def _init_moments(self, param):
         return {name: jnp.zeros(param.shape, jnp.float32)
                 for name in self._state_names}
 

@@ -1,0 +1,196 @@
+"""multi_precision (master-weight) optimizer path — AMP O2.
+
+The reference's multi-precision kernels keep an f32 master param alongside a
+low-precision model param (ref:paddle/phi/kernels/gpu/adamw_kernel.cu master
+path; python knob ``multi_precision=`` on the optimizer ctors, auto-enabled
+by ``amp.decorate`` at O2). Contract tested here: updates smaller than a
+bf16 ulp must still accumulate (they vanish without a master copy), the
+eager and compiled paths agree, and master state survives checkpointing.
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import amp, nn, optimizer
+from paddle_tpu.core.tensor import Tensor
+
+
+def _bf16_param(value=1.0, n=64):
+    p = Tensor(jnp.full((n,), value, jnp.bfloat16), stop_gradient=False)
+    p.name = "w"
+    return p
+
+
+def test_sub_ulp_updates_accumulate_with_master():
+    # bf16 ulp at 1.0 is 2^-8 ≈ 3.9e-3; each SGD step moves 1e-4 — invisible
+    # to bf16, visible to the f32 master
+    steps, lr = 50, 1e-4
+    p_master = _bf16_param()
+    opt_m = optimizer.SGD(learning_rate=lr, parameters=[p_master],
+                          multi_precision=True)
+    p_plain = _bf16_param()
+    opt_p = optimizer.SGD(learning_rate=lr, parameters=[p_plain])
+    g = jnp.ones((64,), jnp.bfloat16)
+    for _ in range(steps):
+        for p, opt in ((p_master, opt_m), (p_plain, opt_p)):
+            p.grad = Tensor(g)
+            opt.step()
+    # plain bf16: every update rounds away; master: they accumulate
+    assert float(jnp.max(jnp.abs(p_plain._data.astype(jnp.float32) - 1.0))) == 0.0
+    master = opt_m._accumulators[id(p_master)]["master_weight"]
+    np.testing.assert_allclose(np.asarray(master), 1.0 - steps * lr, rtol=1e-5)
+    # the bf16 param is the cast of the master (one visible notch after 50
+    # sub-ulp steps would appear once accumulation crosses the ulp; at 5e-3
+    # past 1.0 the cast has moved)
+    assert float(p_master._data[0]) != 1.0
+
+
+def test_adamw_master_matches_f32_reference():
+    """bf16+master AdamW fed f32 grads must track the all-f32 trajectory to
+    within ONE bf16 cast (the only rounding left is the final param emit);
+    the plain-bf16 path rounds grads AND params every step and drifts
+    further."""
+    rng = np.random.RandomState(0)
+    init = rng.standard_normal(128).astype(np.float32)
+    grads = [rng.standard_normal(128).astype(np.float32) * 0.1
+             for _ in range(30)]
+
+    def run(dtype, multi_precision):
+        p = Tensor(jnp.asarray(init, dtype), stop_gradient=False)
+        p.name = "w"
+        opt = optimizer.AdamW(learning_rate=1e-3, parameters=[p],
+                              weight_decay=0.01,
+                              multi_precision=multi_precision)
+        for g in grads:
+            p.grad = Tensor(jnp.asarray(g))  # f32 grads for both runs
+            opt.step()
+        if multi_precision:
+            return np.asarray(opt._accumulators[id(p)]["master_weight"])
+        return np.asarray(p._data.astype(jnp.float32))
+
+    ref = run(jnp.float32, False)
+    with_master = run(jnp.bfloat16, True)
+    plain = run(jnp.bfloat16, False)
+    err_master = np.abs(with_master - ref).max()
+    err_plain = np.abs(plain - ref).max()
+    assert err_master < err_plain
+    # the master trajectory IS the f32 trajectory (init cast aside)
+    assert err_master <= np.abs(init).max() * 2**-8 + 1e-6
+
+
+def test_decorate_o2_enables_master_and_trainstep_converges():
+    model = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 1))
+    opt = optimizer.AdamW(learning_rate=5e-3,
+                          parameters=model.parameters())
+    amp.decorate(model, opt, level="O2", dtype="bfloat16")
+    assert opt._multi_precision
+    assert model.parameters()[0]._data.dtype == jnp.bfloat16
+
+    from paddle_tpu.jit import TrainStep
+
+    x = Tensor(np.random.RandomState(1).standard_normal((64, 16)).astype(np.float32))
+    y = Tensor((np.asarray(x._data)[:, :4].sum(axis=1, keepdims=True)).astype(np.float32))
+
+    def loss_fn(x, y):
+        with amp.auto_cast(level="O1", dtype="bfloat16"):
+            pred = model(x)
+        return ((pred.astype("float32") - y) ** 2).mean()
+
+    step = TrainStep(loss_fn, opt, layers=model)
+    losses = [float(step(x, y)) for _ in range(40)]
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+    # master slots exist in the compiled-path optimizer state
+    assert any("master_weight" in s for s in step._opt_state["slots"])
+
+
+def test_decorate_master_weight_false_opts_out():
+    model = nn.Linear(4, 4)
+    opt = optimizer.Adam(learning_rate=1e-3, parameters=model.parameters())
+    amp.decorate(model, opt, level="O2", master_weight=False)
+    assert not opt._multi_precision
+
+
+def test_moment_dtype_stable_under_master():
+    """Moments must be f32 from step 0 under multi_precision — a bf16→f32
+    flip after the first update would change the opt_state pytree dtype and
+    retrigger a full XLA compile of the donated TrainStep."""
+    p = _bf16_param()
+    opt = optimizer.Momentum(learning_rate=1e-3, momentum=0.9,
+                             parameters=[p], multi_precision=True)
+    slots0 = opt._init_slot(p._data)
+    assert slots0["velocity"].dtype == jnp.float32
+    p.grad = Tensor(jnp.ones((64,), jnp.bfloat16))
+    opt.step()
+    assert opt._accumulators[id(p)]["velocity"].dtype == jnp.float32
+
+
+def test_trainstep_resumes_restored_optimizer_state():
+    from paddle_tpu.jit import TrainStep
+
+    def make():
+        model = nn.Linear(8, 1)
+        opt = optimizer.Adam(learning_rate=1e-2,
+                             parameters=model.parameters())
+        return model, opt
+
+    x = Tensor(np.random.RandomState(0).standard_normal((16, 8)).astype(np.float32))
+    y = Tensor(np.ones((16, 1), np.float32))
+
+    model, opt = make()
+    step = TrainStep(lambda a, b: ((model(a) - b) ** 2).mean(), opt,
+                     layers=model)
+    for _ in range(5):
+        step(x, y)
+    sd_w = {k: v for k, v in model.state_dict().items()}
+    sd_o = opt.state_dict()
+
+    model2, opt2 = make()
+    model2.set_state_dict(sd_w)
+    opt2.set_state_dict(sd_o)
+    step2 = TrainStep(lambda a, b: ((model2(a) - b) ** 2).mean(), opt2,
+                      layers=model2)
+    step2(x, y)
+    # resumed: step continues from 5 (not restarting bias correction), and
+    # the seeded moments came from the checkpoint (non-zero)
+    assert int(step2._opt_state["step"]) == 6
+    m1 = np.asarray(step2._opt_state["slots"][0]["moment1"])
+    assert np.abs(m1).max() > 0
+
+
+def test_state_dict_snapshot_survives_next_step():
+    """opt.state_dict() after TrainStep training must be a copy — the live
+    opt_state buffers are donated to the next compiled call."""
+    from paddle_tpu.jit import TrainStep
+
+    model = nn.Linear(8, 1)
+    opt = optimizer.Adam(learning_rate=1e-2, parameters=model.parameters())
+    x = Tensor(np.ones((4, 8), np.float32))
+    y = Tensor(np.ones((4, 1), np.float32))
+    step = TrainStep(lambda a, b: ((model(a) - b) ** 2).mean(), opt,
+                     layers=model)
+    step(x, y)
+    sd = opt.state_dict()
+    step(x, y)  # donates the buffers sd would alias without the copy
+    for k, v in sd.items():
+        if isinstance(v, Tensor):
+            np.asarray(v._data)  # must not raise "Array has been deleted"
+
+
+def test_master_weight_survives_state_dict_roundtrip():
+    p = _bf16_param(2.0)
+    opt = optimizer.Adam(learning_rate=1e-3, parameters=[p],
+                         multi_precision=True)
+    p.grad = Tensor(jnp.ones((64,), jnp.bfloat16))
+    opt.step()
+    sd = opt.state_dict()
+    assert any(k.endswith("master_weight") for k in sd)
+
+    p2 = _bf16_param(2.0)
+    opt2 = optimizer.Adam(learning_rate=1e-3, parameters=[p2],
+                          multi_precision=True)
+    opt2.set_state_dict(sd)
+    m1 = opt._accumulators[id(p)]["master_weight"]
+    m2 = opt2._accumulators[id(p2)]["master_weight"]
+    np.testing.assert_array_equal(np.asarray(m1), np.asarray(m2))

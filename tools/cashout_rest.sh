@@ -1,0 +1,29 @@
+#!/bin/bash
+# Post-sweep remainder of the banked TPU sequence (tools/tpu_cashout.sh
+# stages minus sweep/bench which ran first this round). Waits for any
+# running sweep/bench process to exit so two processes never contend for
+# the single tunneled chip.
+set -u
+cd "$(dirname "$0")/.."
+LOGS=benches/tpu_logs
+mkdir -p "$LOGS"
+STAMP=$(date +%Y%m%d_%H%M%S)
+
+while pgrep -f "benches/sweep.py|/bench.py" > /dev/null; do sleep 30; done
+
+run() {  # run <name> <timeout_s> <cmd...>
+  local name=$1 t=$2; shift 2
+  echo "[cashout-rest] $name ..."
+  timeout "$t" "$@" > "$LOGS/${name}_$STAMP.log" 2>&1
+  local rc=$?
+  tail -2 "$LOGS/${name}_$STAMP.log"
+  echo "[cashout-rest] $name rc=$rc"
+}
+
+run flash_tpu 3600 python benches/flash_tpu_bench.py
+run baseline  7200 python benches/baseline.py lenet resnet50 ernie gpt-hybrid widedeep
+run decode    2400 python benches/decode_bench.py
+run eager     1800 python tools/eager_bench.py
+run hlo_tpu   2400 env HLO_PLATFORM=tpu python tools/hlo_analysis.py
+run native    1800 env PADDLE_TPU_NATIVE_TPU_TEST=1 python -m pytest tests/test_native_infer.py -k real_plugin -q
+echo "[cashout-rest] done"
