@@ -65,11 +65,14 @@ def main():
             f.write(json.dumps(rec) + "\n")
         if rec.get("error"):
             # one hang can be a tunnel flake; two in a row means the chip is
-            # wedged and later points won't do better — stop
+            # wedged and later points won't do better — stop. A non-hang
+            # error (OOM, parse) proves the chip is answering: reset.
             if "watchdog" in str(rec.get("error")):
                 consecutive_hangs += 1
                 if consecutive_hangs >= 2:
                     break
+            else:
+                consecutive_hangs = 0
             continue
         consecutive_hangs = 0
         if best is None or (rec.get("mfu") or 0) > (best.get("mfu") or 0):

@@ -85,18 +85,25 @@ from . import profiler  # noqa: F401,E402
 bool = bool_  # paddle.bool alias
 
 
-def disable_static():  # API parity: we are always "dygraph"
-    pass
+def disable_static():
+    from .static.program import enable_static_mode
+
+    enable_static_mode(False)
 
 
 def enable_static():
-    raise NotImplementedError(
-        "paddle_tpu has no legacy static-graph mode; use paddle_tpu.jit.to_static (XLA compiles traced functions)"
-    )
+    """Enter static-graph mode: ``static.data`` placeholders record ops onto
+    Programs that ``static.Executor`` compiles and runs (capture + one-jit
+    replay — see paddle_tpu/static/program.py)."""
+    from .static.program import enable_static_mode
+
+    enable_static_mode(True)
 
 
 def in_dynamic_mode():
-    return True
+    from .static.program import in_static_mode
+
+    return not in_static_mode()
 from . import distribution  # noqa: F401,E402
 from . import sparse  # noqa: F401,E402
 from . import quantization  # noqa: F401,E402

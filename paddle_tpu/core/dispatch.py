@@ -186,20 +186,30 @@ def replace_value(x: Tensor, out: Tensor):
 def apply(fn, tensor_args: Tuple, static: Dict[str, Any], *, differentiable: bool = True, name: str = None):
     """Run pure function ``fn(*arrays, **static)`` over Tensor/array args."""
     name = name or fn.__name__.lstrip("_")
-    # one fused scan over the args: unwrap, detect tracers, detect live grads
+    # one fused scan over the args: unwrap, detect tracers, detect live
+    # grads, detect static-graph placeholders
     datas = []
     tracing = False
     any_live = False
+    symbolic = False
     for t in tensor_args:
         if isinstance(t, Tensor):
             d = t._data
             if not t.stop_gradient:
                 any_live = True
+            if getattr(t, "_sym_id", None) is not None:
+                symbolic = True
         else:
             d = jnp.asarray(t)
         if isinstance(d, _Tracer):
             tracing = True
         datas.append(d)
+    if symbolic:
+        # static-graph capture: a symbolic placeholder (static.data) routes
+        # the op onto its Program's tape instead of executing
+        from ..static.program import capture
+
+        return capture(fn, tensor_args, static, name)
     datas = tuple(datas)
     if _amp is not None and _amp.amp_state() is not None:
         datas = _amp.maybe_cast_inputs(name, datas)

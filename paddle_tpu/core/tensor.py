@@ -34,6 +34,11 @@ class Tensor:
         "persistable",
         "_retain_grad",
         "_version",
+        # static-graph capture: set only on symbolic placeholders/outputs
+        # (static.data / captured ops); unset on eager tensors so
+        # getattr(t, "_sym_id", None) stays the cheap discriminator
+        "_sym_id",
+        "_feed_shape",
         "__weakref__",
     )
 
@@ -81,13 +86,23 @@ class Tensor:
     def is_leaf(self) -> bool:
         return self._node is None
 
+    def _no_concrete(self):
+        if getattr(self, "_sym_id", None) is not None:
+            raise RuntimeError(
+                "this Tensor is a static-graph placeholder (static.data / a "
+                "captured op output) — it has no value until Executor.run; "
+                "fetch it via fetch_list instead of reading it directly")
+
     def numpy(self) -> np.ndarray:
+        self._no_concrete()
         return np.asarray(self._data)
 
     def item(self):
+        self._no_concrete()
         return self._data.item()
 
     def tolist(self):
+        self._no_concrete()
         return np.asarray(self._data).tolist()
 
     def __len__(self):
@@ -106,15 +121,19 @@ class Tensor:
         return isinstance(self._data, jax.core.Tracer)
 
     def __bool__(self):
+        self._no_concrete()
         return bool(self._data)
 
     def __int__(self):
+        self._no_concrete()
         return int(self._data)
 
     def __float__(self):
+        self._no_concrete()
         return float(self._data)
 
     def __array__(self, dtype=None):
+        self._no_concrete()
         a = np.asarray(self._data)
         return a.astype(dtype) if dtype is not None else a
 

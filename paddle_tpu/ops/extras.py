@@ -445,6 +445,8 @@ def rank(input, name=None):
 
 
 def tolist(x):
+    if isinstance(x, Tensor):
+        x._no_concrete()
     return np.asarray(x._data if isinstance(x, Tensor) else x).tolist()
 
 

@@ -232,7 +232,11 @@ class Optimizer:
             for i, (key, p) in enumerate(zip(self._slot_keys(),
                                              self._parameter_list)):
                 slots = {}
-                for name in list(self._state_names) + ["master_weight"]:
+                # master_weight only belongs in a multi_precision optimizer:
+                # restoring it into a plain one would silently flip the
+                # update onto the master path against the constructor's word
+                extra = ["master_weight"] if self._multi_precision else []
+                for name in list(self._state_names) + extra:
                     # accept the index form too (pre-auto-naming ckpts)
                     for k in (f"{key}.{name}", f"{i}.{name}"):
                         if k in state_dict:
@@ -247,6 +251,13 @@ class Optimizer:
 
 
 def _minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+    if getattr(loss, "_sym_id", None) is not None:
+        # static-graph capture: register the train section on the owning
+        # Program; Executor.run compiles loss->grad->update as one step
+        from ..static.program import _sym_owner
+
+        _sym_owner[loss._sym_id].set_train(self, loss)
+        return None, None
     loss.backward()
     self.step()
     return None, None

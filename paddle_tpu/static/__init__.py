@@ -37,24 +37,16 @@ def load_inference_model(path_prefix, executor=None, **kwargs):
     return jit_load(path_prefix)
 
 
-def _no_static(name):
-    def fn(*a, **k):
-        raise NotImplementedError(
-            f"paddle.static.{name} builds a legacy Program graph; "
-            "paddle_tpu compiles traced functions instead — decorate with "
-            "@paddle_tpu.jit.to_static and use jit.save/load for deployment")
-
-    # the coverage audit counts these separately, not as implemented
-    fn._intentional_redirect = True
-    return fn
-
-
-Program = _no_static("Program")
-program_guard = _no_static("program_guard")
-Executor = _no_static("Executor")
-data = _no_static("data")
-default_main_program = _no_static("default_main_program")
-default_startup_program = _no_static("default_startup_program")
+# Program/Executor/data are REAL now: tape-capturing Program + one-jit-per-
+# (fetch, feed-shape) Executor replay (see program.py for the redesign).
+from .program import (  # noqa: F401
+    Executor,
+    Program,
+    data,
+    default_main_program,
+    default_startup_program,
+    program_guard,
+)
 
 
 # -------------------------------------------------- working static surface

@@ -1,0 +1,373 @@
+"""Real static-graph mode: Program capture + Executor replay.
+
+The reference's static mode builds a Program of OpDescs that an executor
+interprets (ref:python/paddle/static/__init__.py Program/Executor,
+ref:paddle/fluid/framework/program_desc.h). The TPU-native redesign keeps
+the *API* — ``static.data`` placeholders, ``program_guard``, ``Executor.run``
+with feed/fetch — but the Program is a recorded tape of the same pure op
+functions the eager dispatcher runs, and "executing" it is one ``jax.jit``
+replay per (program, fetch-set, feed-shape) signature: the compiler is the
+executor (SURVEY.md §7), now reachable through the legacy API as well.
+
+How capture works: ``static.data`` returns a *symbolic* Tensor whose
+``_data`` is a ``jax.ShapeDtypeStruct``. Every op funnels through
+``core.dispatch.apply``; when any argument is symbolic, apply routes here —
+the op's pure fn + argument references are appended to the owning Program
+and the outputs come back symbolic (shapes via ``jax.eval_shape``). Real
+Tensors that flow in (layer parameters, constants) are recorded by
+reference, re-read at run time, and passed into the jit as arguments — so a
+Program sees parameter updates without recompiling, and ``opt.minimize``
+under capture records a train section replayed as loss→grad→update in the
+same compiled step (the TrainStep construction, assembled from the tape).
+
+Known capture limits (documented, loud): a symbolic Tensor cannot be
+concretized (``.numpy()``, ``bool()``, python control flow on values raise);
+dims declared ``None``/-1 are captured at size 1 for shape inference and
+re-specialized per concrete feed shape at run time; ops that bake a Python
+RNG key at trace time replay identically each run.
+"""
+from __future__ import annotations
+
+import functools
+import itertools
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+
+_sym_ids = itertools.count()
+_sym_owner: Dict[int, "Program"] = {}  # sym id -> owning program
+
+
+def is_symbolic(t) -> bool:
+    return isinstance(t, Tensor) and getattr(t, "_sym_id", None) is not None
+
+
+def _make_symbolic(prog: "Program", shape, dtype, name=None) -> Tensor:
+    sid = next(_sym_ids)
+    t = Tensor(jax.ShapeDtypeStruct(tuple(shape), dtype), stop_gradient=True,
+               name=name)
+    t._sym_id = sid
+    _sym_owner[sid] = prog
+    return t
+
+
+class _Node:
+    __slots__ = ("fn", "static", "inputs", "out_sids", "multi", "name")
+
+    def __init__(self, fn, static, inputs, out_sids, multi, name):
+        self.fn = fn
+        self.static = static
+        self.inputs = inputs  # list of ("sym", sid) | ("param", idx) | ("const", arr)
+        self.out_sids = out_sids
+        self.multi = multi
+        self.name = name
+
+
+class Program:
+    """A recorded op tape (ref Program; one global block — the nested-block
+    control flow of the reference is jax.lax territory on this stack)."""
+
+    def __init__(self):
+        self.ops: List[_Node] = []
+        self.placeholders: "Dict[str, int]" = {}  # feed name -> sym id
+        self._params: List[Tensor] = []  # referenced real tensors, by index
+        self._param_ids: Dict[int, int] = {}
+        self._train: Optional[tuple] = None  # (optimizer, loss_sid)
+        self.random_seed = 0
+        self._version = 0
+        self._exec_cache: Dict[tuple, Any] = {}
+        # optimizer state lives on the PROGRAM (not a runner closure): a new
+        # (fetch, feed-shape) signature builds a new runner but must keep
+        # training from the same moments/step
+        self._opt_state = None
+
+    # -- capture ----------------------------------------------------------
+    def _param_index(self, t: Tensor) -> int:
+        idx = self._param_ids.get(id(t))
+        if idx is None:
+            idx = len(self._params)
+            self._params.append(t)
+            self._param_ids[id(t)] = idx
+        return idx
+
+    def _record(self, fn, tensor_args, static, name):
+        abstract, inputs = [], []
+        for a in tensor_args:
+            if is_symbolic(a):
+                if _sym_owner.get(a._sym_id) is not self:
+                    raise RuntimeError(
+                        "symbolic tensor from another Program used here")
+                abstract.append(a._data)
+                inputs.append(("sym", a._sym_id))
+            elif isinstance(a, Tensor):
+                abstract.append(a._data)
+                inputs.append(("param", self._param_index(a)))
+            else:
+                arr = jnp.asarray(a)
+                abstract.append(arr)
+                inputs.append(("const", arr))
+        out = jax.eval_shape(lambda *xs: fn(*xs, **static) if static
+                             else fn(*xs), *abstract)
+        multi = isinstance(out, (tuple, list))
+        outs = tuple(out) if multi else (out,)
+        sym_outs = tuple(
+            _make_symbolic(self, o.shape, o.dtype, name=f"{name}.{i}")
+            for i, o in enumerate(outs))
+        self.ops.append(_Node(fn, static, inputs, [t._sym_id for t in sym_outs],
+                              multi, name))
+        self._version += 1
+        return tuple(sym_outs) if multi else sym_outs[0]
+
+    # -- replay -----------------------------------------------------------
+    def _replay(self, env: dict, param_arrays):
+        for node in self.ops:
+            args = []
+            for kind, ref in node.inputs:
+                if kind == "sym":
+                    args.append(env[ref])
+                elif kind == "param":
+                    args.append(param_arrays[ref])
+                else:
+                    args.append(ref)
+            out = node.fn(*args, **node.static) if node.static else node.fn(*args)
+            outs = tuple(out) if node.multi else (out,)
+            for sid, o in zip(node.out_sids, outs):
+                env[sid] = o
+        return env
+
+    # -- Program API parity ------------------------------------------------
+    def global_block(self):
+        return self
+
+    def clone(self, for_test: bool = False) -> "Program":
+        """Shallow copy for RUNNING (the for_test idiom: same tape, no train
+        section). Symbolic tensors remain owned by the original program —
+        capturing NEW ops on them still records onto the original, so build
+        variants before cloning (matches the reference, where clone copies
+        the desc and further mutation targets whichever program is current
+        under program_guard)."""
+        p = Program()
+        p.ops = list(self.ops)
+        p.placeholders = dict(self.placeholders)
+        p._params = list(self._params)
+        p._param_ids = dict(self._param_ids)
+        p._train = None if for_test else self._train
+        p.random_seed = self.random_seed
+        return p
+
+    def all_parameters(self):
+        return [p for p in self._params if not p.stop_gradient]
+
+    def set_train(self, optimizer, loss: Tensor):
+        if not is_symbolic(loss):
+            raise ValueError("minimize() under program_guard needs the "
+                             "captured (symbolic) loss")
+        self._train = (optimizer, loss._sym_id)
+        self._version += 1
+
+    def __repr__(self):
+        return (f"Program(ops={len(self.ops)}, feeds={list(self.placeholders)}, "
+                f"params={len(self._params)}, train={self._train is not None})")
+
+
+# ----------------------------------------------------------- guard plumbing
+
+_default_main: Program = Program()
+_default_startup: Program = Program()
+_guard_stack: List[Tuple[Program, Program]] = []
+_static_mode = False
+
+
+def enable_static_mode(on: bool = True):
+    global _static_mode
+    _static_mode = on
+
+
+def in_static_mode() -> bool:
+    return _static_mode
+
+
+def default_main_program() -> Program:
+    return _guard_stack[-1][0] if _guard_stack else _default_main
+
+
+def default_startup_program() -> Program:
+    return _guard_stack[-1][1] if _guard_stack else _default_startup
+
+
+class program_guard:
+    """Route subsequent ``static.data``/capture onto ``main`` (ref
+    program_guard)."""
+
+    def __init__(self, main_program: Program, startup_program: Optional[Program] = None):
+        self.main = main_program
+        self.startup = startup_program or Program()
+
+    def __enter__(self):
+        _guard_stack.append((self.main, self.startup))
+        return self.main
+
+    def __exit__(self, *exc):
+        _guard_stack.pop()
+
+
+def data(name: str, shape, dtype="float32", lod_level=0) -> Tensor:
+    """Feed placeholder (ref static.data). ``None``/-1 dims are captured at
+    size 1 and re-specialized per concrete feed at run time."""
+    from ..core.dtype import convert_dtype_arg
+
+    prog = default_main_program()
+    fixed = tuple(1 if (d is None or (isinstance(d, int) and d < 0)) else int(d)
+                  for d in shape)
+    t = _make_symbolic(prog, fixed, convert_dtype_arg(dtype), name=name)
+    t._feed_shape = tuple(shape)
+    prog.placeholders[name] = t._sym_id
+    return t
+
+
+# ------------------------------------------------------------- the executor
+
+
+class Executor:
+    """Compile-and-run a captured Program (ref static.Executor). ``place``
+    is accepted for parity; the program runs on the default backend's
+    devices like every other compiled step."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program: Optional[Program] = None, feed: Optional[dict] = None,
+            fetch_list=None, return_numpy: bool = True):
+        program = program if program is not None else default_main_program()
+        if not isinstance(program, Program):
+            # CompiledProgram wrapper from the compat surface
+            inner = getattr(program, "program", None)
+            if isinstance(inner, Program):
+                program = inner
+            else:
+                raise TypeError(f"cannot run {type(program).__name__}")
+        feed = feed or {}
+        fetch_list = fetch_list or []
+        if not program.ops and not fetch_list:
+            # startup program: params are initialized eagerly at layer
+            # construction, nothing to run (an op-less program with fetches
+            # — e.g. fetching a placeholder straight through — still takes
+            # the generic path below)
+            return []
+
+        fetch_sids = []
+        for f in fetch_list:
+            if not is_symbolic(f):
+                raise ValueError("fetch_list entries must be captured "
+                                 "(symbolic) tensors of this program")
+            fetch_sids.append(f._sym_id)
+
+        feed_arrays = {}
+        for name, sid in program.placeholders.items():
+            if name not in feed:
+                raise ValueError(f"missing feed '{name}'")
+            feed_arrays[name] = jnp.asarray(feed[name])
+        extra = set(feed) - set(program.placeholders)
+        if extra:
+            raise ValueError(f"unknown feed keys {sorted(extra)}")
+
+        key = (id(program), program._version, tuple(fetch_sids),
+               tuple((n, a.shape, str(a.dtype))
+                     for n, a in sorted(feed_arrays.items())))
+        runner = program._exec_cache.get(key)
+        if runner is None:
+            runner = self._build(program, fetch_sids, list(sorted(feed_arrays)))
+            program._exec_cache[key] = runner
+        outs = runner(feed_arrays)
+        if return_numpy:
+            return [np.asarray(o) for o in outs]
+        return [Tensor(o) for o in outs]
+
+    @staticmethod
+    def _fetch(env, fetch_sids):
+        try:
+            return [env[s] for s in fetch_sids]
+        except KeyError:
+            raise ValueError(
+                "fetch_list tensor is not computed by this program (it was "
+                "captured on a different Program, or on ops recorded after "
+                "a clone)") from None
+
+    def _build(self, program: Program, fetch_sids, feed_names):
+        placeholders = program.placeholders
+
+        if program._train is None:
+            @jax.jit
+            def replay(feed_arrays, param_arrays):
+                env = {placeholders[n]: feed_arrays[n] for n in feed_names}
+                env = program._replay(env, param_arrays)
+                return self._fetch(env, fetch_sids)
+
+            def runner(feed_arrays):
+                return replay(feed_arrays, [p._data for p in program._params])
+
+            return runner
+
+        # train section: loss -> grads over trainable params -> optimizer
+        # update, all in one compiled step (TrainStep assembled from tape).
+        # Params are keyed by their REAL names so name-conditional optimizer
+        # logic (LARS weight-decay exclusion etc.) behaves as in eager —
+        # deduplicated positionally like Optimizer._slot_keys.
+        opt, loss_sid = program._train
+        train_idx = [i for i, p in enumerate(program._params)
+                     if not p.stop_gradient]
+        raw = [program._params[i].name or f"p{i}" for i in train_idx]
+        names = [n if raw.count(n) == 1 else f"{n}#{raw[:j].count(n)}"
+                 for j, n in enumerate(raw)]
+
+        @jax.jit
+        def train_step(feed_arrays, param_arrays, opt_state, lr):
+            def loss_fn(trainables):
+                arrays = list(param_arrays)
+                for i, a in zip(train_idx, trainables):
+                    arrays[i] = a
+                env = {placeholders[n]: feed_arrays[n] for n in feed_names}
+                env = program._replay(env, arrays)
+                return env[loss_sid].astype(jnp.float32), env
+
+            trainables = [param_arrays[i] for i in train_idx]
+            (loss, env), grads = jax.value_and_grad(loss_fn, has_aux=True)(trainables)
+            new_p, new_state = opt.apply_gradients(
+                dict(zip(names, trainables)), dict(zip(names, grads)),
+                opt_state, lr=lr)
+            return (self._fetch(env, fetch_sids),
+                    [new_p[n] for n in names], new_state)
+
+        def runner(feed_arrays):
+            if program._opt_state is None:
+                program._opt_state = opt.init_state(
+                    {n: program._params[i]
+                     for n, i in zip(names, train_idx)})
+            lr = jnp.asarray(opt.get_lr(), jnp.float32)
+            outs, new_trainables, program._opt_state = train_step(
+                feed_arrays, [p._data for p in program._params],
+                program._opt_state, lr)
+            for i, a in zip(train_idx, new_trainables):
+                program._params[i]._data = a
+            opt._step_count = int(program._opt_state["step"])
+            return outs
+
+        return runner
+
+    def close(self):
+        pass
+
+
+def capture(fn, tensor_args, static, name):
+    """Entry point called by core.dispatch.apply when an argument is
+    symbolic: record onto the owning program."""
+    prog = None
+    for a in tensor_args:
+        if is_symbolic(a):
+            prog = _sym_owner[a._sym_id]
+            break
+    return prog._record(fn, tensor_args, dict(static) if static else {}, name)

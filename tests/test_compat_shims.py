@@ -40,8 +40,7 @@ def test_fluid_core_and_helpers():
     assert fluid.core.CPUPlace() is not None
     with pytest.raises(NotImplementedError):
         fluid.core.Scope()
-    with pytest.raises(NotImplementedError):
-        fluid.Program()
+    assert fluid.Program() is not None  # real capture Program since round 4
     fd = fluid.DataFeeder(feed_list=["x", "y"])
     feeds = fd.feed([(np.zeros(3, np.float32), 1),
                      (np.ones(3, np.float32), 2)])

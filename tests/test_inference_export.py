@@ -52,10 +52,15 @@ def test_predictor_api(tmp_path):
     np.testing.assert_allclose(out, m(paddle.to_tensor(x)).numpy(), atol=1e-5)
 
 
-def test_legacy_static_apis_raise():
+def test_static_program_apis_are_real():
+    # Program/data/Executor are real capture machinery now (round 4) — the
+    # legacy *serialization* path stays a redirect (StableHLO export is the
+    # deployment story)
     import pytest
 
+    prog = paddle.static.Program()
+    with paddle.static.program_guard(prog):
+        x = paddle.static.data("x", [1])
+        assert getattr(x, "_sym_id", None) is not None
     with pytest.raises(NotImplementedError):
-        paddle.static.Program()
-    with pytest.raises(NotImplementedError):
-        paddle.static.data("x", [1])
+        paddle.static.serialize_program()

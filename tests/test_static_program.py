@@ -1,0 +1,202 @@
+"""Static-graph mode: Program capture + Executor replay.
+
+Parity surface: paddle.static.Program/program_guard/data/Executor
+(ref:python/paddle/static/__init__.py; the reference interprets an OpDesc
+Program, here Executor.run jit-replays the captured tape — SURVEY.md §7's
+compiler-is-the-executor stance through the legacy API).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer, static
+
+
+def test_feed_fetch_roundtrip():
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 4], "float32")
+        y = (x * 2.0 + 1.0).sum(axis=1)
+    exe = static.Executor()
+    arr = np.arange(8, dtype=np.float32).reshape(2, 4)
+    (out,) = exe.run(main, feed={"x": arr}, fetch_list=[y])
+    np.testing.assert_allclose(out, (arr * 2 + 1).sum(1), rtol=1e-6)
+
+
+def test_none_dims_respecialize_per_feed_shape():
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 3], "float32")
+        y = paddle.nn.functional.relu(x - 1.0)
+    exe = static.Executor()
+    for b in (1, 5, 8):
+        arr = np.random.RandomState(b).standard_normal((b, 3)).astype(np.float32)
+        (out,) = exe.run(main, feed={"x": arr}, fetch_list=[y])
+        np.testing.assert_allclose(out, np.maximum(arr - 1, 0), rtol=1e-6)
+
+
+def test_layers_capture_with_live_parameters():
+    """nn layers under program_guard record by parameter REFERENCE: updating
+    the parameter is visible on the next run without re-capture."""
+    lin = nn.Linear(4, 2)
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 4], "float32")
+        out = lin(x)
+    exe = static.Executor()
+    arr = np.ones((3, 4), np.float32)
+    (o1,) = exe.run(main, feed={"x": arr}, fetch_list=[out])
+    expect = arr @ np.asarray(lin.weight._data) + np.asarray(lin.bias._data)
+    np.testing.assert_allclose(o1, expect, rtol=1e-5)
+
+    lin.bias._data = lin.bias._data + 10.0
+    (o2,) = exe.run(main, feed={"x": arr}, fetch_list=[out])
+    np.testing.assert_allclose(o2, expect + 10.0, rtol=1e-5)
+
+
+def test_minimize_trains_in_one_compiled_step():
+    rng = np.random.RandomState(0)
+    X = rng.standard_normal((64, 8)).astype(np.float32)
+    Yv = (X[:, :2].sum(1, keepdims=True) + 0.1).astype(np.float32)
+
+    lin = nn.Linear(8, 1)
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 8], "float32")
+        y = static.data("y", [None, 1], "float32")
+        pred = lin(x)
+        loss = ((pred - y) ** 2).mean()
+        opt = optimizer.SGD(learning_rate=0.1)
+        opt.minimize(loss)
+    exe = static.Executor()
+    exe.run(static.default_startup_program())
+    losses = []
+    for _ in range(40):
+        (lv,) = exe.run(main, feed={"x": X, "y": Yv}, fetch_list=[loss])
+        losses.append(float(lv))
+    assert losses[-1] < losses[0] * 0.1, (losses[0], losses[-1])
+
+
+def test_clone_for_test_drops_train_section():
+    lin = nn.Linear(4, 1)
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 4], "float32")
+        loss = (lin(x) ** 2).mean()
+        optimizer.SGD(learning_rate=0.1).minimize(loss)
+    test_prog = main.clone(for_test=True)
+    assert test_prog._train is None and main._train is not None
+    exe = static.Executor()
+    w0 = np.asarray(lin.weight._data).copy()
+    exe.run(test_prog, feed={"x": np.ones((2, 4), np.float32)},
+            fetch_list=[loss])
+    np.testing.assert_array_equal(np.asarray(lin.weight._data), w0)
+
+
+def test_symbolic_concretization_raises():
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [2, 2], "float32")
+        y = x + 1.0
+    with pytest.raises(RuntimeError, match="placeholder"):
+        y.numpy()
+    with pytest.raises(RuntimeError, match="placeholder"):
+        bool(y)
+    with pytest.raises(RuntimeError, match="placeholder"):
+        float(y)
+
+
+def test_executor_validates_feeds_and_fetches():
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [2], "float32")
+        y = x * 3.0
+    exe = static.Executor()
+    with pytest.raises(ValueError, match="missing feed"):
+        exe.run(main, feed={}, fetch_list=[y])
+    with pytest.raises(ValueError, match="unknown feed"):
+        exe.run(main, feed={"x": np.zeros(2, np.float32),
+                            "zz": np.zeros(2)}, fetch_list=[y])
+    with pytest.raises(ValueError, match="symbolic"):
+        exe.run(main, feed={"x": np.zeros(2, np.float32)},
+                fetch_list=[paddle.ones([2])])
+
+
+def test_optimizer_state_survives_feed_shape_change():
+    """A new (fetch, feed-shape) signature builds a new runner; the Adam
+    moments/step must carry over (they live on the Program, not the
+    runner)."""
+    lin = nn.Linear(4, 1)
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 4], "float32")
+        loss = (lin(x) ** 2).mean()
+        opt = optimizer.Adam(learning_rate=0.01)
+        opt.minimize(loss)
+    exe = static.Executor()
+    exe.run(main, feed={"x": np.ones((8, 4), np.float32)}, fetch_list=[loss])
+    exe.run(main, feed={"x": np.ones((8, 4), np.float32)}, fetch_list=[loss])
+    step_before = int(main._opt_state["step"])
+    # last partial batch: different feed shape -> new compiled runner
+    exe.run(main, feed={"x": np.ones((3, 4), np.float32)}, fetch_list=[loss])
+    assert int(main._opt_state["step"]) == step_before + 1 == 3
+    # and the slots are keyed by REAL param names (name-conditional
+    # optimizer logic depends on it)
+    keys = set(main._opt_state["slots"])
+    assert all(not k.isdigit() for k in keys), keys
+
+
+def test_fetch_placeholder_through_opless_program():
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [3], "float32")
+    exe = static.Executor()
+    (out,) = exe.run(main, feed={"x": np.arange(3).astype(np.float32)},
+                     fetch_list=[x])
+    np.testing.assert_allclose(out, [0, 1, 2])
+
+
+def test_np_asarray_on_placeholder_raises():
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [2], "float32")
+        y = x + 1.0
+    with pytest.raises(RuntimeError, match="placeholder"):
+        np.asarray(y)
+    with pytest.raises(RuntimeError, match="placeholder"):
+        y.tolist()
+
+
+def test_fetch_from_other_program_after_ops_is_loud():
+    p1 = static.Program()
+    with static.program_guard(p1):
+        a = static.data("a", [2], "float32")
+        b = a * 2.0
+    p2 = static.Program()
+    with static.program_guard(p2):
+        c = static.data("c", [2], "float32")
+        _ = c + 1.0
+    exe = static.Executor()
+    with pytest.raises(ValueError, match="not computed by this program"):
+        exe.run(p2, feed={"c": np.zeros(2, np.float32)}, fetch_list=[b])
+
+
+def test_enable_static_mode_flag():
+    assert paddle.in_dynamic_mode()
+    paddle.enable_static()
+    try:
+        assert not paddle.in_dynamic_mode()
+    finally:
+        paddle.disable_static()
+    assert paddle.in_dynamic_mode()
+
+
+def test_default_main_program_capture_without_guard():
+    before = len(static.default_main_program().ops)
+    x = static.data("dmp_x", [3], "float32")
+    y = x + 2.0
+    exe = static.Executor()
+    (out,) = exe.run(feed={"dmp_x": np.arange(3, np.float32) if False else np.arange(3).astype(np.float32)},
+                     fetch_list=[y])
+    np.testing.assert_allclose(out, [2, 3, 4])
+    assert len(static.default_main_program().ops) > before
