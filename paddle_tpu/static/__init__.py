@@ -47,6 +47,7 @@ from .program import (  # noqa: F401
     default_startup_program,
     program_guard,
 )
+from . import nn  # noqa: F401  (static.nn layer builders over the capture)
 
 
 # -------------------------------------------------- working static surface

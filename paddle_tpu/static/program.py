@@ -38,8 +38,13 @@ import numpy as np
 
 from ..core.tensor import Tensor
 
+import weakref
+
 _sym_ids = itertools.count()
-_sym_owner: Dict[int, "Program"] = {}  # sym id -> owning program
+# sym id -> owning program, weakly: dropping every user reference to a
+# Program must free it (its params, its jit cache) — an immortal registry
+# would leak one Program per loop iteration in build-per-request patterns
+_sym_owner: "weakref.WeakValueDictionary[int, Program]" = weakref.WeakValueDictionary()
 
 
 def is_symbolic(t) -> bool:
@@ -84,6 +89,10 @@ class Program:
         # (fetch, feed-shape) signature builds a new runner but must keep
         # training from the same moments/step
         self._opt_state = None
+        # (buffer Tensor, sym id) pairs applied after every run — how
+        # batch_norm's running-stat side effects ride the tape (the
+        # reference emits them as extra ops in the same block)
+        self._buffer_updates: List[Tuple[Tensor, int]] = []
 
     # -- capture ----------------------------------------------------------
     def _param_index(self, t: Tensor) -> int:
@@ -156,11 +165,22 @@ class Program:
         p._params = list(self._params)
         p._param_ids = dict(self._param_ids)
         p._train = None if for_test else self._train
+        # eval programs don't update running stats (the reference's
+        # clone(for_test) strips the stat-update ops the same way)
+        p._buffer_updates = [] if for_test else list(self._buffer_updates)
         p.random_seed = self.random_seed
         return p
 
     def all_parameters(self):
         return [p for p in self._params if not p.stop_gradient]
+
+    def add_buffer_update(self, buffer: Tensor, value: Tensor):
+        """Record 'write ``value`` (captured) into ``buffer`` after each
+        run' — stat side effects as first-class tape outputs."""
+        if not is_symbolic(value):
+            raise ValueError("buffer update value must be captured")
+        self._buffer_updates.append((buffer, value._sym_id))
+        self._version += 1
 
     def set_train(self, optimizer, loss: Tensor):
         if not is_symbolic(loss):
@@ -300,15 +320,25 @@ class Executor:
     def _build(self, program: Program, fetch_sids, feed_names):
         placeholders = program.placeholders
 
+        buf_sids = [sid for _, sid in program._buffer_updates]
+
+        def _writeback(buf_values):
+            for (buf, _), v in zip(program._buffer_updates, buf_values):
+                buf._data = v
+
         if program._train is None:
             @jax.jit
             def replay(feed_arrays, param_arrays):
                 env = {placeholders[n]: feed_arrays[n] for n in feed_names}
                 env = program._replay(env, param_arrays)
-                return self._fetch(env, fetch_sids)
+                return (self._fetch(env, fetch_sids),
+                        self._fetch(env, buf_sids))
 
             def runner(feed_arrays):
-                return replay(feed_arrays, [p._data for p in program._params])
+                outs, bufs = replay(feed_arrays,
+                                    [p._data for p in program._params])
+                _writeback(bufs)
+                return outs
 
             return runner
 
@@ -340,6 +370,7 @@ class Executor:
                 dict(zip(names, trainables)), dict(zip(names, grads)),
                 opt_state, lr=lr)
             return (self._fetch(env, fetch_sids),
+                    self._fetch(env, buf_sids),
                     [new_p[n] for n in names], new_state)
 
         def runner(feed_arrays):
@@ -348,11 +379,12 @@ class Executor:
                     {n: program._params[i]
                      for n, i in zip(names, train_idx)})
             lr = jnp.asarray(opt.get_lr(), jnp.float32)
-            outs, new_trainables, program._opt_state = train_step(
+            outs, bufs, new_trainables, program._opt_state = train_step(
                 feed_arrays, [p._data for p in program._params],
                 program._opt_state, lr)
             for i, a in zip(train_idx, new_trainables):
                 program._params[i]._data = a
+            _writeback(bufs)
             opt._step_count = int(program._opt_state["step"])
             return outs
 

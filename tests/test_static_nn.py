@@ -1,0 +1,180 @@
+"""static.nn layer builders (ref:python/paddle/static/nn/__init__.py) over
+the capture Program."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import static
+from paddle_tpu.static import nn as snn
+
+
+def _run(main, feed, fetch):
+    return static.Executor().run(main, feed=feed, fetch_list=fetch)
+
+
+def test_fc_capture_and_run():
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 6], "float32")
+        y = snn.fc(x, 4, activation="relu")
+    (out,) = _run(main, {"x": np.ones((2, 6), np.float32)}, [y])
+    assert out.shape == (2, 4) and (out >= 0).all()
+
+
+def test_fc_num_flatten_dims():
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 3, 4], "float32")
+        y = snn.fc(x, 5, num_flatten_dims=1)
+    (out,) = _run(main, {"x": np.ones((2, 3, 4), np.float32)}, [y])
+    assert out.shape == (2, 5)
+
+
+def test_named_fc_shares_parameters():
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 4], "float32")
+        a = snn.fc(x, 4, name="shared_fc")
+        b = snn.fc(x, 4, name="shared_fc")
+    oa, ob = _run(main, {"x": np.ones((1, 4), np.float32)}, [a, b])
+    np.testing.assert_array_equal(oa, ob)
+
+
+def test_embedding_and_conv():
+    main = static.Program()
+    with static.program_guard(main):
+        ids = static.data("ids", [None, 3], "int64")
+        emb = snn.embedding(ids, size=[10, 8])
+        img = static.data("img", [None, 3, 8, 8], "float32")
+        c = snn.conv2d(img, num_filters=4, filter_size=3, padding=1,
+                       act="relu")
+    e, co = _run(main, {"ids": np.zeros((2, 3), np.int64),
+                        "img": np.ones((2, 3, 8, 8), np.float32)}, [emb, c])
+    assert e.shape == (2, 3, 8) and co.shape == (2, 4, 8, 8)
+
+
+def test_batch_norm_updates_running_stats_through_tape():
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 3, 4, 4], "float32")
+        y = snn.batch_norm(x, momentum=0.5, name="bn0")
+        bn = snn.get_layer("bn0")
+    mean0 = np.asarray(bn._mean._data).copy()
+    arr = np.random.RandomState(0).standard_normal((8, 3, 4, 4)).astype(np.float32) + 5.0
+    _run(main, {"x": arr}, [y])
+    mean1 = np.asarray(bn._mean._data)
+    assert not np.allclose(mean0, mean1)  # running mean moved toward ~5
+    assert (mean1 > 1.0).all()
+
+    # stats must ACCUMULATE run over run (live-buffer read, not a snapshot)
+    _run(main, {"x": arr}, [y])
+    mean2 = np.asarray(bn._mean._data)
+    assert (np.abs(mean2 - arr.mean(axis=(0, 2, 3)))
+            < np.abs(mean1 - arr.mean(axis=(0, 2, 3)))).all()
+
+    # eval clone: no stat updates
+    test_prog = main.clone(for_test=True)
+    _run(test_prog, {"x": arr}, [y])
+    np.testing.assert_array_equal(np.asarray(bn._mean._data), mean2)
+
+
+def test_batch_norm_nhwc_axes():
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 4, 4, 3], "float32")
+        y = snn.batch_norm(x, data_layout="NHWC", name="bn_nhwc")
+        bn = snn.get_layer("bn_nhwc")
+    arr = np.random.RandomState(1).standard_normal((8, 4, 4, 3)).astype(np.float32)
+    (out,) = _run(main, {"x": arr}, [y])
+    assert out.shape == arr.shape
+    assert np.asarray(bn._mean._data).shape == (3,)  # channel-shaped stats
+
+
+def test_named_layers_scoped_per_program():
+    pa, pb = static.Program(), static.Program()
+    with static.program_guard(pa):
+        xa = static.data("x", [None, 4], "float32")
+        snn.fc(xa, 4, name="proj")
+        la = snn.get_layer("proj")
+    with static.program_guard(pb):
+        xb = static.data("x", [None, 6], "float32")
+        snn.fc(xb, 8, name="proj")  # same name, different shape: NEW layer
+        lb = snn.get_layer("proj")
+    assert la is not lb
+    assert la.weight.shape == [4, 4] and lb.weight.shape == [6, 8]
+
+
+def test_dropped_program_is_garbage_collected():
+    import gc
+    import weakref
+
+    def build():
+        p = static.Program()
+        with static.program_guard(p):
+            x = static.data("x", [2], "float32")
+            _ = x * 2.0
+        return weakref.ref(p)
+
+    ref = build()
+    gc.collect()
+    assert ref() is None  # the owner registry must not pin it
+
+
+def test_layer_group_instance_prelu():
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 4, 4, 4], "float32")
+        a = snn.layer_norm(x)
+        g = snn.group_norm(x, groups=2)
+        i = snn.instance_norm(x)
+        p = snn.prelu(x, mode="channel")
+    outs = _run(main, {"x": np.random.RandomState(1).standard_normal(
+        (2, 4, 4, 4)).astype(np.float32)}, [a, g, i, p])
+    for o in outs:
+        assert o.shape == (2, 4, 4, 4)
+
+
+def test_bilinear_and_cvm():
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 3], "float32")
+        y = static.data("y", [None, 5], "float32")
+        b = snn.bilinear_tensor_product(x, y, size=7)
+        c = snn.continuous_value_model(y, None, use_cvm=False)
+    ob, oc = _run(main, {"x": np.ones((2, 3), np.float32),
+                         "y": np.ones((2, 5), np.float32)}, [b, c])
+    assert ob.shape == (2, 7) and oc.shape == (2, 3)
+
+
+def test_control_flow_eager_semantics():
+    t = paddle.to_tensor(np.asarray(True))
+    assert snn.cond(t, lambda: 1, lambda: 2) == 1
+    r = snn.case([(paddle.to_tensor(np.asarray(False)), lambda: "a"),
+                  (paddle.to_tensor(np.asarray(True)), lambda: "b")],
+                 default=lambda: "c")
+    assert r == "b"
+    assert snn.switch_case(paddle.to_tensor(np.asarray(1)),
+                           {0: lambda: "x", 1: lambda: "y"}) == "y"
+    i = paddle.to_tensor(np.asarray(0.0, np.float32))
+    (final,) = snn.while_loop(lambda v: v < 3, lambda v: v + 1, [i])
+    assert float(final._data) == 3.0
+
+
+def test_lod_sequence_ops_raise_with_guidance():
+    with pytest.raises(NotImplementedError, match="padded batches"):
+        snn.sequence_pool(None, "max")
+    with pytest.raises(NotImplementedError, match="padded batches"):
+        snn.StaticRNN()
+
+
+def test_row_conv_mixes_future_context():
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 5, 2], "float32")
+        y = snn.row_conv(x, future_context_size=2)
+    arr = np.zeros((1, 5, 2), np.float32)
+    arr[0, 4] = 3.0  # only the last step is nonzero
+    (out,) = _run(main, {"x": arr}, [y])
+    # with uniform init weights 1/3, steps 2..4 see the future value
+    assert out[0, 4].sum() > 0 and out[0, 2].sum() > 0
+    assert out[0, 0].sum() == 0

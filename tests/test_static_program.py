@@ -196,7 +196,7 @@ def test_default_main_program_capture_without_guard():
     x = static.data("dmp_x", [3], "float32")
     y = x + 2.0
     exe = static.Executor()
-    (out,) = exe.run(feed={"dmp_x": np.arange(3, np.float32) if False else np.arange(3).astype(np.float32)},
+    (out,) = exe.run(feed={"dmp_x": np.arange(3, dtype=np.float32)},
                      fetch_list=[y])
     np.testing.assert_allclose(out, [2, 3, 4])
     assert len(static.default_main_program().ops) > before

@@ -237,8 +237,7 @@ def test_static_working_surface():
 
     v = st.create_global_var([2, 2], 1.5, "float32")
     np.testing.assert_allclose(v.numpy(), np.full((2, 2), 1.5))
-    with pytest.raises(NotImplementedError):
-        st.Program()
+    assert st.Program() is not None  # real capture Program since round 4
 
 
 # -------------------------------------------------------------- distributed
