@@ -137,13 +137,47 @@ def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data
     return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 3, data_format)
 
 
+def _opad_from_output_size(x, weight, stride, padding, dilation, n,
+                           data_format, output_size):
+    """Resolve the transpose-conv shape ambiguity: derive output_padding so
+    L_out == output_size (paddle's output_size contract — mutually exclusive
+    with an explicit output_padding)."""
+    stride_t = _norm_tuple(stride, n)
+    dil = _norm_tuple(dilation, n)
+    k = list(weight.shape)[2:]
+    pad = _norm_padding(padding, n, stride_t, dil, k)
+    if isinstance(pad, str):
+        raise ValueError("output_size cannot be combined with string padding")
+    channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+    shape = list(x.shape)
+    spatial_in = shape[1:1 + n] if channel_last else shape[2:2 + n]
+    out = _norm_tuple(output_size, n)
+    opad = []
+    for i in range(n):
+        base = ((spatial_in[i] - 1) * stride_t[i] - (pad[i][0] + pad[i][1])
+                + dil[i] * (k[i] - 1) + 1)
+        op = out[i] - base
+        if not 0 <= op < max(stride_t[i], dil[i]):
+            raise ValueError(
+                f"output_size {out[i]} unreachable for spatial dim {i}: "
+                f"valid range [{base}, {base + max(stride_t[i], dil[i]) - 1}]")
+        opad.append(op)
+    return tuple(opad)
+
+
 def conv1d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0, groups=1, dilation=1, output_size=None, data_format="NCL", name=None):
+    if output_size is not None:
+        output_padding = _opad_from_output_size(x, weight, stride, padding, dilation, 1, data_format, output_size)
     return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 1, data_format, transpose=True, output_padding=output_padding)
 
 
 def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0, dilation=1, groups=1, output_size=None, data_format="NCHW", name=None):
+    if output_size is not None:
+        output_padding = _opad_from_output_size(x, weight, stride, padding, dilation, 2, data_format, output_size)
     return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 2, data_format, transpose=True, output_padding=output_padding)
 
 
 def conv3d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0, groups=1, dilation=1, output_size=None, data_format="NCDHW", name=None):
+    if output_size is not None:
+        output_padding = _opad_from_output_size(x, weight, stride, padding, dilation, 3, data_format, output_size)
     return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 3, data_format, transpose=True, output_padding=output_padding)

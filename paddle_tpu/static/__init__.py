@@ -271,14 +271,45 @@ def exponential_decay(learning_rate, decay_steps, decay_rate, staircase=False):
 
 
 def save(program, model_path, protocol=4, **configs):
-    raise NotImplementedError(
-        "static.save persists a Program; use paddle.save(layer.state_dict()) "
-        "or jit.save for deployable programs")
+    """Persist a capture Program's parameter/buffer state (the reference
+    saves a Program's persistables; the tape itself is rebuilt from python,
+    like the reference rebuilds from the model code)."""
+    from .program import Program as _P
+
+    if not isinstance(program, _P):
+        program = getattr(program, "program", program)
+    state = {f"p{i}": np.asarray(p._data)
+             for i, p in enumerate(program._params)}
+    from ..framework.io import save as _save
+
+    _save(state, model_path if model_path.endswith(".pdparams")
+          else model_path + ".pdparams")
 
 
 def load(program, model_path, executor=None, var_list=None):
-    raise NotImplementedError(
-        "static.load loads a Program; use paddle.load / jit.load")
+    """Restore state saved by :func:`save` into the live tensors the
+    Program references (positional match — same build code both sides)."""
+    import jax.numpy as jnp
+
+    from .program import Program as _P
+
+    if not isinstance(program, _P):
+        program = getattr(program, "program", program)
+    from ..framework.io import load as _load
+
+    state = _load(model_path if model_path.endswith(".pdparams")
+                  else model_path + ".pdparams")
+    if len(state) != len(program._params):
+        raise ValueError(
+            f"checkpoint has {len(state)} tensors but the program "
+            f"references {len(program._params)} — was it built differently?")
+    for i, p in enumerate(program._params):
+        arr = state[f"p{i}"]
+        arr = arr._data if hasattr(arr, "_data") else jnp.asarray(np.asarray(arr))
+        if tuple(arr.shape) != tuple(p._data.shape):
+            raise ValueError(f"shape mismatch for param {i}: "
+                             f"{tuple(arr.shape)} vs {tuple(p._data.shape)}")
+        p._data = jnp.asarray(arr).astype(p._data.dtype)
 
 
 def save_to_file(path, content: bytes):
@@ -321,9 +352,20 @@ def load_program_state(model_path, var_list=None):
 
 
 def set_program_state(program, state_dict):
-    raise NotImplementedError(
-        "no mutable Program exists; load state into a Layer via "
-        "layer.set_state_dict")
+    """Write a state dict (from load_program_state / save) into the live
+    tensors a capture Program references."""
+    import jax.numpy as jnp
+
+    from .program import Program as _P
+
+    if not isinstance(program, _P):
+        program = getattr(program, "program", program)
+    for i, p in enumerate(program._params):
+        key = f"p{i}"
+        if key in state_dict:
+            arr = state_dict[key]
+            arr = arr._data if hasattr(arr, "_data") else np.asarray(arr)
+            p._data = jnp.asarray(arr).astype(p._data.dtype)
 
 
 def ctr_metric_bundle(input, label, ins_tag_weight=None):

@@ -21,33 +21,29 @@ import numpy as np
 
 from .program import default_main_program, is_symbolic
 
-# explicit-name parameter sharing, scoped to the current Program (the
-# reference scopes parameters per-Program the same way); clear_layer_cache()
-# drops all cached builders
-_named_layers: dict = {}
-
-
-def _scope_key(name):
-    return (id(default_main_program()), name)
+# explicit-name parameter sharing lives ON the current Program
+# (``Program._static_layers``): scoped per program like the reference's
+# per-Program parameter blocks, and freed with the program (no process-
+# global cache, no id()-reuse hazard)
 
 
 def _layer(name, factory):
     if name is None:
         return factory()
-    key = _scope_key(name)
-    if key not in _named_layers:
-        _named_layers[key] = factory()
-    return _named_layers[key]
+    cache = default_main_program()._static_layers
+    if name not in cache:
+        cache[name] = factory()
+    return cache[name]
 
 
 def get_layer(name):
     """The layer object behind a named builder call in the current Program
     scope (test/introspection hook)."""
-    return _named_layers.get(_scope_key(name))
+    return default_main_program()._static_layers.get(name)
 
 
 def clear_layer_cache():
-    _named_layers.clear()
+    default_main_program()._static_layers.clear()
 
 
 def _act(x, activation):
@@ -90,21 +86,26 @@ def embedding(input, size, is_sparse=False, is_distributed=False,
 def sparse_embedding(input, size, padding_idx=None, is_test=False,
                      entry=None, table_class="MemorySparseTable",
                      param_attr=None, dtype="float32", slot=None, name=None):
-    """PS-backed embedding when a parameter-server fleet is active (the
-    reference routes this to the distributed lookup table,
-    ref:python/paddle/static/nn/common.py sparse_embedding); plain
-    Embedding otherwise."""
+    """PS-backed embedding when a sparse table is registered with the fleet
+    (the reference routes this to the distributed lookup table,
+    ref:python/paddle/static/nn/common.py sparse_embedding); plain sparse
+    Embedding otherwise. ``slot`` selects the registered table id (first
+    registered table when omitted)."""
     from ..distributed import fleet
 
-    if getattr(fleet, "_state", None) is not None and \
-            getattr(fleet._state, "ps_client", None) is not None:
+    tables = getattr(fleet, "_registered_tables", None)
+    if tables:
         from ..distributed.ps import PSEmbedding
 
-        ps = _layer(name, lambda: PSEmbedding(fleet._state.ps_client,
-                                              dim=size[1]))
-        return ps(input)
+        client = tables[int(slot)] if slot is not None \
+            else next(iter(tables.values()))
+        return _layer(name, lambda: PSEmbedding(client))(input)
     return embedding(input, size, is_sparse=True, padding_idx=padding_idx,
                      param_attr=param_attr, dtype=dtype, name=name)
+
+
+def _in_channels(input, data_format):
+    return input.shape[-1] if data_format.endswith("C") else input.shape[1]
 
 
 def _conv(cls, name, *args, **kw):
@@ -116,7 +117,8 @@ def _conv(cls, name, *args, **kw):
 def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
            groups=1, param_attr=None, bias_attr=None, act=None, name=None,
            data_format="NCHW"):
-    layer = _conv("Conv2D", name, input.shape[1], num_filters, filter_size,
+    layer = _conv("Conv2D", name, _in_channels(input, data_format),
+                  num_filters, filter_size,
                   stride=stride, padding=padding, dilation=dilation,
                   groups=groups, weight_attr=param_attr, bias_attr=bias_attr,
                   data_format=data_format)
@@ -126,37 +128,51 @@ def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
 def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
            groups=1, param_attr=None, bias_attr=None, act=None, name=None,
            data_format="NCDHW"):
-    layer = _conv("Conv3D", name, input.shape[1], num_filters, filter_size,
+    layer = _conv("Conv3D", name, _in_channels(input, data_format),
+                  num_filters, filter_size,
                   stride=stride, padding=padding, dilation=dilation,
                   groups=groups, weight_attr=param_attr, bias_attr=bias_attr,
                   data_format=data_format)
     return _act(layer(input), act)
 
 
+def _conv_transpose(cls, fname, input, num_filters, filter_size, output_size,
+                    stride, padding, dilation, groups, param_attr, bias_attr,
+                    act, name, data_format):
+    layer = _conv(cls, name, _in_channels(input, data_format), num_filters,
+                  filter_size, stride=stride, padding=padding,
+                  dilation=dilation, groups=groups, weight_attr=param_attr,
+                  bias_attr=bias_attr, data_format=data_format)
+    if output_size is None:
+        return _act(layer(input), act)
+    # output_size resolves the transpose shape ambiguity — route through the
+    # functional form (the layer's forward has no output_size parameter)
+    from ..nn import functional as F
+
+    out = getattr(F, fname)(input, layer.weight, layer.bias, stride=stride,
+                            padding=padding, dilation=dilation, groups=groups,
+                            output_size=output_size, data_format=data_format)
+    return _act(out, act)
+
+
 def conv2d_transpose(input, num_filters, filter_size=None, output_size=None,
                      stride=1, padding=0, dilation=1, groups=1,
                      param_attr=None, bias_attr=None, act=None, name=None,
                      data_format="NCHW"):
-    layer = _conv("Conv2DTranspose", name, input.shape[1], num_filters,
-                  filter_size, stride=stride, padding=padding,
-                  dilation=dilation, groups=groups, weight_attr=param_attr,
-                  bias_attr=bias_attr, data_format=data_format)
-    out = layer(input, output_size=output_size) if output_size is not None \
-        else layer(input)
-    return _act(out, act)
+    return _conv_transpose("Conv2DTranspose", "conv2d_transpose", input,
+                           num_filters, filter_size, output_size, stride,
+                           padding, dilation, groups, param_attr, bias_attr,
+                           act, name, data_format)
 
 
 def conv3d_transpose(input, num_filters, filter_size=None, output_size=None,
                      stride=1, padding=0, dilation=1, groups=1,
                      param_attr=None, bias_attr=None, act=None, name=None,
                      data_format="NCDHW"):
-    layer = _conv("Conv3DTranspose", name, input.shape[1], num_filters,
-                  filter_size, stride=stride, padding=padding,
-                  dilation=dilation, groups=groups, weight_attr=param_attr,
-                  bias_attr=bias_attr, data_format=data_format)
-    out = layer(input, output_size=output_size) if output_size is not None \
-        else layer(input)
-    return _act(out, act)
+    return _conv_transpose("Conv3DTranspose", "conv3d_transpose", input,
+                           num_filters, filter_size, output_size, stride,
+                           padding, dilation, groups, param_attr, bias_attr,
+                           act, name, data_format)
 
 
 def deform_conv2d(x, offset, mask, num_filters, filter_size, stride=1,
@@ -199,14 +215,19 @@ def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
         # the LIVE buffer Tensors, recorded by reference, so each run
         # folds into the previous run's value.
         mean, var = F.batch_stats(input, data_format=data_layout)
-        # algebraic form chosen so the buffer only ever appears inside an op
-        # whose OTHER operand is symbolic: `bn._mean * momentum` alone would
-        # execute eagerly and freeze the product into the tape as a const
-        new_mean = bn._mean + (mean - bn._mean) * (1 - momentum)
-        new_var = bn._variance + (var - bn._variance) * (1 - momentum)
         from .program import _sym_owner
 
         prog = _sym_owner[out._sym_id]
+        # chain through any pending update of the same buffer (name-shared
+        # layer applied twice in one program → sequential fold, like the
+        # reference's in-block stat ops); the algebraic form keeps the
+        # buffer inside ops whose OTHER operand is symbolic — a plain
+        # `buffer * momentum` would execute eagerly and freeze into the
+        # tape as a constant
+        cur_mean = prog.pending_buffer_value(bn._mean)
+        cur_var = prog.pending_buffer_value(bn._variance)
+        new_mean = cur_mean + (mean - cur_mean) * (1 - momentum)
+        new_var = cur_var + (var - cur_var) * (1 - momentum)
         prog.add_buffer_update(bn._mean, new_mean)
         prog.add_buffer_update(bn._variance, new_var)
     return _act(out, act)
@@ -302,10 +323,12 @@ def row_conv(input, future_context_size, param_attr=None, act=None):
 
     ctx = future_context_size + 1
     d = input.shape[-1]
-    w = _named_layers.setdefault(
-        ("row_conv_w", d, ctx),
-        Tensor(jnp.zeros((ctx, d), jnp.float32) + 1.0 / ctx,
-               stop_gradient=False))
+    cache = default_main_program()._static_layers
+    key = ("row_conv_w", d, ctx)
+    if key not in cache:
+        cache[key] = Tensor(jnp.zeros((ctx, d), jnp.float32) + 1.0 / ctx,
+                            stop_gradient=False)
+    w = cache[key]
 
     def _row(x, w):
         T = x.shape[1]

@@ -89,10 +89,13 @@ class Program:
         # (fetch, feed-shape) signature builds a new runner but must keep
         # training from the same moments/step
         self._opt_state = None
-        # (buffer Tensor, sym id) pairs applied after every run — how
-        # batch_norm's running-stat side effects ride the tape (the
-        # reference emits them as extra ops in the same block)
-        self._buffer_updates: List[Tuple[Tensor, int]] = []
+        # (buffer Tensor, captured value Tensor) pairs applied after every
+        # run — how batch_norm's running-stat side effects ride the tape
+        # (the reference emits them as extra ops in the same block)
+        self._buffer_updates: List[Tuple[Tensor, Tensor]] = []
+        # named-layer cache for static.nn builders: living ON the program
+        # ties layer lifetime to program lifetime (no id()-reuse hazard)
+        self._static_layers: dict = {}
 
     # -- capture ----------------------------------------------------------
     def _param_index(self, t: Tensor) -> int:
@@ -176,11 +179,26 @@ class Program:
 
     def add_buffer_update(self, buffer: Tensor, value: Tensor):
         """Record 'write ``value`` (captured) into ``buffer`` after each
-        run' — stat side effects as first-class tape outputs."""
+        run' — stat side effects as first-class tape outputs. Re-registering
+        the same buffer replaces the pending entry; use
+        :meth:`pending_buffer_value` to CHAIN (read the prior pending value
+        into the new expression) so shared-layer updates fold sequentially
+        like the reference's in-block stat ops."""
         if not is_symbolic(value):
             raise ValueError("buffer update value must be captured")
-        self._buffer_updates.append((buffer, value._sym_id))
+        self._buffer_updates = [(b, v) for b, v in self._buffer_updates
+                                if b is not buffer]
+        self._buffer_updates.append((buffer, value))
         self._version += 1
+
+    def pending_buffer_value(self, buffer: Tensor):
+        """The captured value already scheduled to be written into
+        ``buffer`` this run, or the buffer itself if none — what a second
+        update expression should read as 'current'."""
+        for b, v in self._buffer_updates:
+            if b is buffer:
+                return v
+        return buffer
 
     def set_train(self, optimizer, loss: Tensor):
         if not is_symbolic(loss):
@@ -320,7 +338,7 @@ class Executor:
     def _build(self, program: Program, fetch_sids, feed_names):
         placeholders = program.placeholders
 
-        buf_sids = [sid for _, sid in program._buffer_updates]
+        buf_sids = [v._sym_id for _, v in program._buffer_updates]
 
         def _writeback(buf_values):
             for (buf, _), v in zip(program._buffer_updates, buf_values):
@@ -400,6 +418,11 @@ def capture(fn, tensor_args, static, name):
     prog = None
     for a in tensor_args:
         if is_symbolic(a):
-            prog = _sym_owner[a._sym_id]
+            prog = _sym_owner.get(a._sym_id)
+            if prog is None:
+                raise RuntimeError(
+                    "symbolic tensor's Program has been garbage-collected — "
+                    "keep a reference to the Program for as long as its "
+                    "placeholders/outputs are used")
             break
     return prog._record(fn, tensor_args, dict(static) if static else {}, name)

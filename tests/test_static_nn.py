@@ -120,6 +120,60 @@ def test_dropped_program_is_garbage_collected():
     assert ref() is None  # the owner registry must not pin it
 
 
+def test_shared_batch_norm_updates_fold_sequentially():
+    """A name-shared batch_norm applied twice in one program must fold BOTH
+    stat contributions (chained through the pending update), not last-wins."""
+    main = static.Program()
+    with static.program_guard(main):
+        a = static.data("a", [None, 2, 4, 4], "float32")
+        b = static.data("b", [None, 2, 4, 4], "float32")
+        ya = snn.batch_norm(a, momentum=0.5, name="sbn")
+        yb = snn.batch_norm(b, momentum=0.5, name="sbn")
+        bn = snn.get_layer("sbn")
+    arr_a = np.full((4, 2, 4, 4), 2.0, np.float32)
+    arr_b = np.full((4, 2, 4, 4), 10.0, np.float32)
+    _run(main, {"a": arr_a, "b": arr_b}, [ya, yb])
+    # start 0 -> after a: 0.5*2 = 1 -> after b: 1 + 0.5*(10-1) = 5.5
+    np.testing.assert_allclose(np.asarray(bn._mean._data), 5.5, rtol=1e-5)
+
+
+def test_conv_nhwc_and_transpose_output_size():
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 8, 8, 3], "float32")   # NHWC
+        c = snn.conv2d(x, num_filters=5, filter_size=3, padding=1,
+                       data_format="NHWC")
+        z = static.data("z", [None, 2, 4, 4], "float32")
+        # k3 s2 p1 on 4 -> base 7; output_size=8 selects output_padding=1
+        t = snn.conv2d_transpose(z, num_filters=3, filter_size=3, stride=2,
+                                 padding=1, output_size=[8, 8])
+    co, to = _run(main, {"x": np.ones((2, 8, 8, 3), np.float32),
+                         "z": np.ones((2, 2, 4, 4), np.float32)}, [c, t])
+    assert co.shape == (2, 8, 8, 5)
+    assert to.shape == (2, 3, 8, 8)
+
+
+def test_sparse_embedding_routes_to_registered_ps_table():
+    native = pytest.importorskip("paddle_tpu.native")
+    try:
+        native.load()
+    except Exception:
+        pytest.skip("native lib unavailable")
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.ps import EmbeddingServer, SparseTableClient
+
+    srv = EmbeddingServer(dim=8, rule="sgd")
+    client = SparseTableClient([f"127.0.0.1:{srv.port}"], dim=8)
+    fleet.register_sparse_table(0, client)
+    try:
+        ids = paddle.to_tensor(np.array([[1, 2]], np.int64))
+        out = snn.sparse_embedding(ids, size=[1 << 40, 8], slot=0)
+        assert list(out.shape) == [1, 2, 8]
+    finally:
+        fleet._registered_tables.clear()
+        srv.stop()
+
+
 def test_layer_group_instance_prelu():
     main = static.Program()
     with static.program_guard(main):

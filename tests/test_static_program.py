@@ -181,6 +181,40 @@ def test_fetch_from_other_program_after_ops_is_loud():
         exe.run(p2, feed={"c": np.zeros(2, np.float32)}, fetch_list=[b])
 
 
+def test_static_save_load_roundtrip(tmp_path):
+    def build():
+        lin = nn.Linear(4, 2)
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [None, 4], "float32")
+            out = lin(x)
+        return main, out
+
+    main, out = build()
+    exe = static.Executor()
+    arr = np.ones((2, 4), np.float32)
+    (o1,) = exe.run(main, feed={"x": arr}, fetch_list=[out])
+    static.save(main, str(tmp_path / "m"))
+
+    main2, out2 = build()  # fresh params
+    static.load(main2, str(tmp_path / "m"))
+    (o2,) = static.Executor().run(main2, feed={"x": arr}, fetch_list=[out2])
+    np.testing.assert_allclose(o1, o2, rtol=1e-6)
+
+    with pytest.raises(ValueError, match="shape mismatch|references"):
+        bad = nn.Linear(3, 2)
+        p3 = static.Program()
+        with static.program_guard(p3):
+            x3 = static.data("x", [None, 3], "float32")
+            _ = bad(x3)
+        static.load(p3, str(tmp_path / "m"))
+
+    state = static.load_program_state(str(tmp_path / "m"))
+    static.set_program_state(main2, state)
+    (o3,) = static.Executor().run(main2, feed={"x": arr}, fetch_list=[out2])
+    np.testing.assert_allclose(o1, o3, rtol=1e-6)
+
+
 def test_enable_static_mode_flag():
     assert paddle.in_dynamic_mode()
     paddle.enable_static()
