@@ -303,12 +303,22 @@ def load(program, model_path, executor=None, var_list=None):
         raise ValueError(
             f"checkpoint has {len(state)} tensors but the program "
             f"references {len(program._params)} — was it built differently?")
+    # validate EVERYTHING first, assign after: a mid-loop failure must not
+    # leave the program half-overwritten
+    arrs = []
     for i, p in enumerate(program._params):
-        arr = state[f"p{i}"]
-        arr = arr._data if hasattr(arr, "_data") else jnp.asarray(np.asarray(arr))
+        key = f"p{i}"
+        if key not in state:
+            raise ValueError(
+                f"checkpoint is missing '{key}' — was it written by "
+                "static.save (not paddle.save of a layer state_dict)?")
+        arr = state[key]
+        arr = arr._data if hasattr(arr, "_data") else np.asarray(arr)
         if tuple(arr.shape) != tuple(p._data.shape):
             raise ValueError(f"shape mismatch for param {i}: "
                              f"{tuple(arr.shape)} vs {tuple(p._data.shape)}")
+        arrs.append(arr)
+    for p, arr in zip(program._params, arrs):
         p._data = jnp.asarray(arr).astype(p._data.dtype)
 
 
@@ -353,19 +363,28 @@ def load_program_state(model_path, var_list=None):
 
 def set_program_state(program, state_dict):
     """Write a state dict (from load_program_state / save) into the live
-    tensors a capture Program references."""
+    tensors a capture Program references. Missing keys are skipped (partial
+    restore, matching the reference); present keys are shape-checked BEFORE
+    any assignment so a bad dict cannot half-overwrite the program."""
     import jax.numpy as jnp
 
     from .program import Program as _P
 
     if not isinstance(program, _P):
         program = getattr(program, "program", program)
+    todo = []
     for i, p in enumerate(program._params):
         key = f"p{i}"
-        if key in state_dict:
-            arr = state_dict[key]
-            arr = arr._data if hasattr(arr, "_data") else np.asarray(arr)
-            p._data = jnp.asarray(arr).astype(p._data.dtype)
+        if key not in state_dict:
+            continue
+        arr = state_dict[key]
+        arr = arr._data if hasattr(arr, "_data") else np.asarray(arr)
+        if tuple(arr.shape) != tuple(p._data.shape):
+            raise ValueError(f"shape mismatch for param {i}: "
+                             f"{tuple(arr.shape)} vs {tuple(p._data.shape)}")
+        todo.append((p, arr))
+    for p, arr in todo:
+        p._data = jnp.asarray(arr).astype(p._data.dtype)
 
 
 def ctr_metric_bundle(input, label, ins_tag_weight=None):

@@ -95,10 +95,23 @@ def sparse_embedding(input, size, padding_idx=None, is_test=False,
 
     tables = getattr(fleet, "_registered_tables", None)
     if tables:
+        if is_symbolic(input):
+            raise NotImplementedError(
+                "sparse_embedding over a parameter-server table is a host-"
+                "side pull/push (RPC per batch) and cannot be recorded onto "
+                "a compiled Program tape — drive PS training in dygraph "
+                "(distributed.ps.PSEmbedding + TrainStep over the dense "
+                "part), as benches/baseline.py widedeep does")
         from ..distributed.ps import PSEmbedding
 
-        client = tables[int(slot)] if slot is not None \
-            else next(iter(tables.values()))
+        if slot is not None:
+            client = tables.get(int(slot))
+            if client is None:
+                raise ValueError(
+                    f"sparse_embedding: no sparse table registered under id "
+                    f"{slot} (registered: {sorted(tables)})")
+        else:
+            client = next(iter(tables.values()))
         return _layer(name, lambda: PSEmbedding(client))(input)
     return embedding(input, size, is_sparse=True, padding_idx=padding_idx,
                      param_attr=param_attr, dtype=dtype, name=name)

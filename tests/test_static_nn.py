@@ -214,6 +214,48 @@ def test_control_flow_eager_semantics():
     assert float(final._data) == 3.0
 
 
+def test_widedeep_static_recipe_trains():
+    """The reference's Wide&Deep static recipe shape — sparse_embedding
+    (dense-table variant) + fc tower + minimize — end to end through
+    Program/Executor (ref:python/paddle/fluid/tests demo topology)."""
+    from paddle_tpu import optimizer
+
+    rng = np.random.RandomState(3)
+    n, slots, vocab = 256, 4, 50
+    ids_np = rng.randint(0, vocab, (n, slots)).astype(np.int64)
+    # clickiness depends on whether slot-0 id is even (learnable signal)
+    y_np = ((ids_np[:, 0] % 2) == 0).astype(np.float32).reshape(-1, 1)
+
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        ids = static.data("ids", [None, slots], "int64")
+        label = static.data("label", [None, 1], "float32")
+        emb = snn.sparse_embedding(ids, size=[vocab, 8], name="slot_emb")
+        deep = snn.fc(emb, 32, activation="relu", name="deep1")
+        deep = snn.fc(deep, 16, activation="relu", name="deep2")
+        wide = snn.fc(emb, 1, name="wide")
+        logit = snn.fc(deep, 1, name="head") + wide
+        loss = paddle.nn.functional.binary_cross_entropy_with_logits(
+            logit, label).mean()
+        optimizer.Adam(learning_rate=0.05).minimize(loss)
+
+    exe = static.Executor()
+    exe.run(startup)
+    first = None
+    for _ in range(30):
+        for i in range(0, n, 64):
+            (lv,) = exe.run(main, feed={"ids": ids_np[i:i+64],
+                                        "label": y_np[i:i+64]},
+                            fetch_list=[loss])
+            if first is None:
+                first = float(lv)
+    infer = main.clone(for_test=True)
+    (pv,) = exe.run(infer, feed={"ids": ids_np, "label": y_np},
+                    fetch_list=[logit])
+    acc = ((pv[:, 0] > 0) == (y_np[:, 0] > 0.5)).mean()
+    assert acc > 0.95, (first, float(lv), acc)
+
+
 def test_lod_sequence_ops_raise_with_guidance():
     with pytest.raises(NotImplementedError, match="padded batches"):
         snn.sequence_pool(None, "max")

@@ -42,7 +42,8 @@ V5E_HBM_BW = 819e9       # bytes/s
 BATCH, SEQ = 16, 1024
 
 
-def build_step(remat: bool):
+def build_step(remat: bool, hidden=768, layers=12, batch=BATCH, seq=SEQ,
+               amp_level="O1", chunk=0):
     import paddle_tpu  # noqa: F401  (registers ops)
     from paddle_tpu import amp
     from paddle_tpu.core.tensor import Tensor
@@ -50,21 +51,27 @@ def build_step(remat: bool):
     from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
     from paddle_tpu.optimizer import AdamW
 
-    cfg = GPTConfig(vocab_size=50304, hidden_size=768, num_layers=12,
-                    num_heads=12, max_position_embeddings=2048,
-                    use_recompute=remat)
+    cfg = GPTConfig(vocab_size=50304, hidden_size=hidden, num_layers=layers,
+                    num_heads=max(1, hidden // 64),
+                    max_position_embeddings=2048,
+                    use_recompute=remat, loss_chunk_size=chunk)
     model = GPTForCausalLM(cfg)
     opt = AdamW(learning_rate=1e-4, parameters=model.parameters(),
                 weight_decay=0.01)
+    if amp_level == "O2":
+        amp.decorate(model, opt, level="O2")
 
     def loss_fn(x, y):
+        # always O1 autocast: bench.py's BENCH_AMP=O2 means decorate(O2)
+        # (bf16 params + master slots) UNDER O1 autocast — this compiles
+        # the exact program the sweep's "O2" rows time on hardware
         with amp.auto_cast(level="O1", dtype="bfloat16"):
             return model(x, y)
 
     step = TrainStep(loss_fn, opt, layers=model)
     step._build()
     rng = np.random.default_rng(0)
-    ids = rng.integers(0, cfg.vocab_size, (BATCH, SEQ), dtype=np.int32)
+    ids = rng.integers(0, cfg.vocab_size, (batch, seq), dtype=np.int32)
     x, y = Tensor(ids), Tensor(np.roll(ids, -1, axis=1))
     param_arrays = tuple(p._data for p in step._train_params)
     buffer_arrays = tuple(b._data for b in step._buffers)
@@ -80,8 +87,8 @@ def build_step(remat: bool):
     return cfg, step, (param_arrays, buffer_arrays, opt_state, lr, key, args)
 
 
-def analyze(remat: bool):
-    cfg, step, call_args = build_step(remat)
+def analyze(remat: bool, **kw):
+    cfg, step, call_args = build_step(remat, **kw)
     lowered = step._jit_fn.lower(*call_args)
     compiled = lowered.compile()
     ca = compiled.cost_analysis()
@@ -103,15 +110,15 @@ def analyze(remat: bool):
     return cfg, stats, n_params
 
 
-def model_flops(cfg) -> float:
+def model_flops(cfg, batch=BATCH, seq=SEQ) -> float:
     """Analytic 6N-per-token training FLOPs for the bench shapes (the same
     accounting bench.py uses for MFU)."""
     h, L, V = cfg.hidden_size, cfg.num_layers, cfg.vocab_size
     i = cfg.intermediate_size
     n_matmul = L * (4 * h * h + 2 * h * i) + h * V
-    attn = 6 * L * SEQ * h
+    attn = 6 * L * seq * h
     per_token = 6.0 * n_matmul + attn
-    return per_token * BATCH * SEQ
+    return per_token * batch * seq
 
 
 def main():
