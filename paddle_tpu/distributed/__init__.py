@@ -75,3 +75,4 @@ from .api_extra import (  # noqa: F401
 from .sharding import group_sharded_parallel, save_group_sharded_model  # noqa: F401
 from .spawn import spawn  # noqa: F401
 from . import launch  # noqa: F401
+from . import passes  # noqa: F401

@@ -188,6 +188,14 @@ def distributed_optimizer(optimizer, strategy: Optional[DistributedStrategy] = N
                     "exclude_from_weight_decay", []),
                 epsilon=float(cfg.get("epsilon", 0.0)),
                 rescale_grad=float(getattr(optimizer, "_rescale_grad", 1.0)))
+    if strategy is not None and getattr(strategy, "gradient_merge", False):
+        from ..passes import GradientMergeOptimizer
+
+        cfg = dict(getattr(strategy, "gradient_merge_configs", {}) or {})
+        k = int(cfg.get("k_steps", 1))
+        if k > 1 and not isinstance(optimizer, GradientMergeOptimizer):
+            optimizer = GradientMergeOptimizer(
+                optimizer, k_steps=k, avg=bool(cfg.get("avg", True)))
     return optimizer
 
 

@@ -1,0 +1,364 @@
+"""Composable distributed program passes (``paddle.distributed.passes``).
+
+The reference rewrites static Programs op-by-op — 15K lines of graph surgery
+(``ref:python/paddle/distributed/passes/pass_base.py:50`` PassBase registry,
+``auto_parallel_gradient_merge.py``, ``auto_parallel_amp.py``,
+``auto_parallel_recompute.py``, ``fuse_all_reduce.py``...). On this stack the
+XLA compiler performs the op-level rewrites (fusion, allreduce bucketing,
+inplace/memory planning), so a pass here transforms the *pre-compilation*
+object instead — a ``jit.TrainStep``, a ``static.Program``, or a ``Layer``
+tree — and the no-longer-needed graph-surgery passes are recorded as
+compiler-performed for config compatibility.
+
+API parity: ``new_pass(name, attrs)``, ``PassManager``, ``PassContext``,
+``PassBase`` with the reference's registration contract
+(``ref:python/paddle/distributed/passes/pass_base.py:133,353``).
+
+Real transformations:
+  * ``gradient_merge`` — k-step gradient accumulation: sets a TrainStep's
+    ``accumulate_steps`` (one compiled program scans the k microbatches —
+    the TPU-native form of the reference's accumulate-then-apply rewrite,
+    ``ref:python/paddle/distributed/passes/auto_parallel_gradient_merge.py:26``)
+    or wraps an eager optimizer in :class:`GradientMergeOptimizer`.
+  * ``auto_parallel_amp`` / ``auto_parallel_fp16`` — applies amp decoration
+    (O1 cast-list autocast / O2 bf16 params + f32 master weights) to the
+    model+optimizer a TrainStep drives.
+  * ``auto_parallel_recompute`` — wraps named sublayers with
+    ``jax.checkpoint`` via fleet.recompute (segment rematerialization).
+Compiler-performed (validated + recorded, no rewrite needed):
+  * ``fuse_all_reduce``, ``fuse_optimizer``, ``fused_attention``,
+    ``fuse_gemm_epilogue``, ``inplace_addto_op``,
+    ``auto_parallel_data_parallel_optimization``,
+    ``auto_parallel_supplement_explicit_dependencies``.
+"""
+from __future__ import annotations
+
+from abc import ABC
+from typing import Dict, List, Optional
+
+
+class PassContext:
+    """Carries cross-pass state + the attr dicts each applied pass saw
+    (ref PassContext collects applied passes)."""
+
+    def __init__(self):
+        self.passes: List["PassBase"] = []
+        self.attrs: Dict = {}
+
+    def add_pass(self, p: "PassBase"):
+        self.passes.append(p)
+
+
+class PassBase(ABC):
+    _REGISTERED_PASSES: Dict[str, type] = {}
+
+    name: str = ""
+    # passes that only record that XLA already does the rewrite
+    COMPILER_PERFORMED = False
+
+    def __init__(self):
+        self._attrs: Dict = {}
+        self.applied = False
+
+    # -- reference contract ------------------------------------------------
+    def set_attr(self, key, value):
+        self._attrs[key] = value
+        return self
+
+    def get_attr(self, key, default=None):
+        return self._attrs.get(key, default)
+
+    def _check_self(self) -> bool:
+        return True
+
+    def _check_conflict(self, other_pass) -> bool:
+        return True
+
+    def apply(self, main_programs, startup_programs=None, context=None):
+        """Apply to a list of targets (or a single one). Targets may be
+        TrainStep / static.Program / Layer / optimizer depending on the
+        pass; each pass documents what it transforms."""
+        context = context or PassContext()
+        if not self._check_self():
+            raise ValueError(f"pass {self.name}: invalid attributes {self._attrs}")
+        targets = main_programs if isinstance(main_programs, (list, tuple)) \
+            else [main_programs]
+        startups = startup_programs if isinstance(startup_programs, (list, tuple)) \
+            else [startup_programs] * len(targets)
+        out = []
+        for t, s in zip(targets, startups):
+            out.append(self._apply_single_impl(t, s, context))
+        self.applied = True
+        context.add_pass(self)
+        return out if isinstance(main_programs, (list, tuple)) else out[0]
+
+    def _apply_single_impl(self, main_program, startup_program, context):
+        raise NotImplementedError
+
+
+def register_pass(name):
+    def deco(cls):
+        cls.name = name
+        PassBase._REGISTERED_PASSES[name] = cls
+        return cls
+
+    return deco
+
+
+def new_pass(name, pass_attrs: Optional[dict] = None) -> PassBase:
+    cls = PassBase._REGISTERED_PASSES.get(name)
+    if cls is None:
+        raise ValueError(
+            f"unknown pass '{name}'; registered: "
+            f"{sorted(PassBase._REGISTERED_PASSES)}")
+    p = cls()
+    for k, v in (pass_attrs or {}).items():
+        p.set_attr(k, v)
+    return p
+
+
+class PassManager:
+    """Ordered pass application (ref PassManager,
+    ``ref:python/paddle/distributed/passes/pass_base.py:353``)."""
+
+    def __init__(self, passes: List[PassBase]):
+        self._passes = list(passes)
+        self._context = PassContext()
+
+    @property
+    def context(self):
+        return self._context
+
+    @property
+    def names(self):
+        return [p.name for p in self._passes]
+
+    def apply(self, main_programs, startup_programs=None):
+        out = main_programs
+        for p in self._passes:
+            out = p.apply(out, startup_programs, self._context)
+        return out
+
+
+def _as_train_step(target):
+    from ...jit import TrainStep
+
+    return target if isinstance(target, TrainStep) else None
+
+
+# ---------------------------------------------------------------- real passes
+
+
+@register_pass("gradient_merge")
+class GradientMergePass(PassBase):
+    """k-step gradient accumulation.
+
+    attrs: k_steps (int, required > 1), avg (bool, default True).
+
+    * TrainStep target → sets ``accumulate_steps``: ONE compiled XLA program
+      scans the k microbatches (grads accumulate in f32 on-device, optimizer
+      applies once) — no Python-loop overhead, no separate accumulate ops.
+    * Optimizer target → returns a :class:`GradientMergeOptimizer` wrapper
+      for eager loops (step() applies every k-th call).
+    """
+
+    def _check_self(self):
+        k = self.get_attr("k_steps", 1)
+        return isinstance(k, int) and k >= 1
+
+    def _apply_single_impl(self, target, startup, context):
+        k = int(self.get_attr("k_steps", 1))
+        avg = bool(self.get_attr("avg", True))
+        ts = _as_train_step(target)
+        if ts is not None:
+            if ts._jit_fn is not None:
+                raise RuntimeError(
+                    "gradient_merge must be applied before the TrainStep's "
+                    "first call (the accumulation loop is compiled in)")
+            ts._accumulate_steps = k
+            ts._accumulate_avg = avg
+            return ts
+        from ...optimizer.optimizer import Optimizer
+
+        if isinstance(target, Optimizer):
+            return GradientMergeOptimizer(target, k_steps=k, avg=avg)
+        raise TypeError(
+            "gradient_merge applies to a jit.TrainStep (compiled loop) or an "
+            f"Optimizer (eager wrapper); got {type(target).__name__}")
+
+
+@register_pass("auto_parallel_amp")
+class AmpPass(PassBase):
+    """Apply AMP to the (model, optimizer) pair a TrainStep drives.
+
+    attrs: dtype ('bfloat16'|'float16', default bfloat16), level ('O1'|'O2').
+    O2 re-decorates the model/optimizer (bf16 params + f32 master slots in
+    the compiled update, ref auto_parallel_fp16 pass semantics)."""
+
+    def _check_self(self):
+        return self.get_attr("level", "O1") in ("O1", "O2")
+
+    def _apply_single_impl(self, target, startup, context):
+        from ... import amp
+
+        level = self.get_attr("level", "O1")
+        dtype = self.get_attr("dtype", "bfloat16")
+        ts = _as_train_step(target)
+        if ts is None:
+            raise TypeError("auto_parallel_amp applies to a jit.TrainStep")
+        if ts._jit_fn is not None:
+            raise RuntimeError("apply auto_parallel_amp before the first step")
+        if level == "O2":
+            # the Layer(s) the step was built over (TrainStep(layers=...));
+            # amp.decorate accepts a single Layer or the full list
+            model = getattr(ts, "_layers_for_amp", None)
+            if model is None:
+                raise ValueError(
+                    "O2 needs the model: build the TrainStep with layers=")
+            amp.decorate(model, ts._opt, level="O2", dtype=dtype)
+        inner = ts._fn
+
+        def with_autocast(*args):
+            with amp.auto_cast(level="O1", dtype=dtype):
+                return inner(*args)
+
+        ts._fn = with_autocast
+        return ts
+
+
+@register_pass("auto_parallel_fp16")
+class Fp16Pass(AmpPass):
+    """Pure-low-precision pass (ref auto_parallel_fp16): O2 decoration."""
+
+    def _apply_single_impl(self, target, startup, context):
+        self.set_attr("level", "O2")
+        return super()._apply_single_impl(target, startup, context)
+
+
+@register_pass("auto_parallel_recompute")
+class RecomputePass(PassBase):
+    """Wrap sublayers in jax.checkpoint (segment rematerialization).
+
+    attrs: checkpoints — list of sublayer-name prefixes to rematerialize
+    (ref auto_parallel_recompute no_recompute_segments inverse). Applies to
+    a Layer; each named sublayer's forward is wrapped with fleet's
+    ``recompute`` so its activations are recomputed in backward."""
+
+    def _apply_single_impl(self, target, startup, context):
+        from ...nn.layer import Layer
+        from ..fleet.recompute import recompute
+
+        if not isinstance(target, Layer):
+            raise TypeError("auto_parallel_recompute applies to a Layer")
+        names = list(self.get_attr("checkpoints", []) or [])
+        wrapped = []
+        for name, sub in target.named_sublayers():
+            if any(name == n or name.startswith(n + ".") for n in names):
+                # skip if a parent is already wrapped (nested remat is waste)
+                if any(name.startswith(w + ".") for w in wrapped):
+                    continue
+                inner_forward = sub.forward
+
+                def make(fwd):
+                    def fw(*a, **kw):
+                        return recompute(fwd, *a, **kw)
+
+                    return fw
+
+                sub.forward = make(inner_forward)
+                wrapped.append(name)
+        context.attrs.setdefault("recompute_wrapped", []).extend(wrapped)
+        return target
+
+
+# ------------------------------------------------- compiler-performed passes
+
+
+class _CompilerPerformedPass(PassBase):
+    """The rewrite this pass does in the reference is done by XLA on this
+    stack (op fusion / collective bucketing / memory planning happen during
+    compilation). Applying it records the intent and leaves the target
+    unchanged, so reference configs that list these passes run unmodified."""
+
+    COMPILER_PERFORMED = True
+
+    def _apply_single_impl(self, target, startup, context):
+        context.attrs.setdefault("compiler_performed", []).append(self.name)
+        return target
+
+
+for _name in (
+    "fuse_all_reduce",          # XLA combines collectives (combiner threshold)
+    "fuse_optimizer",           # optimizer update fuses into the step program
+    "fused_attention",          # flash/pallas or XLA-fused attention
+    "fused_feedforward",
+    "fuse_gemm_epilogue",       # bias+activation fusion into the matmul
+    "inplace_addto_op",         # donation + XLA buffer reuse
+    "auto_parallel_data_parallel_optimization",
+    "auto_parallel_supplement_explicit_dependencies",
+    "auto_parallel_grad_clip",  # clip compiled into the step (TrainStep)
+    "auto_parallel_sharding",   # the sharding mesh axis partitions states
+    "auto_parallel_pipeline",   # compiled GPipe/interleaved schedule
+):
+    PassBase._REGISTERED_PASSES[_name] = type(
+        f"_CP_{_name}", (_CompilerPerformedPass,), {"name": _name})
+
+
+# --------------------------------------------------- eager gradient merging
+
+
+class GradientMergeOptimizer:
+    """Eager k-step gradient accumulation
+    (ref:python/paddle/incubate/optimizer/gradient_merge.py semantics,
+    dygraph form): grads accumulate on the parameters across ``backward()``
+    calls (the autograd engine already sums); ``step()`` applies the inner
+    optimizer only every k-th call (scaling by 1/k when avg), and
+    ``clear_grad()`` only clears at the boundary so accumulation survives
+    user-written ``opt.clear_grad()`` in the loop."""
+
+    def __init__(self, inner, k_steps: int = 1, avg: bool = True):
+        self._inner = inner
+        self._k = int(k_steps)
+        self._avg = bool(avg)
+        self._calls = 0
+
+    def __getattr__(self, item):  # delegate everything else
+        return getattr(self._inner, item)
+
+    @property
+    def inner_opt(self):
+        return self._inner
+
+    def step(self):
+        self._calls += 1
+        if self._calls % self._k != 0:
+            return  # accumulate only
+        if self._avg and self._k > 1:
+            for p in self._inner._parameter_list or []:
+                if getattr(p, "grad", None) is not None:
+                    p.grad._data = p.grad._data / self._k
+        self._inner.step()
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        return [], []
+
+    def clear_grad(self, set_to_zero: bool = True):
+        if self._calls % self._k == 0:
+            self._inner.clear_grad(set_to_zero=set_to_zero)
+
+    clear_gradients = clear_grad
+
+    def state_dict(self):
+        return self._inner.state_dict()
+
+    def set_state_dict(self, sd):
+        return self._inner.set_state_dict(sd)
+
+
+__all__ = [
+    "PassBase", "PassContext", "PassManager", "new_pass", "register_pass",
+    "GradientMergeOptimizer",
+]

@@ -142,9 +142,33 @@ class TrainStep:
     training path.
     """
 
-    def __init__(self, fn: Callable, optimizer, layers=None, extra_state: Optional[List[Tensor]] = None):
+    def __init__(self, fn: Callable, optimizer, layers=None, extra_state: Optional[List[Tensor]] = None,
+                 accumulate_steps: int = 1):
         self._fn = fn
         self._opt = optimizer
+        # gradient merge (ref auto_parallel_gradient_merge pass): k>1 scans k
+        # microbatches inside ONE compiled program — grads accumulate in f32
+        # on-device, the optimizer applies once with the (averaged) total
+        self._accumulate_steps = int(accumulate_steps)
+        self._accumulate_avg = True
+        if isinstance(layers, Layer):
+            self._layers_for_amp = layers
+        elif isinstance(layers, (list, tuple)):
+            ls = [l for l in layers if isinstance(l, Layer)]
+            self._layers_for_amp = ls or None
+        else:
+            self._layers_for_amp = None
+        # a fleet gradient_merge wrapper (distributed.passes
+        # .GradientMergeOptimizer) can't merge inside a compiled step — its
+        # step() is never called. Adopt its k into the compiled scan and
+        # drive the inner optimizer directly so the strategy still applies.
+        inner = getattr(optimizer, "inner_opt", None)
+        if inner is not None and hasattr(optimizer, "_k"):
+            if self._accumulate_steps == 1:
+                self._accumulate_steps = int(optimizer._k)
+                self._accumulate_avg = bool(optimizer._avg)
+            optimizer = inner
+            self._opt = inner
         plist = optimizer._parameter_list or []
         self._train_params = [p for p in plist if not p.stop_gradient]
         frozen = [p for p in plist if p.stop_gradient]
@@ -168,9 +192,42 @@ class TrainStep:
         self._opt_state = None
         self._jit_fn = None
 
+    def _loss_with_sink(self, pa, buf_arrays, key, args):
+        """value_and_grad target shared by both build paths: swap state in,
+        run the loss fn under the rng/mutation guards, return the f32 loss
+        and the per-buffer mutation list (None = untouched)."""
+        fn, train_params, buffers = self._fn, self._train_params, self._buffers
+        sink = {}
+        with _swap_data(train_params + buffers, list(pa) + list(buf_arrays)):
+            with rng.key_guard(key), mutation_sink(sink):
+                loss = fn(*args)
+        loss_arr = loss._data if isinstance(loss, Tensor) else loss
+        mutated = []
+        for b in buffers:
+            hit = sink.get(id(b))
+            mutated.append(hit[1] if hit is not None else None)
+        return loss_arr.astype(jnp.float32), mutated
+
+    def _apply_optimizer(self, param_arrays, grads, opt_state, lr):
+        """Clip + per-param update with master-weight dispatch (shared by
+        both build paths; runs inside the jitted step)."""
+        opt, train_params = self._opt, self._train_params
+        if opt._grad_clip is not None:
+            grads = opt._grad_clip._clip_arrays(grads)
+        step = opt_state["step"] + 1
+        new_params, new_slots = [], []
+        for p_t, p_arr, g, slots in zip(train_params, param_arrays,
+                                        grads, opt_state["slots"]):
+            upd = opt._update_for(getattr(p_t, "name", None))
+            np_, ns_ = opt._apply_with_master(upd, p_arr, g, slots, lr, step)
+            new_params.append(np_)
+            new_slots.append(ns_)
+        return new_params, {"slots": new_slots, "step": step}
+
     def _build(self):
-        fn, opt = self._fn, self._opt
-        train_params, buffers = self._train_params, self._buffers
+        if self._accumulate_steps > 1:
+            self._build_accum(self._accumulate_steps, self._accumulate_avg)
+            return
 
         # donate params + optimizer state: XLA updates them in place
         # (halves the peak HBM of the update; old arrays are invalidated,
@@ -178,36 +235,79 @@ class TrainStep:
         @functools.partial(jax.jit, donate_argnums=(0, 2))
         def _step(param_arrays, buffer_arrays, opt_state, lr, key, args):
             def loss_f(pa):
-                sink = {}
-                with _swap_data(train_params + buffers, list(pa) + list(buffer_arrays)):
-                    with rng.key_guard(key), mutation_sink(sink):
-                        loss = fn(*args)
-                loss_arr = loss._data if isinstance(loss, Tensor) else loss
-                mutated = []
-                for b in buffers:
-                    hit = sink.get(id(b))
-                    mutated.append(hit[1] if hit is not None else None)
-                return loss_arr.astype(jnp.float32), mutated
+                return self._loss_with_sink(pa, buffer_arrays, key, args)
 
             (loss, mutated), grads = jax.value_and_grad(loss_f, has_aux=True)(list(param_arrays))
-            if opt._grad_clip is not None:
-                grads = opt._grad_clip._clip_arrays(grads)
-            step = opt_state["step"] + 1
-            new_params, new_slots = [], []
-            for p_t, p_arr, g, slots in zip(train_params, param_arrays,
-                                            grads, opt_state["slots"]):
-                upd = opt._update_for(getattr(p_t, "name", None))
-                np_, ns_ = opt._apply_with_master(upd, p_arr, g, slots, lr,
-                                                  step)
-                new_params.append(np_)
-                new_slots.append(ns_)
-            return loss, new_params, {"slots": new_slots, "step": step}, mutated
+            new_params, new_state = self._apply_optimizer(
+                param_arrays, grads, opt_state, lr)
+            return loss, new_params, new_state, mutated
+
+        self._jit_fn = _step
+
+    def _build_accum(self, k: int, avg: bool):
+        """Gradient-merge variant: ONE compiled program scans k microbatches
+        (grads evaluated at the step's initial params, accumulated in f32),
+        then applies the optimizer once — the TPU-native rewrite of
+        ref:python/paddle/distributed/passes/auto_parallel_gradient_merge.py:26
+        (accumulate ops + conditional optimizer block become a lax.scan)."""
+
+        @functools.partial(jax.jit, donate_argnums=(0, 2))
+        def _step(param_arrays, buffer_arrays, opt_state, lr, key, args):
+            micro = jax.tree_util.tree_map(
+                lambda a: a.reshape((k, a.shape[0] // k) + a.shape[1:]), args)
+
+            def body(carry, margs):
+                bufs, acc, lsum, i = carry
+                mkey = jax.random.fold_in(key, i)
+
+                def loss_f(pa):
+                    return self._loss_with_sink(pa, bufs, mkey, margs)
+
+                (loss, mutated), grads = jax.value_and_grad(
+                    loss_f, has_aux=True)(list(param_arrays))
+                # chain buffer mutations (BN stats) across microbatches
+                new_bufs = [m if m is not None else b
+                            for b, m in zip(bufs, mutated)]
+                acc = [a + g.astype(jnp.float32) for a, g in zip(acc, grads)]
+                return (new_bufs, acc, lsum + loss, i + 1), None
+
+            acc0 = [jnp.zeros(p.shape, jnp.float32) for p in param_arrays]
+            carry0 = (list(buffer_arrays), acc0, jnp.zeros((), jnp.float32),
+                      jnp.zeros((), jnp.int32))
+            (new_bufs, acc, lsum, _), _ = jax.lax.scan(body, carry0, micro)
+
+            # merged grads stay f32 into the update: _apply_with_master
+            # casts per-path (master consumes f32; plain update casts to
+            # param dtype) — never round the total through bf16 first
+            scale = (1.0 / k) if avg else 1.0
+            grads = [a * scale for a in acc]
+            new_params, new_state = self._apply_optimizer(
+                param_arrays, grads, opt_state, lr)
+            # every buffer passed through the scan carry: return them all
+            # (loop-invariant ones come back value-equal; __call__ rebinds)
+            return lsum / k, new_params, new_state, new_bufs
 
         self._jit_fn = _step
 
     def __call__(self, *args):
         if self._jit_fn is None:
             self._build()
+        if self._accumulate_steps > 1:
+            k = self._accumulate_steps
+            # every leaf is split along dim 0, so a non-batch arg whose dim0
+            # "happens to divide k" would be silently chunked wrong — demand
+            # ONE shared leading batch dim (constants: close over them or
+            # tile to the batch)
+            leading = set()
+            for leaf in jax.tree_util.tree_leaves(args):
+                shp = getattr(leaf, "shape", None)
+                leading.add(shp[0] if shp else None)
+            dim = next(iter(leading)) if len(leading) == 1 else None
+            if dim is None or dim % k != 0:
+                raise ValueError(
+                    f"accumulate_steps={k}: all inputs must share one "
+                    f"leading (batch) dim divisible by k; got leading dims "
+                    f"{sorted((d if d is not None else -1) for d in leading)}")
         if self._opt_state is None:
             # seed from the optimizer's accumulators when present (ckpt
             # resume via opt.set_state_dict): overlay restored values onto

@@ -1,0 +1,258 @@
+"""Gradient merge (k-step accumulation) + distributed.passes framework.
+
+Reference semantics: ref:python/paddle/distributed/passes/auto_parallel_gradient_merge.py:26
+(accumulate k microbatch grads, apply optimizer once, averaged) and the
+pass registration contract ref:python/paddle/distributed/passes/pass_base.py:133.
+TPU-native form: the k-microbatch loop is a lax.scan inside ONE compiled
+TrainStep program.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.jit import TrainStep
+from paddle_tpu.optimizer import AdamW, Momentum
+
+
+def _data(n=8, din=6, dout=3, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((n, din), dtype=np.float32)
+    y = rng.standard_normal((n, dout), dtype=np.float32)
+    return x, y
+
+
+def _mlp(seed=0, din=6, dout=3):
+    paddle.seed(seed)
+    return nn.Sequential(nn.Linear(din, 16), nn.ReLU(), nn.Linear(16, dout))
+
+
+class TestTrainStepAccumulate:
+    def test_matches_full_batch_step(self):
+        """k microbatches accumulated == one full-batch step (mean loss)."""
+        x, y = _data()
+
+        m1 = _mlp()
+        o1 = AdamW(learning_rate=1e-2, parameters=m1.parameters())
+        s1 = TrainStep(lambda a, b: ((m1(a) - b) ** 2).mean(), o1, layers=m1)
+
+        m2 = _mlp()
+        o2 = AdamW(learning_rate=1e-2, parameters=m2.parameters())
+        s2 = TrainStep(lambda a, b: ((m2(a) - b) ** 2).mean(), o2, layers=m2,
+                       accumulate_steps=4)
+
+        for _ in range(3):
+            l1 = s1(Tensor(x), Tensor(y))
+            l2 = s2(Tensor(x), Tensor(y))
+        np.testing.assert_allclose(float(l1._data), float(l2._data),
+                                   rtol=1e-5)
+        for p1, p2 in zip(m1.parameters(), m2.parameters()):
+            np.testing.assert_allclose(np.asarray(p1._data),
+                                       np.asarray(p2._data), atol=1e-6)
+
+    def test_batch_not_divisible_raises(self):
+        x, y = _data(n=6)
+        m = _mlp()
+        o = AdamW(learning_rate=1e-2, parameters=m.parameters())
+        s = TrainStep(lambda a, b: ((m(a) - b) ** 2).mean(), o, layers=m,
+                      accumulate_steps=4)
+        with pytest.raises(ValueError, match="divisible"):
+            s(Tensor(x), Tensor(y))
+
+    def test_bn_stats_chain_across_microbatches(self):
+        """Running BN stats must see each microbatch in turn (carry
+        threading), matching k sequential eager forward passes."""
+        x, _ = _data(n=8, din=4, dout=4)
+
+        paddle.seed(1)
+        bn_ref = nn.BatchNorm1D(4, momentum=0.5)
+        for chunk in np.split(x, 4):
+            bn_ref(Tensor(chunk))  # eager: stats update per microbatch
+
+        paddle.seed(1)
+        bn = nn.BatchNorm1D(4, momentum=0.5)
+        o = Momentum(learning_rate=0.0, parameters=bn.parameters())
+        s = TrainStep(lambda a: bn(a).mean(), o, layers=bn,
+                      accumulate_steps=4)
+        s(Tensor(x))
+        np.testing.assert_allclose(np.asarray(bn._mean._data),
+                                   np.asarray(bn_ref._mean._data), atol=1e-6)
+        np.testing.assert_allclose(np.asarray(bn._variance._data),
+                                   np.asarray(bn_ref._variance._data),
+                                   atol=1e-6)
+
+    def test_accumulate_with_master_weights(self):
+        """O2 decoration (bf16 params, f32 master) composes with the scan."""
+        from paddle_tpu import amp
+
+        x, y = _data()
+        m = _mlp(seed=2)
+        o = AdamW(learning_rate=1e-2, parameters=m.parameters())
+        amp.decorate(m, o, level="O2", dtype="bfloat16")
+        s = TrainStep(lambda a, b: ((m(a) - b) ** 2).mean(), o, layers=m,
+                      accumulate_steps=2)
+        l0 = float(s(Tensor(x), Tensor(y))._data)
+        for _ in range(5):
+            l1 = float(s(Tensor(x), Tensor(y))._data)
+        assert l1 < l0  # loss decreases through the accumulated steps
+
+
+class TestEagerGradientMerge:
+    def test_step_applies_every_k(self):
+        from paddle_tpu.distributed.passes import GradientMergeOptimizer
+
+        x, y = _data()
+        m = _mlp(seed=3)
+        o = GradientMergeOptimizer(
+            Momentum(learning_rate=0.1, parameters=m.parameters()), k_steps=2)
+        w0 = np.asarray(m[0].weight._data).copy()
+
+        loss = ((m(Tensor(x)) - Tensor(y)) ** 2).mean()
+        loss.backward()
+        o.step()
+        o.clear_grad()  # boundary not reached: must NOT clear
+        np.testing.assert_array_equal(np.asarray(m[0].weight._data), w0)
+        assert m[0].weight.grad is not None
+
+        loss = ((m(Tensor(x)) - Tensor(y)) ** 2).mean()
+        loss.backward()
+        o.step()  # k-th call: applies with grads averaged by k
+        o.clear_grad()
+        assert not np.array_equal(np.asarray(m[0].weight._data), w0)
+        assert m[0].weight.grad is None or \
+            not np.any(np.asarray(m[0].weight.grad._data))
+
+    def test_equivalent_to_scaled_single_step(self):
+        """Two identical half-batches accumulated == one step on the same
+        grad (average of two equal grads == the grad)."""
+        from paddle_tpu.distributed.passes import GradientMergeOptimizer
+
+        x, y = _data(n=4)
+
+        m1 = _mlp(seed=4)
+        o1 = Momentum(learning_rate=0.1, parameters=m1.parameters())
+        loss = ((m1(Tensor(x)) - Tensor(y)) ** 2).mean()
+        loss.backward()
+        o1.step()
+
+        m2 = _mlp(seed=4)
+        o2 = GradientMergeOptimizer(
+            Momentum(learning_rate=0.1, parameters=m2.parameters()), k_steps=2)
+        for _ in range(2):
+            loss = ((m2(Tensor(x)) - Tensor(y)) ** 2).mean()
+            loss.backward()
+            o2.step()
+            o2.clear_grad()
+        for p1, p2 in zip(m1.parameters(), m2.parameters()):
+            np.testing.assert_allclose(np.asarray(p1._data),
+                                       np.asarray(p2._data), atol=1e-6)
+
+    def test_fleet_strategy_wires_wrapper(self):
+        from paddle_tpu.distributed import fleet
+        from paddle_tpu.distributed.passes import GradientMergeOptimizer
+
+        m = _mlp(seed=5)
+        strat = fleet.DistributedStrategy()
+        strat.gradient_merge = True
+        strat.gradient_merge_configs = {"k_steps": 4, "avg": True}
+        opt = fleet.distributed_optimizer(
+            Momentum(learning_rate=0.1, parameters=m.parameters()),
+            strategy=strat)
+        assert isinstance(opt, GradientMergeOptimizer)
+        assert opt._k == 4
+
+    def test_trainstep_adopts_fleet_wrapper(self):
+        """Passing the fleet gradient_merge wrapper to TrainStep must not
+        silently drop the configured k: the step adopts it as
+        accumulate_steps and drives the inner optimizer."""
+        from paddle_tpu.distributed.passes import GradientMergeOptimizer
+
+        x, y = _data()
+        m = _mlp(seed=10)
+        inner = AdamW(learning_rate=1e-2, parameters=m.parameters())
+        wrapper = GradientMergeOptimizer(inner, k_steps=4)
+        ts = TrainStep(lambda a, b: ((m(a) - b) ** 2).mean(), wrapper,
+                       layers=m)
+        assert ts._accumulate_steps == 4
+        assert ts._opt is inner
+        l0 = float(ts(Tensor(x), Tensor(y))._data)
+        l1 = float(ts(Tensor(x), Tensor(y))._data)
+        assert l1 < l0
+        assert inner._step_count == 2  # bookkeeping lands on the inner opt
+
+    def test_non_uniform_leading_dim_raises(self):
+        x, _ = _data()
+        m = _mlp(seed=11)
+        o = AdamW(learning_rate=1e-2, parameters=m.parameters())
+        w = np.ones(4, np.float32)  # 4 % k == 0 but NOT the batch dim
+
+        def loss(a, wvec):
+            return ((m(a) * wvec.reshape(1, -1)).mean())
+
+        s = TrainStep(loss, o, layers=m, accumulate_steps=4)
+        with pytest.raises(ValueError, match="share one leading"):
+            s(Tensor(x), Tensor(w))
+
+
+class TestPassFramework:
+    def test_new_pass_and_manager(self):
+        from paddle_tpu.distributed.passes import PassManager, new_pass
+
+        x, y = _data()
+        m = _mlp(seed=6)
+        o = AdamW(learning_rate=1e-2, parameters=m.parameters())
+        ts = TrainStep(lambda a, b: ((m(a) - b) ** 2).mean(), o, layers=m)
+        pm = PassManager([new_pass("gradient_merge", {"k_steps": 2}),
+                          new_pass("fuse_all_reduce")])
+        ts = pm.apply(ts)
+        assert ts._accumulate_steps == 2
+        assert "fuse_all_reduce" in pm.context.attrs["compiler_performed"]
+        l0 = float(ts(Tensor(x), Tensor(y))._data)
+        l1 = float(ts(Tensor(x), Tensor(y))._data)
+        assert l1 < l0
+
+    def test_gradient_merge_after_build_raises(self):
+        from paddle_tpu.distributed.passes import new_pass
+
+        x, y = _data()
+        m = _mlp(seed=7)
+        o = AdamW(learning_rate=1e-2, parameters=m.parameters())
+        ts = TrainStep(lambda a, b: ((m(a) - b) ** 2).mean(), o, layers=m)
+        ts(Tensor(x), Tensor(y))
+        with pytest.raises(RuntimeError, match="before"):
+            new_pass("gradient_merge", {"k_steps": 2}).apply(ts)
+
+    def test_unknown_pass_raises(self):
+        from paddle_tpu.distributed.passes import new_pass
+
+        with pytest.raises(ValueError, match="unknown pass"):
+            new_pass("definitely_not_a_pass")
+
+    def test_amp_pass_wraps_autocast(self):
+        from paddle_tpu.distributed.passes import new_pass
+
+        x, y = _data()
+        m = _mlp(seed=8)
+        o = AdamW(learning_rate=1e-2, parameters=m.parameters())
+        ts = TrainStep(lambda a, b: ((m(a) - b) ** 2).mean(), o, layers=m)
+        new_pass("auto_parallel_amp", {"dtype": "bfloat16"}).apply(ts)
+        l0 = float(ts(Tensor(x), Tensor(y))._data)
+        l1 = float(ts(Tensor(x), Tensor(y))._data)
+        assert l1 < l0
+
+    def test_recompute_pass_wraps_sublayers(self):
+        from paddle_tpu.distributed.passes import PassContext, new_pass
+
+        x, y = _data()
+        m = _mlp(seed=9)
+        ctx = PassContext()
+        new_pass("auto_parallel_recompute", {"checkpoints": ["0"]}).apply(
+            m, context=ctx)
+        assert ctx.attrs["recompute_wrapped"] == ["0"]
+        # still trains (remat is functionally transparent)
+        o = AdamW(learning_rate=1e-2, parameters=m.parameters())
+        ts = TrainStep(lambda a, b: ((m(a) - b) ** 2).mean(), o, layers=m)
+        l0 = float(ts(Tensor(x), Tensor(y))._data)
+        l1 = float(ts(Tensor(x), Tensor(y))._data)
+        assert l1 < l0
