@@ -88,3 +88,8 @@ define_flag("allocator_strategy", "xla", "Memory allocator strategy (XLA manages
 define_flag("use_stream_safe_allocator", True, "Kept for API parity; XLA/PJRT owns streams on TPU.")
 define_flag("sequence_parallel_mode", "auto",
             "Context parallelism for attention: auto|ring|ulysses|none.")
+define_flag("flash_attention_min_seqlen", 4608,
+            "Route attention through the Pallas flash kernel only at kv "
+            "sequence length >= this (measured v5e break-even: XLA's fused "
+            "softmax attention wins below ~4-8k where the S^2 matrix still "
+            "fits HBM traffic budgets; flash wins 7x at 8k). 0 = always.")

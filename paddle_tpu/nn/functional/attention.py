@@ -46,10 +46,21 @@ def _sdpa_reference(q, k, v, *, scale, causal, dropout_p=0.0, key=None):
     return jnp.swapaxes(out, 1, 2)
 
 
-def _use_pallas(q) -> bool:
-    # trace-safe: the backend, not the (possibly traced) array, decides
-    # ("axon" is the tunneled TPU plugin in this environment)
-    return jax.default_backend() in ("tpu", "axon")
+def _use_pallas(sk: int) -> bool:
+    """Backend + measured-profitability gate (both trace-static).
+
+    On-chip measurement (benches/flash_tpu_bench.py, v5e, bf16 fwd+bwd,
+    d=64): flash is 0.64x XLA's fused attention at s=1024, 0.80x at s=4096,
+    6.99x at s=8192 — blockwise streaming only pays once the materialized
+    S^2 matrix dominates HBM traffic. Route by kv length; the
+    FLAGS_flash_attention_min_seqlen knob re-tunes the break-even per chip
+    generation ("axon" is the tunneled TPU plugin in this environment)."""
+    if jax.default_backend() not in ("tpu", "axon"):
+        return False
+    from ...core import flags
+
+    thr = int(flags.flag("flash_attention_min_seqlen"))
+    return thr == 0 or sk >= thr
 
 
 def _sdpa(q, k, v, *, scale, causal, use_flash, seq_parallel="none"):
@@ -113,7 +124,11 @@ def scaled_dot_product_attention(
             name="sdpa",
         )
     else:
-        use_flash = _use_pallas(query._data if isinstance(query, Tensor) else query)
+        try:
+            sk = int(key.shape[1])
+        except Exception:  # symbolic dim (jit.save export) — jax raises
+            sk = -1        # InconclusiveDimensionOperation, not TypeError
+        use_flash = sk >= 0 and _use_pallas(sk)
         out = apply(
             _sdpa,
             (query, key, value),
