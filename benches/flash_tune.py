@@ -1,0 +1,112 @@
+"""On-chip block-size autotune for the Pallas flash-attention kernels.
+
+The kernels default to 128x128 tiles (MXU/lane width). This sweeps
+(blk_q, blk_k) over the training shapes where flash is (or is near) the
+profitable path — the long-context shapes from benches/flash_tpu_bench.py —
+times fwd+bwd under jit, verifies each candidate against the XLA reference
+before timing (a mis-tiled kernel must never win on wrong numbers), and
+emits per-point records plus a final "best" line with the flag settings to
+adopt (FLAGS_flash_block_q/_k).
+
+Run standalone on a live TPU: python benches/flash_tune.py
+"""
+from __future__ import annotations
+
+import functools
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+from _common import emit  # noqa: E402
+
+from paddle_tpu.ops import pallas_ops as po  # noqa: E402
+
+
+def _watchdog(limit_s: float):
+    import threading
+
+    def fire():
+        emit({"bench": "flash-tune", "error":
+              f"watchdog: no result within {limit_s:.0f}s (tunnel hang)"})
+        os._exit(3)
+
+    t = threading.Timer(limit_s, fire)
+    t.daemon = True
+    t.start()
+    return t
+
+
+def _time_fwd_bwd(fn, q, k, v, iters=10):
+    def loss(q, k, v):
+        return (fn(q, k, v).astype(jnp.float32) ** 2).sum()
+
+    step = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+    g = step(q, k, v)
+    jax.block_until_ready(g)
+    t0 = time.time()
+    for _ in range(iters):
+        g = step(q, k, v)
+    jax.block_until_ready(g)
+    return (time.time() - t0) / iters
+
+
+def main():
+    wd = _watchdog(float(os.environ.get("BENCH_WATCHDOG", "2100")))
+    d = jax.devices()[0]
+    print(f"[flash-tune] device: {d} ({d.platform})", flush=True)
+    rng = np.random.RandomState(7)
+    shapes = [(4, 4096, 12, 64), (1, 8192, 12, 64)]
+    candidates = [(128, 128), (128, 256), (128, 512), (256, 256),
+                  (256, 512), (512, 512), (256, 128), (512, 256)]
+    best_by_shape = {}
+    for b, s, h, dd in shapes:
+        q = jnp.asarray(rng.standard_normal((b, s, h, dd)), jnp.bfloat16)
+        k = jnp.asarray(rng.standard_normal((b, s, h, dd)), jnp.bfloat16)
+        v = jnp.asarray(rng.standard_normal((b, s, h, dd)), jnp.bfloat16)
+        scale = 1.0 / np.sqrt(dd)
+        ref = po._attention_reference(q, k, v, scale, True)
+        best = None
+        for bq, bk in candidates:
+            fn = functools.partial(po._flash_attention, scale=scale,
+                                   causal=True, blk_q=bq, blk_k=bk)
+            try:
+                out = jax.jit(lambda q, k, v: fn(q, k, v))(q, k, v)
+                err = float(jnp.max(jnp.abs(out.astype(jnp.float32)
+                                            - ref.astype(jnp.float32))))
+                if err > 1e-1:  # bf16 tolerance — wrong tiling, not noise
+                    emit({"bench": "flash-tune", "shape": [b, s, h, dd],
+                          "blk": [bq, bk], "error": f"numerics {err:.2e}"})
+                    continue
+                t = _time_fwd_bwd(lambda q, k, v: fn(q, k, v), q, k, v)
+            except Exception as e:  # mosaic lowering can reject a tiling
+                emit({"bench": "flash-tune", "shape": [b, s, h, dd],
+                      "blk": [bq, bk], "error": str(e)[:200]})
+                continue
+            flops = 3 * 2 * b * h * s * s * dd
+            rec = {"bench": "flash-tune", "shape": [b, s, h, dd],
+                   "blk": [bq, bk], "ms": t * 1e3,
+                   "tflops": flops / t / 1e12, "platform": d.platform}
+            emit(rec)
+            print(f"[flash-tune] s={s} blk=({bq},{bk}): {t*1e3:.2f} ms "
+                  f"{rec['tflops']:.2f} TFLOP/s", flush=True)
+            if best is None or t < best[0]:
+                best = (t, bq, bk)
+        if best:
+            best_by_shape[s] = best
+    for s, (t, bq, bk) in best_by_shape.items():
+        emit({"bench": "flash-tune-best", "seq": s, "blk": [bq, bk],
+              "ms": t * 1e3, "platform": d.platform})
+        print(f"[flash-tune] BEST s={s}: blk_q={bq} blk_k={bk} "
+              f"({t*1e3:.2f} ms) -> FLAGS_flash_block_q={bq} "
+              f"FLAGS_flash_block_k={bk}", flush=True)
+    wd.cancel()
+
+
+if __name__ == "__main__":
+    main()

@@ -88,6 +88,11 @@ define_flag("allocator_strategy", "xla", "Memory allocator strategy (XLA manages
 define_flag("use_stream_safe_allocator", True, "Kept for API parity; XLA/PJRT owns streams on TPU.")
 define_flag("sequence_parallel_mode", "auto",
             "Context parallelism for attention: auto|ring|ulysses|none.")
+define_flag("flash_block_q", 128,
+            "Pallas flash-attention q-block tile (benches/flash_tune.py "
+            "measures candidates on-chip).")
+define_flag("flash_block_k", 128,
+            "Pallas flash-attention k-block tile (multiple of 128).")
 define_flag("flash_attention_min_seqlen", 4608,
             "Route attention through the Pallas flash kernel only at kv "
             "sequence length >= this (measured v5e break-even: XLA's fused "

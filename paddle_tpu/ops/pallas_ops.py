@@ -341,26 +341,28 @@ def _unflatten_heads(x, b, h):
     return jnp.swapaxes(x.reshape(b, h, s, d), 1, 2)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _flash_attention(q, k, v, scale, causal):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_attention(q, k, v, scale, causal, blk_q=128, blk_k=128):
     b, sq, h, d = q.shape
     of = _flash_forward(_flatten_heads(q), _flatten_heads(k),
-                        _flatten_heads(v), scale, causal)
+                        _flatten_heads(v), scale, causal,
+                        blk_q=blk_q, blk_k=blk_k)
     return _unflatten_heads(of, b, h)
 
 
-def _flash_fwd_rule(q, k, v, scale, causal):
+def _flash_fwd_rule(q, k, v, scale, causal, blk_q=128, blk_k=128):
     b, sq, h, d = q.shape
     qf, kf, vf = _flatten_heads(q), _flatten_heads(k), _flatten_heads(v)
-    of, lse = _flash_forward(qf, kf, vf, scale, causal, with_lse=True)
+    of, lse = _flash_forward(qf, kf, vf, scale, causal, blk_q=blk_q,
+                             blk_k=blk_k, with_lse=True)
     return _unflatten_heads(of, b, h), (qf, kf, vf, of, lse)
 
 
-def _flash_bwd_rule(scale, causal, res, do):
+def _flash_bwd_rule(scale, causal, blk_q, blk_k, res, do):
     qf, kf, vf, of, lse = res
     b, sq, h, d = do.shape
     dq, dk, dv = _flash_backward(qf, kf, vf, of, lse, _flatten_heads(do),
-                                 scale, causal)
+                                 scale, causal, blk_q=blk_q, blk_k=blk_k)
     return (_unflatten_heads(dq, b, h), _unflatten_heads(dk, b, h),
             _unflatten_heads(dv, b, h))
 
@@ -368,10 +370,31 @@ def _flash_bwd_rule(scale, causal, res, do):
 _flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
-def flash_attention(q, k, v, scale: Optional[float] = None, causal: bool = False):
+def _default_blocks():
+    """Tunable kernel tiling (FLAGS_flash_block_q/_k; benches/flash_tune.py
+    measures the grid on-chip). 128 matches the MXU/lane width and is the
+    safe default; larger k-blocks amortize grid overhead at long context."""
+    from ..core import flags
+
+    return (int(flags.flag("flash_block_q")), int(flags.flag("flash_block_k")))
+
+
+def flash_attention(q, k, v, scale: Optional[float] = None, causal: bool = False,
+                    blk_q: Optional[int] = None, blk_k: Optional[int] = None):
     """Blockwise flash attention, layout [batch, seq, heads, head_dim]."""
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     if not _HAS_PALLAS or not _shapes_ok(q, k):
         return _attention_reference(q, k, v, scale, causal)
-    return _flash_attention(q, k, v, scale, causal)
+    dq, dk = _default_blocks()
+    blk_q = blk_q or dq
+    blk_k = blk_k or dk
+    # block sizes must tile the sequence, and the backward's lane-broadcast
+    # lse/delta tiling (reps = blk_k // 128 in _bwd_common) needs blk_k to
+    # be <=128 or a multiple of 128; fall back to the safe 128s otherwise
+    sq, sk = q.shape[1], k.shape[1]
+    if (sq % min(blk_q, sq) or sk % min(blk_k, sk)
+            or (blk_k > _LANES and blk_k % _LANES)
+            or blk_q % 8):
+        blk_q = blk_k = 128
+    return _flash_attention(q, k, v, scale, causal, blk_q, blk_k)
