@@ -81,6 +81,10 @@ def main():
         print("BEST:", json.dumps(best))
     else:
         print("BEST: none (all points failed)")
+        # a run with zero successful points must NOT report success — the
+        # probe-gated retry loop marks a stage done on rc==0 and would
+        # otherwise never re-run the sweep after a tunnel-hang round
+        sys.exit(1)
 
 
 if __name__ == "__main__":
