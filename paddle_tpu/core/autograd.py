@@ -24,6 +24,13 @@ import jax.numpy as jnp
 from . import dtype as dtype_mod
 from .tensor import Tensor
 
+
+def _cast_ct(arr, dt):
+    """Align an incoming cotangent with the recorded output dtype (op
+    boundaries in mixed-precision graphs accumulate cts in f32)."""
+    arr = jnp.asarray(arr)
+    return arr.astype(dt) if arr.dtype != dt else arr
+
 _state = threading.local()
 
 
@@ -120,8 +127,12 @@ class TapeNode:
         if self.unpack is not None:
             in_datas = tuple(self.unpack(d) for d in in_datas)
         if not create_graph:
+            # cotangents are cast to the recorded output dtype at the op
+            # boundary: mixed-precision graphs (autocast bf16 ops feeding
+            # f32 losses) legitimately hand back f32 cts for bf16 outputs,
+            # which jax.vjp rejects
             cts = [
-                (c._data if isinstance(c, Tensor) else c)
+                _cast_ct(c._data if isinstance(c, Tensor) else c, dt)
                 if c is not None
                 else jnp.zeros(shape, dt)
                 for c, (shape, dt) in zip(out_cts, self.out_avals)
@@ -135,12 +146,18 @@ class TapeNode:
         if not diff_idx:
             return (None,) * len(self.in_datas)
         g = _vjp_fn_of(self.fn, self.static, self.multi_out, len(in_datas), diff_idx)
-        ct_ts = [
-            (c if isinstance(c, Tensor) else Tensor(c))
-            if c is not None
-            else Tensor(jnp.zeros(shape, dt))
-            for c, (shape, dt) in zip(out_cts, self.out_avals)
-        ]
+        ct_ts = []
+        for c, (shape, dt) in zip(out_cts, self.out_avals):
+            if c is None:
+                ct_ts.append(Tensor(jnp.zeros(shape, dt)))
+            elif not isinstance(c, Tensor):
+                ct_ts.append(Tensor(_cast_ct(c, dt)))
+            elif c._data.dtype != dt:
+                # recorded cast (Tensor.astype goes through the tape) so a
+                # graph-carrying cotangent keeps its node for double backward
+                ct_ts.append(c.astype(dt))
+            else:
+                ct_ts.append(c)
         args = tuple(self.in_tensors) + tuple(ct_ts)
         if self.unpack is None:
             out = dispatch.apply(g, args, {}, name=(self.name or "op") + "_grad")

@@ -1,0 +1,293 @@
+"""The switching-user contract: canonical reference (PaddlePaddle 2.x)
+quickstart patterns, written exactly as a reference user writes them, run
+unchanged against this framework (only the import line differs).
+
+Each test is one public-docs-style flow (tensor quickstart, subclass-Layer
+training loop, Dataset/DataLoader, hapi Model.fit, save/load, to_static +
+jit.save, AMP, static graph, fleet DP, schedulers/clip, vision transforms,
+distribution/linalg/fft) — the shapes of code in the reference's
+get-started and practice docs (ref:python/paddle/__init__.py surface,
+ref:python/paddle/hapi/model.py, ref:python/paddle/jit/api.py).
+"""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+def test_tensor_quickstart():
+    x = paddle.to_tensor([[1.0, 2.0, 3.0], [4.0, 5.0, 6.0]])
+    y = paddle.to_tensor(np.ones((2, 3), np.float32))
+    z = x + y * 2
+    assert z.shape == [2, 3]
+    np.testing.assert_allclose(z.numpy()[0], [3.0, 4.0, 5.0])
+    assert float(paddle.sum(z)) == pytest.approx(33.0)
+    # slicing / reshape / transpose / broadcasting
+    assert z[0, 1:].shape == [2]
+    assert paddle.reshape(z, [3, 2]).shape == [3, 2]
+    assert paddle.transpose(z, [1, 0]).shape == [3, 2]
+    a = paddle.arange(6, dtype="float32").reshape([2, 3])
+    b = paddle.unsqueeze(paddle.to_tensor([1.0, 2.0]), 1)
+    assert (a * b).shape == [2, 3]
+    # dtype/device introspection
+    assert "float32" in str(z.dtype)
+    assert paddle.nn.functional.relu(paddle.to_tensor([-1.0, 2.0])).numpy().tolist() == [0.0, 2.0]
+
+
+class _Net(nn.Layer):
+    def __init__(self, num_classes=4):
+        super().__init__()
+        self.fc1 = nn.Linear(16, 32)
+        self.fc2 = nn.Linear(32, num_classes)
+
+    def forward(self, x):
+        return self.fc2(paddle.nn.functional.relu(self.fc1(x)))
+
+
+def _toy(n=64, d=16, c=4, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((n, d), dtype=np.float32)
+    w = rng.standard_normal((d, c), dtype=np.float32)
+    y = (x @ w).argmax(1).astype(np.int64)
+    return x, y
+
+
+def test_subclass_layer_training_loop():
+    """The canonical eager loop: forward -> loss -> backward -> step."""
+    paddle.seed(0)
+    x_np, y_np = _toy()
+    net = _Net()
+    loss_fn = nn.CrossEntropyLoss()
+    sched = paddle.optimizer.lr.StepDecay(learning_rate=0.1, step_size=20, gamma=0.5)
+    opt = paddle.optimizer.Momentum(learning_rate=sched, momentum=0.9,
+                                    parameters=net.parameters(),
+                                    grad_clip=nn.ClipGradByGlobalNorm(1.0))
+    first = last = None
+    for epoch in range(40):
+        x = paddle.to_tensor(x_np)
+        y = paddle.to_tensor(y_np)
+        out = net(x)
+        loss = loss_fn(out, y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        sched.step()
+        if first is None:
+            first = float(loss)
+        last = float(loss)
+    assert last < 0.5 * first
+    acc = (net(paddle.to_tensor(x_np)).numpy().argmax(1) == y_np).mean()
+    assert acc > 0.8
+
+
+def test_dataset_dataloader():
+    from paddle_tpu import io
+
+    x_np, y_np = _toy(n=32)
+
+    class MyDataset(io.Dataset):
+        def __init__(self):
+            super().__init__()
+
+        def __getitem__(self, idx):
+            return x_np[idx], y_np[idx]
+
+        def __len__(self):
+            return len(x_np)
+
+    loader = io.DataLoader(MyDataset(), batch_size=8, shuffle=True,
+                           drop_last=False)
+    seen = 0
+    for xb, yb in loader:
+        assert xb.shape == [8, 16]
+        seen += int(xb.shape[0])
+    assert seen == 32
+
+
+def test_hapi_model_fit_evaluate_predict():
+    from paddle_tpu import io
+
+    x_np, y_np = _toy(n=48)
+
+    class DS(io.Dataset):
+        def __getitem__(self, i):
+            return x_np[i], y_np[i]
+
+        def __len__(self):
+            return len(x_np)
+
+    paddle.seed(0)
+    model = paddle.Model(_Net())
+    model.prepare(
+        paddle.optimizer.Adam(learning_rate=0.05, parameters=model.parameters()),
+        nn.CrossEntropyLoss(),
+        paddle.metric.Accuracy())
+    model.fit(DS(), epochs=8, batch_size=16, verbose=0)
+    res = model.evaluate(DS(), batch_size=16, verbose=0)
+    assert res["acc"] > 0.7
+    preds = model.predict(DS(), batch_size=16)
+    assert np.concatenate(preds[0]).shape[0] == 48
+
+
+def test_save_load_state_dict(tmp_path):
+    paddle.seed(1)
+    net = _Net()
+    opt = paddle.optimizer.Adam(learning_rate=0.01, parameters=net.parameters())
+    # one step so optimizer state exists
+    x_np, y_np = _toy(n=8)
+    loss = nn.CrossEntropyLoss()(net(paddle.to_tensor(x_np)), paddle.to_tensor(y_np))
+    loss.backward()
+    opt.step()
+    pd = os.path.join(tmp_path, "net.pdparams")
+    od = os.path.join(tmp_path, "opt.pdopt")
+    paddle.save(net.state_dict(), pd)
+    paddle.save(opt.state_dict(), od)
+
+    net2 = _Net()
+    opt2 = paddle.optimizer.Adam(learning_rate=0.01, parameters=net2.parameters())
+    net2.set_state_dict(paddle.load(pd))
+    opt2.set_state_dict(paddle.load(od))
+    x = paddle.to_tensor(x_np)
+    np.testing.assert_allclose(net(x).numpy(), net2(x).numpy(), atol=1e-6)
+
+
+def test_to_static_and_jit_save_load(tmp_path):
+    paddle.seed(2)
+    net = _Net()
+    net.eval()
+    x_np = np.random.randn(4, 16).astype(np.float32)
+    eager_out = net(paddle.to_tensor(x_np)).numpy()
+
+    static_net = paddle.jit.to_static(net)
+    static_out = static_net(paddle.to_tensor(x_np)).numpy()
+    np.testing.assert_allclose(eager_out, static_out, atol=1e-5)
+
+    path = os.path.join(tmp_path, "inference/net")
+    paddle.jit.save(net, path, input_spec=[
+        paddle.static.InputSpec(shape=[None, 16], dtype="float32")])
+    loaded = paddle.jit.load(path)
+    np.testing.assert_allclose(eager_out, loaded(paddle.to_tensor(x_np)).numpy(),
+                               atol=1e-5)
+
+
+def test_amp_training_pattern():
+    paddle.seed(3)
+    x_np, y_np = _toy()
+    net = _Net()
+    opt = paddle.optimizer.Adam(learning_rate=0.02, parameters=net.parameters())
+    scaler = paddle.amp.GradScaler(init_loss_scaling=1024)
+    first = last = None
+    for _ in range(30):
+        with paddle.amp.auto_cast(dtype="bfloat16"):
+            loss = nn.CrossEntropyLoss()(net(paddle.to_tensor(x_np)),
+                                         paddle.to_tensor(y_np))
+        scaled = scaler.scale(loss)
+        scaled.backward()
+        scaler.step(opt)
+        scaler.update()
+        opt.clear_grad()
+        if first is None:
+            first = float(loss)
+        last = float(loss)
+    assert last < 0.6 * first
+
+
+def test_static_graph_program():
+    from paddle_tpu import static
+
+    paddle.enable_static() if hasattr(paddle, "enable_static") else None
+    try:
+        main = static.Program()
+        startup = static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [None, 16], "float32")
+            y = static.data("y", [None], "int64")
+            hidden = static.nn.fc(x, size=32, activation="relu")
+            out = static.nn.fc(hidden, size=4)
+            loss = paddle.mean(
+                paddle.nn.functional.cross_entropy(out, y, reduction="none"))
+            opt = paddle.optimizer.SGD(learning_rate=0.1)
+            opt.minimize(loss)
+        exe = static.Executor()
+        exe.run(startup)
+        x_np, y_np = _toy()
+        first = last = None
+        for _ in range(30):
+            (lv,) = exe.run(main, feed={"x": x_np, "y": y_np},
+                            fetch_list=[loss])
+            if first is None:
+                first = float(lv)
+            last = float(lv)
+        assert last < 0.5 * first
+    finally:
+        if hasattr(paddle, "disable_static"):
+            paddle.disable_static()
+
+
+def test_fleet_data_parallel():
+    from paddle_tpu import distributed as dist
+    from paddle_tpu.distributed import fleet
+
+    strategy = fleet.DistributedStrategy()
+    fleet.init(is_collective=True, strategy=strategy)
+    paddle.seed(4)
+    net = _Net()
+    net = fleet.distributed_model(net)
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=net.parameters())
+    opt = fleet.distributed_optimizer(opt)
+    x_np, y_np = _toy(n=32)
+    first = last = None
+    for _ in range(20):
+        loss = nn.CrossEntropyLoss()(net(paddle.to_tensor(x_np)),
+                                     paddle.to_tensor(y_np))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        if first is None:
+            first = float(loss)
+        last = float(loss)
+    assert last < 0.6 * first
+    assert dist.get_world_size() >= 1
+
+
+def test_vision_transforms_and_model():
+    from paddle_tpu.vision import transforms
+
+    t = transforms.Compose([
+        transforms.Resize(36),
+        transforms.CenterCrop(32),
+        transforms.ToTensor(),
+        transforms.Normalize(mean=[0.5, 0.5, 0.5], std=[0.5, 0.5, 0.5]),
+    ])
+    img = (np.random.rand(40, 48, 3) * 255).astype(np.uint8)
+    out = t(img)
+    assert list(out.shape) == [3, 32, 32]
+
+    from paddle_tpu.vision.models import resnet18
+
+    m = resnet18(num_classes=10)
+    m.eval()
+    logits = m(paddle.to_tensor(np.random.randn(2, 3, 32, 32).astype(np.float32)))
+    assert logits.shape == [2, 10]
+
+
+def test_distribution_linalg_fft():
+    d = paddle.distribution.Normal(loc=0.0, scale=1.0)
+    s = d.sample([256])
+    assert abs(float(paddle.mean(s))) < 0.5
+    lp = d.log_prob(paddle.to_tensor(0.0))
+    assert float(lp) == pytest.approx(-0.9189, abs=1e-3)
+
+    mat = paddle.to_tensor(np.random.randn(6, 4).astype(np.float32))
+    u, sv, vh = paddle.linalg.svd(mat, full_matrices=False)
+    rec = u @ paddle.diag(sv) @ vh
+    np.testing.assert_allclose(rec.numpy(), mat.numpy(), atol=1e-4)
+
+    sig = paddle.to_tensor(np.random.randn(64).astype(np.float32))
+    spec = paddle.fft.rfft(sig)
+    back = paddle.fft.irfft(spec, n=64)
+    np.testing.assert_allclose(back.numpy(), sig.numpy(), atol=1e-4)
