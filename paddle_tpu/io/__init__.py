@@ -246,6 +246,7 @@ class DataLoader:
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
+        self.use_buffer_reader = bool(use_buffer_reader)
         self.prefetch_factor = max(prefetch_factor, 1)
         self.use_shared_memory = use_shared_memory
         self.timeout = timeout
@@ -264,7 +265,7 @@ class DataLoader:
         else:
             self.batch_sampler = BatchSampler(dataset, shuffle=shuffle, batch_size=batch_size, drop_last=drop_last)
 
-    def _batches(self):
+    def _batches(self, idx_plan=None):
         if self._iterable_mode:
             it = iter(self.dataset)
             while True:
@@ -275,7 +276,8 @@ class DataLoader:
                     return
                 yield self.collate_fn(batch)
         else:
-            for idx_batch in self.batch_sampler:
+            for idx_batch in (self.batch_sampler if idx_plan is None
+                              else idx_plan):
                 yield self.collate_fn([self.dataset[i] for i in idx_batch])
 
     def _to_device(self, batch):
@@ -289,18 +291,37 @@ class DataLoader:
         return jax.tree_util.tree_map(conv, batch, is_leaf=lambda x: isinstance(x, (Tensor, np.ndarray)))
 
     def __iter__(self):
+        idx_plan = None
+        if self.use_buffer_reader and not self._iterable_mode:
+            # draw the (RNG-dependent) shuffle plan on the CALLING thread:
+            # the producer thread must not touch the global numpy RNG, or
+            # seeded runs lose reproducibility the moment buffering is on
+            idx_plan = list(self.batch_sampler)
+        it = self._iter_batches(idx_plan)
+        if self.use_buffer_reader:
+            # double-buffered device feed (the reference's buffer reader,
+            # ref:python/paddle/io/dataloader/dataloader_iter.py use_buffer_
+            # reader): a host thread stays prefetch_factor batches ahead,
+            # so collate + the async H2D device_put overlap the consumer's
+            # step instead of serializing with it. A live but unconsumed
+            # iterator intentionally holds up to prefetch_factor ready
+            # batches — that is the prefetch contract.
+            return _buffered_iter(it, self.prefetch_factor)
+        return it
+
+    def _iter_batches(self, idx_plan=None):
         if self.num_workers == 0:
-            for b in self._batches():
+            for b in self._batches(idx_plan):
                 yield self._to_device(b)
             return
         if self.persistent_workers and not self._iterable_mode:
             if self._persistent_iter is None:
                 self._persistent_iter = _MultiProcessIter(self)
             it = self._persistent_iter
-            it.start_epoch()
+            it.start_epoch(idx_plan)
         else:
             it = _MultiProcessIter(self)
-            it.start_epoch()
+            it.start_epoch(idx_plan)
         try:
             for b in it.epoch_batches():
                 yield self._to_device(b)
@@ -319,6 +340,61 @@ class DataLoader:
         if self._iterable_mode:
             raise TypeError("IterableDataset has no length")
         return len(self.batch_sampler)
+
+
+def _buffered_iter(gen, depth: int):
+    """Drive ``gen`` from a producer thread with a bounded ready-queue.
+
+    The producer owns the inner generator end-to-end (it alone iterates and
+    closes it, so multiprocess-epoch cleanup in its ``finally`` runs on the
+    producer thread); the consumer sees items, the end marker, or the
+    producer's exception re-raised. Early consumer exit sets ``stop`` and
+    the producer closes the inner generator promptly."""
+    q: "queue.Queue" = queue.Queue(maxsize=max(1, int(depth)))
+    END, ERR, ITEM = "end", "err", "item"
+    stop = threading.Event()
+
+    def produce():
+        try:
+            try:
+                for item in gen:
+                    while not stop.is_set():
+                        try:
+                            q.put((ITEM, item), timeout=0.1)
+                            break
+                        except queue.Full:
+                            continue
+                    if stop.is_set():
+                        return
+            finally:
+                if stop.is_set():
+                    gen.close()
+            _put_final((END, None))
+        except BaseException as e:  # re-raised at the consumer
+            _put_final((ERR, e))
+
+    def _put_final(msg):
+        while not stop.is_set():
+            try:
+                q.put(msg, timeout=0.1)
+                return
+            except queue.Full:
+                continue
+
+    t = threading.Thread(target=produce, daemon=True,
+                          name="paddle-tpu-buffer-reader")
+    t.start()
+    try:
+        while True:
+            kind, val = q.get()
+            if kind == END:
+                return
+            if kind == ERR:
+                raise val
+            yield val
+    finally:
+        stop.set()
+        t.join(timeout=5.0)
 
 
 def _start_method() -> str:
@@ -377,7 +453,7 @@ class _MultiProcessIter:
 
     # ------------------------------------------------------------ epochs
 
-    def start_epoch(self):
+    def start_epoch(self, idx_plan=None):
         if self.iterable:
             pass  # workers stream autonomously; _iterable_epoch tracks done
         else:
@@ -385,7 +461,8 @@ class _MultiProcessIter:
             # consumed epoch (persistent workers + early break) are discarded
             # instead of being misread as this epoch's batches
             self._epoch = getattr(self, "_epoch", -1) + 1
-            self._task_iter = enumerate(iter(self.loader.batch_sampler))
+            self._task_iter = enumerate(iter(
+                self.loader.batch_sampler if idx_plan is None else idx_plan))
             self._sent = 0
             self._yielded = 0
             self._next_worker = 0
