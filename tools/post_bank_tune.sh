@@ -1,0 +1,64 @@
+#!/bin/bash
+# Second-tier TPU measurements, strictly AFTER the main round-4 bank
+# (tools/cashout_loop_r4.sh) finishes all its stages — never competes with
+# it for the single chip. Uses the same probe-gate + marker-file pattern:
+#   flash_tune    — Pallas flash block-size autotune (benches/flash_tune.py)
+#   bench_routed  — headline bench rerun at default config to confirm the
+#                   measured attention-routing gain (flash->XLA at s1024)
+set -u
+cd "$(dirname "$0")/.."
+LOGS=benches/tpu_logs
+MARKS=$LOGS/done
+mkdir -p "$LOGS" "$MARKS"
+
+probe() {
+  timeout 180 python - <<'PY'
+import jax, numpy as np, time
+t0 = time.time()
+y = jax.jit(lambda a: a @ a)(np.ones((256, 256), np.float32))
+y.block_until_ready()
+d = jax.devices()[0]
+assert d.platform != "cpu", f"probe landed on {d.platform}"
+print(f"TPU alive: {d} matmul in {time.time()-t0:.1f}s")
+PY
+}
+
+run() {
+  local name=$1 t=$2; shift 2
+  [ -f "$MARKS/$name" ] && { echo "[post] $name already done"; return 0; }
+  local STAMP=$(date +%Y%m%d_%H%M%S)
+  echo "[post] $name ..."
+  timeout "$t" "$@" > "$LOGS/${name}_$STAMP.log" 2>&1
+  local rc=$?
+  tail -2 "$LOGS/${name}_$STAMP.log"
+  echo "[post] $name rc=$rc"
+  [ "$rc" -eq 0 ] && touch "$MARKS/$name"
+  return $rc
+}
+
+echo "[post] waiting for the main bank to finish..."
+while true; do
+  all=1
+  for m in flash_tpu sweep baseline decode eager hlo_tpu native; do
+    [ -f "$MARKS/$m" ] || { all=0; break; }
+  done
+  [ "$all" -eq 1 ] && break
+  sleep 600
+done
+echo "[post] main bank complete $(date); starting second tier"
+
+attempt=0
+while true; do
+  attempt=$((attempt + 1))
+  echo "[post] attempt $attempt $(date)"
+  if probe > "$LOGS/post_probe_$attempt.log" 2>&1; then
+    cat "$LOGS/post_probe_$attempt.log"
+    run flash_tune   2400 python benches/flash_tune.py
+    run bench_routed 2400 python bench.py
+    [ -f "$MARKS/flash_tune" ] && [ -f "$MARKS/bench_routed" ] && {
+      echo "[post] all second-tier stages done"; break; }
+  else
+    echo "[post] tunnel down"
+  fi
+  sleep 3000
+done
