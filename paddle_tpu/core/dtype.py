@@ -6,6 +6,7 @@ bfloat16; float64 is supported by XLA:CPU for tests but discouraged on TPU.
 """
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -38,6 +39,24 @@ _STR_TO_DTYPE = {
     "complex128": complex128,
 }
 
+# Canonical-width policy: without jax x64 (the TPU-native default — 32-bit
+# indices/floats are what the hardware wants), JAX canonicalizes every
+# 64-bit ARRAY to 32-bit at creation. ``convert_dtype_arg`` applies the
+# same narrowing to every dtype REQUEST (string or type object, checked
+# per-call so enabling x64 restores true 64-bit), making the policy
+# explicit and warning-free instead of a per-array surprise. The exported
+# constants stay genuine 64-bit types: host-side numpy built with
+# ``paddle.int64``/``paddle.float64`` keeps full width, and dtype names
+# round-trip. Device arrays therefore report int32/float32 — reference
+# code comparing ``x.dtype == paddle.int64`` should compare against
+# ``paddle.int32`` (or enable x64); see docs/migration.md.
+_CANONICAL_NARROW = {
+    "int64": int32,
+    "uint64": jnp.uint32,
+    "float64": float32,
+    "complex128": complex64,
+}
+
 _default_dtype = jnp.float32
 
 
@@ -51,15 +70,21 @@ def get_default_dtype():
 
 
 def convert_dtype_arg(dtype):
-    """Normalize a user-provided dtype (str | np.dtype | jnp scalar type) to a jnp type."""
+    """Normalize a user-provided dtype (str | np.dtype | jnp scalar type) to
+    a jnp type, applying the canonical-width policy (64-bit requests narrow
+    to 32-bit while jax x64 is off — every spelling, checked per call)."""
     if dtype is None:
         return None
     if isinstance(dtype, str):
         try:
-            return _STR_TO_DTYPE[dtype]
+            t = _STR_TO_DTYPE[dtype]
         except KeyError:
             raise ValueError(f"unsupported dtype string: {dtype!r}")
-    return jnp.dtype(dtype).type
+    else:
+        t = jnp.dtype(dtype).type
+    if not jax.config.jax_enable_x64:
+        t = _CANONICAL_NARROW.get(jnp.dtype(t).name, t)
+    return t
 
 
 def dtype_name(dtype) -> str:

@@ -1,0 +1,166 @@
+"""Second tier of switching-user patterns: the idioms just past quickstart
+that a reference (PaddlePaddle 2.x) user reaches for immediately —
+ParamAttr/initializer/regularizer, PyLayer custom autograd, container
+layers, buffers, no_grad, lr get/set, parameter traversal, value clipping.
+All bodies are written exactly as reference code (only the import differs).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+def test_param_attr_initializer_regularizer():
+    fc = nn.Linear(
+        4, 3,
+        weight_attr=paddle.ParamAttr(
+            initializer=nn.initializer.Constant(0.5),
+            regularizer=paddle.regularizer.L2Decay(1e-4)),
+        bias_attr=paddle.ParamAttr(initializer=nn.initializer.Constant(0.1)))
+    np.testing.assert_allclose(fc.weight.numpy(), np.full((4, 3), 0.5),
+                               atol=0)
+    np.testing.assert_allclose(fc.bias.numpy(), np.full((3,), 0.1), atol=0)
+
+    k = nn.Linear(16, 16,
+                  weight_attr=nn.initializer.KaimingNormal())
+    std = float(k.weight.numpy().std())
+    assert 0.1 < std < 0.8  # fan-based scale, not constant/zeros
+
+    x = nn.initializer.XavierUniform()
+    lin = nn.Linear(8, 8, weight_attr=x)
+    assert abs(float(lin.weight.numpy().mean())) < 0.2
+
+
+def test_pylayer_custom_op():
+    class Cube(paddle.autograd.PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * x * x
+
+        @staticmethod
+        def backward(ctx, dy):
+            (x,) = ctx.saved_tensor()
+            return dy * 3 * x * x
+
+    t = paddle.to_tensor(np.array([2.0, -1.0], np.float32),
+                         stop_gradient=False)
+    y = Cube.apply(t)
+    y.sum().backward()
+    np.testing.assert_allclose(t.grad.numpy(), [12.0, 3.0], atol=1e-6)
+
+
+def test_container_layers_and_traversal():
+    class Block(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.layers = nn.LayerList([nn.Linear(4, 4) for _ in range(3)])
+            self.extra = nn.ParameterList([
+                paddle.create_parameter([4], "float32")])
+
+        def forward(self, x):
+            for l in self.layers:
+                x = l(x)
+            return x + self.extra[0]
+
+    b = Block()
+    names = [n for n, _ in b.named_parameters()]
+    assert len(names) == 7  # 3 * (w, b) + 1
+    assert any("layers.1" in n for n in names)
+    assert len(list(b.sublayers())) >= 4
+    out = b(paddle.to_tensor(np.ones((2, 4), np.float32)))
+    assert out.shape == [2, 4]
+
+    seq = nn.Sequential(
+        ("fc1", nn.Linear(4, 8)), ("act", nn.ReLU()), ("fc2", nn.Linear(8, 2)))
+    assert seq(paddle.to_tensor(np.ones((1, 4), np.float32))).shape == [1, 2]
+
+
+def test_register_buffer_and_state_dict():
+    class WithStats(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 4)
+            self.register_buffer("steps", paddle.zeros([1], dtype="float32"))
+
+        def forward(self, x):
+            return self.fc(x)
+
+    m = WithStats()
+    assert "steps" in m.state_dict()
+    assert not any(n == "steps" for n, _ in m.named_parameters())
+
+
+def test_no_grad_and_stop_gradient():
+    x = paddle.to_tensor(np.ones(3, np.float32), stop_gradient=False)
+    with paddle.no_grad():
+        y = x * 2
+    assert y.stop_gradient
+    z = x * 2
+    assert not z.stop_gradient
+    frozen = paddle.to_tensor(np.ones(3, np.float32))  # default stop_gradient
+    with pytest.raises(RuntimeError):
+        frozen.sum().backward()
+
+
+def test_lr_get_set_and_clip_value():
+    net = nn.Linear(4, 2)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=net.parameters(),
+                               grad_clip=nn.ClipGradByValue(0.01))
+    assert opt.get_lr() == pytest.approx(0.1)
+    opt.set_lr(0.05)
+    assert opt.get_lr() == pytest.approx(0.05)
+
+    w0 = net.weight.numpy().copy()
+    x = paddle.to_tensor(np.full((2, 4), 100.0, np.float32))
+    loss = net(x).sum()
+    loss.backward()
+    opt.step()
+    # reference contract: clip applies to the UPDATE (p.grad keeps the raw
+    # value); |grad| clipped to 0.01 at lr 0.05 moves weights <= 5e-4
+    delta = np.abs(net.weight.numpy() - w0).max()
+    assert delta <= 0.05 * 0.01 + 1e-7, delta
+
+
+def test_apply_and_children():
+    m = nn.Sequential(nn.Linear(2, 2), nn.Sequential(nn.Linear(2, 2)))
+    hit = []
+
+    def fn(layer):
+        hit.append(type(layer).__name__)
+
+    m.apply(fn)
+    assert "Linear" in hit and "Sequential" in hit
+    assert len(list(m.children())) == 2
+
+
+def test_tensor_methods_a_reference_user_expects():
+    t = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(3, 4))
+    assert t.mean(axis=0).shape == [4]
+    assert t.max().item() == 11.0
+    assert t.argmax(axis=1).numpy().tolist() == [3, 3, 3]
+    # canonical-width policy (TPU-native, x64 off): 64-bit requests narrow
+    # to 32-bit consistently for every spelling, warning-free
+    assert t.astype("int64").dtype == paddle.int32
+    assert t.astype(np.int64).dtype == t.astype("int64").dtype
+    assert t.flatten().shape == [12]
+    assert t.unsqueeze(0).squeeze(0).shape == [3, 4]
+    assert paddle.concat([t, t], axis=0).shape == [6, 4]
+    assert paddle.split(t, 2, axis=1)[0].shape == [3, 2]
+    c = t.clone()
+    c[0, 0] = 99.0
+    assert float(t[0, 0]) == 0.0  # clone is a copy
+    assert t.cpu().numpy().sum() == t.numpy().sum()
+    assert not t.place.is_gpu_place() if hasattr(t.place, "is_gpu_place") else True
+
+
+def test_einsum_matmul_broadcast_semantics():
+    a = paddle.to_tensor(np.random.randn(2, 3, 4).astype(np.float32))
+    b = paddle.to_tensor(np.random.randn(2, 4, 5).astype(np.float32))
+    np.testing.assert_allclose(
+        paddle.matmul(a, b).numpy(),
+        paddle.einsum("bij,bjk->bik", a, b).numpy(), atol=1e-5)
+    v = paddle.to_tensor(np.random.randn(4).astype(np.float32))
+    assert paddle.matmul(a, v.unsqueeze(-1)).shape == [2, 3, 1]
