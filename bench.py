@@ -52,6 +52,25 @@ def model_flops_per_token(cfg) -> float:
 cfg_seq_len = 1024  # set in main() before flop accounting
 
 
+def _tuned_knobs() -> dict:
+    """Best on-chip sweep point (benches/BENCH_TUNED.json, written by
+    benches/sweep.py after a successful sweep). STRICTLY OPT-IN via
+    BENCH_USE_TUNED=1: the plain ``python bench.py`` the driver runs keeps
+    the known-safe defaults (a speculative tuned config must never cost the
+    round its record), while the retry loops can ask for the tuned point
+    once it has been measured."""
+    if os.environ.get("BENCH_USE_TUNED") != "1":
+        return {}
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "benches", "BENCH_TUNED.json")
+    try:
+        with open(path) as f:
+            point = json.load(f).get("sweep_point", {})
+        return {k: str(v) for k, v in point.items()}
+    except (OSError, ValueError):
+        return {}
+
+
 def _arm_watchdog():
     """The tunneled chip can enumerate but hang on compile/execute (observed
     mid-round-2 outage). A hung bench leaves the round with no record at all;
@@ -126,24 +145,31 @@ def main():
 
     dev = jax.devices()[0]
     platform = dev.platform
-    remat = os.environ.get("BENCH_REMAT", "0") == "1"
-    chunk = int(os.environ.get("BENCH_CHUNK_LOSS", "0"))
+    tuned = _tuned_knobs() if platform == "tpu" else {}
+
+    def knob(name, default):
+        return os.environ.get(name, tuned.get(name, default))
+
+    if tuned:
+        print(f"# applying tuned sweep point: {tuned}", flush=True)
+    remat = knob("BENCH_REMAT", "0") == "1"
+    chunk = int(knob("BENCH_CHUNK_LOSS", "0"))
     if platform == "tpu":
         # BENCH_HIDDEN/LAYERS/HEADS scale toward the reference's headline
         # GPT-3 1.3B-class config (BASELINE.md config 4) as far as one chip
         # fits; bigger models raise FLOPs-per-HBM-byte, which is the MFU
         # lever benches/HLO_ANALYSIS.md identifies
-        hidden = int(os.environ.get("BENCH_HIDDEN", "768"))
-        layers = int(os.environ.get("BENCH_LAYERS", "12"))
-        heads = int(os.environ.get("BENCH_HEADS", str(max(1, hidden // 64))))
+        hidden = int(knob("BENCH_HIDDEN", "768"))
+        layers = int(knob("BENCH_LAYERS", "12"))
+        heads = int(knob("BENCH_HEADS", str(max(1, hidden // 64))))
         cfg = GPTConfig(vocab_size=50304, hidden_size=hidden, num_layers=layers,
                         num_heads=heads, max_position_embeddings=2048,
                         use_recompute=remat, loss_chunk_size=chunk)
-        batch = int(os.environ.get("BENCH_BATCH", "16"))  # b16 fits v5e
+        batch = int(knob("BENCH_BATCH", "16"))  # b16 fits v5e
         # HBM comfortably (fused logsumexp CE, donation) and lifts MFU over
         # the b8 round-1 config
-        seq = int(os.environ.get("BENCH_SEQ", "1024"))
-        warmup, iters = 3, int(os.environ.get("BENCH_ITERS", "10"))
+        seq = int(knob("BENCH_SEQ", "1024"))
+        warmup, iters = 3, int(knob("BENCH_ITERS", "10"))
     else:  # CPU smoke path so the script always works
         cfg = gpt_tiny()
         batch, seq = 4, 128
@@ -159,7 +185,7 @@ def main():
     # BENCH_AMP=O2: cast params themselves to bf16 (f32 optimizer slots act
     # as the master weights) — halves the per-step weight HBM traffic on top
     # of O1's bf16 compute
-    if use_amp and os.environ.get("BENCH_AMP", "O1") == "O2":
+    if use_amp and knob("BENCH_AMP", "O1") == "O2":
         amp.decorate(model, opt, level="O2")
 
     def loss_fn(x, y):
