@@ -58,6 +58,28 @@ class GPTConfig:
             self.intermediate_size = 4 * self.hidden_size
 
 
+def _filter_logits(scaled, top_k: int, top_p: float, vocab: int):
+    """Top-k and/or nucleus (top-p) logit filtering, jit-safe (static ks).
+
+    Top-p keeps the smallest set of highest-probability tokens whose
+    cumulative probability reaches ``top_p`` (a token survives when the
+    cumulative probability BEFORE it is still < top_p, so the top token
+    always survives)."""
+    k_eff = min(int(top_k), vocab)
+    if k_eff > 0:
+        kth = jnp.sort(scaled, axis=-1)[:, -k_eff][:, None]
+        scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+    if 0.0 < float(top_p) < 1.0:
+        desc = jnp.sort(scaled, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(desc.astype(jnp.float32), axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        keep = (cum - probs) < top_p
+        thresh = jnp.min(jnp.where(keep, desc, jnp.inf), axis=-1,
+                         keepdims=True)
+        scaled = jnp.where(scaled < thresh, -jnp.inf, scaled)
+    return scaled
+
+
 def gpt_tiny(**kw) -> "GPTConfig":
     return GPTConfig(vocab_size=1024, hidden_size=128, num_layers=2, num_heads=4,
                      max_position_embeddings=256, **kw)
@@ -372,8 +394,8 @@ class GPTForCausalLM(nn.Layer):
 
     def generate(self, input_ids, max_new_tokens: int = 32,
                  do_sample: bool = False, temperature: float = 1.0,
-                 top_k: int = 0, eos_token_id: int = -1, seed: int = 0,
-                 use_cache: bool = True):
+                 top_k: int = 0, top_p: float = 1.0, eos_token_id: int = -1,
+                 seed: int = 0, use_cache: bool = True):
         """Compiled autoregressive decoding: ONE jitted program — prefill
         plus a ``lax.scan`` over decode steps — so the whole loop runs
         on-device with no host round trips (the XLA-native replacement for
@@ -418,8 +440,8 @@ class GPTForCausalLM(nn.Layer):
             # repeat generate() calls with the same shapes/flags reuse the
             # executable instead of retracing the whole scan
             cache_key = (b, prompt_len, max_new_tokens, bool(do_sample),
-                         float(temperature), int(top_k), int(eos_token_id),
-                         bool(use_cache))
+                         float(temperature), int(top_k), float(top_p),
+                         int(eos_token_id), bool(use_cache))
             cached = getattr(self, "_gen_cache", None)
             if cached is not None and cached[0] == cache_key:
                 return Tensor(cached[1](arrays, ids, jax.random.key(seed)))
@@ -428,10 +450,8 @@ class GPTForCausalLM(nn.Layer):
                 if do_sample:
                     key, sub = jax.random.split(key)
                     scaled = logits / jnp.maximum(temperature, 1e-6)
-                    k_eff = min(top_k, self.cfg.vocab_size)
-                    if k_eff > 0:
-                        kth = jnp.sort(scaled, axis=-1)[:, -k_eff][:, None]
-                        scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+                    scaled = _filter_logits(scaled, top_k, top_p,
+                                            self.cfg.vocab_size)
                     nxt = jax.random.categorical(sub, scaled)
                 else:
                     nxt = jnp.argmax(logits, axis=-1)
@@ -510,10 +530,8 @@ class GPTForCausalLM(nn.Layer):
                     if do_sample:
                         key, sub = jax.random.split(key)
                         scaled = logits / jnp.maximum(temperature, 1e-6)
-                        k_eff = min(top_k, self.cfg.vocab_size)
-                        if k_eff > 0:
-                            kth = jnp.sort(scaled, axis=-1)[:, -k_eff][:, None]
-                            scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+                        scaled = _filter_logits(scaled, top_k, top_p,
+                                                self.cfg.vocab_size)
                         nxt = jax.random.categorical(sub, scaled)
                     else:
                         nxt = jnp.argmax(logits, axis=-1)

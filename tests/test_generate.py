@@ -181,3 +181,47 @@ def test_encoder_incremental_cache():
         outs.append(out.numpy())
     np.testing.assert_allclose(outs[-1][:, 0], full[:, -1], rtol=1e-4,
                                atol=1e-5)
+
+
+def test_filter_logits_top_p_unit():
+    """Nucleus filter keeps the smallest prefix reaching top_p (top token
+    always survives), composes with top_k, and -inf's the rest."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from paddle_tpu.models.gpt import _filter_logits
+
+    # probs ~ [0.6438, 0.2369, 0.0871, 0.0321] for logits [3,2,1,0]
+    logits = jnp.asarray([[3.0, 2.0, 1.0, 0.0]])
+    out = np.asarray(_filter_logits(logits, 0, 0.7, 4))
+    # cum-before: [0, .644, .881, .968] -> keep first two
+    assert np.isfinite(out[0, 0]) and np.isfinite(out[0, 1])
+    assert np.isinf(out[0, 2]) and np.isinf(out[0, 3])
+    # tiny top_p: only the argmax survives
+    out = np.asarray(_filter_logits(logits, 0, 1e-6, 4))
+    assert np.isfinite(out[0, 0]) and np.isinf(out[0, 1:]).all()
+    # top_k composes: k=3 then p=0.95 keeps {0,1,2} ∩ nucleus
+    out = np.asarray(_filter_logits(logits, 3, 0.95, 4))
+    assert np.isinf(out[0, 3])
+    # p>=1 is a no-op
+    out = np.asarray(_filter_logits(logits, 0, 1.0, 4))
+    assert np.isfinite(out).all()
+
+
+def test_generate_top_p(model):
+    """top_p sampling decodes valid tokens; a vanishing nucleus reduces to
+    greedy for both the cached and uncached paths."""
+    import numpy as np
+
+    from paddle_tpu.core.tensor import Tensor
+
+    ids = Tensor(np.array([[5, 3, 9]], np.int32))
+    greedy = model.generate(ids, max_new_tokens=6, do_sample=False)
+    for use_cache in (True, False):
+        tiny_p = model.generate(ids, max_new_tokens=6, do_sample=True,
+                                top_p=1e-6, seed=7, use_cache=use_cache)
+        np.testing.assert_array_equal(tiny_p.numpy(), greedy.numpy())
+        sampled = model.generate(ids, max_new_tokens=6, do_sample=True,
+                                 top_p=0.9, seed=3, use_cache=use_cache)
+        assert sampled.numpy().shape == (1, 9)
+        assert (sampled.numpy() >= 0).all()
