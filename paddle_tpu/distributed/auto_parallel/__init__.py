@@ -262,7 +262,11 @@ class Engine:
             return self.loss(out, args[-1])
 
         if mode == "train":
-            self._step = TrainStep(loss_fn, self.optimizer, layers=self.model)
+            # strategy.gradient_merge_k compiles k-microbatch accumulation
+            # into the one step program (global batch = k * fed batch)
+            self._step = TrainStep(
+                loss_fn, self.optimizer, layers=self.model,
+                accumulate_steps=max(1, int(s.gradient_merge_k)))
         return self
 
     # -------------------------------------------------------------- tuner

@@ -256,3 +256,22 @@ class TestPassFramework:
         l0 = float(ts(Tensor(x), Tensor(y))._data)
         l1 = float(ts(Tensor(x), Tensor(y))._data)
         assert l1 < l0
+
+
+class TestEngineGradientMerge:
+    def test_strategy_gradient_merge_k_reaches_train_step(self):
+        """auto_parallel Strategy.gradient_merge_k compiles into the
+        Engine's TrainStep (was a declared-but-dead knob)."""
+        from paddle_tpu.distributed.auto_parallel import Engine, Strategy
+
+        x, y = _data(n=32, din=8, dout=1)
+        m = _mlp(seed=12, din=8, dout=1)
+        o = AdamW(learning_rate=5e-3, parameters=m.parameters())
+        eng = Engine(m, loss=lambda out, t: ((out - t) ** 2).mean(),
+                     optimizer=o,
+                     strategy=Strategy(dp_degree=8, gradient_merge_k=2))
+        eng.prepare()
+        assert eng._step._accumulate_steps == 2
+        data = [(Tensor(x), Tensor(y)) for _ in range(2)]
+        hist = eng.fit(data, epochs=15, verbose=0)
+        assert hist[-1] < 0.5 * hist[0]
