@@ -47,7 +47,11 @@ _UNARY = {
     "floor": jnp.floor,
     "ceil": jnp.ceil,
     "round": jnp.round,
-    "sign": jnp.sign,
+    # reference kernel computes (0 < x) - (x < 0): sign(NaN) == 0, unlike
+    # numpy/jnp's NaN-propagating sign (caught by the op fuzz battery)
+    "sign": lambda x: (jnp.where(jnp.isnan(x), 0, jnp.sign(x))
+                       if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
+                       else jnp.sign(x)),
     "reciprocal": jnp.reciprocal,
     "erf": jax.scipy.special.erf,
     "erfinv": jax.scipy.special.erfinv,

@@ -1,0 +1,171 @@
+"""Property-style fuzz battery: random shapes (incl. rank-0, zero-size,
+broadcast pairs), NaN/Inf propagation, and dtype promotion across the
+elementwise/reduction/comparison op surface, checked against torch CPU.
+
+Complements the fixed-case golden batteries (SURVEY.md §4): those pin known
+contracts; this sweeps the shape/value space where silent divergences hide
+(reduction over empty axes, -0.0, inf-inf, broadcasting against size-1 and
+size-0 dims). Seeded — failures reproduce.
+"""
+import numpy as np
+import pytest
+import torch
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+
+RNG = np.random.default_rng(20260731)
+
+# shape pool: scalars, vectors, matrices, zero-size, higher-rank
+SHAPES = [(), (1,), (7,), (0,), (3, 4), (1, 5), (2, 0, 3), (2, 3, 4),
+          (1, 1, 6)]
+
+
+def _rand(shape, with_specials=False):
+    x = RNG.standard_normal(shape).astype(np.float32)
+    if with_specials and x.size >= 4:
+        flat = x.reshape(-1)
+        flat[0] = np.nan
+        flat[1] = np.inf
+        flat[2] = -np.inf
+        flat[3] = -0.0
+        x = flat.reshape(shape)
+    return x
+
+
+UNARY = [
+    ("abs", paddle.abs, torch.abs),
+    ("exp", paddle.exp, torch.exp),
+    ("log", paddle.log, torch.log),
+    ("sqrt", paddle.sqrt, torch.sqrt),
+    ("tanh", paddle.tanh, torch.tanh),
+    ("sin", paddle.sin, torch.sin),
+    ("floor", paddle.floor, torch.floor),
+    ("ceil", paddle.ceil, torch.ceil),
+    ("round", paddle.round, torch.round),
+    ("sign", paddle.sign, torch.sign),
+    ("expm1", paddle.expm1, torch.expm1),
+    ("log1p", paddle.log1p, torch.log1p),
+    ("rsqrt", paddle.rsqrt, torch.rsqrt),
+    ("sigmoid", paddle.nn.functional.sigmoid, torch.sigmoid),
+    ("erf", paddle.erf, torch.erf),
+]
+
+
+@pytest.mark.parametrize("name,pfn,tfn", UNARY, ids=[u[0] for u in UNARY])
+def test_unary_fuzz(name, pfn, tfn):
+    for shape in SHAPES:
+        for specials in (False, True):
+            x = _rand(shape, with_specials=specials)
+            got = np.asarray(pfn(Tensor(x))._data)
+            want = tfn(torch.from_numpy(x.copy())).numpy()
+            np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-6,
+                                       equal_nan=True,
+                                       err_msg=f"{name} shape={shape} "
+                                               f"specials={specials}")
+
+
+BINARY = [
+    ("add", paddle.add, torch.add),
+    ("subtract", paddle.subtract, torch.subtract),
+    ("multiply", paddle.multiply, torch.multiply),
+    ("divide", paddle.divide, torch.divide),
+    ("maximum", paddle.maximum, torch.maximum),
+    ("minimum", paddle.minimum, torch.minimum),
+    ("pow", paddle.pow, torch.pow),
+    ("atan2", paddle.atan2, torch.atan2),
+    ("fmax", paddle.fmax, torch.fmax),
+    ("fmin", paddle.fmin, torch.fmin),
+]
+
+# broadcastable shape pairs, incl. zero-size and size-1 interplay
+PAIRS = [((3, 4), (3, 4)), ((3, 4), (1, 4)), ((3, 4), (4,)), ((3, 1), (1, 4)),
+         ((), (3, 2)), ((2, 0, 3), (1, 3)), ((5,), ())]
+
+
+@pytest.mark.parametrize("name,pfn,tfn", BINARY, ids=[b[0] for b in BINARY])
+def test_binary_fuzz(name, pfn, tfn):
+    for sa, sb in PAIRS:
+        for specials in (False, True):
+            a = _rand(sa, with_specials=specials)
+            b = _rand(sb)
+            got = np.asarray(pfn(Tensor(a), Tensor(b))._data)
+            want = tfn(torch.from_numpy(a.copy()),
+                       torch.from_numpy(b.copy())).numpy()
+            np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-6,
+                                       equal_nan=True,
+                                       err_msg=f"{name} {sa}x{sb} "
+                                               f"specials={specials}")
+
+
+REDUCTIONS = [
+    ("sum", paddle.sum, torch.sum),
+    ("mean", paddle.mean, torch.mean),
+    ("max", paddle.max, torch.amax),
+    ("min", paddle.min, torch.amin),
+    ("prod", paddle.prod, torch.prod),
+]
+
+
+@pytest.mark.parametrize("name,pfn,tfn", REDUCTIONS,
+                         ids=[r[0] for r in REDUCTIONS])
+def test_reduction_fuzz(name, pfn, tfn):
+    for shape in [(3, 4), (2, 3, 4), (1, 5), (4,)]:
+        x = _rand(shape, with_specials=True)
+        for axis in [None] + list(range(len(shape))):
+            for keepdim in (False, True):
+                if axis is None:
+                    if keepdim:
+                        continue
+                    got = np.asarray(pfn(Tensor(x))._data)
+                    want = tfn(torch.from_numpy(x.copy())).numpy()
+                else:
+                    got = np.asarray(pfn(Tensor(x), axis=axis,
+                                         keepdim=keepdim)._data)
+                    want = tfn(torch.from_numpy(x.copy()), dim=axis,
+                               keepdim=keepdim).numpy()
+                np.testing.assert_allclose(
+                    got, want, rtol=2e-5, atol=1e-5, equal_nan=True,
+                    err_msg=f"{name} shape={shape} axis={axis} "
+                            f"keepdim={keepdim}")
+
+
+def test_reduction_empty_semantics():
+    """Reductions over zero-size inputs follow the identity-element
+    contract (sum->0, prod->1, mean->nan), matching torch."""
+    x = np.zeros((0, 3), np.float32)
+    assert float(paddle.sum(Tensor(x))) == 0.0
+    assert float(paddle.prod(Tensor(x))) == 1.0
+    assert np.isnan(float(paddle.mean(Tensor(x))))
+    np.testing.assert_array_equal(
+        np.asarray(paddle.sum(Tensor(x), axis=0)._data),
+        torch.sum(torch.from_numpy(x.copy()), dim=0).numpy())
+
+
+COMPARISONS = [
+    ("equal", paddle.equal, torch.eq),
+    ("less_than", paddle.less_than, torch.lt),
+    ("greater_than", paddle.greater_than, torch.gt),
+    ("not_equal", paddle.not_equal, torch.ne),
+]
+
+
+@pytest.mark.parametrize("name,pfn,tfn", COMPARISONS,
+                         ids=[c[0] for c in COMPARISONS])
+def test_comparison_fuzz_with_nan(name, pfn, tfn):
+    a = _rand((4, 4), with_specials=True)
+    b = a.copy()
+    b[0, 0] = 1.0  # break one equality; NaN rows keep IEEE semantics
+    got = np.asarray(pfn(Tensor(a), Tensor(b))._data)
+    want = tfn(torch.from_numpy(a.copy()), torch.from_numpy(b.copy())).numpy()
+    np.testing.assert_array_equal(got, want)
+
+
+def test_division_special_values():
+    """x/0 -> ±inf, 0/0 -> nan, matching IEEE + torch."""
+    a = np.array([1.0, -1.0, 0.0, np.inf], np.float32)
+    b = np.array([0.0, 0.0, 0.0, np.inf], np.float32)
+    got = np.asarray(paddle.divide(Tensor(a), Tensor(b))._data)
+    want = torch.divide(torch.from_numpy(a.copy()),
+                        torch.from_numpy(b.copy())).numpy()
+    np.testing.assert_allclose(got, want, equal_nan=True)
