@@ -112,6 +112,20 @@ for _n, _f in _NONDIFF_UNARY_OUT.items():
     _def_unary(_n, _f, differentiable=False, with_out=True)
 
 
+def _ref_floor_divide(a, b):
+    """Reference FloorDivideFunctor (elementwise_functor.h:594) is C
+    integer division — TRUNCATION toward zero, despite the name (caught by
+    the op fuzz battery: (-7)//2 is -3 there, not numpy's -4). Float
+    inputs keep pythonic floor semantics (the reference registers the
+    kernel for integer dtypes)."""
+    a, b = jnp.asarray(a), jnp.asarray(b)
+    if jnp.issubdtype(jnp.result_type(a, b), jnp.integer):
+        t = jnp.result_type(a, b)
+        a, b = jnp.broadcast_arrays(a.astype(t), b.astype(t))
+        return jax.lax.div(a, b)  # lax integer div truncates (C semantics)
+    return jnp.floor_divide(a, b)
+
+
 # --------------------------------------------------------------- binary ops
 _BINARY = {
     "add": jnp.add,
@@ -126,7 +140,7 @@ _BINARY = {
     "remainder": jnp.remainder,
     "mod": jnp.remainder,
     "floor_mod": jnp.remainder,
-    "floor_divide": jnp.floor_divide,
+    "floor_divide": lambda a, b: _ref_floor_divide(a, b),
     "atan2": jnp.arctan2,
     "hypot": jnp.hypot,
     "heaviside": jnp.heaviside,

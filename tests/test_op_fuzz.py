@@ -169,3 +169,28 @@ def test_division_special_values():
     want = torch.divide(torch.from_numpy(a.copy()),
                         torch.from_numpy(b.copy())).numpy()
     np.testing.assert_allclose(got, want, equal_nan=True)
+
+
+def test_integer_division_reference_semantics():
+    """The reference's FloorDivideFunctor is C integer division (TRUNC
+    toward zero — ref:paddle/phi/kernels/funcs/elementwise_functor.h:594),
+    and RemainderFunctor (:527) is floor-mod (divisor's sign). Negative
+    operands separate the two conventions."""
+    a = np.array([7, -7, 7, -7, 9, -9], np.int32)
+    b = np.array([2, 2, -2, -2, 4, 4], np.int32)
+    got = np.asarray(paddle.floor_divide(Tensor(a), Tensor(b))._data)
+    np.testing.assert_array_equal(got, [3, -3, -3, 3, 2, -2])  # trunc
+    got = np.asarray(paddle.mod(Tensor(a), Tensor(b))._data)
+    np.testing.assert_array_equal(got, [1, 1, -1, -1, 1, 3])  # floor-mod
+    # operator forms route the same way
+    got = np.asarray((Tensor(a) // Tensor(b))._data)
+    np.testing.assert_array_equal(got, [3, -3, -3, 3, 2, -2])
+    # floats keep pythonic floor (the reference registers ints only)
+    fa = np.array([-7.0, 7.0], np.float32)
+    fb = np.array([2.0, -2.0], np.float32)
+    got = np.asarray(paddle.floor_divide(Tensor(fa), Tensor(fb))._data)
+    np.testing.assert_array_equal(got, [-4.0, -4.0])
+    # float mod matches torch.remainder (divisor-sign contract)
+    fm = np.asarray(paddle.mod(Tensor(fa), Tensor(fb))._data)
+    np.testing.assert_allclose(
+        fm, torch.remainder(torch.from_numpy(fa), torch.from_numpy(fb)).numpy())
