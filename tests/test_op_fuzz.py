@@ -194,3 +194,88 @@ def test_integer_division_reference_semantics():
     fm = np.asarray(paddle.mod(Tensor(fa), Tensor(fb))._data)
     np.testing.assert_allclose(
         fm, torch.remainder(torch.from_numpy(fa), torch.from_numpy(fb)).numpy())
+
+
+ACTIVATIONS = [
+    # (name, paddle fn, torch fn) — defaults must agree
+    ("relu", paddle.nn.functional.relu, torch.nn.functional.relu),
+    ("relu6", paddle.nn.functional.relu6, torch.nn.functional.relu6),
+    ("gelu_exact", lambda x: paddle.nn.functional.gelu(x),
+     lambda x: torch.nn.functional.gelu(x)),
+    ("gelu_tanh", lambda x: paddle.nn.functional.gelu(x, approximate=True),
+     lambda x: torch.nn.functional.gelu(x, approximate="tanh")),
+    ("silu", paddle.nn.functional.silu, torch.nn.functional.silu),
+    ("mish", paddle.nn.functional.mish, torch.nn.functional.mish),
+    ("softplus", paddle.nn.functional.softplus,
+     torch.nn.functional.softplus),
+    ("hardswish", paddle.nn.functional.hardswish,
+     torch.nn.functional.hardswish),
+    ("hardsigmoid", paddle.nn.functional.hardsigmoid,
+     lambda x: torch.clamp(x / 6 + 0.5, 0, 1)),  # paddle slope=1/6 offset=.5
+    ("elu", paddle.nn.functional.elu, torch.nn.functional.elu),
+    ("selu", paddle.nn.functional.selu, torch.nn.functional.selu),
+    ("leaky_relu", lambda x: paddle.nn.functional.leaky_relu(x, 0.01),
+     lambda x: torch.nn.functional.leaky_relu(x, 0.01)),
+    ("log_sigmoid", paddle.nn.functional.log_sigmoid,
+     torch.nn.functional.logsigmoid),
+    ("tanhshrink", paddle.nn.functional.tanhshrink,
+     torch.nn.functional.tanhshrink),
+    ("softsign", paddle.nn.functional.softsign,
+     torch.nn.functional.softsign),
+]
+
+
+@pytest.mark.parametrize("name,pfn,tfn", ACTIVATIONS,
+                         ids=[a[0] for a in ACTIVATIONS])
+def test_activation_fuzz(name, pfn, tfn):
+    """Default-parameter activations match torch over wide magnitudes
+    (large |x| exposes approximate-vs-exact formulations and overflow
+    handling in softplus/mish)."""
+    for scale in (1.0, 10.0, 100.0):
+        x = (_rand((64,)) * scale).astype(np.float32)
+        got = np.asarray(pfn(Tensor(x))._data)
+        want = tfn(torch.from_numpy(x.copy())).numpy()
+        np.testing.assert_allclose(got, want, rtol=3e-5, atol=1e-5,
+                                   err_msg=f"{name} scale={scale}")
+
+
+def test_softmax_edge_rows():
+    """Softmax rows of -inf (fully masked) and mixed inf behave like
+    torch: all -inf -> nan row (0/0), one finite -> one-hot."""
+    x = np.array([[-np.inf, -np.inf, -np.inf],
+                  [1.0, -np.inf, -np.inf],
+                  [1000.0, 999.0, -1000.0]], np.float32)
+    got = np.asarray(paddle.nn.functional.softmax(Tensor(x), axis=-1)._data)
+    want = torch.softmax(torch.from_numpy(x.copy()), dim=-1).numpy()
+    np.testing.assert_allclose(got, want, atol=1e-6, equal_nan=True)
+
+
+def test_cumsum_cumprod_with_nan():
+    x = _rand((3, 5), with_specials=True)
+    np.testing.assert_allclose(
+        np.asarray(paddle.cumsum(Tensor(x), axis=1)._data),
+        torch.cumsum(torch.from_numpy(x.copy()), dim=1).numpy(),
+        rtol=1e-5, equal_nan=True)
+    np.testing.assert_allclose(
+        np.asarray(paddle.cumprod(Tensor(x), dim=1)._data),
+        torch.cumprod(torch.from_numpy(x.copy()), dim=1).numpy(),
+        rtol=1e-5, equal_nan=True)
+
+
+def test_clip_with_nan_and_reversed_bounds():
+    x = _rand((8,), with_specials=True)
+    got = np.asarray(paddle.clip(Tensor(x), -0.5, 0.5)._data)
+    want = torch.clamp(torch.from_numpy(x.copy()), -0.5, 0.5).numpy()
+    np.testing.assert_allclose(got, want, equal_nan=True)
+    # min > max: torch/paddle contract clamps to max
+    got = np.asarray(paddle.clip(Tensor(x), 1.0, -1.0)._data)
+    want = torch.clamp(torch.from_numpy(x.copy()), 1.0, -1.0).numpy()
+    np.testing.assert_allclose(got, want, equal_nan=True)
+
+
+def test_logsumexp_extremes():
+    x = np.array([[-np.inf, -np.inf], [1000.0, 1000.0], [0.0, -np.inf]],
+                 np.float32)
+    got = np.asarray(paddle.logsumexp(Tensor(x), axis=1)._data)
+    want = torch.logsumexp(torch.from_numpy(x.copy()), dim=1).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-6)
