@@ -300,24 +300,37 @@ def take_along_axis(arr, indices, axis, broadcast=True):
 
 
 def put_along_axis(arr, indices, values, axis, reduce="assign", include_self=True, broadcast=True):
-    def _paa(x, idx, v, *, axis, mode):
+    """ref:python/paddle/tensor/manipulation.py:4603 — reduce in
+    {'assign','add','mul','multiply'} with TRUE scatter semantics:
+    duplicate indices accumulate for add/mul (the phi kernel is a
+    scatter-add; a gather-modify-scatter drops duplicate contributions —
+    caught by the op fuzz battery). include_self=False excludes the
+    original values at touched positions (later-reference extension)."""
+
+    def _paa(x, idx, v, *, axis, mode, include_self):
         v = jnp.broadcast_to(v, idx.shape).astype(x.dtype)
         if mode == "assign":
             return jnp.put_along_axis(x, idx, v, axis=axis, inplace=False)
-        dims = [i for i in range(x.ndim)]
-        # scatter-add/mul via segment ops on flattened representation
-        upd = jnp.zeros_like(x)
-        upd = jnp.put_along_axis(upd, idx, v, axis=axis, inplace=False)
+        # full fancy-index tuple selecting idx positions along `axis`
+        grids = list(jnp.meshgrid(*[jnp.arange(s) for s in idx.shape],
+                                  indexing="ij"))
+        grids[axis] = idx
+        loc = tuple(grids)
+        touched = jnp.zeros(x.shape, bool).at[loc].set(True)
         if mode == "add":
-            return x + upd
-        if mode == "mul":
-            mask = jnp.put_along_axis(jnp.zeros_like(x, dtype=bool), idx, True, axis=axis, inplace=False)
-            return jnp.where(mask, x * v if v.shape == x.shape else x * upd, x)
-        raise ValueError(mode)
+            base = x if include_self else jnp.where(touched, 0, x)
+            return base.at[loc].add(v)
+        if mode in ("mul", "multiply"):
+            base = x if include_self else jnp.where(
+                touched, jnp.ones_like(x), x)
+            return base.at[loc].multiply(v)
+        raise ValueError(f"unsupported reduce mode {mode!r}")
 
     if not isinstance(values, Tensor):
         values = Tensor(jnp.asarray(values))
-    return apply(_paa, (arr, indices, values), dict(axis=int(axis), mode=reduce))
+    return apply(_paa, (arr, indices, values),
+                 dict(axis=int(axis), mode=reduce,
+                      include_self=bool(include_self)))
 
 
 def index_select(x, index, axis=0, name=None):

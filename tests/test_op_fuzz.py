@@ -279,3 +279,99 @@ def test_logsumexp_extremes():
     got = np.asarray(paddle.logsumexp(Tensor(x), axis=1)._data)
     want = torch.logsumexp(torch.from_numpy(x.copy()), dim=1).numpy()
     np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+class TestIndexingFuzz:
+    """Gather/scatter family vs torch analogs: negative indices, duplicate
+    scatter targets (paddle overwrite=False ACCUMULATES), axis variants."""
+
+    def test_gather_and_index_select(self):
+        x = _rand((5, 4))
+        idx = np.array([3, 0, 3, 1], np.int64)
+        np.testing.assert_allclose(
+            np.asarray(paddle.gather(Tensor(x), Tensor(idx))._data),
+            torch.index_select(torch.from_numpy(x.copy()), 0,
+                               torch.from_numpy(idx)).numpy())
+        np.testing.assert_allclose(
+            np.asarray(paddle.index_select(Tensor(x), Tensor(idx),
+                                           axis=1)._data),
+            torch.index_select(torch.from_numpy(x.copy()), 1,
+                               torch.from_numpy(idx)).numpy())
+
+    def test_scatter_overwrite_and_accumulate(self):
+        x = np.zeros((5, 3), np.float32)
+        idx = np.array([1, 3, 1], np.int64)  # duplicate target row 1
+        upd = np.arange(9, dtype=np.float32).reshape(3, 3) + 1
+        # overwrite=False: duplicates ACCUMULATE onto x (paddle contract)
+        got = np.asarray(paddle.scatter(Tensor(x), Tensor(idx), Tensor(upd),
+                                        overwrite=False)._data)
+        want = x.copy()
+        np.add.at(want, idx, upd)
+        np.testing.assert_allclose(got, want)
+        # overwrite=True with unique indices == torch index_copy
+        idx_u = np.array([4, 0, 2], np.int64)
+        got = np.asarray(paddle.scatter(Tensor(x), Tensor(idx_u), Tensor(upd),
+                                        overwrite=True)._data)
+        want = torch.zeros(5, 3).index_copy_(
+            0, torch.from_numpy(idx_u), torch.from_numpy(upd)).numpy()
+        np.testing.assert_allclose(got, want)
+
+    def test_take_along_and_put_along_axis(self):
+        x = _rand((4, 6))
+        idx = RNG.integers(0, 6, (4, 3)).astype(np.int64)
+        np.testing.assert_allclose(
+            np.asarray(paddle.take_along_axis(Tensor(x), Tensor(idx),
+                                              axis=1)._data),
+            torch.gather(torch.from_numpy(x.copy()), 1,
+                         torch.from_numpy(idx)).numpy())
+        v = _rand((4, 3))
+        got = np.asarray(paddle.put_along_axis(
+            Tensor(x), Tensor(idx), Tensor(v), axis=1, reduce="add")._data)
+        want = torch.from_numpy(x.copy()).scatter_add(
+            1, torch.from_numpy(idx), torch.from_numpy(v)).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    def test_index_add_and_index_put(self):
+        x = _rand((5, 3))
+        idx = np.array([0, 2, 0], np.int64)
+        v = _rand((3, 3))
+        got = np.asarray(paddle.index_add(Tensor(x), Tensor(idx), 0,
+                                          Tensor(v))._data)
+        want = torch.from_numpy(x.copy()).index_add(
+            0, torch.from_numpy(idx), torch.from_numpy(v)).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    def test_masked_select_and_where(self):
+        x = _rand((4, 4), with_specials=True)
+        m = x > 0
+        np.testing.assert_allclose(
+            np.asarray(paddle.masked_select(Tensor(x), Tensor(m))._data),
+            torch.masked_select(torch.from_numpy(x.copy()),
+                                torch.from_numpy(m)).numpy(), equal_nan=True)
+        np.testing.assert_allclose(
+            np.asarray(paddle.where(Tensor(m), Tensor(x),
+                                    Tensor(np.zeros_like(x)))._data),
+            torch.where(torch.from_numpy(m), torch.from_numpy(x.copy()),
+                        torch.zeros(4, 4)).numpy(), equal_nan=True)
+
+    def test_negative_gather_indices(self):
+        """paddle.gather follows numpy-style negative indexing on this
+        stack (jnp contract); pin it so it can't silently change."""
+        x = _rand((5, 2))
+        got = np.asarray(paddle.gather(Tensor(x),
+                                       Tensor(np.array([-1], np.int64)))._data)
+        np.testing.assert_allclose(got, x[[-1]])
+
+    def test_put_along_axis_mul_and_include_self(self):
+        x = np.full((2, 4), 2.0, np.float32)
+        idx = np.array([[1, 1], [0, 3]], np.int64)
+        v = np.full((2, 2), 3.0, np.float32)
+        # mul with duplicate targets multiplies BOTH updates in
+        got = np.asarray(paddle.put_along_axis(
+            Tensor(x), Tensor(idx), Tensor(v), axis=1, reduce="mul")._data)
+        np.testing.assert_allclose(got, [[2, 18, 2, 2], [6, 2, 2, 6]])
+        # include_self=False: only the updates at touched positions
+        got = np.asarray(paddle.put_along_axis(
+            Tensor(x), Tensor(idx), Tensor(v), axis=1, reduce="add",
+            include_self=False)._data)
+        np.testing.assert_allclose(got, [[2, 6, 2, 2], [3, 2, 2, 3]])
