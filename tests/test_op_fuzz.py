@@ -375,3 +375,114 @@ class TestIndexingFuzz:
             Tensor(x), Tensor(idx), Tensor(v), axis=1, reduce="add",
             include_self=False)._data)
         np.testing.assert_allclose(got, [[2, 6, 2, 2], [3, 2, 2, 3]])
+
+
+class TestLossFuzz:
+    """Loss functionals vs torch: ignore_index bookkeeping, extreme-logit
+    stability, reduction semantics, pos_weight broadcasting."""
+
+    def test_cross_entropy_ignore_index(self):
+        logits = _rand((6, 5))
+        labels = np.array([0, 4, -100, 2, -100, 1], np.int64)
+        got = float(paddle.nn.functional.cross_entropy(
+            Tensor(logits), Tensor(labels), ignore_index=-100))
+        want = float(torch.nn.functional.cross_entropy(
+            torch.from_numpy(logits.copy()), torch.from_numpy(labels),
+            ignore_index=-100))
+        assert got == pytest.approx(want, rel=1e-5)
+        # all-ignored: the REFERENCE guards the zero count to 0.0
+        # (ref:python/paddle/nn/functional/loss.py:2860
+        # `out_sum / (count + (count == 0.0))`) where torch yields NaN —
+        # pin the reference convention
+        labels_all = np.full((6,), -100, np.int64)
+        got = float(paddle.nn.functional.cross_entropy(
+            Tensor(logits), Tensor(labels_all), ignore_index=-100))
+        assert got == 0.0
+
+    def test_cross_entropy_weight_and_none_reduction(self):
+        logits = _rand((4, 3))
+        labels = np.array([2, 0, 1, 2], np.int64)
+        w = np.array([0.2, 1.0, 3.0], np.float32)
+        got = np.asarray(paddle.nn.functional.cross_entropy(
+            Tensor(logits), Tensor(labels), weight=Tensor(w),
+            reduction="none")._data)
+        want = torch.nn.functional.cross_entropy(
+            torch.from_numpy(logits.copy()), torch.from_numpy(labels),
+            weight=torch.from_numpy(w), reduction="none").numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+        # weighted mean divides by the sum of selected weights
+        got = float(paddle.nn.functional.cross_entropy(
+            Tensor(logits), Tensor(labels), weight=Tensor(w)))
+        want = float(torch.nn.functional.cross_entropy(
+            torch.from_numpy(logits.copy()), torch.from_numpy(labels),
+            weight=torch.from_numpy(w)))
+        assert got == pytest.approx(want, rel=1e-5)
+
+    def test_bce_with_logits_extremes(self):
+        logits = np.array([[-100.0, 100.0, 0.0, 30.0]], np.float32)
+        target = np.array([[0.0, 1.0, 0.5, 0.0]], np.float32)
+        pw = np.array([2.0, 0.5, 1.0, 3.0], np.float32)
+        got = np.asarray(paddle.nn.functional.binary_cross_entropy_with_logits(
+            Tensor(logits), Tensor(target), pos_weight=Tensor(pw),
+            reduction="none")._data)
+        want = torch.nn.functional.binary_cross_entropy_with_logits(
+            torch.from_numpy(logits.copy()), torch.from_numpy(target),
+            pos_weight=torch.from_numpy(pw), reduction="none").numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+        assert np.isfinite(got).all()  # the log-sum-exp form must not overflow
+
+    def test_smooth_l1_and_huber_deltas(self):
+        x = _rand((16,)) * 3
+        y = _rand((16,)) * 3
+        # paddle smooth_l1_loss(delta): torch huber_loss/delta relation
+        for delta in (0.5, 1.0, 2.0):
+            got = float(paddle.nn.functional.smooth_l1_loss(
+                Tensor(x), Tensor(y), delta=delta))
+            want = float(torch.nn.functional.smooth_l1_loss(
+                torch.from_numpy(x.copy()), torch.from_numpy(y.copy()),
+                beta=delta))
+            # paddle's smooth_l1 is huber (delta-scaled), torch's is beta-
+            # normalized: huber = beta * smooth_l1_torch
+            assert got == pytest.approx(want * delta, rel=1e-4), delta
+
+    def test_kl_div_reductions(self):
+        p_log = np.log(np.array([[0.2, 0.3, 0.5], [0.6, 0.3, 0.1]],
+                                np.float32))
+        q = np.array([[0.1, 0.4, 0.5], [0.3, 0.3, 0.4]], np.float32)
+        for red in ("none", "sum", "mean", "batchmean"):
+            got = paddle.nn.functional.kl_div(Tensor(p_log), Tensor(q),
+                                              reduction=red)
+            want = torch.nn.functional.kl_div(
+                torch.from_numpy(p_log.copy()), torch.from_numpy(q),
+                reduction=red)
+            np.testing.assert_allclose(
+                np.asarray(got._data), want.numpy(), rtol=1e-5,
+                err_msg=f"reduction={red}")
+
+    def test_nll_and_log_softmax_chain(self):
+        logits = _rand((5, 7))
+        labels = RNG.integers(0, 7, (5,)).astype(np.int64)
+        lp = paddle.nn.functional.log_softmax(Tensor(logits), axis=-1)
+        got = float(paddle.nn.functional.nll_loss(lp, Tensor(labels)))
+        want = float(torch.nn.functional.nll_loss(
+            torch.log_softmax(torch.from_numpy(logits.copy()), -1),
+            torch.from_numpy(labels)))
+        assert got == pytest.approx(want, rel=1e-5)
+
+    def test_mse_l1_reduction_matrix(self):
+        a, b = _rand((3, 4)), _rand((3, 4))
+        for red in ("none", "mean", "sum"):
+            got = paddle.nn.functional.mse_loss(Tensor(a), Tensor(b),
+                                                reduction=red)
+            want = torch.nn.functional.mse_loss(
+                torch.from_numpy(a.copy()), torch.from_numpy(b.copy()),
+                reduction=red)
+            np.testing.assert_allclose(np.asarray(got._data), want.numpy(),
+                                       rtol=1e-5)
+            got = paddle.nn.functional.l1_loss(Tensor(a), Tensor(b),
+                                               reduction=red)
+            want = torch.nn.functional.l1_loss(
+                torch.from_numpy(a.copy()), torch.from_numpy(b.copy()),
+                reduction=red)
+            np.testing.assert_allclose(np.asarray(got._data), want.numpy(),
+                                       rtol=1e-5)
