@@ -33,11 +33,11 @@ def export(layer, path, input_spec=None, opset_version=17, **configs):
 
     if input_spec is None:
         raise ValueError("paddle.onnx.export requires input_spec")
-    if not 13 <= opset_version <= 17:
-        # >=13: Squeeze/ReduceSum take axes as input; <=17: ReduceMax/Min/
-        # Prod still take axes as an attribute (they switch to axes-as-input
-        # at opset 18, which the emitter does not produce).
-        raise ValueError("opset_version must be in [13, 17]")
+    if not 13 <= opset_version <= 19:
+        # >=13: Squeeze/ReduceSum take axes as input. The emitter switches
+        # the rest of the Reduce family to axes-as-input at opset >= 18;
+        # every other op it produces is form-stable through opset 19.
+        raise ValueError("opset_version must be in [13, 19]")
 
     was_training = getattr(layer, "training", False)
     if hasattr(layer, "eval"):

@@ -113,8 +113,9 @@ class _Graph:
 
 
 class _Converter:
-    def __init__(self, graph: _Graph):
+    def __init__(self, graph: _Graph, opset: int = 17):
         self.G = graph
+        self.opset = int(opset)
         self.env = {}
 
     # ---------------------------------------------------------------- util
@@ -150,7 +151,7 @@ class _Converter:
                 closed = sub if hasattr(sub, "jaxpr") else None
                 inner = closed.jaxpr if closed else sub
                 consts = closed.consts if closed else []
-                inner_conv = _Converter(self.G)
+                inner_conv = _Converter(self.G, self.opset)
                 names = [self.read(v) for v in eqn.invars]
                 # custom_jvp passes num_consts leading args in invars already
                 outs = inner_conv.run(inner, consts, names[-len(inner.invars):])
@@ -428,18 +429,19 @@ class _Converter:
                         group=int(e.params["feature_group_count"])))
 
     # --------------------------------------------------------- reductions
+    def _reduce_node(self, op, x, axes):
+        """ReduceSum takes axes as an input from opset 13; the other
+        Reduce* ops gained the input form at opset 18 — emit whichever
+        form the declared opset requires."""
+        if op == "ReduceSum" or self.opset >= 18:
+            return self.G.node(op, [x, self.G.const_i64(list(axes))],
+                               keepdims=0)
+        return self.G.node(op, [x], axes=list(axes), keepdims=0)
+
     def _reduce(self, e, op):
-        # ReduceSum takes axes as an input from opset 13; the other Reduce*
-        # ops only gained the input form in opset 18 — use the attribute
-        if op == "ReduceSum":
-            axes = self.G.const_i64(e.params["axes"])
-            self.write(e.outvars[0],
-                       self.G.node(op, [self.read(e.invars[0]), axes],
-                                   keepdims=0))
-        else:
-            self.write(e.outvars[0],
-                       self.G.node(op, [self.read(e.invars[0])],
-                                   axes=list(e.params["axes"]), keepdims=0))
+        self.write(e.outvars[0],
+                   self._reduce_node(op, self.read(e.invars[0]),
+                                     e.params["axes"]))
 
     def op_reduce_sum(self, e):
         self._reduce(e, "ReduceSum")
@@ -456,16 +458,14 @@ class _Converter:
     def op_reduce_and(self, e):
         x = self.G.node("Cast", [self.read(e.invars[0])],
                         to=P.TensorProto.INT32)
-        m = self.G.node("ReduceMin", [x], axes=list(e.params["axes"]),
-                        keepdims=0)
+        m = self._reduce_node("ReduceMin", x, e.params["axes"])
         self.write(e.outvars[0],
                    self.G.node("Cast", [m], to=P.TensorProto.BOOL))
 
     def op_reduce_or(self, e):
         x = self.G.node("Cast", [self.read(e.invars[0])],
                         to=P.TensorProto.INT32)
-        m = self.G.node("ReduceMax", [x], axes=list(e.params["axes"]),
-                        keepdims=0)
+        m = self._reduce_node("ReduceMax", x, e.params["axes"])
         self.write(e.outvars[0],
                    self.G.node("Cast", [m], to=P.TensorProto.BOOL))
 
@@ -568,7 +568,7 @@ def to_onnx_model(fn, example_args, *, graph_name="paddle_tpu",
         n = f"input_{i}"
         names.append(n)
         G.value_info(G.g.input, n, v.aval)
-    conv = _Converter(G)
+    conv = _Converter(G, opset_version)
     outs = conv.run(jaxpr, closed.consts, names)
     for n, v in zip(outs, jaxpr.outvars):
         G.value_info(G.g.output, n, v.aval)

@@ -160,10 +160,13 @@ def run_onnx(model: "P.ModelProto", feeds: dict):
         elif op in ("ReduceSum", "ReduceMax", "ReduceMin", "ReduceProd"):
             fn = {"ReduceSum": np.sum, "ReduceMax": np.max,
                   "ReduceMin": np.min, "ReduceProd": np.prod}[op]
-            # opset-13 contract: ReduceSum takes axes as input[1]; the other
-            # Reduce* ops take the axes attribute (input form is opset 18+)
-            if op == "ReduceSum":
-                assert len(i) == 2, "ReduceSum must carry axes as an input"
+            # ReduceSum takes axes as input[1] from opset 13; the rest of
+            # the Reduce family switches to the input form at opset 18 —
+            # enforce the form the model's DECLARED opset requires
+            opset = model.opset_import[0].version
+            if op == "ReduceSum" or opset >= 18:
+                assert len(i) == 2, \
+                    f"{op} must carry axes as an input at opset {opset}"
                 axes = tuple(int(a) for a in i[1])
             else:
                 assert len(i) == 1, f"{op} axes-as-input needs opset 18"
@@ -175,16 +178,17 @@ def run_onnx(model: "P.ModelProto", feeds: dict):
     return [env[o.name] for o in model.graph.output]
 
 
-def _export_and_check(layer, specs, feeds, atol=1e-5):
+def _export_and_check(layer, specs, feeds, atol=1e-5, opset_version=17):
     import tempfile
 
     layer.eval()
     ref = layer(*[paddle.to_tensor(f) for f in feeds])
     with tempfile.TemporaryDirectory() as td:
-        path = paddle.onnx.export(layer, f"{td}/m", input_spec=specs)
+        path = paddle.onnx.export(layer, f"{td}/m", input_spec=specs,
+                                  opset_version=opset_version)
         m = P.ModelProto()
         m.ParseFromString(open(path, "rb").read())
-    assert m.ir_version == 8 and m.opset_import[0].version == 17
+    assert m.ir_version == 8 and m.opset_import[0].version == opset_version
     outs = run_onnx(m, {v.name: f for v, f in zip(m.graph.input, feeds)})
     np.testing.assert_allclose(outs[0], ref.numpy(), atol=atol, rtol=1e-4)
     return m
@@ -243,3 +247,27 @@ def test_onnx_export_validations(tmp_path):
     with pytest.raises(UnsupportedOp, match="sort"):
         to_onnx_model(lambda a: jnp.sort(a),
                       (np.zeros((4,), np.float32),))
+
+
+def test_onnx_opset18_reduce_axes_as_input():
+    """Opset 18+ export emits the whole Reduce family with axes as an
+    INPUT (the 13-17 attribute form is invalid ONNX there); numerics
+    verified by the opset-aware interpreter."""
+
+    class Reducer(nn.Layer):
+        def forward(self, x):
+            return (paddle.max(x, axis=1) + paddle.min(x, axis=1)
+                    + paddle.sum(x, axis=1))
+
+    feeds = [np.random.rand(3, 5).astype(np.float32)]
+    m = _export_and_check(Reducer(), [InputSpec([3, 5])], feeds,
+                          opset_version=18)
+    forms = {n.op_type: len(n.input) for n in m.graph.node
+             if n.op_type.startswith("Reduce")}
+    assert forms and all(v == 2 for v in forms.values()), forms
+
+
+def test_onnx_opset_20_rejected():
+    with pytest.raises(ValueError, match=r"\[13, 19\]"):
+        paddle.onnx.export(nn.Linear(4, 2), "/tmp/never",
+                           input_spec=[InputSpec([1, 4])], opset_version=20)
