@@ -1,8 +1,10 @@
 #!/bin/bash
-# Probe-gated retry loop for the remaining round-4 TPU bank. The tunnel came
-# up once this round (bench.py cashed: MFU 0.159 at b16 s1024), then died
-# mid-sequence. Probe every ~50 min; on success run the remaining stages in
-# value order. Stages that already succeeded are skipped via marker files.
+# Probe-gated retry loop for the round-4 TPU bank. The tunnel flaps between
+# alive / fast-fail / hang many times a round, so the probe runs BEFORE
+# EVERY STAGE — a mid-pass tunnel death costs at most the one stage that
+# was running, not the sum of all remaining stage timeouts. Stages that
+# succeeded are skipped via marker files, so passes resume where they left
+# off on the next window.
 set -u
 cd "$(dirname "$0")/.."
 LOGS=benches/tpu_logs
@@ -21,41 +23,51 @@ print(f"TPU alive: {d} matmul in {time.time()-t0:.1f}s")
 PY
 }
 
-run() {  # run <name> <timeout_s> <cmd...> — skipped once marked done
+run() {  # run <name> <timeout_s> <cmd...> — marked done only on success
   local name=$1 t=$2; shift 2
-  [ -f "$MARKS/$name" ] && { echo "[loop] $name already done"; return 0; }
   local STAMP=$(date +%Y%m%d_%H%M%S)
   echo "[loop] $name ..."
   timeout "$t" "$@" > "$LOGS/${name}_$STAMP.log" 2>&1
   local rc=$?
   tail -2 "$LOGS/${name}_$STAMP.log"
   echo "[loop] $name rc=$rc"
-  # mark done only on success so a hang retries next window
   [ "$rc" -eq 0 ] && touch "$MARKS/$name"
   return $rc
 }
 
+# value order; "name timeout cmd..."
+STAGES=(
+  "flash_tpu 2400 python benches/flash_tpu_bench.py"
+  "sweep 10800 python benches/sweep.py"
+  "baseline 7200 python benches/baseline.py lenet resnet50 ernie gpt-hybrid widedeep"
+  "decode 2400 python benches/decode_bench.py"
+  "eager 1800 python tools/eager_bench.py"
+  "hlo_tpu 2400 env HLO_PLATFORM=tpu python tools/hlo_analysis.py"
+  "native 1800 env PADDLE_TPU_NATIVE_TPU_TEST=1 python -m pytest tests/test_native_infer.py -k real_plugin -q"
+)
+
 attempt=0
 while true; do
   attempt=$((attempt + 1))
-  echo "[loop] attempt $attempt $(date)"
-  if probe > "$LOGS/probe_loop_$attempt.log" 2>&1; then
-    cat "$LOGS/probe_loop_$attempt.log"
-    run flash_tpu 2400 python benches/flash_tpu_bench.py
-    run sweep    10800 python benches/sweep.py
-    run baseline  7200 python benches/baseline.py lenet resnet50 ernie gpt-hybrid widedeep
-    run decode    2400 python benches/decode_bench.py
-    run eager     1800 python tools/eager_bench.py
-    run hlo_tpu   2400 env HLO_PLATFORM=tpu python tools/hlo_analysis.py
-    run native    1800 env PADDLE_TPU_NATIVE_TPU_TEST=1 python -m pytest tests/test_native_infer.py -k real_plugin -q
-    if [ -f "$MARKS/flash_tpu" ] && [ -f "$MARKS/sweep" ] && [ -f "$MARKS/baseline" ] \
-       && [ -f "$MARKS/decode" ] && [ -f "$MARKS/eager" ] && [ -f "$MARKS/hlo_tpu" ] \
-       && [ -f "$MARKS/native" ]; then
-      echo "[loop] all stages done"
+  echo "[loop] pass $attempt $(date)"
+  for spec in "${STAGES[@]}"; do
+    read -r name t cmd <<<"$spec"
+    [ -f "$MARKS/$name" ] && continue
+    if ! probe > "$LOGS/probe_${attempt}_${name}.log" 2>&1; then
+      echo "[loop] tunnel down before $name (pass $attempt)"
       break
     fi
-  else
-    echo "[loop] tunnel down (see $LOGS/probe_loop_$attempt.log)"
+    cat "$LOGS/probe_${attempt}_${name}.log"
+    run "$name" "$t" $cmd || true
+  done
+  remaining=0
+  for spec in "${STAGES[@]}"; do
+    read -r name t cmd <<<"$spec"
+    [ -f "$MARKS/$name" ] || remaining=1
+  done
+  if [ "$remaining" -eq 0 ]; then
+    echo "[loop] all stages done $(date)"
+    break
   fi
   sleep 3000
 done
