@@ -129,6 +129,12 @@ inv = inverse
 
 
 def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    """Moore-Penrose pseudo-inverse (reference contract: singular values
+    <= rcond * s_max are zeroed, default 1e-15 — tuned for float64. For
+    float32 rank-deficient inputs pass rcond ~ 1e-6: the default treats
+    f32 round-off singular values (~1e-7 relative) as signal and inverts
+    them into garbage, exactly as the reference/old-torch default does."""
+
     def _pinv(x, *, rcond, hermitian):
         return jnp.linalg.pinv(x, rtol=rcond, hermitian=hermitian)
 

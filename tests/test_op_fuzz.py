@@ -486,3 +486,70 @@ class TestLossFuzz:
                 reduction=red)
             np.testing.assert_allclose(np.asarray(got._data), want.numpy(),
                                        rtol=1e-5)
+
+
+class TestLinalgDegenerate:
+    """Degenerate/rank-deficient inputs across paddle.linalg vs numpy/torch
+    (reconstruction goldens don't exercise these)."""
+
+    def test_pinv_rank_deficient(self):
+        # the reference's rcond default (1e-15) is float64-tuned: f32
+        # round-off singular values get inverted (documented footgun, same
+        # as reference/old torch) — a dtype-appropriate rcond recovers the
+        # Moore-Penrose inverse of the rank-1 matrix
+        a = np.outer(np.arange(1, 5), np.arange(1, 4)).astype(np.float32)
+        got = np.asarray(paddle.linalg.pinv(Tensor(a), rcond=1e-6)._data)
+        want = np.linalg.pinv(a, rcond=1e-6)
+        np.testing.assert_allclose(got, want, atol=1e-5)
+        # Moore-Penrose identities hold for the deficient case
+        np.testing.assert_allclose(a @ got @ a, a, atol=1e-4)
+
+    def test_matrix_rank_with_tolerance(self):
+        a = np.diag([1.0, 0.5, 1e-9, 0.0]).astype(np.float32)
+        assert int(paddle.linalg.matrix_rank(Tensor(a))) == 2
+        assert int(paddle.linalg.matrix_rank(Tensor(a), tol=1e-10)) == 3
+
+    def test_lstsq_overdetermined_and_deficient(self):
+        a = RNG.standard_normal((6, 3)).astype(np.float32)
+        b = RNG.standard_normal((6, 2)).astype(np.float32)
+        sol = paddle.linalg.lstsq(Tensor(a), Tensor(b))[0]
+        want = np.linalg.lstsq(a, b, rcond=None)[0]
+        np.testing.assert_allclose(np.asarray(sol._data), want, atol=1e-4)
+
+    def test_eigh_ascending_and_reconstruction(self):
+        m = RNG.standard_normal((5, 5)).astype(np.float32)
+        s = (m + m.T) / 2
+        w, v = paddle.linalg.eigh(Tensor(s))
+        w_np = np.asarray(w._data)
+        assert (np.diff(w_np) >= -1e-5).all()  # ascending (reference order)
+        rec = np.asarray(v._data) @ np.diag(w_np) @ np.asarray(v._data).T
+        np.testing.assert_allclose(rec, s, atol=1e-4)
+
+    def test_qr_modes(self):
+        a = RNG.standard_normal((6, 4)).astype(np.float32)
+        q, r = paddle.linalg.qr(Tensor(a), mode="reduced")
+        assert list(q.shape) == [6, 4] and list(r.shape) == [4, 4]
+        np.testing.assert_allclose(np.asarray(q._data) @ np.asarray(r._data),
+                                   a, atol=1e-4)
+        q2, r2 = paddle.linalg.qr(Tensor(a), mode="complete")
+        assert list(q2.shape) == [6, 6] and list(r2.shape) == [6, 4]
+        np.testing.assert_allclose(np.tril(np.asarray(r._data), -1), 0,
+                                   atol=1e-6)
+
+    def test_cond_and_norm_orders(self):
+        a = np.diag([4.0, 2.0, 1.0]).astype(np.float32)
+        assert float(paddle.linalg.cond(Tensor(a))) == pytest.approx(4.0,
+                                                                     rel=1e-4)
+        v = np.array([3.0, -4.0], np.float32)
+        assert float(paddle.linalg.norm(Tensor(v))) == pytest.approx(5.0)
+        assert float(paddle.linalg.norm(Tensor(v), p=1)) == pytest.approx(7.0)
+        assert float(paddle.linalg.norm(Tensor(v),
+                                        p=np.inf)) == pytest.approx(4.0)
+
+    def test_solve_singular_raises_or_inf(self):
+        """Singular solve: jnp yields inf/nan rather than raising — pin the
+        behavior so it can't silently change."""
+        a = np.zeros((2, 2), np.float32)
+        b = np.ones((2,), np.float32)
+        out = np.asarray(paddle.linalg.solve(Tensor(a), Tensor(b))._data)
+        assert not np.isfinite(out).all()
