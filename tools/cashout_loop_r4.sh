@@ -35,8 +35,12 @@ run() {  # run <name> <timeout_s> <cmd...> — marked done only on success
   return $rc
 }
 
-# value order; "name timeout cmd..."
+# value order; "name timeout cmd..." — bench_routed first: the headline
+# number with the measured attention routing is the highest-value datum
+# per tunnel minute (one compile, ~15 min), so it lands in ANY window
+# before the multi-hour sweep starts eating the rest
 STAGES=(
+  "bench_routed 2400 python bench.py"
   "flash_tpu 2400 python benches/flash_tpu_bench.py"
   "sweep 10800 python benches/sweep.py"
   "baseline 7200 python benches/baseline.py lenet resnet50 ernie gpt-hybrid widedeep"
