@@ -79,3 +79,39 @@ def test_cells():
         x = paddle.to_tensor(np.random.rand(2, 4).astype(np.float32))
         out, st = cell(x)
         assert out.shape == [2, 3]
+
+
+def test_lstm_multilayer_bidirectional_matches_torch():
+    """2-layer bidirectional LSTM equals torch with copied weights — the
+    layer-stacking/direction-concat conventions are where silent
+    divergences live (single-layer goldens can't see them)."""
+    torch = pytest.importorskip("torch")
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+
+    f, h, L = 5, 7, 2
+    paddle.seed(3)
+    ours = nn.LSTM(f, h, num_layers=L, direction="bidirect")
+    ref = torch.nn.LSTM(f, h, num_layers=L, batch_first=True,
+                        bidirectional=True)
+    with torch.no_grad():
+        for layer in range(L):
+            for d, suffix in ((0, ""), (1, "_reverse")):
+                getattr(ref, f"weight_ih_l{layer}{suffix}").copy_(
+                    torch.tensor(np.asarray(
+                        getattr(ours, f"wi_l{layer}_d{d}")._data)))
+                getattr(ref, f"weight_hh_l{layer}{suffix}").copy_(
+                    torch.tensor(np.asarray(
+                        getattr(ours, f"wh_l{layer}_d{d}")._data)))
+                getattr(ref, f"bias_ih_l{layer}{suffix}").copy_(
+                    torch.tensor(np.asarray(
+                        getattr(ours, f"bi_l{layer}_d{d}")._data)))
+                getattr(ref, f"bias_hh_l{layer}{suffix}").copy_(
+                    torch.tensor(np.asarray(
+                        getattr(ours, f"bh_l{layer}_d{d}")._data)))
+    x = np.random.randn(3, 6, f).astype(np.float32)
+    out, (hn, cn) = ours(paddle.to_tensor(x))
+    tout, (thn, tcn) = ref(torch.tensor(x))
+    np.testing.assert_allclose(out.numpy(), tout.detach().numpy(), atol=1e-5)
+    np.testing.assert_allclose(hn.numpy(), thn.detach().numpy(), atol=1e-5)
+    np.testing.assert_allclose(cn.numpy(), tcn.detach().numpy(), atol=1e-5)
