@@ -553,3 +553,24 @@ class TestLinalgDegenerate:
         b = np.ones((2,), np.float32)
         out = np.asarray(paddle.linalg.solve(Tensor(a), Tensor(b))._data)
         assert not np.isfinite(out).all()
+
+
+def test_einsum_equation_zoo():
+    """Representative einsum equations vs torch: contraction, batch,
+    trace, outer, ellipsis, repeated-index diagonal."""
+    cases = [
+        ("ij,jk->ik", [(3, 4), (4, 5)]),
+        ("bij,bjk->bik", [(2, 3, 4), (2, 4, 5)]),
+        ("ii->", [(5, 5)]),               # trace
+        ("ii->i", [(5, 5)]),              # diagonal
+        ("i,j->ij", [(3,), (4,)]),        # outer
+        ("...ij->...ji", [(2, 3, 4)]),    # ellipsis transpose
+        ("bhqd,bhkd->bhqk", [(2, 2, 3, 8), (2, 2, 5, 8)]),  # attention
+        ("ij->", [(3, 4)]),               # full reduce
+    ]
+    for eq, shapes in cases:
+        ops = [RNG.standard_normal(s).astype(np.float32) for s in shapes]
+        got = np.asarray(paddle.einsum(eq, *[Tensor(o) for o in ops])._data)
+        want = torch.einsum(eq, *[torch.from_numpy(o.copy()) for o in ops])
+        np.testing.assert_allclose(got, want.numpy(), rtol=1e-5, atol=1e-5,
+                                   err_msg=eq)
