@@ -87,6 +87,14 @@ def convert_dtype_arg(dtype):
     return t
 
 
+def long_dtype():
+    """The framework's 'int64' under the canonical-width policy: int32
+    while jax x64 is off (TPU-native), true int64 with JAX_ENABLE_X64.
+    Use for internally-produced index outputs (argmax/sort/unique/...) so
+    they follow the policy without per-call jax truncation warnings."""
+    return convert_dtype_arg("int64")
+
+
 def dtype_name(dtype) -> str:
     """'float32'-style name for any dtype representation."""
     return jnp.dtype(dtype).name

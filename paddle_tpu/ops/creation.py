@@ -6,7 +6,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.dispatch import apply
-from ..core.dtype import convert_dtype_arg, get_default_dtype
+from ..core.dtype import long_dtype, convert_dtype_arg, get_default_dtype
 from ..core.tensor import Tensor, to_tensor  # noqa: F401  (re-export)
 
 
@@ -89,7 +89,7 @@ def arange(start=0, end=None, step=1, dtype=None, name=None):
         if any(isinstance(v, float) for v in (start, end or 0, step)):
             dtype = get_default_dtype()
         else:
-            dtype = jnp.int64
+            dtype = long_dtype()
     return Tensor(jnp.arange(start, end, step, dtype=dtype))
 
 

@@ -12,7 +12,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.dispatch import apply
-from ..core.dtype import convert_dtype_arg
+from ..core.dtype import long_dtype, convert_dtype_arg
 from ..core.tensor import Tensor
 
 __all__ = [
@@ -171,7 +171,7 @@ def kthvalue(x, k, axis=None, keepdim=False, name=None):
         vals = jnp.sort(x, axis=axis)
         idxs = jnp.argsort(x, axis=axis)
         v = jnp.take(vals, k - 1, axis=axis)
-        i = jnp.take(idxs, k - 1, axis=axis).astype(jnp.int64)
+        i = jnp.take(idxs, k - 1, axis=axis).astype(long_dtype())
         if keepdim:
             v = jnp.expand_dims(v, axis)
             i = jnp.expand_dims(i, axis)
@@ -204,7 +204,7 @@ def mode(x, axis=-1, keepdim=False, name=None):
         # paddle returns the LAST index equal to the mode value
         xm = jnp.moveaxis(x, axis, -1)
         eq = xm == vals[..., None]
-        idx = jnp.where(eq, jnp.arange(n), -1).max(axis=-1).astype(jnp.int64)
+        idx = jnp.where(eq, jnp.arange(n), -1).max(axis=-1).astype(long_dtype())
         if keepdim:
             vals = jnp.expand_dims(vals, -1)
             idx = jnp.expand_dims(idx, -1)

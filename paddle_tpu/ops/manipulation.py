@@ -9,6 +9,7 @@ import numpy as np
 
 from ..core.dispatch import apply
 from ..core.dtype import convert_dtype_arg
+from ..core.dtype import long_dtype
 from ..core.tensor import Tensor
 
 _this = sys.modules[__name__]
@@ -432,7 +433,7 @@ def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
         out = jnp.argmax(x, axis=axis)
         if keepdim and axis is not None:
             out = jnp.expand_dims(out, axis)
-        return out.astype(jnp.int64)
+        return out.astype(long_dtype())
 
     return apply(_argmax, (x,), dict(axis=axis, keepdim=bool(keepdim)), differentiable=False)
 
@@ -442,7 +443,7 @@ def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
         out = jnp.argmin(x, axis=axis)
         if keepdim and axis is not None:
             out = jnp.expand_dims(out, axis)
-        return out.astype(jnp.int64)
+        return out.astype(long_dtype())
 
     return apply(_argmin, (x,), dict(axis=axis, keepdim=bool(keepdim)), differentiable=False)
 
@@ -450,7 +451,7 @@ def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
 def argsort(x, axis=-1, descending=False, name=None):
     def _argsort(x, *, axis, descending):
         out = jnp.argsort(-x if descending else x, axis=axis)
-        return out.astype(jnp.int64)
+        return out.astype(long_dtype())
 
     return apply(_argsort, (x,), dict(axis=axis, descending=bool(descending)), differentiable=False)
 
@@ -475,7 +476,7 @@ def topk(x, k, axis=None, largest=True, sorted=True, name=None):
         else:
             vals, idx = jax.lax.top_k(-xm, k)
             vals = -vals
-        return jnp.moveaxis(vals, -1, ax), jnp.moveaxis(idx.astype(jnp.int64), -1, ax)
+        return jnp.moveaxis(vals, -1, ax), jnp.moveaxis(idx.astype(long_dtype()), -1, ax)
 
     return apply(_topk, (x,), dict(k=int(k), axis=axis, largest=bool(largest)))
 
@@ -506,7 +507,7 @@ def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=Non
                 flat_s, flat_v).reshape(v.shape)
         else:
             out = jnp.searchsorted(s, v, side=side)
-        return out.astype(jnp.int32) if int32 else out.astype(jnp.int64)
+        return out.astype(jnp.int32) if int32 else out.astype(long_dtype())
 
     return apply(_searchsorted, (sorted_sequence, values),
                  dict(side="right" if right else "left",
@@ -525,7 +526,7 @@ def one_hot(x, num_classes, name=None):
 
 
 def numel(x, name=None):
-    return Tensor(jnp.asarray(x.size, dtype=jnp.int64))
+    return Tensor(jnp.asarray(x.size, dtype=long_dtype()))
 
 
 def shape(input):

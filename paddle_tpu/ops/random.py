@@ -6,7 +6,7 @@ import jax.numpy as jnp
 
 from ..core import rng
 from ..core.dispatch import apply
-from ..core.dtype import convert_dtype_arg, get_default_dtype, is_floating
+from ..core.dtype import convert_dtype_arg, get_default_dtype, is_floating, long_dtype
 from ..core.tensor import Tensor
 from .creation import _shape_arg
 
@@ -92,7 +92,7 @@ def rand(shape, dtype=None, name=None):
 def randint(low=0, high=None, shape=(1,), dtype=None, name=None):
     if high is None:
         low, high = 0, low
-    dtype = convert_dtype_arg(dtype) or jnp.int64
+    dtype = convert_dtype_arg(dtype) or long_dtype()
 
     def _randint(key, *, shape, lo, hi, dtype):
         return jax.random.randint(key, shape, lo, hi, dtype=dtype)

@@ -10,6 +10,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from ..core.dtype import long_dtype as _long
+
 from .. import nn
 from ..core.dispatch import apply
 from ..core.tensor import Tensor
@@ -68,7 +70,7 @@ def viterbi_decode(potentials, transition_params, lengths=None,
         # collected ys = tags at positions T-1 .. 1; final carry = tag at 0
         first, ys = jax.lax.scan(backtrace, last, jnp.arange(T - 2, -1, -1))
         full = jnp.concatenate([first[:, None], ys[::-1].T], axis=1)
-        return score.astype(emis.dtype), full.astype(jnp.int64)
+        return score.astype(emis.dtype), full.astype(_long())
 
     if lengths is None:
         import numpy as np
