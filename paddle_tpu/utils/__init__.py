@@ -91,6 +91,7 @@ def run_check():
 
 
 from . import cpp_extension  # noqa: F401,E402
+from . import dlpack  # noqa: F401,E402
 from . import download  # noqa: F401,E402
 
 

@@ -1,0 +1,32 @@
+"""DLPack zero-copy tensor interop (ref:python/paddle/utils/dlpack.py:27
+``to_dlpack``/``from_dlpack`` over the reference's C++ capsule plumbing).
+
+TPU-native: jax arrays speak the DLPack protocol directly, so exchange
+with torch/numpy/cupy needs no copy for same-device (CPU) buffers."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["to_dlpack", "from_dlpack"]
+
+
+def to_dlpack(x):
+    """Produce a DLPack capsule for ``x`` (a paddle Tensor or array)."""
+    arr = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    return arr.__dlpack__()
+
+
+def from_dlpack(dlpack):
+    """Build a Tensor from any object exporting ``__dlpack__``
+    (torch/cupy/numpy arrays) or a legacy ``dltensor`` PyCapsule
+    (the reference's contract — ref:python/paddle/utils/dlpack.py:60)."""
+    if hasattr(dlpack, "__dlpack__"):
+        return Tensor(jax.dlpack.from_dlpack(dlpack))
+    # legacy capsule: jax only consumes protocol objects; bridge through
+    # torch (baked into this environment), which still accepts capsules
+    import torch.utils.dlpack as _tdl
+
+    return Tensor(jax.dlpack.from_dlpack(_tdl.from_dlpack(dlpack)))

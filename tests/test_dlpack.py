@@ -1,0 +1,33 @@
+"""paddle.utils.dlpack interop (ref:python/paddle/utils/dlpack.py:27):
+zero-copy exchange with torch/numpy via capsules and the array protocol."""
+import numpy as np
+import torch
+
+import paddle_tpu as paddle
+
+
+def test_to_dlpack_consumed_by_torch():
+    t = paddle.to_tensor(np.arange(6).astype(np.float32))
+    tt = torch.utils.dlpack.from_dlpack(paddle.utils.dlpack.to_dlpack(t))
+    np.testing.assert_array_equal(tt.numpy(), t.numpy())
+
+
+def test_from_dlpack_protocol_objects():
+    back = paddle.utils.dlpack.from_dlpack(torch.arange(3).float())
+    assert back.numpy().tolist() == [0.0, 1.0, 2.0]
+    back = paddle.utils.dlpack.from_dlpack(np.arange(4).astype(np.int32))
+    assert back.numpy().tolist() == [0, 1, 2, 3]
+
+
+def test_from_dlpack_legacy_capsule():
+    cap = torch.utils.dlpack.to_dlpack(torch.tensor([9.0, 8.0]))
+    back = paddle.utils.dlpack.from_dlpack(cap)
+    assert back.numpy().tolist() == [9.0, 8.0]
+
+
+def test_round_trip_through_ops():
+    t = paddle.to_tensor(np.ones((2, 3), np.float32))
+    rt = paddle.utils.dlpack.from_dlpack(
+        torch.utils.dlpack.from_dlpack(paddle.utils.dlpack.to_dlpack(t)))
+    out = rt * 2 + 1
+    np.testing.assert_array_equal(out.numpy(), np.full((2, 3), 3.0))
