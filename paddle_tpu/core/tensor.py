@@ -322,6 +322,23 @@ class Tensor:
             if self._is_traced():
                 raise ValueError("boolean-mask indexing has a data-dependent shape and cannot be jitted")
             return Tensor(jnp.asarray(np.asarray(self._data)[idx]))
+        if isinstance(idx, tuple):
+            # mixed advanced indexing (arrays + slices/ints): numpy
+            # COORDINATE semantics — index arrays broadcast and pair up
+            # (the reference lowers this to gather_nd over the broadcast
+            # index grid, ref:python/paddle/fluid/variable_index.py:147
+            # SliceInfo.get_item). Collapsing the tuple into one array
+            # would instead gather each list along axis 0.
+            arrays, spec = [], []
+            for i in idx:
+                if isinstance(i, (int, slice, type(None), type(Ellipsis))):
+                    spec.append(("s", _hashable_index(i)))
+                else:
+                    # _unwrap_index already replaced Tensors with arrays
+                    spec.append(("a", len(arrays)))
+                    arrays.append(Tensor(jnp.asarray(i)))
+            return apply(_getitem_mixed, (self, *arrays),
+                         {"spec": tuple(spec)})
         # dynamic integer index: direct gather, no static-arg jit
         return apply(_getitem_dynamic, (self, Tensor(jnp.asarray(idx))), {})
 
@@ -348,6 +365,9 @@ def _index_is_static(idx):
 def _index_has_bool_mask(idx):
     if isinstance(idx, tuple):
         return any(_index_has_bool_mask(i) for i in idx)
+    if isinstance(idx, list):  # python bool lists are masks too (numpy)
+        a = np.asarray(idx)
+        return a.dtype == np.bool_
     return hasattr(idx, "dtype") and jnp.dtype(idx.dtype) == jnp.dtype(jnp.bool_)
 
 
@@ -373,6 +393,12 @@ def _getitem_static(x, *, idx):
 
 def _getitem_dynamic(x, idx):
     return x[idx]
+
+
+def _getitem_mixed(x, *arrays, spec):
+    sel = tuple(arrays[v] if kind == "a" else _unhash_index(v)
+                for kind, v in spec)
+    return x[sel]
 
 
 def _fit_assign(v, slot_shape, dtype):

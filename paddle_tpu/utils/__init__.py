@@ -8,7 +8,7 @@ import threading
 from collections import defaultdict
 
 __all__ = ["monitor", "try_import", "unique_name", "run_check",
-           "cpp_extension", "download"]
+           "cpp_extension", "download", "dlpack"]
 
 
 class _Monitor:

@@ -574,3 +574,37 @@ def test_einsum_equation_zoo():
         want = torch.einsum(eq, *[torch.from_numpy(o.copy()) for o in ops])
         np.testing.assert_allclose(got, want.numpy(), rtol=1e-5, atol=1e-5,
                                    err_msg=eq)
+
+
+def test_getitem_numpy_equivalence():
+    """Indexing zoo vs numpy: ellipsis, None-newaxis, negative steps, bool
+    masks, integer arrays, mixed forms."""
+    x = _rand((4, 5, 6))
+    t = Tensor(x)
+    cases = [
+        np.s_[...],
+        np.s_[1],
+        np.s_[-1],
+        np.s_[::2],
+        np.s_[::-1],
+        np.s_[1:4:2, ::-1],
+        np.s_[..., 0],
+        np.s_[None, 1, ...],
+        np.s_[:, None, 2:],
+        np.s_[[2, 0, 3]],
+        np.s_[[1, 2], [0, 4]],
+        np.s_[x[:, 0, 0] > 0],
+    ]
+    for c in cases:
+        got = np.asarray(t[c]._data)
+        np.testing.assert_allclose(got, x[c], err_msg=str(c))
+
+
+def test_getitem_bool_list_mask():
+    """Python bool lists are masks (numpy/reference contract), alone and
+    inside tuples."""
+    x = _rand((4, 6))
+    t = Tensor(x)
+    m = [True, False, True, False]
+    np.testing.assert_allclose(np.asarray(t[m]._data), x[m])
+    np.testing.assert_allclose(np.asarray(t[m, 2]._data), x[m, 2])
