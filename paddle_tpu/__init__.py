@@ -213,6 +213,7 @@ def flops(net, input_size, custom_ops=None, print_detail=False):
 # ----------------------------------------------------- legacy compat names
 from .batch import batch  # noqa: E402,F401
 from . import _C_ops  # noqa: E402,F401
+from . import _legacy_C_ops  # noqa: E402,F401
 from . import fluid  # noqa: E402,F401
 
 # ---------------------------------------------------------- Tensor methods

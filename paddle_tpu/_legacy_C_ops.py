@@ -1,0 +1,21 @@
+"""paddle._legacy_C_ops compatibility shim (ref:python/paddle/_legacy_C_ops.py
+exposes the OLD-IR op bindings; ported code from the pre-eager era calls
+``paddle._legacy_C_ops.<op>(...)``).
+
+Same surface as :mod:`paddle_tpu._C_ops` — both namespaces resolve to the
+jnp/XLA implementations the Tensor API dispatches to (the reference keeps
+two namespaces only because its two binding generations coexist).
+"""
+from __future__ import annotations
+
+import sys as _sys
+
+from . import _C_ops as _c
+
+_this = _sys.modules[__name__]
+
+for _name in dir(_c):
+    if not _name.startswith("_"):
+        setattr(_this, _name, getattr(_c, _name))
+
+del _sys, _name
