@@ -48,17 +48,12 @@ class ClipGradByGlobalNorm(ClipGradBase):
         return [(g.astype(jnp.float32) * scale).astype(g.dtype) for g in grads]
 
 
-def clip_grad_norm_(parameters, max_norm, norm_type=2.0, error_if_nonfinite=False):
-    if isinstance(parameters, Tensor):
-        parameters = [parameters]
-    params = [p for p in parameters if p.grad is not None]
-    if not params:
-        return Tensor(jnp.zeros(()))
-    if norm_type == float("inf"):
-        total = jnp.max(jnp.stack([jnp.max(jnp.abs(p.grad._data)) for p in params]))
-    else:
-        total = jnp.sum(jnp.stack([jnp.sum(jnp.abs(p.grad._data.astype(jnp.float32)) ** norm_type) for p in params])) ** (1.0 / norm_type)
-    scale = jnp.minimum(max_norm / jnp.maximum(total, 1e-6), 1.0)
-    for p in params:
-        p.grad._data = (p.grad._data.astype(jnp.float32) * scale).astype(p.grad._data.dtype)
-    return Tensor(total)
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
+                    error_if_nonfinite=False):
+    """Single implementation lives in nn.utils (reference-faithful
+    max_norm/(total+1e-6) form); this alias keeps the historical
+    import path working."""
+    from .utils import clip_grad_norm_ as _impl
+
+    return _impl(parameters, max_norm, norm_type=norm_type,
+                 error_if_nonfinite=error_if_nonfinite)

@@ -1,0 +1,248 @@
+"""paddle.nn.utils — gradient clipping helpers, parameter vectorization,
+and the weight/spectral-norm reparameterization hooks
+(ref:python/paddle/nn/utils/: clip_grad_norm_.py:20, weight_norm_hook.py:162,
+spectral_norm_hook.py:140, transform_parameters.py).
+
+TPU-native: the reparameterizations are forward-pre-hooks that recompute
+the effective weight from the underlying parameters with ordinary traced
+ops, so they compose with eager backward AND the compiled TrainStep (the
+recomputation happens inside the trace; gradients flow to g/v)."""
+from __future__ import annotations
+
+import math
+from typing import List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from .layer import Layer, Parameter
+
+__all__ = [
+    "clip_grad_norm_", "clip_grad_value_", "parameters_to_vector",
+    "vector_to_parameters", "weight_norm", "remove_weight_norm",
+    "spectral_norm",
+]
+
+
+# ------------------------------------------------------------ grad clipping
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
+                    error_if_nonfinite=False):
+    """In-place global-norm gradient clipping; returns the total norm
+    (ref clip_grad_norm_.py:20 contract, incl. inf-norm support)."""
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    grads = [p.grad for p in parameters if getattr(p, "grad", None) is not None]
+    if not grads:
+        return Tensor(jnp.zeros((), jnp.float32))
+    max_norm = float(max_norm)
+    norm_type = float(norm_type)
+    if math.isinf(norm_type):
+        total = jnp.max(jnp.stack(
+            [jnp.max(jnp.abs(g._data)) for g in grads]))
+    else:
+        total = jnp.power(
+            sum(jnp.sum(jnp.abs(g._data.astype(jnp.float32)) ** norm_type)
+                for g in grads), 1.0 / norm_type)
+    if error_if_nonfinite and not bool(jnp.isfinite(total)):
+        raise RuntimeError(
+            f"the total norm of order {norm_type} for gradients is "
+            "non-finite, so it cannot be clipped")
+    # reference form (clip_grad_norm_.py): coef = max_norm / (total + 1e-6)
+    scale = jnp.minimum(max_norm / (total + 1e-6), 1.0)
+    for g in grads:
+        g._data = (g._data.astype(jnp.float32) * scale).astype(g._data.dtype)
+    return Tensor(total)
+
+
+def clip_grad_value_(parameters, clip_value):
+    """In-place clamp of every gradient to [-clip_value, clip_value]."""
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    clip_value = float(clip_value)
+    for p in parameters:
+        g = getattr(p, "grad", None)
+        if g is not None:
+            g._data = jnp.clip(g._data, -clip_value, clip_value)
+
+
+# --------------------------------------------------- parameter vectorization
+
+
+def parameters_to_vector(parameters: List[Tensor], name=None) -> Tensor:
+    """Flatten and concatenate parameters into one 1-D Tensor
+    (ref transform_parameters.py parameters_to_vector)."""
+    return Tensor(jnp.concatenate(
+        [jnp.reshape(p._data, (-1,)) for p in parameters]))
+
+
+def vector_to_parameters(vec: Tensor, parameters: List[Tensor], name=None):
+    """Write slices of ``vec`` back into the parameters (shapes preserved)."""
+    data = vec._data if isinstance(vec, Tensor) else jnp.asarray(vec)
+    parameters = list(parameters)  # the size check below must not exhaust
+    off = 0                        # a lazily-passed iterator
+    total = sum(int(np.prod(p.shape)) for p in parameters)
+    if data.size != total:
+        raise ValueError(
+            f"vector has {data.size} elements but parameters need {total}")
+    for p in parameters:
+        n = int(np.prod(p.shape))
+        p._data = jnp.reshape(data[off:off + n], p._data.shape).astype(
+            p._data.dtype)
+        off += n
+
+
+# ----------------------------------------------------------- weight norm
+
+
+def _norm_except_dim(v, dim):
+    if dim is None:
+        return jnp.sqrt(jnp.sum(v * v))
+    axes = tuple(i for i in range(v.ndim) if i != dim)
+    return jnp.sqrt(jnp.sum(v * v, axis=axes, keepdims=True))
+
+
+def _wn_compute(v, g, dim):
+    # w = g * v / ||v||  with g broadcast along dim
+    from ..core.dispatch import apply
+
+    def _wn(v, g, *, dim):
+        n = _norm_except_dim(v, dim)
+        if dim is None:
+            return v * (g / n)
+        shape = [1] * v.ndim
+        shape[dim] = v.shape[dim]
+        return v * (jnp.reshape(g, shape) / n)
+
+    return apply(_wn, (v, g), {"dim": dim}, name="weight_norm")
+
+
+def weight_norm(layer: Layer, name: str = "weight", dim: int = 0):
+    """Reparameterize ``layer.<name>`` as magnitude × direction
+    (ref weight_norm_hook.py:162): parameters ``<name>_g`` (per-``dim``
+    norms) and ``<name>_v`` (direction) replace the original; a forward
+    pre-hook recomputes the effective weight inside the trace."""
+    w = getattr(layer, name)
+    if not isinstance(w, Tensor):
+        raise ValueError(f"layer has no parameter {name!r}")
+    if hasattr(layer, f"_{name}_wn_hook"):
+        raise RuntimeError(f"weight_norm already applied to {name!r}")
+    arr = w._data
+    if dim is None:
+        g0 = jnp.sqrt(jnp.sum(arr * arr))
+    else:
+        dim = dim % arr.ndim
+        g0 = jnp.reshape(np.asarray(_norm_except_dim(arr, dim)), (-1,))
+    v = Parameter(arr)
+    g = Parameter(jnp.asarray(g0))
+    # drop the original parameter; expose v/g instead
+    layer._parameters.pop(name, None)
+    setattr(layer, f"{name}_v", v)
+    setattr(layer, f"{name}_g", g)
+
+    def hook(lyr, inputs):
+        object.__setattr__(lyr, name,
+                           _wn_compute(getattr(lyr, f"{name}_v"),
+                                       getattr(lyr, f"{name}_g"), dim))
+        return None
+
+    handle = layer.register_forward_pre_hook(hook)
+    object.__setattr__(layer, f"_{name}_wn_hook", handle)
+    object.__setattr__(layer, f"_{name}_wn_dim", dim)
+    hook(layer, ())  # effective weight available before the first forward
+    return layer
+
+
+def remove_weight_norm(layer: Layer, name: str = "weight"):
+    """Fold g·v/||v|| back into a plain parameter and remove the hook."""
+    handle = getattr(layer, f"_{name}_wn_hook", None)
+    if handle is None:
+        raise ValueError(f"weight_norm not applied to {name!r}")
+    dim = getattr(layer, f"_{name}_wn_dim")
+    v = getattr(layer, f"{name}_v")
+    g = getattr(layer, f"{name}_g")
+    w = _wn_compute(v, g, dim)
+    handle.remove()
+    layer._parameters.pop(f"{name}_v", None)
+    layer._parameters.pop(f"{name}_g", None)
+    object.__delattr__(layer, f"{name}_v")
+    object.__delattr__(layer, f"{name}_g")
+    object.__delattr__(layer, f"_{name}_wn_hook")
+    object.__delattr__(layer, f"_{name}_wn_dim")
+    setattr(layer, name, Parameter(w._data))
+    return layer
+
+
+# ---------------------------------------------------------- spectral norm
+
+
+def spectral_norm(layer: Layer, name: str = "weight",
+                  n_power_iterations: int = 1, eps: float = 1e-12,
+                  dim=None):
+    """Divide ``layer.<name>`` by its largest singular value, estimated by
+    power iteration on persistent u/v buffers (ref spectral_norm_hook.py:140).
+    The iteration runs under stop_gradient (and only in training mode, the
+    reference's do_power_iteration contract); buffer updates go through the
+    mutation sink, so the hook is compiled-step safe. ``dim=None`` resolves
+    to 1 for Linear-family layers ([in, out] weight layout) and 0 otherwise,
+    as the reference does."""
+    w = getattr(layer, name)
+    if not isinstance(w, Tensor):
+        raise ValueError(f"layer has no parameter {name!r}")
+    if hasattr(layer, f"_{name}_sn_hook"):
+        raise RuntimeError(f"spectral_norm already applied to {name!r}")
+    arr = w._data
+    if dim is None:
+        from .layers_common import Linear
+
+        dim = 1 if isinstance(layer, Linear) else 0
+    dim = dim % arr.ndim
+    h = arr.shape[dim]
+    wsz = int(np.prod(arr.shape)) // h
+    rng = np.random.default_rng(0)
+    u0 = rng.standard_normal(h).astype(np.float32)
+    v0 = rng.standard_normal(wsz).astype(np.float32)
+    orig = Parameter(arr)
+    layer._parameters.pop(name, None)
+    setattr(layer, f"{name}_orig", orig)
+    layer.register_buffer(f"{name}_u", Tensor(jnp.asarray(u0 / np.linalg.norm(u0))))
+    layer.register_buffer(f"{name}_v", Tensor(jnp.asarray(v0 / np.linalg.norm(v0))))
+
+    from ..core.dispatch import apply
+
+    def _sn(wp, u, v, *, dim, iters, eps):
+        perm = (dim,) + tuple(i for i in range(wp.ndim) if i != dim)
+        mat = jnp.transpose(wp, perm).reshape(wp.shape[dim], -1)
+        m = jax.lax.stop_gradient(mat)
+        for _ in range(iters):
+            v = m.T @ u
+            v = v / (jnp.linalg.norm(v) + eps)
+            u = m @ v
+            u = u / (jnp.linalg.norm(u) + eps)
+        u = jax.lax.stop_gradient(u)
+        v = jax.lax.stop_gradient(v)
+        sigma = u @ (mat @ v)
+        return wp / sigma, u, v
+
+    def hook(lyr, inputs):
+        # power-iterate only in training (do_power_iteration contract);
+        # eval computes sigma straight from the stored u/v
+        iters = int(n_power_iterations) if lyr.training else 0
+        wn, u_new, v_new = apply(
+            _sn, (getattr(lyr, f"{name}_orig"), getattr(lyr, f"{name}_u"),
+                  getattr(lyr, f"{name}_v")),
+            {"dim": dim, "iters": iters, "eps": float(eps)},
+            name="spectral_norm")
+        if lyr.training:
+            lyr.update_buffer(getattr(lyr, f"{name}_u"), u_new)
+            lyr.update_buffer(getattr(lyr, f"{name}_v"), v_new)
+        object.__setattr__(lyr, name, wn)
+        return None
+
+    handle = layer.register_forward_pre_hook(hook)
+    object.__setattr__(layer, f"_{name}_sn_hook", handle)
+    hook(layer, ())
+    return layer
