@@ -201,3 +201,5 @@ import sys as _sys  # noqa: E402
 from ..core import dispatch as _dispatch  # noqa: E402
 
 _dispatch._amp = _sys.modules[__name__]
+
+from . import debugging  # noqa: F401,E402

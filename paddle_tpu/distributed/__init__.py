@@ -76,3 +76,4 @@ from .sharding import group_sharded_parallel, save_group_sharded_model  # noqa: 
 from .spawn import spawn  # noqa: F401
 from . import launch  # noqa: F401
 from . import passes  # noqa: F401
+from . import utils  # noqa: F401

@@ -1,0 +1,109 @@
+"""paddle.distributed.utils (ref:python/paddle/distributed/utils/ —
+``__all__`` is empty there too; code reaches these by full path).
+
+``global_scatter``/``global_gather`` are the reference MoE's variable-count
+all-to-all dispatch ops (ref moe_utils.py:20,146, CUDA kernels
+ref:paddle/fluid/operators/collective/global_scatter_op.cu.cc). Their row
+counts are data-dependent, which XLA's static shapes cannot express — the
+TPU-native MoE (incubate.distributed.models.moe.MoELayer) uses capacity-
+based dispatch einsums instead. These eager-only ports keep reference
+MoE code runnable for porting/verification: segments are exchanged as
+objects (concrete shapes), ordering matches the CUDA kernels
+(send layout card-major ``i = card * n_expert + expert``; scatter output
+expert-major; gather output card-major)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ...core.tensor import Tensor
+
+__all__ = []  # reference contract
+
+
+def _np(t):
+    return np.asarray(t._data if isinstance(t, Tensor) else t)
+
+
+def _counts(c, world):
+    c = _np(c).astype(np.int64).reshape(-1)
+    if len(c) % world:
+        raise ValueError(
+            f"count length {len(c)} is not a multiple of world size {world}")
+    return c
+
+
+def _exchange_segments(segments, group):
+    """Publish this rank's outgoing segments; return every rank's list."""
+    from ..collective import all_gather_object
+
+    gathered: list = []
+    all_gather_object(gathered, segments, group=group)
+    return gathered
+
+
+def global_scatter(x, local_count, global_count, group=None,
+                   use_calc_stream=True):
+    """Rows of ``x`` (laid out card-major by ``local_count``) are sent to
+    ``(i % n_expert)``-th expert of card ``i // n_expert``; the output is
+    expert-major over source cards (eager-only; see module docstring)."""
+    from .. import env
+
+    # legacy per-PROCESS semantics: 'cards' are processes, not mesh axes
+    g = group
+    world = env.get_world_size()
+    rank = env.get_rank()
+    lc = _counts(local_count, world)
+    gc = _counts(global_count, world)
+    n_expert = len(lc) // world
+    arr = _np(x)
+    offs = np.concatenate([[0], np.cumsum(lc)])
+    segments = [arr[offs[i]:offs[i + 1]] for i in range(len(lc))]
+    per_rank = _exchange_segments(segments, g)
+    out = []
+    for e in range(n_expert):
+        for c in range(world):
+            seg = per_rank[c][rank * n_expert + e]
+            want = gc[c * n_expert + e]
+            if len(seg) != want:
+                raise ValueError(
+                    f"global_count[{c * n_expert + e}]={want} but card {c} "
+                    f"sent {len(seg)} rows")
+            out.append(seg)
+    return Tensor(np.concatenate(out) if out else arr[:0])
+
+
+def global_gather(x, local_count, global_count, group=None,
+                  use_calc_stream=True):
+    """Inverse of :func:`global_scatter`: rows of ``x`` (expert-major, as
+    scatter produced them, sized by ``global_count``) return to their
+    source cards; output is card-major by ``local_count``."""
+    from .. import env
+
+    # legacy per-PROCESS semantics: 'cards' are processes, not mesh axes
+    g = group
+    world = env.get_world_size()
+    rank = env.get_rank()
+    lc = _counts(local_count, world)
+    gc = _counts(global_count, world)
+    n_expert = len(lc) // world
+    arr = _np(x)
+    # x layout (scatter output): for e, for c -> gc[c * n_expert + e] rows
+    segments = {}
+    off = 0
+    for e in range(n_expert):
+        for c in range(world):
+            n = gc[c * n_expert + e]
+            segments[(c, e)] = arr[off:off + n]
+            off += n
+    per_rank = _exchange_segments(segments, g)
+    out = []
+    for c in range(world):
+        for e in range(n_expert):
+            seg = per_rank[c][(rank, e)]
+            want = lc[c * n_expert + e]
+            if len(seg) != want:
+                raise ValueError(
+                    f"local_count[{c * n_expert + e}]={want} but card {c} "
+                    f"returned {len(seg)} rows")
+            out.append(seg)
+    return Tensor(np.concatenate(out) if out else arr[:0])

@@ -1,2 +1,5 @@
 from . import io  # noqa: F401
 from .io import load, save  # noqa: F401
+
+from ..core.dtype import get_default_dtype, set_default_dtype  # noqa: F401,E402
+from . import random  # noqa: F401,E402

@@ -1,0 +1,18 @@
+"""paddle.framework.random — RNG state surface
+(ref:python/paddle/framework/random.py: get/set_cuda_rng_state + the
+hybrid-parallel rng tracker accessors). On this stack all state lives in
+the functional key registry (core.rng)."""
+from ..core.rng import (  # noqa: F401
+    get_rng_state,
+    set_rng_state,
+    get_rng_state_tracker,
+)
+
+
+def get_cuda_rng_state():
+    """Alias of the device RNG state (one functional key registry here)."""
+    return get_rng_state()
+
+
+def set_cuda_rng_state(state):
+    return set_rng_state(state)

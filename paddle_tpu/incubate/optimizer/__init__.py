@@ -1,0 +1,6 @@
+"""paddle.incubate.optimizer (ref:python/paddle/incubate/optimizer/):
+LookAhead / ModelAverage wrap a base optimizer; GradientMerge is the
+k-step accumulation wrapper (the compiled form is
+jit.TrainStep(accumulate_steps=k))."""
+from .. import LookAhead, ModelAverage  # noqa: F401
+from ...distributed.passes import GradientMergeOptimizer  # noqa: F401

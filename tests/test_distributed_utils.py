@@ -1,0 +1,60 @@
+"""paddle.distributed.utils global_scatter/global_gather (eager compat for
+the reference's variable-count MoE dispatch, ref moe_utils.py:20,146)."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.distributed import utils as dist_utils
+
+
+def test_scatter_gather_world1_round_trip():
+    # world=1, n_expert=2: scatter regroups card-major -> expert-major
+    x = np.arange(10, dtype=np.float32).reshape(5, 2)
+    local_count = np.array([2, 3], np.int64)    # e0: rows 0-1, e1: rows 2-4
+    global_count = np.array([2, 3], np.int64)
+    out = dist_utils.global_scatter(Tensor(x), Tensor(local_count),
+                                    Tensor(global_count))
+    np.testing.assert_array_equal(out.numpy(), x)  # world=1: same order
+    back = dist_utils.global_gather(out, Tensor(local_count),
+                                    Tensor(global_count))
+    np.testing.assert_array_equal(back.numpy(), x)
+
+
+def test_scatter_count_mismatch_raises():
+    import pytest
+
+    x = np.zeros((3, 2), np.float32)
+    with pytest.raises(ValueError, match="sent"):
+        dist_utils.global_scatter(Tensor(x), Tensor(np.array([1, 2])),
+                                  Tensor(np.array([2, 2])))
+
+
+def test_scatter_semantics_simulated_two_cards():
+    """Simulate the reference doc's 2-card example by calling the pure
+    regrouping logic for each rank against captured per-rank segments
+    (the wire exchange is identity-per-rank in one process, so we check
+    the ordering math directly)."""
+    # rank0: x0 5 rows, local_count [2,1,1,1]; rank1: x1 5 rows [1,1,2,1]
+    x0 = np.arange(10, dtype=np.float32).reshape(5, 2)
+    x1 = -np.arange(10, dtype=np.float32).reshape(5, 2)
+    lc0 = np.array([2, 1, 1, 1], np.int64)
+    lc1 = np.array([1, 1, 2, 1], np.int64)
+    gc0 = np.array([2, 1, 1, 1], np.int64)
+
+    def segs(x, lc):
+        offs = np.concatenate([[0], np.cumsum(lc)])
+        return [x[offs[i]:offs[i + 1]] for i in range(len(lc))]
+
+    per_rank = [segs(x0, lc0), segs(x1, lc1)]
+    world, n_expert, rank = 2, 2, 0
+    out = []
+    for e in range(n_expert):
+        for c in range(world):
+            seg = per_rank[c][rank * n_expert + e]
+            assert len(seg) == gc0[c * n_expert + e]
+            out.append(seg)
+    got = np.concatenate(out)
+    # rank0 receives: e0: its own rows 0-1, rank1's row 0; e1: its own
+    # row 2, rank1's row 1  (expert-major over source cards)
+    want = np.concatenate([x0[0:2], x1[0:1], x0[2:3], x1[1:2]])
+    np.testing.assert_array_equal(got, want)
