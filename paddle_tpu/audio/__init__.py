@@ -108,6 +108,8 @@ class MFCC(nn.Layer):
                  htk=False, norm="slaney", ref_value=1.0, amin=1e-10,
                  top_db=None, dtype="float32"):
         super().__init__()
+        if n_mfcc > n_mels:
+            raise ValueError(f"n_mfcc {n_mfcc} cannot exceed n_mels {n_mels}")
         self.logmel = LogMelSpectrogram(sr, n_fft, hop_length, win_length,
                                         window, power, center, pad_mode,
                                         n_mels, f_min, f_max, htk, norm,
@@ -127,5 +129,6 @@ class MFCC(nn.Layer):
 # IO + datasets live in subpackages; imported last so their (lazy) references
 # back to the feature layers above resolve
 from . import backends  # noqa: E402
+from . import features  # noqa: E402
 from . import datasets  # noqa: E402
 from .backends import info, load, save  # noqa: E402

@@ -1,0 +1,7 @@
+"""paddle.audio.features namespace
+(ref:python/paddle/audio/features/layers.py exposes the feature layers
+under ``paddle.audio.features.*``; the implementations live at the
+package level here — one class per feature, re-exported)."""
+from . import MFCC, LogMelSpectrogram, MelSpectrogram, Spectrogram  # noqa: F401
+
+__all__ = ["Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC"]

@@ -48,7 +48,12 @@ def global_scatter(x, local_count, global_count, group=None,
     expert-major over source cards (eager-only; see module docstring)."""
     from .. import env
 
-    # legacy per-PROCESS semantics: 'cards' are processes, not mesh axes
+    # legacy per-PROCESS semantics: 'cards' are processes, not mesh axes;
+    # process subgroups would silently misroute rows, so refuse them
+    if group is not None:
+        raise NotImplementedError(
+            "global_scatter/global_gather support only the global group "
+            "(group=None) on this stack")
     g = group
     world = env.get_world_size()
     rank = env.get_rank()
@@ -79,7 +84,12 @@ def global_gather(x, local_count, global_count, group=None,
     source cards; output is card-major by ``local_count``."""
     from .. import env
 
-    # legacy per-PROCESS semantics: 'cards' are processes, not mesh axes
+    # legacy per-PROCESS semantics: 'cards' are processes, not mesh axes;
+    # process subgroups would silently misroute rows, so refuse them
+    if group is not None:
+        raise NotImplementedError(
+            "global_scatter/global_gather support only the global group "
+            "(group=None) on this stack")
     g = group
     world = env.get_world_size()
     rank = env.get_rank()

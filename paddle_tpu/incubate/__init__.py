@@ -264,3 +264,5 @@ class ModelAverage:
             for p, b in zip(self._params, self._backup):
                 p._data = b
             self._backup = None
+
+from . import optimizer  # noqa: F401,E402

@@ -165,3 +165,23 @@ def calculate_gain(nonlinearity, param=None):
         "selu": 3.0 / 4,
     }
     return gains[nonlinearity]
+
+
+# ------------------------------------------------- global default initializer
+# (ref:python/paddle/nn/initializer/__init__.py set_global_initializer:
+# installs process-wide defaults consulted when neither ParamAttr nor
+# default_initializer specifies one)
+_global_weight_init = None
+_global_bias_init = None
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    """Install process-wide default initializers (weight, optional bias);
+    pass None to clear. Explicit ParamAttr/default_initializer still win."""
+    global _global_weight_init, _global_bias_init
+    _global_weight_init = weight_init
+    _global_bias_init = bias_init
+
+
+def _global_default(is_bias):
+    return _global_bias_init if is_bias else _global_weight_init

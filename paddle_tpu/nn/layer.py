@@ -135,9 +135,15 @@ class Layer:
         trainable = True
         learning_rate = 1.0
         if attr is not None and attr is not False:
-            init = getattr(attr, "initializer", None) or init
-            name = getattr(attr, "name", None)
-            trainable = getattr(attr, "trainable", True)
+            if isinstance(attr, I.Initializer):
+                # paddle idiom: weight_attr=nn.initializer.KaimingNormal()
+                init = attr
+            else:
+                init = getattr(attr, "initializer", None) or init
+                name = getattr(attr, "name", None)
+                trainable = getattr(attr, "trainable", True)
+        if init is None:
+            init = I._global_default(is_bias)  # set_global_initializer
         if init is None:
             init = I.Constant(0.0) if is_bias else I.XavierNormal()
         data = init(shape, dtype)

@@ -3,3 +3,52 @@ from . import models  # noqa: F401
 from . import transforms  # noqa: F401
 from . import datasets  # noqa: F401
 from . import ops  # noqa: F401
+
+
+# ----------------------------------------------------- image backend registry
+# (ref:python/paddle/vision/image.py set_image_backend/get_image_backend/
+# image_load). 'pil' returns a PIL.Image, 'tensor' a paddle Tensor in CHW
+# float [0,1]; 'cv2' needs opencv, which this environment doesn't ship.
+_image_backend = "pil"
+
+
+def set_image_backend(backend: str):
+    global _image_backend
+    if backend not in ("pil", "cv2", "tensor"):
+        raise ValueError(
+            f"expected backend 'pil'/'cv2'/'tensor', got {backend!r}")
+    if backend == "cv2":
+        try:
+            import cv2  # noqa: F401
+        except ImportError as e:
+            raise ValueError("cv2 backend requires opencv-python") from e
+    _image_backend = backend
+
+
+def get_image_backend() -> str:
+    return _image_backend
+
+
+def image_load(path, backend=None):
+    """Load an image with the selected backend (PIL Image, cv2 ndarray, or
+    CHW float Tensor)."""
+    backend = backend or _image_backend
+    if backend not in ("pil", "cv2", "tensor"):
+        raise ValueError(
+            f"expected backend 'pil'/'cv2'/'tensor', got {backend!r}")
+    if backend == "cv2":
+        import cv2
+
+        return cv2.imread(path)
+    from PIL import Image
+
+    img = Image.open(path)
+    if backend == "pil":
+        return img
+    import numpy as np
+
+    from ..core.tensor import Tensor
+    import jax.numpy as jnp
+
+    arr = np.asarray(img.convert("RGB"), np.float32) / 255.0
+    return Tensor(jnp.asarray(arr.transpose(2, 0, 1)))

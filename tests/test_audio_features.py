@@ -1,0 +1,99 @@
+"""paddle.audio.features (ref:python/paddle/audio/features/layers.py):
+Spectrogram/MelSpectrogram/LogMelSpectrogram/MFCC layers; plus the vision
+image-backend registry and nn.initializer.set_global_initializer."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.audio.features import (
+    MFCC,
+    LogMelSpectrogram,
+    MelSpectrogram,
+    Spectrogram,
+)
+from paddle_tpu.core.tensor import Tensor
+
+SR = 16000
+
+
+def _tone(freq, sr=SR, dur=1.0):
+    t = np.linspace(0, dur, int(sr * dur), dtype=np.float32)
+    return np.sin(2 * np.pi * freq * t)
+
+
+def test_spectrogram_peak_at_tone_frequency():
+    x = Tensor(np.stack([_tone(440), _tone(880)]))
+    spec = Spectrogram(n_fft=512)(x)
+    assert list(spec.shape)[:2] == [2, 257]
+    mean = spec.numpy().mean(axis=2)
+    assert abs(int(np.argmax(mean[0])) - round(440 * 512 / SR)) <= 1
+    assert abs(int(np.argmax(mean[1])) - round(880 * 512 / SR)) <= 1
+    # magnitude (power=1) is the sqrt of the power spectrum
+    mag = Spectrogram(n_fft=512, power=1.0)(x)
+    np.testing.assert_allclose(mag.numpy() ** 2, spec.numpy(), rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_mel_and_log_and_mfcc_shapes_and_finiteness():
+    x = Tensor(_tone(440)[None, :])
+    mel = MelSpectrogram(sr=SR, n_fft=512, n_mels=40)(x)
+    assert list(mel.shape)[:2] == [1, 40]
+    assert (mel.numpy() >= 0).all()
+    logmel = LogMelSpectrogram(sr=SR, n_fft=512, n_mels=40, top_db=80.0)(x)
+    ln = logmel.numpy()
+    assert np.isfinite(ln).all()
+    assert ln.max() - ln.min() <= 80.0 + 1e-3  # top_db clamp
+    mfcc = MFCC(sr=SR, n_mfcc=13, n_fft=512, n_mels=40)(x)
+    assert list(mfcc.shape)[:2] == [1, 13]
+    with pytest.raises(ValueError, match="n_mfcc"):
+        MFCC(n_mfcc=80, n_mels=40)
+
+
+def test_features_jit_compatible():
+    from paddle_tpu.jit import to_static
+
+    layer = MFCC(sr=SR, n_mfcc=13, n_fft=512, n_mels=40)
+    x = Tensor(_tone(440)[None, :])
+    eager = layer(x).numpy()
+    compiled = to_static(lambda a: layer(a))(x).numpy()
+    np.testing.assert_allclose(eager, compiled, atol=1e-4)
+
+
+def test_vision_image_backend(tmp_path):
+    from PIL import Image
+
+    p = str(tmp_path / "img.png")
+    Image.fromarray((np.random.rand(8, 6, 3) * 255).astype(np.uint8)).save(p)
+    assert paddle.vision.get_image_backend() == "pil"
+    img = paddle.vision.image_load(p)
+    assert img.size == (6, 8)
+    paddle.vision.set_image_backend("tensor")
+    try:
+        t = paddle.vision.image_load(p)
+        assert list(t.shape) == [3, 8, 6]
+        assert 0.0 <= float(t.numpy().min()) and float(t.numpy().max()) <= 1.0
+    finally:
+        paddle.vision.set_image_backend("pil")
+    with pytest.raises(ValueError, match="backend"):
+        paddle.vision.set_image_backend("nope")
+
+
+def test_set_global_initializer():
+    from paddle_tpu import nn
+
+    nn.initializer.set_global_initializer(nn.initializer.Constant(0.25),
+                                          nn.initializer.Constant(0.5))
+    try:
+        lin = nn.Linear(3, 2)
+        np.testing.assert_array_equal(lin.weight.numpy(),
+                                      np.full((3, 2), 0.25))
+        np.testing.assert_array_equal(lin.bias.numpy(), np.full((2,), 0.5))
+        # explicit attr still wins
+        lin2 = nn.Linear(3, 2,
+                         weight_attr=nn.initializer.Constant(9.0))
+        np.testing.assert_array_equal(lin2.weight.numpy(),
+                                      np.full((3, 2), 9.0))
+    finally:
+        nn.initializer.set_global_initializer(None)
+    lin3 = nn.Linear(3, 2)
+    assert not np.allclose(lin3.weight.numpy(), 0.25)  # defaults restored
