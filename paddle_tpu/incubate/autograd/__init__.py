@@ -151,3 +151,8 @@ class Hessian:
 
     def numpy(self):
         return self._hess.numpy()
+
+
+# lowercase functional aliases (ref incubate.autograd exposes both forms)
+jacobian = Jacobian
+hessian = Hessian
