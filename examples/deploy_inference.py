@@ -1,0 +1,64 @@
+"""Train -> export -> serve: jit.save (StableHLO .pdmodel + native
+.pdnative), jit.load, the C++-style Predictor API, and ONNX export.
+
+Usage: python examples/deploy_inference.py
+"""
+import os
+import tempfile
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+def main():
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(16, 64), nn.ReLU(), nn.Linear(64, 4))
+    opt = paddle.optimizer.Adam(learning_rate=5e-3,
+                                parameters=net.parameters())
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((128, 16), dtype=np.float32)
+    Y = X @ rng.standard_normal((16, 4), dtype=np.float32)
+    for _ in range(60):
+        loss = ((net(paddle.to_tensor(X)) - paddle.to_tensor(Y)) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    print("trained; final loss", float(loss))
+
+    td = tempfile.mkdtemp()
+    path = os.path.join(td, "model/net")
+    net.eval()
+    paddle.jit.save(net, path, input_spec=[
+        paddle.static.InputSpec([None, 16], "float32")])
+    print("saved:", sorted(os.listdir(os.path.dirname(path))))
+
+    loaded = paddle.jit.load(path)
+    x = X[:4]
+    np.testing.assert_allclose(loaded(paddle.to_tensor(x)).numpy(),
+                               net(paddle.to_tensor(x)).numpy(), atol=1e-5)
+    print("jit.load round trip OK")
+
+    # the C++-parity Predictor API over the same artifacts
+    from paddle_tpu import inference
+
+    cfg = inference.Config(path + ".pdmodel", path + ".pdparams")
+    pred = inference.create_predictor(cfg)
+    h = pred.get_input_handle(pred.get_input_names()[0])
+    h.copy_from_cpu(x)
+    pred.run()
+    out = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+    np.testing.assert_allclose(out, net(paddle.to_tensor(x)).numpy(),
+                               atol=1e-5)
+    print("Predictor OK")
+
+    onnx_path = paddle.onnx.export(
+        net, os.path.join(td, "net_onnx"),
+        input_spec=[paddle.static.InputSpec([4, 16], "float32")],
+        opset_version=18)
+    print("ONNX written:", os.path.basename(onnx_path))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,52 @@
+"""Image classification with the hapi Model API (fit/evaluate/predict).
+
+Usage: python examples/train_vision.py [--epochs 2]
+"""
+import argparse
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import io, nn
+
+
+class SyntheticImages(io.Dataset):
+    """Stands in for vision.datasets.* (which read real archives)."""
+
+    def __init__(self, n=256, classes=10, seed=0):
+        rng = np.random.default_rng(seed)
+        self.y = rng.integers(0, classes, n).astype(np.int64)
+        base = rng.standard_normal((classes, 3, 32, 32), dtype=np.float32)
+        noise = rng.standard_normal((n, 3, 32, 32), dtype=np.float32)
+        self.x = (base[self.y] * 2.0 + noise).astype(np.float32)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+    def __len__(self):
+        return len(self.y)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=2)
+    ap.add_argument("--arch", default="resnet18")
+    args = ap.parse_args()
+
+    paddle.seed(0)
+    from paddle_tpu.vision import models
+
+    net = getattr(models, args.arch)(num_classes=10)
+    model = paddle.Model(net)
+    model.prepare(
+        paddle.optimizer.Adam(learning_rate=1e-3,
+                              parameters=model.parameters()),
+        nn.CrossEntropyLoss(),
+        paddle.metric.Accuracy())
+    train, val = SyntheticImages(256), SyntheticImages(64, seed=1)
+    model.fit(train, val, epochs=args.epochs, batch_size=32, verbose=1)
+    print("eval:", model.evaluate(val, batch_size=32, verbose=0))
+
+
+if __name__ == "__main__":
+    main()
