@@ -299,6 +299,8 @@ for _name in (
     "auto_parallel_grad_clip",  # clip compiled into the step (TrainStep)
     "auto_parallel_sharding",   # the sharding mesh axis partitions states
     "auto_parallel_pipeline",   # compiled GPipe/interleaved schedule
+    "ps_server_pass",           # PS roles come from launch --server_num
+    "ps_trainer_pass",          # (TRAINING_ROLE contract), not rewrites
 ):
     PassBase._REGISTERED_PASSES[_name] = type(
         f"_CP_{_name}", (_CompilerPerformedPass,), {"name": _name})
