@@ -15,12 +15,22 @@ pytestmark = pytest.mark.skipif(
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _run(script, *args, timeout=600):
+def _run(script, *args, timeout=600, env_extra=None):
+    # pin the CPU backend IN-PROCESS: this sandbox's sitecustomize force-
+    # selects the tunneled TPU via jax.config (overriding JAX_PLATFORMS),
+    # and a dead tunnel would hang the example in connect backoff
+    wrapper = (
+        "import jax, runpy, sys; "
+        "jax.config.update('jax_platforms', 'cpu'); "
+        f"sys.argv = [sys.argv[0]] + {list(args)!r}; "
+        f"runpy.run_path({os.path.join(ROOT, 'examples', script)!r}, "
+        "run_name='__main__')")
     env = dict(os.environ, JAX_PLATFORMS="cpu",
-               PYTHONPATH=ROOT + os.pathsep + os.environ.get("PYTHONPATH", ""))
-    r = subprocess.run(
-        [sys.executable, os.path.join(ROOT, "examples", script), *args],
-        env=env, capture_output=True, text=True, timeout=timeout)
+               PYTHONPATH=ROOT + os.pathsep + os.environ.get("PYTHONPATH", ""),
+               **(env_extra or {}))
+    r = subprocess.run([sys.executable, "-c", wrapper],
+                       env=env, capture_output=True, text=True,
+                       timeout=timeout)
     assert r.returncode == 0, f"{script}: {r.stdout[-800:]}\n{r.stderr[-800:]}"
     return r.stdout
 
@@ -42,16 +52,9 @@ def test_train_widedeep_ps():
 
 
 def test_distributed_hybrid():
-    env_extra = {"XLA_FLAGS": "--xla_force_host_platform_device_count=8"}
-    env = dict(os.environ, JAX_PLATFORMS="cpu",
-               PYTHONPATH=ROOT + os.pathsep + os.environ.get("PYTHONPATH", ""),
-               **env_extra)
-    r = subprocess.run(
-        [sys.executable, os.path.join(ROOT, "examples",
-                                      "distributed_hybrid.py")],
-        env=env, capture_output=True, text=True, timeout=600)
-    assert r.returncode == 0, r.stdout[-500:] + r.stderr[-500:]
-    assert "mesh: dp=4 x mp=2" in r.stdout
+    out = _run("distributed_hybrid.py", env_extra={
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=8"})
+    assert "mesh: dp=4 x mp=2" in out
 
 
 def test_deploy_inference():
