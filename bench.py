@@ -162,13 +162,15 @@ def main():
         hidden = int(knob("BENCH_HIDDEN", "768"))
         layers = int(knob("BENCH_LAYERS", "12"))
         heads = int(knob("BENCH_HEADS", str(max(1, hidden // 64))))
+        seq_req = int(knob("BENCH_SEQ", "1024"))
         cfg = GPTConfig(vocab_size=50304, hidden_size=hidden, num_layers=layers,
-                        num_heads=heads, max_position_embeddings=2048,
+                        num_heads=heads,
+                        max_position_embeddings=max(2048, seq_req),
                         use_recompute=remat, loss_chunk_size=chunk)
         batch = int(knob("BENCH_BATCH", "16"))  # b16 fits v5e
         # HBM comfortably (fused logsumexp CE, donation) and lifts MFU over
         # the b8 round-1 config
-        seq = int(knob("BENCH_SEQ", "1024"))
+        seq = seq_req
         warmup, iters = 3, int(knob("BENCH_ITERS", "10"))
     else:  # CPU smoke path so the script always works
         cfg = gpt_tiny()

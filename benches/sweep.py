@@ -47,6 +47,10 @@ POINTS = [
      "BENCH_REMAT": "1", "BENCH_CHUNK_LOSS": "1024", "BENCH_AMP": "O2"},
     {"BENCH_BATCH": "16", "BENCH_REMAT": "0", "BENCH_CHUNK_LOSS": "1024"},
     {"BENCH_BATCH": "64", "BENCH_REMAT": "1", "BENCH_CHUNK_LOSS": "1024"},
+    # long-context point: s=8192 routes attention through the Pallas flash
+    # kernels (measured 6.99x over XLA there); remat keeps activations sane
+    {"BENCH_SEQ": "8192", "BENCH_BATCH": "2", "BENCH_REMAT": "1",
+     "BENCH_CHUNK_LOSS": "1024"},
 ]
 
 
