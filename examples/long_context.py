@@ -1,0 +1,87 @@
+"""Long-context training with sequence (context) parallelism: the sequence
+axis is sharded over the mesh's `sep` axis and attention runs as ring
+attention (blockwise, K/V rotating by ppermute) — memory per device scales
+with seq/sep instead of seq.
+
+Run without hardware on a virtual mesh:
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+      python examples/long_context.py --seq 2048 --sep 4
+
+On TPU, sequences >= FLAGS_flash_attention_min_seqlen additionally route
+each block through the Pallas flash kernels (measured 7x over the
+materialized-S^2 path at s=8192 on v5e).
+"""
+import argparse
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import distributed as dist
+from paddle_tpu import nn
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.jit import TrainStep
+
+
+class TinyCausalLM(nn.Layer):
+    """One attention block + head — enough to show the sep-axis plumbing;
+    scaled_dot_product_attention dispatches to ring attention whenever the
+    installed mesh has an active `sep` axis."""
+
+    def __init__(self, vocab=512, d=64, heads=4):
+        super().__init__()
+        self.embed = nn.Embedding(vocab, d)
+        self.qkv = nn.Linear(d, 3 * d)
+        self.proj = nn.Linear(d, d)
+        self.head = nn.Linear(d, vocab)
+        self.heads = heads
+
+    def forward(self, ids, labels):
+        h = self.embed(ids)                       # [b, s, d]
+        b, s, d = h.shape
+        qkv = self.qkv(h).reshape([b, s, 3, self.heads, d // self.heads])
+        q, k, v = qkv.unbind(axis=2)
+        o = nn.functional.scaled_dot_product_attention(q, k, v,
+                                                       is_causal=True)
+        h = h + self.proj(o.reshape([b, s, d]))
+        logits = self.head(h)
+        return nn.functional.cross_entropy(
+            logits.reshape([-1, logits.shape[-1]]),
+            labels.reshape([-1])).mean()
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seq", type=int, default=1024)
+    ap.add_argument("--sep", type=int, default=4)
+    ap.add_argument("--steps", type=int, default=8)
+    args = ap.parse_args()
+
+    import jax
+
+    n = len(jax.devices())
+    sep = min(args.sep, n)
+    dist.init_hybrid_mesh(dp=n // sep, sep=sep)
+    print(f"mesh: dp={n // sep} x sep={sep}; sequence {args.seq} "
+          f"-> {args.seq // sep} per device (ring attention)")
+
+    paddle.seed(0)
+    model = TinyCausalLM()
+    opt = paddle.optimizer.AdamW(learning_rate=3e-4,
+                                 parameters=model.parameters())
+    step = TrainStep(lambda x, y: model(x, y), opt, layers=model)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 512, (max(1, n // sep) * 2, args.seq),
+                       dtype=np.int32)
+    first = last = None
+    for i in range(args.steps):
+        loss = step(dist.shard_batch(Tensor(ids)),
+                    dist.shard_batch(Tensor(np.roll(ids, -1, 1))))
+        if first is None:
+            first = float(loss)
+        last = float(loss)
+    print(f"loss {first:.4f} -> {last:.4f} over {args.steps} steps")
+    assert last < first
+
+
+if __name__ == "__main__":
+    main()

@@ -60,3 +60,10 @@ def test_distributed_hybrid():
 def test_deploy_inference():
     out = _run("deploy_inference.py")
     assert "Predictor OK" in out and "ONNX written" in out
+
+
+def test_long_context():
+    out = _run("long_context.py", "--seq", "512", "--sep", "4",
+               "--steps", "4", env_extra={
+                   "XLA_FLAGS": "--xla_force_host_platform_device_count=8"})
+    assert "sep=4" in out and "ring attention" in out
