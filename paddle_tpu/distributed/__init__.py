@@ -76,4 +76,9 @@ from .sharding import group_sharded_parallel, save_group_sharded_model  # noqa: 
 from .spawn import spawn  # noqa: F401
 from . import launch  # noqa: F401
 from . import passes  # noqa: F401
+from . import stream  # noqa: F401
 from . import utils  # noqa: F401
+
+# communication-namespace aliases (ref paddle.distributed.all_to_all)
+all_to_all = alltoall
+all_to_all_single = alltoall_single

@@ -341,6 +341,54 @@ def init_worker():
 
 
 from . import utils  # noqa: F401,E402  (LocalFS/HDFSClient/recompute)
+from . import elastic  # noqa: F401,E402
+
+
+def __getattr__(name):
+    # meta_parallel pulls nn layers that import distributed back: resolve
+    # it lazily so `fleet.meta_parallel` works without an import cycle
+    # (importlib directly — a relative `from . import` would re-enter this
+    # __getattr__ through _handle_fromlist and recurse)
+    if name == "meta_parallel":
+        import importlib
+
+        mod = importlib.import_module(__name__ + ".meta_parallel")
+        globals()["meta_parallel"] = mod
+        return mod
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+class _RoleMakerBase:
+    """Role makers resolve this process's role/rank from the environment
+    (ref:python/paddle/distributed/fleet/base/role_maker.py). The launch
+    env contract (TRAINING_ROLE/PADDLE_TRAINER_ID/...) carries the same
+    information here, so these are thin views over it."""
+
+    def _worker_index(self):
+        return worker_index()
+
+    def _worker_num(self):
+        return worker_num()
+
+    def _is_first_worker(self):
+        return is_first_worker()
+
+    def _is_server(self):
+        return is_server()
+
+    def _is_worker(self):
+        return is_worker()
+
+
+class PaddleCloudRoleMaker(_RoleMakerBase):
+    def __init__(self, is_collective=True, **kwargs):
+        self._is_collective = is_collective
+
+
+class UserDefinedRoleMaker(_RoleMakerBase):
+    def __init__(self, is_collective=True, init_gloo=False, **kwargs):
+        self._is_collective = is_collective
+        self._kw = kwargs
 
 
 from . import dataset  # noqa: E402  (fleet dataset module)

@@ -1,0 +1,18 @@
+"""paddle.distributed.stream — the stream-variant collective API
+(ref:python/paddle/distributed/communication/stream/): same verbs with
+explicit sync_op/use_calc_stream control. PJRT dispatch is in-order on
+this stack, so the stream distinction is absorbed by the queue; the verbs
+delegate to the standard collectives."""
+from .collective import (  # noqa: F401
+    all_gather,
+    all_reduce,
+    alltoall,
+    alltoall_single,
+    broadcast,
+    reduce,
+    reduce_scatter,
+    scatter,
+)
+
+all_to_all = alltoall
+all_to_all_single = alltoall_single
