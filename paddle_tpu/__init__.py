@@ -13,6 +13,8 @@ __version__ = "0.2.0"
 from . import autograd  # noqa: F401
 from .core.autograd import enable_grad, grad, is_grad_enabled, no_grad, set_grad_enabled  # noqa: F401
 from .core.device import (  # noqa: F401
+    is_compiled_with_cinn,
+    is_compiled_with_rocm,
     is_compiled_with_xpu,
     CPUPlace,
     CUDAPlace,
@@ -21,6 +23,7 @@ from .core.device import (  # noqa: F401
     device_count,
     get_device,
     is_compiled_with_cuda,
+    XPUPlace,
     is_compiled_with_tpu,
     set_device,
 )

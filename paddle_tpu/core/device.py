@@ -56,6 +56,10 @@ def CUDAPlace(device_id: int = 0) -> Place:  # API-parity alias: maps to the acc
     return Place(_default_accelerator(), device_id)
 
 
+def XPUPlace(device_id: int = 0) -> Place:  # API-parity alias (ref XPUPlace)
+    return Place(_default_accelerator(), device_id)
+
+
 def _platform_name(d: jax.Device) -> str:
     p = d.platform
     # the axon tunnel reports TPU devices under an experimental platform name
@@ -103,6 +107,14 @@ def is_compiled_with_cuda() -> bool:  # API parity
 
 def is_compiled_with_xpu() -> bool:  # API parity
     return False
+
+
+def is_compiled_with_rocm() -> bool:  # API parity
+    return False
+
+
+def is_compiled_with_cinn() -> bool:  # API parity (CINN = the reference's
+    return False                      # compiler; XLA plays that role here)
 
 
 def is_compiled_with_tpu() -> bool:
