@@ -61,11 +61,7 @@ def auto_cast(enable=True, custom_white_list=None, custom_black_list=None, level
 amp_guard = auto_cast
 
 
-def maybe_cast_inputs(name: str, datas):
-    """Called by core.dispatch.apply: cast op inputs per AMP policy."""
-    st = amp_state()
-    if st is None:
-        return datas
+def _cast_inputs_with(st, name: str, datas):
     dtype = st["dtype"]
     lvl = st["level"]
     if name in st["black"]:
@@ -76,6 +72,32 @@ def maybe_cast_inputs(name: str, datas):
             d.astype(dtype) if hasattr(d, "dtype") and d.dtype == jnp.float32 else d for d in datas
         )
     return datas
+
+
+def maybe_cast_inputs(name: str, datas):
+    """Called by core.dispatch.apply: cast op inputs per AMP policy."""
+    st = amp_state()
+    if st is None:
+        return datas
+    return _cast_inputs_with(st, name, datas)
+
+
+def capture_cast_fn(name: str, fn):
+    """Static-graph capture runs under a LIVE autocast context but replays
+    later, when the context is gone: snapshot the policy into the recorded
+    fn so the tape carries the same casts the eager path would apply."""
+    st = amp_state()
+    if st is None:
+        return fn
+    if (name not in st["black"] and name not in st["white"]
+            and st["level"] != "O2"):
+        return fn  # this policy can never cast this op: skip the closure
+    snap = dict(st)
+
+    def wrapped(*datas, **kw):
+        return fn(*_cast_inputs_with(snap, name, datas), **kw)
+
+    return wrapped
 
 
 def decorate(models=None, optimizers=None, level="O2", dtype="bfloat16", master_weight=None, save_dtype=None):

@@ -206,9 +206,14 @@ def apply(fn, tensor_args: Tuple, static: Dict[str, Any], *, differentiable: boo
         datas.append(d)
     if symbolic:
         # static-graph capture: a symbolic placeholder (static.data) routes
-        # the op onto its Program's tape instead of executing
+        # the op onto its Program's tape instead of executing. An active
+        # autocast is snapshotted INTO the recorded fn (replay happens
+        # after the context has exited) — static.amp.fp16_guard regions
+        # record the same casts the eager path would apply.
         from ..static.program import capture
 
+        if _amp is not None and _amp.amp_state() is not None:
+            fn = _amp.capture_cast_fn(name, fn)
         return capture(fn, tensor_args, static, name)
     datas = tuple(datas)
     if _amp is not None and _amp.amp_state() is not None:

@@ -48,6 +48,7 @@ from .program import (  # noqa: F401
     program_guard,
 )
 from . import nn  # noqa: F401  (static.nn layer builders over the capture)
+from . import amp  # noqa: F401  (capture-time mixed precision)
 
 
 # -------------------------------------------------- working static surface

@@ -1,0 +1,136 @@
+"""paddle.static.amp — mixed precision for static-graph programs
+(ref:python/paddle/static/amp/decorator.py decorate, fp16_lists.py
+AutoMixedPrecisionLists, fp16_utils.py fp16_guard/cast_* — the reference
+rewrites the Program, inserting cast ops around white/black-listed ops).
+
+TPU-native: static capture RUNS the eager ops onto the Program tape, so
+mixed precision is applied AT CAPTURE TIME — build the forward under
+``fp16_guard()`` (or pass ``use_amp_guard``-scoped code) and the tape
+records the exact cast structure the reference's pass would have inserted;
+``decorate`` wraps the optimizer so ``minimize`` composes with it and pure
+modes cast the captured parameters."""
+from __future__ import annotations
+
+from typing import Optional
+
+from .. import amp as _amp
+
+__all__ = ["decorate", "fp16_guard", "bf16_guard", "CustomOpLists",
+           "AutoMixedPrecisionLists", "cast_model_to_fp16",
+           "cast_parameters_to_fp16"]
+
+
+class AutoMixedPrecisionLists:
+    """White/black op-name lists consumed by the capture-time autocast
+    (ref fp16_lists.py:AutoMixedPrecisionLists)."""
+
+    def __init__(self, custom_white_list=None, custom_black_list=None,
+                 custom_black_varnames=None, dtype="float16"):
+        self.white_list = set(custom_white_list or ())
+        self.black_list = set(custom_black_list or ())
+        self.black_varnames = set(custom_black_varnames or ())
+        self.dtype = dtype
+
+
+CustomOpLists = AutoMixedPrecisionLists
+
+
+def fp16_guard(dtype: str = "float16"):
+    """Context manager: ops built inside record in reduced precision
+    (capture-time equivalent of the reference's fp16_guard region)."""
+    return _amp.auto_cast(level="O1", dtype=dtype)
+
+
+def bf16_guard():
+    return fp16_guard("bfloat16")
+
+
+def cast_parameters_to_fp16(place=None, program=None, scope=None,
+                            to_fp16_var_names=None, dtype="float16"):
+    """Cast a capture Program's floating parameters to the AMP dtype
+    (the pure-fp16 half of ref cast_model_to_fp16)."""
+    from ..core.dtype import convert_dtype_arg, is_floating
+    from .program import default_main_program
+
+    program = program or default_main_program()
+    dt = convert_dtype_arg(dtype)
+    names = set(to_fp16_var_names or ())
+    for i, p in enumerate(program._params):
+        # same naming scheme the Executor uses for checkpoint keys
+        if names and (p.name or f"p{i}") not in names:
+            continue
+        if is_floating(p._data.dtype):
+            p._data = p._data.astype(dt)
+
+
+def cast_model_to_fp16(program=None, amp_lists=None, use_fp16_guard=True,
+                       dtype="float16"):
+    """Pure-mode cast: parameters referenced by the Program move to the
+    AMP dtype (op-level casting happens at capture via the guard)."""
+    cast_parameters_to_fp16(program=program, dtype=dtype)
+
+
+class OptimizerWithMixedPrecision:
+    """ref decorator.py OptimizerWithMixedPrecision: delegates to the inner
+    optimizer; ``amp_init`` performs the pure-mode parameter cast; loss
+    scaling is carried for the float16 path (bf16 needs none)."""
+
+    def __init__(self, optimizer, amp_lists=None, level="O1",
+                 dtype="float16", init_loss_scaling=2.0 ** 15,
+                 use_dynamic_loss_scaling=True, **kw):
+        self._inner = optimizer
+        self._program = None  # recorded by minimize (the loss's Program)
+        self.amp_lists = amp_lists or AutoMixedPrecisionLists(dtype=dtype)
+        self.level = level
+        self.dtype = dtype
+        self.init_loss_scaling = float(init_loss_scaling)
+        # reference default: dynamic loss scaling ON (None means default)
+        self.use_dynamic_loss_scaling = (True if use_dynamic_loss_scaling
+                                         is None
+                                         else bool(use_dynamic_loss_scaling))
+        if level == "O2":
+            # pure low precision trains against f32 master slots, exactly
+            # as the eager amp.decorate O2 path does
+            optimizer._multi_precision = True
+
+    def __getattr__(self, item):
+        if item == "_inner":  # copy/pickle probe before __init__ ran
+            raise AttributeError(item)
+        return getattr(self._inner, item)
+
+    def amp_init(self, place=None, scope=None, test_program=None,
+                 use_fp16_test=False, program=None):
+        """Pure modes (O2) cast the captured parameters (ref amp_init).
+        Casts the Program ``minimize`` saw (falling back to an explicit
+        ``program`` or the current default) — amp_init after the guard
+        exits must still hit the right Program."""
+        if self.level == "O2":
+            cast_parameters_to_fp16(place, program=program or self._program,
+                                    dtype=self.dtype)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        from .program import _sym_owner, is_symbolic
+
+        if is_symbolic(loss):
+            self._program = _sym_owner.get(loss._sym_id)
+        return self._inner.minimize(loss, startup_program=startup_program)
+
+
+def decorate(optimizer, amp_lists=None, init_loss_scaling=2.0 ** 15,
+             incr_every_n_steps=1000, decr_every_n_nan_or_inf=2,
+             incr_ratio=2.0, decr_ratio=0.8,
+             use_dynamic_loss_scaling=None,  # None -> reference default True
+             amp_dtype: str = "float16",
+             level: str = "O1", use_pure_fp16: Optional[bool] = None,
+             use_fp16_guard=None, use_bf16=False):
+    """Wrap an optimizer for static-graph mixed precision (ref decorate).
+    ``use_pure_fp16=True`` (legacy spelling) maps to level='O2'."""
+    if use_pure_fp16:
+        level = "O2"
+    if use_bf16:
+        amp_dtype = "bfloat16"
+    return OptimizerWithMixedPrecision(
+        optimizer, amp_lists=amp_lists, level=level, dtype=amp_dtype,
+        init_loss_scaling=init_loss_scaling,
+        use_dynamic_loss_scaling=use_dynamic_loss_scaling)

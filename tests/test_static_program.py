@@ -234,3 +234,55 @@ def test_default_main_program_capture_without_guard():
                      fetch_list=[y])
     np.testing.assert_allclose(out, [2, 3, 4])
     assert len(static.default_main_program().ops) > before
+
+
+class TestStaticAmp:
+    """paddle.static.amp: capture-time mixed precision (the reference
+    rewrites the Program inserting casts; here the guard records them)."""
+
+    def test_fp16_guard_records_bf16_and_trains(self):
+        import numpy as np
+
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [None, 8], "float32")
+            y = static.data("y", [None, 1], "float32")
+            with static.amp.fp16_guard("bfloat16"):
+                h = static.nn.fc(x, size=16, activation="relu")
+                out = static.nn.fc(h, size=1)
+            assert "bfloat16" in str(h.dtype)  # the capture recorded casts
+            loss = paddle.mean((out - y) ** 2)
+            opt = static.amp.decorate(
+                paddle.optimizer.SGD(learning_rate=0.05),
+                amp_dtype="bfloat16")
+            opt.minimize(loss)
+        exe = static.Executor()
+        exe.run(startup)
+        rng = np.random.default_rng(0)
+        X = rng.standard_normal((32, 8), dtype=np.float32)
+        Y = (X @ rng.standard_normal((8, 1), dtype=np.float32)).astype(
+            np.float32)
+        first = last = None
+        for _ in range(40):
+            (lv,) = exe.run(main, feed={"x": X, "y": Y}, fetch_list=[loss])
+            first = first if first is not None else float(lv)
+            last = float(lv)
+        assert last < 0.5 * first
+
+    def test_pure_mode_casts_parameters(self):
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [None, 4], "float32")
+            with static.amp.bf16_guard():
+                out = static.nn.fc(x, size=3)
+            opt = static.amp.decorate(
+                paddle.optimizer.SGD(learning_rate=0.1),
+                amp_dtype="bfloat16", use_pure_fp16=True)
+            assert opt.level == "O2"
+            assert opt._inner._multi_precision  # f32 master slots engaged
+            assert opt.use_dynamic_loss_scaling  # reference default
+            opt.minimize(paddle.mean(out))
+        # amp_init OUTSIDE the guard must still cast THE loss's program
+        # (minimize recorded it), not whatever default is current
+        opt.amp_init()
+        assert all("bfloat16" in str(p._data.dtype) for p in main._params)
