@@ -286,3 +286,38 @@ class TestStaticAmp:
         # (minimize recorded it), not whatever default is current
         opt.amp_init()
         assert all("bfloat16" in str(p._data.dtype) for p in main._params)
+
+    def test_pure_o2_static_training_uses_master_weights(self):
+        """Full pure-bf16 static train: params bf16, f32 master slots in the
+        compiled update, loss converges (sub-bf16-ulp updates survive)."""
+        import numpy as np
+
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [None, 8], "float32")
+            y = static.data("y", [None, 1], "float32")
+            with static.amp.bf16_guard():
+                h = static.nn.fc(x, size=16, activation="relu")
+                out = static.nn.fc(h, size=1)
+            loss = paddle.mean((out - y) ** 2)
+            opt = static.amp.decorate(
+                paddle.optimizer.Adam(learning_rate=1e-2),
+                amp_dtype="bfloat16", use_pure_fp16=True)
+            opt.minimize(loss)
+        opt.amp_init()
+        assert all("bfloat16" in str(p._data.dtype) for p in main._params)
+        exe = static.Executor()
+        exe.run(startup)
+        rng = np.random.default_rng(1)
+        X = rng.standard_normal((32, 8), dtype=np.float32)
+        Y = (X @ rng.standard_normal((8, 1), dtype=np.float32)).astype(
+            np.float32)
+        first = last = None
+        for _ in range(50):
+            (lv,) = exe.run(main, feed={"x": X, "y": Y}, fetch_list=[loss])
+            first = first if first is not None else float(lv)
+            last = float(lv)
+        assert last < 0.3 * first, (first, last)
+        slots = main._opt_state["slots"]
+        assert any("master_weight" in s for s in
+                   (slots.values() if isinstance(slots, dict) else slots))
