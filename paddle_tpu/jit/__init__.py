@@ -526,7 +526,10 @@ class TranslatedLayer:
 
     def __call__(self, *inputs):
         arrs = [i._data if isinstance(i, Tensor) else jnp.asarray(i) for i in inputs]
-        return Tensor(self._exported.call(self._params, *arrs))
+        out = self._exported.call(self._params, *arrs)
+        if isinstance(out, (tuple, list)):  # multi-fetch static exports
+            return tuple(Tensor(o) for o in out)
+        return Tensor(out)
 
     def forward(self, *inputs):
         return self(*inputs)

@@ -16,19 +16,67 @@ from ..jit import InputSpec  # noqa: F401
 
 def save_inference_model(path_prefix, feed_vars, fetch_vars=None, executor=None,
                          program=None, **kwargs):
-    """TPU-native contract: save_inference_model(path, layer, input_spec).
+    """Both reference forms work:
 
-    (feed_vars = the Layer, fetch_vars = list of InputSpec; the legacy
-    (feed, fetch, executor, program) form is not representable.)"""
+    * ``save_inference_model(path, layer, input_spec)`` — jit.save export.
+    * ``save_inference_model(path, feed_vars, fetch_vars, exe)`` — the
+      legacy static form: ``feed_vars``/``fetch_vars`` are symbolic tensors
+      of a capture Program; its replay (with parameters baked) exports as
+      StableHLO in the same ``.pdmodel``/``.pdparams`` layout jit.load and
+      the inference Predictor consume. ``None`` dims in the placeholders'
+      declared shapes export as symbolic (dynamic-batch) dimensions.
+      Build (or ``program.clone(for_test=True)``) the eval-mode graph
+      before exporting — the tape is exported as captured."""
     from ..jit import save as jit_save
     from ..nn.layer import Layer
 
     if isinstance(feed_vars, Layer):
         jit_save(feed_vars, path_prefix, input_spec=fetch_vars)
         return
-    raise NotImplementedError(
-        "legacy Program-based save_inference_model is not supported; pass "
-        "(path, layer, input_spec) — the model exports as StableHLO")
+
+    import os
+    import pickle
+
+    import jax
+    from jax import export as jexport
+
+    from .program import _sym_owner, is_symbolic
+
+    feeds = list(feed_vars) if isinstance(feed_vars, (list, tuple)) else [feed_vars]
+    fetches = (list(fetch_vars) if isinstance(fetch_vars, (list, tuple))
+               else [fetch_vars])
+    if (not feeds or not all(is_symbolic(f) for f in feeds)
+            or not fetches or not all(is_symbolic(f) for f in fetches)):
+        raise ValueError(
+            "save_inference_model expects a Layer + input_spec, or symbolic "
+            "feed/fetch tensors from a static capture Program")
+    prog = program or _sym_owner.get(feeds[0]._sym_id)
+    if prog is None:
+        raise ValueError("the feed tensors' Program is no longer alive")
+
+    arrays = [p._data for p in prog._params]
+
+    def fwd(param_arrays, *feed_arrays):
+        env = {f._sym_id: a for f, a in zip(feeds, feed_arrays)}
+        env = prog._replay(env, list(param_arrays))
+        outs = tuple(env[f._sym_id] for f in fetches)
+        return outs if len(outs) > 1 else outs[0]
+
+    scope = jexport.SymbolicScope()
+    sds = [InputSpec(list(getattr(f, "_feed_shape", f.shape)),
+                     dtype=f.dtype).to_sds(scope=scope, prefix="d")
+           for f in feeds]
+    param_sds = [jax.ShapeDtypeStruct(a.shape, a.dtype) for a in arrays]
+    exp = jexport.export(jax.jit(fwd))(param_sds, *sds)
+
+    os.makedirs(os.path.dirname(path_prefix) or ".", exist_ok=True)
+    keys = [f"p{i}" for i in range(len(arrays))]
+    with open(path_prefix + ".pdmodel", "wb") as f:
+        pickle.dump({"stablehlo": exp.serialize(), "param_keys": keys}, f,
+                    protocol=4)
+    with open(path_prefix + ".pdparams", "wb") as f:
+        pickle.dump({k: np.asarray(a) for k, a in zip(keys, arrays)}, f,
+                    protocol=4)
 
 
 def load_inference_model(path_prefix, executor=None, **kwargs):

@@ -321,3 +321,61 @@ class TestStaticAmp:
         slots = main._opt_state["slots"]
         assert any("master_weight" in s for s in
                    (slots.values() if isinstance(slots, dict) else slots))
+
+
+class TestStaticInferenceExport:
+    def test_legacy_save_inference_model_round_trip(self, tmp_path):
+        """The legacy (feed, fetch, exe, program) export form: Program
+        replay -> StableHLO .pdmodel, dynamic batch, Predictor-servable."""
+        import numpy as np
+
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [None, 8], "float32")
+            h = static.nn.fc(x, size=16, activation="relu")
+            out = static.nn.fc(h, size=3)
+        exe = static.Executor()
+        exe.run(startup)
+        X = np.random.randn(4, 8).astype(np.float32)
+        (want,) = exe.run(main, feed={"x": X}, fetch_list=[out])
+
+        prefix = str(tmp_path / "m")
+        static.save_inference_model(prefix, [x], [out], exe, program=main)
+        loaded = static.load_inference_model(prefix)
+        np.testing.assert_allclose(loaded(paddle.to_tensor(X)).numpy(),
+                                   np.asarray(want), atol=1e-5)
+        X2 = np.random.randn(7, 8).astype(np.float32)  # dynamic batch
+        assert loaded(paddle.to_tensor(X2)).numpy().shape == (7, 3)
+
+    def test_bad_feed_vars_raise(self):
+        import pytest
+
+        with pytest.raises(ValueError, match="symbolic"):
+            static.save_inference_model("/tmp/never", [paddle.to_tensor(1.0)],
+                                        [paddle.to_tensor(2.0)], None)
+
+    def test_multi_fetch_export(self, tmp_path):
+        import numpy as np
+
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [None, 4], "float32")
+            h = static.nn.fc(x, size=8, activation="relu")
+            out = static.nn.fc(h, size=2)
+        static.Executor().run(startup)
+        X = np.random.randn(3, 4).astype(np.float32)
+        exe = static.Executor()
+        want_h, want_out = exe.run(main, feed={"x": X},
+                                   fetch_list=[h, out])
+        prefix = str(tmp_path / "mm")
+        static.save_inference_model(prefix, [x], [h, out], exe, program=main)
+        got_h, got_out = static.load_inference_model(prefix)(
+            paddle.to_tensor(X))
+        np.testing.assert_allclose(got_h.numpy(), np.asarray(want_h),
+                                   atol=1e-5)
+        np.testing.assert_allclose(got_out.numpy(), np.asarray(want_out),
+                                   atol=1e-5)
+        import pytest
+
+        with pytest.raises(ValueError, match="symbolic"):
+            static.save_inference_model(prefix, [x], None, exe, program=main)
