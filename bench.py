@@ -54,19 +54,23 @@ cfg_seq_len = 1024  # set in main() before flop accounting
 
 def _tuned_knobs() -> dict:
     """Best on-chip sweep point (benches/BENCH_TUNED.json, written by
-    benches/sweep.py after a successful sweep). STRICTLY OPT-IN via
-    BENCH_USE_TUNED=1: the plain ``python bench.py`` the driver runs keeps
-    the known-safe defaults (a speculative tuned config must never cost the
-    round its record), while the retry loops can ask for the tuned point
-    once it has been measured."""
-    if os.environ.get("BENCH_USE_TUNED") != "1":
+    benches/sweep.py after a successful sweep). Applied BY DEFAULT once it
+    exists: sweep.py only writes it from an error-free on-chip record, so
+    the point is measured, not speculative — and the persistent compilation
+    cache (primed by the sweep run itself) makes the driver's plain
+    ``python bench.py`` reach it warm. BENCH_USE_TUNED=0 restores the
+    conservative defaults; =1 forces it even if the record looks odd."""
+    mode = os.environ.get("BENCH_USE_TUNED", "auto")
+    if mode == "0":
         return {}
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "benches", "BENCH_TUNED.json")
     try:
         with open(path) as f:
-            point = json.load(f).get("sweep_point", {})
-        return {k: str(v) for k, v in point.items()}
+            rec = json.load(f)
+        if mode != "1" and (rec.get("error") or not rec.get("mfu")):
+            return {}
+        return {k: str(v) for k, v in rec.get("sweep_point", {}).items()}
     except (OSError, ValueError):
         return {}
 
@@ -135,6 +139,23 @@ def main():
     if want:
         os.environ["BENCH_PLATFORM"] = want  # the watchdog guard reads it
         jax.config.update("jax_platforms", want)
+
+    # Persistent compilation cache: a cold GPT compile through the
+    # remote-compile tunnel is ~8-15 min — longer than most tunnel windows
+    # (round 4's second window was ~3 min and yielded nothing). With the
+    # compiled executable cached on disk, a warm `python bench.py` reaches
+    # its first timed step in well under 2 min, so a short window still
+    # produces a driver-valid record. Cache entries are keyed on HLO +
+    # compile options + backend, so CPU-smoke and TPU runs never collide.
+    cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR") or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "benches", ".jax_cache")
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    except Exception as e:  # cache is an optimization, never a blocker
+        print(f"# compilation cache unavailable: {e}", flush=True)
 
     watchdog = _arm_watchdog()
 

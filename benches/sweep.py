@@ -60,7 +60,11 @@ def main():
     for point in POINTS:
         # a cold compile through the remote-compile tunnel is ~8 min and the
         # transient-flake retry in bench.py can double it: 30 min watchdog
-        env = dict(os.environ, **point, BENCH_WATCHDOG="1800")
+        # BENCH_USE_TUNED=0: each point is exactly its own knobs — without
+        # this, a BENCH_TUNED.json written by an earlier pass would leak its
+        # values into points that don't pin every knob
+        env = dict(os.environ, **point, BENCH_WATCHDOG="1800",
+                   BENCH_USE_TUNED="0")
         try:
             r = subprocess.run([sys.executable, BENCH], env=env,
                                capture_output=True, text=True, timeout=2400)

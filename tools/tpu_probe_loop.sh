@@ -1,0 +1,16 @@
+#!/bin/bash
+# Probe the TPU tunnel every 120s; log status; on success touch a flag file.
+LOG=/root/repo/benches/tpu_logs/probe_r5.log
+mkdir -p /root/repo/benches/tpu_logs
+while true; do
+  ts=$(date -u +%FT%TZ)
+  out=$(timeout 90 python -c "import jax; d=jax.devices(); print(d[0].platform, len(d))" 2>&1 | tail -1)
+  if echo "$out" | grep -q "^tpu"; then
+    echo "$ts LIVE $out" >> "$LOG"
+    touch /root/repo/benches/tpu_logs/TPU_LIVE
+  else
+    echo "$ts DEAD $out" >> "$LOG"
+    rm -f /root/repo/benches/tpu_logs/TPU_LIVE
+  fi
+  sleep 120
+done
