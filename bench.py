@@ -68,8 +68,15 @@ def _tuned_knobs() -> dict:
     try:
         with open(path) as f:
             rec = json.load(f)
-        if mode != "1" and (rec.get("error") or not rec.get("mfu")):
-            return {}
+        if mode != "1":
+            if rec.get("error") or not rec.get("mfu"):
+                return {}
+            # the tuned point must BEAT the standing on-chip headline (MFU
+            # 0.1592 at 768h/12L b16, benches/tpu_logs/bench_r4_try2.log) —
+            # a sweep where every high-intensity point OOMed could otherwise
+            # publish a worse "best" and cost the round its record
+            if rec["mfu"] <= 0.16:
+                return {}
         return {k: str(v) for k, v in rec.get("sweep_point", {}).items()}
     except (OSError, ValueError):
         return {}
@@ -156,6 +163,13 @@ def main():
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
     except Exception as e:  # cache is an optimization, never a blocker
         print(f"# compilation cache unavailable: {e}", flush=True)
+
+    # a tuned large config on a COLD compile cache (fresh checkout / wiped
+    # benches/.jax_cache) can push compile past the 1500s default; don't let
+    # the watchdog turn a slow-but-working run into a zero. Must happen
+    # before arming — _arm_watchdog reads the env once.
+    if _tuned_knobs() and "BENCH_WATCHDOG" not in os.environ:
+        os.environ["BENCH_WATCHDOG"] = "2100"
 
     watchdog = _arm_watchdog()
 

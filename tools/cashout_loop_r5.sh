@@ -57,6 +57,22 @@ while true; do
   for spec in "${STAGES[@]}"; do
     read -r name t cmd <<<"$spec"
     [ -f "$MARKS/$name" ] && continue
+    # bench_tuned only means something after the sweep published a winner
+    # that bench.py's mfu>0.16 gate will actually adopt — running earlier
+    # (or on an under-bar winner) would just duplicate bench_headline and
+    # never warm the tuned config's cache entry. Mirror the gate here.
+    if [ "$name" = bench_tuned ]; then
+      # plain json check — strip the axon env so sitecustomize's register()
+      # (which dials the tunnel at interpreter start and can hang) is skipped
+      timeout 60 env -u PALLAS_AXON_POOL_IPS python - <<'PY' || continue
+import json, sys
+try:
+    rec = json.load(open("benches/BENCH_TUNED.json"))
+except Exception:
+    sys.exit(1)
+sys.exit(0 if not rec.get("error") and (rec.get("mfu") or 0) > 0.16 else 1)
+PY
+    fi
     if ! probe > "$LOGS/r5_probe_${attempt}_${name}.log" 2>&1; then
       echo "[loop] tunnel down before $name (pass $attempt)"
       break

@@ -4,8 +4,10 @@ LOG=/root/repo/benches/tpu_logs/probe_r5.log
 mkdir -p /root/repo/benches/tpu_logs
 while true; do
   ts=$(date -u +%FT%TZ)
-  out=$(timeout 90 python -c "import jax; d=jax.devices(); print(d[0].platform, len(d))" 2>&1 | tail -1)
-  if echo "$out" | grep -q "^tpu"; then
+  out=$(timeout 90 python -c "import jax; d=jax.devices(); print('PLAT', d[0].platform, len(d))" 2>&1 | grep "^PLAT" | tail -1)
+  # the axon tunnel reports the chip under the experimental 'axon' platform
+  # name (core/device.py maps axon->tpu); anything non-cpu that answered is live
+  if echo "$out" | grep -Eq "^PLAT (tpu|axon)"; then
     echo "$ts LIVE $out" >> "$LOG"
     touch /root/repo/benches/tpu_logs/TPU_LIVE
   else
