@@ -310,24 +310,34 @@ class DataLoader:
         return it
 
     def _iter_batches(self, idx_plan=None):
+        """NOT a generator: worker processes must fork on the CALLING
+        thread, eagerly — when the buffer reader is on, the returned
+        iterator is driven by the producer thread, and forking from an
+        already-multi-threaded process is a latent deadlock hazard (and a
+        DeprecationWarning on 3.12+)."""
         if self.num_workers == 0:
-            for b in self._batches(idx_plan):
-                yield self._to_device(b)
-            return
+            def gen_inline():
+                for b in self._batches(idx_plan):
+                    yield self._to_device(b)
+
+            return gen_inline()
         if self.persistent_workers and not self._iterable_mode:
             if self._persistent_iter is None:
                 self._persistent_iter = _MultiProcessIter(self)
             it = self._persistent_iter
-            it.start_epoch(idx_plan)
         else:
             it = _MultiProcessIter(self)
-            it.start_epoch(idx_plan)
-        try:
-            for b in it.epoch_batches():
-                yield self._to_device(b)
-        finally:
-            if it is not self._persistent_iter:
-                it.shutdown()
+        it.start_epoch(idx_plan)
+
+        def gen_workers():
+            try:
+                for b in it.epoch_batches():
+                    yield self._to_device(b)
+            finally:
+                if it is not self._persistent_iter:
+                    it.shutdown()
+
+        return gen_workers()
 
     def __del__(self):  # pragma: no cover
         try:
@@ -585,6 +595,15 @@ class _MultiProcessIter:
                 break
             if kind == "batch":
                 worker_mod.discard(payload)
+
+    def __del__(self):  # pragma: no cover
+        # workers now fork EAGERLY in _iter_batches (fork-on-calling-thread
+        # contract); an iterator obtained but never advanced would otherwise
+        # leak its worker processes — reap them at GC as a last resort
+        try:
+            self.shutdown()
+        except Exception:
+            pass
 
 
 def get_worker_info():

@@ -285,7 +285,10 @@ class TrainStep:
                 param_arrays, grads, opt_state, lr)
             # every buffer passed through the scan carry: return them all
             # (loop-invariant ones come back value-equal; __call__ rebinds)
-            return lsum / k, new_params, new_state, new_bufs
+            # reported loss follows the configured semantics: the microbatch
+            # MEAN under avg=True, the SUM under avg=False — matching what
+            # the gradients were scaled by
+            return lsum * scale, new_params, new_state, new_bufs
 
         self._jit_fn = _step
 

@@ -198,7 +198,10 @@ def spectral_norm(layer: Layer, name: str = "weight",
     if dim is None:
         from .layers_common import Linear
 
-        dim = 1 if isinstance(layer, Linear) else 0
+        # transposed convs carry weight as [in, out, *k]: the output axis is
+        # 1 there too (ref spectral_norm_hook.py dim-resolution rule)
+        dim = 1 if (isinstance(layer, Linear)
+                    or getattr(layer, "_transpose", False)) else 0
     dim = dim % arr.ndim
     h = arr.shape[dim]
     wsz = int(np.prod(arr.shape)) // h

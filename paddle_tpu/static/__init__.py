@@ -62,10 +62,27 @@ def save_inference_model(path_prefix, feed_vars, fetch_vars=None, executor=None,
         outs = tuple(env[f._sym_id] for f in fetches)
         return outs if len(outs) > 1 else outs[0]
 
+    from ..core.dtype import convert_dtype_arg
+
     scope = jexport.SymbolicScope()
-    sds = [InputSpec(list(getattr(f, "_feed_shape", f.shape)),
-                     dtype=f.dtype).to_sds(scope=scope, prefix="d")
-           for f in feeds]
+    # Symbol-sharing rule: a dynamic LEADING dim is the batch and is shared
+    # across feeds (multi-input programs — input+label, two-tower — run all
+    # feeds at one batch size, and ops combining them need equal symbols);
+    # dynamic dims PAST dim 0 (independent None seq-lengths etc.) get
+    # per-feed symbols so they are NOT silently constrained equal.
+    sds = []
+    for i, f in enumerate(feeds):
+        shape = list(getattr(f, "_feed_shape", f.shape))
+        if any(s is None or (isinstance(s, int) and s < 0) for s in shape):
+            parts = [("dbatch" if j == 0 else f"f{i}_d{j}")
+                     if (s is None or (isinstance(s, int) and s < 0))
+                     else str(int(s))
+                     for j, s in enumerate(shape)]
+            shp = tuple(jexport.symbolic_shape(",".join(parts), scope=scope))
+        else:
+            shp = tuple(int(s) for s in shape)
+        sds.append(jax.ShapeDtypeStruct(shp, np.dtype(convert_dtype_arg(
+            f.dtype))))
     param_sds = [jax.ShapeDtypeStruct(a.shape, a.dtype) for a in arrays]
     exp = jexport.export(jax.jit(fwd))(param_sds, *sds)
 

@@ -72,11 +72,20 @@ def cast_model_to_fp16(program=None, amp_lists=None, use_fp16_guard=True,
 
 class OptimizerWithMixedPrecision:
     """ref decorator.py OptimizerWithMixedPrecision: delegates to the inner
-    optimizer; ``amp_init`` performs the pure-mode parameter cast; loss
-    scaling is carried for the float16 path (bf16 needs none)."""
+    optimizer; ``amp_init`` performs the pure-mode parameter cast.
+
+    float16 training applies REAL loss scaling inside the compiled train
+    step (ref decorator.py backward/apply_gradients + update_loss_scaling):
+    the captured loss is multiplied by the live scale (carried in the
+    optimizer state pytree), gradients are unscaled before the inner
+    update, non-finite gradients skip the update entirely, and the scale
+    adjusts dynamically (incr after N good steps / decr after M bad ones).
+    bfloat16 needs none of this and stays pass-through."""
 
     def __init__(self, optimizer, amp_lists=None, level="O1",
                  dtype="float16", init_loss_scaling=2.0 ** 15,
+                 incr_every_n_steps=1000, decr_every_n_nan_or_inf=2,
+                 incr_ratio=2.0, decr_ratio=0.8,
                  use_dynamic_loss_scaling=True, **kw):
         self._inner = optimizer
         self._program = None  # recorded by minimize (the loss's Program)
@@ -84,6 +93,10 @@ class OptimizerWithMixedPrecision:
         self.level = level
         self.dtype = dtype
         self.init_loss_scaling = float(init_loss_scaling)
+        self.incr_every_n_steps = int(incr_every_n_steps)
+        self.decr_every_n_nan_or_inf = int(decr_every_n_nan_or_inf)
+        self.incr_ratio = float(incr_ratio)
+        self.decr_ratio = float(decr_ratio)
         # reference default: dynamic loss scaling ON (None means default)
         self.use_dynamic_loss_scaling = (True if use_dynamic_loss_scaling
                                          is None
@@ -97,6 +110,97 @@ class OptimizerWithMixedPrecision:
         if item == "_inner":  # copy/pickle probe before __init__ ran
             raise AttributeError(item)
         return getattr(self._inner, item)
+
+    # ----------------------------------------------------- loss scaling
+    # Functional hooks consumed by static/program.py's compiled train step.
+
+    @property
+    def _scaling_active(self) -> bool:
+        # active for float16 even at init scale 1.0: the finite-check /
+        # skip-on-overflow / dynamic growth must run regardless of the
+        # starting value (ref decorator.py always inserts
+        # check_finite_and_unscale + update_loss_scaling for fp16)
+        return self.dtype == "float16"
+
+    def _capture_loss_scale(self, state):
+        """Scale the captured loss BEFORE differentiation so fp16 gradient
+        underflow is actually prevented (scaling after the fact would be a
+        no-op numerically). Returns None when scaling is off so the
+        Program's loss_fn stays untouched."""
+        if not self._scaling_active:
+            return None
+        return state["amp_loss_scaling"]
+
+    def init_state(self, params):
+        import jax.numpy as jnp
+
+        state = self._inner.init_state(params)
+        if self._scaling_active:
+            state["amp_loss_scaling"] = jnp.asarray(self.init_loss_scaling,
+                                                    jnp.float32)
+            state["amp_good_steps"] = jnp.zeros((), jnp.int32)
+            state["amp_bad_steps"] = jnp.zeros((), jnp.int32)
+        return state
+
+    def apply_gradients(self, params, grads, state, lr=None):
+        """Unscale -> finite check -> inner update (skipped wholesale on
+        nan/inf) -> dynamic scale adjustment. Pure pytree-in/pytree-out, so
+        it jits inside the Program's train step."""
+        import jax
+        import jax.numpy as jnp
+
+        if not self._scaling_active:
+            return self._inner.apply_gradients(params, grads, state, lr)
+
+        def arr(x):
+            return x._data if hasattr(x, "_data") else x
+
+        scale = state["amp_loss_scaling"]
+        inv = (1.0 / scale).astype(jnp.float32)
+        unscaled = {n: arr(g) * inv.astype(arr(g).dtype)
+                    for n, g in grads.items()}
+        finite = jnp.stack([jnp.all(jnp.isfinite(g))
+                            for g in unscaled.values()])
+        found_inf = jnp.logical_not(jnp.all(finite))
+
+        inner_state = {k: v for k, v in state.items()
+                       if not k.startswith("amp_")}
+        new_p, new_s = self._inner.apply_gradients(params, unscaled,
+                                                   inner_state, lr)
+        # skip the whole update on overflow: params and EVERY piece of
+        # optimizer state (slots, step) roll back to their pre-step values
+        new_p = {n: jnp.where(found_inf, arr(params[n]), arr(new_p[n]))
+                 for n in new_p}
+        new_s = jax.tree_util.tree_map(
+            lambda a, b: jnp.where(found_inf, b, a), new_s, inner_state)
+
+        if self.use_dynamic_loss_scaling:
+            good = jnp.where(found_inf, 0, state["amp_good_steps"] + 1)
+            bad = jnp.where(found_inf, state["amp_bad_steps"] + 1, 0)
+            incr = good >= self.incr_every_n_steps
+            decr = bad >= self.decr_every_n_nan_or_inf
+            scale = jnp.where(decr, scale * self.decr_ratio,
+                              jnp.where(incr, scale * self.incr_ratio,
+                                        scale))
+            scale = jnp.clip(scale, 1.0, 2.0 ** 32)
+            good = jnp.where(incr, 0, good)
+            bad = jnp.where(decr, 0, bad)
+            new_s["amp_good_steps"] = good
+            new_s["amp_bad_steps"] = bad
+        else:
+            new_s["amp_good_steps"] = state["amp_good_steps"]
+            new_s["amp_bad_steps"] = state["amp_bad_steps"]
+        new_s["amp_loss_scaling"] = scale
+        return new_p, new_s
+
+    def get_loss_scaling(self):
+        """Live loss scale (ref decorator.py get_loss_scaling): reads the
+        trained Program's state when one exists, else the initial value."""
+        prog = self._program
+        st = getattr(prog, "_opt_state", None) if prog is not None else None
+        if st and "amp_loss_scaling" in st:
+            return float(st["amp_loss_scaling"])
+        return self.init_loss_scaling
 
     def amp_init(self, place=None, scope=None, test_program=None,
                  use_fp16_test=False, program=None):
@@ -114,6 +218,13 @@ class OptimizerWithMixedPrecision:
 
         if is_symbolic(loss):
             self._program = _sym_owner.get(loss._sym_id)
+            if self._scaling_active:
+                # register THIS wrapper as the train optimizer so the
+                # compiled step routes through our scale/unscale/skip
+                # apply_gradients; bf16 (no scaling) keeps the inner fast
+                # path registered directly
+                self._program.set_train(self, loss)
+                return None, None
         return self._inner.minimize(loss, startup_program=startup_program)
 
 
@@ -133,4 +244,7 @@ def decorate(optimizer, amp_lists=None, init_loss_scaling=2.0 ** 15,
     return OptimizerWithMixedPrecision(
         optimizer, amp_lists=amp_lists, level=level, dtype=amp_dtype,
         init_loss_scaling=init_loss_scaling,
+        incr_every_n_steps=incr_every_n_steps,
+        decr_every_n_nan_or_inf=decr_every_n_nan_or_inf,
+        incr_ratio=incr_ratio, decr_ratio=decr_ratio,
         use_dynamic_loss_scaling=use_dynamic_loss_scaling)

@@ -372,6 +372,11 @@ class Executor:
         names = [n if raw.count(n) == 1 else f"{n}#{raw[:j].count(n)}"
                  for j, n in enumerate(raw)]
 
+        # static-AMP float16: the decorated optimizer exposes the live loss
+        # scale from its state; multiplying BEFORE differentiation keeps
+        # fp16 gradients out of the underflow range (static/amp.py)
+        scale_hook = getattr(opt, "_capture_loss_scale", None)
+
         @jax.jit
         def train_step(feed_arrays, param_arrays, opt_state, lr):
             def loss_fn(trainables):
@@ -380,7 +385,12 @@ class Executor:
                     arrays[i] = a
                 env = {placeholders[n]: feed_arrays[n] for n in feed_names}
                 env = program._replay(env, arrays)
-                return env[loss_sid].astype(jnp.float32), env
+                loss = env[loss_sid].astype(jnp.float32)
+                if scale_hook is not None:
+                    s = scale_hook(opt_state)
+                    if s is not None:
+                        loss = loss * s
+                return loss, env
 
             trainables = [param_arrays[i] for i in train_idx]
             (loss, env), grads = jax.value_and_grad(loss_fn, has_aux=True)(trainables)
@@ -403,7 +413,10 @@ class Executor:
             for i, a in zip(train_idx, new_trainables):
                 program._params[i]._data = a
             _writeback(bufs)
-            opt._step_count = int(program._opt_state["step"])
+            # the AMP decorator wraps the real optimizer: keep the INNER's
+            # step count authoritative (state_dict/schedulers read it there)
+            getattr(opt, "_inner", opt)._step_count = \
+                int(program._opt_state["step"])
             return outs
 
         return runner

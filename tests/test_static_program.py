@@ -322,6 +322,77 @@ class TestStaticAmp:
         assert any("master_weight" in s for s in
                    (slots.values() if isinstance(slots, dict) else slots))
 
+    def test_fp16_loss_scaling_trains_and_grows_scale(self):
+        """float16 static AMP applies REAL loss scaling in the compiled
+        step (ref decorator.py: scale loss, unscale grads, dynamic
+        update_loss_scaling): loss converges and the scale grows after
+        incr_every_n_steps consecutive finite steps."""
+        import numpy as np
+
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [None, 8], "float32")
+            y = static.data("y", [None, 1], "float32")
+            with static.amp.fp16_guard("float16"):
+                h = static.nn.fc(x, size=16, activation="relu")
+                out = static.nn.fc(h, size=1)
+            loss = paddle.mean((out.astype("float32") - y) ** 2)
+            opt = static.amp.decorate(
+                paddle.optimizer.SGD(learning_rate=0.05),
+                init_loss_scaling=4.0, incr_every_n_steps=2,
+                incr_ratio=2.0, amp_dtype="float16")
+            opt.minimize(loss)
+        exe = static.Executor()
+        exe.run(startup)
+        rng = np.random.default_rng(2)
+        X = rng.standard_normal((32, 8), dtype=np.float32)
+        Y = (X @ rng.standard_normal((8, 1), dtype=np.float32)).astype(
+            np.float32)
+        first = last = None
+        for _ in range(40):
+            (lv,) = exe.run(main, feed={"x": X, "y": Y}, fetch_list=[loss])
+            first = first if first is not None else float(lv)
+            last = float(lv)
+        assert last < 0.5 * first, (first, last)
+        # 40 finite steps with incr every 2: scale grew (clipped at 2^32)
+        assert opt.get_loss_scaling() > 4.0
+        assert "amp_loss_scaling" in main._opt_state
+
+    def test_fp16_overflow_skips_update_and_decreases_scale(self):
+        """A non-finite gradient must leave params AND optimizer state
+        untouched and cut the scale by decr_ratio (ref decorator.py
+        _check_finite_and_unscale + update_loss_scaling)."""
+        import numpy as np
+
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [None, 4], "float32")
+            with static.amp.fp16_guard("float16"):
+                out = static.nn.fc(x, size=3)
+            loss = paddle.mean(out.astype("float32"))
+            opt = static.amp.decorate(
+                paddle.optimizer.SGD(learning_rate=0.1),
+                init_loss_scaling=1024.0, decr_every_n_nan_or_inf=1,
+                decr_ratio=0.5, amp_dtype="float16")
+            opt.minimize(loss)
+        exe = static.Executor()
+        exe.run(startup)
+        ok = np.ones((4, 4), np.float32)
+        exe.run(main, feed={"x": ok}, fetch_list=[loss])  # healthy step
+        before = [np.asarray(p._data).copy() for p in main._params]
+        step_before = int(main._opt_state["step"])
+        # 1e30 overflows float16 at the cast -> inf activations -> inf loss
+        bad = np.full((4, 4), 1e30, np.float32)
+        exe.run(main, feed={"x": bad}, fetch_list=[loss])
+        after = [np.asarray(p._data) for p in main._params]
+        for b, a in zip(before, after):
+            np.testing.assert_array_equal(b, a)
+        assert int(main._opt_state["step"]) == step_before  # update skipped
+        assert opt.get_loss_scaling() == 512.0  # 1024 * 0.5
+        # and the run recovers: a healthy step after the skip still trains
+        exe.run(main, feed={"x": ok}, fetch_list=[loss])
+        assert int(main._opt_state["step"]) == step_before + 1
+
 
 class TestStaticInferenceExport:
     def test_legacy_save_inference_model_round_trip(self, tmp_path):
@@ -379,3 +450,47 @@ class TestStaticInferenceExport:
 
         with pytest.raises(ValueError, match="symbolic"):
             static.save_inference_model(prefix, [x], None, exe, program=main)
+
+    def test_export_shares_batch_symbol_across_feeds(self, tmp_path):
+        """Two feeds with dynamic leading dims combined elementwise: the
+        batch symbol must be SHARED (independent symbols would fail the
+        broadcast at trace time), and the export serves any batch size."""
+        import numpy as np
+
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            a = static.data("a", [None, 4], "float32")
+            b = static.data("b", [None, 4], "float32")
+            out = paddle.add(a, b)
+        exe = static.Executor()
+        exe.run(startup)
+        prefix = str(tmp_path / "twofeed")
+        static.save_inference_model(prefix, [a, b], [out], exe, program=main)
+        served = static.load_inference_model(prefix)
+        for n in (2, 5):
+            A = np.random.randn(n, 4).astype(np.float32)
+            B = np.random.randn(n, 4).astype(np.float32)
+            np.testing.assert_allclose(
+                served(paddle.to_tensor(A), paddle.to_tensor(B)).numpy(),
+                A + B, atol=1e-6)
+
+    def test_export_keeps_non_batch_dynamic_dims_independent(self, tmp_path):
+        """Dynamic dims PAST dim 0 stay per-feed: two None seq-lengths must
+        not be constrained equal by the export (ADVICE r4)."""
+        import numpy as np
+
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            a = static.data("a", [2, None], "float32")
+            b = static.data("b", [2, None], "float32")
+            out = paddle.concat([a, b], axis=1)
+        exe = static.Executor()
+        exe.run(startup)
+        prefix = str(tmp_path / "seqs")
+        static.save_inference_model(prefix, [a, b], [out], exe, program=main)
+        served = static.load_inference_model(prefix)
+        A = np.random.randn(2, 3).astype(np.float32)
+        B = np.random.randn(2, 7).astype(np.float32)  # different seq-len
+        np.testing.assert_allclose(
+            served(paddle.to_tensor(A), paddle.to_tensor(B)).numpy(),
+            np.concatenate([A, B], axis=1), atol=1e-6)
