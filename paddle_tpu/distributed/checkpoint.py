@@ -78,11 +78,18 @@ class AsyncSaveHandle:
     shared checkpointer keeps writing in the background, and orbax's
     temp-dir+rename commit keeps an unfinished save invisible to loads."""
 
-    def __init__(self, ckpt):
+    def __init__(self, ckpt, path=None):
         self._ckpt = ckpt
+        self._path = path
 
     def wait(self):
         self._ckpt.wait_until_finished()
+        if self._path and os.path.exists(self._path):
+            # new checkpoint committed: the kept-aside previous one (see
+            # save_state_dict overwrite handling) is no longer needed
+            import shutil
+
+            shutil.rmtree(self._path + ".prev", ignore_errors=True)
 
 
 def save_state_dict(state_dict: Dict[str, Any], path: str,
@@ -94,14 +101,36 @@ def save_state_dict(state_dict: Dict[str, Any], path: str,
     training steps overlap the write instead of stalling in exactly the
     preemption window checkpointing exists for
     (ref:python/paddle/fluid/incubate/checkpoint/auto_checkpoint.py:72).
-    Call ``handle.wait()`` before reading the checkpoint back; a process
-    that dies mid-write leaves no visible (torn) checkpoint."""
+    Call ``handle.wait()`` before reading the checkpoint back. Durability:
+    a death mid-write never exposes a torn checkpoint, and when
+    overwriting, the PREVIOUS complete checkpoint is kept aside (``.prev``)
+    until the new one commits — ``load_state_dict`` falls back to it, so a
+    fixed-path periodic async save never loses all progress. (For
+    step-indexed training checkpoints prefer :class:`TrainCheckpointer`,
+    which retains whole steps.)"""
     tree = _to_arrays(state_dict)
+    path = os.path.abspath(path)
     if not blocking:
         ckpt = _get_async_checkpointer()
-        ckpt.save(os.path.abspath(path), tree, force=overwrite)
-        return AsyncSaveHandle(ckpt)
-    _checkpointer().save(os.path.abspath(path), tree, force=overwrite)
+        # settle any prior in-flight save BEFORE the keep-aside rename:
+        # orbax would block on it inside save() anyway (saves serialize),
+        # and renaming while its commit races could strand the new write
+        ckpt.wait_until_finished()
+        if overwrite and os.path.exists(path):
+            # orbax's force=True DELETES the destination synchronously and
+            # only commits the replacement when the background write
+            # finishes — a mid-write death would lose the previous
+            # checkpoint too. Keep it aside instead; dropped only after
+            # the next successful commit.
+            import shutil
+
+            prev = path + ".prev"
+            if os.path.exists(prev):
+                shutil.rmtree(prev, ignore_errors=True)
+            os.replace(path, prev)
+        ckpt.save(path, tree, force=False)
+        return AsyncSaveHandle(ckpt, path)
+    _checkpointer().save(path, tree, force=overwrite)
     return None
 
 
@@ -115,6 +144,10 @@ def load_state_dict(
     import orbax.checkpoint as ocp
 
     path = os.path.abspath(path)
+    if not os.path.exists(path) and os.path.exists(path + ".prev"):
+        # an async overwrite died before its commit: the kept-aside
+        # previous complete checkpoint is the durable state
+        path = path + ".prev"
     ckpt = _checkpointer()
     if target is None:
         return ckpt.restore(path, args=ocp.args.StandardRestore())

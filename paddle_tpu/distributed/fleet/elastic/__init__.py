@@ -20,13 +20,40 @@ from typing import Callable, Dict, List, Optional
 from ...store import TCPStore
 
 
+def parse_np(np_str: Optional[str], default: int):
+    """``MIN`` or ``MIN:MAX`` (ref manager.py:381 _parse_np). The single
+    authority for the elastic np range — the launcher and the manager both
+    use it."""
+    if not np_str:
+        return default, default
+    if ":" in np_str:
+        lo, hi = np_str.split(":", 1)
+        return int(lo), int(hi)
+    return int(np_str), default
+
+
+def clamp_world(live: int, min_np: int, max_np: int) -> Optional[int]:
+    """The rescale decision (ref manager.py:220-255): the world size to
+    relaunch with given ``live`` survivors — clamped to [min_np, max_np],
+    ``None`` when too few survive to continue."""
+    if live < min_np:
+        return None
+    return min(live, max_np)
+
+
 class ElasticManager:
     def __init__(self, store: TCPStore, rank: int, world_size: int,
-                 lease: float = 3.0):
+                 lease: float = 3.0, min_np: Optional[int] = None,
+                 max_np: Optional[int] = None):
         self.store = store
         self.rank = rank
         self.world_size = world_size
         self.lease = lease
+        # elastic np range (ref manager.py:130 _parse_np): the world may
+        # shrink to min_np when peers die and grow back to max_np when they
+        # re-register; propose_world() is the rescale decision
+        self.min_np = min_np if min_np is not None else world_size
+        self.max_np = max_np if max_np is not None else world_size
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self._watchers: List[Callable[[List[int]], None]] = []
@@ -85,6 +112,16 @@ class ElasticManager:
 
     def all_alive(self) -> bool:
         return not self.dead_peers()
+
+    def live_world(self) -> int:
+        """Number of ranks currently holding a live lease."""
+        return self.world_size - len(self.dead_peers())
+
+    def propose_world(self) -> Optional[int]:
+        """The world size to relaunch with after a membership change —
+        ``None`` means too few survivors (below min_np); callers should
+        keep waiting or abort the job."""
+        return clamp_world(self.live_world(), self.min_np, self.max_np)
 
     def wait_for_world(self, timeout: float = 30.0) -> bool:
         """Block until every rank holds a live lease (rendezvous barrier for

@@ -49,7 +49,14 @@ def _parse(argv=None):
                    help="comma-separated device ids for FLAGS_selected_devices")
     p.add_argument("--job_id", default="default")
     p.add_argument("--max_restart", type=int, default=3)
-    p.add_argument("--elastic_level", type=int, default=0)
+    p.add_argument("--elastic_level", type=int, default=0,
+                   help="0: fail fast; 1: relaunch same world; 2: relaunch "
+                        "with the SURVIVING world size within --np range "
+                        "(scale-in; ref manager.py np rescaling)")
+    p.add_argument("--np", default=os.environ.get("PADDLE_ELASTIC_NP"),
+                   help="elastic world range MIN or MIN:MAX "
+                        "(ref manager.py _parse_np); used by "
+                        "--elastic_level 2 to bound rescaling")
     # PS mode (ref launch --server_num/--trainer_num): spawns servers with
     # TRAINING_ROLE=PSERVER + PADDLE_PORT and workers with TRAINING_ROLE=
     # TRAINER + PADDLE_PSERVER_ENDPOINTS; one script runs both roles via
@@ -171,8 +178,12 @@ class Pod:
 
     def watch(self) -> int:
         """Block until all exit (0) or any fails (kill pod, return its code).
+        Failed ranks (non-zero BEFORE the pod teardown) are recorded in
+        ``self.failed_ranks`` for the elastic rescale decision.
         PS mode: servers run until every trainer exits 0, then the pod stops
         them (the reference launcher's trainer-driven shutdown)."""
+        self.failed_ranks: List[int] = []
+        self.failed_codes: List[int] = []
         while True:
             alive = False
             workers_alive = False
@@ -183,6 +194,13 @@ class Pod:
                     if i >= self._n_servers:
                         workers_alive = True
                 elif code != 0:
+                    # snapshot every rank already dead-with-error before
+                    # SIGTERM makes the survivors nonzero too
+                    self.failed_ranks = [
+                        j for j, q in enumerate(self.procs)
+                        if q.poll() not in (None, 0)]
+                    self.failed_codes = [self.procs[j].poll()
+                                         for j in self.failed_ranks]
                     self.stop()
                     return code
             if not alive:
@@ -212,6 +230,16 @@ class Pod:
 
 def launch(argv: Optional[List[str]] = None) -> int:
     args = _parse(argv)
+    # the np-range parse + clamp live in ONE place (fleet.elastic), shared
+    # with ElasticManager.propose_world
+    from ..fleet.elastic import clamp_world, parse_np
+
+    if args.elastic_level >= 2 and args.nnodes > 1:
+        raise SystemExit(
+            "--elastic_level 2 (world rescale) is single-node in this "
+            "launcher: multi-host membership belongs to ElasticManager "
+            "leases; run one rescaling launcher per job, not per node")
+    min_np, max_np = parse_np(args.np, args.nnodes * args.nproc_per_node)
     restarts = 0
     while True:
         pod = Pod(args)
@@ -221,6 +249,31 @@ def launch(argv: Optional[List[str]] = None) -> int:
             return 0
         if args.elastic_level > 0 and restarts < args.max_restart:
             restarts += 1
+            if args.elastic_level >= 2:
+                # scale-in: relaunch at the SURVIVING world size — the
+                # single-host analog of the reference manager dropping dead
+                # hosts from the endpoint list and relaunching within the
+                # np range (ref manager.py:220-255). Workers rebuild their
+                # mesh from the new PADDLE_TRAINERS_NUM and resume from the
+                # latest checkpoint via reshard-on-load. Only ranks killed
+                # by a SIGNAL count as preempted: survivors that crash
+                # secondarily (store/collective errors after a peer dies)
+                # exit with ordinary codes and must not shrink the world.
+                codes = getattr(pod, "failed_codes", [])
+                n_pre = max(1, len([c for c in codes if c is not None
+                                    and c < 0]))
+                new_np = clamp_world(args.nproc_per_node - n_pre,
+                                     min_np, max_np)
+                if new_np is None:
+                    print(f"[launch] {args.nproc_per_node - n_pre} "
+                          f"survivors is below min np {min_np}; giving up",
+                          file=sys.stderr)
+                    return code
+                if new_np != args.nproc_per_node:
+                    print(f"[launch] rescaling world "
+                          f"{args.nproc_per_node} -> {new_np} "
+                          f"(np range {min_np}:{max_np})", file=sys.stderr)
+                    args.nproc_per_node = new_np
             print(f"[launch] pod failed (exit {code}); elastic restart "
                   f"{restarts}/{args.max_restart}", file=sys.stderr)
             continue

@@ -216,3 +216,37 @@ def test_kill_during_async_save_resumes_previous_step(tmp_path):
         assert np.asarray(restored["w"]).shape == (1024, 1024, 32)
         assert int(restored["step"]) == 2
     ck.close()
+
+
+def test_async_overwrite_keeps_previous_until_commit(tmp_path):
+    """Fixed-path periodic async saves: the previous complete checkpoint is
+    kept aside until the new one commits, and load_state_dict falls back to
+    it — a death mid-overwrite can never lose ALL progress."""
+    path = os.path.join(str(tmp_path), "fixed")
+    v1 = {"w": paddle.to_tensor(np.full(4, 1.0, np.float32))}
+    v2 = {"w": paddle.to_tensor(np.full(4, 2.0, np.float32))}
+
+    h = save_state_dict(v1, path, blocking=False)
+    h.wait()
+    # simulate the state a mid-overwrite death leaves behind: save_state_dict
+    # had renamed the old checkpoint aside and the new write never committed
+    os.replace(path, path + ".prev")
+    restored = load_state_dict(path, target=v1)  # falls back to .prev
+    np.testing.assert_allclose(np.asarray(restored["w"]), 1.0)
+
+    # a completed overwrite cleans the kept-aside copy
+    save_state_dict(v1, path, blocking=True)
+    h2 = save_state_dict(v2, path, blocking=False)
+    h2.wait()
+    assert not os.path.exists(path + ".prev")
+    restored = load_state_dict(path, target=v2)
+    np.testing.assert_allclose(np.asarray(restored["w"]), 2.0)
+
+    # repeated async overwrites to one path serialize cleanly
+    for val in (3.0, 4.0):
+        h = save_state_dict(
+            {"w": paddle.to_tensor(np.full(4, val, np.float32))},
+            path, blocking=False)
+    h.wait()
+    restored = load_state_dict(path, target=v2)
+    np.testing.assert_allclose(np.asarray(restored["w"]), 4.0)
