@@ -108,29 +108,30 @@ def save_state_dict(state_dict: Dict[str, Any], path: str,
     fixed-path periodic async save never loses all progress. (For
     step-indexed training checkpoints prefer :class:`TrainCheckpointer`,
     which retains whole steps.)"""
+    import shutil
+
     tree = _to_arrays(state_dict)
     path = os.path.abspath(path)
+    # settle any prior in-flight async save BEFORE the keep-aside rename:
+    # orbax would block on it inside save() anyway (saves serialize), and
+    # renaming while its commit races could strand the new write
+    if _async_ckpt is not None:
+        _async_ckpt.wait_until_finished()
+    if overwrite and os.path.exists(path):
+        # orbax's force=True DELETES the destination synchronously and only
+        # commits the replacement when the write finishes — a mid-write
+        # death would lose the previous checkpoint too. Keep it aside
+        # instead (both modes); dropped only after a successful commit.
+        prev = path + ".prev"
+        if os.path.exists(prev):
+            shutil.rmtree(prev, ignore_errors=True)
+        os.replace(path, prev)
     if not blocking:
         ckpt = _get_async_checkpointer()
-        # settle any prior in-flight save BEFORE the keep-aside rename:
-        # orbax would block on it inside save() anyway (saves serialize),
-        # and renaming while its commit races could strand the new write
-        ckpt.wait_until_finished()
-        if overwrite and os.path.exists(path):
-            # orbax's force=True DELETES the destination synchronously and
-            # only commits the replacement when the background write
-            # finishes — a mid-write death would lose the previous
-            # checkpoint too. Keep it aside instead; dropped only after
-            # the next successful commit.
-            import shutil
-
-            prev = path + ".prev"
-            if os.path.exists(prev):
-                shutil.rmtree(prev, ignore_errors=True)
-            os.replace(path, prev)
         ckpt.save(path, tree, force=False)
         return AsyncSaveHandle(ckpt, path)
-    _checkpointer().save(path, tree, force=overwrite)
+    _checkpointer().save(path, tree, force=False)
+    shutil.rmtree(path + ".prev", ignore_errors=True)
     return None
 
 

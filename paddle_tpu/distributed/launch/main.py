@@ -240,6 +240,11 @@ def launch(argv: Optional[List[str]] = None) -> int:
             "launcher: multi-host membership belongs to ElasticManager "
             "leases; run one rescaling launcher per job, not per node")
     min_np, max_np = parse_np(args.np, args.nnodes * args.nproc_per_node)
+    if args.elastic_level >= 2 and not args.np:
+        # without an explicit range, level 2 must still be at least as
+        # fault-tolerant as level 1: allow scaling down to a single
+        # survivor instead of giving up on the first preemption
+        min_np = 1
     restarts = 0
     while True:
         pod = Pod(args)
@@ -260,20 +265,21 @@ def launch(argv: Optional[List[str]] = None) -> int:
                 # secondarily (store/collective errors after a peer dies)
                 # exit with ordinary codes and must not shrink the world.
                 codes = getattr(pod, "failed_codes", [])
-                n_pre = max(1, len([c for c in codes if c is not None
-                                    and c < 0]))
-                new_np = clamp_world(args.nproc_per_node - n_pre,
-                                     min_np, max_np)
-                if new_np is None:
-                    print(f"[launch] {args.nproc_per_node - n_pre} "
-                          f"survivors is below min np {min_np}; giving up",
-                          file=sys.stderr)
-                    return code
-                if new_np != args.nproc_per_node:
-                    print(f"[launch] rescaling world "
-                          f"{args.nproc_per_node} -> {new_np} "
-                          f"(np range {min_np}:{max_np})", file=sys.stderr)
-                    args.nproc_per_node = new_np
+                n_pre = len([c for c in codes if c is not None and c < 0])
+                if n_pre:  # no signal deaths -> plain same-world restart
+                    new_np = clamp_world(args.nproc_per_node - n_pre,
+                                         min_np, max_np)
+                    if new_np is None:
+                        print(f"[launch] {args.nproc_per_node - n_pre} "
+                              f"survivors is below min np {min_np}; "
+                              f"giving up", file=sys.stderr)
+                        return code
+                    if new_np != args.nproc_per_node:
+                        print(f"[launch] rescaling world "
+                              f"{args.nproc_per_node} -> {new_np} "
+                              f"(np range {min_np}:{max_np})",
+                              file=sys.stderr)
+                        args.nproc_per_node = new_np
             print(f"[launch] pod failed (exit {code}); elastic restart "
                   f"{restarts}/{args.max_restart}", file=sys.stderr)
             continue

@@ -119,15 +119,17 @@ class ErnieModel(nn.Layer):
         self.pooler = nn.Linear(cfg.hidden_size, cfg.hidden_size)
 
     def forward(self, input_ids, token_type_ids=None, attention_mask=None):
-        import jax
-
         x = self.embeddings(input_ids, token_type_ids)
-        for layer in self.layers:
-            if self.cfg.use_recompute and x._is_traced():
-                x = jax.checkpoint(
-                    layer, policy=jax.checkpoint_policies.nothing_saveable
-                )(x, attention_mask)
-            else:
+        if self.cfg.use_recompute and x._is_traced():
+            # fleet.recompute — see gpt.py GPTModel.forward: remat's jaxpr
+            # cache on the persistent layer would replay stale closure
+            # tracers on a re-trace
+            from ..distributed.fleet.recompute import recompute
+
+            for layer in self.layers:
+                x = recompute(layer, x, attention_mask)
+        else:
+            for layer in self.layers:
                 x = layer(x, attention_mask)
         pooled = F.tanh(self.pooler(x[:, 0]))
         return x, pooled

@@ -220,10 +220,16 @@ class GPTModel(nn.Layer):
                 x, nc = layer(x, cache=cache, start_pos=start_pos)
                 new_caches.append(nc)
             return self.ln_f(x), new_caches
-        for layer in self.layers:
-            if self.cfg.use_recompute and x._is_traced():
-                x = jax.checkpoint(layer, policy=jax.checkpoint_policies.nothing_saveable)(x)
-            else:
+        if self.cfg.use_recompute and x._is_traced():
+            # fleet.recompute (NOT jax.checkpoint(layer) directly): remat's
+            # jaxpr cache keys on the persistent layer and would replay
+            # stale closure-captured param tracers on a re-trace
+            from ..distributed.fleet.recompute import recompute
+
+            for layer in self.layers:
+                x = recompute(layer, x)
+        else:
+            for layer in self.layers:
                 x = layer(x)
         return self.ln_f(x)
 

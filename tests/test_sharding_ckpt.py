@@ -250,3 +250,11 @@ def test_async_overwrite_keeps_previous_until_commit(tmp_path):
     h.wait()
     restored = load_state_dict(path, target=v2)
     np.testing.assert_allclose(np.asarray(restored["w"]), 4.0)
+
+    # the BLOCKING overwrite path keeps the previous checkpoint aside during
+    # the write too (orbax force=True would delete it first) and cleans up
+    # after its synchronous commit
+    save_state_dict(v1, path, blocking=True)
+    assert not os.path.exists(path + ".prev")
+    restored = load_state_dict(path, target=v1)
+    np.testing.assert_allclose(np.asarray(restored["w"]), 1.0)
