@@ -234,12 +234,40 @@ class Engine:
             if measured is not None and measured != cur:
                 import warnings
 
-                warnings.warn(
-                    f"auto_parallel plan was tuned on '{measured}' but is "
-                    f"being applied on '{cur}': step-time ratios between "
-                    "mesh candidates do not transfer across platforms "
-                    "(CPU has no ICI); re-run Engine.tune() on the target "
-                    "platform", RuntimeWarning, stacklevel=2)
+                # a batch for the re-measure: prepare()'s own sample_batch
+                # (the one real path for an IMPORTED plan — a process's
+                # platform never changes, so a cross-platform report always
+                # arrives from outside this process), else specs stashed by
+                # an in-process tune(), synthesized fresh
+                tune_args = getattr(self, "_tune_args", None)
+                batch = sample_batch
+                cands = None
+                if batch is None and tune_args is not None:
+                    batch = tuple(_synth(s) for s in tune_args["specs"])
+                    cands = tune_args["candidates"]
+                if batch:
+                    # RE-TUNE on the platform we are actually running on
+                    # (bounded trials): step-time ratios between mesh
+                    # candidates do not transfer across platforms (CPU has
+                    # no ICI). Both reports are kept in _tuner_reports so
+                    # the cross-platform decision is auditable.
+                    warnings.warn(
+                        f"auto_parallel plan was tuned on '{measured}' but "
+                        f"is being applied on '{cur}': re-measuring "
+                        "candidates on the current platform",
+                        RuntimeWarning, stacklevel=2)
+                    old = rep
+                    self.tune(sample_batch=batch, candidates=cands,
+                              warmup=1, iters=2, verbose=0)
+                    self._tuner_reports = [old, self._tuner_report]
+                else:
+                    warnings.warn(
+                        f"auto_parallel plan was tuned on '{measured}' but "
+                        f"is being applied on '{cur}': step-time ratios "
+                        "between mesh candidates do not transfer across "
+                        "platforms (CPU has no ICI); re-run Engine.tune() "
+                        "on the target platform",
+                        RuntimeWarning, stacklevel=2)
 
         s = self.strategy
         n = len(jax.devices())
@@ -295,6 +323,14 @@ class Engine:
                                           + list(labels_spec or [])))
         if not sample_batch:
             raise ValueError("tune() needs sample_batch or inputs/labels specs")
+        # keep what a platform-change re-tune needs (prepare() re-measures
+        # with bounded trials when the stamped platform != the current one).
+        # SPECS only, not the arrays — stashing a real global batch would
+        # pin it in memory for the Engine's lifetime; _synth rebuilds one.
+        self._tune_args = dict(
+            specs=[(tuple(b.shape), str(getattr(b, "dtype", "float32")))
+                   for b in sample_batch],
+            candidates=candidates)
         n = len(jax.devices())
         param_count = int(sum(np.prod(p.shape)
                               for p in self.model.parameters()))

@@ -84,7 +84,8 @@ class AsyncSaveHandle:
 
     def wait(self):
         self._ckpt.wait_until_finished()
-        if self._path and os.path.exists(self._path):
+        if (self._path and jax.process_index() == 0
+                and os.path.exists(self._path)):
             # new checkpoint committed: the kept-aside previous one (see
             # save_state_dict overwrite handling) is no longer needed
             import shutil
@@ -117,7 +118,11 @@ def save_state_dict(state_dict: Dict[str, Any], path: str,
     # renaming while its commit races could strand the new write
     if _async_ckpt is not None:
         _async_ckpt.wait_until_finished()
-    if overwrite and os.path.exists(path):
+    # primary-process-only (orbax's destination existence check is also
+    # primary-only): in a multi-host job every process calls save, and
+    # concurrent renames on shared storage would race
+    if (overwrite and jax.process_index() == 0
+            and os.path.exists(path)):
         # orbax's force=True DELETES the destination synchronously and only
         # commits the replacement when the write finishes — a mid-write
         # death would lose the previous checkpoint too. Keep it aside
@@ -131,7 +136,8 @@ def save_state_dict(state_dict: Dict[str, Any], path: str,
         ckpt.save(path, tree, force=False)
         return AsyncSaveHandle(ckpt, path)
     _checkpointer().save(path, tree, force=False)
-    shutil.rmtree(path + ".prev", ignore_errors=True)
+    if jax.process_index() == 0:
+        shutil.rmtree(path + ".prev", ignore_errors=True)
     return None
 
 

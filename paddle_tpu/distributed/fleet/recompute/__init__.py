@@ -25,27 +25,6 @@ _POLICIES = {
 }
 
 
-def _remat_layer(layer, *args):
-    """``jax.checkpoint`` over an ``nn.Layer`` with its parameters/buffers
-    passed as EXPLICIT arguments. remat caches the wrapped jaxpr keyed on
-    the callable: checkpointing a persistent layer whose param tracers
-    enter via closure (swapped into ``Tensor._data``) replays the PREVIOUS
-    trace's tracers on the next trace — UnexpectedTracerError on the second
-    ``TrainStep`` call. A fresh wrapper + explicit params per call keeps
-    every trace self-contained."""
-    from ....jit import _swap_data
-
-    state = list(layer.parameters()) + [b for _, b in layer.named_buffers()]
-    arrs = [s._data for s in state]
-
-    def fn(param_arrays, *inner):
-        with _swap_data(state, list(param_arrays)):
-            return layer(*inner)
-
-    return jax.checkpoint(
-        fn, policy=jax.checkpoint_policies.nothing_saveable)(arrs, *args)
-
-
 def recompute(function, *args, **kwargs):
     """paddle.distributed.fleet.recompute.recompute parity: run ``function``
     without saving intermediates; recompute them in backward.
@@ -64,16 +43,12 @@ def recompute(function, *args, **kwargs):
     )
     if not traced:
         return function(*args, **kwargs)
-    from ....nn.layer import Layer
-
-    if isinstance(function, Layer) and not kwargs:
-        # persistent layers take the cache-safe explicit-params path
-        return _remat_layer(function, *args)
-
-    # any other persistent callable (bound method, layer called with
-    # kwargs) would hit remat's fun-keyed jaxpr cache with STALE closure
-    # tracers on a re-trace; a fresh wrapper per call keeps every trace
-    # self-contained (the cache entry dies with the wrapper)
+    # NEVER hand ``function`` itself to jax.checkpoint when it can persist
+    # across traces (a Layer, a bound method): remat's jaxpr cache keys on
+    # the callable and would replay the PREVIOUS trace's closure-captured
+    # param tracers on a re-trace — UnexpectedTracerError on the second
+    # TrainStep call. A wrapper created fresh per call keeps every trace
+    # self-contained (the cache entry dies with the wrapper).
     def _fresh(*a, **k):
         return function(*a, **k)
 
