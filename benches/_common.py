@@ -8,6 +8,26 @@ import time
 HERE = os.path.dirname(os.path.abspath(__file__))
 
 
+def enable_compile_cache():
+    """Persistent XLA compilation cache (same dir bench.py uses): a
+    re-run of any bench after a tunnel flap skips its multi-minute cold
+    compiles, so short windows can still complete whole bank stages."""
+    import jax
+
+    cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR") or os.path.join(
+        HERE, ".jax_cache")
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    except Exception as e:  # optimization only, never a blocker
+        print(f"# compilation cache unavailable: {e}", flush=True)
+
+
+enable_compile_cache()
+
+
 def emit(rec, path=None):
     rec["ts"] = time.time()
     line = json.dumps(rec)

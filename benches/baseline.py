@@ -29,6 +29,8 @@ HERE = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, os.path.dirname(HERE))
 sys.path.insert(0, HERE)
 
+import _common  # noqa: E402,F401 — enables the persistent compile cache
+
 
 def _timed(step, args, warmup=2, iters=8):
     import jax

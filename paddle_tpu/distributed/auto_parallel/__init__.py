@@ -241,11 +241,15 @@ class Engine:
                 # an in-process tune(), synthesized fresh
                 tune_args = getattr(self, "_tune_args", None)
                 batch = sample_batch
-                cands = None
                 if batch is None and tune_args is not None:
                     batch = tuple(_synth(s) for s in tune_args["specs"])
-                    cands = tune_args["candidates"]
-                if batch:
+                # honor a user-restricted candidate list from the original
+                # tune() regardless of where the batch came from
+                cands = tune_args["candidates"] if tune_args else None
+                # re-tuning measures TrainSteps: only meaningful (and only
+                # possible) for a train-mode prepare with an optimizer —
+                # eval/predict prepares keep the warn-only behavior
+                if batch and mode == "train" and self.optimizer is not None:
                     # RE-TUNE on the platform we are actually running on
                     # (bounded trials): step-time ratios between mesh
                     # candidates do not transfer across platforms (CPU has

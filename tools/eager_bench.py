@@ -13,7 +13,12 @@ from __future__ import annotations
 
 import json
 import os
+import sys
 import time
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+sys.path.insert(0, os.path.join(_ROOT, "benches"))
 
 import numpy as np
 
@@ -21,6 +26,9 @@ import numpy as np
 def main():
     import jax
 
+    from _common import enable_compile_cache  # benches/ shared setup
+
+    enable_compile_cache()
     # the sandbox sitecustomize force-pins a (possibly wedged) remote TPU
     # platform; EAGER_BENCH_PLATFORM=cpu pins the backend BEFORE any device
     # touch so a dead tunnel can't hang the tool
