@@ -84,3 +84,9 @@ def test_train_gpt_smoke_always_on():
     out = _run("train_gpt.py", "--steps", "2", "--batch", "2", "--seq", "32",
                "--hidden", "32", "--layers", "1", timeout=420)
     assert "sampled continuation" in out
+
+
+@_gated
+def test_elastic_train_demo():
+    out = _run("elastic_train.py", "--demo", "--steps", "10", timeout=600)
+    assert "elastic demo OK" in out
