@@ -311,7 +311,15 @@ class TrainStep:
                     f"accumulate_steps={k}: all inputs must share one "
                     f"leading (batch) dim divisible by k; got leading dims "
                     f"{sorted((d if d is not None else -1) for d in leading)}")
+        if (self._opt_state is not None
+                and getattr(self._opt, "_state_version", 0)
+                != getattr(self, "_opt_state_version", 0)):
+            # opt.set_state_dict ran after we cached the compiled state
+            # (mid-training restore/rollback): drop the stale cache and
+            # re-seed from the restored accumulators below
+            self._opt_state = None
         if self._opt_state is None:
+            self._opt_state_version = getattr(self._opt, "_state_version", 0)
             # seed from the optimizer's accumulators when present (ckpt
             # resume via opt.set_state_dict): overlay restored values onto
             # freshly-initialized slots — restored keys the current config

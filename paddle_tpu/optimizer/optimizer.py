@@ -225,6 +225,11 @@ class Optimizer:
         return sd
 
     def set_state_dict(self, state_dict):
+        # compiled steps (TrainStep, static Executor) cache the optimizer
+        # state pytree after their first call; bumping this version tells
+        # them their cache is stale and must re-seed from the restored
+        # accumulators (mid-training restore / rollback)
+        self._state_version = getattr(self, "_state_version", 0) + 1
         self._step_count = int(state_dict.get("step", 0))
         if isinstance(self._learning_rate, LRScheduler) and "LR_Scheduler" in state_dict:
             self._learning_rate.set_state_dict(state_dict["LR_Scheduler"])

@@ -402,10 +402,30 @@ class Executor:
                     [new_p[n] for n in names], new_state)
 
         def runner(feed_arrays):
+            inner = getattr(opt, "_inner", opt)
+            if (program._opt_state is not None
+                    and getattr(inner, "_state_version", 0)
+                    != getattr(program, "_opt_state_version", 0)):
+                # opt.set_state_dict ran after this Program cached its
+                # compiled state (mid-training restore): re-seed below
+                program._opt_state = None
             if program._opt_state is None:
-                program._opt_state = opt.init_state(
+                program._opt_state_version = getattr(inner,
+                                                     "_state_version", 0)
+                st = opt.init_state(
                     {n: program._params[i]
                      for n, i in zip(names, train_idx)})
+                # overlay restored accumulators (ckpt resume through
+                # opt.set_state_dict) onto the fresh slots, like TrainStep
+                for n, i in zip(names, train_idx):
+                    acc = inner._accumulators.get(id(program._params[i]))
+                    if acc:
+                        for k in st["slots"][n]:
+                            if k in acc:
+                                st["slots"][n][k] = jnp.asarray(acc[k]) \
+                                    .astype(st["slots"][n][k].dtype)
+                st["step"] = jnp.asarray(inner._step_count, jnp.int32)
+                program._opt_state = st
             lr = jnp.asarray(opt.get_lr(), jnp.float32)
             outs, bufs, new_trainables, program._opt_state = train_step(
                 feed_arrays, [p._data for p in program._params],

@@ -1,5 +1,6 @@
 """ERNIE encoder family + nn.Transformer layers."""
 import numpy as np
+import pytest
 
 import paddle_tpu as paddle
 import paddle_tpu.distributed as dist
@@ -8,9 +9,15 @@ from paddle_tpu.jit import TrainStep
 from paddle_tpu.models import ErnieForPretraining, ErnieForSequenceClassification, ernie_tiny
 
 
-def test_ernie_pretraining_loss_decreases():
+@pytest.mark.parametrize("use_recompute", [False, True],
+                         ids=["plain", "recompute"])
+def test_ernie_pretraining_loss_decreases(use_recompute):
+    """recompute=True doubles as the remat regression: the path must
+    survive repeated TrainStep calls (jax.checkpoint over a persistent
+    layer replayed stale closure tracers on re-trace; fleet.recompute's
+    fresh wrapper fixes it)."""
     paddle.seed(0)
-    model = ErnieForPretraining(ernie_tiny())
+    model = ErnieForPretraining(ernie_tiny(use_recompute=use_recompute))
     opt = paddle.optimizer.AdamW(learning_rate=1e-3, parameters=model.parameters())
     step = TrainStep(lambda x, t, y, n: model(x, t, y, n), opt, layers=model)
     rng = np.random.default_rng(0)
@@ -70,3 +77,4 @@ def test_multi_head_attention_mask():
     mask = paddle.to_tensor(np.tril(np.ones((1, 1, 8, 8))).astype(bool))
     out = mha(x, attn_mask=mask)
     assert out.shape == [2, 8, 32]
+

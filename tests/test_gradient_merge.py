@@ -345,3 +345,45 @@ class TestAccumulateCheckpointResume:
         for p1, p3 in zip(m1.parameters(), m3.parameters()):
             np.testing.assert_allclose(np.asarray(p1._data),
                                        np.asarray(p3._data), atol=1e-6)
+
+
+def test_set_state_dict_invalidates_stepped_trainstep():
+    """Restoring optimizer state into an ALREADY-STEPPED TrainStep must
+    take effect: set_state_dict bumps a state version that drops the
+    compiled cache, so the trajectory after restore equals a fresh resume
+    (previously the stale cached moments kept training silently)."""
+    x, y = _data(n=16, seed=9)
+
+    def build():
+        m = _mlp(seed=31)
+        o = AdamW(learning_rate=1e-2, parameters=m.parameters())
+        s = TrainStep(lambda a, b: ((m(a) - b) ** 2).mean(), o, layers=m)
+        return m, o, s
+
+    # reference: 2 steps, snapshot, 3 more steps
+    m1, o1, s1 = build()
+    for _ in range(2):
+        s1(Tensor(x), Tensor(y))
+    snap_model = {k: np.asarray(v._data) for k, v in
+                  m1.state_dict().items()}
+    snap_opt = o1.state_dict()
+    for _ in range(3):
+        l_ref = s1(Tensor(x), Tensor(y))
+
+    # victim: 2 steps, DIVERGE for 2 steps, then restore the snapshot into
+    # the same (stepped) model+optimizer+TrainStep and continue 3 steps
+    m2, o2, s2 = build()
+    for _ in range(2):
+        s2(Tensor(x), Tensor(y))
+    for _ in range(2):  # diverge: pollutes the cached compiled opt state
+        s2(Tensor(x), Tensor(y))
+    m2.set_state_dict(snap_model)
+    o2.set_state_dict(snap_opt)
+    for _ in range(3):
+        l_res = s2(Tensor(x), Tensor(y))
+
+    np.testing.assert_allclose(float(l_ref._data), float(l_res._data),
+                               rtol=1e-5)
+    for p1, p2 in zip(m1.parameters(), m2.parameters()):
+        np.testing.assert_allclose(np.asarray(p1._data),
+                                   np.asarray(p2._data), atol=1e-6)

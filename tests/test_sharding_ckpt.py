@@ -304,9 +304,9 @@ def test_trainstep_resume_across_sharding_topology_change(tmp_path):
     target = {"model": m3.state_dict(), "opt": o3.state_dict()}
     restored = ck.restore(target=target)
     m3.set_state_dict(restored["model"])
-    o3.set_state_dict(restored["opt"])
-    s3._opt_state = None  # re-seed the compiled state from o3's restored
-    # accumulators on the next call (TrainStep caches it after first step)
+    o3.set_state_dict(restored["opt"])  # bumps the optimizer state version:
+    # the already-stepped TrainStep drops its cached compiled state and
+    # re-seeds from the restored accumulators on the next call
     for _ in range(3):
         l_res = s3(paddle.to_tensor(x), paddle.to_tensor(y))
 
