@@ -105,6 +105,22 @@ def main():
         print(f"[flash-tune] BEST s={s}: blk_q={bq} blk_k={bk} "
               f"({t*1e3:.2f} ms) -> FLAGS_flash_block_q={bq} "
               f"FLAGS_flash_block_k={bk}", flush=True)
+    if best_by_shape and d.platform != "cpu":
+        # ADOPT the winners: pallas_ops._default_blocks reads this when the
+        # block flags sit at their 128 defaults (explicit flags still win).
+        # Only numerics-verified candidates can reach best_by_shape, and
+        # only an on-chip run publishes (a CPU-interpret timing would be
+        # meaningless). Atomic write: a partial file must never load.
+        import json
+
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "FLASH_TUNED.json")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({str(s): [bq, bk]
+                       for s, (t, bq, bk) in best_by_shape.items()}, f)
+        os.replace(tmp, path)
+        print(f"[flash-tune] wrote {path}", flush=True)
     wd.cancel()
 
 

@@ -93,6 +93,10 @@ define_flag("flash_block_q", 128,
             "measures candidates on-chip).")
 define_flag("flash_block_k", 128,
             "Pallas flash-attention k-block tile (multiple of 128).")
+define_flag("flash_use_tuned", True,
+            "Adopt on-chip tuned block sizes (benches/FLASH_TUNED.json) "
+            "when flash_block_q/_k sit at their 128 defaults. Set 0 to "
+            "force the safe defaults even with a tune record present.")
 define_flag("flash_attention_min_seqlen", 4608,
             "Route attention through the Pallas flash kernel only at kv "
             "sequence length >= this (measured v5e break-even: XLA's fused "

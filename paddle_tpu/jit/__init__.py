@@ -321,19 +321,10 @@ class TrainStep:
         if self._opt_state is None:
             self._opt_state_version = getattr(self._opt, "_state_version", 0)
             # seed from the optimizer's accumulators when present (ckpt
-            # resume via opt.set_state_dict): overlay restored values onto
-            # freshly-initialized slots — restored keys the current config
-            # doesn't use (e.g. a master_weight from a run with different
-            # AMP settings) are dropped rather than changing the update path
-            slots = []
-            for p in self._train_params:
-                base = self._opt._init_slot(p._data)
-                acc = self._opt._accumulators.get(id(p))
-                if acc:
-                    for k in base:
-                        if k in acc:
-                            base[k] = jnp.asarray(acc[k]).astype(base[k].dtype)
-                slots.append(base)
+            # resume via opt.set_state_dict) — shared overlay semantics
+            # live in Optimizer._overlay_slot
+            slots = [self._opt._overlay_slot(self._opt._init_slot(p._data), p)
+                     for p in self._train_params]
             self._opt_state = {
                 "slots": slots,
                 "step": jnp.asarray(self._opt._step_count, jnp.int32),

@@ -370,13 +370,55 @@ def _flash_bwd_rule(scale, causal, blk_q, blk_k, res, do):
 _flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
-def _default_blocks():
+_TUNED_BLOCKS = None  # lazy-loaded {seq:int -> (blk_q, blk_k)}, {} if absent
+
+
+def _tuned_blocks(seq):
+    """Per-seqlen best tiling measured on-chip by benches/flash_tune.py
+    (FLASH_TUNED.json, written only from candidates that passed the
+    numerics check). Nearest measured seqlen wins; {} when no tune has
+    ever run (fresh checkout / installed wheel)."""
+    global _TUNED_BLOCKS
+    if _TUNED_BLOCKS is None:
+        import json
+        import os
+
+        path = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))), "benches",
+            "FLASH_TUNED.json")
+        try:
+            with open(path) as f:
+                _TUNED_BLOCKS = {int(s): (int(bk[0]), int(bk[1]))
+                                 for s, bk in json.load(f).items()}
+        except Exception:  # absent OR malformed: never block attention
+            _TUNED_BLOCKS = {}
+    # only adopt within the measured range: a tiling verified at 8192 was
+    # never lowered at 1024 (different VMEM footprint; Mosaic may reject
+    # it), and short seqs route through XLA attention anyway
+    if not _TUNED_BLOCKS or seq < min(_TUNED_BLOCKS):
+        return None
+    nearest = min(_TUNED_BLOCKS, key=lambda s: abs(s - seq))
+    return _TUNED_BLOCKS[nearest]
+
+
+def _default_blocks(seq=None):
     """Tunable kernel tiling (FLAGS_flash_block_q/_k; benches/flash_tune.py
     measures the grid on-chip). 128 matches the MXU/lane width and is the
-    safe default; larger k-blocks amortize grid overhead at long context."""
+    safe default; larger k-blocks amortize grid overhead at long context.
+    When the flags sit at their defaults, an on-chip tune record
+    (FLASH_TUNED.json) takes precedence; non-default flags win, and
+    FLAGS_flash_use_tuned=0 is the explicit escape hatch that forces the
+    128 defaults even with a tune record present."""
     from ..core import flags
 
-    return (int(flags.flag("flash_block_q")), int(flags.flag("flash_block_k")))
+    bq = int(flags.flag("flash_block_q"))
+    bk = int(flags.flag("flash_block_k"))
+    if ((bq, bk) == (128, 128) and seq is not None
+            and flags.flag("flash_use_tuned")):
+        tuned = _tuned_blocks(seq)
+        if tuned:
+            return tuned
+    return bq, bk
 
 
 def flash_attention(q, k, v, scale: Optional[float] = None, causal: bool = False,
@@ -386,7 +428,7 @@ def flash_attention(q, k, v, scale: Optional[float] = None, causal: bool = False
         scale = 1.0 / math.sqrt(q.shape[-1])
     if not _HAS_PALLAS or not _shapes_ok(q, k):
         return _attention_reference(q, k, v, scale, causal)
-    dq, dk = _default_blocks()
+    dq, dk = _default_blocks(seq=k.shape[1])
     blk_q = blk_q or dq
     blk_k = blk_k or dk
     # block sizes must tile the sequence, and the backward's lane-broadcast

@@ -251,8 +251,28 @@ class Optimizer:
                             break
                 if slots:
                     self._accumulators[id(p)] = slots
+                else:
+                    # a snapshot with no slot entries for this param (e.g.
+                    # taken at step 0, before any step) means FRESH state:
+                    # leftover post-training moments must not survive the
+                    # restore and leak into the re-seeded compiled state
+                    self._accumulators.pop(id(p), None)
 
     set_dict = set_state_dict
+
+    def _overlay_slot(self, base, p):
+        """Overlay restored accumulator values onto freshly-initialized
+        slots for one param (ckpt resume): shared by TrainStep and the
+        static Executor so the seed semantics cannot drift. Restored keys
+        the current config doesn't use (e.g. a master_weight from a run
+        with different AMP settings) are dropped rather than changing the
+        update path."""
+        acc = self._accumulators.get(id(p))
+        if acc:
+            for k in base:
+                if k in acc:
+                    base[k] = jnp.asarray(acc[k]).astype(base[k].dtype)
+        return base
 
 
 def _minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):

@@ -416,14 +416,10 @@ class Executor:
                     {n: program._params[i]
                      for n, i in zip(names, train_idx)})
                 # overlay restored accumulators (ckpt resume through
-                # opt.set_state_dict) onto the fresh slots, like TrainStep
+                # opt.set_state_dict) — shared semantics in _overlay_slot
                 for n, i in zip(names, train_idx):
-                    acc = inner._accumulators.get(id(program._params[i]))
-                    if acc:
-                        for k in st["slots"][n]:
-                            if k in acc:
-                                st["slots"][n][k] = jnp.asarray(acc[k]) \
-                                    .astype(st["slots"][n][k].dtype)
+                    st["slots"][n] = inner._overlay_slot(
+                        st["slots"][n], program._params[i])
                 st["step"] = jnp.asarray(inner._step_count, jnp.int32)
                 program._opt_state = st
             lr = jnp.asarray(opt.get_lr(), jnp.float32)

@@ -88,3 +88,32 @@ def test_flash_odd_shapes_fall_back():
     out = po.flash_attention(q, k, v, scale=scale, causal=True)
     exp = po._attention_reference(q, k, v, scale, True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(exp), rtol=1e-4, atol=1e-4)
+
+
+def test_tuned_blocks_precedence(monkeypatch):
+    """FLASH_TUNED.json winners apply when the block flags sit at their
+    128 defaults; explicit flags always win; no tune record -> defaults."""
+    from paddle_tpu.core import flags
+    from paddle_tpu.ops import pallas_ops as po
+
+    monkeypatch.setattr(po, "_TUNED_BLOCKS",
+                        {4096: (256, 512), 8192: (512, 512)})
+    assert po._default_blocks(seq=5000) == (256, 512)  # nearest measured
+    assert po._default_blocks(seq=8192) == (512, 512)
+    assert po._default_blocks() == (128, 128)  # no seq context
+    # below the measured range: a tiling verified at 4096+ was never
+    # lowered at short seqs -> safe defaults
+    assert po._default_blocks(seq=1024) == (128, 128)
+    flags.set_flags({"FLAGS_flash_block_q": 256})
+    try:
+        assert po._default_blocks(seq=8192) == (256, 128)  # explicit wins
+    finally:
+        flags.set_flags({"FLAGS_flash_block_q": 128})
+    # the documented escape hatch: force defaults despite a tune record
+    flags.set_flags({"FLAGS_flash_use_tuned": False})
+    try:
+        assert po._default_blocks(seq=8192) == (128, 128)
+    finally:
+        flags.set_flags({"FLAGS_flash_use_tuned": True})
+    monkeypatch.setattr(po, "_TUNED_BLOCKS", {})
+    assert po._default_blocks(seq=8192) == (128, 128)

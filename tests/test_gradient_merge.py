@@ -387,3 +387,23 @@ def test_set_state_dict_invalidates_stepped_trainstep():
     for p1, p2 in zip(m1.parameters(), m2.parameters()):
         np.testing.assert_allclose(np.asarray(p1._data),
                                    np.asarray(p2._data), atol=1e-6)
+
+
+def test_step0_snapshot_restore_resets_moments():
+    """A snapshot taken BEFORE any optimizer step has no slot entries;
+    restoring it must CLEAR leftover accumulators (not overlay stale
+    post-training moments under a reset step counter)."""
+    x, y = _data(n=16, seed=12)
+    m = _mlp(seed=33)
+    o = AdamW(learning_rate=1e-2, parameters=m.parameters())
+    s = TrainStep(lambda a, b: ((m(a) - b) ** 2).mean(), o, layers=m)
+    snap_model = {k: np.asarray(v._data).copy()
+                  for k, v in m.state_dict().items()}
+    snap_opt = o.state_dict()  # step 0, no accumulators yet
+    losses_fresh = [float(s(Tensor(x), Tensor(y))._data) for _ in range(3)]
+
+    m.set_state_dict(snap_model)
+    o.set_state_dict(snap_opt)
+    losses_restored = [float(s(Tensor(x), Tensor(y))._data)
+                       for _ in range(3)]
+    np.testing.assert_allclose(losses_fresh, losses_restored, rtol=1e-5)
