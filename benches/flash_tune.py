@@ -71,6 +71,15 @@ def main():
         v = jnp.asarray(rng.standard_normal((b, s, h, dd)), jnp.bfloat16)
         scale = 1.0 / np.sqrt(dd)
         ref = po._attention_reference(q, k, v, scale, True)
+
+        def _ref_loss(q, k, v):
+            return (po._attention_reference(q, k, v, scale, True)
+                    .astype(jnp.float32) ** 2).sum()
+
+        # adopted winners drive TRAINING: the backward must be verified
+        # too, not just the forward — a tiling with a subtly wrong dq/dk/dv
+        # but correct outputs must never win
+        ref_grads = jax.jit(jax.grad(_ref_loss, argnums=(0, 1, 2)))(q, k, v)
         best = None
         for bq, bk in candidates:
             fn = functools.partial(po._flash_attention, scale=scale,
@@ -82,6 +91,20 @@ def main():
                 if err > 1e-1:  # bf16 tolerance — wrong tiling, not noise
                     emit({"bench": "flash-tune", "shape": [b, s, h, dd],
                           "blk": [bq, bk], "error": f"numerics {err:.2e}"})
+                    continue
+
+                def _loss(q, k, v):
+                    return (fn(q, k, v).astype(jnp.float32) ** 2).sum()
+
+                grads = jax.jit(jax.grad(_loss, argnums=(0, 1, 2)))(q, k, v)
+                gerr = max(float(jnp.max(jnp.abs(
+                    g.astype(jnp.float32) - rg.astype(jnp.float32))))
+                    for g, rg in zip(grads, ref_grads))
+                # grads accumulate over s contributions: scale tolerance
+                if gerr > 1e-1 * np.sqrt(s / 128):
+                    emit({"bench": "flash-tune", "shape": [b, s, h, dd],
+                          "blk": [bq, bk],
+                          "error": f"bwd numerics {gerr:.2e}"})
                     continue
                 t = _time_fwd_bwd(lambda q, k, v: fn(q, k, v), q, k, v)
             except Exception as e:  # mosaic lowering can reject a tiling
@@ -117,8 +140,13 @@ def main():
                             "FLASH_TUNED.json")
         tmp = path + ".tmp"
         with open(tmp, "w") as f:
-            json.dump({str(s): [bq, bk]
-                       for s, (t, bq, bk) in best_by_shape.items()}, f)
+            # device_kind stamp: tiles verified on one TPU generation must
+            # not be adopted on another (VMEM limits differ; Mosaic may
+            # reject them) — _tuned_blocks checks it against the live chip
+            json.dump({"device_kind": d.device_kind,
+                       "blocks": {str(s): [bq, bk]
+                                  for s, (t, bq, bk)
+                                  in best_by_shape.items()}}, f)
         os.replace(tmp, path)
         print(f"[flash-tune] wrote {path}", flush=True)
     wd.cancel()

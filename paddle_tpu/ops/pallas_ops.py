@@ -371,6 +371,7 @@ _flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
 _TUNED_BLOCKS = None  # lazy-loaded {seq:int -> (blk_q, blk_k)}, {} if absent
+_TUNED_PATH = None  # test override for the FLASH_TUNED.json location
 
 
 def _tuned_blocks(seq):
@@ -383,13 +384,23 @@ def _tuned_blocks(seq):
         import json
         import os
 
-        path = os.path.join(os.path.dirname(os.path.dirname(
+        path = _TUNED_PATH or os.path.join(os.path.dirname(os.path.dirname(
             os.path.dirname(os.path.abspath(__file__)))), "benches",
             "FLASH_TUNED.json")
         try:
             with open(path) as f:
+                rec = json.load(f)
+            # the record is stamped with the chip it was measured on:
+            # tiles verified on one TPU generation must not be adopted on
+            # another (VMEM limits differ; Mosaic may reject them)
+            import jax
+
+            kind = getattr(jax.devices()[0], "device_kind", "")
+            if rec.get("device_kind") == kind:
                 _TUNED_BLOCKS = {int(s): (int(bk[0]), int(bk[1]))
-                                 for s, bk in json.load(f).items()}
+                                 for s, bk in rec["blocks"].items()}
+            else:
+                _TUNED_BLOCKS = {}
         except Exception:  # absent OR malformed: never block attention
             _TUNED_BLOCKS = {}
     # only adopt within the measured range: a tiling verified at 8192 was

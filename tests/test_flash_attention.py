@@ -117,3 +117,32 @@ def test_tuned_blocks_precedence(monkeypatch):
         flags.set_flags({"FLAGS_flash_use_tuned": True})
     monkeypatch.setattr(po, "_TUNED_BLOCKS", {})
     assert po._default_blocks(seq=8192) == (128, 128)
+
+
+def test_tuned_blocks_loader_device_kind_gate(tmp_path, monkeypatch):
+    """A tune record stamped with a different chip generation is ignored
+    (tiles verified on v5e must not load on v4); matching stamp loads;
+    malformed records degrade to defaults instead of raising."""
+    import json
+
+    import jax
+
+    from paddle_tpu.ops import pallas_ops as po
+
+    kind = getattr(jax.devices()[0], "device_kind", "")
+    path = tmp_path / "FLASH_TUNED.json"
+    monkeypatch.setattr(po, "_TUNED_PATH", str(path))
+
+    path.write_text(json.dumps(
+        {"device_kind": kind, "blocks": {"4096": [256, 512]}}))
+    monkeypatch.setattr(po, "_TUNED_BLOCKS", None)
+    assert po._tuned_blocks(4096) == (256, 512)
+
+    path.write_text(json.dumps(
+        {"device_kind": "TPU v99", "blocks": {"4096": [256, 512]}}))
+    monkeypatch.setattr(po, "_TUNED_BLOCKS", None)
+    assert po._tuned_blocks(4096) is None
+
+    path.write_text("[128, 128]")  # malformed: old/other format
+    monkeypatch.setattr(po, "_TUNED_BLOCKS", None)
+    assert po._tuned_blocks(4096) is None
