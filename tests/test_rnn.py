@@ -115,3 +115,46 @@ def test_lstm_multilayer_bidirectional_matches_torch():
     np.testing.assert_allclose(out.numpy(), tout.detach().numpy(), atol=1e-5)
     np.testing.assert_allclose(hn.numpy(), thn.detach().numpy(), atol=1e-5)
     np.testing.assert_allclose(cn.numpy(), tcn.detach().numpy(), atol=1e-5)
+
+
+@pytest.mark.parametrize("kind", ["lstm", "gru"])
+def test_rnn_backward_matches_torch(kind):
+    """Gradients of the scan-based recurrent backward vs torch autograd:
+    input grad AND every weight/bias grad (the scan transpose is where
+    subtle time-reversal bugs hide; forward parity alone would miss them)."""
+    torch = pytest.importorskip("torch")
+    paddle.seed(0)
+    b, s, f, h = 2, 5, 4, 3
+    if kind == "lstm":
+        ours, ref = nn.LSTM(f, h), torch.nn.LSTM(f, h, batch_first=True)
+    else:
+        ours, ref = nn.GRU(f, h), torch.nn.GRU(f, h, batch_first=True)
+    with torch.no_grad():
+        ref.weight_ih_l0.copy_(torch.tensor(np.asarray(ours.wi_l0_d0._data)))
+        ref.weight_hh_l0.copy_(torch.tensor(np.asarray(ours.wh_l0_d0._data)))
+        ref.bias_ih_l0.copy_(torch.tensor(np.asarray(ours.bi_l0_d0._data)))
+        ref.bias_hh_l0.copy_(torch.tensor(np.asarray(ours.bh_l0_d0._data)))
+    x = np.random.rand(b, s, f).astype(np.float32)
+    w = np.random.RandomState(1).standard_normal((b, s, h)) \
+        .astype(np.float32)
+
+    px = paddle.to_tensor(x)
+    px.stop_gradient = False
+    p_out = ours(px)[0]
+    (p_out * paddle.to_tensor(w)).sum().backward()
+
+    tx = torch.tensor(x, requires_grad=True)
+    t_out = ref(tx)[0]
+    (t_out * torch.tensor(w)).sum().backward()
+
+    np.testing.assert_allclose(np.asarray(px.grad._data),
+                               tx.grad.numpy(), rtol=1e-4, atol=1e-5,
+                               err_msg=f"{kind} input grad")
+    pairs = [(ours.wi_l0_d0, ref.weight_ih_l0, "weight_ih"),
+             (ours.wh_l0_d0, ref.weight_hh_l0, "weight_hh"),
+             (ours.bi_l0_d0, ref.bias_ih_l0, "bias_ih"),
+             (ours.bh_l0_d0, ref.bias_hh_l0, "bias_hh")]
+    for pp, tp, name in pairs:
+        np.testing.assert_allclose(np.asarray(pp.grad._data),
+                                   tp.grad.numpy(), rtol=1e-4, atol=1e-5,
+                                   err_msg=f"{kind} {name} grad")
