@@ -6,18 +6,22 @@ import paddle_tpu as paddle
 from paddle_tpu import nn
 
 
+def _copy_rnn_weights(torch, ours, ref):
+    """Map our per-gate l0 parameters onto torch's packed l0 weights."""
+    with torch.no_grad():
+        ref.weight_ih_l0.copy_(torch.tensor(np.asarray(ours.wi_l0_d0._data)))
+        ref.weight_hh_l0.copy_(torch.tensor(np.asarray(ours.wh_l0_d0._data)))
+        ref.bias_ih_l0.copy_(torch.tensor(np.asarray(ours.bi_l0_d0._data)))
+        ref.bias_hh_l0.copy_(torch.tensor(np.asarray(ours.bh_l0_d0._data)))
+
+
 def test_lstm_matches_torch():
     torch = pytest.importorskip("torch")
     paddle.seed(0)
     b, s, f, h = 2, 5, 4, 3
     ours = nn.LSTM(f, h, num_layers=1)
     ref = torch.nn.LSTM(f, h, num_layers=1, batch_first=True)
-    sd = {}
-    with torch.no_grad():
-        ref.weight_ih_l0.copy_(torch.tensor(np.asarray(ours.wi_l0_d0._data)))
-        ref.weight_hh_l0.copy_(torch.tensor(np.asarray(ours.wh_l0_d0._data)))
-        ref.bias_ih_l0.copy_(torch.tensor(np.asarray(ours.bi_l0_d0._data)))
-        ref.bias_hh_l0.copy_(torch.tensor(np.asarray(ours.bh_l0_d0._data)))
+    _copy_rnn_weights(torch, ours, ref)
     x = np.random.rand(b, s, f).astype(np.float32)
     out, (hn, cn) = ours(paddle.to_tensor(x))
     tout, (thn, tcn) = ref(torch.tensor(x))
@@ -32,11 +36,7 @@ def test_gru_matches_torch():
     b, s, f, h = 2, 6, 4, 3
     ours = nn.GRU(f, h)
     ref = torch.nn.GRU(f, h, batch_first=True)
-    with torch.no_grad():
-        ref.weight_ih_l0.copy_(torch.tensor(np.asarray(ours.wi_l0_d0._data)))
-        ref.weight_hh_l0.copy_(torch.tensor(np.asarray(ours.wh_l0_d0._data)))
-        ref.bias_ih_l0.copy_(torch.tensor(np.asarray(ours.bi_l0_d0._data)))
-        ref.bias_hh_l0.copy_(torch.tensor(np.asarray(ours.bh_l0_d0._data)))
+    _copy_rnn_weights(torch, ours, ref)
     x = np.random.rand(b, s, f).astype(np.float32)
     out, hn = ours(paddle.to_tensor(x))
     tout, thn = ref(torch.tensor(x))
@@ -129,11 +129,7 @@ def test_rnn_backward_matches_torch(kind):
         ours, ref = nn.LSTM(f, h), torch.nn.LSTM(f, h, batch_first=True)
     else:
         ours, ref = nn.GRU(f, h), torch.nn.GRU(f, h, batch_first=True)
-    with torch.no_grad():
-        ref.weight_ih_l0.copy_(torch.tensor(np.asarray(ours.wi_l0_d0._data)))
-        ref.weight_hh_l0.copy_(torch.tensor(np.asarray(ours.wh_l0_d0._data)))
-        ref.bias_ih_l0.copy_(torch.tensor(np.asarray(ours.bi_l0_d0._data)))
-        ref.bias_hh_l0.copy_(torch.tensor(np.asarray(ours.bh_l0_d0._data)))
+    _copy_rnn_weights(torch, ours, ref)
     x = np.random.rand(b, s, f).astype(np.float32)
     w = np.random.RandomState(1).standard_normal((b, s, h)) \
         .astype(np.float32)
