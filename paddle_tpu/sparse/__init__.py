@@ -37,6 +37,11 @@ class SparseCooTensor:
         return self._bcoo.dtype
 
     def values(self) -> Tensor:
+        # ops that produce values ON the autograd tape (masked_matmul)
+        # stash the live Tensor so backward() reaches the dense operands
+        vt = getattr(self, "_values_tensor", None)
+        if vt is not None:
+            return vt
         return Tensor(self._bcoo.data)
 
     def indices(self) -> Tensor:
@@ -46,6 +51,15 @@ class SparseCooTensor:
         return int(self._bcoo.nse)
 
     def to_dense(self) -> Tensor:
+        vt = getattr(self, "_values_tensor", None)
+        if vt is not None:
+            # values live on the autograd tape (masked_matmul): densify ON
+            # the tape so backward() through to_dense() reaches them
+            from ..core.dispatch import apply
+
+            return apply(_densify_fn, (vt, self._bcoo.indices),
+                         {"shape": tuple(self._bcoo.shape)},
+                         name="sparse_to_dense")
         return Tensor(self._bcoo.todense())
 
     def to_sparse_csr(self) -> "SparseCsrTensor":
@@ -138,12 +152,44 @@ def _coo(x):
     raise TypeError(f"expected SparseCooTensor, got {type(x)}")
 
 
+# tape-recorded sparse kernels: MODULE-LEVEL functions with the sparse
+# pieces passed as ARRAY ARGS and only the shape static — a closure over a
+# BCOO would defeat dispatch's jit cache (JAXSparse is unhashable, so the
+# cache would key on a fresh lambda per call: retrace every step + one
+# leaked executable per call, each retaining the whole sparse matrix)
+
+
+def _spmm_fn(yd, vals, idx, *, shape):
+    return jsparse.BCOO((vals, idx), shape=shape) @ yd
+
+
+def _sparse_dense_add_fn(yd, vals, idx, *, shape, sparse_first):
+    d = jsparse.BCOO((vals, idx), shape=shape).todense()
+    return d + yd if sparse_first else yd + d
+
+
+def _sddmm_fn(xd, yd, idx, *, shape):
+    rows, cols = idx[:, 0], idx[:, 1]
+    return jnp.einsum("nk,nk->n", xd[rows], yd[:, cols].T)
+
+
+def _densify_fn(vals, idx, *, shape):
+    return jsparse.BCOO((vals, idx), shape=shape).todense()
+
+
 def add(x, y, name=None):
+    from ..core.dispatch import apply
+
     if isinstance(x, SparseCooTensor) and isinstance(y, SparseCooTensor):
         return SparseCooTensor((_coo(x) + _coo(y)).sum_duplicates())
-    if isinstance(x, SparseCooTensor):
-        return Tensor(_coo(x).todense() + _data(y))
-    return Tensor(_data(x) + _coo(y).todense())
+    # dense-result forms record on the tape: gradients flow to the dense
+    # operand (the sparse side is structural data here, ref sparse.add)
+    sparse_first = isinstance(x, SparseCooTensor)
+    b = _coo(x) if sparse_first else _coo(y)
+    dense = y if sparse_first else x
+    return apply(_sparse_dense_add_fn, (dense, b.data, b.indices),
+                 {"shape": tuple(b.shape), "sparse_first": sparse_first},
+                 name="sparse_add")
 
 
 def multiply(x, y, name=None):
@@ -157,23 +203,35 @@ def multiply(x, y, name=None):
 
 
 def matmul(x, y, name=None):
-    """sparse @ dense (the GNN/embedding hot path)."""
-    if isinstance(x, SparseCooTensor):
-        out = _coo(x) @ _data(y)
-        return Tensor(out)
+    """sparse @ dense (the GNN/embedding hot path). Differentiable w.r.t.
+    the DENSE operand — adj @ features trains features/upstream layers;
+    the adjacency is structural (ref sparse matmul grad contract)."""
+    from ..core.dispatch import apply
+
     if isinstance(x, SparseCsrTensor):
-        out = x._bcsr @ _data(y)
-        return Tensor(out)
-    raise TypeError(f"matmul expects a sparse lhs, got {type(x)}")
+        x = SparseCooTensor(x._bcsr.to_bcoo())
+    if not isinstance(x, SparseCooTensor):
+        raise TypeError(f"matmul expects a sparse lhs, got {type(x)}")
+    b = _coo(x)
+    return apply(_spmm_fn, (y, b.data, b.indices),
+                 {"shape": tuple(b.shape)}, name="sparse_matmul")
 
 
 def masked_matmul(x, y, mask: SparseCooTensor, name=None):
-    """dense@dense evaluated only at mask's nonzeros (SDDMM)."""
+    """dense@dense evaluated only at mask's nonzeros (SDDMM). The sparse
+    output's VALUES are produced on the tape, so gradients flow back to
+    both dense operands through ``out.values()`` and ``out.to_dense()``
+    (``coalesce()``/``to_sparse_csr()`` drop the tape edge — take values
+    first when training through this op)."""
+    from ..core.dispatch import apply
+
     b = _coo(mask)
-    xd, yd = _data(x), _data(y)
-    rows, cols = b.indices[:, 0], b.indices[:, 1]
-    vals = jnp.einsum("nk,nk->n", xd[rows], yd[:, cols].T)
-    return SparseCooTensor(jsparse.BCOO((vals, b.indices), shape=b.shape))
+    vals = apply(_sddmm_fn, (x, y, b.indices), {"shape": tuple(b.shape)},
+                 name="masked_matmul")
+    out = SparseCooTensor(jsparse.BCOO((vals._data, b.indices),
+                                       shape=b.shape))
+    out._values_tensor = vals  # keeps the tape edge alive for .values()
+    return out
 
 
 def _unary(fn):

@@ -69,3 +69,131 @@ def test_masked_matmul():
     dense = out.to_dense().numpy()
     for (i, j) in [(0, 1), (1, 2), (2, 0)]:
         np.testing.assert_allclose(dense[i, j], full[i, j], atol=1e-5)
+
+
+class TestSparseAutograd:
+    """Dense-operand gradients through sparse ops (the GNN training path:
+    adj @ features must backprop into features; ref sparse grad contract)."""
+
+    def _coo(self, dense_np):
+        import paddle_tpu.sparse as sparse
+
+        idx = np.argwhere(dense_np != 0)
+        vals = dense_np[tuple(idx.T)]
+        return sparse.sparse_coo_tensor(
+            paddle.to_tensor(idx.T.astype(np.int64)),
+            paddle.to_tensor(vals), shape=list(dense_np.shape))
+
+    def test_spmm_grad_matches_dense(self):
+        import paddle_tpu.sparse as sparse
+
+        rng = np.random.RandomState(40)
+        adj = (rng.rand(5, 5) > 0.6).astype(np.float32) * rng.rand(5, 5) \
+            .astype(np.float32)
+        feats = rng.rand(5, 3).astype(np.float32)
+        w = rng.randn(5, 3).astype(np.float32)
+
+        sp = self._coo(adj)
+        x1 = paddle.to_tensor(feats)
+        x1.stop_gradient = False
+        (sparse.matmul(sp, x1) * paddle.to_tensor(w)).sum().backward()
+
+        x2 = paddle.to_tensor(feats)
+        x2.stop_gradient = False
+        (paddle.matmul(paddle.to_tensor(adj), x2)
+         * paddle.to_tensor(w)).sum().backward()
+        np.testing.assert_allclose(np.asarray(x1.grad._data),
+                                   np.asarray(x2.grad._data),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_sparse_add_dense_grad(self):
+        import paddle_tpu.sparse as sparse
+
+        rng = np.random.RandomState(41)
+        a = (rng.rand(4, 4) > 0.5).astype(np.float32)
+        y = paddle.to_tensor(rng.rand(4, 4).astype(np.float32))
+        y.stop_gradient = False
+        out = sparse.add(self._coo(a), y)
+        (out ** 2).sum().backward()
+        # d/dy (a+y)^2 = 2(a+y)
+        np.testing.assert_allclose(
+            np.asarray(y.grad._data),
+            2 * (a + np.asarray(y._data)), rtol=1e-5)
+
+    def test_masked_matmul_grads_both_operands(self):
+        import paddle_tpu.sparse as sparse
+
+        rng = np.random.RandomState(42)
+        xd = rng.rand(4, 6).astype(np.float32)
+        yd = rng.rand(6, 4).astype(np.float32)
+        mask_np = np.zeros((4, 4), np.float32)
+        mask_np[[0, 1, 3], [2, 0, 3]] = 1.0
+
+        px, py = paddle.to_tensor(xd), paddle.to_tensor(yd)
+        px.stop_gradient = py.stop_gradient = False
+        out = sparse.masked_matmul(px, py, self._coo(mask_np))
+        (out.values() ** 2).sum().backward()
+
+        tx, ty = paddle.to_tensor(xd), paddle.to_tensor(yd)
+        tx.stop_gradient = ty.stop_gradient = False
+        dense = paddle.matmul(tx, ty) * paddle.to_tensor(mask_np)
+        (dense ** 2).sum().backward()
+        np.testing.assert_allclose(np.asarray(px.grad._data),
+                                   np.asarray(tx.grad._data),
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(py.grad._data),
+                                   np.asarray(ty.grad._data),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_masked_matmul_to_dense_keeps_tape(self):
+        import paddle_tpu.sparse as sparse
+
+        rng = np.random.RandomState(43)
+        xd = rng.rand(3, 5).astype(np.float32)
+        yd = rng.rand(5, 3).astype(np.float32)
+        mask_np = np.eye(3, dtype=np.float32)
+        px, py = paddle.to_tensor(xd), paddle.to_tensor(yd)
+        px.stop_gradient = py.stop_gradient = False
+        dense_out = sparse.masked_matmul(px, py, self._coo(mask_np)) \
+            .to_dense()
+        (dense_out ** 2).sum().backward()
+        assert px.grad is not None and py.grad is not None
+        # equals the dense masked computation's grads
+        tx, ty = paddle.to_tensor(xd), paddle.to_tensor(yd)
+        tx.stop_gradient = ty.stop_gradient = False
+        ((paddle.matmul(tx, ty) * paddle.to_tensor(mask_np)) ** 2) \
+            .sum().backward()
+        np.testing.assert_allclose(np.asarray(px.grad._data),
+                                   np.asarray(tx.grad._data), rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_csr_matmul_grad(self):
+        import paddle_tpu.sparse as sparse
+
+        rng = np.random.RandomState(44)
+        adj = (rng.rand(4, 4) > 0.5).astype(np.float32)
+        csr = self._coo(adj).to_sparse_csr()
+        x = paddle.to_tensor(rng.rand(4, 2).astype(np.float32))
+        x.stop_gradient = False
+        sparse.matmul(csr, x).sum().backward()
+        np.testing.assert_allclose(np.asarray(x.grad._data),
+                                   adj.sum(0)[:, None].repeat(2, 1),
+                                   rtol=1e-5)
+
+    def test_spmm_repeated_calls_reuse_jit_cache(self):
+        """Stable module-level kernels: repeated sparse.matmul calls with
+        the same structure must NOT grow the dispatch jit cache per call
+        (a per-call closure would retrace and leak an executable each
+        step of a GNN loop)."""
+        import paddle_tpu.sparse as sparse
+        from paddle_tpu.core import dispatch
+
+        rng = np.random.RandomState(45)
+        adj = (rng.rand(6, 6) > 0.5).astype(np.float32)
+        sp = self._coo(adj)
+        x = paddle.to_tensor(rng.rand(6, 2).astype(np.float32))
+        sparse.matmul(sp, x)  # prime
+        before = len(dispatch._JIT_CACHE)
+        for _ in range(5):
+            sparse.matmul(sp, x)
+        assert len(dispatch._JIT_CACHE) == before
