@@ -168,9 +168,13 @@ def _sparse_dense_add_fn(yd, vals, idx, *, shape, sparse_first):
     return d + yd if sparse_first else yd + d
 
 
-def _sddmm_fn(xd, yd, idx, *, shape):
+def _sddmm_fn(xd, yd, idx):
     rows, cols = idx[:, 0], idx[:, 1]
     return jnp.einsum("nk,nk->n", xd[rows], yd[:, cols].T)
+
+
+def _csr_spmm_fn(yd, data, indices, indptr, *, shape):
+    return jsparse.BCSR((data, indices, indptr), shape=shape) @ yd
 
 
 def _densify_fn(vals, idx, *, shape):
@@ -209,7 +213,10 @@ def matmul(x, y, name=None):
     from ..core.dispatch import apply
 
     if isinstance(x, SparseCsrTensor):
-        x = SparseCooTensor(x._bcsr.to_bcoo())
+        # keep the BCSR lowering (no per-call COO conversion in GNN loops)
+        b = x._bcsr
+        return apply(_csr_spmm_fn, (y, b.data, b.indices, b.indptr),
+                     {"shape": tuple(b.shape)}, name="sparse_matmul_csr")
     if not isinstance(x, SparseCooTensor):
         raise TypeError(f"matmul expects a sparse lhs, got {type(x)}")
     b = _coo(x)
@@ -226,8 +233,7 @@ def masked_matmul(x, y, mask: SparseCooTensor, name=None):
     from ..core.dispatch import apply
 
     b = _coo(mask)
-    vals = apply(_sddmm_fn, (x, y, b.indices), {"shape": tuple(b.shape)},
-                 name="masked_matmul")
+    vals = apply(_sddmm_fn, (x, y, b.indices), {}, name="masked_matmul")
     out = SparseCooTensor(jsparse.BCOO((vals._data, b.indices),
                                        shape=b.shape))
     out._values_tensor = vals  # keeps the tape edge alive for .values()
@@ -329,17 +335,19 @@ def transpose(x, perm, name=None):
 
 
 def mv(x, vec, name=None):
-    """Sparse matrix @ dense vector."""
+    """Sparse matrix @ dense vector — differentiable w.r.t. ``vec`` (the
+    taped ops compose: unsqueeze -> sparse matmul -> squeeze)."""
     if isinstance(x, (SparseCooTensor, SparseCsrTensor)):
-        out = matmul(x, Tensor(_data(vec)[:, None]))
-        return Tensor(_data(out)[:, 0])
-    return Tensor(_data(x) @ _data(vec))
+        return matmul(x, vec.unsqueeze(-1)).squeeze(-1)
+    from ..core.dispatch import apply
+
+    return apply(lambda xa, va: xa @ va, (x, vec), {}, name="mv")
 
 
 def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
-    """beta*input + alpha*(x @ y) with sparse x (ref sparse.addmm)."""
-    prod = matmul(x, y)
-    return Tensor(beta * _data(input) + alpha * _data(prod))
+    """beta*input + alpha*(x @ y) with sparse x (ref sparse.addmm) —
+    composed from taped ops, so gradients reach ``input`` and ``y``."""
+    return input * beta + matmul(x, y) * alpha
 
 
 from . import nn  # noqa: F401,E402  (sparse layers)

@@ -1,5 +1,6 @@
 """paddle.geometric message passing + sampling (ref:python/paddle/geometric/)."""
 import numpy as np
+import pytest
 
 import paddle_tpu as paddle
 from paddle_tpu import geometric as G
@@ -92,3 +93,51 @@ def test_weighted_sampling_fewer_nonzero_than_k():
         row, colptr, w, T([0], np.int64), sample_size=2)
     # only one positive-weight edge: degrade to 1 sample, don't crash
     assert cnt.numpy().tolist() == [1] and neigh.numpy().tolist() == [3]
+
+
+class TestMessagePassingBackward:
+    """Scatter-reduce gradients vs torch (index_add / scatter_reduce):
+    sum/mean route grads to every contributing edge, max only to the
+    argmax edge — the subgradient conventions dense tests can't see."""
+
+    def _setup(self):
+        rng = np.random.RandomState(50)
+        x = rng.randn(6, 3).astype(np.float32)  # no ties (random floats)
+        src = np.array([0, 1, 2, 3, 4, 5, 0, 2], np.int64)
+        dst = np.array([1, 0, 3, 2, 5, 4, 2, 0], np.int64)
+        w = rng.randn(6, 3).astype(np.float32)
+        return x, src, dst, w
+
+    def _torch_grad(self, x, src, dst, w, reduce):
+        import torch
+
+        tx = torch.tensor(x, requires_grad=True)
+        gathered = tx[torch.tensor(src)]
+        if reduce in ("sum", "mean"):
+            out = torch.zeros(6, 3).index_add_(0, torch.tensor(dst),
+                                               gathered)
+            if reduce == "mean":
+                cnt = torch.zeros(6).index_add_(
+                    0, torch.tensor(dst), torch.ones(len(dst)))
+                out = out / cnt.clamp(min=1).unsqueeze(1)
+        else:
+            out = torch.full((6, 3), -torch.inf).scatter_reduce(
+                0, torch.tensor(dst)[:, None].expand(-1, 3), gathered,
+                reduce="amax", include_self=False)
+            out = torch.where(torch.isinf(out), torch.zeros(()), out)
+        (out * torch.tensor(w)).sum().backward()
+        return tx.grad.numpy()
+
+    @pytest.mark.parametrize("reduce", ["sum", "mean", "max"])
+    def test_send_u_recv_grad(self, reduce):
+        x, src, dst, w = self._setup()
+        px = paddle.to_tensor(x)
+        px.stop_gradient = False
+        out = G.send_u_recv(px, paddle.to_tensor(src),
+                            paddle.to_tensor(dst), reduce_op=reduce,
+                            out_size=6)
+        (out * paddle.to_tensor(w)).sum().backward()
+        want = self._torch_grad(x, src, dst, w, reduce)
+        np.testing.assert_allclose(np.asarray(px.grad._data), want,
+                                   rtol=1e-5, atol=1e-6,
+                                   err_msg=f"send_u_recv {reduce} grad")

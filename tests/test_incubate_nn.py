@@ -107,3 +107,59 @@ def test_fused_multi_transformer_cached_decode_matches_full():
         outs.append(out.numpy())
     np.testing.assert_allclose(outs[-1][:, 0], full[:, -1], rtol=1e-4,
                                atol=1e-5)
+
+
+def test_fused_mha_matches_unfused_forward_and_backward():
+    """Fused attention (packed [3,H,Dh,E] qkv, flash core) equals the
+    plain nn.MultiHeadAttention with the same weights — outputs AND
+    gradients (fusion must be a layout change, never a math change)."""
+    from paddle_tpu import nn as pnn
+
+    e, h = 16, 4
+    dh = e // h
+    rng = np.random.default_rng(7)
+    x_np = rng.standard_normal((2, 6, e)).astype(np.float32)
+    w_np = rng.standard_normal((2, 6, e)).astype(np.float32)
+
+    fused = inn.FusedMultiHeadAttention(e, h, dropout_rate=0.0,
+                                        attn_dropout_rate=0.0)
+    plain = pnn.MultiHeadAttention(e, h)
+    # fused packs [3, H, Dh, E] (w @ x convention per slice); plain's
+    # Linear holds [E, E] with x @ w
+    qkv = np.asarray(fused.qkv_weight._data)  # [3, H, Dh, E]
+    for i, proj in enumerate((plain.q_proj, plain.k_proj, plain.v_proj)):
+        import jax.numpy as jnp
+
+        proj.weight._data = jnp.asarray(qkv[i].reshape(e, e).T)
+        proj.bias._data = jnp.asarray(
+            np.asarray(fused.qkv_bias._data)[i].reshape(e))
+    plain.out_proj.weight._data = fused.linear_weight._data
+    plain.out_proj.bias._data = fused.linear_bias._data
+
+    xf = T(x_np); xf.stop_gradient = False
+    xp = T(x_np); xp.stop_gradient = False
+    of = fused(xf)
+    # fused applies post-LN by default (normalize_before=False): compare
+    # the pre-LN attention result by inverting? No — apply the same LN to
+    # the plain path using fused's ln params
+    op_ = plain(xp, xp, xp)
+    op_ = pnn.functional.layer_norm(
+        op_ + xp, normalized_shape=[e],
+        weight=T(np.asarray(fused.ln_scale._data)),
+        bias=T(np.asarray(fused.ln_bias._data)))
+    np.testing.assert_allclose(np.asarray(of._data), np.asarray(op_._data),
+                               rtol=2e-4, atol=2e-4)
+
+    (of * T(w_np)).sum().backward()
+    (op_ * T(w_np)).sum().backward()
+    np.testing.assert_allclose(np.asarray(xf.grad._data),
+                               np.asarray(xp.grad._data),
+                               rtol=2e-3, atol=2e-4,
+                               err_msg="fused vs unfused input grad")
+    # packed qkv grad slices equal the plain projections' grads
+    qg = np.asarray(fused.qkv_weight.grad._data)
+    for i, proj in enumerate((plain.q_proj, plain.k_proj, plain.v_proj)):
+        np.testing.assert_allclose(qg[i].reshape(e, e),
+                                   np.asarray(proj.weight.grad._data).T,
+                                   rtol=2e-3, atol=2e-4,
+                                   err_msg=f"qkv slice {i} grad")

@@ -197,3 +197,26 @@ class TestSparseAutograd:
         for _ in range(5):
             sparse.matmul(sp, x)
         assert len(dispatch._JIT_CACHE) == before
+
+    def test_mv_and_addmm_grads(self):
+        import paddle_tpu.sparse as sparse
+
+        rng = np.random.RandomState(46)
+        adj = (rng.rand(4, 4) > 0.4).astype(np.float32)
+        sp = self._coo(adj)
+        v = paddle.to_tensor(rng.rand(4).astype(np.float32))
+        v.stop_gradient = False
+        sparse.mv(sp, v).sum().backward()
+        np.testing.assert_allclose(np.asarray(v.grad._data), adj.sum(0),
+                                   rtol=1e-5)
+
+        inp = paddle.to_tensor(rng.rand(4, 2).astype(np.float32))
+        y = paddle.to_tensor(rng.rand(4, 2).astype(np.float32))
+        inp.stop_gradient = y.stop_gradient = False
+        out = sparse.addmm(inp, sp, y, beta=0.5, alpha=2.0)
+        out.sum().backward()
+        np.testing.assert_allclose(np.asarray(inp.grad._data),
+                                   np.full((4, 2), 0.5), rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(y.grad._data),
+                                   2.0 * adj.sum(0)[:, None]
+                                   .repeat(2, 1), rtol=1e-5)
