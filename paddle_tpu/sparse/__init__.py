@@ -334,20 +334,25 @@ def transpose(x, perm, name=None):
     return to_sparse_coo(Tensor(arr), sparse_dim=arr.ndim)
 
 
+def _as_tensor(t):
+    return t if isinstance(t, Tensor) else Tensor(jnp.asarray(t))
+
+
 def mv(x, vec, name=None):
     """Sparse matrix @ dense vector — differentiable w.r.t. ``vec`` (the
     taped ops compose: unsqueeze -> sparse matmul -> squeeze)."""
+    vec = _as_tensor(vec)
     if isinstance(x, (SparseCooTensor, SparseCsrTensor)):
         return matmul(x, vec.unsqueeze(-1)).squeeze(-1)
-    from ..core.dispatch import apply
-
-    return apply(lambda xa, va: xa @ va, (x, vec), {}, name="mv")
+    # dense fallback rides the standard matmul op (keeps AMP cast rules
+    # and the tape; Tensor.__matmul__ dispatches it)
+    return _as_tensor(x) @ vec
 
 
 def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
     """beta*input + alpha*(x @ y) with sparse x (ref sparse.addmm) —
     composed from taped ops, so gradients reach ``input`` and ``y``."""
-    return input * beta + matmul(x, y) * alpha
+    return _as_tensor(input) * beta + matmul(x, y) * alpha
 
 
 from . import nn  # noqa: F401,E402  (sparse layers)

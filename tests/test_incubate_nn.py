@@ -126,10 +126,10 @@ def test_fused_mha_matches_unfused_forward_and_backward():
     plain = pnn.MultiHeadAttention(e, h)
     # fused packs [3, H, Dh, E] (w @ x convention per slice); plain's
     # Linear holds [E, E] with x @ w
+    import jax.numpy as jnp
+
     qkv = np.asarray(fused.qkv_weight._data)  # [3, H, Dh, E]
     for i, proj in enumerate((plain.q_proj, plain.k_proj, plain.v_proj)):
-        import jax.numpy as jnp
-
         proj.weight._data = jnp.asarray(qkv[i].reshape(e, e).T)
         proj.bias._data = jnp.asarray(
             np.asarray(fused.qkv_bias._data)[i].reshape(e))
@@ -139,9 +139,8 @@ def test_fused_mha_matches_unfused_forward_and_backward():
     xf = T(x_np); xf.stop_gradient = False
     xp = T(x_np); xp.stop_gradient = False
     of = fused(xf)
-    # fused applies post-LN by default (normalize_before=False): compare
-    # the pre-LN attention result by inverting? No — apply the same LN to
-    # the plain path using fused's ln params
+    # fused applies residual + post-LN (normalize_before=False): build the
+    # same residual+LN around the plain attention with fused's ln params
     op_ = plain(xp, xp, xp)
     op_ = pnn.functional.layer_norm(
         op_ + xp, normalized_shape=[e],
