@@ -48,6 +48,27 @@ class Distribution:
     def rsample(self, shape=()):
         return self.sample(shape)
 
+    # ---- pathwise (reparameterized) sampling support ------------------
+    # Location-scale families keep their ORIGINAL param Tensors: rsample
+    # composes loc + scale * noise through taped Tensor ops, so gradients
+    # reach live loc/scale parameters (the VAE / pathwise-gradient
+    # contract, ref:python/paddle/distribution/normal.py:200 rsample).
+    # sample() stays detached, matching the reference's split.
+
+    def _keep_live(self, **named):
+        from ..core.tensor import Tensor
+
+        self._live_params = {k: v for k, v in named.items()
+                             if isinstance(v, Tensor)}
+
+    def _live(self, name, fallback):
+        t = getattr(self, "_live_params", {}).get(name)
+        return t if t is not None else _t(fallback)
+
+    def _loc_scale_rsample(self, noise):
+        return (self._live("loc", self.loc)
+                + self._live("scale", self.scale) * _t(noise))
+
     def log_prob(self, value):
         raise NotImplementedError
 
@@ -66,6 +87,7 @@ class Normal(Distribution):
         self.loc = _arr(loc)
         self.scale = _arr(scale)
         super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+        self._keep_live(loc=loc, scale=scale)
 
     @property
     def mean(self):
@@ -79,6 +101,11 @@ class Normal(Distribution):
         shape = tuple(shape) + self.batch_shape
         z = jax.random.normal(rng.next_key(), shape)
         return _t(self.loc + self.scale * z)
+
+    def rsample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        return self._loc_scale_rsample(jax.random.normal(rng.next_key(),
+                                                         shape))
 
     def log_prob(self, value):
         v = _arr(value)
@@ -127,6 +154,7 @@ class Bernoulli(Distribution):
             self.probs = _arr(probs)
             self.logits = jnp.log(self.probs) - jnp.log1p(-self.probs)
         super().__init__(self.probs.shape)
+        self._keep_live(probs=probs, logits=logits)
 
     @property
     def mean(self):
@@ -140,6 +168,25 @@ class Bernoulli(Distribution):
         shape = tuple(shape) + self.batch_shape
         return _t(jax.random.bernoulli(rng.next_key(), self.probs, shape)
                   .astype(jnp.float32))
+
+    def rsample(self, shape=(), temperature=1.0):
+        """Gumbel-sigmoid relaxed sample (ref bernoulli.py:193): pathwise
+        differentiable w.r.t. live probs/logits via the taped sigmoid."""
+        from ..nn import functional as _F
+
+        shape = tuple(shape) + self.batch_shape
+        u = jax.random.uniform(rng.next_key(), shape, minval=1e-7,
+                               maxval=1.0 - 1e-7)
+        noise = jnp.log(u) - jnp.log1p(-u)  # logistic noise
+        live = getattr(self, "_live_params", {})
+        if "logits" in live:
+            logits = live["logits"]
+        elif "probs" in live:
+            p = live["probs"]
+            logits = (p / (1.0 - p)).log()
+        else:
+            logits = _t(self.logits)
+        return _F.sigmoid((logits + _t(noise)) / temperature)
 
     def log_prob(self, value):
         v = _arr(value)
@@ -267,10 +314,16 @@ class Laplace(Distribution):
         self.loc = _arr(loc)
         self.scale = _arr(scale)
         super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+        self._keep_live(loc=loc, scale=scale)
 
     def sample(self, shape=()):
         shape = tuple(shape) + self.batch_shape
         return _t(self.loc + self.scale * jax.random.laplace(rng.next_key(), shape))
+
+    def rsample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        return self._loc_scale_rsample(jax.random.laplace(rng.next_key(),
+                                                          shape))
 
     def log_prob(self, value):
         v = _arr(value)
@@ -286,10 +339,16 @@ class Gumbel(Distribution):
         self.loc = _arr(loc)
         self.scale = _arr(scale)
         super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+        self._keep_live(loc=loc, scale=scale)
 
     def sample(self, shape=()):
         shape = tuple(shape) + self.batch_shape
         return _t(self.loc + self.scale * jax.random.gumbel(rng.next_key(), shape))
+
+    def rsample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        return self._loc_scale_rsample(jax.random.gumbel(rng.next_key(),
+                                                         shape))
 
     def log_prob(self, value):
         z = (_arr(value) - self.loc) / self.scale
@@ -305,6 +364,13 @@ class LogNormal(Distribution):
 
     def sample(self, shape=()):
         return _t(jnp.exp(_arr(self._normal.sample(shape))))
+
+    def rsample(self, shape=()):
+        # exp over the underlying normal's pathwise sample, on the tape
+        from ..core.dispatch import apply
+
+        return apply(jnp.exp, (self._normal.rsample(shape),), {},
+                     name="exp")
 
     def log_prob(self, value):
         v = _arr(value)
@@ -433,6 +499,7 @@ class Cauchy(Distribution):
         self.loc = _arr(loc)
         self.scale = _arr(scale)
         super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+        self._keep_live(loc=loc, scale=scale)
 
     def sample(self, shape=()):
         shape = tuple(shape) + self.batch_shape
@@ -440,7 +507,11 @@ class Cauchy(Distribution):
                                maxval=1.0 - 1e-7)
         return _t(self.loc + self.scale * jnp.tan(math.pi * (u - 0.5)))
 
-    rsample = sample
+    def rsample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        u = jax.random.uniform(rng.next_key(), shape, minval=1e-7,
+                               maxval=1.0 - 1e-7)
+        return self._loc_scale_rsample(jnp.tan(math.pi * (u - 0.5)))
 
     def log_prob(self, value):
         v = _arr(value)
@@ -537,7 +608,22 @@ class TransformedDistribution(Distribution):
             x = _arr(t.forward(_t(x)))
         return _t(x)
 
-    rsample = sample
+    def rsample(self, shape=()):
+        # base rsample keeps its tape edge; the transform chain (raw-jnp
+        # internally) is recorded as ONE taped op, so jax.vjp carries the
+        # pathwise gradient through the whole pushforward. Tuple (not
+        # list) in the closure: the jit cache needs hashable cells.
+        from ..core.dispatch import apply
+
+        x = self.base.rsample(shape)
+        transforms = tuple(self.transforms)
+
+        def _push(xa):
+            for t in transforms:
+                xa = _arr(t.forward(_t(xa)))
+            return xa
+
+        return apply(_push, (x,), {}, name="transform_pushforward")
 
     def log_prob(self, value):
         y = _arr(value)

@@ -32,10 +32,44 @@ def _t(x):
     return Tensor(x)
 
 
+
+def _value_key(a):
+    """Hashable identity for a (small) array-valued transform parameter:
+    the VALUE, because closure constants are baked into the traced program
+    — a value-blind cache key would reuse stale constants. Large arrays
+    fall back to object identity (accepting retrace churn over hashing
+    megabytes)."""
+    import numpy as _np
+
+    a = _np.asarray(a)
+    if a.size <= 64:
+        return ("v", a.shape, str(a.dtype), a.tobytes())
+    return ("id", id(a))
+
+
 class Transform:
     """Base invertible transform: forward/inverse plus log-det-Jacobians."""
 
     _is_injective = True
+
+    # Cache identity: TransformedDistribution.rsample records the transform
+    # chain as one taped op whose jit-cache key includes the closure — a
+    # fresh transform object per training step (the normal VAE pattern)
+    # would retrace and leak a cache entry every step if keyed by object
+    # identity. Stateless transforms are interchangeable by TYPE; stateful
+    # ones (Affine/Power/Reshape/...) override _cache_key because their
+    # captured values are baked into the traced program as constants — a
+    # value-blind key would silently reuse stale constants.
+
+    def _cache_key(self):
+        return (type(self),)
+
+    def __eq__(self, other):
+        return (type(self) is type(other)
+                and self._cache_key() == other._cache_key())
+
+    def __hash__(self):
+        return hash(self._cache_key())
 
     def forward(self, x):
         raise NotImplementedError
@@ -83,6 +117,9 @@ class AffineTransform(Transform):
         self.loc = _arr(loc)
         self.scale = _arr(scale)
 
+    def _cache_key(self):
+        return (type(self), _value_key(self.loc), _value_key(self.scale))
+
     def forward(self, x):
         return _t(self.loc + self.scale * _arr(x))
 
@@ -99,6 +136,9 @@ class ChainTransform(Transform):
 
     def __init__(self, transforms):
         self.transforms = list(transforms)
+
+    def _cache_key(self):
+        return (type(self), tuple(t._cache_key() for t in self.transforms))
 
     def forward(self, x):
         for t in self.transforms:
@@ -151,6 +191,10 @@ class IndependentTransform(Transform):
         self.base = base
         self.reinterpreted_batch_ndims = int(reinterpreted_batch_ndims)
 
+    def _cache_key(self):
+        return (type(self), self.base._cache_key(),
+                self.reinterpreted_batch_ndims)
+
     def forward(self, x):
         return self.base.forward(x)
 
@@ -182,6 +226,9 @@ class PowerTransform(Transform):
     def __init__(self, power):
         self.power = _arr(power)
 
+    def _cache_key(self):
+        return (type(self), _value_key(self.power))
+
     def forward(self, x):
         return _t(jnp.power(_arr(x), self.power))
 
@@ -195,6 +242,9 @@ class PowerTransform(Transform):
 
 class ReshapeTransform(Transform):
     """Reshape the event block; volume-preserving (log-det 0)."""
+
+    def _cache_key(self):
+        return (type(self), self.in_event_shape, self.out_event_shape)
 
     def __init__(self, in_event_shape, out_event_shape):
         self.in_event_shape = tuple(in_event_shape)
@@ -276,6 +326,10 @@ class StackTransform(Transform):
     def __init__(self, transforms, axis=0):
         self.transforms = list(transforms)
         self.axis = int(axis)
+
+    def _cache_key(self):
+        return (type(self), self.axis,
+                tuple(t._cache_key() for t in self.transforms))
 
     def _map(self, method, v):
         va = _arr(v)

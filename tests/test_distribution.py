@@ -85,3 +85,96 @@ def test_dirichlet_multinomial():
     sm = m.sample((500,)).numpy()
     assert sm.sum(-1).max() == 10
     np.testing.assert_allclose(sm.mean(0), [2.0, 3.0, 5.0], atol=0.4)
+
+
+class TestPathwiseRsample:
+    """rsample must carry pathwise gradients to LIVE loc/scale parameters
+    (the VAE / reparameterization contract, ref normal.py:200). The
+    location-scale identity gives exact expected grads from the drawn
+    sample itself: d sum(x)/d loc = N, d sum(x)/d scale = sum((x-loc)/scale)."""
+
+    def _check_loc_scale(self, dist_cls, **kw):
+        loc = paddle.to_tensor(np.float32(0.3))
+        scale = paddle.to_tensor(np.float32(1.7))
+        loc.stop_gradient = scale.stop_gradient = False
+        d = dist_cls(loc, scale, **kw)
+        x = d.rsample([64])
+        x.sum().backward()
+        xv = np.asarray(x._data)
+        np.testing.assert_allclose(float(loc.grad._data), 64.0, rtol=1e-5)
+        np.testing.assert_allclose(float(scale.grad._data),
+                                   ((xv - 0.3) / 1.7).sum(), rtol=1e-4)
+
+    def test_normal(self):
+        self._check_loc_scale(D.Normal)
+
+    def test_laplace(self):
+        self._check_loc_scale(D.Laplace)
+
+    def test_gumbel(self):
+        self._check_loc_scale(D.Gumbel)
+
+    def test_cauchy(self):
+        self._check_loc_scale(D.Cauchy)
+
+    def test_sample_stays_detached(self):
+        loc = paddle.to_tensor(np.float32(0.0))
+        loc.stop_gradient = False
+        x = D.Normal(loc, paddle.to_tensor(np.float32(1.0))).sample([4])
+        assert x.stop_gradient  # sample() is the no-grad path
+
+    def test_transformed_rsample_flows(self):
+        from paddle_tpu.distribution import transform as T
+
+        loc = paddle.to_tensor(np.float32(0.1))
+        loc.stop_gradient = False
+        td = D.TransformedDistribution(
+            D.Normal(loc, paddle.to_tensor(np.float32(1.0))),
+            [T.ExpTransform()])
+        y = td.rsample([32])
+        y.sum().backward()
+        # d sum(exp(z))/d loc = sum(exp(z)) = sum(y)
+        np.testing.assert_allclose(float(loc.grad._data),
+                                   float(np.asarray(y._data).sum()),
+                                   rtol=1e-4)
+
+    def test_bernoulli_relaxed_rsample(self):
+        p = paddle.to_tensor(np.float32(0.4))
+        p.stop_gradient = False
+        temp = 0.7
+        x = D.Bernoulli(probs=p).rsample([128], temperature=temp)
+        x.sum().backward()
+        xv = np.asarray(x._data)
+        # x = sigmoid((logits+g)/T): dx/dp = x(1-x) / (T * p(1-p))
+        want = (xv * (1 - xv)).sum() / (temp * 0.4 * 0.6)
+        np.testing.assert_allclose(float(p.grad._data), want, rtol=1e-3)
+
+    def test_lognormal_rsample_flows(self):
+        loc = paddle.to_tensor(np.float32(0.2))
+        loc.stop_gradient = False
+        y = D.LogNormal(loc, paddle.to_tensor(np.float32(0.5))).rsample([32])
+        y.sum().backward()
+        # d sum(exp(z))/d loc = sum(y)
+        np.testing.assert_allclose(float(loc.grad._data),
+                                   float(np.asarray(y._data).sum()),
+                                   rtol=1e-4)
+
+    def test_rsample_jit_cache_stable_across_instances(self):
+        """The VAE pattern rebuilds the distribution + transforms every
+        step: repeated rsample must hit the SAME jit cache entry, not
+        retrace/leak one per step (transforms key by type+value)."""
+        from paddle_tpu.core import dispatch
+        from paddle_tpu.distribution import transform as T
+
+        def once():
+            td = D.TransformedDistribution(
+                D.Normal(paddle.to_tensor(np.float32(0.0)),
+                         paddle.to_tensor(np.float32(1.0))),
+                [T.ExpTransform()])
+            return td.rsample([8])
+
+        once()  # prime
+        before = len(dispatch._JIT_CACHE)
+        for _ in range(5):
+            once()
+        assert len(dispatch._JIT_CACHE) == before
