@@ -82,6 +82,45 @@ class Distribution:
         return kl_divergence(self, other)
 
 
+
+# --- implicit-reparameterization sampling kernels (module-level: the jit
+# cache keys on these + static shape; the PRNG key rides as an ARG). jax's
+# random.gamma/t carry implicit gradients w.r.t. their shape parameters,
+# which is what makes rsample differentiable beyond the location-scale
+# family (exceeds the reference, whose rsample stops at loc-scale).
+
+
+def _gamma_rsample_fn(key, conc, rate, *, shape):
+    return jax.random.gamma(key, conc, shape) / rate
+
+
+def _exponential_rsample_fn(key, rate, *, shape):
+    return jax.random.exponential(key, shape) / rate
+
+
+def _beta_rsample_fn(key, a, b, *, shape):
+    # log-space gamma ratio (jax._src.random._beta's own trick): raw gamma
+    # draws underflow to 0/0 NaN for small concentrations in f32; loggamma
+    # carries the same implicit gradients without the underflow
+    k1, k2 = jax.random.split(key)
+    la = jax.random.loggamma(k1, a, shape)
+    lb = jax.random.loggamma(k2, b, shape)
+    m = jnp.maximum(la, lb)
+    ea, eb = jnp.exp(la - m), jnp.exp(lb - m)
+    return ea / (ea + eb)
+
+
+def _dirichlet_rsample_fn(key, conc, *, shape):
+    # softmax-of-loggamma (jax's own _dirichlet): normalizing raw gamma
+    # draws NaNs whole rows when every component underflows
+    lg = jax.random.loggamma(key, conc, shape + conc.shape[-1:])
+    return jax.nn.softmax(lg, -1)
+
+
+def _studentt_rsample_fn(key, df, loc, scale, *, shape):
+    return loc + scale * jax.random.t(key, df, shape)
+
+
 class Normal(Distribution):
     def __init__(self, loc, scale, name=None):
         self.loc = _arr(loc)
@@ -230,6 +269,7 @@ class Exponential(Distribution):
     def __init__(self, rate, name=None):
         self.rate = _arr(rate)
         super().__init__(self.rate.shape)
+        self._keep_live(rate=rate)
 
     @property
     def mean(self):
@@ -238,6 +278,14 @@ class Exponential(Distribution):
     def sample(self, shape=()):
         shape = tuple(shape) + self.batch_shape
         return _t(jax.random.exponential(rng.next_key(), shape) / self.rate)
+
+    def rsample(self, shape=()):
+        from ..core.dispatch import apply
+
+        full = tuple(shape) + self.batch_shape
+        return apply(_exponential_rsample_fn,
+                     (_t(rng.next_key()), self._live("rate", self.rate)),
+                     {"shape": full}, name="exponential_rsample")
 
     def log_prob(self, value):
         v = _arr(value)
@@ -252,6 +300,7 @@ class Gamma(Distribution):
         self.concentration = _arr(concentration)
         self.rate = _arr(rate)
         super().__init__(jnp.broadcast_shapes(self.concentration.shape, self.rate.shape))
+        self._keep_live(concentration=concentration, rate=rate)
 
     @property
     def mean(self):
@@ -260,6 +309,16 @@ class Gamma(Distribution):
     def sample(self, shape=()):
         shape = tuple(shape) + self.batch_shape
         return _t(jax.random.gamma(rng.next_key(), self.concentration, shape) / self.rate)
+
+    def rsample(self, shape=()):
+        from ..core.dispatch import apply
+
+        full = tuple(shape) + self.batch_shape
+        return apply(_gamma_rsample_fn,
+                     (_t(rng.next_key()),
+                      self._live("concentration", self.concentration),
+                      self._live("rate", self.rate)),
+                     {"shape": full}, name="gamma_rsample")
 
     def log_prob(self, value):
         v = _arr(value)
@@ -276,6 +335,7 @@ class Beta(Distribution):
         self.alpha = _arr(alpha)
         self.beta = _arr(beta)
         super().__init__(jnp.broadcast_shapes(self.alpha.shape, self.beta.shape))
+        self._keep_live(alpha=alpha, beta=beta)
 
     @property
     def mean(self):
@@ -284,6 +344,15 @@ class Beta(Distribution):
     def sample(self, shape=()):
         shape = tuple(shape) + self.batch_shape
         return _t(jax.random.beta(rng.next_key(), self.alpha, self.beta, shape))
+
+    def rsample(self, shape=()):
+        from ..core.dispatch import apply
+
+        full = tuple(shape) + self.batch_shape
+        return apply(_beta_rsample_fn,
+                     (_t(rng.next_key()), self._live("alpha", self.alpha),
+                      self._live("beta", self.beta)),
+                     {"shape": full}, name="beta_rsample")
 
     def log_prob(self, value):
         v = _arr(value)
@@ -297,10 +366,20 @@ class Dirichlet(Distribution):
         self.concentration = _arr(concentration)
         super().__init__(self.concentration.shape[:-1],
                          self.concentration.shape[-1:])
+        self._keep_live(concentration=concentration)
 
     def sample(self, shape=()):
         shape = tuple(shape) + self.batch_shape
         return _t(jax.random.dirichlet(rng.next_key(), self.concentration, shape))
+
+    def rsample(self, shape=()):
+        from ..core.dispatch import apply
+
+        full = tuple(shape) + self.batch_shape
+        return apply(_dirichlet_rsample_fn,
+                     (_t(rng.next_key()),
+                      self._live("concentration", self.concentration)),
+                     {"shape": full}, name="dirichlet_rsample")
 
     def log_prob(self, value):
         v = _arr(value)
@@ -435,10 +514,21 @@ class StudentT(Distribution):
         self.scale = _arr(scale)
         super().__init__(jnp.broadcast_shapes(self.df.shape, self.loc.shape,
                                               self.scale.shape))
+        self._keep_live(df=df, loc=loc, scale=scale)
 
     def sample(self, shape=()):
         shape = tuple(shape) + self.batch_shape
         return _t(self.loc + self.scale * jax.random.t(rng.next_key(), self.df, shape))
+
+    def rsample(self, shape=()):
+        from ..core.dispatch import apply
+
+        full = tuple(shape) + self.batch_shape
+        return apply(_studentt_rsample_fn,
+                     (_t(rng.next_key()), self._live("df", self.df),
+                      self._live("loc", self.loc),
+                      self._live("scale", self.scale)),
+                     {"shape": full}, name="studentt_rsample")
 
     def log_prob(self, value):
         z = (_arr(value) - self.loc) / self.scale

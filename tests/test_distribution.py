@@ -178,3 +178,64 @@ class TestPathwiseRsample:
         for _ in range(5):
             once()
         assert len(dispatch._JIT_CACHE) == before
+
+    def test_implicit_rsample_gamma_beta_exponential(self):
+        """Implicit reparameterization (jax's gamma grads): rsample carries
+        gradients to shape/rate parameters. Sanity via the scaling
+        identity for Gamma/Exponential (x = g/rate => d sum(x)/d rate =
+        -sum(x)/rate), and finite nonzero grads for Beta/StudentT/
+        Dirichlet concentrations."""
+        rate = paddle.to_tensor(np.float32(2.0))
+        rate.stop_gradient = False
+        x = D.Exponential(rate).rsample([64])
+        x.sum().backward()
+        np.testing.assert_allclose(float(rate.grad._data),
+                                   -float(np.asarray(x._data).sum()) / 2.0,
+                                   rtol=1e-4)
+
+        conc = paddle.to_tensor(np.float32(1.5))
+        rate2 = paddle.to_tensor(np.float32(2.0))
+        conc.stop_gradient = rate2.stop_gradient = False
+        g = D.Gamma(conc, rate2).rsample([64])
+        g.sum().backward()
+        np.testing.assert_allclose(float(rate2.grad._data),
+                                   -float(np.asarray(g._data).sum()) / 2.0,
+                                   rtol=1e-4)
+        assert np.isfinite(float(conc.grad._data))
+        assert abs(float(conc.grad._data)) > 0
+
+        a = paddle.to_tensor(np.float32(2.0))
+        b = paddle.to_tensor(np.float32(3.0))
+        a.stop_gradient = b.stop_gradient = False
+        D.Beta(a, b).rsample([64]).sum().backward()
+        assert np.isfinite(float(a.grad._data)) and abs(
+            float(a.grad._data)) > 0
+        assert np.isfinite(float(b.grad._data)) and abs(
+            float(b.grad._data)) > 0
+
+        c = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32))
+        c.stop_gradient = False
+        D.Dirichlet(c).rsample([16]).sum().backward()
+        # simplex sums to 1: d sum / d conc should be ~0 per component?
+        # no — per-sample sum is constant 1, so grads cancel exactly
+        np.testing.assert_allclose(np.asarray(c.grad._data),
+                                   np.zeros(3), atol=1e-4)
+
+        df = paddle.to_tensor(np.float32(5.0))
+        loc = paddle.to_tensor(np.float32(0.5))
+        df.stop_gradient = loc.stop_gradient = False
+        D.StudentT(df, loc, paddle.to_tensor(np.float32(1.0))) \
+            .rsample([32]).sum().backward()
+        np.testing.assert_allclose(float(loc.grad._data), 32.0, rtol=1e-5)
+        assert np.isfinite(float(df.grad._data))
+
+    def test_rsample_tiny_concentrations_stay_finite(self):
+        """Small concentrations underflow raw gamma draws in f32 — the
+        log-space construction must never NaN (review finding: 3% NaN at
+        alpha=0.02 with the naive gamma ratio)."""
+        x = D.Beta(paddle.to_tensor(np.float32(0.02)),
+                   paddle.to_tensor(np.float32(0.02))).rsample([20000])
+        assert np.isfinite(np.asarray(x._data)).all()
+        d = D.Dirichlet(paddle.to_tensor(
+            np.full(3, 0.02, np.float32))).rsample([5000])
+        assert np.isfinite(np.asarray(d._data)).all()
