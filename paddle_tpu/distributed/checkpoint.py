@@ -31,18 +31,72 @@ def _to_arrays(tree):
     )
 
 
+def _globalize(tree):
+    """Multi-process jobs: orbax refuses host-local (single-device) arrays
+    — every process holds its own replica of e.g. a DataParallel
+    state_dict. Lift such leaves to a fully-replicated GLOBAL array over
+    all processes' devices (identical values across hosts is the
+    replicated-state contract; sharded arrays pass through untouched)."""
+    if jax.process_count() == 1:
+        return tree
+    import numpy as _np
+    from jax.experimental import multihost_utils as mh
+    from jax.sharding import Mesh, PartitionSpec
+
+    mesh = Mesh(_np.array(jax.devices()), ("_ckpt",))
+
+    def leaf(x):
+        if (isinstance(x, jax.Array)
+                and len(x.sharding.device_set) == 1):
+            # pass the jax array straight through — no D2H numpy hop
+            return mh.host_local_array_to_global_array(
+                x, mesh, PartitionSpec())
+        return x
+
+    return jax.tree.map(leaf, tree)
+
+
+def _localize(tree):
+    """Inverse of :func:`_globalize` for templateless restores: global
+    fully-replicated leaves come back as plain local values every process
+    can use directly."""
+    if jax.process_count() == 1:
+        return tree
+    import jax.numpy as jnp
+
+    def leaf(x):
+        if (isinstance(x, jax.Array) and not x.is_fully_addressable
+                and x.sharding.is_fully_replicated):
+            # fully-replicated: the local shard IS the whole value.
+            # Genuinely SHARDED global arrays pass through untouched —
+            # collapsing them to one shard would silently corrupt.
+            return jnp.asarray(x.addressable_shards[0].data)
+        return x
+
+    return jax.tree.map(leaf, tree)
+
+
 def _abstract_tree(tree):
     """Restore template: arrays -> ShapeDtypeStruct (keeping shardings for
-    reshard-on-load); scalar leaves (step counters etc.) pass through."""
+    reshard-on-load); scalar leaves (step counters etc.) pass through.
+    Multi-process: HOST-LOCAL leaves get a fully-replicated global-mesh
+    sharding directly on the template — no data is materialized just to
+    describe a shape."""
+    multi = jax.process_count() > 1
+    if multi:
+        import numpy as _np
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+        gmesh = Mesh(_np.array(jax.devices()), ("_ckpt",))
 
     def leaf(x):
         if hasattr(x, "shape") and hasattr(x, "dtype"):
-            return jax.ShapeDtypeStruct(
-                x.shape, x.dtype,
-                sharding=x.sharding
-                if isinstance(x, jax.Array) and hasattr(x, "sharding")
-                else None,
-            )
+            sh = (x.sharding if isinstance(x, jax.Array)
+                  and hasattr(x, "sharding") else None)
+            if (multi and isinstance(x, jax.Array)
+                    and len(x.sharding.device_set) == 1):
+                sh = NamedSharding(gmesh, PartitionSpec())
+            return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sh)
         return x
 
     return jax.tree.map(leaf, tree)
@@ -111,7 +165,7 @@ def save_state_dict(state_dict: Dict[str, Any], path: str,
     which retains whole steps.)"""
     import shutil
 
-    tree = _to_arrays(state_dict)
+    tree = _globalize(_to_arrays(state_dict))
     path = os.path.abspath(path)
     # settle any prior in-flight async save BEFORE the keep-aside rename:
     # orbax would block on it inside save() anyway (saves serialize), and
@@ -157,9 +211,10 @@ def load_state_dict(
         path = path + ".prev"
     ckpt = _checkpointer()
     if target is None:
-        return ckpt.restore(path, args=ocp.args.StandardRestore())
+        return _localize(ckpt.restore(path, args=ocp.args.StandardRestore()))
     abstract = _abstract_tree(_to_arrays(target))
-    return ckpt.restore(path, args=ocp.args.StandardRestore(abstract))
+    return _localize(ckpt.restore(path,
+                                  args=ocp.args.StandardRestore(abstract)))
 
 
 class TrainCheckpointer:
@@ -192,7 +247,7 @@ class TrainCheckpointer:
     def save(self, step: int, state_dict: Dict[str, Any], force: bool = False):
         import orbax.checkpoint as ocp
 
-        tree = _to_arrays(state_dict)
+        tree = _globalize(_to_arrays(state_dict))
         return self._mgr.save(step, args=ocp.args.StandardSave(tree), force=force)
 
     def latest_step(self) -> Optional[int]:
@@ -213,9 +268,11 @@ class TrainCheckpointer:
         if step is None:
             return None
         if target is None:
-            return self._mgr.restore(step, args=ocp.args.StandardRestore())
+            return _localize(
+                self._mgr.restore(step, args=ocp.args.StandardRestore()))
         abstract = _abstract_tree(_to_arrays(target))
-        return self._mgr.restore(step, args=ocp.args.StandardRestore(abstract))
+        return _localize(self._mgr.restore(
+            step, args=ocp.args.StandardRestore(abstract)))
 
     def wait_until_finished(self):
         self._mgr.wait_until_finished()

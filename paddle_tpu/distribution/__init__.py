@@ -56,8 +56,6 @@ class Distribution:
     # sample() stays detached, matching the reference's split.
 
     def _keep_live(self, **named):
-        from ..core.tensor import Tensor
-
         self._live_params = {k: v for k, v in named.items()
                              if isinstance(v, Tensor)}
 

@@ -188,3 +188,42 @@ def test_hybrid_dp_mp_mesh_across_processes():
     for r in results:
         np.testing.assert_allclose(r, ref, rtol=2e-5, atol=1e-6)
     assert results[0] == results[1]
+
+
+def _ckpt_worker(workdir):
+    """Both ranks save the shared replicated state to ONE path repeatedly
+    with overwrite (the multi-host checkpoint pattern): the keep-aside
+    rename must be primary-only or the ranks race on shared storage."""
+    import os
+
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed.checkpoint import (load_state_dict,
+                                                   save_state_dict)
+
+    dist.init_parallel_env()
+    import jax
+
+    path = os.path.join(workdir, "shared_ckpt")
+    for step in range(3):
+        sd = {"w": paddle.to_tensor(
+            np.full((4,), float(step), np.float32)), "step": step}
+        save_state_dict(sd, path, overwrite=True, blocking=True)
+    restored = load_state_dict(path)
+    # targeted restore must come back HOST-USABLE (localized), not as a
+    # global array spanning non-addressable devices
+    target = {"w": paddle.to_tensor(np.zeros(4, np.float32)), "step": 0}
+    restored_t = load_state_dict(path, target=target)
+    return {"rank": jax.process_index(),
+            "w": np.asarray(restored["w"]).tolist(),
+            "w_t": np.asarray(restored_t["w"]).tolist(),
+            "step": int(restored["step"])}
+
+
+def test_multiprocess_checkpoint_overwrite_primary_only(tmp_path):
+    results = spawn(_ckpt_worker, args=(str(tmp_path),), nprocs=WORLD)
+    for r in results:
+        assert r["step"] == 2, results
+        assert r["w"] == [2.0, 2.0, 2.0, 2.0], results
+        assert r["w_t"] == [2.0, 2.0, 2.0, 2.0], results
