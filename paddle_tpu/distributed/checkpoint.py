@@ -31,6 +31,16 @@ def _to_arrays(tree):
     )
 
 
+def _ckpt_mesh():
+    """ONE global mesh over every process's devices — shared by the save
+    lift (_globalize) and the restore templates (_abstract_tree) so the
+    two sides can never desynchronize."""
+    import numpy as _np
+    from jax.sharding import Mesh
+
+    return Mesh(_np.array(jax.devices()), ("_ckpt",))
+
+
 def _globalize(tree):
     """Multi-process jobs: orbax refuses host-local (single-device) arrays
     — every process holds its own replica of e.g. a DataParallel
@@ -39,21 +49,43 @@ def _globalize(tree):
     replicated-state contract; sharded arrays pass through untouched)."""
     if jax.process_count() == 1:
         return tree
-    import numpy as _np
     from jax.experimental import multihost_utils as mh
-    from jax.sharding import Mesh, PartitionSpec
+    from jax.sharding import PartitionSpec
 
-    mesh = Mesh(_np.array(jax.devices()), ("_ckpt",))
+    mesh = _ckpt_mesh()
 
     def leaf(x):
-        if (isinstance(x, jax.Array)
-                and len(x.sharding.device_set) == 1):
+        # HOST-LOCAL = fully addressable by this process (covers both the
+        # single-device case and replicas spread over a host's several
+        # local chips — the default multi-chip host topology); genuinely
+        # global/sharded arrays are not fully addressable and pass through
+        if isinstance(x, jax.Array) and x.is_fully_addressable:
             # pass the jax array straight through — no D2H numpy hop
             return mh.host_local_array_to_global_array(
                 x, mesh, PartitionSpec())
         return x
 
     return jax.tree.map(leaf, tree)
+
+
+def _localize_like(tree, target):
+    """Targeted restores: collapse ONLY the leaves whose TARGET was
+    host-local (the ones _abstract_tree lifted) — a target that was
+    intentionally a global replicated array keeps its global sharding, as
+    the reshard-on-load contract promises."""
+    if jax.process_count() == 1:
+        return tree
+    import jax.numpy as jnp
+
+    def leaf(x, t):
+        t_host_local = ((isinstance(t, jax.Array) and t.is_fully_addressable)
+                        or isinstance(t, np.ndarray))
+        if (isinstance(x, jax.Array) and not x.is_fully_addressable
+                and x.sharding.is_fully_replicated and t_host_local):
+            return jnp.asarray(x.addressable_shards[0].data)
+        return x
+
+    return jax.tree.map(leaf, tree, target)
 
 
 def _localize(tree):
@@ -84,17 +116,18 @@ def _abstract_tree(tree):
     describe a shape."""
     multi = jax.process_count() > 1
     if multi:
-        import numpy as _np
-        from jax.sharding import Mesh, NamedSharding, PartitionSpec
+        from jax.sharding import NamedSharding, PartitionSpec
 
-        gmesh = Mesh(_np.array(jax.devices()), ("_ckpt",))
+        gmesh = _ckpt_mesh()
 
     def leaf(x):
         if hasattr(x, "shape") and hasattr(x, "dtype"):
             sh = (x.sharding if isinstance(x, jax.Array)
                   and hasattr(x, "sharding") else None)
-            if (multi and isinstance(x, jax.Array)
-                    and len(x.sharding.device_set) == 1):
+            if multi and (not isinstance(x, jax.Array)
+                          or x.is_fully_addressable):
+                # host-local jax arrays AND plain numpy targets (both
+                # allowed by the docstring) need a global template
                 sh = NamedSharding(gmesh, PartitionSpec())
             return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sh)
         return x
@@ -212,9 +245,10 @@ def load_state_dict(
     ckpt = _checkpointer()
     if target is None:
         return _localize(ckpt.restore(path, args=ocp.args.StandardRestore()))
-    abstract = _abstract_tree(_to_arrays(target))
-    return _localize(ckpt.restore(path,
-                                  args=ocp.args.StandardRestore(abstract)))
+    tgt = _to_arrays(target)
+    abstract = _abstract_tree(tgt)
+    return _localize_like(
+        ckpt.restore(path, args=ocp.args.StandardRestore(abstract)), tgt)
 
 
 class TrainCheckpointer:
@@ -270,9 +304,10 @@ class TrainCheckpointer:
         if target is None:
             return _localize(
                 self._mgr.restore(step, args=ocp.args.StandardRestore()))
-        abstract = _abstract_tree(_to_arrays(target))
-        return _localize(self._mgr.restore(
-            step, args=ocp.args.StandardRestore(abstract)))
+        tgt = _to_arrays(target)
+        abstract = _abstract_tree(tgt)
+        return _localize_like(self._mgr.restore(
+            step, args=ocp.args.StandardRestore(abstract)), tgt)
 
     def wait_until_finished(self):
         self._mgr.wait_until_finished()

@@ -69,13 +69,58 @@ class StaticFunction:
         functools.update_wrapper(self, function, updated=[])
 
     def _discover_state(self):
+        layers = []
         layer = self._layer
         if layer is None and hasattr(self._fn, "__self__") and isinstance(self._fn.__self__, Layer):
             layer = self._fn.__self__
         if layer is not None:
-            params, buffers = layer.functional_state()
-            self._param_objs = list(params.values())
-            self._buffer_objs = list(buffers.values())
+            layers = [layer]
+        else:
+            # free function closing over model objects (the common "build
+            # the layers, decorate a train/eval fn" pattern): collect
+            # Layers from the closure cells, else their parameters would
+            # bake into the compiled program as constants — inference
+            # would silently use stale weights after an update and
+            # training grads would silently never reach them
+            candidates = []
+            for cell in getattr(self._fn, "__closure__", None) or ():
+                try:
+                    candidates.append(cell.cell_contents)
+                except ValueError:  # empty cell
+                    continue
+            # module-scope models are reached through __globals__; ONLY
+            # names loaded via LOAD_GLOBAL — co_names also lists attribute
+            # names, and an unrelated global Layer colliding with an
+            # attribute name would be silently captured (spurious zero
+            # grads + buffer writebacks on the taped path)
+            code = getattr(self._fn, "__code__", None)
+            gl = getattr(self._fn, "__globals__", None)
+            if code is not None and gl is not None:
+                import dis
+
+                gnames = {i.argval for i in dis.get_instructions(code)
+                          if i.opname == "LOAD_GLOBAL"}
+                for name in gnames:
+                    if name in gl:
+                        candidates.append(gl[name])
+            for v in candidates:
+                if isinstance(v, Layer):
+                    layers.append(v)
+                elif isinstance(v, (list, tuple)):
+                    layers.extend(x for x in v if isinstance(x, Layer))
+        params, buffers, seen = [], [], set()
+        for l in layers:
+            p, b = l.functional_state()
+            for t in p.values():
+                if id(t) not in seen:
+                    seen.add(id(t))
+                    params.append(t)
+            for t in b.values():
+                if id(t) not in seen:
+                    seen.add(id(t))
+                    buffers.append(t)
+        self._param_objs = params
+        self._buffer_objs = buffers
 
     def _build(self):
         self._discover_state()
@@ -102,6 +147,21 @@ class StaticFunction:
             return self._fn(*args, **kwargs)  # eager fallback (debugging)
         if self._jit_fn is None:
             self._build()
+        # TRAINING path: when gradients can flow (a live input arg or live
+        # parameter, grads enabled), the compiled function must join the
+        # autograd tape — the reference's core dy2static pattern is
+        # `@to_static` forward + eager loss.backward(), and a silently
+        # detached output would zero every gradient.
+        from ..core.autograd import is_grad_enabled
+
+        leaves = jax.tree_util.tree_leaves(
+            (args, kwargs), is_leaf=lambda x: isinstance(x, Tensor))
+        live = is_grad_enabled() and (
+            any(isinstance(l, Tensor) and not l.stop_gradient
+                for l in leaves)
+            or any(not p.stop_gradient for p in self._param_objs))
+        if live:
+            return self._call_taped(args, kwargs)
         param_arrays = tuple(p._data for p in self._param_objs)
         buffer_arrays = tuple(b._data for b in self._buffer_objs)
         out, mutated = self._jit_fn(param_arrays, buffer_arrays, rng.next_key(), args, kwargs)
@@ -109,6 +169,114 @@ class StaticFunction:
             if m is not None:
                 b._data = m
         return out
+
+    def _call_taped(self, args, kwargs):
+        """Record the whole compiled function as ONE tape op via
+        dispatch.apply: jax.vjp differentiates through it, so loss
+        .backward() after a @to_static call reaches input Tensors AND the
+        layer's parameters. Buffers (BN stats) ride as extra outputs and
+        are written back. The pure wrapper is cached per call-structure so
+        the jit cache stays stable across training steps."""
+        from ..core.dispatch import apply
+
+        import numpy as _np
+
+        leaves, treedef = jax.tree_util.tree_flatten(
+            (args, kwargs), is_leaf=lambda x: isinstance(x, Tensor))
+        n_leaves = len(leaves)
+        # floats/arrays ride as TRACED args (a per-step python lr must not
+        # mint a new executable per value — matching jax.jit's own leaf
+        # handling on the fast path); ints/bools/strings stay static keys
+        # (axis/flag arguments)
+        def _traced(l):
+            return isinstance(l, (Tensor, jax.Array, _np.ndarray)) or (
+                isinstance(l, float) and not isinstance(l, bool))
+
+        t_idx = tuple(i for i, l in enumerate(leaves) if _traced(l))
+        raw_idx = frozenset(i for i in t_idx
+                            if not isinstance(leaves[i], Tensor))
+        others = tuple((i, l) for i, l in enumerate(leaves)
+                       if not _traced(l))
+        try:
+            key = (treedef, t_idx, raw_idx, others)
+            hash(key)
+        except TypeError:
+            # an unhashable static leaf would defeat every cache below it
+            # (a fresh wrapper per call retraces AND leaks one executable
+            # per training step into the jit cache) — run the plain eager
+            # tape instead: correct, uncompiled, leak-free
+            return self._fn(*args, **kwargs)
+        cache = getattr(self, "_taped_cache", None)
+        if cache is None:
+            cache = self._taped_cache = {}
+        entry = cache.get(key)
+        if entry is None:
+            fn = self._fn
+            param_objs, buffer_objs = self._param_objs, self._buffer_objs
+            n_args = len(t_idx)
+            n_state = len(param_objs) + len(buffer_objs)
+            out_spec = {}  # filled at first trace: output pytree structure
+
+            def pure(rng_key, *arrs):
+                rebuilt = [None] * n_leaves
+                for i, v in others:
+                    rebuilt[i] = v
+                for j, i in enumerate(t_idx):
+                    # raw numeric leaves come back as raw arrays, Tensor
+                    # leaves as Tensors — what fn's body saw originally
+                    rebuilt[i] = (arrs[j] if i in raw_idx
+                                  else Tensor(arrs[j]))
+                a2, k2 = jax.tree_util.tree_unflatten(treedef, rebuilt)
+                sink = {}
+                state = list(param_objs) + list(buffer_objs)
+                with _swap_data(state, list(arrs[n_args:n_args + n_state])):
+                    with rng.key_guard(rng_key), mutation_sink(sink):
+                        out = fn(*a2, **k2)
+                # preserve ARBITRARY output pytrees (dicts, nesting, bare
+                # tensors) — the taped path must return exactly what the
+                # fast path returns. Anything ARRAY-VALUED (Tensor, raw
+                # jax array — a tracer during this trace!) must flow out
+                # through the op outputs; snapshotting it into out_spec
+                # would leak the tracer into later cache-hit calls.
+                out_leaves, out_treedef = jax.tree_util.tree_flatten(
+                    out, is_leaf=lambda x: isinstance(x, Tensor))
+                oi = tuple(i for i, l in enumerate(out_leaves)
+                           if isinstance(l, (Tensor, jax.Array)))
+                out_spec["treedef"] = out_treedef
+                out_spec["t_idx"] = oi
+                out_spec["others"] = tuple(
+                    (i, l) for i, l in enumerate(out_leaves)
+                    if not isinstance(l, (Tensor, jax.Array)))
+                out_arrs = tuple(
+                    out_leaves[i]._data
+                    if isinstance(out_leaves[i], Tensor)
+                    else out_leaves[i] for i in oi)
+                buf_arrs = []
+                for b in buffer_objs:
+                    hit = sink.get(id(b))
+                    buf_arrs.append(hit[1] if hit is not None else b._data)
+                return out_arrs + tuple(buf_arrs)
+
+            entry = (pure, out_spec)
+            cache[key] = entry
+        pure, out_spec = entry
+
+        tensor_args = tuple(leaves[i] for i in t_idx)
+        res = apply(pure,
+                    (Tensor(rng.next_key()),) + tensor_args
+                    + tuple(self._param_objs) + tuple(self._buffer_objs),
+                    {}, name=getattr(self._fn, "__name__", "to_static"))
+        res = res if isinstance(res, tuple) else (res,)
+        n_out = len(res) - len(self._buffer_objs)
+        for b, nb in zip(self._buffer_objs, res[n_out:]):
+            b._data = nb._data
+        out_leaves = [None] * (len(out_spec["t_idx"])
+                               + len(out_spec["others"]))
+        for i, v in out_spec["others"]:
+            out_leaves[i] = v
+        for j, i in enumerate(out_spec["t_idx"]):
+            out_leaves[i] = res[j]
+        return jax.tree_util.tree_unflatten(out_spec["treedef"], out_leaves)
 
     @property
     def code(self):
