@@ -56,6 +56,19 @@ def functional_call(layer: Layer, params_and_buffers: Dict[str, object], *args, 
         return layer(*args, **kwargs)
 
 
+
+def _write_back_buffer(b, new_data):
+    """Buffer writeback that survives NESTING: inside an enclosing trace
+    (outer @to_static / TrainStep), assigning b._data alone would be
+    clobbered when the outer _swap_data restores — notify the ambient
+    mutation sink so the OUTER program carries the update out."""
+    from ..nn.layer import _MUTATION_SINK
+
+    b._data = new_data
+    if _MUTATION_SINK and isinstance(new_data, jax.core.Tracer):
+        _MUTATION_SINK[-1][id(b)] = (b, new_data)
+
+
 class StaticFunction:
     """Result of @to_static: a compile-cached callable (≈ ref StaticFunction,
     ref:python/paddle/jit/dy2static/program_translator.py)."""
@@ -69,7 +82,12 @@ class StaticFunction:
         functools.update_wrapper(self, function, updated=[])
 
     def _discover_state(self):
+        if getattr(self, "_discovering", False):
+            return  # self/mutual recursion: params are being collected by
+            # the in-flight discovery already
+        self._discovering = True
         layers = []
+        inner_fns = []
         layer = self._layer
         if layer is None and hasattr(self._fn, "__self__") and isinstance(self._fn.__self__, Layer):
             layer = self._fn.__self__
@@ -106,21 +124,36 @@ class StaticFunction:
             for v in candidates:
                 if isinstance(v, Layer):
                     layers.append(v)
+                elif isinstance(v, StaticFunction):
+                    # nested @to_static: the inner function's state must be
+                    # OUR state too — otherwise its params bake into our
+                    # trace as constants (stale weights, no grads)
+                    inner_fns.append(v)
                 elif isinstance(v, (list, tuple)):
                     layers.extend(x for x in v if isinstance(x, Layer))
         params, buffers, seen = [], [], set()
-        for l in layers:
-            p, b = l.functional_state()
-            for t in p.values():
+
+        def _take(ps, bs):
+            for t in ps:
                 if id(t) not in seen:
                     seen.add(id(t))
                     params.append(t)
-            for t in b.values():
+            for t in bs:
                 if id(t) not in seen:
                     seen.add(id(t))
                     buffers.append(t)
+
+        for l in layers:
+            p, b = l.functional_state()
+            _take(p.values(), b.values())
+        for f in inner_fns:
+            if f is not self and not getattr(f, "_discovering", False):
+                if not f._param_objs and not f._buffer_objs:
+                    f._discover_state()
+                _take(f._param_objs, f._buffer_objs)
         self._param_objs = params
         self._buffer_objs = buffers
+        self._discovering = False
 
     def _build(self):
         self._discover_state()
@@ -167,7 +200,7 @@ class StaticFunction:
         out, mutated = self._jit_fn(param_arrays, buffer_arrays, rng.next_key(), args, kwargs)
         for b, m in zip(self._buffer_objs, mutated):
             if m is not None:
-                b._data = m
+                _write_back_buffer(b, m)
         return out
 
     def _call_taped(self, args, kwargs):
@@ -269,7 +302,7 @@ class StaticFunction:
         res = res if isinstance(res, tuple) else (res,)
         n_out = len(res) - len(self._buffer_objs)
         for b, nb in zip(self._buffer_objs, res[n_out:]):
-            b._data = nb._data
+            _write_back_buffer(b, nb._data)
         out_leaves = [None] * (len(out_spec["t_idx"])
                                + len(out_spec["others"]))
         for i, v in out_spec["others"]:

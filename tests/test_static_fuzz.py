@@ -287,3 +287,105 @@ def test_to_static_float_arg_does_not_retrace_per_value():
     np.testing.assert_allclose(
         np.asarray(vals) / float(out0.sum().numpy()), [2.0, 3.0, 4.5],
         rtol=1e-5)
+
+
+def test_pylayer_custom_vjp_inside_to_static():
+    """PyLayer custom backward composes with the taped compiled call: the
+    custom 2x vjp must scale the input gradient exactly."""
+    from paddle_tpu import nn
+    from paddle_tpu.autograd import PyLayer
+
+    class Double(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * 2.0
+
+        @staticmethod
+        def backward(ctx, dy):
+            return dy * 2.0
+
+    paddle.seed(2)
+    lin = nn.Linear(4, 2)
+
+    @paddle.jit.to_static
+    def with_pylayer(x):
+        return Double.apply(lin(x)).sum()
+
+    @paddle.jit.to_static
+    def plain(x):
+        return lin(x).sum()
+
+    x1 = paddle.to_tensor(np.ones((2, 4), np.float32))
+    x1.stop_gradient = False
+    with_pylayer(x1).backward()
+    x2 = paddle.to_tensor(np.ones((2, 4), np.float32))
+    x2.stop_gradient = False
+    plain(x2).backward()
+    np.testing.assert_allclose(np.asarray(x1.grad._data),
+                               2 * np.asarray(x2.grad._data), rtol=1e-6)
+
+
+def test_nested_to_static_grads_flow():
+    """A @to_static function calling another @to_static function: the
+    inner executes traced inside the outer's program; grads flow."""
+    from paddle_tpu import nn
+
+    paddle.seed(3)
+    lin = nn.Linear(4, 2)
+
+    @paddle.jit.to_static
+    def inner(x):
+        return lin(x)
+
+    @paddle.jit.to_static
+    def outer(x):
+        return inner(x).sum()
+
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+    x.stop_gradient = False
+    outer(x).backward()
+    assert x.grad is not None and lin.weight.grad is not None
+
+
+def test_recursive_to_static_does_not_hang_discovery():
+    """A @to_static function that REFERENCES itself (LOAD_GLOBAL of its own
+    name) must not infinitely recurse in state discovery — the hazard is at
+    build time, whether or not the recursive branch ever executes."""
+    global _self_ref_fn
+
+    @paddle.jit.to_static
+    def _self_ref_fn(x, depth=0):
+        if depth > 0:  # static python flag: branch never taken at trace
+            return _self_ref_fn(x)
+        return x * 2.0
+
+    out = _self_ref_fn(paddle.to_tensor(np.ones(3, np.float32)))
+    np.testing.assert_allclose(out.numpy(), 2.0)
+
+
+def test_nested_to_static_bn_stats_reach_outer():
+    """BN running stats mutated by an INNER @to_static must survive the
+    outer program's state restore (the ambient-sink forwarding path)."""
+    from paddle_tpu import nn
+
+    paddle.seed(4)
+    bn = nn.BatchNorm1D(3, momentum=0.5)
+
+    @paddle.jit.to_static
+    def inner(x):
+        return bn(x)
+
+    @paddle.jit.to_static
+    def outer(x):
+        return inner(x).sum()
+
+    x = paddle.to_tensor(
+        np.random.RandomState(0).rand(8, 3).astype(np.float32) + 2.0)
+    x.stop_gradient = False
+    before = np.asarray(bn._mean._data).copy()
+    outer(x).backward()
+    after = np.asarray(bn._mean._data)
+    assert not np.allclose(before, after), \
+        "inner BN stats silently dropped by the outer restore"
+    assert np.isfinite(after).all()
