@@ -101,12 +101,24 @@ def dtype_name(dtype) -> str:
 
 
 def is_floating(dtype) -> bool:
-    return jnp.issubdtype(jnp.dtype(dtype), np.floating) or jnp.dtype(dtype) == jnp.dtype(bfloat16)
+    try:
+        return (jnp.issubdtype(jnp.dtype(dtype), np.floating)
+                or jnp.dtype(dtype) == jnp.dtype(bfloat16))
+    except TypeError:
+        # extended dtypes (jax PRNG keys: 'key<fry>') have no numpy
+        # equivalent; they are never differentiable
+        return False
 
 
 def is_integer(dtype) -> bool:
-    return jnp.issubdtype(jnp.dtype(dtype), np.integer)
+    try:
+        return jnp.issubdtype(jnp.dtype(dtype), np.integer)
+    except TypeError:
+        return False
 
 
 def is_complex(dtype) -> bool:
-    return jnp.issubdtype(jnp.dtype(dtype), np.complexfloating)
+    try:
+        return jnp.issubdtype(jnp.dtype(dtype), np.complexfloating)
+    except TypeError:
+        return False

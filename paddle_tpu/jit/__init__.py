@@ -59,14 +59,17 @@ def functional_call(layer: Layer, params_and_buffers: Dict[str, object], *args, 
 
 def _write_back_buffer(b, new_data):
     """Buffer writeback that survives NESTING: inside an enclosing trace
-    (outer @to_static / TrainStep), assigning b._data alone would be
-    clobbered when the outer _swap_data restores — notify the ambient
-    mutation sink so the OUTER program carries the update out."""
+    (outer @to_static / TrainStep), route the update to the ambient
+    mutation sink INSTEAD of assigning — the outer program carries it out
+    (assigning too would leak the tracer into the buffer if the enclosing
+    program's state happens not to cover b). Mirrors
+    Layer.update_buffer's either/or."""
     from ..nn.layer import _MUTATION_SINK
 
-    b._data = new_data
     if _MUTATION_SINK and isinstance(new_data, jax.core.Tracer):
         _MUTATION_SINK[-1][id(b)] = (b, new_data)
+    else:
+        b._data = new_data
 
 
 class StaticFunction:
@@ -86,6 +89,15 @@ class StaticFunction:
             return  # self/mutual recursion: params are being collected by
             # the in-flight discovery already
         self._discovering = True
+        try:
+            self._discover_state_inner()
+        finally:
+            # exception-safe: a failure mid-discovery must not leave the
+            # guard set, or every later call would silently skip discovery
+            # and bake params as constants
+            self._discovering = False
+
+    def _discover_state_inner(self):
         layers = []
         inner_fns = []
         layer = self._layer
@@ -131,6 +143,8 @@ class StaticFunction:
                     inner_fns.append(v)
                 elif isinstance(v, (list, tuple)):
                     layers.extend(x for x in v if isinstance(x, Layer))
+                    inner_fns.extend(x for x in v
+                                     if isinstance(x, StaticFunction))
         params, buffers, seen = [], [], set()
 
         def _take(ps, bs):
@@ -153,7 +167,6 @@ class StaticFunction:
                 _take(f._param_objs, f._buffer_objs)
         self._param_objs = params
         self._buffer_objs = buffers
-        self._discovering = False
 
     def _build(self):
         self._discover_state()

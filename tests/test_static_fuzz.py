@@ -389,3 +389,44 @@ def test_nested_to_static_bn_stats_reach_outer():
     assert not np.allclose(before, after), \
         "inner BN stats silently dropped by the outer restore"
     assert np.isfinite(after).all()
+
+
+def test_to_static_inside_trainstep_loss():
+    """A @to_static function used INSIDE a TrainStep loss: the inner
+    executes traced within the outer compiled program and training
+    converges (the PRNG-key arg must not trip differentiability checks)."""
+    from paddle_tpu import nn
+    from paddle_tpu.jit import TrainStep
+
+    paddle.seed(0)
+    net = nn.Linear(4, 1)
+
+    @paddle.jit.to_static
+    def fwd(x):
+        return net(x)
+
+    opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                parameters=net.parameters())
+    step = TrainStep(lambda a, b: ((fwd(a) - b) ** 2).mean(), opt,
+                     layers=net)
+    X = paddle.to_tensor(np.random.RandomState(0).rand(8, 4)
+                         .astype(np.float32))
+    Y = paddle.to_tensor(np.random.RandomState(1).rand(8, 1)
+                         .astype(np.float32))
+    ls = [float(step(X, Y).numpy()) for _ in range(10)]
+    assert ls[-1] < ls[0]
+
+
+def test_double_grad_through_to_static():
+    """create_graph double-grad composes with the taped compiled call:
+    exact d/dx and d2/dx2 of x^3."""
+    @paddle.jit.to_static
+    def g(x):
+        return (x ** 3).sum()
+
+    x = paddle.to_tensor(np.array([2.0], np.float32))
+    x.stop_gradient = False
+    (dx,) = paddle.grad(g(x), [x], create_graph=True)
+    (d2x,) = paddle.grad(dx.sum(), [x])
+    np.testing.assert_allclose(float(dx.numpy()[0]), 12.0, rtol=1e-5)
+    np.testing.assert_allclose(float(d2x.numpy()[0]), 12.0, rtol=1e-5)
