@@ -74,6 +74,19 @@ def _cast_inputs_with(st, name: str, datas):
     return datas
 
 
+@contextlib.contextmanager
+def _with_state(st):
+    """Reinstall a SNAPSHOTTED autocast policy (taped compiled calls re-run
+    their pure fn at backward time, after the user's context has exited —
+    the re-execution must see the same policy the forward saw)."""
+    prev = amp_state()
+    _state.amp = st
+    try:
+        yield
+    finally:
+        _state.amp = prev
+
+
 def maybe_cast_inputs(name: str, datas):
     """Called by core.dispatch.apply: cast op inputs per AMP policy."""
     st = amp_state()

@@ -183,7 +183,7 @@ def replace_value(x: Tensor, out: Tensor):
     return x
 
 
-def apply(fn, tensor_args: Tuple, static: Dict[str, Any], *, differentiable: bool = True, name: str = None):
+def apply(fn, tensor_args: Tuple, static: Dict[str, Any], *, differentiable: bool = True, name: str = None, cast_inputs: bool = True):
     """Run pure function ``fn(*arrays, **static)`` over Tensor/array args."""
     name = name or fn.__name__.lstrip("_")
     # one fused scan over the args: unwrap, detect tracers, detect live
@@ -216,7 +216,7 @@ def apply(fn, tensor_args: Tuple, static: Dict[str, Any], *, differentiable: boo
             fn = _amp.capture_cast_fn(name, fn)
         return capture(fn, tensor_args, static, name)
     datas = tuple(datas)
-    if _amp is not None and _amp.amp_state() is not None:
+    if cast_inputs and _amp is not None and _amp.amp_state() is not None:
         datas = _amp.maybe_cast_inputs(name, datas)
     static_t = tuple(sorted(static.items())) if static else ()
 

@@ -57,19 +57,22 @@ def functional_call(layer: Layer, params_and_buffers: Dict[str, object], *args, 
 
 
 
+def _amp_key(st):
+    """Hashable identity of an autocast policy (None = no autocast)."""
+    if st is None:
+        return None
+    return (st["level"], str(st["dtype"]), frozenset(st["white"]),
+            frozenset(st["black"]))
+
+
 def _write_back_buffer(b, new_data):
     """Buffer writeback that survives NESTING: inside an enclosing trace
-    (outer @to_static / TrainStep), route the update to the ambient
-    mutation sink INSTEAD of assigning — the outer program carries it out
-    (assigning too would leak the tracer into the buffer if the enclosing
-    program's state happens not to cover b). Mirrors
-    Layer.update_buffer's either/or."""
-    from ..nn.layer import _MUTATION_SINK
+    (outer @to_static / TrainStep), the update goes to the ambient sink —
+    the outer program carries it out. One shared rule (nn.layer
+    sink_or_assign) for Layer.update_buffer and compiled-call writebacks."""
+    from ..nn.layer import sink_or_assign
 
-    if _MUTATION_SINK and isinstance(new_data, jax.core.Tracer):
-        _MUTATION_SINK[-1][id(b)] = (b, new_data)
-    else:
-        b._data = new_data
+    sink_or_assign(b, new_data)
 
 
 class StaticFunction:
@@ -80,6 +83,7 @@ class StaticFunction:
         self._fn = function
         self._layer = layer
         self._jit_fn = None
+        self._jit_fns = {}
         self._param_objs: List[Tensor] = []
         self._buffer_objs: List[Tensor] = []
         functools.update_wrapper(self, function, updated=[])
@@ -173,12 +177,20 @@ class StaticFunction:
         fn = self._fn
         param_objs = self._param_objs
         buffer_objs = self._buffer_objs
+        from .. import amp as _amp_mod
+
+        # ONE compiled function PER autocast policy: jax.jit keys only on
+        # shapes, so the policy active at first trace would otherwise be
+        # silently baked in and reused under a different (or no) policy
+        amp_st = _amp_mod.amp_state()
+        amp_snap = None if amp_st is None else dict(amp_st)
 
         @jax.jit
         def _compiled(param_arrays, buffer_arrays, key, args, kwargs):
             sink = {}
             with _swap_data(param_objs + buffer_objs, list(param_arrays) + list(buffer_arrays)):
-                with rng.key_guard(key), mutation_sink(sink):
+                with _amp_mod._with_state(amp_snap), \
+                        rng.key_guard(key), mutation_sink(sink):
                     out = fn(*args, **kwargs)
             mutated = []
             for b in buffer_objs:
@@ -186,12 +198,17 @@ class StaticFunction:
                 mutated.append(hit[1] if hit is not None else None)
             return out, mutated
 
-        self._jit_fn = _compiled
+        self._jit_fns[_amp_key(amp_st)] = _compiled
+        self._jit_fn = _compiled  # newest policy's executable (compat)
 
     def __call__(self, *args, **kwargs):
         if not _to_static_enabled:
             return self._fn(*args, **kwargs)  # eager fallback (debugging)
-        if self._jit_fn is None:
+        from .. import amp as _amp_mod
+
+        if not hasattr(self, "_jit_fns"):
+            self._jit_fns = {}
+        if _amp_key(_amp_mod.amp_state()) not in self._jit_fns:
             self._build()
         # TRAINING path: when gradients can flow (a live input arg or live
         # parameter, grads enabled), the compiled function must join the
@@ -210,7 +227,8 @@ class StaticFunction:
             return self._call_taped(args, kwargs)
         param_arrays = tuple(p._data for p in self._param_objs)
         buffer_arrays = tuple(b._data for b in self._buffer_objs)
-        out, mutated = self._jit_fn(param_arrays, buffer_arrays, rng.next_key(), args, kwargs)
+        jit_fn = self._jit_fns[_amp_key(_amp_mod.amp_state())]
+        out, mutated = jit_fn(param_arrays, buffer_arrays, rng.next_key(), args, kwargs)
         for b, m in zip(self._buffer_objs, mutated):
             if m is not None:
                 _write_back_buffer(b, m)
@@ -243,8 +261,11 @@ class StaticFunction:
                             if not isinstance(leaves[i], Tensor))
         others = tuple((i, l) for i, l in enumerate(leaves)
                        if not _traced(l))
+        from .. import amp as _amp_mod
+
+        amp_st = _amp_mod.amp_state()
         try:
-            key = (treedef, t_idx, raw_idx, others)
+            key = (treedef, t_idx, raw_idx, others, _amp_key(amp_st))
             hash(key)
         except TypeError:
             # an unhashable static leaf would defeat every cache below it
@@ -258,6 +279,7 @@ class StaticFunction:
         entry = cache.get(key)
         if entry is None:
             fn = self._fn
+            amp_snap = None if amp_st is None else dict(amp_st)
             param_objs, buffer_objs = self._param_objs, self._buffer_objs
             n_args = len(t_idx)
             n_state = len(param_objs) + len(buffer_objs)
@@ -276,7 +298,12 @@ class StaticFunction:
                 sink = {}
                 state = list(param_objs) + list(buffer_objs)
                 with _swap_data(state, list(arrs[n_args:n_args + n_state])):
-                    with rng.key_guard(rng_key), mutation_sink(sink):
+                    # the SNAPSHOTTED autocast policy, not the ambient one:
+                    # backward re-executes this fn after the user's context
+                    # exited, and a policy change would silently change the
+                    # math (vjp rejects the resulting dtype mismatch)
+                    with _amp_mod._with_state(amp_snap), \
+                            rng.key_guard(rng_key), mutation_sink(sink):
                         out = fn(*a2, **k2)
                 # preserve ARBITRARY output pytrees (dicts, nesting, bare
                 # tensors) — the taped path must return exactly what the
@@ -311,7 +338,12 @@ class StaticFunction:
         res = apply(pure,
                     (Tensor(rng.next_key()),) + tensor_args
                     + tuple(self._param_objs) + tuple(self._buffer_objs),
-                    {}, name=getattr(self._fn, "__name__", "to_static"))
+                    {}, name=getattr(self._fn, "__name__", "to_static"),
+                    # the snapshot policy applies PER-OP inside pure; a
+                    # boundary cast (fn name colliding with the amp lists,
+                    # or O2's cast-everything) would downcast params and
+                    # buffers wholesale
+                    cast_inputs=False)
         res = res if isinstance(res, tuple) else (res,)
         n_out = len(res) - len(self._buffer_objs)
         for b, nb in zip(self._buffer_objs, res[n_out:]):

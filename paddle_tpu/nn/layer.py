@@ -27,6 +27,18 @@ from ..core.tensor import Tensor
 _MUTATION_SINK = []
 
 
+def sink_or_assign(buffer, val):
+    """THE buffer-mutation rule, shared by Layer.update_buffer and the
+    compiled-call writebacks (jit.StaticFunction): under a trace the update
+    goes to the innermost sink (the enclosing program carries it out);
+    otherwise it assigns. One implementation — a one-sided edit here once
+    caused a clobber/leak divergence between the two copies."""
+    if _MUTATION_SINK and isinstance(val, jax.core.Tracer):
+        _MUTATION_SINK[-1][id(buffer)] = (buffer, val)
+    else:
+        buffer._data = val
+
+
 @contextlib.contextmanager
 def mutation_sink(sink: dict):
     _MUTATION_SINK.append(sink)
@@ -121,10 +133,7 @@ class Layer:
     def update_buffer(self, buffer: Tensor, new_value):
         """Assign a new value to a registered buffer; trace-safe."""
         val = new_value._data if isinstance(new_value, Tensor) else new_value
-        if _MUTATION_SINK and isinstance(val, jax.core.Tracer):
-            _MUTATION_SINK[-1][id(buffer)] = (buffer, val)
-        else:
-            buffer._data = val
+        sink_or_assign(buffer, val)
 
     def create_parameter(self, shape, attr=None, dtype=None, is_bias=False, default_initializer=None):
         from . import initializer as I

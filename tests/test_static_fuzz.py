@@ -430,3 +430,66 @@ def test_double_grad_through_to_static():
     (d2x,) = paddle.grad(dx.sum(), [x])
     np.testing.assert_allclose(float(dx.numpy()[0]), 12.0, rtol=1e-5)
     np.testing.assert_allclose(float(d2x.numpy()[0]), 12.0, rtol=1e-5)
+
+
+def test_to_static_under_autocast_with_gradscaler():
+    """AMP interplay: @to_static forward under auto_cast + GradScaler
+    training. The autocast policy is SNAPSHOTTED into the taped call —
+    backward re-executes after the context exits and must see the same
+    casts (a policy change would make jax.vjp reject the ct dtype)."""
+    from paddle_tpu import amp, nn
+
+    paddle.seed(0)
+    net = nn.Linear(4, 1)
+
+    @paddle.jit.to_static
+    def fwd(x):
+        return net(x)
+
+    opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                parameters=net.parameters())
+    scaler = amp.GradScaler(init_loss_scaling=1024.0)
+    X = paddle.to_tensor(np.random.RandomState(0).rand(8, 4)
+                         .astype(np.float32))
+    Y = paddle.to_tensor(np.random.RandomState(1).rand(8, 1)
+                         .astype(np.float32))
+    first = last = None
+    for _ in range(15):
+        with amp.auto_cast(level="O1", dtype="bfloat16"):
+            loss = ((fwd(X) - Y) ** 2).mean()
+        scaled = scaler.scale(loss)
+        scaled.backward()
+        scaler.step(opt)
+        scaler.update()
+        opt.clear_grad()
+        first = first if first is not None else float(loss.numpy())
+        last = float(loss.numpy())
+    assert last < first, (first, last)
+
+
+def test_to_static_inference_respects_policy_changes():
+    """The no-grad fast path compiles PER autocast policy: a function first
+    traced under bf16 autocast must NOT reuse that executable for a later
+    call without autocast (and vice versa)."""
+    from paddle_tpu import amp, nn
+
+    paddle.seed(5)
+    net = nn.Linear(4, 2)
+
+    @paddle.jit.to_static
+    def fwd(x):
+        return net(x)
+
+    x = paddle.to_tensor(np.random.RandomState(0).rand(2, 4)
+                         .astype(np.float32))
+    with paddle.no_grad():
+        with amp.auto_cast(level="O1", dtype="bfloat16"):
+            out_amp = fwd(x)
+        out_plain = fwd(x)
+    assert "bfloat16" in str(out_amp._data.dtype)
+    assert "float32" in str(out_plain._data.dtype), \
+        "bf16 executable reused outside autocast"
+    # and back again: the per-policy cache serves the right one
+    with paddle.no_grad():
+        with amp.auto_cast(level="O1", dtype="bfloat16"):
+            assert "bfloat16" in str(fwd(x)._data.dtype)
