@@ -189,6 +189,10 @@ def main():
         print(f"# applying tuned sweep point: {tuned}", flush=True)
     remat = knob("BENCH_REMAT", "0") == "1"
     chunk = int(knob("BENCH_CHUNK_LOSS", "0"))
+    # BENCH_SCAN=1: lax.scan the decoder block over stacked layer params —
+    # compile time stops growing with depth (a deep config then compiles
+    # inside a short tunnel window) for ~2*P bytes/step of stack traffic
+    scan_layers = knob("BENCH_SCAN", "0") == "1"
     if platform == "tpu":
         # BENCH_HIDDEN/LAYERS/HEADS scale toward the reference's headline
         # GPT-3 1.3B-class config (BASELINE.md config 4) as far as one chip
@@ -201,7 +205,8 @@ def main():
         cfg = GPTConfig(vocab_size=50304, hidden_size=hidden, num_layers=layers,
                         num_heads=heads,
                         max_position_embeddings=max(2048, seq_req),
-                        use_recompute=remat, loss_chunk_size=chunk)
+                        use_recompute=remat, loss_chunk_size=chunk,
+                        use_scan_layers=scan_layers)
         batch = int(knob("BENCH_BATCH", "16"))  # b16 fits v5e
         # HBM comfortably (fused logsumexp CE, donation) and lifts MFU over
         # the b8 round-1 config

@@ -26,8 +26,19 @@ POINTS = [
     # the only config over the 0.35 bar (arithmetic intensity finally beats
     # the HBM floor); the remat variant is the fallback if ~18GB of
     # activations+state OOMs the 16GB chip
+    # BENCH_SCAN=1 first: the scanned decoder compiles in roughly
+    # 1-layer time (vs 16 inlined copies), so the point most likely to
+    # survive a short tunnel window is the scan variant — round 4's sweep
+    # died on exactly this point's cold compile. The unrolled variant
+    # follows to reclaim the ~1% stack-copy overhead if the window holds.
+    {"BENCH_HIDDEN": "2048", "BENCH_LAYERS": "16", "BENCH_BATCH": "8",
+     "BENCH_REMAT": "0", "BENCH_CHUNK_LOSS": "1024", "BENCH_AMP": "O2",
+     "BENCH_SCAN": "1"},
     {"BENCH_HIDDEN": "2048", "BENCH_LAYERS": "16", "BENCH_BATCH": "8",
      "BENCH_REMAT": "0", "BENCH_CHUNK_LOSS": "1024", "BENCH_AMP": "O2"},
+    {"BENCH_HIDDEN": "2048", "BENCH_LAYERS": "16", "BENCH_BATCH": "8",
+     "BENCH_REMAT": "1", "BENCH_CHUNK_LOSS": "1024", "BENCH_AMP": "O2",
+     "BENCH_SCAN": "1"},
     {"BENCH_HIDDEN": "2048", "BENCH_LAYERS": "16", "BENCH_BATCH": "8",
      "BENCH_REMAT": "1", "BENCH_CHUNK_LOSS": "1024", "BENCH_AMP": "O2"},
     {"BENCH_HIDDEN": "1536", "BENCH_LAYERS": "24", "BENCH_BATCH": "8",

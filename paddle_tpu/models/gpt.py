@@ -48,6 +48,15 @@ class GPTConfig:
     dropout: float = 0.0
     layer_norm_epsilon: float = 1e-5
     use_recompute: bool = False
+    # lax.scan one decoder block over stacked per-layer params: XLA compiles
+    # the block ONCE instead of inlining num_layers copies, so compile time
+    # (and HLO size) stop growing with depth — the lever that makes a deep
+    # config compile inside a short remote-compile window. Runtime cost is
+    # one stack/unstack copy of the layer params per step (~2*P bytes of
+    # HBM traffic, <1% of a training step). Training-path only (the KV-cache
+    # decode path keeps per-layer buffers); requires dropout == 0 while
+    # training (one trace would share a single mask across layers).
+    use_scan_layers: bool = False
     tie_word_embeddings: bool = True
     # >0: fuse LM head + CE over sequence chunks of this many tokens (the
     # [tokens, vocab] logits tensor is never materialized)
@@ -220,7 +229,22 @@ class GPTModel(nn.Layer):
                 x, nc = layer(x, cache=cache, start_pos=start_pos)
                 new_caches.append(nc)
             return self.ln_f(x), new_caches
-        if self.cfg.use_recompute and x._is_traced():
+        want_scan = self.cfg.use_scan_layers and x._is_traced()
+        if want_scan and self.cfg.dropout > 0.0 and self.training:
+            # one trace would share a single dropout mask across every layer
+            if not getattr(self, "_warned_scan_dropout", False):
+                self._warned_scan_dropout = True
+                import warnings
+
+                warnings.warn(
+                    "use_scan_layers is disabled while training with "
+                    f"dropout={self.cfg.dropout}: the scanned block would "
+                    "reuse one dropout mask for all layers. Falling back to "
+                    "the unrolled stack (compile time grows with depth).")
+            want_scan = False
+        if want_scan:
+            x = self._scan_layers(x)
+        elif self.cfg.use_recompute and x._is_traced():
             # fleet.recompute (NOT jax.checkpoint(layer) directly): remat's
             # jaxpr cache keys on the persistent layer and would replay
             # stale closure-captured param tracers on a re-trace
@@ -232,6 +256,38 @@ class GPTModel(nn.Layer):
             for layer in self.layers:
                 x = layer(x)
         return self.ln_f(x)
+
+    def _scan_layers(self, x):
+        """Run the decoder stack as ``lax.scan(block, x, stacked_params)``.
+
+        The per-layer param tracers are stacked along a new leading axis
+        inside the trace; gradients flow back through the stack to each
+        layer's own parameters, so optimizers/checkpointing/state_dict are
+        untouched. With use_recompute the scan body is rematerialized
+        (policy: save nothing — same as the unrolled path)."""
+        from ..jit import functional_call
+
+        tmpl = self.layers[0]
+        p0, b0 = tmpl.functional_state()
+        if b0:  # a buffer mutated inside a scan body would be silently
+            raise NotImplementedError(  # dropped; no GPT block has one
+                "use_scan_layers requires buffer-free decoder blocks")
+        names = list(p0.keys())
+        cols = []
+        for layer in self.layers:
+            p, _ = layer.functional_state()
+            cols.append([p[n]._data for n in names])
+        stacked = [jnp.stack([c[i] for c in cols]) for i in range(len(names))]
+
+        def body(carry, sl):
+            out = functional_call(tmpl, dict(zip(names, sl)), Tensor(carry))
+            return out._data, None
+
+        if self.cfg.use_recompute:
+            body = jax.checkpoint(
+                body, policy=jax.checkpoint_policies.nothing_saveable)
+        y, _ = jax.lax.scan(body, x._data, stacked)
+        return Tensor(y)
 
 
 class GPTEmbeddingPipe(nn.Layer):

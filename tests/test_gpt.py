@@ -119,6 +119,30 @@ def test_gpt_recompute_multi_step_no_tracer_leak():
     assert losses[-1] < losses[0], losses
 
 
+def test_gpt_scan_layers_training_parity():
+    """use_scan_layers (lax.scan one block over stacked per-layer params —
+    the compile-time lever for deep configs) must be a pure execution
+    strategy: same seed, same per-step losses as the unrolled stack, with
+    and without remat, across multiple optimizer steps."""
+    from paddle_tpu.core import rng as prng
+
+    def run(scan, remat):
+        prng.seed(7)
+        cfg = gpt_tiny(use_scan_layers=scan, use_recompute=remat)
+        model = GPTForCausalLM(cfg)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=model.parameters())
+        step = paddle.jit.TrainStep(lambda a, b: model(a, b), opt,
+                                    layers=model)
+        x, y = _batch(cfg, b=2, s=16, seed=5)
+        return [float(step(x, y).numpy()) for _ in range(3)]
+
+    base = run(False, False)
+    assert base[-1] < base[0], base
+    np.testing.assert_allclose(run(True, False), base, rtol=2e-5, atol=2e-6)
+    np.testing.assert_allclose(run(True, True), base, rtol=2e-5, atol=2e-6)
+
+
 def test_gpt_recompute_matches_plain_forward():
     """Remat must not change the math: same seed, same loss with and
     without use_recompute on the compiled path."""
