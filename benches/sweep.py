@@ -41,6 +41,19 @@ POINTS = [
      "BENCH_SCAN": "1"},
     {"BENCH_HIDDEN": "2048", "BENCH_LAYERS": "16", "BENCH_BATCH": "8",
      "BENCH_REMAT": "1", "BENCH_CHUNK_LOSS": "1024", "BENCH_AMP": "O2"},
+    # scanned variants of the other high-intensity configs next: at ~3 min
+    # compile each (vs ~15 unrolled) one modest window banks the whole
+    # large-h frontier before any unrolled point would have finished
+    {"BENCH_HIDDEN": "1536", "BENCH_LAYERS": "24", "BENCH_BATCH": "8",
+     "BENCH_REMAT": "0", "BENCH_CHUNK_LOSS": "1024", "BENCH_AMP": "O2",
+     "BENCH_SCAN": "1"},
+    # 807M at b16+remat: remat frees the activation HBM that b8 no-remat
+    # spends, letting batch double — more FLOPs per weight-pass if the
+    # recompute overhead stays under ~20% (1.07B-param 2560h configs are
+    # out: Adam f32 state alone exceeds the 16GB chip)
+    {"BENCH_HIDDEN": "2048", "BENCH_LAYERS": "16", "BENCH_BATCH": "16",
+     "BENCH_REMAT": "1", "BENCH_CHUNK_LOSS": "1024", "BENCH_AMP": "O2",
+     "BENCH_SCAN": "1"},
     {"BENCH_HIDDEN": "1536", "BENCH_LAYERS": "24", "BENCH_BATCH": "8",
      "BENCH_REMAT": "0", "BENCH_CHUNK_LOSS": "1024", "BENCH_AMP": "O2"},
     {"BENCH_BATCH": "32", "BENCH_REMAT": "0", "BENCH_CHUNK_LOSS": "1024"},
