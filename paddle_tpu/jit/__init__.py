@@ -57,6 +57,69 @@ def functional_call(layer: Layer, params_and_buffers: Dict[str, object], *args, 
 
 
 
+def scan_layers(layers, x: Tensor, *extra, remat: bool = False) -> Tensor:
+    """Apply a homogeneous LayerList as ``lax.scan(block, x, stacked_params)``.
+
+    The block compiles once instead of ``len(layers)`` inlined copies, so
+    XLA compile time stops growing with depth (the deep-model compile-time
+    lever; see GPTConfig.use_scan_layers). Per-layer param tracers are
+    stacked along a new leading axis inside the trace — gradients flow back
+    through the stack to each layer's own parameters, leaving optimizers,
+    checkpoints, and state_dict untouched. ``extra`` are closure constants
+    shared by every block invocation (e.g. an attention mask). With
+    ``remat`` the body is rematerialized (save-nothing policy, matching
+    fleet.recompute). Blocks must be structurally identical and buffer-free
+    (a buffer mutated inside the scan body would be silently dropped)."""
+    import jax
+    import jax.numpy as jnp
+
+    tmpl = layers[0]
+    p0, b0 = tmpl.functional_state()
+    if b0:
+        raise NotImplementedError("scan_layers requires buffer-free blocks")
+    names = list(p0.keys())
+    cols = []
+    for layer in layers:
+        p, _ = layer.functional_state()
+        cols.append([p[n]._data for n in names])
+    stacked = [jnp.stack([c[i] for c in cols]) for i in range(len(names))]
+
+    def body(carry, sl):
+        out = functional_call(tmpl, dict(zip(names, sl)), Tensor(carry),
+                              *extra)
+        return out._data, None
+
+    if remat:
+        body = jax.checkpoint(
+            body, policy=jax.checkpoint_policies.nothing_saveable)
+    y, _ = jax.lax.scan(body, x._data, stacked)
+    return Tensor(y)
+
+
+def scan_layers_wanted(model, *, traced: bool, training: bool,
+                       dropout_ps) -> bool:
+    """Shared gate for the models' ``use_scan_layers`` flags: scan only
+    under a trace, and never while training with live dropout — one traced
+    block would reuse a single dropout mask for every layer. Warns once per
+    model instance when it has to fall back (the caller asked for the
+    compile-time lever and silently losing it would reproduce the exact
+    compile-window timeout the flag exists to avoid)."""
+    if not traced:
+        return False
+    if training and any(float(p) > 0.0 for p in dropout_ps):
+        if not getattr(model, "_warned_scan_dropout", False):
+            model._warned_scan_dropout = True
+            import warnings
+
+            warnings.warn(
+                f"use_scan_layers is disabled while training with "
+                f"dropout={tuple(dropout_ps)}: the scanned block would "
+                "reuse one dropout mask for all layers. Falling back to "
+                "the unrolled stack (compile time grows with depth).")
+        return False
+    return True
+
+
 def _amp_key(st):
     """Hashable identity of an autocast policy (None = no autocast)."""
     if st is None:

@@ -34,6 +34,10 @@ class ErnieConfig:
     attention_dropout: float = 0.1
     layer_norm_epsilon: float = 1e-12
     use_recompute: bool = False
+    # lax.scan one encoder block over stacked per-layer params — compile
+    # time stops growing with depth (see GPTConfig.use_scan_layers /
+    # jit.scan_layers). Requires dropout == 0 while training.
+    use_scan_layers: bool = False
 
 
 def ernie_tiny(**kw) -> ErnieConfig:
@@ -120,7 +124,15 @@ class ErnieModel(nn.Layer):
 
     def forward(self, input_ids, token_type_ids=None, attention_mask=None):
         x = self.embeddings(input_ids, token_type_ids)
-        if self.cfg.use_recompute and x._is_traced():
+        from ..jit import scan_layers, scan_layers_wanted
+
+        if self.cfg.use_scan_layers and scan_layers_wanted(
+                self, traced=x._is_traced(), training=self.training,
+                dropout_ps=(self.cfg.hidden_dropout,
+                            self.cfg.attention_dropout)):
+            x = scan_layers(self.layers, x, attention_mask,
+                            remat=self.cfg.use_recompute)
+        elif self.cfg.use_recompute and x._is_traced():
             # fleet.recompute — see gpt.py GPTModel.forward: remat's jaxpr
             # cache on the persistent layer would replay stale closure
             # tracers on a re-trace

@@ -229,21 +229,12 @@ class GPTModel(nn.Layer):
                 x, nc = layer(x, cache=cache, start_pos=start_pos)
                 new_caches.append(nc)
             return self.ln_f(x), new_caches
-        want_scan = self.cfg.use_scan_layers and x._is_traced()
-        if want_scan and self.cfg.dropout > 0.0 and self.training:
-            # one trace would share a single dropout mask across every layer
-            if not getattr(self, "_warned_scan_dropout", False):
-                self._warned_scan_dropout = True
-                import warnings
+        from ..jit import scan_layers, scan_layers_wanted
 
-                warnings.warn(
-                    "use_scan_layers is disabled while training with "
-                    f"dropout={self.cfg.dropout}: the scanned block would "
-                    "reuse one dropout mask for all layers. Falling back to "
-                    "the unrolled stack (compile time grows with depth).")
-            want_scan = False
-        if want_scan:
-            x = self._scan_layers(x)
+        if self.cfg.use_scan_layers and scan_layers_wanted(
+                self, traced=x._is_traced(), training=self.training,
+                dropout_ps=(self.cfg.dropout,)):
+            x = scan_layers(self.layers, x, remat=self.cfg.use_recompute)
         elif self.cfg.use_recompute and x._is_traced():
             # fleet.recompute (NOT jax.checkpoint(layer) directly): remat's
             # jaxpr cache keys on the persistent layer and would replay
@@ -256,38 +247,6 @@ class GPTModel(nn.Layer):
             for layer in self.layers:
                 x = layer(x)
         return self.ln_f(x)
-
-    def _scan_layers(self, x):
-        """Run the decoder stack as ``lax.scan(block, x, stacked_params)``.
-
-        The per-layer param tracers are stacked along a new leading axis
-        inside the trace; gradients flow back through the stack to each
-        layer's own parameters, so optimizers/checkpointing/state_dict are
-        untouched. With use_recompute the scan body is rematerialized
-        (policy: save nothing — same as the unrolled path)."""
-        from ..jit import functional_call
-
-        tmpl = self.layers[0]
-        p0, b0 = tmpl.functional_state()
-        if b0:  # a buffer mutated inside a scan body would be silently
-            raise NotImplementedError(  # dropped; no GPT block has one
-                "use_scan_layers requires buffer-free decoder blocks")
-        names = list(p0.keys())
-        cols = []
-        for layer in self.layers:
-            p, _ = layer.functional_state()
-            cols.append([p[n]._data for n in names])
-        stacked = [jnp.stack([c[i] for c in cols]) for i in range(len(names))]
-
-        def body(carry, sl):
-            out = functional_call(tmpl, dict(zip(names, sl)), Tensor(carry))
-            return out._data, None
-
-        if self.cfg.use_recompute:
-            body = jax.checkpoint(
-                body, policy=jax.checkpoint_policies.nothing_saveable)
-        y, _ = jax.lax.scan(body, x._data, stacked)
-        return Tensor(y)
 
 
 class GPTEmbeddingPipe(nn.Layer):

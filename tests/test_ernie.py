@@ -78,3 +78,32 @@ def test_multi_head_attention_mask():
     out = mha(x, attn_mask=mask)
     assert out.shape == [2, 8, 32]
 
+
+
+def test_ernie_scan_layers_training_parity():
+    """use_scan_layers on the ERNIE encoder (jit.scan_layers over the
+    stacked blocks, attention_mask as a shared closure constant) must
+    match the unrolled stack step-for-step, with and without remat."""
+    from paddle_tpu.core import rng as prng
+    from paddle_tpu.optimizer import AdamW
+
+    def run(scan, remat):
+        prng.seed(9)
+        cfg = ernie_tiny(use_scan_layers=scan, use_recompute=remat)
+        m = ErnieForPretraining(cfg)
+        opt = AdamW(learning_rate=1e-3, parameters=m.parameters())
+        rng = np.random.default_rng(2)
+        ids = rng.integers(0, 1024, (2, 32), dtype=np.int32)
+        labels = np.where(rng.random((2, 32)) < 0.15, ids,
+                          -100).astype(np.int64)
+        sop = rng.integers(0, 2, (2,), dtype=np.int64)
+        step = TrainStep(
+            lambda a, b, c: m(a, masked_lm_labels=b, next_sentence_labels=c),
+            opt, layers=m)
+        args = tuple(paddle.to_tensor(t) for t in (ids, labels, sop))
+        return [float(step(*args).numpy()) for _ in range(3)]
+
+    base = run(False, False)
+    assert base[-1] < base[0], base
+    np.testing.assert_allclose(run(True, False), base, rtol=2e-5, atol=2e-6)
+    np.testing.assert_allclose(run(True, True), base, rtol=2e-5, atol=2e-6)
