@@ -158,9 +158,13 @@ def bench_gpt_hybrid():
 
     on_tpu = _platform() != "cpu"
     if on_tpu:
+        # scan-over-layers: same math (dropout=0), ~4x faster cold compile
+        # at 24L — the difference between this row surviving a tunnel
+        # window or not. BASELINE_SCAN=0 restores the unrolled stack.
+        scan = os.environ.get("BASELINE_SCAN", "1") == "1"
         cfg = GPTConfig(vocab_size=50304, hidden_size=1024, num_layers=24,
                         num_heads=16, max_position_embeddings=2048,
-                        use_recompute=True)
+                        use_recompute=True, use_scan_layers=scan)
         batch, seq = 8, 1024
     else:
         cfg = gpt_tiny()
@@ -183,7 +187,8 @@ def bench_gpt_hybrid():
     _emit({"config": "gpt-345m-single-chip", "samples_per_sec": round(batch / dt, 1),
            "tokens_per_sec": round(batch * seq / dt, 1), "batch": batch,
            "seq": seq, "step_ms": round(dt * 1e3, 2),
-           "compile_s": round(comp, 1), "loss": loss, "platform": _platform()})
+           "compile_s": round(comp, 1), "loss": loss, "platform": _platform(),
+           "scan_layers": bool(cfg.use_scan_layers)})
 
 
 def bench_widedeep():
