@@ -43,7 +43,7 @@ BATCH, SEQ = 16, 1024
 
 
 def build_step(remat: bool, hidden=768, layers=12, batch=BATCH, seq=SEQ,
-               amp_level="O1", chunk=0):
+               amp_level="O1", chunk=0, scan=False):
     import paddle_tpu  # noqa: F401  (registers ops)
     from paddle_tpu import amp
     from paddle_tpu.core.tensor import Tensor
@@ -54,7 +54,8 @@ def build_step(remat: bool, hidden=768, layers=12, batch=BATCH, seq=SEQ,
     cfg = GPTConfig(vocab_size=50304, hidden_size=hidden, num_layers=layers,
                     num_heads=max(1, hidden // 64),
                     max_position_embeddings=2048,
-                    use_recompute=remat, loss_chunk_size=chunk)
+                    use_recompute=remat, loss_chunk_size=chunk,
+                    use_scan_layers=scan)
     model = GPTForCausalLM(cfg)
     opt = AdamW(learning_rate=1e-4, parameters=model.parameters(),
                 weight_decay=0.01)
