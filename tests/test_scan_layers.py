@@ -81,6 +81,37 @@ def test_to_static_forward_parity():
     assert abs(eager - compiled) < 1e-5
 
 
+def test_flash_attention_inside_scanned_block():
+    """The Pallas flash kernel (fwd + custom-vjp bwd) must compose with
+    scan-over-layers — the long-context configs route attention through
+    it, and a scanned stack wraps it in a lax.scan body."""
+    from paddle_tpu.core import flags, rng as prng
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    from paddle_tpu.core.tensor import Tensor
+
+    old = flags.flag("flash_attention_min_seqlen")
+    flags.set_flags({"flash_attention_min_seqlen": 8})
+    try:
+        def run(scan):
+            prng.seed(5)
+            cfg = GPTConfig(vocab_size=256, hidden_size=128, num_layers=2,
+                            num_heads=2, max_position_embeddings=64,
+                            use_scan_layers=scan)
+            m = GPTForCausalLM(cfg)
+            opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                         parameters=m.parameters())
+            step = TrainStep(lambda a, b: m(a, b), opt, layers=m)
+            ids = np.random.default_rng(3).integers(0, 256, (2, 32),
+                                                    dtype=np.int32)
+            x, y = Tensor(ids), Tensor(np.roll(ids, -1, 1))
+            return [float(step(x, y).numpy()) for _ in range(2)]
+
+        base = run(False)
+        np.testing.assert_allclose(run(True), base, rtol=2e-5, atol=2e-6)
+    finally:
+        flags.set_flags({"flash_attention_min_seqlen": old})
+
+
 def test_buffer_carrying_block_rejected():
     class BufBlock(nn.Layer):
         def __init__(self):
