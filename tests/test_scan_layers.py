@@ -112,6 +112,33 @@ def test_flash_attention_inside_scanned_block():
         flags.set_flags({"flash_attention_min_seqlen": old})
 
 
+def test_gradient_merge_outer_scan_composes():
+    """accumulate_steps (microbatch lax.scan) wrapping scan-over-layers —
+    nested scans, the realistic large-model recipe — must match the
+    unrolled stack step-for-step."""
+    from paddle_tpu.core import rng as prng
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+    def run(scan):
+        prng.seed(6)
+        cfg = GPTConfig(vocab_size=256, hidden_size=64, num_layers=3,
+                        num_heads=4, max_position_embeddings=64,
+                        use_scan_layers=scan)
+        m = GPTForCausalLM(cfg)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=m.parameters())
+        step = TrainStep(lambda a, b: m(a, b), opt, layers=m,
+                         accumulate_steps=2)
+        ids = np.random.default_rng(4).integers(0, 256, (4, 16),
+                                                dtype=np.int32)
+        x, y = Tensor(ids), Tensor(np.roll(ids, -1, 1))
+        return [float(step(x, y).numpy()) for _ in range(3)]
+
+    base = run(False)
+    np.testing.assert_allclose(run(True), base, rtol=2e-5, atol=2e-6)
+
+
 def test_buffer_carrying_block_rejected():
     class BufBlock(nn.Layer):
         def __init__(self):
