@@ -64,3 +64,24 @@ def test_static_program_apis_are_real():
         assert getattr(x, "_sym_id", None) is not None
     with pytest.raises(NotImplementedError):
         paddle.static.serialize_program()
+
+
+def test_scanned_model_exports_and_roundtrips(tmp_path):
+    """A use_scan_layers model exports to StableHLO (the program contains
+    while ops from lax.scan) and loads back bit-exact — the deploy story
+    must not depend on the execution strategy chosen at training time."""
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+    paddle.seed(21)
+    cfg = GPTConfig(vocab_size=256, hidden_size=64, num_layers=3,
+                    num_heads=4, max_position_embeddings=64,
+                    use_scan_layers=True)
+    m = GPTForCausalLM(cfg)
+    m.eval()
+    ids = np.random.default_rng(8).integers(0, 256, (2, 16), dtype=np.int32)
+    x = paddle.to_tensor(ids)
+    ref = m(x).numpy()
+    prefix = os.path.join(str(tmp_path), "scan_gpt")
+    paddle.jit.save(m, prefix, input_spec=[InputSpec([2, 16], "int32")])
+    out = paddle.jit.load(prefix)(x).numpy()
+    np.testing.assert_allclose(out, ref, atol=1e-5)
