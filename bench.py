@@ -52,7 +52,7 @@ def model_flops_per_token(cfg) -> float:
 cfg_seq_len = 1024  # set in main() before flop accounting
 
 
-def _tuned_knobs() -> dict:
+def _tuned_knobs(path: str = None) -> dict:
     """Best on-chip sweep point (benches/BENCH_TUNED.json, written by
     benches/sweep.py after a successful sweep). Applied BY DEFAULT once it
     exists: sweep.py only writes it from an error-free on-chip record, so
@@ -63,8 +63,8 @@ def _tuned_knobs() -> dict:
     mode = os.environ.get("BENCH_USE_TUNED", "auto")
     if mode == "0":
         return {}
-    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                        "benches", "BENCH_TUNED.json")
+    path = path or os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "benches", "BENCH_TUNED.json")
     try:
         with open(path) as f:
             rec = json.load(f)

@@ -1,0 +1,77 @@
+"""The BENCH_TUNED.json → bench.py contract (the round-record pipeline).
+
+sweep.py publishes its best on-chip point; a plain `python bench.py` (the
+driver's record run) must adopt it ONLY when the record is error-free and
+beats the standing on-chip headline — a worse or failed "best" silently
+replacing the proven config would cost the round its record."""
+import importlib.util
+import json
+import os
+import sys
+
+import pytest
+
+
+@pytest.fixture()
+def bench_mod():
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "bench.py")
+    spec = importlib.util.spec_from_file_location("bench_under_test", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _write(tmp_path, rec):
+    p = os.path.join(str(tmp_path), "BENCH_TUNED.json")
+    with open(p, "w") as f:
+        json.dump(rec, f)
+    return p
+
+
+GOOD = {"mfu": 0.41, "error": None,
+        "sweep_point": {"BENCH_HIDDEN": 2048, "BENCH_LAYERS": 16,
+                        "BENCH_BATCH": 8, "BENCH_CHUNK_LOSS": 1024,
+                        "BENCH_AMP": "O2", "BENCH_SCAN": 1}}
+
+
+def test_good_record_adopted_with_all_keys(bench_mod, tmp_path, monkeypatch):
+    monkeypatch.delenv("BENCH_USE_TUNED", raising=False)
+    knobs = bench_mod._tuned_knobs(_write(tmp_path, GOOD))
+    # every sweep key round-trips as an env-style string (incl. BENCH_SCAN)
+    assert knobs == {"BENCH_HIDDEN": "2048", "BENCH_LAYERS": "16",
+                     "BENCH_BATCH": "8", "BENCH_CHUNK_LOSS": "1024",
+                     "BENCH_AMP": "O2", "BENCH_SCAN": "1"}
+
+
+def test_error_record_rejected(bench_mod, tmp_path, monkeypatch):
+    monkeypatch.delenv("BENCH_USE_TUNED", raising=False)
+    rec = dict(GOOD, error="watchdog: ...")
+    assert bench_mod._tuned_knobs(_write(tmp_path, rec)) == {}
+
+
+def test_worse_than_standing_headline_rejected(bench_mod, tmp_path,
+                                               monkeypatch):
+    # a sweep where every high-intensity point OOMed must not publish a
+    # "best" below the measured r4 headline (MFU 0.1592)
+    monkeypatch.delenv("BENCH_USE_TUNED", raising=False)
+    rec = dict(GOOD, mfu=0.12)
+    assert bench_mod._tuned_knobs(_write(tmp_path, rec)) == {}
+
+
+def test_missing_or_malformed_never_blocks(bench_mod, tmp_path, monkeypatch):
+    monkeypatch.delenv("BENCH_USE_TUNED", raising=False)
+    assert bench_mod._tuned_knobs(
+        os.path.join(str(tmp_path), "absent.json")) == {}
+    p = os.path.join(str(tmp_path), "bad.json")
+    with open(p, "w") as f:
+        f.write("{not json")
+    assert bench_mod._tuned_knobs(p) == {}
+
+
+def test_env_modes(bench_mod, tmp_path, monkeypatch):
+    p = _write(tmp_path, dict(GOOD, mfu=0.12))
+    monkeypatch.setenv("BENCH_USE_TUNED", "0")  # explicit off beats a good rec
+    assert bench_mod._tuned_knobs(_write(tmp_path, GOOD)) == {}
+    monkeypatch.setenv("BENCH_USE_TUNED", "1")  # force adopts even a bad rec
+    assert bench_mod._tuned_knobs(p)["BENCH_HIDDEN"] == "2048"
