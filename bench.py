@@ -189,10 +189,13 @@ def main():
         print(f"# applying tuned sweep point: {tuned}", flush=True)
     remat = knob("BENCH_REMAT", "0") == "1"
     chunk = int(knob("BENCH_CHUNK_LOSS", "0"))
-    # BENCH_SCAN=1: lax.scan the decoder block over stacked layer params —
-    # compile time stops growing with depth (a deep config then compiles
-    # inside a short tunnel window) for ~2*P bytes/step of stack traffic
-    scan_layers = knob("BENCH_SCAN", "0") == "1"
+    # BENCH_SCAN: lax.scan the decoder block over stacked layer params —
+    # compile time stops growing with depth for ~2*P bytes/step of stack
+    # traffic (<2%). Default ON for TPU: three rounds of rc!=0 driver
+    # records were lost to cold compiles outliving tunnel windows; a
+    # 1-2% slower measured step beats no measurement. BENCH_SCAN=0
+    # restores the unrolled stack (the r4-headline-identical program).
+    scan_layers = knob("BENCH_SCAN", "1") == "1"
     if platform == "tpu":
         # BENCH_HIDDEN/LAYERS/HEADS scale toward the reference's headline
         # GPT-3 1.3B-class config (BASELINE.md config 4) as far as one chip
