@@ -28,13 +28,18 @@ def main():
     ap.add_argument("--amp", default="O1", choices=["O0", "O1", "O2"])
     ap.add_argument("--accumulate", type=int, default=1,
                     help="gradient-merge microbatches per step")
+    ap.add_argument("--scan_layers", action="store_true",
+                    help="lax.scan the decoder block over stacked "
+                         "per-layer params: compile time stops growing "
+                         "with --layers (same math; docs/performance.md #9)")
     args = ap.parse_args()
 
     paddle.seed(0)
     cfg = GPTConfig(vocab_size=1024, hidden_size=args.hidden,
                     num_layers=args.layers,
                     num_heads=max(1, args.hidden // 64),
-                    max_position_embeddings=max(2048, args.seq))
+                    max_position_embeddings=max(2048, args.seq),
+                    use_scan_layers=args.scan_layers)
     model = GPTForCausalLM(cfg)
     sched = CosineAnnealingDecay(learning_rate=3e-4, T_max=args.steps)
     opt = AdamW(learning_rate=sched, parameters=model.parameters(),
