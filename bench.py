@@ -6,8 +6,10 @@ ONE JSON line: {"metric", "value", "unit", "vs_baseline", "tokens_per_sec",
 "tflops", "mfu"}.
 
 The reference publishes no absolute numbers (BASELINE.md) — baseline is our
-own first recorded run, stored in BENCH_BASELINE.json; vs_baseline is the
-ratio current/recorded tokens/sec (1.0 on the run that creates the record).
+own first recorded run, stored in BENCH_BASELINE.json; vs_baseline is
+current/recorded samples/sec (identical config), tokens/sec (same model,
+batch/seq changed), or delivered TFLOP/s (different model size — the only
+cross-model comparable; 1.0 on the run that creates the record).
 
 MFU = achieved model FLOP/s ÷ chip peak bf16 FLOP/s, with the standard
 training accounting: 6·N_matmul per token (fwd+bwd over every matmul
@@ -291,18 +293,30 @@ def main():
             try:
                 with open(baseline_path, "w") as f:
                     json.dump({"metric": metric, "value": samples_per_sec,
-                               "tokens_per_sec": tokens_per_sec}, f)
+                               "tokens_per_sec": tokens_per_sec,
+                               "tflops": round(flops / 1e12, 2)}, f)
             except OSError:
                 pass
+    vs_basis = None
     if rec is not None:
         rec_tps = rec.get("tokens_per_sec")
-        if rec.get("metric") == metric and rec.get("value"):
-            vs = samples_per_sec / float(rec["value"])
-        elif rec_tps and "(GPT " in rec.get("metric", "") and f"{platform})" in rec.get("metric", ""):
-            # config changed (batch/seq sweep): tokens/sec is still comparable
-            vs = tokens_per_sec / float(rec_tps)
+        rec_metric = rec.get("metric", "")
+        same_model = f"(GPT {cfg.hidden_size}h/{cfg.num_layers}L " in rec_metric
+        if rec_metric == metric and rec.get("value"):
+            vs, vs_basis = samples_per_sec / float(rec["value"]), "samples"
+        elif rec_tps and same_model and f"{platform})" in rec_metric:
+            # same model, batch/seq sweep: tokens/sec is still comparable
+            vs, vs_basis = tokens_per_sec / float(rec_tps), "tokens"
+        elif rec.get("tflops") and "(GPT " in rec_metric and f"{platform})" in rec_metric:
+            # different model size: tokens aren't comparable, delivered
+            # FLOP/s is — vs_baseline becomes the utilization gain over the
+            # first recorded run (e.g. the 913M tuned config vs the r1
+            # 124M headline)
+            vs, vs_basis = (flops / 1e12) / float(rec["tflops"]), "tflops"
         else:
             vs = None
+    else:
+        vs_basis = "samples"  # the run that creates the record
 
     watchdog.cancel()
     print(json.dumps({
@@ -310,6 +324,7 @@ def main():
         "value": round(samples_per_sec, 3),
         "unit": "samples/sec/chip",
         "vs_baseline": round(vs, 4) if vs is not None else None,
+        "vs_baseline_basis": vs_basis,
         "tokens_per_sec": round(tokens_per_sec, 1),
         "tflops": round(flops / 1e12, 2),
         "mfu": round(mfu, 4) if mfu is not None else None,
