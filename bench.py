@@ -189,7 +189,12 @@ def main():
 
     if tuned:
         print(f"# applying tuned sweep point: {tuned}", flush=True)
-    remat = knob("BENCH_REMAT", "0") == "1"
+    # BENCH_REMAT: 0 = off, 1 = full remat (save nothing), or a policy name
+    # ("core_attn" saves weight-matmul outputs, recomputing only attention
+    # scores/softmax — cheaper backward recompute than full remat)
+    remat_knob = knob("BENCH_REMAT", "0")
+    remat = remat_knob != "0"
+    remat_policy = remat_knob if remat_knob not in ("0", "1") else "full"
     chunk = int(knob("BENCH_CHUNK_LOSS", "0"))
     # BENCH_SCAN: lax.scan the decoder block over stacked layer params —
     # compile time stops growing with depth for ~2*P bytes/step of stack
@@ -210,7 +215,8 @@ def main():
         cfg = GPTConfig(vocab_size=50304, hidden_size=hidden, num_layers=layers,
                         num_heads=heads,
                         max_position_embeddings=max(2048, seq_req),
-                        use_recompute=remat, loss_chunk_size=chunk,
+                        use_recompute=remat, recompute_policy=remat_policy,
+                        loss_chunk_size=chunk,
                         use_scan_layers=scan_layers)
         batch = int(knob("BENCH_BATCH", "16"))  # b16 fits v5e
         # HBM comfortably (fused logsumexp CE, donation) and lifts MFU over
@@ -226,7 +232,12 @@ def main():
     from paddle_tpu import amp
 
     model = GPTForCausalLM(cfg)
-    opt = AdamW(learning_rate=1e-4, parameters=model.parameters(), weight_decay=0.01)
+    # BENCH_MOMENT_DTYPE=bfloat16: store Adam moments in bf16 (math stays
+    # f32) — frees 4 bytes/param of HBM, which is what lets large-h configs
+    # fit bigger batches on the 16 GB chip
+    moment_dtype = knob("BENCH_MOMENT_DTYPE", "") or None
+    opt = AdamW(learning_rate=1e-4, parameters=model.parameters(), weight_decay=0.01,
+                moment_dtype=moment_dtype)
 
     use_amp = platform == "tpu"
     # BENCH_AMP=O2: cast params themselves to bf16 (f32 optimizer slots act

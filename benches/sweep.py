@@ -89,6 +89,13 @@ POINTS = [
 ]
 
 
+if os.environ.get("SWEEP_POINTS_JSON"):
+    # phase-2 / targeted sweeps: take the point list from a JSON file
+    # (list of env-dicts) instead of the built-in grid
+    with open(os.environ["SWEEP_POINTS_JSON"]) as _f:
+        POINTS = json.load(_f)
+
+
 def _publish(best):
     """Publish the winning knobs IMMEDIATELY (not after the full loop): a
     stage timeout or tunnel death later in the sweep must not discard an

@@ -32,6 +32,15 @@ def main():
                     help="lax.scan the decoder block over stacked "
                          "per-layer params: compile time stops growing "
                          "with --layers (same math; docs/performance.md #9)")
+    ap.add_argument("--recompute", default="off",
+                    choices=["off", "full", "full_attn", "core_attn"],
+                    help="activation remat: full saves nothing; core_attn "
+                         "saves weight-matmul outputs and recomputes only "
+                         "attention scores/softmax (cheaper backward)")
+    ap.add_argument("--moment_dtype", default=None,
+                    choices=["float32", "bfloat16"],
+                    help="Adam moment storage dtype; bfloat16 halves "
+                         "optimizer-state HBM, update math stays f32")
     args = ap.parse_args()
 
     paddle.seed(0)
@@ -39,11 +48,14 @@ def main():
                     num_layers=args.layers,
                     num_heads=max(1, args.hidden // 64),
                     max_position_embeddings=max(2048, args.seq),
+                    use_recompute=args.recompute != "off",
+                    recompute_policy=(args.recompute
+                                      if args.recompute != "off" else "full"),
                     use_scan_layers=args.scan_layers)
     model = GPTForCausalLM(cfg)
     sched = CosineAnnealingDecay(learning_rate=3e-4, T_max=args.steps)
     opt = AdamW(learning_rate=sched, parameters=model.parameters(),
-                weight_decay=0.01)
+                weight_decay=0.01, moment_dtype=args.moment_dtype)
     if args.amp == "O2":
         amp.decorate(model, opt, level="O2")
 

@@ -57,7 +57,7 @@ def functional_call(layer: Layer, params_and_buffers: Dict[str, object], *args, 
 
 
 
-def scan_layers(layers, x: Tensor, *extra, remat: bool = False) -> Tensor:
+def scan_layers(layers, x: Tensor, *extra, remat=False) -> Tensor:
     """Apply a homogeneous LayerList as ``lax.scan(block, x, stacked_params)``.
 
     The block compiles once instead of ``len(layers)`` inlined copies, so
@@ -67,8 +67,12 @@ def scan_layers(layers, x: Tensor, *extra, remat: bool = False) -> Tensor:
     through the stack to each layer's own parameters, leaving optimizers,
     checkpoints, and state_dict untouched. ``extra`` are closure constants
     shared by every block invocation (e.g. an attention mask). With
-    ``remat`` the body is rematerialized (save-nothing policy, matching
-    fleet.recompute). Blocks must be structurally identical and buffer-free
+    ``remat`` the body is rematerialized — ``True`` for the save-nothing
+    policy (matching fleet.recompute's default) or a policy name from
+    fleet.recompute._POLICIES (e.g. ``"core_attn"`` saves weight-matmul
+    outputs and recomputes only attention scores/softmax — far cheaper
+    recompute at slightly more memory). Blocks must be structurally
+    identical and buffer-free
     (a buffer mutated inside the scan body would be silently dropped)."""
     import jax
     import jax.numpy as jnp
@@ -90,8 +94,13 @@ def scan_layers(layers, x: Tensor, *extra, remat: bool = False) -> Tensor:
         return out._data, None
 
     if remat:
-        body = jax.checkpoint(
-            body, policy=jax.checkpoint_policies.nothing_saveable)
+        from ..distributed.fleet.recompute import _POLICIES
+
+        name = remat if isinstance(remat, str) else "full"
+        if name not in _POLICIES:
+            raise ValueError(f"unknown recompute policy {name!r}; valid: "
+                             f"{sorted(_POLICIES)}")
+        body = jax.checkpoint(body, policy=_POLICIES[name])
     y, _ = jax.lax.scan(body, x._data, stacked)
     return Tensor(y)
 
