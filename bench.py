@@ -198,11 +198,13 @@ def main():
     chunk = int(knob("BENCH_CHUNK_LOSS", "0"))
     # BENCH_SCAN: lax.scan the decoder block over stacked layer params —
     # compile time stops growing with depth for ~2*P bytes/step of stack
-    # traffic (<2%). Default ON for TPU: three rounds of rc!=0 driver
-    # records were lost to cold compiles outliving tunnel windows; a
-    # 1-2% slower measured step beats no measurement. BENCH_SCAN=0
-    # restores the unrolled stack (the r4-headline-identical program).
-    scan_layers = knob("BENCH_SCAN", "1") == "1"
+    # traffic (<2%). Default OFF on TPU as of r5: on-chip evidence shows
+    # the scanned 768h non-remat program crashes the remote compile
+    # helper while the unrolled one compiles and runs, and the original
+    # motivation (cold compiles outliving tunnel windows) is covered by
+    # the persistent compile cache + the auto-adopted tuned point (which
+    # is unrolled). BENCH_SCAN=1 opts back in for deep-config compiles.
+    scan_layers = knob("BENCH_SCAN", "0") == "1"
     if platform == "tpu":
         # BENCH_HIDDEN/LAYERS/HEADS scale toward the reference's headline
         # GPT-3 1.3B-class config (BASELINE.md config 4) as far as one chip

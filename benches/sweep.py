@@ -59,24 +59,26 @@ POINTS = [
     {"BENCH_HIDDEN": "1536", "BENCH_LAYERS": "24", "BENCH_BATCH": "8",
      "BENCH_REMAT": "0", "BENCH_CHUNK_LOSS": "1024", "BENCH_AMP": "O2",
      "BENCH_SCAN": "0"},
-    # remaining points inherit bench.py's scan-by-default (BENCH_SCAN=1):
-    # the ~1-2% strategy delta is inside sweep-ranking noise and every
-    # compile is ~3x cheaper, so a window covers more of the grid
-    {"BENCH_BATCH": "32", "BENCH_REMAT": "0", "BENCH_CHUNK_LOSS": "1024"},
+    # remaining points pin BENCH_SCAN=1 explicitly (bench.py's TPU default
+    # flipped to unrolled in r5): the ~1-2% strategy delta is inside
+    # sweep-ranking noise and every scanned compile is ~3x cheaper, so a
+    # window covers more of the grid
     {"BENCH_BATCH": "32", "BENCH_REMAT": "0", "BENCH_CHUNK_LOSS": "1024",
-     "BENCH_AMP": "O2"},
+     "BENCH_SCAN": "1"},
+    {"BENCH_BATCH": "32", "BENCH_REMAT": "0", "BENCH_CHUNK_LOSS": "1024",
+     "BENCH_AMP": "O2", "BENCH_SCAN": "1"},
     {"BENCH_HIDDEN": "1024", "BENCH_LAYERS": "24", "BENCH_BATCH": "16",
-     "BENCH_REMAT": "0", "BENCH_CHUNK_LOSS": "1024", "BENCH_AMP": "O2"},
+     "BENCH_REMAT": "0", "BENCH_CHUNK_LOSS": "1024", "BENCH_AMP": "O2", "BENCH_SCAN": "1"},
     {"BENCH_HIDDEN": "1024", "BENCH_LAYERS": "24", "BENCH_BATCH": "32",
-     "BENCH_REMAT": "1", "BENCH_CHUNK_LOSS": "1024", "BENCH_AMP": "O2"},
-    {"BENCH_BATCH": "64", "BENCH_REMAT": "0", "BENCH_CHUNK_LOSS": "1024"},
-    {"BENCH_BATCH": "32", "BENCH_REMAT": "0"},
+     "BENCH_REMAT": "1", "BENCH_CHUNK_LOSS": "1024", "BENCH_AMP": "O2", "BENCH_SCAN": "1"},
+    {"BENCH_BATCH": "64", "BENCH_REMAT": "0", "BENCH_CHUNK_LOSS": "1024", "BENCH_SCAN": "1"},
+    {"BENCH_BATCH": "32", "BENCH_REMAT": "0", "BENCH_SCAN": "1"},
     {"BENCH_BATCH": "64", "BENCH_REMAT": "0", "BENCH_CHUNK_LOSS": "1024",
-     "BENCH_AMP": "O2"},
+     "BENCH_AMP": "O2", "BENCH_SCAN": "1"},
     {"BENCH_HIDDEN": "1536", "BENCH_LAYERS": "24", "BENCH_BATCH": "16",
-     "BENCH_REMAT": "1", "BENCH_CHUNK_LOSS": "1024", "BENCH_AMP": "O2"},
-    {"BENCH_BATCH": "16", "BENCH_REMAT": "0", "BENCH_CHUNK_LOSS": "1024"},
-    {"BENCH_BATCH": "64", "BENCH_REMAT": "1", "BENCH_CHUNK_LOSS": "1024"},
+     "BENCH_REMAT": "1", "BENCH_CHUNK_LOSS": "1024", "BENCH_AMP": "O2", "BENCH_SCAN": "1"},
+    {"BENCH_BATCH": "16", "BENCH_REMAT": "0", "BENCH_CHUNK_LOSS": "1024", "BENCH_SCAN": "1"},
+    {"BENCH_BATCH": "64", "BENCH_REMAT": "1", "BENCH_CHUNK_LOSS": "1024", "BENCH_SCAN": "1"},
     # long-context point: s=8192 routes attention through the Pallas flash
     # kernels (measured 6.99x over XLA there); remat keeps activations sane.
     # Scan variant first (flash-in-scan parity-tested off-chip); if Mosaic

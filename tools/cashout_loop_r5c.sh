@@ -1,0 +1,95 @@
+#!/bin/bash
+# Round-5 probe-gated TPU cashout loop. Priority per VERDICT r4 "Next round":
+#   1. sweep (807M over-bar config is POINTS[0]) — the round's one job
+#   2. bench (default headline; also primes the persistent compile cache so
+#      the driver's end-of-round `python bench.py` is warm + fast)
+#   3. bench_tuned (re-run with the sweep's winning knobs → warms ITS cache
+#      entry, which the driver's run now picks up by default)
+#   4. flash_tune → re-measure break-even → rest of the bank
+# Probe runs before EVERY stage; marker files make passes resumable.
+set -u
+cd "$(dirname "$0")/.."
+LOGS=benches/tpu_logs
+MARKS=$LOGS/done_r5
+mkdir -p "$LOGS" "$MARKS"
+
+probe() {
+  timeout 180 python - <<'PY'
+import jax, numpy as np, time
+t0 = time.time()
+y = jax.jit(lambda a: a @ a)(np.ones((256, 256), np.float32))
+y.block_until_ready()
+d = jax.devices()[0]
+assert d.platform != "cpu", f"probe landed on {d.platform}"
+print(f"TPU alive: {d} matmul in {time.time()-t0:.1f}s")
+PY
+}
+
+run() {  # run <name> <timeout_s> <cmd...> — marked done only on success
+  local name=$1 t=$2; shift 2
+  local STAMP=$(date +%Y%m%d_%H%M%S)
+  echo "[loop] $name ..."
+  timeout "$t" "$@" > "$LOGS/r5_${name}_$STAMP.log" 2>&1
+  local rc=$?
+  tail -2 "$LOGS/r5_${name}_$STAMP.log"
+  echo "[loop] $name rc=$rc"
+  [ "$rc" -eq 0 ] && touch "$MARKS/$name"
+  return $rc
+}
+
+STAGES=(
+  "sweep 14400 python benches/sweep.py"
+  "sweep2 10800 env SWEEP_POINTS_JSON=benches/sweep2_points.json python benches/sweep.py"
+  "sweep3 10800 env SWEEP_POINTS_JSON=benches/sweep3_points.json python benches/sweep.py"
+  "bench_headline 2400 env BENCH_USE_TUNED=0 python bench.py"
+  "bench_tuned 2400 python bench.py"
+  "flash_tune 2400 python benches/flash_tune.py"
+  "flash_tpu 2400 python benches/flash_tpu_bench.py"
+  "baseline 7200 python benches/baseline.py lenet resnet50 ernie gpt-hybrid widedeep"
+  "decode 2400 python benches/decode_bench.py"
+  "eager 1800 python tools/eager_bench.py"
+  "hlo_tpu 2400 env HLO_PLATFORM=tpu python tools/hlo_analysis.py"
+  "native 1800 env PADDLE_TPU_NATIVE_TPU_TEST=1 python -m pytest tests/test_native_infer.py -k real_plugin -q"
+)
+
+attempt=0
+while true; do
+  attempt=$((attempt + 1))
+  echo "[loop] pass $attempt $(date)"
+  for spec in "${STAGES[@]}"; do
+    read -r name t cmd <<<"$spec"
+    [ -f "$MARKS/$name" ] && continue
+    # bench_tuned only means something after the sweep published a winner
+    # that bench.py's mfu>0.16 gate will actually adopt — running earlier
+    # (or on an under-bar winner) would just duplicate bench_headline and
+    # never warm the tuned config's cache entry. Mirror the gate here.
+    if [ "$name" = bench_tuned ]; then
+      # plain json check — strip the axon env so sitecustomize's register()
+      # (which dials the tunnel at interpreter start and can hang) is skipped
+      timeout 60 env -u PALLAS_AXON_POOL_IPS python - <<'PY' || continue
+import json, sys
+try:
+    rec = json.load(open("benches/BENCH_TUNED.json"))
+except Exception:
+    sys.exit(1)
+sys.exit(0 if not rec.get("error") and (rec.get("mfu") or 0) > 0.16 else 1)
+PY
+    fi
+    if ! probe > "$LOGS/r5_probe_${attempt}_${name}.log" 2>&1; then
+      echo "[loop] tunnel down before $name (pass $attempt)"
+      break
+    fi
+    cat "$LOGS/r5_probe_${attempt}_${name}.log"
+    run "$name" "$t" $cmd || true
+  done
+  remaining=0
+  for spec in "${STAGES[@]}"; do
+    read -r name t cmd <<<"$spec"
+    [ -f "$MARKS/$name" ] || remaining=1
+  done
+  if [ "$remaining" -eq 0 ]; then
+    echo "[loop] all stages done $(date)"
+    break
+  fi
+  sleep 600
+done
