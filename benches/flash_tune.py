@@ -58,7 +58,11 @@ def main():
     d = jax.devices()[0]
     print(f"[flash-tune] device: {d} ({d.platform})", flush=True)
     rng = np.random.RandomState(7)
-    shapes = [(4, 4096, 12, 64), (1, 8192, 12, 64)]
+    # 1024/2048 included since the tuned 512-blocks moved the XLA
+    # break-even below 4096 — the short end needs its own best tiling
+    # before FLAGS_flash_attention_min_seqlen can be set from data
+    shapes = [(16, 1024, 12, 64), (8, 2048, 12, 64),
+              (4, 4096, 12, 64), (1, 8192, 12, 64)]
     candidates = [(128, 128), (128, 256), (128, 512), (256, 256),
                   (256, 512), (512, 512), (256, 128), (512, 256)]
     best_by_shape = {}

@@ -71,7 +71,20 @@ class Optimizer:
         return slots
 
     def _init_moments(self, param: jax.Array) -> Dict[str, jax.Array]:
+        # optimizers that set _moment_dtype (Adam family, Lamb) store
+        # moments in that dtype (bf16 halves optimizer-state HBM; update
+        # math stays f32); everything else keeps the param dtype
+        md = getattr(self, "_moment_dtype", None)
+        if md is not None:
+            return {name: jnp.zeros(param.shape, md)
+                    for name in self._state_names}
         return {name: jnp.zeros_like(param) for name in self._state_names}
+
+    @staticmethod
+    def _resolve_moment_dtype(moment_dtype):
+        """Normalize a user moment_dtype (None -> f32) once, in __init__."""
+        return jnp.dtype(moment_dtype if moment_dtype is not None
+                         else jnp.float32)
 
     def _update(self, param, grad, slots, lr, step):
         """Pure: (param, grad, slots, lr, step) -> (new_param, new_slots)."""
@@ -366,7 +379,7 @@ class Adam(Optimizer):
         # config on a 16 GB chip.
         super().__init__(learning_rate, parameters, weight_decay, grad_clip, name, multi_precision)
         self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
-        self._moment_dtype = jnp.dtype(moment_dtype if moment_dtype is not None else jnp.float32)
+        self._moment_dtype = self._resolve_moment_dtype(moment_dtype)
 
     def _hyper_key(self):
         return (self._wd_key, float(self._beta1), float(self._beta2), float(self._epsilon),
@@ -384,9 +397,6 @@ class Adam(Optimizer):
         new_p = param.astype(f32) - lr * m_hat / (jnp.sqrt(v_hat) + self._epsilon)
         md = self._moment_dtype
         return new_p.astype(param.dtype), {"moment1": m.astype(md), "moment2": v.astype(md)}
-
-    def _init_moments(self, param):
-        return {name: jnp.zeros(param.shape, self._moment_dtype) for name in self._state_names}
 
 
 class AdamW(Adam):
@@ -517,24 +527,28 @@ class Adamax(Optimizer):
 
 class Lamb(Optimizer):
     _state_names = ["moment1", "moment2"]
-    _hyper_names = ["_beta1", "_beta2", "_epsilon", "_lamb_weight_decay"]
+    _hyper_names = ["_beta1", "_beta2", "_epsilon", "_lamb_weight_decay",
+                    "_moment_dtype"]
 
     def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9, beta2=0.999, epsilon=1e-6,
                  parameters=None, grad_clip=None, exclude_from_weight_decay_fn=None,
-                 multi_precision=False, name=None):
+                 multi_precision=False, name=None, moment_dtype=None):
         super().__init__(learning_rate, parameters, None, grad_clip, name, multi_precision)
         self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
         self._lamb_weight_decay = lamb_weight_decay
+        self._exclude_fn = exclude_from_weight_decay_fn
+        self._moment_dtype = self._resolve_moment_dtype(moment_dtype)
 
     def _hyper_key(self):
-        return (0.0, float(self._beta1), float(self._beta2), float(self._epsilon), float(self._lamb_weight_decay))
+        return (0.0, float(self._beta1), float(self._beta2), float(self._epsilon), float(self._lamb_weight_decay),
+                str(self._moment_dtype))
 
     def _update(self, param, grad, slots, lr, step):
         f32 = jnp.float32
         g = grad.astype(f32)
         p32 = param.astype(f32)
-        m = self._beta1 * slots["moment1"] + (1 - self._beta1) * g
-        v = self._beta2 * slots["moment2"] + (1 - self._beta2) * jnp.square(g)
+        m = self._beta1 * slots["moment1"].astype(f32) + (1 - self._beta1) * g
+        v = self._beta2 * slots["moment2"].astype(f32) + (1 - self._beta2) * jnp.square(g)
         t = step.astype(f32)
         m_hat = m / (1 - self._beta1**t)
         v_hat = v / (1 - self._beta2**t)
@@ -543,7 +557,64 @@ class Lamb(Optimizer):
         r_norm = jnp.linalg.norm(r)
         trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
         new_p = p32 - lr * trust * r
-        return new_p.astype(param.dtype), {"moment1": m, "moment2": v}
+        md = self._moment_dtype
+        return new_p.astype(param.dtype), {"moment1": m.astype(md), "moment2": v.astype(md)}
+
+    # exclude_from_weight_decay_fn(parameter) -> True trains that param with
+    # wd=0 (ref:python/paddle/optimizer/lamb.py) — same split mechanics as
+    # LarsMomentum's name-list exclusion: the wd=0 variant is a different
+    # jit-cache key, so both compiled and eager paths honor it.
+    def _excluded_param(self, param_name):
+        if self._exclude_fn is None:
+            return None
+        for p in self._parameter_list or []:
+            if getattr(p, "name", None) == param_name:
+                return p if self._exclude_fn(p) else None
+        return None
+
+    def _update_for(self, param_name):
+        if self._excluded_param(param_name) is None:
+            return self._update
+
+        def upd_no_wd(param, grad, slots, lr, step):
+            saved = self._lamb_weight_decay
+            self._lamb_weight_decay = 0.0
+            try:
+                return self._update(param, grad, slots, lr, step)
+            finally:
+                self._lamb_weight_decay = saved
+
+        return upd_no_wd
+
+    def step(self):
+        if self._exclude_fn is None or self._parameter_list is None:
+            return super().step()
+        # clip FIRST over the full set (per-group clipping would change the
+        # global norm), then run each group under its own wd
+        all_params = self._parameter_list
+        clip = self._grad_clip
+        if clip is not None:
+            with_grad = [p for p in all_params
+                         if p.grad is not None and not p.stop_gradient]
+            if with_grad:
+                clipped = clip._clip_arrays([p.grad._data for p in with_grad])
+                for p, a in zip(with_grad, clipped):
+                    p.grad._data = a
+        wd = self._lamb_weight_decay
+        try:
+            self._grad_clip = None
+            self._parameter_list = [p for p in all_params
+                                    if not self._exclude_fn(p)]
+            super().step()
+            self._lamb_weight_decay = 0.0
+            self._parameter_list = [p for p in all_params
+                                    if self._exclude_fn(p)]
+            self._step_count -= 1
+            super().step()
+        finally:
+            self._lamb_weight_decay = wd
+            self._parameter_list = all_params
+            self._grad_clip = clip
 
 
 class LarsMomentum(Optimizer):

@@ -267,3 +267,58 @@ def test_adamw_bf16_moments_compiled_trainstep_converges():
     step = TrainStep(lambda a, b: lossf(net(a), b), opt, layers=net)
     losses = [float(step(x, y)) for _ in range(30)]
     assert losses[-1] < losses[0] * 0.25, losses[::10]
+
+
+def test_lamb_bf16_moments():
+    import jax.numpy as jnp
+    p = paddle.to_tensor(np.arange(1.0, 5.0, dtype=np.float32))
+    p.stop_gradient = False
+    opt = paddle.optimizer.Lamb(learning_rate=0.01, parameters=[p],
+                                moment_dtype="bfloat16")
+    for _ in range(3):
+        (p * paddle.to_tensor(np.ones(4, np.float32))).sum().backward()
+        opt.step()
+        opt.clear_grad()
+    slots = opt._accumulators[id(p)]
+    assert slots["moment1"].dtype == jnp.bfloat16
+    assert slots["moment2"].dtype == jnp.bfloat16
+    assert np.all(np.isfinite(p.numpy()))
+
+
+def test_lamb_exclude_from_weight_decay_fn():
+    """Excluded params (reference: exclude_from_weight_decay_fn(param) ->
+    True) must train with wd=0 in BOTH the eager and compiled paths: with a
+    zero gradient, a decayed param moves (trust-ratio * wd * p) while an
+    excluded one must stay exactly put."""
+    def build():
+        a = paddle.to_tensor(np.full(4, 2.0, np.float32)); a.stop_gradient = False
+        b = paddle.to_tensor(np.full(4, 2.0, np.float32)); b.stop_gradient = False
+        a.name, b.name = "decayed", "no_decay"
+        return a, b
+
+    # eager
+    a, b = build()
+    opt = paddle.optimizer.Lamb(learning_rate=0.1, lamb_weight_decay=0.1,
+                                parameters=[a, b],
+                                exclude_from_weight_decay_fn=lambda p: "no_decay" in p.name)
+    z = paddle.to_tensor(np.zeros(4, np.float32))
+    ((a * z).sum() + (b * z).sum()).backward()
+    opt.step()
+    assert not np.allclose(a.numpy(), 2.0), a.numpy()   # wd moved it
+    np.testing.assert_allclose(b.numpy(), 2.0)          # excluded: untouched
+
+    # compiled (functional path through apply_gradients/_update_for)
+    a2, b2 = build()
+    opt2 = paddle.optimizer.Lamb(learning_rate=0.1, lamb_weight_decay=0.1,
+                                 parameters=[a2, b2],
+                                 exclude_from_weight_decay_fn=lambda p: "no_decay" in p.name)
+    params = {"decayed": a2, "no_decay": b2}
+    state = opt2.init_state(params)
+    grads = {"decayed": z, "no_decay": z}
+    new_params, _ = opt2.apply_gradients(params, grads, state)
+    assert not np.allclose(np.asarray(new_params["decayed"]._data
+                                      if hasattr(new_params["decayed"], "_data")
+                                      else new_params["decayed"]), 2.0)
+    np.testing.assert_allclose(np.asarray(new_params["no_decay"]._data
+                                          if hasattr(new_params["no_decay"], "_data")
+                                          else new_params["no_decay"]), 2.0)
