@@ -97,8 +97,10 @@ define_flag("flash_use_tuned", True,
             "Adopt on-chip tuned block sizes (benches/FLASH_TUNED.json) "
             "when flash_block_q/_k sit at their 128 defaults. Set 0 to "
             "force the safe defaults even with a tune record present.")
-define_flag("flash_attention_min_seqlen", 4608,
-            "Route attention through the Pallas flash kernel only at kv "
-            "sequence length >= this (measured v5e break-even: XLA's fused "
-            "softmax attention wins below ~4-8k where the S^2 matrix still "
-            "fits HBM traffic budgets; flash wins 7x at 8k). 0 = always.")
+define_flag("flash_attention_min_seqlen", -1,
+            "Route attention through the Pallas flash kernel at kv "
+            "sequence length >= this. -1 (default) = auto: 1024 when "
+            "on-chip-tuned blocks exist for this chip (FLASH_TUNED.json; "
+            "tuned kernel measured faster than XLA at every seqlen >= 1k "
+            "on v5e), else 4608 (untuned kernel loses below ~4.6k). "
+            "0 = always flash.")

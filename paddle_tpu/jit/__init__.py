@@ -536,7 +536,7 @@ class TrainStep:
         new_params, new_slots = [], []
         for p_t, p_arr, g, slots in zip(train_params, param_arrays,
                                         grads, opt_state["slots"]):
-            upd = opt._update_for(getattr(p_t, "name", None))
+            upd = opt._update_for(getattr(p_t, "name", None), p_t)
             np_, ns_ = opt._apply_with_master(upd, p_arr, g, slots, lr, step)
             new_params.append(np_)
             new_slots.append(ns_)

@@ -46,20 +46,30 @@ def _sdpa_reference(q, k, v, *, scale, causal, dropout_p=0.0, key=None):
     return jnp.swapaxes(out, 1, 2)
 
 
-def _use_pallas(sk: int) -> bool:
-    """Backend + measured-profitability gate (both trace-static).
-
-    On-chip measurement (benches/flash_tpu_bench.py, v5e, bf16 fwd+bwd,
-    d=64): flash is 0.64x XLA's fused attention at s=1024, 0.80x at s=4096,
-    6.99x at s=8192 — blockwise streaming only pays once the materialized
-    S^2 matrix dominates HBM traffic. Route by kv length; the
-    FLAGS_flash_attention_min_seqlen knob re-tunes the break-even per chip
-    generation ("axon" is the tunneled TPU plugin in this environment)."""
-    if jax.default_backend() not in ("tpu", "axon"):
-        return False
+def _effective_min_seqlen(sk: int) -> int:
+    """Resolve the flash-routing threshold. FLAGS default -1 = auto:
+    with on-chip-tuned blocks (FLASH_TUNED.json for this chip) the kernel
+    measured FASTER than XLA at every seqlen >= 1024 (1.53x @1k, 1.97x
+    @2k, 3.26x @4k, 27x @8k — benches/flash_tpu_bench.py, v5e bf16
+    fwd+bwd d=64), so auto routes from 1024; with untuned 128-blocks the
+    kernel loses below ~4.6k (r4 measurement), so auto stays at 4608.
+    An explicit flag value always wins; 0 = always flash."""
     from ...core import flags
 
     thr = int(flags.flag("flash_attention_min_seqlen"))
+    if thr >= 0:
+        return thr
+    from ...ops.pallas_ops import _tuned_blocks
+
+    return 1024 if _tuned_blocks(sk) else 4608
+
+
+def _use_pallas(sk: int) -> bool:
+    """Backend + measured-profitability gate (both trace-static);
+    "axon" is the tunneled TPU plugin in this environment."""
+    if jax.default_backend() not in ("tpu", "axon"):
+        return False
+    thr = _effective_min_seqlen(sk)
     return thr == 0 or sk >= thr
 
 

@@ -107,9 +107,10 @@ class Optimizer:
         ns["master_weight"] = new_master
         return new_master.astype(param.dtype), ns
 
-    def _update_for(self, param_name):
-        """Per-parameter update fn, dispatched on the (static) name at trace
-        time — how name-conditional math (e.g. LARS weight-decay exclusion)
+    def _update_for(self, param_name, param=None):
+        """Per-parameter update fn, dispatched at trace time on the (static)
+        name — and, when the caller has it in hand, the parameter object
+        itself — how per-param math (LARS/Lamb weight-decay exclusion)
         reaches compiled paths that call the update directly (jit.TrainStep)."""
         return self._update
 
@@ -168,6 +169,54 @@ class Optimizer:
         """Hashable hyperparameters closed over by the jitted update."""
         return (self._wd_key,)
 
+    def _step_with_wd_exclusion(self, excluded, wd_attr):
+        """Eager step where ``excluded(param)`` params train with the
+        ``wd_attr`` decay set to 0 (a distinct jit-cache key per group).
+        Clip FIRST over the full gradient set — per-group clipping would
+        change the global norm ClipGradByGlobalNorm is defined over — and
+        restore the caller-visible ``p.grad`` values afterwards (logging
+        that reads grads after step() must not see clipped copies)."""
+        all_params = self._parameter_list
+        clip = self._grad_clip
+        saved_grads = []
+        if clip is not None:
+            with_grad = [p for p in all_params
+                         if p.grad is not None and not p.stop_gradient]
+            if with_grad:
+                saved_grads = [(p, p.grad._data) for p in with_grad]
+                clipped = clip._clip_arrays([p.grad._data for p in with_grad])
+                for p, a in zip(with_grad, clipped):
+                    p.grad._data = a
+        wd = getattr(self, wd_attr)
+        try:
+            self._grad_clip = None
+            self._parameter_list = [p for p in all_params if not excluded(p)]
+            Optimizer.step(self)
+            setattr(self, wd_attr, 0.0)
+            self._parameter_list = [p for p in all_params if excluded(p)]
+            self._step_count -= 1
+            Optimizer.step(self)
+        finally:
+            setattr(self, wd_attr, wd)
+            self._parameter_list = all_params
+            self._grad_clip = clip
+            for p, g in saved_grads:
+                p.grad._data = g
+
+    def _no_wd_update(self, wd_attr):
+        """Variant of ``_update`` that runs with ``wd_attr`` = 0 for the
+        duration of one (traced) call — the compiled-path twin of
+        _step_with_wd_exclusion's group split."""
+        def upd(param, grad, slots, lr, step):
+            saved = getattr(self, wd_attr)
+            setattr(self, wd_attr, 0.0)
+            try:
+                return self._update(param, grad, slots, lr, step)
+            finally:
+                setattr(self, wd_attr, saved)
+
+        return upd
+
     # ------------------------------------------------------ functional path
     def init_state(self, params: Dict[str, Tensor]):
         """Pytree of optimizer state for the functional/pjit path."""
@@ -195,7 +244,8 @@ class Optimizer:
                 new_params[name], new_slots[name] = p, state["slots"][name]
                 continue
             np_, ns_ = self._apply_with_master(
-                self._update_for(name), arr, garr, state["slots"][name], lr_v, step)
+                self._update_for(name, p), arr, garr, state["slots"][name],
+                lr_v, step)
             new_params[name] = Tensor(np_, stop_gradient=False) if isinstance(p, Tensor) else np_
             new_slots[name] = ns_
         return new_params, {"slots": new_slots, "step": step}
@@ -560,61 +610,32 @@ class Lamb(Optimizer):
         md = self._moment_dtype
         return new_p.astype(param.dtype), {"moment1": m.astype(md), "moment2": v.astype(md)}
 
-    # exclude_from_weight_decay_fn(parameter) -> True trains that param with
-    # wd=0 (ref:python/paddle/optimizer/lamb.py) — same split mechanics as
-    # LarsMomentum's name-list exclusion: the wd=0 variant is a different
-    # jit-cache key, so both compiled and eager paths honor it.
-    def _excluded_param(self, param_name):
+    # exclude_from_weight_decay_fn(parameter) -> True trains that param
+    # with wd=0 (ref:python/paddle/optimizer/lamb.py). Exclusion is decided
+    # on the PARAMETER OBJECT (the reference contract) — callers that have
+    # it in hand pass it to _update_for; a name-only legacy call refuses
+    # ambiguity loudly rather than decaying the wrong param silently.
+    def _update_for(self, param_name, param=None):
         if self._exclude_fn is None:
-            return None
-        for p in self._parameter_list or []:
-            if getattr(p, "name", None) == param_name:
-                return p if self._exclude_fn(p) else None
-        return None
-
-    def _update_for(self, param_name):
-        if self._excluded_param(param_name) is None:
             return self._update
-
-        def upd_no_wd(param, grad, slots, lr, step):
-            saved = self._lamb_weight_decay
-            self._lamb_weight_decay = 0.0
-            try:
-                return self._update(param, grad, slots, lr, step)
-            finally:
-                self._lamb_weight_decay = saved
-
-        return upd_no_wd
+        if param is None:
+            matches = [p for p in self._parameter_list or []
+                       if getattr(p, "name", None) == param_name]
+            if len(matches) > 1 and len({bool(self._exclude_fn(p))
+                                         for p in matches}) > 1:
+                raise ValueError(
+                    f"Lamb exclude_from_weight_decay_fn is ambiguous for "
+                    f"duplicated param name {param_name!r}; pass the "
+                    f"parameter object to _update_for")
+            param = matches[0] if matches else None
+        if param is None or not self._exclude_fn(param):
+            return self._update
+        return self._no_wd_update("_lamb_weight_decay")
 
     def step(self):
         if self._exclude_fn is None or self._parameter_list is None:
             return super().step()
-        # clip FIRST over the full set (per-group clipping would change the
-        # global norm), then run each group under its own wd
-        all_params = self._parameter_list
-        clip = self._grad_clip
-        if clip is not None:
-            with_grad = [p for p in all_params
-                         if p.grad is not None and not p.stop_gradient]
-            if with_grad:
-                clipped = clip._clip_arrays([p.grad._data for p in with_grad])
-                for p, a in zip(with_grad, clipped):
-                    p.grad._data = a
-        wd = self._lamb_weight_decay
-        try:
-            self._grad_clip = None
-            self._parameter_list = [p for p in all_params
-                                    if not self._exclude_fn(p)]
-            super().step()
-            self._lamb_weight_decay = 0.0
-            self._parameter_list = [p for p in all_params
-                                    if self._exclude_fn(p)]
-            self._step_count -= 1
-            super().step()
-        finally:
-            self._lamb_weight_decay = wd
-            self._parameter_list = all_params
-            self._grad_clip = clip
+        self._step_with_wd_exclusion(self._exclude_fn, "_lamb_weight_decay")
 
 
 class LarsMomentum(Optimizer):
@@ -678,51 +699,17 @@ class LarsMomentum(Optimizer):
     def _is_excluded(self, name: str) -> bool:
         return any(s in (name or "") for s in self._exclude_names)
 
-    def _update_for(self, param_name):
+    def _update_for(self, param_name, param=None):
         if not self._is_excluded(param_name):
             return self._update
-
-        def upd_no_wd(param, grad, slots, lr, step):
-            saved = self._lars_weight_decay
-            self._lars_weight_decay = 0.0
-            try:
-                return self._update(param, grad, slots, lr, step)
-            finally:
-                self._lars_weight_decay = saved
-
-        return upd_no_wd
+        return self._no_wd_update("_lars_weight_decay")
 
     def step(self):
         if not self._exclude_names or self._parameter_list is None:
             return super().step()
-        # excluded params update with wd=0 (a different jit-cache key):
-        # split the list and run the base step per group. Clip FIRST, over
-        # the full gradient set — per-group clipping would change the
-        # global norm ClipGradByGlobalNorm is defined over.
-        all_params = self._parameter_list
-        clip = self._grad_clip
-        if clip is not None:
-            with_grad = [p for p in all_params
-                         if p.grad is not None and not p.stop_gradient]
-            if with_grad:
-                clipped = clip._clip_arrays([p.grad._data for p in with_grad])
-                for p, a in zip(with_grad, clipped):
-                    p.grad._data = a
-        wd = self._lars_weight_decay
-        try:
-            self._grad_clip = None
-            self._parameter_list = [
-                p for p in all_params if not self._is_excluded(p.name)]
-            super().step()
-            self._lars_weight_decay = 0.0
-            self._parameter_list = [
-                p for p in all_params if self._is_excluded(p.name)]
-            self._step_count -= 1
-            super().step()
-        finally:
-            self._grad_clip = clip
-            self._lars_weight_decay = wd
-            self._parameter_list = all_params
+        self._step_with_wd_exclusion(
+            lambda p: self._is_excluded(getattr(p, "name", None)),
+            "_lars_weight_decay")
 
     def apply_gradients(self, params, grads, state, lr=None):
         if not self._exclude_names:

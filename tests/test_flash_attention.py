@@ -146,3 +146,37 @@ def test_tuned_blocks_loader_device_kind_gate(tmp_path, monkeypatch):
     path.write_text("[128, 128]")  # malformed: old/other format
     monkeypatch.setattr(po, "_TUNED_BLOCKS", None)
     assert po._tuned_blocks(4096) is None
+
+
+def test_effective_min_seqlen_auto(tmp_path, monkeypatch):
+    """FLAGS_flash_attention_min_seqlen=-1 (auto): 1024 with a tune record
+    for this chip, 4608 without; an explicit value always wins."""
+    import json
+
+    import jax
+
+    from paddle_tpu.core import flags
+    from paddle_tpu.nn.functional.attention import _effective_min_seqlen
+    from paddle_tpu.ops import pallas_ops as po
+
+    kind = getattr(jax.devices()[0], "device_kind", "")
+    path = tmp_path / "FLASH_TUNED.json"
+    monkeypatch.setattr(po, "_TUNED_PATH", str(path))
+    old = flags.flag("flash_attention_min_seqlen")
+    try:
+        flags.set_flags({"flash_attention_min_seqlen": -1})
+        # no tune record -> conservative untuned break-even
+        monkeypatch.setattr(po, "_TUNED_BLOCKS", None)
+        assert _effective_min_seqlen(2048) == 4608
+        # record for this chip covering the seq -> tuned break-even
+        path.write_text(json.dumps(
+            {"device_kind": kind, "blocks": {"1024": [512, 512]}}))
+        monkeypatch.setattr(po, "_TUNED_BLOCKS", None)
+        assert _effective_min_seqlen(2048) == 1024
+        # explicit flag wins over auto
+        flags.set_flags({"flash_attention_min_seqlen": 9999})
+        assert _effective_min_seqlen(2048) == 9999
+        flags.set_flags({"flash_attention_min_seqlen": 0})
+        assert _effective_min_seqlen(2048) == 0
+    finally:
+        flags.set_flags({"flash_attention_min_seqlen": old})
