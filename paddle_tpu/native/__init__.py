@@ -150,6 +150,9 @@ def _declare(lib):
 
     lib.pt_infer_create.restype = c.c_void_p
     lib.pt_infer_create.argtypes = [c.c_char_p, c.c_char_p]
+    lib.pt_infer_create_with_options.restype = c.c_void_p
+    lib.pt_infer_create_with_options.argtypes = [c.c_char_p, c.c_char_p,
+                                                 c.c_char_p]
     lib.pt_infer_last_error.restype = c.c_char_p
     lib.pt_infer_last_error.argtypes = []
     lib.pt_infer_destroy.argtypes = [c.c_void_p]

@@ -13,6 +13,10 @@
 // user C/C++ applications linking libpaddle_tpu_native.so):
 //
 //   PTInfer* pt_infer_create(plugin_so_path, artifact_path)
+//   PTInfer* pt_infer_create_with_options(plugin_so_path, artifact_path,
+//       "k=v;k=v")  // PJRT_Client_Create NamedValues; values may be
+//       // type-tagged "i:<int>" / "s:<str>" (untagged: digits->int64).
+//       // pt_infer_create reads PADDLE_TPU_PJRT_CREATE_OPTIONS instead.
 //   const char* pt_infer_last_error()
 //   int  pt_infer_input_count / pt_infer_output_count
 //   int  pt_infer_input_spec / pt_infer_output_spec (dims/ndim/dtype out)
@@ -29,10 +33,14 @@
 //   outputs := u32 n | { u16 nlen | name | u8 dtype | u8 ndim | i64 dims[] }
 
 #include <dlfcn.h>
+#include <cctype>
+#include <cerrno>
 #include <cstdint>
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <string>
+#include <utility>
 #include <vector>
 
 #include "third_party/pjrt_c_api.h"
@@ -307,7 +315,19 @@ void pt_infer_destroy(PTInfer* h) {
   delete h;
 }
 
+PTInfer* pt_infer_create_with_options(const char* plugin_path,
+                                      const char* artifact_path,
+                                      const char* create_options);
+
 PTInfer* pt_infer_create(const char* plugin_path, const char* artifact_path) {
+  // back-compat / pure-C convenience: options come from the environment
+  return pt_infer_create_with_options(
+      plugin_path, artifact_path, getenv("PADDLE_TPU_PJRT_CREATE_OPTIONS"));
+}
+
+PTInfer* pt_infer_create_with_options(const char* plugin_path,
+                                      const char* artifact_path,
+                                      const char* create_options) {
   auto* h = new PTInfer();
   if (!load_artifact(artifact_path, &h->art)) {
     delete h;
@@ -346,6 +366,81 @@ PTInfer* pt_infer_create(const char* plugin_path, const char* artifact_path) {
   PJRT_Client_Create_Args cc;
   memset(&cc, 0, sizeof(cc));
   cc.struct_size = PJRT_Client_Create_Args_STRUCT_SIZE;
+  // Plugin-specific client options from PADDLE_TPU_PJRT_CREATE_OPTIONS
+  // ("k=v;k=v"; integer-looking values become kInt64, the rest kString).
+  // Some plugins hard-require NamedValues at create time — the tunneled
+  // axon TPU plugin rejects a bare create with "missing NamedValue args"
+  // (it needs remote_compile/topology/session_id/... exactly as the jax
+  // registration path passes them).
+  std::vector<std::pair<std::string, std::string>> kvs;  // parsed pairs
+  std::vector<PJRT_NamedValue> nvs;
+  if (create_options != nullptr && create_options[0] != '\0') {
+    std::string all(create_options);
+    size_t pos = 0;
+    while (pos < all.size()) {
+      size_t semi = all.find(';', pos);
+      if (semi == std::string::npos) semi = all.size();
+      std::string pair = all.substr(pos, semi - pos);
+      pos = semi + 1;
+      size_t eq = pair.find('=');
+      if (eq == std::string::npos || eq == 0) continue;
+      kvs.emplace_back(pair.substr(0, eq), pair.substr(eq + 1));
+    }
+    // build after parsing: kvs is stable now, so the NamedValues' name /
+    // string_value pointers stay valid through PJRT_Client_Create
+    for (auto& kv : kvs) {
+      const std::string& key = kv.first;
+      std::string& val = kv.second;
+      PJRT_NamedValue nv;
+      memset(&nv, 0, sizeof(nv));
+      nv.struct_size = PJRT_NamedValue_STRUCT_SIZE;
+      nv.name = key.c_str();
+      nv.name_size = key.size();
+      // Values may carry an explicit type tag ("i:123" / "s:abc") — the
+      // Python wrapper always emits tags so a digit-only STRING option is
+      // never mis-typed. Untagged values (hand-written env) are guessed:
+      // all-digits -> kInt64, else kString.
+      bool forced_int = false, forced_str = false;
+      if (val.size() >= 2 && val[1] == ':' &&
+          (val[0] == 'i' || val[0] == 's')) {
+        forced_int = val[0] == 'i';
+        forced_str = val[0] == 's';
+        val.erase(0, 2);
+      }
+      bool is_int = forced_int;
+      if (!forced_int && !forced_str && !val.empty()) {
+        is_int = true;
+        for (size_t i = 0; i < val.size(); ++i) {
+          if (!(isdigit(static_cast<unsigned char>(val[i])) ||
+                (i == 0 && val[i] == '-' && val.size() > 1))) {
+            is_int = false;
+            break;
+          }
+        }
+      }
+      if (is_int) {
+        errno = 0;
+        char* endp = nullptr;
+        long long parsed = strtoll(val.c_str(), &endp, 10);
+        if (errno == ERANGE || endp == val.c_str() || *endp != '\0') {
+          set_err("create option '" + key + "' has out-of-range or "
+                  "non-integer value '" + val + "'");
+          pt_infer_destroy(h);
+          return nullptr;
+        }
+        nv.type = PJRT_NamedValue_kInt64;
+        nv.int64_value = static_cast<int64_t>(parsed);
+        nv.value_size = 1;
+      } else {
+        nv.type = PJRT_NamedValue_kString;
+        nv.string_value = val.c_str();
+        nv.value_size = val.size();
+      }
+      nvs.push_back(nv);
+    }
+    cc.create_options = nvs.data();
+    cc.num_options = nvs.size();
+  }
   if (take_err(h->api, h->api->PJRT_Client_Create(&cc), "PJRT_Client_Create")) {
     pt_infer_destroy(h);
     return nullptr;

@@ -12,6 +12,8 @@
 // NOT part of libpaddle_tpu_native.so.
 
 #include <cstdint>
+#include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <string>
 #include <vector>
@@ -58,6 +60,27 @@ PJRT_Error* event_destroy(PJRT_Event_Destroy_Args*) {
 }
 
 PJRT_Error* client_create(PJRT_Client_Create_Args* a) {
+  // Contract check for the runner's create-options plumbing: when the
+  // harness sets FAKE_PJRT_DUMP_OPTIONS, record the NamedValues this
+  // create received so tests can assert they arrived typed correctly.
+  const char* dump = getenv("FAKE_PJRT_DUMP_OPTIONS");
+  if (dump != nullptr && dump[0] != '\0') {
+    FILE* f = fopen(dump, "w");
+    if (f != nullptr) {
+      for (size_t i = 0; i < a->num_options; ++i) {
+        const PJRT_NamedValue& nv = a->create_options[i];
+        if (nv.type == PJRT_NamedValue_kInt64) {
+          fprintf(f, "%.*s=i:%lld\n", static_cast<int>(nv.name_size),
+                  nv.name, static_cast<long long>(nv.int64_value));
+        } else if (nv.type == PJRT_NamedValue_kString) {
+          fprintf(f, "%.*s=s:%.*s\n", static_cast<int>(nv.name_size),
+                  nv.name, static_cast<int>(nv.value_size),
+                  nv.string_value);
+        }
+      }
+      fclose(f);
+    }
+  }
   auto* c = new FakeClient();
   c->devices.push_back(reinterpret_cast<PJRT_Device*>(&c->device_marker));
   a->client = reinterpret_cast<PJRT_Client*>(c);

@@ -210,18 +210,69 @@ def default_plugin_path() -> Optional[str]:
         return None
 
 
+def axon_client_create_options() -> dict:
+    """PJRT_Client_Create NamedValues for the tunneled axon TPU plugin,
+    mirroring what the jax registration path passes (axon.register.pjrt
+    _register_backend): the plugin refuses a bare create ("missing
+    NamedValue args"). remote_compile follows PALLAS_AXON_REMOTE_COMPILE;
+    topology follows PALLAS_AXON_TPU_GEN at single-chip shape; rank is the
+    monoclient sentinel (u32::MAX); session_id must be fresh per client
+    (it keys the terminal's session lock)."""
+    import uuid
+
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
+    return {
+        "remote_compile": 1 if os.environ.get(
+            "PALLAS_AXON_REMOTE_COMPILE") == "1" else 0,
+        "local_only": 0,
+        "priority": 0,
+        "topology": f"{gen}:1x1x1",
+        "n_slices": 1,
+        "rank": 0xFFFF_FFFF,
+        "session_id": str(uuid.uuid4()),
+    }
+
+
 class NativePredictor:
     """Python handle over the C++ PJRT runner — the same code path a C/C++
     application gets by linking libpaddle_tpu_native.so directly."""
 
-    def __init__(self, artifact_path: str, plugin_path: Optional[str] = None):
+    def __init__(self, artifact_path: str, plugin_path: Optional[str] = None,
+                 create_options: Optional[dict] = None):
         self._l = _lib()
         plugin = plugin_path or default_plugin_path()
         if plugin is None:
             raise RuntimeError(
                 "no PJRT plugin found; set PADDLE_TPU_PJRT_PLUGIN")
-        self._h = self._l.pt_infer_create(plugin.encode(),
-                                          artifact_path.encode())
+        # create_options: plugin-specific PJRT_Client_Create NamedValues
+        # ({str: str|int}). Serialized TYPE-TAGGED ("i:<int>" / "s:<str>")
+        # into pt_infer_create_with_options — the Python type decides the
+        # NamedValue type, so a digit-only STRING option (e.g. a numeric
+        # session_id) stays kString, and no process-global env var is
+        # mutated (thread-safe). The axon TPU plugin REQUIRES these
+        # (remote_compile/topology/session_id/...; see
+        # axon_client_create_options()); libtpu needs none. Pure-C users
+        # without this entry point can export
+        # PADDLE_TPU_PJRT_CREATE_OPTIONS instead (guess-typed).
+        if create_options:
+            parts = []
+            for k, v in create_options.items():
+                if ";" in str(k) or "=" in str(k) or ";" in str(v):
+                    raise ValueError(
+                        f"create_options key/value may not contain ';' or "
+                        f"'=': {k!r}={v!r}")
+                # bools ride as ints (PJRT plugins read 0/1 Int64 knobs;
+                # jax does the same for axon's remote_compile/local_only)
+                tag = "i" if isinstance(v, (int, bool)) else "s"
+                parts.append(f"{k}={tag}:{int(v) if tag == 'i' else v}")
+            self._h = self._l.pt_infer_create_with_options(
+                plugin.encode(), artifact_path.encode(),
+                ";".join(parts).encode())
+        else:
+            # no explicit options: plain create (its env-var fallback keeps
+            # working for callers that exported PADDLE_TPU_PJRT_CREATE_OPTIONS)
+            self._h = self._l.pt_infer_create(plugin.encode(),
+                                              artifact_path.encode())
         if not self._h:
             raise RuntimeError("pt_infer_create failed: "
                                + self._l.pt_infer_last_error().decode())

@@ -125,7 +125,11 @@ def _tpu_plugin():
                            "(PADDLE_TPU_NATIVE_TPU_TEST=1)")
 def test_native_predictor_real_plugin(artifact):
     path, w = artifact
-    pred = pdnative.NativePredictor(path + ".pdnative", _tpu_plugin())
+    plugin = _tpu_plugin()
+    opts = (pdnative.axon_client_create_options()
+            if "axon" in os.path.basename(plugin) else None)
+    pred = pdnative.NativePredictor(path + ".pdnative", plugin,
+                                    create_options=opts)
     try:
         x = np.random.default_rng(1).standard_normal((2, 8)).astype(np.float32)
         (y,) = pred.run(x)
@@ -170,3 +174,46 @@ def test_gpt_exports_tpu_pdnative(tmp_path):
         m.state_dict())
     (out,) = art["outputs"]
     assert out.shape == (2, 16, m.cfg.vocab_size)
+
+
+def test_create_options_reach_plugin(artifact, tmp_path, monkeypatch):
+    """create_options must arrive at PJRT_Client_Create as typed
+    NamedValues, with the PYTHON type deciding the NamedValue type — a
+    digit-only string option must stay kString (the axon plugin rejects
+    mistyped values)."""
+    path, _ = artifact
+    dump = tmp_path / "opts.txt"
+    monkeypatch.setenv("FAKE_PJRT_DUMP_OPTIONS", str(dump))
+    pred = pdnative.NativePredictor(
+        path + ".pdnative", pdnative.build_fake_plugin(),
+        create_options={"remote_compile": True, "topology": "v5e:1x1x1",
+                        "rank": 0xFFFF_FFFF, "session_id": "12345"})
+    pred.close()
+    got = dict(l.split("=", 1) for l in dump.read_text().splitlines())
+    assert got["remote_compile"] == "i:1"
+    assert got["topology"] == "s:v5e:1x1x1"
+    assert got["rank"] == f"i:{0xFFFF_FFFF}"
+    assert got["session_id"] == "s:12345"  # digits, but typed str in Python
+
+
+def test_create_options_env_fallback_and_overflow(artifact, tmp_path,
+                                                  monkeypatch):
+    """pt_infer_create (no explicit options) honors the env var with
+    guess-typing; an out-of-range integer fails loudly instead of being
+    silently clamped."""
+    path, _ = artifact
+    dump = tmp_path / "opts.txt"
+    monkeypatch.setenv("FAKE_PJRT_DUMP_OPTIONS", str(dump))
+    monkeypatch.setenv("PADDLE_TPU_PJRT_CREATE_OPTIONS",
+                       "priority=3;name=svc")
+    pred = pdnative.NativePredictor(path + ".pdnative",
+                                    pdnative.build_fake_plugin())
+    pred.close()
+    got = dict(l.split("=", 1) for l in dump.read_text().splitlines())
+    assert got["priority"] == "i:3"
+    assert got["name"] == "s:svc"
+    monkeypatch.setenv("PADDLE_TPU_PJRT_CREATE_OPTIONS",
+                       "rank=99999999999999999999999")
+    with pytest.raises(RuntimeError, match="out-of-range"):
+        pdnative.NativePredictor(path + ".pdnative",
+                                 pdnative.build_fake_plugin())
