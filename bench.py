@@ -276,14 +276,18 @@ def main():
             print(f"# compile attempt {attempt + 1} hit transient tunnel "
                   f"error, retrying: {str(e)[:160]}", flush=True)
             time.sleep(10 * (attempt + 1))
+    from benches import _common
+
+    _sync = _common.sync  # host-read barrier; see _common.sync docstring
+
     for _ in range(warmup - 1):
         loss = step(x, y)
-    jax.block_until_ready(loss._data)
+    _sync(loss)
 
     t0 = time.perf_counter()
     for _ in range(iters):
         loss = step(x, y)
-    jax.block_until_ready(loss._data)
+    _sync(loss)
     dt = time.perf_counter() - t0
 
     samples_per_sec = batch * iters / dt

@@ -37,7 +37,7 @@ def _timed(step, args, warmup=2, iters=8):
 
     t0 = time.perf_counter()
     loss = step(*args)
-    jax.block_until_ready(loss._data if hasattr(loss, "_data") else loss)
+    _common.sync(loss)
     compile_s = time.perf_counter() - t0
     for _ in range(warmup - 1):
         loss = step(*args)

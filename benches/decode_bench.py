@@ -18,7 +18,7 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 ".."))
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-import _common  # noqa: E402,F401 — enables the persistent compile cache
+import _common  # noqa: E402,F401 — compile cache + sync()
 
 
 def main():
@@ -47,22 +47,31 @@ def main():
                               dtype=np.int32))
 
     out = model.generate(ids, max_new_tokens=new)  # compile + warm
-    jax.block_until_ready(out._data)
+    _common.sync(out)
+    # distinct prompts per iteration: an identical (program, inputs)
+    # execution can be served from the tunnel relay's replay cache,
+    # which faked this bench at 200x under the HBM floor
+    prompts = [Tensor(rng.integers(0, cfg.vocab_size, (batch, prompt),
+                                   dtype=np.int32)) for _ in range(iters)]
     t0 = time.perf_counter()
-    for _ in range(iters):
-        out = model.generate(ids, max_new_tokens=new)
-    jax.block_until_ready(out._data)
+    for p in prompts:
+        out = model.generate(p, max_new_tokens=new)
+    _common.sync(out)
     dt = time.perf_counter() - t0
 
     # prefill share: a 1-new-token generate is prefill + one decode step.
     # Measured after the main loop (own warmup) so its compilation doesn't
     # perturb the headline timing.
     p1 = model.generate(ids, max_new_tokens=1)
-    jax.block_until_ready(p1._data)
+    _common.sync(p1)
+    # fresh prompts: the main loop already executed the prefill program
+    # on `prompts`, so reusing them would leave dt_prefill replay-servable
+    prompts2 = [Tensor(rng.integers(0, cfg.vocab_size, (batch, prompt),
+                                    dtype=np.int32)) for _ in range(iters)]
     t0 = time.perf_counter()
-    for _ in range(iters):
-        p1 = model.generate(ids, max_new_tokens=1)
-    jax.block_until_ready(p1._data)
+    for p in prompts2:
+        p1 = model.generate(p, max_new_tokens=1)
+    _common.sync(p1)
     dt_prefill = time.perf_counter() - t0
 
     toks = batch * new * iters

@@ -30,6 +30,7 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+import _common  # noqa: E402
 from _common import emit  # noqa: E402
 
 from paddle_tpu.ops import pallas_ops as po  # noqa: E402
@@ -110,11 +111,17 @@ def _time_fwd_bwd(fn, q, k, v, iters=20):
 
     step = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
     g = step(q, k, v)
-    jax.block_until_ready(g)
+    _common.sync(g)
+    # UNIQUE inputs per iteration: the tunnel relay can serve an identical
+    # (program, inputs) execution from its record/replay cache, which
+    # fakes the timing; a per-iter scale (25 MB of extra HBM traffic vs
+    # the multi-GB attention) defeats that without changing the workload
+    qs = [q * (1.0 + 1e-6 * (i + 1)) for i in range(iters)]
+    _common.sync(qs[-1])
     t0 = time.time()
-    for _ in range(iters):
-        g = step(q, k, v)
-    jax.block_until_ready(g)
+    for qi in qs:
+        g = step(qi, k, v)
+    _common.sync(g)
     return (time.time() - t0) / iters
 
 

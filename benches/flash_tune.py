@@ -23,6 +23,7 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+import _common  # noqa: E402
 from _common import emit  # noqa: E402
 
 from paddle_tpu.ops import pallas_ops as po  # noqa: E402
@@ -45,11 +46,15 @@ def _watchdog(limit_s: float):
 def _time_step(step, q, k, v, iters=10):
     """Time an ALREADY-COMPILED fwd+bwd step (the numerics check's first
     call pays the compile; never compile the same program twice against
-    the watchdog budget)."""
+    the watchdog budget). Inputs are made unique per iteration — the
+    tunnel relay can replay an identical (program, inputs) execution from
+    cache, faking the timing."""
+    qs = [q * (1.0 + 1e-6 * (i + 1)) for i in range(iters)]
+    _common.sync(qs[-1])
     t0 = time.time()
-    for _ in range(iters):
-        g = step(q, k, v)
-    jax.block_until_ready(g)
+    for qi in qs:
+        g = step(qi, k, v)
+    _common.sync(g)
     return (time.time() - t0) / iters
 
 
@@ -99,7 +104,7 @@ def main():
 
                 step = jax.jit(jax.grad(_loss, argnums=(0, 1, 2)))
                 grads = step(q, k, v)  # compiles once; timed below as-is
-                jax.block_until_ready(grads)
+                _common.sync(grads)
                 gerr = max(float(jnp.max(jnp.abs(
                     g.astype(jnp.float32) - rg.astype(jnp.float32))))
                     for g, rg in zip(grads, ref_grads))

@@ -18,6 +18,7 @@ import time
 import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from benches import _common  # noqa: E402
 from benches._common import emit  # noqa: E402
 
 # always the 8-virtual-device CPU mesh: this bench compares SCHEDULES on a
@@ -56,11 +57,11 @@ def _apply(ws, h):
 
 def _time(fn, *args, iters=8, warmup=2):
     for _ in range(warmup):
-        jax.block_until_ready(fn(*args))
+        _common.sync(fn(*args))
     ts = []
     for _ in range(iters):
         t0 = time.perf_counter()
-        jax.block_until_ready(fn(*args))
+        _common.sync(fn(*args))
         ts.append(time.perf_counter() - t0)
     return float(np.median(ts))
 
