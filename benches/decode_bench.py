@@ -42,6 +42,16 @@ def main():
 
     model = GPTForCausalLM(cfg)
     model.eval()
+    # serving dtype: bf16 weights halve the per-step HBM read that bounds
+    # autoregressive decode (the TPU deployment default); DECODE_DTYPE=
+    # float32 restores full precision
+    dtype = os.environ.get("DECODE_DTYPE",
+                           "bfloat16" if platform == "tpu" else "float32")
+    if dtype not in ("bfloat16", "float32"):
+        raise SystemExit(f"DECODE_DTYPE must be bfloat16|float32, got "
+                         f"{dtype!r}")
+    if dtype == "bfloat16":
+        model.bfloat16()
     rng = np.random.default_rng(0)
     ids = Tensor(rng.integers(0, cfg.vocab_size, (batch, prompt),
                               dtype=np.int32))
@@ -82,7 +92,8 @@ def main():
                    if decode_dt > 0.05 * dt else None)
     rec = {
         "metric": f"decode tokens/sec (GPT {cfg.hidden_size}h/"
-                  f"{cfg.num_layers}L b{batch} p{prompt}+{new} {platform})",
+                  f"{cfg.num_layers}L b{batch} p{prompt}+{new} "
+                  f"{dtype} {platform})",
         "value": round(toks / dt, 1),
         "unit": "tokens/sec",
         "ms_per_token": round(dt / toks * 1e3, 3),

@@ -502,9 +502,15 @@ class GPTForCausalLM(nn.Layer):
             def decode_cached(param_arrays, start_ids, key):
                 with _swap_data(objs, list(param_arrays)):
                     with prng.key_guard(jax.random.key(0)):
+                        # cache dtype follows the weights: a bf16-cast
+                        # model (serving mode) must not re-upcast its KV
+                        # cache, and dynamic_update_slice requires exact
+                        # dtype match with the produced k/v
+                        wq = self.gpt.layers[0].attn.qkv.weight._data.dtype
                         caches0 = [
                             (c[0]._data, c[1]._data)
-                            for c in self.gpt.gen_kv_caches(b, total)]
+                            for c in self.gpt.gen_kv_caches(
+                                b, total, dtype=str(wq))]
                         # prefill the prompt in one pass
                         h, caches = self.gpt(
                             Tensor(start_ids),
