@@ -41,22 +41,43 @@ def main():
     dev = jax.devices()[0]
     x = paddle.to_tensor(np.random.rand(256, 256).astype(np.float32))
     y = paddle.to_tensor(np.random.rand(256, 256).astype(np.float32))
+    # unique inputs per iteration: through the tunneled backend an
+    # identical (program, inputs) execution can be served from the
+    # relay's replay cache; the host-side dispatch being measured is
+    # identical either way, but the device part must be real too
+    n = 200
+    xs = [paddle.to_tensor(np.random.rand(256, 256).astype(np.float32))
+          for _ in range(n)]
+    # materialize every input on device BEFORE timing: the first op over a
+    # lazily-uploaded tensor would otherwise absorb 200 H2D transfers into
+    # whichever op runs first (observed: "add" at 10.4 ms/op on TPU)
+    for t in xs:
+        t._data = jax.device_put(t._data)
+    jax.block_until_ready([t._data for t in xs])
 
     ops = {
-        "add": lambda: paddle.add(x, y),
-        "matmul": lambda: paddle.matmul(x, y),
-        "relu": lambda: paddle.nn.functional.relu(x),
-        "sum": lambda: paddle.sum(x),
-        "transpose": lambda: paddle.transpose(x, [1, 0]),
+        "add": lambda xi: paddle.add(xi, y),
+        "matmul": lambda xi: paddle.matmul(xi, y),
+        "relu": lambda xi: paddle.nn.functional.relu(xi),
+        "sum": lambda xi: paddle.sum(xi),
+        "transpose": lambda xi: paddle.transpose(xi, [1, 0]),
     }
 
     results = {}
+    first = True
     for name, f in ops.items():
-        f()  # compile/cache
-        n = 200
+        f(x)  # compile/cache
+        if first:
+            # one untimed pass: the first sustained burst after session
+            # start pays a relay ramp-up (~10 ms/op observed) that is not
+            # steady-state dispatch; prime it off the clock
+            for xi in xs:
+                out = f(xi)
+            np.asarray(out._data if hasattr(out, "_data") else out)
+            first = False
         t0 = time.perf_counter()
-        for _ in range(n):
-            out = f()
+        for xi in xs:
+            out = f(xi)
         np.asarray(out._data if hasattr(out, "_data") else out)
         results[name] = (time.perf_counter() - t0) / n * 1e6  # µs/op
 
@@ -73,12 +94,12 @@ def main():
         "transpose": jax.jit(lambda a, b: a.T),
     }
     raw = {}
+    xds = [t._data for t in xs]
     for name, f in raw_ops.items():
         f(x._data, y._data)
-        n = 200
         t0 = time.perf_counter()
-        for _ in range(n):
-            out = f(x._data, y._data)
+        for xd in xds:
+            out = f(xd, y._data)
         np.asarray(out)
         raw[name] = (time.perf_counter() - t0) / n * 1e6
     overhead = {k: max(results[k] - raw[k], 0.0) for k in results}
@@ -94,10 +115,9 @@ def main():
 
     cf = jax.jit(chain)
     cf(x._data, y._data)
-    n = 200
     t0 = time.perf_counter()
-    for _ in range(n):
-        out = cf(x._data, y._data)
+    for xd in xds:
+        out = cf(xd, y._data)
     np.asarray(out)
     compiled_us = (time.perf_counter() - t0) / n * 1e6
 
