@@ -22,72 +22,33 @@ OUT = os.path.join(HERE, "SWEEP_RESULTS.jsonl")
 # raises FLOPs-per-HBM-byte toward the reference's GPT-1.3B headline): if
 # the tunnel dies mid-sweep the best candidates are already recorded
 POINTS = [
-    # HLO_CONFIG_SWEEP.md projects 0.41 MFU for 2048h/16L b8 O2 chunk1024 —
-    # the only config over the 0.35 bar (arithmetic intensity finally beats
-    # the HBM floor); the remat variant is the fallback if ~18GB of
-    # activations+state OOMs the 16GB chip
-    # BENCH_SCAN=1 first: the scanned decoder compiles in roughly
-    # 1-layer time (vs 16 inlined copies), so the point most likely to
-    # survive a short tunnel window is the scan variant — round 4's sweep
-    # died on exactly this point's cold compile. The unrolled variant
-    # follows to reclaim the ~1% stack-copy overhead if the window holds.
-    {"BENCH_HIDDEN": "2048", "BENCH_LAYERS": "16", "BENCH_BATCH": "8",
-     "BENCH_REMAT": "0", "BENCH_CHUNK_LOSS": "1024", "BENCH_AMP": "O2",
-     "BENCH_SCAN": "1"},
-    {"BENCH_HIDDEN": "2048", "BENCH_LAYERS": "16", "BENCH_BATCH": "8",
-     "BENCH_REMAT": "0", "BENCH_CHUNK_LOSS": "1024", "BENCH_AMP": "O2",
-     "BENCH_SCAN": "0"},
-    {"BENCH_HIDDEN": "2048", "BENCH_LAYERS": "16", "BENCH_BATCH": "8",
+    # Measured r5 frontier first (SWEEP_RESULTS.jsonl, platform: tpu, all
+    # replay-proof): a fresh sweep revalidates the standing winners before
+    # exploring. All full-remat + bf16 moments + O2 + chunked loss,
+    # unrolled (scan's stacked-params copy pushes >=1B configs over HBM).
+    {"BENCH_HIDDEN": "3584", "BENCH_LAYERS": "6", "BENCH_BATCH": "24",
      "BENCH_REMAT": "1", "BENCH_CHUNK_LOSS": "1024", "BENCH_AMP": "O2",
-     "BENCH_SCAN": "1"},
+     "BENCH_SCAN": "0", "BENCH_MOMENT_DTYPE": "bfloat16"},  # MFU 0.5031
+    {"BENCH_HIDDEN": "4096", "BENCH_LAYERS": "5", "BENCH_BATCH": "16",
+     "BENCH_REMAT": "1", "BENCH_CHUNK_LOSS": "1024", "BENCH_AMP": "O2",
+     "BENCH_SCAN": "0", "BENCH_MOMENT_DTYPE": "bfloat16"},  # MFU 0.5017
+    {"BENCH_HIDDEN": "3072", "BENCH_LAYERS": "8", "BENCH_BATCH": "24",
+     "BENCH_REMAT": "1", "BENCH_CHUNK_LOSS": "1024", "BENCH_AMP": "O2",
+     "BENCH_SCAN": "0", "BENCH_MOMENT_DTYPE": "bfloat16"},  # MFU 0.4808
+    # 1.07B GPT-1.3B-class design point (the reference headline scale)
+    {"BENCH_HIDDEN": "2560", "BENCH_LAYERS": "12", "BENCH_BATCH": "16",
+     "BENCH_REMAT": "1", "BENCH_CHUNK_LOSS": "1024", "BENCH_AMP": "O2",
+     "BENCH_SCAN": "0", "BENCH_MOMENT_DTYPE": "bfloat16"},  # MFU 0.4183
+    # core_attn regime check: wins at 2048h, inverts under HBM pressure
     {"BENCH_HIDDEN": "2048", "BENCH_LAYERS": "16", "BENCH_BATCH": "8",
-     "BENCH_REMAT": "1", "BENCH_CHUNK_LOSS": "1024", "BENCH_AMP": "O2",
-     "BENCH_SCAN": "0"},
-    # scanned variants of the other high-intensity configs next: at ~3 min
-    # compile each (vs ~15 unrolled) one modest window banks the whole
-    # large-h frontier before any unrolled point would have finished
-    {"BENCH_HIDDEN": "1536", "BENCH_LAYERS": "24", "BENCH_BATCH": "8",
-     "BENCH_REMAT": "0", "BENCH_CHUNK_LOSS": "1024", "BENCH_AMP": "O2",
-     "BENCH_SCAN": "1"},
-    # 807M at b16+remat: remat frees the activation HBM that b8 no-remat
-    # spends, letting batch double — more FLOPs per weight-pass if the
-    # recompute overhead stays under ~20% (1.07B-param 2560h configs are
-    # out: Adam f32 state alone exceeds the 16GB chip)
-    {"BENCH_HIDDEN": "2048", "BENCH_LAYERS": "16", "BENCH_BATCH": "16",
-     "BENCH_REMAT": "1", "BENCH_CHUNK_LOSS": "1024", "BENCH_AMP": "O2",
-     "BENCH_SCAN": "1"},
-    {"BENCH_HIDDEN": "1536", "BENCH_LAYERS": "24", "BENCH_BATCH": "8",
-     "BENCH_REMAT": "0", "BENCH_CHUNK_LOSS": "1024", "BENCH_AMP": "O2",
-     "BENCH_SCAN": "0"},
-    # remaining points pin BENCH_SCAN=1 explicitly (bench.py's TPU default
-    # flipped to unrolled in r5): the ~1-2% strategy delta is inside
-    # sweep-ranking noise and every scanned compile is ~3x cheaper, so a
-    # window covers more of the grid
-    {"BENCH_BATCH": "32", "BENCH_REMAT": "0", "BENCH_CHUNK_LOSS": "1024",
-     "BENCH_SCAN": "1"},
-    {"BENCH_BATCH": "32", "BENCH_REMAT": "0", "BENCH_CHUNK_LOSS": "1024",
-     "BENCH_AMP": "O2", "BENCH_SCAN": "1"},
-    {"BENCH_HIDDEN": "1024", "BENCH_LAYERS": "24", "BENCH_BATCH": "16",
-     "BENCH_REMAT": "0", "BENCH_CHUNK_LOSS": "1024", "BENCH_AMP": "O2", "BENCH_SCAN": "1"},
-    {"BENCH_HIDDEN": "1024", "BENCH_LAYERS": "24", "BENCH_BATCH": "32",
-     "BENCH_REMAT": "1", "BENCH_CHUNK_LOSS": "1024", "BENCH_AMP": "O2", "BENCH_SCAN": "1"},
-    {"BENCH_BATCH": "64", "BENCH_REMAT": "0", "BENCH_CHUNK_LOSS": "1024", "BENCH_SCAN": "1"},
-    {"BENCH_BATCH": "32", "BENCH_REMAT": "0", "BENCH_SCAN": "1"},
-    {"BENCH_BATCH": "64", "BENCH_REMAT": "0", "BENCH_CHUNK_LOSS": "1024",
-     "BENCH_AMP": "O2", "BENCH_SCAN": "1"},
-    {"BENCH_HIDDEN": "1536", "BENCH_LAYERS": "24", "BENCH_BATCH": "16",
-     "BENCH_REMAT": "1", "BENCH_CHUNK_LOSS": "1024", "BENCH_AMP": "O2", "BENCH_SCAN": "1"},
-    {"BENCH_BATCH": "16", "BENCH_REMAT": "0", "BENCH_CHUNK_LOSS": "1024", "BENCH_SCAN": "1"},
-    {"BENCH_BATCH": "64", "BENCH_REMAT": "1", "BENCH_CHUNK_LOSS": "1024", "BENCH_SCAN": "1"},
-    # long-context point: s=8192 routes attention through the Pallas flash
-    # kernels (measured 6.99x over XLA there); remat keeps activations sane.
-    # Scan variant first (flash-in-scan parity-tested off-chip); if Mosaic
-    # rejects the kernel inside the scan body that's an answering-chip
-    # error, not a hang, and the unrolled fallback still runs.
+     "BENCH_REMAT": "core_attn", "BENCH_CHUNK_LOSS": "1024",
+     "BENCH_AMP": "O2", "BENCH_SCAN": "0",
+     "BENCH_MOMENT_DTYPE": "bfloat16"},  # MFU 0.4083
+    # default headline config (768h/12L b16 non-remat, flash-routed)
+    {"BENCH_BATCH": "16", "BENCH_REMAT": "0", "BENCH_SCAN": "0"},
+    # long-context through the tuned flash kernel
     {"BENCH_SEQ": "8192", "BENCH_BATCH": "2", "BENCH_REMAT": "1",
-     "BENCH_CHUNK_LOSS": "1024", "BENCH_SCAN": "1"},
-    {"BENCH_SEQ": "8192", "BENCH_BATCH": "2", "BENCH_REMAT": "1",
-     "BENCH_CHUNK_LOSS": "1024", "BENCH_SCAN": "0"},
+     "BENCH_CHUNK_LOSS": "1024", "BENCH_SCAN": "0"},  # MFU 0.174
 ]
 
 
