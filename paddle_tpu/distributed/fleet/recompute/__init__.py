@@ -25,6 +25,15 @@ _POLICIES = {
 }
 
 
+def resolve_policy(name):
+    """Validate + resolve a remat policy name (shared by recompute() and
+    jit.scan_layers so the error contract can't drift)."""
+    if name not in _POLICIES:
+        raise ValueError(f"unknown recompute policy {name!r}; valid: "
+                         f"{sorted(_POLICIES)}")
+    return _POLICIES[name]
+
+
 def recompute(function, *args, **kwargs):
     """paddle.distributed.fleet.recompute.recompute parity: run ``function``
     without saving intermediates; recompute them in backward.
@@ -40,11 +49,7 @@ def recompute(function, *args, **kwargs):
     # outputs and recomputes only attention scores/softmax — the backward
     # recompute drops from a full forward to the cheap elementwise part,
     # for ~300 MB/layer more memory at GPT-1B scale.
-    policy_name = kwargs.pop("policy", "full")
-    if policy_name not in _POLICIES:
-        raise ValueError(
-            f"unknown recompute policy {policy_name!r}; valid: "
-            f"{sorted(_POLICIES)}")
+    policy = resolve_policy(kwargs.pop("policy", "full"))
 
     traced = any(
         isinstance(getattr(a, "_data", a), jax.core.Tracer)
@@ -62,7 +67,7 @@ def recompute(function, *args, **kwargs):
     def _fresh(*a, **k):
         return function(*a, **k)
 
-    fn = jax.checkpoint(_fresh, policy=_POLICIES[policy_name])
+    fn = jax.checkpoint(_fresh, policy=policy)
     return fn(*args, **kwargs)
 
 

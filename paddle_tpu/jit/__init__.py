@@ -94,13 +94,10 @@ def scan_layers(layers, x: Tensor, *extra, remat=False) -> Tensor:
         return out._data, None
 
     if remat:
-        from ..distributed.fleet.recompute import _POLICIES
+        from ..distributed.fleet.recompute import resolve_policy
 
-        name = remat if isinstance(remat, str) else "full"
-        if name not in _POLICIES:
-            raise ValueError(f"unknown recompute policy {name!r}; valid: "
-                             f"{sorted(_POLICIES)}")
-        body = jax.checkpoint(body, policy=_POLICIES[name])
+        body = jax.checkpoint(body, policy=resolve_policy(
+            remat if isinstance(remat, str) else "full"))
     y, _ = jax.lax.scan(body, x._data, stacked)
     return Tensor(y)
 

@@ -208,9 +208,14 @@ class GPTModel(nn.Layer):
         self.layers = nn.LayerList([GPTDecoderLayer(cfg) for _ in range(cfg.num_layers)])
         self.ln_f = nn.LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_epsilon)
 
-    def gen_kv_caches(self, batch, max_len, dtype="float32"):
+    def gen_kv_caches(self, batch, max_len, dtype=None):
         """Preallocated per-layer (k, v) buffers [b, max_len, heads, dim]
-        for incremental decoding."""
+        for incremental decoding. dtype defaults to the model's own weight
+        dtype — a bf16-cast serving model must not re-upcast its cache,
+        and dynamic_update_slice requires exact dtype match with the
+        produced k/v."""
+        if dtype is None:
+            dtype = str(self.layers[0].attn.qkv.weight._data.dtype)
         shape = [batch, max_len, self.cfg.num_heads,
                  self.cfg.hidden_size // self.cfg.num_heads]
         return [(creation.zeros(shape, dtype=dtype),
@@ -502,15 +507,9 @@ class GPTForCausalLM(nn.Layer):
             def decode_cached(param_arrays, start_ids, key):
                 with _swap_data(objs, list(param_arrays)):
                     with prng.key_guard(jax.random.key(0)):
-                        # cache dtype follows the weights: a bf16-cast
-                        # model (serving mode) must not re-upcast its KV
-                        # cache, and dynamic_update_slice requires exact
-                        # dtype match with the produced k/v
-                        wq = self.gpt.layers[0].attn.qkv.weight._data.dtype
                         caches0 = [
                             (c[0]._data, c[1]._data)
-                            for c in self.gpt.gen_kv_caches(
-                                b, total, dtype=str(wq))]
+                            for c in self.gpt.gen_kv_caches(b, total)]
                         # prefill the prompt in one pass
                         h, caches = self.gpt(
                             Tensor(start_ids),

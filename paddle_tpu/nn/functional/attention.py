@@ -49,9 +49,10 @@ def _sdpa_reference(q, k, v, *, scale, causal, dropout_p=0.0, key=None):
 def _effective_min_seqlen(sk: int) -> int:
     """Resolve the flash-routing threshold. FLAGS default -1 = auto:
     with on-chip-tuned blocks (FLASH_TUNED.json for this chip) the kernel
-    measured FASTER than XLA at every seqlen >= 1024 (1.53x @1k, 1.97x
-    @2k, 3.26x @4k, 27x @8k — benches/flash_tpu_bench.py, v5e bf16
-    fwd+bwd d=64), so auto routes from 1024; with untuned 128-blocks the
+    measured FASTER than XLA at every seqlen >= 1024 (replay-proof:
+    1.30x @1k, 1.56x @2k, 2.58x @4k, 18.4x @8k —
+    benches/flash_tpu_bench.py, v5e bf16 fwd+bwd d=64), so auto routes
+    from 1024; with untuned 128-blocks the
     kernel loses below ~4.6k (r4 measurement), so auto stays at 4608.
     An explicit flag value always wins; 0 = always flash."""
     from ...core import flags
