@@ -156,15 +156,13 @@ def main():
     # its first timed step in well under 2 min, so a short window still
     # produces a driver-valid record. Cache entries are keyed on HLO +
     # compile options + backend, so CPU-smoke and TPU runs never collide.
-    cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR") or os.path.join(
-        os.path.dirname(os.path.abspath(__file__)), "benches", ".jax_cache")
-    try:
-        os.makedirs(cache_dir, exist_ok=True)
-        jax.config.update("jax_compilation_cache_dir", cache_dir)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
-        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
-    except Exception as e:  # cache is an optimization, never a blocker
-        print(f"# compilation cache unavailable: {e}", flush=True)
+    # Policy (framework-wide since core.compile_cache): a legacy primed
+    # benches/.jax_cache keeps winning; fresh setups share the framework
+    # default dir with to_static/TrainStep; min_compile_secs=0 persists
+    # every compile.
+    from benches import _common as _bench_common
+
+    _bench_common.enable_compile_cache()
 
     # a tuned large config on a COLD compile cache (fresh checkout / wiped
     # benches/.jax_cache) can push compile past the 1500s default; don't let

@@ -8,19 +8,36 @@ import time
 HERE = os.path.dirname(os.path.abspath(__file__))
 
 
-def enable_compile_cache():
-    """Persistent XLA compilation cache (same dir bench.py uses): a
-    re-run of any bench after a tunnel flap skips its multi-minute cold
-    compiles, so short windows can still complete whole bank stages."""
-    import jax
+def bench_cache_dir():
+    """Bench cache-dir policy: JAX_COMPILATION_CACHE_DIR wins; a legacy
+    primed benches/.jax_cache keeps being used (its multi-minute tunnel
+    compiles must not be thrown away by the framework-dir migration);
+    fresh checkouts land on the shared framework default
+    (~/.cache/paddle_tpu/xla) so benches, to_static and TrainStep all
+    warm-start from one cache."""
+    env = os.environ.get("JAX_COMPILATION_CACHE_DIR")
+    if env:
+        return env
+    legacy = os.path.join(HERE, ".jax_cache")
+    if os.path.isdir(legacy) and any(
+            n.endswith("-cache") for n in os.listdir(legacy)):
+        return legacy
+    return None  # framework default
 
-    cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR") or os.path.join(
-        HERE, ".jax_cache")
+
+def enable_compile_cache():
+    """Persistent XLA compilation cache via core.compile_cache (same dir
+    bench.py uses): a re-run of any bench after a tunnel flap skips its
+    multi-minute cold compiles, so short windows can still complete whole
+    bank stages. Benches persist EVERY compile (min_compile_secs=0)."""
     try:
-        os.makedirs(cache_dir, exist_ok=True)
-        jax.config.update("jax_compilation_cache_dir", cache_dir)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
-        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        from paddle_tpu.core import compile_cache
+
+        d = compile_cache.initialize(cache_dir=bench_cache_dir(),
+                                     force=True, min_compile_secs=0.0)
+        if d is None:
+            print("# compilation cache disabled (FLAGS_xla_compile_cache=0)",
+                  flush=True)
     except Exception as e:  # optimization only, never a blocker
         print(f"# compilation cache unavailable: {e}", flush=True)
 

@@ -24,6 +24,7 @@ import jax.numpy as jnp
 
 from . import flags, trace_hook
 from .autograd import TapeNode, is_grad_enabled
+from .compile_cache import bump as _cc_bump
 from .tensor import Tensor
 
 _JIT_CACHE: Dict[Tuple, Any] = {}
@@ -93,15 +94,21 @@ def _jitted(fn, static: Tuple):
         if rec is not None:
             ex = rec.get(static)
             if ex is None:
+                _cc_bump("eager_jit.misses")
                 ex = (jax.jit(functools.partial(fn, **dict(static)))
                       if static else jax.jit(fn))
                 rec[static] = ex
+            else:
+                _cc_bump("eager_jit.hits")
             return ex
     key = (_fn_cache_key(fn), static)
     ex = _JIT_CACHE.get(key)
     if ex is None:
+        _cc_bump("eager_jit.misses")
         ex = jax.jit(functools.partial(fn, **dict(static))) if static else jax.jit(fn)
         _JIT_CACHE[key] = ex
+    else:
+        _cc_bump("eager_jit.hits")
     return ex
 
 

@@ -100,7 +100,36 @@ define_flag("flash_use_tuned", True,
 define_flag("flash_attention_min_seqlen", -1,
             "Route attention through the Pallas flash kernel at kv "
             "sequence length >= this. -1 (default) = auto: 1024 when "
-            "on-chip-tuned blocks exist for this chip (FLASH_TUNED.json; "
-            "tuned kernel measured faster than XLA at every seqlen >= 1k "
-            "on v5e), else 4608 (untuned kernel loses below ~4.6k). "
-            "0 = always flash.")
+            "on-chip-tuned blocks will actually be adopted for this chip "
+            "(FLASH_TUNED.json present, flash_block_q/_k at their 128 "
+            "defaults, flash_use_tuned on; tuned kernel measured faster "
+            "than XLA at every seqlen >= 1k on v5e), else 4608 (untuned "
+            "kernel loses below ~4.6k). 0 = always flash.")
+
+# ---- Compilation cache / donation / bucketing (core.compile_cache) ----
+define_flag("xla_compile_cache", True,
+            "Enable the persistent on-disk XLA compilation cache at import "
+            "(core.compile_cache.initialize). Warm-starts every compiled "
+            "entry point: eager dispatch, to_static, TrainStep, benches.")
+define_flag("xla_compile_cache_dir", "",
+            "Persistent compile cache directory. Empty = "
+            "JAX_COMPILATION_CACHE_DIR env, else ~/.cache/paddle_tpu/xla.")
+define_flag("xla_compile_cache_min_compile_secs", 1.0,
+            "Only persist compiles that took at least this many seconds "
+            "(keeps thousands of tiny eager-op entries off disk). Benches "
+            "set 0.0 to persist everything.")
+define_flag("trainstep_donate", True,
+            "Donate params + optimizer slots into the compiled TrainStep "
+            "update (XLA reuses their HBM in place; halves update peak). "
+            "0 keeps the copying build for A/B verification.")
+define_flag("decode_donate", True,
+            "Donate the preallocated KV cache and output token buffer into "
+            "the compiled generate() decode loop.")
+define_flag("shape_bucketing", False,
+            "Pad batch dims of to_static inference inputs to power-of-two-"
+            "ish buckets (core.compile_cache.bucket_dim) so variable batch "
+            "sizes stop minting one executable each. Opt-in; see "
+            "docs/compile_cache.md for the semantic contract.")
+define_flag("shape_bucket_min", 8,
+            "Smallest shape bucket: batch dims at or below this share one "
+            "bucket.")

@@ -34,22 +34,34 @@ def resolve_policy(name):
     return _POLICIES[name]
 
 
-def recompute(function, *args, **kwargs):
+def recompute(function, *args, policy="full", use_reentrant=True,
+              preserve_rng_state=True, **kwargs):
     """paddle.distributed.fleet.recompute.recompute parity: run ``function``
     without saving intermediates; recompute them in backward.
 
     Under a trace this is jax.checkpoint; in eager mode intermediates are
     owned by the tape anyway, so the call is a plain invocation (matching the
     reference's behavior of recompute being a no-op benefit-wise in pure
-    eager)."""
-    use_reentrant = kwargs.pop("use_reentrant", True)  # accepted, unused
-    preserve_rng_state = kwargs.pop("preserve_rng_state", True)  # automatic
-    # policy (TPU knob): which intermediates remat keeps. "full" saves
-    # nothing (the reference's semantics); "core_attn" saves weight-matmul
-    # outputs and recomputes only attention scores/softmax — the backward
-    # recompute drops from a full forward to the cheap elementwise part,
-    # for ~300 MB/layer more memory at GPT-1B scale.
-    policy = resolve_policy(kwargs.pop("policy", "full"))
+    eager).
+
+    ``policy``, ``use_reentrant`` and ``preserve_rng_state`` are
+    keyword-only parameters of recompute ITSELF and are never forwarded to
+    ``function``. Earlier versions popped them out of ``**kwargs``, which
+    silently swallowed a wrapped function's own ``policy`` keyword — to
+    pass a kwarg with one of these names to ``function``, bind it first:
+    ``recompute(functools.partial(fn, policy=...), *args)``.
+
+    ``use_reentrant`` is accepted for API parity (unused);
+    ``preserve_rng_state`` is automatic (draws are pure functions of the
+    traced key). ``policy`` is the TPU knob for which intermediates remat
+    keeps: "full" saves nothing (the reference's semantics); "core_attn"
+    saves weight-matmul outputs and recomputes only attention
+    scores/softmax — the backward recompute drops from a full forward to
+    the cheap elementwise part, for ~300 MB/layer more memory at GPT-1B
+    scale. All other keyword arguments are forwarded to ``function``
+    untouched."""
+    del use_reentrant, preserve_rng_state
+    policy = resolve_policy(policy)
 
     traced = any(
         isinstance(getattr(a, "_data", a), jax.core.Tracer)

@@ -25,7 +25,7 @@ from typing import Callable, Dict, List, Optional
 import jax
 import jax.numpy as jnp
 
-from ..core import rng
+from ..core import compile_cache, flags, rng
 from ..core.tensor import Tensor
 from ..nn.layer import Layer, mutation_sink
 
@@ -146,15 +146,25 @@ def _write_back_buffer(b, new_data):
 
 class StaticFunction:
     """Result of @to_static: a compile-cached callable (≈ ref StaticFunction,
-    ref:python/paddle/jit/dy2static/program_translator.py)."""
+    ref:python/paddle/jit/dy2static/program_translator.py).
 
-    def __init__(self, function: Callable, layer: Optional[Layer] = None, donate_buffers: bool = True):
+    ``bucket_batch`` pads the shared leading (batch) dim of array inputs up
+    to a power-of-two-ish bucket (core.compile_cache.bucket_dim) on the
+    inference path and slices outputs back, so serving-style callers with
+    variable batch sizes reuse one executable per bucket instead of one per
+    size. None (default) follows FLAGS_shape_bucketing. Training (taped)
+    calls are never bucketed — padded rows would enter batch reductions."""
+
+    def __init__(self, function: Callable, layer: Optional[Layer] = None,
+                 bucket_batch: Optional[bool] = None):
         self._fn = function
         self._layer = layer
         self._jit_fn = None
         self._jit_fns = {}
         self._param_objs: List[Tensor] = []
         self._buffer_objs: List[Tensor] = []
+        self._bucket_batch = bucket_batch
+        self._seen_sigs = set()
         functools.update_wrapper(self, function, updated=[])
 
     def _discover_state(self):
@@ -286,22 +296,100 @@ class StaticFunction:
         # detached output would zero every gradient.
         from ..core.autograd import is_grad_enabled
 
-        leaves = jax.tree_util.tree_leaves(
+        leaves, treedef = jax.tree_util.tree_flatten(
             (args, kwargs), is_leaf=lambda x: isinstance(x, Tensor))
         live = is_grad_enabled() and (
             any(isinstance(l, Tensor) and not l.stop_gradient
                 for l in leaves)
             or any(not p.stop_gradient for p in self._param_objs))
         if live:
+            compile_cache.bump("to_static.taped_calls")
             return self._call_taped(args, kwargs)
+        bucket = (self._bucket_batch if getattr(self, "_bucket_batch", None)
+                  is not None else flags.flag("shape_bucketing"))
+        orig_b = padded_b = None
+        if bucket:
+            leaves, orig_b, padded_b = self._pad_leaves(leaves)
+            if orig_b is not None:
+                args, kwargs = jax.tree_util.tree_unflatten(treedef, leaves)
+        self._count_signature(leaves)
         param_arrays = tuple(p._data for p in self._param_objs)
         buffer_arrays = tuple(b._data for b in self._buffer_objs)
         jit_fn = self._jit_fns[_amp_key(_amp_mod.amp_state())]
         out, mutated = jit_fn(param_arrays, buffer_arrays, rng.next_key(), args, kwargs)
         for b, m in zip(self._buffer_objs, mutated):
             if m is not None:
+                if orig_b is not None and not getattr(
+                        self, "_warned_bucket_buffers", False):
+                    self._warned_bucket_buffers = True
+                    import warnings
+
+                    warnings.warn(
+                        "bucket_batch: a buffer mutation (e.g. BatchNorm "
+                        "running stats) was computed over a zero-padded "
+                        "batch — the written-back statistics include the "
+                        "padding rows. Disable bucketing for functions "
+                        "that update batch statistics.")
                 _write_back_buffer(b, m)
+        if orig_b is not None:
+            out = _slice_batch(out, padded_b, orig_b)
         return out
+
+    def _pad_leaves(self, leaves):
+        """Pad the shared leading dim of array input leaves up to its
+        bucket. Returns (leaves, orig_b, padded_b); orig_b None = no
+        padding (no array leaves, ambiguous leading dims, or already
+        on-bucket) — the caller only re-unflattens when padding happened."""
+        import numpy as _np
+
+        def _arr(l):
+            return (isinstance(l, (Tensor, jax.Array, _np.ndarray))
+                    and getattr(l._data if isinstance(l, Tensor) else l,
+                                "ndim", 0) >= 1)
+
+        dims = {(l._data if isinstance(l, Tensor) else l).shape[0]
+                for l in leaves if _arr(l)}
+        if len(dims) != 1:
+            if dims:
+                compile_cache.bump("bucket.skipped_ambiguous")
+            return leaves, None, None
+        b = dims.pop()
+        pb = compile_cache.bucket_dim(b)
+        if pb == b:
+            return leaves, None, None
+        leaves = [compile_cache.pad_to_bucket(l)[0] if _arr(l) else l
+                  for l in leaves]
+        return leaves, b, pb
+
+    def _count_signature(self, leaves):
+        """Cold/warm counters per (shapes, dtypes, amp) call signature —
+        mirrors what jax.jit's executable cache keys on, so the second call
+        with the same (post-bucketing) shapes records a hit. Works on the
+        already-flattened leaves: no extra tree walk on the hot path."""
+        import numpy as _np
+
+        from .. import amp as _amp_mod
+
+        parts = []
+        for l in leaves:
+            a = l._data if isinstance(l, Tensor) else l
+            if isinstance(a, (jax.Array, _np.ndarray)):
+                parts.append((a.shape, str(a.dtype)))
+            else:
+                parts.append((type(l).__name__,))
+        try:
+            sig = (tuple(parts), _amp_key(_amp_mod.amp_state()))
+            hash(sig)
+        except TypeError:
+            return
+        seen = getattr(self, "_seen_sigs", None)
+        if seen is None:
+            seen = self._seen_sigs = set()
+        if sig in seen:
+            compile_cache.bump("to_static.hits")
+        else:
+            seen.add(sig)
+            compile_cache.bump("to_static.misses")
 
     def _call_taped(self, args, kwargs):
         """Record the whole compiled function as ONE tape op via
@@ -433,16 +521,39 @@ class StaticFunction:
         return self._jit_fn
 
 
+def _slice_batch(out, padded_b: int, orig_b: int):
+    """Undo bucket padding: slice every array leaf whose leading dim is the
+    padded bucket size back to the original batch."""
+
+    def _cut(l):
+        a = l._data if isinstance(l, Tensor) else l
+        if (isinstance(a, jax.Array) and a.ndim >= 1
+                and a.shape[0] == padded_b):
+            s = a[:orig_b]
+            return Tensor(s, stop_gradient=l.stop_gradient) \
+                if isinstance(l, Tensor) else s
+        return l
+
+    return jax.tree_util.tree_map(
+        _cut, out, is_leaf=lambda x: isinstance(x, Tensor))
+
+
 def to_static(function=None, input_spec=None, build_strategy=None, backend=None, **kwargs):
-    """@paddle.jit.to_static equivalent (trace+XLA instead of AST rewrite)."""
+    """@paddle.jit.to_static equivalent (trace+XLA instead of AST rewrite).
+
+    ``bucket_batch=True`` opts this function into inference-path shape
+    bucketing (see StaticFunction / FLAGS_shape_bucketing); ``False`` opts
+    out even when the global flag is on."""
+    bucket_batch = kwargs.pop("bucket_batch", None)
 
     def deco(fn):
         if isinstance(fn, Layer):
             layer = fn
-            sf = StaticFunction(layer.forward, layer=layer)
+            sf = StaticFunction(layer.forward, layer=layer,
+                                bucket_batch=bucket_batch)
             layer.forward = sf
             return layer
-        return StaticFunction(fn)
+        return StaticFunction(fn, bucket_batch=bucket_batch)
 
     if function is not None:
         return deco(function)
@@ -539,15 +650,22 @@ class TrainStep:
             new_slots.append(ns_)
         return new_params, {"slots": new_slots, "step": step}
 
+    @staticmethod
+    def _donate_argnums():
+        """Donate params + optimizer state (argnums 0 and 2): XLA updates
+        them in place — halves the peak HBM of the update; old arrays are
+        invalidated, but __call__ rebinds every Tensor._data to the new
+        buffers. FLAGS_trainstep_donate=0 (read at build time) keeps the
+        copying build for A/B verification."""
+        return (0, 2) if flags.flag("trainstep_donate") else ()
+
     def _build(self):
+        compile_cache.bump("train_step.builds")
         if self._accumulate_steps > 1:
             self._build_accum(self._accumulate_steps, self._accumulate_avg)
             return
 
-        # donate params + optimizer state: XLA updates them in place
-        # (halves the peak HBM of the update; old arrays are invalidated,
-        # but __call__ rebinds every Tensor._data to the new buffers)
-        @functools.partial(jax.jit, donate_argnums=(0, 2))
+        @functools.partial(jax.jit, donate_argnums=self._donate_argnums())
         def _step(param_arrays, buffer_arrays, opt_state, lr, key, args):
             def loss_f(pa):
                 return self._loss_with_sink(pa, buffer_arrays, key, args)
@@ -566,7 +684,7 @@ class TrainStep:
         ref:python/paddle/distributed/passes/auto_parallel_gradient_merge.py:26
         (accumulate ops + conditional optimizer block become a lax.scan)."""
 
-        @functools.partial(jax.jit, donate_argnums=(0, 2))
+        @functools.partial(jax.jit, donate_argnums=self._donate_argnums())
         def _step(param_arrays, buffer_arrays, opt_state, lr, key, args):
             micro = jax.tree_util.tree_map(
                 lambda a: a.reshape((k, a.shape[0] // k) + a.shape[1:]), args)
@@ -644,6 +762,7 @@ class TrainStep:
                 "slots": slots,
                 "step": jnp.asarray(self._opt._step_count, jnp.int32),
             }
+        compile_cache.bump("train_step.steps")
         param_arrays = tuple(p._data for p in self._train_params)
         buffer_arrays = tuple(b._data for b in self._buffers)
         lr = jnp.asarray(self._opt.get_lr(), jnp.float32)

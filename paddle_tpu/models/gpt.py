@@ -471,12 +471,19 @@ class GPTForCausalLM(nn.Layer):
             # keyed on function identity, so the closure is memoized here —
             # repeat generate() calls with the same shapes/flags reuse the
             # executable instead of retracing the whole scan
+            from ..core import compile_cache, flags as _flags
+
+            # the donation flag is part of the key: toggling it must build
+            # a fresh executable, not reuse the old donation setting
+            donate = bool(use_cache and _flags.flag("decode_donate"))
             cache_key = (b, prompt_len, max_new_tokens, bool(do_sample),
                          float(temperature), int(top_k), float(top_p),
-                         int(eos_token_id), bool(use_cache))
+                         int(eos_token_id), bool(use_cache), donate)
             cached = getattr(self, "_gen_cache", None)
             if cached is not None and cached[0] == cache_key:
+                compile_cache.bump("decode.cache_hits")
                 return Tensor(cached[1](arrays, ids, jax.random.key(seed)))
+            compile_cache.bump("decode.builds")
 
             def sample_next(logits, done, key):
                 if do_sample:
@@ -504,12 +511,17 @@ class GPTForCausalLM(nn.Layer):
                         out = self.lm_head(Tensor(h_last[:, None]))
                 return out._data[:, 0]
 
-            def decode_cached(param_arrays, start_ids, key):
+            def decode_cached(param_arrays, start_ids, key, caches0,
+                              out_buf):
+                # with FLAGS_decode_donate, caches0 / out_buf are allocated
+                # by the caller and DONATED: XLA writes the KV cache and
+                # the token buffer into the passed allocations instead of
+                # double-buffering them — the KV cache is the dominant
+                # per-call allocation of the serving loop. With the flag
+                # off they are created inside the program (the copying
+                # build, identical to the pre-donation behavior).
                 with _swap_data(objs, list(param_arrays)):
                     with prng.key_guard(jax.random.key(0)):
-                        caches0 = [
-                            (c[0]._data, c[1]._data)
-                            for c in self.gpt.gen_kv_caches(b, total)]
                         # prefill the prompt in one pass
                         h, caches = self.gpt(
                             Tensor(start_ids),
@@ -541,7 +553,6 @@ class GPTForCausalLM(nn.Layer):
                     return (new_caches, h._data[:, 0], pos + 1, done, key,
                             out_buf), None
 
-                out_buf = jnp.zeros((b, total), start_ids.dtype)
                 out_buf = jax.lax.dynamic_update_slice(out_buf, start_ids,
                                                        (0, 0))
                 done0 = jnp.zeros((b,), jnp.bool_)
@@ -581,9 +592,42 @@ class GPTForCausalLM(nn.Layer):
                     None, length=max_new_tokens)
                 return buf
 
-            jitted = jax.jit(decode_cached if use_cache else decode)
-            self._gen_cache = (cache_key, jitted)
-            return Tensor(jitted(arrays, ids, jax.random.key(seed)))
+            if donate:
+                jitted = jax.jit(decode_cached, donate_argnums=(3, 4))
+
+                def runner(param_arrays, start_ids, key):
+                    # fresh allocations per call: they are donated into the
+                    # compiled loop (invalid afterwards), so they cannot be
+                    # hoisted out of the runner
+                    caches0 = [(c[0]._data, c[1]._data)
+                               for c in self.gpt.gen_kv_caches(b, total)]
+                    out_buf = jnp.zeros((b, total), start_ids.dtype)
+                    import warnings
+
+                    with warnings.catch_warnings():
+                        # donation is best-effort: XLA aliases the buffers
+                        # it can (out_buf + part of the KV set) and warns
+                        # about the rest — expected here, not actionable
+                        warnings.filterwarnings(
+                            "ignore",
+                            message="Some donated buffers were not usable")
+                        return jitted(param_arrays, start_ids, key, caches0,
+                                      out_buf)
+            elif use_cache:
+                # copying build: the buffers materialize inside the
+                # compiled program (no host-side allocation per call)
+                def decode_alloc(param_arrays, start_ids, key):
+                    caches0 = [(c[0]._data, c[1]._data)
+                               for c in self.gpt.gen_kv_caches(b, total)]
+                    out_buf = jnp.zeros((b, total), start_ids.dtype)
+                    return decode_cached(param_arrays, start_ids, key,
+                                         caches0, out_buf)
+
+                runner = jax.jit(decode_alloc)
+            else:
+                runner = jax.jit(decode)
+            self._gen_cache = (cache_key, runner)
+            return Tensor(runner(arrays, ids, jax.random.key(seed)))
         finally:
             if was_training:
                 self.train()

@@ -254,7 +254,12 @@ class NativePredictor:
         # axon_client_create_options()); libtpu needs none. Pure-C users
         # without this entry point can export
         # PADDLE_TPU_PJRT_CREATE_OPTIONS instead (guess-typed).
-        if create_options:
+        # None vs {} matters: an EXPLICIT empty dict means "no options,
+        # period" — it goes through the with_options entry point with an
+        # empty string, which the C++ side treats as zero NamedValues and,
+        # unlike plain pt_infer_create, never falls back to the
+        # PADDLE_TPU_PJRT_CREATE_OPTIONS env var.
+        if create_options is not None:
             parts = []
             for k, v in create_options.items():
                 if ";" in str(k) or "=" in str(k) or ";" in str(v):

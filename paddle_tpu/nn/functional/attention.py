@@ -54,7 +54,14 @@ def _effective_min_seqlen(sk: int) -> int:
     benches/flash_tpu_bench.py, v5e bf16 fwd+bwd d=64), so auto routes
     from 1024; with untuned 128-blocks the
     kernel loses below ~4.6k (r4 measurement), so auto stays at 4608.
-    An explicit flag value always wins; 0 = always flash."""
+    An explicit flag value always wins; 0 = always flash.
+
+    The 1024 threshold applies only when the tuned blocks will actually be
+    ADOPTED — the same gate _default_blocks uses: flash_block_q/_k at their
+    128 defaults and flash_use_tuned truthy. With the escape hatch
+    (flash_use_tuned=0) or custom blocks, the kernel that runs is the
+    untuned one (measured 0.64–0.80x of XLA at 1k–4.6k), so auto must stay
+    at 4608."""
     from ...core import flags
 
     thr = int(flags.flag("flash_attention_min_seqlen"))
@@ -62,7 +69,12 @@ def _effective_min_seqlen(sk: int) -> int:
         return thr
     from ...ops.pallas_ops import _tuned_blocks
 
-    return 1024 if _tuned_blocks(sk) else 4608
+    blocks_at_default = (int(flags.flag("flash_block_q")),
+                         int(flags.flag("flash_block_k"))) == (128, 128)
+    if (blocks_at_default and flags.flag("flash_use_tuned")
+            and _tuned_blocks(sk)):
+        return 1024
+    return 4608
 
 
 def _use_pallas(sk: int) -> bool:

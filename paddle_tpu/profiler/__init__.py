@@ -125,6 +125,9 @@ class Profiler:
                 jax.profiler.start_trace(self._device_dir)
             except Exception:
                 self._device_dir = None
+        from ..core import compile_cache
+
+        self._cc_start = compile_cache.stats()
         self._running = True
 
     def stop(self):
@@ -141,6 +144,12 @@ class Profiler:
                 jax.profiler.stop_trace()
             except Exception:
                 pass
+        from ..core import compile_cache
+
+        # numeric deltas over the profiled window (counts AND seconds);
+        # non-numeric keys (dir/enabled) ride along as-is
+        self.compile_cache_stats = compile_cache.stats_delta(
+            getattr(self, "_cc_start", {}), compile_cache.stats())
         self._running = False
         if self.on_trace_ready is not None:
             self.on_trace_ready(self)
@@ -229,6 +238,16 @@ class Profiler:
         stat = StatisticData(self._events(), self._memory_steps)
         table = build_views(stat, views, sorted_by, time_unit,
                             op_limit=60 if op_detail else 10)
+        cc = getattr(self, "compile_cache_stats", None)
+        if cc and views is None:
+            nz = {k: v for k, v in sorted(cc.items())
+                  if isinstance(v, (int, float))
+                  and not isinstance(v, bool) and v}
+            if nz:
+                lines = ["", "[ Compile Cache Summary (this profile) ]",
+                         "-" * 46]
+                lines += [f"{k:<34}{v:>12}" for k, v in nz.items()]
+                table = table + "\n".join(lines)
         print(table)
         return table
 

@@ -313,13 +313,22 @@ class Executor:
         if extra:
             raise ValueError(f"unknown feed keys {sorted(extra)}")
 
+        from ..core import compile_cache, flags as _core_flags
+
+        # the donate flag is part of the runner identity: _build bakes it
+        # into the compiled train_step, so toggling it for an A/B run must
+        # construct a fresh runner rather than hit the old build
         key = (id(program), program._version, tuple(fetch_sids),
+               bool(_core_flags.flag("trainstep_donate")),
                tuple((n, a.shape, str(a.dtype))
                      for n, a in sorted(feed_arrays.items())))
         runner = program._exec_cache.get(key)
         if runner is None:
+            compile_cache.bump("executor.builds")
             runner = self._build(program, fetch_sids, list(sorted(feed_arrays)))
             program._exec_cache[key] = runner
+        else:
+            compile_cache.bump("executor.hits")
         outs = runner(feed_arrays)
         if return_numpy:
             return [np.asarray(o) for o in outs]
@@ -377,7 +386,16 @@ class Executor:
         # fp16 gradients out of the underflow range (static/amp.py)
         scale_hook = getattr(opt, "_capture_loss_scale", None)
 
-        @jax.jit
+        # donate the optimizer state (argnum 2): the runner rebinds
+        # program._opt_state to the returned pytree every run, so XLA may
+        # update the slots in place (same contract as jit.TrainStep's
+        # donation; FLAGS_trainstep_donate=0 restores the copying build).
+        # param_arrays are NOT donated — frozen params keep their buffers.
+        from ..core import flags as _flags
+
+        _donate = (2,) if _flags.flag("trainstep_donate") else ()
+
+        @functools.partial(jax.jit, donate_argnums=_donate)
         def train_step(feed_arrays, param_arrays, opt_state, lr):
             def loss_fn(trainables):
                 arrays = list(param_arrays)
@@ -431,8 +449,16 @@ class Executor:
             _writeback(bufs)
             # the AMP decorator wraps the real optimizer: keep the INNER's
             # step count authoritative (state_dict/schedulers read it there)
-            getattr(opt, "_inner", opt)._step_count = \
-                int(program._opt_state["step"])
+            inner._step_count = int(program._opt_state["step"])
+            # keep the inner optimizer's accumulators coherent with the
+            # compiled state (TrainStep does the same): opt.state_dict()
+            # after executor training is truthful, and — with opt_state
+            # DONATED into train_step — any pre-donation alias a ckpt
+            # restore left in _accumulators is replaced before it can be
+            # read again
+            for n, i in zip(names, train_idx):
+                inner._accumulators[id(program._params[i])] = \
+                    program._opt_state["slots"][n]
             return outs
 
         return runner
