@@ -56,14 +56,24 @@ def worker(args):
 
     ck = TrainCheckpointer(args.ckpt_dir)  # async_save=True by default
     start = 0
-    latest = ck.latest_step()
-    if latest is not None:
-        restored = ck.restore()
+    # restore() scans newest-first and skips a torn/corrupt newest step
+    # (manifest verification, docs/robustness.md); last_restored_step says
+    # which step actually won
+    restored = ck.restore()
+    latest = ck.last_restored_step if restored is not None else None
+    if restored is not None:
         model.set_state_dict(restored["model"])
         opt.set_state_dict(restored["opt"])
         start = latest + 1
         print(f"[rank {rank}/{world}] resumed from step {latest}",
               flush=True)
+    # graceful preemption (SIGTERM, the TPU eviction notice): finish the
+    # step, write one final synchronous checkpoint + resume marker, exit 0.
+    # The --preempt_at SIGKILL below stays as the HARD-preemption model —
+    # that path is covered by the async commit protocol instead.
+    from paddle_tpu.core import resilience
+
+    guard = resilience.PreemptionGuard()
     if start >= args.steps:
         print(f"nothing to do: {args.ckpt_dir} is already at step "
               f"{latest}; raise --steps or point --ckpt_dir elsewhere",
@@ -88,6 +98,12 @@ def worker(args):
                         "opt": opt.state_dict()})
         print(f"[rank {rank}/{world}] step {s} loss "
               f"{float(np.asarray(loss._data)):.4f}", flush=True)
+        if rank == 0:
+            guard.maybe_finalize(
+                s, ck, lambda: {"model": model.state_dict(),
+                                "opt": opt.state_dict()})
+        elif guard.requested():
+            sys.exit(0)  # non-primary ranks just leave at the boundary
         if (args.preempt_at >= 0 and s == args.preempt_at and first_life
                 and world > 1 and rank == world - 1):
             print(f"[rank {rank}] simulating preemption", flush=True)

@@ -8,6 +8,7 @@ from __future__ import annotations
 
 import contextlib
 import enum
+import warnings
 from typing import Optional
 
 import numpy as np
@@ -39,16 +40,61 @@ class TensorCheckerConfig:
         self.debug_step = debug_step
 
 
+# step-window state for the dispatch-level scan: the reference's per-op CUDA
+# checker honors TensorCheckerConfig.debug_step (only scan inside a step
+# range); here the window gates core.dispatch._check_nan_inf
+_checker = {"debug_step": None, "step": 0}
+_warned_op_lists = False
+
+
+def _normalize_debug_step(debug_step):
+    """Reference contract: ``debug_step`` is ``[start, end)`` (a 2-list) or a
+    single int meaning "the first N optimizer steps"."""
+    if debug_step is None:
+        return None
+    if isinstance(debug_step, int):
+        return (0, int(debug_step))
+    start, end = debug_step
+    return (int(start), int(end))
+
+
+def step_check_active() -> bool:
+    """Whether the dispatch-level NaN/Inf scan applies at the CURRENT step
+    (consulted by core.dispatch on every scanned op)."""
+    window = _checker["debug_step"]
+    return window is None or window[0] <= _checker["step"] < window[1]
+
+
+def mark_step(n: int = 1) -> None:
+    """Advance the checker's step counter (Optimizer.step calls this while
+    the scan is enabled, so debug_step windows track optimizer steps like
+    the reference's checker)."""
+    _checker["step"] += n
+
+
 def enable_tensor_checker(config: Optional[TensorCheckerConfig] = None):
+    global _warned_op_lists
     config = config or TensorCheckerConfig()
     if not config.enable:
         return
+    if (config.checked_op_list or config.skipped_op_list) \
+            and not _warned_op_lists:
+        # warn ONCE instead of silently ignoring: the dispatch-level scan
+        # checks every float output — there is no per-op filter to apply
+        _warned_op_lists = True
+        warnings.warn(
+            "TensorCheckerConfig.checked_op_list/skipped_op_list are not "
+            "supported by the dispatch-level NaN/Inf scan; every float op "
+            "output is checked", stacklevel=2)
+    _checker["debug_step"] = _normalize_debug_step(config.debug_step)
+    _checker["step"] = 0
     flags.set_flags({"FLAGS_check_nan_inf": True})
     level = 0 if config.debug_mode == DebugMode.CHECK_NAN_INF_AND_ABORT else 1
     flags.set_flags({"FLAGS_check_nan_inf_level": level})
 
 
 def disable_tensor_checker():
+    _checker["debug_step"] = None
     flags.set_flags({"FLAGS_check_nan_inf": False})
 
 

@@ -220,7 +220,12 @@ def initialize(cache_dir: Optional[str] = None, *, force: bool = False,
     try:
         import jax
 
-        os.makedirs(d, exist_ok=True)
+        from . import resilience
+
+        # cache-dir creation rides NFS/FUSE on tunneled-TPU hosts: transient
+        # EIO/ESTALE heals under the shared IO retry policy
+        resilience.call_with_retry(os.makedirs, d, exist_ok=True,
+                                   name="compile_cache.mkdir")
         if force and _initialized and d != _cache_dir:
             # jax builds its cache object once per process; a re-point to a
             # different directory needs the (private, best-effort) reset or

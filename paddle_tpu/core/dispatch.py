@@ -115,6 +115,13 @@ def _jitted(fn, static: Tuple):
 def _check_nan_inf(name, outs):
     import numpy as np
 
+    # honor TensorCheckerConfig.debug_step: outside the configured step
+    # window the scan is off (lazy import: amp is loaded by the time the
+    # flag can be on — enable_tensor_checker set it)
+    from ..amp.debugging import step_check_active
+
+    if not step_check_active():
+        return
     for o in outs:
         arr = np.asarray(o)
         if arr.dtype.kind in "fc" and not np.isfinite(arr).all():

@@ -133,3 +133,45 @@ define_flag("shape_bucketing", False,
 define_flag("shape_bucket_min", 8,
             "Smallest shape bucket: batch dims at or below this share one "
             "bucket.")
+
+# ---- Resilience: retry / sentinel / fault injection (core.resilience) ----
+define_flag("io_retries", 3,
+            "Max attempts (first try included) for retried IO: checkpoint "
+            "save/restore, paddle.save, compile-cache dir setup, "
+            "TCPStore/collective init.")
+define_flag("io_retry_backoff", 0.05,
+            "Base delay (seconds) of the jittered exponential backoff "
+            "between retried IO attempts; doubles per attempt, capped at "
+            "the policy max_delay.")
+define_flag("io_retry_deadline", 120.0,
+            "Wall-clock budget (seconds) across all attempts of one retried "
+            "operation; retries stop when it is exhausted.")
+define_flag("trainstep_sentinel", True,
+            "Compile a finiteness reduction over loss+grads into TrainStep; "
+            "nonfinite steps skip the optimizer update (lax.cond, no "
+            "recompile) and bump the sentinel.skipped counter. With the "
+            "fault off, results are bit-identical to a sentinel-disabled "
+            "build (read at build time).")
+define_flag("max_bad_steps", 0,
+            "After this many CONSECUTIVE nonfinite TrainStep steps, trigger "
+            "rollback to the last checkpoint (resilience.trigger_rollback). "
+            "0 = keep skipping bad steps, never roll back.")
+define_flag("ckpt_manifest", True,
+            "Write a per-step manifest (tree paths + per-leaf crc32) on "
+            "TrainCheckpointer.save and verify it on restore, so truncated/"
+            "corrupt steps are skipped in favor of the previous valid one.")
+define_flag("ckpt_manifest_crc_max_bytes", 256 * 1024 * 1024,
+            "PER-SAVE byte budget for manifest checksums (smallest leaves "
+            "first); leaves beyond the budget are recorded structurally "
+            "(shape/dtype) without a crc32, bounding the device->host "
+            "stall a manifest costs the step loop. Raise for full "
+            "coverage, lower for huge models.")
+define_flag("fault_injection", False,
+            "Master gate for the deterministic fault-injection registry "
+            "(resilience.inject_fault). Off = every probe is a no-op; "
+            "production cannot arm faults by accident.")
+define_flag("inject_faults", "",
+            "Arm faults from the environment: 'kind:times[:after],...' "
+            "(e.g. 'ckpt_io:2,preempt:1:5'). Honored only with "
+            "FLAGS_fault_injection=1; used by the chaos harness to drive "
+            "subprocesses.")

@@ -15,12 +15,18 @@ reshards on load; this is the preemptible-TPU resume story (SURVEY.md §5.4).
 """
 from __future__ import annotations
 
+import json
 import os
-from typing import Any, Dict, Optional
+import time
+import warnings
+import zlib
+from typing import Any, Dict, List, Optional
 
 import jax
 import numpy as np
 
+from ..core import flags, resilience
+from ..core.resilience import CheckpointIntegrityError  # noqa: F401  (public)
 from ..core.tensor import Tensor
 
 
@@ -29,6 +35,102 @@ def _to_arrays(tree):
         lambda x: x._data if isinstance(x, Tensor) else x, tree,
         is_leaf=lambda x: isinstance(x, Tensor),
     )
+
+
+def _atomic_write_json(path: str, obj) -> None:
+    """Durable JSON write via ``resilience.atomic_write`` (temp file +
+    fsync + ``os.replace``, retried with a ``ckpt_io`` fault probe)."""
+    resilience.atomic_write(path, json.dumps(obj).encode(),
+                            name="ckpt.manifest")
+
+
+def _restore_tree(restore_fn, target):
+    """ONE restore body shared by :func:`load_state_dict` and
+    :class:`TrainCheckpointer` (``restore_fn(args)`` wraps the orbax call):
+    templated reshard-on-load when ``target`` is given, localized plain
+    arrays otherwise — retried under the IO policy with a ``ckpt_io``
+    probe."""
+    import orbax.checkpoint as ocp
+
+    def _io():
+        resilience.maybe_fault("ckpt_io")
+        if target is None:
+            return _localize(restore_fn(ocp.args.StandardRestore()))
+        tgt = _to_arrays(target)
+        abstract = _abstract_tree(tgt)
+        return _localize_like(
+            restore_fn(ocp.args.StandardRestore(abstract)), tgt)
+
+    return resilience.call_with_retry(_io, name="ckpt.restore",
+                                      policy=resilience.io_policy())
+
+
+def _manifest_entries(tree) -> Dict[str, dict]:
+    """Per-leaf integrity record: tree path -> shape/dtype/crc32.
+
+    crc32 covers the leaf's local bytes and is only computed for fully-
+    addressable leaves (host-local values; single-process always) within a
+    PER-SAVE byte budget, ``FLAGS_ckpt_manifest_crc_max_bytes`` — the
+    checksum runs on the training thread right after an async save is
+    submitted, so an aggregate budget (not per-leaf) actually bounds the
+    device->host stall a save costs the step loop. Smallest leaves are
+    checksummed first (scalars/step counters/norm params are the cheapest
+    and most fragile); over-budget and genuinely global/sharded arrays
+    record shape/dtype only — structure is still verified, content
+    integrity for those rides orbax/tensorstore's own per-chunk checksums.
+    Non-array leaves fall back to a repr record."""
+    budget = int(flags.flag("ckpt_manifest_crc_max_bytes"))
+    entries: Dict[str, dict] = {}
+    arrays: List[tuple] = []
+    for kp, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        key = jax.tree_util.keystr(kp)
+        if hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
+            entries[key] = {"shape": [int(s) for s in leaf.shape],
+                            "dtype": str(np.dtype(leaf.dtype)),
+                            "crc32": None}
+            if not (isinstance(leaf, jax.Array)
+                    and not leaf.is_fully_addressable):
+                nbytes = int(np.prod(leaf.shape, dtype=np.int64)
+                             * np.dtype(leaf.dtype).itemsize)
+                arrays.append((nbytes, key, leaf))
+        else:
+            entries[key] = {"repr": repr(leaf)}
+    spent = 0
+    for nbytes, key, leaf in sorted(arrays, key=lambda t: t[0]):
+        if spent + nbytes > budget:
+            break
+        spent += nbytes
+        arr = np.ascontiguousarray(np.asarray(leaf))
+        # crc32 reads the array buffer directly — no tobytes() copy of up
+        # to the whole budget on the step loop's critical path
+        entries[key]["crc32"] = zlib.crc32(arr)
+    return entries
+
+
+def _manifest_mismatches(expected: Dict[str, dict], tree) -> List[str]:
+    """Compare a stored manifest against a restored tree; returns mismatch
+    descriptions (empty = verified). Leaves whose checksum could not be
+    computed on either side (global arrays, repr-only records) are checked
+    structurally only — never a false corruption report."""
+    got = _manifest_entries(tree)
+    bad: List[str] = []
+    missing = sorted(set(expected) - set(got))
+    extra = sorted(set(got) - set(expected))
+    if missing:
+        bad.append(f"missing leaves {missing[:4]}")
+    if extra:
+        bad.append(f"unexpected leaves {extra[:4]}")
+    for key, exp in expected.items():
+        g = got.get(key)
+        if g is None or "crc32" not in exp or "crc32" not in g:
+            continue
+        if exp["shape"] != g["shape"] or exp["dtype"] != g["dtype"]:
+            bad.append(f"{key}: shape/dtype {g['shape']}/{g['dtype']} != "
+                       f"saved {exp['shape']}/{exp['dtype']}")
+        elif (exp["crc32"] is not None and g["crc32"] is not None
+              and exp["crc32"] != g["crc32"]):
+            bad.append(f"{key}: checksum mismatch")
+    return bad
 
 
 def _ckpt_mesh():
@@ -220,9 +322,21 @@ def save_state_dict(state_dict: Dict[str, Any], path: str,
         os.replace(path, prev)
     if not blocking:
         ckpt = _get_async_checkpointer()
-        ckpt.save(path, tree, force=False)
+
+        def _submit():
+            resilience.maybe_fault("ckpt_io")
+            ckpt.save(path, tree, force=False)
+
+        resilience.call_with_retry(_submit, name="ckpt.save",
+                                   policy=resilience.io_policy())
         return AsyncSaveHandle(ckpt, path)
-    _checkpointer().save(path, tree, force=False)
+
+    def _commit():
+        resilience.maybe_fault("ckpt_io")
+        _checkpointer().save(path, tree, force=False)
+
+    resilience.call_with_retry(_commit, name="ckpt.save",
+                               policy=resilience.io_policy())
     if jax.process_index() == 0:
         shutil.rmtree(path + ".prev", ignore_errors=True)
     return None
@@ -235,20 +349,13 @@ def load_state_dict(
     """Load a checkpoint. With ``target`` (a state dict of Tensors/arrays on
     the CURRENT mesh) the stored values are resharded to the target's
     shardings — mesh-topology changes between save and load are fine."""
-    import orbax.checkpoint as ocp
-
     path = os.path.abspath(path)
     if not os.path.exists(path) and os.path.exists(path + ".prev"):
         # an async overwrite died before its commit: the kept-aside
         # previous complete checkpoint is the durable state
         path = path + ".prev"
     ckpt = _checkpointer()
-    if target is None:
-        return _localize(ckpt.restore(path, args=ocp.args.StandardRestore()))
-    tgt = _to_arrays(target)
-    abstract = _abstract_tree(tgt)
-    return _localize_like(
-        ckpt.restore(path, args=ocp.args.StandardRestore(abstract)), tgt)
+    return _restore_tree(lambda args: ckpt.restore(path, args=args), target)
 
 
 class TrainCheckpointer:
@@ -269,6 +376,8 @@ class TrainCheckpointer:
 
         self._dir = os.path.abspath(directory)
         os.makedirs(self._dir, exist_ok=True)
+        self._manifest_dir = os.path.join(self._dir, "manifests")
+        self.last_restored_step: Optional[int] = None
         self._mgr = ocp.CheckpointManager(
             self._dir,
             options=ocp.CheckpointManagerOptions(
@@ -281,33 +390,160 @@ class TrainCheckpointer:
     def save(self, step: int, state_dict: Dict[str, Any], force: bool = False):
         import orbax.checkpoint as ocp
 
-        tree = _globalize(_to_arrays(state_dict))
-        return self._mgr.save(step, args=ocp.args.StandardSave(tree), force=force)
+        tree = _to_arrays(state_dict)
+        gtree = _globalize(tree)
+
+        def _submit():
+            resilience.maybe_fault("ckpt_io")
+            return self._mgr.save(step, args=ocp.args.StandardSave(gtree),
+                                  force=force)
+
+        saved = resilience.call_with_retry(
+            _submit, name="ckpt.save", policy=resilience.io_policy())
+        if saved:
+            resilience.bump("ckpt.saves")
+            if flags.flag("ckpt_manifest") and jax.process_index() == 0:
+                # checksums come from the host-local view (pre-globalize):
+                # same values, no global-array device round trip
+                _atomic_write_json(
+                    os.path.join(self._manifest_dir, f"{step}.json"),
+                    {"step": int(step), "leaves": _manifest_entries(tree)})
+                self._gc_manifests(keep=step)
+        return saved
+
+    def _gc_manifests(self, keep: int) -> None:
+        """Drop manifests for steps orbax's retention already deleted. The
+        ``keep``/newer manifests always survive: an async save's step is not
+        in ``all_steps()`` until its commit."""
+        try:
+            live = set(self._mgr.all_steps())
+            for name in os.listdir(self._manifest_dir):
+                stem = name.rsplit(".", 1)[0]
+                if stem.isdigit() and int(stem) < keep and int(stem) not in live:
+                    os.remove(os.path.join(self._manifest_dir, name))
+        except OSError:
+            pass
+
+    def _read_manifest(self, step: int) -> Optional[dict]:
+        try:
+            with open(os.path.join(self._manifest_dir, f"{step}.json")) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
 
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
 
+    def all_steps(self) -> List[int]:
+        return sorted(self._mgr.all_steps())
+
+    def latest_valid_step(self) -> Optional[int]:
+        """The newest step that restores cleanly AND passes its manifest —
+        the auto-resume target. This reads the checkpoint data (the only way
+        to catch a torn tensorstore write; it shares :meth:`restore`'s
+        newest-first scan); use plain :meth:`latest_step` when integrity
+        scanning is not needed."""
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            try:
+                tree = self.restore()
+            except Exception:  # every existing step invalid
+                return None
+        return self.last_restored_step if tree is not None else None
+
+    def _restore_verified(self, step: int, target):
+        """Restore one step (retried IO with a ``ckpt_io`` probe) and verify
+        it against its manifest; raises CheckpointIntegrityError on
+        mismatch. A step without a manifest restores unverified (pre-manifest
+        checkpoints stay loadable)."""
+        out = _restore_tree(
+            lambda args: self._mgr.restore(step, args=args), target)
+        if flags.flag("ckpt_manifest"):
+            manifest = self._read_manifest(step)
+            if manifest is not None:
+                bad = _manifest_mismatches(manifest.get("leaves", {}), out)
+                if bad:
+                    raise CheckpointIntegrityError(
+                        f"checkpoint step {step} failed verification: "
+                        + "; ".join(bad[:5]))
+        return out
+
     def restore(self, target: Optional[Dict[str, Any]] = None,
                 step: Optional[int] = None):
-        """Restore latest (or given) step.
+        """Restore latest valid (or given) step.
 
         With ``target`` the stored values are resharded onto the target's
         shardings (multi-host / mesh-change case). Without it the saved tree
         comes back as plain arrays — useful when parts of the state (e.g.
         lazily-created optimizer moments) don't exist yet in this process.
-        """
-        import orbax.checkpoint as ocp
 
-        step = self.latest_step() if step is None else step
-        if step is None:
+        Without ``step``, candidates are scanned newest-first and the first
+        step that restores cleanly AND passes manifest verification wins —
+        a truncated or corrupted newest step (kill mid-save, bit rot) is
+        skipped in favor of the previous complete one instead of crashing
+        the resume. ``last_restored_step`` records which step was used;
+        ``None`` is returned when no step exists at all. When steps exist
+        but EVERY one fails, the newest step's error is re-raised: a
+        systematic failure (target tree no longer matches the run, orbax/
+        mesh incompatibility) must not be misread as per-step corruption
+        and silently restart training from scratch.
+
+        With an explicit ``step``: a never-saved step raises ``ValueError``
+        listing the available steps; a corrupt one raises
+        :class:`CheckpointIntegrityError` (the caller asked for that exact
+        step — silently substituting another would be worse than failing).
+        """
+        steps = self.all_steps()
+        if step is not None:
+            if step not in steps:
+                raise ValueError(
+                    f"TrainCheckpointer.restore: step {step} was never saved "
+                    f"under {self._dir}; available steps: "
+                    f"{steps if steps else '(none)'}")
+            out = self._restore_verified(step, target)
+            self.last_restored_step = step
+            return out
+        first_exc: Optional[BaseException] = None
+        for s in reversed(steps):
+            try:
+                out = self._restore_verified(s, target)
+            except Exception as e:  # torn orbax step / manifest mismatch
+                first_exc = first_exc or e
+                resilience.bump("ckpt.invalid_steps")
+                warnings.warn(
+                    f"checkpoint step {s} is invalid ({type(e).__name__}: "
+                    f"{e}); falling back to the previous step")
+                continue
+            self.last_restored_step = s
+            return out
+        if first_exc is not None:
+            raise first_exc
+        return None
+
+    # ------------------------------------------------- preemption contract
+
+    def write_resume_marker(self, step: int, reason: str = "") -> None:
+        """Record a clean preemption shutdown (PreemptionGuard writes this
+        after the final synchronous save committed). Informational: restore()
+        auto-resumes from the latest valid step with or without it."""
+        if jax.process_index() != 0:
+            return
+        _atomic_write_json(os.path.join(self._dir, "RESUME.json"),
+                           {"step": int(step), "reason": reason,
+                            "time": time.time()})
+
+    def resume_marker(self) -> Optional[dict]:
+        try:
+            with open(os.path.join(self._dir, "RESUME.json")) as f:
+                return json.load(f)
+        except (OSError, ValueError):
             return None
-        if target is None:
-            return _localize(
-                self._mgr.restore(step, args=ocp.args.StandardRestore()))
-        tgt = _to_arrays(target)
-        abstract = _abstract_tree(tgt)
-        return _localize_like(self._mgr.restore(
-            step, args=ocp.args.StandardRestore(abstract)), tgt)
+
+    def clear_resume_marker(self) -> None:
+        try:
+            os.remove(os.path.join(self._dir, "RESUME.json"))
+        except OSError:
+            pass
 
     def wait_until_finished(self):
         self._mgr.wait_until_finished()

@@ -29,6 +29,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec
 
 from . import mesh as mesh_mod
+from .sharding_util import shard_map_compat
 
 SEP_AXIS = "sep"
 _NEG_INF = -1e30  # finite: keeps exp(m_old - m_new) well-defined for empty rows
@@ -112,7 +113,7 @@ def ring_attention(
         return jnp.swapaxes(out, 1, 2)
 
     spec = PartitionSpec(None, SEP_AXIS, None, None)
-    fn = jax.shard_map(
+    fn = shard_map_compat(
         f, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         axis_names={SEP_AXIS}, check_vma=True,
     )
@@ -153,7 +154,7 @@ def ulysses_attention(
         return rev(out)
 
     spec = PartitionSpec(None, SEP_AXIS, None, None)
-    fn = jax.shard_map(
+    fn = shard_map_compat(
         f, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         axis_names={SEP_AXIS}, check_vma=True,
     )

@@ -133,6 +133,18 @@ class ElasticManager:
             time.sleep(self.lease / 4)
         return False
 
+    def bind_preemption_guard(self, guard,
+                              interval: Optional[float] = None
+                              ) -> threading.Thread:
+        """Feed the dead-peer signal into a ``core.resilience``
+        PreemptionGuard: when a peer's lease expires, the guard requests a
+        step-boundary shutdown, so the surviving ranks checkpoint and exit
+        cleanly for the elastic relaunch instead of hanging in a collective
+        against a dead peer."""
+        return self.watch(
+            lambda dead: guard.request(f"elastic dead peers {dead}"),
+            interval=interval)
+
     def watch(self, on_change: Callable[[List[int]], None],
               interval: Optional[float] = None) -> threading.Thread:
         """Poll membership; invoke ``on_change(dead_ranks)`` when a lease

@@ -51,10 +51,19 @@ def init_parallel_env() -> Optional[Group]:
             # transport; gloo is jaxlib's CPU implementation. No-op on TPU,
             # where collectives ride ICI/DCN inside the compiled program.
             jax.config.update("jax_cpu_collectives_implementation", "gloo")
-            jax.distributed.initialize(
+            from ..core import resilience
+
+            # the coordinator (rank 0) races worker startup: a refused/
+            # timed-out rendezvous heals under backoff; "already initialized"
+            # can never heal and short-circuits straight to the except below
+            resilience.call_with_retry(
+                jax.distributed.initialize,
                 coordinator_address=eps[0],
                 num_processes=len(eps),
                 process_id=rank,
+                name="dist.init",
+                policy=resilience.default_policy(
+                    giveup=lambda e: "already" in str(e).lower()),
             )
         except Exception as e:  # already initialized / single-host tests
             if "already" not in str(e).lower():

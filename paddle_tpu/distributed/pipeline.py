@@ -44,6 +44,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from . import mesh as mesh_mod
+from .sharding_util import shard_map_compat
 
 PIPE_AXIS = "pipe"
 
@@ -140,7 +141,7 @@ def pipeline_apply(
         jax.tree.map(lambda _: PartitionSpec(PIPE_AXIS), stage_params),
         PartitionSpec(),
     )
-    fn = jax.shard_map(
+    fn = shard_map_compat(
         _pipelined,
         mesh=mesh,
         in_specs=in_specs,
@@ -308,7 +309,7 @@ def pipeline_apply_interleaved(
         jax.tree.map(lambda _: PartitionSpec(None, PIPE_AXIS), chunk_params),
         PartitionSpec(),
     )
-    fn = jax.shard_map(
+    fn = shard_map_compat(
         _pipelined,
         mesh=mesh,
         in_specs=in_specs,

@@ -22,6 +22,32 @@ def _mesh() -> Mesh:
     return mesh_mod.ensure_mesh()
 
 
+def shard_map_compat(f, mesh, in_specs, out_specs, check_vma=False,
+                     axis_names=None):
+    """``jax.shard_map`` across jax versions (degraded-environment
+    robustness): the public API when this jax has it, else
+    ``jax.experimental.shard_map`` with the old kwarg name (``check_rep``
+    for ``check_vma``). Full-manual maps only on the fallback: the old
+    API's partial-manual (``auto``) mode is unreliable (NotImplementedError
+    and worse on 0.4.x), so ``axis_names`` callers fail with a clear error
+    there instead of entering it."""
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        kw = {"check_vma": check_vma}
+        if axis_names is not None:
+            kw["axis_names"] = axis_names
+        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw)
+    if axis_names is not None:
+        raise NotImplementedError(
+            "partial-manual shard_map (axis_names=...) needs a jax with the "
+            "public jax.shard_map API; this jax only has the experimental "
+            "full-manual fallback")
+    from jax.experimental.shard_map import shard_map as esm
+
+    return esm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=check_vma)
+
+
 def _prune_spec(mesh: Mesh, spec):
     """Drop axis names that aren't on the mesh or have size 1."""
     out = []

@@ -9,6 +9,7 @@ from __future__ import annotations
 import ctypes
 from typing import Optional
 
+from ..core import resilience
 from ..native import load as _load_native
 
 
@@ -24,12 +25,24 @@ class TCPStore:
                 raise RuntimeError(f"TCPStore: cannot bind port {port}")
             port = self._lib.pt_store_server_port(self._server)
         self._port = port
-        self._client = self._lib.pt_store_connect(
-            host.encode() if host != "localhost" else b"127.0.0.1", port, timeout)
-        if not self._client:
+        addr = host.encode() if host != "localhost" else b"127.0.0.1"
+
+        def _connect():
+            client = self._lib.pt_store_connect(addr, port, timeout)
+            if not client:
+                raise RuntimeError(
+                    f"TCPStore: cannot connect to {host}:{port}")
+            return client
+
+        try:
+            # rank 0's server comes up asynchronously with the pod: a
+            # refused connection during startup heals under backoff
+            self._client = resilience.call_with_retry(
+                _connect, name="tcpstore.connect")
+        except RuntimeError:
             if self._server:
                 self._lib.pt_store_server_stop(self._server)
-            raise RuntimeError(f"TCPStore: cannot connect to {host}:{port}")
+            raise
         self._barrier_seq = 0
 
     @property

@@ -13,6 +13,7 @@ import pickle
 import jax
 import numpy as np
 
+from ..core import resilience
 from ..core.tensor import Tensor
 
 
@@ -47,11 +48,17 @@ def _from_saveable(obj, return_numpy=False):
 
 
 def save(obj, path, protocol=4, **configs):
-    d = os.path.dirname(path)
-    if d:
-        os.makedirs(d, exist_ok=True)
-    with open(path, "wb") as f:
-        pickle.dump(_to_saveable(obj), f, protocol=protocol)
+    """Atomic save via ``resilience.atomic_write`` (temp file in the target
+    directory, fsync, ``os.replace``) — a kill mid-save can no longer leave
+    a truncated pickle for ``load`` to crash on; the previous complete file
+    survives until the rename commits. The pickle streams straight into the
+    temp file (no in-RAM copy of a multi-GB state dict); the write is
+    retried under the IO policy (with a ``ckpt_io`` fault probe for the
+    chaos suite)."""
+    saveable = _to_saveable(obj)
+    resilience.atomic_write(
+        path, lambda f: pickle.dump(saveable, f, protocol=protocol),
+        name="paddle.save")
 
 
 def load(path, **configs):

@@ -25,7 +25,7 @@ from typing import Callable, Dict, List, Optional
 import jax
 import jax.numpy as jnp
 
-from ..core import compile_cache, flags, rng
+from ..core import compile_cache, flags, resilience, rng
 from ..core.tensor import Tensor
 from ..nn.layer import Layer, mutation_sink
 
@@ -617,6 +617,8 @@ class TrainStep:
             self._buffers.extend(extra_state)
         self._opt_state = None
         self._jit_fn = None
+        self._sentinel = False  # set at build time from FLAGS_trainstep_sentinel
+        self._bad_steps = 0  # consecutive nonfinite steps (sentinel rollback)
 
     def _loss_with_sink(self, pa, buf_arrays, key, args):
         """value_and_grad target shared by both build paths: swap state in,
@@ -659,10 +661,55 @@ class TrainStep:
         copying build for A/B verification."""
         return (0, 2) if flags.flag("trainstep_donate") else ()
 
+    def _guarded_update(self, param_arrays, grads, loss, opt_state, lr):
+        """NaN/Inf step sentinel: ONE fused finiteness reduction over
+        loss+grads decides between the optimizer update and an identity step
+        via ``lax.cond`` — both branches live in the same compiled program,
+        so a bad step never recompiles. The skip branch returns params and
+        optimizer state unchanged: a nonfinite step leaves training state
+        bit-identical to pre-step (and the optimizer step counter does not
+        advance); ``__call__`` additionally withholds the step's buffer
+        mutations, so BN-style running stats stay clean too. Returns
+        ``(new_params, new_state, finite)``."""
+        finite = jnp.isfinite(loss)
+        for g in grads:
+            finite = jnp.logical_and(finite, jnp.all(jnp.isfinite(g)))
+
+        def _apply(_):
+            return self._apply_optimizer(param_arrays, grads, opt_state, lr)
+
+        def _skip(_):
+            return list(param_arrays), opt_state
+
+        new_params, new_state = jax.lax.cond(finite, _apply, _skip, None)
+        return new_params, new_state, finite
+
     def _build(self):
         compile_cache.bump("train_step.builds")
+        self._sentinel = bool(flags.flag("trainstep_sentinel"))
         if self._accumulate_steps > 1:
             self._build_accum(self._accumulate_steps, self._accumulate_avg)
+            return
+
+        if self._sentinel:
+            @functools.partial(jax.jit, donate_argnums=self._donate_argnums())
+            def _step_sentinel(param_arrays, buffer_arrays, opt_state, lr,
+                               key, scale, args):
+                def loss_f(pa):
+                    loss, mutated = self._loss_with_sink(
+                        pa, buffer_arrays, key, args)
+                    # scale is 1.0 outside fault injection — a bit-exact
+                    # identity; an injected NaN poisons the loss AND (chain
+                    # rule) every grad, exercising the full sentinel path
+                    return loss * scale, mutated
+
+                (loss, mutated), grads = jax.value_and_grad(
+                    loss_f, has_aux=True)(list(param_arrays))
+                new_params, new_state, finite = self._guarded_update(
+                    param_arrays, grads, loss, opt_state, lr)
+                return loss, new_params, new_state, mutated, finite
+
+            self._jit_fn = _step_sentinel
             return
 
         @functools.partial(jax.jit, donate_argnums=self._donate_argnums())
@@ -684,8 +731,7 @@ class TrainStep:
         ref:python/paddle/distributed/passes/auto_parallel_gradient_merge.py:26
         (accumulate ops + conditional optimizer block become a lax.scan)."""
 
-        @functools.partial(jax.jit, donate_argnums=self._donate_argnums())
-        def _step(param_arrays, buffer_arrays, opt_state, lr, key, args):
+        def _core(param_arrays, buffer_arrays, key, sentinel_scale, args):
             micro = jax.tree_util.tree_map(
                 lambda a: a.reshape((k, a.shape[0] // k) + a.shape[1:]), args)
 
@@ -694,7 +740,11 @@ class TrainStep:
                 mkey = jax.random.fold_in(key, i)
 
                 def loss_f(pa):
-                    return self._loss_with_sink(pa, bufs, mkey, margs)
+                    loss, mutated = self._loss_with_sink(pa, bufs, mkey, margs)
+                    # sentinel_scale is 1.0 outside fault injection (the
+                    # non-sentinel build bakes the constant in): bit-exact
+                    # identity; an injected NaN poisons loss and grads
+                    return loss * sentinel_scale, mutated
 
                 (loss, mutated), grads = jax.value_and_grad(
                     loss_f, has_aux=True)(list(param_arrays))
@@ -714,14 +764,33 @@ class TrainStep:
             # param dtype) — never round the total through bf16 first
             scale = (1.0 / k) if avg else 1.0
             grads = [a * scale for a in acc]
-            new_params, new_state = self._apply_optimizer(
-                param_arrays, grads, opt_state, lr)
             # every buffer passed through the scan carry: return them all
             # (loop-invariant ones come back value-equal; __call__ rebinds)
             # reported loss follows the configured semantics: the microbatch
             # MEAN under avg=True, the SUM under avg=False — matching what
             # the gradients were scaled by
-            return lsum * scale, new_params, new_state, new_bufs
+            return lsum * scale, grads, new_bufs
+
+        if self._sentinel:
+            @functools.partial(jax.jit, donate_argnums=self._donate_argnums())
+            def _step_sentinel(param_arrays, buffer_arrays, opt_state, lr,
+                               key, scale, args):
+                loss, grads, new_bufs = _core(
+                    param_arrays, buffer_arrays, key, scale, args)
+                new_params, new_state, finite = self._guarded_update(
+                    param_arrays, grads, loss, opt_state, lr)
+                return loss, new_params, new_state, new_bufs, finite
+
+            self._jit_fn = _step_sentinel
+            return
+
+        @functools.partial(jax.jit, donate_argnums=self._donate_argnums())
+        def _step(param_arrays, buffer_arrays, opt_state, lr, key, args):
+            loss, grads, new_bufs = _core(
+                param_arrays, buffer_arrays, key, 1.0, args)
+            new_params, new_state = self._apply_optimizer(
+                param_arrays, grads, opt_state, lr)
+            return loss, new_params, new_state, new_bufs
 
         self._jit_fn = _step
 
@@ -766,20 +835,56 @@ class TrainStep:
         param_arrays = tuple(p._data for p in self._train_params)
         buffer_arrays = tuple(b._data for b in self._buffers)
         lr = jnp.asarray(self._opt.get_lr(), jnp.float32)
-        loss, new_params, self._opt_state, mutated = self._jit_fn(
-            param_arrays, buffer_arrays, self._opt_state, lr, rng.next_key(), args
-        )
+        finite = None
+        if self._sentinel:
+            # nonfinite_grads injection rides a runtime scalar (no recompile)
+            scale = jnp.asarray(
+                float("nan") if resilience.maybe_fault("nonfinite_grads")
+                else 1.0, jnp.float32)
+            loss, new_params, self._opt_state, mutated, finite = self._jit_fn(
+                param_arrays, buffer_arrays, self._opt_state, lr,
+                rng.next_key(), scale, args)
+        else:
+            loss, new_params, self._opt_state, mutated = self._jit_fn(
+                param_arrays, buffer_arrays, self._opt_state, lr,
+                rng.next_key(), args)
+        # params/opt state MUST rebind even on a skipped step (donation
+        # invalidated the old arrays; the skip branch returned them through)
         for p, np_ in zip(self._train_params, new_params):
             p._data = np_
-        for b, m in zip(self._buffers, mutated):
-            if m is not None:
-                b._data = m
+        finite_b = True if finite is None else bool(finite)
+        if finite_b:
+            # buffer mutations (BN running stats) were computed during the
+            # possibly-poisoned forward: commit them ONLY on finite steps,
+            # or a skipped step would still contaminate persistent buffers
+            # (buffers are not donated, so the old arrays stay valid)
+            for b, m in zip(self._buffers, mutated):
+                if m is not None:
+                    b._data = m
         # keep the optimizer's own accumulators coherent with the compiled
         # state so opt.state_dict() after TrainStep training is truthful
         # (device arrays are shared by reference — no transfer)
         for p, ns in zip(self._train_params, self._opt_state["slots"]):
             self._opt._accumulators[id(p)] = ns
         self._opt._step_count = int(self._opt_state["step"])
+        # a compiled step IS an optimizer step: advance the tensor checker's
+        # debug_step window (Optimizer.step does the same on the eager path;
+        # without this a TrainStep run would freeze the window at 0)
+        mark = getattr(self._opt, "_mark_checker_step", None)
+        if mark is not None:
+            mark()
+        if finite is not None:
+            if finite_b:
+                self._bad_steps = 0
+            else:
+                resilience.bump("sentinel.skipped")
+                self._bad_steps += 1
+                limit = int(flags.flag("max_bad_steps"))
+                if limit > 0 and self._bad_steps >= limit:
+                    self._bad_steps = 0
+                    resilience.trigger_rollback(
+                        f"TrainStep: {limit} consecutive nonfinite steps "
+                        "(loss/grads)")
         return Tensor(loss)
 
 

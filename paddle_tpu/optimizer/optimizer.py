@@ -17,6 +17,7 @@ from typing import Dict, List, Optional
 import jax
 import jax.numpy as jnp
 
+from ..core import flags
 from ..core.tensor import Tensor
 from .lr import LRScheduler
 
@@ -115,6 +116,16 @@ class Optimizer:
         return self._update
 
     # --------------------------------------------------------- eager path
+    @staticmethod
+    def _mark_checker_step():
+        """Advance the tensor checker's debug_step window (amp.debugging.
+        TensorCheckerConfig.debug_step). Called at the END of step() so the
+        window covers this step's own update-math ops too."""
+        if flags.flag("check_nan_inf"):
+            from ..amp.debugging import mark_step
+
+            mark_step()
+
     def step(self):
         if self._parameter_list is None:
             raise ValueError("optimizer created without a parameter list")
@@ -122,6 +133,7 @@ class Optimizer:
         lr = self.get_lr()
         params = [p for p in self._parameter_list if p.grad is not None and not p.stop_gradient]
         if not params:
+            self._mark_checker_step()
             return
         grads = [p.grad._data for p in params]
         if self._grad_clip is not None:
@@ -139,6 +151,7 @@ class Optimizer:
             )
             p._data = new_p
             self._accumulators[id(p)] = new_slots
+        self._mark_checker_step()
 
     minimize = None  # set below
 

@@ -125,9 +125,10 @@ class Profiler:
                 jax.profiler.start_trace(self._device_dir)
             except Exception:
                 self._device_dir = None
-        from ..core import compile_cache
+        from ..core import compile_cache, resilience
 
         self._cc_start = compile_cache.stats()
+        self._rs_start = resilience.stats()
         self._running = True
 
     def stop(self):
@@ -144,12 +145,16 @@ class Profiler:
                 jax.profiler.stop_trace()
             except Exception:
                 pass
-        from ..core import compile_cache
+        from ..core import compile_cache, resilience
 
         # numeric deltas over the profiled window (counts AND seconds);
         # non-numeric keys (dir/enabled) ride along as-is
         self.compile_cache_stats = compile_cache.stats_delta(
             getattr(self, "_cc_start", {}), compile_cache.stats())
+        # same treatment for the resilience counters (sentinel skips,
+        # retries, preemption requests over the profiled window)
+        self.resilience_stats = resilience.stats_delta(
+            getattr(self, "_rs_start", {}), resilience.stats())
         self._running = False
         if self.on_trace_ready is not None:
             self.on_trace_ready(self)
@@ -238,13 +243,16 @@ class Profiler:
         stat = StatisticData(self._events(), self._memory_steps)
         table = build_views(stat, views, sorted_by, time_unit,
                             op_limit=60 if op_detail else 10)
-        cc = getattr(self, "compile_cache_stats", None)
-        if cc and views is None:
-            nz = {k: v for k, v in sorted(cc.items())
+        for title, rec in (
+                ("Compile Cache", getattr(self, "compile_cache_stats", None)),
+                ("Resilience", getattr(self, "resilience_stats", None))):
+            if not rec or views is not None:
+                continue
+            nz = {k: v for k, v in sorted(rec.items())
                   if isinstance(v, (int, float))
                   and not isinstance(v, bool) and v}
             if nz:
-                lines = ["", "[ Compile Cache Summary (this profile) ]",
+                lines = ["", f"[ {title} Summary (this profile) ]",
                          "-" * 46]
                 lines += [f"{k:<34}{v:>12}" for k, v in nz.items()]
                 table = table + "\n".join(lines)

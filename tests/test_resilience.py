@@ -1,0 +1,651 @@
+"""core.resilience: retry policy, fault injection, NaN/Inf step sentinel,
+checkpoint integrity/fallback, preemption guard — the chaos suite
+(ISSUE 3). Fault-driven cases carry the ``chaos`` marker; the end-to-end
+SIGTERM preemption test is additionally ``slow`` (two subprocess runs)."""
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.core import flags, resilience
+from paddle_tpu.distributed.checkpoint import (
+    CheckpointIntegrityError,
+    TrainCheckpointer,
+)
+from paddle_tpu.jit import TrainStep
+from paddle_tpu.optimizer import Adam
+
+_FLAG_KEYS = ("fault_injection", "max_bad_steps", "trainstep_sentinel",
+              "ckpt_manifest", "io_retries", "io_retry_backoff",
+              "io_retry_deadline", "inject_faults", "check_nan_inf",
+              "check_nan_inf_level")
+
+
+@pytest.fixture(autouse=True)
+def _clean_resilience_state():
+    keep = {k: paddle.get_flags(k)[k] for k in _FLAG_KEYS}
+    resilience.reset_stats()
+    try:
+        yield
+    finally:
+        resilience.clear_faults()
+        resilience.set_rollback_handler(None)
+        paddle.set_flags(keep)
+
+
+def _small_net(seed=0):
+    paddle.seed(seed)
+    net = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 1))
+    opt = Adam(learning_rate=1e-2, parameters=net.parameters())
+    return net, opt
+
+
+def _batch():
+    r = np.random.RandomState(0)
+    return (paddle.to_tensor(r.rand(8, 4).astype(np.float32)),
+            paddle.to_tensor(r.rand(8, 1).astype(np.float32)))
+
+
+def _param_bytes(net):
+    return {k: np.asarray(v._data).copy() for k, v in net.state_dict().items()}
+
+
+# ------------------------------------------------------------------- retry
+
+
+def test_retry_succeeds_after_transient_failures():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("transient")
+        return "ok"
+
+    out = resilience.call_with_retry(
+        flaky, policy=resilience.RetryPolicy(max_attempts=5,
+                                             base_delay=0.001),
+        name="unit")
+    assert out == "ok" and len(calls) == 3
+    s = resilience.stats()
+    assert s["retry.retries"] == 2 and s["retry.unit"] == 2
+
+
+def test_retry_exhausts_and_reraises_original():
+    def always():
+        raise ValueError("permanent")
+
+    with pytest.raises(ValueError, match="permanent"):
+        resilience.call_with_retry(
+            always, policy=resilience.RetryPolicy(max_attempts=2,
+                                                  base_delay=0.001))
+    assert resilience.stats()["retry.exhausted"] == 1
+
+
+def test_retry_giveup_short_circuits():
+    calls = []
+
+    def fatal():
+        calls.append(1)
+        raise RuntimeError("already initialized")
+
+    with pytest.raises(RuntimeError):
+        resilience.call_with_retry(
+            fatal,
+            policy=resilience.RetryPolicy(
+                max_attempts=5, base_delay=0.001,
+                giveup=lambda e: "already" in str(e)))
+    assert len(calls) == 1  # no retries for an unhealable error
+
+
+def test_retry_deadline_bounds_attempts():
+    calls = []
+
+    def slow_fail():
+        calls.append(1)
+        time.sleep(0.03)
+        raise OSError("x")
+
+    with pytest.raises(OSError):
+        resilience.call_with_retry(
+            slow_fail, policy=resilience.RetryPolicy(
+                max_attempts=100, base_delay=0.001, deadline=0.05))
+    assert len(calls) < 10
+
+
+# --------------------------------------------------------- fault injection
+
+
+def test_inject_fault_requires_flag():
+    with pytest.raises(RuntimeError, match="FLAGS_fault_injection"):
+        resilience.inject_fault("ckpt_io")
+    assert resilience.maybe_fault("ckpt_io") is False  # inert when off
+
+
+@pytest.mark.chaos
+def test_fault_fires_deterministically():
+    paddle.set_flags({"FLAGS_fault_injection": True})
+    resilience.inject_fault("preempt", times=2, after=1)
+    assert resilience.maybe_fault("preempt") is False  # the `after` pass
+    assert resilience.maybe_fault("preempt") is True
+    assert resilience.maybe_fault("preempt") is True
+    assert resilience.maybe_fault("preempt") is False  # disarmed
+    assert resilience.stats()["fault.preempt"] == 2
+    resilience.inject_fault("ckpt_io", exc=OSError("boom"))
+    with pytest.raises(OSError, match="boom"):
+        resilience.maybe_fault("ckpt_io")
+
+
+@pytest.mark.chaos
+def test_env_armed_faults():
+    paddle.set_flags({"FLAGS_fault_injection": True,
+                      "FLAGS_inject_faults": "preempt:1:1"})
+    resilience.clear_faults()
+    resilience._env_faults_loaded = False
+    assert resilience.maybe_fault("preempt") is False
+    assert resilience.maybe_fault("preempt") is True
+    assert resilience.maybe_fault("preempt") is False
+
+
+# ------------------------------------------------- atomic paddle_tpu.save
+
+
+@pytest.mark.chaos
+def test_kill_mid_save_preserves_previous_file(tmp_path):
+    path = os.path.join(str(tmp_path), "model.pdparams")
+    paddle.save({"w": np.arange(4, dtype=np.float32)}, path)
+    paddle.set_flags({"FLAGS_fault_injection": True, "FLAGS_io_retries": 1})
+    resilience.inject_fault("ckpt_io", exc=OSError("killed mid-save"))
+    with pytest.raises(OSError):
+        paddle.save({"w": np.zeros(999, dtype=np.float32)}, path)
+    # the interrupted save left the previous complete pickle, no tmp litter
+    out = paddle.load(path, return_numpy=True)
+    np.testing.assert_array_equal(out["w"], np.arange(4, dtype=np.float32))
+    assert not [f for f in os.listdir(str(tmp_path)) if f.endswith(".tmp")]
+
+
+@pytest.mark.chaos
+def test_save_retries_transient_io(tmp_path):
+    path = os.path.join(str(tmp_path), "model.pdparams")
+    paddle.set_flags({"FLAGS_fault_injection": True,
+                      "FLAGS_io_retry_backoff": 0.001})
+    resilience.inject_fault("ckpt_io", times=1, exc=OSError("transient"))
+    paddle.save({"w": np.arange(3)}, path)  # first attempt fails, retry wins
+    assert resilience.stats()["retry.paddle.save"] >= 1
+    np.testing.assert_array_equal(
+        paddle.load(path, return_numpy=True)["w"], np.arange(3))
+
+
+# --------------------------------------------------- checkpoint integrity
+
+
+def _ckpt_with_two_steps(tmp_path):
+    net, _ = _small_net()
+    ck = TrainCheckpointer(os.path.join(str(tmp_path), "mgr"), max_to_keep=4)
+    step1_values = _param_bytes(net)  # set_value below mutates in place
+    ck.save(1, {k: v for k, v in net.state_dict().items()})
+    net[0].weight.set_value(paddle.to_tensor(np.ones((4, 8), np.float32)))
+    ck.save(2, {k: v for k, v in net.state_dict().items()})
+    ck.wait_until_finished()
+    return ck, step1_values
+
+
+def test_restore_missing_step_raises_clear_error(tmp_path):
+    ck, _ = _ckpt_with_two_steps(tmp_path)
+    with pytest.raises(ValueError, match=r"available steps: \[1, 2\]"):
+        ck.restore(step=7)
+
+
+def test_manifests_written_and_gcd(tmp_path):
+    net, _ = _small_net()
+    ck = TrainCheckpointer(os.path.join(str(tmp_path), "mgr"), max_to_keep=2)
+    for s in (1, 2, 3):
+        ck.save(s, {k: v for k, v in net.state_dict().items()})
+        ck.wait_until_finished()
+    mdir = os.path.join(str(tmp_path), "mgr", "manifests")
+    kept = sorted(int(n.split(".")[0]) for n in os.listdir(mdir))
+    assert kept == [2, 3]  # step 1 retired with orbax's retention
+
+
+@pytest.mark.chaos
+def test_truncated_newest_step_falls_back(tmp_path):
+    import glob
+
+    ck, sd1 = _ckpt_with_two_steps(tmp_path)
+    step2 = os.path.join(str(tmp_path), "mgr", "2")
+    victims = [p for p in glob.glob(os.path.join(step2, "**", "*"),
+                                    recursive=True)
+               if os.path.isfile(p) and os.path.getsize(p) > 0]
+    assert victims, "expected data files in the step dir"
+    for v in victims:  # simulate the kill mid-write: zero-length files
+        open(v, "wb").close()
+    paddle.set_flags({"FLAGS_io_retries": 1})  # fail fast on the dead step
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        out = ck.restore()
+    assert ck.last_restored_step == 1
+    np.testing.assert_array_equal(np.asarray(out["0.weight"]),
+                                  sd1["0.weight"])
+    assert resilience.stats()["ckpt.invalid_steps"] >= 1
+
+
+@pytest.mark.chaos
+def test_checksum_mismatch_falls_back_and_explicit_step_raises(tmp_path):
+    ck, sd1 = _ckpt_with_two_steps(tmp_path)
+    mpath = os.path.join(str(tmp_path), "mgr", "manifests", "2.json")
+    with open(mpath) as f:
+        manifest = json.load(f)
+    leaf = next(iter(manifest["leaves"]))
+    manifest["leaves"][leaf]["crc32"] = 12345  # silent-corruption stand-in
+    with open(mpath, "w") as f:
+        json.dump(manifest, f)
+    # explicit step: the caller asked for step 2 — fail loudly
+    with pytest.raises(CheckpointIntegrityError, match="checksum"):
+        ck.restore(step=2)
+    # auto-resume: skip the bad step, land on the previous valid one
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        ck.restore()
+    assert ck.last_restored_step == 1
+    assert ck.latest_valid_step() == 1
+
+
+@pytest.mark.chaos
+def test_ckpt_save_restore_retry_transient_fault(tmp_path):
+    net, _ = _small_net()
+    ck = TrainCheckpointer(os.path.join(str(tmp_path), "mgr"))
+    paddle.set_flags({"FLAGS_fault_injection": True,
+                      "FLAGS_io_retry_backoff": 0.001})
+    resilience.inject_fault("ckpt_io", times=1, exc=OSError("flaky fs"))
+    ck.save(1, {k: v for k, v in net.state_dict().items()})
+    ck.wait_until_finished()
+    assert resilience.stats()["retry.ckpt.save"] >= 1
+    resilience.inject_fault("ckpt_io", times=1, exc=OSError("flaky fs"))
+    assert ck.restore() is not None
+    assert resilience.stats()["retry.ckpt.restore"] >= 1
+
+
+# ------------------------------------------------------- NaN/Inf sentinel
+
+
+@pytest.mark.chaos
+def test_injected_nonfinite_step_skips_update_bit_identical():
+    net, opt = _small_net()
+    X, Y = _batch()
+    step = TrainStep(lambda x, y: ((net(x) - y) ** 2).mean(), opt,
+                     layers=net)
+    step(X, Y)  # one good step so optimizer state exists
+    before = _param_bytes(net)
+    opt_step_before = opt._step_count
+    paddle.set_flags({"FLAGS_fault_injection": True})
+    resilience.inject_fault("nonfinite_grads", times=1)
+    loss = step(X, Y)
+    assert not np.isfinite(float(loss.numpy()))
+    after = _param_bytes(net)
+    for k in before:  # params bit-identical to pre-step
+        np.testing.assert_array_equal(before[k], after[k])
+    assert opt._step_count == opt_step_before  # no optimizer advance
+    assert resilience.stats()["sentinel.skipped"] == 1
+    # training recovers on the next (clean) step
+    assert np.isfinite(float(step(X, Y).numpy()))
+    assert opt._step_count == opt_step_before + 1
+
+
+@pytest.mark.chaos
+def test_skipped_step_does_not_poison_buffers():
+    """BN running stats are computed during the (poisoned) forward; a
+    skipped step must withhold them too, or eval-mode outputs go NaN even
+    though the sentinel reported the step as safely skipped."""
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(4, 8), nn.BatchNorm1D(8), nn.Linear(8, 1))
+    opt = Adam(learning_rate=1e-2, parameters=net.parameters())
+    X, Y = _batch()
+    step = TrainStep(lambda x, y: ((net(x) - y) ** 2).mean(), opt,
+                     layers=net)
+    step(X, Y)
+    bufs_before = {k: np.asarray(b._data).copy()
+                   for k, b in net.named_buffers()}
+    assert bufs_before, "expected BN running-stat buffers"
+    paddle.set_flags({"FLAGS_fault_injection": True})
+    resilience.inject_fault("nonfinite_grads", times=1)
+    step(X, Y)
+    for k, b in net.named_buffers():
+        np.testing.assert_array_equal(bufs_before[k], np.asarray(b._data),
+                                      err_msg=k)
+    assert all(np.isfinite(np.asarray(b._data)).all()
+               for _, b in net.named_buffers())
+
+
+def test_tensor_checker_debug_step_window_and_warn_once():
+    from paddle_tpu.amp import debugging as dbg
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        dbg.enable_tensor_checker(
+            dbg.TensorCheckerConfig(checked_op_list=["matmul"]))
+        dbg.enable_tensor_checker(
+            dbg.TensorCheckerConfig(checked_op_list=["matmul"]))
+    assert len([x for x in w if "checked_op_list" in str(x.message)]) <= 1
+    dbg.disable_tensor_checker()
+
+    dbg.enable_tensor_checker(dbg.TensorCheckerConfig(debug_step=[0, 1]))
+    try:
+        x = paddle.to_tensor(np.array([1.0, 0.0], np.float32))
+        with pytest.raises(FloatingPointError):
+            x / x  # nan at index 1, step 0 -> inside the window
+        # an optimizer step advances the window (marked at the END of
+        # step(), so the step's own update ops were still covered)
+        p = paddle.to_tensor(np.ones(2, np.float32))
+        p.stop_gradient = False
+        from paddle_tpu.optimizer import SGD
+
+        opt = SGD(learning_rate=0.1, parameters=[p])
+        (p * paddle.to_tensor(np.ones(2, np.float32))).sum().backward()
+        opt.step()
+        assert not dbg.step_check_active()
+        x / x  # outside the window: no raise
+    finally:
+        dbg.disable_tensor_checker()
+
+
+def test_natural_nan_input_is_skipped():
+    net, opt = _small_net()
+    X, Y = _batch()
+    step = TrainStep(lambda x, y: ((net(x) - y) ** 2).mean(), opt,
+                     layers=net)
+    step(X, Y)
+    before = _param_bytes(net)
+    bad = np.asarray(X.numpy()).copy()
+    bad[0, 0] = np.nan
+    step(paddle.to_tensor(bad), Y)
+    for k, v in _param_bytes(net).items():
+        np.testing.assert_array_equal(before[k], v)
+    assert resilience.stats()["sentinel.skipped"] == 1
+
+
+def test_sentinel_results_bit_identical_to_disabled():
+    X, Y = _batch()
+
+    def run():
+        net, opt = _small_net(seed=7)
+        step = TrainStep(lambda x, y: ((net(x) - y) ** 2).mean(), opt,
+                         layers=net)
+        losses = [float(step(X, Y).numpy()) for _ in range(4)]
+        return losses, _param_bytes(net)
+
+    l_on, p_on = run()
+    paddle.set_flags({"FLAGS_trainstep_sentinel": False})
+    l_off, p_off = run()
+    assert l_on == l_off
+    for k in p_on:
+        np.testing.assert_array_equal(p_on[k], p_off[k])
+
+
+@pytest.mark.chaos
+def test_rollback_after_max_bad_steps_restores_checkpoint(tmp_path):
+    net, opt = _small_net()
+    X, Y = _batch()
+    step = TrainStep(lambda x, y: ((net(x) - y) ** 2).mean(), opt,
+                     layers=net)
+    step(X, Y)
+    ck = TrainCheckpointer(os.path.join(str(tmp_path), "mgr"))
+    ck.save(0, {"model": net.state_dict(), "opt": opt.state_dict()})
+    ck.wait_until_finished()
+    good = _param_bytes(net)
+
+    def rollback(reason):
+        restored = ck.restore()
+        net.set_state_dict(restored["model"])
+        opt.set_state_dict(restored["opt"])
+
+    resilience.set_rollback_handler(rollback)
+    paddle.set_flags({"FLAGS_fault_injection": True,
+                      "FLAGS_max_bad_steps": 2})
+    resilience.inject_fault("nonfinite_grads", times=2)
+    step(X, Y)
+    step(X, Y)  # second consecutive bad step triggers the rollback
+    assert resilience.stats()["sentinel.rollbacks"] == 1
+    for k, v in _param_bytes(net).items():
+        np.testing.assert_array_equal(good[k], v)
+    # post-rollback training proceeds (fresh compiled opt-state re-seed)
+    assert np.isfinite(float(step(X, Y).numpy()))
+
+
+@pytest.mark.chaos
+def test_rollback_without_handler_raises():
+    net, opt = _small_net()
+    X, Y = _batch()
+    step = TrainStep(lambda x, y: ((net(x) - y) ** 2).mean(), opt,
+                     layers=net)
+    paddle.set_flags({"FLAGS_fault_injection": True,
+                      "FLAGS_max_bad_steps": 1})
+    resilience.inject_fault("nonfinite_grads", times=1)
+    with pytest.raises(resilience.NonfiniteStepError):
+        step(X, Y)
+
+
+# ----------------------------------------------------------- preemption
+
+
+def test_preemption_guard_signal_requests_not_kills():
+    guard = resilience.PreemptionGuard()
+    try:
+        assert not guard.requested()
+        os.kill(os.getpid(), signal.SIGTERM)
+        deadline = time.time() + 5
+        while not guard.requested() and time.time() < deadline:
+            time.sleep(0.01)
+        assert guard.requested()  # still alive: the signal became a request
+        assert "signal" in guard.reason
+    finally:
+        guard.uninstall()
+
+
+def test_preemption_guard_second_signal_escalates():
+    """A hung step never reaches the boundary poll: the SECOND signal must
+    fall through to the previous handler instead of being swallowed."""
+    hits = []
+    sig = signal.SIGUSR1
+    prev = signal.signal(sig, lambda s, f: hits.append(s))
+    guard = resilience.PreemptionGuard(signals=(sig,))
+    try:
+        os.kill(os.getpid(), sig)
+        deadline = time.time() + 5
+        while not guard.requested() and time.time() < deadline:
+            time.sleep(0.01)
+        assert guard.requested() and not hits  # first: request, no chain
+        os.kill(os.getpid(), sig)
+        deadline = time.time() + 5
+        while not hits and time.time() < deadline:
+            time.sleep(0.01)
+        assert hits == [sig]  # second: escalated to the previous handler
+        assert resilience.stats()["preempt.escalations"] == 1
+    finally:
+        guard.uninstall()
+        signal.signal(sig, prev)
+
+
+def test_trainstep_advances_checker_window():
+    """debug_step windows must track compiled steps too — a TrainStep run
+    never calls Optimizer.step, which would freeze the window open."""
+    from paddle_tpu.amp import debugging as dbg
+
+    net, opt = _small_net()
+    X, Y = _batch()
+    step = TrainStep(lambda x, y: ((net(x) - y) ** 2).mean(), opt,
+                     layers=net)
+    step(X, Y)  # build outside the checker
+    dbg.enable_tensor_checker(dbg.TensorCheckerConfig(debug_step=[0, 1]))
+    try:
+        assert dbg.step_check_active()
+        step(X, Y)  # one compiled optimizer step closes the [0, 1) window
+        assert not dbg.step_check_active()
+        x = paddle.to_tensor(np.array([1.0, 0.0], np.float32))
+        x / x  # nan outside the window: the eager scan stays quiet
+    finally:
+        dbg.disable_tensor_checker()
+
+
+@pytest.mark.chaos
+def test_preemption_finalize_saves_marker_and_exits(tmp_path):
+    net, opt = _small_net()
+    ck = TrainCheckpointer(os.path.join(str(tmp_path), "mgr"))
+    guard = resilience.PreemptionGuard(install=False)
+    state = lambda: {"model": net.state_dict()}  # noqa: E731
+    assert guard.maybe_finalize(3, ck, state) is False  # nothing requested
+    paddle.set_flags({"FLAGS_fault_injection": True})
+    resilience.inject_fault("preempt", times=1)
+    with pytest.raises(SystemExit) as e:
+        guard.maybe_finalize(3, ck, state)
+    assert e.value.code == 0
+    assert ck.resume_marker()["step"] == 3
+    assert ck.latest_step() == 3
+    restored = ck.restore()
+    assert ck.last_restored_step == 3 and "model" in restored
+
+
+def test_elastic_dead_peer_feeds_guard():
+    from paddle_tpu.distributed.fleet.elastic import ElasticManager
+    from paddle_tpu.distributed.store import TCPStore
+
+    store = TCPStore("127.0.0.1", 0, is_master=True, world_size=2)
+    try:
+        m0 = ElasticManager(store, rank=0, world_size=2, lease=0.6).start()
+        m1 = ElasticManager(store, rank=1, world_size=2, lease=0.6).start()
+        assert m0.wait_for_world(timeout=5)
+        guard = resilience.PreemptionGuard(install=False)
+        m0.bind_preemption_guard(guard, interval=0.1)
+        m1.stop()  # rank 1 stops heartbeating: the preemption signal
+        deadline = time.time() + 5
+        while not guard.requested() and time.time() < deadline:
+            time.sleep(0.05)
+        assert guard.requested() and "dead peers [1]" in guard.reason
+        m0.stop()
+    finally:
+        store.close()
+
+
+# ------------------------------------------------------- observability
+
+
+def test_counters_ride_memory_stats():
+    from paddle_tpu.core import memory_stats
+
+    resilience.bump("sentinel.skipped", 3)
+    out = memory_stats.memory_stats()
+    assert out["provider.resilience.sentinel_skipped"] >= 3
+
+
+def test_resilience_stats_tool_reports_ckpt_dir(tmp_path):
+    net, _ = _small_net()
+    ck = TrainCheckpointer(os.path.join(str(tmp_path), "mgr"))
+    ck.save(1, {k: v for k, v in net.state_dict().items()})
+    ck.wait_until_finished()
+    ck.write_resume_marker(1, reason="unit")
+    tool = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "resilience_stats.py")
+    r = subprocess.run(
+        [sys.executable, tool, "--ckpt",
+         os.path.join(str(tmp_path), "mgr"), "--json"],
+        capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stderr
+    rep = json.loads(r.stdout)
+    assert rep["steps"] == [1] and rep["manifest_steps"] == [1]
+    assert rep["resume_marker"]["step"] == 1
+
+
+# -------------------------------------------- SIGTERM end-to-end (chaos)
+
+_PREEMPT_SCRIPT = r"""
+import os, sys
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.core import resilience
+from paddle_tpu.distributed.checkpoint import TrainCheckpointer
+from paddle_tpu.jit import TrainStep
+from paddle_tpu.optimizer import Adam
+
+work, total = sys.argv[1], int(sys.argv[2])
+paddle.seed(3)
+net = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 1))
+opt = Adam(learning_rate=1e-2, parameters=net.parameters())
+ck = TrainCheckpointer(os.path.join(work, "ckpt"), max_to_keep=2)
+start = 0
+restored = ck.restore()
+if restored is not None:
+    net.set_state_dict(restored["model"])
+    opt.set_state_dict(restored["opt"])
+    start = ck.last_restored_step + 1
+guard = resilience.PreemptionGuard()
+r = np.random.RandomState(0)
+X = paddle.to_tensor(r.rand(16, 4).astype(np.float32))
+Y = paddle.to_tensor(r.rand(16, 1).astype(np.float32))
+step_fn = TrainStep(lambda x, y: ((net(x) - y) ** 2).mean(), opt, layers=net)
+state = lambda: {"model": net.state_dict(), "opt": opt.state_dict()}
+with open(os.path.join(work, "steps.log"), "a") as log:
+    print(f"# start={start}", file=log, flush=True)
+    for step in range(start, total):
+        step_fn(X, Y)
+        ck.save(step, state())
+        print(step, file=log, flush=True)
+        guard.maybe_finalize(step, ck, state)  # SystemExit(0) on preemption
+        import time
+        time.sleep(0.1)  # the parent's SIGTERM window
+    ck.wait_until_finished()
+    print("# done", file=log, flush=True)
+"""
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_sigterm_preemption_checkpoint_and_resume(tmp_path):
+    """Criterion (a): SIGTERM mid-training produces a final checkpoint and
+    a restarted run resumes from it within one step."""
+    work = str(tmp_path)
+    script = os.path.join(work, "train.py")
+    with open(script, "w") as f:
+        f.write(_PREEMPT_SCRIPT)
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=os.path.dirname(os.path.dirname(
+                   os.path.abspath(__file__))))
+    total = 200  # large enough that SIGTERM always lands mid-run
+    p = subprocess.Popen([sys.executable, script, work, str(total)],
+                         env=env, stdout=subprocess.PIPE,
+                         stderr=subprocess.PIPE)
+    log = os.path.join(work, "steps.log")
+    deadline = time.time() + 120
+    while time.time() < deadline:  # wait for a few completed steps
+        if os.path.exists(log) and sum(
+                1 for l in open(log) if not l.startswith("#")) >= 3:
+            break
+        time.sleep(0.05)
+        assert p.poll() is None, p.stderr.read().decode()[-2000:]
+    p.send_signal(signal.SIGTERM)
+    out, err = p.communicate(timeout=120)
+    assert p.returncode == 0, err.decode()[-2000:]  # clean exit, not a kill
+
+    ck = TrainCheckpointer(os.path.join(work, "ckpt"))
+    marker = ck.resume_marker()
+    assert marker is not None and "signal" in marker["reason"]
+    final = marker["step"]
+    assert ck.latest_valid_step() == final
+
+    # restart: must resume from final+1 (within one step of the preemption)
+    r2 = subprocess.run([sys.executable, script, work, str(final + 4)],
+                        env=env, capture_output=True, timeout=180)
+    assert r2.returncode == 0, r2.stderr.decode()[-2000:]
+    lines = open(log).read().splitlines()
+    starts = [int(l.split("=")[1]) for l in lines if l.startswith("# start=")]
+    assert starts[1] == final + 1, (starts, final)
+    assert "# done" in lines
