@@ -112,6 +112,7 @@ from . import sparse  # noqa: F401,E402
 from . import quantization  # noqa: F401,E402
 from . import static  # noqa: F401,E402
 from . import inference  # noqa: F401,E402
+from . import serving  # noqa: F401,E402
 
 # ------------------------------------------------------- remaining root API
 from .nn.layer import ParamAttr  # noqa: F401,E402

@@ -115,7 +115,15 @@ def _register_providers() -> None:
                            "persistent.misses"),
                           ("compile_cache.eager_jit_hits", "eager_jit.hits"),
                           ("compile_cache.eager_jit_misses",
-                           "eager_jit.misses")):
+                           "eager_jit.misses"),
+                          # serving-engine compile counters: the invariant
+                          # the engine sells is "admit/retire never
+                          # recompiles", so its trace counts live on the
+                          # same surface as every other compile number
+                          ("compile_cache.serving_decode_compiles",
+                           "serving.decode_compiles"),
+                          ("compile_cache.serving_prefill_compiles",
+                           "serving.prefill_compiles")):
             memory_stats.register_stat_provider(
                 name, lambda k=key: _counts.get(k, 0))
         _providers_registered = True
@@ -288,6 +296,25 @@ def bucket_shape(shape, axes=(0,), min_bucket: Optional[int] = None):
     axes = {a % len(shape) for a in axes} if shape else set()
     return tuple(bucket_dim(s, min_bucket) if i in axes else s
                  for i, s in enumerate(shape))
+
+
+def prefill_bucket(n: int, max_len: Optional[int] = None,
+                   min_bucket: Optional[int] = None) -> int:
+    """Prompt-length bucket for the serving engine's prefill compiles.
+
+    Same power-of-two-ish ladder as :func:`bucket_dim` but floored at
+    ``FLAGS_serving_prefill_bucket_min`` (sequence buckets want a coarser
+    floor than batch buckets) and clamped to ``max_len`` (the model's
+    position budget — padding past it would index past ``wpe``). Mixed
+    prompt lengths therefore land in at most
+    ``log2(max_len / min_bucket) * 2`` distinct compiled prefill programs.
+    """
+    m = int(min_bucket if min_bucket is not None
+            else flags.flag("serving_prefill_bucket_min"))
+    b = bucket_dim(n, m)
+    if max_len is not None:
+        b = min(b, int(max_len))
+    return max(b, int(n))
 
 
 def pad_to_bucket(x, axis: int = 0, min_bucket: Optional[int] = None):

@@ -134,6 +134,22 @@ define_flag("shape_bucket_min", 8,
             "Smallest shape bucket: batch dims at or below this share one "
             "bucket.")
 
+# ---- Serving: continuous-batching decode engine (paddle_tpu.serving) ----
+define_flag("serving_slots", 8,
+            "Default decode-slot count of a ServingEngine: the fixed batch "
+            "dimension of the compiled slot-based decode step (admitting/"
+            "retiring a request reuses a slot, never recompiles).")
+define_flag("kv_block_size", 16,
+            "Tokens per KV-arena page block. A request's cache is a list of "
+            "blocks, allocated as its context grows and returned to the "
+            "free list at retire.")
+define_flag("serving_max_queue", 0,
+            "Queue-overload shedding: submit() raises QueueOverloadError "
+            "when this many requests are already waiting (0 = unlimited).")
+define_flag("serving_prefill_bucket_min", 16,
+            "Smallest prompt-length bucket for serving prefill compiles; "
+            "prompts at or below this share one compiled prefill program.")
+
 # ---- Resilience: retry / sentinel / fault injection (core.resilience) ----
 define_flag("io_retries", 3,
             "Max attempts (first try included) for retried IO: checkpoint "

@@ -85,7 +85,9 @@ def _register_providers() -> None:
     for name, key in (("resilience.sentinel_skipped", "sentinel.skipped"),
                       ("resilience.rollbacks", "sentinel.rollbacks"),
                       ("resilience.retries", "retry.retries"),
-                      ("resilience.preempt_requests", "preempt.requests")):
+                      ("resilience.preempt_requests", "preempt.requests"),
+                      ("resilience.overload_shed", "overload.shed"),
+                      ("resilience.deadline_exceeded", "deadline.exceeded")):
         memory_stats.register_stat_provider(name, lambda k=key: _counts.get(k, 0))
 
 
@@ -106,6 +108,67 @@ class NonfiniteStepError(FloatingPointError):
 class CheckpointIntegrityError(RuntimeError):
     """A checkpoint step failed manifest verification (truncated write,
     corrupted leaf, or structural mismatch)."""
+
+
+class QueueOverloadError(RuntimeError):
+    """Admission was shed because a serving queue exceeded its depth limit
+    (load-shedding beats unbounded latency growth under overload)."""
+
+
+class DeadlineExceededError(TimeoutError):
+    """A request's wall-clock deadline expired before it finished."""
+
+
+# ---------------------------------------------------- deadlines / shedding
+
+
+@dataclass
+class Deadline:
+    """Absolute wall-clock budget for one unit of work (a serving request,
+    a retried operation). ``None`` expiry means "no deadline" — all probes
+    report unexpired. Monotonic clock, so NTP steps can't fire it."""
+
+    expires_at: Optional[float] = None
+
+    @classmethod
+    def after(cls, timeout: Optional[float]) -> "Deadline":
+        """Deadline ``timeout`` seconds from now (None = unbounded)."""
+        return cls(None if timeout is None
+                   else time.monotonic() + float(timeout))
+
+    def remaining(self) -> float:
+        if self.expires_at is None:
+            return float("inf")
+        return self.expires_at - time.monotonic()
+
+    def expired(self) -> bool:
+        return self.remaining() <= 0.0
+
+    def check(self, what: str = "operation") -> None:
+        """Raise :class:`DeadlineExceededError` (and count it) if expired."""
+        if self.expired():
+            bump("deadline.exceeded")
+            raise DeadlineExceededError(
+                f"{what} exceeded its deadline "
+                f"(over by {-self.remaining():.3f}s)")
+
+
+def check_overload(depth: int, limit: Optional[int] = None,
+                   name: str = "serving") -> None:
+    """Admission-control probe: raise :class:`QueueOverloadError` when
+    ``depth`` waiting requests meet the limit (default
+    ``FLAGS_serving_max_queue``; 0/None = unlimited). Every shed bumps
+    ``overload.shed`` / ``overload.<name>.shed`` so dashboards see the
+    rejected load, not just the served load."""
+    if limit is None:
+        limit = int(flags.flag("serving_max_queue"))
+    if limit and depth >= limit:
+        bump("overload.shed")
+        if name:
+            bump(f"overload.{name}.shed")
+        raise QueueOverloadError(
+            f"{name} queue is full ({depth} waiting >= limit {limit}); "
+            "request shed")
 
 
 # -------------------------------------------------------------------- retry

@@ -9,27 +9,89 @@ owns the decision.
 """
 from __future__ import annotations
 
+import logging
+import os
 from typing import List, Optional
 
 import numpy as np
 
 from ..core.tensor import Tensor
 
+_logger = logging.getLogger("paddle_tpu.inference")
+_logged_placements: set = set()
+
+
+def _log_once(key: str, msg: str) -> None:
+    if key not in _logged_placements:
+        _logged_placements.add(key)
+        _logger.warning(msg)
+
 
 class Config:
     def __init__(self, model_path: Optional[str] = None, params_path: Optional[str] = None):
         # paddle passes either a dir or (model, params) pair; we need the
-        # jit.save path prefix
+        # jit.save path prefix. A directory is accepted when it contains
+        # exactly one .pdmodel (the reference's load_inference_model dir
+        # convention).
         prefix = model_path or ""
+        if prefix and os.path.isdir(prefix):
+            pdmodels = sorted(n for n in os.listdir(prefix)
+                              if n.endswith(".pdmodel"))
+            if len(pdmodels) != 1:
+                raise ValueError(
+                    f"Config(dir) needs exactly one .pdmodel in {prefix!r}; "
+                    f"found {pdmodels or 'none'}")
+            prefix = os.path.join(prefix, pdmodels[0])
         for suffix in (".pdmodel", ".pdiparams", ".pdparams"):
             if prefix.endswith(suffix):
                 prefix = prefix[: -len(suffix)]
         self.model_prefix = prefix
         self._mem_optim = True
         self._device = None
+        self._serving: Optional[dict] = None
 
     def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
-        self._device = ("gpu", device_id)  # accepted; XLA owns placement
+        self._device = ("gpu", device_id)
+
+    def enable_tpu(self, device_id=0):
+        self._device = ("tpu", device_id)
+
+    def _resolve_placement(self) -> str:
+        """Map the requested device onto what this host's XLA backend
+        actually provides — a real placement/no-op decision, logged once
+        per (requested, actual) pair so serve logs show where the model
+        truly runs without repeating per predictor."""
+        try:
+            import jax
+
+            actual = jax.devices()[0].platform
+        except Exception:
+            actual = "unknown"
+        if self._device is None:
+            return actual
+        want, dev_id = self._device
+        if want == actual:
+            _log_once(f"{want}:{dev_id}:{actual}",
+                      f"inference placement: {want}:{dev_id} honored "
+                      f"(platform={actual})")
+        else:
+            _log_once(f"{want}:{dev_id}:{actual}",
+                      f"inference placement: {want}:{dev_id} requested but "
+                      f"this host's XLA backend is {actual!r}; running "
+                      f"there (XLA owns placement)")
+        return actual
+
+    def enable_serving_engine(self, model=None, max_new_tokens: int = 32,
+                              stop_token_id: Optional[int] = None,
+                              **engine_kw):
+        """Route this config's predictor through the continuous-batching
+        ``paddle_tpu.serving`` engine (TPU-native extension to the parity
+        surface). ``model`` is an in-memory ``GPTForCausalLM`` — the slot
+        engine drives the model's decode step directly, which an opaque
+        exported program cannot provide."""
+        self._serving = dict(model=model, max_new_tokens=max_new_tokens,
+                             stop_token_id=stop_token_id,
+                             engine_kw=engine_kw)
 
     def enable_memory_optim(self, flag=True):
         self._mem_optim = flag
@@ -68,6 +130,7 @@ class Predictor:
     def __init__(self, config: Config):
         from ..jit import load as jit_load
 
+        config._resolve_placement()
         self._layer = jit_load(config.model_prefix)
         self._inputs = {}
         self._outputs = {}
@@ -99,7 +162,24 @@ class Predictor:
             return [self._outputs[k] for k in sorted(self._outputs)]
 
 
-def create_predictor(config: Config) -> Predictor:
+def create_predictor(config: Config):
+    if getattr(config, "_serving", None) is not None:
+        # continuous-batching route: GPT models serve through the slot
+        # engine (per-row requests, iteration-level batching) behind the
+        # same predictor handle surface
+        opts = config._serving
+        if opts.get("model") is None:
+            raise ValueError(
+                "enable_serving_engine() needs an in-memory GPT model "
+                "(pass model=...); an exported .pdmodel program cannot be "
+                "driven per-slot")
+        config._resolve_placement()
+        from ..serving import EnginePredictor
+
+        return EnginePredictor(opts["model"],
+                               max_new_tokens=opts["max_new_tokens"],
+                               stop_token_id=opts["stop_token_id"],
+                               **opts["engine_kw"])
     return Predictor(config)
 
 

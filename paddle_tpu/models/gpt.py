@@ -93,6 +93,27 @@ def _filter_logits(scaled, top_k: int, top_p: float, vocab: int):
     return scaled
 
 
+def masked_attention(qa, ka, va, mask):
+    """Core cached-decode attention: q against an (already updated) K/V
+    buffer under an explicit boolean mask. ``qa`` is [b, s, heads, dim];
+    ``ka``/``va`` are [b, kv_len, heads, dim]; ``mask`` broadcasts against
+    [b, heads, s, kv_len]. Returns [b, s, heads, dim].
+
+    This one function is the numerics contract shared by ``generate()``'s
+    contiguous KV path and the serving engine's paged-arena path — both
+    must produce token-for-token identical greedy decodes, so they must
+    run the exact same ops (same dtypes, same -1e30 masking, same fp32
+    softmax)."""
+    qt = jnp.swapaxes(qa, 1, 2)  # [b, h, s, d]
+    kt = jnp.swapaxes(ka, 1, 2)
+    vt = jnp.swapaxes(va, 1, 2)
+    scale = 1.0 / math.sqrt(qa.shape[-1])
+    logits = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) * scale
+    logits = jnp.where(mask, logits, -1e30)
+    p = jax.nn.softmax(logits.astype(jnp.float32), -1).astype(qa.dtype)
+    return jnp.swapaxes(jnp.einsum("bhqk,bhkd->bhqd", p, vt), 1, 2)
+
+
 def gpt_tiny(**kw) -> "GPTConfig":
     return GPTConfig(vocab_size=1024, hidden_size=128, num_layers=2, num_heads=4,
                      max_position_embeddings=256, **kw)
@@ -124,6 +145,15 @@ class GPTAttention(nn.Layer):
         qkv = constraint(qkv, "data", "sep", None, "model", None)
         qs = M.split(qkv, 3, axis=2)
         q, k, v = (M.squeeze(t, 2) for t in qs)
+        if cache is not None and hasattr(cache, "update_and_attend"):
+            # cache-protocol path: the cache object owns its storage layout
+            # (the serving engine's paged KV arena) — it absorbs this
+            # chunk's k/v, attends q against the stored history, and
+            # returns (attn_out [b, s, heads, dim], successor cache)
+            o, new_cache = cache.update_and_attend(q, k, v)
+            oa = o._data if isinstance(o, Tensor) else o
+            out = M.reshape(Tensor(oa), [b, s, h])
+            return self.proj(out), new_cache
         if cache is not None:
             # incremental decode: write this chunk's k/v into the
             # preallocated [b, max_len, heads, dim] buffers at start_pos and
@@ -139,15 +169,7 @@ class GPTAttention(nn.Layer):
                 j = jnp.arange(max_len)[None, :]
                 i = pos + jnp.arange(qa.shape[1])[:, None]
                 mask = (j <= i)[None, None]  # [1, 1, s, max_len]
-                qt = jnp.swapaxes(qa, 1, 2)  # [b, h, s, d]
-                kt = jnp.swapaxes(kb, 1, 2)
-                vt = jnp.swapaxes(vb, 1, 2)
-                scale = 1.0 / math.sqrt(qa.shape[-1])
-                logits = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) * scale
-                logits = jnp.where(mask, logits, -1e30)
-                p = jax.nn.softmax(logits.astype(jnp.float32), -1).astype(
-                    qa.dtype)
-                o = jnp.swapaxes(jnp.einsum("bhqk,bhkd->bhqd", p, vt), 1, 2)
+                o = masked_attention(qa, kb, vb, mask)
                 return o, kb, vb
 
             from ..core.dispatch import apply as _apply
@@ -227,7 +249,13 @@ class GPTModel(nn.Layer):
         if caches is not None:
             off = (start_pos._data if isinstance(start_pos, Tensor)
                    else start_pos)
-            pos = Tensor(jnp.asarray(off) + jnp.arange(s, dtype=jnp.int32))
+            off = jnp.asarray(off)
+            if off.ndim == 1:
+                # per-sequence positions (the serving engine's slots each
+                # sit at their own context length): [b] -> [b, s]
+                pos = Tensor(off[:, None] + jnp.arange(s, dtype=jnp.int32))
+            else:
+                pos = Tensor(off + jnp.arange(s, dtype=jnp.int32))
         else:
             pos = creation.arange(0, s, dtype="int32")
         x = self.wte(input_ids) + self.wpe(pos)
@@ -424,10 +452,27 @@ class GPTForCausalLM(nn.Layer):
 
         return apply(_loss, (h, labels, w), {}, name="chunked_lm_loss")
 
+    def _head_logits(self, h_last):
+        """Next-token logits [b, vocab] from last hidden states [b, hidden]
+        through the (tied) LM head. Raw-array in, raw-array out — the one
+        head computation shared by ``generate()`` and the serving engine's
+        compiled slot step (parity depends on them running the same ops)."""
+        from ..core import rng as prng
+
+        with prng.key_guard(jax.random.key(0)):
+            if self.cfg.tie_word_embeddings:
+                w = self.gpt.wte.weight
+                out = F.linear(Tensor(h_last[:, None]),
+                               M.transpose(w, [1, 0]))
+            else:
+                out = self.lm_head(Tensor(h_last[:, None]))
+        return out._data[:, 0]
+
     def generate(self, input_ids, max_new_tokens: int = 32,
                  do_sample: bool = False, temperature: float = 1.0,
                  top_k: int = 0, top_p: float = 1.0, eos_token_id: int = -1,
-                 seed: int = 0, use_cache: bool = True):
+                 seed: int = 0, use_cache: bool = True,
+                 stop_token_id=None):
         """Compiled autoregressive decoding: ONE jitted program — prefill
         plus a ``lax.scan`` over decode steps — so the whole loop runs
         on-device with no host round trips (the XLA-native replacement for
@@ -439,8 +484,16 @@ class GPTForCausalLM(nn.Layer):
         buffer each step (more FLOPs, zero extra state — useful as a
         cross-check, and what the cache path is tested against).
 
+        ``stop_token_id`` enables per-sequence termination: each sequence
+        carries a finished mask, finished rows stop mutating their KV
+        cache and output buffer, and the decode loop (``lax.while_loop``
+        instead of ``scan``) exits early once EVERY sequence has emitted
+        the stop token — a batch of short answers no longer pays for
+        ``max_new_tokens`` steps. Takes precedence over ``eos_token_id``
+        (the legacy fill-only behavior, kept bit-compatible).
+
         Returns [batch, prompt_len + max_new_tokens] token ids; positions
-        after an ``eos_token_id`` hit are filled with eos.
+        after a stop/eos hit are filled with that token.
         """
         was_training = self.training
         self.eval()
@@ -476,9 +529,10 @@ class GPTForCausalLM(nn.Layer):
             # the donation flag is part of the key: toggling it must build
             # a fresh executable, not reuse the old donation setting
             donate = bool(use_cache and _flags.flag("decode_donate"))
+            stop = None if stop_token_id is None else int(stop_token_id)
             cache_key = (b, prompt_len, max_new_tokens, bool(do_sample),
                          float(temperature), int(top_k), float(top_p),
-                         int(eos_token_id), bool(use_cache), donate)
+                         int(eos_token_id), bool(use_cache), donate, stop)
             cached = getattr(self, "_gen_cache", None)
             if cached is not None and cached[0] == cache_key:
                 compile_cache.bump("decode.cache_hits")
@@ -495,21 +549,23 @@ class GPTForCausalLM(nn.Layer):
                 else:
                     nxt = jnp.argmax(logits, axis=-1)
                 nxt = nxt.astype(jnp.int32)
-                if eos_token_id >= 0:
+                if stop is not None:
+                    nxt = jnp.where(done, stop, nxt)
+                    done = done | (nxt == stop)
+                elif eos_token_id >= 0:
                     nxt = jnp.where(done, eos_token_id, nxt)
                     done = done | (nxt == eos_token_id)
                 return nxt, done, key
 
-            def lm_head_logits(h_last):
-                # h_last [b, hidden] -> [b, vocab] through the (tied) head
-                with prng.key_guard(jax.random.key(0)):
-                    if self.cfg.tie_word_embeddings:
-                        w = self.gpt.wte.weight
-                        out = F.linear(Tensor(h_last[:, None]),
-                                       M.transpose(w, [1, 0]))
-                    else:
-                        out = self.lm_head(Tensor(h_last[:, None]))
-                return out._data[:, 0]
+            lm_head_logits = self._head_logits
+
+            def fresh_out_buf(dtype):
+                # with a stop token the loop can exit before writing every
+                # position — pre-fill the tail so early exit reads as
+                # "finished rows padded with stop"
+                if stop is not None:
+                    return jnp.full((b, total), stop, dtype)
+                return jnp.zeros((b, total), dtype)
 
             def decode_cached(param_arrays, start_ids, key, caches0,
                               out_buf):
@@ -533,11 +589,14 @@ class GPTForCausalLM(nn.Layer):
                                   for k, v in caches]
                         h_last = h._data[:, -1]
 
-                def step(carry, _):
+                def step(carry):
                     caches, h_last, pos, done, key, out_buf = carry
                     with _swap_data(objs, list(param_arrays)):
                         logits = lm_head_logits(h_last)
                         nxt, done, key = sample_next(logits, done, key)
+                        # finished rows: nxt is forced to the stop token and
+                        # the buffer was pre-filled with it, so this write
+                        # is value-preserving for them
                         out_buf = jax.lax.dynamic_update_slice(
                             out_buf, nxt[:, None], (0, pos))
                         with prng.key_guard(jax.random.key(0)):
@@ -550,47 +609,62 @@ class GPTForCausalLM(nn.Layer):
                             (k._data if isinstance(k, Tensor) else k,
                              v._data if isinstance(v, Tensor) else v)
                             for k, v in new_caches]
+                        if stop is not None:
+                            # finished rows freeze their KV state (their
+                            # stop-token k/v is never attended to anyway —
+                            # they only ever re-emit stop)
+                            d4 = done[:, None, None, None]
+                            new_caches = [
+                                (jnp.where(d4, ko, kn), jnp.where(d4, vo, vn))
+                                for (ko, vo), (kn, vn) in zip(caches,
+                                                              new_caches)]
                     return (new_caches, h._data[:, 0], pos + 1, done, key,
-                            out_buf), None
+                            out_buf)
 
                 out_buf = jax.lax.dynamic_update_slice(out_buf, start_ids,
                                                        (0, 0))
                 done0 = jnp.zeros((b,), jnp.bool_)
-                (_, _, _, _, _, out_buf), _ = jax.lax.scan(
-                    step,
-                    (caches, h_last, jnp.int32(prompt_len), done0, key,
-                     out_buf),
-                    None, length=max_new_tokens)
-                return out_buf
+                carry0 = (caches, h_last, jnp.int32(prompt_len), done0, key,
+                          out_buf)
+                if stop is not None:
+                    # early exit: stop decoding the moment every sequence
+                    # finished (or the token budget ran out)
+                    def cond(carry):
+                        _, _, pos, done, _, _ = carry
+                        return (pos < total) & ~jnp.all(done)
+
+                    carry = jax.lax.while_loop(cond, step, carry0)
+                else:
+                    carry, _ = jax.lax.scan(lambda c, _: (step(c), None),
+                                            carry0, None,
+                                            length=max_new_tokens)
+                return carry[5]
 
             def decode(param_arrays, start_ids, key):
-                buf = jnp.zeros((b, total), start_ids.dtype)
+                buf = fresh_out_buf(start_ids.dtype)
                 buf = jax.lax.dynamic_update_slice(buf, start_ids, (0, 0))
 
-                def step(carry, _):
+                def step(carry):
                     buf, pos, done, key = carry
                     logits = logits_at(param_arrays, buf, pos - 1)
-                    if do_sample:
-                        key, sub = jax.random.split(key)
-                        scaled = logits / jnp.maximum(temperature, 1e-6)
-                        scaled = _filter_logits(scaled, top_k, top_p,
-                                                self.cfg.vocab_size)
-                        nxt = jax.random.categorical(sub, scaled)
-                    else:
-                        nxt = jnp.argmax(logits, axis=-1)
-                    nxt = nxt.astype(buf.dtype)
-                    if eos_token_id >= 0:
-                        nxt = jnp.where(done, eos_token_id, nxt)
-                        done = done | (nxt == eos_token_id)
+                    nxt, done, key = sample_next(logits, done, key)
                     buf = jax.lax.dynamic_update_slice(
-                        buf, nxt[:, None], (0, pos))
-                    return (buf, pos + 1, done, key), None
+                        buf, nxt.astype(buf.dtype)[:, None], (0, pos))
+                    return (buf, pos + 1, done, key)
 
                 done0 = jnp.zeros((b,), jnp.bool_)
-                (buf, _, _, _), _ = jax.lax.scan(
-                    step, (buf, jnp.int32(prompt_len), done0, key),
-                    None, length=max_new_tokens)
-                return buf
+                carry0 = (buf, jnp.int32(prompt_len), done0, key)
+                if stop is not None:
+                    def cond(carry):
+                        _, pos, done, _ = carry
+                        return (pos < total) & ~jnp.all(done)
+
+                    carry = jax.lax.while_loop(cond, step, carry0)
+                else:
+                    carry, _ = jax.lax.scan(lambda c, _: (step(c), None),
+                                            carry0, None,
+                                            length=max_new_tokens)
+                return carry[0]
 
             if donate:
                 jitted = jax.jit(decode_cached, donate_argnums=(3, 4))
@@ -601,7 +675,7 @@ class GPTForCausalLM(nn.Layer):
                     # hoisted out of the runner
                     caches0 = [(c[0]._data, c[1]._data)
                                for c in self.gpt.gen_kv_caches(b, total)]
-                    out_buf = jnp.zeros((b, total), start_ids.dtype)
+                    out_buf = fresh_out_buf(start_ids.dtype)
                     import warnings
 
                     with warnings.catch_warnings():
@@ -619,7 +693,7 @@ class GPTForCausalLM(nn.Layer):
                 def decode_alloc(param_arrays, start_ids, key):
                     caches0 = [(c[0]._data, c[1]._data)
                                for c in self.gpt.gen_kv_caches(b, total)]
-                    out_buf = jnp.zeros((b, total), start_ids.dtype)
+                    out_buf = fresh_out_buf(start_ids.dtype)
                     return decode_cached(param_arrays, start_ids, key,
                                          caches0, out_buf)
 

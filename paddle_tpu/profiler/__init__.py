@@ -126,9 +126,11 @@ class Profiler:
             except Exception:
                 self._device_dir = None
         from ..core import compile_cache, resilience
+        from ..serving import metrics as serving_metrics
 
         self._cc_start = compile_cache.stats()
         self._rs_start = resilience.stats()
+        self._sv_start = serving_metrics.stats()
         self._running = True
 
     def stop(self):
@@ -155,6 +157,11 @@ class Profiler:
         # retries, preemption requests over the profiled window)
         self.resilience_stats = resilience.stats_delta(
             getattr(self, "_rs_start", {}), resilience.stats())
+        # and the serving engine (tokens, admits/retires, arena churn)
+        from ..serving import metrics as serving_metrics
+
+        self.serving_stats = serving_metrics.stats_delta(
+            getattr(self, "_sv_start", {}), serving_metrics.stats())
         self._running = False
         if self.on_trace_ready is not None:
             self.on_trace_ready(self)
@@ -245,7 +252,8 @@ class Profiler:
                             op_limit=60 if op_detail else 10)
         for title, rec in (
                 ("Compile Cache", getattr(self, "compile_cache_stats", None)),
-                ("Resilience", getattr(self, "resilience_stats", None))):
+                ("Resilience", getattr(self, "resilience_stats", None)),
+                ("Serving", getattr(self, "serving_stats", None))):
             if not rec or views is not None:
                 continue
             nz = {k: v for k, v in sorted(rec.items())

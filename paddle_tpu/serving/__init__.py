@@ -1,0 +1,47 @@
+"""Continuous-batching serving engine — the TPU-idiomatic descendant of the
+reference's ``paddle/fluid/inference`` layer (turn a trained graph into a
+served endpoint), rebuilt as an Orca/vLLM-style decode runtime:
+
+* :mod:`.engine`    — ``ServingEngine``: one compiled slot-based decode
+  step over a fixed ``[num_slots]`` lane arena; admit/retire never
+  recompiles.
+* :mod:`.kv_arena`  — ``KVArena``: block-granular (paged) KV allocation
+  with free-list reuse and a scratch block for masked lanes.
+* :mod:`.scheduler` — ``Scheduler``/``Request``: iteration-level batching,
+  FCFS admission, stop/budget/cancel/deadline finish policy.
+* :mod:`.api`       — ``ServingAPI`` (``submit/stream/cancel``) and
+  ``EnginePredictor`` (the ``paddle.inference`` bridge).
+* :mod:`.metrics`   — counters/gauges on the shared observability surface.
+
+See docs/serving.md for the architecture and lifecycle walkthrough.
+"""
+from __future__ import annotations
+
+from . import metrics  # noqa: F401  (registers memory_stats providers)
+
+_LAZY = {
+    "ServingEngine": ("engine", "ServingEngine"),
+    "ServingConfig": ("engine", "ServingConfig"),
+    "KVArena": ("kv_arena", "KVArena"),
+    "ArenaExhaustedError": ("kv_arena", "ArenaExhaustedError"),
+    "Scheduler": ("scheduler", "Scheduler"),
+    "Request": ("scheduler", "Request"),
+    "RequestState": ("scheduler", "RequestState"),
+    "ServingAPI": ("api", "ServingAPI"),
+    "EnginePredictor": ("api", "EnginePredictor"),
+}
+
+__all__ = list(_LAZY) + ["metrics"]
+
+
+def __getattr__(name):
+    # lazy: importing paddle_tpu must not pull the model stack; the engine
+    # materializes only when serving is actually used
+    entry = _LAZY.get(name)
+    if entry is None:
+        raise AttributeError(
+            f"module 'paddle_tpu.serving' has no attribute {name!r}")
+    import importlib
+
+    mod = importlib.import_module(f".{entry[0]}", __name__)
+    return getattr(mod, entry[1])

@@ -1,0 +1,256 @@
+"""Serving front door: ``submit()`` / ``stream()`` / ``cancel()``.
+
+Thin, thread-safe policy shell over the scheduler+engine pair:
+
+* **submit** applies queue-overload shedding
+  (``core.resilience.check_overload`` / ``FLAGS_serving_max_queue``) and
+  attaches the per-request wall-clock deadline.
+* **stream** yields tokens as the engine produces them. In foreground mode
+  (default) the consumer's iteration *is* the event loop — each ``next()``
+  pumps scheduler steps; with ``background=True`` a pump thread drives the
+  engine and streams are plain queue consumers.
+* **cancel** flags the request; the scheduler retires its slot at the next
+  step boundary (queued requests never cost a prefill).
+
+The :class:`EnginePredictor` bridge at the bottom gives the classic
+``paddle.inference`` predictor surface (``get_input_handle`` /
+``run`` / ``get_output_handle``) a continuous-batching backend: a batch of
+prompts becomes one request per row, so short rows free their slots for
+other traffic instead of idling until the longest row finishes. It is
+routed through ``inference.Config.enable_serving_engine()`` +
+``inference.create_predictor``.
+"""
+from __future__ import annotations
+
+import queue as _queue
+import threading
+import time
+from typing import Iterator, List, Optional
+
+import numpy as np
+
+from ..core import resilience
+from . import metrics
+from .engine import ServingConfig, ServingEngine
+from .scheduler import Request, RequestState, Scheduler
+
+
+class ServingAPI:
+    """One served model: engine + scheduler + (optional) pump thread."""
+
+    def __init__(self, model, config: Optional[ServingConfig] = None,
+                 background: bool = False,
+                 max_queue: Optional[int] = None, **engine_kw):
+        self.engine = ServingEngine(model, config, **engine_kw)
+        self.scheduler = Scheduler(self.engine)
+        self._lock = threading.RLock()
+        self._max_queue = max_queue
+        self._closed = False
+        self._thread = None
+        if background:
+            self._thread = threading.Thread(target=self._pump_loop,
+                                            name="serving-pump", daemon=True)
+            self._thread.start()
+
+    # ------------------------------------------------------------ public
+
+    def submit(self, prompt, max_new_tokens: int = 32,
+               stop_token_id: Optional[int] = None,
+               timeout: Optional[float] = None,
+               request_id: str = "") -> Request:
+        """Enqueue one generation request; returns its handle immediately.
+
+        ``timeout`` is the request's end-to-end wall-clock deadline
+        (queue wait included). Raises
+        :class:`core.resilience.QueueOverloadError` when the waiting queue
+        is at the shedding limit — callers retry later or route elsewhere;
+        unbounded queues just convert overload into timeouts."""
+        if self._closed:
+            raise RuntimeError("ServingAPI is closed")
+        with self._lock:
+            try:
+                resilience.check_overload(len(self.scheduler.waiting),
+                                          self._max_queue, name="serving")
+            except resilience.QueueOverloadError:
+                metrics.bump("requests.shed")
+                raise
+            req = Request(prompt, max_new_tokens=max_new_tokens,
+                          stop_token_id=stop_token_id,
+                          request_id=request_id,
+                          deadline=resilience.Deadline.after(timeout))
+            return self.scheduler.submit(req)
+
+    def stream(self, req: Request) -> Iterator[int]:
+        """Yield ``req``'s tokens as they are generated; raises the
+        request's error (deadline, shed, engine failure) at the end of a
+        failed stream."""
+        while True:
+            try:
+                tok = req.stream_queue.get_nowait()
+            except _queue.Empty:
+                if req.done_event.is_set():
+                    break
+                if self._thread is None:
+                    self._pump_once()
+                else:
+                    time.sleep(0.001)
+                continue
+            if tok is None:  # finish sentinel (always the queue's last item)
+                break
+            yield tok
+        if req.state == RequestState.FAILED and req.error is not None:
+            raise req.error
+
+    def cancel(self, req: Request) -> None:
+        req.cancel()
+        if self._thread is None:
+            self._pump_once()  # make cancellation take effect promptly
+
+    def result(self, req: Request, timeout: Optional[float] = None
+               ) -> np.ndarray:
+        """Block until ``req`` finishes; returns prompt+generated ids.
+        Raises the request's error for FAILED, RuntimeError for CANCELLED."""
+        if self._thread is None:
+            deadline = resilience.Deadline.after(timeout)
+            while not req.finished:
+                deadline.check(f"result({req.request_id})")
+                self._pump_once()
+        elif not req.done_event.wait(timeout):
+            raise resilience.DeadlineExceededError(
+                f"result({req.request_id}) timed out")
+        if req.state == RequestState.FAILED:
+            raise req.error
+        if req.state == RequestState.CANCELLED:
+            raise RuntimeError(f"{req.request_id} was cancelled")
+        return req.output_ids()
+
+    def run_until_idle(self) -> None:
+        while True:
+            with self._lock:
+                if not self.scheduler.has_work():
+                    return
+                self._step_guarded()
+
+    def close(self) -> None:
+        self._closed = True
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        with self._lock:
+            # no request may outlive the API un-finished: anything still
+            # queued/running fails with a clear error instead of leaving a
+            # result()/stream() caller blocking forever
+            if self.scheduler.has_work():
+                self.scheduler.fail_all(RuntimeError("ServingAPI is closed"))
+
+    # ----------------------------------------------------------- pumping
+
+    def _pump_once(self) -> None:
+        with self._lock:
+            if self.scheduler.has_work():
+                self._step_guarded()
+
+    def _step_guarded(self) -> None:
+        # caller holds the lock. Foreground pumping needs the same
+        # guarantee the background loop's fail_all gives: a step that
+        # raises must not leave in-flight requests RUNNING with slots and
+        # arena blocks held (and done_events never set) after the
+        # exception propagates to the pumping caller.
+        try:
+            self.scheduler.step()
+        except Exception as e:
+            self.scheduler.fail_all(e)
+            raise
+
+    def _pump_loop(self) -> None:
+        while not self._closed:
+            with self._lock:
+                busy = self.scheduler.has_work()
+                if busy:
+                    try:
+                        self.scheduler.step()
+                    except Exception as e:
+                        # the pump thread must never die silently with
+                        # requests in flight: fail them all (done_event +
+                        # sentinel) and keep serving — new submissions
+                        # surface the same error through their own results
+                        self.scheduler.fail_all(e)
+            if not busy:
+                time.sleep(0.001)
+
+
+class EnginePredictor:
+    """``paddle.inference`` predictor surface over the serving engine.
+
+    Input ``input_ids`` is an int32 ``[batch, prompt_len]`` array; ``run``
+    submits one request per row and continuous-batches them through the
+    slot engine. Output ``output_0`` is ``[batch, prompt_len +
+    max_new_tokens]`` with post-stop positions filled with the stop token
+    (exactly ``GPT.generate(stop_token_id=...)``'s contract, so swapping a
+    predictor backend never changes downstream parsing)."""
+
+    def __init__(self, model, max_new_tokens: int = 32,
+                 stop_token_id: Optional[int] = None,
+                 config: Optional[ServingConfig] = None, **engine_kw):
+        self._api = ServingAPI(model, config, **engine_kw)
+        self._max_new = int(max_new_tokens)
+        self._stop = stop_token_id
+        self._inputs = {}
+        self._outputs = {}
+
+    def get_input_names(self) -> List[str]:
+        return ["input_ids"]
+
+    def get_output_names(self) -> List[str]:
+        return sorted(self._outputs) or ["output_0"]
+
+    def get_input_handle(self, name: str):
+        from ..inference import PredictorTensor
+
+        return PredictorTensor(self, name)
+
+    def get_output_handle(self, name: str):
+        from ..inference import PredictorTensor
+
+        return PredictorTensor(self, name)
+
+    def run(self, inputs: Optional[List[np.ndarray]] = None):
+        if inputs is not None:
+            ids = np.asarray(inputs[0])
+        else:
+            ids = np.asarray(self._inputs["input_ids"])
+        ids = np.atleast_2d(ids).astype(np.int32)
+        b, plen = ids.shape
+        reqs = []
+        try:
+            for row in ids:
+                reqs.append(self._api.submit(row,
+                                             max_new_tokens=self._max_new,
+                                             stop_token_id=self._stop))
+        except Exception:
+            # a mid-batch submit failure (overload shed, validation) must
+            # not strand the rows already queued: their handles would be
+            # unreachable, and FCFS would still spend capacity on them
+            # ahead of the next run(). Flag every cancel BEFORE pumping so
+            # the cull runs once and no doomed row gets admitted (and
+            # charged a prefill) while its siblings are being cancelled.
+            for req in reqs:
+                req.cancel()
+            if reqs:
+                self._api._pump_once()
+            raise
+        self._api.run_until_idle()
+        fill = self._stop if self._stop is not None else 0
+        out = np.full((b, plen + self._max_new), fill, np.int32)
+        out[:, :plen] = ids
+        for i, req in enumerate(reqs):
+            if req.state == RequestState.FAILED:
+                raise req.error
+            toks = np.asarray(req.tokens, np.int32)
+            out[i, plen:plen + len(toks)] = toks
+        self._outputs = {"output_0": out}
+        if inputs is not None:
+            return [out]
+
+    def close(self) -> None:
+        self._api.close()

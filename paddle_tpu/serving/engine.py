@@ -1,0 +1,469 @@
+"""Slot-based continuous-batching decode engine (Orca/vLLM-style, XLA-first).
+
+``GPT.generate()`` compiles one decode loop per *batch*: every sequence in
+the call starts together and the whole batch runs to the slowest member. A
+serving endpoint sees the opposite workload — requests arrive and finish
+continuously. The TPU-idiomatic answer is **iteration-level scheduling over
+a fixed slot arena**:
+
+* The engine owns ONE compiled decode step over ``[num_slots]`` lanes. Each
+  slot holds (at most) one in-flight request: its last token, its write
+  position, and a block table into the paged KV arena
+  (:mod:`paddle_tpu.serving.kv_arena`).
+* Admitting a request = prefill its prompt (compiled per
+  ``compile_cache.prefill_bucket`` length bucket), scatter the prompt K/V
+  into the slot's blocks, and flip the slot's lane in the ``active`` mask.
+  Retiring = flip the mask back and return the blocks. **Neither touches
+  the compiled step** — all per-request state is runtime *data* (masking,
+  gather indices), never trace-time *structure*, so admit/retire causes
+  zero recompiles after warmup. The trace counters
+  (``serving.decode_compiles`` / ``serving.prefill_compiles`` in
+  ``compile_cache.stats()``) make that invariant assertable.
+* Inactive lanes still run the model (the step is shape-fixed) but their
+  writes are routed to the arena's scratch block 0 and their outputs are
+  discarded by the scheduler — the standard masked-lane trick that keeps
+  one executable serving every occupancy pattern.
+
+Decode numerics deliberately share ``models.gpt.masked_attention`` and
+``GPTForCausalLM._head_logits`` with ``generate()``, so a greedy request
+served through the engine reproduces ``generate(stop_token_id=...)``
+token-for-token.
+
+Under ``FLAGS_decode_donate`` the KV pools are donated into every compiled
+prefill/decode call: XLA updates the arena in place instead of
+double-buffering what is by far the engine's largest allocation.
+"""
+from __future__ import annotations
+
+import warnings
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..core import compile_cache, flags, resilience
+from ..core.tensor import Tensor
+from . import metrics
+from .kv_arena import KVArena, Reservation
+
+
+def _ceil_div(a: int, b: int) -> int:
+    return -(-a // b)
+
+
+class _PagedCacheView:
+    """One layer's decode-step view of the paged arena (the ``cache``
+    protocol object ``GPTAttention.forward`` drives): write the new token's
+    k/v at each lane's (block, offset), gather the lane's block table, and
+    attend under the per-lane position mask."""
+
+    def __init__(self, k_pool, v_pool, block_tables, positions, active,
+                 block_size: int):
+        self.k_pool = k_pool
+        self.v_pool = v_pool
+        self.block_tables = block_tables  # [S, max_blocks] int32
+        self.positions = positions        # [S] int32: write pos of new token
+        self.active = active              # [S] bool
+        self.block_size = block_size
+
+    def update_and_attend(self, q, k, v):
+        import jax.numpy as jnp
+
+        from ..models.gpt import masked_attention
+
+        qa, ka, va = (t._data if isinstance(t, Tensor) else t
+                      for t in (q, k, v))
+        s_lanes = qa.shape[0]
+        bs = self.block_size
+        pos = self.positions
+        # physical write target; inactive lanes are routed to scratch block
+        # 0 so their (garbage) writes never touch live cache state
+        row = self.block_tables[jnp.arange(s_lanes), pos // bs]
+        row = jnp.where(self.active, row, 0)
+        off = pos % bs
+        k_pool = self.k_pool.at[row, off].set(ka[:, 0])
+        v_pool = self.v_pool.at[row, off].set(va[:, 0])
+        # gather each lane's logical context [S, max_blocks*bs, H, D]
+        t_len = self.block_tables.shape[1] * bs
+        k_all = k_pool[self.block_tables].reshape(
+            s_lanes, t_len, *k_pool.shape[2:])
+        v_all = v_pool[self.block_tables].reshape(
+            s_lanes, t_len, *v_pool.shape[2:])
+        mask = (jnp.arange(t_len)[None, :] <= pos[:, None])[:, None, None, :]
+        o = masked_attention(qa, k_all, v_all, mask)
+        new = _PagedCacheView(k_pool, v_pool, self.block_tables,
+                              self.positions, self.active, bs)
+        return o, new
+
+
+class _CapturePrefillView:
+    """Prefill-side cache protocol object: plain causal attention over the
+    (padded) prompt chunk, returning the chunk's k/v as the successor cache
+    so the engine can scatter them into the slot's arena blocks."""
+
+    def update_and_attend(self, q, k, v):
+        import jax.numpy as jnp
+
+        from ..models.gpt import masked_attention
+
+        qa, ka, va = (t._data if isinstance(t, Tensor) else t
+                      for t in (q, k, v))
+        p = qa.shape[1]
+        mask = (jnp.arange(p)[None, :] <= jnp.arange(p)[:, None])[None, None]
+        o = masked_attention(qa, ka, va, mask)
+        return o, (ka, va)
+
+
+@dataclass
+class ServingConfig:
+    """Engine sizing. Zeros/None defer to flags / the model config:
+    ``num_slots`` -> ``FLAGS_serving_slots``, ``kv_block_size`` ->
+    ``FLAGS_kv_block_size``, ``max_model_len`` ->
+    ``cfg.max_position_embeddings``, ``num_blocks`` -> one full-length
+    context per slot (+ scratch), ``prefill_bucket_min`` ->
+    ``FLAGS_serving_prefill_bucket_min``, ``donate`` ->
+    ``FLAGS_decode_donate``."""
+
+    num_slots: int = 0
+    kv_block_size: int = 0
+    max_model_len: int = 0
+    num_blocks: int = 0
+    prefill_bucket_min: int = 0
+    donate: Optional[bool] = None
+    # retry transient (OSError/timeout) step failures — only honored with
+    # donation OFF: a donated call that died may have consumed its buffers,
+    # so retrying it would replay invalidated state
+    retry_policy: Optional[resilience.RetryPolicy] = None
+
+
+class ServingEngine:
+    """The compiled slot runtime. Host-side responsibilities only: slot
+    bookkeeping, block-table growth, and dispatching the two compiled
+    programs (per-bucket prefill, the single decode step). Queueing and
+    finish policy live in :class:`paddle_tpu.serving.scheduler.Scheduler`.
+    """
+
+    def __init__(self, model, config: Optional[ServingConfig] = None, **kw):
+        cfg = config or ServingConfig(**kw)
+        if config is not None and kw:
+            raise TypeError("pass either a ServingConfig or kwargs, not both")
+        self._model = model
+        model.eval()
+        params, buffers = model.functional_state()
+        self._objs = list(params.values()) + list(buffers.values())
+        self._arrays = [p._data for p in self._objs]
+
+        mcfg = model.cfg
+        self.num_slots = int(cfg.num_slots or flags.flag("serving_slots"))
+        self.block_size = int(cfg.kv_block_size or flags.flag("kv_block_size"))
+        self.max_model_len = int(cfg.max_model_len
+                                 or mcfg.max_position_embeddings)
+        if self.max_model_len > mcfg.max_position_embeddings:
+            raise ValueError("max_model_len exceeds the model's "
+                             "max_position_embeddings")
+        self.blocks_per_slot = _ceil_div(self.max_model_len, self.block_size)
+        num_blocks = int(cfg.num_blocks
+                         or self.num_slots * self.blocks_per_slot + 1)
+        self.prefill_bucket_min = int(cfg.prefill_bucket_min
+                                      or flags.flag("serving_prefill_bucket_min"))
+        self.donate = (bool(flags.flag("decode_donate"))
+                       if cfg.donate is None else bool(cfg.donate))
+        self._retry = cfg.retry_policy
+        if self._retry is None and not self.donate:
+            self._retry = resilience.io_policy()
+
+        kv_dtype = str(model.gpt.layers[0].attn.qkv.weight._data.dtype)
+        self.arena = KVArena(mcfg.num_layers, mcfg.num_heads,
+                             mcfg.hidden_size // mcfg.num_heads,
+                             num_blocks, self.block_size, kv_dtype)
+
+        s = self.num_slots
+        self._bt_host = np.zeros((s, self.blocks_per_slot), np.int32)
+        self._bt_dev = None  # invalidated whenever _bt_host changes
+        self._positions = np.zeros(s, np.int32)
+        self._last_tok = np.zeros(s, np.int32)
+        self._active = np.zeros(s, np.bool_)
+        self._slot_res: List[Optional[Reservation]] = [None] * s
+        # trace counters: incremented at TRACE time inside the compiled
+        # functions — the assertable "admit/retire never recompiles" number
+        self.decode_traces = 0
+        self.prefill_traces: Dict[int, int] = {}
+        self._step_jit = None
+        self._prefill_jits: Dict[int, object] = {}
+        self._meter = metrics.Meter()  # lifetime aggregate tokens/s gauge
+        metrics.set_gauge("slots.total", s)
+        metrics.set_gauge("arena.kv_bytes", self.arena.bytes_total())
+        self._refresh_gauges()
+
+    # ----------------------------------------------------------- capacity
+
+    def free_slots(self) -> int:
+        return int((~self._active).sum())
+
+    def active_slots(self) -> int:
+        return int(self._active.sum())
+
+    def blocks_needed(self, prompt_len: int, max_new_tokens: int) -> int:
+        return _ceil_div(prompt_len + max_new_tokens, self.block_size)
+
+    def validate(self, prompt_len: int, max_new_tokens: int) -> None:
+        if prompt_len < 1:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        total = prompt_len + max_new_tokens
+        if total > self.max_model_len:
+            raise ValueError(
+                f"prompt+new tokens {total} exceeds engine max_model_len "
+                f"{self.max_model_len}")
+        # a request whose worst case exceeds the WHOLE arena could never be
+        # admitted — reject at submit instead of parking it at the FCFS head
+        # forever (it would starve everything queued behind it)
+        need = self.blocks_needed(prompt_len, max_new_tokens)
+        cap = self.arena.num_blocks - 1
+        if need > cap:
+            raise ValueError(
+                f"request needs {need} KV blocks but the arena has only "
+                f"{cap} allocatable; it could never be admitted")
+
+    def can_admit(self, prompt_len: int, max_new_tokens: int) -> bool:
+        return (self.free_slots() > 0
+                and self.arena.can_reserve(
+                    self.blocks_needed(prompt_len, max_new_tokens)))
+
+    # ------------------------------------------------------------ compile
+
+    def _get_prefill(self, p_bucket: int):
+        fn = self._prefill_jits.get(p_bucket)
+        if fn is not None:
+            return fn
+        import jax
+        import jax.numpy as jnp
+
+        from ..core import rng as prng
+        from ..jit import _swap_data
+
+        model = self._model
+        n_layers = model.cfg.num_layers
+        bs = self.block_size
+
+        def prefill(arrays, ids, true_len, pools, rows):
+            # trace-time bookkeeping (runs once per bucket, not per call)
+            self.prefill_traces[p_bucket] = \
+                self.prefill_traces.get(p_bucket, 0) + 1
+            compile_cache.bump("serving.prefill_compiles")
+            views = [_CapturePrefillView() for _ in range(n_layers)]
+            with _swap_data(self._objs, list(arrays)):
+                with prng.key_guard(jax.random.key(0)):
+                    h, chunks = model.gpt(Tensor(ids), caches=views,
+                                          start_pos=0)
+                h_last = jax.lax.dynamic_index_in_dim(
+                    h._data, true_len - 1, axis=1, keepdims=False)
+                logits = model._head_logits(h_last)
+            p_idx = jnp.arange(p_bucket)
+            row = rows[p_idx // bs]
+            # padded positions (>= the true prompt length) scatter into the
+            # scratch block: bucketing never pollutes live cache state
+            row = jnp.where(p_idx < true_len, row, 0)
+            off = p_idx % bs
+            new_pools = []
+            for (kc, vc), (kp, vp) in zip(chunks, pools):
+                kc = kc._data if isinstance(kc, Tensor) else kc
+                vc = vc._data if isinstance(vc, Tensor) else vc
+                new_pools.append((kp.at[row, off].set(kc[0]),
+                                  vp.at[row, off].set(vc[0])))
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return nxt[0], new_pools
+
+        fn = (jax.jit(prefill, donate_argnums=(3,)) if self.donate
+              else jax.jit(prefill))
+        self._prefill_jits[p_bucket] = fn
+        return fn
+
+    def _get_step(self):
+        if self._step_jit is not None:
+            return self._step_jit
+        import jax
+        import jax.numpy as jnp
+
+        from ..core import rng as prng
+        from ..jit import _swap_data
+
+        model = self._model
+        bs = self.block_size
+
+        def step(arrays, pools, block_tables, positions, last_tok, active):
+            self.decode_traces += 1  # trace-time: the no-recompile counter
+            compile_cache.bump("serving.decode_compiles")
+            views = [_PagedCacheView(kp, vp, block_tables, positions,
+                                     active, bs) for kp, vp in pools]
+            with _swap_data(self._objs, list(arrays)):
+                with prng.key_guard(jax.random.key(0)):
+                    h, new_views = model.gpt(Tensor(last_tok[:, None]),
+                                             caches=views,
+                                             start_pos=positions)
+                logits = model._head_logits(h._data[:, 0])
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            new_pools = [(v.k_pool, v.v_pool) for v in new_views]
+            return nxt, new_pools
+
+        self._step_jit = (jax.jit(step, donate_argnums=(1,)) if self.donate
+                          else jax.jit(step))
+        return self._step_jit
+
+    def _call(self, fn, *args, name: str):
+        """Dispatch one compiled call. Donation makes a failed call
+        non-retryable (its buffers may already be consumed), so the retry
+        policy only wraps the copying build."""
+        def attempt(*a):
+            # the fault probe sits inside the retried callable so injected
+            # transient failures exercise the same recovery path real ones
+            # would
+            resilience.maybe_fault("serving_step")
+            return fn(*a)
+
+        with warnings.catch_warnings():
+            # donation is best-effort: XLA warns about lanes it could not
+            # alias (expected on CPU) — not actionable here
+            warnings.filterwarnings(
+                "ignore", message="Some donated buffers were not usable")
+            if self._retry is not None and not self.donate:
+                return resilience.call_with_retry(attempt, *args, name=name,
+                                                  policy=self._retry)
+            return attempt(*args)
+
+    # ----------------------------------------------------- slot lifecycle
+
+    def admit(self, prompt: np.ndarray, max_new_tokens: int
+              ) -> Tuple[int, int]:
+        """Prefill ``prompt`` into a free slot. Returns ``(slot,
+        first_token)`` — the first generated token comes out of the prefill
+        program itself (the prompt's last hidden state is already there).
+        Raises if no capacity; callers gate on :meth:`can_admit`."""
+        import jax.numpy as jnp
+
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        plen = int(prompt.shape[0])
+        self.validate(plen, max_new_tokens)
+        slot = int(np.argmin(self._active))
+        if self._active[slot]:
+            raise RuntimeError("no free slot")
+        res = self.arena.reserve(self.blocks_needed(plen, max_new_tokens))
+        try:
+            for _ in range(_ceil_div(plen, self.block_size)):
+                bi = len(res.taken)  # BEFORE take() appends
+                self._bt_host[slot, bi] = res.take()
+        except Exception:
+            res.release()
+            self._bt_host[slot, :] = 0
+            raise
+        self._bt_dev = None
+
+        p_bucket = compile_cache.prefill_bucket(
+            plen, self.max_model_len, self.prefill_bucket_min)
+        ids = np.zeros((1, p_bucket), np.int32)
+        ids[0, :plen] = prompt
+        mbp = _ceil_div(p_bucket, self.block_size)
+        rows = np.zeros(mbp, np.int32)
+        rows[:len(res.taken)] = res.taken
+        fn = self._get_prefill(p_bucket)
+        try:
+            nxt, new_pools = self._call(
+                fn, self._arrays, jnp.asarray(ids), jnp.int32(plen),
+                self.arena.pools, jnp.asarray(rows), name="serving.prefill")
+        except Exception:
+            # a failed admission must not leak capacity: return the blocks
+            # and clear the slot's table row. (Under donation the pools may
+            # already be consumed — the engine is then dead and every later
+            # call fails loudly; the scheduler fails requests cleanly.)
+            res.release()
+            self._bt_host[slot, :] = 0
+            self._bt_dev = None
+            raise
+        self.arena.set_pools(new_pools)
+
+        self._slot_res[slot] = res
+        self._positions[slot] = plen  # next write position
+        first = int(nxt)
+        self._last_tok[slot] = first
+        self._active[slot] = True
+        metrics.bump("engine.admits")
+        metrics.bump("tokens.prefill", plen)
+        metrics.bump("tokens.generated")  # the first token, out of prefill
+        self._refresh_gauges()
+        return slot, first
+
+    def retire(self, slot: int) -> None:
+        """Free a slot: deactivate its lane and return its blocks to the
+        arena free list. Purely host-side state — never recompiles."""
+        if not self._active[slot]:
+            return
+        self._active[slot] = False
+        res = self._slot_res[slot]
+        self._slot_res[slot] = None
+        if res is not None:
+            res.release()
+        self._bt_host[slot, :] = 0
+        self._bt_dev = None
+        self._positions[slot] = 0
+        self._last_tok[slot] = 0
+        metrics.bump("engine.retires")
+        self._refresh_gauges()
+
+    # --------------------------------------------------------- decode step
+
+    def decode_step(self) -> np.ndarray:
+        """One iteration: every active slot's last token is forwarded at
+        its own position, its k/v lands in its current block, and one new
+        token per slot comes back ([num_slots] int32; inactive lanes carry
+        garbage — callers must mask by activity)."""
+        import jax.numpy as jnp
+
+        # grow block tables whose write position crossed a block boundary
+        # (the reservation guarantees take() cannot fail)
+        for slot in np.flatnonzero(self._active):
+            res = self._slot_res[slot]
+            bi = int(self._positions[slot]) // self.block_size
+            if bi >= len(res.taken):
+                self._bt_host[slot, bi] = res.take()
+                self._bt_dev = None
+        if self._bt_dev is None:
+            self._bt_dev = jnp.asarray(self._bt_host)
+        fn = self._get_step()
+        nxt, new_pools = self._call(
+            fn, self._arrays, self.arena.pools, self._bt_dev,
+            jnp.asarray(self._positions), jnp.asarray(self._last_tok),
+            jnp.asarray(self._active), name="serving.step")
+        self.arena.set_pools(new_pools)
+        out = np.asarray(nxt)
+        act = self._active
+        self._positions[act] += 1
+        self._last_tok[act] = out[act]
+        metrics.bump("engine.steps")
+        metrics.bump("tokens.generated", int(act.sum()))
+        self._meter.tick(int(act.sum()))
+        metrics.set_gauge("tokens_per_sec", round(self._meter.rate(), 1))
+        return out
+
+    # -------------------------------------------------------------- stats
+
+    def _refresh_gauges(self) -> None:
+        metrics.set_gauge("slots.active", self.active_slots())
+        a = self.arena.stats()
+        metrics.set_gauge("arena.blocks_free", a["blocks_free"])
+        metrics.set_gauge("arena.blocks_total", a["blocks_total"])
+        # internal fragmentation: taken-block capacity minus live context
+        frag = 0
+        for slot in np.flatnonzero(self._active):
+            res = self._slot_res[slot]
+            frag += len(res.taken) * self.block_size \
+                - int(self._positions[slot])
+        metrics.set_gauge("arena.frag_tokens", frag)
+
+    def stats(self) -> dict:
+        out = {"slots.total": self.num_slots,
+               "slots.active": self.active_slots(),
+               "decode_traces": self.decode_traces,
+               "prefill_traces": dict(self.prefill_traces)}
+        out.update({f"arena.{k}": v for k, v in self.arena.stats().items()})
+        return out

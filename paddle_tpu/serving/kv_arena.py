@@ -1,0 +1,174 @@
+"""Block-granular KV cache allocation over a fixed arena (vLLM-style pages).
+
+The serving engine's KV cache is NOT per-request buffers (one allocation per
+admit would fragment HBM and retrace XLA) but one fixed **arena** per layer:
+
+    k_pool, v_pool : [num_blocks, block_size, num_heads, head_dim]
+
+A request's cache is a *block table* — an ordered list of physical block ids
+covering its context. Blocks are taken from a LIFO free list as the context
+grows and returned at retire, so churn reuses the hottest blocks instead of
+growing the footprint. **Physical block 0 is reserved as the scratch sink**:
+masked writes from inactive/padded lanes land there, which is what lets one
+compiled decode step serve any admit/retire pattern without recompiling.
+
+Admission control is two-phase: :meth:`KVArena.reserve` claims a request's
+worst-case block budget up front (so mid-decode growth can never fail — no
+preemption/swap machinery needed), and :meth:`Reservation.take` converts one
+reserved block at a time into a physical block as the context actually
+crosses a block boundary.
+
+Counters (``arena.*`` in ``serving.metrics``): allocs, frees, reuse (a taken
+block that had been used before — the free list working), alloc failures,
+high-water blocks in use.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+from ..core import flags
+from . import metrics
+
+
+class ArenaExhaustedError(RuntimeError):
+    """No free (unreserved) blocks left for the requested budget."""
+
+
+@dataclass
+class Reservation:
+    """A request's admission-time block budget. ``take()`` converts one
+    reserved block into a physical block id; ``release()`` returns every
+    taken block to the free list and drops the unused remainder."""
+
+    arena: "KVArena"
+    total: int
+    taken: List[int] = field(default_factory=list)
+    released: bool = False
+
+    def remaining(self) -> int:
+        return self.total - len(self.taken)
+
+    def take(self) -> int:
+        if self.released:
+            raise RuntimeError("reservation already released")
+        if self.remaining() <= 0:
+            raise ArenaExhaustedError(
+                f"reservation of {self.total} blocks exhausted")
+        blk = self.arena._pop_block()
+        self.taken.append(blk)
+        return blk
+
+    def release(self) -> None:
+        if self.released:
+            return
+        self.released = True
+        self.arena._release(self)
+
+
+class KVArena:
+    """The fixed paged KV storage + its free-list allocator.
+
+    ``num_blocks`` INCLUDES the reserved scratch block 0; allocatable
+    capacity is ``num_blocks - 1`` blocks of ``block_size`` tokens each.
+    Pools are jax arrays and are *replaced* after every compiled step (the
+    engine donates them into the step under ``FLAGS_decode_donate``, so the
+    previous arrays are dead the moment the step runs).
+    """
+
+    def __init__(self, num_layers: int, num_heads: int, head_dim: int,
+                 num_blocks: int, block_size: Optional[int] = None,
+                 dtype: str = "float32"):
+        import jax.numpy as jnp
+
+        self.block_size = int(block_size or flags.flag("kv_block_size"))
+        if self.block_size < 1:
+            raise ValueError("kv_block_size must be >= 1")
+        if num_blocks < 2:
+            raise ValueError("need >= 2 blocks (block 0 is the scratch sink)")
+        self.num_blocks = int(num_blocks)
+        self.num_layers = int(num_layers)
+        self.dtype = dtype
+        shape = (self.num_blocks, self.block_size, num_heads, head_dim)
+        self._pools: List[Tuple] = [
+            (jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+            for _ in range(num_layers)]
+        self._itemsize = jnp.zeros((), dtype).dtype.itemsize
+        # LIFO: churny workloads keep re-taking the most recently freed
+        # blocks (cache-friendly, and makes reuse observable)
+        self._free: List[int] = list(range(1, self.num_blocks))
+        self._reserved = 0
+        self._ever_used: set = set()
+        self._high_water = 0
+
+    # ------------------------------------------------------------- pools
+
+    @property
+    def pools(self) -> List[Tuple]:
+        return self._pools
+
+    def set_pools(self, pools) -> None:
+        """Adopt the pool arrays returned by a compiled step (the old ones
+        were donated into it and are no longer valid)."""
+        self._pools = list(pools)
+
+    # -------------------------------------------------------- allocation
+
+    def blocks_free(self) -> int:
+        return len(self._free)
+
+    def blocks_in_use(self) -> int:
+        return (self.num_blocks - 1) - len(self._free)
+
+    def can_reserve(self, n: int) -> bool:
+        return len(self._free) - self._reserved >= n
+
+    def reserve(self, n: int) -> Reservation:
+        """Claim a worst-case budget of ``n`` blocks (none taken yet)."""
+        n = int(n)
+        if not self.can_reserve(n):
+            metrics.bump("arena.alloc_failed")
+            raise ArenaExhaustedError(
+                f"cannot reserve {n} blocks "
+                f"({len(self._free)} free, {self._reserved} already reserved)")
+        self._reserved += n
+        return Reservation(self, n)
+
+    def _pop_block(self) -> int:
+        if not self._free:
+            metrics.bump("arena.alloc_failed")
+            raise ArenaExhaustedError("free list empty")
+        blk = self._free.pop()
+        self._reserved -= 1
+        metrics.bump("arena.alloc")
+        if blk in self._ever_used:
+            metrics.bump("arena.reuse")
+        self._ever_used.add(blk)
+        self._high_water = max(self._high_water, self.blocks_in_use())
+        return blk
+
+    def _release(self, res: Reservation) -> None:
+        self._reserved -= res.remaining()
+        self._free.extend(res.taken)
+        metrics.bump("arena.freed", len(res.taken))
+        res.taken = []
+
+    # ------------------------------------------------------------- stats
+
+    def bytes_total(self) -> int:
+        k, _ = self._pools[0]
+        per_pool = 1
+        for d in k.shape:
+            per_pool *= int(d)
+        return per_pool * self._itemsize * 2 * self.num_layers
+
+    def stats(self) -> dict:
+        return {
+            "blocks_total": self.num_blocks - 1,
+            "blocks_free": self.blocks_free(),
+            "blocks_in_use": self.blocks_in_use(),
+            "blocks_reserved": self._reserved,
+            "high_water": self._high_water,
+            "block_size": self.block_size,
+            "kv_bytes": self.bytes_total(),
+        }

@@ -1,0 +1,119 @@
+"""Serving observability: counters + gauges for the decode engine.
+
+Same contract as ``core.compile_cache`` / ``core.resilience`` counters (plain
+dicts mutated under the GIL, snapshot under a lock), plus *gauges* — point-in-
+time values the engine refreshes each scheduler iteration (queue depth, slot
+occupancy, KV-arena free blocks/bytes). Headline numbers are registered as
+``core.memory_stats`` providers so ``memory_summary()`` shows the serving
+picture next to the allocator/compile-cache picture, the profiler snapshots
+per-run deltas, and ``tools/serving_stats.py`` dumps them standalone.
+
+Counter namespaces:
+
+* ``requests.*``  — submitted / finished / cancelled / expired / failed
+* ``tokens.*``    — ``generated`` (decode) and ``prefill`` (prompt) tokens
+* ``engine.*``    — steps, admits, retires, decode/prefill trace counts
+* ``arena.*``     — block allocs / frees / reuse / alloc failures
+
+Gauges: ``queue.depth``, ``slots.active``, ``slots.total``,
+``arena.blocks_free``, ``arena.blocks_total``, ``arena.kv_bytes``,
+``arena.frag_tokens`` (allocated-block capacity minus live context tokens —
+internal fragmentation of the paged cache), ``tokens_per_sec`` (the engine's
+lifetime-aggregate decode rate from its :class:`Meter`).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict
+
+_lock = threading.Lock()
+
+# plain dicts mutated under the GIL (compile_cache._counts contract): the
+# per-step hot path bumps these without taking the lock
+_counts: Dict[str, int] = {}
+_gauges: Dict[str, float] = {}
+_providers_registered = False
+
+
+def bump(key: str, n: int = 1) -> None:
+    """Increment a serving counter (GIL-atomic dict update, no lock)."""
+    _counts[key] = _counts.get(key, 0) + n
+
+
+def set_gauge(key: str, value) -> None:
+    """Record a point-in-time value (slot occupancy, queue depth, ...)."""
+    _gauges[key] = value
+
+
+def stats() -> dict:
+    """One merged snapshot: counters plus current gauge values."""
+    with _lock:
+        out: dict = dict(_counts)
+        out.update(_gauges)
+    return out
+
+
+def reset_stats() -> None:
+    with _lock:
+        _counts.clear()
+        _gauges.clear()
+
+
+def stats_delta(before: dict, after: dict, *, drop_zero: bool = False) -> dict:
+    """Numeric difference of two :func:`stats` snapshots — one shared
+    definition with the compile cache so every report agrees. NOTE gauges
+    are differenced too (a delta report shows occupancy *change*)."""
+    from ..core import compile_cache
+
+    return compile_cache.stats_delta(before, after, drop_zero=drop_zero)
+
+
+class Meter:
+    """Tokens/s meter over a wall-clock window: ``tick(n)`` per step,
+    ``rate()`` for the current aggregate rate since construction/reset."""
+
+    def __init__(self) -> None:
+        self.reset()
+
+    def reset(self) -> None:
+        self._t0 = time.perf_counter()
+        self._n = 0
+
+    def tick(self, n: int) -> None:
+        self._n += int(n)
+
+    def tokens(self) -> int:
+        return self._n
+
+    def rate(self) -> float:
+        dt = time.perf_counter() - self._t0
+        return self._n / dt if dt > 0 else 0.0
+
+
+def _register_providers() -> None:
+    """Headline serving numbers on the shared observability surface."""
+    global _providers_registered
+    with _lock:
+        if _providers_registered:
+            return
+        from ..core import memory_stats
+
+        for name, key, table in (
+                ("serving.tokens_generated", "tokens.generated", _counts),
+                ("serving.requests_finished", "requests.finished", _counts),
+                ("serving.requests_shed", "requests.shed", _counts),
+                ("serving.tokens_per_sec", "tokens_per_sec", _gauges),
+                ("serving.queue_depth", "queue.depth", _gauges),
+                ("serving.slots_active", "slots.active", _gauges),
+                ("serving.arena_blocks_free", "arena.blocks_free", _gauges),
+                ("serving.kv_arena_bytes", "arena.kv_bytes", _gauges)):
+            memory_stats.register_stat_provider(
+                name, lambda k=key, t=table: t.get(k, 0))
+        _providers_registered = True
+
+
+try:
+    _register_providers()
+except Exception:  # observability is optional, never an import blocker
+    pass

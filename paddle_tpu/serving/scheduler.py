@@ -1,0 +1,239 @@
+"""Iteration-level (continuous) batching scheduler.
+
+Classic batch serving admits a batch, decodes it to completion, then admits
+the next batch — every request waits for the stragglers. Orca's insight
+(and vLLM's): schedule at *iteration* granularity. Between any two decode
+steps the engine can retire finished requests and admit waiting ones into
+the freed slots, because the compiled step is occupancy-agnostic
+(:mod:`paddle_tpu.serving.engine`).
+
+The scheduler owns the policy half of that loop:
+
+* **FCFS admission with capacity gating** — a request is admitted when a
+  slot is free AND the KV arena can reserve its worst-case block budget
+  (so a running request can never be starved of cache mid-decode).
+* **Finish detection** at every step boundary: stop-token hit, token
+  budget, cancellation, and per-request wall-clock deadlines
+  (``core.resilience.Deadline``).
+* **Queue hygiene**: cancelled/expired requests are culled before they
+  ever cost a prefill; submission overload is shed by the caller via
+  ``core.resilience.check_overload`` (see ``serving.api``).
+
+Decoding is greedy (temperature-0) — the deterministic serving mode whose
+outputs are asserted token-for-token against ``GPT.generate()``.
+"""
+from __future__ import annotations
+
+import itertools
+import queue as _queue
+import threading
+from collections import deque
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+import numpy as np
+
+from ..core import resilience
+from . import metrics
+
+_req_counter = itertools.count()
+
+
+class RequestState:
+    QUEUED = "QUEUED"
+    RUNNING = "RUNNING"
+    FINISHED = "FINISHED"
+    CANCELLED = "CANCELLED"
+    FAILED = "FAILED"
+
+
+@dataclass(eq=False)  # identity equality: list membership must never
+class Request:        # compare numpy prompt payloads
+    """One generation request moving through the engine.
+
+    ``tokens`` accumulates generated ids (the stop token, when hit, is the
+    last entry — mirroring ``generate()``'s fill semantics trimmed at the
+    first stop). ``stream_queue``/``done_event`` are the streaming surface
+    ``api.stream()`` consumes."""
+
+    prompt: np.ndarray
+    max_new_tokens: int = 32
+    stop_token_id: Optional[int] = None
+    request_id: str = ""
+    deadline: resilience.Deadline = field(
+        default_factory=resilience.Deadline)
+    state: str = RequestState.QUEUED
+    tokens: List[int] = field(default_factory=list)
+    error: Optional[BaseException] = None
+    slot: Optional[int] = None
+    stream_queue: "_queue.SimpleQueue" = field(
+        default_factory=_queue.SimpleQueue)
+    done_event: threading.Event = field(default_factory=threading.Event)
+    _cancel: bool = False
+
+    def __post_init__(self):
+        self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)
+        if not self.request_id:
+            self.request_id = f"req-{next(_req_counter)}"
+
+    @property
+    def finished(self) -> bool:
+        return self.state in (RequestState.FINISHED, RequestState.CANCELLED,
+                              RequestState.FAILED)
+
+    def cancel(self) -> None:
+        self._cancel = True
+
+    def output_ids(self) -> np.ndarray:
+        """prompt + generated tokens (the serving analog of generate()'s
+        return, without the post-stop fill)."""
+        return np.concatenate([self.prompt,
+                               np.asarray(self.tokens, np.int32)])
+
+
+class Scheduler:
+    """Drives one :class:`ServingEngine` at iteration granularity. Not
+    thread-safe by itself — ``serving.api`` serializes access."""
+
+    def __init__(self, engine):
+        self.engine = engine
+        self.waiting: deque = deque()
+        self.running: List[Request] = []
+
+    # ---------------------------------------------------------- admission
+
+    def submit(self, request: Request) -> Request:
+        """Enqueue (capacity errors surface immediately; overload shedding
+        happens in ``api.submit`` where the queue-depth policy lives)."""
+        self.engine.validate(int(request.prompt.shape[0]),
+                             int(request.max_new_tokens))
+        request.state = RequestState.QUEUED
+        self.waiting.append(request)
+        metrics.bump("requests.submitted")
+        self._gauges()
+        return request
+
+    def has_work(self) -> bool:
+        return bool(self.waiting or self.running)
+
+    # ------------------------------------------------------------ finish
+
+    def _finish(self, req: Request, state: str,
+                error: Optional[BaseException] = None) -> None:
+        if req.slot is not None:
+            self.engine.retire(req.slot)
+            if req in self.running:
+                self.running.remove(req)
+            req.slot = None
+        req.state = state
+        req.error = error
+        key = {RequestState.FINISHED: "requests.finished",
+               RequestState.CANCELLED: "requests.cancelled",
+               RequestState.FAILED: "requests.failed"}[state]
+        metrics.bump(key)
+        if error is not None and isinstance(
+                error, resilience.DeadlineExceededError):
+            metrics.bump("requests.expired")
+            # the shared resilience counter dashboards watch (the same key
+            # Deadline.check() bumps)
+            resilience.bump("deadline.exceeded")
+        req.stream_queue.put(None)  # stream sentinel
+        req.done_event.set()
+
+    def _emit(self, req: Request, token: int) -> None:
+        req.tokens.append(int(token))
+        req.stream_queue.put(int(token))
+
+    def _check_boundary(self, req: Request) -> bool:
+        """Policy checks at a step boundary; True if the request ended."""
+        if req._cancel:
+            self._finish(req, RequestState.CANCELLED)
+            return True
+        # completion outranks the deadline: output that is already whole
+        # (stop token emitted / budget reached) is returned even if the
+        # clock ran out on the same step — paid-for work is never discarded
+        if req.tokens:
+            stop = req.stop_token_id
+            if stop is not None and req.tokens[-1] == stop:
+                self._finish(req, RequestState.FINISHED)
+                return True
+            if len(req.tokens) >= req.max_new_tokens:
+                self._finish(req, RequestState.FINISHED)
+                return True
+        if req.deadline.expired():
+            self._finish(req, RequestState.FAILED,
+                         resilience.DeadlineExceededError(
+                             f"{req.request_id} exceeded its deadline"))
+            return True
+        return False
+
+    # -------------------------------------------------------------- step
+
+    def step(self) -> bool:
+        """One scheduler iteration: cull dead queue entries, admit while
+        capacity allows, run one engine decode step, retire finished.
+        Returns True if any request made progress."""
+        progress = False
+        # cull queued requests that died before costing a prefill
+        for req in list(self.waiting):
+            if req._cancel or req.deadline.expired():
+                self.waiting.remove(req)
+                self._finish(req,
+                             RequestState.CANCELLED if req._cancel
+                             else RequestState.FAILED,
+                             None if req._cancel
+                             else resilience.DeadlineExceededError(
+                                 f"{req.request_id} expired in queue"))
+                progress = True
+        # FCFS admission into free slots
+        while self.waiting and self.engine.can_admit(
+                int(self.waiting[0].prompt.shape[0]),
+                int(self.waiting[0].max_new_tokens)):
+            req = self.waiting.popleft()
+            try:
+                slot, first = self.engine.admit(req.prompt,
+                                                req.max_new_tokens)
+            except Exception as e:
+                # a failed prefill fails THIS request (done_event set,
+                # stream sentinel delivered) — never the whole pump
+                self._finish(req, RequestState.FAILED, e)
+                progress = True
+                continue
+            req.slot = slot
+            req.state = RequestState.RUNNING
+            self.running.append(req)
+            self._emit(req, first)
+            progress = True
+            self._check_boundary(req)  # may retire immediately (stop/budget)
+        # one decode iteration over every occupied slot
+        if self.running:
+            toks = self.engine.decode_step()
+            for req in list(self.running):
+                self._emit(req, int(toks[req.slot]))
+                self._check_boundary(req)
+            progress = True
+        self._gauges()
+        return progress
+
+    def fail_all(self, error: BaseException) -> None:
+        """Fail every queued and running request (engine fatality or
+        shutdown): each gets its error, stream sentinel, and done_event —
+        no caller is ever left blocking on an abandoned request."""
+        for req in list(self.waiting):
+            self.waiting.remove(req)
+            self._finish(req, RequestState.FAILED, error)
+        for req in list(self.running):
+            self._finish(req, RequestState.FAILED, error)
+        self._gauges()
+
+    def run_until_idle(self, max_steps: Optional[int] = None) -> None:
+        steps = 0
+        while self.has_work():
+            self.step()
+            steps += 1
+            if max_steps is not None and steps >= max_steps:
+                raise RuntimeError(
+                    f"scheduler still busy after {max_steps} steps")
+
+    def _gauges(self) -> None:
+        metrics.set_gauge("queue.depth", len(self.waiting))

@@ -1,0 +1,569 @@
+"""paddle_tpu.serving: continuous-batching slot engine, paged KV arena,
+iteration-level scheduler, submit/stream/cancel API, and the
+``inference.Config`` predictor bridge (ISSUE 4).
+
+The compiled-engine tests share one module-scoped ``ServingAPI`` so tier-1
+pays its prefill/decode compiles once; assertions on trace counters are
+written lifetime-safe (every bucket traced at most once, decode traced
+exactly once) so test order can never flip them. Heavy churn and
+fault-injection cases carry ``slow`` / ``chaos``.
+"""
+import os
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core import compile_cache, flags, resilience
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.models.gpt import GPTForCausalLM, gpt_tiny
+from paddle_tpu.serving import (
+    ArenaExhaustedError,
+    KVArena,
+    RequestState,
+    ServingAPI,
+    ServingConfig,
+    ServingEngine,
+)
+from paddle_tpu.serving import metrics as serving_metrics
+
+pytestmark = pytest.mark.serving
+
+MAX_LEN = 64
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(0)
+    m = GPTForCausalLM(gpt_tiny())
+    m.eval()
+    return m
+
+
+@pytest.fixture(scope="module")
+def api(model):
+    a = ServingAPI(model, num_slots=4, kv_block_size=8, max_model_len=MAX_LEN)
+    yield a
+    a.close()
+
+
+def _prompt(rng, n):
+    return rng.integers(0, 1024, (n,), dtype=np.int32)
+
+
+def _ref(model, prompt, max_new, stop=None):
+    out = model.generate(Tensor(np.asarray(prompt)[None]),
+                         max_new_tokens=max_new, stop_token_id=stop)
+    return np.asarray(out._data)[0]
+
+
+# ---------------------------------------------------------------- engine
+
+
+def test_engine_parity_with_generate(api, model):
+    """Greedy decode through the paged-arena slot engine is token-for-token
+    identical to the contiguous-cache generate() path."""
+    rng = np.random.default_rng(1)
+    prompts = [_prompt(rng, n) for n in (5, 11)]
+    reqs = [api.submit(p, max_new_tokens=8) for p in prompts]
+    api.run_until_idle()
+    for p, r in zip(prompts, reqs):
+        assert r.state == RequestState.FINISHED
+        np.testing.assert_array_equal(r.output_ids(), _ref(model, p, 8))
+
+
+def test_stop_token_parity_and_early_exit(api, model):
+    """A stop-token request ends at the stop hit and matches
+    generate(stop_token_id=...) up to its fill tail."""
+    rng = np.random.default_rng(2)
+    p = _prompt(rng, 6)
+    # pick a stop token the greedy decode actually emits mid-stream
+    full = _ref(model, p, 12)
+    stop = int(full[len(p) + 3])
+    ref = _ref(model, p, 12, stop=stop)
+    req = api.submit(p, max_new_tokens=12, stop_token_id=stop)
+    api.run_until_idle()
+    got = req.output_ids()
+    assert req.state == RequestState.FINISHED
+    assert int(got[-1]) == stop
+    assert len(got) < len(p) + 12  # genuinely stopped early
+    np.testing.assert_array_equal(got, ref[: len(got)])
+    assert np.all(ref[len(got):] == stop)  # generate() fills the tail
+
+
+def test_admit_retire_never_recompiles(api):
+    """The engine invariant: churning admits/retires across occupancy
+    patterns adds zero decode traces and retraces no prefill bucket."""
+    rng = np.random.default_rng(3)
+    api.run_until_idle()
+    # make sure the decode step has been traced at least once already
+    api.submit(_prompt(rng, 5), max_new_tokens=3)
+    api.run_until_idle()
+    d0 = api.engine.decode_traces
+    cc0 = compile_cache.stats().get("serving.decode_compiles", 0)
+    for n_live in (1, 3, 4, 2):
+        reqs = [api.submit(_prompt(rng, 4 + 3 * i), max_new_tokens=2 + i)
+                for i in range(n_live)]
+        api.run_until_idle()
+        assert all(r.state == RequestState.FINISHED for r in reqs)
+    assert api.engine.decode_traces == d0 == 1
+    assert compile_cache.stats().get("serving.decode_compiles", 0) == cc0
+    assert all(v == 1 for v in api.engine.prefill_traces.values())
+    assert api.engine.active_slots() == 0
+
+
+def test_mixed_lengths_bounded_by_bucket_count(api):
+    """Mixed prompt lengths land in at most len({their buckets}) compiled
+    prefill programs (shape bucketing from core.compile_cache)."""
+    rng = np.random.default_rng(4)
+    lens = (3, 5, 9, 14, 17, 21, 30)
+    expected = {compile_cache.prefill_bucket(n, MAX_LEN) for n in lens}
+    for n in lens:
+        api.submit(_prompt(rng, n), max_new_tokens=2)
+    api.run_until_idle()
+    traced = set(api.engine.prefill_traces)
+    assert expected <= traced  # every needed bucket exists...
+    assert len(expected) < len(lens)  # ...and bucketing actually coalesced
+    assert all(v == 1 for v in api.engine.prefill_traces.values())
+
+
+def test_prefill_bucket_ladder():
+    m = int(flags.flag("serving_prefill_bucket_min"))
+    assert compile_cache.prefill_bucket(1) == m
+    assert compile_cache.prefill_bucket(m) == m
+    for n in (1, 7, 33, 100):
+        assert compile_cache.prefill_bucket(n) >= n
+    # clamped to the model's position budget
+    assert compile_cache.prefill_bucket(70, max_len=100) <= 100
+    # whole-range bucket count stays small (the "handful of compiles" claim)
+    assert len({compile_cache.prefill_bucket(n, 2048)
+                for n in range(1, 2049)}) <= 16
+
+
+def test_engine_rejects_oversized_and_empty(api):
+    with pytest.raises(ValueError):
+        api.submit(np.arange(MAX_LEN, dtype=np.int32), max_new_tokens=1)
+    with pytest.raises(ValueError):
+        api.submit(np.zeros(0, np.int32), max_new_tokens=4)
+    with pytest.raises(ValueError):
+        api.submit(np.zeros(4, np.int32), max_new_tokens=0)
+
+
+# ------------------------------------------------------- cancel / deadline
+
+
+def test_cancel_mid_decode_frees_slot(api):
+    rng = np.random.default_rng(5)
+    req = api.submit(_prompt(rng, 5), max_new_tokens=40)
+    for _ in range(3):
+        api._pump_once()
+    assert req.state == RequestState.RUNNING
+    assert api.engine.active_slots() == 1
+    api.cancel(req)
+    assert req.state == RequestState.CANCELLED
+    assert api.engine.active_slots() == 0
+    a = api.engine.arena.stats()
+    assert a["blocks_in_use"] == 0 and a["blocks_reserved"] == 0
+    with pytest.raises(RuntimeError, match="cancelled"):
+        api.result(req)
+
+
+def test_cancel_while_queued_costs_no_prefill(api):
+    rng = np.random.default_rng(6)
+    before = dict(api.engine.prefill_traces)
+    admits0 = serving_metrics.stats().get("engine.admits", 0)
+    req = api.submit(_prompt(rng, 5), max_new_tokens=4)
+    req.cancel()
+    api.run_until_idle()
+    assert req.state == RequestState.CANCELLED
+    assert serving_metrics.stats().get("engine.admits", 0) == admits0
+    assert api.engine.prefill_traces == before
+
+
+def test_deadline_expiry_fails_request_and_frees_slot(api):
+    rng = np.random.default_rng(7)
+    dl0 = resilience.stats().get("deadline.exceeded", 0)
+    req = api.submit(_prompt(rng, 5), max_new_tokens=50, timeout=0.02)
+    time.sleep(0.03)
+    api.run_until_idle()
+    assert req.state == RequestState.FAILED
+    assert isinstance(req.error, resilience.DeadlineExceededError)
+    # expiry lands on the shared resilience counter dashboards watch
+    assert resilience.stats().get("deadline.exceeded", 0) == dl0 + 1
+    assert api.engine.active_slots() == 0
+    with pytest.raises(resilience.DeadlineExceededError):
+        api.result(req)
+
+
+def test_queue_overload_shedding(api):
+    rng = np.random.default_rng(8)
+    old = api._max_queue
+    api._max_queue = 2
+    try:
+        shed0 = resilience.stats().get("overload.shed", 0)
+        reqs = [api.submit(_prompt(rng, 4), max_new_tokens=2)
+                for _ in range(2)]
+        with pytest.raises(resilience.QueueOverloadError):
+            api.submit(_prompt(rng, 4), max_new_tokens=2)
+        assert resilience.stats().get("overload.shed", 0) == shed0 + 1
+    finally:
+        api._max_queue = old
+        for r in reqs:
+            r.cancel()
+        api.run_until_idle()
+
+
+def test_stream_yields_generated_tokens(api, model):
+    rng = np.random.default_rng(9)
+    p = _prompt(rng, 7)
+    req = api.submit(p, max_new_tokens=6)
+    toks = list(api.stream(req))
+    assert req.state == RequestState.FINISHED
+    assert toks == req.tokens
+    np.testing.assert_array_equal(
+        np.concatenate([p, np.asarray(toks, np.int32)]), _ref(model, p, 6))
+
+
+# --------------------------------------------------------------- KV arena
+
+
+def test_arena_freelist_reuse_under_churn():
+    arena = KVArena(num_layers=1, num_heads=2, head_dim=4,
+                    num_blocks=9, block_size=4)
+    serving_metrics_before = serving_metrics.stats().get("arena.reuse", 0)
+    res = arena.reserve(3)
+    first = [res.take() for _ in range(3)]
+    assert 0 not in first  # scratch block is never handed out
+    assert arena.blocks_in_use() == 3
+    res.release()
+    assert arena.blocks_free() == 8 and arena.blocks_in_use() == 0
+    # LIFO: the churny path re-takes exactly the just-freed blocks
+    res2 = arena.reserve(3)
+    second = [res2.take() for _ in range(3)]
+    assert set(second) == set(first)
+    assert serving_metrics.stats().get("arena.reuse", 0) \
+        == serving_metrics_before + 3
+    res2.release()
+
+
+def test_arena_two_phase_reservation_accounting():
+    arena = KVArena(num_layers=1, num_heads=2, head_dim=4,
+                    num_blocks=6, block_size=4)
+    res = arena.reserve(3)
+    # the budget is claimed up front: only 2 of 5 blocks remain grantable
+    assert not arena.can_reserve(3)
+    assert arena.can_reserve(2)
+    with pytest.raises(ArenaExhaustedError):
+        arena.reserve(3)
+    # a reservation cannot take past its own budget either
+    for _ in range(3):
+        res.take()
+    with pytest.raises(ArenaExhaustedError):
+        res.take()
+    res.release()
+    assert arena.can_reserve(5)
+    # releasing twice is a no-op, not a double-free
+    res.release()
+    assert arena.blocks_free() == 5
+
+
+def test_engine_admission_gated_on_arena(model):
+    """can_admit() is false when the arena cannot cover the worst case —
+    a running request can never be starved of blocks mid-decode."""
+    eng = ServingEngine(model, num_slots=2, kv_block_size=8,
+                        max_model_len=32, num_blocks=5)  # 4 allocatable
+    assert eng.can_admit(8, 24)  # needs all 4 blocks
+    slot, _ = eng.admit(np.zeros(8, np.int32), max_new_tokens=24)
+    assert not eng.can_admit(1, 1)  # slot free, arena full
+    eng.retire(slot)
+    assert eng.can_admit(8, 24)
+
+
+def test_unadmittable_request_rejected_at_submit(model):
+    """A request that fits max_model_len but needs more KV blocks than the
+    whole arena holds is rejected by validate() — otherwise it would park
+    un-admittable at the FCFS head and starve the queue forever."""
+    eng = ServingEngine(model, num_slots=2, kv_block_size=8,
+                        max_model_len=64, num_blocks=5)  # 4 allocatable
+    eng.validate(8, 24)  # exactly the arena: fine
+    with pytest.raises(ValueError, match="could never be admitted"):
+        eng.validate(8, 56)  # 8 blocks > 4 allocatable, yet total <= 64
+
+
+def test_foreground_step_failure_fails_all_requests(api, monkeypatch):
+    """A decode-step exception during foreground pumping must not strand
+    RUNNING requests holding slots and arena blocks: every in-flight
+    request fails (error + done_event) and capacity is reclaimed, exactly
+    like the background pump's fail_all path."""
+    rng = np.random.default_rng(31)
+    req = api.submit(_prompt(rng, 5), max_new_tokens=8)
+    boom = RuntimeError("decode step died")
+
+    def dead_step():
+        raise boom
+
+    monkeypatch.setattr(api.engine, "decode_step", dead_step)
+    with pytest.raises(RuntimeError, match="decode step died"):
+        api.run_until_idle()
+    assert req.state == RequestState.FAILED
+    assert req.error is boom
+    assert req.done_event.is_set()
+    assert api.engine.free_slots() == 4
+    a = api.engine.arena.stats()
+    assert a["blocks_in_use"] == 0 and a["blocks_reserved"] == 0
+
+
+# ----------------------------------------------- resilience hooks (unit)
+
+
+def test_deadline_helpers():
+    assert not resilience.Deadline.after(None).expired()
+    assert resilience.Deadline.after(None).remaining() == float("inf")
+    d = resilience.Deadline.after(0)
+    assert d.expired()
+    with pytest.raises(resilience.DeadlineExceededError):
+        d.check("unit")
+    resilience.Deadline.after(60).check("unit")  # far future: no raise
+
+
+def test_check_overload_limits():
+    resilience.check_overload(5, limit=0)  # 0 = unlimited
+    resilience.check_overload(5, limit=None, name="")  # flag default 0
+    with pytest.raises(resilience.QueueOverloadError):
+        resilience.check_overload(3, limit=3, name="unit")
+    assert resilience.stats().get("overload.unit.shed", 0) >= 1
+
+
+# ------------------------------------------------- inference.Config bridge
+
+
+def test_config_accepts_pdmodel_directory(tmp_path):
+    from paddle_tpu import inference
+
+    d = tmp_path / "exported"
+    d.mkdir()
+    (d / "model.pdmodel").write_bytes(b"")
+    cfg = inference.Config(str(d))
+    assert cfg.model_prefix == str(d / "model")
+    (d / "other.pdmodel").write_bytes(b"")
+    with pytest.raises(ValueError, match="exactly one"):
+        inference.Config(str(d))
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    with pytest.raises(ValueError, match="exactly one"):
+        inference.Config(str(empty))
+
+
+def test_config_placement_decision():
+    from paddle_tpu import inference
+
+    cfg = inference.Config("m.pdmodel")
+    assert cfg._resolve_placement() == "cpu"  # no request: report actual
+    cfg.enable_use_gpu(100, 0)
+    assert cfg._device == ("gpu", 0)
+    assert cfg._resolve_placement() == "cpu"  # mismatch logged, runs on XLA
+    cfg.enable_tpu()
+    assert cfg._device == ("tpu", 0)
+    assert cfg._resolve_placement() == "cpu"
+
+
+def test_engine_predictor_bridge(api, model):
+    """inference.Config.enable_serving_engine routes create_predictor
+    through the slot engine with generate()'s output contract."""
+    from paddle_tpu import inference
+
+    rng = np.random.default_rng(10)
+    ids = np.stack([_prompt(rng, 6), _prompt(rng, 6)])
+    cfg = inference.Config()
+    cfg.enable_serving_engine(model, max_new_tokens=5, num_slots=2,
+                              kv_block_size=8, max_model_len=MAX_LEN)
+    pred = inference.create_predictor(cfg)
+    h = pred.get_input_handle("input_ids")
+    h.copy_from_cpu(ids)
+    pred.run()
+    out = pred.get_output_handle("output_0").copy_to_cpu()
+    assert out.shape == (2, 6 + 5)
+    for i in range(2):
+        np.testing.assert_array_equal(out[i], _ref(model, ids[i], 5))
+    pred.close()
+    with pytest.raises(ValueError, match="in-memory"):
+        c2 = inference.Config()
+        c2.enable_serving_engine(None)
+        inference.create_predictor(c2)
+
+
+def test_close_fails_outstanding_requests(model):
+    """close() never strands a request: anything still queued fails with a
+    clear error, its done_event set and stream sentinel delivered (a
+    queued request costs no prefill, so this engine never compiles)."""
+    a = ServingAPI(model, num_slots=2, kv_block_size=8, max_model_len=MAX_LEN)
+    rng = np.random.default_rng(15)
+    req = a.submit(_prompt(rng, 5), max_new_tokens=4)  # stays QUEUED
+    a.close()
+    assert req.state == RequestState.FAILED
+    assert isinstance(req.error, RuntimeError)
+    assert req.done_event.is_set()
+    with pytest.raises(RuntimeError, match="closed"):
+        list(a.stream(req))  # sentinel delivered, then the error surfaces
+    with pytest.raises(RuntimeError, match="closed"):
+        a.submit(_prompt(rng, 5), max_new_tokens=4)
+
+
+# ------------------------------------------------------- heavy / chaos
+
+
+@pytest.mark.slow
+def test_slot_churn_stress(model):
+    """Many mixed requests through few slots: everything finishes, the
+    free list is exercised (reuse counter climbs), and the arena ends
+    clean with zero leaked blocks."""
+    api = ServingAPI(model, num_slots=2, kv_block_size=8,
+                     max_model_len=MAX_LEN)
+    try:
+        rng = np.random.default_rng(11)
+        reuse0 = serving_metrics.stats().get("arena.reuse", 0)
+        reqs = [api.submit(_prompt(rng, int(rng.integers(3, 30))),
+                           max_new_tokens=int(rng.integers(2, 16)))
+                for _ in range(12)]
+        api.run_until_idle()
+        assert all(r.state == RequestState.FINISHED for r in reqs)
+        a = api.engine.arena.stats()
+        assert a["blocks_in_use"] == 0 and a["blocks_reserved"] == 0
+        assert serving_metrics.stats().get("arena.reuse", 0) > reuse0
+        assert api.engine.decode_traces == 1
+    finally:
+        api.close()
+
+
+@pytest.mark.slow
+def test_background_pump_thread(model):
+    api = ServingAPI(model, num_slots=2, kv_block_size=8,
+                     max_model_len=MAX_LEN, background=True)
+    try:
+        rng = np.random.default_rng(12)
+        p = _prompt(rng, 5)
+        req = api.submit(p, max_new_tokens=6)
+        out = api.result(req, timeout=60)
+        np.testing.assert_array_equal(out, _ref(model, p, 6))
+    finally:
+        api.close()
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_step_fault_retried_without_donation(model):
+    """With donation off the engine wraps compiled calls in the io retry
+    policy: a transient injected step fault is retried and the request
+    still completes; with donation on the same config refuses to retry."""
+    keep = paddle.get_flags("fault_injection")["fault_injection"]
+    paddle.set_flags({"fault_injection": 1})
+    try:
+        api = ServingAPI(
+            model, config=ServingConfig(num_slots=2, kv_block_size=8,
+                                        max_model_len=MAX_LEN, donate=False))
+        rng = np.random.default_rng(13)
+        p = _prompt(rng, 5)
+        retries0 = resilience.stats().get("retry.retries", 0)
+        resilience.inject_fault("serving_step", times=1,
+                                exc=OSError("injected step fault"))
+        req = api.submit(p, max_new_tokens=6)
+        api.run_until_idle()
+        assert req.state == RequestState.FINISHED
+        np.testing.assert_array_equal(req.output_ids(), _ref(model, p, 6))
+        assert resilience.stats().get("retry.retries", 0) > retries0
+        api.close()
+    finally:
+        resilience.clear_faults()
+        paddle.set_flags({"fault_injection": keep})
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_failed_prefill_fails_request_not_engine(model):
+    """A prefill failure that exhausts retries fails THAT request cleanly
+    (error delivered, done_event set, no leaked arena blocks) and the
+    engine keeps serving the next request."""
+    keep = {k: paddle.get_flags(k)[k]
+            for k in ("fault_injection", "io_retries", "io_retry_backoff")}
+    paddle.set_flags({"fault_injection": 1, "io_retries": 2,
+                      "io_retry_backoff": 0.001})
+    try:
+        api = ServingAPI(
+            model, config=ServingConfig(num_slots=2, kv_block_size=8,
+                                        max_model_len=MAX_LEN, donate=False))
+        rng = np.random.default_rng(16)
+        p = _prompt(rng, 5)
+        resilience.inject_fault("serving_step", times=10,
+                                exc=OSError("persistent step fault"))
+        req = api.submit(p, max_new_tokens=4)
+        api.run_until_idle()
+        assert req.state == RequestState.FAILED
+        assert isinstance(req.error, OSError)
+        assert req.done_event.is_set()
+        a = api.engine.arena.stats()
+        assert a["blocks_in_use"] == 0 and a["blocks_reserved"] == 0
+        resilience.clear_faults()
+        req2 = api.submit(p, max_new_tokens=4)  # engine still healthy
+        api.run_until_idle()
+        assert req2.state == RequestState.FINISHED
+        np.testing.assert_array_equal(req2.output_ids(), _ref(model, p, 4))
+        api.close()
+    finally:
+        resilience.clear_faults()
+        paddle.set_flags(keep)
+
+
+# ----------------------------------------------------------- stats wiring
+
+
+def test_serving_stats_on_shared_surfaces(api):
+    rng = np.random.default_rng(14)
+    before = serving_metrics.stats()
+    req = api.submit(_prompt(rng, 5), max_new_tokens=4)
+    api.run_until_idle()
+    delta = serving_metrics.stats_delta(before, serving_metrics.stats())
+    assert delta.get("tokens.generated", 0) >= 4
+    assert delta.get("requests.finished", 0) == 1
+    # headline numbers ride the shared memory_stats provider surface
+    from paddle_tpu.core import memory_stats
+
+    stats = memory_stats.memory_stats()
+    assert "provider.serving.tokens_generated" in stats
+    assert stats["provider.serving.tokens_generated"] \
+        == serving_metrics.stats().get("tokens.generated", 0)
+    # the engine's Meter publishes a live aggregate decode rate
+    assert serving_metrics.stats().get("tokens_per_sec", 0) > 0
+    assert req.state == RequestState.FINISHED
+
+
+def test_completed_output_beats_expired_deadline(api):
+    """A request whose output is already whole when its deadline expires
+    FINISHES with the result — completed work is never discarded."""
+    from paddle_tpu.serving.scheduler import Request
+
+    req = Request(np.arange(4, dtype=np.int32), max_new_tokens=8,
+                  stop_token_id=3, tokens=[9, 3],
+                  deadline=resilience.Deadline.after(0.0))
+    assert req.deadline.expired()
+    assert api.scheduler._check_boundary(req)
+    assert req.state == RequestState.FINISHED and req.error is None
+
+
+def test_predictor_mid_batch_submit_failure_strands_nothing(model):
+    """If a row's submit sheds mid-batch, EnginePredictor.run cancels the
+    rows it already queued instead of leaving unreachable handles that
+    FCFS would still spend capacity on."""
+    from paddle_tpu.serving.api import EnginePredictor
+
+    pred = EnginePredictor(model, max_new_tokens=4,
+                           config=ServingConfig(num_slots=1, kv_block_size=8,
+                                                max_model_len=MAX_LEN),
+                           max_queue=2)
+    try:
+        ids = np.tile(np.arange(5, dtype=np.int32), (6, 1))
+        with pytest.raises(resilience.QueueOverloadError):
+            pred.run([ids])
+        assert not pred._api.scheduler.has_work()
+    finally:
+        pred.close()
