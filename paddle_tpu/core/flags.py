@@ -149,6 +149,24 @@ define_flag("serving_max_queue", 0,
 define_flag("serving_prefill_bucket_min", 16,
             "Smallest prompt-length bucket for serving prefill compiles; "
             "prompts at or below this share one compiled prefill program.")
+define_flag("serving_starvation_steps", 8,
+            "Priority admission: scheduler steps the best waiting request "
+            "may be blocked on capacity before the scheduler preempts the "
+            "lowest-priority (most recently admitted) running request to "
+            "make room. 0 disables preemption.")
+define_flag("serving_max_rebuilds", 3,
+            "Serving supervisor crash-loop breaker: after this many engine "
+            "rebuilds within FLAGS_serving_rebuild_window scheduler steps, "
+            "transient failures stop being recovered and fail fast "
+            "(CrashLoopError).")
+define_flag("serving_rebuild_window", 200,
+            "Scheduler-step window over which the serving supervisor counts "
+            "rebuilds toward the crash-loop breaker.")
+define_flag("serving_drain_grace", 30.0,
+            "Default grace budget (seconds) for ServingAPI.drain(): "
+            "admissions stop immediately, in-flight requests pump to "
+            "completion within the budget, stragglers fail with the "
+            "retriable RequestDrainedError.")
 
 # ---- Resilience: retry / sentinel / fault injection (core.resilience) ----
 define_flag("io_retries", 3,

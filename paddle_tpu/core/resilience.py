@@ -87,7 +87,15 @@ def _register_providers() -> None:
                       ("resilience.retries", "retry.retries"),
                       ("resilience.preempt_requests", "preempt.requests"),
                       ("resilience.overload_shed", "overload.shed"),
-                      ("resilience.deadline_exceeded", "deadline.exceeded")):
+                      ("resilience.deadline_exceeded", "deadline.exceeded"),
+                      # serving resilience layer (serving.supervisor /
+                      # scheduler preemption / ServingAPI.drain)
+                      ("resilience.serving_preemptions", "serving.preemptions"),
+                      ("resilience.serving_replays", "serving.replays"),
+                      ("resilience.serving_rebuilds", "serving.rebuilds"),
+                      ("resilience.serving_drains", "serving.drains"),
+                      ("resilience.serving_drain_stragglers",
+                       "serving.drain_stragglers")):
         memory_stats.register_stat_provider(name, lambda k=key: _counts.get(k, 0))
 
 
@@ -117,6 +125,26 @@ class QueueOverloadError(RuntimeError):
 
 class DeadlineExceededError(TimeoutError):
     """A request's wall-clock deadline expired before it finished."""
+
+
+class ServingDeviceError(RuntimeError):
+    """Transient accelerator/runtime failure inside a compiled serving call
+    (dead device tunnel, evicted backend). The serving supervisor treats it
+    as recoverable: rebuild the KV arena and replay in-flight requests from
+    their journals (``serving.supervisor``)."""
+
+
+class ArenaCorruptError(RuntimeError):
+    """The serving KV arena is corrupt or consumed (a donated call died
+    holding the pools, a device reset invalidated them). Recoverable by the
+    serving supervisor the same way as :class:`ServingDeviceError` — the
+    arena is rebuilt from scratch and live requests are re-prefilled."""
+
+
+class RequestDrainedError(RuntimeError):
+    """The request was failed by a serving drain/shutdown before completing.
+    Retriable by construction: the request performed no externally visible
+    work, so the caller can safely resubmit it to another instance."""
 
 
 # ---------------------------------------------------- deadlines / shedding
@@ -328,24 +356,35 @@ _env_faults_loaded = False
 
 #: kinds with production probes; inject_fault accepts other kinds too, for
 #: tests that place maybe_fault probes in their own code
-KNOWN_FAULTS = ("ckpt_io", "nonfinite_grads", "preempt")
+KNOWN_FAULTS = ("ckpt_io", "nonfinite_grads", "preempt", "serving_step",
+                "serving_device", "arena_corrupt")
+
+#: kinds whose probe sites are bare statements (they only react to an
+#: exception), so a flag-style fault would silently exercise nothing —
+#: inject_fault defaults their exc to the error the real failure would raise
+_DEFAULT_FAULT_EXC = {
+    "ckpt_io": lambda k: OSError(f"injected {k} fault"),
+    "serving_device": lambda k: ServingDeviceError(f"injected {k} fault"),
+    "arena_corrupt": lambda k: ArenaCorruptError(f"injected {k} fault"),
+}
 
 
 def inject_fault(kind: str, times: int = 1, after: int = 0,
                  exc: Any = None) -> None:
     """Arm a deterministic fault: the next ``after`` probes of ``kind`` pass,
     then ``times`` probes fire (raising ``exc``, else returning True), then
-    the fault disarms. ``ckpt_io`` defaults ``exc`` to ``OSError`` — its
+    the fault disarms. ``ckpt_io``/``serving_device``/``arena_corrupt``
+    default ``exc`` to the error class the real failure would raise — their
     probe sites are bare statements that only react to an exception, so a
-    flag-style ckpt_io fault would silently exercise nothing. Requires
+    flag-style fault would silently exercise nothing. Requires
     ``FLAGS_fault_injection=1`` — production runs cannot arm faults by
     accident."""
     if not flags.flag("fault_injection"):
         raise RuntimeError(
             "fault injection is disabled; set FLAGS_fault_injection=1 "
             "(env or paddle.set_flags) before arming faults")
-    if exc is None and kind == "ckpt_io":
-        exc = OSError(f"injected {kind} fault")
+    if exc is None and kind in _DEFAULT_FAULT_EXC:
+        exc = _DEFAULT_FAULT_EXC[kind](kind)
     with _lock:
         _faults[kind] = _FaultSpec(kind, times=int(times), after=int(after),
                                    exc=exc)
@@ -375,8 +414,8 @@ def _load_env_faults() -> None:
             continue
         times = int(fields[1]) if len(fields) > 1 else 1
         after = int(fields[2]) if len(fields) > 2 else 0
-        exc = OSError(f"injected {fields[0]} fault") \
-            if fields[0] == "ckpt_io" else None
+        mk = _DEFAULT_FAULT_EXC.get(fields[0])
+        exc = mk(fields[0]) if mk is not None else None
         with _lock:
             _faults[fields[0]] = _FaultSpec(fields[0], times=times,
                                             after=after, exc=exc)

@@ -8,12 +8,18 @@ served endpoint), rebuilt as an Orca/vLLM-style decode runtime:
 * :mod:`.kv_arena`  — ``KVArena``: block-granular (paged) KV allocation
   with free-list reuse and a scratch block for masked lanes.
 * :mod:`.scheduler` — ``Scheduler``/``Request``: iteration-level batching,
-  FCFS admission, stop/budget/cancel/deadline finish policy.
-* :mod:`.api`       — ``ServingAPI`` (``submit/stream/cancel``) and
+  priority admission (lower value first, FCFS within a class),
+  starvation-triggered preemption with journal re-admission, and the
+  stop/budget/cancel/deadline finish policy.
+* :mod:`.supervisor` — ``EngineSupervisor``: rebuild-and-replay recovery
+  for transient device/arena failures, with a crash-loop breaker
+  (``CrashLoopError``).
+* :mod:`.api`       — ``ServingAPI`` (``submit/stream/cancel/drain``) and
   ``EnginePredictor`` (the ``paddle.inference`` bridge).
 * :mod:`.metrics`   — counters/gauges on the shared observability surface.
 
-See docs/serving.md for the architecture and lifecycle walkthrough.
+See docs/serving.md for the architecture and lifecycle walkthrough and
+docs/robustness.md ("Serving under failure") for the recovery contract.
 """
 from __future__ import annotations
 
@@ -24,11 +30,15 @@ _LAZY = {
     "ServingConfig": ("engine", "ServingConfig"),
     "KVArena": ("kv_arena", "KVArena"),
     "ArenaExhaustedError": ("kv_arena", "ArenaExhaustedError"),
+    "ReservationExhaustedError": ("kv_arena", "ReservationExhaustedError"),
     "Scheduler": ("scheduler", "Scheduler"),
     "Request": ("scheduler", "Request"),
     "RequestState": ("scheduler", "RequestState"),
+    "EngineSupervisor": ("supervisor", "EngineSupervisor"),
+    "CrashLoopError": ("supervisor", "CrashLoopError"),
     "ServingAPI": ("api", "ServingAPI"),
     "EnginePredictor": ("api", "EnginePredictor"),
+    "drain_all": ("api", "drain_all"),
 }
 
 __all__ = list(_LAZY) + ["metrics"]

@@ -1,16 +1,32 @@
-"""Serving front door: ``submit()`` / ``stream()`` / ``cancel()``.
+"""Serving front door: ``submit()`` / ``stream()`` / ``cancel()`` / ``drain()``.
 
-Thin, thread-safe policy shell over the scheduler+engine pair:
+Thin, thread-safe policy shell over the engine+scheduler+supervisor stack:
 
 * **submit** applies queue-overload shedding
-  (``core.resilience.check_overload`` / ``FLAGS_serving_max_queue``) and
-  attaches the per-request wall-clock deadline.
+  (``core.resilience.check_overload`` / ``FLAGS_serving_max_queue``),
+  attaches the per-request wall-clock deadline, and stamps the request's
+  priority class (lower value = served first; see
+  ``scheduler.Scheduler``'s admission/preemption policy).
 * **stream** yields tokens as the engine produces them. In foreground mode
   (default) the consumer's iteration *is* the event loop — each ``next()``
   pumps scheduler steps; with ``background=True`` a pump thread drives the
   engine and streams are plain queue consumers.
 * **cancel** flags the request; the scheduler retires its slot at the next
   step boundary (queued requests never cost a prefill).
+* **supervision** — every pump step routes through
+  :class:`serving.supervisor.EngineSupervisor`: a transient device/arena
+  failure rebuilds the engine and replays in-flight requests from their
+  journals (token-for-token identical output, zero recompiles) instead of
+  failing them; non-transient errors keep the fail-fast path, and the
+  crash-loop breaker degrades to fail-fast with
+  :class:`serving.supervisor.CrashLoopError`.
+* **drain** — ``drain(grace)`` stops admissions, pumps in-flight requests
+  to completion within the grace budget, then fails stragglers with the
+  *retriable* ``core.resilience.RequestDrainedError``. ``close()`` routes
+  through ``drain(grace=0)`` so the two shutdown paths cannot diverge, and
+  ``bind_preemption_guard`` turns SIGTERM/SIGINT into a drain instead of a
+  mid-decode kill — the serving mirror of the training loop's
+  step-boundary finalize (docs/robustness.md, "Serving under failure").
 
 The :class:`EnginePredictor` bridge at the bottom gives the classic
 ``paddle.inference`` predictor surface (``get_input_handle`` /
@@ -22,31 +38,59 @@ routed through ``inference.Config.enable_serving_engine()`` +
 """
 from __future__ import annotations
 
+import logging
 import queue as _queue
 import threading
 import time
+import weakref
 from typing import Iterator, List, Optional
 
 import numpy as np
 
-from ..core import resilience
+from ..core import flags, resilience
 from . import metrics
 from .engine import ServingConfig, ServingEngine
 from .scheduler import Request, RequestState, Scheduler
+from .supervisor import EngineSupervisor
+
+_logger = logging.getLogger("paddle_tpu.serving")
+
+#: every live ServingAPI, so process-level shutdown epilogues
+#: (``tools/serving_stats.py --run``, operator scripts) can drain them all
+_live_apis: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def drain_all(grace: float = 0.0) -> int:
+    """Drain every live :class:`ServingAPI` (shutdown epilogue — e.g.
+    ``tools/serving_stats.py --run`` calls this after the driven script so
+    no engine exits holding live slots). Returns how many were drained."""
+    n = 0
+    for api in list(_live_apis):
+        if not api._closed and not api._draining:
+            api.drain(grace)
+            n += 1
+    return n
 
 
 class ServingAPI:
-    """One served model: engine + scheduler + (optional) pump thread."""
+    """One served model: engine + scheduler + supervisor + (optional)
+    pump thread."""
 
     def __init__(self, model, config: Optional[ServingConfig] = None,
                  background: bool = False,
                  max_queue: Optional[int] = None, **engine_kw):
         self.engine = ServingEngine(model, config, **engine_kw)
         self.scheduler = Scheduler(self.engine)
+        self.supervisor = EngineSupervisor(self.engine, self.scheduler)
         self._lock = threading.RLock()
         self._max_queue = max_queue
         self._closed = False
+        self._draining = False
+        self.drain_count = 0  # this API's lifetime drains
+        self._guard = None
+        self._guard_grace: Optional[float] = None
         self._thread = None
+        _live_apis.add(self)
         if background:
             self._thread = threading.Thread(target=self._pump_loop,
                                             name="serving-pump", daemon=True)
@@ -57,17 +101,28 @@ class ServingAPI:
     def submit(self, prompt, max_new_tokens: int = 32,
                stop_token_id: Optional[int] = None,
                timeout: Optional[float] = None,
-               request_id: str = "") -> Request:
+               request_id: str = "", priority: int = 0) -> Request:
         """Enqueue one generation request; returns its handle immediately.
 
         ``timeout`` is the request's end-to-end wall-clock deadline
-        (queue wait included). Raises
+        (queue wait included). ``priority`` follows the vLLM convention —
+        lower values are served first; default 0 is normal traffic (FCFS
+        within a class). Raises
         :class:`core.resilience.QueueOverloadError` when the waiting queue
         is at the shedding limit — callers retry later or route elsewhere;
-        unbounded queues just convert overload into timeouts."""
-        if self._closed:
-            raise RuntimeError("ServingAPI is closed")
+        unbounded queues just convert overload into timeouts. During a
+        drain, new submissions raise the retriable
+        :class:`core.resilience.RequestDrainedError`."""
         with self._lock:
+            # checked under the lock: a submit racing drain()/close() must
+            # never enqueue after the straggler sweep (its request would
+            # sit unpumped forever)
+            if self._closed:
+                raise RuntimeError("ServingAPI is closed")
+            if self._draining:
+                raise resilience.RequestDrainedError(
+                    "ServingAPI is draining: admissions are stopped; "
+                    "resubmit to another instance")
             try:
                 resilience.check_overload(len(self.scheduler.waiting),
                                           self._max_queue, name="serving")
@@ -76,7 +131,7 @@ class ServingAPI:
                 raise
             req = Request(prompt, max_new_tokens=max_new_tokens,
                           stop_token_id=stop_token_id,
-                          request_id=request_id,
+                          request_id=request_id, priority=priority,
                           deadline=resilience.Deadline.after(timeout))
             return self.scheduler.submit(req)
 
@@ -126,55 +181,173 @@ class ServingAPI:
 
     def run_until_idle(self) -> None:
         while True:
+            if self._check_guard():
+                return
             with self._lock:
                 if not self.scheduler.has_work():
                     return
                 self._step_guarded()
 
+    # -------------------------------------------------------- drain / close
+
+    def drain(self, grace: Optional[float] = None,
+              reason: str = "serving drain") -> None:
+        """Graceful shutdown of in-flight work: stop admissions immediately
+        (``submit`` raises the retriable ``RequestDrainedError``), pump
+        everything already accepted to completion within ``grace`` seconds
+        (default ``FLAGS_serving_drain_grace``), then fail stragglers with
+        the same retriable error — their callers resubmit to another
+        instance instead of blocking on an engine that is going away.
+        Idempotent. ``close()`` routes through ``drain(grace=0)`` so close
+        and drain share one code path.
+
+        Counters: ``serving.drains`` / ``serving.drain_stragglers``
+        (``core.resilience``, memory_stats providers, profiler Resilience
+        delta) and ``api.drains`` / ``api.drain_stragglers``
+        (``serving.metrics``, profiler Serving delta)."""
+        if grace is None:
+            grace = float(flags.flag("serving_drain_grace"))
+        grace = max(0.0, float(grace))
+        with self._lock:
+            if self._draining:
+                return
+            self._draining = True
+        self.drain_count += 1
+        resilience.bump("serving.drains")
+        metrics.bump("api.drains")
+        deadline = resilience.Deadline.after(grace)
+        # with a background pump the thread keeps stepping and drain just
+        # waits; foreground (or drain initiated FROM the pump thread, via
+        # a bound PreemptionGuard) pumps right here
+        own_pump = (self._thread is None
+                    or threading.current_thread() is self._thread)
+        while grace > 0 and not deadline.expired():
+            with self._lock:
+                if not self.scheduler.has_work():
+                    break
+                if own_pump:
+                    try:
+                        self._step_guarded()
+                    except Exception:
+                        # the failed step already failed every in-flight
+                        # request with its real error (fail_all) — nothing
+                        # left for the grace loop to pump
+                        break
+            if not own_pump:
+                time.sleep(0.001)
+        self._fail_stragglers(grace, reason)
+
+    def _fail_stragglers(self, grace: float, reason: str) -> None:
+        with self._lock:
+            stragglers = (len(self.scheduler.waiting)
+                          + len(self.scheduler.running))
+            if stragglers:
+                self.scheduler.fail_all(resilience.RequestDrainedError(
+                    f"{reason}: request drained before completion "
+                    f"(grace={grace:g}s); safe to resubmit"))
+                resilience.bump("serving.drain_stragglers", stragglers)
+                metrics.bump("api.drain_stragglers", stragglers)
+
     def close(self) -> None:
-        self._closed = True
+        """Shut down through :meth:`drain` with a zero grace budget (close
+        and drain share one code path). Idempotent — and safe after a
+        failed pump: ``Scheduler._finish`` is idempotent, so requests the
+        pump already failed are never double-failed (no second error,
+        sentinel, or done_event)."""
+        if self._closed:
+            return
+        self.drain(grace=0.0, reason="ServingAPI is closed")
+        # if another drain (e.g. a guard drain with a long grace) was
+        # already in flight, the idempotent drain() above returned without
+        # sweeping — close() must still uphold its zero-grace contract, so
+        # fail whatever is left right now instead of letting it outlive the
+        # API (the in-flight drain's own sweep then finds nothing)
+        self._fail_stragglers(0.0, "ServingAPI is closed")
+        with self._lock:
+            self._closed = True
         if self._thread is not None:
             self._thread.join(timeout=5)
             self._thread = None
-        with self._lock:
-            # no request may outlive the API un-finished: anything still
-            # queued/running fails with a clear error instead of leaving a
-            # result()/stream() caller blocking forever
-            if self.scheduler.has_work():
-                self.scheduler.fail_all(RuntimeError("ServingAPI is closed"))
+
+    def bind_preemption_guard(self, guard,
+                              grace: Optional[float] = None) -> "ServingAPI":
+        """SIGTERM/SIGINT (or an injected ``preempt`` fault) drains this
+        API instead of killing it mid-decode — the serving mirror of the
+        training loop's ``PreemptionGuard.maybe_finalize`` step-boundary
+        semantics. The pump polls ``guard.requested()`` at step
+        boundaries; once requested, admissions stop and in-flight requests
+        get ``grace`` (default ``FLAGS_serving_drain_grace``) to finish,
+        then stragglers fail with the retriable ``RequestDrainedError``.
+        Returns ``self`` for chaining."""
+        self._guard = guard
+        self._guard_grace = grace
+        return self
 
     # ----------------------------------------------------------- pumping
 
+    def _check_guard(self) -> bool:
+        """Poll the bound PreemptionGuard at a pump boundary: a pending
+        preemption request turns into a drain, never a mid-step kill."""
+        g = self._guard
+        if g is None or self._draining or not g.requested():
+            return False
+        metrics.bump("api.guard_drains")
+        self.drain(self._guard_grace,
+                   reason=f"preemption requested ({g.reason or 'signal'})")
+        return True
+
     def _pump_once(self) -> None:
+        if self._check_guard():
+            return
         with self._lock:
             if self.scheduler.has_work():
                 self._step_guarded()
 
     def _step_guarded(self) -> None:
-        # caller holds the lock. Foreground pumping needs the same
-        # guarantee the background loop's fail_all gives: a step that
-        # raises must not leave in-flight requests RUNNING with slots and
-        # arena blocks held (and done_events never set) after the
-        # exception propagates to the pumping caller.
+        # caller holds the lock. One SUPERVISED scheduler step: a transient
+        # device/arena failure is recovered by rebuild+replay and the pump
+        # just continues; anything else fails every in-flight request
+        # (error + stream sentinel + done_event) before propagating, so a
+        # pumping caller can never strand RUNNING requests holding slots
+        # and arena blocks.
         try:
             self.scheduler.step()
+            self.supervisor.note_step()
         except Exception as e:
-            self.scheduler.fail_all(e)
-            raise
+            try:
+                recovered = self.supervisor.handle(e)
+            except Exception as e2:
+                # recovery itself died (e.g. the rebuilt arena's allocation
+                # failed on a still-dead device): the supervisor already
+                # failed the requests it had staged for replay; fail_all
+                # sweeps whatever is left registered, so nothing is ever
+                # stranded RUNNING with its done_event unset
+                self.scheduler.fail_all(e2)
+                raise e2 from e
+            if recovered:
+                metrics.bump("api.recoveries")
+                return
+            err = self.supervisor.wrap(e)
+            self.scheduler.fail_all(err)
+            if err is e:
+                raise
+            raise err
 
     def _pump_loop(self) -> None:
         while not self._closed:
+            self._check_guard()
             with self._lock:
                 busy = self.scheduler.has_work()
                 if busy:
                     try:
-                        self.scheduler.step()
-                    except Exception as e:
+                        self._step_guarded()
+                    except Exception:
                         # the pump thread must never die silently with
-                        # requests in flight: fail them all (done_event +
-                        # sentinel) and keep serving — new submissions
-                        # surface the same error through their own results
-                        self.scheduler.fail_all(e)
+                        # requests in flight: _step_guarded already failed
+                        # them all (done_event + sentinel) — keep serving;
+                        # new submissions surface errors through their own
+                        # results
+                        pass
             if not busy:
                 time.sleep(0.001)
 
@@ -187,16 +360,22 @@ class EnginePredictor:
     slot engine. Output ``output_0`` is ``[batch, prompt_len +
     max_new_tokens]`` with post-stop positions filled with the stop token
     (exactly ``GPT.generate(stop_token_id=...)``'s contract, so swapping a
-    predictor backend never changes downstream parsing)."""
+    predictor backend never changes downstream parsing). ``priority``
+    (constructor default, overridable per ``run``) rides the scheduler's
+    priority admission — an offline-batch predictor can mark itself
+    preemptible under a latency-sensitive one sharing the engine."""
 
     def __init__(self, model, max_new_tokens: int = 32,
-                 stop_token_id: Optional[int] = None,
+                 stop_token_id: Optional[int] = None, priority: int = 0,
                  config: Optional[ServingConfig] = None, **engine_kw):
         self._api = ServingAPI(model, config, **engine_kw)
         self._max_new = int(max_new_tokens)
         self._stop = stop_token_id
+        self._priority = int(priority)
         self._inputs = {}
         self._outputs = {}
+        self._finished = 0  # this predictor's own rows, for close()'s
+        self._failed = 0    # summary (metrics.stats() is process-global)
 
     def get_input_names(self) -> List[str]:
         return ["input_ids"]
@@ -214,23 +393,28 @@ class EnginePredictor:
 
         return PredictorTensor(self, name)
 
-    def run(self, inputs: Optional[List[np.ndarray]] = None):
+    def run(self, inputs: Optional[List[np.ndarray]] = None,
+            priority: Optional[int] = None):
+        """One predictor run. ``priority`` overrides the constructor's
+        class for this batch only (lower = served first; None = keep)."""
         if inputs is not None:
             ids = np.asarray(inputs[0])
         else:
             ids = np.asarray(self._inputs["input_ids"])
         ids = np.atleast_2d(ids).astype(np.int32)
         b, plen = ids.shape
+        pr = self._priority if priority is None else int(priority)
         reqs = []
         try:
             for row in ids:
                 reqs.append(self._api.submit(row,
                                              max_new_tokens=self._max_new,
-                                             stop_token_id=self._stop))
+                                             stop_token_id=self._stop,
+                                             priority=pr))
         except Exception:
             # a mid-batch submit failure (overload shed, validation) must
             # not strand the rows already queued: their handles would be
-            # unreachable, and FCFS would still spend capacity on them
+            # unreachable, and admission would still spend capacity on them
             # ahead of the next run(). Flag every cancel BEFORE pumping so
             # the cull runs once and no doomed row gets admitted (and
             # charged a prefill) while its siblings are being cancelled.
@@ -240,6 +424,8 @@ class EnginePredictor:
                 self._api._pump_once()
             raise
         self._api.run_until_idle()
+        self._finished += sum(r.state == RequestState.FINISHED for r in reqs)
+        self._failed += sum(r.state == RequestState.FAILED for r in reqs)
         fill = self._stop if self._stop is not None else 0
         out = np.full((b, plen + self._max_new), fill, np.int32)
         out[:, :plen] = ids
@@ -253,4 +439,18 @@ class EnginePredictor:
             return [out]
 
     def close(self) -> None:
-        self._api.close()
+        """Close the underlying API (drain with grace=0) and log this
+        predictor's lifetime summary — including the resilience picture:
+        supervisor replays/rebuilds, scheduler preemptions, drains. All
+        counts come from this predictor's OWN engine stack (the
+        ``serving.metrics`` counters are process-global and would
+        misattribute a concurrent instance's activity)."""
+        api = self._api
+        api.close()
+        _logger.info(
+            "EnginePredictor closed: %d finished, %d failed, "
+            "%d supervisor replays (%d rebuilds), %d preemptions, "
+            "%d drains",
+            self._finished, self._failed,
+            api.supervisor.replay_count, api.supervisor.rebuild_count,
+            api.scheduler.preempt_count, api.drain_count)

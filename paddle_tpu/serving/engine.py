@@ -173,9 +173,12 @@ class ServingEngine:
             self._retry = resilience.io_policy()
 
         kv_dtype = str(model.gpt.layers[0].attn.qkv.weight._data.dtype)
-        self.arena = KVArena(mcfg.num_layers, mcfg.num_heads,
-                             mcfg.hidden_size // mcfg.num_heads,
-                             num_blocks, self.block_size, kv_dtype)
+        # kept so the supervisor can rebuild an identically-shaped arena
+        # after a transient device failure (same shapes => zero recompiles)
+        self._arena_args = (mcfg.num_layers, mcfg.num_heads,
+                            mcfg.hidden_size // mcfg.num_heads,
+                            num_blocks, self.block_size, kv_dtype)
+        self.arena = KVArena(*self._arena_args)
 
         s = self.num_slots
         self._bt_host = np.zeros((s, self.blocks_per_slot), np.int32)
@@ -205,6 +208,13 @@ class ServingEngine:
 
     def blocks_needed(self, prompt_len: int, max_new_tokens: int) -> int:
         return _ceil_div(prompt_len + max_new_tokens, self.block_size)
+
+    def reserved_blocks(self, slot: int) -> int:
+        """Admission-time block budget held by ``slot`` (0 if empty).
+        Retiring the slot returns this whole budget to the arena's
+        grantable pool — the quantity preemption feasibility sums."""
+        res = self._slot_res[slot]
+        return res.total if res is not None else 0
 
     def validate(self, prompt_len: int, max_new_tokens: int) -> None:
         if prompt_len < 1:
@@ -316,10 +326,14 @@ class ServingEngine:
         non-retryable (its buffers may already be consumed), so the retry
         policy only wraps the copying build."""
         def attempt(*a):
-            # the fault probe sits inside the retried callable so injected
+            # the fault probes sit inside the retried callable so injected
             # transient failures exercise the same recovery path real ones
-            # would
+            # would. serving_step raises caller-chosen (typically IO-class,
+            # retried) errors; serving_device/arena_corrupt raise the
+            # supervisor-recoverable classes (rebuild + replay).
             resilience.maybe_fault("serving_step")
+            resilience.maybe_fault("serving_device")
+            resilience.maybe_fault("arena_corrupt")
             return fn(*a)
 
         with warnings.catch_warnings():
@@ -334,23 +348,42 @@ class ServingEngine:
 
     # ----------------------------------------------------- slot lifecycle
 
-    def admit(self, prompt: np.ndarray, max_new_tokens: int
-              ) -> Tuple[int, int]:
-        """Prefill ``prompt`` into a free slot. Returns ``(slot,
-        first_token)`` — the first generated token comes out of the prefill
-        program itself (the prompt's last hidden state is already there).
+    def admit(self, prompt: np.ndarray, max_new_tokens: int,
+              tokens=None) -> Tuple[int, int]:
+        """Prefill ``prompt`` (plus an optional already-generated token
+        journal) into a free slot. Returns ``(slot, next_token)`` — the
+        token comes out of the prefill program itself (the context's last
+        hidden state is already there).
+
+        ``tokens`` is the request's journal when this admission is a
+        *replay* (supervisor recovery) or *re-admission after preemption*:
+        the prefill runs over ``prompt + tokens`` and emits the journal's
+        next token, leaving the slot in exactly the state an uninterrupted
+        decode would have reached (position ``len(prompt+tokens)``, last
+        token = the newly emitted one) — token-for-token identical output.
+        ``max_new_tokens`` stays the request's ORIGINAL budget (the journal
+        counts toward it), so the block reservation is unchanged.
+
         Raises if no capacity; callers gate on :meth:`can_admit`."""
         import jax.numpy as jnp
 
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         plen = int(prompt.shape[0])
         self.validate(plen, max_new_tokens)
+        journal = np.asarray(tokens if tokens is not None else [], np.int32)
+        ctx = (np.concatenate([prompt, journal.reshape(-1)])
+               if journal.size else prompt)
+        clen = int(ctx.shape[0])
+        if clen >= plen + max_new_tokens:
+            raise ValueError(
+                f"journal of {journal.size} tokens already exhausts the "
+                f"max_new_tokens={max_new_tokens} budget; nothing to resume")
         slot = int(np.argmin(self._active))
         if self._active[slot]:
             raise RuntimeError("no free slot")
         res = self.arena.reserve(self.blocks_needed(plen, max_new_tokens))
         try:
-            for _ in range(_ceil_div(plen, self.block_size)):
+            for _ in range(_ceil_div(clen, self.block_size)):
                 bi = len(res.taken)  # BEFORE take() appends
                 self._bt_host[slot, bi] = res.take()
         except Exception:
@@ -360,16 +393,16 @@ class ServingEngine:
         self._bt_dev = None
 
         p_bucket = compile_cache.prefill_bucket(
-            plen, self.max_model_len, self.prefill_bucket_min)
+            clen, self.max_model_len, self.prefill_bucket_min)
         ids = np.zeros((1, p_bucket), np.int32)
-        ids[0, :plen] = prompt
+        ids[0, :clen] = ctx
         mbp = _ceil_div(p_bucket, self.block_size)
         rows = np.zeros(mbp, np.int32)
         rows[:len(res.taken)] = res.taken
         fn = self._get_prefill(p_bucket)
         try:
             nxt, new_pools = self._call(
-                fn, self._arrays, jnp.asarray(ids), jnp.int32(plen),
+                fn, self._arrays, jnp.asarray(ids), jnp.int32(clen),
                 self.arena.pools, jnp.asarray(rows), name="serving.prefill")
         except Exception:
             # a failed admission must not leak capacity: return the blocks
@@ -383,13 +416,13 @@ class ServingEngine:
         self.arena.set_pools(new_pools)
 
         self._slot_res[slot] = res
-        self._positions[slot] = plen  # next write position
+        self._positions[slot] = clen  # next write position
         first = int(nxt)
         self._last_tok[slot] = first
         self._active[slot] = True
         metrics.bump("engine.admits")
-        metrics.bump("tokens.prefill", plen)
-        metrics.bump("tokens.generated")  # the first token, out of prefill
+        metrics.bump("tokens.prefill", clen)
+        metrics.bump("tokens.generated")  # the next token, out of prefill
         self._refresh_gauges()
         return slot, first
 
@@ -408,6 +441,26 @@ class ServingEngine:
         self._positions[slot] = 0
         self._last_tok[slot] = 0
         metrics.bump("engine.retires")
+        self._refresh_gauges()
+
+    def rebuild(self) -> None:
+        """Throw away the KV arena and every slot's runtime state and start
+        from an empty, identically-shaped arena. This is the supervisor's
+        recovery primitive after a transient device/arena failure: the old
+        pools may be corrupt or consumed (a donated call died holding
+        them), but the COMPILED programs only depend on shapes, so a
+        rebuilt engine re-serves without a single recompile — live
+        requests are re-prefilled from their journals by the supervisor.
+        """
+        self.arena = KVArena(*self._arena_args)
+        self._bt_host[:] = 0
+        self._bt_dev = None
+        self._positions[:] = 0
+        self._last_tok[:] = 0
+        self._active[:] = False
+        self._slot_res = [None] * self.num_slots
+        metrics.bump("engine.rebuilds")
+        metrics.set_gauge("arena.kv_bytes", self.arena.bytes_total())
         self._refresh_gauges()
 
     # --------------------------------------------------------- decode step

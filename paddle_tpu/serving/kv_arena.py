@@ -32,7 +32,18 @@ from . import metrics
 
 
 class ArenaExhaustedError(RuntimeError):
-    """No free (unreserved) blocks left for the requested budget."""
+    """No free (unreserved) blocks left for the requested budget — arena
+    *pressure*: more load than capacity right now. The scheduler reacts with
+    admission gating and (under starvation) preemption."""
+
+
+class ReservationExhaustedError(ArenaExhaustedError):
+    """A request tried to ``take()`` past its own admission-time budget —
+    this request *under-reserved*, which is a bug in the caller's block
+    accounting, not arena pressure. Kept distinct from
+    :class:`ArenaExhaustedError` so supervisor/preemption logic never
+    confuses "this request is broken" with "the arena is full" (preempting
+    victims cannot heal an under-reservation)."""
 
 
 @dataclass
@@ -53,8 +64,10 @@ class Reservation:
         if self.released:
             raise RuntimeError("reservation already released")
         if self.remaining() <= 0:
-            raise ArenaExhaustedError(
-                f"reservation of {self.total} blocks exhausted")
+            raise ReservationExhaustedError(
+                f"reservation exhausted: all {self.total} budgeted blocks "
+                f"already taken ({len(self.taken)} taken) — the request "
+                "under-reserved at admission")
         blk = self.arena._pop_block()
         self.taken.append(blk)
         return blk
@@ -120,8 +133,13 @@ class KVArena:
     def blocks_in_use(self) -> int:
         return (self.num_blocks - 1) - len(self._free)
 
+    def grantable(self) -> int:
+        """Blocks a new reservation could claim right now (free minus the
+        untaken remainder of outstanding reservations)."""
+        return len(self._free) - self._reserved
+
     def can_reserve(self, n: int) -> bool:
-        return len(self._free) - self._reserved >= n
+        return self.grantable() >= n
 
     def reserve(self, n: int) -> Reservation:
         """Claim a worst-case budget of ``n`` blocks (none taken yet)."""

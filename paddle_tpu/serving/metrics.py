@@ -10,10 +10,15 @@ per-run deltas, and ``tools/serving_stats.py`` dumps them standalone.
 
 Counter namespaces:
 
-* ``requests.*``  — submitted / finished / cancelled / expired / failed
-* ``tokens.*``    — ``generated`` (decode) and ``prefill`` (prompt) tokens
-* ``engine.*``    — steps, admits, retires, decode/prefill trace counts
-* ``arena.*``     — block allocs / frees / reuse / alloc failures
+* ``requests.*``   — submitted / finished / cancelled / expired / failed
+* ``tokens.*``     — ``generated`` (decode) and ``prefill`` (prompt) tokens
+* ``engine.*``     — steps, admits, retires, rebuilds, trace counts
+* ``arena.*``      — block allocs / frees / reuse / alloc failures
+* ``scheduler.*``  — ``preemptions`` (starvation-triggered victim evictions)
+* ``supervisor.*`` — ``rebuilds`` / ``replays`` (transient-failure recovery)
+* ``api.*``        — ``drains`` / ``drain_stragglers`` / ``guard_drains`` /
+  ``recoveries`` (the mirror counters land in ``core.resilience`` as
+  ``serving.*`` for the shared resilience dashboards)
 
 Gauges: ``queue.depth``, ``slots.active``, ``slots.total``,
 ``arena.blocks_free``, ``arena.blocks_total``, ``arena.kv_bytes``,

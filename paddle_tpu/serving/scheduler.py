@@ -9,9 +9,20 @@ the freed slots, because the compiled step is occupancy-agnostic
 
 The scheduler owns the policy half of that loop:
 
-* **FCFS admission with capacity gating** — a request is admitted when a
-  slot is free AND the KV arena can reserve its worst-case block budget
-  (so a running request can never be starved of cache mid-decode).
+* **Priority admission with capacity gating** — the best waiting request
+  (lowest ``priority`` value, then earliest arrival; default 0 = normal,
+  FCFS within a class) is admitted when a slot is free AND the KV arena
+  can reserve its worst-case block budget (so a running request can never
+  be starved of cache mid-decode). Admission is strict head-of-line: a
+  smaller, lower-priority waiter never jumps a blocked higher-priority one.
+* **Preemption under starvation** — when the best waiter has been blocked
+  on capacity for ``FLAGS_serving_starvation_steps`` scheduler steps and a
+  strictly lower-priority request is running, the lowest-priority
+  most-recently-admitted victim is preempted: its slot and block
+  reservation are released and it re-queues WITH its token journal, so
+  re-admission re-prefills prompt+generated-so-far into fresh blocks and
+  resumes token-for-token (prefill buckets and the slot step treat all of
+  this as runtime data — no recompile).
 * **Finish detection** at every step boundary: stop-token hit, token
   budget, cancellation, and per-request wall-clock deadlines
   (``core.resilience.Deadline``).
@@ -27,16 +38,16 @@ from __future__ import annotations
 import itertools
 import queue as _queue
 import threading
-from collections import deque
 from dataclasses import dataclass, field
 from typing import List, Optional
 
 import numpy as np
 
-from ..core import resilience
+from ..core import flags, resilience
 from . import metrics
 
 _req_counter = itertools.count()
+_seq_counter = itertools.count()  # arrival / admission ordering ticks
 
 
 class RequestState:
@@ -53,13 +64,18 @@ class Request:        # compare numpy prompt payloads
 
     ``tokens`` accumulates generated ids (the stop token, when hit, is the
     last entry — mirroring ``generate()``'s fill semantics trimmed at the
-    first stop). ``stream_queue``/``done_event`` are the streaming surface
+    first stop); it doubles as the request's *journal*: preemption and
+    supervisor replay re-prefill ``prompt + tokens`` to resume exactly
+    where decode left off. ``priority`` follows the vLLM convention —
+    LOWER values are served first, default 0 is normal traffic.
+    ``stream_queue``/``done_event`` are the streaming surface
     ``api.stream()`` consumes."""
 
     prompt: np.ndarray
     max_new_tokens: int = 32
     stop_token_id: Optional[int] = None
     request_id: str = ""
+    priority: int = 0
     deadline: resilience.Deadline = field(
         default_factory=resilience.Deadline)
     state: str = RequestState.QUEUED
@@ -70,9 +86,15 @@ class Request:        # compare numpy prompt payloads
         default_factory=_queue.SimpleQueue)
     done_event: threading.Event = field(default_factory=threading.Event)
     _cancel: bool = False
+    _arrival: int = 0     # submit-order tick (priority tie-break)
+    _admit_seq: int = 0   # last admission tick ("most recent victim")
+    _starved: int = 0     # consecutive steps blocked at the queue head
+    preemptions: int = 0  # times this request was preempted mid-decode
 
     def __post_init__(self):
         self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)
+        self.priority = int(self.priority)
+        self._arrival = next(_seq_counter)
         if not self.request_id:
             self.request_id = f"req-{next(_req_counter)}"
 
@@ -97,8 +119,9 @@ class Scheduler:
 
     def __init__(self, engine):
         self.engine = engine
-        self.waiting: deque = deque()
+        self.waiting: List[Request] = []
         self.running: List[Request] = []
+        self.preempt_count = 0  # this scheduler's lifetime preemptions
 
     # ---------------------------------------------------------- admission
 
@@ -120,6 +143,10 @@ class Scheduler:
 
     def _finish(self, req: Request, state: str,
                 error: Optional[BaseException] = None) -> None:
+        if req.finished:
+            # idempotent: close()-after-a-failed-pump (or any double sweep)
+            # must not deliver a second error/sentinel/done_event
+            return
         if req.slot is not None:
             self.engine.retire(req.slot)
             if req in self.running:
@@ -167,12 +194,56 @@ class Scheduler:
             return True
         return False
 
+    # ----------------------------------------------------- admission order
+
+    def _next_waiter(self) -> Optional[Request]:
+        """Best waiting request: lowest priority value, earliest arrival.
+        Admission is strict head-of-line — nothing bypasses a blocked
+        better-priority waiter (a stream of small fillers must not starve
+        one big request forever)."""
+        if not self.waiting:
+            return None
+        return min(self.waiting, key=lambda r: (r.priority, r._arrival))
+
+    def _preempt_for(self, waiter: Request) -> bool:
+        """Preempt the lowest-priority, most-recently-admitted running
+        request that is STRICTLY lower-priority than ``waiter``; the victim
+        releases its slot + block reservation and re-queues with its token
+        journal (re-admission re-prefills prompt+generated-so-far — no
+        recompile, token-for-token resume). Declines (returns False) when
+        evicting every eligible victim still could not seat the waiter —
+        higher-priority runners hold the arena, and wasting the victims'
+        prefilled work would free nothing useful. Returns True if a victim
+        was preempted."""
+        candidates = [r for r in self.running if r.priority > waiter.priority]
+        if not candidates:
+            return False
+        need = self.engine.blocks_needed(int(waiter.prompt.shape[0]),
+                                         int(waiter.max_new_tokens))
+        reclaimable = self.engine.arena.grantable() + sum(
+            self.engine.reserved_blocks(r.slot) for r in candidates)
+        if reclaimable < need:
+            return False
+        victim = max(candidates, key=lambda r: (r.priority, r._admit_seq))
+        self.engine.retire(victim.slot)
+        self.running.remove(victim)
+        victim.slot = None
+        victim.state = RequestState.QUEUED
+        victim._starved = 0
+        victim.preemptions += 1
+        self.waiting.append(victim)
+        self.preempt_count += 1
+        metrics.bump("scheduler.preemptions")
+        resilience.bump("serving.preemptions")
+        return True
+
     # -------------------------------------------------------------- step
 
     def step(self) -> bool:
         """One scheduler iteration: cull dead queue entries, admit while
-        capacity allows, run one engine decode step, retire finished.
-        Returns True if any request made progress."""
+        capacity allows (preempting under starvation), run one engine
+        decode step, retire finished. Returns True if any request made
+        progress."""
         progress = False
         # cull queued requests that died before costing a prefill
         for req in list(self.waiting):
@@ -185,15 +256,42 @@ class Scheduler:
                              else resilience.DeadlineExceededError(
                                  f"{req.request_id} expired in queue"))
                 progress = True
-        # FCFS admission into free slots
-        while self.waiting and self.engine.can_admit(
-                int(self.waiting[0].prompt.shape[0]),
-                int(self.waiting[0].max_new_tokens)):
-            req = self.waiting.popleft()
+        # priority admission into free slots
+        starve_after = int(flags.flag("serving_starvation_steps"))
+        starved_this_step = False
+        while True:
+            req = self._next_waiter()
+            if req is None:
+                break
+            if not self.engine.can_admit(int(req.prompt.shape[0]),
+                                         int(req.max_new_tokens)):
+                # the head waiter is capacity-blocked: count starvation
+                # once per step, then preempt one victim per pass until it
+                # fits or no strictly-lower-priority victim remains
+                if not starved_this_step:
+                    req._starved += 1
+                    starved_this_step = True
+                if (starve_after > 0 and req._starved > starve_after
+                        and self._preempt_for(req)):
+                    progress = True
+                    continue  # retry admission with the freed capacity
+                break
+            self.waiting.remove(req)
+            req._starved = 0
             try:
                 slot, first = self.engine.admit(req.prompt,
-                                                req.max_new_tokens)
+                                                req.max_new_tokens,
+                                                tokens=req.tokens)
             except Exception as e:
+                from .supervisor import is_transient_serving_error
+
+                if is_transient_serving_error(e):
+                    # transient prefill failure: the ENGINE is sick, not
+                    # this request — requeue it untouched and let the
+                    # api-level supervisor rebuild and resume everything
+                    req.state = RequestState.QUEUED
+                    self.waiting.append(req)
+                    raise
                 # a failed prefill fails THIS request (done_event set,
                 # stream sentinel delivered) — never the whole pump
                 self._finish(req, RequestState.FAILED, e)
@@ -201,6 +299,7 @@ class Scheduler:
                 continue
             req.slot = slot
             req.state = RequestState.RUNNING
+            req._admit_seq = next(_seq_counter)
             self.running.append(req)
             self._emit(req, first)
             progress = True

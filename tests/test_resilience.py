@@ -155,6 +155,39 @@ def test_env_armed_faults():
     assert resilience.maybe_fault("preempt") is False
 
 
+def test_serving_fault_kinds_and_error_taxonomy():
+    """ISSUE 5: the registry knows the serving fault kinds, and the error
+    classes the serving resilience layer is built on exist with the right
+    ancestry (drained is retriable-by-contract; device/arena-corrupt are
+    the supervisor-recoverable classes)."""
+    for kind in ("serving_step", "serving_device", "arena_corrupt"):
+        assert kind in resilience.KNOWN_FAULTS
+    for klass in (resilience.ServingDeviceError, resilience.ArenaCorruptError,
+                  resilience.RequestDrainedError):
+        assert issubclass(klass, RuntimeError)
+
+
+@pytest.mark.chaos
+def test_serving_faults_default_to_their_error_classes():
+    """serving_device/arena_corrupt probe sites are bare statements, so the
+    injected fault defaults to raising the error class the real failure
+    would — a flag-style fault would silently exercise nothing."""
+    paddle.set_flags({"FLAGS_fault_injection": True})
+    resilience.inject_fault("serving_device")
+    with pytest.raises(resilience.ServingDeviceError, match="injected"):
+        resilience.maybe_fault("serving_device")
+    resilience.inject_fault("arena_corrupt")
+    with pytest.raises(resilience.ArenaCorruptError, match="injected"):
+        resilience.maybe_fault("arena_corrupt")
+    # env arming defaults the same way
+    paddle.set_flags({"FLAGS_inject_faults": "serving_device:1"})
+    resilience.clear_faults()
+    resilience._env_faults_loaded = False
+    with pytest.raises(resilience.ServingDeviceError):
+        resilience.maybe_fault("serving_device")
+    resilience._env_faults_loaded = False
+
+
 # ------------------------------------------------- atomic paddle_tpu.save
 
 
@@ -542,6 +575,25 @@ def test_counters_ride_memory_stats():
     resilience.bump("sentinel.skipped", 3)
     out = memory_stats.memory_stats()
     assert out["provider.resilience.sentinel_skipped"] >= 3
+
+
+def test_serving_counters_ride_memory_stats():
+    """ISSUE 5: the serving resilience counters (supervisor replay,
+    scheduler preemption, API drain) land on the shared memory_stats
+    provider surface next to the training-side ones."""
+    from paddle_tpu.core import memory_stats
+
+    resilience.bump("serving.preemptions")
+    resilience.bump("serving.replays", 2)
+    resilience.bump("serving.rebuilds")
+    resilience.bump("serving.drains")
+    resilience.bump("serving.drain_stragglers", 3)
+    out = memory_stats.memory_stats()
+    assert out["provider.resilience.serving_preemptions"] >= 1
+    assert out["provider.resilience.serving_replays"] >= 2
+    assert out["provider.resilience.serving_rebuilds"] >= 1
+    assert out["provider.resilience.serving_drains"] >= 1
+    assert out["provider.resilience.serving_drain_stragglers"] >= 3
 
 
 def test_resilience_stats_tool_reports_ckpt_dir(tmp_path):

@@ -1,15 +1,23 @@
 """paddle_tpu.serving: continuous-batching slot engine, paged KV arena,
 iteration-level scheduler, submit/stream/cancel API, and the
-``inference.Config`` predictor bridge (ISSUE 4).
+``inference.Config`` predictor bridge (ISSUE 4); plus the resilience layer
+(ISSUE 5): priority admission + starvation preemption, supervisor
+rebuild-and-replay recovery with the crash-loop breaker, and graceful
+drain / preemption-guard shutdown.
 
 The compiled-engine tests share one module-scoped ``ServingAPI`` so tier-1
 pays its prefill/decode compiles once; assertions on trace counters are
 written lifetime-safe (every bucket traced at most once, decode traced
 exactly once) so test order can never flip them. Heavy churn and
-fault-injection cases carry ``slow`` / ``chaos``.
+fault-injection cases carry ``slow`` / ``chaos``. Tests that drain or
+close an API always build their own instance — a drained API refuses
+admissions forever, so the shared fixture must never be drained.
 """
+import logging
 import os
+import queue as pyqueue
 import time
+import weakref
 
 import numpy as np
 import pytest
@@ -20,13 +28,19 @@ from paddle_tpu.core.tensor import Tensor
 from paddle_tpu.models.gpt import GPTForCausalLM, gpt_tiny
 from paddle_tpu.serving import (
     ArenaExhaustedError,
+    CrashLoopError,
+    EngineSupervisor,
     KVArena,
+    Request,
     RequestState,
+    ReservationExhaustedError,
+    Scheduler,
     ServingAPI,
     ServingConfig,
     ServingEngine,
 )
 from paddle_tpu.serving import metrics as serving_metrics
+from paddle_tpu.serving.supervisor import is_transient_serving_error
 
 pytestmark = pytest.mark.serving
 
@@ -550,6 +564,264 @@ def test_completed_output_beats_expired_deadline(api):
     assert req.state == RequestState.FINISHED and req.error is None
 
 
+# ------------------------------------------- priority admission (ISSUE 5)
+
+
+def test_priority_admission_order(api):
+    """Lower priority value is admitted first; FCFS within a class."""
+    rng = np.random.default_rng(20)
+    rs = [api.submit(_prompt(rng, 4), max_new_tokens=2, priority=p)
+          for p in (5, 0, 5)]
+    api.run_until_idle()
+    assert all(r.state == RequestState.FINISHED for r in rs)
+    # admission ticks: the priority-0 request went first, then the two
+    # priority-5 requests in arrival order
+    assert rs[1]._admit_seq < rs[0]._admit_seq < rs[2]._admit_seq
+
+
+def test_reservation_exhausted_distinct_from_pressure():
+    """take() past a reservation's own budget is an under-reservation BUG
+    (ReservationExhaustedError, total/taken in the message) — distinct from
+    arena *pressure* (base ArenaExhaustedError), which preemption can heal."""
+    arena = KVArena(num_layers=1, num_heads=2, head_dim=4,
+                    num_blocks=6, block_size=4)
+    res = arena.reserve(2)
+    for _ in range(2):
+        res.take()
+    with pytest.raises(ReservationExhaustedError) as ei:
+        res.take()
+    assert isinstance(ei.value, ArenaExhaustedError)  # still catchable broadly
+    assert "all 2 budgeted blocks" in str(ei.value)
+    assert "2 taken" in str(ei.value)
+    # genuine pressure raises the base class, never the reservation one
+    with pytest.raises(ArenaExhaustedError) as pei:
+        arena.reserve(5)
+    assert not isinstance(pei.value, ReservationExhaustedError)
+    res.release()
+
+
+# --------------------------------------------- supervisor units (ISSUE 5)
+
+
+def test_transient_serving_error_classifier():
+    assert is_transient_serving_error(resilience.ServingDeviceError("x"))
+    assert is_transient_serving_error(resilience.ArenaCorruptError("x"))
+
+    class XlaRuntimeError(Exception):  # jaxlib's class, matched by name
+        pass
+
+    assert is_transient_serving_error(XlaRuntimeError("dead tunnel"))
+    # bugs / IO / validation / interrupts keep the fail-fast (or retry) path
+    assert not is_transient_serving_error(OSError("io"))
+    assert not is_transient_serving_error(ValueError("bad request"))
+    assert not is_transient_serving_error(KeyboardInterrupt())
+
+
+class _FakeEngine:
+    def __init__(self):
+        self.rebuilds = 0
+
+    def rebuild(self):
+        self.rebuilds += 1
+
+
+class _FakeSched:
+    def __init__(self):
+        self.running = []
+
+    def _gauges(self):
+        pass
+
+
+def test_crash_loop_breaker_opens_and_wraps():
+    eng = _FakeEngine()
+    sup = EngineSupervisor(eng, _FakeSched(), max_rebuilds=2, window=100)
+    err = resilience.ServingDeviceError("flaky")
+    assert sup.handle(err) and sup.handle(err)
+    assert eng.rebuilds == 2
+    assert not sup.handle(err)  # third rebuild within the window: breaker
+    assert sup.breaker_open
+    wrapped = sup.wrap(err)
+    assert isinstance(wrapped, CrashLoopError)
+    assert wrapped.__cause__ is err
+    assert "FLAGS_serving_max_rebuilds" in str(wrapped)
+    # non-transient errors are never handled and pass through wrap()
+    bug = ValueError("bug")
+    assert not sup.handle(bug)
+    assert sup.wrap(bug) is bug
+
+
+def test_crash_loop_breaker_window_slides():
+    eng = _FakeEngine()
+    sup = EngineSupervisor(eng, _FakeSched(), max_rebuilds=1, window=5)
+    err = resilience.ServingDeviceError("flaky")
+    assert sup.handle(err)
+    for _ in range(5):
+        sup.note_step()  # five steps of real progress: the rebuild ages out
+    assert sup.handle(err)
+    assert eng.rebuilds == 2 and not sup.breaker_open
+
+
+def test_recovery_failure_fails_staged_requests():
+    """If recovery itself dies (the fresh arena allocation failing on a
+    still-dead device), requests staged for replay are failed with that
+    error — never left slot-less and RUNNING with done_event unset."""
+
+    class DeadEngine:
+        def rebuild(self):
+            raise MemoryError("fresh arena allocation failed")
+
+    sched = Scheduler(DeadEngine())
+    reqs = [Request(np.arange(4, dtype=np.int32), max_new_tokens=4)
+            for _ in range(2)]
+    for slot, r in enumerate(reqs):
+        r.state = RequestState.RUNNING
+        r.slot = slot
+        sched.running.append(r)
+    sup = EngineSupervisor(DeadEngine(), sched, max_rebuilds=3, window=10)
+    with pytest.raises(MemoryError):
+        sup.handle(resilience.ServingDeviceError("step died"))
+    for r in reqs:
+        assert r.state == RequestState.FAILED
+        assert isinstance(r.error, MemoryError)
+        assert r.done_event.is_set()
+    assert not sched.running
+
+
+# ------------------------------------------------ drain / close (ISSUE 5)
+
+
+def test_drain_zero_grace_fails_stragglers_retriably(model):
+    """drain(grace=0) stops admissions and fails anything still in flight
+    with the retriable RequestDrainedError (a queued request costs no
+    prefill, so this never compiles)."""
+    a = ServingAPI(model, num_slots=2, kv_block_size=8, max_model_len=MAX_LEN)
+    rng = np.random.default_rng(44)
+    req = a.submit(_prompt(rng, 5), max_new_tokens=4)  # stays QUEUED
+    d0 = resilience.stats().get("serving.drains", 0)
+    s0 = resilience.stats().get("serving.drain_stragglers", 0)
+    a.drain(grace=0)
+    assert req.state == RequestState.FAILED
+    assert isinstance(req.error, resilience.RequestDrainedError)
+    assert resilience.stats().get("serving.drains", 0) == d0 + 1
+    assert resilience.stats().get("serving.drain_stragglers", 0) == s0 + 1
+    with pytest.raises(resilience.RequestDrainedError, match="draining"):
+        a.submit(_prompt(rng, 5), max_new_tokens=4)
+    a.drain()  # idempotent: no second drain counter, no re-fail
+    assert resilience.stats().get("serving.drains", 0) == d0 + 1
+    a.close()  # close shares the drain path; the dead request is untouched
+    assert isinstance(req.error, resilience.RequestDrainedError)
+
+
+def test_close_after_failed_pump_single_fail(model, monkeypatch):
+    """ISSUE 5 satellite: close() routes through drain(grace=0), and
+    close() after a failed pump never double-fails requests — one error,
+    one stream sentinel, one done_event edge."""
+    a = ServingAPI(model, num_slots=2, kv_block_size=8, max_model_len=MAX_LEN)
+    rng = np.random.default_rng(45)
+    req = a.submit(_prompt(rng, 5), max_new_tokens=4)
+    boom = RuntimeError("pump died")
+
+    def dead_step():
+        raise boom
+
+    monkeypatch.setattr(a.scheduler, "step", dead_step)
+    with pytest.raises(RuntimeError, match="pump died"):
+        a.run_until_idle()
+    assert req.state == RequestState.FAILED and req.error is boom
+    d0 = resilience.stats().get("serving.drains", 0)
+    a.close()  # one shared code path: close == drain(grace=0)
+    assert resilience.stats().get("serving.drains", 0) == d0 + 1
+    assert req.error is boom  # not replaced by a drain error
+    assert req.stream_queue.get_nowait() is None  # exactly one sentinel
+    with pytest.raises(pyqueue.Empty):
+        req.stream_queue.get_nowait()
+
+
+def test_drain_all_covers_live_apis(model, monkeypatch):
+    import paddle_tpu.serving.api as api_mod
+
+    a = ServingAPI(model, num_slots=2, kv_block_size=8, max_model_len=MAX_LEN)
+    b = ServingAPI(model, num_slots=2, kv_block_size=8, max_model_len=MAX_LEN)
+    b.close()
+    monkeypatch.setattr(api_mod, "_live_apis", weakref.WeakSet((a, b)))
+    rng = np.random.default_rng(46)
+    req = a.submit(_prompt(rng, 5), max_new_tokens=4)
+    assert api_mod.drain_all() == 1  # b is already closed: skipped
+    assert req.state == RequestState.FAILED
+    assert isinstance(req.error, resilience.RequestDrainedError)
+    a.close()
+
+
+def test_preemption_guard_binds_to_drain(model):
+    """SIGTERM (stood in by guard.request()) drains the API at the next
+    pump boundary instead of killing it mid-decode: admissions stop and
+    stragglers fail with the retriable RequestDrainedError — the serving
+    mirror of the training loop's step-boundary finalize."""
+    a = ServingAPI(model, num_slots=2, kv_block_size=8, max_model_len=MAX_LEN)
+    guard = resilience.PreemptionGuard(install=False)
+    assert a.bind_preemption_guard(guard, grace=0.0) is a
+    rng = np.random.default_rng(47)
+    req = a.submit(_prompt(rng, 5), max_new_tokens=4)  # stays QUEUED
+    g0 = serving_metrics.stats().get("api.guard_drains", 0)
+    guard.request("test eviction")
+    a._pump_once()
+    assert req.state == RequestState.FAILED
+    assert isinstance(req.error, resilience.RequestDrainedError)
+    assert "preemption requested" in str(req.error)
+    assert serving_metrics.stats().get("api.guard_drains", 0) == g0 + 1
+    with pytest.raises(resilience.RequestDrainedError):
+        a.submit(_prompt(rng, 5), max_new_tokens=4)
+    a.close()
+
+
+def test_close_during_inflight_drain_still_sweeps(model, monkeypatch):
+    """close() racing an already-running long-grace drain must not return
+    with requests still alive: drain() early-returns on the idempotency
+    guard, so close() sweeps stragglers itself with its zero grace."""
+    a = ServingAPI(model, num_slots=2, kv_block_size=8, max_model_len=MAX_LEN)
+    rng = np.random.default_rng(50)
+    req = a.submit(_prompt(rng, 5), max_new_tokens=4)  # stays QUEUED
+    a._draining = True  # stand-in for a guard drain mid-grace elsewhere
+    a.close()
+    assert req.state == RequestState.FAILED
+    assert isinstance(req.error, resilience.RequestDrainedError)
+    assert req.done_event.is_set()
+
+
+def test_predictor_priority_kwarg_and_close_summary(model, monkeypatch,
+                                                    caplog):
+    """ISSUE 5 satellite: EnginePredictor.run honors priorities (kwarg
+    defaulting to the constructor's class) and close() logs the replay /
+    preemption / drain picture."""
+    from paddle_tpu.serving.api import EnginePredictor
+
+    pred = EnginePredictor(model, max_new_tokens=2, priority=7,
+                           config=ServingConfig(num_slots=2, kv_block_size=8,
+                                                max_model_len=MAX_LEN))
+    seen = []
+
+    def fake_submit(prompt, max_new_tokens=32, stop_token_id=None,
+                    priority=0):
+        seen.append(priority)
+        r = Request(prompt, max_new_tokens=max_new_tokens, priority=priority)
+        r.state = RequestState.FINISHED
+        r.tokens = [1] * max_new_tokens
+        return r
+
+    monkeypatch.setattr(pred._api, "submit", fake_submit)
+    monkeypatch.setattr(pred._api, "run_until_idle", lambda: None)
+    ids = np.ones((2, 4), np.int32)
+    pred.run([ids])
+    assert seen == [7, 7]  # constructor default rides every row
+    pred.run([ids], priority=1)
+    assert seen[2:] == [1, 1]  # per-run override
+    with caplog.at_level(logging.INFO, logger="paddle_tpu.serving"):
+        pred.close()
+    assert "supervisor replays" in caplog.text
+    assert "preemptions" in caplog.text and "drains" in caplog.text
+
+
 def test_predictor_mid_batch_submit_failure_strands_nothing(model):
     """If a row's submit sheds mid-batch, EnginePredictor.run cancels the
     rows it already queued instead of leaving unreachable handles that
@@ -567,3 +839,247 @@ def test_predictor_mid_batch_submit_failure_strands_nothing(model):
         assert not pred._api.scheduler.has_work()
     finally:
         pred.close()
+
+
+# -------------------------------------------- chaos serving (ISSUE 5)
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_supervisor_replay_token_parity_mid_decode(model):
+    """ISSUE 5 acceptance: a transient device fault injected mid-decode
+    recovers through supervisor rebuild+replay with byte-identical final
+    output_ids() for every live request, zero new decode compiles across
+    fail/rebuild/replay/resume, and a clean arena (blocks_in_use == 0, all
+    slots free) once the workload drains."""
+    keep = paddle.get_flags("fault_injection")["fault_injection"]
+    paddle.set_flags({"fault_injection": 1})
+    api = ServingAPI(model, num_slots=4, kv_block_size=8,
+                     max_model_len=MAX_LEN)
+    try:
+        rng = np.random.default_rng(40)
+        prompts = [_prompt(rng, n) for n in (5, 9, 12)]
+        # unfaulted reference pass through the same engine
+        reqs = [api.submit(p, max_new_tokens=10) for p in prompts]
+        api.run_until_idle()
+        refs = [r.output_ids() for r in reqs]
+        cc0 = compile_cache.stats().get("serving.decode_compiles", 0)
+        d0 = api.engine.decode_traces
+        rp0 = serving_metrics.stats().get("supervisor.replays", 0)
+        rb0 = resilience.stats().get("serving.rebuilds", 0)
+        # faulted pass: all three live mid-decode when the device dies
+        reqs2 = [api.submit(p, max_new_tokens=10) for p in prompts]
+        for _ in range(3):
+            api._pump_once()
+        assert all(r.state == RequestState.RUNNING for r in reqs2)
+        resilience.inject_fault("serving_device", times=1)
+        api.run_until_idle()
+        for ref, r in zip(refs, reqs2):
+            assert r.state == RequestState.FINISHED
+            np.testing.assert_array_equal(ref, r.output_ids())
+        assert serving_metrics.stats().get("supervisor.replays", 0) \
+            == rp0 + 3
+        assert resilience.stats().get("serving.rebuilds", 0) == rb0 + 1
+        # the arena_corrupt fault class recovers through the same path
+        reqs3 = [api.submit(p, max_new_tokens=10) for p in prompts]
+        for _ in range(2):
+            api._pump_once()
+        resilience.inject_fault("arena_corrupt", times=1)
+        api.run_until_idle()
+        for ref, r in zip(refs, reqs3):
+            assert r.state == RequestState.FINISHED
+            np.testing.assert_array_equal(ref, r.output_ids())
+        # no recompiles anywhere in fail/rebuild/replay/resume
+        assert api.engine.decode_traces == d0 == 1
+        assert compile_cache.stats().get("serving.decode_compiles", 0) == cc0
+        # graceful drain leaves the engine empty: zero stranded slots/blocks
+        api.drain(grace=5)
+        a = api.engine.arena.stats()
+        assert a["blocks_in_use"] == 0 and a["blocks_reserved"] == 0
+        assert api.engine.active_slots() == 0
+    finally:
+        resilience.clear_faults()
+        api.close()
+        paddle.set_flags({"fault_injection": keep})
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_preemption_starvation_regression(model):
+    """Oversubscribed mixed-priority workload: a high-priority arrival that
+    cannot fit preempts the lowest-priority most-recent victim once the
+    starvation threshold trips; EVERY request still completes (the victim
+    resumes from its journal token-for-token) and nothing recompiles."""
+    keep = paddle.get_flags(
+        "serving_starvation_steps")["serving_starvation_steps"]
+    paddle.set_flags({"serving_starvation_steps": 2})
+    api = ServingAPI(model, num_slots=2, kv_block_size=8,
+                     max_model_len=MAX_LEN)
+    try:
+        rng = np.random.default_rng(41)
+        pre0 = serving_metrics.stats().get("scheduler.preemptions", 0)
+        low_prompts = [_prompt(rng, 6) for _ in range(2)]
+        low = [api.submit(p, max_new_tokens=20, priority=5)
+               for p in low_prompts]
+        api._pump_once()  # both low-priority admitted: slots full
+        assert all(r.state == RequestState.RUNNING for r in low)
+        hp = _prompt(rng, 20)
+        hi = api.submit(hp, max_new_tokens=30, priority=0)
+        api.run_until_idle()
+        assert all(r.state == RequestState.FINISHED for r in low + [hi])
+        assert serving_metrics.stats().get("scheduler.preemptions", 0) > pre0
+        # the most recently admitted of the lowest-priority class was evicted
+        assert low[1].preemptions >= 1
+        # preempted output is identical to an uninterrupted run
+        for p, r in zip(low_prompts, low):
+            np.testing.assert_array_equal(r.output_ids(), _ref(model, p, 20))
+        np.testing.assert_array_equal(hi.output_ids(), _ref(model, hp, 30))
+        assert api.engine.decode_traces == 1  # preempt/resume: no recompile
+        a = api.engine.arena.stats()
+        assert a["blocks_in_use"] == 0 and a["blocks_reserved"] == 0
+    finally:
+        api.close()
+        paddle.set_flags({"serving_starvation_steps": keep})
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_crash_loop_breaker_end_to_end(model):
+    """A persistently dying device stops being rebuilt after the breaker
+    budget: in-flight requests fail fast with CrashLoopError (transient
+    cause chained) instead of replaying forever, capacity is reclaimed,
+    and later pumps surface the same fail-fast error."""
+    keep = paddle.get_flags("fault_injection")["fault_injection"]
+    paddle.set_flags({"fault_injection": 1})
+    api = ServingAPI(model, num_slots=2, kv_block_size=8,
+                     max_model_len=MAX_LEN)
+    api.supervisor.max_rebuilds = 2
+    try:
+        rng = np.random.default_rng(42)
+        req = api.submit(_prompt(rng, 5), max_new_tokens=8)
+        api._pump_once()
+        assert req.state == RequestState.RUNNING
+        rb0 = serving_metrics.stats().get("supervisor.rebuilds", 0)
+        resilience.inject_fault("serving_device", times=100)
+        # breaker exhaustion mid-recovery surfaces CrashLoopError to the
+        # pumping caller right away (a total failure is not a "recovery")
+        with pytest.raises(CrashLoopError):
+            api.run_until_idle()
+        assert req.state == RequestState.FAILED
+        assert isinstance(req.error, CrashLoopError)
+        assert isinstance(req.error.__cause__,
+                          resilience.ServingDeviceError)
+        assert api.supervisor.breaker_open
+        assert serving_metrics.stats().get("supervisor.rebuilds", 0) \
+            == rb0 + 2
+        a = api.engine.arena.stats()
+        assert a["blocks_in_use"] == 0 and a["blocks_reserved"] == 0
+        assert api.engine.active_slots() == 0
+        # after the breaker opens, queued work fails fast through the pump
+        req2 = api.submit(_prompt(rng, 5), max_new_tokens=4)
+        with pytest.raises(CrashLoopError):
+            api.run_until_idle()
+        assert isinstance(req2.error, CrashLoopError)
+    finally:
+        resilience.clear_faults()
+        api.close()
+        paddle.set_flags({"fault_injection": keep})
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_breaker_mid_replay_death_leaks_nothing(model, monkeypatch):
+    """Regression: the engine dying AGAIN during replay — after some
+    requests were already re-admitted into the fresh arena — exhausts the
+    breaker without leaking those slots/blocks: everything re-admitted is
+    retired before the fail-fast sweep."""
+    api = ServingAPI(model, num_slots=2, kv_block_size=8,
+                     max_model_len=MAX_LEN)
+    api.supervisor.max_rebuilds = 1
+    try:
+        rng = np.random.default_rng(48)
+        r1 = api.submit(_prompt(rng, 5), max_new_tokens=8)
+        r2 = api.submit(_prompt(rng, 9), max_new_tokens=8)
+        api._pump_once()
+        assert all(r.state == RequestState.RUNNING for r in (r1, r2))
+        real_admit = api.engine.admit
+        calls = {"n": 0}
+
+        def flaky_admit(prompt, max_new_tokens, tokens=None):
+            calls["n"] += 1
+            if calls["n"] == 2:  # first replay succeeds, second one dies
+                raise resilience.ServingDeviceError("died during replay")
+            return real_admit(prompt, max_new_tokens, tokens=tokens)
+
+        monkeypatch.setattr(api.engine, "admit", flaky_admit)
+        # breaker exhaustion mid-recovery is NOT a recovery: handle()
+        # returns False so the pump surfaces CrashLoopError instead of
+        # counting a total failure as api.recoveries
+        assert not api.supervisor.handle(
+            resilience.ServingDeviceError("step died"))
+        assert api.supervisor.breaker_open
+        for r in (r1, r2):
+            assert r.state == RequestState.FAILED
+            assert isinstance(r.error, CrashLoopError)
+            assert r.done_event.is_set()
+        a = api.engine.arena.stats()
+        assert a["blocks_in_use"] == 0 and a["blocks_reserved"] == 0
+        assert api.engine.active_slots() == 0
+    finally:
+        api.close()
+
+
+@pytest.mark.slow
+def test_preemption_declines_when_eviction_cannot_help(model):
+    """Feasibility gate: when higher-priority runners hold the arena and
+    evicting every strictly-lower-priority victim still could not seat the
+    waiter, nothing is preempted — the victims' prefilled work is not
+    thrown away for unreachable capacity."""
+    keep = paddle.get_flags(
+        "serving_starvation_steps")["serving_starvation_steps"]
+    paddle.set_flags({"serving_starvation_steps": 1})
+    eng_kw = dict(num_slots=3, kv_block_size=8, max_model_len=MAX_LEN,
+                  num_blocks=5)  # 4 allocatable blocks
+    api = ServingAPI(model, **eng_kw)
+    try:
+        rng = np.random.default_rng(49)
+        # priority-0 holder: 2 blocks; priority-9 victim candidate: 1 block
+        holder = api.submit(_prompt(rng, 8), max_new_tokens=8, priority=0)
+        victim = api.submit(_prompt(rng, 4), max_new_tokens=4, priority=9)
+        api._pump_once()
+        assert all(r.state == RequestState.RUNNING for r in (holder, victim))
+        # waiter needs 4 blocks; grantable(1) + victim's budget(1) == 2 < 4
+        waiter = api.submit(_prompt(rng, 8), max_new_tokens=24, priority=0)
+        for _ in range(4):  # well past the starvation threshold
+            api._pump_once()
+        assert victim.preemptions == 0  # eviction declined, work preserved
+        assert victim.state in (RequestState.RUNNING, RequestState.FINISHED)
+        api.run_until_idle()  # capacity frees naturally; everyone completes
+        for r in (holder, victim, waiter):
+            assert r.state == RequestState.FINISHED
+    finally:
+        api.close()
+        paddle.set_flags({"serving_starvation_steps": keep})
+
+
+@pytest.mark.slow
+def test_drain_completes_in_flight_within_grace(model):
+    """drain(grace) pumps already-admitted work to completion — the graceful
+    half of shutdown: the in-flight request finishes with its full (parity-
+    checked) output before the engine goes away."""
+    api = ServingAPI(model, num_slots=2, kv_block_size=8,
+                     max_model_len=MAX_LEN)
+    try:
+        rng = np.random.default_rng(43)
+        p = _prompt(rng, 5)
+        req = api.submit(p, max_new_tokens=6)
+        api._pump_once()  # admitted and decoding
+        assert req.state == RequestState.RUNNING
+        api.drain(grace=30)
+        assert req.state == RequestState.FINISHED
+        np.testing.assert_array_equal(req.output_ids(), _ref(model, p, 6))
+        assert api.engine.active_slots() == 0
+        with pytest.raises(resilience.RequestDrainedError):
+            api.submit(p, max_new_tokens=2)
+    finally:
+        api.close()

@@ -16,7 +16,11 @@ jax backend, so it is safe on a host whose TPU tunnel is down. With --run,
 CMD executes in-process via runpy with the framework imported first, and the
 delta of ``core.resilience.stats()`` across the run is reported — a healthy
 chaos run shows ``sentinel.skipped`` / ``retry.*`` / ``fault.*`` counters
-matching the faults it injected.
+matching the faults it injected. Serving-side resilience lands on the same
+surface: ``serving.preemptions`` (priority-admission victim evictions),
+``serving.rebuilds`` / ``serving.replays`` (supervisor rebuild-and-replay
+recovery), ``serving.drains`` / ``serving.drain_stragglers`` (graceful
+drain) — see docs/robustness.md, "Serving under failure".
 """
 from __future__ import annotations
 
@@ -73,7 +77,14 @@ def main(argv=None) -> int:
         before = resilience.stats()
         t0 = time.perf_counter()
         sys.argv = list(args.run)
-        runpy.run_path(args.run[0], run_name="__main__")
+        try:
+            runpy.run_path(args.run[0], run_name="__main__")
+        finally:
+            # if the script served traffic, exit through the graceful path:
+            # drain any ServingAPI it left open so serving.drain_* counters
+            # reflect a real drain and no engine exits holding live slots
+            if "paddle_tpu.serving.api" in sys.modules:
+                sys.modules["paddle_tpu.serving.api"].drain_all()
         wall = time.perf_counter() - t0
         delta = {k: v for k, v in resilience.stats_delta(
                      before, resilience.stats(), drop_zero=True).items()

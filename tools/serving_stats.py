@@ -18,7 +18,13 @@ tunnel is down. With --run, CMD executes in-process via runpy with the
 framework imported first, and the delta of ``serving.metrics.stats()``
 across the run is reported — a healthy serving run shows
 ``tokens.generated`` climbing with ``engine.decode_compiles`` frozen after
-warmup.
+warmup. The resilience layer's counters ride the same delta:
+``scheduler.preemptions`` (starvation-triggered victim evictions),
+``supervisor.rebuilds`` / ``supervisor.replays`` (transient-failure
+recovery), ``api.drains`` / ``api.drain_stragglers`` / ``api.recoveries``.
+After the script returns, every ServingAPI it left open is drained
+(``serving.drain_all``) so the reported run always exercises the graceful
+shutdown path and no engine exits holding live slots or arena blocks.
 """
 from __future__ import annotations
 
@@ -50,15 +56,26 @@ def _config_report() -> dict:
         "serving_prefill_bucket_min": _flag_env("serving_prefill_bucket_min",
                                                 16),
         "decode_donate": _flag_env("decode_donate", 1),
+        # resilience layer (priority preemption / supervisor / drain)
+        "serving_starvation_steps": _flag_env("serving_starvation_steps", 8),
+        "serving_max_rebuilds": _flag_env("serving_max_rebuilds", 3),
+        "serving_rebuild_window": _flag_env("serving_rebuild_window", 200),
+        "serving_drain_grace": _flag_env("serving_drain_grace", 30.0),
     }
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--json", action="store_true", help="JSON output")
+    ap.add_argument("--drain-grace", type=float, default=0.0,
+                    help="grace budget (seconds) for the post-run drain of "
+                         "any ServingAPI the script left open (default 0: "
+                         "stragglers fail with the retriable "
+                         "RequestDrainedError)")
     ap.add_argument("--run", nargs=argparse.REMAINDER,
                     help="script [args...] to execute in-process; serving "
-                         "counters are reported for that run")
+                         "counters are reported for that run, and every "
+                         "ServingAPI left open is drained afterwards")
     args = ap.parse_args(argv)
 
     if args.run:
@@ -71,7 +88,16 @@ def main(argv=None) -> int:
         before = metrics.stats()
         t0 = time.perf_counter()
         sys.argv = list(args.run)
-        runpy.run_path(args.run[0], run_name="__main__")
+        try:
+            runpy.run_path(args.run[0], run_name="__main__")
+        finally:
+            # shutdown epilogue: drain every ServingAPI the script left
+            # open so the run always exits through the graceful path (no
+            # engine holding live slots/blocks) and the drain counters are
+            # part of the reported delta
+            from paddle_tpu import serving
+
+            serving.drain_all(grace=args.drain_grace)
         wall = time.perf_counter() - t0
         delta = {k: v for k, v in metrics.stats_delta(
                      before, metrics.stats(), drop_zero=True).items()
