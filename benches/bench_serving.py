@@ -18,6 +18,19 @@ timed window (the admit/retire-never-recompiles invariant, assertable as
 Usage: python benches/bench_serving.py   (TPU: GPT-base; CPU: tiny smoke)
 Env: SERVING_LEVELS (comma list, default "2,4,8"), SERVING_REQUESTS,
      SERVING_ARRIVAL_MS (mean inter-arrival gap), SERVING_SEED.
+
+``--shared-prefix`` instead runs the radix-prefix-cache workload
+(ISSUE 6): N requests over K distinct system prompts (every request =
+shared system prefix + unique user tail), once with
+``FLAGS_serving_prefix_cache=0`` and once with ``=1`` on the same offered
+load. Reported: prefill-tokens-avoided (the matched-prefix tokens that
+never ran through a prefill program), aggregate tokens/s for both runs and
+their ratio, and the compile counters across each timed window (warmup
+compiles every bucket first — a cache hit is just different int32 block
+rows, so the timed windows must show zero). Persisted into
+``BENCH_SERVING.json`` under ``"shared_prefix"`` alongside the sweep.
+Env: SERVING_PREFIX_REQUESTS (default 32), SERVING_PREFIX_PROMPTS (K,
+default 3), SERVING_PREFIX_SYS (system-prompt tokens, block-aligned).
 """
 from __future__ import annotations
 
@@ -107,15 +120,135 @@ def run_engine(api, workload):
                            min(pending[0]["arrival"] - now, 1e-3)))
     wall = time.perf_counter() - t0
     cc1 = compile_cache.stats()
-    compiles = (cc1.get("serving.decode_compiles", 0)
-                - cc0.get("serving.decode_compiles", 0)
-                + cc1.get("serving.prefill_compiles", 0)
-                - cc0.get("serving.prefill_compiles", 0))
+    compiles = sum(cc1.get(k, 0) - cc0.get(k, 0)
+                   for k in ("serving.decode_compiles",
+                             "serving.prefill_compiles",
+                             "serving.cow_compiles"))
     toks = sum(w["new"] for w in workload)
     return {"tokens_per_sec": toks / wall, "wall_secs": wall,
             "latency_p50": _percentile(lat, 50),
             "latency_p99": _percentile(lat, 99),
             "compiles_during_run": int(compiles)}
+
+
+def make_shared_prefix_workload(rng, n_requests, k_prompts, sys_len,
+                                tail_len, new_tokens, gap_s, vocab):
+    """N requests round-robining over K distinct system prompts, each with
+    a unique user tail — the millions-of-users shape where almost all
+    prefill work is the same system prompt over and over."""
+    systems = [rng.integers(0, vocab, (sys_len,), dtype=np.int32)
+               for _ in range(k_prompts)]
+    work, t = [], 0.0
+    for i in range(n_requests):
+        tail = rng.integers(0, vocab, (tail_len,), dtype=np.int32)
+        prompt = np.concatenate([systems[i % k_prompts], tail])
+        work.append({"prompt": prompt, "new": new_tokens, "arrival": t})
+        t += float(rng.exponential(gap_s))
+    return work
+
+
+def run_shared_prefix(model, platform):
+    import paddle_tpu as paddle
+    from paddle_tpu.serving import ServingAPI
+    from paddle_tpu.serving import metrics as serving_metrics
+
+    if platform == "tpu":
+        sys_len = int(os.environ.get("SERVING_PREFIX_SYS", "448"))
+        tail_len, new_tokens, gap_ms = 16, 16, 20.0
+    else:
+        sys_len = int(os.environ.get("SERVING_PREFIX_SYS", "192"))
+        tail_len, new_tokens, gap_ms = 8, 4, 5.0
+    n_requests = int(os.environ.get("SERVING_PREFIX_REQUESTS", "32"))
+    k_prompts = int(os.environ.get("SERVING_PREFIX_PROMPTS", "3"))
+    seed = int(os.environ.get("SERVING_SEED", "0"))
+    max_len = sys_len + tail_len + new_tokens
+
+    rng = np.random.default_rng(seed)
+    workload = make_shared_prefix_workload(
+        rng, n_requests, k_prompts, sys_len, tail_len, new_tokens,
+        gap_ms / 1e3, model.cfg.vocab_size)
+    total_prompt_tokens = sum(len(w["prompt"]) for w in workload)
+
+    keep = paddle.get_flags("serving_prefix_cache")["serving_prefix_cache"]
+    runs = {}
+    try:
+        for label, flag in (("cache_off", 0), ("cache_on", 1)):
+            paddle.set_flags({"serving_prefix_cache": flag})
+            api = ServingAPI(model, num_slots=8, max_model_len=max_len)
+            # warm every compiled program the timed window will touch:
+            # the full-prompt prefill bucket (cache-off path AND the
+            # cache-on cold first admission of each distinct prompt), the
+            # suffix bucket (warm admissions re-prefill only their tail),
+            # and the decode step. The warmup system prefix is distinct
+            # from the workload's, so the timed window still pays its own
+            # cold inserts — only compiles are excluded, not cache misses.
+            warm_sys = rng.integers(0, model.cfg.vocab_size, (sys_len,),
+                                    dtype=np.int32)
+            for _ in range(2):
+                tail = rng.integers(0, model.cfg.vocab_size, (tail_len,),
+                                    dtype=np.int32)
+                api.submit(np.concatenate([warm_sys, tail]),
+                           max_new_tokens=2)
+                api.run_until_idle()
+            sm0 = serving_metrics.stats()
+            rec = run_engine(api, workload)
+            sm1 = serving_metrics.stats()
+            avoided = (sm1.get("tokens.prefill_avoided", 0)
+                       - sm0.get("tokens.prefill_avoided", 0))
+            rec["prefill_tokens"] = (sm1.get("tokens.prefill", 0)
+                                     - sm0.get("tokens.prefill", 0))
+            rec["prefill_tokens_avoided"] = int(avoided)
+            rec["prefill_tokens_avoided_pct"] = round(
+                100.0 * avoided / total_prompt_tokens, 1)
+            runs[label] = rec
+            print(f"# shared-prefix {label}: "
+                  f"{rec['tokens_per_sec']:.1f} tok/s, "
+                  f"avoided {rec['prefill_tokens_avoided_pct']}% of "
+                  f"{total_prompt_tokens} prompt tokens, "
+                  f"compiles={rec['compiles_during_run']}", flush=True)
+            api.close()
+    finally:
+        paddle.set_flags({"serving_prefix_cache": keep})
+
+    rec = {
+        "bench": "serving_shared_prefix",
+        "metric": f"shared-prefix tokens/sec (N={n_requests} K={k_prompts} "
+                  f"sys{sys_len} {platform})",
+        "value": round(runs["cache_on"]["tokens_per_sec"], 1),
+        "unit": "tokens/sec",
+        "platform": platform,
+        "requests": n_requests,
+        "distinct_prompts": k_prompts,
+        "sys_len": sys_len,
+        "tail_len": tail_len,
+        "new_tokens": new_tokens,
+        "prefill_tokens_avoided_pct":
+            runs["cache_on"]["prefill_tokens_avoided_pct"],
+        "speedup_vs_cache_off": round(
+            runs["cache_on"]["tokens_per_sec"]
+            / runs["cache_off"]["tokens_per_sec"], 2),
+        "compiles_during_run": runs["cache_on"]["compiles_during_run"],
+        "runs": {k: {kk: (round(vv, 4) if isinstance(vv, float) else vv)
+                     for kk, vv in r.items()} for k, r in runs.items()},
+    }
+    from _common import emit
+
+    emit(rec)
+    out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "BENCH_SERVING.json")
+    # persist ALONGSIDE the offered-load sweep: merge into the existing
+    # record instead of clobbering it
+    existing = {}
+    if os.path.exists(out_path):
+        try:
+            with open(out_path) as f:
+                existing = json.load(f)
+        except (OSError, ValueError):
+            existing = {}
+    existing["shared_prefix"] = rec
+    with open(out_path, "w") as f:
+        json.dump(existing, f)
+        f.write("\n")
 
 
 def main():
@@ -126,6 +259,16 @@ def main():
     from paddle_tpu.serving import ServingAPI
 
     platform = jax.devices()[0].platform
+    if "--shared-prefix" in sys.argv:
+        from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM, gpt_tiny
+
+        cfg = (GPTConfig(vocab_size=50304, hidden_size=768, num_layers=12,
+                         num_heads=12, max_position_embeddings=2048)
+               if platform == "tpu" else gpt_tiny())
+        model = GPTForCausalLM(cfg)
+        model.eval()
+        run_shared_prefix(model, platform)
+        return
     if platform == "tpu":
         cfg = GPTConfig(vocab_size=50304, hidden_size=768, num_layers=12,
                         num_heads=12, max_position_embeddings=2048)
@@ -203,6 +346,16 @@ def main():
     emit(rec)
     out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                             "BENCH_SERVING.json")
+    # keep the shared-prefix record (written by --shared-prefix runs)
+    # alongside the sweep instead of clobbering it
+    if os.path.exists(out_path):
+        try:
+            with open(out_path) as f:
+                prev = json.load(f)
+            if "shared_prefix" in prev:
+                rec["shared_prefix"] = prev["shared_prefix"]
+        except (OSError, ValueError):
+            pass
     with open(out_path, "w") as f:
         json.dump(rec, f)
         f.write("\n")

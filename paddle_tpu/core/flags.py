@@ -167,6 +167,26 @@ define_flag("serving_drain_grace", 30.0,
             "admissions stop immediately, in-flight requests pump to "
             "completion within the budget, stragglers fail with the "
             "retriable RequestDrainedError.")
+define_flag("serving_prefix_cache", False,
+            "Radix prefix cache over the paged KV arena: full prompt "
+            "blocks are content-hashed into a tree and shared by "
+            "reference across slots (refcounted, copy-on-write), so an "
+            "admission whose prefix is resident prefills only its "
+            "unmatched suffix. 0 (default) keeps the PR 5 behavior: "
+            "every admit prefills its full prompt into private blocks.")
+define_flag("serving_cache_affinity", 0,
+            "Cache-aware admission: how many times the strict "
+            "(priority, arrival) head-of-line waiter may be skipped in "
+            "favor of a same-priority waiter whose prefix is resident in "
+            "the radix cache. Bounded so a cache-cold head request is "
+            "never starved past this window. 0 disables the preference "
+            "(strict PR 5 admission order).")
+define_flag("serving_arena_invariants", False,
+            "Audit the refcount layer after every release path (retire, "
+            "cancel, preemption, drain stragglers): free-list blocks must "
+            "have refcount zero, and a block id may appear in multiple "
+            "slots' tables only when its refcount says so. Costs a host "
+            "walk per retire; tests turn it on, production leaves it off.")
 
 # ---- Resilience: retry / sentinel / fault injection (core.resilience) ----
 define_flag("io_retries", 3,

@@ -6,7 +6,12 @@ served endpoint), rebuilt as an Orca/vLLM-style decode runtime:
   step over a fixed ``[num_slots]`` lane arena; admit/retire never
   recompiles.
 * :mod:`.kv_arena`  — ``KVArena``: block-granular (paged) KV allocation
-  with free-list reuse and a scratch block for masked lanes.
+  with refcounted free-list reuse (shared blocks return only at refcount
+  zero) and a scratch block for masked lanes.
+* :mod:`.prefix_cache` — ``PrefixCache``: radix tree over content-hashed
+  full prompt blocks; admissions attach matched prefixes by reference
+  (copy-on-write when a shared block must be written) and prefill only
+  their unmatched suffix (``FLAGS_serving_prefix_cache``).
 * :mod:`.scheduler` — ``Scheduler``/``Request``: iteration-level batching,
   priority admission (lower value first, FCFS within a class),
   starvation-triggered preemption with journal re-admission, and the
@@ -29,6 +34,7 @@ _LAZY = {
     "ServingEngine": ("engine", "ServingEngine"),
     "ServingConfig": ("engine", "ServingConfig"),
     "KVArena": ("kv_arena", "KVArena"),
+    "PrefixCache": ("prefix_cache", "PrefixCache"),
     "ArenaExhaustedError": ("kv_arena", "ArenaExhaustedError"),
     "ReservationExhaustedError": ("kv_arena", "ReservationExhaustedError"),
     "Scheduler": ("scheduler", "Scheduler"),

@@ -447,10 +447,19 @@ class EnginePredictor:
         misattribute a concurrent instance's activity)."""
         api = self._api
         api.close()
+        cache = api.engine.prefix_cache
+        if cache is not None and (cache.hits or cache.misses):
+            prefix = (", prefix hit-rate %.0f%% (%d/%d admits, "
+                      "%d prefill tokens avoided)") % (
+                          100.0 * cache.hits / (cache.hits + cache.misses),
+                          cache.hits, cache.hits + cache.misses,
+                          cache.hit_tokens)
+        else:
+            prefix = ""
         _logger.info(
             "EnginePredictor closed: %d finished, %d failed, "
             "%d supervisor replays (%d rebuilds), %d preemptions, "
-            "%d drains",
+            "%d drains%s",
             self._finished, self._failed,
             api.supervisor.replay_count, api.supervisor.rebuild_count,
-            api.scheduler.preempt_count, api.drain_count)
+            api.scheduler.preempt_count, api.drain_count, prefix)

@@ -45,6 +45,7 @@ from ..core import compile_cache, flags, resilience
 from ..core.tensor import Tensor
 from . import metrics
 from .kv_arena import KVArena, Reservation
+from .prefix_cache import PrefixCache
 
 
 def _ceil_div(a: int, b: int) -> int:
@@ -114,6 +115,53 @@ class _CapturePrefillView:
         return o, (ka, va)
 
 
+class _PrefixPrefillView:
+    """Suffix-only prefill over a slot whose prefix KV is already resident
+    (matched radix-cache blocks attached to the block table by reference):
+    scatter only the suffix chunk's k/v at global positions
+    ``prefix_len + i`` via the slot's table, then attend each suffix query
+    against the full gathered context — prefix blocks are read, never
+    recomputed. ``prefix_len`` is a traced scalar and the table is runtime
+    int32 data, so every (cache hit, prefix length) reuses ONE compiled
+    program per suffix-length bucket."""
+
+    def __init__(self, k_pool, v_pool, bt_row, prefix_len, true_len,
+                 block_size: int):
+        self.k_pool = k_pool
+        self.v_pool = v_pool
+        self.bt_row = bt_row          # [max_blocks] int32: the slot's table
+        self.prefix_len = prefix_len  # scalar int32: resident context length
+        self.true_len = true_len      # scalar int32: real (unpadded) suffix
+        self.block_size = block_size
+
+    def update_and_attend(self, q, k, v):
+        import jax.numpy as jnp
+
+        from ..models.gpt import masked_attention
+
+        qa, ka, va = (t._data if isinstance(t, Tensor) else t
+                      for t in (q, k, v))
+        p = qa.shape[1]
+        bs = self.block_size
+        p_idx = jnp.arange(p)
+        gpos = self.prefix_len + p_idx  # global write positions
+        bi = jnp.clip(gpos // bs, 0, self.bt_row.shape[0] - 1)
+        # padded suffix positions scatter into the scratch block, exactly
+        # like full prefill's padding — bucketing never pollutes live state
+        row = jnp.where(p_idx < self.true_len, self.bt_row[bi], 0)
+        off = gpos % bs
+        k_pool = self.k_pool.at[row, off].set(ka[0])
+        v_pool = self.v_pool.at[row, off].set(va[0])
+        t_len = self.bt_row.shape[0] * bs
+        k_all = k_pool[self.bt_row].reshape(1, t_len, *k_pool.shape[2:])
+        v_all = v_pool[self.bt_row].reshape(1, t_len, *v_pool.shape[2:])
+        mask = (jnp.arange(t_len)[None, :] <= gpos[:, None])[None, None]
+        o = masked_attention(qa, k_all, v_all, mask)
+        new = _PrefixPrefillView(k_pool, v_pool, self.bt_row,
+                                 self.prefix_len, self.true_len, bs)
+        return o, new
+
+
 @dataclass
 class ServingConfig:
     """Engine sizing. Zeros/None defer to flags / the model config:
@@ -130,6 +178,9 @@ class ServingConfig:
     num_blocks: int = 0
     prefill_bucket_min: int = 0
     donate: Optional[bool] = None
+    # radix prefix cache (content-addressed KV block sharing); None defers
+    # to FLAGS_serving_prefix_cache
+    prefix_cache: Optional[bool] = None
     # retry transient (OSError/timeout) step failures — only honored with
     # donation OFF: a donated call that died may have consumed its buffers,
     # so retrying it would replay invalidated state
@@ -179,6 +230,11 @@ class ServingEngine:
                             mcfg.hidden_size // mcfg.num_heads,
                             num_blocks, self.block_size, kv_dtype)
         self.arena = KVArena(*self._arena_args)
+        self.use_prefix_cache = (bool(flags.flag("serving_prefix_cache"))
+                                 if cfg.prefix_cache is None
+                                 else bool(cfg.prefix_cache))
+        self.prefix_cache = (PrefixCache(self.arena, self.block_size)
+                             if self.use_prefix_cache else None)
 
         s = self.num_slots
         self._bt_host = np.zeros((s, self.blocks_per_slot), np.int32)
@@ -187,12 +243,22 @@ class ServingEngine:
         self._last_tok = np.zeros(s, np.int32)
         self._active = np.zeros(s, np.bool_)
         self._slot_res: List[Optional[Reservation]] = [None] * s
+        # per-slot sharing state: block ids attached by reference from the
+        # radix cache (deref'd at retire, NOT owned by the reservation) and
+        # the count of filled block-table entries (shared + private) that
+        # decode growth compares against
+        self._slot_shared: List[List[int]] = [[] for _ in range(s)]
+        self._slot_filled = np.zeros(s, np.int32)
         # trace counters: incremented at TRACE time inside the compiled
         # functions — the assertable "admit/retire never recompiles" number
         self.decode_traces = 0
         self.prefill_traces: Dict[int, int] = {}
+        self.prefix_prefill_traces: Dict[int, int] = {}
+        self.cow_traces = 0
         self._step_jit = None
         self._prefill_jits: Dict[int, object] = {}
+        self._prefix_jits: Dict[int, object] = {}
+        self._cow_jit = None
         self._meter = metrics.Meter()  # lifetime aggregate tokens/s gauge
         metrics.set_gauge("slots.total", s)
         metrics.set_gauge("arena.kv_bytes", self.arena.bytes_total())
@@ -236,10 +302,40 @@ class ServingEngine:
                 f"request needs {need} KV blocks but the arena has only "
                 f"{cap} allocatable; it could never be admitted")
 
-    def can_admit(self, prompt_len: int, max_new_tokens: int) -> bool:
-        return (self.free_slots() > 0
-                and self.arena.can_reserve(
-                    self.blocks_needed(prompt_len, max_new_tokens)))
+    def admit_blocks_needed(self, prompt_len: int, max_new_tokens: int,
+                            prompt=None) -> int:
+        """Blocks an admission would actually RESERVE: the worst-case
+        budget minus full prompt blocks resident in the radix cache (those
+        attach by reference). A fully-cached block-aligned prompt still
+        reserves one private block — the copy-on-write target its last
+        block is recomputed into. Conservative when ``prompt`` is None or
+        the cache is off (plain worst case)."""
+        return self.admit_sizing(prompt_len, max_new_tokens, prompt)[0]
+
+    def admit_sizing(self, prompt_len: int, max_new_tokens: int,
+                     prompt=None, keys=None):
+        """Both admission-feasibility numbers from ONE radix walk:
+        (blocks this admission would reserve, matched-but-unpinned blocks
+        that ``grantable()`` counts evictable but admit() will pin).
+        ``keys`` — a precomputed ``PrefixCache.chunk_keys`` chain — makes
+        the walk hash-free for per-step scheduler probes."""
+        need = self.blocks_needed(prompt_len, max_new_tokens)
+        if self.prefix_cache is None or (prompt is None and keys is None):
+            return need, 0
+        matched, unpinned = self.prefix_cache.match_stats(prompt, keys=keys)
+        if matched:
+            need -= matched
+            if matched * self.block_size >= prompt_len:
+                need += 1  # COW copy of the last fully-matched block
+        return need, unpinned
+
+    def can_admit(self, prompt_len: int, max_new_tokens: int,
+                  prompt=None, keys=None) -> bool:
+        if self.free_slots() <= 0:
+            return False
+        need, pinned = self.admit_sizing(prompt_len, max_new_tokens,
+                                         prompt, keys=keys)
+        return self.arena.grantable() - pinned >= need
 
     # ------------------------------------------------------------ compile
 
@@ -289,6 +385,72 @@ class ServingEngine:
               else jax.jit(prefill))
         self._prefill_jits[p_bucket] = fn
         return fn
+
+    def _get_prefix_prefill(self, p_bucket: int):
+        """Compiled suffix-only prefill for a cache-hit admission: run the
+        model over the unmatched suffix (padded to ``p_bucket``) while
+        attending to — not recomputing — the resident prefix blocks.
+        One program per suffix-length bucket; prefix length and the block
+        table are runtime data, so hits of any depth share it."""
+        fn = self._prefix_jits.get(p_bucket)
+        if fn is not None:
+            return fn
+        import jax
+        import jax.numpy as jnp
+
+        from ..core import rng as prng
+        from ..jit import _swap_data
+
+        model = self._model
+        bs = self.block_size
+
+        def prefix_prefill(arrays, ids, true_len, prefix_len, pools, bt_row):
+            self.prefix_prefill_traces[p_bucket] = \
+                self.prefix_prefill_traces.get(p_bucket, 0) + 1
+            compile_cache.bump("serving.prefill_compiles")
+            views = [_PrefixPrefillView(kp, vp, bt_row, prefix_len,
+                                        true_len, bs) for kp, vp in pools]
+            with _swap_data(self._objs, list(arrays)):
+                with prng.key_guard(jax.random.key(0)):
+                    h, new_views = model.gpt(Tensor(ids), caches=views,
+                                             start_pos=prefix_len)
+                h_last = jax.lax.dynamic_index_in_dim(
+                    h._data, true_len - 1, axis=1, keepdims=False)
+                logits = model._head_logits(h_last)
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            new_pools = [(v.k_pool, v.v_pool) for v in new_views]
+            return nxt[0], new_pools
+
+        fn = (jax.jit(prefix_prefill, donate_argnums=(4,)) if self.donate
+              else jax.jit(prefix_prefill))
+        self._prefix_jits[p_bucket] = fn
+        return fn
+
+    def _cow_copy(self, src: int, dst: int) -> None:
+        """Copy one physical block's K/V rows (every layer, both pools)
+        into a privately taken block — the copy-on-write that keeps shared
+        blocks read-only when a slot must write inside its matched prefix
+        (a fully-cached block-aligned prompt recomputing its last token).
+        One compiled gather/scatter per arena shape; src/dst are runtime
+        scalars, so COW never recompiles either."""
+        if self._cow_jit is None:
+            import jax
+
+            def cow(pools, src, dst):
+                self.cow_traces += 1
+                compile_cache.bump("serving.cow_compiles")
+                return [(kp.at[dst].set(kp[src]), vp.at[dst].set(vp[src]))
+                        for kp, vp in pools]
+
+            self._cow_jit = (jax.jit(cow, donate_argnums=(0,))
+                             if self.donate else jax.jit(cow))
+        import jax.numpy as jnp
+
+        new_pools = self._call(self._cow_jit, self.arena.pools,
+                               jnp.int32(src), jnp.int32(dst),
+                               name="serving.cow_copy")
+        self.arena.set_pools(new_pools)
+        metrics.bump("prefix.cow_copies")
 
     def _get_step(self):
         if self._step_jit is not None:
@@ -381,16 +543,100 @@ class ServingEngine:
         slot = int(np.argmin(self._active))
         if self._active[slot]:
             raise RuntimeError("no free slot")
-        res = self.arena.reserve(self.blocks_needed(plen, max_new_tokens))
+
+        # ---- radix-cache walk: attach resident full PROMPT blocks by
+        # reference (refcount++, zero prefill work for the matched prefix).
+        # The refs are taken BEFORE reserve() so its eviction pass can
+        # never reclaim the very blocks this admission is about to share.
+        cache = self.prefix_cache
+        chain = cache.match(prompt) if cache is not None else []
+        # a fully-matched block-aligned context has no suffix to prefill,
+        # but the last token must still be recomputed for its logits: the
+        # last matched block is copied into a private block (COW) and the
+        # final token re-scattered there — shared blocks stay read-only
+        cow = bool(chain) and len(chain) * self.block_size == clen
+        attached = chain[:-1] if cow else chain
+        shared = [node.block for node in attached]
+        for blk in shared:
+            self.arena.ref(blk)
+        # the COW source is read, not attached — but it must be pinned
+        # across reserve() too, or the eviction pass could reclaim (and a
+        # recycled take() could overwrite) the block _cow_copy is about to
+        # read; admit_sizing's unpinned count already budgets for this pin
+        cow_src: Optional[int] = chain[-1].block if cow else None
+        if cow_src is not None:
+            self.arena.ref(cow_src)
         try:
-            for _ in range(_ceil_div(clen, self.block_size)):
-                bi = len(res.taken)  # BEFORE take() appends
-                self._bt_host[slot, bi] = res.take()
+            res = self.arena.reserve(
+                self.blocks_needed(plen, max_new_tokens) - len(attached))
         except Exception:
+            for blk in shared:
+                self.arena.deref(blk)
+            if cow_src is not None:
+                self.arena.deref(cow_src)
+            raise
+        n_attached = len(attached)
+        prefix_len = clen - 1 if cow else n_attached * self.block_size
+        try:
+            for i, blk in enumerate(shared):
+                self._bt_host[slot, i] = blk
+            # private blocks covering the suffix [prefix blocks, clen)
+            for bi in range(n_attached, _ceil_div(clen, self.block_size)):
+                self._bt_host[slot, bi] = res.take()
+            self._bt_dev = None
+            if cow:
+                self._cow_copy(cow_src, res.taken[0])
+                self.arena.deref(cow_src)
+                cow_src = None  # pin released: the copy is private now
+            if n_attached or cow:
+                nxt, new_pools = self._suffix_prefill_call(
+                    ctx, clen, prefix_len, slot)
+            else:
+                nxt, new_pools = self._full_prefill_call(ctx, clen, res)
+        except Exception:
+            # a failed admission must not leak capacity: drop the shared
+            # refs, return the private blocks, clear the slot's table row.
+            # (Under donation the pools may already be consumed — the
+            # engine is then dead and every later call fails loudly; the
+            # scheduler fails requests cleanly.)
+            for blk in shared:
+                self.arena.deref(blk)
+            if cow_src is not None:
+                self.arena.deref(cow_src)
             res.release()
             self._bt_host[slot, :] = 0
+            self._bt_dev = None
             raise
-        self._bt_dev = None
+        self.arena.set_pools(new_pools)
+
+        if cache is not None:
+            cache.note_hit(prefix_len if (n_attached or cow) else 0)
+            # make this prompt's freshly scattered FULL blocks shareable;
+            # the trailing partial block (still written mid-stream) and
+            # journal/generated tokens stay private to the slot
+            cache.insert(prompt, self._bt_host[slot],
+                         plen // self.block_size)
+            if n_attached or cow:
+                metrics.bump("tokens.prefill_avoided", prefix_len)
+
+        self._slot_res[slot] = res
+        self._slot_shared[slot] = shared
+        self._slot_filled[slot] = _ceil_div(clen, self.block_size)
+        self._positions[slot] = clen  # next write position
+        first = int(nxt)
+        self._last_tok[slot] = first
+        self._active[slot] = True
+        metrics.bump("engine.admits")
+        metrics.bump("tokens.prefill", clen - prefix_len)
+        metrics.bump("tokens.generated")  # the next token, out of prefill
+        self._refresh_gauges()
+        return slot, first
+
+    def _full_prefill_call(self, ctx: np.ndarray, clen: int,
+                           res: Reservation):
+        """Dispatch the whole-context bucketed prefill (the cache-miss and
+        cache-off path — byte-identical to the pre-cache engine)."""
+        import jax.numpy as jnp
 
         p_bucket = compile_cache.prefill_bucket(
             clen, self.max_model_len, self.prefill_bucket_min)
@@ -400,35 +646,35 @@ class ServingEngine:
         rows = np.zeros(mbp, np.int32)
         rows[:len(res.taken)] = res.taken
         fn = self._get_prefill(p_bucket)
-        try:
-            nxt, new_pools = self._call(
-                fn, self._arrays, jnp.asarray(ids), jnp.int32(clen),
-                self.arena.pools, jnp.asarray(rows), name="serving.prefill")
-        except Exception:
-            # a failed admission must not leak capacity: return the blocks
-            # and clear the slot's table row. (Under donation the pools may
-            # already be consumed — the engine is then dead and every later
-            # call fails loudly; the scheduler fails requests cleanly.)
-            res.release()
-            self._bt_host[slot, :] = 0
-            self._bt_dev = None
-            raise
-        self.arena.set_pools(new_pools)
+        return self._call(
+            fn, self._arrays, jnp.asarray(ids), jnp.int32(clen),
+            self.arena.pools, jnp.asarray(rows), name="serving.prefill")
 
-        self._slot_res[slot] = res
-        self._positions[slot] = clen  # next write position
-        first = int(nxt)
-        self._last_tok[slot] = first
-        self._active[slot] = True
-        metrics.bump("engine.admits")
-        metrics.bump("tokens.prefill", clen)
-        metrics.bump("tokens.generated")  # the next token, out of prefill
-        self._refresh_gauges()
-        return slot, first
+    def _suffix_prefill_call(self, ctx: np.ndarray, clen: int,
+                             prefix_len: int, slot: int):
+        """Dispatch the suffix-only prefill for a cache-hit admission:
+        only ``ctx[prefix_len:]`` runs through the model; the matched
+        prefix is attended via the slot's (already attached) block table."""
+        import jax.numpy as jnp
+
+        slen = clen - prefix_len
+        s_bucket = compile_cache.prefill_bucket(
+            slen, self.max_model_len, self.prefill_bucket_min)
+        ids = np.zeros((1, s_bucket), np.int32)
+        ids[0, :slen] = ctx[prefix_len:]
+        fn = self._get_prefix_prefill(s_bucket)
+        metrics.bump("prefix.suffix_prefills")
+        return self._call(
+            fn, self._arrays, jnp.asarray(ids), jnp.int32(slen),
+            jnp.int32(prefix_len), self.arena.pools,
+            jnp.asarray(self._bt_host[slot]), name="serving.prefix_prefill")
 
     def retire(self, slot: int) -> None:
-        """Free a slot: deactivate its lane and return its blocks to the
-        arena free list. Purely host-side state — never recompiles."""
+        """Free a slot: deactivate its lane, drop its shared-prefix
+        references (refcount--; a shared block returns to the free list
+        only when the last sharer lets go — or stays resident if the radix
+        cache holds it), and release its private blocks through the same
+        refcount layer. Purely host-side state — never recompiles."""
         if not self._active[slot]:
             return
         self._active[slot] = False
@@ -436,12 +682,32 @@ class ServingEngine:
         self._slot_res[slot] = None
         if res is not None:
             res.release()
+        for blk in self._slot_shared[slot]:
+            self.arena.deref(blk)
+        self._slot_shared[slot] = []
+        self._slot_filled[slot] = 0
         self._bt_host[slot, :] = 0
         self._bt_dev = None
         self._positions[slot] = 0
         self._last_tok[slot] = 0
         metrics.bump("engine.retires")
+        if flags.flag("serving_arena_invariants"):
+            self.check_invariants()
         self._refresh_gauges()
+
+    def check_invariants(self) -> None:
+        """Audit the refcount layer against the live slot tables: free
+        blocks must be refcount-zero/uncached, and each block's refcount
+        must equal the number of ACTIVE table entries referencing it
+        (shared prefixes may appear in several tables — but only as many
+        times as the refcount says). Gated behind
+        ``FLAGS_serving_arena_invariants`` on the release paths; callable
+        directly from tests."""
+        tables = []
+        for slot in np.flatnonzero(self._active):
+            n = int(self._slot_filled[slot])
+            tables.append([int(b) for b in self._bt_host[slot, :n]])
+        self.arena.check_invariants(tables)
 
     def rebuild(self) -> None:
         """Throw away the KV arena and every slot's runtime state and start
@@ -453,12 +719,25 @@ class ServingEngine:
         requests are re-prefilled from their journals by the supervisor.
         """
         self.arena = KVArena(*self._arena_args)
+        # the radix tree indexed the OLD arena's blocks: reset it with the
+        # fresh arena — journal replays re-populate it (and re-share) as
+        # they re-prefill. Lifetime counters carry over: stats()/close()
+        # summaries cover the engine's whole life, not just post-rebuild.
+        if self.use_prefix_cache:
+            old = self.prefix_cache
+            self.prefix_cache = PrefixCache(self.arena, self.block_size)
+            if old is not None:
+                for k in ("hits", "misses", "hit_tokens",
+                          "inserted_blocks", "evictions"):
+                    setattr(self.prefix_cache, k, getattr(old, k))
         self._bt_host[:] = 0
         self._bt_dev = None
         self._positions[:] = 0
         self._last_tok[:] = 0
         self._active[:] = False
         self._slot_res = [None] * self.num_slots
+        self._slot_shared = [[] for _ in range(self.num_slots)]
+        self._slot_filled[:] = 0
         metrics.bump("engine.rebuilds")
         metrics.set_gauge("arena.kv_bytes", self.arena.bytes_total())
         self._refresh_gauges()
@@ -473,12 +752,17 @@ class ServingEngine:
         import jax.numpy as jnp
 
         # grow block tables whose write position crossed a block boundary
-        # (the reservation guarantees take() cannot fail)
+        # (the reservation guarantees take() cannot fail). Growth compares
+        # against FILLED table entries — shared prefix blocks count, so a
+        # cache-hit slot grows past its attached prefix seamlessly, and
+        # decode never writes a shared block: the write position is always
+        # past the last full (sharable) block of the context.
         for slot in np.flatnonzero(self._active):
             res = self._slot_res[slot]
             bi = int(self._positions[slot]) // self.block_size
-            if bi >= len(res.taken):
+            if bi >= int(self._slot_filled[slot]):
                 self._bt_host[slot, bi] = res.take()
+                self._slot_filled[slot] = bi + 1
                 self._bt_dev = None
         if self._bt_dev is None:
             self._bt_dev = jnp.asarray(self._bt_host)
@@ -505,18 +789,27 @@ class ServingEngine:
         a = self.arena.stats()
         metrics.set_gauge("arena.blocks_free", a["blocks_free"])
         metrics.set_gauge("arena.blocks_total", a["blocks_total"])
-        # internal fragmentation: taken-block capacity minus live context
+        metrics.set_gauge("arena.blocks_cached", a["blocks_cached"])
+        metrics.set_gauge("arena.high_water", a["high_water"])
+        # internal fragmentation: filled-block capacity minus live context
         frag = 0
         for slot in np.flatnonzero(self._active):
-            res = self._slot_res[slot]
-            frag += len(res.taken) * self.block_size \
+            frag += int(self._slot_filled[slot]) * self.block_size \
                 - int(self._positions[slot])
         metrics.set_gauge("arena.frag_tokens", frag)
+        if self.prefix_cache is not None:
+            metrics.set_gauge("prefix.resident_blocks",
+                              self.prefix_cache.resident_blocks())
 
     def stats(self) -> dict:
         out = {"slots.total": self.num_slots,
                "slots.active": self.active_slots(),
                "decode_traces": self.decode_traces,
-               "prefill_traces": dict(self.prefill_traces)}
+               "prefill_traces": dict(self.prefill_traces),
+               "prefix_prefill_traces": dict(self.prefix_prefill_traces),
+               "cow_traces": self.cow_traces}
         out.update({f"arena.{k}": v for k, v in self.arena.stats().items()})
+        if self.prefix_cache is not None:
+            out.update({f"prefix.{k}": v
+                        for k, v in self.prefix_cache.stats().items()})
         return out

@@ -18,6 +18,19 @@ preemption/swap machinery needed), and :meth:`Reservation.take` converts one
 reserved block at a time into a physical block as the context actually
 crosses a block boundary.
 
+**Refcounted sharing** (the radix prefix cache,
+:mod:`paddle_tpu.serving.prefix_cache`): a physical block may be referenced
+by several slots' block tables at once — shared prompt prefixes attach the
+same block by reference instead of re-prefilling it. Every block therefore
+carries a refcount: ``take()`` starts it at 1, :meth:`ref` adds a sharer,
+:meth:`deref` drops one, and a block returns to the free list only at
+refcount zero — unless the prefix cache holds it resident
+(:meth:`mark_cached`), in which case it stays out of the free list at
+refcount zero as a best-effort cached prefix, reclaimed by LRU eviction
+only when :meth:`reserve` would otherwise fail. Shared blocks are
+read-only by contract; a slot that must write into one copies it first
+(copy-on-write, in the engine).
+
 Counters (``arena.*`` in ``serving.metrics``): allocs, frees, reuse (a taken
 block that had been used before — the free list working), alloc failures,
 high-water blocks in use.
@@ -113,6 +126,13 @@ class KVArena:
         self._reserved = 0
         self._ever_used: set = set()
         self._high_water = 0
+        # refcounted sharing (prefix cache): per-block reference counts,
+        # the set of blocks resident in the radix cache at refcount zero,
+        # and the cache itself (bound by PrefixCache.__init__) as the
+        # eviction authority reserve() turns to under pressure
+        self._refs: List[int] = [0] * self.num_blocks
+        self._cached: set = set()
+        self._cache = None
 
     # ------------------------------------------------------------- pools
 
@@ -133,18 +153,36 @@ class KVArena:
     def blocks_in_use(self) -> int:
         return (self.num_blocks - 1) - len(self._free)
 
+    def blocks_cached(self) -> int:
+        """Blocks resident in the prefix cache (in use, but reclaimable)."""
+        return len(self._cached)
+
     def grantable(self) -> int:
-        """Blocks a new reservation could claim right now (free minus the
-        untaken remainder of outstanding reservations)."""
-        return len(self._free) - self._reserved
+        """Blocks a new reservation could claim right now: the free list
+        minus the untaken remainder of outstanding reservations, plus
+        whatever the prefix cache could evict — cached prefixes are a
+        best-effort extension of the free list, never a competitor."""
+        n = len(self._free) - self._reserved
+        if self._cache is not None:
+            n += self._cache.evictable_blocks()
+        return n
 
     def can_reserve(self, n: int) -> bool:
         return self.grantable() >= n
 
     def reserve(self, n: int) -> Reservation:
-        """Claim a worst-case budget of ``n`` blocks (none taken yet)."""
+        """Claim a worst-case budget of ``n`` blocks (none taken yet).
+        When the free list alone cannot cover it, cold cached prefixes are
+        evicted (LRU leaves first) to make room — eviction happens only
+        here, where it would otherwise be an admission failure."""
         n = int(n)
-        if not self.can_reserve(n):
+        short = n - (len(self._free) - self._reserved)
+        if (short > 0 and self._cache is not None
+                and short <= self._cache.evictable_blocks()):
+            # feasibility first: a doomed reservation must not flush the
+            # cache on its way to raising anyway
+            self._cache.evict(short)
+        if len(self._free) - self._reserved < n:
             metrics.bump("arena.alloc_failed")
             raise ArenaExhaustedError(
                 f"cannot reserve {n} blocks "
@@ -158,6 +196,7 @@ class KVArena:
             raise ArenaExhaustedError("free list empty")
         blk = self._free.pop()
         self._reserved -= 1
+        self._refs[blk] = 1
         metrics.bump("arena.alloc")
         if blk in self._ever_used:
             metrics.bump("arena.reuse")
@@ -167,9 +206,92 @@ class KVArena:
 
     def _release(self, res: Reservation) -> None:
         self._reserved -= res.remaining()
-        self._free.extend(res.taken)
-        metrics.bump("arena.freed", len(res.taken))
+        for blk in res.taken:
+            self.deref(blk)
         res.taken = []
+
+    # --------------------------------------------------- refcount / cache
+
+    def bind_cache(self, cache) -> None:
+        """Adopt a :class:`~.prefix_cache.PrefixCache` as this arena's
+        eviction authority (called by the cache's constructor)."""
+        self._cache = cache
+
+    def refcount(self, blk: int) -> int:
+        return self._refs[blk]
+
+    def ref(self, blk: int) -> None:
+        """Attach one more reference to a live or cached block (a slot
+        sharing a resident prefix block)."""
+        if blk <= 0 or (self._refs[blk] == 0 and blk not in self._cached):
+            raise RuntimeError(
+                f"ref() on block {blk} which is neither live nor cached")
+        self._refs[blk] += 1
+        # only the 0 -> 1 transition can change evictability
+        if self._refs[blk] == 1 and self._cache is not None:
+            self._cache.invalidate()
+
+    def deref(self, blk: int) -> None:
+        """Drop one reference; at refcount zero the block returns to the
+        free list — unless the prefix cache holds it resident, in which
+        case it stays allocated (reclaimable by eviction) so its KV
+        content survives for future admissions to share."""
+        if self._refs[blk] <= 0:
+            raise RuntimeError(f"deref() on block {blk} with refcount 0 — "
+                               "double free in the caller's accounting")
+        self._refs[blk] -= 1
+        # only the 1 -> 0 transition can change evictability
+        if self._refs[blk] == 0 and self._cache is not None:
+            self._cache.invalidate()
+        if self._refs[blk] == 0 and blk not in self._cached:
+            self._free.append(blk)
+            metrics.bump("arena.freed")
+
+    def mark_cached(self, blk: int) -> None:
+        """The prefix cache took residency of ``blk``: at refcount zero it
+        is retained (not freed) until evicted."""
+        self._cached.add(blk)
+
+    def uncache(self, blk: int) -> None:
+        """The prefix cache evicted ``blk``: if no slot still references
+        it, it returns to the free list now."""
+        if blk not in self._cached:
+            raise RuntimeError(f"uncache() on block {blk} that is not "
+                               "cached — double eviction in the caller's "
+                               "accounting")
+        self._cached.discard(blk)
+        if self._refs[blk] == 0:
+            self._free.append(blk)
+            metrics.bump("arena.freed")
+
+    def check_invariants(self, tables=None) -> None:
+        """Audit the refcount layer (flag-gated; on in tests). Free-list
+        blocks must be refcount-zero and uncached; ``tables`` — an
+        iterable of per-slot block-id lists for ACTIVE slots — must
+        reference each block exactly ``refcount`` times (a block id in two
+        slots' tables is legal only when its refcount says so)."""
+        if len(self._free) != len(set(self._free)):
+            raise RuntimeError(
+                "invariant violated: duplicate block id on the free list")
+        for blk in self._free:
+            if self._refs[blk] != 0:
+                raise RuntimeError(
+                    f"invariant violated: free block {blk} has refcount "
+                    f"{self._refs[blk]}")
+            if blk in self._cached:
+                raise RuntimeError(
+                    f"invariant violated: free block {blk} is marked cached")
+        if tables is not None:
+            counts: dict = {}
+            for table in tables:
+                for blk in table:
+                    counts[blk] = counts.get(blk, 0) + 1
+            for blk, n in counts.items():
+                if blk != 0 and self._refs[blk] != n:
+                    raise RuntimeError(
+                        f"invariant violated: block {blk} appears in {n} "
+                        f"slot table entries but has refcount "
+                        f"{self._refs[blk]}")
 
     # ------------------------------------------------------------- stats
 
@@ -186,6 +308,7 @@ class KVArena:
             "blocks_free": self.blocks_free(),
             "blocks_in_use": self.blocks_in_use(),
             "blocks_reserved": self._reserved,
+            "blocks_cached": self.blocks_cached(),
             "high_water": self._high_water,
             "block_size": self.block_size,
             "kv_bytes": self.bytes_total(),

@@ -14,16 +14,23 @@ Counter namespaces:
 * ``tokens.*``     — ``generated`` (decode) and ``prefill`` (prompt) tokens
 * ``engine.*``     — steps, admits, retires, rebuilds, trace counts
 * ``arena.*``      — block allocs / frees / reuse / alloc failures
-* ``scheduler.*``  — ``preemptions`` (starvation-triggered victim evictions)
+* ``scheduler.*``  — ``preemptions`` (starvation-triggered victim
+  evictions), ``cache_skips`` (cache-affinity admissions past a cold head)
 * ``supervisor.*`` — ``rebuilds`` / ``replays`` (transient-failure recovery)
 * ``api.*``        — ``drains`` / ``drain_stragglers`` / ``guard_drains`` /
   ``recoveries`` (the mirror counters land in ``core.resilience`` as
   ``serving.*`` for the shared resilience dashboards)
+* ``prefix.*``     — the radix prefix cache: ``hits`` / ``misses`` /
+  ``hit_tokens`` (prefill tokens avoided, also ``tokens.prefill_avoided``)
+  / ``inserted_blocks`` / ``evictions`` / ``cow_copies`` /
+  ``suffix_prefills``
 
 Gauges: ``queue.depth``, ``slots.active``, ``slots.total``,
-``arena.blocks_free``, ``arena.blocks_total``, ``arena.kv_bytes``,
-``arena.frag_tokens`` (allocated-block capacity minus live context tokens —
-internal fragmentation of the paged cache), ``tokens_per_sec`` (the engine's
+``arena.blocks_free``, ``arena.blocks_total``, ``arena.blocks_cached``
+(resident prefix blocks — in use but reclaimable), ``arena.high_water``,
+``arena.kv_bytes``, ``arena.frag_tokens`` (allocated-block capacity minus
+live context tokens — internal fragmentation of the paged cache),
+``prefix.resident_blocks``, ``tokens_per_sec`` (the engine's
 lifetime-aggregate decode rate from its :class:`Meter`).
 """
 from __future__ import annotations
@@ -57,6 +64,13 @@ def stats() -> dict:
         out: dict = dict(_counts)
         out.update(_gauges)
     return out
+
+
+def gauges() -> dict:
+    """Gauges-only snapshot (point-in-time state — occupancy, residency —
+    that a delta report must NOT difference)."""
+    with _lock:
+        return dict(_gauges)
 
 
 def reset_stats() -> None:
@@ -109,6 +123,9 @@ def _register_providers() -> None:
                 ("serving.requests_finished", "requests.finished", _counts),
                 ("serving.requests_shed", "requests.shed", _counts),
                 ("serving.tokens_per_sec", "tokens_per_sec", _gauges),
+                ("serving.prefix_hit_tokens", "prefix.hit_tokens", _counts),
+                ("serving.prefix_resident_blocks",
+                 "prefix.resident_blocks", _gauges),
                 ("serving.queue_depth", "queue.depth", _gauges),
                 ("serving.slots_active", "slots.active", _gauges),
                 ("serving.arena_blocks_free", "arena.blocks_free", _gauges),

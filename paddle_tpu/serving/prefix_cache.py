@@ -1,0 +1,305 @@
+"""Radix prefix cache: content-addressed KV block sharing for the arena.
+
+At serving scale most traffic shares prompt *prefixes* — system prompts,
+few-shot examples, chat history replayed every turn. The paged arena
+(:mod:`paddle_tpu.serving.kv_arena`) already stores KV state at block
+granularity, which is exactly the unit a prefix cache wants: a prompt's KV
+is a *chain* of full blocks, and two prompts that agree on their first
+``k * block_size`` tokens can share the same ``k`` physical blocks.
+
+This module is the tree over those chains:
+
+* **Nodes are block-granular token chunks.** A node's key is the content
+  hash of ``(parent_key, chunk_tokens)``, so a chunk is only ever equal to
+  another chunk *in the same left context* — block 2 of prompt A never
+  collides with block 2 of prompt B unless blocks 0..1 matched too. Only
+  FULL blocks are inserted; the trailing partial block of a prompt is
+  private to its slot (it is still being written mid-stream).
+* **Matching is admission's tree walk.** ``match(prompt)`` returns the
+  longest chain of resident full blocks. The engine attaches each matched
+  block to the slot's block table *by reference* (``KVArena.ref`` — the
+  refcount layer this cache motivated) and prefills only the unmatched
+  suffix. Shared blocks are read-only by contract; if a slot must write
+  into one (a fully-cached, block-aligned prompt recomputing its last
+  token for logits), the engine copies it first (copy-on-write).
+* **Insertion is the other half of admission.** After the suffix prefill
+  scatters fresh KV, the request's full *prompt* blocks are inserted:
+  ``arena.mark_cached`` keeps them off the free list when the slot later
+  retires (refcount zero + cached = resident, not leaked).
+* **Eviction is LRU over leaves with refcount zero**, triggered only when
+  ``KVArena.reserve`` would otherwise fail — cached prefixes are a
+  best-effort extension of the free list, never competition for live
+  traffic. Evicting a leaf can expose its parent as the next candidate, so
+  a cold chain unwinds from the tail exactly as it was built.
+
+Counters (``prefix.*`` in ``serving.metrics``): ``hits`` (admissions with
+at least one matched block), ``misses``, ``hit_tokens`` (prefill tokens
+avoided), ``inserted_blocks``, ``evictions``, ``cow_copies`` (bumped by
+the engine), and the ``resident_blocks`` gauge.
+"""
+from __future__ import annotations
+
+import hashlib
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from . import metrics
+
+_ROOT_KEY = b"prefix-cache-root"
+
+
+def _chunk_key(parent_key: bytes, chunk: np.ndarray) -> bytes:
+    """Content hash of one block-granular chunk *in its left context*:
+    keyed by (parent hash, token bytes) so equal chunks under different
+    prefixes never alias."""
+    h = hashlib.blake2b(parent_key, digest_size=16)
+    h.update(np.ascontiguousarray(chunk, np.int32).tobytes())
+    return h.digest()
+
+
+class PrefixNode:
+    """One resident full block: its chunk's tokens, the physical arena
+    block holding the chunk's K/V, and its place in the tree."""
+
+    __slots__ = ("key", "chunk", "block", "parent", "children", "last_use")
+
+    def __init__(self, key: bytes, chunk: np.ndarray, block: int,
+                 parent: Optional["PrefixNode"]):
+        self.key = key
+        self.chunk = chunk
+        self.block = block
+        self.parent = parent
+        self.children: Dict[bytes, "PrefixNode"] = {}
+        self.last_use = 0
+
+
+class PrefixCache:
+    """The radix tree over one :class:`~.kv_arena.KVArena`'s blocks.
+
+    Single-threaded by contract (the scheduler/engine serialize admission
+    under the API lock). The cache holds no jax state — blocks live in the
+    arena's pools; this is pure host-side bookkeeping, so a cache hit is
+    just different int32 rows in a slot's block table and can never add a
+    compile."""
+
+    def __init__(self, arena, block_size: Optional[int] = None):
+        self.arena = arena
+        self.block_size = int(block_size or arena.block_size)
+        self._root = PrefixNode(_ROOT_KEY, np.zeros(0, np.int32), -1, None)
+        self._nodes: Dict[bytes, PrefixNode] = {}
+        self._tick = 0
+        self._evictable_memo: Optional[int] = None
+        # per-instance lifetime counters (serving.metrics is process-global)
+        self.hits = 0
+        self.misses = 0
+        self.hit_tokens = 0
+        self.inserted_blocks = 0
+        self.evictions = 0
+        arena.bind_cache(self)
+
+    # ------------------------------------------------------------- walking
+
+    def _walk(self, tokens: np.ndarray) -> List[PrefixNode]:
+        """Longest chain of resident FULL blocks matching ``tokens``."""
+        bs = self.block_size
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        out: List[PrefixNode] = []
+        node = self._root
+        for i in range(int(tokens.shape[0]) // bs):
+            chunk = tokens[i * bs:(i + 1) * bs]
+            child = node.children.get(_chunk_key(node.key, chunk))
+            if child is None:
+                break
+            out.append(child)
+            node = child
+        return out
+
+    def _walk_keys(self, keys: List[bytes]) -> List[PrefixNode]:
+        """:meth:`_walk` over a precomputed :meth:`chunk_keys` chain —
+        hash-free, for callers probing residency every scheduler step."""
+        out: List[PrefixNode] = []
+        node = self._root
+        for k in keys:
+            child = node.children.get(k)
+            if child is None:
+                break
+            out.append(child)
+            node = child
+        return out
+
+    def lookup(self, tokens) -> int:
+        """Non-mutating: how many TOKENS of ``tokens`` are resident as full
+        blocks right now (admission sizing / cache-affinity scheduling)."""
+        return len(self._walk(tokens)) * self.block_size
+
+    def match_stats(self, tokens=None, keys: Optional[List[bytes]] = None):
+        """One walk, both admission-sizing numbers: (matched full blocks,
+        matched blocks at refcount zero). The latter matters because
+        ``grantable()`` counts refcount-zero cached blocks as eviction
+        headroom, but an admission of these very tokens pins them
+        (``arena.ref``) before it reserves — feasibility checks must
+        subtract them, or ``reserve()`` can fail after ``can_admit`` said
+        yes. Pass precomputed ``keys`` (:meth:`chunk_keys`) to skip
+        hashing."""
+        chain = self._walk_keys(keys) if keys is not None \
+            else self._walk(tokens)
+        unpinned = sum(1 for n in chain
+                       if self.arena.refcount(n.block) == 0)
+        return len(chain), unpinned
+
+    def chunk_keys(self, tokens) -> List[bytes]:
+        """The content-key chain of ``tokens``' full blocks — a pure
+        function of the tokens (independent of tree state), so callers
+        polling residency every scheduler step can hash once and reuse."""
+        bs = self.block_size
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        keys: List[bytes] = []
+        parent = _ROOT_KEY
+        for i in range(int(tokens.shape[0]) // bs):
+            parent = _chunk_key(parent, tokens[i * bs:(i + 1) * bs])
+            keys.append(parent)
+        return keys
+
+    def resident_tokens_for(self, keys: List[bytes]) -> int:
+        """``lookup()`` over a precomputed :meth:`chunk_keys` chain."""
+        return len(self._walk_keys(keys)) * self.block_size
+
+    def match(self, tokens) -> List[PrefixNode]:
+        """The admission walk: returns the matched chain and touches each
+        node's LRU clock. The caller (engine) takes the references
+        (``arena.ref``) — splitting touch from ref keeps this reusable for
+        sizing probes that never attach."""
+        chain = self._walk(tokens)
+        self._tick += 1
+        for node in chain:
+            node.last_use = self._tick
+        return chain
+
+    # ----------------------------------------------------------- insertion
+
+    def insert(self, tokens, blocks, num_blocks: int) -> int:
+        """Insert the first ``num_blocks`` full chunks of ``tokens``, whose
+        K/V was just scattered into physical ``blocks[i]``. Chunks already
+        resident are skipped (the existing block stays authoritative — the
+        caller's copy remains private to its slot and is freed at retire).
+        Returns how many blocks were newly inserted."""
+        bs = self.block_size
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        node = self._root
+        self._tick += 1
+        inserted = 0
+        for i in range(num_blocks):
+            chunk = tokens[i * bs:(i + 1) * bs]
+            key = _chunk_key(node.key, chunk)
+            child = node.children.get(key)
+            if child is None:
+                child = PrefixNode(key, np.array(chunk), int(blocks[i]), node)
+                node.children[key] = child
+                self._nodes[key] = child
+                self.arena.mark_cached(child.block)
+                inserted += 1
+            child.last_use = self._tick
+            node = child
+        if inserted:
+            self.invalidate()
+            self.inserted_blocks += inserted
+            metrics.bump("prefix.inserted_blocks", inserted)
+            metrics.set_gauge("prefix.resident_blocks", len(self._nodes))
+        return inserted
+
+    # ------------------------------------------------------------ eviction
+
+    def _evictable_leaves(self) -> List[PrefixNode]:
+        return [n for n in self._nodes.values()
+                if not n.children and self.arena.refcount(n.block) == 0]
+
+    def invalidate(self) -> None:
+        """Drop the memoized evictable count (called by the arena on every
+        refcount/residency transition and by insert/evict)."""
+        self._evictable_memo = None
+
+    def evictable_blocks(self) -> int:
+        """Blocks reclaimable by (possibly cascading) eviction: nodes whose
+        entire subtree is refcount-zero. This is what the arena adds to
+        ``grantable()`` — cached prefixes extend the free list. Memoized
+        between refcount/tree transitions: admission probes hit this once
+        per scheduler pass per waiter, and the tree walk is O(resident)."""
+        if self._evictable_memo is not None:
+            return self._evictable_memo
+        n = 0
+        stack = list(self._root.children.values())
+        # a node is reclaimable iff nothing below it is pinned by a slot
+        blocked: Dict[bytes, bool] = {}
+        order: List[PrefixNode] = []
+        while stack:
+            node = stack.pop()
+            order.append(node)
+            stack.extend(node.children.values())
+        for node in reversed(order):  # children before parents
+            pinned = self.arena.refcount(node.block) > 0 or any(
+                blocked[c.key] for c in node.children.values())
+            blocked[node.key] = pinned
+            if not pinned:
+                n += 1
+        self._evictable_memo = n
+        return n
+
+    def evict(self, need: int) -> int:
+        """Free up to ``need`` blocks, LRU leaves first (evicting a leaf
+        may expose its parent). Returns blocks actually freed; the arena
+        calls this from ``reserve()`` when the free list alone cannot
+        cover a budget. The candidate set is scanned once and maintained
+        incrementally (a victim's parent joins when its last child goes),
+        not rebuilt per freed block."""
+        freed = 0
+        leaves = {n.key: n for n in self._evictable_leaves()}
+        while freed < need and leaves:
+            victim = min(leaves.values(), key=lambda n: n.last_use)
+            del leaves[victim.key]
+            parent = victim.parent
+            self._remove(victim)
+            freed += 1
+            if (parent is not self._root and not parent.children
+                    and self.arena.refcount(parent.block) == 0):
+                leaves[parent.key] = parent
+        if freed:
+            self.evictions += freed
+            metrics.bump("prefix.evictions", freed)
+            metrics.set_gauge("prefix.resident_blocks", len(self._nodes))
+        return freed
+
+    def _remove(self, node: PrefixNode) -> None:
+        assert not node.children, "only leaves are evicted"
+        node.parent.children.pop(node.key, None)
+        self._nodes.pop(node.key, None)
+        self.invalidate()
+        self.arena.uncache(node.block)
+
+    # --------------------------------------------------------------- admin
+
+    def resident_blocks(self) -> int:
+        return len(self._nodes)
+
+    def note_hit(self, matched_tokens: int) -> None:
+        """Engine callback after a successful shared admission (counted on
+        success, not at walk time, so a failed prefill is not a 'hit')."""
+        if matched_tokens > 0:
+            self.hits += 1
+            self.hit_tokens += matched_tokens
+            metrics.bump("prefix.hits")
+            metrics.bump("prefix.hit_tokens", matched_tokens)
+        else:
+            self.misses += 1
+            metrics.bump("prefix.misses")
+
+    def stats(self) -> dict:
+        return {
+            "resident_blocks": len(self._nodes),
+            "evictable_blocks": self.evictable_blocks(),
+            "hits": self.hits,
+            "misses": self.misses,
+            "hit_tokens": self.hit_tokens,
+            "inserted_blocks": self.inserted_blocks,
+            "evictions": self.evictions,
+        }

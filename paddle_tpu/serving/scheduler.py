@@ -23,6 +23,14 @@ The scheduler owns the policy half of that loop:
   re-admission re-prefills prompt+generated-so-far into fresh blocks and
   resumes token-for-token (prefill buckets and the slot step treat all of
   this as runtime data — no recompile).
+* **Cache-aware admission** (``FLAGS_serving_cache_affinity``) — with the
+  radix prefix cache on, a same-priority waiter whose prompt prefix is
+  resident may be admitted ahead of a cache-cold head (its matched
+  prefill is free), but only within a bounded skip window so strict
+  FCFS/priority order is never starved: after W skips the head is served
+  regardless. Admission capacity itself is cache-aware too — a request
+  whose prefix is resident reserves only its suffix's blocks
+  (``ServingEngine.admit_blocks_needed``).
 * **Finish detection** at every step boundary: stop-token hit, token
   budget, cancellation, and per-request wall-clock deadlines
   (``core.resilience.Deadline``).
@@ -89,6 +97,8 @@ class Request:        # compare numpy prompt payloads
     _arrival: int = 0     # submit-order tick (priority tie-break)
     _admit_seq: int = 0   # last admission tick ("most recent victim")
     _starved: int = 0     # consecutive steps blocked at the queue head
+    _cache_skips: int = 0  # times cache-affinity admitted someone past us
+    _prefix_keys: Optional[list] = None  # memoized radix chunk-key chain
     preemptions: int = 0  # times this request was preempted mid-decode
 
     def __post_init__(self):
@@ -205,6 +215,56 @@ class Scheduler:
             return None
         return min(self.waiting, key=lambda r: (r.priority, r._arrival))
 
+    def _keys_for(self, r: Request):
+        """Memoized radix chunk-key chain for a request's prompt: a pure
+        function of the tokens, hashed once at first probe and reused by
+        every later residency/feasibility poll (they run per pump step)."""
+        if r._prefix_keys is None:
+            r._prefix_keys = self.engine.prefix_cache.chunk_keys(r.prompt)
+        return r._prefix_keys
+
+    def _cache_preferred(self, head: Request) -> Request:
+        """Cache-aware admission (``FLAGS_serving_cache_affinity`` = W > 0):
+        prefer a SAME-priority waiter whose prompt prefix is resident in
+        the engine's radix cache over a cache-cold head — a warm admission
+        skips its matched prefill entirely, so serving it first is nearly
+        free capacity. Strictly bounded: the head may be skipped at most W
+        times (each skip is counted on the head), priorities are never
+        crossed, and a head that is itself warm — or not even admissible —
+        is never skipped. With the window spent, admission is the exact
+        (priority, arrival) order of PR 5."""
+        window = int(flags.flag("serving_cache_affinity"))
+        if window <= 0 or head._cache_skips >= window:
+            return head
+        engine = self.engine
+        if getattr(engine, "prefix_cache", None) is None:
+            return head
+        if engine.free_slots() == 0:
+            return head  # nothing can be admitted: skip the radix walks
+        cache = engine.prefix_cache
+        if cache.resident_tokens_for(self._keys_for(head)) > 0:
+            return head  # the head is warm: no reason to skip it
+        if not engine.can_admit(int(head.prompt.shape[0]),
+                                int(head.max_new_tokens),
+                                keys=self._keys_for(head)):
+            # a capacity-blocked head belongs to the starvation/preemption
+            # machinery — skipping it would burn its bounded window on
+            # passes where it could not have been admitted anyway
+            return head
+        best, best_tokens = head, 0
+        for r in self.waiting:
+            if r is head or r.priority != head.priority:
+                continue
+            tokens = cache.resident_tokens_for(self._keys_for(r))
+            if tokens > best_tokens and engine.can_admit(
+                    int(r.prompt.shape[0]), int(r.max_new_tokens),
+                    keys=self._keys_for(r)):
+                best, best_tokens = r, tokens
+        if best is not head:
+            head._cache_skips += 1
+            metrics.bump("scheduler.cache_skips")
+        return best
+
     def _preempt_for(self, waiter: Request) -> bool:
         """Preempt the lowest-priority, most-recently-admitted running
         request that is STRICTLY lower-priority than ``waiter``; the victim
@@ -218,10 +278,17 @@ class Scheduler:
         candidates = [r for r in self.running if r.priority > waiter.priority]
         if not candidates:
             return False
-        need = self.engine.blocks_needed(int(waiter.prompt.shape[0]),
-                                         int(waiter.max_new_tokens))
-        reclaimable = self.engine.arena.grantable() + sum(
-            self.engine.reserved_blocks(r.slot) for r in candidates)
+        # feasibility must use the same cache-aware sizing as admission:
+        # a waiter with a resident prefix reserves only its suffix, so the
+        # worst-case blocks_needed() would decline preemptions that the
+        # very next can_admit() would in fact grant
+        cache_on = getattr(self.engine, "prefix_cache", None) is not None
+        need, pinned = self.engine.admit_sizing(
+            int(waiter.prompt.shape[0]), int(waiter.max_new_tokens),
+            keys=self._keys_for(waiter) if cache_on else None)
+        reclaimable = (self.engine.arena.grantable() - pinned
+                       + sum(self.engine.reserved_blocks(r.slot)
+                             for r in candidates))
         if reclaimable < need:
             return False
         victim = max(candidates, key=lambda r: (r.priority, r._admit_seq))
@@ -263,8 +330,11 @@ class Scheduler:
             req = self._next_waiter()
             if req is None:
                 break
-            if not self.engine.can_admit(int(req.prompt.shape[0]),
-                                         int(req.max_new_tokens)):
+            req = self._cache_preferred(req)
+            cache_on = getattr(self.engine, "prefix_cache", None) is not None
+            if not self.engine.can_admit(
+                    int(req.prompt.shape[0]), int(req.max_new_tokens),
+                    keys=self._keys_for(req) if cache_on else None):
                 # the head waiter is capacity-blocked: count starvation
                 # once per step, then preempt one victim per pass until it
                 # fits or no strictly-lower-priority victim remains

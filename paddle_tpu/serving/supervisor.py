@@ -16,14 +16,20 @@ recovery loop:
    raises). Everything else (bugs, validation, deadlines) keeps the
    fail-fast path.
 2. **Rebuild** — ``ServingEngine.rebuild()`` drops the (possibly corrupt or
-   donation-consumed) KV arena and resets all slot state. Compiled programs
-   depend only on shapes, so the rebuilt engine serves with ZERO recompiles.
+   donation-consumed) KV arena and resets all slot state — including the
+   radix prefix tree, which indexed the dead arena's blocks. Compiled
+   programs depend only on shapes, so the rebuilt engine serves with ZERO
+   recompiles.
 3. **Replay** — every live request is re-prefilled from its journal
    (``engine.admit(prompt, max_new, tokens=...)``): the prefill runs over
    ``prompt + tokens`` and emits the journal's next token, leaving the slot
    exactly where an uninterrupted decode would be. Output is
    token-for-token identical (prefill and decode share one numerics
-   contract — ``models.gpt.masked_attention`` / ``_head_logits``).
+   contract — ``models.gpt.masked_attention`` / ``_head_logits``). With
+   the prefix cache on, each replayed admission re-inserts its prompt's
+   full blocks, so replays that share a prefix re-attach the SAME fresh
+   blocks by reference — the tree re-populates as a side effect of
+   recovery, with the same refcount discipline as live traffic.
 4. **Break the crash loop** — ``FLAGS_serving_max_rebuilds`` rebuilds within
    ``FLAGS_serving_rebuild_window`` scheduler steps open the breaker:
    further transient failures degrade to fail-fast with a

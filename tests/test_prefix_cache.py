@@ -1,0 +1,361 @@
+"""Radix prefix cache (ISSUE 6): content-addressed KV block sharing with
+refcounted copy-on-write in the serving arena.
+
+Unit half: the radix tree (content hashing, left-context keying, LRU leaf
+eviction) and the arena's refcount layer (deref-to-free, cache residency,
+eviction under reserve pressure, the flag-gated invariant audit) — pure
+host-side, no compiles. Engine half: the tier-1 acceptance regressions —
+a two-request shared-prefix admit does exactly ONE suffix-bucket prefill
+and zero extra decode compiles, copy-on-write on a fully-cached
+block-aligned prompt, eviction under arena pressure, bounded cache-affinity
+admission, and token-for-token parity with ``generate()`` throughout.
+
+Engine tests pin the cache per-instance (``prefix_cache=True`` engine
+kwarg) rather than flipping the global flag, so the rest of the suite —
+which must pass byte-identically with ``FLAGS_serving_prefix_cache=0`` —
+is never affected by ordering. The refcount audit flag is enabled for the
+whole module: every retire path in these tests self-checks.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core import compile_cache
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.models.gpt import GPTForCausalLM, gpt_tiny
+from paddle_tpu.serving import (
+    ArenaExhaustedError,
+    KVArena,
+    PrefixCache,
+    RequestState,
+    ServingAPI,
+)
+from paddle_tpu.serving import metrics as serving_metrics
+
+pytestmark = pytest.mark.serving
+
+MAX_LEN = 64
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _invariants_on():
+    keep = paddle.get_flags(
+        "serving_arena_invariants")["serving_arena_invariants"]
+    paddle.set_flags({"serving_arena_invariants": 1})
+    yield
+    paddle.set_flags({"serving_arena_invariants": keep})
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(0)
+    m = GPTForCausalLM(gpt_tiny())
+    m.eval()
+    return m
+
+
+@pytest.fixture(scope="module")
+def api(model):
+    a = ServingAPI(model, num_slots=4, kv_block_size=8, max_model_len=MAX_LEN,
+                   prefix_cache=True)
+    yield a
+    a.close()
+
+
+def _prompt(rng, n):
+    return rng.integers(0, 1024, (n,), dtype=np.int32)
+
+
+def _ref(model, prompt, max_new, stop=None):
+    out = model.generate(Tensor(np.asarray(prompt)[None]),
+                         max_new_tokens=max_new, stop_token_id=stop)
+    return np.asarray(out._data)[0]
+
+
+# ------------------------------------------------------------- tree units
+
+
+def _arena(num_blocks=12, block_size=4):
+    return KVArena(num_layers=1, num_heads=2, head_dim=4,
+                   num_blocks=num_blocks, block_size=block_size)
+
+
+def _take(arena, n):
+    res = arena.reserve(n)
+    return res, [res.take() for _ in range(n)]
+
+
+def test_radix_content_hash_keys_on_left_context():
+    """Equal chunks under different prefixes never alias: block 1 of
+    prompt A is a different node than the same tokens as block 1 of B."""
+    arena = _arena()
+    cache = PrefixCache(arena)
+    rng = np.random.default_rng(0)
+    a = rng.integers(0, 100, (12,), dtype=np.int32)   # 3 full chunks of 4
+    res, blocks = _take(arena, 3)
+    assert cache.insert(a, blocks, 3) == 3
+    assert cache.lookup(a) == 12
+    assert cache.lookup(a[:9]) == 8    # partial trailing chunk not matched
+    assert cache.lookup(a[:4]) == 4
+    # same middle chunk under a different first chunk: no match at all
+    b = np.concatenate([a[:4] + 1, a[4:8]])
+    assert cache.lookup(b) == 0
+    # re-inserting resident chunks is a no-op (existing stays authoritative)
+    res2, blocks2 = _take(arena, 3)
+    assert cache.insert(a, blocks2, 3) == 0
+    assert cache.resident_blocks() == 3
+    res2.release()
+    res.release()
+
+
+def test_refcounted_release_keeps_cached_blocks_resident():
+    """deref at refcount zero frees — unless the prefix cache holds the
+    block, in which case it stays allocated (reclaimable, not leaked)."""
+    arena = _arena(num_blocks=6)
+    cache = PrefixCache(arena)
+    res, (blk,) = _take(arena, 1)
+    assert arena.refcount(blk) == 1
+    arena.mark_cached(blk)
+    res.release()
+    assert arena.refcount(blk) == 0
+    assert blk not in arena._free          # resident, NOT freed
+    assert arena.blocks_cached() == 1
+    # a sharer can re-reference a cached block; the free path waits for it
+    arena.ref(blk)
+    arena.uncache(blk)
+    assert blk not in arena._free          # still referenced
+    arena.deref(blk)
+    assert blk in arena._free              # last ref gone -> free list
+    # double-free and ref-of-free are loud bugs, not silent corruption
+    with pytest.raises(RuntimeError, match="refcount 0"):
+        arena.deref(blk)
+    with pytest.raises(RuntimeError, match="neither live nor cached"):
+        arena.ref(blk)
+    del cache
+
+
+def test_reserve_pressure_evicts_lru_leaves():
+    """reserve() beyond the free list evicts refcount-zero LRU leaves —
+    cached prefixes extend the free list; pinned blocks never move."""
+    arena = _arena(num_blocks=7, block_size=4)  # 6 allocatable
+    cache = PrefixCache(arena)
+    rng = np.random.default_rng(1)
+    old = rng.integers(0, 100, (8,), dtype=np.int32)
+    new = rng.integers(100, 200, (8,), dtype=np.int32)
+    res_a, blocks_a = _take(arena, 2)
+    cache.insert(old, blocks_a, 2)
+    res_a.release()
+    res_b, blocks_b = _take(arena, 2)
+    cache.insert(new, blocks_b, 2)      # touched later -> more recent
+    res_b.release()
+    assert arena.blocks_free() == 2 and arena.blocks_cached() == 4
+    assert arena.grantable() == 6       # evictable counts as grantable
+    res = arena.reserve(4)              # needs 2 evictions
+    assert cache.evictions == 2
+    # LRU: the OLD chain went first, leaf (chunk 1) before its parent
+    assert cache.lookup(old) == 0
+    assert cache.lookup(new) == 8
+    res.release()
+    # pinned blocks are not evictable even at the leaf
+    chain = cache.match(new)
+    arena.ref(chain[-1].block)
+    assert cache.evictable_blocks() == 0  # leaf pinned -> parent blocked too
+    with pytest.raises(ArenaExhaustedError):
+        arena.reserve(5)
+    arena.deref(chain[-1].block)
+    assert cache.evictable_blocks() == 2
+
+
+def test_invariant_checker_catches_corruption():
+    arena = _arena(num_blocks=6)
+    res, blocks = _take(arena, 2)
+    arena.check_invariants([list(blocks)])
+    # a block in two tables with refcount 1 is a sharing-accounting bug
+    with pytest.raises(RuntimeError, match="appears in 2"):
+        arena.check_invariants([[blocks[0]], [blocks[0]]])
+    arena.ref(blocks[0])
+    arena.check_invariants([[blocks[0]], [blocks[0], blocks[1]]])
+    arena.deref(blocks[0])
+    res.release()
+    arena.check_invariants([])
+    # a freed block with a nonzero refcount is a double-accounting bug
+    arena._refs[blocks[0]] = 1
+    with pytest.raises(RuntimeError, match="free block"):
+        arena.check_invariants([])
+
+
+# -------------------------------------------------- engine: tier-1 gates
+
+
+def test_shared_prefix_single_suffix_prefill_no_new_decode_compiles(
+        api, model):
+    """ISSUE 6 tier-1 regression: the second of two requests sharing a
+    full-block prefix admits with exactly ONE suffix-bucket prefill and
+    zero extra decode compiles — and both outputs are token-for-token
+    identical to generate()."""
+    rng = np.random.default_rng(10)
+    shared = _prompt(rng, 24)  # 3 full blocks at kv_block_size=8
+    p1 = np.concatenate([shared, _prompt(rng, 5)])
+    p2 = np.concatenate([shared, _prompt(rng, 7)])
+    r1 = api.submit(p1, max_new_tokens=6)
+    api.run_until_idle()
+    d0 = api.engine.decode_traces
+    cc0 = compile_cache.stats().get("serving.decode_compiles", 0)
+    sp0 = serving_metrics.stats().get("prefix.suffix_prefills", 0)
+    av0 = serving_metrics.stats().get("tokens.prefill_avoided", 0)
+    r2 = api.submit(p2, max_new_tokens=6)
+    api.run_until_idle()
+    for p, r in ((p1, r1), (p2, r2)):
+        assert r.state == RequestState.FINISHED
+        np.testing.assert_array_equal(r.output_ids(), _ref(model, p, 6))
+    # exactly one suffix-bucket prefill ran for the whole second admission
+    assert serving_metrics.stats().get("prefix.suffix_prefills", 0) \
+        == sp0 + 1
+    # the 3 shared blocks' 24 tokens never touched a prefill program
+    assert serving_metrics.stats().get("tokens.prefill_avoided", 0) \
+        == av0 + 24
+    # and nothing recompiled: block tables are runtime data
+    assert api.engine.decode_traces == d0
+    assert compile_cache.stats().get("serving.decode_compiles", 0) == cc0
+    assert all(v == 1 for v in api.engine.prefix_prefill_traces.values())
+    api.engine.check_invariants()
+
+
+def test_cow_on_fully_cached_aligned_prompt(api, model):
+    """A block-aligned prompt whose every block is resident admits by
+    copying its last matched block (COW) and recomputing only the final
+    token — shared blocks are never written, output parity holds, and
+    repeating the hit reuses the one compiled COW program."""
+    rng = np.random.default_rng(11)
+    p = _prompt(rng, 16)  # exactly 2 blocks
+    r1 = api.submit(p, max_new_tokens=4)  # cold: inserts both blocks
+    api.run_until_idle()
+    cow0 = serving_metrics.stats().get("prefix.cow_copies", 0)
+    ct0 = api.engine.cow_traces
+    r2 = api.submit(p, max_new_tokens=4)  # fully cached -> COW path
+    api.run_until_idle()
+    ref = _ref(model, p, 4)
+    np.testing.assert_array_equal(r1.output_ids(), ref)
+    np.testing.assert_array_equal(r2.output_ids(), ref)
+    assert serving_metrics.stats().get("prefix.cow_copies", 0) == cow0 + 1
+    r3 = api.submit(p, max_new_tokens=4)  # hit again: no recompile
+    api.run_until_idle()
+    np.testing.assert_array_equal(r3.output_ids(), ref)
+    assert serving_metrics.stats().get("prefix.cow_copies", 0) == cow0 + 2
+    assert api.engine.cow_traces == max(ct0, 1)  # traced at most once ever
+    api.engine.check_invariants()
+
+
+def test_eviction_under_arena_pressure_end_to_end(model):
+    """Resident prefixes never block live traffic: when an admission's
+    reservation exceeds the free list, cold cached blocks are evicted
+    (LRU) and the request completes with full parity."""
+    a = ServingAPI(model, num_slots=2, kv_block_size=8, max_model_len=48,
+                   num_blocks=7, prefix_cache=True)  # 6 allocatable
+    try:
+        rng = np.random.default_rng(12)
+        pa = _prompt(rng, 16)
+        ra = a.submit(pa, max_new_tokens=8)  # 3 blocks; inserts 2
+        a.run_until_idle()
+        assert a.engine.arena.blocks_cached() == 2
+        ev0 = serving_metrics.stats().get("prefix.evictions", 0)
+        pb = _prompt(rng, 24)
+        rb = a.submit(pb, max_new_tokens=16)  # needs 5 of 6 blocks
+        a.run_until_idle()
+        assert rb.state == RequestState.FINISHED
+        np.testing.assert_array_equal(rb.output_ids(), _ref(model, pb, 16))
+        assert serving_metrics.stats().get("prefix.evictions", 0) > ev0
+        np.testing.assert_array_equal(ra.output_ids(), _ref(model, pa, 8))
+        a.engine.check_invariants()
+    finally:
+        a.close()
+
+
+def test_can_admit_never_spends_own_matched_blocks_as_eviction_headroom(
+        model):
+    """A request whose matched prefix is resident at refcount zero pins
+    those blocks (ref before reserve) when admitted — so can_admit() must
+    not count them as evictable headroom. Double-counting made can_admit
+    say yes, admit() raise ArenaExhaustedError, and the scheduler FAIL a
+    request that should simply have waited for capacity."""
+    a = ServingAPI(model, num_slots=2, kv_block_size=8, max_model_len=48,
+                   num_blocks=7, prefix_cache=True)  # 6 allocatable
+    try:
+        rng = np.random.default_rng(21)
+        pa = _prompt(rng, 24)
+        ra = a.submit(pa, max_new_tokens=8)   # 4 blocks; caches 3
+        a.run_until_idle()
+        assert a.engine.arena.blocks_cached() == 3
+        pb = _prompt(rng, 8)
+        rb = a.submit(pb, max_new_tokens=16)  # reserves the other 3
+        a._pump_once()
+        assert rb.state == RequestState.RUNNING
+        pc = np.concatenate([pa, _prompt(rng, 8)])  # matched prefix = 3
+        eng = a.engine
+        need = eng.admit_blocks_needed(32, 8, prompt=pc)
+        # grantable alone (free + evictable) would cover the suffix need —
+        # exactly the double-count: the 3 evictable blocks ARE the match
+        assert eng.arena.grantable() >= need
+        assert eng.admit_sizing(32, 8, prompt=pc)[1] == 3  # pinned-on-admit
+        assert not eng.can_admit(32, 8, prompt=pc)
+        rc = a.submit(pc, max_new_tokens=8)
+        a.run_until_idle()  # admits only once rb retires — never FAILs
+        for r in (ra, rb, rc):
+            assert r.state == RequestState.FINISHED
+        np.testing.assert_array_equal(rc.output_ids(), _ref(model, pc, 8))
+        eng.check_invariants()
+    finally:
+        a.close()
+
+
+def test_cache_affinity_bounded_head_of_line_skips(model):
+    """Cache-preferred admission: a same-priority cache-warm waiter may be
+    admitted ahead of a cache-cold head, but only
+    FLAGS_serving_cache_affinity times — the cold head is then served
+    before any further warm traffic (no starvation)."""
+    keep = paddle.get_flags(
+        "serving_cache_affinity")["serving_cache_affinity"]
+    paddle.set_flags({"serving_cache_affinity": 1})
+    a = ServingAPI(model, num_slots=1, kv_block_size=8, max_model_len=MAX_LEN,
+                   prefix_cache=True)
+    try:
+        rng = np.random.default_rng(13)
+        warm_prefix = _prompt(rng, 16)
+        seed_req = a.submit(warm_prefix, max_new_tokens=4)  # makes it warm
+        a.run_until_idle()
+        assert seed_req.state == RequestState.FINISHED
+        blocker = a.submit(_prompt(rng, 8), max_new_tokens=8)
+        a._pump_once()
+        assert blocker.state == RequestState.RUNNING
+        cold = a.submit(_prompt(rng, 8), max_new_tokens=4)
+        w1 = a.submit(np.concatenate([warm_prefix, _prompt(rng, 4)]),
+                      max_new_tokens=4)
+        w2 = a.submit(np.concatenate([warm_prefix, _prompt(rng, 4)]),
+                      max_new_tokens=4)
+        a.run_until_idle()
+        for r in (blocker, cold, w1, w2):
+            assert r.state == RequestState.FINISHED
+        # w1 jumped the cold head once; the spent window then forces the
+        # cold head in before w2, despite w2 being warm too
+        assert cold._cache_skips == 1
+        assert blocker._admit_seq < w1._admit_seq < cold._admit_seq \
+            < w2._admit_seq
+    finally:
+        a.close()
+        paddle.set_flags({"serving_cache_affinity": keep})
+
+
+def test_flag_off_keeps_engine_cache_free(model):
+    """FLAGS_serving_prefix_cache=0 (the default here): no tree, no
+    refs, worst-case reservations — the exact pre-cache engine."""
+    a = ServingAPI(model, num_slots=2, kv_block_size=8, max_model_len=MAX_LEN)
+    try:
+        eng = a.engine
+        assert eng.prefix_cache is None
+        p = np.arange(12, dtype=np.int32)
+        assert eng.admit_sizing(12, 8, prompt=p) \
+            == (eng.blocks_needed(12, 8), 0)
+        assert eng.admit_blocks_needed(12, 8, prompt=p) \
+            == eng.blocks_needed(12, 8)
+    finally:
+        a.close()

@@ -3,7 +3,9 @@ iteration-level scheduler, submit/stream/cancel API, and the
 ``inference.Config`` predictor bridge (ISSUE 4); plus the resilience layer
 (ISSUE 5): priority admission + starvation preemption, supervisor
 rebuild-and-replay recovery with the crash-loop breaker, and graceful
-drain / preemption-guard shutdown.
+drain / preemption-guard shutdown. The radix prefix cache's supervisor
+interaction (ISSUE 6) chaos-tests here; its unit and tier-1 regression
+coverage lives in ``tests/test_prefix_cache.py``.
 
 The compiled-engine tests share one module-scoped ``ServingAPI`` so tier-1
 pays its prefill/decode compiles once; assertions on trace counters are
@@ -1060,6 +1062,72 @@ def test_preemption_declines_when_eviction_cannot_help(model):
     finally:
         api.close()
         paddle.set_flags({"serving_starvation_steps": keep})
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_supervisor_replay_with_live_shared_prefixes(model, monkeypatch):
+    """ISSUE 6 satellite: a ``serving_device`` fault mid-decode while
+    several slots SHARE radix-cache prefix blocks rebuilds the arena
+    (resetting the tree), replays every journal token-for-token — the
+    replays re-inserting and re-sharing the prefix with fresh blocks —
+    and leaves zero leaked blocks and consistent refcounts after
+    ``drain_all()``."""
+    keep = {k: paddle.get_flags(k)[k]
+            for k in ("fault_injection", "serving_arena_invariants")}
+    paddle.set_flags({"fault_injection": 1, "serving_arena_invariants": 1})
+    api = ServingAPI(model, num_slots=4, kv_block_size=8,
+                     max_model_len=MAX_LEN, prefix_cache=True)
+    try:
+        import paddle_tpu.serving.api as api_mod
+
+        # drain_all must only sweep THIS test's api, not the shared
+        # module fixture (a drained API refuses admissions forever)
+        monkeypatch.setattr(api_mod, "_live_apis", weakref.WeakSet((api,)))
+        rng = np.random.default_rng(60)
+        shared = _prompt(rng, 24)  # 3 full blocks shared by every request
+        prompts = [np.concatenate([shared, _prompt(rng, n)])
+                   for n in (4, 6, 9)]
+        # unfaulted reference pass through the same engine (and the same
+        # cache — the second/third admissions already share blocks)
+        reqs = [api.submit(p, max_new_tokens=10) for p in prompts]
+        api.run_until_idle()
+        refs = [r.output_ids() for r in reqs]
+        d0 = api.engine.decode_traces
+        rb0 = resilience.stats().get("serving.rebuilds", 0)
+        # faulted pass: all three live (and sharing) when the device dies
+        reqs2 = [api.submit(p, max_new_tokens=10) for p in prompts]
+        for _ in range(3):
+            api._pump_once()
+        assert all(r.state == RequestState.RUNNING for r in reqs2)
+        assert api.engine.arena.refcount(
+            api.engine.prefix_cache.match(shared)[0].block) >= 2
+        resilience.inject_fault("serving_device", times=1)
+        api.run_until_idle()
+        for ref, r in zip(refs, reqs2):
+            assert r.state == RequestState.FINISHED
+            np.testing.assert_array_equal(ref, r.output_ids())
+        assert resilience.stats().get("serving.rebuilds", 0) == rb0 + 1
+        assert api.engine.decode_traces == d0  # replay never recompiles
+        # the replays re-populated the FRESH tree and re-shared it
+        assert api.engine.prefix_cache.resident_blocks() >= 3
+        assert api.engine.prefix_cache.hits >= 2
+        # drain everything: no leaked blocks, refcounts all zero, only
+        # cache-resident blocks may remain allocated
+        import paddle_tpu.serving as serving_mod
+
+        assert serving_mod.drain_all(grace=5) == 1
+        api.engine.check_invariants()
+        a = api.engine.arena.stats()
+        assert a["blocks_reserved"] == 0
+        assert a["blocks_in_use"] == a["blocks_cached"]
+        assert api.engine.active_slots() == 0
+        assert all(api.engine.arena.refcount(b) == 0
+                   for b in range(1, api.engine.arena.num_blocks))
+    finally:
+        resilience.clear_faults()
+        api.close()
+        paddle.set_flags(keep)
 
 
 @pytest.mark.slow

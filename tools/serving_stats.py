@@ -22,6 +22,12 @@ warmup. The resilience layer's counters ride the same delta:
 ``scheduler.preemptions`` (starvation-triggered victim evictions),
 ``supervisor.rebuilds`` / ``supervisor.replays`` (transient-failure
 recovery), ``api.drains`` / ``api.drain_stragglers`` / ``api.recoveries``.
+So do the radix prefix cache's (``FLAGS_serving_prefix_cache``):
+``prefix.hits`` / ``prefix.hit_tokens`` (prefill tokens avoided) /
+``prefix.inserted_blocks`` / ``prefix.evictions`` / ``prefix.cow_copies``.
+A run report also prints the end-of-run arena/prefix gauges (occupancy,
+cached/resident blocks, high-water, fragmentation) next to the delta —
+point-in-time state, not differenced.
 After the script returns, every ServingAPI it left open is drained
 (``serving.drain_all``) so the reported run always exercises the graceful
 shutdown path and no engine exits holding live slots or arena blocks.
@@ -61,6 +67,10 @@ def _config_report() -> dict:
         "serving_max_rebuilds": _flag_env("serving_max_rebuilds", 3),
         "serving_rebuild_window": _flag_env("serving_rebuild_window", 200),
         "serving_drain_grace": _flag_env("serving_drain_grace", 30.0),
+        # radix prefix cache (content-addressed KV block sharing)
+        "serving_prefix_cache": _flag_env("serving_prefix_cache", 0),
+        "serving_cache_affinity": _flag_env("serving_cache_affinity", 0),
+        "serving_arena_invariants": _flag_env("serving_arena_invariants", 0),
     }
 
 
@@ -103,12 +113,19 @@ def main(argv=None) -> int:
                      before, metrics.stats(), drop_zero=True).items()
                  if isinstance(v, (int, float)) and not isinstance(v, bool)}
         toks = delta.get("tokens.generated", 0)
+        # end-of-run arena/prefix gauges: point-in-time occupancy picture
+        # (cached blocks, high-water, fragmentation), NOT differenced
+        gauges = {k: v for k, v in metrics.gauges().items()
+                  if k.split(".")[0] in ("arena", "prefix", "slots")}
         rec = {"wall_secs": round(wall, 3), "stats": delta,
+               "gauges": gauges,
                "tokens_per_sec": round(toks / wall, 2) if wall > 0 else None}
         print(json.dumps(rec) if args.json else
               "\n".join([f"wall_secs: {rec['wall_secs']}",
                          f"tokens_per_sec: {rec['tokens_per_sec']}"]
-                        + [f"{k}: {v}" for k, v in sorted(delta.items())]))
+                        + [f"{k}: {v}" for k, v in sorted(delta.items())]
+                        + [f"gauge {k}: {v}"
+                           for k, v in sorted(gauges.items())]))
         return 0
 
     rep = _config_report()
