@@ -31,6 +31,19 @@ rows, so the timed windows must show zero). Persisted into
 ``BENCH_SERVING.json`` under ``"shared_prefix"`` alongside the sweep.
 Env: SERVING_PREFIX_REQUESTS (default 32), SERVING_PREFIX_PROMPTS (K,
 default 3), SERVING_PREFIX_SYS (system-prompt tokens, block-aligned).
+
+``--gateway`` runs the multi-tenant offered-load bench (ISSUE 8): a
+2-replica ``serving.gateway.ReplicaPool`` under three tenants — one
+offering 2x its token-bucket quota, two compliant — with a chaos
+``serving_device`` fault escalated to a crash loop killing one replica
+mid-run. Reported: per-tenant goodput vs entitlement (the acceptance gate:
+compliant tenants >= 90% of their fair share), Jain fairness, p50/p99
+latency, sheds (noisy tenant only), re-routes (every re-routed stream must
+finish token-for-token identical to ``generate()``), and the serving
+compile counters across the timed window (zero — ejection, journal
+re-route, and the survivor absorbing the load reuse warm programs).
+Persisted under ``"gateway"`` in ``BENCH_SERVING.json``.
+Env: GATEWAY_DURATION (arrival window seconds, default 6), GATEWAY_SEED.
 """
 from __future__ import annotations
 
@@ -251,6 +264,204 @@ def run_shared_prefix(model, platform):
         f.write("\n")
 
 
+def _jain(xs):
+    xs = np.asarray(xs, np.float64)
+    denom = len(xs) * float((xs ** 2).sum())
+    return float(xs.sum()) ** 2 / denom if denom > 0 else 0.0
+
+
+def run_gateway(model, platform):
+    """Tenant-mix offered-load bench over a 2-replica gateway pool, with a
+    mid-run chaos crash of one replica. See the module docstring for what
+    is measured; the acceptance gates are asserted here (the bench fails
+    loudly instead of persisting a silently-broken record)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.core import compile_cache, resilience
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.serving import (ReplicaPool, RequestState, TenantConfig,
+                                    TenantManager)
+
+    duration = float(os.environ.get("GATEWAY_DURATION", "6.0"))
+    seed = int(os.environ.get("GATEWAY_SEED", "0"))
+    new_tokens, max_len = 8, 32
+    prompt_lens = (8, 10, 12)
+    # tenant contracts: the noisy tenant offers 2x its 32 tok/s quota; the
+    # compliant tenants offer 32 tok/s against a 40 tok/s quota with a
+    # two-second burst (poisson clumping must not shed a tenant whose
+    # long-run rate is inside its contract)
+    quota = {"noisy": 32.0, "calm1": 40.0, "calm2": 40.0}
+    offered_rps = {"noisy": 8.0, "calm1": 4.0, "calm2": 4.0}
+
+    keep = paddle.get_flags(["serving_max_rebuilds", "fault_injection"])
+    paddle.set_flags({"serving_max_rebuilds": 1, "fault_injection": True})
+    tm = TenantManager()
+    tm.configure(TenantConfig("noisy", rate=quota["noisy"],
+                              burst=quota["noisy"]))
+    for t in ("calm1", "calm2"):
+        tm.configure(TenantConfig(t, rate=quota[t], burst=2 * quota[t]))
+    pool = ReplicaPool(model, replicas=2, tenants=tm, num_slots=4,
+                       kv_block_size=8, max_model_len=max_len,
+                       respawn_backoff=600)  # the dead replica stays out
+    rng = np.random.default_rng(seed)
+    vocab = model.cfg.vocab_size
+
+    # warm BOTH replicas across every program the timed window can touch:
+    # the decode step, the admission buckets (prompts <=12 -> bucket 16)
+    # and the journal-replay bucket (prompt+journal up to 19 -> bucket 32)
+    for rep in pool.replicas():
+        for plen in (10, 20):
+            rep.api.submit(rng.integers(0, vocab, (plen,), dtype=np.int32),
+                           max_new_tokens=2)
+        rep.api.run_until_idle()
+
+    # merged poisson arrival schedule per tenant
+    work = []
+    for t, rps in offered_rps.items():
+        at = 0.0
+        while at < duration:
+            at += float(rng.exponential(1.0 / rps))
+            if at < duration:
+                plen = int(rng.choice(prompt_lens))
+                work.append({"tenant": t, "arrival": at,
+                             "prompt": rng.integers(0, vocab, (plen,),
+                                                    dtype=np.int32)})
+    work.sort(key=lambda w: w["arrival"])
+    t_kill = 0.4 * duration
+    offered = {t: 0 for t in quota}
+    shed = {t: 0 for t in quota}
+    accepted, lat = [], []
+    killed = False
+
+    cc0 = compile_cache.stats()
+    pending = list(work)
+    inflight = []
+    t0 = time.perf_counter()
+    while pending or any(not rr.finished for rr, _ in inflight):
+        now = time.perf_counter() - t0
+        while pending and pending[0]["arrival"] <= now:
+            w = pending.pop(0)
+            offered[w["tenant"]] += 1
+            try:
+                rr = pool.submit(w["prompt"], max_new_tokens=new_tokens,
+                                 tenant=w["tenant"])
+            except resilience.QuotaExceededError:
+                shed[w["tenant"]] += 1
+                continue
+            accepted.append(rr)
+            inflight.append((rr, w["arrival"]))
+        if not killed and now >= t_kill:
+            # chaos: a serving_device fault storm on replica 0 — its
+            # supervisor rebuilds+replays until the crash-loop breaker
+            # opens, the router ejects it and re-queues its journaled
+            # streams onto replica 1. Pumping ONLY the victim while the
+            # fault is armed confines the storm to one replica, like a
+            # real single-chip failure would be
+            victim = pool._replica_at(0)
+            if victim is not None and victim.healthy \
+                    and victim.api.scheduler.has_work():
+                resilience.inject_fault("serving_device", times=10_000)
+                try:
+                    while victim.healthy:
+                        pool._pump_replica(victim)
+                finally:
+                    resilience.clear_faults()
+                killed = True
+        pool.pump_once()
+        done = time.perf_counter() - t0
+        for item in list(inflight):
+            pool._observe(item[0])
+            if item[0].finished:
+                inflight.remove(item)
+                lat.append(done - item[1])
+    wall = time.perf_counter() - t0
+    cc1 = compile_cache.stats()
+    compiles = sum(cc1.get(k, 0) - cc0.get(k, 0)
+                   for k in ("serving.decode_compiles",
+                             "serving.prefill_compiles",
+                             "serving.cow_compiles"))
+
+    # ---- acceptance gates -------------------------------------------------
+    assert killed, "the chaos kill never fired (replica 0 had no work?)"
+    incomplete = [rr for rr in accepted
+                  if rr.state != RequestState.FINISHED]
+    assert not incomplete, (
+        f"{len(incomplete)} accepted streams did not complete")
+    assert shed["calm1"] == 0 and shed["calm2"] == 0, \
+        "a compliant tenant was shed"
+    rerouted = [rr for rr in accepted if rr.reroutes > 0]
+    assert rerouted, "the crash must have re-routed in-flight streams"
+    parity_checked = 0
+    for rr in rerouted:  # refs AFTER the timed window: generate() compiles
+        ref = np.asarray(model.generate(
+            Tensor(rr.prompt[None]), max_new_tokens=new_tokens)._data)[0]
+        np.testing.assert_array_equal(rr.output_ids(), ref)
+        parity_checked += 1
+    # goodput over the ARRIVAL window: every accepted stream completes
+    # shortly after its arrival, and the drain tail past the last arrival
+    # must not dilute a tenant's delivered rate below what it was offered
+    goodput = {t: 0.0 for t in quota}
+    for rr in accepted:
+        goodput[rr.tenant] += len(rr.tokens())
+    goodput = {t: v / duration for t, v in goodput.items()}
+    # a tenant's fair share = what it ACTUALLY offered (poisson draws
+    # wobble around the nominal rate), capped at its quota — the fraction
+    # of in-contract demand that was delivered
+    entitlement = {t: min(offered[t] * new_tokens / duration, quota[t])
+                   for t in quota}
+    fair = {t: goodput[t] / entitlement[t] for t in quota}
+    assert fair["calm1"] >= 0.9 and fair["calm2"] >= 0.9, (
+        f"compliant goodput below 90% of fair share: {fair}")
+    assert compiles == 0, f"{compiles} serving compiles in the timed window"
+
+    st = pool.stats()
+    rec = {
+        "bench": "serving_gateway",
+        "metric": f"gateway tenant-mix goodput (2 replicas, 3 tenants, "
+                  f"noisy@2x quota, mid-run crash, {platform})",
+        "value": round(sum(goodput.values()), 1),
+        "unit": "tokens/sec",
+        "platform": platform,
+        "duration_secs": duration,
+        "wall_secs": round(wall, 3),
+        "replicas": 2,
+        "replicas_healthy_end": st["replicas_healthy"],
+        "offered": offered,
+        "shed": shed,
+        "accepted": len(accepted),
+        "accepted_completed": len(accepted) - len(incomplete),
+        "rerouted_streams": len(rerouted),
+        "reroute_parity_checked": parity_checked,
+        "goodput_tps": {t: round(v, 1) for t, v in goodput.items()},
+        "fair_share_frac": {t: round(v, 3) for t, v in fair.items()},
+        "jain_fairness": round(_jain(list(fair.values())), 4),
+        "latency_p50_ms": round(_percentile(lat, 50) * 1e3, 1),
+        "latency_p99_ms": round(_percentile(lat, 99) * 1e3, 1),
+        "compiles_during_run": int(compiles),
+    }
+    pool.close()
+    paddle.set_flags(keep)
+    print(f"# gateway: {rec['value']} tok/s aggregate, fair="
+          f"{rec['fair_share_frac']}, jain={rec['jain_fairness']}, "
+          f"shed={shed}, rerouted={len(rerouted)} (parity ok), "
+          f"p99={rec['latency_p99_ms']}ms, compiles={compiles}", flush=True)
+    from _common import emit
+
+    emit(rec)
+    out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "BENCH_SERVING.json")
+    existing = {}
+    if os.path.exists(out_path):
+        try:
+            with open(out_path) as f:
+                existing = json.load(f)
+        except (OSError, ValueError):
+            existing = {}
+    existing["gateway"] = rec
+    with open(out_path, "w") as f:
+        json.dump(existing, f)
+        f.write("\n")
+
+
 def main():
     import jax
 
@@ -259,6 +470,14 @@ def main():
     from paddle_tpu.serving import ServingAPI
 
     platform = jax.devices()[0].platform
+    if "--gateway" in sys.argv:
+        cfg = (GPTConfig(vocab_size=50304, hidden_size=768, num_layers=12,
+                         num_heads=12, max_position_embeddings=2048)
+               if platform == "tpu" else gpt_tiny())
+        model = GPTForCausalLM(cfg)
+        model.eval()
+        run_gateway(model, platform)
+        return
     if "--shared-prefix" in sys.argv:
         from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM, gpt_tiny
 

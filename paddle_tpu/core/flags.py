@@ -188,6 +188,48 @@ define_flag("serving_arena_invariants", False,
             "slots' tables only when its refcount says so. Costs a host "
             "walk per retire; tests turn it on, production leaves it off.")
 
+# ---- Serving gateway: replica router + tenant quotas (serving.gateway) ----
+define_flag("serving_replicas", 2,
+            "Default replica count of a gateway ReplicaPool: independent "
+            "ServingAPI engine replicas (threads sharing one process) the "
+            "router load-balances across by least outstanding work.")
+define_flag("gateway_port", 8100,
+            "Default TCP port of the HTTP/SSE serving gateway (0 = bind an "
+            "ephemeral port; Gateway.port reports the bound one).")
+define_flag("gateway_affinity_slack", 2,
+            "Bounded prefix-cache affinity in the replica router: a replica "
+            "whose radix cache holds the request's prefix may win routing "
+            "over the least-loaded replica only while its outstanding work "
+            "exceeds the minimum by at most this many requests. Bounded so "
+            "warm traffic can never pile onto (and starve) one replica. "
+            "0 = pure least-outstanding-work routing. No effect unless "
+            "FLAGS_serving_prefix_cache is on.")
+define_flag("gateway_max_reroutes", 3,
+            "How many times one gateway request may be re-routed onto "
+            "another replica (crash-loop ejection, scale-down) before it "
+            "fails; each re-route resumes from the request's token journal.")
+define_flag("gateway_respawn_backoff", 0.5,
+            "Seconds before the router respawns an ejected replica "
+            "(doubles per consecutive ejection, capped at 30s; a healthy "
+            "respawn resets it).")
+define_flag("gateway_tenant_rate", 0.0,
+            "Default per-tenant token-bucket refill rate (generated tokens "
+            "per second) for tenants without an explicit TenantConfig. "
+            "0 = unlimited.")
+define_flag("gateway_tenant_burst", 0.0,
+            "Default per-tenant token-bucket capacity (tokens). 0 = one "
+            "second of the tenant's rate (or unlimited when the rate is 0).")
+define_flag("gateway_tenant_concurrency", 0,
+            "Default per-tenant cap on concurrently in-flight gateway "
+            "requests. 0 = unlimited.")
+define_flag("gateway_fair_share", True,
+            "Weighted fair-share admission under overload: once the pool's "
+            "outstanding work reaches TWICE its slot capacity (slots plus "
+            "one capacity's worth of queued buffering), a tenant holding "
+            "more than its weight-proportional share of that budget is "
+            "shed with the retriable QuotaExceededError (retry-after hint) "
+            "so a noisy tenant cannot starve compliant ones.")
+
 # ---- Resilience: retry / sentinel / fault injection (core.resilience) ----
 define_flag("io_retries", 3,
             "Max attempts (first try included) for retried IO: checkpoint "

@@ -95,7 +95,14 @@ def _register_providers() -> None:
                       ("resilience.serving_rebuilds", "serving.rebuilds"),
                       ("resilience.serving_drains", "serving.drains"),
                       ("resilience.serving_drain_stragglers",
-                       "serving.drain_stragglers")):
+                       "serving.drain_stragglers"),
+                      # multi-tenant gateway (serving.gateway): replica
+                      # health + tenant quota shedding
+                      ("resilience.replica_ejections",
+                       "serving.replica_ejections"),
+                      ("resilience.replica_respawns",
+                       "serving.replica_respawns"),
+                      ("resilience.quota_shed", "quota.shed")):
         memory_stats.register_stat_provider(name, lambda k=key: _counts.get(k, 0))
 
 
@@ -145,6 +152,20 @@ class RequestDrainedError(RuntimeError):
     """The request was failed by a serving drain/shutdown before completing.
     Retriable by construction: the request performed no externally visible
     work, so the caller can safely resubmit it to another instance."""
+
+
+class QuotaExceededError(RuntimeError):
+    """A tenant's rate limit, concurrency quota, or fair share was exceeded
+    at gateway admission (``serving.gateway.tenancy``). Retriable by
+    construction — nothing was enqueued; ``retry_after`` is the seconds the
+    caller should wait before resubmitting (the gateway maps it to an HTTP
+    429 with a ``Retry-After`` header)."""
+
+    def __init__(self, message: str, retry_after: float = 0.0,
+                 tenant: str = ""):
+        super().__init__(message)
+        self.retry_after = float(retry_after)
+        self.tenant = tenant
 
 
 # ---------------------------------------------------- deadlines / shedding

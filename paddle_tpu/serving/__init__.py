@@ -21,6 +21,10 @@ served endpoint), rebuilt as an Orca/vLLM-style decode runtime:
   (``CrashLoopError``).
 * :mod:`.api`       — ``ServingAPI`` (``submit/stream/cancel/drain``) and
   ``EnginePredictor`` (the ``paddle.inference`` bridge).
+* :mod:`.gateway`   — the multi-tenant front door: ``ReplicaPool`` replica
+  router (least-outstanding-work + bounded cache affinity, crash-loop
+  ejection/respawn), ``TenantManager`` quotas/fair share, and the
+  HTTP/SSE ``Gateway``.
 * :mod:`.metrics`   — counters/gauges on the shared observability surface.
 
 See docs/serving.md for the architecture and lifecycle walkthrough and
@@ -45,6 +49,14 @@ _LAZY = {
     "ServingAPI": ("api", "ServingAPI"),
     "EnginePredictor": ("api", "EnginePredictor"),
     "drain_all": ("api", "drain_all"),
+    # multi-tenant gateway (serving.gateway): replica router, tenant
+    # quotas, HTTP/SSE front door
+    "ReplicaPool": ("gateway.router", "ReplicaPool"),
+    "RoutedRequest": ("gateway.router", "RoutedRequest"),
+    "NoHealthyReplicaError": ("gateway.router", "NoHealthyReplicaError"),
+    "TenantConfig": ("gateway.tenancy", "TenantConfig"),
+    "TenantManager": ("gateway.tenancy", "TenantManager"),
+    "Gateway": ("gateway.gateway", "Gateway"),
 }
 
 __all__ = list(_LAZY) + ["metrics"]

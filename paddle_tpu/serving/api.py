@@ -38,12 +38,13 @@ routed through ``inference.Config.enable_serving_engine()`` +
 """
 from __future__ import annotations
 
+import atexit
 import logging
 import queue as _queue
 import threading
 import time
 import weakref
-from typing import Iterator, List, Optional
+from typing import Iterator, List, Optional, Sequence
 
 import numpy as np
 
@@ -70,6 +71,23 @@ def drain_all(grace: float = 0.0) -> int:
             api.drain(grace)
             n += 1
     return n
+
+
+@atexit.register
+def _drain_at_exit() -> None:  # pragma: no cover - interpreter shutdown
+    """Interpreter shutdown must never strand a background pump thread
+    mid-decode: zero-grace-drain every API still live (admissions stop, in
+    flight requests fail with the retriable ``RequestDrainedError``, every
+    done_event fires). Idempotent with an explicit ``close()``/``drain()``
+    — already-closed or already-draining APIs are skipped by
+    :func:`drain_all`, so operator scripts that shut down properly see no
+    second sweep."""
+    try:
+        drain_all(grace=0.0)
+    except Exception:
+        # shutdown epilogues must never turn a clean exit into a traceback
+        # (the GC may already have torn down parts of the runtime)
+        pass
 
 
 class ServingAPI:
@@ -101,7 +119,9 @@ class ServingAPI:
     def submit(self, prompt, max_new_tokens: int = 32,
                stop_token_id: Optional[int] = None,
                timeout: Optional[float] = None,
-               request_id: str = "", priority: int = 0) -> Request:
+               request_id: str = "", priority: int = 0,
+               journal: Optional[Sequence[int]] = None,
+               shed: bool = True) -> Request:
         """Enqueue one generation request; returns its handle immediately.
 
         ``timeout`` is the request's end-to-end wall-clock deadline
@@ -112,7 +132,17 @@ class ServingAPI:
         is at the shedding limit — callers retry later or route elsewhere;
         unbounded queues just convert overload into timeouts. During a
         drain, new submissions raise the retriable
-        :class:`core.resilience.RequestDrainedError`."""
+        :class:`core.resilience.RequestDrainedError`.
+
+        ``journal`` seeds the request's token journal: admission prefills
+        ``prompt + journal`` and decode resumes at the journal's next token
+        (``journal`` counts toward ``max_new_tokens``, and only tokens
+        PAST it are streamed). This is the gateway router's re-queue path —
+        a request whose replica crash-looped resumes token-for-token on a
+        healthy replica. ``shed=False`` bypasses the queue-depth shed for
+        such re-routed requests: they were already accepted once, and
+        dropping accepted work at an overloaded fail-over target would turn
+        one replica's crash into request loss."""
         with self._lock:
             # checked under the lock: a submit racing drain()/close() must
             # never enqueue after the straggler sweep (its request would
@@ -123,17 +153,29 @@ class ServingAPI:
                 raise resilience.RequestDrainedError(
                     "ServingAPI is draining: admissions are stopped; "
                     "resubmit to another instance")
-            try:
-                resilience.check_overload(len(self.scheduler.waiting),
-                                          self._max_queue, name="serving")
-            except resilience.QueueOverloadError:
-                metrics.bump("requests.shed")
-                raise
+            if shed:
+                try:
+                    resilience.check_overload(len(self.scheduler.waiting),
+                                              self._max_queue, name="serving")
+                except resilience.QueueOverloadError:
+                    metrics.bump("requests.shed")
+                    raise
             req = Request(prompt, max_new_tokens=max_new_tokens,
                           stop_token_id=stop_token_id,
                           request_id=request_id, priority=priority,
                           deadline=resilience.Deadline.after(timeout))
+            if journal:
+                if len(journal) >= int(max_new_tokens):
+                    raise ValueError(
+                        f"journal of {len(journal)} tokens already exhausts "
+                        f"max_new_tokens={max_new_tokens}; nothing to resume")
+                req.tokens = [int(t) for t in journal]
             return self.scheduler.submit(req)
+
+    def outstanding(self) -> int:
+        """Waiting + running request count — the router's
+        least-outstanding-work routing signal."""
+        return len(self.scheduler.waiting) + len(self.scheduler.running)
 
     def stream(self, req: Request) -> Iterator[int]:
         """Yield ``req``'s tokens as they are generated; raises the

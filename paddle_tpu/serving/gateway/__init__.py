@@ -1,0 +1,49 @@
+"""Multi-tenant serving gateway: replica router, tenant quotas, HTTP/SSE
+front door — the deployable layer over :mod:`paddle_tpu.serving` that makes
+"heavy traffic from millions of users" an in-process reality (the mirror of
+the reference's ``distributed/fleet/elastic`` membership/health machinery,
+folded into the serving stack):
+
+* :mod:`.router`  — :class:`ReplicaPool`: N ``ServingAPI`` engine replicas
+  routed by least-outstanding-work with bounded prefix-cache affinity;
+  crash-looping replicas are ejected (their journaled in-flight requests
+  re-queue token-for-token onto healthy replicas) and respawned with
+  backoff; scale-down routes through ``drain(grace)``.
+* :mod:`.tenancy` — :class:`TenantManager` / :class:`TenantConfig`:
+  per-tenant token-bucket rates, concurrency quotas, and weighted fair
+  share under overload, shed with the retriable
+  :class:`core.resilience.QuotaExceededError` (retry-after hint attached);
+  tenants map onto the scheduler's priority classes.
+* :mod:`.gateway` — :class:`Gateway` / :func:`serve`: the stdlib
+  ``http.server`` HTTP/SSE streaming front door
+  (submit/stream/cancel/health/stats), error taxonomy mapped to
+  429/503/504, SIGTERM → gateway-wide drain.
+
+See docs/serving.md ("Gateway & multi-tenancy") for endpoints, tenant
+configuration, and flags.
+"""
+from __future__ import annotations
+
+_LAZY = {
+    "ReplicaPool": ("router", "ReplicaPool"),
+    "RoutedRequest": ("router", "RoutedRequest"),
+    "NoHealthyReplicaError": ("router", "NoHealthyReplicaError"),
+    "TenantConfig": ("tenancy", "TenantConfig"),
+    "TenantManager": ("tenancy", "TenantManager"),
+    "Gateway": ("gateway", "Gateway"),
+    "serve": ("gateway", "serve"),
+}
+
+__all__ = list(_LAZY)
+
+
+def __getattr__(name):
+    # lazy like paddle_tpu.serving: the gateway materializes only when used
+    entry = _LAZY.get(name)
+    if entry is None:
+        raise AttributeError(
+            f"module 'paddle_tpu.serving.gateway' has no attribute {name!r}")
+    import importlib
+
+    mod = importlib.import_module(f".{entry[0]}", __name__)
+    return getattr(mod, entry[1])

@@ -1,0 +1,900 @@
+"""Replica router: N engine replicas behind one submit/stream surface.
+
+One :class:`~paddle_tpu.serving.api.ServingAPI` is one engine: one compiled
+slot arena, one scheduler, one supervisor. The :class:`ReplicaPool` owns N
+of them (threads sharing this process today; mesh shards when the GSPMD
+refactor lands) and adds the three behaviors a fleet needs that a single
+engine cannot express:
+
+* **Routing** — each accepted request goes to the replica with the least
+  outstanding work (waiting + running), with *bounded prefix-cache
+  affinity*: when the radix cache is on, a replica whose tree already holds
+  the request's prompt prefix (probed via PR 6's memoized chunk-key chain —
+  hash once per request, walk per candidate) may win instead, but only
+  while its load is within ``FLAGS_gateway_affinity_slack`` requests of the
+  minimum — warm traffic can never pile onto one replica and starve a cold
+  tenant of capacity.
+* **Health** — replica health is driven by the supervisor's crash-loop
+  state: a replica whose breaker opens (or whose pump surfaces a
+  :class:`~paddle_tpu.serving.supervisor.CrashLoopError` / transient device
+  error) is **ejected**. Its journaled in-flight requests re-queue onto
+  healthy replicas — the same ``prompt + tokens`` journal replay the PR 5
+  supervisor uses in-engine, so a re-routed stream finishes token-for-token
+  identical to an uninterrupted one. The dead replica respawns after a
+  doubling backoff (``FLAGS_gateway_respawn_backoff``, capped at 30s).
+* **Tenancy** — every submission is charged to a tenant through
+  :class:`~paddle_tpu.serving.gateway.tenancy.TenantManager` *before* any
+  replica is touched, and the tenant's configured priority class rides the
+  scheduler's PR 5 priority admission.
+
+Scale-down routes through ``drain(grace)``: :meth:`ReplicaPool.scale_to`
+drains the retiring replica (in-flight requests get the grace budget to
+finish), then re-routes stragglers onto the survivors — autoscaling never
+drops an accepted stream. ``bind_preemption_guard`` gives the whole pool
+the SIGTERM-drain semantics each API already had alone.
+
+Counters (``serving.metrics``): ``gateway.routed`` / ``gateway.rerouted``
+/ ``gateway.affinity_routes`` / ``gateway.ejected`` / ``gateway.respawned``
+/ ``gateway.scale_downs`` / ``gateway.drains`` / ``gateway.guard_drains``;
+gauges ``gateway.replicas_healthy`` / ``gateway.replicas_total`` /
+``gateway.outstanding``. Ejections/respawns mirror into
+``core.resilience`` as ``serving.replica_ejections`` /
+``serving.replica_respawns`` for the shared resilience dashboards.
+"""
+from __future__ import annotations
+
+import itertools
+import logging
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ...core import flags, resilience
+from .. import metrics
+from ..api import ServingAPI
+from ..scheduler import Request, RequestState
+from ..supervisor import CrashLoopError, is_transient_serving_error
+from .tenancy import TenantManager
+
+_logger = logging.getLogger("paddle_tpu.serving.gateway")
+
+_RESPAWN_BACKOFF_CAP = 30.0
+_REAP_EVERY = 16  # submits between abandoned-handle sweeps
+_gw_counter = itertools.count()
+
+
+class NoHealthyReplicaError(RuntimeError):
+    """Every replica is ejected, draining, or removed. Retriable — the
+    router's respawn loop brings ejected replicas back after their backoff;
+    the gateway maps this to HTTP 503 with a Retry-After hint."""
+
+
+#: backend failures the router answers with a re-route instead of failing
+#: the gateway request: the replica died (crash loop / transient device
+#: error that escaped the supervisor) or was intentionally drained away
+#: under the request (scale-down, ejection sweep)
+def _is_reroutable(exc: BaseException) -> bool:
+    return (isinstance(exc, (CrashLoopError,
+                             resilience.RequestDrainedError))
+            or is_transient_serving_error(exc))
+
+
+class _Replica:
+    """One engine replica plus its health record. ``generation`` bumps on
+    every respawn so stale routed requests can't mis-attribute a fresh
+    api's failures to the incarnation that died."""
+
+    def __init__(self, idx: int, api: ServingAPI):
+        self.idx = idx
+        self.api = api
+        self.healthy = True
+        self.draining = False   # scale-down in progress: no new routes
+        self.removed = False    # scaled away for good
+        self.generation = 0
+        self.ejections = 0      # lifetime; drives the respawn backoff
+        self.ejected_at = 0.0
+        self.backoff = 0.0
+        self.respawning = False  # claimed by one respawner at a time
+
+    def outstanding(self) -> int:
+        return self.api.outstanding()
+
+    def routable(self) -> bool:
+        return self.healthy and not self.draining and not self.removed
+
+
+class RoutedRequest:
+    """The gateway-side handle for one stream: survives replica ejection
+    and scale-down by carrying its own token journal across backends.
+
+    ``tokens()`` is the single source of truth the streaming surface reads:
+    tokens from dead backends (``_base``) plus the live backend's tokens
+    past the journal it was seeded with. Re-routing swaps the backend under
+    the lock; because the journal snapshot is taken at swap time from the
+    backend's append-only token list, a consumer's view is monotone — no
+    token is ever re-delivered or skipped across a re-route."""
+
+    def __init__(self, pool: "ReplicaPool", prompt: np.ndarray,
+                 max_new_tokens: int, stop_token_id: Optional[int],
+                 tenant: str, priority: int,
+                 deadline: resilience.Deadline, request_id: str):
+        self.pool = pool
+        self.prompt = np.asarray(prompt, np.int32).reshape(-1)
+        self.max_new_tokens = int(max_new_tokens)
+        self.stop_token_id = stop_token_id
+        self.tenant = tenant
+        self.priority = int(priority)
+        self.deadline = deadline
+        self.request_id = request_id or f"gw-{next(_gw_counter)}"
+        self.reroutes = 0
+        self.state = RequestState.QUEUED
+        self.error: Optional[BaseException] = None
+        self.done_event = threading.Event()
+        self._lock = threading.Lock()
+        self._base: List[int] = []      # tokens from previous backends
+        self._backend: Optional[Request] = None
+        self._backend_journal = 0       # len of journal the backend carries
+        self._replica_idx = -1
+        self._replica_gen = -1
+        self._released = False          # tenant release happened exactly once
+        self._cancelled = False         # survives re-routes (backend _cancel
+        self._rerouting = False         # does not); one re-route at a time
+
+    # ------------------------------------------------------------- reading
+
+    def tokens(self) -> List[int]:
+        """All generated tokens so far (journal + live backend, deduped)."""
+        return self.tokens_from(0)
+
+    def tokens_from(self, offset: int) -> List[int]:
+        """Tokens past ``offset`` — what an incremental consumer reads per
+        poll (a full-list copy per iteration would make a long stream
+        O(n^2) while holding the lock)."""
+        with self._lock:
+            n_base = len(self._base)
+            out = list(self._base[offset:]) if offset < n_base else []
+            if self._backend is not None:
+                start = self._backend_journal + max(0, offset - n_base)
+                out.extend(self._backend.tokens[start:])
+            return out
+
+    def output_ids(self) -> np.ndarray:
+        """prompt + generated tokens (``generate()``'s contract without the
+        post-stop fill) — token-for-token identical across re-routes."""
+        return np.concatenate([self.prompt,
+                               np.asarray(self.tokens(), np.int32)])
+
+    @property
+    def finished(self) -> bool:
+        return self.state in (RequestState.FINISHED, RequestState.CANCELLED,
+                              RequestState.FAILED)
+
+    def cancel(self) -> None:
+        """Flag the stream for cancellation. The flag lives on the GATEWAY
+        handle, not just the backend request — a cancel that races a
+        re-route (ejection, scale-down) must stick to the replacement
+        backend too, not silently resurrect the stream."""
+        with self._lock:
+            self._cancelled = True
+            backend = self._backend
+        if backend is not None:
+            backend.cancel()
+
+    # ------------------------------------------------------------ plumbing
+
+    def _attach(self, backend: Request, replica: "_Replica",
+                journal_len: int) -> None:
+        with self._lock:
+            self._backend = backend
+            self._backend_journal = int(journal_len)
+            self._replica_idx = replica.idx
+            self._replica_gen = replica.generation
+        if self.state == RequestState.QUEUED:
+            self.state = RequestState.RUNNING
+
+    def _detach_journal(self) -> List[int]:
+        """Fold the (dead) backend's tokens into the journal and detach;
+        returns the full journal the replacement backend resumes from."""
+        with self._lock:
+            if self._backend is not None:
+                self._base.extend(
+                    self._backend.tokens[self._backend_journal:])
+                self._backend = None
+            return list(self._base)
+
+    def _finalize(self, state: str,
+                  error: Optional[BaseException] = None) -> None:
+        with self._lock:
+            if self.finished:
+                return
+            if self._backend is not None:
+                self._base.extend(
+                    self._backend.tokens[self._backend_journal:])
+                self._backend = None
+            self.state = state
+            self.error = error
+        self.done_event.set()
+
+
+class ReplicaPool:
+    """N ServingAPI replicas behind one tenant-aware routed front door.
+
+    ``model`` is either a model instance (shared read-only by every
+    replica's engine — the single-host case) or a zero-arg factory called
+    per replica/respawn (the hook for per-replica mesh shards).
+    ``background=True`` gives every replica its own pump thread (what the
+    HTTP gateway runs on); ``background=False`` keeps pumping in the
+    consumer's thread — deterministic, what the tests and bench drive."""
+
+    def __init__(self, model, replicas: Optional[int] = None,
+                 config=None, tenants: Optional[TenantManager] = None,
+                 background: bool = False,
+                 affinity_slack: Optional[int] = None,
+                 respawn_backoff: Optional[float] = None,
+                 max_reroutes: Optional[int] = None,
+                 max_queue: Optional[int] = None, **engine_kw):
+        n = int(flags.flag("serving_replicas")
+                if replicas is None else replicas)
+        if n < 1:
+            raise ValueError("a ReplicaPool needs at least one replica")
+        # a zero-arg factory builds one model per replica (mesh shards
+        # later); a model INSTANCE (itself callable — nn.Layer.__call__ is
+        # forward) is shared read-only by every replica's engine
+        self._factory: Callable[[], object] = (
+            model if callable(model) and not hasattr(model,
+                                                     "functional_state")
+            else (lambda: model))
+        self._api_kw = dict(config=config, background=background,
+                            max_queue=max_queue, **engine_kw)
+        self.tenants = tenants if tenants is not None else TenantManager()
+        self._affinity_slack = (int(flags.flag("gateway_affinity_slack"))
+                                if affinity_slack is None
+                                else int(affinity_slack))
+        self._respawn_backoff = (
+            float(flags.flag("gateway_respawn_backoff"))
+            if respawn_backoff is None else float(respawn_backoff))
+        self._max_reroutes = (int(flags.flag("gateway_max_reroutes"))
+                              if max_reroutes is None else int(max_reroutes))
+        self._background = bool(background)
+        self._lock = threading.RLock()
+        self._replicas: List[_Replica] = [
+            _Replica(i, self._spawn_api()) for i in range(n)]
+        #: live (unfinished) routed requests per replica index
+        self._live: Dict[int, List[RoutedRequest]] = {
+            r.idx: [] for r in self._replicas}
+        self._draining = False
+        self._closed = False
+        self._guard = None
+        self._guard_grace: Optional[float] = None
+        self.drain_count = 0
+        self._reap_tick = 0
+        self._refresh_gauges()
+
+    def _spawn_api(self) -> ServingAPI:
+        return ServingAPI(self._factory(), **self._api_kw)
+
+    # ----------------------------------------------------------- capacity
+
+    def replicas(self) -> List[_Replica]:
+        with self._lock:
+            return [r for r in self._replicas if not r.removed]
+
+    def healthy_replicas(self) -> List[_Replica]:
+        with self._lock:
+            return [r for r in self._replicas if r.routable()]
+
+    def capacity(self) -> int:
+        """Total decode slots across routable replicas — the fair-share
+        gate's notion of what "overloaded" means."""
+        return sum(r.api.engine.num_slots for r in self.healthy_replicas())
+
+    def outstanding(self) -> int:
+        return sum(r.outstanding() for r in self.healthy_replicas())
+
+    # ------------------------------------------------------------- submit
+
+    def submit(self, prompt, max_new_tokens: int = 32,
+               stop_token_id: Optional[int] = None,
+               tenant: str = "default",
+               timeout: Optional[float] = None,
+               request_id: str = "",
+               priority: Optional[int] = None) -> RoutedRequest:
+        """Admit one stream through the tenant gates and route it to a
+        replica. ``priority=None`` takes the tenant's configured class.
+        Raises :class:`core.resilience.QuotaExceededError` (tenant gates,
+        retriable with ``retry_after``),
+        :class:`core.resilience.QueueOverloadError` (every routable replica
+        queue full), :class:`NoHealthyReplicaError` (no routable replica),
+        or the retriable ``RequestDrainedError`` during a pool drain."""
+        self._check_guard()
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("ReplicaPool is closed")
+            if self._draining:
+                raise resilience.RequestDrainedError(
+                    "gateway is draining: admissions are stopped; "
+                    "resubmit to another instance")
+        self._maybe_respawn()
+        self._sweep_health()
+        self._reap()
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        cfg = self.tenants.admit(tenant, int(max_new_tokens),
+                                 outstanding=self.outstanding(),
+                                 capacity=self.capacity())
+        rr = RoutedRequest(self, prompt, max_new_tokens, stop_token_id,
+                           tenant, cfg.priority if priority is None
+                           else int(priority),
+                           resilience.Deadline.after(timeout), request_id)
+        try:
+            self._route(rr, journal=None)
+        except Exception:
+            # the request was never enqueued: free the concurrency slot AND
+            # refund the bucket charge — a retriable routing shed must not
+            # drain a compliant tenant's rate budget (the shed contract)
+            self.tenants.release(tenant, failed=True)
+            self.tenants.refund(tenant, int(max_new_tokens))
+            raise
+        metrics.bump("gateway.routed")
+        return rr
+
+    def _route(self, rr: RoutedRequest,
+               journal: Optional[Sequence[int]]) -> None:
+        """Place ``rr`` on the best replica (least outstanding work, warm
+        radix cache within the bounded slack); falls through the candidate
+        order when the preferred replica's queue sheds. Re-routes
+        (``journal`` not None) bypass per-replica queue shedding — the
+        request was already accepted once."""
+        candidates = self._candidates(rr)
+        last_exc: Optional[BaseException] = None
+        for rep in candidates:
+            try:
+                backend = rep.api.submit(
+                    rr.prompt, max_new_tokens=rr.max_new_tokens,
+                    stop_token_id=rr.stop_token_id,
+                    timeout=(None if rr.deadline.expires_at is None
+                             else max(0.001, rr.deadline.remaining())),
+                    request_id=f"{rr.request_id}.{rr.reroutes}",
+                    priority=rr.priority, journal=journal,
+                    shed=journal is None)
+            except (resilience.QueueOverloadError,
+                    resilience.RequestDrainedError) as e:
+                last_exc = e  # replica-local condition: try the next one
+                continue
+            rr._attach(backend, rep, len(journal) if journal else 0)
+            if rr._cancelled:
+                backend.cancel()  # cancel raced the attach: make it stick
+            with self._lock:
+                bucket = self._live.setdefault(rep.idx, [])
+                if rr not in bucket:  # membership, not multiplicity: a
+                    bucket.append(rr)  # double-routed handle must not need
+            self._refresh_gauges()     # two finalizes to leave the pool
+            return
+        raise last_exc if last_exc is not None else NoHealthyReplicaError(
+            "no healthy serving replica (all ejected, draining, or "
+            "removed); retry after the respawn backoff")
+
+    def _candidates(self, rr: RoutedRequest) -> List[_Replica]:
+        """Routable replicas, best first: least outstanding work, with the
+        bounded warm-cache preference applied to the front of the order."""
+        reps = self.healthy_replicas()
+        if not reps:
+            raise NoHealthyReplicaError(
+                "no healthy serving replica (all ejected, draining, or "
+                "removed); retry after the respawn backoff")
+        load = {r.idx: r.outstanding() for r in reps}
+        reps.sort(key=lambda r: (load[r.idx], r.idx))
+        slack = self._affinity_slack
+        if slack > 0 and len(reps) > 1:
+            keys = self._prefix_keys(rr, reps[0])
+            if keys:
+                floor = load[reps[0].idx]
+                best, best_tokens = None, 0
+                for r in reps:
+                    if load[r.idx] > floor + slack:
+                        continue  # bounded: never pile onto a busy replica
+                    cache = r.api.engine.prefix_cache
+                    tokens = (cache.resident_tokens_for(keys)
+                              if cache is not None else 0)
+                    if tokens > best_tokens:
+                        best, best_tokens = r, tokens
+                if best is not None and best is not reps[0]:
+                    reps.remove(best)
+                    reps.insert(0, best)
+                    metrics.bump("gateway.affinity_routes")
+        return reps
+
+    def _prefix_keys(self, rr: RoutedRequest, rep: _Replica):
+        """Memoized chunk-key chain for the request's prompt (PR 6's
+        residency probe): content hashes depend only on tokens and block
+        size, so one chain probes every replica's tree."""
+        cache = rep.api.engine.prefix_cache
+        if cache is None:
+            return None
+        keys = getattr(rr, "_prefix_keys", None)
+        if keys is None:
+            keys = cache.chunk_keys(rr.prompt)
+            rr._prefix_keys = keys
+        return keys
+
+    # ---------------------------------------------------- health / reroute
+
+    def _sweep_health(self) -> None:
+        """Eject any replica whose supervisor breaker is open — the router
+        reads the crash-loop state directly instead of waiting for the next
+        request to fail through it."""
+        for rep in self.healthy_replicas():
+            if rep.api.supervisor.breaker_open:
+                self._eject(rep, CrashLoopError(
+                    f"replica {rep.idx} crash-loop breaker open"))
+
+    def _eject(self, rep: _Replica, cause: BaseException) -> None:
+        """Take a crash-looping replica out of rotation: mark it ejected
+        (respawn after backoff), re-queue its journaled in-flight requests
+        onto healthy replicas, then close the dead API (zero-grace drain —
+        already-detached backends fail harmlessly)."""
+        with self._lock:
+            if not rep.healthy or rep.removed:
+                return
+            rep.healthy = False
+            rep.ejections += 1
+            rep.ejected_at = time.monotonic()
+            rep.backoff = min(_RESPAWN_BACKOFF_CAP,
+                              self._respawn_backoff
+                              * (2 ** (rep.ejections - 1)))
+            live = [r for r in self._live.get(rep.idx, ())
+                    if not r.finished]
+            self._live[rep.idx] = []
+        _logger.warning(
+            "ejecting serving replica %d (%d in flight re-queued, respawn "
+            "in %.2fs): %r", rep.idx, len(live), rep.backoff, cause)
+        metrics.bump("gateway.ejected")
+        resilience.bump("serving.replica_ejections")
+        for rr in live:
+            self._reroute(rr)
+        try:
+            rep.api.close()
+        except Exception:
+            _logger.exception("closing ejected replica %d failed", rep.idx)
+        self._refresh_gauges()
+
+    def _reroute(self, rr: RoutedRequest) -> None:
+        """Move one in-flight request to a healthy replica, resuming from
+        its token journal (token-for-token parity — the cross-replica twin
+        of the supervisor's in-engine replay). Serialized per request: an
+        ejection sweep and a consumer's `_observe` may both decide to move
+        the same stream — only one wins, and a request whose backend was
+        already replaced (alive again on a healthy replica) is never
+        detached a second time (that would orphan the live backend and
+        double-decode the stream)."""
+        with self._lock:
+            if rr.finished or rr._rerouting:
+                return
+            rr._rerouting = True
+        try:
+            with rr._lock:
+                backend = rr._backend
+            if backend is not None and not backend.finished:
+                # a concurrent re-route already moved it — OR the backend
+                # was enqueued on the ejecting replica after its pump died
+                # (submit racing eject) and is about to be drain-failed by
+                # close(). Either way the handle must stay registered in
+                # its replica's live bucket, or no reap/observe would ever
+                # finalize it (leaking its tenant concurrency slot)
+                with self._lock:
+                    bucket = self._live.setdefault(rr._replica_idx, [])
+                    if rr not in bucket:
+                        bucket.append(rr)
+                return
+            self._reroute_locked(rr)
+        finally:
+            rr._rerouting = False
+
+    def _reroute_locked(self, rr: RoutedRequest) -> None:
+        if rr._cancelled:
+            # a cancel acknowledged before/through the failure must stick:
+            # resurrecting the stream on a fresh replica would decode
+            # output nobody wants and charge the tenant for it
+            self._finalize(rr, RequestState.CANCELLED)
+            return
+        journal = rr._detach_journal()
+        stop = rr.stop_token_id
+        if (len(journal) >= rr.max_new_tokens
+                or (stop is not None and journal and journal[-1] == stop)):
+            # the journal already completes the stream: the replica died on
+            # the very step that finished it — nothing left to decode
+            self._finalize(rr, RequestState.FINISHED)
+            return
+        if rr.reroutes >= self._max_reroutes:
+            self._finalize(rr, RequestState.FAILED, NoHealthyReplicaError(
+                f"{rr.request_id} re-routed {rr.reroutes} times "
+                f"(FLAGS_gateway_max_reroutes); giving up"))
+            return
+        rr.reroutes += 1
+        try:
+            self._route(rr, journal=journal)
+        except Exception as e:
+            self._finalize(rr, RequestState.FAILED, e)
+            return
+        metrics.bump("gateway.rerouted")
+
+    def _maybe_respawn(self) -> None:
+        """Bring ejected replicas back once their backoff elapsed (a fresh
+        ServingAPI: compiled programs reload from the persistent compile
+        cache, the KV arena starts empty)."""
+        now = time.monotonic()
+        with self._lock:
+            if self._draining or self._closed:
+                return  # a draining pool must not spawn fresh admitters
+            due = [r for r in self._replicas
+                   if not r.healthy and not r.removed and not r.draining
+                   and not r.respawning
+                   and now >= r.ejected_at + r.backoff]
+            for r in due:
+                # claimed under the lock: two concurrent pumps seeing the
+                # same expired backoff must not BOTH spawn an API (the
+                # loser's engine + pump thread would leak unreferenced)
+                r.respawning = True
+        for rep in due:
+            try:
+                api = self._spawn_api()
+            except Exception:
+                _logger.exception("respawn of replica %d failed; backing "
+                                  "off again", rep.idx)
+                with self._lock:
+                    rep.ejected_at = time.monotonic()
+                    rep.backoff = min(_RESPAWN_BACKOFF_CAP, rep.backoff * 2)
+                    rep.respawning = False
+                continue
+            with self._lock:
+                if rep.removed or rep.draining or self._draining \
+                        or self._closed:
+                    # scale_to / drain retired this replica while the fresh
+                    # API was being built: installing it would resurrect a
+                    # removed replica and leak a live engine past close()
+                    rep.respawning = False
+                    stillborn = api
+                else:
+                    rep.api = api
+                    rep.generation += 1
+                    rep.healthy = True
+                    rep.respawning = False
+                    stillborn = None
+            if stillborn is not None:
+                try:
+                    stillborn.close()
+                except Exception:
+                    pass
+                continue
+            _logger.info("respawned serving replica %d (generation %d)",
+                         rep.idx, rep.generation)
+            metrics.bump("gateway.respawned")
+            resilience.bump("serving.replica_respawns")
+        if due:
+            self._refresh_gauges()
+
+    # ------------------------------------------------------------ progress
+
+    def _observe(self, rr: RoutedRequest) -> None:
+        """Reconcile one routed request with its backend: propagate finish,
+        convert a re-routable backend failure (crash loop, drain-under-me,
+        transient device error) into an ejection + re-route."""
+        if rr.finished:
+            return
+        with rr._lock:
+            backend = rr._backend
+            rep_idx, rep_gen = rr._replica_idx, rr._replica_gen
+        if backend is None or not backend.finished:
+            return
+        if backend.state == RequestState.FINISHED:
+            self._finalize(rr, RequestState.FINISHED)
+        elif backend.state == RequestState.CANCELLED:
+            self._finalize(rr, RequestState.CANCELLED)
+        else:
+            err = backend.error
+            if self._draining or err is None or not _is_reroutable(err):
+                self._finalize(rr, RequestState.FAILED, err)
+                return
+            rep = self._replica_at(rep_idx)
+            if (rep is not None and rep.generation == rep_gen
+                    and rep.healthy and not rep.draining
+                    and not isinstance(err, resilience.RequestDrainedError)):
+                # the replica this died on is still in rotation: the crash
+                # surfaced through the request before any sweep — eject it
+                # (which re-routes every live request it holds, this one
+                # included)
+                self._eject(rep, err)
+            else:
+                # replica already ejected/draining/respawned under us (or
+                # intentionally drained for scale-down): just move this one
+                self._reroute(rr)
+
+    def _reap(self) -> None:
+        """Finalize abandoned handles whose backends already reached a
+        terminal state (an SSE client that hung up, a submit that was never
+        streamed): without a consumer calling ``_observe``, their tenant
+        concurrency slot and ``_live`` entry would leak forever. Throttled
+        to every ``_REAP_EVERY`` submits — a full sweep per submit would
+        make admission latency O(live handles); the sweep is a backstop
+        (the disconnect path finalizes its own handle eagerly)."""
+        self._reap_tick += 1
+        if self._reap_tick % _REAP_EVERY:
+            return
+        with self._lock:
+            live = [rr for bucket in self._live.values() for rr in bucket]
+        for rr in live:
+            self._observe(rr)
+
+    def _replica_at(self, idx: int) -> Optional[_Replica]:
+        with self._lock:
+            for r in self._replicas:
+                if r.idx == idx:
+                    return r
+        return None
+
+    def _finalize(self, rr: RoutedRequest, state: str,
+                  error: Optional[BaseException] = None) -> None:
+        rr._finalize(state, error)
+        with self._lock:
+            bucket = self._live.get(rr._replica_idx)
+            if bucket is not None and rr in bucket:
+                bucket.remove(rr)
+            release = not rr._released
+            rr._released = True
+        if release:
+            self.tenants.release(
+                rr.tenant,
+                tokens_out=len(rr.tokens()),
+                failed=state != RequestState.FINISHED)
+        self._refresh_gauges()
+
+    def pump_once(self) -> None:
+        """Foreground event loop: one guarded scheduler step on every
+        routable replica with work. A step that surfaces a crash-loop /
+        transient error ejects that replica (re-routing its requests); the
+        pool keeps serving on the survivors."""
+        if self._check_guard():
+            return
+        self._maybe_respawn()
+        for rep in self.healthy_replicas():
+            self._pump_replica(rep)
+
+    def _pump_replica(self, rep: _Replica) -> None:
+        """One guarded foreground step on a single replica (the chaos
+        bench drives this directly to confine injected faults to one
+        replica's supervisor)."""
+        if rep.api._thread is not None:
+            return  # background replica pumps itself
+        if not rep.api.scheduler.has_work():
+            return
+        try:
+            rep.api._pump_once()
+        except Exception as e:
+            if _is_reroutable(e):
+                self._eject(rep, e)
+            else:
+                raise
+
+    def _pump(self) -> None:
+        if self._background:
+            self._maybe_respawn()
+            self._sweep_health()
+            time.sleep(0.001)
+        else:
+            self.pump_once()
+
+    def stream(self, rr: RoutedRequest):
+        """Yield ``rr``'s tokens as they are generated — across replica
+        ejections and re-routes. Raises the request's error at the end of
+        a failed stream (mirrors ``ServingAPI.stream``)."""
+        sent = 0
+        while True:
+            for tok in rr.tokens_from(sent):
+                yield int(tok)
+                sent += 1
+            if rr.finished:
+                break
+            self._observe(rr)
+            if rr.finished:
+                continue  # flush tokens folded in by the finalize
+            self._pump()
+        # drain any tokens recorded between the last read and the finalize
+        for tok in rr.tokens_from(sent):
+            yield int(tok)
+            sent += 1
+        if rr.state == RequestState.FAILED and rr.error is not None:
+            raise rr.error
+
+    def result(self, rr: RoutedRequest,
+               timeout: Optional[float] = None) -> np.ndarray:
+        """Block until ``rr`` finishes; returns prompt+generated ids."""
+        deadline = resilience.Deadline.after(timeout)
+        while not rr.finished:
+            deadline.check(f"result({rr.request_id})")
+            self._observe(rr)
+            if rr.finished:
+                break
+            if self._background:
+                rr.done_event.wait(0.01)
+            else:
+                self._pump()
+        if rr.state == RequestState.FAILED:
+            raise rr.error
+        if rr.state == RequestState.CANCELLED:
+            raise RuntimeError(f"{rr.request_id} was cancelled")
+        return rr.output_ids()
+
+    def run_until_idle(self) -> None:
+        """Pump every replica until no routed request is live (foreground
+        helper for tests/benches)."""
+        while True:
+            with self._lock:
+                live = [rr for bucket in self._live.values()
+                        for rr in bucket]
+            for rr in live:
+                self._observe(rr)
+            with self._lock:
+                busy = any(bucket for bucket in self._live.values())
+            if not busy:
+                return
+            self._pump()
+
+    # ------------------------------------------------------- drain / scale
+
+    def drain(self, grace: Optional[float] = None,
+              reason: str = "gateway drain") -> None:
+        """Gateway-wide graceful shutdown: stop admissions, drain every
+        replica within the shared ``grace`` budget (default
+        ``FLAGS_serving_drain_grace``), then fail stragglers with the
+        retriable ``RequestDrainedError``. Idempotent."""
+        if grace is None:
+            grace = float(flags.flag("serving_drain_grace"))
+        grace = max(0.0, float(grace))
+        with self._lock:
+            if self._draining:
+                return
+            self._draining = True
+        self.drain_count += 1
+        metrics.bump("gateway.drains")
+        deadline = resilience.Deadline.after(grace)
+        for rep in self.replicas():
+            if rep.healthy:
+                rep.api.drain(max(0.0, min(grace, deadline.remaining())),
+                              reason=reason)
+        # every backend is now terminal: reconcile the routed handles (the
+        # _draining flag makes _observe propagate RequestDrainedError
+        # instead of re-routing)
+        with self._lock:
+            live = [rr for bucket in self._live.values() for rr in bucket]
+        for rr in live:
+            self._observe(rr)
+            if not rr.finished:
+                self._finalize(rr, RequestState.FAILED,
+                               resilience.RequestDrainedError(
+                                   f"{reason}: request drained before "
+                                   f"completion (grace={grace:g}s); safe "
+                                   f"to resubmit"))
+        self._refresh_gauges()
+
+    def close(self) -> None:
+        """Drain with zero grace and close every replica. Idempotent."""
+        if self._closed:
+            return
+        self.drain(grace=0.0, reason="ReplicaPool is closed")
+        for rep in self.replicas():
+            try:
+                rep.api.close()
+            except Exception:
+                _logger.exception("closing replica %d failed", rep.idx)
+        with self._lock:
+            self._closed = True
+
+    def scale_to(self, n: int, grace: Optional[float] = None) -> None:
+        """Scale the pool down to ``n`` replicas through ``drain(grace)``:
+        each retiring replica stops taking new routes, pumps its in-flight
+        requests to completion within the grace budget, and any stragglers
+        re-route onto the survivors — no accepted stream is dropped.
+        (Scale-UP is just respawn capacity: ejected replicas come back on
+        their own; adding brand-new replicas is not supported yet.)"""
+        n = int(n)
+        if n < 1:
+            raise ValueError("cannot scale below one replica")
+        while True:
+            with self._lock:
+                active = [r for r in self._replicas if not r.removed]
+                if len(active) <= n:
+                    return
+                # retire ejected (unhealthy) replicas first — scaling down
+                # must never remove the last healthy replica while a dead
+                # one idles toward respawn; among healthy ones, retire the
+                # highest index (keeps replica 0, the most-warmed, longest)
+                victim = None
+                for rep in reversed(active):
+                    if not rep.draining and not rep.healthy:
+                        victim = rep
+                        break
+                if victim is None:
+                    for rep in reversed(active):
+                        if not rep.draining:
+                            victim = rep
+                            break
+                if victim is None:
+                    return
+                victim.draining = True
+            self._remove_replica(victim, grace)
+
+    def _remove_replica(self, rep: _Replica,
+                        grace: Optional[float]) -> None:
+        if rep.healthy:
+            rep.api.drain(grace, reason=f"replica {rep.idx} scale-down")
+        with self._lock:
+            live = [r for r in self._live.get(rep.idx, ())
+                    if not r.finished]
+            self._live[rep.idx] = []
+            rep.removed = True
+            rep.healthy = False
+        for rr in live:
+            # completed-during-drain backends just finalize; stragglers
+            # failed with RequestDrainedError re-route to the survivors
+            # (_observe's draining-replica branch does the re-route itself;
+            # the explicit call only covers a backend that somehow never
+            # reached a terminal state)
+            self._observe(rr)
+            if not rr.finished and rr._replica_idx == rep.idx:
+                self._reroute(rr)
+        try:
+            rep.api.close()
+        except Exception:
+            _logger.exception("closing scaled-down replica %d failed",
+                              rep.idx)
+        metrics.bump("gateway.scale_downs")
+        self._refresh_gauges()
+
+    # ----------------------------------------------------- guard / gauges
+
+    def bind_preemption_guard(self, guard,
+                              grace: Optional[float] = None
+                              ) -> "ReplicaPool":
+        """SIGTERM/SIGINT drains the WHOLE pool instead of killing it
+        mid-decode: every replica's in-flight work gets the grace budget,
+        stragglers fail retriably — the fleet mirror of
+        ``ServingAPI.bind_preemption_guard``."""
+        self._guard = guard
+        self._guard_grace = grace
+        return self
+
+    def _check_guard(self) -> bool:
+        g = self._guard
+        if g is None or self._draining or not g.requested():
+            return False
+        metrics.bump("gateway.guard_drains")
+        self.drain(self._guard_grace,
+                   reason=f"preemption requested ({g.reason or 'signal'})")
+        return True
+
+    def _refresh_gauges(self) -> None:
+        with self._lock:
+            total = sum(1 for r in self._replicas if not r.removed)
+            healthy = sum(1 for r in self._replicas if r.routable())
+        metrics.set_gauge("gateway.replicas_total", total)
+        metrics.set_gauge("gateway.replicas_healthy", healthy)
+        metrics.set_gauge("gateway.outstanding", self.outstanding())
+
+    def stats(self) -> dict:
+        """Pool + tenant snapshot (the ``/v1/stats`` payload next to the
+        process-global ``serving.metrics`` counters)."""
+        with self._lock:
+            reps = [{"idx": r.idx, "healthy": r.healthy,
+                     "draining": r.draining, "removed": r.removed,
+                     "generation": r.generation, "ejections": r.ejections,
+                     "outstanding": (r.outstanding()
+                                     if not r.removed else 0)}
+                    for r in self._replicas]
+        return {"replicas": reps,
+                "replicas_total": sum(1 for r in reps if not r["removed"]),
+                "replicas_healthy": len(self.healthy_replicas()),
+                "capacity_slots": self.capacity(),
+                "outstanding": self.outstanding(),
+                "draining": self._draining,
+                "tenants": self.tenants.stats()}

@@ -24,6 +24,15 @@ Counter namespaces:
   ``hit_tokens`` (prefill tokens avoided, also ``tokens.prefill_avoided``)
   / ``inserted_blocks`` / ``evictions`` / ``cow_copies`` /
   ``suffix_prefills``
+* ``gateway.*``    — the multi-tenant front door (``serving.gateway``):
+  ``routed`` / ``rerouted`` (journaled fail-over onto a healthy replica) /
+  ``affinity_routes`` (warm-cache wins within the bounded slack) /
+  ``ejected`` / ``respawned`` (replica health) / ``scale_downs`` /
+  ``drains`` / ``guard_drains`` / ``http_submits`` / ``http_streams`` /
+  ``client_disconnects`` (mid-stream hangups, cancelled server-side)
+* ``tenant.*``     — quota admission: ``admitted`` / ``completed`` /
+  ``shed_rate`` / ``shed_concurrency`` / ``shed_share``, plus per-tenant
+  ``tenant.<name>.admitted`` / ``.shed`` / ``.tokens_out`` (goodput)
 
 Gauges: ``queue.depth``, ``slots.active``, ``slots.total``,
 ``arena.blocks_free``, ``arena.blocks_total``, ``arena.blocks_cached``
@@ -31,7 +40,9 @@ Gauges: ``queue.depth``, ``slots.active``, ``slots.total``,
 ``arena.kv_bytes``, ``arena.frag_tokens`` (allocated-block capacity minus
 live context tokens — internal fragmentation of the paged cache),
 ``prefix.resident_blocks``, ``tokens_per_sec`` (the engine's
-lifetime-aggregate decode rate from its :class:`Meter`).
+lifetime-aggregate decode rate from its :class:`Meter`),
+``gateway.replicas_healthy`` / ``gateway.replicas_total`` /
+``gateway.outstanding`` (the router's fleet picture).
 """
 from __future__ import annotations
 

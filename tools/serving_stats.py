@@ -25,9 +25,16 @@ recovery), ``api.drains`` / ``api.drain_stragglers`` / ``api.recoveries``.
 So do the radix prefix cache's (``FLAGS_serving_prefix_cache``):
 ``prefix.hits`` / ``prefix.hit_tokens`` (prefill tokens avoided) /
 ``prefix.inserted_blocks`` / ``prefix.evictions`` / ``prefix.cow_copies``.
-A run report also prints the end-of-run arena/prefix gauges (occupancy,
-cached/resident blocks, high-water, fragmentation) next to the delta —
-point-in-time state, not differenced.
+The multi-tenant gateway's counters ride it too (``serving.gateway``):
+``gateway.routed`` / ``gateway.rerouted`` (journaled fail-over) /
+``gateway.ejected`` / ``gateway.respawned`` (replica health) /
+``gateway.affinity_routes`` / ``gateway.drains``, plus tenant admission:
+``tenant.admitted`` / ``tenant.shed_rate`` / ``tenant.shed_concurrency`` /
+``tenant.shed_share`` and the per-tenant ``tenant.<name>.tokens_out``
+goodput counters.
+A run report also prints the end-of-run arena/prefix/gateway gauges
+(occupancy, cached/resident blocks, high-water, fragmentation, replica
+health) next to the delta — point-in-time state, not differenced.
 After the script returns, every ServingAPI it left open is drained
 (``serving.drain_all``) so the reported run always exercises the graceful
 shutdown path and no engine exits holding live slots or arena blocks.
@@ -71,6 +78,17 @@ def _config_report() -> dict:
         "serving_prefix_cache": _flag_env("serving_prefix_cache", 0),
         "serving_cache_affinity": _flag_env("serving_cache_affinity", 0),
         "serving_arena_invariants": _flag_env("serving_arena_invariants", 0),
+        # multi-tenant gateway (serving.gateway: router/tenancy/front door)
+        "serving_replicas": _flag_env("serving_replicas", 2),
+        "gateway_port": _flag_env("gateway_port", 8100),
+        "gateway_affinity_slack": _flag_env("gateway_affinity_slack", 2),
+        "gateway_max_reroutes": _flag_env("gateway_max_reroutes", 3),
+        "gateway_respawn_backoff": _flag_env("gateway_respawn_backoff", 0.5),
+        "gateway_tenant_rate": _flag_env("gateway_tenant_rate", 0.0),
+        "gateway_tenant_burst": _flag_env("gateway_tenant_burst", 0.0),
+        "gateway_tenant_concurrency": _flag_env("gateway_tenant_concurrency",
+                                                0),
+        "gateway_fair_share": _flag_env("gateway_fair_share", 1),
     }
 
 
@@ -116,7 +134,8 @@ def main(argv=None) -> int:
         # end-of-run arena/prefix gauges: point-in-time occupancy picture
         # (cached blocks, high-water, fragmentation), NOT differenced
         gauges = {k: v for k, v in metrics.gauges().items()
-                  if k.split(".")[0] in ("arena", "prefix", "slots")}
+                  if k.split(".")[0] in ("arena", "prefix", "slots",
+                                         "gateway", "tenant")}
         rec = {"wall_secs": round(wall, 3), "stats": delta,
                "gauges": gauges,
                "tokens_per_sec": round(toks / wall, 2) if wall > 0 else None}
