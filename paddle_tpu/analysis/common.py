@@ -1,0 +1,264 @@
+"""Shared infrastructure for the framework lint (`paddle_tpu.analysis`).
+
+One :class:`SourceFile` per analyzed module (text + parsed AST + the
+suppression table extracted from its comments), one :class:`Finding` per
+reported defect, and the matching machinery for the two ways a finding is
+accepted without failing the gate:
+
+* **inline suppression** — ``# analysis: allow(<rule>) — <reason>`` on the
+  finding's line (or the line directly above it). The reason is mandatory:
+  an allow() without one is itself reported (``suppression-missing-reason``)
+  so suppressions stay auditable.
+* **baseline** — ``tools/analysis_baseline.json`` entries keyed by
+  ``(rule, path, scope)`` (scope = enclosing ``Class.method`` qualname, so
+  entries survive unrelated edits shifting line numbers). Every entry
+  carries a one-line ``why``; the gate test fails on entries that no longer
+  match anything (stale baseline) and on findings no entry covers.
+
+Analyzers are pure-AST — no imports of the analyzed code — so the suite is
+deterministic and fast enough (<10s over the whole package) to run in
+tier-1.
+"""
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+#: ``# analysis: allow(rule-a, rule-b) — reason`` (em/en dash or ``-``/``:``
+#: accepted before the reason; the reason itself is required)
+_ALLOW_RE = re.compile(
+    r"#\s*analysis:\s*allow\(\s*([a-zA-Z0-9_,\- ]+?)\s*\)"
+    r"\s*(?:[—–:-]+\s*(?P<reason>\S.*))?$")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One lint finding. ``scope`` is the enclosing qualname
+    (``Class.method``, ``function``, or ``<module>``) — the stable half of
+    the baseline key."""
+
+    rule: str
+    path: str      # repo-relative, forward slashes
+    line: int
+    scope: str
+    message: str
+
+    def key(self) -> Tuple[str, str, str]:
+        return (self.rule, self.path, self.scope)
+
+    def __str__(self) -> str:
+        return (f"{self.path}:{self.line}: [{self.rule}] {self.message} "
+                f"(in {self.scope})")
+
+
+@dataclass
+class Suppression:
+    line: int
+    rules: Tuple[str, ...]
+    reason: str
+    used: bool = False
+
+
+class SourceFile:
+    """One parsed module: raw text, AST, scope map, suppressions."""
+
+    def __init__(self, path: str, relpath: str, text: str):
+        self.path = path
+        self.relpath = relpath.replace(os.sep, "/")
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree: Optional[ast.AST] = None
+        self.parse_error: Optional[str] = None
+        try:
+            self.tree = ast.parse(text, filename=relpath)
+        except SyntaxError as e:
+            self.parse_error = f"{e.msg} (line {e.lineno})"
+        self.suppressions: Dict[int, Suppression] = {}
+        self._scan_suppressions()
+        self._scopes: Optional[List[Tuple[int, int, str]]] = None
+
+    # ------------------------------------------------------- suppressions
+
+    def _scan_suppressions(self) -> None:
+        for i, line in enumerate(self.lines, start=1):
+            if "analysis:" not in line:
+                continue
+            m = _ALLOW_RE.search(line)
+            if m is None:
+                continue
+            rules = tuple(r.strip() for r in m.group(1).split(",")
+                          if r.strip())
+            reason = (m.group("reason") or "").strip()
+            self.suppressions[i] = Suppression(i, rules, reason)
+
+    def suppression_for(self, rule: str, line: int) -> Optional[Suppression]:
+        """An allow() on the finding's line, in the contiguous comment
+        block directly above it, or in the leading comment block directly
+        below it (the natural placement inside an ``except:`` handler
+        body). Multi-line justifications are encouraged — the allow() line
+        itself must still carry the rule and the start of the reason."""
+        sup = self.suppressions.get(line)
+        if sup is not None and (rule in sup.rules or "all" in sup.rules):
+            return sup
+        for step in (-1, 1):
+            ln = line + step
+            while 1 <= ln <= len(self.lines):
+                if not self.lines[ln - 1].strip().startswith("#"):
+                    break
+                sup = self.suppressions.get(ln)
+                if sup is not None and (rule in sup.rules
+                                        or "all" in sup.rules):
+                    return sup
+                ln += step
+        return None
+
+    # ------------------------------------------------------------- scopes
+
+    def _build_scopes(self) -> List[Tuple[int, int, str]]:
+        spans: List[Tuple[int, int, str]] = []
+        if self.tree is None:
+            return spans
+
+        def visit(node: ast.AST, prefix: str) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.ClassDef)):
+                    qual = f"{prefix}.{child.name}" if prefix else child.name
+                    end = getattr(child, "end_lineno", child.lineno)
+                    spans.append((child.lineno, end, qual))
+                    visit(child, qual)
+                else:
+                    visit(child, prefix)
+
+        visit(self.tree, "")
+        # innermost span wins: sort by size descending so later (smaller)
+        # spans override earlier ones in scope_at's linear scan
+        spans.sort(key=lambda s: -(s[1] - s[0]))
+        return spans
+
+    def scope_at(self, line: int) -> str:
+        if self._scopes is None:
+            self._scopes = self._build_scopes()
+        best = "<module>"
+        for lo, hi, qual in self._scopes:
+            if lo <= line <= hi:
+                best = qual
+        return best
+
+    def finding(self, rule: str, line: int, message: str) -> Finding:
+        return Finding(rule, self.relpath, line, self.scope_at(line), message)
+
+
+# --------------------------------------------------------------- corpus IO
+
+#: directory names never worth walking into
+_SKIP_DIRS = {"__pycache__", ".git", ".pytest_cache", "node_modules",
+              "build", "dist", ".eggs"}
+
+
+def iter_python_files(paths: Sequence[str], root: str) -> List[str]:
+    """Expand files/directories into a sorted list of ``.py`` paths."""
+    out: List[str] = []
+    for p in paths:
+        ap = p if os.path.isabs(p) else os.path.join(root, p)
+        if os.path.isfile(ap) and ap.endswith(".py"):
+            out.append(ap)
+        elif os.path.isdir(ap):
+            for dirpath, dirnames, filenames in os.walk(ap):
+                dirnames[:] = [d for d in sorted(dirnames)
+                               if d not in _SKIP_DIRS]
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        out.append(os.path.join(dirpath, fn))
+    seen, uniq = set(), []
+    for p in out:
+        if p not in seen:
+            seen.add(p)
+            uniq.append(p)
+    return uniq
+
+
+def load_corpus(paths: Sequence[str], root: str) -> List[SourceFile]:
+    corpus = []
+    for path in iter_python_files(paths, root):
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                text = f.read()
+        except (OSError, UnicodeDecodeError):
+            continue
+        rel = os.path.relpath(path, root)
+        corpus.append(SourceFile(path, rel, text))
+    return corpus
+
+
+# ---------------------------------------------------------------- baseline
+
+@dataclass
+class BaselineEntry:
+    rule: str
+    path: str
+    scope: str
+    why: str
+    matched: int = 0
+
+    def key(self) -> Tuple[str, str, str]:
+        return (self.rule, self.path, self.scope)
+
+
+def load_baseline(path: str) -> List[BaselineEntry]:
+    if not os.path.exists(path):
+        return []
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    return [BaselineEntry(e["rule"], e["path"], e["scope"],
+                          e.get("why", ""))
+            for e in data.get("entries", [])]
+
+
+def save_baseline(path: str, entries: Iterable[BaselineEntry]) -> None:
+    data = {
+        "version": 1,
+        "comment": ("Accepted pre-existing findings of tools/analyze.py. "
+                    "Keyed by (rule, path, scope) so unrelated edits don't "
+                    "churn entries; every entry must carry a one-line "
+                    "'why'. New code should use inline "
+                    "'# analysis: allow(<rule>) -- <reason>' instead."),
+        "entries": [{"rule": e.rule, "path": e.path, "scope": e.scope,
+                     "why": e.why}
+                    for e in sorted(entries,
+                                    key=lambda e: (e.rule, e.path, e.scope))],
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(data, f, indent=1)
+        f.write("\n")
+
+
+@dataclass
+class Report:
+    """Outcome of one analysis run (before/after baseline filtering)."""
+
+    findings: List[Finding] = field(default_factory=list)
+    suppressed: List[Finding] = field(default_factory=list)
+    files: int = 0
+    parse_errors: Dict[str, str] = field(default_factory=dict)
+    elapsed: float = 0.0
+
+    def apply_baseline(self, entries: List[BaselineEntry]
+                       ) -> Tuple[List[Finding], List[BaselineEntry]]:
+        """(new findings not covered by the baseline, stale entries that
+        matched nothing)."""
+        by_key: Dict[Tuple[str, str, str], BaselineEntry] = {
+            e.key(): e for e in entries}
+        new: List[Finding] = []
+        for f in self.findings:
+            entry = by_key.get(f.key())
+            if entry is None:
+                new.append(f)
+            else:
+                entry.matched += 1
+        stale = [e for e in entries if e.matched == 0]
+        return new, stale

@@ -1,0 +1,417 @@
+"""Recompile-hazard / tracer-leak lint for compiled (jit) code.
+
+The compile cache's trace counters catch recompile storms *at runtime*;
+this analyzer catches the hazard classes *statically*, in any function
+reachable from a ``jax.jit`` / ``@to_static`` entry point:
+
+* ``traced-branch`` — Python ``if``/``while`` on a traced array value.
+  Under trace this either raises (ConcretizationTypeError) or, worse,
+  silently bakes one branch into the executable. ``is None`` checks and
+  static accessors (``.shape`` / ``.ndim`` / ``.dtype`` / ``len()``) are
+  fine and not flagged.
+* ``traced-cast`` — ``bool()`` / ``int()`` / ``float()`` / ``.item()`` /
+  ``np.asarray()`` on a traced value: forces a device sync at best, a
+  tracer leak at worst. ``int(x.shape[i])`` is static and allowed.
+* ``mutable-global-capture`` — a module-level mutable (dict/list/set, or a
+  name rebound via ``global``) read inside a compiled function: its value
+  is baked at trace time, so later mutation silently diverges from the
+  compiled executable (the classic "why didn't my flag change anything").
+* ``shape-from-data`` — ``nonzero`` / ``unique`` / single-argument
+  ``where`` / boolean-mask indexing on traced values: output shape depends
+  on data, which XLA cannot compile (or pads unpredictably).
+* ``use-after-donate`` — a buffer passed at a donated position of a
+  ``jax.jit(..., donate_argnums=...)`` callable and then read again: the
+  donated buffer's memory was reused by XLA, the read returns garbage (or
+  raises on TPU). The compile cache made donation flag-gated precisely
+  because of this class of bug.
+
+Reachability is per-module: a function is "compiled" when it is decorated
+with ``jax.jit`` / ``jit`` / ``to_static`` (bare or parameterized), passed
+to ``jax.jit(...)`` anywhere in the module, or called (transitively) from
+such a function. Parameters listed in ``static_argnums`` /
+``static_argnames`` are treated as static, everything else as traced.
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from .common import Finding, SourceFile
+
+_JIT_NAMES = {"jit", "to_static", "pjit"}
+_STATIC_ACCESSORS = {"shape", "ndim", "dtype", "size", "sharding"}
+_STATIC_CALLS = {"len", "isinstance", "getattr", "hasattr", "type",
+                 "range", "enumerate", "zip"}
+_CAST_CALLS = {"bool", "int", "float"}
+_SHAPE_FROM_DATA = {"nonzero", "unique", "flatnonzero", "argwhere"}
+
+
+def _callable_name(f: ast.AST) -> str:
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return ""
+
+
+def _is_jit_expr(node: ast.AST) -> Optional[ast.Call]:
+    """The Call node when ``node`` is ``jax.jit(...)`` / ``jit(...)`` /
+    ``to_static(...)`` / ``partial(jax.jit, ...)``."""
+    if not isinstance(node, ast.Call):
+        return None
+    name = _callable_name(node.func)
+    if name in _JIT_NAMES:
+        return node
+    if name == "partial" and node.args:
+        inner = _callable_name(node.args[0])
+        if inner in _JIT_NAMES:
+            return node
+    return None
+
+
+@dataclass
+class _FnInfo:
+    node: ast.FunctionDef
+    qual: str
+    compiled: bool = False
+    static_params: Set[str] = field(default_factory=set)
+    donate_idx: Tuple[int, ...] = ()
+
+
+class CompiledCodeAnalyzer:
+    name = "compiled"
+    rules = ("traced-branch", "traced-cast", "mutable-global-capture",
+             "shape-from-data", "use-after-donate")
+
+    def relevant(self, relpath: str) -> bool:
+        return relpath.startswith("paddle_tpu/")
+
+    def analyze(self, corpus: List[SourceFile]) -> List[Finding]:
+        findings: List[Finding] = []
+        for sf in corpus:
+            if sf.tree is None or not self.relevant(sf.relpath):
+                continue
+            findings.extend(self._analyze_module(sf))
+        return findings
+
+    # ------------------------------------------------------------- module
+
+    def _analyze_module(self, sf: SourceFile) -> List[Finding]:
+        fns: Dict[str, _FnInfo] = {}       # simple name -> info (last def)
+        mutable_globals: Set[str] = set()
+        rebound_globals: Set[str] = set()
+
+        for node in sf.tree.body:
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Name) and isinstance(
+                            node.value, (ast.Dict, ast.List, ast.Set,
+                                         ast.DictComp, ast.ListComp,
+                                         ast.SetComp)):
+                        mutable_globals.add(t.id)
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Global):
+                rebound_globals.update(node.names)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fns.setdefault(node.name, _FnInfo(node, node.name))
+
+        # entry points: decorated, or passed to a jit call anywhere
+        jit_of: Dict[str, ast.Call] = {}
+        for info in fns.values():
+            for dec in info.node.decorator_list:
+                call = _is_jit_expr(dec)
+                if call is not None or _callable_name(dec) in _JIT_NAMES:
+                    info.compiled = True
+                    if call is not None:
+                        self._apply_jit_opts(info, call)
+        for node in ast.walk(sf.tree):
+            call = _is_jit_expr(node)
+            if call is None:
+                continue
+            for arg in call.args[:1] or ():
+                name = _callable_name(arg)
+                if name in fns:
+                    fns[name].compiled = True
+                    self._apply_jit_opts(fns[name], call)
+                    jit_of.setdefault(name, call)
+
+        # transitive closure over same-module calls
+        changed = True
+        while changed:
+            changed = False
+            for info in fns.values():
+                if not info.compiled:
+                    continue
+                for sub in ast.walk(info.node):
+                    if isinstance(sub, ast.Call):
+                        callee = _callable_name(sub.func)
+                        target = fns.get(callee)
+                        if target is not None and not target.compiled \
+                                and target.node is not info.node:
+                            target.compiled = True
+                            target.static_params = set(info.static_params)
+                            changed = True
+
+        findings: List[Finding] = []
+        for info in fns.values():
+            if info.compiled:
+                findings.extend(self._check_compiled_fn(
+                    sf, info, mutable_globals, rebound_globals))
+            # use-after-donate applies to the CALLER side, compiled or not
+            findings.extend(self._check_donation(sf, info.node))
+        return findings
+
+    def _apply_jit_opts(self, info: _FnInfo, call: ast.Call) -> None:
+        params = [a.arg for a in call_args_of(info.node)]
+        for kw in call.keywords:
+            if kw.arg == "static_argnums":
+                for idx in _int_tuple(kw.value):
+                    if 0 <= idx < len(params):
+                        info.static_params.add(params[idx])
+            elif kw.arg == "static_argnames":
+                info.static_params.update(_str_tuple(kw.value))
+            elif kw.arg == "donate_argnums":
+                info.donate_idx = _int_tuple(kw.value)
+
+    # ------------------------------------------------ per-function checks
+
+    def _check_compiled_fn(self, sf: SourceFile, info: _FnInfo,
+                           mutable_globals: Set[str],
+                           rebound_globals: Set[str]) -> List[Finding]:
+        node = info.node
+        findings: List[Finding] = []
+        traced: Set[str] = set()
+        for a in call_args_of(node):
+            if a.arg in ("self", "cls") or a.arg in info.static_params:
+                continue
+            # a scalar type annotation is a staticness contract: `k: int`
+            # params are Python values closed over at trace time
+            ann = a.annotation
+            if isinstance(ann, ast.Name) and ann.id in (
+                    "int", "float", "bool", "str"):
+                continue
+            traced.add(a.arg)
+        local_names: Set[str] = set()
+        for sub in ast.walk(node):
+            for t in getattr(sub, "targets", []) or []:
+                if isinstance(t, ast.Name):
+                    local_names.add(t.id)
+
+        def is_traced(e: ast.AST) -> bool:
+            if isinstance(e, ast.Name):
+                return e.id in traced
+            if isinstance(e, ast.Attribute):
+                if e.attr in _STATIC_ACCESSORS:
+                    return False
+                return is_traced(e.value)
+            if isinstance(e, ast.Call):
+                fname = _callable_name(e.func)
+                if fname in _STATIC_CALLS:
+                    return False
+                args_traced = any(is_traced(a) for a in e.args) or any(
+                    is_traced(kw.value) for kw in e.keywords)
+                if isinstance(e.func, ast.Attribute):
+                    return args_traced or is_traced(e.func.value)
+                return args_traced
+            if isinstance(e, ast.Subscript):
+                return is_traced(e.value)
+            if isinstance(e, (ast.Constant, ast.Lambda)):
+                return False
+            return any(is_traced(c) for c in ast.iter_child_nodes(e))
+
+        def is_static_compare(test: ast.AST) -> bool:
+            # `x is None` and `"key" [not] in pytree` are static under
+            # trace (identity / dict-key membership, never array values)
+            if isinstance(test, ast.Compare) and all(
+                    isinstance(op, (ast.Is, ast.IsNot, ast.In, ast.NotIn))
+                    for op in test.ops):
+                return True
+            return False
+
+        for sub in ast.walk(node):
+            # propagate tracedness through simple assignments
+            if isinstance(sub, ast.Assign) and is_traced(sub.value):
+                for t in sub.targets:
+                    if isinstance(t, ast.Name):
+                        traced.add(t.id)
+            if isinstance(sub, (ast.If, ast.While)):
+                if not is_static_compare(sub.test) and is_traced(sub.test):
+                    kind = ("while" if isinstance(sub, ast.While) else "if")
+                    findings.append(sf.finding(
+                        "traced-branch", sub.lineno,
+                        f"Python `{kind}` on a traced value in compiled "
+                        f"`{info.qual}`: concretizes the tracer (or bakes "
+                        f"one branch in); use lax.cond/lax.while_loop or "
+                        f"hoist the value to a static arg"))
+            elif isinstance(sub, ast.Call):
+                fname = _callable_name(sub.func)
+                if fname in _CAST_CALLS and sub.args \
+                        and is_traced(sub.args[0]):
+                    findings.append(sf.finding(
+                        "traced-cast", sub.lineno,
+                        f"`{fname}()` on a traced value in compiled "
+                        f"`{info.qual}`: forces concretization "
+                        f"(device sync / tracer error)"))
+                elif fname == "item" and isinstance(sub.func, ast.Attribute) \
+                        and is_traced(sub.func.value):
+                    findings.append(sf.finding(
+                        "traced-cast", sub.lineno,
+                        f"`.item()` on a traced value in compiled "
+                        f"`{info.qual}`: forces concretization"))
+                elif fname == "asarray" and isinstance(
+                        sub.func, ast.Attribute) and isinstance(
+                        sub.func.value, ast.Name) \
+                        and sub.func.value.id == "np" \
+                        and sub.args and is_traced(sub.args[0]):
+                    findings.append(sf.finding(
+                        "traced-cast", sub.lineno,
+                        f"`np.asarray()` on a traced value in compiled "
+                        f"`{info.qual}`: host transfer under trace"))
+                elif fname in _SHAPE_FROM_DATA and (
+                        (sub.args and is_traced(sub.args[0]))
+                        or (isinstance(sub.func, ast.Attribute)
+                            and is_traced(sub.func.value))):
+                    findings.append(sf.finding(
+                        "shape-from-data", sub.lineno,
+                        f"`{fname}` in compiled `{info.qual}`: output "
+                        f"shape depends on data — XLA cannot compile it; "
+                        f"use a mask or jnp.where(cond, a, b)"))
+                elif fname == "where" and len(sub.args) == 1 \
+                        and is_traced(sub.args[0]):
+                    findings.append(sf.finding(
+                        "shape-from-data", sub.lineno,
+                        f"single-argument `where` in compiled "
+                        f"`{info.qual}` returns data-dependent shapes; "
+                        f"use the three-argument form"))
+            elif isinstance(sub, ast.Subscript) and isinstance(
+                    sub.ctx, ast.Load):
+                sl = sub.slice
+                if is_traced(sub.value) and is_traced(sl) \
+                        and self._looks_boolean_mask(sl):
+                    findings.append(sf.finding(
+                        "shape-from-data", sub.lineno,
+                        f"boolean-mask indexing in compiled "
+                        f"`{info.qual}`: result shape depends on the "
+                        f"mask's data; use jnp.where instead"))
+            elif isinstance(sub, ast.Name) and isinstance(
+                    sub.ctx, ast.Load):
+                if (sub.id in mutable_globals
+                        or sub.id in rebound_globals) \
+                        and sub.id not in traced \
+                        and sub.id not in local_names:
+                    findings.append(sf.finding(
+                        "mutable-global-capture", sub.lineno,
+                        f"module-level mutable `{sub.id}` read inside "
+                        f"compiled `{info.qual}`: its value is baked at "
+                        f"trace time, later mutation silently diverges "
+                        f"from the executable; pass it as an argument or "
+                        f"close over an immutable snapshot"))
+        return findings
+
+    @staticmethod
+    def _looks_boolean_mask(sl: ast.AST) -> bool:
+        """A Compare (x > 0) or a name ending in mask/cond used as index."""
+        if isinstance(sl, ast.Compare):
+            return True
+        if isinstance(sl, ast.Name) and any(
+                s in sl.id.lower() for s in ("mask", "cond", "bool")):
+            return True
+        return False
+
+    # --------------------------------------------------- donation tracking
+
+    def _check_donation(self, sf: SourceFile,
+                        fn: ast.FunctionDef) -> List[Finding]:
+        """Within one function body: ``g = jax.jit(f, donate_argnums=..)``
+        then ``g(buf)`` followed by a later read of ``buf``."""
+        findings: List[Finding] = []
+        donated_callables: Dict[str, Tuple[int, ...]] = {}
+        dead: Dict[str, int] = {}  # name -> line it was donated at
+        for stmt in fn.body:
+            findings.extend(self._donation_stmt(
+                sf, fn, stmt, donated_callables, dead))
+        return findings
+
+    def _donation_stmt(self, sf, fn, stmt, donated_callables, dead):
+        findings: List[Finding] = []
+        # reassignment revives a name
+        if isinstance(stmt, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = (stmt.targets if isinstance(stmt, ast.Assign)
+                       else [stmt.target])
+            value = getattr(stmt, "value", None)
+            if value is not None:
+                # reads in the value happen before the assignment...
+                findings.extend(self._donation_reads(sf, fn, value, dead))
+                # ...then any donating call in the value kills its args...
+                self._mark_donated(value, donated_callables, dead)
+            call = _is_jit_expr(value) if value is not None else None
+            # ...and finally rebinding a name to the result revives it
+            flat = []
+            for t in targets:
+                flat.extend(t.elts if isinstance(t, (ast.Tuple, ast.List))
+                            else [t])
+            for t in flat:
+                if not isinstance(t, ast.Name):
+                    continue
+                dead.pop(t.id, None)
+                if call is not None:
+                    idx = ()
+                    for kw in call.keywords:
+                        if kw.arg == "donate_argnums":
+                            idx = _int_tuple(kw.value)
+                    if idx:
+                        donated_callables[t.id] = idx
+            return findings
+        findings.extend(self._donation_reads(sf, fn, stmt, dead))
+        self._mark_donated(stmt, donated_callables, dead)
+        return findings
+
+    @staticmethod
+    def _mark_donated(node, donated_callables, dead):
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                idx = donated_callables.get(_callable_name(sub.func))
+                for i in idx or ():
+                    if 0 <= i < len(sub.args) and isinstance(
+                            sub.args[i], ast.Name):
+                        dead[sub.args[i].id] = sub.lineno
+
+    def _donation_reads(self, sf, fn, node, dead):
+        findings = []
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Load) \
+                    and sub.id in dead and sub.lineno > dead[sub.id]:
+                findings.append(sf.finding(
+                    "use-after-donate", sub.lineno,
+                    f"`{sub.id}` read after being passed at a donated "
+                    f"position (donated at line {dead[sub.id]}): XLA "
+                    f"reused its buffer — the read returns garbage or "
+                    f"raises on TPU"))
+                dead.pop(sub.id)  # one finding per donation
+        return findings
+
+
+def call_args_of(node: ast.FunctionDef) -> List[ast.arg]:
+    a = node.args
+    return list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)
+
+
+def _int_tuple(node: ast.AST) -> Tuple[int, ...]:
+    out = []
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    for e in getattr(node, "elts", []) or []:
+        if isinstance(e, ast.Constant) and isinstance(e.value, int):
+            out.append(e.value)
+    return tuple(out)
+
+
+def _str_tuple(node: ast.AST) -> Tuple[str, ...]:
+    out = []
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    for e in getattr(node, "elts", []) or []:
+        if isinstance(e, ast.Constant) and isinstance(e.value, str):
+            out.append(e.value)
+    return tuple(out)

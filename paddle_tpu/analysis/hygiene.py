@@ -1,0 +1,70 @@
+"""Error-handling hygiene: the ``broad-except`` rule.
+
+``except Exception:`` in a serving/gateway hot path swallows the error
+taxonomy the whole retry/shed/reroute machinery is built on
+(``ServingDeviceError``, ``QuotaExceededError``, ``RequestDrainedError``,
+...): a handler that catches everything cannot tell a retriable shed from
+a crash, so it either retries the unretriable or drops the retriable.
+
+Every ``except Exception`` / ``except BaseException`` / bare ``except:``
+in ``paddle_tpu/`` must therefore either
+
+* be **narrowed** to the concrete error taxonomy it actually handles, or
+* carry ``# analysis: allow(broad-except) — <reason>`` stating why broad
+  is correct there (classification happens inside the handler,
+  observability must never block import, shutdown epilogues must not turn
+  a clean exit into a traceback, ...).
+
+Handlers that immediately ``raise`` unconditionally (pure
+cleanup-and-reraise) still need the annotation — the reviewer-facing point
+is that every broad catch is a *decision*, recorded next to the code.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from .common import Finding, SourceFile
+
+
+class HygieneAnalyzer:
+    name = "hygiene"
+    rules = ("broad-except",)
+
+    def relevant(self, relpath: str) -> bool:
+        return relpath.startswith("paddle_tpu/")
+
+    def analyze(self, corpus: List[SourceFile]) -> List[Finding]:
+        findings: List[Finding] = []
+        for sf in corpus:
+            if sf.tree is None or not self.relevant(sf.relpath):
+                continue
+            for node in ast.walk(sf.tree):
+                if not isinstance(node, ast.ExceptHandler):
+                    continue
+                kind = self._broad_kind(node.type)
+                if kind is None:
+                    continue
+                findings.append(sf.finding(
+                    "broad-except", node.lineno,
+                    f"`except {kind}` swallows the error taxonomy: narrow "
+                    f"it to the concrete errors this handler owns, or "
+                    f"annotate why broad is correct here"))
+        return findings
+
+    @staticmethod
+    def _broad_kind(type_node) -> str:
+        if type_node is None:
+            return "<bare>"
+        names = []
+        nodes = (type_node.elts if isinstance(type_node, ast.Tuple)
+                 else [type_node])
+        for n in nodes:
+            if isinstance(n, ast.Name):
+                names.append(n.id)
+            elif isinstance(n, ast.Attribute):
+                names.append(n.attr)
+        for broad in ("Exception", "BaseException"):
+            if broad in names:
+                return broad
+        return None

@@ -1,0 +1,222 @@
+"""Registry-consistency lint: flags and metric keys.
+
+``core/flags.py`` is the single source of truth for ``FLAGS_*``: every flag
+referenced anywhere in the framework (env-var strings, docstrings,
+``flags.flag("x")`` / ``get_flags`` / ``set_flags`` literals) must resolve
+to a ``define_flag(...)`` declaration, and every declaration must be read
+by something — before this lint existed, 36 referenced names had no
+mechanical link to the registry and dead declarations accumulated
+silently.
+
+* ``undefined-flag`` — a ``FLAGS_<name>`` reference (or a literal flag-API
+  name) with no ``define_flag`` declaration. Anchored at the referencing
+  line.
+* ``dead-flag`` — a ``define_flag`` declaration nothing outside
+  ``flags.py`` reads. Anchored at the declaration. Skipped when the run
+  only covers a subset of files (``--changed`` mode cannot prove death).
+* ``unknown-metric-key`` — a literal key passed to ``metrics.bump`` /
+  ``metrics.set_gauge`` / ``resilience.bump`` whose namespace (the segment
+  before the first ``.``) is not in the owning module's documented
+  namespace registry (``serving.metrics.DOCUMENTED_NAMESPACES``,
+  ``core.resilience.DOCUMENTED_NAMESPACES``). Dashboards and the stats
+  CLIs group by namespace — an unregistered one is invisible to all of
+  them.
+
+Reference extraction is text-level for ``FLAGS_<name>`` tokens (they live
+in strings and docstrings) with two filters: names ending in ``_`` and
+names followed by ``*``/``<``/``{`` are prose placeholders
+(``FLAGS_gateway_tenant_*``), not references.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from .common import Finding, SourceFile
+
+#: leading boundary so identifiers merely *containing* the token (e.g. a
+#: constant named ``_FLAGS_MODULE``) are not counted as flag references
+_FLAG_REF_RE = re.compile(r"(?<![A-Za-z0-9_])FLAGS_([a-z][A-Za-z0-9_]*)")
+_FLAGS_MODULE = "paddle_tpu/core/flags.py"
+_METRIC_REGISTRIES = {
+    # call-target module prefix -> file that documents its namespaces
+    "metrics": "paddle_tpu/serving/metrics.py",
+    "resilience": "paddle_tpu/core/resilience.py",
+}
+
+
+class RegistryAnalyzer:
+    name = "registry"
+    rules = ("undefined-flag", "dead-flag", "unknown-metric-key")
+
+    def __init__(self, full_corpus: bool = True):
+        #: False when analyzing a subset (--changed): dead-flag needs the
+        #: whole reference corpus to prove a declaration unread
+        self.full_corpus = full_corpus
+
+    def relevant(self, relpath: str) -> bool:
+        return not relpath.startswith("tests/")
+
+    def analyze(self, corpus: List[SourceFile]) -> List[Finding]:
+        findings: List[Finding] = []
+        flags_sf = next((sf for sf in corpus
+                         if sf.relpath == _FLAGS_MODULE), None)
+        declared = self._declared_flags(flags_sf)
+
+        referenced: Dict[str, List[Tuple[SourceFile, int]]] = {}
+        for sf in corpus:
+            if not self.relevant(sf.relpath):
+                continue
+            for name, line in self._flag_refs(sf):
+                referenced.setdefault(name, []).append((sf, line))
+
+        if declared is not None:
+            for name, sites in sorted(referenced.items()):
+                if name in declared:
+                    continue
+                sf, line = sites[0]
+                findings.append(sf.finding(
+                    "undefined-flag", line,
+                    f"FLAGS_{name} is referenced ({len(sites)} site(s)) "
+                    f"but has no define_flag() declaration in "
+                    f"core/flags.py — a typo, or an undeclared contract"))
+            if self.full_corpus and flags_sf is not None:
+                for name, line in sorted(declared.items()):
+                    if name not in referenced:
+                        findings.append(flags_sf.finding(
+                            "dead-flag", line,
+                            f"define_flag({name!r}) is read by nothing "
+                            f"outside flags.py: delete it, or reference "
+                            f"it where the behavior lives"))
+
+        findings.extend(self._check_metric_keys(corpus))
+        return findings
+
+    # -------------------------------------------------------------- flags
+
+    def _declared_flags(self, flags_sf: Optional[SourceFile]
+                        ) -> Optional[Dict[str, int]]:
+        if flags_sf is None or flags_sf.tree is None:
+            return None
+        out: Dict[str, int] = {}
+        for node in ast.walk(flags_sf.tree):
+            if isinstance(node, ast.Call) \
+                    and _call_name(node.func) == "define_flag" \
+                    and node.args and isinstance(node.args[0], ast.Constant):
+                out[str(node.args[0].value)] = node.lineno
+        return out
+
+    def _flag_refs(self, sf: SourceFile) -> List[Tuple[str, int]]:
+        refs: List[Tuple[str, int]] = []
+        if sf.relpath != _FLAGS_MODULE:
+            for i, line in enumerate(sf.lines, start=1):
+                for m in _FLAG_REF_RE.finditer(line):
+                    name = m.group(1)
+                    tail = line[m.end():m.end() + 1]
+                    if name.endswith("_") or tail in ("*", "<", "{"):
+                        continue  # prose placeholder, not a reference
+                    refs.append((name, i))
+        if sf.tree is None:
+            return refs
+        # literal names through the flag API (flag("x"), get/set_flags)
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            cname = _call_name(node.func)
+            if cname == "flag" and node.args and isinstance(
+                    node.args[0], ast.Constant) and isinstance(
+                    node.args[0].value, str):
+                if sf.relpath != _FLAGS_MODULE:
+                    refs.append((node.args[0].value, node.lineno))
+            elif cname in ("get_flags", "set_flags") and node.args:
+                arg = node.args[0]
+                names: List[Tuple[str, int]] = []
+                if isinstance(arg, ast.Constant) and isinstance(
+                        arg.value, str):
+                    names.append((arg.value, arg.lineno))
+                elif isinstance(arg, (ast.List, ast.Tuple)):
+                    names.extend((e.value, e.lineno) for e in arg.elts
+                                 if isinstance(e, ast.Constant)
+                                 and isinstance(e.value, str))
+                elif isinstance(arg, ast.Dict):
+                    names.extend((k.value, k.lineno) for k in arg.keys
+                                 if isinstance(k, ast.Constant)
+                                 and isinstance(k.value, str))
+                for raw, line in names:
+                    name = raw[6:] if raw.startswith("FLAGS_") else raw
+                    refs.append((name, line))
+        return refs
+
+    # ------------------------------------------------------------ metrics
+
+    def _check_metric_keys(self, corpus: List[SourceFile]) -> List[Finding]:
+        findings: List[Finding] = []
+        namespaces: Dict[str, Optional[Set[str]]] = {}
+        by_path = {sf.relpath: sf for sf in corpus}
+        for target, path in _METRIC_REGISTRIES.items():
+            namespaces[target] = self._documented_namespaces(
+                by_path.get(path))
+        for sf in corpus:
+            if sf.tree is None or not self.relevant(sf.relpath) \
+                    or not sf.relpath.startswith("paddle_tpu/"):
+                continue
+            for node in ast.walk(sf.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                f = node.func
+                if not isinstance(f, ast.Attribute) \
+                        or f.attr not in ("bump", "set_gauge"):
+                    continue
+                if not isinstance(f.value, ast.Name):
+                    continue
+                registry = namespaces.get(f.value.id)
+                if registry is None or not node.args:
+                    continue
+                key = _literal_prefix(node.args[0])
+                if key is None:
+                    continue
+                ns = key.split(".", 1)[0]
+                if ns and ns not in registry:
+                    findings.append(sf.finding(
+                        "unknown-metric-key", node.lineno,
+                        f"metric key {key!r} uses namespace {ns!r} not in "
+                        f"{f.value.id}.DOCUMENTED_NAMESPACES: register it "
+                        f"(with docs) or fix the typo — unregistered "
+                        f"namespaces are invisible to the stats CLIs"))
+        return findings
+
+    def _documented_namespaces(self, sf: Optional[SourceFile]
+                               ) -> Optional[Set[str]]:
+        if sf is None or sf.tree is None:
+            return None
+        for node in sf.tree.body:
+            if isinstance(node, ast.Assign) \
+                    and any(isinstance(t, ast.Name)
+                            and t.id == "DOCUMENTED_NAMESPACES"
+                            for t in node.targets):
+                vals = getattr(node.value, "elts", [])
+                return {e.value for e in vals
+                        if isinstance(e, ast.Constant)
+                        and isinstance(e.value, str)}
+        return None
+
+
+def _call_name(f: ast.AST) -> str:
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return ""
+
+
+def _literal_prefix(node: ast.AST) -> Optional[str]:
+    """A string literal key, or the leading literal chunk of an f-string
+    (``f"tenant.{name}.shed"`` -> ``"tenant."``)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.JoinedStr) and node.values:
+        first = node.values[0]
+        if isinstance(first, ast.Constant) and isinstance(first.value, str):
+            return first.value
+    return None

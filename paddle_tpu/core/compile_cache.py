@@ -51,6 +51,8 @@ def bump(key: str, n: int = 1) -> None:
 
 
 def bump_secs(key: str, secs: float) -> None:
+    """Accumulate seconds into a timing counter (hot path: GIL-atomic
+    dict update, no lock — same contract as :func:`bump`)."""
     _times[key] = _times.get(key, 0.0) + float(secs)
 
 
@@ -210,12 +212,12 @@ def initialize(cache_dir: Optional[str] = None, *, force: bool = False,
     # provider hookup) must never make `import paddle_tpu` crash
     try:
         _install_listeners()
-    except Exception:
-        pass
+    except Exception:  # analysis: allow(broad-except) — optional observability;
+        pass           # import must never crash on a jax without it
     try:
         _register_providers()
-    except Exception:
-        pass
+    except Exception:  # analysis: allow(broad-except) — optional observability;
+        pass           # import must never crash on a jax without it
     if not flags.flag("xla_compile_cache"):
         return None
     if _initialized and not force:
@@ -242,14 +244,14 @@ def initialize(cache_dir: Optional[str] = None, *, force: bool = False,
                 from jax._src import compilation_cache as _jcc
 
                 _jcc.reset_cache()
-            except Exception:
-                pass
+            except Exception:  # analysis: allow(broad-except) — private jax API,
+                pass           # best-effort cache re-point only
         jax.config.update("jax_compilation_cache_dir", d)
         jax.config.update("jax_persistent_cache_min_compile_time_secs",
                           float(min_compile_secs))
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
-    except Exception:  # optimization only, never a blocker at import
-        return None
+    except Exception:  # analysis: allow(broad-except) — optimization only,
+        return None    # never a blocker at import
     with _lock:
         _initialized = True
         _cache_dir = d

@@ -82,10 +82,12 @@ define_flag("check_nan_inf", False, "Scan op outputs for NaN/Inf in eager mode (
 define_flag("check_nan_inf_level", 0, "0: fail on nan/inf; >0 report-only.")
 define_flag("eager_jit_ops", True, "Cache per-op jitted executables for eager mode dispatch.")
 define_flag("default_device", "", "Override default device: 'cpu' | 'tpu'.")
-define_flag("benchmark", False, "Block on each op for accurate eager timing.")
-define_flag("tracer_mkldnn_ops_on", "", "Unused; kept for API parity.")
-define_flag("allocator_strategy", "xla", "Memory allocator strategy (XLA manages HBM on TPU).")
-define_flag("use_stream_safe_allocator", True, "Kept for API parity; XLA/PJRT owns streams on TPU.")
+define_flag("selected_devices", "",
+            "Comma-separated local device ids for this process. Set per "
+            "rank by the distributed launcher (distributed.launch) and "
+            "read back from the PROCESS ENVIRONMENT by ParallelEnv "
+            "(distributed.api_extra) — declared here so the flag-registry "
+            "lint can prove every FLAGS_* reference resolves.")
 define_flag("sequence_parallel_mode", "auto",
             "Context parallelism for attention: auto|ring|ulysses|none.")
 define_flag("flash_block_q", 128,

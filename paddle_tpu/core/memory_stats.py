@@ -157,8 +157,8 @@ def _pjrt_stats(device_id: int = 0) -> dict:
         return local_device(device_id).memory_stats() or {}
     except ValueError:
         raise
-    except Exception:  # backend without stats (CPU)
-        return {}
+    except Exception:  # analysis: allow(broad-except) — backend without
+        return {}      # memory_stats (CPU) reports empty
 
 
 _DEVICE_KEYS = {
@@ -215,8 +215,8 @@ def memory_stats(device_id: int = 0) -> dict:
     for name, fn in list(_providers.items()):
         try:
             out[f"provider.{name}"] = int(fn())
-        except Exception:
-            out[f"provider.{name}"] = -1
+        except Exception:  # analysis: allow(broad-except) — one broken provider
+            out[f"provider.{name}"] = -1  # must not take down the report
     return out
 
 

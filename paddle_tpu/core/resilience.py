@@ -50,6 +50,21 @@ _lock = threading.Lock()
 # the TrainStep hot path bumps these per step, so no lock on update
 _counts: Dict[str, int] = {}
 
+#: resilience counter namespaces (key segment before the first ``.``).
+#: ``retry.*`` retried-IO attempts/exhaustions, ``ckpt.*`` checkpoint
+#: saves/integrity, ``sentinel.*`` nonfinite-step skips/rollbacks,
+#: ``preempt.*`` PreemptionGuard activity, ``overload.*``/``deadline.*``/
+#: ``quota.*`` shed taxonomy, ``serving.*`` the serving mirrors (drains,
+#: rebuilds, replays, preemptions, replica ejections/respawns),
+#: ``faults`` armed-fault gauge. Checked by ``tools/analyze.py``'s
+#: ``unknown-metric-key`` rule against every literal ``resilience.bump``
+#: call — register new namespaces here WITH a docs mention, or the lint
+#: fails.
+DOCUMENTED_NAMESPACES = (
+    "retry", "ckpt", "sentinel", "preempt", "overload", "deadline",
+    "quota", "serving", "faults",
+)
+
 
 def bump(key: str, n: int = 1) -> None:
     """Increment a resilience counter (GIL-atomic dict update, no lock)."""
@@ -108,8 +123,8 @@ def _register_providers() -> None:
 
 try:
     _register_providers()
-except Exception:  # observability is optional, never an import blocker
-    pass
+except Exception:  # analysis: allow(broad-except) — observability is
+    pass           # optional, never an import blocker
 
 
 # ------------------------------------------------------------------- errors
@@ -332,9 +347,9 @@ def atomic_write(path: str, data, *, name: str = "atomic_write",
                     os.close(dfd)
             except OSError:
                 pass
-        except BaseException:
-            try:
-                os.unlink(tmp)
+        except BaseException:  # analysis: allow(broad-except) — cleanup-and-
+            try:               # reraise: the tmp file must go even on
+                os.unlink(tmp)  # KeyboardInterrupt
             except OSError:
                 pass
             raise

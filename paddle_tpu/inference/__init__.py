@@ -65,7 +65,8 @@ class Config:
             import jax
 
             actual = jax.devices()[0].platform
-        except Exception:
+        except (ImportError, RuntimeError, IndexError):
+            # no jax / no initialized backend on this host
             actual = "unknown"
         if self._device is None:
             return actual

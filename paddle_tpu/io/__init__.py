@@ -422,8 +422,8 @@ def _start_method() -> str:
         backends = getattr(xla_bridge, "_backends", {})
         if any(name != "cpu" for name in backends):
             return "spawn"
-    except Exception:  # private API drift: fall through to fork
-        pass
+    except (ImportError, AttributeError):
+        pass  # private jax API drift: fall through to fork
     return "fork"
 
 

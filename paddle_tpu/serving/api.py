@@ -85,8 +85,9 @@ def _drain_at_exit() -> None:  # pragma: no cover - interpreter shutdown
     try:
         drain_all(grace=0.0)
     except Exception:
-        # shutdown epilogues must never turn a clean exit into a traceback
-        # (the GC may already have torn down parts of the runtime)
+        # analysis: allow(broad-except) — shutdown epilogue: must never
+        # turn a clean exit into a traceback (the GC may already have
+        # torn down parts of the runtime)
         pass
 
 
@@ -228,6 +229,9 @@ class ServingAPI:
             with self._lock:
                 if not self.scheduler.has_work():
                     return
+                # analysis: allow(blocking-call-in-lock) — the API lock IS
+                # the engine serialization point: exactly one thread may
+                # step the scheduler, and waiters queue on this lock
                 self._step_guarded()
 
     # -------------------------------------------------------- drain / close
@@ -269,11 +273,15 @@ class ServingAPI:
                     break
                 if own_pump:
                     try:
+                        # analysis: allow(blocking-call-in-lock) — the API
+                        # lock is the engine serialization point (drain
+                        # pumps under it by design)
                         self._step_guarded()
                     except Exception:
-                        # the failed step already failed every in-flight
-                        # request with its real error (fail_all) — nothing
-                        # left for the grace loop to pump
+                        # analysis: allow(broad-except) — any step failure
+                        # already failed every in-flight request with its
+                        # real error (fail_all); nothing left for the
+                        # grace loop to pump
                         break
             if not own_pump:
                 time.sleep(0.001)
@@ -343,6 +351,8 @@ class ServingAPI:
             return
         with self._lock:
             if self.scheduler.has_work():
+                # analysis: allow(blocking-call-in-lock) — the API lock is
+                # the engine serialization point (foreground pump)
                 self._step_guarded()
 
     def _step_guarded(self) -> None:
@@ -355,9 +365,13 @@ class ServingAPI:
         try:
             self.scheduler.step()
             self.supervisor.note_step()
+        # analysis: allow(broad-except) — THE classification point:
+        # the supervisor decides transient-vs-fatal for every step error
         except Exception as e:
             try:
                 recovered = self.supervisor.handle(e)
+            # analysis: allow(broad-except) — recovery failure of any
+            # kind must fail staged requests, never strand them RUNNING
             except Exception as e2:
                 # recovery itself died (e.g. the rebuilt arena's allocation
                 # failed on a still-dead device): the supervisor already
@@ -382,9 +396,13 @@ class ServingAPI:
                 busy = self.scheduler.has_work()
                 if busy:
                     try:
+                        # analysis: allow(blocking-call-in-lock) — the API
+                        # lock is the engine serialization point
+                        # (background pump thread)
                         self._step_guarded()
                     except Exception:
-                        # the pump thread must never die silently with
+                        # analysis: allow(broad-except) — the pump thread
+                        # must never die silently with
                         # requests in flight: _step_guarded already failed
                         # them all (done_event + sentinel) — keep serving;
                         # new submissions surface errors through their own
@@ -454,7 +472,8 @@ class EnginePredictor:
                                              stop_token_id=self._stop,
                                              priority=pr))
         except Exception:
-            # a mid-batch submit failure (overload shed, validation) must
+            # analysis: allow(broad-except) — cleanup-and-reraise: a
+            # mid-batch submit failure (overload shed, validation) must
             # not strand the rows already queued: their handles would be
             # unreachable, and admission would still spend capacity on them
             # ahead of the next run(). Flag every cancel BEFORE pumping so

@@ -569,6 +569,8 @@ class ServingEngine:
         try:
             res = self.arena.reserve(
                 self.blocks_needed(plen, max_new_tokens) - len(attached))
+        # analysis: allow(broad-except) — cleanup-and-reraise: any
+        # reservation failure must drop the refs taken above
         except Exception:
             for blk in shared:
                 self.arena.deref(blk)
@@ -594,8 +596,9 @@ class ServingEngine:
             else:
                 nxt, new_pools = self._full_prefill_call(ctx, clen, res)
         except Exception:
-            # a failed admission must not leak capacity: drop the shared
-            # refs, return the private blocks, clear the slot's table row.
+            # analysis: allow(broad-except) — cleanup-and-reraise: a failed
+            # admission must not leak capacity whatever the cause — drop
+            # the shared refs, return the private blocks, clear the row.
             # (Under donation the pools may already be consumed — the
             # engine is then dead and every later call fails loudly; the
             # scheduler fails requests cleanly.)

@@ -163,8 +163,8 @@ class Gateway:
         try:
             self._httpd.shutdown()
             self._httpd.server_close()
-        except Exception:
-            pass
+        except OSError:
+            pass  # already closed / socket torn down by the peer
         if self._thread is not None:
             self._thread.join(timeout=5)
             self._thread = None
@@ -301,7 +301,9 @@ def _make_handler(gw: Gateway):
                         "tokens": [int(t) for t in rr.tokens()]})
                 self._json(404, {"error": "NotFound",
                                  "message": self.path})
-            except Exception as e:  # taxonomy-mapped, never a stack dump
+            # analysis: allow(broad-except) — THE taxonomy boundary:
+            # every error maps to an HTTP status, never a stack dump
+            except Exception as e:
                 self._error(e)
 
         def do_POST(self):
@@ -328,6 +330,8 @@ def _make_handler(gw: Gateway):
                                             "cancelled": True})
                 self._json(404, {"error": "NotFound",
                                  "message": self.path})
+            # analysis: allow(broad-except) — THE taxonomy boundary:
+            # every error maps to an HTTP status, never a stack dump
             except Exception as e:
                 self._error(e)
 
@@ -373,8 +377,12 @@ def _make_handler(gw: Gateway):
                     # next submit's reap sweep happens past it
                     gw.pool.result(rr, timeout=5.0)
                 except Exception:
-                    pass  # cancelled/failed either way; reap backstops
+                    # analysis: allow(broad-except) — best-effort wait:
+                    # cancelled/failed either way; reap backstops
+                    pass
                 return
+            # analysis: allow(broad-except) — the SSE error frame must
+            # carry ANY failure's taxonomy status to the client
             except Exception as e:
                 status, retry = _status_for(e)
                 payload = {"error": type(e).__name__, "message": str(e),

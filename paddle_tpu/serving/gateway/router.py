@@ -191,8 +191,12 @@ class RoutedRequest:
             self._backend_journal = int(journal_len)
             self._replica_idx = replica.idx
             self._replica_gen = replica.generation
-        if self.state == RequestState.QUEUED:
-            self.state = RequestState.RUNNING
+            # under the lock: a check-then-set outside it races _finalize —
+            # a cancel/failure finalizing between the check and the set
+            # would be overwritten back to RUNNING, resurrecting a stream
+            # every consumer already saw reach a terminal state
+            if self.state == RequestState.QUEUED:
+                self.state = RequestState.RUNNING
 
     def _detach_journal(self) -> List[int]:
         """Fold the (dead) backend's tokens into the journal and detach;
@@ -330,6 +334,8 @@ class ReplicaPool:
         try:
             self._route(rr, journal=None)
         except Exception:
+            # analysis: allow(broad-except) — cleanup-and-reraise: whatever
+            # the routing failure, the tenant must be made whole.
             # the request was never enqueued: free the concurrency slot AND
             # refund the bucket charge — a retriable routing shed must not
             # drain a compliant tenant's rate budget (the shed contract)
@@ -455,7 +461,9 @@ class ReplicaPool:
             self._reroute(rr)
         try:
             rep.api.close()
-        except Exception:
+        except Exception:  # analysis: allow(broad-except) — the replica is
+            # already out of rotation; a dead engine failing its own close
+            # must not abort the ejection that is removing it
             _logger.exception("closing ejected replica %d failed", rep.idx)
         self._refresh_gauges()
 
@@ -514,7 +522,9 @@ class ReplicaPool:
         rr.reroutes += 1
         try:
             self._route(rr, journal=journal)
-        except Exception as e:
+        except Exception as e:  # analysis: allow(broad-except) — any
+            # re-route failure must finalize the handle (tenant slot
+            # freed, done_event fired), never strand it in no bucket
             self._finalize(rr, RequestState.FAILED, e)
             return
         metrics.bump("gateway.rerouted")
@@ -539,7 +549,10 @@ class ReplicaPool:
         for rep in due:
             try:
                 api = self._spawn_api()
-            except Exception:
+            except Exception:  # analysis: allow(broad-except) — engine
+                # construction can die arbitrarily on a sick device; a
+                # failed respawn re-enters backoff instead of crashing
+                # the pump that happened to trigger it
                 _logger.exception("respawn of replica %d failed; backing "
                                   "off again", rep.idx)
                 with self._lock:
@@ -564,8 +577,8 @@ class ReplicaPool:
             if stillborn is not None:
                 try:
                     stillborn.close()
-                except Exception:
-                    pass
+                except Exception:  # analysis: allow(broad-except) — best-
+                    pass           # effort teardown of a never-installed API
                 continue
             _logger.info("respawned serving replica %d (generation %d)",
                          rep.idx, rep.generation)
@@ -670,6 +683,8 @@ class ReplicaPool:
             return
         try:
             rep.api._pump_once()
+        # analysis: allow(broad-except) — classification inside:
+        # reroutable failures eject the replica, the rest re-raise
         except Exception as e:
             if _is_reroutable(e):
                 self._eject(rep, e)
@@ -785,7 +800,8 @@ class ReplicaPool:
         for rep in self.replicas():
             try:
                 rep.api.close()
-            except Exception:
+            except Exception:  # analysis: allow(broad-except) — pool close
+                # must close every OTHER replica even if one dies closing
                 _logger.exception("closing replica %d failed", rep.idx)
         with self._lock:
             self._closed = True
@@ -845,7 +861,9 @@ class ReplicaPool:
                 self._reroute(rr)
         try:
             rep.api.close()
-        except Exception:
+        except Exception:  # analysis: allow(broad-except) — the stragglers
+            # were already re-routed; a close failure must not undo the
+            # scale-down bookkeeping
             _logger.exception("closing scaled-down replica %d failed",
                               rep.idx)
         metrics.bump("gateway.scale_downs")

@@ -149,6 +149,7 @@ class TenantManager:
                     max_concurrency=int(
                         flags.flag("gateway_tenant_concurrency")))
             state = _TenantState(cfg, configured=False)
+            # analysis: allow(unguarded-mutation) — caller holds self._lock
             self._tenants[name] = state
             self._evict_idle_materialized()
         return state
@@ -164,6 +165,7 @@ class TenantManager:
             return
         for name in [n for n, s in self._tenants.items()
                      if not s.configured and s.inflight == 0]:
+            # analysis: allow(unguarded-mutation) — caller holds self._lock
             del self._tenants[name]
             n_mat -= 1
             if n_mat <= _MATERIALIZED_CAP // 2:

@@ -58,6 +58,19 @@ _counts: Dict[str, int] = {}
 _gauges: Dict[str, float] = {}
 _providers_registered = False
 
+#: every serving counter/gauge key lives in one of these namespaces (the
+#: segment before the first ``.``, or the whole key for the bare gauges) —
+#: the docstring above documents each. ``tools/analyze.py``'s
+#: ``unknown-metric-key`` rule checks every literal ``metrics.bump``/
+#: ``metrics.set_gauge`` key against this registry, so a typo'd or
+#: undocumented namespace fails the lint instead of silently vanishing
+#: from the stats CLIs and dashboards.
+DOCUMENTED_NAMESPACES = (
+    "requests", "tokens", "engine", "arena", "scheduler", "supervisor",
+    "api", "prefix", "gateway", "tenant", "queue", "slots",
+    "tokens_per_sec",
+)
+
 
 def bump(key: str, n: int = 1) -> None:
     """Increment a serving counter (GIL-atomic dict update, no lock)."""
@@ -65,7 +78,8 @@ def bump(key: str, n: int = 1) -> None:
 
 
 def set_gauge(key: str, value) -> None:
-    """Record a point-in-time value (slot occupancy, queue depth, ...)."""
+    """Record a point-in-time value (slot occupancy, queue depth, ...) —
+    GIL-atomic single-key dict update, no lock (see :func:`bump`)."""
     _gauges[key] = value
 
 
@@ -148,5 +162,5 @@ def _register_providers() -> None:
 
 try:
     _register_providers()
-except Exception:  # observability is optional, never an import blocker
-    pass
+except Exception:  # analysis: allow(broad-except) — observability is
+    pass           # optional, never an import blocker

@@ -352,6 +352,9 @@ class Scheduler:
                 slot, first = self.engine.admit(req.prompt,
                                                 req.max_new_tokens,
                                                 tokens=req.tokens)
+            # analysis: allow(broad-except) — classification inside:
+            # transient engine sickness re-queues + re-raises for the
+            # supervisor; anything else fails THIS request, not the pump
             except Exception as e:
                 from .supervisor import is_transient_serving_error
 

@@ -174,6 +174,8 @@ class EngineSupervisor:
             req.slot = None  # the old slot numbers die with the old arena
         try:
             return self._rebuild_and_replay(pending)
+        # analysis: allow(broad-except) — any replay failure must fail
+        # the staged requests (done_event + sentinel), never strand them
         except Exception as e:
             for req in list(pending):
                 sched._finish(req, RequestState.FAILED, e)
@@ -196,6 +198,9 @@ class EngineSupervisor:
                     slot, nxt = self.engine.admit(req.prompt,
                                                   req.max_new_tokens,
                                                   tokens=req.tokens)
+                # analysis: allow(broad-except) — classification inside:
+                # transient errors restage the replay, the rest fail one
+                # request each
                 except Exception as e:
                     if is_transient_serving_error(e):
                         died_again = e

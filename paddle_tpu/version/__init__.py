@@ -26,7 +26,8 @@ def _commit() -> str:
         out = subprocess.run(["git", "-C", root, "rev-parse", "HEAD"],
                              capture_output=True, text=True, timeout=5)
         return out.stdout.strip() or "unknown"
-    except Exception:
+    except (OSError, subprocess.SubprocessError):
+        # no git / not a checkout / timeout: version is best-effort
         return "unknown"
 
 

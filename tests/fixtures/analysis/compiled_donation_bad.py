@@ -1,0 +1,15 @@
+"""known-bad: `kv` is passed at the donated position and then read
+again -> use-after-donate (XLA reused the buffer)."""
+import jax
+import jax.numpy as jnp
+
+
+def decode(tokens, kv):
+    return tokens + 1, kv * 2
+
+
+def run(tokens, kv):
+    step = jax.jit(decode, donate_argnums=(1,))
+    out, new_kv = step(tokens, kv)
+    checksum = jnp.sum(kv)   # BAD: kv was donated on the line above
+    return out, new_kv, checksum

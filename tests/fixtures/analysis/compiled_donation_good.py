@@ -1,0 +1,15 @@
+"""known-good twin: after donation only the RETURNED buffer is used —
+the donated name is never read again (checksum comes first)."""
+import jax
+import jax.numpy as jnp
+
+
+def decode(tokens, kv):
+    return tokens + 1, kv * 2
+
+
+def run(tokens, kv):
+    step = jax.jit(decode, donate_argnums=(1,))
+    checksum = jnp.sum(kv)       # read BEFORE the donating call: fine
+    out, kv = step(tokens, kv)   # rebinding kv to the fresh buffer: fine
+    return out, kv, checksum

@@ -1,0 +1,17 @@
+"""known-bad: a module-level mutable dict read inside a compiled
+function -> mutable-global-capture: the value is baked at trace time,
+so `set_scale()` silently stops working after the first call."""
+import jax
+
+_CONFIG = {"scale": 2.0}
+
+
+def set_scale(s):
+    _CONFIG["scale"] = s
+
+
+def apply(x):
+    return x * _CONFIG["scale"]   # BAD: baked at trace time
+
+
+apply_jit = jax.jit(apply)

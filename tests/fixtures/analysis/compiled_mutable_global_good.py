@@ -1,0 +1,17 @@
+"""known-good twin: the scale rides in as an argument (retrace on
+change is explicit), the module constant is immutable."""
+import jax
+
+_DEFAULT_SCALE = 2.0  # immutable module constant: fine to close over
+
+
+def apply(x, scale):
+    return x * scale
+
+
+def apply_default(x):
+    return x * _DEFAULT_SCALE
+
+
+apply_jit = jax.jit(apply)
+default_jit = jax.jit(apply_default)

@@ -1,0 +1,14 @@
+"""known-bad: nonzero / boolean-mask indexing / one-arg where inside a
+compiled function -> shape-from-data (x3)."""
+import jax
+import jax.numpy as jnp
+
+
+def live_tokens(x, mask):
+    idx = jnp.nonzero(x)              # BAD: data-dependent shape
+    picked = x[mask]                  # BAD: boolean-mask indexing
+    more = jnp.where(x > 0)           # BAD: one-arg where
+    return idx, picked, more
+
+
+live_jit = jax.jit(live_tokens)

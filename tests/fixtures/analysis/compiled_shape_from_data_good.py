@@ -1,0 +1,13 @@
+"""known-good twin: fixed-shape masking via three-arg where — the
+compiled-friendly form of every selection in the bad twin."""
+import jax
+import jax.numpy as jnp
+
+
+def live_tokens(x, mask):
+    picked = jnp.where(mask, x, 0.0)        # fixed shape
+    count = jnp.sum(mask.astype(jnp.int32))  # scalar, fixed shape
+    return picked, count
+
+
+live_jit = jax.jit(live_tokens)

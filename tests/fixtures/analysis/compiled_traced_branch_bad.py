@@ -1,0 +1,15 @@
+"""known-bad: Python `if`/`while` on traced array values inside a
+jit-compiled function -> traced-branch (x2)."""
+import jax
+import jax.numpy as jnp
+
+
+def step(x, budget):
+    if x.sum() > 0:           # BAD: traced condition
+        x = x * 2
+    while budget - x[0] > 0:  # BAD: traced loop condition
+        x = x + 1
+    return x
+
+
+step_jit = jax.jit(step)

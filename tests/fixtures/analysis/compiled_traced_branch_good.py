@@ -1,0 +1,20 @@
+"""known-good twin: branches on static properties (`shape`, `is None`,
+annotated scalar args, pytree key membership) and lax control flow."""
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def step(x, slots, mask=None, budget: int = 8):
+    if x.shape[0] > 1:                  # static: shape
+        x = x * 2
+    if mask is not None:                # static: identity
+        x = jnp.where(mask, x, 0.0)
+    if "master" in slots:               # static: pytree keys
+        x = x + slots["master"]
+    if budget > 4:                      # static: annotated scalar arg
+        x = x + 1
+    return lax.cond(x.sum() > 0, lambda v: v * 2, lambda v: v, x)
+
+
+step_jit = jax.jit(step, static_argnames=("budget",))

@@ -1,0 +1,12 @@
+"""known-bad: bool()/int()/.item() on traced values inside a compiled
+function -> traced-cast (x3)."""
+import jax
+
+
+def gate(x, limit):
+    flag = bool(x.sum() > 0)      # BAD
+    k = int(limit)                # BAD: limit is traced (no annotation)
+    return x.max().item() if flag else k  # BAD: .item() under trace
+
+
+gate_jit = jax.jit(gate)

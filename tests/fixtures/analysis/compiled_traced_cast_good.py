@@ -1,0 +1,13 @@
+"""known-good twin: casts on static values only (shapes, annotated
+scalars); array math stays in jnp."""
+import jax
+import jax.numpy as jnp
+
+
+def gate(x, limit: int):
+    k = int(limit)                 # static: annotated scalar
+    rows = int(x.shape[0])         # static: shape access
+    return jnp.where(x.sum() > 0, x * rows, jnp.full_like(x, k))
+
+
+gate_jit = jax.jit(gate)

@@ -1,0 +1,20 @@
+"""known-bad: time.sleep, a thread join, and an engine step all happen
+while holding the lock -> blocking-call-in-lock (3 findings)."""
+import threading
+import time
+
+
+class Pump:
+    def __init__(self, engine):
+        self._lock = threading.Lock()
+        self.engine = engine
+        self._thread = threading.Thread(target=self.run)
+
+    def run(self):
+        with self._lock:
+            time.sleep(0.5)                 # BAD
+            self.engine.decode_step()       # BAD
+
+    def stop(self):
+        with self._lock:
+            self._thread.join()             # BAD

@@ -1,0 +1,28 @@
+"""known-good twin: the lock only guards state handoff; sleeping,
+joining, and stepping the engine all happen outside it. `", ".join()`
+on a string is not a thread join."""
+import threading
+import time
+
+
+class Pump:
+    def __init__(self, engine):
+        self._lock = threading.Lock()
+        self.engine = engine
+        self._thread = threading.Thread(target=self.run)
+        self.busy = False
+
+    def run(self):
+        with self._lock:
+            self.busy = True
+        self.engine.decode_step()
+        time.sleep(0.5)
+        with self._lock:
+            self.busy = False
+
+    def stop(self):
+        self._thread.join()
+
+    def label(self, parts):
+        with self._lock:
+            return ", ".join(parts)  # str.join: not blocking

@@ -1,0 +1,36 @@
+"""known-bad: Router holds its lock while calling Ledger.charge (which
+takes Ledger's lock), and Ledger holds its lock while calling
+Router.requeue (which takes Router's lock) -> ABBA lock-order-cycle."""
+import threading
+
+
+class Ledger:
+    def __init__(self, router: "Router" = None):
+        self._lock = threading.Lock()
+        self.balance = 0
+        self.router = router
+
+    def charge(self, n):
+        with self._lock:
+            self.balance -= n
+
+    def settle(self, item):
+        with self._lock:
+            self.balance += 1
+            self.router.requeue(item)  # BAD: Ledger -> Router edge
+
+
+class Router:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.pending = []
+        self.ledger = Ledger()
+
+    def requeue(self, item):
+        with self._lock:
+            self.pending.append(item)
+
+    def route(self, item):
+        with self._lock:
+            self.pending.append(item)
+            self.ledger.charge(1)  # BAD: Router -> Ledger edge

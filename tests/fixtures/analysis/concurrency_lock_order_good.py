@@ -1,0 +1,35 @@
+"""known-good twin: same two classes, but cross-class calls happen AFTER
+the own lock is released — a one-directional acquisition order, no cycle."""
+import threading
+
+
+class Ledger:
+    def __init__(self, router: "Router" = None):
+        self._lock = threading.Lock()
+        self.balance = 0
+        self.router = router
+
+    def charge(self, n):
+        with self._lock:
+            self.balance -= n
+
+    def settle(self, item):
+        with self._lock:
+            self.balance += 1
+        self.router.requeue(item)  # lock released first
+
+
+class Router:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.pending = []
+        self.ledger = Ledger()
+
+    def requeue(self, item):
+        with self._lock:
+            self.pending.append(item)
+
+    def route(self, item):
+        with self._lock:
+            self.pending.append(item)
+        self.ledger.charge(1)  # lock released first

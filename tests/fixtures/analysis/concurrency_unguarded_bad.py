@@ -1,0 +1,18 @@
+"""known-bad: `depth` is mutated under the lock in push() but also
+mutated outside any lock scope in drop() -> unguarded-mutation."""
+import threading
+
+
+class Queue:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.items = []
+        self.depth = 0
+
+    def push(self, item):
+        with self._lock:
+            self.items.append(item)
+            self.depth += 1
+
+    def drop(self):
+        self.depth -= 1  # BAD: racy read-modify-write outside the lock

@@ -1,0 +1,34 @@
+"""known-good twin: every post-construction mutation of guarded state
+happens under the lock; __init__ writes are construction (happens-before
+publication); the module-level GIL-atomic bump pattern is an allowed
+idiom, not a finding."""
+import threading
+
+_lock = threading.Lock()
+_counts = {}
+
+
+def bump(key, n=1):
+    """GIL-atomic single-key dict update, no lock (documented pattern)."""
+    _counts[key] = _counts.get(key, 0) + n
+
+
+def snapshot():
+    with _lock:
+        return dict(_counts)
+
+
+class Queue:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.items = []
+        self.depth = 0  # construction: not a finding
+
+    def push(self, item):
+        with self._lock:
+            self.items.append(item)
+            self.depth += 1
+
+    def drop(self):
+        with self._lock:
+            self.depth -= 1

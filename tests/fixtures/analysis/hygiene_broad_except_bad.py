@@ -1,0 +1,16 @@
+"""known-bad: unannotated `except Exception` / bare except swallow the
+error taxonomy -> broad-except (x2)."""
+
+
+def submit(engine, req):
+    try:
+        return engine.submit(req)
+    except Exception:       # BAD: retriable shed vs crash: can't tell
+        return None
+
+
+def close(engine):
+    try:
+        engine.close()
+    except:                 # BAD: bare except
+        pass

@@ -1,0 +1,26 @@
+"""known-good twin: narrowed to the concrete taxonomy, or broad with an
+annotated reason."""
+
+
+class QuotaExceededError(RuntimeError):
+    pass
+
+
+class QueueOverloadError(RuntimeError):
+    pass
+
+
+def submit(engine, req):
+    try:
+        return engine.submit(req)
+    except (QuotaExceededError, QueueOverloadError):
+        return None  # retriable sheds: caller backs off and resubmits
+
+
+def close(engine):
+    try:
+        engine.close()
+    except Exception:
+        # analysis: allow(broad-except) — shutdown epilogue: a dead
+        # engine failing its own close must not abort the teardown
+        pass

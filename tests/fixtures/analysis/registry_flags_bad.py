@@ -1,0 +1,14 @@
+"""known-bad: references a FLAGS_* name with no define_flag declaration
+(and a typo'd flag-API read) -> undefined-flag."""
+import os
+
+from paddle_tpu.core import flags
+
+
+def queue_limit():
+    # BAD: no define_flag("serving_max_queu") exists (typo)
+    return flags.flag("serving_max_queu")
+
+
+def env_override():
+    return os.environ.get("FLAGS_totally_unregistered_flag")  # BAD

@@ -1,0 +1,13 @@
+"""known-good twin: every referenced flag resolves to a define_flag
+declaration in core/flags.py."""
+import os
+
+from paddle_tpu.core import flags
+
+
+def queue_limit():
+    return flags.flag("serving_max_queue")
+
+
+def env_override():
+    return os.environ.get("FLAGS_serving_slots")

@@ -1,0 +1,9 @@
+"""known-bad: a metric key in a namespace missing from
+metrics.DOCUMENTED_NAMESPACES -> unknown-metric-key (typo'd namespace
+would silently vanish from every stats CLI)."""
+from paddle_tpu.serving import metrics
+
+
+def record(n):
+    metrics.bump("requets.finished")        # BAD: typo'd namespace
+    metrics.set_gauge("qeue.depth", n)      # BAD: typo'd namespace

@@ -1,0 +1,8 @@
+"""known-good twin: every key lives in a documented namespace."""
+from paddle_tpu.serving import metrics
+
+
+def record(n, name):
+    metrics.bump("requests.finished")
+    metrics.set_gauge("queue.depth", n)
+    metrics.bump(f"tenant.{name}.admitted")  # literal prefix checked

@@ -1,0 +1,194 @@
+#!/usr/bin/env python
+"""Framework lint CLI (``paddle_tpu.analysis``).
+
+Usage:
+
+    python tools/analyze.py                      # full suite + baseline gate
+    python tools/analyze.py --changed            # only files modified vs main
+    python tools/analyze.py paddle_tpu/serving   # explicit paths
+    python tools/analyze.py --rules broad-except,unguarded-mutation
+    python tools/analyze.py --json               # machine-readable findings
+    python tools/analyze.py --no-baseline        # raw findings, no gate
+    python tools/analyze.py --update-baseline    # accept current findings
+
+Exit status: 0 = clean (no non-baseline findings), 1 = findings, 2 = usage
+/ internal error.
+
+``--changed`` lints only Python files modified vs the merge base with
+``main`` (plus staged/unstracked changes) — the fast pre-commit loop. The
+global-view ``dead-flag`` rule is disabled there (a subset of files cannot
+prove a flag unread); everything else runs normally.
+
+``--update-baseline`` rewrites ``tools/analysis_baseline.json`` from the
+current findings, carrying existing ``why`` justifications forward by
+``(rule, path, scope)`` key and stamping ``TODO: justify`` on new entries —
+the gate test fails until every entry has a real one. Prefer inline
+``# analysis: allow(<rule>) — <reason>`` for new code; the baseline exists
+for pre-existing findings only. See docs/static_analysis.md.
+"""
+from __future__ import annotations
+
+import argparse
+import importlib
+import json
+import os
+import subprocess
+import sys
+import types
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+# the analyzers are pure AST — they must not import the framework they
+# lint (no jax import cost, and a syntax error in the analyzed code can't
+# take the linter down with it). Register a stub parent package so
+# ``paddle_tpu.analysis`` loads WITHOUT executing ``paddle_tpu/__init__``.
+if "paddle_tpu" not in sys.modules:
+    _pkg = types.ModuleType("paddle_tpu")
+    _pkg.__path__ = [os.path.join(_REPO, "paddle_tpu")]
+    sys.modules["paddle_tpu"] = _pkg
+
+analysis = importlib.import_module("paddle_tpu.analysis")
+common = importlib.import_module("paddle_tpu.analysis.common")
+
+DEFAULT_BASELINE = os.path.join(_REPO, "tools", "analysis_baseline.json")
+
+
+def _changed_files() -> list:
+    """Python files modified vs the merge base with main, plus working-tree
+    changes (the pre-commit view)."""
+    files = set()
+    try:
+        base = subprocess.run(
+            ["git", "merge-base", "HEAD", "main"], cwd=_REPO,
+            capture_output=True, text=True, check=True).stdout.strip()
+        diff = subprocess.run(
+            ["git", "diff", "--name-only", base], cwd=_REPO,
+            capture_output=True, text=True, check=True).stdout
+        files.update(diff.splitlines())
+        # untracked files individually (`status --porcelain` collapses a
+        # new DIRECTORY to one `dir/` entry, hiding every file inside it)
+        untracked = subprocess.run(
+            ["git", "ls-files", "--others", "--exclude-standard"],
+            cwd=_REPO, capture_output=True, text=True, check=True).stdout
+        files.update(untracked.splitlines())
+    except (subprocess.CalledProcessError, FileNotFoundError) as e:
+        print(f"analyze: --changed needs git ({e})", file=sys.stderr)
+        raise SystemExit(2)
+    return sorted(f for f in files
+                  if f.endswith(".py") and not f.startswith("tests/")
+                  and os.path.exists(os.path.join(_REPO, f)))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="analyze.py", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to lint (default: the framework)")
+    ap.add_argument("--changed", action="store_true",
+                    help="lint only files modified vs main (pre-commit)")
+    ap.add_argument("--rules", default="",
+                    help="comma-separated rule filter")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable output")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline file (default tools/analysis_baseline"
+                         ".json)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report raw findings without the baseline gate")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline from current findings "
+                         "(existing justifications carried forward)")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for a in analysis.all_analyzers():
+            for r in a.rules:
+                print(f"{r:28s} ({a.name})")
+        return 0
+
+    rules = [r.strip() for r in args.rules.split(",") if r.strip()]
+    known = set(analysis.all_rules()) | {"suppression-missing-reason"}
+    unknown = [r for r in rules if r not in known]
+    if unknown:
+        print(f"analyze: unknown rule(s) {unknown}; --list-rules shows "
+              f"the set", file=sys.stderr)
+        return 2
+
+    paths = args.paths or None
+    full = paths is None
+    if args.changed:
+        paths = _changed_files()
+        full = False
+        if not paths:
+            print("analyze: no changed Python files vs main")
+            return 0
+        # the flag registry itself must always be in the corpus so
+        # undefined-flag can resolve references from the changed files
+        if "paddle_tpu/core/flags.py" not in paths:
+            paths = list(paths) + ["paddle_tpu/core/flags.py"]
+
+    report = analysis.run_analysis(paths, root=_REPO, rules=rules or None,
+                                   full_corpus=full)
+
+    if args.update_baseline:
+        if not full:
+            # rewriting from a subset view would silently DELETE every
+            # baseline entry for files outside the scanned corpus (and
+            # their hand-written justifications)
+            print("analyze: --update-baseline requires a full run — drop "
+                  "--changed / explicit paths, or baseline by hand",
+                  file=sys.stderr)
+            return 2
+        old = {e.key(): e for e in common.load_baseline(args.baseline)}
+        entries = {}
+        for f in report.findings:
+            if f.key() in entries:
+                continue
+            prev = old.get(f.key())
+            entries[f.key()] = common.BaselineEntry(
+                f.rule, f.path, f.scope,
+                prev.why if prev is not None and prev.why else
+                "TODO: justify")
+        common.save_baseline(args.baseline, entries.values())
+        print(f"analyze: wrote {len(entries)} baseline entries to "
+              f"{os.path.relpath(args.baseline, _REPO)}")
+        return 0
+
+    new, stale = report.findings, []
+    if not args.no_baseline and os.path.exists(args.baseline):
+        baseline = common.load_baseline(args.baseline)
+        new, stale = report.apply_baseline(baseline)
+        if full and stale:
+            for e in stale:
+                print(f"stale baseline entry (matches nothing): "
+                      f"[{e.rule}] {e.path} :: {e.scope}", file=sys.stderr)
+
+    if args.as_json:
+        print(json.dumps({
+            "files": report.files,
+            "elapsed_sec": round(report.elapsed, 3),
+            "findings": [{"rule": f.rule, "path": f.path, "line": f.line,
+                          "scope": f.scope, "message": f.message}
+                         for f in new],
+            "suppressed": len(report.suppressed),
+            "stale_baseline": [{"rule": e.rule, "path": e.path,
+                                "scope": e.scope} for e in stale],
+            "parse_errors": report.parse_errors,
+        }, indent=1))
+    else:
+        for f in new:
+            print(str(f))
+        for path, err in report.parse_errors.items():
+            print(f"{path}: parse error: {err}", file=sys.stderr)
+        print(f"analyze: {len(new)} finding(s) "
+              f"({len(report.suppressed)} suppressed inline, "
+              f"{len(report.findings) - len(new)} baselined) over "
+              f"{report.files} files in {report.elapsed:.2f}s")
+    return 1 if new or (full and stale) else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
