@@ -189,6 +189,27 @@ define_flag("serving_arena_invariants", False,
             "have refcount zero, and a block id may appear in multiple "
             "slots' tables only when its refcount says so. Costs a host "
             "walk per retire; tests turn it on, production leaves it off.")
+define_flag("serving_spec_k", 0,
+            "Speculative decoding: tokens proposed per decode iteration "
+            "(0 = off, one token per compiled call — the PR 8/9 "
+            "behavior). With a draft model configured "
+            "(ServingConfig.draft_model) the draft proposes k tokens into "
+            "its own KV namespace and the target verifies all k in ONE "
+            "batched compiled call, accepting the longest matching prefix "
+            "(greedy semantics unchanged — bit-identical). Without a "
+            "draft the engine self-drafts (lockstep fused multi-token "
+            "decode: k target sub-steps per dispatch, acceptance "
+            "structurally 1.0). Part of the engine's program key: changing "
+            "it builds new executables, never reuses old ones.")
+define_flag("serving_chunked_prefill", 0,
+            "Chunked prefill: slice a long prompt's prefill into chunks of "
+            "this many tokens, interleaved one chunk per scheduler "
+            "iteration, so admitting a long prompt bounds the decode "
+            "stall of running streams to one chunk instead of the whole "
+            "prompt. 0 = off (admission prefills the full prompt in one "
+            "bucketed call — the PR 8/9 behavior). Chunks reuse the "
+            "suffix-prefill programs (one per chunk-length bucket); chunk "
+            "size joins the engine's program key like donation flags do.")
 
 # ---- Serving gateway: replica router + tenant quotas (serving.gateway) ----
 define_flag("serving_replicas", 2,

@@ -56,13 +56,15 @@ _counts: Dict[str, int] = {}
 #: ``preempt.*`` PreemptionGuard activity, ``overload.*``/``deadline.*``/
 #: ``quota.*`` shed taxonomy, ``serving.*`` the serving mirrors (drains,
 #: rebuilds, replays, preemptions, replica ejections/respawns),
-#: ``faults`` armed-fault gauge. Checked by ``tools/analyze.py``'s
-#: ``unknown-metric-key`` rule against every literal ``resilience.bump``
-#: call — register new namespaces here WITH a docs mention, or the lint
-#: fails.
+#: ``faults`` armed-fault gauge, ``fault.<kind>`` per-kind fired-fault
+#: counters (dynamic keys from ``maybe_fault`` — invisible to the
+#: literal-key lint, so listed here for the runtime-coverage test).
+#: Checked by ``tools/analyze.py``'s ``unknown-metric-key`` rule against
+#: every literal ``resilience.bump`` call — register new namespaces here
+#: WITH a docs mention, or the lint fails.
 DOCUMENTED_NAMESPACES = (
     "retry", "ckpt", "sentinel", "preempt", "overload", "deadline",
-    "quota", "serving", "faults",
+    "quota", "serving", "faults", "fault",
 )
 
 

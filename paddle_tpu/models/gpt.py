@@ -468,6 +468,21 @@ class GPTForCausalLM(nn.Layer):
                 out = self.lm_head(Tensor(h_last[:, None]))
         return out._data[:, 0]
 
+    def verify_logits(self, h_seq):
+        """Verify-k head: next-token logits ``[b, s, vocab]`` for a chunk
+        of ``s`` hidden states ``[b, s, hidden]`` — the head computation of
+        the serving engine's speculative verify step. Deliberately NOT one
+        big ``[b*s, hidden]`` matmul: each position routes through
+        :meth:`_head_logits` with the exact ``[b, hidden]`` shape the
+        compiled decode step uses, so verifying k proposals is bit-identical
+        to running k single-token decode steps (shape-dependent reduction
+        order in the batched matmul would break the greedy-parity
+        guarantee; see tests/test_spec_decode.py). ``s`` is static (the
+        engine's ``k+1``), so the unroll costs nothing at runtime."""
+        s = h_seq.shape[1]
+        return jnp.stack([self._head_logits(h_seq[:, j]) for j in range(s)],
+                         axis=1)
+
     def generate(self, input_ids, max_new_tokens: int = 32,
                  do_sample: bool = False, temperature: float = 1.0,
                  top_k: int = 0, top_p: float = 1.0, eos_token_id: int = -1,

@@ -12,7 +12,13 @@ served endpoint), rebuilt as an Orca/vLLM-style decode runtime:
   full prompt blocks; admissions attach matched prefixes by reference
   (copy-on-write when a shared block must be written) and prefill only
   their unmatched suffix (``FLAGS_serving_prefix_cache``).
+* :mod:`.spec_decode` — ``SpecDecoder``: speculative decoding — a draft
+  GPT proposes k tokens into a second KV-arena namespace and the target
+  verifies all k in one batched compiled call, bit-identical to plain
+  greedy decode (``FLAGS_serving_spec_k``; lockstep self-draft without a
+  draft model).
 * :mod:`.scheduler` — ``Scheduler``/``Request``: iteration-level batching,
+  chunked prefill interleaving (``FLAGS_serving_chunked_prefill``),
   priority admission (lower value first, FCFS within a class),
   starvation-triggered preemption with journal re-admission, and the
   stop/budget/cancel/deadline finish policy.
@@ -44,6 +50,7 @@ _LAZY = {
     "Scheduler": ("scheduler", "Scheduler"),
     "Request": ("scheduler", "Request"),
     "RequestState": ("scheduler", "RequestState"),
+    "SpecDecoder": ("spec_decode", "SpecDecoder"),
     "EngineSupervisor": ("supervisor", "EngineSupervisor"),
     "CrashLoopError": ("supervisor", "CrashLoopError"),
     "ServingAPI": ("api", "ServingAPI"),

@@ -174,9 +174,12 @@ class ServingAPI:
             return self.scheduler.submit(req)
 
     def outstanding(self) -> int:
-        """Waiting + running request count — the router's
-        least-outstanding-work routing signal."""
-        return len(self.scheduler.waiting) + len(self.scheduler.running)
+        """Waiting + prefilling + running request count — the router's
+        least-outstanding-work routing signal (a chunked prefill in
+        progress is committed work, so the gateway must weigh it)."""
+        return (len(self.scheduler.waiting)
+                + len(self.scheduler.prefilling)
+                + len(self.scheduler.running))
 
     def stream(self, req: Request) -> Iterator[int]:
         """Yield ``req``'s tokens as they are generated; raises the
@@ -290,6 +293,7 @@ class ServingAPI:
     def _fail_stragglers(self, grace: float, reason: str) -> None:
         with self._lock:
             stragglers = (len(self.scheduler.waiting)
+                          + len(self.scheduler.prefilling)
                           + len(self.scheduler.running))
             if stragglers:
                 self.scheduler.fail_all(resilience.RequestDrainedError(
@@ -517,10 +521,22 @@ class EnginePredictor:
                           cache.hit_tokens)
         else:
             prefix = ""
+        spec = api.engine.spec
+        if spec is not None and spec.proposed:
+            speculation = (", speculation %d proposed / %d accepted "
+                           "(%.0f%% acceptance, %d emitted, %s k=%d)") % (
+                               spec.proposed, spec.accepted,
+                               100.0 * spec.acceptance_rate(),
+                               spec.emitted,
+                               "draft" if spec.draft_mode else "lockstep",
+                               spec.k)
+        else:
+            speculation = ""
         _logger.info(
             "EnginePredictor closed: %d finished, %d failed, "
             "%d supervisor replays (%d rebuilds), %d preemptions, "
-            "%d drains%s",
+            "%d drains%s%s",
             self._finished, self._failed,
             api.supervisor.replay_count, api.supervisor.rebuild_count,
-            api.scheduler.preempt_count, api.drain_count, prefix)
+            api.scheduler.preempt_count, api.drain_count, prefix,
+            speculation)

@@ -32,11 +32,22 @@ token-for-token.
 Under ``FLAGS_decode_donate`` the KV pools are donated into every compiled
 prefill/decode call: XLA updates the arena in place instead of
 double-buffering what is by far the engine's largest allocation.
+
+Two flag-gated multi-token extensions ride the same no-recompile
+contract: **speculative decoding** (``FLAGS_serving_spec_k`` —
+:mod:`paddle_tpu.serving.spec_decode`: a draft model proposes k tokens
+into a second arena namespace, the target verifies all k in one fused
+compiled call, bit-identical to plain greedy) and **chunked prefill**
+(``FLAGS_serving_chunked_prefill`` — :meth:`ServingEngine.admit_begin` /
+:meth:`ServingEngine.admit_chunk`: long prompts scatter one chunk per
+scheduler iteration through the suffix-prefill programs, bounding the
+decode stall of running streams to one chunk). Both default off,
+reproducing the plain engine exactly.
 """
 from __future__ import annotations
 
 import warnings
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -46,6 +57,7 @@ from ..core.tensor import Tensor
 from . import metrics
 from .kv_arena import KVArena, Reservation
 from .prefix_cache import PrefixCache
+from .spec_decode import SpecDecoder
 
 
 def _ceil_div(a: int, b: int) -> int:
@@ -185,6 +197,40 @@ class ServingConfig:
     # donation OFF: a donated call that died may have consumed its buffers,
     # so retrying it would replay invalidated state
     retry_policy: Optional[resilience.RetryPolicy] = None
+    # speculative decoding: tokens proposed per iteration (None defers to
+    # FLAGS_serving_spec_k; 0 = off). With a draft_model the draft
+    # proposes into its own arena namespace and the target verifies k in
+    # one batched call; without one the engine self-drafts (lockstep
+    # fused multi-token decode). Captured at construction — like the
+    # donation flag, it is part of the engine's program key: a different
+    # k builds different executables, never reuses old ones.
+    spec_k: Optional[int] = None
+    draft_model: Optional[object] = None
+    # chunked prefill: chunk size in tokens (None defers to
+    # FLAGS_serving_chunked_prefill; 0 = off). Long prompts prefill one
+    # chunk per scheduler iteration through the suffix-prefill programs,
+    # bounding the decode stall of running streams to one chunk.
+    chunked_prefill: Optional[int] = None
+
+
+@dataclass
+class _AdmitState:
+    """Everything an in-flight admission carries between its setup
+    (slot + blocks + shared refs claimed) and its finish (first token
+    emitted, slot activated) — the unit of progress for chunked prefill."""
+
+    slot: int
+    prompt: np.ndarray
+    ctx: np.ndarray
+    plen: int
+    clen: int
+    max_new: int
+    res: Reservation
+    shared: List[int] = field(default_factory=list)
+    n_attached: int = 0
+    cow: bool = False
+    prefix_len: int = 0
+    done: int = 0  # context positions already scattered (chunk progress)
 
 
 class ServingEngine:
@@ -213,8 +259,17 @@ class ServingEngine:
             raise ValueError("max_model_len exceeds the model's "
                              "max_position_embeddings")
         self.blocks_per_slot = _ceil_div(self.max_model_len, self.block_size)
+        spec_k = int(cfg.spec_k if cfg.spec_k is not None
+                     else flags.flag("serving_spec_k"))
+        self.chunk_size = int(cfg.chunked_prefill
+                              if cfg.chunked_prefill is not None
+                              else flags.flag("serving_chunked_prefill"))
+        # draft mode doubles the default arena: every slot carries a
+        # second (draft-namespace) block table of the same worst case
+        draft_on = spec_k > 0 and cfg.draft_model is not None
         num_blocks = int(cfg.num_blocks
-                         or self.num_slots * self.blocks_per_slot + 1)
+                         or self.num_slots * self.blocks_per_slot
+                         * (2 if draft_on else 1) + 1)
         self.prefill_bucket_min = int(cfg.prefill_bucket_min
                                       or flags.flag("serving_prefill_bucket_min"))
         self.donate = (bool(flags.flag("decode_donate"))
@@ -242,6 +297,15 @@ class ServingEngine:
         self._positions = np.zeros(s, np.int32)
         self._last_tok = np.zeros(s, np.int32)
         self._active = np.zeros(s, np.bool_)
+        # occupied ⊇ active: a slot mid-chunked-prefill holds blocks and
+        # must not be re-picked, but its lane stays masked out of the
+        # decode step until its first token exists
+        self._occupied = np.zeros(s, np.bool_)
+        # per-slot context-length cap (prompt + max_new): the runtime clamp
+        # speculation depth respects so block reservations and the model's
+        # position budget are never overrun
+        self._slot_limit = np.zeros(s, np.int32)
+        self._chunk: Dict[int, _AdmitState] = {}
         self._slot_res: List[Optional[Reservation]] = [None] * s
         # per-slot sharing state: block ids attached by reference from the
         # radix cache (deref'd at retire, NOT owned by the reservation) and
@@ -259,6 +323,10 @@ class ServingEngine:
         self._prefill_jits: Dict[int, object] = {}
         self._prefix_jits: Dict[int, object] = {}
         self._cow_jit = None
+        # speculative decoding sidecar (draft or lockstep self-draft);
+        # built after the arena so the draft namespace can bind to it
+        self.spec = (SpecDecoder(self, cfg.draft_model, spec_k)
+                     if spec_k > 0 else None)
         self._meter = metrics.Meter()  # lifetime aggregate tokens/s gauge
         metrics.set_gauge("slots.total", s)
         metrics.set_gauge("arena.kv_bytes", self.arena.bytes_total())
@@ -267,20 +335,36 @@ class ServingEngine:
     # ----------------------------------------------------------- capacity
 
     def free_slots(self) -> int:
-        return int((~self._active).sum())
+        # occupied, not active: a slot mid-chunked-prefill is taken
+        return int((~self._occupied).sum())
 
     def active_slots(self) -> int:
         return int(self._active.sum())
 
     def blocks_needed(self, prompt_len: int, max_new_tokens: int) -> int:
+        need = _ceil_div(prompt_len + max_new_tokens, self.block_size)
+        if self.spec is not None:
+            # draft mode reserves a second (draft-namespace) table's worst
+            # case per slot; lockstep adds nothing
+            need += self.spec.blocks_needed(prompt_len, max_new_tokens)
+        return need
+
+    def _target_blocks_needed(self, prompt_len: int,
+                              max_new_tokens: int) -> int:
+        """The primary (target-cache) table's worst case alone — what the
+        prefix cache's matched blocks subtract from."""
         return _ceil_div(prompt_len + max_new_tokens, self.block_size)
 
     def reserved_blocks(self, slot: int) -> int:
-        """Admission-time block budget held by ``slot`` (0 if empty).
-        Retiring the slot returns this whole budget to the arena's
-        grantable pool — the quantity preemption feasibility sums."""
+        """Admission-time block budget held by ``slot`` (0 if empty),
+        draft-namespace reservation included. Retiring the slot returns
+        this whole budget to the arena's grantable pool — the quantity
+        preemption feasibility sums."""
         res = self._slot_res[slot]
-        return res.total if res is not None else 0
+        n = res.total if res is not None else 0
+        if self.spec is not None:
+            n += self.spec.reserved_blocks(slot)
+        return n
 
     def validate(self, prompt_len: int, max_new_tokens: int) -> None:
         if prompt_len < 1:
@@ -322,6 +406,9 @@ class ServingEngine:
         need = self.blocks_needed(prompt_len, max_new_tokens)
         if self.prefix_cache is None or (prompt is None and keys is None):
             return need, 0
+        # matched prefix blocks attach by reference to the TARGET table
+        # only (the draft namespace, when present, always prefills its own
+        # private blocks — its budget in `need` is untouched)
         matched, unpinned = self.prefix_cache.match_stats(prompt, keys=keys)
         if matched:
             need -= matched
@@ -523,12 +610,74 @@ class ServingEngine:
         next token, leaving the slot in exactly the state an uninterrupted
         decode would have reached (position ``len(prompt+tokens)``, last
         token = the newly emitted one) — token-for-token identical output.
-        ``max_new_tokens`` stays the request's ORIGINAL budget (the journal
-        counts toward it), so the block reservation is unchanged.
+        With speculation on, the draft cache is reconstructed here too
+        (one draft prefill over the same context), so replay resumes with
+        a warm draft. ``max_new_tokens`` stays the request's ORIGINAL
+        budget (the journal counts toward it), so the block reservation is
+        unchanged.
 
         Raises if no capacity; callers gate on :meth:`can_admit`."""
-        import jax.numpy as jnp
+        st = self._admit_setup(prompt, max_new_tokens, tokens)
+        return st.slot, self._admit_prefill_all(st)
 
+    def admit_begin(self, prompt: np.ndarray, max_new_tokens: int,
+                    tokens=None) -> Tuple[int, Optional[int]]:
+        """Chunked admission entry point: claim a slot + block budget now,
+        prefill incrementally. Returns ``(slot, first_token)`` when the
+        whole context fits one chunk (identical to :meth:`admit`), or
+        ``(slot, None)`` with a chunked prefill left in progress — the
+        scheduler then calls :meth:`admit_chunk` once per iteration until
+        the first token appears. The slot is *occupied* (its blocks are
+        held) but not *active* (its lane stays masked out of the decode
+        step), so running streams keep decoding between chunks."""
+        st = self._admit_setup(prompt, max_new_tokens, tokens)
+        chunk = self.chunk_size
+        if chunk <= 0 or st.clen - st.prefix_len <= chunk:
+            return st.slot, self._admit_prefill_all(st)
+        st.done = st.prefix_len
+        self._chunk[st.slot] = st
+        metrics.bump("chunk.admits")
+        self._refresh_gauges()
+        return st.slot, None
+
+    def admit_chunk(self, slot: int) -> Optional[int]:
+        """Advance one chunked prefill by one chunk (one compiled
+        suffix-prefill call over ``ctx[done:done+chunk]`` — prefix length
+        and the block table are runtime data, so every chunk of every
+        admission reuses the chunk-size bucket's ONE program). Returns the
+        first generated token when the context is fully scattered (the
+        final chunk's last-position logits), else None."""
+        st = self._chunk.get(slot)
+        if st is None:
+            raise RuntimeError(f"slot {slot} has no chunked prefill "
+                               "in progress")
+        take = min(self.chunk_size, st.clen - st.done)
+        try:
+            nxt, new_pools = self._suffix_prefill_call(
+                st.ctx, st.done + take, st.done, slot, chunked=True)
+            self.arena.set_pools(new_pools)
+            st.done += take
+            metrics.bump("chunk.chunks")
+            metrics.bump("chunk.tokens", take)
+            if st.done < st.clen:
+                return None
+            if self.spec is not None:
+                self.spec.prefill(slot, st.ctx)
+        # analysis: allow(broad-except) — cleanup-and-reraise: a failed
+        # chunk must not leak the admission's blocks/refs/slot
+        except Exception:
+            self._chunk.pop(slot, None)
+            self._admit_abort(st)
+            raise
+        self._chunk.pop(slot, None)
+        return self._admit_finish(st, int(nxt))
+
+    def _admit_setup(self, prompt: np.ndarray, max_new_tokens: int,
+                     tokens) -> _AdmitState:
+        """Claim everything an admission needs before any prefill work:
+        the slot, the shared-prefix references, the target + draft block
+        reservations, the filled block table, and the COW copy. On ANY
+        failure the claim unwinds completely."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         plen = int(prompt.shape[0])
         self.validate(plen, max_new_tokens)
@@ -540,8 +689,8 @@ class ServingEngine:
             raise ValueError(
                 f"journal of {journal.size} tokens already exhausts the "
                 f"max_new_tokens={max_new_tokens} budget; nothing to resume")
-        slot = int(np.argmin(self._active))
-        if self._active[slot]:
+        slot = int(np.argmin(self._occupied))
+        if self._occupied[slot]:
             raise RuntimeError("no free slot")
 
         # ---- radix-cache walk: attach resident full PROMPT blocks by
@@ -568,7 +717,8 @@ class ServingEngine:
             self.arena.ref(cow_src)
         try:
             res = self.arena.reserve(
-                self.blocks_needed(plen, max_new_tokens) - len(attached))
+                self._target_blocks_needed(plen, max_new_tokens)
+                - len(attached))
         # analysis: allow(broad-except) — cleanup-and-reraise: any
         # reservation failure must drop the refs taken above
         except Exception:
@@ -577,24 +727,39 @@ class ServingEngine:
             if cow_src is not None:
                 self.arena.deref(cow_src)
             raise
+        if self.spec is not None:
+            try:
+                self.spec.alloc_slot(slot, plen, max_new_tokens)
+            # analysis: allow(broad-except) — cleanup-and-reraise: the
+            # draft budget failing must return the target's too
+            except Exception:
+                res.release()
+                for blk in shared:
+                    self.arena.deref(blk)
+                if cow_src is not None:
+                    self.arena.deref(cow_src)
+                raise
         n_attached = len(attached)
         prefix_len = clen - 1 if cow else n_attached * self.block_size
+        st = _AdmitState(slot=slot, prompt=prompt, ctx=ctx, plen=plen,
+                         clen=clen, max_new=int(max_new_tokens), res=res,
+                         shared=shared, n_attached=n_attached, cow=cow,
+                         prefix_len=prefix_len)
+        self._occupied[slot] = True
+        self._slot_res[slot] = res
+        self._slot_shared[slot] = shared
         try:
             for i, blk in enumerate(shared):
                 self._bt_host[slot, i] = blk
             # private blocks covering the suffix [prefix blocks, clen)
             for bi in range(n_attached, _ceil_div(clen, self.block_size)):
                 self._bt_host[slot, bi] = res.take()
+            self._slot_filled[slot] = _ceil_div(clen, self.block_size)
             self._bt_dev = None
             if cow:
                 self._cow_copy(cow_src, res.taken[0])
                 self.arena.deref(cow_src)
                 cow_src = None  # pin released: the copy is private now
-            if n_attached or cow:
-                nxt, new_pools = self._suffix_prefill_call(
-                    ctx, clen, prefix_len, slot)
-            else:
-                nxt, new_pools = self._full_prefill_call(ctx, clen, res)
         except Exception:
             # analysis: allow(broad-except) — cleanup-and-reraise: a failed
             # admission must not leak capacity whatever the cause — drop
@@ -602,38 +767,76 @@ class ServingEngine:
             # (Under donation the pools may already be consumed — the
             # engine is then dead and every later call fails loudly; the
             # scheduler fails requests cleanly.)
-            for blk in shared:
-                self.arena.deref(blk)
             if cow_src is not None:
                 self.arena.deref(cow_src)
-            res.release()
-            self._bt_host[slot, :] = 0
-            self._bt_dev = None
+            self._admit_abort(st)
             raise
-        self.arena.set_pools(new_pools)
+        return st
 
+    def _admit_abort(self, st: _AdmitState) -> None:
+        """Unwind a claimed admission (setup succeeded, a later prefill /
+        chunk / draft call failed): drop the shared refs, release both
+        reservations, clear the slot row."""
+        for blk in st.shared:
+            self.arena.deref(blk)
+        st.shared = []
+        st.res.release()
+        if self.spec is not None:
+            self.spec.release_slot(st.slot)
+        self._slot_res[st.slot] = None
+        self._slot_shared[st.slot] = []
+        self._slot_filled[st.slot] = 0
+        self._bt_host[st.slot, :] = 0
+        self._bt_dev = None
+        self._occupied[st.slot] = False
+        self._refresh_gauges()
+
+    def _admit_prefill_all(self, st: _AdmitState) -> int:
+        """The one-shot (non-chunked) prefill path: whole-context bucketed
+        prefill (or suffix-only on a cache hit), then the draft prefill
+        when speculation runs a draft model."""
+        try:
+            if st.n_attached or st.cow:
+                nxt, new_pools = self._suffix_prefill_call(
+                    st.ctx, st.clen, st.prefix_len, st.slot)
+            else:
+                nxt, new_pools = self._full_prefill_call(st.ctx, st.clen,
+                                                         st.res)
+            self.arena.set_pools(new_pools)
+            if self.spec is not None:
+                self.spec.prefill(st.slot, st.ctx)
+        # analysis: allow(broad-except) — cleanup-and-reraise: a failed
+        # prefill must not leak the admission's blocks/refs/slot
+        except Exception:
+            self._admit_abort(st)
+            raise
+        return self._admit_finish(st, int(nxt))
+
+    def _admit_finish(self, st: _AdmitState, first: int) -> int:
+        """Activate the slot: the whole context is scattered and its next
+        token exists. From here the slot decodes like any other."""
+        cache = self.prefix_cache
+        slot = st.slot
         if cache is not None:
-            cache.note_hit(prefix_len if (n_attached or cow) else 0)
+            cache.note_hit(st.prefix_len if (st.n_attached or st.cow)
+                           else 0)
             # make this prompt's freshly scattered FULL blocks shareable;
             # the trailing partial block (still written mid-stream) and
             # journal/generated tokens stay private to the slot
-            cache.insert(prompt, self._bt_host[slot],
-                         plen // self.block_size)
-            if n_attached or cow:
-                metrics.bump("tokens.prefill_avoided", prefix_len)
+            cache.insert(st.prompt, self._bt_host[slot],
+                         st.plen // self.block_size)
+            if st.n_attached or st.cow:
+                metrics.bump("tokens.prefill_avoided", st.prefix_len)
 
-        self._slot_res[slot] = res
-        self._slot_shared[slot] = shared
-        self._slot_filled[slot] = _ceil_div(clen, self.block_size)
-        self._positions[slot] = clen  # next write position
-        first = int(nxt)
+        self._positions[slot] = st.clen  # next write position
         self._last_tok[slot] = first
+        self._slot_limit[slot] = st.plen + st.max_new
         self._active[slot] = True
         metrics.bump("engine.admits")
-        metrics.bump("tokens.prefill", clen - prefix_len)
+        metrics.bump("tokens.prefill", st.clen - st.prefix_len)
         metrics.bump("tokens.generated")  # the next token, out of prefill
         self._refresh_gauges()
-        return slot, first
+        return first
 
     def _full_prefill_call(self, ctx: np.ndarray, clen: int,
                            res: Reservation):
@@ -654,19 +857,23 @@ class ServingEngine:
             self.arena.pools, jnp.asarray(rows), name="serving.prefill")
 
     def _suffix_prefill_call(self, ctx: np.ndarray, clen: int,
-                             prefix_len: int, slot: int):
-        """Dispatch the suffix-only prefill for a cache-hit admission:
-        only ``ctx[prefix_len:]`` runs through the model; the matched
-        prefix is attended via the slot's (already attached) block table."""
+                             prefix_len: int, slot: int,
+                             chunked: bool = False):
+        """Dispatch the suffix-only prefill for a cache-hit admission (or
+        one chunk of a chunked admission — same programs, different
+        accounting): only ``ctx[prefix_len:clen]`` runs through the model;
+        everything before ``prefix_len`` is attended via the slot's
+        (already filled) block table, never recomputed."""
         import jax.numpy as jnp
 
         slen = clen - prefix_len
         s_bucket = compile_cache.prefill_bucket(
             slen, self.max_model_len, self.prefill_bucket_min)
         ids = np.zeros((1, s_bucket), np.int32)
-        ids[0, :slen] = ctx[prefix_len:]
+        ids[0, :slen] = ctx[prefix_len:clen]
         fn = self._get_prefix_prefill(s_bucket)
-        metrics.bump("prefix.suffix_prefills")
+        if not chunked:
+            metrics.bump("prefix.suffix_prefills")
         return self._call(
             fn, self._arrays, jnp.asarray(ids), jnp.int32(slen),
             jnp.int32(prefix_len), self.arena.pools,
@@ -676,23 +883,31 @@ class ServingEngine:
         """Free a slot: deactivate its lane, drop its shared-prefix
         references (refcount--; a shared block returns to the free list
         only when the last sharer lets go — or stays resident if the radix
-        cache holds it), and release its private blocks through the same
-        refcount layer. Purely host-side state — never recompiles."""
-        if not self._active[slot]:
+        cache holds it), and release its private blocks (draft namespace
+        included) through the same refcount layer. Also covers a slot
+        mid-chunked-prefill (occupied but not yet active) — a cancelled
+        long admission frees everything it claimed. Purely host-side
+        state — never recompiles."""
+        if not self._occupied[slot]:
             return
+        self._occupied[slot] = False
         self._active[slot] = False
+        self._chunk.pop(slot, None)
         res = self._slot_res[slot]
         self._slot_res[slot] = None
         if res is not None:
             res.release()
         for blk in self._slot_shared[slot]:
             self.arena.deref(blk)
+        if self.spec is not None:
+            self.spec.release_slot(slot)
         self._slot_shared[slot] = []
         self._slot_filled[slot] = 0
         self._bt_host[slot, :] = 0
         self._bt_dev = None
         self._positions[slot] = 0
         self._last_tok[slot] = 0
+        self._slot_limit[slot] = 0
         metrics.bump("engine.retires")
         if flags.flag("serving_arena_invariants"):
             self.check_invariants()
@@ -707,9 +922,15 @@ class ServingEngine:
         ``FLAGS_serving_arena_invariants`` on the release paths; callable
         directly from tests."""
         tables = []
-        for slot in np.flatnonzero(self._active):
+        # occupied, not just active: a slot mid-chunked-prefill already
+        # holds (and may share) blocks
+        for slot in np.flatnonzero(self._occupied):
             n = int(self._slot_filled[slot])
             tables.append([int(b) for b in self._bt_host[slot, :n]])
+        if self.spec is not None:
+            # the second (draft-namespace) block tables: privately owned,
+            # so each entry must account for exactly one refcount
+            tables.extend(self.spec.slot_tables())
         self.arena.check_invariants(tables)
 
     def rebuild(self) -> None:
@@ -738,14 +959,43 @@ class ServingEngine:
         self._positions[:] = 0
         self._last_tok[:] = 0
         self._active[:] = False
+        self._occupied[:] = False
+        self._slot_limit[:] = 0
+        self._chunk.clear()
         self._slot_res = [None] * self.num_slots
         self._slot_shared = [[] for _ in range(self.num_slots)]
         self._slot_filled[:] = 0
+        if self.spec is not None:
+            # bind a fresh draft namespace to the fresh arena; journal
+            # replays reconstruct each slot's draft cache as they re-admit
+            self.spec.rebuild()
         metrics.bump("engine.rebuilds")
         metrics.set_gauge("arena.kv_bytes", self.arena.bytes_total())
         self._refresh_gauges()
 
     # --------------------------------------------------------- decode step
+
+    def _grow_slot_to(self, slot: int, pos_max: int) -> None:
+        """Take private blocks until the slot's table covers ``pos_max``
+        (the reservation guarantees take() cannot fail). Growth compares
+        against FILLED table entries — shared prefix blocks count, so a
+        cache-hit slot grows past its attached prefix seamlessly, and
+        decode never writes a shared block: the write position is always
+        past the last full (sharable) block of the context."""
+        res = self._slot_res[slot]
+        need = pos_max // self.block_size + 1
+        while int(self._slot_filled[slot]) < need:
+            bi = int(self._slot_filled[slot])
+            self._bt_host[slot, bi] = res.take()
+            self._slot_filled[slot] = bi + 1
+            self._bt_dev = None
+
+    def spec_decode_step(self):
+        """One speculative iteration (``FLAGS_serving_spec_k`` > 0):
+        up to k accepted tokens per active slot from one compiled call —
+        see :class:`~.spec_decode.SpecDecoder.step`. Returns
+        ``{slot: [tokens]}``."""
+        return self.spec.step()
 
     def decode_step(self) -> np.ndarray:
         """One iteration: every active slot's last token is forwarded at
@@ -755,18 +1005,8 @@ class ServingEngine:
         import jax.numpy as jnp
 
         # grow block tables whose write position crossed a block boundary
-        # (the reservation guarantees take() cannot fail). Growth compares
-        # against FILLED table entries — shared prefix blocks count, so a
-        # cache-hit slot grows past its attached prefix seamlessly, and
-        # decode never writes a shared block: the write position is always
-        # past the last full (sharable) block of the context.
         for slot in np.flatnonzero(self._active):
-            res = self._slot_res[slot]
-            bi = int(self._positions[slot]) // self.block_size
-            if bi >= int(self._slot_filled[slot]):
-                self._bt_host[slot, bi] = res.take()
-                self._slot_filled[slot] = bi + 1
-                self._bt_dev = None
+            self._grow_slot_to(slot, int(self._positions[slot]))
         if self._bt_dev is None:
             self._bt_dev = jnp.asarray(self._bt_host)
         fn = self._get_step()
@@ -810,9 +1050,12 @@ class ServingEngine:
                "decode_traces": self.decode_traces,
                "prefill_traces": dict(self.prefill_traces),
                "prefix_prefill_traces": dict(self.prefix_prefill_traces),
-               "cow_traces": self.cow_traces}
+               "cow_traces": self.cow_traces,
+               "chunk_size": self.chunk_size}
         out.update({f"arena.{k}": v for k, v in self.arena.stats().items()})
         if self.prefix_cache is not None:
             out.update({f"prefix.{k}": v
                         for k, v in self.prefix_cache.stats().items()})
+        if self.spec is not None:
+            out.update(self.spec.stats())
         return out

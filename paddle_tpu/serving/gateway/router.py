@@ -901,14 +901,27 @@ class ReplicaPool:
 
     def stats(self) -> dict:
         """Pool + tenant snapshot (the ``/v1/stats`` payload next to the
-        process-global ``serving.metrics`` counters)."""
+        process-global ``serving.metrics`` counters). With speculative
+        decoding / chunked prefill on, each replica row carries its
+        engine's acceptance picture — per-replica, since acceptance skew
+        across replicas is a routing signal worth watching."""
         with self._lock:
-            reps = [{"idx": r.idx, "healthy": r.healthy,
-                     "draining": r.draining, "removed": r.removed,
-                     "generation": r.generation, "ejections": r.ejections,
-                     "outstanding": (r.outstanding()
-                                     if not r.removed else 0)}
-                    for r in self._replicas]
+            reps = []
+            for r in self._replicas:
+                row = {"idx": r.idx, "healthy": r.healthy,
+                       "draining": r.draining, "removed": r.removed,
+                       "generation": r.generation, "ejections": r.ejections,
+                       "outstanding": (r.outstanding()
+                                       if not r.removed else 0)}
+                spec = (getattr(r.api.engine, "spec", None)
+                        if not r.removed else None)
+                if spec is not None:
+                    row["spec_acceptance_rate"] = round(
+                        spec.acceptance_rate(), 4)
+                    row["spec_emitted"] = spec.emitted
+                if not r.removed and getattr(r.api.engine, "chunk_size", 0):
+                    row["prefilling"] = len(r.api.scheduler.prefilling)
+                reps.append(row)
         return {"replicas": reps,
                 "replicas_total": sum(1 for r in reps if not r["removed"]),
                 "replicas_healthy": len(self.healthy_replicas()),

@@ -133,6 +133,14 @@ class KVArena:
         self._refs: List[int] = [0] * self.num_blocks
         self._cached: set = set()
         self._cache = None
+        # named pool namespaces (speculative decoding's draft cache): a
+        # second per-layer pool set addressed by the SAME block ids and the
+        # same free-list/refcount accounting — a block taken for a slot's
+        # draft table is one allocation like any other, it just indexes a
+        # different physical pool. Namespace shapes may differ from the
+        # primary's (a draft model has its own layers/heads/head_dim).
+        self._ns_pools: dict = {}
+        self._ns_shapes: dict = {}
 
     # ------------------------------------------------------------- pools
 
@@ -144,6 +152,40 @@ class KVArena:
         """Adopt the pool arrays returned by a compiled step (the old ones
         were donated into it and are no longer valid)."""
         self._pools = list(pools)
+
+    def add_namespace(self, name: str, num_layers: int, num_heads: int,
+                      head_dim: int, dtype: Optional[str] = None) -> None:
+        """Create a named secondary pool set over the same block ids (the
+        speculative decoder's draft KV cache). Shares the allocator: a
+        block id taken from the free list is simultaneously valid in every
+        namespace — the engine decides which namespace a given slot table
+        actually writes. Idempotent per name only via :meth:`rebuild`-style
+        reconstruction (adding an existing name raises)."""
+        import jax.numpy as jnp
+
+        if name in self._ns_pools:
+            raise ValueError(f"namespace {name!r} already exists")
+        dtype = dtype or self.dtype
+        shape = (self.num_blocks, self.block_size, int(num_heads),
+                 int(head_dim))
+        self._ns_pools[name] = [
+            (jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+            for _ in range(int(num_layers))]
+        self._ns_shapes[name] = (int(num_layers), int(num_heads),
+                                 int(head_dim), dtype)
+
+    def ns_pools(self, name: str) -> List[Tuple]:
+        return self._ns_pools[name]
+
+    def set_ns_pools(self, name: str, pools) -> None:
+        """Adopt a namespace's pool arrays after a compiled step (donation
+        contract identical to :meth:`set_pools`)."""
+        if name not in self._ns_pools:
+            raise KeyError(f"unknown namespace {name!r}")
+        self._ns_pools[name] = list(pools)
+
+    def namespaces(self) -> List[str]:
+        return list(self._ns_pools)
 
     # -------------------------------------------------------- allocation
 
@@ -296,11 +338,20 @@ class KVArena:
     # ------------------------------------------------------------- stats
 
     def bytes_total(self) -> int:
-        k, _ = self._pools[0]
-        per_pool = 1
-        for d in k.shape:
-            per_pool *= int(d)
-        return per_pool * self._itemsize * 2 * self.num_layers
+        def _pool_bytes(pools):
+            total = 0
+            for k, _ in pools:
+                per = 1
+                for d in k.shape:
+                    per *= int(d)
+                # .dtype.itemsize is host metadata (works for ml_dtypes
+                # bf16 too): stats()/gauges poll this — it must never
+                # allocate on the device
+                total += per * k.dtype.itemsize * 2
+            return total
+
+        return _pool_bytes(self._pools) + sum(
+            _pool_bytes(p) for p in self._ns_pools.values())
 
     def stats(self) -> dict:
         return {
@@ -312,4 +363,5 @@ class KVArena:
             "high_water": self._high_water,
             "block_size": self.block_size,
             "kv_bytes": self.bytes_total(),
+            "namespaces": len(self._ns_pools),
         }

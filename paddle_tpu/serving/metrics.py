@@ -24,6 +24,13 @@ Counter namespaces:
   ``hit_tokens`` (prefill tokens avoided, also ``tokens.prefill_avoided``)
   / ``inserted_blocks`` / ``evictions`` / ``cow_copies`` /
   ``suffix_prefills``
+* ``spec.*``       — speculative decoding (``serving.spec_decode``):
+  ``proposed`` / ``accepted`` / ``rollback_tokens`` (proposed but
+  rejected — positions rolled back as runtime data) / ``emitted`` /
+  ``iterations`` / ``draft_prefills``
+* ``chunk.*``      — chunked prefill: ``admits`` (admissions that went
+  chunked) / ``chunks`` (compiled chunk calls) / ``tokens`` (prompt
+  tokens scattered through chunks)
 * ``gateway.*``    — the multi-tenant front door (``serving.gateway``):
   ``routed`` / ``rerouted`` (journaled fail-over onto a healthy replica) /
   ``affinity_routes`` (warm-cache wins within the bounded slack) /
@@ -34,7 +41,8 @@ Counter namespaces:
   ``shed_rate`` / ``shed_concurrency`` / ``shed_share``, plus per-tenant
   ``tenant.<name>.admitted`` / ``.shed`` / ``.tokens_out`` (goodput)
 
-Gauges: ``queue.depth``, ``slots.active``, ``slots.total``,
+Gauges: ``queue.depth``, ``queue.prefilling`` (chunked prefills in
+progress), ``spec.acceptance_rate``, ``slots.active``, ``slots.total``,
 ``arena.blocks_free``, ``arena.blocks_total``, ``arena.blocks_cached``
 (resident prefix blocks — in use but reclaimable), ``arena.high_water``,
 ``arena.kv_bytes``, ``arena.frag_tokens`` (allocated-block capacity minus
@@ -67,8 +75,8 @@ _providers_registered = False
 #: from the stats CLIs and dashboards.
 DOCUMENTED_NAMESPACES = (
     "requests", "tokens", "engine", "arena", "scheduler", "supervisor",
-    "api", "prefix", "gateway", "tenant", "queue", "slots",
-    "tokens_per_sec",
+    "api", "prefix", "spec", "chunk", "gateway", "tenant", "queue",
+    "slots", "tokens_per_sec",
 )
 
 

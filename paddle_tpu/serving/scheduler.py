@@ -131,6 +131,10 @@ class Scheduler:
         self.engine = engine
         self.waiting: List[Request] = []
         self.running: List[Request] = []
+        # chunked prefill (FLAGS_serving_chunked_prefill): requests whose
+        # admission claimed a slot but whose prompt is still scattering,
+        # one chunk per step — they hold capacity but don't decode yet
+        self.prefilling: List[Request] = []
         self.preempt_count = 0  # this scheduler's lifetime preemptions
 
     # ---------------------------------------------------------- admission
@@ -147,7 +151,7 @@ class Scheduler:
         return request
 
     def has_work(self) -> bool:
-        return bool(self.waiting or self.running)
+        return bool(self.waiting or self.running or self.prefilling)
 
     # ------------------------------------------------------------ finish
 
@@ -161,6 +165,8 @@ class Scheduler:
             self.engine.retire(req.slot)
             if req in self.running:
                 self.running.remove(req)
+            if req in self.prefilling:
+                self.prefilling.remove(req)
             req.slot = None
         req.state = state
         req.error = error
@@ -306,11 +312,58 @@ class Scheduler:
 
     # -------------------------------------------------------------- step
 
+    def _advance_prefill(self) -> bool:
+        """Chunked prefill: cull dead in-progress admissions (EVERY one,
+        not just the head — a cancelled request behind the head must not
+        hold its slot and block reservations for the head's remaining
+        chunks), then advance the oldest survivor by exactly one chunk —
+        one compiled suffix-prefill call — so the decode stall this
+        iteration imposes on running streams is bounded by one chunk, not
+        one prompt. The final chunk emits the first token and promotes
+        the request to running."""
+        progress = False
+        for req in list(self.prefilling):
+            if req._cancel or req.deadline.expired():
+                # _finish retires the slot (engine releases chunk state)
+                self._finish(req,
+                             RequestState.CANCELLED if req._cancel
+                             else RequestState.FAILED,
+                             None if req._cancel
+                             else resilience.DeadlineExceededError(
+                                 f"{req.request_id} expired mid-prefill"))
+                progress = True
+        if not self.prefilling:
+            return progress
+        req = self.prefilling[0]
+        try:
+            first = self.engine.admit_chunk(req.slot)
+        # analysis: allow(broad-except) — classification inside:
+        # transient engine sickness re-queues + re-raises for the
+        # supervisor; anything else fails THIS request, not the pump
+        except Exception as e:
+            from .supervisor import is_transient_serving_error
+
+            self.prefilling.remove(req)
+            req.slot = None  # the engine already unwound the admission
+            if is_transient_serving_error(e):
+                req.state = RequestState.QUEUED
+                self.waiting.append(req)
+                raise
+            self._finish(req, RequestState.FAILED, e)
+            return True
+        if first is not None:
+            self.prefilling.remove(req)
+            req._admit_seq = next(_seq_counter)
+            self.running.append(req)
+            self._emit(req, first)
+            self._check_boundary(req)  # may retire at once (stop/budget)
+        return True
+
     def step(self) -> bool:
-        """One scheduler iteration: cull dead queue entries, admit while
-        capacity allows (preempting under starvation), run one engine
-        decode step, retire finished. Returns True if any request made
-        progress."""
+        """One scheduler iteration: cull dead queue entries, advance one
+        chunked prefill, admit while capacity allows (preempting under
+        starvation), run one engine decode step, retire finished. Returns
+        True if any request made progress."""
         progress = False
         # cull queued requests that died before costing a prefill
         for req in list(self.waiting):
@@ -323,6 +376,9 @@ class Scheduler:
                              else resilience.DeadlineExceededError(
                                  f"{req.request_id} expired in queue"))
                 progress = True
+        # one chunk of at most one in-progress chunked prefill per step
+        if self.prefilling:
+            progress |= self._advance_prefill()
         # priority admission into free slots
         starve_after = int(flags.flag("serving_starvation_steps"))
         starved_this_step = False
@@ -348,10 +404,18 @@ class Scheduler:
                 break
             self.waiting.remove(req)
             req._starved = 0
+            chunked = getattr(self.engine, "chunk_size", 0) > 0
             try:
-                slot, first = self.engine.admit(req.prompt,
-                                                req.max_new_tokens,
-                                                tokens=req.tokens)
+                if chunked:
+                    # chunked admission: the engine decides whether the
+                    # context fits one chunk (plain admit) or stays in
+                    # progress (first is None — one chunk per step)
+                    slot, first = self.engine.admit_begin(
+                        req.prompt, req.max_new_tokens, tokens=req.tokens)
+                else:
+                    slot, first = self.engine.admit(req.prompt,
+                                                    req.max_new_tokens,
+                                                    tokens=req.tokens)
             # analysis: allow(broad-except) — classification inside:
             # transient engine sickness re-queues + re-raises for the
             # supervisor; anything else fails THIS request, not the pump
@@ -372,17 +436,35 @@ class Scheduler:
                 continue
             req.slot = slot
             req.state = RequestState.RUNNING
+            progress = True
+            if first is None:
+                # chunked prefill in progress: holds its slot/blocks but
+                # decodes nothing until the final chunk emits its token
+                self.prefilling.append(req)
+                continue
             req._admit_seq = next(_seq_counter)
             self.running.append(req)
             self._emit(req, first)
-            progress = True
             self._check_boundary(req)  # may retire immediately (stop/budget)
         # one decode iteration over every occupied slot
         if self.running:
-            toks = self.engine.decode_step()
-            for req in list(self.running):
-                self._emit(req, int(toks[req.slot]))
-                self._check_boundary(req)
+            if getattr(self.engine, "spec", None) is not None:
+                # speculative: up to k accepted tokens per slot from one
+                # compiled call; emission stays per-token so stop-token /
+                # budget / deadline boundaries keep generate() semantics
+                # (tokens past a stop are dropped, exactly like the
+                # sequential path that would never have generated them)
+                accepted = self.engine.spec_decode_step()
+                for req in list(self.running):
+                    for tok in accepted.get(req.slot, ()):
+                        self._emit(req, int(tok))
+                        if self._check_boundary(req):
+                            break
+            else:
+                toks = self.engine.decode_step()
+                for req in list(self.running):
+                    self._emit(req, int(toks[req.slot]))
+                    self._check_boundary(req)
             progress = True
         self._gauges()
         return progress
@@ -393,6 +475,8 @@ class Scheduler:
         no caller is ever left blocking on an abandoned request."""
         for req in list(self.waiting):
             self.waiting.remove(req)
+            self._finish(req, RequestState.FAILED, error)
+        for req in list(self.prefilling):
             self._finish(req, RequestState.FAILED, error)
         for req in list(self.running):
             self._finish(req, RequestState.FAILED, error)
@@ -409,3 +493,4 @@ class Scheduler:
 
     def _gauges(self) -> None:
         metrics.set_gauge("queue.depth", len(self.waiting))
+        metrics.set_gauge("queue.prefilling", len(self.prefilling))

@@ -168,8 +168,17 @@ class EngineSupervisor:
         staged for replay is failed before the error propagates — nothing
         is ever left slot-less with its done_event unset."""
         sched = self.scheduler
-        pending = list(sched.running)
+        # mid-chunked-prefill requests died with the arena too: their
+        # journal is just prompt (+ any pre-crash tokens), so replay
+        # re-admits them through the normal one-shot prefill — recovery
+        # favors simplicity over chunk interleaving (the outage already
+        # stalled every stream; with speculation on, admit() also
+        # reconstructs each slot's draft cache)
+        pending = list(sched.running) + list(
+            getattr(sched, "prefilling", ()))
         sched.running.clear()
+        if hasattr(sched, "prefilling"):
+            sched.prefilling.clear()
         for req in pending:
             req.slot = None  # the old slot numbers die with the old arena
         try:

@@ -1,0 +1,26 @@
+"""known-bad twin of the speculative verify-k pattern
+(serving/spec_decode.py): the fused propose+verify program donates the KV
+pools, so (1) "rolling back" rejected speculation by re-reading the OLD
+pools after the call is use-after-donate (XLA reused that memory), and
+(2) deciding acceptance by branching on the traced proposal/target
+comparison INSIDE the compiled function is traced-branch (acceptance is
+data — it must come out as arrays and be decided host-side)."""
+import jax
+import jax.numpy as jnp
+
+
+def verify_k(arrays, pools, proposals, targets):
+    accepted = []
+    for j in range(4):
+        if proposals[j] == targets[j]:   # BAD: branch on traced compare
+            accepted.append(targets[j])
+    return jnp.stack(accepted) if accepted else targets, pools
+
+
+def spec_step(arrays, pools, proposals, targets):
+    step = jax.jit(verify_k, donate_argnums=(1,))
+    out, new_pools = step(arrays, pools, proposals, targets)
+    # BAD: rollback must be position bookkeeping over the RETURNED pools;
+    # the old `pools` were donated into the call on the line above
+    stale = jnp.sum(pools[0])
+    return out, new_pools, stale

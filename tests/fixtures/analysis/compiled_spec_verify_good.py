@@ -1,0 +1,27 @@
+"""known-good twin of the speculative verify-k pattern
+(serving/spec_decode.py): the fused program returns the target's greedy
+pick at EVERY position as an array; acceptance (the longest matching
+prefix) is computed host-side on fetched numpy values, and rejected
+speculation "rolls back" as pure position bookkeeping — the donated old
+pools are never touched again, only the returned ones are adopted."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def verify_k(arrays, pools, proposals, targets):
+    # all k positions scored unconditionally; acceptance is data, not
+    # control flow — no traced branch anywhere
+    agree = (proposals == targets).astype(jnp.int32)
+    return targets, agree, pools
+
+
+def spec_step(arrays, pools, proposals, targets):
+    step = jax.jit(verify_k, donate_argnums=(1,))
+    out, agree, new_pools = step(arrays, pools, proposals, targets)
+    agree = np.asarray(agree)  # host-side: fetched, no longer traced
+    n = 0
+    while n < agree.shape[0] and agree[n]:
+        n += 1
+    # rollback = position bookkeeping; the returned pools are adopted
+    return out[: n + 1], new_pools

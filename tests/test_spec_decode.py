@@ -1,0 +1,545 @@
+"""Speculative decoding + chunked prefill (ISSUE 10).
+
+Parity is the whole contract: with ``FLAGS_serving_spec_k`` > 0 the engine
+must emit *bit-identical* greedy output to plain decode — for a perfect
+draft, a garbage draft (pure rejection fallback), the lockstep self-draft,
+and across supervisor rebuild+replay with a live draft cache. Accept /
+reject / chunk admission must add ZERO compiled programs after warmup
+(trace-counter asserted), and draft-block rollback must leave the arena's
+refcount layer clean (invariant-checker asserted).
+
+Fast cases run in tier-1; the chaos replay and heavier churn cases carry
+``chaos`` / ``slow`` like the rest of the serving suite. Everything here
+builds its own ServingAPI (spec/chunk config is captured at engine
+construction), so the shared fixtures of test_serving.py are untouched —
+and the flag-off default path is exercised by that whole suite unmodified.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core import compile_cache, flags, resilience
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.models.gpt import GPTForCausalLM, gpt_tiny
+from paddle_tpu.serving import (
+    RequestState,
+    ServingAPI,
+    ServingConfig,
+    SpecDecoder,
+)
+from paddle_tpu.serving import metrics as serving_metrics
+
+pytestmark = pytest.mark.serving
+
+MAX_LEN = 96
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(0)
+    m = GPTForCausalLM(gpt_tiny())
+    m.eval()
+    return m
+
+
+@pytest.fixture(scope="module")
+def bad_draft():
+    """An independently initialized draft: proposes near-pure garbage, so
+    every iteration exercises the rejection/rollback path."""
+    paddle.seed(1234)
+    d = GPTForCausalLM(gpt_tiny())
+    d.eval()
+    return d
+
+
+@pytest.fixture(scope="module")
+def tied_draft(model):
+    """A separate draft instance carrying the target's weights: agrees
+    with the target everywhere (acceptance 1.0) while still running the
+    full draft machinery (own arrays, own arena namespace, own prefills)."""
+    paddle.seed(77)
+    d = GPTForCausalLM(gpt_tiny())
+    d.eval()
+    d.set_state_dict(dict(model.state_dict()))
+    return d
+
+
+def _prompt(rng, n):
+    return rng.integers(0, 1024, (n,), dtype=np.int32)
+
+
+def _ref(model, prompt, max_new, stop=None):
+    out = model.generate(Tensor(np.asarray(prompt)[None]),
+                         max_new_tokens=max_new, stop_token_id=stop)
+    return np.asarray(out._data)[0]
+
+
+def _spec_api(model, draft=None, k=4, **kw):
+    kw.setdefault("num_slots", 4)
+    kw.setdefault("kv_block_size", 8)
+    kw.setdefault("max_model_len", MAX_LEN)
+    return ServingAPI(model, ServingConfig(spec_k=k, draft_model=draft,
+                                           **kw))
+
+
+# ------------------------------------------------------------- parity
+
+
+def test_lockstep_parity_with_generate(model):
+    """Self-draft fused decode (no draft model): k target sub-steps per
+    compiled call, token-for-token identical to generate() across mixed
+    prompt/output lengths — including budgets that don't divide k."""
+    api = _spec_api(model, k=4)
+    try:
+        rng = np.random.default_rng(1)
+        cases = [(5, 8), (11, 13), (17, 1), (9, 4), (23, 19)]
+        prompts = [_prompt(rng, p) for p, _ in cases]
+        reqs = [api.submit(p, max_new_tokens=n)
+                for p, (_, n) in zip(prompts, cases)]
+        api.run_until_idle()
+        for p, (_, n), r in zip(prompts, cases, reqs):
+            assert r.state == RequestState.FINISHED
+            np.testing.assert_array_equal(r.output_ids(), _ref(model, p, n))
+        st = api.engine.spec.stats()
+        assert st["spec.acceptance_rate"] == 1.0  # structural, not lucky
+        # every decode-phase token came through speculation (each
+        # request's FIRST token is emitted by its prefill): exact count —
+        # the engine never over-emits past a budget and discards nothing
+        assert st["spec.emitted"] == sum(n - 1 for _, n in cases)
+    finally:
+        api.close()
+
+
+def test_bad_draft_rejection_fallback_is_bit_identical(model, bad_draft):
+    """A garbage draft is a pure slowdown, never a correctness change:
+    acceptance collapses toward zero (every iteration rolls speculation
+    back) and the output still equals plain greedy decode exactly."""
+    api = _spec_api(model, draft=bad_draft, k=3)
+    try:
+        rng = np.random.default_rng(2)
+        prompts = [_prompt(rng, n) for n in (5, 9, 14)]
+        reqs = [api.submit(p, max_new_tokens=12) for p in prompts]
+        api.run_until_idle()
+        for p, r in zip(prompts, reqs):
+            np.testing.assert_array_equal(r.output_ids(),
+                                          _ref(model, p, 12))
+        st = api.engine.spec.stats()
+        assert st["spec.rollback_tokens"] > 0  # rejections really happened
+        assert st["spec.acceptance_rate"] < 0.5
+    finally:
+        api.close()
+
+
+def test_tied_draft_full_acceptance_parity(model, tied_draft):
+    """A draft carrying the target's weights accepts everything — the
+    longest-prefix machinery, the second block table, and the draft
+    prefills all run, and the output is still bit-identical."""
+    api = _spec_api(model, draft=tied_draft, k=3)
+    try:
+        rng = np.random.default_rng(3)
+        prompts = [_prompt(rng, n) for n in (6, 10)]
+        reqs = [api.submit(p, max_new_tokens=10) for p in prompts]
+        api.run_until_idle()
+        for p, r in zip(prompts, reqs):
+            np.testing.assert_array_equal(r.output_ids(),
+                                          _ref(model, p, 10))
+        st = api.engine.spec.stats()
+        assert st["spec.acceptance_rate"] == 1.0
+        assert st["spec.rollback_tokens"] == 0
+        assert st["spec.draft_prefill_traces"]  # the draft really prefilled
+    finally:
+        api.close()
+
+
+def test_stop_token_parity_under_speculation(model):
+    """Tokens speculated past a stop hit are dropped, exactly like the
+    sequential path that would never have generated them."""
+    api = _spec_api(model, k=4)
+    try:
+        rng = np.random.default_rng(4)
+        p = _prompt(rng, 6)
+        full = _ref(model, p, 12)
+        stop = int(full[len(p) + 3])  # a token greedy decode really emits
+        ref = _ref(model, p, 12, stop=stop)
+        req = api.submit(p, max_new_tokens=12, stop_token_id=stop)
+        api.run_until_idle()
+        got = req.output_ids()
+        assert req.state == RequestState.FINISHED
+        assert int(got[-1]) == stop
+        assert len(got) < len(p) + 12
+        np.testing.assert_array_equal(got, ref[: len(got)])
+    finally:
+        api.close()
+
+
+# -------------------------------------------------- no-recompile invariant
+
+
+def test_accept_reject_churn_zero_new_compiles(model, bad_draft):
+    """Accept/reject churn is pure runtime data: after the first
+    iteration traces the fused program, an arbitrary mix of acceptance
+    depths, admissions, retirements and budget-clamped lanes adds ZERO
+    decode/prefill compiles (engine trace counters AND the shared
+    compile_cache counters agree)."""
+    api = _spec_api(model, draft=bad_draft, k=3)
+    try:
+        rng = np.random.default_rng(5)
+        # warm: one admission per prefill bucket the churn will touch,
+        # plus the fused spec-step program
+        api.submit(_prompt(rng, 5), max_new_tokens=4)
+        api.submit(_prompt(rng, 12), max_new_tokens=4)
+        api.run_until_idle()
+        s0 = api.engine.spec.spec_traces
+        cc0 = compile_cache.stats().get("serving.decode_compiles", 0)
+        pf0 = compile_cache.stats().get("serving.prefill_compiles", 0)
+        for round_ in range(3):
+            reqs = [api.submit(_prompt(rng, int(rng.integers(4, 14))),
+                               max_new_tokens=int(rng.integers(2, 9)))
+                    for _ in range(6)]
+            api.run_until_idle()
+            assert all(r.state == RequestState.FINISHED for r in reqs)
+        assert api.engine.spec.spec_traces == s0 == 1
+        assert compile_cache.stats().get("serving.decode_compiles", 0) == cc0
+        assert compile_cache.stats().get("serving.prefill_compiles", 0) == pf0
+    finally:
+        api.close()
+
+
+# ------------------------------------------------------- arena invariants
+
+
+def test_arena_invariants_after_draft_rollback_churn(model, bad_draft):
+    """The second (draft) block-table namespace obeys the refcount layer:
+    after rejection-heavy churn every draft block is accounted exactly
+    once, retirement returns both tables' budgets, and the drained arena
+    is empty."""
+    keep = paddle.get_flags("serving_arena_invariants")
+    paddle.set_flags({"serving_arena_invariants": 1})
+    api = _spec_api(model, draft=bad_draft, k=3)
+    try:
+        rng = np.random.default_rng(6)
+        reqs = [api.submit(_prompt(rng, n), max_new_tokens=8)
+                for n in (5, 9, 13, 7)]
+        # mid-flight audit: active target tables + draft tables vs refcounts
+        for _ in range(2):
+            api._pump_once()
+        api.engine.check_invariants()
+        api.run_until_idle()
+        assert all(r.state == RequestState.FINISHED for r in reqs)
+        api.engine.check_invariants()
+        a = api.engine.arena.stats()
+        assert a["blocks_in_use"] == 0 and a["blocks_reserved"] == 0
+        assert a["namespaces"] == 1  # the draft namespace exists
+    finally:
+        api.close()
+        paddle.set_flags(keep)
+
+
+def test_draft_mode_doubles_default_arena_and_reservations(model,
+                                                          tied_draft):
+    """Draft mode budgets a second worst-case table per slot: the default
+    arena doubles, admission reserves both, and retire returns both."""
+    api = _spec_api(model, draft=tied_draft, k=2, num_slots=2)
+    try:
+        eng = api.engine
+        assert eng.arena.num_blocks == 2 * 2 * eng.blocks_per_slot + 1
+        rng = np.random.default_rng(7)
+        req = api.submit(_prompt(rng, 9), max_new_tokens=4)
+        api._pump_once()
+        slot = req.slot
+        assert slot is not None
+        # both namespaces' budgets counted (preemption feasibility sums)
+        per_table = -(-(9 + 4) // eng.block_size)
+        assert eng.reserved_blocks(slot) == 2 * per_table
+        api.run_until_idle()
+        assert eng.arena.stats()["blocks_in_use"] == 0
+    finally:
+        api.close()
+
+
+# ----------------------------------------------------------- flag gating
+
+
+def test_flag_off_engine_has_no_spec_surface(model):
+    """Default flags reproduce the PR 9 engine exactly: no SpecDecoder,
+    no chunk state, plain decode_step semantics (the whole existing
+    serving suite runs against this path unmodified)."""
+    api = ServingAPI(model, num_slots=2, kv_block_size=8,
+                     max_model_len=MAX_LEN)
+    try:
+        assert api.engine.spec is None
+        assert api.engine.chunk_size == 0
+        assert flags.flag("serving_spec_k") == 0
+        assert flags.flag("serving_chunked_prefill") == 0
+        rng = np.random.default_rng(8)
+        p = _prompt(rng, 7)
+        req = api.submit(p, max_new_tokens=6)
+        api.run_until_idle()
+        np.testing.assert_array_equal(req.output_ids(), _ref(model, p, 6))
+    finally:
+        api.close()
+
+
+def test_spec_decoder_rejects_bad_config(model):
+    with pytest.raises(ValueError):
+        SpecDecoder(object(), None, k=0)
+    small_vocab = GPTForCausalLM(gpt_tiny())
+    small_vocab.cfg.vocab_size = 999
+    with pytest.raises(ValueError, match="vocab"):
+        _spec_api(model, draft=small_vocab, k=2)
+
+
+# -------------------------------------------------------- chunked prefill
+
+
+def test_chunked_prefill_interleaves_and_keeps_parity(model):
+    """A long prompt admits in chunks while a running stream keeps
+    decoding every iteration: the running stream gains >= one token per
+    chunk step (the bounded-stall contract), and both outputs equal
+    generate()'s bit-for-bit."""
+    api = ServingAPI(model, ServingConfig(num_slots=4, kv_block_size=8,
+                                          max_model_len=MAX_LEN,
+                                          chunked_prefill=8))
+    try:
+        rng = np.random.default_rng(9)
+        small = _prompt(rng, 5)
+        big = _prompt(rng, 41)  # 41 tokens -> several 8-token chunks
+        r1 = api.submit(small, max_new_tokens=24)
+        for _ in range(2):
+            api.scheduler.step()
+        r2 = api.submit(big, max_new_tokens=6)
+        api.scheduler.step()  # admission: the big prompt begins chunking
+        assert r2 in api.scheduler.prefilling
+        interleaved = 0
+        while api.scheduler.prefilling:
+            before = len(r1.tokens)
+            api.scheduler.step()
+            if not r1.finished and len(r1.tokens) > before:
+                interleaved += 1
+        assert interleaved >= 3  # decode really ran between chunks
+        api.run_until_idle()
+        np.testing.assert_array_equal(r1.output_ids(),
+                                      _ref(model, small, 24))
+        np.testing.assert_array_equal(r2.output_ids(),
+                                      _ref(model, big, 6))
+        sm = serving_metrics.stats()
+        assert sm.get("chunk.admits", 0) >= 1
+        assert sm.get("chunk.chunks", 0) >= 5
+    finally:
+        api.close()
+
+
+def test_chunked_prefill_bounded_compiles(model):
+    """Chunks reuse the suffix-prefill ladder: N chunked admissions of
+    assorted lengths mint at most the chunk-bucket programs once, then
+    zero — chunk admission is runtime data like everything else."""
+    api = ServingAPI(model, ServingConfig(num_slots=4, kv_block_size=8,
+                                          max_model_len=MAX_LEN,
+                                          chunked_prefill=8))
+    try:
+        rng = np.random.default_rng(10)
+        r = api.submit(_prompt(rng, 30), max_new_tokens=3)
+        api.run_until_idle()
+        assert r.state == RequestState.FINISHED
+        pf0 = compile_cache.stats().get("serving.prefill_compiles", 0)
+        d0 = api.engine.decode_traces
+        reqs = [api.submit(_prompt(rng, n), max_new_tokens=3)
+                for n in (25, 33, 17, 30)]
+        api.run_until_idle()
+        assert all(q.state == RequestState.FINISHED for q in reqs)
+        assert compile_cache.stats().get("serving.prefill_compiles", 0) == pf0
+        assert api.engine.decode_traces == d0
+    finally:
+        api.close()
+
+
+def test_cancel_mid_chunked_prefill_frees_everything(model):
+    """Cancelling a request whose prompt is still scattering releases the
+    slot, both reservations, and the chunk state — nothing leaks, and the
+    next admission reuses the slot."""
+    keep = paddle.get_flags("serving_arena_invariants")
+    paddle.set_flags({"serving_arena_invariants": 1})
+    api = ServingAPI(model, ServingConfig(num_slots=2, kv_block_size=8,
+                                          max_model_len=MAX_LEN,
+                                          chunked_prefill=8))
+    try:
+        rng = np.random.default_rng(11)
+        big = _prompt(rng, 40)
+        req = api.submit(big, max_new_tokens=6)
+        api.scheduler.step()  # admit_begin: slot claimed, chunks pending
+        assert req in api.scheduler.prefilling
+        in_use = api.engine.arena.stats()["blocks_in_use"]
+        assert in_use > 0
+        req.cancel()
+        api.scheduler.step()
+        assert req.state == RequestState.CANCELLED
+        a = api.engine.arena.stats()
+        assert a["blocks_in_use"] == 0 and a["blocks_reserved"] == 0
+        assert api.engine.free_slots() == 2
+        api.engine.check_invariants()
+        # slot is genuinely reusable
+        r2 = api.submit(_prompt(rng, 6), max_new_tokens=4)
+        api.run_until_idle()
+        assert r2.state == RequestState.FINISHED
+    finally:
+        api.close()
+        paddle.set_flags(keep)
+
+
+def test_cancel_behind_prefilling_head_frees_immediately(model):
+    """Regression (review finding): a cancelled chunked admission BEHIND
+    the queue head must release its slot/blocks at the next step, not
+    after the head's remaining chunks."""
+    api = ServingAPI(model, ServingConfig(num_slots=4, kv_block_size=8,
+                                          max_model_len=MAX_LEN,
+                                          chunked_prefill=8))
+    try:
+        rng = np.random.default_rng(16)
+        a = api.submit(_prompt(rng, 40), max_new_tokens=4)
+        b = api.submit(_prompt(rng, 40), max_new_tokens=4)
+        api.scheduler.step()  # both admitted chunked
+        assert [a, b] == api.scheduler.prefilling
+        free_before = api.engine.free_slots()
+        b.cancel()
+        api.scheduler.step()  # head A advances ONE chunk; B culled NOW
+        assert b.state == RequestState.CANCELLED
+        assert b not in api.scheduler.prefilling
+        assert api.engine.free_slots() == free_before + 1
+        assert a in api.scheduler.prefilling  # head unaffected
+        api.run_until_idle()
+        assert a.state == RequestState.FINISHED
+    finally:
+        api.close()
+
+
+def test_chunked_plus_speculation_compose(model, tied_draft):
+    """Both flags on: chunked admission scatters the target cache, the
+    final chunk triggers the draft prefill, and speculative decode takes
+    over — output still bit-identical."""
+    api = ServingAPI(model, ServingConfig(num_slots=2, kv_block_size=8,
+                                          max_model_len=MAX_LEN,
+                                          chunked_prefill=8, spec_k=3,
+                                          draft_model=tied_draft))
+    try:
+        rng = np.random.default_rng(12)
+        big = _prompt(rng, 37)
+        req = api.submit(big, max_new_tokens=10)
+        api.run_until_idle()
+        assert req.state == RequestState.FINISHED
+        np.testing.assert_array_equal(req.output_ids(),
+                                      _ref(model, big, 10))
+        assert api.engine.spec.stats()["spec.acceptance_rate"] == 1.0
+    finally:
+        api.close()
+
+
+# ------------------------------------------------------------ chaos/replay
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_spec_replay_parity_mid_verify_fault(model, tied_draft):
+    """A transient device fault during speculative decode recovers through
+    supervisor rebuild + journal replay: the draft cache is reconstructed
+    (admit re-prefills both namespaces), outputs are byte-identical to the
+    unfaulted run, the fused spec program never retraces, and the drained
+    arena is clean."""
+    keep = paddle.get_flags("fault_injection")["fault_injection"]
+    paddle.set_flags({"fault_injection": 1})
+    api = _spec_api(model, draft=tied_draft, k=3)
+    try:
+        rng = np.random.default_rng(13)
+        prompts = [_prompt(rng, n) for n in (5, 9, 12)]
+        reqs = [api.submit(p, max_new_tokens=14) for p in prompts]
+        api.run_until_idle()
+        refs = [r.output_ids() for r in reqs]
+        s0 = api.engine.spec.spec_traces
+        rb0 = resilience.stats().get("serving.rebuilds", 0)
+        reqs2 = [api.submit(p, max_new_tokens=14) for p in prompts]
+        for _ in range(2):
+            api._pump_once()
+        assert all(r.state == RequestState.RUNNING for r in reqs2)
+        # the fault probe fires inside the fused propose+verify dispatch
+        resilience.inject_fault("serving_device", times=1)
+        api.run_until_idle()
+        for ref, r in zip(refs, reqs2):
+            assert r.state == RequestState.FINISHED
+            np.testing.assert_array_equal(ref, r.output_ids())
+        assert resilience.stats().get("serving.rebuilds", 0) == rb0 + 1
+        assert api.engine.spec.spec_traces == s0 == 1  # no retrace anywhere
+        api.drain(grace=5)
+        a = api.engine.arena.stats()
+        assert a["blocks_in_use"] == 0 and a["blocks_reserved"] == 0
+    finally:
+        resilience.clear_faults()
+        api.close()
+        paddle.set_flags({"fault_injection": keep})
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_chunked_prefill_replay_after_mid_chunk_fault(model):
+    """A device fault while a long prompt is mid-chunk re-queues it (the
+    engine unwound the half-scattered admission) and the supervisor's
+    rebuild resumes everything token-for-token."""
+    keep = paddle.get_flags("fault_injection")["fault_injection"]
+    paddle.set_flags({"fault_injection": 1})
+    api = ServingAPI(model, ServingConfig(num_slots=4, kv_block_size=8,
+                                          max_model_len=MAX_LEN,
+                                          chunked_prefill=8))
+    try:
+        rng = np.random.default_rng(14)
+        small, big = _prompt(rng, 6), _prompt(rng, 40)
+        r_small = api.submit(small, max_new_tokens=20)
+        for _ in range(2):
+            api._pump_once()
+        r_big = api.submit(big, max_new_tokens=6)
+        api._pump_once()  # admit_begin; first chunks pending
+        assert r_big in api.scheduler.prefilling
+        resilience.inject_fault("serving_device", times=1)
+        api.run_until_idle()
+        assert r_small.state == RequestState.FINISHED
+        assert r_big.state == RequestState.FINISHED
+        np.testing.assert_array_equal(r_small.output_ids(),
+                                      _ref(model, small, 20))
+        np.testing.assert_array_equal(r_big.output_ids(),
+                                      _ref(model, big, 6))
+        a = api.engine.arena.stats()
+        api.drain(grace=5)
+        a = api.engine.arena.stats()
+        assert a["blocks_in_use"] == 0 and a["blocks_reserved"] == 0
+    finally:
+        resilience.clear_faults()
+        api.close()
+        paddle.set_flags({"fault_injection": keep})
+
+
+# ------------------------------------------------------------ observability
+
+
+def test_spec_stats_and_predictor_summary(model, caplog):
+    """Engine stats carry the spec.* keys; EnginePredictor.close() logs the
+    speculation line next to the PR 6 prefix hit-rate line."""
+    from paddle_tpu.serving import EnginePredictor
+
+    pred = EnginePredictor(model, max_new_tokens=6,
+                           config=ServingConfig(num_slots=2,
+                                                kv_block_size=8,
+                                                max_model_len=MAX_LEN,
+                                                spec_k=3))
+    rng = np.random.default_rng(15)
+    ids = np.stack([_prompt(rng, 8), _prompt(rng, 8)])
+    out = pred.run([ids])[0]
+    ref = np.asarray(model.generate(Tensor(ids), max_new_tokens=6)._data)
+    np.testing.assert_array_equal(out, ref)
+    st = pred._api.engine.stats()
+    assert st["spec.mode"] == "lockstep" and st["spec.k"] == 3
+    assert st["spec.emitted"] == 10  # 2 rows x (6 - 1 prefill-emitted)
+    import logging
+
+    with caplog.at_level(logging.INFO, logger="paddle_tpu.serving"):
+        pred.close()
+    summary = [rec.getMessage() for rec in caplog.records
+               if "EnginePredictor" in rec.getMessage()]
+    assert summary and "speculation" in summary[-1]
+    assert "lockstep k=3" in summary[-1]

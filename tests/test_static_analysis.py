@@ -63,6 +63,10 @@ FIXTURE_CASES = [
     ("mutable-global-capture", "compiled_mutable_global", ()),
     ("shape-from-data", "compiled_shape_from_data", ()),
     ("use-after-donate", "compiled_donation", ()),
+    # the PR 10 speculative verify-k shape: donated-pool rollback and
+    # traced acceptance branching (serving/spec_decode.py's two hazards)
+    ("use-after-donate", "compiled_spec_verify", ()),
+    ("traced-branch", "compiled_spec_verify", ()),
     ("undefined-flag", "registry_flags",
      ("paddle_tpu/core/flags.py",)),
     ("unknown-metric-key", "registry_metrics",
@@ -95,6 +99,10 @@ def test_bad_fixtures_are_specific():
         if stem.startswith("compiled_traced"):
             # casts and branches legitimately co-occur in trace hazards
             allowed |= {"traced-branch", "traced-cast"}
+        if stem == "compiled_spec_verify":
+            # this fixture deliberately seeds BOTH spec-decode hazards:
+            # donated-pool rollback + traced acceptance branching
+            allowed |= {"use-after-donate", "traced-branch"}
         assert rules <= allowed, (stem, rules)
 
 

@@ -25,6 +25,11 @@ recovery), ``api.drains`` / ``api.drain_stragglers`` / ``api.recoveries``.
 So do the radix prefix cache's (``FLAGS_serving_prefix_cache``):
 ``prefix.hits`` / ``prefix.hit_tokens`` (prefill tokens avoided) /
 ``prefix.inserted_blocks`` / ``prefix.evictions`` / ``prefix.cow_copies``.
+Speculative decoding (``FLAGS_serving_spec_k``) adds ``spec.proposed`` /
+``spec.accepted`` / ``spec.rollback_tokens`` / ``spec.emitted`` /
+``spec.iterations`` (+ the ``spec.acceptance_rate`` end-of-run gauge),
+and chunked prefill (``FLAGS_serving_chunked_prefill``) adds
+``chunk.admits`` / ``chunk.chunks`` / ``chunk.tokens``.
 The multi-tenant gateway's counters ride it too (``serving.gateway``):
 ``gateway.routed`` / ``gateway.rerouted`` (journaled fail-over) /
 ``gateway.ejected`` / ``gateway.respawned`` (replica health) /
@@ -78,6 +83,9 @@ def _config_report() -> dict:
         "serving_prefix_cache": _flag_env("serving_prefix_cache", 0),
         "serving_cache_affinity": _flag_env("serving_cache_affinity", 0),
         "serving_arena_invariants": _flag_env("serving_arena_invariants", 0),
+        # speculative decoding + chunked prefill (serving.spec_decode)
+        "serving_spec_k": _flag_env("serving_spec_k", 0),
+        "serving_chunked_prefill": _flag_env("serving_chunked_prefill", 0),
         # multi-tenant gateway (serving.gateway: router/tenancy/front door)
         "serving_replicas": _flag_env("serving_replicas", 2),
         "gateway_port": _flag_env("gateway_port", 8100),
@@ -135,6 +143,7 @@ def main(argv=None) -> int:
         # (cached blocks, high-water, fragmentation), NOT differenced
         gauges = {k: v for k, v in metrics.gauges().items()
                   if k.split(".")[0] in ("arena", "prefix", "slots",
+                                         "spec", "queue",
                                          "gateway", "tenant")}
         rec = {"wall_secs": round(wall, 3), "stats": delta,
                "gauges": gauges,
