@@ -201,6 +201,33 @@ define_flag("serving_spec_k", 0,
             "decode: k target sub-steps per dispatch, acceptance "
             "structurally 1.0). Part of the engine's program key: changing "
             "it builds new executables, never reuses old ones.")
+define_flag("serving_quant_weights", False,
+            "Weight-only int8 serving: quantize every GPT attention/MLP "
+            "matmul per output channel at engine construction "
+            "(models.gpt.quantize_serving_weights — the single "
+            "quantization.quantize_weight path) and dequantize in-kernel "
+            "inside the compiled decode/prefill/verify programs, so "
+            "weight HBM traffic is 1 byte/param. Greedy output is gated "
+            "on parity (or the documented per-token tolerance) vs the "
+            "unquantized compute dtype — see docs/quantization.md. Part "
+            "of the engine's program key like the donation flags; 0 "
+            "(default) keeps the serving path bit-identical to PR 10.")
+define_flag("serving_quant_kv", False,
+            "Int8 KV arena: the paged K/V pools store int8 with per-block "
+            "float32 scale pools (one symmetric scale per token row, "
+            "carried through pools()/set_pools()/namespaces/COW), "
+            "quantize-on-scatter at every KV write and dequant-on-attend "
+            "at every read — halves KV HBM traffic and roughly doubles "
+            "the slots an arena of equal bytes seats. Same parity gate "
+            "and program-key contract as FLAGS_serving_quant_weights; 0 "
+            "(default) keeps full-precision pools.")
+define_flag("serving_quant_draft", False,
+            "Quantize the speculative-decoding draft model's weights to "
+            "int8 (models.gpt.quantize_serving_weights on "
+            "ServingConfig.draft_model). Never changes emitted tokens — "
+            "verification keeps target-greedy semantics; a quantized "
+            "draft only moves spec.acceptance_rate (per-mode telemetry: "
+            "quant.draft_acceptance). No effect without a draft model.")
 define_flag("serving_chunked_prefill", 0,
             "Chunked prefill: slice a long prompt's prefill into chunks of "
             "this many tokens, interleaved one chunk per scheduler "

@@ -58,13 +58,16 @@ _counts: Dict[str, int] = {}
 #: rebuilds, replays, preemptions, replica ejections/respawns),
 #: ``faults`` armed-fault gauge, ``fault.<kind>`` per-kind fired-fault
 #: counters (dynamic keys from ``maybe_fault`` — invisible to the
-#: literal-key lint, so listed here for the runtime-coverage test).
+#: literal-key lint, so listed here for the runtime-coverage test),
+#: ``quant.*`` quantized-serving mirrors (docs/quantization.md — the
+#: serving-side counters live in ``serving.metrics``; this registry entry
+#: reserves the namespace so resilience dashboards can adopt them).
 #: Checked by ``tools/analyze.py``'s ``unknown-metric-key`` rule against
 #: every literal ``resilience.bump`` call — register new namespaces here
 #: WITH a docs mention, or the lint fails.
 DOCUMENTED_NAMESPACES = (
     "retry", "ckpt", "sentinel", "preempt", "overload", "deadline",
-    "quota", "serving", "faults", "fault",
+    "quota", "serving", "faults", "fault", "quant",
 )
 
 

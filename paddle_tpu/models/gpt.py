@@ -28,6 +28,7 @@ import numpy as np
 from .. import nn
 from ..core.tensor import Tensor
 from ..distributed.fleet.meta_parallel.mp_layers import (
+    MODEL_AXIS,
     ColumnParallelLinear,
     RowParallelLinear,
     VocabParallelEmbedding,
@@ -114,6 +115,121 @@ def masked_attention(qa, ka, va, mask):
     return jnp.swapaxes(jnp.einsum("bhqk,bhkd->bhqd", p, vt), 1, 2)
 
 
+#: the attention/MLP matmul weights quantize_serving_weights targets — the
+#: serving decode hot path's HBM traffic, in model order
+_SERVING_QUANT_LINEARS = ("attn.qkv", "attn.proj", "mlp.up", "mlp.down")
+
+
+def quantize_serving_weights(model) -> int:
+    """Per-channel int8 weight-only quantization of every attention/MLP
+    matmul of a :class:`GPTForCausalLM`, in place (``FLAGS_serving_quant_weights``
+    — the serving engine calls this at model load).
+
+    Each targeted linear's weight payload becomes int8 (``[in, out]``,
+    quantized per OUTPUT channel via
+    :func:`paddle_tpu.quantization.quantize_weight` — the framework's one
+    weight quantizer, no absmax math duplicated here) and the ``[1, out]``
+    float32 scale is registered as a ``weight_scale`` buffer, so
+    ``functional_state()`` carries both into every compiled program: the
+    decode/prefill/verify programs then stream int8 weights from HBM and
+    dequantize in-kernel (:func:`_serving_linear`). Embeddings, the (tied)
+    LM head and LayerNorms stay in the compute dtype — they are a small
+    fraction of decode traffic and the head's argmax is tolerance-critical.
+
+    Idempotent (a gateway's replicas share one model instance): already
+    quantized layers are skipped. Returns the number of layers quantized
+    by THIS call. Training a quantized model is not supported — serving
+    quantization is a load-time conversion, not QAT (see
+    :mod:`paddle_tpu.quantization` for fake-quant training)."""
+    from .. import quantization
+    from ..distributed.sharding_util import shard_parameter
+
+    n = 0
+    for blk in model.gpt.layers:
+        for lin in (blk.attn.qkv, blk.attn.proj, blk.mlp.up, blk.mlp.down):
+            if getattr(lin, "weight_scale", None) is not None:
+                continue
+            qw, scale = quantization.quantize_weight(
+                np.asarray(lin.weight._data), channel_axis=1)
+            lin.weight._data = jnp.asarray(qw)
+            lin.weight.stop_gradient = True
+            lin.register_buffer("weight_scale",
+                                Tensor(jnp.asarray(scale)))
+            # re-place on the mesh: the payload swap above replaced the
+            # committed (sharded) array with a default-placed one, and jit
+            # infers in_shardings from committed arrays — without this a
+            # TP mesh would hold the FULL int8 weight per chip. Column
+            # linears (qkv/up) shard out_features on the model axis (the
+            # per-out-channel scale shards with them); row linears
+            # (proj/down) shard in_features, their out-channel scale is
+            # replicated. No-op off-mesh (single chip).
+            if isinstance(lin, ColumnParallelLinear):
+                shard_parameter(lin.weight, None, MODEL_AXIS)
+                shard_parameter(lin.weight_scale, None, MODEL_AXIS)
+            else:
+                shard_parameter(lin.weight, MODEL_AXIS, None)
+                shard_parameter(lin.weight_scale, None, None)
+            n += 1
+    if n:
+        # generate()'s memoized runner is keyed per decode configuration;
+        # the quant tag joins that key (like the donation flag) so a
+        # pre-quantization runner is never reused on int8 weights
+        model._serving_quant = getattr(model, "_serving_quant", 0) + 1
+    return n
+
+
+def _serving_linear(layer, x):
+    """The attention/MLP matmul entry point shared by the quantized and
+    plain paths. An unquantized layer runs its normal forward (op-for-op
+    identical to calling it directly — the flag-off serving path stays
+    bit-identical). A layer carrying a ``weight_scale`` buffer (int8
+    payload from :func:`quantize_serving_weights`) dequantizes IN the
+    kernel: the int8 weight is read from HBM, multiplied by its per-channel
+    scale and cast to the activation dtype right before the matmul, so XLA
+    fuses the dequant into the matmul's operand pipeline — weight traffic
+    is 1 byte/param instead of 2-4."""
+    scale = getattr(layer, "weight_scale", None)
+    if scale is None:
+        return layer(x)
+    from ..core.dispatch import apply
+
+    if isinstance(layer, RowParallelLinear) and layer.input_is_parallel:
+        # mirror RowParallelLinear.forward's input hint: the contraction
+        # over the model-sharded in_features must stay a partial matmul +
+        # psum, not an all-gather of the activations
+        x = constraint(x, "data", None, MODEL_AXIS)
+
+    def deq_matmul(xa, qwa, sa, ba=None):
+        w = (qwa.astype(jnp.float32) * sa).astype(xa.dtype)
+        y = xa @ w
+        if ba is not None:
+            y = y + ba.astype(y.dtype)
+        return y
+
+    args = (x, layer.weight, scale) + (
+        () if layer.bias is None else (layer.bias,))
+    y = apply(deq_matmul, args, {}, name="serving_qlinear")
+    # mirror the parallel linears' output shardings (the quantized matmul
+    # must shard exactly like the one it replaces)
+    if isinstance(layer, ColumnParallelLinear) and not layer.gather_output:
+        return constraint(y, "data", None, MODEL_AXIS)
+    return constraint(y, "data", None, None)
+
+
+def serving_compute_dtype(model) -> str:
+    """The model's activation/KV compute dtype. Normally the attention
+    weights' dtype; with int8-quantized serving weights those read "int8",
+    so fall back to the (never-quantized) token embedding — KV caches and
+    activation buffers must be allocated in the compute dtype, not the
+    storage dtype. Accepts a :class:`GPTForCausalLM` or a bare
+    :class:`GPTModel`; this is the ONE home of the fallback rule
+    (``gen_kv_caches`` derives from it too), and the dict lookup keeps it
+    branch-free — generate()'s compiled copying build traces through it."""
+    gpt = getattr(model, "gpt", model)
+    d = str(gpt.layers[0].attn.qkv.weight._data.dtype)
+    return {"int8": str(gpt.wte.weight._data.dtype)}.get(d, d)
+
+
 def gpt_tiny(**kw) -> "GPTConfig":
     return GPTConfig(vocab_size=1024, hidden_size=128, num_layers=2, num_heads=4,
                      max_position_embeddings=256, **kw)
@@ -140,7 +256,7 @@ class GPTAttention(nn.Layer):
 
     def forward(self, x, cache=None, start_pos=0):
         b, s, h = x.shape
-        qkv = self.qkv(x)  # [b, s, 3h] sharded on model axis
+        qkv = _serving_linear(self.qkv, x)  # [b, s, 3h] sharded on model axis
         qkv = M.reshape(qkv, [b, s, 3, self.num_heads, self.head_dim])
         qkv = constraint(qkv, "data", "sep", None, "model", None)
         qs = M.split(qkv, 3, axis=2)
@@ -153,7 +269,7 @@ class GPTAttention(nn.Layer):
             o, new_cache = cache.update_and_attend(q, k, v)
             oa = o._data if isinstance(o, Tensor) else o
             out = M.reshape(Tensor(oa), [b, s, h])
-            return self.proj(out), new_cache
+            return _serving_linear(self.proj, out), new_cache
         if cache is not None:
             # incremental decode: write this chunk's k/v into the
             # preallocated [b, max_len, heads, dim] buffers at start_pos and
@@ -181,12 +297,12 @@ class GPTAttention(nn.Layer):
                                Tensor(jnp.asarray(pos_arr, jnp.int32))),
                 {}, name="gpt_cached_attn")
             out = M.reshape(o, [b, s, h])
-            return self.proj(out), (kb2, vb2)
+            return _serving_linear(self.proj, out), (kb2, vb2)
         out = F.scaled_dot_product_attention(q, k, v, is_causal=True,
                                              dropout_p=self.dropout if self.training else 0.0)
         out = M.reshape(out, [b, s, h])
         out = constraint(out, "data", "sep", "model")
-        return self.proj(out)
+        return _serving_linear(self.proj, out)
 
 
 class GPTMLP(nn.Layer):
@@ -196,7 +312,9 @@ class GPTMLP(nn.Layer):
         self.down = RowParallelLinear(cfg.intermediate_size, cfg.hidden_size, input_is_parallel=True)
 
     def forward(self, x):
-        return self.down(F.gelu(self.up(x), approximate=True))
+        return _serving_linear(
+            self.down,
+            F.gelu(_serving_linear(self.up, x), approximate=True))
 
 
 class GPTDecoderLayer(nn.Layer):
@@ -235,9 +353,12 @@ class GPTModel(nn.Layer):
         for incremental decoding. dtype defaults to the model's own weight
         dtype — a bf16-cast serving model must not re-upcast its cache,
         and dynamic_update_slice requires exact dtype match with the
-        produced k/v."""
+        produced k/v. Int8-quantized serving weights store int8 but
+        COMPUTE in the embedding dtype — the cache follows
+        :func:`serving_compute_dtype` (the one home of that fallback
+        rule; weight-only quantization never quantizes this path's KV)."""
         if dtype is None:
-            dtype = str(self.layers[0].attn.qkv.weight._data.dtype)
+            dtype = serving_compute_dtype(self)
         shape = [batch, max_len, self.cfg.num_heads,
                  self.cfg.hidden_size // self.cfg.num_heads]
         return [(creation.zeros(shape, dtype=dtype),
@@ -545,9 +666,14 @@ class GPTForCausalLM(nn.Layer):
             # a fresh executable, not reuse the old donation setting
             donate = bool(use_cache and _flags.flag("decode_donate"))
             stop = None if stop_token_id is None else int(stop_token_id)
+            # the serving-quant tag joins the key like the donation flag:
+            # quantizing the weights after a runner was memoized must build
+            # a fresh executable over the int8 payload, never reuse one
+            # traced against float weights
             cache_key = (b, prompt_len, max_new_tokens, bool(do_sample),
                          float(temperature), int(top_k), float(top_p),
-                         int(eos_token_id), bool(use_cache), donate, stop)
+                         int(eos_token_id), bool(use_cache), donate, stop,
+                         getattr(self, "_serving_quant", 0))
             cached = getattr(self, "_gen_cache", None)
             if cached is not None and cached[0] == cache_key:
                 compile_cache.bump("decode.cache_hits")

@@ -33,6 +33,7 @@ __all__ = [
     "QuantConfig", "QAT", "PTQ", "quanted_layers",
     "FakeQuanterWithAbsMaxObserver", "AbsmaxObserver",
     "MovingAverageMinMaxObserver", "quantize_weight", "dequantize_weight",
+    "quantize_kv", "dequantize_kv",
 ]
 
 
@@ -66,11 +67,21 @@ def fake_quant(x: Tensor, scale: Tensor) -> Tensor:
 
 
 def quantize_weight(w: np.ndarray, channel_axis: Optional[int] = None):
-    """float weight -> (int8 weight, float scale[, per-channel])."""
+    """float weight -> (int8 weight, float scale[, per-channel]).
+
+    This is THE weight quantizer of the framework: both the PTQ/QAT
+    ``convert()`` path and the serving engine's weight-only int8 mode
+    (:func:`paddle_tpu.models.gpt.quantize_serving_weights`) call it, so
+    the absmax math exists exactly once. ``channel_axis`` selects the
+    per-channel axis (negative values count from the end, numpy-style);
+    the returned scale keeps that axis (``keepdims``) so dequantization
+    is a plain broadcast multiply."""
+    w = np.asarray(w)
     if channel_axis is None:
         scale = np.maximum(np.abs(w).max(), 1e-9) / 127.0
         q = np.clip(np.round(w / scale), -128, 127).astype(np.int8)
         return q, np.float32(scale)
+    channel_axis = channel_axis % w.ndim
     axes = tuple(i for i in range(w.ndim) if i != channel_axis)
     scale = (np.maximum(np.abs(w).max(axis=axes, keepdims=True), 1e-9) / 127.0)
     q = np.clip(np.round(w / scale), -128, 127).astype(np.int8)
@@ -79,6 +90,29 @@ def quantize_weight(w: np.ndarray, channel_axis: Optional[int] = None):
 
 def dequantize_weight(q: np.ndarray, scale) -> np.ndarray:
     return q.astype(np.float32) * scale
+
+
+def quantize_kv(x):
+    """Symmetric per-token int8 quantization of a K/V chunk — jax-traceable
+    (runs INSIDE the serving engine's compiled prefill/decode programs:
+    quantize-on-scatter). ``x`` is ``[..., heads, head_dim]``; one scale per
+    leading (token/lane) index, reduced over the trailing ``(heads, dim)``
+    axes. Returns ``(int8 payload, float32 scale[...])``. All-array math by
+    construction: no host casts, no data-dependent shapes — the recompile
+    lint's ``compiled_quant`` fixture pair documents the anti-patterns."""
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=(-2, -1))
+    scale = jnp.maximum(amax, 1e-9) / 127.0
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale[..., None, None]),
+                 -128, 127).astype(jnp.int8)
+    return q, scale.astype(jnp.float32)
+
+
+def dequantize_kv(q, scale, dtype):
+    """Inverse of :func:`quantize_kv` (dequant-on-attend): int8 payload *
+    per-token scale, cast to the attention compute ``dtype``. The f32
+    multiply happens before the cast so a bf16 compute dtype rounds once,
+    not twice."""
+    return (q.astype(jnp.float32) * scale[..., None, None]).astype(dtype)
 
 
 # -------------------------------------------------------------- observers

@@ -527,16 +527,30 @@ class EnginePredictor:
                            "(%.0f%% acceptance, %d emitted, %s k=%d)") % (
                                spec.proposed, spec.accepted,
                                100.0 * spec.acceptance_rate(),
-                               spec.emitted,
-                               "draft" if spec.draft_mode else "lockstep",
-                               spec.k)
+                               spec.emitted, spec.mode(), spec.k)
         else:
             speculation = ""
+        engine = api.engine
+        if engine.quant_weights or engine.quant_kv or engine.quant_draft:
+            # the quantized-serving memory picture, per arena namespace —
+            # the int8 win is reported, not just asserted in tests
+            by_ns = engine.arena.bytes_by_namespace()
+            arena_desc = " + ".join(
+                "%s %s %.2f MiB%s" % (
+                    name, d["dtype"], d["bytes"] / 2 ** 20,
+                    (" (%.2f MiB scales)" % (d["scale_bytes"] / 2 ** 20)
+                     if d["scale_bytes"] else ""))
+                for name, d in by_ns.items())
+            quant = ", quantized serving [weights=%d kv=%d draft=%d]: %s" % (
+                int(engine.quant_weights), int(engine.quant_kv),
+                int(engine.quant_draft), arena_desc)
+        else:
+            quant = ""
         _logger.info(
             "EnginePredictor closed: %d finished, %d failed, "
             "%d supervisor replays (%d rebuilds), %d preemptions, "
-            "%d drains%s%s",
+            "%d drains%s%s%s",
             self._finished, self._failed,
             api.supervisor.replay_count, api.supervisor.rebuild_count,
             api.scheduler.preempt_count, api.drain_count, prefix,
-            speculation)
+            speculation, quant)

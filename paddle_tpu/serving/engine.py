@@ -33,6 +33,16 @@ Under ``FLAGS_decode_donate`` the KV pools are donated into every compiled
 prefill/decode call: XLA updates the arena in place instead of
 double-buffering what is by far the engine's largest allocation.
 
+**Quantized serving** (``FLAGS_serving_quant_weights`` /
+``FLAGS_serving_quant_kv`` / ``FLAGS_serving_quant_draft`` — see
+docs/quantization.md) rides the same data path: weights stream int8 and
+dequantize in-kernel (:func:`paddle_tpu.models.gpt._serving_linear`),
+the KV arena stores int8 with per-block scale pools carried inside every
+pool entry (quantize-on-scatter in :func:`_scatter_rows`,
+dequant-on-attend in :func:`_gather_ctx`), and each mode is captured at
+construction as part of the engine's program key exactly like the
+donation flag. All default off — the unquantized path is bit-identical.
+
 Two flag-gated multi-token extensions ride the same no-recompile
 contract: **speculative decoding** (``FLAGS_serving_spec_k`` —
 :mod:`paddle_tpu.serving.spec_decode`: a draft model proposes k tokens
@@ -64,16 +74,58 @@ def _ceil_div(a: int, b: int) -> int:
     return -(-a // b)
 
 
+def _scatter_rows(entry, row, off, kc, vc):
+    """Scatter one chunk's k/v rows at ``(row, off)`` into a pool entry.
+    A full-precision ``(k, v)`` entry writes the rows as-is (op-for-op
+    the pre-quantization path); an int8 ``(k, v, k_scale, v_scale)``
+    entry quantizes-on-scatter: each token row is symmetric-int8 quantized
+    (:func:`paddle_tpu.quantization.quantize_kv`) and its per-row scale
+    lands in the scale pools at the SAME (row, off) — payload and scale
+    can never go out of step. The entry-length branch is tuple structure
+    (static at trace time), never traced data."""
+    if len(entry) == 2:
+        kp, vp = entry
+        return (kp.at[row, off].set(kc), vp.at[row, off].set(vc))
+    from ..quantization import quantize_kv
+
+    kp, vp, ks, vs = entry
+    qk, sk = quantize_kv(kc)
+    qv, sv = quantize_kv(vc)
+    return (kp.at[row, off].set(qk), vp.at[row, off].set(qv),
+            ks.at[row, off].set(sk), vs.at[row, off].set(sv))
+
+
+def _gather_ctx(entry, table, dtype):
+    """Gather a block table's logical context from one pool entry:
+    ``table`` is ``[..., max_blocks]`` int32; returns ``(k_all, v_all)``
+    shaped ``[..., max_blocks*block_size, heads, dim]``. Int8 entries
+    dequantize-on-attend through their per-row scales in f32 before the
+    cast to the attention compute ``dtype``."""
+    kp, vp = entry[0], entry[1]
+    k_all = kp[table]
+    v_all = vp[table]  # [..., mb, bs, H, D]
+    if len(entry) == 4:
+        from ..quantization import dequantize_kv
+
+        k_all = dequantize_kv(k_all, entry[2][table], dtype)
+        v_all = dequantize_kv(v_all, entry[3][table], dtype)
+    shp = k_all.shape
+    out_shape = shp[:-4] + (shp[-4] * shp[-3],) + shp[-2:]
+    return k_all.reshape(out_shape), v_all.reshape(out_shape)
+
+
 class _PagedCacheView:
     """One layer's decode-step view of the paged arena (the ``cache``
     protocol object ``GPTAttention.forward`` drives): write the new token's
     k/v at each lane's (block, offset), gather the lane's block table, and
-    attend under the per-lane position mask."""
+    attend under the per-lane position mask. ``entry`` is the layer's
+    whole arena pool entry — ``(k, v)`` or, with ``FLAGS_serving_quant_kv``,
+    ``(k, v, k_scale, v_scale)`` (quantize-on-scatter / dequant-on-attend
+    via :func:`_scatter_rows` / :func:`_gather_ctx`)."""
 
-    def __init__(self, k_pool, v_pool, block_tables, positions, active,
+    def __init__(self, entry, block_tables, positions, active,
                  block_size: int):
-        self.k_pool = k_pool
-        self.v_pool = v_pool
+        self.entry = entry
         self.block_tables = block_tables  # [S, max_blocks] int32
         self.positions = positions        # [S] int32: write pos of new token
         self.active = active              # [S] bool
@@ -94,17 +146,13 @@ class _PagedCacheView:
         row = self.block_tables[jnp.arange(s_lanes), pos // bs]
         row = jnp.where(self.active, row, 0)
         off = pos % bs
-        k_pool = self.k_pool.at[row, off].set(ka[:, 0])
-        v_pool = self.v_pool.at[row, off].set(va[:, 0])
+        entry = _scatter_rows(self.entry, row, off, ka[:, 0], va[:, 0])
         # gather each lane's logical context [S, max_blocks*bs, H, D]
         t_len = self.block_tables.shape[1] * bs
-        k_all = k_pool[self.block_tables].reshape(
-            s_lanes, t_len, *k_pool.shape[2:])
-        v_all = v_pool[self.block_tables].reshape(
-            s_lanes, t_len, *v_pool.shape[2:])
+        k_all, v_all = _gather_ctx(entry, self.block_tables, qa.dtype)
         mask = (jnp.arange(t_len)[None, :] <= pos[:, None])[:, None, None, :]
         o = masked_attention(qa, k_all, v_all, mask)
-        new = _PagedCacheView(k_pool, v_pool, self.block_tables,
+        new = _PagedCacheView(entry, self.block_tables,
                               self.positions, self.active, bs)
         return o, new
 
@@ -137,10 +185,9 @@ class _PrefixPrefillView:
     int32 data, so every (cache hit, prefix length) reuses ONE compiled
     program per suffix-length bucket."""
 
-    def __init__(self, k_pool, v_pool, bt_row, prefix_len, true_len,
+    def __init__(self, entry, bt_row, prefix_len, true_len,
                  block_size: int):
-        self.k_pool = k_pool
-        self.v_pool = v_pool
+        self.entry = entry            # the layer's whole arena pool entry
         self.bt_row = bt_row          # [max_blocks] int32: the slot's table
         self.prefix_len = prefix_len  # scalar int32: resident context length
         self.true_len = true_len      # scalar int32: real (unpadded) suffix
@@ -162,14 +209,13 @@ class _PrefixPrefillView:
         # like full prefill's padding — bucketing never pollutes live state
         row = jnp.where(p_idx < self.true_len, self.bt_row[bi], 0)
         off = gpos % bs
-        k_pool = self.k_pool.at[row, off].set(ka[0])
-        v_pool = self.v_pool.at[row, off].set(va[0])
+        entry = _scatter_rows(self.entry, row, off, ka[0], va[0])
         t_len = self.bt_row.shape[0] * bs
-        k_all = k_pool[self.bt_row].reshape(1, t_len, *k_pool.shape[2:])
-        v_all = v_pool[self.bt_row].reshape(1, t_len, *v_pool.shape[2:])
+        k_all, v_all = _gather_ctx(entry, self.bt_row, qa.dtype)
+        k_all, v_all = k_all[None], v_all[None]
         mask = (jnp.arange(t_len)[None, :] <= gpos[:, None])[None, None]
         o = masked_attention(qa, k_all, v_all, mask)
-        new = _PrefixPrefillView(k_pool, v_pool, self.bt_row,
+        new = _PrefixPrefillView(entry, self.bt_row,
                                  self.prefix_len, self.true_len, bs)
         return o, new
 
@@ -211,6 +257,17 @@ class ServingConfig:
     # chunk per scheduler iteration through the suffix-prefill programs,
     # bounding the decode stall of running streams to one chunk.
     chunked_prefill: Optional[int] = None
+    # quantized serving (None defers to the FLAGS_serving_quant_* trio;
+    # all default off = bit-identical to the unquantized engine).
+    # Captured at construction like the donation flag — each mode is part
+    # of the engine's program key: toggling builds fresh executables over
+    # the new dtypes, never reuses old ones. quant_weights: int8
+    # weight-only decode (per-channel, dequant-in-kernel); quant_kv: int8
+    # KV arena with per-block scale pools; quant_draft: int8-quantize the
+    # draft model's weights (speed/acceptance knob, never correctness).
+    quant_weights: Optional[bool] = None
+    quant_kv: Optional[bool] = None
+    quant_draft: Optional[bool] = None
 
 
 @dataclass
@@ -246,6 +303,24 @@ class ServingEngine:
             raise TypeError("pass either a ServingConfig or kwargs, not both")
         self._model = model
         model.eval()
+
+        self.quant_weights = (bool(flags.flag("serving_quant_weights"))
+                              if cfg.quant_weights is None
+                              else bool(cfg.quant_weights))
+        self.quant_kv = (bool(flags.flag("serving_quant_kv"))
+                         if cfg.quant_kv is None else bool(cfg.quant_kv))
+        self.quant_draft = (bool(flags.flag("serving_quant_draft"))
+                            if cfg.quant_draft is None
+                            else bool(cfg.quant_draft))
+        if self.quant_weights:
+            # in-place, idempotent (gateway replicas share one model):
+            # must run BEFORE the functional_state snapshot below so the
+            # compiled programs stream the int8 payload + scale buffers
+            from ..models.gpt import quantize_serving_weights
+
+            n = quantize_serving_weights(model)
+            if n:
+                metrics.bump("quant.weight_layers", n)
         params, buffers = model.functional_state()
         self._objs = list(params.values()) + list(buffers.values())
         self._arrays = [p._data for p in self._objs]
@@ -278,12 +353,17 @@ class ServingEngine:
         if self._retry is None and not self.donate:
             self._retry = resilience.io_policy()
 
-        kv_dtype = str(model.gpt.layers[0].attn.qkv.weight._data.dtype)
+        from ..models.gpt import serving_compute_dtype
+
+        kv_dtype = serving_compute_dtype(model)
         # kept so the supervisor can rebuild an identically-shaped arena
-        # after a transient device failure (same shapes => zero recompiles)
+        # after a transient device failure (same shapes => zero recompiles);
+        # the quant-kv mode rides along so the rebuilt arena keeps its
+        # int8 pools + scale pools
         self._arena_args = (mcfg.num_layers, mcfg.num_heads,
                             mcfg.hidden_size // mcfg.num_heads,
-                            num_blocks, self.block_size, kv_dtype)
+                            num_blocks, self.block_size, kv_dtype,
+                            self.quant_kv)
         self.arena = KVArena(*self._arena_args)
         self.use_prefix_cache = (bool(flags.flag("serving_prefix_cache"))
                                  if cfg.prefix_cache is None
@@ -329,7 +409,12 @@ class ServingEngine:
                      if spec_k > 0 else None)
         self._meter = metrics.Meter()  # lifetime aggregate tokens/s gauge
         metrics.set_gauge("slots.total", s)
-        metrics.set_gauge("arena.kv_bytes", self.arena.bytes_total())
+        metrics.set_gauge("quant.weights", int(self.quant_weights))
+        metrics.set_gauge("quant.kv", int(self.quant_kv))
+        metrics.set_gauge("quant.draft", int(self.quant_draft
+                                             and self.spec is not None
+                                             and self.spec.draft_mode))
+        self._publish_arena_bytes()
         self._refresh_gauges()
 
     # ----------------------------------------------------------- capacity
@@ -460,11 +545,11 @@ class ServingEngine:
             row = jnp.where(p_idx < true_len, row, 0)
             off = p_idx % bs
             new_pools = []
-            for (kc, vc), (kp, vp) in zip(chunks, pools):
+            for (kc, vc), entry in zip(chunks, pools):
                 kc = kc._data if isinstance(kc, Tensor) else kc
                 vc = vc._data if isinstance(vc, Tensor) else vc
-                new_pools.append((kp.at[row, off].set(kc[0]),
-                                  vp.at[row, off].set(vc[0])))
+                new_pools.append(
+                    _scatter_rows(entry, row, off, kc[0], vc[0]))
             nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             return nxt[0], new_pools
 
@@ -495,8 +580,8 @@ class ServingEngine:
             self.prefix_prefill_traces[p_bucket] = \
                 self.prefix_prefill_traces.get(p_bucket, 0) + 1
             compile_cache.bump("serving.prefill_compiles")
-            views = [_PrefixPrefillView(kp, vp, bt_row, prefix_len,
-                                        true_len, bs) for kp, vp in pools]
+            views = [_PrefixPrefillView(entry, bt_row, prefix_len,
+                                        true_len, bs) for entry in pools]
             with _swap_data(self._objs, list(arrays)):
                 with prng.key_guard(jax.random.key(0)):
                     h, new_views = model.gpt(Tensor(ids), caches=views,
@@ -505,7 +590,7 @@ class ServingEngine:
                     h._data, true_len - 1, axis=1, keepdims=False)
                 logits = model._head_logits(h_last)
             nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            new_pools = [(v.k_pool, v.v_pool) for v in new_views]
+            new_pools = [v.entry for v in new_views]
             return nxt[0], new_pools
 
         fn = (jax.jit(prefix_prefill, donate_argnums=(4,)) if self.donate
@@ -519,15 +604,19 @@ class ServingEngine:
         blocks read-only when a slot must write inside its matched prefix
         (a fully-cached block-aligned prompt recomputing its last token).
         One compiled gather/scatter per arena shape; src/dst are runtime
-        scalars, so COW never recompiles either."""
+        scalars, so COW never recompiles either. Copies EVERY array of
+        each pool entry — with the int8 arena that includes the per-block
+        scale pools (a COW that copied KV but not scales would silently
+        dequantize the copy with the victim block's scales; the arena's
+        ``check_invariants`` audits the entry structure)."""
         if self._cow_jit is None:
             import jax
 
             def cow(pools, src, dst):
                 self.cow_traces += 1
                 compile_cache.bump("serving.cow_compiles")
-                return [(kp.at[dst].set(kp[src]), vp.at[dst].set(vp[src]))
-                        for kp, vp in pools]
+                return [tuple(p.at[dst].set(p[src]) for p in entry)
+                        for entry in pools]
 
             self._cow_jit = (jax.jit(cow, donate_argnums=(0,))
                              if self.donate else jax.jit(cow))
@@ -554,8 +643,8 @@ class ServingEngine:
         def step(arrays, pools, block_tables, positions, last_tok, active):
             self.decode_traces += 1  # trace-time: the no-recompile counter
             compile_cache.bump("serving.decode_compiles")
-            views = [_PagedCacheView(kp, vp, block_tables, positions,
-                                     active, bs) for kp, vp in pools]
+            views = [_PagedCacheView(entry, block_tables, positions,
+                                     active, bs) for entry in pools]
             with _swap_data(self._objs, list(arrays)):
                 with prng.key_guard(jax.random.key(0)):
                     h, new_views = model.gpt(Tensor(last_tok[:, None]),
@@ -563,7 +652,7 @@ class ServingEngine:
                                              start_pos=positions)
                 logits = model._head_logits(h._data[:, 0])
             nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            new_pools = [(v.k_pool, v.v_pool) for v in new_views]
+            new_pools = [v.entry for v in new_views]
             return nxt, new_pools
 
         self._step_jit = (jax.jit(step, donate_argnums=(1,)) if self.donate
@@ -970,7 +1059,7 @@ class ServingEngine:
             # replays reconstruct each slot's draft cache as they re-admit
             self.spec.rebuild()
         metrics.bump("engine.rebuilds")
-        metrics.set_gauge("arena.kv_bytes", self.arena.bytes_total())
+        self._publish_arena_bytes()
         self._refresh_gauges()
 
     # --------------------------------------------------------- decode step
@@ -1027,6 +1116,19 @@ class ServingEngine:
 
     # -------------------------------------------------------------- stats
 
+    def _publish_arena_bytes(self) -> None:
+        """Byte/dtype gauges per arena namespace (scale pools broken out)
+        — the memory win of the int8 arena is observable, not asserted:
+        ``tools/serving_stats.py --run`` and ``EnginePredictor.close()``
+        both read these."""
+        metrics.set_gauge("arena.kv_bytes", self.arena.bytes_total())
+        by_ns = self.arena.bytes_by_namespace()
+        metrics.set_gauge("arena.scale_bytes",
+                          sum(d["scale_bytes"] for d in by_ns.values()))
+        for name, d in by_ns.items():
+            metrics.set_gauge(f"arena.bytes.{name}", d["bytes"])
+            metrics.set_gauge(f"arena.dtype.{name}", d["dtype"])
+
     def _refresh_gauges(self) -> None:
         metrics.set_gauge("slots.active", self.active_slots())
         a = self.arena.stats()
@@ -1051,7 +1153,14 @@ class ServingEngine:
                "prefill_traces": dict(self.prefill_traces),
                "prefix_prefill_traces": dict(self.prefix_prefill_traces),
                "cow_traces": self.cow_traces,
-               "chunk_size": self.chunk_size}
+               "chunk_size": self.chunk_size,
+               "quant.weights": int(self.quant_weights),
+               "quant.kv": int(self.quant_kv),
+               # effective, not the raw flag: quant_draft without a draft
+               # model quantizes nothing (matches the quant.draft gauge)
+               "quant.draft": int(self.quant_draft
+                                  and self.spec is not None
+                                  and self.spec.draft_mode)}
         out.update({f"arena.{k}": v for k, v in self.arena.stats().items()})
         if self.prefix_cache is not None:
             out.update({f"prefix.{k}": v

@@ -5,6 +5,12 @@ admit would fragment HBM and retrace XLA) but one fixed **arena** per layer:
 
     k_pool, v_pool : [num_blocks, block_size, num_heads, head_dim]
 
+With ``quantized=True`` (``FLAGS_serving_quant_kv``) each per-layer entry is
+a 4-tuple instead: ``(k, v, k_scale, v_scale)`` — int8 payload plus float32
+``[num_blocks, block_size]`` per-block-row scale pools that travel as one
+unit through every pools consumer (iterate entries, never unpack ``k, v``;
+``check_invariants`` rejects adopted pools missing their scales).
+
 A request's cache is a *block table* — an ordered list of physical block ids
 covering its context. Blocks are taken from a LIFO free list as the context
 grows and returned at retire, so churn reuses the hottest blocks instead of
@@ -104,7 +110,7 @@ class KVArena:
 
     def __init__(self, num_layers: int, num_heads: int, head_dim: int,
                  num_blocks: int, block_size: Optional[int] = None,
-                 dtype: str = "float32"):
+                 dtype: str = "float32", quantized: bool = False):
         import jax.numpy as jnp
 
         self.block_size = int(block_size or flags.flag("kv_block_size"))
@@ -114,12 +120,19 @@ class KVArena:
             raise ValueError("need >= 2 blocks (block 0 is the scratch sink)")
         self.num_blocks = int(num_blocks)
         self.num_layers = int(num_layers)
+        # `dtype` stays the LOGICAL (compute) dtype; with `quantized` the
+        # physical k/v payload is int8 and each per-layer pool entry grows
+        # per-block scale pools: (k, v) -> (k, v, k_scale, v_scale), with
+        # scales shaped [num_blocks, block_size] float32 (one symmetric
+        # scale per token row of each block). The 4-tuple travels as one
+        # unit through pools()/set_pools()/namespaces/donation/COW — a
+        # consumer that copies or adopts K/V without its scales cannot
+        # exist structurally (check_invariants audits the entry shape).
         self.dtype = dtype
-        shape = (self.num_blocks, self.block_size, num_heads, head_dim)
+        self.quantized = bool(quantized)
         self._pools: List[Tuple] = [
-            (jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+            self._fresh_entry(jnp, num_heads, head_dim)
             for _ in range(num_layers)]
-        self._itemsize = jnp.zeros((), dtype).dtype.itemsize
         # LIFO: churny workloads keep re-taking the most recently freed
         # blocks (cache-friendly, and makes reuse observable)
         self._free: List[int] = list(range(1, self.num_blocks))
@@ -144,6 +157,21 @@ class KVArena:
 
     # ------------------------------------------------------------- pools
 
+    def _fresh_entry(self, jnp, num_heads: int, head_dim: int,
+                     quantized: Optional[bool] = None,
+                     dtype: Optional[str] = None) -> Tuple:
+        """One layer's zeroed pool entry: ``(k, v)`` full-precision, or
+        ``(k, v, k_scale, v_scale)`` int8 + per-block-row scales."""
+        quantized = self.quantized if quantized is None else quantized
+        dtype = dtype or self.dtype
+        shape = (self.num_blocks, self.block_size, int(num_heads),
+                 int(head_dim))
+        if not quantized:
+            return (jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+        sshape = (self.num_blocks, self.block_size)
+        return (jnp.zeros(shape, "int8"), jnp.zeros(shape, "int8"),
+                jnp.zeros(sshape, "float32"), jnp.zeros(sshape, "float32"))
+
     @property
     def pools(self) -> List[Tuple]:
         return self._pools
@@ -154,25 +182,28 @@ class KVArena:
         self._pools = list(pools)
 
     def add_namespace(self, name: str, num_layers: int, num_heads: int,
-                      head_dim: int, dtype: Optional[str] = None) -> None:
+                      head_dim: int, dtype: Optional[str] = None,
+                      quantized: Optional[bool] = None) -> None:
         """Create a named secondary pool set over the same block ids (the
         speculative decoder's draft KV cache). Shares the allocator: a
         block id taken from the free list is simultaneously valid in every
         namespace — the engine decides which namespace a given slot table
-        actually writes. Idempotent per name only via :meth:`rebuild`-style
+        actually writes. ``quantized`` defaults to the arena's own mode
+        (an int8 arena quantizes its draft namespace too, scale pools
+        included). Idempotent per name only via :meth:`rebuild`-style
         reconstruction (adding an existing name raises)."""
         import jax.numpy as jnp
 
         if name in self._ns_pools:
             raise ValueError(f"namespace {name!r} already exists")
         dtype = dtype or self.dtype
-        shape = (self.num_blocks, self.block_size, int(num_heads),
-                 int(head_dim))
+        quantized = self.quantized if quantized is None else bool(quantized)
         self._ns_pools[name] = [
-            (jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+            self._fresh_entry(jnp, num_heads, head_dim,
+                              quantized=quantized, dtype=dtype)
             for _ in range(int(num_layers))]
         self._ns_shapes[name] = (int(num_layers), int(num_heads),
-                                 int(head_dim), dtype)
+                                 int(head_dim), dtype, quantized)
 
     def ns_pools(self, name: str) -> List[Tuple]:
         return self._ns_pools[name]
@@ -312,6 +343,28 @@ class KVArena:
         iterable of per-slot block-id lists for ACTIVE slots — must
         reference each block exactly ``refcount`` times (a block id in two
         slots' tables is legal only when its refcount says so)."""
+        # structural audit of the quantized pool entries: adopted pools
+        # (set_pools after a compiled step, COW, rebuild) must carry their
+        # scale pools — K/V copied without scales is silent corruption
+        for name, pools in [("primary", self._pools)] + [
+                (n, p) for n, p in self._ns_pools.items()]:
+            if name == "primary":
+                quantized = self.quantized
+            else:
+                quantized = self._ns_shapes[name][4]
+            want = 4 if quantized else 2
+            for li, entry in enumerate(pools):
+                if len(entry) != want:
+                    raise RuntimeError(
+                        f"invariant violated: {name} pool entry {li} has "
+                        f"{len(entry)} arrays (expected {want}) — a "
+                        "quantized pool was adopted without its scales")
+                if quantized and tuple(entry[2].shape) != (
+                        self.num_blocks, self.block_size):
+                    raise RuntimeError(
+                        f"invariant violated: {name} scale pool {li} shape "
+                        f"{tuple(entry[2].shape)} != "
+                        f"{(self.num_blocks, self.block_size)}")
         if len(self._free) != len(set(self._free)):
             raise RuntimeError(
                 "invariant violated: duplicate block id on the free list")
@@ -337,21 +390,55 @@ class KVArena:
 
     # ------------------------------------------------------------- stats
 
-    def bytes_total(self) -> int:
-        def _pool_bytes(pools):
-            total = 0
-            for k, _ in pools:
+    @staticmethod
+    def _pool_bytes(pools) -> Tuple[int, int]:
+        """(kv payload bytes, scale-pool bytes) of one pool set.
+        ``.dtype.itemsize`` is host metadata (works for ml_dtypes bf16 and
+        int8 alike): stats()/gauges poll this — it must never allocate on
+        the device."""
+        kv = scale = 0
+        for entry in pools:
+            for i, arr in enumerate(entry):
                 per = 1
-                for d in k.shape:
+                for d in arr.shape:
                     per *= int(d)
-                # .dtype.itemsize is host metadata (works for ml_dtypes
-                # bf16 too): stats()/gauges poll this — it must never
-                # allocate on the device
-                total += per * k.dtype.itemsize * 2
-            return total
+                b = per * arr.dtype.itemsize
+                if i < 2:
+                    kv += b
+                else:
+                    scale += b
+        return kv, scale
 
-        return _pool_bytes(self._pools) + sum(
-            _pool_bytes(p) for p in self._ns_pools.values())
+    def bytes_total(self) -> int:
+        """All pool bytes — K/V payload PLUS scale pools, every namespace.
+        The equal-memory comparisons (the >=1.9x-slots acceptance gate,
+        the --quantized bench) budget against this number, so the scale
+        overhead is never hidden."""
+        total = 0
+        for pools in [self._pools] + list(self._ns_pools.values()):
+            kv, scale = self._pool_bytes(pools)
+            total += kv + scale
+        return total
+
+    def bytes_by_namespace(self) -> dict:
+        """Per-namespace byte/dtype breakdown: ``{name: {kv_bytes,
+        scale_bytes, bytes, dtype, quantized}}`` with the primary pools
+        under ``"primary"`` — the observable form of the quantized-arena
+        memory win (tools/serving_stats.py --run, EnginePredictor.close)."""
+        out = {}
+
+        def record(name, pools, dtype, quantized):
+            kv, scale = self._pool_bytes(pools)
+            out[name] = {"kv_bytes": kv, "scale_bytes": scale,
+                         "bytes": kv + scale,
+                         "dtype": "int8" if quantized else dtype,
+                         "quantized": bool(quantized)}
+
+        record("primary", self._pools, self.dtype, self.quantized)
+        for name, pools in self._ns_pools.items():
+            _, _, _, dtype, quantized = self._ns_shapes[name]
+            record(name, pools, dtype, quantized)
+        return out
 
     def stats(self) -> dict:
         return {
@@ -363,5 +450,7 @@ class KVArena:
             "high_water": self._high_water,
             "block_size": self.block_size,
             "kv_bytes": self.bytes_total(),
+            "quantized": self.quantized,
+            "bytes_by_namespace": self.bytes_by_namespace(),
             "namespaces": len(self._ns_pools),
         }

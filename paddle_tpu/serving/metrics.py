@@ -31,6 +31,11 @@ Counter namespaces:
 * ``chunk.*``      — chunked prefill: ``admits`` (admissions that went
   chunked) / ``chunks`` (compiled chunk calls) / ``tokens`` (prompt
   tokens scattered through chunks)
+* ``quant.*``      — quantized serving (``FLAGS_serving_quant_*``):
+  ``weight_layers`` / ``draft_layers`` (linears int8-quantized at model
+  load), plus the mode gauges ``quant.weights`` / ``quant.kv`` /
+  ``quant.draft`` (0/1) and ``quant.draft_acceptance`` (the quantized
+  draft's acceptance rate — its tuning signal)
 * ``gateway.*``    — the multi-tenant front door (``serving.gateway``):
   ``routed`` / ``rerouted`` (journaled fail-over onto a healthy replica) /
   ``affinity_routes`` (warm-cache wins within the bounded slack) /
@@ -75,8 +80,8 @@ _providers_registered = False
 #: from the stats CLIs and dashboards.
 DOCUMENTED_NAMESPACES = (
     "requests", "tokens", "engine", "arena", "scheduler", "supervisor",
-    "api", "prefix", "spec", "chunk", "gateway", "tenant", "queue",
-    "slots", "tokens_per_sec",
+    "api", "prefix", "spec", "chunk", "quant", "gateway", "tenant",
+    "queue", "slots", "tokens_per_sec",
 )
 
 

@@ -110,6 +110,7 @@ class SpecDecoder:
         self.iterations = 0
         self._spec_jit = None
         self._prefill_jits: Dict[int, object] = {}
+        self.quant_draft = bool(getattr(engine, "quant_draft", False))
         if self.draft is not None:
             self.draft.eval()
             dcfg = self.draft.cfg
@@ -118,6 +119,17 @@ class SpecDecoder:
                 raise ValueError(
                     f"draft vocab {dcfg.vocab_size} != target vocab "
                     f"{tcfg.vocab_size}: proposals would be meaningless ids")
+            if self.quant_draft:
+                # int8-quantize the draft's weights in place (idempotent)
+                # BEFORE the functional-state snapshot: the fused
+                # propose+verify program then streams the int8 payload.
+                # Verification keeps target-greedy semantics, so this only
+                # moves acceptance/speed — never the emitted tokens.
+                from ..models.gpt import quantize_serving_weights
+
+                n = quantize_serving_weights(self.draft)
+                if n:
+                    metrics.bump("quant.draft_layers", n)
             params, buffers = self.draft.functional_state()
             self._d_objs = list(params.values()) + list(buffers.values())
             self._d_arrays = [p._data for p in self._d_objs]
@@ -130,9 +142,13 @@ class SpecDecoder:
         return self.draft is not None
 
     def _bind_namespace(self) -> None:
+        from ..models.gpt import serving_compute_dtype
+
         dcfg = self.draft.cfg
-        kv_dtype = str(
-            self.draft.gpt.layers[0].attn.qkv.weight._data.dtype)
+        # compute dtype, not storage dtype: an int8-quantized draft still
+        # produces (and attends over) float k/v; with FLAGS_serving_quant_kv
+        # the namespace inherits the arena's int8+scale-pool layout
+        kv_dtype = serving_compute_dtype(self.draft)
         self.engine.arena.add_namespace(
             self.NAMESPACE, dcfg.num_layers, dcfg.num_heads,
             dcfg.hidden_size // dcfg.num_heads, kv_dtype)
@@ -251,7 +267,7 @@ class SpecDecoder:
 
         from ..core import rng as prng
         from ..jit import _swap_data
-        from .engine import _CapturePrefillView
+        from .engine import _CapturePrefillView, _scatter_rows
 
         draft = self.draft
         n_layers = draft.cfg.num_layers
@@ -273,11 +289,11 @@ class SpecDecoder:
             row = jnp.where(p_idx < true_len, row, 0)
             off = p_idx % bs
             new_pools = []
-            for (kc, vc), (kp, vp) in zip(chunks, pools):
+            for (kc, vc), entry in zip(chunks, pools):
                 kc = kc._data if isinstance(kc, Tensor) else kc
                 vc = vc._data if isinstance(vc, Tensor) else vc
-                new_pools.append((kp.at[row, off].set(kc[0]),
-                                  vp.at[row, off].set(vc[0])))
+                new_pools.append(
+                    _scatter_rows(entry, row, off, kc[0], vc[0]))
             return new_pools
 
         fn = (jax.jit(draft_prefill, donate_argnums=(3,))
@@ -313,13 +329,13 @@ class SpecDecoder:
             """One single-token model forward — same ops, shapes and view
             class as ``ServingEngine._get_step``'s body, head excluded.
             Returns (last hidden [S, H], new pools)."""
-            views = [_PagedCacheView(kp, vp, bt, positions, act, bs)
-                     for kp, vp in pools]
+            views = [_PagedCacheView(entry, bt, positions, act, bs)
+                     for entry in pools]
             with _swap_data(objs, list(arrays)):
                 with prng.key_guard(jax.random.key(0)):
                     h, new_views = m.gpt(Tensor(toks[:, None]),
                                          caches=views, start_pos=positions)
-            return h._data[:, 0], [(v.k_pool, v.v_pool) for v in new_views]
+            return h._data[:, 0], [v.entry for v in new_views]
 
         def _sub_step(m, objs, arrays, pools, bt, positions, toks, act):
             """Forward + head + greedy pick — one full decode sub-step."""
@@ -495,6 +511,11 @@ class SpecDecoder:
                           round(engine._meter.rate(), 1))
         metrics.set_gauge("spec.acceptance_rate",
                           round(self.acceptance_rate(), 4))
+        if self.quant_draft and self.draft_mode:
+            # per-mode acceptance telemetry: the tuning signal for a
+            # quantized draft (speed knob — correctness is structural)
+            metrics.set_gauge("quant.draft_acceptance",
+                              round(self.acceptance_rate(), 4))
         return out
 
     # ------------------------------------------------------------- stats
@@ -502,10 +523,17 @@ class SpecDecoder:
     def acceptance_rate(self) -> float:
         return self.accepted / self.proposed if self.proposed else 0.0
 
+    def mode(self) -> str:
+        """The speculation mode label, quantization included —
+        ``lockstep`` / ``draft`` / ``draft-int8``."""
+        if not self.draft_mode:
+            return "lockstep"
+        return "draft-int8" if self.quant_draft else "draft"
+
     def stats(self) -> dict:
         return {
             "spec.k": self.k,
-            "spec.mode": "draft" if self.draft_mode else "lockstep",
+            "spec.mode": self.mode(),
             "spec.proposed": self.proposed,
             "spec.accepted": self.accepted,
             "spec.rollback_tokens": self.rollback_tokens,

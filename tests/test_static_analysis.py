@@ -67,6 +67,11 @@ FIXTURE_CASES = [
     # traced acceptance branching (serving/spec_decode.py's two hazards)
     ("use-after-donate", "compiled_spec_verify", ()),
     ("traced-branch", "compiled_spec_verify", ()),
+    # the quantized-serving dequant shape: host-cast scale and
+    # data-dependent quantization support (quantization.quantize_kv /
+    # engine._scatter_rows must stay all-array math)
+    ("traced-cast", "compiled_quant", ()),
+    ("shape-from-data", "compiled_quant", ()),
     ("undefined-flag", "registry_flags",
      ("paddle_tpu/core/flags.py",)),
     ("unknown-metric-key", "registry_metrics",
@@ -103,6 +108,10 @@ def test_bad_fixtures_are_specific():
             # this fixture deliberately seeds BOTH spec-decode hazards:
             # donated-pool rollback + traced acceptance branching
             allowed |= {"use-after-donate", "traced-branch"}
+        if stem == "compiled_quant":
+            # deliberately seeds BOTH dequant hazards: host-cast scale +
+            # data-dependent support
+            allowed |= {"traced-cast", "shape-from-data"}
         assert rules <= allowed, (stem, rules)
 
 

@@ -29,7 +29,13 @@ Speculative decoding (``FLAGS_serving_spec_k``) adds ``spec.proposed`` /
 ``spec.accepted`` / ``spec.rollback_tokens`` / ``spec.emitted`` /
 ``spec.iterations`` (+ the ``spec.acceptance_rate`` end-of-run gauge),
 and chunked prefill (``FLAGS_serving_chunked_prefill``) adds
-``chunk.admits`` / ``chunk.chunks`` / ``chunk.tokens``.
+``chunk.admits`` / ``chunk.chunks`` / ``chunk.tokens``. Quantized
+serving (``FLAGS_serving_quant_weights`` / ``_kv`` / ``_draft``) adds
+``quant.weight_layers`` / ``quant.draft_layers`` plus the end-of-run
+mode gauges (``quant.weights`` / ``quant.kv`` / ``quant.draft`` /
+``quant.draft_acceptance``) and the per-namespace arena byte gauges
+(``arena.kv_bytes`` / ``arena.scale_bytes`` / ``arena.bytes.<ns>`` /
+``arena.dtype.<ns>``) — the int8 memory win, observable per run.
 The multi-tenant gateway's counters ride it too (``serving.gateway``):
 ``gateway.routed`` / ``gateway.rerouted`` (journaled fail-over) /
 ``gateway.ejected`` / ``gateway.respawned`` (replica health) /
@@ -86,6 +92,10 @@ def _config_report() -> dict:
         # speculative decoding + chunked prefill (serving.spec_decode)
         "serving_spec_k": _flag_env("serving_spec_k", 0),
         "serving_chunked_prefill": _flag_env("serving_chunked_prefill", 0),
+        # quantized serving (int8 weights / int8 KV arena / int8 draft)
+        "serving_quant_weights": _flag_env("serving_quant_weights", 0),
+        "serving_quant_kv": _flag_env("serving_quant_kv", 0),
+        "serving_quant_draft": _flag_env("serving_quant_draft", 0),
         # multi-tenant gateway (serving.gateway: router/tenancy/front door)
         "serving_replicas": _flag_env("serving_replicas", 2),
         "gateway_port": _flag_env("gateway_port", 8100),
@@ -143,7 +153,7 @@ def main(argv=None) -> int:
         # (cached blocks, high-water, fragmentation), NOT differenced
         gauges = {k: v for k, v in metrics.gauges().items()
                   if k.split(".")[0] in ("arena", "prefix", "slots",
-                                         "spec", "queue",
+                                         "spec", "queue", "quant",
                                          "gateway", "tenant")}
         rec = {"wall_secs": round(wall, 3), "stats": delta,
                "gauges": gauges,
