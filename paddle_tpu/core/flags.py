@@ -237,6 +237,21 @@ define_flag("serving_chunked_prefill", 0,
             "bucketed call — the PR 8/9 behavior). Chunks reuse the "
             "suffix-prefill programs (one per chunk-length bucket); chunk "
             "size joins the engine's program key like donation flags do.")
+define_flag("serving_lora_rank", 0,
+            "Multi-LoRA serving: the adapter arena's low-rank dimension "
+            "(serving.adapters.AdapterArena). 0 = off (no arena, the "
+            "compiled programs carry no adapter parameters — the PR 11 "
+            "behavior). Rank is static per engine (program key, like "
+            "donation/quant flags); which adapters are live and which "
+            "slot wears which are runtime data — registration and "
+            "per-slot adapter churn never recompile. Adapter id 0 is "
+            "the identity (base weights, token-identical).")
+define_flag("serving_lora_adapters", 4,
+            "Capacity of the serving LoRA adapter arena: how many "
+            "adapters can be registered (live) at once. Row 0 is the "
+            "reserved identity adapter on top of this count. Static per "
+            "engine; AdapterExhaustedError past it (unregister or "
+            "resize). Only read when FLAGS_serving_lora_rank > 0.")
 
 # ---- Serving gateway: replica router + tenant quotas (serving.gateway) ----
 define_flag("serving_replicas", 2,

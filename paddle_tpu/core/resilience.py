@@ -68,6 +68,10 @@ _counts: Dict[str, int] = {}
 DOCUMENTED_NAMESPACES = (
     "retry", "ckpt", "sentinel", "preempt", "overload", "deadline",
     "quota", "serving", "faults", "fault", "quant",
+    # scenario-diversity serving (ISSUE 12): per-slot sampling's
+    # spec-decode fallbacks, constraint-walker anomalies, LoRA adapter
+    # lifecycle — mirrored here so the resilience dashboards see them
+    "sampling", "constrain", "lora",
 )
 
 

@@ -119,6 +119,21 @@ def masked_attention(qa, ka, va, mask):
 #: serving decode hot path's HBM traffic, in model order
 _SERVING_QUANT_LINEARS = ("attn.qkv", "attn.proj", "mlp.up", "mlp.down")
 
+#: multi-LoRA hook (serving.adapters): called as hook(layer, x, y) inside
+#: _serving_linear to add the per-lane low-rank update when an adapter
+#: trace context is bound; inert (returns y) without one. Process-global
+#: and None until an AdapterArena exists, so the training/generate paths
+#: never pay for it.
+_lora_hook = None
+
+
+def set_lora_hook(fn) -> None:
+    """Install the serving-adapter hook (``serving.adapters`` calls this
+    once, at the first :class:`~paddle_tpu.serving.adapters.AdapterArena`
+    construction). Idempotent."""
+    global _lora_hook
+    _lora_hook = fn
+
 
 def quantize_serving_weights(model) -> int:
     """Per-channel int8 weight-only quantization of every attention/MLP
@@ -187,10 +202,18 @@ def _serving_linear(layer, x):
     kernel: the int8 weight is read from HBM, multiplied by its per-channel
     scale and cast to the activation dtype right before the matmul, so XLA
     fuses the dequant into the matmul's operand pipeline — weight traffic
-    is 1 byte/param instead of 2-4."""
+    is 1 byte/param instead of 2-4.
+
+    This is also the multi-LoRA attach point (``serving.adapters``): when
+    an adapter trace context is bound, the per-lane low-rank update
+    ``(x @ A[ids]) @ B[ids]`` is added to the base matmul's output —
+    int8 base + f32 adapters compose here. No context ⇒ identical trace."""
     scale = getattr(layer, "weight_scale", None)
     if scale is None:
-        return layer(x)
+        y = layer(x)
+        if _lora_hook is not None:
+            y = _lora_hook(layer, x, y)
+        return y
     from ..core.dispatch import apply
 
     if isinstance(layer, RowParallelLinear) and layer.input_is_parallel:
@@ -209,6 +232,8 @@ def _serving_linear(layer, x):
     args = (x, layer.weight, scale) + (
         () if layer.bias is None else (layer.bias,))
     y = apply(deq_matmul, args, {}, name="serving_qlinear")
+    if _lora_hook is not None:
+        y = _lora_hook(layer, x, y)
     # mirror the parallel linears' output shardings (the quantized matmul
     # must shard exactly like the one it replaces)
     if isinstance(layer, ColumnParallelLinear) and not layer.gather_output:
@@ -608,7 +633,7 @@ class GPTForCausalLM(nn.Layer):
                  do_sample: bool = False, temperature: float = 1.0,
                  top_k: int = 0, top_p: float = 1.0, eos_token_id: int = -1,
                  seed: int = 0, use_cache: bool = True,
-                 stop_token_id=None):
+                 stop_token_id=None, sampling=None):
         """Compiled autoregressive decoding: ONE jitted program — prefill
         plus a ``lax.scan`` over decode steps — so the whole loop runs
         on-device with no host round trips (the XLA-native replacement for
@@ -619,6 +644,17 @@ class GPTForCausalLM(nn.Layer):
         use_cache=False re-runs the causal forward on a max-length padded
         buffer each step (more FLOPs, zero extra state — useful as a
         cross-check, and what the cache path is tested against).
+
+        ``sampling`` (a :class:`paddle_tpu.serving.SamplingParams`) routes
+        next-token selection through the serving engine's ONE sampling
+        core (``serving.sampling.sample_tokens``) with *positional* PRNG
+        keys — ``fold_in(PRNGKey(seed + row), context_index)`` — so a
+        seeded ``generate(sampling=...)`` call is the bit-level parity
+        anchor for a slot-engine request carrying the same params
+        (``temperature=0`` reproduces greedy decode exactly). It overrides
+        the legacy ``do_sample``/``temperature``/``top_k``/``top_p``/
+        ``seed`` arguments, whose sequential-key behavior is kept
+        bit-compatible for existing callers.
 
         ``stop_token_id`` enables per-sequence termination: each sequence
         carries a finished mask, finished rows stop mutating their KV
@@ -670,18 +706,48 @@ class GPTForCausalLM(nn.Layer):
             # quantizing the weights after a runner was memoized must build
             # a fresh executable over the int8 payload, never reuse one
             # traced against float weights
+            # the seed is RUNTIME data on both sampling paths (threaded
+            # through the `key` argument slot), so re-seeding never
+            # rebuilds the program: the cache key carries the sampling
+            # params with the seed stripped
+            import dataclasses as _dc
+
+            samp_key = (None if sampling is None
+                        else _dc.replace(sampling, seed=0))
+            # sampling.seed None falls back to the legacy `seed` argument
+            # (generate() stays reproducible-by-default, unlike serving
+            # submits which pin fresh entropy per request)
+            key_arg = (jnp.int32(seed if sampling.seed is None
+                                 else sampling.seed)
+                       if sampling is not None else jax.random.key(seed))
             cache_key = (b, prompt_len, max_new_tokens, bool(do_sample),
                          float(temperature), int(top_k), float(top_p),
                          int(eos_token_id), bool(use_cache), donate, stop,
-                         getattr(self, "_serving_quant", 0))
+                         getattr(self, "_serving_quant", 0), samp_key)
             cached = getattr(self, "_gen_cache", None)
             if cached is not None and cached[0] == cache_key:
                 compile_cache.bump("decode.cache_hits")
-                return Tensor(cached[1](arrays, ids, jax.random.key(seed)))
+                return Tensor(cached[1](arrays, ids, key_arg))
             compile_cache.bump("decode.builds")
 
-            def sample_next(logits, done, key):
-                if do_sample:
+            def sample_next(logits, done, key, pos):
+                if sampling is not None:
+                    # the serving engine's sampling core with positional
+                    # keys: row i's token at context index `pos` draws
+                    # under fold_in(PRNGKey(seed+i), pos) — the engine
+                    # parity anchor (see serving.sampling). On this path
+                    # `key` carries the TRACED int32 base seed (runtime
+                    # data: re-seeding reuses the compiled program).
+                    from ..serving.sampling import sample_tokens
+
+                    seeds = key + jnp.arange(b, dtype=jnp.int32)
+                    nxt = sample_tokens(
+                        logits,
+                        jnp.full((b,), sampling.temperature, jnp.float32),
+                        jnp.full((b,), sampling.top_k, jnp.int32),
+                        jnp.full((b,), sampling.top_p, jnp.float32),
+                        seeds, jnp.full((b,), pos, jnp.int32))
+                elif do_sample:
                     key, sub = jax.random.split(key)
                     scaled = logits / jnp.maximum(temperature, 1e-6)
                     scaled = _filter_logits(scaled, top_k, top_p,
@@ -734,7 +800,7 @@ class GPTForCausalLM(nn.Layer):
                     caches, h_last, pos, done, key, out_buf = carry
                     with _swap_data(objs, list(param_arrays)):
                         logits = lm_head_logits(h_last)
-                        nxt, done, key = sample_next(logits, done, key)
+                        nxt, done, key = sample_next(logits, done, key, pos)
                         # finished rows: nxt is forced to the stop token and
                         # the buffer was pre-filled with it, so this write
                         # is value-preserving for them
@@ -788,7 +854,7 @@ class GPTForCausalLM(nn.Layer):
                 def step(carry):
                     buf, pos, done, key = carry
                     logits = logits_at(param_arrays, buf, pos - 1)
-                    nxt, done, key = sample_next(logits, done, key)
+                    nxt, done, key = sample_next(logits, done, key, pos)
                     buf = jax.lax.dynamic_update_slice(
                         buf, nxt.astype(buf.dtype)[:, None], (0, pos))
                     return (buf, pos + 1, done, key)
@@ -842,7 +908,7 @@ class GPTForCausalLM(nn.Layer):
             else:
                 runner = jax.jit(decode)
             self._gen_cache = (cache_key, runner)
-            return Tensor(runner(arrays, ids, jax.random.key(seed)))
+            return Tensor(runner(arrays, ids, key_arg))
         finally:
             if was_training:
                 self.train()

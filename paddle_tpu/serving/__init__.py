@@ -31,6 +31,15 @@ served endpoint), rebuilt as an Orca/vLLM-style decode runtime:
   router (least-outstanding-work + bounded cache affinity, crash-loop
   ejection/respawn), ``TenantManager`` quotas/fair share, and the
   HTTP/SSE ``Gateway``.
+* :mod:`.sampling`  — ``SamplingParams`` + the one compiled sampling
+  core: per-slot temperature/top-k/top-p/seed as runtime data, positional
+  PRNG keys (seeded runs bit-reproducible and replay-safe).
+* :mod:`.constrain` — ``TrieConstraint``/``TokenDFA``: host-side
+  incremental walkers materializing per-slot vocab masks for
+  grammar/structured output (runtime data — no recompiles per grammar).
+* :mod:`.adapters`  — ``AdapterArena``/``LoraAdapter``: paged multi-LoRA
+  store gathered by per-slot index inside the compiled step (adapter 0 =
+  base weights; every gateway tenant gets its own fine-tune).
 * :mod:`.metrics`   — counters/gauges on the shared observability surface.
 
 See docs/serving.md for the architecture and lifecycle walkthrough and
@@ -51,6 +60,15 @@ _LAZY = {
     "Request": ("scheduler", "Request"),
     "RequestState": ("scheduler", "RequestState"),
     "SpecDecoder": ("spec_decode", "SpecDecoder"),
+    # scenario diversity in the one compiled step (ISSUE 12): per-slot
+    # sampling, constrained decoding, multi-LoRA adapters
+    "SamplingParams": ("sampling", "SamplingParams"),
+    "Constraint": ("constrain", "Constraint"),
+    "TrieConstraint": ("constrain", "TrieConstraint"),
+    "TokenDFA": ("constrain", "TokenDFA"),
+    "LoraAdapter": ("adapters", "LoraAdapter"),
+    "AdapterArena": ("adapters", "AdapterArena"),
+    "AdapterExhaustedError": ("adapters", "AdapterExhaustedError"),
     "EngineSupervisor": ("supervisor", "EngineSupervisor"),
     "CrashLoopError": ("supervisor", "CrashLoopError"),
     "ServingAPI": ("api", "ServingAPI"),

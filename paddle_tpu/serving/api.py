@@ -122,7 +122,8 @@ class ServingAPI:
                timeout: Optional[float] = None,
                request_id: str = "", priority: int = 0,
                journal: Optional[Sequence[int]] = None,
-               shed: bool = True) -> Request:
+               shed: bool = True, sampling=None, constraint=None,
+               adapter: int = 0) -> Request:
         """Enqueue one generation request; returns its handle immediately.
 
         ``timeout`` is the request's end-to-end wall-clock deadline
@@ -143,7 +144,16 @@ class ServingAPI:
         healthy replica. ``shed=False`` bypasses the queue-depth shed for
         such re-routed requests: they were already accepted once, and
         dropping accepted work at an overloaded fail-over target would turn
-        one replica's crash into request loss."""
+        one replica's crash into request loss.
+
+        ``sampling`` (a :class:`~.sampling.SamplingParams`; None = greedy,
+        bit-identical to the classic engine), ``constraint`` (a
+        :class:`~.constrain.Constraint` walker masking the vocab per
+        step), and ``adapter`` (a registered LoRA arena row id — see
+        :meth:`register_adapter`; 0 = base weights) select the request's
+        decode scenario. All three are per-slot runtime data in the ONE
+        compiled decode step — mixing them across a batch never
+        recompiles."""
         with self._lock:
             # checked under the lock: a submit racing drain()/close() must
             # never enqueue after the straggler sweep (its request would
@@ -164,6 +174,8 @@ class ServingAPI:
             req = Request(prompt, max_new_tokens=max_new_tokens,
                           stop_token_id=stop_token_id,
                           request_id=request_id, priority=priority,
+                          sampling=sampling, constraint=constraint,
+                          adapter_id=int(adapter),
                           deadline=resilience.Deadline.after(timeout))
             if journal:
                 if len(journal) >= int(max_new_tokens):
@@ -171,7 +183,50 @@ class ServingAPI:
                         f"journal of {len(journal)} tokens already exhausts "
                         f"max_new_tokens={max_new_tokens}; nothing to resume")
                 req.tokens = [int(t) for t in journal]
+                # the walker never saw the journal's tokens: rebuild its
+                # state so the re-routed stream stays in-grammar
+                req.reset_constraint()
             return self.scheduler.submit(req)
+
+    def register_adapter(self, adapter, name: Optional[str] = None) -> int:
+        """Install a :class:`~.adapters.LoraAdapter` into this engine's
+        adapter arena; returns the id requests pass as ``adapter=``.
+        Value-only (shape-preserving) — zero recompiles. Requires the
+        engine to have been built with ``FLAGS_serving_lora_rank`` > 0 /
+        ``ServingConfig.lora_rank``."""
+        if self.engine.lora is None:
+            raise RuntimeError(
+                "this engine has no adapter arena "
+                "(FLAGS_serving_lora_rank is 0)")
+        with self._lock:
+            return self.engine.lora.register(adapter, name=name)
+
+    def unregister_adapter(self, adapter) -> None:
+        """Free one adapter row (by id or name). Refused while ANY
+        request — running, prefilling, or still queued — names the row:
+        the arena's own guard only sees occupied slots, but a queued
+        request that passed ``check_live`` at submit would otherwise be
+        admitted onto a freed (and possibly recycled-to-another-tenant)
+        row."""
+        lora = self.engine.lora
+        if lora is None:
+            raise RuntimeError(
+                "this engine has no adapter arena "
+                "(FLAGS_serving_lora_rank is 0)")
+        with self._lock:
+            idx = (lora.adapter_id(adapter) if isinstance(adapter, str)
+                   else int(adapter))
+            sched = self.scheduler
+            worn = [r.request_id
+                    for r in (sched.waiting + sched.prefilling
+                              + sched.running)
+                    if r.adapter_id == idx]
+            if worn:
+                raise RuntimeError(
+                    f"adapter {adapter!r} (id {idx}) is named by "
+                    f"in-flight/queued request(s) {worn[:4]}; let them "
+                    "finish (or cancel them) before unregistering")
+            lora.unregister(idx)
 
     def outstanding(self) -> int:
         """Waiting + prefilling + running request count — the router's
@@ -431,11 +486,14 @@ class EnginePredictor:
 
     def __init__(self, model, max_new_tokens: int = 32,
                  stop_token_id: Optional[int] = None, priority: int = 0,
+                 sampling=None, adapter: int = 0,
                  config: Optional[ServingConfig] = None, **engine_kw):
         self._api = ServingAPI(model, config, **engine_kw)
         self._max_new = int(max_new_tokens)
         self._stop = stop_token_id
         self._priority = int(priority)
+        self._sampling = sampling   # SamplingParams for every row (None =
+        self._adapter = int(adapter)  # greedy); LoRA row id (0 = base)
         self._inputs = {}
         self._outputs = {}
         self._finished = 0  # this predictor's own rows, for close()'s
@@ -474,7 +532,9 @@ class EnginePredictor:
                 reqs.append(self._api.submit(row,
                                              max_new_tokens=self._max_new,
                                              stop_token_id=self._stop,
-                                             priority=pr))
+                                             priority=pr,
+                                             sampling=self._sampling,
+                                             adapter=self._adapter))
         except Exception:
             # analysis: allow(broad-except) — cleanup-and-reraise: a
             # mid-batch submit failure (overload shed, validation) must
@@ -546,11 +606,28 @@ class EnginePredictor:
                 int(engine.quant_draft), arena_desc)
         else:
             quant = ""
+        if (engine.sampled_admits or engine.constrained_admits
+                or engine.adapter_admits or engine.lora is not None):
+            # the scenario-diversity picture: per-slot sampling /
+            # constrained decoding / multi-LoRA admissions of THIS engine
+            lora_desc = ""
+            if engine.lora is not None:
+                st = engine.lora.stats()
+                lora_desc = ", lora arena rank %d: %d/%d live (%.2f MiB)" % (
+                    st["lora.rank"], st["lora.live"], st["lora.slots"],
+                    st["lora.arena_bytes"] / 2 ** 20)
+            scenario = (", scenarios: %d sampled / %d constrained / "
+                        "%d adapter admits%s") % (
+                            engine.sampled_admits,
+                            engine.constrained_admits,
+                            engine.adapter_admits, lora_desc)
+        else:
+            scenario = ""
         _logger.info(
             "EnginePredictor closed: %d finished, %d failed, "
             "%d supervisor replays (%d rebuilds), %d preemptions, "
-            "%d drains%s%s%s",
+            "%d drains%s%s%s%s",
             self._finished, self._failed,
             api.supervisor.replay_count, api.supervisor.rebuild_count,
             api.scheduler.preempt_count, api.drain_count, prefix,
-            speculation, quant)
+            speculation, quant, scenario)

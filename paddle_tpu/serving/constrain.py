@@ -1,0 +1,224 @@
+"""Constrained (grammar/structured) decoding as per-slot vocab masks.
+
+Structured output — "the model may only emit tokens that keep the output
+inside this grammar" — must not cost a recompile per grammar, per state,
+or per request. The split that achieves that:
+
+* **Host side**: an incremental walker (trie or DFA over *token ids*)
+  advances one state per emitted token and materializes the current
+  state's allowed-token set as a ``[vocab]`` boolean mask. Walker state is
+  pure data derived from the emitted tokens, so preemption re-admission,
+  gateway journal re-routes, and supervisor replay all reconstruct it by
+  replaying the journal — nothing extra to checkpoint.
+* **Device side**: the engine scatters each constrained slot's mask row
+  into the per-slot ``[S, vocab]`` mask the ONE compiled decode step (and
+  the prefill programs' first-token emission) applies before sampling —
+  ``where(mask, logits, -inf)``. The mask is runtime data like
+  ``start_pos``: grammars of any shape share the same executable, and an
+  all-True row (mask off) is the bitwise identity on the greedy branch.
+
+Walkers are deliberately *token-level*: a JSON/regex grammar lowers to a
+:class:`TokenDFA` over the deployment's tokenizer ids (the framework is
+tokenizer-agnostic, so that lowering lives with the tokenizer, not here).
+:class:`TrieConstraint` covers the other common case directly — "the
+output must be one of these strings" (function names, enum values, tool
+call signatures) as a token trie.
+
+The contract every constraint must keep: :meth:`Constraint.allowed` never
+returns an empty set while the stream is live (a DFA dead end would force
+``argmax`` over all ``-inf``); walkers here fall back to stop-only /
+unconstrained at exhaustion, and the scheduler sanitizes (and counts)
+``constrain.dead_ends`` from user-supplied walkers.
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["Constraint", "TrieConstraint", "TokenDFA"]
+
+#: walker sink state: the constraint is exhausted (a full choice was
+#: emitted / an accept state was left via the stop token)
+_SINK = -1
+
+
+class Constraint:
+    """Incremental decoding constraint over token ids.
+
+    Immutable-state protocol: ``initial()`` returns the walker state
+    before any generated token, ``advance(state, token)`` the successor
+    state, and ``allowed(state)`` the current ``[vocab] bool`` mask
+    (``None`` = unconstrained). States must be cheap values (ints) — they
+    are recomputed from the token journal on replay, never serialized."""
+
+    vocab_size: int = 0
+
+    def initial(self):
+        raise NotImplementedError
+
+    def advance(self, state, token: int):
+        raise NotImplementedError
+
+    def allowed(self, state) -> Optional[np.ndarray]:
+        raise NotImplementedError
+
+
+class TrieConstraint(Constraint):
+    """Constrain the generated tokens to one of a fixed set of token
+    sequences (a token trie) — enum values, tool names, canned answers.
+
+    While walking the trie only the current node's children are allowed;
+    once a full choice has been emitted the walker reaches the sink:
+    stop-token-only when ``stop_token_id`` is given (the stream ends
+    cleanly), otherwise unconstrained (free continuation). A node that
+    ends one choice but prefixes a longer one allows both its children
+    and (with a stop token) the stop."""
+
+    def __init__(self, choices: Iterable[Sequence[int]], vocab_size: int,
+                 stop_token_id: Optional[int] = None):
+        self.vocab_size = int(vocab_size)
+        self.stop_token_id = (None if stop_token_id is None
+                              else int(stop_token_id))
+        # node: (children {token: node_idx}, ends_a_choice)
+        self._children: List[Dict[int, int]] = [{}]
+        self._ends: List[bool] = [False]
+        n = 0
+        for choice in choices:
+            toks = [int(t) for t in choice]
+            if not toks:
+                raise ValueError("empty choice in TrieConstraint")
+            node = 0
+            for t in toks:
+                if not 0 <= t < self.vocab_size:
+                    raise ValueError(f"choice token {t} outside vocab "
+                                     f"[0, {self.vocab_size})")
+                nxt = self._children[node].get(t)
+                if nxt is None:
+                    self._children.append({})
+                    self._ends.append(False)
+                    nxt = len(self._children) - 1
+                    self._children[node][t] = nxt
+                node = nxt
+            self._ends[node] = True
+            n += 1
+        if n == 0:
+            raise ValueError("TrieConstraint needs at least one choice")
+        # memoized per-node masks: the walker is consulted once per
+        # emitted token per slot — the mask build must not be per-step
+        self._masks: Dict[int, Optional[np.ndarray]] = {}
+
+    @classmethod
+    def from_choices(cls, choices, vocab_size, stop_token_id=None
+                     ) -> "TrieConstraint":
+        return cls(choices, vocab_size, stop_token_id=stop_token_id)
+
+    def initial(self) -> int:
+        return 0
+
+    def advance(self, state: int, token: int) -> int:
+        if state == _SINK:
+            return _SINK
+        nxt = self._children[state].get(int(token))
+        if nxt is not None:
+            # a node both ending a choice and prefixing a longer one stays
+            # on the trie; the stop token (if that's what was emitted)
+            # falls through to the sink below
+            return nxt
+        return _SINK  # choice completed (stop emitted / leaf reached)
+
+    def allowed(self, state: int) -> Optional[np.ndarray]:
+        if state == _SINK:
+            return self._stop_only()
+        mask = self._masks.get(state)
+        if mask is None and state not in self._masks:
+            kids = self._children[state]
+            if not kids and not self._ends[state]:  # unreachable: leaf
+                mask = self._stop_only()            # nodes end a choice
+            else:
+                mask = np.zeros(self.vocab_size, bool)
+                for t in kids:
+                    mask[t] = True
+                if self._ends[state]:
+                    if self.stop_token_id is not None:
+                        mask[self.stop_token_id] = True
+                    elif not kids:
+                        mask = None  # choice done, free continuation
+            self._masks[state] = mask
+        return None if mask is None else mask
+
+    def _stop_only(self) -> Optional[np.ndarray]:
+        if self.stop_token_id is None:
+            return None
+        mask = np.zeros(self.vocab_size, bool)
+        mask[self.stop_token_id] = True
+        return mask
+
+
+class TokenDFA(Constraint):
+    """Generic deterministic automaton over token ids — the lowering
+    target for JSON/regex grammars (grammar → tokenizer-aware DFA is the
+    tokenizer layer's job; this walks the result incrementally).
+
+    ``transitions``: ``{state: {token: next_state}}`` — only listed tokens
+    are allowed in a state. ``accept``: states where the stream may end;
+    emitting ``stop_token_id`` there moves to the sink (stop-only /
+    unconstrained, like :class:`TrieConstraint`). A state with no
+    outgoing transitions must be an accept state (the dead-end guard)."""
+
+    def __init__(self, transitions: Dict[int, Dict[int, int]],
+                 vocab_size: int, start: int = 0,
+                 accept: Iterable[int] = (),
+                 stop_token_id: Optional[int] = None):
+        self.vocab_size = int(vocab_size)
+        self.stop_token_id = (None if stop_token_id is None
+                              else int(stop_token_id))
+        self._tx = {int(s): {int(t): int(n) for t, n in row.items()}
+                    for s, row in transitions.items()}
+        self._start = int(start)
+        self._accept = {int(s) for s in accept}
+        for s, row in self._tx.items():
+            for t in row:
+                if not 0 <= t < self.vocab_size:
+                    raise ValueError(f"DFA token {t} outside vocab "
+                                     f"[0, {self.vocab_size})")
+        states = set(self._tx) | {n for row in self._tx.values()
+                                  for n in row.values()} | {self._start}
+        for s in states:
+            if not self._tx.get(s) and s not in self._accept:
+                raise ValueError(
+                    f"DFA state {s} has no outgoing transitions and is not "
+                    "an accept state — a stream reaching it could emit "
+                    "nothing (dead end)")
+        if self._accept and self.stop_token_id is None:
+            raise ValueError("accept states need a stop_token_id to end "
+                             "the stream through")
+        self._masks: Dict[int, Optional[np.ndarray]] = {}
+
+    def initial(self) -> int:
+        return self._start
+
+    def advance(self, state: int, token: int) -> int:
+        if state == _SINK:
+            return _SINK
+        nxt = self._tx.get(state, {}).get(int(token))
+        if nxt is not None:
+            return nxt
+        return _SINK  # stop emitted in an accept state
+
+    def allowed(self, state: int) -> Optional[np.ndarray]:
+        if state == _SINK:
+            if self.stop_token_id is None:
+                return None
+            mask = np.zeros(self.vocab_size, bool)
+            mask[self.stop_token_id] = True
+            return mask
+        mask = self._masks.get(state)
+        if mask is None:
+            mask = np.zeros(self.vocab_size, bool)
+            for t in self._tx.get(state, {}):
+                mask[t] = True
+            if state in self._accept:
+                mask[self.stop_token_id] = True
+            self._masks[state] = mask
+        return mask

@@ -43,6 +43,17 @@ dequant-on-attend in :func:`_gather_ctx`), and each mode is captured at
 construction as part of the engine's program key exactly like the
 donation flag. All default off — the unquantized path is bit-identical.
 
+**Scenario diversity** (ISSUE 12) rides the same runtime-data contract:
+per-slot sampling params + positional PRNG seeds
+(:mod:`paddle_tpu.serving.sampling`), the per-slot constrained-decoding
+vocab mask (:mod:`paddle_tpu.serving.constrain`), and the per-slot LoRA
+adapter index into a paged adapter arena
+(:mod:`paddle_tpu.serving.adapters`, gathered inside
+``gpt._serving_linear``) all thread through the one compiled step like
+``start_pos`` — a batch mixing greedy, sampled, constrained, and
+N-adapter slots never recompiles, and the greedy/mask-off/adapter-0
+paths are token-identical to the classic engine.
+
 Two flag-gated multi-token extensions ride the same no-recompile
 contract: **speculative decoding** (``FLAGS_serving_spec_k`` —
 :mod:`paddle_tpu.serving.spec_decode`: a draft model proposes k tokens
@@ -57,6 +68,7 @@ reproducing the plain engine exactly.
 from __future__ import annotations
 
 import warnings
+from contextlib import nullcontext as _null_ctx
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
@@ -268,6 +280,14 @@ class ServingConfig:
     quant_weights: Optional[bool] = None
     quant_kv: Optional[bool] = None
     quant_draft: Optional[bool] = None
+    # multi-LoRA adapter arena (None defers to FLAGS_serving_lora_rank /
+    # FLAGS_serving_lora_adapters; rank 0 = off). Rank and capacity are
+    # static (program key, like quant/donation); which adapters are live
+    # and which slot wears which are runtime data — registration and
+    # per-slot adapter churn never recompile. Adapter id 0 is the
+    # identity (base weights, token-identical to an arena-less engine).
+    lora_rank: Optional[int] = None
+    lora_adapters: Optional[int] = None
 
 
 @dataclass
@@ -288,6 +308,9 @@ class _AdmitState:
     cow: bool = False
     prefix_len: int = 0
     done: int = 0  # context positions already scattered (chunk progress)
+    sampling: Optional[object] = None  # SamplingParams (None = greedy)
+    adapter: int = 0                   # LoRA arena row (0 = base)
+    skip_draft: bool = False  # spec-ineligible: no draft prefill/blocks
 
 
 class ServingEngine:
@@ -321,6 +344,21 @@ class ServingEngine:
             n = quantize_serving_weights(model)
             if n:
                 metrics.bump("quant.weight_layers", n)
+        # multi-LoRA adapter arena: rank/capacity are static (program key,
+        # like the quant trio); registration and per-slot adapter ids are
+        # runtime data. Built before the snapshot only for symmetry — the
+        # adapter pools are program ARGUMENTS, not buffers.
+        lora_rank = int(cfg.lora_rank if cfg.lora_rank is not None
+                        else flags.flag("serving_lora_rank"))
+        lora_cap = int(cfg.lora_adapters if cfg.lora_adapters is not None
+                       else flags.flag("serving_lora_adapters"))
+        if lora_rank > 0:
+            from .adapters import AdapterArena
+
+            self.lora = AdapterArena(model, lora_rank, lora_cap)
+            self.lora.bind_engine(self)  # unregister liveness guard
+        else:
+            self.lora = None
         params, buffers = model.functional_state()
         self._objs = list(params.values()) + list(buffers.values())
         self._arrays = [p._data for p in self._objs]
@@ -385,6 +423,38 @@ class ServingEngine:
         # speculation depth respects so block reservations and the model's
         # position budget are never overrun
         self._slot_limit = np.zeros(s, np.int32)
+        # per-slot sampling / constraint / adapter state — ALL runtime
+        # data threaded into the one compiled step exactly like start_pos
+        # (see serving.sampling): temperature 0 = greedy (bit-identical
+        # to the classic path), the [S, vocab] mask defaults all-True
+        # (mask-off identity), adapter 0 = base weights. The mask's
+        # device copy is memoized and invalidated only on change, so
+        # unconstrained workloads re-pass one cached array per step.
+        self.vocab = int(mcfg.vocab_size)
+        self._temp = np.zeros(s, np.float32)
+        self._top_k = np.zeros(s, np.int32)
+        self._top_p = np.ones(s, np.float32)
+        self._seed = np.zeros(s, np.int32)
+        self._adapter = np.zeros(s, np.int32)
+        self._sampled = np.zeros(s, np.bool_)      # temp > 0
+        self._constrained = np.zeros(s, np.bool_)  # mask row not all-True
+        # STICKY spec-ineligibility: once a slot has sampled, worn a
+        # mask, or carried an adapter this request, it stays on the
+        # plain-decode path even if the constraint later lifts — during
+        # the fallback iterations the draft namespace saw none of the
+        # slot's tokens, so handing the lane back to speculation would
+        # propose from a holed draft cache (silent acceptance collapse)
+        self._scenario_once = np.zeros(s, np.bool_)
+        self._mask_host = np.ones((s, self.vocab), np.bool_)
+        self._mask_dev = None
+        self._mask_dirty: set = set()  # rows stale on device (see
+        #                                _samp_args: one batched row
+        #                                scatter per step, not per update)
+        # lifetime per-engine admission counters (EnginePredictor.close()
+        # summaries must not read the process-global metrics)
+        self.sampled_admits = 0
+        self.constrained_admits = 0
+        self.adapter_admits = 0
         self._chunk: Dict[int, _AdmitState] = {}
         self._slot_res: List[Optional[Reservation]] = [None] * s
         # per-slot sharing state: block ids attached by reference from the
@@ -451,11 +521,19 @@ class ServingEngine:
             n += self.spec.reserved_blocks(slot)
         return n
 
-    def validate(self, prompt_len: int, max_new_tokens: int) -> None:
+    def validate(self, prompt_len: int, max_new_tokens: int,
+                 adapter: int = 0) -> None:
         if prompt_len < 1:
             raise ValueError("empty prompt")
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
+        if int(adapter) != 0:
+            # fail at submit, not with silent base-weight output mid-decode
+            if self.lora is None:
+                raise ValueError(
+                    f"request names adapter {adapter} but the engine has "
+                    "no adapter arena (FLAGS_serving_lora_rank is 0)")
+            self.lora.check_live(adapter)
         total = prompt_len + max_new_tokens
         if total > self.max_model_len:
             raise ValueError(
@@ -520,12 +598,14 @@ class ServingEngine:
 
         from ..core import rng as prng
         from ..jit import _swap_data
+        from .sampling import sample_tokens
 
         model = self._model
+        lora = self.lora
         n_layers = model.cfg.num_layers
         bs = self.block_size
 
-        def prefill(arrays, ids, true_len, pools, rows):
+        def prefill(arrays, ids, true_len, pools, rows, samp, *lora_args):
             # trace-time bookkeeping (runs once per bucket, not per call)
             self.prefill_traces[p_bucket] = \
                 self.prefill_traces.get(p_bucket, 0) + 1
@@ -533,8 +613,10 @@ class ServingEngine:
             views = [_CapturePrefillView() for _ in range(n_layers)]
             with _swap_data(self._objs, list(arrays)):
                 with prng.key_guard(jax.random.key(0)):
-                    h, chunks = model.gpt(Tensor(ids), caches=views,
-                                          start_pos=0)
+                    with (lora.bind(*lora_args) if lora is not None
+                          else _null_ctx()):
+                        h, chunks = model.gpt(Tensor(ids), caches=views,
+                                              start_pos=0)
                 h_last = jax.lax.dynamic_index_in_dim(
                     h._data, true_len - 1, axis=1, keepdims=False)
                 logits = model._head_logits(h_last)
@@ -550,7 +632,13 @@ class ServingEngine:
                 vc = vc._data if isinstance(vc, Tensor) else vc
                 new_pools.append(
                     _scatter_rows(entry, row, off, kc[0], vc[0]))
-            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            # the first generated token goes through the SAME sampling
+            # core as the decode step ([1, V] and [S, V] rows are
+            # bit-identical per row); greedy/unmasked slots reproduce
+            # the classic argmax exactly
+            temp, k, p, seed, spos, vmask = samp
+            nxt = sample_tokens(logits, temp, k, p, seed, spos,
+                                allowed=vmask)
             return nxt[0], new_pools
 
         fn = (jax.jit(prefill, donate_argnums=(3,)) if self.donate
@@ -572,11 +660,14 @@ class ServingEngine:
 
         from ..core import rng as prng
         from ..jit import _swap_data
+        from .sampling import sample_tokens
 
         model = self._model
+        lora = self.lora
         bs = self.block_size
 
-        def prefix_prefill(arrays, ids, true_len, prefix_len, pools, bt_row):
+        def prefix_prefill(arrays, ids, true_len, prefix_len, pools,
+                           bt_row, samp, *lora_args):
             self.prefix_prefill_traces[p_bucket] = \
                 self.prefix_prefill_traces.get(p_bucket, 0) + 1
             compile_cache.bump("serving.prefill_compiles")
@@ -584,12 +675,16 @@ class ServingEngine:
                                         true_len, bs) for entry in pools]
             with _swap_data(self._objs, list(arrays)):
                 with prng.key_guard(jax.random.key(0)):
-                    h, new_views = model.gpt(Tensor(ids), caches=views,
-                                             start_pos=prefix_len)
+                    with (lora.bind(*lora_args) if lora is not None
+                          else _null_ctx()):
+                        h, new_views = model.gpt(Tensor(ids), caches=views,
+                                                 start_pos=prefix_len)
                 h_last = jax.lax.dynamic_index_in_dim(
                     h._data, true_len - 1, axis=1, keepdims=False)
                 logits = model._head_logits(h_last)
-            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            temp, k, p, seed, spos, vmask = samp
+            nxt = sample_tokens(logits, temp, k, p, seed, spos,
+                                allowed=vmask)
             new_pools = [v.entry for v in new_views]
             return nxt[0], new_pools
 
@@ -632,26 +727,37 @@ class ServingEngine:
         if self._step_jit is not None:
             return self._step_jit
         import jax
-        import jax.numpy as jnp
 
         from ..core import rng as prng
         from ..jit import _swap_data
+        from .sampling import sample_tokens
 
         model = self._model
+        lora = self.lora
         bs = self.block_size
 
-        def step(arrays, pools, block_tables, positions, last_tok, active):
+        def step(arrays, pools, block_tables, positions, last_tok, active,
+                 samp, *lora_args):
             self.decode_traces += 1  # trace-time: the no-recompile counter
             compile_cache.bump("serving.decode_compiles")
             views = [_PagedCacheView(entry, block_tables, positions,
                                      active, bs) for entry in pools]
             with _swap_data(self._objs, list(arrays)):
                 with prng.key_guard(jax.random.key(0)):
-                    h, new_views = model.gpt(Tensor(last_tok[:, None]),
-                                             caches=views,
-                                             start_pos=positions)
+                    with (lora.bind(*lora_args) if lora is not None
+                          else _null_ctx()):
+                        h, new_views = model.gpt(Tensor(last_tok[:, None]),
+                                                 caches=views,
+                                                 start_pos=positions)
                 logits = model._head_logits(h._data[:, 0])
-            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            # per-slot sampling over the constrained logits: temperature /
+            # top-k / top-p / seed / mask are all runtime data (greedy
+            # lanes reproduce the classic argmax bit-for-bit); the
+            # emitted token sits at context index positions+1 — its
+            # positional PRNG key (see serving.sampling)
+            temp, k, p, seed, vmask = samp
+            nxt = sample_tokens(logits, temp, k, p, seed, positions + 1,
+                                allowed=vmask)
             new_pools = [v.entry for v in new_views]
             return nxt, new_pools
 
@@ -687,7 +793,8 @@ class ServingEngine:
     # ----------------------------------------------------- slot lifecycle
 
     def admit(self, prompt: np.ndarray, max_new_tokens: int,
-              tokens=None) -> Tuple[int, int]:
+              tokens=None, sampling=None, adapter: int = 0,
+              mask=None, spec_exclude: bool = False) -> Tuple[int, int]:
         """Prefill ``prompt`` (plus an optional already-generated token
         journal) into a free slot. Returns ``(slot, next_token)`` — the
         token comes out of the prefill program itself (the context's last
@@ -705,12 +812,20 @@ class ServingEngine:
         budget (the journal counts toward it), so the block reservation is
         unchanged.
 
+        ``sampling`` / ``adapter`` / ``mask`` install the slot's scenario
+        state (all runtime data — see :meth:`admit`); replay passes the
+        same values and resumes bit-identically.
+
         Raises if no capacity; callers gate on :meth:`can_admit`."""
-        st = self._admit_setup(prompt, max_new_tokens, tokens)
+        st = self._admit_setup(prompt, max_new_tokens, tokens,
+                               sampling=sampling, adapter=adapter,
+                               mask=mask, spec_exclude=spec_exclude)
         return st.slot, self._admit_prefill_all(st)
 
     def admit_begin(self, prompt: np.ndarray, max_new_tokens: int,
-                    tokens=None) -> Tuple[int, Optional[int]]:
+                    tokens=None, sampling=None, adapter: int = 0,
+                    mask=None,
+                    spec_exclude: bool = False) -> Tuple[int, Optional[int]]:
         """Chunked admission entry point: claim a slot + block budget now,
         prefill incrementally. Returns ``(slot, first_token)`` when the
         whole context fits one chunk (identical to :meth:`admit`), or
@@ -719,7 +834,9 @@ class ServingEngine:
         the first token appears. The slot is *occupied* (its blocks are
         held) but not *active* (its lane stays masked out of the decode
         step), so running streams keep decoding between chunks."""
-        st = self._admit_setup(prompt, max_new_tokens, tokens)
+        st = self._admit_setup(prompt, max_new_tokens, tokens,
+                               sampling=sampling, adapter=adapter,
+                               mask=mask, spec_exclude=spec_exclude)
         chunk = self.chunk_size
         if chunk <= 0 or st.clen - st.prefix_len <= chunk:
             return st.slot, self._admit_prefill_all(st)
@@ -750,7 +867,7 @@ class ServingEngine:
             metrics.bump("chunk.tokens", take)
             if st.done < st.clen:
                 return None
-            if self.spec is not None:
+            if self.spec is not None and not st.skip_draft:
                 self.spec.prefill(slot, st.ctx)
         # analysis: allow(broad-except) — cleanup-and-reraise: a failed
         # chunk must not leak the admission's blocks/refs/slot
@@ -762,14 +879,17 @@ class ServingEngine:
         return self._admit_finish(st, int(nxt))
 
     def _admit_setup(self, prompt: np.ndarray, max_new_tokens: int,
-                     tokens) -> _AdmitState:
+                     tokens, sampling=None, adapter: int = 0,
+                     mask=None, spec_exclude: bool = False) -> _AdmitState:
         """Claim everything an admission needs before any prefill work:
         the slot, the shared-prefix references, the target + draft block
-        reservations, the filled block table, and the COW copy. On ANY
-        failure the claim unwinds completely."""
+        reservations, the filled block table, the COW copy, and the
+        slot's sampling/constraint/adapter state (installed BEFORE the
+        prefill calls — the prefill programs sample their first token
+        under it). On ANY failure the claim unwinds completely."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         plen = int(prompt.shape[0])
-        self.validate(plen, max_new_tokens)
+        self.validate(plen, max_new_tokens, adapter=adapter)
         journal = np.asarray(tokens if tokens is not None else [], np.int32)
         ctx = (np.concatenate([prompt, journal.reshape(-1)])
                if journal.size else prompt)
@@ -816,7 +936,17 @@ class ServingEngine:
             if cow_src is not None:
                 self.arena.deref(cow_src)
             raise
-        if self.spec is not None:
+        # a spec-ineligible lane (sampled/constrained/adapter — sticky,
+        # see spec_ineligible) never reads its draft cache: skip the
+        # draft prefill AND its block reservation entirely. Admission
+        # FEASIBILITY (blocks_needed/can_admit) stays conservative —
+        # it doesn't know the scenario — so this only under-consumes.
+        skip_draft = (self.spec is not None
+                      and (bool(spec_exclude) or int(adapter) != 0
+                           or mask is not None
+                           or (sampling is not None
+                               and sampling.temperature > 0)))
+        if self.spec is not None and not skip_draft:
             try:
                 self.spec.alloc_slot(slot, plen, max_new_tokens)
             # analysis: allow(broad-except) — cleanup-and-reraise: the
@@ -833,11 +963,17 @@ class ServingEngine:
         st = _AdmitState(slot=slot, prompt=prompt, ctx=ctx, plen=plen,
                          clen=clen, max_new=int(max_new_tokens), res=res,
                          shared=shared, n_attached=n_attached, cow=cow,
-                         prefix_len=prefix_len)
+                         prefix_len=prefix_len, sampling=sampling,
+                         adapter=int(adapter), skip_draft=skip_draft)
         self._occupied[slot] = True
         self._slot_res[slot] = res
         self._slot_shared[slot] = shared
         try:
+            # inside the unwind: a bad constraint mask (wrong vocab size,
+            # empty) must release the slot/reservation/refs like any
+            # other admission failure, not leak them
+            self._install_slot_scenario(slot, sampling, int(adapter),
+                                        mask, spec_exclude=spec_exclude)
             for i, blk in enumerate(shared):
                 self._bt_host[slot, i] = blk
             # private blocks covering the suffix [prefix blocks, clen)
@@ -862,6 +998,103 @@ class ServingEngine:
             raise
         return st
 
+    def _install_slot_scenario(self, slot: int, sampling, adapter: int,
+                               mask, spec_exclude: bool = False) -> None:
+        """Install the slot's per-request scenario state — sampling
+        params, constraint mask, adapter id — as runtime data. Runs at
+        claim time (before any prefill call: the prefill programs sample
+        their first token under it)."""
+        sp = sampling
+        greedy = sp is None or sp.temperature <= 0.0
+        self._temp[slot] = 0.0 if sp is None else float(sp.temperature)
+        self._top_k[slot] = 0 if sp is None else int(sp.top_k)
+        self._top_p[slot] = 1.0 if sp is None else float(sp.top_p)
+        self._seed[slot] = 0 if sp is None else int(sp.seed)
+        self._sampled[slot] = not greedy
+        self._adapter[slot] = adapter
+        if mask is not None:
+            row = np.asarray(mask, bool).reshape(-1)
+            if row.shape[0] != self.vocab:
+                raise ValueError(
+                    f"constraint mask covers {row.shape[0]} tokens, "
+                    f"vocab is {self.vocab}")
+            if not row.any():
+                raise ValueError("constraint mask allows no token")
+            self._mask_host[slot, :] = row
+            self._constrained[slot] = True
+            self._update_mask_row(slot)
+            metrics.bump("constrain.admits")
+        if not greedy:
+            self.sampled_admits += 1
+            metrics.bump("sampling.admits")
+        if mask is not None:
+            self.constrained_admits += 1
+        if adapter:
+            self.adapter_admits += 1
+            metrics.bump("lora.admits")
+        self._scenario_once[slot] = (not greedy or mask is not None
+                                     or bool(adapter) or bool(spec_exclude))
+
+    def _clear_slot_scenario(self, slot: int) -> None:
+        """Reset the slot's scenario state to the greedy/unmasked/base
+        defaults (retire and admission unwind)."""
+        self._temp[slot] = 0.0
+        self._top_k[slot] = 0
+        self._top_p[slot] = 1.0
+        self._seed[slot] = 0
+        self._sampled[slot] = False
+        self._adapter[slot] = 0
+        self._scenario_once[slot] = False
+        if self._constrained[slot]:
+            self._mask_host[slot, :] = True
+            self._constrained[slot] = False
+            self._update_mask_row(slot)
+
+    def _update_mask_row(self, slot: int) -> None:
+        """Mark one mask row stale on device. The refresh is DEFERRED and
+        batched: ``_samp_args`` applies every dirty row in one scatter
+        per decode step — neither a full [S, vocab] re-upload per step
+        (the walker advances every token) nor one dispatch per update."""
+        if self._mask_dev is not None:
+            self._mask_dirty.add(int(slot))
+
+    def set_slot_mask(self, slot: int, mask) -> None:
+        """Scatter a constrained slot's new allowed-vocab row (the host
+        walker advanced one token): pure runtime data — one device row
+        updates, never the compiled step. ``None`` lifts the constraint
+        (all-True, the mask-off identity)."""
+        if mask is None:
+            if self._constrained[slot]:
+                self._mask_host[slot, :] = True
+                self._constrained[slot] = False
+                self._update_mask_row(slot)
+            return
+        row = np.asarray(mask, bool).reshape(-1)
+        if row.shape[0] != self.vocab:
+            raise ValueError(
+                f"constraint mask covers {row.shape[0]} tokens, vocab "
+                f"is {self.vocab}")
+        if not row.any():
+            raise ValueError("constraint mask allows no token")
+        self._mask_host[slot, :] = row
+        self._constrained[slot] = True
+        self._scenario_once[slot] = True  # sticky: see spec_ineligible
+        self._update_mask_row(slot)
+        metrics.bump("constrain.mask_updates")
+
+    def spec_ineligible(self) -> np.ndarray:
+        """Per-slot mask of lanes speculative decoding must NOT cover:
+        sampled (verify-against-sampled-distribution is follow-up work),
+        constrained (the verify program applies no vocab mask), and
+        adapter-wearing (the verify program binds no adapter context)
+        slots fall back to the plain decode step per-slot — see
+        :meth:`~.spec_decode.SpecDecoder.step`. STICKY per request
+        (``_scenario_once``): a constraint that lifts mid-stream must
+        not hand the lane back — its draft cache missed every token of
+        the fallback phase."""
+        return (self._sampled | self._constrained
+                | (self._adapter != 0) | self._scenario_once)
+
     def _admit_abort(self, st: _AdmitState) -> None:
         """Unwind a claimed admission (setup succeeded, a later prefill /
         chunk / draft call failed): drop the shared refs, release both
@@ -878,6 +1111,7 @@ class ServingEngine:
         self._bt_host[st.slot, :] = 0
         self._bt_dev = None
         self._occupied[st.slot] = False
+        self._clear_slot_scenario(st.slot)
         self._refresh_gauges()
 
     def _admit_prefill_all(self, st: _AdmitState) -> int:
@@ -890,9 +1124,9 @@ class ServingEngine:
                     st.ctx, st.clen, st.prefix_len, st.slot)
             else:
                 nxt, new_pools = self._full_prefill_call(st.ctx, st.clen,
-                                                         st.res)
+                                                         st.res, st.slot)
             self.arena.set_pools(new_pools)
-            if self.spec is not None:
+            if self.spec is not None and not st.skip_draft:
                 self.spec.prefill(st.slot, st.ctx)
         # analysis: allow(broad-except) — cleanup-and-reraise: a failed
         # prefill must not leak the admission's blocks/refs/slot
@@ -928,9 +1162,11 @@ class ServingEngine:
         return first
 
     def _full_prefill_call(self, ctx: np.ndarray, clen: int,
-                           res: Reservation):
+                           res: Reservation, slot: int):
         """Dispatch the whole-context bucketed prefill (the cache-miss and
-        cache-off path — byte-identical to the pre-cache engine)."""
+        cache-off path — byte-identical to the pre-cache engine). The
+        emitted first token sits at context index ``clen`` — it samples
+        under the slot's params at that positional key."""
         import jax.numpy as jnp
 
         p_bucket = compile_cache.prefill_bucket(
@@ -943,7 +1179,9 @@ class ServingEngine:
         fn = self._get_prefill(p_bucket)
         return self._call(
             fn, self._arrays, jnp.asarray(ids), jnp.int32(clen),
-            self.arena.pools, jnp.asarray(rows), name="serving.prefill")
+            self.arena.pools, jnp.asarray(rows),
+            self._samp_row(slot, clen), *self._lora_args(slot),
+            name="serving.prefill")
 
     def _suffix_prefill_call(self, ctx: np.ndarray, clen: int,
                              prefix_len: int, slot: int,
@@ -963,10 +1201,14 @@ class ServingEngine:
         fn = self._get_prefix_prefill(s_bucket)
         if not chunked:
             metrics.bump("prefix.suffix_prefills")
+        # the emitted token sits at context index `clen`; only the FINAL
+        # chunk of a chunked admission consumes it, where clen == the
+        # full context length — the same positional key either way
         return self._call(
             fn, self._arrays, jnp.asarray(ids), jnp.int32(slen),
             jnp.int32(prefix_len), self.arena.pools,
-            jnp.asarray(self._bt_host[slot]), name="serving.prefix_prefill")
+            jnp.asarray(self._bt_host[slot]), self._samp_row(slot, clen),
+            *self._lora_args(slot), name="serving.prefix_prefill")
 
     def retire(self, slot: int) -> None:
         """Free a slot: deactivate its lane, drop its shared-prefix
@@ -997,6 +1239,7 @@ class ServingEngine:
         self._positions[slot] = 0
         self._last_tok[slot] = 0
         self._slot_limit[slot] = 0
+        self._clear_slot_scenario(slot)
         metrics.bump("engine.retires")
         if flags.flag("serving_arena_invariants"):
             self.check_invariants()
@@ -1051,6 +1294,20 @@ class ServingEngine:
         self._occupied[:] = False
         self._slot_limit[:] = 0
         self._chunk.clear()
+        # scenario state dies with the slots; journal replays re-install
+        # each request's sampling/mask/adapter at re-admission (the LoRA
+        # arena itself is host-owned and survives — registered adapters
+        # need no re-registration after a rebuild)
+        self._temp[:] = 0.0
+        self._top_k[:] = 0
+        self._top_p[:] = 1.0
+        self._seed[:] = 0
+        self._adapter[:] = 0
+        self._sampled[:] = False
+        self._constrained[:] = False
+        self._scenario_once[:] = False
+        self._mask_host[:] = True
+        self._mask_dev = None
         self._slot_res = [None] * self.num_slots
         self._slot_shared = [[] for _ in range(self.num_slots)]
         self._slot_filled[:] = 0
@@ -1086,15 +1343,67 @@ class ServingEngine:
         ``{slot: [tokens]}``."""
         return self.spec.step()
 
-    def decode_step(self) -> np.ndarray:
+    def _samp_args(self):
+        """The decode step's per-slot sampling pytree: (temp, top_k,
+        top_p, seed, mask) — [S] arrays plus the [S, vocab] constraint
+        mask. The device mask is memoized; rows the walkers changed
+        since the last step refresh in ONE batched scatter here
+        (unconstrained steady state re-passes the cached array with
+        zero transfer; constrained slots cost one small dispatch/step)."""
+        import jax.numpy as jnp
+
+        if self._mask_dev is None:
+            self._mask_dev = jnp.asarray(self._mask_host)
+            self._mask_dirty.clear()
+        elif self._mask_dirty:
+            rows = np.fromiter(self._mask_dirty, np.int32,
+                               len(self._mask_dirty))
+            self._mask_dev = self._mask_dev.at[jnp.asarray(rows)].set(
+                jnp.asarray(self._mask_host[rows]))
+            self._mask_dirty.clear()
+        return (jnp.asarray(self._temp), jnp.asarray(self._top_k),
+                jnp.asarray(self._top_p), jnp.asarray(self._seed),
+                self._mask_dev)
+
+    def _samp_row(self, slot: int, pos: int):
+        """One slot's sampling pytree for a prefill call ([1] shapes;
+        ``pos`` = the context index where the emitted token will sit —
+        its positional PRNG key)."""
+        import jax.numpy as jnp
+
+        return (jnp.asarray(self._temp[slot:slot + 1]),
+                jnp.asarray(self._top_k[slot:slot + 1]),
+                jnp.asarray(self._top_p[slot:slot + 1]),
+                jnp.asarray(self._seed[slot:slot + 1]),
+                jnp.full((1,), pos, jnp.int32),
+                jnp.asarray(self._mask_host[slot:slot + 1]))
+
+    def _lora_args(self, slot: Optional[int] = None) -> tuple:
+        """The adapter-arena args of a compiled call — ``()`` when the
+        arena is off (the programs are built without the parameters), else
+        ``(pools, adapter_ids)``: the memoized device pools plus the
+        per-lane (or single-slot) adapter index vector."""
+        if self.lora is None:
+            return ()
+        import jax.numpy as jnp
+
+        ids = (self._adapter if slot is None
+               else self._adapter[slot:slot + 1])
+        return (self.lora.device_pools(), jnp.asarray(ids))
+
+    def decode_step(self, active=None) -> np.ndarray:
         """One iteration: every active slot's last token is forwarded at
         its own position, its k/v lands in its current block, and one new
         token per slot comes back ([num_slots] int32; inactive lanes carry
-        garbage — callers must mask by activity)."""
+        garbage — callers must mask by activity). ``active`` overrides the
+        lane mask (runtime data — same program): the speculative decoder
+        drives the sampled/constrained/adapter lanes it must not cover
+        through here, see :meth:`spec_ineligible`."""
         import jax.numpy as jnp
 
+        act = self._active if active is None else np.asarray(active, bool)
         # grow block tables whose write position crossed a block boundary
-        for slot in np.flatnonzero(self._active):
+        for slot in np.flatnonzero(act):
             self._grow_slot_to(slot, int(self._positions[slot]))
         if self._bt_dev is None:
             self._bt_dev = jnp.asarray(self._bt_host)
@@ -1102,10 +1411,10 @@ class ServingEngine:
         nxt, new_pools = self._call(
             fn, self._arrays, self.arena.pools, self._bt_dev,
             jnp.asarray(self._positions), jnp.asarray(self._last_tok),
-            jnp.asarray(self._active), name="serving.step")
+            jnp.asarray(act), self._samp_args(), *self._lora_args(),
+            name="serving.step")
         self.arena.set_pools(new_pools)
         out = np.asarray(nxt)
-        act = self._active
         self._positions[act] += 1
         self._last_tok[act] = out[act]
         metrics.bump("engine.steps")
@@ -1142,6 +1451,14 @@ class ServingEngine:
             frag += int(self._slot_filled[slot]) * self.block_size \
                 - int(self._positions[slot])
         metrics.set_gauge("arena.frag_tokens", frag)
+        metrics.set_gauge("sampling.active_slots",
+                          int((self._sampled & self._active).sum()))
+        metrics.set_gauge("constrain.active_slots",
+                          int((self._constrained & self._active).sum()))
+        if self.lora is not None:
+            metrics.set_gauge("lora.active_slots",
+                              int(((self._adapter != 0)
+                                   & self._active).sum()))
         if self.prefix_cache is not None:
             metrics.set_gauge("prefix.resident_blocks",
                               self.prefix_cache.resident_blocks())
@@ -1161,10 +1478,17 @@ class ServingEngine:
                "quant.draft": int(self.quant_draft
                                   and self.spec is not None
                                   and self.spec.draft_mode)}
+        out.update({
+            "sampling.admits": self.sampled_admits,
+            "constrain.admits": self.constrained_admits,
+            "lora.admits": self.adapter_admits,
+        })
         out.update({f"arena.{k}": v for k, v in self.arena.stats().items()})
         if self.prefix_cache is not None:
             out.update({f"prefix.{k}": v
                         for k, v in self.prefix_cache.stats().items()})
         if self.spec is not None:
             out.update(self.spec.stats())
+        if self.lora is not None:
+            out.update(self.lora.stats())
         return out

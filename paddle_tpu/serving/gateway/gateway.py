@@ -11,6 +11,13 @@ the client's contract with its model):
 * ``POST /v1/submit``  — ``{"prompt": [ids], "max_new_tokens",
   "stop_token_id", "tenant", "priority", "timeout"}`` →
   ``{"request_id": ...}``. Admission runs the tenant gates + router here.
+  Decode-scenario fields (ISSUE 12, all optional): ``temperature`` /
+  ``top_k`` / ``top_p`` / ``seed`` build a ``SamplingParams`` (absent =
+  the tenant's configured default, else greedy); ``adapter`` picks the
+  LoRA arena row (absent = the tenant's fine-tune, 0 = base weights);
+  ``choices`` — a list of token-id lists — constrains the output to one
+  of those sequences (a ``serving.constrain.TrieConstraint``; richer
+  grammars lower to ``TokenDFA`` client-side against the tokenizer).
 * ``GET /v1/stream/<request_id>`` — Server-Sent Events: one
   ``data: {"token": t}`` event per generated token (re-routes are invisible
   — the journal keeps the stream token-for-token), then
@@ -185,6 +192,32 @@ class Gateway:
                     f"request_id {rid!r} is already in flight; pick a "
                     f"unique id or omit it for a generated one")
         prompt = np.asarray(body["prompt"], np.int32).reshape(-1)
+        sampling = None
+        if any(k in body for k in ("temperature", "top_k", "top_p",
+                                   "seed")):
+            from ..sampling import SamplingParams
+
+            # a client sending top_k/top_p/seed WITHOUT temperature is
+            # asking to sample: default temperature 1.0 (neutral scale),
+            # not 0 — temperature<=0 would silently ignore the truncation
+            # and return greedy. Explicit temperature 0 still means greedy.
+            # seed absent -> None: the router pins fresh entropy per
+            # request (two unseeded clients must not share a stream)
+            sampling = SamplingParams(
+                temperature=float(body.get("temperature", 1.0)),
+                top_k=int(body.get("top_k", 0)),
+                top_p=float(body.get("top_p", 1.0)),
+                seed=(None if body.get("seed") is None
+                      else int(body["seed"])))
+        constraint = None
+        if body.get("choices") is not None:
+            from ..constrain import TrieConstraint
+
+            stop = body.get("stop_token_id")
+            constraint = TrieConstraint(
+                [[int(t) for t in c] for c in body["choices"]],
+                vocab_size=self.pool.vocab_size(),
+                stop_token_id=None if stop is None else int(stop))
         rr = self.pool.submit(
             prompt,
             max_new_tokens=int(body.get("max_new_tokens", 32)),
@@ -195,7 +228,10 @@ class Gateway:
                      else float(body["timeout"])),
             request_id=str(body.get("request_id", "")),
             priority=(None if body.get("priority") is None
-                      else int(body["priority"])))
+                      else int(body["priority"])),
+            sampling=sampling, constraint=constraint,
+            adapter=(None if body.get("adapter") is None
+                     else int(body["adapter"])))
         with self._lock:
             self._requests[rr.request_id] = rr
             if len(self._requests) > _REGISTRY_SOFT_CAP:

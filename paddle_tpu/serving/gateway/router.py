@@ -119,13 +119,20 @@ class RoutedRequest:
     def __init__(self, pool: "ReplicaPool", prompt: np.ndarray,
                  max_new_tokens: int, stop_token_id: Optional[int],
                  tenant: str, priority: int,
-                 deadline: resilience.Deadline, request_id: str):
+                 deadline: resilience.Deadline, request_id: str,
+                 sampling=None, constraint=None, adapter: int = 0):
         self.pool = pool
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         self.max_new_tokens = int(max_new_tokens)
         self.stop_token_id = stop_token_id
         self.tenant = tenant
         self.priority = int(priority)
+        # decode-scenario state rides the handle so a re-route re-submits
+        # the SAME scenario: positional sampling keys + a journal-rebuilt
+        # constraint walker make the resumed stream token-identical
+        self.sampling = sampling
+        self.constraint = constraint
+        self.adapter = int(adapter)
         self.deadline = deadline
         self.request_id = request_id or f"gw-{next(_gw_counter)}"
         self.reroutes = 0
@@ -263,6 +270,9 @@ class ReplicaPool:
                               if max_reroutes is None else int(max_reroutes))
         self._background = bool(background)
         self._lock = threading.RLock()
+        # pool-level LoRA registrations, in order: respawned replicas
+        # replay them so every replica serves identical adapter ids
+        self._adapters: List[tuple] = []
         self._replicas: List[_Replica] = [
             _Replica(i, self._spawn_api()) for i in range(n)]
         #: live (unfinished) routed requests per replica index
@@ -277,7 +287,38 @@ class ReplicaPool:
         self._refresh_gauges()
 
     def _spawn_api(self) -> ServingAPI:
-        return ServingAPI(self._factory(), **self._api_kw)
+        api = ServingAPI(self._factory(), **self._api_kw)
+        # ordered replay of pool-level adapter registrations: the arena
+        # hands out rows in registration order, so a respawned replica
+        # reconstructs the exact id assignment its peers serve
+        for adapter, name in self._adapters:
+            api.engine.lora.register(adapter, name=name)
+        return api
+
+    def register_adapter(self, adapter, name: Optional[str] = None) -> int:
+        """Install one :class:`~..adapters.LoraAdapter` on EVERY replica
+        (and on every future respawn); returns the pool-wide adapter id.
+        Requires the replicas' engines to carry an adapter arena
+        (``FLAGS_serving_lora_rank`` > 0). Registration is value-only —
+        zero recompiles on any replica."""
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("ReplicaPool is closed")
+            ids = [rep.api.register_adapter(adapter, name=name)
+                   for rep in self._replicas if not rep.removed]
+            if not ids:
+                raise NoHealthyReplicaError("no replica to register on")
+            if len(set(ids)) != 1:  # ordered replay makes this impossible
+                raise RuntimeError(f"replicas disagree on adapter id: {ids}")
+            self._adapters.append((adapter, name))
+            metrics.bump("lora.pool_registered")
+            return ids[0]
+
+    def vocab_size(self) -> int:
+        """The served model's vocab size (what gateway-built constraint
+        walkers size their masks to)."""
+        with self._lock:
+            return int(self._replicas[0].api.engine.vocab)
 
     # ----------------------------------------------------------- capacity
 
@@ -304,9 +345,15 @@ class ReplicaPool:
                tenant: str = "default",
                timeout: Optional[float] = None,
                request_id: str = "",
-               priority: Optional[int] = None) -> RoutedRequest:
+               priority: Optional[int] = None,
+               sampling=None, constraint=None,
+               adapter: Optional[int] = None) -> RoutedRequest:
         """Admit one stream through the tenant gates and route it to a
-        replica. ``priority=None`` takes the tenant's configured class.
+        replica. ``priority=None`` takes the tenant's configured class —
+        as do ``sampling`` (the tenant's default SamplingParams) and
+        ``adapter`` (the tenant's configured LoRA row: every tenant gets
+        its own fine-tune on the shared base weights). ``constraint`` is
+        always per-request (a ``serving.constrain`` walker).
         Raises :class:`core.resilience.QuotaExceededError` (tenant gates,
         retriable with ``retry_after``),
         :class:`core.resilience.QueueOverloadError` (every routable replica
@@ -327,10 +374,31 @@ class ReplicaPool:
         cfg = self.tenants.admit(tenant, int(max_new_tokens),
                                  outstanding=self.outstanding(),
                                  capacity=self.capacity())
+        ad = cfg.adapter if adapter is None else int(adapter)
+        if not cfg.adapter_allowed(ad):
+            # a per-request adapter override must be authorized for the
+            # tenant: fine-tunes are tenant property, and check_live alone
+            # would let any client decode through another tenant's row.
+            # Never enqueued — make the tenant whole like any routing shed
+            self.tenants.release(tenant, failed=True)
+            self.tenants.refund(tenant, int(max_new_tokens))
+            metrics.bump("lora.denied")
+            raise ValueError(
+                f"adapter {ad} is not authorized for tenant {tenant!r} "
+                "(TenantConfig.allowed_adapters)")
+        samp = cfg.sampling if sampling is None else sampling
+        if samp is not None:
+            # pin an unset seed at the GATEWAY handle: re-routes re-submit
+            # the materialized params, so a fail-over continues the exact
+            # stream instead of re-drawing entropy mid-journal
+            samp = samp.materialized()
         rr = RoutedRequest(self, prompt, max_new_tokens, stop_token_id,
                            tenant, cfg.priority if priority is None
                            else int(priority),
-                           resilience.Deadline.after(timeout), request_id)
+                           resilience.Deadline.after(timeout), request_id,
+                           sampling=samp,
+                           constraint=constraint,
+                           adapter=ad)
         try:
             self._route(rr, journal=None)
         except Exception:
@@ -363,7 +431,8 @@ class ReplicaPool:
                              else max(0.001, rr.deadline.remaining())),
                     request_id=f"{rr.request_id}.{rr.reroutes}",
                     priority=rr.priority, journal=journal,
-                    shed=journal is None)
+                    shed=journal is None, sampling=rr.sampling,
+                    constraint=rr.constraint, adapter=rr.adapter)
             except (resilience.QueueOverloadError,
                     resilience.RequestDrainedError) as e:
                 last_exc = e  # replica-local condition: try the next one

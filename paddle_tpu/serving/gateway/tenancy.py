@@ -57,7 +57,22 @@ class TenantConfig:
     ``rate``). ``max_concurrency`` — in-flight request cap (0 = unlimited).
     ``weight`` — fair-share weight under overload (share = weight / sum of
     active tenants' weights). ``priority`` — the scheduler priority class
-    stamped on this tenant's requests (lower = served first)."""
+    stamped on this tenant's requests (lower = served first).
+
+    ``adapter`` / ``sampling`` are the tenant's decode-scenario defaults
+    (ISSUE 12): ``adapter`` names the LoRA arena row the tenant's
+    requests decode with unless they say otherwise (0 = base weights —
+    "every tenant gets its own fine-tune on shared base weights"), and
+    ``sampling`` (a :class:`paddle_tpu.serving.SamplingParams`) the
+    default sampling params (None = greedy). Both are per-slot runtime
+    data in the compiled step — tenant mix never recompiles.
+
+    ``allowed_adapters`` is the tenant's adapter AUTHORIZATION set: a
+    per-request ``adapter=`` override must name a row in it (the base
+    row 0 and the tenant's own configured ``adapter`` are always
+    allowed). Fine-tunes are per-tenant property — without this gate any
+    wire client could decode through another tenant's private adapter by
+    guessing its row id."""
 
     name: str
     rate: float = 0.0
@@ -65,6 +80,14 @@ class TenantConfig:
     max_concurrency: int = 0
     weight: float = 1.0
     priority: int = 0
+    adapter: int = 0
+    sampling: Optional[object] = None
+    allowed_adapters: tuple = ()
+
+    def adapter_allowed(self, adapter_id: int) -> bool:
+        return (int(adapter_id) in (0, int(self.adapter))
+                or int(adapter_id) in {int(a)
+                                       for a in self.allowed_adapters})
 
     def bucket_capacity(self) -> float:
         if self.burst > 0:
@@ -140,7 +163,9 @@ class TenantManager:
                 d = self._default
                 cfg = TenantConfig(name, rate=d.rate, burst=d.burst,
                                    max_concurrency=d.max_concurrency,
-                                   weight=d.weight, priority=d.priority)
+                                   weight=d.weight, priority=d.priority,
+                                   adapter=d.adapter, sampling=d.sampling,
+                                   allowed_adapters=d.allowed_adapters)
             else:
                 cfg = TenantConfig(
                     name,

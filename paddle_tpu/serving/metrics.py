@@ -45,6 +45,18 @@ Counter namespaces:
 * ``tenant.*``     — quota admission: ``admitted`` / ``completed`` /
   ``shed_rate`` / ``shed_concurrency`` / ``shed_share``, plus per-tenant
   ``tenant.<name>.admitted`` / ``.shed`` / ``.tokens_out`` (goodput)
+* ``sampling.*``   — per-slot sampling (``serving.sampling``):
+  ``admits`` (non-greedy admissions) / ``spec_fallback_slots`` (lanes
+  the speculative decoder routed through the plain step per the compose
+  rule — sampled/constrained/adapter slots never take spec's greedy
+  verify path)
+* ``constrain.*``  — constrained decoding (``serving.constrain``):
+  ``admits`` (masked admissions) / ``mask_updates`` (walker advances
+  scattered into the slot mask) / ``dead_ends`` (user walkers sanitized
+  to unconstrained)
+* ``lora.*``       — the multi-LoRA adapter arena (``serving.adapters``):
+  ``registered`` / ``unregistered`` / ``register_failed`` (capacity) /
+  ``admits`` (slots admitted with a non-zero adapter)
 
 Gauges: ``queue.depth``, ``queue.prefilling`` (chunked prefills in
 progress), ``spec.acceptance_rate``, ``slots.active``, ``slots.total``,
@@ -55,7 +67,10 @@ live context tokens — internal fragmentation of the paged cache),
 ``prefix.resident_blocks``, ``tokens_per_sec`` (the engine's
 lifetime-aggregate decode rate from its :class:`Meter`),
 ``gateway.replicas_healthy`` / ``gateway.replicas_total`` /
-``gateway.outstanding`` (the router's fleet picture).
+``gateway.outstanding`` (the router's fleet picture),
+``sampling.active_slots`` / ``constrain.active_slots`` /
+``lora.active_slots`` (scenario mix of the live batch), and the adapter
+arena's ``lora.slots`` / ``lora.live`` / ``lora.arena_bytes``.
 """
 from __future__ import annotations
 
@@ -81,6 +96,7 @@ _providers_registered = False
 DOCUMENTED_NAMESPACES = (
     "requests", "tokens", "engine", "arena", "scheduler", "supervisor",
     "api", "prefix", "spec", "chunk", "quant", "gateway", "tenant",
+    "sampling", "constrain", "lora",
     "queue", "slots", "tokens_per_sec",
 )
 

@@ -1,0 +1,194 @@
+"""Per-slot sampling for the compiled decode step (and ``generate()``).
+
+The serving engine decodes every slot greedily today; real traffic mixes
+temperatures, top-k/top-p truncation, and seeded reproducible streams in
+one batch. The TPU-idiomatic answer is the same one the engine uses for
+``start_pos``: **every sampling parameter is per-slot runtime data** —
+``temperature [S] f32``, ``top_k [S] i32``, ``top_p [S] f32``,
+``seed [S] i32`` ride into the ONE compiled decode step as arrays, so a
+batch mixing greedy and sampled slots (or a slot changing params between
+requests) never builds a new program.
+
+Determinism is positional, not stateful: the PRNG key for the token at
+context index ``i`` of a stream seeded ``s`` is
+``fold_in(PRNGKey(s), i)`` — a pure function of ``(seed, position)``.
+That one rule buys three guarantees at once:
+
+* **bit-reproducible seeded runs** — same seed, same prompt, same params
+  ⇒ the identical token stream, every time;
+* **slot-independence** — the stream does not depend on which slot (or
+  which batch neighbours) served it, so preemption/re-admission into a
+  different slot continues the exact stream;
+* **replay-identical recovery** — supervisor rebuild+replay re-prefills
+  ``prompt + journal`` and resumes at position ``len(journal)+plen``
+  with the exact key an uninterrupted decode would have used. Nothing
+  about the PRNG needs journaling beyond the request's own seed.
+
+``temperature == 0`` short-circuits to ``argmax`` via ``jnp.where`` over
+the same logits, so a greedy slot's tokens are bit-identical to the
+pre-sampling engine (the parity contract tests pin). The constrained-
+decoding vocab mask (:mod:`paddle_tpu.serving.constrain`) is applied
+BEFORE both branches — mask-off (all-True) is the identity.
+
+:func:`sample_tokens` is the one sampling core shared by the engine's
+compiled programs and ``GPT.generate(sampling=...)`` — the parity anchor:
+a request served through the slot engine and a ``generate()`` call with
+the same :class:`SamplingParams` emit identical tokens.
+"""
+from __future__ import annotations
+
+import random as _random
+from dataclasses import dataclass, replace as _dc_replace
+from typing import Optional
+
+__all__ = ["SamplingParams", "sample_tokens"]
+
+
+@dataclass(frozen=True)
+class SamplingParams:
+    """One request's sampling contract.
+
+    ``temperature`` — 0 (default) is greedy argmax, bit-identical to the
+    engine's classic decode; > 0 samples from the (optionally truncated)
+    softmax. ``top_k`` — keep only the k highest logits (0 = off).
+    ``top_p`` — nucleus truncation: keep the smallest set of
+    highest-probability tokens whose cumulative probability reaches
+    ``top_p`` (1.0 = off). ``seed`` — the stream's PRNG seed; the key for
+    the token at context index ``i`` is ``fold_in(PRNGKey(seed), i)``,
+    so seeded runs are bit-reproducible and replay-safe. ``None``
+    (default) draws fresh server-side entropy ONCE at request creation
+    (:meth:`materialized`) — unseeded requests genuinely differ from
+    each other, while the drawn seed is pinned on the request so
+    replay/preemption/re-route still resume the exact stream. Frozen so
+    it can join compiled-program cache keys (``generate()``)."""
+
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    seed: Optional[int] = None
+
+    @property
+    def greedy(self) -> bool:
+        return self.temperature <= 0.0
+
+    def materialized(self) -> "SamplingParams":
+        """These params with a concrete seed: an unset seed is drawn
+        from process entropy exactly once — the request then carries it
+        for its whole (replayable) life. Shared default objects (e.g. a
+        ``TenantConfig.sampling``) are never mutated."""
+        if self.seed is not None:
+            return self
+        return _dc_replace(self, seed=_random.getrandbits(31))
+
+
+def sample_tokens(logits, temperature, top_k, top_p, seeds, positions,
+                  allowed=None):
+    """The compiled per-row sampling core: next token ids ``[S] int32``
+    from ``logits [S, V]``.
+
+    Every parameter is RUNTIME DATA (``[S]`` arrays — per-row temperature,
+    top-k, top-p, seed, and the absolute context index ``positions`` where
+    each sampled token will sit), so one traced program serves every mix
+    of greedy/sampled/constrained rows. ``allowed`` is the optional
+    ``[S, V]`` boolean constraint mask (False = token forbidden); an
+    all-True mask is the bitwise identity on the greedy branch.
+
+    Rows with ``temperature <= 0`` return ``argmax`` of the (masked)
+    logits — bit-identical to the pre-sampling greedy path. Sampled rows
+    scale by temperature, apply per-row top-k then top-p truncation
+    (the same keep rule as ``models.gpt._filter_logits``: a token
+    survives top-p while the cumulative probability BEFORE it is still
+    < p, so the top token always survives), and draw via Gumbel/categorical
+    under the positional key ``fold_in(PRNGKey(seed), position)``.
+
+    All math is array-only (``jnp.where`` over static shapes — no host
+    branches, no data-dependent shapes): safe inside any jit, and the
+    per-row value is independent of the batch size, so a token sampled in
+    a ``[1, V]`` prefill call is bit-identical to the same row sampled in
+    the ``[S, V]`` decode step.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    vocab = logits.shape[-1]
+    logits = logits.astype(jnp.float32)
+    if allowed is not None:
+        logits = jnp.where(allowed, logits, -jnp.inf)
+    greedy = jnp.argmax(logits, axis=-1)
+    temperature = temperature.astype(jnp.float32)
+
+    def _search(x, pred, lo, hi):
+        """Monotone value-threshold search over ``[lo, hi]`` per row:
+        64 bisections shrink the bracket far below one f32 ulp, so the
+        kept SET {x >= threshold} is exact — at most one representable
+        float (the true boundary value) fits the final interval.
+        ``pred(mid) -> [S] bool`` must be true at ``lo``-side values."""
+        def body(_, lh):
+            lo, hi = lh
+            mid = 0.5 * (lo + hi)
+            ok = pred(mid)
+            return (jnp.where(ok, mid, lo), jnp.where(ok, hi, mid))
+
+        return jax.lax.fori_loop(0, 64, body, (lo, hi))
+
+    def sampled_branch(_):
+        # SORT-FREE truncation: top-k and top-p are value cuts with
+        # tie-inclusive keep rules, so each reduces to a per-row value
+        # threshold found by monotone bisection (64 fused reduce
+        # iterations — ~4x cheaper than one [S, vocab] argsort on CPU,
+        # and the draw is inverse-CDF over the unsorted distribution:
+        # ONE uniform per row instead of V gumbels).
+        scaled = logits / jnp.maximum(temperature, 1e-6)[:, None]
+        finite = jnp.isfinite(scaled)
+        lo0 = jnp.min(jnp.where(finite, scaled, jnp.inf), axis=-1)
+        hi0 = jnp.max(jnp.where(finite, scaled, -jnp.inf), axis=-1)
+        # per-row top-k (0 = off): keep x >= (k-th largest value) — ties
+        # at the threshold all survive. count(x >= v) >= k is decreasing
+        # in v; the converged lower bound IS the k-th largest float.
+        k_eff = jnp.clip(top_k.astype(jnp.int32), 0, vocab)
+        k_min1 = jnp.maximum(k_eff, 1)
+        kth, _ = _search(
+            scaled,
+            lambda mid: (scaled >= mid[:, None]).sum(-1) >= k_min1,
+            lo0, hi0)
+        scaled = jnp.where((k_eff > 0)[:, None] & (scaled < kth[:, None]),
+                           -jnp.inf, scaled)
+        # per-row top-p over the top-k-filtered distribution: keep x
+        # while the probability mass STRICTLY above x is < p (the
+        # _filter_logits keep rule — the top token always survives,
+        # threshold ties all survive). That mass is increasing in x, so
+        # the converged upper bound is the smallest kept float.
+        p = top_p.astype(jnp.float32)
+        probs = jax.nn.softmax(scaled, axis=-1)
+        _, p_thresh = _search(
+            scaled,
+            lambda mid: jnp.where(scaled > mid[:, None], probs,
+                                  0.0).sum(-1) >= p,
+            lo0, hi0)
+        p_on = ((p > 0.0) & (p < 1.0))[:, None]
+        probs = jnp.where(~p_on | (scaled >= p_thresh[:, None]),
+                          probs, 0.0)
+        # positional keys: a pure function of (seed, absolute position)
+        # — the replay/preemption/slot-independence contract. Inverse-CDF
+        # draw in vocab order: ONE uniform per row against the
+        # renormalized cumulative mass of the kept set.
+        cum = jnp.cumsum(probs, axis=-1)
+        keys = jax.vmap(lambda s, q: jax.random.fold_in(
+            jax.random.PRNGKey(s), q))(seeds.astype(jnp.int32),
+                                       positions.astype(jnp.int32))
+        u = jax.vmap(lambda k: jax.random.uniform(k))(keys)
+        # u can be exactly 0.0 (~2^-23 of draws): a zero draw against a
+        # strict < comparison would select index 0 even when token 0 is
+        # masked/truncated (cum[0] == 0) — emitting a forbidden token.
+        # Flooring u keeps the draw strictly positive, so leading
+        # zero-probability entries (cum == 0 < draw) are always skipped.
+        u = jnp.maximum(u, jnp.float32(1e-12))
+        draw = (u * cum[:, -1])[:, None]
+        return jnp.minimum((cum < draw).sum(axis=-1), vocab - 1)
+
+    # all-greedy batches (the common serving case) skip the sort/softmax/
+    # cumsum machinery entirely: lax.cond on runtime data — one program,
+    # no recompile, and the greedy hot path stays argmax-priced
+    sampled = jax.lax.cond(jnp.any(temperature > 0.0),
+                           sampled_branch, lambda _: greedy, None)
+    return jnp.where(temperature > 0.0, sampled, greedy).astype(jnp.int32)

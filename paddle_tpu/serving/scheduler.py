@@ -84,6 +84,15 @@ class Request:        # compare numpy prompt payloads
     stop_token_id: Optional[int] = None
     request_id: str = ""
     priority: int = 0
+    # per-request scenario state (ISSUE 12): sampling params
+    # (serving.sampling.SamplingParams; None = greedy), an incremental
+    # decoding constraint (serving.constrain.Constraint; its walker state
+    # `_cstate` is pure data derived from `tokens`, so journal replay /
+    # preemption / gateway re-routes reconstruct it for free), and the
+    # LoRA adapter arena row this request decodes with (0 = base weights)
+    sampling: Optional[object] = None
+    constraint: Optional[object] = None
+    adapter_id: int = 0
     deadline: resilience.Deadline = field(
         default_factory=resilience.Deadline)
     state: str = RequestState.QUEUED
@@ -104,9 +113,16 @@ class Request:        # compare numpy prompt payloads
     def __post_init__(self):
         self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)
         self.priority = int(self.priority)
+        self.adapter_id = int(self.adapter_id)
+        if self.sampling is not None:
+            # pin an unset seed NOW (fresh entropy per request): the
+            # request then replays/preempts/re-routes token-identically
+            self.sampling = self.sampling.materialized()
         self._arrival = next(_seq_counter)
         if not self.request_id:
             self.request_id = f"req-{next(_req_counter)}"
+        self._cstate = (None if self.constraint is None
+                        else self.constraint.initial())
 
     @property
     def finished(self) -> bool:
@@ -116,11 +132,61 @@ class Request:        # compare numpy prompt payloads
     def cancel(self) -> None:
         self._cancel = True
 
+    # ------------------------------------------------ constraint walker
+
+    def reset_constraint(self) -> None:
+        """Rebuild the walker state from the token journal (a journal-
+        seeded submit — gateway re-route — arrives with tokens the walker
+        never saw)."""
+        if self.constraint is None:
+            return
+        st = self.constraint.initial()
+        for t in self.tokens:
+            st = self.constraint.advance(st, int(t))
+        self._cstate = st
+        self._dead_ended = False
+
+    def advance_constraint(self, token: int) -> None:
+        if self.constraint is not None:
+            self._cstate = self.constraint.advance(self._cstate, int(token))
+
+    def allowed_mask(self) -> Optional[np.ndarray]:
+        """The walker's current allowed-vocab mask (None = unconstrained).
+        An empty mask — a dead-ended user DFA — is sanitized to
+        unconstrained, counted ONCE per dead-ending (the mask is polled
+        every emitted token — a per-call bump would make the dashboard
+        count tokens, not incidents)."""
+        if self.constraint is None:
+            return None
+        mask = self.constraint.allowed(self._cstate)
+        if mask is not None and not mask.any():
+            if not getattr(self, "_dead_ended", False):
+                self._dead_ended = True
+                metrics.bump("constrain.dead_ends")
+            return None
+        return mask
+
     def output_ids(self) -> np.ndarray:
         """prompt + generated tokens (the serving analog of generate()'s
         return, without the post-stop fill)."""
         return np.concatenate([self.prompt,
                                np.asarray(self.tokens, np.int32)])
+
+
+def admit_kwargs(req: Request) -> dict:
+    """The engine-admission keyword set derived from one request's
+    scenario state (sampling params, adapter id, the constraint walker's
+    CURRENT mask) — shared by the scheduler's admission paths and the
+    supervisor's journal replay so the two can never drift. Replay-safe
+    by construction: the walker state is a pure function of the journal,
+    and sampling PRNG keys are positional (``serving.sampling``).
+    ``spec_exclude`` tells the engine a CONSTRAINT exists even when its
+    current mask is None (unconstrained start): such a lane must never
+    take the speculative path, so its draft prefill/blocks are skipped
+    up front."""
+    return {"sampling": req.sampling, "adapter": req.adapter_id,
+            "mask": req.allowed_mask(),
+            "spec_exclude": req.constraint is not None}
 
 
 class Scheduler:
@@ -143,7 +209,8 @@ class Scheduler:
         """Enqueue (capacity errors surface immediately; overload shedding
         happens in ``api.submit`` where the queue-depth policy lives)."""
         self.engine.validate(int(request.prompt.shape[0]),
-                             int(request.max_new_tokens))
+                             int(request.max_new_tokens),
+                             adapter=request.adapter_id)
         request.state = RequestState.QUEUED
         self.waiting.append(request)
         metrics.bump("requests.submitted")
@@ -184,8 +251,25 @@ class Scheduler:
         req.done_event.set()
 
     def _emit(self, req: Request, token: int) -> None:
+        if req.finished:
+            return  # a walker failure mid-iteration already closed it
         req.tokens.append(int(token))
         req.stream_queue.put(int(token))
+        if req.constraint is not None:
+            # advance the host-side walker one token and scatter the new
+            # allowed-vocab row into the slot's mask (runtime data — the
+            # next decode step constrains under it, zero recompiles)
+            try:
+                req.advance_constraint(token)
+                if req.slot is not None:
+                    self.engine.set_slot_mask(req.slot, req.allowed_mask())
+            # analysis: allow(broad-except) — user-supplied walker code
+            # (Constraint is a public protocol): its failure — wrong-width
+            # mask, a raising advance() — fails THIS request, never the
+            # pump (an escaped exception would read as engine sickness
+            # and rebuild-loop the supervisor toward CrashLoopError)
+            except Exception as e:
+                self._finish(req, RequestState.FAILED, e)
 
     def _check_boundary(self, req: Request) -> bool:
         """Policy checks at a step boundary; True if the request ended."""
@@ -411,11 +495,13 @@ class Scheduler:
                     # context fits one chunk (plain admit) or stays in
                     # progress (first is None — one chunk per step)
                     slot, first = self.engine.admit_begin(
-                        req.prompt, req.max_new_tokens, tokens=req.tokens)
+                        req.prompt, req.max_new_tokens, tokens=req.tokens,
+                        **admit_kwargs(req))
                 else:
                     slot, first = self.engine.admit(req.prompt,
                                                     req.max_new_tokens,
-                                                    tokens=req.tokens)
+                                                    tokens=req.tokens,
+                                                    **admit_kwargs(req))
             # analysis: allow(broad-except) — classification inside:
             # transient engine sickness re-queues + re-raises for the
             # supervisor; anything else fails THIS request, not the pump

@@ -414,12 +414,46 @@ class SpecDecoder:
         positions / last-token state advance here; rejected speculation
         rolls back as pure position bookkeeping (``spec.rollback_tokens``)
         — stale KV is masked by position and overwritten next iteration,
-        so accept/reject never touches compiled code."""
+        so accept/reject never touches compiled code.
+
+        **Sampling/constraint/adapter compose rule**: speculation's
+        verify step is greedy-argmax over base weights with no vocab
+        mask, so a slot carrying non-greedy sampling, a constraint mask,
+        or a LoRA adapter (``engine.spec_ineligible()``) FALLS BACK to
+        the plain per-slot decode step for this iteration — one token,
+        sampled/masked/adapted exactly like a speculation-off engine,
+        never an off-distribution token. The two compiled calls cover
+        disjoint lane sets of the same arena; both are warm programs
+        (zero recompiles). Verifying against the sampled distribution is
+        follow-up work (docs/serving.md)."""
+        engine = self.engine
+        ineligible = engine.spec_ineligible()
+        act_spec = engine._active & ~ineligible
+        act_plain = engine._active & ineligible
+        out: Dict[int, List[int]] = {}
+        if act_spec.any():
+            out.update(self._spec_step(act_spec))
+        if act_plain.any():
+            # per-slot fallback: sampled/constrained/adapter lanes decode
+            # one plain (sampling-core) token through the classic step
+            metrics.bump("sampling.spec_fallback_slots",
+                         int(act_plain.sum()))
+            from ..core import resilience
+
+            resilience.bump("sampling.spec_fallbacks")
+            toks = engine.decode_step(active=act_plain)
+            for slot in np.flatnonzero(act_plain):
+                out[slot] = [int(toks[slot])]
+        return out
+
+    def _spec_step(self, act_spec: np.ndarray) -> Dict[int, List[int]]:
+        """The fused propose+verify dispatch over the speculation-eligible
+        lanes (``act_spec`` — greedy, unconstrained, adapter-0)."""
         import jax.numpy as jnp
 
         engine = self.engine
         k = self.k
-        active_slots = np.flatnonzero(engine._active)
+        active_slots = np.flatnonzero(act_spec)
         # per-lane speculation depth: writes this iteration reach position
         # pos+allow (target) / pos+allow-1 (draft), clamped so neither the
         # block reservation nor the model's position budget is overrun. A
@@ -450,7 +484,7 @@ class SpecDecoder:
                 fn, engine._arrays, self._d_arrays, engine.arena.pools,
                 engine.arena.ns_pools(self.NAMESPACE), engine._bt_dev,
                 self._bt_dev, jnp.asarray(engine._positions),
-                jnp.asarray(engine._last_tok), jnp.asarray(engine._active),
+                jnp.asarray(engine._last_tok), jnp.asarray(act_spec),
                 jnp.asarray(allow), name="serving.spec_step")
             engine.arena.set_pools(t_pools)
             engine.arena.set_ns_pools(self.NAMESPACE, d_pools)
@@ -460,7 +494,7 @@ class SpecDecoder:
             tgt, t_pools = engine._call(
                 fn, engine._arrays, engine.arena.pools, engine._bt_dev,
                 jnp.asarray(engine._positions),
-                jnp.asarray(engine._last_tok), jnp.asarray(engine._active),
+                jnp.asarray(engine._last_tok), jnp.asarray(act_spec),
                 jnp.asarray(allow), name="serving.spec_step")
             engine.arena.set_pools(t_pools)
             tgt = np.asarray(tgt)      # [S, k] fused greedy tokens

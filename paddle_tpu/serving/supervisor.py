@@ -48,7 +48,7 @@ from typing import List, Optional
 
 from ..core import flags, resilience
 from . import metrics
-from .scheduler import RequestState, _seq_counter
+from .scheduler import RequestState, _seq_counter, admit_kwargs
 
 
 class CrashLoopError(RuntimeError):
@@ -204,9 +204,15 @@ class EngineSupervisor:
             died_again: Optional[BaseException] = None
             for req in list(pending):
                 try:
+                    # admit_kwargs re-threads the request's sampling
+                    # params, adapter id and the constraint walker's
+                    # current mask: positional PRNG keys + journal-derived
+                    # walker state make the replayed stream bit-identical
+                    # to the uninterrupted one
                     slot, nxt = self.engine.admit(req.prompt,
                                                   req.max_new_tokens,
-                                                  tokens=req.tokens)
+                                                  tokens=req.tokens,
+                                                  **admit_kwargs(req))
                 # analysis: allow(broad-except) — classification inside:
                 # transient errors restage the replay, the rest fail one
                 # request each

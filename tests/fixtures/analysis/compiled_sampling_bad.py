@@ -1,0 +1,30 @@
+"""known-bad twin of the per-slot sampling pattern
+(serving.sampling.sample_tokens): every sampling parameter must stay
+traced ARRAY data. This one (1) branches on the traced per-slot top-k —
+``if top_k > 0`` inside a compiled step is traced-branch: the Python
+``if`` burns the first batch's truthiness into the executable (and
+forces a sync), so a batch mixing top-k-on and top-k-off slots silently
+decodes with one slot's setting; and (2) materializes the constraint's
+allowed set by boolean-mask indexing — ``logits[mask]`` has a
+data-dependent shape (shape-from-data), so every distinct mask pattern
+mints a new executable, the exact recompile-per-grammar-state the mask
+design exists to avoid."""
+import jax
+import jax.numpy as jnp
+
+
+def sample_step(logits, top_k, mask):
+    # BAD: python branch on a traced per-slot parameter — the first
+    # batch's top_k decides the program for every later batch
+    if top_k > 0:
+        kth = jnp.sort(logits)[-top_k]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    # BAD: data-dependent shape — the allowed-token count picks the
+    # result size, so each grammar state compiles its own program
+    allowed = logits[mask]
+    return jnp.argmax(logits), allowed.sum()
+
+
+def run(logits, top_k, mask):
+    step = jax.jit(sample_step)
+    return step(logits, top_k, mask)

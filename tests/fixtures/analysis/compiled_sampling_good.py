@@ -1,0 +1,24 @@
+"""known-good twin of the per-slot sampling pattern
+(serving.sampling.sample_tokens): top-k is applied with ``jnp.where``
+over the traced parameter (one program serves every per-slot mix, 0 = off
+expressed as data), and the constraint mask stays a mask — ``where`` over
+the static vocab shape, never boolean indexing — so grammar state changes
+are runtime data."""
+import jax
+import jax.numpy as jnp
+
+
+def sample_step(logits, top_k, mask):
+    # top-k as data: threshold at the clamped k-th largest, gate with
+    # where — slots with top_k == 0 keep every logit, same program
+    desc = jnp.sort(logits)[::-1]
+    kth = desc[jnp.maximum(top_k - 1, 0)]
+    logits = jnp.where((top_k > 0) & (logits < kth), -jnp.inf, logits)
+    # masking instead of boolean indexing: static shape, mask as data
+    allowed_sum = jnp.where(mask, logits, 0.0).sum()
+    return jnp.argmax(logits), allowed_sum
+
+
+def run(logits, top_k, mask):
+    step = jax.jit(sample_step)
+    return step(logits, top_k, mask)

@@ -804,7 +804,7 @@ def test_predictor_priority_kwarg_and_close_summary(model, monkeypatch,
     seen = []
 
     def fake_submit(prompt, max_new_tokens=32, stop_token_id=None,
-                    priority=0):
+                    priority=0, sampling=None, adapter=0):
         seen.append(priority)
         r = Request(prompt, max_new_tokens=max_new_tokens, priority=priority)
         r.state = RequestState.FINISHED

@@ -72,6 +72,11 @@ FIXTURE_CASES = [
     # engine._scatter_rows must stay all-array math)
     ("traced-cast", "compiled_quant", ()),
     ("shape-from-data", "compiled_quant", ()),
+    # the ISSUE 12 per-slot sampling shape: traced branch on a per-slot
+    # top-k and data-dependent constraint-mask indexing
+    # (serving.sampling.sample_tokens must stay all-array math)
+    ("traced-branch", "compiled_sampling", ()),
+    ("shape-from-data", "compiled_sampling", ()),
     ("undefined-flag", "registry_flags",
      ("paddle_tpu/core/flags.py",)),
     ("unknown-metric-key", "registry_metrics",
@@ -112,6 +117,10 @@ def test_bad_fixtures_are_specific():
             # deliberately seeds BOTH dequant hazards: host-cast scale +
             # data-dependent support
             allowed |= {"traced-cast", "shape-from-data"}
+        if stem == "compiled_sampling":
+            # deliberately seeds BOTH sampling hazards: traced top-k
+            # branch + data-dependent mask shape
+            allowed |= {"traced-branch", "shape-from-data"}
         assert rules <= allowed, (stem, rules)
 
 

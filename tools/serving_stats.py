@@ -36,6 +36,14 @@ mode gauges (``quant.weights`` / ``quant.kv`` / ``quant.draft`` /
 ``quant.draft_acceptance``) and the per-namespace arena byte gauges
 (``arena.kv_bytes`` / ``arena.scale_bytes`` / ``arena.bytes.<ns>`` /
 ``arena.dtype.<ns>``) — the int8 memory win, observable per run.
+Scenario diversity (ISSUE 12) adds per-slot sampling
+(``sampling.admits`` / ``sampling.spec_fallback_slots``), constrained
+decoding (``constrain.admits`` / ``constrain.mask_updates`` /
+``constrain.dead_ends``), and the multi-LoRA arena (``lora.registered`` /
+``lora.admits``, plus the end-of-run ``lora.slots`` / ``lora.live`` /
+``lora.arena_bytes`` and per-scenario ``*.active_slots`` gauges);
+``FLAGS_serving_lora_rank`` / ``FLAGS_serving_lora_adapters`` size the
+arena in config mode.
 The multi-tenant gateway's counters ride it too (``serving.gateway``):
 ``gateway.routed`` / ``gateway.rerouted`` (journaled fail-over) /
 ``gateway.ejected`` / ``gateway.respawned`` (replica health) /
@@ -96,6 +104,9 @@ def _config_report() -> dict:
         "serving_quant_weights": _flag_env("serving_quant_weights", 0),
         "serving_quant_kv": _flag_env("serving_quant_kv", 0),
         "serving_quant_draft": _flag_env("serving_quant_draft", 0),
+        # multi-LoRA adapter arena (serving.adapters; 0 rank = off)
+        "serving_lora_rank": _flag_env("serving_lora_rank", 0),
+        "serving_lora_adapters": _flag_env("serving_lora_adapters", 4),
         # multi-tenant gateway (serving.gateway: router/tenancy/front door)
         "serving_replicas": _flag_env("serving_replicas", 2),
         "gateway_port": _flag_env("gateway_port", 8100),
@@ -154,7 +165,8 @@ def main(argv=None) -> int:
         gauges = {k: v for k, v in metrics.gauges().items()
                   if k.split(".")[0] in ("arena", "prefix", "slots",
                                          "spec", "queue", "quant",
-                                         "gateway", "tenant")}
+                                         "gateway", "tenant", "sampling",
+                                         "constrain", "lora")}
         rec = {"wall_secs": round(wall, 3), "stats": delta,
                "gauges": gauges,
                "tokens_per_sec": round(toks / wall, 2) if wall > 0 else None}
