@@ -157,6 +157,24 @@ def main():
                                   in best_by_shape.items()}}, f)
         os.replace(tmp, path)
         print(f"[flash-tune] wrote {path}", flush=True)
+        # mirror the winners into the SHARED kernel-tuning store
+        # (ops.tuning — per-(kernel, chip, shape-bucket), the store every
+        # Pallas kernel reads first; FLASH_TUNED.json above stays as the
+        # legacy fallback for pre-store checkouts)
+        from paddle_tpu.ops import tuning
+
+        persisted = sum(
+            tuning.adopt("flash_fwd", tuning.bucket_key(s=s),
+                         {"blk_q": bq, "blk_k": bk}, t * 1e6)
+            for s, (t, bq, bk) in best_by_shape.items())
+        if persisted == len(best_by_shape):
+            print(f"[flash-tune] adopted {persisted} records into "
+                  f"{tuning.store_path()}", flush=True)
+        else:
+            print(f"[flash-tune] WARNING: only {persisted}/"
+                  f"{len(best_by_shape)} records persisted to "
+                  f"{tuning.store_path()} (write failed — the store is "
+                  "NOT published)", flush=True)
     wd.cancel()
 
 

@@ -72,6 +72,11 @@ DOCUMENTED_NAMESPACES = (
     # spec-decode fallbacks, constraint-walker anomalies, LoRA adapter
     # lifecycle — mirrored here so the resilience dashboards see them
     "sampling", "constrain", "lora",
+    # Pallas paged-attention serving kernels (ISSUE 13,
+    # ops.paged_attention / docs/performance.md): trace/tuning telemetry
+    # lives in serving.metrics; this entry reserves the namespace so the
+    # resilience dashboards can mirror kernel fallbacks and tune state
+    "kernel",
 )
 
 

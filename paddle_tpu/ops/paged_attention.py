@@ -1,0 +1,359 @@
+"""Pallas paged-attention kernels over KV-arena block tables.
+
+The serving engine's XLA path pays a *gather tax* on every decode step:
+``engine._gather_ctx`` materializes each lane's whole logical context
+(``kp[table]`` — ``[S, max_blocks*block_size, H, D]`` of mostly-masked
+rows, dequantized from int8 first when the arena is quantized) before
+``masked_attention`` reads a single useful element. These kernels read
+K/V **directly through the block tables instead**: the table rides as a
+scalar-prefetch operand and each grid step's BlockSpec ``index_map``
+resolves one logical block to its physical pool row, so HBM traffic is
+the pool blocks themselves — no contiguous copy, no f32 materialization
+of an int8 arena (per-block scales stream alongside the payload and
+dequantize in VMEM via the one
+:func:`paddle_tpu.quantization.dequantize_kv` home).
+
+Two kernels, same online-softmax core as the training flash kernel
+(:mod:`paddle_tpu.ops.pallas_ops`):
+
+* :func:`paged_decode_attention` — one new token per slot. Grid
+  ``(slots, head-groups, logical blocks)``; each lane's ``positions``
+  entry masks keys past its own context (``start_pos`` semantics of
+  ``engine._PagedCacheView``), and whole blocks past the position are
+  predicated off with ``pl.when``.
+* :func:`paged_prefill_attention` — a suffix/chunk of queries for ONE
+  slot against its table (the ``engine._PrefixPrefillView`` contract):
+  query ``i`` sits at global position ``prefix_len + i`` and attends
+  keys at global index ``<= prefix_len + i``. ``prefix_len`` is runtime
+  data (scalar prefetch), so every chunk of every admission reuses one
+  compiled program per suffix bucket.
+
+Block tables, positions and prefix lengths are *runtime data*
+(scalar-prefetch operands): admit/retire/accept/reject churn never
+recompiles — the same invariant the XLA path holds. Launch parameters
+(``block_h`` head grouping, ``block_q`` query tiling) come from the
+shared per-(kernel, chip, shape-bucket) tuning store
+(:mod:`paddle_tpu.ops.tuning`); absent a record the safe defaults run.
+
+Numerics: the online softmax is mathematically identical to the gather
+path's full-width softmax but associates differently, so parity is
+*tolerance*, not bitwise — see docs/performance.md ("Paged attention
+kernels") for the documented bound and the greedy token-parity gate.
+Off-TPU the kernels run in the Pallas interpreter
+(:func:`~paddle_tpu.ops.pallas_ops._use_interpret`), so tier-1 exercises
+this exact code path on the CPU mesh.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .pallas_ops import (NEG_INF, _HAS_PALLAS, _LANES, _compiler_params,
+                         _use_interpret)
+
+if _HAS_PALLAS:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["available", "paged_decode_attention", "paged_prefill_attention"]
+
+
+def available() -> bool:
+    """Whether the paged kernels can run here (Pallas importable with
+    scalar-prefetch support). The engine checks ONCE at construction and
+    falls back to the XLA gather path with a warning — never a traced
+    branch."""
+    return _HAS_PALLAS and hasattr(pltpu, "PrefetchScalarGridSpec")
+
+
+def _head_group(num_heads: int, block_h) -> int:
+    """Clamp a (tuned) head-group size to a divisor of ``num_heads``.
+    Default: all heads in one grid step (fewest steps — the right call
+    for small pools and the interpreter; a chip tune may prefer smaller
+    groups to fit VMEM at large head_dim)."""
+    g = int(block_h) if block_h else num_heads
+    g = max(1, min(g, num_heads))
+    while num_heads % g:
+        g -= 1
+    return g
+
+
+def _query_block(sq: int, block_q) -> int:
+    """Clamp a (tuned) query tile to a divisor of the (bucketed) suffix
+    length."""
+    b = int(block_q) if block_q else min(sq, 128)
+    b = max(1, min(b, sq))
+    while sq % b:
+        b -= 1
+    return b
+
+
+def _deq(block, scale_row, dtype):
+    """In-VMEM dequant of one pool block ``[bs, ...,]`` through its
+    per-row scales — the same
+    :func:`paddle_tpu.quantization.dequantize_kv` math the XLA fallback
+    uses (f32 multiply, one cast), applied to one block instead of the
+    whole gathered context."""
+    from ..quantization import dequantize_kv
+
+    return dequantize_kv(block, scale_row, dtype)
+
+
+# ---------------------------------------------------------------- decode
+
+
+def _decode_kernel(bt_ref, pos_ref, q_ref, k_ref, v_ref, *rest, bs, blk_h,
+                   scale, quantized):
+    """One (slot, head-group, logical-block) step: online softmax of the
+    slot's single query against one physical KV block, masked to keys at
+    global index ``<= positions[slot]``."""
+    if quantized:
+        ks_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        (o_ref, m_scr, l_scr, acc_scr), ks_ref, vs_ref = rest, None, None
+    s = pl.program_id(0)
+    j = pl.program_id(2)
+    nj = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    pos = pos_ref[s]
+
+    # whole blocks past the lane's position contribute nothing — skip the
+    # math (the masked-lane/garbage-query cases still produce finite
+    # output: key 0 is always <= pos, so the denominator never zeroes)
+    @pl.when(j * bs <= pos)
+    def _step():
+        q = q_ref[0]  # [blk_h, D]
+        k = k_ref[0]  # [bs, blk_h, D]
+        v = v_ref[0]
+        if quantized:
+            k = _deq(k, ks_ref[0], q.dtype)
+            v = _deq(v, vs_ref[0], q.dtype)
+        sc = jax.lax.dot_general(  # [blk_h, bs], heads batched
+            q, k, (((1,), (2,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32) * scale
+        gk = j * bs + jax.lax.broadcasted_iota(jnp.int32, (1, bs), 1)
+        sc = jnp.where(gk <= pos, sc, NEG_INF)
+        m_prev = m_scr[:, 0:1]
+        m_new = jnp.maximum(m_prev, jnp.max(sc, axis=1, keepdims=True))
+        p = jnp.exp(sc - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        l_new = corr * l_scr[:, 0:1] + jnp.sum(p, axis=1, keepdims=True)
+        pv = jax.lax.dot_general(  # [blk_h, D]
+            p.astype(v.dtype), v, (((1,), (0,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32)
+        acc_scr[:] = acc_scr[:] * corr + pv
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(j == nj - 1)
+    def _fin():
+        denom = l_scr[:, 0:1]
+        denom = jnp.where(denom == 0.0, 1.0, denom)
+        o_ref[0] = (acc_scr[:] / denom).astype(o_ref.dtype)
+
+
+def paged_decode_attention(q, entry, block_tables, positions,
+                           block_h=None):
+    """Decode attention straight through the block tables.
+
+    ``q`` is ``[S, H, D]`` (each slot's new token, heads unflattened);
+    ``entry`` is one layer's whole arena pool entry — ``(k, v)`` pools
+    shaped ``[num_blocks, block_size, H, D]``, or int8
+    ``(k, v, k_scale, v_scale)`` with ``[num_blocks, block_size]`` scale
+    pools (dequantized in-kernel — the f32 full-width context of the
+    gather path is never materialized). ``block_tables`` is ``[S, MB]``
+    int32, ``positions`` ``[S]`` int32 (the new token's write position —
+    keys at global index ``<= positions[s]`` are attended, matching
+    ``masked_attention``'s mask in ``_PagedCacheView``). Returns
+    ``[S, H, D]`` in ``q.dtype``. All table/position operands are
+    runtime data: one compiled program serves every churn pattern."""
+    S, H, D = q.shape
+    quantized = len(entry) == 4
+    kp, vp = entry[0], entry[1]
+    bs = kp.shape[1]
+    MB = block_tables.shape[1]
+    if block_h is None:
+        from . import tuning
+
+        rec = tuning.lookup("paged_decode",
+                            tuning.bucket_key(h=H, d=D, bs=bs, mb=MB))
+        block_h = rec.get("block_h") if rec else None
+    blk_h = _head_group(H, block_h)
+    grid = (S, H // blk_h, MB)
+    kern = functools.partial(_decode_kernel, bs=bs, blk_h=blk_h,
+                             scale=1.0 / math.sqrt(D), quantized=quantized)
+    in_specs = [
+        pl.BlockSpec((1, blk_h, D), lambda s, g, j, bt, pos: (s, g, 0)),
+        pl.BlockSpec((1, bs, blk_h, D),
+                     lambda s, g, j, bt, pos: (bt[s, j], 0, g, 0)),
+        pl.BlockSpec((1, bs, blk_h, D),
+                     lambda s, g, j, bt, pos: (bt[s, j], 0, g, 0)),
+    ]
+    args = [block_tables, positions, q, kp, vp]
+    if quantized:
+        in_specs += [
+            pl.BlockSpec((1, bs), lambda s, g, j, bt, pos: (bt[s, j], 0)),
+            pl.BlockSpec((1, bs), lambda s, g, j, bt, pos: (bt[s, j], 0)),
+        ]
+        args += [entry[2], entry[3]]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, blk_h, D),
+                               lambda s, g, j, bt, pos: (s, g, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((blk_h, _LANES), jnp.float32),  # running max
+            pltpu.VMEM((blk_h, _LANES), jnp.float32),  # running denom
+            pltpu.VMEM((blk_h, D), jnp.float32),       # out accumulator
+        ],
+    )
+    return pl.pallas_call(
+        kern,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((S, H, D), q.dtype),
+        compiler_params=_compiler_params(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=_use_interpret(),
+    )(*args)
+
+
+# --------------------------------------------------------------- prefill
+
+
+def _prefill_kernel(bt_ref, meta_ref, q_ref, k_ref, v_ref, *rest, bs,
+                    blk_q, blk_h, scale, quantized):
+    """One (head-group, query-tile, logical-block) step of suffix/chunk
+    prefill: flash-style causal attention at global positions
+    ``prefix_len + i`` (``meta_ref[0]`` = the runtime prefix length)."""
+    if quantized:
+        ks_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        (o_ref, m_scr, l_scr, acc_scr), ks_ref, vs_ref = rest, None, None
+    qi = pl.program_id(1)
+    j = pl.program_id(2)
+    nj = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    prefix = meta_ref[0]
+
+    # a block strictly past this tile's last global row is fully masked
+    @pl.when(j * bs <= prefix + (qi + 1) * blk_q - 1)
+    def _step():
+        q = q_ref[:]  # [blk_h, blk_q, D] (head-major — see the wrapper)
+        k = k_ref[0]  # [bs, blk_h, D]
+        v = v_ref[0]
+        if quantized:
+            k = _deq(k, ks_ref[0], q.dtype)
+            v = _deq(v, vs_ref[0], q.dtype)
+        sc = jax.lax.dot_general(  # [blk_h, blk_q, bs]
+            q, k, (((2,), (2,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32) * scale
+        rows = prefix + qi * blk_q + jax.lax.broadcasted_iota(
+            jnp.int32, (1, blk_q, bs), 1)
+        cols = j * bs + jax.lax.broadcasted_iota(
+            jnp.int32, (1, blk_q, bs), 2)
+        sc = jnp.where(cols <= rows, sc, NEG_INF)
+        m_prev = m_scr[:, :, 0:1]
+        m_new = jnp.maximum(m_prev, jnp.max(sc, axis=2, keepdims=True))
+        p = jnp.exp(sc - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        l_new = corr * l_scr[:, :, 0:1] + jnp.sum(p, axis=2, keepdims=True)
+        pv = jax.lax.dot_general(  # [blk_h, blk_q, D]
+            p.astype(v.dtype), v, (((2,), (0,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32)
+        acc_scr[:] = acc_scr[:] * corr + pv
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(j == nj - 1)
+    def _fin():
+        denom = l_scr[:, :, 0:1]
+        denom = jnp.where(denom == 0.0, 1.0, denom)
+        o_ref[:] = (acc_scr[:] / denom).astype(o_ref.dtype)
+
+
+def paged_prefill_attention(q, entry, bt_row, prefix_len,
+                            block_q=None, block_h=None):
+    """Suffix/chunk prefill attention for ONE slot through its table.
+
+    ``q`` is ``[sq, H, D]`` (the padded suffix bucket — padded rows
+    produce garbage the caller discards, exactly like the XLA path);
+    ``bt_row`` is ``[MB]`` int32, ``prefix_len`` a (traced) scalar: query
+    ``i`` attends keys at global index ``<= prefix_len + i``, the
+    ``_PrefixPrefillView`` mask verbatim. The suffix's own K/V must
+    already be scattered into the pools (same call order as the XLA
+    path: scatter, then attend). Returns ``[sq, H, D]``."""
+    sq, H, D = q.shape
+    quantized = len(entry) == 4
+    kp, vp = entry[0], entry[1]
+    bs = kp.shape[1]
+    MB = bt_row.shape[0]
+    if block_q is None and block_h is None:
+        from . import tuning
+
+        rec = tuning.lookup(
+            "paged_prefill",
+            tuning.bucket_key(sq=sq, h=H, d=D, bs=bs, mb=MB))
+        if rec:
+            block_q, block_h = rec.get("block_q"), rec.get("block_h")
+    blk_q = _query_block(sq, block_q)
+    blk_h = _head_group(H, block_h)
+    grid = (H // blk_h, sq // blk_q, MB)
+    kern = functools.partial(_prefill_kernel, bs=bs, blk_q=blk_q,
+                             blk_h=blk_h, scale=1.0 / math.sqrt(D),
+                             quantized=quantized)
+    # head-major query/output layout so neither the kernel nor Mosaic
+    # transposes inside VMEM; the swapaxes below stay in XLA
+    q_hm = jnp.swapaxes(q, 0, 1)  # [H, sq, D]
+    in_specs = [
+        pl.BlockSpec((blk_h, blk_q, D),
+                     lambda g, qi, j, bt, meta: (g, qi, 0)),
+        pl.BlockSpec((1, bs, blk_h, D),
+                     lambda g, qi, j, bt, meta: (bt[j], 0, g, 0)),
+        pl.BlockSpec((1, bs, blk_h, D),
+                     lambda g, qi, j, bt, meta: (bt[j], 0, g, 0)),
+    ]
+    args = [bt_row, jnp.reshape(jnp.asarray(prefix_len, jnp.int32), (1,)),
+            q_hm, kp, vp]
+    if quantized:
+        in_specs += [
+            pl.BlockSpec((1, bs), lambda g, qi, j, bt, meta: (bt[j], 0)),
+            pl.BlockSpec((1, bs), lambda g, qi, j, bt, meta: (bt[j], 0)),
+        ]
+        args += [entry[2], entry[3]]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((blk_h, blk_q, D),
+                               lambda g, qi, j, bt, meta: (g, qi, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((blk_h, blk_q, _LANES), jnp.float32),
+            pltpu.VMEM((blk_h, blk_q, _LANES), jnp.float32),
+            pltpu.VMEM((blk_h, blk_q, D), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kern,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((H, sq, D), q.dtype),
+        compiler_params=_compiler_params(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=_use_interpret(),
+    )(*args)
+    return jnp.swapaxes(out, 0, 1)

@@ -19,7 +19,7 @@ from __future__ import annotations
 
 import functools
 import math
-from typing import Optional
+from typing import Dict, Optional
 
 import jax
 import jax.numpy as jnp
@@ -37,14 +37,42 @@ NEG_INF = -1e30
 _LANES = 128  # residuals (lse, delta) are stored lane-broadcast [.., s, 128]
 
 
+def _compiler_params(**kw):
+    """Version-portable Mosaic compiler params: newer jax names the class
+    ``pltpu.CompilerParams``, 0.4.x ``pltpu.TPUCompilerParams`` (same
+    kwargs). Every pallas_call in the tree builds its params here."""
+    cls = getattr(pltpu, "CompilerParams", None) \
+        or getattr(pltpu, "TPUCompilerParams")
+    return cls(**kw)
+
+
+#: memoized _use_interpret() answer, resolved once per backend at module
+#: level: the default backend is fixed for a process's lifetime
+#: (JAX_PLATFORMS), and the probe (jax.default_backend() resolves the
+#: backend registry) used to re-run inside every pallas_call trace — three
+#: call sites here alone, plus every paged kernel. Maps {backend: bool};
+#: clear it (tests only) after swapping platforms mid-process.
+_INTERPRET_MEMO: Dict[str, bool] = {}
+
+
 def _use_interpret() -> bool:
     """Run kernels in the Pallas interpreter off-TPU (CPU test mesh): the CPU
     backend has no Mosaic lowering, and remote-compile plugins would otherwise
-    try to ship 'cpu' pallas calls to the accelerator compile service."""
+    try to ship 'cpu' pallas calls to the accelerator compile service.
+    Memoized per backend at module level (``_INTERPRET_MEMO``) — the backend
+    probe runs once per process, not once per kernel trace."""
+    if len(_INTERPRET_MEMO) == 1:
+        # fast path: the process has one resolved backend (always, outside
+        # platform-swapping tests — those clear the memo)
+        return next(iter(_INTERPRET_MEMO.values()))
     try:
-        return jax.default_backend() != "tpu"
+        backend = jax.default_backend()
     except Exception:  # pragma: no cover
-        return True
+        return True  # never memoize a failed probe
+    hit = _INTERPRET_MEMO.get(backend)
+    if hit is None:
+        hit = _INTERPRET_MEMO[backend] = backend != "tpu"
+    return hit
 
 
 def _attention_reference(q, k, v, scale, causal):
@@ -160,7 +188,7 @@ def _flash_forward(q, k, v, scale, causal, blk_q=128, blk_k=128,
             pltpu.VMEM((blk_q, _LANES), jnp.float32),  # running denom
             pltpu.VMEM((blk_q, d), jnp.float32),  # output accumulator
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=_use_interpret(),
@@ -290,7 +318,7 @@ def _flash_backward(q, k, v, o, lse, do, scale, causal, blk_q=128, blk_k=128):
                    jax.ShapeDtypeStruct((bh, sk, d), v.dtype)],
         scratch_shapes=[pltpu.VMEM((blk_k, d), jnp.float32),
                         pltpu.VMEM((blk_k, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=_use_interpret(),
@@ -308,7 +336,7 @@ def _flash_backward(q, k, v, o, lse, do, scale, causal, blk_q=128, blk_k=128):
         out_specs=q_spec_q,
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((blk_q, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=_use_interpret(),
@@ -375,10 +403,19 @@ _TUNED_PATH = None  # test override for the FLASH_TUNED.json location
 
 
 def _tuned_blocks(seq):
-    """Per-seqlen best tiling measured on-chip by benches/flash_tune.py
-    (FLASH_TUNED.json, written only from candidates that passed the
-    numerics check). Nearest measured seqlen wins; {} when no tune has
-    ever run (fresh checkout / installed wheel)."""
+    """Per-seqlen best tiling measured on-chip by benches/flash_tune.py.
+    The shared kernel-tuning store (:mod:`paddle_tpu.ops.tuning`, kernel
+    ``"flash_fwd"``, bucketed by seqlen, device-kind gated) is consulted
+    first; the legacy FLASH_TUNED.json record (written only from
+    candidates that passed the numerics check) remains the fallback so a
+    pre-store tune keeps winning. Nearest measured seqlen wins within the
+    legacy record; {} when no tune has ever run (fresh checkout /
+    installed wheel)."""
+    from . import tuning
+
+    rec = tuning.lookup("flash_fwd", tuning.bucket_key(s=seq))
+    if rec and "blk_q" in rec and "blk_k" in rec:
+        return int(rec["blk_q"]), int(rec["blk_k"])
     global _TUNED_BLOCKS
     if _TUNED_BLOCKS is None:
         import json

@@ -111,7 +111,17 @@ def dequantize_kv(q, scale, dtype):
     """Inverse of :func:`quantize_kv` (dequant-on-attend): int8 payload *
     per-token scale, cast to the attention compute ``dtype``. The f32
     multiply happens before the cast so a bf16 compute dtype rounds once,
-    not twice."""
+    not twice.
+
+    This is the ONE home of the dequant math: the XLA gather path calls
+    it over gathered context (per block when the compute dtype is
+    narrower than f32 — ``engine._gather_ctx``), and the Pallas paged
+    kernels (:mod:`paddle_tpu.ops.paged_attention`) call it inside the
+    kernel body on one VMEM-resident block at a time with its ``[bs]``
+    scale rows — the broadcast over the trailing ``(heads, dim)`` axes is
+    the same either way, so the fused path can never drift from the
+    fallback's numbers by more than the documented softmax-association
+    tolerance (docs/performance.md)."""
     return (q.astype(jnp.float32) * scale[..., None, None]).astype(dtype)
 
 

@@ -112,15 +112,39 @@ def _gather_ctx(entry, table, dtype):
     ``table`` is ``[..., max_blocks]`` int32; returns ``(k_all, v_all)``
     shaped ``[..., max_blocks*block_size, heads, dim]``. Int8 entries
     dequantize-on-attend through their per-row scales in f32 before the
-    cast to the attention compute ``dtype``."""
+    cast to the attention compute ``dtype`` — per table ROW (``lax.map``
+    over the lanes) when the compute dtype is narrower than f32, so the
+    f32 intermediate is one lane's context, never a second full-width
+    copy of the whole batch's context (which used to double peak context
+    bytes on the quantized fallback path). One lane per map step keeps
+    the within-lane dequant fully vectorized — the loop is S iterations,
+    not S*max_blocks. Per-element math is identical either way (one f32
+    multiply, one cast), so the output is bitwise unchanged."""
     kp, vp = entry[0], entry[1]
-    k_all = kp[table]
-    v_all = vp[table]  # [..., mb, bs, H, D]
     if len(entry) == 4:
+        import jax
+        import jax.numpy as jnp
+
         from ..quantization import dequantize_kv
 
-        k_all = dequantize_kv(k_all, entry[2][table], dtype)
-        v_all = dequantize_kv(v_all, entry[3][table], dtype)
+        ks, vs = entry[2], entry[3]
+        if jnp.dtype(dtype).itemsize >= 4:
+            # f32 compute: the dequant output IS the f32 buffer — nothing
+            # to save by chunking
+            k_all = dequantize_kv(kp[table], ks[table], dtype)
+            v_all = dequantize_kv(vp[table], vs[table], dtype)
+        else:
+            def _deq_lane(row):  # row: one lane's [max_blocks] table
+                return (dequantize_kv(kp[row], ks[row], dtype),
+                        dequantize_kv(vp[row], vs[row], dtype))
+
+            lanes = table.reshape(-1, table.shape[-1])
+            k_all, v_all = jax.lax.map(_deq_lane, lanes)
+            k_all = k_all.reshape(table.shape + kp.shape[1:])
+            v_all = v_all.reshape(table.shape + vp.shape[1:])
+    else:
+        k_all = kp[table]
+        v_all = vp[table]  # [..., mb, bs, H, D]
     shp = k_all.shape
     out_shape = shp[:-4] + (shp[-4] * shp[-3],) + shp[-2:]
     return k_all.reshape(out_shape), v_all.reshape(out_shape)
@@ -133,15 +157,27 @@ class _PagedCacheView:
     attend under the per-lane position mask. ``entry`` is the layer's
     whole arena pool entry — ``(k, v)`` or, with ``FLAGS_serving_quant_kv``,
     ``(k, v, k_scale, v_scale)`` (quantize-on-scatter / dequant-on-attend
-    via :func:`_scatter_rows` / :func:`_gather_ctx`)."""
+    via :func:`_scatter_rows` / :func:`_gather_ctx`).
+
+    With ``kernel=True`` (``FLAGS_serving_paged_kernel``, captured at
+    engine construction like the quant/donation flags) the attend side
+    routes through the Pallas paged-decode kernel
+    (:func:`paddle_tpu.ops.paged_attention.paged_decode_attention`):
+    K/V are read directly through the block table — no gather into a
+    contiguous ``[S, max_blocks*bs, H, D]`` buffer, int8 dequant fused
+    in-kernel. The scatter of the new token stays in XLA either way
+    (one row per lane — there is no gather to kill there). ``kernel`` is
+    trace-time *structure*: toggling it is a different engine build,
+    never a mid-run branch."""
 
     def __init__(self, entry, block_tables, positions, active,
-                 block_size: int):
+                 block_size: int, kernel: bool = False):
         self.entry = entry
         self.block_tables = block_tables  # [S, max_blocks] int32
         self.positions = positions        # [S] int32: write pos of new token
         self.active = active              # [S] bool
         self.block_size = block_size
+        self.kernel = kernel
 
     def update_and_attend(self, q, k, v):
         import jax.numpy as jnp
@@ -159,13 +195,21 @@ class _PagedCacheView:
         row = jnp.where(self.active, row, 0)
         off = pos % bs
         entry = _scatter_rows(self.entry, row, off, ka[:, 0], va[:, 0])
-        # gather each lane's logical context [S, max_blocks*bs, H, D]
-        t_len = self.block_tables.shape[1] * bs
-        k_all, v_all = _gather_ctx(entry, self.block_tables, qa.dtype)
-        mask = (jnp.arange(t_len)[None, :] <= pos[:, None])[:, None, None, :]
-        o = masked_attention(qa, k_all, v_all, mask)
+        if self.kernel:
+            from ..ops.paged_attention import paged_decode_attention
+
+            o = paged_decode_attention(qa[:, 0], entry,
+                                       self.block_tables, pos)[:, None]
+        else:
+            # gather each lane's logical context [S, max_blocks*bs, H, D]
+            t_len = self.block_tables.shape[1] * bs
+            k_all, v_all = _gather_ctx(entry, self.block_tables, qa.dtype)
+            mask = (jnp.arange(t_len)[None, :]
+                    <= pos[:, None])[:, None, None, :]
+            o = masked_attention(qa, k_all, v_all, mask)
         new = _PagedCacheView(entry, self.block_tables,
-                              self.positions, self.active, bs)
+                              self.positions, self.active, bs,
+                              kernel=self.kernel)
         return o, new
 
 
@@ -195,15 +239,24 @@ class _PrefixPrefillView:
     against the full gathered context — prefix blocks are read, never
     recomputed. ``prefix_len`` is a traced scalar and the table is runtime
     int32 data, so every (cache hit, prefix length) reuses ONE compiled
-    program per suffix-length bucket."""
+    program per suffix-length bucket.
+
+    With ``kernel=True`` the attend side routes through the Pallas
+    chunked-prefill kernel
+    (:func:`paddle_tpu.ops.paged_attention.paged_prefill_attention`) —
+    same scatter-then-attend order, same global-position mask, but the
+    resident prefix is streamed block-by-block through the table instead
+    of gathered into a contiguous buffer. Chunked prefill rides this
+    view, so every chunk of a long admission skips the gather too."""
 
     def __init__(self, entry, bt_row, prefix_len, true_len,
-                 block_size: int):
+                 block_size: int, kernel: bool = False):
         self.entry = entry            # the layer's whole arena pool entry
         self.bt_row = bt_row          # [max_blocks] int32: the slot's table
         self.prefix_len = prefix_len  # scalar int32: resident context length
         self.true_len = true_len      # scalar int32: real (unpadded) suffix
         self.block_size = block_size
+        self.kernel = kernel
 
     def update_and_attend(self, q, k, v):
         import jax.numpy as jnp
@@ -222,13 +275,20 @@ class _PrefixPrefillView:
         row = jnp.where(p_idx < self.true_len, self.bt_row[bi], 0)
         off = gpos % bs
         entry = _scatter_rows(self.entry, row, off, ka[0], va[0])
-        t_len = self.bt_row.shape[0] * bs
-        k_all, v_all = _gather_ctx(entry, self.bt_row, qa.dtype)
-        k_all, v_all = k_all[None], v_all[None]
-        mask = (jnp.arange(t_len)[None, :] <= gpos[:, None])[None, None]
-        o = masked_attention(qa, k_all, v_all, mask)
+        if self.kernel:
+            from ..ops.paged_attention import paged_prefill_attention
+
+            o = paged_prefill_attention(qa[0], entry, self.bt_row,
+                                        self.prefix_len)[None]
+        else:
+            t_len = self.bt_row.shape[0] * bs
+            k_all, v_all = _gather_ctx(entry, self.bt_row, qa.dtype)
+            k_all, v_all = k_all[None], v_all[None]
+            mask = (jnp.arange(t_len)[None, :] <= gpos[:, None])[None, None]
+            o = masked_attention(qa, k_all, v_all, mask)
         new = _PrefixPrefillView(entry, self.bt_row,
-                                 self.prefix_len, self.true_len, bs)
+                                 self.prefix_len, self.true_len, bs,
+                                 kernel=self.kernel)
         return o, new
 
 
@@ -288,6 +348,14 @@ class ServingConfig:
     # identity (base weights, token-identical to an arena-less engine).
     lora_rank: Optional[int] = None
     lora_adapters: Optional[int] = None
+    # Pallas paged-attention kernels (None defers to
+    # FLAGS_serving_paged_kernel; default off = the XLA gather path,
+    # bit-preserved). Captured at construction like the quant trio —
+    # part of the engine's program key: toggling builds fresh
+    # executables whose decode/suffix-prefill attention reads K/V
+    # directly through the block tables (ops.paged_attention) instead
+    # of gathering the context into contiguous buffers.
+    paged_kernel: Optional[bool] = None
 
 
 @dataclass
@@ -387,6 +455,20 @@ class ServingEngine:
                                       or flags.flag("serving_prefill_bucket_min"))
         self.donate = (bool(flags.flag("decode_donate"))
                        if cfg.donate is None else bool(cfg.donate))
+        self.paged_kernel = (bool(flags.flag("serving_paged_kernel"))
+                             if cfg.paged_kernel is None
+                             else bool(cfg.paged_kernel))
+        if self.paged_kernel:
+            from ..ops import paged_attention
+
+            if not paged_attention.available():
+                # resolved ONCE here, never a traced branch: without
+                # Pallas scalar-prefetch support the engine serves the
+                # (numerically equivalent) XLA gather path instead
+                warnings.warn("FLAGS_serving_paged_kernel requested but "
+                              "Pallas scalar-prefetch is unavailable; "
+                              "falling back to the XLA gather path")
+                self.paged_kernel = False
         self._retry = cfg.retry_policy
         if self._retry is None and not self.donate:
             self._retry = resilience.io_policy()
@@ -479,6 +561,14 @@ class ServingEngine:
                      if spec_k > 0 else None)
         self._meter = metrics.Meter()  # lifetime aggregate tokens/s gauge
         metrics.set_gauge("slots.total", s)
+        metrics.set_gauge("kernel.paged", int(self.paged_kernel))
+        if self.paged_kernel:
+            from ..ops import tuning as kernel_tuning
+
+            # the tuning store's coverage for this chip, next to the mode
+            # gauge: a chip with 0 entries runs the safe default launch
+            # params until a tune bench adopts better ones
+            metrics.set_gauge("kernel.tuned_entries", kernel_tuning.entries())
         metrics.set_gauge("quant.weights", int(self.quant_weights))
         metrics.set_gauge("quant.kv", int(self.quant_kv))
         metrics.set_gauge("quant.draft", int(self.quant_draft
@@ -665,14 +755,20 @@ class ServingEngine:
         model = self._model
         lora = self.lora
         bs = self.block_size
+        use_kernel = self.paged_kernel
 
         def prefix_prefill(arrays, ids, true_len, prefix_len, pools,
                            bt_row, samp, *lora_args):
             self.prefix_prefill_traces[p_bucket] = \
                 self.prefix_prefill_traces.get(p_bucket, 0) + 1
             compile_cache.bump("serving.prefill_compiles")
+            if use_kernel:
+                # trace-time: the paged-kernel twin of prefill_traces —
+                # asserts chunk/hit churn never re-lowers the kernel
+                metrics.bump("kernel.prefill_traces")
             views = [_PrefixPrefillView(entry, bt_row, prefix_len,
-                                        true_len, bs) for entry in pools]
+                                        true_len, bs, kernel=use_kernel)
+                     for entry in pools]
             with _swap_data(self._objs, list(arrays)):
                 with prng.key_guard(jax.random.key(0)):
                     with (lora.bind(*lora_args) if lora is not None
@@ -735,13 +831,19 @@ class ServingEngine:
         model = self._model
         lora = self.lora
         bs = self.block_size
+        use_kernel = self.paged_kernel
 
         def step(arrays, pools, block_tables, positions, last_tok, active,
                  samp, *lora_args):
             self.decode_traces += 1  # trace-time: the no-recompile counter
             compile_cache.bump("serving.decode_compiles")
+            if use_kernel:
+                # trace-time: the paged-kernel twin of decode_traces —
+                # asserts admit/retire churn never re-lowers the kernel
+                metrics.bump("kernel.decode_traces")
             views = [_PagedCacheView(entry, block_tables, positions,
-                                     active, bs) for entry in pools]
+                                     active, bs, kernel=use_kernel)
+                     for entry in pools]
             with _swap_data(self._objs, list(arrays)):
                 with prng.key_guard(jax.random.key(0)):
                     with (lora.bind(*lora_args) if lora is not None
@@ -1471,6 +1573,7 @@ class ServingEngine:
                "prefix_prefill_traces": dict(self.prefix_prefill_traces),
                "cow_traces": self.cow_traces,
                "chunk_size": self.chunk_size,
+               "kernel.paged": int(self.paged_kernel),
                "quant.weights": int(self.quant_weights),
                "quant.kv": int(self.quant_kv),
                # effective, not the raw flag: quant_draft without a draft

@@ -176,6 +176,32 @@ class KVArena:
     def pools(self) -> List[Tuple]:
         return self._pools
 
+    def kernel_layout(self) -> dict:
+        """The block-table/pool layout contract the Pallas paged kernels
+        (:mod:`paddle_tpu.ops.paged_attention`) compile against — stated
+        once, next to the arrays it describes:
+
+        * per-layer pool entries are ``(k, v)`` arrays shaped
+          ``[num_blocks, block_size, heads, head_dim]`` in the compute
+          dtype, or int8 ``(k, v, k_scale, v_scale)`` with ``float32``
+          ``[num_blocks, block_size]`` per-token-row scale pools;
+        * a block table is int32, indexes pool axis 0, and row 0 is the
+          scratch sink (masked/padded writes land there, so a kernel may
+          read any table entry without validity checks — garbage rows are
+          masked by position, never out of bounds);
+        * tables, positions and prefix lengths are runtime data: a kernel
+          keyed on this layout is keyed on shapes only, so admit/retire/
+          accept/reject churn never re-lowers it.
+
+        Returns the shape facts (``num_blocks``, ``block_size``,
+        ``quantized``, ``dtype``, ``scratch_block``) kernels and benches
+        size their launches from."""
+        return {"num_blocks": self.num_blocks,
+                "block_size": self.block_size,
+                "quantized": self.quantized,
+                "dtype": self.dtype,
+                "scratch_block": 0}
+
     def set_pools(self, pools) -> None:
         """Adopt the pool arrays returned by a compiled step (the old ones
         were donated into it and are no longer valid)."""

@@ -57,6 +57,13 @@ Counter namespaces:
 * ``lora.*``       — the multi-LoRA adapter arena (``serving.adapters``):
   ``registered`` / ``unregistered`` / ``register_failed`` (capacity) /
   ``admits`` (slots admitted with a non-zero adapter)
+* ``kernel.*``     — the Pallas paged-attention serving kernels
+  (``FLAGS_serving_paged_kernel``, ``ops.paged_attention``):
+  trace-time counters ``decode_traces`` / ``prefill_traces`` /
+  ``verify_traces`` (the kernel twins of the engine's no-recompile
+  counters — churn must never re-lower a kernel), plus the gauges
+  ``kernel.paged`` (0/1 mode) and ``kernel.tuned_entries`` (tuning-store
+  records for this chip — ``ops.tuning`` / benches/TUNED_KERNELS.json)
 
 Gauges: ``queue.depth``, ``queue.prefilling`` (chunked prefills in
 progress), ``spec.acceptance_rate``, ``slots.active``, ``slots.total``,
@@ -96,7 +103,7 @@ _providers_registered = False
 DOCUMENTED_NAMESPACES = (
     "requests", "tokens", "engine", "arena", "scheduler", "supervisor",
     "api", "prefix", "spec", "chunk", "quant", "gateway", "tenant",
-    "sampling", "constrain", "lora",
+    "sampling", "constrain", "lora", "kernel",
     "queue", "slots", "tokens_per_sec",
 )
 

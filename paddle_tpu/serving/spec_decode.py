@@ -325,11 +325,17 @@ class SpecDecoder:
         k = self.k
         bs = engine.block_size
 
+        use_kernel = engine.paged_kernel
+
         def _fwd(m, objs, arrays, pools, bt, positions, toks, act):
             """One single-token model forward — same ops, shapes and view
-            class as ``ServingEngine._get_step``'s body, head excluded.
+            class as ``ServingEngine._get_step``'s body, head excluded
+            (``kernel=`` rides along: under FLAGS_serving_paged_kernel
+            every draft/verify sub-step reads K/V through the block
+            tables via the Pallas paged-decode kernel too).
             Returns (last hidden [S, H], new pools)."""
-            views = [_PagedCacheView(entry, bt, positions, act, bs)
+            views = [_PagedCacheView(entry, bt, positions, act, bs,
+                                     kernel=use_kernel)
                      for entry in pools]
             with _swap_data(objs, list(arrays)):
                 with prng.key_guard(jax.random.key(0)):
@@ -351,6 +357,10 @@ class SpecDecoder:
                           positions, last_tok, active, allow):
                 self.spec_traces += 1  # trace-time no-recompile counter
                 compile_cache.bump("serving.decode_compiles")
+                if use_kernel:
+                    # trace-time: verify/draft sub-steps route through the
+                    # paged-decode kernel; churn must never re-lower it
+                    metrics.bump("kernel.verify_traces")
                 # ---- draft proposes k tokens from its own namespace;
                 # lanes past their allowed depth are masked (writes to
                 # scratch, outputs ignored host-side)
@@ -389,6 +399,10 @@ class SpecDecoder:
                           active, allow):
                 self.spec_traces += 1  # trace-time no-recompile counter
                 compile_cache.bump("serving.decode_compiles")
+                if use_kernel:
+                    # trace-time: verify/draft sub-steps route through the
+                    # paged-decode kernel; churn must never re-lower it
+                    metrics.bump("kernel.verify_traces")
                 # lockstep self-draft: k fused target sub-steps, each
                 # feeding the previous sub-step's own output — multi-token
                 # greedy decode in one dispatch, acceptance structurally 1

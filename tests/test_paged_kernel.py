@@ -1,0 +1,497 @@
+"""Pallas paged-attention serving kernels (ISSUE 13): interpreter-mode
+parity of :mod:`paddle_tpu.ops.paged_attention` against the XLA gather
+baseline (``engine._gather_ctx`` + ``gpt.masked_attention``), the shared
+kernel-tuning store (:mod:`paddle_tpu.ops.tuning`), and the engine
+integration behind ``FLAGS_serving_paged_kernel``.
+
+Parity policy (docs/performance.md "Paged attention kernels"): the
+kernels' online softmax associates differently from the gather path's
+full-width softmax, so raw attention output is compared under a small
+f32 tolerance — while greedy DECODED TOKENS must match exactly, which the
+engine-level tests assert across cache hits, chunked prefill, int8
+arenas and speculative verify. Everything here runs the real kernel
+bodies through the Pallas interpreter on the CPU mesh."""
+import json
+import math
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core import compile_cache
+from paddle_tpu.models.gpt import GPTForCausalLM, gpt_tiny
+from paddle_tpu.ops import paged_attention as pk
+from paddle_tpu.ops import tuning
+from paddle_tpu.serving import ServingAPI, ServingConfig
+from paddle_tpu.serving import metrics as serving_metrics
+
+pytestmark = pytest.mark.serving
+
+pytest.importorskip("jax.experimental.pallas")
+if not pk.available():  # pragma: no cover - environment guard
+    pytest.skip("Pallas scalar-prefetch unavailable", allow_module_level=True)
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from paddle_tpu.quantization import quantize_kv  # noqa: E402
+from paddle_tpu.serving.engine import _gather_ctx  # noqa: E402
+from paddle_tpu.models.gpt import masked_attention  # noqa: E402
+
+
+# ------------------------------------------------------------- references
+
+
+def _decode_ref(q, entry, bt, pos):
+    """The XLA gather baseline, op-for-op what _PagedCacheView does after
+    the scatter: gather the whole logical context, mask to <= pos."""
+    t_len = bt.shape[1] * entry[0].shape[1]
+    k_all, v_all = _gather_ctx(entry, bt, q.dtype)
+    mask = (jnp.arange(t_len)[None, :] <= pos[:, None])[:, None, None, :]
+    return masked_attention(q[:, None], k_all, v_all, mask)[:, 0]
+
+
+def _prefill_ref(q, entry, bt_row, prefix_len):
+    """The _PrefixPrefillView baseline: one slot's suffix queries at
+    global positions prefix_len + i over the gathered table."""
+    t_len = bt_row.shape[0] * entry[0].shape[1]
+    k_all, v_all = _gather_ctx(entry, bt_row, q.dtype)
+    gpos = prefix_len + jnp.arange(q.shape[0])
+    mask = (jnp.arange(t_len)[None, :] <= gpos[:, None])[None, None]
+    return masked_attention(q[None], k_all[None], v_all[None], mask)[0]
+
+
+def _pools(rng, nb, bs, h, d, dtype="float32", quantized=False):
+    kf = jnp.asarray(rng.standard_normal((nb, bs, h, d)), dtype)
+    vf = jnp.asarray(rng.standard_normal((nb, bs, h, d)), dtype)
+    if not quantized:
+        return (kf, vf)
+    kq, ks = quantize_kv(kf)
+    vq, vs = quantize_kv(vf)
+    return (kq, vq, ks, vs)
+
+
+def _tol(dtype):
+    # online vs full-width softmax association; bf16 rounds the operands
+    return dict(atol=5e-6, rtol=5e-6) if dtype == "float32" \
+        else dict(atol=2e-2, rtol=2e-2)
+
+
+# ---------------------------------------------------------- kernel parity
+
+
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+@pytest.mark.parametrize("quantized", [False, True],
+                         ids=["full", "int8"])
+def test_decode_parity_permuted_partial_tables(dtype, quantized):
+    """Kernel vs gather+masked_attention over permuted, partially-filled
+    tables and mixed per-lane positions — bf16 and int8 entries."""
+    rng = np.random.default_rng(0)
+    S, H, D, NB, bs, MB = 5, 4, 32, 23, 8, 4
+    entry = _pools(rng, NB, bs, H, D, dtype, quantized)
+    q = jnp.asarray(rng.standard_normal((S, H, D)), dtype)
+    # permuted physical blocks; lanes 3/4 share a "partial" look: table
+    # tails still point at arbitrary blocks but positions mask them off
+    bt = jnp.asarray(rng.permutation(np.arange(1, NB))[: S * MB].reshape(
+        S, MB), jnp.int32)
+    pos = jnp.asarray([0, 3, 17, 25, 31], jnp.int32)
+    out = pk.paged_decode_attention(q, entry, bt, pos)
+    ref = _decode_ref(q, entry, bt, pos)
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32),
+        **_tol(dtype))
+
+
+def test_decode_parity_every_head_grouping():
+    """block_h is a pure launch parameter: every legal grouping computes
+    the same attention (the autotuner can never change results)."""
+    rng = np.random.default_rng(1)
+    S, H, D, NB, bs, MB = 3, 4, 16, 11, 4, 3
+    entry = _pools(rng, NB, bs, H, D)
+    q = jnp.asarray(rng.standard_normal((S, H, D)), jnp.float32)
+    bt = jnp.asarray(rng.integers(1, NB, (S, MB)), jnp.int32)
+    pos = jnp.asarray([2, 7, 11], jnp.int32)
+    ref = _decode_ref(q, entry, bt, pos)
+    for g in (1, 2, 4):
+        out = pk.paged_decode_attention(q, entry, bt, pos, block_h=g)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   **_tol("float32"))
+
+
+def test_decode_shared_block_between_lanes():
+    """Two lanes whose tables alias the same physical block (a radix-
+    cache shared prefix) read identical context through the kernel."""
+    rng = np.random.default_rng(2)
+    S, H, D, NB, bs, MB = 2, 2, 16, 9, 4, 2
+    entry = _pools(rng, NB, bs, H, D)
+    q0 = rng.standard_normal((1, H, D))
+    q = jnp.asarray(np.concatenate([q0, q0]), jnp.float32)  # same query
+    bt = jnp.asarray([[5, 3], [5, 7]], jnp.int32)  # block 5 shared
+    pos = jnp.asarray([3, 3], jnp.int32)  # both inside the shared block
+    out = np.asarray(pk.paged_decode_attention(q, entry, bt, pos))
+    np.testing.assert_array_equal(out[0], out[1])
+
+
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+@pytest.mark.parametrize("quantized", [False, True],
+                         ids=["full", "int8"])
+def test_prefill_parity_mixed_prefix(dtype, quantized):
+    """Chunked-prefill kernel vs the suffix-prefill baseline at several
+    runtime prefix lengths (cache hits of different depths / successive
+    chunks) — one compiled shape serves them all."""
+    rng = np.random.default_rng(3)
+    sq, H, D, NB, bs, MB = 16, 4, 32, 19, 8, 6
+    entry = _pools(rng, NB, bs, H, D, dtype, quantized)
+    q = jnp.asarray(rng.standard_normal((sq, H, D)), dtype)
+    bt_row = jnp.asarray(rng.permutation(np.arange(1, MB + 1)), jnp.int32)
+    for prefix in (0, 5, 11, 31):
+        out = pk.paged_prefill_attention(q, entry, bt_row, prefix)
+        ref = _prefill_ref(q, entry, bt_row, prefix)
+        np.testing.assert_allclose(
+            np.asarray(out, np.float32), np.asarray(ref, np.float32),
+            err_msg=f"prefix={prefix}", **_tol(dtype))
+
+
+def test_prefill_parity_every_tile():
+    rng = np.random.default_rng(4)
+    sq, H, D, NB, bs, MB = 8, 2, 16, 9, 4, 3
+    entry = _pools(rng, NB, bs, H, D)
+    q = jnp.asarray(rng.standard_normal((sq, H, D)), jnp.float32)
+    bt_row = jnp.asarray([4, 1, 7], jnp.int32)
+    ref = _prefill_ref(q, entry, bt_row, 2)
+    for blk_q in (1, 2, 4, 8):
+        for blk_h in (1, 2):
+            out = pk.paged_prefill_attention(q, entry, bt_row, 2,
+                                             block_q=blk_q, block_h=blk_h)
+            np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                       **_tol("float32"))
+
+
+def test_kernel_runtime_data_one_trace():
+    """Tables, positions and prefix lengths are runtime data: one jit
+    trace serves arbitrary churn of all three."""
+    rng = np.random.default_rng(5)
+    S, H, D, NB, bs, MB = 3, 2, 16, 9, 4, 3
+    entry = _pools(rng, NB, bs, H, D)
+    q = jnp.asarray(rng.standard_normal((S, H, D)), jnp.float32)
+    traces = {"n": 0}
+
+    @jax.jit
+    def step(q, entry, bt, pos):
+        traces["n"] += 1
+        return pk.paged_decode_attention(q, entry, bt, pos)
+
+    for i in range(3):
+        bt = jnp.asarray(rng.integers(1, NB, (S, MB)), jnp.int32)
+        pos = jnp.asarray(rng.integers(0, MB * bs, (S,)), jnp.int32)
+        out = step(q, entry, bt, pos)
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.asarray(_decode_ref(q, entry, bt, pos)),
+                                   **_tol("float32"))
+    assert traces["n"] == 1
+
+
+# ------------------------------------------------------------ tuning store
+
+
+def test_tuning_store_roundtrip(tmp_path):
+    tuning.set_store_path(str(tmp_path / "TUNED_KERNELS.json"))
+    try:
+        key = tuning.bucket_key(h=4, d=32, bs=16, mb=7)
+        assert tuning.lookup("paged_decode", key) is None
+        tuning.adopt("paged_decode", key, {"block_h": 2}, 12.5,
+                     baseline_us=20.0)
+        tuning.reset()  # force a re-read from disk
+        assert tuning.lookup("paged_decode", key) == {"block_h": 2}
+        assert tuning.entries() == 1
+        assert tuning.entries("paged_decode") == 1
+        assert tuning.entries("paged_prefill") == 0
+        # persisted under THIS device kind only
+        with open(tuning.store_path()) as f:
+            data = json.load(f)
+        assert list(data["records"]) == [tuning.device_kind()]
+    finally:
+        tuning.set_store_path(None)
+
+
+def test_tuning_adopt_merges_fresh_disk_state(tmp_path):
+    """adopt() merges into what's on disk NOW, not the per-process
+    snapshot — a concurrent tuner's records (flash_tune racing the
+    serving bench) must survive this process's adoption."""
+    tuning.set_store_path(str(tmp_path / "TUNED_KERNELS.json"))
+    try:
+        assert tuning.lookup("paged_decode", "k1") is None  # snapshot: {}
+        # another process adopts while our snapshot is live
+        (tmp_path / "TUNED_KERNELS.json").write_text(json.dumps(
+            {"records": {tuning.device_kind(): {"flash_fwd": {
+                "s=2048": {"params": {"blk_q": 256, "blk_k": 512},
+                           "measured_us": 1.0}}}}}))
+        assert tuning.adopt("paged_decode", "k1", {"block_h": 2}, 5.0)
+        tuning.reset()
+        assert tuning.lookup("flash_fwd", "s=2048") == {
+            "blk_q": 256, "blk_k": 512}  # the other tuner's record lives
+        assert tuning.lookup("paged_decode", "k1") == {"block_h": 2}
+    finally:
+        tuning.set_store_path(None)
+
+
+def test_tuning_adopt_reports_persist_failure(tmp_path):
+    """A failed persist (unwritable path) returns False so callers never
+    report an unpublished tune as adopted."""
+    tuning.set_store_path(str(tmp_path / "no_such_dir" / "T.json"))
+    try:
+        assert tuning.adopt("paged_decode", "k", {"block_h": 1}, 1.0) \
+            is False
+    finally:
+        tuning.set_store_path(None)
+
+
+def test_tuning_store_device_kind_gated(tmp_path):
+    """A record measured on another chip generation is never served."""
+    path = tmp_path / "TUNED_KERNELS.json"
+    key = tuning.bucket_key(h=4, d=32)
+    path.write_text(json.dumps({"records": {"TPU v9000": {
+        "paged_decode": {key: {"params": {"block_h": 1},
+                               "measured_us": 1.0}}}}}))
+    tuning.set_store_path(str(path))
+    try:
+        assert tuning.lookup("paged_decode", key) is None
+    finally:
+        tuning.set_store_path(None)
+
+
+def test_tuning_store_malformed_never_blocks(tmp_path):
+    path = tmp_path / "TUNED_KERNELS.json"
+    path.write_text("{not json")
+    tuning.set_store_path(str(path))
+    try:
+        assert tuning.lookup("paged_decode", "h=4") is None
+        assert tuning.entries() == 0
+    finally:
+        tuning.set_store_path(None)
+
+
+def test_tuning_bucket_key_buckets_like_compile_cache():
+    """Tuning keys ride the compile cache's bucket ladder: shapes that
+    share a compiled program share a tuning record."""
+    assert tuning.bucket_key(s=100) == tuning.bucket_key(s=128)
+    assert tuning.bucket_key(s=100) == f"s={compile_cache.bucket_dim(100, 1)}"
+    assert tuning.bucket_key(d=64, h=12) == "d=64,h=12"
+
+
+def test_flash_tuned_blocks_reads_shared_store(tmp_path):
+    """_tuned_blocks consults the shared store first (kernel
+    "flash_fwd"), keeping FLASH_TUNED.json as the legacy fallback."""
+    from paddle_tpu.ops import pallas_ops
+
+    tuning.set_store_path(str(tmp_path / "TUNED_KERNELS.json"))
+    try:
+        tuning.adopt("flash_fwd", tuning.bucket_key(s=2048),
+                     {"blk_q": 256, "blk_k": 512}, 10.0)
+        tuning.reset()
+        assert pallas_ops._tuned_blocks(2048) == (256, 512)
+    finally:
+        tuning.set_store_path(None)
+
+
+def test_use_interpret_memoized():
+    """Satellite: the backend probe resolves once per process, at module
+    level — not once per pallas_call trace."""
+    from paddle_tpu.ops import pallas_ops
+
+    assert pallas_ops._use_interpret() is True  # CPU test mesh
+    assert pallas_ops._INTERPRET_MEMO  # resolved and memoized
+    memo = dict(pallas_ops._INTERPRET_MEMO)
+    assert pallas_ops._use_interpret() is True
+    assert pallas_ops._INTERPRET_MEMO == memo  # no re-probe growth
+
+
+def test_gather_ctx_per_block_dequant_bitwise():
+    """Satellite: the bf16 fallback dequant chunks per block (lax.map)
+    but stays bitwise identical to the whole-context expression."""
+    from paddle_tpu.quantization import dequantize_kv
+
+    rng = np.random.default_rng(6)
+    NB, bs, H, D, S, MB = 9, 4, 2, 16, 3, 3
+    entry = _pools(rng, NB, bs, H, D, quantized=True)
+    table = jnp.asarray(rng.integers(0, NB, (S, MB)), jnp.int32)
+    k_all, v_all = _gather_ctx(entry, table, "bfloat16")
+    k_ref = dequantize_kv(entry[0][table], entry[2][table],
+                          "bfloat16").reshape(S, MB * bs, H, D)
+    v_ref = dequantize_kv(entry[1][table], entry[3][table],
+                          "bfloat16").reshape(S, MB * bs, H, D)
+    np.testing.assert_array_equal(np.asarray(k_all), np.asarray(k_ref))
+    np.testing.assert_array_equal(np.asarray(v_all), np.asarray(v_ref))
+
+
+# ------------------------------------------------------- engine integration
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(0)
+    m = GPTForCausalLM(gpt_tiny())
+    m.eval()
+    return m
+
+
+def _serve(model, rng, workload, **cfg_kw):
+    cfg = ServingConfig(num_slots=4, kv_block_size=16, max_model_len=128,
+                        **cfg_kw)
+    api = ServingAPI(model, cfg)
+    try:
+        reqs = [api.submit(p, max_new_tokens=n) for p, n in workload]
+        api.run_until_idle()
+        outs = [np.asarray(r.output_ids()) for r in reqs]
+        stats = api.engine.stats()
+    finally:
+        api.close()
+    return outs, stats
+
+
+def _workload(rng, n=6):
+    lens = [8, 12, 20, 7, 16, 9]
+    return [(rng.integers(0, 1024, (lens[i % len(lens)],), dtype=np.int32),
+             8) for i in range(n)]
+
+
+def test_engine_token_parity_and_zero_recompile_churn(model):
+    """The headline gate: a paged-kernel engine reproduces the gather
+    engine token-for-token across admit/retire churn, with decode traced
+    exactly ONCE (kernel.decode_traces mirrors it) — block-table and
+    position churn never re-lowers the kernel."""
+    off, _ = _serve(model, None, _workload(np.random.default_rng(0)),
+                    paged_kernel=False)
+    before = serving_metrics.stats()
+    on, st = _serve(model, None, _workload(np.random.default_rng(0)),
+                    paged_kernel=True)
+    after = serving_metrics.stats()
+    assert st["kernel.paged"] == 1
+    assert st["decode_traces"] == 1
+    assert after.get("kernel.decode_traces", 0) \
+        - before.get("kernel.decode_traces", 0) == 1
+    for a, b in zip(off, on):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_engine_parity_int8_arena(model):
+    """Fused in-kernel dequant: int8 arena + kernel reproduces the int8
+    gather engine exactly (quantized serving never materializes f32
+    context on the kernel path)."""
+    w = _workload(np.random.default_rng(1))
+    off, _ = _serve(model, None, w, paged_kernel=False, quant_kv=True)
+    on, st = _serve(model, None, w, paged_kernel=True, quant_kv=True)
+    assert st["arena.quantized"] is True
+    for a, b in zip(off, on):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_engine_parity_prefix_cache_suffix_prefill(model):
+    """Cache-hit admissions route the suffix prefill through the paged
+    prefill kernel (prefix_len runtime data — one program per bucket)."""
+    rng = np.random.default_rng(2)
+    sys_p = rng.integers(0, 1024, (32,), dtype=np.int32)
+    w = [(np.concatenate([sys_p,
+                          rng.integers(0, 1024, (6,), dtype=np.int32)]), 8)
+         for _ in range(4)]
+    off, _ = _serve(model, None, w, paged_kernel=False, prefix_cache=True)
+    on, st = _serve(model, None, w, paged_kernel=True, prefix_cache=True)
+    assert st["prefix.hits"] >= 3
+    assert sum(st["prefix_prefill_traces"].values()) == 1
+    for a, b in zip(off, on):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_engine_parity_chunked_prefill(model):
+    """Chunked admissions drive every chunk through the prefill kernel —
+    same tokens, chunks actually taken."""
+    rng = np.random.default_rng(7)
+    w = [(rng.integers(0, 1024, (40,), dtype=np.int32), 6)
+         for _ in range(3)]
+    off, _ = _serve(model, None, w, paged_kernel=False, chunked_prefill=8)
+    before = serving_metrics.stats()
+    on, st = _serve(model, None, w, paged_kernel=True, chunked_prefill=8)
+    after = serving_metrics.stats()
+    assert after.get("chunk.chunks", 0) > before.get("chunk.chunks", 0)
+    for a, b in zip(off, on):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_engine_parity_spec_verify(model):
+    """Speculative decoding's draft/verify sub-steps read through the
+    kernel too (the _PagedCacheView route inside _spec_step): lockstep
+    spec + kernel == plain greedy, acceptance structurally 1.0."""
+    w = _workload(np.random.default_rng(3), n=4)
+    off, _ = _serve(model, None, w, paged_kernel=False)
+    on, st = _serve(model, None, w, paged_kernel=True, spec_k=2)
+    assert st["spec.mode"] == "lockstep"
+    for a, b in zip(off, on):
+        np.testing.assert_array_equal(a, b)
+
+
+@pytest.mark.chaos
+def test_engine_kernel_supervisor_replay_parity(model):
+    """Standing invariant: supervisor rebuild/replay is unchanged under
+    the kernel — a mid-decode device fault recovers with token-identical
+    output, one rebuild, and the decode step never re-traced (the
+    rebuilt arena has the same shapes, so the kernel programs are
+    reused)."""
+    keep = paddle.get_flags("fault_injection")["fault_injection"]
+    paddle.set_flags({"fault_injection": 1})
+    from paddle_tpu.core import resilience
+
+    cfg = ServingConfig(num_slots=4, kv_block_size=16, max_model_len=128,
+                        paged_kernel=True)
+    api = ServingAPI(model, cfg)
+    try:
+        rng = np.random.default_rng(8)
+        prompts = [rng.integers(0, 1024, (n,), dtype=np.int32)
+                   for n in (5, 9, 12)]
+        reqs = [api.submit(p, max_new_tokens=8) for p in prompts]
+        api.run_until_idle()
+        refs = [r.output_ids() for r in reqs]
+        d0 = api.engine.decode_traces
+        reqs2 = [api.submit(p, max_new_tokens=8) for p in prompts]
+        for _ in range(3):
+            api._pump_once()
+        resilience.inject_fault("serving_device", times=1)
+        api.run_until_idle()
+        for ref, r in zip(refs, reqs2):
+            np.testing.assert_array_equal(ref, r.output_ids())
+        assert api.engine.decode_traces == d0 == 1
+        assert api.engine.stats()["kernel.paged"] == 1
+    finally:
+        api.close()
+        paddle.set_flags({"fault_injection": keep})
+
+
+def test_arena_kernel_layout_contract(model):
+    """KVArena.kernel_layout() states the facts the kernels and the
+    --paged-attention bench size launches from — it must match the live
+    pool arrays exactly, quantized and not."""
+    for quant in (False, True):
+        cfg = ServingConfig(num_slots=2, kv_block_size=16,
+                            max_model_len=64, paged_kernel=True,
+                            quant_kv=quant)
+        api = ServingAPI(model, cfg)
+        try:
+            arena = api.engine.arena
+            lay = arena.kernel_layout()
+            entry = arena.pools[0]
+            assert lay["num_blocks"] == entry[0].shape[0]
+            assert lay["block_size"] == entry[0].shape[1]
+            assert lay["quantized"] == (len(entry) == 4)
+            assert lay["scratch_block"] == 0
+            if quant:
+                assert tuple(entry[2].shape) == (lay["num_blocks"],
+                                                 lay["block_size"])
+        finally:
+            api.close()
+
+
+def test_engine_kernel_off_is_default(model):
+    """Flag-off (the default): the gather path, kernel gauge 0 — the
+    bit-preserved baseline every parity test above compares against."""
+    _, st = _serve(model, None, _workload(np.random.default_rng(4), n=2))
+    assert st["kernel.paged"] == 0

@@ -77,6 +77,12 @@ FIXTURE_CASES = [
     # (serving.sampling.sample_tokens must stay all-array math)
     ("traced-branch", "compiled_sampling", ()),
     ("shape-from-data", "compiled_sampling", ()),
+    # the ISSUE 13 paged-kernel dispatch shape: data-dependent workload
+    # from a block table's contents and a traced branch on the filled
+    # block count (ops.paged_attention / engine views must key on the
+    # table's static shape only)
+    ("shape-from-data", "compiled_paged", ()),
+    ("traced-branch", "compiled_paged", ()),
     ("undefined-flag", "registry_flags",
      ("paddle_tpu/core/flags.py",)),
     ("unknown-metric-key", "registry_metrics",
@@ -121,6 +127,11 @@ def test_bad_fixtures_are_specific():
             # deliberately seeds BOTH sampling hazards: traced top-k
             # branch + data-dependent mask shape
             allowed |= {"traced-branch", "shape-from-data"}
+        if stem == "compiled_paged":
+            # deliberately seeds BOTH paged-dispatch hazards: table-
+            # content shape + traced block-count branch (the int() cast
+            # feeding it legitimately co-fires traced-cast)
+            allowed |= {"shape-from-data", "traced-branch", "traced-cast"}
         assert rules <= allowed, (stem, rules)
 
 

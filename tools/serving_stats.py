@@ -44,6 +44,12 @@ decoding (``constrain.admits`` / ``constrain.mask_updates`` /
 ``lora.arena_bytes`` and per-scenario ``*.active_slots`` gauges);
 ``FLAGS_serving_lora_rank`` / ``FLAGS_serving_lora_adapters`` size the
 arena in config mode.
+The Pallas paged-attention kernels (``FLAGS_serving_paged_kernel``,
+``ops.paged_attention``) add the trace-time ``kernel.decode_traces`` /
+``kernel.prefill_traces`` / ``kernel.verify_traces`` counters (frozen
+after warmup in a healthy run — churn never re-lowers a kernel) and the
+end-of-run ``kernel.paged`` / ``kernel.tuned_entries`` gauges (mode +
+tuning-store coverage for this chip, benches/TUNED_KERNELS.json).
 The multi-tenant gateway's counters ride it too (``serving.gateway``):
 ``gateway.routed`` / ``gateway.rerouted`` (journaled fail-over) /
 ``gateway.ejected`` / ``gateway.respawned`` (replica health) /
@@ -107,6 +113,9 @@ def _config_report() -> dict:
         # multi-LoRA adapter arena (serving.adapters; 0 rank = off)
         "serving_lora_rank": _flag_env("serving_lora_rank", 0),
         "serving_lora_adapters": _flag_env("serving_lora_adapters", 4),
+        # Pallas paged-attention kernels (ops.paged_attention; 0 = the
+        # XLA gather path)
+        "serving_paged_kernel": _flag_env("serving_paged_kernel", 0),
         # multi-tenant gateway (serving.gateway: router/tenancy/front door)
         "serving_replicas": _flag_env("serving_replicas", 2),
         "gateway_port": _flag_env("gateway_port", 8100),
@@ -166,7 +175,7 @@ def main(argv=None) -> int:
                   if k.split(".")[0] in ("arena", "prefix", "slots",
                                          "spec", "queue", "quant",
                                          "gateway", "tenant", "sampling",
-                                         "constrain", "lora")}
+                                         "constrain", "lora", "kernel")}
         rec = {"wall_secs": round(wall, 3), "stats": delta,
                "gauges": gauges,
                "tokens_per_sec": round(toks / wall, 2) if wall > 0 else None}
