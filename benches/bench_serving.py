@@ -86,6 +86,24 @@ trace time — like flash_tune, only an ON-CHIP run publishes the real
 a chip; off-TPU the identical workflow runs against a throwaway store
 file). Persisted under ``"paged_attention"``. Env: PAGED_STEPS (timed
 decode steps, default 24), PAGED_TUNE_REPS (default 5).
+
+``--sharded`` runs the mesh-sharded serving workload (ISSUE 14,
+docs/distributed.md "Tensor-parallel serving"): the same slot workload
+through a single-device baseline engine and a ``("data", "model")``-mesh
+tensor-parallel engine (``distributed.mesh.serving_mesh``; on CPU the
+process forces 8 virtual devices before backend init). Reported:
+aggregate decode tokens/s for both builds, per-chip HBM bytes
+(weights + KV arena, measured from the committed shards' device-0 share)
+vs the 1-device total — the memory headroom that lets a model bigger
+than one chip's HBM serve at all — greedy token parity between the two
+builds, and ZERO serving compiles inside both timed windows
+(trace-asserted: a live mesh changes committed shardings once, at build,
+never per step). On CPU the step-time ratio is recorded for the record
+only (virtual-device GSPMD is emulation); the per-chip-bytes gate
+(sharded <= 0.55x baseline) asserts everywhere. Persisted under
+``"sharded"``. Env: SHARDED_STEPS (default 24), SHARDED_MP (model-axis
+degree; default = largest head divisor <= device count), SHARDED_DATA
+(data-axis degree, default 1).
 """
 from __future__ import annotations
 
@@ -99,6 +117,15 @@ import numpy as np
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 ".."))
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+if ("--sharded" in sys.argv
+        and "xla_force_host_platform_device_count"
+        not in os.environ.get("XLA_FLAGS", "")):
+    # the sharded bench needs a multi-device platform; set BEFORE the jax
+    # backend initializes. Only the CPU host platform is affected — a TPU
+    # run keeps its real chips.
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8")
 
 import _common  # noqa: E402,F401 — compile cache + sync()
 
@@ -1005,6 +1032,130 @@ def run_paged_attention(model, platform):
     _persist("paged_attention", rec)
 
 
+def run_sharded(platform):
+    """Mesh-sharded serving bench (ISSUE 14) — see the module docstring.
+    Builds its own models (weights commit their shardings at
+    construction, so baseline and mesh runs need separate instances
+    seeded identically)."""
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu.core import compile_cache
+    from paddle_tpu.distributed.mesh import clear_mesh, serving_mesh
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM, gpt_tiny
+    from paddle_tpu.serving import ServingConfig, ServingEngine
+
+    cfg = (GPTConfig(vocab_size=50304, hidden_size=768, num_layers=12,
+                     num_heads=12, max_position_embeddings=2048)
+           if platform == "tpu" else gpt_tiny())
+    ndev = len(jax.devices())
+    H = cfg.num_heads
+    mp_env = os.environ.get("SHARDED_MP")
+    if mp_env:
+        mp = int(mp_env)
+    else:
+        mp = max((g for g in range(1, min(H, ndev) + 1)
+                  if H % g == 0 and ndev % g == 0), default=1)
+    dp = int(os.environ.get("SHARDED_DATA", "1"))
+    if platform == "tpu":
+        max_len, plen, steps = 2048, 512, 64
+    else:
+        max_len, plen, steps = 128, 24, 24
+    steps = int(os.environ.get("SHARDED_STEPS", str(steps)))
+    slots, block, warm = 8, 16, 2
+    rng = np.random.default_rng(int(os.environ.get("SERVING_SEED", "0")))
+    prompts = [rng.integers(0, cfg.vocab_size, (plen,), dtype=np.int32)
+               for _ in range(slots)]
+    max_new = warm + steps + 2
+
+    def device0_bytes(arrays):
+        total = 0
+        for a in arrays:
+            sh = getattr(a, "addressable_shards", None)
+            total += int(sh[0].data.nbytes) if sh else int(a.nbytes)
+        return total
+
+    def one_build(mesh_on):
+        if mesh_on:
+            serving_mesh(mp, data=dp)
+        else:
+            clear_mesh()
+        paddle.seed(0)
+        model = GPTForCausalLM(cfg)
+        model.eval()
+        eng = ServingEngine(model, ServingConfig(
+            num_slots=slots, kv_block_size=block, max_model_len=max_len))
+        for p in prompts:
+            eng.admit(p, max_new)
+        toks = []
+        for _ in range(warm):
+            toks.append(np.asarray(eng.decode_step()))
+        cc0 = compile_cache.stats()
+        traces0 = eng.decode_traces
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            toks.append(np.asarray(eng.decode_step()))
+        _common.sync(eng.arena.pools[0][0])
+        wall = time.perf_counter() - t0
+        cc1 = compile_cache.stats()
+        compiles = int(cc1.get("serving.decode_compiles", 0)
+                       - cc0.get("serving.decode_compiles", 0))
+        assert compiles == 0, f"{compiles} compiles in the timed window"
+        assert eng.decode_traces == traces0 == 1, "decode re-traced"
+        params, buffers = model.functional_state()
+        arrays = [p._data for p in list(params.values())
+                  + list(buffers.values())]
+        for entry in eng.arena.pools:
+            arrays.extend(entry)
+        logical = sum(int(a.nbytes) for a in arrays)
+        per_chip = device0_bytes(arrays)
+        for s in range(slots):
+            eng.retire(s)
+        label = f"mesh(d{dp}xm{mp})" if mesh_on else "1-device"
+        rec = {"step_ms": round(wall / steps * 1e3, 3),
+               "tokens_per_sec": round(slots * steps / wall, 1),
+               "compiles_during_run": compiles,
+               "per_chip_bytes": per_chip,
+               "logical_bytes": logical,
+               "mesh_key": eng.mesh_key}
+        print(f"# sharded {label}: {rec['step_ms']:.2f} ms/step "
+              f"({rec['tokens_per_sec']:.1f} tok/s), "
+              f"per-chip {per_chip / 1e6:.1f} MB of "
+              f"{logical / 1e6:.1f} MB logical, compiles=0", flush=True)
+        return rec, np.stack(toks)
+
+    base, t_base = one_build(False)
+    shard, t_shard = one_build(True)
+    clear_mesh()
+    assert (t_base == t_shard).all(), "sharded-vs-1-device token parity"
+    if mp > 1:
+        # the memory headroom gate: every chip holds strictly less than
+        # the logical weights+arena — the lever that serves models bigger
+        # than one chip's HBM (asserted on CPU's virtual mesh too)
+        assert shard["per_chip_bytes"] <= 0.55 * base["per_chip_bytes"], (
+            shard["per_chip_bytes"], base["per_chip_bytes"])
+    rec = {
+        "bench": "serving_sharded",
+        "metric": f"sharded serving tokens/sec (GPT {cfg.hidden_size}h/"
+                  f"{cfg.num_layers}L d{dp}xm{mp} {platform})",
+        "value": shard["tokens_per_sec"],
+        "unit": "tokens/sec",
+        "platform": platform,
+        "devices": ndev,
+        "model_axis": mp,
+        "data_axis": dp,
+        "token_parity": True,
+        "per_chip_bytes_ratio": round(
+            shard["per_chip_bytes"] / base["per_chip_bytes"], 3),
+        "step_time_ratio_vs_1dev": round(
+            base["step_ms"] / shard["step_ms"], 3),
+        "baseline": base,
+        "sharded": shard,
+    }
+    _persist("sharded", rec)
+    return rec
+
+
 def run_sampling(model, platform):
     """Scenario-diversity bench (ISSUE 12): mixed greedy / seeded-sampled
     / trie-constrained / two-LoRA-adapter slots in ONE batch through the
@@ -1356,6 +1507,9 @@ def main():
     from paddle_tpu.serving import ServingAPI
 
     platform = jax.devices()[0].platform
+    if "--sharded" in sys.argv:
+        run_sharded(platform)
+        return
     if "--speculative" in sys.argv:
         cfg = (GPTConfig(vocab_size=50304, hidden_size=768, num_layers=12,
                          num_heads=12, max_position_embeddings=2048)

@@ -45,6 +45,14 @@ _STATIC_CALLS = {"len", "isinstance", "getattr", "hasattr", "type",
                  "range", "enumerate", "zip"}
 _CAST_CALLS = {"bool", "int", "float"}
 _SHAPE_FROM_DATA = {"nonzero", "unique", "flatnonzero", "argwhere"}
+# mesh-aware tracedness (ISSUE 14): these produce TRACED values from
+# static arguments (an axis name string) — ``r = lax.axis_index("model");
+# if r == 0:`` is a traced branch even though no traced value flows in.
+# Mesh-SHAPE queries (``mesh.shape[...]``, ``axis_size``) stay static:
+# branching on the mesh's size at trace time is legal (a different mesh
+# is a different program key), branching on per-device values is not.
+_TRACED_PRODUCERS = {"axis_index", "psum", "pmax", "pmin", "pmean",
+                     "ppermute", "pshuffle", "all_gather", "all_to_all"}
 
 
 def _callable_name(f: ast.AST) -> str:
@@ -209,6 +217,8 @@ class CompiledCodeAnalyzer:
                 fname = _callable_name(e.func)
                 if fname in _STATIC_CALLS:
                     return False
+                if fname in _TRACED_PRODUCERS:
+                    return True
                 args_traced = any(is_traced(a) for a in e.args) or any(
                     is_traced(kw.value) for kw in e.keywords)
                 if isinstance(e.func, ast.Attribute):

@@ -10,10 +10,12 @@ from .mesh import (  # noqa: F401
     HYBRID_AXES,
     HybridCommunicateGroup,
     build_mesh,
+    clear_mesh,
     ensure_mesh,
     get_mesh,
     init_hybrid_mesh,
     named_sharding,
+    serving_mesh,
     set_mesh,
 )
 from .collective import (  # noqa: F401

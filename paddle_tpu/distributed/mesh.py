@@ -54,6 +54,32 @@ def build_mesh(
     return Mesh(dev_array, tuple(names))
 
 
+def serving_mesh(model_parallel: int, data: int = 1,
+                 devices: Optional[Sequence] = None,
+                 install: bool = True) -> Mesh:
+    """The serving topology of ISSUE 14: a ``("data", "model")`` mesh —
+    batch/replica axis outer, tensor-parallel axis innermost (fastest ICI).
+    ``model_parallel`` shards attention/MLP weights and the KV arena's
+    head dim; ``data`` replicates the engine and shards the slot batch.
+    ``install=True`` (default) also makes it the global mesh so models
+    built afterwards commit their parameters with the right shardings —
+    the serving engine captures whatever mesh is installed at construction
+    as part of its program key. ``devices`` defaults to all; pass a
+    one-device slice to build the 1-device mesh whose compiled programs
+    are bit-identical to the no-mesh path (tests assert this). When
+    ``data * model_parallel`` covers fewer devices than exist, the mesh is
+    built over the first ``data * model_parallel`` of them (a sub-mesh is
+    a legal serving topology — the rest of the chips belong to other
+    replicas)."""
+    if devices is None:
+        devices = list(jax.devices())[:int(data) * int(model_parallel)]
+    mesh = build_mesh({"data": int(data), "model": int(model_parallel)},
+                      devices)
+    if install:
+        set_mesh(mesh)
+    return mesh
+
+
 def set_mesh(mesh: Mesh):
     global _global_mesh
     with _global_lock:
@@ -62,6 +88,14 @@ def set_mesh(mesh: Mesh):
 
 def get_mesh() -> Optional[Mesh]:
     return _global_mesh
+
+
+def clear_mesh() -> None:
+    """Uninstall the global mesh (benches/tests that interleave mesh and
+    single-device builds; models constructed afterwards commit unsharded)."""
+    global _global_mesh
+    with _global_lock:
+        _global_mesh = None
 
 
 def ensure_mesh() -> Mesh:

@@ -44,7 +44,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from . import mesh as mesh_mod
-from .sharding_util import shard_map_compat
+from .sharding_util import pcast, shard_map_compat
 
 PIPE_AXIS = "pipe"
 
@@ -109,8 +109,8 @@ def pipeline_apply(
         x_mb = xb.reshape((M, mb_sz) + xb.shape[1:])
 
         # initial carries become stage-varying after the first tick; mark them
-        state = jax.lax.pcast(jnp.zeros_like(x_mb[0]), (PIPE_AXIS,), to="varying")
-        outputs = jax.lax.pcast(jnp.zeros_like(x_mb), (PIPE_AXIS,), to="varying")
+        state = pcast(jnp.zeros_like(x_mb[0]), (PIPE_AXIS,), to="varying")
+        outputs = pcast(jnp.zeros_like(x_mb), (PIPE_AXIS,), to="varying")
         fwd_perm = [(i, (i + 1) % S) for i in range(S)]
 
         def tick(carry, t):
@@ -264,11 +264,10 @@ def pipeline_apply_interleaved(
         mb_sz = xb.shape[0] // M
         x_mb = xb.reshape((M, mb_sz) + xb.shape[1:])
 
-        state = jax.lax.pcast(jnp.zeros_like(x_mb[0]), (PIPE_AXIS,),
-                              to="varying")
+        state = pcast(jnp.zeros_like(x_mb[0]), (PIPE_AXIS,), to="varying")
         out_shape = (M_pad,) + x_mb.shape[1:]
-        outputs = jax.lax.pcast(jnp.zeros(out_shape, x_mb.dtype),
-                                (PIPE_AXIS,), to="varying")
+        outputs = pcast(jnp.zeros(out_shape, x_mb.dtype),
+                        (PIPE_AXIS,), to="varying")
         fwd_perm = [(i, (i + 1) % S) for i in range(S)]
 
         def tick(carry, t):

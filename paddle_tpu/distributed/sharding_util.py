@@ -1,4 +1,4 @@
-"""Sharding helpers shared by TP/PP/ZeRO layers.
+"""Sharding helpers shared by TP/PP/ZeRO layers — the mesh execution core.
 
 The reference moves data with explicit collective ops (c_allreduce/c_concat/
 c_split, ref:paddle/fluid/operators/collective/); TPU-native we *annotate*:
@@ -6,10 +6,20 @@ parameters are device_put with a NamedSharding, activations get
 ``with_sharding_constraint`` under trace, and XLA's SPMD partitioner inserts
 the ICI collectives (SURVEY.md §7: "GSPMD sharding annotations give DP/TP/
 sharding for free").
+
+ISSUE 14 makes this module the ONE sharding home for the compiled
+execution core: :func:`shard_map_compat` now emulates partial-manual maps
+on old jax (instead of refusing), :func:`pcast` shims the vma-marking API,
+:func:`shard_kv_entry` states the KV-arena pool placement rule (payload
+heads-sharded over "model", per-block scale pools replicated), and
+:func:`mesh_axes_key` is the hashable mesh fingerprint that joins every
+compiled program key (engine builds, ``generate()``'s runner cache)
+exactly like the quant/donation flags already do.
 """
 from __future__ import annotations
 
-from typing import Optional
+import threading
+from typing import Optional, Tuple
 
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
@@ -22,30 +32,83 @@ def _mesh() -> Mesh:
     return mesh_mod.ensure_mesh()
 
 
+# --------------------------------------------------- manual-region tracking
+
+_manual_tls = threading.local()
+
+
+def in_manual_region() -> bool:
+    """True while tracing the body of an emulated partial-manual shard_map
+    (see :func:`shard_map_compat`): every mesh axis is manual there, so a
+    full-mesh ``with_sharding_constraint`` would be ill-typed —
+    :func:`constraint` consults this and lets GSPMD propagate instead (the
+    vma-based check covers the same case on a jax with the public API)."""
+    return getattr(_manual_tls, "depth", 0) > 0
+
+
+def manual_emulation_active() -> bool:
+    """True when this jax lacks the public ``jax.shard_map`` API, i.e.
+    partial-manual maps run through the full-manual EMULATION below.
+    Callers use this to steer around old-jaxlib sharp edges — e.g.
+    TrainStep declines buffer donation for pipe/sep-axis programs here,
+    because donated params read back through an emulated manual region
+    hit a CPU aliasing bug (nondeterministic NaN / heap corruption on
+    0.4.x; the copying build is bit-correct)."""
+    return getattr(jax, "shard_map", None) is None
+
+
+def pcast(x, axes, to: str = "varying"):
+    """``jax.lax.pcast`` across jax versions: marks a value as
+    manual-axis-varying where the API exists; identity on a jax without it
+    (the emulated full-manual path needs no vma marking — replication is
+    unchecked there, see :func:`shard_map_compat`)."""
+    fn = getattr(jax.lax, "pcast", None)
+    if fn is None:
+        return x
+    return fn(x, tuple(axes), to=to)
+
+
 def shard_map_compat(f, mesh, in_specs, out_specs, check_vma=False,
                      axis_names=None):
     """``jax.shard_map`` across jax versions (degraded-environment
     robustness): the public API when this jax has it, else
     ``jax.experimental.shard_map`` with the old kwarg name (``check_rep``
-    for ``check_vma``). Full-manual maps only on the fallback: the old
-    API's partial-manual (``auto``) mode is unreliable (NotImplementedError
-    and worse on 0.4.x), so ``axis_names`` callers fail with a clear error
-    there instead of entering it."""
+    for ``check_vma``).
+
+    Partial-manual callers (``axis_names=...`` — the pipeline and
+    context-parallel bodies, manual only over their own axis) get the
+    public API's native mode when available. On an old jax the native
+    ``auto=`` partial-manual mode is unsound (XLA SPMD-partitioner CHECK
+    failures that abort the process on 0.4.x), so the fallback EMULATES it
+    with a full-manual map instead: the body's collectives only ever name
+    the manual axes, and the in/out specs replicate over every other axis,
+    so full-manual is numerically identical — the only cost is that
+    non-manual-axis GSPMD sharding inside the body degrades to
+    replication (a perf, never a correctness, difference). The body is
+    traced inside a manual-region marker so :func:`constraint` calls
+    within it no-op (the vma check does this on new jax), and replication
+    checking is off — the emulation has no vma tracking to satisfy it."""
     sm = getattr(jax, "shard_map", None)
     if sm is not None:
         kw = {"check_vma": check_vma}
         if axis_names is not None:
             kw["axis_names"] = axis_names
         return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw)
-    if axis_names is not None:
-        raise NotImplementedError(
-            "partial-manual shard_map (axis_names=...) needs a jax with the "
-            "public jax.shard_map API; this jax only has the experimental "
-            "full-manual fallback")
     from jax.experimental.shard_map import shard_map as esm
 
-    return esm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-               check_rep=check_vma)
+    if axis_names is None:
+        return esm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   check_rep=check_vma)
+
+    def manual_body(*args, **kwargs):
+        _manual_tls.depth = getattr(_manual_tls, "depth", 0) + 1
+        try:
+            return f(*args, **kwargs)
+        finally:
+            _manual_tls.depth -= 1
+
+    return esm(manual_body, mesh=mesh, in_specs=in_specs,
+               out_specs=out_specs, check_rep=False)
 
 
 def _prune_spec(mesh: Mesh, spec):
@@ -84,6 +147,12 @@ def constraint(x, *spec, mesh: Optional[Mesh] = None):
     mesh = mesh or mesh_mod.get_mesh()
     if mesh is None:
         return x
+    if in_manual_region():
+        # inside an emulated partial-manual shard_map body every mesh axis
+        # is manual: a full-mesh constraint is ill-typed — let GSPMD
+        # propagate from the operands (the vma check below covers this on
+        # a jax with the public shard_map API)
+        return x
     spec = _prune_spec(mesh, spec)
     t = isinstance(x, Tensor)
     arr = x._data if t else x
@@ -105,3 +174,54 @@ def constraint(x, *spec, mesh: Optional[Mesh] = None):
 
 def replicate(x, mesh: Optional[Mesh] = None):
     return constraint(x, mesh=mesh)
+
+
+# ------------------------------------------------ mesh-aware program keys
+
+MODEL_AXIS = "model"
+
+
+def mesh_axes_key(mesh: Optional[Mesh] = None) -> Optional[Tuple]:
+    """Hashable fingerprint of a mesh — ``((axis, size), ...)`` in device
+    order, or ``None`` off-mesh. This is the value that joins compiled
+    program keys (the serving engine's build config, ``generate()``'s
+    runner cache) exactly like the quant/donation flags: a different mesh
+    shape or axis layout is a different executable, never a reused one.
+    A 1-device mesh keys differently from no mesh on purpose — the
+    programs are bit-identical but the committed shardings are not."""
+    m = mesh if mesh is not None else mesh_mod.get_mesh()
+    if m is None:
+        return None
+    return tuple((str(a), int(m.shape[a])) for a in m.axis_names)
+
+
+def shard_kv_entry(entry, mesh: Optional[Mesh] = None):
+    """Place one KV-arena pool entry on the mesh — the ONE statement of
+    the arena's sharding rule (ISSUE 14):
+
+    * K/V payload pools ``[num_blocks, block_size, heads, head_dim]``
+      shard their HEADS dim over the "model" axis (the same axis the
+      attention weights shard over, so the decode step's scatter/gather
+      stay local per shard). Heads that don't divide the model degree
+      replicate instead — correct, just not memory-scaled.
+    * per-block scale pools ``[num_blocks, block_size]`` (the int8
+      arena's 4-tuple entries) replicate: they are read by every head's
+      dequant, and at 2 floats per token row they are noise next to the
+      payload.
+
+    Block tables, positions, refcounts and COW bookkeeping stay host-side
+    numpy — layout-agnostic by construction. No-op without a mesh (the
+    single-chip path is byte-identical to PR 13)."""
+    mesh = mesh if mesh is not None else mesh_mod.get_mesh()
+    if mesh is None:
+        return tuple(entry)
+    mp = mesh.shape.get(MODEL_AXIS, 1)
+    out = []
+    for i, arr in enumerate(entry):
+        if (i < 2 and mp > 1 and arr.ndim >= 3
+                and arr.shape[2] % mp == 0):
+            spec = PartitionSpec(None, None, MODEL_AXIS, None)
+        else:
+            spec = PartitionSpec()
+        out.append(jax.device_put(arr, NamedSharding(mesh, spec)))
+    return tuple(out)

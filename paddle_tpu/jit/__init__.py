@@ -658,8 +658,28 @@ class TrainStep:
         them in place — halves the peak HBM of the update; old arrays are
         invalidated, but __call__ rebinds every Tensor._data to the new
         buffers. FLAGS_trainstep_donate=0 (read at build time) keeps the
-        copying build for A/B verification."""
-        return (0, 2) if flags.flag("trainstep_donate") else ()
+        copying build for A/B verification.
+
+        Declined (regardless of the flag) when the step will trace an
+        EMULATED partial-manual shard_map region — a multi-device mesh
+        with an active pipe/sep axis on a jax without the public
+        shard_map API: donated params read back through the emulated
+        manual region hit a 0.4.x CPU aliasing bug (nondeterministic
+        NaN / heap corruption in the SECOND step; reproduced via the
+        interleaved GPT pipe). The copying build is bit-correct, so the
+        old environment trades the HBM win for determinism; GSPMD-only
+        mesh programs (dp/mp, serving) keep donating."""
+        if not flags.flag("trainstep_donate"):
+            return ()
+        from ..distributed import mesh as mesh_mod
+        from ..distributed.sharding_util import manual_emulation_active
+
+        m = mesh_mod.get_mesh()
+        if (m is not None and m.devices.size > 1
+                and manual_emulation_active()
+                and any(m.shape.get(a, 1) > 1 for a in ("pipe", "sep"))):
+            return ()
+        return (0, 2)
 
     def _guarded_update(self, param_arrays, grads, loss, opt_state, lr):
         """NaN/Inf step sentinel: ONE fused finiteness reduction over

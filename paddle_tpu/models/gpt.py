@@ -135,7 +135,7 @@ def set_lora_hook(fn) -> None:
     _lora_hook = fn
 
 
-def quantize_serving_weights(model) -> int:
+def quantize_serving_weights(model, mesh=None) -> int:
     """Per-channel int8 weight-only quantization of every attention/MLP
     matmul of a :class:`GPTForCausalLM`, in place (``FLAGS_serving_quant_weights``
     — the serving engine calls this at model load).
@@ -153,7 +153,10 @@ def quantize_serving_weights(model) -> int:
 
     Idempotent (a gateway's replicas share one model instance): already
     quantized layers are skipped. Returns the number of layers quantized
-    by THIS call. Training a quantized model is not supported — serving
+    by THIS call. ``mesh`` pins the re-placement below to a specific mesh
+    (the serving engine passes its captured one so an explicit
+    ``ServingConfig.mesh`` stays coherent); None defers to the installed
+    global. Training a quantized model is not supported — serving
     quantization is a load-time conversion, not QAT (see
     :mod:`paddle_tpu.quantization` for fake-quant training)."""
     from .. import quantization
@@ -179,11 +182,12 @@ def quantize_serving_weights(model) -> int:
             # (proj/down) shard in_features, their out-channel scale is
             # replicated. No-op off-mesh (single chip).
             if isinstance(lin, ColumnParallelLinear):
-                shard_parameter(lin.weight, None, MODEL_AXIS)
-                shard_parameter(lin.weight_scale, None, MODEL_AXIS)
+                shard_parameter(lin.weight, None, MODEL_AXIS, mesh=mesh)
+                shard_parameter(lin.weight_scale, None, MODEL_AXIS,
+                                mesh=mesh)
             else:
-                shard_parameter(lin.weight, MODEL_AXIS, None)
-                shard_parameter(lin.weight_scale, None, None)
+                shard_parameter(lin.weight, MODEL_AXIS, None, mesh=mesh)
+                shard_parameter(lin.weight_scale, None, None, mesh=mesh)
             n += 1
     if n:
         # generate()'s memoized runner is keyed per decode configuration;
@@ -720,10 +724,17 @@ class GPTForCausalLM(nn.Layer):
             key_arg = (jnp.int32(seed if sampling.seed is None
                                  else sampling.seed)
                        if sampling is not None else jax.random.key(seed))
+            # the mesh fingerprint joins the key like the quant/donation
+            # tags: installing (or changing) a device mesh between calls
+            # must rebuild the runner over the newly committed shardings,
+            # never replay one traced against the old placement
+            from ..distributed.sharding_util import mesh_axes_key
+
             cache_key = (b, prompt_len, max_new_tokens, bool(do_sample),
                          float(temperature), int(top_k), float(top_p),
                          int(eos_token_id), bool(use_cache), donate, stop,
-                         getattr(self, "_serving_quant", 0), samp_key)
+                         getattr(self, "_serving_quant", 0), samp_key,
+                         mesh_axes_key())
             cached = getattr(self, "_gen_cache", None)
             if cached is not None and cached[0] == cache_key:
                 compile_cache.bump("decode.cache_hits")

@@ -58,7 +58,8 @@ if _HAS_PALLAS:
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-__all__ = ["available", "paged_decode_attention", "paged_prefill_attention"]
+__all__ = ["available", "paged_decode_attention", "paged_prefill_attention",
+           "paged_full_prefill_attention"]
 
 
 def available() -> bool:
@@ -357,3 +358,30 @@ def paged_prefill_attention(q, entry, bt_row, prefix_len,
         interpret=_use_interpret(),
     )(*args)
     return jnp.swapaxes(out, 0, 1)
+
+
+def paged_full_prefill_attention(q, k, v, block_size,
+                                 block_q=None, block_h=None):
+    """Full (no-table) causal prefill through the SAME kernel — the PR 13
+    open item: a cache-miss admission has no resident prefix and no block
+    table yet, but the flash-style kernel above is exactly the right
+    attention for it too. Contiguous ``k``/``v`` (``[sq, H, D]``, the
+    chunk's own keys/values) are viewed as ``ceil(sq/bs)`` **pseudo-blocks**
+    and addressed through an identity (``arange``) pseudo-table with
+    ``prefix_len = 0``: query ``i`` attends keys ``<= i`` — the
+    ``_CapturePrefillView`` causal mask verbatim. The pad rows a non-divisible
+    ``sq`` adds sit at key positions ``>= sq``, above every query row, so
+    the mask discards them like the XLA path's padding. One reshape/pad in
+    XLA; no gather, no ``[sq, sq]`` materialized probability matrix —
+    kernel-on engines have no gather-path prefill left."""
+    sq, H, D = q.shape
+    bs = int(block_size)
+    nb = -(-sq // bs)
+    pad = nb * bs - sq
+    if pad:
+        k = jnp.pad(k, ((0, pad), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, pad), (0, 0), (0, 0)))
+    entry = (k.reshape(nb, bs, H, D), v.reshape(nb, bs, H, D))
+    table = jnp.arange(nb, dtype=jnp.int32)
+    return paged_prefill_attention(q, entry, table, jnp.int32(0),
+                                   block_q=block_q, block_h=block_h)

@@ -289,11 +289,24 @@ class AdapterArena:
     def device_pools(self):
         """The stacked pools as device arrays (memoized; invalidated only
         by register/unregister — steady-state decode passes the SAME
-        arrays every step, so there is no per-step transfer)."""
+        arrays every step, so there is no per-step transfer). On a device
+        mesh the pools commit REPLICATED (sharding_util.replicate): the
+        per-lane gather `A[ids]` reads a whole adapter row per slot, and
+        at rank r the rows are noise next to the model-axis-sharded base
+        weights — replication keeps the gather local on every shard, and
+        an explicit committed placement means mesh installs never churn
+        the program's input shardings between steps. The OWNING engine's
+        captured mesh wins over the installed global (bind_engine), so an
+        explicit ServingConfig.mesh keeps adapters coherent with the
+        weights/arena."""
         if self._dev is None:
             import jax.numpy as jnp
 
-            self._dev = [(jnp.asarray(a), jnp.asarray(b))
+            from ..distributed.sharding_util import replicate
+
+            mesh = getattr(getattr(self, "_engine", None), "mesh", None)
+            self._dev = [(replicate(jnp.asarray(a), mesh=mesh),
+                          replicate(jnp.asarray(b), mesh=mesh))
                          for a, b in zip(self._a, self._b)]
         return self._dev
 

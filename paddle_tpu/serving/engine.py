@@ -54,6 +54,22 @@ adapter index into a paged adapter arena
 N-adapter slots never recompiles, and the greedy/mask-off/adapter-0
 paths are token-identical to the classic engine.
 
+**Mesh-sharded execution** (ISSUE 14 — docs/distributed.md
+"Tensor-parallel serving"): the engine captures the installed device
+mesh at construction exactly like the quant/donation flags — the mesh's
+``(axis, size)`` fingerprint (``sharding_util.mesh_axes_key``) is part
+of its program key. On a ``("data", "model")`` mesh
+(``distributed.mesh.serving_mesh``) the model's weights arrive with
+committed model-axis shardings, the KV arena's pools (every namespace,
+int8 scale 4-tuples included) shard their heads dim over the model axis
+(``sharding_util.shard_kv_entry`` via ``KVArena``), and ALL slot/block
+bookkeeping stays host-side numpy — so admit/retire churn on a live
+mesh is still pure runtime data with zero recompiles, and supervisor
+rebuilds re-commit identical placements through ``_arena_args``.
+Greedy tokens are parity-asserted against the single-device engine;
+a 1-device mesh is bit-identical to no mesh
+(tests/test_mesh_serving.py).
+
 Two flag-gated multi-token extensions ride the same no-recompile
 contract: **speculative decoding** (``FLAGS_serving_spec_k`` —
 :mod:`paddle_tpu.serving.spec_decode`: a draft model proposes k tokens
@@ -216,7 +232,19 @@ class _PagedCacheView:
 class _CapturePrefillView:
     """Prefill-side cache protocol object: plain causal attention over the
     (padded) prompt chunk, returning the chunk's k/v as the successor cache
-    so the engine can scatter them into the slot's arena blocks."""
+    so the engine can scatter them into the slot's arena blocks.
+
+    With ``kernel=True`` the attention routes through the Pallas prefill
+    kernel's no-table entry
+    (:func:`paddle_tpu.ops.paged_attention.paged_full_prefill_attention` —
+    the chunk's own K/V viewed as a contiguous pseudo-table, prefix 0), so
+    a kernel-on engine runs ALL of its prefill shapes through the one
+    flash-style kernel; ``kernel=False`` is the original masked_attention
+    path, bit-preserved."""
+
+    def __init__(self, block_size: int = 0, kernel: bool = False):
+        self.block_size = block_size
+        self.kernel = kernel
 
     def update_and_attend(self, q, k, v):
         import jax.numpy as jnp
@@ -225,6 +253,12 @@ class _CapturePrefillView:
 
         qa, ka, va = (t._data if isinstance(t, Tensor) else t
                       for t in (q, k, v))
+        if self.kernel:
+            from ..ops.paged_attention import paged_full_prefill_attention
+
+            o = paged_full_prefill_attention(qa[0], ka[0], va[0],
+                                             self.block_size)[None]
+            return o, (ka, va)
         p = qa.shape[1]
         mask = (jnp.arange(p)[None, :] <= jnp.arange(p)[:, None])[None, None]
         o = masked_attention(qa, ka, va, mask)
@@ -356,6 +390,19 @@ class ServingConfig:
     # directly through the block tables (ops.paged_attention) instead
     # of gathering the context into contiguous buffers.
     paged_kernel: Optional[bool] = None
+    # device mesh (ISSUE 14): None defers to the globally installed mesh
+    # (distributed.mesh.get_mesh() — e.g. serving_mesh(mp, dp)). Captured
+    # at construction EXACTLY like quant/donation: the mesh's
+    # (axis, size) fingerprint is part of the engine's program key — a
+    # different mesh is a different set of executables. Everything the
+    # ENGINE places follows this mesh (KV-arena pools via
+    # sharding_util.shard_kv_entry, int8 weight re-placement, adapter
+    # pools); the BASE float weights commit at model construction, so
+    # an explicit mesh here must be the mesh the model was built under
+    # (normally just the installed global — mixing device sets makes
+    # jit reject the step). All block-table/refcount/COW bookkeeping
+    # stays host-side. A 1-device mesh is bit-identical to no mesh.
+    mesh: Optional[object] = None
 
 
 @dataclass
@@ -395,6 +442,22 @@ class ServingEngine:
         self._model = model
         model.eval()
 
+        # the mesh is captured FIRST: weight quantization re-places int8
+        # payloads on it, the arena shards its pools over it, and its
+        # fingerprint joins the program key like quant/donation below
+        from ..distributed import mesh as mesh_mod
+        from ..distributed.sharding_util import mesh_axes_key
+
+        self.mesh = cfg.mesh if cfg.mesh is not None else mesh_mod.get_mesh()
+        self.mesh_key = mesh_axes_key(self.mesh) if self.mesh is not None \
+            else None
+        self._mesh_model = (self.mesh.shape.get("model", 1)
+                            if self.mesh is not None else 1)
+        self._mesh_data = (self.mesh.shape.get("data", 1)
+                           if self.mesh is not None else 1)
+        self._mesh_devices = (int(self.mesh.devices.size)
+                              if self.mesh is not None else 1)
+
         self.quant_weights = (bool(flags.flag("serving_quant_weights"))
                               if cfg.quant_weights is None
                               else bool(cfg.quant_weights))
@@ -406,10 +469,13 @@ class ServingEngine:
         if self.quant_weights:
             # in-place, idempotent (gateway replicas share one model):
             # must run BEFORE the functional_state snapshot below so the
-            # compiled programs stream the int8 payload + scale buffers
+            # compiled programs stream the int8 payload + scale buffers.
+            # The captured mesh is threaded through so an explicit
+            # ServingConfig.mesh re-places the int8 payloads on THIS
+            # engine's mesh, not whatever global happens to be installed
             from ..models.gpt import quantize_serving_weights
 
-            n = quantize_serving_weights(model)
+            n = quantize_serving_weights(model, mesh=self.mesh)
             if n:
                 metrics.bump("quant.weight_layers", n)
         # multi-LoRA adapter arena: rank/capacity are static (program key,
@@ -469,6 +535,19 @@ class ServingEngine:
                               "Pallas scalar-prefetch is unavailable; "
                               "falling back to the XLA gather path")
                 self.paged_kernel = False
+            elif self._mesh_devices > 1:
+                # same once-at-construction rule for ANY multi-device
+                # mesh (model- OR data-axis: the pools commit onto the
+                # whole mesh either way): pallas_call has no SPMD
+                # partitioning rule over mesh-committed pools (routing
+                # it through shard_map is the open follow-up in
+                # docs/distributed.md), so a mesh engine serves the
+                # GSPMD gather path — which shards fine
+                warnings.warn("FLAGS_serving_paged_kernel requested on a "
+                              "multi-device mesh; the paged kernels "
+                              "have no SPMD partitioning yet — serving "
+                              "the (sharded) XLA gather path instead")
+                self.paged_kernel = False
         self._retry = cfg.retry_policy
         if self._retry is None and not self.donate:
             self._retry = resilience.io_policy()
@@ -480,10 +559,13 @@ class ServingEngine:
         # after a transient device failure (same shapes => zero recompiles);
         # the quant-kv mode rides along so the rebuilt arena keeps its
         # int8 pools + scale pools
+        # the mesh rides along so the rebuilt arena re-commits the SAME
+        # pool shardings (identical shapes AND placements => the
+        # supervisor's rebuild/replay path stays zero-recompile on a mesh)
         self._arena_args = (mcfg.num_layers, mcfg.num_heads,
                             mcfg.hidden_size // mcfg.num_heads,
                             num_blocks, self.block_size, kv_dtype,
-                            self.quant_kv)
+                            self.quant_kv, self.mesh)
         self.arena = KVArena(*self._arena_args)
         self.use_prefix_cache = (bool(flags.flag("serving_prefix_cache"))
                                  if cfg.prefix_cache is None
@@ -561,6 +643,11 @@ class ServingEngine:
                      if spec_k > 0 else None)
         self._meter = metrics.Meter()  # lifetime aggregate tokens/s gauge
         metrics.set_gauge("slots.total", s)
+        # mesh/axis gauges (ISSUE 14): the live topology next to the mode
+        # gauges — tools/serving_stats.py --run reports them per run
+        metrics.set_gauge("mesh.devices", self._mesh_devices)
+        metrics.set_gauge("mesh.model_axis", self._mesh_model)
+        metrics.set_gauge("mesh.data_axis", self._mesh_data)
         metrics.set_gauge("kernel.paged", int(self.paged_kernel))
         if self.paged_kernel:
             from ..ops import tuning as kernel_tuning
@@ -694,13 +781,19 @@ class ServingEngine:
         lora = self.lora
         n_layers = model.cfg.num_layers
         bs = self.block_size
+        use_kernel = self.paged_kernel
 
         def prefill(arrays, ids, true_len, pools, rows, samp, *lora_args):
             # trace-time bookkeeping (runs once per bucket, not per call)
             self.prefill_traces[p_bucket] = \
                 self.prefill_traces.get(p_bucket, 0) + 1
             compile_cache.bump("serving.prefill_compiles")
-            views = [_CapturePrefillView() for _ in range(n_layers)]
+            if use_kernel:
+                # trace-time: the full-prefill (pseudo-table) kernel twin
+                # of prefill_traces — admission churn never re-lowers it
+                metrics.bump("kernel.prefill_traces")
+            views = [_CapturePrefillView(bs, kernel=use_kernel)
+                     for _ in range(n_layers)]
             with _swap_data(self._objs, list(arrays)):
                 with prng.key_guard(jax.random.key(0)):
                     with (lora.bind(*lora_args) if lora is not None
@@ -1573,6 +1666,9 @@ class ServingEngine:
                "prefix_prefill_traces": dict(self.prefix_prefill_traces),
                "cow_traces": self.cow_traces,
                "chunk_size": self.chunk_size,
+               "mesh.key": self.mesh_key,
+               "mesh.model_axis": self._mesh_model,
+               "mesh.data_axis": self._mesh_data,
                "kernel.paged": int(self.paged_kernel),
                "quant.weights": int(self.quant_weights),
                "quant.kv": int(self.quant_kv),

@@ -110,9 +110,19 @@ class KVArena:
 
     def __init__(self, num_layers: int, num_heads: int, head_dim: int,
                  num_blocks: int, block_size: Optional[int] = None,
-                 dtype: str = "float32", quantized: bool = False):
+                 dtype: str = "float32", quantized: bool = False,
+                 mesh=None):
         import jax.numpy as jnp
 
+        # mesh-sharded pools (ISSUE 14): every pool entry — primary and
+        # namespace alike — is committed via sharding_util.shard_kv_entry
+        # (K/V payload heads-sharded over "model", scale pools
+        # replicated). The engine passes its captured mesh through
+        # _arena_args, so a supervisor rebuild reconstructs the SAME
+        # placement (same shardings => zero recompiles). All allocator /
+        # refcount / COW bookkeeping below is host-side numpy and never
+        # sees the layout. None = single-chip, byte-identical to PR 13.
+        self.mesh = mesh
         self.block_size = int(block_size or flags.flag("kv_block_size"))
         if self.block_size < 1:
             raise ValueError("kv_block_size must be >= 1")
@@ -167,10 +177,17 @@ class KVArena:
         shape = (self.num_blocks, self.block_size, int(num_heads),
                  int(head_dim))
         if not quantized:
-            return (jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
-        sshape = (self.num_blocks, self.block_size)
-        return (jnp.zeros(shape, "int8"), jnp.zeros(shape, "int8"),
-                jnp.zeros(sshape, "float32"), jnp.zeros(sshape, "float32"))
+            entry = (jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+        else:
+            sshape = (self.num_blocks, self.block_size)
+            entry = (jnp.zeros(shape, "int8"), jnp.zeros(shape, "int8"),
+                     jnp.zeros(sshape, "float32"),
+                     jnp.zeros(sshape, "float32"))
+        if self.mesh is None:
+            return entry
+        from ..distributed.sharding_util import shard_kv_entry
+
+        return shard_kv_entry(entry, self.mesh)
 
     @property
     def pools(self) -> List[Tuple]:
@@ -200,7 +217,8 @@ class KVArena:
                 "block_size": self.block_size,
                 "quantized": self.quantized,
                 "dtype": self.dtype,
-                "scratch_block": 0}
+                "scratch_block": 0,
+                "mesh": self.mesh_key()}
 
     def set_pools(self, pools) -> None:
         """Adopt the pool arrays returned by a compiled step (the old ones
@@ -466,6 +484,13 @@ class KVArena:
             record(name, pools, dtype, quantized)
         return out
 
+    def mesh_key(self):
+        """The arena's mesh fingerprint (None single-chip) — part of every
+        consumer's program-key story, surfaced next to the shape facts."""
+        from ..distributed.sharding_util import mesh_axes_key
+
+        return mesh_axes_key(self.mesh) if self.mesh is not None else None
+
     def stats(self) -> dict:
         return {
             "blocks_total": self.num_blocks - 1,
@@ -479,4 +504,5 @@ class KVArena:
             "quantized": self.quantized,
             "bytes_by_namespace": self.bytes_by_namespace(),
             "namespaces": len(self._ns_pools),
+            "mesh": self.mesh_key(),
         }

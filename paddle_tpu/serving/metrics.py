@@ -104,6 +104,10 @@ DOCUMENTED_NAMESPACES = (
     "requests", "tokens", "engine", "arena", "scheduler", "supervisor",
     "api", "prefix", "spec", "chunk", "quant", "gateway", "tenant",
     "sampling", "constrain", "lora", "kernel",
+    # mesh.* (ISSUE 14): the engine's captured device-mesh topology —
+    # mesh.devices / mesh.model_axis / mesh.data_axis gauges set at
+    # construction (docs/distributed.md "Tensor-parallel serving")
+    "mesh",
     "queue", "slots", "tokens_per_sec",
 )
 

@@ -18,8 +18,11 @@ recovery loop:
 2. **Rebuild** — ``ServingEngine.rebuild()`` drops the (possibly corrupt or
    donation-consumed) KV arena and resets all slot state — including the
    radix prefix tree, which indexed the dead arena's blocks. Compiled
-   programs depend only on shapes, so the rebuilt engine serves with ZERO
-   recompiles.
+   programs depend only on shapes — and, on a device mesh, on committed
+   shardings: the engine's ``_arena_args`` carry its captured mesh, so a
+   rebuilt arena re-commits the SAME model-axis pool placement and the
+   rebuilt engine serves with ZERO recompiles, tensor-parallel or not
+   (tests/test_mesh_serving.py asserts the mesh case).
 3. **Replay** — every live request is re-prefilled from its journal
    (``engine.admit(prompt, max_new, tokens=...)``): the prefill runs over
    ``prompt + tokens`` and emits the journal's next token, leaving the slot

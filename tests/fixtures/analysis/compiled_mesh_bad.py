@@ -1,0 +1,24 @@
+"""known-bad mesh hazards (ISSUE 14): a Python branch on a per-device
+traced value (`lax.axis_index`) -> traced-branch, and a mesh-committed
+pool donated into the sharded step then read again -> use-after-donate
+(the sharded buffer's memory was reused shard-by-shard — the read
+returns garbage on every device)."""
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec
+
+
+def sharded_step(pools, tokens):
+    rank = jax.lax.axis_index("model")
+    if rank == 0:                 # BAD: traced per-device branch — bakes
+        tokens = tokens + 1       # one shard's arm into every shard
+    return pools + tokens, tokens
+
+
+def serve(mesh, pools, tokens):
+    step = jax.jit(sharded_step, donate_argnums=(0,))
+    pools = jax.device_put(
+        pools, NamedSharding(mesh, PartitionSpec(None, "model")))
+    new_pools, out = step(pools, tokens)
+    leak = jnp.sum(pools)         # BAD: pools was donated above
+    return new_pools, out, leak

@@ -1,0 +1,25 @@
+"""known-good twin: per-device values stay in lax-land (`jnp.where` on
+the axis index, never a Python branch); mesh-size decisions read the
+STATIC mesh shape at trace time (legal — a different mesh is a different
+program key); the donated sharded pool is only ever read through the
+returned buffer."""
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec
+
+
+def sharded_step(pools, tokens, mp_degree: int):
+    rank = jax.lax.axis_index("model")
+    tokens = jnp.where(rank == 0, tokens + 1, tokens)  # lax select: fine
+    if mp_degree > 1:             # static mesh shape, closed at trace
+        tokens = jax.lax.psum(tokens, "model")
+    return pools + tokens, tokens
+
+
+def serve(mesh, pools, tokens):
+    step = jax.jit(sharded_step, donate_argnums=(0,), static_argnums=(2,))
+    pools = jax.device_put(
+        pools, NamedSharding(mesh, PartitionSpec(None, "model")))
+    checksum = jnp.sum(pools)     # read BEFORE the donating call: fine
+    pools, out = step(pools, tokens, mesh.shape.get("model", 1))
+    return pools, out, checksum

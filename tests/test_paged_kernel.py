@@ -168,6 +168,24 @@ def test_prefill_parity_every_tile():
                                        **_tol("float32"))
 
 
+@pytest.mark.parametrize("sq", [8, 12, 16])  # 12: non-divisible pad path
+def test_full_prefill_pseudo_table_parity(sq):
+    """The no-table entry (PR 13 open item): contiguous K/V through an
+    arange pseudo-table with prefix 0 equals plain causal attention —
+    including when sq doesn't divide the block size (pad keys sit above
+    every query row and are masked off)."""
+    rng = np.random.default_rng(6)
+    H, D, bs = 4, 32, 8
+    q = jnp.asarray(rng.standard_normal((sq, H, D)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((sq, H, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((sq, H, D)), jnp.float32)
+    out = pk.paged_full_prefill_attention(q, k, v, bs)
+    mask = (jnp.arange(sq)[None, :] <= jnp.arange(sq)[:, None])[None, None]
+    ref = masked_attention(q[None], k[None], v[None], mask)[0]
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               **_tol("float32"))
+
+
 def test_kernel_runtime_data_one_trace():
     """Tables, positions and prefix lengths are runtime data: one jit
     trace serves arbitrary churn of all three."""

@@ -156,7 +156,10 @@ def test_interleaved_beats_gpipe_wall_clock(tmp_path):
     in closed form. Runs the recorded bench (subprocess: it needs its own
     8-device env) at M=4 — the largest predicted gain (1.27x) — and
     accepts any measured win to stay robust to CPU noise; full M sweep
-    numbers live in benches/BASELINE_RESULTS.jsonl."""
+    numbers live in benches/BASELINE_RESULTS.jsonl. d=1024: below that,
+    per-tick dispatch overhead on the emulated CPU mesh (the interleaved
+    schedule runs ~1.6x the ticks at 1/V the compute each) cancels the
+    bubble win and the ratio is pure noise."""
     import json
     import os
     import subprocess
@@ -168,7 +171,7 @@ def test_interleaved_beats_gpipe_wall_clock(tmp_path):
          "import sys; sys.path.insert(0, '/root/repo/benches'); "
          "sys.path.insert(0, '/root/repo'); "
          "import pipeline_bench as b, json; "
-         "print('ROW ' + json.dumps(b.measure(4, d=512, iters=4)))"],
+         "print('ROW ' + json.dumps(b.measure(4, d=1024, iters=4)))"],
         capture_output=True, text=True, timeout=600, env=env,
         cwd="/root/repo")
     assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]

@@ -83,6 +83,12 @@ FIXTURE_CASES = [
     # table's static shape only)
     ("shape-from-data", "compiled_paged", ()),
     ("traced-branch", "compiled_paged", ()),
+    # the ISSUE 14 mesh shape: a Python branch on a per-device traced
+    # value (lax.axis_index — the mesh-aware tracedness extension) and a
+    # mesh-committed pool donated into the sharded step then read again
+    # (the donation rule over NamedSharding-placed buffers)
+    ("traced-branch", "compiled_mesh", ()),
+    ("use-after-donate", "compiled_mesh", ()),
     ("undefined-flag", "registry_flags",
      ("paddle_tpu/core/flags.py",)),
     ("unknown-metric-key", "registry_metrics",
@@ -132,6 +138,10 @@ def test_bad_fixtures_are_specific():
             # content shape + traced block-count branch (the int() cast
             # feeding it legitimately co-fires traced-cast)
             allowed |= {"shape-from-data", "traced-branch", "traced-cast"}
+        if stem == "compiled_mesh":
+            # deliberately seeds BOTH mesh hazards: per-device traced
+            # branch + donated sharded pool read-back
+            allowed |= {"traced-branch", "use-after-donate"}
         assert rules <= allowed, (stem, rules)
 
 

@@ -50,6 +50,10 @@ The Pallas paged-attention kernels (``FLAGS_serving_paged_kernel``,
 after warmup in a healthy run — churn never re-lowers a kernel) and the
 end-of-run ``kernel.paged`` / ``kernel.tuned_entries`` gauges (mode +
 tuning-store coverage for this chip, benches/TUNED_KERNELS.json).
+The mesh-sharded execution core (ISSUE 14, docs/distributed.md) adds the
+``mesh.devices`` / ``mesh.model_axis`` / ``mesh.data_axis`` topology
+gauges — a tensor-parallel run shows ``mesh.model_axis`` > 1 with the
+same frozen compile counters as a single chip.
 The multi-tenant gateway's counters ride it too (``serving.gateway``):
 ``gateway.routed`` / ``gateway.rerouted`` (journaled fail-over) /
 ``gateway.ejected`` / ``gateway.respawned`` (replica health) /
@@ -175,7 +179,8 @@ def main(argv=None) -> int:
                   if k.split(".")[0] in ("arena", "prefix", "slots",
                                          "spec", "queue", "quant",
                                          "gateway", "tenant", "sampling",
-                                         "constrain", "lora", "kernel")}
+                                         "constrain", "lora", "kernel",
+                                         "mesh")}
         rec = {"wall_secs": round(wall, 3), "stats": delta,
                "gauges": gauges,
                "tokens_per_sec": round(toks / wall, 2) if wall > 0 else None}
