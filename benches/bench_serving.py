@@ -32,6 +32,18 @@ rows, so the timed windows must show zero). Persisted into
 Env: SERVING_PREFIX_REQUESTS (default 32), SERVING_PREFIX_PROMPTS (K,
 default 3), SERVING_PREFIX_SYS (system-prompt tokens, block-aligned).
 
+``--tiered`` runs the tiered-KV-cache workload (ISSUE 15,
+``FLAGS_serving_kv_tiering`` / ``serving.tiered``): a shared-prefix
+working set ~10x the arena's allocatable blocks over K distinct system
+prompts, served by three builds — spill-off eviction (re-prefill on
+every evicted-prefix re-admission), host-RAM tier, and a tiny-host-
+budget build overflowing to a crc-checked disk tier. Gates: combined
+(device+host+disk) hit rate >= 80%, tiered tokens/s >= 1.4x spill-off,
+0 serving compiles in every timed window (the compiled restore scatter
+included), token parity across builds. Persisted under ``"tiered"``.
+Env: TIERED_REQUESTS (default 120), TIERED_PROMPTS (K, default 20),
+TIERED_SYS (system-prompt tokens, block-aligned).
+
 ``--gateway`` runs the multi-tenant offered-load bench (ISSUE 8): a
 2-replica ``serving.gateway.ReplicaPool`` under three tenants — one
 offering 2x its token-bucket quota, two compliant — with a chaos
@@ -210,7 +222,8 @@ def run_engine(api, workload):
     compiles = sum(cc1.get(k, 0) - cc0.get(k, 0)
                    for k in ("serving.decode_compiles",
                              "serving.prefill_compiles",
-                             "serving.cow_compiles"))
+                             "serving.cow_compiles",
+                             "serving.restore_compiles"))
     toks = sum(w["new"] for w in workload)
     return {"tokens_per_sec": toks / wall, "wall_secs": wall,
             "latency_p50": _percentile(lat, 50),
@@ -359,6 +372,161 @@ def _persist(key, rec):
         f.write("\n")
 
 
+def run_tiered(model, platform):
+    """ISSUE 15: the tiered-KV-cache workload — a shared-prefix working
+    set sized ~10x the arena's allocatable capacity over K distinct
+    system prompts, so cached prefixes are constantly evicted. Three
+    engine builds serve the same offered load: spill-off (eviction
+    discards — every re-admission of an evicted prefix re-pays its full
+    prefill), tiered with a host-RAM tier, and tiered with a deliberately
+    tiny host budget overflowing to a disk tier (crc-checked files).
+    Gates: combined (device+host+disk) prefix hit rate >= 80%, tiered
+    aggregate tokens/s >= 1.4x spill-off, ZERO serving compiles in every
+    timed window (the restore path included — restores are one warm
+    compiled scatter with the dst block id as runtime data), and
+    token-for-token parity across all three builds."""
+    import shutil
+    import tempfile
+
+    from paddle_tpu.serving import HostKVCache, ServingAPI
+    from paddle_tpu.serving import metrics as serving_metrics
+
+    if platform == "tpu":
+        sys_len = int(os.environ.get("TIERED_SYS", "448"))
+        tail_len, new_tokens, gap_ms = 16, 16, 5.0
+        bs = 16
+    else:
+        sys_len = int(os.environ.get("TIERED_SYS", "256"))
+        tail_len, new_tokens, gap_ms = 8, 4, 2.0
+        bs = 16
+    n_requests = int(os.environ.get("TIERED_REQUESTS", "84"))
+    k_prompts = int(os.environ.get("TIERED_PROMPTS", "14"))
+    seed = int(os.environ.get("SERVING_SEED", "0"))
+    max_len = sys_len + tail_len + new_tokens
+    blocks_per_prefix = sys_len // bs
+    per_req_blocks = -(-max_len // bs)
+    # arena sized so the K shared prefixes are ~10x its allocatable
+    # capacity (two requests must still fit live)
+    working_set = k_prompts * blocks_per_prefix
+    alloc_blocks = max(working_set // 10, per_req_blocks + 4)
+    num_blocks = alloc_blocks + 1
+    num_slots = 2
+
+    rng = np.random.default_rng(seed)
+    workload = make_shared_prefix_workload(
+        rng, n_requests, k_prompts, sys_len, tail_len, new_tokens,
+        gap_ms / 1e3, model.cfg.vocab_size)
+
+    disk_dir = tempfile.mkdtemp(prefix="tiered_kv_")
+    configs = [
+        ("spill_off", dict(kv_tiering=False), None),
+        ("tiered_host", dict(kv_tiering=True), (1 << 40, "")),
+        ("tiered_disk", dict(kv_tiering=True), (None, disk_dir)),
+    ]
+    runs, parities = {}, {}
+    try:
+        for label, kw, tier_cfg in configs:
+            store = None
+            if tier_cfg is not None:
+                budget, ddir = tier_cfg
+                if budget is None:
+                    # measured per-entry bytes: cap the host tier at ~25%
+                    # of the working set so ~75% of hits come off disk
+                    entry_b = max(1, _tier_entry_bytes(model, bs))
+                    budget = max(entry_b, working_set * entry_b // 4)
+                store = HostKVCache(max_bytes=budget, disk_dir=ddir)
+            api = ServingAPI(model, num_slots=num_slots,
+                             kv_block_size=bs, max_model_len=max_len,
+                             num_blocks=num_blocks, prefix_cache=True,
+                             tier_store=store, **kw)
+            # warm every program the timed window touches: the full
+            # prefill bucket, the suffix bucket (a still-resident warm
+            # prefix re-admission), the decode step, and — by cycling two
+            # warm prefixes through the tiny arena — the spill + compiled
+            # restore path. Warm prefixes are distinct from the
+            # workload's, so the window still pays its own cold misses.
+            warm = [rng.integers(0, model.cfg.vocab_size, (sys_len,),
+                                 dtype=np.int32) for _ in range(2)]
+            for wsys in (warm[0], warm[0], warm[1], warm[0]):
+                tail = rng.integers(0, model.cfg.vocab_size, (tail_len,),
+                                    dtype=np.int32)
+                api.submit(np.concatenate([wsys, tail]), max_new_tokens=2)
+                api.run_until_idle()
+            if kw.get("kv_tiering"):
+                assert api.engine.restore_traces == 1, (
+                    "warmup never exercised the compiled restore path")
+            sm0 = serving_metrics.stats()
+            rec = run_engine(api, workload)
+            sm1 = serving_metrics.stats()
+            hits = sm1.get("prefix.hits", 0) - sm0.get("prefix.hits", 0)
+            misses = (sm1.get("prefix.misses", 0)
+                      - sm0.get("prefix.misses", 0))
+            rec["prefix_hits"] = int(hits)
+            rec["prefix_misses"] = int(misses)
+            rec["hit_rate"] = round(hits / max(1, hits + misses), 4)
+            for key in ("tier.restored_blocks", "tier.spilled_blocks",
+                        "tier.host_hits", "tier.disk_hits", "tier.misses",
+                        "tokens.prefill_avoided"):
+                rec[key] = sm1.get(key, 0) - sm0.get(key, 0)
+            runs[label] = rec
+            parities[label] = [list(w["req"].tokens) for w in workload]
+            print(f"# tiered {label}: {rec['tokens_per_sec']:.1f} tok/s, "
+                  f"hit-rate {100 * rec['hit_rate']:.0f}%, "
+                  f"restored {rec['tier.restored_blocks']} "
+                  f"(host {rec['tier.host_hits']} / "
+                  f"disk {rec['tier.disk_hits']}), "
+                  f"compiles={rec['compiles_during_run']}", flush=True)
+            api.close()
+    finally:
+        shutil.rmtree(disk_dir, ignore_errors=True)
+
+    speedup = (runs["tiered_host"]["tokens_per_sec"]
+               / runs["spill_off"]["tokens_per_sec"])
+    combined_rate = runs["tiered_host"]["hit_rate"]
+    # ---- acceptance gates --------------------------------------------
+    for label, rec in runs.items():
+        assert rec["compiles_during_run"] == 0, (label, rec)
+        assert parities[label] == parities["spill_off"], (
+            f"{label} diverged from spill_off on the same greedy workload")
+    assert combined_rate >= 0.80, (
+        f"combined hit rate {combined_rate} < 0.80 gate")
+    assert speedup >= 1.4, (
+        f"tiered tokens/s only {speedup:.2f}x spill-off (gate 1.4x)")
+    assert runs["tiered_disk"]["tier.disk_hits"] > 0, (
+        "the disk-tier build never hit disk — budget sizing is off")
+
+    rec = {
+        "bench": "serving_tiered_kv",
+        "metric": f"tiered-KV tokens/sec (N={n_requests} K={k_prompts} "
+                  f"sys{sys_len} 10x-arena {platform})",
+        "value": round(runs["tiered_host"]["tokens_per_sec"], 1),
+        "unit": "tokens/sec",
+        "platform": platform,
+        "requests": n_requests,
+        "distinct_prompts": k_prompts,
+        "sys_len": sys_len,
+        "arena_blocks": num_blocks - 1,
+        "working_set_blocks": working_set,
+        "working_set_x_arena": round(working_set / (num_blocks - 1), 2),
+        "combined_hit_rate": combined_rate,
+        "speedup_vs_spill_off": round(speedup, 2),
+        "compiles_during_run":
+            runs["tiered_host"]["compiles_during_run"],
+        "runs": {k: {kk: (round(vv, 4) if isinstance(vv, float) else vv)
+                     for kk, vv in r.items()} for k, r in runs.items()},
+    }
+    _persist("tiered", rec)
+
+
+def _tier_entry_bytes(model, block_size):
+    """Host bytes of one spilled block entry for this model's arena
+    layout (pure shape arithmetic — no pools are allocated)."""
+    cfg = model.cfg
+    head_dim = cfg.hidden_size // cfg.num_heads
+    per_array = block_size * cfg.num_heads * head_dim * 4  # f32
+    return cfg.num_layers * 2 * per_array
+
+
 def run_speculative(model, platform):
     """Single-stream decode speed with speculative decoding (ISSUE 10).
 
@@ -421,7 +589,8 @@ def run_speculative(model, platform):
             compiles = sum(cc1.get(kk, 0) - cc0.get(kk, 0)
                            for kk in ("serving.decode_compiles",
                                       "serving.prefill_compiles",
-                                      "serving.cow_compiles"))
+                                      "serving.cow_compiles",
+                                      "serving.restore_compiles"))
             for p, ref, r in zip(prompts, refs, reqs):
                 assert r.state == RequestState.FINISHED
                 np.testing.assert_array_equal(r.output_ids(), ref)
@@ -550,7 +719,8 @@ def run_chunked_prefill(model, platform):
             compiles = sum(cc1.get(kk, 0) - cc0.get(kk, 0)
                            for kk in ("serving.decode_compiles",
                                       "serving.prefill_compiles",
-                                      "serving.cow_compiles"))
+                                      "serving.cow_compiles",
+                                      "serving.restore_compiles"))
             np.testing.assert_array_equal(stream.output_ids(), stream_ref)
             for r, ref in zip(lreqs, long_refs):
                 assert r.state == RequestState.FINISHED
@@ -1415,7 +1585,8 @@ def run_gateway(model, platform):
     compiles = sum(cc1.get(k, 0) - cc0.get(k, 0)
                    for k in ("serving.decode_compiles",
                              "serving.prefill_compiles",
-                             "serving.cow_compiles"))
+                             "serving.cow_compiles",
+                             "serving.restore_compiles"))
 
     # ---- acceptance gates -------------------------------------------------
     assert killed, "the chaos kill never fired (replica 0 had no work?)"
@@ -1509,6 +1680,21 @@ def main():
     platform = jax.devices()[0].platform
     if "--sharded" in sys.argv:
         run_sharded(platform)
+        return
+    if "--tiered" in sys.argv:
+        # the CPU build is mid-size on purpose: tiering trades prefill
+        # COMPUTE for one compiled scatter + host->device copies, so the
+        # bench model must have real prefill cost (gpt_tiny's prefill is
+        # cheaper than any dispatch, which would measure overhead, not
+        # the tradeoff any serving-scale model actually faces)
+        cfg = (GPTConfig(vocab_size=50304, hidden_size=768, num_layers=12,
+                         num_heads=12, max_position_embeddings=2048)
+               if platform == "tpu" else
+               GPTConfig(vocab_size=2048, hidden_size=256, num_layers=4,
+                         num_heads=8, max_position_embeddings=512))
+        model = GPTForCausalLM(cfg)
+        model.eval()
+        run_tiered(model, platform)
         return
     if "--speculative" in sys.argv:
         cfg = (GPTConfig(vocab_size=50304, hidden_size=768, num_layers=12,

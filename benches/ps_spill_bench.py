@@ -1,7 +1,16 @@
-"""Beyond-RAM sparse-table benchmark: a multi-GB Wide&Deep embedding table
-behind a hard resident-RAM cap, spilling cold rows to disk
+"""LEGACY bench (predates the serving stack): beyond-RAM *sparse-table*
+spill for the parameter-server path — a multi-GB Wide&Deep embedding
+table behind a hard resident-RAM cap, spilling cold rows to disk
 (the SSD-table story, ref:paddle/fluid/distributed/ps/table/
 ssd_sparse_table.cc; accessor ref:.../ctr_accessor.cc).
+
+NOTE: this exercises ``distributed.ps.EmbeddingService``'s own row pager,
+NOT the serving stack's tiered KV cache (``serving.tiered``,
+``benches/bench_serving.py --tiered``). The two spill for different
+objects — per-row embedding state keyed by feature id vs per-block KV
+keyed by prefix content hash — so the PS pager was deliberately left on
+its own store; this file stays in the inventory as the training-side
+spill record.
 
 Drives the REAL Wide&Deep model + PS client path: every step touches a
 fresh slice of a huge id space (recommender long-tail access pattern), so

@@ -183,6 +183,33 @@ define_flag("serving_cache_affinity", 0,
             "the radix cache. Bounded so a cache-cold head request is "
             "never starved past this window. 0 disables the preference "
             "(strict PR 5 admission order).")
+define_flag("serving_kv_tiering", False,
+            "Tiered KV cache (serving.tiered): instead of discarding an "
+            "evicted refcount-zero cached prefix block, spill its pool "
+            "rows (int8 payload + scales as one unit) to a host-RAM tier "
+            "keyed by the radix cache's content hashes, overflowing to an "
+            "on-disk tier; a radix hit on a spilled block restores it via "
+            "ONE compiled scatter (zero new compiles per restore). "
+            "Requires FLAGS_serving_prefix_cache. 0 (default) keeps the "
+            "PR 14 behavior bit-for-bit: eviction frees the block and its "
+            "prefill is recomputed on the next hit.")
+define_flag("serving_host_cache_bytes", 256 * 1024 * 1024,
+            "Byte budget of the host-RAM KV spill tier "
+            "(serving.tiered.HostKVCache, shared across gateway "
+            "replicas). LRU entries past the budget overflow to "
+            "FLAGS_serving_disk_cache_dir when set, else drop (the next "
+            "hit recomputes). Only read when FLAGS_serving_kv_tiering.")
+define_flag("serving_disk_cache_dir", "",
+            "Directory of the on-disk KV spill tier (third tier under "
+            "HBM -> host RAM). Files are written atomically "
+            "(tmp + rename) and crc-checked on load — a corrupt or "
+            "truncated entry falls back to recompute, never serves "
+            "garbage. Empty (default) disables the disk tier.")
+define_flag("serving_disk_cache_bytes", 8 * 1024 * 1024 * 1024,
+            "Byte budget of the on-disk KV spill tier: past it the "
+            "oldest-written entries are deleted (a churning working set "
+            "must never fill the disk). Only read when "
+            "FLAGS_serving_disk_cache_dir is set.")
 define_flag("serving_arena_invariants", False,
             "Audit the refcount layer after every release path (retire, "
             "cancel, preemption, drain stragglers): free-list blocks must "

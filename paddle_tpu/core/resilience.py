@@ -77,6 +77,10 @@ DOCUMENTED_NAMESPACES = (
     # lives in serving.metrics; this entry reserves the namespace so the
     # resilience dashboards can mirror kernel fallbacks and tune state
     "kernel",
+    # tiered KV cache (ISSUE 15, serving.tiered): tier.disk_corrupt —
+    # a spill file failing its crc on load (deleted + recomputed, never
+    # served) is a resilience event the shared dashboards must see
+    "tier",
 )
 
 

@@ -12,6 +12,10 @@ served endpoint), rebuilt as an Orca/vLLM-style decode runtime:
   full prompt blocks; admissions attach matched prefixes by reference
   (copy-on-write when a shared block must be written) and prefill only
   their unmatched suffix (``FLAGS_serving_prefix_cache``).
+* :mod:`.tiered`    — ``HostKVCache``/``TierView``: the tiered KV cache —
+  evicted cached blocks spill to a shared host-RAM tier (overflowing to
+  crc-checked disk files) keyed by the radix content hashes, restored on
+  hit via one compiled scatter (``FLAGS_serving_kv_tiering``).
 * :mod:`.spec_decode` — ``SpecDecoder``: speculative decoding — a draft
   GPT proposes k tokens into a second KV-arena namespace and the target
   verifies all k in one batched compiled call, bit-identical to plain
@@ -71,6 +75,10 @@ _LAZY = {
     "AdapterExhaustedError": ("adapters", "AdapterExhaustedError"),
     "EngineSupervisor": ("supervisor", "EngineSupervisor"),
     "CrashLoopError": ("supervisor", "CrashLoopError"),
+    # tiered KV cache (ISSUE 15): host-RAM/disk spill tiers under the
+    # radix prefix cache, shared across gateway replicas
+    "HostKVCache": ("tiered", "HostKVCache"),
+    "TierView": ("tiered", "TierView"),
     "ServingAPI": ("api", "ServingAPI"),
     "EnginePredictor": ("api", "EnginePredictor"),
     "drain_all": ("api", "drain_all"),
@@ -78,6 +86,7 @@ _LAZY = {
     # quotas, HTTP/SSE front door
     "ReplicaPool": ("gateway.router", "ReplicaPool"),
     "RoutedRequest": ("gateway.router", "RoutedRequest"),
+    "GlobalRadixIndex": ("gateway.router", "GlobalRadixIndex"),
     "NoHealthyReplicaError": ("gateway.router", "NoHealthyReplicaError"),
     "TenantConfig": ("gateway.tenancy", "TenantConfig"),
     "TenantManager": ("gateway.tenancy", "TenantManager"),

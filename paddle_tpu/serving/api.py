@@ -581,6 +581,24 @@ class EnginePredictor:
                           cache.hit_tokens)
         else:
             prefix = ""
+        tier_view = getattr(api.engine, "tier", None)
+        if tier_view is not None and (tier_view.host_hits
+                                      or tier_view.disk_hits
+                                      or tier_view.misses
+                                      or tier_view.spilled_blocks):
+            # the tiered-KV picture next to the prefix hit-rate: how many
+            # spilled-block lookups each tier answered (a miss = the
+            # entry was lost and the prefix recomputed)
+            lookups = (tier_view.host_hits + tier_view.disk_hits
+                       + tier_view.misses)
+            rate = (100.0 * (tier_view.host_hits + tier_view.disk_hits)
+                    / lookups) if lookups else 0.0
+            tier = (", tier hit-rate %.0f%% (%d host / %d disk hits, "
+                    "%d blocks spilled, %d restored)") % (
+                        rate, tier_view.host_hits, tier_view.disk_hits,
+                        tier_view.spilled_blocks, tier_view.restored_blocks)
+        else:
+            tier = ""
         spec = api.engine.spec
         if spec is not None and spec.proposed:
             speculation = (", speculation %d proposed / %d accepted "
@@ -626,8 +644,8 @@ class EnginePredictor:
         _logger.info(
             "EnginePredictor closed: %d finished, %d failed, "
             "%d supervisor replays (%d rebuilds), %d preemptions, "
-            "%d drains%s%s%s%s",
+            "%d drains%s%s%s%s%s",
             self._finished, self._failed,
             api.supervisor.replay_count, api.supervisor.rebuild_count,
-            api.scheduler.preempt_count, api.drain_count, prefix,
+            api.scheduler.preempt_count, api.drain_count, prefix, tier,
             speculation, quant, scenario)

@@ -70,6 +70,18 @@ Greedy tokens are parity-asserted against the single-device engine;
 a 1-device mesh is bit-identical to no mesh
 (tests/test_mesh_serving.py).
 
+**Tiered KV cache** (ISSUE 15 — ``FLAGS_serving_kv_tiering``,
+:mod:`paddle_tpu.serving.tiered`): with the prefix cache on, an evicted
+refcount-zero cached block spills its pool rows to a shared host-RAM tier
+(overflowing to disk) keyed by the radix cache's content hashes instead
+of discarding them; a later radix hit restores the rows into a fresh
+block through ONE compiled scatter (:meth:`ServingEngine._get_restore` —
+the ``_cow_copy`` template, dst block id as runtime data, zero new
+compiles per restore). The tiers are off-device, so they survive
+supervisor rebuilds (warm-cache replay) and are shared across gateway
+replicas (a prefill on replica A is a host-tier hit on replica B).
+Default off — eviction then discards exactly as before.
+
 Two flag-gated multi-token extensions ride the same no-recompile
 contract: **speculative decoding** (``FLAGS_serving_spec_k`` —
 :mod:`paddle_tpu.serving.spec_decode`: a draft model proposes k tokens
@@ -93,7 +105,7 @@ import numpy as np
 from ..core import compile_cache, flags, resilience
 from ..core.tensor import Tensor
 from . import metrics
-from .kv_arena import KVArena, Reservation
+from .kv_arena import ArenaExhaustedError, KVArena, Reservation
 from .prefix_cache import PrefixCache
 from .spec_decode import SpecDecoder
 
@@ -345,6 +357,17 @@ class ServingConfig:
     # radix prefix cache (content-addressed KV block sharing); None defers
     # to FLAGS_serving_prefix_cache
     prefix_cache: Optional[bool] = None
+    # tiered KV cache (ISSUE 15 — serving.tiered / docs/serving.md
+    # "Tiered KV cache"): None defers to FLAGS_serving_kv_tiering
+    # (default off = PR 14 eviction behavior bit-for-bit). Requires the
+    # prefix cache; evicted refcount-zero cached blocks spill to a
+    # host-RAM/disk tier keyed by content hash and restore via one
+    # compiled scatter on the next radix hit.
+    kv_tiering: Optional[bool] = None
+    # the shared tiered.HostKVCache to attach to (gateway replicas pass
+    # ONE store so a prefix prefilled on replica A is a host-tier hit on
+    # replica B); None = the process-global store when tiering is on
+    tier_store: Optional[object] = None
     # retry transient (OSError/timeout) step failures — only honored with
     # donation OFF: a donated call that died may have consumed its buffers,
     # so retrying it would replay invalidated state
@@ -570,7 +593,27 @@ class ServingEngine:
         self.use_prefix_cache = (bool(flags.flag("serving_prefix_cache"))
                                  if cfg.prefix_cache is None
                                  else bool(cfg.prefix_cache))
-        self.prefix_cache = (PrefixCache(self.arena, self.block_size)
+        # tiered KV cache (ISSUE 15): the TierView survives rebuild()
+        # untouched — host/disk tiers are off-device by construction, so
+        # crash recovery replays against a warm cache. The view's arena
+        # signature (shape facts + quant mode + mesh fingerprint) keeps
+        # incompatible engines from ever exchanging entries through a
+        # shared store.
+        self.kv_tiering = (bool(flags.flag("serving_kv_tiering"))
+                           if cfg.kv_tiering is None
+                           else bool(cfg.kv_tiering))
+        self.tier = None
+        if self.kv_tiering and self.use_prefix_cache:
+            from .tiered import TierView, get_tier_store
+
+            store = (cfg.tier_store if cfg.tier_store is not None
+                     else get_tier_store())
+            self.tier = TierView(store, signature=(
+                mcfg.num_layers, mcfg.num_heads,
+                mcfg.hidden_size // mcfg.num_heads, self.block_size,
+                kv_dtype, self.quant_kv, self.mesh_key))
+        self.prefix_cache = (PrefixCache(self.arena, self.block_size,
+                                         tier=self.tier)
                              if self.use_prefix_cache else None)
 
         s = self.num_slots
@@ -633,10 +676,12 @@ class ServingEngine:
         self.prefill_traces: Dict[int, int] = {}
         self.prefix_prefill_traces: Dict[int, int] = {}
         self.cow_traces = 0
+        self.restore_traces = 0  # tier restore: one trace per arena shape
         self._step_jit = None
         self._prefill_jits: Dict[int, object] = {}
         self._prefix_jits: Dict[int, object] = {}
         self._cow_jit = None
+        self._restore_jit = None
         # speculative decoding sidecar (draft or lockstep self-draft);
         # built after the arena so the draft namespace can bind to it
         self.spec = (SpecDecoder(self, cfg.draft_model, spec_k)
@@ -656,6 +701,7 @@ class ServingEngine:
             # gauge: a chip with 0 entries runs the safe default launch
             # params until a tune bench adopts better ones
             metrics.set_gauge("kernel.tuned_entries", kernel_tuning.entries())
+        metrics.set_gauge("tier.enabled", int(self.tier is not None))
         metrics.set_gauge("quant.weights", int(self.quant_weights))
         metrics.set_gauge("quant.kv", int(self.quant_kv))
         metrics.set_gauge("quant.draft", int(self.quant_draft
@@ -749,9 +795,15 @@ class ServingEngine:
         # matched prefix blocks attach by reference to the TARGET table
         # only (the draft namespace, when present, always prefills its own
         # private blocks — its budget in `need` is untouched)
-        matched, unpinned = self.prefix_cache.match_stats(prompt, keys=keys)
+        resident, spilled, unpinned = self.prefix_cache.match_stats(
+            prompt, keys=keys)
+        matched = resident + spilled
         if matched:
-            need -= matched
+            # only DEVICE-resident blocks are free (attach by reference);
+            # a matched-but-SPILLED block avoids the prefill compute but
+            # still consumes one fresh block as its restore target —
+            # restore cost, not prefill cost — so it stays in the budget
+            need -= resident
             if matched * self.block_size >= prompt_len:
                 need += 1  # COW copy of the last fully-matched block
         return need, unpinned
@@ -911,6 +963,96 @@ class ServingEngine:
                                name="serving.cow_copy")
         self.arena.set_pools(new_pools)
         metrics.bump("prefix.cow_copies")
+
+    def _get_restore(self):
+        """Compiled tier-restore scatter (ISSUE 15): write a whole
+        spilled CHAIN's host rows — every layer, EVERY array of the pool
+        entry, so an int8 arena's payload and its per-row scales land
+        together — into their destination blocks in one call. The
+        :meth:`_cow_copy` gather/scatter is the template scaled to a
+        fixed batch: ``dsts`` is a runtime ``[blocks_per_slot]`` id
+        vector and the stacked payload rows are runtime data of fixed
+        per-arena shapes (shorter chains pad with zero rows scattered
+        into scratch block 0, exactly like padded prefill positions), so
+        every restore of every admission reuses ONE program — zero new
+        compiles per restore, trace-asserted via ``restore_traces``."""
+        if self._restore_jit is None:
+            import jax
+
+            def restore(pools, rows, dsts):
+                self.restore_traces += 1
+                compile_cache.bump("serving.restore_compiles")
+                return [tuple(p.at[dsts].set(r) for p, r in zip(entry, row))
+                        for entry, row in zip(pools, rows)]
+
+            self._restore_jit = (jax.jit(restore, donate_argnums=(0,))
+                                 if self.donate else jax.jit(restore))
+        return self._restore_jit
+
+    def _restore_nodes(self, nodes) -> int:
+        """Restore a spilled radix chain's KV into fresh arena blocks:
+        load the host rows from the tier, take cached refcount-zero
+        blocks (evicting colder prefixes under pressure), scatter ALL of
+        them through the one compiled restore program, and re-point each
+        node at its block — from there they are indistinguishable from
+        prefix blocks that never left the device. Stops at the first
+        node whose tier entry was lost (pruned — the caller's match
+        truncates there and the remainder prefills: recompute, never
+        garbage) or when the arena has no headroom for another restore
+        target. Returns how many leading nodes of ``nodes`` were
+        restored."""
+        cache = self.prefix_cache
+        payloads, live = [], []
+        for node in nodes:
+            if len(live) >= self.blocks_per_slot:
+                break  # a chain can never exceed one slot's table anyway
+            payload = self.tier.lookup(node.key)
+            if payload is None:
+                cache.prune_lost(node)
+                break
+            payloads.append(payload)
+            live.append(node)
+        if not live:
+            return 0
+        blks: List[int] = []
+        for _ in live:
+            try:
+                blks.append(self.arena.take_cached_block())
+            except ArenaExhaustedError:
+                break  # restore what fits; the tail prefills normally
+        if not blks:
+            return 0
+        live, payloads = live[:len(blks)], payloads[:len(blks)]
+        batch = self.blocks_per_slot
+        dsts = np.zeros(batch, np.int32)
+        dsts[:len(blks)] = blks
+        rows = []
+        for li in range(len(payloads[0])):
+            entry_rows = []
+            for ai in range(len(payloads[0][li])):
+                base = [pl[li][ai] for pl in payloads]
+                pad = np.zeros_like(base[0])
+                entry_rows.append(
+                    np.stack(base + [pad] * (batch - len(base))))
+            rows.append(tuple(entry_rows))
+        import jax.numpy as jnp
+
+        try:
+            new_pools = self._call(self._get_restore(), self.arena.pools,
+                                   rows, jnp.asarray(dsts),
+                                   name="serving.tier_restore")
+        # analysis: allow(broad-except) — cleanup-and-reraise: a failed
+        # restore scatter must return the taken blocks before the error
+        # reaches the admission unwind / supervisor
+        except Exception:
+            for blk in blks:
+                self.arena.uncache(blk)
+            raise
+        self.arena.set_pools(new_pools)
+        for node, blk in zip(live, blks):
+            cache.mark_restored(node, blk)
+        self.tier.note_restored(payloads)
+        return len(live)
 
     def _get_step(self):
         if self._step_jit is not None:
@@ -1101,8 +1243,35 @@ class ServingEngine:
         # reference (refcount++, zero prefill work for the matched prefix).
         # The refs are taken BEFORE reserve() so its eviction pass can
         # never reclaim the very blocks this admission is about to share.
+        # With tiering the chain is a resident prefix followed by a
+        # SPILLED tail (a resident node's ancestors are resident by
+        # construction): each resident node is pinned the moment it is
+        # reached — so the evictions a restore may trigger can never
+        # reclaim it — and each spilled node is first restored into a
+        # fresh cached block (ONE compiled scatter, _restore_node), then
+        # pinned identically. A restore that fails (tier lost the entry /
+        # no headroom) truncates the match there: the remainder prefills
+        # normally — recompute, never garbage.
         cache = self.prefix_cache
-        chain = cache.match(prompt) if cache is not None else []
+        walked = cache.match(prompt) if cache is not None else []
+        chain = []
+        try:
+            split = next((i for i, n in enumerate(walked) if n.spilled),
+                         len(walked))
+            for node in walked[:split]:
+                self.arena.ref(node.block)
+                chain.append(node)
+            if split < len(walked) and self.tier is not None:
+                restored = self._restore_nodes(walked[split:])
+                for node in walked[split:split + restored]:
+                    self.arena.ref(node.block)
+                    chain.append(node)
+        # analysis: allow(broad-except) — cleanup-and-reraise: a restore
+        # dying mid-chain must drop every ref taken so far
+        except Exception:
+            for node in chain:
+                self.arena.deref(node.block)
+            raise
         # a fully-matched block-aligned context has no suffix to prefill,
         # but the last token must still be recomputed for its logits: the
         # last matched block is copied into a private block (COW) and the
@@ -1110,15 +1279,14 @@ class ServingEngine:
         cow = bool(chain) and len(chain) * self.block_size == clen
         attached = chain[:-1] if cow else chain
         shared = [node.block for node in attached]
-        for blk in shared:
-            self.arena.ref(blk)
-        # the COW source is read, not attached — but it must be pinned
+        # the COW source is read, not attached — but it must stay pinned
         # across reserve() too, or the eviction pass could reclaim (and a
         # recycled take() could overwrite) the block _cow_copy is about to
-        # read; admit_sizing's unpinned count already budgets for this pin
+        # read. Every chain node already holds this admission's ref from
+        # the loop above: `shared` names the ones retire dereferences,
+        # `cow_src`'s ref is the COW pin released right after the copy;
+        # admit_sizing's unpinned count already budgets for these pins
         cow_src: Optional[int] = chain[-1].block if cow else None
-        if cow_src is not None:
-            self.arena.ref(cow_src)
         try:
             res = self.arena.reserve(
                 self._target_blocks_needed(plen, max_new_tokens)
@@ -1126,10 +1294,8 @@ class ServingEngine:
         # analysis: allow(broad-except) — cleanup-and-reraise: any
         # reservation failure must drop the refs taken above
         except Exception:
-            for blk in shared:
-                self.arena.deref(blk)
-            if cow_src is not None:
-                self.arena.deref(cow_src)
+            for node in chain:
+                self.arena.deref(node.block)
             raise
         # a spec-ineligible lane (sampled/constrained/adapter — sticky,
         # see spec_ineligible) never reads its draft cache: skip the
@@ -1474,13 +1640,24 @@ class ServingEngine:
         # fresh arena — journal replays re-populate it (and re-share) as
         # they re-prefill. Lifetime counters carry over: stats()/close()
         # summaries cover the engine's whole life, not just post-rebuild.
+        # The TIER VIEW survives untouched: host/disk entries are
+        # off-device by construction, so replay walks hit the tier and
+        # RESTORE the crashed arena's prefixes instead of re-prefilling
+        # them — warm-cache replay for free.
         if self.use_prefix_cache:
             old = self.prefix_cache
-            self.prefix_cache = PrefixCache(self.arena, self.block_size)
+            self.prefix_cache = PrefixCache(self.arena, self.block_size,
+                                            tier=self.tier)
             if old is not None:
                 for k in ("hits", "misses", "hit_tokens",
-                          "inserted_blocks", "evictions"):
+                          "inserted_blocks", "evictions", "spills",
+                          "restores"):
                     setattr(self.prefix_cache, k, getattr(old, k))
+                if old._index is not None:
+                    # rebind the cross-replica residency index; binding
+                    # resets this replica's published device residency
+                    # (the fresh tree is empty — replays republish)
+                    self.prefix_cache.bind_index(old._index, old._replica)
         self._bt_host[:] = 0
         self._bt_dev = None
         self._positions[:] = 0
@@ -1665,7 +1842,9 @@ class ServingEngine:
                "prefill_traces": dict(self.prefill_traces),
                "prefix_prefill_traces": dict(self.prefix_prefill_traces),
                "cow_traces": self.cow_traces,
+               "restore_traces": self.restore_traces,
                "chunk_size": self.chunk_size,
+               "tier.enabled": int(self.tier is not None),
                "mesh.key": self.mesh_key,
                "mesh.model_axis": self._mesh_model,
                "mesh.data_axis": self._mesh_data,
@@ -1686,6 +1865,8 @@ class ServingEngine:
         if self.prefix_cache is not None:
             out.update({f"prefix.{k}": v
                         for k, v in self.prefix_cache.stats().items()})
+        if self.tier is not None:
+            out.update(self.tier.stats())
         if self.spec is not None:
             out.update(self.spec.stats())
         if self.lora is not None:

@@ -8,12 +8,21 @@ engine cannot express:
 
 * **Routing** — each accepted request goes to the replica with the least
   outstanding work (waiting + running), with *bounded prefix-cache
-  affinity*: when the radix cache is on, a replica whose tree already holds
-  the request's prompt prefix (probed via PR 6's memoized chunk-key chain —
-  hash once per request, walk per candidate) may win instead, but only
-  while its load is within ``FLAGS_gateway_affinity_slack`` requests of the
-  minimum — warm traffic can never pile onto one replica and starve a cold
-  tenant of capacity.
+  affinity*: when the radix cache is on, a replica that already holds the
+  request's prompt prefix ON DEVICE may win instead, but only while its
+  load is within ``FLAGS_gateway_affinity_slack`` requests of the minimum
+  — warm traffic can never pile onto one replica and starve a cold tenant
+  of capacity. Residency comes from the shared
+  :class:`GlobalRadixIndex` (ISSUE 15): every replica's
+  :class:`~..prefix_cache.PrefixCache` publishes its insert/evict/spill
+  deltas of chunk-key chains, so routing consults TRUE per-replica
+  residency instead of the PR 8 approximation of probing each private
+  tree from the router thread. With tiering on
+  (``FLAGS_serving_kv_tiering``), replicas also attach to ONE shared
+  :class:`~..tiered.HostKVCache`, so a prefix prefilled on replica A is a
+  host-tier hit on replica B whatever the routing decision — affinity
+  then only decides who serves from HBM versus who pays one compiled
+  restore.
 * **Health** — replica health is driven by the supervisor's crash-loop
   state: a replica whose breaker opens (or whose pump surfaces a
   :class:`~paddle_tpu.serving.supervisor.CrashLoopError` / transient device
@@ -63,6 +72,99 @@ _logger = logging.getLogger("paddle_tpu.serving.gateway")
 _RESPAWN_BACKOFF_CAP = 30.0
 _REAP_EVERY = 16  # submits between abandoned-handle sweeps
 _gw_counter = itertools.count()
+
+
+class GlobalRadixIndex:
+    """Cross-replica residency index over radix chunk-key chains.
+
+    Replicas PUBLISH their device-residency deltas (radix insert /
+    restore -> ``publish_insert``; evict / spill -> ``publish_evict``;
+    rebuild / respawn -> ``publish_reset``) through
+    :meth:`~..prefix_cache.PrefixCache.bind_index`; the router CONSULTS
+    the index per candidate replica. Content-hash chunk keys are
+    location-independent, so one key chain (hashed once per request)
+    probes every replica. Host/disk residency is not tracked here — it
+    lives in the shared tier store and is replica-independent by
+    construction (:meth:`residency` folds it in for observability).
+
+    Thread-safe: publishes arrive from every replica's pump thread,
+    lookups from the router. Lookups walk the chain front-to-back and
+    stop at the first non-resident key — matching the radix walk's
+    longest-resident-prefix semantics exactly."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._replicas_of: Dict[bytes, set] = {}
+        self._keys_of: Dict[int, set] = {}
+
+    def publish_insert(self, replica: int, keys) -> None:
+        with self._lock:
+            mine = self._keys_of.setdefault(replica, set())
+            for k in keys:
+                self._replicas_of.setdefault(k, set()).add(replica)
+                mine.add(k)
+
+    def publish_evict(self, replica: int, key: bytes) -> None:
+        with self._lock:
+            reps = self._replicas_of.get(key)
+            if reps is not None:
+                reps.discard(replica)
+                if not reps:
+                    del self._replicas_of[key]
+            mine = self._keys_of.get(replica)
+            if mine is not None:
+                mine.discard(key)
+
+    def publish_reset(self, replica: int) -> None:
+        with self._lock:
+            for k in self._keys_of.pop(replica, ()):
+                reps = self._replicas_of.get(k)
+                if reps is not None:
+                    reps.discard(replica)
+                    if not reps:
+                        del self._replicas_of[k]
+
+    def resident_blocks(self, keys, replica: int) -> int:
+        """Longest prefix of ``keys`` device-resident on ``replica``."""
+        n = 0
+        with self._lock:
+            for k in keys:
+                reps = self._replicas_of.get(k)
+                if reps is None or replica not in reps:
+                    break
+                n += 1
+        return n
+
+    def residency(self, keys, tier=None) -> dict:
+        """The full tier picture of one key chain: device blocks per
+        replica, plus (with a ``tiered.TierView``) the host/disk-resident
+        chain length — the ``/v1/stats`` observability payload."""
+        with self._lock:
+            replicas = set()
+            for reps in (self._replicas_of.get(k) for k in keys):
+                if reps:
+                    replicas |= reps
+        out = {"device": {r: self.resident_blocks(keys, r)
+                          for r in sorted(replicas)}}
+        if tier is not None:
+            host = disk = 0
+            for k in keys:
+                where = tier.tier_of(k)
+                if where is None:
+                    break
+                if where == "host":
+                    host += 1
+                else:
+                    disk += 1
+            out["host"] = host
+            out["disk"] = disk
+        return out
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"keys": len(self._replicas_of),
+                    "replicas": {r: len(ks)
+                                 for r, ks in self._keys_of.items() if ks}}
 
 
 class NoHealthyReplicaError(RuntimeError):
@@ -270,11 +372,18 @@ class ReplicaPool:
                               if max_reroutes is None else int(max_reroutes))
         self._background = bool(background)
         self._lock = threading.RLock()
+        # the shared cross-replica residency index (ISSUE 15): every
+        # replica's prefix cache publishes insert/evict/spill deltas here;
+        # routing reads it instead of probing private trees. Engines with
+        # FLAGS_serving_kv_tiering also share ONE HostKVCache — either the
+        # explicit tier_store engine kwarg or the process-global default —
+        # so cross-replica host hits need no extra plumbing.
+        self.index = GlobalRadixIndex()
         # pool-level LoRA registrations, in order: respawned replicas
         # replay them so every replica serves identical adapter ids
         self._adapters: List[tuple] = []
         self._replicas: List[_Replica] = [
-            _Replica(i, self._spawn_api()) for i in range(n)]
+            _Replica(i, self._spawn_api(i)) for i in range(n)]
         #: live (unfinished) routed requests per replica index
         self._live: Dict[int, List[RoutedRequest]] = {
             r.idx: [] for r in self._replicas}
@@ -286,13 +395,19 @@ class ReplicaPool:
         self._reap_tick = 0
         self._refresh_gauges()
 
-    def _spawn_api(self) -> ServingAPI:
+    def _spawn_api(self, idx: int) -> ServingAPI:
         api = ServingAPI(self._factory(), **self._api_kw)
         # ordered replay of pool-level adapter registrations: the arena
         # hands out rows in registration order, so a respawned replica
         # reconstructs the exact id assignment its peers serve
         for adapter, name in self._adapters:
             api.engine.lora.register(adapter, name=name)
+        # bind the residency index (resets this replica's published
+        # state: a fresh/respawned engine starts device-cold; supervisor
+        # rebuilds re-bind through the old cache's carried binding)
+        cache = api.engine.prefix_cache
+        if cache is not None:
+            cache.bind_index(self.index, idx)
         return api
 
     def register_adapter(self, adapter, name: Optional[str] = None) -> int:
@@ -452,7 +567,14 @@ class ReplicaPool:
 
     def _candidates(self, rr: RoutedRequest) -> List[_Replica]:
         """Routable replicas, best first: least outstanding work, with the
-        bounded warm-cache preference applied to the front of the order."""
+        bounded warm-cache preference applied to the front of the order.
+        Warmth is TRUE device residency from the shared
+        :class:`GlobalRadixIndex` (replicas publish their radix deltas),
+        not a cross-thread probe of each replica's private tree — and it
+        is deliberately DEVICE-only: host/disk tier residency is shared by
+        every replica, so it cannot differentiate candidates (a cold-HBM
+        route still hits the host tier and pays one compiled restore
+        instead of a prefill)."""
         reps = self.healthy_replicas()
         if not reps:
             raise NoHealthyReplicaError(
@@ -465,15 +587,13 @@ class ReplicaPool:
             keys = self._prefix_keys(rr, reps[0])
             if keys:
                 floor = load[reps[0].idx]
-                best, best_tokens = None, 0
+                best, best_blocks = None, 0
                 for r in reps:
                     if load[r.idx] > floor + slack:
                         continue  # bounded: never pile onto a busy replica
-                    cache = r.api.engine.prefix_cache
-                    tokens = (cache.resident_tokens_for(keys)
-                              if cache is not None else 0)
-                    if tokens > best_tokens:
-                        best, best_tokens = r, tokens
+                    blocks = self.index.resident_blocks(keys, r.idx)
+                    if blocks > best_blocks:
+                        best, best_blocks = r, blocks
                 if best is not None and best is not reps[0]:
                     reps.remove(best)
                     reps.insert(0, best)
@@ -617,7 +737,7 @@ class ReplicaPool:
                 r.respawning = True
         for rep in due:
             try:
-                api = self._spawn_api()
+                api = self._spawn_api(rep.idx)
             except Exception:  # analysis: allow(broad-except) — engine
                 # construction can die arbitrarily on a sick device; a
                 # failed respawn re-enters backoff instead of crashing
@@ -991,10 +1111,19 @@ class ReplicaPool:
                 if not r.removed and getattr(r.api.engine, "chunk_size", 0):
                     row["prefilling"] = len(r.api.scheduler.prefilling)
                 reps.append(row)
-        return {"replicas": reps,
-                "replicas_total": sum(1 for r in reps if not r["removed"]),
-                "replicas_healthy": len(self.healthy_replicas()),
-                "capacity_slots": self.capacity(),
-                "outstanding": self.outstanding(),
-                "draining": self._draining,
-                "tenants": self.tenants.stats()}
+        out = {"replicas": reps,
+               "replicas_total": sum(1 for r in reps if not r["removed"]),
+               "replicas_healthy": len(self.healthy_replicas()),
+               "capacity_slots": self.capacity(),
+               "outstanding": self.outstanding(),
+               "draining": self._draining,
+               "radix_index": self.index.stats(),
+               "tenants": self.tenants.stats()}
+        # the shared spill-tier picture (ISSUE 15): replicas attach to one
+        # HostKVCache, so reporting any live replica's store covers all
+        for r in self.healthy_replicas():
+            tier = getattr(r.api.engine, "tier", None)
+            if tier is not None:
+                out["tier"] = tier.store.stats()
+                break
+        return out

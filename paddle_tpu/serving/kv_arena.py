@@ -327,6 +327,51 @@ class KVArena:
             self.deref(blk)
         res.taken = []
 
+    def take_cached_block(self) -> int:
+        """Pop one free block for a tier restore (``serving.tiered``):
+        the block starts at refcount ZERO with cache residency — after the
+        restore scatter it is indistinguishable from any resident prefix
+        block (admissions ``ref`` it, retire ``deref``s it back to cached
+        residency, eviction can spill it again). Outside the reservation
+        system by design, but it must never eat into outstanding
+        reservations' guaranteed ``take()`` headroom; under pressure it
+        evicts cold cached prefixes exactly like :meth:`reserve`."""
+        short = 1 - (len(self._free) - self._reserved)
+        if (short > 0 and self._cache is not None
+                and short <= self._cache.evictable_blocks()):
+            self._cache.evict(short)
+        if len(self._free) - self._reserved < 1:
+            metrics.bump("arena.alloc_failed")
+            raise ArenaExhaustedError(
+                "no free block for a tier restore "
+                f"({len(self._free)} free, {self._reserved} reserved)")
+        blk = self._free.pop()
+        self._refs[blk] = 0
+        self._cached.add(blk)
+        metrics.bump("arena.alloc")
+        if blk in self._ever_used:
+            metrics.bump("arena.reuse")
+        self._ever_used.add(blk)
+        self._high_water = max(self._high_water, self.blocks_in_use())
+        if self._cache is not None:
+            self._cache.invalidate()
+        return blk
+
+    def read_block(self, blk: int):
+        """Host copy of one physical block's rows across every PRIMARY
+        pool layer — the spill payload of ``serving.tiered`` (the prefix
+        cache only ever covers the primary namespace; draft blocks are
+        private). Every array of each entry is read, so an int8 arena's
+        payload and its per-row scales travel as one unit. On a device
+        mesh ``np.asarray`` re-assembles the committed shards host-side;
+        the restore scatter re-commits them through the pool's own
+        sharding, so a rebuild on the same ``mesh_axes_key`` reproduces
+        identical placements."""
+        import numpy as np
+
+        return [tuple(np.asarray(arr[blk]) for arr in entry)
+                for entry in self._pools]
+
     # --------------------------------------------------- refcount / cache
 
     def bind_cache(self, cache) -> None:

@@ -57,6 +57,21 @@ Counter namespaces:
 * ``lora.*``       — the multi-LoRA adapter arena (``serving.adapters``):
   ``registered`` / ``unregistered`` / ``register_failed`` (capacity) /
   ``admits`` (slots admitted with a non-zero adapter)
+* ``tier.*``       — the tiered KV cache (``serving.tiered``,
+  ``FLAGS_serving_kv_tiering``): ``spilled_blocks`` / ``spilled_bytes``
+  (device blocks demoted to host/disk; bytes only when the write-through
+  copy was gone) / ``restored_blocks`` / ``restored_bytes`` (compiled
+  scatter restores on radix hits), per-tier ``host_hits`` / ``disk_hits``
+  / ``misses`` (a spilled node whose entry was lost — recompute),
+  ``host_evictions`` / ``host_drops`` (LRU past the byte budget, with /
+  without a disk tier) / ``disk_writes`` / ``disk_evictions``
+  (oldest entries deleted past ``FLAGS_serving_disk_cache_bytes``) /
+  ``disk_write_failed`` (ENOSPC/dead disk — the entry degrades to a
+  miss, mirrored into ``core.resilience``) / ``disk_corrupt``
+  (crc-failed loads, mirrored into ``core.resilience``); gauges
+  ``tier.enabled``
+  (0/1 mode), ``host_bytes`` / ``host_entries`` / ``disk_bytes`` /
+  ``disk_entries`` (occupancy)
 * ``kernel.*``     — the Pallas paged-attention serving kernels
   (``FLAGS_serving_paged_kernel``, ``ops.paged_attention``):
   trace-time counters ``decode_traces`` / ``prefill_traces`` /
@@ -104,6 +119,9 @@ DOCUMENTED_NAMESPACES = (
     "requests", "tokens", "engine", "arena", "scheduler", "supervisor",
     "api", "prefix", "spec", "chunk", "quant", "gateway", "tenant",
     "sampling", "constrain", "lora", "kernel",
+    # tier.* (ISSUE 15): the tiered KV cache's spill/restore telemetry —
+    # serving.tiered / docs/serving.md "Tiered KV cache"
+    "tier",
     # mesh.* (ISSUE 14): the engine's captured device-mesh topology —
     # mesh.devices / mesh.model_axis / mesh.data_axis gauges set at
     # construction (docs/distributed.md "Tensor-parallel serving")

@@ -36,6 +36,20 @@ Counters (``prefix.*`` in ``serving.metrics``): ``hits`` (admissions with
 at least one matched block), ``misses``, ``hit_tokens`` (prefill tokens
 avoided), ``inserted_blocks``, ``evictions``, ``cow_copies`` (bumped by
 the engine), and the ``resident_blocks`` gauge.
+
+**Tiered spill** (``FLAGS_serving_kv_tiering`` — :mod:`.tiered`): with a
+tier store bound, eviction does not discard a block's KV — the rows are
+already host-resident (written through at insert time) or are copied out
+now, and the node stays in the tree marked *spilled* (``block == -1``).
+A later walk that reaches a spilled node (or a chunk key another replica
+published into the shared store) counts it as matched: the engine
+restores it into a fresh cached block via one compiled scatter before
+attaching. A spilled node whose tier entry was lost (host LRU drop with
+no disk tier, disk crc failure) is pruned on discovery and the walk
+treats it as a plain miss — recompute, never garbage. Device-residency
+deltas (insert / evict / spill / restore) are published to an optional
+:class:`~.gateway.router.GlobalRadixIndex` so gateway routing consults
+true per-replica residency instead of probing private trees.
 """
 from __future__ import annotations
 
@@ -59,10 +73,17 @@ def _chunk_key(parent_key: bytes, chunk: np.ndarray) -> bytes:
 
 
 class PrefixNode:
-    """One resident full block: its chunk's tokens, the physical arena
-    block holding the chunk's K/V, and its place in the tree."""
+    """One full block of the tree: its chunk's tokens, the physical arena
+    block holding the chunk's K/V, and its place in the tree. With
+    tiering, a node may instead be *spilled*: ``block == -1`` and the
+    KV rows live in the host/disk tier under ``key`` — restorable into a
+    fresh block on the next hit. Invariant: a resident node's ancestors
+    are all resident (eviction spills leaves first, restores and inserts
+    walk top-down), so every match chain is a resident prefix followed by
+    a spilled tail."""
 
-    __slots__ = ("key", "chunk", "block", "parent", "children", "last_use")
+    __slots__ = ("key", "chunk", "block", "parent", "children", "last_use",
+                 "spilled")
 
     def __init__(self, key: bytes, chunk: np.ndarray, block: int,
                  parent: Optional["PrefixNode"]):
@@ -72,6 +93,7 @@ class PrefixNode:
         self.parent = parent
         self.children: Dict[bytes, "PrefixNode"] = {}
         self.last_use = 0
+        self.spilled = False
 
 
 class PrefixCache:
@@ -83,70 +105,140 @@ class PrefixCache:
     just different int32 rows in a slot's block table and can never add a
     compile."""
 
-    def __init__(self, arena, block_size: Optional[int] = None):
+    def __init__(self, arena, block_size: Optional[int] = None, tier=None):
         self.arena = arena
         self.block_size = int(block_size or arena.block_size)
+        # the host/disk spill store (a tiered.TierView, already namespaced
+        # by this arena's signature); None = PR 14 behavior: eviction
+        # discards, the walk never leaves the tree
+        self.tier = tier
         self._root = PrefixNode(_ROOT_KEY, np.zeros(0, np.int32), -1, None)
         self._nodes: Dict[bytes, PrefixNode] = {}
+        self._n_spilled = 0
         self._tick = 0
         self._evictable_memo: Optional[int] = None
+        # optional cross-replica residency index (gateway routing):
+        # device-residency deltas are published per replica id
+        self._index = None
+        self._replica: Optional[int] = None
         # per-instance lifetime counters (serving.metrics is process-global)
         self.hits = 0
         self.misses = 0
         self.hit_tokens = 0
         self.inserted_blocks = 0
         self.evictions = 0
+        self.spills = 0
+        self.restores = 0
         arena.bind_cache(self)
+
+    # ------------------------------------------------------ index plumbing
+
+    def bind_index(self, index, replica: int) -> None:
+        """Attach a :class:`~.gateway.router.GlobalRadixIndex`: this
+        cache's device-residency deltas are published under ``replica``.
+        Binding resets the replica's published state first (a respawned or
+        rebuilt engine starts cold) and republishes any blocks already
+        resident."""
+        self._index = index
+        self._replica = int(replica)
+        index.publish_reset(self._replica)
+        resident = [n.key for n in self._nodes.values() if not n.spilled]
+        if resident:
+            index.publish_insert(self._replica, resident)
+
+    def _publish_insert(self, keys: List[bytes]) -> None:
+        if self._index is not None and keys:
+            self._index.publish_insert(self._replica, keys)
+
+    def _publish_evict(self, key: bytes) -> None:
+        if self._index is not None:
+            self._index.publish_evict(self._replica, key)
 
     # ------------------------------------------------------------- walking
 
     def _walk(self, tokens: np.ndarray) -> List[PrefixNode]:
-        """Longest chain of resident FULL blocks matching ``tokens``."""
+        """Longest chain of matchable FULL blocks for ``tokens``: resident
+        nodes, then (with a tier bound) spilled nodes whose entry is still
+        tier-resident. A chunk key absent from the tree but present in the
+        SHARED tier — another replica's write-through — is materialized as
+        a spilled node, which is how a prefix prefilled on replica A
+        becomes a hit on replica B. A spilled node whose tier entry was
+        lost is pruned (with its all-spilled subtree) and the walk stops:
+        from there the admission recomputes."""
         bs = self.block_size
         tokens = np.asarray(tokens, np.int32).reshape(-1)
         out: List[PrefixNode] = []
         node = self._root
         for i in range(int(tokens.shape[0]) // bs):
             chunk = tokens[i * bs:(i + 1) * bs]
-            child = node.children.get(_chunk_key(node.key, chunk))
+            key = _chunk_key(node.key, chunk)
+            child = node.children.get(key)
             if child is None:
+                if self.tier is None or not self.tier.has(key):
+                    break
+                child = PrefixNode(key, np.array(chunk), -1, node)
+                child.spilled = True
+                node.children[key] = child
+                self._nodes[key] = child
+                self._n_spilled += 1
+            elif child.spilled and (self.tier is None
+                                    or not self.tier.has(key)):
+                self.prune_lost(child)
                 break
             out.append(child)
             node = child
         return out
 
-    def _walk_keys(self, keys: List[bytes]) -> List[PrefixNode]:
-        """:meth:`_walk` over a precomputed :meth:`chunk_keys` chain —
-        hash-free, for callers probing residency every scheduler step."""
-        out: List[PrefixNode] = []
-        node = self._root
+    def _probe_keys(self, keys: List[bytes]):
+        """Non-mutating residency probe over a precomputed
+        :meth:`chunk_keys` chain — hash-free, for callers polling every
+        scheduler step. Returns ``(resident, spilled, unpinned)``:
+        device-resident matched blocks, tier-restorable matched blocks
+        (spilled in the tree OR published by another replica into the
+        shared store), and the resident ones at refcount zero."""
+        resident = spilled = unpinned = 0
+        node: Optional[PrefixNode] = self._root
         for k in keys:
-            child = node.children.get(k)
-            if child is None:
-                break
-            out.append(child)
-            node = child
-        return out
+            child = node.children.get(k) if node is not None else None
+            if child is not None and not child.spilled:
+                resident += 1
+                if self.arena.refcount(child.block) == 0:
+                    unpinned += 1
+                node = child
+                continue
+            # spilled in the tree, or absent: matchable iff tier-resident.
+            # Once off the resident prefix everything further is spilled/
+            # absent too (resident ancestors invariant), so keep probing
+            # the tier along the key chain
+            if self.tier is not None and self.tier.has(k):
+                spilled += 1
+                node = child
+                continue
+            break
+        return resident, spilled, unpinned
 
     def lookup(self, tokens) -> int:
-        """Non-mutating: how many TOKENS of ``tokens`` are resident as full
-        blocks right now (admission sizing / cache-affinity scheduling)."""
-        return len(self._walk(tokens)) * self.block_size
+        """Non-mutating: how many TOKENS of ``tokens`` are matchable as
+        full blocks right now — device-resident or tier-restorable
+        (admission sizing / cache-affinity scheduling: either kind skips
+        the prefill compute)."""
+        return self.resident_tokens_for(self.chunk_keys(tokens))
 
     def match_stats(self, tokens=None, keys: Optional[List[bytes]] = None):
-        """One walk, both admission-sizing numbers: (matched full blocks,
-        matched blocks at refcount zero). The latter matters because
-        ``grantable()`` counts refcount-zero cached blocks as eviction
-        headroom, but an admission of these very tokens pins them
-        (``arena.ref``) before it reserves — feasibility checks must
-        subtract them, or ``reserve()`` can fail after ``can_admit`` said
-        yes. Pass precomputed ``keys`` (:meth:`chunk_keys`) to skip
-        hashing."""
-        chain = self._walk_keys(keys) if keys is not None \
-            else self._walk(tokens)
-        unpinned = sum(1 for n in chain
-                       if self.arena.refcount(n.block) == 0)
-        return len(chain), unpinned
+        """One walk, the three admission-sizing numbers:
+        ``(resident, spilled, unpinned)`` — device-resident matched full
+        blocks (attach by reference, free), tier-restorable matched
+        blocks (avoid prefill COMPUTE but each consumes one fresh block:
+        restore cost, not prefill cost), and resident matched blocks at
+        refcount zero. The last matters because ``grantable()`` counts
+        refcount-zero cached blocks as eviction headroom, but an admission
+        of these very tokens pins them (``arena.ref``) before it reserves
+        — feasibility checks must subtract them, or ``reserve()`` can
+        fail after ``can_admit`` said yes. Pass precomputed ``keys``
+        (:meth:`chunk_keys`) to skip hashing."""
+        if keys is None:
+            keys = self.chunk_keys(tokens)
+        return self._probe_keys(keys)
 
     def chunk_keys(self, tokens) -> List[bytes]:
         """The content-key chain of ``tokens``' full blocks — a pure
@@ -162,8 +254,10 @@ class PrefixCache:
         return keys
 
     def resident_tokens_for(self, keys: List[bytes]) -> int:
-        """``lookup()`` over a precomputed :meth:`chunk_keys` chain."""
-        return len(self._walk_keys(keys)) * self.block_size
+        """``lookup()`` over a precomputed :meth:`chunk_keys` chain —
+        device-resident plus tier-restorable full blocks, in tokens."""
+        resident, spilled, _ = self._probe_keys(keys)
+        return (resident + spilled) * self.block_size
 
     def match(self, tokens) -> List[PrefixNode]:
         """The admission walk: returns the matched chain and touches each
@@ -182,13 +276,20 @@ class PrefixCache:
         """Insert the first ``num_blocks`` full chunks of ``tokens``, whose
         K/V was just scattered into physical ``blocks[i]``. Chunks already
         resident are skipped (the existing block stays authoritative — the
-        caller's copy remains private to its slot and is freed at retire).
-        Returns how many blocks were newly inserted."""
+        caller's copy remains private to its slot and is freed at retire);
+        a SPILLED node is revived onto the caller's freshly scattered
+        block (content-hash keying guarantees identical bytes). With a
+        tier bound, every full block is also written through to the shared
+        host tier — that copy is what other replicas hit and what makes a
+        later spill free. Returns how many blocks became device-resident.
+        """
         bs = self.block_size
         tokens = np.asarray(tokens, np.int32).reshape(-1)
         node = self._root
         self._tick += 1
         inserted = 0
+        new_keys: List[bytes] = []
+        arena = self.arena
         for i in range(num_blocks):
             chunk = tokens[i * bs:(i + 1) * bs]
             key = _chunk_key(node.key, chunk)
@@ -197,22 +298,49 @@ class PrefixCache:
                 child = PrefixNode(key, np.array(chunk), int(blocks[i]), node)
                 node.children[key] = child
                 self._nodes[key] = child
-                self.arena.mark_cached(child.block)
+                arena.mark_cached(child.block)
                 inserted += 1
+                new_keys.append(key)
+            elif child.spilled:
+                # revive: the slot just scattered these exact tokens'
+                # KV into blocks[i] — re-point the node at the fresh
+                # device copy (the tier entry stays valid alongside)
+                child.block = int(blocks[i])
+                child.spilled = False
+                self._n_spilled -= 1
+                arena.mark_cached(child.block)
+                inserted += 1
+                new_keys.append(key)
             child.last_use = self._tick
+            if self.tier is not None:
+                blk = child.block
+                self.tier.write_through(key,
+                                        lambda b=blk: arena.read_block(b))
             node = child
         if inserted:
             self.invalidate()
+            self._publish_insert(new_keys)
             self.inserted_blocks += inserted
             metrics.bump("prefix.inserted_blocks", inserted)
-            metrics.set_gauge("prefix.resident_blocks", len(self._nodes))
+            metrics.set_gauge("prefix.resident_blocks",
+                              self.resident_blocks())
         return inserted
 
     # ------------------------------------------------------------ eviction
 
+    def _is_evictable_leaf(self, node: PrefixNode) -> bool:
+        # "leaf" for eviction = no RESIDENT children: a node whose whole
+        # remaining subtree is spilled frees its block without stranding
+        # anything below (spilled descendants hold no device blocks).
+        # ONE definition — the candidate scan and evict()'s incremental
+        # parent re-add must never drift apart.
+        return (node is not self._root and not node.spilled
+                and self.arena.refcount(node.block) == 0
+                and not any(not c.spilled for c in node.children.values()))
+
     def _evictable_leaves(self) -> List[PrefixNode]:
         return [n for n in self._nodes.values()
-                if not n.children and self.arena.refcount(n.block) == 0]
+                if self._is_evictable_leaf(n)]
 
     def invalidate(self) -> None:
         """Drop the memoized evictable count (called by the arena on every
@@ -229,7 +357,8 @@ class PrefixCache:
             return self._evictable_memo
         n = 0
         stack = list(self._root.children.values())
-        # a node is reclaimable iff nothing below it is pinned by a slot
+        # a node is reclaimable iff nothing below it is pinned by a slot;
+        # spilled nodes hold no device block (never pinned, never counted)
         blocked: Dict[bytes, bool] = {}
         order: List[PrefixNode] = []
         while stack:
@@ -237,37 +366,86 @@ class PrefixCache:
             order.append(node)
             stack.extend(node.children.values())
         for node in reversed(order):  # children before parents
-            pinned = self.arena.refcount(node.block) > 0 or any(
+            pinned = (not node.spilled
+                      and self.arena.refcount(node.block) > 0) or any(
                 blocked[c.key] for c in node.children.values())
             blocked[node.key] = pinned
-            if not pinned:
+            if not pinned and not node.spilled:
                 n += 1
         self._evictable_memo = n
         return n
 
     def evict(self, need: int) -> int:
-        """Free up to ``need`` blocks, LRU leaves first (evicting a leaf
-        may expose its parent). Returns blocks actually freed; the arena
-        calls this from ``reserve()`` when the free list alone cannot
-        cover a budget. The candidate set is scanned once and maintained
-        incrementally (a victim's parent joins when its last child goes),
-        not rebuilt per freed block."""
+        """Free up to ``need`` device blocks, LRU leaves first (evicting a
+        leaf may expose its parent). With a tier bound the block's KV is
+        SPILLED — host/disk-resident under its content key, node kept in
+        the tree — instead of discarded; either way the device block
+        returns to the allocator. Returns blocks actually freed; the
+        arena calls this from ``reserve()`` when the free list alone
+        cannot cover a budget. The candidate set is scanned once and
+        maintained incrementally (a victim's parent joins when its last
+        resident child goes), not rebuilt per freed block."""
         freed = 0
         leaves = {n.key: n for n in self._evictable_leaves()}
         while freed < need and leaves:
             victim = min(leaves.values(), key=lambda n: n.last_use)
             del leaves[victim.key]
             parent = victim.parent
-            self._remove(victim)
+            if self.tier is not None:
+                self._spill(victim)
+            else:
+                self._remove(victim)
             freed += 1
-            if (parent is not self._root and not parent.children
-                    and self.arena.refcount(parent.block) == 0):
+            if self._is_evictable_leaf(parent):
                 leaves[parent.key] = parent
         if freed:
             self.evictions += freed
             metrics.bump("prefix.evictions", freed)
-            metrics.set_gauge("prefix.resident_blocks", len(self._nodes))
+            metrics.set_gauge("prefix.resident_blocks",
+                              self.resident_blocks())
         return freed
+
+    def _spill(self, node: PrefixNode) -> None:
+        """Demote one resident refcount-zero node to the spill tier: make
+        its rows tier-resident (usually free — the write-through copy from
+        insert time is still there), then free the device block. The node
+        stays in the tree so a later walk finds and restores it."""
+        blk = node.block
+        self.tier.spill(node.key, lambda: self.arena.read_block(blk))
+        node.spilled = True
+        node.block = -1
+        self._n_spilled += 1
+        self.spills += 1
+        self.invalidate()
+        self.arena.uncache(blk)
+        self._publish_evict(node.key)
+
+    def mark_restored(self, node: PrefixNode, blk: int) -> None:
+        """The engine restored ``node``'s rows into fresh cached block
+        ``blk`` (refcount zero — the restoring admission refs it next,
+        like any resident prefix block)."""
+        node.block = int(blk)
+        node.spilled = False
+        self._n_spilled -= 1
+        self.restores += 1
+        self.invalidate()
+        self._publish_insert([node.key])
+        metrics.set_gauge("prefix.resident_blocks", self.resident_blocks())
+
+    def prune_lost(self, node: PrefixNode) -> None:
+        """Drop a spilled node whose tier entry vanished (host LRU drop
+        with no disk tier, crc-failed disk file) — with its subtree, which
+        is all-spilled by the resident-ancestors invariant. Pure tree
+        bookkeeping: spilled nodes hold no device block."""
+        stack = [node]
+        while stack:
+            n = stack.pop()
+            stack.extend(n.children.values())
+            assert n.spilled, "pruning a resident node"
+            n.parent.children.pop(n.key, None)
+            self._nodes.pop(n.key, None)
+            self._n_spilled -= 1
+        self.invalidate()
 
     def _remove(self, node: PrefixNode) -> None:
         assert not node.children, "only leaves are evicted"
@@ -275,11 +453,16 @@ class PrefixCache:
         self._nodes.pop(node.key, None)
         self.invalidate()
         self.arena.uncache(node.block)
+        self._publish_evict(node.key)
 
     # --------------------------------------------------------------- admin
 
     def resident_blocks(self) -> int:
-        return len(self._nodes)
+        """Device-resident nodes only (spilled nodes hold no block)."""
+        return len(self._nodes) - self._n_spilled
+
+    def spilled_nodes(self) -> int:
+        return self._n_spilled
 
     def note_hit(self, matched_tokens: int) -> None:
         """Engine callback after a successful shared admission (counted on
@@ -294,8 +477,8 @@ class PrefixCache:
             metrics.bump("prefix.misses")
 
     def stats(self) -> dict:
-        return {
-            "resident_blocks": len(self._nodes),
+        out = {
+            "resident_blocks": self.resident_blocks(),
             "evictable_blocks": self.evictable_blocks(),
             "hits": self.hits,
             "misses": self.misses,
@@ -303,3 +486,8 @@ class PrefixCache:
             "inserted_blocks": self.inserted_blocks,
             "evictions": self.evictions,
         }
+        if self.tier is not None:
+            out["spilled_nodes"] = self._n_spilled
+            out["spills"] = self.spills
+            out["restores"] = self.restores
+        return out

@@ -1,0 +1,30 @@
+"""known-bad twin of the tiered-KV restore pattern
+(serving.engine._get_restore / _restore_node): the compiled restore
+scatter must treat tier state as runtime data. This one (1) BRANCHES on
+tier residency inside the program — ``if resident[dst]:`` on a traced
+per-block residency mask is traced-branch: residency is decided on the
+host (the radix walk) and must never reach the trace as control flow, or
+every residency pattern mints a new executable; and (2) materializes the
+DONATED pool host-side with ``np.asarray`` inside the restore program —
+traced-cast: a device sync per restore, and the "host copy" it appears
+to make is a baked-in constant of the first call's pool, not a copy of
+anything."""
+import jax
+import numpy as np
+
+
+def restore_step(pools, rows, dst, resident):
+    # BAD: python branch on a traced residency lookup — tier residency
+    # is host-side bookkeeping, never trace-time control flow
+    if resident[dst]:
+        return pools
+    # BAD: host materialization of the donated pool inside the program
+    host_rows = np.asarray(pools[0])
+    out = [p.at[dst].set(r) for p, r in zip(pools, rows)]
+    out[0] = out[0] + host_rows[0] * 0
+    return out
+
+
+def run(pools, rows, dst, resident):
+    step = jax.jit(restore_step, donate_argnums=(0,))
+    return step(pools, rows, dst, resident)

@@ -1,0 +1,19 @@
+"""known-good twin of the tiered-KV restore pattern
+(serving.engine._get_restore): tier residency is resolved HOST-SIDE
+before the call (the radix walk decides what to restore; the program
+never sees it), and the scatter is pure array math — the destination
+block id rides as a traced scalar, the host payload rows ride as runtime
+arrays of fixed shapes, so every restore of every spilled block reuses
+one executable."""
+import jax
+
+
+def restore_step(pools, rows, dst):
+    # dst is runtime data; the scatter covers every pool array
+    # unconditionally (payload + scales as one unit)
+    return [p.at[dst].set(r) for p, r in zip(pools, rows)]
+
+
+def run(pools, rows, dst):
+    step = jax.jit(restore_step, donate_argnums=(0,))
+    return step(pools, rows, dst)

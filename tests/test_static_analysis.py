@@ -89,6 +89,12 @@ FIXTURE_CASES = [
     # (the donation rule over NamedSharding-placed buffers)
     ("traced-branch", "compiled_mesh", ()),
     ("use-after-donate", "compiled_mesh", ()),
+    # the ISSUE 15 tiered-restore shape: a traced branch on tier
+    # residency and a host np.asarray of the donated pool inside the
+    # restore program (engine._get_restore must keep residency host-side
+    # and the scatter all-array)
+    ("traced-branch", "compiled_tiered", ()),
+    ("traced-cast", "compiled_tiered", ()),
     ("undefined-flag", "registry_flags",
      ("paddle_tpu/core/flags.py",)),
     ("unknown-metric-key", "registry_metrics",
@@ -142,6 +148,10 @@ def test_bad_fixtures_are_specific():
             # deliberately seeds BOTH mesh hazards: per-device traced
             # branch + donated sharded pool read-back
             allowed |= {"traced-branch", "use-after-donate"}
+        if stem == "compiled_tiered":
+            # deliberately seeds BOTH restore hazards: traced residency
+            # branch + host np.asarray of the donated pool
+            allowed |= {"traced-branch", "traced-cast"}
         assert rules <= allowed, (stem, rules)
 
 

@@ -25,6 +25,15 @@ recovery), ``api.drains`` / ``api.drain_stragglers`` / ``api.recoveries``.
 So do the radix prefix cache's (``FLAGS_serving_prefix_cache``):
 ``prefix.hits`` / ``prefix.hit_tokens`` (prefill tokens avoided) /
 ``prefix.inserted_blocks`` / ``prefix.evictions`` / ``prefix.cow_copies``.
+The tiered KV cache (``FLAGS_serving_kv_tiering``, ``serving.tiered``)
+adds ``tier.spilled_blocks`` / ``tier.restored_blocks`` (evictions
+demoted to host/disk and their compiled-scatter restores),
+``tier.host_hits`` / ``tier.disk_hits`` / ``tier.misses``,
+``tier.disk_corrupt`` (crc-failed loads — recomputed, never served), and
+the end-of-run occupancy gauges ``tier.host_bytes`` / ``tier.host_entries``
+/ ``tier.disk_bytes`` / ``tier.disk_entries``;
+``FLAGS_serving_host_cache_bytes`` / ``FLAGS_serving_disk_cache_dir``
+size the tiers in config mode.
 Speculative decoding (``FLAGS_serving_spec_k``) adds ``spec.proposed`` /
 ``spec.accepted`` / ``spec.rollback_tokens`` / ``spec.emitted`` /
 ``spec.iterations`` (+ the ``spec.acceptance_rate`` end-of-run gauge),
@@ -106,6 +115,13 @@ def _config_report() -> dict:
         # radix prefix cache (content-addressed KV block sharing)
         "serving_prefix_cache": _flag_env("serving_prefix_cache", 0),
         "serving_cache_affinity": _flag_env("serving_cache_affinity", 0),
+        # tiered KV cache (serving.tiered: host-RAM/disk spill + restore)
+        "serving_kv_tiering": _flag_env("serving_kv_tiering", 0),
+        "serving_host_cache_bytes": _flag_env("serving_host_cache_bytes",
+                                              256 * 1024 * 1024),
+        "serving_disk_cache_dir": _flag_env("serving_disk_cache_dir", ""),
+        "serving_disk_cache_bytes": _flag_env(
+            "serving_disk_cache_bytes", 8 * 1024 * 1024 * 1024),
         "serving_arena_invariants": _flag_env("serving_arena_invariants", 0),
         # speculative decoding + chunked prefill (serving.spec_decode)
         "serving_spec_k": _flag_env("serving_spec_k", 0),
@@ -180,7 +196,7 @@ def main(argv=None) -> int:
                                          "spec", "queue", "quant",
                                          "gateway", "tenant", "sampling",
                                          "constrain", "lora", "kernel",
-                                         "mesh")}
+                                         "mesh", "tier")}
         rec = {"wall_secs": round(wall, 3), "stats": delta,
                "gauges": gauges,
                "tokens_per_sec": round(toks / wall, 2) if wall > 0 else None}
