@@ -99,6 +99,25 @@ a chip; off-TPU the identical workflow runs against a throwaway store
 file). Persisted under ``"paged_attention"``. Env: PAGED_STEPS (timed
 decode steps, default 24), PAGED_TUNE_REPS (default 5).
 
+``--paged-attention --mesh`` runs the SPMD-kernel sweep (ISSUE 16):
+the same 8-slot decode window per mesh TOPOLOGY — for each
+``("data", "model")`` degree pair a mesh-gather engine and a mesh-kernel
+engine (the kernels running per model-shard through
+``headwise_shard_map``) serve identical workloads. Gates on every
+platform: token-for-token greedy parity kernel-vs-gather AND vs the
+no-mesh kernel reference, zero serving compiles in every timed window,
+decode traced exactly once per build (churn on a live mesh re-lowers
+nothing), and the ``kernel.mesh`` route gauge reporting
+``kernel@<topo>`` (no silent gather fallback). Reported: per-topology
+kernel-vs-gather step-time ratios plus the fused-dequant ratio at the
+deepest topology (int8 arena: head-sharded payloads, replicated scale
+pools). The ON-TPU gates stay the ISSUE 13 ones — kernel >= 1.3x gather
+at 8+ slots, fused dequant >= gather+dequant — now enforced per
+topology. On CPU the virtual-device ratios are a trend record only.
+Persisted under ``"paged_attention_mesh"``. Env: PAGED_STEPS,
+PAGED_MESH_TOPOS (comma list of ``mp`` or ``dpxmp``, e.g. "2,4,2x4";
+default = head-divisor degrees that fit the device count).
+
 ``--sharded`` runs the mesh-sharded serving workload (ISSUE 14,
 docs/distributed.md "Tensor-parallel serving"): the same slot workload
 through a single-device baseline engine and a ``("data", "model")``-mesh
@@ -130,12 +149,12 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 ".."))
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-if ("--sharded" in sys.argv
+if (("--sharded" in sys.argv or "--mesh" in sys.argv)
         and "xla_force_host_platform_device_count"
         not in os.environ.get("XLA_FLAGS", "")):
-    # the sharded bench needs a multi-device platform; set BEFORE the jax
-    # backend initializes. Only the CPU host platform is affected — a TPU
-    # run keeps its real chips.
+    # the sharded/mesh benches need a multi-device platform; set BEFORE
+    # the jax backend initializes. Only the CPU host platform is affected
+    # — a TPU run keeps its real chips.
     os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
                                + " --xla_force_host_platform_device_count=8")
 
@@ -1202,6 +1221,163 @@ def run_paged_attention(model, platform):
     _persist("paged_attention", rec)
 
 
+def run_paged_attention_mesh(platform):
+    """SPMD paged-attention sweep (ISSUE 16) — see the module docstring.
+    Per mesh topology: gather vs kernel engine over the same workload,
+    token parity (also vs the no-mesh kernel reference), zero compiles
+    and one decode trace per build, route gauge = kernel@<topo>."""
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu.core import compile_cache
+    from paddle_tpu.distributed.mesh import clear_mesh, serving_mesh
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM, gpt_tiny
+    from paddle_tpu.serving import ServingConfig, ServingEngine
+
+    cfg = (GPTConfig(vocab_size=50304, hidden_size=768, num_layers=12,
+                     num_heads=12, max_position_embeddings=2048)
+           if platform == "tpu" else gpt_tiny())
+    ndev = len(jax.devices())
+    assert ndev > 1, ("the --mesh sweep needs a multi-device platform "
+                      "(the module-top XLA_FLAGS guard forces 8 virtual "
+                      "CPU devices when unset)")
+    H = cfg.num_heads
+    if platform == "tpu":
+        max_len, plen, steps = 2048, 512, 64
+    else:
+        max_len, plen, steps = 128, 24, 24
+    steps = int(os.environ.get("PAGED_STEPS", str(steps)))
+    slots, block, warm = 8, 16, 2
+    rng = np.random.default_rng(int(os.environ.get("SERVING_SEED", "0")))
+    prompts = [rng.integers(0, cfg.vocab_size, (plen,), dtype=np.int32)
+               for _ in range(slots)]
+    max_new = warm + steps + 2
+
+    topo_env = os.environ.get("PAGED_MESH_TOPOS")
+    if topo_env:
+        topos = []
+        for tok in topo_env.split(","):
+            dp, _, mp = tok.strip().partition("x")
+            topos.append((int(mp), int(dp)) if mp else (int(dp), 1))
+    else:
+        # model degrees that split the heads and fit the devices; one
+        # data-replicated variant at the deepest degree when it fits
+        degrees = [g for g in (2, 4, 8) if H % g == 0 and g <= ndev]
+        topos = [(mp, 1) for mp in degrees]
+        if degrees and degrees[-1] * 2 <= ndev:
+            topos.append((degrees[-1], 2))
+    assert topos, f"no model degree splits {H} heads over {ndev} devices"
+
+    def one_build(mesh_on, mp, dp, paged, quant_kv=False):
+        if mesh_on:
+            serving_mesh(mp, data=dp)
+        else:
+            clear_mesh()
+        paddle.seed(0)
+        model = GPTForCausalLM(cfg)
+        model.eval()
+        eng = ServingEngine(model, ServingConfig(
+            num_slots=slots, kv_block_size=block, max_model_len=max_len,
+            paged_kernel=paged, quant_kv=quant_kv))
+        route = eng.kernel_route()
+        if paged:
+            assert route.startswith("kernel@"), (
+                f"silent gather fallback: {route}")
+        for p in prompts:
+            eng.admit(p, max_new)
+        toks = []
+        for _ in range(warm):
+            toks.append(np.asarray(eng.decode_step()))
+        cc0 = compile_cache.stats()
+        traces0 = eng.decode_traces
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            toks.append(np.asarray(eng.decode_step()))
+        _common.sync(eng.arena.pools[0][0])
+        wall = time.perf_counter() - t0
+        cc1 = compile_cache.stats()
+        compiles = int(cc1.get("serving.decode_compiles", 0)
+                       - cc0.get("serving.decode_compiles", 0))
+        assert compiles == 0, f"{compiles} compiles in the timed window"
+        assert eng.decode_traces == traces0 == 1, "decode re-traced"
+        for s in range(slots):
+            eng.retire(s)
+        rec = {"step_ms": round(wall / steps * 1e3, 3),
+               "tokens_per_sec": round(slots * steps / wall, 1),
+               "compiles_during_run": compiles,
+               "route": route}
+        print(f"# mesh-paged {route}"
+              f"{'-int8' if quant_kv else ''}: {rec['step_ms']:.2f} "
+              f"ms/step ({rec['tokens_per_sec']:.1f} tok/s), compiles=0",
+              flush=True)
+        return rec, np.stack(toks)
+
+    # the no-mesh kernel reference: the PR 13 path every topology must
+    # reproduce token-for-token
+    ref_rec, t_ref = one_build(False, 1, 1, True)
+    per_topo = {}
+    try:
+        for mp, dp in topos:
+            g, t_g = one_build(True, mp, dp, False)
+            k, t_k = one_build(True, mp, dp, True)
+            assert (t_g == t_k).all(), (
+                f"kernel-vs-gather token parity at d{dp}xm{mp}")
+            assert (t_ref == t_k).all(), (
+                f"mesh-kernel vs no-mesh token parity at d{dp}xm{mp}")
+            ratio = g["step_ms"] / k["step_ms"]
+            if platform == "tpu":
+                assert ratio >= 1.3, (
+                    f"sharded kernel {ratio:.2f}x gather at d{dp}xm{mp} "
+                    f"/ {slots} slots (gate: >=1.3x)")
+            per_topo[f"d{dp}xm{mp}"] = {
+                "gather": g, "kernel": k,
+                "step_time_ratio": round(ratio, 3)}
+        # fused in-kernel dequant at the deepest topology: int8 arena
+        # (head-sharded payloads, replicated scale pools)
+        mp_q, dp_q = topos[-1]
+        gq, t_gq = one_build(True, mp_q, dp_q, False, quant_kv=True)
+        kq, t_kq = one_build(True, mp_q, dp_q, True, quant_kv=True)
+        assert (t_gq == t_kq).all(), "int8 kernel-vs-gather token parity"
+        ratio_int8 = gq["step_ms"] / kq["step_ms"]
+        if platform == "tpu":
+            assert ratio_int8 >= 1.0, (
+                f"sharded fused dequant {ratio_int8:.2f}x gather+dequant "
+                "(gate: >=1.0x)")
+    finally:
+        clear_mesh()
+
+    head_topo = max(per_topo, key=lambda t: per_topo[t]["step_time_ratio"])
+    rec = {
+        "bench": "serving_paged_attention_mesh",
+        "metric": f"SPMD paged-kernel decode step ratio vs mesh gather "
+                  f"({slots} slots ctx{plen} {platform})",
+        "value": per_topo[head_topo]["step_time_ratio"],
+        "unit": "x gather step time",
+        "platform": platform,
+        "interpreter": platform != "tpu",
+        "devices": ndev,
+        "slots": slots,
+        "context_len": plen,
+        "timed_steps": steps,
+        "token_parity": True,
+        "no_mesh_kernel": ref_rec,
+        "per_topology": per_topo,
+        "int8_fused_dequant": {
+            "topology": f"d{dp_q}xm{mp_q}",
+            "gather": gq, "kernel": kq,
+            "step_time_ratio": round(ratio_int8, 3)},
+        "tpu_gates": {"ratio_fp_min": 1.3, "ratio_int8_min": 1.0,
+                      "enforced": platform == "tpu"},
+    }
+    print(f"# paged-attention --mesh: ratios "
+          + ", ".join(f"{t}={v['step_time_ratio']:.2f}x"
+                      for t, v in per_topo.items())
+          + f", int8 fused {ratio_int8:.2f}x"
+          + (" (interpreter — TPU gates armed for the next chip run)"
+             if platform != "tpu" else ""), flush=True)
+    _persist("paged_attention_mesh", rec)
+
+
 def run_sharded(platform):
     """Mesh-sharded serving bench (ISSUE 14) — see the module docstring.
     Builds its own models (weights commit their shardings at
@@ -1721,6 +1897,9 @@ def main():
         run_quantized(model, platform)
         return
     if "--paged-attention" in sys.argv:
+        if "--mesh" in sys.argv:
+            run_paged_attention_mesh(platform)
+            return
         cfg = (GPTConfig(vocab_size=50304, hidden_size=768, num_layers=12,
                          num_heads=12, max_position_embeddings=2048)
                if platform == "tpu" else gpt_tiny())

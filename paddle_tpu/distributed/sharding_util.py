@@ -15,6 +15,11 @@ heads-sharded over "model", per-block scale pools replicated), and
 :func:`mesh_axes_key` is the hashable mesh fingerprint that joins every
 compiled program key (engine builds, ``generate()``'s runner cache)
 exactly like the quant/donation flags already do.
+
+ISSUE 16 adds :func:`headwise_shard_map` — the manual-partitioning rule
+that runs the Pallas paged-attention kernels per model-shard over the
+pools :func:`shard_kv_entry` committed (local head counts in, replicated
+block tables through, heads-sharded output back to GSPMD).
 """
 from __future__ import annotations
 
@@ -109,6 +114,51 @@ def shard_map_compat(f, mesh, in_specs, out_specs, check_vma=False,
 
     return esm(manual_body, mesh=mesh, in_specs=in_specs,
                out_specs=out_specs, check_rep=False)
+
+
+def headwise_shard_map(fn, mesh, in_head_dims, out_head_dim: int,
+                       num_heads: int):
+    """Manual-partitioning wrapper for a head-parallel Pallas kernel
+    (ISSUE 16) — the SPMD rule the paged-attention kernels run under.
+
+    ``fn`` is a per-device kernel body over positional args;
+    ``in_head_dims[i]`` names the heads dimension of argument ``i``, or
+    ``None`` for replicated runtime data (block tables, positions,
+    per-block scale pools — exactly the operands
+    :func:`shard_kv_entry` keeps replicated). The returned callable maps
+    ``fn`` over the WHOLE mesh via :func:`shard_map_compat`: head-carrying
+    operands split over the "model" axis (so ``fn`` sees the LOCAL head
+    count, ``num_heads // mp``, and reads only its own K/V shard — zero
+    cross-chip traffic), everything else replicates, and the single output
+    re-assembles its ``out_head_dim`` over "model" — handing GSPMD a
+    heads-sharded activation that the row-parallel output projection's
+    psum contracts, same as the gather path.
+
+    When ``num_heads`` doesn't divide the model degree the pools were
+    committed replicated (:func:`shard_kv_entry`'s divisibility guard), so
+    every spec replicates and each device runs the full-head kernel —
+    correct, just not compute-scaled; a data-only mesh degenerates the
+    same way. Replicated operands are passed through :func:`pcast` inside
+    the body (identity on a jax without the vma API) so a vma-checking
+    shard_map types them against the sharded ones."""
+    mp = mesh.shape.get(MODEL_AXIS, 1)
+    split = mp > 1 and num_heads % mp == 0
+
+    def spec(dim):
+        if dim is None or not split:
+            return PartitionSpec()
+        return PartitionSpec(*([None] * dim), MODEL_AXIS)
+
+    in_specs = tuple(spec(d) for d in in_head_dims)
+
+    def body(*local):
+        if split:
+            local = [pcast(a, (MODEL_AXIS,)) if d is None else a
+                     for a, d in zip(local, in_head_dims)]
+        return fn(*local)
+
+    return shard_map_compat(body, mesh, in_specs, spec(out_head_dim),
+                            check_vma=False)
 
 
 def _prune_spec(mesh: Mesh, spec):

@@ -42,6 +42,19 @@ kernels") for the documented bound and the greedy token-parity gate.
 Off-TPU the kernels run in the Pallas interpreter
 (:func:`~paddle_tpu.ops.pallas_ops._use_interpret`), so tier-1 exercises
 this exact code path on the CPU mesh.
+
+SPMD partitioning (ISSUE 16): every public entry takes ``mesh=``. On a
+multi-device mesh the call routes through
+:func:`~paddle_tpu.distributed.sharding_util.headwise_shard_map` —
+``shard_kv_entry`` already committed the K/V payload pools heads-sharded
+over the "model" axis, so each device runs this SAME kernel on its local
+head shard (the grid's head-group math sees the local ``H``) through the
+replicated per-slot block tables, with zero cross-chip K/V traffic; the
+heads-sharded output hands straight to the row-parallel output
+projection's psum. Launch params resolve from the tuning store under the
+mesh-topology key (:func:`paddle_tpu.ops.tuning.lookup` with ``mesh=``)
+BEFORE the manual region, against the local head count. A 1-device mesh
+(or ``mesh=None``) skips the wrapper entirely — bit-identical to PR 13.
 """
 from __future__ import annotations
 
@@ -90,6 +103,25 @@ def _query_block(sq: int, block_q) -> int:
     while sq % b:
         b -= 1
     return b
+
+
+def _mesh_routes(mesh) -> bool:
+    """Whether ``mesh`` routes a call through the manual shard_map wrapper:
+    only a MULTI-device mesh does — a 1-device mesh (the default
+    deployment posture) or no mesh calls pallas directly, so those two
+    stay bit-identical by construction."""
+    return mesh is not None and int(mesh.devices.size) > 1
+
+
+def _local_heads(num_heads: int, mesh) -> int:
+    """The per-device head count inside the manual region: ``H // mp``
+    when the payload pools shard (``shard_kv_entry``'s divisibility rule),
+    else the full ``H`` (replicated pools, replicated kernel)."""
+    from ..distributed.sharding_util import MODEL_AXIS
+
+    mp = mesh.shape.get(MODEL_AXIS, 1)
+    return num_heads // mp if (mp > 1 and num_heads % mp == 0) \
+        else num_heads
 
 
 def _deq(block, scale_row, dtype):
@@ -163,7 +195,7 @@ def _decode_kernel(bt_ref, pos_ref, q_ref, k_ref, v_ref, *rest, bs, blk_h,
 
 
 def paged_decode_attention(q, entry, block_tables, positions,
-                           block_h=None):
+                           block_h=None, mesh=None):
     """Decode attention straight through the block tables.
 
     ``q`` is ``[S, H, D]`` (each slot's new token, heads unflattened);
@@ -176,7 +208,12 @@ def paged_decode_attention(q, entry, block_tables, positions,
     keys at global index ``<= positions[s]`` are attended, matching
     ``masked_attention``'s mask in ``_PagedCacheView``). Returns
     ``[S, H, D]`` in ``q.dtype``. All table/position operands are
-    runtime data: one compiled program serves every churn pattern."""
+    runtime data: one compiled program serves every churn pattern.
+    On a multi-device ``mesh`` the call runs per model-shard (module
+    docstring, "SPMD partitioning")."""
+    if _mesh_routes(mesh):
+        return _sharded_decode(q, entry, block_tables, positions,
+                               block_h, mesh)
     S, H, D = q.shape
     quantized = len(entry) == 4
     kp, vp = entry[0], entry[1]
@@ -226,6 +263,41 @@ def paged_decode_attention(q, entry, block_tables, positions,
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_use_interpret(),
     )(*args)
+
+
+def _sharded_decode(q, entry, block_tables, positions, block_h, mesh):
+    """Per-shard decode: resolve launch params OUTSIDE the manual region
+    under the mesh-topology tuning key (against the LOCAL head count each
+    device actually launches with), then map the plain kernel over the
+    mesh — heads-sharded q/K/V in, replicated tables/positions/scales
+    through, heads-sharded output back."""
+    from ..distributed.sharding_util import (headwise_shard_map,
+                                             mesh_axes_key)
+
+    S, H, D = q.shape
+    if block_h is None:
+        from . import tuning
+
+        rec = tuning.lookup(
+            "paged_decode",
+            tuning.bucket_key(h=_local_heads(H, mesh), d=D,
+                              bs=entry[0].shape[1],
+                              mb=block_tables.shape[1]),
+            mesh=mesh_axes_key(mesh))
+        block_h = (rec or {}).get("block_h") or 0
+    n = len(entry)
+
+    def kernel(q, *rest):
+        # block_h=0 means "safe default, no store lookup" to the plain
+        # entry point — the mesh-keyed lookup above already ran
+        return paged_decode_attention(q, rest[:n], rest[n], rest[n + 1],
+                                      block_h=block_h or 0)
+
+    mapped = headwise_shard_map(
+        kernel, mesh,
+        in_head_dims=(1, 2, 2) + (None,) * (n - 2) + (None, None),
+        out_head_dim=1, num_heads=H)
+    return mapped(q, *entry, block_tables, positions)
 
 
 # --------------------------------------------------------------- prefill
@@ -289,7 +361,7 @@ def _prefill_kernel(bt_ref, meta_ref, q_ref, k_ref, v_ref, *rest, bs,
 
 
 def paged_prefill_attention(q, entry, bt_row, prefix_len,
-                            block_q=None, block_h=None):
+                            block_q=None, block_h=None, mesh=None):
     """Suffix/chunk prefill attention for ONE slot through its table.
 
     ``q`` is ``[sq, H, D]`` (the padded suffix bucket — padded rows
@@ -298,7 +370,12 @@ def paged_prefill_attention(q, entry, bt_row, prefix_len,
     ``i`` attends keys at global index ``<= prefix_len + i``, the
     ``_PrefixPrefillView`` mask verbatim. The suffix's own K/V must
     already be scattered into the pools (same call order as the XLA
-    path: scatter, then attend). Returns ``[sq, H, D]``."""
+    path: scatter, then attend). Returns ``[sq, H, D]``. On a
+    multi-device ``mesh`` the call runs per model-shard (module
+    docstring, "SPMD partitioning")."""
+    if _mesh_routes(mesh):
+        return _sharded_prefill(q, entry, bt_row, prefix_len,
+                                block_q, block_h, mesh)
     sq, H, D = q.shape
     quantized = len(entry) == 4
     kp, vp = entry[0], entry[1]
@@ -360,8 +437,41 @@ def paged_prefill_attention(q, entry, bt_row, prefix_len,
     return jnp.swapaxes(out, 0, 1)
 
 
+def _sharded_prefill(q, entry, bt_row, prefix_len, block_q, block_h, mesh):
+    """Per-shard suffix/chunk prefill — same structure as
+    :func:`_sharded_decode`; ``prefix_len`` rides replicated like the
+    table (runtime data, identical on every device)."""
+    from ..distributed.sharding_util import (headwise_shard_map,
+                                             mesh_axes_key)
+
+    sq, H, D = q.shape
+    if block_q is None and block_h is None:
+        from . import tuning
+
+        rec = tuning.lookup(
+            "paged_prefill",
+            tuning.bucket_key(sq=sq, h=_local_heads(H, mesh), d=D,
+                              bs=entry[0].shape[1], mb=bt_row.shape[0]),
+            mesh=mesh_axes_key(mesh))
+        block_q = (rec or {}).get("block_q") or 0
+        block_h = (rec or {}).get("block_h") or 0
+    n = len(entry)
+
+    def kernel(q, *rest):
+        return paged_prefill_attention(q, rest[:n], rest[n], rest[n + 1],
+                                       block_q=block_q or 0,
+                                       block_h=block_h or 0)
+
+    mapped = headwise_shard_map(
+        kernel, mesh,
+        in_head_dims=(1, 2, 2) + (None,) * (n - 2) + (None, None),
+        out_head_dim=1, num_heads=H)
+    return mapped(q, *entry, bt_row,
+                  jnp.asarray(prefix_len, jnp.int32))
+
+
 def paged_full_prefill_attention(q, k, v, block_size,
-                                 block_q=None, block_h=None):
+                                 block_q=None, block_h=None, mesh=None):
     """Full (no-table) causal prefill through the SAME kernel — the PR 13
     open item: a cache-miss admission has no resident prefix and no block
     table yet, but the flash-style kernel above is exactly the right
@@ -384,4 +494,5 @@ def paged_full_prefill_attention(q, k, v, block_size,
     entry = (k.reshape(nb, bs, H, D), v.reshape(nb, bs, H, D))
     table = jnp.arange(nb, dtype=jnp.int32)
     return paged_prefill_attention(q, entry, table, jnp.int32(0),
-                                   block_q=block_q, block_h=block_h)
+                                   block_q=block_q, block_h=block_h,
+                                   mesh=mesh)

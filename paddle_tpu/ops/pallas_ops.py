@@ -46,32 +46,34 @@ def _compiler_params(**kw):
     return cls(**kw)
 
 
-#: memoized _use_interpret() answer, resolved once per backend at module
-#: level: the default backend is fixed for a process's lifetime
-#: (JAX_PLATFORMS), and the probe (jax.default_backend() resolves the
-#: backend registry) used to re-run inside every pallas_call trace — three
-#: call sites here alone, plus every paged kernel. Maps {backend: bool};
-#: clear it (tests only) after swapping platforms mid-process.
-_INTERPRET_MEMO: Dict[str, bool] = {}
+#: memoized _use_interpret() answers, keyed on (backend, device_count):
+#: the default backend is fixed for a process's lifetime (JAX_PLATFORMS),
+#: and the probe (jax.default_backend() resolves the backend registry)
+#: used to re-run inside every pallas_call trace — three call sites here
+#: alone, plus every paged kernel. The device count is PART of the key
+#: (ISSUE 16): a forced ``xla_force_host_platform_device_count`` mesh is
+#: a different runtime than the single-device probe that may have
+#: resolved first — the blind "one entry, reuse it" fast path reused the
+#: single-device answer there. Clear it (tests only) after swapping
+#: platforms mid-process.
+_INTERPRET_MEMO: Dict[tuple, bool] = {}
 
 
 def _use_interpret() -> bool:
     """Run kernels in the Pallas interpreter off-TPU (CPU test mesh): the CPU
     backend has no Mosaic lowering, and remote-compile plugins would otherwise
     try to ship 'cpu' pallas calls to the accelerator compile service.
-    Memoized per backend at module level (``_INTERPRET_MEMO``) — the backend
-    probe runs once per process, not once per kernel trace."""
-    if len(_INTERPRET_MEMO) == 1:
-        # fast path: the process has one resolved backend (always, outside
-        # platform-swapping tests — those clear the memo)
-        return next(iter(_INTERPRET_MEMO.values()))
+    Memoized per (backend, device_count) at module level
+    (``_INTERPRET_MEMO``); both probes are answered from jax's own cached
+    backend object, so a memo hit never re-resolves the backend
+    registry."""
     try:
-        backend = jax.default_backend()
+        key = (jax.default_backend(), jax.device_count())
     except Exception:  # pragma: no cover
         return True  # never memoize a failed probe
-    hit = _INTERPRET_MEMO.get(backend)
+    hit = _INTERPRET_MEMO.get(key)
     if hit is None:
-        hit = _INTERPRET_MEMO[backend] = backend != "tpu"
+        hit = _INTERPRET_MEMO[key] = key[0] != "tpu"
     return hit
 
 

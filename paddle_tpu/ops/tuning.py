@@ -21,6 +21,16 @@ chip generation:
   :func:`~paddle_tpu.core.compile_cache.bucket_dim` ladder, so the tuning
   key buckets exactly like the compiled-program key does (a shape that
   reuses a compiled program reuses its tuned params too).
+* **mesh topology** (ISSUE 16) — the SPMD paged kernels run per
+  model-shard with LOCAL head counts and per-device VMEM budgets, so a
+  launch tuned on one topology must not be served on another.
+  :func:`lookup`/:func:`adopt` take the
+  :func:`~paddle_tpu.distributed.sharding_util.mesh_axes_key`
+  fingerprint and append a canonical ``mesh=<axis><size>...`` suffix to
+  the bucket. Legacy migration: records adopted before mesh-keying carry
+  no suffix — they were measured without a mesh, so a lookup on any
+  1-device topology (every axis size 1) falls back to the unsuffixed
+  record; a multi-device topology never does.
 
 Adoption is *persisted*: :func:`adopt` merges the record into
 ``benches/TUNED_KERNELS.json`` (atomic tmp+replace write), so a tune run
@@ -40,8 +50,8 @@ import os
 import threading
 from typing import Dict, Optional
 
-__all__ = ["bucket_key", "lookup", "adopt", "entries", "device_kind",
-           "set_store_path", "reset"]
+__all__ = ["bucket_key", "mesh_suffix", "lookup", "adopt", "entries",
+           "device_kind", "set_store_path", "reset"]
 
 _lock = threading.Lock()
 _STORE: Optional[dict] = None      # lazy-loaded file contents
@@ -106,6 +116,21 @@ def bucket_key(**dims) -> str:
                     for k, v in sorted(dims.items()))
 
 
+def mesh_suffix(mesh) -> Optional[str]:
+    """Canonical mesh-topology key component from a
+    :func:`~paddle_tpu.distributed.sharding_util.mesh_axes_key`
+    fingerprint (``((axis, size), ...)``): ``"mesh=data1.model4"``.
+    ``None`` off-mesh — the legacy (unsuffixed) key space."""
+    if not mesh:
+        return None
+    return "mesh=" + ".".join(f"{a}{int(n)}" for a, n in mesh)
+
+
+def _effective_key(key: str, mesh) -> str:
+    sfx = mesh_suffix(mesh)
+    return f"{key},{sfx}" if sfx else key
+
+
 def _load() -> dict:
     global _STORE
     if _STORE is None:
@@ -122,25 +147,36 @@ def _load() -> dict:
     return _STORE
 
 
-def lookup(kernel: str, key: str) -> Optional[dict]:
-    """Best-measured params for ``kernel`` at bucket ``key`` on THIS chip,
-    or ``None`` (fresh checkout, different chip, no tune yet). Memoized
-    per process: the compiled programs traced against a result must keep
-    seeing it."""
-    memo_key = (kernel, key)
+def _params_of(rec) -> Optional[dict]:
+    return dict(rec["params"]) if (
+        isinstance(rec, dict) and isinstance(rec.get("params"), dict)
+    ) else None
+
+
+def lookup(kernel: str, key: str, mesh=None) -> Optional[dict]:
+    """Best-measured params for ``kernel`` at bucket ``key`` on THIS chip
+    and mesh topology (``mesh``: a ``mesh_axes_key`` fingerprint or
+    ``None``), or ``None`` (fresh checkout, different chip/topology, no
+    tune yet). A 1-device topology falls back to the legacy unsuffixed
+    record — pre-ISSUE-16 stores keep resolving there; a multi-device
+    topology never borrows a single-device tune. Memoized per process:
+    the compiled programs traced against a result must keep seeing it."""
+    memo_key = (kernel, key, mesh_suffix(mesh))
     with _lock:
         if memo_key in _LOOKUPS:
             return _LOOKUPS[memo_key]
-        rec = _load().get(device_kind(), {}).get(kernel, {}).get(key)
-        params = dict(rec["params"]) if (
-            isinstance(rec, dict) and isinstance(rec.get("params"), dict)
-        ) else None
+        table = _load().get(device_kind(), {}).get(kernel, {})
+        params = _params_of(table.get(_effective_key(key, mesh)))
+        if params is None and mesh and all(int(n) == 1 for _, n in mesh):
+            # legacy-record migration: a 1-device mesh runs the same
+            # launch geometry as no mesh
+            params = _params_of(table.get(key))
         _LOOKUPS[memo_key] = params
     return params
 
 
 def adopt(kernel: str, key: str, params: dict, measured_us: float,
-          baseline_us: Optional[float] = None) -> bool:
+          baseline_us: Optional[float] = None, mesh=None) -> bool:
     """Persist a measured-best record (tune benches call this after the
     numerics check passed). Merges into a FRESH read of the store file —
     never the per-process snapshot, which may predate another process's
@@ -148,8 +184,10 @@ def adopt(kernel: str, key: str, params: dict, measured_us: float,
     stale-snapshot rewrite would silently erase its records. Atomic
     write; the in-process lookup memo is NOT invalidated — live compiled
     programs keep the params they traced against, new processes get the
-    adoption. Returns whether the record actually reached disk (callers
-    must not report a failed persist as published)."""
+    adoption. ``mesh`` (a ``mesh_axes_key`` fingerprint) keys the record
+    to the topology it was measured on. Returns whether the record
+    actually reached disk (callers must not report a failed persist as
+    published)."""
     global _STORE
     with _lock:
         _STORE = None  # drop the snapshot: merge into what's on disk NOW
@@ -159,7 +197,7 @@ def adopt(kernel: str, key: str, params: dict, measured_us: float,
         if baseline_us is not None:
             rec["baseline_us"] = round(float(baseline_us), 3)
         store.setdefault(device_kind(), {}).setdefault(
-            kernel, {})[key] = rec
+            kernel, {})[_effective_key(key, mesh)] = rec
         path = store_path()
         tmp = path + ".tmp"
         try:

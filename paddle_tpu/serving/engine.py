@@ -196,16 +196,19 @@ class _PagedCacheView:
     in-kernel. The scatter of the new token stays in XLA either way
     (one row per lane — there is no gather to kill there). ``kernel`` is
     trace-time *structure*: toggling it is a different engine build,
-    never a mid-run branch."""
+    never a mid-run branch. ``mesh`` rides the same way (ISSUE 16): on a
+    multi-device mesh the kernel call runs per model-shard through
+    ``headwise_shard_map`` — None keeps the direct pallas path."""
 
     def __init__(self, entry, block_tables, positions, active,
-                 block_size: int, kernel: bool = False):
+                 block_size: int, kernel: bool = False, mesh=None):
         self.entry = entry
         self.block_tables = block_tables  # [S, max_blocks] int32
         self.positions = positions        # [S] int32: write pos of new token
         self.active = active              # [S] bool
         self.block_size = block_size
         self.kernel = kernel
+        self.mesh = mesh
 
     def update_and_attend(self, q, k, v):
         import jax.numpy as jnp
@@ -227,7 +230,8 @@ class _PagedCacheView:
             from ..ops.paged_attention import paged_decode_attention
 
             o = paged_decode_attention(qa[:, 0], entry,
-                                       self.block_tables, pos)[:, None]
+                                       self.block_tables, pos,
+                                       mesh=self.mesh)[:, None]
         else:
             # gather each lane's logical context [S, max_blocks*bs, H, D]
             t_len = self.block_tables.shape[1] * bs
@@ -237,7 +241,7 @@ class _PagedCacheView:
             o = masked_attention(qa, k_all, v_all, mask)
         new = _PagedCacheView(entry, self.block_tables,
                               self.positions, self.active, bs,
-                              kernel=self.kernel)
+                              kernel=self.kernel, mesh=self.mesh)
         return o, new
 
 
@@ -254,9 +258,11 @@ class _CapturePrefillView:
     flash-style kernel; ``kernel=False`` is the original masked_attention
     path, bit-preserved."""
 
-    def __init__(self, block_size: int = 0, kernel: bool = False):
+    def __init__(self, block_size: int = 0, kernel: bool = False,
+                 mesh=None):
         self.block_size = block_size
         self.kernel = kernel
+        self.mesh = mesh
 
     def update_and_attend(self, q, k, v):
         import jax.numpy as jnp
@@ -269,7 +275,8 @@ class _CapturePrefillView:
             from ..ops.paged_attention import paged_full_prefill_attention
 
             o = paged_full_prefill_attention(qa[0], ka[0], va[0],
-                                             self.block_size)[None]
+                                             self.block_size,
+                                             mesh=self.mesh)[None]
             return o, (ka, va)
         p = qa.shape[1]
         mask = (jnp.arange(p)[None, :] <= jnp.arange(p)[:, None])[None, None]
@@ -296,13 +303,14 @@ class _PrefixPrefillView:
     view, so every chunk of a long admission skips the gather too."""
 
     def __init__(self, entry, bt_row, prefix_len, true_len,
-                 block_size: int, kernel: bool = False):
+                 block_size: int, kernel: bool = False, mesh=None):
         self.entry = entry            # the layer's whole arena pool entry
         self.bt_row = bt_row          # [max_blocks] int32: the slot's table
         self.prefix_len = prefix_len  # scalar int32: resident context length
         self.true_len = true_len      # scalar int32: real (unpadded) suffix
         self.block_size = block_size
         self.kernel = kernel
+        self.mesh = mesh
 
     def update_and_attend(self, q, k, v):
         import jax.numpy as jnp
@@ -325,7 +333,8 @@ class _PrefixPrefillView:
             from ..ops.paged_attention import paged_prefill_attention
 
             o = paged_prefill_attention(qa[0], entry, self.bt_row,
-                                        self.prefix_len)[None]
+                                        self.prefix_len,
+                                        mesh=self.mesh)[None]
         else:
             t_len = self.bt_row.shape[0] * bs
             k_all, v_all = _gather_ctx(entry, self.bt_row, qa.dtype)
@@ -334,7 +343,7 @@ class _PrefixPrefillView:
             o = masked_attention(qa, k_all, v_all, mask)
         new = _PrefixPrefillView(entry, self.bt_row,
                                  self.prefix_len, self.true_len, bs,
-                                 kernel=self.kernel)
+                                 kernel=self.kernel, mesh=self.mesh)
         return o, new
 
 
@@ -547,6 +556,14 @@ class ServingEngine:
         self.paged_kernel = (bool(flags.flag("serving_paged_kernel"))
                              if cfg.paged_kernel is None
                              else bool(cfg.paged_kernel))
+        # the mesh the kernel calls route through (ISSUE 16): on a
+        # multi-device mesh every kernel call runs per model-shard via
+        # paged_attention's headwise_shard_map wrapper — the pools are
+        # already heads-sharded by shard_kv_entry, the block tables ride
+        # replicated. None on a 1-device mesh / no mesh: the direct
+        # pallas path there is bit-identical to PR 13 by construction.
+        # Trace-time STRUCTURE like `kernel` itself, never a traced branch.
+        self._kernel_mesh = None
         if self.paged_kernel:
             from ..ops import paged_attention
 
@@ -559,18 +576,7 @@ class ServingEngine:
                               "falling back to the XLA gather path")
                 self.paged_kernel = False
             elif self._mesh_devices > 1:
-                # same once-at-construction rule for ANY multi-device
-                # mesh (model- OR data-axis: the pools commit onto the
-                # whole mesh either way): pallas_call has no SPMD
-                # partitioning rule over mesh-committed pools (routing
-                # it through shard_map is the open follow-up in
-                # docs/distributed.md), so a mesh engine serves the
-                # GSPMD gather path — which shards fine
-                warnings.warn("FLAGS_serving_paged_kernel requested on a "
-                              "multi-device mesh; the paged kernels "
-                              "have no SPMD partitioning yet — serving "
-                              "the (sharded) XLA gather path instead")
-                self.paged_kernel = False
+                self._kernel_mesh = self.mesh
         self._retry = cfg.retry_policy
         if self._retry is None and not self.donate:
             self._retry = resilience.io_policy()
@@ -694,6 +700,14 @@ class ServingEngine:
         metrics.set_gauge("mesh.model_axis", self._mesh_model)
         metrics.set_gauge("mesh.data_axis", self._mesh_data)
         metrics.set_gauge("kernel.paged", int(self.paged_kernel))
+        # the EFFECTIVE attention route x mesh topology (ISSUE 16), per
+        # arena namespace: "kernel@data1.model4", "gather@single", ... A
+        # fallback (Pallas unavailable, flag off) is observable here
+        # instead of inferred from step times — every namespace (primary
+        # + the spec-decode draft) rides the same engine-level route.
+        metrics.set_gauge("kernel.mesh", self.kernel_route())
+        for ns in ["primary"] + self.arena.namespaces():
+            metrics.set_gauge(f"kernel.mesh.{ns}", self.kernel_route())
         if self.paged_kernel:
             from ..ops import tuning as kernel_tuning
 
@@ -834,6 +848,7 @@ class ServingEngine:
         n_layers = model.cfg.num_layers
         bs = self.block_size
         use_kernel = self.paged_kernel
+        kmesh = self._kernel_mesh
 
         def prefill(arrays, ids, true_len, pools, rows, samp, *lora_args):
             # trace-time bookkeeping (runs once per bucket, not per call)
@@ -844,7 +859,7 @@ class ServingEngine:
                 # trace-time: the full-prefill (pseudo-table) kernel twin
                 # of prefill_traces — admission churn never re-lowers it
                 metrics.bump("kernel.prefill_traces")
-            views = [_CapturePrefillView(bs, kernel=use_kernel)
+            views = [_CapturePrefillView(bs, kernel=use_kernel, mesh=kmesh)
                      for _ in range(n_layers)]
             with _swap_data(self._objs, list(arrays)):
                 with prng.key_guard(jax.random.key(0)):
@@ -901,6 +916,7 @@ class ServingEngine:
         lora = self.lora
         bs = self.block_size
         use_kernel = self.paged_kernel
+        kmesh = self._kernel_mesh
 
         def prefix_prefill(arrays, ids, true_len, prefix_len, pools,
                            bt_row, samp, *lora_args):
@@ -912,7 +928,8 @@ class ServingEngine:
                 # asserts chunk/hit churn never re-lowers the kernel
                 metrics.bump("kernel.prefill_traces")
             views = [_PrefixPrefillView(entry, bt_row, prefix_len,
-                                        true_len, bs, kernel=use_kernel)
+                                        true_len, bs, kernel=use_kernel,
+                                        mesh=kmesh)
                      for entry in pools]
             with _swap_data(self._objs, list(arrays)):
                 with prng.key_guard(jax.random.key(0)):
@@ -1067,6 +1084,7 @@ class ServingEngine:
         lora = self.lora
         bs = self.block_size
         use_kernel = self.paged_kernel
+        kmesh = self._kernel_mesh
 
         def step(arrays, pools, block_tables, positions, last_tok, active,
                  samp, *lora_args):
@@ -1077,7 +1095,8 @@ class ServingEngine:
                 # asserts admit/retire churn never re-lowers the kernel
                 metrics.bump("kernel.decode_traces")
             views = [_PagedCacheView(entry, block_tables, positions,
-                                     active, bs, kernel=use_kernel)
+                                     active, bs, kernel=use_kernel,
+                                     mesh=kmesh)
                      for entry in pools]
             with _swap_data(self._objs, list(arrays)):
                 with prng.key_guard(jax.random.key(0)):
@@ -1797,6 +1816,20 @@ class ServingEngine:
 
     # -------------------------------------------------------------- stats
 
+    def kernel_route(self) -> str:
+        """The effective attention route x mesh topology this engine was
+        BUILT with — ``"kernel@data1.model4"``, ``"gather@single"``, ...
+        (the ``kernel.mesh`` gauge). "kernel" means every decode /
+        prefill / spec sub-step reads K/V through the Pallas paged
+        kernels (per model-shard on a multi-device mesh); "gather" is the
+        XLA fallback. Construction-time structure, so a silent fallback
+        shows up here, not as a mystery step-time regression."""
+        route = "kernel" if self.paged_kernel else "gather"
+        topo = ("single" if self.mesh is None else
+                ".".join(f"{a}{int(self.mesh.shape[a])}"
+                         for a in self.mesh.axis_names))
+        return f"{route}@{topo}"
+
     def _publish_arena_bytes(self) -> None:
         """Byte/dtype gauges per arena namespace (scale pools broken out)
         — the memory win of the int8 arena is observable, not asserted:
@@ -1849,6 +1882,7 @@ class ServingEngine:
                "mesh.model_axis": self._mesh_model,
                "mesh.data_axis": self._mesh_data,
                "kernel.paged": int(self.paged_kernel),
+               "kernel.mesh": self.kernel_route(),
                "quant.weights": int(self.quant_weights),
                "quant.kv": int(self.quant_kv),
                # effective, not the raw flag: quant_draft without a draft

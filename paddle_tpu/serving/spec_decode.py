@@ -326,16 +326,19 @@ class SpecDecoder:
         bs = engine.block_size
 
         use_kernel = engine.paged_kernel
+        kmesh = engine._kernel_mesh
 
         def _fwd(m, objs, arrays, pools, bt, positions, toks, act):
             """One single-token model forward — same ops, shapes and view
             class as ``ServingEngine._get_step``'s body, head excluded
             (``kernel=`` rides along: under FLAGS_serving_paged_kernel
             every draft/verify sub-step reads K/V through the block
-            tables via the Pallas paged-decode kernel too).
+            tables via the Pallas paged-decode kernel too, and ``mesh=``
+            with it — on a multi-device mesh the sub-steps run the
+            sharded kernel per model-shard like the main decode step).
             Returns (last hidden [S, H], new pools)."""
             views = [_PagedCacheView(entry, bt, positions, act, bs,
-                                     kernel=use_kernel)
+                                     kernel=use_kernel, mesh=kmesh)
                      for entry in pools]
             with _swap_data(objs, list(arrays)):
                 with prng.key_guard(jax.random.key(0)):

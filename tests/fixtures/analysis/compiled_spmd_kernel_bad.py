@@ -1,0 +1,18 @@
+"""known-bad SPMD-kernel hazards (ISSUE 16): the model-axis degree
+recovered as a *traced per-device value* (``lax.psum(1, "model")``)
+instead of the static mesh shape — the host ``int()`` of it is a
+traced-cast, and the per-shard head count it feeds leaks into a Python
+branch -> traced-branch. The real route (`headwise_shard_map`) closes
+the axis degree statically and reads the local head count off the
+already-sharded ``q.shape``."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def shard_kernel(q, kv_pool, tables):
+    mp = jax.lax.psum(1, "model")        # BAD: traced axis degree
+    local_heads = int(q.shape[1] // mp)  # BAD: host int() of traced value
+    if local_heads > 1:                  # BAD: Python branch on it bakes
+        q = q * 2.0                      # one shard's arm into all shards
+    return q + jnp.sum(kv_pool) + jnp.sum(tables)

@@ -1,0 +1,21 @@
+"""known-good twin: the model-axis degree is the STATIC mesh shape
+(closed at trace time — a different mesh is a different program key),
+the per-shard head count comes off the already-sharded local
+``q.shape`` (static inside the manual region), and per-device selects
+stay in lax-land (``jnp.where`` on the axis index, never a Python
+branch)."""
+import jax
+import jax.numpy as jnp
+
+
+def shard_kernel(q, kv_pool, tables, mp_degree: int):
+    local_heads = q.shape[1]            # static: shard-local shape
+    if mp_degree > 1 and local_heads > 1:   # static mesh shape: fine
+        rank = jax.lax.axis_index("model")
+        q = jnp.where(rank == 0, q * 2.0, q)
+    return q + jnp.sum(kv_pool) + jnp.sum(tables)
+
+
+def serve(mesh, q, kv_pool, tables):
+    step = jax.jit(shard_kernel, static_argnums=(3,))
+    return step(q, kv_pool, tables, mesh.shape.get("model", 1))

@@ -24,6 +24,8 @@ extended to a mesh:
   holding strictly less than the logical total (tensor parallelism is
   real, not annotation theater).
 """
+import warnings
+
 import numpy as np
 import pytest
 
@@ -341,16 +343,24 @@ def test_explicit_config_mesh_threads_everywhere():
         api.close()
 
 
-def test_paged_kernel_falls_back_on_any_multi_device_mesh():
-    """The kernel gate covers data-only meshes too: pools commit onto
-    the whole mesh either way, and pallas_call has no SPMD rule — the
-    engine must warn and serve the gather path, not die at lowering."""
+def test_paged_kernel_serves_on_data_only_mesh():
+    """ISSUE 16 closed the kernels-on-mesh gap: a data-only mesh (no
+    model axis to split heads over) serves the KERNEL with every operand
+    replicated inside the shard_map wrapper — no warning, no gather
+    fallback, token parity with the mesh-gather engine. (The full
+    model-sharded route is tests/test_paged_kernel.py's mesh family.)"""
     serving_mesh(1, data=2)  # drops the size-1 model axis: ("data", 2)
-    with pytest.warns(UserWarning, match="multi-device mesh"):
-        outs, stats, _ = _serve(_model(), _workload(
-            np.random.default_rng(11), n=2), paged_kernel=True)
-    assert stats["kernel.paged"] == 0
+    w = _workload(np.random.default_rng(11), n=2)
+    model = _model()
+    off, _, _ = _serve(model, w, paged_kernel=False)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        outs, stats, _ = _serve(model, w, paged_kernel=True)
+    assert stats["kernel.paged"] == 1
+    assert stats["kernel.mesh"] == "kernel@data2"
     assert stats["mesh.key"] == (("data", 2),)
+    for a, b in zip(off, outs):
+        np.testing.assert_array_equal(a, b)
 
 
 # -------------------------------------------------------------- training

@@ -513,3 +513,252 @@ def test_engine_kernel_off_is_default(model):
     bit-preserved baseline every parity test above compares against."""
     _, st = _serve(model, None, _workload(np.random.default_rng(4), n=2))
     assert st["kernel.paged"] == 0
+    assert st["kernel.mesh"] == "gather@single"
+
+
+# ------------------------------------------------- SPMD partitioning (mesh)
+#
+# ISSUE 16: on a multi-device mesh the kernels run per model-shard
+# through headwise_shard_map — head-sharded q/K/V pool operands,
+# replicated block tables/positions/scales, the row-parallel output
+# psum closing the attention output. Everything below runs on the 8
+# virtual CPU devices conftest forces.
+
+from paddle_tpu.distributed.mesh import serving_mesh  # noqa: E402
+from paddle_tpu.distributed.sharding_util import mesh_axes_key  # noqa: E402
+
+
+def _fresh():
+    paddle.seed(0)
+    m = GPTForCausalLM(gpt_tiny())
+    m.eval()
+    return m
+
+
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+@pytest.mark.parametrize("quantized", [False, True], ids=["full", "int8"])
+def test_sharded_decode_parity(dtype, quantized):
+    """The sharded decode kernel (4-way model split of 8 heads — each
+    device runs its 2 local heads against replicated tables) matches the
+    unsharded kernel: per-head attention is independent, so splitting
+    the head dim changes nothing but placement."""
+    mesh = serving_mesh(4, install=False)
+    rng = np.random.default_rng(9)
+    S, H, D, NB, bs, MB = 4, 8, 32, 17, 8, 4
+    entry = _pools(rng, NB, bs, H, D, dtype, quantized)
+    q = jnp.asarray(rng.standard_normal((S, H, D)), dtype)
+    bt = jnp.asarray(rng.integers(1, NB, (S, MB)), jnp.int32)
+    pos = jnp.asarray([0, 7, 19, 31], jnp.int32)
+    ref = pk.paged_decode_attention(q, entry, bt, pos)
+    out = pk.paged_decode_attention(q, entry, bt, pos, mesh=mesh)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32), **_tol(dtype))
+
+
+@pytest.mark.parametrize("quantized", [False, True], ids=["full", "int8"])
+def test_sharded_prefill_parity(quantized):
+    """The sharded suffix-prefill kernel at several runtime prefix
+    lengths — one shard_map'd program serves them all."""
+    mesh = serving_mesh(4, install=False)
+    rng = np.random.default_rng(10)
+    sq, H, D, NB, bs, MB = 16, 8, 32, 19, 8, 6
+    entry = _pools(rng, NB, bs, H, D, "float32", quantized)
+    q = jnp.asarray(rng.standard_normal((sq, H, D)), jnp.float32)
+    bt_row = jnp.asarray(rng.permutation(np.arange(1, MB + 1)), jnp.int32)
+    for prefix in (0, 5, 31):
+        out = pk.paged_prefill_attention(q, entry, bt_row, prefix,
+                                         mesh=mesh)
+        ref = _prefill_ref(q, entry, bt_row, prefix)
+        np.testing.assert_allclose(
+            np.asarray(out, np.float32), np.asarray(ref, np.float32),
+            err_msg=f"prefix={prefix}", **_tol("float32"))
+
+
+def test_sharded_nondivisible_heads_replicate():
+    """Heads not divisible by the model degree degrade to replicated
+    specs inside the wrapper — correct output, never a crash or a
+    gather fallback."""
+    mesh = serving_mesh(4, install=False)
+    rng = np.random.default_rng(11)
+    S, H, D, NB, bs, MB = 3, 6, 16, 9, 4, 3  # 6 % 4 != 0
+    entry = _pools(rng, NB, bs, H, D)
+    q = jnp.asarray(rng.standard_normal((S, H, D)), jnp.float32)
+    bt = jnp.asarray(rng.integers(1, NB, (S, MB)), jnp.int32)
+    pos = jnp.asarray([2, 7, 11], jnp.int32)
+    out = pk.paged_decode_attention(q, entry, bt, pos, mesh=mesh)
+    ref = _decode_ref(q, entry, bt, pos)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               **_tol("float32"))
+
+
+def test_mesh_engine_kernel_vs_gather_parity_one_trace():
+    """The ISSUE 16 headline gate: on a live (model=4) mesh the kernel
+    engine reproduces the mesh-gather engine token-for-token, decode is
+    traced exactly ONCE (kernel.decode_traces mirrors it), and the
+    route gauge reports kernel@model4 — admit/retire churn on the mesh
+    re-lowers nothing."""
+    serving_mesh(4)
+    model = _fresh()
+    w = _workload(np.random.default_rng(12))
+    off, st0 = _serve(model, None, w, paged_kernel=False)
+    assert st0["kernel.mesh"] == "gather@model4"
+    before = serving_metrics.stats()
+    on, st = _serve(model, None, w, paged_kernel=True)
+    after = serving_metrics.stats()
+    assert st["kernel.paged"] == 1
+    assert st["kernel.mesh"] == "kernel@model4"
+    assert st["decode_traces"] == 1
+    assert after.get("kernel.decode_traces", 0) \
+        - before.get("kernel.decode_traces", 0) == 1
+    for a, b in zip(off, on):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_mesh_engine_parity_int8_arena():
+    """Fused in-kernel dequant per model-shard: int8 arena + kernel on
+    the mesh reproduces the int8 mesh-gather engine exactly (the scale
+    pools ride replicated next to the head-sharded payloads)."""
+    serving_mesh(4)
+    model = _fresh()
+    w = _workload(np.random.default_rng(13), n=4)
+    off, _ = _serve(model, None, w, paged_kernel=False, quant_kv=True)
+    on, st = _serve(model, None, w, paged_kernel=True, quant_kv=True)
+    assert st["arena.quantized"] is True
+    assert st["kernel.mesh"] == "kernel@model4"
+    for a, b in zip(off, on):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_mesh_engine_parity_spec_verify():
+    """Speculative draft/verify sub-steps ride the sharded kernel too:
+    lockstep spec + kernel + mesh == plain mesh greedy decode."""
+    serving_mesh(4)
+    model = _fresh()
+    w = _workload(np.random.default_rng(14), n=3)
+    off, _ = _serve(model, None, w, paged_kernel=False)
+    on, st = _serve(model, None, w, paged_kernel=True, spec_k=2)
+    assert st["spec.mode"] == "lockstep"
+    assert st["kernel.mesh"] == "kernel@model4"
+    for a, b in zip(off, on):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_mesh_mp1_kernel_bit_identity():
+    """A 1-device mesh never takes the shard_map route (`_kernel_mesh`
+    stays None): same tokens as no mesh at all — the PR 13 kernel path
+    is bit-preserved, while the program key still differs (mesh_axes_key
+    joins it)."""
+    w = _workload(np.random.default_rng(15), n=3)
+    ref, st0 = _serve(_fresh(), None, w, paged_kernel=True)
+    assert st0["kernel.mesh"] == "kernel@single"
+    serving_mesh(1)
+    on, st = _serve(_fresh(), None, w, paged_kernel=True)
+    assert st["kernel.paged"] == 1
+    assert st["kernel.mesh"].startswith("kernel@")
+    assert st["kernel.mesh"] != "kernel@single"  # keyed differently
+    for a, b in zip(ref, on):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_tuning_mesh_key_roundtrip(tmp_path):
+    """Mesh-keyed records: adopted under the topology suffix, resolved
+    only at that topology — never off-mesh, never at another degree."""
+    tuning.set_store_path(str(tmp_path / "TUNED_KERNELS.json"))
+    try:
+        key = tuning.bucket_key(h=2, d=32, bs=16, mb=8)
+        topo = (("data", 1), ("model", 4))
+        assert tuning.mesh_suffix(topo) == "mesh=data1.model4"
+        tuning.adopt("paged_decode", key, {"block_h": 2}, 9.0, mesh=topo)
+        tuning.reset()
+        assert tuning.lookup("paged_decode", key, mesh=topo) \
+            == {"block_h": 2}
+        assert tuning.lookup("paged_decode", key) is None
+        assert tuning.lookup("paged_decode", key,
+                             mesh=(("data", 1), ("model", 2))) is None
+    finally:
+        tuning.set_store_path(None)
+
+
+def test_tuning_mesh_legacy_migration(tmp_path):
+    """Pre-ISSUE-16 stores (no mesh suffix) keep resolving on 1-device
+    topologies; a multi-device topology never borrows a single-chip
+    tune; a suffixed 1-device record wins over the legacy fallback."""
+    tuning.set_store_path(str(tmp_path / "TUNED_KERNELS.json"))
+    try:
+        key = tuning.bucket_key(h=4, d=32)
+        tuning.adopt("paged_decode", key, {"block_h": 4}, 7.0)  # legacy
+        tuning.reset()
+        one = (("data", 1), ("model", 1))
+        assert tuning.lookup("paged_decode", key, mesh=one) \
+            == {"block_h": 4}
+        assert tuning.lookup("paged_decode", key,
+                             mesh=(("model", 4),)) is None
+        tuning.adopt("paged_decode", key, {"block_h": 2}, 5.0, mesh=one)
+        tuning.reset()
+        assert tuning.lookup("paged_decode", key, mesh=one) \
+            == {"block_h": 2}
+    finally:
+        tuning.set_store_path(None)
+
+
+def test_sharded_tuned_block_h_applies(tmp_path):
+    """A mesh-keyed tune actually reaches the sharded launch: the
+    record's block_h (legal for the LOCAL head count, 8//4 = 2) changes
+    nothing numerically — block_h stays a pure launch parameter under
+    shard_map."""
+    mesh = serving_mesh(4, install=False)
+    rng = np.random.default_rng(16)
+    S, H, D, NB, bs, MB = 3, 8, 16, 11, 4, 3
+    entry = _pools(rng, NB, bs, H, D)
+    q = jnp.asarray(rng.standard_normal((S, H, D)), jnp.float32)
+    bt = jnp.asarray(rng.integers(1, NB, (S, MB)), jnp.int32)
+    pos = jnp.asarray([2, 7, 11], jnp.int32)
+    ref = _decode_ref(q, entry, bt, pos)
+    tuning.set_store_path(str(tmp_path / "TUNED_KERNELS.json"))
+    try:
+        key = tuning.bucket_key(h=H // 4, d=D, bs=bs, mb=MB)
+        tuning.adopt("paged_decode", key, {"block_h": 2}, 3.0,
+                     mesh=mesh_axes_key(mesh))
+        tuning.reset()
+        out = pk.paged_decode_attention(q, entry, bt, pos, mesh=mesh)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   **_tol("float32"))
+    finally:
+        tuning.set_store_path(None)
+
+
+@pytest.mark.chaos
+def test_mesh_kernel_supervisor_replay_parity():
+    """Supervisor rebuild/replay with mesh AND kernel on: a mid-decode
+    device fault recovers token-identically, the rebuilt arena
+    re-commits the same shardings, and the sharded decode program is
+    reused (decode never re-traced)."""
+    keep = paddle.get_flags("fault_injection")["fault_injection"]
+    paddle.set_flags({"fault_injection": 1})
+    from paddle_tpu.core import resilience
+
+    serving_mesh(4)
+    model = _fresh()
+    cfg = ServingConfig(num_slots=4, kv_block_size=16, max_model_len=128,
+                        paged_kernel=True)
+    api = ServingAPI(model, cfg)
+    try:
+        rng = np.random.default_rng(17)
+        prompts = [rng.integers(0, 1024, (n,), dtype=np.int32)
+                   for n in (5, 9, 12)]
+        reqs = [api.submit(p, max_new_tokens=8) for p in prompts]
+        api.run_until_idle()
+        refs = [r.output_ids() for r in reqs]
+        d0 = api.engine.decode_traces
+        reqs2 = [api.submit(p, max_new_tokens=8) for p in prompts]
+        for _ in range(3):
+            api._pump_once()
+        resilience.inject_fault("serving_device", times=1)
+        api.run_until_idle()
+        for ref, r in zip(refs, reqs2):
+            np.testing.assert_array_equal(ref, r.output_ids())
+        assert api.engine.decode_traces == d0 == 1
+        assert api.engine.stats()["kernel.mesh"] == "kernel@model4"
+    finally:
+        api.close()
+        paddle.set_flags({"fault_injection": keep})

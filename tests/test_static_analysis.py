@@ -95,6 +95,12 @@ FIXTURE_CASES = [
     # and the scatter all-array)
     ("traced-branch", "compiled_tiered", ()),
     ("traced-cast", "compiled_tiered", ()),
+    # the ISSUE 16 SPMD-kernel shape: the model-axis degree recovered as
+    # a traced per-device value (lax.psum of 1), host-cast into a
+    # per-shard head count and Python-branched on (headwise_shard_map
+    # must read the STATIC mesh shape / local q.shape instead)
+    ("traced-cast", "compiled_spmd_kernel", ()),
+    ("traced-branch", "compiled_spmd_kernel", ()),
     ("undefined-flag", "registry_flags",
      ("paddle_tpu/core/flags.py",)),
     ("unknown-metric-key", "registry_metrics",
@@ -152,6 +158,10 @@ def test_bad_fixtures_are_specific():
             # deliberately seeds BOTH restore hazards: traced residency
             # branch + host np.asarray of the donated pool
             allowed |= {"traced-branch", "traced-cast"}
+        if stem == "compiled_spmd_kernel":
+            # deliberately seeds BOTH SPMD-kernel hazards: host-cast of
+            # the traced axis degree + the head-count branch it feeds
+            allowed |= {"traced-cast", "traced-branch"}
         assert rules <= allowed, (stem, rules)
 
 

@@ -62,7 +62,14 @@ tuning-store coverage for this chip, benches/TUNED_KERNELS.json).
 The mesh-sharded execution core (ISSUE 14, docs/distributed.md) adds the
 ``mesh.devices`` / ``mesh.model_axis`` / ``mesh.data_axis`` topology
 gauges — a tensor-parallel run shows ``mesh.model_axis`` > 1 with the
-same frozen compile counters as a single chip.
+same frozen compile counters as a single chip. ``kernel.mesh`` /
+``kernel.mesh.<namespace>`` (ISSUE 16) state the EFFECTIVE attention
+route x topology per arena namespace — ``kernel@data1.model4``,
+``gather@single``, ... — so a silent fallback to the gather path (Pallas
+unavailable, flag off) is observable per run instead of inferred from
+step times; on a multi-device mesh ``kernel@...`` means the sharded
+(per-model-shard) Pallas route served every decode/prefill/spec
+sub-step.
 The multi-tenant gateway's counters ride it too (``serving.gateway``):
 ``gateway.routed`` / ``gateway.rerouted`` (journaled fail-over) /
 ``gateway.ejected`` / ``gateway.respawned`` (replica health) /
