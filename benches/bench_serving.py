@@ -10,10 +10,12 @@ in-flight decode), while the engine admits each arrival into a free slot
 at the next iteration boundary and retires it the moment it finishes.
 
 For each offered concurrency level the bench reports aggregate generated
-tokens/s, per-request latency p50/p99 (arrival -> finish, queueing
-included), and the engine's prefill/decode compile counters across the
-timed window (the admit/retire-never-recompiles invariant, assertable as
-``compiles_during_run == 0``).
+tokens/s, per-request latency p50/p99 plus TTFT and inter-token-gap
+p50/p95/p99 — all derived from the engine's own ``latency.*`` histograms
+(ISSUE 17: submit -> finish e2e, queueing included; the per-bench numpy
+percentile math is gone), and the engine's prefill/decode compile
+counters across the timed window (the admit/retire-never-recompiles
+invariant, assertable as ``compiles_during_run == 0``).
 
 Usage: python benches/bench_serving.py   (TPU: GPT-base; CPU: tiny smoke)
 Env: SERVING_LEVELS (comma list, default "2,4,8"), SERVING_REQUESTS,
@@ -206,14 +208,19 @@ def run_sequential(model, workload):
 def run_engine(api, workload):
     """Drive the ServingAPI in foreground mode against the same arrival
     schedule: submit requests as their arrival time passes, pump the
-    scheduler, stamp each request's finish. Compile counters are sampled
-    around the timed window, so warmup compiles don't count against the
-    zero-recompile invariant."""
+    scheduler. Compile counters AND latency histograms are sampled around
+    the timed window, so warmup compiles/samples don't count against the
+    zero-recompile invariant or the reported percentiles. Latency
+    percentiles come from the ``latency.*`` histograms the engine records
+    anyway (ISSUE 17) — submit -> finish for e2e, plus the TTFT and
+    inter-token distributions no per-bench stopwatch captured before —
+    instead of each bench's own numpy percentile math."""
     from paddle_tpu.core import compile_cache
+    from paddle_tpu.serving import telemetry
 
     cc0 = compile_cache.stats()
+    h0 = telemetry.histograms()
     pending = list(workload)
-    inflight, lat = [], []
     t0 = time.perf_counter()
     while pending or api.scheduler.has_work():
         now = time.perf_counter() - t0
@@ -222,17 +229,10 @@ def run_engine(api, workload):
             # per-request decode scenario (the --sampling workload):
             # sampling params / constraint walker / adapter id ride the
             # submit — all runtime data in the compiled step
-            req = api.submit(w["prompt"], max_new_tokens=w["new"],
-                             **w.get("submit_kw", {}))
-            w["req"] = req
-            inflight.append((req, w["arrival"]))
+            w["req"] = api.submit(w["prompt"], max_new_tokens=w["new"],
+                                  **w.get("submit_kw", {}))
         if api.scheduler.has_work():
             api.scheduler.step()
-            done = time.perf_counter() - t0
-            for item in list(inflight):
-                if item[0].finished:
-                    inflight.remove(item)
-                    lat.append(done - item[1])
         elif pending:
             time.sleep(max(0.0,
                            min(pending[0]["arrival"] - now, 1e-3)))
@@ -243,10 +243,22 @@ def run_engine(api, workload):
                              "serving.prefill_compiles",
                              "serving.cow_compiles",
                              "serving.restore_compiles"))
+    hd = telemetry.histograms_delta(h0)
+
+    def pct(name, q, scale=1.0):
+        h = hd.get(name)
+        return round(h.percentile(q) * scale, 4) if h is not None else 0.0
+
     toks = sum(w["new"] for w in workload)
     return {"tokens_per_sec": toks / wall, "wall_secs": wall,
-            "latency_p50": _percentile(lat, 50),
-            "latency_p99": _percentile(lat, 99),
+            "latency_p50": pct("latency.e2e", 50),
+            "latency_p99": pct("latency.e2e", 99),
+            "ttft_p50_ms": pct("latency.ttft", 50, 1e3),
+            "ttft_p95_ms": pct("latency.ttft", 95, 1e3),
+            "ttft_p99_ms": pct("latency.ttft", 99, 1e3),
+            "inter_token_p50_ms": pct("latency.inter_token", 50, 1e3),
+            "inter_token_p95_ms": pct("latency.inter_token", 95, 1e3),
+            "inter_token_p99_ms": pct("latency.inter_token", 99, 1e3),
             "compiles_during_run": int(compiles)}
 
 
@@ -996,7 +1008,7 @@ def run_paged_attention(model, platform):
     from paddle_tpu.models.gpt import masked_attention
     from paddle_tpu.ops import paged_attention as pk
     from paddle_tpu.ops import tuning
-    from paddle_tpu.serving import ServingConfig, ServingEngine
+    from paddle_tpu.serving import ServingConfig, ServingEngine, telemetry
     from paddle_tpu.serving.engine import _gather_ctx
 
     if platform == "tpu":
@@ -1026,6 +1038,7 @@ def run_paged_attention(model, platform):
         for _ in range(warm):
             toks.append(np.asarray(eng.decode_step()))
         cc0 = compile_cache.stats()
+        h0 = telemetry.histograms()
         traces0 = eng.decode_traces
         t0 = time.perf_counter()
         for _ in range(steps):
@@ -1041,7 +1054,14 @@ def run_paged_attention(model, platform):
             eng.retire(s)
         label = (f"{'kernel' if paged else 'gather'}-"
                  f"{'int8' if quant_kv else 'fp'}")
+        # per-step distribution from the engine's own latency.decode_step
+        # histogram (the mean alone hides bimodal step times)
+        step_h = telemetry.histograms_delta(h0).get("latency.decode_step")
         rec = {"step_ms": wall / steps * 1e3,
+               "step_p50_ms": (round(step_h.percentile(50) * 1e3, 3)
+                               if step_h is not None else None),
+               "step_p99_ms": (round(step_h.percentile(99) * 1e3, 3)
+                               if step_h is not None else None),
                "tokens_per_sec": slots * steps / wall,
                "compiles_during_run": compiles}
         print(f"# paged {label}: {rec['step_ms']:.2f} ms/step "
@@ -1639,6 +1659,8 @@ def run_sampling(model, platform):
         "ratio_vs_greedy": round(ratio, 3),
         "latency_p50": round(mixed["latency_p50"], 4),
         "latency_p99": round(mixed["latency_p99"], 4),
+        "ttft_p99_ms": mixed["ttft_p99_ms"],
+        "inter_token_p99_ms": mixed["inter_token_p99_ms"],
         "compiles_during_run": mixed["compiles_during_run"],
     }
     print(f"# sampling: mixed {rec['value']} tok/s = "
@@ -1986,6 +2008,10 @@ def main():
               f"({rec['speedup_vs_sequential']}x seq), "
               f"p50={rec['latency_p50'] * 1e3:.0f}ms "
               f"p99={rec['latency_p99'] * 1e3:.0f}ms, "
+              f"ttft p50/p95/p99={rec['ttft_p50_ms']:.1f}/"
+              f"{rec['ttft_p95_ms']:.1f}/{rec['ttft_p99_ms']:.1f}ms, "
+              f"gap p50/p99={rec['inter_token_p50_ms']:.2f}/"
+              f"{rec['inter_token_p99_ms']:.2f}ms, "
               f"compiles={rec['compiles_during_run']}", flush=True)
 
     head = next((r for r in sweep if r["slots"] == 8), sweep[-1])
@@ -2001,6 +2027,12 @@ def main():
         "compiles_during_run": head["compiles_during_run"],
         "latency_p50_ms": round(head["latency_p50"] * 1e3, 1),
         "latency_p99_ms": round(head["latency_p99"] * 1e3, 1),
+        "ttft_p50_ms": head["ttft_p50_ms"],
+        "ttft_p95_ms": head["ttft_p95_ms"],
+        "ttft_p99_ms": head["ttft_p99_ms"],
+        "inter_token_p50_ms": head["inter_token_p50_ms"],
+        "inter_token_p95_ms": head["inter_token_p95_ms"],
+        "inter_token_p99_ms": head["inter_token_p99_ms"],
         "sequential": {k: round(v, 4) for k, v in seq.items()},
         "sweep": [{k: (round(v, 4) if isinstance(v, float) else v)
                    for k, v in r.items()} for r in sweep],

@@ -15,10 +15,12 @@ silently.
   ``flags.py`` reads. Anchored at the declaration. Skipped when the run
   only covers a subset of files (``--changed`` mode cannot prove death).
 * ``unknown-metric-key`` — a literal key passed to ``metrics.bump`` /
-  ``metrics.set_gauge`` / ``resilience.bump`` whose namespace (the segment
-  before the first ``.``) is not in the owning module's documented
-  namespace registry (``serving.metrics.DOCUMENTED_NAMESPACES``,
-  ``core.resilience.DOCUMENTED_NAMESPACES``). Dashboards and the stats
+  ``metrics.set_gauge`` / ``resilience.bump`` / ``telemetry.observe``
+  (histogram samples) whose namespace (the segment before the first
+  ``.``) is not in the owning module's documented namespace registry
+  (``serving.metrics.DOCUMENTED_NAMESPACES``,
+  ``core.resilience.DOCUMENTED_NAMESPACES``,
+  ``serving.telemetry.DOCUMENTED_NAMESPACES``). Dashboards and the stats
   CLIs group by namespace — an unregistered one is invisible to all of
   them.
 
@@ -43,6 +45,7 @@ _METRIC_REGISTRIES = {
     # call-target module prefix -> file that documents its namespaces
     "metrics": "paddle_tpu/serving/metrics.py",
     "resilience": "paddle_tpu/core/resilience.py",
+    "telemetry": "paddle_tpu/serving/telemetry.py",
 }
 
 
@@ -166,7 +169,7 @@ class RegistryAnalyzer:
                     continue
                 f = node.func
                 if not isinstance(f, ast.Attribute) \
-                        or f.attr not in ("bump", "set_gauge"):
+                        or f.attr not in ("bump", "set_gauge", "observe"):
                     continue
                 if not isinstance(f.value, ast.Name):
                     continue

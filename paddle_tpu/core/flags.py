@@ -328,6 +328,21 @@ define_flag("gateway_tenant_burst", 0.0,
 define_flag("gateway_tenant_concurrency", 0,
             "Default per-tenant cap on concurrently in-flight gateway "
             "requests. 0 = unlimited.")
+define_flag("serving_telemetry", False,
+            "Request-lifecycle span collection (serving.telemetry): "
+            "SUBMITTED/QUEUED/ADMITTED/FIRST_TOKEN/... events keyed by "
+            "each request's trace_id land in a bounded ring buffer, "
+            "exported via GET /v1/trace/<id> and tools/trace_dump.py "
+            "(Chrome trace-event JSON). Latency histograms are always on "
+            "regardless — this flag gates only the per-event span path. "
+            "Host-side only: never read inside a compiled region, so the "
+            "zero-recompile invariant is unaffected either way.")
+define_flag("serving_trace_events", 4096,
+            "Capacity of the serving telemetry span ring buffer "
+            "(serving.telemetry.TraceLog): the newest N span events are "
+            "kept, older ones are dropped oldest-first (counted as "
+            "telemetry.spans_dropped). Sized so one scrape interval of "
+            "traces fits; raising it only costs host RAM.")
 define_flag("gateway_fair_share", True,
             "Weighted fair-share admission under overload: once the pool's "
             "outstanding work reaches TWICE its slot capacity (slots plus "

@@ -81,6 +81,13 @@ DOCUMENTED_NAMESPACES = (
     # a spill file failing its crc on load (deleted + recomputed, never
     # served) is a resilience event the shared dashboards must see
     "tier",
+    # observability plane (ISSUE 17, serving.telemetry /
+    # docs/observability.md): telemetry.* span meta-counters (spans
+    # recorded / dropped by the bounded ring) and latency.* duration
+    # histograms — the primary copies live in serving.metrics/telemetry;
+    # these entries reserve the namespaces so resilience dashboards can
+    # mirror span-loss and latency-regression alerts
+    "telemetry", "latency",
 )
 
 

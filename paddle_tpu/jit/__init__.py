@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import contextlib
 import functools
+import threading
 from typing import Callable, Dict, List, Optional
 
 import jax
@@ -30,16 +31,28 @@ from ..core.tensor import Tensor
 from ..nn.layer import Layer, mutation_sink
 
 
+# _swap_data mutates shared Tensor objects in place, so concurrent swapped
+# regions over the SAME module corrupt each other: two gateway replicas
+# cold-starting their prefill buckets in background threads would read the
+# other trace's tracers out of the shared params (UnexpectedTracerError at
+# best, silently-baked wrong constants at worst). One process-wide re-entrant
+# lock serializes the whole swapped region; in the serving path it is only
+# ever taken at trace time (compiled bodies run as XLA programs, not
+# Python), so steady-state decode never contends on it.
+_SWAP_LOCK = threading.RLock()
+
+
 @contextlib.contextmanager
 def _swap_data(tensors: List[Tensor], arrays):
-    old = [t._data for t in tensors]
-    for t, a in zip(tensors, arrays):
-        t._data = a
-    try:
-        yield
-    finally:
-        for t, o in zip(tensors, old):
-            t._data = o
+    with _SWAP_LOCK:
+        old = [t._data for t in tensors]
+        for t, a in zip(tensors, arrays):
+            t._data = a
+        try:
+            yield
+        finally:
+            for t, o in zip(tensors, old):
+                t._data = o
 
 
 def functional_call(layer: Layer, params_and_buffers: Dict[str, object], *args, **kwargs):

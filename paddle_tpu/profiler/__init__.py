@@ -127,10 +127,12 @@ class Profiler:
                 self._device_dir = None
         from ..core import compile_cache, resilience
         from ..serving import metrics as serving_metrics
+        from ..serving import telemetry as serving_telemetry
 
         self._cc_start = compile_cache.stats()
         self._rs_start = resilience.stats()
         self._sv_start = serving_metrics.stats()
+        self._lt_start = serving_telemetry.histograms()
         self._running = True
 
     def stop(self):
@@ -162,6 +164,19 @@ class Profiler:
 
         self.serving_stats = serving_metrics.stats_delta(
             getattr(self, "_sv_start", {}), serving_metrics.stats())
+        # latency percentiles over the profiled window only: subtract the
+        # start-of-run bucket counts so a long-lived process doesn't smear
+        # old samples into this profile's p99
+        from ..serving import telemetry as serving_telemetry
+
+        self.latency_stats = {}
+        for name, h in serving_telemetry.histograms_delta(
+                getattr(self, "_lt_start", {})).items():
+            self.latency_stats[f"{name}.count"] = h.n
+            self.latency_stats[f"{name}.p50_ms"] = round(
+                h.percentile(50) * 1e3, 3)
+            self.latency_stats[f"{name}.p99_ms"] = round(
+                h.percentile(99) * 1e3, 3)
         self._running = False
         if self.on_trace_ready is not None:
             self.on_trace_ready(self)
@@ -253,7 +268,8 @@ class Profiler:
         for title, rec in (
                 ("Compile Cache", getattr(self, "compile_cache_stats", None)),
                 ("Resilience", getattr(self, "resilience_stats", None)),
-                ("Serving", getattr(self, "serving_stats", None))):
+                ("Serving", getattr(self, "serving_stats", None)),
+                ("Latency", getattr(self, "latency_stats", None))):
             if not rec or views is not None:
                 continue
             nz = {k: v for k, v in sorted(rec.items())

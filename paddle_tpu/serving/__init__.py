@@ -45,6 +45,10 @@ served endpoint), rebuilt as an Orca/vLLM-style decode runtime:
   store gathered by per-slot index inside the compiled step (adapter 0 =
   base weights; every gateway tenant gets its own fine-tune).
 * :mod:`.metrics`   — counters/gauges on the shared observability surface.
+* :mod:`.telemetry` — latency histograms (TTFT / inter-token / queue /
+  prefill / decode-step / restore / e2e), request-lifecycle trace ring
+  keyed by a ``trace_id`` that survives preemption/replay/re-route, and
+  the Prometheus + Chrome-trace export plane (docs/observability.md).
 
 See docs/serving.md for the architecture and lifecycle walkthrough and
 docs/robustness.md ("Serving under failure") for the recovery contract.

@@ -49,7 +49,7 @@ from typing import Iterator, List, Optional, Sequence
 import numpy as np
 
 from ..core import flags, resilience
-from . import metrics
+from . import metrics, telemetry
 from .engine import ServingConfig, ServingEngine
 from .scheduler import Request, RequestState, Scheduler
 from .supervisor import EngineSupervisor
@@ -123,7 +123,7 @@ class ServingAPI:
                request_id: str = "", priority: int = 0,
                journal: Optional[Sequence[int]] = None,
                shed: bool = True, sampling=None, constraint=None,
-               adapter: int = 0) -> Request:
+               adapter: int = 0, trace_id: str = "") -> Request:
         """Enqueue one generation request; returns its handle immediately.
 
         ``timeout`` is the request's end-to-end wall-clock deadline
@@ -153,7 +153,13 @@ class ServingAPI:
         :meth:`register_adapter`; 0 = base weights) select the request's
         decode scenario. All three are per-slot runtime data in the ONE
         compiled decode step — mixing them across a batch never
-        recompiles."""
+        recompiles.
+
+        ``trace_id`` carries an existing lifecycle trace onto this
+        request (the gateway passes its ``RoutedRequest``'s id so a
+        re-route continues ONE timeline); empty mints a fresh one and
+        emits its SUBMITTED span here — exactly one site ever emits
+        SUBMITTED per trace (docs/observability.md)."""
         with self._lock:
             # checked under the lock: a submit racing drain()/close() must
             # never enqueue after the straggler sweep (its request would
@@ -171,12 +177,18 @@ class ServingAPI:
                 except resilience.QueueOverloadError:
                     metrics.bump("requests.shed")
                     raise
+            minted = not trace_id
             req = Request(prompt, max_new_tokens=max_new_tokens,
                           stop_token_id=stop_token_id,
                           request_id=request_id, priority=priority,
                           sampling=sampling, constraint=constraint,
-                          adapter_id=int(adapter),
+                          adapter_id=int(adapter), trace_id=trace_id,
                           deadline=resilience.Deadline.after(timeout))
+            if minted:
+                telemetry.span(req.trace_id, telemetry.SUBMITTED,
+                               request_id=req.request_id,
+                               prompt_tokens=int(req.prompt.shape[0]),
+                               max_new_tokens=int(max_new_tokens))
             if journal:
                 if len(journal) >= int(max_new_tokens):
                     raise ValueError(
@@ -351,6 +363,14 @@ class ServingAPI:
                           + len(self.scheduler.prefilling)
                           + len(self.scheduler.running))
             if stragglers:
+                for req in (self.scheduler.waiting
+                            + self.scheduler.prefilling
+                            + self.scheduler.running):
+                    # DRAINED precedes the FAILED span fail_all emits:
+                    # the timeline shows retriable-drain, then terminal
+                    telemetry.span(req.trace_id, telemetry.DRAINED,
+                                   request_id=req.request_id,
+                                   reason=reason)
                 self.scheduler.fail_all(resilience.RequestDrainedError(
                     f"{reason}: request drained before completion "
                     f"(grace={grace:g}s); safe to resubmit"))
@@ -641,11 +661,27 @@ class EnginePredictor:
                             engine.adapter_admits, lora_desc)
         else:
             scenario = ""
+        # headline latency percentiles from THIS engine's histograms
+        # (satellite: the benches read the same surface instead of
+        # re-deriving percentiles from ad-hoc sample lists)
+        ttft_h = engine.hists.peek("latency.ttft")
+        gap_h = engine.hists.peek("latency.inter_token")
+        latency = ""
+        if ttft_h is not None and ttft_h.n:
+            latency = (", ttft p50/p95/p99 %.1f/%.1f/%.1f ms" % (
+                ttft_h.percentile(50) * 1e3, ttft_h.percentile(95) * 1e3,
+                ttft_h.percentile(99) * 1e3))
+            if gap_h is not None and gap_h.n:
+                latency += (", inter-token p50/p95/p99 "
+                            "%.2f/%.2f/%.2f ms" % (
+                                gap_h.percentile(50) * 1e3,
+                                gap_h.percentile(95) * 1e3,
+                                gap_h.percentile(99) * 1e3))
         _logger.info(
             "EnginePredictor closed: %d finished, %d failed, "
             "%d supervisor replays (%d rebuilds), %d preemptions, "
-            "%d drains%s%s%s%s%s",
+            "%d drains%s%s%s%s%s%s",
             self._finished, self._failed,
             api.supervisor.replay_count, api.supervisor.rebuild_count,
             api.scheduler.preempt_count, api.drain_count, prefix, tier,
-            speculation, quant, scenario)
+            speculation, quant, scenario, latency)

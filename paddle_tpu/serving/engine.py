@@ -95,6 +95,7 @@ reproducing the plain engine exactly.
 """
 from __future__ import annotations
 
+import time
 import warnings
 from contextlib import nullcontext as _null_ctx
 from dataclasses import dataclass, field
@@ -104,7 +105,7 @@ import numpy as np
 
 from ..core import compile_cache, flags, resilience
 from ..core.tensor import Tensor
-from . import metrics
+from . import metrics, telemetry
 from .kv_arena import ArenaExhaustedError, KVArena, Reservation
 from .prefix_cache import PrefixCache
 from .spec_decode import SpecDecoder
@@ -458,6 +459,7 @@ class _AdmitState:
     sampling: Optional[object] = None  # SamplingParams (None = greedy)
     adapter: int = 0                   # LoRA arena row (0 = base)
     skip_draft: bool = False  # spec-ineligible: no draft prefill/blocks
+    trace_id: str = ""  # the owning request's trace (RESTORED spans)
 
 
 class ServingEngine:
@@ -692,7 +694,14 @@ class ServingEngine:
         # built after the arena so the draft namespace can bind to it
         self.spec = (SpecDecoder(self, cfg.draft_model, spec_k)
                      if spec_k > 0 else None)
-        self._meter = metrics.Meter()  # lifetime aggregate tokens/s gauge
+        self._meter = metrics.Meter()  # sliding-window tokens/s gauge
+        # per-replica latency histograms (ISSUE 17): every observe() below
+        # records into BOTH the process-global set (pool-merged view,
+        # survives replica ejection) and this one (`/v1/metrics` labels it
+        # by replica index); timestamps are taken AROUND compiled calls,
+        # never inside them — see docs/observability.md "Overhead policy"
+        self.hists = telemetry.HistogramSet()
+        self._trace_ctx = ""  # the in-flight admission's trace id
         metrics.set_gauge("slots.total", s)
         # mesh/axis gauges (ISSUE 14): the live topology next to the mode
         # gauges — tools/serving_stats.py --run reports them per run
@@ -1018,6 +1027,7 @@ class ServingEngine:
         garbage) or when the arena has no headroom for another restore
         target. Returns how many leading nodes of ``nodes`` were
         restored."""
+        t0 = time.perf_counter()
         cache = self.prefix_cache
         payloads, live = [], []
         for node in nodes:
@@ -1069,6 +1079,13 @@ class ServingEngine:
         for node, blk in zip(live, blks):
             cache.mark_restored(node, blk)
         self.tier.note_restored(payloads)
+        telemetry.observe("latency.restore", time.perf_counter() - t0,
+                          self.hists)
+        # the restore ran inside an admission's radix walk: its span lands
+        # on the admitting request's timeline (the engine is serialized
+        # under the api lock, so _trace_ctx is exactly that admission's)
+        telemetry.span(self._trace_ctx, telemetry.RESTORED,
+                       blocks=len(live))
         return len(live)
 
     def _get_step(self):
@@ -1150,7 +1167,8 @@ class ServingEngine:
 
     def admit(self, prompt: np.ndarray, max_new_tokens: int,
               tokens=None, sampling=None, adapter: int = 0,
-              mask=None, spec_exclude: bool = False) -> Tuple[int, int]:
+              mask=None, spec_exclude: bool = False,
+              trace_id: str = "") -> Tuple[int, int]:
         """Prefill ``prompt`` (plus an optional already-generated token
         journal) into a free slot. Returns ``(slot, next_token)`` — the
         token comes out of the prefill program itself (the context's last
@@ -1173,15 +1191,20 @@ class ServingEngine:
         same values and resumes bit-identically.
 
         Raises if no capacity; callers gate on :meth:`can_admit`."""
+        self._trace_ctx = trace_id
+        t0 = time.perf_counter()
         st = self._admit_setup(prompt, max_new_tokens, tokens,
                                sampling=sampling, adapter=adapter,
                                mask=mask, spec_exclude=spec_exclude)
-        return st.slot, self._admit_prefill_all(st)
+        out = st.slot, self._admit_prefill_all(st)
+        telemetry.observe("latency.prefill", time.perf_counter() - t0,
+                          self.hists)
+        return out
 
     def admit_begin(self, prompt: np.ndarray, max_new_tokens: int,
                     tokens=None, sampling=None, adapter: int = 0,
-                    mask=None,
-                    spec_exclude: bool = False) -> Tuple[int, Optional[int]]:
+                    mask=None, spec_exclude: bool = False,
+                    trace_id: str = "") -> Tuple[int, Optional[int]]:
         """Chunked admission entry point: claim a slot + block budget now,
         prefill incrementally. Returns ``(slot, first_token)`` when the
         whole context fits one chunk (identical to :meth:`admit`), or
@@ -1190,12 +1213,18 @@ class ServingEngine:
         the first token appears. The slot is *occupied* (its blocks are
         held) but not *active* (its lane stays masked out of the decode
         step), so running streams keep decoding between chunks."""
+        self._trace_ctx = trace_id
+        t0 = time.perf_counter()
         st = self._admit_setup(prompt, max_new_tokens, tokens,
                                sampling=sampling, adapter=adapter,
                                mask=mask, spec_exclude=spec_exclude)
         chunk = self.chunk_size
         if chunk <= 0 or st.clen - st.prefix_len <= chunk:
-            return st.slot, self._admit_prefill_all(st)
+            out = st.slot, self._admit_prefill_all(st)
+            telemetry.observe("latency.prefill", time.perf_counter() - t0,
+                              self.hists)
+            return out
+        st.trace_id = trace_id  # admit_chunk restores the trace context
         st.done = st.prefix_len
         self._chunk[st.slot] = st
         metrics.bump("chunk.admits")
@@ -1213,6 +1242,8 @@ class ServingEngine:
         if st is None:
             raise RuntimeError(f"slot {slot} has no chunked prefill "
                                "in progress")
+        self._trace_ctx = st.trace_id
+        t0 = time.perf_counter()
         take = min(self.chunk_size, st.clen - st.done)
         try:
             nxt, new_pools = self._suffix_prefill_call(
@@ -1221,9 +1252,8 @@ class ServingEngine:
             st.done += take
             metrics.bump("chunk.chunks")
             metrics.bump("chunk.tokens", take)
-            if st.done < st.clen:
-                return None
-            if self.spec is not None and not st.skip_draft:
+            if (st.done >= st.clen and self.spec is not None
+                    and not st.skip_draft):
                 self.spec.prefill(slot, st.ctx)
         # analysis: allow(broad-except) — cleanup-and-reraise: a failed
         # chunk must not leak the admission's blocks/refs/slot
@@ -1231,6 +1261,10 @@ class ServingEngine:
             self._chunk.pop(slot, None)
             self._admit_abort(st)
             raise
+        telemetry.observe("latency.prefill", time.perf_counter() - t0,
+                          self.hists)
+        if st.done < st.clen:
+            return None
         self._chunk.pop(slot, None)
         return self._admit_finish(st, int(nxt))
 
@@ -1732,7 +1766,11 @@ class ServingEngine:
         up to k accepted tokens per active slot from one compiled call —
         see :class:`~.spec_decode.SpecDecoder.step`. Returns
         ``{slot: [tokens]}``."""
-        return self.spec.step()
+        t0 = time.perf_counter()
+        out = self.spec.step()
+        telemetry.observe("latency.spec_step", time.perf_counter() - t0,
+                          self.hists)
+        return out
 
     def _samp_args(self):
         """The decode step's per-slot sampling pytree: (temp, top_k,
@@ -1792,6 +1830,7 @@ class ServingEngine:
         through here, see :meth:`spec_ineligible`."""
         import jax.numpy as jnp
 
+        t0 = time.perf_counter()
         act = self._active if active is None else np.asarray(active, bool)
         # grow block tables whose write position crossed a block boundary
         for slot in np.flatnonzero(act):
@@ -1812,6 +1851,8 @@ class ServingEngine:
         metrics.bump("tokens.generated", int(act.sum()))
         self._meter.tick(int(act.sum()))
         metrics.set_gauge("tokens_per_sec", round(self._meter.rate(), 1))
+        telemetry.observe("latency.decode_step",
+                          time.perf_counter() - t0, self.hists)
         return out
 
     # -------------------------------------------------------------- stats

@@ -32,6 +32,18 @@ the client's contract with its model):
   (what a load balancer health-checks).
 * ``GET /v1/stats`` — pool + tenant snapshot next to the process-global
   ``serving.metrics`` counters.
+* ``GET /v1/metrics`` — the same picture in the Prometheus text
+  exposition format (``text/plain``): every counter/gauge, every
+  ``latency.*`` histogram (pool-merged buckets + p50/p95/p99 quantiles,
+  per-replica quantiles labeled ``replica="<idx>"``), per-replica health
+  and per-tenant goodput as labeled series. Pure snapshot read —
+  O(registry), no compiled work, scrape-safe under churn.
+* ``GET /v1/trace/<request_id>`` — one request's lifecycle span timeline
+  (``FLAGS_serving_telemetry``; SUBMITTED → QUEUED → ADMITTED → ... →
+  FINISHED, one ``trace_id`` across preemption/replay/re-route — see
+  docs/observability.md). Accepts the gateway request id or a raw
+  ``trace_id``; ``tools/trace_dump.py`` renders the same events as Chrome
+  trace JSON.
 
 Error taxonomy → status codes (retriable errors carry ``Retry-After``):
 
@@ -63,7 +75,7 @@ from urllib.parse import parse_qs, urlparse
 import numpy as np
 
 from ...core import flags, resilience
-from .. import metrics
+from .. import metrics, telemetry
 from .router import NoHealthyReplicaError, ReplicaPool, RoutedRequest
 
 _logger = logging.getLogger("paddle_tpu.serving.gateway")
@@ -303,6 +315,10 @@ def _make_handler(gw: Gateway):
                     return self._healthz()
                 if parsed.path == "/v1/stats":
                     return self._stats()
+                if parsed.path == "/v1/metrics":
+                    return self._metrics()
+                if parsed.path.startswith("/v1/trace"):
+                    return self._trace(self._tail("/v1/trace/", parsed))
                 if parsed.path.startswith("/v1/stream"):
                     rid = self._tail("/v1/stream/", parsed)
                     rr = gw._get(rid)
@@ -386,6 +402,35 @@ def _make_handler(gw: Gateway):
             snap = {k: v for k, v in metrics.stats().items()
                     if isinstance(v, (int, float)) and not isinstance(v, bool)}
             self._json(200, {"pool": gw.pool.stats(), "serving": snap})
+
+        def _metrics(self):
+            body = telemetry.prometheus_text(pool=gw.pool).encode()
+            self.send_response(200)
+            # the Prometheus text exposition content type (format 0.0.4)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _trace(self, rid: str):
+            if not rid:
+                return self._json(400, {
+                    "error": "ValueError",
+                    "message": "GET /v1/trace/<request_id>"})
+            rr = gw._get(rid)
+            # the tail is either a gateway request id or a raw trace id
+            trace_id = rr.trace_id if rr is not None else rid
+            events = telemetry.trace(trace_id)
+            if not events and rr is None:
+                return self._json(404, {
+                    "error": "NotFound",
+                    "message": f"no trace for {rid!r} (unknown id, "
+                               "FLAGS_serving_telemetry off, or the span "
+                               "ring already dropped it)"})
+            self._json(200, {"trace_id": trace_id,
+                             "enabled": telemetry.enabled(),
+                             "events": events})
 
         def _sse(self, rr: RoutedRequest) -> None:
             self.send_response(200)

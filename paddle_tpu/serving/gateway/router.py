@@ -61,7 +61,7 @@ from typing import Callable, Dict, List, Optional, Sequence
 import numpy as np
 
 from ...core import flags, resilience
-from .. import metrics
+from .. import metrics, telemetry
 from ..api import ServingAPI
 from ..scheduler import Request, RequestState
 from ..supervisor import CrashLoopError, is_transient_serving_error
@@ -237,6 +237,10 @@ class RoutedRequest:
         self.adapter = int(adapter)
         self.deadline = deadline
         self.request_id = request_id or f"gw-{next(_gw_counter)}"
+        # ONE lifecycle trace for the whole handle: every backend Request
+        # this handle ever routes to (re-routes included) carries this id,
+        # so eject -> re-route -> replay reads as one span timeline
+        self.trace_id = telemetry.mint_trace_id()
         self.reroutes = 0
         self.state = RequestState.QUEUED
         self.error: Optional[BaseException] = None
@@ -514,6 +518,13 @@ class ReplicaPool:
                            sampling=samp,
                            constraint=constraint,
                            adapter=ad)
+        # the gateway is this trace's minting site (api.submit sees a
+        # non-empty trace_id and stays quiet — exactly one SUBMITTED
+        # per trace)
+        telemetry.span(rr.trace_id, telemetry.SUBMITTED,
+                       request_id=rr.request_id, tenant=tenant,
+                       prompt_tokens=int(prompt.shape[0]),
+                       max_new_tokens=int(max_new_tokens))
         try:
             self._route(rr, journal=None)
         except Exception:
@@ -547,7 +558,8 @@ class ReplicaPool:
                     request_id=f"{rr.request_id}.{rr.reroutes}",
                     priority=rr.priority, journal=journal,
                     shed=journal is None, sampling=rr.sampling,
-                    constraint=rr.constraint, adapter=rr.adapter)
+                    constraint=rr.constraint, adapter=rr.adapter,
+                    trace_id=rr.trace_id)
             except (resilience.QueueOverloadError,
                     resilience.RequestDrainedError) as e:
                 last_exc = e  # replica-local condition: try the next one
@@ -709,6 +721,14 @@ class ReplicaPool:
                 f"(FLAGS_gateway_max_reroutes); giving up"))
             return
         rr.reroutes += 1
+        # the span marks the DECISION, before the re-submit, so the
+        # timeline reads REROUTED -> QUEUED -> ADMITTED on the survivor
+        # (docs/observability.md); a failed re-route shows REROUTED
+        # followed by FAILED — the attempt is part of the story
+        telemetry.span(rr.trace_id, telemetry.REROUTED,
+                       request_id=rr.request_id, reroute=rr.reroutes,
+                       from_replica=rr._replica_idx,
+                       journal_tokens=len(journal))
         try:
             self._route(rr, journal=journal)
         except Exception as e:  # analysis: allow(broad-except) — any
@@ -1093,10 +1113,24 @@ class ReplicaPool:
         process-global ``serving.metrics`` counters). With speculative
         decoding / chunked prefill on, each replica row carries its
         engine's acceptance picture — per-replica, since acceptance skew
-        across replicas is a routing signal worth watching."""
+        across replicas is a routing signal worth watching.
+
+        The whole replica picture — rows AND the healthy/capacity/
+        outstanding totals — comes from ONE lock acquisition. The totals
+        used to be recomputed after release via :meth:`healthy_replicas`
+        etc., so a scrape racing an eject/respawn could report e.g. a row
+        marked unhealthy next to a capacity that still counted it (a
+        half-updated fleet picture on exactly the dashboards meant to
+        debug ejections)."""
         with self._lock:
             reps = []
+            healthy = capacity = outstanding = 0
             for r in self._replicas:
+                routable = r.routable()
+                if routable:
+                    healthy += 1
+                    capacity += r.api.engine.num_slots
+                    outstanding += r.outstanding()
                 row = {"idx": r.idx, "healthy": r.healthy,
                        "draining": r.draining, "removed": r.removed,
                        "generation": r.generation, "ejections": r.ejections,
@@ -1111,19 +1145,23 @@ class ReplicaPool:
                 if not r.removed and getattr(r.api.engine, "chunk_size", 0):
                     row["prefilling"] = len(r.api.scheduler.prefilling)
                 reps.append(row)
+            tier_store = None
+            for r in self._replicas:
+                if r.routable():
+                    tier = getattr(r.api.engine, "tier", None)
+                    if tier is not None:
+                        tier_store = tier.store
+                        break
         out = {"replicas": reps,
                "replicas_total": sum(1 for r in reps if not r["removed"]),
-               "replicas_healthy": len(self.healthy_replicas()),
-               "capacity_slots": self.capacity(),
-               "outstanding": self.outstanding(),
+               "replicas_healthy": healthy,
+               "capacity_slots": capacity,
+               "outstanding": outstanding,
                "draining": self._draining,
                "radix_index": self.index.stats(),
                "tenants": self.tenants.stats()}
         # the shared spill-tier picture (ISSUE 15): replicas attach to one
         # HostKVCache, so reporting any live replica's store covers all
-        for r in self.healthy_replicas():
-            tier = getattr(r.api.engine, "tier", None)
-            if tier is not None:
-                out["tier"] = tier.store.stats()
-                break
+        if tier_store is not None:
+            out["tier"] = tier_store.stats()
         return out

@@ -115,6 +115,10 @@ class _TenantState:
     completed: int = 0
     failed: int = 0
     tokens_out: int = 0          # generated tokens of COMPLETED requests
+    # per-tenant goodput rate over the Meter's sliding window (ISSUE 17):
+    # the stats row's tokens_per_sec — a live load signal per tenant, not
+    # a lifetime average
+    meter: metrics.Meter = field(default_factory=metrics.Meter)
 
     def __post_init__(self):
         self.tokens = self.cfg.bucket_capacity()  # start with a full burst
@@ -150,7 +154,7 @@ class TenantManager:
             state = _TenantState(cfg)
             if old is not None:
                 for k in ("inflight", "admitted", "shed", "completed",
-                          "failed", "tokens_out"):
+                          "failed", "tokens_out", "meter"):
                     setattr(state, k, getattr(old, k))
             self._tenants[cfg.name] = state
             return cfg
@@ -301,6 +305,7 @@ class TenantManager:
             else:
                 state.completed += 1
                 state.tokens_out += int(tokens_out)
+                state.meter.tick(int(tokens_out))
                 metrics.bump("tenant.completed")
                 if state.configured:
                     metrics.bump(f"tenant.{name}.tokens_out",
@@ -323,5 +328,6 @@ class TenantManager:
                     "inflight": s.inflight, "admitted": s.admitted,
                     "shed": s.shed, "completed": s.completed,
                     "failed": s.failed, "tokens_out": s.tokens_out,
+                    "tokens_per_sec": round(s.meter.rate(), 1),
                 }
             return out

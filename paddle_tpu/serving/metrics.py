@@ -86,13 +86,20 @@ progress), ``spec.acceptance_rate``, ``slots.active``, ``slots.total``,
 (resident prefix blocks — in use but reclaimable), ``arena.high_water``,
 ``arena.kv_bytes``, ``arena.frag_tokens`` (allocated-block capacity minus
 live context tokens — internal fragmentation of the paged cache),
-``prefix.resident_blocks``, ``tokens_per_sec`` (the engine's
-lifetime-aggregate decode rate from its :class:`Meter`),
+``prefix.resident_blocks``, ``tokens_per_sec`` (the engine's decode rate
+over its :class:`Meter`'s sliding window — idle tails decay it to 0
+instead of averaging into a lifetime mean),
 ``gateway.replicas_healthy`` / ``gateway.replicas_total`` /
 ``gateway.outstanding`` (the router's fleet picture),
 ``sampling.active_slots`` / ``constrain.active_slots`` /
 ``lora.active_slots`` (scenario mix of the live batch), and the adapter
 arena's ``lora.slots`` / ``lora.live`` / ``lora.arena_bytes``.
+
+Latency *distributions* live next door in ``serving.telemetry``
+(``latency.*`` histograms + ``telemetry.*`` span meta-counters — see its
+docstring for the key registry); :func:`histograms` re-exports them here
+so this module stays the one-stop stats surface, and ``GET /v1/metrics``
+renders both planes as Prometheus text via ``telemetry.prometheus_text``.
 """
 from __future__ import annotations
 
@@ -126,6 +133,15 @@ DOCUMENTED_NAMESPACES = (
     # mesh.devices / mesh.model_axis / mesh.data_axis gauges set at
     # construction (docs/distributed.md "Tensor-parallel serving")
     "mesh",
+    # telemetry.* (ISSUE 17): the tracing plane's own meta-counters —
+    # spans recorded / spans_dropped (ring overflow), mirrored from
+    # serving.telemetry (docs/observability.md)
+    "telemetry",
+    # latency.* (ISSUE 17): duration histograms (ttft, inter_token,
+    # queue_wait, prefill, decode_step, spec_step, spec_verify, restore,
+    # spill, e2e) — serving.telemetry observe() keys, exported as
+    # paddle_latency_*_seconds (docs/observability.md)
+    "latency",
     "queue", "slots", "tokens_per_sec",
 )
 
@@ -172,25 +188,60 @@ def stats_delta(before: dict, after: dict, *, drop_zero: bool = False) -> dict:
 
 
 class Meter:
-    """Tokens/s meter over a wall-clock window: ``tick(n)`` per step,
-    ``rate()`` for the current aggregate rate since construction/reset."""
+    """Tokens/s meter over a SLIDING window: ``tick(n)`` per step,
+    ``rate()`` for the windowed rate. Ticks land in per-second buckets
+    and ``rate()`` sums only the last ``window`` seconds, so an idle
+    tail decays the gauge toward 0 instead of averaging into a lifetime
+    mean (the pre-ISSUE-17 behaviour, which made ``tokens_per_sec``
+    useless as a load signal after the first lull). ``tokens()`` still
+    reports the lifetime count. ``now`` is injectable for deterministic
+    decay tests."""
 
-    def __init__(self) -> None:
+    def __init__(self, window: float = 10.0, now=time.perf_counter) -> None:
+        self._window = float(window)
+        self._now = now
         self.reset()
 
     def reset(self) -> None:
-        self._t0 = time.perf_counter()
+        self._t0 = self._now()
         self._n = 0
+        self._buckets: Dict[int, int] = {}
 
     def tick(self, n: int) -> None:
-        self._n += int(n)
+        n = int(n)
+        self._n += n
+        sec = int(self._now())
+        self._buckets[sec] = self._buckets.get(sec, 0) + n
+        # GIL-safe pruning: the dict stays O(window) without a lock
+        if len(self._buckets) > self._window * 2 + 2:
+            horizon = sec - self._window
+            for k in [k for k in self._buckets if k < horizon]:
+                self._buckets.pop(k, None)
 
     def tokens(self) -> int:
+        """Lifetime tick total (NOT windowed)."""
         return self._n
 
     def rate(self) -> float:
-        dt = time.perf_counter() - self._t0
-        return self._n / dt if dt > 0 else 0.0
+        """Tokens/s over the sliding window. Before a full window has
+        elapsed since construction/reset, divides by the elapsed time so
+        early readings aren't diluted by the empty remainder."""
+        now = self._now()
+        horizon = now - self._window
+        n = sum(c for sec, c in list(self._buckets.items())
+                if sec >= horizon - 1.0)
+        dt = min(now - self._t0, self._window)
+        return n / dt if dt > 0 else 0.0
+
+
+def histograms() -> dict:
+    """The latency histograms (``serving.telemetry``'s process-global
+    set), re-exported so callers already importing ``metrics`` get the
+    whole stats picture from one module. Lazy import: telemetry imports
+    this module for its meta-counters."""
+    from . import telemetry
+
+    return telemetry.histograms()
 
 
 def _register_providers() -> None:

@@ -46,13 +46,14 @@ from __future__ import annotations
 import itertools
 import queue as _queue
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import List, Optional
 
 import numpy as np
 
 from ..core import flags, resilience
-from . import metrics
+from . import metrics, telemetry
 
 _req_counter = itertools.count()
 _seq_counter = itertools.count()  # arrival / admission ordering ticks
@@ -109,6 +110,15 @@ class Request:        # compare numpy prompt payloads
     _cache_skips: int = 0  # times cache-affinity admitted someone past us
     _prefix_keys: Optional[list] = None  # memoized radix chunk-key chain
     preemptions: int = 0  # times this request was preempted mid-decode
+    # observability (ISSUE 17): ONE trace id names this request's whole
+    # lifecycle — minted here unless the caller (gateway RoutedRequest,
+    # supervisor replay via journal-seeded resubmit) already carries one,
+    # so preemption re-queue / replay / re-route all land their spans on
+    # the same timeline (docs/observability.md)
+    trace_id: str = ""
+    _submit_ts: float = 0.0     # perf_counter at construction (ttft/e2e)
+    _queued_ts: float = 0.0     # perf_counter at enqueue (queue_wait)
+    _last_emit_ts: float = 0.0  # perf_counter of the last emitted token
 
     def __post_init__(self):
         self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)
@@ -119,8 +129,11 @@ class Request:        # compare numpy prompt payloads
             # request then replays/preempts/re-routes token-identically
             self.sampling = self.sampling.materialized()
         self._arrival = next(_seq_counter)
+        self._submit_ts = time.perf_counter()
         if not self.request_id:
             self.request_id = f"req-{next(_req_counter)}"
+        if not self.trace_id:
+            self.trace_id = telemetry.mint_trace_id()
         self._cstate = (None if self.constraint is None
                         else self.constraint.initial())
 
@@ -186,7 +199,10 @@ def admit_kwargs(req: Request) -> dict:
     up front."""
     return {"sampling": req.sampling, "adapter": req.adapter_id,
             "mask": req.allowed_mask(),
-            "spec_exclude": req.constraint is not None}
+            "spec_exclude": req.constraint is not None,
+            # the engine holds this as its trace context for the admit
+            # call so restore-path spans (RESTORED) land on this timeline
+            "trace_id": req.trace_id}
 
 
 class Scheduler:
@@ -212,8 +228,13 @@ class Scheduler:
                              int(request.max_new_tokens),
                              adapter=request.adapter_id)
         request.state = RequestState.QUEUED
+        request._queued_ts = time.perf_counter()
         self.waiting.append(request)
         metrics.bump("requests.submitted")
+        telemetry.span(request.trace_id, telemetry.QUEUED,
+                       request_id=request.request_id,
+                       priority=request.priority,
+                       journal_tokens=len(request.tokens))
         self._gauges()
         return request
 
@@ -247,12 +268,38 @@ class Scheduler:
             # the shared resilience counter dashboards watch (the same key
             # Deadline.check() bumps)
             resilience.bump("deadline.exceeded")
+        if state == RequestState.FINISHED:
+            # e2e = construction -> complete output (only for requests
+            # that delivered one — failures/cancels would skew the tail)
+            telemetry.observe("latency.e2e",
+                              time.perf_counter() - req._submit_ts,
+                              getattr(self.engine, "hists", None))
+        telemetry.span(req.trace_id,
+                       telemetry.FINISHED if state == RequestState.FINISHED
+                       else telemetry.FAILED,
+                       request_id=req.request_id, state=state,
+                       tokens=len(req.tokens),
+                       error=type(error).__name__ if error else None)
         req.stream_queue.put(None)  # stream sentinel
         req.done_event.set()
 
     def _emit(self, req: Request, token: int) -> None:
         if req.finished:
             return  # a walker failure mid-iteration already closed it
+        now = time.perf_counter()
+        if not req.tokens and req._last_emit_ts == 0.0:
+            # TRUE first token only: a journal-seeded resubmit (gateway
+            # re-route) arrives with tokens, a replayed/preempted request
+            # keeps its _last_emit_ts — neither re-records TTFT
+            telemetry.observe("latency.ttft", now - req._submit_ts,
+                              getattr(self.engine, "hists", None))
+            telemetry.span(req.trace_id, telemetry.FIRST_TOKEN,
+                           request_id=req.request_id, token=int(token))
+        elif req._last_emit_ts > 0.0:
+            telemetry.observe("latency.inter_token",
+                              now - req._last_emit_ts,
+                              getattr(self.engine, "hists", None))
+        req._last_emit_ts = now
         req.tokens.append(int(token))
         req.stream_queue.put(int(token))
         if req.constraint is not None:
@@ -383,9 +430,16 @@ class Scheduler:
             return False
         victim = max(candidates, key=lambda r: (r.priority, r._admit_seq))
         self.engine.retire(victim.slot)
+        telemetry.span(victim.trace_id, telemetry.PREEMPTED,
+                       request_id=victim.request_id, slot=victim.slot,
+                       by=waiter.request_id, tokens=len(victim.tokens))
         self.running.remove(victim)
         victim.slot = None
         victim.state = RequestState.QUEUED
+        victim._queued_ts = time.perf_counter()  # re-queued: new wait
+        telemetry.span(victim.trace_id, telemetry.QUEUED,
+                       request_id=victim.request_id,
+                       journal_tokens=len(victim.tokens))
         victim._starved = 0
         victim.preemptions += 1
         self.waiting.append(victim)
@@ -421,6 +475,9 @@ class Scheduler:
         req = self.prefilling[0]
         try:
             first = self.engine.admit_chunk(req.slot)
+            telemetry.span(req.trace_id, telemetry.PREFILL_CHUNK,
+                           request_id=req.request_id, slot=req.slot,
+                           done=first is not None)
         # analysis: allow(broad-except) — classification inside:
         # transient engine sickness re-queues + re-raises for the
         # supervisor; anything else fails THIS request, not the pump
@@ -522,6 +579,13 @@ class Scheduler:
                 continue
             req.slot = slot
             req.state = RequestState.RUNNING
+            telemetry.observe("latency.queue_wait",
+                              time.perf_counter() - req._queued_ts,
+                              getattr(self.engine, "hists", None))
+            telemetry.span(req.trace_id, telemetry.ADMITTED,
+                           request_id=req.request_id, slot=slot,
+                           chunked=first is None,
+                           journal_tokens=len(req.tokens))
             progress = True
             if first is None:
                 # chunked prefill in progress: holds its slot/blocks but

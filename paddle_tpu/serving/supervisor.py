@@ -50,7 +50,7 @@ from __future__ import annotations
 from typing import List, Optional
 
 from ..core import flags, resilience
-from . import metrics
+from . import metrics, telemetry
 from .scheduler import RequestState, _seq_counter, admit_kwargs
 
 
@@ -232,6 +232,13 @@ class EngineSupervisor:
                 req.slot = slot
                 req._admit_seq = next(_seq_counter)
                 sched.running.append(req)
+                # REPLAYED before the replayed token's emit: the timeline
+                # reads rebuild -> resume -> tokens, on the SAME trace_id
+                # the request carried since submit
+                telemetry.span(req.trace_id, telemetry.REPLAYED,
+                               request_id=req.request_id, slot=slot,
+                               journal_tokens=len(req.tokens),
+                               rebuilds=self.rebuild_count)
                 sched._emit(req, nxt)
                 self.replay_count += 1
                 metrics.bump("supervisor.replays")

@@ -1,0 +1,24 @@
+"""known-bad: telemetry from INSIDE a compiled region -> traced-cast (x2).
+
+The overhead policy (docs/observability.md) puts timestamps AROUND
+compiled calls, never inside: a host clock read under trace is baked in
+as a constant at trace time, and casting a traced value to feed the
+histogram forces a device sync on every step."""
+import time
+
+import jax
+
+from paddle_tpu.serving import telemetry
+
+
+def step(x):
+    t0 = time.perf_counter()  # baked at TRACE time, not read per step
+    y = (x * x).sum()
+    telemetry.observe("latency.decode_step", float(y))  # BAD: traced cast
+    dt = time.perf_counter() - t0  # constant: both reads traced together
+    telemetry.observe("latency.decode_step",
+                      dt + float(y * 0))  # BAD: traced cast to smuggle dt
+    return y
+
+
+step_jit = jax.jit(step)

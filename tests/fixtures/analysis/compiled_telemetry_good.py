@@ -1,0 +1,23 @@
+"""known-good twin: the host-side telemetry pattern — the compiled
+function is pure array math; the timestamp pair and the histogram record
+wrap the dispatch from OUTSIDE (one perf_counter pair + one bucket
+increment per step, zero traced work)."""
+import time
+
+import jax
+
+from paddle_tpu.serving import telemetry
+
+
+def step(x):
+    return (x * x).sum()
+
+
+step_jit = jax.jit(step)
+
+
+def timed_step(x):
+    t0 = time.perf_counter()
+    y = step_jit(x)
+    telemetry.observe("latency.decode_step", time.perf_counter() - t0)
+    return y

@@ -1,9 +1,11 @@
 """known-bad: a metric key in a namespace missing from
 metrics.DOCUMENTED_NAMESPACES -> unknown-metric-key (typo'd namespace
-would silently vanish from every stats CLI)."""
-from paddle_tpu.serving import metrics
+would silently vanish from every stats CLI); same rule for histogram
+keys through telemetry.observe (ISSUE 17)."""
+from paddle_tpu.serving import metrics, telemetry
 
 
-def record(n):
+def record(n, dt):
     metrics.bump("requets.finished")        # BAD: typo'd namespace
     metrics.set_gauge("qeue.depth", n)      # BAD: typo'd namespace
+    telemetry.observe("latncy.ttft", dt)    # BAD: typo'd histogram ns

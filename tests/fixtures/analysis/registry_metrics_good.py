@@ -1,8 +1,9 @@
 """known-good twin: every key lives in a documented namespace."""
-from paddle_tpu.serving import metrics
+from paddle_tpu.serving import metrics, telemetry
 
 
-def record(n, name):
+def record(n, name, dt):
     metrics.bump("requests.finished")
     metrics.set_gauge("queue.depth", n)
     metrics.bump(f"tenant.{name}.admitted")  # literal prefix checked
+    telemetry.observe("latency.ttft", dt)    # documented histogram ns

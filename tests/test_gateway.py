@@ -12,6 +12,8 @@ Heavier load/fairness runs live in ``benches/bench_serving.py --gateway``;
 a miniature is here under the ``slow`` marker.
 """
 import json
+import re
+import threading
 import time
 import urllib.error
 import urllib.request
@@ -20,7 +22,7 @@ import numpy as np
 import pytest
 
 import paddle_tpu as paddle
-from paddle_tpu.core import resilience
+from paddle_tpu.core import compile_cache, resilience
 from paddle_tpu.core.tensor import Tensor
 from paddle_tpu.models.gpt import GPTForCausalLM, gpt_tiny
 from paddle_tpu.serving import (
@@ -29,6 +31,7 @@ from paddle_tpu.serving import (
     ServingAPI,
     TenantConfig,
     TenantManager,
+    telemetry,
 )
 from paddle_tpu.serving import metrics as serving_metrics
 from paddle_tpu.serving.gateway import Gateway
@@ -493,6 +496,207 @@ def test_http_drain_maps_to_503(model):
         assert ei.value.code == 503
     finally:
         gw.close()
+
+
+# ------------------------------------------------- observability (ISSUE 17)
+
+_COMPILE_KEYS = ("serving.decode_compiles", "serving.prefill_compiles",
+                 "serving.cow_compiles", "serving.restore_compiles")
+
+_PROM_LINE = re.compile(
+    r"^[a-zA-Z_][a-zA-Z0-9_]*(\{[^{}]*\})? -?\d+(\.\d+)?([eE][+-]?\d+)?$")
+
+
+def test_http_metrics_scrape_concurrent_with_sse_under_churn(model):
+    """``GET /v1/metrics`` scraped in a loop while SSE streams decode:
+    every scrape is valid Prometheus text exposition, the scrapes cause
+    ZERO serving compiles (the export plane reads host-side counters —
+    it must never touch a traced region), and ``/v1/trace/<request_id>``
+    serves the finished request's span timeline over HTTP."""
+    keep = paddle.get_flags(["serving_telemetry"])
+    paddle.set_flags({"serving_telemetry": True})
+    telemetry.reset_tracelog()
+    pool = ReplicaPool(model, replicas=2, background=True, **POOL_KW)
+    gw = Gateway(pool, port=0).start()
+    base = f"http://127.0.0.1:{gw.port}"
+    try:
+        rng = np.random.default_rng(21)
+        # warm both replicas at the churn shape so the scraped window is
+        # compile-free (same prompt length -> same prefill bucket)
+        warm = [pool.submit(_prompt(rng, 6), max_new_tokens=4, tenant="m")
+                for _ in range(4)]
+        for rr in warm:
+            pool.result(rr, timeout=60)
+        cc0 = compile_cache.stats()
+
+        scrapes, errors = [], []
+        stop = threading.Event()
+
+        def scraper():
+            while not stop.is_set():
+                try:
+                    with urllib.request.urlopen(base + "/v1/metrics",
+                                                timeout=30) as resp:
+                        ctype = resp.headers["Content-Type"]
+                        assert ctype.startswith(
+                            "text/plain; version=0.0.4"), ctype
+                        scrapes.append(resp.read().decode())
+                except Exception as e:  # noqa: BLE001 — surfaced below
+                    errors.append(e)
+                    return
+                time.sleep(0.002)
+
+        th = threading.Thread(target=scraper, daemon=True)
+        th.start()
+        body = json.dumps({"prompt": _prompt(rng, 6).tolist(),
+                           "max_new_tokens": 5, "tenant": "m"}).encode()
+        for _ in range(4):  # churn: live SSE streams under the scraper
+            req = urllib.request.Request(base + "/v1/stream", data=body,
+                                         method="POST")
+            with urllib.request.urlopen(req, timeout=120) as resp:
+                for _line in resp:
+                    pass
+        # one request by id so /v1/trace has a finished timeline to serve
+        sub = json.load(urllib.request.urlopen(urllib.request.Request(
+            base + "/v1/submit", data=body, method="POST"), timeout=60))
+        json.load(urllib.request.urlopen(
+            base + f"/v1/result/{sub['request_id']}?timeout=60",
+            timeout=120))
+        stop.set()
+        th.join(timeout=30)
+        assert not errors, errors[0]
+        assert scrapes  # the scraper did overlap the streams
+
+        cc1 = compile_cache.stats()
+        assert sum(cc1.get(k, 0) - cc0.get(k, 0)
+                   for k in _COMPILE_KEYS) == 0
+
+        last = scrapes[-1]
+        for line in last.splitlines():
+            if not line or line.startswith("#"):
+                continue
+            assert _PROM_LINE.match(line) or "+Inf" in line, line
+        assert "paddle_serving_tokens_generated" in last
+        assert "paddle_latency_ttft_seconds_bucket" in last
+        assert "paddle_gateway_replica_outstanding" in last
+
+        tr = json.load(urllib.request.urlopen(
+            base + f"/v1/trace/{sub['request_id']}", timeout=30))
+        assert tr["enabled"] is True and tr["trace_id"].startswith("t")
+        kinds = [e["event"] for e in tr["events"]]
+        assert kinds[0] == telemetry.SUBMITTED
+        assert telemetry.FIRST_TOKEN in kinds
+        assert kinds[-1] == telemetry.FINISHED
+        # unknown ids stay a clean 404, not a crash in the export plane
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(base + "/v1/trace/nope", timeout=30)
+        assert ei.value.code == 404
+    finally:
+        gw.close()
+        paddle.set_flags(keep)
+        telemetry.reset_tracelog()
+
+
+def test_stats_snapshot_consistent_under_concurrent_eject(model):
+    """Regression: the router's ``stats()`` snapshot is taken under ONE
+    lock — scrapers hammering it while replicas are ejected and respawned
+    must never observe a torn picture where the healthy/capacity headline
+    disagrees with the per-replica rows it was (supposedly) derived from.
+    (The old implementation read ``healthy_replicas()`` outside the rows
+    pass; an eject between the two reads skewed ``capacity_slots``.)"""
+    keep = paddle.get_flags(["serving_max_rebuilds"])
+    paddle.set_flags({"serving_max_rebuilds": 1})
+    pool = ReplicaPool(model, replicas=2, respawn_backoff=0.01, **POOL_KW)
+    torn = []
+    stop = threading.Event()
+
+    def scraper():
+        while not stop.is_set():
+            st = pool.stats()
+            routable = sum(1 for row in st["replicas"]
+                           if row["healthy"] and not row["draining"]
+                           and not row["removed"])
+            if st["replicas_healthy"] != routable:
+                torn.append(("replicas_healthy", st))
+                return
+            if st["capacity_slots"] != routable * POOL_KW["num_slots"]:
+                torn.append(("capacity_slots", st))
+                return
+            time.sleep(0.0005)
+
+    threads = [threading.Thread(target=scraper, daemon=True)
+               for _ in range(3)]
+    try:
+        for th in threads:
+            th.start()
+        rng = np.random.default_rng(22)
+        for _cycle in range(3):  # eject -> reroute -> respawn, repeatedly
+            rr = pool.submit(_prompt(rng, 6), max_new_tokens=6, tenant="s")
+            victim = pool._replica_at(rr._replica_idx)
+            pool.pump_once()
+            _kill_decode(victim)
+            pool.result(rr, timeout=60)
+            time.sleep(0.05)  # past the respawn backoff
+            pool.pump_once()  # respawn happens at the next pump
+        assert len(pool.healthy_replicas()) == 2
+    finally:
+        stop.set()
+        for th in threads:
+            th.join(timeout=30)
+        pool.close()
+        paddle.set_flags(keep)
+    assert not torn, torn[0]
+
+
+@pytest.mark.chaos
+def test_chaos_trace_timeline_survives_eject_and_reroute(model):
+    """ISSUE 17 chaos acceptance: a serving_device eject -> re-route ->
+    journal replay keeps ONE trace_id whose span timeline is complete and
+    ordered — exactly one SUBMITTED (the gateway mints, everyone
+    downstream passes the id along), exactly one FIRST_TOKEN (the
+    journal-seeded resubmit must not re-record it), a REROUTED span at
+    the fail-over followed by QUEUED/ADMITTED on the survivor, FINISHED
+    last, ``seq`` strictly increasing throughout."""
+    keep = paddle.get_flags(["serving_max_rebuilds", "serving_telemetry"])
+    paddle.set_flags({"serving_max_rebuilds": 1, "serving_telemetry": True})
+    telemetry.reset_tracelog()
+    pool = ReplicaPool(model, replicas=2, respawn_backoff=600, **POOL_KW)
+    try:
+        rng = np.random.default_rng(23)
+        p = _prompt(rng, 8)
+        ref = _ref(model, p, 8)
+        rr = pool.submit(p, max_new_tokens=8, tenant="chaos")
+        victim = pool._replica_at(rr._replica_idx)
+        for _ in range(3):  # a few tokens land before the chip dies
+            pool.pump_once()
+        assert not rr.finished
+        _kill_decode(victim)
+        out = pool.result(rr, timeout=60)
+        np.testing.assert_array_equal(out, ref)
+        assert rr.reroutes == 1
+
+        events = telemetry.trace(rr.trace_id)
+        kinds = [e["event"] for e in events]
+        assert kinds.count(telemetry.SUBMITTED) == 1
+        assert kinds.count(telemetry.FIRST_TOKEN) == 1
+        assert kinds.count(telemetry.REROUTED) == 1
+        assert kinds.count(telemetry.FINISHED) == 1
+        assert kinds[-1] == telemetry.FINISHED
+        # the survivor re-admits from the journal AFTER the re-route
+        after = kinds[kinds.index(telemetry.REROUTED):]
+        assert telemetry.QUEUED in after and telemetry.ADMITTED in after
+        # one contiguous, strictly ordered timeline — no interleaved or
+        # duplicated sequence numbers across the replica hop
+        seqs = [e["seq"] for e in events]
+        assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+        assert all(e["trace_id"] == rr.trace_id for e in events)
+        # wall clocks are monotone too (same host; ties allowed)
+        ts = [e["ts"] for e in events]
+        assert all(b >= a for a, b in zip(ts, ts[1:]))
+    finally:
+        pool.close()
+        paddle.set_flags(keep)
+        telemetry.reset_tracelog()
 
 
 # ----------------------------------------------------------- load (slow)

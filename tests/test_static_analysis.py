@@ -104,7 +104,14 @@ FIXTURE_CASES = [
     ("undefined-flag", "registry_flags",
      ("paddle_tpu/core/flags.py",)),
     ("unknown-metric-key", "registry_metrics",
-     ("paddle_tpu/serving/metrics.py",)),
+     ("paddle_tpu/serving/metrics.py",
+      "paddle_tpu/serving/telemetry.py")),
+    # the ISSUE 17 observability shape: telemetry from INSIDE a compiled
+    # region — a trace-time-baked clock read smuggled out through a
+    # float() cast of a traced value (timestamps + histogram records
+    # belong AROUND the dispatch; docs/observability.md overhead policy)
+    ("traced-cast", "compiled_telemetry",
+     ("paddle_tpu/serving/telemetry.py",)),
     ("broad-except", "hygiene_broad_except", ()),
 ]
 

@@ -77,6 +77,13 @@ The multi-tenant gateway's counters ride it too (``serving.gateway``):
 ``tenant.admitted`` / ``tenant.shed_rate`` / ``tenant.shed_concurrency`` /
 ``tenant.shed_share`` and the per-tenant ``tenant.<name>.tokens_out``
 goodput counters.
+The observability plane (ISSUE 17, docs/observability.md) adds the
+``latency.*`` histograms (ttft, inter_token, queue_wait, prefill,
+decode_step, restore, e2e, ... — recorded host-side around compiled
+calls) rendered as a per-run p50/p95/p99 percentile table, plus the
+``telemetry.spans`` / ``telemetry.spans_dropped`` trace-ring counters
+(the headline ``serving.ttft_p50_ms`` / ``serving.inter_token_p99_ms``
+percentiles live on the shared ``memory_stats`` surface).
 A run report also prints the end-of-run arena/prefix/gateway gauges
 (occupancy, cached/resident blocks, high-water, fragmentation, replica
 health) next to the delta — point-in-time state, not differenced.
@@ -176,9 +183,10 @@ def main(argv=None) -> int:
             os.path.abspath(__file__))))
         import runpy
 
-        from paddle_tpu.serving import metrics
+        from paddle_tpu.serving import metrics, telemetry
 
         before = metrics.stats()
+        hists_before = telemetry.histograms()
         t0 = time.perf_counter()
         sys.argv = list(args.run)
         try:
@@ -203,16 +211,31 @@ def main(argv=None) -> int:
                                          "spec", "queue", "quant",
                                          "gateway", "tenant", "sampling",
                                          "constrain", "lora", "kernel",
-                                         "mesh", "tier")}
+                                         "mesh", "tier", "telemetry",
+                                         "serving")}
+        # latency histograms recorded during the run (ISSUE 17): the same
+        # per-run delta discipline as the counters, rendered as percentiles
+        hists = telemetry.histograms_delta(hists_before)
+        latency = {name: {"count": h.n,
+                          "p50_ms": round(h.percentile(50) * 1e3, 3),
+                          "p95_ms": round(h.percentile(95) * 1e3, 3),
+                          "p99_ms": round(h.percentile(99) * 1e3, 3),
+                          "mean_ms": round(h.mean() * 1e3, 3)}
+                   for name, h in sorted(hists.items())}
         rec = {"wall_secs": round(wall, 3), "stats": delta,
-               "gauges": gauges,
+               "gauges": gauges, "latency": latency,
                "tokens_per_sec": round(toks / wall, 2) if wall > 0 else None}
-        print(json.dumps(rec) if args.json else
-              "\n".join([f"wall_secs: {rec['wall_secs']}",
-                         f"tokens_per_sec: {rec['tokens_per_sec']}"]
-                        + [f"{k}: {v}" for k, v in sorted(delta.items())]
-                        + [f"gauge {k}: {v}"
-                           for k, v in sorted(gauges.items())]))
+        if args.json:
+            print(json.dumps(rec))
+        else:
+            print("\n".join([f"wall_secs: {rec['wall_secs']}",
+                             f"tokens_per_sec: {rec['tokens_per_sec']}"]
+                            + [f"{k}: {v}" for k, v in sorted(delta.items())]
+                            + [f"gauge {k}: {v}"
+                               for k, v in sorted(gauges.items())]))
+            table = telemetry.percentile_table(hists)
+            if table:
+                print(table)
         return 0
 
     rep = _config_report()

@@ -1,0 +1,107 @@
+#!/usr/bin/env python
+"""Request-lifecycle trace export: dump buffered span events as Chrome
+trace-event JSON (``chrome://tracing`` / Perfetto's ``traceEvents``
+format) so one request's SUBMITTED -> QUEUED -> ADMITTED -> FIRST_TOKEN
+-> ... -> FINISHED timeline — preemptions, re-routes and replays
+included — renders as a swimlane per trace_id.
+
+Usage:
+    python tools/trace_dump.py --run CMD [args...] [-o trace.json]
+        Execute CMD in-process with FLAGS_serving_telemetry forced on,
+        then export every span the run buffered (the
+        tools/serving_stats.py --run harness, pointed at the trace ring
+        instead of the counters).
+    python tools/trace_dump.py --url http://HOST:PORT --request-id ID
+        Fetch one trace from a live gateway's ``GET /v1/trace/<id>``
+        (the gateway resolves a request_id to its trace_id).
+    python tools/trace_dump.py --input spans.json
+        Convert an already-captured span-event array (the ``events``
+        field of a ``/v1/trace`` response, or a prior --raw dump).
+
+With ``--raw`` the untranslated span dicts are written instead of the
+Chrome form — the lossless capture to convert or diff later. Spans are
+only buffered while ``FLAGS_serving_telemetry`` is on and the ring
+(``FLAGS_serving_trace_events``) drops oldest-first, so an empty export
+from a live system means "flag off or spans aged out", not "no traffic"
+(``telemetry.spans_dropped`` counts the aged-out tail).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def _fetch(url: str, request_id: str) -> list:
+    from urllib.request import urlopen
+
+    full = url.rstrip("/") + "/v1/trace/" + request_id
+    with urlopen(full, timeout=10.0) as resp:
+        body = json.loads(resp.read().decode())
+    return list(body.get("events", []))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("-o", "--output", default="",
+                    help="output path (default: stdout)")
+    ap.add_argument("--raw", action="store_true",
+                    help="write the raw span dicts, not Chrome trace JSON")
+    src = ap.add_mutually_exclusive_group(required=True)
+    src.add_argument("--run", nargs=argparse.REMAINDER,
+                     help="script [args...] to execute in-process with "
+                          "telemetry forced on; its span ring is exported")
+    src.add_argument("--url", default="",
+                     help="gateway base URL to fetch one trace from "
+                          "(requires --request-id)")
+    src.add_argument("--input", default="",
+                     help="JSON file holding a span-event array (or a "
+                          "/v1/trace response object)")
+    ap.add_argument("--request-id", default="",
+                    help="request_id (or trace_id) to fetch with --url")
+    args = ap.parse_args(argv)
+
+    # force the span gate BEFORE the framework import reads the env
+    if args.run:
+        os.environ.setdefault("FLAGS_serving_telemetry", "1")
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from paddle_tpu.serving import telemetry
+
+    if args.run:
+        import runpy
+
+        sys.argv = list(args.run)
+        try:
+            runpy.run_path(args.run[0], run_name="__main__")
+        finally:
+            from paddle_tpu import serving
+
+            serving.drain_all(grace=0.0)
+        events = telemetry.trace_events()
+    elif args.url:
+        if not args.request_id:
+            ap.error("--url requires --request-id")
+        events = _fetch(args.url, args.request_id)
+    else:
+        with open(args.input, "r", encoding="utf-8") as f:
+            body = json.load(f)
+        events = list(body.get("events", []) if isinstance(body, dict)
+                      else body)
+
+    payload = (events if args.raw
+               else {"traceEvents": telemetry.chrome_events(events),
+                     "displayTimeUnit": "ms"})
+    text = json.dumps(payload, indent=None, separators=(",", ":"))
+    if args.output:
+        with open(args.output, "w", encoding="utf-8") as f:
+            f.write(text)
+        print(f"{len(events)} span(s) -> {args.output}", file=sys.stderr)
+    else:
+        print(text)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
