@@ -59,6 +59,20 @@ re-route, and the survivor absorbing the load reuse warm programs).
 Persisted under ``"gateway"`` in ``BENCH_SERVING.json``.
 Env: GATEWAY_DURATION (arrival window seconds, default 6), GATEWAY_SEED.
 
+``--process-replicas`` runs the process-isolated fleet chaos bench
+(ISSUE 18): a 2-worker ``serving.gateway.ProcessReplicaPool`` — real OS
+processes behind the RPC handles — with a mid-run ``kill -9`` of worker
+0 while its decode slots are full. Gates (asserted, not just reported):
+every accepted stream completes, every re-routed stream finishes
+token-for-token identical to ``generate()`` (the journal replay
+contract survives process death), recovery-to-first-token after the
+SIGKILL lands under 2x the respawn backoff (detection + re-route must
+never wait for the respawn), and ZERO serving compiles in the
+survivor's timed window (read per-process via ``pool.worker_stats()``
+— the survivor absorbs the re-routed load on warm programs).
+Persisted under ``"process_replicas"``. Env: PROCPOOL_SEED,
+PROCPOOL_BACKOFF (respawn backoff seconds, default 2).
+
 ``--sampling`` runs the scenario-diversity workload (ISSUE 12): one
 batch mixing greedy, seeded-sampled (temperature/top-k/top-p),
 trie-constrained, and two-LoRA-adapter slots through the ONE compiled
@@ -1868,6 +1882,174 @@ def run_gateway(model, platform):
         f.write("\n")
 
 
+def _procpool_worker_model():
+    """Worker-process model factory: module-level so the spawn payload
+    pickles it BY REFERENCE (the child rebuilds the model inside its own
+    process — weights never cross the RPC socket); seeded so the parent's
+    parity reference and every worker agree bit-for-bit."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import GPTForCausalLM, gpt_tiny
+
+    paddle.seed(0)
+    m = GPTForCausalLM(gpt_tiny())
+    m.eval()
+    return m
+
+
+def run_process_replicas(platform):
+    """Process-isolated fleet chaos bench (ISSUE 18): 2 worker PROCESSES,
+    mid-run kill -9 of worker 0 while its slots are mid-decode. See the
+    module docstring for the gates; they are asserted here (the bench
+    fails loudly instead of persisting a silently-broken record)."""
+    import signal
+
+    from paddle_tpu.core import resilience
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.serving import RequestState
+    from paddle_tpu.serving.gateway.procpool import ProcessReplicaPool
+
+    seed = int(os.environ.get("PROCPOOL_SEED", "0"))
+    respawn_backoff = float(os.environ.get("PROCPOOL_BACKOFF", "2.0"))
+    n_streams = int(os.environ.get("PROCPOOL_STREAMS", "16"))
+    new_tokens, max_len = 48, 64
+    prompt_lens = (8, 10, 12)
+    compile_keys = ("serving.decode_compiles", "serving.prefill_compiles",
+                    "serving.cow_compiles", "serving.restore_compiles")
+
+    res0 = dict(resilience.stats())
+    t_boot = time.perf_counter()
+    pool = ProcessReplicaPool(
+        _procpool_worker_model, replicas=2, background=True,
+        num_slots=4, kv_block_size=8, max_model_len=max_len,
+        respawn_backoff=respawn_backoff,
+        heartbeat_interval=0.1, heartbeat_misses=5)
+    boot_secs = time.perf_counter() - t_boot
+    ref_model = _procpool_worker_model()  # same seed => same weights
+    vocab = ref_model.cfg.vocab_size
+    rng = np.random.default_rng(seed)
+
+    try:
+        # warm BOTH workers across every program the run can touch: the
+        # decode step, the admission bucket (prompts <=12 -> bucket 16)
+        # and every journal-replay bucket a re-routed stream can land in
+        # (prompt+journal up to 59 tokens -> the full 16/24/32/48/64
+        # ladder) — the survivor must absorb the re-routed load with
+        # zero compiles
+        for rep in pool.replicas():
+            warm = [rep.api.submit(
+                rng.integers(0, vocab, (plen,), dtype=np.int32),
+                max_new_tokens=2) for plen in (10, 20, 28, 40, 60)]
+            for req in warm:
+                assert req.done_event.wait(120.0), "warmup stalled"
+
+        ws0 = pool.worker_stats()
+        pids = {idx: snap["pid"] for idx, snap in ws0.items()}
+        assert set(pids) == {0, 1}
+
+        # offered load: more streams than the fleet has slots (they queue
+        # behind the first admission wave) with decodes long enough that
+        # the kill lands mid-stream
+        prompts = [rng.integers(0, vocab, (int(rng.choice(prompt_lens)),),
+                                dtype=np.int32) for _ in range(n_streams)]
+        t0 = time.perf_counter()
+        rrs = [pool.submit(p, max_new_tokens=new_tokens) for p in prompts]
+        time.sleep(0.05)  # let both workers start decoding
+
+        tok_at_kill = {id(rr): len(rr.tokens()) for rr in rrs}
+        t_kill = time.perf_counter()
+        os.kill(pids[0], signal.SIGKILL)
+
+        # recovery-to-first-token: the first NEW token on a re-routed
+        # stream after the kill (journaled tokens never regress, so any
+        # growth past the kill-time count is post-recovery decode)
+        t_recover = None
+        while t_recover is None and time.perf_counter() - t_kill < 60.0:
+            for rr in rrs:
+                if rr.reroutes > 0 and len(rr.tokens()) > tok_at_kill[id(rr)]:
+                    t_recover = time.perf_counter() - t_kill
+                    break
+            if all(rr.finished for rr in rrs):
+                break
+            time.sleep(0.005)
+
+        outs = [pool.result(rr, timeout=180.0) for rr in rrs]
+        wall = time.perf_counter() - t0
+
+        # ---- acceptance gates ---------------------------------------
+        incomplete = [rr for rr in rrs if rr.state != RequestState.FINISHED]
+        assert not incomplete, (
+            f"{len(incomplete)} accepted streams did not complete")
+        rerouted = [rr for rr in rrs if rr.reroutes > 0]
+        assert rerouted, ("the kill never landed mid-decode — no stream "
+                          "re-routed (retune PROCPOOL_* for this host)")
+        assert t_recover is not None, "no re-routed stream ever resumed"
+        assert t_recover < 2 * respawn_backoff, (
+            f"recovery-to-first-token {t_recover:.2f}s >= 2x respawn "
+            f"backoff {respawn_backoff}s: detection/re-route waited for "
+            f"the respawn")
+        parity_checked = 0
+        for p, out in zip(prompts, outs):  # refs AFTER the timed window
+            ref = np.asarray(ref_model.generate(
+                Tensor(np.asarray(p)[None]),
+                max_new_tokens=new_tokens)._data)[0]
+            np.testing.assert_array_equal(out, ref)
+            parity_checked += 1
+
+        # the SURVIVING process (same pid, never restarted) absorbed the
+        # re-routed load on warm programs: zero compiles in its window
+        ws1 = pool.worker_stats()
+        assert 1 in ws1 and ws1[1]["pid"] == pids[1], \
+            "the survivor did not survive"
+        survivor_compiles = sum(
+            ws1[1]["metrics"].get(k, 0) - ws0[1]["metrics"].get(k, 0)
+            for k in compile_keys)
+        assert survivor_compiles == 0, (
+            f"{survivor_compiles} serving compiles in the survivor's "
+            f"timed window")
+
+        # wait out the backoff for the record: the fleet heals itself
+        deadline = time.perf_counter() + max(30.0, 4 * respawn_backoff)
+        while time.perf_counter() < deadline:
+            rows = pool.stats()["replicas"]
+            if len(rows) == 2 and all(r["healthy"] for r in rows):
+                break
+            time.sleep(0.1)
+        st = pool.stats()
+        res1 = dict(resilience.stats())
+    finally:
+        pool.close()
+
+    rec = {
+        "bench": "serving_process_replicas",
+        "metric": f"process-fleet kill -9 recovery to first token "
+                  f"(2 worker processes, {platform})",
+        "value": round(t_recover, 3),
+        "unit": "seconds",
+        "platform": platform,
+        "workers": 2,
+        "boot_secs": round(boot_secs, 2),
+        "wall_secs": round(wall, 3),
+        "respawn_backoff_secs": respawn_backoff,
+        "recovery_budget_secs": 2 * respawn_backoff,
+        "accepted": len(rrs),
+        "accepted_completed": len(rrs) - len(incomplete),
+        "rerouted_streams": len(rerouted),
+        "reroute_parity_checked": parity_checked,
+        "survivor_compiles": int(survivor_compiles),
+        "worker_kills": int(res1.get("worker.kills", 0)
+                            - res0.get("worker.kills", 0)),
+        "worker_spawns": int(res1.get("worker.spawns", 0)
+                             - res0.get("worker.spawns", 0)),
+        "replicas_healthy_end": st["replicas_healthy"],
+    }
+    print(f"# process-replicas: recovery {rec['value']}s "
+          f"(budget {rec['recovery_budget_secs']}s), "
+          f"rerouted={len(rerouted)} (parity ok), "
+          f"survivor_compiles={survivor_compiles}, "
+          f"healthy_end={st['replicas_healthy']}/2", flush=True)
+    _persist("process_replicas", rec)
+
+
 def main():
     import jax
 
@@ -1936,6 +2118,11 @@ def main():
         model = GPTForCausalLM(cfg)
         model.eval()
         run_sampling(model, platform)
+        return
+    if "--process-replicas" in sys.argv:
+        # the model builds INSIDE each worker process from the module-
+        # level factory — the parent never holds a serving engine
+        run_process_replicas(platform)
         return
     if "--gateway" in sys.argv:
         cfg = (GPTConfig(vocab_size=50304, hidden_size=768, num_layers=12,

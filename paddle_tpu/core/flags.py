@@ -350,6 +350,33 @@ define_flag("gateway_fair_share", True,
             "more than its weight-proportional share of that budget is "
             "shed with the retriable QuotaExceededError (retry-after hint) "
             "so a noisy tenant cannot starve compliant ones.")
+define_flag("gateway_process_replicas", False,
+            "Run gateway replicas as supervised OS worker processes "
+            "(serving.gateway.procpool.ProcessReplicaPool) instead of "
+            "in-process threads: each replica is one spawned worker "
+            "owning its own engine, reached over a local length-prefixed "
+            "JSON-RPC socket, so a segfault/OOM/wedged XLA call in one "
+            "replica cannot take down the fleet. Off (default) keeps the "
+            "thread-replica ReplicaPool bit-for-bit; the gateway/tenancy/"
+            "HTTP layers see the same ReplicaPool interface either way.")
+define_flag("gateway_heartbeat_interval", 0.2,
+            "Seconds between worker-process heartbeats (process-replica "
+            "mode). Each worker pushes a heartbeat frame carrying its "
+            "outstanding count, crash-loop breaker state, and new "
+            "telemetry spans; the pool's watchdog reads the age of the "
+            "last one.")
+define_flag("gateway_heartbeat_misses", 3,
+            "Consecutive missed heartbeat intervals before the watchdog "
+            "classifies a worker as hung/dead and ejects it (its "
+            "journaled in-flight streams re-route to survivors, the "
+            "process respawns after the doubling gateway_respawn_backoff).")
+define_flag("gateway_worker_timeout", 10.0,
+            "Per-RPC deadline (seconds) on gateway->worker calls "
+            "(submit/poll/cancel/stats/...). A call that outlives it "
+            "classifies the worker as dead and ejects it. drain() adds "
+            "its grace budget on top; worker SPAWN uses its own fixed "
+            "boot budget since a cold worker imports jax and builds an "
+            "engine first.")
 
 # ---- Resilience: retry / sentinel / fault injection (core.resilience) ----
 define_flag("io_retries", 3,

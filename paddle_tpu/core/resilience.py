@@ -88,6 +88,11 @@ DOCUMENTED_NAMESPACES = (
     # these entries reserve the namespaces so resilience dashboards can
     # mirror span-loss and latency-regression alerts
     "telemetry", "latency",
+    # process-isolated worker fleet (ISSUE 18, serving.gateway.procpool /
+    # docs/robustness.md "Process isolation"): worker.spawns / exits /
+    # kills / hangs / heartbeats / heartbeat_misses / protocol_errors —
+    # the heartbeat watchdog's classification of worker-process deaths
+    "worker",
 )
 
 
@@ -416,9 +421,15 @@ _faults: Dict[str, _FaultSpec] = {}
 _env_faults_loaded = False
 
 #: kinds with production probes; inject_fault accepts other kinds too, for
-#: tests that place maybe_fault probes in their own code
+#: tests that place maybe_fault probes in their own code.
+#: ``worker_kill``/``worker_hang`` are flag-kind faults probed by the
+#: process-replica watchdog (serving.gateway.procpool): kill SIGKILLs a
+#: live worker process, hang makes one stop heartbeating while holding
+#: its socket — the two failure modes the heartbeat supervision must
+#: classify and recover from (docs/robustness.md "Process isolation").
 KNOWN_FAULTS = ("ckpt_io", "nonfinite_grads", "preempt", "serving_step",
-                "serving_device", "arena_corrupt")
+                "serving_device", "arena_corrupt",
+                "worker_kill", "worker_hang")
 
 #: kinds whose probe sites are bare statements (they only react to an
 #: exception), so a flag-style fault would silently exercise nothing —

@@ -32,6 +32,10 @@ _LAZY = {
     "TenantManager": ("tenancy", "TenantManager"),
     "Gateway": ("gateway", "Gateway"),
     "serve": ("gateway", "serve"),
+    "ProcessReplicaPool": ("procpool", "ProcessReplicaPool"),
+    "WorkerHandle": ("procpool", "WorkerHandle"),
+    "WorkerDiedError": ("procpool", "WorkerDiedError"),
+    "WorkerProtocolError": ("procpool", "WorkerProtocolError"),
 }
 
 __all__ = list(_LAZY)

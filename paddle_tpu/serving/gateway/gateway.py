@@ -497,9 +497,18 @@ def serve(model, replicas: Optional[int] = None,
     """One-call deployable front door: build a background
     :class:`ReplicaPool` over ``model``, bind the HTTP listener, install
     the SIGTERM drain guard, start serving. Returns the running
-    :class:`Gateway` (``.port`` reports the bound port)."""
-    pool = ReplicaPool(model, replicas=replicas, tenants=tenants,
-                       background=True, **pool_kw)
+    :class:`Gateway` (``.port`` reports the bound port).
+
+    With ``FLAGS_gateway_process_replicas`` the replicas are supervised
+    OS worker processes (:class:`~.procpool.ProcessReplicaPool` — process
+    fault domains, heartbeat watchdog, kill -9 crash recovery; see
+    docs/robustness.md "Process isolation"). Off (the default) keeps the
+    thread-replica :class:`ReplicaPool` bit-for-bit."""
+    pool_cls = ReplicaPool
+    if flags.flag("gateway_process_replicas"):
+        from .procpool import ProcessReplicaPool as pool_cls
+    pool = pool_cls(model, replicas=replicas, tenants=tenants,
+                    background=True, **pool_kw)
     gw = Gateway(pool, host=host, port=port).start()
     if guard:
         gw.install_preemption_guard()

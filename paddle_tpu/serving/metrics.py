@@ -45,6 +45,13 @@ Counter namespaces:
 * ``tenant.*``     — quota admission: ``admitted`` / ``completed`` /
   ``shed_rate`` / ``shed_concurrency`` / ``shed_share``, plus per-tenant
   ``tenant.<name>.admitted`` / ``.shed`` / ``.tokens_out`` (goodput)
+* ``worker.*``     — the process-isolated replica fleet
+  (``serving.gateway.procpool``, ``FLAGS_gateway_process_replicas``):
+  per-worker gauges ``worker.<idx>.pid`` / ``worker.<idx>.heartbeat_age_ms``
+  / ``worker.<idx>.restarts`` (the watchdog's live fleet picture —
+  ``tools/serving_stats.py --run`` and ``/v1/metrics`` render them); the
+  eject-classification counters (spawns/exits/kills/hangs/heartbeat
+  misses/protocol errors) live in ``core.resilience`` as ``worker.*``
 * ``sampling.*``   — per-slot sampling (``serving.sampling``):
   ``admits`` (non-greedy admissions) / ``spec_fallback_slots`` (lanes
   the speculative decoder routed through the plain step per the compose
@@ -142,6 +149,11 @@ DOCUMENTED_NAMESPACES = (
     # spill, e2e) — serving.telemetry observe() keys, exported as
     # paddle_latency_*_seconds (docs/observability.md)
     "latency",
+    # worker.* (ISSUE 18): per-worker-process gauges of the
+    # process-isolated replica fleet — pid / heartbeat_age_ms / restarts
+    # per worker index (serving.gateway.procpool, docs/robustness.md
+    # "Process isolation")
+    "worker",
     "queue", "slots", "tokens_per_sec",
 )
 

@@ -324,6 +324,20 @@ class TraceLog:
                  "ts": ts, **detail}
                 for seq, tid, kind, ts, detail in list(self._buf)]
 
+    def ingest(self, trace_id: str, kind: str, ts: float,
+               detail: dict) -> None:
+        """Append one event RECORDED ELSEWHERE (a worker process's ring,
+        shipped over the RPC socket): the original wall-clock ``ts`` is
+        preserved — the worker shares this host's clock — while the
+        ordering ``seq`` is re-stamped locally, so ingested spans
+        interleave with gateway-minted ones (SUBMITTED/REROUTED) in
+        arrival order and ``trace()`` reads one contiguous timeline."""
+        buf = self._buf
+        if len(buf) == buf.maxlen:
+            metrics.bump("telemetry.spans_dropped")
+        buf.append((next(self._seq), trace_id, kind, ts, detail))
+        metrics.bump("telemetry.spans")
+
     def clear(self) -> None:
         self._buf.clear()
 
@@ -362,6 +376,37 @@ def trace_events() -> List[dict]:
     """Every buffered span event (ordered by seq)."""
     log = _tracelog
     return log.events() if log is not None else []
+
+
+def events_since(after_seq: int) -> List[list]:
+    """Raw span tuples ``[seq, trace_id, kind, ts, detail]`` with
+    ``seq > after_seq`` — the wire format a worker process ships in its
+    heartbeat/poll responses (JSON-safe as long as span details are; the
+    span() call sites only record scalars and short strings). The caller
+    tracks the max seq it has seen to ship each span exactly once."""
+    log = _tracelog
+    if log is None:
+        return []
+    return [[seq, tid, kind, ts, detail]
+            for seq, tid, kind, ts, detail in list(log._buf)
+            if seq > after_seq]
+
+
+def ingest(events) -> None:
+    """Fold span tuples from :func:`events_since` (another process's
+    ring) into this process's TraceLog — the gateway side of the
+    worker span carriage. Gated by ``FLAGS_serving_telemetry`` like
+    :func:`span`; malformed entries are dropped silently (the transport
+    already classifies framing errors)."""
+    if not events or not enabled():
+        return
+    log = _log()
+    for ev in events:
+        try:
+            _, tid, kind, ts, detail = ev
+            log.ingest(str(tid), str(kind), float(ts), dict(detail))
+        except (TypeError, ValueError):
+            continue
 
 
 def reset_tracelog() -> None:
@@ -482,6 +527,15 @@ def prometheus_text(pool=None) -> str:
                     lines.append(
                         f'paddle_gateway_replica_{key}{{replica="{idx}"}} '
                         f'{val}')
+            # process-replica mode (ISSUE 18): ProcessReplicaPool rows
+            # carry the per-worker fleet picture — absent in thread mode
+            for key in ("pid", "heartbeat_age_ms", "restarts"):
+                if key in row:
+                    val = _prom_value(row.get(key))
+                    if val is not None:
+                        lines.append(
+                            f'paddle_gateway_worker_{key}'
+                            f'{{replica="{idx}"}} {val}')
         for tenant, row in sorted(snap.get("tenants", {}).items()):
             for key in ("admitted", "shed", "completed", "failed",
                         "inflight", "tokens_out", "tokens_per_sec"):

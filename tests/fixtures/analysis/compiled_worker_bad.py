@@ -1,0 +1,21 @@
+"""known-bad: poll-RPC serialization from INSIDE a compiled decode step
+-> traced-cast (x2).
+
+The process-worker wire protocol ships token tails as JSON ints.
+Casting the traced new-token inside the jit'd step forces a device sync
+per token — and under trace the int lands in the frame buffer as a
+trace-time constant, so every subsequent poll replays the same token.
+Materialization belongs AROUND the dispatch: return the traced arrays,
+host-cast in the poll handler."""
+import jax
+import jax.numpy as jnp
+
+
+def decode_step(logits, slot, frame):
+    tok = jnp.argmax(logits[slot])
+    frame.append(int(tok))  # BAD: traced cast to serialize for the RPC
+    logprob = float(logits[slot, tok])  # BAD: traced logprob host-cast
+    return tok, logprob
+
+
+decode_step_jit = jax.jit(decode_step)

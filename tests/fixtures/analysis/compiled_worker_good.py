@@ -1,0 +1,22 @@
+"""known-good twin: the compiled step returns traced arrays only; the
+poll handler materializes the token tail outside the dispatch (one host
+sync per poll, not per token) and builds the JSON frame from host
+ints."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def decode_step(logits, slot):
+    tok = jnp.argmax(logits[slot])
+    return tok, logits[slot, tok]
+
+
+decode_step_jit = jax.jit(decode_step)
+
+
+def poll(logits, slot):
+    tok, logprob = decode_step_jit(logits, slot)
+    # host casts happen outside the compiled region: legal, one sync
+    return {"tokens": [int(np.asarray(tok))],
+            "logprob": float(np.asarray(logprob))}

@@ -1,0 +1,28 @@
+"""known-bad: the pending-RPC table is registered under the handle lock
+in call() but popped outside any lock scope in the reader loop ->
+unguarded-mutation.
+
+The race: the reader pops while call() is registering the next id — a
+dict resize mid-pop strands the caller's event forever (a hung handle,
+exactly what the framing-fuzz tests guard against)."""
+import threading
+
+
+class Handle:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.pending = {}
+        self.seq = 0
+
+    def call(self, op):
+        with self._lock:
+            self.seq += 1
+            rid = self.seq
+            self.pending[rid] = [threading.Event(), None]
+        return rid
+
+    def reader_loop(self, frames):
+        for msg in frames:
+            slot = self.pending.pop(msg["id"])  # BAD: racy pop, no lock
+            slot[1] = msg
+            slot[0].set()

@@ -1,0 +1,26 @@
+"""known-good twin: every post-construction mutation of the pending-RPC
+table happens under the handle lock; the reader pops under the lock and
+fires the caller's event outside it (waking a waiter is not a guarded
+mutation)."""
+import threading
+
+
+class Handle:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.pending = {}
+        self.seq = 0
+
+    def call(self, op):
+        with self._lock:
+            self.seq += 1
+            rid = self.seq
+            self.pending[rid] = [threading.Event(), None]
+        return rid
+
+    def reader_loop(self, frames):
+        for msg in frames:
+            with self._lock:
+                slot = self.pending.pop(msg["id"])
+            slot[1] = msg
+            slot[0].set()

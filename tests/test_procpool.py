@@ -1,0 +1,479 @@
+"""Process-isolated replica fleet (ISSUE 18): RPC framing + error
+taxonomy round-trip, framing fuzz → classified ``WorkerProtocolError``
+ejects (never a hung handle), heartbeat supervision, ``worker_kill`` /
+``worker_hang`` chaos recovery with token parity + contiguous span
+timelines + zero leaked tenant slots, and orphan reaping on close.
+
+The worker model is a MODULE-LEVEL factory: spawn ships it by reference
+(module + qualname), so each worker process rebuilds its own instance —
+``paddle.seed(0)`` inside the factory keeps every process's weights (and
+therefore greedy decodes) identical, which is what makes cross-process
+re-route parity a meaningful assertion.
+"""
+import os
+import socket
+import struct
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core import flags as core_flags
+from paddle_tpu.core import resilience
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.models.gpt import GPTForCausalLM, gpt_tiny
+from paddle_tpu.serving import telemetry
+from paddle_tpu.serving import metrics as serving_metrics
+from paddle_tpu.serving.gateway import (
+    ProcessReplicaPool,
+    WorkerDiedError,
+    WorkerHandle,
+    WorkerProtocolError,
+)
+from paddle_tpu.serving.gateway import worker as worker_mod
+from paddle_tpu.serving.scheduler import RequestState
+
+pytestmark = [pytest.mark.serving, pytest.mark.gateway]
+
+MAX_LEN = 64
+POOL_KW = dict(num_slots=4, kv_block_size=8, max_model_len=MAX_LEN)
+
+
+def worker_model():
+    paddle.seed(0)
+    m = GPTForCausalLM(gpt_tiny())
+    m.eval()
+    return m
+
+
+@pytest.fixture(scope="module")
+def model():
+    return worker_model()
+
+
+@pytest.fixture
+def flag_guard():
+    snap = core_flags.all_flags()
+    yield
+    core_flags.set_flags(snap)
+    resilience.clear_faults()
+
+
+def _mk_pool(**kw):
+    base = dict(replicas=2, background=True, respawn_backoff=0.5,
+                heartbeat_interval=0.2, heartbeat_misses=5,
+                worker_timeout=10.0, **POOL_KW)
+    base.update(kw)
+    return ProcessReplicaPool(worker_model, **base)
+
+
+def _prompt(rng, n=8):
+    return rng.integers(0, 1024, (n,), dtype=np.int32)
+
+
+def _ref(model, prompt, max_new, stop=None):
+    out = model.generate(Tensor(np.asarray(prompt)[None]),
+                         max_new_tokens=max_new, stop_token_id=stop)
+    return np.asarray(out._data)[0]
+
+
+# ------------------------------------------------------------- framing unit
+
+
+def test_frame_roundtrip():
+    a, b = socket.socketpair()
+    try:
+        msg = {"op": "poll", "id": 7, "reqs": {"0.1": 3},
+               "text": "héllo"}
+        worker_mod.send_frame(a, msg)
+        assert worker_mod.recv_frame(b) == msg
+        # clean EOF at a frame boundary is None, not an error
+        a.close()
+        assert worker_mod.recv_frame(b) is None
+    finally:
+        b.close()
+
+
+def test_send_frame_rejects_oversized():
+    a, b = socket.socketpair()
+    try:
+        with pytest.raises(worker_mod.FrameError):
+            worker_mod.send_frame(
+                a, {"blob": "x" * (worker_mod._MAX_FRAME + 1)})
+    finally:
+        a.close()
+        b.close()
+
+
+def test_error_taxonomy_roundtrip():
+    for exc in (resilience.QueueOverloadError("full"),
+                resilience.RequestDrainedError("drained"),
+                resilience.DeadlineExceededError("late"),
+                resilience.ServingDeviceError("chip pulled"),
+                resilience.ArenaCorruptError("bad arena"),
+                ValueError("bad journal")):
+        back = worker_mod.decode_error(worker_mod.encode_error(exc))
+        assert type(back) is type(exc)
+        assert str(exc) in str(back)
+    # unknown types decode as RuntimeError: NOT re-routable, so a novel
+    # worker failure fails the stream loudly instead of bouncing forever
+    weird = worker_mod.decode_error({"type": "SegfaultGremlin",
+                                     "message": "boom"})
+    assert type(weird) is RuntimeError
+    assert "boom" in str(weird)
+
+
+# ------------------------------------------------------------ framing fuzz
+
+
+def _fuzz_handle():
+    """A WorkerHandle over a socketpair with no real worker behind it —
+    the reader thread and RPC plumbing are real, the peer is the fuzzer."""
+    ours, theirs = socket.socketpair()
+    handle = WorkerHandle(idx=0, conn=ours, proc=None, pid=0,
+                          num_slots=4, vocab=1024,
+                          call_timeout=5.0, hb_interval=0.2)
+    return handle, theirs
+
+
+@pytest.mark.parametrize("junk", [
+    struct.pack(">I", 100) + b"abc",            # truncated mid-frame
+    struct.pack(">I", worker_mod._MAX_FRAME + 1),   # oversized prefix
+    struct.pack(">I", 0),                       # zero-length frame
+    struct.pack(">I", 5) + b"\xff\xfe\xfd\xfc\xfb",  # not JSON
+    struct.pack(">I", 4) + b"[1]\n",            # JSON but not an object
+], ids=["truncated", "oversized", "zero", "garbage", "non-object"])
+def test_framing_fuzz_classifies_protocol_error(junk):
+    before = resilience._counts.get("worker.protocol_errors", 0)
+    handle, peer = _fuzz_handle()
+    try:
+        peer.sendall(junk)
+        peer.close()
+        deadline = time.monotonic() + 5.0
+        while handle._dead is None and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert isinstance(handle._dead, WorkerProtocolError), handle._dead
+        assert resilience._counts.get("worker.protocol_errors", 0) > before
+        # the reader thread exits — a corrupt stream never leaves a
+        # spinning/hung pump behind
+        handle._thread.join(2.0)
+        assert not handle._thread.is_alive()
+        # and the dead handle refuses instantly instead of hanging
+        with pytest.raises(WorkerProtocolError):
+            handle._call("stats", {})
+    finally:
+        handle.mark_dead(WorkerDiedError("test cleanup"))
+
+
+def test_fuzz_fails_pending_call_and_requests_fast():
+    handle, peer = _fuzz_handle()
+    try:
+        # a live request that must NOT leak when the stream corrupts
+        req = None
+        with handle._lock:
+            from paddle_tpu.serving.gateway.procpool import RemoteRequest
+            req = RemoteRequest(handle, "0.1", "r1", "t1", None)
+            handle._reqs["0.1"] = req
+        results = []
+
+        def call():
+            try:
+                handle._call("stats", {}, timeout=30.0)
+                results.append("returned")
+            except BaseException as e:
+                results.append(e)
+
+        t = threading.Thread(target=call, daemon=True)
+        t.start()
+        worker_mod.recv_frame(peer)  # drain the call (no RST on close)
+        peer.sendall(struct.pack(">I", 64) + b"short")
+        peer.shutdown(socket.SHUT_WR)  # FIN: EOF mid-frame, not reset
+        t.join(5.0)  # must fail FAR before the 30s call budget
+        assert not t.is_alive()
+        assert len(results) == 1
+        assert isinstance(results[0], WorkerProtocolError)
+        # the registered request was failed re-routably, not stranded
+        assert req.finished
+        assert req.state == RequestState.FAILED
+        assert isinstance(req.error, WorkerProtocolError)
+        assert handle.outstanding() == 0
+    finally:
+        handle.mark_dead(WorkerDiedError("test cleanup"))
+
+
+def test_rpc_deadline_classifies_silent_worker():
+    handle, peer = _fuzz_handle()
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(WorkerDiedError):
+            handle._call("stats", {}, timeout=0.3)  # peer never answers
+        assert time.monotonic() - t0 < 3.0
+        assert isinstance(handle._dead, WorkerDiedError)
+    finally:
+        peer.close()
+        handle.mark_dead(WorkerDiedError("test cleanup"))
+
+
+def test_busy_poll_tolerated_while_heartbeating():
+    """A poll that blows its deadline on a live, fresh-heartbeating
+    worker is BUSY, not hung: tolerated and retried, no eject — until
+    hb_misses consecutive busy cycles prove the main loop is wedged."""
+    import types
+
+    from paddle_tpu.serving.gateway.procpool import RemoteRequest
+
+    ours, theirs = socket.socketpair()
+    handle = WorkerHandle(idx=0, conn=ours,
+                          proc=types.SimpleNamespace(
+                              is_alive=lambda: True, pid=12345,
+                              exitcode=None, join=lambda t=None: None,
+                              kill=lambda: None),
+                          pid=12345, num_slots=4, vocab=1024,
+                          call_timeout=5.0, hb_interval=0.05, hb_misses=3)
+    req = RemoteRequest(handle, "0.1", "r1", "", None)
+    with handle._lock:
+        handle._reqs["0.1"] = req
+    busy0 = resilience._counts.get("worker.busy_polls", 0)
+    hangs0 = resilience._counts.get("worker.hangs", 0)
+    wl = threading.Lock()  # feeder + responder share the peer socket
+    stop = threading.Event()
+
+    def feed_heartbeats():
+        # a busy worker's heartbeat THREAD keeps running while the main
+        # loop is stuck — that's the condition under test
+        while not stop.is_set():
+            try:
+                worker_mod.send_frame(theirs, {
+                    "hb": True, "ts": time.time(), "outstanding": 1,
+                    "breaker_open": False, "spans": []}, wl)
+            except (worker_mod.FrameError, OSError):
+                return
+            stop.wait(0.03)
+
+    feeder = threading.Thread(target=feed_heartbeats, daemon=True)
+    feeder.start()
+    try:
+        # two busy cycles: deadline blown, heartbeats fresh -> no eject
+        for expect in (1, 2):
+            handle.poll()  # peer never answers: returns, doesn't raise
+            assert handle._dead is None
+            assert handle._busy_polls == expect
+        assert resilience._counts.get("worker.busy_polls", 0) == busy0 + 2
+
+        # one answered poll resets the consecutive count
+        def respond():
+            theirs.settimeout(3.0)
+            while True:
+                try:
+                    msg = worker_mod.recv_frame(theirs)
+                except (worker_mod.FrameError, OSError):
+                    return
+                if msg is None:
+                    return
+                worker_mod.send_frame(theirs, {
+                    "id": msg["id"], "ok": True, "reqs": {},
+                    "spans": [], "breaker_open": False,
+                    "outstanding": 1}, wl)
+
+        responder = threading.Thread(target=respond, daemon=True)
+        responder.start()
+        handle.poll()
+        assert handle._busy_polls == 0
+        responder.join(5.0)
+
+        # wedged for real: hb_misses consecutive busy cycles (heartbeats
+        # STILL fresh the whole time) -> eject
+        for _ in range(2):
+            handle.poll()
+        with pytest.raises(WorkerDiedError, match="wedged"):
+            handle.poll()
+        assert isinstance(handle._dead, WorkerDiedError)
+        assert resilience._counts.get("worker.hangs", 0) == hangs0 + 1
+        # the stranded request was failed, not leaked
+        assert req.state == RequestState.FAILED
+    finally:
+        stop.set()
+        feeder.join(2.0)
+        theirs.close()
+        handle.mark_dead(WorkerDiedError("test cleanup"))
+
+
+def test_heartbeat_frame_updates_liveness():
+    handle, peer = _fuzz_handle()
+    try:
+        handle._last_hb = time.monotonic() - 60.0
+        assert handle.heartbeat_age() > 59.0
+        worker_mod.send_frame(peer, {"hb": True, "ts": time.time(),
+                                     "outstanding": 0,
+                                     "breaker_open": True, "spans": []})
+        deadline = time.monotonic() + 2.0
+        while handle.heartbeat_age() > 1.0 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert handle.heartbeat_age() < 1.0
+        assert handle.supervisor.breaker_open is True
+    finally:
+        peer.close()
+        handle.mark_dead(WorkerDiedError("test cleanup"))
+
+
+# --------------------------------------------------------- live worker pool
+
+
+def test_process_pool_token_parity_and_reaping(model):
+    rng = np.random.default_rng(0)
+    pool = _mk_pool()
+    try:
+        prompts = [_prompt(rng) for _ in range(4)]
+        rrs = [pool.submit(p, max_new_tokens=16) for p in prompts]
+        outs = [pool.result(rr, timeout=120.0) for rr in rrs]
+        for p, o in zip(prompts, outs):
+            assert np.array_equal(o, _ref(model, p, 16))
+
+        st = pool.stats()
+        assert st["process_replicas"] is True
+        assert len(st["replicas"]) == 2
+        for row in st["replicas"]:
+            assert row["pid"] > 0
+            assert row["restarts"] == 0
+            assert row["heartbeat_age_ms"] >= 0.0
+
+        # per-worker remote scrapes carry the worker PROCESS's counters
+        ws = pool.worker_stats()
+        assert set(ws) == {0, 1}
+        for idx, snap in ws.items():
+            assert snap["pid"] == st["replicas"][idx]["pid"]
+            assert any(k.startswith("engine.")
+                       for k in snap["metrics"]), snap["metrics"].keys()
+    finally:
+        procs = [r.api.proc for r in pool.replicas()]
+        pool.close()
+    # satellite 2: close() REAPS — no orphan worker survives to hold the
+    # compile-cache dir lock
+    for proc in procs:
+        assert not proc.is_alive()
+
+
+def test_worker_kill_chaos_recovery(model, flag_guard):
+    core_flags.set_flags({"fault_injection": True,
+                          "serving_telemetry": True})
+    kills0 = resilience._counts.get("worker.kills", 0)
+    ejected0 = serving_metrics.stats().get("gateway.ejected", 0)
+    rng = np.random.default_rng(1)
+    pool = _mk_pool()
+    try:
+        # warm both workers: compiles land before the chaos window, so the
+        # zero-recompile invariant holds across the re-route
+        warm = [pool.submit(_prompt(rng), max_new_tokens=4)
+                for _ in range(2)]
+        for rr in warm:
+            pool.result(rr, timeout=120.0)
+
+        prompts = [_prompt(rng) for _ in range(6)]
+        rrs = [pool.submit(p, max_new_tokens=40) for p in prompts]
+        # chaos: the watchdog's next sweep SIGKILLs a live worker
+        resilience.inject_fault("worker_kill", times=1)
+
+        outs = [pool.result(rr, timeout=180.0) for rr in rrs]
+
+        # token parity: journaled streams resumed token-for-token on the
+        # survivor — byte-identical to the single-model reference
+        for p, o in zip(prompts, outs):
+            assert np.array_equal(o, _ref(model, p, 40))
+
+        assert resilience._counts.get("fault.worker_kill", 0) >= 1
+        assert resilience._counts.get("worker.kills", 0) > kills0
+        assert serving_metrics.stats().get("gateway.ejected", 0) > ejected0
+
+        # one contiguous span timeline per trace_id: SUBMITTED first,
+        # FINISHED last, and the killed worker's streams show REROUTED
+        # with survivor spans after it
+        rerouted = 0
+        for rr in rrs:
+            kinds = [ev["event"] for ev in telemetry.trace(rr.trace_id)]
+            assert kinds[0] == telemetry.SUBMITTED
+            assert kinds.count(telemetry.SUBMITTED) == 1
+            assert kinds[-1] == telemetry.FINISHED
+            if telemetry.REROUTED in kinds:
+                rerouted += 1
+                assert kinds.index(telemetry.REROUTED) < len(kinds) - 1
+        assert rerouted >= 1
+
+        # zero leaked tenant concurrency slots after recovery
+        assert pool.stats()["tenants"]["default"]["inflight"] == 0
+
+        # the dead worker respawns (doubled backoff ran its course)
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            rows = pool.stats()["replicas"]
+            if (all(r["healthy"] for r in rows)
+                    and any(r["restarts"] >= 1 for r in rows)):
+                break
+            time.sleep(0.2)
+        rows = pool.stats()["replicas"]
+        assert all(r["healthy"] for r in rows), rows
+        assert any(r["restarts"] >= 1 for r in rows), rows
+    finally:
+        pool.close()
+
+
+def test_worker_hang_chaos_recovery(model, flag_guard):
+    core_flags.set_flags({"fault_injection": True})
+    hangs0 = resilience._counts.get("worker.hangs", 0)
+    rng = np.random.default_rng(2)
+    # tight heartbeat budget: 0.1s x 8 misses -> ~0.8s to classify
+    pool = _mk_pool(heartbeat_interval=0.1, heartbeat_misses=8,
+                    worker_timeout=3.0)
+    try:
+        warm = [pool.submit(_prompt(rng), max_new_tokens=4)
+                for _ in range(2)]
+        for rr in warm:
+            pool.result(rr, timeout=120.0)
+
+        prompts = [_prompt(rng) for _ in range(4)]
+        rrs = [pool.submit(p, max_new_tokens=32) for p in prompts]
+        # chaos: a worker stops heartbeating but HOLDS its socket — only
+        # heartbeat age (not ECONNRESET) can classify this
+        resilience.inject_fault("worker_hang", times=1)
+
+        outs = [pool.result(rr, timeout=180.0) for rr in rrs]
+        for p, o in zip(prompts, outs):
+            assert np.array_equal(o, _ref(model, p, 32))
+
+        assert resilience._counts.get("fault.worker_hang", 0) >= 1
+        assert resilience._counts.get("worker.hangs", 0) > hangs0
+        assert pool.stats()["tenants"]["default"]["inflight"] == 0
+    finally:
+        pool.close()
+
+
+def test_serve_flag_switches_to_process_pool(flag_guard):
+    core_flags.set_flags({"gateway_process_replicas": True})
+    from paddle_tpu.serving.gateway import serve
+
+    gw = serve(worker_model, replicas=1, guard=False, **POOL_KW)
+    try:
+        assert isinstance(gw.pool, ProcessReplicaPool)
+        base = f"http://127.0.0.1:{gw.port}"
+        stats = urllib.request.urlopen(base + "/v1/stats",
+                                       timeout=10).read().decode()
+        assert '"process_replicas": true' in stats
+        metrics_text = urllib.request.urlopen(base + "/v1/metrics",
+                                              timeout=10).read().decode()
+        assert "paddle_gateway_worker_pid" in metrics_text
+        assert "paddle_gateway_worker_heartbeat_age_ms" in metrics_text
+        procs = [r.api.proc for r in gw.pool.replicas()]
+    finally:
+        gw.close()
+    # Gateway.close() -> pool.close() -> reap: no orphans
+    for proc in procs:
+        assert not proc.is_alive()
+
+
+def test_default_flag_keeps_thread_pool():
+    assert core_flags.flag("gateway_process_replicas") is False
+    # the worker fault kinds are registered probes
+    assert "worker_kill" in resilience.KNOWN_FAULTS
+    assert "worker_hang" in resilience.KNOWN_FAULTS

@@ -112,6 +112,13 @@ FIXTURE_CASES = [
     # belong AROUND the dispatch; docs/observability.md overhead policy)
     ("traced-cast", "compiled_telemetry",
      ("paddle_tpu/serving/telemetry.py",)),
+    # the ISSUE 18 process-worker shapes: (a) poll-RPC serialization from
+    # inside the compiled decode step — the token tail int()-cast under
+    # trace instead of materialized around the dispatch; (b) the
+    # WorkerHandle pending-RPC table registered under the handle lock but
+    # popped lock-free in the reader loop (a strand-the-caller race)
+    ("traced-cast", "compiled_worker", ()),
+    ("unguarded-mutation", "concurrency_worker", ()),
     ("broad-except", "hygiene_broad_except", ()),
 ]
 

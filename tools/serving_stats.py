@@ -77,6 +77,18 @@ The multi-tenant gateway's counters ride it too (``serving.gateway``):
 ``tenant.admitted`` / ``tenant.shed_rate`` / ``tenant.shed_concurrency`` /
 ``tenant.shed_share`` and the per-tenant ``tenant.<name>.tokens_out``
 goodput counters.
+The process-isolated replica fleet (``FLAGS_gateway_process_replicas``,
+``serving.gateway.procpool``) adds the ``worker.*`` namespace:
+``worker.spawns`` / ``worker.exits`` / ``worker.kills`` (processes that
+died to a signal — a kill -9'd worker shows up here, not as a hang) /
+``worker.hangs`` (missed-heartbeat or RPC-deadline ejections) /
+``worker.heartbeats`` / ``worker.heartbeat_misses`` /
+``worker.protocol_errors`` (malformed RPC frames — classified eject,
+never a hung handle), plus the per-worker end-of-run gauges
+``worker.<i>.pid`` / ``worker.<i>.heartbeat_age_ms`` /
+``worker.<i>.restarts`` — a healthy fleet shows every heartbeat age far
+under ``FLAGS_gateway_heartbeat_interval * FLAGS_gateway_heartbeat_misses``
+and restart counts flat after warmup.
 The observability plane (ISSUE 17, docs/observability.md) adds the
 ``latency.*`` histograms (ttft, inter_token, queue_wait, prefill,
 decode_step, restore, e2e, ... — recorded host-side around compiled
@@ -161,6 +173,13 @@ def _config_report() -> dict:
         "gateway_tenant_concurrency": _flag_env("gateway_tenant_concurrency",
                                                 0),
         "gateway_fair_share": _flag_env("gateway_fair_share", 1),
+        # process-isolated replica fleet (serving.gateway.procpool;
+        # 0 = in-process thread replicas, bit-for-bit the same routing)
+        "gateway_process_replicas": _flag_env("gateway_process_replicas", 0),
+        "gateway_heartbeat_interval": _flag_env("gateway_heartbeat_interval",
+                                                0.2),
+        "gateway_heartbeat_misses": _flag_env("gateway_heartbeat_misses", 3),
+        "gateway_worker_timeout": _flag_env("gateway_worker_timeout", 10.0),
     }
 
 
@@ -212,7 +231,7 @@ def main(argv=None) -> int:
                                          "gateway", "tenant", "sampling",
                                          "constrain", "lora", "kernel",
                                          "mesh", "tier", "telemetry",
-                                         "serving")}
+                                         "serving", "worker")}
         # latency histograms recorded during the run (ISSUE 17): the same
         # per-run delta discipline as the counters, rendered as percentiles
         hists = telemetry.histograms_delta(hists_before)
