@@ -73,6 +73,25 @@ survivor's timed window (read per-process via ``pool.worker_stats()``
 Persisted under ``"process_replicas"``. Env: PROCPOOL_SEED,
 PROCPOOL_BACKOFF (respawn backoff seconds, default 2).
 
+``--disagg`` runs the disaggregated prefill/decode bench (ISSUE 19,
+``serving.disagg`` / docs/serving.md "Disaggregated prefill/decode"):
+the same mixed load — a few short-prompt long-decode streams plus a
+burst of long-prompt prefill pressure — over a 1-prefill + 2-decode
+``DisaggReplicaPool`` and a 3-unified ``ProcessReplicaPool``. The
+metric is the p99 inter-token stall on the RUNNING decode streams while
+the pressure burst prefills: unified workers interleave the long
+prefills with their decode slots, disagg decode workers only ever pay
+the handoff restore. Gates (asserted): unified p99 stall >= 2x the
+disagg p99 stall (``DISAGG_STALL_FACTOR``), token-for-token greedy
+parity for EVERY stream in both fleets (the handoff is invisible in
+tokens), and ZERO serving compiles in every worker's timed window in
+both fleets (per-process via ``pool.worker_stats()`` — handoffs and
+prefetches mint no programs). Persisted under ``"disagg"``.
+Env: DISAGG_SEED, DISAGG_STREAMS (decode streams, default 3),
+DISAGG_PRESSURE (burst size, default 8), DISAGG_LONG (pressure prompt
+tokens, default 176), DISAGG_NEW (decode-stream tokens, default 96),
+DISAGG_STALL_FACTOR (default 2).
+
 ``--sampling`` runs the scenario-diversity workload (ISSUE 12): one
 batch mixing greedy, seeded-sampled (temperature/top-k/top-p),
 trie-constrained, and two-LoRA-adapter slots through the ONE compiled
@@ -1896,6 +1915,23 @@ def _procpool_worker_model():
     return m
 
 
+def _disagg_worker_model():
+    """Disagg-bench worker factory: mid-size on purpose (the same
+    reasoning as the --tiered bench) — gpt_tiny's prefill is cheaper
+    than a dispatch, so a long-prompt admission barely stalls a unified
+    worker's decode streams and the bench would measure handoff OVERHEAD
+    instead of the prefill-isolation win disaggregation exists for."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+    paddle.seed(0)
+    m = GPTForCausalLM(GPTConfig(vocab_size=2048, hidden_size=256,
+                                 num_layers=4, num_heads=8,
+                                 max_position_embeddings=512))
+    m.eval()
+    return m
+
+
 def run_process_replicas(platform):
     """Process-isolated fleet chaos bench (ISSUE 18): 2 worker PROCESSES,
     mid-run kill -9 of worker 0 while its slots are mid-decode. See the
@@ -2050,6 +2086,197 @@ def run_process_replicas(platform):
     _persist("process_replicas", rec)
 
 
+def _disagg_fleet_run(pool_cls, pool_kw, ref_model, vocab, rng_seed,
+                      n_streams, n_pressure, long_len, new_tokens,
+                      compile_keys):
+    """One fleet's timed window: start the decode streams, wait until
+    every one is past its handoff (>= 2 tokens), then inject the
+    prefill-pressure burst and sample each decode stream's inter-token
+    gaps at ~1 kHz until the burst retires. Returns (p99_stall_ms,
+    compile_delta, parity_failures, gaps_sampled)."""
+    import threading
+
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.serving import RequestState
+
+    rng = np.random.default_rng(rng_seed)
+    pool = pool_cls(_disagg_worker_model, **pool_kw)
+    try:
+        # warm every worker across every program the window can touch:
+        # short/long prefill buckets, decode, and (via pool-routed
+        # submits) the handoff restore + suffix-prefill path on the
+        # decode side — the timed window must be compile-free
+        for rep in pool.replicas():
+            warm = [rep.api.submit(
+                rng.integers(0, vocab, (plen,), dtype=np.int32),
+                max_new_tokens=2) for plen in (8, 12, long_len,
+                                               long_len + 8)]
+            for req in warm:
+                if not req.done_event.wait(240.0):
+                    ws = pool.worker_stats()
+                    raise AssertionError(
+                        f"warmup stalled on worker {rep.idx}: "
+                        f"state={req.state} stats="
+                        + repr({i: {k: v for k, v in row.items()
+                                    if k != 'metrics'}
+                                for i, row in ws.items()}))
+        warm_rrs = [pool.submit(rng.integers(0, vocab, (plen,),
+                                             dtype=np.int32),
+                                max_new_tokens=4)
+                    for plen in (8, 12, long_len, long_len + 8) * 2]
+        for rr in warm_rrs:
+            pool.result(rr, timeout=240.0)
+
+        ws0 = pool.worker_stats()
+        streams = [rng.integers(0, vocab, (int(rng.choice((8, 10, 12))),),
+                                dtype=np.int32) for _ in range(n_streams)]
+        pressure = [rng.integers(0, vocab, (long_len,), dtype=np.int32)
+                    for _ in range(n_pressure)]
+
+        rrs = [pool.submit(p, max_new_tokens=new_tokens) for p in streams]
+        deadline = time.perf_counter() + 120.0
+        while (any(len(rr.tokens()) < 2 for rr in rrs)
+               and time.perf_counter() < deadline):
+            time.sleep(0.002)  # decode phase reached on every stream
+
+        gaps: list = []
+        stop_ev = threading.Event()
+
+        def watch(rr, out):
+            last_n = len(rr.tokens())
+            last_t = time.perf_counter()
+            while not stop_ev.is_set() and not rr.finished:
+                n = len(rr.tokens())
+                now = time.perf_counter()
+                if n > last_n:
+                    out.append((now - last_t) / (n - last_n))
+                    last_n, last_t = n, now
+                time.sleep(0.001)
+
+        watchers = [threading.Thread(target=watch, args=(rr, gaps),
+                                     daemon=True) for rr in rrs]
+        for w in watchers:
+            w.start()
+        prrs = [pool.submit(p, max_new_tokens=2) for p in pressure]
+        for rr in prrs:
+            pool.result(rr, timeout=240.0)
+        stop_ev.set()
+        for w in watchers:
+            w.join(timeout=10.0)
+        outs = [pool.result(rr, timeout=240.0) for rr in rrs]
+        pouts = [pool.result(rr, timeout=240.0) for rr in prrs]
+        assert all(rr.state == RequestState.FINISHED for rr in rrs + prrs)
+
+        parity_failures = 0
+        for p, out, max_new in (
+                [(p, o, new_tokens) for p, o in zip(streams, outs)]
+                + [(p, o, 2) for p, o in zip(pressure, pouts)]):
+            ref = np.asarray(ref_model.generate(
+                Tensor(np.asarray(p)[None]),
+                max_new_tokens=max_new)._data)[0]
+            if not np.array_equal(out, ref):
+                parity_failures += 1
+
+        ws1 = pool.worker_stats()
+        compile_delta = sum(
+            ws1[i]["metrics"].get(k, 0) - ws0[i]["metrics"].get(k, 0)
+            for i in ws0 if i in ws1 for k in compile_keys)
+        st = pool.stats()
+        handoffs = st.get("disagg", {})
+    finally:
+        pool.close()
+    if not gaps:
+        raise AssertionError("no inter-token gaps sampled during the "
+                             "pressure window — burst finished before "
+                             "any decode step (retune DISAGG_* sizes)")
+    return (_percentile(gaps, 99) * 1e3, int(compile_delta),
+            parity_failures, len(gaps), handoffs)
+
+
+def run_disagg(platform):
+    """ISSUE 19: disaggregated vs unified under prefill pressure — see
+    the module docstring for the workload and gates (asserted here)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.serving.disagg import DisaggReplicaPool
+    from paddle_tpu.serving.gateway.procpool import ProcessReplicaPool
+
+    seed = int(os.environ.get("DISAGG_SEED", "0"))
+    n_streams = int(os.environ.get("DISAGG_STREAMS", "3"))
+    n_pressure = int(os.environ.get("DISAGG_PRESSURE", "8"))
+    long_len = int(os.environ.get("DISAGG_LONG", "448"))
+    new_tokens = int(os.environ.get("DISAGG_NEW", "64"))
+    factor = float(os.environ.get("DISAGG_STALL_FACTOR", "2.0"))
+    max_len = max(384, long_len + 16)
+    compile_keys = ("serving.decode_compiles", "serving.prefill_compiles",
+                    "serving.cow_compiles", "serving.restore_compiles")
+    # the heartbeat window is sized ABOVE the worst compile pause, not
+    # for fast kill detection (nothing is chaos-killed here): mid-size
+    # first-compiles saturate every core, and a 1s window misclassifies
+    # a starved-but-fine worker as hung (robustness.md, "Heartbeat
+    # supervision")
+    base_kw = dict(background=True, num_slots=4, kv_block_size=8,
+                   max_model_len=max_len, heartbeat_interval=0.5,
+                   heartbeat_misses=30, worker_timeout=60.0)
+    ref_model = _disagg_worker_model()
+    vocab = ref_model.cfg.vocab_size
+
+    p99_uni, c_uni, pf_uni, n_uni, _ = _disagg_fleet_run(
+        ProcessReplicaPool, dict(base_kw, replicas=3), ref_model, vocab,
+        seed, n_streams, n_pressure, long_len, new_tokens, compile_keys)
+    # restore-ahead ON for the disagg window: without the planner every
+    # handoff pays its chain restore (disk read + scatter) inside the
+    # decode worker's admission — on the very critical path whose stalls
+    # this bench measures. The planner is parent-side and the unified
+    # pool has none, so the flag is scoped to the disagg fleet.
+    keep_prefetch = paddle.get_flags("gateway_prefetch")["gateway_prefetch"]
+    paddle.set_flags({"gateway_prefetch": max(2, int(keep_prefetch))})
+    try:
+        p99_dis, c_dis, pf_dis, n_dis, dstat = _disagg_fleet_run(
+            DisaggReplicaPool,
+            dict(base_kw, prefill_replicas=1, decode_replicas=2),
+            ref_model, vocab, seed, n_streams, n_pressure, long_len,
+            new_tokens, compile_keys)
+    finally:
+        paddle.set_flags({"gateway_prefetch": keep_prefetch})
+
+    # ---- acceptance gates -------------------------------------------
+    assert pf_uni == 0 and pf_dis == 0, (
+        f"token parity broke: unified={pf_uni} disagg={pf_dis} streams "
+        f"diverged from generate()")
+    assert c_uni == 0, f"{c_uni} serving compiles in the unified window"
+    assert c_dis == 0, (f"{c_dis} serving compiles in the disagg window "
+                        f"— a handoff or prefetch minted a program")
+    ratio = p99_uni / p99_dis if p99_dis > 0 else float("inf")
+    assert ratio >= factor, (
+        f"p99 inter-token stall under prefill pressure: unified "
+        f"{p99_uni:.1f}ms vs disagg {p99_dis:.1f}ms = {ratio:.2f}x, "
+        f"below the {factor}x gate")
+
+    rec = {
+        "bench": "serving_disagg",
+        "metric": f"p99 decode-stream stall reduction under prefill "
+                  f"pressure (1P+2D disagg vs 3 unified, {platform})",
+        "value": round(ratio, 2),
+        "unit": "x",
+        "platform": platform,
+        "p99_stall_unified_ms": round(p99_uni, 2),
+        "p99_stall_disagg_ms": round(p99_dis, 2),
+        "stall_gate_x": factor,
+        "decode_streams": n_streams,
+        "pressure_requests": n_pressure,
+        "pressure_prompt_tokens": long_len,
+        "gaps_sampled_unified": n_uni,
+        "gaps_sampled_disagg": n_dis,
+        "compiles_unified_window": c_uni,
+        "compiles_disagg_window": c_dis,
+        "disagg_fleet": dstat,
+    }
+    print(f"# disagg: p99 stall {p99_uni:.1f}ms unified -> "
+          f"{p99_dis:.1f}ms disagg ({ratio:.2f}x, gate {factor}x), "
+          f"parity ok, compiles 0/0", flush=True)
+    _persist("disagg", rec)
+
+
 def main():
     import jax
 
@@ -2123,6 +2350,10 @@ def main():
         # the model builds INSIDE each worker process from the module-
         # level factory — the parent never holds a serving engine
         run_process_replicas(platform)
+        return
+    if "--disagg" in sys.argv:
+        # both fleets build their models inside the worker processes
+        run_disagg(platform)
         return
     if "--gateway" in sys.argv:
         cfg = (GPTConfig(vocab_size=50304, hidden_size=768, num_layers=12,

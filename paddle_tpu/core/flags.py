@@ -377,6 +377,49 @@ define_flag("gateway_worker_timeout", 10.0,
             "its grace budget on top; worker SPAWN uses its own fixed "
             "boot budget since a cold worker imports jax and builds an "
             "engine first.")
+define_flag("gateway_prefill_replicas", 0,
+            "Disaggregated serving: worker processes in the PREFILL role "
+            "(serving.disagg.DisaggReplicaPool). A prefill worker "
+            "runs chunked prefill only, write-through-publishes each "
+            "finished full block into the shared tier store under its "
+            "radix content hash, emits the first token, and hands the "
+            "request off to the decode pool. 0 together with "
+            "FLAGS_gateway_decode_replicas = 0 keeps the unified "
+            "ProcessReplicaPool behavior. Requires "
+            "FLAGS_gateway_process_replicas.")
+define_flag("gateway_decode_replicas", 0,
+            "Disaggregated serving: worker processes in the DECODE role. "
+            "A decode worker admits a handed-off request by restoring its "
+            "published content-hash chain through the existing one-scatter "
+            "compiled restore path and decodes it to completion — "
+            "token-for-token identical to a unified run, zero new "
+            "compiled programs per handoff. 0 together with "
+            "FLAGS_gateway_prefill_replicas = 0 keeps the unified pool.")
+define_flag("gateway_prefetch", 0,
+            "Restore-ahead prefetch depth: how many QUEUED decode-phase "
+            "requests the gateway-side planner may pre-restore per pump "
+            "sweep, pulling their published/spilled KV chains into the "
+            "target decode worker's arena before admission (bounded by "
+            "free refcount-zero headroom, so prefetch can never starve "
+            "admission). 0 = off (restore happens at admission).")
+define_flag("serving_tier_publish", False,
+            "Write-through publish: every tier write-through (radix "
+            "insert of a full prompt block) also lands the payload in "
+            "the on-disk tier immediately instead of only on host-RAM "
+            "LRU overflow, making the chain restorable by OTHER worker "
+            "processes sharing FLAGS_serving_disk_cache_dir — the "
+            "disaggregated prefill->decode handoff contract. No effect "
+            "without a disk tier.")
+define_flag("serving_publish_chunks", False,
+            "Publish each finished full prompt block into the radix "
+            "cache (and, with FLAGS_serving_tier_publish, the shared "
+            "disk tier) at every chunked-prefill chunk boundary instead "
+            "of only at admission finish — so a prefill worker's partial "
+            "chain is already restorable when the request hands off (or "
+            "when the worker dies mid-prompt: the successor re-prefills "
+            "only the unpublished suffix). Requires "
+            "FLAGS_serving_prefix_cache; no effect without chunked "
+            "prefill.")
 
 # ---- Resilience: retry / sentinel / fault injection (core.resilience) ----
 define_flag("io_retries", 3,

@@ -93,6 +93,12 @@ DOCUMENTED_NAMESPACES = (
     # kills / hangs / heartbeats / heartbeat_misses / protocol_errors —
     # the heartbeat watchdog's classification of worker-process deaths
     "worker",
+    # disaggregated prefill/decode serving (ISSUE 19, serving.disagg /
+    # docs/serving.md "Disaggregated prefill/decode"):
+    # disagg.prefill_ejections / disagg.decode_ejections — per-role
+    # worker deaths, the resilience-plane view of the role-typed fleet
+    # (routing/handoff/prefetch counters live in serving.metrics)
+    "disagg",
 )
 
 

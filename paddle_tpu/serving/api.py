@@ -248,6 +248,17 @@ class ServingAPI:
                 + len(self.scheduler.prefilling)
                 + len(self.scheduler.running))
 
+    def prefetch(self, prompt, trace_id: str = "") -> int:
+        """Restore-ahead (disagg): pre-restore ``prompt``'s published/
+        spilled radix chain into this engine's arena before its request
+        is admitted — see :meth:`ServingEngine.prefetch` for the
+        never-starves-admission bound. Serialized with the pump under
+        the api lock; a closed/draining instance declines (returns 0)."""
+        with self._lock:
+            if self._closed or self._draining:
+                return 0
+            return self.engine.prefetch(prompt, trace_id=trace_id)
+
     def stream(self, req: Request) -> Iterator[int]:
         """Yield ``req``'s tokens as they are generated; raises the
         request's error (deadline, shed, engine failure) at the end of a

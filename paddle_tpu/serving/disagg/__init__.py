@@ -1,0 +1,27 @@
+"""Disaggregated prefill/decode serving (ISSUE 19).
+
+Role-typed worker pools on top of the process-replica gateway: prefill
+workers run chunked prefill only and publish each finished full block
+into the shared tier store under its radix content hash; decode workers
+admit the handed-off request by restoring the published chain through
+the existing one-scatter compiled restore path and decode it to
+completion — token-for-token identical to a unified run, zero new
+compiled programs per handoff. See docs/serving.md "Disaggregated
+prefill/decode".
+"""
+from .pool import DisaggReplicaPool
+from .prefetch import RestorePlanner
+from .roles import (DECODE, PREFILL, UNIFIED, role_counts,
+                    role_flag_overrides, role_of, shared_disk_dir)
+
+__all__ = [
+    "DisaggReplicaPool",
+    "RestorePlanner",
+    "PREFILL",
+    "DECODE",
+    "UNIFIED",
+    "role_counts",
+    "role_flag_overrides",
+    "role_of",
+    "shared_disk_dir",
+]

@@ -1,0 +1,89 @@
+"""Restore-ahead prefetch: turn decode-pool queue wait into overlap.
+
+A handed-off request that cannot be admitted yet (its decode worker's
+slots are full) will, at admission, pay one compiled restore scatter to
+pull its published chain from the shared tier into the arena. That wait
+is overlappable: the chain's content hashes are known the moment the
+request is routed, and restoring them early only converts free
+refcount-zero blocks into evictable cached blocks — ``grantable()`` is
+unchanged, so prefetch can NEVER starve the admission it is trying to
+accelerate (the bound is enforced worker-side in
+``ServingEngine.prefetch``; see its docstring for the cost model).
+
+The planner here is the gateway-side half: each pool pump sweep walks
+the live routed requests, picks up to ``FLAGS_gateway_prefetch``
+decode-phase requests whose backend is still QUEUED, and fires one
+``prefetch`` RPC at the worker the router already placed them on. The
+shared :class:`~..gateway.router.GlobalRadixIndex` is consulted first —
+when the target replica publishes radix deltas (thread pools) and the
+index already shows the whole chain device-resident there is nothing to
+restore — but under process pools the index is conservatively empty
+(workers publish no deltas across the process boundary), so the
+worker-side radix walk stays the authority: a prefetch against an
+already-resident chain is a cheap no-op walk.
+
+Each request is prefetched at most once per placement: a re-route onto
+a different worker re-arms it (the new arena is cold for this chain).
+"""
+from __future__ import annotations
+
+from ...core import flags
+from .. import metrics
+from ..scheduler import RequestState
+
+
+class RestorePlanner:
+    """Gateway-side restore-ahead planner for one
+    :class:`~.pool.DisaggReplicaPool`. Stateless beyond the per-request
+    arming marks it leaves on the handles; safe to call from any pump
+    thread (it reads pool state under the pool lock and talks to
+    workers through their per-call-thread-safe RPC handles)."""
+
+    def __init__(self, pool):
+        self.pool = pool
+
+    def sweep(self) -> int:
+        """One planning pass: prefetch up to ``FLAGS_gateway_prefetch``
+        eligible requests; returns how many RPCs were fired."""
+        depth = int(flags.flag("gateway_prefetch"))
+        if depth <= 0:
+            return 0
+        pool = self.pool
+        with pool._lock:
+            live = [rr for bucket in pool._live.values() for rr in bucket]
+        fired = 0
+        for rr in live:
+            if fired >= depth:
+                break
+            if rr.finished or pool._phase(rr) != "decode":
+                continue
+            with rr._lock:
+                backend = rr._backend
+                idx = rr._replica_idx
+            if backend is None or backend.state != RequestState.QUEUED:
+                continue  # admitted already: its restore ran (or will not)
+            if getattr(rr, "_prefetched_on", None) == (idx, rr.reroutes):
+                continue  # armed once per placement
+            rep = pool._replica_at(idx)
+            if rep is None or not rep.routable():
+                continue
+            handle = rep.api
+            if not hasattr(handle, "prefetch"):
+                continue
+            keys = pool._prefix_keys(rr, rep)
+            if keys and pool.index.resident_blocks(keys, idx) >= len(keys):
+                continue  # whole chain already device-resident there
+            rr._prefetched_on = (idx, rr.reroutes)
+            try:
+                blocks = int(handle.prefetch(rr.prompt,
+                                             trace_id=rr.trace_id))
+            # analysis: allow(broad-except) — prefetch is best-effort by
+            # contract: a worker dying under the RPC is the watchdog's
+            # problem (ejection + journal re-route), never the planner's
+            except Exception:
+                continue
+            fired += 1
+            metrics.bump("disagg.prefetches")
+            if blocks:
+                metrics.bump("disagg.prefetched_chains")
+        return fired

@@ -1,0 +1,103 @@
+"""Worker roles and their flag profiles.
+
+A role is ROUTING POLICY, not capability: every worker boots the same
+full serving stack (engine + scheduler + supervisor), so a pool whose
+prefill or decode side empties can degrade to unified routing without
+respawning anything. What differs per role is the flag profile its
+spawn payload carries:
+
+* **prefill** — chunked prefill with incremental publish: every finished
+  full prompt block becomes a radix node (``FLAGS_serving_publish_chunks``)
+  and is write-through-published to the shared DISK tier
+  (``FLAGS_serving_tier_publish``) the moment it is scattered, so the
+  chain is restorable by other processes before the prefill even
+  finishes (and after a kill -9, the successor re-prefills only the
+  unpublished suffix).
+* **decode** — prefix cache + tiering on (the restore path), publish
+  off: a decode worker admits a handed-off request by walking its radix
+  tree, materializing the disk-resident content hashes as spilled nodes,
+  and restoring them through the ONE compiled scatter.
+* **unified** — no overrides: the worker runs whatever the parent's
+  flags say (the PR 18 behavior).
+
+Roles are assigned by replica INDEX — prefill workers first, decode
+workers after — so a respawned worker keeps its role (the payload seam
+``ProcessReplicaPool._payload_for`` is a pure function of the index).
+
+Both roles share one on-disk tier directory
+(``FLAGS_serving_disk_cache_dir``; :func:`shared_disk_dir` mints a
+tempdir when unset): the disk tier is content-addressed (blake2b chunk
+keys namespaced by the arena signature, which is deterministic across
+processes for an identical model/flag config), written atomically and
+crc-checked on load, so cross-process sharing needs no coordination
+beyond the directory itself.
+"""
+from __future__ import annotations
+
+import tempfile
+
+from ...core import flags
+
+PREFILL = "prefill"
+DECODE = "decode"
+UNIFIED = "unified"
+
+
+def role_counts(prefill=None, decode=None):
+    """(n_prefill, n_decode) from the explicit args or the gateway
+    flags. ``(0, 0)`` means disaggregation is off (unified pool)."""
+    p = int(flags.flag("gateway_prefill_replicas")
+            if prefill is None else prefill)
+    d = int(flags.flag("gateway_decode_replicas")
+            if decode is None else decode)
+    if p < 0 or d < 0:
+        raise ValueError(f"role counts must be >= 0, got prefill={p} "
+                         f"decode={d}")
+    return p, d
+
+
+def role_of(idx: int, n_prefill: int, n_decode: int) -> str:
+    """The role replica ``idx`` wears: prefill workers occupy the low
+    indices, decode workers the next band, anything past that (a pool
+    built with extra unified capacity) is unified."""
+    if idx < n_prefill:
+        return PREFILL
+    if idx < n_prefill + n_decode:
+        return DECODE
+    return UNIFIED
+
+
+def shared_disk_dir() -> str:
+    """The disk-tier directory both roles publish/restore through:
+    ``FLAGS_serving_disk_cache_dir`` when set, else a fresh tempdir (the
+    pool ships it to every worker via its payload's flag snapshot, so
+    all of them agree even though the parent flag stays empty)."""
+    configured = str(flags.flag("serving_disk_cache_dir"))
+    if configured:
+        return configured
+    return tempfile.mkdtemp(prefix="paddle_tpu_disagg_kv_")
+
+
+def role_flag_overrides(role: str, disk_dir: str) -> dict:
+    """The flag overrides a worker of ``role`` boots under (merged over
+    the parent's snapshot by ``worker.encode_payload``)."""
+    base = {
+        "serving_prefix_cache": True,
+        "serving_kv_tiering": True,
+        "serving_disk_cache_dir": str(disk_dir),
+    }
+    if role == PREFILL:
+        base["serving_publish_chunks"] = True
+        base["serving_tier_publish"] = True
+        # chunked prefill is what makes publish INCREMENTAL (admit_chunk
+        # inserts each finished full block as it is scattered) — without
+        # it the chain only becomes restorable when the whole prompt
+        # lands, and a killed prefill worker's successor would re-prefill
+        # everything. Chunk size is NOT part of the arena signature, so
+        # prefill and decode workers still exchange identical chunk keys.
+        base["serving_chunked_prefill"] = (
+            int(flags.flag("serving_chunked_prefill")) or 32)
+        return base
+    if role == DECODE:
+        return base
+    return {}

@@ -796,22 +796,33 @@ class ServingEngine:
                 f"{cap} allocatable; it could never be admitted")
 
     def admit_blocks_needed(self, prompt_len: int, max_new_tokens: int,
-                            prompt=None) -> int:
+                            prompt=None, journal_len: int = 0) -> int:
         """Blocks an admission would actually RESERVE: the worst-case
         budget minus full prompt blocks resident in the radix cache (those
         attach by reference). A fully-cached block-aligned prompt still
         reserves one private block — the copy-on-write target its last
         block is recomputed into. Conservative when ``prompt`` is None or
         the cache is off (plain worst case)."""
-        return self.admit_sizing(prompt_len, max_new_tokens, prompt)[0]
+        return self.admit_sizing(prompt_len, max_new_tokens, prompt,
+                                 journal_len=journal_len)[0]
 
     def admit_sizing(self, prompt_len: int, max_new_tokens: int,
-                     prompt=None, keys=None):
+                     prompt=None, keys=None, journal_len: int = 0):
         """Both admission-feasibility numbers from ONE radix walk:
         (blocks this admission would reserve, matched-but-unpinned blocks
         that ``grantable()`` counts evictable but admit() will pin).
         ``keys`` — a precomputed ``PrefixCache.chunk_keys`` chain — makes
-        the walk hash-free for per-step scheduler probes."""
+        the walk hash-free for per-step scheduler probes.
+
+        ``journal_len`` is the request's replay-journal length (re-route /
+        replay / disagg-handoff admissions): admit prefills
+        ``prompt + journal``, so the copy-on-write trigger — "the whole
+        PREFILLED context is cache-matched" — compares against
+        ``prompt_len + journal_len``, not the bare prompt. Without it a
+        handed-off request whose published chain exactly covers its
+        block-aligned prompt would be billed a phantom COW block: the
+        published chain is restore cost (one fresh block each, already in
+        the worst-case budget), never a COW."""
         need = self.blocks_needed(prompt_len, max_new_tokens)
         if self.prefix_cache is None or (prompt is None and keys is None):
             return need, 0
@@ -827,17 +838,53 @@ class ServingEngine:
             # still consumes one fresh block as its restore target —
             # restore cost, not prefill cost — so it stays in the budget
             need -= resident
-            if matched * self.block_size >= prompt_len:
+            if matched * self.block_size >= prompt_len + int(journal_len):
                 need += 1  # COW copy of the last fully-matched block
         return need, unpinned
 
     def can_admit(self, prompt_len: int, max_new_tokens: int,
-                  prompt=None, keys=None) -> bool:
+                  prompt=None, keys=None, journal_len: int = 0) -> bool:
         if self.free_slots() <= 0:
             return False
         need, pinned = self.admit_sizing(prompt_len, max_new_tokens,
-                                         prompt, keys=keys)
+                                         prompt, keys=keys,
+                                         journal_len=journal_len)
         return self.arena.grantable() - pinned >= need
+
+    def prefetch(self, prompt, trace_id: str = "") -> int:
+        """Restore-ahead (disagg, ISSUE 19): pull the spilled/published
+        tail of ``prompt``'s radix chain into fresh arena blocks NOW —
+        the same one-scatter ``_restore_nodes`` path admission uses, with
+        no slot claimed and no references taken — so a QUEUED request's
+        later admission finds the whole chain device-resident and skips
+        the restore wait. Bounded by the arena's free refcount-zero
+        headroom ABOVE what eviction could already reclaim
+        (``grantable() - evictable``): a prefetch converts free blocks
+        into evictable cached blocks, which leaves ``grantable()``
+        unchanged — prefetch can never starve admission — and the bound
+        additionally keeps it from evicting warmer prefixes to make room
+        for colder ones. Returns how many blocks were restored."""
+        if self.prefix_cache is None or self.tier is None:
+            return 0
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        walked = self.prefix_cache.match(prompt)
+        split = next((i for i, n in enumerate(walked) if n.spilled),
+                     len(walked))
+        tail = walked[split:]
+        if not tail:
+            return 0
+        # free - reserved headroom (grantable counts evictable on top)
+        headroom = self.arena.grantable() \
+            - self.prefix_cache.evictable_blocks()
+        if headroom <= 0:
+            return 0
+        self._trace_ctx = trace_id
+        restored = self._restore_nodes(tail[:headroom])
+        if restored:
+            metrics.bump("disagg.prefetched_blocks", restored)
+            telemetry.span(trace_id, telemetry.PREFETCHED,
+                           blocks=restored)
+        return restored
 
     # ------------------------------------------------------------ compile
 
@@ -1252,6 +1299,22 @@ class ServingEngine:
             st.done += take
             metrics.bump("chunk.chunks")
             metrics.bump("chunk.tokens", take)
+            # incremental publish (FLAGS_serving_publish_chunks): every
+            # prompt block this chunk finished scattering becomes a radix
+            # node NOW — and, via the insert path's write_through (+
+            # FLAGS_serving_tier_publish), tier/disk-resident — so a
+            # disagg prefill worker's partial chain is restorable the
+            # moment it exists. insert() is idempotent over the already-
+            # inserted prefix (resident nodes are skipped), and the new
+            # nodes' blocks are marked cached, so even an abort of the
+            # remaining chunks leaves them valid (cached blocks survive
+            # the reservation release).
+            if (self.prefix_cache is not None
+                    and flags.flag("serving_publish_chunks")):
+                full = min(st.done, st.plen) // self.block_size
+                if full > 0:
+                    self.prefix_cache.insert(st.prompt, self._bt_host[slot],
+                                             full)
             if (st.done >= st.clen and self.spec is not None
                     and not st.skip_draft):
                 self.spec.prefill(slot, st.ctx)

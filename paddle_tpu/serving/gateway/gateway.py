@@ -16,8 +16,12 @@ the client's contract with its model):
   the tenant's configured default, else greedy); ``adapter`` picks the
   LoRA arena row (absent = the tenant's fine-tune, 0 = base weights);
   ``choices`` — a list of token-id lists — constrains the output to one
-  of those sequences (a ``serving.constrain.TrieConstraint``; richer
-  grammars lower to ``TokenDFA`` client-side against the tokenizer).
+  of those sequences (a ``serving.constrain.TrieConstraint``);
+  ``grammar`` — ``{"regex": "..."} `` or ``{"json_schema": {...}}`` plus a
+  ``token_table`` (token id → string) — compiles server-side to a
+  ``serving.constrain.TokenDFA`` via ``TokenDFA.from_regex`` /
+  ``from_json_schema``, so clients ship a pattern instead of a
+  pre-lowered automaton. Mutually exclusive with ``choices``.
 * ``GET /v1/stream/<request_id>`` — Server-Sent Events: one
   ``data: {"token": t}`` event per generated token (re-routes are invisible
   — the journal keeps the stream token-for-token), then
@@ -230,6 +234,35 @@ class Gateway:
                 [[int(t) for t in c] for c in body["choices"]],
                 vocab_size=self.pool.vocab_size(),
                 stop_token_id=None if stop is None else int(stop))
+        if body.get("grammar") is not None:
+            if constraint is not None:
+                raise ValueError("pass either choices or grammar, "
+                                 "not both")
+            from ..constrain import TokenDFA
+
+            g = body["grammar"]
+            if not isinstance(g, dict):
+                raise ValueError("grammar must be an object")
+            table = g.get("token_table")
+            if not isinstance(table, dict) or not table:
+                raise ValueError("grammar.token_table (token id -> "
+                                 "string) is required")
+            token_table = {int(k): str(v) for k, v in table.items()}
+            stop = g.get("stop_token_id", body.get("stop_token_id"))
+            stop = None if stop is None else int(stop)
+            if g.get("regex") is not None:
+                constraint = TokenDFA.from_regex(
+                    str(g["regex"]), token_table,
+                    vocab_size=self.pool.vocab_size(),
+                    stop_token_id=stop)
+            elif g.get("json_schema") is not None:
+                constraint = TokenDFA.from_json_schema(
+                    g["json_schema"], token_table,
+                    vocab_size=self.pool.vocab_size(),
+                    stop_token_id=stop)
+            else:
+                raise ValueError(
+                    'grammar needs a "regex" or "json_schema" key')
         rr = self.pool.submit(
             prompt,
             max_new_tokens=int(body.get("max_new_tokens", 32)),
@@ -503,10 +536,21 @@ def serve(model, replicas: Optional[int] = None,
     OS worker processes (:class:`~.procpool.ProcessReplicaPool` — process
     fault domains, heartbeat watchdog, kill -9 crash recovery; see
     docs/robustness.md "Process isolation"). Off (the default) keeps the
-    thread-replica :class:`ReplicaPool` bit-for-bit."""
+    thread-replica :class:`ReplicaPool` bit-for-bit.
+
+    With ``FLAGS_gateway_prefill_replicas`` / ``FLAGS_gateway_decode_replicas``
+    both > 0 (requires process replicas) the pool is the role-typed
+    :class:`~..disagg.DisaggReplicaPool` — disaggregated prefill/decode
+    serving with content-hash KV handoff; see docs/serving.md
+    "Disaggregated prefill/decode". ``replicas`` is ignored there: the
+    role counts are the fleet size."""
     pool_cls = ReplicaPool
     if flags.flag("gateway_process_replicas"):
         from .procpool import ProcessReplicaPool as pool_cls
+        if (int(flags.flag("gateway_prefill_replicas")) > 0
+                and int(flags.flag("gateway_decode_replicas")) > 0):
+            from ..disagg import DisaggReplicaPool as pool_cls
+            replicas = None  # role counts define the fleet
     pool = pool_cls(model, replicas=replicas, tenants=tenants,
                     background=True, **pool_kw)
     gw = Gateway(pool, host=host, port=port).start()

@@ -232,6 +232,10 @@ class WorkerHandle:
         self._poll_lock = threading.Lock()  # serialize whole poll cycles
         self._pending: Dict[int, list] = {}   # call id -> [event, resp]
         self._reqs: Dict[str, RemoteRequest] = {}
+        # finalized rids the worker hasn't confirmed dropping yet — the
+        # worker retains a finished request until this ack reaches it
+        # (poll responses are lossy under busy timeouts; see _op_poll)
+        self._done_unacked: set = set()
         self._dead: Optional[BaseException] = None
         self._closing = False
         self._exit_classified = False
@@ -480,12 +484,17 @@ class WorkerHandle:
         budget = min(self._call_timeout, max(1.0, 10 * self.hb_interval))
         with self._poll_lock:
             with self._lock:
-                if self._dead is not None or not self._reqs:
+                if self._dead is not None or (not self._reqs
+                                              and not self._done_unacked):
                     return
                 offsets = {rid: len(r.tokens)
                            for rid, r in self._reqs.items()}
+                done = list(self._done_unacked)
+            body: dict = {"reqs": offsets}
+            if done:
+                body["done"] = done
             try:
-                resp = self._call("poll", {"reqs": offsets},
+                resp = self._call("poll", body,
                                   timeout=budget, busy_ok=True)
             except WorkerBusyError:
                 # tolerated while heartbeats stay fresh — but a main loop
@@ -509,6 +518,9 @@ class WorkerHandle:
             breaker = bool(resp.get("breaker_open"))
             entries = resp.get("reqs") or {}
             with self._lock:
+                # the worker saw the ack list of a SUCCESSFUL call; newly
+                # finalized rids below re-join the set for the next cycle
+                self._done_unacked.difference_update(done)
                 pairs = [(self._reqs[rid], entry)
                          for rid, entry in entries.items()
                          if rid in self._reqs]
@@ -516,6 +528,7 @@ class WorkerHandle:
                     if (entry.get("state") in _TERMINAL.STATES
                             and rid in self._reqs):
                         del self._reqs[rid]
+                        self._done_unacked.add(rid)
             for req, entry in pairs:
                 req._apply(entry)
         if breaker is not None:
@@ -542,6 +555,15 @@ class WorkerHandle:
         included — the bench's per-survivor zero-recompile gate) plus
         pid/outstanding/breaker."""
         return self._call("stats", {}, timeout=timeout)
+
+    def prefetch(self, prompt, trace_id: str = "") -> int:
+        """Restore-ahead (disagg): ask the worker to pre-restore this
+        prompt's published chain into its arena (bounded worker-side —
+        see ``ServingEngine.prefetch``). Returns blocks restored."""
+        resp = self._call("prefetch", {
+            "prompt": [int(t) for t in np.asarray(prompt).reshape(-1)],
+            "trace_id": str(trace_id)})
+        return int(resp.get("blocks", 0))
 
     def hang(self) -> None:
         """Chaos: tell the worker to stop heartbeating and swallow all
@@ -597,6 +619,7 @@ class WorkerHandle:
             self._pending.clear()
             reqs = list(self._reqs.values())
             self._reqs.clear()
+            self._done_unacked.clear()  # nobody left to ack to
         for slot in pending:
             slot[0].set()
         for req in reqs:
@@ -718,9 +741,17 @@ class ProcessReplicaPool(ReplicaPool):
 
     # ----------------------------------------------------- spawn / respawn
 
+    def _payload_for(self, idx: int) -> dict:
+        """The spawn payload for replica ``idx``. Seam for role-typed
+        pools (disagg): per-role payloads carry flag overrides, and this
+        is called from BOTH the constructor and the respawn threads — a
+        role override must be a pure function of ``idx``, never mutable
+        pool state."""
+        return self._payload
+
     def _spawn_api(self, idx: int) -> WorkerHandle:
         handle = WorkerHandle.spawn(
-            idx, self._payload, boot_timeout=self._boot_timeout,
+            idx, self._payload_for(idx), boot_timeout=self._boot_timeout,
             call_timeout=self._call_timeout,
             hb_interval=self._hb_interval,
             hb_misses=self._hb_misses)
@@ -817,6 +848,15 @@ class ProcessReplicaPool(ReplicaPool):
         for rep in self.healthy_replicas():
             handle = rep.api
             if not isinstance(handle, WorkerHandle):
+                continue
+            dead = handle._dead
+            if dead is not None:
+                # the handle classified the death first (wedged main loop,
+                # send failure): eject with THAT cause — by now the worker
+                # has usually seen the closed socket and exited cleanly,
+                # and the proc check below would mislabel the hang as
+                # "exited with code 0"
+                self._eject(rep, dead)
                 continue
             proc = handle.proc
             if proc is not None and not proc.is_alive():

@@ -548,10 +548,11 @@ class ReplicaPool:
         request was already accepted once."""
         candidates = self._candidates(rr)
         last_exc: Optional[BaseException] = None
+        budget = self._backend_budget(rr, journal)
         for rep in candidates:
             try:
                 backend = rep.api.submit(
-                    rr.prompt, max_new_tokens=rr.max_new_tokens,
+                    rr.prompt, max_new_tokens=budget,
                     stop_token_id=rr.stop_token_id,
                     timeout=(None if rr.deadline.expires_at is None
                              else max(0.001, rr.deadline.remaining())),
@@ -576,6 +577,17 @@ class ReplicaPool:
         raise last_exc if last_exc is not None else NoHealthyReplicaError(
             "no healthy serving replica (all ejected, draining, or "
             "removed); retry after the respawn backoff")
+
+    def _backend_budget(self, rr: RoutedRequest,
+                        journal: Optional[Sequence[int]]) -> int:
+        """The ``max_new_tokens`` the BACKEND submit is given. The base
+        pool always hands over the request's full budget; a role-typed
+        pool (disagg) caps a prefill-phase placement at first-token so
+        the prefill worker finishes its backend request at the handoff
+        point. Never mutates ``rr.max_new_tokens`` — the reroute/handoff
+        completion checks compare the journal against the REQUEST's
+        budget, not any one placement's."""
+        return rr.max_new_tokens
 
     def _candidates(self, rr: RoutedRequest) -> List[_Replica]:
         """Routable replicas, best first: least outstanding work, with the

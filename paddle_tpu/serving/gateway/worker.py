@@ -200,12 +200,16 @@ def b64_loads(data: str) -> Any:
 
 
 def encode_payload(model, api_kw: dict,
-                   hb_interval: Optional[float] = None) -> dict:
+                   hb_interval: Optional[float] = None,
+                   flag_overrides: Optional[dict] = None) -> dict:
     """The picklable spawn-args payload ``worker_main`` boots from: the
     model (or zero-arg factory) and engine kwargs as base64 pickle, the
     full flag snapshot, and the parent's effective jax platform/precision
     config so the worker's numerics match the parent's token-for-token
-    (greedy decode parity across re-routes depends on it)."""
+    (greedy decode parity across re-routes depends on it).
+    ``flag_overrides`` merge over the snapshot — how a disaggregated pool
+    gives each ROLE its own flag profile (publish-on-prefill, shared disk
+    dir) without mutating the parent's flags."""
     import jax
 
     kw = dict(api_kw)
@@ -216,12 +220,15 @@ def encode_payload(model, api_kw: dict,
     except AttributeError:
         platforms = os.environ.get("JAX_PLATFORMS")
     precision = getattr(jax.config, "jax_default_matmul_precision", None)
+    snapshot = flags.all_flags()
+    if flag_overrides:
+        snapshot = dict(snapshot, **flag_overrides)
     return {
         "model": b64_dumps(model),
         "model_is_factory": bool(callable(model)
                                  and not hasattr(model, "functional_state")),
         "api_kw": b64_dumps(kw),
-        "flags": flags.all_flags(),
+        "flags": snapshot,
         "jax_platforms": platforms,
         "matmul_precision": precision,
         "hb_interval": hb_interval,
@@ -399,17 +406,24 @@ class _WorkerServer:
         return {"rid": rid}
 
     def _op_poll(self, msg: dict) -> dict:
+        # acknowledge-based reap: a finished request is dropped only when
+        # the parent's NEXT poll lists it in ``done`` (it applied the
+        # terminal state). Reaping on send would lose the terminal entry
+        # whenever the response frame outlives the parent's poll deadline
+        # (busy-classified under compile load) — the parent would re-poll
+        # an rid this side no longer knows and the request would sit
+        # QUEUED forever. Acks are idempotent; a lost ack just re-ships.
+        for rid in (msg.get("done") or ()):
+            self.reqs.pop(str(rid), None)
         out = {}
         for rid, offset in (msg.get("reqs") or {}).items():
             req = self.reqs.get(rid)
             if req is None:
-                continue  # already reaped on a previous poll
+                continue  # unknown rid: acked earlier or never submitted
             entry = {"state": req.state,
                      "tokens": [int(t) for t in req.tokens[int(offset):]]}
-            if req.finished:
-                if req.error is not None:
-                    entry["error"] = encode_error(req.error)
-                self.reqs.pop(rid, None)
+            if req.finished and req.error is not None:
+                entry["error"] = encode_error(req.error)
             out[rid] = entry
         return {"reqs": out, "spans": self.take_spans(),
                 "breaker_open": bool(self.api.supervisor.breaker_open),
@@ -447,6 +461,14 @@ class _WorkerServer:
                 "breaker_open": bool(self.api.supervisor.breaker_open),
                 "drain_count": int(self.api.drain_count),
                 "metrics": snap}
+
+    def _op_prefetch(self, msg: dict) -> dict:
+        # restore-ahead (disagg): pre-restore a queued request's
+        # published chain into this worker's arena; bounded worker-side
+        # (never starves admission), so the parent fires and forgets
+        return {"blocks": int(self.api.prefetch(
+            np.asarray(msg["prompt"], np.int32),
+            trace_id=str(msg.get("trace_id", ""))))}
 
     def _op_register_adapter(self, msg: dict) -> dict:
         adapter = b64_loads(msg["adapter"])

@@ -154,6 +154,11 @@ DOCUMENTED_NAMESPACES = (
     # per worker index (serving.gateway.procpool, docs/robustness.md
     # "Process isolation")
     "worker",
+    # disagg.* (ISSUE 19): disaggregated prefill/decode serving —
+    # handoffs, prefill/decode/degraded route counts, restore-ahead
+    # prefetches / prefetched_chains / prefetched_blocks
+    # (serving.disagg, docs/serving.md "Disaggregated prefill/decode")
+    "disagg",
     "queue", "slots", "tokens_per_sec",
 )
 

@@ -383,7 +383,8 @@ class Scheduler:
             return head  # the head is warm: no reason to skip it
         if not engine.can_admit(int(head.prompt.shape[0]),
                                 int(head.max_new_tokens),
-                                keys=self._keys_for(head)):
+                                keys=self._keys_for(head),
+                                journal_len=len(head.tokens)):
             # a capacity-blocked head belongs to the starvation/preemption
             # machinery — skipping it would burn its bounded window on
             # passes where it could not have been admitted anyway
@@ -395,7 +396,7 @@ class Scheduler:
             tokens = cache.resident_tokens_for(self._keys_for(r))
             if tokens > best_tokens and engine.can_admit(
                     int(r.prompt.shape[0]), int(r.max_new_tokens),
-                    keys=self._keys_for(r)):
+                    keys=self._keys_for(r), journal_len=len(r.tokens)):
                 best, best_tokens = r, tokens
         if best is not head:
             head._cache_skips += 1
@@ -420,9 +421,13 @@ class Scheduler:
         # worst-case blocks_needed() would decline preemptions that the
         # very next can_admit() would in fact grant
         cache_on = getattr(self.engine, "prefix_cache", None) is not None
+        # journal_len: a preempted/re-routed waiter re-prefills
+        # prompt+journal, so its COW trigger compares against the full
+        # prefilled context (admit_sizing) — the handed-off/replayed case
         need, pinned = self.engine.admit_sizing(
             int(waiter.prompt.shape[0]), int(waiter.max_new_tokens),
-            keys=self._keys_for(waiter) if cache_on else None)
+            keys=self._keys_for(waiter) if cache_on else None,
+            journal_len=len(waiter.tokens))
         reclaimable = (self.engine.arena.grantable() - pinned
                        + sum(self.engine.reserved_blocks(r.slot)
                              for r in candidates))
@@ -531,7 +536,8 @@ class Scheduler:
             cache_on = getattr(self.engine, "prefix_cache", None) is not None
             if not self.engine.can_admit(
                     int(req.prompt.shape[0]), int(req.max_new_tokens),
-                    keys=self._keys_for(req) if cache_on else None):
+                    keys=self._keys_for(req) if cache_on else None,
+                    journal_len=len(req.tokens)):
                 # the head waiter is capacity-blocked: count starvation
                 # once per step, then preempt one victim per pass until it
                 # fits or no strictly-lower-priority victim remains

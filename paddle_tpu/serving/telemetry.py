@@ -75,6 +75,8 @@ PREEMPTED = "PREEMPTED"          # victim evicted mid-decode, re-queued
 REPLAYED = "REPLAYED"            # supervisor rebuild re-admitted the journal
 REROUTED = "REROUTED"            # gateway moved the stream to another replica
 RESTORED = "RESTORED"            # tier-restore scatter landed for this admit
+HANDOFF = "HANDOFF"              # prefill->decode pool handoff (disagg)
+PREFETCHED = "PREFETCHED"        # restore-ahead planner pre-restored the chain
 DRAINED = "DRAINED"              # failed by a drain (retriable)
 FINISHED = "FINISHED"            # terminal: complete output delivered
 FAILED = "FAILED"                # terminal: error or cancellation
@@ -82,8 +84,8 @@ FAILED = "FAILED"                # terminal: error or cancellation
 #: every event kind a well-formed trace may contain, in no particular
 #: order (docs/observability.md documents the expected sequences)
 SPAN_KINDS = (SUBMITTED, QUEUED, ADMITTED, PREFILL_CHUNK, FIRST_TOKEN,
-              PREEMPTED, REPLAYED, REROUTED, RESTORED, DRAINED, FINISHED,
-              FAILED)
+              PREEMPTED, REPLAYED, REROUTED, RESTORED, HANDOFF, PREFETCHED,
+              DRAINED, FINISHED, FAILED)
 
 
 def mint_trace_id() -> str:

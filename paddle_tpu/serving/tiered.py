@@ -155,8 +155,25 @@ class DiskTier:
         metrics.set_gauge("tier.disk_bytes", sum(self._sizes.values()))
 
     def has(self, key: bytes) -> bool:
+        name = self._name(key)
         with self._lock:
-            return self._name(key) in self._sizes
+            if name in self._sizes:
+                return True
+        # cross-process adoption (disagg handoff): another worker may
+        # have published this content hash into the shared directory
+        # after our construction scan — a miss in the in-memory index is
+        # only authoritative for what THIS process wrote, so fall back
+        # to a stat and adopt the file (content-addressed + atomically
+        # replaced, so an existing path is always a complete entry)
+        try:
+            size = os.stat(os.path.join(self.root, name)).st_size
+        except OSError:
+            return False
+        with self._lock:
+            if name not in self._sizes:
+                self._sizes[name] = size
+                self._publish()
+        return True
 
     def put(self, key: bytes, payload: Payload) -> None:
         body = _pack(payload)
@@ -313,20 +330,35 @@ class HostKVCache:
     def put(self, key: bytes, payload: Payload) -> None:
         self._insert(key, payload)
 
-    def ensure(self, key: bytes, reader: Callable[[], Payload]) -> int:
+    def ensure(self, key: bytes, reader: Callable[[], Payload],
+               publish: bool = False) -> int:
         """Make sure ``key`` is resident in SOME tier; ``reader`` is only
         called (one device->host copy) when it is not — the write-through
         at insert time usually means a later spill finds the bytes
-        already here. Returns the bytes actually written (0 = present)."""
+        already here. Returns the bytes actually written (0 = present).
+
+        ``publish=True`` additionally guarantees the bytes reach the
+        DISK tier now (not just on host-LRU overflow): disaggregated
+        prefill workers publish each finished block so decode workers in
+        OTHER processes — which share only the disk directory, never this
+        host dict — can restore the chain. No-op without a disk tier."""
+        payload = None
         with self._lock:
-            if key in self._host:
+            payload = self._host.get(key)
+            if payload is not None:
                 self._host.move_to_end(key)
-                return 0
-        if self.disk is not None and self.disk.has(key):
-            return 0
-        payload = reader()
-        self._insert(key, payload)
-        return _payload_bytes(payload)
+        on_disk = self.disk is not None and self.disk.has(key)
+        if payload is None and not on_disk:
+            payload = reader()
+            self._insert(key, payload)
+            written = _payload_bytes(payload)
+        else:
+            written = 0
+        if (publish and self.disk is not None and not on_disk
+                and payload is not None):
+            self.disk.put(key, payload)
+            metrics.bump("tier.published_blocks")
+        return written
 
     def _insert(self, key: bytes, payload: Payload) -> None:
         with self._lock:
@@ -439,8 +471,12 @@ class TierView:
     def write_through(self, key: bytes, reader: Callable[[], Payload]) -> None:
         """Radix-insert publication: freshly prefilled full blocks land in
         the shared host tier so OTHER replicas (and a post-crash rebuild)
-        can hit them while this replica still serves them from device."""
-        self.store.ensure(self._k(key), reader)
+        can hit them while this replica still serves them from device.
+        With ``FLAGS_serving_tier_publish`` the bytes also land on disk
+        immediately — the cross-process handoff contract of the
+        disaggregated prefill role (docs/serving.md)."""
+        self.store.ensure(self._k(key), reader,
+                          publish=bool(flags.flag("serving_tier_publish")))
 
     def lookup(self, key: bytes) -> Optional[Payload]:
         """Load for restore; None = the entry was lost (host LRU dropped

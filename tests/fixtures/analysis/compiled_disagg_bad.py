@@ -1,0 +1,32 @@
+"""known-bad twin of the disagg restore-ahead prefetch pattern
+(serving.engine.prefetch / serving.disagg.prefetch.RestorePlanner): the
+prefetch restore must treat the published chain as host-planned runtime
+data. This one (1) BRANCHES on the published-chain residency mask inside
+the compiled program — ``if published[i]:`` on a traced per-block mask
+is traced-branch: which blocks the decode worker still needs is decided
+on the host (the planner's radix walk against the shared tier), and
+letting it reach the trace as control flow mints a new executable per
+residency pattern, breaking the zero-compile handoff invariant; and (2)
+sizes the scatter with ``int()`` of a traced block count — traced-cast:
+a device sync per prefetch sweep and a count baked in at trace time, not
+read per call."""
+import jax
+
+
+def prefetch_restore(pools, rows, dsts, published, count):
+    # BAD: host int() of a traced chain length — the restore width is
+    # decided by the planner before the call, never inside the program
+    n = int(count)
+    out = pools
+    for i in range(n):
+        # BAD: python branch on a traced residency lookup — published
+        # vs. still-spilled is the gateway planner's host-side call
+        if published[i]:
+            continue
+        out = [p.at[dsts[i]].set(r[i]) for p, r in zip(out, rows)]
+    return out
+
+
+def run(pools, rows, dsts, published, count):
+    step = jax.jit(prefetch_restore, donate_argnums=(0,))
+    return step(pools, rows, dsts, published, count)

@@ -1,0 +1,22 @@
+"""known-good twin of the disagg restore-ahead prefetch pattern
+(serving.engine.prefetch): the gateway planner resolves the published
+chain HOST-SIDE (radix walk + tier residency before the call picks the
+block payloads and their destination slots), and the compiled restore is
+the same one-scatter program every admission-time restore uses — one
+block per call, destination as a traced scalar, payload as a runtime
+array, so every prefetch of every chain reuses one executable and the
+handoff stays zero-compile."""
+import jax
+
+
+def prefetch_restore(pools, row_parts, dst):
+    # dst is runtime data; the scatter covers every pool array
+    # unconditionally — which blocks to restore was decided on the host
+    return [p.at[dst].set(r) for p, r in zip(pools, row_parts)]
+
+
+def run(pools, plan):
+    step = jax.jit(prefetch_restore, donate_argnums=(0,))
+    for row_parts, dst in plan:  # host-side: the planner's chain walk
+        pools = step(pools, row_parts, dst)
+    return pools

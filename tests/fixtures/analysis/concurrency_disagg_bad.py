@@ -1,0 +1,31 @@
+"""known-bad: the handoff mover flips the request's phase table entry
+outside any lock scope while the pump thread reads it under the pool
+lock -> unguarded-mutation.
+
+The race: the watchdog's observe pass and a foreground pump can both see
+the same prefill-phase FINISH; without the flag-under-lock claim, both
+movers detach the journal and the request is routed to the decode pool
+twice (two backends decoding one stream — exactly the duplication the
+journal contract forbids)."""
+import threading
+
+
+class HandoffTable:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.phase = {}
+        self.moving = {}
+
+    def register(self, rid):
+        with self._lock:
+            self.phase[rid] = "prefill"
+            self.moving[rid] = False
+
+    def observe(self, rid, finished):
+        with self._lock:
+            current = self.phase.get(rid)
+        if current != "prefill" or not finished:
+            return False
+        self.moving[rid] = True     # BAD: racy claim, no lock
+        self.phase[rid] = "decode"  # BAD: racy flip, no lock
+        return True

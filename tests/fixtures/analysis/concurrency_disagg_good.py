@@ -1,0 +1,27 @@
+"""known-good twin: the handoff claim-and-flip is one atomic section
+under the pool lock — whichever mover (foreground pump or watchdog
+sweep) wins the claim owns the re-route; the loser sees ``moving`` set
+and backs off, so one stream can never reach the decode pool twice."""
+import threading
+
+
+class HandoffTable:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.phase = {}
+        self.moving = {}
+
+    def register(self, rid):
+        with self._lock:
+            self.phase[rid] = "prefill"
+            self.moving[rid] = False
+
+    def observe(self, rid, finished):
+        with self._lock:
+            if self.phase.get(rid) != "prefill" or not finished:
+                return False
+            if self.moving[rid]:
+                return False  # the other mover owns this handoff
+            self.moving[rid] = True
+            self.phase[rid] = "decode"
+        return True

@@ -1,0 +1,548 @@
+"""Disaggregated prefill/decode serving (ISSUE 19): role-typed worker
+pools, content-hash KV handoff, restore-ahead prefetch, chaos recovery,
+and the grammar frontends that ride along.
+
+The worker model is a MODULE-LEVEL factory (spawn ships it by
+reference; ``paddle.seed(0)`` keeps every process's weights identical),
+so greedy decode parity against the in-parent reference model is a
+meaningful bit-for-bit assertion across prefill->decode handoffs and
+kill -9 reroutes.
+"""
+import os
+import signal
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core import flags as core_flags
+from paddle_tpu.core import resilience
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.models.gpt import GPTForCausalLM, gpt_tiny
+from paddle_tpu.serving import ServingAPI, telemetry
+from paddle_tpu.serving import metrics as serving_metrics
+from paddle_tpu.serving.constrain import TokenDFA, TrieConstraint
+from paddle_tpu.serving.disagg import (
+    DECODE,
+    PREFILL,
+    UNIFIED,
+    DisaggReplicaPool,
+    role_counts,
+    role_flag_overrides,
+    role_of,
+)
+from paddle_tpu.serving.sampling import SamplingParams
+
+pytestmark = [pytest.mark.serving, pytest.mark.gateway]
+
+VOCAB = 1024  # gpt_tiny's vocab
+POOL_KW = dict(num_slots=4, kv_block_size=8, max_model_len=96)
+
+
+def worker_model():
+    paddle.seed(0)
+    m = GPTForCausalLM(gpt_tiny())
+    m.eval()
+    return m
+
+
+@pytest.fixture(scope="module")
+def model():
+    return worker_model()
+
+
+@pytest.fixture
+def flag_guard():
+    snap = core_flags.all_flags()
+    yield
+    core_flags.set_flags(snap)
+    resilience.clear_faults()
+
+
+def _mk_disagg(prefill=1, decode=2, **kw):
+    base = dict(background=True, respawn_backoff=0.5,
+                heartbeat_interval=0.2, heartbeat_misses=5,
+                worker_timeout=10.0, **POOL_KW)
+    base.update(kw)
+    return DisaggReplicaPool(worker_model, prefill_replicas=prefill,
+                             decode_replicas=decode, **base)
+
+
+def _prompt(rng, n=8):
+    return rng.integers(0, VOCAB, (n,), dtype=np.int32)
+
+
+def _ref(model, prompt, max_new, stop=None):
+    out = model.generate(Tensor(np.asarray(prompt)[None]),
+                         max_new_tokens=max_new, stop_token_id=stop)
+    return np.asarray(out._data)[0]
+
+
+def _metric(pool, idx, key):
+    return pool.worker_stats().get(idx, {}).get("metrics", {}).get(key, 0)
+
+
+# -------------------------------------------------------------- roles unit
+
+
+def test_role_bands_and_flag_profiles():
+    assert [role_of(i, 2, 3) for i in range(6)] == \
+        [PREFILL, PREFILL, DECODE, DECODE, DECODE, UNIFIED]
+    pre = role_flag_overrides(PREFILL, "/tmp/kv")
+    assert pre["serving_publish_chunks"] is True
+    assert pre["serving_tier_publish"] is True
+    assert pre["serving_chunked_prefill"] > 0  # incremental publish
+    dec = role_flag_overrides(DECODE, "/tmp/kv")
+    assert dec["serving_prefix_cache"] is True
+    assert dec["serving_kv_tiering"] is True
+    assert "serving_tier_publish" not in dec  # decode restores, never publishes
+    assert role_flag_overrides(UNIFIED, "/tmp/kv") == {}
+    with pytest.raises(ValueError):
+        role_counts(prefill=-1, decode=2)
+
+
+def test_pool_requires_both_roles():
+    # validation fires before any worker spawns — cheap to assert
+    with pytest.raises(ValueError):
+        DisaggReplicaPool(worker_model, prefill_replicas=0,
+                          decode_replicas=2, **POOL_KW)
+    with pytest.raises(ValueError):
+        DisaggReplicaPool(worker_model, prefill_replicas=1,
+                          decode_replicas=0, **POOL_KW)
+
+
+# ---------------------------------------------------- handoff parity + freeze
+
+
+def test_handoff_parity_compile_freeze_and_prefetch(model):
+    rng = np.random.default_rng(0)
+    h0 = serving_metrics.stats().get("disagg.handoffs", 0)
+    pool = _mk_disagg(prefill=1, decode=2)
+    api = ServingAPI(model, **POOL_KW)  # unified in-process reference
+    try:
+        st = pool.stats()
+        assert [r["role"] for r in st["replicas"]] == \
+            [PREFILL, DECODE, DECODE]
+        assert st["disagg"]["prefill_replicas"] == 1
+        assert st["disagg"]["decode_replicas"] == 2
+
+        # warm every program the main window touches (handoff restore +
+        # suffix prefill + sampled/constrained variants) so the freeze
+        # window below is compile-free
+        warm = [pool.submit(_prompt(rng, n), max_new_tokens=4)
+                for n in (8, 16, 24) * 2]
+        warm.append(pool.submit(
+            _prompt(rng, 16), max_new_tokens=4,
+            sampling=SamplingParams(temperature=0.8, top_k=8, seed=1)))
+        warm.append(pool.submit(
+            _prompt(rng, 16), max_new_tokens=4, stop_token_id=3,
+            constraint=TrieConstraint([[5, 6]], vocab_size=VOCAB,
+                                      stop_token_id=3)))
+        for rr in warm:
+            pool.result(rr, timeout=180.0)
+        ws0 = pool.worker_stats()
+
+        # greedy: bit-for-bit vs the single-model reference
+        prompts = [_prompt(rng, n) for n in (8, 16, 24)]
+        rrs = [pool.submit(p, max_new_tokens=24) for p in prompts]
+        for p, rr in zip(prompts, rrs):
+            assert np.array_equal(pool.result(rr, timeout=180.0),
+                                  _ref(model, p, 24))
+            assert rr.reroutes == 0  # a handoff is NOT a failure reroute
+
+        # sampled-seeded: the per-position key schedule makes the stream
+        # reproducible across the prefill->decode process boundary
+        sp = SamplingParams(temperature=0.8, top_k=8, seed=7)
+        p = _prompt(rng, 16)
+        rr = pool.submit(p, max_new_tokens=16, sampling=sp)
+        ref = api.result(api.submit(p, max_new_tokens=16, sampling=sp),
+                         timeout=120.0)
+        assert np.array_equal(pool.result(rr, timeout=180.0), ref)
+
+        # constrained: the automaton rides the handoff with the request
+        c = TrieConstraint([[5, 6], [7, 8, 9]], vocab_size=VOCAB,
+                           stop_token_id=3)
+        p = _prompt(rng, 16)
+        rr = pool.submit(p, max_new_tokens=8, stop_token_id=3,
+                         constraint=c)
+        ref = api.result(api.submit(p, max_new_tokens=8, stop_token_id=3,
+                                    constraint=c), timeout=120.0)
+        assert np.array_equal(pool.result(rr, timeout=180.0), ref)
+
+        # compile counters FROZE across every handoff + prefetch above
+        ws1 = pool.worker_stats()
+        for key in ("serving.decode_compiles", "serving.prefill_compiles",
+                    "serving.cow_compiles", "serving.restore_compiles"):
+            for i in ws0:
+                assert ws1[i]["metrics"].get(key, 0) == \
+                    ws0[i]["metrics"].get(key, 0), (i, key)
+
+        # every stream crossed the pools: prefill side published, decode
+        # side restored the published chain instead of re-prefilling it
+        assert serving_metrics.stats().get("disagg.handoffs", 0) > h0
+        assert _metric(pool, 0, "tier.published_blocks") > 0
+        assert sum(_metric(pool, i, "tier.restored_blocks")
+                   for i in (1, 2)) > 0
+        assert sum(_metric(pool, i, "tokens.prefill_avoided")
+                   for i in (1, 2)) > 0
+    finally:
+        api.close()
+        pool.close()
+
+
+# ------------------------------------------------------------- chaos: kill -9
+
+
+def test_prefill_kill_reprefills_only_unpublished_suffix(model, flag_guard):
+    # tiny chunks -> many scheduler iterations per prefill -> a wide
+    # window where the chain is PARTIALLY published when the kill lands
+    core_flags.set_flags({"serving_chunked_prefill": 8,
+                          "serving_telemetry": True})
+    ej0 = resilience._counts.get("disagg.prefill_ejections", 0)
+    rng = np.random.default_rng(1)
+    pool = _mk_disagg(prefill=2, decode=1)
+    try:
+        warm = [pool.submit(_prompt(rng, n), max_new_tokens=2)
+                for n in (8, 64) * 2]
+        for rr in warm:
+            pool.result(rr, timeout=180.0)
+        # per-worker publish baseline AFTER warm: the kill trigger must
+        # fire on blocks published for THIS batch, not warm leftovers
+        pub0 = {i: _metric(pool, i, "tier.published_blocks")
+                for i in (0, 1)}
+
+        prompts = [_prompt(rng, 64) for _ in range(8)]
+        rrs = [pool.submit(p, max_new_tokens=8) for p in prompts]
+
+        # kill a prefill worker as soon as it has published a partial
+        # chain (chunked prefill publishes block-by-block)
+        victim = None
+        deadline = time.monotonic() + 60.0
+        while victim is None and time.monotonic() < deadline:
+            ws = pool.worker_stats()
+            for i in (0, 1):
+                snap = ws.get(i, {})
+                if (snap.get("outstanding", 0) > 0
+                        and snap.get("metrics", {}).get(
+                            "tier.published_blocks", 0)
+                        >= pub0.get(i, 0) + 2):
+                    victim = snap
+                    break
+            time.sleep(0.001)
+        assert victim is not None, "no prefill worker caught mid-publish"
+        os.kill(victim["pid"], signal.SIGKILL)
+
+        outs = [pool.result(rr, timeout=180.0) for rr in rrs]
+        for p, o in zip(prompts, outs):
+            assert np.array_equal(o, _ref(model, p, 8))
+        assert any(rr.reroutes >= 1 for rr in rrs)
+
+        # the successor walked the dead worker's PUBLISHED chain out of
+        # the shared tier instead of re-prefilling from token zero
+        assert sum(_metric(pool, i, "tokens.prefill_avoided")
+                   for i in (0, 1)) > 0
+        assert resilience._counts.get("disagg.prefill_ejections", 0) > ej0
+
+        # one contiguous span timeline per stream, reroutes included
+        for rr in rrs:
+            kinds = [ev["event"] for ev in telemetry.trace(rr.trace_id)]
+            assert kinds.count(telemetry.SUBMITTED) == 1
+            assert kinds[-1] == telemetry.FINISHED
+    finally:
+        pool.close()
+
+
+def test_decode_kill_restores_same_hashes(model, flag_guard):
+    core_flags.set_flags({"serving_telemetry": True})
+    ej0 = resilience._counts.get("disagg.decode_ejections", 0)
+    rng = np.random.default_rng(2)
+    pool = _mk_disagg(prefill=1, decode=2)
+    try:
+        warm = [pool.submit(_prompt(rng, n), max_new_tokens=4)
+                for n in (16, 24) * 2]
+        for rr in warm:
+            pool.result(rr, timeout=180.0)
+
+        prompts = [_prompt(rng, n) for n in (16, 24)]
+        rrs = [pool.submit(p, max_new_tokens=48) for p in prompts]
+        deadline = time.monotonic() + 60.0
+        while (any(len(rr.tokens()) < 4 for rr in rrs)
+               and time.monotonic() < deadline):
+            time.sleep(0.002)  # mid-decode on the decode side
+        assert all(len(rr.tokens()) >= 4 for rr in rrs)
+
+        # SIGKILL whichever decode worker holds streams right now; the
+        # restore assertion watches the SURVIVOR only (the victim's
+        # respawn resets its counters, so fleet-wide sums can go DOWN
+        # across a kill even when the survivor restored the chain)
+        ws = pool.worker_stats()
+        victim = max((1, 2), key=lambda i: ws[i].get("outstanding", 0))
+        survivor = 1 if victim == 2 else 2
+        assert ws[victim].get("outstanding", 0) > 0
+        restored0 = _metric(pool, survivor, "tier.restored_blocks")
+        os.kill(ws[victim]["pid"], signal.SIGKILL)
+
+        outs = [pool.result(rr, timeout=180.0) for rr in rrs]
+        for p, o in zip(prompts, outs):
+            assert np.array_equal(o, _ref(model, p, 48))
+        assert any(rr.reroutes >= 1 for rr in rrs)
+        assert resilience._counts.get("disagg.decode_ejections", 0) > ej0
+
+        # the successor re-restored the SAME published content hashes
+        # (prompt chain) rather than re-prefilling the whole context
+        assert _metric(pool, survivor, "tier.restored_blocks") > restored0
+
+        for rr in rrs:
+            kinds = [ev["event"] for ev in telemetry.trace(rr.trace_id)]
+            assert kinds.count(telemetry.SUBMITTED) == 1
+            assert kinds[-1] == telemetry.FINISHED
+    finally:
+        pool.close()
+
+
+# --------------------------------------------------- degrade + per-role scale
+
+
+def test_scale_to_zero_prefill_degrades_to_unified(model):
+    rng = np.random.default_rng(3)
+    pool = _mk_disagg(prefill=1, decode=1)
+    try:
+        warm = pool.submit(_prompt(rng), max_new_tokens=4)
+        pool.result(warm, timeout=180.0)
+        with pytest.raises(ValueError):
+            pool.scale_to(2, prefill=1)  # plain and per-role conflict
+
+        d0 = serving_metrics.stats().get("disagg.degraded_routes", 0)
+        pool.scale_to(prefill=0)
+        deadline = time.monotonic() + 30.0
+        while (pool.stats()["disagg"]["prefill_healthy"] > 0
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        assert pool.stats()["disagg"]["prefill_healthy"] == 0
+
+        # the pool keeps serving: requests route to the decode worker
+        # end-to-end (no prefill pool to hand off from)
+        prompts = [_prompt(rng, n) for n in (8, 16)]
+        rrs = [pool.submit(p, max_new_tokens=12) for p in prompts]
+        for p, rr in zip(prompts, rrs):
+            assert np.array_equal(pool.result(rr, timeout=180.0),
+                                  _ref(model, p, 12))
+        assert serving_metrics.stats().get("disagg.degraded_routes",
+                                           0) > d0
+    finally:
+        pool.close()
+
+
+# ------------------------------------------------ prefetch admission headroom
+
+
+def test_prefetch_never_starves_admission(model, flag_guard):
+    # two engines sharing one disk dir = the disagg publish/restore pair
+    # in-process: A publishes a chain, B prefetches it — and the bound
+    # must keep grantable() (what admission can claim) UNCHANGED
+    import tempfile
+
+    disk = tempfile.mkdtemp(prefix="paddle_tpu_test_disagg_kv_")
+    core_flags.set_flags({"serving_prefix_cache": True,
+                          "serving_kv_tiering": True,
+                          "serving_disk_cache_dir": disk,
+                          "serving_tier_publish": True})
+    from paddle_tpu.serving.tiered import HostKVCache
+
+    prompt = np.arange(48, dtype=np.int32) % VOCAB
+    other = (np.arange(40, dtype=np.int32) * 7 + 1) % VOCAB
+    pub = ServingAPI(model, tier_store=HostKVCache(disk_dir=disk),
+                     **POOL_KW)
+    try:
+        pub.result(pub.submit(prompt, max_new_tokens=2), timeout=120.0)
+        pub.result(pub.submit(other, max_new_tokens=2), timeout=120.0)
+        assert pub.engine.tier.store.disk is not None
+    finally:
+        pub.close()
+
+    sub = ServingAPI(model, tier_store=HostKVCache(disk_dir=disk),
+                     **POOL_KW)
+    try:
+        eng = sub.engine
+        g0 = eng.arena.grantable()
+        restored = eng.prefetch(prompt, trace_id="t-prefetch")
+        assert restored > 0  # the published chain came back from disk
+        # restore-ahead converts free blocks into EVICTABLE cached blocks
+        assert eng.arena.grantable() == g0
+        assert eng.prefetch(prompt) == 0  # idempotent: chain resident
+
+        # with zero free-above-evictable headroom prefetch declines
+        # instead of evicting warmer prefixes or starving admission —
+        # even though `other`'s chain IS restorable from the shared disk
+        # (a reservation is a CLAIM against grantable, not an allocation:
+        # blocks_free() is untouched, the free-above-evictable headroom
+        # prefetch bounds on is what hits zero)
+        res = eng.arena.reserve(eng.arena.blocks_free())
+        assert (eng.arena.grantable()
+                - eng.prefix_cache.evictable_blocks()) <= 0
+        assert eng.prefetch(other) == 0
+        res.release()
+    finally:
+        sub.close()
+
+
+def test_admit_sizing_counts_journal_restore_not_cow(model, flag_guard):
+    core_flags.set_flags({"serving_prefix_cache": True})
+    api = ServingAPI(model, **POOL_KW)
+    try:
+        eng = api.engine
+        prompt = (np.arange(32, dtype=np.int32) * 3 + 5) % VOCAB
+        api.result(api.submit(prompt, max_new_tokens=2), timeout=120.0)
+        # a handed-off admission re-prefills ONLY its journal suffix: a
+        # fully-cached block-aligned prompt + 1 journal token means the
+        # first generated position lands in a FRESH block, so the COW
+        # charge for writing into the matched tail must disappear
+        need_plain, _ = eng.admit_sizing(len(prompt), 8, prompt=prompt)
+        need_journal, _ = eng.admit_sizing(len(prompt), 8, prompt=prompt,
+                                           journal_len=1)
+        assert need_journal == need_plain - 1
+    finally:
+        api.close()
+
+
+# ------------------------------------------------------------ grammar unit
+
+
+def _table(strings):
+    return {i: s for i, s in enumerate(strings)}
+
+
+def _walk_dfa(dfa, tokens, stop):
+    """Feed ``tokens`` through the automaton; True iff every one was
+    allowed in its state AND the stream may end (stop allowed) after."""
+    state = dfa.initial()
+    for t in tokens:
+        if not dfa.allowed(state)[t]:
+            return False
+        state = dfa.advance(state, t)
+    return bool(dfa.allowed(state)[stop])
+
+
+def _accepts(pattern, table, tokens, stop=99):
+    dfa = TokenDFA.from_regex(pattern, table, vocab_size=100,
+                              stop_token_id=stop)
+    return _walk_dfa(dfa, tokens, stop)
+
+
+def test_from_regex_acceptance():
+    table = _table(["0", "1", "2", "-", "9", "a"])
+    pat = r"-?(0|[1-9][0-9]*)"
+    assert _accepts(pat, table, [0])            # "0"
+    assert _accepts(pat, table, [3, 4, 1])      # "-91"
+    assert _accepts(pat, table, [2, 0, 0])      # "200"
+    assert not _accepts(pat, table, [0, 0])     # "00" leading zero
+    assert not _accepts(pat, table, [3])        # bare "-"
+    assert not _accepts(pat, table, [5])        # "a"
+
+
+def test_from_regex_multichar_tokens():
+    # multi-character tokens must follow the CHAR automaton end-to-end
+    table = _table(["ab", "c", "abc", "b"])
+    dfa = TokenDFA.from_regex("abc", table, vocab_size=10,
+                              stop_token_id=9)
+    s0 = dfa.initial()
+    assert set(np.flatnonzero(dfa.allowed(s0))) == {0, 2}  # "ab" | "abc"
+    after_ab = dfa.advance(s0, 0)
+    assert set(np.flatnonzero(dfa.allowed(after_ab))) == {1}  # only "c"
+
+
+def test_from_regex_unrealizable_and_dead_ends():
+    with pytest.raises(ValueError, match="unrealizable"):
+        TokenDFA.from_regex("z+", _table(["a", "b"]), vocab_size=10,
+                            stop_token_id=9)
+    with pytest.raises(ValueError):
+        TokenDFA.from_regex("a+", _table(["a"]), vocab_size=10,
+                            stop_token_id=None)  # stop id is mandatory
+    # co-reachability pruning guarantees no reachable dead end survives:
+    # every live state either accepts or has an outgoing edge
+    dfa = TokenDFA.from_regex("(ab|a)b*", _table(["a", "b"]),
+                              vocab_size=10, stop_token_id=9)
+    frontier, seen = [dfa.initial()], {dfa.initial()}
+    while frontier:
+        s = frontier.pop()
+        mask = dfa.allowed(s)
+        moves = set(np.flatnonzero(mask)) - {9}
+        assert moves or mask[9]
+        for t in moves:
+            n = dfa.advance(s, t)
+            if n not in seen:
+                seen.add(n)
+                frontier.append(n)
+
+
+def test_from_regex_parse_errors():
+    table = _table(["a"])
+    for bad in ("(a", "a)", "[a", "[z-a]", "*a", "a**"):
+        with pytest.raises(ValueError):
+            TokenDFA.from_regex(bad, table, vocab_size=10,
+                                stop_token_id=9)
+
+
+def test_from_json_schema_shapes():
+    table = _table(list('{}[]",:0123456789-truefalsnxb "') + ["ab"])
+    dfa = TokenDFA.from_json_schema(
+        {"type": "object",
+         "properties": {"a": {"type": "integer"},
+                        "b": {"enum": ["x", True, None]}}},
+        table, vocab_size=100, stop_token_id=99)
+    by_char = {s: i for i, s in table.items() if len(s) == 1}
+
+    def accepts(text):
+        return _walk_dfa(dfa, [by_char[ch] for ch in text], 99)
+
+    assert accepts('{"a":42,"b":"x"}')
+    assert accepts('{"a":-7,"b":true}')
+    assert accepts('{"a":0,"b":null}')
+    assert not accepts('{"a":42}')          # missing required property
+    assert not accepts('{"a":007,"b":"x"}')  # leading zeros
+
+
+def test_gateway_grammar_body(model):
+    import json
+    import urllib.request
+
+    from paddle_tpu.serving.gateway import Gateway, ReplicaPool
+
+    table = {0: "{", 1: "}", 2: '"', 3: "a", 4: ":", 5: "1", 6: "2"}
+    pool = ReplicaPool(model, replicas=1, background=True, **POOL_KW)
+    gw = Gateway(pool, port=0).start()
+    try:
+        base = f"http://127.0.0.1:{gw.port}"
+        body = json.dumps({
+            "prompt": [1, 2, 3], "max_new_tokens": 16,
+            "stop_token_id": 9,
+            "grammar": {"regex": '\\{"a":(1|2)\\}',
+                        "token_table": {str(k): v
+                                        for k, v in table.items()}},
+        }).encode()
+        req = urllib.request.Request(base + "/v1/submit", data=body,
+                                     headers={"Content-Type":
+                                              "application/json"})
+        sub = json.loads(urllib.request.urlopen(
+            req, timeout=60).read().decode())
+        res = json.loads(urllib.request.urlopen(
+            base + f"/v1/result/{sub['request_id']}?timeout=120",
+            timeout=120).read().decode())
+        text = "".join(table[t] for t in res["tokens"] if t != 9)
+        import re
+        assert re.fullmatch('\\{"a":(1|2)\\}', text), text
+
+        # grammar + choices is a client error, not a 500
+        bad = json.dumps({"prompt": [1], "choices": [[5]],
+                          "grammar": {"regex": "a",
+                                      "token_table": {"3": "a"}}}).encode()
+        breq = urllib.request.Request(base + "/v1/submit", data=bad,
+                                      headers={"Content-Type":
+                                               "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(breq, timeout=30)
+        assert ei.value.code == 400
+    finally:
+        gw.close()
+        pool.close()

@@ -119,6 +119,15 @@ FIXTURE_CASES = [
     # popped lock-free in the reader loop (a strand-the-caller race)
     ("traced-cast", "compiled_worker", ()),
     ("unguarded-mutation", "concurrency_worker", ()),
+    # the ISSUE 19 disagg shapes: (a) restore-ahead prefetch deciding
+    # published-chain residency INSIDE the compiled restore — a traced
+    # branch on the residency mask plus a host int() of the traced chain
+    # length (the planner's radix walk is host-side; the restore must
+    # stay the one shared scatter); (b) the handoff claim-and-flip done
+    # lock-free while the pump/watchdog movers race on the same FINISH
+    ("traced-branch", "compiled_disagg", ()),
+    ("traced-cast", "compiled_disagg", ()),
+    ("unguarded-mutation", "concurrency_disagg", ()),
     ("broad-except", "hygiene_broad_except", ()),
 ]
 
@@ -176,6 +185,10 @@ def test_bad_fixtures_are_specific():
             # deliberately seeds BOTH SPMD-kernel hazards: host-cast of
             # the traced axis degree + the head-count branch it feeds
             allowed |= {"traced-cast", "traced-branch"}
+        if stem == "compiled_disagg":
+            # deliberately seeds BOTH prefetch-restore hazards: traced
+            # residency branch + host int() of the traced chain length
+            allowed |= {"traced-branch", "traced-cast"}
         assert rules <= allowed, (stem, rules)
 
 

@@ -89,6 +89,15 @@ never a hung handle), plus the per-worker end-of-run gauges
 ``worker.<i>.restarts`` — a healthy fleet shows every heartbeat age far
 under ``FLAGS_gateway_heartbeat_interval * FLAGS_gateway_heartbeat_misses``
 and restart counts flat after warmup.
+Disaggregated prefill/decode serving (``FLAGS_gateway_prefill_replicas``
+/ ``FLAGS_gateway_decode_replicas``, ``serving.disagg``) adds the
+``disagg.*`` namespace: ``disagg.handoffs`` (prefill → decode moves) /
+``disagg.prefill_routes`` / ``disagg.decode_routes`` /
+``disagg.degraded_routes`` (a role pool was empty and the request ran
+unified), the restore-ahead planner's ``disagg.prefetches`` /
+``disagg.prefetched_chains`` / ``disagg.prefetched_blocks``, and the
+publish side's ``tier.published_blocks`` (full KV blocks write-through-
+published to the shared disk tier during chunked prefill).
 The observability plane (ISSUE 17, docs/observability.md) adds the
 ``latency.*`` histograms (ttft, inter_token, queue_wait, prefill,
 decode_step, restore, e2e, ... — recorded host-side around compiled
@@ -180,6 +189,13 @@ def _config_report() -> dict:
                                                 0.2),
         "gateway_heartbeat_misses": _flag_env("gateway_heartbeat_misses", 3),
         "gateway_worker_timeout": _flag_env("gateway_worker_timeout", 10.0),
+        # disaggregated prefill/decode serving (serving.disagg; both role
+        # counts > 0 turns the process fleet into a DisaggReplicaPool)
+        "gateway_prefill_replicas": _flag_env("gateway_prefill_replicas", 0),
+        "gateway_decode_replicas": _flag_env("gateway_decode_replicas", 0),
+        "gateway_prefetch": _flag_env("gateway_prefetch", 0),
+        "serving_tier_publish": _flag_env("serving_tier_publish", 0),
+        "serving_publish_chunks": _flag_env("serving_publish_chunks", 0),
     }
 
 
@@ -231,7 +247,7 @@ def main(argv=None) -> int:
                                          "gateway", "tenant", "sampling",
                                          "constrain", "lora", "kernel",
                                          "mesh", "tier", "telemetry",
-                                         "serving", "worker")}
+                                         "serving", "worker", "disagg")}
         # latency histograms recorded during the run (ISSUE 17): the same
         # per-run delta discipline as the counters, rendered as percentiles
         hists = telemetry.histograms_delta(hists_before)
