@@ -59,6 +59,28 @@ re-route, and the survivor absorbing the load reuse warm programs).
 Persisted under ``"gateway"`` in ``BENCH_SERVING.json``.
 Env: GATEWAY_DURATION (arrival window seconds, default 6), GATEWAY_SEED.
 
+``--gateway-crash`` runs the crash-safe-gateway chaos bench (ISSUE 20,
+``serving.gateway.wal`` / docs/robustness.md "Gateway crash recovery"):
+a real WAL-backed gateway process (``wal_harness``) is SIGKILL'd
+mid-stream under offered load, a second incarnation boots on the same
+``--wal-dir``, and the bench measures recovery-to-ready wall time (the
+process-spawn -> ``/healthz`` ok window: model build + journal replay)
+plus the WAL's submit-path cost (p50 of ``pool.submit()`` on the same
+in-process pool, journal off vs on). Gates (asserted, not just
+reported): 100% of the accepted streams complete after the crash,
+token-for-token identical to ``generate()`` references; the resumed
+``?offset=N`` client sees no duplicate and no gap across the restart;
+the recovered incarnation's decode/prefill compile counters are FROZEN
+once every recovered stream has finished (replay and re-reads mint no
+programs, read over HTTP via ``/v1/stats``); and WAL-on p50 submit
+latency stays within 10% of WAL-off — with a 50us absolute floor for
+tiny-model runs where the entire submit is ~150us — because the
+ACCEPTED record is a buffered append: fsync rides the pump's batched
+commit, never the accept path.
+Persisted under ``"gateway_crash"``. Env: GWCRASH_STREAMS (default 6),
+GWCRASH_NEW (tokens per stream, default 32), GWCRASH_LAT_SAMPLES
+(submit-latency samples per build, default 200), GWCRASH_SEED.
+
 ``--process-replicas`` runs the process-isolated fleet chaos bench
 (ISSUE 18): a 2-worker ``serving.gateway.ProcessReplicaPool`` — real OS
 processes behind the RPC handles — with a mid-run ``kill -9`` of worker
@@ -1901,6 +1923,286 @@ def run_gateway(model, platform):
         f.write("\n")
 
 
+def run_gateway_crash(platform):
+    """Crash-safe-gateway chaos bench (ISSUE 20): SIGKILL a WAL-backed
+    gateway PROCESS mid-stream, boot a second incarnation on the same
+    journal, and measure recovery-to-ready plus the WAL's submit-path
+    overhead. See the module docstring for the gates; they are asserted
+    here (the bench fails loudly instead of persisting a silently-broken
+    record)."""
+    import shutil
+    import signal
+    import subprocess
+    import tempfile
+    import urllib.error
+    import urllib.request
+
+    import paddle_tpu as paddle
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.models.gpt import GPTForCausalLM, gpt_tiny
+    from paddle_tpu.serving.gateway.router import ReplicaPool
+    from paddle_tpu.serving.gateway.wal import GatewayWAL
+
+    n_streams = int(os.environ.get("GWCRASH_STREAMS", "6"))
+    new_tokens = int(os.environ.get("GWCRASH_NEW", "32"))
+    lat_samples = int(os.environ.get("GWCRASH_LAT_SAMPLES", "200"))
+    seed = int(os.environ.get("GWCRASH_SEED", "0"))
+    repo = os.path.abspath(os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), ".."))
+
+    # the harness seeds paddle.seed(0) before building gpt_tiny, so an
+    # in-process twin has bit-identical weights: greedy generate() is the
+    # parity reference for every stream the crash interrupts
+    paddle.seed(0)
+    ref_model = GPTForCausalLM(gpt_tiny())
+    ref_model.eval()
+    vocab = ref_model.cfg.vocab_size
+    rng = np.random.default_rng(seed)
+    prompts = [rng.integers(0, vocab, (int(rng.choice((6, 8, 10))),),
+                            dtype=np.int32) for _ in range(n_streams)]
+    refs = []
+    for p in prompts:
+        out = np.asarray(ref_model.generate(
+            Tensor(np.asarray(p)[None]), max_new_tokens=new_tokens)._data)[0]
+        refs.append([int(t) for t in out[len(p):]])
+
+    def _get(url, timeout=60):
+        return json.load(urllib.request.urlopen(url, timeout=timeout))
+
+    def _post(base, body, timeout=120):
+        req = urllib.request.Request(
+            base + "/v1/submit", data=json.dumps(body).encode(),
+            method="POST")
+        return json.load(urllib.request.urlopen(req, timeout=timeout))
+
+    def _read_sse(url, timeout=180, stop_after=None):
+        toks, done = [], None
+        try:
+            with urllib.request.urlopen(url, timeout=timeout) as resp:
+                event = None
+                for line in resp:
+                    line = line.decode().strip()
+                    if line.startswith("event:"):
+                        event = line.split(":", 1)[1].strip()
+                    elif line.startswith("data:"):
+                        d = json.loads(line.split(":", 1)[1])
+                        if event == "done":
+                            done = d
+                        else:
+                            toks.append(d["token"])
+                        event = None
+                    if stop_after is not None and len(toks) >= stop_after:
+                        break
+        except (OSError, urllib.error.URLError):
+            if stop_after is None:
+                raise
+        return toks, done
+
+    def _boot(wal_dir):
+        env = dict(os.environ, PYTHONPATH=repo)
+        env.setdefault("JAX_PLATFORMS", platform)
+        proc = subprocess.Popen(
+            [sys.executable, "-m",
+             "paddle_tpu.serving.gateway.wal_harness",
+             "--wal-dir", wal_dir],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            cwd=repo, env=env, text=True)
+        line = proc.stdout.readline()
+        assert line, "harness died before announcing its port"
+        info = json.loads(line)
+        return proc, f"http://127.0.0.1:{info['port']}", info["pid"]
+
+    def _kill(proc):
+        if proc is None:
+            return
+        if proc.poll() is None:
+            proc.kill()
+        proc.wait(timeout=30)
+        if proc.stdout is not None:
+            proc.stdout.close()
+
+    def _wait_ready(base, deadline_s=300):
+        statuses, deadline = [], time.time() + deadline_s
+        while True:
+            try:
+                h = _get(base + "/healthz", timeout=10)
+            except urllib.error.HTTPError as e:
+                h = json.load(e)
+            statuses.append(h["status"])
+            if h["status"] == "ok":
+                return statuses
+            assert time.time() < deadline, \
+                f"gateway never became ready: {statuses[-5:]}"
+            time.sleep(0.02)
+
+    root = tempfile.mkdtemp(prefix="bench-gwcrash-")
+    try:
+        # ---- WAL submit-path overhead -------------------------------
+        # p50 of pool.submit() wall time on an idle in-process pool,
+        # journal off vs on — the ACCEPTED record is a buffered append
+        # (fsync rides the pump's batched commit), so the accept path
+        # must stay within 10% of the non-durable build. Each sample
+        # drains to completion before the next submit: this measures
+        # the accept path, not queue backpressure.
+        lat_prompts = [rng.integers(0, vocab, (8,), dtype=np.int32)
+                       for _ in range(4)]
+
+        def _submit_p50(wal_dir):
+            wal = GatewayWAL(wal_dir) if wal_dir else None
+            # FOREGROUND pool: submit() is the identical code path the
+            # background build runs, but with no engine thread to
+            # convolve GIL handoffs into the timed section — the sample
+            # measures the accept path, deterministically
+            pool = ReplicaPool(ref_model, replicas=1, wal=wal,
+                               num_slots=4, kv_block_size=8,
+                               max_model_len=64)
+            lat = []
+            try:
+                for i in range(lat_samples + 16):
+                    p = lat_prompts[i % len(lat_prompts)]
+                    t0 = time.perf_counter()
+                    rr = pool.submit(p, max_new_tokens=2)
+                    dt = time.perf_counter() - t0
+                    pool.run_until_idle()
+                    if i >= 16:  # the first few pay compiles/warmup
+                        lat.append(dt)
+            finally:
+                pool.close()
+            return _percentile(lat, 50)
+
+        # interleaved rounds, min-of-round-p50s per build: a single long
+        # round is exposed to slow drift (page cache, sibling load on a
+        # shared host) that would otherwise masquerade as WAL overhead
+        offs, ons = [], []
+        for r in range(2):
+            offs.append(_submit_p50(None))
+            ons.append(_submit_p50(os.path.join(root, f"wal-lat{r}")))
+        p50_off, p50_on = min(offs), min(ons)
+
+        # ---- the crash ----------------------------------------------
+        d = os.path.join(root, "wal")
+        t_cold = time.perf_counter()
+        proc1, base1, pid1 = _boot(d)
+        seen = []
+        try:
+            _wait_ready(base1)
+            cold_boot = time.perf_counter() - t_cold
+            for i, p in enumerate(prompts):
+                sub = _post(base1, {"prompt": p.tolist(),
+                                    "max_new_tokens": new_tokens,
+                                    "request_id": f"bc{i:02d}"})
+                assert sub["request_id"] == f"bc{i:02d}"
+            # stream a prefix of stream 0 — the pre-crash client's
+            # position — then pull the plug mid-decode (kill -9: no
+            # drain, no atexit, torn tail and all)
+            seen, _ = _read_sse(base1 + "/v1/stream/bc00", stop_after=4)
+            assert 4 <= len(seen) < len(refs[0]), \
+                "the kill must land mid-stream (raise GWCRASH_NEW)"
+            t_kill = time.perf_counter()
+            os.kill(pid1, signal.SIGKILL)
+            proc1.wait(timeout=60)
+        finally:
+            _kill(proc1)
+
+        proc2 = None
+        try:
+            t_spawn = time.perf_counter()
+            proc2, base2, _pid2 = _boot(d)
+            statuses = _wait_ready(base2)
+            t_ready = time.perf_counter()
+            recovery_secs = t_ready - t_spawn
+            outage_secs = t_ready - t_kill
+
+            # exactly-once resume: offset=N picks up exactly where the
+            # dead connection left this client — no dup, no gap, even
+            # for tokens that outran the journal's fsync (recovery
+            # regenerates them deterministically)
+            toks, done = _read_sse(
+                base2 + f"/v1/stream/bc00?offset={len(seen)}")
+            assert seen + toks == refs[0], "resumed stream lost parity"
+            assert done["state"] == "FINISHED"
+
+            # 100% accepted-stream completion, token-for-token
+            completed = 0
+            for i, ref in enumerate(refs):
+                r = _get(base2 + f"/v1/result/bc{i:02d}?timeout=180",
+                         timeout=200)
+                assert r["state"] == "FINISHED", \
+                    f"bc{i:02d} did not complete: {r['state']}"
+                assert r["tokens"] == ref, f"bc{i:02d} lost parity"
+                completed += 1
+            st1 = _get(base2 + "/v1/stats", timeout=30)
+
+            # compile counters froze once the recovered streams
+            # finished: a full re-read of every stream and result
+            # mints nothing (replay reuses every compiled program)
+            toks2, _ = _read_sse(base2 + "/v1/stream/bc00?offset=0")
+            assert toks2 == refs[0]
+            for i in range(n_streams):
+                _get(base2 + f"/v1/result/bc{i:02d}", timeout=30)
+            st2 = _get(base2 + "/v1/stats", timeout=30)
+            for key in ("serving.decode_compiles",
+                        "serving.prefill_compiles"):
+                assert st2["compile"].get(key, 0) \
+                    == st1["compile"].get(key, 0), \
+                    f"{key} grew after recovery completed"
+            recovered = int(st2["serving"].get("gateway.recovered", 0))
+            replayed = int(st2["serving"].get("wal.replayed", 0))
+            walst = st2["pool"].get("wal", {})
+        finally:
+            _kill(proc2)
+
+        # the submit-path gate: the WAL's accept cost is ONE buffered
+        # append (serialize + frame + buffer write, measured ~25us — the
+        # fsync is batched off-path by design). At serving scale submit
+        # is ms-class and the 10% relative contract binds; at gpt_tiny
+        # scale the whole submit is ~150us, so a 50us absolute floor
+        # keeps the gate above this host's scheduler jitter while still
+        # failing the regression class that matters — an fsync landing
+        # back on the accept path costs 100us+ and trips either term
+        assert p50_on - p50_off <= max(0.10 * p50_off, 50e-6), (
+            f"WAL-on p50 submit latency {p50_on * 1e6:.0f}us vs WAL-off "
+            f"{p50_off * 1e6:.0f}us: regression exceeds both the 10% "
+            f"relative and the 50us absolute budget")
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+    rec = {
+        "bench": "serving_gateway_crash",
+        "metric": f"gateway SIGKILL recovery to ready "
+                  f"(WAL replay, {platform})",
+        "value": round(recovery_secs, 3),
+        "unit": "seconds",
+        "platform": platform,
+        "streams": n_streams,
+        "new_tokens": new_tokens,
+        "cold_boot_secs": round(cold_boot, 2),
+        "recovery_to_ready_secs": round(recovery_secs, 3),
+        "outage_secs": round(outage_secs, 2),
+        "saw_recovering": "recovering" in statuses,
+        "resumed_prefix_tokens": len(seen),
+        "streams_completed": completed,
+        "parity_checked": completed,
+        "recovered_live_streams": recovered,
+        "wal_records_replayed": replayed,
+        "results_cached": int(walst.get("results_cached", 0)),
+        "compiles_post_recovery": 0,  # asserted frozen above
+        "submit_p50_us_wal_off": round(p50_off * 1e6, 1),
+        "submit_p50_us_wal_on": round(p50_on * 1e6, 1),
+        "submit_p50_overhead_frac": round(p50_on / p50_off - 1.0, 4),
+        "submit_latency_samples": lat_samples,
+    }
+    print(f"# gateway-crash: recovery {rec['value']}s to ready "
+          f"(outage {rec['outage_secs']}s, cold boot "
+          f"{rec['cold_boot_secs']}s), {completed}/{n_streams} streams "
+          f"completed (parity ok), resumed at offset "
+          f"{len(seen)} (no dup/no gap), submit p50 "
+          f"{rec['submit_p50_us_wal_off']}us -> "
+          f"{rec['submit_p50_us_wal_on']}us "
+          f"({rec['submit_p50_overhead_frac']:+.1%})", flush=True)
+    _persist("gateway_crash", rec)
+
+
 def _procpool_worker_model():
     """Worker-process model factory: module-level so the spawn payload
     pickles it BY REFERENCE (the child rebuilds the model inside its own
@@ -2354,6 +2656,11 @@ def main():
     if "--disagg" in sys.argv:
         # both fleets build their models inside the worker processes
         run_disagg(platform)
+        return
+    if "--gateway-crash" in sys.argv:
+        # the harness subprocess builds its own model; the parent only
+        # holds the seeded reference twin (built inside the bench)
+        run_gateway_crash(platform)
         return
     if "--gateway" in sys.argv:
         cfg = (GPTConfig(vocab_size=50304, hidden_size=768, num_layers=12,

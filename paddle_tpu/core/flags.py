@@ -420,6 +420,28 @@ define_flag("serving_publish_chunks", False,
             "only the unpublished suffix). Requires "
             "FLAGS_serving_prefix_cache; no effect without chunked "
             "prefill.")
+define_flag("gateway_wal", False,
+            "Gateway write-ahead request log (serving.gateway.wal, "
+            "ISSUE 20): journal every accepted stream's lifecycle "
+            "(ACCEPTED / EMITTED deltas / REROUTE-HANDOFF moves / "
+            "TERMINAL) to FLAGS_gateway_wal_dir so a SIGKILLed gateway "
+            "restarted on the same directory replays it — live streams "
+            "resubmit journal-seeded (token-identical, zero new compiled "
+            "programs), terminal ids serve from a bounded result cache. "
+            "Off (default) keeps the gateway bit-for-bit WAL-free.")
+define_flag("gateway_wal_dir", "",
+            "Directory of the gateway WAL's segment files "
+            "(wal-<seq>.log). Required when FLAGS_gateway_wal is on; a "
+            "restarted gateway pointed at the same directory recovers "
+            "the previous incarnation's accepted streams.")
+define_flag("gateway_wal_segment_bytes", 1 << 20,
+            "Rotate the gateway WAL's active segment once it exceeds "
+            "this many bytes; sealed segments are deleted (compacted) "
+            "once every request recorded in them is terminal.")
+define_flag("gateway_wal_results", 256,
+            "How many terminal results the gateway WAL keeps replayable "
+            "(the bounded cache /v1/result serves from across a "
+            "restart); older results are forgotten by compaction.")
 
 # ---- Resilience: retry / sentinel / fault injection (core.resilience) ----
 define_flag("io_retries", 3,

@@ -99,6 +99,13 @@ DOCUMENTED_NAMESPACES = (
     # worker deaths, the resilience-plane view of the role-typed fleet
     # (routing/handoff/prefetch counters live in serving.metrics)
     "disagg",
+    # gateway write-ahead request log (ISSUE 20, serving.gateway.wal /
+    # docs/robustness.md "Gateway crash recovery"): wal.torn_tail — a
+    # segment whose unfsynced tail tore across the crash (replay
+    # truncated at the last good record) is a recovery event the shared
+    # dashboards must see; the full wal.* picture lives in
+    # serving.metrics
+    "wal",
 )
 
 
@@ -435,7 +442,12 @@ _env_faults_loaded = False
 #: classify and recover from (docs/robustness.md "Process isolation").
 KNOWN_FAULTS = ("ckpt_io", "nonfinite_grads", "preempt", "serving_step",
                 "serving_device", "arena_corrupt",
-                "worker_kill", "worker_hang")
+                "worker_kill", "worker_hang",
+                # gateway_kill (ISSUE 20): SIGKILL the gateway PARENT at
+                # its WAL-sweep boundary — the chaos probe behind the
+                # crash-safe-gateway e2e (restart on the same WAL dir,
+                # token-identical journal-seeded resumption)
+                "gateway_kill")
 
 #: kinds whose probe sites are bare statements (they only react to an
 #: exception), so a flag-style fault would silently exercise nothing —

@@ -179,6 +179,10 @@ class DisaggReplicaPool(ProcessReplicaPool):
                            request_id=rr.request_id,
                            from_replica=rr._replica_idx,
                            journal_tokens=len(journal))
+            # journal the phase flip: a WAL replay must resubmit this
+            # stream into its DECODE phase (restore the published chain),
+            # never re-prefill it from scratch
+            self._wal_moved(rr, "HANDOFF")
             metrics.bump("disagg.handoffs")
             try:
                 self._route(rr, journal=journal)

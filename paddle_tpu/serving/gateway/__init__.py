@@ -36,6 +36,8 @@ _LAZY = {
     "WorkerHandle": ("procpool", "WorkerHandle"),
     "WorkerDiedError": ("procpool", "WorkerDiedError"),
     "WorkerProtocolError": ("procpool", "WorkerProtocolError"),
+    "GatewayWAL": ("wal", "GatewayWAL"),
+    "DuplicateRequestError": ("gateway", "DuplicateRequestError"),
 }
 
 __all__ = list(_LAZY)

@@ -22,18 +22,25 @@ the client's contract with its model):
   ``serving.constrain.TokenDFA`` via ``TokenDFA.from_regex`` /
   ``from_json_schema``, so clients ship a pattern instead of a
   pre-lowered automaton. Mutually exclusive with ``choices``.
-* ``GET /v1/stream/<request_id>`` — Server-Sent Events: one
+* ``GET /v1/stream/<request_id>?offset=N`` — Server-Sent Events: one
   ``data: {"token": t}`` event per generated token (re-routes are invisible
   — the journal keeps the stream token-for-token), then
   ``event: done`` with the final state, or ``event: error`` with the error
-  taxonomy below.
+  taxonomy below. ``offset=N`` resumes from token N — the exactly-once
+  reattach contract: a client that saw N tokens before a disconnect (or a
+  gateway crash, with the WAL on) reattaches with ``offset=N`` and
+  observes no duplicate and no gap.
 * ``POST /v1/stream`` — submit + stream in one round trip (the streaming
   front door's main path; body as ``/v1/submit``).
 * ``POST /v1/cancel/<request_id>`` — flag the request; its slot frees at
   the next step boundary.
-* ``GET /healthz`` — ``{"status": "ok"|"draining", "replicas_healthy",
-  "replicas_total"}``; 503 while draining or with zero healthy replicas
-  (what a load balancer health-checks).
+* ``GET /healthz`` — READINESS: ``{"status": "ok"|"recovering"|
+  "draining"|"unhealthy", ...}``; 503 + ``Retry-After`` while WAL replay
+  or worker respawn is in flight, while draining, or with zero healthy
+  replicas — 200 only once routing is live (what a load balancer holds
+  traffic on).
+* ``GET /livez`` — LIVENESS: 200 while the process is up (including all
+  of recovery), 503 only once closed (what an orchestrator restarts on).
 * ``GET /v1/stats`` — pool + tenant snapshot next to the process-global
   ``serving.metrics`` counters.
 * ``GET /v1/metrics`` — the same picture in the Prometheus text
@@ -57,6 +64,10 @@ Error taxonomy → status codes (retriable errors carry ``Retry-After``):
 * :class:`core.resilience.RequestDrainedError` /
   :class:`~.router.NoHealthyReplicaError` → **503**
 * :class:`core.resilience.DeadlineExceededError` → **504**
+* :class:`DuplicateRequestError` (a ``request_id`` already in flight —
+  including one recovered from the WAL) → **409**; a resubmitted
+  TERMINAL id is NOT an error: the cached result is served with
+  ``"cached": true``
 * validation (``ValueError`` / bad JSON) → **400**; unknown id → **404**
 
 **Shutdown is a drain, not a kill**: :meth:`Gateway.install_preemption_guard`
@@ -89,6 +100,13 @@ _logger = logging.getLogger("paddle_tpu.serving.gateway")
 _REGISTRY_SOFT_CAP = 1024
 
 
+class DuplicateRequestError(ValueError):
+    """The client's ``request_id`` names a stream that is already in
+    flight (possibly accepted by the PREVIOUS gateway incarnation and
+    recovered from the WAL). 409 — the id is the conflict; a terminal
+    id is NOT a conflict (the cached result is served instead)."""
+
+
 def _status_for(exc: BaseException):
     """(http_status, retry_after_or_None) for the serving error taxonomy."""
     if isinstance(exc, resilience.QuotaExceededError):
@@ -100,6 +118,8 @@ def _status_for(exc: BaseException):
         return 503, 1.0
     if isinstance(exc, resilience.DeadlineExceededError):
         return 504, None
+    if isinstance(exc, DuplicateRequestError):
+        return 409, None  # before ValueError: a dup id is a conflict
     if isinstance(exc, (ValueError, KeyError, TypeError)):
         return 400, None
     return 500, None
@@ -127,6 +147,8 @@ class Gateway:
         self._guard_grace: Optional[float] = None
         self._lock = threading.Lock()
         self._requests = {}  # request_id -> RoutedRequest
+        self._results = {}   # request_id -> WAL-recovered terminal result
+        self._recovered_done = False  # one-shot once pool replay settles
         self._closed = False
 
     # ------------------------------------------------------------ lifecycle
@@ -194,17 +216,52 @@ class Gateway:
 
     # ------------------------------------------------------------- requests
 
+    def _sync_recovered(self) -> None:
+        """Fold the pool's WAL-recovered state into the HTTP registry:
+        resubmitted live streams join ``_requests`` (so duplicate-id
+        rejection and late ``/v1/stream`` attaches work across the
+        restart), replayed terminal results join the ``_results`` cache
+        ``/v1/result`` serves from. Lazy (called from the lookup paths)
+        and idempotent; keeps syncing while replay is still in flight."""
+        pool = self.pool
+        if self._recovered_done or getattr(pool, "wal", None) is None:
+            return
+        done = not pool.recovering  # read BEFORE the pull: the flag
+        # clearing after the pull could hide a late resubmission forever
+        live = pool.recovered_live()
+        results = pool.recovered_results()
+        with self._lock:
+            for rr in live:
+                self._requests.setdefault(rr.request_id, rr)
+            for rid, res in results.items():
+                self._results.setdefault(rid, res)
+            if done:
+                self._recovered_done = True
+
+    def _cached(self, request_id: str):
+        """The WAL-recovered terminal result for ``request_id``, if any
+        — what a client retrying across the crash gets instead of a
+        duplicate decode (exactly-once observable output)."""
+        if not request_id:
+            return None
+        self._sync_recovered()
+        with self._lock:
+            return self._results.get(request_id)
+
     def _submit(self, body: dict) -> RoutedRequest:
         if "prompt" not in body:
             raise ValueError("body must carry 'prompt': [token ids]")
         rid = str(body.get("request_id", ""))
         if rid:
+            self._sync_recovered()
             with self._lock:
                 prev = self._requests.get(rid)
             if prev is not None and not prev.finished:
                 # silently replacing the registry entry would make the
-                # first stream unreachable (and uncancellable) by id
-                raise ValueError(
+                # first stream unreachable (and uncancellable) by id —
+                # and across a WAL restart, a retried id must attach to
+                # the recovered stream, never start a second decode
+                raise DuplicateRequestError(
                     f"request_id {rid!r} is already in flight; pick a "
                     f"unique id or omit it for a generated one")
         prompt = np.asarray(body["prompt"], np.int32).reshape(-1)
@@ -263,6 +320,14 @@ class Gateway:
             else:
                 raise ValueError(
                     'grammar needs a "regex" or "json_schema" key')
+        # the constraint's serializable CLIENT spec rides into the WAL so
+        # a recovered stream rebuilds an identical walker (the compiled
+        # automaton itself is derived state, never journaled)
+        constraint_spec = None
+        if constraint is not None:
+            constraint_spec = {"choices": body.get("choices"),
+                               "grammar": body.get("grammar"),
+                               "stop_token_id": body.get("stop_token_id")}
         rr = self.pool.submit(
             prompt,
             max_new_tokens=int(body.get("max_new_tokens", 32)),
@@ -276,7 +341,8 @@ class Gateway:
                       else int(body["priority"])),
             sampling=sampling, constraint=constraint,
             adapter=(None if body.get("adapter") is None
-                     else int(body["adapter"])))
+                     else int(body["adapter"])),
+            constraint_spec=constraint_spec)
         with self._lock:
             self._requests[rr.request_id] = rr
             if len(self._requests) > _REGISTRY_SOFT_CAP:
@@ -284,9 +350,21 @@ class Gateway:
                             if r.finished][:len(self._requests) // 2]:
                     del self._requests[rid]
         metrics.bump("gateway.http_submits")
+        # group-commit ack barrier: the HTTP response is the client's
+        # durability receipt, so the ACCEPTED record must be synced
+        # BEFORE it leaves. pool.submit() only buffers the append (the
+        # accept path never touches the disk) and the pump's batched
+        # commit can lag by a sweep interval — exactly the window a
+        # SIGKILL would erase an already-acknowledged stream in. The
+        # commit no-ops when a concurrent sweep already covered this
+        # append, so a submit burst coalesces into one sync.
+        wal = getattr(self.pool, "wal", None)
+        if wal is not None:
+            wal.commit()
         return rr
 
     def _get(self, request_id: str) -> Optional[RoutedRequest]:
+        self._sync_recovered()
         with self._lock:
             return self._requests.get(request_id)
 
@@ -346,6 +424,8 @@ def _make_handler(gw: Gateway):
             try:
                 if parsed.path == "/healthz":
                     return self._healthz()
+                if parsed.path == "/livez":
+                    return self._livez()
                 if parsed.path == "/v1/stats":
                     return self._stats()
                 if parsed.path == "/v1/metrics":
@@ -354,16 +434,34 @@ def _make_handler(gw: Gateway):
                     return self._trace(self._tail("/v1/trace/", parsed))
                 if parsed.path.startswith("/v1/stream"):
                     rid = self._tail("/v1/stream/", parsed)
+                    q = parse_qs(parsed.query)
+                    # ?offset=N: resume from a token offset — the
+                    # exactly-once reattach contract across re-routes AND
+                    # gateway restarts (no duplicate, no gap)
+                    offset = max(0, int((q.get("offset") or [0])[0]))
                     rr = gw._get(rid)
                     if rr is None:
+                        res = gw._cached(rid)
+                        if res is not None:
+                            return self._sse_cached(rid, res, offset)
                         return self._json(
                             404, {"error": "NotFound",
                                   "message": f"unknown request {rid!r}"})
-                    return self._sse(rr)
+                    return self._sse(rr, offset=offset)
                 if parsed.path.startswith("/v1/result"):
                     rid = self._tail("/v1/result/", parsed)
                     rr = gw._get(rid)
                     if rr is None:
+                        res = gw._cached(rid)
+                        if res is not None:
+                            # recovered-terminal id: the WAL-backed cache
+                            # (tokens only — the prompt died with the old
+                            # process; the journal carries the stream)
+                            return self._json(200, {
+                                "request_id": rid, "state": res["state"],
+                                "tokens": [int(t)
+                                           for t in res["tokens"]],
+                                "cached": True})
                         return self._json(
                             404, {"error": "NotFound",
                                   "message": f"unknown request {rid!r}"})
@@ -395,12 +493,27 @@ def _make_handler(gw: Gateway):
             parsed = urlparse(self.path)
             try:
                 if parsed.path == "/v1/submit":
-                    rr = gw._submit(self._body())
+                    body = self._body()
+                    res = gw._cached(str(body.get("request_id", "")))
+                    if res is not None:
+                        # a retry of a TERMINAL id across the crash:
+                        # serve the recovered result, never decode twice
+                        return self._json(200, {
+                            "request_id": str(body["request_id"]),
+                            "state": res["state"],
+                            "tokens": [int(t) for t in res["tokens"]],
+                            "cached": True})
+                    rr = gw._submit(body)
                     return self._json(200, {"request_id": rr.request_id,
                                             "tenant": rr.tenant,
                                             "state": rr.state})
                 if parsed.path == "/v1/stream":
-                    rr = gw._submit(self._body())
+                    body = self._body()
+                    res = gw._cached(str(body.get("request_id", "")))
+                    if res is not None:
+                        return self._sse_cached(
+                            str(body["request_id"]), res)
+                    rr = gw._submit(body)
                     return self._sse(rr)
                 if parsed.path.startswith("/v1/cancel"):
                     rid = (self._tail("/v1/cancel/", parsed)
@@ -421,20 +534,49 @@ def _make_handler(gw: Gateway):
                 self._error(e)
 
         def _healthz(self):
+            # READINESS: 200 only once routing is live — 503 with a
+            # Retry-After while WAL replay / worker respawn is in flight
+            # (a half-recovered pool must not take load-balancer traffic;
+            # /livez is the liveness half)
+            gw._sync_recovered()
             stats = gw.pool.stats()
+            recovering = bool(stats.get("recovering"))
             ok = (not stats["draining"] and not gw._closed
-                  and stats["replicas_healthy"] > 0)
-            self._json(200 if ok else 503,
-                       {"status": "ok" if ok else "draining"
-                        if stats["draining"] else "unhealthy",
-                        "replicas_healthy": stats["replicas_healthy"],
-                        "replicas_total": stats["replicas_total"]},
+                  and not recovering and stats["replicas_healthy"] > 0)
+            status = ("ok" if ok else
+                      "recovering" if recovering else
+                      "draining" if stats["draining"] else "unhealthy")
+            payload = {"status": status,
+                       "replicas_healthy": stats["replicas_healthy"],
+                       "replicas_total": stats["replicas_total"]}
+            if "wal" in stats:
+                payload["wal"] = stats["wal"]
+            self._json(200 if ok else 503, payload,
                        retry_after=None if ok else 1.0)
 
+        def _livez(self):
+            # LIVENESS: the process is up and its listener answers — true
+            # throughout recovery; false only once the gateway is closed
+            # (an orchestrator restarts on liveness, holds traffic on
+            # readiness)
+            alive = not gw._closed
+            self._json(200 if alive else 503,
+                       {"status": "alive" if alive else "closed"},
+                       retry_after=None if alive else 1.0)
+
         def _stats(self):
+            from ...core import compile_cache
+
             snap = {k: v for k, v in metrics.stats().items()
                     if isinstance(v, (int, float)) and not isinstance(v, bool)}
-            self._json(200, {"pool": gw.pool.stats(), "serving": snap})
+            # THIS process's compile counters: the chaos/recovery drivers
+            # gate on decode_compiles frozen post-recovery over HTTP (for
+            # process workers the per-worker picture is in pool stats)
+            comp = {k: v for k, v in compile_cache.stats().items()
+                    if isinstance(v, (int, float))
+                    and not isinstance(v, bool)}
+            self._json(200, {"pool": gw.pool.stats(), "serving": snap,
+                             "compile": comp})
 
         def _metrics(self):
             body = telemetry.prometheus_text(pool=gw.pool).encode()
@@ -465,15 +607,40 @@ def _make_handler(gw: Gateway):
                              "enabled": telemetry.enabled(),
                              "events": events})
 
-        def _sse(self, rr: RoutedRequest) -> None:
+        def _sse_headers(self) -> None:
             self.send_response(200)
             self.send_header("Content-Type", "text/event-stream")
             self.send_header("Cache-Control", "no-cache")
             self.send_header("Connection", "close")
             self.end_headers()
             metrics.bump("gateway.http_streams")
+
+        def _sse_cached(self, rid: str, res: dict, offset: int = 0) -> None:
+            """SSE over a WAL-recovered terminal result: the remainder of
+            the stream past ``offset``, then the done frame — what a
+            client that was mid-stream at the crash reattaches to when
+            the stream already finished during/after recovery."""
+            self._sse_headers()
             try:
-                for tok in gw.pool.stream(rr):
+                for tok in res["tokens"][offset:]:
+                    self.wfile.write(
+                        b"data: " + json.dumps({"token": int(tok)}).encode()
+                        + b"\n\n")
+                self.wfile.write(
+                    b"event: done\ndata: " + json.dumps(
+                        {"state": res["state"],
+                         "tokens": len(res["tokens"]),
+                         "cached": True}).encode() + b"\n\n")
+                self.wfile.flush()
+            except OSError:
+                pass  # client left again: the result stays cached
+
+        def _sse(self, rr: RoutedRequest, offset: int = 0) -> None:
+            self._sse_headers()
+            try:
+                for i, tok in enumerate(gw.pool.stream(rr)):
+                    if i < offset:
+                        continue  # resume: the client already holds these
                     self.wfile.write(
                         b"data: " + json.dumps({"token": int(tok)}).encode()
                         + b"\n\n")
@@ -551,8 +718,17 @@ def serve(model, replicas: Optional[int] = None,
                 and int(flags.flag("gateway_decode_replicas")) > 0):
             from ..disagg import DisaggReplicaPool as pool_cls
             replicas = None  # role counts define the fleet
+    wal = pool_kw.pop("wal", None)
+    if wal is None and flags.flag("gateway_wal"):
+        # crash-safe gateway (ISSUE 20): open (and replay) the WAL before
+        # the pool exists — recovery runs off-thread inside the pool
+        # constructor, and /healthz answers 503-not-ready until the
+        # replayed streams are back on workers
+        from .wal import GatewayWAL
+
+        wal = GatewayWAL(str(flags.flag("gateway_wal_dir")))
     pool = pool_cls(model, replicas=replicas, tenants=tenants,
-                    background=True, **pool_kw)
+                    background=True, wal=wal, **pool_kw)
     gw = Gateway(pool, host=host, port=port).start()
     if guard:
         gw.install_preemption_guard()

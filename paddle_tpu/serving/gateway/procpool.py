@@ -690,6 +690,10 @@ class ProcessReplicaPool(ReplicaPool):
     boot takes seconds — it must not stall the survivors' token pumps),
     and guaranteed reaping."""
 
+    #: the watchdog loop already observes live streams and runs the WAL
+    #: sweep each supervision cycle — no separate sweeper thread
+    _wal_autosweep = False
+
     def __init__(self, model, replicas: Optional[int] = None,
                  config=None, tenants=None, background: bool = False,
                  affinity_slack: Optional[int] = None,
@@ -699,7 +703,8 @@ class ProcessReplicaPool(ReplicaPool):
                  heartbeat_interval: Optional[float] = None,
                  heartbeat_misses: Optional[int] = None,
                  worker_timeout: Optional[float] = None,
-                 boot_timeout: float = _BOOT_TIMEOUT, **engine_kw):
+                 boot_timeout: float = _BOOT_TIMEOUT, wal=None,
+                 **engine_kw):
         self._hb_interval = float(
             flags.flag("gateway_heartbeat_interval")
             if heartbeat_interval is None else heartbeat_interval)
@@ -726,12 +731,16 @@ class ProcessReplicaPool(ReplicaPool):
                 f"tier_store cannot cross; got: {e!r})") from e
         self._watchdog_stop = threading.Event()
         self._watchdog: Optional[threading.Thread] = None
+        # wal is an explicit pool-level kwarg on purpose: anything left in
+        # **engine_kw is pickled into the worker spawn payload, and a WAL
+        # (open file handle + locks) must never cross — it is gateway
+        # state, one per parent process
         super().__init__(model, replicas=replicas, config=config,
                          tenants=tenants, background=background,
                          affinity_slack=affinity_slack,
                          respawn_backoff=respawn_backoff,
                          max_reroutes=max_reroutes,
-                         max_queue=max_queue, **engine_kw)
+                         max_queue=max_queue, wal=wal, **engine_kw)
         _live_pools.add(self)
         if background:
             self._watchdog = threading.Thread(
@@ -918,6 +927,7 @@ class ProcessReplicaPool(ReplicaPool):
                 self._sweep_health()
                 self._poll_workers()
                 self._observe_live()
+                self._wal_sweep()
             # analysis: allow(broad-except) — the watchdog IS the
             # supervisor of last resort; any sweep failure must leave it
             # alive to classify the next death
@@ -937,6 +947,7 @@ class ProcessReplicaPool(ReplicaPool):
         self._sweep_health()
         self._poll_workers()
         self._observe_live()
+        self._wal_sweep()
 
     def _poll_workers(self) -> None:
         for rep in self.healthy_replicas():
@@ -1004,9 +1015,15 @@ class ProcessReplicaPool(ReplicaPool):
         return out
 
     def close(self) -> None:
+        # ordering contract (satellite 2, atexit included — _reap_at_exit
+        # funnels here): super().close() runs drain(0) FIRST, whose final
+        # _wal_sweep(final=True) writes + fsyncs every TERMINAL record
+        # BEFORE any worker handle is closed or reaped — a clean shutdown
+        # never leaves live-looking records for the next incarnation to
+        # resurrect. Only then are workers shut down and reaped.
         if self._closed:
             return
-        super().close()  # drain(0) + per-replica handle.close() (reaps)
+        super().close()  # drain(0) + WAL terminal sweep, then handle closes
         self._watchdog_stop.set()
         w = self._watchdog
         if w is not None and w is not threading.current_thread():

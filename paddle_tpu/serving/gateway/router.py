@@ -54,6 +54,8 @@ from __future__ import annotations
 
 import itertools
 import logging
+import os
+import signal
 import threading
 import time
 from typing import Callable, Dict, List, Optional, Sequence
@@ -254,6 +256,14 @@ class RoutedRequest:
         self._released = False          # tenant release happened exactly once
         self._cancelled = False         # survives re-routes (backend _cancel
         self._rerouting = False         # does not); one re-route at a time
+        # WAL bookkeeping (ISSUE 20): how many of this stream's tokens the
+        # gateway WAL has journaled, under its own lock — the sweep (pump
+        # thread) and the finalize tail write (any consumer thread) must
+        # never journal the same delta twice
+        self._wal_lock = threading.Lock()
+        self._wal_logged = 0
+        self._wal_accepted = False      # ACCEPTED record durably appended
+        self._wal_terminal = False      # TERMINAL record written exactly once
 
     # ------------------------------------------------------------- reading
 
@@ -345,13 +355,18 @@ class ReplicaPool:
     HTTP gateway runs on); ``background=False`` keeps pumping in the
     consumer's thread — deterministic, what the tests and bench drive."""
 
+    #: WAL'd background pools run a dedicated observe+commit sweeper
+    #: thread; subclasses with their own supervision loop (the process
+    #: pools' watchdog) turn this off and sweep from there instead
+    _wal_autosweep = True
+
     def __init__(self, model, replicas: Optional[int] = None,
                  config=None, tenants: Optional[TenantManager] = None,
                  background: bool = False,
                  affinity_slack: Optional[int] = None,
                  respawn_backoff: Optional[float] = None,
                  max_reroutes: Optional[int] = None,
-                 max_queue: Optional[int] = None, **engine_kw):
+                 max_queue: Optional[int] = None, wal=None, **engine_kw):
         n = int(flags.flag("serving_replicas")
                 if replicas is None else replicas)
         if n < 1:
@@ -376,6 +391,15 @@ class ReplicaPool:
                               if max_reroutes is None else int(max_reroutes))
         self._background = bool(background)
         self._lock = threading.RLock()
+        # gateway write-ahead request log (ISSUE 20): set BEFORE replicas
+        # spawn so every later path may read self.wal; recovery itself is
+        # kicked off at the END of construction, once routing exists
+        self.wal = wal
+        self._recovering = wal is not None
+        self._recovered: List[RoutedRequest] = []
+        self._recovered_results: Dict[str, dict] = {}
+        self._wal_sweep_lock = threading.Lock()
+        self._wal_last_sweep = 0.0
         # the shared cross-replica residency index (ISSUE 15): every
         # replica's prefix cache publishes insert/evict/spill deltas here;
         # routing reads it instead of probing private trees. Engines with
@@ -398,6 +422,31 @@ class ReplicaPool:
         self.drain_count = 0
         self._reap_tick = 0
         self._refresh_gauges()
+        if wal is not None:
+            # replay the previous incarnation's accepted streams: live
+            # requests resubmit journal-seeded, terminal ids fill the
+            # recovered-result cache. Background pools (the HTTP gateway)
+            # recover off-thread so construction returns fast — /healthz
+            # reports 503-not-ready until _recovering clears (the
+            # liveness/readiness split); foreground pools recover inline
+            # (tests/benches see a fully replayed pool on return).
+            if self._background:
+                threading.Thread(target=self._wal_recover,
+                                 name="gateway-wal-recover",
+                                 daemon=True).start()
+            else:
+                self._wal_recover()
+            if self._background and self._wal_autosweep:
+                # a background in-process pool has no pump thread of its
+                # own (each replica's engine pumps itself; consumers
+                # drive observe from their wait loops) — but durability
+                # must not depend on a client blocking in stream():
+                # this sweeper is the WAL's commit heartbeat. The
+                # process pools override _wal_autosweep off — their
+                # watchdog already observes live streams and sweeps.
+                threading.Thread(target=self._wal_sweeper_loop,
+                                 name="gateway-wal-sweep",
+                                 daemon=True).start()
 
     def _spawn_api(self, idx: int) -> ServingAPI:
         api = ServingAPI(self._factory(), **self._api_kw)
@@ -466,13 +515,17 @@ class ReplicaPool:
                request_id: str = "",
                priority: Optional[int] = None,
                sampling=None, constraint=None,
-               adapter: Optional[int] = None) -> RoutedRequest:
+               adapter: Optional[int] = None,
+               constraint_spec: Optional[dict] = None) -> RoutedRequest:
         """Admit one stream through the tenant gates and route it to a
         replica. ``priority=None`` takes the tenant's configured class —
         as do ``sampling`` (the tenant's default SamplingParams) and
         ``adapter`` (the tenant's configured LoRA row: every tenant gets
         its own fine-tune on the shared base weights). ``constraint`` is
-        always per-request (a ``serving.constrain`` walker).
+        always per-request (a ``serving.constrain`` walker);
+        ``constraint_spec`` is its serializable client spec (the gateway
+        body's ``choices``/``grammar``), journaled by the WAL so a
+        recovered stream can rebuild an identical walker.
         Raises :class:`core.resilience.QuotaExceededError` (tenant gates,
         retriable with ``retry_after``),
         :class:`core.resilience.QueueOverloadError` (every routable replica
@@ -536,6 +589,18 @@ class ReplicaPool:
             self.tenants.release(tenant, failed=True)
             self.tenants.refund(tenant, int(max_new_tokens))
             raise
+        if self.wal is not None:
+            # durably ACCEPTED only after routing succeeded: a shed
+            # request must not be resurrected by replay. The append only
+            # buffers — in-process callers hold an unacknowledged handle
+            # until the next batched commit, and the HTTP front door
+            # syncs it via the ack barrier BEFORE the 200 leaves
+            # (gateway._submit), so an acknowledged client always finds
+            # its stream after a crash. The sweep skips un-accepted
+            # handles, so no EMITTED record can ever precede its
+            # ACCEPTED in the log.
+            self.wal.accepted(rr, constraint_spec)
+            rr._wal_accepted = True
         metrics.bump("gateway.routed")
         return rr
 
@@ -741,6 +806,7 @@ class ReplicaPool:
                        request_id=rr.request_id, reroute=rr.reroutes,
                        from_replica=rr._replica_idx,
                        journal_tokens=len(journal))
+        self._wal_moved(rr, "REROUTE")
         try:
             self._route(rr, journal=journal)
         except Exception as e:  # analysis: allow(broad-except) — any
@@ -870,6 +936,7 @@ class ReplicaPool:
     def _finalize(self, rr: RoutedRequest, state: str,
                   error: Optional[BaseException] = None) -> None:
         rr._finalize(state, error)
+        self._wal_finalize(rr)
         with self._lock:
             bucket = self._live.get(rr._replica_idx)
             if bucket is not None and rr in bucket:
@@ -883,6 +950,200 @@ class ReplicaPool:
                 failed=state != RequestState.FINISHED)
         self._refresh_gauges()
 
+    # ----------------------------------------------------------------- wal
+
+    def _wal_moved(self, rr: RoutedRequest, kind: str) -> None:
+        if self.wal is not None and rr._wal_accepted:
+            self.wal.moved(rr.request_id, kind)
+
+    def _wal_emit(self, rr: RoutedRequest) -> None:
+        """Journal one stream's new tokens since the last sweep (one
+        EMITTED delta per stream per pump iteration, not per token)."""
+        wal = self.wal
+        if wal is None or not rr._wal_accepted:
+            return
+        with rr._wal_lock:
+            if rr._wal_terminal:
+                return
+            new = rr.tokens_from(rr._wal_logged)
+            if new:
+                wal.emitted(rr.request_id, new)
+                rr._wal_logged += len(new)
+
+    def _wal_finalize(self, rr: RoutedRequest) -> None:
+        """Journal the TERMINAL record exactly once: the token tail past
+        the last EMITTED delta plus the full stream for the bounded
+        result cache."""
+        wal = self.wal
+        if wal is None or not rr._wal_accepted:
+            return
+        with rr._wal_lock:
+            if rr._wal_terminal:
+                return
+            rr._wal_terminal = True
+            tail = rr.tokens_from(rr._wal_logged)
+            rr._wal_logged += len(tail)
+            wal.terminal(rr.request_id, rr.state, tail, rr.tokens())
+
+    def _wal_sweep(self, final: bool = False) -> None:
+        """One WAL pump iteration: journal every live stream's token
+        delta, then ONE batched flush+fsync (``commit``, which also
+        rotates/compacts segments). Throttled and contended-skip — many
+        consumer threads drive ``_pump`` concurrently on a background
+        pool, and per-token fsyncs would put disk latency on the submit
+        path. ``final=True`` (drain/close) always runs to completion.
+        Doubles as the ``gateway_kill`` chaos site: the probe SIGKILLs
+        THIS process at the sweep boundary — exactly the torn-tail
+        crash point the replay discipline is built for."""
+        wal = self.wal
+        if wal is None:
+            return
+        if resilience.maybe_fault("gateway_kill"):
+            os.kill(os.getpid(), signal.SIGKILL)
+        if not self._wal_sweep_lock.acquire(blocking=final):
+            return  # another thread is mid-sweep: its commit covers us
+        try:
+            now = time.monotonic()
+            if not final and now - self._wal_last_sweep < 0.01:
+                return
+            self._wal_last_sweep = now
+            with self._lock:
+                live = [rr for bucket in self._live.values()
+                        for rr in bucket]
+            for rr in live:
+                self._wal_emit(rr)
+            wal.commit()
+        finally:
+            self._wal_sweep_lock.release()
+
+    def _wal_sweeper_loop(self) -> None:
+        """Background WAL heartbeat: reconcile every live stream with its
+        backend (so finished streams get their TERMINAL record even with
+        no consumer polling) and run one batched sweep+commit. Exits on
+        drain/close — ``drain()`` runs the final sweep itself."""
+        while True:
+            with self._lock:
+                if self._closed or self._draining:
+                    return
+                live = [rr for bucket in self._live.values()
+                        for rr in bucket]
+            for rr in live:
+                self._observe(rr)
+            self._wal_sweep()
+            time.sleep(0.005)
+
+    def _wal_recover(self) -> None:
+        """Replay the WAL's recovered state into this pool: live streams
+        resubmit journal-seeded (the existing ``_route(journal=...,
+        shed=False)`` contract — token-identical, zero new compiled
+        programs), terminal ids fill the recovered-result cache the
+        gateway serves ``/v1/result`` from. Always clears
+        ``_recovering`` — readiness must flip even if replay fails."""
+        try:
+            state = self.wal.recover()
+            self._recovered_results = state["results"]
+            for rec in state["live"]:
+                try:
+                    self._resubmit_recovered(rec)
+                # analysis: allow(broad-except) — one unrecoverable
+                # stream (e.g. its adapter no longer registered) must
+                # not abort the replay of every other accepted stream
+                except Exception:
+                    _logger.exception("WAL recovery of %r failed",
+                                      rec.get("rid"))
+            if state["live"] or state["results"]:
+                _logger.info(
+                    "gateway WAL recovery: %d live stream(s) resubmitted "
+                    "journal-seeded, %d terminal result(s) cached",
+                    len(state["live"]), len(state["results"]))
+        finally:
+            self._recovering = False
+            self._refresh_gauges()
+
+    def _resubmit_recovered(self, rec: dict) -> None:
+        """Rebuild one WAL-live stream and re-route it with its journal.
+        The recorded request keeps its id, trace, pinned sampling seed,
+        rebuilt constraint walker, and disagg phase; tenant accounting is
+        re-charged (rebuilding the buckets the crash destroyed) — a
+        recovery-time quota shed keeps the stream alive uncharged rather
+        than dropping an already-accepted request."""
+        rid = rec["rid"]
+        sampling = None
+        if rec.get("samp"):
+            from ..sampling import SamplingParams
+
+            sampling = SamplingParams(**rec["samp"])
+        constraint = None
+        if rec.get("cspec"):
+            from .wal import build_constraint
+
+            constraint = build_constraint(rec["cspec"], self.vocab_size())
+        charged = True
+        try:
+            self.tenants.admit(rec["tenant"], int(rec["mnt"]),
+                               outstanding=self.outstanding(),
+                               capacity=self.capacity())
+        except resilience.QuotaExceededError:
+            charged = False
+        rr = RoutedRequest(self, np.asarray(rec["prompt"], np.int32),
+                           int(rec["mnt"]), rec.get("stop"),
+                           rec["tenant"], int(rec.get("prio", 0)),
+                           resilience.Deadline.after(None), rid,
+                           sampling=sampling, constraint=constraint,
+                           adapter=int(rec.get("adapter", 0)))
+        if rec.get("tid"):
+            rr.trace_id = rec["tid"]  # one timeline across the crash
+        toks = [int(t) for t in rec.get("toks", ())]
+        rr._base = list(toks)
+        rr._wal_logged = len(toks)  # the WAL already holds these tokens
+        rr._wal_accepted = True     # ...and the ACCEPTED record
+        if not charged:
+            rr._released = True     # never charged -> never released
+        if rec.get("phase") == "decode":
+            rr._disagg_phase = "decode"  # restore, don't re-prefill
+        telemetry.span(rr.trace_id, telemetry.RECOVERED,
+                       request_id=rid, tenant=rr.tenant,
+                       journal_tokens=len(toks))
+        metrics.bump("gateway.recovered")
+        with self._lock:
+            self._recovered.append(rr)
+        stop = rr.stop_token_id
+        if (len(toks) >= rr.max_new_tokens
+                or (stop is not None and toks and toks[-1] == stop)):
+            # the journal already completes the stream: the crash landed
+            # between the final token and its TERMINAL record
+            self._finalize(rr, RequestState.FINISHED)
+            return
+        try:
+            # an explicit (possibly empty) journal list: shed=False — a
+            # recovered stream was already accepted once and must not
+            # re-enter admission shedding
+            self._route(rr, journal=list(toks))
+        except Exception as e:  # analysis: allow(broad-except) — any
+            # placement failure must finalize the handle (done_event
+            # fired, WAL terminal written), never strand it bucketless
+            self._finalize(rr, RequestState.FAILED, e)
+
+    def recovered_live(self) -> List[RoutedRequest]:
+        """Streams the WAL replay resubmitted (live and since-finished) —
+        the gateway folds these into its id registry so duplicate-id
+        rejection and /v1/stream attach work across the restart."""
+        with self._lock:
+            return list(self._recovered)
+
+    def recovered_results(self) -> Dict[str, dict]:
+        """Terminal results replayed from the WAL: ``{request_id:
+        {"state", "tokens"}}`` — the exactly-once ``/v1/result`` cache."""
+        return dict(self._recovered_results)
+
+    @property
+    def recovering(self) -> bool:
+        """True while WAL replay / recovered-stream resubmission is in
+        flight — the gateway's readiness gate (503 + Retry-After)."""
+        return self._recovering
+
+    # ------------------------------------------------------------ pumping
+
     def pump_once(self) -> None:
         """Foreground event loop: one guarded scheduler step on every
         routable replica with work. A step that surfaces a crash-loop /
@@ -893,6 +1154,7 @@ class ReplicaPool:
         self._maybe_respawn()
         for rep in self.healthy_replicas():
             self._pump_replica(rep)
+        self._wal_sweep()
 
     def _pump_replica(self, rep: _Replica) -> None:
         """One guarded foreground step on a single replica (the chaos
@@ -916,6 +1178,7 @@ class ReplicaPool:
         if self._background:
             self._maybe_respawn()
             self._sweep_health()
+            self._wal_sweep()
             time.sleep(0.001)
         else:
             self.pump_once()
@@ -1011,10 +1274,17 @@ class ReplicaPool:
                                    f"{reason}: request drained before "
                                    f"completion (grace={grace:g}s); safe "
                                    f"to resubmit"))
+        # the terminal sweep: every TERMINAL record written above reaches
+        # disk NOW — before close() tears anything else down (satellite 2:
+        # a clean shutdown never leaves live-looking records)
+        self._wal_sweep(final=True)
         self._refresh_gauges()
 
     def close(self) -> None:
-        """Drain with zero grace and close every replica. Idempotent."""
+        """Drain with zero grace and close every replica. Idempotent.
+        The drain's final WAL sweep (terminal records + fsync) runs
+        BEFORE any replica teardown; the WAL file handle itself closes
+        last, after every path that could still append is gone."""
         if self._closed:
             return
         self.drain(grace=0.0, reason="ReplicaPool is closed")
@@ -1026,6 +1296,8 @@ class ReplicaPool:
                 _logger.exception("closing replica %d failed", rep.idx)
         with self._lock:
             self._closed = True
+        if self.wal is not None:
+            self.wal.close()
 
     def scale_to(self, n: int, grace: Optional[float] = None) -> None:
         """Scale the pool down to ``n`` replicas through ``drain(grace)``:
@@ -1170,8 +1442,12 @@ class ReplicaPool:
                "capacity_slots": capacity,
                "outstanding": outstanding,
                "draining": self._draining,
+               "recovering": self._recovering,
                "radix_index": self.index.stats(),
                "tenants": self.tenants.stats()}
+        if self.wal is not None:
+            out["wal"] = self.wal.stats()
+            out["wal"]["recovered"] = len(self._recovered)
         # the shared spill-tier picture (ISSUE 15): replicas attach to one
         # HostKVCache, so reporting any live replica's store covers all
         if tier_store is not None:

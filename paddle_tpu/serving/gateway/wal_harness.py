@@ -1,0 +1,70 @@
+"""Subprocess gateway harness for the crash-safe-gateway chaos path.
+
+``python -m paddle_tpu.serving.gateway.wal_harness --wal-dir D`` boots a
+complete WAL-backed gateway — the deterministic ``gpt_tiny`` model
+(``paddle.seed(0)``: every incarnation's weights, and therefore greedy
+decodes, are identical), a ``background=True`` :class:`~.router.ReplicaPool`
+journaling to ``D``, the HTTP/SSE front door — then prints ONE JSON line
+``{"port": <bound port>, "pid": <pid>}`` to stdout and parks. The chaos
+test and ``bench_serving.py --gateway-crash`` drive it from outside:
+submit streams over HTTP, ``SIGKILL`` this process mid-stream (the real
+crash — no atexit, no drain), start a second harness on the SAME
+``--wal-dir``, and assert the recovered streams finish token-identical
+with ``/healthz`` flipping 503 → 200 around the replay.
+
+The process installs no preemption guard on purpose: its only exit paths
+are SIGKILL (the scenario under test) and SIGTERM (the driver's cleanup).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--wal-dir", required=True,
+                    help="gateway WAL directory (shared across "
+                         "incarnations — the crash-recovery contract)")
+    ap.add_argument("--replicas", type=int, default=1)
+    ap.add_argument("--port", type=int, default=0,
+                    help="0 = ephemeral (reported on stdout)")
+    ap.add_argument("--num-slots", type=int, default=4)
+    ap.add_argument("--kv-block-size", type=int, default=8)
+    ap.add_argument("--max-model-len", type=int, default=64)
+    ap.add_argument("--telemetry", action="store_true",
+                    help="enable span collection (RECOVERED timelines)")
+    args = ap.parse_args(argv)
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import GPTForCausalLM, gpt_tiny
+    from paddle_tpu.serving.gateway.gateway import Gateway
+    from paddle_tpu.serving.gateway.router import ReplicaPool
+    from paddle_tpu.serving.gateway.wal import GatewayWAL
+
+    if args.telemetry:
+        paddle.set_flags({"FLAGS_serving_telemetry": True})
+    paddle.seed(0)
+    model = GPTForCausalLM(gpt_tiny())
+    model.eval()
+    wal = GatewayWAL(args.wal_dir)
+    pool = ReplicaPool(model, replicas=args.replicas, background=True,
+                       wal=wal, num_slots=args.num_slots,
+                       kv_block_size=args.kv_block_size,
+                       max_model_len=args.max_model_len)
+    gw = Gateway(pool, port=args.port).start()
+    # the driver reads exactly one JSON line, then talks HTTP
+    print(json.dumps({"port": gw.port, "pid": os.getpid()}), flush=True)
+    try:
+        while True:
+            time.sleep(0.5)
+    except KeyboardInterrupt:
+        gw.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -159,6 +159,12 @@ DOCUMENTED_NAMESPACES = (
     # prefetches / prefetched_chains / prefetched_blocks
     # (serving.disagg, docs/serving.md "Disaggregated prefill/decode")
     "disagg",
+    # wal.* (ISSUE 20): the gateway write-ahead request log — records /
+    # accepted / emitted_tokens / terminals / commits / rotations /
+    # compactions / carried / torn_tail / replayed{,_live,_results}
+    # counters and segments / bytes gauges (serving.gateway.wal,
+    # docs/robustness.md "Gateway crash recovery")
+    "wal",
     "queue", "slots", "tokens_per_sec",
 )
 

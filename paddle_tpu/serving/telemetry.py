@@ -77,6 +77,7 @@ REROUTED = "REROUTED"            # gateway moved the stream to another replica
 RESTORED = "RESTORED"            # tier-restore scatter landed for this admit
 HANDOFF = "HANDOFF"              # prefill->decode pool handoff (disagg)
 PREFETCHED = "PREFETCHED"        # restore-ahead planner pre-restored the chain
+RECOVERED = "RECOVERED"          # WAL replay resubmitted the journaled stream
 DRAINED = "DRAINED"              # failed by a drain (retriable)
 FINISHED = "FINISHED"            # terminal: complete output delivered
 FAILED = "FAILED"                # terminal: error or cancellation
@@ -85,7 +86,7 @@ FAILED = "FAILED"                # terminal: error or cancellation
 #: order (docs/observability.md documents the expected sequences)
 SPAN_KINDS = (SUBMITTED, QUEUED, ADMITTED, PREFILL_CHUNK, FIRST_TOKEN,
               PREEMPTED, REPLAYED, REROUTED, RESTORED, HANDOFF, PREFETCHED,
-              DRAINED, FINISHED, FAILED)
+              RECOVERED, DRAINED, FINISHED, FAILED)
 
 
 def mint_trace_id() -> str:

@@ -1,0 +1,21 @@
+"""known-bad: WAL record serialization from INSIDE the compiled decode
+step -> traced-cast (x2).
+
+The gateway journal ships token deltas as JSON ints. Casting the traced
+new-token inside the jit'd step forces a device sync per token — and
+under trace the int lands in the record as a trace-time constant, so
+every crash replay resubmits the same frozen token. Journal appends
+belong AROUND the dispatch: the compiled step returns traced arrays,
+the WAL sweep host-casts the delta once per commit."""
+import jax
+import jax.numpy as jnp
+
+
+def decode_step(logits, slot, journal):
+    tok = jnp.argmax(logits[slot])
+    journal.append(int(tok))  # BAD: traced cast to build the WAL record
+    crc_seed = float(logits[slot, tok])  # BAD: traced value host-cast
+    return tok, crc_seed
+
+
+decode_step_jit = jax.jit(decode_step)

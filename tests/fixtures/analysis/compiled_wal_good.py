@@ -1,0 +1,22 @@
+"""known-good twin: the compiled step returns traced arrays only; the
+WAL sweep materializes the token delta outside the dispatch (one host
+sync per commit batch, not per token) and builds the journal record
+from host ints."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def decode_step(logits, slot):
+    tok = jnp.argmax(logits[slot])
+    return tok, logits[slot, tok]
+
+
+decode_step_jit = jax.jit(decode_step)
+
+
+def sweep(logits, slot, journal):
+    tok, score = decode_step_jit(logits, slot)
+    # host casts happen outside the compiled region: legal, one sync
+    journal.append({"toks": [int(np.asarray(tok))],
+                    "score": float(np.asarray(score))})

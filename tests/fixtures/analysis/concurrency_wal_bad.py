@@ -1,0 +1,39 @@
+"""known-bad: the WAL sweep's per-stream bookkeeping is advanced
+outside any lock scope while the finalizer reads/writes it under the
+stream lock -> unguarded-mutation.
+
+The race: the background sweep and the finalizer both journal the same
+stream. Without the lock around the ``logged`` high-water mark, the
+sweep can read ``logged=3``, the finalizer journals the terminal tail
+from 3 and marks the stream terminal, and THEN the sweep appends its
+stale EMITTED delta — the journal now carries the same tokens twice, so
+a replay resubmits a longer-than-real stream (exactly the duplication
+the exactly-once contract forbids)."""
+import threading
+
+
+class StreamJournal:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.logged = {}
+        self.terminal = {}
+
+    def accept(self, rid):
+        with self._lock:
+            self.logged[rid] = 0
+            self.terminal[rid] = False
+
+    def sweep(self, rid, tokens):
+        with self._lock:
+            done = self.terminal.get(rid)
+        if done:
+            return []
+        delta = tokens[self.logged[rid]:]
+        self.logged[rid] = len(tokens)   # BAD: racy high-water advance
+        return delta
+
+    def finalize(self, rid, tokens):
+        with self._lock:
+            tail = tokens[self.logged[rid]:]
+            self.terminal[rid] = True
+        return tail

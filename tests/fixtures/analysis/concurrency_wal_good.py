@@ -1,0 +1,32 @@
+"""known-good twin: the delta computation, the high-water advance, and
+the terminal check are ONE atomic section under the stream lock —
+whichever writer (background sweep or finalizer) runs first, the other
+sees the advanced mark, so no token is ever journaled twice and nothing
+lands after the terminal record."""
+import threading
+
+
+class StreamJournal:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.logged = {}
+        self.terminal = {}
+
+    def accept(self, rid):
+        with self._lock:
+            self.logged[rid] = 0
+            self.terminal[rid] = False
+
+    def sweep(self, rid, tokens):
+        with self._lock:
+            if self.terminal.get(rid):
+                return []
+            delta = tokens[self.logged[rid]:]
+            self.logged[rid] = len(tokens)
+        return delta
+
+    def finalize(self, rid, tokens):
+        with self._lock:
+            tail = tokens[self.logged[rid]:]
+            self.terminal[rid] = True
+        return tail

@@ -1,0 +1,582 @@
+"""Crash-safe gateway (ISSUE 20): the write-ahead request log
+(``serving.gateway.wal``), restart recovery, and the exactly-once client
+stream contract.
+
+Layers, cheapest first: WAL record framing round-trip + torn-tail
+truncation and segment rotation/compaction as pure file-format units (no
+engine); in-process crash recovery with token parity for greedy /
+seeded-sampled / constrained streams (a foreground pool abandoned
+WITHOUT close is the crash — same process, fresh incarnation on the same
+directory); the HTTP exactly-once surface across a restart (409 on a
+WAL-live duplicate id, cached results for terminal ids, ``?offset=``
+stream resume); the ``/healthz`` readiness-vs-``/livez`` liveness split
+while replay is in flight; the satellite-2 shutdown ordering regression
+(final WAL fsync strictly before worker reaping); and the real chaos
+e2e — ``wal_harness`` subprocess SIGKILL'd mid-stream, a second
+incarnation on the same WAL dir, token-for-token resumption with frozen
+compile counters.
+
+The in-process reference pools double as the ``FLAGS_gateway_wal=0``
+default-path check: every parity assertion compares a WAL'd stream
+against a WAL-less pool's output.
+"""
+import json
+import os
+import signal
+import struct
+import subprocess
+import sys
+import threading
+import time
+import types
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.models.gpt import GPTForCausalLM, gpt_tiny
+from paddle_tpu.serving import (
+    ReplicaPool,
+    RequestState,
+    SamplingParams,
+    TrieConstraint,
+    telemetry,
+)
+from paddle_tpu.serving import metrics as serving_metrics
+from paddle_tpu.serving.gateway import Gateway, GatewayWAL, ProcessReplicaPool
+
+pytestmark = [pytest.mark.serving, pytest.mark.gateway]
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+MAX_LEN = 64
+POOL_KW = dict(num_slots=4, kv_block_size=8, max_model_len=MAX_LEN)
+CHOICES = [[5, 6, 7], [5, 9]]
+
+
+def worker_model():
+    paddle.seed(0)
+    m = GPTForCausalLM(gpt_tiny())
+    m.eval()
+    return m
+
+
+@pytest.fixture(scope="module")
+def model():
+    return worker_model()
+
+
+def _prompt(rng, n):
+    return rng.integers(0, 1024, (n,), dtype=np.int32)
+
+
+def _ref(model, prompt, max_new, stop=None):
+    out = model.generate(Tensor(np.asarray(prompt)[None]),
+                         max_new_tokens=max_new, stop_token_id=stop)
+    return np.asarray(out._data)[0]
+
+
+def _rr(rid, prompt=(1, 2, 3), mnt=8):
+    """A minimal stand-in for ``RoutedRequest`` carrying exactly the
+    attributes ``GatewayWAL.accepted`` journals."""
+    return types.SimpleNamespace(
+        request_id=rid, tenant="default", prompt=list(prompt),
+        max_new_tokens=mnt, stop_token_id=None, priority=1, adapter=0,
+        sampling=None, trace_id=f"trace-{rid}")
+
+
+def _read_sse(url, timeout=180):
+    toks, done = [], None
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        event = None
+        for line in resp:
+            line = line.decode().strip()
+            if line.startswith("event:"):
+                event = line.split(":", 1)[1].strip()
+            elif line.startswith("data:"):
+                d = json.loads(line.split(":", 1)[1])
+                if event == "done":
+                    done = d
+                else:
+                    toks.append(d["token"])
+                event = None
+    return toks, done
+
+
+def _wait_ready(base, deadline_s=120):
+    """Poll ``/healthz`` until it reports ok; returns every status string
+    observed on the way (503 bodies included — readiness is data)."""
+    seen = []
+    deadline = time.time() + deadline_s
+    while True:
+        try:
+            h = json.load(urllib.request.urlopen(base + "/healthz",
+                                                 timeout=10))
+        except urllib.error.HTTPError as e:
+            h = json.load(e)
+        seen.append(h["status"])
+        if h["status"] == "ok":
+            return seen
+        assert time.time() < deadline, f"never became ready: {seen[-5:]}"
+        time.sleep(0.02)
+
+
+# --------------------------------------------------------- WAL file format
+
+
+def test_wal_roundtrip_and_torn_tail(tmp_path):
+    """Append → crash (no close) → replay folds records back; a torn tail
+    (half-written frame) truncates replay at the last good record and
+    bumps the torn-tail counter instead of raising."""
+    d = str(tmp_path / "wal")
+    w = GatewayWAL(d)
+    w.accepted(_rr("r1"), {"choices": CHOICES, "stop_token_id": 3})
+    w.emitted("r1", [10, 11])
+    w.moved("r1", "HANDOFF")
+    w.accepted(_rr("r2"))
+    w.emitted("r2", [20])
+    w.terminal("r2", "FINISHED", [30], [20, 30])
+    w.commit()
+    # crash: the process dies here — no close(), no final fsync beyond
+    # the committed batch
+
+    w2 = GatewayWAL(d)
+    rec = w2.recover()
+    assert [e["rid"] for e in rec["live"]] == ["r1"]
+    live = rec["live"][0]
+    assert live["toks"] == [10, 11]
+    assert live["phase"] == "decode"          # the HANDOFF move replayed
+    assert live["prompt"] == [1, 2, 3]
+    assert live["cspec"] == {"choices": CHOICES, "stop_token_id": 3}
+    assert live["tid"] == "trace-r1"
+    assert rec["results"]["r2"] == {"state": "FINISHED",
+                                    "tokens": [20, 30]}
+    # recover() is one-shot: the state was handed to exactly one pool
+    assert w2.recover()["live"] == []
+
+    # torn tail: a frame whose header promises more body than was ever
+    # written (the classic power-cut shape DiskTier also defends against)
+    with open(os.path.join(d, "wal-00000000.log"), "ab") as f:
+        f.write(struct.pack("<II", 40, 0) + b"short")
+    t0 = serving_metrics.stats().get("wal.torn_tail", 0)
+    rec3 = GatewayWAL(d).recover()
+    assert [e["rid"] for e in rec3["live"]] == ["r1"]
+    assert rec3["live"][0]["toks"] == [10, 11]
+    assert serving_metrics.stats().get("wal.torn_tail", 0) == t0 + 1
+
+
+def test_wal_rotation_and_compaction_carry_forward(tmp_path):
+    """With a 1-byte segment budget every commit rotates; a sealed
+    segment whose every stream is terminal is deleted with its results
+    carried forward, and a segment holding a live stream survives."""
+    d = str(tmp_path / "wal")
+    m0 = serving_metrics.stats()
+    w = GatewayWAL(d, segment_bytes=1, result_cap=8)
+    w.accepted(_rr("c1"))
+    w.emitted("c1", [1, 2])
+    w.terminal("c1", "FINISHED", [], [1, 2])
+    w.commit()  # seals segment 0; fully terminal → carried + deleted
+    assert not os.path.exists(os.path.join(d, "wal-00000000.log"))
+
+    w.accepted(_rr("c2"))
+    w.commit()  # seals the carry segment; c2 is live → it must survive
+    assert len([n for n in os.listdir(d) if n.startswith("wal-")]) == 2
+
+    w.terminal("c2", "FINISHED", [7], [7])
+    w.commit()  # everything terminal: only the active segment remains
+    assert len([n for n in os.listdir(d) if n.startswith("wal-")]) == 1
+    m1 = serving_metrics.stats()
+    assert m1.get("wal.rotations", 0) > m0.get("wal.rotations", 0)
+    assert m1.get("wal.compactions", 0) >= m0.get("wal.compactions", 0) + 2
+    assert m1.get("wal.carried", 0) > m0.get("wal.carried", 0)
+    assert w.stats()["segments"] == 1
+    w.close()
+
+    # the carried summaries replay: no live resurrections, results intact
+    rec = GatewayWAL(d).recover()
+    assert rec["live"] == []
+    assert rec["results"]["c1"]["tokens"] == [1, 2]
+    assert rec["results"]["c2"]["tokens"] == [7]
+
+
+# ------------------------------------------------- in-process recovery
+
+
+def test_pool_crash_recovery_token_parity(model, tmp_path):
+    """The tentpole invariant, in-process: a WAL'd foreground pool
+    abandoned mid-decode (no close — the crash) is rebuilt by a fresh
+    incarnation on the same directory, and every recovered stream
+    (greedy, seeded-sampled, constrained) finishes token-for-token
+    identical to a WAL-less reference pool. The journaled trace id keeps
+    ONE timeline across the restart, with a RECOVERED span at the seam."""
+    keep = paddle.get_flags(["serving_telemetry"])
+    paddle.set_flags({"serving_telemetry": True})
+    telemetry.reset_tracelog()
+    d = str(tmp_path / "wal")
+    pool2 = refpool = None
+    try:
+        rng = np.random.default_rng(11)
+        p1, p2, p3 = _prompt(rng, 8), _prompt(rng, 8), _prompt(rng, 5)
+        ref1 = _ref(model, p1, 8)
+
+        wal = GatewayWAL(d)
+        pool = ReplicaPool(model, replicas=1, wal=wal, **POOL_KW)
+        pool.submit(p1, max_new_tokens=8, request_id="r1")
+        pool.submit(p2, max_new_tokens=8, request_id="r2",
+                    sampling=SamplingParams(temperature=0.8, seed=42))
+        pool.submit(p3, max_new_tokens=8, stop_token_id=3, request_id="r3",
+                    constraint=TrieConstraint(
+                        CHOICES, vocab_size=pool.vocab_size(),
+                        stop_token_id=3),
+                    constraint_spec={"choices": CHOICES,
+                                     "stop_token_id": 3})
+        for _ in range(3):
+            pool.pump_once()  # partial: every stream is mid-flight
+        # crash: abandon the incarnation without close/drain
+
+        # the WAL-off reference (also the FLAGS_gateway_wal=0 default
+        # path): same model, same pinned seed, same constraint
+        refpool = ReplicaPool(model, replicas=1, **POOL_KW)
+        q2 = refpool.submit(p2, max_new_tokens=8,
+                            sampling=SamplingParams(temperature=0.8,
+                                                    seed=42))
+        q3 = refpool.submit(p3, max_new_tokens=8, stop_token_id=3,
+                            constraint=TrieConstraint(
+                                CHOICES, vocab_size=refpool.vocab_size(),
+                                stop_token_id=3))
+        refpool.run_until_idle()
+        ref2, ref3 = list(q2.tokens()), list(q3.tokens())
+
+        g0 = serving_metrics.stats().get("gateway.recovered", 0)
+        pool2 = ReplicaPool(model, replicas=1, wal=GatewayWAL(d), **POOL_KW)
+        assert not pool2.recovering  # foreground recovery is inline
+        rec = {rr.request_id: rr for rr in pool2.recovered_live()}
+        assert set(rec) == {"r1", "r2", "r3"}
+        assert serving_metrics.stats().get("gateway.recovered", 0) == g0 + 3
+        pool2.run_until_idle()
+
+        assert rec["r1"].state == RequestState.FINISHED
+        np.testing.assert_array_equal(
+            np.concatenate([p1, rec["r1"].tokens()]), ref1)
+        assert list(rec["r2"].tokens()) == ref2
+        assert list(rec["r3"].tokens()) == ref3
+        assert list(rec["r3"].tokens()) in ([5, 6, 7, 3], [5, 9, 3])
+
+        # one trace, both incarnations: a single SUBMITTED (from the
+        # first life), a RECOVERED span at the restart seam, and the
+        # journal-seeded resubmit never re-records FIRST_TOKEN
+        events = telemetry.trace(rec["r1"].trace_id)
+        kinds = [e["event"] for e in events]
+        assert kinds.count(telemetry.SUBMITTED) == 1
+        assert telemetry.RECOVERED in kinds
+        assert kinds.count(telemetry.FIRST_TOKEN) == 1
+        assert kinds.index(telemetry.RECOVERED) \
+            > kinds.index(telemetry.SUBMITTED)
+    finally:
+        if refpool is not None:
+            refpool.close()
+        if pool2 is not None:
+            pool2.close()
+        paddle.set_flags(keep)
+        telemetry.reset_tracelog()
+
+
+# ------------------------------------------------ HTTP exactly-once
+
+
+def test_http_restart_exactly_once(model, tmp_path):
+    """The client-visible contract across a restart: a WAL-live id
+    resubmitted to the new incarnation is a 409 (never a second decode),
+    a terminal id's result is served from the recovered cache with
+    ``cached: true``, and ``GET /v1/stream/<id>?offset=N`` resumes the
+    recovered stream with no duplicated and no missing token."""
+    d = str(tmp_path / "wal")
+    rng = np.random.default_rng(17)
+    p_done, p_live = _prompt(rng, 6), _prompt(rng, 6)
+    ref_done = [int(t) for t in _ref(model, p_done, 6)[6:]]
+    ref_live = [int(t) for t in _ref(model, p_live, 48)[6:]]
+
+    pool1 = ReplicaPool(model, replicas=1, wal=GatewayWAL(d), **POOL_KW)
+    done_rr = pool1.submit(p_done, max_new_tokens=6, request_id="dup-done")
+    pool1.run_until_idle()
+    assert done_rr.state == RequestState.FINISHED
+    live_rr = pool1.submit(p_live, max_new_tokens=48, request_id="dup-live")
+    for _ in range(4):
+        pool1.pump_once()
+    assert not live_rr.finished
+    prefix = [int(t) for t in live_rr.tokens()]
+    assert prefix  # the pre-crash client got a real prefix
+    # crash: abandon without close
+
+    pool2 = ReplicaPool(model, replicas=1, wal=GatewayWAL(d),
+                        background=True, **POOL_KW)
+    gw = Gateway(pool2, port=0).start()
+    base = f"http://127.0.0.1:{gw.port}"
+    try:
+        _wait_ready(base)
+
+        # the recovered stream is live again: a duplicate submit is 409
+        body = json.dumps({"prompt": p_live.tolist(), "max_new_tokens": 48,
+                           "request_id": "dup-live"}).encode()
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(urllib.request.Request(
+                base + "/v1/submit", data=body, method="POST"), timeout=60)
+        assert ei.value.code == 409
+
+        # offset resume: the client skips the prefix it already has and
+        # sees exactly the remainder — no dup, no gap
+        toks, done = _read_sse(
+            base + f"/v1/stream/dup-live?offset={len(prefix)}")
+        assert prefix + toks == ref_live
+        assert done["state"] == "FINISHED"
+        # a full re-read of the finished stream is the whole reference
+        toks_all, _ = _read_sse(base + "/v1/stream/dup-live?offset=0")
+        assert toks_all == ref_live
+
+        # terminal id from the previous life: the recovered result cache
+        res = json.load(urllib.request.urlopen(
+            base + "/v1/result/dup-done", timeout=30))
+        assert res["cached"] is True
+        assert res["state"] == "FINISHED"
+        assert res["tokens"] == ref_done
+        # resubmitting the terminal id answers from the cache too
+        body2 = json.dumps({"prompt": p_done.tolist(),
+                            "request_id": "dup-done"}).encode()
+        sub = json.load(urllib.request.urlopen(urllib.request.Request(
+            base + "/v1/submit", data=body2, method="POST"), timeout=30))
+        assert sub["cached"] is True and sub["tokens"] == ref_done
+    finally:
+        gw.close()
+
+
+def test_healthz_readiness_split_during_replay(model, tmp_path):
+    """Satellite 1: while WAL replay is in flight the gateway is ALIVE
+    but not READY — ``/healthz`` 503 with Retry-After and a
+    ``recovering`` status, ``/livez`` 200 throughout — and flips to 200
+    only once recovery hands routing back."""
+    gate = threading.Event()
+
+    class BlockingWAL(GatewayWAL):
+        def recover(self):
+            gate.wait(30)
+            return super().recover()
+
+    pool = ReplicaPool(model, replicas=1, wal=BlockingWAL(
+        str(tmp_path / "wal")), background=True, **POOL_KW)
+    gw = Gateway(pool, port=0).start()
+    base = f"http://127.0.0.1:{gw.port}"
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(base + "/healthz", timeout=10)
+        assert ei.value.code == 503
+        assert float(ei.value.headers["Retry-After"]) > 0
+        assert json.load(ei.value)["status"] == "recovering"
+        # liveness stays green: an orchestrator must NOT restart a
+        # gateway that is busy replaying its log
+        lv = json.load(urllib.request.urlopen(base + "/livez", timeout=10))
+        assert lv["status"] == "alive"
+
+        gate.set()
+        seen = _wait_ready(base, 60)
+        assert seen[-1] == "ok"
+    finally:
+        gate.set()
+        gw.close()
+
+
+# -------------------------------------------------- shutdown ordering
+
+
+def test_close_orders_wal_flush_before_worker_reap(model, tmp_path):
+    """Satellite 2 regression: on a clean close the WAL's terminal sweep
+    and final fsync land strictly BEFORE the worker processes are
+    reaped — a shutdown interleaving the two would journal streams as
+    live that the workers already finished. A reopened WAL must replay
+    zero live records after a clean close."""
+    d = str(tmp_path / "wal")
+    wal = GatewayWAL(d)
+    pool = ProcessReplicaPool(worker_model, replicas=1, background=True,
+                              wal=wal, respawn_backoff=0.5,
+                              heartbeat_interval=0.2, heartbeat_misses=5,
+                              worker_timeout=10.0, **POOL_KW)
+    try:
+        rng = np.random.default_rng(19)
+        p = _prompt(rng, 6)
+        ref = _ref(model, p, 6)
+        rr = pool.submit(p, max_new_tokens=6, request_id="w1")
+        out = pool.result(rr, timeout=180)
+        np.testing.assert_array_equal(out, ref)
+    except BaseException:
+        pool.close()
+        raise
+
+    order = []
+    orig_close, orig_reap = wal.close, pool._reap_workers
+
+    def traced_close():
+        order.append("wal-close")
+        orig_close()
+
+    def traced_reap(*a, **kw):
+        order.append("reap")
+        return orig_reap(*a, **kw)
+
+    wal.close = traced_close
+    pool._reap_workers = traced_reap
+    pool.close()
+    assert "wal-close" in order and "reap" in order
+    assert order.index("wal-close") < order.index("reap")
+
+    rec = GatewayWAL(d).recover()
+    assert rec["live"] == []  # a clean shutdown leaves nothing live
+    assert rec["results"]["w1"]["state"] == "FINISHED"
+    assert rec["results"]["w1"]["tokens"] == [int(t) for t in ref[6:]]
+
+
+# ------------------------------------------------------- chaos e2e
+
+
+def _boot_harness(wal_dir):
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "paddle_tpu.serving.gateway.wal_harness",
+         "--wal-dir", wal_dir],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        cwd=REPO, env=env, text=True)
+    line = proc.stdout.readline()
+    assert line, "harness died before announcing its port"
+    info = json.loads(line)
+    return proc, f"http://127.0.0.1:{info['port']}", info["pid"]
+
+
+def _kill_proc(proc):
+    if proc.poll() is None:
+        proc.kill()
+    proc.wait(timeout=30)
+    if proc.stdout is not None:
+        proc.stdout.close()
+
+
+def test_sigkill_chaos_exactly_once_across_restart(model, tmp_path):
+    """THE acceptance chaos: a real gateway process SIGKILL'd mid-stream
+    (greedy + seeded-sampled + constrained in flight), a second process
+    booted on the same WAL dir, and every accepted stream finishes
+    token-for-token identical to an in-process reference — the resumed
+    ``?offset=`` client sees no duplicate and no gap, the terminal-id
+    retry is served from the cache, and the decode/prefill compile
+    counters are FROZEN from the first resumed stream's completion on
+    (journal replay reuses every compiled program)."""
+    d = str(tmp_path / "wal")
+    rng = np.random.default_rng(29)
+    pg, ps, pc = _prompt(rng, 6), _prompt(rng, 6), _prompt(rng, 5)
+
+    # references: the harness seeds paddle.seed(0) exactly like
+    # worker_model(), so weights (hence streams) match in-process
+    ref_g = [int(t) for t in _ref(model, pg, 24)[6:]]
+    refpool = ReplicaPool(model, replicas=1, **POOL_KW)
+    qs = refpool.submit(ps, max_new_tokens=24,
+                        sampling=SamplingParams(temperature=0.9, seed=7))
+    qc = refpool.submit(pc, max_new_tokens=8, stop_token_id=3,
+                        constraint=TrieConstraint(
+                            CHOICES, vocab_size=refpool.vocab_size(),
+                            stop_token_id=3))
+    refpool.run_until_idle()
+    ref_s, ref_c = list(qs.tokens()), list(qc.tokens())
+    refpool.close()
+
+    proc1, base1, pid1 = _boot_harness(d)
+    seen = []
+    try:
+        _wait_ready(base1)
+        for body in (
+                {"prompt": pg.tolist(), "max_new_tokens": 24,
+                 "request_id": "cg"},
+                {"prompt": ps.tolist(), "max_new_tokens": 24,
+                 "temperature": 0.9, "seed": 7, "request_id": "cs"},
+                {"prompt": pc.tolist(), "max_new_tokens": 8,
+                 "stop_token_id": 3, "choices": CHOICES,
+                 "request_id": "cc"}):
+            sub = json.load(urllib.request.urlopen(urllib.request.Request(
+                base1 + "/v1/submit", data=json.dumps(body).encode(),
+                method="POST"), timeout=120))
+            assert sub["request_id"] == body["request_id"]
+        # stream a few greedy tokens — the pre-crash client's prefix —
+        # then pull the plug mid-decode (kill -9: no drain, no atexit)
+        try:
+            with urllib.request.urlopen(base1 + "/v1/stream/cg",
+                                        timeout=120) as resp:
+                event = None
+                for line in resp:
+                    line = line.decode().strip()
+                    if line.startswith("event:"):
+                        event = line.split(":", 1)[1].strip()
+                    elif line.startswith("data:"):
+                        dd = json.loads(line.split(":", 1)[1])
+                        if event != "done":
+                            seen.append(dd["token"])
+                        event = None
+                    if len(seen) >= 4:
+                        break
+        except (OSError, urllib.error.URLError):
+            pass
+        assert len(seen) >= 4 and len(seen) < len(ref_g)
+        os.kill(pid1, signal.SIGKILL)
+        proc1.wait(timeout=60)
+    finally:
+        _kill_proc(proc1)
+
+    proc2, base2, pid2 = _boot_harness(d)
+    try:
+        _wait_ready(base2)
+        # resume exactly where the dead connection left the client: the
+        # recovered stream replays deterministically, so offset=N is
+        # no-dup/no-gap even for tokens that outran the journal's fsync
+        toks, done = _read_sse(base2 + f"/v1/stream/cg?offset={len(seen)}")
+        assert seen + toks == ref_g
+        assert done["state"] == "FINISHED"
+        st1 = json.load(urllib.request.urlopen(base2 + "/v1/stats",
+                                               timeout=30))
+
+        rs = json.load(urllib.request.urlopen(
+            base2 + "/v1/result/cs?timeout=120", timeout=150))
+        assert rs["tokens"] == ref_s
+        rc = json.load(urllib.request.urlopen(
+            base2 + "/v1/result/cc?timeout=120", timeout=150))
+        assert rc["tokens"] == ref_c
+        assert rc["tokens"] in ([5, 6, 7, 3], [5, 9, 3])
+
+        # compile counters froze once the first resumed stream finished:
+        # recovery re-used every compiled program for the rest
+        st2 = json.load(urllib.request.urlopen(base2 + "/v1/stats",
+                                               timeout=30))
+        for key in ("serving.decode_compiles", "serving.prefill_compiles"):
+            assert st2["compile"].get(key, 0) == st1["compile"].get(key, 0)
+        assert st2["pool"]["wal"]["results_cached"] >= 3
+
+        # let the background sweep commit the terminal records before
+        # this incarnation dies too
+        time.sleep(0.3)
+    finally:
+        _kill_proc(proc2)
+
+    # a THIRD incarnation replays only terminal records: the retried id
+    # is served from the recovered result cache with ZERO decode work
+    proc3, base3, _pid3 = _boot_harness(d)
+    try:
+        _wait_ready(base3)
+        sub = json.load(urllib.request.urlopen(urllib.request.Request(
+            base3 + "/v1/submit",
+            data=json.dumps({"prompt": pg.tolist(),
+                             "request_id": "cg"}).encode(),
+            method="POST"), timeout=30))
+        assert sub["cached"] is True and sub["tokens"] == ref_g
+        res = json.load(urllib.request.urlopen(
+            base3 + "/v1/result/cs", timeout=30))
+        assert res["cached"] is True and res["tokens"] == ref_s
+        st3 = json.load(urllib.request.urlopen(base3 + "/v1/stats",
+                                               timeout=30))
+        assert st3["compile"].get("serving.decode_compiles", 0) == 0
+    finally:
+        _kill_proc(proc3)

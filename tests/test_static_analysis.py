@@ -128,6 +128,14 @@ FIXTURE_CASES = [
     ("traced-branch", "compiled_disagg", ()),
     ("traced-cast", "compiled_disagg", ()),
     ("unguarded-mutation", "concurrency_disagg", ()),
+    # the ISSUE 20 crash-safe-gateway shapes: (a) WAL record serialization
+    # from inside the compiled decode step — the token delta int()-cast
+    # under trace instead of materialized once per commit batch around
+    # the dispatch; (b) the per-stream journal high-water mark advanced
+    # lock-free while the finalizer's terminal sweep reads it under the
+    # stream lock (a journal-the-same-token-twice race)
+    ("traced-cast", "compiled_wal", ()),
+    ("unguarded-mutation", "concurrency_wal", ()),
     ("broad-except", "hygiene_broad_except", ()),
 ]
 

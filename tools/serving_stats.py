@@ -98,6 +98,17 @@ unified), the restore-ahead planner's ``disagg.prefetches`` /
 ``disagg.prefetched_chains`` / ``disagg.prefetched_blocks``, and the
 publish side's ``tier.published_blocks`` (full KV blocks write-through-
 published to the shared disk tier during chunked prefill).
+The crash-safe gateway (``FLAGS_gateway_wal``, ``serving.gateway.wal``)
+adds the ``wal.*`` namespace: ``wal.records`` / ``wal.accepted`` /
+``wal.emitted_tokens`` / ``wal.terminals`` (journal writes),
+``wal.commits`` (batched fsyncs — one per pump sweep, not per token),
+``wal.rotations`` / ``wal.compactions`` / ``wal.carried``
+(segment lifecycle: sealed segments whose every stream is terminal are
+deleted, live/result records carried forward), ``wal.replayed`` /
+``wal.replayed_live`` / ``wal.replayed_results`` (restart recovery) and
+``wal.torn_tail`` (crc/length-truncated tail records discarded on
+replay — also bumped on the resilience surface), plus the end-of-run
+``wal.segments`` / ``wal.bytes`` occupancy gauges.
 The observability plane (ISSUE 17, docs/observability.md) adds the
 ``latency.*`` histograms (ttft, inter_token, queue_wait, prefill,
 decode_step, restore, e2e, ... — recorded host-side around compiled
@@ -196,6 +207,13 @@ def _config_report() -> dict:
         "gateway_prefetch": _flag_env("gateway_prefetch", 0),
         "serving_tier_publish": _flag_env("serving_tier_publish", 0),
         "serving_publish_chunks": _flag_env("serving_publish_chunks", 0),
+        # crash-safe gateway WAL (serving.gateway.wal; 0 = no journal,
+        # bit-for-bit the non-durable gateway)
+        "gateway_wal": _flag_env("gateway_wal", 0),
+        "gateway_wal_dir": _flag_env("gateway_wal_dir", ""),
+        "gateway_wal_segment_bytes": _flag_env("gateway_wal_segment_bytes",
+                                               1 << 20),
+        "gateway_wal_results": _flag_env("gateway_wal_results", 256),
     }
 
 
@@ -247,7 +265,8 @@ def main(argv=None) -> int:
                                          "gateway", "tenant", "sampling",
                                          "constrain", "lora", "kernel",
                                          "mesh", "tier", "telemetry",
-                                         "serving", "worker", "disagg")}
+                                         "serving", "worker", "disagg",
+                                         "wal")}
         # latency histograms recorded during the run (ISSUE 17): the same
         # per-run delta discipline as the counters, rendered as percentiles
         hists = telemetry.histograms_delta(hists_before)
