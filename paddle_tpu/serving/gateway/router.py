@@ -598,9 +598,18 @@ class ReplicaPool:
             # (gateway._submit), so an acknowledged client always finds
             # its stream after a crash. The sweep skips un-accepted
             # handles, so no EMITTED record can ever precede its
-            # ACCEPTED in the log.
-            self.wal.accepted(rr, constraint_spec)
-            rr._wal_accepted = True
+            # ACCEPTED in the log. Appending and flagging under
+            # rr._wal_lock keeps a concurrent finalize's TERMINAL
+            # strictly behind the ACCEPTED record.
+            with rr._wal_lock:
+                self.wal.accepted(rr, constraint_spec)
+                rr._wal_accepted = True
+            if rr.finished:
+                # the stream finished (and was swept) before its
+                # ACCEPTED record existed — that sweep's _wal_finalize
+                # saw _wal_accepted False and skipped the TERMINAL;
+                # write it now or replay resurrects a finished stream
+                self._wal_finalize(rr)
         metrics.bump("gateway.routed")
         return rr
 
@@ -975,10 +984,13 @@ class ReplicaPool:
         the last EMITTED delta plus the full stream for the bounded
         result cache."""
         wal = self.wal
-        if wal is None or not rr._wal_accepted:
+        if wal is None:
             return
         with rr._wal_lock:
-            if rr._wal_terminal:
+            # _wal_accepted is read under the lock: submit sets it in
+            # the same critical section as the ACCEPTED append, so a
+            # TERMINAL can never land ahead of (or instead of) it
+            if not rr._wal_accepted or rr._wal_terminal:
                 return
             rr._wal_terminal = True
             tail = rr.tokens_from(rr._wal_logged)

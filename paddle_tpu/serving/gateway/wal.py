@@ -257,7 +257,9 @@ class GatewayWAL:
             elif kind == "T":
                 entry = self._live.pop(rid, None)
                 toks = list(entry["toks"]) if entry is not None else []
-                toks.extend(int(t) for t in rec.get("toks", ()))
+                # a compaction tombstone carries "toks": None (the result
+                # aged out of the bounded cache) — terminal-only, no tail
+                toks.extend(int(t) for t in rec.get("toks") or ())
                 self._terminal.add(rid)
                 if entry is not None or rec.get("toks") is not None:
                     self._remember_result(rid, rec.get("state", "FAILED"),
@@ -431,10 +433,26 @@ class GatewayWAL:
                         carries.append({"t": "T", "rid": rid,
                                         "state": "FAILED", "toks": None})
                     else:
+                        # no records for rid anywhere: terminal
+                        # membership has nothing left to guard
                         self._rid_segments.pop(rid, None)
+                        self._terminal.discard(rid)
             for rec in carries:
                 self._append(rec)
                 metrics.bump("wal.carried")
+            if carries:
+                # the carries must be durable BEFORE the old segment
+                # disappears — a crash in between would forget an
+                # already-acknowledged terminal result. _commit_lock
+                # (held by our caller) keeps the fd open under the sync.
+                with self._lock:
+                    if self._closed:
+                        return
+                    self._fh.flush()
+                    self._dirty = False
+                    fd = self._fh.fileno()
+                os.fsync(fd)
+                metrics.bump("wal.commits")
             try:
                 os.unlink(_seg_path(self.dir, seq))
             except OSError:

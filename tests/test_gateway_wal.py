@@ -201,6 +201,66 @@ def test_wal_rotation_and_compaction_carry_forward(tmp_path):
     assert rec["results"]["c2"]["tokens"] == [7]
 
 
+def test_wal_tombstone_replay_and_terminal_gc(tmp_path):
+    """A terminal request whose result aged out of the bounded cache
+    compacts to a token-free tombstone while later segments still hold
+    its records; replaying that tombstone must come up clean (terminal,
+    no result, no resurrection), not crash recovery on ``toks: None``.
+    And a rid compacted away with NO surviving records drops out of the
+    terminal set instead of leaking for the life of the process."""
+    d = str(tmp_path / "wal")
+    w = GatewayWAL(d, segment_bytes=1, result_cap=1)
+    # x spans three segments (A | E | T in seg 0/1/2) so compacting the
+    # older ones needs a tombstone; y evicts x's result from the 1-deep
+    # cache before compaction runs, forcing the toks-free T form
+    w.accepted(_rr("x"))
+    w.commit()                    # seals seg0 (x live: survives intact)
+    w.emitted("x", [1])
+    w.commit()                    # seals seg1 (x still live)
+    w.terminal("x", "FINISHED", [2], [1, 2])
+    w.accepted(_rr("y"))
+    w.terminal("y", "FINISHED", [3], [3])   # cap 1: x's result evicted
+    w.commit()   # everything terminal: seg0..2 compact via tombstones
+    assert not os.path.exists(os.path.join(d, "wal-00000000.log"))
+    w.close()
+
+    rec = GatewayWAL(d).recover()   # must not raise on the tombstone
+    assert rec["live"] == []                 # x never resurrects...
+    assert "x" not in rec["results"]         # ...and stays forgotten
+    assert rec["results"]["y"]["tokens"] == [3]
+
+    # terminal-set GC: z lives and dies entirely inside seg0, its result
+    # is evicted before compaction — no carry, no surviving records, so
+    # terminal membership has nothing left to guard and is discarded
+    d2 = str(tmp_path / "wal2")
+    w2 = GatewayWAL(d2, segment_bytes=1, result_cap=1)
+    w2.accepted(_rr("z"))
+    w2.terminal("z", "FINISHED", [1], [1])
+    w2.accepted(_rr("q"))
+    w2.terminal("q", "FINISHED", [2], [2])   # evicts z's result
+    w2.commit()   # seg0 compacts: q carries forward (R), z drops whole
+    assert w2.stats()["terminal"] == 1       # q only; z not leaked
+    w2.close()
+
+
+def test_wal_compaction_carry_durable_before_unlink(tmp_path):
+    """Compaction fsyncs its carry-forwards into the active segment
+    BEFORE unlinking the compacted one: a crash right after the unlink
+    (no close, no further commit) must still replay the carried result —
+    an acknowledged ``/v1/result`` can never regress to 404."""
+    d = str(tmp_path / "wal")
+    w = GatewayWAL(d, segment_bytes=1, result_cap=8)
+    w.accepted(_rr("c1"))
+    w.terminal("c1", "FINISHED", [1, 2], [1, 2])
+    w.commit()   # seals + compacts seg0, carrying c1's result forward
+    assert not os.path.exists(os.path.join(d, "wal-00000000.log"))
+    # crash here: NO close(), NO later commit — the carry must already
+    # be on disk, not sitting in the userspace write buffer
+    rec = GatewayWAL(d).recover()
+    assert rec["live"] == []
+    assert rec["results"]["c1"] == {"state": "FINISHED", "tokens": [1, 2]}
+
+
 # ------------------------------------------------- in-process recovery
 
 
@@ -281,6 +341,44 @@ def test_pool_crash_recovery_token_parity(model, tmp_path):
             pool2.close()
         paddle.set_flags(keep)
         telemetry.reset_tracelog()
+
+
+def test_wal_terminal_not_skipped_when_finalized_during_submit(
+        model, tmp_path):
+    """A stream that finishes — and is swept — in the window between
+    routing and the ACCEPTED append must still get its TERMINAL record:
+    an A-only log would replay the finished stream as live and re-decode
+    it after restart (regression: the sweep's ``_wal_finalize`` checked
+    ``_wal_accepted`` before ``submit`` had set it)."""
+    d = str(tmp_path / "wal")
+    rng = np.random.default_rng(23)
+    p = _prompt(rng, 6)
+    ref = _ref(model, p, 4)
+    pool = ReplicaPool(model, replicas=1, wal=GatewayWAL(d), **POOL_KW)
+    orig_route = ReplicaPool._route
+
+    def route_then_sweep(self, rr, journal):
+        # deterministic worst case of the race: the stream runs to
+        # completion and the sweep finalizes it BEFORE submit's WAL
+        # block has appended the ACCEPTED record
+        orig_route(self, rr, journal)
+        self.run_until_idle()
+        assert rr.finished
+
+    ReplicaPool._route = route_then_sweep
+    try:
+        rr = pool.submit(p, max_new_tokens=4, request_id="early")
+    finally:
+        ReplicaPool._route = orig_route
+    assert rr.state == RequestState.FINISHED
+    toks = list(rr.tokens())
+    np.testing.assert_array_equal(np.concatenate([p, toks]), ref)
+    pool.close()
+
+    rec = GatewayWAL(d).recover()
+    assert rec["live"] == []        # the TERMINAL made it into the log
+    assert rec["results"]["early"]["state"] == RequestState.FINISHED
+    assert rec["results"]["early"]["tokens"] == toks
 
 
 # ------------------------------------------------ HTTP exactly-once
